@@ -1,0 +1,40 @@
+"""nos_tpu — a TPU-native rebuild of the `nos` GPU-orchestration stack.
+
+`nos` (reference: /root/reference, module github.com/nebuly-ai/nos) raises
+accelerator utilization on Kubernetes clusters via dynamic partitioning and
+elastic resource quotas. This package rebuilds that capability TPU-first:
+
+- ``nos_tpu.kube``         — in-process Kubernetes API machinery + controller
+                             runtime (the reference uses controller-runtime;
+                             here a self-contained, envtest-style equivalent).
+- ``nos_tpu.tpu``          — the TPU domain library: slice topologies, chip
+                             sub-slicing geometries, ICI adjacency, annotation
+                             codec (analog of reference pkg/gpu + pkg/gpu/mig +
+                             pkg/gpu/slicing).
+- ``nos_tpu.api``          — CRD-equivalent API types: ElasticQuota,
+                             CompositeElasticQuota, component configs, webhooks
+                             (analog of pkg/api/nos.nebuly.com/v1alpha1).
+- ``nos_tpu.quota``        — ElasticQuota / CompositeElasticQuota controllers
+                             (analog of internal/controllers/elasticquota).
+- ``nos_tpu.scheduler``    — CapacityScheduling-equivalent scheduler plugin
+                             with quota-aware preemption and TPU gang
+                             scheduling (analog of
+                             pkg/scheduler/plugins/capacityscheduling).
+- ``nos_tpu.partitioning`` — the cluster-level partitioning control plane:
+                             snapshot, planner, actuator, state (analog of
+                             internal/partitioning).
+- ``nos_tpu.agents``       — node agents: tpuagent reporter/actuator over the
+                             native device layer (analog of
+                             internal/controllers/migagent + gpuagent).
+- ``nos_tpu.parallel``     — parallelism layout math: (dp, fsdp, tp, pp, sp, ep)
+                             layouts -> required slice topology; JAX mesh
+                             builders and sharding rules for workloads.
+- ``nos_tpu.models``/``ops`` — the JAX workload plane used by the benchmark
+                             demo (the reference's only published benchmark is
+                             N inference pods sharing one accelerator,
+                             demos/gpu-sharing-comparison/README.md).
+- ``nos_tpu.utils``        — batcher, permutations, generic helpers, pod
+                             classification (analog of pkg/util).
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,98 @@
+"""Well-known resource names, labels, annotations and defaults.
+
+Analog of reference pkg/constant/constants.go:23-115 and
+pkg/api/nos.nebuly.com/v1alpha1/{annotations,labels,constants}.go, re-keyed
+for TPUs: the partitionable resource is ``google.com/tpu`` (GKE TPU device
+plugin) instead of ``nvidia.com/gpu``; MIG-profile resources
+(``nvidia.com/mig-1g.10gb``) become TPU sub-slice resources
+(``nos.ai/tpu-slice-1x1``); GPU-feature-discovery labels become GKE TPU
+node labels (``cloud.google.com/gke-tpu-accelerator`` etc.).
+"""
+from __future__ import annotations
+
+import re
+
+# ---------------------------------------------------------------------------
+# Domain / prefixes
+# ---------------------------------------------------------------------------
+DOMAIN = "nos.ai"
+
+# ---------------------------------------------------------------------------
+# Resource names
+# ---------------------------------------------------------------------------
+# The whole-chip resource advertised by the (GKE) TPU device plugin.
+RESOURCE_TPU = "google.com/tpu"
+# Sub-slice resources advertised after dynamic per-host partitioning
+# (analog of nvidia.com/mig-1g.10gb; reference pkg/gpu/mig/profile.go:29-100).
+# Format: nos.ai/tpu-slice-<X>x<Y> — a sub-slice of a host's chip grid.
+RESOURCE_TPU_SLICE_PREFIX = DOMAIN + "/tpu-slice-"
+# Derived scalar resource: TPU HBM memory in GB (analog of
+# nos.nebuly.com/gpu-memory; reference pkg/api/nos.nebuly.com/v1alpha1/constants.go:25).
+RESOURCE_TPU_MEMORY = DOMAIN + "/tpu-memory"
+# Kept for mixed-cluster quota accounting (reference counts nvidia.com/gpu
+# and MIG resources; we count those *and* TPU chips under one quota system).
+RESOURCE_NVIDIA_GPU = "nvidia.com/gpu"
+RESOURCE_GPU_MEMORY = DOMAIN + "/gpu-memory"
+
+TPU_SLICE_RESOURCE_REGEX = re.compile(
+    r"^" + re.escape(RESOURCE_TPU_SLICE_PREFIX) + r"(\d+)x(\d+)$"
+)
+
+# ---------------------------------------------------------------------------
+# Node labels (reference: nvidia GFD labels, pkg/constant/constants.go)
+# ---------------------------------------------------------------------------
+# GKE-standard TPU node labels.
+LABEL_TPU_ACCELERATOR = "cloud.google.com/gke-tpu-accelerator"   # e.g. tpu-v5-lite-podslice
+LABEL_TPU_TOPOLOGY = "cloud.google.com/gke-tpu-topology"          # e.g. 2x4, 4x4x4
+LABEL_NODEPOOL = "cloud.google.com/gke-nodepool"
+# nos labels (analog of nos.nebuly.com/gpu-partitioning, pkg/gpu/partitioning.go:80-128).
+LABEL_PARTITIONING = DOMAIN + "/tpu-partitioning"                  # "subslicing" | "topology"
+LABEL_CAPACITY = DOMAIN + "/capacity"                              # in-quota | over-quota
+LABEL_DEVICE_PLUGIN_CONFIG = DOMAIN + "/device-plugin.config"
+
+# Partitioning kinds (reference: mig / mps / hybrid).
+PARTITIONING_SUBSLICING = "subslicing"   # per-host chip sub-slicing (v5e-style; MPS/MIG analog)
+PARTITIONING_TOPOLOGY = "topology"       # multi-host slice placement (gang; no GPU analog)
+
+CAPACITY_IN_QUOTA = "in-quota"
+CAPACITY_OVER_QUOTA = "over-quota"
+
+# ---------------------------------------------------------------------------
+# Node annotations — the spec/status wire protocol
+# (reference pkg/api/nos.nebuly.com/v1alpha1/annotations.go:20-42)
+# ---------------------------------------------------------------------------
+# Desired (written by the partitioner control plane):
+#   nos.ai/spec-tpu-<hostIndex>-<profile>: "<quantity>"
+# Observed (written by the node tpuagent):
+#   nos.ai/status-tpu-<hostIndex>-<profile>-<free|used>: "<quantity>"
+ANNOTATION_SPEC_PREFIX = DOMAIN + "/spec-tpu-"
+ANNOTATION_STATUS_PREFIX = DOMAIN + "/status-tpu-"
+ANNOTATION_PARTITIONING_PLAN = DOMAIN + "/spec-partitioning-plan"
+ANNOTATION_REPORTED_PARTITIONING_PLAN = DOMAIN + "/status-partitioning-plan"
+
+ANNOTATION_SPEC_REGEX = re.compile(
+    r"^" + re.escape(ANNOTATION_SPEC_PREFIX) + r"(\d+)-([a-z0-9.x\-]+)$"
+)
+ANNOTATION_STATUS_REGEX = re.compile(
+    r"^" + re.escape(ANNOTATION_STATUS_PREFIX) + r"(\d+)-([a-z0-9.x\-]+)-(free|used)$"
+)
+
+# ---------------------------------------------------------------------------
+# Defaults (reference pkg/constant/constants.go + helm values)
+# ---------------------------------------------------------------------------
+DEFAULT_TPU_MEMORY_GB = 16          # HBM per chip if the generation is unknown
+DEFAULT_NVIDIA_GPU_MEMORY_GB = 32   # reference helm-charts/nos/values.yaml:7
+DEFAULT_BATCH_WINDOW_TIMEOUT_S = 60.0   # reference values.yaml:276
+DEFAULT_BATCH_WINDOW_IDLE_S = 10.0      # reference values.yaml:283
+DEFAULT_REPORT_INTERVAL_S = 10.0        # migagent report interval
+DEFAULT_DEVICE_PLUGIN_DELAY_S = 5.0     # mps partitioner CM propagation delay
+DEFAULT_POD_RESOURCES_TIMEOUT_S = 10.0
+
+# Scheduler / controller names
+SCHEDULER_NAME = "nos-scheduler"
+DEVICE_PLUGIN_CONFIGMAP = "nos-device-plugin-config"
+DEVICE_PLUGIN_NAMESPACE = "kube-system"
+
+# Field-index keys (reference pkg/constant: pod spec.nodeName / status.phase indexes)
+INDEX_POD_PHASE = "status.phase"
+INDEX_POD_NODE = "spec.nodeName"

@@ -1,0 +1,45 @@
+"""In-process Kubernetes API machinery and controller runtime.
+
+The reference (`nos`) is built on controller-runtime and coordinates all of
+its components through the Kubernetes API server — node annotations as a
+spec/status wire protocol, labels, ConfigMaps, CRD status (SURVEY §1;
+reference pkg/api/nos.nebuly.com/v1alpha1/annotations.go:20-42). Its
+integration tests run against envtest, a real in-process API server
+(reference internal/controllers/elasticquota/suite_int_test.go:58-60).
+
+This package provides the equivalent substrate without external binaries:
+
+- typed objects (Pod, Node, ConfigMap, CRD-style types) with metadata,
+- an in-process API server (``ApiServer``) with resourceVersion bookkeeping,
+  optimistic-concurrency updates, merge patches, label/field selection,
+  field indexes and watch streams,
+- a controller runtime (``Manager``/``Controller``) with work-queues,
+  event predicates, and deterministic ``run_until_idle`` pumping for tests,
+- quantity parsing compatible with Kubernetes resource strings.
+
+Production deployments would bind the same ``Client`` protocol to a real
+API server; every controller in nos_tpu is written against the protocol,
+not the fake.
+"""
+from nos_tpu.kube.objects import (  # noqa: F401
+    ObjectMeta,
+    Container,
+    Pod,
+    PodSpec,
+    PodStatus,
+    PodCondition,
+    Node,
+    NodeStatus,
+    ConfigMap,
+    OwnerReference,
+)
+from nos_tpu.kube.quantity import parse_quantity, format_quantity  # noqa: F401
+from nos_tpu.kube.apiserver import ApiServer, Conflict, NotFound, AlreadyExists  # noqa: F401
+from nos_tpu.kube.client import Client  # noqa: F401
+from nos_tpu.kube.controller import (  # noqa: F401
+    Manager,
+    Controller,
+    Request,
+    Result,
+    Event,
+)

@@ -1,0 +1,221 @@
+"""In-process Kubernetes API server double.
+
+The reference's integration tier runs controllers against envtest — a real
+kube-apiserver + etcd (reference Makefile:103-106, suite_int_test.go files).
+``ApiServer`` plays that role in-process: typed object storage with
+uid/resourceVersion bookkeeping, optimistic-concurrency updates, functional
+merge patches, label selection, field indexes (analog of the reference's
+controller-runtime field indexers, cmd/gpupartitioner/gpupartitioner.go:270-292),
+admission hooks (analog of the validating webhooks,
+pkg/api/nos.nebuly.com/v1alpha1/*_webhook.go), and watch streams that feed
+the controller runtime's work-queues.
+"""
+from __future__ import annotations
+
+import itertools
+import threading
+import time
+from collections import deque
+from dataclasses import dataclass
+from typing import Callable, Dict, List, Optional, Tuple
+
+from nos_tpu.kube.objects import deep_copy, kind_of
+
+
+class ApiError(Exception):
+    pass
+
+
+class NotFound(ApiError):
+    pass
+
+
+class AlreadyExists(ApiError):
+    pass
+
+
+class Conflict(ApiError):
+    pass
+
+
+class AdmissionDenied(ApiError):
+    pass
+
+
+@dataclass
+class WatchEvent:
+    type: str           # "ADDED" | "MODIFIED" | "DELETED"
+    kind: str
+    obj: object         # new object (for DELETED: last state)
+    old: Optional[object] = None
+
+
+class Subscription:
+    """A watch stream: the server appends events; consumers pop them."""
+
+    def __init__(self, kinds: Optional[List[str]] = None):
+        self.kinds = set(kinds) if kinds else None
+        self._events: deque[WatchEvent] = deque()
+        self._lock = threading.Lock()
+
+    def _push(self, ev: WatchEvent) -> None:
+        if self.kinds is not None and ev.kind not in self.kinds:
+            return
+        with self._lock:
+            self._events.append(ev)
+
+    def pop(self) -> Optional[WatchEvent]:
+        with self._lock:
+            return self._events.popleft() if self._events else None
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._events)
+
+
+Key = Tuple[str, str]  # (namespace, name); cluster-scoped objects use ns ""
+
+
+class ApiServer:
+    def __init__(self, clock: Callable[[], float] = time.time):
+        self._clock = clock
+        self._lock = threading.RLock()
+        self._store: Dict[str, Dict[Key, object]] = {}
+        self._rv = itertools.count(1)
+        self._uid = itertools.count(1)
+        self._subs: List[Subscription] = []
+        # field indexes: (kind, index_key) -> extractor(obj) -> str | None
+        self._indexers: Dict[Tuple[str, str], Callable[[object], Optional[str]]] = {}
+        # admission hooks: kind -> [fn(server, op, obj, old) raising AdmissionDenied]
+        self._admission: Dict[str, List[Callable]] = {}
+
+    # -- admission / indexes ------------------------------------------------
+    def register_admission(self, kind: str, hook: Callable) -> None:
+        self._admission.setdefault(kind, []).append(hook)
+
+    def register_index(self, kind: str, key: str, extractor: Callable[[object], Optional[str]]) -> None:
+        self._indexers[(kind, key)] = extractor
+
+    def _admit(self, op: str, obj, old) -> None:
+        for hook in self._admission.get(kind_of(obj), []):
+            hook(self, op, obj, old)
+
+    # -- watch --------------------------------------------------------------
+    def subscribe(self, kinds: Optional[List[str]] = None) -> Subscription:
+        sub = Subscription(kinds)
+        with self._lock:
+            self._subs.append(sub)
+        return sub
+
+    def unsubscribe(self, sub: Subscription) -> None:
+        with self._lock:
+            if sub in self._subs:
+                self._subs.remove(sub)
+
+    def _emit(self, ev: WatchEvent) -> None:
+        for sub in self._subs:
+            sub._push(ev)
+
+    # -- CRUD ---------------------------------------------------------------
+    def create(self, obj) -> object:
+        with self._lock:
+            kind = kind_of(obj)
+            obj = deep_copy(obj)
+            key = (obj.metadata.namespace, obj.metadata.name)
+            bucket = self._store.setdefault(kind, {})
+            if key in bucket:
+                raise AlreadyExists(f"{kind} {key} already exists")
+            self._admit("CREATE", obj, None)
+            obj.metadata.uid = f"uid-{next(self._uid)}"
+            obj.metadata.resource_version = next(self._rv)
+            if not obj.metadata.creation_timestamp:
+                obj.metadata.creation_timestamp = self._clock()
+            bucket[key] = deep_copy(obj)
+            self._emit(WatchEvent("ADDED", kind, deep_copy(obj)))
+            return deep_copy(obj)
+
+    def get(self, kind: str, name: str, namespace: str = "") -> object:
+        with self._lock:
+            try:
+                return deep_copy(self._store[kind][(namespace, name)])
+            except KeyError:
+                raise NotFound(f"{kind} {namespace}/{name} not found") from None
+
+    def try_get(self, kind: str, name: str, namespace: str = "") -> Optional[object]:
+        try:
+            return self.get(kind, name, namespace)
+        except NotFound:
+            return None
+
+    def list(
+        self,
+        kind: str,
+        namespace: Optional[str] = None,
+        label_selector: Optional[Dict[str, str]] = None,
+        index: Optional[Tuple[str, str]] = None,
+    ) -> List[object]:
+        """List objects; ``index=(key, value)`` filters via a registered field
+        index (e.g. ("status.phase", "Running"))."""
+        with self._lock:
+            out = []
+            for (ns, _name), obj in self._store.get(kind, {}).items():
+                if namespace is not None and ns != namespace:
+                    continue
+                if label_selector and any(
+                    obj.metadata.labels.get(k) != v for k, v in label_selector.items()
+                ):
+                    continue
+                if index is not None:
+                    extractor = self._indexers.get((kind, index[0]))
+                    if extractor is None:
+                        raise ApiError(f"no index {index[0]!r} registered for {kind}")
+                    if extractor(obj) != index[1]:
+                        continue
+                out.append(deep_copy(obj))
+            out.sort(key=lambda o: (o.metadata.namespace, o.metadata.name))
+            return out
+
+    def update(self, obj, *, check_version: bool = True) -> object:
+        with self._lock:
+            kind = kind_of(obj)
+            key = (obj.metadata.namespace, obj.metadata.name)
+            bucket = self._store.setdefault(kind, {})
+            if key not in bucket:
+                raise NotFound(f"{kind} {key} not found")
+            current = bucket[key]
+            if check_version and obj.metadata.resource_version != current.metadata.resource_version:
+                raise Conflict(
+                    f"{kind} {key}: resourceVersion {obj.metadata.resource_version} "
+                    f"!= {current.metadata.resource_version}"
+                )
+            obj = deep_copy(obj)
+            self._admit("UPDATE", obj, deep_copy(current))
+            obj.metadata.uid = current.metadata.uid
+            obj.metadata.creation_timestamp = current.metadata.creation_timestamp
+            obj.metadata.resource_version = next(self._rv)
+            old = deep_copy(current)
+            bucket[key] = deep_copy(obj)
+            self._emit(WatchEvent("MODIFIED", kind, deep_copy(obj), old))
+            return deep_copy(obj)
+
+    def patch(self, kind: str, name: str, namespace: str, mutate: Callable[[object], None]) -> object:
+        """Atomic read-modify-write — the moral equivalent of a merge PATCH
+        (the reference patches node annotations and pod labels constantly;
+        e.g. internal/partitioning/mig/partitioner.go:43-77)."""
+        with self._lock:
+            obj = self.get(kind, name, namespace)
+            before = deep_copy(obj)
+            mutate(obj)
+            obj.metadata.resource_version = before.metadata.resource_version
+            return self.update(obj)
+
+    def delete(self, kind: str, name: str, namespace: str = "") -> None:
+        with self._lock:
+            key = (namespace, name)
+            bucket = self._store.get(kind, {})
+            if key not in bucket:
+                raise NotFound(f"{kind} {namespace}/{name} not found")
+            obj = bucket[key]
+            self._admit("DELETE", obj, deep_copy(obj))
+            bucket.pop(key)
+            self._emit(WatchEvent("DELETED", kind, deep_copy(obj)))

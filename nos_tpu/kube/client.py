@@ -1,0 +1,44 @@
+"""Client facade over the API server.
+
+Controllers are written against ``Client`` (the reference writes against
+controller-runtime's client.Client). Binding it to the in-process
+``ApiServer`` gives the envtest-equivalent test rig; a production binding
+would speak to a real API server with the same surface.
+"""
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Optional, Tuple
+
+from nos_tpu.kube.apiserver import ApiServer
+
+
+class Client:
+    def __init__(self, server: ApiServer):
+        self.server = server
+
+    def create(self, obj):
+        return self.server.create(obj)
+
+    def get(self, kind: str, name: str, namespace: str = ""):
+        return self.server.get(kind, name, namespace)
+
+    def try_get(self, kind: str, name: str, namespace: str = ""):
+        return self.server.try_get(kind, name, namespace)
+
+    def list(
+        self,
+        kind: str,
+        namespace: Optional[str] = None,
+        label_selector: Optional[Dict[str, str]] = None,
+        index: Optional[Tuple[str, str]] = None,
+    ) -> List[object]:
+        return self.server.list(kind, namespace, label_selector, index)
+
+    def update(self, obj):
+        return self.server.update(obj)
+
+    def patch(self, kind: str, name: str, namespace: str, mutate: Callable[[object], None]):
+        return self.server.patch(kind, name, namespace, mutate)
+
+    def delete(self, kind: str, name: str, namespace: str = ""):
+        return self.server.delete(kind, name, namespace)

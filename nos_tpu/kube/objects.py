@@ -1,0 +1,163 @@
+"""Typed Kubernetes-style objects.
+
+A minimal but faithful slice of the core/v1 types the reference manipulates
+(Pods, Nodes, ConfigMaps) plus the machinery CRD types build on. Resource
+lists are plain ``dict[str, float]`` in base units (see quantity.py).
+"""
+from __future__ import annotations
+
+import copy
+import dataclasses
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+ResourceList = Dict[str, float]
+
+
+def add_resources(a: ResourceList, b: ResourceList) -> ResourceList:
+    out = dict(a)
+    for k, v in b.items():
+        out[k] = out.get(k, 0) + v
+    return out
+
+
+def sub_resources(a: ResourceList, b: ResourceList) -> ResourceList:
+    out = dict(a)
+    for k, v in b.items():
+        out[k] = out.get(k, 0) - v
+    return out
+
+
+def resources_fit(request: ResourceList, available: ResourceList) -> bool:
+    """True if every requested quantity is available."""
+    return all(available.get(k, 0) + 1e-9 >= v for k, v in request.items())
+
+
+def nonzero(r: ResourceList) -> ResourceList:
+    return {k: v for k, v in r.items() if v != 0}
+
+
+@dataclass
+class OwnerReference:
+    kind: str
+    name: str
+    uid: str = ""
+    controller: bool = False
+
+
+@dataclass
+class ObjectMeta:
+    name: str = ""
+    namespace: str = ""
+    uid: str = ""
+    resource_version: int = 0
+    creation_timestamp: float = 0.0
+    deletion_timestamp: Optional[float] = None
+    labels: Dict[str, str] = field(default_factory=dict)
+    annotations: Dict[str, str] = field(default_factory=dict)
+    owner_references: List[OwnerReference] = field(default_factory=list)
+
+
+@dataclass
+class Container:
+    name: str = "main"
+    image: str = ""
+    requests: ResourceList = field(default_factory=dict)
+    limits: ResourceList = field(default_factory=dict)
+
+
+@dataclass
+class PodSpec:
+    containers: List[Container] = field(default_factory=list)
+    init_containers: List[Container] = field(default_factory=list)
+    node_name: str = ""
+    scheduler_name: str = "default-scheduler"
+    priority: Optional[int] = None
+    priority_class_name: str = ""
+    node_selector: Dict[str, str] = field(default_factory=dict)
+
+
+@dataclass
+class PodCondition:
+    type: str = ""
+    status: str = ""    # "True" | "False" | "Unknown"
+    reason: str = ""
+    message: str = ""
+
+
+@dataclass
+class PodStatus:
+    phase: str = "Pending"   # Pending | Running | Succeeded | Failed
+    conditions: List[PodCondition] = field(default_factory=list)
+    nominated_node_name: str = ""
+
+
+@dataclass
+class Pod:
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    spec: PodSpec = field(default_factory=PodSpec)
+    status: PodStatus = field(default_factory=PodStatus)
+
+    KIND = "Pod"
+
+    # -- helpers mirroring k8s resource semantics ---------------------------
+    def request(self) -> ResourceList:
+        """Total pod resource request: max(sum(containers), max(initContainers))
+        per resource (standard k8s pod-request computation)."""
+        total: ResourceList = {}
+        for c in self.spec.containers:
+            total = add_resources(total, c.requests)
+        for ic in self.spec.init_containers:
+            for k, v in ic.requests.items():
+                if v > total.get(k, 0):
+                    total[k] = v
+        return total
+
+    def is_scheduled(self) -> bool:
+        return bool(self.spec.node_name)
+
+    def is_unschedulable(self) -> bool:
+        return any(
+            c.type == "PodScheduled" and c.status == "False" and c.reason == "Unschedulable"
+            for c in self.status.conditions
+        )
+
+    def priority(self) -> int:
+        return self.spec.priority if self.spec.priority is not None else 0
+
+
+@dataclass
+class NodeStatus:
+    capacity: ResourceList = field(default_factory=dict)
+    allocatable: ResourceList = field(default_factory=dict)
+
+
+@dataclass
+class Node:
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    status: NodeStatus = field(default_factory=NodeStatus)
+
+    KIND = "Node"
+
+
+@dataclass
+class ConfigMap:
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    data: Dict[str, str] = field(default_factory=dict)
+
+    KIND = "ConfigMap"
+
+
+def kind_of(obj) -> str:
+    k = getattr(obj, "KIND", None)
+    if k is None:
+        raise TypeError(f"object has no KIND: {type(obj)}")
+    return k
+
+
+def deep_copy(obj):
+    return copy.deepcopy(obj)
+
+
+def is_dataclass_obj(obj) -> bool:
+    return dataclasses.is_dataclass(obj) and not isinstance(obj, type)

@@ -1,0 +1,62 @@
+"""Watch-event predicates (analog of reference pkg/util/predicate/predicates.go:26-76).
+
+Predicates filter which watch events enqueue reconcile requests:
+
+- ``matching_name`` — only events for a specific object name (the node agents
+  watch only their own Node).
+- ``node_resources_changed`` — node capacity/allocatable changed.
+- ``annotations_changed`` — metadata.annotations changed (the MIG/tpu actuator
+  triggers on spec-annotation changes).
+- ``labels_changed`` — metadata.labels changed.
+- ``exclude_delete`` — drop DELETED events.
+"""
+from __future__ import annotations
+
+from typing import Callable
+
+from nos_tpu.kube.apiserver import WatchEvent
+
+Predicate = Callable[[WatchEvent], bool]
+
+
+def matching_name(name: str) -> Predicate:
+    def pred(ev: WatchEvent) -> bool:
+        return ev.obj.metadata.name == name
+    return pred
+
+
+def exclude_delete(ev: WatchEvent) -> bool:
+    return ev.type != "DELETED"
+
+
+def annotations_changed(ev: WatchEvent) -> bool:
+    if ev.type != "MODIFIED" or ev.old is None:
+        return True
+    return ev.obj.metadata.annotations != ev.old.metadata.annotations
+
+
+def labels_changed(ev: WatchEvent) -> bool:
+    if ev.type != "MODIFIED" or ev.old is None:
+        return True
+    return ev.obj.metadata.labels != ev.old.metadata.labels
+
+
+def node_resources_changed(ev: WatchEvent) -> bool:
+    if ev.type != "MODIFIED" or ev.old is None:
+        return True
+    return (
+        ev.obj.status.allocatable != ev.old.status.allocatable
+        or ev.obj.status.capacity != ev.old.status.capacity
+    )
+
+
+def all_of(*preds: Predicate) -> Predicate:
+    def pred(ev: WatchEvent) -> bool:
+        return all(p(ev) for p in preds)
+    return pred
+
+
+def any_of(*preds: Predicate) -> Predicate:
+    def pred(ev: WatchEvent) -> bool:
+        return any(p(ev) for p in preds)
+    return pred

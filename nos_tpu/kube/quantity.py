@@ -1,0 +1,39 @@
+"""Kubernetes resource-quantity parsing.
+
+Quantities appear in pod resource requests (``500m`` CPU, ``10Gi`` memory,
+``4`` TPU chips). Internally nos_tpu stores quantities as floats in base
+units (cores, bytes, chips) — the reference uses k8s resource.Quantity
+(reference pkg/gpu/util/resource.go:28-88 operates on v1.ResourceList).
+"""
+from __future__ import annotations
+
+import re
+
+_SUFFIXES = {
+    "": 1,
+    "n": 1e-9, "u": 1e-6, "m": 1e-3,
+    "k": 1e3, "M": 1e6, "G": 1e9, "T": 1e12, "P": 1e15, "E": 1e18,
+    "Ki": 2**10, "Mi": 2**20, "Gi": 2**30, "Ti": 2**40, "Pi": 2**50, "Ei": 2**60,
+}
+
+_QUANTITY_RE = re.compile(r"^([+-]?[0-9]*\.?[0-9]+(?:[eE][+-]?[0-9]+)?)([a-zA-Z]*)$")
+
+
+def parse_quantity(value) -> float:
+    """Parse a k8s quantity string (or passthrough numbers) to a float."""
+    if isinstance(value, (int, float)):
+        return float(value)
+    m = _QUANTITY_RE.match(str(value).strip())
+    if not m:
+        raise ValueError(f"invalid quantity: {value!r}")
+    number, suffix = m.groups()
+    if suffix not in _SUFFIXES:
+        raise ValueError(f"invalid quantity suffix: {value!r}")
+    return float(number) * _SUFFIXES[suffix]
+
+
+def format_quantity(value: float) -> str:
+    """Format a float quantity compactly (integers without decimal point)."""
+    if value == int(value):
+        return str(int(value))
+    return repr(value)

@@ -1,0 +1,9 @@
+"""Shared utility plane (analog of reference pkg/util)."""
+from nos_tpu.utils.generic import (  # noqa: F401
+    filter_list,
+    unordered_equal,
+    min_by,
+    max_by,
+)
+from nos_tpu.utils.stat import iter_permutations  # noqa: F401
+from nos_tpu.utils.batcher import Batcher  # noqa: F401

@@ -1,0 +1,39 @@
+"""Generic collection helpers (analog of reference pkg/util/util.go:106-199)."""
+from __future__ import annotations
+
+from typing import Callable, Dict, Iterable, List, Optional, TypeVar
+
+T = TypeVar("T")
+K = TypeVar("K")
+V = TypeVar("V")
+
+
+def filter_list(items: Iterable[T], keep: Callable[[T], bool]) -> List[T]:
+    return [i for i in items if keep(i)]
+
+
+def unordered_equal(a: Iterable[T], b: Iterable[T]) -> bool:
+    """True if the two iterables contain the same items regardless of order
+    (multiset equality, tolerating unhashable items)."""
+    la, lb = list(a), list(b)
+    if len(la) != len(lb):
+        return False
+    remaining = list(lb)
+    for item in la:
+        for j, other in enumerate(remaining):
+            if item == other:
+                del remaining[j]
+                break
+        else:
+            return False
+    return True
+
+
+def min_by(items: Iterable[T], key: Callable[[T], float]) -> Optional[T]:
+    items = list(items)
+    return min(items, key=key) if items else None
+
+
+def max_by(items: Iterable[T], key: Callable[[T], float]) -> Optional[T]:
+    items = list(items)
+    return max(items, key=key) if items else None

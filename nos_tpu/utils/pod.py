@@ -1,0 +1,40 @@
+"""Pod classification helpers (analog of reference pkg/util/pod/pod.go).
+
+The key gate is ``extra_resources_could_help_scheduling`` (reference
+pkg/util/pod/pod.go:41-49): the partitioning controller only plans for pods
+that are pending AND marked Unschedulable AND not already preempting AND not
+owned by a DaemonSet/Node (those are bound to a node regardless of
+resources).
+"""
+from __future__ import annotations
+
+from nos_tpu import constants
+from nos_tpu.kube.objects import Pod
+
+
+def is_pending(pod: Pod) -> bool:
+    return pod.status.phase == "Pending"
+
+
+def is_preempting(pod: Pod) -> bool:
+    return bool(pod.status.nominated_node_name)
+
+
+def is_owned_by_daemonset_or_node(pod: Pod) -> bool:
+    return any(o.kind in ("DaemonSet", "Node") for o in pod.metadata.owner_references)
+
+
+def extra_resources_could_help_scheduling(pod: Pod) -> bool:
+    """Reference pkg/util/pod/pod.go:41-49."""
+    return (
+        is_pending(pod)
+        and pod.is_unschedulable()
+        and not is_preempting(pod)
+        and not is_owned_by_daemonset_or_node(pod)
+        and not pod.is_scheduled()
+    )
+
+
+def is_over_quota(pod: Pod) -> bool:
+    """Reference pkg/util/pod/pod.go:31."""
+    return pod.metadata.labels.get(constants.LABEL_CAPACITY) == constants.CAPACITY_OVER_QUOTA

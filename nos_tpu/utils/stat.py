@@ -1,0 +1,54 @@
+"""Combinatorics helpers (analog of reference pkg/util/stat.go:57).
+
+The reference uses permutation iteration when actuating MIG geometry because
+NVML profile-creation order matters (pkg/gpu/nvml/client.go:225-340). The TPU
+actuation path is declarative, but the planner still uses permutations when
+searching small geometry orderings, and tests exercise the iterator directly.
+"""
+from __future__ import annotations
+
+from typing import Iterator, List, Sequence, TypeVar
+
+T = TypeVar("T")
+
+
+def iter_permutations(items: Sequence[T], limit: int | None = None) -> Iterator[List[T]]:
+    """Yield the *distinct* permutations of ``items`` (equal items produce the
+    same permutation once), optionally capped at ``limit`` results.
+
+    Runs in O(#distinct permutations), not O(n!): duplicates are grouped up
+    front, so e.g. ten equal profiles yield exactly one permutation after one
+    step instead of iterating 10! orderings.
+    """
+    # Group equal items: list of (representative, count).
+    groups: List[List] = []  # [representative, remaining_count]
+    for item in items:
+        for g in groups:
+            if g[0] == item:
+                g[1] += 1
+                break
+        else:
+            groups.append([item, 1])
+
+    n = len(items)
+    emitted = 0
+    prefix: List[T] = []
+
+    def gen() -> Iterator[List[T]]:
+        nonlocal emitted
+        if len(prefix) == n:
+            emitted += 1
+            yield list(prefix)
+            return
+        for g in groups:
+            if g[1] == 0:
+                continue
+            g[1] -= 1
+            prefix.append(g[0])
+            yield from gen()
+            prefix.pop()
+            g[1] += 1
+            if limit is not None and emitted >= limit:
+                return
+
+    yield from gen()

@@ -1,0 +1,89 @@
+"""Batcher window semantics (model: reference pkg/util/batcher_test.go, 290 LoC —
+but with an injected clock instead of real sleeps)."""
+from nos_tpu.utils.batcher import Batcher
+
+
+class FakeClock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+def make():
+    clock = FakeClock()
+    return Batcher(timeout_s=60.0, idle_s=10.0, clock=clock), clock
+
+
+def test_empty_batcher_not_ready():
+    b, _ = make()
+    assert not b.ready()
+    assert b.drain_if_ready() == []
+    assert b.seconds_until_ready() is None
+
+
+def test_idle_window_makes_batch_ready():
+    b, clock = make()
+    b.add("a")
+    assert not b.ready()
+    clock.advance(9.9)
+    assert not b.ready()
+    clock.advance(0.2)
+    assert b.ready()
+    assert b.drain_if_ready() == ["a"]
+    assert not b.ready()
+
+
+def test_new_items_reset_idle_window():
+    b, clock = make()
+    b.add("a")
+    clock.advance(8)
+    b.add("b")
+    clock.advance(8)  # 16s since first add, 8s since last -> not ready
+    assert not b.ready()
+    clock.advance(3)
+    assert b.ready()
+    assert b.drain_if_ready() == ["a", "b"]
+
+
+def test_timeout_window_caps_busy_batch():
+    b, clock = make()
+    # keep adding every 5s so idle never fires; timeout at 60s must.
+    for i in range(13):
+        b.add(i)
+        clock.advance(5)
+    # t=65 > 60s after first add
+    assert b.ready()
+    assert len(b.drain_if_ready()) == 13
+
+
+def test_timeout_window_restarts_after_drain():
+    b, clock = make()
+    b.add("a")
+    clock.advance(61)
+    assert b.drain_if_ready() == ["a"]
+    b.add("b")
+    assert not b.ready()
+    clock.advance(11)
+    assert b.drain_if_ready() == ["b"]
+
+
+def test_seconds_until_ready():
+    b, clock = make()
+    b.add("a")
+    assert abs(b.seconds_until_ready() - 10.0) < 1e-9
+    clock.advance(4)
+    assert abs(b.seconds_until_ready() - 6.0) < 1e-9
+
+
+def test_invalid_windows_rejected():
+    import pytest
+
+    with pytest.raises(ValueError):
+        Batcher(timeout_s=0, idle_s=1)
+    with pytest.raises(ValueError):
+        Batcher(timeout_s=1, idle_s=0)

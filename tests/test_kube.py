@@ -1,0 +1,332 @@
+"""API server + controller runtime semantics (the envtest-equivalent rig)."""
+import pytest
+
+from nos_tpu.kube import (
+    ApiServer,
+    Client,
+    Conflict,
+    Controller,
+    Manager,
+    NotFound,
+    AlreadyExists,
+    Node,
+    ObjectMeta,
+    Pod,
+    PodSpec,
+    PodStatus,
+    Container,
+    Request,
+    Result,
+)
+from nos_tpu.kube.apiserver import AdmissionDenied
+from nos_tpu.kube.controller import Watch
+from nos_tpu.kube import predicates
+
+
+def make_pod(name, ns="default", phase="Pending", node=""):
+    return Pod(
+        metadata=ObjectMeta(name=name, namespace=ns),
+        spec=PodSpec(containers=[Container(requests={"cpu": 1})], node_name=node),
+        status=PodStatus(phase=phase),
+    )
+
+
+# ---------------------------------------------------------------------------
+# ApiServer CRUD
+# ---------------------------------------------------------------------------
+
+def test_create_get_roundtrip_and_metadata_stamping():
+    s = ApiServer()
+    created = s.create(make_pod("p1"))
+    assert created.metadata.uid
+    assert created.metadata.resource_version > 0
+    assert created.metadata.creation_timestamp > 0
+    got = s.get("Pod", "p1", "default")
+    assert got.metadata.uid == created.metadata.uid
+
+
+def test_create_duplicate_rejected():
+    s = ApiServer()
+    s.create(make_pod("p1"))
+    with pytest.raises(AlreadyExists):
+        s.create(make_pod("p1"))
+
+
+def test_get_missing_raises_not_found():
+    s = ApiServer()
+    with pytest.raises(NotFound):
+        s.get("Pod", "nope", "default")
+    assert s.try_get("Pod", "nope", "default") is None
+
+
+def test_update_optimistic_concurrency():
+    s = ApiServer()
+    s.create(make_pod("p1"))
+    a = s.get("Pod", "p1", "default")
+    b = s.get("Pod", "p1", "default")
+    a.status.phase = "Running"
+    s.update(a)
+    b.status.phase = "Failed"
+    with pytest.raises(Conflict):
+        s.update(b)
+
+
+def test_patch_is_atomic_read_modify_write():
+    s = ApiServer()
+    s.create(Node(metadata=ObjectMeta(name="n1")))
+    s.patch("Node", "n1", "", lambda n: n.metadata.annotations.update({"a": "1"}))
+    s.patch("Node", "n1", "", lambda n: n.metadata.annotations.update({"b": "2"}))
+    n = s.get("Node", "n1")
+    assert n.metadata.annotations == {"a": "1", "b": "2"}
+
+
+def test_returned_objects_are_copies():
+    s = ApiServer()
+    s.create(make_pod("p1"))
+    got = s.get("Pod", "p1", "default")
+    got.status.phase = "Running"  # mutating the copy must not touch the store
+    assert s.get("Pod", "p1", "default").status.phase == "Pending"
+
+
+def test_list_with_namespace_and_labels():
+    s = ApiServer()
+    p = make_pod("p1")
+    p.metadata.labels["team"] = "a"
+    s.create(p)
+    s.create(make_pod("p2", ns="other"))
+    assert len(s.list("Pod")) == 2
+    assert [p.metadata.name for p in s.list("Pod", namespace="default")] == ["p1"]
+    assert len(s.list("Pod", label_selector={"team": "a"})) == 1
+    assert len(s.list("Pod", label_selector={"team": "b"})) == 0
+
+
+def test_field_index():
+    s = ApiServer()
+    s.register_index("Pod", "status.phase", lambda p: p.status.phase)
+    s.create(make_pod("p1", phase="Running"))
+    s.create(make_pod("p2", phase="Pending"))
+    running = s.list("Pod", index=("status.phase", "Running"))
+    assert [p.metadata.name for p in running] == ["p1"]
+
+
+def test_admission_hook_blocks_create():
+    s = ApiServer()
+
+    def deny_default_ns(server, op, obj, old):
+        if obj.metadata.namespace == "default":
+            raise AdmissionDenied("no pods in default")
+
+    s.register_admission("Pod", deny_default_ns)
+    with pytest.raises(AdmissionDenied):
+        s.create(make_pod("p1"))
+    s.create(make_pod("p2", ns="ok"))
+
+
+def test_delete_and_watch_events():
+    s = ApiServer()
+    sub = s.subscribe(["Pod"])
+    s.create(make_pod("p1"))
+    p = s.get("Pod", "p1", "default")
+    p.status.phase = "Running"
+    s.update(p)
+    s.delete("Pod", "p1", "default")
+    events = []
+    while (ev := sub.pop()) is not None:
+        events.append((ev.type, ev.obj.metadata.name))
+    assert events == [("ADDED", "p1"), ("MODIFIED", "p1"), ("DELETED", "p1")]
+
+
+def test_watch_modified_carries_old_object():
+    s = ApiServer()
+    sub = s.subscribe()
+    s.create(Node(metadata=ObjectMeta(name="n1")))
+    s.patch("Node", "n1", "", lambda n: n.metadata.annotations.update({"k": "v"}))
+    sub.pop()  # ADDED
+    ev = sub.pop()
+    assert ev.type == "MODIFIED"
+    assert ev.old.metadata.annotations == {}
+    assert ev.obj.metadata.annotations == {"k": "v"}
+
+
+# ---------------------------------------------------------------------------
+# Pod helpers
+# ---------------------------------------------------------------------------
+
+def test_pod_request_includes_init_containers_max():
+    p = Pod(
+        metadata=ObjectMeta(name="p"),
+        spec=PodSpec(
+            containers=[Container(requests={"cpu": 1}), Container(requests={"cpu": 2, "mem": 5})],
+            init_containers=[Container(requests={"cpu": 10})],
+        ),
+    )
+    assert p.request() == {"cpu": 10, "mem": 5}
+
+
+# ---------------------------------------------------------------------------
+# Controller runtime
+# ---------------------------------------------------------------------------
+
+def test_controller_reconciles_on_events():
+    s = ApiServer()
+    mgr = Manager(s)
+    seen = []
+
+    def reconcile(client, req):
+        seen.append(req.name)
+        return Result()
+
+    mgr.add_controller(Controller("t", reconcile, [Watch("Pod")]))
+    s.create(make_pod("p1"))
+    mgr.run_until_idle()
+    assert seen == ["p1"]
+
+
+def test_controller_requeue_retries_then_gives_up():
+    s = ApiServer()
+    mgr = Manager(s)
+    calls = []
+
+    def reconcile(client, req):
+        calls.append(req.name)
+        return Result(requeue=True)
+
+    mgr.add_controller(Controller("t", reconcile, [Watch("Pod")], max_retries=3))
+    s.create(make_pod("p1"))
+    mgr.run_until_idle()
+    assert len(calls) == 4  # initial + 3 retries
+
+
+def test_controller_exception_counts_as_requeue():
+    s = ApiServer()
+    mgr = Manager(s)
+    calls = []
+
+    def reconcile(client, req):
+        calls.append(1)
+        if len(calls) < 2:
+            raise RuntimeError("boom")
+        return Result()
+
+    mgr.add_controller(Controller("t", reconcile, [Watch("Pod")]))
+    s.create(make_pod("p1"))
+    mgr.run_until_idle()
+    assert len(calls) == 2
+
+
+def test_queue_dedup():
+    s = ApiServer()
+    mgr = Manager(s)
+    calls = []
+    c = Controller("t", lambda cl, r: calls.append(r.name), [Watch("Pod")])
+    mgr.add_controller(c)
+    # three rapid events for the same object before any processing
+    s.create(make_pod("p1"))
+    p = s.get("Pod", "p1", "default")
+    p.status.phase = "Running"
+    s.update(p)
+    p = s.get("Pod", "p1", "default")
+    p.status.phase = "Succeeded"
+    s.update(p)
+    mgr.run_until_idle()
+    assert calls == ["p1"]  # deduped into one level-triggered reconcile
+
+
+def test_requeue_after_with_advance():
+    s = ApiServer()
+    mgr = Manager(s)
+    calls = []
+
+    def reconcile(client, req):
+        calls.append(1)
+        if len(calls) == 1:
+            return Result(requeue_after=30.0)
+        return Result()
+
+    mgr.add_controller(Controller("t", reconcile, [Watch("Pod")]))
+    s.create(make_pod("p1"))
+    mgr.run_until_idle(advance_delayed=True)
+    assert len(calls) == 2
+
+
+def test_predicates_filter_events():
+    s = ApiServer()
+    mgr = Manager(s)
+    seen = []
+    c = Controller(
+        "t",
+        lambda cl, r: seen.append(r.name),
+        [Watch("Node", predicate=predicates.all_of(
+            predicates.matching_name("n1"), predicates.annotations_changed))],
+    )
+    mgr.add_controller(c)
+    s.create(Node(metadata=ObjectMeta(name="n1")))
+    s.create(Node(metadata=ObjectMeta(name="n2")))
+    mgr.run_until_idle()
+    assert seen == ["n1"]
+    # label-only change on n1 does not trigger (annotations unchanged)
+    s.patch("Node", "n1", "", lambda n: n.metadata.labels.update({"x": "y"}))
+    mgr.run_until_idle()
+    assert seen == ["n1"]
+    s.patch("Node", "n1", "", lambda n: n.metadata.annotations.update({"x": "y"}))
+    mgr.run_until_idle()
+    assert seen == ["n1", "n1"]
+
+
+def test_multiple_watches_same_kind():
+    s = ApiServer()
+    mgr = Manager(s)
+    seen = []
+    c = Controller(
+        "t",
+        lambda cl, r: seen.append(r.name),
+        [
+            Watch("Pod", mapper=lambda ev: [Request(name="from-first")]),
+            Watch("Pod", mapper=lambda ev: [Request(name="from-second")]),
+        ],
+    )
+    mgr.add_controller(c)
+    s.create(make_pod("p1"))
+    mgr.run_until_idle()
+    assert sorted(seen) == ["from-first", "from-second"]
+
+
+def test_admission_hook_blocks_delete():
+    s = ApiServer()
+
+    def deny_delete(server, op, obj, old):
+        if op == "DELETE":
+            raise AdmissionDenied("protected")
+
+    s.register_admission("Node", deny_delete)
+    s.create(Node(metadata=ObjectMeta(name="n1")))
+    with pytest.raises(AdmissionDenied):
+        s.delete("Node", "n1")
+    assert s.try_get("Node", "n1") is not None
+
+
+def test_livelock_guard():
+    s = ApiServer()
+    mgr = Manager(s)
+
+    def always_patch(client, req):
+        client.patch("Node", req.name, "", lambda n: n.metadata.annotations.update(
+            {"count": str(len(n.metadata.annotations))}))
+        return Result()
+
+    mgr.add_controller(Controller("livelock", always_patch, [Watch("Node")]))
+    s.create(Node(metadata=ObjectMeta(name="n1")))
+    with pytest.raises(RuntimeError, match="livelock"):
+        mgr.run_until_idle(max_iterations=50)
+
+
+def test_unsubscribe_stops_event_delivery():
+    s = ApiServer()
+    sub = s.subscribe()
+    s.create(make_pod("p1"))
+    s.unsubscribe(sub)
+    s.create(make_pod("p2"))
+    events = []
+    while (ev := sub.pop()) is not None:
+        events.append(ev.obj.metadata.name)
+    assert events == ["p1"]

@@ -1,0 +1,56 @@
+from nos_tpu.utils.generic import filter_list, unordered_equal, min_by, max_by
+from nos_tpu.utils.stat import iter_permutations
+from nos_tpu.kube.quantity import parse_quantity, format_quantity
+
+
+def test_unordered_equal():
+    assert unordered_equal([1, 2, 2], [2, 1, 2])
+    assert not unordered_equal([1, 2], [1, 2, 2])
+    assert not unordered_equal([1, 3], [1, 2])
+    assert unordered_equal([{"a": 1}], [{"a": 1}])  # unhashable items
+
+
+def test_filter_min_max():
+    assert filter_list([1, 2, 3, 4], lambda x: x % 2 == 0) == [2, 4]
+    assert min_by([3, 1, 2], lambda x: x) == 1
+    assert max_by([], lambda x: x) is None
+
+
+def test_iter_permutations_dedup():
+    perms = list(iter_permutations(["a", "a", "b"]))
+    assert len(perms) == 3  # 3!/2! distinct
+    assert ["a", "a", "b"] in perms and ["b", "a", "a"] in perms
+
+
+def test_iter_permutations_limit():
+    perms = list(iter_permutations([1, 2, 3, 4], limit=5))
+    assert len(perms) == 5
+
+
+def test_parse_quantity():
+    assert parse_quantity("500m") == 0.5
+    assert parse_quantity("4") == 4.0
+    assert parse_quantity("10Gi") == 10 * 2**30
+    assert parse_quantity("1k") == 1000.0
+    assert parse_quantity(7) == 7.0
+    assert format_quantity(4.0) == "4"
+
+
+def test_parse_quantity_invalid():
+    import pytest
+
+    with pytest.raises(ValueError):
+        parse_quantity("abc")
+    with pytest.raises(ValueError):
+        parse_quantity("1Xx")
+
+
+def test_parse_quantity_nano_micro():
+    assert abs(parse_quantity("100n") - 1e-7) < 1e-15
+    assert abs(parse_quantity("250u") - 25e-5) < 1e-12
+
+
+def test_iter_permutations_duplicates_fast():
+    # 10 equal items: must yield exactly 1 permutation quickly (not 10! work)
+    perms = list(iter_permutations(["x"] * 10))
+    assert perms == [["x"] * 10]
