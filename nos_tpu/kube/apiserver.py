@@ -215,7 +215,7 @@ class ApiServer:
             bucket = self._store.get(kind, {})
             if key not in bucket:
                 raise NotFound(f"{kind} {namespace}/{name} not found")
-            obj = bucket[key]
+            obj = deep_copy(bucket[key])
             self._admit("DELETE", obj, deep_copy(obj))
             bucket.pop(key)
-            self._emit(WatchEvent("DELETED", kind, deep_copy(obj)))
+            self._emit(WatchEvent("DELETED", kind, obj))

@@ -9,10 +9,14 @@ internal/controllers/*). Semantics preserved:
 - reconcilers are level-triggered: they read current state from the client,
   never from the event;
 - a reconcile returning ``Result(requeue=True)`` or raising re-queues the
-  request (with a retry cap in ``run_until_idle`` so tests terminate);
-- ``Result(requeue_after=s)`` schedules a delayed requeue (the partitioning
-  controller uses this to wait out the batch window,
-  partitioner_controller.go:121,144).
+  request with exponential backoff and is never dropped (controller-runtime
+  rate-limiter semantics);
+- ``Result(requeue_after=s)`` schedules a delayed requeue and takes
+  precedence over ``requeue`` (the partitioning controller uses this to wait
+  out the batch window, partitioner_controller.go:121,144);
+- adding a controller seeds its queue from an initial LIST of each watched
+  kind, so objects that existed before the controller started are reconciled
+  (informer initial-sync semantics).
 
 ``run_until_idle`` pumps events + queues deterministically for tests; daemon
 binaries use ``run`` with a wall-clock loop.
@@ -62,19 +66,20 @@ class Watch:
 
 
 class Controller:
+    BACKOFF_BASE_S = 0.005
+    BACKOFF_MAX_S = 30.0
+
     def __init__(
         self,
         name: str,
         reconciler: Reconciler,
         watches: List[Watch],
-        max_retries: int = 5,
     ):
         self.name = name
         self.reconciler = reconciler
         self.watches: Dict[str, List[Watch]] = {}
         for w in watches:
             self.watches.setdefault(w.kind, []).append(w)
-        self.max_retries = max_retries
         self._queue: List[Request] = []
         self._queued: set[Request] = set()
         self._retries: Dict[Request, int] = {}
@@ -129,18 +134,17 @@ class Controller:
         except Exception:
             logger.exception("[%s] reconcile %s failed", self.name, req)
             result = Result(requeue=True)
-        if result.requeue:
+        if result.requeue_after is not None:
+            # RequeueAfter wins over Requeue (controller-runtime precedence)
+            self._retries.pop(req, None)
+            self.enqueue_after(req, result.requeue_after, now)
+        elif result.requeue:
             retries = self._retries.get(req, 0) + 1
             self._retries[req] = retries
-            if retries <= self.max_retries:
-                self.enqueue(req)
-            else:
-                logger.error("[%s] giving up on %s after %d retries", self.name, req, retries)
-                self._retries.pop(req, None)
+            delay = min(self.BACKOFF_BASE_S * (2 ** (retries - 1)), self.BACKOFF_MAX_S)
+            self.enqueue_after(req, delay, now)
         else:
             self._retries.pop(req, None)
-            if result.requeue_after is not None:
-                self.enqueue_after(req, result.requeue_after, now)
         return True
 
     def has_pending(self, now: float) -> bool:
@@ -171,6 +175,11 @@ class Manager:
 
     def add_controller(self, controller: Controller) -> Controller:
         self.controllers.append(controller)
+        # Initial sync: seed the queue from a LIST of each watched kind so
+        # pre-existing objects are reconciled (informer initial-sync).
+        for kind in controller.watches:
+            for obj in self.server.list(kind):
+                controller.offer(WatchEvent("ADDED", kind, obj))
         return controller
 
     def healthz(self) -> bool:

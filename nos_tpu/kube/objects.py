@@ -29,8 +29,11 @@ def sub_resources(a: ResourceList, b: ResourceList) -> ResourceList:
 
 
 def resources_fit(request: ResourceList, available: ResourceList) -> bool:
-    """True if every requested quantity is available."""
-    return all(available.get(k, 0) + 1e-9 >= v for k, v in request.items())
+    """True if every requested quantity is available (relative tolerance so
+    byte-scale float quantities compare by value, not ulp)."""
+    return all(
+        available.get(k, 0) + 1e-9 * max(1.0, abs(v)) >= v for k, v in request.items()
+    )
 
 
 def nonzero(r: ResourceList) -> ResourceList:

@@ -1,0 +1,31 @@
+"""TPU domain library — the accelerator model the control plane plans over.
+
+Analog of the reference's GPU domain layer (pkg/gpu, pkg/gpu/mig,
+pkg/gpu/slicing, pkg/gpu/util — SURVEY §2.4), rebuilt around TPU facts:
+
+- chips live on hosts as a 2D grid wired by ICI (v4/v5p hosts are a 2x2 board
+  of a 3D torus; v5e/v6e hosts are a 2x4 grid of a 2D torus);
+- *sub-slicing* a host means choosing contiguous rectangular sub-grids — the
+  analog of MIG profiles, except legality is geometric (rectangles must tile
+  the host grid) rather than a per-model menu
+  (reference pkg/gpu/mig/known_configs.go:25-135 hard-codes menus; here
+  ``topology.allowed_geometries`` *derives* them);
+- *multi-host slices* have fixed legal topologies per generation
+  (2x2x1 … 16x16 …) — the table the gang scheduler plans against, with ICI
+  adjacency derived from slice shape.
+"""
+from nos_tpu.tpu.slice import Profile, Geometry, parse_profile, fewest_slices_geometry  # noqa: F401
+from nos_tpu.tpu.topology import (  # noqa: F401
+    Generation,
+    GENERATIONS,
+    SliceTopology,
+    allowed_geometries,
+    host_grid,
+    chip_memory_gb,
+    slice_topologies,
+    find_slice_topology,
+)
+from nos_tpu.tpu.device import Device, DeviceList  # noqa: F401
+from nos_tpu.tpu.host import TpuBoard  # noqa: F401
+from nos_tpu.tpu.node import TpuNode  # noqa: F401
+from nos_tpu.tpu.resource_calc import ResourceCalculator  # noqa: F401

@@ -1,0 +1,146 @@
+"""Spec/status node-annotation codec — the system's wire format.
+
+Analog of reference pkg/gpu/annotation.go:26-98 (+ list ops :150-220) and
+pkg/gpu/mig/annotation.go. The partitioner writes *spec* annotations
+(desired geometry per board); the node tpuagent writes *status* annotations
+(observed free/used sub-slices per board) plus the plan-id handshake pair
+that serializes plan application (reference
+internal/controllers/gpupartitioner/partitioner_controller.go:212-232).
+
+    nos.ai/spec-tpu-<board>-<profile>: "<count>"
+    nos.ai/status-tpu-<board>-<profile>-<free|used>: "<count>"
+    nos.ai/spec-partitioning-plan: "<plan-id>"
+    nos.ai/status-partitioning-plan: "<plan-id>"
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, Iterable, Tuple
+
+from nos_tpu import constants
+from nos_tpu.tpu.device import Device, DeviceList
+from nos_tpu.tpu.slice import Geometry, Profile, parse_profile
+
+
+@dataclass(frozen=True)
+class SpecAnnotation:
+    board_index: int
+    profile: Profile
+    quantity: int
+
+    @property
+    def key(self) -> str:
+        return f"{constants.ANNOTATION_SPEC_PREFIX}{self.board_index}-{self.profile}"
+
+
+@dataclass(frozen=True)
+class StatusAnnotation:
+    board_index: int
+    profile: Profile
+    status: str          # "free" | "used"
+    quantity: int
+
+    @property
+    def key(self) -> str:
+        return (
+            f"{constants.ANNOTATION_STATUS_PREFIX}"
+            f"{self.board_index}-{self.profile}-{self.status}"
+        )
+
+
+def parse_node_annotations(
+    annotations: Dict[str, str],
+) -> Tuple[list[SpecAnnotation], list[StatusAnnotation]]:
+    """Reference gpu.ParseNodeAnnotations (pkg/gpu/annotation.go:26)."""
+    specs: list[SpecAnnotation] = []
+    statuses: list[StatusAnnotation] = []
+    for key, value in annotations.items():
+        m = constants.ANNOTATION_SPEC_REGEX.match(key)
+        if m:
+            try:
+                qty = int(value)
+                if qty <= 0:
+                    continue
+                specs.append(SpecAnnotation(int(m.group(1)), parse_profile(m.group(2)), qty))
+            except ValueError:
+                continue
+            continue
+        m = constants.ANNOTATION_STATUS_REGEX.match(key)
+        if m:
+            try:
+                qty = int(value)
+                if qty <= 0:
+                    continue
+                statuses.append(
+                    StatusAnnotation(int(m.group(1)), parse_profile(m.group(2)), m.group(3), qty)
+                )
+            except ValueError:
+                continue
+    return specs, statuses
+
+
+def spec_annotations_from_partitioning(
+    boards: Dict[int, Geometry],
+) -> Dict[str, str]:
+    """Desired-state annotations for a node (one entry per board+profile)."""
+    out: Dict[str, str] = {}
+    for board_index, geometry in boards.items():
+        for profile, quantity in geometry.items():
+            if quantity > 0:
+                sa = SpecAnnotation(board_index, profile, quantity)
+                out[sa.key] = str(quantity)
+    return out
+
+
+def status_annotations_from_devices(devices: Iterable[Device]) -> Dict[str, str]:
+    """Observed-state annotations (reference DeviceList.AsStatusAnnotation,
+    pkg/gpu/device.go:101)."""
+    counts: Dict[Tuple[int, Profile, str], int] = {}
+    for d in devices:
+        key = (d.board_index, d.profile, d.status)
+        counts[key] = counts.get(key, 0) + 1
+    return {
+        StatusAnnotation(b, p, s, q).key: str(q) for (b, p, s), q in counts.items()
+    }
+
+
+def spec_from_annotations(specs: Iterable[SpecAnnotation]) -> Dict[int, Geometry]:
+    out: Dict[int, Geometry] = {}
+    for sa in specs:
+        board = out.setdefault(sa.board_index, {})
+        board[sa.profile] = board.get(sa.profile, 0) + sa.quantity
+    return out
+
+
+def status_to_board_state(
+    statuses: Iterable[StatusAnnotation],
+) -> Dict[int, Dict[str, Dict[Profile, int]]]:
+    """{board: {"free": {profile: n}, "used": {profile: n}}}"""
+    out: Dict[int, Dict[str, Dict[Profile, int]]] = {}
+    for st in statuses:
+        board = out.setdefault(st.board_index, {"free": {}, "used": {}})
+        board[st.status][st.profile] = board[st.status].get(st.profile, 0) + st.quantity
+    return out
+
+
+def spec_matches_status(
+    specs: Iterable[SpecAnnotation], statuses: Iterable[StatusAnnotation]
+) -> bool:
+    """True if observed geometry equals desired geometry (reference
+    mig.SpecMatchesStatus, pkg/gpu/mig/annotation.go:24)."""
+    desired = spec_from_annotations(specs)
+    observed: Dict[int, Dict[Profile, int]] = {}
+    for st in statuses:
+        board = observed.setdefault(st.board_index, {})
+        board[st.profile] = board.get(st.profile, 0) + st.quantity
+    desired_clean = {
+        b: {p: q for p, q in g.items() if q > 0} for b, g in desired.items()
+    }
+    desired_clean = {b: g for b, g in desired_clean.items() if g}
+    observed_clean = {b: g for b, g in observed.items() if g}
+    return desired_clean == observed_clean
+
+
+def strip_partitioning_annotations(annotations: Dict[str, str], prefix: str) -> Dict[str, str]:
+    """Remove all spec (or status) partitioning annotations, returning the rest."""
+    return {k: v for k, v in annotations.items() if not k.startswith(prefix)}

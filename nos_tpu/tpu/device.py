@@ -1,0 +1,63 @@
+"""Device model (analog of reference pkg/gpu/device.go:26-130 and
+pkg/resource device types).
+
+A ``Device`` is one advertised sub-slice resource instance on a node board,
+with its usage status as observed by the node agent (via the device plugin /
+pod-resources API in production; via the native tpuagent library here).
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, Iterable, List
+
+from nos_tpu.tpu.slice import Profile
+
+
+STATUS_FREE = "free"
+STATUS_USED = "used"
+
+
+@dataclass(frozen=True)
+class Device:
+    device_id: str
+    board_index: int
+    profile: Profile
+    status: str = STATUS_FREE          # free | used
+
+    def is_used(self) -> bool:
+        return self.status == STATUS_USED
+
+    def is_free(self) -> bool:
+        return self.status == STATUS_FREE
+
+
+class DeviceList(List[Device]):
+    """Rich grouping helpers (analog of gpu.DeviceList group-bys)."""
+
+    def group_by_board(self) -> Dict[int, "DeviceList"]:
+        out: Dict[int, DeviceList] = {}
+        for d in self:
+            out.setdefault(d.board_index, DeviceList()).append(d)
+        return out
+
+    def group_by_profile(self) -> Dict[Profile, "DeviceList"]:
+        out: Dict[Profile, DeviceList] = {}
+        for d in self:
+            out.setdefault(d.profile, DeviceList()).append(d)
+        return out
+
+    def used(self) -> "DeviceList":
+        return DeviceList(d for d in self if d.is_used())
+
+    def free(self) -> "DeviceList":
+        return DeviceList(d for d in self if d.is_free())
+
+    def geometry(self) -> Dict[Profile, int]:
+        out: Dict[Profile, int] = {}
+        for d in self:
+            out[d.profile] = out.get(d.profile, 0) + 1
+        return out
+
+    @staticmethod
+    def of(devices: Iterable[Device]) -> "DeviceList":
+        return DeviceList(devices)

@@ -1,0 +1,132 @@
+"""Per-board geometry state machine.
+
+Analog of reference pkg/gpu/mig/gpu.go:97-217 (``mig.GPU``): tracks used and
+free sub-slices on one TPU board (host chip grid), and answers
+
+- ``can_apply_geometry``   — a new geometry is only applicable if it keeps
+                             every *used* sub-slice (never delete used
+                             devices; reference gpu.go:97-116),
+- ``init_geometry``        — virgin boards get the fewest-slices geometry
+                             (whole-board partition; reference gpu.go:118),
+- ``apply_geometry``,
+- ``update_geometry_for``  — greedy search over the generation's allowed
+                             geometries for the one that (a) preserves used
+                             slices and (b) provides the most lacking slices
+                             (reference gpu.go:158-217).
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, Optional
+
+from nos_tpu.tpu.slice import Geometry, Profile, fewest_slices_geometry, geometry_chips
+from nos_tpu.tpu import topology
+
+
+@dataclass
+class TpuBoard:
+    generation: str                   # key into topology.GENERATIONS
+    index: int = 0
+    used: Dict[Profile, int] = field(default_factory=dict)
+    free: Dict[Profile, int] = field(default_factory=dict)
+
+    # -- views --------------------------------------------------------------
+    @property
+    def geometry(self) -> Geometry:
+        g: Geometry = {}
+        for src in (self.used, self.free):
+            for p, q in src.items():
+                g[p] = g.get(p, 0) + q
+        return g
+
+    def has_geometry(self) -> bool:
+        return bool(self.geometry)
+
+    def clone(self) -> "TpuBoard":
+        return TpuBoard(self.generation, self.index, dict(self.used), dict(self.free))
+
+    # -- state machine ------------------------------------------------------
+    def can_apply_geometry(self, g: Geometry) -> bool:
+        """True iff ``g`` is a legal board geometry that keeps every used
+        sub-slice."""
+        if tuple(sorted(g.items(), key=lambda kv: (kv[0].chips, str(kv[0])))) \
+                not in topology.allowed_geometries(self.generation):
+            return False
+        return all(g.get(p, 0) >= q for p, q in self.used.items() if q > 0)
+
+    def apply_geometry(self, g: Geometry) -> None:
+        if not self.can_apply_geometry(g):
+            raise ValueError(
+                f"board {self.index}: cannot apply geometry {g} over used {self.used}"
+            )
+        self.free = {
+            p: q - self.used.get(p, 0) for p, q in g.items() if q - self.used.get(p, 0) > 0
+        }
+
+    def init_geometry(self) -> None:
+        """Reference gpu.go:118 InitGeometry — fewest slices (largest parts)."""
+        if self.has_geometry():
+            return
+        g = fewest_slices_geometry(topology.allowed_geometry_list(self.generation))
+        if g is not None:
+            self.apply_geometry(g)
+
+    def update_geometry_for(self, lacking: Dict[Profile, int]) -> bool:
+        """Try to re-partition this board to provide as many of the lacking
+        sub-slices as possible without disturbing used ones. Returns True if
+        the geometry changed. Greedy: pick the allowed geometry maximizing
+        newly-provided lacking slices, tie-broken toward fewer total slices
+        (less fragmentation). Reference pkg/gpu/mig/gpu.go:158-217."""
+        if not lacking:
+            return False
+        def provided_by(free_slices: Dict[Profile, int]) -> int:
+            return sum(
+                min(want, free_slices.get(p, 0)) for p, want in lacking.items() if want > 0
+            )
+
+        current_score = provided_by(self.free)
+        best: Optional[Geometry] = None
+        best_score = current_score
+        for cand in topology.allowed_geometry_list(self.generation):
+            if cand == self.geometry or not self.can_apply_geometry(cand):
+                continue
+            cand_free = {
+                p: q - self.used.get(p, 0)
+                for p, q in cand.items()
+                if q - self.used.get(p, 0) > 0
+            }
+            score = provided_by(cand_free)
+            if score > best_score or (
+                best is not None
+                and score == best_score
+                and sum(cand.values()) < sum(best.values())
+            ):
+                best = cand
+                best_score = score
+        if best is None:
+            return False
+        self.apply_geometry(best)
+        return True
+
+    # -- allocation bookkeeping (used by snapshot simulation) ---------------
+    def reserve(self, p: Profile, n: int = 1) -> bool:
+        if self.free.get(p, 0) < n:
+            return False
+        self.free[p] -= n
+        if self.free[p] == 0:
+            del self.free[p]
+        self.used[p] = self.used.get(p, 0) + n
+        return True
+
+    def release(self, p: Profile, n: int = 1) -> None:
+        have = self.used.get(p, 0)
+        if have < n:
+            raise ValueError(f"board {self.index}: releasing {n}x{p} but only {have} used")
+        self.used[p] = have - n
+        if self.used[p] == 0:
+            del self.used[p]
+        self.free[p] = self.free.get(p, 0) + n
+
+    @property
+    def total_chips(self) -> int:
+        return geometry_chips(self.geometry)

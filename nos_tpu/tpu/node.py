@@ -1,0 +1,136 @@
+"""TpuNode — the partitionable-node view built from a Node's labels and
+annotations.
+
+Analog of reference pkg/gpu/mig/node.go:40-220 (``mig.Node``): constructed
+from GKE TPU node labels (accelerator type, topology) plus nos status
+annotations, it implements the planner's ``PartitionableNode`` contract —
+geometry queries, ``update_geometry_for``, and recomputing the node's scalar
+allocatable resources after a geometry change (node.go:180-220).
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from nos_tpu import constants
+from nos_tpu.kube.objects import Node, ResourceList
+from nos_tpu.tpu import annotation as ann
+from nos_tpu.tpu import topology
+from nos_tpu.tpu.host import TpuBoard
+from nos_tpu.tpu.slice import Geometry, Profile
+
+
+class NotATpuNode(ValueError):
+    pass
+
+
+@dataclass
+class TpuNode:
+    name: str
+    generation: str                       # GENERATIONS key
+    topology_name: str                    # gke-tpu-topology label value
+    boards: List[TpuBoard] = field(default_factory=list)
+
+    @classmethod
+    def from_node(cls, node: Node) -> "TpuNode":
+        gen_label = node.metadata.labels.get(constants.LABEL_TPU_ACCELERATOR, "")
+        gen = topology.get_generation(gen_label)
+        if gen is None:
+            raise NotATpuNode(f"node {node.metadata.name}: unknown accelerator {gen_label!r}")
+        topo = node.metadata.labels.get(constants.LABEL_TPU_TOPOLOGY, "")
+        _, statuses = ann.parse_node_annotations(node.metadata.annotations)
+        board_state = ann.status_to_board_state(statuses)
+        n_boards = cls._board_count(node, gen)
+        boards = []
+        for i in range(n_boards):
+            st = board_state.get(i, {"free": {}, "used": {}})
+            boards.append(
+                TpuBoard(generation=gen.name, index=i, used=dict(st["used"]), free=dict(st["free"]))
+            )
+        return cls(
+            name=node.metadata.name,
+            generation=gen.name,
+            topology_name=topo,
+            boards=boards,
+        )
+
+    @staticmethod
+    def _board_count(node: Node, gen: topology.Generation) -> int:
+        """A GKE TPU node is one host = one board. Kept as a method so a
+        future multi-board host only changes this."""
+        return 1
+
+    # -- PartitionableNode contract (reference core/interface.go:44-56) -----
+    def clone(self) -> "TpuNode":
+        return TpuNode(
+            self.name,
+            self.generation,
+            self.topology_name,
+            [b.clone() for b in self.boards],
+        )
+
+    def has_free_capacity(self) -> bool:
+        gen = topology.GENERATIONS[self.generation]
+        partitioned = sum(b.total_chips for b in self.boards)
+        free_slices = any(b.free for b in self.boards)
+        return free_slices or partitioned < gen.chips_per_host * len(self.boards)
+
+    def update_geometry_for(self, lacking: Dict[Profile, int]) -> bool:
+        """Greedy per-board geometry update (reference mig.Node.UpdateGeometryFor,
+        node.go:145): boards are tried in order; each consumes the demand it
+        can serve before the next board is considered."""
+        changed = False
+        remaining = {p: q for p, q in lacking.items() if q > 0}
+        for board in self.boards:
+            if not remaining:
+                break
+            if board.update_geometry_for(remaining):
+                changed = True
+            for p in list(remaining.keys()):
+                served = board.free.get(p, 0)
+                if served:
+                    remaining[p] = remaining[p] - served
+                    if remaining[p] <= 0:
+                        del remaining[p]
+        return changed
+
+    def partitioning(self) -> Dict[int, Geometry]:
+        return {b.index: b.geometry for b in self.boards if b.geometry}
+
+    # -- scalar resources ---------------------------------------------------
+    def allocatable_scalar_resources(self, base: Optional[ResourceList] = None) -> ResourceList:
+        """Recompute the node's allocatable extended resources from board
+        geometry (reference mig.Node scalar recompute, node.go:180-220):
+        sub-slice resources replace whole-chip ones once partitioned."""
+        out: ResourceList = dict(base or {})
+        out = {
+            k: v
+            for k, v in out.items()
+            if not k.startswith(constants.RESOURCE_TPU_SLICE_PREFIX)
+            and k != constants.RESOURCE_TPU
+        }
+        gen = topology.GENERATIONS[self.generation]
+        unpartitioned_chips = 0
+        for b in self.boards:
+            if b.has_geometry():
+                for p, q in b.geometry.items():
+                    out[p.resource_name] = out.get(p.resource_name, 0) + q
+            else:
+                unpartitioned_chips += gen.chips_per_host
+        if unpartitioned_chips:
+            out[constants.RESOURCE_TPU] = out.get(constants.RESOURCE_TPU, 0) + unpartitioned_chips
+        return out
+
+    def free_slices(self) -> Dict[Profile, int]:
+        out: Dict[Profile, int] = {}
+        for b in self.boards:
+            for p, q in b.free.items():
+                out[p] = out.get(p, 0) + q
+        return out
+
+    def used_slices(self) -> Dict[Profile, int]:
+        out: Dict[Profile, int] = {}
+        for b in self.boards:
+            for p, q in b.used.items():
+                out[p] = out.get(p, 0) + q
+        return out

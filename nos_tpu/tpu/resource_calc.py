@@ -1,0 +1,69 @@
+"""ResourceCalculator — pod requests with derived accelerator-memory scalars.
+
+Analog of reference pkg/gpu/util/resource.go:28-88: the quota layer compares
+namespaces by a common currency. The reference derives
+``nos.nebuly.com/gpu-memory`` (N GB per whole GPU, parsed GB per MIG
+profile); here we derive ``nos.ai/tpu-memory`` from whole TPU chips
+(per-generation HBM, default when unknown) and from sub-slice profiles
+(chips(profile) x HBM/chip), plus the GPU derivation for mixed clusters.
+"""
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass
+
+from nos_tpu import constants
+from nos_tpu.kube.objects import Pod, ResourceList
+from nos_tpu.tpu.slice import parse_profile
+from nos_tpu.tpu import topology
+
+_MIG_RE = re.compile(r"^nvidia\.com/mig-\d+g\.(\d+)gb$")
+
+
+@dataclass
+class ResourceCalculator:
+    tpu_memory_gb: int = constants.DEFAULT_TPU_MEMORY_GB
+    nvidia_gpu_memory_gb: int = constants.DEFAULT_NVIDIA_GPU_MEMORY_GB
+    # when the pod's target generation is known (node selector), per-chip HBM
+    # comes from the generation table instead of the default
+    generation: str | None = None
+
+    def _hbm_per_chip(self) -> int:
+        if self.generation:
+            return topology.chip_memory_gb(self.generation, self.tpu_memory_gb)
+        return self.tpu_memory_gb
+
+    def compute_request(self, requests: ResourceList) -> ResourceList:
+        out = dict(requests)
+        tpu_mem = 0.0
+        gpu_mem = 0.0
+        for name, qty in requests.items():
+            if name == constants.RESOURCE_TPU:
+                tpu_mem += qty * self._hbm_per_chip()
+            elif name.startswith(constants.RESOURCE_TPU_SLICE_PREFIX):
+                profile = parse_profile(name)
+                tpu_mem += qty * profile.chips * self._hbm_per_chip()
+            elif name == constants.RESOURCE_NVIDIA_GPU:
+                gpu_mem += qty * self.nvidia_gpu_memory_gb
+            else:
+                m = _MIG_RE.match(name)
+                if m:
+                    gpu_mem += qty * int(m.group(1))
+        if tpu_mem:
+            out[constants.RESOURCE_TPU_MEMORY] = out.get(constants.RESOURCE_TPU_MEMORY, 0) + tpu_mem
+        if gpu_mem:
+            out[constants.RESOURCE_GPU_MEMORY] = out.get(constants.RESOURCE_GPU_MEMORY, 0) + gpu_mem
+        return out
+
+    def compute_pod_request(self, pod: Pod) -> ResourceList:
+        """Reference ResourceCalculator.ComputePodRequest (resource.go:60)."""
+        hbm = self._generation_for_pod(pod)
+        calc = self if hbm is None else ResourceCalculator(
+            self.tpu_memory_gb, self.nvidia_gpu_memory_gb, hbm
+        )
+        return calc.compute_request(pod.request())
+
+    @staticmethod
+    def _generation_for_pod(pod: Pod) -> str | None:
+        gen = pod.spec.node_selector.get(constants.LABEL_TPU_ACCELERATOR)
+        return gen if gen and topology.get_generation(gen) else None

@@ -1,0 +1,90 @@
+"""Sub-slice profiles and geometries.
+
+Analog of reference pkg/gpu/partitioning.go:27-60 (`gpu.Slice`,
+`gpu.Geometry`) and pkg/gpu/mig/profile.go:29-100 (profile name parsing).
+A TPU sub-slice profile is a contiguous ``<rows>x<cols>`` rectangle of a
+host's chip grid; its resource name is ``nos.ai/tpu-slice-<rows>x<cols>``.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict
+
+from nos_tpu import constants
+
+
+@dataclass(frozen=True)
+class Profile:
+    """A sub-slice shape. Ordering is by chip count then shape (so sorted()
+    yields smallest-first, the packing order the planner wants — analog of
+    gpu.Slice.SmallerThan, reference pkg/gpu/partitioning.go:34)."""
+
+    rows: int
+    cols: int
+
+    def __post_init__(self):
+        if self.rows <= 0 or self.cols <= 0:
+            raise ValueError(f"invalid profile {self.rows}x{self.cols}")
+
+    @property
+    def chips(self) -> int:
+        return self.rows * self.cols
+
+    @property
+    def resource_name(self) -> str:
+        return f"{constants.RESOURCE_TPU_SLICE_PREFIX}{self.rows}x{self.cols}"
+
+    def __str__(self) -> str:
+        return f"{self.rows}x{self.cols}"
+
+    def __lt__(self, other: "Profile") -> bool:
+        return (self.chips, self.rows, self.cols) < (other.chips, other.rows, other.cols)
+
+    def smaller_than(self, other: "Profile") -> bool:
+        return self.chips < other.chips
+
+
+# A geometry maps each profile to how many such sub-slices exist on a board
+# (analog of gpu.Geometry = map[Slice]int).
+Geometry = Dict[Profile, int]
+
+
+def parse_profile(name: str) -> Profile:
+    """Parse ``1x1``/``2x4`` or the full resource name
+    ``nos.ai/tpu-slice-2x4`` into a Profile."""
+    m = constants.TPU_SLICE_RESOURCE_REGEX.match(name)
+    if m:
+        return Profile(int(m.group(1)), int(m.group(2)))
+    parts = name.split("x")
+    if len(parts) == 2 and all(p.isdigit() for p in parts):
+        return Profile(int(parts[0]), int(parts[1]))
+    raise ValueError(f"invalid tpu sub-slice profile: {name!r}")
+
+
+def is_slice_resource(resource_name: str) -> bool:
+    return bool(constants.TPU_SLICE_RESOURCE_REGEX.match(resource_name))
+
+
+def geometry_chips(g: Geometry) -> int:
+    return sum(p.chips * q for p, q in g.items())
+
+
+def geometry_slices(g: Geometry) -> int:
+    return sum(g.values())
+
+
+def fewest_slices_geometry(geometries: list[Geometry]) -> Geometry | None:
+    """The geometry with the fewest slices — used to initialize virgin boards
+    with the largest partitions (analog of gpu.GetFewestSlicesGeometry,
+    reference pkg/gpu/partitioning.go:67)."""
+    if not geometries:
+        return None
+    return min(geometries, key=lambda g: (geometry_slices(g), _geometry_key(g)))
+
+
+def _geometry_key(g: Geometry):
+    return tuple(sorted((str(p), q) for p, q in g.items()))
+
+
+def format_geometry(g: Geometry) -> str:
+    return ", ".join(f"{q}x[{p}]" for p, q in sorted(g.items(), key=lambda kv: str(kv[0])))

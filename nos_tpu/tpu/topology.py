@@ -1,0 +1,258 @@
+"""TPU generation facts: host grids, HBM, slice topologies, sub-slice menus.
+
+The analog of the reference's hard-coded MIG geometry tables
+(pkg/gpu/mig/known_configs.go:25-135), with two TPU-first differences:
+
+1. **Sub-slice geometries are derived, not enumerated.** A geometry (multiset
+   of ``Profile`` rectangles) is legal on a host iff the rectangles exactly
+   tile the host's chip grid — that's what "sub-slice" means physically on
+   the ICI mesh. ``allowed_geometries`` computes the full menu by exact-cover
+   backtracking over the (tiny: ≤8-cell) grid, restricted to the generation's
+   supported profile shapes. Like the reference's table it is overridable at
+   runtime (``set_known_generations``; analog of mig.SetKnownGeometries,
+   cmd/gpupartitioner/gpupartitioner.go:123-135).
+
+2. **Multi-host slice topologies are a first-class table.** Each generation
+   lists its legal slice shapes (GKE ``gke-tpu-topology`` values) with chip
+   and host counts; the gang planner places whole topologies, since multi-host
+   ICI wiring is fixed at node-pool creation (SURVEY §7 risk: TPU
+   repartitioning is coarser than MIG).
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from functools import lru_cache
+from typing import Dict, List, Optional, Tuple
+
+from nos_tpu.tpu.slice import Geometry, Profile
+
+
+@dataclass(frozen=True)
+class SliceTopology:
+    """One legal multi-host slice shape, e.g. 4x4x4 on v5p."""
+
+    dims: Tuple[int, ...]            # (x, y) for 2D generations, (x, y, z) for 3D
+
+    @property
+    def name(self) -> str:
+        return "x".join(str(d) for d in self.dims)
+
+    @property
+    def chips(self) -> int:
+        n = 1
+        for d in self.dims:
+            n *= d
+        return n
+
+    def __str__(self) -> str:
+        return self.name
+
+
+@dataclass(frozen=True)
+class Generation:
+    """One TPU generation's scheduling-relevant facts."""
+
+    name: str                         # GKE accelerator label value
+    short: str                        # v4 / v5e / v5p / v6e
+    host_rows: int                    # host chip-grid shape
+    host_cols: int
+    hbm_gb_per_chip: int
+    # sub-slice profile shapes supported for per-host partitioning
+    subslice_profiles: Tuple[Profile, ...]
+    # legal multi-host (and single-host) slice topologies
+    topologies: Tuple[SliceTopology, ...]
+
+    @property
+    def chips_per_host(self) -> int:
+        return self.host_rows * self.host_cols
+
+    def hosts_for(self, topo: SliceTopology) -> int:
+        return max(1, topo.chips // self.chips_per_host)
+
+
+def _t(*dims_list: str) -> Tuple[SliceTopology, ...]:
+    return tuple(SliceTopology(tuple(int(d) for d in s.split("x"))) for s in dims_list)
+
+
+# ---------------------------------------------------------------------------
+# The generation table. GKE accelerator label values per Cloud TPU docs;
+# host grids: v4/v5p boards are 2x2 (4 chips, 3D torus between boards),
+# v5e/v6e hosts are 2x4 (8 chips, 2D torus).
+# ---------------------------------------------------------------------------
+V4 = Generation(
+    name="tpu-v4-podslice",
+    short="v4",
+    host_rows=2, host_cols=2,
+    hbm_gb_per_chip=32,
+    subslice_profiles=(Profile(1, 1), Profile(1, 2), Profile(2, 2)),
+    topologies=_t(
+        "2x2x1", "2x2x2", "2x2x4", "2x4x4", "4x4x4", "4x4x8",
+        "4x8x8", "8x8x8", "8x8x12", "8x8x16", "8x16x16", "12x16x16",
+    ),
+)
+
+V5E = Generation(
+    name="tpu-v5-lite-podslice",
+    short="v5e",
+    host_rows=2, host_cols=4,
+    hbm_gb_per_chip=16,
+    subslice_profiles=(Profile(1, 1), Profile(2, 2), Profile(2, 4)),
+    topologies=_t("1x1", "2x2", "2x4", "4x4", "4x8", "8x8", "8x16", "16x16"),
+)
+
+V5P = Generation(
+    name="tpu-v5p-slice",
+    short="v5p",
+    host_rows=2, host_cols=2,
+    hbm_gb_per_chip=95,
+    subslice_profiles=(Profile(1, 1), Profile(1, 2), Profile(2, 2)),
+    topologies=_t(
+        "2x2x1", "2x2x2", "2x2x4", "2x4x4", "4x4x4", "4x4x8",
+        "4x8x8", "8x8x8", "8x8x16", "8x16x16", "16x16x16", "16x16x24",
+    ),
+)
+
+V6E = Generation(
+    name="tpu-v6e-slice",
+    short="v6e",
+    host_rows=2, host_cols=4,
+    hbm_gb_per_chip=32,
+    subslice_profiles=(Profile(1, 1), Profile(2, 2), Profile(2, 4)),
+    topologies=_t("1x1", "2x2", "2x4", "4x4", "4x8", "8x8", "8x16", "16x16"),
+)
+
+_DEFAULT_GENERATIONS: Dict[str, Generation] = {
+    g.name: g for g in (V4, V5E, V5P, V6E)
+}
+# Also index by short name for convenience.
+for _g in list(_DEFAULT_GENERATIONS.values()):
+    _DEFAULT_GENERATIONS[_g.short] = _g
+
+GENERATIONS: Dict[str, Generation] = dict(_DEFAULT_GENERATIONS)
+
+
+def set_known_generations(gens: List[Generation]) -> None:
+    """Override the generation table at runtime (config-file analog of
+    mig.SetKnownGeometries)."""
+    GENERATIONS.clear()
+    for g in gens:
+        GENERATIONS[g.name] = g
+        GENERATIONS[g.short] = g
+    allowed_geometries.cache_clear()
+
+
+def reset_known_generations() -> None:
+    GENERATIONS.clear()
+    GENERATIONS.update(_DEFAULT_GENERATIONS)
+    allowed_geometries.cache_clear()
+
+
+def get_generation(name: str) -> Optional[Generation]:
+    return GENERATIONS.get(name)
+
+
+def chip_memory_gb(generation_name: str, default: int = 16) -> int:
+    g = get_generation(generation_name)
+    return g.hbm_gb_per_chip if g else default
+
+
+def host_grid(generation_name: str) -> Tuple[int, int]:
+    g = GENERATIONS[generation_name]
+    return (g.host_rows, g.host_cols)
+
+
+def slice_topologies(generation_name: str) -> Tuple[SliceTopology, ...]:
+    g = get_generation(generation_name)
+    return g.topologies if g else ()
+
+
+def find_slice_topology(generation_name: str, topo_name: str) -> Optional[SliceTopology]:
+    for t in slice_topologies(generation_name):
+        if t.name == topo_name:
+            return t
+    return None
+
+
+# ---------------------------------------------------------------------------
+# Sub-slice geometry derivation: exact tiling of the host grid.
+# ---------------------------------------------------------------------------
+
+@lru_cache(maxsize=None)
+def allowed_geometries(generation_key: str) -> Tuple[Tuple[Tuple[Profile, int], ...], ...]:
+    """All distinct geometries (as sorted (profile, count) tuples) whose
+    rectangles exactly tile the generation's host grid. Cached per
+    generation; the host grids are tiny (≤ 8 cells) so enumeration is
+    instant."""
+    gen = GENERATIONS[generation_key]
+    rows, cols = gen.host_rows, gen.host_cols
+    profiles = set()
+    for p in gen.subslice_profiles:
+        profiles.add((p.rows, p.cols))
+        # a rectangle can be placed rotated on the grid if it fits
+        profiles.add((p.cols, p.rows))
+
+    results: set = set()
+
+    def canonical(counts: Dict[Tuple[int, int], int]) -> Tuple[Tuple[Profile, int], ...]:
+        # merge rotations into the generation's declared orientation
+        merged: Dict[Profile, int] = {}
+        for (r, c), n in counts.items():
+            prof = None
+            for p in gen.subslice_profiles:
+                if (p.rows, p.cols) == (r, c) or (p.rows, p.cols) == (c, r):
+                    prof = p
+                    break
+            assert prof is not None
+            merged[prof] = merged.get(prof, 0) + n
+        return tuple(sorted(merged.items(), key=lambda kv: (kv[0].chips, str(kv[0]))))
+
+    grid = [[False] * cols for _ in range(rows)]
+    counts: Dict[Tuple[int, int], int] = {}
+
+    def first_free() -> Optional[Tuple[int, int]]:
+        for r in range(rows):
+            for c in range(cols):
+                if not grid[r][c]:
+                    return (r, c)
+        return None
+
+    def place(r0, c0, h, w, value: bool) -> bool:
+        if r0 + h > rows or c0 + w > cols:
+            return False
+        if value:
+            for r in range(r0, r0 + h):
+                for c in range(c0, c0 + w):
+                    if grid[r][c]:
+                        return False
+            for r in range(r0, r0 + h):
+                for c in range(c0, c0 + w):
+                    grid[r][c] = True
+        else:
+            for r in range(r0, r0 + h):
+                for c in range(c0, c0 + w):
+                    grid[r][c] = False
+        return True
+
+    def search() -> None:
+        cell = first_free()
+        if cell is None:
+            results.add(canonical(counts))
+            return
+        r0, c0 = cell
+        for (h, w) in sorted(profiles):
+            if place(r0, c0, h, w, True):
+                key = (h, w)
+                counts[key] = counts.get(key, 0) + 1
+                search()
+                counts[key] -= 1
+                if counts[key] == 0:
+                    del counts[key]
+                place(r0, c0, h, w, False)
+
+    search()
+    return tuple(sorted(results, key=lambda g: (len(g), str(g))))
+
+
+def allowed_geometry_list(generation_key: str) -> List[Geometry]:
+    """allowed_geometries as mutable dicts."""
+    return [dict(g) for g in allowed_geometries(generation_key)]

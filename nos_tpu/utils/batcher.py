@@ -41,7 +41,6 @@ class Batcher(Generic[T]):
         self._items: List[T] = []
         self._batch_started_at: float | None = None
         self._last_added_at: float | None = None
-        self._ready_event = threading.Event()
 
     def add(self, item: T) -> None:
         with self._lock:

@@ -182,7 +182,23 @@ def test_controller_reconciles_on_events():
     assert seen == ["p1"]
 
 
-def test_controller_requeue_retries_then_gives_up():
+def test_controller_requeue_retries_with_backoff_until_success():
+    s = ApiServer()
+    mgr = Manager(s)
+    calls = []
+
+    def reconcile(client, req):
+        calls.append(req.name)
+        # fail 3 times, then succeed — must converge, not be dropped
+        return Result(requeue=len(calls) <= 3)
+
+    mgr.add_controller(Controller("t", reconcile, [Watch("Pod")]))
+    s.create(make_pod("p1"))
+    mgr.run_until_idle(advance_delayed=True)
+    assert len(calls) == 4
+
+
+def test_controller_requeue_is_delayed_not_immediate():
     s = ApiServer()
     mgr = Manager(s)
     calls = []
@@ -191,10 +207,11 @@ def test_controller_requeue_retries_then_gives_up():
         calls.append(req.name)
         return Result(requeue=True)
 
-    mgr.add_controller(Controller("t", reconcile, [Watch("Pod")], max_retries=3))
+    mgr.add_controller(Controller("t", reconcile, [Watch("Pod")]))
     s.create(make_pod("p1"))
-    mgr.run_until_idle()
-    assert len(calls) == 4  # initial + 3 retries
+    # without advancing delayed work, the backoff retry stays parked
+    mgr.run_until_idle(advance_delayed=False)
+    assert len(calls) == 1
 
 
 def test_controller_exception_counts_as_requeue():
@@ -210,8 +227,40 @@ def test_controller_exception_counts_as_requeue():
 
     mgr.add_controller(Controller("t", reconcile, [Watch("Pod")]))
     s.create(make_pod("p1"))
-    mgr.run_until_idle()
+    mgr.run_until_idle(advance_delayed=True)
     assert len(calls) == 2
+
+
+def test_requeue_after_takes_precedence_over_requeue():
+    s = ApiServer()
+    mgr = Manager(s)
+    calls = []
+
+    def reconcile(client, req):
+        calls.append(1)
+        if len(calls) == 1:
+            return Result(requeue=True, requeue_after=30.0)
+        return Result()
+
+    c = Controller("t", reconcile, [Watch("Pod")])
+    mgr.add_controller(c)
+    s.create(make_pod("p1"))
+    mgr.run_until_idle()
+    # the retry is parked at +30s (requeue_after), not immediate
+    assert len(calls) == 1
+    assert c.next_due() is not None
+    mgr.run_until_idle(advance_delayed=True)
+    assert len(calls) == 2
+
+
+def test_initial_sync_reconciles_preexisting_objects():
+    s = ApiServer()
+    s.create(make_pod("pre-existing"))
+    mgr = Manager(s)   # subscribed after the create
+    seen = []
+    mgr.add_controller(Controller("t", lambda cl, r: seen.append(r.name), [Watch("Pod")]))
+    mgr.run_until_idle()
+    assert seen == ["pre-existing"]
 
 
 def test_queue_dedup():

@@ -1,0 +1,94 @@
+"""Annotation codec (model: reference pkg/gpu/annotation_test.go)."""
+from nos_tpu.tpu import annotation as ann
+from nos_tpu.tpu.device import Device, DeviceList
+from nos_tpu.tpu.slice import Profile
+
+P11, P22, P24 = Profile(1, 1), Profile(2, 2), Profile(2, 4)
+
+
+def test_parse_node_annotations_roundtrip():
+    annotations = {
+        "nos.ai/spec-tpu-0-1x1": "4",
+        "nos.ai/spec-tpu-0-2x2": "1",
+        "nos.ai/status-tpu-0-1x1-free": "2",
+        "nos.ai/status-tpu-0-1x1-used": "2",
+        "nos.ai/status-tpu-0-2x2-used": "1",
+        "unrelated": "x",
+        "nos.ai/spec-tpu-bad": "7",            # malformed -> ignored
+        "nos.ai/spec-tpu-0-1x1-extra": "oops", # malformed -> ignored
+    }
+    specs, statuses = ann.parse_node_annotations(annotations)
+    assert len(specs) == 2
+    assert len(statuses) == 3
+    desired = ann.spec_from_annotations(specs)
+    assert desired == {0: {P11: 4, P22: 1}}
+    state = ann.status_to_board_state(statuses)
+    assert state[0]["free"] == {P11: 2}
+    assert state[0]["used"] == {P11: 2, P22: 1}
+
+
+def test_spec_annotations_from_partitioning():
+    out = ann.spec_annotations_from_partitioning({0: {P11: 4, P22: 1}, 1: {P24: 1}})
+    assert out == {
+        "nos.ai/spec-tpu-0-1x1": "4",
+        "nos.ai/spec-tpu-0-2x2": "1",
+        "nos.ai/spec-tpu-1-2x4": "1",
+    }
+    # zero quantities are omitted
+    assert ann.spec_annotations_from_partitioning({0: {P11: 0}}) == {}
+
+
+def test_status_annotations_from_devices():
+    devices = DeviceList([
+        Device("d0", 0, P11, "used"),
+        Device("d1", 0, P11, "used"),
+        Device("d2", 0, P11, "free"),
+        Device("d3", 0, P22, "free"),
+    ])
+    out = ann.status_annotations_from_devices(devices)
+    assert out == {
+        "nos.ai/status-tpu-0-1x1-used": "2",
+        "nos.ai/status-tpu-0-1x1-free": "1",
+        "nos.ai/status-tpu-0-2x2-free": "1",
+    }
+
+
+def test_spec_matches_status():
+    annotations = {
+        "nos.ai/spec-tpu-0-1x1": "2",
+        "nos.ai/status-tpu-0-1x1-free": "1",
+        "nos.ai/status-tpu-0-1x1-used": "1",
+    }
+    specs, statuses = ann.parse_node_annotations(annotations)
+    assert ann.spec_matches_status(specs, statuses)
+
+    annotations["nos.ai/spec-tpu-0-1x1"] = "3"
+    specs, statuses = ann.parse_node_annotations(annotations)
+    assert not ann.spec_matches_status(specs, statuses)
+
+
+def test_spec_matches_status_empty_sides():
+    assert ann.spec_matches_status([], [])
+    specs, statuses = ann.parse_node_annotations({"nos.ai/spec-tpu-0-1x1": "1"})
+    assert not ann.spec_matches_status(specs, statuses)
+
+
+def test_device_list_groupings():
+    devices = DeviceList([
+        Device("a", 0, P11, "used"),
+        Device("b", 1, P11, "free"),
+        Device("c", 0, P22, "free"),
+    ])
+    assert set(devices.group_by_board().keys()) == {0, 1}
+    assert len(devices.group_by_profile()[P11]) == 2
+    assert [d.device_id for d in devices.used()] == ["a"]
+    assert devices.geometry() == {P11: 2, P22: 1}
+
+
+def test_parse_rejects_nonpositive_quantities():
+    specs, statuses = ann.parse_node_annotations({
+        "nos.ai/spec-tpu-0-1x1": "-3",
+        "nos.ai/spec-tpu-0-2x2": "0",
+        "nos.ai/status-tpu-0-1x1-free": "-1",
+    })
+    assert specs == [] and statuses == []

@@ -1,0 +1,97 @@
+"""Board geometry state machine (model: reference pkg/gpu/mig/gpu_test.go)."""
+import pytest
+
+from nos_tpu.tpu.host import TpuBoard
+from nos_tpu.tpu.slice import Profile
+
+P11, P22, P24 = Profile(1, 1), Profile(2, 2), Profile(2, 4)
+
+
+def test_init_geometry_uses_fewest_slices():
+    b = TpuBoard(generation="v5e")
+    b.init_geometry()
+    assert b.geometry == {P24: 1}
+    assert b.free == {P24: 1} and b.used == {}
+
+
+def test_init_geometry_noop_when_partitioned():
+    b = TpuBoard(generation="v5e", free={P11: 8})
+    b.init_geometry()
+    assert b.geometry == {P11: 8}
+
+
+def test_can_apply_geometry_never_deletes_used():
+    b = TpuBoard(generation="v5e", used={P22: 1}, free={P22: 1})
+    assert b.can_apply_geometry({P22: 2})
+    assert b.can_apply_geometry({P22: 1, P11: 4})
+    assert not b.can_apply_geometry({P11: 8})      # would delete the used 2x2
+    assert not b.can_apply_geometry({P24: 1})      # ditto
+    assert not b.can_apply_geometry({P22: 3})      # not a legal tiling
+
+
+def test_apply_geometry_recomputes_free():
+    b = TpuBoard(generation="v5e", used={P22: 1}, free={P22: 1})
+    b.apply_geometry({P22: 1, P11: 4})
+    assert b.used == {P22: 1}
+    assert b.free == {P11: 4}
+
+
+def test_apply_illegal_geometry_raises():
+    b = TpuBoard(generation="v5e", used={P24: 1})
+    with pytest.raises(ValueError):
+        b.apply_geometry({P11: 8})
+
+
+def test_update_geometry_for_repartitions_to_demand():
+    b = TpuBoard(generation="v5e")
+    b.init_geometry()                       # 1x(2x4), all free
+    changed = b.update_geometry_for({P11: 3})
+    assert changed
+    assert b.free.get(P11, 0) >= 3
+
+
+def test_update_geometry_for_prefers_less_fragmentation():
+    b = TpuBoard(generation="v5e")
+    b.init_geometry()
+    b.update_geometry_for({P22: 1})
+    # both {2x2:2} and {2x2:1,1x1:4} provide one 2x2; fewest-slices tie-break
+    assert b.geometry == {P22: 2}
+
+
+def test_update_geometry_noop_when_demand_already_served():
+    b = TpuBoard(generation="v5e", free={P11: 8})
+    assert not b.update_geometry_for({P11: 2})
+    assert b.geometry == {P11: 8}
+
+
+def test_update_geometry_respects_used_slices():
+    b = TpuBoard(generation="v5e", used={P22: 1}, free={P22: 1})
+    changed = b.update_geometry_for({P11: 4})
+    assert changed
+    assert b.used == {P22: 1}
+    assert b.free == {P11: 4}
+
+
+def test_update_geometry_impossible_demand_returns_false():
+    b = TpuBoard(generation="v5e", used={P22: 2})   # board full with used slices
+    assert not b.update_geometry_for({P11: 1})
+    assert b.geometry == {P22: 2}
+
+
+def test_reserve_release_roundtrip():
+    b = TpuBoard(generation="v5e", free={P11: 2})
+    assert b.reserve(P11)
+    assert b.used == {P11: 1} and b.free == {P11: 1}
+    assert b.reserve(P11)
+    assert not b.reserve(P11)               # none free
+    b.release(P11)
+    assert b.free == {P11: 1}
+    with pytest.raises(ValueError):
+        b.release(P22)
+
+
+def test_clone_is_independent():
+    b = TpuBoard(generation="v5e", free={P11: 8})
+    c = b.clone()
+    c.reserve(P11)
+    assert b.free == {P11: 8}

@@ -1,0 +1,127 @@
+"""TpuNode + ResourceCalculator (model: reference pkg/gpu/mig/node_test.go,
+pkg/gpu/util resource tests)."""
+import pytest
+
+from nos_tpu import constants
+from nos_tpu.kube.objects import Container, Node, ObjectMeta, Pod, PodSpec
+from nos_tpu.tpu.node import NotATpuNode, TpuNode
+from nos_tpu.tpu.resource_calc import ResourceCalculator
+from nos_tpu.tpu.slice import Profile
+
+P11, P22, P24 = Profile(1, 1), Profile(2, 2), Profile(2, 4)
+
+
+def make_tpu_node(name="n1", gen="tpu-v5-lite-podslice", topo="2x4", annotations=None):
+    return Node(
+        metadata=ObjectMeta(
+            name=name,
+            labels={
+                constants.LABEL_TPU_ACCELERATOR: gen,
+                constants.LABEL_TPU_TOPOLOGY: topo,
+            },
+            annotations=annotations or {},
+        ),
+    )
+
+
+def test_from_node_reads_labels_and_status_annotations():
+    node = make_tpu_node(annotations={
+        "nos.ai/status-tpu-0-1x1-free": "2",
+        "nos.ai/status-tpu-0-1x1-used": "2",
+        "nos.ai/status-tpu-0-2x2-used": "1",
+    })
+    tn = TpuNode.from_node(node)
+    assert tn.generation == "tpu-v5-lite-podslice"
+    assert tn.topology_name == "2x4"
+    assert len(tn.boards) == 1
+    assert tn.free_slices() == {P11: 2}
+    assert tn.used_slices() == {P11: 2, P22: 1}
+
+
+def test_from_node_rejects_non_tpu_node():
+    node = Node(metadata=ObjectMeta(name="gpu-node"))
+    with pytest.raises(NotATpuNode):
+        TpuNode.from_node(node)
+
+
+def test_update_geometry_for_and_partitioning():
+    tn = TpuNode.from_node(make_tpu_node())
+    tn.boards[0].init_geometry()
+    assert tn.update_geometry_for({P11: 2})
+    part = tn.partitioning()
+    assert 0 in part and part[0].get(P11, 0) >= 2
+
+
+def test_allocatable_scalar_resources_partitioned():
+    node = make_tpu_node(annotations={
+        "nos.ai/status-tpu-0-2x2-free": "1",
+        "nos.ai/status-tpu-0-1x1-used": "4",
+    })
+    tn = TpuNode.from_node(node)
+    res = tn.allocatable_scalar_resources({"cpu": 8, constants.RESOURCE_TPU: 8})
+    # whole-chip resource replaced by sub-slice resources
+    assert constants.RESOURCE_TPU not in res
+    assert res["nos.ai/tpu-slice-2x2"] == 1
+    assert res["nos.ai/tpu-slice-1x1"] == 4
+    assert res["cpu"] == 8
+
+
+def test_allocatable_scalar_resources_unpartitioned():
+    tn = TpuNode.from_node(make_tpu_node())
+    res = tn.allocatable_scalar_resources({})
+    assert res[constants.RESOURCE_TPU] == 8
+
+
+def test_clone_independence():
+    tn = TpuNode.from_node(make_tpu_node())
+    tn.boards[0].init_geometry()
+    c = tn.clone()
+    c.update_geometry_for({P11: 8})
+    assert tn.partitioning() == {0: {P24: 1}}
+
+
+# ---------------------------------------------------------------------------
+# ResourceCalculator
+# ---------------------------------------------------------------------------
+
+def test_resource_calculator_whole_chips_default_memory():
+    calc = ResourceCalculator()
+    out = calc.compute_request({constants.RESOURCE_TPU: 4, "cpu": 2})
+    assert out[constants.RESOURCE_TPU_MEMORY] == 4 * 16
+    assert out["cpu"] == 2
+
+
+def test_resource_calculator_generation_aware():
+    calc = ResourceCalculator(generation="v5p")
+    out = calc.compute_request({constants.RESOURCE_TPU: 4})
+    assert out[constants.RESOURCE_TPU_MEMORY] == 4 * 95
+
+
+def test_resource_calculator_subslice_memory():
+    calc = ResourceCalculator()  # default 16 GB/chip
+    out = calc.compute_request({"nos.ai/tpu-slice-2x2": 2})
+    assert out[constants.RESOURCE_TPU_MEMORY] == 2 * 4 * 16
+
+
+def test_resource_calculator_pod_node_selector_generation():
+    calc = ResourceCalculator()
+    pod = Pod(
+        metadata=ObjectMeta(name="p"),
+        spec=PodSpec(
+            containers=[Container(requests={constants.RESOURCE_TPU: 1})],
+            node_selector={constants.LABEL_TPU_ACCELERATOR: "tpu-v5p-slice"},
+        ),
+    )
+    out = calc.compute_pod_request(pod)
+    assert out[constants.RESOURCE_TPU_MEMORY] == 95
+
+
+def test_resource_calculator_mixed_gpu_cluster():
+    calc = ResourceCalculator()
+    out = calc.compute_request({
+        "nvidia.com/gpu": 1,
+        "nvidia.com/mig-1g.10gb": 2,
+        constants.RESOURCE_TPU: 1,
+    })
+    assert out[constants.RESOURCE_GPU_MEMORY] == 32 + 20
+    assert out[constants.RESOURCE_TPU_MEMORY] == 16

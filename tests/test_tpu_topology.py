@@ -1,0 +1,107 @@
+"""Topology tables + derived sub-slice geometry menus
+(model: reference pkg/gpu/mig/known_configs.go + gpu_test.go)."""
+import pytest
+
+from nos_tpu.tpu.slice import Profile, parse_profile, fewest_slices_geometry, geometry_chips
+from nos_tpu.tpu import topology
+from nos_tpu.tpu.topology import (
+    Generation,
+    SliceTopology,
+    allowed_geometry_list,
+    find_slice_topology,
+    set_known_generations,
+    reset_known_generations,
+)
+
+
+def teardown_function():
+    reset_known_generations()
+
+
+def test_profile_parsing():
+    assert parse_profile("2x4") == Profile(2, 4)
+    assert parse_profile("nos.ai/tpu-slice-1x1") == Profile(1, 1)
+    assert Profile(2, 4).resource_name == "nos.ai/tpu-slice-2x4"
+    assert Profile(1, 1) < Profile(2, 2) < Profile(2, 4)
+    with pytest.raises(ValueError):
+        parse_profile("banana")
+    with pytest.raises(ValueError):
+        Profile(0, 1)
+
+
+def test_generation_table_facts():
+    v5e = topology.GENERATIONS["v5e"]
+    assert v5e.chips_per_host == 8
+    assert v5e.hbm_gb_per_chip == 16
+    v5p = topology.GENERATIONS["v5p"]
+    assert v5p.chips_per_host == 4
+    assert v5p.hbm_gb_per_chip == 95
+    # lookup by GKE label value too
+    assert topology.GENERATIONS["tpu-v5-lite-podslice"] is v5e
+
+
+def test_slice_topology_chips_and_hosts():
+    v5p = topology.GENERATIONS["v5p"]
+    t = find_slice_topology("v5p", "4x4x4")
+    assert t is not None and t.chips == 64
+    assert v5p.hosts_for(t) == 16
+    v5e = topology.GENERATIONS["v5e"]
+    t2 = find_slice_topology("v5e", "4x8")
+    assert t2.chips == 32 and v5e.hosts_for(t2) == 4
+    # single-host topology
+    t3 = find_slice_topology("v5e", "2x4")
+    assert t3.chips == 8 and v5e.hosts_for(t3) == 1
+
+
+def test_v5e_allowed_geometries_derived_from_tiling():
+    """v5e host = 2x4 grid, profiles 1x1 / 2x2 / 2x4. Exact tilings:
+    8x1x1, 4x1x1+2x2, 2x(2x2), 1x(2x4). All must appear; nothing else."""
+    geoms = allowed_geometry_list("v5e")
+    p11, p22, p24 = Profile(1, 1), Profile(2, 2), Profile(2, 4)
+    expected = [
+        {p24: 1},
+        {p22: 2},
+        {p22: 1, p11: 4},
+        {p11: 8},
+    ]
+    assert len(geoms) == len(expected)
+    for e in expected:
+        assert e in geoms
+    # every geometry covers exactly the full host grid
+    for g in geoms:
+        assert geometry_chips(g) == 8
+
+
+def test_v5p_allowed_geometries():
+    """v5p host = 2x2, profiles 1x1 / 1x2 / 2x2:
+    4x1x1, 2x1x2, 1x2+2x1x1, 2x2."""
+    geoms = allowed_geometry_list("v5p")
+    p11, p12, p22 = Profile(1, 1), Profile(1, 2), Profile(2, 2)
+    assert {p22: 1} in geoms
+    assert {p12: 2} in geoms
+    assert {p11: 4} in geoms
+    assert {p12: 1, p11: 2} in geoms
+    assert len(geoms) == 4
+
+
+def test_fewest_slices_geometry_prefers_whole_board():
+    g = fewest_slices_geometry(allowed_geometry_list("v5e"))
+    assert g == {Profile(2, 4): 1}
+
+
+def test_runtime_generation_override():
+    custom = Generation(
+        name="tpu-vX-test",
+        short="vX",
+        host_rows=1,
+        host_cols=2,
+        hbm_gb_per_chip=8,
+        subslice_profiles=(Profile(1, 1), Profile(1, 2)),
+        topologies=(SliceTopology((1, 2)),),
+    )
+    set_known_generations([custom])
+    assert topology.get_generation("v5e") is None
+    geoms = allowed_geometry_list("vX")
+    assert {Profile(1, 1): 2} in geoms and {Profile(1, 2): 1} in geoms
+    reset_known_generations()
+    assert topology.get_generation("v5e") is not None
