@@ -1,0 +1,16 @@
+"""CRD-equivalent API types, validating webhooks, and component configs
+(analog of reference pkg/api/nos.nebuly.com/v1alpha1 and .../config/v1alpha1)."""
+from nos_tpu.api.quota import (  # noqa: F401
+    ElasticQuota,
+    ElasticQuotaSpec,
+    ElasticQuotaStatus,
+    CompositeElasticQuota,
+    CompositeElasticQuotaSpec,
+)
+from nos_tpu.api.webhooks import register_quota_webhooks  # noqa: F401
+from nos_tpu.api.configs import (  # noqa: F401
+    OperatorConfig,
+    PartitionerConfig,
+    TpuAgentConfig,
+    CapacitySchedulingArgs,
+)

@@ -1,0 +1,99 @@
+"""Component configuration kinds.
+
+Analog of reference pkg/api/nos.nebuly.com/config/v1alpha1/*.go — each binary
+loads a YAML config file into one of these kinds and validates it
+(cmd/gpupartitioner/gpupartitioner.go:87-101). YAML loading is provided via
+``from_yaml_file`` so the cmd/ entrypoints match the reference's
+``ctrl.ConfigFile().AtPath(...)`` pattern.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field, fields
+from typing import Optional
+
+import yaml
+
+from nos_tpu import constants
+
+
+class ConfigError(ValueError):
+    pass
+
+
+@dataclass
+class _BaseConfig:
+    leader_election: bool = False
+    log_level: int = 0
+
+    @classmethod
+    def from_yaml_file(cls, path: str):
+        with open(path) as f:
+            data = yaml.safe_load(f) or {}
+        known = {f.name for f in fields(cls)}
+        unknown = set(data) - known
+        if unknown:
+            raise ConfigError(f"{cls.__name__}: unknown config keys {sorted(unknown)}")
+        cfg = cls(**data)
+        cfg.validate()
+        return cfg
+
+    def validate(self) -> None:
+        pass
+
+
+@dataclass
+class OperatorConfig(_BaseConfig):
+    """Analog of OperatorConfig{NvidiaGpuResourceMemoryGB}."""
+
+    tpu_resource_memory_gb: int = constants.DEFAULT_TPU_MEMORY_GB
+    nvidia_gpu_resource_memory_gb: int = constants.DEFAULT_NVIDIA_GPU_MEMORY_GB
+
+    def validate(self) -> None:
+        if self.tpu_resource_memory_gb <= 0:
+            raise ConfigError("tpu_resource_memory_gb must be positive")
+        if self.nvidia_gpu_resource_memory_gb <= 0:
+            raise ConfigError("nvidia_gpu_resource_memory_gb must be positive")
+
+
+@dataclass
+class PartitionerConfig(_BaseConfig):
+    """Analog of GpuPartitionerConfig (batch windows, device-plugin CM,
+    known-geometries override file)."""
+
+    batch_window_timeout_seconds: float = constants.DEFAULT_BATCH_WINDOW_TIMEOUT_S
+    batch_window_idle_seconds: float = constants.DEFAULT_BATCH_WINDOW_IDLE_S
+    device_plugin_config_map: str = constants.DEVICE_PLUGIN_CONFIGMAP
+    device_plugin_namespace: str = constants.DEVICE_PLUGIN_NAMESPACE
+    device_plugin_delay_seconds: float = constants.DEFAULT_DEVICE_PLUGIN_DELAY_S
+    known_generations_file: Optional[str] = None
+
+    def validate(self) -> None:
+        if self.batch_window_timeout_seconds <= 0:
+            raise ConfigError("batch_window_timeout_seconds must be positive")
+        if self.batch_window_idle_seconds <= 0:
+            raise ConfigError("batch_window_idle_seconds must be positive")
+        if self.batch_window_idle_seconds > self.batch_window_timeout_seconds:
+            raise ConfigError("batch_window_idle_seconds must be <= timeout")
+
+
+@dataclass
+class TpuAgentConfig(_BaseConfig):
+    """Analog of MigAgentConfig/GpuAgentConfig."""
+
+    report_interval_seconds: float = constants.DEFAULT_REPORT_INTERVAL_S
+
+    def validate(self) -> None:
+        if self.report_interval_seconds <= 0:
+            raise ConfigError("report_interval_seconds must be positive")
+
+
+@dataclass
+class CapacitySchedulingArgs(_BaseConfig):
+    """Analog of pkg/api/scheduler/types.go:20-27 CapacitySchedulingArgs."""
+
+    tpu_resource_memory_gb: int = constants.DEFAULT_TPU_MEMORY_GB
+    nvidia_gpu_resource_memory_gb: int = constants.DEFAULT_NVIDIA_GPU_MEMORY_GB
+
+    def validate(self) -> None:
+        if self.tpu_resource_memory_gb <= 0:
+            raise ConfigError("tpu_resource_memory_gb must be positive")

@@ -1,0 +1,88 @@
+"""ElasticQuota / CompositeElasticQuota types.
+
+Analog of reference pkg/api/nos.nebuly.com/v1alpha1/elasticquota_types.go:30-60
+and compositeelasticquota_types.go:29-57:
+
+- ``ElasticQuota``: namespace-scoped quota with ``spec.min`` (guaranteed) and
+  optional ``spec.max`` (cap); ``status.used`` maintained by the operator.
+  Namespaces may *borrow* unused min from other namespaces (pods beyond min
+  are labeled over-quota and are preemptible).
+- ``CompositeElasticQuota``: one quota spanning ``spec.namespaces``.
+
+Quotas count TPU chips (google.com/tpu), TPU sub-slices, the derived
+nos.ai/tpu-memory scalar, and (mixed clusters) GPU resources, all through
+the same ResourceList machinery.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import List, Optional
+
+from nos_tpu.kube.objects import ObjectMeta, ResourceList
+
+
+@dataclass
+class ElasticQuotaSpec:
+    min: ResourceList = field(default_factory=dict)
+    max: Optional[ResourceList] = None
+
+
+@dataclass
+class ElasticQuotaStatus:
+    used: ResourceList = field(default_factory=dict)
+
+
+@dataclass
+class ElasticQuota:
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    spec: ElasticQuotaSpec = field(default_factory=ElasticQuotaSpec)
+    status: ElasticQuotaStatus = field(default_factory=ElasticQuotaStatus)
+
+    KIND = "ElasticQuota"
+
+
+@dataclass
+class CompositeElasticQuotaSpec:
+    namespaces: List[str] = field(default_factory=list)
+    min: ResourceList = field(default_factory=dict)
+    max: Optional[ResourceList] = None
+
+
+@dataclass
+class CompositeElasticQuota:
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    spec: CompositeElasticQuotaSpec = field(default_factory=CompositeElasticQuotaSpec)
+    status: ElasticQuotaStatus = field(default_factory=ElasticQuotaStatus)
+
+    KIND = "CompositeElasticQuota"
+
+
+# -- builder factories (analog of elasticquota_factory.go) -------------------
+
+def make_elastic_quota(
+    name: str,
+    namespace: str,
+    min: ResourceList,
+    max: Optional[ResourceList] = None,
+) -> ElasticQuota:
+    return ElasticQuota(
+        metadata=ObjectMeta(name=name, namespace=namespace),
+        spec=ElasticQuotaSpec(min=dict(min), max=dict(max) if max is not None else None),
+    )
+
+
+def make_composite_elastic_quota(
+    name: str,
+    namespace: str,
+    namespaces: List[str],
+    min: ResourceList,
+    max: Optional[ResourceList] = None,
+) -> CompositeElasticQuota:
+    return CompositeElasticQuota(
+        metadata=ObjectMeta(name=name, namespace=namespace),
+        spec=CompositeElasticQuotaSpec(
+            namespaces=list(namespaces),
+            min=dict(min),
+            max=dict(max) if max is not None else None,
+        ),
+    )

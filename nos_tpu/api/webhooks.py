@@ -1,0 +1,70 @@
+"""Validating webhooks for ElasticQuota / CompositeElasticQuota.
+
+Analog of reference pkg/api/nos.nebuly.com/v1alpha1/elasticquota_webhook.go:30-80
+and compositeelasticquota_webhook.go:47-87. Invariants enforced at admission:
+
+1. at most one ElasticQuota per namespace;
+2. an ElasticQuota's namespace must not be covered by any
+   CompositeElasticQuota;
+3. a namespace may belong to at most one CompositeElasticQuota;
+4. (both kinds) every max entry must be >= the matching min entry.
+"""
+from __future__ import annotations
+
+from nos_tpu.api.quota import CompositeElasticQuota, ElasticQuota
+from nos_tpu.kube.apiserver import AdmissionDenied, ApiServer
+
+
+def _validate_min_max(spec) -> None:
+    if spec.max is None:
+        return
+    for resource, min_qty in spec.min.items():
+        if resource in spec.max and spec.max[resource] < min_qty:
+            raise AdmissionDenied(
+                f"max[{resource}]={spec.max[resource]} is less than min[{resource}]={min_qty}"
+            )
+
+
+def _validate_elastic_quota(server: ApiServer, op: str, eq: ElasticQuota, old) -> None:
+    if op == "DELETE":
+        return
+    _validate_min_max(eq.spec)
+    ns = eq.metadata.namespace
+    for other in server.list("ElasticQuota", namespace=ns):
+        if other.metadata.name != eq.metadata.name:
+            raise AdmissionDenied(
+                f"namespace {ns!r} already has ElasticQuota {other.metadata.name!r}"
+            )
+    for ceq in server.list("CompositeElasticQuota"):
+        if ns in ceq.spec.namespaces:
+            raise AdmissionDenied(
+                f"namespace {ns!r} is covered by CompositeElasticQuota "
+                f"{ceq.metadata.name!r}"
+            )
+
+
+def _validate_composite_elastic_quota(
+    server: ApiServer, op: str, ceq: CompositeElasticQuota, old
+) -> None:
+    if op == "DELETE":
+        return
+    _validate_min_max(ceq.spec)
+    if len(set(ceq.spec.namespaces)) != len(ceq.spec.namespaces):
+        raise AdmissionDenied("duplicate namespaces in CompositeElasticQuota")
+    for other in server.list("CompositeElasticQuota"):
+        if other.metadata.name == ceq.metadata.name and \
+                other.metadata.namespace == ceq.metadata.namespace:
+            continue
+        overlap = set(ceq.spec.namespaces) & set(other.spec.namespaces)
+        if overlap:
+            raise AdmissionDenied(
+                f"namespaces {sorted(overlap)} already belong to "
+                f"CompositeElasticQuota {other.metadata.name!r}"
+            )
+
+
+def register_quota_webhooks(server: ApiServer) -> None:
+    """Wire the validating webhooks into the API server (analog of
+    SetupWebhookWithManager, cmd/operator/operator.go:92,107)."""
+    server.register_admission("ElasticQuota", _validate_elastic_quota)
+    server.register_admission("CompositeElasticQuota", _validate_composite_elastic_quota)

@@ -1,0 +1,8 @@
+"""Elastic quota layer: quota accounting math + controllers
+(analog of reference internal/controllers/elasticquota and the
+ElasticQuotaInfo machinery of pkg/scheduler/plugins/capacityscheduling)."""
+from nos_tpu.quota.info import QuotaInfo, QuotaInfos  # noqa: F401
+from nos_tpu.quota.controller import (  # noqa: F401
+    ElasticQuotaReconciler,
+    CompositeElasticQuotaReconciler,
+)

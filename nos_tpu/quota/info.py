@@ -1,0 +1,230 @@
+"""Quota accounting math — QuotaInfo / QuotaInfos.
+
+Analog of reference
+pkg/scheduler/plugins/capacityscheduling/elasticquotainfo.go:31-361 (the
+best-tested code in the reference; its 881-LoC test file is mirrored by
+tests/test_quota_info.py). Semantics preserved:
+
+- comparisons are *bound-keyed*: a resource counts against a bound (min or
+  max) only if the bound lists it, except the core resources (cpu, memory)
+  which are always bounded with default 0 — matching the reference's
+  framework.Resource behavior where MilliCPU/Memory always exist;
+- ``guaranteed_overquotas(ns)``: the aggregated unused min across all quotas
+  (Σ max(0, min-used)) split proportionally to each quota's share of
+  aggregated min, floored per resource — the fair-sharing rule preemption
+  is built on (elasticquotainfo.go:81-152);
+- one QuotaInfo may span several namespaces (CompositeElasticQuota).
+"""
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass, field
+from typing import Dict, Optional, Set
+
+from nos_tpu.kube.objects import Pod, ResourceList
+from nos_tpu.tpu.resource_calc import ResourceCalculator
+
+# Core resources are always constrained (absent bound entry means 0),
+# mirroring framework.Resource's always-present MilliCPU/Memory.
+CORE_RESOURCES = ("cpu", "memory")
+
+
+def _bound_keys(*lists: ResourceList) -> Set[str]:
+    keys: Set[str] = set(CORE_RESOURCES)
+    for lst in lists:
+        keys.update(lst.keys())
+    return keys
+
+
+def sum_greater_than(x1: ResourceList, x2: ResourceList, y: ResourceList) -> bool:
+    """True if any resource of (x1+x2) that is bounded by y exceeds it.
+    Core resources are always bounded (default 0); scalars only when listed
+    in y (reference sumGreaterThan, elasticquotainfo.go:316)."""
+    for r in set(x1) | set(x2):
+        bound = y.get(r)
+        if bound is None:
+            if r not in CORE_RESOURCES:
+                continue
+            bound = 0.0
+        if x1.get(r, 0) + x2.get(r, 0) > bound + 1e-9 * max(1.0, abs(bound)):
+            return True
+    return False
+
+
+def greater_than(x: ResourceList, y: ResourceList) -> bool:
+    return sum_greater_than(x, {}, y)
+
+
+def sum_less_than_equal(x1: ResourceList, x2: ResourceList, y: ResourceList) -> bool:
+    return not sum_greater_than(x1, x2, y)
+
+
+@dataclass
+class QuotaInfo:
+    """Live accounting for one ElasticQuota or CompositeElasticQuota."""
+
+    name: str
+    namespace: str                         # namespace the quota object lives in
+    namespaces: Set[str] = field(default_factory=set)  # namespaces it covers
+    min: ResourceList = field(default_factory=dict)
+    max: Optional[ResourceList] = None
+    used: ResourceList = field(default_factory=dict)
+    pods: Set[str] = field(default_factory=set)
+    calculator: ResourceCalculator = field(default_factory=ResourceCalculator)
+
+    @property
+    def max_enforced(self) -> bool:
+        return self.max is not None
+
+    # -- bounds -------------------------------------------------------------
+    def used_over_min_with(self, req: ResourceList) -> bool:
+        return sum_greater_than(req, self.used, self.min)
+
+    def used_over_max_with(self, req: ResourceList) -> bool:
+        if not self.max_enforced:
+            return False
+        return sum_greater_than(req, self.used, self.max)
+
+    def used_over_min(self) -> bool:
+        return greater_than(self.used, self.min)
+
+    def used_over(self, bound: ResourceList) -> bool:
+        return greater_than(self.used, bound)
+
+    def used_lte_with(self, bound: ResourceList, req: ResourceList) -> bool:
+        return sum_less_than_equal(req, self.used, bound)
+
+    # -- accounting ---------------------------------------------------------
+    def reserve(self, req: ResourceList) -> None:
+        for r, v in req.items():
+            self.used[r] = self.used.get(r, 0) + v
+
+    def unreserve(self, req: ResourceList) -> None:
+        for r, v in req.items():
+            self.used[r] = self.used.get(r, 0) - v
+
+    def add_pod_if_not_present(self, pod: Pod) -> None:
+        key = f"{pod.metadata.namespace}/{pod.metadata.name}"
+        if key in self.pods:
+            return
+        self.pods.add(key)
+        self.reserve(self.calculator.compute_pod_request(pod))
+
+    def delete_pod_if_present(self, pod: Pod) -> None:
+        key = f"{pod.metadata.namespace}/{pod.metadata.name}"
+        if key not in self.pods:
+            return
+        self.pods.discard(key)
+        self.unreserve(self.calculator.compute_pod_request(pod))
+
+    def clone(self) -> "QuotaInfo":
+        return QuotaInfo(
+            name=self.name,
+            namespace=self.namespace,
+            namespaces=set(self.namespaces),
+            min=dict(self.min),
+            max=dict(self.max) if self.max is not None else None,
+            used=dict(self.used),
+            pods=set(self.pods),
+            calculator=self.calculator,
+        )
+
+
+class QuotaInfos(Dict[str, QuotaInfo]):
+    """namespace -> QuotaInfo (one info object may appear under several
+    namespaces for composite quotas). Analog of ElasticQuotaInfos."""
+
+    def add(self, info: QuotaInfo) -> None:
+        for ns in info.namespaces:
+            self[ns] = info
+
+    def remove(self, info: QuotaInfo) -> None:
+        for ns in list(info.namespaces):
+            if self.get(ns) is info or (
+                ns in self and self[ns].name == info.name
+            ):
+                del self[ns]
+
+    def replace_info(self, old_info: QuotaInfo, new_info: QuotaInfo) -> None:
+        for ns in new_info.namespaces:
+            existing = self.get(ns)
+            if existing is not None:
+                new_info.pods = existing.pods
+                new_info.used = existing.used
+            self[ns] = new_info
+        for ns in old_info.namespaces:
+            if ns not in new_info.namespaces and ns in self:
+                del self[ns]
+
+    def clone(self) -> "QuotaInfos":
+        out = QuotaInfos()
+        cloned: Dict[int, QuotaInfo] = {}
+        for ns, info in self.items():
+            if id(info) not in cloned:
+                cloned[id(info)] = info.clone()
+            out[ns] = cloned[id(info)]
+        return out
+
+    # -- aggregates ---------------------------------------------------------
+    def _distinct_infos(self):
+        seen = set()
+        for info in self.values():
+            if id(info) not in seen:
+                seen.add(id(info))
+                yield info
+
+    def aggregated_min(self) -> ResourceList:
+        total: ResourceList = {}
+        for info in self._distinct_infos():
+            for r, v in info.min.items():
+                total[r] = total.get(r, 0) + v
+        return total
+
+    def aggregated_used(self) -> ResourceList:
+        total: ResourceList = {}
+        for info in self._distinct_infos():
+            for r, v in info.used.items():
+                total[r] = total.get(r, 0) + v
+        return total
+
+    def aggregated_used_over_min_with(self, req: ResourceList) -> bool:
+        """Cluster-wide ceiling: Σused + req > Σmin
+        (reference AggregatedUsedOverMinWith)."""
+        return sum_greater_than(req, self.aggregated_used(), self.aggregated_min())
+
+    def aggregated_overquotas(self) -> ResourceList:
+        """Σ max(0, min - used) over quotas: quota headroom available for
+        borrowing (reference getAggregatedOverquotas with its worked
+        example)."""
+        total: ResourceList = {}
+        for info in self._distinct_infos():
+            for r, m in info.min.items():
+                unused = m - info.used.get(r, 0)
+                if unused > 0:
+                    total[r] = total.get(r, 0) + unused
+        return total
+
+    def guaranteed_overquotas(self, namespace: str) -> ResourceList:
+        """The slice of aggregated overquota guaranteed to ``namespace``'s
+        quota: proportional to its share of aggregated min, floored
+        (reference GetGuaranteedOverquotas, elasticquotainfo.go:81)."""
+        info = self.get(namespace)
+        if info is None:
+            raise KeyError(f"no quota covers namespace {namespace!r}")
+        total_min = self.aggregated_min()
+        overquotas = self.aggregated_overquotas()
+        out: ResourceList = {}
+        for r, m in info.min.items():
+            t = total_min.get(r, 0)
+            pct = (m / t) if t > 0 else 0.0
+            out[r] = _floor_quantity(r, overquotas.get(r, 0) * pct)
+        return out
+
+
+def _floor_quantity(resource: str, value: float) -> float:
+    """Floor at the resource's allocation granularity (reference floors
+    MilliCPU/Memory/scalars as integers): cpu at millicores, everything else
+    at whole units (bytes, chips, sub-slices, GB scalars)."""
+    if resource == "cpu":
+        return math.floor(value * 1000 + 1e-9) / 1000
+    return float(math.floor(value + 1e-9))

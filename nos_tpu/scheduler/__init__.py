@@ -1,0 +1,21 @@
+"""Quota-aware scheduler (analog of reference cmd/scheduler +
+pkg/scheduler/plugins/capacityscheduling).
+
+The reference recompiles the stock kube-scheduler with an out-of-tree
+CapacityScheduling plugin (cmd/scheduler/scheduler.go:43-59) built on the
+vendored k8s scheduler framework. SURVEY §7 flags that vendoring as a risk
+and recommends a leaner framework mirroring only the plugins that matter —
+that's ``nos_tpu.scheduler.framework``: NodeInfo bookkeeping, a plugin
+pipeline (PreFilter → Filter → Score → Reserve → Permit → Bind, PostFilter
+on failure), and the two default filters that matter for TPU scheduling
+(resource fit + node selector).
+"""
+from nos_tpu.scheduler.framework import (  # noqa: F401
+    CycleState,
+    NodeInfo,
+    SchedulerFramework,
+    Snapshot,
+    Status,
+)
+from nos_tpu.scheduler.capacity import CapacityScheduling  # noqa: F401
+from nos_tpu.scheduler.scheduler import Scheduler  # noqa: F401

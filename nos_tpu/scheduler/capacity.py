@@ -1,0 +1,285 @@
+"""CapacityScheduling plugin — elastic-quota enforcement + quota-aware
+preemption.
+
+Analog of reference
+pkg/scheduler/plugins/capacityscheduling/capacity_scheduling.go:
+
+- **PreFilter** (:190-278): reject a pod whose namespace quota would exceed
+  ``max`` (when enforced), or that would push aggregate cluster usage over
+  the aggregate ``min`` ceiling.
+- **PostFilter** (:323, :468-675): preemption. Victim selection per node
+  follows the reference's two regimes:
+  * preemptor would go over its min (*borrowing*): victims are same-namespace
+    lower-priority pods, or cross-namespace pods already labeled over-quota —
+    but only if the preemptor stays within min + its guaranteed overquota
+    share, and only from quotas using more than min + *their* guaranteed
+    share (the fair-sharing rule, elasticquotainfo.go:81-152);
+  * preemptor stays within min: victims are cross-namespace over-quota pods
+    from any quota over its min (reclaiming borrowed capacity).
+  After removing potential victims it re-checks fit and quota ceilings, then
+  reprieves as many victims as possible highest-priority-first (:635-673).
+- **Reserve/Unreserve** (:343-369): live ``used`` bookkeeping.
+
+PodDisruptionBudget-violation ordering is not modeled (no PDB analog here);
+everything else mirrors the reference's decision structure.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Tuple
+
+from nos_tpu import constants
+from nos_tpu.kube.objects import Pod, ResourceList, add_resources
+from nos_tpu.quota.info import QuotaInfo, QuotaInfos
+from nos_tpu.scheduler import framework as fw
+from nos_tpu.tpu.resource_calc import ResourceCalculator
+from nos_tpu.utils.pod import is_over_quota
+
+PRE_FILTER_STATE = "capacity/preFilterState"
+SNAPSHOT_STATE = "capacity/quotaSnapshot"
+
+
+@dataclass
+class _PreFilterState:
+    pod_req: ResourceList
+
+
+class CapacityScheduling:
+    name = "CapacityScheduling"
+
+    def __init__(self, calculator: Optional[ResourceCalculator] = None):
+        self.calc = calculator or ResourceCalculator()
+        self.quotas = QuotaInfos()
+        # Set by the hosting Scheduler so preemption's what-if fit check runs
+        # the FULL filter pipeline (reference RunFilterPluginsWithNominatedPods,
+        # capacity_scheduling.go:610) — not just resource fit. None during
+        # standalone unit use; falls back to the default filters.
+        self.framework = None
+
+    def _fits(self, state: fw.CycleState, pod: Pod, node_info: fw.NodeInfo) -> bool:
+        if self.framework is not None:
+            return self.framework.run_filter(state, pod, node_info).success
+        return (
+            fw.NodeSelectorFit().filter(state, pod, node_info).success
+            and fw.NodeResourcesFit().filter(state, pod, node_info).success
+        )
+
+    # ------------------------------------------------------------------
+    # informer surface (analog of capacityscheduling/informer.go: unified
+    # EQ+CEQ stream with CEQ taking precedence)
+    # ------------------------------------------------------------------
+    def sync_quotas(self, eqs: List[object], ceqs: List[object]) -> None:
+        infos = QuotaInfos()
+        covered = set()
+        for ceq in ceqs:
+            info = QuotaInfo(
+                name=ceq.metadata.name,
+                namespace=ceq.metadata.namespace,
+                namespaces=set(ceq.spec.namespaces),
+                min=dict(ceq.spec.min),
+                max=dict(ceq.spec.max) if ceq.spec.max is not None else None,
+                calculator=self.calc,
+            )
+            infos.add(info)
+            covered |= info.namespaces
+        for eq in eqs:
+            ns = eq.metadata.namespace
+            if ns in covered:
+                continue  # CEQ takes precedence (informer.go:57-300)
+            infos.add(
+                QuotaInfo(
+                    name=eq.metadata.name,
+                    namespace=ns,
+                    namespaces={ns},
+                    min=dict(eq.spec.min),
+                    max=dict(eq.spec.max) if eq.spec.max is not None else None,
+                    calculator=self.calc,
+                )
+            )
+        # carry over live accounting
+        for ns, old in self.quotas.items():
+            new = infos.get(ns)
+            if new is not None and new.name == old.name:
+                new.used = old.used
+                new.pods = old.pods
+        self.quotas = infos
+
+    def reset_accounting(self) -> None:
+        """Zero all used/pod bookkeeping (the scheduler loop rebuilds it
+        from the live pod list each cycle — level-triggered accounting)."""
+        seen = set()
+        for info in self.quotas.values():
+            if id(info) in seen:
+                continue
+            seen.add(id(info))
+            info.used = {}
+            info.pods = set()
+
+    def track_pod(self, pod: Pod) -> None:
+        """Account a running/assigned pod against its namespace quota."""
+        info = self.quotas.get(pod.metadata.namespace)
+        if info is not None:
+            info.add_pod_if_not_present(pod)
+
+    def untrack_pod(self, pod: Pod) -> None:
+        info = self.quotas.get(pod.metadata.namespace)
+        if info is not None:
+            info.delete_pod_if_present(pod)
+
+    # ------------------------------------------------------------------
+    # PreFilter
+    # ------------------------------------------------------------------
+    def pre_filter(
+        self, state: fw.CycleState, pod: Pod, snapshot: fw.Snapshot
+    ) -> fw.Status:
+        req = self.calc.compute_pod_request(pod)
+        state[PRE_FILTER_STATE] = _PreFilterState(pod_req=req)
+        state[SNAPSHOT_STATE] = self.quotas.clone()
+        info = self.quotas.get(pod.metadata.namespace)
+        if info is None:
+            return fw.Status.ok()
+        if info.used_over_max_with(req):
+            return fw.Status.unschedulable(
+                f"quota {info.name}: max quota exceeded"
+            )
+        if self.quotas.aggregated_used_over_min_with(req):
+            return fw.Status.unschedulable(
+                "aggregated used would exceed aggregated min"
+            )
+        return fw.Status.ok()
+
+    # ------------------------------------------------------------------
+    # Reserve / Unreserve
+    # ------------------------------------------------------------------
+    def reserve(self, state: fw.CycleState, pod: Pod, node_name: str) -> fw.Status:
+        info = self.quotas.get(pod.metadata.namespace)
+        if info is not None:
+            info.add_pod_if_not_present(pod)
+        return fw.Status.ok()
+
+    def unreserve(self, state: fw.CycleState, pod: Pod, node_name: str) -> None:
+        info = self.quotas.get(pod.metadata.namespace)
+        if info is not None:
+            info.delete_pod_if_present(pod)
+
+    # ------------------------------------------------------------------
+    # PostFilter: preemption
+    # ------------------------------------------------------------------
+    def post_filter(
+        self, state: fw.CycleState, pod: Pod, snapshot: fw.Snapshot
+    ) -> Tuple[Optional[str], fw.Status]:
+        """Evaluate preemption on every node; pick the node needing the
+        fewest victims (ties: lexical). Returns (node, status); the caller
+        (scheduler loop) deletes ``state['capacity/victims']`` and nominates
+        the pod."""
+        best_node: Optional[str] = None
+        best_victims: Optional[List[Pod]] = None
+        for name, info in sorted(snapshot.items()):
+            victims = self._select_victims_on_node(state, pod, info)
+            if victims is None:
+                continue
+            if best_victims is None or len(victims) < len(best_victims):
+                best_node = name
+                best_victims = victims
+        if best_node is None:
+            return None, fw.Status.unschedulable("preemption found no candidate")
+        state["capacity/victims"] = best_victims
+        return best_node, fw.Status.ok()
+
+    def _select_victims_on_node(
+        self, state: fw.CycleState, pod: Pod, node_info: fw.NodeInfo
+    ) -> Optional[List[Pod]]:
+        """Reference SelectVictimsOnNode (capacity_scheduling.go:468-675).
+        Returns the victim list, or None if preempting on this node cannot
+        make the pod schedulable."""
+        pf: _PreFilterState = state.get(PRE_FILTER_STATE) or _PreFilterState(
+            self.calc.compute_pod_request(pod)
+        )
+        quotas: QuotaInfos = state.get(SNAPSHOT_STATE) or self.quotas
+        quotas = quotas.clone()
+        sim = node_info.clone()
+        pod_req = pf.pod_req
+        pod_priority = pod.priority()
+        preemptor_info = quotas.get(pod.metadata.namespace)
+
+        potential: List[Pod] = []
+        if preemptor_info is not None:
+            over_min_with_pod = preemptor_info.used_over_min_with(pod_req)
+            # invariant across the victim loop (quotas unchanged during
+            # potential-victim selection) — hoisted
+            guaranteed = quotas.guaranteed_overquotas(pod.metadata.namespace)
+            min_plus_guaranteed = add_resources(preemptor_info.min, guaranteed)
+            preemptor_within_share = preemptor_info.used_lte_with(
+                min_plus_guaranteed, pod_req
+            )
+            for victim in list(sim.pods):
+                v_info = quotas.get(victim.metadata.namespace)
+                if v_info is None:
+                    continue
+                if over_min_with_pod:
+                    if victim.metadata.namespace == pod.metadata.namespace:
+                        if victim.priority() < pod_priority:
+                            potential.append(victim)
+                        continue
+                    if not is_over_quota(victim):
+                        continue
+                    if preemptor_within_share:
+                        v_guaranteed = quotas.guaranteed_overquotas(
+                            victim.metadata.namespace
+                        )
+                        v_bound = add_resources(v_info.min, v_guaranteed)
+                        if v_info.used_over(v_bound):
+                            potential.append(victim)
+                else:
+                    # preemptor within min: reclaim borrowed capacity
+                    if (
+                        victim.metadata.namespace != pod.metadata.namespace
+                        and v_info.used_over_min()
+                        and is_over_quota(victim)
+                    ):
+                        potential.append(victim)
+        else:
+            for victim in list(sim.pods):
+                if quotas.get(victim.metadata.namespace) is not None:
+                    continue
+                if victim.priority() < pod_priority:
+                    potential.append(victim)
+
+        if not potential:
+            return None
+
+        # Remove all potential victims, then check the pod fits.
+        for v in potential:
+            sim.remove_pod(v)
+            v_info = quotas.get(v.metadata.namespace)
+            if v_info is not None:
+                v_info.delete_pod_if_present(v)
+        if not self._fits(state, pod, sim):
+            return None
+        if preemptor_info is not None:
+            if preemptor_info.used_over_max_with(pod_req):
+                return None
+            if quotas.aggregated_used_over_min_with(pod_req):
+                return None
+
+        # Reprieve as many victims as possible, highest priority first
+        # (reference reprieve loop :635-673).
+        victims: List[Pod] = []
+        for v in sorted(potential, key=lambda p: (-p.priority(), p.metadata.name)):
+            sim.add_pod(v)
+            v_info = quotas.get(v.metadata.namespace)
+            if v_info is not None:
+                v_info.add_pod_if_not_present(v)
+            fits = self._fits(state, pod, sim)
+            quota_ok = True
+            if preemptor_info is not None:
+                if preemptor_info.used_over_max_with(pod_req):
+                    quota_ok = False
+                if quotas.aggregated_used_over_min_with(pod_req):
+                    quota_ok = False
+            if not (fits and quota_ok):
+                sim.remove_pod(v)
+                if v_info is not None:
+                    v_info.delete_pod_if_present(v)
+                victims.append(v)
+        return victims

@@ -1,0 +1,267 @@
+"""Lean scheduler framework.
+
+Mirrors the k8s scheduler framework surface the reference actually uses
+(PreFilter / Filter / PostFilter / Reserve / Unreserve, plus Permit for the
+gang scheduler — new ground, the reference never uses Permit, SURVEY §7
+step 6), over an in-memory ``Snapshot`` of nodes and pods. The partitioning
+planner embeds the same framework for what-if simulation (analog of
+cmd/gpupartitioner/gpupartitioner.go:294-318 newSchedulerFramework).
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional, Tuple
+
+from nos_tpu.kube.objects import (
+    Node,
+    Pod,
+    ResourceList,
+    add_resources,
+    resources_fit,
+)
+from nos_tpu.tpu.resource_calc import ResourceCalculator
+
+
+# ---------------------------------------------------------------------------
+# Status
+# ---------------------------------------------------------------------------
+
+SUCCESS = "Success"
+UNSCHEDULABLE = "Unschedulable"
+UNSCHEDULABLE_AND_UNRESOLVABLE = "UnschedulableAndUnresolvable"
+WAIT = "Wait"
+
+
+@dataclass
+class Status:
+    code: str = SUCCESS
+    reason: str = ""
+
+    @property
+    def success(self) -> bool:
+        return self.code == SUCCESS
+
+    @property
+    def wait(self) -> bool:
+        return self.code == WAIT
+
+    @staticmethod
+    def ok() -> "Status":
+        return Status()
+
+    @staticmethod
+    def unschedulable(reason: str) -> "Status":
+        return Status(UNSCHEDULABLE, reason)
+
+    @staticmethod
+    def unresolvable(reason: str) -> "Status":
+        return Status(UNSCHEDULABLE_AND_UNRESOLVABLE, reason)
+
+
+CycleState = Dict[str, object]
+
+
+# ---------------------------------------------------------------------------
+# NodeInfo / Snapshot
+# ---------------------------------------------------------------------------
+
+@dataclass
+class NodeInfo:
+    node: Node
+    pods: List[Pod] = field(default_factory=list)
+    calculator: ResourceCalculator = field(default_factory=ResourceCalculator)
+
+    def requested(self) -> ResourceList:
+        # Node fit uses *raw* pod requests. Derived accounting scalars
+        # (nos.ai/tpu-memory) are quota currency, not node resources — the
+        # reference likewise applies its ResourceCalculator only in quota
+        # math, never in the node Fit plugin.
+        total: ResourceList = {}
+        for p in self.pods:
+            total = add_resources(total, p.request())
+        return total
+
+    def allocatable(self) -> ResourceList:
+        return dict(self.node.status.allocatable)
+
+    def available(self) -> ResourceList:
+        req = self.requested()
+        return {
+            k: v - req.get(k, 0) for k, v in self.allocatable().items()
+        }
+
+    def add_pod(self, pod: Pod) -> None:
+        self.pods.append(pod)
+
+    def remove_pod(self, pod: Pod) -> bool:
+        for i, p in enumerate(self.pods):
+            if (
+                p.metadata.namespace == pod.metadata.namespace
+                and p.metadata.name == pod.metadata.name
+            ):
+                del self.pods[i]
+                return True
+        return False
+
+    def clone(self) -> "NodeInfo":
+        from nos_tpu.kube.objects import deep_copy
+
+        return NodeInfo(deep_copy(self.node), [deep_copy(p) for p in self.pods], self.calculator)
+
+
+class Snapshot(Dict[str, NodeInfo]):
+    """node name -> NodeInfo (analog of the framework SharedLister /
+    FakeSharedLister, reference pkg/test/util/fake.go:35-80, used in both
+    tests and production wiring)."""
+
+    @staticmethod
+    def build(nodes: List[Node], pods: List[Pod],
+              calculator: Optional[ResourceCalculator] = None) -> "Snapshot":
+        calc = calculator or ResourceCalculator()
+        snap = Snapshot()
+        for n in nodes:
+            snap[n.metadata.name] = NodeInfo(n, [], calc)
+        for p in pods:
+            if p.spec.node_name and p.spec.node_name in snap:
+                snap[p.spec.node_name].add_pod(p)
+        return snap
+
+    def clone(self) -> "Snapshot":
+        out = Snapshot()
+        for name, info in self.items():
+            out[name] = info.clone()
+        return out
+
+
+# ---------------------------------------------------------------------------
+# Default filters
+# ---------------------------------------------------------------------------
+
+class NodeResourcesFit:
+    """The fit filter: pod request must fit node allocatable minus requested."""
+
+    name = "NodeResourcesFit"
+
+    def filter(self, state: CycleState, pod: Pod, node_info: NodeInfo) -> Status:
+        if resources_fit(pod.request(), node_info.available()):
+            return Status.ok()
+        return Status.unschedulable(
+            f"insufficient resources on {node_info.node.metadata.name}"
+        )
+
+
+class NodeSelectorFit:
+    """node_selector labels must match (how pods target TPU generations)."""
+
+    name = "NodeSelector"
+
+    def filter(self, state: CycleState, pod: Pod, node_info: NodeInfo) -> Status:
+        labels = node_info.node.metadata.labels
+        for k, v in pod.spec.node_selector.items():
+            if labels.get(k) != v:
+                return Status.unresolvable(
+                    f"node selector {k}={v} does not match node "
+                    f"{node_info.node.metadata.name}"
+                )
+        return Status.ok()
+
+
+# ---------------------------------------------------------------------------
+# Framework
+# ---------------------------------------------------------------------------
+
+class SchedulerFramework:
+    """Runs registered plugins through the scheduling pipeline. Plugins are
+    duck-typed: any of pre_filter / filter / post_filter / score / reserve /
+    unreserve / permit / on_bind methods are picked up if present."""
+
+    def __init__(self, plugins: Optional[List[object]] = None,
+                 calculator: Optional[ResourceCalculator] = None):
+        self.calculator = calculator or ResourceCalculator()
+        self.plugins: List[object] = [
+            NodeSelectorFit(),
+            NodeResourcesFit(),
+        ]
+        if plugins:
+            self.plugins.extend(plugins)
+
+    def _having(self, hook: str):
+        return [p for p in self.plugins if hasattr(p, hook)]
+
+    def run_pre_filter(self, state: CycleState, pod: Pod, snapshot: Snapshot) -> Status:
+        for p in self._having("pre_filter"):
+            st = p.pre_filter(state, pod, snapshot)
+            if not st.success:
+                return st
+        return Status.ok()
+
+    def run_filter(self, state: CycleState, pod: Pod, node_info: NodeInfo) -> Status:
+        for p in self._having("filter"):
+            st = p.filter(state, pod, node_info)
+            if not st.success:
+                return st
+        return Status.ok()
+
+    def run_post_filter(
+        self, state: CycleState, pod: Pod, snapshot: Snapshot
+    ) -> Tuple[Optional[str], Status]:
+        """Returns (nominated node, status)."""
+        for p in self._having("post_filter"):
+            nominated, st = p.post_filter(state, pod, snapshot)
+            if st.success:
+                return nominated, st
+        return None, Status.unschedulable("no post-filter plugin succeeded")
+
+    def run_score(self, state: CycleState, pod: Pod, node_info: NodeInfo) -> float:
+        total = 0.0
+        for p in self._having("score"):
+            total += p.score(state, pod, node_info)
+        return total
+
+    def run_reserve(self, state: CycleState, pod: Pod, node_name: str) -> Status:
+        done: List[object] = []
+        for p in self._having("reserve"):
+            st = p.reserve(state, pod, node_name)
+            if not st.success:
+                for q in reversed(done):
+                    if hasattr(q, "unreserve"):
+                        q.unreserve(state, pod, node_name)
+                return st
+            done.append(p)
+        return Status.ok()
+
+    def run_unreserve(self, state: CycleState, pod: Pod, node_name: str) -> None:
+        for p in self._having("unreserve"):
+            p.unreserve(state, pod, node_name)
+
+    def run_permit(self, state: CycleState, pod: Pod, node_name: str) -> Status:
+        for p in self._having("permit"):
+            st = p.permit(state, pod, node_name)
+            if not st.success:
+                return st
+        return Status.ok()
+
+    def find_feasible(
+        self, state: CycleState, pod: Pod, snapshot: Snapshot
+    ) -> Tuple[Optional[str], Status]:
+        """Filter + Score over all nodes; returns (best node, status).
+        Shared by the live scheduling loop and the planner simulation so the
+        two paths cannot diverge."""
+        feasible = []
+        for name, info in sorted(snapshot.items()):
+            if self.run_filter(state, pod, info).success:
+                feasible.append((self.run_score(state, pod, info), name))
+        if not feasible:
+            return None, Status.unschedulable("no feasible node")
+        feasible.sort(key=lambda t: (-t[0], t[1]))
+        return feasible[0][1], Status.ok()
+
+    def can_schedule(self, pod: Pod, snapshot: Snapshot) -> Tuple[Optional[str], Status]:
+        """PreFilter + Filter over all nodes; returns (best node, status).
+        This is the what-if entry used by the partitioning planner
+        (reference internal/partitioning/core/planner.go:178-207)."""
+        state: CycleState = {}
+        st = self.run_pre_filter(state, pod, snapshot)
+        if not st.success:
+            return None, st
+        return self.find_feasible(state, pod, snapshot)

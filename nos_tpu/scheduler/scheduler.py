@@ -1,0 +1,195 @@
+"""The scheduling loop — the nos-scheduler binary's core.
+
+Analog of the kube-scheduler scheduling cycle the reference rides
+(SURVEY §3.4): for each pending pod targeting this scheduler, run
+PreFilter → Filter over all nodes → Score → Reserve → Permit → Bind.
+On failure run PostFilter (preemption): delete the selected victims, set
+``status.nominated_node_name``, and wait for the next cycle.
+
+Implemented as a reconciler over Pod events so it composes with the same
+controller runtime as everything else.
+"""
+from __future__ import annotations
+
+import logging
+from typing import Optional
+
+from nos_tpu import constants
+from nos_tpu.kube.apiserver import NotFound
+from nos_tpu.kube.client import Client
+from nos_tpu.kube.controller import Controller, Request, Result, Watch
+from nos_tpu.kube.objects import Pod, PodCondition
+from nos_tpu.scheduler import framework as fw
+from nos_tpu.scheduler.capacity import CapacityScheduling
+from nos_tpu.tpu.resource_calc import ResourceCalculator
+
+logger = logging.getLogger(__name__)
+
+
+class Scheduler:
+    def __init__(
+        self,
+        scheduler_name: str = constants.SCHEDULER_NAME,
+        calculator: Optional[ResourceCalculator] = None,
+        extra_plugins: Optional[list] = None,
+    ):
+        self.scheduler_name = scheduler_name
+        self.calc = calculator or ResourceCalculator()
+        self.capacity = CapacityScheduling(self.calc)
+        self.framework = fw.SchedulerFramework(
+            plugins=[self.capacity] + list(extra_plugins or []),
+            calculator=self.calc,
+        )
+        self.capacity.framework = self.framework
+
+    # ------------------------------------------------------------------
+    def _sync_state(self, client: Client) -> fw.Snapshot:
+        self.capacity.sync_quotas(
+            client.list("ElasticQuota"), client.list("CompositeElasticQuota")
+        )
+        self.capacity.reset_accounting()
+        nodes = client.list("Node")
+        pods = [
+            p
+            for p in client.list("Pod")
+            if p.spec.node_name and p.status.phase in ("Pending", "Running")
+        ]
+        for p in pods:
+            self.capacity.track_pod(p)
+        return fw.Snapshot.build(nodes, pods, self.calc)
+
+    # ------------------------------------------------------------------
+    def reconcile(self, client: Client, req: Request) -> Result:
+        if req.name == "*":
+            # sweep: capacity may have been freed (pod deleted / node added /
+            # quota changed) — re-run every pending pod of this scheduler
+            result = Result()
+            for pod in client.list("Pod"):
+                if (
+                    pod.spec.scheduler_name == self.scheduler_name
+                    and not pod.spec.node_name
+                    and pod.status.phase == "Pending"
+                ):
+                    r = self.reconcile(
+                        client, Request(pod.metadata.name, pod.metadata.namespace)
+                    )
+                    result.requeue = result.requeue or r.requeue
+            return result
+        try:
+            pod = client.get("Pod", req.name, req.namespace)
+        except NotFound:
+            return Result()
+        if pod.spec.scheduler_name != self.scheduler_name:
+            return Result()
+        if pod.spec.node_name or pod.status.phase != "Pending":
+            return Result()
+
+        snapshot = self._sync_state(client)
+        state: fw.CycleState = {}
+
+        st = self.framework.run_pre_filter(state, pod, snapshot)
+        node_name: Optional[str] = None
+        if st.success:
+            node_name, st = self._find_node(state, pod, snapshot)
+
+        if not st.success:
+            return self._handle_unschedulable(client, pod, snapshot, state, st)
+
+        assert node_name is not None
+        st = self.framework.run_reserve(state, pod, node_name)
+        if not st.success:
+            return self._handle_unschedulable(client, pod, snapshot, state, st)
+        st = self.framework.run_permit(state, pod, node_name)
+        if st.wait:
+            # gang not complete yet — stay pending, re-evaluated on events
+            self.framework.run_unreserve(state, pod, node_name)
+            self._mark_unschedulable(client, pod, "waiting for gang")
+            return Result()
+        if not st.success:
+            self.framework.run_unreserve(state, pod, node_name)
+            return self._handle_unschedulable(client, pod, snapshot, state, st)
+
+        # Bind
+        def bind(p: Pod, n=node_name):
+            p.spec.node_name = n
+            p.status.nominated_node_name = ""
+            p.status.conditions = [
+                c for c in p.status.conditions if c.type != "PodScheduled"
+            ] + [PodCondition(type="PodScheduled", status="True")]
+
+        client.patch("Pod", pod.metadata.name, pod.metadata.namespace, bind)
+        logger.info("scheduled %s/%s -> %s", pod.metadata.namespace, pod.metadata.name, node_name)
+        return Result()
+
+    # ------------------------------------------------------------------
+    def _find_node(self, state, pod, snapshot):
+        return self.framework.find_feasible(state, pod, snapshot)
+
+    def _handle_unschedulable(self, client, pod, snapshot, state, st) -> Result:
+        nominated, post_st = self.framework.run_post_filter(state, pod, snapshot)
+        if post_st.success and nominated is not None:
+            victims = state.get("capacity/victims") or []
+            for v in victims:
+                try:
+                    client.delete("Pod", v.metadata.name, v.metadata.namespace)
+                except NotFound:
+                    pass
+            def nominate(p: Pod, n=nominated):
+                p.status.nominated_node_name = n
+            client.patch("Pod", pod.metadata.name, pod.metadata.namespace, nominate)
+            logger.info(
+                "preempted %d pods on %s for %s/%s",
+                len(victims), nominated, pod.metadata.namespace, pod.metadata.name,
+            )
+            # requeue: next cycle schedules onto the freed node
+            return Result(requeue=True)
+        self._mark_unschedulable(client, pod, st.reason)
+        return Result()
+
+    @staticmethod
+    def _mark_unschedulable(client: Client, pod: Pod, reason: str) -> None:
+        current = [
+            c for c in pod.status.conditions
+            if c.type == "PodScheduled" and c.status == "False"
+            and c.reason == "Unschedulable" and c.message == reason
+        ]
+        if current:
+            return
+
+        def mark(p: Pod):
+            p.status.conditions = [
+                c for c in p.status.conditions if c.type != "PodScheduled"
+            ] + [
+                PodCondition(
+                    type="PodScheduled",
+                    status="False",
+                    reason="Unschedulable",
+                    message=reason,
+                )
+            ]
+
+        client.patch("Pod", pod.metadata.name, pod.metadata.namespace, mark)
+
+    # ------------------------------------------------------------------
+    def controller(self) -> Controller:
+        sweep = lambda ev: [Request(name="*")]  # noqa: E731
+
+        def pod_events(ev) -> list:
+            reqs = [Request(ev.obj.metadata.name, ev.obj.metadata.namespace)]
+            if ev.type == "DELETED" or (
+                ev.type == "MODIFIED" and ev.obj.status.phase in ("Succeeded", "Failed")
+            ):
+                # freed capacity: retry all pending pods
+                reqs.append(Request(name="*"))
+            return reqs
+
+        return Controller(
+            "scheduler",
+            self.reconcile,
+            [
+                Watch("Pod", mapper=pod_events),
+                Watch("Node", mapper=sweep),
+                Watch("ElasticQuota", mapper=sweep),
+                Watch("CompositeElasticQuota", mapper=sweep),
+            ],
+        )

@@ -41,7 +41,10 @@ class ResourceCalculator:
             if name == constants.RESOURCE_TPU:
                 tpu_mem += qty * self._hbm_per_chip()
             elif name.startswith(constants.RESOURCE_TPU_SLICE_PREFIX):
-                profile = parse_profile(name)
+                try:
+                    profile = parse_profile(name)
+                except ValueError:
+                    continue  # malformed user-supplied resource name
                 tpu_mem += qty * profile.chips * self._hbm_per_chip()
             elif name == constants.RESOURCE_NVIDIA_GPU:
                 gpu_mem += qty * self.nvidia_gpu_memory_gb

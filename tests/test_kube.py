@@ -200,7 +200,7 @@ def test_controller_requeue_retries_with_backoff_until_success():
 
 def test_controller_requeue_is_delayed_not_immediate():
     s = ApiServer()
-    mgr = Manager(s)
+    mgr = Manager(s, clock=lambda: 0.0)   # frozen clock: backoff never elapses
     calls = []
 
     def reconcile(client, req):

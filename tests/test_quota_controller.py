@@ -1,0 +1,190 @@
+"""EQ/CEQ controllers + webhooks against the in-process API server
+(model: reference elasticquota_controller_int_test.go, 427 LoC, envtest)."""
+import pytest
+
+from nos_tpu import constants
+from nos_tpu.api.quota import make_composite_elastic_quota, make_elastic_quota
+from nos_tpu.api.webhooks import register_quota_webhooks
+from nos_tpu.kube import ApiServer, Manager
+from nos_tpu.kube.apiserver import AdmissionDenied
+from nos_tpu.kube.objects import Container, ObjectMeta, Pod, PodSpec, PodStatus
+from nos_tpu.quota.controller import (
+    CompositeElasticQuotaReconciler,
+    ElasticQuotaReconciler,
+)
+
+TPU = "google.com/tpu"
+
+
+def make_pod(name, ns, tpu=0, cpu=0.0, phase="Running", created=0.0, priority=None):
+    req = {}
+    if tpu:
+        req[TPU] = tpu
+    if cpu:
+        req["cpu"] = cpu
+    return Pod(
+        metadata=ObjectMeta(name=name, namespace=ns, creation_timestamp=created),
+        spec=PodSpec(containers=[Container(requests=req)], priority=priority),
+        status=PodStatus(phase=phase),
+    )
+
+
+def rig():
+    server = ApiServer()
+    register_quota_webhooks(server)
+    mgr = Manager(server)
+    mgr.add_controller(ElasticQuotaReconciler().controller())
+    mgr.add_controller(CompositeElasticQuotaReconciler().controller())
+    return server, mgr
+
+
+# ---------------------------------------------------------------------------
+# ElasticQuota controller
+# ---------------------------------------------------------------------------
+
+def test_eq_status_used_from_running_pods():
+    server, mgr = rig()
+    server.create(make_elastic_quota("quota-a", "team-a", min={TPU: 8}))
+    server.create(make_pod("p1", "team-a", tpu=4, created=1))
+    server.create(make_pod("p2", "team-a", tpu=2, created=2))
+    server.create(make_pod("pending", "team-a", tpu=2, phase="Pending"))
+    mgr.run_until_idle()
+    eq = server.get("ElasticQuota", "quota-a", "team-a")
+    assert eq.status.used == {TPU: 6}     # pending pod not counted
+
+
+def test_eq_labels_pods_in_and_over_quota():
+    server, mgr = rig()
+    server.create(make_elastic_quota("quota-a", "team-a", min={TPU: 4}))
+    server.create(make_pod("first", "team-a", tpu=4, created=1))
+    server.create(make_pod("second", "team-a", tpu=4, created=2))
+    mgr.run_until_idle()
+    first = server.get("Pod", "first", "team-a")
+    second = server.get("Pod", "second", "team-a")
+    assert first.metadata.labels[constants.LABEL_CAPACITY] == "in-quota"
+    assert second.metadata.labels[constants.LABEL_CAPACITY] == "over-quota"
+
+
+def test_eq_overquota_ordering_earlier_pods_win():
+    server, mgr = rig()
+    server.create(make_elastic_quota("quota-a", "team-a", min={TPU: 4}))
+    # same creation time: lower priority first in the walk -> that one is
+    # in-quota (reference sorts ascending by priority after creation-ts)
+    server.create(make_pod("low", "team-a", tpu=4, created=5, priority=0))
+    server.create(make_pod("high", "team-a", tpu=4, created=5, priority=10))
+    mgr.run_until_idle()
+    assert (
+        server.get("Pod", "low", "team-a").metadata.labels[constants.LABEL_CAPACITY]
+        == "in-quota"
+    )
+    assert (
+        server.get("Pod", "high", "team-a").metadata.labels[constants.LABEL_CAPACITY]
+        == "over-quota"
+    )
+
+
+def test_eq_used_shrinks_when_pod_completes():
+    server, mgr = rig()
+    server.create(make_elastic_quota("quota-a", "team-a", min={TPU: 8}))
+    server.create(make_pod("p1", "team-a", tpu=4))
+    mgr.run_until_idle()
+    assert server.get("ElasticQuota", "quota-a", "team-a").status.used == {TPU: 4}
+    p = server.get("Pod", "p1", "team-a")
+    p.status.phase = "Succeeded"
+    server.update(p)
+    mgr.run_until_idle()
+    assert server.get("ElasticQuota", "quota-a", "team-a").status.used == {TPU: 0}
+
+
+def test_eq_used_only_reports_enforced_resources():
+    server, mgr = rig()
+    server.create(make_elastic_quota("quota-a", "team-a", min={TPU: 8}))
+    server.create(make_pod("p1", "team-a", tpu=2, cpu=3))
+    mgr.run_until_idle()
+    eq = server.get("ElasticQuota", "quota-a", "team-a")
+    assert eq.status.used == {TPU: 2}    # cpu not in min -> not reported
+
+
+# ---------------------------------------------------------------------------
+# CompositeElasticQuota controller
+# ---------------------------------------------------------------------------
+
+def test_ceq_spans_namespaces_and_deletes_overlapping_eqs():
+    server, mgr = rig()
+    server.create(make_elastic_quota("quota-a", "team-a", min={TPU: 4}))
+    mgr.run_until_idle()
+    server.create(
+        make_composite_elastic_quota(
+            "comp", "default", ["team-a", "team-b"], min={TPU: 8}
+        )
+    )
+    server.create(make_pod("p1", "team-a", tpu=2))
+    server.create(make_pod("p2", "team-b", tpu=4))
+    mgr.run_until_idle()
+    # overlapping per-namespace EQ deleted (composite takes precedence)
+    assert server.try_get("ElasticQuota", "quota-a", "team-a") is None
+    ceq = server.get("CompositeElasticQuota", "comp", "default")
+    assert ceq.status.used == {TPU: 6}
+
+
+# ---------------------------------------------------------------------------
+# Webhooks
+# ---------------------------------------------------------------------------
+
+def test_webhook_one_eq_per_namespace():
+    server, _ = rig()
+    server.create(make_elastic_quota("q1", "team-a", min={TPU: 4}))
+    with pytest.raises(AdmissionDenied):
+        server.create(make_elastic_quota("q2", "team-a", min={TPU: 2}))
+
+
+def test_webhook_eq_rejected_in_ceq_namespace():
+    server, _ = rig()
+    server.create(
+        make_composite_elastic_quota("comp", "default", ["team-a"], min={TPU: 4})
+    )
+    with pytest.raises(AdmissionDenied):
+        server.create(make_elastic_quota("q1", "team-a", min={TPU: 2}))
+
+
+def test_webhook_namespace_in_at_most_one_ceq():
+    server, _ = rig()
+    server.create(
+        make_composite_elastic_quota("c1", "default", ["team-a", "team-b"], min={TPU: 4})
+    )
+    with pytest.raises(AdmissionDenied):
+        server.create(
+            make_composite_elastic_quota("c2", "default", ["team-b"], min={TPU: 2})
+        )
+
+
+def test_webhook_max_must_cover_min():
+    server, _ = rig()
+    with pytest.raises(AdmissionDenied):
+        server.create(
+            make_elastic_quota("q1", "team-a", min={TPU: 8}, max={TPU: 4})
+        )
+    server.create(make_elastic_quota("q2", "team-b", min={TPU: 4}, max={TPU: 8}))
+
+
+def test_eq_cpu_not_counted_against_tpu_only_min():
+    """Resources absent from min are ignored when classifying in/over-quota
+    (k8s quota.LessThanOrEqual semantics) — a pod's cpu must not flip it
+    over-quota under a TPU-only quota."""
+    server, mgr = rig()
+    server.create(make_elastic_quota("quota-a", "team-a", min={TPU: 8}))
+    server.create(make_pod("p1", "team-a", tpu=2, cpu=4))
+    mgr.run_until_idle()
+    p = server.get("Pod", "p1", "team-a")
+    assert p.metadata.labels[constants.LABEL_CAPACITY] == "in-quota"
+
+
+def test_malformed_slice_resource_does_not_crash_reconcile():
+    server, mgr = rig()
+    server.create(make_elastic_quota("quota-a", "team-a", min={TPU: 8}))
+    pod = make_pod("p1", "team-a", tpu=1)
+    pod.spec.containers[0].requests["nos.ai/tpu-slice-weird"] = 1
+    server.create(pod)
+    mgr.run_until_idle(advance_delayed=True)   # must converge, not retry forever
+    eq = server.get("ElasticQuota", "quota-a", "team-a")
+    assert eq.status.used == {TPU: 1}

@@ -1,0 +1,188 @@
+"""Quota accounting math (model: reference elasticquotainfo_test.go, 881 LoC).
+
+Includes the reference's worked guaranteed-overquota example
+(elasticquotainfo.go getAggregatedOverquotas doc comment).
+"""
+from nos_tpu.quota.info import (
+    QuotaInfo,
+    QuotaInfos,
+    greater_than,
+    sum_greater_than,
+    sum_less_than_equal,
+)
+
+TPU = "google.com/tpu"
+
+
+def qi(name, ns, min=None, max=None, used=None, namespaces=None):
+    return QuotaInfo(
+        name=name,
+        namespace=ns,
+        namespaces=set(namespaces or [ns]),
+        min=dict(min or {}),
+        max=dict(max) if max is not None else None,
+        used=dict(used or {}),
+    )
+
+
+# ---------------------------------------------------------------------------
+# comparison primitives
+# ---------------------------------------------------------------------------
+
+def test_sum_greater_than_core_resources_always_bounded():
+    # cpu/memory default to bound 0 when absent from y
+    assert sum_greater_than({"cpu": 1}, {}, {})
+    assert sum_greater_than({"memory": 1}, {}, {TPU: 4})
+    assert not sum_greater_than({}, {}, {})
+
+
+def test_sum_greater_than_scalars_unbounded_when_absent():
+    # a scalar not listed in y is unconstrained
+    assert not sum_greater_than({TPU: 100}, {}, {"cpu": 1000})
+    assert sum_greater_than({TPU: 5}, {}, {"cpu": 1000, TPU: 4})
+
+
+def test_sum_greater_than_sums_both_sides():
+    assert sum_greater_than({TPU: 2}, {TPU: 3}, {TPU: 4})
+    assert not sum_greater_than({TPU: 2}, {TPU: 2}, {TPU: 4})
+
+
+def test_sum_less_than_equal_is_negation():
+    assert sum_less_than_equal({TPU: 2}, {TPU: 2}, {TPU: 4})
+    assert not sum_less_than_equal({TPU: 3}, {TPU: 2}, {TPU: 4})
+
+
+# ---------------------------------------------------------------------------
+# QuotaInfo bounds
+# ---------------------------------------------------------------------------
+
+def test_used_over_min_with():
+    info = qi("a", "ns-a", min={TPU: 8}, used={TPU: 6})
+    assert not info.used_over_min_with({TPU: 2})
+    assert info.used_over_min_with({TPU: 3})
+
+
+def test_used_over_max_unenforced_when_absent():
+    info = qi("a", "ns-a", min={TPU: 2}, used={TPU: 100})
+    assert not info.used_over_max_with({TPU: 100})   # no max -> never over
+    info2 = qi("b", "ns-b", min={TPU: 2}, max={TPU: 4}, used={TPU: 3})
+    assert not info2.used_over_max_with({TPU: 1})
+    assert info2.used_over_max_with({TPU: 2})
+
+
+def test_reserve_unreserve_roundtrip():
+    info = qi("a", "ns-a", min={TPU: 8})
+    info.reserve({TPU: 4, "cpu": 2})
+    assert info.used == {TPU: 4, "cpu": 2}
+    info.unreserve({TPU: 4, "cpu": 2})
+    assert info.used == {TPU: 0, "cpu": 0}
+
+
+def test_add_delete_pod_idempotent():
+    from nos_tpu.kube.objects import Container, ObjectMeta, Pod, PodSpec
+
+    info = qi("a", "team-a", min={TPU: 8})
+    pod = Pod(
+        metadata=ObjectMeta(name="p1", namespace="team-a"),
+        spec=PodSpec(containers=[Container(requests={TPU: 4})]),
+    )
+    info.add_pod_if_not_present(pod)
+    info.add_pod_if_not_present(pod)     # no double counting
+    assert info.used[TPU] == 4
+    info.delete_pod_if_present(pod)
+    info.delete_pod_if_present(pod)
+    assert info.used[TPU] == 0
+
+
+def test_clone_independence():
+    info = qi("a", "ns-a", min={TPU: 8}, used={TPU: 2})
+    c = info.clone()
+    c.reserve({TPU: 1})
+    assert info.used == {TPU: 2}
+
+
+# ---------------------------------------------------------------------------
+# QuotaInfos aggregates + guaranteed overquotas
+# ---------------------------------------------------------------------------
+
+def make_reference_example() -> QuotaInfos:
+    """The reference's worked example (cpu in millicores -> cores here):
+    A: min 100m used 350m; B: min 50m used 0; C: min 200m used 50m.
+    Aggregated overquota = 0.05 + 0.15 = 0.2 cores."""
+    infos = QuotaInfos()
+    infos.add(qi("a", "ns-a", min={"cpu": 0.1}, used={"cpu": 0.35}))
+    infos.add(qi("b", "ns-b", min={"cpu": 0.05}, used={"cpu": 0.0}))
+    infos.add(qi("c", "ns-c", min={"cpu": 0.2}, used={"cpu": 0.05}))
+    return infos
+
+
+def test_aggregated_overquotas_reference_example():
+    infos = make_reference_example()
+    assert abs(infos.aggregated_overquotas()["cpu"] - 0.2) < 1e-9
+
+
+def test_guaranteed_overquotas_proportional_to_min_share():
+    infos = make_reference_example()
+    # total min = 0.35; shares: a 2/7, b 1/7, c 4/7 of 0.2 cores,
+    # floored at millicore granularity
+    assert abs(infos.guaranteed_overquotas("ns-a")["cpu"] - 0.057) < 1e-9
+    assert abs(infos.guaranteed_overquotas("ns-b")["cpu"] - 0.028) < 1e-9
+    assert abs(infos.guaranteed_overquotas("ns-c")["cpu"] - 0.114) < 1e-9
+
+
+def test_guaranteed_overquotas_tpu_chips_floored_whole():
+    infos = QuotaInfos()
+    infos.add(qi("a", "ns-a", min={TPU: 3}, used={TPU: 0}))
+    infos.add(qi("b", "ns-b", min={TPU: 5}, used={TPU: 5}))
+    # overquota = 3 (all of a's unused min); a's share 3/8 -> 1.125 -> 1 chip
+    assert infos.guaranteed_overquotas("ns-a")[TPU] == 1
+    assert infos.guaranteed_overquotas("ns-b")[TPU] == 1  # 15/8 -> 1
+
+
+def test_guaranteed_overquotas_unknown_namespace_raises():
+    import pytest
+
+    with pytest.raises(KeyError):
+        QuotaInfos().guaranteed_overquotas("nope")
+
+
+def test_aggregated_used_over_min_with():
+    infos = make_reference_example()
+    # total used = 0.4, total min = 0.35 -> already over; any request is over
+    assert infos.aggregated_used_over_min_with({"cpu": 0.001})
+    infos2 = QuotaInfos()
+    infos2.add(qi("a", "ns-a", min={TPU: 8}, used={TPU: 2}))
+    assert not infos2.aggregated_used_over_min_with({TPU: 6})
+    assert infos2.aggregated_used_over_min_with({TPU: 7})
+
+
+def test_composite_info_counted_once_in_aggregates():
+    infos = QuotaInfos()
+    composite = qi("comp", "ns-x", min={TPU: 8}, used={TPU: 4},
+                   namespaces=["ns-x", "ns-y", "ns-z"])
+    infos.add(composite)
+    assert infos.aggregated_min() == {TPU: 8}       # not 24
+    assert infos.aggregated_used() == {TPU: 4}
+
+
+def test_infos_replace_preserves_used_and_pods():
+    infos = QuotaInfos()
+    old = qi("a", "ns-a", min={TPU: 4}, used={TPU: 2}, namespaces=["ns-a", "ns-b"])
+    old.pods.add("ns-a/p1")
+    infos.add(old)
+    new = qi("a", "ns-a", min={TPU: 8}, namespaces=["ns-a"])
+    infos.replace_info(old, new)
+    assert infos["ns-a"].min == {TPU: 8}
+    assert infos["ns-a"].used == {TPU: 2}
+    assert "ns-a/p1" in infos["ns-a"].pods
+    assert "ns-b" not in infos
+
+
+def test_infos_clone_preserves_aliasing():
+    infos = QuotaInfos()
+    composite = qi("comp", "ns-x", min={TPU: 8}, namespaces=["ns-x", "ns-y"])
+    infos.add(composite)
+    c = infos.clone()
+    assert c["ns-x"] is c["ns-y"]            # aliasing preserved
+    c["ns-x"].reserve({TPU: 1})
+    assert infos["ns-x"].used.get(TPU, 0) == 0   # deep-copied

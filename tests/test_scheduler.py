@@ -1,0 +1,284 @@
+"""Scheduler framework + CapacityScheduling plugin + scheduling loop
+(model: reference capacity_scheduling_test.go, 704 LoC)."""
+import pytest
+
+from nos_tpu import constants
+from nos_tpu.api.quota import make_composite_elastic_quota, make_elastic_quota
+from nos_tpu.kube import ApiServer, Manager
+from nos_tpu.kube.objects import (
+    Container,
+    Node,
+    NodeStatus,
+    ObjectMeta,
+    Pod,
+    PodSpec,
+    PodStatus,
+)
+from nos_tpu.scheduler import CapacityScheduling, Scheduler
+from nos_tpu.scheduler import framework as fw
+
+TPU = "google.com/tpu"
+SCHED = constants.SCHEDULER_NAME
+
+
+def make_node(name, tpu=8, cpu=96, labels=None):
+    return Node(
+        metadata=ObjectMeta(name=name, labels=labels or {}),
+        status=NodeStatus(
+            capacity={TPU: tpu, "cpu": cpu},
+            allocatable={TPU: tpu, "cpu": cpu},
+        ),
+    )
+
+
+def make_pod(name, ns, tpu=0, cpu=0.0, node="", phase="Pending", priority=None,
+             labels=None, selector=None, scheduler=SCHED, created=0.0):
+    req = {}
+    if tpu:
+        req[TPU] = tpu
+    if cpu:
+        req["cpu"] = cpu
+    return Pod(
+        metadata=ObjectMeta(name=name, namespace=ns, labels=labels or {},
+                            creation_timestamp=created),
+        spec=PodSpec(
+            containers=[Container(requests=req)],
+            node_name=node,
+            priority=priority,
+            node_selector=selector or {},
+            scheduler_name=scheduler,
+        ),
+        status=PodStatus(phase=phase),
+    )
+
+
+# ---------------------------------------------------------------------------
+# framework basics
+# ---------------------------------------------------------------------------
+
+def test_snapshot_and_fit_filter():
+    snap = fw.Snapshot.build(
+        [make_node("n1", tpu=8)],
+        [make_pod("running", "a", tpu=6, node="n1", phase="Running")],
+    )
+    f = fw.NodeResourcesFit()
+    ok = f.filter({}, make_pod("p", "a", tpu=2), snap["n1"])
+    assert ok.success
+    bad = f.filter({}, make_pod("p", "a", tpu=3), snap["n1"])
+    assert not bad.success
+
+
+def test_node_selector_filter():
+    snap = fw.Snapshot.build(
+        [make_node("v5e", labels={constants.LABEL_TPU_ACCELERATOR: "tpu-v5-lite-podslice"})],
+        [],
+    )
+    f = fw.NodeSelectorFit()
+    pod = make_pod("p", "a", tpu=1,
+                   selector={constants.LABEL_TPU_ACCELERATOR: "tpu-v5-lite-podslice"})
+    assert f.filter({}, pod, snap["v5e"]).success
+    pod2 = make_pod("p2", "a", tpu=1,
+                    selector={constants.LABEL_TPU_ACCELERATOR: "tpu-v5p-slice"})
+    assert not f.filter({}, pod2, snap["v5e"]).success
+
+
+def test_framework_can_schedule_picks_feasible_node():
+    fwk = fw.SchedulerFramework()
+    snap = fw.Snapshot.build(
+        [make_node("small", tpu=2), make_node("big", tpu=8)],
+        [make_pod("r", "a", tpu=2, node="small", phase="Running")],
+    )
+    node, st = fwk.can_schedule(make_pod("p", "a", tpu=4), snap)
+    assert st.success and node == "big"
+    node, st = fwk.can_schedule(make_pod("p", "a", tpu=100), snap)
+    assert not st.success and node is None
+
+
+# ---------------------------------------------------------------------------
+# CapacityScheduling PreFilter
+# ---------------------------------------------------------------------------
+
+def quota_rig(*eqs, ceqs=()):
+    cap = CapacityScheduling()
+    cap.sync_quotas(list(eqs), list(ceqs))
+    return cap
+
+
+def test_pre_filter_rejects_over_max():
+    cap = quota_rig(make_elastic_quota("qa", "team-a", min={TPU: 2}, max={TPU: 4}))
+    snap = fw.Snapshot()
+    for p in [make_pod("r1", "team-a", tpu=3, node="n1", phase="Running")]:
+        cap.track_pod(p)
+    st = cap.pre_filter({}, make_pod("p", "team-a", tpu=2), snap)
+    assert not st.success and "max" in st.reason
+
+
+def test_pre_filter_rejects_over_aggregated_min():
+    cap = quota_rig(
+        make_elastic_quota("qa", "team-a", min={TPU: 4}),
+        make_elastic_quota("qb", "team-b", min={TPU: 4}),
+    )
+    cap.track_pod(make_pod("r1", "team-b", tpu=6, node="n1", phase="Running"))
+    # cluster min total 8, used 6: a request of 3 exceeds the ceiling
+    st = cap.pre_filter({}, make_pod("p", "team-a", tpu=3), fw.Snapshot())
+    assert not st.success and "aggregated" in st.reason
+    st2 = cap.pre_filter({}, make_pod("p2", "team-a", tpu=2), fw.Snapshot())
+    assert st2.success
+
+
+def test_pre_filter_allows_borrowing_within_ceiling():
+    cap = quota_rig(
+        make_elastic_quota("qa", "team-a", min={TPU: 2}),
+        make_elastic_quota("qb", "team-b", min={TPU: 6}),
+    )
+    # team-a borrowing beyond its min but under the aggregate ceiling
+    st = cap.pre_filter({}, make_pod("p", "team-a", tpu=5), fw.Snapshot())
+    assert st.success
+
+
+def test_pre_filter_no_quota_namespace_passes():
+    cap = quota_rig(make_elastic_quota("qa", "team-a", min={TPU: 2}))
+    st = cap.pre_filter({}, make_pod("p", "no-quota-ns", tpu=100), fw.Snapshot())
+    assert st.success
+
+
+# ---------------------------------------------------------------------------
+# end-to-end scheduling loop
+# ---------------------------------------------------------------------------
+
+def sched_rig():
+    server = ApiServer()
+    mgr = Manager(server)
+    sched = Scheduler()
+    mgr.add_controller(sched.controller())
+    return server, mgr, sched
+
+
+def test_schedules_pod_onto_feasible_node():
+    server, mgr, _ = sched_rig()
+    server.create(make_node("n1", tpu=8))
+    server.create(make_pod("p1", "team-a", tpu=4))
+    mgr.run_until_idle()
+    pod = server.get("Pod", "p1", "team-a")
+    assert pod.spec.node_name == "n1"
+    assert any(c.type == "PodScheduled" and c.status == "True"
+               for c in pod.status.conditions)
+
+
+def test_marks_unschedulable_when_no_fit():
+    server, mgr, _ = sched_rig()
+    server.create(make_node("n1", tpu=2))
+    server.create(make_pod("p1", "team-a", tpu=4))
+    mgr.run_until_idle()
+    pod = server.get("Pod", "p1", "team-a")
+    assert pod.spec.node_name == ""
+    assert pod.is_unschedulable()
+
+
+def test_pending_pod_scheduled_when_node_appears():
+    server, mgr, _ = sched_rig()
+    server.create(make_pod("p1", "team-a", tpu=4))
+    mgr.run_until_idle()
+    assert server.get("Pod", "p1", "team-a").spec.node_name == ""
+    server.create(make_node("late", tpu=8))
+    mgr.run_until_idle()
+    assert server.get("Pod", "p1", "team-a").spec.node_name == "late"
+
+
+def test_pending_pod_scheduled_when_capacity_freed():
+    server, mgr, _ = sched_rig()
+    server.create(make_node("n1", tpu=8))
+    server.create(make_pod("r1", "team-a", tpu=8, node="n1", phase="Running"))
+    server.create(make_pod("p1", "team-a", tpu=4))
+    mgr.run_until_idle()
+    assert server.get("Pod", "p1", "team-a").spec.node_name == ""
+    server.delete("Pod", "r1", "team-a")
+    mgr.run_until_idle()
+    assert server.get("Pod", "p1", "team-a").spec.node_name == "n1"
+
+
+def test_ignores_other_schedulers_pods():
+    server, mgr, _ = sched_rig()
+    server.create(make_node("n1", tpu=8))
+    server.create(make_pod("p1", "team-a", tpu=4, scheduler="default-scheduler"))
+    mgr.run_until_idle()
+    assert server.get("Pod", "p1", "team-a").spec.node_name == ""
+
+
+def test_respects_max_quota_end_to_end():
+    server, mgr, _ = sched_rig()
+    server.create(make_node("n1", tpu=8))
+    server.create(make_elastic_quota("qa", "team-a", min={TPU: 2}, max={TPU: 2}))
+    server.create(make_pod("p1", "team-a", tpu=2))
+    server.create(make_pod("p2", "team-a", tpu=2))
+    mgr.run_until_idle()
+    pods = server.list("Pod", namespace="team-a")
+    scheduled = [p for p in pods if p.spec.node_name]
+    assert len(scheduled) == 1   # second pod would exceed max=2
+
+
+# ---------------------------------------------------------------------------
+# preemption
+# ---------------------------------------------------------------------------
+
+def test_preemption_reclaims_borrowed_quota():
+    """team-b borrowed team-a's unused min; when team-a needs it back, the
+    over-quota pod of team-b is evicted (reference regime 2:
+    preemptor within min reclaims borrowed capacity)."""
+    server, mgr, _ = sched_rig()
+    server.create(make_node("n1", tpu=8))
+    server.create(make_elastic_quota("qa", "team-a", min={TPU: 4}))
+    server.create(make_elastic_quota("qb", "team-b", min={TPU: 4}))
+    # team-b uses the whole node: 4 in-quota + 4 borrowed (over-quota label)
+    server.create(make_pod("b-in", "team-b", tpu=4, node="n1", phase="Running",
+                           labels={constants.LABEL_CAPACITY: "in-quota"}))
+    server.create(make_pod("b-over", "team-b", tpu=4, node="n1", phase="Running",
+                           labels={constants.LABEL_CAPACITY: "over-quota"}))
+    server.create(make_pod("a-pod", "team-a", tpu=4))
+    mgr.run_until_idle(advance_delayed=True)
+    # the borrower's over-quota pod was evicted and team-a's pod scheduled
+    assert server.try_get("Pod", "b-over", "team-b") is None
+    assert server.try_get("Pod", "b-in", "team-b") is not None
+    assert server.get("Pod", "a-pod", "team-a").spec.node_name == "n1"
+
+
+def test_preemption_same_namespace_by_priority():
+    server, mgr, _ = sched_rig()
+    server.create(make_node("n1", tpu=8))
+    server.create(make_elastic_quota("qa", "team-a", min={TPU: 4}))
+    server.create(make_pod("low", "team-a", tpu=8, node="n1", phase="Running",
+                           priority=0,
+                           labels={constants.LABEL_CAPACITY: "over-quota"}))
+    server.create(make_pod("high", "team-a", tpu=4, priority=100))
+    mgr.run_until_idle(advance_delayed=True)
+    assert server.try_get("Pod", "low", "team-a") is None
+    assert server.get("Pod", "high", "team-a").spec.node_name == "n1"
+
+
+def test_no_preemption_of_in_quota_pods_cross_namespace():
+    server, mgr, _ = sched_rig()
+    server.create(make_node("n1", tpu=8))
+    server.create(make_elastic_quota("qa", "team-a", min={TPU: 4}))
+    server.create(make_elastic_quota("qb", "team-b", min={TPU: 4}))
+    server.create(make_pod("b-in", "team-b", tpu=4, node="n1", phase="Running",
+                           labels={constants.LABEL_CAPACITY: "in-quota"}))
+    # team-a wants 8 (over its min); team-b is within min -> no victims
+    server.create(make_pod("a-pod", "team-a", tpu=8))
+    mgr.run_until_idle(advance_delayed=True)
+    assert server.try_get("Pod", "b-in", "team-b") is not None
+    assert server.get("Pod", "a-pod", "team-a").spec.node_name == ""
+
+
+def test_preemption_respects_node_selector():
+    """Preemption must not kill pods on nodes the preemptor can't run on."""
+    server, mgr, _ = sched_rig()
+    server.create(make_node("v5e", tpu=8,
+                            labels={constants.LABEL_TPU_ACCELERATOR: "tpu-v5-lite-podslice"}))
+    server.create(make_pod("low", "team-a", tpu=8, node="v5e", phase="Running",
+                           priority=0))
+    server.create(make_pod("high", "team-a", tpu=8, priority=100,
+                           selector={constants.LABEL_TPU_ACCELERATOR: "tpu-v5p-slice"}))
+    mgr.run_until_idle(advance_delayed=True)
+    # the running pod survives; the selector-mismatched preemptor stays pending
+    assert server.try_get("Pod", "low", "team-a") is not None
+    assert server.get("Pod", "high", "team-a").spec.node_name == ""
