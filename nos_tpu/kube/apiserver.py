@@ -192,6 +192,12 @@ class ApiServer:
             self._admit("UPDATE", obj, deep_copy(current))
             obj.metadata.uid = current.metadata.uid
             obj.metadata.creation_timestamp = current.metadata.creation_timestamp
+            # no-op updates keep the resourceVersion and emit no event
+            # (matching real apiserver behavior; prevents patch→event→patch
+            # livelocks in controllers)
+            obj.metadata.resource_version = current.metadata.resource_version
+            if obj == current:
+                return deep_copy(current)
             obj.metadata.resource_version = next(self._rv)
             old = deep_copy(current)
             bucket[key] = deep_copy(obj)

@@ -1,0 +1,28 @@
+"""Cluster-level dynamic partitioning control plane.
+
+Analog of reference internal/partitioning/{core,state,mig,mps} and
+internal/controllers/gpupartitioner (SURVEY §2.2, §3.2). The flow:
+
+  pending pod requesting a TPU sub-slice → batcher coalesces a burst →
+  snapshot the cluster → planner searches per-node geometry updates that
+  let the most pods schedule (what-if simulation through the scheduler
+  framework) → actuator writes desired geometries as node spec annotations
+  + a plan id → the node tpuagent actuates and reports status annotations →
+  the plan-id handshake unblocks the next plan.
+"""
+from nos_tpu.partitioning.state import ClusterState, NodePartitioning, PartitioningState  # noqa: F401
+from nos_tpu.partitioning.snapshot import ClusterSnapshot, SnapshotNode  # noqa: F401
+from nos_tpu.partitioning.tracker import SliceTracker  # noqa: F401
+from nos_tpu.partitioning.planner import Planner, PartitioningPlan  # noqa: F401
+from nos_tpu.partitioning.actuator import Actuator  # noqa: F401
+from nos_tpu.partitioning.subslicing import (  # noqa: F401
+    SubslicingPartitioner,
+    SubslicingSnapshotTaker,
+    SubslicingSliceCalculator,
+    NodeInitializer,
+)
+from nos_tpu.partitioning.controller import (  # noqa: F401
+    NodeController,
+    PodController,
+    PartitioningController,
+)

@@ -1,0 +1,55 @@
+"""Actuator — applies a partitioning plan when it differs from reality.
+
+Analog of reference internal/partitioning/core/actuator.go:39-66: diff
+current vs desired PartitioningState; when different and non-empty, call the
+mode-specific partitioner per node.
+"""
+from __future__ import annotations
+
+import logging
+from typing import Protocol
+
+from nos_tpu.kube.client import Client
+from nos_tpu.partitioning.planner import PartitioningPlan
+from nos_tpu.partitioning.state import (
+    NodePartitioning,
+    PartitioningState,
+    partitioning_states_equal,
+)
+
+logger = logging.getLogger(__name__)
+
+
+class Partitioner(Protocol):
+    def apply_partitioning(
+        self, client: Client, node_name: str, plan_id: str, partitioning: NodePartitioning
+    ) -> None:
+        ...
+
+
+class Actuator:
+    def __init__(self, partitioner: Partitioner):
+        self.partitioner = partitioner
+
+    def apply(
+        self,
+        client: Client,
+        current: PartitioningState,
+        plan: PartitioningPlan,
+    ) -> bool:
+        """Returns True if any node was actuated."""
+        if plan.is_empty():
+            logger.debug("actuator: empty plan, nothing to do")
+            return False
+        if partitioning_states_equal(current, plan.desired_state):
+            logger.debug("actuator: desired state equals current, nothing to do")
+            return False
+        applied = False
+        for node_name, node_partitioning in sorted(plan.desired_state.items()):
+            if current.get(node_name) == node_partitioning:
+                continue
+            self.partitioner.apply_partitioning(
+                client, node_name, plan.id, node_partitioning
+            )
+            applied = True
+        return applied

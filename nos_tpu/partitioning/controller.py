@@ -1,0 +1,211 @@
+"""Partitioning controllers — the §3.2 hot loop.
+
+Analog of reference internal/controllers/gpupartitioner/:
+
+- ``NodeController`` (node_controller.go): maintains ClusterState for nodes
+  labeled for partitioning; triggers virgin-node initialization.
+- ``PodController`` (pod_controller.go): keeps per-pod usage fresh in
+  ClusterState.
+- ``PartitioningController`` (partitioner_controller.go:81-239): watches all
+  pods; when a pod that extra resources could help becomes pending, adds it
+  to the batch window; when the batch is ready (timeout/idle) and every node
+  has reported its last plan (spec plan-id == status plan-id handshake,
+  :212-232), takes a snapshot, plans, and actuates.
+"""
+from __future__ import annotations
+
+import logging
+from typing import Callable, List, Optional
+
+from nos_tpu import constants
+from nos_tpu.kube.apiserver import NotFound
+from nos_tpu.kube.client import Client
+from nos_tpu.kube.controller import Controller, Request, Result, Watch
+from nos_tpu.kube.objects import Node, ObjectMeta, Pod
+from nos_tpu.partitioning.actuator import Actuator
+from nos_tpu.partitioning.planner import Planner
+from nos_tpu.partitioning.snapshot import ClusterSnapshot
+from nos_tpu.partitioning.state import ClusterState, NodePartitioning, PartitioningState
+from nos_tpu.partitioning.subslicing import (
+    NodeInitializer,
+    SubslicingPartitioner,
+    SubslicingSnapshotTaker,
+)
+from nos_tpu.tpu import annotation as ann
+from nos_tpu.utils.batcher import Batcher
+from nos_tpu.utils.pod import extra_resources_could_help_scheduling
+
+logger = logging.getLogger(__name__)
+
+
+class NodeController:
+    """Keeps ClusterState nodes fresh + initializes virgin nodes
+    (reference node_controller.go:45, §3.5)."""
+
+    def __init__(self, state: ClusterState, initializer: Optional[NodeInitializer] = None):
+        self.state = state
+        self.initializer = initializer or NodeInitializer()
+
+    def reconcile(self, client: Client, req: Request) -> Result:
+        try:
+            node = client.get("Node", req.name)
+        except NotFound:
+            self.state.remove_node(req.name)
+            return Result()
+        if node.metadata.labels.get(constants.LABEL_PARTITIONING):
+            self.state.upsert_node(node)
+            if node.metadata.labels[constants.LABEL_PARTITIONING] == \
+                    constants.PARTITIONING_SUBSLICING:
+                self.initializer.initialize(client, node)
+        else:
+            self.state.remove_node(req.name)
+        return Result()
+
+    def controller(self) -> Controller:
+        return Controller("partitioner-nodes", self.reconcile, [Watch("Node")])
+
+
+class PodController:
+    """Per-pod usage updates in ClusterState (reference pod_controller.go:32)."""
+
+    def __init__(self, state: ClusterState):
+        self.state = state
+
+    def reconcile(self, client: Client, req: Request) -> Result:
+        try:
+            pod = client.get("Pod", req.name, req.namespace)
+        except NotFound:
+            self.state.remove_pod(
+                Pod(metadata=ObjectMeta(name=req.name, namespace=req.namespace))
+            )
+            return Result()
+        if pod.status.phase in ("Succeeded", "Failed"):
+            self.state.remove_pod(pod)
+        else:
+            self.state.upsert_pod(pod)
+        return Result()
+
+    def controller(self) -> Controller:
+        return Controller("partitioner-pods", self.reconcile, [Watch("Pod")])
+
+
+class PartitioningController:
+    """The planning loop (reference partitioner_controller.go:81-239)."""
+
+    def __init__(
+        self,
+        state: ClusterState,
+        batch_timeout_s: float = constants.DEFAULT_BATCH_WINDOW_TIMEOUT_S,
+        batch_idle_s: float = constants.DEFAULT_BATCH_WINDOW_IDLE_S,
+        planner: Optional[Planner] = None,
+        actuator: Optional[Actuator] = None,
+        snapshot_taker: Optional[SubslicingSnapshotTaker] = None,
+        clock: Optional[Callable[[], float]] = None,
+    ):
+        import time as _time
+
+        self.state = state
+        self.clock = clock or _time.monotonic
+        self.batcher: Batcher[str] = Batcher(batch_timeout_s, batch_idle_s, self.clock)
+        self.planner = planner or Planner()
+        self.actuator = actuator or Actuator(SubslicingPartitioner())
+        self.snapshot_taker = snapshot_taker or SubslicingSnapshotTaker()
+        # pods already in the current batch: a requeue that re-examines a
+        # pod must not re-add it (that would reset the idle window forever)
+        self._batched: set[str] = set()
+
+    # ------------------------------------------------------------------
+    def reconcile(self, client: Client, req: Request) -> Result:
+        if not self.state.is_partitioning_enabled(constants.PARTITIONING_SUBSLICING):
+            return Result()
+        if req.name != "*":
+            try:
+                pod = client.get("Pod", req.name, req.namespace)
+            except NotFound:
+                return Result()
+            if not extra_resources_could_help_scheduling(pod):
+                return Result()
+            key = f"{req.namespace}/{req.name}"
+            if key not in self._batched:
+                self._batched.add(key)
+                self.batcher.add(key)
+
+        if not self.batcher.ready():
+            wait = self.batcher.seconds_until_ready()
+            if wait is None:
+                return Result()
+            return Result(requeue_after=max(wait, 0.01))
+
+        # plan handshake: every partitioning node must have reported the last
+        # plan before a new one is issued (reference :212-232)
+        if not self._all_nodes_reported_last_plan():
+            logger.debug("partitioner: waiting for nodes to report last plan")
+            return Result(requeue_after=1.0)
+
+        self.batcher.drain()
+        self._batched.clear()
+        pending = self._fetch_pending_pods(client)
+        if not pending:
+            return Result()
+        self._process(client, pending)
+        return Result()
+
+    # ------------------------------------------------------------------
+    def _all_nodes_reported_last_plan(self) -> bool:
+        for node in self.state.partitioning_enabled_nodes(
+            constants.PARTITIONING_SUBSLICING
+        ):
+            spec_plan = node.metadata.annotations.get(
+                constants.ANNOTATION_PARTITIONING_PLAN
+            )
+            reported = node.metadata.annotations.get(
+                constants.ANNOTATION_REPORTED_PARTITIONING_PLAN
+            )
+            if spec_plan and spec_plan != reported:
+                return False
+        return True
+
+    @staticmethod
+    def _fetch_pending_pods(client: Client) -> List[Pod]:
+        return [
+            p for p in client.list("Pod") if extra_resources_could_help_scheduling(p)
+        ]
+
+    def _current_partitioning(self) -> PartitioningState:
+        """Observed partitioning from node status annotations."""
+        out: PartitioningState = {}
+        for node in self.state.partitioning_enabled_nodes(
+            constants.PARTITIONING_SUBSLICING
+        ):
+            _, statuses = ann.parse_node_annotations(node.metadata.annotations)
+            boards = {}
+            for board_idx, st in ann.status_to_board_state(statuses).items():
+                g = {}
+                for src in (st["free"], st["used"]):
+                    for p, q in src.items():
+                        g[p] = g.get(p, 0) + q
+                boards[board_idx] = g
+            out[node.metadata.name] = NodePartitioning(boards=boards)
+        return out
+
+    def _process(self, client: Client, pending: List[Pod]) -> None:
+        snapshot = self.snapshot_taker.take(self.state)
+        plan = self.planner.plan(snapshot, pending)
+        current = self._current_partitioning()
+        if self.actuator.apply(client, current, plan):
+            logger.info(
+                "partitioner: actuated plan %s for %d pending pods",
+                plan.id, len(pending),
+            )
+
+    # ------------------------------------------------------------------
+    def controller(self) -> Controller:
+        def node_events(ev) -> List[Request]:
+            # a node reporting its plan can unblock a parked batch
+            return [Request(name="*")]
+
+        return Controller(
+            "partitioner",
+            self.reconcile,
+            [Watch("Pod"), Watch("Node", mapper=node_events)],
+        )

@@ -1,0 +1,123 @@
+"""The partitioning planner — the heart of the control plane.
+
+Analog of reference internal/partitioning/core/planner.go:40-207
+(``planner.Plan``): given a snapshot and the batch of pending pods, find
+per-node geometry updates that let the most pods schedule.
+
+Algorithm (preserved from the reference):
+
+1. build a SliceTracker of lacking slices;
+2. sort pods: priority desc, then fewest-requested-chips first so small pods
+   pack densely (reference util.go:34-71);
+3. for each candidate node (name order): fork the snapshot, update the
+   node's geometry toward the lacking slices, then try each still-pending
+   pod — a pod "places" if the embedded scheduler framework's
+   PreFilter+Filter pass on that node (reference canSchedulePod,
+   planner.go:178-207); placed pods are added to the snapshot and removed
+   from the tracker; commit the fork if >=1 pod placed, else revert;
+4. the result is a ``PartitioningPlan`` carrying the desired
+   PartitioningState and a plan id.
+"""
+from __future__ import annotations
+
+import logging
+from dataclasses import dataclass, field
+from typing import Callable, List, Optional
+
+from nos_tpu.kube.objects import Pod
+from nos_tpu.partitioning.snapshot import ClusterSnapshot, SnapshotNode
+from nos_tpu.partitioning.state import PartitioningState
+from nos_tpu.partitioning.tracker import SliceTracker, pod_slice_request
+from nos_tpu.scheduler import framework as fw
+
+logger = logging.getLogger(__name__)
+
+
+@dataclass
+class PartitioningPlan:
+    desired_state: PartitioningState
+    id: str
+
+    def is_empty(self) -> bool:
+        return not self.desired_state
+
+
+def sort_pods_for_planning(pods: List[Pod]) -> List[Pod]:
+    """Priority desc, then smaller slice request first (maximizes packed
+    pods), then name (reference core/util.go:34-71)."""
+    def key(p: Pod):
+        chips = sum(
+            prof.chips * q for prof, q in pod_slice_request(p).items()
+        )
+        return (-p.priority(), chips, p.metadata.name)
+
+    return sorted(pods, key=key)
+
+
+class Planner:
+    def __init__(
+        self,
+        framework: Optional[fw.SchedulerFramework] = None,
+        plan_id_fn: Optional[Callable[[], str]] = None,
+    ):
+        self.framework = framework or fw.SchedulerFramework()
+        self._plan_id_fn = plan_id_fn or _default_plan_id
+
+    def plan(self, snapshot: ClusterSnapshot, pending: List[Pod]) -> PartitioningPlan:
+        tracker = SliceTracker(snapshot, pending)
+        remaining = sort_pods_for_planning(pending)
+        if tracker.is_empty() and not remaining:
+            return PartitioningPlan(snapshot.partitioning_state(), self._plan_id_fn())
+
+        # iterate by name and re-fetch after each fork: revert() replaces the
+        # snapshot's node objects, so holding SnapshotNode references across
+        # iterations would mutate orphaned clones
+        candidate_names = [sn.tpu_node.name for sn in snapshot.candidate_nodes()]
+        for name in candidate_names:
+            if not remaining or tracker.is_empty():
+                break
+            snapshot.fork()
+            sn = snapshot.get(name)
+            changed = sn.update_geometry_for(tracker.lacking)
+            placed: List[Pod] = []
+            for pod in remaining:
+                if self._can_schedule_on(pod, sn, snapshot):
+                    snapshot.add_pod(sn.tpu_node.name, pod)
+                    tracker.remove(pod)
+                    placed.append(pod)
+            if placed:
+                snapshot.commit()
+                remaining = [p for p in remaining if p not in placed]
+                logger.debug(
+                    "planner: node %s geometry %s placed %d pods",
+                    sn.tpu_node.name, "updated" if changed else "kept", len(placed),
+                )
+            else:
+                snapshot.revert()
+
+        return PartitioningPlan(snapshot.partitioning_state(), self._plan_id_fn())
+
+    # ------------------------------------------------------------------
+    def _can_schedule_on(
+        self, pod: Pod, sn: SnapshotNode, snapshot: ClusterSnapshot
+    ) -> bool:
+        """PreFilter + Filter against this node only (reference
+        canSchedulePod, planner.go:178-207)."""
+        state: fw.CycleState = {}
+        st = self.framework.run_pre_filter(state, pod, snapshot.framework_snapshot())
+        if not st.success:
+            return False
+        # the fork mutates node objects; re-read the node info by name
+        node_info = snapshot.get(sn.tpu_node.name).node_info
+        return self.framework.run_filter(state, pod, node_info).success
+
+
+_counter = 0
+
+
+def _default_plan_id() -> str:
+    import time
+
+    global _counter
+    _counter += 1
+    return f"{int(time.time())}-{_counter}"

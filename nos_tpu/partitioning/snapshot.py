@@ -1,0 +1,129 @@
+"""Copy-on-write cluster snapshot with Fork/Commit/Revert.
+
+Analog of reference internal/partitioning/core/snapshot.go:43-190. The
+planner speculates on a fork: update a node's geometry, try to place pods,
+then commit (keep) or revert (discard). Each snapshot node pairs the
+``TpuNode`` geometry state machine with a scheduler-framework ``NodeInfo``
+whose allocatable is recomputed after every geometry change (the simulation
+sees sub-slice resources exactly as the kubelet would advertise them).
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, List, Optional
+
+from nos_tpu.kube.objects import Pod, ResourceList, deep_copy
+from nos_tpu.scheduler import framework as fw
+from nos_tpu.tpu.node import TpuNode
+from nos_tpu.tpu.slice import Profile, is_slice_resource, parse_profile
+from nos_tpu.partitioning.state import NodePartitioning, PartitioningState
+
+
+@dataclass
+class SnapshotNode:
+    tpu_node: TpuNode
+    node_info: fw.NodeInfo
+
+    def clone(self) -> "SnapshotNode":
+        return SnapshotNode(self.tpu_node.clone(), self.node_info.clone())
+
+    def refresh_allocatable(self) -> None:
+        """Propagate board geometry into the simulated node allocatable."""
+        node = self.node_info.node
+        node.status.allocatable = self.tpu_node.allocatable_scalar_resources(
+            node.status.allocatable
+        )
+
+    def update_geometry_for(self, lacking: Dict[Profile, int]) -> bool:
+        changed = self.tpu_node.update_geometry_for(lacking)
+        if changed:
+            self.refresh_allocatable()
+        return changed
+
+
+class ClusterSnapshot:
+    def __init__(self, nodes: Optional[Dict[str, SnapshotNode]] = None):
+        self._nodes: Dict[str, SnapshotNode] = nodes or {}
+        self._forked: Optional[Dict[str, SnapshotNode]] = None
+
+    # -- fork/commit/revert --------------------------------------------------
+    def fork(self) -> None:
+        if self._forked is not None:
+            raise RuntimeError("snapshot already forked")
+        self._forked = {name: sn.clone() for name, sn in self._nodes.items()}
+
+    def commit(self) -> None:
+        self._forked = None
+
+    def revert(self) -> None:
+        if self._forked is None:
+            raise RuntimeError("snapshot not forked")
+        self._nodes = self._forked
+        self._forked = None
+
+    def clone(self) -> "ClusterSnapshot":
+        return ClusterSnapshot({name: sn.clone() for name, sn in self._nodes.items()})
+
+    # -- accessors -----------------------------------------------------------
+    def nodes(self) -> Dict[str, SnapshotNode]:
+        return self._nodes
+
+    def get(self, name: str) -> Optional[SnapshotNode]:
+        return self._nodes.get(name)
+
+    def candidate_nodes(self) -> List[SnapshotNode]:
+        """Nodes with room to host new slices, sorted by name for
+        deterministic planning (reference snapshot.go:119-130)."""
+        return [
+            sn
+            for _, sn in sorted(self._nodes.items())
+            if sn.tpu_node.has_free_capacity() or any(b.free for b in sn.tpu_node.boards)
+        ]
+
+    def framework_snapshot(self) -> fw.Snapshot:
+        snap = fw.Snapshot()
+        for name, sn in self._nodes.items():
+            snap[name] = sn.node_info
+        return snap
+
+    # -- resource math -------------------------------------------------------
+    def cluster_available(self) -> ResourceList:
+        total: ResourceList = {}
+        for sn in self._nodes.values():
+            for r, v in sn.node_info.available().items():
+                total[r] = total.get(r, 0) + v
+        return total
+
+    def lacking_resources(self, pod: Pod) -> ResourceList:
+        """Resources the cluster is missing to host this pod:
+        max(0, request - available) per requested resource
+        (reference getLackingResources, snapshot.go:132-165)."""
+        available = self.cluster_available()
+        out: ResourceList = {}
+        for r, v in pod.request().items():
+            missing = v - available.get(r, 0)
+            if missing > 0:
+                out[r] = missing
+        return out
+
+    def add_pod(self, node_name: str, pod: Pod) -> None:
+        sn = self._nodes[node_name]
+        sn.node_info.add_pod(deep_copy(pod))
+        # reflect sub-slice consumption in board free/used bookkeeping
+        for r, q in pod.request().items():
+            if not is_slice_resource(r):
+                continue
+            try:
+                profile = parse_profile(r)
+            except ValueError:
+                continue
+            remaining = int(q)
+            for board in sn.tpu_node.boards:
+                while remaining > 0 and board.reserve(profile):
+                    remaining -= 1
+
+    def partitioning_state(self) -> PartitioningState:
+        return {
+            name: NodePartitioning(boards=sn.tpu_node.partitioning())
+            for name, sn in self._nodes.items()
+        }

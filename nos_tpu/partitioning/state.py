@@ -1,0 +1,107 @@
+"""Cluster state and the PartitioningState value object.
+
+Analog of reference internal/partitioning/state/state.go:29-222 and
+partitioning.go:24-57. ``ClusterState`` is the partitioner's live cache of
+nodes and pod→node bindings, maintained by the node/pod controllers;
+``PartitioningState`` is the pure desired/current-partitioning value the
+planner and actuator exchange: node → board index → geometry.
+"""
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from nos_tpu import constants
+from nos_tpu.kube.objects import Node, Pod
+from nos_tpu.tpu.slice import Geometry
+
+
+@dataclass
+class NodePartitioning:
+    """Desired/observed partitioning of one node: board -> geometry
+    (analog of state.NodePartitioning{GPUs: []GPUPartitioning})."""
+
+    boards: Dict[int, Geometry] = field(default_factory=dict)
+
+    def __eq__(self, other) -> bool:
+        if not isinstance(other, NodePartitioning):
+            return NotImplemented
+        def clean(b):
+            return {
+                i: {p: q for p, q in g.items() if q > 0}
+                for i, g in b.items()
+                if any(q > 0 for q in g.values())
+            }
+        return clean(self.boards) == clean(other.boards)
+
+
+PartitioningState = Dict[str, NodePartitioning]
+
+
+def partitioning_states_equal(a: PartitioningState, b: PartitioningState) -> bool:
+    keys = set(a) | set(b)
+    for k in keys:
+        if a.get(k, NodePartitioning()) != b.get(k, NodePartitioning()):
+            return False
+    return True
+
+
+class ClusterState:
+    """Thread-safe view of nodes + their pods (reference state.go:54 mtx)."""
+
+    def __init__(self) -> None:
+        self._lock = threading.RLock()
+        self._nodes: Dict[str, Node] = {}
+        self._pods: Dict[str, Dict[str, Pod]] = {}   # node name -> pod key -> pod
+
+    # -- node/pod bookkeeping (driven by controllers) ------------------------
+    def upsert_node(self, node: Node) -> None:
+        with self._lock:
+            self._nodes[node.metadata.name] = node
+            self._pods.setdefault(node.metadata.name, {})
+
+    def remove_node(self, name: str) -> None:
+        with self._lock:
+            self._nodes.pop(name, None)
+            self._pods.pop(name, None)
+
+    def upsert_pod(self, pod: Pod) -> None:
+        key = f"{pod.metadata.namespace}/{pod.metadata.name}"
+        with self._lock:
+            # remove any stale binding first (pod may have moved/unbound)
+            for pods in self._pods.values():
+                pods.pop(key, None)
+            node = pod.spec.node_name
+            if node and pod.status.phase in ("Pending", "Running"):
+                self._pods.setdefault(node, {})[key] = pod
+
+    def remove_pod(self, pod: Pod) -> None:
+        key = f"{pod.metadata.namespace}/{pod.metadata.name}"
+        with self._lock:
+            for pods in self._pods.values():
+                pods.pop(key, None)
+
+    # -- queries -------------------------------------------------------------
+    def nodes(self) -> List[Node]:
+        with self._lock:
+            return list(self._nodes.values())
+
+    def get_node(self, name: str) -> Optional[Node]:
+        with self._lock:
+            return self._nodes.get(name)
+
+    def pods_on(self, node_name: str) -> List[Pod]:
+        with self._lock:
+            return list(self._pods.get(node_name, {}).values())
+
+    def partitioning_enabled_nodes(self, kind: str) -> List[Node]:
+        with self._lock:
+            return [
+                n
+                for n in self._nodes.values()
+                if n.metadata.labels.get(constants.LABEL_PARTITIONING) == kind
+            ]
+
+    def is_partitioning_enabled(self, kind: str) -> bool:
+        return bool(self.partitioning_enabled_nodes(kind))

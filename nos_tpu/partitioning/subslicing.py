@@ -1,0 +1,184 @@
+"""Sub-slicing mode module: the TPU analog of the reference's mig/ and mps/
+partitioning modules (internal/partitioning/mig/*.go, mps/*.go).
+
+- ``SubslicingPartitioner``: writes the desired geometry as node spec
+  annotations + the plan-id annotation (MIG-style,
+  internal/partitioning/mig/partitioner.go:43-77) *and* publishes the
+  per-node device-plugin config into a ConfigMap keyed ``<node>-<planId>``
+  then labels the node with the config key (MPS-style,
+  internal/partitioning/mps/partitioner.go:61-123) — on GKE the TPU device
+  plugin consumes the ConfigMap; the tpuagent consumes the annotations.
+- ``SubslicingSnapshotTaker``: builds a ClusterSnapshot of sub-slicing
+  labeled nodes (mig/snapshot_taker.go).
+- ``SubslicingSliceCalculator``/``slice filter``: extract sub-slice
+  requests from pods (mig/slice_calculator.go).
+- ``NodeInitializer``: applies the fewest-slices geometry to virgin nodes
+  (mig/initializer.go).
+"""
+from __future__ import annotations
+
+import json
+import logging
+from typing import List, Optional
+
+from nos_tpu import constants
+from nos_tpu.kube.apiserver import NotFound
+from nos_tpu.kube.client import Client
+from nos_tpu.kube.objects import ConfigMap, Node, ObjectMeta, Pod, deep_copy
+from nos_tpu.partitioning.snapshot import ClusterSnapshot, SnapshotNode
+from nos_tpu.partitioning.state import ClusterState, NodePartitioning
+from nos_tpu.scheduler import framework as fw
+from nos_tpu.tpu import annotation as ann
+from nos_tpu.tpu.node import NotATpuNode, TpuNode
+from nos_tpu.tpu.resource_calc import ResourceCalculator
+from nos_tpu.tpu.slice import Geometry, fewest_slices_geometry
+from nos_tpu.tpu import topology
+from nos_tpu.partitioning.tracker import pod_slice_request
+from nos_tpu.partitioning.planner import _default_plan_id
+
+logger = logging.getLogger(__name__)
+
+
+class SubslicingPartitioner:
+    """Writes desired state to the API server (node annotations + plan id +
+    device-plugin ConfigMap + node label)."""
+
+    def __init__(
+        self,
+        configmap_name: str = constants.DEVICE_PLUGIN_CONFIGMAP,
+        configmap_namespace: str = constants.DEVICE_PLUGIN_NAMESPACE,
+    ):
+        self.configmap_name = configmap_name
+        self.configmap_namespace = configmap_namespace
+
+    def apply_partitioning(
+        self,
+        client: Client,
+        node_name: str,
+        plan_id: str,
+        partitioning: NodePartitioning,
+    ) -> None:
+        spec_annotations = ann.spec_annotations_from_partitioning(partitioning.boards)
+        config_key = f"{node_name}-{plan_id}"
+
+        # 1. device-plugin ConfigMap entry (MPS-style hand-off)
+        plugin_config = json.dumps(
+            {
+                "version": "v1",
+                "boards": {
+                    str(i): {str(p): q for p, q in g.items()}
+                    for i, g in sorted(partitioning.boards.items())
+                },
+            },
+            sort_keys=True,
+        )
+        def update_cm(cm: ConfigMap):
+            # prune this node's stale plan entries so cm.data stays bounded
+            for key in [k for k in cm.data if k.startswith(f"{node_name}-")]:
+                del cm.data[key]
+            cm.data[config_key] = plugin_config
+
+        try:
+            client.patch(
+                "ConfigMap",
+                self.configmap_name,
+                self.configmap_namespace,
+                update_cm,
+            )
+        except NotFound:
+            client.create(
+                ConfigMap(
+                    metadata=ObjectMeta(
+                        name=self.configmap_name, namespace=self.configmap_namespace
+                    ),
+                    data={config_key: plugin_config},
+                )
+            )
+
+        # 2. node spec annotations + plan id + config label (MIG-style)
+        def mutate(node: Node):
+            kept = {
+                k: v
+                for k, v in node.metadata.annotations.items()
+                if not k.startswith(constants.ANNOTATION_SPEC_PREFIX)
+            }
+            kept.update(spec_annotations)
+            kept[constants.ANNOTATION_PARTITIONING_PLAN] = plan_id
+            node.metadata.annotations = kept
+            node.metadata.labels[constants.LABEL_DEVICE_PLUGIN_CONFIG] = config_key
+
+        client.patch("Node", node_name, "", mutate)
+        logger.info("partitioner: applied plan %s to node %s", plan_id, node_name)
+
+
+class SubslicingSliceCalculator:
+    """Extract sub-slice demand from pods (reference slice_calculator.go)."""
+
+    @staticmethod
+    def requested(pods: List[Pod]) -> Geometry:
+        total: Geometry = {}
+        for pod in pods:
+            for p, q in pod_slice_request(pod).items():
+                total[p] = total.get(p, 0) + q
+        return total
+
+
+class SubslicingSnapshotTaker:
+    """Build a ClusterSnapshot from sub-slicing-enabled nodes
+    (reference mig/snapshot_taker.go)."""
+
+    def __init__(self, calculator: Optional[ResourceCalculator] = None):
+        self.calc = calculator or ResourceCalculator()
+
+    def take(self, state: ClusterState) -> ClusterSnapshot:
+        nodes = {}
+        for node in state.partitioning_enabled_nodes(constants.PARTITIONING_SUBSLICING):
+            try:
+                tpu_node = TpuNode.from_node(node)
+            except NotATpuNode:
+                logger.warning(
+                    "node %s labeled for sub-slicing but not a TPU node",
+                    node.metadata.name,
+                )
+                continue
+            sim_node = deep_copy(node)
+            sn = SnapshotNode(
+                tpu_node,
+                fw.NodeInfo(sim_node, list(state.pods_on(node.metadata.name)), self.calc),
+            )
+            sn.refresh_allocatable()
+            nodes[node.metadata.name] = sn
+        return ClusterSnapshot(nodes)
+
+
+class NodeInitializer:
+    """Apply the fewest-slices geometry to virgin sub-slicing nodes
+    (reference mig/initializer.go:49, §3.5): a node is initialized when its
+    spec annotations cover all boards."""
+
+    def __init__(self, partitioner: Optional[SubslicingPartitioner] = None,
+                 plan_id_fn=None):
+        self.partitioner = partitioner or SubslicingPartitioner()
+        self._plan_id_fn = plan_id_fn or _default_plan_id
+
+    @staticmethod
+    def is_initialized(node: Node) -> bool:
+        specs, _ = ann.parse_node_annotations(node.metadata.annotations)
+        return bool(specs)
+
+    def initialize(self, client: Client, node: Node) -> bool:
+        if self.is_initialized(node):
+            return False
+        try:
+            tpu_node = TpuNode.from_node(node)
+        except NotATpuNode:
+            return False
+        boards = {}
+        for board in tpu_node.boards:
+            if not board.has_geometry():
+                board.init_geometry()
+            boards[board.index] = board.geometry
+        self.partitioner.apply_partitioning(
+            client, node.metadata.name, self._plan_id_fn(), NodePartitioning(boards)
+        )
+        return True

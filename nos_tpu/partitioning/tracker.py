@@ -1,0 +1,57 @@
+"""SliceTracker — aggregate requested/lacking sub-slices across pending pods.
+
+Analog of reference internal/partitioning/core/tracker.go:26-88: the planner
+plans geometry changes for the slices the pending pods *lack* (cluster-wide
+missing capacity), decrementing as pods get virtually placed.
+"""
+from __future__ import annotations
+
+from typing import Dict, Iterable, List
+
+from nos_tpu.kube.objects import Pod
+from nos_tpu.tpu.slice import Profile, is_slice_resource, parse_profile
+
+
+def pod_slice_request(pod: Pod) -> Dict[Profile, int]:
+    out: Dict[Profile, int] = {}
+    for r, q in pod.request().items():
+        if is_slice_resource(r) and q > 0:
+            out[parse_profile(r)] = out.get(parse_profile(r), 0) + int(q)
+    return out
+
+
+class SliceTracker:
+    def __init__(self, snapshot, pods: Iterable[Pod]):
+        self._requested: Dict[Profile, int] = {}
+        self._lacking: Dict[Profile, int] = {}
+        self._pod_lacking: Dict[str, Dict[Profile, int]] = {}
+        for pod in pods:
+            key = f"{pod.metadata.namespace}/{pod.metadata.name}"
+            req = pod_slice_request(pod)
+            for p, q in req.items():
+                self._requested[p] = self._requested.get(p, 0) + q
+            lacking = {}
+            for r, v in snapshot.lacking_resources(pod).items():
+                if is_slice_resource(r):
+                    lacking[parse_profile(r)] = int(v)
+            self._pod_lacking[key] = lacking
+            for p, q in lacking.items():
+                self._lacking[p] = self._lacking.get(p, 0) + q
+
+    @property
+    def requested(self) -> Dict[Profile, int]:
+        return dict(self._requested)
+
+    @property
+    def lacking(self) -> Dict[Profile, int]:
+        return {p: q for p, q in self._lacking.items() if q > 0}
+
+    def remove(self, pod: Pod) -> None:
+        """Pod (virtually) placed: drop its contribution
+        (reference tracker.go Remove)."""
+        key = f"{pod.metadata.namespace}/{pod.metadata.name}"
+        for p, q in self._pod_lacking.pop(key, {}).items():
+            self._lacking[p] = max(0, self._lacking.get(p, 0) - q)
+
+    def is_empty(self) -> bool:
+        return not self.lacking

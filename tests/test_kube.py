@@ -359,8 +359,11 @@ def test_livelock_guard():
     mgr = Manager(s)
 
     def always_patch(client, req):
-        client.patch("Node", req.name, "", lambda n: n.metadata.annotations.update(
-            {"count": str(len(n.metadata.annotations))}))
+        def bump(n):
+            n.metadata.annotations["count"] = str(
+                int(n.metadata.annotations.get("count", "0")) + 1
+            )
+        client.patch("Node", req.name, "", bump)
         return Result()
 
     mgr.add_controller(Controller("livelock", always_patch, [Watch("Node")]))
@@ -379,3 +382,15 @@ def test_unsubscribe_stops_event_delivery():
     while (ev := sub.pop()) is not None:
         events.append(ev.obj.metadata.name)
     assert events == ["p1"]
+
+
+def test_noop_update_emits_no_event_and_keeps_rv():
+    s = ApiServer()
+    s.create(Node(metadata=ObjectMeta(name="n1")))
+    sub = s.subscribe()
+    n = s.get("Node", "n1")
+    rv = n.metadata.resource_version
+    s.update(n)                                    # identical content
+    s.patch("Node", "n1", "", lambda x: None)      # no-op patch
+    assert len(sub) == 0
+    assert s.get("Node", "n1").metadata.resource_version == rv

@@ -1,0 +1,298 @@
+"""Partitioning control plane: tracker, planner, actuator, subslicing module
+(model: reference internal/partitioning/core/planner_test.go and the mig/mps
+module tests)."""
+import pytest
+
+from nos_tpu import constants
+from nos_tpu.kube.objects import (
+    Container,
+    Node,
+    NodeStatus,
+    ObjectMeta,
+    Pod,
+    PodCondition,
+    PodSpec,
+    PodStatus,
+)
+from nos_tpu.partitioning.actuator import Actuator
+from nos_tpu.partitioning.planner import Planner, sort_pods_for_planning
+from nos_tpu.partitioning.snapshot import ClusterSnapshot, SnapshotNode
+from nos_tpu.partitioning.state import ClusterState, NodePartitioning
+from nos_tpu.partitioning.subslicing import (
+    NodeInitializer,
+    SubslicingPartitioner,
+    SubslicingSnapshotTaker,
+)
+from nos_tpu.partitioning.tracker import SliceTracker
+from nos_tpu.scheduler import framework as fw
+from nos_tpu.tpu.node import TpuNode
+from nos_tpu.tpu.slice import Profile
+
+P11, P22, P24 = Profile(1, 1), Profile(2, 2), Profile(2, 4)
+SLICE_11 = "nos.ai/tpu-slice-1x1"
+SLICE_22 = "nos.ai/tpu-slice-2x2"
+SLICE_24 = "nos.ai/tpu-slice-2x4"
+
+
+def v5e_node(name, annotations=None, labels=None):
+    lab = {
+        constants.LABEL_TPU_ACCELERATOR: "tpu-v5-lite-podslice",
+        constants.LABEL_TPU_TOPOLOGY: "2x4",
+        constants.LABEL_PARTITIONING: constants.PARTITIONING_SUBSLICING,
+    }
+    lab.update(labels or {})
+    return Node(
+        metadata=ObjectMeta(name=name, labels=lab, annotations=annotations or {}),
+        status=NodeStatus(capacity={"cpu": 96}, allocatable={"cpu": 96}),
+    )
+
+
+def snapshot_of(*nodes) -> ClusterSnapshot:
+    out = {}
+    for node in nodes:
+        tn = TpuNode.from_node(node)
+        sn = SnapshotNode(tn, fw.NodeInfo(node, []))
+        sn.refresh_allocatable()
+        out[node.metadata.name] = sn
+    return ClusterSnapshot(out)
+
+
+def slice_pod(name, profile_resource, qty=1, ns="default", priority=None,
+              unschedulable=True):
+    conditions = (
+        [PodCondition(type="PodScheduled", status="False", reason="Unschedulable")]
+        if unschedulable
+        else []
+    )
+    return Pod(
+        metadata=ObjectMeta(name=name, namespace=ns),
+        spec=PodSpec(
+            containers=[Container(requests={profile_resource: qty})],
+            priority=priority,
+        ),
+        status=PodStatus(phase="Pending", conditions=conditions),
+    )
+
+
+# ---------------------------------------------------------------------------
+# snapshot fork/commit/revert
+# ---------------------------------------------------------------------------
+
+def test_snapshot_fork_commit_revert():
+    snap = snapshot_of(v5e_node("n1"))
+    sn = snap.get("n1")
+    sn.tpu_node.boards[0].init_geometry()
+    sn.refresh_allocatable()
+    snap.fork()
+    snap.get("n1").update_geometry_for({P11: 4})
+    assert snap.get("n1").node_info.node.status.allocatable.get(SLICE_11, 0) >= 4
+    snap.revert()
+    assert snap.get("n1").node_info.node.status.allocatable.get(SLICE_11, 0) == 0
+    snap.fork()
+    snap.get("n1").update_geometry_for({P11: 4})
+    snap.commit()
+    assert snap.get("n1").node_info.node.status.allocatable.get(SLICE_11, 0) >= 4
+
+
+def test_snapshot_double_fork_rejected():
+    snap = snapshot_of(v5e_node("n1"))
+    snap.fork()
+    with pytest.raises(RuntimeError):
+        snap.fork()
+
+
+def test_lacking_resources():
+    snap = snapshot_of(v5e_node("n1"))
+    snap.get("n1").tpu_node.boards[0].init_geometry()  # 1x(2x4) free
+    snap.get("n1").refresh_allocatable()
+    pod = slice_pod("p", SLICE_11, qty=3)
+    lacking = snap.lacking_resources(pod)
+    assert lacking == {SLICE_11: 3}   # no 1x1 slices exist yet
+    pod2 = slice_pod("p2", SLICE_24, qty=1)
+    assert snap.lacking_resources(pod2) == {}
+
+
+# ---------------------------------------------------------------------------
+# tracker
+# ---------------------------------------------------------------------------
+
+def test_tracker_aggregates_and_removes():
+    snap = snapshot_of(v5e_node("n1"))
+    pods = [slice_pod("a", SLICE_11, 2), slice_pod("b", SLICE_11, 1),
+            slice_pod("c", SLICE_22, 1)]
+    tracker = SliceTracker(snap, pods)
+    assert tracker.lacking == {P11: 3, P22: 1}
+    tracker.remove(pods[0])
+    assert tracker.lacking == {P11: 1, P22: 1}
+    tracker.remove(pods[1])
+    tracker.remove(pods[2])
+    assert tracker.is_empty()
+
+
+# ---------------------------------------------------------------------------
+# planner
+# ---------------------------------------------------------------------------
+
+def test_sort_pods_priority_then_size():
+    pods = [
+        slice_pod("big-low", SLICE_24, priority=0),
+        slice_pod("small-low", SLICE_11, priority=0),
+        slice_pod("small-high", SLICE_11, priority=10),
+    ]
+    assert [p.metadata.name for p in sort_pods_for_planning(pods)] == [
+        "small-high", "small-low", "big-low",
+    ]
+
+
+def test_planner_repartitions_virgin_node_for_pending_pods():
+    snap = snapshot_of(v5e_node("n1"))
+    snap.get("n1").tpu_node.boards[0].init_geometry()   # whole board 2x4
+    snap.get("n1").refresh_allocatable()
+    pods = [slice_pod("a", SLICE_11, 2), slice_pod("b", SLICE_22, 1)]
+    plan = Planner(plan_id_fn=lambda: "t1").plan(snap, pods)
+    assert plan.id == "t1"
+    geometry = plan.desired_state["n1"].boards[0]
+    assert geometry.get(P11, 0) >= 2
+    assert geometry.get(P22, 0) >= 1
+
+
+def test_planner_keeps_geometry_when_pods_cannot_fit():
+    """Reference planner_test.go case: 'Cluster geometry cannot be changed
+    for pending Pods' — demand that exceeds every node leaves geometry
+    untouched."""
+    snap = snapshot_of(v5e_node("n1"))
+    snap.get("n1").tpu_node.boards[0].init_geometry()
+    snap.get("n1").refresh_allocatable()
+    before = snap.partitioning_state()
+    pods = [slice_pod("impossible", SLICE_24, qty=3)]   # 3 whole boards on 1 node
+    plan = Planner(plan_id_fn=lambda: "t1").plan(snap, pods)
+    assert plan.desired_state["n1"] == before["n1"]
+
+
+def test_planner_respects_used_slices():
+    node = v5e_node("n1", annotations={
+        "nos.ai/status-tpu-0-2x2-used": "1",
+        "nos.ai/status-tpu-0-2x2-free": "1",
+    })
+    snap = snapshot_of(node)
+    pods = [slice_pod("a", SLICE_11, 4)]
+    plan = Planner(plan_id_fn=lambda: "t1").plan(snap, pods)
+    geometry = plan.desired_state["n1"].boards[0]
+    assert geometry.get(P22, 0) >= 1          # used 2x2 preserved
+    assert geometry.get(P11, 0) >= 4
+
+
+def test_planner_spreads_over_multiple_nodes():
+    snap = snapshot_of(v5e_node("n1"), v5e_node("n2"))
+    for n in ("n1", "n2"):
+        snap.get(n).tpu_node.boards[0].init_geometry()
+        snap.get(n).refresh_allocatable()
+    # 16 single-chip slices: 8 per v5e node
+    pods = [slice_pod(f"p{i}", SLICE_11, 1) for i in range(16)]
+    plan = Planner(plan_id_fn=lambda: "t1").plan(snap, pods)
+    assert plan.desired_state["n1"].boards[0] == {P11: 8}
+    assert plan.desired_state["n2"].boards[0] == {P11: 8}
+
+
+def test_planner_only_helps_schedulable_pods():
+    """A pod whose node selector matches nothing must not trigger geometry
+    churn."""
+    snap = snapshot_of(v5e_node("n1"))
+    snap.get("n1").tpu_node.boards[0].init_geometry()
+    snap.get("n1").refresh_allocatable()
+    before = snap.partitioning_state()
+    pod = slice_pod("selector-miss", SLICE_11, 1)
+    pod.spec.node_selector = {constants.LABEL_TPU_ACCELERATOR: "tpu-v5p-slice"}
+    plan = Planner(plan_id_fn=lambda: "t1").plan(snap, [pod])
+    assert plan.desired_state["n1"] == before["n1"]
+
+
+# ---------------------------------------------------------------------------
+# actuator + subslicing partitioner
+# ---------------------------------------------------------------------------
+
+class RecordingPartitioner:
+    def __init__(self):
+        self.applied = []
+
+    def apply_partitioning(self, client, node_name, plan_id, partitioning):
+        self.applied.append((node_name, plan_id, partitioning))
+
+
+def test_actuator_applies_only_diffs():
+    from nos_tpu.partitioning.planner import PartitioningPlan
+
+    rec = RecordingPartitioner()
+    actuator = Actuator(rec)
+    current = {
+        "n1": NodePartitioning(boards={0: {P24: 1}}),
+        "n2": NodePartitioning(boards={0: {P24: 1}}),
+    }
+    desired = {
+        "n1": NodePartitioning(boards={0: {P24: 1}}),      # unchanged
+        "n2": NodePartitioning(boards={0: {P11: 8}}),      # changed
+    }
+    assert actuator.apply(None, current, PartitioningPlan(desired, "plan-1"))
+    assert [a[0] for a in rec.applied] == ["n2"]
+
+
+def test_actuator_noop_on_equal_or_empty():
+    from nos_tpu.partitioning.planner import PartitioningPlan
+
+    rec = RecordingPartitioner()
+    actuator = Actuator(rec)
+    state = {"n1": NodePartitioning(boards={0: {P24: 1}})}
+    assert not actuator.apply(None, state, PartitioningPlan(dict(state), "p"))
+    assert not actuator.apply(None, state, PartitioningPlan({}, "p"))
+    assert rec.applied == []
+
+
+def test_subslicing_partitioner_writes_wire_format():
+    from nos_tpu.kube import ApiServer, Client
+
+    server = ApiServer()
+    client = Client(server)
+    server.create(v5e_node("n1"))
+    SubslicingPartitioner().apply_partitioning(
+        client, "n1", "plan-42", NodePartitioning(boards={0: {P11: 4, P22: 1}})
+    )
+    node = server.get("Node", "n1")
+    assert node.metadata.annotations["nos.ai/spec-tpu-0-1x1"] == "4"
+    assert node.metadata.annotations["nos.ai/spec-tpu-0-2x2"] == "1"
+    assert node.metadata.annotations[constants.ANNOTATION_PARTITIONING_PLAN] == "plan-42"
+    assert node.metadata.labels[constants.LABEL_DEVICE_PLUGIN_CONFIG] == "n1-plan-42"
+    cm = server.get("ConfigMap", constants.DEVICE_PLUGIN_CONFIGMAP,
+                    constants.DEVICE_PLUGIN_NAMESPACE)
+    assert "n1-plan-42" in cm.data
+    # reapplying replaces stale spec annotations
+    SubslicingPartitioner().apply_partitioning(
+        client, "n1", "plan-43", NodePartitioning(boards={0: {P24: 1}})
+    )
+    node = server.get("Node", "n1")
+    assert "nos.ai/spec-tpu-0-1x1" not in node.metadata.annotations
+    assert node.metadata.annotations["nos.ai/spec-tpu-0-2x4"] == "1"
+
+
+def test_node_initializer_virgin_node():
+    from nos_tpu.kube import ApiServer, Client
+
+    server = ApiServer()
+    client = Client(server)
+    server.create(v5e_node("n1"))
+    init = NodeInitializer(plan_id_fn=lambda: "init-1")
+    node = server.get("Node", "n1")
+    assert init.initialize(client, node)
+    got = server.get("Node", "n1")
+    assert got.metadata.annotations["nos.ai/spec-tpu-0-2x4"] == "1"
+    # second call is a no-op (already has spec annotations)
+    assert not init.initialize(client, got)
+
+
+def test_snapshot_taker_only_labeled_tpu_nodes():
+    state = ClusterState()
+    state.upsert_node(v5e_node("tpu-1"))
+    plain = Node(metadata=ObjectMeta(
+        name="cpu-1", labels={constants.LABEL_PARTITIONING: "subslicing"}))
+    state.upsert_node(plain)  # labeled but not a TPU node
+    snap = SubslicingSnapshotTaker().take(state)
+    assert set(snap.nodes().keys()) == {"tpu-1"}

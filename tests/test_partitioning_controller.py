@@ -1,0 +1,194 @@
+"""End-to-end dynamic partitioning flow (BASELINE.json config 3): pending
+sub-slice pods -> batch -> plan -> node annotations -> (fake agent actuates
+and reports) -> allocatable updated -> scheduler places the pods.
+
+The fake agent plays tpuagent's role exactly at the wire format: it reads
+spec annotations, 'applies' them, writes matching status annotations, the
+reported-plan id, and the node's allocatable sub-slice resources.
+"""
+from nos_tpu import constants
+from nos_tpu.kube import ApiServer, Manager
+from nos_tpu.kube.controller import Controller, Request, Result, Watch
+from nos_tpu.kube.objects import (
+    Container,
+    Node,
+    NodeStatus,
+    ObjectMeta,
+    Pod,
+    PodCondition,
+    PodSpec,
+    PodStatus,
+)
+from nos_tpu.partitioning.controller import (
+    NodeController,
+    PartitioningController,
+    PodController,
+)
+from nos_tpu.partitioning.state import ClusterState
+from nos_tpu.scheduler import Scheduler
+from nos_tpu.tpu import annotation as ann
+from nos_tpu.tpu.node import TpuNode
+
+SLICE_11 = "nos.ai/tpu-slice-1x1"
+
+
+class FakeClock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+def v5e_node(name):
+    return Node(
+        metadata=ObjectMeta(
+            name=name,
+            labels={
+                constants.LABEL_TPU_ACCELERATOR: "tpu-v5-lite-podslice",
+                constants.LABEL_TPU_TOPOLOGY: "2x4",
+                constants.LABEL_PARTITIONING: constants.PARTITIONING_SUBSLICING,
+            },
+        ),
+        status=NodeStatus(capacity={"cpu": 96}, allocatable={"cpu": 96}),
+    )
+
+
+def slice_pod(name, qty=1, ns="default"):
+    return Pod(
+        metadata=ObjectMeta(name=name, namespace=ns),
+        spec=PodSpec(
+            containers=[Container(requests={SLICE_11: qty})],
+            scheduler_name=constants.SCHEDULER_NAME,
+        ),
+        status=PodStatus(
+            phase="Pending",
+            conditions=[
+                PodCondition(type="PodScheduled", status="False", reason="Unschedulable")
+            ],
+        ),
+    )
+
+
+def fake_agent_reconcile(client, req: Request) -> Result:
+    """Actuate spec annotations: report status annotations + plan id +
+    allocatable (what the real tpuagent + device plugin do)."""
+    node = client.try_get("Node", req.name)
+    if node is None:
+        return Result()
+    specs, _ = ann.parse_node_annotations(node.metadata.annotations)
+    if not specs:
+        return Result()
+    desired = ann.spec_from_annotations(specs)
+    plan_id = node.metadata.annotations.get(constants.ANNOTATION_PARTITIONING_PLAN)
+
+    def mutate(n: Node):
+        # wipe old status annotations, write new ones (all free)
+        anns = {
+            k: v
+            for k, v in n.metadata.annotations.items()
+            if not k.startswith(constants.ANNOTATION_STATUS_PREFIX)
+        }
+        alloc = {
+            k: v
+            for k, v in n.status.allocatable.items()
+            if not k.startswith(constants.RESOURCE_TPU_SLICE_PREFIX)
+        }
+        for board, geometry in desired.items():
+            for profile, q in geometry.items():
+                anns[
+                    f"{constants.ANNOTATION_STATUS_PREFIX}{board}-{profile}-free"
+                ] = str(q)
+                alloc[profile.resource_name] = alloc.get(profile.resource_name, 0) + q
+        if plan_id:
+            anns[constants.ANNOTATION_REPORTED_PARTITIONING_PLAN] = plan_id
+        n.metadata.annotations = anns
+        n.status.allocatable = alloc
+
+    client.patch("Node", node.metadata.name, "", mutate)
+    return Result()
+
+
+def rig():
+    server = ApiServer()
+    clock = FakeClock()
+    mgr = Manager(server, clock=clock)
+    state = ClusterState()
+    mgr.add_controller(NodeController(state).controller())
+    mgr.add_controller(PodController(state).controller())
+    part = PartitioningController(
+        state, batch_timeout_s=60, batch_idle_s=10, clock=clock
+    )
+    mgr.add_controller(part.controller())
+    mgr.add_controller(
+        Controller("fake-tpuagent", fake_agent_reconcile, [Watch("Node")])
+    )
+    mgr.add_controller(Scheduler().controller())
+    return server, mgr, clock, state
+
+
+def test_full_dynamic_partitioning_flow():
+    server, mgr, clock, state = rig()
+    server.create(v5e_node("v5e-0"))
+    mgr.run_until_idle()
+
+    # node got initialized to the whole-board geometry and the agent reported
+    node = server.get("Node", "v5e-0")
+    assert node.metadata.annotations.get("nos.ai/spec-tpu-0-2x4") == "1"
+    assert (
+        node.metadata.annotations.get(constants.ANNOTATION_REPORTED_PARTITIONING_PLAN)
+        == node.metadata.annotations.get(constants.ANNOTATION_PARTITIONING_PLAN)
+    )
+
+    # four pods each requesting one 1x1 sub-slice arrive; nothing fits yet
+    for i in range(4):
+        server.create(slice_pod(f"p{i}"))
+    mgr.run_until_idle()       # pods batched; partitioner parked on the window
+    clock.advance(11)          # idle window elapses
+    mgr.run_until_idle()
+
+    node = server.get("Node", "v5e-0")
+    # partitioner re-planned toward 1x1 slices; agent actuated and reported
+    assert int(node.metadata.annotations.get("nos.ai/spec-tpu-0-1x1", 0)) >= 4
+    assert node.status.allocatable.get(SLICE_11, 0) >= 4
+
+    # and the scheduler placed all four pods on the repartitioned node
+    for i in range(4):
+        assert server.get("Pod", f"p{i}", "default").spec.node_name == "v5e-0"
+
+
+def test_no_plan_when_partitioning_disabled():
+    server, mgr, clock, state = rig()
+    # no partitioning-labeled nodes at all
+    server.create(slice_pod("p0"))
+    mgr.run_until_idle()
+    clock.advance(11)
+    mgr.run_until_idle()
+    assert server.get("Pod", "p0", "default").spec.node_name == ""
+
+
+def test_handshake_blocks_second_plan_until_report():
+    """With no agent running, a second batch must not be actuated until the
+    node reports the first plan."""
+    server = ApiServer()
+    clock = FakeClock()
+    mgr = Manager(server, clock=clock)
+    state = ClusterState()
+    mgr.add_controller(NodeController(state).controller())
+    mgr.add_controller(PodController(state).controller())
+    part = PartitioningController(state, batch_timeout_s=60, batch_idle_s=10, clock=clock)
+    mgr.add_controller(part.controller())
+    server.create(v5e_node("v5e-0"))
+    mgr.run_until_idle()
+    plan1 = server.get("Node", "v5e-0").metadata.annotations[
+        constants.ANNOTATION_PARTITIONING_PLAN
+    ]
+    # pods arrive; batch becomes ready but the node never reported plan1
+    server.create(slice_pod("p0"))
+    clock.advance(61)
+    mgr.run_until_idle()
+    node = server.get("Node", "v5e-0")
+    assert node.metadata.annotations[constants.ANNOTATION_PARTITIONING_PLAN] == plan1
