@@ -1,0 +1,230 @@
+// tpuagent native device layer.
+//
+// The TPU-native replacement for the reference's cgo->libnvidia-ml boundary
+// (reference pkg/gpu/nvml/client.go — the only native code path in nos).
+// Where NVML creates/destroys MIG GPU instances imperatively (with the
+// fragile permutation retry loop, nvml/client.go:225-340), TPU per-host
+// partitioning is *declarative*: the desired board geometry is applied as a
+// whole and persisted atomically; reads always reflect the full current
+// state. That follows SURVEY §7's guidance that device-level actuation must
+// be idempotent, resumable reconcile — not imperative op sequences.
+//
+// Responsibilities (C ABI, consumed from Python via ctypes):
+//   - chip discovery: count /dev/accel* device files (TPU VMs expose one
+//     per chip) with an env override for non-TPU hosts and tests;
+//   - instance metadata: accelerator type / topology / worker id from the
+//     GCE metadata environment (tpu-env style KEY=VALUE file or process
+//     env) — a TPU VM publishes these via the metadata server;
+//   - partition state: atomically persist/load the host's sub-slice
+//     geometry (JSON) so agent restarts resume cleanly;
+//   - health: per-chip usability probe (device node present + readable).
+//
+// Everything is exercised through tpu_native.py; the Python shim falls back
+// to a pure-Python mock when the shared library cannot be built.
+
+#include <cerrno>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <dirent.h>
+#include <fcntl.h>
+#include <string>
+#include <sys/stat.h>
+#include <unistd.h>
+#include <vector>
+
+extern "C" {
+
+// ---------------------------------------------------------------------------
+// chip discovery
+// ---------------------------------------------------------------------------
+
+// Number of TPU chips on this host. Order of precedence:
+//   1. NOS_TPU_CHIP_COUNT env (tests / simulation)
+//   2. /dev/accel* device files (real TPU VM)
+// Returns 0 when no chips are present.
+int tpu_chip_count() {
+  const char* env = getenv("NOS_TPU_CHIP_COUNT");
+  if (env != nullptr && *env != '\0') {
+    long n = strtol(env, nullptr, 10);
+    return n > 0 ? static_cast<int>(n) : 0;
+  }
+  DIR* dev = opendir("/dev");
+  if (dev == nullptr) return 0;
+  int count = 0;
+  struct dirent* entry;
+  while ((entry = readdir(dev)) != nullptr) {
+    if (strncmp(entry->d_name, "accel", 5) == 0) {
+      const char* suffix = entry->d_name + 5;
+      if (*suffix != '\0' && strspn(suffix, "0123456789") == strlen(suffix)) {
+        count++;
+      }
+    }
+  }
+  closedir(dev);
+  return count;
+}
+
+// Chip health: 1 = healthy (device node exists and is openable), 0 = not.
+// With NOS_TPU_CHIP_COUNT set, chips below the count are always healthy
+// unless listed in NOS_TPU_UNHEALTHY_CHIPS (comma-separated indexes).
+int tpu_chip_healthy(int chip) {
+  const char* env = getenv("NOS_TPU_CHIP_COUNT");
+  if (env != nullptr && *env != '\0') {
+    if (chip < 0 || chip >= tpu_chip_count()) return 0;
+    const char* bad = getenv("NOS_TPU_UNHEALTHY_CHIPS");
+    if (bad != nullptr) {
+      std::string list(bad);
+      std::string needle = std::to_string(chip);
+      size_t pos = 0;
+      while (pos <= list.size()) {
+        size_t comma = list.find(',', pos);
+        std::string tok = list.substr(
+            pos, comma == std::string::npos ? std::string::npos : comma - pos);
+        if (tok == needle) return 0;
+        if (comma == std::string::npos) break;
+        pos = comma + 1;
+      }
+    }
+    return 1;
+  }
+  char path[64];
+  snprintf(path, sizeof(path), "/dev/accel%d", chip);
+  int fd = open(path, O_RDONLY | O_NONBLOCK);
+  if (fd < 0) return 0;
+  close(fd);
+  return 1;
+}
+
+// ---------------------------------------------------------------------------
+// metadata
+// ---------------------------------------------------------------------------
+
+// Look up a metadata key. Precedence:
+//   1. process env NOS_TPU_META_<KEY> (upper-cased, dashes -> underscores)
+//   2. the tpu-env style file at $NOS_TPU_ENV_FILE (KEY=VALUE per line)
+// Writes a NUL-terminated value into buf; returns value length, or -1 if
+// absent / buffer too small.
+int tpu_metadata(const char* key, char* buf, int buf_len) {
+  if (key == nullptr || buf == nullptr || buf_len <= 0) return -1;
+
+  std::string env_key = "NOS_TPU_META_";
+  for (const char* p = key; *p != '\0'; ++p) {
+    char c = *p;
+    if (c == '-') c = '_';
+    else if (c >= 'a' && c <= 'z') c = static_cast<char>(c - 'a' + 'A');
+    env_key.push_back(c);
+  }
+  const char* env = getenv(env_key.c_str());
+  if (env != nullptr) {
+    int len = static_cast<int>(strlen(env));
+    if (len + 1 > buf_len) return -1;
+    memcpy(buf, env, len + 1);
+    return len;
+  }
+
+  const char* file = getenv("NOS_TPU_ENV_FILE");
+  if (file == nullptr) return -1;
+  FILE* f = fopen(file, "r");
+  if (f == nullptr) return -1;
+  char line[1024];
+  int result = -1;
+  size_t key_len = strlen(key);
+  while (fgets(line, sizeof(line), f) != nullptr) {
+    char* p = line;
+    while (*p == ' ' || *p == '\t') p++;
+    if (strncmp(p, key, key_len) != 0) continue;
+    char* eq = p + key_len;
+    while (*eq == ' ' || *eq == '\t') eq++;
+    if (*eq != '=') continue;
+    eq++;
+    while (*eq == ' ' || *eq == '\t' || *eq == '\'' || *eq == '"') eq++;
+    char* end = eq + strlen(eq);
+    while (end > eq && (end[-1] == '\n' || end[-1] == '\r' || end[-1] == ' ' ||
+                        end[-1] == '\'' || end[-1] == '"')) {
+      end--;
+    }
+    int len = static_cast<int>(end - eq);
+    if (len + 1 > buf_len) break;
+    memcpy(buf, eq, len);
+    buf[len] = '\0';
+    result = len;
+    break;
+  }
+  fclose(f);
+  return result;
+}
+
+// ---------------------------------------------------------------------------
+// partition state (declarative, atomic)
+// ---------------------------------------------------------------------------
+
+static std::string state_path() {
+  const char* p = getenv("NOS_TPU_STATE_FILE");
+  if (p != nullptr && *p != '\0') return std::string(p);
+  return std::string("/var/run/nos-tpuagent/partition.json");
+}
+
+// Atomically persist the host partition state (opaque JSON payload owned by
+// the Python layer). Returns 0 on success, -1 on error.
+int tpu_apply_partition(const char* json) {
+  if (json == nullptr) return -1;
+  std::string path = state_path();
+  size_t slash = path.find_last_of('/');
+  if (slash != std::string::npos) {
+    std::string dir = path.substr(0, slash);
+    // best-effort recursive mkdir
+    for (size_t i = 1; i <= dir.size(); ++i) {
+      if (i == dir.size() || dir[i] == '/') {
+        std::string part = dir.substr(0, i);
+        if (!part.empty()) mkdir(part.c_str(), 0755);
+      }
+    }
+  }
+  std::string tmp = path + ".tmp";
+  FILE* f = fopen(tmp.c_str(), "w");
+  if (f == nullptr) return -1;
+  size_t len = strlen(json);
+  if (fwrite(json, 1, len, f) != len) {
+    fclose(f);
+    unlink(tmp.c_str());
+    return -1;
+  }
+  if (fflush(f) != 0 || fsync(fileno(f)) != 0) {
+    fclose(f);
+    unlink(tmp.c_str());
+    return -1;
+  }
+  fclose(f);
+  if (rename(tmp.c_str(), path.c_str()) != 0) {
+    unlink(tmp.c_str());
+    return -1;
+  }
+  return 0;
+}
+
+// Read the persisted partition state into buf. Returns length, 0 if no
+// state exists yet, -1 on error / buffer too small.
+int tpu_read_partition(char* buf, int buf_len) {
+  if (buf == nullptr || buf_len <= 0) return -1;
+  FILE* f = fopen(state_path().c_str(), "r");
+  if (f == nullptr) {
+    buf[0] = '\0';
+    return 0;
+  }
+  size_t n = fread(buf, 1, static_cast<size_t>(buf_len - 1), f);
+  // distinguish "fits exactly" from truncation: probe one byte past the read
+  bool overflow = fgetc(f) != EOF;
+  fclose(f);
+  if (overflow) return -1;
+  buf[n] = '\0';
+  return static_cast<int>(n);
+}
+
+// Remove persisted partition state (factory reset). 0 on success.
+int tpu_clear_partition() {
+  if (unlink(state_path().c_str()) != 0 && errno != ENOENT) return -1;
+  return 0;
+}
+
+}  // extern "C"

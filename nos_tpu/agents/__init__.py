@@ -1,0 +1,6 @@
+"""Node agents (analog of reference internal/controllers/migagent + gpuagent
+and cmd/migagent, cmd/gpuagent): the tpuagent reporter/actuator pair over the
+C++ native device layer."""
+from nos_tpu.agents.tpu_native import TpuNativeClient, MockTpuClient, load_native  # noqa: F401
+from nos_tpu.agents.plan import PartitionConfigPlan, BoardState  # noqa: F401
+from nos_tpu.agents.tpuagent import TpuAgent, SharedState  # noqa: F401

@@ -1,0 +1,76 @@
+"""Partition-config plan differ.
+
+Analog of reference internal/controllers/migagent/plan/ (NewMigConfigPlan,
+plan.go:31-92; MigState, mig_state.go:42-66; ops, operation.go). The TPU
+actuation path is declarative (whole-board geometry apply), so ops exist for
+observability and validation rather than sequencing: the differ still
+computes per-(board, profile) create/delete quantity deltas, refuses to
+delete used slices (the invariant the reference enforces by preferring free
+delete candidates, plan.go:113-135), and reports whether desired already
+matches actual.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List
+
+from nos_tpu.tpu.slice import Geometry, Profile
+
+
+@dataclass
+class BoardState:
+    """Actual state of one board: full geometry + the used subset."""
+
+    geometry: Geometry = field(default_factory=dict)
+    used: Dict[Profile, int] = field(default_factory=dict)
+
+
+@dataclass(frozen=True)
+class Operation:
+    kind: str            # "create" | "delete"
+    board: int
+    profile: Profile
+    quantity: int
+
+
+@dataclass
+class PartitionConfigPlan:
+    """Diff of desired vs actual (reference NewMigConfigPlan)."""
+
+    desired: Dict[int, Geometry]
+    actual: Dict[int, BoardState]
+    ops: List[Operation] = field(default_factory=list)
+    errors: List[str] = field(default_factory=list)
+
+    def __post_init__(self):
+        boards = set(self.desired) | set(self.actual)
+        for board in sorted(boards):
+            want = {p: q for p, q in self.desired.get(board, {}).items() if q > 0}
+            state = self.actual.get(board, BoardState())
+            have = {p: q for p, q in state.geometry.items() if q > 0}
+            for profile in sorted(set(want) | set(have)):
+                delta = want.get(profile, 0) - have.get(profile, 0)
+                if delta > 0:
+                    self.ops.append(Operation("create", board, profile, delta))
+                elif delta < 0:
+                    deletable = have.get(profile, 0) - state.used.get(profile, 0)
+                    if deletable < -delta:
+                        self.errors.append(
+                            f"board {board}: cannot delete {-delta}x{profile} "
+                            f"(only {deletable} free)"
+                        )
+                    self.ops.append(Operation("delete", board, profile, -delta))
+
+    def is_empty(self) -> bool:
+        return not self.ops
+
+    def is_valid(self) -> bool:
+        """False if any delete would destroy used slices."""
+        return not self.errors
+
+    def summary(self) -> str:
+        if self.is_empty():
+            return "no-op"
+        return ", ".join(
+            f"{op.kind} {op.quantity}x{op.profile}@board{op.board}" for op in self.ops
+        )

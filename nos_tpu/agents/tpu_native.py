@@ -1,0 +1,219 @@
+"""ctypes shim over the C++ tpuagent native library.
+
+Analog of the reference's go-nvml binding layer (pkg/gpu/nvml/client.go
+wraps libnvidia-ml via cgo; here tpu_native wraps native/tpuagent via
+ctypes). The shim:
+
+- builds ``libtpuagent.so`` on demand with g++ (cached beside the source),
+- exposes a typed ``TpuNativeClient``,
+- provides ``MockTpuClient`` with identical surface for tests and non-TPU
+  hosts (the reference always mocks NVML in tests — SURVEY §4).
+
+Partition state is an opaque JSON document
+``{"boards": {"0": {"1x1": 4, "2x2": 1}}, "plan": "<id>"}`` persisted
+atomically by the native layer.
+"""
+from __future__ import annotations
+
+import ctypes
+import json
+import logging
+import os
+import subprocess
+from dataclasses import dataclass, field
+from typing import Dict, Optional
+
+from nos_tpu.tpu.slice import Geometry, parse_profile
+
+logger = logging.getLogger(__name__)
+
+_NATIVE_DIR = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))),
+    "native",
+    "tpuagent",
+)
+_SO_PATH = os.path.join(_NATIVE_DIR, "libtpuagent.so")
+_SRC_PATH = os.path.join(_NATIVE_DIR, "tpuagent.cc")
+
+_BUF_LEN = 65536
+
+
+def _build_native() -> Optional[str]:
+    if os.path.exists(_SO_PATH) and (
+        not os.path.exists(_SRC_PATH)
+        or os.path.getmtime(_SO_PATH) >= os.path.getmtime(_SRC_PATH)
+    ):
+        return _SO_PATH
+    if not os.path.exists(_SRC_PATH):
+        return None
+    try:
+        subprocess.run(
+            ["g++", "-O2", "-fPIC", "-Wall", "-std=c++17", "-shared",
+             "-o", _SO_PATH, _SRC_PATH],
+            check=True,
+            capture_output=True,
+            timeout=120,
+        )
+        return _SO_PATH
+    except (subprocess.SubprocessError, FileNotFoundError) as e:
+        logger.warning("could not build tpuagent native library: %s", e)
+        return None
+
+
+def load_native() -> Optional[ctypes.CDLL]:
+    """Build (if needed) and load the native library; None if unavailable."""
+    path = _build_native()
+    if path is None:
+        return None
+    try:
+        lib = ctypes.CDLL(path)
+    except OSError as e:
+        logger.warning("could not load %s: %s", path, e)
+        return None
+    lib.tpu_chip_count.restype = ctypes.c_int
+    lib.tpu_chip_healthy.argtypes = [ctypes.c_int]
+    lib.tpu_chip_healthy.restype = ctypes.c_int
+    lib.tpu_metadata.argtypes = [ctypes.c_char_p, ctypes.c_char_p, ctypes.c_int]
+    lib.tpu_metadata.restype = ctypes.c_int
+    lib.tpu_apply_partition.argtypes = [ctypes.c_char_p]
+    lib.tpu_apply_partition.restype = ctypes.c_int
+    lib.tpu_read_partition.argtypes = [ctypes.c_char_p, ctypes.c_int]
+    lib.tpu_read_partition.restype = ctypes.c_int
+    lib.tpu_clear_partition.restype = ctypes.c_int
+    return lib
+
+
+class TpuClientError(RuntimeError):
+    pass
+
+
+class TpuNativeClient:
+    """Typed client over the native layer (reference nvml.Client analog,
+    pkg/gpu/nvml/interface.go)."""
+
+    def __init__(self, lib: Optional[ctypes.CDLL] = None):
+        self.lib = lib or load_native()
+        if self.lib is None:
+            raise TpuClientError("tpuagent native library unavailable")
+
+    # -- discovery / metadata ----------------------------------------------
+    def chip_count(self) -> int:
+        return int(self.lib.tpu_chip_count())
+
+    def chip_healthy(self, chip: int) -> bool:
+        return bool(self.lib.tpu_chip_healthy(chip))
+
+    def metadata(self, key: str) -> Optional[str]:
+        buf = ctypes.create_string_buffer(_BUF_LEN)
+        n = self.lib.tpu_metadata(key.encode(), buf, _BUF_LEN)
+        if n < 0:
+            return None
+        return buf.value.decode()
+
+    def accelerator_type(self) -> Optional[str]:
+        return self.metadata("ACCELERATOR_TYPE") or self.metadata("accelerator-type")
+
+    def topology(self) -> Optional[str]:
+        return self.metadata("TPU_TOPOLOGY") or self.metadata("topology")
+
+    def worker_id(self) -> int:
+        v = self.metadata("WORKER_ID") or self.metadata("agent-worker-number")
+        try:
+            return int(v) if v is not None else 0
+        except ValueError:
+            return 0
+
+    # -- partition state ----------------------------------------------------
+    def apply_partition(self, boards: Dict[int, Geometry], plan_id: str) -> None:
+        payload = json.dumps(
+            {
+                "plan": plan_id,
+                "boards": {
+                    str(i): {str(p): q for p, q in g.items() if q > 0}
+                    for i, g in boards.items()
+                },
+            },
+            sort_keys=True,
+        )
+        if self.lib.tpu_apply_partition(payload.encode()) != 0:
+            raise TpuClientError("tpu_apply_partition failed")
+
+    def read_partition(self) -> tuple[Dict[int, Geometry], str]:
+        buf = ctypes.create_string_buffer(_BUF_LEN)
+        n = self.lib.tpu_read_partition(buf, _BUF_LEN)
+        if n < 0:
+            raise TpuClientError("tpu_read_partition failed")
+        raw = buf.value.decode()
+        if not raw:
+            return {}, ""
+        return _decode_partition(raw)
+
+    def clear_partition(self) -> None:
+        if self.lib.tpu_clear_partition() != 0:
+            raise TpuClientError("tpu_clear_partition failed")
+
+
+def _decode_partition(raw: str) -> tuple[Dict[int, Geometry], str]:
+    try:
+        doc = json.loads(raw)
+    except json.JSONDecodeError as e:
+        raise TpuClientError(f"corrupt partition state: {e}") from e
+    boards: Dict[int, Geometry] = {}
+    for idx, geometry in (doc.get("boards") or {}).items():
+        g: Geometry = {}
+        for name, q in geometry.items():
+            try:
+                g[parse_profile(name)] = int(q)
+            except ValueError:
+                continue
+        boards[int(idx)] = g
+    return boards, str(doc.get("plan", ""))
+
+
+@dataclass
+class MockTpuClient:
+    """In-memory double with the TpuNativeClient surface (the test/mock
+    boundary the reference keeps for NVML, pkg/test/mocks)."""
+
+    chips: int = 8
+    unhealthy: set = field(default_factory=set)
+    meta: Dict[str, str] = field(default_factory=dict)
+    _boards: Dict[int, Geometry] = field(default_factory=dict)
+    _plan: str = ""
+    apply_error: Optional[Exception] = None
+
+    def chip_count(self) -> int:
+        return self.chips
+
+    def chip_healthy(self, chip: int) -> bool:
+        return 0 <= chip < self.chips and chip not in self.unhealthy
+
+    def metadata(self, key: str) -> Optional[str]:
+        return self.meta.get(key)
+
+    def accelerator_type(self) -> Optional[str]:
+        return self.meta.get("ACCELERATOR_TYPE")
+
+    def topology(self) -> Optional[str]:
+        return self.meta.get("TPU_TOPOLOGY")
+
+    def worker_id(self) -> int:
+        return int(self.meta.get("WORKER_ID", "0"))
+
+    def apply_partition(self, boards: Dict[int, Geometry], plan_id: str) -> None:
+        if self.apply_error is not None:
+            raise self.apply_error
+        self._boards = {
+            i: {p: q for p, q in g.items() if q > 0} for i, g in boards.items()
+        }
+        self._plan = plan_id
+
+    def read_partition(self) -> tuple[Dict[int, Geometry], str]:
+        return (
+            {i: dict(g) for i, g in self._boards.items()},
+            self._plan,
+        )
+
+    def clear_partition(self) -> None:
+        self._boards = {}
+        self._plan = ""

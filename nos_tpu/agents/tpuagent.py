@@ -1,0 +1,258 @@
+"""tpuagent — the per-node daemon (reporter + actuator).
+
+Analog of reference internal/controllers/migagent (SURVEY §2.3, §3.3):
+
+- **Reporter** (reporter.go:54-127): periodically, and on node events, reads
+  the actual board partitioning from the native device layer, joins it with
+  used-slice counts (from the pods bound to this node — the stand-in for the
+  kubelet pod-resources gRPC socket, reference pkg/resource/lister.go), and
+  patches the node's status annotations + the reported-plan id. When
+  ``manage_allocatable`` is on (in-process clusters without a separate
+  device plugin) it also advertises the sub-slice resources in
+  node.status.allocatable — the role the GKE TPU device plugin plays in
+  production.
+- **Actuator** (actuator.go:71-201): watches its own node's spec
+  annotations; when spec != status, computes a PartitionConfigPlan, refuses
+  to delete used slices, applies the desired geometry declaratively through
+  the native layer, and wakes the reporter.
+- **SharedState** (shared.go:24-56): the mutex+flag handshake ensuring a
+  plan is re-reported before being re-applied.
+
+Startup cleanup (cmd/migagent/migagent.go:190-199 analog): on start the
+agent reconciles persisted partition state against the node's spec — stale
+state from a previous incarnation is re-reported rather than wiped, keeping
+restart resumable.
+"""
+from __future__ import annotations
+
+import logging
+import threading
+from dataclasses import dataclass
+from typing import Dict, Optional
+
+from nos_tpu import constants
+from nos_tpu.kube.apiserver import NotFound
+from nos_tpu.kube.client import Client
+from nos_tpu.kube.controller import Controller, Request, Result, Watch
+from nos_tpu.kube import predicates
+from nos_tpu.kube.objects import Node
+from nos_tpu.agents.plan import BoardState, PartitionConfigPlan
+from nos_tpu.tpu import annotation as ann
+from nos_tpu.tpu.slice import Geometry, Profile, is_slice_resource, parse_profile
+
+logger = logging.getLogger(__name__)
+
+
+class SharedState:
+    """Reporter/actuator handshake (reference migagent/shared.go)."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._report_since_apply = True
+
+    def mark_applied(self) -> None:
+        with self._lock:
+            self._report_since_apply = False
+
+    def mark_reported(self) -> None:
+        with self._lock:
+            self._report_since_apply = True
+
+    def at_least_one_report_since_last_apply(self) -> bool:
+        with self._lock:
+            return self._report_since_apply
+
+
+def used_slices_from_bound_pods(client: Client, node_name: str) -> Dict[Profile, int]:
+    """Used sub-slices = sum of slice requests of pods bound to this node
+    (the in-process analog of GetUsedDevices over pod-resources)."""
+    used: Dict[Profile, int] = {}
+    for pod in client.list("Pod"):
+        if pod.spec.node_name != node_name:
+            continue
+        if pod.status.phase not in ("Pending", "Running"):
+            continue
+        for r, q in pod.request().items():
+            if is_slice_resource(r) and q > 0:
+                p = parse_profile(r)
+                used[p] = used.get(p, 0) + int(q)
+    return used
+
+
+class TpuAgent:
+    def __init__(
+        self,
+        node_name: str,
+        tpu_client,
+        report_interval_s: Optional[float] = constants.DEFAULT_REPORT_INTERVAL_S,
+        manage_allocatable: bool = True,
+    ):
+        self.node_name = node_name
+        self.tpu = tpu_client
+        # None = event-driven only (tests / deterministic pumps); a float
+        # adds the reference's periodic re-report (migagent default 10s)
+        self.report_interval_s = report_interval_s
+        self.manage_allocatable = manage_allocatable
+        self.shared = SharedState()
+
+    def _report_result(self) -> Result:
+        if self.report_interval_s is None:
+            return Result()
+        return Result(requeue_after=self.report_interval_s)
+
+    # ------------------------------------------------------------------
+    # Reporter
+    # ------------------------------------------------------------------
+    def report(self, client: Client, req: Request) -> Result:
+        try:
+            node = client.get("Node", self.node_name)
+        except NotFound:
+            return self._report_result()
+
+        boards, applied_plan = self.tpu.read_partition()
+        used = used_slices_from_bound_pods(client, self.node_name)
+
+        status_annotations: Dict[str, str] = {}
+        allocatable_slices: Dict[str, int] = {}
+        remaining_used = dict(used)
+        for board_idx, geometry in sorted(boards.items()):
+            for profile, total in sorted(geometry.items(), key=lambda kv: str(kv[0])):
+                u = min(remaining_used.get(profile, 0), total)
+                if u:
+                    remaining_used[profile] -= u
+                free = total - u
+                prefix = f"{constants.ANNOTATION_STATUS_PREFIX}{board_idx}-{profile}"
+                if free > 0:
+                    status_annotations[f"{prefix}-free"] = str(free)
+                if u > 0:
+                    status_annotations[f"{prefix}-used"] = str(u)
+                allocatable_slices[profile.resource_name] = (
+                    allocatable_slices.get(profile.resource_name, 0) + total
+                )
+
+        def mutate(n: Node):
+            anns = {
+                k: v
+                for k, v in n.metadata.annotations.items()
+                if not k.startswith(constants.ANNOTATION_STATUS_PREFIX)
+            }
+            anns.update(status_annotations)
+            if applied_plan:
+                anns[constants.ANNOTATION_REPORTED_PARTITIONING_PLAN] = applied_plan
+            n.metadata.annotations = anns
+            if self.manage_allocatable:
+                alloc = {
+                    k: v
+                    for k, v in n.status.allocatable.items()
+                    if not k.startswith(constants.RESOURCE_TPU_SLICE_PREFIX)
+                }
+                if boards:
+                    # partitioned: sub-slices replace whole-chip resource
+                    alloc.pop(constants.RESOURCE_TPU, None)
+                    alloc.update(allocatable_slices)
+                n.status.allocatable = alloc
+
+        client.patch("Node", self.node_name, "", mutate)
+        self.shared.mark_reported()
+        return self._report_result()
+
+    # ------------------------------------------------------------------
+    # Actuator
+    # ------------------------------------------------------------------
+    def actuate(self, client: Client, req: Request) -> Result:
+        if not self.shared.at_least_one_report_since_last_apply():
+            # wait for the reporter to observe the previous apply
+            return Result(requeue_after=0.5)
+        try:
+            node = client.get("Node", self.node_name)
+        except NotFound:
+            return Result()
+
+        specs, statuses = ann.parse_node_annotations(node.metadata.annotations)
+        if not specs:
+            return Result()
+        plan_id = node.metadata.annotations.get(
+            constants.ANNOTATION_PARTITIONING_PLAN, ""
+        )
+        reported_plan = node.metadata.annotations.get(
+            constants.ANNOTATION_REPORTED_PARTITIONING_PLAN, ""
+        )
+        if ann.spec_matches_status(specs, statuses) and plan_id == reported_plan:
+            return Result()
+
+        desired = ann.spec_from_annotations(specs)
+        actual_boards, _ = self.tpu.read_partition()
+        used = used_slices_from_bound_pods(client, self.node_name)
+        actual: Dict[int, BoardState] = {}
+        remaining_used = dict(used)
+        for board_idx, geometry in actual_boards.items():
+            board_used: Dict[Profile, int] = {}
+            for profile, total in geometry.items():
+                u = min(remaining_used.get(profile, 0), total)
+                if u:
+                    board_used[profile] = u
+                    remaining_used[profile] -= u
+            actual[board_idx] = BoardState(geometry=geometry, used=board_used)
+
+        plan = PartitionConfigPlan(desired, actual)
+        if plan.is_empty():
+            # geometry already right; just (re)report the plan id
+            self.tpu.apply_partition(actual_boards or desired, plan_id)
+            self.shared.mark_applied()
+            return Result()
+        if not plan.is_valid():
+            logger.error(
+                "tpuagent %s: refusing plan %s: %s",
+                self.node_name, plan_id, "; ".join(plan.errors),
+            )
+            return Result()
+        logger.info("tpuagent %s: applying %s (%s)", self.node_name, plan_id, plan.summary())
+        self.tpu.apply_partition(desired, plan_id)
+        self.shared.mark_applied()
+        return Result()
+
+    # ------------------------------------------------------------------
+    def controllers(self) -> list[Controller]:
+        own_node = predicates.matching_name(self.node_name)
+        reporter = Controller(
+            "tpuagent-reporter",
+            self.report,
+            [
+                Watch(
+                    "Node",
+                    predicate=predicates.all_of(own_node, predicates.exclude_delete),
+                ),
+                # pod churn on this node changes used counts
+                Watch("Pod", mapper=lambda ev: (
+                    [Request(name=self.node_name)]
+                    if ev.obj.spec.node_name == self.node_name
+                    else []
+                )),
+            ],
+        )
+        actuator = Controller(
+            "tpuagent-actuator",
+            self.actuate,
+            [
+                Watch(
+                    "Node",
+                    predicate=predicates.all_of(
+                        own_node,
+                        predicates.exclude_delete,
+                        predicates.annotations_changed,
+                    ),
+                ),
+            ],
+        )
+        return [actuator, reporter]
+
+    # -- startup (cmd/migagent initAgent analog) ---------------------------
+    def startup_cleanup(self, client: Client) -> None:
+        """Re-sync persisted partition state on start: nothing is deleted
+        (used slices may exist); the reporter will re-publish reality."""
+        boards, plan = self.tpu.read_partition()
+        if boards:
+            logger.info(
+                "tpuagent %s: resuming with persisted partition (plan %s)",
+                self.node_name, plan or "<none>",
+            )

@@ -1,0 +1,1 @@
+"""JAX workload models for the benchmark demo and gang-scheduling examples."""

@@ -1,0 +1,1 @@
+"""JAX/Pallas ops used by the workload models."""

@@ -1,0 +1,1 @@
+"""Parallelism layout math + JAX mesh builders (workload plane)."""

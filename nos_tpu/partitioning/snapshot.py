@@ -77,7 +77,7 @@ class ClusterSnapshot:
         return [
             sn
             for _, sn in sorted(self._nodes.items())
-            if sn.tpu_node.has_free_capacity() or any(b.free for b in sn.tpu_node.boards)
+            if sn.tpu_node.has_free_capacity()
         ]
 
     def framework_snapshot(self) -> fw.Snapshot:

@@ -18,7 +18,7 @@ from nos_tpu import constants
 from nos_tpu.kube.apiserver import NotFound
 from nos_tpu.kube.client import Client
 from nos_tpu.kube.controller import Controller, Request, Result, Watch
-from nos_tpu.kube.objects import Pod, PodCondition
+from nos_tpu.kube.objects import Pod, PodCondition, deep_copy
 from nos_tpu.scheduler import framework as fw
 from nos_tpu.scheduler.capacity import CapacityScheduling
 from nos_tpu.tpu.resource_calc import ResourceCalculator
@@ -63,16 +63,17 @@ class Scheduler:
         if req.name == "*":
             # sweep: capacity may have been freed (pod deleted / node added /
             # quota changed) — re-run every pending pod of this scheduler
+            # against ONE shared state sync (the snapshot is updated in
+            # place after each bind, so later pods see earlier placements)
             result = Result()
+            snapshot = self._sync_state(client)
             for pod in client.list("Pod"):
                 if (
                     pod.spec.scheduler_name == self.scheduler_name
                     and not pod.spec.node_name
                     and pod.status.phase == "Pending"
                 ):
-                    r = self.reconcile(
-                        client, Request(pod.metadata.name, pod.metadata.namespace)
-                    )
+                    r = self._schedule_one(client, pod, snapshot)
                     result.requeue = result.requeue or r.requeue
             return result
         try:
@@ -83,8 +84,9 @@ class Scheduler:
             return Result()
         if pod.spec.node_name or pod.status.phase != "Pending":
             return Result()
+        return self._schedule_one(client, pod, self._sync_state(client))
 
-        snapshot = self._sync_state(client)
+    def _schedule_one(self, client: Client, pod: Pod, snapshot: fw.Snapshot) -> Result:
         state: fw.CycleState = {}
 
         st = self.framework.run_pre_filter(state, pod, snapshot)
@@ -118,6 +120,10 @@ class Scheduler:
             ] + [PodCondition(type="PodScheduled", status="True")]
 
         client.patch("Pod", pod.metadata.name, pod.metadata.namespace, bind)
+        # keep the shared sweep snapshot truthful for subsequent pods
+        bound = deep_copy(pod)
+        bound.spec.node_name = node_name
+        snapshot[node_name].add_pod(bound)
         logger.info("scheduled %s/%s -> %s", pod.metadata.namespace, pod.metadata.name, node_name)
         return Result()
 

@@ -84,12 +84,16 @@ class TpuNode:
         for board in self.boards:
             if not remaining:
                 break
+            free_before = dict(board.free)
             if board.update_geometry_for(remaining):
                 changed = True
             for p in list(remaining.keys()):
-                served = board.free.get(p, 0)
-                if served:
-                    remaining[p] = remaining[p] - served
+                # only newly created slices count against `remaining`:
+                # pre-existing free slices were already netted out of the
+                # cluster-wide lacking computation
+                newly = board.free.get(p, 0) - free_before.get(p, 0)
+                if newly > 0:
+                    remaining[p] -= newly
                     if remaining[p] <= 0:
                         del remaining[p]
         return changed

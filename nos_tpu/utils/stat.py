@@ -2,8 +2,9 @@
 
 The reference uses permutation iteration when actuating MIG geometry because
 NVML profile-creation order matters (pkg/gpu/nvml/client.go:225-340). The TPU
-actuation path is declarative, but the planner still uses permutations when
-searching small geometry orderings, and tests exercise the iterator directly.
+actuation path is declarative (order-independent), so nothing in the control
+plane needs this at runtime — it is kept for utility-plane parity with
+reference pkg/util/stat.go and exercised by tests.
 """
 from __future__ import annotations
 

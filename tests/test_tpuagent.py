@@ -1,0 +1,253 @@
+"""tpuagent: plan differ, native layer (real C++ build), reporter/actuator
+(model: reference migagent plan_test.go 617 LoC + reporter/actuator int
+tests)."""
+import json
+import os
+
+import pytest
+
+from nos_tpu import constants
+from nos_tpu.agents.plan import BoardState, PartitionConfigPlan
+from nos_tpu.agents.tpu_native import MockTpuClient, TpuNativeClient, load_native
+from nos_tpu.agents.tpuagent import TpuAgent
+from nos_tpu.kube import ApiServer, Manager
+from nos_tpu.kube.objects import (
+    Container,
+    Node,
+    NodeStatus,
+    ObjectMeta,
+    Pod,
+    PodSpec,
+    PodStatus,
+)
+from nos_tpu.tpu.slice import Profile
+
+P11, P22, P24 = Profile(1, 1), Profile(2, 2), Profile(2, 4)
+
+
+# ---------------------------------------------------------------------------
+# plan differ
+# ---------------------------------------------------------------------------
+
+def test_plan_noop_when_equal():
+    plan = PartitionConfigPlan(
+        desired={0: {P11: 4, P22: 1}},
+        actual={0: BoardState(geometry={P11: 4, P22: 1})},
+    )
+    assert plan.is_empty() and plan.is_valid()
+    assert plan.summary() == "no-op"
+
+
+def test_plan_creates_and_deletes():
+    plan = PartitionConfigPlan(
+        desired={0: {P11: 8}},
+        actual={0: BoardState(geometry={P24: 1})},
+    )
+    kinds = {(op.kind, op.profile, op.quantity) for op in plan.ops}
+    assert ("create", P11, 8) in kinds
+    assert ("delete", P24, 1) in kinds
+    assert plan.is_valid()
+
+
+def test_plan_refuses_to_delete_used():
+    plan = PartitionConfigPlan(
+        desired={0: {P11: 8}},
+        actual={0: BoardState(geometry={P22: 2}, used={P22: 1})},
+    )
+    assert not plan.is_valid()
+    assert "cannot delete" in plan.errors[0]
+
+
+def test_plan_partial_delete_of_free_is_valid():
+    plan = PartitionConfigPlan(
+        desired={0: {P22: 1, P11: 4}},
+        actual={0: BoardState(geometry={P22: 2}, used={P22: 1})},
+    )
+    assert plan.is_valid()
+
+
+def test_plan_zero_quantities_ignored():
+    plan = PartitionConfigPlan(
+        desired={0: {P11: 0, P24: 1}},
+        actual={0: BoardState(geometry={P24: 1})},
+    )
+    assert plan.is_empty()
+
+
+# ---------------------------------------------------------------------------
+# native layer (builds the real C++ library)
+# ---------------------------------------------------------------------------
+
+@pytest.fixture
+def native(tmp_path, monkeypatch):
+    lib = load_native()
+    if lib is None:
+        pytest.skip("native toolchain unavailable")
+    monkeypatch.setenv("NOS_TPU_STATE_FILE", str(tmp_path / "partition.json"))
+    monkeypatch.setenv("NOS_TPU_CHIP_COUNT", "8")
+    return TpuNativeClient(lib)
+
+
+def test_native_chip_count_and_health(native, monkeypatch):
+    assert native.chip_count() == 8
+    assert native.chip_healthy(0)
+    assert native.chip_healthy(7)
+    assert not native.chip_healthy(8)
+    assert not native.chip_healthy(-1)
+    monkeypatch.setenv("NOS_TPU_UNHEALTHY_CHIPS", "2,5")
+    assert not native.chip_healthy(2)
+    assert not native.chip_healthy(5)
+    assert native.chip_healthy(3)
+
+
+def test_native_metadata_env_and_file(native, monkeypatch, tmp_path):
+    monkeypatch.setenv("NOS_TPU_META_ACCELERATOR_TYPE", "v5litepod-8")
+    assert native.metadata("accelerator-type") == "v5litepod-8"
+    assert native.accelerator_type() == "v5litepod-8"
+    env_file = tmp_path / "tpu-env"
+    env_file.write_text("TPU_TOPOLOGY = '2x4'\nWORKER_ID=3\n")
+    monkeypatch.setenv("NOS_TPU_ENV_FILE", str(env_file))
+    assert native.metadata("TPU_TOPOLOGY") == "2x4"
+    assert native.worker_id() == 3
+    assert native.metadata("missing-key") is None
+
+
+def test_native_partition_roundtrip(native):
+    boards = {0: {P11: 4, P22: 1}}
+    native.apply_partition(boards, "plan-7")
+    got, plan = native.read_partition()
+    assert got == boards
+    assert plan == "plan-7"
+    native.clear_partition()
+    got, plan = native.read_partition()
+    assert got == {} and plan == ""
+
+
+def test_native_partition_survives_reload(native, tmp_path):
+    native.apply_partition({0: {P24: 1}}, "p1")
+    fresh = TpuNativeClient(load_native())
+    got, plan = fresh.read_partition()
+    assert got == {0: {P24: 1}} and plan == "p1"
+
+
+def test_native_partition_atomic_file(native, tmp_path):
+    native.apply_partition({0: {P11: 8}}, "p2")
+    raw = json.loads((tmp_path / "partition.json").read_text())
+    assert raw["plan"] == "p2"
+    assert raw["boards"]["0"]["1x1"] == 8
+    assert not os.path.exists(tmp_path / "partition.json.tmp")
+
+
+# ---------------------------------------------------------------------------
+# agent reporter/actuator against the API server
+# ---------------------------------------------------------------------------
+
+def v5e_node(name="v5e-0", annotations=None):
+    return Node(
+        metadata=ObjectMeta(
+            name=name,
+            labels={
+                constants.LABEL_TPU_ACCELERATOR: "tpu-v5-lite-podslice",
+                constants.LABEL_TPU_TOPOLOGY: "2x4",
+                constants.LABEL_PARTITIONING: constants.PARTITIONING_SUBSLICING,
+            },
+            annotations=annotations or {},
+        ),
+        status=NodeStatus(capacity={"cpu": 96}, allocatable={"cpu": 96}),
+    )
+
+
+def agent_rig(annotations=None, mock=None):
+    server = ApiServer()
+    mgr = Manager(server)
+    tpu = mock or MockTpuClient(chips=8)
+    agent = TpuAgent("v5e-0", tpu, report_interval_s=None)
+    for c in agent.controllers():
+        mgr.add_controller(c)
+    server.create(v5e_node(annotations=annotations))
+    return server, mgr, tpu, agent
+
+
+def test_actuator_applies_spec_and_reporter_reports():
+    server, mgr, tpu, agent = agent_rig(annotations={
+        "nos.ai/spec-tpu-0-1x1": "4",
+        "nos.ai/spec-tpu-0-2x2": "1",
+        constants.ANNOTATION_PARTITIONING_PLAN: "plan-1",
+    })
+    mgr.run_until_idle()
+    boards, plan = tpu.read_partition()
+    assert boards == {0: {P11: 4, P22: 1}}
+    assert plan == "plan-1"
+    node = server.get("Node", "v5e-0")
+    assert node.metadata.annotations["nos.ai/status-tpu-0-1x1-free"] == "4"
+    assert node.metadata.annotations["nos.ai/status-tpu-0-2x2-free"] == "1"
+    assert node.metadata.annotations[constants.ANNOTATION_REPORTED_PARTITIONING_PLAN] == "plan-1"
+    assert node.status.allocatable["nos.ai/tpu-slice-1x1"] == 4
+
+
+def test_reporter_counts_used_slices_from_bound_pods():
+    server, mgr, tpu, agent = agent_rig(annotations={
+        "nos.ai/spec-tpu-0-1x1": "4",
+        constants.ANNOTATION_PARTITIONING_PLAN: "p1",
+    })
+    mgr.run_until_idle()
+    server.create(Pod(
+        metadata=ObjectMeta(name="user", namespace="team-a"),
+        spec=PodSpec(containers=[Container(requests={"nos.ai/tpu-slice-1x1": 2})],
+                     node_name="v5e-0"),
+        status=PodStatus(phase="Running"),
+    ))
+    mgr.run_until_idle()
+    node = server.get("Node", "v5e-0")
+    assert node.metadata.annotations["nos.ai/status-tpu-0-1x1-used"] == "2"
+    assert node.metadata.annotations["nos.ai/status-tpu-0-1x1-free"] == "2"
+
+
+def test_actuator_refuses_to_destroy_used_slices():
+    server, mgr, tpu, agent = agent_rig(annotations={
+        "nos.ai/spec-tpu-0-2x2": "2",
+        constants.ANNOTATION_PARTITIONING_PLAN: "p1",
+    })
+    mgr.run_until_idle()
+    # a pod uses one 2x2 slice
+    server.create(Pod(
+        metadata=ObjectMeta(name="user", namespace="team-a"),
+        spec=PodSpec(containers=[Container(requests={"nos.ai/tpu-slice-2x2": 1})],
+                     node_name="v5e-0"),
+        status=PodStatus(phase="Running"),
+    ))
+    mgr.run_until_idle()
+    # a hostile plan wants to wipe the board to 8x1x1
+    def bad_spec(n):
+        n.metadata.annotations.pop("nos.ai/spec-tpu-0-2x2")
+        n.metadata.annotations["nos.ai/spec-tpu-0-1x1"] = "8"
+        n.metadata.annotations[constants.ANNOTATION_PARTITIONING_PLAN] = "p2"
+    server.patch("Node", "v5e-0", "", bad_spec)
+    mgr.run_until_idle()
+    boards, plan = tpu.read_partition()
+    assert boards == {0: {P22: 2}}     # untouched
+    assert plan == "p1"
+
+
+def test_agent_ignores_other_nodes():
+    server, mgr, tpu, agent = agent_rig()
+    other = v5e_node("other-node", annotations={
+        "nos.ai/spec-tpu-0-1x1": "8",
+        constants.ANNOTATION_PARTITIONING_PLAN: "px",
+    })
+    server.create(other)
+    mgr.run_until_idle()
+    boards, _ = tpu.read_partition()
+    assert boards == {}               # agent only acts on its own node
+
+
+def test_agent_startup_resume_from_persisted_state():
+    tpu = MockTpuClient(chips=8)
+    tpu.apply_partition({0: {P24: 1}}, "old-plan")
+    server, mgr, tpu, agent = agent_rig(mock=tpu)
+    agent.startup_cleanup(Manager(server).client)
+    mgr.run_until_idle()
+    node = server.get("Node", "v5e-0")
+    # reporter re-published reality from persisted state
+    assert node.metadata.annotations["nos.ai/status-tpu-0-2x4-free"] == "1"
+    assert node.metadata.annotations[constants.ANNOTATION_REPORTED_PARTITIONING_PLAN] == "old-plan"
