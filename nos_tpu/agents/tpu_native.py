@@ -160,13 +160,17 @@ def _decode_partition(raw: str) -> tuple[Dict[int, Geometry], str]:
         raise TpuClientError(f"corrupt partition state: {e}") from e
     boards: Dict[int, Geometry] = {}
     for idx, geometry in (doc.get("boards") or {}).items():
+        try:
+            board_idx = int(idx)
+        except (TypeError, ValueError) as e:
+            raise TpuClientError(f"corrupt partition state: board key {idx!r}") from e
         g: Geometry = {}
         for name, q in geometry.items():
             try:
                 g[parse_profile(name)] = int(q)
             except ValueError:
                 continue
-        boards[int(idx)] = g
+        boards[board_idx] = g
     return boards, str(doc.get("plan", ""))
 
 

@@ -1,0 +1,113 @@
+"""Vision Transformer (ViT-small) — the benchmark workload.
+
+The reference's only published benchmark runs YOLOS-small (a ViT-small
+detection variant, ~22M backbone params) under N pods sharing one GPU
+(demos/gpu-sharing-comparison/README.md; BASELINE.md). This is the same
+backbone scale as a TPU-first inference program: patchify as reshape +
+one projection matmul, encoder blocks of flash attention + GELU MLP, all
+bf16, static shapes.
+
+ViT-small/16: d=384, 12 layers, 6 heads, mlp 1536, patch 16, 224x224 input
+-> 196 tokens + cls.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+
+from nos_tpu.ops.attention import attention
+from nos_tpu.ops.layers import gelu_mlp, layer_norm, patchify
+
+Params = Dict[str, Any]
+
+
+@dataclass(frozen=True)
+class ViTConfig:
+    image_size: int = 224
+    patch: int = 16
+    d_model: int = 384
+    n_layers: int = 12
+    n_heads: int = 6
+    d_ff: int = 1536
+    n_classes: int = 1000
+    dtype: Any = jnp.bfloat16
+
+    @property
+    def n_patches(self) -> int:
+        return (self.image_size // self.patch) ** 2
+
+    @property
+    def head_dim(self) -> int:
+        return self.d_model // self.n_heads
+
+
+def init_params(rng: jax.Array, cfg: ViTConfig) -> Params:
+    keys = jax.random.split(rng, 4 + cfg.n_layers)
+
+    def dense(key, shape, fan_in):
+        return (jax.random.normal(key, shape, jnp.float32) * fan_in ** -0.5
+                ).astype(cfg.dtype)
+
+    patch_dim = cfg.patch * cfg.patch * 3
+
+    def block(key):
+        ks = jax.random.split(key, 4)
+        d, f = cfg.d_model, cfg.d_ff
+        return {
+            "ln1_scale": jnp.ones((d,), jnp.float32),
+            "ln1_bias": jnp.zeros((d,), jnp.float32),
+            "wqkv": dense(ks[0], (d, 3 * d), d),
+            "wo": dense(ks[1], (d, d), d),
+            "ln2_scale": jnp.ones((d,), jnp.float32),
+            "ln2_bias": jnp.zeros((d,), jnp.float32),
+            "w_in": dense(ks[2], (d, f), d),
+            "b_in": jnp.zeros((f,), cfg.dtype),
+            "w_out": dense(ks[3], (f, d), f),
+            "b_out": jnp.zeros((d,), cfg.dtype),
+        }
+
+    blocks = jax.tree.map(
+        lambda *xs: jnp.stack(xs), *[block(keys[4 + i]) for i in range(cfg.n_layers)]
+    )
+    return {
+        "patch_proj": dense(keys[0], (patch_dim, cfg.d_model), patch_dim),
+        "cls_token": jnp.zeros((1, 1, cfg.d_model), cfg.dtype),
+        "pos_embed": (jax.random.normal(keys[1], (1, cfg.n_patches + 1, cfg.d_model),
+                                        jnp.float32) * 0.02).astype(cfg.dtype),
+        "blocks": blocks,
+        "final_ln_scale": jnp.ones((cfg.d_model,), jnp.float32),
+        "final_ln_bias": jnp.zeros((cfg.d_model,), jnp.float32),
+        "head": dense(keys[2], (cfg.d_model, cfg.n_classes), cfg.d_model),
+    }
+
+
+def forward(params: Params, cfg: ViTConfig, images: jax.Array) -> jax.Array:
+    """images [B, H, W, 3] -> logits [B, n_classes]."""
+    b = images.shape[0]
+    x = patchify(images.astype(cfg.dtype), cfg.patch)
+    x = jnp.dot(x, params["patch_proj"])
+    cls = jnp.broadcast_to(params["cls_token"], (b, 1, cfg.d_model))
+    x = jnp.concatenate([cls, x], axis=1) + params["pos_embed"]
+    seq = x.shape[1]
+
+    def block_body(x, blk):
+        h = layer_norm(x, blk["ln1_scale"], blk["ln1_bias"])
+        qkv = jnp.dot(h, blk["wqkv"]).reshape(b, seq, 3, cfg.n_heads, cfg.head_dim)
+        q, k, v = (qkv[:, :, i].transpose(0, 2, 1, 3) for i in range(3))
+        o = attention(q, k, v, causal=False)
+        o = o.transpose(0, 2, 1, 3).reshape(b, seq, cfg.d_model)
+        x = x + jnp.dot(o, blk["wo"])
+        h = layer_norm(x, blk["ln2_scale"], blk["ln2_bias"])
+        x = x + gelu_mlp(h, blk["w_in"], blk["b_in"], blk["w_out"], blk["b_out"])
+        return x, None
+
+    x, _ = jax.lax.scan(block_body, x, params["blocks"])
+    x = layer_norm(x, params["final_ln_scale"], params["final_ln_bias"])
+    return jnp.dot(x[:, 0], params["head"]).astype(jnp.float32)
+
+
+def param_count(params: Params) -> int:
+    return sum(p.size for p in jax.tree.leaves(params))

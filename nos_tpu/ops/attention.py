@@ -1,0 +1,67 @@
+"""Attention dispatch: pallas TPU flash attention on the hot path, XLA
+reference elsewhere.
+
+The pallas kernel (jax.experimental.pallas.ops.tpu.flash_attention) keeps
+the softmax running statistics in VMEM and never materializes the [S, S]
+score matrix in HBM — the standard memory-bound win. The XLA fallback is
+used on CPU test meshes and for shapes the kernel doesn't support; both
+paths produce the same math (tested against each other).
+"""
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["attention", "xla_attention", "flash_attention_available"]
+
+
+@functools.cache
+def _pallas_flash():
+    try:
+        from jax.experimental.pallas.ops.tpu.flash_attention import (
+            flash_attention as fa,
+        )
+        return fa
+    except Exception:   # pragma: no cover - import surface varies by version
+        return None
+
+
+def flash_attention_available() -> bool:
+    return jax.default_backend() == "tpu" and _pallas_flash() is not None
+
+
+def xla_attention(
+    q: jax.Array, k: jax.Array, v: jax.Array, *, causal: bool = False,
+    scale: Optional[float] = None,
+) -> jax.Array:
+    """Reference attention. q,k,v: [B, H, S, D] -> [B, H, S, D]."""
+    d = q.shape[-1]
+    scale = scale if scale is not None else d ** -0.5
+    scores = jnp.einsum(
+        "bhqd,bhkd->bhqk", q, k, preferred_element_type=jnp.float32
+    ) * scale
+    if causal:
+        s_q, s_k = scores.shape[-2], scores.shape[-1]
+        mask = jnp.tril(jnp.ones((s_q, s_k), dtype=bool), s_k - s_q)
+        scores = jnp.where(mask, scores, jnp.finfo(jnp.float32).min)
+    probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
+    return jnp.einsum("bhqk,bhkd->bhqd", probs, v)
+
+
+def attention(
+    q: jax.Array, k: jax.Array, v: jax.Array, *, causal: bool = False,
+    scale: Optional[float] = None, force_xla: bool = False,
+) -> jax.Array:
+    """q,k,v: [B, H, S, D]. Uses the pallas TPU kernel when available and
+    the shape is kernel-friendly (S multiple of the block size), else XLA."""
+    if force_xla or not flash_attention_available():
+        return xla_attention(q, k, v, causal=causal, scale=scale)
+    s = q.shape[-2]
+    if s % 128 != 0 or q.shape[-1] % 128 != 0:
+        return xla_attention(q, k, v, causal=causal, scale=scale)
+    fa = _pallas_flash()
+    sm_scale = scale if scale is not None else q.shape[-1] ** -0.5
+    return fa(q, k, v, causal=causal, sm_scale=sm_scale)

@@ -1,0 +1,79 @@
+"""Core layer ops, TPU-first: bf16-friendly, fusable by XLA, static shapes.
+
+Pure functions over parameter pytrees (no framework classes) so the same
+code jits under any mesh/sharding.
+"""
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def rms_norm(x: jax.Array, weight: jax.Array, eps: float = 1e-6) -> jax.Array:
+    orig_dtype = x.dtype
+    x = x.astype(jnp.float32)
+    var = jnp.mean(jnp.square(x), axis=-1, keepdims=True)
+    x = x * jax.lax.rsqrt(var + eps)
+    return (x * weight).astype(orig_dtype)
+
+
+def layer_norm(x: jax.Array, scale: jax.Array, bias: jax.Array,
+               eps: float = 1e-6) -> jax.Array:
+    orig_dtype = x.dtype
+    x = x.astype(jnp.float32)
+    mean = jnp.mean(x, axis=-1, keepdims=True)
+    var = jnp.mean(jnp.square(x - mean), axis=-1, keepdims=True)
+    x = (x - mean) * jax.lax.rsqrt(var + eps)
+    return (x * scale + bias).astype(orig_dtype)
+
+
+def rope_frequencies(head_dim: int, max_len: int, theta: float = 10000.0) -> jax.Array:
+    """Precomputed complex rotation table [max_len, head_dim//2]."""
+    inv_freq = 1.0 / (theta ** (jnp.arange(0, head_dim, 2, dtype=jnp.float32) / head_dim))
+    t = jnp.arange(max_len, dtype=jnp.float32)
+    freqs = jnp.outer(t, inv_freq)
+    return jnp.exp(1j * freqs)
+
+
+def apply_rope(x: jax.Array, freqs: jax.Array,
+               positions: Optional[jax.Array] = None) -> jax.Array:
+    """x: [..., seq, heads, head_dim]; freqs: [max_len, head_dim//2]."""
+    orig_dtype = x.dtype
+    seq = x.shape[-3]
+    if positions is None:
+        rot = freqs[:seq]
+    else:
+        rot = freqs[positions]
+    xc = x.astype(jnp.float32).reshape(*x.shape[:-1], -1, 2)
+    xc = jax.lax.complex(xc[..., 0], xc[..., 1])
+    rot = rot[:, None, :]          # broadcast over heads
+    out = xc * rot
+    out = jnp.stack([jnp.real(out), jnp.imag(out)], axis=-1)
+    return out.reshape(x.shape).astype(orig_dtype)
+
+
+def swiglu(x: jax.Array, w_gate: jax.Array, w_up: jax.Array,
+           w_down: jax.Array) -> jax.Array:
+    """SwiGLU MLP: down( silu(x @ gate) * (x @ up) ). Three matmuls —
+    exactly the shape XLA fuses the elementwise ops into."""
+    gate = jax.nn.silu(jnp.dot(x, w_gate))
+    up = jnp.dot(x, w_up)
+    return jnp.dot(gate * up, w_down)
+
+
+def gelu_mlp(x: jax.Array, w_in: jax.Array, b_in: jax.Array,
+             w_out: jax.Array, b_out: jax.Array) -> jax.Array:
+    h = jax.nn.gelu(jnp.dot(x, w_in) + b_in, approximate=True)
+    return jnp.dot(h, w_out) + b_out
+
+
+def patchify(images: jax.Array, patch: int) -> jax.Array:
+    """[B, H, W, C] -> [B, (H/p)*(W/p), p*p*C] without convs: a reshape +
+    transpose XLA lowers to pure data movement, then the projection matmul
+    lands on the MXU."""
+    b, h, w, c = images.shape
+    x = images.reshape(b, h // patch, patch, w // patch, patch, c)
+    x = x.transpose(0, 1, 3, 2, 4, 5)
+    return x.reshape(b, (h // patch) * (w // patch), patch * patch * c)

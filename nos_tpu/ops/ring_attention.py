@@ -1,0 +1,108 @@
+"""Ring attention — exact attention over sequence-parallel shards.
+
+Long-context first-class support (task brief; no reference analog — nos has
+no model code, SURVEY §5): the sequence axis is sharded over the ``sp`` mesh
+axis; each device holds local Q/K/V blocks and the K/V blocks rotate around
+the ring with ``jax.lax.ppermute`` while flash-style online-softmax
+statistics (m, l, acc) accumulate locally. Compute overlaps the next block's
+transfer naturally under XLA's async collective scheduling on ICI.
+
+Math is exact (tested against full attention on a virtual 8-device mesh):
+block contributions merge via the standard log-sum-exp rescaling, and causal
+masking uses global positions so cross-block boundaries are correct.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def _block_attention(q, k, v, q_offset, kv_offset, causal, scale):
+    """One (q_local, kv_block) partial: returns (m, l, o) statistics.
+    q,k,v: [B, H, S, D]; offsets are global sequence starts."""
+    s_q, s_k = q.shape[-2], k.shape[-2]
+    scores = jnp.einsum(
+        "bhqd,bhkd->bhqk", q, k, preferred_element_type=jnp.float32
+    ) * scale
+    if causal:
+        q_pos = q_offset + jnp.arange(s_q)
+        k_pos = kv_offset + jnp.arange(s_k)
+        mask = q_pos[:, None] >= k_pos[None, :]
+        scores = jnp.where(mask, scores, -jnp.inf)
+    m = jnp.max(scores, axis=-1)                           # [B, H, Sq]
+    # fully-masked rows: keep m finite so exp() stays well-defined
+    m_safe = jnp.where(jnp.isfinite(m), m, 0.0)
+    p = jnp.exp(scores - m_safe[..., None])
+    p = jnp.where(jnp.isfinite(scores), p, 0.0)
+    l = jnp.sum(p, axis=-1)                                # [B, H, Sq]
+    o = jnp.einsum("bhqk,bhkd->bhqd", p.astype(v.dtype), v).astype(jnp.float32)
+    return m_safe, l, o
+
+
+def ring_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    axis_name: str,
+    causal: bool = False,
+    scale: Optional[float] = None,
+) -> jax.Array:
+    """Runs INSIDE shard_map: q,k,v are the local [B, H, S_local, D] shards
+    on the ``axis_name`` ring."""
+    scale = scale if scale is not None else q.shape[-1] ** -0.5
+    ring_size = jax.lax.axis_size(axis_name)
+    my_idx = jax.lax.axis_index(axis_name)
+    s_local = q.shape[-2]
+    q_offset = my_idx * s_local
+
+    perm = [(i, (i + 1) % ring_size) for i in range(ring_size)]
+
+    def step(i, carry):
+        k_blk, v_blk, m, l, acc = carry
+        # rotate first: at loop step i the device holds block (my_idx - i)
+        k_blk = jax.lax.ppermute(k_blk, axis_name, perm)
+        v_blk = jax.lax.ppermute(v_blk, axis_name, perm)
+        kv_idx = (my_idx - i) % ring_size
+        m_blk, l_blk, o_blk = _block_attention(
+            q, k_blk, v_blk, q_offset, kv_idx * s_local, causal, scale
+        )
+        m_new = jnp.maximum(m, m_blk)
+        alpha = jnp.exp(m - m_new)
+        beta = jnp.exp(m_blk - m_new)
+        l = alpha * l + beta * l_blk
+        acc = alpha[..., None] * acc + beta[..., None] * o_blk
+        return k_blk, v_blk, m_new, l, acc
+
+    # step 0 (the local block) runs outside the loop so the accumulator
+    # carries inherit their sharding/varying type from q/k/v directly
+    m, l, acc = _block_attention(q, k, v, q_offset, my_idx * s_local, causal, scale)
+    init = (k, v, m, l, acc)
+    _, _, m, l, acc = jax.lax.fori_loop(1, ring_size, step, init)
+    l = jnp.maximum(l, 1e-20)
+    return (acc / l[..., None]).astype(q.dtype)
+
+
+def ring_attention_sharded(
+    mesh: Mesh,
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    seq_axis: str = "sp",
+    causal: bool = False,
+) -> jax.Array:
+    """Convenience wrapper: shard [B, H, S, D] over ``seq_axis`` and run the
+    ring. For use outside an existing shard_map context."""
+    spec = P(None, None, seq_axis, None)
+    fn = jax.shard_map(
+        functools.partial(ring_attention, axis_name=seq_axis, causal=causal),
+        mesh=mesh,
+        in_specs=(spec, spec, spec),
+        out_specs=spec,
+    )
+    return fn(q, k, v)

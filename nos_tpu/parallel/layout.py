@@ -1,0 +1,95 @@
+"""Parallelism layouts and their mapping to TPU slice topologies.
+
+This is the bridge between the workload plane and the scheduler (SURVEY §2.7
+and §5 "long-context"): a training job's parallelism layout — data, fsdp,
+tensor, pipeline, sequence/context, expert axes — determines how many chips
+it needs and therefore which slice topology the gang scheduler must place.
+The reference has no analog (it schedules opaque pods); for TPUs the layout
+IS the scheduling contract: `required_topology` is what a JobSet's
+gang annotation carries.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Optional, Tuple
+
+from nos_tpu.tpu import topology
+from nos_tpu.tpu.topology import Generation, SliceTopology
+
+
+@dataclass(frozen=True)
+class ParallelLayout:
+    """Degrees of each parallelism axis. Total chips = product of all axes.
+
+    Axis naming follows the scaling-book convention:
+      dp    — pure data parallel (replicated params)
+      fsdp  — data parallel with sharded params/optimizer (zero-style)
+      tp    — tensor (model) parallel: activations sharded on features
+      pp    — pipeline parallel: layers partitioned into stages
+      sp    — sequence/context parallel (ring attention / all-to-all)
+      ep    — expert parallel (MoE experts spread over chips)
+    """
+
+    dp: int = 1
+    fsdp: int = 1
+    tp: int = 1
+    pp: int = 1
+    sp: int = 1
+    ep: int = 1
+
+    def __post_init__(self):
+        for name in ("dp", "fsdp", "tp", "pp", "sp", "ep"):
+            if getattr(self, name) < 1:
+                raise ValueError(f"{name} degree must be >= 1")
+
+    @property
+    def chips(self) -> int:
+        return self.dp * self.fsdp * self.tp * self.pp * self.sp * self.ep
+
+    def axis_names(self) -> Tuple[str, ...]:
+        return tuple(
+            n for n in ("dp", "fsdp", "tp", "pp", "sp", "ep")
+            if getattr(self, n) > 1
+        ) or ("dp",)
+
+    def axis_sizes(self) -> Tuple[int, ...]:
+        names = self.axis_names()
+        return tuple(getattr(self, n) for n in names)
+
+    # ------------------------------------------------------------------
+    def required_topology(self, generation: str) -> Optional[SliceTopology]:
+        """Smallest legal slice topology of ``generation`` with at least
+        ``chips`` chips. None if the layout exceeds every topology.
+
+        ICI-aware preference: among topologies with equal chip count the
+        table is already ordered smallest-first; an exact chip match is
+        preferred over overshoot.
+        """
+        best: Optional[SliceTopology] = None
+        for t in topology.slice_topologies(generation):
+            if t.chips < self.chips:
+                continue
+            if best is None or t.chips < best.chips:
+                best = t
+        return best
+
+    def hosts_required(self, generation: str) -> Optional[int]:
+        gen = topology.get_generation(generation)
+        topo = self.required_topology(generation)
+        if gen is None or topo is None:
+            return None
+        return gen.hosts_for(topo)
+
+
+def layout_for_chips(chips: int, *, prefer_tp_up_to: int = 8) -> ParallelLayout:
+    """A sensible default layout for a chip budget: tensor-parallel within a
+    host (ICI-cheap, up to ``prefer_tp_up_to``), data-parallel across the
+    rest. Used by examples and tests; real jobs specify their own layout."""
+    if chips < 1:
+        raise ValueError("chips must be >= 1")
+    tp = 1
+    for cand in (8, 4, 2, 1):
+        if cand <= prefer_tp_up_to and chips % cand == 0:
+            tp = cand
+            break
+    return ParallelLayout(dp=chips // tp, tp=tp)

@@ -1,0 +1,58 @@
+"""JAX device-mesh construction and sharding helpers for a ParallelLayout.
+
+The scaling-book recipe: pick a mesh, annotate shardings, let XLA insert the
+collectives. ``build_mesh`` arranges jax devices into the layout's axes so
+that the innermost (rightmost) axes — tp, sp — map to physically adjacent
+devices (ICI neighbors under the default device enumeration), keeping
+tensor/sequence collectives on the fastest links.
+"""
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from nos_tpu.parallel.layout import ParallelLayout
+
+
+def build_mesh(layout: ParallelLayout, devices: Optional[Sequence] = None) -> Mesh:
+    devices = list(devices if devices is not None else jax.devices())
+    if layout.chips > len(devices):
+        raise ValueError(
+            f"layout needs {layout.chips} chips, only {len(devices)} devices"
+        )
+    names = layout.axis_names()
+    sizes = layout.axis_sizes()
+    n = 1
+    for s in sizes:
+        n *= s
+    grid = np.array(devices[:n]).reshape(sizes)
+    return Mesh(grid, names)
+
+
+def data_sharding(mesh: Mesh) -> NamedSharding:
+    """Batch-dimension sharding over every data-like axis present."""
+    data_axes = tuple(a for a in ("dp", "fsdp") if a in mesh.axis_names)
+    spec = P(data_axes if data_axes else None)
+    return NamedSharding(mesh, spec)
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def logical_to_sharding(mesh: Mesh, *spec_axes) -> NamedSharding:
+    """Build a NamedSharding, silently dropping axes the mesh doesn't have
+    (so the same model code works for every layout)."""
+    cleaned = []
+    for axis in spec_axes:
+        if axis is None:
+            cleaned.append(None)
+        elif isinstance(axis, (tuple, list)):
+            present = tuple(a for a in axis if a in mesh.axis_names)
+            cleaned.append(present if present else None)
+        else:
+            cleaned.append(axis if axis in mesh.axis_names else None)
+    return NamedSharding(mesh, P(*cleaned))

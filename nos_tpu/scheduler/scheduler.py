@@ -140,6 +140,12 @@ class Scheduler:
                     client.delete("Pod", v.metadata.name, v.metadata.namespace)
                 except NotFound:
                     pass
+                # keep the shared sweep snapshot + quota accounting truthful
+                # so later pods in this sweep don't re-preempt live pods
+                node = v.spec.node_name
+                if node and node in snapshot:
+                    snapshot[node].remove_pod(v)
+                self.capacity.untrack_pod(v)
             def nominate(p: Pod, n=nominated):
                 p.status.nominated_node_name = n
             client.patch("Pod", pod.metadata.name, pod.metadata.namespace, nominate)

@@ -282,3 +282,24 @@ def test_preemption_respects_node_selector():
     # the running pod survives; the selector-mismatched preemptor stays pending
     assert server.try_get("Pod", "low", "team-a") is not None
     assert server.get("Pod", "high", "team-a").spec.node_name == ""
+
+
+def test_sweep_preemption_does_not_overkill():
+    """Two pending pods in one sweep: the first's preemption must be visible
+    to the second so it doesn't evict additional live pods."""
+    server, mgr, _ = sched_rig()
+    server.create(make_node("n1", tpu=8))
+    server.create(make_node("n2", tpu=8))
+    for node in ("n1", "n2"):
+        server.create(make_pod(f"low-{node}", "team-a", tpu=8, node=node,
+                               phase="Running", priority=0))
+    # two high-priority pods arrive in one burst; each needs one full node
+    server.create(make_pod("high-1", "team-a", tpu=8, priority=100))
+    server.create(make_pod("high-2", "team-a", tpu=8, priority=100))
+    mgr.run_until_idle(advance_delayed=True)
+    survivors = [p.metadata.name for p in server.list("Pod")]
+    # both high pods scheduled, both low pods evicted — but never MORE than
+    # the two needed evictions (no over-kill of freshly-freed capacity)
+    assert "high-1" in survivors and "high-2" in survivors
+    highs = [server.get("Pod", n, "team-a").spec.node_name for n in ("high-1", "high-2")]
+    assert sorted(h for h in highs if h) == ["n1", "n2"]

@@ -251,3 +251,11 @@ def test_agent_startup_resume_from_persisted_state():
     # reporter re-published reality from persisted state
     assert node.metadata.annotations["nos.ai/status-tpu-0-2x4-free"] == "1"
     assert node.metadata.annotations[constants.ANNOTATION_REPORTED_PARTITIONING_PLAN] == "old-plan"
+
+
+def test_native_decode_rejects_bad_board_key(native, tmp_path):
+    from nos_tpu.agents.tpu_native import TpuClientError
+
+    (tmp_path / "partition.json").write_text('{"boards": {"abc": {}}}')
+    with pytest.raises(TpuClientError):
+        native.read_partition()
