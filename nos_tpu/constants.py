@@ -54,6 +54,21 @@ LABEL_DEVICE_PLUGIN_CONFIG = DOMAIN + "/device-plugin.config"
 PARTITIONING_SUBSLICING = "subslicing"   # per-host chip sub-slicing (v5e-style; MPS/MIG analog)
 PARTITIONING_TOPOLOGY = "topology"       # multi-host slice placement (gang; no GPU analog)
 
+# ---------------------------------------------------------------------------
+# Gang scheduling (multi-host TPU JobSets; no reference analog — SURVEY §2.7)
+# ---------------------------------------------------------------------------
+# Pods of one multi-host job carry:
+#   nos.ai/gang-name:   job identity (JobSet name)
+#   nos.ai/gang-size:   total worker count (hosts in the slice)
+#   nos.ai/gang-worker: this pod's worker index 0..size-1
+# and the annotation:
+#   nos.ai/tpu-topology: the slice topology the job's parallelism layout
+#                        requires (e.g. "4x4" on v5e, "4x4x4" on v5p)
+LABEL_GANG_NAME = DOMAIN + "/gang-name"
+LABEL_GANG_SIZE = DOMAIN + "/gang-size"
+LABEL_GANG_WORKER = DOMAIN + "/gang-worker"
+ANNOTATION_TPU_TOPOLOGY = DOMAIN + "/tpu-topology"
+
 CAPACITY_IN_QUOTA = "in-quota"
 CAPACITY_OVER_QUOTA = "over-quota"
 

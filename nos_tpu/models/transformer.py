@@ -60,10 +60,10 @@ def init_params(rng: jax.Array, cfg: TransformerConfig) -> Params:
         return (jax.random.normal(key, shape, jnp.float32) * fan_in ** -0.5
                 ).astype(cfg.dtype)
 
-    keys = jax.random.split(k_layers, cfg.n_layers * 6).reshape(cfg.n_layers, 6, 2)
+    keys = jax.random.split(k_layers, cfg.n_layers * 7).reshape(cfg.n_layers, 7, 2)
 
     def layer(i):
-        kq, kk, kv, ko, kg, kd = [keys[i, j] for j in range(6)]
+        kq, kk, kv, ko, kg, ku, kd = [keys[i, j] for j in range(7)]
         d, h = cfg.d_model, cfg.d_ff
         return {
             "attn_norm": jnp.ones((d,), jnp.float32),
@@ -73,8 +73,8 @@ def init_params(rng: jax.Array, cfg: TransformerConfig) -> Params:
             "wo": dense(ko, (d, d), d),
             "mlp_norm": jnp.ones((d,), jnp.float32),
             "w_gate": dense(kg, (d, h), d),
-            "w_up": dense(kd, (d, h), d),
-            "w_down": dense(kg, (h, d), h),
+            "w_up": dense(ku, (d, h), d),
+            "w_down": dense(kd, (h, d), h),
         }
 
     layers = jax.tree.map(lambda *xs: jnp.stack(xs), *[layer(i) for i in range(cfg.n_layers)])
@@ -90,11 +90,10 @@ def init_params(rng: jax.Array, cfg: TransformerConfig) -> Params:
 def param_shardings(mesh: Mesh, cfg: TransformerConfig) -> Params:
     """Megatron+zero layout: feature axes over tp, the other matmul axis
     over fsdp; norms replicated."""
+    from nos_tpu.parallel.mesh import logical_to_sharding
+
     def ns(*axes):
-        cleaned = tuple(
-            a if (a is None or a in mesh.axis_names) else None for a in axes
-        )
-        return NamedSharding(mesh, P(*cleaned))
+        return logical_to_sharding(mesh, *axes)
 
     layer = {
         "attn_norm": ns(None, None),

@@ -59,8 +59,9 @@ def attention(
     the shape is kernel-friendly (S multiple of the block size), else XLA."""
     if force_xla or not flash_attention_available():
         return xla_attention(q, k, v, causal=causal, scale=scale)
-    s = q.shape[-2]
-    if s % 128 != 0 or q.shape[-1] % 128 != 0:
+    # kernel constraint (probed on v5e): sequence length divisible by the
+    # 128 k-major block; head_dim 64/128 both supported
+    if q.shape[-2] % 128 != 0 or k.shape[-2] % 128 != 0:
         return xla_attention(q, k, v, causal=causal, scale=scale)
     fa = _pallas_flash()
     sm_scale = scale if scale is not None else q.shape[-1] ** -0.5

@@ -1,0 +1,209 @@
+"""Gang scheduling of multi-host TPU JobSets with ICI locality.
+
+The hard new part relative to the reference (SURVEY §7 step 6): the
+reference's planner simulates one pod onto one node
+(internal/partitioning/core/planner.go:155-175); a multi-host TPU job is
+schedulable only if *all* its workers land on the hosts of one
+ICI-connected slice. This module implements all-or-nothing gang placement:
+
+- a gang is identified by pod labels (nos.ai/gang-name, gang-size,
+  gang-worker) and its required slice topology annotation
+  (nos.ai/tpu-topology) — normally derived from the job's parallelism
+  layout via ``ParallelLayout.required_topology``;
+- **admission**: placement is attempted only when ALL members exist; no
+  member binds before every member has a feasible host (deadlock
+  avoidance: partial gangs never hold capacity);
+- **ICI locality**: candidate hosts come from one ICI domain (node pool)
+  whose slice topology matches the request exactly and which is complete;
+  DCN-spanning placements are never produced;
+- **scoring**: among feasible domains, prefer the one whose host count is
+  tightest (it always equals the requirement for complete pools, so the
+  effective tie-break is stable name order) — and domains already partially
+  occupied by other jobs lose to empty ones only if the gang doesn't fit;
+- **quota**: the gang's aggregate request is admitted through the
+  CapacityScheduling bounds as one unit (all-or-nothing at the quota level
+  too).
+
+Worker i is assigned to the domain's i-th free host in worker order so the
+job's mesh axes line up with the physical torus.
+"""
+from __future__ import annotations
+
+import logging
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Tuple
+
+from nos_tpu import constants
+from nos_tpu.kube.objects import Pod, ResourceList, add_resources
+from nos_tpu.scheduler import framework as fw
+from nos_tpu.tpu import topology
+from nos_tpu.tpu.ici import IciDomain, group_ici_domains
+
+logger = logging.getLogger(__name__)
+
+
+@dataclass(frozen=True)
+class GangKey:
+    namespace: str
+    name: str
+
+
+def gang_key(pod: Pod) -> Optional[GangKey]:
+    name = pod.metadata.labels.get(constants.LABEL_GANG_NAME)
+    if not name:
+        return None
+    return GangKey(pod.metadata.namespace, name)
+
+
+def gang_size(pod: Pod) -> Optional[int]:
+    try:
+        return int(pod.metadata.labels.get(constants.LABEL_GANG_SIZE, ""))
+    except ValueError:
+        return None
+
+
+def gang_worker(pod: Pod) -> int:
+    try:
+        return int(pod.metadata.labels.get(constants.LABEL_GANG_WORKER, "0"))
+    except ValueError:
+        return 0
+
+
+def required_topology_name(pod: Pod) -> Optional[str]:
+    return pod.metadata.annotations.get(constants.ANNOTATION_TPU_TOPOLOGY)
+
+
+@dataclass
+class GangPlacement:
+    """node name per gang member pod (same order as ``pods``)."""
+
+    pods: List[Pod]
+    nodes: List[str]
+    domain: IciDomain
+
+
+class GangScheduler:
+    """Gang placement engine used by the Scheduler for gang-labeled pods."""
+
+    def __init__(self, framework: fw.SchedulerFramework, capacity=None):
+        self.framework = framework
+        self.capacity = capacity
+
+    # ------------------------------------------------------------------
+    def collect_gang(self, pods: List[Pod], key: GangKey) -> List[Pod]:
+        members = [
+            p for p in pods
+            if gang_key(p) == key
+        ]
+        members.sort(key=gang_worker)
+        return members
+
+    # ------------------------------------------------------------------
+    def admit(self, members: List[Pod]) -> Tuple[bool, str]:
+        """Gang-level admission: completeness, consistent declaration,
+        topology validity, quota bounds on the aggregate request."""
+        if not members:
+            return False, "empty gang"
+        declared = gang_size(members[0])
+        if declared is None:
+            return False, "missing or invalid gang-size label"
+        if len(members) < declared:
+            return False, f"waiting for gang: {len(members)}/{declared} members exist"
+        if len(members) > declared:
+            return False, f"gang has {len(members)} members, declared {declared}"
+        workers = sorted(gang_worker(p) for p in members)
+        if workers != list(range(declared)):
+            return False, f"gang worker indexes {workers} != 0..{declared - 1}"
+        topo_name = required_topology_name(members[0])
+        if not topo_name:
+            return False, "missing nos.ai/tpu-topology annotation"
+        if any(required_topology_name(p) != topo_name for p in members):
+            return False, "gang members disagree on tpu-topology"
+        # quota: aggregate request admitted as one unit
+        if self.capacity is not None:
+            total: ResourceList = {}
+            for p in members:
+                total = add_resources(
+                    total, self.capacity.calc.compute_pod_request(p)
+                )
+            info = self.capacity.quotas.get(members[0].metadata.namespace)
+            if info is not None:
+                if info.used_over_max_with(total):
+                    return False, "gang would exceed max quota"
+                if self.capacity.quotas.aggregated_used_over_min_with(total):
+                    return False, "gang would exceed aggregated min quota"
+        return True, ""
+
+    # ------------------------------------------------------------------
+    def place(
+        self, members: List[Pod], snapshot: fw.Snapshot
+    ) -> Tuple[Optional[GangPlacement], str]:
+        """Find an ICI domain hosting the whole gang. ``members`` is the
+        FULL gang in worker order; already-bound members (crash recovery
+        after a partial bind) pin the search to their domain and keep their
+        worker-indexed hosts. Returns a placement covering only the unbound
+        members, or (None, reason)."""
+        topo_name = required_topology_name(members[0])
+        nodes = [ni.node for ni in snapshot.values()]
+        domains = group_ici_domains(nodes)
+        bound = {
+            gang_worker(p): p.spec.node_name for p in members if p.spec.node_name
+        }
+
+        reasons: List[str] = []
+        for pool, domain in sorted(domains.items()):
+            if domain.topology_name != topo_name:
+                continue
+            if not domain.is_complete():
+                reasons.append(f"pool {pool}: incomplete slice ({domain.hosts} hosts)")
+                continue
+            expected = domain.expected_hosts
+            if expected != len(members):
+                reasons.append(
+                    f"pool {pool}: slice has {expected} hosts, gang has {len(members)}"
+                )
+                continue
+            placement = self._try_domain(members, bound, domain, snapshot)
+            if placement is None:
+                reasons.append(f"pool {pool}: hosts busy or unfit")
+                continue
+            return placement, ""
+
+        matching = [d for d in domains.values() if d.topology_name == topo_name]
+        if not matching:
+            return None, f"no ICI domain with topology {topo_name!r} exists"
+        return None, "; ".join(reasons) or "no feasible ICI domain"
+
+    def _try_domain(
+        self,
+        members: List[Pod],
+        bound: Dict[int, str],
+        domain: IciDomain,
+        snapshot: fw.Snapshot,
+    ) -> Optional[GangPlacement]:
+        """Worker w -> domain host w (torus alignment). Already-bound
+        workers must sit exactly on their worker-indexed host; every unbound
+        assignment must pass the full filter pipeline (one worker per host:
+        whole-host chip requests make the resource filter enforce
+        exclusivity)."""
+        if len(domain.nodes) != len(members):
+            return None
+        for w, node_name in bound.items():
+            if domain.nodes[w].metadata.name != node_name:
+                return None
+        state: fw.CycleState = {}
+        pods: List[Pod] = []
+        assignments: List[str] = []
+        for pod in members:
+            w = gang_worker(pod)
+            if w in bound:
+                continue
+            node = domain.nodes[w]
+            node_info = snapshot.get(node.metadata.name)
+            if node_info is None:
+                return None
+            if not self.framework.run_filter(state, pod, node_info).success:
+                return None
+            pods.append(pod)
+            assignments.append(node.metadata.name)
+        return GangPlacement(pods=pods, nodes=assignments, domain=domain)

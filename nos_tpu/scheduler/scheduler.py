@@ -21,6 +21,7 @@ from nos_tpu.kube.controller import Controller, Request, Result, Watch
 from nos_tpu.kube.objects import Pod, PodCondition, deep_copy
 from nos_tpu.scheduler import framework as fw
 from nos_tpu.scheduler.capacity import CapacityScheduling
+from nos_tpu.scheduler.gang import GangScheduler, gang_key
 from nos_tpu.tpu.resource_calc import ResourceCalculator
 
 logger = logging.getLogger(__name__)
@@ -41,6 +42,7 @@ class Scheduler:
             calculator=self.calc,
         )
         self.capacity.framework = self.framework
+        self.gang = GangScheduler(self.framework, self.capacity)
 
     # ------------------------------------------------------------------
     def _sync_state(self, client: Client) -> fw.Snapshot:
@@ -87,6 +89,8 @@ class Scheduler:
         return self._schedule_one(client, pod, self._sync_state(client))
 
     def _schedule_one(self, client: Client, pod: Pod, snapshot: fw.Snapshot) -> Result:
+        if gang_key(pod) is not None:
+            return self._schedule_gang(client, pod, snapshot)
         state: fw.CycleState = {}
 
         st = self.framework.run_pre_filter(state, pod, snapshot)
@@ -125,6 +129,59 @@ class Scheduler:
         bound.spec.node_name = node_name
         snapshot[node_name].add_pod(bound)
         logger.info("scheduled %s/%s -> %s", pod.metadata.namespace, pod.metadata.name, node_name)
+        return Result()
+
+    # ------------------------------------------------------------------
+    def _schedule_gang(self, client: Client, pod: Pod, snapshot: fw.Snapshot) -> Result:
+        """All-or-nothing placement of a multi-host gang onto one ICI
+        domain. No member binds unless every member has a feasible host."""
+        key = gang_key(pod)
+        members = self.gang.collect_gang(client.list("Pod", namespace=key.namespace), key)
+        pending = [p for p in members if not p.spec.node_name and p.status.phase == "Pending"]
+        if not pending:
+            return Result()
+
+        ok, reason = self.gang.admit(members)
+        if not ok:
+            for p in pending:
+                self._mark_unschedulable(client, p, reason)
+            return Result()
+
+        # place() receives the FULL gang: already-bound members (partial bind
+        # from a crashed prior cycle) pin the domain and keep their hosts;
+        # the returned placement covers only the unbound members
+        placement, why = self.gang.place(members, snapshot)
+        if placement is None:
+            for p in pending:
+                self._mark_unschedulable(client, p, f"gang unplaceable: {why}")
+            return Result()
+
+        reserved = []
+        for member, node_name in zip(placement.pods, placement.nodes):
+            st = self.framework.run_reserve({}, member, node_name)
+            if not st.success:
+                for m, n in reserved:
+                    self.framework.run_unreserve({}, m, n)
+                for p in pending:
+                    self._mark_unschedulable(client, p, st.reason)
+                return Result()
+            reserved.append((member, node_name))
+
+        for member, node_name in zip(placement.pods, placement.nodes):
+            def bind(p: Pod, n=node_name):
+                p.spec.node_name = n
+                p.status.conditions = [
+                    c for c in p.status.conditions if c.type != "PodScheduled"
+                ] + [PodCondition(type="PodScheduled", status="True")]
+
+            client.patch("Pod", member.metadata.name, member.metadata.namespace, bind)
+            bound = deep_copy(member)
+            bound.spec.node_name = node_name
+            snapshot[node_name].add_pod(bound)
+        logger.info(
+            "gang %s/%s: placed %d workers on ICI domain %s",
+            key.namespace, key.name, len(placement.pods), placement.domain.pool,
+        )
         return Result()
 
     # ------------------------------------------------------------------

@@ -1,0 +1,73 @@
+"""ICI domain modeling — which nodes share an interconnect mesh.
+
+No GPU analog exists in the reference (SURVEY §2.7: "ICI/DCN topology
+modeling ... as a first-class input to the planner and the gang-scheduler
+plugin"). On GKE, a multi-host TPU slice is one node pool: every node
+(host) in the pool is wired into the same ICI mesh with a fixed topology
+chosen at pool creation; traffic between pools crosses DCN. So:
+
+- an **ICI domain** = (node pool, generation, slice topology): the set of
+  hosts a gang may span with full ICI bandwidth;
+- a gang must be placed entirely inside one domain (DCN-crossing
+  avoidance is a hard constraint here, not a score);
+- within a domain, host ordering follows the worker index convention
+  (node name sort = worker order) so the job's mesh axes line up with the
+  physical torus.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from nos_tpu import constants
+from nos_tpu.kube.objects import Node
+from nos_tpu.tpu import topology
+
+
+@dataclass
+class IciDomain:
+    pool: str
+    generation: str                     # GENERATIONS key (label value)
+    topology_name: str
+    nodes: List[Node] = field(default_factory=list)   # worker order (name sort)
+
+    @property
+    def slice_topology(self) -> Optional[topology.SliceTopology]:
+        return topology.find_slice_topology(self.generation, self.topology_name)
+
+    @property
+    def hosts(self) -> int:
+        return len(self.nodes)
+
+    @property
+    def expected_hosts(self) -> Optional[int]:
+        gen = topology.get_generation(self.generation)
+        topo = self.slice_topology
+        if gen is None or topo is None:
+            return None
+        return gen.hosts_for(topo)
+
+    def is_complete(self) -> bool:
+        """All hosts of the slice are present (a gang needs the whole
+        slice's ICI mesh; an incomplete pool cannot host it)."""
+        expected = self.expected_hosts
+        return expected is not None and self.hosts == expected
+
+
+def group_ici_domains(nodes: List[Node]) -> Dict[str, IciDomain]:
+    """Group TPU nodes into ICI domains by node pool."""
+    domains: Dict[str, IciDomain] = {}
+    for node in nodes:
+        labels = node.metadata.labels
+        pool = labels.get(constants.LABEL_NODEPOOL)
+        gen = labels.get(constants.LABEL_TPU_ACCELERATOR)
+        topo = labels.get(constants.LABEL_TPU_TOPOLOGY)
+        if not pool or not gen or not topo:
+            continue
+        if topology.get_generation(gen) is None:
+            continue
+        domain = domains.setdefault(pool, IciDomain(pool, gen, topo))
+        domain.nodes.append(node)
+    for domain in domains.values():
+        domain.nodes.sort(key=lambda n: n.metadata.name)
+    return domains

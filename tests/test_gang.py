@@ -1,0 +1,281 @@
+"""Gang scheduling + ICI locality (BASELINE.json config 4: multi-host
+gang-schedule of a JAX JobSet with topology-aware placement)."""
+import pytest
+
+from nos_tpu import constants
+from nos_tpu.api.quota import make_elastic_quota
+from nos_tpu.kube import ApiServer, Manager
+from nos_tpu.kube.objects import (
+    Container,
+    Node,
+    NodeStatus,
+    ObjectMeta,
+    Pod,
+    PodCondition,
+    PodSpec,
+    PodStatus,
+)
+from nos_tpu.parallel.layout import ParallelLayout
+from nos_tpu.scheduler import Scheduler
+from nos_tpu.tpu.ici import group_ici_domains
+
+TPU = "google.com/tpu"
+
+
+def slice_host(name, pool, topo="4x4", gen="tpu-v5-lite-podslice"):
+    """One host (node) of a multi-host TPU slice node pool."""
+    return Node(
+        metadata=ObjectMeta(
+            name=name,
+            labels={
+                constants.LABEL_TPU_ACCELERATOR: gen,
+                constants.LABEL_TPU_TOPOLOGY: topo,
+                constants.LABEL_NODEPOOL: pool,
+            },
+        ),
+        status=NodeStatus(
+            capacity={TPU: 8, "cpu": 96},
+            allocatable={TPU: 8, "cpu": 96},
+        ),
+    )
+
+
+def gang_pod(job, worker, size, topo="4x4", ns="team-a", tpu=8):
+    return Pod(
+        metadata=ObjectMeta(
+            name=f"{job}-{worker}",
+            namespace=ns,
+            labels={
+                constants.LABEL_GANG_NAME: job,
+                constants.LABEL_GANG_SIZE: str(size),
+                constants.LABEL_GANG_WORKER: str(worker),
+            },
+            annotations={constants.ANNOTATION_TPU_TOPOLOGY: topo},
+        ),
+        spec=PodSpec(
+            containers=[Container(requests={TPU: tpu})],
+            scheduler_name=constants.SCHEDULER_NAME,
+        ),
+        status=PodStatus(
+            phase="Pending",
+            conditions=[
+                PodCondition(type="PodScheduled", status="False", reason="Unschedulable")
+            ],
+        ),
+    )
+
+
+def make_pool(server, pool, hosts, topo="4x4"):
+    for i in range(hosts):
+        server.create(slice_host(f"{pool}-w{i}", pool, topo))
+
+
+def rig():
+    server = ApiServer()
+    mgr = Manager(server)
+    mgr.add_controller(Scheduler().controller())
+    return server, mgr
+
+
+# ---------------------------------------------------------------------------
+# ICI domain grouping
+# ---------------------------------------------------------------------------
+
+def test_group_ici_domains():
+    nodes = [slice_host(f"a-w{i}", "pool-a") for i in range(2)]
+    nodes += [slice_host(f"b-w{i}", "pool-b", topo="2x4") for i in range(1)]
+    nodes.append(Node(metadata=ObjectMeta(name="plain")))   # not a TPU node
+    domains = group_ici_domains(nodes)
+    assert set(domains) == {"pool-a", "pool-b"}
+    assert domains["pool-a"].hosts == 2
+    assert [n.metadata.name for n in domains["pool-a"].nodes] == ["a-w0", "a-w1"]
+    # v5e 4x4 = 16 chips = 2 hosts -> complete; 2x4 = 1 host -> complete
+    assert domains["pool-a"].is_complete()
+    assert domains["pool-b"].is_complete()
+
+
+def test_incomplete_domain_detected():
+    nodes = [slice_host("a-w0", "pool-a", topo="4x8")]   # 4x8 needs 4 hosts
+    domains = group_ici_domains(nodes)
+    assert not domains["pool-a"].is_complete()
+
+
+def test_layout_to_gang_contract():
+    """ParallelLayout -> topology -> gang size: the workload-plane contract
+    the gang annotations carry."""
+    layout = ParallelLayout(dp=2, tp=8)        # 16 chips
+    topo = layout.required_topology("v5e")
+    assert topo.name == "4x4"
+    assert layout.hosts_required("v5e") == 2
+
+
+# ---------------------------------------------------------------------------
+# gang placement end-to-end
+# ---------------------------------------------------------------------------
+
+def test_gang_places_all_or_nothing_waits_for_members():
+    server, mgr = rig()
+    make_pool(server, "pool-a", 2)
+    # only 1 of 2 members exists -> nothing binds
+    server.create(gang_pod("train", 0, 2))
+    mgr.run_until_idle()
+    p0 = server.get("Pod", "train-0", "team-a")
+    assert p0.spec.node_name == ""
+    assert any("waiting for gang" in c.message for c in p0.status.conditions)
+    # second member arrives -> whole gang binds onto pool-a in worker order
+    server.create(gang_pod("train", 1, 2))
+    mgr.run_until_idle()
+    assert server.get("Pod", "train-0", "team-a").spec.node_name == "pool-a-w0"
+    assert server.get("Pod", "train-1", "team-a").spec.node_name == "pool-a-w1"
+
+
+def test_gang_requires_matching_topology_domain():
+    server, mgr = rig()
+    make_pool(server, "pool-a", 2, topo="4x4")
+    for w in range(4):
+        server.create(gang_pod("big", w, 4, topo="4x8"))   # needs 4-host 4x8
+    mgr.run_until_idle()
+    p = server.get("Pod", "big-0", "team-a")
+    assert p.spec.node_name == ""
+    assert any("no ICI domain with topology '4x8'" in c.message
+               for c in p.status.conditions)
+
+
+def test_gang_never_spans_pools():
+    """Two 1-host-free pools cannot host a 2-host gang (DCN crossing)."""
+    server, mgr = rig()
+    make_pool(server, "pool-a", 2)
+    make_pool(server, "pool-b", 2)
+    # occupy one host in each pool
+    for pool in ("pool-a", "pool-b"):
+        server.create(Pod(
+            metadata=ObjectMeta(name=f"busy-{pool}", namespace="x"),
+            spec=PodSpec(containers=[Container(requests={TPU: 8})],
+                         node_name=f"{pool}-w0"),
+            status=PodStatus(phase="Running"),
+        ))
+    for w in range(2):
+        server.create(gang_pod("train", w, 2))
+    mgr.run_until_idle()
+    for w in range(2):
+        assert server.get("Pod", f"train-{w}", "team-a").spec.node_name == ""
+
+
+def test_gang_picks_free_pool():
+    server, mgr = rig()
+    make_pool(server, "pool-a", 2)
+    make_pool(server, "pool-b", 2)
+    # pool-a busy
+    server.create(Pod(
+        metadata=ObjectMeta(name="busy", namespace="x"),
+        spec=PodSpec(containers=[Container(requests={TPU: 8})], node_name="pool-a-w0"),
+        status=PodStatus(phase="Running"),
+    ))
+    for w in range(2):
+        server.create(gang_pod("train", w, 2))
+    mgr.run_until_idle()
+    assert server.get("Pod", "train-0", "team-a").spec.node_name == "pool-b-w0"
+    assert server.get("Pod", "train-1", "team-a").spec.node_name == "pool-b-w1"
+
+
+def test_two_gangs_two_pools_no_interleave():
+    server, mgr = rig()
+    make_pool(server, "pool-a", 2)
+    make_pool(server, "pool-b", 2)
+    for job in ("j1", "j2"):
+        for w in range(2):
+            server.create(gang_pod(job, w, 2))
+    mgr.run_until_idle()
+    placements = {}
+    for job in ("j1", "j2"):
+        pools = set()
+        for w in range(2):
+            node = server.get("Pod", f"{job}-{w}", "team-a").spec.node_name
+            assert node
+            pools.add(node.rsplit("-w", 1)[0])
+        assert len(pools) == 1, f"{job} spans pools {pools}"
+        placements[job] = pools.pop()
+    assert placements["j1"] != placements["j2"]
+
+
+def test_gang_quota_all_or_nothing():
+    server, mgr = rig()
+    make_pool(server, "pool-a", 2)
+    server.create(make_elastic_quota("qa", "team-a", min={TPU: 8}, max={TPU: 8}))
+    # gang needs 16 chips but max is 8 -> nothing binds (not even worker 0)
+    for w in range(2):
+        server.create(gang_pod("train", w, 2))
+    mgr.run_until_idle()
+    for w in range(2):
+        p = server.get("Pod", f"train-{w}", "team-a")
+        assert p.spec.node_name == ""
+        assert any("quota" in c.message for c in p.status.conditions)
+
+
+def test_gang_invalid_worker_indexes_rejected():
+    server, mgr = rig()
+    make_pool(server, "pool-a", 2)
+    server.create(gang_pod("train", 0, 2))
+    server.create(gang_pod("train", 0, 2).__class__(  # duplicate worker 0
+        metadata=ObjectMeta(
+            name="train-dup", namespace="team-a",
+            labels={
+                constants.LABEL_GANG_NAME: "train",
+                constants.LABEL_GANG_SIZE: "2",
+                constants.LABEL_GANG_WORKER: "0",
+            },
+            annotations={constants.ANNOTATION_TPU_TOPOLOGY: "4x4"},
+        ),
+        spec=PodSpec(containers=[Container(requests={TPU: 8})],
+                     scheduler_name=constants.SCHEDULER_NAME),
+        status=PodStatus(phase="Pending", conditions=[
+            PodCondition(type="PodScheduled", status="False", reason="Unschedulable")]),
+    ))
+    mgr.run_until_idle()
+    p = server.get("Pod", "train-0", "team-a")
+    assert p.spec.node_name == ""
+    assert any("worker indexes" in c.message for c in p.status.conditions)
+
+
+def test_gang_frees_and_reschedules():
+    """A finished gang releases its slice; the next gang takes it."""
+    server, mgr = rig()
+    make_pool(server, "pool-a", 2)
+    for w in range(2):
+        server.create(gang_pod("first", w, 2))
+    mgr.run_until_idle()
+    # first gang done
+    for w in range(2):
+        server.delete("Pod", f"first-{w}", "team-a")
+    for w in range(2):
+        server.create(gang_pod("second", w, 2))
+    mgr.run_until_idle()
+    for w in range(2):
+        assert server.get("Pod", f"second-{w}", "team-a").spec.node_name
+
+
+def test_gang_partial_bind_recovery():
+    """Crash between bind patches: worker 0 bound, worker 1 not. The next
+    cycle must complete the gang on the same domain, worker-aligned."""
+    server, mgr = rig()
+    make_pool(server, "pool-a", 2)
+    make_pool(server, "pool-b", 2)
+    p0 = gang_pod("train", 0, 2)
+    p0.spec.node_name = "pool-a-w0"   # pre-bound (partial prior cycle)
+    server.create(p0)
+    server.create(gang_pod("train", 1, 2))
+    mgr.run_until_idle()
+    assert server.get("Pod", "train-1", "team-a").spec.node_name == "pool-a-w1"
+
+
+def test_gang_partial_bind_wrong_host_blocks():
+    """A bound member sitting on a host that doesn't match its worker index
+    must not be 'completed' into a torus-misaligned placement."""
+    server, mgr = rig()
+    make_pool(server, "pool-a", 2)
+    p0 = gang_pod("train", 0, 2)
+    p0.spec.node_name = "pool-a-w1"   # worker 0 on host 1: misaligned
+    server.create(p0)
+    server.create(gang_pod("train", 1, 2))
+    mgr.run_until_idle()
+    assert server.get("Pod", "train-1", "team-a").spec.node_name == ""
