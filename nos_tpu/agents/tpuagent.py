@@ -30,7 +30,7 @@ import threading
 from dataclasses import dataclass
 from typing import Dict, Optional
 
-from nos_tpu import constants
+from nos_tpu import constants, observability as obs
 from nos_tpu.kube.apiserver import NotFound
 from nos_tpu.kube.client import Client
 from nos_tpu.kube.controller import Controller, Request, Result, Watch
@@ -130,6 +130,8 @@ class TpuAgent:
                     allocatable_slices.get(profile.resource_name, 0) + total
                 )
 
+        changed = [False]
+
         def mutate(n: Node):
             anns = {
                 k: v
@@ -139,6 +141,7 @@ class TpuAgent:
             anns.update(status_annotations)
             if applied_plan:
                 anns[constants.ANNOTATION_REPORTED_PARTITIONING_PLAN] = applied_plan
+            changed[0] = anns != n.metadata.annotations
             n.metadata.annotations = anns
             if self.manage_allocatable:
                 alloc = {
@@ -150,9 +153,11 @@ class TpuAgent:
                     # partitioned: sub-slices replace whole-chip resource
                     alloc.pop(constants.RESOURCE_TPU, None)
                     alloc.update(allocatable_slices)
+                changed[0] = changed[0] or alloc != n.status.allocatable
                 n.status.allocatable = alloc
 
         client.patch("Node", self.node_name, "", mutate)
+        obs.AGENT_REPORTS.labels("changed" if changed[0] else "unchanged").inc()
         self.shared.mark_reported()
         return self._report_result()
 
@@ -201,13 +206,19 @@ class TpuAgent:
             self.shared.mark_applied()
             return Result()
         if not plan.is_valid():
+            obs.AGENT_APPLIES.labels("skipped").inc()
             logger.error(
                 "tpuagent %s: refusing plan %s: %s",
                 self.node_name, plan_id, "; ".join(plan.errors),
             )
             return Result()
         logger.info("tpuagent %s: applying %s (%s)", self.node_name, plan_id, plan.summary())
-        self.tpu.apply_partition(desired, plan_id)
+        try:
+            self.tpu.apply_partition(desired, plan_id)
+        except Exception:
+            obs.AGENT_APPLIES.labels("error").inc()
+            raise
+        obs.AGENT_APPLIES.labels("ok").inc()
         self.shared.mark_applied()
         return Result()
 

@@ -1,0 +1,88 @@
+"""Domain metrics for the nos-tpu control plane.
+
+The reference has no custom domain metrics (SURVEY §5 — only stock
+controller-runtime endpoints); the survey flags that as a gap since the
+north-star metrics are chip utilization and schedule latency. These
+instruments close it. They live on the default registry so every cmd/
+binary's /metrics endpoint (nos_tpu/cmd/serve.py) exposes whichever subset
+its process exercises.
+"""
+from __future__ import annotations
+
+from nos_tpu.utils.metrics import default_registry
+
+_r = default_registry()
+
+# --- partitioning control plane (the §3.2 loop) -----------------------
+PLANS_TOTAL = _r.counter(
+    "nos_partitioning_plans_total",
+    "Partitioning plans produced by the planner, by outcome "
+    "(actuated: a new desired state was written; noop: plan matched the "
+    "current state).",
+    ("outcome",),
+)
+PLAN_DURATION = _r.histogram(
+    "nos_partitioning_plan_duration_seconds",
+    "Wall time of one planning pass (snapshot + plan + actuate).",
+)
+PLAN_BATCH_SIZE = _r.histogram(
+    "nos_partitioning_batch_pods",
+    "Pending pods considered per planning pass.",
+    buckets=(1, 2, 5, 10, 20, 50, 100, 250),
+)
+
+# --- scheduler --------------------------------------------------------
+SCHEDULE_ATTEMPTS = _r.counter(
+    "nos_scheduler_attempts_total",
+    "Pod scheduling attempts by result (bound | unschedulable | error | "
+    "gang_wait | preempted_victims).",
+    ("result",),
+)
+SCHEDULE_DURATION = _r.histogram(
+    "nos_scheduler_e2e_duration_seconds",
+    "Wall time to schedule one pod (PreFilter through Bind).",
+)
+PREEMPTION_VICTIMS = _r.counter(
+    "nos_scheduler_preemption_victims_total",
+    "Pods deleted as preemption victims by the capacity plugin.",
+)
+GANGS_PLACED = _r.counter(
+    "nos_scheduler_gangs_placed_total",
+    "Multi-host gangs placed atomically.",
+)
+
+# --- node agent -------------------------------------------------------
+AGENT_REPORTS = _r.counter(
+    "nos_tpuagent_reports_total",
+    "Status reports written by the tpuagent reporter, by outcome "
+    "(changed | unchanged | error).",
+    ("outcome",),
+)
+AGENT_APPLIES = _r.counter(
+    "nos_tpuagent_applies_total",
+    "Partition plans applied by the tpuagent actuator, by outcome "
+    "(ok | error | skipped).",
+    ("outcome",),
+)
+
+# --- quota ------------------------------------------------------------
+QUOTA_USED = _r.gauge(
+    "nos_quota_used",
+    "Current status.used of each (Composite)ElasticQuota, per resource.",
+    ("quota", "resource"),
+)
+OVERQUOTA_PODS = _r.gauge(
+    "nos_quota_overquota_pods",
+    "Pods currently labeled over-quota, per quota object.",
+    ("quota",),
+)
+
+# --- utilization (north-star) ----------------------------------------
+CHIPS_ALLOCATABLE = _r.gauge(
+    "nos_tpu_chips_allocatable",
+    "TPU chips allocatable on partitioning-managed nodes.",
+)
+CHIPS_USED = _r.gauge(
+    "nos_tpu_chips_used",
+    "TPU chips requested by running pods on partitioning-managed nodes.",
+)

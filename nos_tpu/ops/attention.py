@@ -60,8 +60,9 @@ def attention(
     if force_xla or not flash_attention_available():
         return xla_attention(q, k, v, causal=causal, scale=scale)
     # kernel constraint (probed on v5e): sequence length divisible by the
-    # 128 k-major block; head_dim 64/128 both supported
-    if q.shape[-2] % 128 != 0 or k.shape[-2] % 128 != 0:
+    # 128 k-major block; head_dim 64/128 are the probed-supported sizes
+    if (q.shape[-2] % 128 != 0 or k.shape[-2] % 128 != 0
+            or q.shape[-1] not in (64, 128)):
         return xla_attention(q, k, v, causal=causal, scale=scale)
     fa = _pallas_flash()
     sm_scale = scale if scale is not None else q.shape[-1] ** -0.5

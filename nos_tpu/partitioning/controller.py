@@ -17,7 +17,7 @@ from __future__ import annotations
 import logging
 from typing import Callable, List, Optional
 
-from nos_tpu import constants
+from nos_tpu import constants, observability as obs
 from nos_tpu.kube.apiserver import NotFound
 from nos_tpu.kube.client import Client
 from nos_tpu.kube.controller import Controller, Request, Result, Watch
@@ -189,14 +189,35 @@ class PartitioningController:
         return out
 
     def _process(self, client: Client, pending: List[Pod]) -> None:
+        started = self.clock()
+        obs.PLAN_BATCH_SIZE.observe(len(pending))
         snapshot = self.snapshot_taker.take(self.state)
         plan = self.planner.plan(snapshot, pending)
         current = self._current_partitioning()
         if self.actuator.apply(client, current, plan):
+            obs.PLANS_TOTAL.labels("actuated").inc()
             logger.info(
                 "partitioner: actuated plan %s for %d pending pods",
                 plan.id, len(pending),
             )
+        else:
+            obs.PLANS_TOTAL.labels("noop").inc()
+        obs.PLAN_DURATION.observe(self.clock() - started)
+        self._update_utilization_gauges()
+
+    def _update_utilization_gauges(self) -> None:
+        """North-star gauges: allocatable vs used TPU chips on managed nodes."""
+        allocatable = 0.0
+        used = 0.0
+        for node in self.state.nodes():
+            if not node.metadata.labels.get(constants.LABEL_PARTITIONING):
+                continue
+            allocatable += node.status.allocatable.get(constants.RESOURCE_TPU, 0)
+            for pod in self.state.pods_on(node.metadata.name):
+                req = pod.request()
+                used += req.get(constants.RESOURCE_TPU, 0)
+        obs.CHIPS_ALLOCATABLE.set(allocatable)
+        obs.CHIPS_USED.set(used)
 
     # ------------------------------------------------------------------
     def controller(self) -> Controller:

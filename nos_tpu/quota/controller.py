@@ -20,7 +20,7 @@ from __future__ import annotations
 
 from typing import List, Optional, Tuple
 
-from nos_tpu import constants
+from nos_tpu import constants, observability as obs
 from nos_tpu.kube.apiserver import NotFound, WatchEvent
 from nos_tpu.kube.client import Client
 from nos_tpu.kube.controller import Controller, Request, Result, Watch
@@ -57,13 +57,14 @@ def _compute_used_and_label(
     pods: List[Pod],
     quota_min: ResourceList,
     quota_max: Optional[ResourceList],
-) -> ResourceList:
+) -> Tuple[ResourceList, int]:
     """Reference PatchPodsAndComputeUsedQuota (elasticquota.go:38-103):
     walk pods in over-quota-finding order, accumulate usage, label each pod
-    by whether the running total still fits min, and return used filtered to
-    the resources min enforces."""
+    by whether the running total still fits min, and return (used filtered
+    to the resources min enforces, count of over-quota pods)."""
     pods = sorted(pods, key=_pod_sort_key(calc))
     used: ResourceList = {r: 0 for r in {**quota_min, **(quota_max or {})}}
+    over_quota = 0
     for pod in pods:
         req = calc.compute_pod_request(pod)
         for r, v in req.items():
@@ -73,6 +74,8 @@ def _compute_used_and_label(
             if _used_fits_min(used, quota_min)
             else constants.CAPACITY_OVER_QUOTA
         )
+        if capacity == constants.CAPACITY_OVER_QUOTA:
+            over_quota += 1
         if pod.metadata.labels.get(constants.LABEL_CAPACITY) != capacity:
             client.patch(
                 "Pod",
@@ -83,7 +86,7 @@ def _compute_used_and_label(
                 ),
             )
     # status.used only reports resources the quota enforces
-    return {r: v for r, v in used.items() if r in quota_min}
+    return {r: v for r, v in used.items() if r in quota_min}, over_quota
 
 
 def _running_pods(client: Client, namespace: str) -> List[Pod]:
@@ -107,6 +110,14 @@ def _map_pod_to_quota(kind: str):
     return mapper
 
 
+def _export_quota_metrics(quota, used: ResourceList, over_quota: int) -> None:
+    qname = f"{quota.metadata.namespace}/{quota.metadata.name}" \
+        if quota.metadata.namespace else quota.metadata.name
+    for resource, value in used.items():
+        obs.QUOTA_USED.labels(qname, resource).set(value)
+    obs.OVERQUOTA_PODS.labels(qname).set(over_quota)
+
+
 class ElasticQuotaReconciler:
     def __init__(self, calculator: Optional[ResourceCalculator] = None):
         self.calc = calculator or ResourceCalculator()
@@ -126,7 +137,9 @@ class ElasticQuotaReconciler:
 
     def _reconcile_one(self, client: Client, eq) -> None:
         pods = _running_pods(client, eq.metadata.namespace)
-        used = _compute_used_and_label(client, self.calc, pods, eq.spec.min, eq.spec.max)
+        used, over = _compute_used_and_label(
+            client, self.calc, pods, eq.spec.min, eq.spec.max)
+        _export_quota_metrics(eq, used, over)
         if used != eq.status.used:
             client.patch(
                 "ElasticQuota",
@@ -172,9 +185,10 @@ class CompositeElasticQuotaReconciler:
         pods: List[Pod] = []
         for ns in ceq.spec.namespaces:
             pods.extend(_running_pods(client, ns))
-        used = _compute_used_and_label(
+        used, over = _compute_used_and_label(
             client, self.calc, pods, ceq.spec.min, ceq.spec.max
         )
+        _export_quota_metrics(ceq, used, over)
         if used != ceq.status.used:
             client.patch(
                 "CompositeElasticQuota",

@@ -119,10 +119,16 @@ class GangScheduler:
             return False, "missing nos.ai/tpu-topology annotation"
         if any(required_topology_name(p) != topo_name for p in members):
             return False, "gang members disagree on tpu-topology"
-        # quota: aggregate request admitted as one unit
+        # quota: aggregate request admitted as one unit. Already-bound
+        # members (partial bind from a crashed prior cycle) are excluded:
+        # the scheduler's state sync has already tracked their requests
+        # into QuotaInfo.used, so adding them again would double-count and
+        # wedge the gang the recovery path in place() exists to finish.
         if self.capacity is not None:
             total: ResourceList = {}
             for p in members:
+                if p.spec.node_name:
+                    continue
                 total = add_resources(
                     total, self.capacity.calc.compute_pod_request(p)
                 )

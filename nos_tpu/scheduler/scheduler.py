@@ -12,9 +12,10 @@ controller runtime as everything else.
 from __future__ import annotations
 
 import logging
+import time
 from typing import Optional
 
-from nos_tpu import constants
+from nos_tpu import constants, observability as obs
 from nos_tpu.kube.apiserver import NotFound
 from nos_tpu.kube.client import Client
 from nos_tpu.kube.controller import Controller, Request, Result, Watch
@@ -89,6 +90,13 @@ class Scheduler:
         return self._schedule_one(client, pod, self._sync_state(client))
 
     def _schedule_one(self, client: Client, pod: Pod, snapshot: fw.Snapshot) -> Result:
+        started = time.monotonic()
+        try:
+            return self._schedule_one_inner(client, pod, snapshot)
+        finally:
+            obs.SCHEDULE_DURATION.observe(time.monotonic() - started)
+
+    def _schedule_one_inner(self, client: Client, pod: Pod, snapshot: fw.Snapshot) -> Result:
         if gang_key(pod) is not None:
             return self._schedule_gang(client, pod, snapshot)
         state: fw.CycleState = {}
@@ -128,6 +136,7 @@ class Scheduler:
         bound = deep_copy(pod)
         bound.spec.node_name = node_name
         snapshot[node_name].add_pod(bound)
+        obs.SCHEDULE_ATTEMPTS.labels("bound").inc()
         logger.info("scheduled %s/%s -> %s", pod.metadata.namespace, pod.metadata.name, node_name)
         return Result()
 
@@ -143,6 +152,9 @@ class Scheduler:
 
         ok, reason = self.gang.admit(members)
         if not ok:
+            obs.SCHEDULE_ATTEMPTS.labels(
+                "gang_wait" if "waiting for gang" in reason else "unschedulable"
+            ).inc()
             for p in pending:
                 self._mark_unschedulable(client, p, reason)
             return Result()
@@ -152,6 +164,7 @@ class Scheduler:
         # the returned placement covers only the unbound members
         placement, why = self.gang.place(members, snapshot)
         if placement is None:
+            obs.SCHEDULE_ATTEMPTS.labels("unschedulable").inc()
             for p in pending:
                 self._mark_unschedulable(client, p, f"gang unplaceable: {why}")
             return Result()
@@ -162,6 +175,7 @@ class Scheduler:
             if not st.success:
                 for m, n in reserved:
                     self.framework.run_unreserve({}, m, n)
+                obs.SCHEDULE_ATTEMPTS.labels("unschedulable").inc()
                 for p in pending:
                     self._mark_unschedulable(client, p, st.reason)
                 return Result()
@@ -178,6 +192,8 @@ class Scheduler:
             bound = deep_copy(member)
             bound.spec.node_name = node_name
             snapshot[node_name].add_pod(bound)
+        obs.GANGS_PLACED.inc()
+        obs.SCHEDULE_ATTEMPTS.labels("bound").inc(len(placement.pods))
         logger.info(
             "gang %s/%s: placed %d workers on ICI domain %s",
             key.namespace, key.name, len(placement.pods), placement.domain.pool,
@@ -203,6 +219,8 @@ class Scheduler:
                 if node and node in snapshot:
                     snapshot[node].remove_pod(v)
                 self.capacity.untrack_pod(v)
+            obs.PREEMPTION_VICTIMS.inc(len(victims))
+            obs.SCHEDULE_ATTEMPTS.labels("preempted_victims").inc()
             def nominate(p: Pod, n=nominated):
                 p.status.nominated_node_name = n
             client.patch("Pod", pod.metadata.name, pod.metadata.namespace, nominate)
@@ -212,6 +230,7 @@ class Scheduler:
             )
             # requeue: next cycle schedules onto the freed node
             return Result(requeue=True)
+        obs.SCHEDULE_ATTEMPTS.labels("unschedulable").inc()
         self._mark_unschedulable(client, pod, st.reason)
         return Result()
 

@@ -25,3 +25,69 @@ jax.config.update("jax_platforms", "cpu")
 import sys  # noqa: E402
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import pytest  # noqa: E402
+
+
+class _Cluster:
+    """Minimal wired cluster (apiserver + operator + scheduler) for tests
+    that need the control plane but not the partitioning/agent layers."""
+
+    def __init__(self):
+        from nos_tpu.api.webhooks import register_quota_webhooks
+        from nos_tpu.kube import ApiServer, Manager
+        from nos_tpu.kube.client import Client
+        from nos_tpu.quota.controller import (
+            CompositeElasticQuotaReconciler,
+            ElasticQuotaReconciler,
+        )
+        from nos_tpu.scheduler import Scheduler
+
+        self.server = ApiServer()
+        register_quota_webhooks(self.server)
+        self.manager = Manager(self.server)
+        self.manager.add_controller(ElasticQuotaReconciler().controller())
+        self.manager.add_controller(CompositeElasticQuotaReconciler().controller())
+        self.manager.add_controller(Scheduler().controller())
+        self.client = Client(self.server)
+
+    def add_node(self, name, allocatable):
+        from nos_tpu.kube.objects import Node, NodeStatus, ObjectMeta
+
+        node = Node(
+            metadata=ObjectMeta(name=name),
+            status=NodeStatus(capacity=dict(allocatable),
+                              allocatable=dict(allocatable)),
+        )
+        self.client.create(node)
+        return node
+
+    def add_pod(self, namespace, name, requests, phase="Pending"):
+        from nos_tpu import constants
+        from nos_tpu.kube.objects import (
+            Container, ObjectMeta, Pod, PodSpec, PodStatus,
+        )
+
+        pod = Pod(
+            metadata=ObjectMeta(name=name, namespace=namespace),
+            spec=PodSpec(containers=[Container(requests=dict(requests))],
+                         scheduler_name=constants.SCHEDULER_NAME),
+            status=PodStatus(phase=phase),
+        )
+        self.client.create(pod)
+        return pod
+
+    def add_elastic_quota(self, namespace, name, minimum, maximum=None):
+        from nos_tpu.api.quota import make_elastic_quota
+
+        eq = make_elastic_quota(name, namespace, minimum, maximum)
+        self.client.create(eq)
+        return eq
+
+    def run_until_idle(self):
+        self.manager.run_until_idle()
+
+
+@pytest.fixture
+def make_cluster():
+    return _Cluster

@@ -279,3 +279,19 @@ def test_gang_partial_bind_wrong_host_blocks():
     server.create(gang_pod("train", 1, 2))
     mgr.run_until_idle()
     assert server.get("Pod", "train-1", "team-a").spec.node_name == ""
+
+
+def test_gang_partial_bind_recovery_under_tight_quota():
+    """Regression: admit() must not double-count already-bound members.
+    Quota max fits the whole gang exactly (16 chips); worker 0 is already
+    bound (its 8 chips are in QuotaInfo.used via state sync). Counting it
+    again would compute 8 + 16 > 16 and wedge the gang forever."""
+    server, mgr = rig()
+    make_pool(server, "pool-a", 2)
+    server.create(make_elastic_quota("qa", "team-a", min={TPU: 16}, max={TPU: 16}))
+    p0 = gang_pod("train", 0, 2)
+    p0.spec.node_name = "pool-a-w0"   # partial bind from a crashed cycle
+    server.create(p0)
+    server.create(gang_pod("train", 1, 2))
+    mgr.run_until_idle()
+    assert server.get("Pod", "train-1", "team-a").spec.node_name == "pool-a-w1"

@@ -1,0 +1,118 @@
+"""Metrics registry + domain-metric wiring.
+
+The reference exposes only stock controller-runtime metrics (SURVEY §5);
+nos-tpu adds domain metrics. These tests cover the exposition format and
+that the hot paths actually record samples.
+"""
+import pytest
+
+from nos_tpu.utils.metrics import Counter, Gauge, Histogram, Registry, default_registry
+
+
+def test_counter_exposition():
+    r = Registry()
+    c = r.counter("requests_total", "Total requests.", ("method",))
+    c.labels("GET").inc()
+    c.labels("GET").inc(2)
+    c.labels(method="POST").inc()
+    text = r.expose()
+    assert "# HELP requests_total Total requests." in text
+    assert "# TYPE requests_total counter" in text
+    assert 'requests_total{method="GET"} 3' in text
+    assert 'requests_total{method="POST"} 1' in text
+
+
+def test_counter_rejects_negative_and_wrong_labels():
+    r = Registry()
+    c = r.counter("x_total", "x", ("a",))
+    with pytest.raises(ValueError):
+        c.labels("v").inc(-1)
+    with pytest.raises(ValueError):
+        c.labels("v", "extra")
+    with pytest.raises(ValueError):
+        c.inc()  # labeled metric needs labels
+
+
+def test_gauge_set_inc_dec():
+    r = Registry()
+    g = r.gauge("temp", "Temperature.")
+    g.set(1.5)
+    g.inc()
+    g.dec(0.5)
+    assert "temp 2" in r.expose()
+
+
+def test_histogram_buckets_cumulative():
+    r = Registry()
+    h = r.histogram("lat", "Latency.", buckets=(0.1, 1.0, 10.0))
+    for v in (0.05, 0.5, 0.5, 5.0):
+        h.observe(v)
+    text = r.expose()
+    assert 'lat_bucket{le="0.1"} 1' in text
+    assert 'lat_bucket{le="1"} 3' in text
+    assert 'lat_bucket{le="10"} 4' in text
+    assert 'lat_bucket{le="+Inf"} 4' in text
+    assert "lat_count 4" in text
+    assert "lat_sum 6.05" in text
+
+
+def test_register_idempotent_and_conflict():
+    r = Registry()
+    a = r.counter("c_total", "c")
+    b = r.counter("c_total", "c")
+    assert a is b
+    with pytest.raises(ValueError):
+        r.gauge("c_total", "now a gauge")
+    with pytest.raises(ValueError):
+        r.counter("c_total", "c", ("label",))
+
+
+def test_label_escaping():
+    r = Registry()
+    c = r.counter("e_total", "e", ("v",))
+    c.labels('a"b\\c\nd').inc()
+    text = r.expose()
+    assert 'e_total{v="a\\"b\\\\c\\nd"} 1' in text
+
+
+def test_reset_keeps_registrations():
+    r = Registry()
+    c = r.counter("r_total", "r", ("k",))
+    c.labels("x").inc()
+    r.reset()
+    assert 'r_total{k="x"}' not in r.expose()
+    assert "# TYPE r_total counter" in r.expose()
+    assert r.counter("r_total", "r", ("k",)) is c
+
+
+def test_scheduler_records_attempts(make_cluster):
+    """End-to-end: scheduling a pod through the Scheduler increments
+    nos_scheduler_attempts_total{result=bound} and observes latency."""
+    from nos_tpu import observability as obs
+
+    default_registry().reset()
+    cluster = make_cluster()
+    cluster.add_node("n1", {"google.com/tpu": 4, "cpu": 8})
+    pod = cluster.add_pod("default", "p1", {"google.com/tpu": 2})
+    cluster.run_until_idle()
+    assert cluster.client.get("Pod", "p1", "default").spec.node_name == "n1"
+    assert obs.SCHEDULE_ATTEMPTS.labels("bound").value >= 1
+    text = default_registry().expose()
+    assert "nos_scheduler_e2e_duration_seconds_count" in text
+
+
+def test_quota_controller_exports_used_gauge(make_cluster):
+    from nos_tpu import observability as obs
+
+    default_registry().reset()
+    cluster = make_cluster()
+    cluster.add_node("n1", {"google.com/tpu": 8, "cpu": 8})
+    cluster.add_elastic_quota("default", "eq", minimum={"google.com/tpu": 4},
+                             maximum={"google.com/tpu": 8})
+    cluster.add_pod("default", "p1", {"google.com/tpu": 2})
+    cluster.run_until_idle()
+    # kubelet's role: bound pod starts running
+    cluster.client.patch("Pod", "p1", "default",
+                         lambda p: setattr(p.status, "phase", "Running"))
+    cluster.run_until_idle()
+    assert obs.QUOTA_USED.labels("default/eq", "google.com/tpu").value == 2
