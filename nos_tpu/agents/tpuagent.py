@@ -156,7 +156,11 @@ class TpuAgent:
                 changed[0] = changed[0] or alloc != n.status.allocatable
                 n.status.allocatable = alloc
 
-        client.patch("Node", self.node_name, "", mutate)
+        try:
+            client.patch("Node", self.node_name, "", mutate)
+        except Exception:
+            obs.AGENT_REPORTS.labels("error").inc()
+            raise
         obs.AGENT_REPORTS.labels("changed" if changed[0] else "unchanged").inc()
         self.shared.mark_reported()
         return self._report_result()

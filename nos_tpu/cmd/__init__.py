@@ -1,0 +1,14 @@
+"""Entry-point binaries (analog of the reference's cmd/ tree, SURVEY §2.1).
+
+Each module exposes ``build(args)`` (wire the component, return it without
+running — used by tests) and ``main(argv)`` (parse flags, run the daemon).
+Run as ``python -m nos_tpu.cmd <binary> [flags]``:
+
+  apiserver        the coordination backbone all binaries point at (the
+                   kube-apiserver stand-in; hosts admission webhooks)
+  operator         ElasticQuota/CompositeElasticQuota reconcilers
+  scheduler        quota- and gang-aware pod scheduler
+  partitioner      dynamic TPU partitioning control plane
+  tpuagent         per-node daemon: reporter + actuator
+  metricsexporter  one-shot cluster telemetry snapshot
+"""

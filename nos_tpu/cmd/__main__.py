@@ -1,0 +1,28 @@
+"""Dispatch: ``python -m nos_tpu.cmd <binary> [flags]``."""
+from __future__ import annotations
+
+import sys
+
+_BINARIES = {
+    "apiserver": "nos_tpu.cmd.apiserver",
+    "operator": "nos_tpu.cmd.operator",
+    "scheduler": "nos_tpu.cmd.scheduler",
+    "partitioner": "nos_tpu.cmd.partitioner",
+    "tpuagent": "nos_tpu.cmd.tpuagent",
+    "metricsexporter": "nos_tpu.cmd.metricsexporter",
+}
+
+
+def main() -> None:
+    if len(sys.argv) < 2 or sys.argv[1] not in _BINARIES:
+        names = ", ".join(sorted(_BINARIES))
+        print(f"usage: python -m nos_tpu.cmd <{names}> [flags]", file=sys.stderr)
+        raise SystemExit(2)
+    import importlib
+
+    mod = importlib.import_module(_BINARIES[sys.argv[1]])
+    mod.main(sys.argv[2:])
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,60 @@
+"""nos-tpu-apiserver — the coordination backbone.
+
+The reference's binaries all point at the cluster's kube-apiserver; this
+binary is that backbone for a self-contained nos-tpu deployment (and the
+envtest double for integration tests): it hosts the typed object store,
+admission webhooks (analog of the operator's validating webhooks,
+pkg/api/nos.nebuly.com/v1alpha1/*_webhook.go), the standard field indexes
+(cmd/gpupartitioner/gpupartitioner.go:270-292), and serves the JSON/HTTP
+API every other binary's RemoteApiServer speaks.
+"""
+from __future__ import annotations
+
+import argparse
+from typing import Optional, Sequence
+
+from nos_tpu.api.webhooks import register_quota_webhooks
+from nos_tpu.cmd import serve
+from nos_tpu.kube.apiserver import ApiServer
+from nos_tpu.kube.httpapi import ApiHttpServer
+
+
+def register_standard_indexes(server: ApiServer) -> None:
+    """Field indexes the controllers list by (reference
+    cmd/gpupartitioner/gpupartitioner.go:270-292: pod phase + node name)."""
+    server.register_index("Pod", "status.phase", lambda p: p.status.phase)
+    server.register_index("Pod", "spec.nodeName", lambda p: p.spec.node_name or None)
+
+
+def build(host: str = "127.0.0.1", port: int = 8001,
+          quota_webhooks: bool = True) -> ApiHttpServer:
+    server = ApiServer()
+    register_standard_indexes(server)
+    if quota_webhooks:
+        register_quota_webhooks(server)
+    return ApiHttpServer(server, host=host, port=port)
+
+
+def main(argv: Optional[Sequence[str]] = None) -> None:
+    parser = argparse.ArgumentParser(prog="nos-tpu-apiserver",
+                                     description=__doc__)
+    parser.add_argument("--host", default="127.0.0.1")
+    parser.add_argument("--port", type=int, default=8001)
+    parser.add_argument(
+        "--no-quota-webhooks", action="store_true",
+        help="disable ElasticQuota/CompositeElasticQuota admission validation",
+    )
+    parser.add_argument("--log-level", type=int, default=0)
+    args = parser.parse_args(argv)
+    serve.setup_logging(args.log_level)
+
+    http = build(args.host, args.port, quota_webhooks=not args.no_quota_webhooks)
+    print(f"nos-tpu-apiserver listening at {http.address}")
+    try:
+        http.serve_forever()
+    except KeyboardInterrupt:
+        http.stop()
+
+
+if __name__ == "__main__":
+    main()

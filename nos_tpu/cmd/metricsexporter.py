@@ -1,0 +1,106 @@
+"""nos-tpu-metrics-exporter — one-shot cluster telemetry snapshot.
+
+Analog of cmd/metricsexporter (metricsexporter.go:33-91 + metrics.go:24-42):
+collects cluster facts (nodes, accelerator types, chip counts, quota
+objects) into one JSON document and writes it to a file/stdout. The
+reference POSTs to a vendor endpoint; here upload is gated behind
+--endpoint and off by default (and a no-egress environment simply keeps
+the file).
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import Optional, Sequence
+
+from nos_tpu import constants
+from nos_tpu.cmd import serve
+from nos_tpu.kube.client import Client
+
+
+def collect(client: Client) -> dict:
+    nodes = []
+    for node in client.list("Node"):
+        labels = node.metadata.labels
+        nodes.append({
+            "name": node.metadata.name,
+            "accelerator": labels.get(constants.LABEL_TPU_ACCELERATOR),
+            "topology": labels.get(constants.LABEL_TPU_TOPOLOGY),
+            "partitioning": labels.get(constants.LABEL_PARTITIONING),
+            "tpu_chips": node.status.allocatable.get(constants.RESOURCE_TPU, 0),
+            "tpu_slices": {
+                k: v for k, v in node.status.allocatable.items()
+                if k.startswith(constants.RESOURCE_TPU_SLICE_PREFIX)
+            },
+        })
+    quotas = [
+        {
+            "namespace": q.metadata.namespace,
+            "name": q.metadata.name,
+            "min": q.spec.min,
+            "max": q.spec.max,
+            "used": q.status.used,
+        }
+        for q in client.list("ElasticQuota")
+    ]
+    composite = [
+        {
+            "name": q.metadata.name,
+            "namespaces": q.spec.namespaces,
+            "min": q.spec.min,
+            "max": q.spec.max,
+            "used": q.status.used,
+        }
+        for q in client.list("CompositeElasticQuota")
+    ]
+    pods = client.list("Pod")
+    return {
+        "version": "v0.1",
+        "nodes": nodes,
+        "elastic_quotas": quotas,
+        "composite_elastic_quotas": composite,
+        "pod_count": len(pods),
+        "tpu_pod_count": sum(
+            1 for p in pods
+            if any(
+                r == constants.RESOURCE_TPU
+                or r.startswith(constants.RESOURCE_TPU_SLICE_PREFIX)
+                for r in p.request()
+            )
+        ),
+    }
+
+
+def main(argv: Optional[Sequence[str]] = None) -> None:
+    parser = argparse.ArgumentParser(prog="nos-tpu-metrics-exporter",
+                                     description=__doc__)
+    serve.common_flags(parser, config=False)
+    parser.add_argument("--output", default="-",
+                        help="file to write the snapshot to ('-' = stdout)")
+    parser.add_argument(
+        "--endpoint", default=None,
+        help="optional URL to POST the snapshot to (disabled by default)",
+    )
+    args = parser.parse_args(argv)
+
+    client = Client(serve.connect(args))
+    doc = json.dumps(collect(client), indent=2, sort_keys=True)
+    if args.output == "-":
+        print(doc)
+    else:
+        with open(args.output, "w") as f:
+            f.write(doc + "\n")
+    if args.endpoint:
+        import urllib.request
+
+        req = urllib.request.Request(
+            args.endpoint, data=doc.encode(), method="POST",
+            headers={"Content-Type": "application/json"},
+        )
+        with urllib.request.urlopen(req, timeout=30) as resp:
+            print(f"uploaded: HTTP {resp.status}", file=sys.stderr)
+
+
+if __name__ == "__main__":
+    main()

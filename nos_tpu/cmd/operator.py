@@ -1,0 +1,48 @@
+"""nos-tpu-operator — quota reconcilers.
+
+Analog of cmd/operator/operator.go:50-126: a manager running the
+ElasticQuota + CompositeElasticQuota reconcilers (the validating webhooks
+live with the apiserver binary, which is the admission path here) with
+healthz/readyz probes and metrics.
+"""
+from __future__ import annotations
+
+import argparse
+from typing import Optional, Sequence
+
+from nos_tpu.api.configs import OperatorConfig
+from nos_tpu.cmd import serve
+from nos_tpu.kube.controller import Manager
+from nos_tpu.quota.controller import (
+    CompositeElasticQuotaReconciler,
+    ElasticQuotaReconciler,
+)
+from nos_tpu.tpu.resource_calc import ResourceCalculator
+
+
+def build(server, config: Optional[OperatorConfig] = None) -> Manager:
+    cfg = config or OperatorConfig()
+    calc = ResourceCalculator(
+        tpu_memory_gb=cfg.tpu_resource_memory_gb,
+        nvidia_gpu_memory_gb=cfg.nvidia_gpu_resource_memory_gb,
+    )
+    mgr = Manager(server)
+    mgr.add_controller(ElasticQuotaReconciler(calc).controller())
+    mgr.add_controller(CompositeElasticQuotaReconciler(calc).controller())
+    return mgr
+
+
+def main(argv: Optional[Sequence[str]] = None) -> None:
+    parser = argparse.ArgumentParser(prog="nos-tpu-operator", description=__doc__)
+    serve.common_flags(parser)
+    args = parser.parse_args(argv)
+
+    cfg = OperatorConfig.from_yaml_file(args.config) if args.config \
+        else OperatorConfig()
+    serve.setup_logging(cfg.log_level)
+    mgr = build(serve.connect(args), cfg)
+    serve.run_daemon(mgr, args.health_port)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,58 @@
+"""nos-tpu-partitioner — the dynamic partitioning control plane.
+
+Analog of cmd/gpupartitioner/gpupartitioner.go:72-268: cluster-state
+node/pod controllers, the batched planning loop, and the known-generations
+override file (the analog of the known-MIG-geometries YAML,
+gpupartitioner.go:123-135 + 370-380).
+"""
+from __future__ import annotations
+
+import argparse
+from typing import Optional, Sequence
+
+from nos_tpu.api.configs import PartitionerConfig
+from nos_tpu.cmd import serve
+from nos_tpu.kube.controller import Manager
+from nos_tpu.partitioning import (
+    NodeController,
+    PartitioningController,
+    PodController,
+)
+from nos_tpu.partitioning.state import ClusterState
+from nos_tpu.tpu import topology
+
+
+def build(server, config: Optional[PartitionerConfig] = None) -> Manager:
+    cfg = config or PartitionerConfig()
+    if cfg.known_generations_file:
+        topology.set_known_generations(
+            topology.load_generations_file(cfg.known_generations_file)
+        )
+    state = ClusterState()
+    mgr = Manager(server)
+    mgr.add_controller(NodeController(state).controller())
+    mgr.add_controller(PodController(state).controller())
+    mgr.add_controller(
+        PartitioningController(
+            state,
+            batch_timeout_s=cfg.batch_window_timeout_seconds,
+            batch_idle_s=cfg.batch_window_idle_seconds,
+        ).controller()
+    )
+    return mgr
+
+
+def main(argv: Optional[Sequence[str]] = None) -> None:
+    parser = argparse.ArgumentParser(prog="nos-tpu-partitioner", description=__doc__)
+    serve.common_flags(parser)
+    args = parser.parse_args(argv)
+
+    cfg = PartitionerConfig.from_yaml_file(args.config) if args.config \
+        else PartitionerConfig()
+    serve.setup_logging(cfg.log_level)
+    mgr = build(serve.connect(args), cfg)
+    serve.run_daemon(mgr, args.health_port)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,44 @@
+"""nos-tpu-scheduler — the quota- and gang-aware scheduler.
+
+Analog of cmd/scheduler/scheduler.go:43-59 (a kube-scheduler with the
+CapacityScheduling plugin registered). The plugin args come from a config
+file the way the reference's KubeSchedulerConfiguration carries
+CapacitySchedulingArgs (pkg/api/scheduler/types.go:20-27).
+"""
+from __future__ import annotations
+
+import argparse
+from typing import Optional, Sequence
+
+from nos_tpu.api.configs import CapacitySchedulingArgs
+from nos_tpu.cmd import serve
+from nos_tpu.kube.controller import Manager
+from nos_tpu.scheduler import Scheduler
+from nos_tpu.tpu.resource_calc import ResourceCalculator
+
+
+def build(server, config: Optional[CapacitySchedulingArgs] = None) -> Manager:
+    cfg = config or CapacitySchedulingArgs()
+    calc = ResourceCalculator(
+        tpu_memory_gb=cfg.tpu_resource_memory_gb,
+        nvidia_gpu_memory_gb=cfg.nvidia_gpu_resource_memory_gb,
+    )
+    mgr = Manager(server)
+    mgr.add_controller(Scheduler(calculator=calc).controller())
+    return mgr
+
+
+def main(argv: Optional[Sequence[str]] = None) -> None:
+    parser = argparse.ArgumentParser(prog="nos-tpu-scheduler", description=__doc__)
+    serve.common_flags(parser)
+    args = parser.parse_args(argv)
+
+    cfg = CapacitySchedulingArgs.from_yaml_file(args.config) if args.config \
+        else CapacitySchedulingArgs()
+    serve.setup_logging(cfg.log_level)
+    mgr = build(serve.connect(args), cfg)
+    serve.run_daemon(mgr, args.health_port)
+
+
+if __name__ == "__main__":
+    main()

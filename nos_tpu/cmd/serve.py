@@ -1,0 +1,114 @@
+"""Shared daemon plumbing for the cmd/ binaries.
+
+Every reference binary serves healthz/readyz probes and a metrics endpoint
+(cmd/operator/operator.go:112-119; metrics.bindAddress in the component
+ConfigMaps). ``HealthServer`` provides those three endpoints for any
+Manager-hosting process; ``common_flags``/``connect`` standardize the
+--api / --health-port flags.
+"""
+from __future__ import annotations
+
+import argparse
+import logging
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+from nos_tpu.kube.httpapi import RemoteApiServer
+from nos_tpu.utils.metrics import default_registry
+
+logger = logging.getLogger(__name__)
+
+
+class HealthServer:
+    """Serves /healthz, /readyz, /metrics for one binary."""
+
+    def __init__(self, manager=None, host: str = "127.0.0.1", port: int = 0):
+        mgr = manager
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, fmt, *args):
+                pass
+
+            def _send(self, status: int, text: str) -> None:
+                body = text.encode()
+                self.send_response(status)
+                self.send_header("Content-Type", "text/plain; version=0.0.4")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                if self.path == "/healthz":
+                    ok = mgr.healthz() if mgr is not None else True
+                    self._send(200 if ok else 500, "ok" if ok else "unhealthy")
+                elif self.path == "/readyz":
+                    ok = mgr.readyz() if mgr is not None else True
+                    self._send(200 if ok else 500, "ok" if ok else "not ready")
+                elif self.path == "/metrics":
+                    self._send(200, default_registry().expose())
+                else:
+                    self._send(404, "not found")
+
+        self.httpd = ThreadingHTTPServer((host, port), Handler)
+        self.httpd.daemon_threads = True
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def address(self) -> str:
+        host, port = self.httpd.server_address[:2]
+        return f"http://{host}:{port}"
+
+    def start(self) -> "HealthServer":
+        self._thread = threading.Thread(
+            target=self.httpd.serve_forever, name="health-http", daemon=True
+        )
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self.httpd.shutdown()
+        if self._thread:
+            self._thread.join(timeout=5)
+
+
+def common_flags(parser: argparse.ArgumentParser, config: bool = True) -> None:
+    parser.add_argument(
+        "--api", default="http://127.0.0.1:8001",
+        help="URL of the nos-tpu apiserver binary",
+    )
+    parser.add_argument(
+        "--health-port", type=int, default=0,
+        help="healthz/readyz/metrics port (0 = ephemeral)",
+    )
+    if config:
+        parser.add_argument(
+            "-config", "--config", dest="config", default=None,
+            help="component config YAML (reference: ctrl.ConfigFile().AtPath)",
+        )
+
+
+def connect(args) -> RemoteApiServer:
+    remote = RemoteApiServer(args.api)
+    if not remote.healthz():
+        raise SystemExit(f"apiserver at {args.api} is not reachable")
+    return remote
+
+
+def setup_logging(level: int = 0) -> None:
+    logging.basicConfig(
+        level=logging.DEBUG if level > 0 else logging.INFO,
+        format="%(asctime)s %(levelname)s %(name)s: %(message)s",
+    )
+
+
+def run_daemon(manager, health_port: int) -> None:
+    health = HealthServer(manager, port=health_port).start()
+    logger.info("health endpoints at %s", health.address)
+    try:
+        manager.run()
+    except KeyboardInterrupt:
+        pass
+    finally:
+        manager.stop()
+        health.stop()

@@ -1,0 +1,69 @@
+"""nos-tpu-agent — the per-node daemon.
+
+Analog of cmd/migagent (reporter + actuator + startup resync,
+migagent.go:165-199) and cmd/gpuagent. The device boundary is the C++
+native layer (native/tpuagent/tpuagent.cc via ctypes — the cgo/NVML
+analog); --mock substitutes the in-memory device double for clusters
+without the library (and for tests).
+"""
+from __future__ import annotations
+
+import argparse
+import os
+from typing import Optional, Sequence
+
+from nos_tpu.agents.tpu_native import MockTpuClient, TpuClientError, TpuNativeClient
+from nos_tpu.agents.tpuagent import TpuAgent
+from nos_tpu.api.configs import TpuAgentConfig
+from nos_tpu.cmd import serve
+from nos_tpu.kube.client import Client
+from nos_tpu.kube.controller import Manager
+
+
+def build(server, node_name: str, config: Optional[TpuAgentConfig] = None,
+          tpu_client=None, mock_chips: int = 8) -> Manager:
+    cfg = config or TpuAgentConfig()
+    if tpu_client is None:
+        try:
+            tpu_client = TpuNativeClient()
+        except TpuClientError:
+            tpu_client = MockTpuClient(chips=mock_chips)
+    agent = TpuAgent(
+        node_name,
+        tpu_client,
+        report_interval_s=cfg.report_interval_seconds,
+    )
+    agent.startup_cleanup(Client(server))
+    mgr = Manager(server)
+    for c in agent.controllers():
+        mgr.add_controller(c)
+    return mgr
+
+
+def main(argv: Optional[Sequence[str]] = None) -> None:
+    parser = argparse.ArgumentParser(prog="nos-tpu-agent", description=__doc__)
+    serve.common_flags(parser)
+    parser.add_argument(
+        "--node-name", default=os.environ.get("NODE_NAME", ""),
+        help="this node's name (GKE downward API sets NODE_NAME)",
+    )
+    parser.add_argument(
+        "--mock", action="store_true",
+        help="use the in-memory device double instead of the native layer",
+    )
+    parser.add_argument("--mock-chips", type=int, default=8)
+    args = parser.parse_args(argv)
+    if not args.node_name:
+        parser.error("--node-name (or NODE_NAME env) is required")
+
+    cfg = TpuAgentConfig.from_yaml_file(args.config) if args.config \
+        else TpuAgentConfig()
+    serve.setup_logging(cfg.log_level)
+    tpu_client = MockTpuClient(chips=args.mock_chips) if args.mock else None
+    mgr = build(serve.connect(args), args.node_name, cfg, tpu_client=tpu_client,
+                mock_chips=args.mock_chips)
+    serve.run_daemon(mgr, args.health_port)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,70 @@
+"""Wire (de)serialization for API objects.
+
+The reference's objects travel as JSON through the real kube-apiserver;
+here the typed dataclasses in ``nos_tpu.kube.objects`` / ``nos_tpu.api``
+are converted to/from plain dicts so the HTTP API facade
+(``nos_tpu.kube.httpapi``) can move them between the cmd/ binaries.
+
+Generic over any registered dataclass kind — nested dataclasses, Optional,
+List[...] and Dict[...] fields are reconstructed from type hints.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional, Union, get_args, get_origin, get_type_hints
+
+from nos_tpu.api.quota import CompositeElasticQuota, ElasticQuota
+from nos_tpu.kube.objects import ConfigMap, Node, Pod, kind_of
+
+KINDS: Dict[str, type] = {
+    c.KIND: c for c in (Pod, Node, ConfigMap, ElasticQuota, CompositeElasticQuota)
+}
+
+
+def register_kind(cls: type) -> type:
+    """Register an additional API kind (must be a dataclass with KIND)."""
+    KINDS[cls.KIND] = cls
+    return cls
+
+
+def to_wire(obj) -> dict:
+    d = dataclasses.asdict(obj)
+    d["kind"] = kind_of(obj)
+    return d
+
+
+def _coerce(tp, val):
+    if val is None:
+        return None
+    origin = get_origin(tp)
+    if origin is Union:
+        args = [a for a in get_args(tp) if a is not type(None)]
+        return _coerce(args[0], val) if args else val
+    if origin in (list, List):
+        (item_tp,) = get_args(tp) or (None,)
+        return [_coerce(item_tp, v) for v in val]
+    if origin in (dict, Dict):
+        args = get_args(tp)
+        val_tp = args[1] if len(args) == 2 else None
+        return {k: _coerce(val_tp, v) for k, v in val.items()}
+    if dataclasses.is_dataclass(tp):
+        return _from_dict(tp, val)
+    return val
+
+
+def _from_dict(cls: type, data: dict):
+    hints = get_type_hints(cls)
+    kwargs = {}
+    for f in dataclasses.fields(cls):
+        if f.name in data:
+            kwargs[f.name] = _coerce(hints[f.name], data[f.name])
+    return cls(**kwargs)
+
+
+def from_wire(data: dict):
+    kind = data.get("kind")
+    cls = KINDS.get(kind or "")
+    if cls is None:
+        raise ValueError(f"unknown kind {kind!r}")
+    body = {k: v for k, v in data.items() if k != "kind"}
+    return _from_dict(cls, body)

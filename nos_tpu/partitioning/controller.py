@@ -206,16 +206,29 @@ class PartitioningController:
         self._update_utilization_gauges()
 
     def _update_utilization_gauges(self) -> None:
-        """North-star gauges: allocatable vs used TPU chips on managed nodes."""
+        """North-star gauges: allocatable vs used TPU chips on managed nodes.
+        Partitioned nodes advertise sub-slice resources INSTEAD of whole
+        chips, so both are converted to chip counts."""
+        from nos_tpu.tpu.slice import parse_profile
+
+        def chips(resources) -> float:
+            n = resources.get(constants.RESOURCE_TPU, 0)
+            for r, qty in resources.items():
+                if r.startswith(constants.RESOURCE_TPU_SLICE_PREFIX):
+                    try:
+                        n += qty * parse_profile(r).chips
+                    except ValueError:
+                        continue  # malformed resource name
+            return n
+
         allocatable = 0.0
         used = 0.0
         for node in self.state.nodes():
             if not node.metadata.labels.get(constants.LABEL_PARTITIONING):
                 continue
-            allocatable += node.status.allocatable.get(constants.RESOURCE_TPU, 0)
+            allocatable += chips(node.status.allocatable)
             for pod in self.state.pods_on(node.metadata.name):
-                req = pod.request()
-                used += req.get(constants.RESOURCE_TPU, 0)
+                used += chips(pod.request())
         obs.CHIPS_ALLOCATABLE.set(allocatable)
         obs.CHIPS_USED.set(used)
 

@@ -110,12 +110,24 @@ def _map_pod_to_quota(kind: str):
     return mapper
 
 
+def _quota_metric_name(namespace: str, name: str) -> str:
+    return f"{namespace}/{name}" if namespace else name
+
+
 def _export_quota_metrics(quota, used: ResourceList, over_quota: int) -> None:
-    qname = f"{quota.metadata.namespace}/{quota.metadata.name}" \
-        if quota.metadata.namespace else quota.metadata.name
+    qname = _quota_metric_name(quota.metadata.namespace, quota.metadata.name)
+    # drop resources no longer in spec.min before re-exporting, so a
+    # shrunk quota doesn't leave phantom series behind
+    obs.QUOTA_USED.clear_label("quota", qname)
     for resource, value in used.items():
         obs.QUOTA_USED.labels(qname, resource).set(value)
     obs.OVERQUOTA_PODS.labels(qname).set(over_quota)
+
+
+def _clear_quota_metrics(namespace: str, name: str) -> None:
+    qname = _quota_metric_name(namespace, name)
+    obs.QUOTA_USED.clear_label("quota", qname)
+    obs.OVERQUOTA_PODS.clear_label("quota", qname)
 
 
 class ElasticQuotaReconciler:
@@ -131,6 +143,7 @@ class ElasticQuotaReconciler:
         try:
             eq = client.get("ElasticQuota", req.name, req.namespace)
         except NotFound:
+            _clear_quota_metrics(req.namespace, req.name)
             return Result()
         self._reconcile_one(client, eq)
         return Result()
@@ -172,6 +185,7 @@ class CompositeElasticQuotaReconciler:
         try:
             ceq = client.get("CompositeElasticQuota", req.name, req.namespace)
         except NotFound:
+            _clear_quota_metrics(req.namespace, req.name)
             return Result()
         self._reconcile_one(client, ceq)
         return Result()

@@ -73,6 +73,20 @@ def required_topology_name(pod: Pod) -> Optional[str]:
     return pod.metadata.annotations.get(constants.ANNOTATION_TPU_TOPOLOGY)
 
 
+@dataclass(frozen=True)
+class GangAdmission:
+    """Typed admission verdict. Iterable as (ok, reason) for the common
+    unpacking; ``waiting`` distinguishes an incomplete gang (members still
+    arriving) from a hard rejection without parsing the reason text."""
+
+    ok: bool
+    reason: str = ""
+    waiting: bool = False
+
+    def __iter__(self):
+        return iter((self.ok, self.reason))
+
+
 @dataclass
 class GangPlacement:
     """node name per gang member pod (same order as ``pods``)."""
@@ -99,26 +113,35 @@ class GangScheduler:
         return members
 
     # ------------------------------------------------------------------
-    def admit(self, members: List[Pod]) -> Tuple[bool, str]:
+    def admit(self, members: List[Pod]) -> "GangAdmission":
         """Gang-level admission: completeness, consistent declaration,
-        topology validity, quota bounds on the aggregate request."""
+        topology validity, quota bounds on the aggregate request.
+        ``waiting`` marks the not-yet-complete case (more members expected)
+        as distinct from a hard rejection — metric/backoff classification
+        must not parse the human-readable reason."""
         if not members:
-            return False, "empty gang"
+            return GangAdmission(False, "empty gang")
         declared = gang_size(members[0])
         if declared is None:
-            return False, "missing or invalid gang-size label"
+            return GangAdmission(False, "missing or invalid gang-size label")
         if len(members) < declared:
-            return False, f"waiting for gang: {len(members)}/{declared} members exist"
+            return GangAdmission(
+                False,
+                f"waiting for gang: {len(members)}/{declared} members exist",
+                waiting=True,
+            )
         if len(members) > declared:
-            return False, f"gang has {len(members)} members, declared {declared}"
+            return GangAdmission(
+                False, f"gang has {len(members)} members, declared {declared}")
         workers = sorted(gang_worker(p) for p in members)
         if workers != list(range(declared)):
-            return False, f"gang worker indexes {workers} != 0..{declared - 1}"
+            return GangAdmission(
+                False, f"gang worker indexes {workers} != 0..{declared - 1}")
         topo_name = required_topology_name(members[0])
         if not topo_name:
-            return False, "missing nos.ai/tpu-topology annotation"
+            return GangAdmission(False, "missing nos.ai/tpu-topology annotation")
         if any(required_topology_name(p) != topo_name for p in members):
-            return False, "gang members disagree on tpu-topology"
+            return GangAdmission(False, "gang members disagree on tpu-topology")
         # quota: aggregate request admitted as one unit. Already-bound
         # members (partial bind from a crashed prior cycle) are excluded:
         # the scheduler's state sync has already tracked their requests
@@ -135,10 +158,11 @@ class GangScheduler:
             info = self.capacity.quotas.get(members[0].metadata.namespace)
             if info is not None:
                 if info.used_over_max_with(total):
-                    return False, "gang would exceed max quota"
+                    return GangAdmission(False, "gang would exceed max quota")
                 if self.capacity.quotas.aggregated_used_over_min_with(total):
-                    return False, "gang would exceed aggregated min quota"
-        return True, ""
+                    return GangAdmission(
+                        False, "gang would exceed aggregated min quota")
+        return GangAdmission(True, "")
 
     # ------------------------------------------------------------------
     def place(

@@ -93,6 +93,9 @@ class Scheduler:
         started = time.monotonic()
         try:
             return self._schedule_one_inner(client, pod, snapshot)
+        except Exception:
+            obs.SCHEDULE_ATTEMPTS.labels("error").inc()
+            raise
         finally:
             obs.SCHEDULE_DURATION.observe(time.monotonic() - started)
 
@@ -150,13 +153,13 @@ class Scheduler:
         if not pending:
             return Result()
 
-        ok, reason = self.gang.admit(members)
-        if not ok:
+        admission = self.gang.admit(members)
+        if not admission.ok:
             obs.SCHEDULE_ATTEMPTS.labels(
-                "gang_wait" if "waiting for gang" in reason else "unschedulable"
+                "gang_wait" if admission.waiting else "unschedulable"
             ).inc()
             for p in pending:
-                self._mark_unschedulable(client, p, reason)
+                self._mark_unschedulable(client, p, admission.reason)
             return Result()
 
         # place() receives the FULL gang: already-bound members (partial bind

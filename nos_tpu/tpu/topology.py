@@ -147,6 +147,65 @@ def reset_known_generations() -> None:
     allowed_geometries.cache_clear()
 
 
+def load_generations_file(path: str) -> List[Generation]:
+    """Load a generation-table override from YAML (the analog of the
+    reference's known-MIG-geometries file, cmd/gpupartitioner/
+    gpupartitioner.go:123-135 + SetKnownGeometries). Schema:
+
+    generations:
+      - name: tpu-v5-lite-podslice
+        short: v5e
+        host_rows: 2
+        host_cols: 4
+        hbm_gb_per_chip: 16
+        subslice_profiles: ["1x1", "2x2", "2x4"]
+        topologies: ["1x1", "2x2", "2x4", "4x4"]
+    """
+    import yaml
+
+    from nos_tpu.tpu.slice import Profile
+
+    def dims(s: str, want: Tuple[int, ...]) -> Tuple[int, ...]:
+        try:
+            d = tuple(int(p) for p in str(s).split("x"))
+        except ValueError as e:
+            raise ValueError(f"{path}: bad topology/profile {s!r}") from e
+        if len(d) not in want or any(v < 1 for v in d):
+            raise ValueError(
+                f"{path}: {s!r} must be {' or '.join(str(w) for w in want)} "
+                f"positive dims")
+        return d
+
+    with open(path) as f:
+        data = yaml.safe_load(f) or {}
+    gens: List[Generation] = []
+    for entry in data.get("generations", []):
+        missing = {"name", "short", "host_rows", "host_cols",
+                   "hbm_gb_per_chip"} - set(entry)
+        if missing:
+            raise ValueError(f"{path}: generation missing keys {sorted(missing)}")
+        profiles = [
+            Profile(*dims(p, want=(2,)))
+            for p in entry.get("subslice_profiles", [])
+        ]
+        topos = tuple(
+            SliceTopology(dims(t, want=(2, 3)))
+            for t in entry.get("topologies", [])
+        )
+        gens.append(Generation(
+            name=entry["name"],
+            short=entry["short"],
+            host_rows=int(entry["host_rows"]),
+            host_cols=int(entry["host_cols"]),
+            hbm_gb_per_chip=int(entry["hbm_gb_per_chip"]),
+            subslice_profiles=tuple(profiles),
+            topologies=topos,
+        ))
+    if not gens:
+        raise ValueError(f"{path}: no generations defined")
+    return gens
+
+
 def get_generation(name: str) -> Optional[Generation]:
     return GENERATIONS.get(name)
 

@@ -83,6 +83,23 @@ class _Metric:
             raise ValueError(f"metric {self.name} requires labels {self.labelnames}")
         return self.labels()
 
+    def remove(self, *values) -> None:
+        """Drop one label series (exact match). No-op if absent."""
+        with self._lock:
+            self._children.pop(tuple(str(v) for v in values), None)
+
+    def clear_label(self, labelname: str, value: str) -> None:
+        """Drop every series whose ``labelname`` equals ``value`` — used when
+        the labeled object (e.g. a quota) is deleted, so stale series don't
+        export forever."""
+        try:
+            i = self.labelnames.index(labelname)
+        except ValueError:
+            return
+        with self._lock:
+            for key in [k for k in self._children if k[i] == str(value)]:
+                del self._children[key]
+
     def _new_child(self):  # pragma: no cover - overridden
         raise NotImplementedError
 

@@ -1,0 +1,347 @@
+"""Wire serialization + the HTTP apiserver facade + cmd/ binaries.
+
+The reference's binaries coordinate only through the API server (SURVEY §1);
+these tests prove the same works here across real process boundaries: an
+ApiHttpServer hosting the store, RemoteApiServer clients doing typed CRUD,
+optimistic-concurrency patches, watches, and full multi-"binary" flows
+(operator + scheduler + agent managers over HTTP).
+"""
+import threading
+
+import pytest
+
+from nos_tpu import constants
+from nos_tpu.api.quota import make_elastic_quota
+from nos_tpu.kube import serial
+from nos_tpu.kube.apiserver import AdmissionDenied, Conflict, NotFound
+from nos_tpu.kube.objects import (
+    Container,
+    Node,
+    NodeStatus,
+    ObjectMeta,
+    Pod,
+    PodCondition,
+    PodSpec,
+    PodStatus,
+)
+
+
+def sample_pod():
+    return Pod(
+        metadata=ObjectMeta(name="p", namespace="ns", labels={"a": "b"},
+                            annotations={"k": "v"}),
+        spec=PodSpec(
+            containers=[Container(requests={"google.com/tpu": 4})],
+            scheduler_name=constants.SCHEDULER_NAME,
+            priority=10,
+        ),
+        status=PodStatus(phase="Pending", conditions=[
+            PodCondition(type="PodScheduled", status="False",
+                         reason="Unschedulable", message="m")]),
+    )
+
+
+# ---------------------------------------------------------------------------
+# serialization
+# ---------------------------------------------------------------------------
+
+def test_wire_roundtrip_pod():
+    pod = sample_pod()
+    back = serial.from_wire(serial.to_wire(pod))
+    assert back == pod
+
+
+def test_wire_roundtrip_all_kinds():
+    from nos_tpu.api.quota import make_composite_elastic_quota
+    from nos_tpu.kube.objects import ConfigMap
+
+    objs = [
+        sample_pod(),
+        Node(metadata=ObjectMeta(name="n"),
+             status=NodeStatus(allocatable={"google.com/tpu": 8})),
+        ConfigMap(metadata=ObjectMeta(name="cm", namespace="ns"),
+                  data={"x": "y"}),
+        make_elastic_quota("eq", "ns", {"google.com/tpu": 4},
+                           {"google.com/tpu": 8}),
+        make_composite_elastic_quota("ceq", "", ["a", "b"],
+                                     {"google.com/tpu": 4}),
+    ]
+    for obj in objs:
+        assert serial.from_wire(serial.to_wire(obj)) == obj
+
+
+def test_wire_optional_none_preserved():
+    eq = make_elastic_quota("eq", "ns", {"cpu": 1})  # max=None
+    back = serial.from_wire(serial.to_wire(eq))
+    assert back.spec.max is None
+
+
+def test_wire_unknown_kind_rejected():
+    with pytest.raises(ValueError):
+        serial.from_wire({"kind": "Nope"})
+
+
+# ---------------------------------------------------------------------------
+# HTTP facade
+# ---------------------------------------------------------------------------
+
+@pytest.fixture
+def http_rig():
+    from nos_tpu.cmd.apiserver import build
+    from nos_tpu.kube.httpapi import RemoteApiServer
+
+    http = build(port=0).start()
+    try:
+        yield http, RemoteApiServer(http.address)
+    finally:
+        http.stop()
+
+
+def test_http_crud_roundtrip(http_rig):
+    http, remote = http_rig
+    pod = sample_pod()
+    created = remote.create(pod)
+    assert created.metadata.uid
+
+    got = remote.get("Pod", "p", "ns")
+    assert got.spec.containers[0].requests == {"google.com/tpu": 4}
+
+    assert [p.metadata.name for p in remote.list("Pod", namespace="ns")] == ["p"]
+    assert remote.list("Pod", label_selector={"a": "b"})
+    assert not remote.list("Pod", label_selector={"a": "nope"})
+
+    remote.patch("Pod", "p", "ns", lambda p: p.metadata.labels.update({"c": "d"}))
+    assert remote.get("Pod", "p", "ns").metadata.labels["c"] == "d"
+
+    remote.delete("Pod", "p", "ns")
+    with pytest.raises(NotFound):
+        remote.get("Pod", "p", "ns")
+    assert remote.try_get("Pod", "p", "ns") is None
+
+
+def test_http_update_conflict(http_rig):
+    http, remote = http_rig
+    remote.create(sample_pod())
+    stale = remote.get("Pod", "p", "ns")
+    remote.patch("Pod", "p", "ns", lambda p: p.metadata.labels.update({"x": "1"}))
+    stale.metadata.labels["y"] = "2"
+    with pytest.raises(Conflict):
+        remote.update(stale)
+
+
+def test_http_concurrent_patchers_all_land(http_rig):
+    """Optimistic concurrency over HTTP: concurrent patch() retry loops
+    must each land their label."""
+    http, remote_factory = http_rig
+    from nos_tpu.kube.httpapi import RemoteApiServer
+
+    remote_factory.create(sample_pod())
+    errors = []
+
+    def patcher(i):
+        r = RemoteApiServer(http.address)
+        try:
+            r.patch("Pod", "p", "ns",
+                    lambda p, i=i: p.metadata.labels.update({f"w{i}": "1"}))
+        except Exception as e:  # noqa: BLE001
+            errors.append(e)
+
+    threads = [threading.Thread(target=patcher, args=(i,)) for i in range(6)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errors
+    labels = remote_factory.get("Pod", "p", "ns").metadata.labels
+    assert all(f"w{i}" in labels for i in range(6))
+
+
+def test_http_admission_denied(http_rig):
+    http, remote = http_rig
+    remote.create(make_elastic_quota("eq1", "ns", {"cpu": 1}))
+    with pytest.raises(AdmissionDenied):
+        remote.create(make_elastic_quota("eq2", "ns", {"cpu": 1}))
+
+
+def test_http_watch_stream(http_rig):
+    http, remote = http_rig
+    sub = remote.subscribe(["Pod"])
+    remote.create(sample_pod())
+    remote.patch("Pod", "p", "ns", lambda p: p.metadata.labels.update({"z": "1"}))
+    assert sub.wait(timeout=2.0)
+    events = []
+    ev = sub.pop()
+    while ev is not None:
+        events.append(ev)
+        ev = sub.pop()
+    assert [e.type for e in events] == ["ADDED", "MODIFIED"]
+    assert events[0].obj.metadata.name == "p"
+    remote.unsubscribe(sub)
+
+
+def test_http_healthz(http_rig):
+    http, remote = http_rig
+    assert remote.healthz()
+
+
+# ---------------------------------------------------------------------------
+# cmd/ binaries wired over HTTP — the multi-process deployment shape
+# ---------------------------------------------------------------------------
+
+def test_binaries_over_http_schedule_and_account():
+    """operator + scheduler as separate managers, each with its own remote
+    client (separate 'processes'), coordinating only via the HTTP apiserver."""
+    from nos_tpu.cmd import apiserver as cmd_apiserver
+    from nos_tpu.cmd import operator as cmd_operator
+    from nos_tpu.cmd import scheduler as cmd_scheduler
+    from nos_tpu.kube.httpapi import RemoteApiServer
+
+    http = cmd_apiserver.build(port=0).start()
+    try:
+        operator_mgr = cmd_operator.build(RemoteApiServer(http.address))
+        scheduler_mgr = cmd_scheduler.build(RemoteApiServer(http.address))
+        client = RemoteApiServer(http.address)
+
+        client.create(Node(
+            metadata=ObjectMeta(name="n1"),
+            status=NodeStatus(capacity={"google.com/tpu": 8, "cpu": 8},
+                              allocatable={"google.com/tpu": 8, "cpu": 8}),
+        ))
+        client.create(make_elastic_quota("eq", "team-a", {"google.com/tpu": 4},
+                                         {"google.com/tpu": 8}))
+        pod = sample_pod()
+        pod.metadata.namespace = "team-a"
+        client.create(pod)
+
+        scheduler_mgr.run_until_idle()
+        bound = client.get("Pod", "p", "team-a")
+        assert bound.spec.node_name == "n1"
+
+        client.patch("Pod", "p", "team-a",
+                     lambda p: setattr(p.status, "phase", "Running"))
+        operator_mgr.run_until_idle()
+        eq = client.get("ElasticQuota", "eq", "team-a")
+        assert eq.status.used.get("google.com/tpu") == 4
+        labeled = client.get("Pod", "p", "team-a")
+        assert labeled.metadata.labels[constants.LABEL_CAPACITY] == "in-quota"
+    finally:
+        http.stop()
+
+
+def test_tpuagent_binary_over_http():
+    from nos_tpu.agents.tpu_native import MockTpuClient
+    from nos_tpu.cmd import apiserver as cmd_apiserver
+    from nos_tpu.cmd import tpuagent as cmd_tpuagent
+    from nos_tpu.kube.httpapi import RemoteApiServer
+
+    http = cmd_apiserver.build(port=0).start()
+    try:
+        client = RemoteApiServer(http.address)
+        client.create(Node(
+            metadata=ObjectMeta(name="w0", labels={
+                constants.LABEL_TPU_ACCELERATOR: "tpu-v5-lite-podslice",
+                constants.LABEL_TPU_TOPOLOGY: "2x4",
+            }),
+            status=NodeStatus(capacity={"cpu": 8}, allocatable={"cpu": 8}),
+        ))
+        mgr = cmd_tpuagent.build(
+            RemoteApiServer(http.address), "w0",
+            tpu_client=MockTpuClient(chips=8),
+        )
+        mgr.run_until_idle()
+        # control plane hands down a spec: partition board 0 into two 2x2s
+        def want(n):
+            n.metadata.annotations.update({
+                constants.ANNOTATION_SPEC_PREFIX + "0-2x2": "2",
+                constants.ANNOTATION_PARTITIONING_PLAN: "plan-1",
+            })
+        client.patch("Node", "w0", "", want)
+        mgr.run_until_idle()
+        node = client.get("Node", "w0")
+        anns = node.metadata.annotations
+        # actuator applied, reporter re-read and published status + plan id
+        assert anns.get(constants.ANNOTATION_REPORTED_PARTITIONING_PLAN) == "plan-1"
+        assert anns.get(constants.ANNOTATION_STATUS_PREFIX + "0-2x2-free") == "2"
+        assert node.status.allocatable.get("nos.ai/tpu-slice-2x2") == 2
+    finally:
+        http.stop()
+
+
+def test_metricsexporter_collect():
+    from nos_tpu.cmd import apiserver as cmd_apiserver
+    from nos_tpu.cmd.metricsexporter import collect
+    from nos_tpu.kube.client import Client
+    from nos_tpu.kube.httpapi import RemoteApiServer
+
+    http = cmd_apiserver.build(port=0).start()
+    try:
+        remote = RemoteApiServer(http.address)
+        remote.create(Node(
+            metadata=ObjectMeta(name="n1", labels={
+                constants.LABEL_TPU_ACCELERATOR: "tpu-v5-lite-podslice",
+                constants.LABEL_TPU_TOPOLOGY: "2x4",
+            }),
+            status=NodeStatus(allocatable={"google.com/tpu": 8}),
+        ))
+        remote.create(make_elastic_quota("eq", "ns", {"google.com/tpu": 4}))
+        remote.create(sample_pod())
+        doc = collect(Client(remote))
+        assert doc["nodes"][0]["tpu_chips"] == 8
+        assert doc["nodes"][0]["accelerator"] == "tpu-v5-lite-podslice"
+        assert doc["elastic_quotas"][0]["min"] == {"google.com/tpu": 4}
+        assert doc["pod_count"] == 1 and doc["tpu_pod_count"] == 1
+    finally:
+        http.stop()
+
+
+def test_config_file_loading(tmp_path):
+    from nos_tpu.api.configs import ConfigError, OperatorConfig, PartitionerConfig
+
+    f = tmp_path / "op.yaml"
+    f.write_text("tpu_resource_memory_gb: 95\nlog_level: 1\n")
+    cfg = OperatorConfig.from_yaml_file(str(f))
+    assert cfg.tpu_resource_memory_gb == 95 and cfg.log_level == 1
+
+    bad = tmp_path / "bad.yaml"
+    bad.write_text("nonsense_key: 1\n")
+    with pytest.raises(ConfigError):
+        OperatorConfig.from_yaml_file(str(bad))
+
+    invalid = tmp_path / "invalid.yaml"
+    invalid.write_text("batch_window_idle_seconds: 90\n")
+    with pytest.raises(ConfigError):
+        PartitionerConfig.from_yaml_file(str(invalid))
+
+
+def test_known_generations_file(tmp_path):
+    from nos_tpu.tpu import topology
+
+    f = tmp_path / "gens.yaml"
+    f.write_text("""
+generations:
+  - name: tpu-v9x-slice
+    short: v9x
+    host_rows: 2
+    host_cols: 4
+    hbm_gb_per_chip: 128
+    subslice_profiles: ["1x1", "2x2"]
+    topologies: ["2x4", "4x4", "4x4x4"]
+""")
+    gens = topology.load_generations_file(str(f))
+    assert len(gens) == 1
+    g = gens[0]
+    assert g.chips_per_host == 8
+    assert [t.name for t in g.topologies] == ["2x4", "4x4", "4x4x4"]
+    assert g.subslice_profiles[1].chips == 4
+
+    try:
+        topology.set_known_generations(gens)
+        assert topology.get_generation("v9x") is g
+        assert topology.get_generation("v5e") is None
+    finally:
+        topology.reset_known_generations()
+
+    bad = tmp_path / "bad.yaml"
+    bad.write_text("generations:\n  - name: x\n")
+    with pytest.raises(ValueError):
+        topology.load_generations_file(str(bad))

@@ -116,3 +116,37 @@ def test_quota_controller_exports_used_gauge(make_cluster):
                          lambda p: setattr(p.status, "phase", "Running"))
     cluster.run_until_idle()
     assert obs.QUOTA_USED.labels("default/eq", "google.com/tpu").value == 2
+
+
+def test_gauge_remove_and_clear_label():
+    from nos_tpu.utils.metrics import Registry
+
+    r = Registry()
+    g = r.gauge("q_used", "q", ("quota", "resource"))
+    g.labels("a/x", "tpu").set(4)
+    g.labels("a/x", "cpu").set(2)
+    g.labels("b/y", "tpu").set(1)
+    g.clear_label("quota", "a/x")
+    text = r.expose()
+    assert 'quota="a/x"' not in text
+    assert 'q_used{quota="b/y",resource="tpu"} 1' in text
+    g.remove("b/y", "tpu")
+    assert 'q_used{' not in r.expose()
+
+
+def test_quota_deletion_clears_series(make_cluster):
+    from nos_tpu import observability as obs
+
+    default_registry().reset()
+    cluster = make_cluster()
+    cluster.add_node("n1", {"google.com/tpu": 8, "cpu": 8})
+    cluster.add_elastic_quota("default", "eq", minimum={"google.com/tpu": 4})
+    cluster.add_pod("default", "p1", {"google.com/tpu": 2})
+    cluster.run_until_idle()
+    cluster.client.patch("Pod", "p1", "default",
+                         lambda p: setattr(p.status, "phase", "Running"))
+    cluster.run_until_idle()
+    assert obs.QUOTA_USED.labels("default/eq", "google.com/tpu").value == 2
+    cluster.client.delete("ElasticQuota", "eq", "default")
+    cluster.run_until_idle()
+    assert 'quota="default/eq"' not in default_registry().expose()
