@@ -1,0 +1,42 @@
+# Developer entry points (analog of reference Makefile:18-118).
+
+PYTHON ?= python
+IMAGE_REGISTRY ?= ghcr.io/nos-tpu
+VERSION ?= 0.1.0
+COMPONENTS = apiserver operator scheduler partitioner tpuagent metricsexporter
+
+.PHONY: test
+test:  ## Run the unit + integration suite (virtual 8-device CPU mesh for JAX tests).
+	$(PYTHON) -m pytest tests/ -x -q
+
+.PHONY: bench
+bench:  ## Run the headline benchmark (prints one JSON line).
+	$(PYTHON) bench.py
+
+.PHONY: native
+native:  ## Build the tpuagent C++ device layer.
+	$(MAKE) -C native/tpuagent
+
+.PHONY: dryrun
+dryrun:  ## Compile-check the multi-chip training step on 8 virtual devices.
+	XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu \
+		$(PYTHON) __graft_entry__.py 8
+
+.PHONY: docker-build
+docker-build:  ## Build all component images.
+	for c in $(COMPONENTS); do \
+		docker build -t $(IMAGE_REGISTRY)/nos-tpu-$$c:$(VERSION) -f build/$$c/Dockerfile . || exit 1; \
+	done
+
+.PHONY: kind-create
+kind-create:  ## Create the dev kind cluster with fake TPU nodes.
+	kind create cluster --config hack/kind/cluster.yaml
+	hack/kind/fake-tpu-nodes.sh
+
+.PHONY: helm-template
+helm-template:  ## Render the chart (requires helm).
+	helm template nos-tpu helm-charts/nos-tpu
+
+.PHONY: help
+help:
+	@grep -E '^[a-zA-Z_-]+:.*?## ' $(MAKEFILE_LIST) | awk 'BEGIN {FS = ":.*?## "}; {printf "  %-14s %s\n", $$1, $$2}'

@@ -1,0 +1,16 @@
+#!/usr/bin/env bash
+# Label the kind workers as a fake v5e 2x4 single-host pool each, so the
+# nos-tpu control plane treats them as TPU nodes (mock device layer).
+set -euo pipefail
+
+CLUSTER=${1:-kind}
+i=0
+for node in $(kubectl get nodes -o name | grep worker); do
+  kubectl label --overwrite "$node" \
+    cloud.google.com/gke-tpu-accelerator=tpu-v5-lite-podslice \
+    cloud.google.com/gke-tpu-topology=2x4 \
+    cloud.google.com/gke-nodepool="fake-v5e-pool-$i" \
+    nos.ai/tpu-partitioning=subslicing
+  i=$((i + 1))
+done
+echo "labeled $i fake TPU nodes in cluster $CLUSTER"

@@ -1,0 +1,54 @@
+{{/* Common template helpers for the nos-tpu chart. */}}
+
+{{- define "nos-tpu.name" -}}
+{{- default .Chart.Name .Values.nameOverride | trunc 63 | trimSuffix "-" -}}
+{{- end -}}
+
+{{- define "nos-tpu.namespace" -}}
+{{- default .Release.Namespace .Values.namespaceOverride -}}
+{{- end -}}
+
+{{- define "nos-tpu.fullname" -}}
+{{- printf "%s-%s" .Release.Name (include "nos-tpu.name" .) | trunc 63 | trimSuffix "-" -}}
+{{- end -}}
+
+{{- define "nos-tpu.labels" -}}
+helm.sh/chart: {{ printf "%s-%s" .Chart.Name .Chart.Version | replace "+" "_" | trunc 63 }}
+app.kubernetes.io/name: {{ include "nos-tpu.name" . }}
+app.kubernetes.io/instance: {{ .Release.Name }}
+app.kubernetes.io/version: {{ .Chart.AppVersion | quote }}
+app.kubernetes.io/managed-by: {{ .Release.Service }}
+{{- end -}}
+
+{{- define "nos-tpu.tag" -}}
+{{- default .Chart.AppVersion .Values.image.tag -}}
+{{- end -}}
+
+{{- define "nos-tpu.operator.image" -}}
+{{- printf "%s/%s:%s" .Values.image.registry .Values.operator.image.repository (include "nos-tpu.tag" .) -}}
+{{- end -}}
+
+{{- define "nos-tpu.scheduler.image" -}}
+{{- printf "%s/%s:%s" .Values.image.registry .Values.scheduler.image.repository (include "nos-tpu.tag" .) -}}
+{{- end -}}
+
+{{- define "nos-tpu.tpuPartitioner.image" -}}
+{{- printf "%s/%s:%s" .Values.image.registry .Values.tpuPartitioner.image.repository (include "nos-tpu.tag" .) -}}
+{{- end -}}
+
+{{- define "nos-tpu.tpuAgent.image" -}}
+{{- printf "%s/%s:%s" .Values.image.registry .Values.tpuAgent.image.repository (include "nos-tpu.tag" .) -}}
+{{- end -}}
+
+{{- define "nos-tpu.apiServer.image" -}}
+{{- printf "%s/%s:%s" .Values.image.registry .Values.apiServer.image.repository (include "nos-tpu.tag" .) -}}
+{{- end -}}
+
+{{/* URL every component passes as --api. */}}
+{{- define "nos-tpu.apiServer.url" -}}
+{{- printf "http://%s-apiserver.%s.svc:%d" (include "nos-tpu.fullname" .) (include "nos-tpu.namespace" .) (int .Values.apiServer.port) -}}
+{{- end -}}
+
+{{- define "nos-tpu.metricsExporter.image" -}}
+{{- printf "%s/%s:%s" .Values.image.registry .Values.metricsExporter.image.repository (include "nos-tpu.tag" .) -}}
+{{- end -}}

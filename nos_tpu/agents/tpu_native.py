@@ -39,6 +39,17 @@ _BUF_LEN = 65536
 
 
 def _build_native() -> Optional[str]:
+    # Pre-built library (container images set NOS_TPU_NATIVE_LIB; the source
+    # tree is not shipped there). An explicitly configured path that is
+    # missing is a deployment error, not a fall-back-to-mock situation.
+    prebuilt = os.environ.get("NOS_TPU_NATIVE_LIB")
+    if prebuilt:
+        if os.path.exists(prebuilt):
+            return prebuilt
+        raise TpuClientError(
+            f"NOS_TPU_NATIVE_LIB={prebuilt} does not exist; refusing to "
+            "fall back to the mock device layer on a configured deployment"
+        )
     if os.path.exists(_SO_PATH) and (
         not os.path.exists(_SRC_PATH)
         or os.path.getmtime(_SO_PATH) >= os.path.getmtime(_SRC_PATH)
@@ -61,13 +72,18 @@ def _build_native() -> Optional[str]:
 
 
 def load_native() -> Optional[ctypes.CDLL]:
-    """Build (if needed) and load the native library; None if unavailable."""
+    """Build (if needed) and load the native library; None if unavailable.
+    Raises TpuClientError when NOS_TPU_NATIVE_LIB names a missing file."""
     path = _build_native()
     if path is None:
         return None
     try:
         lib = ctypes.CDLL(path)
     except OSError as e:
+        if os.environ.get("NOS_TPU_NATIVE_LIB"):
+            raise TpuClientError(
+                f"NOS_TPU_NATIVE_LIB={path} failed to load: {e}"
+            ) from e
         logger.warning("could not load %s: %s", path, e)
         return None
     lib.tpu_chip_count.restype = ctypes.c_int

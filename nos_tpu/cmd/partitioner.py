@@ -51,7 +51,7 @@ def main(argv: Optional[Sequence[str]] = None) -> None:
         else PartitionerConfig()
     serve.setup_logging(cfg.log_level)
     mgr = build(serve.connect(args), cfg)
-    serve.run_daemon(mgr, args.health_port)
+    serve.run_daemon(mgr, args.health_port, args.health_host)
 
 
 if __name__ == "__main__":

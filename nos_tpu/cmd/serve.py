@@ -81,6 +81,11 @@ def common_flags(parser: argparse.ArgumentParser, config: bool = True) -> None:
         "--health-port", type=int, default=0,
         help="healthz/readyz/metrics port (0 = ephemeral)",
     )
+    parser.add_argument(
+        "--health-host", default="0.0.0.0",
+        help="healthz bind address (kubelet probes the pod IP, so the "
+             "default binds all interfaces)",
+    )
     if config:
         parser.add_argument(
             "-config", "--config", dest="config", default=None,
@@ -102,8 +107,8 @@ def setup_logging(level: int = 0) -> None:
     )
 
 
-def run_daemon(manager, health_port: int) -> None:
-    health = HealthServer(manager, port=health_port).start()
+def run_daemon(manager, health_port: int, health_host: str) -> None:
+    health = HealthServer(manager, host=health_host, port=health_port).start()
     logger.info("health endpoints at %s", health.address)
     try:
         manager.run()

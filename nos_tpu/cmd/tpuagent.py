@@ -27,6 +27,10 @@ def build(server, node_name: str, config: Optional[TpuAgentConfig] = None,
         try:
             tpu_client = TpuNativeClient()
         except TpuClientError:
+            # A deployment that explicitly configured the native library
+            # must never silently report fake device state.
+            if os.environ.get("NOS_TPU_NATIVE_LIB"):
+                raise
             tpu_client = MockTpuClient(chips=mock_chips)
     agent = TpuAgent(
         node_name,
@@ -62,7 +66,7 @@ def main(argv: Optional[Sequence[str]] = None) -> None:
     tpu_client = MockTpuClient(chips=args.mock_chips) if args.mock else None
     mgr = build(serve.connect(args), args.node_name, cfg, tpu_client=tpu_client,
                 mock_chips=args.mock_chips)
-    serve.run_daemon(mgr, args.health_port)
+    serve.run_daemon(mgr, args.health_port, args.health_host)
 
 
 if __name__ == "__main__":

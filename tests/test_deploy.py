@@ -1,0 +1,250 @@
+"""Deployment-layer validation (SURVEY §2.6 L8: helm chart, kustomize
+mirrors, Dockerfiles, kind config — analog of the reference's
+helm-charts/nos + config/ + build/ + hack/kind).
+
+Helm templates contain Go-template directives and cannot be YAML-parsed
+directly; they get structural checks (balanced delimiters, referenced
+values exist). The config/ mirrors are plain YAML and are parsed and
+cross-checked against the component configs they feed.
+"""
+import glob
+import os
+import re
+
+import pytest
+import yaml
+
+from nos_tpu.api import configs
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+CHART = os.path.join(REPO, "helm-charts", "nos-tpu")
+CONFIG = os.path.join(REPO, "config")
+
+
+# ---------------------------------------------------------------------------
+# Helm chart
+# ---------------------------------------------------------------------------
+def test_chart_metadata_parses():
+    with open(os.path.join(CHART, "Chart.yaml")) as f:
+        chart = yaml.safe_load(f)
+    assert chart["name"] == "nos-tpu"
+    assert chart["apiVersion"] == "v2"
+    with open(os.path.join(CHART, "values.yaml")) as f:
+        values = yaml.safe_load(f)
+    for key in ("operator", "scheduler", "tpuPartitioner", "tpuAgent",
+                "metricsExporter", "tpuMemoryGB"):
+        assert key in values, f"values.yaml missing {key}"
+    # reference parity: batch windows 60/10 (values.yaml:276,283)
+    assert values["tpuPartitioner"]["batchWindowTimeoutSeconds"] == 60
+    assert values["tpuPartitioner"]["batchWindowIdleSeconds"] == 10
+    assert values["tpuAgent"]["reportConfigIntervalSeconds"] == 10
+
+
+def _templates():
+    pats = os.path.join(CHART, "templates", "**", "*.yaml")
+    return sorted(glob.glob(pats, recursive=True))
+
+
+def test_templates_exist_for_every_component():
+    names = [os.path.relpath(t, CHART) for t in _templates()]
+    joined = "\n".join(names)
+    for frag in ("apiserver/deployment_apiserver",
+                 "operator/deployment_operator", "operator/rbac_operator",
+                 "scheduler/deployment_scheduler",
+                 "tpu-partitioner/deployment_tpu-partitioner",
+                 "tpu-partitioner/configmap_known-tpu-topologies",
+                 "tpuagent/daemonset_tpuagent", "pod_metrics-exporter"):
+        assert frag in joined, f"missing template {frag}"
+
+
+def test_workload_templates_dial_the_apiserver():
+    """Every workload container must pass --api (serve.connect exits
+    otherwise) and the apiserver deployment itself must exist."""
+    for t in _templates():
+        with open(t) as f:
+            text = f.read()
+        if re.search(r"kind: (Deployment|DaemonSet)", text) \
+                and "component: apiserver" not in text:
+            assert "--api=" in text, f"{t}: workload without --api"
+
+
+def test_templates_balanced_delimiters():
+    for path in _templates():
+        with open(path) as f:
+            text = f.read()
+        assert text.count("{{") == text.count("}}"), path
+        opens = len(re.findall(r"\{\{-?\s*(?:if|range|with)\b", text))
+        closes = len(re.findall(r"\{\{-?\s*end\s*-?\}\}", text))
+        assert opens == closes, f"{path}: {opens} open blocks, {closes} ends"
+
+
+def test_template_values_references_exist():
+    """Every .Values.foo.bar referenced by a template resolves in values.yaml."""
+    with open(os.path.join(CHART, "values.yaml")) as f:
+        values = yaml.safe_load(f)
+
+    def resolve(path):
+        cur = values
+        for part in path:
+            if not isinstance(cur, dict) or part not in cur:
+                return False
+            cur = cur[part]
+        return True
+
+    tpl_files = _templates() + sorted(
+        glob.glob(os.path.join(CHART, "templates", "**", "*.tpl"),
+                  recursive=True)
+    )
+    for path in tpl_files:
+        with open(path) as f:
+            text = f.read()
+        for m in re.finditer(r"\.Values\.([A-Za-z0-9_.]+)", text):
+            parts = m.group(1).split(".")
+            # nameOverride/nameOverride-style optional keys use `default`
+            if parts[-1] in ("nameOverride", "namespaceOverride"):
+                continue
+            assert resolve(parts), f"{path}: .Values.{m.group(1)} not in values.yaml"
+
+
+def test_chart_crds_match_config_bases():
+    """The chart's crds/ dir must stay identical to config/operator/crd/bases."""
+    for name in ("nos.ai_elasticquotas.yaml", "nos.ai_compositeelasticquotas.yaml"):
+        with open(os.path.join(CHART, "crds", name)) as f:
+            chart_crd = f.read()
+        with open(os.path.join(CONFIG, "operator", "crd", "bases", name)) as f:
+            base_crd = f.read()
+        assert chart_crd == base_crd, f"{name}: chart copy diverged"
+
+
+def test_crd_schemas_valid():
+    for name, kind in (
+        ("nos.ai_elasticquotas.yaml", "ElasticQuota"),
+        ("nos.ai_compositeelasticquotas.yaml", "CompositeElasticQuota"),
+    ):
+        with open(os.path.join(CONFIG, "operator", "crd", "bases", name)) as f:
+            crd = yaml.safe_load(f)
+        assert crd["kind"] == "CustomResourceDefinition"
+        assert crd["spec"]["group"] == "nos.ai"
+        assert crd["spec"]["names"]["kind"] == kind
+        v = crd["spec"]["versions"][0]
+        assert v["name"] == "v1alpha1" and v["served"] and v["storage"]
+        props = v["schema"]["openAPIV3Schema"]["properties"]
+        assert "spec" in props and "status" in props
+        assert "used" in props["status"]["properties"]
+
+
+# ---------------------------------------------------------------------------
+# config/ kustomize mirrors — plain YAML, deep-checked
+# ---------------------------------------------------------------------------
+def _manifests():
+    out = []
+    for path in sorted(glob.glob(os.path.join(CONFIG, "**", "*.yaml"),
+                                 recursive=True)):
+        with open(path) as f:
+            for doc in yaml.safe_load_all(f):
+                if doc:
+                    out.append((path, doc))
+    return out
+
+
+def test_config_manifests_parse_and_have_kind():
+    docs = _manifests()
+    assert len(docs) >= 15
+    for path, doc in docs:
+        assert "kind" in doc, f"{path}: document without kind"
+        if doc["kind"] != "Kustomization":
+            assert doc.get("metadata", {}).get("name"), f"{path}: unnamed object"
+
+
+def test_config_embedded_component_configs_load():
+    """The YAML embedded in each config/ ConfigMap must round-trip through
+    the actual component config dataclass (catches key drift)."""
+    kinds = {
+        "operator-config.yaml": configs.OperatorConfig,
+        "scheduler-config.yaml": configs.CapacitySchedulingArgs,
+        "partitioner-config.yaml": configs.PartitionerConfig,
+        "tpuagent-config.yaml": configs.TpuAgentConfig,
+    }
+    seen = set()
+    for path, doc in _manifests():
+        if doc["kind"] != "ConfigMap":
+            continue
+        for key, payload in (doc.get("data") or {}).items():
+            if key not in kinds:
+                continue
+            seen.add(key)
+            data = yaml.safe_load(payload)
+            cfg = kinds[key](**data)
+            cfg.validate()
+    assert seen == set(kinds), f"config maps missing for {set(kinds) - seen}"
+
+
+def test_config_rbac_covers_each_serviceaccount():
+    sas, bindings = set(), set()
+    for _, doc in _manifests():
+        if doc["kind"] == "ServiceAccount":
+            sas.add(doc["metadata"]["name"])
+        if doc["kind"] == "ClusterRoleBinding":
+            for s in doc.get("subjects", []):
+                bindings.add(s["name"])
+    assert sas, "no ServiceAccounts in config/"
+    assert sas <= bindings, f"ServiceAccounts without bindings: {sas - bindings}"
+
+
+def test_kustomization_resources_exist():
+    for path in sorted(glob.glob(os.path.join(CONFIG, "**", "kustomization.yaml"),
+                                 recursive=True)):
+        with open(path) as f:
+            kust = yaml.safe_load(f)
+        base = os.path.dirname(path)
+        for res in kust.get("resources", []):
+            assert os.path.exists(os.path.join(base, res)), f"{path}: {res} missing"
+
+
+def test_samples_valid():
+    path = os.path.join(CONFIG, "operator", "samples", "gang-jobset.yaml")
+    with open(path) as f:
+        pod = yaml.safe_load(f)
+    labels = pod["metadata"]["labels"]
+    assert labels["nos.ai/gang-name"]
+    assert int(labels["nos.ai/gang-size"]) == 4
+    assert pod["metadata"]["annotations"]["nos.ai/tpu-topology"] == "4x4"
+    assert pod["spec"]["schedulerName"] == "nos-scheduler"
+
+
+# ---------------------------------------------------------------------------
+# build/ + hack/
+# ---------------------------------------------------------------------------
+def test_dockerfiles_exist_per_component():
+    for c in ("apiserver", "operator", "scheduler", "partitioner", "tpuagent",
+              "metricsexporter"):
+        path = os.path.join(REPO, "build", c, "Dockerfile")
+        assert os.path.exists(path), f"missing {path}"
+        with open(path) as f:
+            text = f.read()
+        assert "FROM" in text and "ENTRYPOINT" in text
+    with open(os.path.join(REPO, "build", "tpuagent", "Dockerfile")) as f:
+        agent = f.read()
+    assert "native/tpuagent" in agent, "tpuagent image must build the C++ layer"
+
+
+def test_kind_cluster_config():
+    with open(os.path.join(REPO, "hack", "kind", "cluster.yaml")) as f:
+        cluster = yaml.safe_load(f)
+    roles = [n["role"] for n in cluster["nodes"]]
+    assert roles.count("worker") >= 2, "need >=2 workers for multi-node tests"
+
+
+def test_console_scripts_resolve():
+    """Every [project.scripts] entry points at an importable main()."""
+    import importlib
+    try:
+        import tomllib
+    except ImportError:  # pragma: no cover - py<3.11
+        pytest.skip("tomllib unavailable")
+    with open(os.path.join(REPO, "pyproject.toml"), "rb") as f:
+        proj = tomllib.load(f)
+    for name, target in proj["project"]["scripts"].items():
+        mod_name, func = target.split(":")
+        mod = importlib.import_module(mod_name)
+        assert callable(getattr(mod, func)), f"{name}: {target} not callable"

@@ -259,3 +259,31 @@ def test_native_decode_rejects_bad_board_key(native, tmp_path):
     (tmp_path / "partition.json").write_text('{"boards": {"abc": {}}}')
     with pytest.raises(TpuClientError):
         native.read_partition()
+
+
+# ---------------------------------------------------------------------------
+# configured-deployment guard: NOS_TPU_NATIVE_LIB must never silently fall
+# back to the mock device layer
+# ---------------------------------------------------------------------------
+def test_missing_configured_native_lib_raises(monkeypatch):
+    from nos_tpu.agents.tpu_native import TpuClientError, _build_native
+    monkeypatch.setenv("NOS_TPU_NATIVE_LIB", "/nonexistent/libtpuagent.so")
+    with pytest.raises(TpuClientError):
+        _build_native()
+
+
+def test_unloadable_configured_native_lib_raises(monkeypatch, tmp_path):
+    from nos_tpu.agents.tpu_native import TpuClientError
+    bogus = tmp_path / "libtpuagent.so"
+    bogus.write_bytes(b"not an ELF shared object")
+    monkeypatch.setenv("NOS_TPU_NATIVE_LIB", str(bogus))
+    with pytest.raises(TpuClientError):
+        load_native()
+
+
+def test_cmd_build_does_not_mask_configured_lib_error(monkeypatch):
+    from nos_tpu.agents.tpu_native import TpuClientError
+    from nos_tpu.cmd import tpuagent as agent_cmd
+    monkeypatch.setenv("NOS_TPU_NATIVE_LIB", "/nonexistent/libtpuagent.so")
+    with pytest.raises(TpuClientError):
+        agent_cmd.build(ApiServer(), "n0")
