@@ -1,0 +1,223 @@
+"""Unit-level victim selection for quota-aware preemption.
+
+Scenario tables for CapacityScheduling._select_victims_on_node / post_filter,
+modeling the reference's SelectVictimsOnNode decision structure
+(capacity_scheduling.go:468-675) and the guaranteed-overquota fair-sharing
+rule (elasticquotainfo.go:81-152). Complements the end-to-end preemption
+tests in test_scheduler.py with precise victim-identity assertions.
+"""
+from nos_tpu import constants
+from nos_tpu.kube.objects import (
+    Container,
+    Node,
+    NodeStatus,
+    ObjectMeta,
+    Pod,
+    PodSpec,
+    PodStatus,
+)
+from nos_tpu.quota.info import QuotaInfo, QuotaInfos
+from nos_tpu.scheduler import framework as fw
+from nos_tpu.scheduler.capacity import CapacityScheduling
+
+TPU = "google.com/tpu"
+OVER = {constants.LABEL_CAPACITY: constants.CAPACITY_OVER_QUOTA}
+IN = {constants.LABEL_CAPACITY: constants.CAPACITY_IN_QUOTA}
+
+
+def make_pod(name, ns, tpu, priority=0, labels=None, node="n1"):
+    return Pod(
+        metadata=ObjectMeta(name=name, namespace=ns, labels=dict(labels or {})),
+        spec=PodSpec(containers=[Container(requests={TPU: tpu})],
+                     node_name=node, priority=priority),
+        status=PodStatus(phase="Running"),
+    )
+
+
+def make_node(name="n1", tpu=8):
+    return Node(
+        metadata=ObjectMeta(name=name),
+        status=NodeStatus(capacity={TPU: tpu}, allocatable={TPU: tpu}),
+    )
+
+
+def rig(quota_mins, running, maxes=None, tpu=8, nodes=None):
+    """Build a CapacityScheduling with quotas + used tracked from running
+    pods, and a Snapshot of the given nodes."""
+    cs = CapacityScheduling()
+    cs.quotas = QuotaInfos()
+    for name, (ns, mn) in quota_mins.items():
+        cs.quotas.add(QuotaInfo(
+            name=name, namespace=ns, namespaces={ns}, min={TPU: mn},
+            max={TPU: (maxes or {}).get(name)} if name in (maxes or {}) else None,
+            calculator=cs.calc,
+        ))
+    node_objs = nodes or [make_node(tpu=tpu)]
+    snap = fw.Snapshot.build(node_objs, running, cs.calc)
+    for p in running:
+        cs.track_pod(p)
+    return cs, snap
+
+
+def select(cs, snap, pod, node_name="n1"):
+    state = {}
+    cs.pre_filter(state, pod, snap)   # populates state; status ignored
+    return cs._select_victims_on_node(state, pod, snap[node_name])
+
+
+def names(victims):
+    return sorted(p.metadata.name for p in victims) if victims is not None else None
+
+
+# ---------------------------------------------------------------------------
+# regime 1: preemptor borrows beyond its min (fair-sharing rule)
+# ---------------------------------------------------------------------------
+# Shared numbers: quotas a:min4, b:min4, c:min8 (total min 16). c is idle so
+# the aggregated overquota is 8 chips; guaranteed shares: a=2, b=2, c=4.
+
+def fair_share_rig(b_over_chips, node_tpu, a_max=None):
+    running = [
+        make_pod("a-run", "ns-a", 4),
+        make_pod("b-in", "ns-b", 4, labels=IN),
+        make_pod("b-over", "ns-b", b_over_chips, labels=OVER),
+    ]
+    cs, snap = rig(
+        {"qa": ("ns-a", 4), "qb": ("ns-b", 4), "qc": ("ns-c", 8)},
+        running,
+        maxes={"qa": a_max} if a_max is not None else None,
+        nodes=[make_node(tpu=node_tpu)],
+    )
+    return cs, snap
+
+
+def test_borrowing_preemptor_evicts_over_share_quota():
+    # b uses 10 > its min+guaranteed share (4+2); a's request keeps it within
+    # its own share (4 used + 2 req == 4+2) -> b's over-quota pod is a victim.
+    cs, snap = fair_share_rig(b_over_chips=6, node_tpu=14)
+    victims = select(cs, snap, make_pod("a-new", "ns-a", 2, node=""))
+    assert names(victims) == ["b-over"]
+
+
+def test_fair_share_protects_quota_within_its_guaranteed_share():
+    # b uses 5 <= its min+guaranteed share (6): its over-quota pod is
+    # protected even though b is over min.
+    cs, snap = fair_share_rig(b_over_chips=1, node_tpu=9)
+    victims = select(cs, snap, make_pod("a-new", "ns-a", 2, node=""))
+    assert victims is None
+
+
+def test_preemptor_beyond_own_share_cannot_evict_cross_namespace():
+    # Same cluster as the first scenario, but a asks for 4: 4 used + 4 req
+    # exceeds its share bound (6) -> no cross-namespace victims at all.
+    cs, snap = fair_share_rig(b_over_chips=6, node_tpu=14)
+    victims = select(cs, snap, make_pod("a-new", "ns-a", 4, node=""))
+    assert victims is None
+
+
+def test_max_quota_recheck_blocks_fair_share_eviction():
+    # Identical to the eviction scenario, but a's max (5) is below
+    # min+guaranteed (6): the post-removal max recheck must veto.
+    cs, snap = fair_share_rig(b_over_chips=6, node_tpu=14, a_max=5)
+    victims = select(cs, snap, make_pod("a-new", "ns-a", 2, node=""))
+    assert victims is None
+
+
+def test_borrowing_same_namespace_only_lower_priority():
+    running = [
+        make_pod("a-low", "ns-a", 4, priority=0),
+        make_pod("a-high", "ns-a", 4, priority=200),
+    ]
+    cs, snap = rig({"qa": ("ns-a", 8)}, running)
+    victims = select(cs, snap, make_pod("a-new", "ns-a", 4, node="", priority=100))
+    assert names(victims) == ["a-low"]   # never the higher-priority pod
+
+
+# ---------------------------------------------------------------------------
+# regime 2: preemptor within min reclaims borrowed capacity
+# ---------------------------------------------------------------------------
+
+def test_within_min_reclaims_borrowed_capacity():
+    running = [
+        make_pod("b-in", "ns-b", 4, labels=IN),
+        make_pod("b-over", "ns-b", 4, labels=OVER),
+    ]
+    cs, snap = rig({"qa": ("ns-a", 4), "qb": ("ns-b", 4)}, running)
+    victims = select(cs, snap, make_pod("a-new", "ns-a", 4, node=""))
+    assert names(victims) == ["b-over"]
+
+
+def test_unlabeled_cross_namespace_pod_never_victim():
+    # Same as above but the borrower's pod lacks the over-quota label:
+    # nothing is eligible in either regime.
+    running = [
+        make_pod("b-in", "ns-b", 4, labels=IN),
+        make_pod("b-extra", "ns-b", 4),      # no capacity label
+    ]
+    cs, snap = rig({"qa": ("ns-a", 4), "qb": ("ns-b", 4)}, running)
+    victims = select(cs, snap, make_pod("a-new", "ns-a", 4, node=""))
+    assert victims is None
+
+
+def test_reprieve_keeps_highest_priority_victims():
+    # Two eligible over-quota pods but only one eviction needed: the
+    # higher-priority one is reprieved (reference reprieve loop :635-673).
+    running = [
+        make_pod("b-in", "ns-b", 4, labels=IN),
+        make_pod("v-high", "ns-b", 2, priority=50, labels=OVER),
+        make_pod("v-low", "ns-b", 2, priority=10, labels=OVER),
+    ]
+    cs, snap = rig({"qa": ("ns-a", 4), "qb": ("ns-b", 4)}, running)
+    victims = select(cs, snap, make_pod("a-new", "ns-a", 2, node=""))
+    assert names(victims) == ["v-low"]
+
+
+# ---------------------------------------------------------------------------
+# preemptor without a quota
+# ---------------------------------------------------------------------------
+
+def test_no_quota_preemptor_only_evicts_unquotad_lower_priority():
+    running = [
+        make_pod("y-pod", "ns-y", 4, priority=0),       # no quota covers ns-y
+        make_pod("b-in", "ns-b", 4, labels=IN),          # quota'd: untouchable
+    ]
+    cs, snap = rig({"qb": ("ns-b", 4)}, running)
+    victims = select(cs, snap, make_pod("x-pod", "ns-x", 4, node="", priority=100))
+    assert names(victims) == ["y-pod"]
+
+
+def test_no_quota_preemptor_cannot_evict_higher_priority():
+    running = [make_pod("y-pod", "ns-y", 8, priority=200)]
+    cs, snap = rig({}, running)
+    victims = select(cs, snap, make_pod("x-pod", "ns-x", 4, node="", priority=100))
+    assert victims is None
+
+
+# ---------------------------------------------------------------------------
+# post_filter node choice
+# ---------------------------------------------------------------------------
+
+def test_post_filter_prefers_node_with_fewest_victims():
+    nodes = [make_node("n1", tpu=4), make_node("n2", tpu=4)]
+    running = [
+        make_pod("v1a", "ns-b", 2, labels=OVER, node="n1"),
+        make_pod("v1b", "ns-b", 2, labels=OVER, node="n1"),
+        make_pod("v2", "ns-b", 4, labels=OVER, node="n2"),
+    ]
+    cs, snap = rig({"qa": ("ns-a", 4), "qb": ("ns-b", 4)}, running, nodes=nodes)
+    pod = make_pod("a-new", "ns-a", 4, node="")
+    state = {}
+    cs.pre_filter(state, pod, snap)
+    node, status = cs.post_filter(state, pod, snap)
+    assert status.success
+    assert node == "n2"                       # one victim beats two
+    assert names(state["capacity/victims"]) == ["v2"]
+
+
+def test_post_filter_unschedulable_when_no_candidates():
+    running = [make_pod("b-in", "ns-b", 8, labels=IN)]
+    cs, snap = rig({"qa": ("ns-a", 4), "qb": ("ns-b", 8)}, running)
+    pod = make_pod("a-new", "ns-a", 4, node="")
+    state = {}
+    cs.pre_filter(state, pod, snap)
+    node, status = cs.post_filter(state, pod, snap)
+    assert node is None and not status.success

@@ -1,0 +1,155 @@
+"""Partition-config plan differ scenario tables.
+
+Model: reference internal/controllers/migagent/plan/plan_test.go (617 LoC) —
+desired-vs-actual diffing, used-slice protection, multi-board plans,
+deterministic op ordering. Complements the agent-level plan tests in
+test_tpuagent.py.
+"""
+from nos_tpu.agents.plan import BoardState, Operation, PartitionConfigPlan
+from nos_tpu.tpu.slice import Profile
+
+P11 = Profile(1, 1)
+P12 = Profile(1, 2)
+P22 = Profile(2, 2)
+P24 = Profile(2, 4)
+
+
+def plan(desired, actual):
+    return PartitionConfigPlan(desired=desired, actual=actual)
+
+
+# ---------------------------------------------------------------------------
+# no-op detection
+# ---------------------------------------------------------------------------
+
+def test_empty_everything_is_noop():
+    p = plan({}, {})
+    assert p.is_empty() and p.is_valid()
+    assert p.summary() == "no-op"
+
+
+def test_equal_geometries_noop():
+    p = plan(
+        {0: {P22: 1, P11: 4}},
+        {0: BoardState(geometry={P11: 4, P22: 1})},
+    )
+    assert p.is_empty()
+
+
+def test_zero_quantity_entries_equal_absent():
+    p = plan(
+        {0: {P22: 1, P12: 0}},
+        {0: BoardState(geometry={P22: 1, P11: 0})},
+    )
+    assert p.is_empty()
+
+
+# ---------------------------------------------------------------------------
+# create / delete deltas
+# ---------------------------------------------------------------------------
+
+def test_creates_on_virgin_board():
+    p = plan({0: {P22: 2}}, {})
+    assert p.ops == [Operation("create", 0, P22, 2)]
+
+
+def test_deletes_when_board_absent_from_desired():
+    p = plan({}, {0: BoardState(geometry={P12: 3})})
+    assert p.ops == [Operation("delete", 0, P12, 3)]
+    assert p.is_valid()          # all free, deletable
+
+
+def test_quantity_delta_create():
+    p = plan({0: {P11: 4}}, {0: BoardState(geometry={P11: 1})})
+    assert p.ops == [Operation("create", 0, P11, 3)]
+
+
+def test_quantity_delta_delete_partial():
+    p = plan({0: {P11: 1}}, {0: BoardState(geometry={P11: 4})})
+    assert p.ops == [Operation("delete", 0, P11, 3)]
+
+
+def test_profile_swap_creates_and_deletes():
+    p = plan({0: {P24: 1}}, {0: BoardState(geometry={P12: 4})})
+    assert Operation("delete", 0, P12, 4) in p.ops
+    assert Operation("create", 0, P24, 1) in p.ops
+    assert len(p.ops) == 2
+
+
+# ---------------------------------------------------------------------------
+# used-slice protection (reference: delete candidates must be free,
+# plan.go:113-135)
+# ---------------------------------------------------------------------------
+
+def test_delete_of_used_slices_invalid():
+    p = plan(
+        {0: {P11: 1}},
+        {0: BoardState(geometry={P11: 4}, used={P11: 3})},
+    )
+    assert not p.is_valid()
+    assert "only 1 free" in p.errors[0]
+
+
+def test_delete_exactly_the_free_slices_valid():
+    p = plan(
+        {0: {P11: 2}},
+        {0: BoardState(geometry={P11: 4}, used={P11: 2})},
+    )
+    assert p.is_valid()
+    assert p.ops == [Operation("delete", 0, P11, 2)]
+
+
+def test_used_other_profile_does_not_block():
+    p = plan(
+        {0: {P22: 1}},
+        {0: BoardState(geometry={P22: 1, P12: 2}, used={P22: 1})},
+    )
+    assert p.is_valid()
+    assert p.ops == [Operation("delete", 0, P12, 2)]
+
+
+# ---------------------------------------------------------------------------
+# multi-board plans + deterministic ordering
+# ---------------------------------------------------------------------------
+
+def test_multi_board_independent_diffs():
+    p = plan(
+        {0: {P22: 2}, 1: {P11: 4}},
+        {
+            0: BoardState(geometry={P22: 1}),
+            1: BoardState(geometry={P11: 4}),
+            2: BoardState(geometry={P12: 2}),
+        },
+    )
+    assert p.ops == [
+        Operation("create", 0, P22, 1),
+        Operation("delete", 2, P12, 2),
+    ]
+
+
+def test_ops_ordered_by_board_then_profile():
+    p = plan(
+        {1: {P11: 1, P24: 1}, 0: {P12: 1}},
+        {0: BoardState(), 1: BoardState()},
+    )
+    assert [(o.board, o.profile) for o in p.ops] == [
+        (0, P12), (1, P11), (1, P24),
+    ]
+
+
+def test_summary_lists_all_ops():
+    p = plan({0: {P22: 1}}, {0: BoardState(geometry={P12: 2})})
+    s = p.summary()
+    assert "create 1x2x2@board0" in s and "delete 2x1x2@board0" in s
+
+
+def test_invalid_plan_still_reports_all_ops():
+    # validation failure doesn't truncate the diff — the actuator needs the
+    # full picture to log what it refused to do
+    p = plan(
+        {0: {P11: 0}, 1: {P22: 1}},
+        {0: BoardState(geometry={P11: 2}, used={P11: 2}), 1: BoardState()},
+    )
+    assert not p.is_valid()
+    assert Operation("create", 1, P22, 1) in p.ops
+    assert Operation("delete", 0, P11, 2) in p.ops

@@ -178,6 +178,74 @@ def test_infos_replace_preserves_used_and_pods():
     assert "ns-b" not in infos
 
 
+def test_guaranteed_overquotas_resource_only_in_own_min():
+    # b's min lists a resource nobody else bounds: b gets the whole
+    # overquota for it (share = 1).
+    infos = QuotaInfos()
+    infos.add(qi("a", "ns-a", min={"cpu": 1.0}, used={"cpu": 1.0}))
+    infos.add(qi("b", "ns-b", min={TPU: 4, "cpu": 1.0}, used={}))
+    g = infos.guaranteed_overquotas("ns-b")
+    assert g[TPU] == 4
+
+
+def test_guaranteed_overquotas_zero_total_min_is_zero():
+    infos = QuotaInfos()
+    infos.add(qi("a", "ns-a", min={TPU: 0}))
+    assert infos.guaranteed_overquotas("ns-a")[TPU] == 0
+
+
+def test_aggregated_overquotas_clamps_overused_quotas():
+    # a quota using MORE than its min contributes 0 headroom, not negative
+    infos = QuotaInfos()
+    infos.add(qi("a", "ns-a", min={TPU: 4}, used={TPU: 10}))
+    infos.add(qi("b", "ns-b", min={TPU: 4}, used={TPU: 1}))
+    assert infos.aggregated_overquotas() == {TPU: 3}
+
+
+def test_guaranteed_overquotas_memory_floored_to_whole_bytes():
+    gib = 1024 ** 3
+    infos = QuotaInfos()
+    infos.add(qi("a", "ns-a", min={"memory": gib}, used={"memory": 0}))
+    infos.add(qi("b", "ns-b", min={"memory": 2 * gib}, used={"memory": 2 * gib}))
+    g = infos.guaranteed_overquotas("ns-a")["memory"]
+    assert g == float(int(g))          # whole bytes
+    assert abs(g - gib / 3) < 1        # a's third of its own unused GiB
+
+
+def test_guaranteed_overquotas_composite_counted_once():
+    infos = QuotaInfos()
+    infos.add(qi("comp", "ns-x", min={TPU: 4}, used={TPU: 0},
+                 namespaces=["ns-x", "ns-y"]))
+    infos.add(qi("b", "ns-b", min={TPU: 4}, used={TPU: 4}))
+    # total min 8 (composite once), overquota 4; comp share = 4/8*4 = 2
+    assert infos.guaranteed_overquotas("ns-x")[TPU] == 2
+    assert infos.guaranteed_overquotas("ns-y")[TPU] == 2
+
+
+def test_infos_replace_covers_new_namespace():
+    infos = QuotaInfos()
+    old = qi("a", "ns-a", min={TPU: 4}, namespaces=["ns-a"])
+    infos.add(old)
+    new = qi("a", "ns-a", min={TPU: 4}, namespaces=["ns-a", "ns-b"])
+    infos.replace_info(old, new)
+    assert infos["ns-b"] is infos["ns-a"]
+
+
+def test_infos_remove():
+    infos = QuotaInfos()
+    info = qi("comp", "ns-x", min={TPU: 4}, namespaces=["ns-x", "ns-y"])
+    infos.add(info)
+    infos.remove(info)
+    assert "ns-x" not in infos and "ns-y" not in infos
+
+
+def test_sum_greater_than_exact_equality_is_not_greater():
+    # bound comparisons are >, never >= (a request exactly filling min/max
+    # is allowed)
+    assert not sum_greater_than({TPU: 4}, {TPU: 4}, {TPU: 8})
+    assert not greater_than({"cpu": 0.0}, {})
+
+
 def test_infos_clone_preserves_aliasing():
     infos = QuotaInfos()
     composite = qi("comp", "ns-x", min={TPU: 8}, namespaces=["ns-x", "ns-y"])
