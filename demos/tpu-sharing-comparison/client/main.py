@@ -1,0 +1,161 @@
+#!/usr/bin/env python3
+"""Benchmark client — continuous ViT-small inference on a (shared) TPU.
+
+Analog of the reference's benchmarks client
+(demos/gpu-sharing-comparison/client/main.py): saturate the accelerator with
+single-image inferences at the YOLOS-small backbone scale and export
+per-inference latency, so Prometheus can aggregate the average inference
+time across pods sharing one chip.
+
+Sharing modes (TPU_SHARING_MODE):
+  multiplex   — the N outstanding requests are coalesced into one batched
+                bf16 forward per step (the TPU-idiomatic analog of MPS:
+                concurrent tenants share the MXU in a single pass).
+  timeslice   — requests execute one at a time (the analog of GPU
+                time-slicing: each stream observes the full round-trip of
+                everyone ahead of it).
+  subslice    — the pod owns an isolated sub-slice resource
+                (nos.ai/tpu-slice-RxC); latency is flat in the number of
+                co-resident pods, like MIG. Requires a partitioned host.
+
+Serves Prometheus text metrics on :8000 (histogram
+``tpu_sharing_inference_seconds``). With ``--oneshot`` it instead prints one
+JSON line with the measured per-request latency and exits — used by the
+Makefile's ``results`` target to build the README table.
+"""
+import argparse
+import json
+import os
+import sys
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, HTTPServer
+
+sys.path.insert(0, os.environ.get("NOS_TPU_ROOT", "/app"))
+
+import jax                       # noqa: E402
+import jax.numpy as jnp          # noqa: E402
+import numpy as np               # noqa: E402
+
+from nos_tpu.models import vit                    # noqa: E402
+from nos_tpu.utils.metrics import Histogram, Registry  # noqa: E402
+
+REGISTRY = Registry()
+LATENCY = Histogram(
+    "tpu_sharing_inference_seconds",
+    "Per-request inference latency under TPU sharing",
+    labelnames=("mode", "streams"),
+    buckets=(0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0),
+)
+REGISTRY.register(LATENCY)
+
+
+def build_forward(cfg, batch: int, chain: int = 1):
+    """One jitted program running ``chain`` dependent batched forwards.
+    Chaining cancels host<->device dispatch latency out of the measurement
+    (same methodology as bench.py)."""
+
+    @jax.jit
+    def run(params, images):
+        def body(x, _):
+            logits = vit.forward(params, cfg, images + x)
+            return jnp.sum(logits) * 1e-30, None
+
+        x, _ = jax.lax.scan(body, jnp.float32(0), None, length=chain)
+        return x
+
+    return run
+
+
+class BenchRig:
+    """Model + compiled programs, built once and reused across measurement
+    windows (rebuilding per window would recompile both forwards)."""
+
+    def __init__(self, mode: str, streams: int, chain: int = 50):
+        self.mode = mode
+        self.streams = streams
+        self.chain = chain
+        cfg = vit.ViTConfig()
+        self.params = jax.device_put(vit.init_params(jax.random.PRNGKey(0), cfg))
+        batch = streams if mode == "multiplex" else 1
+        self.images = jax.random.normal(
+            jax.random.PRNGKey(1), (batch, cfg.image_size, cfg.image_size, 3),
+            jnp.float32,
+        )
+        self.short = build_forward(cfg, batch, 1)
+        self.long = build_forward(cfg, batch, 1 + chain)
+        np.asarray(self.short(self.params, self.images))    # compile
+        np.asarray(self.long(self.params, self.images))
+
+    def measure(self, seconds: float) -> float:
+        """Median per-request latency for ``streams`` concurrent tenants."""
+        samples = []
+        deadline = time.time() + seconds
+        while time.time() < deadline or len(samples) < 3:
+            t0 = time.perf_counter()
+            np.asarray(self.short(self.params, self.images))
+            t_short = time.perf_counter() - t0
+            t0 = time.perf_counter()
+            np.asarray(self.long(self.params, self.images))
+            t_long = time.perf_counter() - t0
+            per_step = max(t_long - t_short, 1e-9) / self.chain
+            if self.mode == "timeslice":
+                # each of the N streams waits for the N-1 ahead of it
+                per_step *= self.streams
+            samples.append(per_step)
+        samples.sort()
+        return samples[len(samples) // 2]
+
+
+def serve_metrics(port: int):
+    class Handler(BaseHTTPRequestHandler):
+        def do_GET(self):
+            body = REGISTRY.expose().encode()
+            self.send_response(200)
+            self.send_header("Content-Type", "text/plain; version=0.0.4")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def log_message(self, *a):
+            pass
+
+    srv = HTTPServer(("", port), Handler)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    return srv
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--mode", default=os.environ.get("TPU_SHARING_MODE", "multiplex"),
+                    choices=("multiplex", "timeslice", "subslice"))
+    ap.add_argument("--streams", type=int,
+                    default=int(os.environ.get("TPU_SHARING_STREAMS", "1")))
+    ap.add_argument("--seconds", type=float, default=20.0,
+                    help="measurement window per sample batch")
+    ap.add_argument("--oneshot", action="store_true",
+                    help="print one JSON result line and exit")
+    ap.add_argument("--port", type=int, default=8000)
+    args = ap.parse_args()
+
+    # subslice pods each own an isolated partition: their latency is the
+    # single-stream latency regardless of co-resident pod count
+    streams = 1 if args.mode == "subslice" else args.streams
+    rig = BenchRig(args.mode, streams)
+
+    if args.oneshot:
+        lat = rig.measure(args.seconds)
+        print(json.dumps({
+            "mode": args.mode, "streams": args.streams,
+            "avg_inference_s": round(lat, 6),
+        }))
+        return
+
+    serve_metrics(args.port)
+    h = LATENCY.labels(args.mode, str(args.streams))
+    while True:
+        h.observe(rig.measure(args.seconds))
+
+
+if __name__ == "__main__":
+    main()

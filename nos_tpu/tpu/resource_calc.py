@@ -18,6 +18,8 @@ from nos_tpu.tpu.slice import parse_profile
 from nos_tpu.tpu import topology
 
 _MIG_RE = re.compile(r"^nvidia\.com/mig-\d+g\.(\d+)gb$")
+# MPS memory slice (reference pkg/gpu/slicing/profile.go:29-64)
+_MPS_RE = re.compile(r"^nvidia\.com/gpu-(\d+)gb$")
 
 
 @dataclass
@@ -49,7 +51,7 @@ class ResourceCalculator:
             elif name == constants.RESOURCE_NVIDIA_GPU:
                 gpu_mem += qty * self.nvidia_gpu_memory_gb
             else:
-                m = _MIG_RE.match(name)
+                m = _MIG_RE.match(name) or _MPS_RE.match(name)
                 if m:
                     gpu_mem += qty * int(m.group(1))
         if tpu_mem:

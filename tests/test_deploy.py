@@ -248,3 +248,33 @@ def test_console_scripts_resolve():
         mod_name, func = target.split(":")
         mod = importlib.import_module(mod_name)
         assert callable(getattr(mod, func)), f"{name}: {target} not callable"
+
+
+# ---------------------------------------------------------------------------
+# demos/tpu-sharing-comparison manifests
+# ---------------------------------------------------------------------------
+
+DEMO = os.path.join(REPO, "demos", "tpu-sharing-comparison")
+
+
+def test_demo_manifests_parse_and_cover_all_modes():
+    for mode in ("multiplex", "timeslice", "subslice"):
+        overlay = os.path.join(DEMO, "manifests", "overlays", mode)
+        for name in ("kustomization.yaml", "patch.yaml"):
+            with open(os.path.join(overlay, name)) as f:
+                assert yaml.safe_load(f)
+    base = os.path.join(DEMO, "manifests", "base")
+    docs = []
+    for path in sorted(glob.glob(os.path.join(base, "*.yaml"))):
+        with open(path) as f:
+            docs.extend(d for d in yaml.safe_load_all(f) if d)
+    kinds = {d["kind"] for d in docs}
+    assert {"Namespace", "Deployment", "PodMonitor", "Kustomization"} <= kinds
+
+
+def test_demo_subslice_overlay_requests_partition_resource():
+    with open(os.path.join(DEMO, "manifests", "overlays", "subslice",
+                           "patch.yaml")) as f:
+        patch = yaml.safe_load(f)
+    limits = patch["spec"]["template"]["spec"]["containers"][0]["resources"]["limits"]
+    assert any(k.startswith("nos.ai/tpu-slice-") for k in limits)

@@ -1,0 +1,143 @@
+"""Mixed A100 + TPU clusters under one quota system (BASELINE config 5).
+
+The reference counts only NVIDIA resources; the rebuild's quota layer must
+count TPU chips alongside GPUs, with accelerator memory as the common
+borrowing currency (nos.ai/tpu-memory + nos.ai/gpu-memory derived scalars —
+analog of reference pkg/gpu/util/resource.go).
+"""
+from nos_tpu import constants
+from nos_tpu.tpu.resource_calc import ResourceCalculator
+
+TPU = constants.RESOURCE_TPU
+GPU = constants.RESOURCE_NVIDIA_GPU
+TPU_MEM = constants.RESOURCE_TPU_MEMORY
+GPU_MEM = constants.RESOURCE_GPU_MEMORY
+
+
+# ---------------------------------------------------------------------------
+# derived-currency parsing across accelerator families
+# ---------------------------------------------------------------------------
+
+def test_mig_profile_memory_parsed():
+    calc = ResourceCalculator()
+    req = calc.compute_request({"nvidia.com/mig-1g.10gb": 2})
+    assert req[GPU_MEM] == 20
+
+
+def test_mps_slice_memory_parsed():
+    calc = ResourceCalculator()
+    req = calc.compute_request({"nvidia.com/gpu-10gb": 3})
+    assert req[GPU_MEM] == 30
+
+
+def test_whole_gpu_uses_default_memory():
+    calc = ResourceCalculator(nvidia_gpu_memory_gb=32)
+    req = calc.compute_request({GPU: 2})
+    assert req[GPU_MEM] == 64
+
+
+def test_mixed_pod_derives_both_currencies():
+    calc = ResourceCalculator(tpu_memory_gb=16)
+    req = calc.compute_request({TPU: 4, "nvidia.com/mig-2g.20gb": 1, "cpu": 8})
+    assert req[TPU_MEM] == 64
+    assert req[GPU_MEM] == 20
+    assert req["cpu"] == 8
+
+
+def test_unknown_nvidia_resource_ignored():
+    calc = ResourceCalculator()
+    req = calc.compute_request({"nvidia.com/gpu.shared": 1})
+    assert GPU_MEM not in req
+
+
+# ---------------------------------------------------------------------------
+# end-to-end: EQ borrowing across a GPU namespace and a TPU namespace
+# ---------------------------------------------------------------------------
+
+def test_quota_borrowing_across_gpu_and_tpu_namespaces(make_cluster):
+    """A TPU namespace borrows the GPU namespace's idle chips' worth of
+    quota counted in its own resource; each family's min is enforced
+    independently while both live under one quota system."""
+    c = make_cluster()
+    c.add_node("gpu-node", {GPU: 4, "cpu": 32})
+    c.add_node("tpu-node", {TPU: 8, "cpu": 32})
+    # team-gpu holds idle TPU min that team-tpu can borrow
+    c.add_elastic_quota("team-gpu", "q-gpu", {GPU: 4, TPU: 4})
+    c.add_elastic_quota("team-tpu", "q-tpu", {TPU: 4})
+    # TPU team goes over its min=4 by borrowing team-gpu's idle TPU min
+    c.add_pod("team-tpu", "t1", {TPU: 4})
+    c.add_pod("team-tpu", "t2", {TPU: 4})
+    c.add_pod("team-gpu", "g1", {GPU: 2})
+    c.run_until_idle()
+    pods = {p.metadata.name: p for p in c.client.list("Pod")}
+    assert pods["t1"].spec.node_name == "tpu-node"
+    assert pods["t2"].spec.node_name == "tpu-node"   # borrowed TPU quota
+    assert pods["g1"].spec.node_name == "gpu-node"
+
+
+def test_borrowing_blocked_without_aggregated_headroom(make_cluster):
+    """With no other quota holding idle TPU min, the aggregated-min ceiling
+    rejects the borrower even though the node has free chips."""
+    c = make_cluster()
+    c.add_node("tpu-node", {TPU: 8, "cpu": 32})
+    c.add_elastic_quota("team-gpu", "q-gpu", {GPU: 4})     # no TPU min anywhere else
+    c.add_elastic_quota("team-tpu", "q-tpu", {TPU: 4})
+    c.add_pod("team-tpu", "t1", {TPU: 4})
+    c.add_pod("team-tpu", "t2", {TPU: 4})
+    c.run_until_idle()
+    pods = {p.metadata.name: p for p in c.client.list("Pod")}
+    scheduled = sorted(n for n, p in pods.items() if p.spec.node_name)
+    assert scheduled == ["t1"]
+
+
+def test_over_quota_labeling_is_per_family(make_cluster):
+    """The EQ controller labels the borrowing TPU pod over-quota while the
+    GPU namespace's pods stay in-quota."""
+    c = make_cluster()
+    c.add_node("gpu-node", {GPU: 4, "cpu": 32})
+    c.add_node("tpu-node", {TPU: 8, "cpu": 32})
+    c.add_elastic_quota("team-gpu", "q-gpu", {GPU: 4})
+    c.add_elastic_quota("team-tpu", "q-tpu", {TPU: 4})
+    c.add_pod("team-tpu", "t1", {TPU: 4}, phase="Running")
+    c.add_pod("team-tpu", "t2", {TPU: 4}, phase="Running")
+    c.add_pod("team-gpu", "g1", {GPU: 2}, phase="Running")
+    c.run_until_idle()
+    labels = {
+        p.metadata.name: p.metadata.labels.get(constants.LABEL_CAPACITY)
+        for p in c.client.list("Pod")
+    }
+    assert labels["g1"] == constants.CAPACITY_IN_QUOTA
+    # one TPU pod fits min=4, the other is borrowing
+    tpu_labels = sorted([labels["t1"], labels["t2"]])
+    assert tpu_labels == [constants.CAPACITY_IN_QUOTA, constants.CAPACITY_OVER_QUOTA]
+
+
+def test_eq_status_counts_both_families(make_cluster):
+    c = make_cluster()
+    c.add_elastic_quota("team-mixed", "q-mixed", {TPU: 8, GPU: 4})
+    c.add_pod("team-mixed", "p1", {TPU: 4, GPU: 2}, phase="Running")
+    c.run_until_idle()
+    eq = c.client.get("ElasticQuota", "q-mixed", "team-mixed")
+    assert eq.status.used[TPU] == 4
+    assert eq.status.used[GPU] == 2
+    # status.used reports only the resources the quota enforces
+    assert TPU_MEM not in eq.status.used
+    assert GPU_MEM not in eq.status.used
+
+
+def test_eq_enforces_derived_memory_currency(make_cluster):
+    """A quota whose min bounds the derived accelerator-memory scalar
+    accounts it across families: MIG slices and TPU chips both charge it."""
+    c = make_cluster()
+    calc = ResourceCalculator()
+    c.add_elastic_quota(
+        "team-mixed", "q-mem",
+        {TPU_MEM: 100, GPU_MEM: 100},
+    )
+    c.add_pod("team-mixed", "p1",
+              {TPU: 2, "nvidia.com/mig-1g.10gb": 1}, phase="Running")
+    c.run_until_idle()
+    eq = c.client.get("ElasticQuota", "q-mem", "team-mixed")
+    expected = calc.compute_request({TPU: 2})[TPU_MEM]
+    assert eq.status.used[TPU_MEM] == expected
+    assert eq.status.used[GPU_MEM] == 10
