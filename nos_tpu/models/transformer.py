@@ -27,6 +27,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from nos_tpu.ops.attention import attention
 from nos_tpu.ops.layers import apply_rope, rms_norm, rope_frequencies
+from nos_tpu.ops.moe import moe_ffn
 from nos_tpu.ops.ring_attention import ring_attention
 
 Params = Dict[str, Any]
@@ -43,6 +44,11 @@ class TransformerConfig:
     rope_theta: float = 10000.0
     dtype: Any = jnp.bfloat16
     remat: bool = True
+    # Mixture-of-Experts: when n_experts > 0 every layer's FFN is a top-2
+    # MoE with experts sharded over the mesh's ep axis (nos_tpu/ops/moe.py)
+    n_experts: int = 0
+    expert_capacity_factor: float = 1.25
+    moe_aux_weight: float = 0.01
 
     @property
     def head_dim(self) -> int:
@@ -60,22 +66,30 @@ def init_params(rng: jax.Array, cfg: TransformerConfig) -> Params:
         return (jax.random.normal(key, shape, jnp.float32) * fan_in ** -0.5
                 ).astype(cfg.dtype)
 
-    keys = jax.random.split(k_layers, cfg.n_layers * 7).reshape(cfg.n_layers, 7, 2)
+    keys = jax.random.split(k_layers, cfg.n_layers * 8).reshape(cfg.n_layers, 8, 2)
 
     def layer(i):
-        kq, kk, kv, ko, kg, ku, kd = [keys[i, j] for j in range(7)]
-        d, h = cfg.d_model, cfg.d_ff
-        return {
+        kq, kk, kv, ko, kg, ku, kd, kr = [keys[i, j] for j in range(8)]
+        d, h, e = cfg.d_model, cfg.d_ff, cfg.n_experts
+        out = {
             "attn_norm": jnp.ones((d,), jnp.float32),
             "wq": dense(kq, (d, d), d),
             "wk": dense(kk, (d, d), d),
             "wv": dense(kv, (d, d), d),
             "wo": dense(ko, (d, d), d),
             "mlp_norm": jnp.ones((d,), jnp.float32),
-            "w_gate": dense(kg, (d, h), d),
-            "w_up": dense(ku, (d, h), d),
-            "w_down": dense(kd, (h, d), h),
         }
+        if e > 0:
+            out["w_router"] = (jax.random.normal(kr, (d, e), jnp.float32)
+                               * d ** -0.5)
+            out["w_gate"] = dense(kg, (e, d, h), d)
+            out["w_up"] = dense(ku, (e, d, h), d)
+            out["w_down"] = dense(kd, (e, h, d), h)
+        else:
+            out["w_gate"] = dense(kg, (d, h), d)
+            out["w_up"] = dense(ku, (d, h), d)
+            out["w_down"] = dense(kd, (h, d), h)
+        return out
 
     layers = jax.tree.map(lambda *xs: jnp.stack(xs), *[layer(i) for i in range(cfg.n_layers)])
     return {
@@ -102,10 +116,17 @@ def param_shardings(mesh: Mesh, cfg: TransformerConfig) -> Params:
         "wv": ns(None, "fsdp", "tp"),
         "wo": ns(None, "tp", "fsdp"),
         "mlp_norm": ns(None, None),
-        "w_gate": ns(None, "fsdp", "tp"),
-        "w_up": ns(None, "fsdp", "tp"),
-        "w_down": ns(None, "tp", "fsdp"),
     }
+    if cfg.n_experts > 0:
+        # experts over ep; within each expert the megatron layout
+        layer["w_router"] = ns(None, "fsdp", None)
+        layer["w_gate"] = ns(None, "ep", "fsdp", "tp")
+        layer["w_up"] = ns(None, "ep", "fsdp", "tp")
+        layer["w_down"] = ns(None, "ep", "tp", "fsdp")
+    else:
+        layer["w_gate"] = ns(None, "fsdp", "tp")
+        layer["w_up"] = ns(None, "fsdp", "tp")
+        layer["w_down"] = ns(None, "tp", "fsdp")
     return {
         "embed": ns("tp", None),
         "layers": layer,
@@ -124,6 +145,42 @@ def _activation_spec(mesh: Optional[Mesh]) -> Optional[P]:
     batch = tuple(a for a in ("dp", "fsdp") if a in mesh.axis_names) or None
     seq = "sp" if "sp" in mesh.axis_names else None
     return P(batch, seq, None)
+
+
+def attention_block(h_in, layer, cfg: TransformerConfig, freqs,
+                    attention_call):
+    """Pre-RMSNorm attention sublayer + residual. ``attention_call(q, k, v)``
+    takes/returns [B, S, H, D]."""
+    b, s = h_in.shape[:2]
+    h = rms_norm(h_in, layer["attn_norm"])
+    q = jnp.dot(h, layer["wq"]).reshape(b, s, cfg.n_heads, cfg.head_dim)
+    k = jnp.dot(h, layer["wk"]).reshape(b, s, cfg.n_heads, cfg.head_dim)
+    v = jnp.dot(h, layer["wv"]).reshape(b, s, cfg.n_heads, cfg.head_dim)
+    q, k = apply_rope(q, freqs), apply_rope(k, freqs)
+    o = attention_call(q, k, v).reshape(b, s, cfg.d_model)
+    return h_in + jnp.dot(o, layer["wo"])
+
+
+def dense_ffn_block(h_in, layer):
+    """Pre-RMSNorm SwiGLU FFN sublayer + residual (dense path)."""
+    h = rms_norm(h_in, layer["mlp_norm"])
+    gate = jax.nn.silu(jnp.dot(h, layer["w_gate"]))
+    up = jnp.dot(h, layer["w_up"])
+    return h_in + jnp.dot(gate * up, layer["w_down"])
+
+
+def dense_layer_block(h_in, layer, cfg: TransformerConfig, freqs,
+                      attention_call):
+    """One decoder layer on the dense path. Shared by the plain forward and
+    the pipelined forward so the two cannot drift."""
+    x = attention_block(h_in, layer, cfg, freqs, attention_call)
+    return dense_ffn_block(x, layer)
+
+
+def cross_entropy(logits: jax.Array, targets: jax.Array) -> jax.Array:
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    return jnp.mean(nll)
 
 
 def _attention_call(q, k, v, mesh: Optional[Mesh]):
@@ -149,8 +206,10 @@ def forward(
     cfg: TransformerConfig,
     tokens: jax.Array,
     mesh: Optional[Mesh] = None,
-) -> jax.Array:
-    """tokens [B, S] -> logits [B, S, vocab]."""
+    return_aux: bool = False,
+):
+    """tokens [B, S] -> logits [B, S, vocab] (plus the MoE auxiliary loss
+    when ``return_aux``)."""
     b, s = tokens.shape
     freqs = rope_frequencies(cfg.head_dim, cfg.max_seq, cfg.rope_theta)
     act_spec = _activation_spec(mesh)
@@ -166,27 +225,31 @@ def forward(
     # applied inside the layer on the local shard with its global offset
     # handled by the constraint (XLA keeps the gather local)
     def layer_body(x, layer):
-        h = rms_norm(x, layer["attn_norm"])
-        q = jnp.dot(h, layer["wq"]).reshape(b, s, cfg.n_heads, cfg.head_dim)
-        k = jnp.dot(h, layer["wk"]).reshape(b, s, cfg.n_heads, cfg.head_dim)
-        v = jnp.dot(h, layer["wv"]).reshape(b, s, cfg.n_heads, cfg.head_dim)
-        q = apply_rope(q, freqs)
-        k = apply_rope(k, freqs)
-        o = _attention_call(q, k, v, mesh).reshape(b, s, cfg.d_model)
-        x = constrain(x + jnp.dot(o, layer["wo"]))
-        h = rms_norm(x, layer["mlp_norm"])
-        gate = jax.nn.silu(jnp.dot(h, layer["w_gate"]))
-        up = jnp.dot(h, layer["w_up"])
-        x = constrain(x + jnp.dot(gate * up, layer["w_down"]))
-        return x, None
+        x = constrain(attention_block(
+            x, layer, cfg, freqs, lambda q, k, v: _attention_call(q, k, v, mesh)
+        ))
+        if cfg.n_experts > 0:
+            h = rms_norm(x, layer["mlp_norm"])
+            y, aux = moe_ffn(
+                h, layer["w_router"], layer["w_gate"], layer["w_up"],
+                layer["w_down"], cfg.expert_capacity_factor,
+            )
+            x = x + y
+        else:
+            x = dense_ffn_block(x, layer)
+            aux = jnp.float32(0.0)
+        return constrain(x), aux
 
     body = layer_body
     if cfg.remat:
         body = jax.checkpoint(layer_body)
-    x, _ = jax.lax.scan(body, x, params["layers"])
+    x, aux_per_layer = jax.lax.scan(body, x, params["layers"])
 
     x = rms_norm(x, params["final_norm"])
-    return jnp.dot(x, params["unembed"]).astype(jnp.float32)
+    logits = jnp.dot(x, params["unembed"]).astype(jnp.float32)
+    if return_aux:
+        return logits, jnp.mean(aux_per_layer)
+    return logits
 
 
 # ---------------------------------------------------------------------------
@@ -195,11 +258,8 @@ def forward(
 
 def loss_fn(params: Params, cfg: TransformerConfig, batch: Dict[str, jax.Array],
             mesh: Optional[Mesh] = None) -> jax.Array:
-    logits = forward(params, cfg, batch["tokens"], mesh)
-    targets = batch["targets"]
-    logp = jax.nn.log_softmax(logits, axis=-1)
-    nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
-    return jnp.mean(nll)
+    logits, aux = forward(params, cfg, batch["tokens"], mesh, return_aux=True)
+    return cross_entropy(logits, batch["targets"]) + cfg.moe_aux_weight * aux
 
 
 def make_train_step(cfg: TransformerConfig, optimizer,

@@ -1,0 +1,109 @@
+"""Mixture-of-Experts FFN with expert parallelism — TPU-first.
+
+The ep axis of ``ParallelLayout`` (SURVEY §2.7: parallelism components the
+reference lacks) becomes real here: experts are sharded over the mesh's
+``ep`` axis and tokens reach them through dense dispatch/combine einsums —
+static shapes, no gather/scatter, so XLA lowers the routing to all-to-all
+collectives over ICI (the GShard/Switch pattern, PAPERS.md).
+
+Top-2 gating with per-expert capacity:
+- every token picks its best and second-best expert by router logits;
+- each expert accepts at most C tokens per batch row (C from
+  ``capacity_factor``); overflow tokens are dropped for that expert (their
+  residual path still carries them — standard MoE semantics);
+- gate weights of the kept assignments are renormalized per token;
+- the load-balancing auxiliary loss (mean fraction routed x mean gate
+  probability, scaled by E) keeps the router from collapsing.
+
+Everything is computed in fp32 for routing stability; expert matmuls run in
+the model dtype on the MXU.
+"""
+from __future__ import annotations
+
+from typing import Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def expert_capacity(seq: int, n_experts: int, capacity_factor: float,
+                    top_k: int = 2) -> int:
+    """Tokens each expert can accept per batch row."""
+    return max(1, int(seq * top_k * capacity_factor / n_experts))
+
+
+def top2_gating(
+    logits: jax.Array, capacity: int
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """logits [B, S, E] (fp32) -> (combine [B, S, E, C], dispatch bool
+    [B, S, E, C], aux_loss scalar)."""
+    b, s, e = logits.shape
+    gates = jax.nn.softmax(logits, axis=-1)
+
+    # top-1 and top-2 expert choices per token
+    idx1 = jnp.argmax(gates, axis=-1)                       # [B, S]
+    mask1 = jax.nn.one_hot(idx1, e, dtype=jnp.float32)      # [B, S, E]
+    gates2 = gates * (1.0 - mask1)
+    idx2 = jnp.argmax(gates2, axis=-1)
+    mask2 = jax.nn.one_hot(idx2, e, dtype=jnp.float32)
+
+    # position of each token in its expert's buffer (cumsum over sequence);
+    # top-1 assignments fill first, top-2 go after all top-1s
+    pos1 = jnp.cumsum(mask1, axis=1) - mask1                # [B, S, E]
+    count1 = jnp.sum(mask1, axis=1, keepdims=True)          # [B, 1, E]
+    pos2 = jnp.cumsum(mask2, axis=1) - mask2 + count1
+
+    keep1 = mask1 * (pos1 < capacity)
+    keep2 = mask2 * (pos2 < capacity)
+
+    # renormalized gate weights over the kept assignments
+    g1 = jnp.sum(gates * keep1, axis=-1)                    # [B, S]
+    g2 = jnp.sum(gates * keep2, axis=-1)
+    denom = jnp.maximum(g1 + g2, 1e-9)
+    g1, g2 = g1 / denom, g2 / denom
+
+    cap1 = jax.nn.one_hot(jnp.sum(pos1 * keep1, axis=-1).astype(jnp.int32),
+                          capacity, dtype=jnp.float32)      # [B, S, C]
+    cap2 = jax.nn.one_hot(jnp.sum(pos2 * keep2, axis=-1).astype(jnp.int32),
+                          capacity, dtype=jnp.float32)
+    combine = (
+        g1[..., None, None] * keep1[..., None] * cap1[..., None, :]
+        + g2[..., None, None] * keep2[..., None] * cap2[..., None, :]
+    )                                                       # [B, S, E, C]
+    dispatch = combine > 0.0
+
+    # load-balancing aux loss (GShard eq. for top-1 fractions)
+    frac_routed = jnp.mean(mask1, axis=(0, 1))              # [E]
+    mean_gate = jnp.mean(gates, axis=(0, 1))                # [E]
+    aux = e * jnp.sum(frac_routed * mean_gate)
+    return combine, dispatch, aux
+
+
+def moe_ffn(
+    h: jax.Array,
+    router: jax.Array,
+    w_gate: jax.Array,
+    w_up: jax.Array,
+    w_down: jax.Array,
+    capacity_factor: float = 1.25,
+) -> Tuple[jax.Array, jax.Array]:
+    """h [B, S, d]; router [d, E]; expert weights [E, d, f]/[E, f, d].
+    Returns (out [B, S, d], aux_loss). Shard the leading E axis of the
+    expert weights over the mesh's ``ep`` axis — the dispatch/combine
+    einsums then become ICI all-to-alls under GSPMD."""
+    e = router.shape[-1]
+    seq = h.shape[1]
+    cap = expert_capacity(seq, e, capacity_factor)
+
+    logits = jnp.dot(h.astype(jnp.float32), router.astype(jnp.float32))
+    combine, dispatch, aux = top2_gating(logits, cap)
+
+    # dispatch: [B,S,E,C] x [B,S,d] -> [E,B,C,d]
+    x = jnp.einsum("bsec,bsd->ebcd", dispatch.astype(h.dtype), h)
+    # per-expert SwiGLU, expert dim carried through the einsums
+    gate = jax.nn.silu(jnp.einsum("ebcd,edf->ebcf", x, w_gate))
+    up = jnp.einsum("ebcd,edf->ebcf", x, w_up)
+    y = jnp.einsum("ebcf,efd->ebcd", gate * up, w_down)
+    # combine back: [E,B,C,d] x [B,S,E,C] -> [B,S,d]
+    out = jnp.einsum("ebcd,bsec->bsd", y, combine.astype(h.dtype))
+    return out, aux

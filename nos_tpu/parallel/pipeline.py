@@ -1,0 +1,177 @@
+"""Pipeline parallelism — GPipe-style microbatch rotation over a ``pp``
+mesh axis.
+
+The last parallelism axis of ``ParallelLayout`` made real (SURVEY §2.7):
+the layer stack is split into P stages, each stage's parameters live on one
+slice of the ``pp`` axis, and microbatches flow stage-to-stage over ICI via
+``lax.ppermute`` inside a ``lax.scan`` — the SPMD pipelining pattern (one
+program, stage identity from ``axis_index``), not P separate programs.
+
+Composition contract:
+- ``pp`` is the only *manual* axis (``jax.shard_map(axis_names={"pp"})``);
+  dp/fsdp/tp stay auto, so GSPMD still shards the within-stage matmuls —
+  pipeline composes freely with data/tensor parallelism.
+- sequence parallelism (sp/ring attention) does not compose with pp in this
+  implementation (it would nest shard_maps); long-context jobs pick sp,
+  depth-bound jobs pick pp. MoE layers are likewise dense-path only here.
+
+Schedule: plain GPipe fill-and-drain — T = M + P - 1 rotation steps for M
+microbatches over P stages; bubble fraction (P-1)/T shrinks as M grows.
+"""
+from __future__ import annotations
+
+from typing import Dict
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from nos_tpu.models.transformer import (
+    Params,
+    TransformerConfig,
+    cross_entropy,
+    dense_layer_block,
+)
+from nos_tpu.ops.attention import attention
+from nos_tpu.ops.layers import rms_norm, rope_frequencies
+
+
+def _check(cfg: TransformerConfig, mesh: Mesh, batch: int, n_microbatches: int):
+    if "pp" not in mesh.axis_names:
+        raise ValueError("mesh has no pp axis")
+    if "sp" in mesh.axis_names and mesh.shape["sp"] > 1:
+        raise ValueError("pipeline does not compose with sp (ring attention)")
+    if cfg.n_experts:
+        raise ValueError("pipeline supports the dense FFN path only")
+    stages = mesh.shape["pp"]
+    if cfg.n_layers % stages:
+        raise ValueError(
+            f"n_layers {cfg.n_layers} not divisible by pp {stages}")
+    if batch % n_microbatches:
+        raise ValueError(
+            f"batch {batch} not divisible by n_microbatches {n_microbatches}")
+    return stages
+
+
+def pipeline_forward(
+    params: Params,
+    cfg: TransformerConfig,
+    tokens: jax.Array,
+    mesh: Mesh,
+    n_microbatches: int = 2,
+) -> jax.Array:
+    """tokens [B, S] -> logits [B, S, vocab], layer stack executed as a
+    P-stage pipeline over the mesh's pp axis. Numerically identical to
+    ``transformer.forward`` on the dense path."""
+    b, s = tokens.shape
+    stages = _check(cfg, mesh, b, n_microbatches)
+    n_local = cfg.n_layers // stages
+    mb = b // n_microbatches
+    freqs = rope_frequencies(cfg.head_dim, cfg.max_seq, cfg.rope_theta)
+
+    x = params["embed"][tokens]                       # [B, S, d]
+    mbs = x.reshape(n_microbatches, mb, s, cfg.d_model)
+
+    # [L, ...] -> [P, K, ...]: leading stage dim is pp-sharded in the
+    # shard_map below
+    stage_params = jax.tree.map(
+        lambda w: w.reshape(stages, n_local, *w.shape[1:]), params["layers"])
+
+    def attention_call(q, k, v):
+        return attention(
+            q.transpose(0, 2, 1, 3), k.transpose(0, 2, 1, 3),
+            v.transpose(0, 2, 1, 3), causal=True,
+        ).transpose(0, 2, 1, 3)
+
+    def layer_body(h_in, layer):
+        return dense_layer_block(h_in, layer, cfg, freqs, attention_call), None
+
+    if cfg.remat:
+        layer_body = jax.checkpoint(layer_body)
+
+    def stage_program(local_params, microbatches):
+        # local_params leaves [1, K, ...] (this stage's slice); squeeze it
+        local_params = jax.tree.map(lambda w: w[0], local_params)
+        p_idx = jax.lax.axis_index("pp")
+        n_steps = n_microbatches + stages - 1
+        perm = [(i, (i + 1) % stages) for i in range(stages)]
+
+        def stage_fn(h):
+            out, _ = jax.lax.scan(layer_body, h, local_params)
+            return out
+
+        def step(carry, t):
+            recv, outputs = carry
+            mb_idx = t - p_idx
+            first = microbatches[jnp.clip(t, 0, n_microbatches - 1)]
+            inp = jnp.where(p_idx == 0, first, recv)
+            y = stage_fn(inp)
+            active = (mb_idx >= 0) & (mb_idx < n_microbatches)
+            write = jnp.clip(mb_idx, 0, n_microbatches - 1)
+            updated = jax.lax.dynamic_update_index_in_dim(outputs, y, write, 0)
+            outputs = jnp.where(active & (p_idx == stages - 1),
+                                updated, outputs)
+            recv = jax.lax.ppermute(y, "pp", perm)
+            return (recv, outputs), None
+
+        zeros = jnp.zeros_like(microbatches[0])
+        out0 = jnp.zeros_like(microbatches)
+        (_, outputs), _ = jax.lax.scan(
+            step, (zeros, out0), jnp.arange(n_steps))
+        # [1, M, mb, S, d]: stacked back over pp by out_specs
+        return outputs[None]
+
+    stacked = jax.shard_map(
+        stage_program,
+        mesh=mesh,
+        in_specs=(P("pp"), P()),
+        out_specs=P("pp"),
+        axis_names={"pp"},
+        check_vma=False,
+    )(stage_params, mbs)
+    x = stacked[-1].reshape(b, s, cfg.d_model)        # last stage's outputs
+
+    x = rms_norm(x, params["final_norm"])
+    return jnp.dot(x, params["unembed"]).astype(jnp.float32)
+
+
+def pipeline_loss_fn(params: Params, cfg: TransformerConfig,
+                     batch: Dict[str, jax.Array], mesh: Mesh,
+                     n_microbatches: int = 2) -> jax.Array:
+    logits = pipeline_forward(params, cfg, batch["tokens"], mesh,
+                              n_microbatches)
+    return cross_entropy(logits, batch["targets"])
+
+
+def make_pipeline_train_step(cfg: TransformerConfig, optimizer, mesh: Mesh,
+                             n_microbatches: int = 2):
+    """Pipelined analog of transformer.make_train_step."""
+
+    def train_step(params, opt_state, batch):
+        import optax
+
+        loss, grads = jax.value_and_grad(pipeline_loss_fn)(
+            params, cfg, batch, mesh, n_microbatches)
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, loss
+
+    return train_step
+
+
+def pipeline_param_shardings(mesh: Mesh, cfg: TransformerConfig) -> Params:
+    """Param shardings for the pipelined layout: the stacked layer dim is
+    pp-sharded (stage p holds layers [pK, (p+1)K)); within a stage the
+    megatron fsdp/tp layout applies as usual."""
+    from nos_tpu.models.transformer import param_shardings
+    from nos_tpu.parallel.mesh import logical_to_sharding
+
+    base = param_shardings(mesh, cfg)
+
+    def reshard(path_sharding):
+        spec = path_sharding.spec
+        return logical_to_sharding(mesh, "pp", *spec[1:]) if spec else path_sharding
+
+    layers = {k: reshard(v) for k, v in base["layers"].items()}
+    base["layers"] = layers
+    return base

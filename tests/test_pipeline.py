@@ -1,0 +1,114 @@
+"""Pipeline parallelism (parallel/pipeline.py): equivalence with the plain
+forward, training step, composition rules."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from nos_tpu.models import transformer as tfm
+from nos_tpu.parallel.layout import ParallelLayout
+from nos_tpu.parallel.mesh import build_mesh, data_sharding
+from nos_tpu.parallel.pipeline import (
+    make_pipeline_train_step,
+    pipeline_forward,
+    pipeline_loss_fn,
+    pipeline_param_shardings,
+)
+
+pytestmark = pytest.mark.skipif(
+    len(jax.devices()) < 8, reason="needs 8 virtual devices")
+
+
+def small_cfg(**kw):
+    base = dict(vocab=64, d_model=32, n_layers=4, n_heads=4, d_ff=64,
+                max_seq=32, dtype=jnp.float32)
+    base.update(kw)
+    return tfm.TransformerConfig(**base)
+
+
+def pp_mesh(pp=2, dp=1, tp=1):
+    layout = ParallelLayout(dp=dp, tp=tp, pp=pp)
+    return build_mesh(layout, jax.devices()[:layout.chips])
+
+
+def test_pipeline_forward_matches_plain_forward():
+    cfg = small_cfg()
+    mesh = pp_mesh(pp=2)
+    params = tfm.init_params(jax.random.PRNGKey(0), cfg)
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (4, 16), 0, cfg.vocab)
+
+    ref = tfm.forward(params, cfg, tokens)
+    params_sharded = jax.device_put(params, pipeline_param_shardings(mesh, cfg))
+    got = jax.jit(
+        lambda p, t: pipeline_forward(p, cfg, t, mesh, n_microbatches=2)
+    )(params_sharded, tokens)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               rtol=2e-4, atol=2e-4)
+
+
+def test_pipeline_forward_matches_with_more_microbatches_and_stages():
+    cfg = small_cfg()
+    mesh = pp_mesh(pp=4)
+    params = tfm.init_params(jax.random.PRNGKey(2), cfg)
+    tokens = jax.random.randint(jax.random.PRNGKey(3), (8, 16), 0, cfg.vocab)
+    ref = tfm.forward(params, cfg, tokens)
+    got = jax.jit(
+        lambda p, t: pipeline_forward(p, cfg, t, mesh, n_microbatches=4)
+    )(jax.device_put(params, pipeline_param_shardings(mesh, cfg)), tokens)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               rtol=2e-4, atol=2e-4)
+
+
+def test_pipeline_composes_with_dp_and_tp():
+    import optax
+
+    cfg = small_cfg()
+    mesh = build_mesh(ParallelLayout(dp=2, tp=2, pp=2), jax.devices()[:8])
+    params = jax.device_put(
+        tfm.init_params(jax.random.PRNGKey(0), cfg),
+        pipeline_param_shardings(mesh, cfg))
+    optimizer = optax.adamw(1e-3)
+    opt_state = optimizer.init(params)
+    step = jax.jit(make_pipeline_train_step(cfg, optimizer, mesh,
+                                            n_microbatches=2))
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (4, 16), 0, cfg.vocab)
+    batch = {"tokens": jax.device_put(tokens, data_sharding(mesh)),
+             "targets": jax.device_put(tokens, data_sharding(mesh))}
+    params, opt_state, loss = step(params, opt_state, batch)
+    assert jnp.isfinite(loss)
+
+
+def test_pipeline_loss_matches_plain_loss():
+    cfg = small_cfg()
+    mesh = pp_mesh(pp=2)
+    params = tfm.init_params(jax.random.PRNGKey(0), cfg)
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (4, 16), 0, cfg.vocab)
+    batch = {"tokens": tokens, "targets": tokens}
+    ref = tfm.loss_fn(params, cfg, batch)
+    got = pipeline_loss_fn(
+        jax.device_put(params, pipeline_param_shardings(mesh, cfg)),
+        cfg, batch, mesh, n_microbatches=2)
+    np.testing.assert_allclose(float(got), float(ref), rtol=1e-4)
+
+
+def test_pipeline_validation_errors():
+    cfg = small_cfg(n_layers=3)
+    mesh = pp_mesh(pp=2)
+    params = tfm.init_params(jax.random.PRNGKey(0), cfg)
+    tokens = jnp.zeros((4, 16), jnp.int32)
+    with pytest.raises(ValueError, match="not divisible by pp"):
+        pipeline_forward(params, cfg, tokens, mesh)
+    cfg4 = small_cfg()
+    params4 = tfm.init_params(jax.random.PRNGKey(0), cfg4)
+    with pytest.raises(ValueError, match="n_microbatches"):
+        pipeline_forward(params4, cfg4, tokens, mesh, n_microbatches=3)
+    moe = small_cfg(n_experts=2)
+    with pytest.raises(ValueError, match="dense"):
+        pipeline_forward(tfm.init_params(jax.random.PRNGKey(0), moe),
+                         moe, tokens, mesh)
+    sp_mesh = build_mesh(ParallelLayout(pp=2, sp=2), jax.devices()[:4])
+    with pytest.raises(ValueError, match="sp"):
+        pipeline_forward(params4, cfg4, tokens, sp_mesh)
+    no_pp = build_mesh(ParallelLayout(dp=2), jax.devices()[:2])
+    with pytest.raises(ValueError, match="no pp axis"):
+        pipeline_forward(params4, cfg4, tokens, no_pp)
