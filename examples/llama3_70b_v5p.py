@@ -1,0 +1,140 @@
+#!/usr/bin/env python3
+"""North-star example: a Llama-3-70B-scale training JobSet on a v5p-512.
+
+BASELINE.json's target scenario: nos-tpu schedules and right-sizes a
+Llama-3-70B training JobSet onto a v5p-512 GKE node pool. This example
+connects the three planes end to end:
+
+1. **workload plane** — a 70B-scale ``TransformerConfig`` and the
+   ``ParallelLayout`` that trains it (fsdp x tp x sp), with the HBM
+   feasibility math (params + optimizer state sharded by fsdp x tp must
+   fit each chip's 95 GB);
+2. **scheduling contract** — ``ParallelLayout.required_topology("v5p")``
+   names the slice topology the gang needs (8x8x8 = 512 chips); the gang
+   labels + topology annotation on each worker pod are exactly what the
+   gang scheduler admits and places (nos_tpu/scheduler/gang.py);
+3. **manifests** — ``worker_pods()`` emits the 128 worker-pod dicts a
+   JobSet controller would create, one per v5p host (4 chips/host).
+
+Run ``python examples/llama3_70b_v5p.py`` to print the plan summary and
+write the first worker manifest to stdout.
+"""
+from __future__ import annotations
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
+
+from nos_tpu import constants                                 # noqa: E402
+from nos_tpu.models.transformer import TransformerConfig       # noqa: E402
+from nos_tpu.parallel.layout import ParallelLayout             # noqa: E402
+from nos_tpu.tpu import topology                               # noqa: E402
+
+GENERATION = "v5p"
+NAMESPACE = "llm-training"
+GANG_NAME = "llama3-70b"
+
+# Llama-3-70B architecture (public numbers; GQA with 8 kv heads)
+LLAMA3_70B = TransformerConfig(
+    vocab=128256,
+    d_model=8192,
+    n_layers=80,
+    n_heads=64,
+    n_kv_heads=8,
+    d_ff=28672,
+    max_seq=8192,
+)
+
+# 512 chips: zero-style param sharding over 64, tensor parallel 4 within a
+# host, sequence/context parallel 2 for the 8k context
+LAYOUT = ParallelLayout(fsdp=64, tp=4, sp=2)
+
+
+def param_count(cfg: TransformerConfig) -> int:
+    """Decoder transformer parameter count (embeddings + layers + head),
+    GQA-aware: k/v projections are d x (kv_heads * head_dim)."""
+    d, f, L, v = cfg.d_model, cfg.d_ff, cfg.n_layers, cfg.vocab
+    attn = 2 * d * d + 2 * d * cfg.kv_dim            # q,o + k,v
+    per_layer = attn + 3 * d * f + 2 * d             # + swiglu + norms
+    return v * d + L * per_layer + d + d * v         # embed + layers + head
+
+
+def hbm_per_chip_gb(cfg: TransformerConfig, layout: ParallelLayout) -> float:
+    """Training-state HBM per chip: bf16 params + fp32 grads and Adam
+    moments, sharded over the fsdp x tp axes."""
+    n = param_count(cfg)
+    bytes_total = n * (2 + 4 + 4 + 4)                # params, grads, m, v
+    return bytes_total / (layout.fsdp * layout.tp) / 1024**3
+
+
+def plan() -> dict:
+    gen = topology.get_generation(GENERATION)
+    topo = LAYOUT.required_topology(GENERATION)
+    if topo is None:
+        raise ValueError(f"no {GENERATION} topology fits {LAYOUT.chips} chips")
+    hosts = gen.hosts_for(topo)
+    need_gb = hbm_per_chip_gb(LLAMA3_70B, LAYOUT)
+    return {
+        "params_b": round(param_count(LLAMA3_70B) / 1e9, 1),
+        "chips": LAYOUT.chips,
+        "topology": topo.name,
+        "hosts": hosts,
+        "chips_per_host": gen.chips_per_host,
+        "hbm_needed_gb_per_chip": round(need_gb, 1),
+        "hbm_available_gb_per_chip": gen.hbm_gb_per_chip,
+        "fits": need_gb <= gen.hbm_gb_per_chip,
+    }
+
+
+def worker_pods() -> list:
+    """One pod per v5p host, carrying the gang contract the scheduler
+    admits (labels) and the topology it must place (annotation)."""
+    p = plan()
+    pods = []
+    for w in range(p["hosts"]):
+        pods.append({
+            "apiVersion": "v1",
+            "kind": "Pod",
+            "metadata": {
+                "name": f"{GANG_NAME}-worker-{w}",
+                "namespace": NAMESPACE,
+                "labels": {
+                    constants.LABEL_GANG_NAME: GANG_NAME,
+                    constants.LABEL_GANG_SIZE: str(p["hosts"]),
+                    constants.LABEL_GANG_WORKER: str(w),
+                },
+                "annotations": {
+                    constants.ANNOTATION_TPU_TOPOLOGY: p["topology"],
+                },
+            },
+            "spec": {
+                "schedulerName": constants.SCHEDULER_NAME,
+                "nodeSelector": {
+                    constants.LABEL_TPU_ACCELERATOR: topology.get_generation(
+                        GENERATION).name,
+                },
+                "containers": [{
+                    "name": "train",
+                    "image": "nos-tpu/trainer:latest",
+                    "resources": {
+                        "limits": {constants.RESOURCE_TPU: p["chips_per_host"]},
+                        "requests": {constants.RESOURCE_TPU: p["chips_per_host"]},
+                    },
+                }],
+            },
+        })
+    return pods
+
+
+def main() -> None:
+    import json
+
+    p = plan()
+    print(json.dumps(p, indent=2))
+    print(f"\n# first of {p['hosts']} worker pods:")
+    print(json.dumps(worker_pods()[0], indent=2))
+
+
+if __name__ == "__main__":
+    main()

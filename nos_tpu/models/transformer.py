@@ -44,15 +44,31 @@ class TransformerConfig:
     rope_theta: float = 10000.0
     dtype: Any = jnp.bfloat16
     remat: bool = True
+    # grouped-query attention: 0 means MHA (n_kv_heads == n_heads)
+    n_kv_heads: int = 0
     # Mixture-of-Experts: when n_experts > 0 every layer's FFN is a top-2
     # MoE with experts sharded over the mesh's ep axis (nos_tpu/ops/moe.py)
     n_experts: int = 0
     expert_capacity_factor: float = 1.25
     moe_aux_weight: float = 0.01
 
+    def __post_init__(self):
+        if self.d_model % self.n_heads:
+            raise ValueError("d_model must divide by n_heads")
+        if self.n_kv_heads and self.n_heads % self.n_kv_heads:
+            raise ValueError("n_heads must divide by n_kv_heads")
+
     @property
     def head_dim(self) -> int:
         return self.d_model // self.n_heads
+
+    @property
+    def kv_heads(self) -> int:
+        return self.n_kv_heads or self.n_heads
+
+    @property
+    def kv_dim(self) -> int:
+        return self.kv_heads * self.head_dim
 
 
 # ---------------------------------------------------------------------------
@@ -74,8 +90,8 @@ def init_params(rng: jax.Array, cfg: TransformerConfig) -> Params:
         out = {
             "attn_norm": jnp.ones((d,), jnp.float32),
             "wq": dense(kq, (d, d), d),
-            "wk": dense(kk, (d, d), d),
-            "wv": dense(kv, (d, d), d),
+            "wk": dense(kk, (d, cfg.kv_dim), d),
+            "wv": dense(kv, (d, cfg.kv_dim), d),
             "wo": dense(ko, (d, d), d),
             "mlp_norm": jnp.ones((d,), jnp.float32),
         }
@@ -154,9 +170,11 @@ def attention_block(h_in, layer, cfg: TransformerConfig, freqs,
     b, s = h_in.shape[:2]
     h = rms_norm(h_in, layer["attn_norm"])
     q = jnp.dot(h, layer["wq"]).reshape(b, s, cfg.n_heads, cfg.head_dim)
-    k = jnp.dot(h, layer["wk"]).reshape(b, s, cfg.n_heads, cfg.head_dim)
-    v = jnp.dot(h, layer["wv"]).reshape(b, s, cfg.n_heads, cfg.head_dim)
+    k = jnp.dot(h, layer["wk"]).reshape(b, s, cfg.kv_heads, cfg.head_dim)
+    v = jnp.dot(h, layer["wv"]).reshape(b, s, cfg.kv_heads, cfg.head_dim)
     q, k = apply_rope(q, freqs), apply_rope(k, freqs)
+    # GQA: k/v stay at kv_heads — the attention ops group query heads
+    # internally (and only the pallas kernel path materializes a repeat)
     o = attention_call(q, k, v).reshape(b, s, cfg.d_model)
     return h_in + jnp.dot(o, layer["wo"])
 

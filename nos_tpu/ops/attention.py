@@ -37,26 +37,32 @@ def xla_attention(
     q: jax.Array, k: jax.Array, v: jax.Array, *, causal: bool = False,
     scale: Optional[float] = None,
 ) -> jax.Array:
-    """Reference attention. q,k,v: [B, H, S, D] -> [B, H, S, D]."""
-    d = q.shape[-1]
+    """Reference attention. q: [B, H, S, D]; k,v: [B, Hkv, S, D] with
+    H % Hkv == 0 (GQA: each kv head serves H/Hkv query heads without
+    materializing repeated k/v) -> [B, H, S, D]."""
+    b, h, s_q, d = q.shape
+    h_kv = k.shape[1]
+    g = h // h_kv
     scale = scale if scale is not None else d ** -0.5
+    qg = q.reshape(b, h_kv, g, s_q, d)
     scores = jnp.einsum(
-        "bhqd,bhkd->bhqk", q, k, preferred_element_type=jnp.float32
+        "bhgqd,bhkd->bhgqk", qg, k, preferred_element_type=jnp.float32
     ) * scale
     if causal:
-        s_q, s_k = scores.shape[-2], scores.shape[-1]
+        s_k = scores.shape[-1]
         mask = jnp.tril(jnp.ones((s_q, s_k), dtype=bool), s_k - s_q)
         scores = jnp.where(mask, scores, jnp.finfo(jnp.float32).min)
     probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
-    return jnp.einsum("bhqk,bhkd->bhqd", probs, v)
+    return jnp.einsum("bhgqk,bhkd->bhgqd", probs, v).reshape(b, h, s_q, d)
 
 
 def attention(
     q: jax.Array, k: jax.Array, v: jax.Array, *, causal: bool = False,
     scale: Optional[float] = None, force_xla: bool = False,
 ) -> jax.Array:
-    """q,k,v: [B, H, S, D]. Uses the pallas TPU kernel when available and
-    the shape is kernel-friendly (S multiple of the block size), else XLA."""
+    """q: [B, H, S, D]; k,v: [B, Hkv, S, D] (Hkv == H for MHA, a divisor
+    of H for GQA). Uses the pallas TPU kernel when available and the shape
+    is kernel-friendly (S multiple of the block size), else XLA."""
     if force_xla or not flash_attention_available():
         return xla_attention(q, k, v, causal=causal, scale=scale)
     # kernel constraint (probed on v5e): sequence length divisible by the
@@ -65,5 +71,12 @@ def attention(
             or q.shape[-1] not in (64, 128)):
         return xla_attention(q, k, v, causal=causal, scale=scale)
     fa = _pallas_flash()
+    if k.shape[1] != q.shape[1]:
+        # the kernel wants equal head counts: replicate kv across each
+        # query group only on this path (GQA stays un-materialized on the
+        # XLA and ring paths)
+        group = q.shape[1] // k.shape[1]
+        k = jnp.repeat(k, group, axis=1)
+        v = jnp.repeat(v, group, axis=1)
     sm_scale = scale if scale is not None else q.shape[-1] ** -0.5
     return fa(q, k, v, causal=causal, sm_scale=sm_scale)

@@ -21,25 +21,26 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 
-def _block_attention(q, k, v, q_offset, kv_offset, causal, scale):
+def _block_attention(qg, k, v, q_offset, kv_offset, causal, scale):
     """One (q_local, kv_block) partial: returns (m, l, o) statistics.
-    q,k,v: [B, H, S, D]; offsets are global sequence starts."""
-    s_q, s_k = q.shape[-2], k.shape[-2]
+    qg: [B, Hkv, G, Sq, D] (G = query heads per kv head; 1 for MHA);
+    k,v: [B, Hkv, Sk, D]; offsets are global sequence starts."""
+    s_q, s_k = qg.shape[-2], k.shape[-2]
     scores = jnp.einsum(
-        "bhqd,bhkd->bhqk", q, k, preferred_element_type=jnp.float32
+        "bhgqd,bhkd->bhgqk", qg, k, preferred_element_type=jnp.float32
     ) * scale
     if causal:
         q_pos = q_offset + jnp.arange(s_q)
         k_pos = kv_offset + jnp.arange(s_k)
         mask = q_pos[:, None] >= k_pos[None, :]
         scores = jnp.where(mask, scores, -jnp.inf)
-    m = jnp.max(scores, axis=-1)                           # [B, H, Sq]
+    m = jnp.max(scores, axis=-1)                           # [B, Hkv, G, Sq]
     # fully-masked rows: keep m finite so exp() stays well-defined
     m_safe = jnp.where(jnp.isfinite(m), m, 0.0)
     p = jnp.exp(scores - m_safe[..., None])
     p = jnp.where(jnp.isfinite(scores), p, 0.0)
-    l = jnp.sum(p, axis=-1)                                # [B, H, Sq]
-    o = jnp.einsum("bhqk,bhkd->bhqd", p.astype(v.dtype), v).astype(jnp.float32)
+    l = jnp.sum(p, axis=-1)                                # [B, Hkv, G, Sq]
+    o = jnp.einsum("bhgqk,bhkd->bhgqd", p.astype(v.dtype), v).astype(jnp.float32)
     return m_safe, l, o
 
 
@@ -52,12 +53,16 @@ def ring_attention(
     causal: bool = False,
     scale: Optional[float] = None,
 ) -> jax.Array:
-    """Runs INSIDE shard_map: q,k,v are the local [B, H, S_local, D] shards
-    on the ``axis_name`` ring."""
+    """Runs INSIDE shard_map: q,k,v are the local shards on the
+    ``axis_name`` ring — q [B, H, S_local, D], k/v [B, Hkv, S_local, D]
+    with Hkv a divisor of H (GQA). Only the small kv heads circulate the
+    ring, so GQA's ICI-bandwidth saving is preserved."""
     scale = scale if scale is not None else q.shape[-1] ** -0.5
     ring_size = jax.lax.axis_size(axis_name)
     my_idx = jax.lax.axis_index(axis_name)
-    s_local = q.shape[-2]
+    b, h, s_local, d = q.shape
+    h_kv = k.shape[1]
+    q = q.reshape(b, h_kv, h // h_kv, s_local, d)
     q_offset = my_idx * s_local
 
     perm = [(i, (i + 1) % ring_size) for i in range(ring_size)]
@@ -84,7 +89,7 @@ def ring_attention(
     init = (k, v, m, l, acc)
     _, _, m, l, acc = jax.lax.fori_loop(1, ring_size, step, init)
     l = jnp.maximum(l, 1e-20)
-    return (acc / l[..., None]).astype(q.dtype)
+    return (acc / l[..., None]).reshape(b, h, s_local, d).astype(q.dtype)
 
 
 def ring_attention_sharded(

@@ -1,0 +1,1 @@
+from nos_tpu.train.checkpoint import CheckpointManager  # noqa: F401

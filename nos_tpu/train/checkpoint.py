@@ -1,0 +1,99 @@
+"""Checkpoint / resume for the training plane (orbax-backed).
+
+The control plane needs no checkpointing — the API server is its durable
+state, a property preserved from the reference (SURVEY §5 "Checkpoint /
+resume"). The *workload* plane does: a gang-scheduled training job that is
+preempted by quota reclaim (nos_tpu/scheduler/capacity.py) or rescheduled
+onto a different slice must resume from its last step. This module wraps
+orbax so:
+
+- saves are **sharding-agnostic**: what lands on disk is the global array;
+- restores are **sharding-aware**: pass the target shardings (possibly for
+  a different mesh/layout than the one that saved) and each process loads
+  only its shards — how a job resumes on a differently-shaped slice;
+- step numbering + retention live in orbax's CheckpointManager; `latest()`
+  supports crash-loop resume.
+"""
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax
+
+
+class CheckpointManager:
+    """Step-numbered train-state checkpoints under one directory."""
+
+    def __init__(self, directory: str, max_to_keep: int = 3):
+        import orbax.checkpoint as ocp
+
+        self._ocp = ocp
+        self.directory = directory
+        self.manager = ocp.CheckpointManager(
+            directory,
+            options=ocp.CheckpointManagerOptions(
+                max_to_keep=max_to_keep, create=True),
+        )
+
+    # ------------------------------------------------------------------
+    def save(self, step: int, params: Any, opt_state: Any) -> None:
+        ocp = self._ocp
+        self.manager.save(
+            step,
+            args=ocp.args.Composite(
+                params=ocp.args.StandardSave(params),
+                opt_state=ocp.args.StandardSave(opt_state),
+            ),
+        )
+        self.manager.wait_until_finished()
+
+    def latest(self) -> Optional[int]:
+        return self.manager.latest_step()
+
+    def restore(self, step: Optional[int] = None, *,
+                params_template: Any, opt_state_template: Any,
+                mesh: Any = None):
+        """Restore (params, opt_state). Templates are pytrees of arrays OR
+        jax.ShapeDtypeStruct with ``.sharding`` set — restoring onto a
+        different mesh than the one that saved is the normal case. Leaves
+        whose template carries no mesh sharding (e.g. optimizer step
+        counters created on one device by ``opt.init``) are replicated over
+        ``mesh`` when given, so the restored state is consistently placed."""
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        ocp = self._ocp
+        if step is None:
+            step = self.latest()
+        if step is None:
+            raise FileNotFoundError(f"no checkpoint under {self.directory}")
+
+        replicated = NamedSharding(mesh, PartitionSpec()) if mesh is not None \
+            else None
+
+        def leaf_sharding(x):
+            s = getattr(x, "sharding", None)
+            if isinstance(s, NamedSharding):
+                return s
+            return replicated if replicated is not None else s
+
+        def as_abstract(tree):
+            return jax.tree.map(
+                lambda x: x if isinstance(x, jax.ShapeDtypeStruct)
+                else jax.ShapeDtypeStruct(
+                    getattr(x, "shape", ()), getattr(x, "dtype", None),
+                    sharding=leaf_sharding(x)),
+                tree,
+            )
+
+        restored = self.manager.restore(
+            step,
+            args=ocp.args.Composite(
+                params=ocp.args.StandardRestore(as_abstract(params_template)),
+                opt_state=ocp.args.StandardRestore(
+                    as_abstract(opt_state_template)),
+            ),
+        )
+        return restored["params"], restored["opt_state"]
+
+    def close(self) -> None:
+        self.manager.close()
