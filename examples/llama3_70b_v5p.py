@@ -117,6 +117,18 @@ def worker_pods() -> list:
                 "containers": [{
                     "name": "train",
                     "image": "nos-tpu/trainer:latest",
+                    "command": ["python", "-m", "nos_tpu.cmd", "trainer",
+                                "--config", "/etc/nos-tpu/trainer.yaml"],
+                    # the trainer's multi-host contract
+                    # (nos_tpu/cmd/trainer.py::_maybe_init_distributed):
+                    # worker 0 is the coordinator, gang size/worker index
+                    # give world size and rank
+                    "env": [
+                        {"name": "COORDINATOR_ADDRESS",
+                         "value": f"{GANG_NAME}-worker-0.{NAMESPACE}:8476"},
+                        {"name": "NUM_PROCESSES", "value": str(p["hosts"])},
+                        {"name": "PROCESS_ID", "value": str(w)},
+                    ],
                     "resources": {
                         "limits": {constants.RESOURCE_TPU: p["chips_per_host"]},
                         "requests": {constants.RESOURCE_TPU: p["chips_per_host"]},

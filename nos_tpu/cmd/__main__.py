@@ -10,6 +10,7 @@ _BINARIES = {
     "partitioner": "nos_tpu.cmd.partitioner",
     "tpuagent": "nos_tpu.cmd.tpuagent",
     "metricsexporter": "nos_tpu.cmd.metricsexporter",
+    "trainer": "nos_tpu.cmd.trainer",
 }
 
 

@@ -1,0 +1,223 @@
+"""nos-tpu-trainer — the training binary gang-scheduled worker pods run.
+
+This is the data-plane half of the gang contract
+(config/operator/samples/gang-jobset.yaml, examples/llama3_70b_v5p.py): the
+scheduler places one pod per TPU host of an ICI slice; each pod runs this
+binary, which
+
+1. initializes ``jax.distributed`` from the gang environment when running
+   multi-host (GKE TPU pods get the coordinator/world from the TPU env;
+   single-process runs skip it);
+2. builds the ``ParallelLayout`` mesh over the visible devices —
+   dp/fsdp/tp/sp/ep via ``make_train_step``, or the pipelined step when
+   ``pp > 1``;
+3. trains the decoder transformer on synthetic (or memory-mapped) token
+   batches, logging loss and steps/s;
+4. checkpoints through ``nos_tpu.train.CheckpointManager`` and resumes
+   from the latest step on restart — the preemption/reschedule story the
+   quota scheduler relies on.
+"""
+from __future__ import annotations
+
+import argparse
+import logging
+import os
+import time
+from dataclasses import dataclass, fields
+from typing import Optional, Sequence
+
+logger = logging.getLogger("nos_tpu.trainer")
+
+
+@dataclass
+class TrainerConfig:
+    # model (defaults are test-sized; production configs come from --config)
+    vocab: int = 32000
+    d_model: int = 512
+    n_layers: int = 4
+    n_heads: int = 8
+    n_kv_heads: int = 0
+    d_ff: int = 1408
+    max_seq: int = 512
+    n_experts: int = 0
+    # layout
+    dp: int = 1
+    fsdp: int = 1
+    tp: int = 1
+    pp: int = 1
+    sp: int = 1
+    ep: int = 1
+    n_microbatches: int = 2            # pp only
+    # run
+    steps: int = 10
+    batch_size: int = 8
+    seq_len: int = 256
+    learning_rate: float = 3e-4
+    seed: int = 0
+    log_every: int = 10
+    # checkpointing
+    checkpoint_dir: str = ""
+    checkpoint_every: int = 100
+    # misc
+    log_level: str = "info"
+    bf16: bool = True
+
+    @classmethod
+    def from_yaml_file(cls, path: str) -> "TrainerConfig":
+        import yaml
+
+        with open(path) as f:
+            data = yaml.safe_load(f) or {}
+        known = {f.name for f in fields(cls)}
+        unknown = set(data) - known
+        if unknown:
+            raise ValueError(f"{path}: unknown trainer config keys {sorted(unknown)}")
+        return cls(**data)
+
+
+def _maybe_init_distributed() -> None:
+    """Multi-host init. Two triggers (single-process runs stay untouched):
+
+    - explicit env: COORDINATOR_ADDRESS (+ NUM_PROCESSES, PROCESS_ID) — the
+      contract the gang manifests set (examples/llama3_70b_v5p.py
+      worker_pods(), config/operator/samples/gang-jobset.yaml): worker 0's
+      pod address as coordinator, gang-size and gang-worker as world/rank;
+    - TPU pod auto-detect: on a multi-host GKE TPU slice the TPU env
+      (TPU_WORKER_HOSTNAMES) carries the topology and
+      jax.distributed.initialize() reads it natively with no arguments."""
+    import jax
+
+    if os.environ.get("COORDINATOR_ADDRESS"):
+        jax.distributed.initialize(
+            coordinator_address=os.environ["COORDINATOR_ADDRESS"],
+            num_processes=int(os.environ.get("NUM_PROCESSES", "1")),
+            process_id=int(os.environ.get("PROCESS_ID", "0")),
+        )
+    elif len(os.environ.get("TPU_WORKER_HOSTNAMES", "").split(",")) > 1:
+        jax.distributed.initialize()
+
+
+def train(cfg: TrainerConfig) -> float:
+    """Run the configured training job; returns the final loss."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from nos_tpu.models import transformer as tfm
+    from nos_tpu.parallel.layout import ParallelLayout
+    from nos_tpu.parallel.mesh import build_mesh, data_sharding
+
+    layout = ParallelLayout(dp=cfg.dp, fsdp=cfg.fsdp, tp=cfg.tp, pp=cfg.pp,
+                            sp=cfg.sp, ep=cfg.ep)
+    mesh = build_mesh(layout, jax.devices()[:layout.chips])
+    model_cfg = tfm.TransformerConfig(
+        vocab=cfg.vocab, d_model=cfg.d_model, n_layers=cfg.n_layers,
+        n_heads=cfg.n_heads, n_kv_heads=cfg.n_kv_heads, d_ff=cfg.d_ff,
+        max_seq=cfg.max_seq, n_experts=cfg.n_experts,
+        dtype=jnp.bfloat16 if cfg.bf16 else jnp.float32,
+    )
+
+    pipelined = cfg.pp > 1
+    if pipelined:
+        from nos_tpu.parallel.pipeline import (
+            make_pipeline_train_step, pipeline_param_shardings,
+        )
+
+        shardings = pipeline_param_shardings(mesh, model_cfg)
+    else:
+        shardings = tfm.param_shardings(mesh, model_cfg)
+
+    if jax.process_count() == 1:
+        params = jax.device_put(
+            tfm.init_params(jax.random.PRNGKey(cfg.seed), model_cfg),
+            shardings)
+    else:
+        # multi-host: host arrays can't be device_put onto non-addressable
+        # devices; compile the init with the target shardings instead so
+        # every process materializes only its shards
+        params = jax.jit(
+            lambda: tfm.init_params(jax.random.PRNGKey(cfg.seed), model_cfg),
+            out_shardings=shardings,
+        )()
+    optimizer = optax.adamw(cfg.learning_rate)
+    opt_state = optimizer.init(params)
+
+    ckpt = None
+    start_step = 0
+    if cfg.checkpoint_dir:
+        from nos_tpu.train import CheckpointManager
+
+        ckpt = CheckpointManager(cfg.checkpoint_dir)
+        latest = ckpt.latest()
+        if latest is not None:
+            params, opt_state = ckpt.restore(
+                latest, params_template=params,
+                opt_state_template=opt_state, mesh=mesh)
+            start_step = latest
+            logger.info("resumed from checkpoint step %d", latest)
+
+    if pipelined:
+        step_fn = jax.jit(make_pipeline_train_step(
+            model_cfg, optimizer, mesh, n_microbatches=cfg.n_microbatches))
+    else:
+        step_fn = jax.jit(tfm.make_train_step(model_cfg, optimizer, mesh))
+
+    def put(x, sharding):
+        if jax.process_count() == 1:
+            return jax.device_put(x, sharding)
+        # every process holds the same deterministic global batch; each
+        # materializes only the shards its devices own
+        return jax.make_array_from_callback(
+            x.shape, sharding, lambda idx: x[idx])
+
+    def batch_for(step: int):
+        # synthetic shifted-token LM batches, deterministic per step so a
+        # resumed run replays the same stream
+        key = jax.random.fold_in(jax.random.PRNGKey(cfg.seed + 1), step)
+        tokens = jax.random.randint(
+            key, (cfg.batch_size, cfg.seq_len), 0, cfg.vocab)
+        return {
+            "tokens": put(tokens, data_sharding(mesh)),
+            "targets": put(jnp.roll(tokens, -1, axis=1), data_sharding(mesh)),
+        }
+
+    loss = float("nan")
+    last_saved = start_step
+    t0 = time.perf_counter()
+    for step in range(start_step, cfg.steps):
+        params, opt_state, loss_arr = step_fn(params, opt_state, batch_for(step))
+        if (step + 1) % cfg.log_every == 0 or step + 1 == cfg.steps:
+            jax.block_until_ready(loss_arr)
+            loss = float(loss_arr)
+            dt = time.perf_counter() - t0
+            done = step + 1 - start_step
+            logger.info("step %d/%d loss %.4f (%.2f steps/s)",
+                        step + 1, cfg.steps, loss, done / max(dt, 1e-9))
+        if ckpt is not None and (step + 1) % cfg.checkpoint_every == 0:
+            ckpt.save(step + 1, params, opt_state)
+            last_saved = step + 1
+    if ckpt is not None:
+        # final save only when steps actually ran (a restart whose restored
+        # step already meets cfg.steps must not relabel old state)
+        if start_step < cfg.steps and last_saved != cfg.steps:
+            ckpt.save(cfg.steps, params, opt_state)
+        ckpt.close()
+    return loss
+
+
+def main(argv: Optional[Sequence[str]] = None) -> None:
+    parser = argparse.ArgumentParser(prog="nos-tpu-trainer", description=__doc__)
+    parser.add_argument("--config", default="", help="trainer config YAML")
+    args = parser.parse_args(argv)
+
+    cfg = TrainerConfig.from_yaml_file(args.config) if args.config \
+        else TrainerConfig()
+    logging.basicConfig(level=getattr(logging, cfg.log_level.upper(), 20),
+                        format="%(asctime)s %(name)s %(levelname)s %(message)s")
+    _maybe_init_distributed()
+    final = train(cfg)
+    logger.info("training done, final loss %.4f", final)
+
+
+if __name__ == "__main__":
+    main()
