@@ -152,3 +152,28 @@ def test_gqa_attention_matches_repeated_kv_reference():
     ring = ring_attention_sharded(mesh, q, k, v, causal=True)
     np.testing.assert_allclose(np.asarray(ring), np.asarray(ref),
                                rtol=1e-4, atol=1e-4)
+
+
+def test_gqa_transformer_under_tp_and_sp_sharding():
+    """GQA composes with tensor parallel (kv heads sharded over tp) and
+    sequence parallel (ring attention circulates only kv heads)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from nos_tpu.models import transformer as tfm
+    from nos_tpu.parallel.layout import ParallelLayout
+    from nos_tpu.parallel.mesh import build_mesh
+
+    cfg = tfm.TransformerConfig(
+        vocab=64, d_model=32, n_layers=2, n_heads=8, n_kv_heads=4,
+        d_ff=64, max_seq=32, dtype=jnp.float32)
+    params = tfm.init_params(jax.random.PRNGKey(0), cfg)
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 16), 0, cfg.vocab)
+    ref = tfm.forward(params, cfg, tokens)              # unsharded reference
+
+    mesh = build_mesh(ParallelLayout(dp=2, tp=2, sp=2), jax.devices()[:8])
+    sharded = jax.device_put(params, tfm.param_shardings(mesh, cfg))
+    got = jax.jit(lambda p, t: tfm.forward(p, cfg, t, mesh))(sharded, tokens)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               rtol=2e-4, atol=2e-4)
