@@ -100,6 +100,15 @@ class TpuAgent:
             return Result()
         return Result(requeue_after=self.report_interval_s)
 
+    def _unhealthy_chips(self) -> list:
+        """Failure detection: indexes failing the device-health probe.
+        Clients without the health surface (minimal doubles) report none."""
+        count_fn = getattr(self.tpu, "chip_count", None)
+        healthy_fn = getattr(self.tpu, "chip_healthy", None)
+        if count_fn is None or healthy_fn is None:
+            return []
+        return [i for i in range(count_fn()) if not healthy_fn(i)]
+
     # ------------------------------------------------------------------
     # Reporter
     # ------------------------------------------------------------------
@@ -111,6 +120,8 @@ class TpuAgent:
 
         boards, applied_plan = self.tpu.read_partition()
         used = used_slices_from_bound_pods(client, self.node_name)
+        unhealthy = self._unhealthy_chips()
+        obs.AGENT_UNHEALTHY_CHIPS.labels(self.node_name).set(len(unhealthy))
 
         status_annotations: Dict[str, str] = {}
         allocatable_slices: Dict[str, int] = {}
@@ -141,6 +152,11 @@ class TpuAgent:
             anns.update(status_annotations)
             if applied_plan:
                 anns[constants.ANNOTATION_REPORTED_PARTITIONING_PLAN] = applied_plan
+            if unhealthy:
+                anns[constants.ANNOTATION_UNHEALTHY_CHIPS] = ",".join(
+                    str(i) for i in unhealthy)
+            else:
+                anns.pop(constants.ANNOTATION_UNHEALTHY_CHIPS, None)
             changed[0] = anns != n.metadata.annotations
             n.metadata.annotations = anns
             if self.manage_allocatable:
@@ -153,6 +169,16 @@ class TpuAgent:
                     # partitioned: sub-slices replace whole-chip resource
                     alloc.pop(constants.RESOURCE_TPU, None)
                     alloc.update(allocatable_slices)
+                elif constants.RESOURCE_TPU in n.status.capacity:
+                    # unpartitioned host: advertise capacity minus the chips
+                    # failing the health probe, so the scheduler cannot
+                    # place onto them — recomputed from capacity each report
+                    # so it is idempotent and recovers when chips heal. (On
+                    # partitioned hosts the chip->sub-slice map is the
+                    # device plugin's; the annotation still surfaces the
+                    # failure for operators/controllers.)
+                    base = int(n.status.capacity[constants.RESOURCE_TPU])
+                    alloc[constants.RESOURCE_TPU] = max(0, base - len(unhealthy))
                 changed[0] = changed[0] or alloc != n.status.allocatable
                 n.status.allocatable = alloc
 

@@ -84,6 +84,9 @@ ANNOTATION_SPEC_PREFIX = DOMAIN + "/spec-tpu-"
 ANNOTATION_STATUS_PREFIX = DOMAIN + "/status-tpu-"
 ANNOTATION_PARTITIONING_PLAN = DOMAIN + "/spec-partitioning-plan"
 ANNOTATION_REPORTED_PARTITIONING_PLAN = DOMAIN + "/status-partitioning-plan"
+# failure detection: comma-separated unhealthy chip indexes reported by the
+# agent's device-health probe (absent when all chips are healthy)
+ANNOTATION_UNHEALTHY_CHIPS = DOMAIN + "/status-unhealthy-chips"
 
 ANNOTATION_SPEC_REGEX = re.compile(
     r"^" + re.escape(ANNOTATION_SPEC_PREFIX) + r"(\d+)-([a-z0-9.x\-]+)$"

@@ -64,6 +64,11 @@ AGENT_APPLIES = _r.counter(
     "(ok | error | skipped).",
     ("outcome",),
 )
+AGENT_UNHEALTHY_CHIPS = _r.gauge(
+    "nos_tpuagent_unhealthy_chips",
+    "TPU chips failing the device-health probe on this node.",
+    ("node",),
+)
 
 # --- quota ------------------------------------------------------------
 QUOTA_USED = _r.gauge(

@@ -11,6 +11,7 @@ from nos_tpu.agents.plan import BoardState, PartitionConfigPlan
 from nos_tpu.agents.tpu_native import MockTpuClient, TpuNativeClient, load_native
 from nos_tpu.agents.tpuagent import TpuAgent
 from nos_tpu.kube import ApiServer, Manager
+from nos_tpu.kube.client import Client
 from nos_tpu.kube.objects import (
     Container,
     Node,
@@ -287,3 +288,45 @@ def test_cmd_build_does_not_mask_configured_lib_error(monkeypatch):
     monkeypatch.setenv("NOS_TPU_NATIVE_LIB", "/nonexistent/libtpuagent.so")
     with pytest.raises(TpuClientError):
         agent_cmd.build(ApiServer(), "n0")
+
+
+# ---------------------------------------------------------------------------
+# failure detection: chip health -> annotations + allocatable
+# ---------------------------------------------------------------------------
+
+def unhealthy_rig(unhealthy):
+    server = ApiServer()
+    mgr = Manager(server)
+    tpu = MockTpuClient(chips=8, unhealthy=set(unhealthy))
+    agent = TpuAgent("v5e-0", tpu, report_interval_s=None)
+    for c in agent.controllers():
+        mgr.add_controller(c)
+    node = v5e_node()
+    node.status.capacity["google.com/tpu"] = 8
+    node.status.allocatable["google.com/tpu"] = 8
+    server.create(node)
+    return server, mgr, tpu
+
+
+def test_reporter_surfaces_unhealthy_chips():
+    server, mgr, tpu = unhealthy_rig({1, 5})
+    mgr.run_until_idle()
+    node = server.get("Node", "v5e-0")
+    assert node.metadata.annotations[
+        constants.ANNOTATION_UNHEALTHY_CHIPS] == "1,5"
+    # unpartitioned host: allocatable shrinks by the unhealthy count
+    assert node.status.allocatable["google.com/tpu"] == 6
+
+
+def test_reporter_restores_allocatable_when_chips_heal():
+    server, mgr, tpu = unhealthy_rig({0})
+    mgr.run_until_idle()
+    assert server.get("Node", "v5e-0").status.allocatable["google.com/tpu"] == 7
+    tpu.unhealthy = set()
+    # re-trigger a report (idempotent recompute from capacity)
+    Client(server).patch("Node", "v5e-0", "",
+                         lambda n: n.metadata.labels.update({"poke": "1"}))
+    mgr.run_until_idle()
+    node = server.get("Node", "v5e-0")
+    assert node.status.allocatable["google.com/tpu"] == 8
+    assert constants.ANNOTATION_UNHEALTHY_CHIPS not in node.metadata.annotations
