@@ -58,6 +58,12 @@ class TrainerConfig:
     # checkpointing
     checkpoint_dir: str = ""
     checkpoint_every: int = 100
+    # profiling: when set, a jax.profiler trace of steps [profile_start,
+    # profile_start+profile_steps) is written here (viewable in
+    # TensorBoard/XProf — the TPU tracing story)
+    profile_dir: str = ""
+    profile_start: int = 2
+    profile_steps: int = 3
     # misc
     log_level: str = "info"
     bf16: bool = True
@@ -183,9 +189,22 @@ def train(cfg: TrainerConfig) -> float:
 
     loss = float("nan")
     last_saved = start_step
+    profiling = False
+    profiled = not (cfg.profile_dir and cfg.profile_steps > 0)
+    profile_stop = 0
     t0 = time.perf_counter()
     for step in range(start_step, cfg.steps):
+        if not profiled and step >= cfg.profile_start:
+            # >= so a checkpoint-resumed run past profile_start still traces
+            jax.profiler.start_trace(cfg.profile_dir)
+            profiling, profiled = True, True
+            profile_stop = step + cfg.profile_steps
         params, opt_state, loss_arr = step_fn(params, opt_state, batch_for(step))
+        if profiling and step + 1 >= profile_stop:
+            jax.block_until_ready(loss_arr)
+            jax.profiler.stop_trace()
+            profiling = False
+            logger.info("profiler trace written to %s", cfg.profile_dir)
         if (step + 1) % cfg.log_every == 0 or step + 1 == cfg.steps:
             jax.block_until_ready(loss_arr)
             loss = float(loss_arr)
@@ -196,6 +215,10 @@ def train(cfg: TrainerConfig) -> float:
         if ckpt is not None and (step + 1) % cfg.checkpoint_every == 0:
             ckpt.save(step + 1, params, opt_state)
             last_saved = step + 1
+    if profiling:   # profile window ran past the last step
+        jax.block_until_ready(loss_arr)
+        jax.profiler.stop_trace()
+        logger.info("profiler trace written to %s", cfg.profile_dir)
     if ckpt is not None:
         # final save only when steps actually ran (a restart whose restored
         # step already meets cfg.steps must not relabel old state)

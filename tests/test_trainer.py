@@ -69,3 +69,24 @@ def test_lowered_steps_does_not_relabel_checkpoints(tmp_path):
     assert mgr.latest() == 4
     assert sorted(mgr.manager.all_steps()) == [2, 4]
     mgr.close()
+
+
+def test_profiler_trace_written(tmp_path):
+    d = str(tmp_path / "trace")
+    train(tiny(dp=2, steps=4, profile_dir=d, profile_start=1, profile_steps=2))
+    import os
+    found = [os.path.join(r, f) for r, _, fs in os.walk(d) for f in fs]
+    assert found, "profiler trace directory is empty"
+
+
+def test_profiler_fires_on_resume_past_start(tmp_path):
+    import os
+
+    ckpt = str(tmp_path / "ckpt")
+    train(tiny(dp=2, steps=4, checkpoint_dir=ckpt, checkpoint_every=4))
+    # resume at step 4 with profile_start=2 (already passed): still traces
+    d = str(tmp_path / "trace")
+    train(tiny(dp=2, steps=6, checkpoint_dir=ckpt, checkpoint_every=4,
+               profile_dir=d, profile_start=2, profile_steps=10))
+    found = [os.path.join(r, f) for r, _, fs in os.walk(d) for f in fs]
+    assert found, "resumed run wrote no trace (window also ran past end)"
