@@ -40,6 +40,7 @@ class TrainerConfig:
     d_ff: int = 1408
     max_seq: int = 512
     n_experts: int = 0
+    sp_strategy: str = "ring"          # ring | ulysses (sp axis attention)
     # layout
     dp: int = 1
     fsdp: int = 1
@@ -120,6 +121,7 @@ def train(cfg: TrainerConfig) -> float:
         vocab=cfg.vocab, d_model=cfg.d_model, n_layers=cfg.n_layers,
         n_heads=cfg.n_heads, n_kv_heads=cfg.n_kv_heads, d_ff=cfg.d_ff,
         max_seq=cfg.max_seq, n_experts=cfg.n_experts,
+        sp_strategy=cfg.sp_strategy,
         dtype=jnp.bfloat16 if cfg.bf16 else jnp.float32,
     )
 
@@ -193,32 +195,41 @@ def train(cfg: TrainerConfig) -> float:
     profiled = not (cfg.profile_dir and cfg.profile_steps > 0)
     profile_stop = 0
     t0 = time.perf_counter()
-    for step in range(start_step, cfg.steps):
-        if not profiled and step >= cfg.profile_start:
-            # >= so a checkpoint-resumed run past profile_start still traces
-            jax.profiler.start_trace(cfg.profile_dir)
-            profiling, profiled = True, True
-            profile_stop = step + cfg.profile_steps
-        params, opt_state, loss_arr = step_fn(params, opt_state, batch_for(step))
-        if profiling and step + 1 >= profile_stop:
-            jax.block_until_ready(loss_arr)
+    try:
+        for step in range(start_step, cfg.steps):
+            if not profiled and step >= cfg.profile_start:
+                # >= so a checkpoint-resumed run past profile_start traces
+                jax.profiler.start_trace(cfg.profile_dir)
+                profiling, profiled = True, True
+                profile_stop = step + cfg.profile_steps
+            params, opt_state, loss_arr = step_fn(
+                params, opt_state, batch_for(step))
+            if profiling and step + 1 >= profile_stop:
+                jax.block_until_ready(loss_arr)
+                jax.profiler.stop_trace()
+                profiling = False
+                logger.info("profiler trace written to %s", cfg.profile_dir)
+            if (step + 1) % cfg.log_every == 0 or step + 1 == cfg.steps:
+                jax.block_until_ready(loss_arr)
+                loss = float(loss_arr)
+                dt = time.perf_counter() - t0
+                done = step + 1 - start_step
+                logger.info("step %d/%d loss %.4f (%.2f steps/s)",
+                            step + 1, cfg.steps, loss, done / max(dt, 1e-9))
+            if ckpt is not None and (step + 1) % cfg.checkpoint_every == 0:
+                ckpt.save(step + 1, params, opt_state)
+                last_saved = step + 1
+    finally:
+        # stop the trace on every exit path (incl. step_fn raising) so a
+        # retry/next train() in this process doesn't find the profiler
+        # already active; window-past-end also lands here
+        if profiling:
+            try:
+                jax.block_until_ready(loss_arr)
+            except Exception:
+                pass
             jax.profiler.stop_trace()
-            profiling = False
             logger.info("profiler trace written to %s", cfg.profile_dir)
-        if (step + 1) % cfg.log_every == 0 or step + 1 == cfg.steps:
-            jax.block_until_ready(loss_arr)
-            loss = float(loss_arr)
-            dt = time.perf_counter() - t0
-            done = step + 1 - start_step
-            logger.info("step %d/%d loss %.4f (%.2f steps/s)",
-                        step + 1, cfg.steps, loss, done / max(dt, 1e-9))
-        if ckpt is not None and (step + 1) % cfg.checkpoint_every == 0:
-            ckpt.save(step + 1, params, opt_state)
-            last_saved = step + 1
-    if profiling:   # profile window ran past the last step
-        jax.block_until_ready(loss_arr)
-        jax.profiler.stop_trace()
-        logger.info("profiler trace written to %s", cfg.profile_dir)
     if ckpt is not None:
         # final save only when steps actually ran (a restart whose restored
         # step already meets cfg.steps must not relabel old state)

@@ -46,6 +46,10 @@ class TransformerConfig:
     remat: bool = True
     # grouped-query attention: 0 means MHA (n_kv_heads == n_heads)
     n_kv_heads: int = 0
+    # sequence-parallel attention strategy when the mesh has an sp axis:
+    # "ring" (K/V rotation, no head-count constraint) or "ulysses"
+    # (all-to-all head/sequence reshuffle; heads must divide by sp)
+    sp_strategy: str = "ring"
     # Mixture-of-Experts: when n_experts > 0 every layer's FFN is a top-2
     # MoE with experts sharded over the mesh's ep axis (nos_tpu/ops/moe.py)
     n_experts: int = 0
@@ -57,6 +61,8 @@ class TransformerConfig:
             raise ValueError("d_model must divide by n_heads")
         if self.n_kv_heads and self.n_heads % self.n_kv_heads:
             raise ValueError("n_heads must divide by n_kv_heads")
+        if self.sp_strategy not in ("ring", "ulysses"):
+            raise ValueError(f"unknown sp_strategy {self.sp_strategy!r}")
 
     @property
     def head_dim(self) -> int:
@@ -201,15 +207,18 @@ def cross_entropy(logits: jax.Array, targets: jax.Array) -> jax.Array:
     return jnp.mean(nll)
 
 
-def _attention_call(q, k, v, mesh: Optional[Mesh]):
+def _attention_call(q, k, v, mesh: Optional[Mesh], sp_strategy: str = "ring"):
     """q,k,v: [B, S, H, D] -> transpose to [B, H, S, D] and dispatch."""
     q, k, v = (x.transpose(0, 2, 1, 3) for x in (q, k, v))
     if mesh is not None and "sp" in mesh.axis_names and mesh.shape["sp"] > 1:
+        from nos_tpu.ops.ulysses import ulysses_attention
+
+        sp_fn = ring_attention if sp_strategy == "ring" else ulysses_attention
         batch = tuple(a for a in ("dp", "fsdp") if a in mesh.axis_names) or None
         tp = "tp" if "tp" in mesh.axis_names else None
         spec = P(batch, tp, "sp", None)
         out = jax.shard_map(
-            functools.partial(ring_attention, axis_name="sp", causal=True),
+            functools.partial(sp_fn, axis_name="sp", causal=True),
             mesh=mesh,
             in_specs=(spec, spec, spec),
             out_specs=spec,
@@ -244,7 +253,8 @@ def forward(
     # handled by the constraint (XLA keeps the gather local)
     def layer_body(x, layer):
         x = constrain(attention_block(
-            x, layer, cfg, freqs, lambda q, k, v: _attention_call(q, k, v, mesh)
+            x, layer, cfg, freqs,
+            lambda q, k, v: _attention_call(q, k, v, mesh, cfg.sp_strategy),
         ))
         if cfg.n_experts > 0:
             h = rms_norm(x, layer["mlp_norm"])

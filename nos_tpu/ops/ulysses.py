@@ -1,0 +1,96 @@
+"""Ulysses-style sequence parallelism — all-to-all head/sequence reshuffle.
+
+The second canonical long-context strategy next to ring attention
+(nos_tpu/ops/ring_attention.py): instead of rotating K/V blocks around the
+ring, one all-to-all converts the sequence sharding into a head sharding,
+each device then runs ordinary (flash) attention over the FULL sequence for
+its subset of heads, and a second all-to-all restores the sequence
+sharding. A constant four all-to-alls per attention call (q, k, v in;
+output back) independent of the ring size — vs the ring's sp-1 rotation
+steps — the better trade when heads are plentiful and ICI all-to-all
+bandwidth is good (the DeepSpeed-Ulysses pattern, PAPERS.md).
+
+Contract: runs INSIDE shard_map over ``axis_name``; requires both the
+query and kv head counts to divide by the axis size (GQA works when
+kv_heads % sp == 0). Ring attention has no head-count constraint — pick
+per job.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+
+from jax.sharding import Mesh, PartitionSpec as P
+
+from nos_tpu.ops.attention import attention
+
+
+def ulysses_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    axis_name: str,
+    causal: bool = False,
+    scale: Optional[float] = None,
+) -> jax.Array:
+    """q [B, H, S_local, D]; k,v [B, Hkv, S_local, D] — the local shards on
+    the ``axis_name`` sequence axis. Returns the local output shard."""
+    n = jax.lax.axis_size(axis_name)
+    b, h, s_local, d = q.shape
+    h_kv = k.shape[1]
+    if h % n or h_kv % n:
+        raise ValueError(
+            f"ulysses needs head counts divisible by the axis size "
+            f"({h} q heads, {h_kv} kv heads, axis {n})")
+
+    # all_to_all(tiled=False): the split axis (size n) is removed and the
+    # received-piece dimension (size n) is inserted at concat_axis.
+
+    def seq_to_heads(x):
+        # [B, H, S/n, D] -> [B, H/n, S, D]: head group i goes to device i;
+        # the received dimension is the sequence-chunk index, inserted
+        # chunk-major before s_local so the flatten yields global order
+        hx = x.shape[1]
+        x = x.reshape(b, n, hx // n, s_local, d)
+        x = jax.lax.all_to_all(x, axis_name, split_axis=1, concat_axis=2,
+                               tiled=False)          # [B, H/n, n, S/n, D]
+        return x.reshape(b, hx // n, n * s_local, d)
+
+    def heads_to_seq(x):
+        # [B, H/n, S, D] -> [B, H, S/n, D]: sequence chunk j goes to
+        # device j; the received dimension is the head-group index,
+        # inserted group-major before the local heads
+        hx = x.shape[1] * n
+        x = x.reshape(b, hx // n, n, s_local, d)
+        x = jax.lax.all_to_all(x, axis_name, split_axis=2, concat_axis=1,
+                               tiled=False)          # [B, n, H/n, S/n, D]
+        return x.reshape(b, hx, s_local, d)
+
+    q_full = seq_to_heads(q)          # [B, H/n, S, D]
+    k_full = seq_to_heads(k)
+    v_full = seq_to_heads(v)
+    out = attention(q_full, k_full, v_full, causal=causal, scale=scale)
+    return heads_to_seq(out)
+
+
+def ulysses_attention_sharded(
+    mesh: Mesh,
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    seq_axis: str = "sp",
+    causal: bool = False,
+) -> jax.Array:
+    """Convenience wrapper mirroring ring_attention_sharded."""
+    spec = P(None, None, seq_axis, None)
+    fn = jax.shard_map(
+        functools.partial(ulysses_attention, axis_name=seq_axis, causal=causal),
+        mesh=mesh,
+        in_specs=(spec, spec, spec),
+        out_specs=spec,
+    )
+    return fn(q, k, v)
