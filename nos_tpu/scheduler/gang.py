@@ -14,12 +14,15 @@ ICI-connected slice. This module implements all-or-nothing gang placement:
   member binds before every member has a feasible host (deadlock
   avoidance: partial gangs never hold capacity);
 - **ICI locality**: candidate hosts come from one ICI domain (node pool)
-  whose slice topology matches the request exactly and which is complete;
-  DCN-spanning placements are never produced;
-- **scoring**: among feasible domains, prefer the one whose host count is
-  tightest (it always equals the requirement for complete pools, so the
-  effective tie-break is stable name order) — and domains already partially
-  occupied by other jobs lose to empty ones only if the gang doesn't fit;
+  and form an axis-aligned, host-aligned **sub-cuboid** of its topology —
+  either the whole pool (exact match) or a contiguous block carved out of
+  a larger pool (a 2x2x2 gang can take half of an idle 2x2x4 pool; two
+  4x4 gangs can share an 8x8 pool on disjoint blocks). DCN-spanning
+  placements are never produced;
+- **scoring**: tightest fit first — exact-size pools beat carving a larger
+  one; among larger pools prefer the one left with the fewest free hosts
+  after placement (fragmentation-aware), then the smaller pool, then name;
+  within a pool, offsets pack toward the origin;
 - **quota**: the gang's aggregate request is admitted through the
   CapacityScheduling bounds as one unit (all-or-nothing at the quota level
   too).
@@ -94,6 +97,9 @@ class GangPlacement:
     pods: List[Pod]
     nodes: List[str]
     domain: IciDomain
+    # host-grid offset of the placed sub-cuboid inside the domain (all-zero
+    # for an exact-size placement) — logged by the scheduler on placement
+    offset: Tuple[int, ...] = ()
 
 
 class GangScheduler:
@@ -181,59 +187,145 @@ class GangScheduler:
         }
 
         reasons: List[str] = []
+        # (exact-mismatch, free-hosts-after, domain-size, pool) — tightest
+        # fit first: exact-size domains beat carving a larger pool; among
+        # larger pools prefer the one left with the fewest free hosts after
+        # placement (pack into already-fragmented pools, keep big slices
+        # whole for big gangs).
+        candidates: List[Tuple[tuple, GangPlacement]] = []
         for pool, domain in sorted(domains.items()):
-            if domain.topology_name != topo_name:
-                continue
+            req_topo = topology.find_slice_topology(domain.generation, topo_name)
+            if req_topo is None:
+                continue  # not a legal topology of this pool's generation
             if not domain.is_complete():
                 reasons.append(f"pool {pool}: incomplete slice ({domain.hosts} hosts)")
                 continue
-            expected = domain.expected_hosts
-            if expected != len(members):
+            req_shape = topology.host_shape(domain.generation, req_topo)
+            dom_shape = domain.host_shape
+            if req_shape is None or dom_shape is None:
+                reasons.append(f"pool {pool}: topology not host-alignable")
+                continue
+            gen = topology.get_generation(domain.generation)
+            if gen.hosts_for(req_topo) != len(members):
                 reasons.append(
-                    f"pool {pool}: slice has {expected} hosts, gang has {len(members)}"
+                    f"pool {pool}: topology {topo_name} needs "
+                    f"{gen.hosts_for(req_topo)} hosts, gang has {len(members)}"
                 )
                 continue
-            placement = self._try_domain(members, bound, domain, snapshot)
+            if not topology.is_sub_topology(
+                domain.generation, req_topo, domain.slice_topology
+            ):
+                reasons.append(
+                    f"pool {pool}: {topo_name} does not fit in {domain.topology_name}"
+                )
+                continue
+            placement = self._try_domain(members, bound, domain, req_shape, snapshot)
             if placement is None:
                 reasons.append(f"pool {pool}: hosts busy or unfit")
                 continue
-            return placement, ""
+            exact = 0 if domain.topology_name == topo_name else 1
+            free_after = self._free_hosts_after(domain, placement, snapshot)
+            candidates.append(
+                ((exact, free_after, domain.expected_hosts or 0, pool), placement)
+            )
+        if candidates:
+            candidates.sort(key=lambda t: t[0])
+            return candidates[0][1], ""
 
-        matching = [d for d in domains.values() if d.topology_name == topo_name]
+        matching = [
+            d for d in domains.values()
+            if topology.find_slice_topology(d.generation, topo_name) is not None
+        ]
         if not matching:
-            return None, f"no ICI domain with topology {topo_name!r} exists"
+            return None, f"no ICI domain supporting topology {topo_name!r} exists"
         return None, "; ".join(reasons) or "no feasible ICI domain"
+
+    def _free_hosts_after(
+        self, domain: IciDomain, placement: GangPlacement, snapshot: fw.Snapshot
+    ) -> int:
+        """Hosts of the domain left with no TPU occupancy after this
+        placement lands (fragmentation score input)."""
+        taken = set(placement.nodes)
+        free = 0
+        for node in domain.nodes:
+            name = node.metadata.name
+            if name in taken:
+                continue
+            info = snapshot.get(name)
+            if info is None:
+                continue
+            if any(
+                constants.RESOURCE_TPU in p.request() for p in info.pods
+            ):
+                continue
+            free += 1
+        return free
 
     def _try_domain(
         self,
         members: List[Pod],
         bound: Dict[int, str],
         domain: IciDomain,
+        req_shape: Tuple[int, ...],
         snapshot: fw.Snapshot,
     ) -> Optional[GangPlacement]:
-        """Worker w -> domain host w (torus alignment). Already-bound
-        workers must sit exactly on their worker-indexed host; every unbound
+        """Place the gang on an axis-aligned host-grid sub-cuboid of the
+        domain (the whole domain when shapes are equal). Worker w maps to
+        the w-th host of the sub-cuboid in row-major order so the job's
+        mesh axes line up with the physical torus axes. Already-bound
+        workers (crash recovery) pin the offset: the search only keeps
+        offsets placing them exactly where they are. Every unbound
         assignment must pass the full filter pipeline (one worker per host:
         whole-host chip requests make the resource filter enforce
-        exclusivity)."""
-        if len(domain.nodes) != len(members):
+        exclusivity — which is also what lets several gangs coexist in one
+        pool on disjoint sub-cuboids)."""
+        dom_shape = domain.host_shape
+        if dom_shape is None:
             return None
-        for w, node_name in bound.items():
-            if domain.nodes[w].metadata.name != node_name:
-                return None
-        state: fw.CycleState = {}
-        pods: List[Pod] = []
-        assignments: List[str] = []
-        for pod in members:
-            w = gang_worker(pod)
-            if w in bound:
+
+        def coords(shape):
+            out = [()]
+            for d in shape:
+                out = [c + (i,) for c in out for i in range(d)]
+            return out
+
+        sub_coords = coords(req_shape)  # worker order: row-major
+        offsets = coords(tuple(d - r + 1 for d, r in zip(dom_shape, req_shape)))
+
+        for offset in offsets:  # lexicographic: pack toward the origin
+            hosts = []
+            ok = True
+            for c in sub_coords:
+                node = domain.node_at(tuple(o + i for o, i in zip(offset, c)))
+                if node is None:
+                    ok = False
+                    break
+                hosts.append(node)
+            if not ok or len(hosts) != len(members):
                 continue
-            node = domain.nodes[w]
-            node_info = snapshot.get(node.metadata.name)
-            if node_info is None:
-                return None
-            if not self.framework.run_filter(state, pod, node_info).success:
-                return None
-            pods.append(pod)
-            assignments.append(node.metadata.name)
-        return GangPlacement(pods=pods, nodes=assignments, domain=domain)
+            if any(
+                hosts[w].metadata.name != node_name
+                for w, node_name in bound.items()
+            ):
+                continue
+            state: fw.CycleState = {}
+            pods: List[Pod] = []
+            assignments: List[str] = []
+            feasible = True
+            for pod in members:
+                w = gang_worker(pod)
+                if w in bound:
+                    continue
+                node_info = snapshot.get(hosts[w].metadata.name)
+                if node_info is None or not self.framework.run_filter(
+                    state, pod, node_info
+                ).success:
+                    feasible = False
+                    break
+                pods.append(pod)
+                assignments.append(hosts[w].metadata.name)
+            if feasible:
+                return GangPlacement(
+                    pods=pods, nodes=assignments, domain=domain, offset=offset
+                )
+        return None

@@ -198,8 +198,9 @@ class Scheduler:
         obs.GANGS_PLACED.inc()
         obs.SCHEDULE_ATTEMPTS.labels("bound").inc(len(placement.pods))
         logger.info(
-            "gang %s/%s: placed %d workers on ICI domain %s",
-            key.namespace, key.name, len(placement.pods), placement.domain.pool,
+            "gang %s/%s: placed %d workers on ICI domain %s at host offset %s",
+            key.namespace, key.name, len(placement.pods),
+            placement.domain.pool, placement.offset,
         )
         return Result()
 

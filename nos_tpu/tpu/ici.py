@@ -53,6 +53,32 @@ class IciDomain:
         expected = self.expected_hosts
         return expected is not None and self.hosts == expected
 
+    @property
+    def host_shape(self) -> Optional[tuple]:
+        """Host-grid dims of this domain's slice topology (see
+        topology.host_shape). Worker index = row-major position in this
+        grid — the TPU runtime's host ordering convention, which name-sorted
+        GKE node names follow."""
+        topo = self.slice_topology
+        if topo is None:
+            return None
+        return topology.host_shape(self.generation, topo)
+
+    def node_at(self, coord: tuple) -> Optional[Node]:
+        """Node at a host-grid coordinate (row-major ravel). Requires a
+        complete domain for the index↔coordinate map to be sound."""
+        shape = self.host_shape
+        if shape is None or len(coord) != len(shape):
+            return None
+        idx = 0
+        for c, d in zip(coord, shape):
+            if not (0 <= c < d):
+                return None
+            idx = idx * d + c
+        if idx >= len(self.nodes):
+            return None
+        return self.nodes[idx]
+
 
 def group_ici_domains(nodes: List[Node]) -> Dict[str, IciDomain]:
     """Group TPU nodes into ICI domains by node pool."""

@@ -232,6 +232,47 @@ def find_slice_topology(generation_name: str, topo_name: str) -> Optional[SliceT
     return None
 
 
+def host_shape(generation_name: str, topo: SliceTopology) -> Optional[Tuple[int, ...]]:
+    """Host-grid dims of a slice topology: how the slice's hosts tile the
+    chip cuboid. 3D generations (v4/v5p, 2x2 boards): (x,y,z) chips →
+    (x/2, y/2, z) hosts. 2D generations (v5e/v6e, 2x4 hosts): (x,y) →
+    (x/2, y/4). A topology no larger than one host maps to a single-host
+    shape of all-ones. Returns None when the chip dims don't align to host
+    boundaries (no valid host tiling exists)."""
+    gen = get_generation(generation_name)
+    if gen is None:
+        return None
+    if topo.chips <= gen.chips_per_host:
+        return (1,) * len(topo.dims)
+    if len(topo.dims) == 3:
+        per_host = (gen.host_rows, gen.host_cols, 1)
+    else:
+        per_host = (gen.host_rows, gen.host_cols)
+    if len(per_host) != len(topo.dims):
+        return None
+    out = []
+    for d, h in zip(topo.dims, per_host):
+        if d % h != 0:
+            return None
+        out.append(d // h)
+    return tuple(out)
+
+
+def is_sub_topology(generation_name: str, small: SliceTopology,
+                    big: SliceTopology) -> bool:
+    """True when ``small``'s host grid is an axis-aligned sub-cuboid of
+    ``big``'s host grid — i.e. a gang needing ``small`` can occupy an
+    ICI-contiguous host-aligned block carved out of a ``big`` pool. (The
+    carved block has mesh connectivity, not the full torus's wraparound
+    links; collectives over a contiguous mesh block still ride ICI, which
+    is the constraint that matters for placement.)"""
+    hs = host_shape(generation_name, small)
+    hb = host_shape(generation_name, big)
+    if hs is None or hb is None or len(hs) != len(hb):
+        return False
+    return all(s <= b for s, b in zip(hs, hb))
+
+
 # ---------------------------------------------------------------------------
 # Sub-slice geometry derivation: exact tiling of the host grid.
 # ---------------------------------------------------------------------------

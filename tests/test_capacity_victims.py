@@ -221,3 +221,105 @@ def test_post_filter_unschedulable_when_no_candidates():
     cs.pre_filter(state, pod, snap)
     node, status = cs.post_filter(state, pod, snap)
     assert node is None and not status.success
+
+
+# ---------------------------------------------------------------------------
+# gang-aware preemption (VERDICT r1 #3): gangs are all-or-nothing victims
+# ---------------------------------------------------------------------------
+
+def gang_pod(name, ns, job, worker, size, tpu=8, node="n1", labels=None):
+    p = make_pod(name, ns, tpu, node=node, labels=labels)
+    p.metadata.labels.update({
+        constants.LABEL_GANG_NAME: job,
+        constants.LABEL_GANG_SIZE: str(size),
+        constants.LABEL_GANG_WORKER: str(worker),
+    })
+    return p
+
+
+def test_over_quota_gang_fully_reclaimed_by_in_quota_pod():
+    """An in-quota pod needing ONE host's capacity evicts the WHOLE
+    over-quota gang (both hosts), not just the colocated member."""
+    running = [
+        gang_pod("job-0", "ns-b", "job", 0, 2, node="n1", labels=OVER),
+        gang_pod("job-1", "ns-b", "job", 1, 2, node="n2", labels=OVER),
+    ]
+    cs, snap = rig(
+        {"qa": ("ns-a", 8), "qb": ("ns-b", 0)},
+        running,
+        nodes=[make_node("n1"), make_node("n2")],
+    )
+    preemptor = make_pod("p", "ns-a", 8, node="")
+    state = {}
+    cs.pre_filter(state, preemptor, snap)
+    node, st = cs.post_filter(state, preemptor, snap)
+    assert st.success and node in ("n1", "n2")
+    assert names(state["capacity/victims"]) == ["job-0", "job-1"]
+
+
+def test_straddling_gang_reclaimed_whole_never_half():
+    """A gang straddling its quota's min gets MIXED capacity labels from
+    the EQ controller (first pods under min are in-quota). Reclaim must
+    still take the whole gang — any over-quota member makes the atomic
+    unit reclaimable; eviction is never partial."""
+    running = [
+        gang_pod("job-0", "ns-b", "job", 0, 2, node="n1", labels=IN),
+        gang_pod("job-1", "ns-b", "job", 1, 2, node="n2", labels=OVER),
+    ]
+    cs, snap = rig(
+        {"qa": ("ns-a", 8), "qb": ("ns-b", 8)},
+        running,
+        nodes=[make_node("n1"), make_node("n2")],
+    )
+    preemptor = make_pod("p", "ns-a", 8, node="")
+    state = {}
+    cs.pre_filter(state, preemptor, snap)
+    node, st = cs.post_filter(state, preemptor, snap)
+    assert st.success
+    assert names(state["capacity/victims"]) == ["job-0", "job-1"]
+
+
+def test_fully_in_quota_gang_not_preemptible():
+    """A gang entirely within its quota's min (no member over-quota) is
+    not a reclaim target at all."""
+    running = [
+        gang_pod("job-0", "ns-b", "job", 0, 2, node="n1", labels=IN),
+        gang_pod("job-1", "ns-b", "job", 1, 2, node="n2", labels=IN),
+    ]
+    cs, snap = rig(
+        {"qa": ("ns-a", 8), "qb": ("ns-b", 16)},
+        running,
+        nodes=[make_node("n1"), make_node("n2")],
+    )
+    preemptor = make_pod("p", "ns-a", 8, node="")
+    state = {}
+    cs.pre_filter(state, preemptor, snap)
+    node, st = cs.post_filter(state, preemptor, snap)
+    assert not st.success
+
+
+def test_gang_reprieve_is_all_or_nothing():
+    """Reclaiming borrowed capacity must evict the gang WHOLE while the
+    smaller solo borrower reprieves — never a lone gang member.
+
+    Numbers: Σmin = 8 (qa 4 + qb 4); ns-b borrows 12 (gang 4+4, solo 4).
+    An in-quota ns-a pod (4) forces ns-b down to 4 borrowed-total: only one
+    unit may stay. Evicting solo alone frees too little (aggregate still
+    over Σmin), so the correct minimal outcome is the whole gang out, solo
+    reprieved."""
+    running = [
+        gang_pod("job-0", "ns-b", "job", 0, 2, tpu=4, node="n1", labels=OVER),
+        gang_pod("job-1", "ns-b", "job", 1, 2, tpu=4, node="n2", labels=OVER),
+        make_pod("solo", "ns-b", 4, node="n1", labels=OVER),
+    ]
+    cs, snap = rig(
+        {"qa": ("ns-a", 4), "qb": ("ns-b", 4)},
+        running,
+        nodes=[make_node("n1"), make_node("n2")],
+    )
+    preemptor = make_pod("p", "ns-a", 4, node="")
+    state = {}
+    cs.pre_filter(state, preemptor, snap)
+    node, st = cs.post_filter(state, preemptor, snap)
+    assert st.success and node == "n1"
+    assert names(state["capacity/victims"]) == ["job-0", "job-1"]

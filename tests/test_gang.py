@@ -137,7 +137,7 @@ def test_gang_requires_matching_topology_domain():
     mgr.run_until_idle()
     p = server.get("Pod", "big-0", "team-a")
     assert p.spec.node_name == ""
-    assert any("no ICI domain with topology '4x8'" in c.message
+    assert any("4x8 does not fit in 4x4" in c.message
                for c in p.status.conditions)
 
 
@@ -295,3 +295,113 @@ def test_gang_partial_bind_recovery_under_tight_quota():
     server.create(gang_pod("train", 1, 2))
     mgr.run_until_idle()
     assert server.get("Pod", "train-1", "team-a").spec.node_name == "pool-a-w1"
+
+
+# ---------------------------------------------------------------------------
+# sub-cuboid placement (VERDICT r1 #4): gangs smaller than the pool
+# ---------------------------------------------------------------------------
+
+V5P = "tpu-v5p-slice"
+
+
+def v5p_pool(server, pool, topo):
+    """v5p pool (4 chips/host, 3D torus). 2x2x4 = 16 chips = 4 hosts."""
+    from nos_tpu.tpu import topology as topo_mod
+    gen = topo_mod.get_generation(V5P)
+    t = topo_mod.find_slice_topology(V5P, topo)
+    for i in range(gen.hosts_for(t)):
+        n = slice_host(f"{pool}-w{i}", pool, topo, gen=V5P)
+        n.status.capacity = {TPU: 4, "cpu": 96}
+        n.status.allocatable = {TPU: 4, "cpu": 96}
+        server.create(n)
+
+
+def test_subcuboid_gang_on_larger_pool():
+    """A 2x2x2 gang (2 hosts) occupies a contiguous half of an idle
+    2x2x4 pool (4 hosts) instead of going unschedulable."""
+    server, mgr = rig()
+    v5p_pool(server, "pool-a", "2x2x4")
+    for w in range(2):
+        server.create(gang_pod("half", w, 2, topo="2x2x2", tpu=4))
+    mgr.run_until_idle()
+    # offset packs toward origin: workers on hosts 0,1 (contiguous along z)
+    assert server.get("Pod", "half-0", "team-a").spec.node_name == "pool-a-w0"
+    assert server.get("Pod", "half-1", "team-a").spec.node_name == "pool-a-w1"
+
+
+def test_two_subcuboid_gangs_share_pool():
+    """Two 2x2x2 gangs coexist on one 2x2x4 pool on disjoint contiguous
+    blocks."""
+    server, mgr = rig()
+    v5p_pool(server, "pool-a", "2x2x4")
+    for w in range(2):
+        server.create(gang_pod("g1", w, 2, topo="2x2x2", tpu=4))
+        server.create(gang_pod("g2", w, 2, topo="2x2x2", tpu=4))
+    mgr.run_until_idle()
+    g1 = {server.get("Pod", f"g1-{w}", "team-a").spec.node_name for w in range(2)}
+    g2 = {server.get("Pod", f"g2-{w}", "team-a").spec.node_name for w in range(2)}
+    assert g1 == {"pool-a-w0", "pool-a-w1"}
+    assert g2 == {"pool-a-w2", "pool-a-w3"}
+
+
+def test_two_4x4_gangs_share_8x8_pool_contiguously():
+    """VERDICT done-criterion: two 4x4 gangs coexist on an 8x8 pool; each
+    occupies an axis-aligned contiguous block of the host grid."""
+    from nos_tpu.tpu.ici import group_ici_domains
+    server, mgr = rig()
+    make_pool(server, "pool-a", 8, topo="8x8")   # v5e 8x8 = 64 chips = 8 hosts
+    for w in range(2):
+        server.create(gang_pod("g1", w, 2, topo="4x4"))
+        server.create(gang_pod("g2", w, 2, topo="4x4"))
+    mgr.run_until_idle()
+
+    domain = group_ici_domains(server.list("Node"))["pool-a"]
+    shape = domain.host_shape                    # (4, 2) hosts
+    names = [n.metadata.name for n in domain.nodes]
+
+    def grid_coords(gang):
+        out = []
+        for w in range(2):
+            node = server.get("Pod", f"{gang}-{w}", "team-a").spec.node_name
+            assert node, f"{gang}-{w} not bound"
+            idx = names.index(node)
+            out.append((idx // shape[1], idx % shape[1]))
+        return out
+
+    c1, c2 = grid_coords("g1"), grid_coords("g2")
+    assert not (set(c1) & set(c2))
+    for coords in (c1, c2):
+        # contiguous 2x1 block of the host grid: same column, adjacent rows
+        (r0, col0), (r1, col1) = coords
+        assert col0 == col1 and abs(r1 - r0) == 1
+
+
+def test_exact_pool_preferred_over_carving():
+    """Tightest fit: an exact-size 2x2x2 pool wins over carving a corner
+    out of an idle 2x2x4 pool (which stays whole for bigger gangs)."""
+    server, mgr = rig()
+    v5p_pool(server, "pool-big", "2x2x4")
+    v5p_pool(server, "pool-small", "2x2x2")
+    for w in range(2):
+        server.create(gang_pod("job", w, 2, topo="2x2x2", tpu=4))
+    mgr.run_until_idle()
+    for w in range(2):
+        node = server.get("Pod", f"job-{w}", "team-a").spec.node_name
+        assert node.startswith("pool-small"), node
+
+
+def test_subcuboid_host_misaligned_topology_rejected():
+    """A topology whose chip dims don't align to host boundaries can never
+    be placed (no valid host tiling)."""
+    from nos_tpu.tpu import topology as topo_mod
+    assert topo_mod.host_shape(V5P, topo_mod.SliceTopology((3, 2, 2))) is None
+    # and legal ones do align
+    assert topo_mod.host_shape(V5P, topo_mod.SliceTopology((2, 2, 4))) == (1, 1, 4)
+    assert topo_mod.host_shape("tpu-v5-lite-podslice",
+                               topo_mod.SliceTopology((8, 8))) == (4, 2)
+    # dimensionality mismatch (2D request vs 3D pool or vice versa) is
+    # never a sub-topology — guards against zip-truncation double-binding
+    assert not topo_mod.is_sub_topology(
+        V5P, topo_mod.SliceTopology((2, 2, 2)), topo_mod.SliceTopology((4, 4)))
+    assert topo_mod.is_sub_topology(
+        V5P, topo_mod.SliceTopology((2, 2, 2)), topo_mod.SliceTopology((2, 2, 4)))
