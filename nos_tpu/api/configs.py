@@ -40,6 +40,26 @@ class _BaseConfig:
     def validate(self) -> None:
         pass
 
+    def leader_election_config(self, component: str):
+        """LeaderElectionConfig for this component, or None when disabled
+        (reference: ControllerManagerConfigurationSpec.LeaderElection,
+        enabled for every manager in helm values)."""
+        if not self.leader_election:
+            return None
+        import socket
+        import uuid
+
+        from nos_tpu.kube.leaderelection import LeaderElectionConfig
+
+        # uuid suffix (controller-runtime does the same): hostname+pid is
+        # NOT unique across two managers in one process or pid reuse
+        # across container restarts — identity collision makes both
+        # replicas believe they hold the lease.
+        return LeaderElectionConfig(
+            lease_name=f"nos-tpu-{component}-leader",
+            identity=f"{socket.gethostname()}-{uuid.uuid4().hex[:8]}",
+        )
+
 
 @dataclass
 class OperatorConfig(_BaseConfig):

@@ -26,7 +26,7 @@ def build(server, config: Optional[OperatorConfig] = None) -> Manager:
         tpu_memory_gb=cfg.tpu_resource_memory_gb,
         nvidia_gpu_memory_gb=cfg.nvidia_gpu_resource_memory_gb,
     )
-    mgr = Manager(server)
+    mgr = Manager(server, leader_election=cfg.leader_election_config("operator"))
     mgr.add_controller(ElasticQuotaReconciler(calc).controller())
     mgr.add_controller(CompositeElasticQuotaReconciler(calc).controller())
     return mgr

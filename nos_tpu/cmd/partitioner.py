@@ -29,7 +29,7 @@ def build(server, config: Optional[PartitionerConfig] = None) -> Manager:
             topology.load_generations_file(cfg.known_generations_file)
         )
     state = ClusterState()
-    mgr = Manager(server)
+    mgr = Manager(server, leader_election=cfg.leader_election_config("partitioner"))
     mgr.add_controller(NodeController(state).controller())
     mgr.add_controller(PodController(state).controller())
     mgr.add_controller(

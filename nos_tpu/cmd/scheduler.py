@@ -23,7 +23,7 @@ def build(server, config: Optional[CapacitySchedulingArgs] = None) -> Manager:
         tpu_memory_gb=cfg.tpu_resource_memory_gb,
         nvidia_gpu_memory_gb=cfg.nvidia_gpu_resource_memory_gb,
     )
-    mgr = Manager(server)
+    mgr = Manager(server, leader_election=cfg.leader_election_config("scheduler"))
     mgr.add_controller(Scheduler(calculator=calc).controller())
     return mgr
 

@@ -160,18 +160,34 @@ class Controller:
 class Manager:
     """Hosts controllers against one API server (one per reference binary).
 
-    Leader election is a no-op here (single-process); healthz/readyz are
-    trivial accessors kept for parity with the reference binaries
-    (cmd/operator/operator.go:112-119).
+    With ``leader_election`` set, reconciling is gated on holding a Lease
+    (reference: every manager enables leader election,
+    cmd/operator/operator.go:76-81): followers keep consuming watch events
+    (queues stay warm) but process nothing until they acquire the lease.
+    healthz/readyz are trivial accessors kept for parity with the
+    reference binaries (cmd/operator/operator.go:112-119).
     """
 
-    def __init__(self, server: ApiServer, clock: Callable[[], float] = time.monotonic):
+    def __init__(
+        self,
+        server: ApiServer,
+        clock: Callable[[], float] = time.monotonic,
+        leader_election: Optional["LeaderElectionConfig"] = None,
+    ):
         self.server = server
         self.client = Client(server)
         self.clock = clock
         self.controllers: List[Controller] = []
         self._sub = server.subscribe()
         self._stop = threading.Event()
+        self.elector = None
+        if leader_election is not None:
+            from nos_tpu.kube.leaderelection import LeaderElector
+
+            self.elector = LeaderElector(self.client, leader_election, clock)
+
+    def is_leader(self) -> bool:
+        return self.elector is None or self.elector.is_leader
 
     def add_controller(self, controller: Controller) -> Controller:
         self.controllers.append(controller)
@@ -210,20 +226,23 @@ class Manager:
         while True:
             progressed = self._dispatch_events() > 0
             now = self.clock()
+            if self.elector is not None:
+                self.elector.tick(now)
             if advance_delayed:
                 for c in self.controllers:
                     due = c.next_due()
                     if due is not None:
                         now = max(now, due)
-            for c in self.controllers:
-                while c.process_one(self.client, now):
-                    done += 1
-                    if done > max_iterations:
-                        raise RuntimeError(
-                            "run_until_idle did not converge (reconcile livelock?)"
-                        )
-                    progressed = True
-                    self._dispatch_events()
+            if self.is_leader():
+                for c in self.controllers:
+                    while c.process_one(self.client, now):
+                        done += 1
+                        if done > max_iterations:
+                            raise RuntimeError(
+                                "run_until_idle did not converge (reconcile livelock?)"
+                            )
+                        progressed = True
+                        self._dispatch_events()
             if not progressed:
                 return done
 
@@ -232,12 +251,17 @@ class Manager:
         while not self._stop.is_set():
             self._dispatch_events()
             now = self.clock()
+            if self.elector is not None:
+                self.elector.tick(now)
             worked = False
-            for c in self.controllers:
-                worked = c.process_one(self.client, now) or worked
+            if self.is_leader():
+                for c in self.controllers:
+                    worked = c.process_one(self.client, now) or worked
             if not worked:
                 self._stop.wait(poll_interval_s)
 
     def stop(self) -> None:
         self._stop.set()
+        if self.elector is not None:
+            self.elector.release()
         self.server.unsubscribe(self._sub)

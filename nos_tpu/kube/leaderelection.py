@@ -1,0 +1,176 @@
+"""Lease-based leader election for controller managers.
+
+Analog of controller-runtime's leader election, which every reference
+manager enables (cmd/operator/operator.go:76-81, helm values
+leaderElection.enabled — helm-charts/nos/values.yaml:57-59). Two replicas
+of a manager must not double-reconcile; the loser idles hot-standby and
+takes over when the holder's lease expires.
+
+Mechanics mirror k8s coordination.k8s.io/v1 Lease semantics:
+
+- a named ``Lease`` object records holder identity + renew time;
+- acquisition and renewal go through the API server's optimistic
+  concurrency (``update`` with resource-version check): when two
+  candidates race, exactly one update lands, the other gets ``Conflict``
+  and stays a follower;
+- the holder renews every ``renew_interval_s``; a candidate may steal the
+  lease only after observing an UNCHANGED lease record for a full
+  ``lease_duration_s`` on its OWN clock (client-go's observedTime rule:
+  remote renew timestamps are never compared against the local clock, so
+  skewed or differently-epoched clocks — time.monotonic is per-host —
+  cannot produce two leaders);
+- callers gate work on ``is_leader`` — the Manager checks it before
+  processing any controller queue, so followers keep watching (caches
+  warm) but reconcile nothing.
+"""
+from __future__ import annotations
+
+import logging
+import time
+from dataclasses import dataclass, field
+from typing import Callable, Optional
+
+from nos_tpu.kube.apiserver import AlreadyExists, ApiError, Conflict, NotFound
+from nos_tpu.kube.objects import ObjectMeta
+
+logger = logging.getLogger(__name__)
+
+
+@dataclass
+class LeaseSpec:
+    holder_identity: str = ""
+    lease_duration_seconds: float = 15.0
+    acquire_time: float = 0.0
+    renew_time: float = 0.0
+    lease_transitions: int = 0
+
+
+@dataclass
+class Lease:
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    spec: LeaseSpec = field(default_factory=LeaseSpec)
+
+    KIND = "Lease"
+
+
+@dataclass
+class LeaderElectionConfig:
+    lease_name: str
+    identity: str
+    namespace: str = "nos-system"
+    lease_duration_s: float = 15.0
+    renew_interval_s: float = 2.0
+
+
+class LeaderElector:
+    """Drives one candidate's view of a lease. Pump ``tick(now)`` from the
+    manager loop; read ``is_leader``."""
+
+    def __init__(
+        self,
+        client,
+        config: LeaderElectionConfig,
+        clock: Callable[[], float] = time.monotonic,
+    ):
+        self.client = client
+        self.config = config
+        self.clock = clock
+        self.is_leader = False
+        self._last_attempt = -float("inf")
+        # last observed lease record + WHEN we observed it (our clock)
+        self._observed: Optional[tuple] = None
+        self._observed_at = -float("inf")
+
+    # ------------------------------------------------------------------
+    def tick(self, now: Optional[float] = None) -> bool:
+        """Acquire/renew if due; returns current leadership."""
+        now = self.clock() if now is None else now
+        interval = self.config.renew_interval_s
+        if now - self._last_attempt < interval:
+            return self.is_leader
+        self._last_attempt = now
+        try:
+            self.is_leader = self._try_acquire_or_renew(now)
+        except ApiError:
+            logger.exception(
+                "[%s] leader election attempt failed", self.config.identity
+            )
+            # can't reach/update the lease: assume lost (fail closed —
+            # better two idle managers than two active ones)
+            self.is_leader = False
+        return self.is_leader
+
+    def release(self) -> None:
+        """Voluntarily drop the lease on clean shutdown so a standby can
+        take over immediately instead of waiting out the duration."""
+        if not self.is_leader:
+            return
+        try:
+            lease = self.client.get(
+                "Lease", self.config.lease_name, self.config.namespace
+            )
+            if lease.spec.holder_identity == self.config.identity:
+                lease.spec.holder_identity = ""
+                lease.spec.renew_time = 0.0
+                self.client.update(lease)
+        except ApiError:
+            pass
+        self.is_leader = False
+
+    # ------------------------------------------------------------------
+    def _take_over(self, spec: LeaseSpec, now: float) -> None:
+        spec.holder_identity = self.config.identity
+        spec.lease_duration_seconds = self.config.lease_duration_s
+        spec.acquire_time = now
+        spec.renew_time = now
+        spec.lease_transitions += 1
+
+    def _try_acquire_or_renew(self, now: float) -> bool:
+        cfg = self.config
+        try:
+            lease: Lease = self.client.get("Lease", cfg.lease_name, cfg.namespace)
+        except NotFound:
+            lease = Lease(
+                metadata=ObjectMeta(name=cfg.lease_name, namespace=cfg.namespace),
+                spec=LeaseSpec(
+                    holder_identity=cfg.identity,
+                    lease_duration_seconds=cfg.lease_duration_s,
+                    acquire_time=now,
+                    renew_time=now,
+                ),
+            )
+            try:
+                self.client.create(lease)
+                logger.info("[%s] acquired lease %s (created)", cfg.identity, cfg.lease_name)
+                return True
+            except (AlreadyExists, Conflict):
+                return False  # raced another candidate's create; retry next tick
+        spec = lease.spec
+        if spec.holder_identity == cfg.identity:
+            spec.renew_time = now
+        elif spec.holder_identity:
+            # Held by someone else. Never compare their renew timestamp to
+            # our clock — judge liveness by how long the record has stayed
+            # unchanged as seen on OUR clock (client-go observedTime).
+            record = (spec.holder_identity, spec.renew_time)
+            if record != self._observed:
+                self._observed = record
+                self._observed_at = now
+                return False  # fresh evidence of a live leader
+            if now - self._observed_at < spec.lease_duration_seconds:
+                return False  # not yet stale for a full lease duration
+            # record frozen for >= lease_duration: leader is gone — steal
+            self._take_over(spec, now)
+        else:
+            # voluntarily released — take over immediately
+            self._take_over(spec, now)
+        try:
+            self.client.update(lease)
+        except Conflict:
+            return False  # someone else renewed/stole first
+        if spec.lease_transitions and spec.acquire_time == now:
+            logger.info(
+                "[%s] acquired lease %s (takeover #%d)",
+                cfg.identity, cfg.lease_name, spec.lease_transitions,
+            )
+        return True

@@ -70,6 +70,81 @@ class Container:
 
 
 @dataclass
+class Taint:
+    """Node taint (GKE TPU pools carry google.com/tpu=present:NoSchedule)."""
+
+    key: str = ""
+    value: str = ""
+    effect: str = "NoSchedule"   # NoSchedule | PreferNoSchedule | NoExecute
+
+
+@dataclass
+class Toleration:
+    key: str = ""                # empty key + Exists tolerates everything
+    operator: str = "Equal"      # Equal | Exists
+    value: str = ""
+    effect: str = ""             # empty matches all effects
+
+    def tolerates(self, taint: Taint) -> bool:
+        if self.effect and self.effect != taint.effect:
+            return False
+        if self.operator == "Exists":
+            return not self.key or self.key == taint.key
+        return self.key == taint.key and self.value == taint.value
+
+
+@dataclass
+class NodeSelectorRequirement:
+    key: str = ""
+    operator: str = "In"         # In | NotIn | Exists | DoesNotExist | Gt | Lt
+    values: List[str] = field(default_factory=list)
+
+    def matches(self, labels: Dict[str, str]) -> bool:
+        present = self.key in labels
+        val = labels.get(self.key)
+        if self.operator == "In":
+            return present and val in self.values
+        if self.operator == "NotIn":
+            return not present or val not in self.values
+        if self.operator == "Exists":
+            return present
+        if self.operator == "DoesNotExist":
+            return not present
+        if self.operator in ("Gt", "Lt"):
+            if not present or not self.values:
+                return False
+            try:
+                node_v, want = int(val), int(self.values[0])
+            except ValueError:
+                return False
+            return node_v > want if self.operator == "Gt" else node_v < want
+        return False
+
+
+@dataclass
+class NodeSelectorTerm:
+    """AND of match expressions (one k8s nodeSelectorTerm)."""
+
+    match_expressions: List[NodeSelectorRequirement] = field(default_factory=list)
+
+    def matches(self, labels: Dict[str, str]) -> bool:
+        return all(r.matches(labels) for r in self.match_expressions)
+
+
+@dataclass
+class Affinity:
+    """requiredDuringSchedulingIgnoredDuringExecution node affinity:
+    OR over terms, AND within a term."""
+
+    node_affinity_required: List[NodeSelectorTerm] = field(default_factory=list)
+
+    def matches(self, labels: Dict[str, str]) -> bool:
+        if not self.node_affinity_required:
+            return True
+        return any(t.matches(labels) for t in self.node_affinity_required)
+
+
+@dataclass
 class PodSpec:
     containers: List[Container] = field(default_factory=list)
     init_containers: List[Container] = field(default_factory=list)
@@ -78,6 +153,8 @@ class PodSpec:
     priority: Optional[int] = None
     priority_class_name: str = ""
     node_selector: Dict[str, str] = field(default_factory=dict)
+    tolerations: List[Toleration] = field(default_factory=list)
+    affinity: Optional[Affinity] = None
 
 
 @dataclass
@@ -136,8 +213,15 @@ class NodeStatus:
 
 
 @dataclass
+class NodeSpec:
+    taints: List[Taint] = field(default_factory=list)
+    unschedulable: bool = False
+
+
+@dataclass
 class Node:
     metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    spec: NodeSpec = field(default_factory=NodeSpec)
     status: NodeStatus = field(default_factory=NodeStatus)
 
     KIND = "Node"

@@ -14,10 +14,12 @@ import dataclasses
 from typing import Dict, List, Optional, Union, get_args, get_origin, get_type_hints
 
 from nos_tpu.api.quota import CompositeElasticQuota, ElasticQuota
+from nos_tpu.kube.leaderelection import Lease
 from nos_tpu.kube.objects import ConfigMap, Node, Pod, kind_of
 
 KINDS: Dict[str, type] = {
-    c.KIND: c for c in (Pod, Node, ConfigMap, ElasticQuota, CompositeElasticQuota)
+    c.KIND: c
+    for c in (Pod, Node, ConfigMap, ElasticQuota, CompositeElasticQuota, Lease)
 }
 
 

@@ -37,6 +37,7 @@ from nos_tpu.utils.pod import is_over_quota
 
 PRE_FILTER_STATE = "capacity/preFilterState"
 SNAPSHOT_STATE = "capacity/quotaSnapshot"
+NOMINATED_STATE = "capacity/nominatedForNode"
 
 
 @dataclass
@@ -53,16 +54,20 @@ class CapacityScheduling:
         # Set by the hosting Scheduler so preemption's what-if fit check runs
         # the FULL filter pipeline (reference RunFilterPluginsWithNominatedPods,
         # capacity_scheduling.go:610) — not just resource fit. None during
-        # standalone unit use; falls back to the default filters.
+        # standalone unit use; falls back to the default filter suite.
         self.framework = None
+        self._default_framework = fw.SchedulerFramework(calculator=self.calc)
 
     def _fits(self, state: fw.CycleState, pod: Pod, node_info: fw.NodeInfo) -> bool:
-        if self.framework is not None:
-            return self.framework.run_filter(state, pod, node_info).success
-        return (
-            fw.NodeSelectorFit().filter(state, pod, node_info).success
-            and fw.NodeResourcesFit().filter(state, pod, node_info).success
-        )
+        nominated: List[Pod] = state.get(NOMINATED_STATE) or []
+        fwk = self.framework
+        if fwk is None:
+            # standalone unit use: same default filter suite as the wired
+            # scheduler (no silent divergence on taints/cordons/affinity)
+            fwk = self._default_framework
+        return fwk.run_filter_with_nominated(
+            state, pod, node_info, nominated
+        ).success
 
     # ------------------------------------------------------------------
     # informer surface (analog of capacityscheduling/informer.go: unified
@@ -176,12 +181,16 @@ class CapacityScheduling:
         best_victims: Optional[List[Pod]] = None
         gang_index = self._gang_index(snapshot)  # once; reused per node
         for name, info in sorted(snapshot.items()):
+            # the what-if fit must count pods already nominated to this node
+            # by earlier preemption passes (their capacity is spoken for)
+            state[NOMINATED_STATE] = snapshot.nominated_for(name, exclude=pod)
             victims = self._select_victims_on_node(state, pod, info, gang_index)
             if victims is None:
                 continue
             if best_victims is None or len(victims) < len(best_victims):
                 best_node = name
                 best_victims = victims
+        state.pop(NOMINATED_STATE, None)
         if best_node is None:
             return None, fw.Status.unschedulable("preemption found no candidate")
         state["capacity/victims"] = best_victims

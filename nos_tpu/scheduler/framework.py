@@ -112,7 +112,14 @@ class NodeInfo:
 class Snapshot(Dict[str, NodeInfo]):
     """node name -> NodeInfo (analog of the framework SharedLister /
     FakeSharedLister, reference pkg/test/util/fake.go:35-80, used in both
-    tests and production wiring)."""
+    tests and production wiring). Also tracks *nominated* pods — pending
+    pods a preemption pass has earmarked for a node — so feasibility checks
+    can account for capacity they will consume (reference
+    RunFilterPluginsWithNominatedPods, capacity_scheduling.go:610-673)."""
+
+    def __init__(self, *args, **kwargs):
+        super().__init__(*args, **kwargs)
+        self._nominated: Dict[str, List[Pod]] = {}
 
     @staticmethod
     def build(nodes: List[Node], pods: List[Pod],
@@ -124,12 +131,38 @@ class Snapshot(Dict[str, NodeInfo]):
         for p in pods:
             if p.spec.node_name and p.spec.node_name in snap:
                 snap[p.spec.node_name].add_pod(p)
+            elif not p.spec.node_name and p.status.nominated_node_name in snap:
+                snap.add_nominated(p)
         return snap
+
+    def add_nominated(self, pod: Pod) -> None:
+        node = pod.status.nominated_node_name
+        if node:
+            self._nominated.setdefault(node, []).append(pod)
+
+    def remove_nominated(self, pod: Pod) -> None:
+        for node, pods in self._nominated.items():
+            self._nominated[node] = [
+                p for p in pods
+                if not (p.metadata.name == pod.metadata.name
+                        and p.metadata.namespace == pod.metadata.namespace)
+            ]
+
+    def nominated_for(self, node_name: str, exclude: Optional[Pod] = None) -> List[Pod]:
+        out = self._nominated.get(node_name, [])
+        if exclude is not None:
+            out = [
+                p for p in out
+                if not (p.metadata.name == exclude.metadata.name
+                        and p.metadata.namespace == exclude.metadata.namespace)
+            ]
+        return out
 
     def clone(self) -> "Snapshot":
         out = Snapshot()
         for name, info in self.items():
             out[name] = info.clone()
+        out._nominated = {k: list(v) for k, v in self._nominated.items()}
         return out
 
 
@@ -166,6 +199,63 @@ class NodeSelectorFit:
         return Status.ok()
 
 
+class TaintTolerationFit:
+    """Reject nodes whose NoSchedule/NoExecute taints the pod does not
+    tolerate. GKE TPU node pools are tainted google.com/tpu=present:
+    NoSchedule, so without this filter the simulation would place ordinary
+    pods onto TPU hosts the real kubelet refuses."""
+
+    name = "TaintToleration"
+
+    def filter(self, state: CycleState, pod: Pod, node_info: NodeInfo) -> Status:
+        for taint in node_info.node.spec.taints:
+            if taint.effect not in ("NoSchedule", "NoExecute"):
+                continue  # PreferNoSchedule is a preference, not a filter
+            if not any(t.tolerates(taint) for t in pod.spec.tolerations):
+                return Status.unresolvable(
+                    f"node {node_info.node.metadata.name} has untolerated "
+                    f"taint {taint.key}={taint.value}:{taint.effect}"
+                )
+        return Status.ok()
+
+
+class NodeUnschedulableFit:
+    """Reject cordoned nodes (spec.unschedulable), unless the pod
+    explicitly tolerates the standard unschedulable taint key."""
+
+    name = "NodeUnschedulable"
+
+    TAINT_KEY = "node.kubernetes.io/unschedulable"
+
+    def filter(self, state: CycleState, pod: Pod, node_info: NodeInfo) -> Status:
+        if not node_info.node.spec.unschedulable:
+            return Status.ok()
+        from nos_tpu.kube.objects import Taint
+
+        synthetic = Taint(key=self.TAINT_KEY, effect="NoSchedule")
+        if any(t.tolerates(synthetic) for t in pod.spec.tolerations):
+            return Status.ok()
+        return Status.unresolvable(
+            f"node {node_info.node.metadata.name} is unschedulable"
+        )
+
+
+class NodeAffinityFit:
+    """requiredDuringScheduling node affinity: OR over terms, AND within
+    a term (reference planner simulation registers the full plugin suite,
+    cmd/gpupartitioner/gpupartitioner.go:294-318)."""
+
+    name = "NodeAffinity"
+
+    def filter(self, state: CycleState, pod: Pod, node_info: NodeInfo) -> Status:
+        aff = pod.spec.affinity
+        if aff is None or aff.matches(node_info.node.metadata.labels):
+            return Status.ok()
+        return Status.unresolvable(
+            f"node affinity does not match node {node_info.node.metadata.name}"
+        )
+
+
 # ---------------------------------------------------------------------------
 # Framework
 # ---------------------------------------------------------------------------
@@ -179,7 +269,10 @@ class SchedulerFramework:
                  calculator: Optional[ResourceCalculator] = None):
         self.calculator = calculator or ResourceCalculator()
         self.plugins: List[object] = [
+            NodeUnschedulableFit(),
             NodeSelectorFit(),
+            TaintTolerationFit(),
+            NodeAffinityFit(),
             NodeResourcesFit(),
         ]
         if plugins:
@@ -201,6 +294,21 @@ class SchedulerFramework:
             if not st.success:
                 return st
         return Status.ok()
+
+    def run_filter_with_nominated(
+        self, state: CycleState, pod: Pod, node_info: NodeInfo,
+        nominated: List[Pod],
+    ) -> Status:
+        """Filter with higher-or-equal-priority nominated pods counted as
+        if already placed (their capacity is spoken for) — the reference's
+        RunFilterPluginsWithNominatedPods (capacity_scheduling.go:610)."""
+        relevant = [p for p in nominated if p.priority() >= pod.priority()]
+        if not relevant:
+            return self.run_filter(state, pod, node_info)
+        sim = node_info.clone()
+        for p in relevant:
+            sim.add_pod(p)
+        return self.run_filter(state, pod, sim)
 
     def run_post_filter(
         self, state: CycleState, pod: Pod, snapshot: Snapshot
@@ -248,11 +356,20 @@ class SchedulerFramework:
         Shared by the live scheduling loop and the planner simulation so the
         two paths cannot diverge."""
         feasible = []
+        reasons: List[str] = []
         for name, info in sorted(snapshot.items()):
-            if self.run_filter(state, pod, info).success:
+            nominated = snapshot.nominated_for(name, exclude=pod)
+            st = self.run_filter_with_nominated(state, pod, info, nominated)
+            if st.success:
                 feasible.append((self.run_score(state, pod, info), name))
+            elif st.reason and st.reason not in reasons:
+                reasons.append(st.reason)
         if not feasible:
-            return None, Status.unschedulable("no feasible node")
+            # aggregate distinct per-node reasons (kube-scheduler style)
+            detail = "; ".join(reasons[:4]) if reasons else ""
+            return None, Status.unschedulable(
+                f"no feasible node: {detail}" if detail else "no feasible node"
+            )
         feasible.sort(key=lambda t: (-t[0], t[1]))
         return feasible[0][1], Status.ok()
 

@@ -316,9 +316,11 @@ class GangScheduler:
                 w = gang_worker(pod)
                 if w in bound:
                     continue
-                node_info = snapshot.get(hosts[w].metadata.name)
-                if node_info is None or not self.framework.run_filter(
-                    state, pod, node_info
+                host_name = hosts[w].metadata.name
+                node_info = snapshot.get(host_name)
+                if node_info is None or not self.framework.run_filter_with_nominated(
+                    state, pod, node_info,
+                    snapshot.nominated_for(host_name, exclude=pod),
                 ).success:
                     feasible = False
                     break

@@ -52,14 +52,20 @@ class Scheduler:
         )
         self.capacity.reset_accounting()
         nodes = client.list("Node")
-        pods = [
-            p
-            for p in client.list("Pod")
-            if p.spec.node_name and p.status.phase in ("Pending", "Running")
-        ]
-        for p in pods:
+        assigned = []
+        nominated = []
+        for p in client.list("Pod"):
+            if p.spec.node_name and p.status.phase in ("Pending", "Running"):
+                assigned.append(p)
+            elif (
+                not p.spec.node_name
+                and p.status.phase == "Pending"
+                and p.status.nominated_node_name
+            ):
+                nominated.append(p)
+        for p in assigned:
             self.capacity.track_pod(p)
-        return fw.Snapshot.build(nodes, pods, self.calc)
+        return fw.Snapshot.build(nodes, assigned + nominated, self.calc)
 
     # ------------------------------------------------------------------
     def reconcile(self, client: Client, req: Request) -> Result:
@@ -139,6 +145,7 @@ class Scheduler:
         bound = deep_copy(pod)
         bound.spec.node_name = node_name
         snapshot[node_name].add_pod(bound)
+        snapshot.remove_nominated(pod)
         obs.SCHEDULE_ATTEMPTS.labels("bound").inc()
         logger.info("scheduled %s/%s -> %s", pod.metadata.namespace, pod.metadata.name, node_name)
         return Result()
@@ -228,6 +235,13 @@ class Scheduler:
             def nominate(p: Pod, n=nominated):
                 p.status.nominated_node_name = n
             client.patch("Pod", pod.metadata.name, pod.metadata.namespace, nominate)
+            # later pods in this sweep must see the freed capacity as
+            # spoken for by this pod — and any PREVIOUS nomination of this
+            # pod must go, or it would phantom-reserve two nodes at once
+            snapshot.remove_nominated(pod)
+            marked = deep_copy(pod)
+            marked.status.nominated_node_name = nominated
+            snapshot.add_nominated(marked)
             logger.info(
                 "preempted %d pods on %s for %s/%s",
                 len(victims), nominated, pod.metadata.namespace, pod.metadata.name,
