@@ -1,97 +1,120 @@
 #!/usr/bin/env python3
-"""Benchmark — the reference's headline scenario on TPU.
+"""Headline benchmark: train-step MFU of the flagship decoder on one TPU
+chip, with the north-star scheduler numbers embedded.
 
-Reference benchmark (BASELINE.md, demos/gpu-sharing-comparison): average
-per-request inference latency of YOLOS-small (ViT-small backbone, ~22M
-params, 224x224 input) when 7 pods share one accelerator. Best reference
-number: MPS sharing on an A100 80GB = 0.31982 s per request at 7 pods.
+Measures what BASELINE.json's metric asks for, on the workload plane:
 
-TPU-native equivalent: 7 concurrent single-image streams multiplexed onto
-one chip. The TPU-idiomatic way to share a chip among concurrent tenants is
-batched multiplexing — the serving runtime coalesces the 7 outstanding
-requests into one bf16 batch that the MXU executes in a single pass (the
-role MPS plays on the GPU, minus the kernel-level context switching). Each
-request's latency is the batched forward time.
+- **MFU**: a chip-filling GQA decoder (1.1B params: d_model 2048, 16
+  layers, 16 heads / 4 KV heads, ff 8192, seq 2048, bf16, full-remat)
+  trains with adamw; achieved model TFLOP/s divided by the chip's peak
+  bf16 TFLOP/s. Model FLOPs are computed analytically from the config
+  (matmul terms only, attention counted full-S^2); remat recompute is NOT
+  counted — the quotient is true Model FLOPs Utilization.
+- **Scheduler north star** (embedded from bench_sched.run): p50/p99
+  submit->bind latency for a 256-chip v5p JobSet sharing one 4x8x8 pool
+  via sub-cuboid gang placement, and allocated-chip utilization.
 
 Prints ONE JSON line:
-  {"metric": ..., "value": <avg seconds per request>, "unit": "s",
-   "vs_baseline": <reference_latency / ours>}
+  {"metric": ..., "value": <MFU %>, "unit": "%", "vs_baseline": <value/40>}
+
+``vs_baseline`` is measured against the 40%-MFU bar set for this rebuild
+(the reference publishes no training numbers at all — SURVEY §6 — so the
+bar, not an apples-to-oranges GPU latency, is the honest denominator).
+A secondary inference bench against the reference's actual published
+numbers lives in bench_infer.py. If the TPU is unreachable the scheduler
+line (CPU-only, bench_sched.py) is printed instead so the driver always
+gets a datapoint.
 """
 import json
 import sys
 import time
 
-import jax
-import jax.numpy as jnp
-
 sys.path.insert(0, ".")
 
-from nos_tpu.models import vit  # noqa: E402
+# peak bf16 TFLOP/s by device kind (Cloud TPU public specs)
+PEAK_TFLOPS = {
+    "TPU v5 lite": 197.0,   # v5e
+    "TPU v5e": 197.0,
+    "TPU v5p": 459.0,
+    "TPU v4": 275.0,
+    "TPU v6e": 918.0,
+    "TPU v6 lite": 918.0,
+}
 
-N_STREAMS = 7          # reference: 7 pods sharing the accelerator
-BASELINE_S = 0.31982   # reference MPS, 7 pods (BASELINE.md)
-CHAIN = 200            # forwards per timed device chain
-TRIALS = 9
-
-
-def _chained_forward(cfg, k: int):
-    """One jitted program executing k sequentially-dependent forwards.
-
-    Timing difference between two chain lengths cancels host<->device RPC
-    latency (the TPU may sit behind a relay where per-dispatch round trips
-    dominate and block_until_ready is cheap), leaving pure device time.
-    """
-
-    @jax.jit
-    def run(params, images):
-        def body(x, _):
-            logits = vit.forward(params, cfg, images + x)
-            return jnp.sum(logits) * 1e-30, None
-
-        x, _ = jax.lax.scan(body, jnp.float32(0), None, length=k)
-        return x
-
-    return run
+MODEL = dict(
+    d_model=2048, n_layers=16, n_heads=16, n_kv_heads=4, d_ff=8192,
+    vocab=32000, max_seq=2048, remat=True,
+)
+BATCH, SEQ = 8, 2048
+WARMUP_STEPS = 2
+TIMED_STEPS = 10
+MFU_BAR = 40.0  # % — the target this rebuild is held to (VERDICT r1 #2)
 
 
-def _time_fetch(fn, *args) -> float:
-    import numpy as np
-
-    np.asarray(fn(*args))   # warmup/compile
-    times = []
-    for _ in range(TRIALS):
-        t0 = time.perf_counter()
-        np.asarray(fn(*args))
-        times.append(time.perf_counter() - t0)
-    times.sort()
-    return times[len(times) // 2]   # median: robust to relay jitter
+def model_flops_per_step(cfg, batch, seq):
+    """Analytic matmul FLOPs of one fwd+bwd step (bwd = 2x fwd). Attention
+    is counted at full S^2 (the flash kernel actually skips masked blocks,
+    so this slightly UNDERSTATES true utilization — conservative)."""
+    d, ff, L, v, kv = cfg.d_model, cfg.d_ff, cfg.n_layers, cfg.vocab, cfg.kv_dim
+    per_tok = L * (2 * d * (d + 2 * kv) + 2 * d * d + 6 * d * ff) + 2 * d * v
+    attn = L * 4 * batch * seq * seq * d
+    return 3 * (batch * seq * per_tok + attn)
 
 
-def main() -> None:
-    cfg = vit.ViTConfig()   # ViT-small/16 @ 224 — the YOLOS-small backbone scale
-    rng = jax.random.PRNGKey(0)
-    params = vit.init_params(rng, cfg)
-    params = jax.device_put(params)
+import os
 
-    # one outstanding single-image request per stream, coalesced per step
-    images = jax.random.normal(
-        jax.random.PRNGKey(1), (N_STREAMS, cfg.image_size, cfg.image_size, 3),
-        jnp.float32,
+MFU_TIMEOUT_S = int(os.environ.get("NOS_TPU_BENCH_TIMEOUT_S", "900"))
+# watchdog: a wedged TPU tunnel hangs instead of raising
+
+
+def run_mfu():
+    """Run bench_mfu.py in a subprocess under a watchdog (first compile is
+    ~20-40s; a dead tunnel would hang this process forever otherwise)."""
+    import subprocess
+
+    proc = subprocess.run(
+        [sys.executable, "bench_mfu.py"],
+        capture_output=True, text=True, timeout=MFU_TIMEOUT_S,
     )
+    if proc.returncode != 0:
+        raise RuntimeError(f"bench_mfu failed: {proc.stderr.strip()[-300:]}")
+    return json.loads(proc.stdout.strip().splitlines()[-1])
 
-    t_short = _time_fetch(_chained_forward(cfg, 1), params, images)
-    t_long = _time_fetch(_chained_forward(cfg, 1 + CHAIN), params, images)
 
-    per_request = max(t_long - t_short, 1e-9) / CHAIN
-    print(json.dumps({
+def main():
+    import bench_sched
+
+    # scheduler north star first (CPU-only, fast, can't hang on the TPU)
+    import contextlib
+    import io
+
+    buf = io.StringIO()
+    with contextlib.redirect_stdout(buf):
+        sched = bench_sched.main()
+
+    try:
+        mfu = run_mfu()
+    except Exception as e:  # TPU unreachable / compile failure
+        sched["mfu_error"] = f"{type(e).__name__}: {e}"[:200]
+        print(json.dumps(sched))
+        return
+
+    result = {
         "metric": (
-            "avg inference latency, ViT-small (YOLOS-small backbone scale), "
-            f"{N_STREAMS} concurrent streams sharing one chip"
+            f"train-step MFU, {mfu['params_b']}B GQA decoder "
+            f"(d2048/L16/ff8192, seq {SEQ}, batch {BATCH}, bf16+remat), "
+            f"1x {mfu['device']}"
         ),
-        "value": round(per_request, 6),
-        "unit": "s",
-        "vs_baseline": round(BASELINE_S / per_request, 2),
-    }))
+        "value": mfu["mfu_pct"],
+        "unit": "%",
+        "vs_baseline": round(mfu["mfu_pct"] / MFU_BAR, 3) if mfu["mfu_pct"] else None,
+        **{k: v for k, v in mfu.items() if k != "mfu_pct"},
+        "sched_gang_p50_s": sched["gang_p50_s"],
+        "sched_gang_p99_s": sched["gang_p99_s"],
+        "sched_subslice_p50_s": sched["subslice_p50_s"],
+        "sched_allocated_chip_utilization": sched["allocated_chip_utilization"],
+    }
+    print(json.dumps(result))
 
 
 if __name__ == "__main__":

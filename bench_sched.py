@@ -1,0 +1,202 @@
+#!/usr/bin/env python3
+"""Scheduler-latency + utilization benchmark — the north-star control-plane
+metrics (BASELINE.json: "TPU chip utilization % + p50 pod-schedule latency
+(256-chip JobSet)").
+
+Scenario (BASELINE.json config 4 at the named scale): a 256-chip v5p
+4x8x8 JobSet — a 64-worker gang — is submitted together with a 4-pod
+v5e sub-slice batch and two smaller gangs that must share the big pool
+via sub-cuboid placement. Measured:
+
+- **submit -> bind latency** per pod (p50/p99): wall-clock from the pod's
+  API-server creation to the bind patch landing, under the deterministic
+  controller pump — covers quota sync, gang admission, sub-cuboid search,
+  filter pipeline, and bind, i.e. the full scheduling path the real
+  cluster pays per pod (everything except real-apiserver RTTs).
+- **allocated-chip utilization**: chips requested by bound pods / cluster
+  chips, after the mixed workload lands. The north star is >= 90% on the
+  gang pool.
+
+Prints ONE JSON line. Run directly, or let bench.py embed the numbers.
+"""
+import json
+import statistics
+import sys
+import time
+
+sys.path.insert(0, ".")
+
+from nos_tpu import constants                               # noqa: E402
+from nos_tpu.api.quota import make_elastic_quota            # noqa: E402
+from nos_tpu.kube import ApiServer, Manager                 # noqa: E402
+from nos_tpu.kube.objects import (                          # noqa: E402
+    Container,
+    Node,
+    NodeSpec,
+    NodeStatus,
+    ObjectMeta,
+    Pod,
+    PodCondition,
+    PodSpec,
+    PodStatus,
+    Taint,
+    Toleration,
+)
+from nos_tpu.scheduler import Scheduler                     # noqa: E402
+
+TPU = constants.RESOURCE_TPU
+V5P = "tpu-v5p-slice"
+V5E = "tpu-v5-lite-podslice"
+TPU_TAINT = Taint(key=TPU, value="present", effect="NoSchedule")
+TOLERATION = Toleration(key=TPU, operator="Exists")
+
+
+def make_pool(server, pool, gen, topo, hosts, chips_per_host):
+    for i in range(hosts):
+        server.create(Node(
+            metadata=ObjectMeta(
+                name=f"{pool}-w{i:03d}",
+                labels={
+                    constants.LABEL_TPU_ACCELERATOR: gen,
+                    constants.LABEL_TPU_TOPOLOGY: topo,
+                    constants.LABEL_NODEPOOL: pool,
+                },
+            ),
+            spec=NodeSpec(taints=[TPU_TAINT]),
+            status=NodeStatus(
+                capacity={TPU: chips_per_host, "cpu": 96},
+                allocatable={TPU: chips_per_host, "cpu": 96},
+            ),
+        ))
+
+
+def gang_pod(job, ns, worker, size, topo, chips):
+    return Pod(
+        metadata=ObjectMeta(
+            name=f"{job}-{worker:03d}", namespace=ns,
+            labels={
+                constants.LABEL_GANG_NAME: job,
+                constants.LABEL_GANG_SIZE: str(size),
+                constants.LABEL_GANG_WORKER: str(worker),
+            },
+            annotations={constants.ANNOTATION_TPU_TOPOLOGY: topo},
+        ),
+        spec=PodSpec(
+            containers=[Container(requests={TPU: chips})],
+            scheduler_name=constants.SCHEDULER_NAME,
+            tolerations=[TOLERATION],
+        ),
+        status=PodStatus(phase="Pending", conditions=[PodCondition(
+            type="PodScheduled", status="False", reason="Unschedulable")]),
+    )
+
+
+def single_pod(name, ns, chips):
+    return Pod(
+        metadata=ObjectMeta(name=name, namespace=ns),
+        spec=PodSpec(
+            containers=[Container(requests={TPU: chips})],
+            scheduler_name=constants.SCHEDULER_NAME,
+            tolerations=[TOLERATION],
+        ),
+        status=PodStatus(phase="Pending", conditions=[PodCondition(
+            type="PodScheduled", status="False", reason="Unschedulable")]),
+    )
+
+
+def run_once():
+    """One full scenario; returns (latencies by group, utilization)."""
+    server = ApiServer()
+    submit_t = {}
+    bind_t = {}
+
+    def record_bind(srv, op, obj, old):
+        if op == "UPDATE" and obj.spec.node_name and old is not None \
+                and not old.spec.node_name:
+            bind_t[(obj.metadata.namespace, obj.metadata.name)] = time.perf_counter()
+
+    server.register_admission("Pod", record_bind)
+
+    mgr = Manager(server)
+    mgr.add_controller(Scheduler().controller())
+
+    # 256-chip v5p pool (4x8x8 = 64 hosts x 4 chips) + one v5e host
+    make_pool(server, "v5p-pool", V5P, "4x8x8", 64, 4)
+    make_pool(server, "v5e-pool", V5E, "2x4", 1, 8)
+    server.create(make_elastic_quota("q-big", "team-big", min={TPU: 256}))
+    server.create(make_elastic_quota("q-sub", "team-sub", min={TPU: 8}))
+    mgr.run_until_idle()
+
+    pods = []
+    # the 256-chip JobSet mix: a 4x4x8 gang (32 hosts) + two 4x4x4 gangs
+    # (16 hosts each) — fills the 4x8x8 pool via sub-cuboid sharing
+    for w in range(32):
+        pods.append(gang_pod("jobset-a", "team-big", w, 32, "4x4x8", 4))
+    for g in ("jobset-b", "jobset-c"):
+        for w in range(16):
+            pods.append(gang_pod(g, "team-big", w, 16, "4x4x4", 4))
+    # the 4-pod sub-slice batch on the v5e host
+    for i in range(4):
+        pods.append(single_pod(f"sub-{i}", "team-sub", 2))
+
+    for p in pods:
+        submit_t[(p.metadata.namespace, p.metadata.name)] = time.perf_counter()
+        server.create(p)
+    mgr.run_until_idle()
+
+    lat = {}
+    for key, t0 in submit_t.items():
+        t1 = bind_t.get(key)
+        lat[key] = (t1 - t0) if t1 is not None else None
+    unbound = [k for k, v in lat.items() if v is None]
+
+    total_chips = 64 * 4 + 8
+    used = sum(
+        p.request().get(TPU, 0)
+        for p in server.list("Pod")
+        if p.spec.node_name
+    )
+    return lat, unbound, used / total_chips
+
+
+def main():
+    reps = 5
+    gang_lat, sub_lat = [], []
+    utils = []
+    unbound_total = 0
+    t_start = time.perf_counter()
+    for _ in range(reps):
+        lat, unbound, util = run_once()
+        unbound_total += len(unbound)
+        utils.append(util)
+        for (ns, name), v in lat.items():
+            if v is None:
+                continue
+            (sub_lat if ns == "team-sub" else gang_lat).append(v)
+    wall = time.perf_counter() - t_start
+
+    def q(xs, p):
+        return statistics.quantiles(xs, n=100)[p - 1] if len(xs) > 1 else xs[0]
+
+    result = {
+        "metric": "p50 submit->bind latency, 256-chip v5p JobSet "
+                  "(3 gangs sub-cuboid-sharing one 4x8x8 pool) + v5e sub-slice batch",
+        "value": round(q(gang_lat, 50), 6),
+        "unit": "s",
+        "vs_baseline": None,   # reference publishes no scheduler latency (SURVEY §6)
+        "gang_p50_s": round(q(gang_lat, 50), 6),
+        "gang_p99_s": round(q(gang_lat, 99), 6),
+        "subslice_p50_s": round(q(sub_lat, 50), 6),
+        "subslice_p99_s": round(q(sub_lat, 99), 6),
+        "allocated_chip_utilization": round(sum(utils) / len(utils), 4),
+        "unbound_pods": unbound_total,
+        "pods_per_rep": 68,
+        "reps": reps,
+        "wall_s": round(wall, 2),
+    }
+    print(json.dumps(result))
+    return result
+
+
+if __name__ == "__main__":
+    main()

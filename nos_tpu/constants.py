@@ -45,6 +45,9 @@ TPU_SLICE_RESOURCE_REGEX = re.compile(
 LABEL_TPU_ACCELERATOR = "cloud.google.com/gke-tpu-accelerator"   # e.g. tpu-v5-lite-podslice
 LABEL_TPU_TOPOLOGY = "cloud.google.com/gke-tpu-topology"          # e.g. 2x4, 4x4x4
 LABEL_NODEPOOL = "cloud.google.com/gke-nodepool"
+# explicit host position in the pool's worker order (overrides natural
+# name sort when the naming scheme doesn't encode it)
+LABEL_TPU_HOST_INDEX = DOMAIN + "/tpu-host-index"
 # nos labels (analog of nos.nebuly.com/gpu-partitioning, pkg/gpu/partitioning.go:80-128).
 LABEL_PARTITIONING = DOMAIN + "/tpu-partitioning"                  # "subslicing" | "topology"
 LABEL_CAPACITY = DOMAIN + "/capacity"                              # in-quota | over-quota

@@ -305,10 +305,15 @@ class SchedulerFramework:
         relevant = [p for p in nominated if p.priority() >= pod.priority()]
         if not relevant:
             return self.run_filter(state, pod, node_info)
-        sim = node_info.clone()
-        for p in relevant:
-            sim.add_pod(p)
-        return self.run_filter(state, pod, sim)
+        # append/pop instead of cloning: filters only READ pods, and this
+        # runs per node per feasibility pass (and per reprieve candidate
+        # in preemption) — deep-copying the NodeInfo each time is O(pods)
+        # waste on the scheduler's hottest path
+        node_info.pods.extend(relevant)
+        try:
+            return self.run_filter(state, pod, node_info)
+        finally:
+            del node_info.pods[len(node_info.pods) - len(relevant):]
 
     def run_post_filter(
         self, state: CycleState, pod: Pod, snapshot: Snapshot

@@ -11,11 +11,12 @@ chosen at pool creation; traffic between pools crosses DCN. So:
 - a gang must be placed entirely inside one domain (DCN-crossing
   avoidance is a hard constraint here, not a score);
 - within a domain, host ordering follows the worker index convention
-  (node name sort = worker order) so the job's mesh axes line up with the
-  physical torus.
+  (host-index label, else natural name sort) so the job's mesh axes line
+  up with the physical torus.
 """
 from __future__ import annotations
 
+import re
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional
 
@@ -23,13 +24,35 @@ from nos_tpu import constants
 from nos_tpu.kube.objects import Node
 from nos_tpu.tpu import topology
 
+_NUM_RE = re.compile(r"(\d+)")
+
+
+def host_order_key(node: Node):
+    """Worker-order sort key for a pool's hosts. An explicit host-index
+    label wins; otherwise NATURAL sort of the name (digit runs compared
+    numerically) — plain lexicographic order would put 'w10' before 'w2'
+    and scatter a 10+-host pool's worker->coordinate map across the
+    torus."""
+    idx = node.metadata.labels.get(constants.LABEL_TPU_HOST_INDEX)
+    if idx is not None:
+        try:
+            return (0, int(idx), node.metadata.name)
+        except ValueError:
+            pass
+    parts = _NUM_RE.split(node.metadata.name)
+    # tag each element so int/str segments stay mutually comparable even
+    # across heterogeneous name structures within one pool
+    return (1,) + tuple(
+        (0, int(p)) if p.isdigit() else (1, p) for p in parts
+    ) + ((1, node.metadata.name),)
+
 
 @dataclass
 class IciDomain:
     pool: str
     generation: str                     # GENERATIONS key (label value)
     topology_name: str
-    nodes: List[Node] = field(default_factory=list)   # worker order (name sort)
+    nodes: List[Node] = field(default_factory=list)   # worker order (host_order_key)
 
     @property
     def slice_topology(self) -> Optional[topology.SliceTopology]:
@@ -57,8 +80,8 @@ class IciDomain:
     def host_shape(self) -> Optional[tuple]:
         """Host-grid dims of this domain's slice topology (see
         topology.host_shape). Worker index = row-major position in this
-        grid — the TPU runtime's host ordering convention, which name-sorted
-        GKE node names follow."""
+        grid — the TPU runtime's host ordering convention (host-index
+        label when present, else natural name sort)."""
         topo = self.slice_topology
         if topo is None:
             return None
@@ -95,5 +118,5 @@ def group_ici_domains(nodes: List[Node]) -> Dict[str, IciDomain]:
         domain = domains.setdefault(pool, IciDomain(pool, gen, topo))
         domain.nodes.append(node)
     for domain in domains.values():
-        domain.nodes.sort(key=lambda n: n.metadata.name)
+        domain.nodes.sort(key=host_order_key)
     return domains

@@ -405,3 +405,44 @@ def test_subcuboid_host_misaligned_topology_rejected():
         V5P, topo_mod.SliceTopology((2, 2, 2)), topo_mod.SliceTopology((4, 4)))
     assert topo_mod.is_sub_topology(
         V5P, topo_mod.SliceTopology((2, 2, 2)), topo_mod.SliceTopology((2, 2, 4)))
+
+
+def test_host_order_natural_sort_large_pool():
+    """Worker order must survive unpadded numeric suffixes: in a 16-host
+    pool, 'w2' precedes 'w10' (natural sort), and an explicit host-index
+    label overrides the name entirely."""
+    from nos_tpu.tpu.ici import group_ici_domains, host_order_key
+    nodes = [slice_host(f"pool-w{i}", "pool-a", topo="16x16") for i in range(16)]
+    import random
+    random.Random(7).shuffle(nodes)
+    domains = group_ici_domains(nodes)
+    order = [n.metadata.name for n in domains["pool-a"].nodes]
+    assert order == [f"pool-w{i}" for i in range(16)]
+
+    # label override wins over names
+    labeled = [slice_host(f"host-{c}", "pool-b", topo="4x4") for c in "ab"]
+    labeled[0].metadata.labels[constants.LABEL_TPU_HOST_INDEX] = "1"
+    labeled[1].metadata.labels[constants.LABEL_TPU_HOST_INDEX] = "0"
+    domains = group_ici_domains(labeled)
+    assert [n.metadata.name for n in domains["pool-b"].nodes] == ["host-b", "host-a"]
+
+
+def test_subcuboid_on_large_pool_uses_numeric_worker_order():
+    """End-to-end: a 2-host gang carved from a 16-host v5e 8x16 pool
+    lands on a contiguous host-grid block — not scrambled by
+    lexicographic name order (w10 < w2)."""
+    server, mgr = rig()
+    make_pool(server, "pool-a", 16, topo="8x16")    # names w0..w15, unpadded
+    for w in range(2):
+        server.create(gang_pod("edge", w, 2, topo="4x4"))
+    mgr.run_until_idle()
+    bound = [server.get("Pod", f"edge-{w}", "team-a").spec.node_name
+             for w in range(2)]
+    from nos_tpu.tpu.ici import group_ici_domains
+    domain = group_ici_domains(server.list("Node"))["pool-a"]
+    names = [n.metadata.name for n in domain.nodes]
+    shape = domain.host_shape                        # (8, 4)
+    coords = [(names.index(b) // shape[1], names.index(b) % shape[1])
+              for b in bound]
+    (r0, c0), (r1, c1) = coords
+    assert c0 == c1 and abs(r1 - r0) == 1            # contiguous block

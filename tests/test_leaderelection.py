@@ -146,3 +146,41 @@ def test_follower_does_not_lose_queued_work():
     clock.advance(20)     # m1 lease expires
     m2.run_until_idle()
     assert "early" in c2
+
+
+def test_contested_steal_conflict_one_winner():
+    """Two candidates race a genuinely concurrent takeover of an expired
+    lease: the second writer's update hits the resource-version Conflict
+    and loses. Simulated by feeding e3 the stale lease snapshot it read
+    before e2's steal landed (the interleaving a real apiserver allows)."""
+    from nos_tpu.kube.client import Client
+    server = ApiServer()
+    clock = FakeClock()
+    client = Client(server)
+    e1 = LeaderElector(client, cfg("a"), clock=clock)
+    assert e1.tick()                     # a holds the lease
+    e2 = LeaderElector(client, cfg("b"), clock=clock)
+    e3 = LeaderElector(client, cfg("c"), clock=clock)
+    e2.tick(); e3.tick()                 # both observe a's record
+    clock.advance(100)                   # a is dead; lease stale for both
+
+    stale = client.get("Lease", "nos-tpu-operator-leader", "nos-system")
+    assert e2.tick()                     # b steals (update lands)
+
+    # c read `stale` BEFORE b's update: its takeover must hit Conflict
+    class StaleGetClient:
+        def __init__(self, real, stale_obj):
+            self.real, self.stale = real, stale_obj
+
+        def get(self, *a, **k):
+            return self.stale
+
+        def __getattr__(self, name):
+            return getattr(self.real, name)
+
+    e3.client = StaleGetClient(client, stale)
+    assert e3._try_acquire_or_renew(clock()) is False
+    assert not e3.is_leader
+    lease = server.get("Lease", "nos-tpu-operator-leader", "nos-system")
+    assert lease.spec.holder_identity == "b"
+    assert lease.spec.lease_transitions == 1
