@@ -78,6 +78,20 @@ def common_flags(parser: argparse.ArgumentParser, config: bool = True) -> None:
         help="URL of the nos-tpu apiserver binary",
     )
     parser.add_argument(
+        "--kubeconfig", default=None,
+        help="kubeconfig path: run against a REAL Kubernetes API server "
+             "(GKE/kind) instead of the nos-tpu apiserver double",
+    )
+    parser.add_argument(
+        "--in-cluster", action="store_true",
+        help="use the pod service-account to reach the real API server "
+             "(the in-cluster deployment path)",
+    )
+    parser.add_argument(
+        "--kube-context", default=None,
+        help="kubeconfig context override",
+    )
+    parser.add_argument(
         "--health-port", type=int, default=0,
         help="healthz/readyz/metrics port (0 = ephemeral)",
     )
@@ -93,7 +107,21 @@ def common_flags(parser: argparse.ArgumentParser, config: bool = True) -> None:
         )
 
 
-def connect(args) -> RemoteApiServer:
+def connect(args):
+    """API-server binding per flags: --kubeconfig/--in-cluster selects the
+    real-Kubernetes REST adapter (nos_tpu.kube.rest.K8sApiServer); the
+    default is the nos-tpu apiserver double. Both duck-type the same
+    surface, so every controller runs unchanged against either."""
+    if getattr(args, "kubeconfig", None) or getattr(args, "in_cluster", False):
+        from nos_tpu.kube.rest import K8sApiServer
+
+        remote = K8sApiServer(
+            kubeconfig=getattr(args, "kubeconfig", None),
+            context=getattr(args, "kube_context", None),
+        )
+        if not remote.healthz():
+            raise SystemExit("real API server is not reachable/ready")
+        return remote
     remote = RemoteApiServer(args.api)
     if not remote.healthz():
         raise SystemExit(f"apiserver at {args.api} is not reachable")

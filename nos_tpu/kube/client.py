@@ -1,9 +1,15 @@
 """Client facade over the API server.
 
 Controllers are written against ``Client`` (the reference writes against
-controller-runtime's client.Client). Binding it to the in-process
-``ApiServer`` gives the envtest-equivalent test rig; a production binding
-would speak to a real API server with the same surface.
+controller-runtime's client.Client). Three bindings exist behind this
+seam, all duck-typing the same surface:
+
+- the in-process ``ApiServer`` (envtest-equivalent test rig);
+- ``httpapi.RemoteApiServer`` (the nos-tpu apiserver binary's wire);
+- ``rest.K8sApiServer`` — the PRODUCTION binding: a real Kubernetes API
+  server via kubeconfig/in-cluster auth, native k8s manifests, watch
+  streams, and the /status + /binding subresources (cmd/ binaries select
+  it with --kubeconfig or --in-cluster).
 """
 from __future__ import annotations
 

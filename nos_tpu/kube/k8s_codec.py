@@ -1,0 +1,503 @@
+"""Kubernetes-native JSON codec: typed dataclasses <-> real k8s manifests.
+
+The in-process double moves objects in its own snake_case wire format
+(``nos_tpu.kube.serial``); a REAL kube-apiserver speaks camelCase k8s
+schemas with string resource quantities ("8", "500m", "64Mi"), string
+resourceVersions, and RFC3339 timestamps. This module is the translation
+layer under ``nos_tpu.kube.rest.K8sApiServer`` — the binding the
+reference gets for free from controller-runtime's typed clients
+(cmd/operator/operator.go:76 ctrl.NewManager).
+
+Covered kinds: Pod, Node, ConfigMap, ElasticQuota, CompositeElasticQuota
+(nos.ai/v1alpha1 CRDs), Lease (coordination.k8s.io/v1).
+"""
+from __future__ import annotations
+
+import datetime
+import re
+from typing import Dict, Optional, Tuple
+
+from nos_tpu.api.quota import (
+    CompositeElasticQuota,
+    CompositeElasticQuotaSpec,
+    ElasticQuota,
+    ElasticQuotaSpec,
+    ElasticQuotaStatus,
+)
+from nos_tpu.kube.leaderelection import Lease, LeaseSpec
+from nos_tpu.kube.objects import (
+    Affinity,
+    ConfigMap,
+    Container,
+    Node,
+    NodeSelectorRequirement,
+    NodeSelectorTerm,
+    NodeSpec,
+    NodeStatus,
+    ObjectMeta,
+    OwnerReference,
+    Pod,
+    PodCondition,
+    PodSpec,
+    PodStatus,
+    Taint,
+    Toleration,
+)
+
+# ---------------------------------------------------------------------------
+# kind routing: KIND -> (apiVersion, plural, namespaced)
+# ---------------------------------------------------------------------------
+
+GROUP_CRD = "nos.ai"
+
+ROUTES: Dict[str, Tuple[str, str, bool]] = {
+    "Pod": ("v1", "pods", True),
+    "Node": ("v1", "nodes", False),
+    "ConfigMap": ("v1", "configmaps", True),
+    "ElasticQuota": (f"{GROUP_CRD}/v1alpha1", "elasticquotas", True),
+    "CompositeElasticQuota": (f"{GROUP_CRD}/v1alpha1", "compositeelasticquotas", True),
+    "Lease": ("coordination.k8s.io/v1", "leases", True),
+}
+
+
+def api_path(kind: str, namespace: str = "", name: str = "") -> str:
+    """REST path for a kind: /api/v1/... for core, /apis/{group}/... else."""
+    api_version, plural, namespaced = ROUTES[kind]
+    if "/" in api_version:
+        base = f"/apis/{api_version}"
+    else:
+        base = f"/api/{api_version}"
+    if namespaced and namespace:
+        base += f"/namespaces/{namespace}"
+    base += f"/{plural}"
+    if name:
+        base += f"/{name}"
+    return base
+
+
+# ---------------------------------------------------------------------------
+# quantities
+# ---------------------------------------------------------------------------
+
+def parse_quantity(s) -> float:
+    """k8s resource.Quantity -> number ('8'->8, '500m'->0.5, '64Mi'->
+    67108864). Full suffix table lives in nos_tpu.kube.quantity."""
+    from nos_tpu.kube.quantity import parse_quantity as _parse
+
+    v = _parse(s)
+    return int(v) if v == int(v) else v
+
+
+def format_quantity(v) -> str:
+    if isinstance(v, float) and v != int(v):
+        millis = v * 1000
+        if millis == int(millis):
+            return f"{int(millis)}m"
+        return repr(v)  # k8s accepts plain decimal strings
+    return str(int(v))
+
+
+def _resources_to_k8s(r: Dict[str, float]) -> Dict[str, str]:
+    return {k: format_quantity(v) for k, v in r.items()}
+
+
+def _resources_from_k8s(r: Optional[Dict[str, str]]) -> Dict[str, float]:
+    return {k: parse_quantity(v) for k, v in (r or {}).items()}
+
+
+# ---------------------------------------------------------------------------
+# timestamps
+# ---------------------------------------------------------------------------
+
+def _ts_to_k8s(t: float) -> Optional[str]:
+    if not t:
+        return None
+    return datetime.datetime.fromtimestamp(
+        t, tz=datetime.timezone.utc
+    ).strftime("%Y-%m-%dT%H:%M:%SZ")
+
+
+def _micro_ts_to_k8s(t: float) -> Optional[str]:
+    if not t:
+        return None
+    return datetime.datetime.fromtimestamp(
+        t, tz=datetime.timezone.utc
+    ).strftime("%Y-%m-%dT%H:%M:%S.%fZ")
+
+
+def _ts_from_k8s(s) -> float:
+    if not s:
+        return 0.0
+    s = str(s).replace("Z", "+00:00")
+    return datetime.datetime.fromisoformat(s).timestamp()
+
+
+# ---------------------------------------------------------------------------
+# metadata
+# ---------------------------------------------------------------------------
+
+def _meta_to_k8s(m: ObjectMeta) -> dict:
+    out: dict = {"name": m.name}
+    if m.namespace:
+        out["namespace"] = m.namespace
+    if m.uid:
+        out["uid"] = m.uid
+    if m.resource_version:
+        out["resourceVersion"] = str(m.resource_version)
+    if m.creation_timestamp:
+        out["creationTimestamp"] = _ts_to_k8s(m.creation_timestamp)
+    if m.labels:
+        out["labels"] = dict(m.labels)
+    if m.annotations:
+        out["annotations"] = dict(m.annotations)
+    if m.owner_references:
+        out["ownerReferences"] = [
+            {"kind": o.kind, "name": o.name, "uid": o.uid,
+             "controller": o.controller, "apiVersion": "v1"}
+            for o in m.owner_references
+        ]
+    return out
+
+
+def _rv_from_k8s(s) -> int:
+    try:
+        return int(s)
+    except (TypeError, ValueError):
+        return 0
+
+
+def _meta_from_k8s(d: dict) -> ObjectMeta:
+    return ObjectMeta(
+        name=d.get("name", ""),
+        namespace=d.get("namespace", ""),
+        uid=d.get("uid", ""),
+        resource_version=_rv_from_k8s(d.get("resourceVersion")),
+        creation_timestamp=_ts_from_k8s(d.get("creationTimestamp")),
+        labels=dict(d.get("labels") or {}),
+        annotations=dict(d.get("annotations") or {}),
+        owner_references=[
+            OwnerReference(kind=o.get("kind", ""), name=o.get("name", ""),
+                           uid=o.get("uid", ""),
+                           controller=bool(o.get("controller")))
+            for o in (d.get("ownerReferences") or [])
+        ],
+    )
+
+
+# ---------------------------------------------------------------------------
+# Pod
+# ---------------------------------------------------------------------------
+
+def _container_to_k8s(c: Container) -> dict:
+    out: dict = {"name": c.name or "main"}
+    if c.image:
+        out["image"] = c.image
+    res = {}
+    if c.requests:
+        res["requests"] = _resources_to_k8s(c.requests)
+    if c.limits:
+        res["limits"] = _resources_to_k8s(c.limits)
+    if res:
+        out["resources"] = res
+    return out
+
+
+def _container_from_k8s(d: dict) -> Container:
+    res = d.get("resources") or {}
+    return Container(
+        name=d.get("name", "main"),
+        image=d.get("image", ""),
+        requests=_resources_from_k8s(res.get("requests")),
+        limits=_resources_from_k8s(res.get("limits")),
+    )
+
+
+def _affinity_to_k8s(a: Optional[Affinity]) -> Optional[dict]:
+    if a is None or not a.node_affinity_required:
+        return None
+    return {
+        "nodeAffinity": {
+            "requiredDuringSchedulingIgnoredDuringExecution": {
+                "nodeSelectorTerms": [
+                    {"matchExpressions": [
+                        {"key": r.key, "operator": r.operator,
+                         **({"values": list(r.values)} if r.values else {})}
+                        for r in t.match_expressions
+                    ]}
+                    for t in a.node_affinity_required
+                ]
+            }
+        }
+    }
+
+
+def _affinity_from_k8s(d: Optional[dict]) -> Optional[Affinity]:
+    if not d:
+        return None
+    sel = ((d.get("nodeAffinity") or {})
+           .get("requiredDuringSchedulingIgnoredDuringExecution") or {})
+    terms = sel.get("nodeSelectorTerms") or []
+    if not terms:
+        return None
+    return Affinity(node_affinity_required=[
+        NodeSelectorTerm(match_expressions=[
+            NodeSelectorRequirement(
+                key=e.get("key", ""), operator=e.get("operator", "In"),
+                values=list(e.get("values") or []))
+            for e in (t.get("matchExpressions") or [])
+        ])
+        for t in terms
+    ])
+
+
+def pod_to_k8s(p: Pod) -> dict:
+    spec: dict = {
+        "containers": [_container_to_k8s(c) for c in p.spec.containers],
+    }
+    if p.spec.init_containers:
+        spec["initContainers"] = [
+            _container_to_k8s(c) for c in p.spec.init_containers]
+    if p.spec.node_name:
+        spec["nodeName"] = p.spec.node_name
+    if p.spec.scheduler_name:
+        spec["schedulerName"] = p.spec.scheduler_name
+    if p.spec.priority is not None:
+        spec["priority"] = p.spec.priority
+    if p.spec.priority_class_name:
+        spec["priorityClassName"] = p.spec.priority_class_name
+    if p.spec.node_selector:
+        spec["nodeSelector"] = dict(p.spec.node_selector)
+    if p.spec.tolerations:
+        spec["tolerations"] = [
+            {k: v for k, v in (
+                ("key", t.key), ("operator", t.operator),
+                ("value", t.value), ("effect", t.effect)) if v}
+            for t in p.spec.tolerations
+        ]
+    aff = _affinity_to_k8s(p.spec.affinity)
+    if aff:
+        spec["affinity"] = aff
+    status: dict = {"phase": p.status.phase}
+    if p.status.conditions:
+        status["conditions"] = [
+            {"type": c.type, "status": c.status,
+             **({"reason": c.reason} if c.reason else {}),
+             **({"message": c.message} if c.message else {})}
+            for c in p.status.conditions
+        ]
+    if p.status.nominated_node_name:
+        status["nominatedNodeName"] = p.status.nominated_node_name
+    return {
+        "apiVersion": "v1", "kind": "Pod",
+        "metadata": _meta_to_k8s(p.metadata),
+        "spec": spec, "status": status,
+    }
+
+
+def pod_from_k8s(d: dict) -> Pod:
+    spec = d.get("spec") or {}
+    status = d.get("status") or {}
+    return Pod(
+        metadata=_meta_from_k8s(d.get("metadata") or {}),
+        spec=PodSpec(
+            containers=[_container_from_k8s(c)
+                        for c in (spec.get("containers") or [])],
+            init_containers=[_container_from_k8s(c)
+                             for c in (spec.get("initContainers") or [])],
+            node_name=spec.get("nodeName", ""),
+            scheduler_name=spec.get("schedulerName", "default-scheduler"),
+            priority=spec.get("priority"),
+            priority_class_name=spec.get("priorityClassName", ""),
+            node_selector=dict(spec.get("nodeSelector") or {}),
+            tolerations=[
+                Toleration(key=t.get("key", ""),
+                           operator=t.get("operator", "Equal"),
+                           value=t.get("value", ""),
+                           effect=t.get("effect", ""))
+                for t in (spec.get("tolerations") or [])
+            ],
+            affinity=_affinity_from_k8s(spec.get("affinity")),
+        ),
+        status=PodStatus(
+            phase=status.get("phase", "Pending"),
+            conditions=[
+                PodCondition(type=c.get("type", ""), status=c.get("status", ""),
+                             reason=c.get("reason", ""),
+                             message=c.get("message", ""))
+                for c in (status.get("conditions") or [])
+            ],
+            nominated_node_name=status.get("nominatedNodeName", ""),
+        ),
+    )
+
+
+# ---------------------------------------------------------------------------
+# Node / ConfigMap
+# ---------------------------------------------------------------------------
+
+def node_to_k8s(n: Node) -> dict:
+    spec: dict = {}
+    if n.spec.taints:
+        spec["taints"] = [
+            {k: v for k, v in (("key", t.key), ("value", t.value),
+                               ("effect", t.effect)) if v}
+            for t in n.spec.taints
+        ]
+    if n.spec.unschedulable:
+        spec["unschedulable"] = True
+    return {
+        "apiVersion": "v1", "kind": "Node",
+        "metadata": _meta_to_k8s(n.metadata),
+        "spec": spec,
+        "status": {
+            "capacity": _resources_to_k8s(n.status.capacity),
+            "allocatable": _resources_to_k8s(n.status.allocatable),
+        },
+    }
+
+
+def node_from_k8s(d: dict) -> Node:
+    spec = d.get("spec") or {}
+    status = d.get("status") or {}
+    return Node(
+        metadata=_meta_from_k8s(d.get("metadata") or {}),
+        spec=NodeSpec(
+            taints=[Taint(key=t.get("key", ""), value=t.get("value", ""),
+                          effect=t.get("effect", "NoSchedule"))
+                    for t in (spec.get("taints") or [])],
+            unschedulable=bool(spec.get("unschedulable")),
+        ),
+        status=NodeStatus(
+            capacity=_resources_from_k8s(status.get("capacity")),
+            allocatable=_resources_from_k8s(status.get("allocatable")),
+        ),
+    )
+
+
+def configmap_to_k8s(c: ConfigMap) -> dict:
+    return {
+        "apiVersion": "v1", "kind": "ConfigMap",
+        "metadata": _meta_to_k8s(c.metadata),
+        "data": dict(c.data),
+    }
+
+
+def configmap_from_k8s(d: dict) -> ConfigMap:
+    return ConfigMap(
+        metadata=_meta_from_k8s(d.get("metadata") or {}),
+        data=dict(d.get("data") or {}),
+    )
+
+
+# ---------------------------------------------------------------------------
+# ElasticQuota CRDs
+# ---------------------------------------------------------------------------
+
+def _eq_to_k8s(q, kind: str) -> dict:
+    spec: dict = {"min": _resources_to_k8s(q.spec.min)}
+    if q.spec.max is not None:
+        spec["max"] = _resources_to_k8s(q.spec.max)
+    if kind == "CompositeElasticQuota":
+        spec["namespaces"] = list(q.spec.namespaces)
+    return {
+        "apiVersion": f"{GROUP_CRD}/v1alpha1", "kind": kind,
+        "metadata": _meta_to_k8s(q.metadata),
+        "spec": spec,
+        "status": {"used": _resources_to_k8s(q.status.used)},
+    }
+
+
+def eq_from_k8s(d: dict) -> ElasticQuota:
+    spec = d.get("spec") or {}
+    return ElasticQuota(
+        metadata=_meta_from_k8s(d.get("metadata") or {}),
+        spec=ElasticQuotaSpec(
+            min=_resources_from_k8s(spec.get("min")),
+            max=_resources_from_k8s(spec.get("max")) if "max" in spec else None,
+        ),
+        status=ElasticQuotaStatus(
+            used=_resources_from_k8s((d.get("status") or {}).get("used"))),
+    )
+
+
+def ceq_from_k8s(d: dict) -> CompositeElasticQuota:
+    spec = d.get("spec") or {}
+    return CompositeElasticQuota(
+        metadata=_meta_from_k8s(d.get("metadata") or {}),
+        spec=CompositeElasticQuotaSpec(
+            namespaces=list(spec.get("namespaces") or []),
+            min=_resources_from_k8s(spec.get("min")),
+            max=_resources_from_k8s(spec.get("max")) if "max" in spec else None,
+        ),
+        status=ElasticQuotaStatus(
+            used=_resources_from_k8s((d.get("status") or {}).get("used"))),
+    )
+
+
+# ---------------------------------------------------------------------------
+# Lease (coordination.k8s.io/v1; renew/acquire are MicroTime)
+# ---------------------------------------------------------------------------
+
+def lease_to_k8s(le: Lease) -> dict:
+    spec: dict = {}
+    if le.spec.holder_identity:
+        spec["holderIdentity"] = le.spec.holder_identity
+    spec["leaseDurationSeconds"] = int(le.spec.lease_duration_seconds)
+    if le.spec.acquire_time:
+        spec["acquireTime"] = _micro_ts_to_k8s(le.spec.acquire_time)
+    if le.spec.renew_time:
+        spec["renewTime"] = _micro_ts_to_k8s(le.spec.renew_time)
+    spec["leaseTransitions"] = int(le.spec.lease_transitions)
+    return {
+        "apiVersion": "coordination.k8s.io/v1", "kind": "Lease",
+        "metadata": _meta_to_k8s(le.metadata),
+        "spec": spec,
+    }
+
+
+def lease_from_k8s(d: dict) -> Lease:
+    spec = d.get("spec") or {}
+    return Lease(
+        metadata=_meta_from_k8s(d.get("metadata") or {}),
+        spec=LeaseSpec(
+            holder_identity=spec.get("holderIdentity", ""),
+            lease_duration_seconds=float(spec.get("leaseDurationSeconds", 15)),
+            acquire_time=_ts_from_k8s(spec.get("acquireTime")),
+            renew_time=_ts_from_k8s(spec.get("renewTime")),
+            lease_transitions=int(spec.get("leaseTransitions", 0)),
+        ),
+    )
+
+
+# ---------------------------------------------------------------------------
+# dispatch
+# ---------------------------------------------------------------------------
+
+_TO = {
+    "Pod": pod_to_k8s,
+    "Node": node_to_k8s,
+    "ConfigMap": configmap_to_k8s,
+    "ElasticQuota": lambda q: _eq_to_k8s(q, "ElasticQuota"),
+    "CompositeElasticQuota": lambda q: _eq_to_k8s(q, "CompositeElasticQuota"),
+    "Lease": lease_to_k8s,
+}
+
+_FROM = {
+    "Pod": pod_from_k8s,
+    "Node": node_from_k8s,
+    "ConfigMap": configmap_from_k8s,
+    "ElasticQuota": eq_from_k8s,
+    "CompositeElasticQuota": ceq_from_k8s,
+    "Lease": lease_from_k8s,
+}
+
+
+def to_k8s(obj) -> dict:
+    return _TO[obj.KIND](obj)
+
+
+def from_k8s(d: dict):
+    kind = d.get("kind", "")
+    if kind not in _FROM:
+        raise ValueError(f"unsupported kind {kind!r}")
+    return _FROM[kind](d)

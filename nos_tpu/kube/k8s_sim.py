@@ -1,0 +1,408 @@
+"""kube-apiserver emulator — the envtest analog for this repo.
+
+The reference's integration tier boots envtest (a real kube-apiserver +
+etcd; internal/controllers/elasticquota/suite_int_test.go:58-60). No
+container runtime exists in this build environment, so this module
+provides the same role: an HTTP server speaking the REAL Kubernetes REST
+conventions — paths, camelCase JSON, string resourceVersions, 409
+semantics, /status and /binding subresources, bearer-token auth, chunked
+``?watch=true`` streams, CRD registration — so ``K8sApiServer`` (the
+production REST adapter) is exercised over a genuine wire. Controllers
+tested against this sim run unmodified against kind/GKE because the
+adapter's request shapes are real k8s requests.
+
+Fidelity points deliberately mirrored from a real apiserver:
+
+- main-endpoint PUT on a Pod IGNORES status changes (status is a
+  subresource) and REJECTS spec.nodeName changes (422; binding is the
+  only way to schedule);
+- POST .../pods/{name}/binding sets spec.nodeName once (409 if bound);
+- PUT with a stale metadata.resourceVersion -> 409 Conflict;
+- POST of an existing name -> 409 with an "already exists" message;
+- every write bumps a single global resourceVersion counter (etcd-like)
+  and appends to the watch log; watches resume from ?resourceVersion=N.
+"""
+from __future__ import annotations
+
+import copy
+import itertools
+import json
+import re
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Dict, List, Optional, Tuple
+
+# (group, version, resource) routing; "" group = core /api/v1
+_CORE = {"pods", "nodes", "configmaps", "namespaces", "events"}
+
+_PATH_RE = re.compile(
+    r"^/(?:api/(?P<core_version>v1)|apis/(?P<group>[^/]+)/(?P<version>[^/]+))"
+    r"(?:/namespaces/(?P<namespace>[^/]+))?"
+    r"/(?P<resource>[^/]+)"
+    r"(?:/(?P<name>[^/]+))?"
+    r"(?:/(?P<subresource>status|binding))?$"
+)
+
+
+class _Store:
+    def __init__(self):
+        self.lock = threading.Condition()
+        self.rv = itertools.count(1)
+        # (group, resource, namespace, name) -> dict
+        self.objects: Dict[Tuple[str, str, str, str], dict] = {}
+        # append-only watch log: (rv, type, group, resource, obj-copy)
+        self.log: List[Tuple[int, str, str, str, dict]] = []
+
+    def bump(self, obj: dict) -> int:
+        rv = next(self.rv)
+        obj.setdefault("metadata", {})["resourceVersion"] = str(rv)
+        return rv
+
+    def emit(self, etype: str, group: str, resource: str, obj: dict) -> None:
+        rv = int(obj["metadata"]["resourceVersion"])
+        self.log.append((rv, etype, group, resource, copy.deepcopy(obj)))
+        self.lock.notify_all()
+
+
+class K8sSim:
+    """Threaded HTTP server emulating the kube-apiserver surface."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0,
+                 token: Optional[str] = None):
+        self.store = _Store()
+        self.token = token
+        self._uid = itertools.count(1)
+        sim = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, fmt, *args):
+                pass
+
+            def _deny(self, code: int, reason: str, message: str) -> None:
+                body = json.dumps({
+                    "kind": "Status", "apiVersion": "v1", "status": "Failure",
+                    "reason": reason, "message": message, "code": code,
+                }).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def _ok(self, payload: dict, code: int = 200) -> None:
+                body = json.dumps(payload).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def _authed(self) -> bool:
+                if sim.token is None:
+                    return True
+                if self.headers.get("Authorization") == f"Bearer {sim.token}":
+                    return True
+                self._deny(401, "Unauthorized", "invalid bearer token")
+                return False
+
+            def _body(self) -> dict:
+                n = int(self.headers.get("Content-Length") or 0)
+                return json.loads(self.rfile.read(n) or b"{}")
+
+            def do_GET(self):
+                if not self._authed():
+                    return
+                if self.path in ("/readyz", "/healthz", "/livez"):
+                    self.send_response(200)
+                    self.send_header("Content-Length", "2")
+                    self.end_headers()
+                    self.wfile.write(b"ok")
+                    return
+                sim._get(self)
+
+            def do_POST(self):
+                if self._authed():
+                    sim._post(self)
+
+            def do_PUT(self):
+                if self._authed():
+                    sim._put(self)
+
+            def do_DELETE(self):
+                if self._authed():
+                    sim._delete(self)
+
+        self.httpd = ThreadingHTTPServer((host, port), Handler)
+        self.httpd.daemon_threads = True
+        self._thread: Optional[threading.Thread] = None
+
+    # ------------------------------------------------------------------
+    @property
+    def url(self) -> str:
+        h, p = self.httpd.server_address[:2]
+        return f"http://{h}:{p}"
+
+    def start(self) -> "K8sSim":
+        self._thread = threading.Thread(
+            target=self.httpd.serve_forever, daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self.httpd.shutdown()
+        self.httpd.server_close()
+
+    # ------------------------------------------------------------------
+    @staticmethod
+    def _parse(path: str):
+        q = ""
+        if "?" in path:
+            path, q = path.split("?", 1)
+        m = _PATH_RE.match(path)
+        if m is None:
+            return None, {}
+        parts = m.groupdict()
+        if parts["core_version"]:
+            parts["group"] = ""
+        params = dict(
+            kv.split("=", 1) if "=" in kv else (kv, "")
+            for kv in q.split("&") if kv
+        )
+        return parts, params
+
+    def _key(self, parts, name=None):
+        return (parts["group"] or "", parts["resource"],
+                parts["namespace"] or "", name or parts["name"])
+
+    @staticmethod
+    def _kind_guess(resource: str, obj: dict) -> str:
+        return obj.get("kind") or resource[:-1].capitalize()
+
+    @staticmethod
+    def _label_match(obj: dict, selector: str) -> bool:
+        labels = (obj.get("metadata") or {}).get("labels") or {}
+        import urllib.parse as up
+
+        for clause in up.unquote(selector).split(","):
+            if not clause:
+                continue
+            if "=" in clause:
+                k, v = clause.split("=", 1)
+                if labels.get(k) != v:
+                    return False
+            elif clause not in labels:
+                return False
+        return True
+
+    # -- GET -----------------------------------------------------------
+    def _get(self, h) -> None:
+        parts, params = self._parse(h.path)
+        if parts is None:
+            h._deny(404, "NotFound", f"unknown path {h.path}")
+            return
+        if params.get("watch") in ("true", "1"):
+            self._serve_watch(h, parts, params)
+            return
+        with self.store.lock:
+            if parts["name"]:
+                obj = self.store.objects.get(self._key(parts))
+                if obj is None:
+                    h._deny(404, "NotFound",
+                            f"{parts['resource']} {parts['name']} not found")
+                    return
+                h._ok(copy.deepcopy(obj))
+                return
+            sel = params.get("labelSelector", "")
+            items = [
+                copy.deepcopy(o)
+                for (g, r, ns, _), o in sorted(self.store.objects.items())
+                if g == (parts["group"] or "") and r == parts["resource"]
+                and (not parts["namespace"] or ns == parts["namespace"])
+                and (not sel or self._label_match(o, sel))
+            ]
+            latest = str(max(
+                [int(o["metadata"]["resourceVersion"]) for o in items],
+                default=self._current_rv()))
+            h._ok({
+                "apiVersion": "v1",
+                "kind": "List",
+                "metadata": {"resourceVersion": latest},
+                "items": items,
+            })
+
+    def _current_rv(self) -> int:
+        return self.store.log[-1][0] if self.store.log else 0
+
+    def _serve_watch(self, h, parts, params) -> None:
+        since = int(params.get("resourceVersion") or 0)
+        group = parts["group"] or ""
+        resource = parts["resource"]
+        h.send_response(200)
+        h.send_header("Content-Type", "application/json")
+        h.send_header("Transfer-Encoding", "chunked")
+        h.end_headers()
+
+        def send_line(payload: dict) -> bool:
+            data = json.dumps(payload).encode() + b"\n"
+            try:
+                h.wfile.write(f"{len(data):x}\r\n".encode() + data + b"\r\n")
+                h.wfile.flush()
+                return True
+            except (BrokenPipeError, ConnectionResetError, OSError):
+                return False
+
+        idx = 0
+        deadline = time.monotonic() + 300
+        while time.monotonic() < deadline:
+            with self.store.lock:
+                while idx < len(self.store.log):
+                    rv, etype, g, r, obj = self.store.log[idx]
+                    idx += 1
+                    if g != group or r != resource or rv <= since:
+                        continue
+                    if parts["namespace"] and \
+                            (obj.get("metadata") or {}).get("namespace") != parts["namespace"]:
+                        continue
+                    if not send_line({"type": etype, "object": obj}):
+                        return
+                if not self.store.lock.wait(timeout=1.0):
+                    continue
+        try:
+            h.wfile.write(b"0\r\n\r\n")
+        except OSError:
+            pass
+
+    # -- POST ----------------------------------------------------------
+    def _post(self, h) -> None:
+        parts, _ = self._parse(h.path)
+        if parts is None:
+            h._deny(404, "NotFound", f"unknown path {h.path}")
+            return
+        body = h._body()
+        if parts["subresource"] == "binding":
+            self._bind(h, parts, body)
+            return
+        if parts["group"] == "apiextensions.k8s.io" \
+                and parts["resource"] == "customresourcedefinitions":
+            # store CRDs like any object (no schema enforcement, as envtest
+            # without validation webhooks)
+            parts = dict(parts, namespace=None, name=None)
+        name = (body.get("metadata") or {}).get("name")
+        if not name:
+            h._deny(422, "Invalid", "metadata.name required")
+            return
+        with self.store.lock:
+            key = self._key(parts, name)
+            if key in self.store.objects:
+                h._deny(409, "AlreadyExists",
+                        f'{parts["resource"]} "{name}" already exists')
+                return
+            meta = body.setdefault("metadata", {})
+            if parts["namespace"]:
+                meta["namespace"] = parts["namespace"]
+            meta["uid"] = f"sim-uid-{next(self._uid)}"
+            meta.setdefault(
+                "creationTimestamp",
+                time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()))
+            self.store.bump(body)
+            self.store.objects[key] = copy.deepcopy(body)
+            self.store.emit("ADDED", parts["group"] or "",
+                            parts["resource"], body)
+            h._ok(copy.deepcopy(body), code=201)
+
+    def _bind(self, h, parts, body) -> None:
+        with self.store.lock:
+            key = (parts["group"] or "", parts["resource"],
+                   parts["namespace"] or "", parts["name"])
+            obj = self.store.objects.get(key)
+            if obj is None:
+                h._deny(404, "NotFound", f"pod {parts['name']} not found")
+                return
+            if (obj.get("spec") or {}).get("nodeName"):
+                h._deny(409, "Conflict",
+                        f"pod {parts['name']} is already assigned to a node")
+                return
+            target = (body.get("target") or {}).get("name")
+            if not target:
+                h._deny(422, "Invalid", "binding target.name required")
+                return
+            obj.setdefault("spec", {})["nodeName"] = target
+            self.store.bump(obj)
+            self.store.emit("MODIFIED", parts["group"] or "",
+                            parts["resource"], obj)
+            h._ok({"kind": "Status", "status": "Success"})
+
+    # -- PUT -----------------------------------------------------------
+    def _put(self, h) -> None:
+        parts, _ = self._parse(h.path)
+        if parts is None or not parts["name"]:
+            h._deny(404, "NotFound", f"unknown path {h.path}")
+            return
+        body = h._body()
+        with self.store.lock:
+            key = self._key(parts)
+            current = self.store.objects.get(key)
+            if current is None:
+                h._deny(404, "NotFound", f"{parts['name']} not found")
+                return
+            sent_rv = (body.get("metadata") or {}).get("resourceVersion")
+            if sent_rv and sent_rv != current["metadata"]["resourceVersion"]:
+                h._deny(
+                    409, "Conflict",
+                    f"Operation cannot be fulfilled on {parts['resource']} "
+                    f"\"{parts['name']}\": the object has been modified")
+                return
+            if parts["subresource"] == "status":
+                current["status"] = body.get("status") or {}
+            else:
+                is_pod = parts["resource"] == "pods" and not parts["group"]
+                if is_pod:
+                    old_node = (current.get("spec") or {}).get("nodeName", "")
+                    new_node = (body.get("spec") or {}).get("nodeName", "")
+                    if old_node and new_node != old_node:
+                        h._deny(422, "Invalid",
+                                "spec.nodeName: Forbidden: pod updates may "
+                                "not change fields other than allowed ones")
+                        return
+                    if new_node and not old_node:
+                        h._deny(422, "Invalid",
+                                "spec.nodeName: Forbidden: use the Binding "
+                                "subresource to assign a pod to a node")
+                        return
+                preserved_status = current.get("status")
+                preserved_meta = {
+                    "uid": current["metadata"].get("uid"),
+                    "creationTimestamp":
+                        current["metadata"].get("creationTimestamp"),
+                    "namespace": current["metadata"].get("namespace"),
+                }
+                current.update(copy.deepcopy(body))
+                current["metadata"].update(
+                    {k: v for k, v in preserved_meta.items() if v})
+                if parts["resource"] == "pods":
+                    # status is a subresource on the main endpoint
+                    current["status"] = preserved_status or {}
+            self.store.bump(current)
+            self.store.emit("MODIFIED", parts["group"] or "",
+                            parts["resource"], current)
+            h._ok(copy.deepcopy(current))
+
+    # -- DELETE --------------------------------------------------------
+    def _delete(self, h) -> None:
+        parts, _ = self._parse(h.path)
+        if parts is None or not parts["name"]:
+            h._deny(404, "NotFound", f"unknown path {h.path}")
+            return
+        with self.store.lock:
+            key = self._key(parts)
+            obj = self.store.objects.pop(key, None)
+            if obj is None:
+                h._deny(404, "NotFound", f"{parts['name']} not found")
+                return
+            self.store.bump(obj)
+            self.store.emit("DELETED", parts["group"] or "",
+                            parts["resource"], obj)
+            h._ok({"kind": "Status", "status": "Success"})
