@@ -51,6 +51,43 @@ TIMED_STEPS = 10
 MFU_BAR = 40.0  # % — the target this rebuild is held to (VERDICT r1 #2)
 
 
+class ImplausibleMeasurement(RuntimeError):
+    """The bench produced numbers that violate hardware physics. Raised
+    instead of publishing: round 2 shipped 380,935% MFU because the
+    timing fence silently no-opped (VERDICT r2 weak #1); this guard makes
+    that class of failure loud."""
+
+
+def validate_mfu(m: dict) -> None:
+    """Refuse implausible physics. m is the dict bench_mfu.run_mfu builds.
+    Checks: 0 < MFU <= 100 (no chip exceeds its own peak); achieved
+    TFLOP/s <= peak; tokens/s consistent with step time. Unknown device
+    kinds (peak is None) only get the consistency check."""
+    problems = []
+    peak = m.get("peak_tflops")
+    mfu = m.get("mfu_pct")
+    if peak:
+        if mfu is None or not (0 < mfu <= 100):
+            problems.append(f"MFU {mfu}% outside (0, 100]")
+        tfl = m.get("model_tflops_per_s", 0)
+        if tfl > peak:
+            problems.append(
+                f"achieved {tfl} TFLOP/s exceeds peak {peak} TFLOP/s")
+    dt = m.get("step_time_s", 0)
+    if dt <= 0:
+        problems.append(f"non-positive step time {dt}s")
+    else:
+        expect_tps = BATCH * SEQ / dt
+        tps = m.get("tokens_per_s", 0)
+        if abs(tps - expect_tps) > 0.05 * expect_tps + 1:
+            problems.append(
+                f"tokens_per_s {tps} inconsistent with step_time_s {dt}")
+    if problems:
+        raise ImplausibleMeasurement(
+            "refusing to publish: " + "; ".join(problems)
+            + f" [platform={m.get('platform')}, fence={m.get('timing_fence')}]")
+
+
 def model_flops_per_step(cfg, batch, seq):
     """Analytic matmul FLOPs of one fwd+bwd step (bwd = 2x fwd). Attention
     is counted at full S^2 (the flash kernel actually skips masked blocks,
@@ -77,8 +114,15 @@ def run_mfu():
         capture_output=True, text=True, timeout=MFU_TIMEOUT_S,
     )
     if proc.returncode != 0:
-        raise RuntimeError(f"bench_mfu failed: {proc.stderr.strip()[-300:]}")
-    return json.loads(proc.stdout.strip().splitlines()[-1])
+        err = proc.stderr.strip()
+        if "ImplausibleMeasurement" in err:
+            # do NOT degrade to the sched-only fallback: the TPU answered,
+            # the numbers are garbage — the run must fail loudly
+            raise ImplausibleMeasurement(err[-500:])
+        raise RuntimeError(f"bench_mfu failed: {err[-300:]}")
+    mfu = json.loads(proc.stdout.strip().splitlines()[-1])
+    validate_mfu(mfu)  # belt-and-braces: subprocess validated too
+    return mfu
 
 
 def main():
@@ -94,6 +138,9 @@ def main():
 
     try:
         mfu = run_mfu()
+    except ImplausibleMeasurement as e:
+        print(f"BENCH FAILED (implausible physics): {e}", file=sys.stderr)
+        sys.exit(1)
     except Exception as e:  # TPU unreachable / compile failure
         sched["mfu_error"] = f"{type(e).__name__}: {e}"[:200]
         print(json.dumps(sched))

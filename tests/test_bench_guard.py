@@ -1,0 +1,102 @@
+"""The bench must be un-fakeable: round 2 published 380,935% MFU because
+jax.block_until_ready is a no-op on the experimental 'axon' platform and
+bench.py had no physics guard (VERDICT r2 weak #1). These tests pin the
+guard so that failure class can never ship again."""
+import sys
+from pathlib import Path
+
+import pytest
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+import bench  # noqa: E402
+
+
+def _honest():
+    # the judge's re-measured round-2 truth (VERDICT r2: 1.70 s/step)
+    return {
+        "platform": "tpu",
+        "device": "TPU v5 lite",
+        "timing_fence": "device_to_host_transfer",
+        "step_time_s": 1.7103,
+        "tokens_per_s": 9580,
+        "model_tflops_per_s": 67.4,
+        "peak_tflops": 197.0,
+        "mfu_pct": 34.2,
+    }
+
+
+def test_honest_measurement_passes():
+    bench.validate_mfu(_honest())
+
+
+def test_r02_published_garbage_is_refused():
+    # verbatim from BENCH_r02.json — the artifact this guard exists for
+    garbage = {
+        "platform": "tpu",
+        "device": "TPU v5 lite",
+        "step_time_s": 0.0002,
+        "tokens_per_s": 106642644,
+        "model_tflops_per_s": 750443.6,
+        "peak_tflops": 197.0,
+        "mfu_pct": 380935.8,
+    }
+    with pytest.raises(bench.ImplausibleMeasurement, match="outside"):
+        bench.validate_mfu(garbage)
+
+
+def test_mfu_over_100_refused():
+    m = _honest()
+    m["mfu_pct"] = 101.0
+    with pytest.raises(bench.ImplausibleMeasurement):
+        bench.validate_mfu(m)
+
+
+def test_zero_or_negative_mfu_refused():
+    for bad in (0, -3.0, None):
+        m = _honest()
+        m["mfu_pct"] = bad
+        with pytest.raises(bench.ImplausibleMeasurement):
+            bench.validate_mfu(m)
+
+
+def test_tflops_above_peak_refused():
+    m = _honest()
+    m["model_tflops_per_s"] = 198.0
+    m["mfu_pct"] = 99.0  # internally consistent lie — still above peak
+    with pytest.raises(bench.ImplausibleMeasurement, match="exceeds peak"):
+        bench.validate_mfu(m)
+
+
+def test_tokens_per_s_must_match_step_time():
+    m = _honest()
+    m["tokens_per_s"] = 2 * m["tokens_per_s"]
+    with pytest.raises(bench.ImplausibleMeasurement, match="inconsistent"):
+        bench.validate_mfu(m)
+
+
+def test_nonpositive_step_time_refused():
+    m = _honest()
+    m["step_time_s"] = 0.0
+    with pytest.raises(bench.ImplausibleMeasurement):
+        bench.validate_mfu(m)
+
+
+def test_unknown_device_still_checks_consistency():
+    m = _honest()
+    m["peak_tflops"] = None
+    m["mfu_pct"] = None
+    bench.validate_mfu(m)  # consistency ok -> passes
+    m["tokens_per_s"] = 10 * m["tokens_per_s"]
+    with pytest.raises(bench.ImplausibleMeasurement):
+        bench.validate_mfu(m)
+
+
+def test_fault_injection_env_wired():
+    """NOS_TPU_BENCH_FAULT=noop_sync must route bench_mfu to the broken
+    block_until_ready fence (verified end-to-end on TPU: rc=1 with an
+    ImplausibleMeasurement diagnostic). Here we just pin the seam exists."""
+    src = (Path(__file__).resolve().parent.parent / "bench_mfu.py").read_text()
+    assert "NOS_TPU_BENCH_FAULT" in src
+    assert "block_until_ready" in src
+    assert "device_get" in src  # the real fence is a host transfer
