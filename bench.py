@@ -77,7 +77,7 @@ def validate_mfu(m: dict) -> None:
     if dt <= 0:
         problems.append(f"non-positive step time {dt}s")
     else:
-        expect_tps = BATCH * SEQ / dt
+        expect_tps = m.get("batch", BATCH) * SEQ / dt
         tps = m.get("tokens_per_s", 0)
         if abs(tps - expect_tps) > 0.05 * expect_tps + 1:
             problems.append(

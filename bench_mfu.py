@@ -48,6 +48,13 @@ def run_mfu():
     from nos_tpu.models import transformer as tr
 
     faulty_fence = os.environ.get("NOS_TPU_BENCH_FAULT") == "noop_sync"
+    # sweep knobs (bench_sweep.py): published config is the bench.py default
+    batch = int(os.environ.get("NOS_TPU_BENCH_BATCH", BATCH))
+    model = dict(MODEL)
+    if "NOS_TPU_BENCH_REMAT_POLICY" in os.environ:
+        model["remat_policy"] = os.environ["NOS_TPU_BENCH_REMAT_POLICY"]
+    if "NOS_TPU_BENCH_REMAT" in os.environ:
+        model["remat"] = os.environ["NOS_TPU_BENCH_REMAT"] == "1"
 
     def fence(*arrays):
         if faulty_fence:  # deliberately broken: no-op on 'axon'
@@ -58,27 +65,27 @@ def run_mfu():
     dev = jax.devices()[0]
     peak = PEAK_TFLOPS.get(dev.device_kind)
 
-    cfg = tr.TransformerConfig(**MODEL)
+    cfg = tr.TransformerConfig(**model)
     params = tr.init_params(jax.random.PRNGKey(0), cfg)
     n_params = sum(x.size for x in jax.tree.leaves(params))
     opt = optax.adamw(1e-4)
     opt_state = opt.init(params)
     step = jax.jit(tr.make_train_step(cfg, opt), donate_argnums=(0, 1))
-    tok = jax.random.randint(jax.random.PRNGKey(1), (BATCH, SEQ), 0, cfg.vocab)
-    batch = {"tokens": tok, "targets": tok}
+    tok = jax.random.randint(jax.random.PRNGKey(1), (batch, SEQ), 0, cfg.vocab)
+    data = {"tokens": tok, "targets": tok}
 
     loss = None
     for _ in range(WARMUP_STEPS):
-        params, opt_state, loss = step(params, opt_state, batch)
+        params, opt_state, loss = step(params, opt_state, data)
     fence(loss, params)
 
     t0 = time.perf_counter()
     for _ in range(TIMED_STEPS):
-        params, opt_state, loss = step(params, opt_state, batch)
+        params, opt_state, loss = step(params, opt_state, data)
     final_loss = fence(loss, params)
     dt = (time.perf_counter() - t0) / TIMED_STEPS
 
-    flops = model_flops_per_step(cfg, BATCH, SEQ)
+    flops = model_flops_per_step(cfg, batch, SEQ)
     tflops = flops / dt / 1e12
     result = {
         "platform": jax.default_backend(),
@@ -87,9 +94,12 @@ def run_mfu():
         "device": dev.device_kind,
         "timing_fence": "block_until_ready[FAULT]" if faulty_fence
                         else "device_to_host_transfer",
+        "batch": batch,
+        "remat_policy": model.get("remat_policy", "full")
+                        if model.get("remat", True) else "none",
         "params_b": round(n_params / 1e9, 3),
         "step_time_s": round(dt, 4),
-        "tokens_per_s": round(BATCH * SEQ / dt),
+        "tokens_per_s": round(batch * SEQ / dt),
         "model_tflops_per_s": round(tflops, 1),
         "peak_tflops": peak,
         "mfu_pct": round(100 * tflops / peak, 1) if peak else None,

@@ -1,0 +1,115 @@
+#!/usr/bin/env python3
+"""Component timing for the flagship MFU config: where does the step time
+go? Times each piece with the host-transfer fence (block_until_ready lies
+on 'axon' — see bench_mfu.py). Used to target VERDICT r2 next #2c."""
+import json
+import sys
+import time
+
+sys.path.insert(0, ".")
+
+from bench import BATCH, MODEL, SEQ  # noqa: E402
+from bench_mfu import host_fence  # noqa: E402
+
+
+def timeit(fn, *args, reps=5, warmup=2):
+    out = None
+    for _ in range(warmup):
+        out = fn(*args)
+    host_fence(out)
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        out = fn(*args)
+    host_fence(out)
+    return (time.perf_counter() - t0) / reps
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from nos_tpu.models import transformer as tr
+    from nos_tpu.ops.attention import attention
+
+    cfg = tr.TransformerConfig(**MODEL)
+    params = tr.init_params(jax.random.PRNGKey(0), cfg)
+    tok = jax.random.randint(jax.random.PRNGKey(1), (BATCH, SEQ), 0, cfg.vocab)
+    batch = {"tokens": tok, "targets": tok}
+    opt = optax.adamw(1e-4)
+    opt_state = opt.init(params)
+
+    report = {}
+
+    # 1. forward only (no remat in play: remat only affects backward)
+    fwd = jax.jit(lambda p, b: tr.loss_fn(p, cfg, b))
+    report["fwd_s"] = round(timeit(fwd, params, batch), 4)
+
+    # 2. forward+backward (grads) — includes remat recompute
+    vg = jax.jit(lambda p, b: jax.value_and_grad(tr.loss_fn)(p, cfg, b))
+    report["fwd_bwd_s"] = round(timeit(vg, params, batch), 4)
+
+    # 3. optimizer update alone
+    _, grads = vg(params, batch)
+
+    def opt_step(p, g, s):
+        updates, s = opt.update(g, s, p)
+        return optax.apply_updates(p, updates)
+
+    ostep = jax.jit(opt_step)
+    report["opt_s"] = round(timeit(ostep, params, grads, opt_state), 4)
+
+    # 4. attention alone, bench shapes (pallas kernel, GQA repeat today)
+    b, h, hkv, s, d = BATCH, cfg.n_heads, cfg.kv_heads, SEQ, cfg.head_dim
+    q = jax.random.normal(jax.random.PRNGKey(2), (b, h, s, d), jnp.bfloat16)
+    k = jax.random.normal(jax.random.PRNGKey(3), (b, hkv, s, d), jnp.bfloat16)
+    v = jax.random.normal(jax.random.PRNGKey(4), (b, hkv, s, d), jnp.bfloat16)
+    att = jax.jit(lambda q, k, v: attention(q, k, v, causal=True))
+    one_layer = timeit(att, q, k, v)
+    report["attn_fwd_per_layer_s"] = round(one_layer, 5)
+    report["attn_fwd_total_s"] = round(one_layer * cfg.n_layers, 4)
+
+    attg = jax.jit(jax.grad(
+        lambda q, k, v: attention(q, k, v, causal=True).sum(), argnums=(0, 1, 2)))
+    one_layer_bwd = timeit(attg, q, k, v)
+    report["attn_fwdbwd_per_layer_s"] = round(one_layer_bwd, 5)
+
+    # 5. FFN matmuls alone (the FLOPs majority): x[Btok, d] @ the SwiGLU trio
+    x = jax.random.normal(jax.random.PRNGKey(5), (b * s, cfg.d_model), jnp.bfloat16)
+    wg = jax.random.normal(jax.random.PRNGKey(6), (cfg.d_model, cfg.d_ff), jnp.bfloat16)
+    wu = jax.random.normal(jax.random.PRNGKey(10), (cfg.d_model, cfg.d_ff), jnp.bfloat16)
+    wd = jax.random.normal(jax.random.PRNGKey(7), (cfg.d_ff, cfg.d_model), jnp.bfloat16)
+
+    def ffn(x, wg, wu, wd):
+        g = jax.nn.silu(x @ wg)
+        u = x @ wu
+        return (g * u) @ wd
+
+    f = jax.jit(ffn)
+    t = timeit(f, x, wg, wu, wd)
+    ffn_flops = 2 * (b * s) * cfg.d_model * cfg.d_ff * 3
+    report["ffn_fwd_per_layer_s"] = round(t, 5)
+    report["ffn_fwd_tflops"] = round(ffn_flops / t / 1e12, 1)
+
+    # 6. unembed + CE alone
+    xf = jax.random.normal(jax.random.PRNGKey(8), (b, s, cfg.d_model), jnp.bfloat16)
+
+    def ce(x, w, tgt):
+        logits = (x @ w).astype(jnp.float32)
+        return tr.cross_entropy(logits, tgt)
+
+    cef = jax.jit(jax.value_and_grad(ce))
+    report["unembed_ce_fwdbwd_s"] = round(
+        timeit(cef, xf, params["unembed"], tok), 4)
+
+    # 7. pure matmul roofline: what the chip gives us on one big bf16 matmul
+    m = jax.random.normal(jax.random.PRNGKey(9), (8192, 8192), jnp.bfloat16)
+    mm = jax.jit(lambda a: a @ a)
+    t = timeit(mm, m)
+    report["matmul8k_tflops"] = round(2 * 8192 ** 3 / t / 1e12, 1)
+
+    print(json.dumps(report))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,64 @@
+#!/usr/bin/env python3
+"""Sweep remat policy x batch size for the flagship MFU config (VERDICT
+r2 next #2b). Each point runs bench_mfu.py in its own subprocess so an
+OOM kills the point, not the sweep. Prints one JSON line per point and a
+final `best` line; bench.py's published config should be updated to the
+best honest point by hand (the bench itself stays pinned)."""
+import json
+import os
+import subprocess
+import sys
+
+POINTS = [
+    # (batch, remat_policy or "none")
+    (8, "full"),       # round-2 published config
+    (16, "full"),
+    (8, "dots"),
+    (4, "dots"),
+    (2, "dots"),
+    (4, "none"),
+    (2, "none"),
+]
+
+
+def run_point(batch, policy, timeout=900):
+    env = dict(os.environ)
+    # clear every sweep knob so shell leftovers can't skew a point
+    for knob in ("NOS_TPU_BENCH_BATCH", "NOS_TPU_BENCH_REMAT",
+                 "NOS_TPU_BENCH_REMAT_POLICY", "NOS_TPU_BENCH_FAULT"):
+        env.pop(knob, None)
+    env["NOS_TPU_BENCH_BATCH"] = str(batch)
+    if policy == "none":
+        env["NOS_TPU_BENCH_REMAT"] = "0"
+    else:
+        env["NOS_TPU_BENCH_REMAT_POLICY"] = policy
+    try:
+        proc = subprocess.run(
+            [sys.executable, "bench_mfu.py"], env=env,
+            capture_output=True, text=True, timeout=timeout,
+        )
+    except subprocess.TimeoutExpired:
+        return {"batch": batch, "remat_policy": policy, "error": "timeout"}
+    if proc.returncode != 0:
+        tail = proc.stderr.strip().splitlines()[-1:] or ["?"]
+        return {"batch": batch, "remat_policy": policy,
+                "error": tail[0][:160]}
+    return json.loads(proc.stdout.strip().splitlines()[-1])
+
+
+def main():
+    results = []
+    for batch, policy in POINTS:
+        r = run_point(batch, policy)
+        results.append(r)
+        print(json.dumps(r), flush=True)
+    ok = [r for r in results if r.get("mfu_pct")]
+    if ok:
+        best = max(ok, key=lambda r: r["mfu_pct"])
+        print(json.dumps({"best": {k: best[k] for k in
+                                   ("batch", "remat_policy", "mfu_pct",
+                                    "step_time_s")}}))
+
+
+if __name__ == "__main__":
+    main()

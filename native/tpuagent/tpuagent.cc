@@ -22,6 +22,7 @@
 // Everything is exercised through tpu_native.py; the Python shim falls back
 // to a pure-Python mock when the shared library cannot be built.
 
+#include <cctype>
 #include <cerrno>
 #include <cstdio>
 #include <cstdlib>
@@ -225,6 +226,306 @@ int tpu_read_partition(char* buf, int buf_len) {
 int tpu_clear_partition() {
   if (unlink(state_path().c_str()) != 0 && errno != ENOENT) return -1;
   return 0;
+}
+
+// ---------------------------------------------------------------------------
+// device attachment ground truth
+// ---------------------------------------------------------------------------
+//
+// The reference joins kubelet pod-resources allocations with NVML device
+// queries to learn which pod actually holds which device
+// (pkg/resource/lister.go:27-39 + pkg/gpu/mig/client.go:29-120). The
+// TPU-native equivalents here:
+//
+//   1. an attachment TABLE persisted by the device plugin's Allocate hook
+//      (tpu_record_attachments / tpu_read_attachments) — allocation truth,
+//      the pod-resources-socket analog, file-backed like partition state;
+//   2. a /proc PROBE (tpu_chip_attached_pids / tpu_pid_pod_uid) — runtime
+//      truth: which live processes hold /dev/accel<N> open, and which
+//      kubelet pod (cgroup path embeds the pod UID) each belongs to.
+//
+// The Python Reporter reconciles both against the API server's bound-pod
+// view and surfaces disagreements (bound-but-never-started pods, ghost
+// attachments) as a node status annotation.
+
+static std::string attach_path() {
+  const char* p = getenv("NOS_TPU_ATTACH_FILE");
+  if (p != nullptr && *p != '\0') return std::string(p);
+  return std::string("/var/run/nos-tpuagent/attachments.json");
+}
+
+static int write_atomic(const std::string& path, const char* payload) {
+  size_t slash = path.find_last_of('/');
+  if (slash != std::string::npos) {
+    std::string dir = path.substr(0, slash);
+    for (size_t i = 1; i <= dir.size(); ++i) {
+      if (i == dir.size() || dir[i] == '/') {
+        std::string part = dir.substr(0, i);
+        if (!part.empty()) mkdir(part.c_str(), 0755);
+      }
+    }
+  }
+  std::string tmp = path + ".tmp";
+  FILE* f = fopen(tmp.c_str(), "w");
+  if (f == nullptr) return -1;
+  size_t len = strlen(payload);
+  bool ok = fwrite(payload, 1, len, f) == len && fflush(f) == 0 &&
+            fsync(fileno(f)) == 0;
+  fclose(f);
+  if (!ok || rename(tmp.c_str(), path.c_str()) != 0) {
+    unlink(tmp.c_str());
+    return -1;
+  }
+  return 0;
+}
+
+// Persist the attachment table (opaque JSON owned by the Python layer /
+// device-plugin hook). 0 on success.
+int tpu_record_attachments(const char* json) {
+  if (json == nullptr) return -1;
+  return write_atomic(attach_path(), json);
+}
+
+// Read the attachment table. Returns length, 0 when absent, -1 on error.
+int tpu_read_attachments(char* buf, int buf_len) {
+  if (buf == nullptr || buf_len <= 0) return -1;
+  FILE* f = fopen(attach_path().c_str(), "r");
+  if (f == nullptr) {
+    buf[0] = '\0';
+    return 0;
+  }
+  size_t n = fread(buf, 1, static_cast<size_t>(buf_len - 1), f);
+  bool overflow = fgetc(f) != EOF;
+  fclose(f);
+  if (overflow) return -1;
+  buf[n] = '\0';
+  return static_cast<int>(n);
+}
+
+int tpu_clear_attachments() {
+  if (unlink(attach_path().c_str()) != 0 && errno != ENOENT) return -1;
+  return 0;
+}
+
+// PIDs with /dev/accel<chip> open, comma-separated into buf. Scans
+// /proc/<pid>/fd symlinks (runtime truth on a real host). Env seam for
+// tests / non-TPU hosts: NOS_TPU_ATTACHED_PIDS_<chip>. Returns the number
+// of PIDs found (0 legitimate), -1 on error / buffer too small.
+int tpu_chip_attached_pids(int chip, char* buf, int buf_len) {
+  if (buf == nullptr || buf_len <= 0 || chip < 0) return -1;
+  buf[0] = '\0';
+  char env_key[64];
+  snprintf(env_key, sizeof(env_key), "NOS_TPU_ATTACHED_PIDS_%d", chip);
+  const char* env = getenv(env_key);
+  if (env != nullptr) {
+    int len = static_cast<int>(strlen(env));
+    if (len + 1 > buf_len) return -1;
+    memcpy(buf, env, len + 1);
+    if (len == 0) return 0;
+    int count = 1;
+    for (const char* p = env; *p != '\0'; ++p) {
+      if (*p == ',') count++;
+    }
+    return count;
+  }
+  char target[64];
+  snprintf(target, sizeof(target), "/dev/accel%d", chip);
+  DIR* proc = opendir("/proc");
+  if (proc == nullptr) return -1;
+  int count = 0;
+  size_t used = 0;
+  struct dirent* entry;
+  while ((entry = readdir(proc)) != nullptr) {
+    const char* name = entry->d_name;
+    if (*name == '\0' || strspn(name, "0123456789") != strlen(name)) continue;
+    char fd_dir[300];
+    snprintf(fd_dir, sizeof(fd_dir), "/proc/%s/fd", name);
+    DIR* fds = opendir(fd_dir);
+    if (fds == nullptr) continue;  // gone or not ours to read
+    struct dirent* fd_entry;
+    bool attached = false;
+    while (!attached && (fd_entry = readdir(fds)) != nullptr) {
+      if (fd_entry->d_name[0] == '.') continue;
+      char link_path[600];
+      snprintf(link_path, sizeof(link_path), "%s/%s", fd_dir,
+               fd_entry->d_name);
+      char resolved[256];
+      ssize_t n = readlink(link_path, resolved, sizeof(resolved) - 1);
+      if (n <= 0) continue;
+      resolved[n] = '\0';
+      if (strcmp(resolved, target) == 0) attached = true;
+    }
+    closedir(fds);
+    if (!attached) continue;
+    size_t name_len = strlen(name);
+    if (used + name_len + 2 > static_cast<size_t>(buf_len)) {
+      closedir(proc);
+      return -1;
+    }
+    if (count > 0) buf[used++] = ',';
+    memcpy(buf + used, name, name_len);
+    used += name_len;
+    buf[used] = '\0';
+    count++;
+  }
+  closedir(proc);
+  return count;
+}
+
+// All chips' attached PIDs in ONE /proc sweep: writes
+// "chip:pid,pid;chip:pid" into buf. The per-node agent calls this every
+// report interval; one O(pids x fds) walk matching every /dev/accel<N>
+// beats max_chips separate walks (tpu_chip_attached_pids remains for
+// single-chip queries and the env-seam test path). Returns the number of
+// (chip, pid) attachment pairs, -1 on error / buffer too small.
+int tpu_attached_pids_all(int max_chips, char* buf, int buf_len) {
+  if (buf == nullptr || buf_len <= 0 || max_chips <= 0) return -1;
+  buf[0] = '\0';
+  // honor the env seam so mocks and the real path share one surface
+  bool any_env = false;
+  for (int c = 0; c < max_chips && !any_env; ++c) {
+    char env_key[64];
+    snprintf(env_key, sizeof(env_key), "NOS_TPU_ATTACHED_PIDS_%d", c);
+    if (getenv(env_key) != nullptr) any_env = true;
+  }
+  size_t used = 0;
+  int pairs = 0;
+  auto emit = [&](int chip, const char* pid) {
+    size_t pid_len = strlen(pid);
+    char head[16];
+    int head_len = snprintf(head, sizeof(head), "%d:", chip);
+    // worst case: ';' + "chip:" + pid + NUL
+    if (used + pid_len + head_len + 2 > static_cast<size_t>(buf_len)) {
+      return false;
+    }
+    // ';'-joined "chip:pid" pairs; the Python side groups them per chip
+    if (used > 0) buf[used++] = ';';
+    memcpy(buf + used, head, head_len);
+    used += head_len;
+    memcpy(buf + used, pid, pid_len);
+    used += pid_len;
+    buf[used] = '\0';
+    return true;
+  };
+  if (any_env) {
+    for (int c = 0; c < max_chips; ++c) {
+      char env_key[64];
+      snprintf(env_key, sizeof(env_key), "NOS_TPU_ATTACHED_PIDS_%d", c);
+      const char* env = getenv(env_key);
+      if (env == nullptr || *env == '\0') continue;
+      std::string list(env);
+      size_t pos = 0;
+      while (pos <= list.size()) {
+        size_t comma = list.find(',', pos);
+        std::string tok = list.substr(
+            pos, comma == std::string::npos ? std::string::npos : comma - pos);
+        if (!tok.empty()) {
+          if (!emit(c, tok.c_str())) return -1;
+          pairs++;
+        }
+        if (comma == std::string::npos) break;
+        pos = comma + 1;
+      }
+    }
+    return pairs;
+  }
+  DIR* proc = opendir("/proc");
+  if (proc == nullptr) return -1;
+  struct dirent* entry;
+  while ((entry = readdir(proc)) != nullptr) {
+    const char* name = entry->d_name;
+    if (*name == '\0' || strspn(name, "0123456789") != strlen(name)) continue;
+    char fd_dir[300];
+    snprintf(fd_dir, sizeof(fd_dir), "/proc/%s/fd", name);
+    DIR* fds = opendir(fd_dir);
+    if (fds == nullptr) continue;
+    struct dirent* fd_entry;
+    // one pid can hold several chips: collect the set per pid
+    std::vector<bool> holds(static_cast<size_t>(max_chips), false);
+    while ((fd_entry = readdir(fds)) != nullptr) {
+      if (fd_entry->d_name[0] == '.') continue;
+      char link_path[600];
+      snprintf(link_path, sizeof(link_path), "%s/%s", fd_dir,
+               fd_entry->d_name);
+      char resolved[256];
+      ssize_t n = readlink(link_path, resolved, sizeof(resolved) - 1);
+      if (n <= 0) continue;
+      resolved[n] = '\0';
+      if (strncmp(resolved, "/dev/accel", 10) != 0) continue;
+      const char* suffix = resolved + 10;
+      if (*suffix == '\0' || strspn(suffix, "0123456789") != strlen(suffix)) {
+        continue;
+      }
+      long chip = strtol(suffix, nullptr, 10);
+      if (chip >= 0 && chip < max_chips) holds[static_cast<size_t>(chip)] = true;
+    }
+    closedir(fds);
+    for (int c = 0; c < max_chips; ++c) {
+      if (!holds[static_cast<size_t>(c)]) continue;
+      if (!emit(c, name)) {
+        closedir(proc);
+        return -1;
+      }
+      pairs++;
+    }
+  }
+  closedir(proc);
+  return pairs;
+}
+
+// Kubernetes pod UID owning a PID, parsed from /proc/<pid>/cgroup: kubelet
+// cgroup paths embed "pod<uid>" (uid dash- or underscore-separated,
+// systemd or cgroupfs driver). Env seam: NOS_TPU_PID_POD_<pid>. Returns
+// UID length, 0 when the PID is not in a pod cgroup, -1 on error.
+int tpu_pid_pod_uid(int pid, char* buf, int buf_len) {
+  if (buf == nullptr || buf_len <= 0 || pid < 0) return -1;
+  buf[0] = '\0';
+  char env_key[64];
+  snprintf(env_key, sizeof(env_key), "NOS_TPU_PID_POD_%d", pid);
+  const char* env = getenv(env_key);
+  if (env != nullptr) {
+    int len = static_cast<int>(strlen(env));
+    if (len + 1 > buf_len) return -1;
+    memcpy(buf, env, len + 1);
+    return len;
+  }
+  char path[64];
+  snprintf(path, sizeof(path), "/proc/%d/cgroup", pid);
+  FILE* f = fopen(path, "r");
+  if (f == nullptr) return 0;  // process gone: no pod
+  char line[1024];
+  int result = 0;
+  while (result == 0 && fgets(line, sizeof(line), f) != nullptr) {
+    const char* pod = strstr(line, "pod");
+    while (pod != nullptr) {
+      const char* uid = pod + 3;
+      // accept hex digits plus '-'/'_' separators, length of a UUID-ish id
+      int len = 0;
+      while (uid[len] != '\0' &&
+             (isxdigit(static_cast<unsigned char>(uid[len])) ||
+              uid[len] == '-' || uid[len] == '_')) {
+        len++;
+      }
+      // canonical UID is 36 chars with '-', systemd driver uses '_'
+      if (len >= 32) {
+        // trim trailing separators and ".slice" style leftovers
+        while (len > 0 && (uid[len - 1] == '-' || uid[len - 1] == '_')) len--;
+        if (len + 1 > buf_len) {
+          result = -1;
+          break;
+        }
+        for (int i = 0; i < len; ++i) {
+          buf[i] = uid[i] == '_' ? '-' : uid[i];
+        }
+        buf[len] = '\0';
+        result = len;
+        break;
+      }
+      pod = strstr(uid, "pod");
+    }
+  }
+  fclose(f);
+  return result;
 }
 
 }  // extern "C"

@@ -96,6 +96,19 @@ def load_native() -> Optional[ctypes.CDLL]:
     lib.tpu_read_partition.argtypes = [ctypes.c_char_p, ctypes.c_int]
     lib.tpu_read_partition.restype = ctypes.c_int
     lib.tpu_clear_partition.restype = ctypes.c_int
+    lib.tpu_record_attachments.argtypes = [ctypes.c_char_p]
+    lib.tpu_record_attachments.restype = ctypes.c_int
+    lib.tpu_read_attachments.argtypes = [ctypes.c_char_p, ctypes.c_int]
+    lib.tpu_read_attachments.restype = ctypes.c_int
+    lib.tpu_clear_attachments.restype = ctypes.c_int
+    lib.tpu_chip_attached_pids.argtypes = [
+        ctypes.c_int, ctypes.c_char_p, ctypes.c_int]
+    lib.tpu_chip_attached_pids.restype = ctypes.c_int
+    lib.tpu_attached_pids_all.argtypes = [
+        ctypes.c_int, ctypes.c_char_p, ctypes.c_int]
+    lib.tpu_attached_pids_all.restype = ctypes.c_int
+    lib.tpu_pid_pod_uid.argtypes = [ctypes.c_int, ctypes.c_char_p, ctypes.c_int]
+    lib.tpu_pid_pod_uid.restype = ctypes.c_int
     return lib
 
 
@@ -168,6 +181,75 @@ class TpuNativeClient:
         if self.lib.tpu_clear_partition() != 0:
             raise TpuClientError("tpu_clear_partition failed")
 
+    # -- device attachment ground truth ------------------------------------
+    # The pod-resources-socket analog (reference pkg/resource/lister.go
+    # joined with pkg/gpu/mig/client.go): allocation truth from the device
+    # plugin's Allocate hand-off (file table) plus runtime truth from
+    # /proc (which live processes hold the device nodes).
+
+    def record_attachments(self, attachments: Dict[str, dict]) -> None:
+        """attachments: {"<chip-or-slice-id>": {"pod_uid": ..., "pod":
+        "ns/name", "profile": "...", ...}} — written by the device-plugin
+        hook at Allocate/Deallocate time."""
+        payload = json.dumps({"attachments": attachments}, sort_keys=True)
+        if self.lib.tpu_record_attachments(payload.encode()) != 0:
+            raise TpuClientError("tpu_record_attachments failed")
+
+    def read_attachments(self) -> Dict[str, dict]:
+        buf = ctypes.create_string_buffer(_BUF_LEN)
+        n = self.lib.tpu_read_attachments(buf, _BUF_LEN)
+        if n < 0:
+            raise TpuClientError("tpu_read_attachments failed")
+        raw = buf.value.decode()
+        if not raw:
+            return {}
+        try:
+            return dict(json.loads(raw).get("attachments") or {})
+        except (json.JSONDecodeError, AttributeError) as e:
+            raise TpuClientError(f"corrupt attachment table: {e}") from e
+
+    def clear_attachments(self) -> None:
+        if self.lib.tpu_clear_attachments() != 0:
+            raise TpuClientError("tpu_clear_attachments failed")
+
+    def chip_attached_pids(self, chip: int) -> list[int]:
+        """PIDs holding /dev/accel<chip> open right now (runtime truth)."""
+        buf = ctypes.create_string_buffer(_BUF_LEN)
+        n = self.lib.tpu_chip_attached_pids(chip, buf, _BUF_LEN)
+        if n < 0:
+            raise TpuClientError(f"tpu_chip_attached_pids({chip}) failed")
+        raw = buf.value.decode()
+        return [int(p) for p in raw.split(",") if p]
+
+    def pid_pod_uid(self, pid: int) -> Optional[str]:
+        """Pod UID owning a PID (kubelet cgroup path), or None."""
+        buf = ctypes.create_string_buffer(_BUF_LEN)
+        n = self.lib.tpu_pid_pod_uid(pid, buf, _BUF_LEN)
+        if n < 0:
+            raise TpuClientError(f"tpu_pid_pod_uid({pid}) failed")
+        return buf.value.decode() or None
+
+    def attachment_truth(self) -> Dict[int, set]:
+        """Runtime attachment map {chip: {pod_uid, ...}} from ONE /proc
+        sweep (tpu_attached_pids_all) joined through cgroups. Chips with
+        open FDs from processes outside any pod map to the pseudo-uid
+        "<host>"."""
+        buf = ctypes.create_string_buffer(_BUF_LEN)
+        n = self.lib.tpu_attached_pids_all(self.chip_count(), buf, _BUF_LEN)
+        if n < 0:
+            raise TpuClientError("tpu_attached_pids_all failed")
+        truth: Dict[int, set] = {}
+        pod_cache: Dict[int, Optional[str]] = {}
+        for group in buf.value.decode().split(";"):
+            if not group or ":" not in group:
+                continue
+            chip_s, pid_s = group.split(":", 1)
+            chip, pid = int(chip_s), int(pid_s)
+            if pid not in pod_cache:
+                pod_cache[pid] = self.pid_pod_uid(pid)
+            truth.setdefault(chip, set()).add(pod_cache[pid] or "<host>")
+        return truth
+
 
 def _decode_partition(raw: str) -> tuple[Dict[int, Geometry], str]:
     try:
@@ -201,6 +283,10 @@ class MockTpuClient:
     _boards: Dict[int, Geometry] = field(default_factory=dict)
     _plan: str = ""
     apply_error: Optional[Exception] = None
+    _attachments: Dict[str, dict] = field(default_factory=dict)
+    # {chip: [pid, ...]} and {pid: pod_uid} — the /proc double
+    attached_pids: Dict[int, list] = field(default_factory=dict)
+    pid_pods: Dict[int, str] = field(default_factory=dict)
 
     def chip_count(self) -> int:
         return self.chips
@@ -237,3 +323,27 @@ class MockTpuClient:
     def clear_partition(self) -> None:
         self._boards = {}
         self._plan = ""
+
+    def record_attachments(self, attachments: Dict[str, dict]) -> None:
+        self._attachments = {k: dict(v) for k, v in attachments.items()}
+
+    def read_attachments(self) -> Dict[str, dict]:
+        return {k: dict(v) for k, v in self._attachments.items()}
+
+    def clear_attachments(self) -> None:
+        self._attachments = {}
+
+    def chip_attached_pids(self, chip: int) -> list:
+        return list(self.attached_pids.get(chip, []))
+
+    def pid_pod_uid(self, pid: int) -> Optional[str]:
+        return self.pid_pods.get(pid)
+
+    def attachment_truth(self) -> Dict[int, set]:
+        truth: Dict[int, set] = {}
+        for chip in range(self.chip_count()):
+            uids = {self.pid_pod_uid(p) or "<host>"
+                    for p in self.chip_attached_pids(chip)}
+            if uids:
+                truth[chip] = uids
+        return truth

@@ -79,6 +79,66 @@ def used_slices_from_bound_pods(client: Client, node_name: str) -> Dict[Profile,
     return used
 
 
+def _requests_tpu(pod) -> bool:
+    return any(
+        q > 0 and (r == constants.RESOURCE_TPU or is_slice_resource(r))
+        for r, q in pod.request().items()
+    )
+
+
+def attachment_drift(client: Client, node_name: str, tpu_client) -> str:
+    """Reconcile the API server's bound-pod view against the node's native
+    attachment truth (reference: kubelet pod-resources + NVML,
+    pkg/resource/lister.go:27-39, pkg/gpu/mig/client.go:29-120).
+
+    Returns ";"-joined "kind:pod-uid" items (see
+    constants.ANNOTATION_ATTACHMENT_DRIFT), "" when no drift is visible.
+
+    - ghost: a pod UID holding a device (allocation table or /proc probe)
+      with no Pending/Running pod bound here — invisible usage the
+      bound-pod inference cannot see.
+    - unattached: a Running TPU-requesting pod absent from the allocation
+      table — a device-plugin/scheduler disagreement. Only judged when the
+      table has entries (no device plugin recording -> no claim; the /proc
+      probe can miss permission-restricted processes so its absence is
+      never evidence).
+    """
+    read_attach = getattr(tpu_client, "read_attachments", None)
+    truth_fn = getattr(tpu_client, "attachment_truth", None)
+    if read_attach is None or truth_fn is None:
+        return ""
+    try:
+        table = read_attach()
+        proc_truth = truth_fn()
+    except Exception:  # native layer unavailable mid-flight
+        logger.warning("attachment truth unreachable", exc_info=True)
+        return ""
+
+    bound = {}
+    for pod in client.list("Pod"):
+        if pod.spec.node_name == node_name and pod.metadata.uid:
+            bound[pod.metadata.uid] = pod
+
+    table_uids = {e.get("pod_uid") for e in table.values() if e.get("pod_uid")}
+    proc_uids = {u for uids in proc_truth.values() for u in uids
+                 if u != "<host>"}
+
+    drift = []
+    for uid in sorted(table_uids | proc_uids):
+        pod = bound.get(uid)
+        if pod is None or pod.status.phase not in ("Pending", "Running"):
+            drift.append(f"ghost:{uid}")
+    if table:
+        for uid, pod in sorted(bound.items()):
+            if (pod.status.phase == "Running" and _requests_tpu(pod)
+                    and uid not in table_uids and uid not in proc_uids):
+                # the runtime probe showing the pod DOES hold a device
+                # overrides a stale/partial allocation table (e.g. tmpfs
+                # table lost to a host reboot): no false drift claim
+                drift.append(f"unattached:{uid}")
+    return ";".join(drift)
+
+
 class TpuAgent:
     def __init__(
         self,
@@ -122,6 +182,7 @@ class TpuAgent:
         used = used_slices_from_bound_pods(client, self.node_name)
         unhealthy = self._unhealthy_chips()
         obs.AGENT_UNHEALTHY_CHIPS.labels(self.node_name).set(len(unhealthy))
+        drift = attachment_drift(client, self.node_name, self.tpu)
 
         status_annotations: Dict[str, str] = {}
         allocatable_slices: Dict[str, int] = {}
@@ -157,6 +218,10 @@ class TpuAgent:
                     str(i) for i in unhealthy)
             else:
                 anns.pop(constants.ANNOTATION_UNHEALTHY_CHIPS, None)
+            if drift:
+                anns[constants.ANNOTATION_ATTACHMENT_DRIFT] = drift
+            else:
+                anns.pop(constants.ANNOTATION_ATTACHMENT_DRIFT, None)
             changed[0] = anns != n.metadata.annotations
             n.metadata.annotations = anns
             if self.manage_allocatable:

@@ -90,6 +90,13 @@ ANNOTATION_REPORTED_PARTITIONING_PLAN = DOMAIN + "/status-partitioning-plan"
 # failure detection: comma-separated unhealthy chip indexes reported by the
 # agent's device-health probe (absent when all chips are healthy)
 ANNOTATION_UNHEALTHY_CHIPS = DOMAIN + "/status-unhealthy-chips"
+# device-attachment reconciliation (reference pkg/resource/lister.go joined
+# with NVML truth): disagreements between the API server's bound-pod view
+# and the node's native attachment truth, as "kind:pod-uid" items,
+# ";"-separated — "ghost" = device held by a pod the API doesn't show
+# bound/running here; "unattached" = Running pod that requested TPU but
+# holds no device per the device-plugin allocation table
+ANNOTATION_ATTACHMENT_DRIFT = DOMAIN + "/status-attachment-drift"
 
 ANNOTATION_SPEC_REGEX = re.compile(
     r"^" + re.escape(ANNOTATION_SPEC_PREFIX) + r"(\d+)-([a-z0-9.x\-]+)$"

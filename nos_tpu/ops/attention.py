@@ -29,6 +29,37 @@ def _pallas_flash():
         return None
 
 
+@functools.cache
+def _block_sizes(s_q: int, s_kv: int):
+    """Tuned pallas grid for this kernel. The library default (128/128)
+    under-fills the MXU badly: measured on v5e at B8/H16/S2048/D128
+    causal, default blocks run 12.6 ms while 512/512 runs 2.65 ms (4.8x).
+    512 is the sweet spot of the swept grid (256..2048 per axis); clamp
+    to the sequence so short-seq shapes still satisfy divisibility."""
+    try:
+        from jax.experimental.pallas.ops.tpu.flash_attention import BlockSizes
+    except Exception:   # pragma: no cover
+        return None
+    def pick(s, cap=512):
+        for cand in (512, 256, 128):
+            if cand <= cap and s % cand == 0:
+                return min(cand, s)
+        return min(128, s)
+
+    bq, bk = pick(s_q), pick(s_kv)
+    # backward blocks stay at the library's 128 default: 512-block dkv/dq
+    # kernels sent the Mosaic compiler into a 20+ minute spiral on this
+    # toolchain (observed on v5e/axon), while the forward win is where the
+    # wall-clock is
+    bqb, bkb = pick(s_q, 128), pick(s_kv, 128)
+    return BlockSizes(
+        block_q=bq, block_k_major=bk, block_k=bk, block_b=1,
+        block_q_major_dkv=bqb, block_k_major_dkv=bkb, block_k_dkv=bkb,
+        block_q_dkv=bqb,
+        block_k_major_dq=bkb, block_k_dq=bkb, block_q_dq=bqb,
+    )
+
+
 def flash_attention_available() -> bool:
     return jax.default_backend() == "tpu" and _pallas_flash() is not None
 
@@ -79,4 +110,5 @@ def attention(
         k = jnp.repeat(k, group, axis=1)
         v = jnp.repeat(v, group, axis=1)
     sm_scale = scale if scale is not None else q.shape[-1] ** -0.5
-    return fa(q, k, v, causal=causal, sm_scale=sm_scale)
+    return fa(q, k, v, causal=causal, sm_scale=sm_scale,
+              block_sizes=_block_sizes(q.shape[-2], k.shape[-2]))

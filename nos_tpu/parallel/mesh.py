@@ -3,8 +3,17 @@
 The scaling-book recipe: pick a mesh, annotate shardings, let XLA insert the
 collectives. ``build_mesh`` arranges jax devices into the layout's axes so
 that the innermost (rightmost) axes — tp, sp — map to physically adjacent
-devices (ICI neighbors under the default device enumeration), keeping
-tensor/sequence collectives on the fastest links.
+devices, keeping tensor/sequence collectives on the fastest ICI links.
+
+Physical adjacency is real, not an enumeration accident: when devices carry
+TPU torus coordinates (``device.coords``, plus ``core_on_chip`` on
+two-core chips), ``arrange_devices`` orders them along a boustrophedon
+(snake) walk of the coordinate grid. Consecutive devices on a snake walk
+are always one torus hop apart, so after reshaping into the mesh axes any
+two devices adjacent along the innermost axis are ICI neighbors — the same
+contiguity contract the scheduler enforces for gang placement
+(nos_tpu/scheduler/gang.py sub-cuboids). Devices without coords (CPU test
+meshes, older runtimes) fall back to enumeration order.
 """
 from __future__ import annotations
 
@@ -17,6 +26,65 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from nos_tpu.parallel.layout import ParallelLayout
 
 
+def _snake_indices(shape: Sequence[int]):
+    """Yield every index of an N-d grid along a boustrophedon walk:
+    consecutive yielded indices differ by exactly 1 in exactly one
+    dimension (a Hamiltonian unit-step path; wrap links never needed)."""
+    if not shape:
+        yield ()
+        return
+    head, rest = shape[0], list(shape[1:])
+    sub = list(_snake_indices(rest))
+    for i in range(head):
+        for idx in (sub if i % 2 == 0 else reversed(sub)):
+            yield (i,) + idx
+
+
+def device_grid_coords(devices: Sequence) -> Optional[dict]:
+    """Map each device to its normalized physical grid coordinate, or None
+    when coords are unusable (missing, or not a full cuboid). Two-core
+    chips get core_on_chip as an extra innermost dimension."""
+    coords = {}
+    for d in devices:
+        c = getattr(d, "coords", None)
+        if c is None:
+            return None
+        core = getattr(d, "core_on_chip", 0) or 0
+        coords[d] = tuple(c) + (core,)
+    lo = [min(c[i] for c in coords.values()) for i in range(len(next(iter(coords.values()))))]
+    norm = {d: tuple(ci - li for ci, li in zip(c, lo)) for d, c in coords.items()}
+    shape = tuple(max(c[i] for c in norm.values()) + 1
+                  for i in range(len(lo)))
+    expect = 1
+    for s in shape:
+        expect *= s
+    if expect != len(devices) or len(set(norm.values())) != len(devices):
+        return None  # holes / duplicates: not a full cuboid, can't walk it
+    return norm
+
+
+def arrange_devices(devices: Sequence, sizes: Sequence[int]) -> np.ndarray:
+    """Arrange ``prod(sizes)`` devices into an ndarray of shape ``sizes``
+    such that, when physical coords are available, devices adjacent along
+    the innermost axis are one torus hop apart (see module docstring).
+    Falls back to enumeration order without coords."""
+    n = 1
+    for s in sizes:
+        n *= s
+    devices = list(devices)[:n] if len(devices) > n else list(devices)
+    if len(devices) != n:
+        raise ValueError(f"need {n} devices, got {len(devices)}")
+    norm = device_grid_coords(devices)
+    if norm is not None:
+        shape = tuple(max(c[i] for c in norm.values()) + 1
+                      for i in range(len(next(iter(norm.values())))))
+        by_coord = {c: d for d, c in norm.items()}
+        ordered = [by_coord[idx] for idx in _snake_indices(shape)]
+    else:
+        ordered = devices
+    return np.array(ordered, dtype=object).reshape(tuple(sizes))
+
+
 def build_mesh(layout: ParallelLayout, devices: Optional[Sequence] = None) -> Mesh:
     devices = list(devices if devices is not None else jax.devices())
     if layout.chips > len(devices):
@@ -25,11 +93,7 @@ def build_mesh(layout: ParallelLayout, devices: Optional[Sequence] = None) -> Me
         )
     names = layout.axis_names()
     sizes = layout.axis_sizes()
-    n = 1
-    for s in sizes:
-        n *= s
-    grid = np.array(devices[:n]).reshape(sizes)
-    return Mesh(grid, names)
+    return Mesh(arrange_devices(devices, sizes), names)
 
 
 def data_sharding(mesh: Mesh) -> NamedSharding:

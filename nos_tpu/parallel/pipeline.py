@@ -15,8 +15,24 @@ Composition contract:
   implementation (it would nest shard_maps); long-context jobs pick sp,
   depth-bound jobs pick pp. MoE layers are likewise dense-path only here.
 
-Schedule: plain GPipe fill-and-drain — T = M + P - 1 rotation steps for M
-microbatches over P stages; bubble fraction (P-1)/T shrinks as M grows.
+Two schedules:
+
+- **GPipe** (``pipeline_forward``): fill-and-drain, T = M + P - 1 rotation
+  steps; autodiff produces the backward, so every stage keeps all M
+  microbatch boundary activations alive across the scan.
+- **1F1B** (``pipeline_1f1b_loss_fn``): the steady-state
+  one-forward-one-backward schedule. Lockstep SPMD ticks
+  t = 0 .. 2M+2P-3: stage p runs fwd(m) at t = p + 2m and bwd(m) at
+  t = 2P-1-p + 2m — the parity of (t - p) selects the unit, so a single
+  ``lax.cond`` executes exactly one unit per tick. Backward is computed
+  *inside* the schedule with explicit ``jax.vjp`` (recompute-from-saved-
+  input rematerialization), so in-flight activations are bounded by a
+  **P-slot ring buffer** per stage instead of M — the 1F1B memory bound.
+  Cotangents flow backward over the reverse ``ppermute`` ring while
+  activations flow forward, and parameter gradients accumulate in the
+  scan carry; a ``custom_vjp`` wrapper hands the pre-computed grads to
+  the outer ``jax.grad`` (scaled by the incoming cotangent), which keeps
+  the embed table's gradient on the normal autodiff path.
 """
 from __future__ import annotations
 
@@ -77,17 +93,7 @@ def pipeline_forward(
     stage_params = jax.tree.map(
         lambda w: w.reshape(stages, n_local, *w.shape[1:]), params["layers"])
 
-    def attention_call(q, k, v):
-        return attention(
-            q.transpose(0, 2, 1, 3), k.transpose(0, 2, 1, 3),
-            v.transpose(0, 2, 1, 3), causal=True,
-        ).transpose(0, 2, 1, 3)
-
-    def layer_body(h_in, layer):
-        return dense_layer_block(h_in, layer, cfg, freqs, attention_call), None
-
-    if cfg.remat:
-        layer_body = jax.checkpoint(layer_body)
+    stage_fn = _stage_fn_factory(cfg, freqs)
 
     def stage_program(local_params, microbatches):
         # local_params leaves [1, K, ...] (this stage's slice); squeeze it
@@ -96,16 +102,12 @@ def pipeline_forward(
         n_steps = n_microbatches + stages - 1
         perm = [(i, (i + 1) % stages) for i in range(stages)]
 
-        def stage_fn(h):
-            out, _ = jax.lax.scan(layer_body, h, local_params)
-            return out
-
         def step(carry, t):
             recv, outputs = carry
             mb_idx = t - p_idx
             first = microbatches[jnp.clip(t, 0, n_microbatches - 1)]
             inp = jnp.where(p_idx == 0, first, recv)
-            y = stage_fn(inp)
+            y = stage_fn(local_params, inp)
             active = (mb_idx >= 0) & (mb_idx < n_microbatches)
             write = jnp.clip(mb_idx, 0, n_microbatches - 1)
             updated = jax.lax.dynamic_update_index_in_dim(outputs, y, write, 0)
@@ -143,18 +145,242 @@ def pipeline_loss_fn(params: Params, cfg: TransformerConfig,
     return cross_entropy(logits, batch["targets"])
 
 
+# ---------------------------------------------------------------------------
+# 1F1B
+# ---------------------------------------------------------------------------
+
+def _stage_fn_factory(cfg: TransformerConfig, freqs):
+    """Per-stage forward: scan this stage's K layers over one microbatch."""
+
+    def attention_call(q, k, v):
+        return attention(
+            q.transpose(0, 2, 1, 3), k.transpose(0, 2, 1, 3),
+            v.transpose(0, 2, 1, 3), causal=True,
+        ).transpose(0, 2, 1, 3)
+
+    def layer_body(h_in, layer):
+        return dense_layer_block(h_in, layer, cfg, freqs, attention_call), None
+
+    if cfg.remat:
+        layer_body = jax.checkpoint(layer_body)
+
+    def stage_fn(local_params, x):
+        out, _ = jax.lax.scan(layer_body, x, local_params)
+        return out
+
+    return stage_fn
+
+
+def _head_fn(head: Params, x: jax.Array, targets: jax.Array) -> jax.Array:
+    """Loss head executed by the last stage per microbatch."""
+    x = rms_norm(x, head["final_norm"])
+    logits = jnp.dot(x, head["unembed"]).astype(jnp.float32)
+    return cross_entropy(logits, targets)
+
+
+def _make_1f1b_op(cfg: TransformerConfig, mesh: Mesh, n_microbatches: int,
+                  stages: int):
+    """Build the custom_vjp op: (stage_params [P,K,...], head, xs [M,...],
+    targets [M,...]) -> loss, with gradients for all four computed inside
+    the schedule itself (see module docstring)."""
+    M, Pn = n_microbatches, stages
+    freqs = rope_frequencies(cfg.head_dim, cfg.max_seq, cfg.rope_theta)
+    stage_fn = _stage_fn_factory(cfg, freqs)
+
+    def stage_program(stage_params, head, xs, targets):
+        local_params = jax.tree.map(lambda w: w[0], stage_params)
+        p_idx = jax.lax.axis_index("pp")
+        is_last = p_idx == Pn - 1
+        is_first = p_idx == 0
+        fwd_perm = [(i, (i + 1) % Pn) for i in range(Pn)]
+        bwd_perm = [((i + 1) % Pn, i) for i in range(Pn)]
+        mb_shape = xs.shape[1:]          # (mb, S, d)
+
+        zero_layer_grads = jax.tree.map(jnp.zeros_like, local_params)
+        zero_head_grads = jax.tree.map(jnp.zeros_like, head)
+
+        def fwd_unit(carry, t):
+            recv_f, recv_g, act, gl, gh, dxs, loss = carry
+            fm = jnp.clip((t - p_idx) // 2, 0, M - 1)
+            x_in = jnp.where(is_first, xs[fm], recv_f)
+            y = stage_fn(local_params, x_in)
+            act = jax.lax.dynamic_update_index_in_dim(
+                act, x_in, fm % Pn, 0)
+            g_send = jnp.zeros(mb_shape, xs.dtype)
+            return (y, g_send), (recv_f, recv_g, act, gl, gh, dxs, loss)
+
+        def bwd_unit(carry, t):
+            recv_f, recv_g, act, gl, gh, dxs, loss = carry
+            bm = jnp.clip((t - (2 * Pn - 1 - p_idx)) // 2, 0, M - 1)
+            x_in = act[bm % Pn]
+            y, pull = jax.vjp(stage_fn, local_params, x_in)
+
+            def head_cotangent(_):
+                loss_m, head_pull = jax.vjp(
+                    lambda h, x: _head_fn(h, x, targets[bm]), head, y)
+                dh, dy = head_pull(jnp.float32(1.0 / M))
+                return dy.astype(xs.dtype), dh, loss_m / M
+
+            def relay_cotangent(_):
+                return recv_g, zero_head_grads, jnp.float32(0.0)
+
+            g_in, dh, loss_m = jax.lax.cond(
+                is_last, head_cotangent, relay_cotangent, operand=None)
+            d_params, d_x = pull(g_in)
+            gl = jax.tree.map(jnp.add, gl, d_params)
+            gh = jax.tree.map(jnp.add, gh, dh)
+            loss = loss + loss_m
+            dxs_upd = jax.lax.dynamic_update_index_in_dim(
+                dxs, d_x.astype(dxs.dtype), bm, 0)
+            dxs = jnp.where(is_first, dxs_upd, dxs)
+            y_send = jnp.zeros(mb_shape, xs.dtype)
+            return (y_send, d_x.astype(xs.dtype)), \
+                (recv_f, recv_g, act, gl, gh, dxs, loss)
+
+        def idle_unit(carry, t):
+            z = jnp.zeros(mb_shape, xs.dtype)
+            return (z, z), carry
+
+        def tick(carry, t):
+            rel = t - p_idx
+            fm = rel // 2
+            bm = (t - (2 * Pn - 1 - p_idx)) // 2
+            is_f = (rel >= 0) & (rel % 2 == 0) & (fm < M)
+            is_b = (rel % 2 == 1) & (bm >= 0) & (bm < M)
+
+            def run_f(c):
+                return fwd_unit(c, t)
+
+            def run_b_or_idle(c):
+                return jax.lax.cond(is_b, lambda cc: bwd_unit(cc, t),
+                                    lambda cc: idle_unit(cc, t), c)
+
+            (y_send, g_send), carry = jax.lax.cond(
+                is_f, run_f, run_b_or_idle, carry)
+            recv_f = jax.lax.ppermute(y_send, "pp", fwd_perm)
+            recv_g = jax.lax.ppermute(g_send, "pp", bwd_perm)
+            _, _, act, gl, gh, dxs, loss = carry
+            return (recv_f, recv_g, act, gl, gh, dxs, loss), None
+
+        zeros_mb = jnp.zeros(mb_shape, xs.dtype)
+        init = (
+            zeros_mb, zeros_mb,
+            jnp.zeros((Pn,) + mb_shape, xs.dtype),     # P-slot ring, not M
+            zero_layer_grads, zero_head_grads,
+            jnp.zeros_like(xs), jnp.float32(0.0),
+        )
+        carry, _ = jax.lax.scan(tick, init, jnp.arange(2 * M + 2 * Pn - 2))
+        _, _, _, gl, gh, dxs, loss = carry
+        # only the owning stage holds a nonzero contribution; psum makes
+        # the pp-replicated outputs actually replicated
+        loss = jax.lax.psum(loss, "pp")
+        gh = jax.tree.map(lambda g: jax.lax.psum(g, "pp"), gh)
+        dxs = jax.lax.psum(dxs, "pp")
+        gl = jax.tree.map(lambda g: g[None], gl)       # restack over pp
+        return loss, gl, gh, dxs
+
+    def stage_program_fwd_only(stage_params, head, xs, targets):
+        """Loss without gradients: plain fill-drain rotation (T = M+P-1
+        ticks, fwd units only). The custom_vjp primal uses this so
+        eval/validation calls don't pay the 1F1B backward."""
+        local_params = jax.tree.map(lambda w: w[0], stage_params)
+        p_idx = jax.lax.axis_index("pp")
+        is_last = p_idx == Pn - 1
+        fwd_perm = [(i, (i + 1) % Pn) for i in range(Pn)]
+
+        def step(carry, t):
+            recv_f, loss = carry
+            m = jnp.clip(t - p_idx, 0, M - 1)
+            active = (t - p_idx >= 0) & (t - p_idx < M)
+            x_in = jnp.where(p_idx == 0, xs[m], recv_f)
+            y = stage_fn(local_params, x_in)
+            loss_m = jax.lax.cond(
+                is_last & active,
+                lambda: _head_fn(head, y, targets[m]) / M,
+                lambda: jnp.float32(0.0))
+            recv_f = jax.lax.ppermute(y, "pp", fwd_perm)
+            return (recv_f, loss + loss_m), None
+
+        init = (jnp.zeros(xs.shape[1:], xs.dtype), jnp.float32(0.0))
+        (_, loss), _ = jax.lax.scan(step, init, jnp.arange(M + Pn - 1))
+        return jax.lax.psum(loss, "pp")
+
+    sharded = jax.shard_map(
+        stage_program,
+        mesh=mesh,
+        in_specs=(P("pp"), P(), P(), P()),
+        out_specs=(P(), P("pp"), P(), P()),
+        axis_names={"pp"},
+        check_vma=False,
+    )
+    sharded_fwd = jax.shard_map(
+        stage_program_fwd_only,
+        mesh=mesh,
+        in_specs=(P("pp"), P(), P(), P()),
+        out_specs=P(),
+        axis_names={"pp"},
+        check_vma=False,
+    )
+
+    @jax.custom_vjp
+    def op(stage_params, head, xs, targets):
+        return sharded_fwd(stage_params, head, xs, targets)
+
+    def op_fwd(stage_params, head, xs, targets):
+        loss, gl, gh, dxs = sharded(stage_params, head, xs, targets)
+        return loss, (gl, gh, dxs)
+
+    def op_bwd(res, ct):
+        gl, gh, dxs = res
+        scale = lambda g: (g * ct).astype(g.dtype)  # noqa: E731
+        return (jax.tree.map(scale, gl), jax.tree.map(scale, gh),
+                jax.tree.map(scale, dxs), None)
+
+    op.defvjp(op_fwd, op_bwd)
+    return op
+
+
+def pipeline_1f1b_loss_fn(params: Params, cfg: TransformerConfig,
+                          batch: Dict[str, jax.Array], mesh: Mesh,
+                          n_microbatches: int = 2) -> jax.Array:
+    """1F1B analog of ``pipeline_loss_fn``: same math, P-bounded activation
+    residency. Differentiable in ``params`` (embed included — its grad
+    flows through the returned d(embedded-inputs))."""
+    tokens, targets = batch["tokens"], batch["targets"]
+    b, s = tokens.shape
+    stages = _check(cfg, mesh, b, n_microbatches)
+    n_local = cfg.n_layers // stages
+    mb = b // n_microbatches
+
+    x = params["embed"][tokens]
+    xs = x.reshape(n_microbatches, mb, s, cfg.d_model)
+    tgts = targets.reshape(n_microbatches, mb, s)
+
+    stage_params = jax.tree.map(
+        lambda w: w.reshape(stages, n_local, *w.shape[1:]), params["layers"])
+    head = {"final_norm": params["final_norm"], "unembed": params["unembed"]}
+
+    op = _make_1f1b_op(cfg, mesh, n_microbatches, stages)
+    return op(stage_params, head, xs, tgts)
+
+
 def make_pipeline_train_step(cfg: TransformerConfig, optimizer, mesh: Mesh,
-                             n_microbatches: int = 2):
-    """Pipelined analog of transformer.make_train_step."""
+                             n_microbatches: int = 2,
+                             schedule: str = "1f1b"):
+    """Pipelined analog of transformer.make_train_step. ``schedule`` is
+    "1f1b" (default: P-bounded activation memory) or "gpipe" (fallback)."""
+    if schedule not in ("1f1b", "gpipe"):
+        raise ValueError(f"unknown pipeline schedule {schedule!r}")
+    loss = pipeline_1f1b_loss_fn if schedule == "1f1b" else pipeline_loss_fn
 
     def train_step(params, opt_state, batch):
         import optax
 
-        loss, grads = jax.value_and_grad(pipeline_loss_fn)(
+        loss_val, grads = jax.value_and_grad(loss)(
             params, cfg, batch, mesh, n_microbatches)
         updates, opt_state = optimizer.update(grads, opt_state, params)
         params = optax.apply_updates(params, updates)
-        return params, opt_state, loss
+        return params, opt_state, loss_val
 
     return train_step
 

@@ -1,0 +1,141 @@
+"""Topology-aware mesh construction (VERDICT r1 #5 / r2 next #3): the
+"tp lands on ICI neighbors" claim is a tested invariant, not a docstring.
+Fabricated-coords devices stand in for a real torus; the CPU fallback is
+exercised through build_mesh on the 8-device test platform."""
+import itertools
+
+import numpy as np
+import pytest
+
+from nos_tpu.parallel.layout import ParallelLayout
+from nos_tpu.parallel.mesh import (
+    _snake_indices, arrange_devices, build_mesh, device_grid_coords,
+)
+
+
+class FakeDev:
+    """Looks enough like a TPU device: coords + core_on_chip."""
+
+    def __init__(self, coords, core=0, id_=0):
+        self.coords = tuple(coords)
+        self.core_on_chip = core
+        self.id = id_
+
+    def __repr__(self):
+        return f"FakeDev{self.coords}/{self.core_on_chip}"
+
+
+def torus(*shape):
+    devs = []
+    for i, c in enumerate(itertools.product(*(range(s) for s in shape))):
+        devs.append(FakeDev(c, id_=i))
+    return devs
+
+
+def hop_distance(a: FakeDev, b: FakeDev, shape):
+    """Torus hop count between two chips (wrap links counted)."""
+    total = 0
+    for ca, cb, s in zip(a.coords + (a.core_on_chip,),
+                         b.coords + (b.core_on_chip,),
+                         tuple(shape) + (1,)):
+        d = abs(ca - cb)
+        total += min(d, s - d) if s > 1 else d
+    return total
+
+
+# ---------------------------------------------------------------- snake walk
+
+def test_snake_consecutive_indices_are_unit_steps():
+    for shape in [(2, 2, 2), (4, 4, 4), (3, 5), (2, 3, 4, 2)]:
+        walk = list(_snake_indices(shape))
+        n = int(np.prod(shape))
+        assert len(walk) == n and len(set(walk)) == n  # Hamiltonian
+        for a, b in zip(walk, walk[1:]):
+            diffs = [abs(x - y) for x, y in zip(a, b)]
+            assert sum(diffs) == 1, f"{a}->{b} not a unit step"
+
+
+# ------------------------------------------------------- coords extraction
+
+def test_device_grid_coords_normalizes_offset_subgrid():
+    devs = [FakeDev((x + 4, y + 2, 7)) for x in range(2) for y in range(2)]
+    norm = device_grid_coords(devs)
+    assert set(norm.values()) == {(x, y, 0, 0) for x in range(2) for y in range(2)}
+
+
+def test_device_grid_coords_rejects_holes():
+    devs = torus(2, 2, 2)
+    assert device_grid_coords(devs[:-1] + [FakeDev((9, 9, 9))]) is None
+
+
+def test_device_grid_coords_none_without_coords():
+    class Bare:
+        pass
+
+    assert device_grid_coords([Bare(), Bare()]) is None
+
+
+def test_two_core_chips_get_core_dimension():
+    devs = [FakeDev((x, 0, 0), core=c, id_=2 * x + c)
+            for x in range(2) for c in range(2)]
+    grid = arrange_devices(devs, (2, 2))
+    # inner axis must vary core (the cheapest "link"), not cross chips
+    for row in grid:
+        assert row[0].coords == row[1].coords
+
+
+# --------------------------------------------------- the headline invariant
+
+@pytest.mark.parametrize("shape,sizes", [
+    ((2, 2, 2), (2, 4)),       # dp=2, tp=4 on a 2x2x2 cube
+    ((2, 2, 2), (2, 2, 2)),
+    ((4, 4, 4), (4, 16)),      # fsdp=4, tp=16 on v5p 4x4x4
+    ((4, 4, 4), (2, 2, 4, 4)),
+    ((4, 4, 1), (4, 4)),       # v5e 2D slice
+])
+def test_inner_axis_neighbors_are_one_torus_hop(shape, sizes):
+    devs = torus(*shape)
+    grid = arrange_devices(devs, sizes)
+    flat_rows = grid.reshape(-1, sizes[-1])
+    for row in flat_rows:
+        for a, b in zip(row, row[1:]):
+            assert hop_distance(a, b, shape) == 1, (
+                f"tp neighbors {a} {b} are {hop_distance(a, b, shape)} hops apart")
+
+
+def test_whole_walk_is_unit_steps_so_every_axis_stays_local():
+    # the flattened mesh order itself is a one-hop walk: outer axes get
+    # contiguous physical blocks too (dp blocks are compact sub-regions)
+    shape, sizes = (4, 4, 4), (4, 4, 4)
+    grid = arrange_devices(torus(*shape), sizes)
+    flat = grid.reshape(-1)
+    for a, b in zip(flat, flat[1:]):
+        assert hop_distance(a, b, shape) == 1
+
+
+def test_fallback_preserves_enumeration_order_without_coords():
+    class Bare:
+        def __init__(self, i):
+            self.id = i
+
+    devs = [Bare(i) for i in range(8)]
+    grid = arrange_devices(devs, (2, 4))
+    assert [d.id for d in grid.reshape(-1)] == list(range(8))
+
+
+def test_build_mesh_on_cpu_devices_still_works():
+    import jax
+
+    devs = jax.devices()
+    if len(devs) < 8:
+        pytest.skip("needs 8 virtual devices")
+    layout = ParallelLayout(dp=2, tp=4)
+    mesh = build_mesh(layout, devs)
+    assert mesh.shape["dp"] == 2 and mesh.shape["tp"] == 4
+
+
+def test_build_mesh_uses_coords_when_available():
+    devs = torus(2, 2, 2)
+    grid = arrange_devices(devs, (2, 2, 2))
+    # flat order must NOT be plain enumeration (snake reverses odd rows)
+    assert [d.id for d in grid.reshape(-1)] != list(range(8))
