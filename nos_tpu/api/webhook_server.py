@@ -1,0 +1,200 @@
+"""TLS validating-admission webhook server for the real-k8s path.
+
+Analog of the reference's webhook deployment: controller-runtime serves
+TLS AdmissionReview endpoints registered via ValidatingWebhookConfiguration
+(reference pkg/api/nos.nebuly.com/v1alpha1/elasticquota_webhook.go:30-80,
+config/operator/webhook/manifests.yaml). On the in-process double the same
+checks run as server-side admission hooks (api/webhooks.py); this module
+serves them over the wire so a REAL API server (kind/GKE, or the K8sSim
+envtest analog, which invokes registered webhook configurations on writes)
+enforces the quota invariants when the operator runs with ``--kubeconfig``.
+
+Protocol: admission.k8s.io/v1 AdmissionReview — POST a review request,
+always answer HTTP 200 with ``response.allowed`` (denials carry a Status
+with code 403 and the validator's message), echoing ``request.uid``.
+
+Certificates: ``generate_self_signed_cert`` shells out to the openssl CLI
+(present in all deploy images) producing a key/cert pair with localhost +
+service-DNS SANs; the PEM doubles as the caBundle in the webhook
+configuration the way cert-manager-less helm installs do it.
+"""
+from __future__ import annotations
+
+import base64
+import json
+import logging
+import ssl
+import subprocess
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from pathlib import Path
+from typing import Optional
+
+from nos_tpu.api.webhooks import (
+    _validate_composite_elastic_quota,
+    _validate_elastic_quota,
+)
+from nos_tpu.kube import k8s_codec as kc
+from nos_tpu.kube.apiserver import AdmissionDenied
+
+logger = logging.getLogger(__name__)
+
+# URL path -> (kind, validator). Paths follow the controller-runtime
+# convention /validate-<group>-<version>-<kind>.
+VALIDATORS = {
+    "/validate-nos-ai-v1alpha1-elasticquota":
+        ("ElasticQuota", _validate_elastic_quota),
+    "/validate-nos-ai-v1alpha1-compositeelasticquota":
+        ("CompositeElasticQuota", _validate_composite_elastic_quota),
+}
+
+
+def generate_self_signed_cert(cert_dir: str, cn: str = "nos-tpu-webhook",
+                              dns_names: Optional[list] = None) -> tuple:
+    """Create key.pem/cert.pem under ``cert_dir`` via the openssl CLI.
+    Returns (certfile, keyfile, ca_bundle_b64)."""
+    cert_dir = Path(cert_dir)
+    cert_dir.mkdir(parents=True, exist_ok=True)
+    cert, key = cert_dir / "cert.pem", cert_dir / "key.pem"
+    sans = ["DNS:localhost", "IP:127.0.0.1"] + [
+        f"DNS:{d}" for d in (dns_names or [])]
+    subprocess.run(
+        ["openssl", "req", "-x509", "-newkey", "rsa:2048",
+         "-keyout", str(key), "-out", str(cert), "-days", "365", "-nodes",
+         "-subj", f"/CN={cn}", "-addext", f"subjectAltName={','.join(sans)}"],
+        check=True, capture_output=True, timeout=60,
+    )
+    bundle = base64.b64encode(cert.read_bytes()).decode()
+    return str(cert), str(key), bundle
+
+
+class QuotaWebhookServer:
+    """Serve the quota validators as TLS AdmissionReview endpoints.
+
+    ``client`` is anything with ``.list(kind, namespace=None)`` — the
+    in-process ApiServer or the K8sApiServer REST adapter — used by the
+    validators to see existing quotas."""
+
+    def __init__(self, client, certfile: str, keyfile: str,
+                 host: str = "127.0.0.1", port: int = 0):
+        self.client = client
+        srv = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, fmt, *args):
+                pass
+
+            def _reply(self, payload: dict, code: int = 200) -> None:
+                body = json.dumps(payload).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                if self.path in ("/readyz", "/healthz"):
+                    self.send_response(200)
+                    self.send_header("Content-Length", "2")
+                    self.end_headers()
+                    self.wfile.write(b"ok")
+                    return
+                self._reply({"message": "POST AdmissionReview"}, 404)
+
+            def do_POST(self):
+                n = int(self.headers.get("Content-Length") or 0)
+                try:
+                    review = json.loads(self.rfile.read(n) or b"{}")
+                except json.JSONDecodeError:
+                    self._reply({"message": "invalid JSON"}, 400)
+                    return
+                self._reply(srv._review(self.path, review))
+
+        self.httpd = ThreadingHTTPServer((host, port), Handler)
+        self.httpd.daemon_threads = True
+        ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+        ctx.load_cert_chain(certfile, keyfile)
+        self.httpd.socket = ctx.wrap_socket(self.httpd.socket, server_side=True)
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def url(self) -> str:
+        h, p = self.httpd.server_address[:2]
+        return f"https://{h}:{p}"
+
+    def start(self) -> "QuotaWebhookServer":
+        self._thread = threading.Thread(
+            target=self.httpd.serve_forever, daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self.httpd.shutdown()
+        self.httpd.server_close()
+
+    # ------------------------------------------------------------------
+    def _review(self, path: str, review: dict) -> dict:
+        req = review.get("request") or {}
+        uid = req.get("uid", "")
+
+        def respond(allowed: bool, message: str = "") -> dict:
+            resp = {"uid": uid, "allowed": allowed}
+            if not allowed:
+                resp["status"] = {"code": 403, "reason": "Forbidden",
+                                  "message": message}
+            return {"apiVersion": "admission.k8s.io/v1",
+                    "kind": "AdmissionReview", "response": resp}
+
+        entry = VALIDATORS.get(path)
+        if entry is None:
+            return respond(False, f"no validator registered at {path}")
+        kind, validator = entry
+        op = req.get("operation", "CREATE")
+        if op == "DELETE":
+            return respond(True)
+        try:
+            raw = dict(req.get("object") or {})
+            raw.setdefault("kind", kind)
+            obj = kc.from_k8s(raw)
+            old = None
+            if req.get("oldObject"):
+                raw_old = dict(req["oldObject"])
+                raw_old.setdefault("kind", kind)
+                old = kc.from_k8s(raw_old)
+            validator(self.client, op, obj, old)
+        except AdmissionDenied as e:
+            return respond(False, str(e))
+        except Exception as e:  # malformed object etc.: fail closed
+            logger.warning("webhook %s errored", path, exc_info=True)
+            return respond(False, f"webhook error: {e}")
+        return respond(True)
+
+
+def webhook_configuration_manifest(url_base: str, ca_bundle_b64: str) -> dict:
+    """ValidatingWebhookConfiguration pointing at this server by URL (the
+    kind/dev shape; the helm chart renders the service-reference shape)."""
+    webhooks = []
+    for path, (kind, _) in sorted(VALIDATORS.items()):
+        plural = kc.ROUTES[kind][1]
+        webhooks.append({
+            "name": f"v{kind.lower()}.nos.ai",
+            "admissionReviewVersions": ["v1"],
+            "sideEffects": "None",
+            "failurePolicy": "Fail",
+            "clientConfig": {"url": f"{url_base}{path}",
+                             "caBundle": ca_bundle_b64},
+            "rules": [{
+                "apiGroups": ["nos.ai"],
+                "apiVersions": ["v1alpha1"],
+                "operations": ["CREATE", "UPDATE"],
+                "resources": [plural],
+            }],
+        })
+    return {
+        "apiVersion": "admissionregistration.k8s.io/v1",
+        "kind": "ValidatingWebhookConfiguration",
+        "metadata": {"name": "nos-tpu-validating-webhooks"},
+        "webhooks": webhooks,
+    }

@@ -1,13 +1,18 @@
-"""nos-tpu-operator — quota reconcilers.
+"""nos-tpu-operator — quota reconcilers + validating webhooks.
 
 Analog of cmd/operator/operator.go:50-126: a manager running the
-ElasticQuota + CompositeElasticQuota reconcilers (the validating webhooks
-live with the apiserver binary, which is the admission path here) with
-healthz/readyz probes and metrics.
+ElasticQuota + CompositeElasticQuota reconcilers with healthz/readyz
+probes and metrics. On the in-process double the admission checks run
+server-side (apiserver binary); with ``--webhook-certs`` the operator
+additionally serves them as TLS AdmissionReview endpoints
+(elasticquota_webhook.go:30-80 analog) for real clusters, where a
+ValidatingWebhookConfiguration (helm templates/operator/webhook.yaml)
+points the API server at this pod.
 """
 from __future__ import annotations
 
 import argparse
+import os
 from typing import Optional, Sequence
 
 from nos_tpu.api.configs import OperatorConfig
@@ -35,13 +40,35 @@ def build(server, config: Optional[OperatorConfig] = None) -> Manager:
 def main(argv: Optional[Sequence[str]] = None) -> None:
     parser = argparse.ArgumentParser(prog="nos-tpu-operator", description=__doc__)
     serve.common_flags(parser)
+    parser.add_argument(
+        "--webhook-certs", default=os.environ.get("NOS_TPU_WEBHOOK_CERTS", ""),
+        help="directory with cert.pem/key.pem: serve the EQ/CEQ validating "
+             "webhooks over TLS (real-cluster admission path)")
+    parser.add_argument(
+        "--webhook-port", type=int, default=9443,
+        help="TLS port for the validating webhooks")
     args = parser.parse_args(argv)
 
     cfg = OperatorConfig.from_yaml_file(args.config) if args.config \
         else OperatorConfig()
     serve.setup_logging(cfg.log_level)
-    mgr = build(serve.connect(args), cfg)
-    serve.run_daemon(mgr, args.health_port, args.health_host)
+    server = serve.connect(args)
+    webhook = None
+    if args.webhook_certs:
+        from nos_tpu.api.webhook_server import QuotaWebhookServer
+
+        webhook = QuotaWebhookServer(
+            server,
+            certfile=os.path.join(args.webhook_certs, "cert.pem"),
+            keyfile=os.path.join(args.webhook_certs, "key.pem"),
+            host="0.0.0.0", port=args.webhook_port,
+        ).start()
+    mgr = build(server, cfg)
+    try:
+        serve.run_daemon(mgr, args.health_port, args.health_host)
+    finally:
+        if webhook is not None:
+            webhook.stop()
 
 
 if __name__ == "__main__":

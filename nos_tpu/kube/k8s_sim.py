@@ -11,6 +11,15 @@ production REST adapter) is exercised over a genuine wire. Controllers
 tested against this sim run unmodified against kind/GKE because the
 adapter's request shapes are real k8s requests.
 
+Validating admission: ValidatingWebhookConfiguration objects POSTed to
+``/apis/admissionregistration.k8s.io/v1/validatingwebhookconfigurations``
+are honored — on CREATE/UPDATE of a matching resource the sim sends a real
+admission.k8s.io/v1 AdmissionReview to the configured ``clientConfig.url``
+over TLS (caBundle verified when provided) and turns ``allowed: false``
+into the 400-with-Status denial a real apiserver returns. This closes the
+loop for api/webhook_server.py: the same TLS webhook deployment that
+serves kind/GKE is exercised in-repo.
+
 Fidelity points deliberately mirrored from a real apiserver:
 
 - main-endpoint PUT on a Pod IGNORES status changes (status is a
@@ -275,6 +284,95 @@ class K8sSim:
         except OSError:
             pass
 
+    # -- validating admission ------------------------------------------
+    def _webhooks_for(self, group: str, resource: str) -> List[dict]:
+        """Registered webhook entries whose rules match this resource."""
+        out = []
+        with self.store.lock:
+            configs = [
+                copy.deepcopy(o)
+                for (g, r, _, _), o in self.store.objects.items()
+                if g == "admissionregistration.k8s.io"
+                and r == "validatingwebhookconfigurations"
+            ]
+        for cfg in configs:
+            for wh in cfg.get("webhooks") or []:
+                for rule in wh.get("rules") or []:
+                    groups = rule.get("apiGroups") or []
+                    resources = rule.get("resources") or []
+                    if (group in groups or "*" in groups) and \
+                            (resource in resources or "*" in resources):
+                        out.append((wh, rule))  # the rule that matched
+                        break
+        return out
+
+    def _admit(self, h, parts, operation: str, obj: dict,
+               old: Optional[dict]) -> bool:
+        """Run matching validating webhooks; on denial answer the request
+        with the real-apiserver 400 Status and return False."""
+        group, resource = parts["group"] or "", parts["resource"]
+        if resource == "validatingwebhookconfigurations":
+            return True
+        webhooks = self._webhooks_for(group, resource)
+        if not webhooks:
+            return True
+        import ssl as _ssl
+        import urllib.request as _rq
+        import uuid as _uuid
+
+        for wh, rule in webhooks:
+            if operation not in rule.get("operations",
+                                         ["CREATE", "UPDATE"]):
+                continue
+            url = (wh.get("clientConfig") or {}).get("url")
+            if not url:
+                continue
+            ctx = _ssl.create_default_context()
+            ca = (wh.get("clientConfig") or {}).get("caBundle")
+            if ca:
+                import base64 as _b64
+
+                ctx = _ssl.create_default_context(
+                    cadata=_b64.b64decode(ca).decode())
+                ctx.check_hostname = False  # URL may be an IP literal
+            else:
+                ctx.check_hostname = False
+                ctx.verify_mode = _ssl.CERT_NONE
+            review = {
+                "apiVersion": "admission.k8s.io/v1",
+                "kind": "AdmissionReview",
+                "request": {
+                    "uid": str(_uuid.uuid4()),
+                    "operation": operation,
+                    "namespace": parts["namespace"] or "",
+                    "object": obj,
+                    "oldObject": old,
+                },
+            }
+            req = _rq.Request(
+                url, data=json.dumps(review).encode(),
+                headers={"Content-Type": "application/json"}, method="POST")
+            try:
+                with _rq.urlopen(req, timeout=10, context=ctx) as resp:
+                    answer = json.loads(resp.read())
+            except Exception as e:
+                # failurePolicy Fail (the manifest default here): an
+                # unreachable webhook blocks the write, as on real k8s
+                if wh.get("failurePolicy", "Fail") == "Ignore":
+                    continue
+                h._deny(500, "InternalError",
+                        f"calling webhook {wh.get('name')}: {e}")
+                return False
+            r = answer.get("response") or {}
+            if not r.get("allowed"):
+                msg = ((r.get("status") or {}).get("message")
+                       or "admission webhook denied the request")
+                h._deny(400, "Invalid",
+                        f"admission webhook \"{wh.get('name')}\" denied the "
+                        f"request: {msg}")
+                return False
+        return True
+
     # -- POST ----------------------------------------------------------
     def _post(self, h) -> None:
         parts, _ = self._parse(h.path)
@@ -290,9 +388,13 @@ class K8sSim:
             # store CRDs like any object (no schema enforcement, as envtest
             # without validation webhooks)
             parts = dict(parts, namespace=None, name=None)
+        if parts["group"] == "admissionregistration.k8s.io":
+            parts = dict(parts, namespace=None)
         name = (body.get("metadata") or {}).get("name")
         if not name:
             h._deny(422, "Invalid", "metadata.name required")
+            return
+        if not self._admit(h, parts, "CREATE", body, None):
             return
         with self.store.lock:
             key = self._key(parts, name)
@@ -342,6 +444,13 @@ class K8sSim:
             h._deny(404, "NotFound", f"unknown path {h.path}")
             return
         body = h._body()
+        if parts["subresource"] is None:
+            with self.store.lock:
+                old = copy.deepcopy(self.store.objects.get(self._key(parts)))
+            # webhook call happens outside the store lock (network I/O)
+            if old is not None and not self._admit(h, parts, "UPDATE",
+                                                   body, old):
+                return
         with self.store.lock:
             key = self._key(parts)
             current = self.store.objects.get(key)

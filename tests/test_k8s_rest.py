@@ -243,3 +243,29 @@ def test_e2e_operator_and_scheduler_over_k8s_rest(sim, api):
 
     for m in (op, sched):
         m.stop()
+
+
+def test_bind_patch_applies_status_over_the_wire(sim, api):
+    """Regression: the trimmed bind path must still land the status
+    facet. A scheduler bind sets nodeName (via binding) AND clears the
+    nomination / sets PodScheduled=True (via /status with the
+    post-binding resourceVersion) — round 3's first cut silently lost
+    the status PUT to a stale-RV 409."""
+    raw(sim, "POST", "/api/v1/namespaces/ns/pods", k8s_pod("bindme", ns="ns"))
+    # simulate a prior nomination
+    api.patch("Pod", "bindme", "ns",
+              lambda p: setattr(p.status, "nominated_node_name", "n-old"))
+
+    from nos_tpu.kube.objects import PodCondition
+
+    def bind(p):
+        p.spec.node_name = "n-new"
+        p.status.nominated_node_name = ""
+        p.status.conditions = [PodCondition(type="PodScheduled", status="True")]
+
+    api.patch("Pod", "bindme", "ns", bind)
+    got = api.get("Pod", "bindme", "ns")
+    assert got.spec.node_name == "n-new"
+    assert got.status.nominated_node_name == ""
+    assert any(c.type == "PodScheduled" and c.status == "True"
+               for c in got.status.conditions)

@@ -1,0 +1,172 @@
+"""Real validating admission on the REST path (VERDICT r2 next #5):
+TLS AdmissionReview webhook server + K8sSim invoking registered
+ValidatingWebhookConfigurations on writes. Reference analog:
+pkg/api/nos.nebuly.com/v1alpha1/elasticquota_webhook.go:30-80 served via
+controller-runtime's TLS webhook server."""
+import json
+import shutil
+import ssl
+import urllib.request
+
+import pytest
+
+from nos_tpu.api.quota import (
+    CompositeElasticQuota, CompositeElasticQuotaSpec, ElasticQuota,
+    ElasticQuotaSpec,
+)
+from nos_tpu.api.webhook_server import (
+    QuotaWebhookServer, generate_self_signed_cert,
+    webhook_configuration_manifest,
+)
+from nos_tpu.kube.apiserver import ApiServer
+from nos_tpu.kube.k8s_sim import K8sSim
+from nos_tpu.kube.objects import ObjectMeta
+from nos_tpu.kube.rest import ApiError, K8sApiServer
+
+pytestmark = pytest.mark.skipif(
+    shutil.which("openssl") is None, reason="openssl CLI unavailable")
+
+
+def eq(name, ns, mn=4, mx=8):
+    return ElasticQuota(
+        metadata=ObjectMeta(name=name, namespace=ns),
+        spec=ElasticQuotaSpec(min={"cpu": mn}, max={"cpu": mx}),
+    )
+
+
+def ceq(name, namespaces, mn=4, mx=8):
+    return CompositeElasticQuota(
+        metadata=ObjectMeta(name=name, namespace="default"),
+        spec=CompositeElasticQuotaSpec(
+            namespaces=list(namespaces), min={"cpu": mn}, max={"cpu": mx}),
+    )
+
+
+@pytest.fixture(scope="module")
+def certs(tmp_path_factory):
+    d = tmp_path_factory.mktemp("webhook-certs")
+    return generate_self_signed_cert(str(d))
+
+
+@pytest.fixture()
+def rig(certs):
+    """K8sSim + REST adapter + TLS webhook server wired via a registered
+    ValidatingWebhookConfiguration — the full real-cluster shape."""
+    certfile, keyfile, bundle = certs
+    sim = K8sSim().start()
+    client = K8sApiServer(base_url=sim.url)
+    webhook = QuotaWebhookServer(client, certfile, keyfile).start()
+    manifest = webhook_configuration_manifest(webhook.url, bundle)
+    req = urllib.request.Request(
+        sim.url + "/apis/admissionregistration.k8s.io/v1/"
+        "validatingwebhookconfigurations",
+        data=json.dumps(manifest).encode(),
+        headers={"Content-Type": "application/json"}, method="POST")
+    assert urllib.request.urlopen(req, timeout=10).status == 201
+    yield sim, client, webhook
+    webhook.stop()
+    sim.stop()
+
+
+def test_direct_admission_review_roundtrip(certs):
+    """Protocol shape: POST an AdmissionReview over TLS, get allowed."""
+    certfile, keyfile, bundle = certs
+    backing = ApiServer()
+    srv = QuotaWebhookServer(backing, certfile, keyfile).start()
+    try:
+        ctx = ssl.create_default_context()
+        ctx.check_hostname = False
+        ctx.verify_mode = ssl.CERT_NONE
+        from nos_tpu.kube import k8s_codec as kc
+
+        review = {
+            "apiVersion": "admission.k8s.io/v1", "kind": "AdmissionReview",
+            "request": {"uid": "u-1", "operation": "CREATE",
+                        "object": kc.to_k8s(eq("q", "team-a"))},
+        }
+        req = urllib.request.Request(
+            srv.url + "/validate-nos-ai-v1alpha1-elasticquota",
+            data=json.dumps(review).encode(),
+            headers={"Content-Type": "application/json"}, method="POST")
+        with urllib.request.urlopen(req, timeout=10, context=ctx) as resp:
+            answer = json.loads(resp.read())
+        assert answer["response"]["uid"] == "u-1"
+        assert answer["response"]["allowed"] is True
+    finally:
+        srv.stop()
+
+
+def test_two_elasticquotas_one_namespace_denied_over_wire(rig):
+    sim, client, _ = rig
+    client.create(eq("quota-a", "team-a"))
+    with pytest.raises(ApiError) as exc:
+        client.create(eq("quota-b", "team-a"))
+    assert "already has ElasticQuota" in str(exc.value)
+    # the denied object must not exist
+    names = [o.metadata.name for o in client.list("ElasticQuota",
+                                                  namespace="team-a")]
+    assert names == ["quota-a"]
+
+
+def test_eq_ceq_overlap_denied_over_wire(rig):
+    sim, client, _ = rig
+    client.create(ceq("composite", ["team-b", "team-c"]))
+    with pytest.raises(ApiError) as exc:
+        client.create(eq("quota-b", "team-b"))
+    assert "covered by CompositeElasticQuota" in str(exc.value)
+
+
+def test_ceq_namespace_overlap_denied_over_wire(rig):
+    sim, client, _ = rig
+    client.create(ceq("composite-1", ["team-d", "team-e"]))
+    with pytest.raises(ApiError) as exc:
+        client.create(ceq("composite-2", ["team-e", "team-f"]))
+    assert "already belong" in str(exc.value)
+
+
+def test_max_less_than_min_denied_over_wire(rig):
+    sim, client, _ = rig
+    with pytest.raises(ApiError) as exc:
+        client.create(eq("bad", "team-g", mn=8, mx=4))
+    assert "less than min" in str(exc.value)
+
+
+def test_update_also_validated(rig):
+    sim, client, _ = rig
+    client.create(eq("quota-h", "team-h"))
+
+    got = client.get("ElasticQuota", "quota-h", "team-h")
+    got.spec.max = {"cpu": 1}  # < min: must be denied on UPDATE
+    with pytest.raises(ApiError) as exc:
+        client.update(got)
+    assert "less than min" in str(exc.value)
+
+
+def test_valid_writes_pass_through(rig):
+    sim, client, _ = rig
+    client.create(eq("quota-i", "team-i"))
+    got = client.get("ElasticQuota", "quota-i", "team-i")
+    got.spec.max = {"cpu": 16}
+    client.update(got)
+    assert client.get("ElasticQuota", "quota-i",
+                      "team-i").spec.max == {"cpu": 16}
+
+
+def test_unreachable_webhook_fails_closed(certs):
+    """failurePolicy Fail: a dead webhook blocks quota writes."""
+    certfile, keyfile, bundle = certs
+    sim = K8sSim().start()
+    client = K8sApiServer(base_url=sim.url)
+    manifest = webhook_configuration_manifest(
+        "https://127.0.0.1:1", bundle)  # nothing listens there
+    req = urllib.request.Request(
+        sim.url + "/apis/admissionregistration.k8s.io/v1/"
+        "validatingwebhookconfigurations",
+        data=json.dumps(manifest).encode(),
+        headers={"Content-Type": "application/json"}, method="POST")
+    urllib.request.urlopen(req, timeout=10)
+    try:
+        with pytest.raises(ApiError):
+            client.create(eq("q", "team-z"))
+    finally:
+        sim.stop()
