@@ -9,11 +9,14 @@ program, stage identity from ``axis_index``), not P separate programs.
 
 Composition contract:
 - ``pp`` is the only *manual* axis (``jax.shard_map(axis_names={"pp"})``);
-  dp/fsdp/tp stay auto, so GSPMD still shards the within-stage matmuls —
-  pipeline composes freely with data/tensor parallelism.
+  dp/fsdp/tp/ep stay auto, so GSPMD still shards the within-stage matmuls
+  — pipeline composes freely with data/tensor parallelism AND with MoE
+  expert parallelism (the dispatch/combine einsums are dense, so the ep
+  all-to-alls need no manual axis; the load-balancing aux loss is
+  accumulated per stage x microbatch and psum'd over pp).
 - sequence parallelism (sp/ring attention) does not compose with pp in this
   implementation (it would nest shard_maps); long-context jobs pick sp,
-  depth-bound jobs pick pp. MoE layers are likewise dense-path only here.
+  depth-bound jobs pick pp.
 
 Two schedules:
 
@@ -57,8 +60,6 @@ def _check(cfg: TransformerConfig, mesh: Mesh, batch: int, n_microbatches: int):
         raise ValueError("mesh has no pp axis")
     if "sp" in mesh.axis_names and mesh.shape["sp"] > 1:
         raise ValueError("pipeline does not compose with sp (ring attention)")
-    if cfg.n_experts:
-        raise ValueError("pipeline supports the dense FFN path only")
     stages = mesh.shape["pp"]
     if cfg.n_layers % stages:
         raise ValueError(
@@ -75,9 +76,12 @@ def pipeline_forward(
     tokens: jax.Array,
     mesh: Mesh,
     n_microbatches: int = 2,
+    return_aux: bool = False,
 ) -> jax.Array:
-    """tokens [B, S] -> logits [B, S, vocab], layer stack executed as a
-    P-stage pipeline over the mesh's pp axis. Numerically identical to
+    """tokens [B, S] -> logits [B, S, vocab] (plus the MoE auxiliary loss,
+    averaged over layers x microbatches, when ``return_aux``), layer stack
+    executed as a P-stage pipeline over the mesh's pp axis. Numerically
+    identical to
     ``transformer.forward`` on the dense path."""
     b, s = tokens.shape
     stages = _check(cfg, mesh, b, n_microbatches)
@@ -103,46 +107,54 @@ def pipeline_forward(
         perm = [(i, (i + 1) % stages) for i in range(stages)]
 
         def step(carry, t):
-            recv, outputs = carry
+            recv, outputs, aux_acc = carry
             mb_idx = t - p_idx
             first = microbatches[jnp.clip(t, 0, n_microbatches - 1)]
             inp = jnp.where(p_idx == 0, first, recv)
-            y = stage_fn(local_params, inp)
+            y, aux = stage_fn(local_params, inp)
             active = (mb_idx >= 0) & (mb_idx < n_microbatches)
+            aux_acc = aux_acc + jnp.where(active, aux, 0.0)
             write = jnp.clip(mb_idx, 0, n_microbatches - 1)
             updated = jax.lax.dynamic_update_index_in_dim(outputs, y, write, 0)
             outputs = jnp.where(active & (p_idx == stages - 1),
                                 updated, outputs)
             recv = jax.lax.ppermute(y, "pp", perm)
-            return (recv, outputs), None
+            return (recv, outputs, aux_acc), None
 
         zeros = jnp.zeros_like(microbatches[0])
         out0 = jnp.zeros_like(microbatches)
-        (_, outputs), _ = jax.lax.scan(
-            step, (zeros, out0), jnp.arange(n_steps))
-        # [1, M, mb, S, d]: stacked back over pp by out_specs
-        return outputs[None]
+        (_, outputs, aux_acc), _ = jax.lax.scan(
+            step, (zeros, out0, jnp.float32(0.0)), jnp.arange(n_steps))
+        # outputs [1, M, mb, S, d] stacked over pp; aux summed across
+        # stages here (psum -> replicated scalar out_spec)
+        return outputs[None], jax.lax.psum(aux_acc, "pp")
 
-    stacked = jax.shard_map(
+    stacked, aux_sum = jax.shard_map(
         stage_program,
         mesh=mesh,
         in_specs=(P("pp"), P()),
-        out_specs=P("pp"),
+        out_specs=(P("pp"), P()),
         axis_names={"pp"},
         check_vma=False,
     )(stage_params, mbs)
     x = stacked[-1].reshape(b, s, cfg.d_model)        # last stage's outputs
 
     x = rms_norm(x, params["final_norm"])
-    return jnp.dot(x, params["unembed"]).astype(jnp.float32)
+    logits = jnp.dot(x, params["unembed"]).astype(jnp.float32)
+    if return_aux:
+        # mean over all L layers and M microbatches (each stage summed its
+        # K layers over its M active ticks; psum folded the stages)
+        aux = aux_sum / (cfg.n_layers * n_microbatches)
+        return logits, aux
+    return logits
 
 
 def pipeline_loss_fn(params: Params, cfg: TransformerConfig,
                      batch: Dict[str, jax.Array], mesh: Mesh,
                      n_microbatches: int = 2) -> jax.Array:
-    logits = pipeline_forward(params, cfg, batch["tokens"], mesh,
-                              n_microbatches)
-    return cross_entropy(logits, batch["targets"])
+    logits, aux = pipeline_forward(params, cfg, batch["tokens"], mesh,
+                                   n_microbatches, return_aux=True)
+    return cross_entropy(logits, batch["targets"]) + cfg.moe_aux_weight * aux
 
 
 # ---------------------------------------------------------------------------
@@ -150,7 +162,12 @@ def pipeline_loss_fn(params: Params, cfg: TransformerConfig,
 # ---------------------------------------------------------------------------
 
 def _stage_fn_factory(cfg: TransformerConfig, freqs):
-    """Per-stage forward: scan this stage's K layers over one microbatch."""
+    """Per-stage forward: scan this stage's K layers over one microbatch.
+    Returns ``stage_fn(local_params, x) -> (y, aux_sum)`` where aux_sum is
+    the summed MoE load-balancing loss of this stage's layers (0.0 on the
+    dense path). Experts stay GSPMD-auto over the ep mesh axis — dense
+    dispatch/combine einsums need no manual axis, so ep composes with the
+    pipeline's manual pp axis for free."""
 
     def attention_call(q, k, v):
         return attention(
@@ -158,15 +175,31 @@ def _stage_fn_factory(cfg: TransformerConfig, freqs):
             v.transpose(0, 2, 1, 3), causal=True,
         ).transpose(0, 2, 1, 3)
 
-    def layer_body(h_in, layer):
-        return dense_layer_block(h_in, layer, cfg, freqs, attention_call), None
+    if cfg.n_experts > 0:
+        from nos_tpu.models.transformer import attention_block
+        from nos_tpu.ops.layers import rms_norm as _rms_norm
+        from nos_tpu.ops.moe import moe_ffn
+
+        def layer_body(h_in, layer):
+            x = attention_block(h_in, layer, cfg, freqs, attention_call)
+            h = _rms_norm(x, layer["mlp_norm"])
+            y, aux = moe_ffn(
+                h, layer["w_router"], layer["w_gate"], layer["w_up"],
+                layer["w_down"], cfg.expert_capacity_factor,
+            )
+            return x + y, aux
+    else:
+        def layer_body(h_in, layer):
+            return (dense_layer_block(h_in, layer, cfg, freqs,
+                                      attention_call),
+                    jnp.float32(0.0))
 
     if cfg.remat:
         layer_body = jax.checkpoint(layer_body)
 
     def stage_fn(local_params, x):
-        out, _ = jax.lax.scan(layer_body, x, local_params)
-        return out
+        out, aux = jax.lax.scan(layer_body, x, local_params)
+        return out, jnp.sum(aux)
 
     return stage_fn
 
@@ -199,11 +232,15 @@ def _make_1f1b_op(cfg: TransformerConfig, mesh: Mesh, n_microbatches: int,
         zero_layer_grads = jax.tree.map(jnp.zeros_like, local_params)
         zero_head_grads = jax.tree.map(jnp.zeros_like, head)
 
+        # constant per-layer-sum aux cotangent: total aux term is
+        # w * (1/(L*M)) * sum over (stage, microbatch) of stage aux sums
+        aux_ct = jnp.float32(cfg.moe_aux_weight / (cfg.n_layers * M))
+
         def fwd_unit(carry, t):
             recv_f, recv_g, act, gl, gh, dxs, loss = carry
             fm = jnp.clip((t - p_idx) // 2, 0, M - 1)
             x_in = jnp.where(is_first, xs[fm], recv_f)
-            y = stage_fn(local_params, x_in)
+            y, _aux = stage_fn(local_params, x_in)  # aux recomputed in bwd
             act = jax.lax.dynamic_update_index_in_dim(
                 act, x_in, fm % Pn, 0)
             g_send = jnp.zeros(mb_shape, xs.dtype)
@@ -213,7 +250,7 @@ def _make_1f1b_op(cfg: TransformerConfig, mesh: Mesh, n_microbatches: int,
             recv_f, recv_g, act, gl, gh, dxs, loss = carry
             bm = jnp.clip((t - (2 * Pn - 1 - p_idx)) // 2, 0, M - 1)
             x_in = act[bm % Pn]
-            y, pull = jax.vjp(stage_fn, local_params, x_in)
+            (y, aux), pull = jax.vjp(stage_fn, local_params, x_in)
 
             def head_cotangent(_):
                 loss_m, head_pull = jax.vjp(
@@ -226,10 +263,10 @@ def _make_1f1b_op(cfg: TransformerConfig, mesh: Mesh, n_microbatches: int,
 
             g_in, dh, loss_m = jax.lax.cond(
                 is_last, head_cotangent, relay_cotangent, operand=None)
-            d_params, d_x = pull(g_in)
+            d_params, d_x = pull((g_in, aux_ct))
             gl = jax.tree.map(jnp.add, gl, d_params)
             gh = jax.tree.map(jnp.add, gh, dh)
-            loss = loss + loss_m
+            loss = loss + loss_m + aux_ct * aux
             dxs_upd = jax.lax.dynamic_update_index_in_dim(
                 dxs, d_x.astype(dxs.dtype), bm, 0)
             dxs = jnp.where(is_first, dxs_upd, dxs)
@@ -288,16 +325,19 @@ def _make_1f1b_op(cfg: TransformerConfig, mesh: Mesh, n_microbatches: int,
         is_last = p_idx == Pn - 1
         fwd_perm = [(i, (i + 1) % Pn) for i in range(Pn)]
 
+        aux_ct = jnp.float32(cfg.moe_aux_weight / (cfg.n_layers * M))
+
         def step(carry, t):
             recv_f, loss = carry
             m = jnp.clip(t - p_idx, 0, M - 1)
             active = (t - p_idx >= 0) & (t - p_idx < M)
             x_in = jnp.where(p_idx == 0, xs[m], recv_f)
-            y = stage_fn(local_params, x_in)
+            y, aux = stage_fn(local_params, x_in)
             loss_m = jax.lax.cond(
                 is_last & active,
                 lambda: _head_fn(head, y, targets[m]) / M,
                 lambda: jnp.float32(0.0))
+            loss_m = loss_m + jnp.where(active, aux_ct * aux, 0.0)
             recv_f = jax.lax.ppermute(y, "pp", fwd_perm)
             return (recv_f, loss + loss_m), None
 

@@ -278,3 +278,19 @@ def test_demo_subslice_overlay_requests_partition_resource():
         patch = yaml.safe_load(f)
     limits = patch["spec"]["template"]["spec"]["containers"][0]["resources"]["limits"]
     assert any(k.startswith("nos.ai/tpu-slice-") for k in limits)
+
+
+def test_kind_e2e_script_runs_or_skips():
+    """hack/kind/run-e2e.sh is the scripted real-apiserver runbook
+    (VERDICT r2 next #10). Exit 2 = environment can't run it (no kind /
+    no container runtime) -> skip; 0 = the full stack bound a pod against
+    a real kube-apiserver; anything else is a genuine failure."""
+    import subprocess
+
+    script = os.path.join(REPO, "hack", "kind", "run-e2e.sh")
+    assert os.access(script, os.X_OK)
+    proc = subprocess.run(["bash", script], capture_output=True, text=True,
+                          timeout=600)
+    if proc.returncode == 2:
+        pytest.skip(f"kind e2e unavailable: {proc.stdout.strip()[-100:]}")
+    assert proc.returncode == 0, proc.stdout[-2000:] + proc.stderr[-2000:]

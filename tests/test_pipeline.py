@@ -102,10 +102,6 @@ def test_pipeline_validation_errors():
     params4 = tfm.init_params(jax.random.PRNGKey(0), cfg4)
     with pytest.raises(ValueError, match="n_microbatches"):
         pipeline_forward(params4, cfg4, tokens, mesh, n_microbatches=3)
-    moe = small_cfg(n_experts=2)
-    with pytest.raises(ValueError, match="dense"):
-        pipeline_forward(tfm.init_params(jax.random.PRNGKey(0), moe),
-                         moe, tokens, mesh)
     sp_mesh = build_mesh(ParallelLayout(pp=2, sp=2), jax.devices()[:4])
     with pytest.raises(ValueError, match="sp"):
         pipeline_forward(params4, cfg4, tokens, sp_mesh)
@@ -216,10 +212,80 @@ def test_1f1b_activation_residency_is_P_not_M():
     assert f1b < gpipe, f"1f1b temp {f1b} not below gpipe {gpipe}"
 
 
-def test_1f1b_rejects_sp_and_moe_like_gpipe():
-    cfg = small_cfg(n_experts=2)
-    mesh = pp_mesh(pp=2)
+def test_pipeline_still_rejects_sp():
+    cfg = small_cfg()
+    layout = ParallelLayout(sp=2, pp=2, dp=2)
+    mesh = build_mesh(layout, jax.devices()[:8])
     params = tfm.init_params(jax.random.PRNGKey(0), cfg)
-    with pytest.raises(ValueError, match="dense"):
+    with pytest.raises(ValueError, match="sp"):
         pipeline_1f1b_loss_fn(params, cfg, _batch(cfg, jax.random.PRNGKey(1)),
                               mesh, 2)
+
+
+# ---------------------------------------------------------------------------
+# ep (MoE) composed with pp — VERDICT r2 weak #9
+# ---------------------------------------------------------------------------
+
+def ep_pp_mesh():
+    layout = ParallelLayout(dp=2, ep=2, pp=2)
+    return build_mesh(layout, jax.devices()[:8])
+
+
+def test_moe_pipeline_matches_plain_forward_single_microbatch():
+    # M=1: per-microbatch aux == full-batch aux, so the match is exact
+    cfg = small_cfg(n_experts=4)
+    mesh = ep_pp_mesh()
+    params = tfm.init_params(jax.random.PRNGKey(10), cfg)
+    batch = _batch(cfg, jax.random.PRNGKey(11), b=4)
+
+    ref = tfm.loss_fn(params, cfg, batch)
+    gpipe = jax.jit(lambda p, b: pipeline_loss_fn(p, cfg, b, mesh, 1))(
+        params, batch)
+    f1b = jax.jit(lambda p, b: pipeline_1f1b_loss_fn(p, cfg, b, mesh, 1))(
+        params, batch)
+    np.testing.assert_allclose(float(gpipe), float(ref), rtol=2e-4)
+    np.testing.assert_allclose(float(f1b), float(ref), rtol=2e-4)
+
+
+def test_moe_1f1b_matches_gpipe_and_trains():
+    # M>1: aux is averaged per microbatch in BOTH pipeline schedules, so
+    # they must agree with each other (and differ from full-batch only by
+    # the nonlinear load-balance term)
+    import optax
+
+    cfg = small_cfg(n_experts=4)
+    mesh = ep_pp_mesh()
+    params = tfm.init_params(jax.random.PRNGKey(12), cfg)
+    batch = _batch(cfg, jax.random.PRNGKey(13), b=8)
+
+    gpipe = jax.jit(lambda p, b: pipeline_loss_fn(p, cfg, b, mesh, 4))(
+        params, batch)
+    f1b = jax.jit(lambda p, b: pipeline_1f1b_loss_fn(p, cfg, b, mesh, 4))(
+        params, batch)
+    np.testing.assert_allclose(float(f1b), float(gpipe), rtol=2e-4)
+
+    step = jax.jit(make_pipeline_train_step(cfg, optax.adam(1e-2), mesh, 4,
+                                            schedule="1f1b"))
+    opt_state = optax.adam(1e-2).init(params)
+    losses = []
+    for _ in range(4):
+        params, opt_state, loss = step(params, opt_state, batch)
+        losses.append(float(loss))
+    assert losses[-1] < losses[0]
+
+
+def test_moe_1f1b_grads_match_gpipe_backward():
+    cfg = small_cfg(n_experts=4)
+    mesh = ep_pp_mesh()
+    params = tfm.init_params(jax.random.PRNGKey(14), cfg)
+    batch = _batch(cfg, jax.random.PRNGKey(15), b=4)
+
+    g_ref = jax.jit(jax.grad(
+        lambda p: pipeline_loss_fn(p, cfg, batch, mesh, 2)))(params)
+    g_f1b = jax.jit(jax.grad(
+        lambda p: pipeline_1f1b_loss_fn(p, cfg, batch, mesh, 2)))(params)
+    for (pr, r), (pg, g) in zip(jax.tree.leaves_with_path(g_ref),
+                                jax.tree.leaves_with_path(g_f1b)):
+        assert pr == pg
+        np.testing.assert_allclose(np.asarray(g), np.asarray(r),
+                                   rtol=5e-3, atol=5e-4, err_msg=str(pr))
