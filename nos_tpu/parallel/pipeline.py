@@ -14,9 +14,16 @@ Composition contract:
   expert parallelism (the dispatch/combine einsums are dense, so the ep
   all-to-alls need no manual axis; the load-balancing aux loss is
   accumulated per stage x microbatch and psum'd over pp).
-- sequence parallelism (sp/ring attention) does not compose with pp in this
-  implementation (it would nest shard_maps); long-context jobs pick sp,
-  depth-bound jobs pick pp.
+- sequence parallelism (sp/ring attention) does not compose with pp.
+  Both routes were implemented and measured unshippable on the current
+  toolchain (round 3): (a) manual sp — ring attention's ppermutes end up
+  inside the 1F1B tick's ``lax.cond``, and at any tick different pp rows
+  take different branches, so manual collectives under divergent control
+  flow mispair (wrong loss, reproduced); (b) auto sp — seeding GSPMD
+  propagation of an sp-sharded sequence dim through the manual-pp
+  shard_map SIGABRTs XLA:CPU. Long-context jobs pick sp, depth-bound
+  jobs pick pp; revisit (b) when shard_map auto-axis propagation
+  stabilizes.
 
 Two schedules:
 
