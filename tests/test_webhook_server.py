@@ -170,3 +170,97 @@ def test_unreachable_webhook_fails_closed(certs):
             client.create(eq("q", "team-z"))
     finally:
         sim.stop()
+
+
+def test_operator_cmd_serves_webhooks(certs, tmp_path, monkeypatch):
+    """The --webhook-certs wiring in cmd/operator.py: main() starts the
+    TLS AdmissionReview server alongside the reconcilers (the helm
+    deployment shape). Driven through the real argv path with the manager
+    daemon on a thread; run_daemon is intercepted so the manager can be
+    stopped (and the webhook's finally-stop runs) when the test ends."""
+    import shutil as sh
+    import socket
+    import threading
+    import time
+
+    from nos_tpu.cmd import operator as op_cmd, serve
+
+    certfile, keyfile, bundle = certs
+    cert_dir = tmp_path / "certs"
+    cert_dir.mkdir()
+    sh.copy(certfile, cert_dir / "cert.pem")
+    sh.copy(keyfile, cert_dir / "key.pem")
+
+    managers = []
+    stop_evt = threading.Event()
+
+    def fake_run_daemon(manager, health_port, health_host):
+        managers.append(manager)
+        threading.Thread(target=manager.run, daemon=True).start()
+        stop_evt.wait(30)
+        manager.stop()
+
+    monkeypatch.setattr(serve, "run_daemon", fake_run_daemon)
+
+    with socket.socket() as s:  # ephemeral free port
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+
+    sim = K8sSim().start()
+    kubeconfig = tmp_path / "kubeconfig"
+    kubeconfig.write_text(f"""
+apiVersion: v1
+kind: Config
+current-context: sim
+contexts:
+- name: sim
+  context: {{cluster: sim, user: sim-user}}
+clusters:
+- name: sim
+  cluster: {{server: "{sim.url}"}}
+users:
+- name: sim-user
+  user: {{token: "t"}}
+""")
+    t = threading.Thread(
+        target=op_cmd.main,
+        args=([f"--kubeconfig={kubeconfig}", "--webhook-certs", str(cert_dir),
+               "--webhook-port", str(port)],),
+        daemon=True,
+    )
+    t.start()
+    try:
+        ctx = ssl.create_default_context()
+        ctx.check_hostname = False
+        ctx.verify_mode = ssl.CERT_NONE
+        deadline = time.monotonic() + 15
+        ready = False
+        while time.monotonic() < deadline and not ready:
+            try:
+                req = urllib.request.Request(
+                    f"https://127.0.0.1:{port}/readyz")
+                with urllib.request.urlopen(req, timeout=2, context=ctx) as r:
+                    ready = r.status == 200
+            except Exception:
+                time.sleep(0.2)
+        assert ready, "operator webhook endpoint never came up"
+
+        from nos_tpu.kube import k8s_codec as kc
+
+        review = {
+            "apiVersion": "admission.k8s.io/v1", "kind": "AdmissionReview",
+            "request": {"uid": "op-1", "operation": "CREATE",
+                        "object": kc.to_k8s(eq("bad", "ns-x", mn=8, mx=4))},
+        }
+        req = urllib.request.Request(
+            f"https://127.0.0.1:{port}/validate-nos-ai-v1alpha1-elasticquota",
+            data=json.dumps(review).encode(),
+            headers={"Content-Type": "application/json"}, method="POST")
+        with urllib.request.urlopen(req, timeout=10, context=ctx) as resp:
+            answer = json.loads(resp.read())
+        assert answer["response"]["allowed"] is False
+        assert "less than min" in answer["response"]["status"]["message"]
+    finally:
+        stop_evt.set()
+        t.join(timeout=10)
+        sim.stop()
