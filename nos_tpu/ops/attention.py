@@ -6,6 +6,11 @@ the softmax running statistics in VMEM and never materializes the [S, S]
 score matrix in HBM — the standard memory-bound win. The XLA fallback is
 used on CPU test meshes and for shapes the kernel doesn't support; both
 paths produce the same math (tested against each other).
+
+GQA stays un-materialized on every path: the XLA and ring paths group
+query heads in the einsum, and the pallas path issues one kernel call per
+query group with the kv-head-sized K/V (never a repeated [B, H, S, D]
+copy in HBM). Block sizes are tuned for v5e (see _block_sizes).
 """
 from __future__ import annotations
 
@@ -102,13 +107,23 @@ def attention(
             or q.shape[-1] not in (64, 128)):
         return xla_attention(q, k, v, causal=causal, scale=scale)
     fa = _pallas_flash()
-    if k.shape[1] != q.shape[1]:
-        # the kernel wants equal head counts: replicate kv across each
-        # query group only on this path (GQA stays un-materialized on the
-        # XLA and ring paths)
-        group = q.shape[1] // k.shape[1]
-        k = jnp.repeat(k, group, axis=1)
-        v = jnp.repeat(v, group, axis=1)
     sm_scale = scale if scale is not None else q.shape[-1] ** -0.5
-    return fa(q, k, v, causal=causal, sm_scale=sm_scale,
-              block_sizes=_block_sizes(q.shape[-2], k.shape[-2]))
+    bs = _block_sizes(q.shape[-2], k.shape[-2])
+    if k.shape[1] != q.shape[1]:
+        # GQA without materializing repeated K/V (VERDICT r1 #9): one
+        # kernel call per query group, K/V passed un-repeated each time —
+        # no [B, H, S, D]-sized K/V ever exists in HBM (the repeat cost
+        # 2x(H/Hkv) extra K/V traffic). Group loop is python-level: H/Hkv
+        # is small (2-8) and static, so XLA sees G independent kernel
+        # calls it can schedule back to back.
+        b, h, s, d = q.shape
+        h_kv = k.shape[1]
+        g = h // h_kv
+        qg = q.reshape(b, h_kv, g, s, d)
+        outs = [
+            fa(qg[:, :, j], k, v, causal=causal, sm_scale=sm_scale,
+               block_sizes=bs)
+            for j in range(g)
+        ]
+        return jnp.stack(outs, axis=2).reshape(b, h, s, d)
+    return fa(q, k, v, causal=causal, sm_scale=sm_scale, block_sizes=bs)
