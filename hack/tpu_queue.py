@@ -1,0 +1,82 @@
+#!/usr/bin/env python3
+"""Round-3 TPU validation queue — run when the axon tunnel is back.
+
+1. pallas-vs-XLA parity at the bench shape (GQA per-group kernel calls +
+   tuned block sizes must be numerically equal to the reference einsum);
+2. one honest bench_mfu measurement (published config);
+3. remat x batch sweep points that OOM'd or are newly interesting with
+   the faster attention.
+
+Prints one JSON line per step; exits non-zero on any parity failure.
+"""
+import json
+import subprocess
+import sys
+import time
+
+sys.path.insert(0, ".")
+
+
+def parity():
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from bench_mfu import host_fence
+    from nos_tpu.ops.attention import attention
+
+    key = jax.random.PRNGKey
+    b, h, hkv, s, d = 2, 16, 4, 2048, 128
+    q = jax.random.normal(key(0), (b, h, s, d), jnp.bfloat16)
+    k = jax.random.normal(key(1), (b, hkv, s, d), jnp.bfloat16)
+    v = jax.random.normal(key(2), (b, hkv, s, d), jnp.bfloat16)
+
+    pal = jax.jit(lambda q, k, v: attention(q, k, v, causal=True))(q, k, v)
+    ref = jax.jit(lambda q, k, v: attention(q, k, v, causal=True,
+                                            force_xla=True))(q, k, v)
+    host_fence(pal, ref)
+    diff = float(jnp.max(jnp.abs(pal.astype(jnp.float32)
+                                 - ref.astype(jnp.float32))))
+    ok = diff < 2e-2  # bf16 flash vs einsum tolerance
+    print(json.dumps({"step": "gqa_pallas_parity", "max_abs_diff": diff,
+                      "ok": ok}))
+    return ok
+
+
+def run(cmd, env=None, timeout=900):
+    import os
+
+    e = dict(os.environ)
+    e.update(env or {})
+    t0 = time.time()
+    try:
+        proc = subprocess.run(cmd, capture_output=True, text=True, env=e,
+                              timeout=timeout)
+    except subprocess.TimeoutExpired:
+        print(json.dumps({"cmd": " ".join(cmd), "rc": "timeout",
+                          "wall_s": round(time.time() - t0, 1)}))
+        return False  # keep draining the queue; the tunnel window is short
+    out = proc.stdout.strip().splitlines()[-1] if proc.stdout.strip() else ""
+    err = proc.stderr.strip().splitlines()[-1] if proc.stderr.strip() else ""
+    print(json.dumps({"cmd": " ".join(cmd), "rc": proc.returncode,
+                      "wall_s": round(time.time() - t0, 1),
+                      "out": out[:500],
+                      **({"err": err[:300]} if proc.returncode else {})}))
+    return proc.returncode == 0
+
+
+def main():
+    if not parity():
+        sys.exit(1)
+    run([sys.executable, "bench_mfu.py"])
+    # sweep: dots policies with the tuned attention (b8 dots OOM'd before;
+    # faster attention doesn't change memory, but b4/b2 dots numbers move)
+    for batch, policy in ((8, "full"), (4, "dots"), (2, "dots")):
+        env = {"NOS_TPU_BENCH_BATCH": str(batch)}
+        if policy != "full":
+            env["NOS_TPU_BENCH_REMAT_POLICY"] = policy
+        run([sys.executable, "bench_mfu.py"], env=env)
+
+
+if __name__ == "__main__":
+    main()

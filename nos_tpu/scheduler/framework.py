@@ -354,21 +354,41 @@ class SchedulerFramework:
                 return st
         return Status.ok()
 
+    # kube-scheduler's percentageOfNodesToScore idea: on big clusters, stop
+    # filtering once enough feasible nodes are found, starting each sweep
+    # where the previous one left off (kube's nextStartNodeIndex) so the
+    # candidate window rotates instead of always sampling the same sorted
+    # prefix. The floor keeps small clusters (and every test topology)
+    # exhaustive, so scoring still sees all candidates there; at 1k+ nodes
+    # this turns each pod's O(cluster) filter sweep into O(floor)
+    # (measured: the 1024-node bench_sched scale point spent ~60% of its
+    # time in run_filter without it).
+    MIN_FEASIBLE_TO_FIND = 100
+
     def find_feasible(
         self, state: CycleState, pod: Pod, snapshot: Snapshot
     ) -> Tuple[Optional[str], Status]:
-        """Filter + Score over all nodes; returns (best node, status).
-        Shared by the live scheduling loop and the planner simulation so the
-        two paths cannot diverge."""
+        """Filter + Score over nodes; returns (best node, status). Shared
+        by the live scheduling loop and the planner simulation so the two
+        paths cannot diverge. Scans every node on small clusters; stops
+        after MIN_FEASIBLE_TO_FIND feasible candidates on large ones,
+        rotating the scan start across calls."""
         feasible = []
         reasons: List[str] = []
-        for name, info in sorted(snapshot.items()):
+        items = sorted(snapshot.items())
+        start = getattr(self, "_next_start_node", 0) % max(len(items), 1)
+        scanned = 0
+        for name, info in items[start:] + items[:start]:
+            scanned += 1
             nominated = snapshot.nominated_for(name, exclude=pod)
             st = self.run_filter_with_nominated(state, pod, info, nominated)
             if st.success:
                 feasible.append((self.run_score(state, pod, info), name))
+                if len(feasible) >= self.MIN_FEASIBLE_TO_FIND:
+                    break
             elif st.reason and st.reason not in reasons:
                 reasons.append(st.reason)
+        self._next_start_node = (start + scanned) % max(len(items), 1)
         if not feasible:
             # aggregate distinct per-node reasons (kube-scheduler style)
             detail = "; ".join(reasons[:4]) if reasons else ""

@@ -224,3 +224,33 @@ def test_taint_toleration_affinity_wire_roundtrip():
     p2 = serial.from_wire(serial.to_wire(p))
     assert p2.spec.tolerations == p.spec.tolerations
     assert p2.spec.affinity == p.spec.affinity
+
+
+def test_feasible_node_cap_binds_and_rotates_on_large_clusters():
+    """kube percentageOfNodesToScore analog: >MIN_FEASIBLE_TO_FIND feasible
+    nodes -> the sweep stops at the cap and the scan start rotates across
+    calls (nextStartNodeIndex), so successive sweeps sample different
+    windows instead of always the same sorted prefix."""
+    framework = fw.SchedulerFramework()
+    n_nodes = framework.MIN_FEASIBLE_TO_FIND + 50
+    nodes = [tpu_node(f"cap-n{i:03d}") for i in range(n_nodes)]
+    snap = fw.Snapshot.build(nodes, [])
+    pod_ = pod("cap-p", tpu=8)
+
+    name, st = framework.find_feasible({}, pod_, snap)
+    assert st.success and name == "cap-n000"
+    # the sweep stopped at the cap, not the cluster size
+    assert framework._next_start_node == framework.MIN_FEASIBLE_TO_FIND
+
+    # second sweep starts where the first stopped and wraps
+    name2, st2 = framework.find_feasible({}, pod_, snap)
+    assert st2.success
+    assert framework._next_start_node == (
+        2 * framework.MIN_FEASIBLE_TO_FIND) % n_nodes
+
+    # small clusters stay exhaustive: every node is scanned, the scan
+    # cursor wraps to where it started, and the best name wins as before
+    small = fw.Snapshot.build([tpu_node("s2"), tpu_node("s1")], [])
+    fw2 = fw.SchedulerFramework()
+    name3, _ = fw2.find_feasible({}, pod_, small)
+    assert name3 == "s1" and fw2._next_start_node == 0
