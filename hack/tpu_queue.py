@@ -10,11 +10,14 @@
 Prints one JSON line per step; exits non-zero on any parity failure.
 """
 import json
+import os
 import subprocess
 import sys
 import time
 
-sys.path.insert(0, ".")
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+os.chdir(REPO)  # bench_mfu expects repo-root cwd
 
 
 def parity():
@@ -44,7 +47,6 @@ def parity():
 
 
 def run(cmd, env=None, timeout=900):
-    import os
 
     e = dict(os.environ)
     e.update(env or {})

@@ -120,6 +120,23 @@ class Snapshot(Dict[str, NodeInfo]):
     def __init__(self, *args, **kwargs):
         super().__init__(*args, **kwargs)
         self._nominated: Dict[str, List[Pod]] = {}
+        self._ordered_names: Optional[List[str]] = None
+
+    def __setitem__(self, key, value):
+        self._ordered_names = None
+        super().__setitem__(key, value)
+
+    def __delitem__(self, key):
+        self._ordered_names = None
+        super().__delitem__(key)
+
+    def ordered_names(self) -> List[str]:
+        """Sorted node names, cached until the node set changes — the
+        feasibility sweep iterates this per pod, and re-sorting 1k nodes
+        per pod is measurable at scale."""
+        if self._ordered_names is None:
+            self._ordered_names = sorted(self)
+        return self._ordered_names
 
     @staticmethod
     def build(nodes: List[Node], pods: List[Pod],
@@ -368,17 +385,22 @@ class SchedulerFramework:
     def find_feasible(
         self, state: CycleState, pod: Pod, snapshot: Snapshot
     ) -> Tuple[Optional[str], Status]:
-        """Filter + Score over nodes; returns (best node, status). Shared
-        by the live scheduling loop and the planner simulation so the two
-        paths cannot diverge. Scans every node on small clusters; stops
-        after MIN_FEASIBLE_TO_FIND feasible candidates on large ones,
-        rotating the scan start across calls."""
+        """Filter + Score over nodes; returns (best node, status). The
+        same filter/score pipeline serves the live scheduling loop and
+        the planner simulation (what-if entry: can_schedule, which
+        save/restores the rotation cursor so simulations never perturb
+        live placement). Scans every node on small clusters; stops after
+        MIN_FEASIBLE_TO_FIND feasible candidates on large ones, rotating
+        the scan start across calls."""
         feasible = []
         reasons: List[str] = []
-        items = sorted(snapshot.items())
-        start = getattr(self, "_next_start_node", 0) % max(len(items), 1)
+        names = snapshot.ordered_names()
+        n = len(names)
+        start = getattr(self, "_next_start_node", 0) % max(n, 1)
         scanned = 0
-        for name, info in items[start:] + items[:start]:
+        for i in range(n):
+            name = names[(start + i) % n]
+            info = snapshot[name]
             scanned += 1
             nominated = snapshot.nominated_for(name, exclude=pod)
             st = self.run_filter_with_nominated(state, pod, info, nominated)
@@ -388,7 +410,7 @@ class SchedulerFramework:
                     break
             elif st.reason and st.reason not in reasons:
                 reasons.append(st.reason)
-        self._next_start_node = (start + scanned) % max(len(items), 1)
+        self._next_start_node = (start + scanned) % max(n, 1)
         if not feasible:
             # aggregate distinct per-node reasons (kube-scheduler style)
             detail = "; ".join(reasons[:4]) if reasons else ""
@@ -401,9 +423,16 @@ class SchedulerFramework:
     def can_schedule(self, pod: Pod, snapshot: Snapshot) -> Tuple[Optional[str], Status]:
         """PreFilter + Filter over all nodes; returns (best node, status).
         This is the what-if entry used by the partitioning planner
-        (reference internal/partitioning/core/planner.go:178-207)."""
+        (reference internal/partitioning/core/planner.go:178-207). The
+        rotation cursor is save/restored: a simulation must not shift the
+        live loop's scan window (order-dependence would make simulated
+        and real placement diverge)."""
         state: CycleState = {}
         st = self.run_pre_filter(state, pod, snapshot)
         if not st.success:
             return None, st
-        return self.find_feasible(state, pod, snapshot)
+        cursor = getattr(self, "_next_start_node", 0)
+        try:
+            return self.find_feasible(state, pod, snapshot)
+        finally:
+            self._next_start_node = cursor

@@ -394,3 +394,58 @@ def test_noop_update_emits_no_event_and_keeps_rv():
     s.patch("Node", "n1", "", lambda x: None)      # no-op patch
     assert len(sub) == 0
     assert s.get("Node", "n1").metadata.resource_version == rv
+
+
+def test_deep_copy_full_isolation_and_parity_with_deepcopy():
+    """deep_copy is a hand-rolled clone (hot path of the apiserver
+    double): it must isolate every mutable level and agree with
+    copy.deepcopy for the API-object graphs we store."""
+    import copy as _copy
+
+    from nos_tpu.kube.objects import (
+        Affinity, Container, NodeSelectorRequirement, NodeSelectorTerm,
+        Pod, PodCondition, PodSpec, PodStatus, Toleration, deep_copy,
+    )
+
+    pod = Pod(
+        metadata=ObjectMeta(
+            name="p", namespace="ns", labels={"a": "1"},
+            annotations={"x": "y"}, uid="u1", resource_version=7),
+        spec=PodSpec(
+            containers=[Container(requests={"cpu": 2, "google.com/tpu": 4})],
+            node_name="n1",
+            tolerations=[Toleration(key="k", operator="Exists")],
+            affinity=Affinity(node_affinity_required=[NodeSelectorTerm(
+                match_expressions=[NodeSelectorRequirement(
+                    key="topo", operator="In", values=["2x2"])])]),
+        ),
+        status=PodStatus(phase="Running", conditions=[
+            PodCondition(type="PodScheduled", status="True")]),
+    )
+    clone = deep_copy(pod)
+    assert clone == pod
+    assert clone == _copy.deepcopy(pod)
+    # full isolation at every level
+    clone.metadata.labels["a"] = "2"
+    clone.spec.containers[0].requests["cpu"] = 99
+    clone.spec.tolerations[0].key = "other"
+    clone.status.conditions[0].status = "False"
+    assert pod.metadata.labels["a"] == "1"
+    assert pod.spec.containers[0].requests["cpu"] == 2
+    assert pod.spec.tolerations[0].key == "k"
+    assert pod.status.conditions[0].status == "True"
+
+
+def test_deep_copy_exotic_values_fall_back():
+    from nos_tpu.kube.objects import deep_copy
+
+    class Odd:
+        def __init__(self):
+            self.xs = [1, 2]
+
+    o = Odd()
+    c = deep_copy(o)
+    assert c is not o and c.xs == [1, 2]
+    c.xs.append(3)
+    assert o.xs == [1, 2]
+    assert deep_copy({("t", 1): {4, 5}}) == {("t", 1): {4, 5}}
