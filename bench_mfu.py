@@ -40,6 +40,15 @@ def host_fence(*arrays) -> float:
     return first
 
 
+def _effective_attn_impl(cfg, batch: int) -> str:
+    from nos_tpu.ops.attention import effective_impl
+
+    head = cfg.head_dim
+    q_shape = (batch, cfg.n_heads, SEQ, head)
+    k_shape = (batch, cfg.kv_heads, SEQ, head)
+    return effective_impl(q_shape, k_shape)
+
+
 def run_mfu():
     import jax
     import jax.numpy as jnp  # noqa: F401
@@ -55,6 +64,8 @@ def run_mfu():
         model["remat_policy"] = os.environ["NOS_TPU_BENCH_REMAT_POLICY"]
     if "NOS_TPU_BENCH_REMAT" in os.environ:
         model["remat"] = os.environ["NOS_TPU_BENCH_REMAT"] == "1"
+    if "NOS_TPU_BENCH_LOSS_CHUNK" in os.environ:
+        model["loss_chunk"] = int(os.environ["NOS_TPU_BENCH_LOSS_CHUNK"])
 
     def fence(*arrays):
         if faulty_fence:  # deliberately broken: no-op on 'axon'
@@ -95,6 +106,12 @@ def run_mfu():
         "timing_fence": "block_until_ready[FAULT]" if faulty_fence
                         else "device_to_host_transfer",
         "batch": batch,
+        # record what actually dispatched/engaged, not what was requested:
+        # fallback runs must never be mislabeled (VERDICT r2 weak #1 ethos)
+        "attn_impl": _effective_attn_impl(cfg, batch),
+        "loss_chunk": model.get("loss_chunk", 0)
+                      if model.get("loss_chunk", 0) and
+                      SEQ % model.get("loss_chunk", 1) == 0 else 0,
         "remat_policy": model.get("remat_policy", "full")
                         if model.get("remat", True) else "none",
         "params_b": round(n_params / 1e9, 3),

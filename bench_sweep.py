@@ -10,24 +10,28 @@ import subprocess
 import sys
 
 POINTS = [
-    # (batch, remat_policy or "none")
-    (8, "full"),       # round-2 published config
-    (16, "full"),
-    (8, "dots"),
-    (4, "dots"),
-    (2, "dots"),
-    (4, "none"),
-    (2, "none"),
+    # (batch, remat_policy or "none", loss_chunk)
+    (8, "full", 0),       # round-2 published config
+    (8, "except_mlp", 512),
+    (16, "except_mlp", 512),
+    (8, "dots", 0),
+    (16, "minimal", 512),
+    (32, "minimal", 512),
+    (8, "none", 512),
+    (4, "none", 512),
 ]
 
 
-def run_point(batch, policy, timeout=900):
+def run_point(batch, policy, loss_chunk=0, timeout=900):
     env = dict(os.environ)
     # clear every sweep knob so shell leftovers can't skew a point
     for knob in ("NOS_TPU_BENCH_BATCH", "NOS_TPU_BENCH_REMAT",
-                 "NOS_TPU_BENCH_REMAT_POLICY", "NOS_TPU_BENCH_FAULT"):
+                 "NOS_TPU_BENCH_REMAT_POLICY", "NOS_TPU_BENCH_FAULT",
+                 "NOS_TPU_BENCH_LOSS_CHUNK"):
         env.pop(knob, None)
     env["NOS_TPU_BENCH_BATCH"] = str(batch)
+    if loss_chunk:
+        env["NOS_TPU_BENCH_LOSS_CHUNK"] = str(loss_chunk)
     if policy == "none":
         env["NOS_TPU_BENCH_REMAT"] = "0"
     else:
@@ -38,26 +42,27 @@ def run_point(batch, policy, timeout=900):
             capture_output=True, text=True, timeout=timeout,
         )
     except subprocess.TimeoutExpired:
-        return {"batch": batch, "remat_policy": policy, "error": "timeout"}
+        return {"batch": batch, "remat_policy": policy,
+                "loss_chunk": loss_chunk, "error": "timeout"}
     if proc.returncode != 0:
         tail = proc.stderr.strip().splitlines()[-1:] or ["?"]
         return {"batch": batch, "remat_policy": policy,
-                "error": tail[0][:160]}
+                "loss_chunk": loss_chunk, "error": tail[0][:160]}
     return json.loads(proc.stdout.strip().splitlines()[-1])
 
 
 def main():
     results = []
-    for batch, policy in POINTS:
-        r = run_point(batch, policy)
+    for batch, policy, loss_chunk in POINTS:
+        r = run_point(batch, policy, loss_chunk)
         results.append(r)
         print(json.dumps(r), flush=True)
     ok = [r for r in results if r.get("mfu_pct")]
     if ok:
         best = max(ok, key=lambda r: r["mfu_pct"])
-        print(json.dumps({"best": {k: best[k] for k in
-                                   ("batch", "remat_policy", "mfu_pct",
-                                    "step_time_s")}}))
+        print(json.dumps({"best": {k: best.get(k) for k in
+                                   ("batch", "remat_policy", "loss_chunk",
+                                    "mfu_pct", "step_time_s")}}))
 
 
 if __name__ == "__main__":

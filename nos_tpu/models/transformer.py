@@ -23,6 +23,7 @@ from typing import Any, Dict, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
+from jax.ad_checkpoint import checkpoint_name
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from nos_tpu.ops.attention import attention
@@ -46,9 +47,18 @@ class TransformerConfig:
     remat: bool = True
     # "full": recompute the whole layer in backward (max HBM savings,
     # ~+33% FLOPs). "dots": save matmul outputs, recompute only cheap
-    # elementwise ops — near-zero recompute at moderate HBM cost; the
-    # policy that maximizes MFU when the model still fits.
+    # elementwise ops — near-zero recompute but the wide d_ff
+    # intermediates dominate HBM. "except_mlp": save the qkv/attention
+    # tensors, recompute only the gate/up mlp matmuls (~16% FLOPs
+    # overhead at a fraction of dots' memory — the policy that lets the
+    # flagship batch fit un-rematerialized where it matters).
+    # "minimal": save only the attention outputs (and kernel residuals)
+    # — recompute every projection, max batch headroom short of "full".
     remat_policy: str = "full"
+    # > 0: compute the lm head + cross-entropy in sequence chunks of this
+    # size under jax.checkpoint, so the [B, S, vocab] fp32 logits never
+    # materialize at once (peak transient becomes [B, chunk, vocab]).
+    loss_chunk: int = 0
     # grouped-query attention: 0 means MHA (n_kv_heads == n_heads)
     n_kv_heads: int = 0
     # sequence-parallel attention strategy when the mesh has an sp axis:
@@ -68,7 +78,7 @@ class TransformerConfig:
             raise ValueError("n_heads must divide by n_kv_heads")
         if self.sp_strategy not in ("ring", "ulysses"):
             raise ValueError(f"unknown sp_strategy {self.sp_strategy!r}")
-        if self.remat_policy not in ("full", "dots"):
+        if self.remat_policy not in ("full", "dots", "except_mlp", "minimal"):
             raise ValueError(f"unknown remat_policy {self.remat_policy!r}")
 
     @property
@@ -186,14 +196,23 @@ def attention_block(h_in, layer, cfg: TransformerConfig, freqs,
     k = jnp.dot(h, layer["wk"]).reshape(b, s, cfg.kv_heads, cfg.head_dim)
     v = jnp.dot(h, layer["wv"]).reshape(b, s, cfg.kv_heads, cfg.head_dim)
     q, k = apply_rope(q, freqs), apply_rope(k, freqs)
+    # checkpoint_name tags feed the named remat policies ("except_mlp",
+    # "minimal"); under other policies they are inert
+    q = checkpoint_name(q, "qkv_proj")
+    k = checkpoint_name(k, "qkv_proj")
+    v = checkpoint_name(v, "qkv_proj")
     # GQA: k/v stay at kv_heads — the attention ops group query heads
-    # internally (and only the pallas kernel path materializes a repeat)
+    # internally, un-materialized on every path
     o = attention_call(q, k, v).reshape(b, s, cfg.d_model)
+    o = checkpoint_name(o, "attn_out")
     return h_in + jnp.dot(o, layer["wo"])
 
 
 def dense_ffn_block(h_in, layer):
-    """Pre-RMSNorm SwiGLU FFN sublayer + residual (dense path)."""
+    """Pre-RMSNorm SwiGLU FFN sublayer + residual (dense path). The wide
+    [B, S, d_ff] intermediates carry no checkpoint_name on purpose: every
+    named policy exists to NOT save them (that is the memory win over
+    "dots")."""
     h = rms_norm(h_in, layer["mlp_norm"])
     gate = jax.nn.silu(jnp.dot(h, layer["w_gate"]))
     up = jnp.dot(h, layer["w_up"])
@@ -212,6 +231,50 @@ def cross_entropy(logits: jax.Array, targets: jax.Array) -> jax.Array:
     logp = jax.nn.log_softmax(logits, axis=-1)
     nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
     return jnp.mean(nll)
+
+
+def _remat_policy(cfg: TransformerConfig):
+    """Saveable-set for jax.checkpoint by cfg.remat_policy (None means
+    checkpoint everything). "attn_residuals" is the splash kernel's
+    logsumexp tag (ops/attention.py) — saving it means the backward never
+    re-runs the forward attention kernel under the named policies."""
+    cp = jax.checkpoint_policies
+    if cfg.remat_policy == "dots":
+        return cp.dots_with_no_batch_dims_saveable
+    if cfg.remat_policy == "except_mlp":
+        return cp.save_only_these_names(
+            "qkv_proj", "attn_out", "attn_residuals")
+    if cfg.remat_policy == "minimal":
+        return cp.save_only_these_names("attn_out", "attn_residuals")
+    return None
+
+
+def lm_head_loss(norm_w, unembed, hidden, targets, loss_chunk: int = 0):
+    """Final rms-norm + unembed + token cross-entropy. With loss_chunk > 0
+    the sequence is processed in checkpointed chunks so the fp32
+    [B, S, vocab] logits (the largest transient in the whole step — 2 GB
+    at the flagship's batch 8) never exist at once; the backward
+    recomputes one [B, chunk, vocab] block at a time (one extra unembed
+    matmul, ~2% of step FLOPs)."""
+    hidden = rms_norm(hidden, norm_w)
+    b, s, _ = hidden.shape
+    if loss_chunk and s > loss_chunk and s % loss_chunk == 0:
+        n = s // loss_chunk
+        xs = hidden.reshape(b, n, loss_chunk, -1).swapaxes(0, 1)
+        ts = targets.reshape(b, n, loss_chunk).swapaxes(0, 1)
+
+        @jax.checkpoint
+        def chunk_nll(carry, xt):
+            xc, tc = xt
+            logits = jnp.dot(xc, unembed).astype(jnp.float32)
+            logp = jax.nn.log_softmax(logits, axis=-1)
+            nll = -jnp.take_along_axis(logp, tc[..., None], axis=-1)[..., 0]
+            return carry + jnp.sum(nll), None
+
+        total, _ = jax.lax.scan(chunk_nll, jnp.float32(0.0), (xs, ts))
+        return total / (b * s)
+    logits = jnp.dot(hidden, unembed).astype(jnp.float32)
+    return cross_entropy(logits, targets)
 
 
 def _attention_call(q, k, v, mesh: Optional[Mesh], sp_strategy: str = "ring"):
@@ -241,9 +304,12 @@ def forward(
     tokens: jax.Array,
     mesh: Optional[Mesh] = None,
     return_aux: bool = False,
+    return_hidden: bool = False,
 ):
     """tokens [B, S] -> logits [B, S, vocab] (plus the MoE auxiliary loss
-    when ``return_aux``)."""
+    when ``return_aux``). ``return_hidden`` instead yields the pre-head
+    hidden state [B, S, d_model] + aux, for callers (loss_fn) that apply
+    the lm head themselves — chunked, so the logits never materialize."""
     b, s = tokens.shape
     freqs = rope_frequencies(cfg.head_dim, cfg.max_seq, cfg.rope_theta)
     act_spec = _activation_spec(mesh)
@@ -277,15 +343,11 @@ def forward(
 
     body = layer_body
     if cfg.remat:
-        if cfg.remat_policy == "dots":
-            body = jax.checkpoint(
-                layer_body,
-                policy=jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
-            )
-        else:
-            body = jax.checkpoint(layer_body)
+        body = jax.checkpoint(layer_body, policy=_remat_policy(cfg))
     x, aux_per_layer = jax.lax.scan(body, x, params["layers"])
 
+    if return_hidden:
+        return x, jnp.mean(aux_per_layer)
     x = rms_norm(x, params["final_norm"])
     logits = jnp.dot(x, params["unembed"]).astype(jnp.float32)
     if return_aux:
@@ -299,8 +361,11 @@ def forward(
 
 def loss_fn(params: Params, cfg: TransformerConfig, batch: Dict[str, jax.Array],
             mesh: Optional[Mesh] = None) -> jax.Array:
-    logits, aux = forward(params, cfg, batch["tokens"], mesh, return_aux=True)
-    return cross_entropy(logits, batch["targets"]) + cfg.moe_aux_weight * aux
+    hidden, aux = forward(params, cfg, batch["tokens"], mesh,
+                          return_hidden=True)
+    loss = lm_head_loss(params["final_norm"], params["unembed"], hidden,
+                        batch["targets"], cfg.loss_chunk)
+    return loss + cfg.moe_aux_weight * aux
 
 
 def make_train_step(cfg: TransformerConfig, optimizer,

@@ -55,8 +55,9 @@ from jax.sharding import Mesh, PartitionSpec as P
 from nos_tpu.models.transformer import (
     Params,
     TransformerConfig,
-    cross_entropy,
+    _remat_policy,
     dense_layer_block,
+    lm_head_loss,
 )
 from nos_tpu.ops.attention import attention
 from nos_tpu.ops.layers import rms_norm, rope_frequencies
@@ -84,12 +85,14 @@ def pipeline_forward(
     mesh: Mesh,
     n_microbatches: int = 2,
     return_aux: bool = False,
+    return_hidden: bool = False,
 ) -> jax.Array:
     """tokens [B, S] -> logits [B, S, vocab] (plus the MoE auxiliary loss,
     averaged over layers x microbatches, when ``return_aux``), layer stack
     executed as a P-stage pipeline over the mesh's pp axis. Numerically
-    identical to
-    ``transformer.forward`` on the dense path."""
+    identical to ``transformer.forward`` on the dense path.
+    ``return_hidden`` yields the pre-head hidden state + aux instead (for
+    pipeline_loss_fn's chunked lm head)."""
     b, s = tokens.shape
     stages = _check(cfg, mesh, b, n_microbatches)
     n_local = cfg.n_layers // stages
@@ -146,12 +149,14 @@ def pipeline_forward(
     )(stage_params, mbs)
     x = stacked[-1].reshape(b, s, cfg.d_model)        # last stage's outputs
 
+    # mean over all L layers and M microbatches (each stage summed its
+    # K layers over its M active ticks; psum folded the stages)
+    aux = aux_sum / (cfg.n_layers * n_microbatches)
+    if return_hidden:
+        return x, aux
     x = rms_norm(x, params["final_norm"])
     logits = jnp.dot(x, params["unembed"]).astype(jnp.float32)
     if return_aux:
-        # mean over all L layers and M microbatches (each stage summed its
-        # K layers over its M active ticks; psum folded the stages)
-        aux = aux_sum / (cfg.n_layers * n_microbatches)
         return logits, aux
     return logits
 
@@ -159,9 +164,11 @@ def pipeline_forward(
 def pipeline_loss_fn(params: Params, cfg: TransformerConfig,
                      batch: Dict[str, jax.Array], mesh: Mesh,
                      n_microbatches: int = 2) -> jax.Array:
-    logits, aux = pipeline_forward(params, cfg, batch["tokens"], mesh,
-                                   n_microbatches, return_aux=True)
-    return cross_entropy(logits, batch["targets"]) + cfg.moe_aux_weight * aux
+    hidden, aux = pipeline_forward(params, cfg, batch["tokens"], mesh,
+                                   n_microbatches, return_hidden=True)
+    loss = lm_head_loss(params["final_norm"], params["unembed"], hidden,
+                        batch["targets"], cfg.loss_chunk)
+    return loss + cfg.moe_aux_weight * aux
 
 
 # ---------------------------------------------------------------------------
@@ -202,7 +209,10 @@ def _stage_fn_factory(cfg: TransformerConfig, freqs):
                     jnp.float32(0.0))
 
     if cfg.remat:
-        layer_body = jax.checkpoint(layer_body)
+        # same saved-set policies as the plain forward (full/dots/
+        # except_mlp/minimal) — the pipeline path must not silently
+        # ignore cfg.remat_policy
+        layer_body = jax.checkpoint(layer_body, policy=_remat_policy(cfg))
 
     def stage_fn(local_params, x):
         out, aux = jax.lax.scan(layer_body, x, local_params)
@@ -211,11 +221,13 @@ def _stage_fn_factory(cfg: TransformerConfig, freqs):
     return stage_fn
 
 
-def _head_fn(head: Params, x: jax.Array, targets: jax.Array) -> jax.Array:
-    """Loss head executed by the last stage per microbatch."""
-    x = rms_norm(x, head["final_norm"])
-    logits = jnp.dot(x, head["unembed"]).astype(jnp.float32)
-    return cross_entropy(logits, targets)
+def _head_fn(head: Params, x: jax.Array, targets: jax.Array,
+             loss_chunk: int = 0) -> jax.Array:
+    """Loss head executed by the last stage per microbatch. Honors
+    cfg.loss_chunk so the fp32 [mb, S, vocab] logits chunk on the
+    pipeline path too."""
+    return lm_head_loss(head["final_norm"], head["unembed"], x, targets,
+                        loss_chunk)
 
 
 def _make_1f1b_op(cfg: TransformerConfig, mesh: Mesh, n_microbatches: int,
@@ -261,7 +273,8 @@ def _make_1f1b_op(cfg: TransformerConfig, mesh: Mesh, n_microbatches: int,
 
             def head_cotangent(_):
                 loss_m, head_pull = jax.vjp(
-                    lambda h, x: _head_fn(h, x, targets[bm]), head, y)
+                    lambda h, x: _head_fn(h, x, targets[bm],
+                                          cfg.loss_chunk), head, y)
                 dh, dy = head_pull(jnp.float32(1.0 / M))
                 return dy.astype(xs.dtype), dh, loss_m / M
 
@@ -342,7 +355,7 @@ def _make_1f1b_op(cfg: TransformerConfig, mesh: Mesh, n_microbatches: int,
             y, aux = stage_fn(local_params, x_in)
             loss_m = jax.lax.cond(
                 is_last & active,
-                lambda: _head_fn(head, y, targets[m]) / M,
+                lambda: _head_fn(head, y, targets[m], cfg.loss_chunk) / M,
                 lambda: jnp.float32(0.0))
             loss_m = loss_m + jnp.where(active, aux_ct * aux, 0.0)
             recv_f = jax.lax.ppermute(y, "pp", fwd_perm)

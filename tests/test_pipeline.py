@@ -289,3 +289,30 @@ def test_moe_1f1b_grads_match_gpipe_backward():
         assert pr == pg
         np.testing.assert_allclose(np.asarray(g), np.asarray(r),
                                    rtol=5e-3, atol=5e-4, err_msg=str(pr))
+
+
+def test_pipeline_honors_loss_chunk_and_named_policy():
+    """cfg.loss_chunk and the named remat policies must not be silently
+    dropped on the pipeline path: both schedules' losses (and the 1F1B
+    grads) still match the plain loss when they are set."""
+    from nos_tpu.parallel.pipeline import pipeline_1f1b_loss_fn
+
+    cfg = small_cfg(remat_policy="except_mlp", loss_chunk=8)
+    mesh = pp_mesh(pp=2)
+    params = tfm.init_params(jax.random.PRNGKey(0), cfg)
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (4, 16), 0, cfg.vocab)
+    batch = {"tokens": tokens, "targets": tokens}
+    ref_loss, ref_grads = jax.value_and_grad(tfm.loss_fn)(params, cfg, batch)
+
+    sharded = jax.device_put(params, pipeline_param_shardings(mesh, cfg))
+    gpipe = pipeline_loss_fn(sharded, cfg, batch, mesh, n_microbatches=2)
+    np.testing.assert_allclose(float(gpipe), float(ref_loss), rtol=1e-4)
+
+    got_loss, got_grads = jax.value_and_grad(pipeline_1f1b_loss_fn)(
+        sharded, cfg, batch, mesh, 2)
+    np.testing.assert_allclose(float(got_loss), float(ref_loss), rtol=1e-4)
+    ref_n = jnp.sqrt(sum(jnp.sum(jnp.square(g)) for g in
+                         jax.tree.leaves(ref_grads)))
+    got_n = jnp.sqrt(sum(jnp.sum(jnp.square(g)) for g in
+                         jax.tree.leaves(got_grads)))
+    np.testing.assert_allclose(float(got_n), float(ref_n), rtol=1e-3)

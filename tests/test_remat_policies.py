@@ -1,0 +1,95 @@
+"""Remat-policy and chunked-loss-head coverage (VERDICT r2 next #2b).
+
+The named policies ("except_mlp", "minimal") exist so the flagship batch
+can train with near-zero recompute on a 16 GB v5e: "dots" saves the wide
+[B, S, d_ff] mlp intermediates (the HBM hog), the named policies save
+only the attention-sized tensors tagged with checkpoint_name in
+models/transformer.py. All policies are the same math — only the
+saved-set differs — so loss and grads must match "full" exactly.
+"""
+import jax
+import jax.numpy as jnp
+import pytest
+
+from nos_tpu.models import transformer as tr
+
+BASE = dict(vocab=128, d_model=64, n_layers=2, n_heads=4, n_kv_heads=2,
+            d_ff=128, max_seq=64)
+
+
+def _loss_and_gnorm(cfg):
+    params = tr.init_params(jax.random.PRNGKey(0), cfg)
+    tok = jax.random.randint(jax.random.PRNGKey(1), (2, 64), 0, cfg.vocab)
+    batch = {"tokens": tok, "targets": tok}
+    loss, grads = jax.jit(
+        jax.value_and_grad(lambda p, b: tr.loss_fn(p, cfg, b)))(params, batch)
+    gnorm = jnp.sqrt(sum(jnp.sum(jnp.square(g.astype(jnp.float32)))
+                         for g in jax.tree.leaves(grads)))
+    return float(loss), float(gnorm)
+
+
+@pytest.fixture(scope="module")
+def full_ref():
+    return _loss_and_gnorm(tr.TransformerConfig(**BASE, remat_policy="full"))
+
+
+@pytest.mark.parametrize("policy", ["dots", "except_mlp", "minimal"])
+def test_policy_matches_full(policy, full_ref):
+    loss, gnorm = _loss_and_gnorm(
+        tr.TransformerConfig(**BASE, remat_policy=policy))
+    assert loss == pytest.approx(full_ref[0], abs=1e-4)
+    assert gnorm == pytest.approx(full_ref[1], rel=1e-3)
+
+
+def test_chunked_loss_head_matches_unchunked(full_ref):
+    loss, gnorm = _loss_and_gnorm(tr.TransformerConfig(**BASE, loss_chunk=16))
+    assert loss == pytest.approx(full_ref[0], abs=1e-3)
+    assert gnorm == pytest.approx(full_ref[1], rel=1e-2)
+
+
+def test_chunked_head_never_materializes_full_logits():
+    """The point of loss_chunk: the fp32 [B, S, vocab] logits must not
+    appear in the compiled backward's live set. Compare compiled temp
+    memory with a vocab big enough to dominate."""
+    kw = dict(BASE, vocab=4096, remat_policy="minimal")
+    tok = jax.random.randint(jax.random.PRNGKey(1), (2, 64), 0, 4096)
+    batch = {"tokens": tok, "targets": tok}
+
+    def temp_bytes(cfg):
+        params = tr.init_params(jax.random.PRNGKey(0), cfg)
+        c = jax.jit(
+            jax.value_and_grad(lambda p, b: tr.loss_fn(p, cfg, b))
+        ).lower(params, batch).compile()
+        return c.memory_analysis().temp_size_in_bytes
+
+    plain = temp_bytes(tr.TransformerConfig(**kw))
+    chunked = temp_bytes(tr.TransformerConfig(**kw, loss_chunk=8))
+    # full logits+logp: 2 * 2*64*4096*4B = 4.2 MB of the plain temp set;
+    # chunked keeps one 8-token slice live at a time
+    assert chunked < plain
+
+
+def test_named_policies_save_less_than_dots():
+    """Compiled temp memory must be ordered full <= minimal <= except_mlp
+    <= dots at a shape where the d_ff intermediates dominate."""
+    kw = dict(BASE, d_model=128, d_ff=512, n_layers=4, max_seq=256)
+    tok = jax.random.randint(jax.random.PRNGKey(1), (4, 256), 0, 128)
+    batch = {"tokens": tok, "targets": tok}
+
+    def temp_bytes(policy):
+        cfg = tr.TransformerConfig(**kw, remat_policy=policy)
+        params = tr.init_params(jax.random.PRNGKey(0), cfg)
+        c = jax.jit(
+            jax.value_and_grad(lambda p, b: tr.loss_fn(p, cfg, b))
+        ).lower(params, batch).compile()
+        return c.memory_analysis().temp_size_in_bytes
+
+    sizes = {p: temp_bytes(p) for p in ("full", "minimal", "except_mlp",
+                                        "dots")}
+    assert sizes["minimal"] <= sizes["except_mlp"] <= sizes["dots"]
+    assert sizes["full"] <= sizes["except_mlp"]
+
+
+def test_unknown_policy_rejected():
+    with pytest.raises(ValueError, match="remat_policy"):
+        tr.TransformerConfig(**BASE, remat_policy="everything")
