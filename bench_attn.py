@@ -1,0 +1,93 @@
+#!/usr/bin/env python3
+"""Attention-kernel microbench at the flagship shape: forward and
+forward+backward wall-clock for each dispatchable implementation
+(splash / legacy flash / XLA), so kernel choice and block-size sweeps are
+decided by measurement, not vibes. Timing fence is the host transfer
+(block_until_ready lies on 'axon' — see bench_mfu.py).
+
+Usage: python bench_attn.py [reps]
+Env: NOS_TPU_SPLASH_* block-size overrides are honored (ops/attention.py).
+Prints one JSON line per impl.
+"""
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, ".")
+
+from bench import BATCH, MODEL, SEQ  # noqa: E402
+from bench_mfu import host_fence  # noqa: E402
+
+REPS = int(sys.argv[1]) if len(sys.argv) > 1 else 10
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    from nos_tpu.ops import attention as at
+
+    b, s = BATCH, SEQ
+    h, kv = MODEL["n_heads"], MODEL["n_kv_heads"]
+    d = MODEL["d_model"] // h
+    ks = jax.random.split(jax.random.PRNGKey(0), 3)
+    q = jax.random.normal(ks[0], (b, h, s, d), jnp.bfloat16)
+    k = jax.random.normal(ks[1], (b, kv, s, d), jnp.bfloat16)
+    v = jax.random.normal(ks[2], (b, kv, s, d), jnp.bfloat16)
+
+    impls = ["splash", "flash", "xla"]
+    for impl in impls:
+        os.environ["NOS_TPU_ATTN_IMPL"] = impl
+        eff = at.effective_impl(q.shape, k.shape)
+        if eff != impl:
+            print(json.dumps({"impl": impl, "skipped": f"dispatches {eff}"}))
+            continue
+
+        fwd = jax.jit(lambda q, k, v: at.attention(q, k, v, causal=True))
+
+        def loss(q, k, v):
+            return jnp.sum(at.attention(q, k, v, causal=True)
+                           .astype(jnp.float32))
+
+        grad = jax.jit(jax.grad(loss, argnums=(0, 1, 2)))
+
+        try:
+            t0 = time.perf_counter()
+            out = fwd(q, k, v)
+            host_fence(out)
+            compile_fwd = time.perf_counter() - t0
+
+            t0 = time.perf_counter()
+            for _ in range(REPS):
+                out = fwd(q, k, v)
+            host_fence(out)
+            t_fwd = (time.perf_counter() - t0) / REPS
+
+            t0 = time.perf_counter()
+            g = grad(q, k, v)
+            host_fence(g[0])
+            compile_bwd = time.perf_counter() - t0
+
+            t0 = time.perf_counter()
+            for _ in range(REPS):
+                g = grad(q, k, v)
+            host_fence(g[0])
+            t_bwd = (time.perf_counter() - t0) / REPS
+        except Exception as e:
+            print(json.dumps({"impl": impl,
+                              "error": f"{type(e).__name__}: {e}"[:200]}))
+            continue
+
+        print(json.dumps({
+            "impl": impl,
+            "shape": f"b{b} h{h} kv{kv} s{s} d{d} causal bf16",
+            "fwd_ms": round(t_fwd * 1e3, 2),
+            "fwd_bwd_ms": round(t_bwd * 1e3, 2),
+            "compile_fwd_s": round(compile_fwd, 1),
+            "compile_bwd_s": round(compile_bwd, 1),
+        }), flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -109,8 +109,11 @@ def run_mfu():
         # record what actually dispatched/engaged, not what was requested:
         # fallback runs must never be mislabeled (VERDICT r2 weak #1 ethos)
         "attn_impl": _effective_attn_impl(cfg, batch),
+        # effective value: mirrors lm_head_loss's engage condition
+        # (chunk > 0, SEQ divisible, SEQ strictly longer than chunk)
         "loss_chunk": model.get("loss_chunk", 0)
                       if model.get("loss_chunk", 0) and
+                      SEQ > model.get("loss_chunk", 0) and
                       SEQ % model.get("loss_chunk", 1) == 0 else 0,
         "remat_policy": model.get("remat_policy", "full")
                         if model.get("remat", True) else "none",

@@ -41,6 +41,10 @@ class TrainerConfig:
     max_seq: int = 512
     n_experts: int = 0
     sp_strategy: str = "ring"          # ring | ulysses (sp axis attention)
+    # memory/recompute trade (models/transformer.TransformerConfig):
+    # full | dots | except_mlp | minimal, and the chunked lm head
+    remat_policy: str = "full"
+    loss_chunk: int = 0
     # layout
     dp: int = 1
     fsdp: int = 1
@@ -122,6 +126,7 @@ def train(cfg: TrainerConfig) -> float:
         n_heads=cfg.n_heads, n_kv_heads=cfg.n_kv_heads, d_ff=cfg.d_ff,
         max_seq=cfg.max_seq, n_experts=cfg.n_experts,
         sp_strategy=cfg.sp_strategy,
+        remat_policy=cfg.remat_policy, loss_chunk=cfg.loss_chunk,
         dtype=jnp.bfloat16 if cfg.bf16 else jnp.float32,
     )
 

@@ -1,0 +1,182 @@
+"""Autoregressive decoding with a KV cache, TPU-first.
+
+The inference half of the decoder workload (training lives in
+``transformer.py``; both share the same parameter pytree). Design per the
+TPU brief:
+
+- **Static shapes everywhere.** The cache is pre-allocated at
+  ``max_len`` and written with ``lax.dynamic_update_slice``; the decode
+  loop is a ``lax.scan`` over step indices, so the whole generation
+  compiles to one XLA program — no per-token retrace, no dynamic shapes.
+- **GQA-sized cache.** K/V are cached at ``kv_heads`` (never repeated to
+  ``n_heads``): decode is HBM-bandwidth-bound on reading the cache, so a
+  4x-grouped model reads 4x less. Query heads group in the einsum,
+  exactly like ``ops.attention.xla_attention``.
+- **One function for prefill and decode.** ``forward_with_cache`` handles
+  any chunk length S >= 1 with absolute-position rope and a causal mask
+  against the cache timeline, so prefill (S = prompt length) and decode
+  (S = 1) are the same traced program at two shapes.
+- Works under jit/pjit with the training param shardings (the cache
+  follows the k/v head axis over tp).
+
+Reference parity note: the reference repo is a K8s operator suite with no
+generation path; this module exists because the TPU rebuild's workload
+plane (SURVEY §2.7) owns the model stack end to end.
+"""
+from __future__ import annotations
+
+from typing import Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from nos_tpu.models.transformer import Params, TransformerConfig
+from nos_tpu.ops.layers import (
+    apply_rope, rms_norm, rope_frequencies, swiglu,
+)
+
+Cache = Dict[str, jax.Array]
+
+
+def init_cache(cfg: TransformerConfig, batch: int,
+               max_len: Optional[int] = None, dtype=None) -> Cache:
+    """Pre-allocated KV cache: k/v [L, B, Hkv, max_len, head_dim] plus the
+    write position. bf16 by default (cfg.dtype)."""
+    max_len = max_len or cfg.max_seq
+    if max_len > cfg.max_seq:
+        raise ValueError(
+            f"cache max_len {max_len} exceeds the rope table "
+            f"(cfg.max_seq {cfg.max_seq})")
+    dtype = dtype or cfg.dtype
+    shape = (cfg.n_layers, batch, cfg.kv_heads, max_len, cfg.head_dim)
+    return {
+        "k": jnp.zeros(shape, dtype),
+        "v": jnp.zeros(shape, dtype),
+        "pos": jnp.zeros((), jnp.int32),
+    }
+
+
+def _cached_attention(q, ck, cv, positions, scale):
+    """q: [B, H, S, D] (queries at absolute ``positions``); ck/cv:
+    [B, Hkv, T, D] (full cache). Causal against the cache timeline:
+    query at absolute position p attends to cache slots [0, p]. Query
+    heads group per kv head — no K/V repeat."""
+    b, h, s, d = q.shape
+    h_kv = ck.shape[1]
+    g = h // h_kv
+    qg = q.reshape(b, h_kv, g, s, d)
+    scores = jnp.einsum(
+        "bhgqd,bhkd->bhgqk", qg, ck, preferred_element_type=jnp.float32
+    ) * scale
+    t = ck.shape[2]
+    mask = jnp.arange(t)[None, :] <= positions[:, None]     # [S, T]
+    scores = jnp.where(mask[None, None, None], scores,
+                       jnp.finfo(jnp.float32).min)
+    probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
+    return jnp.einsum("bhgqk,bhkd->bhgqd", probs, cv).reshape(b, h, s, d)
+
+
+def forward_with_cache(
+    params: Params, cfg: TransformerConfig, tokens: jax.Array, cache: Cache,
+) -> Tuple[jax.Array, Cache]:
+    """tokens [B, S] (the next S tokens after cache['pos']) -> (logits
+    [B, S, vocab], updated cache). S is the prefill chunk length or 1 for
+    single-token decode — same code, two compiled shapes."""
+    b, s = tokens.shape
+    pos0 = cache["pos"]
+    freqs = rope_frequencies(cfg.head_dim, cfg.max_seq, cfg.rope_theta)
+    positions = pos0 + jnp.arange(s)
+    scale = cfg.head_dim ** -0.5
+
+    x = params["embed"][tokens]
+
+    def layer_body(x, layer_and_cache):
+        layer, ck, cv = layer_and_cache
+        h = rms_norm(x, layer["attn_norm"])
+        q = jnp.dot(h, layer["wq"]).reshape(b, s, cfg.n_heads, cfg.head_dim)
+        k = jnp.dot(h, layer["wk"]).reshape(b, s, cfg.kv_heads, cfg.head_dim)
+        v = jnp.dot(h, layer["wv"]).reshape(b, s, cfg.kv_heads, cfg.head_dim)
+        q, k = (apply_rope(t, freqs, positions) for t in (q, k))
+        ck = jax.lax.dynamic_update_slice(
+            ck, k.transpose(0, 2, 1, 3).astype(ck.dtype), (0, 0, pos0, 0))
+        cv = jax.lax.dynamic_update_slice(
+            cv, v.transpose(0, 2, 1, 3).astype(cv.dtype), (0, 0, pos0, 0))
+        o = _cached_attention(q.transpose(0, 2, 1, 3), ck, cv, positions,
+                              scale)
+        o = o.transpose(0, 2, 1, 3).reshape(b, s, cfg.d_model)
+        x = x + jnp.dot(o, layer["wo"])
+        if cfg.n_experts > 0:
+            from nos_tpu.ops.moe import moe_ffn
+
+            h2 = rms_norm(x, layer["mlp_norm"])
+            y, _aux = moe_ffn(
+                h2, layer["w_router"], layer["w_gate"], layer["w_up"],
+                layer["w_down"], cfg.expert_capacity_factor,
+            )
+            x = x + y
+        else:
+            h2 = rms_norm(x, layer["mlp_norm"])
+            x = x + swiglu(h2, layer["w_gate"], layer["w_up"],
+                           layer["w_down"])
+        return x, (ck, cv)
+
+    x, (ks, vs) = jax.lax.scan(
+        layer_body, x, (params["layers"], cache["k"], cache["v"]))
+
+    x = rms_norm(x, params["final_norm"])
+    logits = jnp.dot(x, params["unembed"]).astype(jnp.float32)
+    return logits, {"k": ks, "v": vs, "pos": pos0 + s}
+
+
+def generate(
+    params: Params,
+    cfg: TransformerConfig,
+    prompt: jax.Array,
+    max_new_tokens: int,
+    *,
+    temperature: float = 0.0,
+    rng: Optional[jax.Array] = None,
+    max_len: Optional[int] = None,
+) -> jax.Array:
+    """Greedy (temperature 0) or temperature sampling. prompt [B, S] ->
+    [B, S + max_new_tokens]. One prefill pass over the prompt, then a
+    ``lax.scan`` of single-token decode steps — jit the whole call.
+
+    ``max_len`` bounds the cache (default cfg.max_seq); the caller must
+    keep S + max_new_tokens <= max_len."""
+    b, s = prompt.shape
+    if max_new_tokens <= 0:
+        return prompt
+    max_len = max_len or cfg.max_seq
+    if s + max_new_tokens > max_len:
+        raise ValueError(
+            f"prompt ({s}) + max_new_tokens ({max_new_tokens}) exceeds "
+            f"cache length {max_len}")
+    if temperature > 0 and rng is None:
+        raise ValueError("temperature sampling needs an rng key")
+
+    cache = init_cache(cfg, b, max_len)
+    logits, cache = forward_with_cache(params, cfg, prompt, cache)
+
+    def pick(step_logits, key):
+        if temperature > 0:
+            return jax.random.categorical(key, step_logits / temperature,
+                                          axis=-1)
+        return jnp.argmax(step_logits, axis=-1)
+
+    keys = (jax.random.split(rng, max_new_tokens) if rng is not None
+            else jnp.zeros((max_new_tokens, 2), jnp.uint32))
+    first = pick(logits[:, -1], keys[0])
+
+    def step(carry, key):
+        tok, cache = carry
+        logits, cache = forward_with_cache(params, cfg, tok[:, None], cache)
+        nxt = pick(logits[:, -1], key)
+        return (nxt, cache), tok
+
+    (last, _), toks = jax.lax.scan(step, (first, cache), keys[1:])
+    # toks: [max_new_tokens-1, B] of the tokens *fed* at each step, i.e.
+    # generated tokens 0..n-2; append the final one
+    out = jnp.concatenate(
+        [toks.swapaxes(0, 1), last[:, None]], axis=1)
+    return jnp.concatenate([prompt, out], axis=1)

@@ -27,7 +27,9 @@ from jax.ad_checkpoint import checkpoint_name
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from nos_tpu.ops.attention import attention
-from nos_tpu.ops.layers import apply_rope, rms_norm, rope_frequencies
+from nos_tpu.ops.layers import (
+    apply_rope, rms_norm, rope_frequencies, swiglu,
+)
 from nos_tpu.ops.moe import moe_ffn
 from nos_tpu.ops.ring_attention import ring_attention
 
@@ -214,9 +216,8 @@ def dense_ffn_block(h_in, layer):
     named policy exists to NOT save them (that is the memory win over
     "dots")."""
     h = rms_norm(h_in, layer["mlp_norm"])
-    gate = jax.nn.silu(jnp.dot(h, layer["w_gate"]))
-    up = jnp.dot(h, layer["w_up"])
-    return h_in + jnp.dot(gate * up, layer["w_down"])
+    return h_in + swiglu(h, layer["w_gate"], layer["w_up"],
+                         layer["w_down"])
 
 
 def dense_layer_block(h_in, layer, cfg: TransformerConfig, freqs,

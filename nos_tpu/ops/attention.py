@@ -94,35 +94,43 @@ def _env_int(name: str, default: int) -> int:
     return int(os.environ.get(name, default))
 
 
-@functools.cache
+def _clamp_block(v: int, s: int) -> int:
+    # sanitize a swept env override to the largest power-of-two block
+    # <= v that divides s (dispatch guarantees s % 128 == 0, so this
+    # terminates at >= 128 for any v; bogus overrides degrade to 128
+    # rather than to a pathological grid or a ZeroDivisionError)
+    c = 128
+    while c * 2 <= min(v, s) and s % (c * 2) == 0:
+        c *= 2
+    return c
+
+
 def _splash_kernel(q_heads: int, s_q: int, s_kv: int, causal: bool):
-    """Build (and cache) a splash-attention kernel for this shape. Splash
-    is GQA-native: q [H, Sq, D] with k/v [Hkv, Skv, D] and the kernel
-    groups query heads internally — no K/V repeat, no per-group call loop
-    (the legacy flash kernel needs one call per query group). Backward
-    runs as the fused dq+dkv kernel.
+    """Splash-attention kernel for this shape + the current
+    NOS_TPU_SPLASH_B* env overrides (the env is read HERE, outside the
+    cache, so an in-process block-size sweep is never served a stale
+    kernel). Splash is GQA-native: q [H, Sq, D] with k/v [Hkv, Skv, D]
+    and the kernel groups query heads internally — no K/V repeat, no
+    per-group call loop (the legacy flash kernel needs one call per query
+    group). Backward runs as the fused dq+dkv kernel by default.
 
     Block sizes: 512 forward (same sweet spot measured for the legacy
-    kernel at this shape — see _block_sizes), backward via
-    NOS_TPU_SPLASH_B*-overridable defaults so bench_sweep can probe the
-    backward grid without rebuilding."""
-    sk, mk = _splash_mod()
-
-    def clamp(v, s):
-        # sanitize a swept env override to the largest power-of-two block
-        # <= v that divides s (dispatch guarantees s % 128 == 0, so this
-        # terminates at >= 128 for any v; bogus overrides degrade to 128
-        # rather than to a pathological grid or a ZeroDivisionError)
-        c = 128
-        while c * 2 <= min(v, s) and s % (c * 2) == 0:
-            c *= 2
-        return c
-
-    bq = clamp(_env_int("NOS_TPU_SPLASH_BQ", 512), s_q)
-    bkv = clamp(_env_int("NOS_TPU_SPLASH_BKV", 512), s_kv)
-    bq_dkv = clamp(_env_int("NOS_TPU_SPLASH_BQ_DKV", 128), s_q)
-    bkv_dkv = clamp(_env_int("NOS_TPU_SPLASH_BKV_DKV", 128), s_kv)
+    kernel at this shape — see _block_sizes), backward
+    NOS_TPU_SPLASH_B*-overridable so bench sweeps can probe the grid."""
+    bq = _clamp_block(_env_int("NOS_TPU_SPLASH_BQ", 512), s_q)
+    bkv = _clamp_block(_env_int("NOS_TPU_SPLASH_BKV", 512), s_kv)
+    bq_dkv = _clamp_block(_env_int("NOS_TPU_SPLASH_BQ_DKV", 128), s_q)
+    bkv_dkv = _clamp_block(_env_int("NOS_TPU_SPLASH_BKV_DKV", 128), s_kv)
     fused = os.environ.get("NOS_TPU_SPLASH_FUSED_BWD", "1") == "1"
+    return _splash_kernel_cached(q_heads, s_q, s_kv, causal,
+                                 bq, bkv, bq_dkv, bkv_dkv, fused)
+
+
+@functools.cache
+def _splash_kernel_cached(q_heads: int, s_q: int, s_kv: int, causal: bool,
+                          bq: int, bkv: int, bq_dkv: int, bkv_dkv: int,
+                          fused: bool):
+    sk, mk = _splash_mod()
     bs = sk.BlockSizes(
         block_q=bq, block_kv=bkv, block_kv_compute=bkv,
         block_q_dkv=bq_dkv, block_kv_dkv=bkv_dkv,
