@@ -60,6 +60,11 @@ class TrainerConfig:
     learning_rate: float = 3e-4
     seed: int = 0
     log_every: int = 10
+    # data: glob of memory-mapped token shards (train/data.py); empty =
+    # deterministic synthetic batches. prefetch = batches staged ahead
+    # onto devices (host paging + transfer overlap compute)
+    data_path: str = ""
+    prefetch: int = 2
     # checkpointing
     checkpoint_dir: str = ""
     checkpoint_every: int = 100
@@ -183,9 +188,29 @@ def train(cfg: TrainerConfig) -> float:
         return jax.make_array_from_callback(
             x.shape, sharding, lambda idx: x[idx])
 
+    dataset = None
+    if cfg.data_path:
+        from nos_tpu.train.data import TokenDataset
+
+        dataset = TokenDataset(cfg.data_path, cfg.seq_len,
+                               seed=cfg.seed + 1)
+        logger.info("dataset: %d shards, %d tokens",
+                    len(dataset.paths), dataset.n_tokens)
+
     def batch_for(step: int):
-        # synthetic shifted-token LM batches, deterministic per step so a
-        # resumed run replays the same stream
+        # deterministic per step (dataset sampling is a pure function of
+        # (seed, step); synthetic uses fold_in) so a resumed run replays
+        # exactly the stream an uninterrupted one would have seen
+        if dataset is not None:
+            # every process assembles the global batch (tens of MB even at
+            # large global sizes — memmap windows, not the corpus);
+            # `put`'s make_array_from_callback then transfers only the
+            # shards this process's devices own. The per-process slicing
+            # API (dataset.batch(..., process_index/process_count)) is for
+            # custom loops that feed process-local arrays directly.
+            host = dataset.batch(step, cfg.batch_size)
+            return {k: put(v, data_sharding(mesh))
+                    for k, v in host.items()}
         key = jax.random.fold_in(jax.random.PRNGKey(cfg.seed + 1), step)
         tokens = jax.random.randint(
             key, (cfg.batch_size, cfg.seq_len), 0, cfg.vocab)
@@ -200,15 +225,20 @@ def train(cfg: TrainerConfig) -> float:
     profiled = not (cfg.profile_dir and cfg.profile_steps > 0)
     profile_stop = 0
     t0 = time.perf_counter()
+    from nos_tpu.train.data import prefetch_to_device
+
+    batches = prefetch_to_device(
+        batch_for, start_step, cfg.steps - start_step,
+        depth=max(1, cfg.prefetch))
     try:
-        for step in range(start_step, cfg.steps):
+        for step, batch in zip(range(start_step, cfg.steps), batches):
             if not profiled and step >= cfg.profile_start:
                 # >= so a checkpoint-resumed run past profile_start traces
                 jax.profiler.start_trace(cfg.profile_dir)
                 profiling, profiled = True, True
                 profile_stop = step + cfg.profile_steps
             params, opt_state, loss_arr = step_fn(
-                params, opt_state, batch_for(step))
+                params, opt_state, batch)
             if profiling and step + 1 >= profile_stop:
                 jax.block_until_ready(loss_arr)
                 jax.profiler.stop_trace()
@@ -225,6 +255,10 @@ def train(cfg: TrainerConfig) -> float:
                 ckpt.save(step + 1, params, opt_state)
                 last_saved = step + 1
     finally:
+        # release the prefetch producer (and the device batches it holds)
+        # immediately on every exit path, not at GC time — an OOM retry
+        # needs that memory back now
+        batches.close()
         # stop the trace on every exit path (incl. step_fn raising) so a
         # retry/next train() in this process doesn't find the profiler
         # already active; window-past-end also lands here
