@@ -1,0 +1,84 @@
+#!/usr/bin/env python3
+"""Decode-throughput bench: KV-cache generation on the flagship decoder
+(models/generate.py) — prefill tokens/s and steady-state decode tokens/s.
+
+Decode is HBM-bandwidth-bound (every token re-reads the params + the
+GQA-sized cache), so the interesting numbers are per-token latency and
+how far tokens/s sits from the bandwidth roofline. Timing fence is the
+host transfer (block_until_ready lies on 'axon' — see bench_mfu.py).
+
+Prints one JSON line.
+"""
+import json
+import sys
+import time
+
+sys.path.insert(0, ".")
+
+from bench import MODEL, PEAK_TFLOPS  # noqa: E402  (device table reused)
+from bench_mfu import host_fence  # noqa: E402
+
+BATCH = 8
+PROMPT = 128
+NEW_TOKENS = 128
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    from nos_tpu.models import transformer as tr
+    from nos_tpu.models.generate import forward_with_cache, init_cache
+
+    cfg = tr.TransformerConfig(**MODEL)
+    params = tr.init_params(jax.random.PRNGKey(0), cfg)
+    n_params = sum(x.size for x in jax.tree.leaves(params))
+    prompt = jax.random.randint(
+        jax.random.PRNGKey(1), (BATCH, PROMPT), 0, cfg.vocab)
+
+    prefill = jax.jit(
+        lambda p, t, c: forward_with_cache(p, cfg, t, c))
+    decode = jax.jit(
+        lambda p, t, c: forward_with_cache(p, cfg, t, c))
+
+    # compile + warm
+    cache = init_cache(cfg, BATCH, PROMPT + NEW_TOKENS + 8)
+    logits, cache = prefill(params, prompt, cache)
+    tok = jnp.argmax(logits[:, -1], axis=-1)[:, None]
+    logits, cache = decode(params, tok, cache)
+    host_fence(logits)
+
+    # prefill timing
+    t0 = time.perf_counter()
+    cache2 = init_cache(cfg, BATCH, PROMPT + NEW_TOKENS + 8)
+    logits, cache2 = prefill(params, prompt, cache2)
+    host_fence(logits)
+    t_prefill = time.perf_counter() - t0
+
+    # steady-state decode timing
+    t0 = time.perf_counter()
+    for _ in range(NEW_TOKENS):
+        tok = jnp.argmax(logits[:, -1], axis=-1)[:, None]
+        logits, cache2 = decode(params, tok, cache2)
+    host_fence(logits)
+    dt = (time.perf_counter() - t0) / NEW_TOKENS
+
+    dev = jax.devices()[0]
+    result = {
+        "metric": "KV-cache decode, flagship 1.1B GQA decoder",
+        "device": dev.device_kind,
+        "platform": jax.default_backend(),
+        "batch": BATCH,
+        "prompt_len": PROMPT,
+        "new_tokens": NEW_TOKENS,
+        "params_b": round(n_params / 1e9, 3),
+        "prefill_s": round(t_prefill, 4),
+        "prefill_tokens_per_s": round(BATCH * PROMPT / t_prefill),
+        "decode_ms_per_token": round(dt * 1e3, 2),
+        "decode_tokens_per_s": round(BATCH / dt),
+    }
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    main()

@@ -58,13 +58,29 @@ class TrainerConfig:
     batch_size: int = 8
     seq_len: int = 256
     learning_rate: float = 3e-4
+    # optimizer (train/optim.py): linear warmup into constant|cosine,
+    # global-norm clipping, on-device gradient accumulation
+    lr_schedule: str = "constant"
+    warmup_steps: int = 0
+    min_lr_ratio: float = 0.0
+    weight_decay: float = 0.01
+    adam_b1: float = 0.9
+    adam_b2: float = 0.95
+    grad_clip: float = 0.0
+    accum_steps: int = 1
     seed: int = 0
     log_every: int = 10
     # data: glob of memory-mapped token shards (train/data.py); empty =
     # deterministic synthetic batches. prefetch = batches staged ahead
-    # onto devices (host paging + transfer overlap compute)
+    # onto devices (host paging + transfer overlap compute); 0 disables
+    # prefetching entirely (synchronous per-step assembly, no thread)
     data_path: str = ""
     prefetch: int = 2
+    # held-out evaluation: every eval_every steps, mean loss over
+    # eval_steps deterministic batches from eval_data_path (0 = off)
+    eval_data_path: str = ""
+    eval_every: int = 0
+    eval_steps: int = 4
     # checkpointing
     checkpoint_dir: str = ""
     checkpoint_every: int = 100
@@ -157,7 +173,13 @@ def train(cfg: TrainerConfig) -> float:
             lambda: tfm.init_params(jax.random.PRNGKey(cfg.seed), model_cfg),
             out_shardings=shardings,
         )()
-    optimizer = optax.adamw(cfg.learning_rate)
+    from nos_tpu.train.optim import build_optimizer
+
+    optimizer = build_optimizer(
+        cfg.learning_rate, cfg.steps, warmup_steps=cfg.warmup_steps,
+        schedule=cfg.lr_schedule, min_lr_ratio=cfg.min_lr_ratio,
+        weight_decay=cfg.weight_decay, b1=cfg.adam_b1, b2=cfg.adam_b2,
+        grad_clip=cfg.grad_clip, accum_steps=cfg.accum_steps)
     opt_state = optimizer.init(params)
 
     ckpt = None
@@ -197,6 +219,22 @@ def train(cfg: TrainerConfig) -> float:
         logger.info("dataset: %d shards, %d tokens",
                     len(dataset.paths), dataset.n_tokens)
 
+    eval_fn = eval_dataset = eval_batches = None
+    if cfg.eval_every > 0 and cfg.eval_data_path:
+        from nos_tpu.train.data import TokenDataset
+
+        eval_dataset = TokenDataset(cfg.eval_data_path, cfg.seq_len,
+                                    seed=cfg.seed + 2)
+        if pipelined:
+            from nos_tpu.parallel.pipeline import pipeline_1f1b_loss_fn
+
+            # loss-only 1F1B call runs the cheap forward-only rotation
+            eval_fn = jax.jit(lambda p, b: pipeline_1f1b_loss_fn(
+                p, model_cfg, b, mesh, cfg.n_microbatches))
+        else:
+            eval_fn = jax.jit(
+                lambda p, b: tfm.loss_fn(p, model_cfg, b, mesh))
+
     def batch_for(step: int):
         # deterministic per step (dataset sampling is a pure function of
         # (seed, step); synthetic uses fold_in) so a resumed run replays
@@ -227,9 +265,12 @@ def train(cfg: TrainerConfig) -> float:
     t0 = time.perf_counter()
     from nos_tpu.train.data import prefetch_to_device
 
-    batches = prefetch_to_device(
-        batch_for, start_step, cfg.steps - start_step,
-        depth=max(1, cfg.prefetch))
+    if cfg.prefetch > 0:
+        batches = prefetch_to_device(
+            batch_for, start_step, cfg.steps - start_step,
+            depth=cfg.prefetch)
+    else:   # synchronous: no background thread, nothing staged ahead
+        batches = (batch_for(s) for s in range(start_step, cfg.steps))
     try:
         for step, batch in zip(range(start_step, cfg.steps), batches):
             if not profiled and step >= cfg.profile_start:
@@ -251,6 +292,20 @@ def train(cfg: TrainerConfig) -> float:
                 done = step + 1 - start_step
                 logger.info("step %d/%d loss %.4f (%.2f steps/s)",
                             step + 1, cfg.steps, loss, done / max(dt, 1e-9))
+            if eval_fn is not None and (step + 1) % cfg.eval_every == 0:
+                if eval_batches is None:
+                    # the eval set is deterministic — stage it onto the
+                    # devices once, reuse every trigger
+                    eval_batches = [
+                        {k: put(v, data_sharding(mesh))
+                         for k, v in
+                         eval_dataset.batch(i, cfg.batch_size).items()}
+                        for i in range(cfg.eval_steps)
+                    ]
+                losses = [eval_fn(params, eb) for eb in eval_batches]
+                mean = sum(float(x) for x in losses) / len(losses)
+                logger.info("step %d eval loss %.4f (%d batches)",
+                            step + 1, mean, cfg.eval_steps)
             if ckpt is not None and (step + 1) % cfg.checkpoint_every == 0:
                 ckpt.save(step + 1, params, opt_state)
                 last_saved = step + 1

@@ -1,0 +1,82 @@
+"""Optimizer construction for the trainer: schedules, clipping,
+accumulation.
+
+One place builds the optax chain every training entry point uses
+(`cmd/trainer.py`, `examples/`), so a job config — not code — decides the
+schedule. All pieces are optax-native transforms, which keeps the whole
+update inside the jitted train step (schedules read the count carried in
+the optimizer state, so checkpoint restore resumes the schedule exactly).
+
+Gradient accumulation (`accum_steps > 1`) wraps the chain in
+``optax.MultiSteps``: k micro-steps average their grads on device and
+apply one real update — the dp-free way to reach large effective batches
+on a memory-bound chip (composes with pipeline microbatching, which
+splits *within* a step).
+"""
+from __future__ import annotations
+
+import optax
+
+__all__ = ["build_lr_schedule", "build_optimizer"]
+
+
+def build_lr_schedule(
+    base_lr: float,
+    total_steps: int,
+    *,
+    warmup_steps: int = 0,
+    schedule: str = "constant",
+    min_lr_ratio: float = 0.0,
+):
+    """Linear warmup (optional) into a constant or cosine-decay schedule.
+    ``min_lr_ratio`` is the cosine floor as a fraction of base_lr."""
+    if schedule not in ("constant", "cosine"):
+        raise ValueError(f"unknown lr schedule {schedule!r}")
+    if schedule == "cosine":
+        decay_steps = max(1, total_steps - warmup_steps)
+        main = optax.cosine_decay_schedule(base_lr, decay_steps,
+                                           alpha=min_lr_ratio)
+    else:
+        main = optax.constant_schedule(base_lr)
+    if warmup_steps > 0:
+        warm = optax.linear_schedule(0.0, base_lr, warmup_steps)
+        return optax.join_schedules([warm, main], [warmup_steps])
+    return main
+
+
+def build_optimizer(
+    base_lr: float,
+    total_steps: int,
+    *,
+    warmup_steps: int = 0,
+    schedule: str = "constant",
+    min_lr_ratio: float = 0.0,
+    weight_decay: float = 0.01,
+    b1: float = 0.9,
+    b2: float = 0.95,
+    grad_clip: float = 0.0,
+    accum_steps: int = 1,
+):
+    """adamw with the configured schedule, optional global-norm clipping,
+    optional gradient accumulation. Returns an optax
+    GradientTransformation (MultiSteps-wrapped when accum_steps > 1).
+
+    ``total_steps``/``warmup_steps`` are in *caller* steps (micro-steps):
+    MultiSteps advances the inner schedule count only once per window, so
+    with accum_steps > 1 the horizons are converted to update units here
+    — warmup and decay complete exactly when the configured step counts
+    say they do."""
+    if accum_steps > 1:
+        total_steps = -(-total_steps // accum_steps)     # ceil div
+        warmup_steps = -(-warmup_steps // accum_steps)
+    lr = build_lr_schedule(
+        base_lr, total_steps, warmup_steps=warmup_steps, schedule=schedule,
+        min_lr_ratio=min_lr_ratio)
+    parts = []
+    if grad_clip > 0:
+        parts.append(optax.clip_by_global_norm(grad_clip))
+    parts.append(optax.adamw(lr, b1=b1, b2=b2, weight_decay=weight_decay))
+    tx = optax.chain(*parts) if len(parts) > 1 else parts[0]
+    if accum_steps > 1:
+        tx = optax.MultiSteps(tx, every_k_schedule=accum_steps)
+    return tx

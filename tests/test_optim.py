@@ -1,0 +1,116 @@
+"""Optimizer construction (train/optim.py): schedules, clipping,
+accumulation — and their wiring through the trainer."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from nos_tpu.train.optim import build_lr_schedule, build_optimizer
+
+
+def test_warmup_then_cosine_shape():
+    s = build_lr_schedule(1e-3, 100, warmup_steps=10, schedule="cosine",
+                          min_lr_ratio=0.1)
+    assert float(s(0)) == 0.0
+    assert float(s(10)) == pytest.approx(1e-3, rel=1e-6)
+    assert float(s(55)) < 1e-3                      # decaying
+    assert float(s(100)) == pytest.approx(1e-4, rel=1e-3)   # floor
+    # monotone rise through warmup
+    assert float(s(5)) == pytest.approx(5e-4, rel=1e-6)
+
+
+def test_constant_schedule_with_warmup():
+    s = build_lr_schedule(2e-4, 50, warmup_steps=4)
+    assert float(s(2)) == pytest.approx(1e-4, rel=1e-6)
+    assert float(s(30)) == pytest.approx(2e-4, rel=1e-6)
+
+
+def test_unknown_schedule_rejected():
+    with pytest.raises(ValueError, match="schedule"):
+        build_lr_schedule(1e-3, 10, schedule="linear")
+
+
+def test_grad_clip_bounds_update():
+    params = {"w": jnp.zeros(4)}
+    huge = {"w": jnp.full(4, 1e6)}
+    clipped = build_optimizer(1.0, 10, grad_clip=1.0, weight_decay=0.0)
+    state = clipped.init(params)
+    updates, _ = clipped.update(huge, state, params)
+    # adam normalizes magnitude anyway; the clip must make the update
+    # identical to feeding the pre-clipped gradient
+    pre = jax.tree.map(lambda g: g / jnp.sqrt(jnp.sum(jnp.square(g))), huge)
+    ref = build_optimizer(1.0, 10, grad_clip=0.0, weight_decay=0.0)
+    ref_updates, _ = ref.update(pre, ref.init(params), params)
+    np.testing.assert_allclose(np.asarray(updates["w"]),
+                               np.asarray(ref_updates["w"]), rtol=1e-5)
+
+
+def test_accumulation_applies_every_k_and_averages():
+    params = {"w": jnp.ones(3)}
+    tx = build_optimizer(1e-2, 10, accum_steps=2, weight_decay=0.0)
+    state = tx.init(params)
+    g1 = {"w": jnp.array([1.0, 0.0, 2.0])}
+    g2 = {"w": jnp.array([3.0, 4.0, 0.0])}
+
+    u1, state = tx.update(g1, state, params)
+    assert float(jnp.abs(u1["w"]).max()) == 0.0     # mid-window: no-op
+    u2, state = tx.update(g2, state, params)
+    assert float(jnp.abs(u2["w"]).max()) > 0.0      # window closes: applies
+
+    # the applied update equals one plain-adamw step on the mean grad
+    mean = jax.tree.map(lambda a, b: (a + b) / 2, g1, g2)
+    ref = build_optimizer(1e-2, 10, weight_decay=0.0)
+    ref_u, _ = ref.update(mean, ref.init(params), params)
+    np.testing.assert_allclose(np.asarray(u2["w"]), np.asarray(ref_u["w"]),
+                               rtol=1e-5, atol=1e-8)
+
+
+def test_schedule_count_lives_in_opt_state():
+    """Cosine decay must progress with the step count carried in the
+    optimizer state (that's what makes checkpoint-resume exact)."""
+    params = {"w": jnp.ones(2)}
+    tx = build_optimizer(1e-2, 4, schedule="cosine", weight_decay=0.0)
+    state = tx.init(params)
+    g = {"w": jnp.ones(2)}
+    mags = []
+    for _ in range(4):
+        u, state = tx.update(g, state, params)
+        mags.append(float(jnp.abs(u["w"]).max()))
+    assert mags[0] > mags[-1]                       # lr decayed
+
+
+@pytest.mark.skipif(len(jax.devices()) < 8, reason="needs 8 devices")
+def test_trainer_wires_schedule_clip_accum():
+    from nos_tpu.cmd.trainer import TrainerConfig, train
+
+    loss = train(TrainerConfig(
+        vocab=64, d_model=32, n_layers=2, n_heads=4, d_ff=64, max_seq=32,
+        steps=6, batch_size=4, seq_len=16, bf16=False, dp=2,
+        lr_schedule="cosine", warmup_steps=2, grad_clip=1.0,
+        accum_steps=2, log_every=3))
+    assert loss == loss and loss < 100
+
+
+def test_accum_schedule_horizon_in_update_units():
+    """With accumulation, warmup/decay must complete at the configured
+    micro-step counts: MultiSteps advances the inner count once per
+    window, so build_optimizer converts the horizons."""
+    params = {"w": jnp.ones(2)}
+    g = {"w": jnp.ones(2)}
+
+    def mags(tx, n):
+        state = tx.init(params)
+        out = []
+        for _ in range(n):
+            u, state = tx.update(g, state, params)
+            out.append(float(jnp.abs(u["w"]).max()))
+        return out
+
+    plain = mags(build_optimizer(
+        1e-2, 4, schedule="cosine", weight_decay=0.0), 4)
+    accum = mags(build_optimizer(
+        1e-2, 8, schedule="cosine", weight_decay=0.0, accum_steps=2), 8)
+    # window-closing micro-steps must follow the same decay the plain
+    # optimizer follows per step (same grads every step -> same updates)
+    np.testing.assert_allclose(accum[1::2], plain, rtol=1e-5)

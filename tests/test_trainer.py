@@ -127,3 +127,25 @@ def test_dataset_resume_reproduces_uninterrupted_run(tmp_path):
     resumed = train(tiny(data_path=data, steps=4, checkpoint_dir=ck,
                          checkpoint_every=2))
     assert resumed == pytest.approx(straight, rel=1e-5)
+
+
+def test_eval_loop_logs_heldout_loss(tmp_path, caplog):
+    import logging
+
+    import numpy as np
+
+    from nos_tpu.train.data import write_token_shards
+
+    rng = np.random.default_rng(2)
+    write_token_shards(str(tmp_path / "train"),
+                       [rng.integers(0, 64, size=600, dtype=np.uint32)])
+    write_token_shards(str(tmp_path / "val"),
+                       [rng.integers(0, 64, size=300, dtype=np.uint32)])
+    with caplog.at_level(logging.INFO, logger="nos_tpu.trainer"):
+        loss = train(tiny(
+            data_path=str(tmp_path / "train" / "shard_*.bin"),
+            eval_data_path=str(tmp_path / "val" / "shard_*.bin"),
+            eval_every=2, eval_steps=2))
+    assert loss == loss
+    evals = [r for r in caplog.records if "eval loss" in r.getMessage()]
+    assert len(evals) == 2          # steps 2 and 4 of a 4-step run
