@@ -1,13 +1,11 @@
 #!/usr/bin/env python3
 """Decode-throughput bench: KV-cache generation on the flagship decoder
-(models/generate.py) — prefill tokens/s and steady-state decode tokens/s.
+(models/generate.py) — prefill tokens/s and steady-state decode tokens/s,
+in bf16 and with int8 weight-only quantization (models/quant.py; decode
+is HBM-bandwidth-bound on weight reads, so int8 should approach 2x).
 
-Decode is HBM-bandwidth-bound (every token re-reads the params + the
-GQA-sized cache), so the interesting numbers are per-token latency and
-how far tokens/s sits from the bandwidth roofline. Timing fence is the
-host transfer (block_until_ready lies on 'axon' — see bench_mfu.py).
-
-Prints one JSON line.
+Timing fence is the host transfer (block_until_ready lies on 'axon' —
+see bench_mfu.py). Prints one JSON line.
 """
 import json
 import sys
@@ -30,38 +28,39 @@ def main():
     from nos_tpu.models import transformer as tr
     from nos_tpu.models.generate import forward_with_cache, init_cache
 
+    from nos_tpu.models.quant import quantize_params
+
     cfg = tr.TransformerConfig(**MODEL)
     params = tr.init_params(jax.random.PRNGKey(0), cfg)
     n_params = sum(x.size for x in jax.tree.leaves(params))
     prompt = jax.random.randint(
         jax.random.PRNGKey(1), (BATCH, PROMPT), 0, cfg.vocab)
 
-    prefill = jax.jit(
-        lambda p, t, c: forward_with_cache(p, cfg, t, c))
-    decode = jax.jit(
-        lambda p, t, c: forward_with_cache(p, cfg, t, c))
+    step = jax.jit(lambda p, t, c: forward_with_cache(p, cfg, t, c))
 
-    # compile + warm
-    cache = init_cache(cfg, BATCH, PROMPT + NEW_TOKENS + 8)
-    logits, cache = prefill(params, prompt, cache)
-    tok = jnp.argmax(logits[:, -1], axis=-1)[:, None]
-    logits, cache = decode(params, tok, cache)
-    host_fence(logits)
-
-    # prefill timing
-    t0 = time.perf_counter()
-    cache2 = init_cache(cfg, BATCH, PROMPT + NEW_TOKENS + 8)
-    logits, cache2 = prefill(params, prompt, cache2)
-    host_fence(logits)
-    t_prefill = time.perf_counter() - t0
-
-    # steady-state decode timing
-    t0 = time.perf_counter()
-    for _ in range(NEW_TOKENS):
+    def measure(p):
+        cache = init_cache(cfg, BATCH, PROMPT + NEW_TOKENS + 8)
+        logits, cache = step(p, prompt, cache)          # compile prefill
         tok = jnp.argmax(logits[:, -1], axis=-1)[:, None]
-        logits, cache2 = decode(params, tok, cache2)
-    host_fence(logits)
-    dt = (time.perf_counter() - t0) / NEW_TOKENS
+        logits, cache = step(p, tok, cache)             # compile decode
+        host_fence(logits)
+
+        t0 = time.perf_counter()
+        cache = init_cache(cfg, BATCH, PROMPT + NEW_TOKENS + 8)
+        logits, cache = step(p, prompt, cache)
+        host_fence(logits)
+        t_prefill = time.perf_counter() - t0
+
+        t0 = time.perf_counter()
+        for _ in range(NEW_TOKENS):
+            tok = jnp.argmax(logits[:, -1], axis=-1)[:, None]
+            logits, cache = step(p, tok, cache)
+        host_fence(logits)
+        dt = (time.perf_counter() - t0) / NEW_TOKENS
+        return t_prefill, dt
+
+    t_prefill, dt = measure(params)
+    t_prefill_q8, dt_q8 = measure(quantize_params(params))
 
     dev = jax.devices()[0]
     result = {
@@ -76,6 +75,10 @@ def main():
         "prefill_tokens_per_s": round(BATCH * PROMPT / t_prefill),
         "decode_ms_per_token": round(dt * 1e3, 2),
         "decode_tokens_per_s": round(BATCH / dt),
+        "int8_prefill_s": round(t_prefill_q8, 4),
+        "int8_decode_ms_per_token": round(dt_q8 * 1e3, 2),
+        "int8_decode_tokens_per_s": round(BATCH / dt_q8),
+        "int8_speedup": round(dt / dt_q8, 2),
     }
     print(json.dumps(result))
 

@@ -34,6 +34,7 @@ from nos_tpu.models.transformer import Params, TransformerConfig
 from nos_tpu.ops.layers import (
     apply_rope, rms_norm, rope_frequencies, swiglu,
 )
+from nos_tpu.ops.quant import embed_lookup, qdot
 
 Cache = Dict[str, jax.Array]
 
@@ -88,14 +89,16 @@ def forward_with_cache(
     positions = pos0 + jnp.arange(s)
     scale = cfg.head_dim ** -0.5
 
-    x = params["embed"][tokens]
+    # params may be the training pytree or its int8-quantized twin
+    # (models/quant.quantize_params): qdot/embed_lookup handle both
+    x = embed_lookup(params["embed"], tokens, cfg.dtype)
 
     def layer_body(x, layer_and_cache):
         layer, ck, cv = layer_and_cache
         h = rms_norm(x, layer["attn_norm"])
-        q = jnp.dot(h, layer["wq"]).reshape(b, s, cfg.n_heads, cfg.head_dim)
-        k = jnp.dot(h, layer["wk"]).reshape(b, s, cfg.kv_heads, cfg.head_dim)
-        v = jnp.dot(h, layer["wv"]).reshape(b, s, cfg.kv_heads, cfg.head_dim)
+        q = qdot(h, layer["wq"]).reshape(b, s, cfg.n_heads, cfg.head_dim)
+        k = qdot(h, layer["wk"]).reshape(b, s, cfg.kv_heads, cfg.head_dim)
+        v = qdot(h, layer["wv"]).reshape(b, s, cfg.kv_heads, cfg.head_dim)
         q, k = (apply_rope(t, freqs, positions) for t in (q, k))
         ck = jax.lax.dynamic_update_slice(
             ck, k.transpose(0, 2, 1, 3).astype(ck.dtype), (0, 0, pos0, 0))
@@ -104,7 +107,7 @@ def forward_with_cache(
         o = _cached_attention(q.transpose(0, 2, 1, 3), ck, cv, positions,
                               scale)
         o = o.transpose(0, 2, 1, 3).reshape(b, s, cfg.d_model)
-        x = x + jnp.dot(o, layer["wo"])
+        x = x + qdot(o, layer["wo"])
         if cfg.n_experts > 0:
             from nos_tpu.ops.moe import moe_ffn
 
@@ -124,7 +127,7 @@ def forward_with_cache(
         layer_body, x, (params["layers"], cache["k"], cache["v"]))
 
     x = rms_norm(x, params["final_norm"])
-    logits = jnp.dot(x, params["unembed"]).astype(jnp.float32)
+    logits = qdot(x, params["unembed"]).astype(jnp.float32)
     return logits, {"k": ks, "v": vs, "pos": pos0 + s}
 
 

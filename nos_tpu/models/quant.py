@@ -1,0 +1,39 @@
+"""Param-tree quantization for the decode path.
+
+``quantize_params`` knows the transformer parameter layout
+(models/transformer.init_params) and converts the dense matmul weights
+to int8 ``QuantLinear``s (ops/quant.py). Norms stay fp32, the MoE expert
+stacks stay bf16 (the MoE einsum path doesn't route through ``qdot``),
+and training/prefill-quality paths are untouched — this feeds
+``models.generate`` only (the classic weight-only inference split).
+"""
+from __future__ import annotations
+
+from typing import Any
+
+from nos_tpu.ops.quant import quantize_array
+
+__all__ = ["quantize_params"]
+
+_DENSE_FFN_KEYS = ("w_gate", "w_up", "w_down")
+_ATTN_KEYS = ("wq", "wk", "wv", "wo")
+
+
+def quantize_params(params: Any, *, quantize_embed: bool = True) -> Any:
+    """Return a params pytree where the decoder's matmul weights are
+    QuantLinear (int8 + per-channel scales). Plugs directly into
+    ``generate.forward_with_cache``."""
+    out = dict(params)
+    layers = dict(params["layers"])
+    for k in _ATTN_KEYS:
+        layers[k] = quantize_array(layers[k])
+    if "w_router" not in layers:        # dense FFN only; experts stay bf16
+        for k in _DENSE_FFN_KEYS:
+            layers[k] = quantize_array(layers[k])
+    out["layers"] = layers
+    out["unembed"] = quantize_array(params["unembed"])
+    if quantize_embed:
+        # per-ROW scales: a rare token's small row must not quantize
+        # against the whole column's max (embed is a gather, not a matmul)
+        out["embed"] = quantize_array(params["embed"], axis=-1)
+    return out

@@ -54,13 +54,16 @@ def apply_rope(x: jax.Array, freqs: jax.Array,
     return out.reshape(x.shape).astype(orig_dtype)
 
 
-def swiglu(x: jax.Array, w_gate: jax.Array, w_up: jax.Array,
-           w_down: jax.Array) -> jax.Array:
+def swiglu(x: jax.Array, w_gate, w_up, w_down) -> jax.Array:
     """SwiGLU MLP: down( silu(x @ gate) * (x @ up) ). Three matmuls —
-    exactly the shape XLA fuses the elementwise ops into."""
-    gate = jax.nn.silu(jnp.dot(x, w_gate))
-    up = jnp.dot(x, w_up)
-    return jnp.dot(gate * up, w_down)
+    exactly the shape XLA fuses the elementwise ops into. Weights may be
+    plain arrays or int8 ``QuantLinear``s (ops/quant.py) — the decode
+    path feeds quantized ones."""
+    from nos_tpu.ops.quant import qdot
+
+    gate = jax.nn.silu(qdot(x, w_gate))
+    up = qdot(x, w_up)
+    return qdot(gate * up, w_down)
 
 
 def gelu_mlp(x: jax.Array, w_in: jax.Array, b_in: jax.Array,

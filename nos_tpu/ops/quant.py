@@ -1,0 +1,62 @@
+"""Weight-only int8 primitives.
+
+Decode reads every weight matrix once per generated token — it is
+HBM-bandwidth-bound on the params, not FLOPs-bound — so storing weights
+as int8 (+ fp32 per-channel scales) halves the bytes the hot loop pulls
+from HBM vs bf16. XLA fuses the int8→bf16 convert and the per-channel
+scale into the matmul read; no dequantized copy is ever materialized.
+
+Per-channel symmetric quantization over the contraction axis:
+q = round(w / s), s = max|w| / 127 per output channel (axis -1, reduced
+over axis -2), so a stacked weight [L, in, out] gets per-(layer, out)
+scales and slices cleanly under ``lax.scan``.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["QuantLinear", "quantize_array", "qdot", "embed_lookup"]
+
+
+@jax.tree_util.register_dataclass
+@dataclass(frozen=True)
+class QuantLinear:
+    """int8 weights + fp32 scales; w ≈ q * scale broadcast over the
+    reduced axis (the weight's shape minus the quantization axis).
+    Matmul weights quantize over the contraction axis -2 (per-output-
+    channel scales); embedding tables over axis -1 (per-row scales —
+    rare-token rows must not inherit the whole column's max)."""
+    q: jax.Array        # int8, same shape as the original weight
+    scale: jax.Array    # fp32, weight shape with the quantized axis removed
+
+
+def quantize_array(w: jax.Array, *, axis: int = -2) -> QuantLinear:
+    w32 = w.astype(jnp.float32)
+    amax = jnp.max(jnp.abs(w32), axis=axis)
+    scale = jnp.where(amax > 0, amax / 127.0, 1.0)
+    q = jnp.clip(jnp.round(w32 / jnp.expand_dims(scale, axis)), -127, 127
+                 ).astype(jnp.int8)
+    return QuantLinear(q=q, scale=scale)
+
+
+def qdot(x: jax.Array, w) -> jax.Array:
+    """x @ w for a plain array or a QuantLinear (2-D at call time — a
+    stacked QuantLinear is sliced per layer by the caller's scan)."""
+    if isinstance(w, QuantLinear):
+        y = jnp.dot(x, w.q.astype(x.dtype))
+        return y * w.scale.astype(x.dtype)
+    return jnp.dot(x, w)
+
+
+def embed_lookup(table, tokens: jax.Array, dtype=None) -> jax.Array:
+    """Embedding row gather for a plain [vocab, d] table or one quantized
+    with per-row scales (quantize_array(..., axis=-1))."""
+    if isinstance(table, QuantLinear):
+        rows = (table.q[tokens].astype(jnp.float32)
+                * table.scale[tokens][..., None])
+        return rows.astype(dtype) if dtype is not None else rows
+    rows = table[tokens]
+    return rows.astype(dtype) if dtype is not None else rows

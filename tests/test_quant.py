@@ -1,0 +1,121 @@
+"""Weight-only int8 quantization (ops/quant.py, models/quant.py): error
+bounds, decode-path integration, memory halving."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from nos_tpu.models import transformer as tfm
+from nos_tpu.models.generate import forward_with_cache, generate, init_cache
+from nos_tpu.models.quant import quantize_params
+from nos_tpu.ops.quant import QuantLinear, qdot, quantize_array
+
+
+def cfg_kw(**kw):
+    base = dict(vocab=64, d_model=32, n_layers=2, n_heads=4, d_ff=64,
+                max_seq=32, dtype=jnp.float32)
+    base.update(kw)
+    return tfm.TransformerConfig(**base)
+
+
+def test_quantize_roundtrip_error_bounded():
+    w = jax.random.normal(jax.random.PRNGKey(0), (64, 32))
+    ql = quantize_array(w)
+    assert ql.q.dtype == jnp.int8 and ql.scale.shape == (32,)
+    err = jnp.abs(ql.q.astype(jnp.float32) * ql.scale - w)
+    # rounding error is at most half a quantization step per element
+    assert float((err - ql.scale[None, :] / 2).max()) <= 1e-6
+
+
+def test_quantize_stacked_weights_per_layer_scales():
+    w = jax.random.normal(jax.random.PRNGKey(1), (3, 16, 8))
+    ql = quantize_array(w)
+    assert ql.scale.shape == (3, 8)             # per (layer, out_channel)
+    # scanning the leading axis must slice q and scale together
+    sliced = jax.tree.map(lambda x: x[1], ql)
+    np.testing.assert_allclose(
+        np.asarray(qdot(jnp.eye(16), sliced)),
+        np.asarray(ql.q[1].astype(jnp.float32) * ql.scale[1]),
+        rtol=1e-6)
+
+
+def test_zero_channel_does_not_nan():
+    w = jnp.zeros((8, 4))
+    ql = quantize_array(w)
+    out = qdot(jnp.ones((2, 8)), ql)
+    assert not jnp.isnan(out).any() and float(jnp.abs(out).max()) == 0.0
+
+
+def test_qdot_passthrough_for_plain_arrays():
+    x = jnp.ones((2, 4))
+    w = jnp.full((4, 3), 2.0)
+    np.testing.assert_allclose(np.asarray(qdot(x, w)),
+                               np.asarray(jnp.dot(x, w)))
+
+
+def test_quantized_decode_close_to_fp():
+    cfg = cfg_kw(n_kv_heads=2)
+    params = tfm.init_params(jax.random.PRNGKey(0), cfg)
+    qparams = quantize_params(params)
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 8), 0, cfg.vocab)
+
+    fp, _ = forward_with_cache(params, cfg, tokens, init_cache(cfg, 2))
+    q8, _ = forward_with_cache(qparams, cfg, tokens, init_cache(cfg, 2))
+    # weight-only int8 keeps logits close; compare direction + magnitude
+    a, b = np.asarray(fp).ravel(), np.asarray(q8).ravel()
+    cos = float(np.dot(a, b) / (np.linalg.norm(a) * np.linalg.norm(b)))
+    assert cos > 0.999
+    assert np.abs(a - b).max() < 0.15 * max(1.0, np.abs(a).max())
+
+
+def test_quantized_generate_runs_and_is_deterministic():
+    cfg = cfg_kw()
+    params = quantize_params(tfm.init_params(jax.random.PRNGKey(0), cfg))
+    prompt = jnp.zeros((2, 3), jnp.int32)
+    out1 = jax.jit(lambda p, t: generate(p, cfg, t, 5))(params, prompt)
+    out2 = generate(params, cfg, prompt, 5)
+    assert out1.shape == (2, 8)
+    np.testing.assert_array_equal(np.asarray(out1), np.asarray(out2))
+
+
+def test_quantization_halves_param_bytes():
+    cfg = cfg_kw(d_model=64, d_ff=256, dtype=jnp.bfloat16)
+    params = tfm.init_params(jax.random.PRNGKey(0), cfg)
+    qparams = quantize_params(params)
+
+    def nbytes(t):
+        return sum(x.nbytes for x in jax.tree.leaves(t))
+
+    # bf16 -> int8 on the matmul weights: close to half, plus small scales
+    assert nbytes(qparams) < 0.65 * nbytes(params)
+
+
+def test_moe_experts_stay_unquantized_and_decode_runs():
+    cfg = cfg_kw(n_experts=2)
+    params = tfm.init_params(jax.random.PRNGKey(0), cfg)
+    qparams = quantize_params(params)
+    assert isinstance(qparams["layers"]["wq"], QuantLinear)
+    assert not isinstance(qparams["layers"]["w_gate"], QuantLinear)
+    out = generate(qparams, cfg, jnp.zeros((1, 2), jnp.int32), 3)
+    assert out.shape == (1, 5)
+
+
+def test_embed_quantizes_per_row_not_per_column():
+    """A rare-token row 100x smaller than the rest must survive
+    quantization — per-row scales, not the matmul per-column convention."""
+    from nos_tpu.ops.quant import embed_lookup
+
+    table = jnp.ones((16, 8))
+    table = table.at[3].set(0.01)           # tiny "rare token" row
+    qt = quantize_params(
+        {"layers": {"wq": jnp.ones((2, 4, 4)), "wk": jnp.ones((2, 4, 4)),
+                    "wv": jnp.ones((2, 4, 4)), "wo": jnp.ones((2, 4, 4)),
+                    "w_gate": jnp.ones((2, 4, 4)),
+                    "w_up": jnp.ones((2, 4, 4)),
+                    "w_down": jnp.ones((2, 4, 4))},
+         "embed": table, "unembed": jnp.ones((8, 16)),
+         "final_norm": jnp.ones(8)})["embed"]
+    assert qt.scale.shape == (16,)          # per row
+    rows = embed_lookup(qt, jnp.array([[3]]))
+    np.testing.assert_allclose(np.asarray(rows[0, 0]),
+                               np.full(8, 0.01), rtol=0.01)
