@@ -40,10 +40,10 @@ def _snake_indices(shape: Sequence[int]):
             yield (i,) + idx
 
 
-def device_grid_coords(devices: Sequence) -> Optional[dict]:
-    """Map each device to its normalized physical grid coordinate, or None
-    when coords are unusable (missing, or not a full cuboid). Two-core
-    chips get core_on_chip as an extra innermost dimension."""
+def device_grid_coords(devices: Sequence) -> Optional[tuple]:
+    """(device -> normalized physical grid coordinate, grid shape), or
+    None when coords are unusable (missing, or not a full cuboid).
+    Two-core chips get core_on_chip as an extra innermost dimension."""
     coords = {}
     for d in devices:
         c = getattr(d, "coords", None)
@@ -60,29 +60,83 @@ def device_grid_coords(devices: Sequence) -> Optional[dict]:
         expect *= s
     if expect != len(devices) or len(set(norm.values())) != len(devices):
         return None  # holes / duplicates: not a full cuboid, can't walk it
-    return norm
+    return norm, shape
 
 
-def arrange_devices(devices: Sequence, sizes: Sequence[int]) -> np.ndarray:
+def _snake_order(devices: Sequence) -> Sequence:
+    """Devices along a boustrophedon walk of their coord grid (ICI unit
+    steps between consecutive devices); enumeration order without usable
+    coords."""
+    got = device_grid_coords(devices)
+    if got is None:
+        return list(devices)
+    norm, shape = got
+    by_coord = {c: d for d, c in norm.items()}
+    return [by_coord[idx] for idx in _snake_indices(shape)]
+
+
+def arrange_devices(devices: Sequence, sizes: Sequence[int],
+                    names: Optional[Sequence[str]] = None) -> np.ndarray:
     """Arrange ``prod(sizes)`` devices into an ndarray of shape ``sizes``
     such that, when physical coords are available, devices adjacent along
     the innermost axis are one torus hop apart (see module docstring).
-    Falls back to enumeration order without coords."""
+    Falls back to enumeration order without coords.
+
+    Multi-slice (DCN-connected) device sets — devices carrying distinct
+    ``slice_index`` values, e.g. TPU multislice — are laid out so a slice
+    boundary is only ever crossed by the LEADING DATA axes: each slice is
+    snake-ordered on its own ICI torus and slices are concatenated, which
+    after the reshape keeps every model-axis collective (tp/sp/ep/pp) on
+    ICI and puts only dp/fsdp hops on DCN. The product of the leading
+    data axes must be divisible by the slice count for the boundary to
+    align (validated when ``names`` — the mesh axis names — are given;
+    without names the outermost axis stands in for "data"). When more
+    devices than needed are offered, whole slices are consumed first so
+    the truncation itself cannot split a slice."""
     n = 1
     for s in sizes:
         n *= s
-    devices = list(devices)[:n] if len(devices) > n else list(devices)
-    if len(devices) != n:
+    devices = list(devices)
+    if len(devices) < n:
         raise ValueError(f"need {n} devices, got {len(devices)}")
-    norm = device_grid_coords(devices)
-    if norm is not None:
-        shape = tuple(max(c[i] for c in norm.values()) + 1
-                      for i in range(len(next(iter(norm.values())))))
-        by_coord = {c: d for d, c in norm.items()}
-        ordered = [by_coord[idx] for idx in _snake_indices(shape)]
+
+    groups: dict = {}
+    for d in devices:
+        groups.setdefault(getattr(d, "slice_index", None), []).append(d)
+
+    if len(groups) > 1:
+        # consume whole slices first (sorted for determinism) so
+        # truncation can't split a slice; each slice snake-ordered
+        ordered = []
+        taken = {}
+        for sid in sorted(groups, key=str):
+            take = min(n - len(ordered), len(groups[sid]))
+            if take == 0:
+                break
+            taken[sid] = take
+            ordered.extend(_snake_order(groups[sid])[:take])
+        if len(set(taken.values())) == 1 and len(taken) > 1:
+            # every used slice contributes equally: the slice boundary
+            # falls on fixed strides — enforce DCN/ICI alignment
+            n_slices = len(taken)
+            if names is not None:
+                data = 1
+                for name, size in zip(names, sizes):
+                    if name not in ("dp", "fsdp"):
+                        break
+                    data *= size
+            else:
+                data = sizes[0]
+            if data % n_slices != 0:
+                raise ValueError(
+                    f"the leading data axes (product {data}) must be "
+                    f"divisible by the slice count ({n_slices}) so "
+                    f"model-axis collectives stay on ICI — put dp/fsdp "
+                    f"totalling a multiple of {n_slices} outermost in "
+                    f"the ParallelLayout")
     else:
-        ordered = devices
-    return np.array(ordered, dtype=object).reshape(tuple(sizes))
+        ordered = _snake_order(devices)[:n]
+    return np.array(ordered[:n], dtype=object).reshape(tuple(sizes))
 
 
 def build_mesh(layout: ParallelLayout, devices: Optional[Sequence] = None) -> Mesh:
@@ -93,7 +147,7 @@ def build_mesh(layout: ParallelLayout, devices: Optional[Sequence] = None) -> Me
         )
     names = layout.axis_names()
     sizes = layout.axis_sizes()
-    return Mesh(arrange_devices(devices, sizes), names)
+    return Mesh(arrange_devices(devices, sizes, names), names)
 
 
 def data_sharding(mesh: Mesh) -> NamedSharding:

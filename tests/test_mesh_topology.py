@@ -59,8 +59,9 @@ def test_snake_consecutive_indices_are_unit_steps():
 
 def test_device_grid_coords_normalizes_offset_subgrid():
     devs = [FakeDev((x + 4, y + 2, 7)) for x in range(2) for y in range(2)]
-    norm = device_grid_coords(devs)
+    norm, shape = device_grid_coords(devs)
     assert set(norm.values()) == {(x, y, 0, 0) for x in range(2) for y in range(2)}
+    assert shape == (2, 2, 1, 1)
 
 
 def test_device_grid_coords_rejects_holes():
@@ -139,3 +140,81 @@ def test_build_mesh_uses_coords_when_available():
     grid = arrange_devices(devs, (2, 2, 2))
     # flat order must NOT be plain enumeration (snake reverses odd rows)
     assert [d.id for d in grid.reshape(-1)] != list(range(8))
+
+
+# ------------------------------------------------------- multi-slice / DCN
+
+class FakeSliceDev(FakeDev):
+    def __init__(self, coords, slice_index, id_=0):
+        super().__init__(coords, id_=id_)
+        self.slice_index = slice_index
+
+    def __repr__(self):
+        return f"FakeSliceDev(s{self.slice_index}){self.coords}"
+
+
+def two_slices(shape=(2, 2, 1)):
+    devs = []
+    for s in range(2):
+        for i, c in enumerate(itertools.product(*(range(x) for x in shape))):
+            devs.append(FakeSliceDev(c, s, id_=s * 100 + i))
+    return devs
+
+
+def test_multislice_inner_axes_never_cross_slice_boundary():
+    devs = two_slices()                     # 2 slices x 4 chips
+    grid = arrange_devices(devs, (2, 4))    # dp=2 outer, tp=4 inner
+    for row in grid:                        # each dp row = one slice
+        assert len({d.slice_index for d in row}) == 1
+    # and within a slice the tp walk is still ICI-unit-step
+    for row in grid:
+        for a, b in zip(row, row[1:]):
+            assert hop_distance(a, b, (2, 2, 1)) == 1
+
+
+def test_multislice_requires_divisible_data_axes():
+    devs = two_slices()
+    with pytest.raises(ValueError, match="divisible by the slice count"):
+        arrange_devices(devs, (1, 8))       # outer=1 can't split 2 slices
+    # axis-identity aware: a model-only layout (tp, pp) must not let tp
+    # straddle DCN silently
+    with pytest.raises(ValueError, match="dp/fsdp"):
+        build_mesh(ParallelLayout(tp=4, pp=2), devs)
+
+
+def test_multislice_accepts_data_product_across_leading_axes():
+    # 4 slices x 2 chips; dp*fsdp = 4 aligns even though dp alone (2) < 4
+    devs = []
+    for s in range(4):
+        for i, c in enumerate(itertools.product(range(2), range(1), range(1))):
+            devs.append(FakeSliceDev(c, s, id_=s * 10 + i))
+    mesh = build_mesh(ParallelLayout(dp=2, fsdp=2, tp=2), devs)
+    for idx_dp in range(2):
+        for idx_fs in range(2):
+            row = mesh.devices[idx_dp, idx_fs]
+            assert len({d.slice_index for d in row}) == 1
+
+
+def test_multislice_build_mesh_places_dp_across_dcn():
+    devs = two_slices()
+    mesh = build_mesh(ParallelLayout(dp=2, tp=4), devs)
+    arr = mesh.devices
+    assert arr.shape == (2, 4)
+    assert {d.slice_index for d in arr[0]} != \
+        {d.slice_index for d in arr[1]}
+
+
+def test_ragged_slices_best_effort_per_slice_snake():
+    devs = two_slices()[:6]                 # 4 + 2 chips: ragged
+    grid = arrange_devices(devs, (2, 3))
+    assert grid.shape == (2, 3)             # no crash, best-effort order
+    flat = list(grid.ravel())
+    # whole slices consumed first, each snake-ordered: slice 0's four
+    # devices precede slice 1's two
+    assert [d.slice_index for d in flat] == [0, 0, 0, 0, 1, 1]
+
+
+def test_truncation_consumes_whole_slices_first():
+    devs = two_slices()                     # 2 slices x 4 chips, need 4
+    grid = arrange_devices(devs, (2, 2))
+    assert {d.slice_index for d in grid.ravel()} == {0}
