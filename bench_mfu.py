@@ -57,6 +57,13 @@ def run_mfu():
     from nos_tpu.models import transformer as tr
 
     faulty_fence = os.environ.get("NOS_TPU_BENCH_FAULT") == "noop_sync"
+    # pin the attention kernel to the hardware-proven one unless the
+    # caller (bench_sweep/bench_attn) overrides: the splash default in
+    # ops/attention.py is faster by design but each kernel+block config
+    # must prove it compiles on the real toolchain before the round
+    # artifact may depend on it (a Mosaic hang here would replace the
+    # MFU number with a watchdog timeout)
+    os.environ.setdefault("NOS_TPU_ATTN_IMPL", "flash")
     # sweep knobs (bench_sweep.py): published config is the bench.py default
     batch = int(os.environ.get("NOS_TPU_BENCH_BATCH", BATCH))
     model = dict(MODEL)

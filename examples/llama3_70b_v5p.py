@@ -35,7 +35,11 @@ GENERATION = "v5p"
 NAMESPACE = "llm-training"
 GANG_NAME = "llama3-70b"
 
-# Llama-3-70B architecture (public numbers; GQA with 8 kv heads)
+# Llama-3-70B architecture (public numbers; GQA with 8 kv heads).
+# except_mlp remat + a chunked loss head (docs/workload-plane/
+# performance-tuning.md): near-dots throughput at a fraction of its
+# activation HBM, and the fp32 [B, S, 128k-vocab] logits never
+# materialize at once.
 LLAMA3_70B = TransformerConfig(
     vocab=128256,
     d_model=8192,
@@ -44,6 +48,8 @@ LLAMA3_70B = TransformerConfig(
     n_kv_heads=8,
     d_ff=28672,
     max_seq=8192,
+    remat_policy="except_mlp",
+    loss_chunk=1024,
 )
 
 # 512 chips: zero-style param sharding over 64, tensor parallel 4 within a

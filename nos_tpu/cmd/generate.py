@@ -1,0 +1,159 @@
+"""nos-tpu-generate — decode from a trained checkpoint.
+
+The inference counterpart of the trainer binary: loads the params saved
+by ``nos-tpu-trainer`` (orbax, params-only restore), optionally
+quantizes the matmul weights to int8 (models/quant.py — decode is
+HBM-bandwidth-bound on weight reads), and runs KV-cache generation
+(models/generate.py). Prompts are token-id lists (tokenization is the
+serving stack's concern, not the framework's); output is one JSON line
+per prompt batch.
+
+Usage:
+    nos-tpu-generate --config model.yaml --checkpoint-dir /ckpt \\
+        --prompt 1,5,20 --max-new-tokens 64 --temperature 0.8 --int8
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import logging
+from dataclasses import dataclass, fields
+from typing import Optional, Sequence
+
+logger = logging.getLogger("nos_tpu.generate")
+
+
+@dataclass
+class GenerateConfig:
+    # model (must match the checkpoint's training config)
+    vocab: int = 32000
+    d_model: int = 512
+    n_layers: int = 4
+    n_heads: int = 8
+    n_kv_heads: int = 0
+    d_ff: int = 1408
+    max_seq: int = 512
+    n_experts: int = 0
+    bf16: bool = True
+    # decode
+    checkpoint_dir: str = ""
+    max_new_tokens: int = 32
+    temperature: float = 0.0
+    int8: bool = False
+    seed: int = 0
+    log_level: str = "info"
+
+    @classmethod
+    def from_yaml_file(cls, path: str) -> "GenerateConfig":
+        import yaml
+
+        with open(path) as f:
+            data = yaml.safe_load(f) or {}
+        known = {f.name for f in fields(cls)}
+        unknown = set(data) - known
+        if unknown:
+            raise ValueError(
+                f"{path}: unknown generate config keys {sorted(unknown)}")
+        return cls(**data)
+
+
+def load_params(cfg: GenerateConfig):
+    """Init-or-restore: the checkpoint overrides fresh init when present."""
+    import jax
+    import jax.numpy as jnp
+
+    from nos_tpu.models import transformer as tfm
+
+    model_cfg = tfm.TransformerConfig(
+        vocab=cfg.vocab, d_model=cfg.d_model, n_layers=cfg.n_layers,
+        n_heads=cfg.n_heads, n_kv_heads=cfg.n_kv_heads, d_ff=cfg.d_ff,
+        max_seq=cfg.max_seq, n_experts=cfg.n_experts,
+        dtype=jnp.bfloat16 if cfg.bf16 else jnp.float32,
+    )
+    if cfg.checkpoint_dir:
+        from nos_tpu.train import CheckpointManager
+
+        # shape-only template: never materialize (or pay init compute
+        # for) weights the restore immediately replaces
+        template = jax.eval_shape(
+            lambda: tfm.init_params(jax.random.PRNGKey(0), model_cfg))
+        ckpt = CheckpointManager(cfg.checkpoint_dir)
+        step = ckpt.latest()
+        params = ckpt.restore_params(step, params_template=template)
+        ckpt.close()
+        logger.info("restored params from step %s", step)
+    else:
+        params = tfm.init_params(jax.random.PRNGKey(cfg.seed), model_cfg)
+    if cfg.int8:
+        from nos_tpu.models.quant import quantize_params
+
+        params = quantize_params(params)
+        logger.info("quantized matmul weights to int8")
+    return model_cfg, params
+
+
+def run(cfg: GenerateConfig, prompts: Sequence[Sequence[int]]):
+    """Generate continuations for prompt token lists (equal lengths make
+    one batch; ragged prompts run one batch each). Returns the full
+    token sequences as lists."""
+    import jax
+    import jax.numpy as jnp
+
+    from nos_tpu.models.generate import generate
+
+    if any(len(p) == 0 for p in prompts):
+        raise ValueError("empty prompt: every prompt needs >= 1 token id")
+    model_cfg, params = load_params(cfg)
+    rng = (jax.random.PRNGKey(cfg.seed + 1)
+           if cfg.temperature > 0 else None)
+
+    by_len: dict = {}
+    for i, p in enumerate(prompts):
+        by_len.setdefault(len(p), []).append((i, list(p)))
+
+    results: list = [None] * len(prompts)
+    for gi, (_, group) in enumerate(sorted(by_len.items())):
+        idxs = [i for i, _ in group]
+        batch = jnp.asarray([p for _, p in group], jnp.int32)
+        # independent sampling noise per length-group
+        grng = jax.random.fold_in(rng, gi) if rng is not None else None
+        out = generate(params, model_cfg, batch, cfg.max_new_tokens,
+                       temperature=cfg.temperature, rng=grng)
+        for row, i in enumerate(idxs):
+            results[i] = [int(t) for t in out[row]]
+    return results
+
+
+def main(argv: Optional[Sequence[str]] = None) -> None:
+    parser = argparse.ArgumentParser(prog="nos-tpu-generate",
+                                     description=__doc__)
+    parser.add_argument("--config", default="", help="model config YAML")
+    parser.add_argument("--checkpoint-dir", default="")
+    parser.add_argument("--prompt", action="append", default=[],
+                        help="comma-separated token ids (repeatable)")
+    parser.add_argument("--max-new-tokens", type=int, default=None)
+    parser.add_argument("--temperature", type=float, default=None)
+    parser.add_argument("--int8", action="store_true")
+    args = parser.parse_args(argv)
+
+    cfg = GenerateConfig.from_yaml_file(args.config) if args.config \
+        else GenerateConfig()
+    if args.checkpoint_dir:
+        cfg.checkpoint_dir = args.checkpoint_dir
+    if args.max_new_tokens is not None:
+        cfg.max_new_tokens = args.max_new_tokens
+    if args.temperature is not None:
+        cfg.temperature = args.temperature
+    if args.int8:
+        cfg.int8 = True
+    logging.basicConfig(level=getattr(logging, cfg.log_level.upper(), 20),
+                        format="%(asctime)s %(name)s %(levelname)s %(message)s")
+
+    prompts = [[int(t) for t in p.split(",") if t.strip()]
+               for p in (args.prompt or ["0"])]
+    for seq in run(cfg, prompts):
+        print(json.dumps({"tokens": seq}))
+
+
+if __name__ == "__main__":
+    main()

@@ -59,9 +59,39 @@ class CheckpointManager:
         whose template carries no mesh sharding (e.g. optimizer step
         counters created on one device by ``opt.init``) are replicated over
         ``mesh`` when given, so the restored state is consistently placed."""
+        ocp = self._ocp
+        step, as_abstract = self._restore_setup(step, mesh)
+        restored = self.manager.restore(
+            step,
+            args=ocp.args.Composite(
+                params=ocp.args.StandardRestore(as_abstract(params_template)),
+                opt_state=ocp.args.StandardRestore(
+                    as_abstract(opt_state_template)),
+            ),
+        )
+        return restored["params"], restored["opt_state"]
+
+    def restore_params(self, step: Optional[int] = None, *,
+                       params_template: Any, mesh: Any = None):
+        """Params-only restore — what inference consumers (cmd/generate.py)
+        need; the optimizer state on disk is ignored. Same template and
+        mesh semantics as :meth:`restore`."""
+        ocp = self._ocp
+        step, as_abstract = self._restore_setup(step, mesh)
+        restored = self.manager.restore(
+            step,
+            args=ocp.args.Composite(
+                params=ocp.args.StandardRestore(
+                    as_abstract(params_template))),
+        )
+        return restored["params"]
+
+    def _restore_setup(self, step: Optional[int], mesh: Any):
+        """Shared restore plumbing: resolve the step and build the
+        template->abstract converter (NamedSharding leaves kept, others
+        replicated over ``mesh`` when given)."""
         from jax.sharding import NamedSharding, PartitionSpec
 
-        ocp = self._ocp
         if step is None:
             step = self.latest()
         if step is None:
@@ -85,15 +115,7 @@ class CheckpointManager:
                 tree,
             )
 
-        restored = self.manager.restore(
-            step,
-            args=ocp.args.Composite(
-                params=ocp.args.StandardRestore(as_abstract(params_template)),
-                opt_state=ocp.args.StandardRestore(
-                    as_abstract(opt_state_template)),
-            ),
-        )
-        return restored["params"], restored["opt_state"]
+        return step, as_abstract
 
     def close(self) -> None:
         self.manager.close()

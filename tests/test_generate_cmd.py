@@ -1,0 +1,50 @@
+"""The generation binary (cmd/generate.py): checkpoint restore -> decode,
+int8 path, ragged prompt batching."""
+import jax
+import pytest
+
+from nos_tpu.cmd.generate import GenerateConfig, run
+from nos_tpu.cmd.trainer import TrainerConfig, train
+
+pytestmark = pytest.mark.skipif(
+    len(jax.devices()) < 8, reason="needs 8 virtual devices")
+
+MODEL = dict(vocab=64, d_model=32, n_layers=2, n_heads=4, d_ff=64,
+             max_seq=32, bf16=False)
+
+
+def test_generates_from_trained_checkpoint(tmp_path):
+    ck = str(tmp_path / "ckpt")
+    train(TrainerConfig(**MODEL, steps=2, batch_size=4, seq_len=16,
+                        checkpoint_dir=ck, checkpoint_every=2))
+    cfg = GenerateConfig(**MODEL, checkpoint_dir=ck, max_new_tokens=5)
+    out = run(cfg, [[1, 2, 3]])
+    assert len(out) == 1 and len(out[0]) == 8
+    assert out[0][:3] == [1, 2, 3]
+    assert all(0 <= t < 64 for t in out[0])
+
+
+def test_int8_and_ragged_prompts(tmp_path):
+    cfg = GenerateConfig(**MODEL, int8=True, max_new_tokens=4)
+    out = run(cfg, [[1, 2], [3, 4, 5], [6, 7]])
+    assert [len(s) for s in out] == [6, 7, 6]
+    assert out[0][:2] == [1, 2] and out[1][:3] == [3, 4, 5]
+
+
+def test_deterministic_greedy_across_calls():
+    cfg = GenerateConfig(**MODEL, max_new_tokens=6)
+    a = run(cfg, [[9, 9]])
+    b = run(cfg, [[9, 9]])
+    assert a == b
+
+
+def test_unknown_config_key_rejected(tmp_path):
+    p = tmp_path / "bad.yaml"
+    p.write_text("vocab: 64\nnot_a_key: 1\n")
+    with pytest.raises(ValueError, match="not_a_key"):
+        GenerateConfig.from_yaml_file(str(p))
+
+
+def test_empty_prompt_rejected():
+    with pytest.raises(ValueError, match="empty prompt"):
+        run(GenerateConfig(**MODEL), [[1, 2], []])
