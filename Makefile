@@ -13,6 +13,18 @@ test:  ## Run the unit + integration suite (virtual 8-device CPU mesh for JAX te
 bench:  ## Run the headline benchmark (prints one JSON line).
 	$(PYTHON) bench.py
 
+.PHONY: bench-sweep
+bench-sweep:  ## Sweep remat policy x batch x loss-chunk for the MFU config.
+	$(PYTHON) bench_sweep.py
+
+.PHONY: bench-attn
+bench-attn:  ## Compare attention kernels (splash/flash/xla) at the flagship shape.
+	$(PYTHON) bench_attn.py
+
+.PHONY: bench-decode
+bench-decode:  ## KV-cache decode throughput, bf16 and int8.
+	$(PYTHON) bench_decode.py
+
 .PHONY: native
 native:  ## Build the tpuagent C++ device layer.
 	$(MAKE) -C native/tpuagent
