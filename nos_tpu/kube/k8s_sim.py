@@ -39,6 +39,7 @@ import json
 import re
 import threading
 import time
+import urllib.parse
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Dict, List, Optional, Tuple
 
@@ -177,8 +178,11 @@ class K8sSim:
         if parts["core_version"]:
             parts["group"] = ""
         params = dict(
-            kv.split("=", 1) if "=" in kv else (kv, "")
-            for kv in q.split("&") if kv
+            (urllib.parse.unquote(k), urllib.parse.unquote(v))
+            for k, v in (
+                kv.split("=", 1) if "=" in kv else (kv, "")
+                for kv in q.split("&") if kv
+            )
         )
         return parts, params
 
@@ -192,10 +196,9 @@ class K8sSim:
 
     @staticmethod
     def _label_match(obj: dict, selector: str) -> bool:
+        # _parse already percent-decoded every query param
         labels = (obj.get("metadata") or {}).get("labels") or {}
-        import urllib.parse as up
-
-        for clause in up.unquote(selector).split(","):
+        for clause in selector.split(","):
             if not clause:
                 continue
             if "=" in clause:
@@ -203,6 +206,30 @@ class K8sSim:
                 if labels.get(k) != v:
                     return False
             elif clause not in labels:
+                return False
+        return True
+
+    @staticmethod
+    def _field_match(obj: dict, selector: str) -> bool:
+        """Server-side fieldSelector, the subset a real apiserver supports
+        for pods (spec.nodeName, status.phase, metadata.name/namespace).
+        Unknown fields are rejected like kube's "field label not
+        supported" — surfaced as no match so the bug is visible."""
+        for clause in selector.split(","):
+            if not clause:
+                continue
+            # the three operator forms real kube accepts: =, ==, !=
+            if "!=" in clause:
+                k, _, v = clause.partition("!=")
+                negate = True
+            else:
+                k, _, v = clause.partition("=")
+                v = v[1:] if v.startswith("=") else v    # '==' form
+                negate = False
+            cur: object = obj
+            for part in k.split("."):
+                cur = cur.get(part, None) if isinstance(cur, dict) else None
+            if ((cur or "") == v) == negate:
                 return False
         return True
 
@@ -225,12 +252,14 @@ class K8sSim:
                 h._ok(copy.deepcopy(obj))
                 return
             sel = params.get("labelSelector", "")
+            fsel = params.get("fieldSelector", "")
             items = [
                 copy.deepcopy(o)
                 for (g, r, ns, _), o in sorted(self.store.objects.items())
                 if g == (parts["group"] or "") and r == parts["resource"]
                 and (not parts["namespace"] or ns == parts["namespace"])
                 and (not sel or self._label_match(o, sel))
+                and (not fsel or self._field_match(o, fsel))
             ]
             latest = str(max(
                 [int(o["metadata"]["resourceVersion"]) for o in items],

@@ -323,6 +323,12 @@ class K8sApiServer:
         if label_selector:
             params["labelSelector"] = ",".join(
                 f"{k}={v}" for k, v in sorted(label_selector.items()))
+        server_side = (index is not None
+                       and (kind, index[0]) in _SERVER_FIELD_SELECTORS)
+        if server_side:
+            # a real apiserver filters these itself — don't fetch the
+            # whole collection just to drop most of it client-side
+            params["fieldSelector"] = f"{index[0]}={index[1]}"
         if params:
             path += "?" + urllib.parse.urlencode(params)
         data = self._request_json("GET", path)
@@ -330,8 +336,8 @@ class K8sApiServer:
         for item in data.get("items", []):
             item.setdefault("kind", kind)
             items.append(kc.from_k8s(item))
-        if index is not None:
-            # field indexes are a client-side convenience against real k8s
+        if index is not None and not server_side:
+            # other indexes stay a client-side convenience against real k8s
             key, value = index
             items = [o for o in items if _index_value(o, key) == value]
         return items
@@ -475,6 +481,15 @@ class K8sApiServer:
                         pass
                     applied.append(doc["metadata"]["name"])
         return applied
+
+
+# field selectors a real kube-apiserver evaluates server-side for the
+# kind (the documented supported pod field labels); K8sSim honors the
+# same set (k8s_sim._field_match)
+_SERVER_FIELD_SELECTORS = {
+    ("Pod", "spec.nodeName"),
+    ("Pod", "status.phase"),
+}
 
 
 def _index_value(obj, key: str) -> Optional[str]:

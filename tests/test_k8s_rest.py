@@ -269,3 +269,32 @@ def test_bind_patch_applies_status_over_the_wire(sim, api):
     assert got.status.nominated_node_name == ""
     assert any(c.type == "PodScheduled" and c.status == "True"
                for c in got.status.conditions)
+
+
+def test_field_selector_filters_server_side(sim, api):
+    """Pod spec.nodeName / status.phase indexes ride the wire as
+    fieldSelector (the selectors a real apiserver evaluates itself) —
+    verified by hitting the raw HTTP endpoint AND through the adapter."""
+    for i, node in enumerate(("node-a", "node-b", "")):
+        p = k8s_pod(f"fs-{i}")
+        if node:
+            p["spec"]["nodeName"] = node
+        raw(sim, "POST", "/api/v1/namespaces/team-a/pods", p)
+
+    got = raw(sim, "GET",
+              "/api/v1/namespaces/team-a/pods?fieldSelector=spec.nodeName%3Dnode-a")
+    assert [o["metadata"]["name"] for o in got["items"]] == ["fs-0"]
+
+    via_adapter = api.list("Pod", "team-a", index=("spec.nodeName", "node-b"))
+    assert [p.metadata.name for p in via_adapter] == ["fs-1"]
+
+    pending = api.list("Pod", "team-a", index=("status.phase", "Pending"))
+    assert {p.metadata.name for p in pending} == {"fs-0", "fs-1", "fs-2"}
+
+    # the other operator forms a real apiserver accepts: == and !=
+    eq = raw(sim, "GET", "/api/v1/namespaces/team-a/pods"
+             "?fieldSelector=spec.nodeName%3D%3Dnode-a")
+    assert [o["metadata"]["name"] for o in eq["items"]] == ["fs-0"]
+    ne = raw(sim, "GET", "/api/v1/namespaces/team-a/pods"
+             "?fieldSelector=spec.nodeName%21%3Dnode-a")
+    assert [o["metadata"]["name"] for o in ne["items"]] == ["fs-1", "fs-2"]
