@@ -307,8 +307,17 @@ def train(cfg: TrainerConfig) -> float:
                 logger.info("step %d eval loss %.4f (%d batches)",
                             step + 1, mean, cfg.eval_steps)
             if ckpt is not None and (step + 1) % cfg.checkpoint_every == 0:
-                ckpt.save(step + 1, params, opt_state)
+                # async: serialization overlaps the next steps' compute
+                # (params are immutable arrays — the snapshot is safe);
+                # close() at exit fences the last in-flight save
+                ckpt.save(step + 1, params, opt_state, wait=False)
                 last_saved = step + 1
+        # success path: final save only when steps actually ran (a restart
+        # whose restored step already meets cfg.steps must not relabel old
+        # state); the finally below fences + closes
+        if ckpt is not None and start_step < cfg.steps \
+                and last_saved != cfg.steps:
+            ckpt.save(cfg.steps, params, opt_state)
     finally:
         # release the prefetch producer (and the device batches it holds)
         # immediately on every exit path, not at GC time — an OOM retry
@@ -324,12 +333,11 @@ def train(cfg: TrainerConfig) -> float:
                 pass
             jax.profiler.stop_trace()
             logger.info("profiler trace written to %s", cfg.profile_dir)
-    if ckpt is not None:
-        # final save only when steps actually ran (a restart whose restored
-        # step already meets cfg.steps must not relabel old state)
-        if start_step < cfg.steps and last_saved != cfg.steps:
-            ckpt.save(cfg.steps, params, opt_state)
-        ckpt.close()
+        # fence any in-flight async save on EVERY exit path — an
+        # exception retry must not race a background writer over the
+        # checkpoint directory
+        if ckpt is not None:
+            ckpt.close()
     return loss
 
 

@@ -209,16 +209,21 @@ class K8sSim:
                 return False
         return True
 
-    @staticmethod
-    def _field_match(obj: dict, selector: str) -> bool:
-        """Server-side fieldSelector, the subset a real apiserver supports
-        for pods (spec.nodeName, status.phase, metadata.name/namespace).
-        Unknown fields are rejected like kube's "field label not
-        supported" — surfaced as no match so the bug is visible."""
+    # the field labels a real apiserver supports for pod selectors; any
+    # other field draws the same 400 real kube answers with
+    _FIELD_LABELS = {"spec.nodeName", "status.phase",
+                     "metadata.name", "metadata.namespace"}
+
+    @classmethod
+    def _field_clauses(cls, selector: str):
+        """Parse a fieldSelector into (key, value, negate) clauses,
+        accepting the three operator forms real kube does (=, ==, !=).
+        Raises ValueError for an unsupported field label — the caller
+        turns it into kube's 400 "field label not supported"."""
+        out = []
         for clause in selector.split(","):
             if not clause:
                 continue
-            # the three operator forms real kube accepts: =, ==, !=
             if "!=" in clause:
                 k, _, v = clause.partition("!=")
                 negate = True
@@ -226,9 +231,21 @@ class K8sSim:
                 k, _, v = clause.partition("=")
                 v = v[1:] if v.startswith("=") else v    # '==' form
                 negate = False
+            if k not in cls._FIELD_LABELS:
+                raise ValueError(f'field label not supported: "{k}"')
+            out.append((k, v, negate))
+        return out
+
+    @staticmethod
+    def _field_match(obj: dict, clauses) -> bool:
+        for k, v, negate in clauses:
             cur: object = obj
             for part in k.split("."):
                 cur = cur.get(part, None) if isinstance(cur, dict) else None
+            if k == "status.phase" and not cur:
+                # kube defaults pod phase; the adapter codec does too
+                # (k8s_codec from_k8s) — the wire must agree with both
+                cur = "Pending"
             if ((cur or "") == v) == negate:
                 return False
         return True
@@ -252,14 +269,19 @@ class K8sSim:
                 h._ok(copy.deepcopy(obj))
                 return
             sel = params.get("labelSelector", "")
-            fsel = params.get("fieldSelector", "")
+            try:
+                fclauses = self._field_clauses(
+                    params.get("fieldSelector", ""))
+            except ValueError as e:
+                h._deny(400, "BadRequest", str(e))
+                return
             items = [
                 copy.deepcopy(o)
                 for (g, r, ns, _), o in sorted(self.store.objects.items())
                 if g == (parts["group"] or "") and r == parts["resource"]
                 and (not parts["namespace"] or ns == parts["namespace"])
                 and (not sel or self._label_match(o, sel))
-                and (not fsel or self._field_match(o, fsel))
+                and (not fclauses or self._field_match(o, fclauses))
             ]
             latest = str(max(
                 [int(o["metadata"]["resourceVersion"]) for o in items],

@@ -36,7 +36,14 @@ class CheckpointManager:
         )
 
     # ------------------------------------------------------------------
-    def save(self, step: int, params: Any, opt_state: Any) -> None:
+    def save(self, step: int, params: Any, opt_state: Any,
+             wait: bool = True) -> None:
+        """Persist train state at ``step``. With ``wait=False`` the
+        serialization runs in orbax's background thread and the train
+        loop keeps stepping — the async-checkpoint norm; a crash before
+        the background commit finishes simply resumes from the previous
+        step (orbax commits atomically). ``wait_until_finished`` /
+        ``close`` fence the in-flight save."""
         ocp = self._ocp
         self.manager.save(
             step,
@@ -45,6 +52,10 @@ class CheckpointManager:
                 opt_state=ocp.args.StandardSave(opt_state),
             ),
         )
+        if wait:
+            self.manager.wait_until_finished()
+
+    def wait_until_finished(self) -> None:
         self.manager.wait_until_finished()
 
     def latest(self) -> Optional[int]:
@@ -118,4 +129,5 @@ class CheckpointManager:
         return step, as_abstract
 
     def close(self) -> None:
+        self.manager.wait_until_finished()   # fence any async save
         self.manager.close()

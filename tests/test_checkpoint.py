@@ -80,3 +80,30 @@ def test_restore_empty_dir_raises(tmp_path):
     with pytest.raises(FileNotFoundError):
         mgr.restore(params_template={}, opt_state_template={})
     mgr.close()
+
+
+def test_async_save_completes_by_close(tmp_path):
+    """wait=False returns while orbax serializes in the background;
+    close() fences, after which a fresh manager sees the step."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from nos_tpu.models import transformer as tfm
+    from nos_tpu.train import CheckpointManager
+
+    cfg = tfm.TransformerConfig(vocab=32, d_model=16, n_layers=1, n_heads=2,
+                                d_ff=32, max_seq=16, dtype=jnp.float32)
+    params = tfm.init_params(jax.random.PRNGKey(0), cfg)
+    opt = optax.adamw(1e-3)
+    state = opt.init(params)
+
+    m = CheckpointManager(str(tmp_path))
+    m.save(3, params, state, wait=False)
+    m.close()
+
+    m2 = CheckpointManager(str(tmp_path))
+    assert m2.latest() == 3
+    restored = m2.restore_params(params_template=params)
+    assert jnp.allclose(restored["embed"], params["embed"])
+    m2.close()

@@ -298,3 +298,18 @@ def test_field_selector_filters_server_side(sim, api):
     ne = raw(sim, "GET", "/api/v1/namespaces/team-a/pods"
              "?fieldSelector=spec.nodeName%21%3Dnode-a")
     assert [o["metadata"]["name"] for o in ne["items"]] == ["fs-1", "fs-2"]
+
+    # unsupported field labels draw kube's 400, not a silent wrong answer
+    import urllib.error
+    with pytest.raises(urllib.error.HTTPError) as exc:
+        raw(sim, "GET", "/api/v1/namespaces/team-a/pods"
+            "?fieldSelector=status.hostIP%3D10.0.0.1")
+    assert exc.value.code == 400
+
+    # a pod stored without a status block still counts as Pending (kube
+    # defaults the phase; the adapter codec does too)
+    bare = k8s_pod("fs-bare")
+    del bare["status"]
+    raw(sim, "POST", "/api/v1/namespaces/team-a/pods", bare)
+    pending2 = api.list("Pod", "team-a", index=("status.phase", "Pending"))
+    assert "fs-bare" in {p.metadata.name for p in pending2}
