@@ -1,0 +1,151 @@
+"""Long-context showcase: a 1M-token context on a v5e-256 slice via ring
+attention (sequence parallelism), gang-scheduled by the same contract as
+the Llama-3-70B example.
+
+Why this shape: at 1M tokens even the ACTIVATIONS of one layer dwarf a
+chip (bf16 [1, 1M, 4096] is 8 GB per tensor), and the fp32 attention
+scores would be 128 TB if materialized (32 heads x 1M^2). Ring attention
+(nos_tpu/ops/
+ring_attention.py) never materializes the [S, S] block — each of the
+``sp`` devices holds S/sp of the sequence, K/V blocks rotate over ICI
+with ``ppermute``, and flash-style online-softmax statistics accumulate
+locally — so context length scales linearly with the ring size while
+per-chip memory stays constant. That is what makes sp the right axis for
+context (and why pp, which shards depth, cannot substitute).
+
+The scheduling half is identical to the 70B example: the layout's chip
+count maps to a slice topology (``ParallelLayout.required_topology``),
+and the gang scheduler places one pod per host on a contiguous ICI
+sub-cuboid. Long context changes WHICH axes the layout turns on, not the
+scheduling contract — exactly the separation SURVEY §5 ("long-context /
+sequence parallelism") prescribes.
+
+Run ``python examples/long_context_1m_v5e.py`` for the plan (no TPU
+needed); the worked numbers are asserted in tests/test_example_longctx.py.
+"""
+from __future__ import annotations
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+from nos_tpu import constants                                  # noqa: E402
+from nos_tpu.models.transformer import TransformerConfig       # noqa: E402
+from nos_tpu.parallel.layout import ParallelLayout             # noqa: E402
+from nos_tpu.tpu import topology                               # noqa: E402
+
+GENERATION = "v5e"
+NAMESPACE = "long-context"
+GANG_NAME = "ctx-1m"
+
+SEQ_LEN = 1 << 20            # 1,048,576 tokens
+
+# A 7B-class GQA decoder: big enough that the context, not the params,
+# is the problem being demonstrated.
+MODEL = TransformerConfig(
+    vocab=128256,
+    d_model=4096,
+    n_layers=32,
+    n_heads=32,
+    n_kv_heads=8,
+    d_ff=14336,
+    max_seq=SEQ_LEN,
+    remat_policy="minimal",   # long context: activations are the enemy
+    loss_chunk=2048,          # never materialize [B, 1M, 128k] logits
+)
+
+# 256 chips: ring of 64 over the sequence, fsdp 4 for the params.
+# sp=64 leaves 16k tokens per chip — the ring hop overlaps with block
+# compute on ICI, and GQA circulates only the 8 kv heads.
+LAYOUT = ParallelLayout(fsdp=4, sp=64)
+
+
+def activation_gb_per_chip(cfg: TransformerConfig, layout: ParallelLayout,
+                           batch: int = 1) -> float:
+    """Residual-stream bf16 activations per chip per layer boundary under
+    sp sharding (the quantity ring attention keeps constant as S grows)."""
+    local_tokens = cfg.max_seq // layout.sp
+    return batch * local_tokens * cfg.d_model * 2 / 1024**3
+
+
+def scores_tb_if_materialized(batch: int = 1) -> float:
+    """What full [S, S] fp32 attention scores would cost — the number
+    that rules out anything but an online-softmax scheme."""
+    return batch * MODEL.n_heads * SEQ_LEN * SEQ_LEN * 4 / 1024**4
+
+
+def plan() -> dict:
+    gen = topology.get_generation(GENERATION)
+    topo = LAYOUT.required_topology(GENERATION)
+    if topo is None:
+        raise ValueError(f"no {GENERATION} topology fits {LAYOUT.chips} chips")
+    return {
+        "seq_len": SEQ_LEN,
+        "chips": LAYOUT.chips,
+        "topology": topo.name,
+        "hosts": gen.hosts_for(topo),
+        "chips_per_host": gen.chips_per_host,
+        "tokens_per_chip": SEQ_LEN // LAYOUT.sp,
+        "activation_gb_per_chip_per_layer": round(
+            activation_gb_per_chip(MODEL, LAYOUT), 3),
+        "scores_tb_if_materialized": round(scores_tb_if_materialized(), 1),
+        "kv_ring_bytes_per_hop": 2 * MODEL.kv_dim * (SEQ_LEN // LAYOUT.sp) * 2,
+    }
+
+
+def worker_pods() -> list:
+    """One pod per v5e host — same gang contract as the 70B example."""
+    p = plan()
+    pods = []
+    for w in range(p["hosts"]):
+        pods.append({
+            "apiVersion": "v1",
+            "kind": "Pod",
+            "metadata": {
+                "name": f"{GANG_NAME}-worker-{w}",
+                "namespace": NAMESPACE,
+                "labels": {
+                    constants.LABEL_GANG_NAME: GANG_NAME,
+                    constants.LABEL_GANG_SIZE: str(p["hosts"]),
+                    constants.LABEL_GANG_WORKER: str(w),
+                },
+                "annotations": {
+                    constants.ANNOTATION_TPU_TOPOLOGY: p["topology"],
+                },
+            },
+            "spec": {
+                "schedulerName": constants.SCHEDULER_NAME,
+                "nodeSelector": {
+                    constants.LABEL_TPU_ACCELERATOR: topology.get_generation(
+                        GENERATION).name,
+                },
+                "containers": [{
+                    "name": "train",
+                    "image": "nos-tpu/trainer:latest",
+                    "command": ["python", "-m", "nos_tpu.cmd", "trainer",
+                                "--config", "/etc/nos-tpu/trainer.yaml"],
+                    "env": [
+                        {"name": "COORDINATOR_ADDRESS",
+                         "value": f"{GANG_NAME}-worker-0.{NAMESPACE}:8476"},
+                        {"name": "NUM_PROCESSES", "value": str(p["hosts"])},
+                        {"name": "PROCESS_ID", "value": str(w)},
+                    ],
+                    "resources": {
+                        "limits": {constants.RESOURCE_TPU: p["chips_per_host"]},
+                        "requests": {constants.RESOURCE_TPU: p["chips_per_host"]},
+                    },
+                }],
+            },
+        })
+    return pods
+
+
+def main() -> None:
+    import json
+
+    print(json.dumps(plan(), indent=2))
+
+
+if __name__ == "__main__":
+    main()

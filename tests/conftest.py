@@ -91,3 +91,54 @@ class _Cluster:
 @pytest.fixture
 def make_cluster():
     return _Cluster
+
+
+def example_pod_from_manifest(m):
+    """Shared by the example tests (llama70b, long-context): raw k8s pod
+    manifest (examples/*.worker_pods()) -> typed Pod."""
+    from nos_tpu.kube.objects import (
+        Container, ObjectMeta, Pod, PodSpec, PodStatus,
+    )
+
+    limits = m["spec"]["containers"][0]["resources"]["limits"]
+    return Pod(
+        metadata=ObjectMeta(
+            name=m["metadata"]["name"],
+            namespace=m["metadata"]["namespace"],
+            labels=dict(m["metadata"]["labels"]),
+            annotations=dict(m["metadata"]["annotations"]),
+        ),
+        spec=PodSpec(
+            containers=[Container(requests=dict(limits))],
+            scheduler_name=m["spec"]["schedulerName"],
+            node_selector=dict(m["spec"]["nodeSelector"]),
+        ),
+        status=PodStatus(phase="Pending"),
+    )
+
+
+def example_pool(pool, hosts, accelerator, topo, chips_per_host):
+    """A homogeneous ICI-domain node pool for the example gang tests."""
+    from nos_tpu import constants
+    from nos_tpu.kube.objects import Node, NodeStatus, ObjectMeta
+
+    return [
+        Node(
+            metadata=ObjectMeta(
+                name=f"{pool}-{i:03d}",
+                labels={
+                    constants.LABEL_NODEPOOL: pool,
+                    constants.LABEL_TPU_ACCELERATOR: accelerator,
+                    constants.LABEL_TPU_TOPOLOGY: topo,
+                    constants.LABEL_PARTITIONING: "topology",
+                },
+            ),
+            status=NodeStatus(
+                capacity={constants.RESOURCE_TPU: chips_per_host,
+                          "cpu": 100},
+                allocatable={constants.RESOURCE_TPU: chips_per_host,
+                             "cpu": 100},
+            ),
+        )
+        for i in range(hosts)
+    ]

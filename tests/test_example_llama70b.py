@@ -6,18 +6,10 @@ import os
 
 import pytest
 
-from nos_tpu import constants
-from nos_tpu.kube.objects import (
-    Container,
-    Node,
-    NodeStatus,
-    ObjectMeta,
-    Pod,
-    PodSpec,
-    PodStatus,
-)
 from nos_tpu.scheduler import framework as fw
 from nos_tpu.scheduler.gang import GangScheduler
+
+from conftest import example_pod_from_manifest, example_pool
 
 
 def load_example():
@@ -41,43 +33,12 @@ def test_plan_numbers():
     assert p["fits"] is True
 
 
-def pod_from_manifest(m) -> Pod:
-    limits = m["spec"]["containers"][0]["resources"]["limits"]
-    return Pod(
-        metadata=ObjectMeta(
-            name=m["metadata"]["name"],
-            namespace=m["metadata"]["namespace"],
-            labels=dict(m["metadata"]["labels"]),
-            annotations=dict(m["metadata"]["annotations"]),
-        ),
-        spec=PodSpec(
-            containers=[Container(requests=dict(limits))],
-            scheduler_name=m["spec"]["schedulerName"],
-            node_selector=dict(m["spec"]["nodeSelector"]),
-        ),
-        status=PodStatus(phase="Pending"),
-    )
+def pod_from_manifest(m):
+    return example_pod_from_manifest(m)
 
 
 def v5p_pool(pool: str, hosts: int):
-    nodes = []
-    for i in range(hosts):
-        nodes.append(Node(
-            metadata=ObjectMeta(
-                name=f"{pool}-{i:03d}",
-                labels={
-                    constants.LABEL_NODEPOOL: pool,
-                    constants.LABEL_TPU_ACCELERATOR: "tpu-v5p-slice",
-                    constants.LABEL_TPU_TOPOLOGY: "8x8x8",
-                    constants.LABEL_PARTITIONING: "topology",
-                },
-            ),
-            status=NodeStatus(
-                capacity={constants.RESOURCE_TPU: 4, "cpu": 100},
-                allocatable={constants.RESOURCE_TPU: 4, "cpu": 100},
-            ),
-        ))
-    return nodes
+    return example_pool(pool, hosts, "tpu-v5p-slice", "8x8x8", 4)
 
 
 def test_gang_admitted_and_placed_on_v5p_512():
