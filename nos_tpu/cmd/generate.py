@@ -39,6 +39,8 @@ class GenerateConfig:
     checkpoint_dir: str = ""
     max_new_tokens: int = 32
     temperature: float = 0.0
+    top_k: int = 0
+    top_p: float = 0.0
     int8: bool = False
     seed: int = 0
     log_level: str = "info"
@@ -118,7 +120,8 @@ def run(cfg: GenerateConfig, prompts: Sequence[Sequence[int]]):
         # independent sampling noise per length-group
         grng = jax.random.fold_in(rng, gi) if rng is not None else None
         out = generate(params, model_cfg, batch, cfg.max_new_tokens,
-                       temperature=cfg.temperature, rng=grng)
+                       temperature=cfg.temperature, top_k=cfg.top_k,
+                       top_p=cfg.top_p, rng=grng)
         for row, i in enumerate(idxs):
             results[i] = [int(t) for t in out[row]]
     return results
@@ -133,6 +136,8 @@ def main(argv: Optional[Sequence[str]] = None) -> None:
                         help="comma-separated token ids (repeatable)")
     parser.add_argument("--max-new-tokens", type=int, default=None)
     parser.add_argument("--temperature", type=float, default=None)
+    parser.add_argument("--top-k", type=int, default=None)
+    parser.add_argument("--top-p", type=float, default=None)
     parser.add_argument("--int8", action="store_true")
     args = parser.parse_args(argv)
 
@@ -144,6 +149,10 @@ def main(argv: Optional[Sequence[str]] = None) -> None:
         cfg.max_new_tokens = args.max_new_tokens
     if args.temperature is not None:
         cfg.temperature = args.temperature
+    if args.top_k is not None:
+        cfg.top_k = args.top_k
+    if args.top_p is not None:
+        cfg.top_p = args.top_p
     if args.int8:
         cfg.int8 = True
     logging.basicConfig(level=getattr(logging, cfg.log_level.upper(), 20),

@@ -131,6 +131,39 @@ def forward_with_cache(
     return logits, {"k": ks, "v": vs, "pos": pos0 + s}
 
 
+def _truncate_logits(logits: jax.Array, top_k: int, top_p: float) -> jax.Array:
+    """Mask logits outside the top-k set and/or the top-p nucleus of the
+    distribution ``softmax(logits)`` — callers pass ALREADY-TEMPERED
+    logits so the nucleus covers the distribution actually sampled from.
+    One sort serves both filters (static-shape; [B, vocab] is tiny next
+    to the decode matmuls). No-op when both are unset."""
+    neg = jnp.finfo(logits.dtype).min
+    do_k = 0 < top_k < logits.shape[-1]
+    do_p = 0.0 < top_p < 1.0
+    if not (do_k or do_p):
+        return logits
+    sorted_desc = jnp.sort(logits, axis=-1)[..., ::-1]
+    if do_k:
+        # sequential semantics (top-k first, then nucleus of what's left)
+        sorted_desc = jnp.where(
+            jnp.arange(sorted_desc.shape[-1]) < top_k, sorted_desc, neg)
+        logits = jnp.where(logits >= sorted_desc[..., top_k - 1][..., None],
+                           logits, neg)
+    if do_p:
+        probs = jax.nn.softmax(sorted_desc, axis=-1)
+        cum = jnp.cumsum(probs, axis=-1)
+        # keep every token whose PRECEDING cumulative mass is < top_p, so
+        # the nucleus always includes the first token past the threshold
+        keep = jnp.concatenate(
+            [jnp.zeros_like(cum[..., :1]), cum[..., :-1]], axis=-1) < top_p
+        # the nucleus is everything at or above the SMALLEST kept logit
+        cutoff = jnp.min(
+            jnp.where(keep, sorted_desc, jnp.finfo(logits.dtype).max),
+            axis=-1, keepdims=True)
+        logits = jnp.where(logits >= cutoff, logits, neg)
+    return logits
+
+
 def generate(
     params: Params,
     cfg: TransformerConfig,
@@ -138,12 +171,16 @@ def generate(
     max_new_tokens: int,
     *,
     temperature: float = 0.0,
+    top_k: int = 0,
+    top_p: float = 0.0,
     rng: Optional[jax.Array] = None,
     max_len: Optional[int] = None,
 ) -> jax.Array:
-    """Greedy (temperature 0) or temperature sampling. prompt [B, S] ->
-    [B, S + max_new_tokens]. One prefill pass over the prompt, then a
-    ``lax.scan`` of single-token decode steps — jit the whole call.
+    """Greedy (temperature 0) or temperature sampling, optionally
+    truncated to the ``top_k`` most likely tokens and/or the smallest
+    ``top_p``-mass nucleus. prompt [B, S] -> [B, S + max_new_tokens].
+    One prefill pass over the prompt, then a ``lax.scan`` of single-token
+    decode steps — jit the whole call.
 
     ``max_len`` bounds the cache (default cfg.max_seq); the caller must
     keep S + max_new_tokens <= max_len."""
@@ -157,14 +194,22 @@ def generate(
             f"cache length {max_len}")
     if temperature > 0 and rng is None:
         raise ValueError("temperature sampling needs an rng key")
+    if temperature <= 0 and (top_k or top_p):
+        raise ValueError(
+            "top_k/top_p only apply when sampling — set temperature > 0 "
+            "(greedy decoding ignores truncation)")
 
     cache = init_cache(cfg, b, max_len)
     logits, cache = forward_with_cache(params, cfg, prompt, cache)
 
     def pick(step_logits, key):
         if temperature > 0:
-            return jax.random.categorical(key, step_logits / temperature,
-                                          axis=-1)
+            # temperature FIRST, truncation second: the nucleus must
+            # cover the distribution actually sampled from
+            return jax.random.categorical(
+                key,
+                _truncate_logits(step_logits / temperature, top_k, top_p),
+                axis=-1)
         return jnp.argmax(step_logits, axis=-1)
 
     keys = (jax.random.split(rng, max_new_tokens) if rng is not None

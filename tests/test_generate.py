@@ -122,3 +122,57 @@ def test_generate_with_moe():
     out = jax.jit(lambda p, t: generate(p, cfg, t, 4))(params, prompt)
     assert out.shape == (2, 7)
     assert (out < cfg.vocab).all() and (out >= 0).all()
+
+
+def test_top_k_restricts_sampled_tokens():
+    import jax
+
+    from nos_tpu.models.generate import _truncate_logits
+
+    logits = jnp.asarray([[1.0, 5.0, 3.0, 4.0, 2.0]])
+    t = _truncate_logits(logits, top_k=2, top_p=0.0)
+    neg = jnp.finfo(t.dtype).min
+    np.testing.assert_array_equal(
+        np.asarray(t[0] > neg), [False, True, False, True, False])
+    # sampling can now only ever produce indices 1 or 3
+    cfg = cfg_kw()
+    params = tfm.init_params(jax.random.PRNGKey(0), cfg)
+    out = generate(params, cfg, jnp.zeros((4, 2), jnp.int32), 8,
+                   temperature=1.5, top_k=1, rng=jax.random.PRNGKey(3))
+    greedy = generate(params, cfg, jnp.zeros((4, 2), jnp.int32), 8)
+    # top_k=1 at any temperature IS greedy
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(greedy))
+
+
+def test_top_p_nucleus_keeps_smallest_covering_set():
+    from nos_tpu.models.generate import _truncate_logits
+
+    # probs ~ [0.643, 0.236, 0.087, 0.032, 0.002]
+    logits = jnp.log(jnp.asarray([[0.643, 0.236, 0.087, 0.032, 0.002]]))
+    t = _truncate_logits(logits, top_k=0, top_p=0.8)
+    neg = jnp.finfo(t.dtype).min
+    # 0.643 < 0.8, 0.643+0.236 crosses it -> nucleus = first two
+    np.testing.assert_array_equal(
+        np.asarray(t[0] > neg), [True, True, False, False, False])
+    # top_p=1.0 and 0.0 are no-ops
+    np.testing.assert_array_equal(
+        np.asarray(_truncate_logits(logits, 0, 0.0)), np.asarray(logits))
+
+
+def test_truncation_requires_sampling():
+    cfg = cfg_kw()
+    params = tfm.init_params(jax.random.PRNGKey(0), cfg)
+    with pytest.raises(ValueError, match="temperature > 0"):
+        generate(params, cfg, jnp.zeros((1, 2), jnp.int32), 3, top_p=0.9)
+
+
+def test_top_k_then_top_p_sequential_semantics():
+    from nos_tpu.models.generate import _truncate_logits
+
+    # after top_k=3, renormalized probs ~ [0.666, 0.244, 0.090]; nucleus
+    # 0.8 keeps the first two of the SURVIVORS
+    logits = jnp.log(jnp.asarray([[0.643, 0.236, 0.087, 0.032, 0.002]]))
+    t = _truncate_logits(logits, top_k=3, top_p=0.8)
+    neg = jnp.finfo(t.dtype).min
+    np.testing.assert_array_equal(
+        np.asarray(t[0] > neg), [True, True, False, False, False])
