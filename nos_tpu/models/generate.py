@@ -198,6 +198,10 @@ def generate(
         raise ValueError(
             "top_k/top_p only apply when sampling — set temperature > 0 "
             "(greedy decoding ignores truncation)")
+    if top_k < 0 or not (0.0 <= top_p <= 1.0):
+        raise ValueError(
+            f"top_k must be >= 0 and top_p in [0, 1] (a probability, "
+            f"not a percent): got top_k={top_k}, top_p={top_p}")
 
     cache = init_cache(cfg, b, max_len)
     logits, cache = forward_with_cache(params, cfg, prompt, cache)

@@ -1,0 +1,133 @@
+"""Speculative decoding: a small draft model proposes, the target model
+verifies k tokens per forward pass.
+
+Decode is HBM-bandwidth-bound — each target step re-reads every weight to
+produce ONE token. Verification flips the economics: the target runs one
+forward over k drafted tokens (same weight traffic as one decode step,
+k x the MXU work, which was idle anyway) and accepts the longest
+matching prefix, so accepted tokens cost ~1/k of a target pass each
+while the first rejected position still yields the target's own token —
+output is EXACTLY what plain greedy decoding of the target would
+produce, just cheaper when the draft is any good.
+
+Greedy-only by design: greedy acceptance (`draft token == target
+argmax`) keeps the equivalence bit-exact and testable; the
+rejection-sampling generalization for temperature > 0 is out of scope.
+
+Batched rounds advance UNIFORMLY by the minimum acceptance across rows
+(plus the verified correction token): rows that matched further simply
+re-propose those tokens next round and get the identical result — the
+single scalar ``cache['pos']`` then stays valid for every row. Rolling
+back speculation is just resetting ``pos``: entries beyond it are masked
+out of attention and overwritten by later writes
+(models/generate._cached_attention).
+"""
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from nos_tpu.models.generate import forward_with_cache, init_cache
+from nos_tpu.models.transformer import Params, TransformerConfig
+
+__all__ = ["speculative_generate"]
+
+
+@functools.lru_cache(maxsize=None)
+def _jitted_step(cfg: TransformerConfig):
+    """One compiled forward per (config, shape) across ALL calls —
+    speculative_generate is the serving hot path and must not re-trace
+    per request (TransformerConfig is a frozen dataclass, so it keys the
+    cache)."""
+    return jax.jit(
+        lambda p, t, c: forward_with_cache(p, cfg, t, c))
+
+
+def speculative_generate(
+    params: Params,
+    cfg: TransformerConfig,
+    draft_params: Params,
+    draft_cfg: TransformerConfig,
+    prompt: jax.Array,
+    max_new_tokens: int,
+    *,
+    n_draft: int = 4,
+    max_len: Optional[int] = None,
+) -> jax.Array:
+    """Greedy speculative decoding. prompt [B, S] ->
+    [B, S + max_new_tokens], bit-identical to
+    ``generate(params, cfg, prompt, max_new_tokens)``."""
+    b, s = prompt.shape
+    if max_new_tokens <= 0:
+        return prompt
+    max_len = max_len or min(cfg.max_seq, draft_cfg.max_seq)
+    # headroom: a round may write up to k speculative positions past the
+    # accepted prefix before rolling back
+    k = max(1, min(n_draft, max_new_tokens))
+    if s + max_new_tokens + k > max_len:
+        raise ValueError(
+            f"prompt ({s}) + max_new_tokens ({max_new_tokens}) + draft "
+            f"window ({k}) exceeds cache length {max_len}")
+
+    t_step = _jitted_step(cfg)
+    d_step = _jitted_step(draft_cfg)
+
+    # invariant between rounds: both caches have processed sequence[:-1],
+    # `last` [B, 1] is the newest token, not yet fed
+    t_cache = init_cache(cfg, b, max_len)
+    d_cache = init_cache(draft_cfg, b, max_len)
+    if s > 1:
+        _, t_cache = t_step(params, prompt[:, :-1], t_cache)
+        _, d_cache = d_step(draft_params, prompt[:, :-1], d_cache)
+    last = prompt[:, -1:]
+
+    pieces = []
+    produced = 0
+    while produced < max_new_tokens:
+        base = int(t_cache["pos"])
+
+        # 1. draft proposes k tokens autoregressively from `last`
+        drafts = []
+        tok = last
+        for _ in range(k):
+            logits, d_cache = d_step(draft_params, tok, d_cache)
+            tok = jnp.argmax(logits[:, -1], axis=-1)[:, None]
+            drafts.append(tok)
+        proposed = jnp.concatenate(drafts, axis=1)          # [B, k]
+
+        # 2. target verifies in ONE pass: greedy[:, i] is the target's
+        # token after feed[:, i], i.e. its verdict on proposed[:, i]
+        feed = jnp.concatenate([last, proposed[:, :-1]], axis=1)
+        logits, t_cache = t_step(params, feed, t_cache)
+        greedy = jnp.argmax(logits, axis=-1)                # [B, k]
+
+        # 3. uniform advance: min over rows of the longest matching
+        # prefix, plus the verified token at that position (for rows that
+        # matched further, proposed == greedy there, so the "correction"
+        # is their accepted token — every emitted token is target-greedy)
+        match = proposed == greedy
+        accepted = jnp.argmin(
+            jnp.concatenate([match, jnp.zeros((b, 1), bool)], axis=1),
+            axis=1)
+        min_a = int(jnp.min(accepted))
+        if min_a == k:                                      # full accept
+            new = proposed
+            last = proposed[:, -1:]
+            # caches processed exactly feed = seq[:-1]: invariant holds
+        else:
+            new = jnp.concatenate(
+                [proposed[:, :min_a], greedy[:, min_a:min_a + 1]], axis=1)
+            last = greedy[:, min_a:min_a + 1]
+            # roll speculation back to the accepted prefix: positions
+            # base..base+min_a hold [last, d1..d_min_a] — all part of the
+            # new sequence[:-1] — so processed count is base + min_a + 1
+            t_cache = {**t_cache, "pos": jnp.int32(base + min_a + 1)}
+            d_cache = {**d_cache, "pos": jnp.int32(base + min_a + 1)}
+        pieces.append(new)
+        produced += new.shape[1]
+
+    tail = jnp.concatenate(pieces, axis=1)[:, :max_new_tokens]
+    return jnp.concatenate([prompt, tail], axis=1)

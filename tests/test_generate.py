@@ -176,3 +176,15 @@ def test_top_k_then_top_p_sequential_semantics():
     neg = jnp.finfo(t.dtype).min
     np.testing.assert_array_equal(
         np.asarray(t[0] > neg), [True, True, False, False, False])
+
+
+def test_out_of_range_truncation_rejected():
+    cfg = cfg_kw()
+    params = tfm.init_params(jax.random.PRNGKey(0), cfg)
+    prompt = jnp.zeros((1, 2), jnp.int32)
+    with pytest.raises(ValueError, match="not a percent"):
+        generate(params, cfg, prompt, 3, temperature=0.8, top_p=90.0,
+                 rng=jax.random.PRNGKey(0))
+    with pytest.raises(ValueError, match="top_k"):
+        generate(params, cfg, prompt, 3, temperature=0.8, top_k=-2,
+                 rng=jax.random.PRNGKey(0))

@@ -1,0 +1,74 @@
+"""Speculative decoding (models/speculative.py): the whole point is
+bit-exact equivalence with plain greedy decoding of the target."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from nos_tpu.models import transformer as tfm
+from nos_tpu.models.generate import generate
+from nos_tpu.models.speculative import speculative_generate
+
+
+def cfg_kw(**kw):
+    base = dict(vocab=64, d_model=32, n_layers=2, n_heads=4, d_ff=64,
+                max_seq=64, dtype=jnp.float32)
+    base.update(kw)
+    return tfm.TransformerConfig(**base)
+
+
+TARGET = cfg_kw(n_kv_heads=2)
+DRAFT = cfg_kw(d_model=16, n_layers=1, n_heads=2, d_ff=32)
+
+
+@pytest.mark.parametrize("n_draft", [1, 3, 4])
+def test_bit_exact_vs_plain_greedy_bad_draft(n_draft):
+    """A draft that mostly disagrees (different random params) must not
+    change the output, only the speed."""
+    params = tfm.init_params(jax.random.PRNGKey(0), TARGET)
+    draft = tfm.init_params(jax.random.PRNGKey(9), DRAFT)
+    prompt = jax.random.randint(jax.random.PRNGKey(1), (2, 5), 0, 64)
+
+    ref = generate(params, TARGET, prompt, 12)
+    got = speculative_generate(params, TARGET, draft, DRAFT, prompt, 12,
+                               n_draft=n_draft)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(ref))
+
+
+def test_bit_exact_when_draft_is_target():
+    """Perfect draft: every round fully accepts; still exact."""
+    params = tfm.init_params(jax.random.PRNGKey(0), TARGET)
+    prompt = jax.random.randint(jax.random.PRNGKey(2), (3, 4), 0, 64)
+
+    ref = generate(params, TARGET, prompt, 10)
+    got = speculative_generate(params, TARGET, params, TARGET, prompt, 10,
+                               n_draft=4)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(ref))
+
+
+def test_batch_rows_with_uneven_acceptance_stay_exact():
+    """Rows accept unevenly (different prompts); uniform advance must
+    keep every row bit-exact."""
+    params = tfm.init_params(jax.random.PRNGKey(0), TARGET)
+    draft = tfm.init_params(jax.random.PRNGKey(3), DRAFT)
+    prompt = jnp.asarray([[1, 2, 3], [60, 61, 62], [7, 7, 7],
+                          [0, 1, 0]], jnp.int32)
+
+    ref = generate(params, TARGET, prompt, 9)
+    got = speculative_generate(params, TARGET, draft, DRAFT, prompt, 9,
+                               n_draft=3)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(ref))
+
+
+def test_cache_headroom_validated():
+    params = tfm.init_params(jax.random.PRNGKey(0), TARGET)
+    with pytest.raises(ValueError, match="draft window"):
+        speculative_generate(params, TARGET, params, TARGET,
+                             jnp.zeros((1, 50), jnp.int32), 12, n_draft=4)
+
+
+def test_zero_tokens_returns_prompt():
+    params = tfm.init_params(jax.random.PRNGKey(0), TARGET)
+    prompt = jnp.zeros((1, 3), jnp.int32)
+    out = speculative_generate(params, TARGET, params, TARGET, prompt, 0)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(prompt))
