@@ -40,9 +40,13 @@ Cache = Dict[str, jax.Array]
 
 
 def init_cache(cfg: TransformerConfig, batch: int,
-               max_len: Optional[int] = None, dtype=None) -> Cache:
+               max_len: Optional[int] = None, dtype=None,
+               per_row_pos: bool = False) -> Cache:
     """Pre-allocated KV cache: k/v [L, B, Hkv, max_len, head_dim] plus the
-    write position. bf16 by default (cfg.dtype)."""
+    write position — a scalar (all rows in lockstep: generate/
+    speculative) or, with ``per_row_pos``, a [B] vector so every row sits
+    at its own depth (continuous-batching serving slots). bf16 by default
+    (cfg.dtype)."""
     max_len = max_len or cfg.max_seq
     if max_len > cfg.max_seq:
         raise ValueError(
@@ -53,7 +57,7 @@ def init_cache(cfg: TransformerConfig, batch: int,
     return {
         "k": jnp.zeros(shape, dtype),
         "v": jnp.zeros(shape, dtype),
-        "pos": jnp.zeros((), jnp.int32),
+        "pos": jnp.zeros((batch,) if per_row_pos else (), jnp.int32),
     }
 
 
@@ -70,8 +74,11 @@ def _cached_attention(q, ck, cv, positions, scale):
         "bhgqd,bhkd->bhgqk", qg, ck, preferred_element_type=jnp.float32
     ) * scale
     t = ck.shape[2]
-    mask = jnp.arange(t)[None, :] <= positions[:, None]     # [S, T]
-    scores = jnp.where(mask[None, None, None], scores,
+    # positions: [S] (lockstep rows) or [B, S] (per-row depths)
+    mask = jnp.arange(t) <= positions[..., None]    # [S, T] or [B, S, T]
+    if mask.ndim == 2:
+        mask = mask[None]
+    scores = jnp.where(mask[:, None, None], scores,
                        jnp.finfo(jnp.float32).min)
     probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
     return jnp.einsum("bhgqk,bhkd->bhgqd", probs, cv).reshape(b, h, s, d)
@@ -82,11 +89,15 @@ def forward_with_cache(
 ) -> Tuple[jax.Array, Cache]:
     """tokens [B, S] (the next S tokens after cache['pos']) -> (logits
     [B, S, vocab], updated cache). S is the prefill chunk length or 1 for
-    single-token decode — same code, two compiled shapes."""
+    single-token decode — same code, two compiled shapes. A [B]-vector
+    ``pos`` (init_cache(per_row_pos=True)) lets every row sit at its own
+    depth — the serving-slot case."""
     b, s = tokens.shape
     pos0 = cache["pos"]
+    vector = getattr(pos0, "ndim", 0) == 1
     freqs = rope_frequencies(cfg.head_dim, cfg.max_seq, cfg.rope_theta)
-    positions = pos0 + jnp.arange(s)
+    positions = (pos0[:, None] + jnp.arange(s)[None, :] if vector
+                 else pos0 + jnp.arange(s))
     scale = cfg.head_dim ** -0.5
 
     # params may be the training pytree or its int8-quantized twin
@@ -100,10 +111,17 @@ def forward_with_cache(
         k = qdot(h, layer["wk"]).reshape(b, s, cfg.kv_heads, cfg.head_dim)
         v = qdot(h, layer["wv"]).reshape(b, s, cfg.kv_heads, cfg.head_dim)
         q, k = (apply_rope(t, freqs, positions) for t in (q, k))
-        ck = jax.lax.dynamic_update_slice(
-            ck, k.transpose(0, 2, 1, 3).astype(ck.dtype), (0, 0, pos0, 0))
-        cv = jax.lax.dynamic_update_slice(
-            cv, v.transpose(0, 2, 1, 3).astype(cv.dtype), (0, 0, pos0, 0))
+        kt = k.transpose(0, 2, 1, 3).astype(ck.dtype)
+        vt = v.transpose(0, 2, 1, 3).astype(cv.dtype)
+        if vector:
+            # per-row write offsets: one dynamic_update_slice per row
+            write = jax.vmap(
+                lambda c, u, p: jax.lax.dynamic_update_slice(c, u, (0, p, 0)))
+            ck = write(ck, kt, pos0)
+            cv = write(cv, vt, pos0)
+        else:
+            ck = jax.lax.dynamic_update_slice(ck, kt, (0, 0, pos0, 0))
+            cv = jax.lax.dynamic_update_slice(cv, vt, (0, 0, pos0, 0))
         o = _cached_attention(q.transpose(0, 2, 1, 3), ck, cv, positions,
                               scale)
         o = o.transpose(0, 2, 1, 3).reshape(b, s, cfg.d_model)

@@ -1,0 +1,211 @@
+"""Continuous-batching decode serving (slot-based, static shapes).
+
+The TPU-native serving pattern: ONE compiled decode program over a fixed
+[max_batch, 1] token window runs every step; requests occupy rows
+("slots") of a shared KV cache whose ``pos`` is a per-row vector
+(models/generate.init_cache(per_row_pos=True)), so a long request and a
+freshly-admitted short one decode in the same batch at different depths.
+A finished slot is recycled by simply resetting its pos — no
+reallocation, no shape change, no retrace. Prefill runs per request over
+a scratch cache sized to the power-of-two prompt bucket (a handful of
+compiled shapes, attention cost proportional to the request, not to
+max_len) and is installed into the shared cache by a donated jitted
+update, so admission never copies the multi-GB cache on the host.
+
+Hot-loop economics: the decode step donates the cache (updates in place,
+no second full-cache allocation per token), corrects inactive rows' pos
+in-graph, and the host syncs ONE small array per tick.
+
+This is deliberately an in-process engine, not an RPC server: the
+operator stack schedules pods; what runs inside a serving pod is this
+loop. Greedy decoding (the exactness-testable core); sampling belongs to
+the single-request ``generate`` path.
+"""
+from __future__ import annotations
+
+import functools
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from nos_tpu.models.generate import forward_with_cache, init_cache
+from nos_tpu.models.transformer import Params, TransformerConfig
+
+__all__ = ["DecodeServer"]
+
+
+def _bucket(n: int) -> int:
+    b = 8
+    while b < n:
+        b *= 2
+    return b
+
+
+@dataclass
+class _Request:
+    rid: int
+    prompt: List[int]
+    max_new_tokens: int
+    out: List[int] = field(default_factory=list)
+    slot: int = -1
+
+    @property
+    def done(self) -> bool:
+        return len(self.out) >= self.max_new_tokens
+
+
+class DecodeServer:
+    """Greedy continuous-batching engine over ``max_batch`` cache slots.
+
+    ``submit`` enqueues a request (admitted to a free slot immediately or
+    when one frees); ``step`` decodes one token for every active slot;
+    ``drain`` runs to completion and returns {request_id: full token
+    list} for the requests completed since the last drain (and clears
+    them — a long-lived serving pod must not accumulate results).
+    Output per request is bit-identical to
+    ``generate(params, cfg, prompt, max_new_tokens)``.
+    """
+
+    def __init__(self, params: Params, cfg: TransformerConfig,
+                 max_batch: int = 8, max_len: Optional[int] = None):
+        self.params = params
+        self.cfg = cfg
+        self.max_batch = max_batch
+        self.max_len = max_len or cfg.max_seq
+        self.cache = init_cache(cfg, max_batch, self.max_len,
+                                per_row_pos=True)
+        self._free = list(range(max_batch))
+        self._active: Dict[int, _Request] = {}      # slot -> request
+        self._pending: List[_Request] = []
+        self._done: Dict[int, _Request] = {}
+        self._last = jnp.zeros((max_batch, 1), jnp.int32)
+        self._next_rid = 0
+
+        def decode(p, toks, cache, keep):
+            # one fused program: forward, next-token argmax, inactive
+            # rows' pos frozen, next feed tokens — cache donated
+            pos0 = cache["pos"]
+            logits, cache = forward_with_cache(p, cfg, toks, cache)
+            cache["pos"] = jnp.where(keep, cache["pos"], pos0)
+            nxt = jnp.argmax(logits[:, -1], axis=-1)
+            new_last = jnp.where(keep[:, None], nxt[:, None], toks)
+            return nxt, new_last, cache
+
+        self._decode = jax.jit(decode, donate_argnums=(2,))
+
+        def prefill(p, toks, row_cache):
+            return forward_with_cache(p, cfg, toks, row_cache)
+
+        self._prefill = jax.jit(prefill)
+
+        def install(cache, rk, rv, slot, plen, first, last):
+            # donated shared-cache update: write the prefilled bucket
+            # rows, set the slot's pos and feed token
+            cache["k"] = jax.lax.dynamic_update_slice(
+                cache["k"], rk, (0, slot, 0, 0, 0))
+            cache["v"] = jax.lax.dynamic_update_slice(
+                cache["v"], rv, (0, slot, 0, 0, 0))
+            cache["pos"] = cache["pos"].at[slot].set(plen)
+            last = last.at[slot, 0].set(first)
+            return cache, last
+
+        self._install = jax.jit(install, donate_argnums=(0,))
+
+    # ------------------------------------------------------------------
+    def submit(self, prompt: List[int], max_new_tokens: int) -> int:
+        if not prompt:
+            raise ValueError("empty prompt")
+        if len(prompt) + max_new_tokens > self.max_len:
+            raise ValueError(
+                f"prompt ({len(prompt)}) + max_new_tokens "
+                f"({max_new_tokens}) exceeds cache length {self.max_len}")
+        rid = self._next_rid
+        self._next_rid += 1
+        self._pending.append(_Request(rid, list(prompt), max_new_tokens))
+        self._admit()
+        return rid
+
+    def _admit(self) -> None:
+        while self._pending and self._free:
+            req = self._pending.pop(0)
+            slot = self._free.pop(0)
+            req.slot = slot
+            self._active[slot] = req
+            self._prefill_slot(req)
+
+    @functools.lru_cache(maxsize=None)      # noqa: B019 — engine-lived
+    def _row_zeros(self, bucket: int):
+        shape = list(self.cache["k"].shape)
+        shape[1], shape[3] = 1, bucket
+        z = jnp.zeros(tuple(shape), self.cache["k"].dtype)
+        return z
+
+    def _prefill_slot(self, req: _Request) -> None:
+        """Prefill the prompt over a bucket-sized scratch cache (cost
+        proportional to the request), then install the rows + position
+        into the shared cache in one donated jitted update."""
+        plen = len(req.prompt)
+        bucket = min(_bucket(plen), self.max_len)
+        toks = jnp.asarray(
+            [req.prompt + [0] * (bucket - plen)], jnp.int32)
+        row = {
+            "k": self._row_zeros(bucket),
+            "v": self._row_zeros(bucket),
+            "pos": jnp.zeros((), jnp.int32),
+        }
+        logits, row = self._prefill(self.params, toks, row)
+        first = int(jnp.argmax(logits[0, plen - 1]))
+        # padding garbage past plen stays masked until overwritten: only
+        # pos decides what exists
+        self.cache, self._last = self._install(
+            self.cache, row["k"], row["v"], jnp.int32(req.slot),
+            jnp.int32(plen), jnp.int32(first), self._last)
+        req.out.append(first)
+        self._finish_if_done(req)
+
+    def _finish_if_done(self, req: _Request) -> None:
+        if req.done and req.slot >= 0:
+            s = req.slot
+            del self._active[s]
+            self.cache["pos"] = self.cache["pos"].at[s].set(0)
+            self._free.append(s)
+            req.slot = -1
+            self._done[req.rid] = req
+            self._admit()
+
+    # ------------------------------------------------------------------
+    def step(self) -> int:
+        """One decode tick for every active slot; returns the number of
+        tokens emitted. Inactive slots ride along (their output discarded,
+        their pos frozen in-graph — same compiled program every tick)."""
+        if not self._active:
+            return 0
+        active = sorted(self._active)
+        keep = jnp.zeros((self.max_batch,), bool).at[
+            jnp.asarray(active, jnp.int32)].set(True)
+        nxt, self._last, self.cache = self._decode(
+            self.params, self._last, self.cache, keep)
+        nxt_host = np.asarray(nxt)          # ONE device->host sync
+        emitted = 0
+        for s in active:
+            req = self._active[s]
+            req.out.append(int(nxt_host[s]))
+            emitted += 1
+            self._finish_if_done(req)
+        return emitted
+
+    def drain(self) -> Dict[int, List[int]]:
+        """Run until every submitted request completes; returns
+        {request_id: prompt + generated tokens} for requests finished
+        since the last drain, and forgets them."""
+        while self._active or self._pending:
+            if not self._active:       # pending but no free slot: bug
+                raise RuntimeError("pending requests with no active slots")
+            self.step()
+        out = {r.rid: r.prompt + r.out[:r.max_new_tokens]
+               for r in self._done.values()}
+        self._done.clear()
+        return out

@@ -39,7 +39,9 @@ def rope_frequencies(head_dim: int, max_len: int, theta: float = 10000.0) -> jax
 
 def apply_rope(x: jax.Array, freqs: jax.Array,
                positions: Optional[jax.Array] = None) -> jax.Array:
-    """x: [..., seq, heads, head_dim]; freqs: [max_len, head_dim//2]."""
+    """x: [..., seq, heads, head_dim]; freqs: [max_len, head_dim//2].
+    ``positions`` may be [seq] (shared) or [batch, seq] (per-row — the
+    serving-slot case)."""
     orig_dtype = x.dtype
     seq = x.shape[-3]
     if positions is None:
@@ -48,7 +50,7 @@ def apply_rope(x: jax.Array, freqs: jax.Array,
         rot = freqs[positions]
     xc = x.astype(jnp.float32).reshape(*x.shape[:-1], -1, 2)
     xc = jax.lax.complex(xc[..., 0], xc[..., 1])
-    rot = rot[:, None, :]          # broadcast over heads
+    rot = rot[..., :, None, :]     # broadcast over the heads axis
     out = xc * rot
     out = jnp.stack([jnp.real(out), jnp.imag(out)], axis=-1)
     return out.reshape(x.shape).astype(orig_dtype)
