@@ -1,0 +1,230 @@
+"""nos-tpu-server — the serving binary a gang-scheduled inference pod
+runs: the continuous-batching engine (models/serving.py) behind a
+minimal HTTP API.
+
+    POST /v1/generate   {"prompt": [ids], "max_new_tokens": N}
+                        -> {"tokens": [full sequence]}
+    GET  /healthz       -> ok
+
+Requests batch continuously: concurrent POSTs share the engine's decode
+ticks (one compiled program per tick serves every active slot), each
+blocking only until its own slot completes. Params load exactly like
+``nos-tpu-generate`` (checkpoint restore, optional int8).
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import logging
+import threading
+import time
+from dataclasses import dataclass, fields
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional, Sequence
+
+from nos_tpu.utils.metrics import default_registry
+
+logger = logging.getLogger("nos_tpu.server")
+
+
+@dataclass
+class ServerConfig:
+    # model (must match the checkpoint's training config)
+    vocab: int = 32000
+    d_model: int = 512
+    n_layers: int = 4
+    n_heads: int = 8
+    n_kv_heads: int = 0
+    d_ff: int = 1408
+    max_seq: int = 512
+    n_experts: int = 0
+    bf16: bool = True
+    checkpoint_dir: str = ""
+    int8: bool = False
+    # serving
+    max_batch: int = 8
+    default_max_new_tokens: int = 64
+    port: int = 8000
+    seed: int = 0
+    log_level: str = "info"
+
+    @classmethod
+    def from_yaml_file(cls, path: str) -> "ServerConfig":
+        import yaml
+
+        with open(path) as f:
+            data = yaml.safe_load(f) or {}
+        known = {f.name for f in fields(cls)}
+        unknown = set(data) - known
+        if unknown:
+            raise ValueError(
+                f"{path}: unknown server config keys {sorted(unknown)}")
+        return cls(**data)
+
+
+class ServingLoop:
+    """Thread-safe wrapper around DecodeServer: handlers submit and wait;
+    one background thread ticks the engine whenever there is work. A tick
+    failure (XLA OOM, device loss) marks the loop unhealthy — /healthz
+    flips to 500 so orchestration restarts the pod instead of every
+    request silently burning its timeout."""
+
+    def __init__(self, engine):
+        self.engine = engine
+        self._lock = threading.Lock()
+        self._work = threading.Condition(self._lock)
+        self._stop = False
+        self._failed: Optional[BaseException] = None
+        self._abandoned: set = set()        # rids whose client timed out
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+
+    @property
+    def healthy(self) -> bool:
+        return self._failed is None
+
+    def _run(self) -> None:
+        while True:
+            with self._work:
+                while not self._stop and not self.engine.has_work():
+                    self._work.wait()
+                if self._stop:
+                    return
+                try:
+                    self.engine.step()
+                except BaseException as e:   # decode tick died: go unhealthy
+                    logger.exception("decode tick failed; marking unhealthy")
+                    self._failed = e
+                    self._work.notify_all()
+                    return
+                # reap results whose client already gave up, so _done
+                # can't grow from timed-out requests
+                for rid in list(self._abandoned):
+                    if self.engine.pop_result(rid) is not None:
+                        self._abandoned.discard(rid)
+                self._work.notify_all()     # wake waiters to check results
+
+    def generate(self, prompt, max_new_tokens, timeout: float = 300.0):
+        with self._work:
+            if self._failed is not None:
+                raise RuntimeError(f"serving loop failed: {self._failed}")
+            rid = self.engine.submit(prompt, max_new_tokens)
+            self._work.notify_all()
+            deadline = time.monotonic() + timeout
+            while True:
+                result = self.engine.pop_result(rid)
+                if result is not None:
+                    return result
+                if self._failed is not None:
+                    raise RuntimeError(
+                        f"serving loop failed: {self._failed}")
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    self._abandoned.add(rid)    # reaped by the ticker
+                    raise TimeoutError(f"request {rid} timed out")
+                self._work.wait(timeout=min(remaining, 1.0))
+
+    def shutdown(self) -> None:
+        with self._work:
+            self._stop = True
+            self._work.notify_all()
+        self._thread.join(timeout=5)
+
+
+def build_engine(cfg: ServerConfig):
+    """Load params (checkpoint / int8, shared with cmd/generate.py) and
+    build the continuous-batching engine."""
+    from nos_tpu.cmd.generate import GenerateConfig, load_params
+    from nos_tpu.models.serving import DecodeServer
+
+    gcfg = GenerateConfig(
+        vocab=cfg.vocab, d_model=cfg.d_model, n_layers=cfg.n_layers,
+        n_heads=cfg.n_heads, n_kv_heads=cfg.n_kv_heads, d_ff=cfg.d_ff,
+        max_seq=cfg.max_seq, n_experts=cfg.n_experts, bf16=cfg.bf16,
+        checkpoint_dir=cfg.checkpoint_dir, int8=cfg.int8, seed=cfg.seed)
+    model_cfg, params = load_params(gcfg)
+    return DecodeServer(params, model_cfg, max_batch=cfg.max_batch)
+
+
+def make_http_server(cfg: ServerConfig, loop: ServingLoop
+                     ) -> ThreadingHTTPServer:
+    class Handler(BaseHTTPRequestHandler):
+        def log_message(self, fmt, *args):      # route through logging
+            logger.debug("http: " + fmt, *args)
+
+        def _reply(self, code: int, body: dict) -> None:
+            data = json.dumps(body).encode()
+            self.send_response(code)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(data)))
+            self.end_headers()
+            self.wfile.write(data)
+
+        def do_GET(self):
+            if self.path == "/healthz":
+                ok = loop.healthy
+                self._reply(200 if ok else 500,
+                            {"status": "ok" if ok else "unhealthy"})
+            elif self.path == "/readyz":
+                self._reply(200, {"status": "ok"})
+            elif self.path == "/metrics":
+                body = default_registry().expose().encode()
+                self.send_response(200)
+                self.send_header("Content-Type",
+                                 "text/plain; version=0.0.4")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+            else:
+                self._reply(404, {"error": f"unknown path {self.path}"})
+
+        def do_POST(self):
+            if self.path != "/v1/generate":
+                self._reply(404, {"error": f"unknown path {self.path}"})
+                return
+            try:
+                length = int(self.headers.get("Content-Length", 0))
+                body = json.loads(self.rfile.read(length) or b"{}")
+                prompt = [int(t) for t in body["prompt"]]
+                n = int(body.get("max_new_tokens",
+                                 cfg.default_max_new_tokens))
+                tokens = loop.generate(prompt, n)
+            except (KeyError, ValueError, TypeError) as e:
+                self._reply(400, {"error": f"{type(e).__name__}: {e}"})
+                return
+            except TimeoutError as e:
+                self._reply(503, {"error": str(e)})
+                return
+            self._reply(200, {"tokens": tokens})
+
+    return ThreadingHTTPServer(("0.0.0.0", cfg.port), Handler)
+
+
+def main(argv: Optional[Sequence[str]] = None) -> None:
+    parser = argparse.ArgumentParser(prog="nos-tpu-server",
+                                     description=__doc__)
+    parser.add_argument("--config", default="", help="server config YAML")
+    parser.add_argument("--checkpoint-dir", default="")
+    parser.add_argument("--port", type=int, default=None)
+    args = parser.parse_args(argv)
+
+    cfg = ServerConfig.from_yaml_file(args.config) if args.config \
+        else ServerConfig()
+    if args.checkpoint_dir:
+        cfg.checkpoint_dir = args.checkpoint_dir
+    if args.port is not None:
+        cfg.port = args.port
+    logging.basicConfig(level=getattr(logging, cfg.log_level.upper(), 20),
+                        format="%(asctime)s %(name)s %(levelname)s %(message)s")
+
+    loop = ServingLoop(build_engine(cfg))
+    httpd = make_http_server(cfg, loop)
+    logger.info("serving on :%d (max_batch=%d)", cfg.port, cfg.max_batch)
+    try:
+        httpd.serve_forever()
+    finally:
+        loop.shutdown()
+
+
+if __name__ == "__main__":
+    main()

@@ -118,6 +118,8 @@ class DecodeServer:
     def submit(self, prompt: List[int], max_new_tokens: int) -> int:
         if not prompt:
             raise ValueError("empty prompt")
+        if max_new_tokens < 1:
+            raise ValueError(f"max_new_tokens must be >= 1, got {max_new_tokens}")
         if len(prompt) + max_new_tokens > self.max_len:
             raise ValueError(
                 f"prompt ({len(prompt)}) + max_new_tokens "
@@ -196,6 +198,18 @@ class DecodeServer:
             emitted += 1
             self._finish_if_done(req)
         return emitted
+
+    def pop_result(self, rid: int) -> Optional[List[int]]:
+        """The finished sequence for ``rid`` (prompt + generated), or None
+        while it is still pending/active. Popping forgets it — each
+        result is handed out exactly once (the HTTP server's contract)."""
+        req = self._done.pop(rid, None)
+        if req is None:
+            return None
+        return req.prompt + req.out[:req.max_new_tokens]
+
+    def has_work(self) -> bool:
+        return bool(self._active or self._pending)
 
     def drain(self) -> Dict[int, List[int]]:
         """Run until every submitted request completes; returns

@@ -1,0 +1,135 @@
+"""The serving binary (cmd/server.py): HTTP surface over the
+continuous-batching engine — concurrent requests, correctness vs
+generate(), validation."""
+import json
+import threading
+import urllib.error
+import urllib.request
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from nos_tpu.cmd.server import ServerConfig, ServingLoop, make_http_server
+from nos_tpu.models import transformer as tfm
+from nos_tpu.models.generate import generate
+from nos_tpu.models.serving import DecodeServer
+
+MODEL = dict(vocab=64, d_model=32, n_layers=2, n_heads=4, n_kv_heads=2,
+             d_ff=64, max_seq=64)
+
+
+@pytest.fixture(scope="module")
+def served():
+    cfg = ServerConfig(**MODEL, bf16=False, max_batch=2, port=0)
+    mcfg = tfm.TransformerConfig(**MODEL, dtype=jnp.float32)
+    params = tfm.init_params(jax.random.PRNGKey(0), mcfg)
+    loop = ServingLoop(DecodeServer(params, mcfg, max_batch=2))
+    httpd = make_http_server(cfg, loop)
+    t = threading.Thread(target=httpd.serve_forever, daemon=True)
+    t.start()
+    url = f"http://127.0.0.1:{httpd.server_address[1]}"
+    yield url, params, mcfg
+    httpd.shutdown()
+    loop.shutdown()
+
+
+def post(url, body, timeout=120):
+    req = urllib.request.Request(
+        url + "/v1/generate", data=json.dumps(body).encode(),
+        headers={"Content-Type": "application/json"}, method="POST")
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        return json.loads(r.read())
+
+
+def test_healthz(served):
+    url, _, _ = served
+    with urllib.request.urlopen(url + "/healthz", timeout=30) as r:
+        assert json.loads(r.read())["status"] == "ok"
+
+
+def test_generate_over_http_matches_generate(served):
+    url, params, mcfg = served
+    got = post(url, {"prompt": [1, 2, 3], "max_new_tokens": 5})
+    want = [int(t) for t in
+            generate(params, mcfg, jnp.asarray([[1, 2, 3]], jnp.int32), 5)[0]]
+    assert got["tokens"] == want
+
+
+def test_concurrent_requests_batch_and_stay_exact(served):
+    url, params, mcfg = served
+    prompts = [[1, 2], [9, 8, 7], [5], [3, 3, 3, 3]]
+    results = [None] * len(prompts)
+
+    def worker(i):
+        results[i] = post(url, {"prompt": prompts[i],
+                                "max_new_tokens": 6})["tokens"]
+
+    threads = [threading.Thread(target=worker, args=(i,))
+               for i in range(len(prompts))]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=180)
+    for i, p in enumerate(prompts):
+        want = [int(t) for t in
+                generate(params, mcfg, jnp.asarray([p], jnp.int32), 6)[0]]
+        assert results[i] == want, f"request {i}"
+
+
+def test_bad_requests_rejected(served):
+    url, _, _ = served
+    with pytest.raises(urllib.error.HTTPError) as e:
+        post(url, {"max_new_tokens": 5})            # no prompt
+    assert e.value.code == 400
+    with pytest.raises(urllib.error.HTTPError) as e:
+        post(url, {"prompt": [], "max_new_tokens": 5})
+    assert e.value.code == 400
+    with pytest.raises(urllib.error.HTTPError) as e:
+        req = urllib.request.Request(url + "/nope", data=b"{}",
+                                     method="POST")
+        urllib.request.urlopen(req, timeout=30)
+    assert e.value.code == 404
+
+
+def test_negative_max_new_tokens_rejected(served):
+    url, _, _ = served
+    with pytest.raises(urllib.error.HTTPError) as e:
+        post(url, {"prompt": [1, 2], "max_new_tokens": -5})
+    assert e.value.code == 400
+
+
+def test_health_endpoints(served):
+    url, _, _ = served
+    for path in ("/healthz", "/readyz"):
+        with urllib.request.urlopen(url + path, timeout=30) as r:
+            assert r.status == 200
+    with urllib.request.urlopen(url + "/metrics", timeout=30) as r:
+        assert r.status == 200
+
+
+def test_failed_loop_reports_unhealthy():
+    from nos_tpu.cmd.server import ServingLoop
+
+    class Boom:
+        def has_work(self):
+            return True
+
+        def step(self):
+            raise RuntimeError("device fell over")
+
+        def submit(self, p, n):
+            return 0
+
+        def pop_result(self, rid):
+            return None
+
+    loop = ServingLoop(Boom())
+    deadline = 5.0
+    import time as _t
+    t0 = _t.monotonic()
+    while loop.healthy and _t.monotonic() - t0 < deadline:
+        _t.sleep(0.05)
+    assert not loop.healthy
+    with pytest.raises(RuntimeError, match="serving loop failed"):
+        loop.generate([1], 2)
