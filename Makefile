@@ -3,7 +3,7 @@
 PYTHON ?= python
 IMAGE_REGISTRY ?= ghcr.io/nos-tpu
 VERSION ?= 0.1.0
-COMPONENTS = apiserver operator scheduler partitioner tpuagent metricsexporter
+COMPONENTS = apiserver operator scheduler partitioner tpuagent metricsexporter trainer server
 
 .PHONY: test
 test:  ## Run the unit + integration suite (virtual 8-device CPU mesh for JAX tests).
@@ -24,6 +24,10 @@ bench-attn:  ## Compare attention kernels (splash/flash/xla) at the flagship sha
 .PHONY: bench-decode
 bench-decode:  ## KV-cache decode throughput, bf16 and int8.
 	$(PYTHON) bench_decode.py
+
+.PHONY: bench-serve
+bench-serve:  ## Continuous-batching serving throughput.
+	$(PYTHON) bench_serve.py
 
 .PHONY: native
 native:  ## Build the tpuagent C++ device layer.

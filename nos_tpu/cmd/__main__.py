@@ -11,6 +11,8 @@ _BINARIES = {
     "tpuagent": "nos_tpu.cmd.tpuagent",
     "metricsexporter": "nos_tpu.cmd.metricsexporter",
     "trainer": "nos_tpu.cmd.trainer",
+    "generate": "nos_tpu.cmd.generate",
+    "server": "nos_tpu.cmd.server",
 }
 
 

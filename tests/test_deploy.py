@@ -217,7 +217,7 @@ def test_samples_valid():
 # ---------------------------------------------------------------------------
 def test_dockerfiles_exist_per_component():
     for c in ("apiserver", "operator", "scheduler", "partitioner", "tpuagent",
-              "metricsexporter"):
+              "metricsexporter", "trainer", "server"):
         path = os.path.join(REPO, "build", c, "Dockerfile")
         assert os.path.exists(path), f"missing {path}"
         with open(path) as f:
