@@ -70,6 +70,19 @@ class ServingLoop:
     request silently burning its timeout."""
 
     def __init__(self, engine):
+        reg = default_registry()
+        # register() is idempotent per (name, type, labels) and raises on
+        # a mismatched re-registration — exactly what we want at startup
+        self.m_requests = reg.counter(
+            "nos_tpu_serve_requests_total",
+            "Requests completed by the serving loop")
+        self.m_tokens = reg.counter(
+            "nos_tpu_serve_tokens_total", "Tokens emitted by decode ticks")
+        self.m_ticks = reg.counter(
+            "nos_tpu_serve_ticks_total", "Decode ticks executed")
+        self.m_abandoned = reg.counter(
+            "nos_tpu_serve_abandoned_total",
+            "Requests that finished after their client timed out")
         self.engine = engine
         self._lock = threading.Lock()
         self._work = threading.Condition(self._lock)
@@ -91,7 +104,9 @@ class ServingLoop:
                 if self._stop:
                     return
                 try:
-                    self.engine.step()
+                    emitted = self.engine.step()
+                    self.m_ticks.inc()
+                    self.m_tokens.inc(emitted)
                 except BaseException as e:   # decode tick died: go unhealthy
                     logger.exception("decode tick failed; marking unhealthy")
                     self._failed = e
@@ -102,6 +117,9 @@ class ServingLoop:
                 for rid in list(self._abandoned):
                     if self.engine.pop_result(rid) is not None:
                         self._abandoned.discard(rid)
+                        # completed work, even if nobody is waiting
+                        self.m_requests.inc()
+                        self.m_abandoned.inc()
                 self._work.notify_all()     # wake waiters to check results
 
     def generate(self, prompt, max_new_tokens, timeout: float = 300.0):
@@ -114,6 +132,7 @@ class ServingLoop:
             while True:
                 result = self.engine.pop_result(rid)
                 if result is not None:
+                    self.m_requests.inc()
                     return result
                 if self._failed is not None:
                     raise RuntimeError(

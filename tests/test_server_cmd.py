@@ -133,3 +133,21 @@ def test_failed_loop_reports_unhealthy():
     assert not loop.healthy
     with pytest.raises(RuntimeError, match="serving loop failed"):
         loop.generate([1], 2)
+
+
+def test_metrics_count_requests_and_tokens(served):
+    url, _, _ = served
+    post(url, {"prompt": [2, 4], "max_new_tokens": 3})
+    with urllib.request.urlopen(url + "/metrics", timeout=30) as r:
+        text = r.read().decode()
+    assert "nos_tpu_serve_requests_total" in text
+    assert "nos_tpu_serve_ticks_total" in text
+
+    def val(name):
+        for line in text.splitlines():
+            if line.startswith(name + " "):
+                return float(line.split()[-1])
+        return 0.0
+
+    assert val("nos_tpu_serve_requests_total") >= 1
+    assert val("nos_tpu_serve_tokens_total") >= 2   # N-1 decode tokens
