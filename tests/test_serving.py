@@ -103,3 +103,25 @@ def test_drain_returns_only_new_results_and_clears(params):
     second = srv.drain()
     assert set(second) == {b}          # a's result was forgotten
     assert second[b] == ref(params, [3, 4], 3)
+
+
+def test_random_schedules_stay_exact(params):
+    """Crash-prober: random prompt lengths (spanning several prefill
+    buckets), budgets, and arrival points over a 2-slot engine must stay
+    bit-exact vs generate() for every request."""
+    rng = np.random.default_rng(42)
+    for trial in range(3):
+        srv = DecodeServer(params, CFG, max_batch=2)
+        n_req = int(rng.integers(3, 6))
+        # lengths up to 40 hit the 8/16/32/64 buckets, not just the min
+        reqs = [([int(t) for t in rng.integers(0, 64, rng.integers(1, 41))],
+                 int(rng.integers(1, 7))) for _ in range(n_req)]
+        rids = []
+        for p, n in reqs:
+            rids.append(srv.submit(p, n))
+            # random interleaving: sometimes tick between submissions
+            for _ in range(int(rng.integers(0, 3))):
+                srv.step()
+        results = srv.drain()
+        for rid, (p, n) in zip(rids, reqs):
+            assert results[rid] == ref(params, p, n), (trial, rid, p, n)
