@@ -100,3 +100,45 @@ def test_dataset_windows_always_valid(tmp_path_factory, sizes, seq_len, step):
     # and identical on a fresh instance (stateless determinism)
     again = TokenDataset(str(tmp / "shard_*.bin"), seq_len).batch(step, 4)
     np.testing.assert_array_equal(b["tokens"], again["tokens"])
+
+
+@settings(max_examples=8, deadline=None)
+@given(
+    st.integers(1, 2),                   # batch
+    st.sampled_from([2, 4]),             # query heads
+    st.sampled_from([1, 2]),             # kv-head divisor (h // this)
+    st.sampled_from([4, 8]),             # tokens per ring device
+    st.sampled_from([2, 4]),             # ring size
+    st.booleans(),                       # causal
+    st.integers(0, 2**31 - 1),           # seed
+)
+def test_ring_attention_exact_for_all_shapes(b, h, kv_div, s_local, sp,
+                                             causal, seed):
+    # no silent-skip guard: a misconfigured mesh (fewer than sp devices)
+    # must fail loudly via build_mesh's "need N devices" rather than
+    # letting the property pass vacuously
+    """Ring attention must be EXACT attention for every (batch, heads,
+    GQA grouping, ring size, local length, causality) combination — the
+    sp path is the long-context flagship, so its math gets the for-all
+    treatment, not just the worked examples."""
+    import jax
+    import jax.numpy as jnp
+
+    from nos_tpu.ops.attention import xla_attention
+    from nos_tpu.ops.ring_attention import ring_attention_sharded
+    from nos_tpu.parallel.layout import ParallelLayout
+    from nos_tpu.parallel.mesh import build_mesh
+
+    h_kv = h // kv_div
+    s = s_local * sp
+    d = 8
+    ks = jax.random.split(jax.random.PRNGKey(seed), 3)
+    q = jax.random.normal(ks[0], (b, h, s, d), jnp.float32)
+    k = jax.random.normal(ks[1], (b, h_kv, s, d), jnp.float32)
+    v = jax.random.normal(ks[2], (b, h_kv, s, d), jnp.float32)
+
+    mesh = build_mesh(ParallelLayout(sp=sp), jax.devices()[:sp])
+    got = ring_attention_sharded(mesh, q, k, v, causal=causal)
+    want = xla_attention(q, k, v, causal=causal)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-4, atol=2e-4)

@@ -125,3 +125,17 @@ def test_random_schedules_stay_exact(params):
         results = srv.drain()
         for rid, (p, n) in zip(rids, reqs):
             assert results[rid] == ref(params, p, n), (trial, rid, p, n)
+
+
+def test_engine_serves_int8_params(params):
+    """The quantized pytree drops into the engine unchanged — int8
+    serving must match int8 generate() exactly."""
+    from nos_tpu.models.quant import quantize_params
+
+    qp = quantize_params(params)
+    srv = DecodeServer(qp, CFG, max_batch=2)
+    rid = srv.submit([1, 2, 3], 5)
+    results = srv.drain()
+    want = [int(t) for t in
+            generate(qp, CFG, jnp.asarray([[1, 2, 3]], jnp.int32), 5)[0]]
+    assert results[rid] == want
