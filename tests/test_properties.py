@@ -102,6 +102,33 @@ def test_dataset_windows_always_valid(tmp_path_factory, sizes, seq_len, step):
     np.testing.assert_array_equal(b["tokens"], again["tokens"])
 
 
+def _check_sp_strategy_exact(sharded_fn, b, h, h_kv, s_local, sp, causal,
+                             seed, **kw):
+    """Shared for-all harness: an sp attention strategy must equal full
+    attention for this (batch, heads, kv heads, ring size, local length,
+    causality) draw. No silent device-count guard: a misconfigured mesh
+    fails loudly via build_mesh's "need N devices"."""
+    import jax
+    import jax.numpy as jnp
+
+    from nos_tpu.ops.attention import xla_attention
+    from nos_tpu.parallel.layout import ParallelLayout
+    from nos_tpu.parallel.mesh import build_mesh
+
+    s = s_local * sp
+    d = 8
+    ks = jax.random.split(jax.random.PRNGKey(seed), 3)
+    q = jax.random.normal(ks[0], (b, h, s, d), jnp.float32)
+    k = jax.random.normal(ks[1], (b, h_kv, s, d), jnp.float32)
+    v = jax.random.normal(ks[2], (b, h_kv, s, d), jnp.float32)
+
+    mesh = build_mesh(ParallelLayout(sp=sp), jax.devices()[:sp])
+    got = sharded_fn(mesh, q, k, v, causal=causal, **kw)
+    want = xla_attention(q, k, v, causal=causal)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-4, atol=2e-4)
+
+
 @settings(max_examples=8, deadline=None)
 @given(
     st.integers(1, 2),                   # batch
@@ -114,31 +141,32 @@ def test_dataset_windows_always_valid(tmp_path_factory, sizes, seq_len, step):
 )
 def test_ring_attention_exact_for_all_shapes(b, h, kv_div, s_local, sp,
                                              causal, seed):
-    # no silent-skip guard: a misconfigured mesh (fewer than sp devices)
-    # must fail loudly via build_mesh's "need N devices" rather than
-    # letting the property pass vacuously
-    """Ring attention must be EXACT attention for every (batch, heads,
-    GQA grouping, ring size, local length, causality) combination — the
-    sp path is the long-context flagship, so its math gets the for-all
-    treatment, not just the worked examples."""
-    import jax
-    import jax.numpy as jnp
-
-    from nos_tpu.ops.attention import xla_attention
+    """Ring attention is the long-context flagship — its math gets the
+    for-all treatment, not just the worked examples."""
     from nos_tpu.ops.ring_attention import ring_attention_sharded
-    from nos_tpu.parallel.layout import ParallelLayout
-    from nos_tpu.parallel.mesh import build_mesh
 
-    h_kv = h // kv_div
-    s = s_local * sp
-    d = 8
-    ks = jax.random.split(jax.random.PRNGKey(seed), 3)
-    q = jax.random.normal(ks[0], (b, h, s, d), jnp.float32)
-    k = jax.random.normal(ks[1], (b, h_kv, s, d), jnp.float32)
-    v = jax.random.normal(ks[2], (b, h_kv, s, d), jnp.float32)
+    _check_sp_strategy_exact(ring_attention_sharded, b, h, h // kv_div,
+                             s_local, sp, causal, seed)
 
-    mesh = build_mesh(ParallelLayout(sp=sp), jax.devices()[:sp])
-    got = ring_attention_sharded(mesh, q, k, v, causal=causal)
-    want = xla_attention(q, k, v, causal=causal)
-    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
-                               rtol=2e-4, atol=2e-4)
+
+@settings(max_examples=6, deadline=None)
+@given(
+    st.integers(1, 2),                   # batch
+    st.sampled_from([2, 4]),             # ring size (heads must divide)
+    st.sampled_from([1, 2]),             # head multiple of sp
+    st.sampled_from([1, 2]),             # kv-head divisor (of hmul)
+    st.sampled_from([4, 8]),             # tokens per device
+    st.booleans(),                       # causal
+    st.integers(0, 2**31 - 1),           # seed
+)
+def test_ulysses_exact_for_all_shapes(b, sp, hmul, kv_div, s_local, causal,
+                                      seed):
+    """Same treatment for the all-to-all strategy, GQA included: ulysses
+    needs heads (and kv heads) divisible by sp, so kv_div applies only
+    when it divides hmul."""
+    from nos_tpu.ops.ulysses import ulysses_attention_sharded
+
+    h = sp * hmul
+    kv_div = kv_div if hmul % kv_div == 0 else 1
+    _check_sp_strategy_exact(ulysses_attention_sharded, b, h,
+                             h // kv_div, s_local, sp, causal, seed)
