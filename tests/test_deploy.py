@@ -294,3 +294,26 @@ def test_kind_e2e_script_runs_or_skips():
     if proc.returncode == 2:
         pytest.skip(f"kind e2e unavailable: {proc.stdout.strip()[-100:]}")
     assert proc.returncode == 0, proc.stdout[-2000:] + proc.stderr[-2000:]
+
+
+def test_serving_sample_valid():
+    """The serving Deployment sample must parse, and its embedded config
+    must construct a real ServerConfig (drift between the sample and the
+    binary's schema fails here)."""
+    import yaml
+
+    from nos_tpu.cmd.server import ServerConfig
+
+    path = os.path.join(CONFIG, "operator", "samples",
+                        "serving-deployment.yaml")
+    with open(path) as f:
+        docs = list(yaml.safe_load_all(f))
+    dep, cm = docs
+    assert dep["kind"] == "Deployment"
+    tmpl = dep["spec"]["template"]["spec"]
+    assert tmpl["schedulerName"] == "nos-scheduler"
+    ctr = tmpl["containers"][0]
+    assert ctr["resources"]["requests"]["nos.ai/tpu-slice-2x2"] == 1
+    assert ctr["livenessProbe"]["httpGet"]["path"] == "/healthz"
+    cfg = ServerConfig(**yaml.safe_load(cm["data"]["server.yaml"]))
+    assert cfg.int8 and cfg.checkpoint_dir == "/ckpt"
