@@ -170,3 +170,70 @@ def test_ulysses_exact_for_all_shapes(b, sp, hmul, kv_div, s_local, causal,
     kv_div = kv_div if hmul % kv_div == 0 else 1
     _check_sp_strategy_exact(ulysses_attention_sharded, b, h,
                              h // kv_div, s_local, sp, causal, seed)
+
+
+@settings(max_examples=60, deadline=None)
+@given(
+    st.dictionaries(                      # desired geometry per board
+        st.integers(0, 2),
+        st.dictionaries(st.sampled_from([(1, 1), (1, 2), (2, 2)]),
+                        st.integers(0, 4), max_size=3),
+        max_size=3),
+    st.dictionaries(                      # actual geometry per board
+        st.integers(0, 2),
+        st.dictionaries(st.sampled_from([(1, 1), (1, 2), (2, 2)]),
+                        st.integers(0, 4), max_size=3),
+        max_size=3),
+    st.data(),
+)
+def test_plan_differ_invariants(desired_raw, actual_raw, data):
+    """For ALL (desired, actual, used) partition states: applying the
+    plan's ops to actual must yield exactly desired; a plan is invalid
+    iff some delete exceeds the free count; desired == actual iff the
+    plan is empty (the differ's contract, reference plan.go:31-92)."""
+    from nos_tpu.agents.plan import BoardState, PartitionConfigPlan
+    from nos_tpu.tpu.slice import Profile
+
+    def geom(raw):
+        return {Profile(*k): v for k, v in raw.items()}
+
+    desired = {b: geom(g) for b, g in desired_raw.items()}
+    actual = {}
+    for b, g in actual_raw.items():
+        g = geom(g)
+        used = {p: data.draw(st.integers(0, q), label=f"used{b}{p}")
+                for p, q in g.items()}
+        actual[b] = BoardState(geometry=g, used=used)
+
+    plan = PartitionConfigPlan(desired=desired, actual=actual)
+
+    # 1. replaying the ops onto actual reproduces desired exactly
+    result = {b: {p: q for p, q in st_.geometry.items() if q > 0}
+              for b, st_ in actual.items()}
+    for op in plan.ops:
+        board = result.setdefault(op.board, {})
+        delta = op.quantity if op.kind == "create" else -op.quantity
+        board[op.profile] = board.get(op.profile, 0) + delta
+        if board[op.profile] == 0:
+            del board[op.profile]
+    want = {b: {p: q for p, q in g.items() if q > 0}
+            for b, g in desired.items()}
+    want = {b: g for b, g in want.items() if g}
+    result = {b: g for b, g in result.items() if g}
+    assert result == want
+
+    # 2. invalid iff a delete digs into used slices
+    overdelete = any(
+        op.kind == "delete"
+        and op.quantity > (actual.get(op.board, BoardState()).geometry
+                           .get(op.profile, 0)
+                           - actual.get(op.board, BoardState()).used
+                           .get(op.profile, 0))
+        for op in plan.ops)
+    assert plan.is_valid() == (not overdelete)
+
+    # 3. empty iff already converged
+    have = {b: {p: q for p, q in s.geometry.items() if q > 0}
+            for b, s in actual.items()}
+    have = {b: g for b, g in have.items() if g}
+    assert plan.is_empty() == (have == want)
