@@ -102,16 +102,73 @@ import os
 
 MFU_TIMEOUT_S = int(os.environ.get("NOS_TPU_BENCH_TIMEOUT_S", "900"))
 # watchdog: a wedged TPU tunnel hangs instead of raising
+PROBE_TIMEOUT_S = int(os.environ.get("NOS_TPU_PROBE_TIMEOUT_S", "60"))
+PROBE_ATTEMPTS = int(os.environ.get("NOS_TPU_PROBE_ATTEMPTS", "3"))
+PROBE_RETRY_WAIT_S = int(os.environ.get("NOS_TPU_PROBE_RETRY_WAIT_S", "120"))
+
+_PROBE_CODE = (
+    "import jax, jax.numpy as jnp\n"
+    "d = jax.devices()[0]\n"
+    "x = jnp.ones((128, 128), jnp.bfloat16)\n"
+    "v = float((x @ x)[0, 0])\n"      # host fetch = the only real fence
+    "print('PROBE_OK', d.platform, flush=True)\n"
+)
 
 
-def run_mfu():
+def probe_tpu():
+    """Pre-flight tunnel probe (VERDICT r3 weak #1): claim the device,
+    run a tiny matmul, fetch the result to host — all in a subprocess
+    under a short watchdog. Distinguishes the three failure worlds the
+    900s burn used to conflate:
+
+    - ``ok``     — a TPU answered and round-tripped a value
+    - ``hang``   — device claim / compile hung (wedged axon tunnel)
+    - ``absent`` — no TPU behind jax.devices() (CPU-only environment)
+    - ``error``  — probe subprocess died (libtpu init failure, device
+      busy, import error): a present-but-erroring TPU, NOT absence
+
+    Returns (status, detail) — detail is a stderr tail on ``error``.
+    """
+    import subprocess
+
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c", _PROBE_CODE],
+            capture_output=True, text=True, timeout=PROBE_TIMEOUT_S,
+        )
+    except subprocess.TimeoutExpired:
+        return "hang", ""
+    for line in proc.stdout.splitlines():
+        if line.startswith("PROBE_OK"):
+            platform = line.split()[-1].lower()
+            return ("ok" if "tpu" in platform else "absent"), ""
+    if proc.returncode != 0:
+        return "error", proc.stderr.strip()[-200:]
+    return "absent", ""
+
+
+def probe_tpu_with_retry():
+    """Probe; on hang, retry every ~2 min (a wedged tunnel sometimes
+    un-wedges) so a transient outage costs minutes, not the whole
+    watchdog budget. Returns (status, attempts, detail)."""
+    status, detail = probe_tpu()
+    attempts = 1
+    while status == "hang" and attempts < PROBE_ATTEMPTS:
+        time.sleep(PROBE_RETRY_WAIT_S)
+        status, detail = probe_tpu()
+        attempts += 1
+    return status, attempts, detail
+
+
+def run_mfu(timeout_s=None):
     """Run bench_mfu.py in a subprocess under a watchdog (first compile is
     ~20-40s; a dead tunnel would hang this process forever otherwise)."""
     import subprocess
 
     proc = subprocess.run(
         [sys.executable, "bench_mfu.py"],
-        capture_output=True, text=True, timeout=MFU_TIMEOUT_S,
+        capture_output=True, text=True,
+        timeout=MFU_TIMEOUT_S if timeout_s is None else timeout_s,
     )
     if proc.returncode != 0:
         err = proc.stderr.strip()
@@ -128,16 +185,44 @@ def run_mfu():
 def main():
     import bench_sched
 
-    # scheduler north star first (CPU-only, fast, can't hang on the TPU)
+    # scheduler north star first (CPU-only, fast, can't hang on the TPU).
+    # stdout AND stderr are captured: the published artifact must be one
+    # clean JSON line, never preceded by a stray teardown traceback from
+    # the wire rep's reconnect loop (VERDICT r3 weak #3)
     import contextlib
     import io
 
-    buf = io.StringIO()
-    with contextlib.redirect_stdout(buf):
+    buf, errbuf = io.StringIO(), io.StringIO()
+    with contextlib.redirect_stdout(buf), \
+            contextlib.redirect_stderr(errbuf):
         sched = bench_sched.main()
+    stray = errbuf.getvalue().strip()
+    if stray:
+        sched["sched_stderr_tail"] = stray[-200:]
+
+    # pre-flight probe before committing the big watchdog budget: a
+    # wedged tunnel now costs ~3 probe attempts, not the full 900s, and
+    # the artifact records WHY there is no MFU number
+    t0 = time.time()
+    status, attempts, detail = probe_tpu_with_retry()
+    sched["tpu_probe"] = status
+    sched["tpu_probe_attempts"] = attempts
+    if status != "ok":
+        sched["mfu_error"] = {
+            "hang": "tunnel probe hung (device claim/compile) "
+                    f"after {attempts} attempts",
+            "absent": "no TPU behind jax.devices() (cpu-only environment)",
+            "error": f"tpu probe subprocess failed: {detail}",
+        }[status]
+        print(json.dumps(sched))
+        return
 
     try:
-        mfu = run_mfu()
+        # floor the remaining watchdog at 120s for compile headroom, but
+        # never above the operator-configured total budget
+        remaining = max(min(120.0, MFU_TIMEOUT_S),
+                        MFU_TIMEOUT_S - (time.time() - t0))
+        mfu = run_mfu(timeout_s=remaining)
     except ImplausibleMeasurement as e:
         print(f"BENCH FAILED (implausible physics): {e}", file=sys.stderr)
         sys.exit(1)
@@ -155,6 +240,8 @@ def main():
         "value": mfu["mfu_pct"],
         "unit": "%",
         "vs_baseline": round(mfu["mfu_pct"] / MFU_BAR, 3) if mfu["mfu_pct"] else None,
+        "tpu_probe": status,
+        "tpu_probe_attempts": attempts,
         **{k: v for k, v in mfu.items() if k != "mfu_pct"},
         "sched_gang_p50_s": sched["gang_p50_s"],
         "sched_gang_p99_s": sched["gang_p99_s"],

@@ -158,8 +158,17 @@ def main(argv: Optional[Sequence[str]] = None) -> None:
     logging.basicConfig(level=getattr(logging, cfg.log_level.upper(), 20),
                         format="%(asctime)s %(name)s %(levelname)s %(message)s")
 
-    prompts = [[int(t) for t in p.split(",") if t.strip()]
-               for p in (args.prompt or ["0"])]
+    prompts = []
+    for raw in args.prompt or ["0"]:
+        try:
+            toks = [int(t) for t in raw.split(",") if t.strip()]
+        except ValueError:
+            parser.error(f"--prompt {raw!r} contains a non-integer token; "
+                         f"pass comma-separated token ids, e.g. '1,2,3'")
+        if not toks:
+            parser.error(f"--prompt {raw!r} parsed to zero tokens; pass a "
+                         f"comma-separated list of token ids, e.g. '1,2,3'")
+        prompts.append(toks)
     for seq in run(cfg, prompts):
         print(json.dumps({"tokens": seq}))
 

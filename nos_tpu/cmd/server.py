@@ -2,7 +2,9 @@
 runs: the continuous-batching engine (models/serving.py) behind a
 minimal HTTP API.
 
-    POST /v1/generate   {"prompt": [ids], "max_new_tokens": N}
+    POST /v1/generate   {"prompt": [ids], "max_new_tokens": N,
+                         "temperature": T?, "top_k": K?, "top_p": P?,
+                         "seed": S?}
                         -> {"tokens": [full sequence]}
     GET  /healthz       -> ok
 
@@ -122,11 +124,12 @@ class ServingLoop:
                         self.m_abandoned.inc()
                 self._work.notify_all()     # wake waiters to check results
 
-    def generate(self, prompt, max_new_tokens, timeout: float = 300.0):
+    def generate(self, prompt, max_new_tokens, timeout: float = 300.0,
+                 **sampling):
         with self._work:
             if self._failed is not None:
                 raise RuntimeError(f"serving loop failed: {self._failed}")
-            rid = self.engine.submit(prompt, max_new_tokens)
+            rid = self.engine.submit(prompt, max_new_tokens, **sampling)
             self._work.notify_all()
             deadline = time.monotonic() + timeout
             while True:
@@ -207,12 +210,24 @@ def make_http_server(cfg: ServerConfig, loop: ServingLoop
                 prompt = [int(t) for t in body["prompt"]]
                 n = int(body.get("max_new_tokens",
                                  cfg.default_max_new_tokens))
-                tokens = loop.generate(prompt, n)
+                sampling = {}
+                if "temperature" in body:
+                    sampling["temperature"] = float(body["temperature"])
+                if "top_k" in body:
+                    sampling["top_k"] = int(body["top_k"])
+                if "top_p" in body:
+                    sampling["top_p"] = float(body["top_p"])
+                if "seed" in body:
+                    sampling["seed"] = int(body["seed"])
+                tokens = loop.generate(prompt, n, **sampling)
             except (KeyError, ValueError, TypeError) as e:
                 self._reply(400, {"error": f"{type(e).__name__}: {e}"})
                 return
             except TimeoutError as e:
                 self._reply(503, {"error": str(e)})
+                return
+            except Exception as e:  # decode-loop death → JSON 500, not a dropped conn
+                self._reply(500, {"error": f"{type(e).__name__}: {e}"})
                 return
             self._reply(200, {"tokens": tokens})
 

@@ -153,33 +153,50 @@ def _truncate_logits(logits: jax.Array, top_k: int, top_p: float) -> jax.Array:
     """Mask logits outside the top-k set and/or the top-p nucleus of the
     distribution ``softmax(logits)`` — callers pass ALREADY-TEMPERED
     logits so the nucleus covers the distribution actually sampled from.
-    One sort serves both filters (static-shape; [B, vocab] is tiny next
-    to the decode matmuls). No-op when both are unset."""
-    neg = jnp.finfo(logits.dtype).min
+    Scalar ``top_k``/``top_p`` shared by every row; thin shape adapter
+    over ``_truncate_logits_rows`` (ONE implementation of the sequential
+    top-k-then-nucleus semantics). No-op when both are unset."""
     do_k = 0 < top_k < logits.shape[-1]
     do_p = 0.0 < top_p < 1.0
     if not (do_k or do_p):
         return logits
+    shape = logits.shape
+    flat = logits.reshape(-1, shape[-1])
+    b = flat.shape[0]
+    out = _truncate_logits_rows(
+        flat, jnp.full((b,), top_k, jnp.int32),
+        jnp.full((b,), top_p, jnp.float32))
+    return out.reshape(shape)
+
+
+def _truncate_logits_rows(logits: jax.Array, top_k: jax.Array,
+                          top_p: jax.Array) -> jax.Array:
+    """Per-ROW top-k/top-p truncation: ``top_k`` [B] int32 (0 = off) and
+    ``top_p`` [B] float (outside (0,1) = off) vary by row — the
+    continuous-batching case, where every slot carries its own sampling
+    params but must share ONE compiled decode program. Same sequential
+    semantics as ``_truncate_logits`` (top-k first, then the nucleus of
+    what's left); rows with both filters off pass through unchanged."""
+    b, v = logits.shape
+    neg = jnp.finfo(logits.dtype).min
+    k_eff = jnp.where((top_k > 0) & (top_k < v), top_k, v)      # [B]
+    # off-rows get threshold 2.0 (not 1.0): cumsum float error must
+    # never drop the least-likely token of an untruncated row
+    p_eff = jnp.where((top_p > 0.0) & (top_p < 1.0), top_p, 2.0)
     sorted_desc = jnp.sort(logits, axis=-1)[..., ::-1]
-    if do_k:
-        # sequential semantics (top-k first, then nucleus of what's left)
-        sorted_desc = jnp.where(
-            jnp.arange(sorted_desc.shape[-1]) < top_k, sorted_desc, neg)
-        logits = jnp.where(logits >= sorted_desc[..., top_k - 1][..., None],
-                           logits, neg)
-    if do_p:
-        probs = jax.nn.softmax(sorted_desc, axis=-1)
-        cum = jnp.cumsum(probs, axis=-1)
-        # keep every token whose PRECEDING cumulative mass is < top_p, so
-        # the nucleus always includes the first token past the threshold
-        keep = jnp.concatenate(
-            [jnp.zeros_like(cum[..., :1]), cum[..., :-1]], axis=-1) < top_p
-        # the nucleus is everything at or above the SMALLEST kept logit
-        cutoff = jnp.min(
-            jnp.where(keep, sorted_desc, jnp.finfo(logits.dtype).max),
-            axis=-1, keepdims=True)
-        logits = jnp.where(logits >= cutoff, logits, neg)
-    return logits
+    sorted_desc = jnp.where(
+        jnp.arange(v)[None, :] < k_eff[:, None], sorted_desc, neg)
+    kth = jnp.take_along_axis(sorted_desc, k_eff[:, None] - 1, axis=-1)
+    logits = jnp.where(logits >= kth, logits, neg)
+    probs = jax.nn.softmax(sorted_desc, axis=-1)
+    cum = jnp.cumsum(probs, axis=-1)
+    keep = jnp.concatenate(
+        [jnp.zeros_like(cum[..., :1]), cum[..., :-1]], axis=-1) \
+        < p_eff[:, None]
+    cutoff = jnp.min(
+        jnp.where(keep, sorted_desc, jnp.finfo(logits.dtype).max),
+        axis=-1, keepdims=True)
+    return jnp.where(logits >= cutoff, logits, neg)
 
 
 def generate(

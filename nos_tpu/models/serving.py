@@ -18,8 +18,13 @@ in-graph, and the host syncs ONE small array per tick.
 
 This is deliberately an in-process engine, not an RPC server: the
 operator stack schedules pods; what runs inside a serving pod is this
-loop. Greedy decoding (the exactness-testable core); sampling belongs to
-the single-request ``generate`` path.
+loop. Every slot carries its own sampling params (temperature / top-k /
+top-p / seed) as per-row vectors through the ONE compiled decode
+program; a request's sample stream is keyed by (seed, absolute
+position), so what a request generates is INDEPENDENT of batch
+composition — sampled alone or wedged between seven neighbours, same
+seed gives the same tokens (tested). Greedy rows (temperature 0) stay
+bit-identical to ``generate``.
 """
 from __future__ import annotations
 
@@ -31,7 +36,9 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from nos_tpu.models.generate import forward_with_cache, init_cache
+from nos_tpu.models.generate import (
+    _truncate_logits_rows, forward_with_cache, init_cache,
+)
 from nos_tpu.models.transformer import Params, TransformerConfig
 
 __all__ = ["DecodeServer"]
@@ -49,6 +56,10 @@ class _Request:
     rid: int
     prompt: List[int]
     max_new_tokens: int
+    temperature: float = 0.0
+    top_k: int = 0
+    top_p: float = 0.0
+    seed: int = 0
     out: List[int] = field(default_factory=list)
     slot: int = -1
 
@@ -58,15 +69,18 @@ class _Request:
 
 
 class DecodeServer:
-    """Greedy continuous-batching engine over ``max_batch`` cache slots.
+    """Continuous-batching engine over ``max_batch`` cache slots.
 
     ``submit`` enqueues a request (admitted to a free slot immediately or
     when one frees); ``step`` decodes one token for every active slot;
     ``drain`` runs to completion and returns {request_id: full token
     list} for the requests completed since the last drain (and clears
     them — a long-lived serving pod must not accumulate results).
-    Output per request is bit-identical to
-    ``generate(params, cfg, prompt, max_new_tokens)``.
+    Greedy requests (temperature 0, the default) are bit-identical to
+    ``generate(params, cfg, prompt, max_new_tokens)``; sampled requests
+    carry per-slot temperature/top-k/top-p/seed through the shared
+    decode program, with a (seed, position)-keyed stream that is
+    invariant to batch composition.
     """
 
     def __init__(self, params: Params, cfg: TransformerConfig,
@@ -83,18 +97,41 @@ class DecodeServer:
         self._done: Dict[int, _Request] = {}
         self._last = jnp.zeros((max_batch, 1), jnp.int32)
         self._next_rid = 0
+        # per-slot sampling params, rows of the compiled decode program
+        self._temp = jnp.zeros((max_batch,), jnp.float32)
+        self._topk = jnp.zeros((max_batch,), jnp.int32)
+        self._topp = jnp.zeros((max_batch,), jnp.float32)
+        self._seed = jnp.zeros((max_batch,), jnp.uint32)
 
-        def decode(p, toks, cache, keep):
-            # one fused program: forward, next-token argmax, inactive
-            # rows' pos frozen, next feed tokens — cache donated
+        def decode(p, toks, cache, keep, temp, topk, topp, seeds,
+                   sampling: bool):
+            # one fused program: forward, per-row sample-or-argmax,
+            # inactive rows' pos frozen, next feed tokens — cache
+            # donated. ``sampling`` is static: a greedy-only tick (every
+            # active slot at temperature 0 — the host knows) compiles
+            # WITHOUT the vocab-wide sort/softmax/RNG machinery
             pos0 = cache["pos"]
             logits, cache = forward_with_cache(p, cfg, toks, cache)
             cache["pos"] = jnp.where(keep, cache["pos"], pos0)
-            nxt = jnp.argmax(logits[:, -1], axis=-1)
+            step = logits[:, -1]                            # [B, vocab]
+            nxt = jnp.argmax(step, axis=-1)
+            if sampling:
+                # the token being produced sits at absolute index
+                # pos0 + 1: (seed, index) keys the stream, so a slot's
+                # samples don't depend on who else is in the batch
+                keys = jax.vmap(
+                    lambda s, i: jax.random.fold_in(
+                        jax.random.PRNGKey(s), i)
+                )(seeds, pos0 + 1)
+                trunc = _truncate_logits_rows(
+                    step / jnp.maximum(temp, 1e-6)[:, None], topk, topp)
+                sampled = jax.vmap(jax.random.categorical)(keys, trunc)
+                nxt = jnp.where(temp > 0, sampled, nxt)
             new_last = jnp.where(keep[:, None], nxt[:, None], toks)
             return nxt, new_last, cache
 
-        self._decode = jax.jit(decode, donate_argnums=(2,))
+        self._decode = jax.jit(decode, donate_argnums=(2,),
+                               static_argnums=(8,))
 
         def prefill(p, toks, row_cache):
             return forward_with_cache(p, cfg, toks, row_cache)
@@ -115,7 +152,14 @@ class DecodeServer:
         self._install = jax.jit(install, donate_argnums=(0,))
 
     # ------------------------------------------------------------------
-    def submit(self, prompt: List[int], max_new_tokens: int) -> int:
+    def submit(self, prompt: List[int], max_new_tokens: int, *,
+               temperature: float = 0.0, top_k: int = 0,
+               top_p: float = 0.0, seed: Optional[int] = None) -> int:
+        """Enqueue a request. ``temperature`` 0 = greedy (bit-identical to
+        ``generate``); > 0 samples, optionally truncated per-request by
+        ``top_k``/``top_p``. ``seed`` keys the request's sample stream
+        (default: the request id) — same (prompt, params, seed) always
+        yields the same tokens, whatever else shares the batch."""
         if not prompt:
             raise ValueError("empty prompt")
         if max_new_tokens < 1:
@@ -124,9 +168,21 @@ class DecodeServer:
             raise ValueError(
                 f"prompt ({len(prompt)}) + max_new_tokens "
                 f"({max_new_tokens}) exceeds cache length {self.max_len}")
+        if temperature <= 0 and (top_k or top_p):
+            raise ValueError(
+                "top_k/top_p only apply when sampling — set temperature "
+                "> 0 (greedy decoding ignores truncation)")
+        if top_k < 0 or not (0.0 <= top_p <= 1.0):
+            raise ValueError(
+                f"top_k must be >= 0 and top_p in [0, 1]: got "
+                f"top_k={top_k}, top_p={top_p}")
         rid = self._next_rid
         self._next_rid += 1
-        self._pending.append(_Request(rid, list(prompt), max_new_tokens))
+        self._pending.append(_Request(
+            rid, list(prompt), max_new_tokens,
+            temperature=float(temperature), top_k=int(top_k),
+            top_p=float(top_p),
+            seed=(rid if seed is None else int(seed)) & 0xFFFFFFFF))
         self._admit()
         return rid
 
@@ -159,7 +215,24 @@ class DecodeServer:
             "pos": jnp.zeros((), jnp.int32),
         }
         logits, row = self._prefill(self.params, toks, row)
-        first = int(jnp.argmax(logits[0, plen - 1]))
+        step = logits[0, plen - 1]
+        if req.temperature > 0:
+            # token at absolute index plen: same (seed, index) keying as
+            # the decode program, so prefill vs decode is seamless
+            key = jax.random.fold_in(
+                jax.random.PRNGKey(jnp.uint32(req.seed)), plen)
+            trunc = _truncate_logits_rows(
+                (step / max(req.temperature, 1e-6))[None, :],
+                jnp.asarray([req.top_k], jnp.int32),
+                jnp.asarray([req.top_p], jnp.float32))
+            first = int(jax.random.categorical(key, trunc[0]))
+        else:
+            first = int(jnp.argmax(step))
+        s = req.slot
+        self._temp = self._temp.at[s].set(req.temperature)
+        self._topk = self._topk.at[s].set(req.top_k)
+        self._topp = self._topp.at[s].set(req.top_p)
+        self._seed = self._seed.at[s].set(req.seed)
         # padding garbage past plen stays masked until overwritten: only
         # pos decides what exists
         self.cache, self._last = self._install(
@@ -188,8 +261,10 @@ class DecodeServer:
         active = sorted(self._active)
         keep = jnp.zeros((self.max_batch,), bool).at[
             jnp.asarray(active, jnp.int32)].set(True)
+        sampling = any(self._active[s].temperature > 0 for s in active)
         nxt, self._last, self.cache = self._decode(
-            self.params, self._last, self.cache, keep)
+            self.params, self._last, self.cache, keep,
+            self._temp, self._topk, self._topp, self._seed, sampling)
         nxt_host = np.asarray(nxt)          # ONE device->host sync
         emitted = 0
         for s in active:

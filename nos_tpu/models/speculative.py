@@ -10,9 +10,18 @@ while the first rejected position still yields the target's own token —
 output is EXACTLY what plain greedy decoding of the target would
 produce, just cheaper when the draft is any good.
 
-Greedy-only by design: greedy acceptance (`draft token == target
-argmax`) keeps the equivalence bit-exact and testable; the
-rejection-sampling generalization for temperature > 0 is out of scope.
+Two acceptance regimes, both EXACT w.r.t. the target model:
+
+- **Greedy** (temperature 0): accept while ``draft token == target
+  argmax`` — output is bit-identical to plain greedy decoding.
+- **Speculative sampling** (temperature > 0): the standard
+  accept-reject rule — accept draft token x_i with probability
+  ``min(1, p_i(x_i) / q_i(x_i))`` (p = target, q = draft, both
+  tempered and top-k/top-p-truncated the same way ``generate`` does);
+  on the first rejection, emit a sample from the normalized residual
+  ``max(p_i - q_i, 0)``. Each committed token is distributed exactly
+  as target-only sampling (property-tested against the analytically
+  computed target distribution).
 
 Batched rounds advance UNIFORMLY by the minimum acceptance across rows
 (plus the verified correction token): rows that matched further simply
@@ -30,10 +39,28 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 
-from nos_tpu.models.generate import forward_with_cache, init_cache
+from nos_tpu.models.generate import (
+    _truncate_logits, forward_with_cache, init_cache,
+)
 from nos_tpu.models.transformer import Params, TransformerConfig
 
 __all__ = ["speculative_generate"]
+
+
+def _dist(logits: jax.Array, temperature: float, top_k: int,
+          top_p: float) -> jax.Array:
+    """Tempered + truncated sampling distribution [..., vocab] — the
+    distribution ``generate`` actually samples from, applied identically
+    to draft and target so the accept-reject identity holds."""
+    return jax.nn.softmax(
+        _truncate_logits(logits / temperature, top_k, top_p), axis=-1)
+
+
+def _sample_rows(key: jax.Array, probs: jax.Array) -> jax.Array:
+    """Categorical over explicit probabilities [B, vocab] -> [B]."""
+    logp = jnp.where(probs > 0, jnp.log(jnp.maximum(probs, 1e-38)),
+                     -jnp.inf)
+    return jax.random.categorical(key, logp, axis=-1)
 
 
 @functools.lru_cache(maxsize=None)
@@ -56,13 +83,31 @@ def speculative_generate(
     *,
     n_draft: int = 4,
     max_len: Optional[int] = None,
+    temperature: float = 0.0,
+    top_k: int = 0,
+    top_p: float = 0.0,
+    rng: Optional[jax.Array] = None,
 ) -> jax.Array:
-    """Greedy speculative decoding. prompt [B, S] ->
-    [B, S + max_new_tokens], bit-identical to
-    ``generate(params, cfg, prompt, max_new_tokens)``."""
+    """Speculative decoding. prompt [B, S] -> [B, S + max_new_tokens].
+    Temperature 0 (default): bit-identical to
+    ``generate(params, cfg, prompt, max_new_tokens)``. Temperature > 0:
+    accept-reject speculative sampling — every emitted token is
+    distributed exactly as ``generate(..., temperature, top_k, top_p)``
+    samples it (see module docstring)."""
     b, s = prompt.shape
     if max_new_tokens <= 0:
         return prompt
+    if temperature > 0 and rng is None:
+        raise ValueError("temperature sampling needs an rng key")
+    if temperature <= 0 and (top_k or top_p):
+        raise ValueError(
+            "top_k/top_p only apply when sampling — set temperature > 0 "
+            "(greedy decoding ignores truncation)")
+    if top_k < 0 or not (0.0 <= top_p <= 1.0):
+        raise ValueError(
+            f"top_k must be >= 0 and top_p in [0, 1]: got "
+            f"top_k={top_k}, top_p={top_p}")
+    sampling = temperature > 0
     max_len = max_len or min(cfg.max_seq, draft_cfg.max_seq)
     # headroom: a round may write up to k speculative positions past the
     # accepted prefix before rolling back
@@ -88,29 +133,53 @@ def speculative_generate(
     produced = 0
     while produced < max_new_tokens:
         base = int(t_cache["pos"])
+        if sampling:
+            rng, kd, kacc, kres = jax.random.split(rng, 4)
+            dkeys = jax.random.split(kd, k)
 
         # 1. draft proposes k tokens autoregressively from `last`
-        drafts = []
+        # (argmax when greedy; a draw from q_i = tempered+truncated
+        # draft distribution when sampling, with q_i recorded)
+        drafts, qs = [], []
         tok = last
-        for _ in range(k):
+        for i in range(k):
             logits, d_cache = d_step(draft_params, tok, d_cache)
-            tok = jnp.argmax(logits[:, -1], axis=-1)[:, None]
+            if sampling:
+                q = _dist(logits[:, -1], temperature, top_k, top_p)
+                tok = _sample_rows(dkeys[i], q)[:, None]
+                qs.append(q)
+            else:
+                tok = jnp.argmax(logits[:, -1], axis=-1)[:, None]
             drafts.append(tok)
         proposed = jnp.concatenate(drafts, axis=1)          # [B, k]
 
-        # 2. target verifies in ONE pass: greedy[:, i] is the target's
-        # token after feed[:, i], i.e. its verdict on proposed[:, i]
+        # 2. target verifies in ONE pass: position i of the output is
+        # the target's distribution after feed[:, i], i.e. its verdict
+        # on proposed[:, i]
         feed = jnp.concatenate([last, proposed[:, :-1]], axis=1)
         logits, t_cache = t_step(params, feed, t_cache)
-        greedy = jnp.argmax(logits, axis=-1)                # [B, k]
 
-        # 3. uniform advance: min over rows of the longest matching
-        # prefix, plus the verified token at that position (for rows that
-        # matched further, proposed == greedy there, so the "correction"
-        # is their accepted token — every emitted token is target-greedy)
-        match = proposed == greedy
+        # 3. per-row, per-position acceptance:
+        #    greedy:   accept while proposed == target argmax
+        #    sampling: accept x_i w.p. min(1, p_i(x_i)/q_i(x_i))
+        if sampling:
+            p = _dist(logits, temperature, top_k, top_p)    # [B, k, V]
+            q = jnp.stack(qs, axis=1)                       # [B, k, V]
+            px = jnp.take_along_axis(p, proposed[..., None], -1)[..., 0]
+            qx = jnp.take_along_axis(q, proposed[..., None], -1)[..., 0]
+            u = jax.random.uniform(kacc, (b, k))
+            accept = u * qx < px        # u < px/qx, div-free
+        else:
+            greedy = jnp.argmax(logits, axis=-1)            # [B, k]
+            accept = proposed == greedy
+
+        # 4. uniform advance: min over rows of the longest accepted
+        # prefix, plus a correction token at that position — the
+        # target's own token (greedy) or a residual draw (sampling);
+        # rows that accepted further commit their accepted token there
+        # and simply re-propose the discarded tail next round
         accepted = jnp.argmin(
-            jnp.concatenate([match, jnp.zeros((b, 1), bool)], axis=1),
+            jnp.concatenate([accept, jnp.zeros((b, 1), bool)], axis=1),
             axis=1)
         min_a = int(jnp.min(accepted))
         if min_a == k:                                      # full accept
@@ -118,9 +187,20 @@ def speculative_generate(
             last = proposed[:, -1:]
             # caches processed exactly feed = seq[:-1]: invariant holds
         else:
-            new = jnp.concatenate(
-                [proposed[:, :min_a], greedy[:, min_a:min_a + 1]], axis=1)
-            last = greedy[:, min_a:min_a + 1]
+            if sampling:
+                # first rejection → sample the normalized residual
+                # max(p - q, 0); if p ≡ q (residual empty — can only be
+                # approached numerically, rejection prob → 0) fall back
+                # to p itself
+                resid = jnp.maximum(p[:, min_a] - q[:, min_a], 0.0)
+                norm = jnp.sum(resid, axis=-1, keepdims=True)
+                resid = jnp.where(norm > 0, resid / norm, p[:, min_a])
+                corr = jnp.where(accept[:, min_a], proposed[:, min_a],
+                                 _sample_rows(kres, resid))[:, None]
+            else:
+                corr = greedy[:, min_a:min_a + 1]
+            new = jnp.concatenate([proposed[:, :min_a], corr], axis=1)
+            last = corr
             # roll speculation back to the accepted prefix: positions
             # base..base+min_a hold [last, d1..d_min_a] — all part of the
             # new sequence[:-1] — so processed count is base + min_a + 1

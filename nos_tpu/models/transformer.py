@@ -259,7 +259,13 @@ def lm_head_loss(norm_w, unembed, hidden, targets, loss_chunk: int = 0):
     matmul, ~2% of step FLOPs)."""
     hidden = rms_norm(hidden, norm_w)
     b, s, _ = hidden.shape
-    if loss_chunk and s > loss_chunk and s % loss_chunk == 0:
+    if loss_chunk and s > loss_chunk and s % loss_chunk != 0:
+        raise ValueError(
+            f"loss_chunk={loss_chunk} does not divide seq_len={s}; "
+            f"chunking would be silently disabled and the full fp32 "
+            f"[B,S,vocab] logits materialised — pick a divisor of the "
+            f"sequence length")
+    if loss_chunk and s > loss_chunk:
         n = s // loss_chunk
         xs = hidden.reshape(b, n, loss_chunk, -1).swapaxes(0, 1)
         ts = targets.reshape(b, n, loss_chunk).swapaxes(0, 1)

@@ -115,10 +115,14 @@ def arrange_devices(devices: Sequence, sizes: Sequence[int],
                 break
             taken[sid] = take
             ordered.extend(_snake_order(groups[sid])[:take])
-        if len(set(taken.values())) == 1 and len(taken) > 1:
-            # every used slice contributes equally: the slice boundary
-            # falls on fixed strides — enforce DCN/ICI alignment
-            n_slices = len(taken)
+        if len(taken) > 1:
+            # DCN/ICI alignment: after the reshape, the model axes span
+            # contiguous runs of ``n // data`` devices (``data`` = product
+            # of leading dp/fsdp axes). Every slice boundary must land on
+            # a multiple of that stride, or a model-axis collective
+            # silently crosses DCN. Checking the cumulative offsets
+            # covers unequal per-slice contributions too (e.g. a partial
+            # last slice after truncation).
             if names is not None:
                 data = 1
                 for name, size in zip(names, sizes):
@@ -127,13 +131,20 @@ def arrange_devices(devices: Sequence, sizes: Sequence[int],
                     data *= size
             else:
                 data = sizes[0]
-            if data % n_slices != 0:
-                raise ValueError(
-                    f"the leading data axes (product {data}) must be "
-                    f"divisible by the slice count ({n_slices}) so "
-                    f"model-axis collectives stay on ICI — put dp/fsdp "
-                    f"totalling a multiple of {n_slices} outermost in "
-                    f"the ParallelLayout")
+            model_block = n // data if data else n
+            offset = 0
+            for sid in sorted(taken, key=str):
+                offset += taken[sid]
+                if offset < n and model_block and offset % model_block != 0:
+                    raise ValueError(
+                        f"slice boundary at device offset {offset} falls "
+                        f"inside a model-axis block of {model_block} "
+                        f"devices (leading data axes product {data}, "
+                        f"{len(taken)} slices contributing "
+                        f"{dict(taken)}): a tp/sp/ep/pp collective would "
+                        f"cross DCN — use whole slices of equal size, or "
+                        f"put dp/fsdp axes totalling a multiple of the "
+                        f"slice count outermost in the ParallelLayout")
     else:
         ordered = _snake_order(devices)[:n]
     return np.array(ordered[:n], dtype=object).reshape(tuple(sizes))

@@ -174,7 +174,7 @@ def test_multislice_inner_axes_never_cross_slice_boundary():
 
 def test_multislice_requires_divisible_data_axes():
     devs = two_slices()
-    with pytest.raises(ValueError, match="divisible by the slice count"):
+    with pytest.raises(ValueError, match="cross DCN"):
         arrange_devices(devs, (1, 8))       # outer=1 can't split 2 slices
     # axis-identity aware: a model-only layout (tp, pp) must not let tp
     # straddle DCN silently
@@ -204,14 +204,23 @@ def test_multislice_build_mesh_places_dp_across_dcn():
         {d.slice_index for d in arr[1]}
 
 
-def test_ragged_slices_best_effort_per_slice_snake():
+def test_ragged_slices_align_or_raise():
+    """Unequal per-slice contributions are fine only when every slice
+    boundary lands on a model-block stride; otherwise a model-axis
+    collective would silently cross DCN — raise instead (advisor r3)."""
     devs = two_slices()[:6]                 # 4 + 2 chips: ragged
-    grid = arrange_devices(devs, (2, 3))
-    assert grid.shape == (2, 3)             # no crash, best-effort order
+    # (3, 2): model blocks of 2; the 4|2 boundary falls at offset 4 —
+    # aligned, so the ragged layout is accepted and slice-contiguous
+    grid = arrange_devices(devs, (3, 2))
+    assert grid.shape == (3, 2)
     flat = list(grid.ravel())
-    # whole slices consumed first, each snake-ordered: slice 0's four
-    # devices precede slice 1's two
     assert [d.slice_index for d in flat] == [0, 0, 0, 0, 1, 1]
+    for row in grid:                        # no row straddles DCN
+        assert len({d.slice_index for d in row}) == 1
+    # (2, 3): model blocks of 3; boundary at 4 falls mid-block — the
+    # middle row would straddle DCN: refuse
+    with pytest.raises(ValueError, match="cross DCN"):
+        arrange_devices(devs, (2, 3))
 
 
 def test_truncation_consumes_whole_slices_first():

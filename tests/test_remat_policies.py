@@ -93,3 +93,16 @@ def test_named_policies_save_less_than_dots():
 def test_unknown_policy_rejected():
     with pytest.raises(ValueError, match="remat_policy"):
         tr.TransformerConfig(**BASE, remat_policy="everything")
+
+
+def test_loss_chunk_must_divide_seq_len():
+    """A loss_chunk that doesn't divide S must raise, not silently
+    materialise the full [B, S, vocab] logits (advisor r3)."""
+    cfg = tr.TransformerConfig(**BASE, loss_chunk=7)
+    params = tr.init_params(jax.random.PRNGKey(0), cfg)
+    batch = {
+        "tokens": jnp.zeros((2, 32), jnp.int32),
+        "targets": jnp.zeros((2, 32), jnp.int32),
+    }
+    with pytest.raises(ValueError, match="loss_chunk"):
+        tr.loss_fn(params, cfg, batch)

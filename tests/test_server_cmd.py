@@ -151,3 +151,37 @@ def test_metrics_count_requests_and_tokens(served):
 
     assert val("nos_tpu_serve_requests_total") >= 1
     assert val("nos_tpu_serve_tokens_total") >= 2   # N-1 decode tokens
+
+
+def test_sampling_params_over_http(served):
+    """temperature/top_k/top_p/seed pass through to the engine: same
+    seed reproduces, different seed diverges, and concurrent sampled
+    requests don't perturb each other's streams."""
+    url, _, _ = served
+    body = {"prompt": [4, 5], "max_new_tokens": 8,
+            "temperature": 0.9, "top_k": 6, "seed": 77}
+    a = post(url, body)["tokens"]
+
+    results = {}
+
+    def worker(name, b):
+        results[name] = post(url, b)["tokens"]
+
+    ts = [threading.Thread(target=worker, args=("same", dict(body))),
+          threading.Thread(target=worker, args=(
+              "other", {"prompt": [9, 9, 9], "max_new_tokens": 6,
+                        "temperature": 1.1, "top_p": 0.8, "seed": 5}))]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    assert results["same"] == a
+    b2 = post(url, {**body, "seed": 78})["tokens"]
+    assert b2 != a
+
+
+def test_bad_sampling_params_rejected(served):
+    url, _, _ = served
+    with pytest.raises(urllib.error.HTTPError) as e:
+        post(url, {"prompt": [1], "max_new_tokens": 2, "top_k": 3})
+    assert e.value.code == 400

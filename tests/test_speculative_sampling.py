@@ -1,0 +1,140 @@
+"""Accept-reject speculative sampling (models/speculative.py,
+temperature > 0): every emitted token must be distributed EXACTLY as
+target-only sampling — verified against the analytically computed target
+distribution, not another sampler.
+
+Reference parity note: the reference repo has no generation path; this
+is the workload plane's exactness bar (SURVEY §2.7), mirroring the
+greedy bit-exactness suite in test_speculative.py.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from nos_tpu.models import transformer as tfm
+from nos_tpu.models.generate import _truncate_logits, generate
+from nos_tpu.models.speculative import speculative_generate
+
+VOCAB = 13          # small vocab -> tight empirical-distribution test
+
+
+def cfg_kw(**kw):
+    base = dict(vocab=VOCAB, d_model=16, n_layers=2, n_heads=2, d_ff=32,
+                max_seq=64, dtype=jnp.float32)
+    base.update(kw)
+    return tfm.TransformerConfig(**base)
+
+
+TARGET = cfg_kw()
+DRAFT = cfg_kw(d_model=8, n_layers=1, d_ff=16)
+PARAMS = tfm.init_params(jax.random.PRNGKey(0), TARGET)
+DRAFT_P = tfm.init_params(jax.random.PRNGKey(9), DRAFT)
+PROMPT_ROW = [1, 7, 3]
+
+
+def exact_next_dist(params, cfg, prompt_row, temperature, top_k=0,
+                    top_p=0.0):
+    """The closed-form distribution generate() samples the next token
+    from: softmax of the tempered, truncated last-position logits."""
+    from nos_tpu.models.generate import forward_with_cache, init_cache
+
+    prompt = jnp.asarray([prompt_row], jnp.int32)
+    cache = init_cache(cfg, 1, cfg.max_seq)
+    logits, _ = forward_with_cache(params, cfg, prompt, cache)
+    t = logits[0, -1] / temperature
+    return np.asarray(jax.nn.softmax(_truncate_logits(t, top_k, top_p)))
+
+
+def spec_first_token_counts(draft_p, draft_cfg, temperature, top_k=0,
+                            top_p=0.0, batches=8, rows=256):
+    """Empirical first-token distribution from speculative sampling:
+    ``rows`` identical prompts per call (independent streams), several
+    calls with fresh keys."""
+    prompt = jnp.tile(jnp.asarray([PROMPT_ROW], jnp.int32), (rows, 1))
+    counts = np.zeros(VOCAB)
+    for i in range(batches):
+        out = speculative_generate(
+            PARAMS, TARGET, draft_p, draft_cfg, prompt, 1, n_draft=4,
+            temperature=temperature, top_k=top_k, top_p=top_p,
+            rng=jax.random.PRNGKey(100 + i))
+        toks = np.asarray(out[:, len(PROMPT_ROW)])
+        counts += np.bincount(toks, minlength=VOCAB)
+    return counts / counts.sum()
+
+
+def tv(a, b):
+    return 0.5 * float(np.abs(np.asarray(a) - np.asarray(b)).sum())
+
+
+def test_distribution_matches_target_bad_draft():
+    """Draft disagrees often (both accept and reject paths hot): the
+    emitted-token distribution must still be the target's, exactly."""
+    p_exact = exact_next_dist(PARAMS, TARGET, PROMPT_ROW, 1.0)
+    freq = spec_first_token_counts(DRAFT_P, DRAFT, 1.0)
+    assert tv(freq, p_exact) < 0.07, (freq, p_exact)
+
+
+def test_distribution_matches_target_perfect_draft():
+    """Draft == target: acceptance prob 1 everywhere; still the target
+    distribution (and the residual fallback must not fire nonsense)."""
+    p_exact = exact_next_dist(PARAMS, TARGET, PROMPT_ROW, 0.7)
+    freq = spec_first_token_counts(PARAMS, TARGET, 0.7)
+    assert tv(freq, p_exact) < 0.07
+
+
+def test_distribution_matches_under_top_k_top_p():
+    """Truncation applies to draft and target alike; emitted tokens keep
+    the truncated target distribution and never leave its support."""
+    p_exact = exact_next_dist(PARAMS, TARGET, PROMPT_ROW, 1.0,
+                              top_k=5, top_p=0.9)
+    freq = spec_first_token_counts(DRAFT_P, DRAFT, 1.0, top_k=5,
+                                   top_p=0.9)
+    assert np.all(freq[p_exact == 0.0] == 0.0), "left the nucleus"
+    assert tv(freq, p_exact) < 0.07
+
+
+def test_multi_token_stays_in_truncated_support():
+    """Over a longer sampled generation every token must lie in the
+    target's truncated support given its own prefix (teacher-forced
+    replay)."""
+    from nos_tpu.models.generate import forward_with_cache, init_cache
+
+    prompt = jnp.asarray([PROMPT_ROW, [2, 2, 5]], jnp.int32)
+    out = speculative_generate(
+        PARAMS, TARGET, DRAFT_P, DRAFT, prompt, 8, n_draft=3,
+        temperature=0.8, top_k=4, rng=jax.random.PRNGKey(5))
+    out_np = np.asarray(out)
+    b, total = out_np.shape
+    cache = init_cache(TARGET, b, TARGET.max_seq)
+    logits, _ = forward_with_cache(PARAMS, TARGET, out, cache)
+    for pos in range(prompt.shape[1] - 1, total - 1):
+        step = logits[:, pos] / 0.8
+        allowed = np.asarray(_truncate_logits(step, 4, 0.0))
+        for r in range(b):
+            tok = out_np[r, pos + 1]
+            assert allowed[r, tok] > np.finfo(np.float32).min, (
+                f"row {r} pos {pos + 1}: token {tok} outside top-4")
+
+
+def test_rng_required_and_param_validation():
+    prompt = jnp.asarray([PROMPT_ROW], jnp.int32)
+    with pytest.raises(ValueError, match="rng"):
+        speculative_generate(PARAMS, TARGET, DRAFT_P, DRAFT, prompt, 4,
+                             temperature=0.5)
+    with pytest.raises(ValueError, match="top_k/top_p"):
+        speculative_generate(PARAMS, TARGET, DRAFT_P, DRAFT, prompt, 4,
+                             top_k=3)
+    with pytest.raises(ValueError, match="top_p"):
+        speculative_generate(PARAMS, TARGET, DRAFT_P, DRAFT, prompt, 4,
+                             temperature=0.5, top_p=1.5,
+                             rng=jax.random.PRNGKey(0))
+
+
+def test_sampling_is_deterministic_given_key():
+    prompt = jnp.asarray([PROMPT_ROW], jnp.int32)
+    a = speculative_generate(PARAMS, TARGET, DRAFT_P, DRAFT, prompt, 6,
+                             temperature=0.9, rng=jax.random.PRNGKey(3))
+    b = speculative_generate(PARAMS, TARGET, DRAFT_P, DRAFT, prompt, 6,
+                             temperature=0.9, rng=jax.random.PRNGKey(3))
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
