@@ -56,3 +56,12 @@ helm-template:  ## Render the chart (requires helm).
 .PHONY: help
 help:
 	@grep -E '^[a-zA-Z_-]+:.*?## ' $(MAKEFILE_LIST) | awk 'BEGIN {FS = ":.*?## "}; {printf "  %-14s %s\n", $$1, $$2}'
+
+.PHONY: lint
+lint:  ## Static checks: ruff when available, byte-compile otherwise.
+	@if $(PYTHON) -m ruff --version >/dev/null 2>&1; then \
+		$(PYTHON) -m ruff check nos_tpu tests $(wildcard *.py); \
+	else \
+		echo "ruff not installed; falling back to compileall"; \
+		$(PYTHON) -m compileall -q nos_tpu tests $(wildcard *.py); \
+	fi

@@ -76,7 +76,8 @@ def _snake_order(devices: Sequence) -> Sequence:
 
 
 def arrange_devices(devices: Sequence, sizes: Sequence[int],
-                    names: Optional[Sequence[str]] = None) -> np.ndarray:
+                    names: Optional[Sequence[str]] = None,
+                    slice_ids: Optional[Sequence[int]] = None) -> np.ndarray:
     """Arrange ``prod(sizes)`` devices into an ndarray of shape ``sizes``
     such that, when physical coords are available, devices adjacent along
     the innermost axis are one torus hop apart (see module docstring).
@@ -92,17 +93,28 @@ def arrange_devices(devices: Sequence, sizes: Sequence[int],
     align (validated when ``names`` — the mesh axis names — are given;
     without names the outermost axis stands in for "data"). When more
     devices than needed are offered, whole slices are consumed first so
-    the truncation itself cannot split a slice."""
+    the truncation itself cannot split a slice.
+
+    ``slice_ids`` (aligned with ``devices``) overrides per-device
+    ``slice_index`` attributes — for runtimes that expose slice identity
+    out-of-band (e.g. megascale env vars) and for dry-running multislice
+    layouts on devices that carry no slice attribute."""
     n = 1
     for s in sizes:
         n *= s
     devices = list(devices)
     if len(devices) < n:
         raise ValueError(f"need {n} devices, got {len(devices)}")
+    if slice_ids is not None and len(slice_ids) != len(devices):
+        raise ValueError(
+            f"slice_ids ({len(slice_ids)}) must align with devices "
+            f"({len(devices)})")
 
     groups: dict = {}
-    for d in devices:
-        groups.setdefault(getattr(d, "slice_index", None), []).append(d)
+    for i, d in enumerate(devices):
+        sid = (slice_ids[i] if slice_ids is not None
+               else getattr(d, "slice_index", None))
+        groups.setdefault(sid, []).append(d)
 
     if len(groups) > 1:
         # consume whole slices first (sorted for determinism) so
@@ -150,7 +162,8 @@ def arrange_devices(devices: Sequence, sizes: Sequence[int],
     return np.array(ordered[:n], dtype=object).reshape(tuple(sizes))
 
 
-def build_mesh(layout: ParallelLayout, devices: Optional[Sequence] = None) -> Mesh:
+def build_mesh(layout: ParallelLayout, devices: Optional[Sequence] = None,
+               slice_ids: Optional[Sequence[int]] = None) -> Mesh:
     devices = list(devices if devices is not None else jax.devices())
     if layout.chips > len(devices):
         raise ValueError(
@@ -158,7 +171,7 @@ def build_mesh(layout: ParallelLayout, devices: Optional[Sequence] = None) -> Me
         )
     names = layout.axis_names()
     sizes = layout.axis_sizes()
-    return Mesh(arrange_devices(devices, sizes, names), names)
+    return Mesh(arrange_devices(devices, sizes, names, slice_ids), names)
 
 
 def data_sharding(mesh: Mesh) -> NamedSharding:

@@ -227,3 +227,26 @@ def test_truncation_consumes_whole_slices_first():
     devs = two_slices()                     # 2 slices x 4 chips, need 4
     grid = arrange_devices(devs, (2, 2))
     assert {d.slice_index for d in grid.ravel()} == {0}
+
+
+def test_slice_ids_override_builds_multislice_from_plain_devices():
+    """slice_ids fabricates slice identity for devices that carry no
+    slice_index attribute (CPU dryruns, megascale env-var runtimes):
+    same DCN-boundary guarantees as attribute-carrying devices."""
+    class Plain:
+        def __init__(self, i):
+            self.id = i
+
+        def __repr__(self):
+            return f"Plain({self.id})"
+
+    devs = [Plain(i) for i in range(8)]
+    grid = arrange_devices(devs, (2, 2, 2), names=("dp", "tp", "sp"),
+                           slice_ids=[i // 4 for i in range(8)])
+    for r in range(2):                      # dp rows slice-contiguous
+        ids = {d.id // 4 for d in grid[r].ravel()}
+        assert len(ids) == 1
+    with pytest.raises(ValueError, match="cross DCN"):
+        arrange_devices(devs, (1, 8), slice_ids=[i // 4 for i in range(8)])
+    with pytest.raises(ValueError, match="align"):
+        arrange_devices(devs, (2, 4), slice_ids=[0, 1])
