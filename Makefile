@@ -65,3 +65,7 @@ lint:  ## Static checks: ruff when available, byte-compile otherwise.
 		echo "ruff not installed; falling back to compileall"; \
 		$(PYTHON) -m compileall -q nos_tpu tests $(wildcard *.py); \
 	fi
+
+.PHONY: bench-hw
+bench-hw:  ## Full hardware publish sequence (attn -> sweep -> bench -> decode/serve), journaled to BENCH_HW/.
+	hack/bench_hw.sh

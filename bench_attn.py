@@ -6,7 +6,11 @@ decided by measurement, not vibes. Timing fence is the host transfer
 (block_until_ready lies on 'axon' — see bench_mfu.py).
 
 Usage: python bench_attn.py [reps]
-Env: NOS_TPU_SPLASH_* block-size overrides are honored (ops/attention.py).
+Env: NOS_TPU_SPLASH_* block-size overrides are honored (ops/attention.py);
+NOS_TPU_ATTN_ONLY=<impl> restricts to one implementation so an
+orchestrator can isolate each kernel in its own process (a wedged Mosaic
+compile then kills one point, not the whole comparison — the round-3
+outage playbook).
 Prints one JSON line per impl.
 """
 import json
@@ -36,7 +40,8 @@ def main():
     k = jax.random.normal(ks[1], (b, kv, s, d), jnp.bfloat16)
     v = jax.random.normal(ks[2], (b, kv, s, d), jnp.bfloat16)
 
-    impls = ["splash", "flash", "xla"]
+    only = os.environ.get("NOS_TPU_ATTN_ONLY", "")
+    impls = [only] if only else ["splash", "flash", "xla"]
     for impl in impls:
         os.environ["NOS_TPU_ATTN_IMPL"] = impl
         eff = at.effective_impl(q.shape, k.shape)

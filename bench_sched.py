@@ -277,11 +277,23 @@ def run_scale():
     def q(xs, p):
         return statistics.quantiles(xs, n=100)[p - 1] if len(xs) > 1 else xs[0]
 
+    # submit->bind latency under a 500-pod BURST mixes queue wait with
+    # scheduling work: the p99 pod mostly *waited in line*. The
+    # inter-bind gap (service time per pod, gang placements amortized
+    # over their members) is the per-pod cost the scheduler actually
+    # controls — published separately so the tail is attributable
+    # (VERDICT r3 weak #6).
+    ts = sorted(bind_t.values())
+    gaps = [b - a for a, b in zip(ts, ts[1:])]
     return {
         "scale_nodes": 1024,
         "scale_pods": len(pods),
         "scale_p50_s": round(q(lat, 50), 6) if lat else None,
         "scale_p99_s": round(q(lat, 99), 6) if lat else None,
+        "scale_service_p50_ms": round(q(gaps, 50) * 1e3, 3) if gaps else None,
+        "scale_service_p99_ms": round(q(gaps, 99) * 1e3, 3) if gaps else None,
+        "scale_burst_wall_s": round(ts[-1] - min(submit_t.values()), 3)
+        if ts else None,
         "scale_unbound_pods": unbound,
     }
 
@@ -302,12 +314,20 @@ def main():
             (sub_lat if ns == "team-sub" else gang_lat).append(v)
     wall = time.perf_counter() - t_start
 
-    # over-the-wire rep: one pass (68 pods x 65 nodes over real HTTP)
+    # over-the-wire reps (68 pods x 65 nodes over real HTTP each): three
+    # passes so the published wire p99 rests on ~200 samples, not 68
+    # (VERDICT r3 weak #6)
+    wire_reps = 3
     wire_gang, wire_sub = [], []
-    wire_lat, wire_unbound, wire_util = run_once_wire()
-    for (ns, name), v in wire_lat.items():
-        if v is not None:
-            (wire_sub if ns == "team-sub" else wire_gang).append(v)
+    wire_unbound_per_rep, wire_utils = [], []
+    for _ in range(wire_reps):
+        wire_lat, wu, wutil = run_once_wire()
+        wire_unbound_per_rep.append(len(wu))
+        wire_utils.append(wutil)
+        for (ns, name), v in wire_lat.items():
+            if v is not None:
+                (wire_sub if ns == "team-sub" else wire_gang).append(v)
+    wire_util = sum(wire_utils) / len(wire_utils)
 
     def q(xs, p):
         return statistics.quantiles(xs, n=100)[p - 1] if len(xs) > 1 else xs[0]
@@ -332,7 +352,8 @@ def main():
         "wire_gang_p50_s": round(q(wire_gang, 50), 6) if wire_gang else None,
         "wire_gang_p99_s": round(q(wire_gang, 99), 6) if wire_gang else None,
         "wire_subslice_p50_s": round(q(wire_sub, 50), 6) if wire_sub else None,
-        "wire_unbound_pods": len(wire_unbound),
+        "wire_unbound_pods": max(wire_unbound_per_rep),
+        "wire_reps": wire_reps,
         "wire_allocated_chip_utilization": round(wire_util, 4),
         # 1024-node / 500-pod event-economics point (watch-fed cache)
         **run_scale(),

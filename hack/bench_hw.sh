@@ -1,0 +1,91 @@
+#!/usr/bin/env bash
+# Full hardware publish sequence — run the moment the TPU tunnel answers
+# (BASELINE.md round-3 status: attn kernel pick -> policy/batch/loss-chunk
+# sweep -> decode/serve/infer -> final artifact). Every step runs in its
+# own subprocess under a generous timeout and journals to BENCH_HW/, so a
+# mid-run tunnel wedge loses one point, not the session's data. The
+# sweep's `best` line is the input to the manual re-pin of
+# bench_mfu.py / __graft_entry__.py (kept flash-pinned by default so the
+# driver's unattended `make bench` can never hang on an unproven
+# compile).
+#
+# Usage: hack/bench_hw.sh [quick]
+#   quick: halve timeouts and skip serve/infer (smoke the sequence)
+set -u
+cd "$(dirname "$0")/.."
+OUT=BENCH_HW
+mkdir -p "$OUT"
+QUICK="${1:-}"
+T_ATTN=1800; T_SWEEP=7200; T_AUX=1200
+if [ "$QUICK" = "quick" ]; then T_ATTN=600; T_SWEEP=1800; T_AUX=400; fi
+
+log() { echo "[bench-hw $(date +%H:%M:%S)] $*" | tee -a "$OUT/journal.log"; }
+
+step() { # name timeout_s cmd...
+  local name="$1" t="$2"; shift 2
+  log "START $name (timeout ${t}s)"
+  timeout "$t" "$@" >> "$OUT/$name.jsonl" 2>> "$OUT/$name.err"
+  local rc=$?
+  log "END $name rc=$rc"
+  return $rc
+}
+
+# 0. pre-flight: never start a multi-hour sequence against a dead tunnel
+log "probe"
+probe=$(python - <<'EOF'
+import sys
+sys.path.insert(0, ".")
+import bench
+s, d = bench.probe_tpu()
+print(s)
+EOF
+)
+log "probe: $probe"
+if [ "$probe" != "ok" ]; then
+  log "tunnel not answering ($probe); aborting"
+  exit 1
+fi
+
+# 1. attention kernel comparison — one process per impl (round-3 rule:
+#    a Mosaic compile spiral must kill one point, not the tunnel session;
+#    never run two TPU processes at once)
+for impl in flash splash xla; do
+  NOS_TPU_ATTN_ONLY=$impl step "attn_$impl" "$T_ATTN" python bench_attn.py 5 \
+    || log "attn_$impl failed (continuing)"
+done
+
+# 2. pick the kernel for the sweep: fastest completed fwd+bwd
+KERNEL=$(python - <<'EOF'
+import glob, json
+best, best_ms = "flash", None
+for f in glob.glob("BENCH_HW/attn_*.jsonl"):
+    for line in open(f):
+        try:
+            r = json.loads(line)
+        except ValueError:
+            continue
+        ms = r.get("fwd_bwd_ms")
+        if ms and (best_ms is None or ms < best_ms):
+            best, best_ms = r["impl"], ms
+print(best)
+EOF
+)
+log "kernel pick: $KERNEL"
+
+# 3. policy x batch x loss-chunk sweep under the chosen kernel
+NOS_TPU_ATTN_IMPL=$KERNEL step sweep "$T_SWEEP" python bench_sweep.py \
+  || log "sweep failed (continuing)"
+grep -h '"best"' "$OUT/sweep.jsonl" | tail -1 | tee -a "$OUT/journal.log" || true
+
+# 4. headline artifact with current (safe) pins — the re-pin to the
+#    sweep's best is a deliberate source edit, not automated
+step bench "$T_AUX" python bench.py || log "bench failed (continuing)"
+
+# 5. inference numbers
+step decode "$T_AUX" python bench_decode.py || log "decode failed (continuing)"
+if [ "$QUICK" != "quick" ]; then
+  step serve "$T_AUX" python bench_serve.py || log "serve failed (continuing)"
+  step infer "$T_AUX" python bench_infer.py || log "infer failed (continuing)"
+fi
+
+log "sequence complete — journal in $OUT/"

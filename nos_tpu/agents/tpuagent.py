@@ -86,10 +86,19 @@ def _requests_tpu(pod) -> bool:
     )
 
 
-def attachment_drift(client: Client, node_name: str, tpu_client) -> str:
+def attachment_drift(client: Client, node_name: str, tpu_client,
+                     podres_client=None) -> str:
     """Reconcile the API server's bound-pod view against the node's native
     attachment truth (reference: kubelet pod-resources + NVML,
     pkg/resource/lister.go:27-39, pkg/gpu/mig/client.go:29-120).
+
+    ``podres_client`` (agents/podresources.PodResourcesClient) adds the
+    KUBELET's allocation view as a third truth source: a kubelet
+    allocation for a pod not bound here is a ghost, and a Running
+    TPU-requesting pod missing from table AND kubelet view AND /proc is
+    unattached. The v1 List response carries pod (namespace, name), not
+    UID, so the kubelet view joins on identity and reports drift items
+    as "ghost-alloc:<ns>/<name>".
 
     Returns ";"-joined "kind:pod-uid" items (see
     constants.ANNOTATION_ATTACHMENT_DRIFT), "" when no drift is visible.
@@ -114,24 +123,51 @@ def attachment_drift(client: Client, node_name: str, tpu_client) -> str:
         logger.warning("attachment truth unreachable", exc_info=True)
         return ""
 
+    kubelet_allocs = {}
+    if podres_client is not None:
+        try:
+            # whole chips AND dynamic sub-slice resources both count as
+            # TPU allocations in the kubelet's view
+            for pr in podres_client.list():
+                ids = {
+                    d for cd in pr.devices
+                    if cd.resource_name == constants.RESOURCE_TPU
+                    or is_slice_resource(cd.resource_name)
+                    for d in cd.device_ids
+                }
+                if ids:
+                    kubelet_allocs[(pr.namespace, pr.name)] = ids
+        except Exception:   # socket gone mid-flight: not evidence
+            logger.warning("pod-resources API unreachable", exc_info=True)
+            kubelet_allocs = {}
+
     bound = {}
+    bound_names = set()
     for pod in client.list("Pod"):
         if pod.spec.node_name == node_name and pod.metadata.uid:
             bound[pod.metadata.uid] = pod
+            bound_names.add((pod.metadata.namespace, pod.metadata.name))
 
     table_uids = {e.get("pod_uid") for e in table.values() if e.get("pod_uid")}
     proc_uids = {u for uids in proc_truth.values() for u in uids
                  if u != "<host>"}
+    kubelet_names = set(kubelet_allocs)
 
     drift = []
     for uid in sorted(table_uids | proc_uids):
         pod = bound.get(uid)
         if pod is None or pod.status.phase not in ("Pending", "Running"):
             drift.append(f"ghost:{uid}")
-    if table:
+    # kubelet-view ghosts: the kubelet holds devices for a pod this node
+    # doesn't know — joined by (ns, name) since List has no UID
+    for ns, name in sorted(kubelet_names - bound_names):
+        drift.append(f"ghost-alloc:{ns}/{name}")
+    if table or kubelet_allocs:
         for uid, pod in sorted(bound.items()):
+            key = (pod.metadata.namespace, pod.metadata.name)
             if (pod.status.phase == "Running" and _requests_tpu(pod)
-                    and uid not in table_uids and uid not in proc_uids):
+                    and uid not in table_uids and uid not in proc_uids
+                    and key not in kubelet_names):
                 # the runtime probe showing the pod DOES hold a device
                 # overrides a stale/partial allocation table (e.g. tmpfs
                 # table lost to a host reboot): no false drift claim
@@ -146,9 +182,13 @@ class TpuAgent:
         tpu_client,
         report_interval_s: Optional[float] = constants.DEFAULT_REPORT_INTERVAL_S,
         manage_allocatable: bool = True,
+        podres_client=None,
     ):
         self.node_name = node_name
         self.tpu = tpu_client
+        # kubelet pod-resources view (agents/podresources); None = rely
+        # on the device-plugin table + /proc probe alone
+        self.podres = podres_client
         # None = event-driven only (tests / deterministic pumps); a float
         # adds the reference's periodic re-report (migagent default 10s)
         self.report_interval_s = report_interval_s
@@ -182,7 +222,8 @@ class TpuAgent:
         used = used_slices_from_bound_pods(client, self.node_name)
         unhealthy = self._unhealthy_chips()
         obs.AGENT_UNHEALTHY_CHIPS.labels(self.node_name).set(len(unhealthy))
-        drift = attachment_drift(client, self.node_name, self.tpu)
+        drift = attachment_drift(client, self.node_name, self.tpu,
+                                 self.podres)
 
         status_annotations: Dict[str, str] = {}
         allocatable_slices: Dict[str, int] = {}

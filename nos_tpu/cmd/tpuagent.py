@@ -21,7 +21,8 @@ from nos_tpu.kube.controller import Manager
 
 
 def build(server, node_name: str, config: Optional[TpuAgentConfig] = None,
-          tpu_client=None, mock_chips: int = 8) -> Manager:
+          tpu_client=None, mock_chips: int = 8,
+          pod_resources_socket: str = "") -> Manager:
     cfg = config or TpuAgentConfig()
     if tpu_client is None:
         try:
@@ -32,10 +33,16 @@ def build(server, node_name: str, config: Optional[TpuAgentConfig] = None,
             if os.environ.get("NOS_TPU_NATIVE_LIB"):
                 raise
             tpu_client = MockTpuClient(chips=mock_chips)
+    podres = None
+    if pod_resources_socket:
+        from nos_tpu.agents.podresources import KubeletPodResourcesClient
+
+        podres = KubeletPodResourcesClient(pod_resources_socket)
     agent = TpuAgent(
         node_name,
         tpu_client,
         report_interval_s=cfg.report_interval_seconds,
+        podres_client=podres,
     )
     agent.startup_cleanup(Client(server))
     mgr = Manager(server)
@@ -56,6 +63,12 @@ def main(argv: Optional[Sequence[str]] = None) -> None:
         help="use the in-memory device double instead of the native layer",
     )
     parser.add_argument("--mock-chips", type=int, default=8)
+    parser.add_argument(
+        "--pod-resources-socket", default="",
+        help="kubelet pod-resources socket path (e.g. "
+             "/var/lib/kubelet/pod-resources/kubelet.sock); empty "
+             "disables the kubelet allocation view",
+    )
     args = parser.parse_args(argv)
     if not args.node_name:
         parser.error("--node-name (or NODE_NAME env) is required")
@@ -65,7 +78,8 @@ def main(argv: Optional[Sequence[str]] = None) -> None:
     serve.setup_logging(cfg.log_level)
     tpu_client = MockTpuClient(chips=args.mock_chips) if args.mock else None
     mgr = build(serve.connect(args), args.node_name, cfg, tpu_client=tpu_client,
-                mock_chips=args.mock_chips)
+                mock_chips=args.mock_chips,
+                pod_resources_socket=args.pod_resources_socket)
     serve.run_daemon(mgr, args.health_port, args.health_host)
 
 

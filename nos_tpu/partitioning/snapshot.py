@@ -33,6 +33,9 @@ class SnapshotNode:
         node.status.allocatable = self.tpu_node.allocatable_scalar_resources(
             node.status.allocatable
         )
+        # the NodeInfo memoizes available(); an allocatable swap outside
+        # add_pod/remove_pod must drop that memo
+        self.node_info.invalidate_requested()
 
     def update_geometry_for(self, lacking: Dict[Profile, int]) -> bool:
         changed = self.tpu_node.update_geometry_for(lacking)

@@ -33,6 +33,11 @@ class ClusterCache:
         self._objs: Dict[str, Dict[Tuple[str, str], object]] = {
             k: {} for k in KINDS}
         self.primed = False
+        # bumped on every state-changing fold: lets the scheduler skip
+        # whole batch passes when nothing it can see has changed (an
+        # unschedulable burst would otherwise re-attempt every pending
+        # pod per event — O(pending^2))
+        self.generation = 0
 
     def _fresher(self, kind: str, obj, strict: bool) -> bool:
         """Staleness guard: an in-flight watch event from before a
@@ -58,14 +63,17 @@ class ClusterCache:
         if kind not in self._objs:
             return
         if ev.type == "DELETED":
-            self._objs[kind].pop(_key(ev.obj), None)
+            if self._objs[kind].pop(_key(ev.obj), None) is not None:
+                self.generation += 1
         elif self._fresher(kind, ev.obj, strict=True):
             self._objs[kind][_key(ev.obj)] = ev.obj
+            self.generation += 1
 
     def prime(self, client: Client) -> None:
         for kind in KINDS:
             self._objs[kind] = {_key(o): o for o in client.list(kind)}
         self.primed = True
+        self.generation += 1
 
     def upsert(self, kind: str, obj) -> None:
         """Reflect the scheduler's OWN successful write immediately: the
@@ -76,10 +84,12 @@ class ClusterCache:
         any stale in-flight event."""
         if kind in self._objs and self._fresher(kind, obj, strict=False):
             self._objs[kind][_key(obj)] = obj
+            self.generation += 1
 
     def remove(self, kind: str, obj) -> None:
         if kind in self._objs:
-            self._objs[kind].pop(_key(obj), None)
+            if self._objs[kind].pop(_key(obj), None) is not None:
+                self.generation += 1
 
     def list(self, kind: str, namespace: Optional[str] = None) -> List[object]:
         objs = self._objs[kind].values()

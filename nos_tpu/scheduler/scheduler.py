@@ -49,6 +49,11 @@ class Scheduler:
         # events (reference state.go:29-222 informer pattern) — no
         # per-event relist (VERDICT r2 weak #6)
         self.cache = ClusterCache()
+        # batch-pass bookkeeping (see reconcile): generation of the last
+        # pass, and whether a requeue-worthy outcome (preemption
+        # nomination) is owed a retry regardless of generation
+        self._batch_gen = -1
+        self._retry_pending = False
 
     # ------------------------------------------------------------------
     def _sync_state(self, client: Client) -> fw.Snapshot:
@@ -77,31 +82,85 @@ class Scheduler:
 
     # ------------------------------------------------------------------
     def reconcile(self, client: Client, req: Request) -> Result:
-        if req.name == "*":
-            # sweep: capacity may have been freed (pod deleted / node added /
-            # quota changed) — re-run every pending pod of this scheduler
-            # against ONE shared state sync (the snapshot is updated in
-            # place after each bind, so later pods see earlier placements)
-            result = Result()
-            snapshot = self._sync_state(client)
-            for pod in self.cache.list("Pod"):
-                if (
-                    pod.spec.scheduler_name == self.scheduler_name
-                    and not pod.spec.node_name
-                    and pod.status.phase == "Pending"
-                ):
-                    r = self._schedule_one(client, pod, snapshot)
-                    result.requeue = result.requeue or r.requeue
-            return result
+        # EVERY trigger (pod event, sweep, requeue) funnels into one
+        # batch pass over the pending pods, sharing ONE state sync (kube
+        # keeps its snapshot informer-maintained; rebuilding per pod
+        # event made a 500-pod burst O(n^2) in sync work — measured 1.7s
+        # of a 4.2s pump at the 1024-node scale point). Pod events are
+        # generation-guarded: if nothing the cache can see changed since
+        # the last pass, the event's pod was already attempted and the
+        # whole pass is skipped — an *unschedulable* burst costs ~2
+        # passes (attempt + idempotent condition writes), not one pass
+        # per event. Sweeps and controller requeues always run.
+        first = None
+        if req.name != "*":
+            if not self._retry_pending \
+                    and self._batch_gen == self.cache.generation:
+                return Result()
+            try:
+                pod = client.get("Pod", req.name, req.namespace)
+            except NotFound:
+                pod = None
+            if (
+                pod is not None
+                and pod.spec.scheduler_name == self.scheduler_name
+                and not pod.spec.node_name
+                and pod.status.phase == "Pending"
+            ):
+                first = pod
+            elif not self._retry_pending:
+                # a bound / foreign / vanished pod's event is not new
+                # capacity (capacity-freeing transitions — DELETED,
+                # Succeeded/Failed — enqueue a '*' sweep from the
+                # mapper): no reason to rebuild state for it
+                return Result()
+        return self._batch_schedule(client, first)
+
+    def _batch_schedule(self, client: Client, first: Optional[Pod]) -> Result:
+        """One shared sync, then attempt every pending pod (``first``
+        ahead of the rest — the event's own pod). The snapshot is updated
+        in place after each bind/preemption so later pods see earlier
+        placements. Gangs are attempted once per pass: a placeable gang
+        binds every member on its first member's attempt; an unplaceable
+        one must not re-run the sub-cuboid search per member."""
+        result = Result()
         try:
-            pod = client.get("Pod", req.name, req.namespace)
-        except NotFound:
-            return Result()
-        if pod.spec.scheduler_name != self.scheduler_name:
-            return Result()
-        if pod.spec.node_name or pod.status.phase != "Pending":
-            return Result()
-        return self._schedule_one(client, pod, self._sync_state(client))
+            snapshot = self._sync_state(client)
+            seen_gangs = set()
+            me = ((first.metadata.namespace, first.metadata.name)
+                  if first is not None else None)
+            pods = ([first] if first is not None else []) + [
+                p for p in self.cache.list("Pod")
+                if (
+                    p.spec.scheduler_name == self.scheduler_name
+                    and not p.spec.node_name
+                    and p.status.phase == "Pending"
+                    and (p.metadata.namespace, p.metadata.name) != me
+                )
+            ]
+            for pod in pods:
+                gk = gang_key(pod)
+                if gk is not None:
+                    if gk in seen_gangs:
+                        continue
+                    seen_gangs.add(gk)
+                r = self._schedule_one(client, pod, snapshot)
+                result.requeue = result.requeue or r.requeue
+        except BaseException:
+            # incomplete pass: the controller's error-requeue must not be
+            # swallowed by the generation guard on redelivery
+            self._retry_pending = True
+            raise
+        # mark the pass complete ONLY now (exception above leaves the
+        # guard open); recording the post-pass generation also absorbs
+        # the cache bumps from our own binds, so the trailing bind events
+        # don't trigger a no-op pass
+        self._batch_gen = self.cache.generation
+        # a preemption nominated someone: the retry must survive even if
+        # this request's own pod is bound by then (reconcile honors
+        # _retry_pending before the generation check)
+        self._retry_pending = bool(result.requeue)
+        return result
 
     def _schedule_one(self, client: Client, pod: Pod, snapshot: fw.Snapshot) -> Result:
         started = time.monotonic()

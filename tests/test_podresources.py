@@ -1,0 +1,230 @@
+"""Kubelet pod-resources client (agents/podresources.py): wire codec
+against hand-encoded protobuf bytes, the real gRPC path over a unix
+socket, and the drift reconciliation fed by the kubelet view
+(reference pkg/resource/lister.go + client.go)."""
+import os
+import tempfile
+
+import pytest
+
+from nos_tpu import constants
+from nos_tpu.agents.podresources import (
+    ContainerDevices,
+    KubeletPodResourcesClient,
+    MockPodResourcesClient,
+    PodResources,
+    decode_fields,
+)
+
+TPU = constants.RESOURCE_TPU
+
+
+# ---------------------------------------------------------------------------
+# protobuf wire helpers for building test fixtures
+# ---------------------------------------------------------------------------
+
+def varint(n: int) -> bytes:
+    out = b""
+    while True:
+        b = n & 0x7F
+        n >>= 7
+        if n:
+            out += bytes([b | 0x80])
+        else:
+            return out + bytes([b])
+
+
+def field_bytes(fnum: int, payload: bytes) -> bytes:
+    return varint((fnum << 3) | 2) + varint(len(payload)) + payload
+
+
+def field_str(fnum: int, s: str) -> bytes:
+    return field_bytes(fnum, s.encode())
+
+
+def container_devices(resource: str, *ids: str) -> bytes:
+    out = field_str(1, resource)
+    for d in ids:
+        out += field_str(2, d)
+    return out
+
+
+def pod_resources_msg(name: str, ns: str, *devs: bytes) -> bytes:
+    container = field_str(1, "main")
+    for d in devs:
+        container += field_bytes(2, d)
+    return field_str(1, name) + field_str(2, ns) + field_bytes(3, container)
+
+
+def list_response(*pods: bytes) -> bytes:
+    return b"".join(field_bytes(1, p) for p in pods)
+
+
+# ---------------------------------------------------------------------------
+# codec
+# ---------------------------------------------------------------------------
+
+def test_decode_list_response():
+    raw = list_response(
+        pod_resources_msg("trainer-0", "team-a",
+                          container_devices(TPU, "0", "1")),
+        pod_resources_msg("infer-0", "team-b",
+                          container_devices("nos.ai/tpu-slice-1x1", "s0")),
+    )
+    fields = decode_fields(raw)
+    assert len(fields[1]) == 2
+
+    from nos_tpu.agents.podresources import _decode_pod_resources
+
+    p0 = _decode_pod_resources(fields[1][0])
+    assert (p0.name, p0.namespace) == ("trainer-0", "team-a")
+    assert p0.device_ids_for(TPU) == {"0", "1"}
+    p1 = _decode_pod_resources(fields[1][1])
+    assert p1.device_ids_for("nos.ai/tpu-slice-1x1") == {"s0"}
+    assert p1.device_ids_for(TPU) == set()
+
+
+def test_decode_skips_unknown_fields():
+    # a future kubelet adding fields (cpu_ids=3 varints, memory=4
+    # messages) must not break the decoder
+    extra = varint((7 << 3) | 0) + varint(42)        # unknown varint field
+    raw = list_response(
+        pod_resources_msg("p", "ns", container_devices(TPU, "3")) + extra)
+    fields = decode_fields(raw)
+
+    from nos_tpu.agents.podresources import _decode_pod_resources
+
+    assert _decode_pod_resources(fields[1][0]).device_ids_for(TPU) == {"3"}
+
+
+def test_multibyte_varint_lengths():
+    big_id = "x" * 300                               # length needs 2 bytes
+    raw = list_response(
+        pod_resources_msg("p", "ns", container_devices(TPU, big_id)))
+    from nos_tpu.agents.podresources import _decode_pod_resources
+
+    p = _decode_pod_resources(decode_fields(raw)[1][0])
+    assert p.device_ids_for(TPU) == {big_id}
+
+
+# ---------------------------------------------------------------------------
+# real gRPC over a unix socket
+# ---------------------------------------------------------------------------
+
+@pytest.fixture
+def kubelet_sock():
+    grpc = pytest.importorskip("grpc")
+    tmp = tempfile.mkdtemp()
+    sock = os.path.join(tmp, "kubelet.sock")
+
+    response = list_response(
+        pod_resources_msg("trainer-0", "team-a",
+                          container_devices(TPU, "0", "1", "2", "3")))
+    alloc_response = field_bytes(
+        1, container_devices(TPU, *[str(i) for i in range(8)]))
+
+    ident = lambda b: b                               # noqa: E731
+
+    def list_handler(request, context):
+        return response
+
+    def alloc_handler(request, context):
+        return alloc_response
+
+    from concurrent.futures import ThreadPoolExecutor
+
+    server = grpc.server(ThreadPoolExecutor(max_workers=2))
+    handlers = grpc.method_handlers_generic_handler(
+        "v1.PodResourcesLister",
+        {
+            "List": grpc.unary_unary_rpc_method_handler(
+                list_handler, request_deserializer=ident,
+                response_serializer=ident),
+            "GetAllocatableResources": grpc.unary_unary_rpc_method_handler(
+                alloc_handler, request_deserializer=ident,
+                response_serializer=ident),
+        },
+    )
+    server.add_generic_rpc_handlers((handlers,))
+    server.add_insecure_port(f"unix://{sock}")
+    server.start()
+    yield sock
+    server.stop(None)
+
+
+def test_kubelet_client_over_unix_socket(kubelet_sock):
+    client = KubeletPodResourcesClient(kubelet_sock, timeout_s=10)
+    pods = client.list()
+    assert len(pods) == 1
+    assert pods[0].namespace == "team-a"
+    assert client.used_device_ids(TPU) == {"0", "1", "2", "3"}
+    assert client.allocations(TPU) == {("team-a", "trainer-0"):
+                                       {"0", "1", "2", "3"}}
+    alloc = client.allocatable()
+    assert {d for cd in alloc for d in cd.device_ids} == \
+        {str(i) for i in range(8)}
+    client.close()
+
+
+# ---------------------------------------------------------------------------
+# drift reconciliation with the kubelet view
+# ---------------------------------------------------------------------------
+
+def mock_pr(ns, name, *ids, resource=TPU):
+    return PodResources(name=name, namespace=ns, devices=[
+        ContainerDevices(resource_name=resource, device_ids=tuple(ids))])
+
+
+def drift_rig(bound_pods, kubelet_pods):
+    from nos_tpu.agents.tpu_native import MockTpuClient
+    from nos_tpu.agents.tpuagent import attachment_drift
+    from nos_tpu.kube import ApiServer
+    from nos_tpu.kube.client import Client
+    from nos_tpu.kube.objects import (
+        Container, ObjectMeta, Pod, PodSpec, PodStatus,
+    )
+
+    server = ApiServer()
+    for ns, name, uid, phase in bound_pods:
+        server.create(Pod(
+            metadata=ObjectMeta(name=name, namespace=ns, uid=uid),
+            spec=PodSpec(containers=[Container(requests={TPU: 1})],
+                         node_name="v5e-0"),
+            status=PodStatus(phase=phase),
+        ))
+    return attachment_drift(
+        Client(server), "v5e-0", MockTpuClient(chips=4),
+        MockPodResourcesClient(pods=kubelet_pods))
+
+
+def test_kubelet_ghost_allocation_detected():
+    out = drift_rig(
+        bound_pods=[("team-a", "trainer-0", "uid-1", "Running")],
+        kubelet_pods=[mock_pr("team-a", "trainer-0", "0"),
+                      mock_pr("team-b", "gone-pod", "1")])
+    assert "ghost-alloc:team-b/gone-pod" in out
+    assert "trainer-0" not in out
+
+
+def test_kubelet_view_suppresses_false_unattached():
+    # pod present in the kubelet view but absent from the (empty)
+    # device-plugin table: NOT unattached
+    out = drift_rig(
+        bound_pods=[("team-a", "trainer-0", "uid-1", "Running")],
+        kubelet_pods=[mock_pr("team-a", "trainer-0", "0")])
+    assert out == ""
+
+
+def test_missing_everywhere_is_unattached():
+    out = drift_rig(
+        bound_pods=[("team-a", "trainer-0", "uid-1", "Running")],
+        kubelet_pods=[mock_pr("team-b", "other", "1")])
+    assert "unattached:uid-1" in out
+
+
+def test_slice_resources_count_as_kubelet_allocations():
+    out = drift_rig(
+        bound_pods=[("team-a", "svc-0", "uid-9", "Running")],
+        kubelet_pods=[mock_pr("team-a", "svc-0", "s0",
+                              resource="nos.ai/tpu-slice-1x1")])
+    assert out == ""

@@ -254,3 +254,79 @@ def test_infos_clone_preserves_aliasing():
     assert c["ns-x"] is c["ns-y"]            # aliasing preserved
     c["ns-x"].reserve({TPU: 1})
     assert infos["ns-x"].used.get(TPU, 0) == 0   # deep-copied
+
+
+# ---------------------------------------------------------------------------
+# floor rounding at granularity boundaries (VERDICT r3 next #7)
+# ---------------------------------------------------------------------------
+
+def infos(*qs):
+    out = QuotaInfos()
+    for q in qs:
+        out.add(q)
+    return out
+
+
+def test_guaranteed_overquotas_cpu_floors_at_millicores():
+    # overquota cpu 1, a's share 1/3 -> 0.333... floored to 333 millicores
+    qa = qi("qa", "ns-a", min={"cpu": 1}, used={"cpu": 0})
+    qb = qi("qb", "ns-b", min={"cpu": 2}, used={"cpu": 2})
+    got = infos(qa, qb).guaranteed_overquotas("ns-a")
+    assert got["cpu"] == 0.333
+
+
+def test_guaranteed_overquotas_exact_integer_share_not_eroded():
+    # 3/7 of 7 chips is exactly 3; float arithmetic gives
+    # 3.0000000000000004 or 2.9999999999999996 depending on evaluation
+    # order — the epsilon in _floor_quantity must keep the floor at 3,
+    # never 2
+    qa = qi("qa", "ns-a", min={TPU: 3}, used={TPU: 0})
+    qb = qi("qb", "ns-b", min={TPU: 4}, used={TPU: 0})
+    got = infos(qa, qb).guaranteed_overquotas("ns-a")
+    assert got[TPU] == 3.0
+    # and the denominator-49 case (1/49 * 49)
+    q1 = qi("q1", "ns-1", min={TPU: 1}, used={TPU: 0})
+    q2 = qi("q2", "ns-2", min={TPU: 48}, used={TPU: 0})
+    assert infos(q1, q2).guaranteed_overquotas("ns-1")[TPU] == 1.0
+
+
+def test_guaranteed_overquotas_sum_never_exceeds_aggregate():
+    """Conservation: Σ over quotas of guaranteed ≤ aggregated overquota,
+    whatever the share fractions (the floors donate the remainder) —
+    the reference pins the percentage-sum analog of this."""
+    tables = [
+        {"qa": ("ns-a", 1, 0), "qb": ("ns-b", 2, 1), "qc": ("ns-c", 4, 0)},
+        {"qa": ("ns-a", 3, 2), "qb": ("ns-b", 5, 0), "qc": ("ns-c", 7, 7)},
+        {"qa": ("ns-a", 1, 0), "qb": ("ns-b", 1, 0), "qc": ("ns-c", 1, 0)},
+        {"qa": ("ns-a", 9, 11), "qb": ("ns-b", 6, 2), "qc": ("ns-c", 2, 0)},
+    ]
+    for table in tables:
+        qs = infos(*[
+            qi(name, ns, min={TPU: mn}, used={TPU: us})
+            for name, (ns, mn, us) in table.items()
+        ])
+        agg = qs.aggregated_overquotas().get(TPU, 0)
+        total = sum(
+            qs.guaranteed_overquotas(ns)[TPU]
+            for ns in ("ns-a", "ns-b", "ns-c")
+        )
+        assert total <= agg, (table, total, agg)
+
+
+def test_guaranteed_overquotas_resource_absent_from_own_min_is_zero():
+    # a quota gets no guaranteed share of a resource it declares no min
+    # for (its pct of that resource's total min is 0)
+    qa = qi("qa", "ns-a", min={TPU: 4}, used={TPU: 0})
+    qb = qi("qb", "ns-b", min={TPU: 4, "cpu": 2}, used={})
+    got = infos(qa, qb).guaranteed_overquotas("ns-a")
+    assert "cpu" not in got      # only resources in a's own min appear
+
+
+def test_guaranteed_overquotas_zero_used_idle_cluster_returns_full_share():
+    # wholly idle cluster: every quota's guaranteed share is its
+    # proportional slice of the full aggregated min
+    qa = qi("qa", "ns-a", min={TPU: 2}, used={})
+    qb = qi("qb", "ns-b", min={TPU: 6}, used={})
+    got_a = infos(qa, qb).guaranteed_overquotas("ns-a")
+    got_b = infos(qa, qb).guaranteed_overquotas("ns-b")
+    assert got_a[TPU] == 2.0 and got_b[TPU] == 6.0
