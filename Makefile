@@ -67,5 +67,5 @@ lint:  ## Static checks: ruff when available, byte-compile otherwise.
 	fi
 
 .PHONY: bench-hw
-bench-hw:  ## Full hardware publish sequence (attn -> sweep -> bench -> decode/serve), journaled to BENCH_HW/.
-	hack/bench_hw.sh
+bench-hw:  ## Hardware measurement queue (parity gates -> MFU sweep -> attn -> decode/serve), flap-resilient, journaled to bench_logs/.
+	$(PYTHON) hack/bench_babysit.py --queue default

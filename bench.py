@@ -51,6 +51,16 @@ TIMED_STEPS = 10
 MFU_BAR = 40.0  # % — the target this rebuild is held to (VERDICT r1 #2)
 
 
+def phase_marker(tag: str, name: str) -> None:
+    """Stderr progress marker (``PHASE <tag> <name> t=HH:MM:SS``) shared by
+    every hardware bench script: when a watchdog kills a run, the captured
+    stderr shows WHICH compile/run stage wedged (a 900s timeout with no
+    output is unattributable — round-4 lesson). One definition so log
+    parsers (hack/bench_babysit.py) never chase two format strings."""
+    print(f"PHASE {tag} {name} t={time.strftime('%H:%M:%S')}",
+          file=sys.stderr, flush=True)
+
+
 class ImplausibleMeasurement(RuntimeError):
     """The bench produced numbers that violate hardware physics. Raised
     instead of publishing: round 2 shipped 380,935% MFU because the

@@ -20,7 +20,7 @@ import time
 
 sys.path.insert(0, ".")
 
-from bench import BATCH, MODEL, SEQ  # noqa: E402
+from bench import BATCH, MODEL, SEQ, phase_marker  # noqa: E402
 from bench_mfu import host_fence  # noqa: E402
 
 REPS = int(sys.argv[1]) if len(sys.argv) > 1 else 10
@@ -57,28 +57,36 @@ def main():
 
         grad = jax.jit(jax.grad(loss, argnums=(0, 1, 2)))
 
+        def phase(name):
+            phase_marker(impl, name)
+
         try:
+            phase("fwd_compile")
             t0 = time.perf_counter()
             out = fwd(q, k, v)
             host_fence(out)
             compile_fwd = time.perf_counter() - t0
 
+            phase("fwd_timing")
             t0 = time.perf_counter()
             for _ in range(REPS):
                 out = fwd(q, k, v)
             host_fence(out)
             t_fwd = (time.perf_counter() - t0) / REPS
 
+            phase("bwd_compile")
             t0 = time.perf_counter()
             g = grad(q, k, v)
             host_fence(g[0])
             compile_bwd = time.perf_counter() - t0
 
+            phase("bwd_timing")
             t0 = time.perf_counter()
             for _ in range(REPS):
                 g = grad(q, k, v)
             host_fence(g[0])
             t_bwd = (time.perf_counter() - t0) / REPS
+            phase("done")
         except Exception as e:
             print(json.dumps({"impl": impl,
                               "error": f"{type(e).__name__}: {e}"[:200]}))

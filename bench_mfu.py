@@ -20,7 +20,7 @@ import time
 sys.path.insert(0, ".")
 
 from bench import BATCH, MODEL, PEAK_TFLOPS, SEQ, TIMED_STEPS, WARMUP_STEPS, \
-    model_flops_per_step, validate_mfu  # noqa: E402
+    model_flops_per_step, phase_marker, validate_mfu  # noqa: E402
 
 
 def host_fence(*arrays) -> float:
@@ -92,16 +92,22 @@ def run_mfu():
     tok = jax.random.randint(jax.random.PRNGKey(1), (batch, SEQ), 0, cfg.vocab)
     data = {"tokens": tok, "targets": tok}
 
+    def phase(name):
+        phase_marker("mfu", name)
+
     loss = None
+    phase("compile_warmup")
     for _ in range(WARMUP_STEPS):
         params, opt_state, loss = step(params, opt_state, data)
     fence(loss, params)
 
+    phase("timing")
     t0 = time.perf_counter()
     for _ in range(TIMED_STEPS):
         params, opt_state, loss = step(params, opt_state, data)
     final_loss = fence(loss, params)
     dt = (time.perf_counter() - t0) / TIMED_STEPS
+    phase("done")
 
     flops = model_flops_per_step(cfg, batch, SEQ)
     tflops = flops / dt / 1e12

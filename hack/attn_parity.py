@@ -1,0 +1,57 @@
+#!/usr/bin/env python3
+"""Pallas-vs-XLA attention parity at the bench shape, on hardware.
+
+The kernel under NOS_TPU_ATTN_IMPL (splash or flash; GQA per-group calls
+and tuned block sizes included) must be numerically equal to the
+reference einsum within bf16 tolerance — run before trusting any MFU
+number from that kernel. Prints one JSON line; exits non-zero on
+mismatch or when the requested kernel isn't what actually dispatches
+(a mislabeled fallback must fail loudly, not "pass" by comparing the
+reference against itself).
+"""
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    from bench import phase_marker
+    from bench_mfu import host_fence
+    from nos_tpu.ops.attention import attention, effective_impl
+
+    want = os.environ.get("NOS_TPU_ATTN_IMPL", "splash")
+    b, h, hkv, s, d = 2, 16, 4, 2048, 128
+    key = jax.random.PRNGKey
+    q = jax.random.normal(key(0), (b, h, s, d), jnp.bfloat16)
+    k = jax.random.normal(key(1), (b, hkv, s, d), jnp.bfloat16)
+    v = jax.random.normal(key(2), (b, hkv, s, d), jnp.bfloat16)
+
+    eff = effective_impl(q.shape, k.shape)
+    if eff != want:
+        print(json.dumps({"step": "attn_parity", "impl": want,
+                          "error": f"dispatches {eff}, not {want}"}))
+        sys.exit(1)
+
+    phase_marker(f"parity_{want}", "kernel_compile")
+    pal = jax.jit(lambda q, k, v: attention(q, k, v, causal=True))(q, k, v)
+    host_fence(pal)
+    phase_marker(f"parity_{want}", "reference_compile")
+    ref = jax.jit(lambda q, k, v: attention(q, k, v, causal=True,
+                                            force_xla=True))(q, k, v)
+    host_fence(ref)
+    phase_marker(f"parity_{want}", "compare")
+    diff = float(jnp.max(jnp.abs(pal.astype(jnp.float32)
+                                 - ref.astype(jnp.float32))))
+    ok = diff < 2e-2  # bf16 kernel vs einsum tolerance
+    print(json.dumps({"step": "attn_parity", "impl": want,
+                      "max_abs_diff": diff, "ok": ok}))
+    sys.exit(0 if ok else 1)
+
+
+if __name__ == "__main__":
+    main()

@@ -94,9 +94,14 @@ class PodResources:
     namespace: str
     devices: List[ContainerDevices] = field(default_factory=list)
 
-    def device_ids_for(self, resource: str) -> Set[str]:
+    def device_ids_for(self, resource) -> Set[str]:
+        """``resource``: an exact resource name, or a predicate over
+        resource names (so callers counting families of resources — e.g.
+        whole chips plus dynamic sub-slice resources — share this join
+        instead of re-implementing it)."""
+        match = resource if callable(resource) else resource.__eq__
         return {
-            d for cd in self.devices if cd.resource_name == resource
+            d for cd in self.devices if match(cd.resource_name)
             for d in cd.device_ids
         }
 
@@ -136,15 +141,16 @@ class PodResourcesClient:
         raise NotImplementedError
 
     # -- derived views (reference GetUsedDevices / GetAllocatableDevices)
-    def used_device_ids(self, resource: str) -> Set[str]:
+    def used_device_ids(self, resource) -> Set[str]:
         return {
             d for pr in self.list() for d in pr.device_ids_for(resource)
         }
 
-    def allocations(self, resource: str) -> Dict[Tuple[str, str], Set[str]]:
+    def allocations(self, resource) -> Dict[Tuple[str, str], Set[str]]:
         """{(namespace, name): device ids} for pods holding ``resource``
-        per the kubelet — the join key the drift reconciler uses (the v1
-        List response carries no pod UID)."""
+        (a name or a predicate — see ``device_ids_for``) per the kubelet —
+        the join key the drift reconciler uses (the v1 List response
+        carries no pod UID)."""
         out: Dict[Tuple[str, str], Set[str]] = {}
         for pr in self.list():
             ids = pr.device_ids_for(resource)

@@ -107,10 +107,10 @@ def attachment_drift(client: Client, node_name: str, tpu_client,
       with no Pending/Running pod bound here — invisible usage the
       bound-pod inference cannot see.
     - unattached: a Running TPU-requesting pod absent from the allocation
-      table — a device-plugin/scheduler disagreement. Only judged when the
-      table has entries (no device plugin recording -> no claim; the /proc
-      probe can miss permission-restricted processes so its absence is
-      never evidence).
+      table AND the kubelet view — a device-plugin/scheduler disagreement.
+      Only judged when at least one of those two sources has entries (no
+      recording anywhere -> no claim; the /proc probe can miss
+      permission-restricted processes so its absence is never evidence).
     """
     read_attach = getattr(tpu_client, "read_attachments", None)
     truth_fn = getattr(tpu_client, "attachment_truth", None)
@@ -128,15 +128,9 @@ def attachment_drift(client: Client, node_name: str, tpu_client,
         try:
             # whole chips AND dynamic sub-slice resources both count as
             # TPU allocations in the kubelet's view
-            for pr in podres_client.list():
-                ids = {
-                    d for cd in pr.devices
-                    if cd.resource_name == constants.RESOURCE_TPU
-                    or is_slice_resource(cd.resource_name)
-                    for d in cd.device_ids
-                }
-                if ids:
-                    kubelet_allocs[(pr.namespace, pr.name)] = ids
+            kubelet_allocs = podres_client.allocations(
+                lambda r: r == constants.RESOURCE_TPU
+                or is_slice_resource(r))
         except Exception:   # socket gone mid-flight: not evidence
             logger.warning("pod-resources API unreachable", exc_info=True)
             kubelet_allocs = {}
@@ -146,7 +140,12 @@ def attachment_drift(client: Client, node_name: str, tpu_client,
     for pod in client.list("Pod"):
         if pod.spec.node_name == node_name and pod.metadata.uid:
             bound[pod.metadata.uid] = pod
-            bound_names.add((pod.metadata.namespace, pod.metadata.name))
+            # only an ACTIVE pod legitimately holds devices — a Succeeded/
+            # Failed pod whose devices the kubelet still lists is exactly
+            # the leak the ghost checks exist to surface, so the (ns, name)
+            # join must mirror the UID check's phase filter
+            if pod.status.phase in ("Pending", "Running"):
+                bound_names.add((pod.metadata.namespace, pod.metadata.name))
 
     table_uids = {e.get("pod_uid") for e in table.values() if e.get("pod_uid")}
     proc_uids = {u for uids in proc_truth.values() for u in uids

@@ -145,10 +145,15 @@ def _splash_kernel_cached(q_heads: int, s_q: int, s_kv: int, causal: bool,
     mask = mk.MultiHeadMask([mask_cls((s_q, s_kv)) for _ in range(q_heads)])
     # residual_checkpoint_name exposes the kernel's logsumexp residuals to
     # named remat policies (models/transformer._remat_policy saves
-    # "attn_residuals" so backward never re-runs the forward kernel)
-    return sk.make_splash_mha(
-        mask=mask, block_sizes=bs, head_shards=1, q_seq_shards=1,
-        residual_checkpoint_name="attn_residuals")
+    # "attn_residuals" so backward never re-runs the forward kernel).
+    # ensure_compile_time_eval: kernel construction materializes block-level
+    # mask-info arrays; when first invoked inside a jit trace those would be
+    # tracers, and this cache would leak them into later traces
+    # (UnexpectedTracerError observed on v5e) — force them concrete here.
+    with jax.ensure_compile_time_eval():
+        return sk.make_splash_mha(
+            mask=mask, block_sizes=bs, head_shards=1, q_seq_shards=1,
+            residual_checkpoint_name="attn_residuals")
 
 
 def effective_impl(q_shape, k_shape, *, force_xla: bool = False) -> str:

@@ -228,3 +228,27 @@ def test_slice_resources_count_as_kubelet_allocations():
         kubelet_pods=[mock_pr("team-a", "svc-0", "s0",
                               resource="nos.ai/tpu-slice-1x1")])
     assert out == ""
+
+
+def test_kubelet_alloc_for_completed_pod_is_ghost():
+    # a Succeeded pod whose devices the kubelet still lists is a leaked
+    # allocation: the (ns, name) join must mirror the UID ghost check's
+    # Pending/Running filter, not treat any bound pod as legitimate
+    out = drift_rig(
+        bound_pods=[("team-a", "done-0", "uid-1", "Succeeded")],
+        kubelet_pods=[mock_pr("team-a", "done-0", "0")])
+    assert "ghost-alloc:team-a/done-0" in out
+
+
+def test_allocations_accepts_resource_predicate():
+    from nos_tpu.agents.podresources import MockPodResourcesClient
+    client = MockPodResourcesClient(pods=[
+        mock_pr("a", "p0", "0"),
+        mock_pr("a", "p1", "1", resource="nos.ai/tpu-slice-2x2"),
+        mock_pr("a", "p2", "2", resource="cpu"),
+    ])
+    allocs = client.allocations(
+        lambda r: r == TPU or r.startswith("nos.ai/tpu-slice"))
+    assert set(allocs) == {("a", "p0"), ("a", "p1")}
+    # exact-name form still works
+    assert set(client.allocations(TPU)) == {("a", "p0")}

@@ -1,0 +1,56 @@
+"""Splash-kernel construction invariants (CPU-safe: construction only —
+execution needs the TPU Mosaic toolchain and is exercised by bench_attn).
+
+Regression for the round-4 hardware failure: the first splash dispatch
+happens inside a jit trace (the model's train step), kernel construction
+materializes block-level mask-info arrays, and ``functools.cache`` kept
+those TRACERS alive into later traces — ``UnexpectedTracerError:
+... int8[1,4,4] wrapped in a DynamicJaxprTracer`` on v5e the moment the
+grad trace reused the cached kernel. ``_splash_kernel_cached`` now
+constructs under ``jax.ensure_compile_time_eval`` so cached mask info is
+concrete no matter which trace context builds it first.
+"""
+import jax
+import jax.numpy as jnp
+import pytest
+
+from nos_tpu.ops import attention as at
+
+
+def _tracer_leaves(obj):
+    return [l for l in jax.tree.leaves(obj)
+            if isinstance(l, jax.core.Tracer)]
+
+
+@pytest.mark.skipif(at._splash_mod() is None,
+                    reason="splash-attention module unavailable")
+def test_kernel_built_inside_trace_caches_no_tracers():
+    at._splash_kernel_cached.cache_clear()
+    built = {}
+
+    @jax.jit
+    def build(x):
+        # construction at trace time — exactly how the train step's first
+        # attention call reaches _splash_kernel
+        built["kernel"] = at._splash_kernel(2, 256, 256, True)
+        return x + 1
+
+    build(jnp.zeros(()))
+    assert not _tracer_leaves(built["kernel"]), (
+        "mask-info arrays captured as tracers: the cache would leak them "
+        "into every later trace")
+
+    # the cache must serve the same concrete kernel outside the trace
+    again = at._splash_kernel(2, 256, 256, True)
+    assert not _tracer_leaves(again)
+
+
+@pytest.mark.skipif(at._splash_mod() is None,
+                    reason="splash-attention module unavailable")
+def test_kernel_cache_distinguishes_block_overrides(monkeypatch):
+    at._splash_kernel_cached.cache_clear()
+    k_default = at._splash_kernel(2, 512, 512, True)
+    monkeypatch.setenv("NOS_TPU_SPLASH_BQ", "256")
+    k_small = at._splash_kernel(2, 512, 512, True)
+    # env override must reach the kernel, not be swallowed by the cache
+    assert k_default is not k_small
