@@ -22,6 +22,7 @@ from nos_tpu.quota.controller import (
     CompositeElasticQuotaReconciler,
     ElasticQuotaReconciler,
 )
+from nos_tpu.quota.pdb import PdbReconciler
 from nos_tpu.tpu.resource_calc import ResourceCalculator
 
 
@@ -34,6 +35,10 @@ def build(server, config: Optional[OperatorConfig] = None) -> Manager:
     mgr = Manager(server, leader_election=cfg.leader_election_config("operator"))
     mgr.add_controller(ElasticQuotaReconciler(calc).controller())
     mgr.add_controller(CompositeElasticQuotaReconciler(calc).controller())
+    # disruption-controller analog: this control plane IS the cluster, so
+    # PDB status (consumed by the scheduler's preemption ordering) is
+    # maintained here rather than by kube-controller-manager
+    mgr.add_controller(PdbReconciler().controller())
     return mgr
 
 
@@ -51,7 +56,8 @@ def main(argv: Optional[Sequence[str]] = None) -> None:
 
     cfg = OperatorConfig.from_yaml_file(args.config) if args.config \
         else OperatorConfig()
-    serve.setup_logging(cfg.log_level)
+    serve.setup_logging(args.log_level if args.log_level is not None
+                        else cfg.log_level)
     server = serve.connect(args)
     webhook = None
     if args.webhook_certs:

@@ -32,6 +32,9 @@ from nos_tpu.kube.objects import (  # noqa: F401
     NodeStatus,
     ConfigMap,
     OwnerReference,
+    PodDisruptionBudget,
+    PodDisruptionBudgetSpec,
+    PodDisruptionBudgetStatus,
 )
 from nos_tpu.kube.quantity import parse_quantity, format_quantity  # noqa: F401
 from nos_tpu.kube.apiserver import ApiServer, Conflict, NotFound, AlreadyExists  # noqa: F401

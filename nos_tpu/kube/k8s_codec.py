@@ -38,6 +38,9 @@ from nos_tpu.kube.objects import (
     OwnerReference,
     Pod,
     PodCondition,
+    PodDisruptionBudget,
+    PodDisruptionBudgetSpec,
+    PodDisruptionBudgetStatus,
     PodSpec,
     PodStatus,
     Taint,
@@ -57,6 +60,7 @@ ROUTES: Dict[str, Tuple[str, str, bool]] = {
     "ElasticQuota": (f"{GROUP_CRD}/v1alpha1", "elasticquotas", True),
     "CompositeElasticQuota": (f"{GROUP_CRD}/v1alpha1", "compositeelasticquotas", True),
     "Lease": ("coordination.k8s.io/v1", "leases", True),
+    "PodDisruptionBudget": ("policy/v1", "poddisruptionbudgets", True),
 }
 
 
@@ -469,6 +473,52 @@ def lease_from_k8s(d: dict) -> Lease:
     )
 
 
+def pdb_to_k8s(p: PodDisruptionBudget) -> dict:
+    spec: dict = {}
+    if p.spec.selector:
+        spec["selector"] = {"matchLabels": dict(p.spec.selector)}
+    if p.spec.min_available is not None:
+        spec["minAvailable"] = int(p.spec.min_available)
+    if p.spec.max_unavailable is not None:
+        spec["maxUnavailable"] = int(p.spec.max_unavailable)
+    return {
+        "apiVersion": "policy/v1", "kind": "PodDisruptionBudget",
+        "metadata": _meta_to_k8s(p.metadata),
+        "spec": spec,
+        "status": {
+            "disruptionsAllowed": int(p.status.disruptions_allowed),
+            "currentHealthy": int(p.status.current_healthy),
+            "desiredHealthy": int(p.status.desired_healthy),
+            "expectedPods": int(p.status.expected_pods),
+            **({"disruptedPods": dict(p.status.disrupted_pods)}
+               if p.status.disrupted_pods else {}),
+        },
+    }
+
+
+def pdb_from_k8s(d: dict) -> PodDisruptionBudget:
+    spec = d.get("spec") or {}
+    status = d.get("status") or {}
+    sel = (spec.get("selector") or {}).get("matchLabels") or {}
+    mn = spec.get("minAvailable")
+    mx = spec.get("maxUnavailable")
+    return PodDisruptionBudget(
+        metadata=_meta_from_k8s(d.get("metadata") or {}),
+        spec=PodDisruptionBudgetSpec(
+            selector=dict(sel),
+            min_available=int(mn) if mn is not None else None,
+            max_unavailable=int(mx) if mx is not None else None,
+        ),
+        status=PodDisruptionBudgetStatus(
+            disruptions_allowed=int(status.get("disruptionsAllowed", 0)),
+            current_healthy=int(status.get("currentHealthy", 0)),
+            desired_healthy=int(status.get("desiredHealthy", 0)),
+            expected_pods=int(status.get("expectedPods", 0)),
+            disrupted_pods=dict(status.get("disruptedPods") or {}),
+        ),
+    )
+
+
 # ---------------------------------------------------------------------------
 # dispatch
 # ---------------------------------------------------------------------------
@@ -480,6 +530,7 @@ _TO = {
     "ElasticQuota": lambda q: _eq_to_k8s(q, "ElasticQuota"),
     "CompositeElasticQuota": lambda q: _eq_to_k8s(q, "CompositeElasticQuota"),
     "Lease": lease_to_k8s,
+    "PodDisruptionBudget": pdb_to_k8s,
 }
 
 _FROM = {
@@ -489,6 +540,7 @@ _FROM = {
     "ElasticQuota": eq_from_k8s,
     "CompositeElasticQuota": ceq_from_k8s,
     "Lease": lease_from_k8s,
+    "PodDisruptionBudget": pdb_from_k8s,
 }
 
 

@@ -235,6 +235,55 @@ class ConfigMap:
     KIND = "ConfigMap"
 
 
+@dataclass
+class PodDisruptionBudgetSpec:
+    """policy/v1 PDBSpec: exactly one of min_available / max_unavailable
+    is meaningful (k8s validation enforces mutual exclusion); values are
+    absolute counts (the string-percentage form is not modeled — TPU
+    training gangs are counted in pods, not fractions)."""
+    selector: Dict[str, str] = field(default_factory=dict)  # matchLabels
+    min_available: Optional[int] = None
+    max_unavailable: Optional[int] = None
+
+
+@dataclass
+class PodDisruptionBudgetStatus:
+    """Mirror of policy/v1 PDBStatus as the preemptor consumes it
+    (reference capacity_scheduling.go:850-889 reads DisruptionsAllowed
+    and DisruptedPods): maintained by quota/pdb.PdbController — this
+    control plane IS the cluster, so the kube disruption-controller's
+    job lands here."""
+    disruptions_allowed: int = 0
+    current_healthy: int = 0
+    desired_healthy: int = 0
+    expected_pods: int = 0
+    # pods already being disrupted (eviction issued, deletion pending):
+    # name -> creation timestamp string; they never double-decrement
+    disrupted_pods: Dict[str, str] = field(default_factory=dict)
+
+
+@dataclass
+class PodDisruptionBudget:
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    spec: PodDisruptionBudgetSpec = field(
+        default_factory=PodDisruptionBudgetSpec)
+    status: PodDisruptionBudgetStatus = field(
+        default_factory=PodDisruptionBudgetStatus)
+
+    KIND = "PodDisruptionBudget"
+
+    def matches(self, pod: "Pod") -> bool:
+        """Same-namespace label match (empty selector matches nothing,
+        per the k8s PDB convention — an empty selector PDB would
+        otherwise budget every pod in the namespace)."""
+        if pod.metadata.namespace != self.metadata.namespace:
+            return False
+        if not self.spec.selector:
+            return False
+        labels = pod.metadata.labels or {}
+        return all(labels.get(k) == v for k, v in self.spec.selector.items())
+
+
 def kind_of(obj) -> str:
     k = getattr(obj, "KIND", None)
     if k is None:

@@ -1,0 +1,104 @@
+"""PodDisruptionBudget status maintenance — the disruption-controller
+analog.
+
+The reference's preemptor consumes ``pdb.Status.DisruptionsAllowed`` /
+``DisruptedPods`` as maintained by kube-controller-manager's disruption
+controller (capacity_scheduling.go:850-889 only reads them). This control
+plane IS the cluster here, so the maintenance job lands in this module:
+recompute each PDB's status from the live pods matching its selector.
+
+Semantics (k8s disruption controller, pared to the absolute-count form
+the object model carries):
+
+- ``expected_pods``   = pods matching the selector (any phase but
+  Succeeded/Failed)
+- ``current_healthy`` = matching pods with phase Running
+- ``desired_healthy`` = ``min_available``, or
+  ``expected_pods - max_unavailable`` for the max-unavailable form
+- ``disruptions_allowed`` = max(0, current_healthy - desired_healthy),
+  minus in-flight disruptions (``disrupted_pods`` entries whose pod still
+  exists — entries for pods that finished deleting are pruned)
+"""
+from __future__ import annotations
+
+from typing import List, Tuple
+
+from nos_tpu.kube.apiserver import NotFound
+from nos_tpu.kube.client import Client
+from nos_tpu.kube.controller import Controller, Request, Result, Watch
+from nos_tpu.kube.objects import Pod, PodDisruptionBudget
+
+
+def compute_status(
+    pdb: PodDisruptionBudget, pods: List[Pod]
+) -> Tuple[int, int, int, int]:
+    """(disruptions_allowed, current_healthy, desired_healthy,
+    expected_pods) for ``pdb`` against ``pods`` (same-namespace pod list;
+    matching is re-checked here)."""
+    matching = [p for p in pods if pdb.matches(p)
+                and p.status.phase not in ("Succeeded", "Failed")]
+    expected = len(matching)
+    healthy = sum(1 for p in matching if p.status.phase == "Running")
+    if pdb.spec.min_available is not None:
+        desired = pdb.spec.min_available
+    elif pdb.spec.max_unavailable is not None:
+        desired = max(0, expected - pdb.spec.max_unavailable)
+    else:
+        # neither bound set: nothing is budgeted (k8s validation rejects
+        # this spec; tolerate it as "no protection" rather than crash)
+        desired = 0
+    live_names = {p.metadata.name for p in matching}
+    in_flight = sum(1 for n in pdb.status.disrupted_pods if n in live_names)
+    allowed = max(0, healthy - desired - in_flight)
+    return allowed, healthy, desired, expected
+
+
+class PdbReconciler:
+    """Watches PDBs + pods; keeps ``status`` current. Mapper fans a pod
+    event out to every PDB in the pod's namespace (selector match is
+    cheap and the controller layer dedupes requests)."""
+
+    def reconcile(self, client: Client, req: Request) -> Result:
+        if req.name == "*":
+            for pdb in client.list("PodDisruptionBudget",
+                                   namespace=req.namespace):
+                self._reconcile_one(client, pdb)
+            return Result()
+        try:
+            pdb = client.get("PodDisruptionBudget", req.name, req.namespace)
+        except NotFound:
+            return Result()
+        self._reconcile_one(client, pdb)
+        return Result()
+
+    def _reconcile_one(self, client: Client, pdb: PodDisruptionBudget) -> None:
+        pods = [p for p in client.list("Pod", namespace=pdb.metadata.namespace)]
+        allowed, healthy, desired, expected = compute_status(pdb, pods)
+        live = {p.metadata.name for p in pods
+                if p.status.phase not in ("Succeeded", "Failed")}
+        pruned = {n: t for n, t in pdb.status.disrupted_pods.items()
+                  if n in live}
+        if (allowed, healthy, desired, expected, pruned) != (
+            pdb.status.disruptions_allowed, pdb.status.current_healthy,
+            pdb.status.desired_healthy, pdb.status.expected_pods,
+            pdb.status.disrupted_pods,
+        ):
+            def apply(o):
+                o.status.disruptions_allowed = allowed
+                o.status.current_healthy = healthy
+                o.status.desired_healthy = desired
+                o.status.expected_pods = expected
+                o.status.disrupted_pods = pruned
+
+            client.patch("PodDisruptionBudget", pdb.metadata.name,
+                         pdb.metadata.namespace, apply)
+
+    def controller(self) -> Controller:
+        def pod_to_pdbs(ev) -> List[Request]:
+            return [Request(name="*", namespace=ev.obj.metadata.namespace)]
+
+        return Controller(
+            "poddisruptionbudget",
+            self.reconcile,
+            [Watch("PodDisruptionBudget"), Watch("Pod", mapper=pod_to_pdbs)],
+        )

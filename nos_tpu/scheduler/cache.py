@@ -21,7 +21,8 @@ from typing import Dict, List, Optional, Tuple
 
 from nos_tpu.kube.client import Client
 
-KINDS = ("Pod", "Node", "ElasticQuota", "CompositeElasticQuota")
+KINDS = ("Pod", "Node", "ElasticQuota", "CompositeElasticQuota",
+         "PodDisruptionBudget")
 
 
 def _key(obj) -> Tuple[str, str]:

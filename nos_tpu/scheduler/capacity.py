@@ -19,9 +19,14 @@ pkg/scheduler/plugins/capacityscheduling/capacity_scheduling.go:
   After removing potential victims it re-checks fit and quota ceilings, then
   reprieves as many victims as possible highest-priority-first (:635-673).
 - **Reserve/Unreserve** (:343-369): live ``used`` bookkeeping.
-
-PodDisruptionBudget-violation ordering is not modeled (no PDB analog here);
-everything else mirrors the reference's decision structure.
+- **PDB-aware ordering** (:634, :850-889): potential victims are split
+  into PDB-violating / non-violating by simulating each budget's
+  ``disruptions_allowed`` across the victim list (``disrupted_pods``
+  entries never double-decrement); violating victims are reprieved FIRST
+  (best chance to be spared), and candidate nodes are ranked by fewest
+  violating victims before fewest victims. Budgets come from
+  ``sync_pdbs`` (the scheduler's informer pass); status is maintained by
+  quota/pdb.PdbReconciler — the disruption-controller analog.
 """
 from __future__ import annotations
 
@@ -29,7 +34,9 @@ from dataclasses import dataclass
 from typing import Dict, List, Optional, Tuple
 
 from nos_tpu import constants
-from nos_tpu.kube.objects import Pod, ResourceList, add_resources
+from nos_tpu.kube.objects import (
+    Pod, PodDisruptionBudget, ResourceList, add_resources,
+)
 from nos_tpu.quota.info import QuotaInfo, QuotaInfos
 from nos_tpu.scheduler import framework as fw
 from nos_tpu.tpu.resource_calc import ResourceCalculator
@@ -45,6 +52,35 @@ class _PreFilterState:
     pod_req: ResourceList
 
 
+def filter_units_with_pdb_violation(
+    units: List[List[Pod]], pdbs: List[PodDisruptionBudget]
+) -> Tuple[List[List[Pod]], List[List[Pod]]]:
+    """Split victim units into (violating, non_violating) by simulating
+    each budget's ``disruptions_allowed`` across the list in order —
+    reference filterPodsWithPDBViolation (capacity_scheduling.go:850-889)
+    lifted to gang units. A pod already in a budget's ``disrupted_pods``
+    never double-decrements; a unit is violating when evicting it drives
+    any matched budget's remaining allowance negative. Order matters:
+    callers pass units most-important-first so the budget is "spent" on
+    the pods most likely to survive reprieve."""
+    allowed = [p.status.disruptions_allowed for p in pdbs]
+    violating: List[List[Pod]] = []
+    non_violating: List[List[Pod]] = []
+    for unit in units:
+        violates = False
+        for pod in unit:
+            for i, pdb in enumerate(pdbs):
+                if not pdb.matches(pod):
+                    continue
+                if pod.metadata.name in pdb.status.disrupted_pods:
+                    continue
+                allowed[i] -= 1
+                if allowed[i] < 0:
+                    violates = True
+        (violating if violates else non_violating).append(unit)
+    return violating, non_violating
+
+
 class CapacityScheduling:
     name = "CapacityScheduling"
 
@@ -57,6 +93,9 @@ class CapacityScheduling:
         # standalone unit use; falls back to the default filter suite.
         self.framework = None
         self._default_framework = fw.SchedulerFramework(calculator=self.calc)
+        # PodDisruptionBudgets for victim ordering (informer-fed via
+        # sync_pdbs; empty = no budgets, every victim is non-violating)
+        self.pdbs: List[PodDisruptionBudget] = []
 
     def _fits(self, state: fw.CycleState, pod: Pod, node_info: fw.NodeInfo) -> bool:
         nominated: List[Pod] = state.get(NOMINATED_STATE) or []
@@ -108,6 +147,12 @@ class CapacityScheduling:
                 new.used = old.used
                 new.pods = old.pods
         self.quotas = infos
+
+    def sync_pdbs(self, pdbs: List[PodDisruptionBudget]) -> None:
+        """Refresh the PDB view (reference pdbLister,
+        capacity_scheduling.go:54/:332 — a lister snapshot per preemption
+        pass, here fed by the scheduler's informer cache)."""
+        self.pdbs = list(pdbs)
 
     def reset_accounting(self) -> None:
         """Zero all used/pod bookkeeping (the scheduler loop rebuilds it
@@ -179,17 +224,24 @@ class CapacityScheduling:
         the pod."""
         best_node: Optional[str] = None
         best_victims: Optional[List[Pod]] = None
+        best_rank: Optional[Tuple[int, int]] = None
         gang_index = self._gang_index(snapshot)  # once; reused per node
         for name, info in sorted(snapshot.items()):
             # the what-if fit must count pods already nominated to this node
             # by earlier preemption passes (their capacity is spoken for)
             state[NOMINATED_STATE] = snapshot.nominated_for(name, exclude=pod)
-            victims = self._select_victims_on_node(state, pod, info, gang_index)
-            if victims is None:
+            selected = self._select_victims_on_node(
+                state, pod, info, gang_index)
+            if selected is None:
                 continue
-            if best_victims is None or len(victims) < len(best_victims):
+            victims, num_violating = selected
+            # reference pickOneNodeForPreemption: fewest PDB violations
+            # outranks fewest victims (default_preemption.go ordering)
+            rank = (num_violating, len(victims))
+            if best_rank is None or rank < best_rank:
                 best_node = name
                 best_victims = victims
+                best_rank = rank
         state.pop(NOMINATED_STATE, None)
         if best_node is None:
             return None, fw.Status.unschedulable("preemption found no candidate")
@@ -242,11 +294,13 @@ class CapacityScheduling:
         pod: Pod,
         node_info: fw.NodeInfo,
         gang_index: Optional[Dict[object, List[Pod]]] = None,
-    ) -> Optional[List[Pod]]:
+    ) -> Optional[Tuple[List[Pod], int]]:
         """Reference SelectVictimsOnNode (capacity_scheduling.go:468-675),
-        extended with gang-aware all-or-nothing victim units. Returns the
-        victim list (gang victims include members on OTHER nodes), or None
-        if preempting on this node cannot make the pod schedulable."""
+        extended with gang-aware all-or-nothing victim units. Returns
+        (victims, num_violating) — the victim list (gang victims include
+        members on OTHER nodes) and how many of those victims violate a
+        PodDisruptionBudget — or None if preempting on this node cannot
+        make the pod schedulable."""
         pf: _PreFilterState = state.get(PRE_FILTER_STATE) or _PreFilterState(
             self.calc.compute_pod_request(pod)
         )
@@ -338,15 +392,24 @@ class CapacityScheduling:
 
         # Reprieve as many units as possible, highest priority first
         # (reference reprieve loop :635-673) — a gang reprieves (or dies)
-        # whole, never partially.
+        # whole, never partially. PDB-violating units are reprieved FIRST
+        # (:634: they get the best chance of being spared); the budget
+        # simulation sees units most-important-first, matching the
+        # reference's MoreImportantPod pre-sort (:628-630).
         victims: List[Pod] = []
-        order = sorted(
+        importance = sorted(
             removed,
             key=lambda ul: (
                 -max(p.priority() for p in ul[0]),
                 min(p.metadata.name for p in ul[0]),
             ),
         )
+        violating_units, _ = filter_units_with_pdb_violation(
+            [u for u, _ in importance], self.pdbs)
+        violating_ids = {id(u) for u in violating_units}
+        order = ([ul for ul in importance if id(ul[0]) in violating_ids]
+                 + [ul for ul in importance if id(ul[0]) not in violating_ids])
+        num_violating = 0
         for unit, local in order:
             for v in local:
                 sim.add_pod(v)
@@ -369,4 +432,6 @@ class CapacityScheduling:
                     if v_info is not None:
                         v_info.delete_pod_if_present(v)
                 victims.extend(unit)
-        return victims
+                if id(unit) in violating_ids:
+                    num_violating += len(unit)
+        return victims, num_violating

@@ -63,6 +63,7 @@ class Scheduler:
             self.cache.list("ElasticQuota"),
             self.cache.list("CompositeElasticQuota"),
         )
+        self.capacity.sync_pdbs(self.cache.list("PodDisruptionBudget"))
         self.capacity.reset_accounting()
         nodes = self.cache.list("Node")
         assigned = []
@@ -282,6 +283,40 @@ class Scheduler:
         return Result()
 
     # ------------------------------------------------------------------
+    def _record_disruptions(self, client, victims) -> None:
+        """Before deleting victims, record them in every matching PDB's
+        ``status.disrupted_pods`` (the eviction-API side effect kube's
+        disruption controller relies on): until the deletion lands, the
+        in-flight entry keeps ``disruptions_allowed`` honest so a
+        concurrent preemption pass can't spend the same budget twice;
+        quota/pdb.PdbReconciler prunes entries once the pod is gone.
+        Best-effort — a conflict just means the reconciler got there
+        first, and victim deletion must not be blocked."""
+        import time
+
+        stamp = time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
+        for pdb in self.capacity.pdbs:
+            names = [v.metadata.name for v in victims if pdb.matches(v)]
+            if not names:
+                continue
+
+            def mark(o, names=names):
+                for n in names:
+                    o.status.disrupted_pods.setdefault(n, stamp)
+                o.status.disruptions_allowed = max(
+                    0, o.status.disruptions_allowed - len(names))
+
+            try:
+                updated = client.patch(
+                    "PodDisruptionBudget", pdb.metadata.name,
+                    pdb.metadata.namespace, mark)
+                self.cache.upsert("PodDisruptionBudget", updated)
+            except Exception:
+                logger.warning("failed to record disruption in PDB %s/%s",
+                               pdb.metadata.namespace, pdb.metadata.name,
+                               exc_info=True)
+
+    # ------------------------------------------------------------------
     def _find_node(self, state, pod, snapshot):
         return self.framework.find_feasible(state, pod, snapshot)
 
@@ -289,6 +324,7 @@ class Scheduler:
         nominated, post_st = self.framework.run_post_filter(state, pod, snapshot)
         if post_st.success and nominated is not None:
             victims = state.get("capacity/victims") or []
+            self._record_disruptions(client, victims)
             for v in victims:
                 try:
                     client.delete("Pod", v.metadata.name, v.metadata.namespace)

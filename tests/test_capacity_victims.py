@@ -62,7 +62,8 @@ def rig(quota_mins, running, maxes=None, tpu=8, nodes=None):
 def select(cs, snap, pod, node_name="n1"):
     state = {}
     cs.pre_filter(state, pod, snap)   # populates state; status ignored
-    return cs._select_victims_on_node(state, pod, snap[node_name])
+    out = cs._select_victims_on_node(state, pod, snap[node_name])
+    return out[0] if out is not None else None
 
 
 def names(victims):

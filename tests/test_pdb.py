@@ -1,0 +1,300 @@
+"""PodDisruptionBudget: status maintenance (quota/pdb — the disruption-
+controller analog) and PDB-aware preemption ordering
+(scheduler/capacity.filter_units_with_pdb_violation + reprieve order +
+candidate-node ranking — reference capacity_scheduling.go:634, :850-889).
+"""
+from nos_tpu.kube import ApiServer, Manager
+from nos_tpu.kube.client import Client
+from nos_tpu.kube.objects import (
+    Container, ObjectMeta, Pod, PodDisruptionBudget,
+    PodDisruptionBudgetSpec, PodSpec, PodStatus,
+)
+from nos_tpu.quota.pdb import PdbReconciler, compute_status
+from nos_tpu.scheduler.capacity import filter_units_with_pdb_violation
+
+TPU = "google.com/tpu"
+
+
+def mk_pod(name, ns="team-a", phase="Running", labels=None, node="n1",
+           priority=0, tpu=1):
+    return Pod(
+        metadata=ObjectMeta(name=name, namespace=ns, uid=f"uid-{name}",
+                            labels=labels or {}),
+        spec=PodSpec(containers=[Container(requests={TPU: tpu})],
+                     node_name=node, priority=priority),
+        status=PodStatus(phase=phase),
+    )
+
+
+def mk_pdb(name="budget", ns="team-a", selector=None, min_available=None,
+           max_unavailable=None, allowed=None, disrupted=None):
+    pdb = PodDisruptionBudget(
+        metadata=ObjectMeta(name=name, namespace=ns),
+        spec=PodDisruptionBudgetSpec(
+            selector=selector if selector is not None else {"app": "train"},
+            min_available=min_available, max_unavailable=max_unavailable,
+        ),
+    )
+    if allowed is not None:
+        pdb.status.disruptions_allowed = allowed
+    if disrupted is not None:
+        pdb.status.disrupted_pods = disrupted
+    return pdb
+
+
+# ---------------------------------------------------------------------------
+# compute_status
+# ---------------------------------------------------------------------------
+
+def test_min_available_budget():
+    pods = [mk_pod(f"t-{i}", labels={"app": "train"}) for i in range(4)]
+    pdb = mk_pdb(min_available=3)
+    allowed, healthy, desired, expected = compute_status(pdb, pods)
+    assert (allowed, healthy, desired, expected) == (1, 4, 3, 4)
+
+
+def test_max_unavailable_budget():
+    pods = [mk_pod(f"t-{i}", labels={"app": "train"}) for i in range(4)]
+    pdb = mk_pdb(max_unavailable=1)
+    allowed, healthy, desired, expected = compute_status(pdb, pods)
+    assert (allowed, desired) == (1, 3)
+
+
+def test_completed_pods_leave_the_budget():
+    pods = [mk_pod("t-0", labels={"app": "train"}),
+            mk_pod("t-1", labels={"app": "train"}, phase="Succeeded")]
+    pdb = mk_pdb(min_available=1)
+    allowed, healthy, desired, expected = compute_status(pdb, pods)
+    assert (healthy, expected, allowed) == (1, 1, 0)
+
+
+def test_pending_pods_count_expected_not_healthy():
+    pods = [mk_pod("t-0", labels={"app": "train"}),
+            mk_pod("t-1", labels={"app": "train"}, phase="Pending")]
+    pdb = mk_pdb(min_available=1)
+    allowed, healthy, desired, expected = compute_status(pdb, pods)
+    assert (healthy, expected, allowed) == (1, 2, 0)
+
+
+def test_in_flight_disruption_reserves_budget():
+    pods = [mk_pod(f"t-{i}", labels={"app": "train"}) for i in range(4)]
+    pdb = mk_pdb(min_available=2, disrupted={"t-0": "ts"})
+    allowed, *_ = compute_status(pdb, pods)
+    assert allowed == 1  # 4 healthy - 2 desired - 1 in flight
+
+
+def test_empty_selector_budgets_nothing():
+    pods = [mk_pod("t-0", labels={"app": "train"})]
+    pdb = mk_pdb(selector={}, min_available=1)
+    allowed, healthy, desired, expected = compute_status(pdb, pods)
+    assert (healthy, expected) == (0, 0)
+
+
+# ---------------------------------------------------------------------------
+# PdbReconciler end-to-end (ApiServer + Manager pump)
+# ---------------------------------------------------------------------------
+
+def _rig():
+    server = ApiServer()
+    mgr = Manager(server)
+    mgr.add_controller(PdbReconciler().controller())
+    return server, mgr
+
+
+def test_reconciler_maintains_status():
+    server, mgr = _rig()
+    server.create(mk_pdb(min_available=1))
+    for i in range(3):
+        server.create(mk_pod(f"t-{i}", labels={"app": "train"}))
+    mgr.run_until_idle()
+    pdb = server.get("PodDisruptionBudget", "budget", "team-a")
+    assert pdb.status.disruptions_allowed == 2
+    assert pdb.status.current_healthy == 3
+    assert pdb.status.expected_pods == 3
+
+    server.delete("Pod", "t-0", "team-a")
+    server.delete("Pod", "t-1", "team-a")
+    mgr.run_until_idle()
+    pdb = server.get("PodDisruptionBudget", "budget", "team-a")
+    assert pdb.status.disruptions_allowed == 0
+    assert pdb.status.current_healthy == 1
+
+
+def test_reconciler_prunes_finished_disrupted_pods():
+    server, mgr = _rig()
+    server.create(mk_pdb(min_available=0, disrupted={"gone": "ts"}))
+    server.create(mk_pod("t-0", labels={"app": "train"}))
+    mgr.run_until_idle()
+    pdb = server.get("PodDisruptionBudget", "budget", "team-a")
+    assert pdb.status.disrupted_pods == {}
+    assert pdb.status.disruptions_allowed == 1
+
+
+# ---------------------------------------------------------------------------
+# filter_units_with_pdb_violation
+# ---------------------------------------------------------------------------
+
+def test_budget_spent_in_order():
+    a = [mk_pod("a", labels={"app": "train"})]
+    b = [mk_pod("b", labels={"app": "train"})]
+    pdb = mk_pdb(allowed=1, min_available=1)
+    violating, ok = filter_units_with_pdb_violation([a, b], [pdb])
+    assert ok == [a]            # first unit consumes the single allowance
+    assert violating == [b]
+
+
+def test_gang_unit_spends_budget_per_member():
+    gang = [mk_pod("g-0", labels={"app": "train"}),
+            mk_pod("g-1", labels={"app": "train"})]
+    pdb = mk_pdb(allowed=1, min_available=1)
+    violating, ok = filter_units_with_pdb_violation([gang], [pdb])
+    assert violating == [gang]  # 2 members vs allowance 1
+
+
+def test_disrupted_pods_never_double_decrement():
+    a = [mk_pod("a", labels={"app": "train"})]
+    pdb = mk_pdb(allowed=0, min_available=1, disrupted={"a": "ts"})
+    violating, ok = filter_units_with_pdb_violation([a], [pdb])
+    assert ok == [a]
+
+
+def test_cross_namespace_pdb_does_not_match():
+    a = [mk_pod("a", ns="team-b", labels={"app": "train"})]
+    pdb = mk_pdb(allowed=0, min_available=1)   # ns team-a
+    violating, ok = filter_units_with_pdb_violation([a], [pdb])
+    assert ok == [a]
+
+
+# ---------------------------------------------------------------------------
+# preemption integration (CapacityScheduling)
+# ---------------------------------------------------------------------------
+
+def _capacity_rig(pods, pdbs, nodes):
+    from nos_tpu.quota.info import QuotaInfo
+    from nos_tpu.scheduler import framework as fw
+    from nos_tpu.scheduler.capacity import CapacityScheduling
+
+    cs = CapacityScheduling()
+    # team-a min 2: the 1-chip preemptor lands over min (borrowing
+    # regime), making same-namespace lower-priority pods eligible victims
+    for ns, mn in {"team-a": 2, "team-b": 0}.items():
+        cs.quotas.add(QuotaInfo(name=f"eq-{ns}", namespace=ns,
+                                namespaces={ns}, min={TPU: mn},
+                                calculator=cs.calc))
+    snap = fw.Snapshot.build(nodes, pods, cs.calc)
+    for p in pods:
+        cs.track_pod(p)
+    cs.sync_pdbs(pdbs)
+    return cs, snap
+
+
+def _node(name, tpu=2):
+    from nos_tpu.kube.objects import Node, NodeStatus
+    return Node(metadata=ObjectMeta(name=name),
+                status=NodeStatus(capacity={TPU: tpu},
+                                  allocatable={TPU: tpu}))
+
+
+def test_pdb_flips_reprieve_order():
+    # one eviction suffices; without PDBs the higher-priority pod is
+    # reprieved first (lower-priority becomes victim). A PDB with no
+    # remaining allowance protecting the LOW-priority pod must flip it:
+    # the protected pod is reprieved first and spared, the unprotected
+    # high-priority pod becomes the victim.
+    low = mk_pod("low", priority=1, labels={"app": "train"})
+    high = mk_pod("high", priority=5, labels={"app": "other"})
+    preemptor = mk_pod("new", priority=10, node="")
+    pdb = mk_pdb(allowed=0, min_available=2)
+    cs, snap = _capacity_rig([low, high], [pdb], [_node("n1")])
+    state = {}
+    cs.pre_filter(state, preemptor, snap)
+    victims, num_violating = cs._select_victims_on_node(
+        state, preemptor, snap["n1"])
+    assert [v.metadata.name for v in victims] == ["high"]
+    assert num_violating == 0
+
+    # control: without the PDB the low-priority pod is the victim
+    cs2, snap2 = _capacity_rig([low, high], [], [_node("n1")])
+    state2 = {}
+    cs2.pre_filter(state2, preemptor, snap2)
+    victims2, _ = cs2._select_victims_on_node(state2, preemptor, snap2["n1"])
+    assert [v.metadata.name for v in victims2] == ["low"]
+
+
+def test_post_filter_prefers_node_without_pdb_violation():
+    # both nodes need one victim; n1's only candidate is PDB-protected
+    # (violating), n2's is not — rank (violations, victims) must pick n2
+    # even though n1 sorts first lexically.
+    v1 = mk_pod("v1", priority=1, labels={"app": "train"}, node="n1")
+    v2 = mk_pod("v2", priority=1, labels={"app": "other"}, node="n2")
+    preemptor = mk_pod("new", priority=10, node="")
+    pdb = mk_pdb(allowed=0, min_available=1)
+    cs, snap = _capacity_rig([v1, v2], [pdb],
+                             [_node("n1", tpu=1), _node("n2", tpu=1)])
+    state = {}
+    cs.pre_filter(state, preemptor, snap)
+    node, status = cs.post_filter(state, preemptor, snap)
+    assert status.success
+    assert node == "n2"
+    assert [v.metadata.name for v in state["capacity/victims"]] == ["v2"]
+
+
+def test_codec_roundtrip():
+    from nos_tpu.kube import k8s_codec as kc
+
+    pdb = mk_pdb(min_available=2, allowed=1, disrupted={"t-0": "ts"})
+    pdb.status.current_healthy = 3
+    wire = kc.to_k8s(pdb)
+    assert wire["apiVersion"] == "policy/v1"
+    assert wire["spec"]["selector"]["matchLabels"] == {"app": "train"}
+    back = kc.from_k8s(wire)
+    assert back.spec.min_available == 2
+    assert back.status.disruptions_allowed == 1
+    assert back.status.disrupted_pods == {"t-0": "ts"}
+    assert back.matches(mk_pod("x", labels={"app": "train"}))
+
+
+def test_preemption_records_disruption_in_pdb():
+    # the eviction-API side effect: before deleting a victim the
+    # scheduler writes it into every matching PDB's disrupted_pods and
+    # spends the allowance, so a concurrent pass can't double-spend;
+    # the reconciler prunes the entry once the deletion lands.
+    from nos_tpu import constants as C
+    from nos_tpu.api.quota import ElasticQuota, ElasticQuotaSpec
+    from nos_tpu.cmd import operator as op_cmd, scheduler as sched_cmd
+    from nos_tpu.kube.objects import Node, NodeStatus
+
+    server = ApiServer()
+    op = op_cmd.build(server)
+    sched = sched_cmd.build(server)
+    server.create(Node(metadata=ObjectMeta(name="n1"),
+                       status=NodeStatus(capacity={TPU: 1},
+                                         allocatable={TPU: 1})))
+    server.create(ElasticQuota(
+        metadata=ObjectMeta(name="eq-a", namespace="team-a"),
+        spec=ElasticQuotaSpec(min={TPU: 1})))  # preemptor lands over min
+    server.create(mk_pdb(min_available=0))     # allowance 1 once reconciled
+
+    victim = mk_pod("victim", labels={"app": "train"}, node="n1")
+    victim.spec.scheduler_name = C.SCHEDULER_NAME
+    server.create(victim)
+    op.run_until_idle()
+    assert server.get("PodDisruptionBudget", "budget",
+                      "team-a").status.disruptions_allowed == 1
+
+    urgent = mk_pod("urgent", node="", priority=10, phase="Pending")
+    urgent.spec.scheduler_name = C.SCHEDULER_NAME
+    server.create(urgent)
+    sched.run_until_idle()
+
+    import pytest as _pytest
+    with _pytest.raises(Exception):            # victim evicted
+        server.get("Pod", "victim", "team-a")
+    # the scheduler spent the budget and recorded the in-flight eviction
+    # (the operator has not reconciled yet, so the entry is still there
+    # unless it already pumped — accept either pruned-or-present, but the
+    # allowance must never exceed the recomputed truth)
+    op.run_until_idle()
+    pdb = server.get("PodDisruptionBudget", "budget", "team-a")
+    assert pdb.status.disrupted_pods == {}     # pruned after deletion
+    assert pdb.status.disruptions_allowed == 0  # no matching pods left
