@@ -57,41 +57,47 @@ def mfu_env(batch, policy, loss_chunk, attn="flash", **extra):
     return env
 
 
-# (name, argv, env-overrides, timeout_s) — ordered by artifact value:
-# the instrument-confirming r2 reproduction first, then the sweep points
-# projected to clear 40%, then splash (highest upside, highest compile
-# risk), then the inference plane. A flap mid-queue loses the tail, not
-# the head.
+# (name, argv, env-overrides, timeout_s, requires) — ordered by artifact
+# value: the instrument-confirming r2 reproduction first, then the sweep
+# points projected to clear 40%, then splash (highest upside, highest
+# compile risk), then the inference plane. A flap mid-queue loses the
+# tail, not the head. ``requires`` names a gate item: a publishable MFU
+# number from a kernel that failed (or never passed) its numerical
+# parity check is worthless, so dependents are SKIPPED unless the gate's
+# status is "ok" (the deleted tpu_queue.py enforced this with exit 1;
+# the attn_* timing diagnostics stay ungated on purpose — compile/timing
+# behavior is worth knowing even when the math is wrong).
 QUEUES = {
     "mfu": [
-        # parity gates first: an MFU number from a kernel that disagrees
-        # with the reference einsum is worthless (hack/attn_parity.py)
         ("parity_flash", ["hack/attn_parity.py"],
-         {"NOS_TPU_ATTN_IMPL": "flash"}, 1200),
-        ("mfu_b8_full_flash", ["bench_mfu.py"], mfu_env(8, "full", 0), 1500),
+         {"NOS_TPU_ATTN_IMPL": "flash"}, 1200, None),
+        ("mfu_b8_full_flash", ["bench_mfu.py"], mfu_env(8, "full", 0),
+         1500, "parity_flash"),
         ("mfu_b8_exceptmlp512", ["bench_mfu.py"],
-         mfu_env(8, "except_mlp", 512), 1500),
+         mfu_env(8, "except_mlp", 512), 1500, "parity_flash"),
         ("mfu_b16_exceptmlp512", ["bench_mfu.py"],
-         mfu_env(16, "except_mlp", 512), 1500),
+         mfu_env(16, "except_mlp", 512), 1500, "parity_flash"),
         ("mfu_b16_minimal512", ["bench_mfu.py"],
-         mfu_env(16, "minimal", 512), 1500),
+         mfu_env(16, "minimal", 512), 1500, "parity_flash"),
         ("mfu_b32_minimal512", ["bench_mfu.py"],
-         mfu_env(32, "minimal", 512), 1500),
+         mfu_env(32, "minimal", 512), 1500, "parity_flash"),
         ("parity_splash", ["hack/attn_parity.py"],
-         {"NOS_TPU_ATTN_IMPL": "splash"}, 1200),
+         {"NOS_TPU_ATTN_IMPL": "splash"}, 1200, None),
         ("attn_splash", ["bench_attn.py", "5"],
-         {"NOS_TPU_ATTN_ONLY": "splash"}, 1200),
+         {"NOS_TPU_ATTN_ONLY": "splash"}, 1200, None),
         ("attn_flash", ["bench_attn.py", "5"],
-         {"NOS_TPU_ATTN_ONLY": "flash"}, 1200),
+         {"NOS_TPU_ATTN_ONLY": "flash"}, 1200, None),
         ("mfu_b8_exceptmlp512_splash", ["bench_mfu.py"],
-         mfu_env(8, "except_mlp", 512, attn="splash"), 1500),
+         mfu_env(8, "except_mlp", 512, attn="splash"), 1500,
+         "parity_splash"),
         ("mfu_b16_minimal512_splash", ["bench_mfu.py"],
-         mfu_env(16, "minimal", 512, attn="splash"), 1500),
+         mfu_env(16, "minimal", 512, attn="splash"), 1500,
+         "parity_splash"),
     ],
     "infer": [
-        ("decode", ["bench_decode.py"], {}, 1800),
-        ("serve", ["bench_serve.py"], {}, 1800),
-        ("infer_tenants", ["bench_infer.py"], {}, 1800),
+        ("decode", ["bench_decode.py"], {}, 1800, None),
+        ("serve", ["bench_serve.py"], {}, 1800, None),
+        ("infer_tenants", ["bench_infer.py"], {}, 1800, None),
     ],
 }
 QUEUES["default"] = QUEUES["mfu"] + QUEUES["infer"]
@@ -121,7 +127,7 @@ def main():
     ap.add_argument("--queue", default="default", choices=sorted(QUEUES))
     args = ap.parse_args()
     os.makedirs(LOGDIR, exist_ok=True)
-    queue = [(n, a, e, t, 0) for n, a, e, t in QUEUES[args.queue]]
+    queue = [(n, a, e, t, r, 0) for n, a, e, t, r in QUEUES[args.queue]]
     summary = {"queue": args.queue, "started": time.strftime("%H:%M:%S"),
                "items": {}}
 
@@ -140,7 +146,13 @@ def main():
             time.sleep(PROBE_RETRY_WAIT_S)
             continue
         summary["tunnel"] = f"up at {time.strftime('%H:%M:%S')}"
-        name, argv, env_over, timeout_s, attempts = queue.pop(0)
+        name, argv, env_over, timeout_s, requires, attempts = queue.pop(0)
+        if requires is not None and summary["items"].get(requires) != "ok":
+            # the parity gate failed (or never completed): a measurement
+            # from that kernel must not be produced at all
+            summary["items"][name] = f"skipped: gate {requires} not ok"
+            save()
+            continue
         summary["items"][name] = f"running (attempt {attempts + 1})"
         save()
         status = run_item(name, argv, env_over, timeout_s, attempts + 1)
@@ -153,7 +165,7 @@ def main():
                 # requeue at the HEAD: the queue is value-ordered and the
                 # outer loop already waits for tunnel recovery, so the
                 # highest-value item must stay first
-                queue.insert(0, (name, argv, env_over, timeout_s,
+                queue.insert(0, (name, argv, env_over, timeout_s, requires,
                                  attempts + 1))
             else:
                 summary["items"][name] = "failed: tunnel died 3x"

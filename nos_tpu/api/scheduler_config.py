@@ -1,0 +1,138 @@
+"""Versioned scheduler configuration — the conversion/defaulting layer.
+
+The reference carries CapacitySchedulingArgs inside a
+KubeSchedulerConfiguration (helm
+templates/scheduler/configmap_scheduler-config.yaml:10-34) and maintains
+a versioned external type with generated defaulting and conversion into
+the internal hub type (pkg/api/scheduler/types.go:20-27,
+pkg/api/scheduler/v1beta3/{types,defaults,zz_generated.conversions}.go,
+hack/generate-scheduler.sh). This module is that layer done the Python
+way — explicit version schemas instead of codegen:
+
+- **External versions** (wire, camelCase, every field optional):
+  * ``v1beta2``: ``nvidiaGpuResourceMemoryGB`` — the GPU-era schema.
+  * ``v1beta3``: adds ``tpuResourceMemoryGB`` — the TPU rebuild's schema.
+- **Defaulting** (SetDefaults_CapacitySchedulingArgs analog): absent
+  fields take the internal defaults at decode time.
+- **Conversion**: every external version decodes into the ONE internal
+  hub type (`nos_tpu.api.configs.CapacitySchedulingArgs`); older
+  versions simply have fewer wire fields.
+
+``load_scheduler_config`` accepts either wire shape:
+- a KubeSchedulerConfiguration doc (apiVersion
+  ``kubescheduler.config.k8s.io/v1beta2|v1beta3|v1``) whose
+  ``profiles[].pluginConfig[name=CapacityScheduling].args`` carries the
+  versioned args (the plugin-args version follows the enclosing
+  document's), plus ``leaderElection.leaderElect``;
+- the repo's flat snake_case ``CapacitySchedulingArgs`` YAML (no
+  ``apiVersion``) — the pre-existing format stays valid.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import yaml
+
+from nos_tpu import constants
+from nos_tpu.api.configs import CapacitySchedulingArgs, ConfigError
+
+SCHEDULER_CONFIG_GROUP = "kubescheduler.config.k8s.io"
+
+# external schema registry: version -> {wire key: internal field}
+# (v1 follows v1beta3 — kube GA'd the schema unchanged)
+_VERSIONED_ARG_FIELDS = {
+    "v1beta2": {
+        "nvidiaGpuResourceMemoryGB": "nvidia_gpu_resource_memory_gb",
+    },
+    "v1beta3": {
+        "nvidiaGpuResourceMemoryGB": "nvidia_gpu_resource_memory_gb",
+        "tpuResourceMemoryGB": "tpu_resource_memory_gb",
+    },
+}
+_VERSIONED_ARG_FIELDS["v1"] = _VERSIONED_ARG_FIELDS["v1beta3"]
+
+PLUGIN_NAME = "CapacityScheduling"
+
+
+def decode_plugin_args(version: str, args: Optional[dict],
+                       leader_election: bool = False) -> CapacitySchedulingArgs:
+    """Decode one versioned ``pluginConfig.args`` dict into the internal
+    hub type: unknown keys rejected (a v1beta3-only key in a v1beta2 doc
+    is an error, not a silent drop — strict decoding is the conversion
+    layer's whole point), absent keys defaulted, values validated."""
+    schema = _VERSIONED_ARG_FIELDS.get(version)
+    if schema is None:
+        raise ConfigError(
+            f"unsupported scheduler config version {version!r} "
+            f"(known: {sorted(_VERSIONED_ARG_FIELDS)})")
+    args = args or {}
+    unknown = set(args) - set(schema)
+    if unknown:
+        raise ConfigError(
+            f"{PLUGIN_NAME} args ({version}): unknown keys {sorted(unknown)}")
+    kwargs = {"leader_election": leader_election}
+    for wire_key, field in schema.items():
+        if args.get(wire_key) is not None:
+            kwargs[field] = int(args[wire_key])
+    cfg = CapacitySchedulingArgs(**kwargs)  # dataclass defaults = defaulting
+    cfg.validate()
+    return cfg
+
+
+def decode_scheduler_configuration(doc: dict) -> CapacitySchedulingArgs:
+    """Decode a KubeSchedulerConfiguration document: find the
+    CapacityScheduling pluginConfig entry across profiles (absent entry =
+    all defaults, matching kube's behavior for unconfigured plugins)."""
+    api_version = doc.get("apiVersion", "")
+    group, _, version = api_version.partition("/")
+    if group != SCHEDULER_CONFIG_GROUP:
+        raise ConfigError(
+            f"not a scheduler configuration: apiVersion {api_version!r}")
+    if doc.get("kind") not in ("KubeSchedulerConfiguration", None):
+        raise ConfigError(f"unexpected kind {doc.get('kind')!r}")
+    leader = bool((doc.get("leaderElection") or {}).get("leaderElect", False))
+    args: Optional[dict] = None
+    for profile in doc.get("profiles") or []:
+        _validate_profile(profile)
+        for pc in profile.get("pluginConfig") or []:
+            if pc.get("name") == PLUGIN_NAME:
+                if args is not None:
+                    raise ConfigError(
+                        f"multiple {PLUGIN_NAME} pluginConfig entries")
+                args = pc.get("args") or {}
+    return decode_plugin_args(version, args, leader_election=leader)
+
+
+def _validate_profile(profile: dict) -> None:
+    """Reject profile settings this scheduler cannot honor — silently
+    ignoring an edit (a different schedulerName, CapacityScheduling
+    disabled for a phase) would let a config change deploy as a no-op.
+    Only the canonical enablement (CapacityScheduling on at preFilter/
+    postFilter/reserve) is accepted; plugin wiring is compiled in, not
+    configurable."""
+    name = profile.get("schedulerName")
+    if name is not None and name != constants.SCHEDULER_NAME:
+        raise ConfigError(
+            f"unsupported schedulerName {name!r}: this binary schedules "
+            f"pods selecting {constants.SCHEDULER_NAME!r}")
+    for phase, spec in (profile.get("plugins") or {}).items():
+        enabled = [p.get("name") for p in (spec or {}).get("enabled") or []]
+        disabled = [p.get("name") for p in (spec or {}).get("disabled") or []]
+        if enabled not in ([], [PLUGIN_NAME]) or PLUGIN_NAME in disabled:
+            raise ConfigError(
+                f"unsupported plugins.{phase} stanza: only "
+                f"{PLUGIN_NAME!r} enablement is supported (plugin wiring "
+                "is compiled into this scheduler, not configurable)")
+
+
+def load_scheduler_config(path: str) -> CapacitySchedulingArgs:
+    """Load scheduler args from ``path``, auto-detecting the wire shape
+    (KubeSchedulerConfiguration vs flat snake_case args)."""
+    with open(path) as f:
+        doc = yaml.safe_load(f) or {}
+    if not isinstance(doc, dict):
+        raise ConfigError(f"scheduler config must be a mapping, got "
+                          f"{type(doc).__name__}")
+    if "apiVersion" in doc:
+        return decode_scheduler_configuration(doc)
+    return CapacitySchedulingArgs.from_yaml_file(path)

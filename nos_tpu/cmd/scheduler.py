@@ -11,6 +11,7 @@ import argparse
 from typing import Optional, Sequence
 
 from nos_tpu.api.configs import CapacitySchedulingArgs
+from nos_tpu.api.scheduler_config import load_scheduler_config
 from nos_tpu.cmd import serve
 from nos_tpu.kube.controller import Manager
 from nos_tpu.scheduler import Scheduler
@@ -33,9 +34,13 @@ def main(argv: Optional[Sequence[str]] = None) -> None:
     serve.common_flags(parser)
     args = parser.parse_args(argv)
 
-    cfg = CapacitySchedulingArgs.from_yaml_file(args.config) if args.config \
+    # accepts both the flat snake_case args file and a full
+    # KubeSchedulerConfiguration with versioned pluginConfig args
+    # (api/scheduler_config — the reference's conversion/defaulting layer)
+    cfg = load_scheduler_config(args.config) if args.config \
         else CapacitySchedulingArgs()
-    serve.setup_logging(cfg.log_level)
+    serve.setup_logging(args.log_level if args.log_level is not None
+                        else cfg.log_level)
     mgr = build(serve.connect(args), cfg)
     serve.run_daemon(mgr, args.health_port, args.health_host)
 

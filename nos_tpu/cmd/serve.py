@@ -92,6 +92,13 @@ def common_flags(parser: argparse.ArgumentParser, config: bool = True) -> None:
         help="kubeconfig context override",
     )
     parser.add_argument(
+        "-v", "--log-level", type=int, default=None, dest="log_level",
+        help="log verbosity override (kube component convention; takes "
+             "precedence over the config file's log_level — needed when "
+             "the config is a KubeSchedulerConfiguration, which carries "
+             "no log level)",
+    )
+    parser.add_argument(
         "--health-port", type=int, default=0,
         help="healthz/readyz/metrics port (0 = ephemeral)",
     )

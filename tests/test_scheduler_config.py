@@ -1,0 +1,133 @@
+"""Versioned scheduler config decode (api/scheduler_config) — the
+conversion/defaulting layer (reference pkg/api/scheduler/v1beta3 +
+hack/generate-scheduler.sh, here explicit schemas instead of codegen).
+"""
+import pytest
+
+from nos_tpu import constants
+from nos_tpu.api.configs import CapacitySchedulingArgs, ConfigError
+from nos_tpu.api.scheduler_config import (
+    decode_plugin_args,
+    decode_scheduler_configuration,
+    load_scheduler_config,
+)
+
+
+def ksc(version="v1beta3", args=None, leader=None, kind="KubeSchedulerConfiguration"):
+    doc = {
+        "apiVersion": f"kubescheduler.config.k8s.io/{version}",
+        "kind": kind,
+        "profiles": [{
+            "schedulerName": "nos-scheduler",
+            "pluginConfig": ([{"name": "CapacityScheduling", "args": args}]
+                             if args is not None else []),
+        }],
+    }
+    if leader is not None:
+        doc["leaderElection"] = {"leaderElect": leader}
+    return doc
+
+
+def test_v1beta3_decodes_both_fields():
+    cfg = decode_scheduler_configuration(ksc(args={
+        "tpuResourceMemoryGB": 32, "nvidiaGpuResourceMemoryGB": 80}))
+    assert cfg.tpu_resource_memory_gb == 32
+    assert cfg.nvidia_gpu_resource_memory_gb == 80
+
+
+def test_v1beta2_converts_and_defaults_tpu_field():
+    # older schema has no TPU key: conversion fills the internal default
+    cfg = decode_scheduler_configuration(
+        ksc(version="v1beta2", args={"nvidiaGpuResourceMemoryGB": 40}))
+    assert cfg.nvidia_gpu_resource_memory_gb == 40
+    assert cfg.tpu_resource_memory_gb == constants.DEFAULT_TPU_MEMORY_GB
+
+
+def test_v1beta2_rejects_v1beta3_only_key():
+    with pytest.raises(ConfigError, match="unknown keys.*tpuResourceMemoryGB"):
+        decode_scheduler_configuration(
+            ksc(version="v1beta2", args={"tpuResourceMemoryGB": 32}))
+
+
+def test_v1_follows_v1beta3_schema():
+    cfg = decode_scheduler_configuration(
+        ksc(version="v1", args={"tpuResourceMemoryGB": 16}))
+    assert cfg.tpu_resource_memory_gb == 16
+
+
+def test_unsupported_version_rejected():
+    with pytest.raises(ConfigError, match="unsupported scheduler config"):
+        decode_plugin_args("v1alpha1", {})
+
+
+def test_absent_plugin_config_defaults_everything():
+    cfg = decode_scheduler_configuration(ksc())
+    assert cfg == CapacitySchedulingArgs()
+
+
+def test_leader_election_carried():
+    cfg = decode_scheduler_configuration(ksc(args={}, leader=True))
+    assert cfg.leader_election is True
+
+
+def test_duplicate_plugin_config_rejected():
+    doc = ksc(args={"tpuResourceMemoryGB": 16})
+    doc["profiles"].append(doc["profiles"][0])
+    with pytest.raises(ConfigError, match="multiple"):
+        decode_scheduler_configuration(doc)
+
+
+def test_validation_applies_after_defaulting():
+    with pytest.raises(ConfigError, match="positive"):
+        decode_plugin_args("v1beta3", {"tpuResourceMemoryGB": 0})
+
+
+def test_load_autodetects_both_shapes(tmp_path):
+    import yaml
+
+    ksc_path = tmp_path / "ksc.yaml"
+    ksc_path.write_text(yaml.safe_dump(ksc(args={"tpuResourceMemoryGB": 24})))
+    assert load_scheduler_config(str(ksc_path)).tpu_resource_memory_gb == 24
+
+    flat = tmp_path / "flat.yaml"
+    flat.write_text("tpu_resource_memory_gb: 48\n")
+    assert load_scheduler_config(str(flat)).tpu_resource_memory_gb == 48
+
+
+def test_wrong_group_rejected():
+    with pytest.raises(ConfigError, match="not a scheduler configuration"):
+        decode_scheduler_configuration({"apiVersion": "nos.ai/v1"})
+
+
+def test_wrong_scheduler_name_rejected():
+    doc = ksc(args={})
+    doc["profiles"][0]["schedulerName"] = "someone-elses-scheduler"
+    with pytest.raises(ConfigError, match="unsupported schedulerName"):
+        decode_scheduler_configuration(doc)
+
+
+def test_disabling_capacity_scheduling_rejected():
+    doc = ksc(args={})
+    doc["profiles"][0]["plugins"] = {
+        "postFilter": {"disabled": [{"name": "CapacityScheduling"}]}}
+    with pytest.raises(ConfigError, match="unsupported plugins.postFilter"):
+        decode_scheduler_configuration(doc)
+
+
+def test_canonical_plugins_stanza_accepted():
+    doc = ksc(args={"tpuResourceMemoryGB": 24})
+    doc["profiles"][0]["plugins"] = {
+        "preFilter": {"enabled": [{"name": "CapacityScheduling"}]},
+        "postFilter": {"enabled": [{"name": "CapacityScheduling"}],
+                       "disabled": [{"name": "*"}]},
+        "reserve": {"enabled": [{"name": "CapacityScheduling"}]},
+    }
+    assert decode_scheduler_configuration(doc).tpu_resource_memory_gb == 24
+
+
+def test_foreign_plugin_enablement_rejected():
+    doc = ksc(args={})
+    doc["profiles"][0]["plugins"] = {
+        "score": {"enabled": [{"name": "NodeResourcesFit"}]}}
+    with pytest.raises(ConfigError, match="unsupported plugins.score"):
+        decode_scheduler_configuration(doc)
