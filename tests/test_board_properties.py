@@ -1,0 +1,128 @@
+"""Property-based tests for the TpuBoard geometry state machine
+(nos_tpu/tpu/host.py — reference pkg/gpu/mig/gpu.go:97-217): the
+used-slice-preservation contract must hold under ANY sequence of
+reserve/release/update_geometry_for, for every generation's geometry
+table, not just the worked examples in test_tpu_board.py.
+"""
+import random
+
+from hypothesis import given, settings
+from hypothesis import strategies as st
+
+from nos_tpu.tpu import topology
+from nos_tpu.tpu.host import TpuBoard
+from nos_tpu.tpu.slice import geometry_chips
+
+GENERATIONS = sorted(topology.GENERATIONS)
+
+
+def profiles_for(gen):
+    out = set()
+    for g in topology.allowed_geometry_list(gen):
+        out.update(g)
+    return sorted(out, key=lambda p: (p.chips, str(p)))
+
+
+@st.composite
+def board_ops(draw):
+    gen = draw(st.sampled_from(GENERATIONS))
+    profs = profiles_for(gen)
+    n = draw(st.integers(0, 25))
+    seed = draw(st.integers(0, 2**32 - 1))
+    return gen, profs, n, seed
+
+
+@settings(max_examples=80, deadline=None)
+@given(board_ops())
+def test_board_invariants_under_any_op_sequence(ops):
+    gen, profs, n, seed = ops
+    rng = random.Random(seed)
+    board = TpuBoard(gen)
+    board.init_geometry()
+    chips0 = board.total_chips
+    reserved = {}
+
+    for _ in range(n):
+        kind = rng.choice(["reserve", "release", "update"])
+        p = rng.choice(profs)
+        if kind == "reserve":
+            if board.reserve(p):
+                reserved[p] = reserved.get(p, 0) + 1
+        elif kind == "release":
+            if reserved.get(p, 0) > 0:
+                board.release(p)
+                reserved[p] -= 1
+        else:
+            board.update_geometry_for({p: rng.randint(1, 3)})
+
+        # (1) the board's used ledger always equals successful reserves
+        assert board.used == {p: q for p, q in reserved.items() if q > 0}
+        # (2) every geometry the machine lands in is a legal table entry
+        key = tuple(sorted(board.geometry.items(),
+                           key=lambda kv: (kv[0].chips, str(kv[0]))))
+        assert key in topology.allowed_geometries(gen), (
+            f"{gen}: machine left the allowed-geometry table: {key}")
+        # (3) chip count is conserved across re-partitioning (a board
+        #     cannot create or destroy silicon)
+        assert board.total_chips == chips0
+
+
+@settings(max_examples=60, deadline=None)
+@given(board_ops())
+def test_update_geometry_never_evicts_used_slices(ops):
+    gen, profs, n, seed = ops
+    rng = random.Random(seed)
+    board = TpuBoard(gen)
+    board.init_geometry()
+    # reserve a random prefix of what's free
+    for p in list(board.free):
+        for _ in range(rng.randint(0, board.free.get(p, 0))):
+            board.reserve(p)
+    used_before = dict(board.used)
+
+    for _ in range(max(n, 1)):
+        want = {rng.choice(profs): rng.randint(1, 4)}
+        board.update_geometry_for(want)
+        assert board.used == used_before, (
+            "re-partitioning must never disturb used sub-slices "
+            "(reference gpu.go:97-116 contract)")
+        for p, q in used_before.items():
+            assert board.geometry.get(p, 0) >= q
+
+
+@settings(max_examples=60, deadline=None)
+@given(st.sampled_from(GENERATIONS), st.integers(0, 2**32 - 1))
+def test_update_geometry_only_improves_lacking_provision(gen, seed):
+    # the greedy search must never pick a geometry that provides FEWER
+    # of the lacking slices than the current one already does
+    rng = random.Random(seed)
+    profs = profiles_for(gen)
+    board = TpuBoard(gen)
+    board.init_geometry()
+    lacking = {rng.choice(profs): rng.randint(1, 4)}
+
+    def provided(b):
+        return sum(min(w, b.free.get(p, 0)) for p, w in lacking.items())
+
+    before = provided(board)
+    changed = board.update_geometry_for(lacking)
+    after = provided(board)
+    assert after >= before
+    if changed:
+        assert after > before, (
+            "a geometry change that does not improve provision is pure "
+            "churn (actuator would reconfigure hardware for nothing)")
+
+
+@settings(max_examples=40, deadline=None)
+@given(st.sampled_from(GENERATIONS))
+def test_init_geometry_is_fewest_slices_and_idempotent(gen):
+    board = TpuBoard(gen)
+    board.init_geometry()
+    first = dict(board.geometry)
+    n_slices = sum(first.values())
+    for g in topology.allowed_geometry_list(gen):
+        assert sum(g.values()) >= n_slices or \
+            geometry_chips(g) != geometry_chips(first)
+    board.init_geometry()                 # second call: no-op on non-virgin
+    assert board.geometry == first

@@ -1,0 +1,220 @@
+"""Property-based tests for the partitioner's state machinery:
+ClusterState pod/node bookkeeping (reference
+internal/partitioning/state/state_test.go:31-614's table cases become
+generative invariants) and ClusterSnapshot fork/commit/revert algebra
+(reference internal/partitioning/core/snapshot.go:43-190).
+"""
+import random
+
+from hypothesis import given, settings
+from hypothesis import strategies as st
+
+from nos_tpu import constants
+from nos_tpu.kube.objects import (
+    Node, NodeStatus, ObjectMeta, Pod, PodSpec, PodStatus,
+)
+from nos_tpu.partitioning.snapshot import ClusterSnapshot, SnapshotNode
+from nos_tpu.partitioning.state import ClusterState, partitioning_states_equal
+from nos_tpu.scheduler import framework as fw
+from nos_tpu.tpu.node import TpuNode
+from nos_tpu.tpu.slice import Profile
+
+NODES = ["n0", "n1", "n2"]
+PODS = ["p0", "p1", "p2", "p3"]
+PHASES = ["Pending", "Running", "Succeeded", "Failed"]
+
+
+def mk_node(name):
+    return Node(metadata=ObjectMeta(name=name))
+
+
+def mk_pod(name, node, phase):
+    return Pod(
+        metadata=ObjectMeta(name=name, namespace="ns"),
+        spec=PodSpec(node_name=node),
+        status=PodStatus(phase=phase),
+    )
+
+
+# one ClusterState op: (kind, args)
+OPS = st.lists(
+    st.one_of(
+        st.tuples(st.just("upsert_node"), st.sampled_from(NODES)),
+        st.tuples(st.just("remove_node"), st.sampled_from(NODES)),
+        st.tuples(st.just("upsert_pod"), st.sampled_from(PODS),
+                  st.sampled_from(NODES + [""]), st.sampled_from(PHASES)),
+        st.tuples(st.just("remove_pod"), st.sampled_from(PODS)),
+    ),
+    min_size=0, max_size=40,
+)
+
+
+@settings(max_examples=60, deadline=None)
+@given(OPS)
+def test_cluster_state_invariants_under_any_op_sequence(ops):
+    cs = ClusterState()
+    for op in ops:
+        if op[0] == "upsert_node":
+            cs.upsert_node(mk_node(op[1]))
+        elif op[0] == "remove_node":
+            cs.remove_node(op[1])
+        elif op[0] == "upsert_pod":
+            cs.upsert_pod(mk_pod(op[1], op[2], op[3]))
+        else:
+            cs.remove_pod(mk_pod(op[1], "", "Running"))
+
+    # (1) a pod key appears under at most ONE node (upsert moves, never
+    #     duplicates — the reference's deletePod/updateUsage contract)
+    seen = {}
+    for n in cs.nodes():
+        for p in cs.pods_on(n.metadata.name):
+            key = f"{p.metadata.namespace}/{p.metadata.name}"
+            assert key not in seen, (
+                f"{key} bound to both {seen[key]} and {n.metadata.name}")
+            seen[key] = n.metadata.name
+    # (2) every tracked pod is active and names the node it is filed under
+    for n in cs.nodes():
+        for p in cs.pods_on(n.metadata.name):
+            assert p.status.phase in ("Pending", "Running")
+            assert p.spec.node_name == n.metadata.name
+    # (3) queries never surface removed nodes
+    live = {n.metadata.name for n in cs.nodes()}
+    for name in NODES:
+        assert (cs.get_node(name) is not None) == (name in live)
+
+
+@settings(max_examples=40, deadline=None)
+@given(OPS)
+def test_cluster_state_last_upsert_wins(ops):
+    # replay: the final binding of each pod equals the effect of its LAST
+    # upsert/remove — earlier history is irrelevant (level-triggered)
+    cs = ClusterState()
+    last = {}
+    for op in ops:
+        if op[0] == "upsert_node":
+            cs.upsert_node(mk_node(op[1]))
+        elif op[0] == "remove_node":
+            cs.remove_node(op[1])
+            for k, v in list(last.items()):
+                if v == op[1]:
+                    last[k] = None       # binding vanished with the node
+        elif op[0] == "upsert_pod":
+            cs.upsert_pod(mk_pod(op[1], op[2], op[3]))
+            active = op[2] and op[3] in ("Pending", "Running")
+            last[op[1]] = op[2] if active else None
+        else:
+            cs.remove_pod(mk_pod(op[1], "", "Running"))
+            last[op[1]] = None
+    for pod_name, node in last.items():
+        key = f"ns/{pod_name}"
+        found = [n.metadata.name for n in cs.nodes()
+                 if any(f"{p.metadata.namespace}/{p.metadata.name}" == key
+                        for p in cs.pods_on(n.metadata.name))]
+        if node is None or cs.get_node(node) is None:
+            assert found == [], (pod_name, node, found)
+        else:
+            assert found == [node], (pod_name, node, found)
+
+
+# ---------------------------------------------------------------------------
+# ClusterSnapshot fork/commit/revert algebra
+# ---------------------------------------------------------------------------
+
+def v5e_node(name):
+    return Node(
+        metadata=ObjectMeta(name=name, labels={
+            constants.LABEL_TPU_ACCELERATOR: "tpu-v5-lite-podslice",
+            constants.LABEL_TPU_TOPOLOGY: "2x4",
+            constants.LABEL_PARTITIONING: constants.PARTITIONING_SUBSLICING,
+        }),
+        status=NodeStatus(capacity={"cpu": 8}, allocatable={"cpu": 8}),
+    )
+
+
+def mk_snapshot(n_nodes=2):
+    out = {}
+    for i in range(n_nodes):
+        node = v5e_node(f"n{i}")
+        sn = SnapshotNode(TpuNode.from_node(node), fw.NodeInfo(node, []))
+        sn.refresh_allocatable()
+        out[node.metadata.name] = sn
+    return ClusterSnapshot(out)
+
+
+def mutate(snap, rng):
+    """One random speculative mutation of the kind the planner makes."""
+    names = sorted(snap.nodes())
+    sn = snap.get(rng.choice(names))
+    profile = rng.choice([Profile(1, 1), Profile(2, 2), Profile(2, 4)])
+    sn.update_geometry_for({profile: rng.randint(1, 4)})
+
+
+@settings(max_examples=25, deadline=None)
+@given(st.integers(0, 2**32 - 1), st.integers(1, 5))
+def test_revert_restores_exact_prefork_state(seed, n_mut):
+    rng = random.Random(seed)
+    snap = mk_snapshot()
+    mutate(snap, rng)                      # arbitrary pre-fork state
+    before_part = snap.partitioning_state()
+    before_avail = snap.cluster_available()
+
+    snap.fork()
+    for _ in range(n_mut):
+        mutate(snap, rng)
+    snap.revert()
+
+    assert partitioning_states_equal(snap.partitioning_state(), before_part)
+    assert snap.cluster_available() == before_avail
+
+
+@settings(max_examples=25, deadline=None)
+@given(st.integers(0, 2**32 - 1), st.integers(1, 5))
+def test_commit_keeps_mutations_and_reopens_fork(seed, n_mut):
+    rng = random.Random(seed)
+    snap = mk_snapshot()
+    snap.fork()
+    for _ in range(n_mut):
+        mutate(snap, rng)
+    mutated_part = snap.partitioning_state()
+    snap.commit()
+    assert partitioning_states_equal(snap.partitioning_state(), mutated_part)
+    snap.fork()                            # commit must re-arm forking
+    snap.revert()
+    assert partitioning_states_equal(snap.partitioning_state(), mutated_part)
+
+
+@settings(max_examples=20, deadline=None)
+@given(st.integers(0, 2**32 - 1), st.integers(1, 4))
+def test_clone_is_fully_isolated(seed, n_mut):
+    rng = random.Random(seed)
+    snap = mk_snapshot()
+    original_part = snap.partitioning_state()
+    original_avail = snap.cluster_available()
+    clone = snap.clone()
+    for _ in range(n_mut):
+        mutate(clone, rng)
+    assert partitioning_states_equal(snap.partitioning_state(), original_part)
+    assert snap.cluster_available() == original_avail
+
+
+@settings(max_examples=20, deadline=None)
+@given(st.integers(0, 2**32 - 1))
+def test_allocatable_tracks_geometry_through_revert(seed):
+    # the NodeInfo's advertised slice resources must match the board
+    # geometry after EVERY fork/revert — a stale memo here would let the
+    # planner place pods on capacity that reverted away
+    rng = random.Random(seed)
+    snap = mk_snapshot(1)
+    snap.fork()
+    mutate(snap, rng)
+    sn = snap.get("n0")
+    expect = sn.tpu_node.allocatable_scalar_resources(
+        sn.node_info.node.status.allocatable)
+    assert {r: v for r, v in sn.node_info.node.status.allocatable.items()} \
+        == {r: v for r, v in expect.items()}
+    snap.revert()
+    sn = snap.get("n0")
+    expect = sn.tpu_node.allocatable_scalar_resources(
+        sn.node_info.node.status.allocatable)
+    assert {r: v for r, v in sn.node_info.node.status.allocatable.items()} \
+        == {r: v for r, v in expect.items()}
