@@ -126,31 +126,113 @@ class ServingLoop:
 
     def generate(self, prompt, max_new_tokens, timeout: float = 300.0,
                  **sampling):
+        """Unary request: expressed over ``stream`` so there is exactly
+        one waiting/abandon/metrics implementation."""
+        out = list(prompt)
+        for delta in self.stream(prompt, max_new_tokens, timeout,
+                                 **sampling):
+            out.extend(delta)
+        return out
+
+    def _forget(self, rid: int) -> None:
+        """Idempotently drop a request in whatever state it is: pop it if
+        finished (counting the completion), mark it abandoned if still
+        decoding (the ticker reaps it), no-op if already handed out. Runs
+        from stream teardown — including client disconnects that land
+        exactly at completion, when the ticker may never tick again on an
+        idle server."""
+        with self._work:
+            if self.engine.progress(rid) is None:
+                self._abandoned.discard(rid)    # already popped
+                return
+            if self.engine.pop_result(rid) is not None:
+                self.m_requests.inc()
+                self.m_abandoned.inc()
+                self._abandoned.discard(rid)
+            else:
+                self._abandoned.add(rid)
+
+    def stream(self, prompt, max_new_tokens, timeout: float = 300.0,
+               **sampling):
+        """Streaming primitive: submits EAGERLY (validation errors raise
+        here, before the caller commits response headers) and returns an
+        iterator yielding lists of newly-decoded tokens as ticks land.
+        ``close()`` at ANY point — even before the first ``next()``,
+        which a raw generator's finally cannot cover — drops the request
+        via ``_forget``. Token identity with the unary path is the
+        engine's batch-composition-invariance contract."""
         with self._work:
             if self._failed is not None:
                 raise RuntimeError(f"serving loop failed: {self._failed}")
             rid = self.engine.submit(prompt, max_new_tokens, **sampling)
             self._work.notify_all()
+
+        def deltas():
+            sent = 0
+            finished = False
             deadline = time.monotonic() + timeout
-            while True:
-                result = self.engine.pop_result(rid)
-                if result is not None:
-                    self.m_requests.inc()
-                    return result
-                if self._failed is not None:
-                    raise RuntimeError(
-                        f"serving loop failed: {self._failed}")
-                remaining = deadline - time.monotonic()
-                if remaining <= 0:
-                    self._abandoned.add(rid)    # reaped by the ticker
-                    raise TimeoutError(f"request {rid} timed out")
-                self._work.wait(timeout=min(remaining, 1.0))
+            try:
+                while True:
+                    with self._work:
+                        prog = self.engine.progress(rid)
+                        if prog is None:
+                            # reaped out from under us (shutdown race)
+                            raise RuntimeError(f"request {rid} vanished")
+                        toks, done = prog
+                        delta = toks[sent:]
+                        if done:
+                            self.engine.pop_result(rid)
+                            self.m_requests.inc()
+                            finished = True
+                        elif not delta:
+                            if self._failed is not None:
+                                raise RuntimeError(
+                                    f"serving loop failed: {self._failed}")
+                            remaining = deadline - time.monotonic()
+                            if remaining <= 0:
+                                raise TimeoutError(
+                                    f"request {rid} timed out")
+                            self._work.wait(timeout=min(remaining, 1.0))
+                            continue
+                    if delta:
+                        sent += len(delta)
+                        yield delta
+                    if finished:
+                        return
+            finally:
+                if not finished:        # timeout / failure / client gone
+                    self._forget(rid)
+
+        return _Stream(self, rid, deltas())
 
     def shutdown(self) -> None:
         with self._work:
             self._stop = True
             self._work.notify_all()
         self._thread.join(timeout=5)
+
+
+class _Stream:
+    """Iterator over a streamed request whose ``close()`` is safe in
+    every state: a started generator runs its finally; a NEVER-started
+    one (e.g. response headers failed before the first frame) gets the
+    explicit idempotent ``_forget`` so the submitted request cannot leak
+    into the engine's done-table."""
+
+    def __init__(self, loop: "ServingLoop", rid: int, gen):
+        self._loop = loop
+        self.rid = rid
+        self._gen = gen
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        return next(self._gen)
+
+    def close(self) -> None:
+        self._gen.close()
+        self._loop._forget(self.rid)
 
 
 def build_engine(cfg: ServerConfig):
@@ -200,6 +282,43 @@ def make_http_server(cfg: ServerConfig, loop: ServingLoop
             else:
                 self._reply(404, {"error": f"unknown path {self.path}"})
 
+        def _stream_sse(self, gen) -> None:
+            """Server-sent events: one ``data: {"tokens": [...]}`` frame
+            per decode batch, ``data: [DONE]`` terminator (the OpenAI
+            streaming convention, token-ids instead of text). Mid-stream
+            failures become an SSE error frame — the 200 is already on
+            the wire, so a clean in-band error beats a dropped
+            connection. Fully self-contained: every exit path closes the
+            stream (dropping the server-side request — ``_Stream.close``
+            is safe even before the first frame, covering a disconnect
+            during header send) and nothing escapes to do_POST, whose
+            JSON error arms must never write a second status line onto a
+            committed SSE response."""
+            try:
+                self.send_response(200)
+                self.send_header("Content-Type", "text/event-stream")
+                self.send_header("Cache-Control", "no-cache")
+                self.send_header("Connection", "close")
+                self.end_headers()
+                for delta in gen:
+                    self.wfile.write(
+                        b"data: " + json.dumps({"tokens": delta}).encode()
+                        + b"\n\n")
+                    self.wfile.flush()
+                self.wfile.write(b"data: [DONE]\n\n")
+            except OSError:             # client went away (BrokenPipe, reset)
+                pass
+            except (TimeoutError, RuntimeError) as e:
+                try:
+                    self.wfile.write(
+                        b"data: " + json.dumps(
+                            {"error": f"{type(e).__name__}: {e}"}).encode()
+                        + b"\n\n")
+                except OSError:
+                    pass
+            finally:
+                gen.close()
+
         def do_POST(self):
             if self.path != "/v1/generate":
                 self._reply(404, {"error": f"unknown path {self.path}"})
@@ -219,6 +338,13 @@ def make_http_server(cfg: ServerConfig, loop: ServingLoop
                     sampling["top_p"] = float(body["top_p"])
                 if "seed" in body:
                     sampling["seed"] = int(body["seed"])
+                if body.get("stream"):
+                    # stream() submits eagerly, so validation errors land
+                    # in the except arms below as a clean JSON 4xx —
+                    # headers are only committed once the request is in
+                    gen = loop.stream(prompt, n, **sampling)
+                    self._stream_sse(gen)
+                    return
                 tokens = loop.generate(prompt, n, **sampling)
             except (KeyError, ValueError, TypeError) as e:
                 self._reply(400, {"error": f"{type(e).__name__}: {e}"})

@@ -283,6 +283,24 @@ class DecodeServer:
             return None
         return req.prompt + req.out[:req.max_new_tokens]
 
+    def progress(self, rid: int) -> Optional[tuple]:
+        """(generated tokens so far, done) for a submitted request —
+        the streaming read. None for an unknown (or already-popped) rid.
+        Unlike ``pop_result`` this never forgets: a finished request
+        stays readable until popped, so a streamer can observe the tail
+        and THEN pop. O(slots + pending) scan — both are small by
+        construction."""
+        req = self._done.get(rid)
+        if req is not None:
+            return list(req.out[:req.max_new_tokens]), True
+        for req in self._active.values():
+            if req.rid == rid:
+                return list(req.out), False
+        for req in self._pending:
+            if req.rid == rid:
+                return [], False
+        return None
+
     def has_work(self) -> bool:
         return bool(self._active or self._pending)
 

@@ -3,6 +3,7 @@ continuous-batching engine — concurrent requests, correctness vs
 generate(), validation."""
 import json
 import threading
+import time
 import urllib.error
 import urllib.request
 
@@ -185,3 +186,152 @@ def test_bad_sampling_params_rejected(served):
     with pytest.raises(urllib.error.HTTPError) as e:
         post(url, {"prompt": [1], "max_new_tokens": 2, "top_k": 3})
     assert e.value.code == 400
+
+
+def sse_post(url, body, timeout=120):
+    """POST with stream=true; parse SSE frames into (token_batches, tail)."""
+    req = urllib.request.Request(
+        url + "/v1/generate", data=json.dumps(body).encode(),
+        headers={"Content-Type": "application/json"}, method="POST")
+    batches, done, err = [], False, None
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        assert r.headers["Content-Type"] == "text/event-stream"
+        for raw in r:
+            line = raw.decode().strip()
+            if not line.startswith("data: "):
+                continue
+            payload = line[len("data: "):]
+            if payload == "[DONE]":
+                done = True
+                break
+            frame = json.loads(payload)
+            if "error" in frame:
+                err = frame["error"]
+                break
+            batches.append(frame["tokens"])
+    return batches, done, err
+
+
+def test_streaming_matches_generate_and_terminates(served):
+    url, params, mcfg = served
+    batches, done, err = sse_post(
+        url, {"prompt": [4, 5], "max_new_tokens": 6, "stream": True})
+    assert err is None and done
+    streamed = [t for b in batches for t in b]
+    want = [int(t) for t in
+            generate(params, mcfg, jnp.asarray([[4, 5]], jnp.int32), 6)[0]]
+    assert [4, 5] + streamed == want          # stream carries only NEW tokens
+    assert len(batches) >= 2                   # incremental, not one blob
+
+
+def test_streaming_validation_error_is_clean_400(served):
+    url, _, _ = served
+    req = urllib.request.Request(
+        url + "/v1/generate",
+        data=json.dumps({"prompt": [], "stream": True}).encode(),
+        headers={"Content-Type": "application/json"}, method="POST")
+    with pytest.raises(urllib.error.HTTPError) as e:
+        urllib.request.urlopen(req, timeout=30)
+    assert e.value.code == 400                 # headers not yet committed
+
+
+def test_streaming_and_unary_share_the_batch(served):
+    url, params, mcfg = served
+    out = {}
+
+    def stream_req():
+        out["stream"] = sse_post(
+            url, {"prompt": [7, 8], "max_new_tokens": 8, "stream": True})
+
+    def unary_req():
+        out["unary"] = post(url, {"prompt": [9], "max_new_tokens": 8})
+
+    ts = [threading.Thread(target=stream_req),
+          threading.Thread(target=unary_req)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(timeout=180)
+    assert not any(t.is_alive() for t in ts), "request thread wedged"
+    batches, done, err = out["stream"]
+    assert err is None and done
+    want_s = [int(t) for t in
+              generate(params, mcfg, jnp.asarray([[7, 8]], jnp.int32), 8)[0]]
+    want_u = [int(t) for t in
+              generate(params, mcfg, jnp.asarray([[9]], jnp.int32), 8)[0]]
+    assert [7, 8] + [t for b in batches for t in b] == want_s
+    assert out["unary"]["tokens"] == want_u    # batch-composition invariance
+
+
+class _FakeEngine:
+    """Instant-completion engine stub: isolates ServingLoop's stream
+    teardown bookkeeping from real decode compiles."""
+
+    def __init__(self):
+        self.pending, self.done, self._rid = {}, {}, 0
+
+    def submit(self, prompt, n, **kw):
+        rid = self._rid
+        self._rid += 1
+        self.pending[rid] = n
+        return rid
+
+    def has_work(self):
+        return bool(self.pending)
+
+    def step(self):
+        for rid, n in list(self.pending.items()):
+            self.done[rid] = list(range(n))
+            del self.pending[rid]
+        return 1
+
+    def progress(self, rid):
+        if rid in self.done:
+            return list(self.done[rid]), True
+        if rid in self.pending:
+            return [], False
+        return None
+
+    def pop_result(self, rid):
+        return self.done.pop(rid, None)
+
+
+def _wait_until(cond, timeout=10.0):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if cond():
+            return True
+        time.sleep(0.02)
+    return False
+
+
+def test_stream_closed_before_first_next_does_not_leak():
+    # headers failing before the first frame closes a NEVER-started
+    # generator; the request must still be dropped (reaped by the
+    # ticker), not decode to completion and park in the done-table
+    eng = _FakeEngine()
+    loop = ServingLoop(eng)
+    try:
+        s = loop.stream([1, 2], 4)
+        s.close()                           # before any next()
+        assert _wait_until(lambda: not eng.done and not eng.pending), \
+            f"leaked: done={eng.done} pending={eng.pending}"
+        assert _wait_until(lambda: not loop._abandoned)
+    finally:
+        loop.shutdown()
+
+
+def test_stream_closed_after_completion_pops_immediately():
+    # disconnect landing exactly at completion: close() must pop the
+    # finished result NOW — an idle server may never tick again, so
+    # relying on the ticker's reap loop would park it forever
+    eng = _FakeEngine()
+    loop = ServingLoop(eng)
+    try:
+        s = loop.stream([1], 3)
+        assert _wait_until(lambda: s.rid in eng.done)   # ticker finished it
+        s.close()                           # without consuming a frame
+        assert eng.done == {}               # popped synchronously
+        assert s.rid not in loop._abandoned
+    finally:
+        loop.shutdown()
