@@ -51,6 +51,16 @@ TIMED_STEPS = 10
 MFU_BAR = 40.0  # % — the target this rebuild is held to (VERDICT r1 #2)
 
 
+def smoke_overrides(model: dict) -> dict:
+    """Tiny-shape twin of ``model`` for NOS_TPU_BENCH_SMOKE dry runs
+    (bench_decode/bench_serve): the exact code path at toy sizes, so a
+    queued hardware run can never be the first execution ever. One
+    definition — the decode and serve smokes must exercise the SAME
+    config or the 'exact code path' guarantee silently forks."""
+    return dict(model, d_model=128, n_layers=2, n_heads=4, n_kv_heads=2,
+                d_ff=256, vocab=256, max_seq=512)
+
+
 def phase_marker(tag: str, name: str) -> None:
     """Stderr progress marker (``PHASE <tag> <name> t=HH:MM:SS``) shared by
     every hardware bench script: when a watchdog kills a run, the captured

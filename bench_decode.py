@@ -13,12 +13,22 @@ import time
 
 sys.path.insert(0, ".")
 
-from bench import MODEL, PEAK_TFLOPS  # noqa: E402  (device table reused)
+import os  # noqa: E402
+
+from bench import MODEL, PEAK_TFLOPS, smoke_overrides  # noqa: E402
 from bench_mfu import host_fence  # noqa: E402
 
 BATCH = 8
 PROMPT = 128
 NEW_TOKENS = 128
+
+# NOS_TPU_BENCH_SMOKE=1: tiny-shape dry run of the EXACT code path, so
+# the queued hardware run cannot be the first execution ever (a crash
+# here costs seconds on CPU, not a tunnel window)
+SMOKE = os.environ.get("NOS_TPU_BENCH_SMOKE") == "1"
+if SMOKE:
+    MODEL = smoke_overrides(MODEL)
+    BATCH, PROMPT, NEW_TOKENS = 2, 16, 8
 
 
 def main():
@@ -64,7 +74,8 @@ def main():
 
     dev = jax.devices()[0]
     result = {
-        "metric": "KV-cache decode, flagship 1.1B GQA decoder",
+        "metric": "KV-cache decode, flagship GQA decoder"
+                  + (" [SMOKE]" if SMOKE else ""),
         "device": dev.device_kind,
         "platform": jax.default_backend(),
         "batch": BATCH,

@@ -16,11 +16,20 @@ import time
 
 sys.path.insert(0, ".")
 
-from bench import MODEL  # noqa: E402
+import os  # noqa: E402
+
+from bench import MODEL, smoke_overrides  # noqa: E402
 
 MAX_BATCH = 8
 PROMPT_LENS = [64, 128, 256, 96, 64, 192, 128, 80]
 NEW_TOKENS = 64
+
+# NOS_TPU_BENCH_SMOKE=1: tiny-shape dry run of the exact code path (see
+# bench_decode.py) — hardware runs must never be the first execution
+SMOKE = os.environ.get("NOS_TPU_BENCH_SMOKE") == "1"
+if SMOKE:
+    MODEL = smoke_overrides(MODEL)
+    MAX_BATCH, PROMPT_LENS, NEW_TOKENS = 2, [16, 24, 16], 6
 
 
 def main():
@@ -63,7 +72,8 @@ def main():
     total_new = len(PROMPT_LENS) * (NEW_TOKENS - 1)
     dev = jax.devices()[0]
     print(json.dumps({
-        "metric": "continuous-batching serving, flagship 1.1B GQA decoder",
+        "metric": "continuous-batching serving, flagship GQA decoder"
+                  + (" [SMOKE]" if SMOKE else ""),
         "device": dev.device_kind,
         "platform": jax.default_backend(),
         "max_batch": MAX_BATCH,

@@ -145,6 +145,12 @@ class ServingLoop:
             if self.engine.progress(rid) is None:
                 self._abandoned.discard(rid)    # already popped
                 return
+            # stop burning ticks on output nobody will read: cancel frees
+            # the slot immediately (engines without cancel — test stubs —
+            # fall back to reap-after-completion)
+            cancel = getattr(self.engine, "cancel", None)
+            if cancel is not None:
+                cancel(rid)
             if self.engine.pop_result(rid) is not None:
                 self.m_requests.inc()
                 self.m_abandoned.inc()
@@ -309,11 +315,14 @@ def make_http_server(cfg: ServerConfig, loop: ServingLoop
             except OSError:             # client went away (BrokenPipe, reset)
                 pass
             except (TimeoutError, RuntimeError) as e:
+                # in-band error frame, then the normal terminator: clients
+                # must always be able to read to [DONE] and distinguish a
+                # server-reported failure from a dropped connection
                 try:
                     self.wfile.write(
                         b"data: " + json.dumps(
                             {"error": f"{type(e).__name__}: {e}"}).encode()
-                        + b"\n\n")
+                        + b"\n\ndata: [DONE]\n\n")
                 except OSError:
                     pass
             finally:

@@ -283,6 +283,26 @@ class DecodeServer:
             return None
         return req.prompt + req.out[:req.max_new_tokens]
 
+    def cancel(self, rid: int) -> bool:
+        """Stop decoding a request NOW: a pending request is dropped from
+        the queue; an active one is truncated at its current output and
+        its slot recycled (the serving loop calls this when a streaming
+        client disconnects — without it an abandoned 480-token request
+        would burn its remaining ticks while queued requests wait). The
+        request lands in the done-table (possibly with a partial output)
+        for the caller to pop. False for an unknown/finished rid."""
+        for i, req in enumerate(self._pending):
+            if req.rid == rid:
+                del self._pending[i]
+                self._done[rid] = req        # empty output; poppable
+                return True
+        for req in self._active.values():
+            if req.rid == rid:
+                req.max_new_tokens = len(req.out)
+                self._finish_if_done(req)    # frees the slot, admits next
+                return True
+        return False
+
     def progress(self, rid: int) -> Optional[tuple]:
         """(generated tokens so far, done) for a submitted request —
         the streaming read. None for an unknown (or already-popped) rid.

@@ -139,3 +139,28 @@ def test_engine_serves_int8_params(params):
     want = [int(t) for t in
             generate(qp, CFG, jnp.asarray([[1, 2, 3]], jnp.int32), 5)[0]]
     assert results[rid] == want
+
+
+def test_cancel_frees_slot_and_truncates(params):
+    srv = DecodeServer(params, CFG, max_batch=1)   # one slot: queuing visible
+    rid_a = srv.submit([1, 2], 32)            # occupies the only slot
+    rid_b = srv.submit([3], 4)                # queued behind it
+    for _ in range(3):
+        srv.step()
+    assert srv.cancel(rid_a)                  # truncate at current output
+    out_a = srv.pop_result(rid_a)
+    # prefill emitted token 1, then 3 decode steps: prompt + 4 tokens
+    assert out_a == [1, 2] + out_a[2:] and len(out_a) == 2 + 4
+    results = srv.drain()                     # b got the freed slot
+    assert len(results[rid_b]) == 1 + 4
+    assert not srv.cancel(rid_a)              # unknown rid now
+
+
+def test_cancel_pending_request_never_decodes(params):
+    srv = DecodeServer(params, CFG, max_batch=1)
+    rid_a = srv.submit([1], 8)
+    rid_b = srv.submit([2], 8)                # pending
+    assert srv.cancel(rid_b)
+    assert srv.pop_result(rid_b) == [2]       # prompt only, zero decoded
+    results = srv.drain()
+    assert len(results[rid_a]) == 1 + 8
