@@ -53,6 +53,9 @@ class TrainerConfig:
     sp: int = 1
     ep: int = 1
     n_microbatches: int = 2            # pp only
+    # pp schedule: "1f1b" (P-bounded activation memory; no sp) or
+    # "gpipe" (composes with sp/ring attention for dense long-context)
+    pipeline_schedule: str = "1f1b"
     # run
     steps: int = 10
     batch_size: int = 8
@@ -198,7 +201,8 @@ def train(cfg: TrainerConfig) -> float:
 
     if pipelined:
         step_fn = jax.jit(make_pipeline_train_step(
-            model_cfg, optimizer, mesh, n_microbatches=cfg.n_microbatches))
+            model_cfg, optimizer, mesh, n_microbatches=cfg.n_microbatches,
+            schedule=cfg.pipeline_schedule))
     else:
         step_fn = jax.jit(tfm.make_train_step(model_cfg, optimizer, mesh))
 
@@ -226,10 +230,16 @@ def train(cfg: TrainerConfig) -> float:
         eval_dataset = TokenDataset(cfg.eval_data_path, cfg.seq_len,
                                     seed=cfg.seed + 2)
         if pipelined:
-            from nos_tpu.parallel.pipeline import pipeline_1f1b_loss_fn
+            from nos_tpu.parallel.pipeline import (
+                pipeline_1f1b_loss_fn, pipeline_loss_fn,
+            )
 
-            # loss-only 1F1B call runs the cheap forward-only rotation
-            eval_fn = jax.jit(lambda p, b: pipeline_1f1b_loss_fn(
+            # eval matches the training schedule: loss-only 1F1B runs the
+            # cheap forward-only rotation; gpipe (the sp-composing
+            # schedule) evaluates with its own forward
+            ploss = (pipeline_1f1b_loss_fn
+                     if cfg.pipeline_schedule == "1f1b" else pipeline_loss_fn)
+            eval_fn = jax.jit(lambda p, b: ploss(
                 p, model_cfg, b, mesh, cfg.n_microbatches))
         else:
             eval_fn = jax.jit(

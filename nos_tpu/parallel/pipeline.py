@@ -14,16 +14,21 @@ Composition contract:
   expert parallelism (the dispatch/combine einsums are dense, so the ep
   all-to-alls need no manual axis; the load-balancing aux loss is
   accumulated per stage x microbatch and psum'd over pp).
-- sequence parallelism (sp/ring attention) does not compose with pp.
-  Both routes were implemented and measured unshippable on the current
-  toolchain (round 3): (a) manual sp — ring attention's ppermutes end up
-  inside the 1F1B tick's ``lax.cond``, and at any tick different pp rows
-  take different branches, so manual collectives under divergent control
-  flow mispair (wrong loss, reproduced); (b) auto sp — seeding GSPMD
+- sequence parallelism (sp/ring attention) composes with the **GPipe**
+  schedule only, dense models only: sp joins pp as a second MANUAL axis
+  and ring attention runs inside the uniform rotation tick — every
+  (pp, sp) program executes the same ``ppermute``s every step, so the
+  collectives pair (exactness + grads tested vs the plain forward on an
+  8-device dp×pp×sp mesh). The routes that do NOT work, measured:
+  (a) 1F1B + sp — ring ppermutes land inside the divergent 1F1B
+  ``lax.cond`` and at any tick different pp rows take different
+  branches, so manual collectives mispair (wrong loss, reproduced in
+  round 3; ``_check`` still rejects it); (b) auto sp — seeding GSPMD
   propagation of an sp-sharded sequence dim through the manual-pp
-  shard_map SIGABRTs XLA:CPU. Long-context jobs pick sp, depth-bound
-  jobs pick pp; revisit (b) when shard_map auto-axis propagation
-  stabilizes.
+  shard_map SIGABRTs XLA:CPU; (c) sp + MoE under the manual axis —
+  per-shard capacity routing genuinely diverges from global routing
+  (rejected with an explicit error). Long-context deep models:
+  GPipe + sp; depth-bound dense/MoE without long context: 1F1B.
 
 Two schedules:
 
@@ -63,11 +68,16 @@ from nos_tpu.ops.attention import attention
 from nos_tpu.ops.layers import rms_norm, rope_frequencies
 
 
-def _check(cfg: TransformerConfig, mesh: Mesh, batch: int, n_microbatches: int):
+def _check(cfg: TransformerConfig, mesh: Mesh, batch: int, n_microbatches: int,
+           allow_sp: bool = False):
     if "pp" not in mesh.axis_names:
         raise ValueError("mesh has no pp axis")
-    if "sp" in mesh.axis_names and mesh.shape["sp"] > 1:
-        raise ValueError("pipeline does not compose with sp (ring attention)")
+    if "sp" in mesh.axis_names and mesh.shape["sp"] > 1 and not allow_sp:
+        raise ValueError(
+            "1F1B does not compose with sp (ring attention): ring ppermutes "
+            "inside the divergent 1F1B lax.cond mispair across pp rows — "
+            "use the GPipe schedule (pipeline_forward/pipeline_loss_fn), "
+            "whose uniform tick composes with manual sp")
     stages = mesh.shape["pp"]
     if cfg.n_layers % stages:
         raise ValueError(
@@ -94,7 +104,19 @@ def pipeline_forward(
     ``return_hidden`` yields the pre-head hidden state + aux instead (for
     pipeline_loss_fn's chunked lm head)."""
     b, s = tokens.shape
-    stages = _check(cfg, mesh, b, n_microbatches)
+    stages = _check(cfg, mesh, b, n_microbatches, allow_sp=True)
+    sp = mesh.shape.get("sp", 1) if "sp" in mesh.axis_names else 1
+    if sp > 1 and s % sp:
+        raise ValueError(f"seq_len {s} not divisible by sp {sp}")
+    if sp > 1 and cfg.n_experts > 0:
+        # measured, not hypothetical: MoE capacity routing under a MANUAL
+        # sp axis computes per-expert capacity and overflow drops from
+        # each shard's local tokens, while the plain forward (GSPMD-auto
+        # sp) routes globally — the outputs genuinely diverge. Dense is
+        # the long-context case; MoE long-context picks sp without pp.
+        raise ValueError(
+            "GPipe sp composition is dense-only: per-shard MoE capacity "
+            "routing diverges from global routing")
     n_local = cfg.n_layers // stages
     mb = b // n_microbatches
     freqs = rope_frequencies(cfg.head_dim, cfg.max_seq, cfg.rope_theta)
@@ -107,12 +129,25 @@ def pipeline_forward(
     stage_params = jax.tree.map(
         lambda w: w.reshape(stages, n_local, *w.shape[1:]), params["layers"])
 
-    stage_fn = _stage_fn_factory(cfg, freqs)
-
-    def stage_program(local_params, microbatches):
+    def stage_program(local_params, microbatches, freqs_full):
         # local_params leaves [1, K, ...] (this stage's slice); squeeze it
         local_params = jax.tree.map(lambda w: w[0], local_params)
         p_idx = jax.lax.axis_index("pp")
+        # sp as a SECOND manual axis: the sequence dim of every
+        # microbatch is the local shard; ring attention's ppermutes run
+        # in the uniform GPipe tick (every (pp, sp) program executes the
+        # same collectives every step — no divergent control flow, which
+        # is exactly what broke the 1F1B composition). RoPE gets the
+        # globally-offset slice of the frequency table.
+        if sp > 1:
+            sp_idx = jax.lax.axis_index("sp")
+            s_local = microbatches.shape[2]
+            freqs_local = jax.lax.dynamic_slice_in_dim(
+                freqs_full, sp_idx * s_local, s_local)
+        else:
+            freqs_local = freqs_full
+        stage_fn = _stage_fn_factory(cfg, freqs_local,
+                                     sp_axis="sp" if sp > 1 else None)
         n_steps = n_microbatches + stages - 1
         perm = [(i, (i + 1) % stages) for i in range(stages)]
 
@@ -135,18 +170,22 @@ def pipeline_forward(
         out0 = jnp.zeros_like(microbatches)
         (_, outputs, aux_acc), _ = jax.lax.scan(
             step, (zeros, out0, jnp.float32(0.0)), jnp.arange(n_steps))
-        # outputs [1, M, mb, S, d] stacked over pp; aux summed across
-        # stages here (psum -> replicated scalar out_spec)
+        # outputs [1, M, mb, S_local, d] stacked over pp; aux summed
+        # across stages -> replicated scalar out_spec (MoE is rejected
+        # under sp above, so aux is identically 0.0 on every sp>1 path
+        # and the plain pp psum is replicated across sp too)
         return outputs[None], jax.lax.psum(aux_acc, "pp")
 
+    manual_axes = {"pp", "sp"} if sp > 1 else {"pp"}
+    mb_spec = P(None, None, "sp", None) if sp > 1 else P()
     stacked, aux_sum = jax.shard_map(
         stage_program,
         mesh=mesh,
-        in_specs=(P("pp"), P()),
-        out_specs=(P("pp"), P()),
-        axis_names={"pp"},
+        in_specs=(P("pp"), mb_spec, P()),
+        out_specs=(P("pp", None, None, "sp" if sp > 1 else None, None), P()),
+        axis_names=manual_axes,
         check_vma=False,
-    )(stage_params, mbs)
+    )(stage_params, mbs, freqs)
     x = stacked[-1].reshape(b, s, cfg.d_model)        # last stage's outputs
 
     # mean over all L layers and M microbatches (each stage summed its
@@ -175,19 +214,31 @@ def pipeline_loss_fn(params: Params, cfg: TransformerConfig,
 # 1F1B
 # ---------------------------------------------------------------------------
 
-def _stage_fn_factory(cfg: TransformerConfig, freqs):
+def _stage_fn_factory(cfg: TransformerConfig, freqs, sp_axis=None):
     """Per-stage forward: scan this stage's K layers over one microbatch.
     Returns ``stage_fn(local_params, x) -> (y, aux_sum)`` where aux_sum is
     the summed MoE load-balancing loss of this stage's layers (0.0 on the
     dense path). Experts stay GSPMD-auto over the ep mesh axis — dense
     dispatch/combine einsums need no manual axis, so ep composes with the
-    pipeline's manual pp axis for free."""
+    pipeline's manual pp axis for free. With ``sp_axis`` set (the GPipe
+    schedule running under a manual sp axis), attention is ring attention
+    over that axis and ``freqs`` must already be the shard's
+    globally-offset slice."""
 
-    def attention_call(q, k, v):
-        return attention(
-            q.transpose(0, 2, 1, 3), k.transpose(0, 2, 1, 3),
-            v.transpose(0, 2, 1, 3), causal=True,
-        ).transpose(0, 2, 1, 3)
+    if sp_axis is not None:
+        from nos_tpu.ops.ring_attention import ring_attention
+
+        def attention_call(q, k, v):
+            return ring_attention(
+                q.transpose(0, 2, 1, 3), k.transpose(0, 2, 1, 3),
+                v.transpose(0, 2, 1, 3), axis_name=sp_axis, causal=True,
+            ).transpose(0, 2, 1, 3)
+    else:
+        def attention_call(q, k, v):
+            return attention(
+                q.transpose(0, 2, 1, 3), k.transpose(0, 2, 1, 3),
+                v.transpose(0, 2, 1, 3), causal=True,
+            ).transpose(0, 2, 1, 3)
 
     if cfg.n_experts > 0:
         from nos_tpu.models.transformer import attention_block

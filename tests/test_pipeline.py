@@ -103,8 +103,11 @@ def test_pipeline_validation_errors():
     with pytest.raises(ValueError, match="n_microbatches"):
         pipeline_forward(params4, cfg4, tokens, mesh, n_microbatches=3)
     sp_mesh = build_mesh(ParallelLayout(pp=2, sp=2), jax.devices()[:4])
-    with pytest.raises(ValueError, match="sp"):
-        pipeline_forward(params4, cfg4, tokens, sp_mesh)
+    # GPipe accepts sp (see the sp-composition tests); its seq-shard
+    # divisibility is still validated
+    with pytest.raises(ValueError, match="not divisible by sp"):
+        pipeline_forward(params4, cfg4, jnp.zeros((4, 15), jnp.int32),
+                         sp_mesh)
     no_pp = build_mesh(ParallelLayout(dp=2), jax.devices()[:2])
     with pytest.raises(ValueError, match="no pp axis"):
         pipeline_forward(params4, cfg4, tokens, no_pp)
@@ -316,3 +319,73 @@ def test_pipeline_honors_loss_chunk_and_named_policy():
     got_n = jnp.sqrt(sum(jnp.sum(jnp.square(g)) for g in
                          jax.tree.leaves(got_grads)))
     np.testing.assert_allclose(float(got_n), float(ref_n), rtol=1e-3)
+
+
+# ---------------------------------------------------------------------------
+# sp (ring attention) composition — GPipe schedule only
+# ---------------------------------------------------------------------------
+
+def sp_pp_mesh(dp=2, pp=2, sp=2):
+    layout = ParallelLayout(dp=dp, pp=pp, sp=sp)
+    return build_mesh(layout, jax.devices()[:layout.chips])
+
+
+def test_gpipe_composes_with_sp_ring_attention():
+    # the third route: sp as a second MANUAL axis inside GPipe's uniform
+    # tick — every (pp, sp) program executes the same ring ppermutes
+    # every step, so collectives pair (1F1B's divergent lax.cond is what
+    # breaks composition there). Exactness vs the plain forward.
+    cfg = small_cfg()
+    mesh = sp_pp_mesh()
+    params = tfm.init_params(jax.random.PRNGKey(0), cfg)
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (4, 16), 0, cfg.vocab)
+
+    ref = tfm.forward(params, cfg, tokens)
+    got = jax.jit(
+        lambda p, t: pipeline_forward(p, cfg, t, mesh, n_microbatches=2)
+    )(params, tokens)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               rtol=2e-4, atol=2e-4)
+
+
+def test_gpipe_sp_loss_and_grads_match_plain():
+    cfg = small_cfg()
+    mesh = sp_pp_mesh()
+    params = tfm.init_params(jax.random.PRNGKey(0), cfg)
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (4, 16), 0, cfg.vocab)
+    batch = {"tokens": tokens, "targets": tokens}
+
+    ref_loss, ref_grads = jax.value_and_grad(
+        lambda p: tfm.loss_fn(p, cfg, batch))(params)
+    got_loss, got_grads = jax.jit(jax.value_and_grad(
+        lambda p: pipeline_loss_fn(p, cfg, batch, mesh,
+                                   n_microbatches=2)))(params)
+    np.testing.assert_allclose(float(got_loss), float(ref_loss),
+                               rtol=2e-4, atol=2e-4)
+    flat_ref = jax.tree.leaves(ref_grads)
+    flat_got = jax.tree.leaves(got_grads)
+    for a, b in zip(flat_got, flat_ref):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=5e-3, atol=5e-3)
+
+
+def test_gpipe_sp_rejects_moe():
+    cfg = small_cfg(n_kv_heads=2, n_experts=4)
+    mesh = sp_pp_mesh()
+    params = tfm.init_params(jax.random.PRNGKey(0), cfg)
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (4, 16), 0, cfg.vocab)
+    with pytest.raises(ValueError, match="dense-only"):
+        jax.jit(lambda p, t: pipeline_forward(p, cfg, t, mesh,
+                                              n_microbatches=2))(params, tokens)
+
+
+def test_1f1b_still_rejects_sp():
+    from nos_tpu.parallel.pipeline import pipeline_1f1b_loss_fn
+    cfg = small_cfg()
+    mesh = sp_pp_mesh()
+    params = tfm.init_params(jax.random.PRNGKey(0), cfg)
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (4, 16), 0, cfg.vocab)
+    with pytest.raises(ValueError, match="1F1B does not compose with sp"):
+        pipeline_1f1b_loss_fn(params, cfg,
+                              {"tokens": tokens, "targets": tokens},
+                              mesh, n_microbatches=2)

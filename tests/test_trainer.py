@@ -149,3 +149,16 @@ def test_eval_loop_logs_heldout_loss(tmp_path, caplog):
     assert loss == loss
     evals = [r for r in caplog.records if "eval loss" in r.getMessage()]
     assert len(evals) == 2          # steps 2 and 4 of a 4-step run
+
+
+def test_trains_gpipe_with_sp():
+    # the dense long-context + depth recipe is reachable from the binary:
+    # pipeline_schedule="gpipe" composes pp with sp/ring attention
+    loss = train(tiny(pp=2, sp=2, dp=2, n_microbatches=2,
+                      pipeline_schedule="gpipe"))
+    assert loss == loss
+
+
+def test_1f1b_with_sp_fails_loudly():
+    with pytest.raises(ValueError, match="1F1B does not compose with sp"):
+        train(tiny(pp=2, sp=2, dp=2, n_microbatches=2))
