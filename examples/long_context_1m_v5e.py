@@ -11,7 +11,10 @@ ring_attention.py) never materializes the [S, S] block — each of the
 with ``ppermute``, and flash-style online-softmax statistics accumulate
 locally — so context length scales linearly with the ring size while
 per-chip memory stays constant. That is what makes sp the right axis for
-context (and why pp, which shards depth, cannot substitute).
+context (and why pp, which shards depth, cannot substitute). When the
+model is also too DEEP for fsdp alone, sp composes with pipeline depth
+sharding via the GPipe schedule (``TrainerConfig(pp=...,
+pipeline_schedule="gpipe")`` — dense models; see parallel/pipeline.py).
 
 The scheduling half is identical to the 70B example: the layout's chip
 count maps to a slice topology (``ParallelLayout.required_topology``),

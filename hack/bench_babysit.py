@@ -77,6 +77,10 @@ QUEUES = {
          mfu_env(8, "except_mlp", 512), 1500, "parity_flash"),
         ("mfu_b16_exceptmlp512", ["bench_mfu.py"],
          mfu_env(16, "except_mlp", 512), 1500, "parity_flash"),
+        # insurance between b8 and b16: if b16 OOMs and b8 undershoots,
+        # b12 is the publishable point
+        ("mfu_b12_exceptmlp512", ["bench_mfu.py"],
+         mfu_env(12, "except_mlp", 512), 1500, "parity_flash"),
         ("mfu_b16_minimal512", ["bench_mfu.py"],
          mfu_env(16, "minimal", 512), 1500, "parity_flash"),
         ("mfu_b32_minimal512", ["bench_mfu.py"],
