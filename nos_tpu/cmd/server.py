@@ -45,6 +45,9 @@ class ServerConfig:
     int8: bool = False
     # serving
     max_batch: int = 8
+    # prefix-cache entries (0 = off): each holds one prompt's KV on
+    # device — budget by model size (flagship: ~64 MB per 1k tokens)
+    prefix_cache_size: int = 0
     default_max_new_tokens: int = 64
     port: int = 8000
     seed: int = 0
@@ -85,6 +88,12 @@ class ServingLoop:
         self.m_abandoned = reg.counter(
             "nos_tpu_serve_abandoned_total",
             "Requests that finished after their client timed out")
+        self.m_prefix_hits = reg.gauge(
+            "nos_tpu_serve_prefix_hits",
+            "Prefill requests served from the prefix cache")
+        self.m_prefix_saved = reg.gauge(
+            "nos_tpu_serve_prefix_tokens_saved",
+            "Prompt tokens whose prefill was skipped via the prefix cache")
         self.engine = engine
         self._lock = threading.Lock()
         self._work = threading.Condition(self._lock)
@@ -109,6 +118,12 @@ class ServingLoop:
                     emitted = self.engine.step()
                     self.m_ticks.inc()
                     self.m_tokens.inc(emitted)
+                    # engine-held prefix-cache stats, mirrored as gauges
+                    hits = getattr(self.engine, "prefix_hits", None)
+                    if hits is not None:
+                        self.m_prefix_hits.set(hits)
+                        self.m_prefix_saved.set(
+                            self.engine.prefix_tokens_saved)
                 except BaseException as e:   # decode tick died: go unhealthy
                     logger.exception("decode tick failed; marking unhealthy")
                     self._failed = e
@@ -253,7 +268,8 @@ def build_engine(cfg: ServerConfig):
         max_seq=cfg.max_seq, n_experts=cfg.n_experts, bf16=cfg.bf16,
         checkpoint_dir=cfg.checkpoint_dir, int8=cfg.int8, seed=cfg.seed)
     model_cfg, params = load_params(gcfg)
-    return DecodeServer(params, model_cfg, max_batch=cfg.max_batch)
+    return DecodeServer(params, model_cfg, max_batch=cfg.max_batch,
+                        prefix_cache_size=cfg.prefix_cache_size)
 
 
 def make_http_server(cfg: ServerConfig, loop: ServingLoop
@@ -347,6 +363,10 @@ def make_http_server(cfg: ServerConfig, loop: ServingLoop
                     sampling["top_p"] = float(body["top_p"])
                 if "seed" in body:
                     sampling["seed"] = int(body["seed"])
+                if "cache_prefix" in body:
+                    # mark this prompt's KV as a reusable prefix (system
+                    # prompts); reuse is automatic on every request
+                    sampling["cache_prefix"] = bool(body["cache_prefix"])
                 if body.get("stream"):
                     # stream() submits eagerly, so validation errors land
                     # in the except arms below as a clean JSON 4xx —

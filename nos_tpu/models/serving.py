@@ -62,6 +62,7 @@ class _Request:
     seed: int = 0
     out: List[int] = field(default_factory=list)
     slot: int = -1
+    cache_prefix: bool = False
 
     @property
     def done(self) -> bool:
@@ -84,7 +85,8 @@ class DecodeServer:
     """
 
     def __init__(self, params: Params, cfg: TransformerConfig,
-                 max_batch: int = 8, max_len: Optional[int] = None):
+                 max_batch: int = 8, max_len: Optional[int] = None,
+                 prefix_cache_size: int = 0):
         self.params = params
         self.cfg = cfg
         self.max_batch = max_batch
@@ -95,6 +97,18 @@ class DecodeServer:
         self._active: Dict[int, _Request] = {}      # slot -> request
         self._pending: List[_Request] = []
         self._done: Dict[int, _Request] = {}
+        # prefix cache: token-tuple -> (k_rows, v_rows) of the prefix's
+        # KV (device arrays, [L, 1, Hkv, len, D]), LRU-capped at
+        # ``prefix_cache_size`` entries (0 = off). Requests submitted
+        # with cache_prefix=True publish their prompt's KV; every submit
+        # reuses the longest cached prefix of its prompt, prefilling
+        # only the suffix. KV rows hold absolute-position RoPE, and a
+        # prefix occupies the same absolute positions in every request
+        # that shares it, so reuse is exact.
+        self._prefix_max = prefix_cache_size
+        self._prefixes: Dict[tuple, tuple] = {}     # insertion-ordered LRU
+        self.prefix_hits = 0
+        self.prefix_tokens_saved = 0
         self._last = jnp.zeros((max_batch, 1), jnp.int32)
         self._next_rid = 0
         # per-slot sampling params, rows of the compiled decode program
@@ -154,7 +168,8 @@ class DecodeServer:
     # ------------------------------------------------------------------
     def submit(self, prompt: List[int], max_new_tokens: int, *,
                temperature: float = 0.0, top_k: int = 0,
-               top_p: float = 0.0, seed: Optional[int] = None) -> int:
+               top_p: float = 0.0, seed: Optional[int] = None,
+               cache_prefix: bool = False) -> int:
         """Enqueue a request. ``temperature`` 0 = greedy (bit-identical to
         ``generate``); > 0 samples, optionally truncated per-request by
         ``top_k``/``top_p``. ``seed`` keys the request's sample stream
@@ -182,7 +197,8 @@ class DecodeServer:
             rid, list(prompt), max_new_tokens,
             temperature=float(temperature), top_k=int(top_k),
             top_p=float(top_p),
-            seed=(rid if seed is None else int(seed)) & 0xFFFFFFFF))
+            seed=(rid if seed is None else int(seed)) & 0xFFFFFFFF,
+            cache_prefix=bool(cache_prefix) and self._prefix_max > 0))
         self._admit()
         return rid
 
@@ -201,21 +217,99 @@ class DecodeServer:
         z = jnp.zeros(tuple(shape), self.cache["k"].dtype)
         return z
 
+    def _prefix_match(self, prompt: List[int]):
+        """Pure lookup: (m, entry_key) for the longest common HEAD
+        between ``prompt`` and any cached entry — a partial entry match
+        reuses the entry's first m KV rows (valid on their own: they are
+        exactly positions 0..m), so an identical prompt resubmit reuses
+        plen-1 of itself and a longer cached prompt still serves its
+        shared head. Capped at plen-1: at least one suffix token must run
+        to produce the next token's logits. No side effects — the caller
+        decides whether the match is actually USED (fit + profitability)
+        before stats and LRU order move. Linear scan: the cache is
+        operator-capped small (system prompts, not pages)."""
+        cap = len(prompt) - 1
+        best, best_key = 0, None
+        for key in self._prefixes:
+            m = 0
+            for a, b in zip(key, prompt[:cap]):
+                if a != b:
+                    break
+                m += 1
+            if m > best:
+                best, best_key = m, key
+        return best, best_key
+
+    def _publish_prefix(self, prompt: List[int], rk, rv) -> None:
+        """Store this prompt's KV rows as a reusable prefix (trimmed to
+        the exact prompt length), evicting least-recently-used entries
+        past the cap."""
+        key = tuple(prompt)
+        plen = len(prompt)
+        self._prefixes[key] = (rk[:, :, :, :plen, :], rv[:, :, :, :plen, :])
+        while len(self._prefixes) > self._prefix_max:
+            self._prefixes.pop(next(iter(self._prefixes)))
+
     def _prefill_slot(self, req: _Request) -> None:
         """Prefill the prompt over a bucket-sized scratch cache (cost
         proportional to the request), then install the rows + position
-        into the shared cache in one donated jitted update."""
+        into the shared cache in one donated jitted update. A cached
+        prefix skips its share of the forward: its KV rows are written
+        into the scratch cache and only the suffix tokens run."""
         plen = len(req.prompt)
-        bucket = min(_bucket(plen), self.max_len)
-        toks = jnp.asarray(
-            [req.prompt + [0] * (bucket - plen)], jnp.int32)
+        m, mkey = (self._prefix_match(req.prompt) if self._prefixes
+                   else (0, None))
+        # fit: the suffix's padded bucket must land inside max_len after
+        # the prefix (forward_with_cache writes the whole bucket at pos
+        # m, and dynamic_update_slice CLAMPS an overrunning start — which
+        # would silently overwrite the prefix KV). Shrink m instead of
+        # discarding the match: a 400-token reuse trimmed to 384 beats
+        # zero. _bucket(plen - m) grows as m shrinks, so iterate.
+        while m > 0 and m + _bucket(plen - m) > self.max_len:
+            m = max(0, self.max_len - _bucket(plen - m))
+        # profitability: reuse must make the suffix forward strictly
+        # cheaper than full prefill (fewer query tokens per bucket tier),
+        # or a trivial shared head (e.g. a lone BOS token) would route
+        # every request through the prefix path — extra copies, same
+        # compute — while the metrics report savings
+        if m > 0 and _bucket(plen - m) >= _bucket(plen):
+            m = 0
+        sbucket = _bucket(plen - m)
+        if m > 0:
+            self._prefixes[mkey] = self._prefixes.pop(mkey)   # LRU refresh
+            self.prefix_hits += 1
+            self.prefix_tokens_saved += m
+        else:
+            mkey = None
+        # scratch sized so prefix + padded suffix both fit (≥ the plen
+        # bucket: _install expects rows at least plen long)
+        bucket = min(_bucket(max(plen, m + sbucket)), self.max_len)
         row = {
             "k": self._row_zeros(bucket),
             "v": self._row_zeros(bucket),
             "pos": jnp.zeros((), jnp.int32),
         }
-        logits, row = self._prefill(self.params, toks, row)
-        step = logits[0, plen - 1]
+        if m > 0:
+            pk, pv = self._prefixes[mkey]
+            row["k"] = jax.lax.dynamic_update_slice(
+                row["k"], pk[:, :, :, :m, :], (0, 0, 0, 0, 0))
+            row["v"] = jax.lax.dynamic_update_slice(
+                row["v"], pv[:, :, :, :m, :], (0, 0, 0, 0, 0))
+            row["pos"] = jnp.int32(m)
+            suffix = req.prompt[m:]
+            toks = jnp.asarray(
+                [suffix + [0] * (sbucket - len(suffix))], jnp.int32)
+            logits, row = self._prefill(self.params, toks, row)
+            step = logits[0, len(suffix) - 1]
+        else:
+            # pad to the row length (not the raw bucket): _bucket can
+            # round past max_len and the write must fit the scratch
+            toks = jnp.asarray(
+                [req.prompt + [0] * (bucket - plen)], jnp.int32)
+            logits, row = self._prefill(self.params, toks, row)
+            step = logits[0, plen - 1]
+        if req.cache_prefix:
+            self._publish_prefix(req.prompt, row["k"], row["v"])
         if req.temperature > 0:
             # token at absolute index plen: same (seed, index) keying as
             # the decode program, so prefill vs decode is seamless

@@ -164,3 +164,97 @@ def test_cancel_pending_request_never_decodes(params):
     assert srv.pop_result(rid_b) == [2]       # prompt only, zero decoded
     results = srv.drain()
     assert len(results[rid_a]) == 1 + 8
+
+
+# ---------------------------------------------------------------------------
+# prefix cache
+# ---------------------------------------------------------------------------
+
+def test_prefix_reuse_is_exact(params):
+    # a request sharing a published prefix must produce EXACTLY the
+    # tokens of the uncached path — prefix KV reuse is a compute saving,
+    # never a numerics change
+    system = [7, 3, 5, 9, 2, 4, 1, 8]
+    srv = DecodeServer(params, CFG, max_batch=2, prefix_cache_size=4)
+    srv.submit(system + [11, 12], 6, cache_prefix=True)
+    srv.drain()
+    assert not srv.prefix_hits            # nothing cached before publish
+
+    rid = srv.submit(system + [11, 12], 6)
+    got = srv.drain()[rid]
+    assert srv.prefix_hits == 1           # identical prompt: plen-1 reused
+    assert srv.prefix_tokens_saved == len(system) + 1
+    assert got == ref(params, system + [11, 12], 6)
+
+
+def test_prefix_partial_overlap_and_sampling(params):
+    system = [7, 3, 5, 9, 2, 4, 1, 8]
+    srv = DecodeServer(params, CFG, max_batch=2, prefix_cache_size=4)
+    srv.submit(system, 2, cache_prefix=True)
+    srv.drain()
+
+    # different suffixes over the shared prefix, greedy and sampled
+    uncached = DecodeServer(params, CFG, max_batch=2)
+    for suffix, sampling in ([13, 14], {}), ([15], dict(
+            temperature=0.9, top_k=8, seed=42)):
+        r1 = srv.submit(system + suffix, 5, **sampling)
+        got1 = srv.drain()[r1]
+        r2 = uncached.submit(system + suffix, 5, **sampling)
+        got2 = uncached.drain()[r2]
+        assert got1 == got2, (suffix, sampling)
+    assert srv.prefix_hits == 2
+    assert srv.prefix_tokens_saved == 2 * len(system)
+
+
+def test_prefix_identical_prompt_still_needs_last_token(params):
+    # prompt == cached prefix: reuse is capped at plen-1 so the final
+    # token still runs to produce the next-token logits
+    prompt = [5, 6, 7, 8]
+    srv = DecodeServer(params, CFG, max_batch=1, prefix_cache_size=2)
+    srv.submit(prompt, 3, cache_prefix=True)
+    srv.drain()
+    rid = srv.submit(prompt, 3)
+    assert srv.drain()[rid] == ref(params, prompt, 3)
+
+
+def test_prefix_lru_eviction(params):
+    srv = DecodeServer(params, CFG, max_batch=1, prefix_cache_size=2)
+    for base in ([1, 2, 3], [4, 5, 6], [7, 8, 9]):   # third evicts first
+        srv.submit(base, 1, cache_prefix=True)
+        srv.drain()
+    assert len(srv._prefixes) == 2
+    assert (1, 2, 3) not in srv._prefixes
+    rid = srv.submit([1, 2, 3, 10], 3)               # evicted: no hit
+    got = srv.drain()[rid]
+    assert srv.prefix_hits == 0
+    assert got == ref(params, [1, 2, 3, 10], 3)
+
+
+def test_prefix_shrinks_to_fit_instead_of_discarding(params):
+    # when prefix + padded-suffix bucket would overrun max_len, m shrinks
+    # to keep partial reuse (the long prompts where savings matter most)
+    srv = DecodeServer(params, CFG, max_batch=1, max_len=32,
+                       prefix_cache_size=2)
+    base = list(range(1, 21))                 # 20-token system prompt
+    srv.submit(base, 1, cache_prefix=True)
+    srv.drain()
+    prompt = base + list(range(40, 50))       # plen 30: 20+_bucket(10)=36>32
+    rid = srv.submit(prompt, 1)
+    got = srv.drain()[rid]
+    assert srv.prefix_hits == 1
+    assert srv.prefix_tokens_saved == 16      # shrunk from 20 to fit
+    assert got == ref(params, prompt, 1)
+
+
+def test_trivial_prefix_overlap_not_counted(params):
+    # a shared head too small to shrink the suffix bucket must not route
+    # through the prefix path (same compute, extra copies) nor count as
+    # savings in the metrics
+    srv = DecodeServer(params, CFG, max_batch=1, prefix_cache_size=2)
+    srv.submit([1, 2, 3], 1, cache_prefix=True)
+    srv.drain()
+    rid = srv.submit([1, 9, 9, 9, 9, 9], 2)   # shares only the first token
+    got = srv.drain()[rid]
+    assert srv.prefix_hits == 0
+    assert srv.prefix_tokens_saved == 0
+    assert got == ref(params, [1, 9, 9, 9, 9, 9], 2)
