@@ -118,12 +118,7 @@ class ServingLoop:
                     emitted = self.engine.step()
                     self.m_ticks.inc()
                     self.m_tokens.inc(emitted)
-                    # engine-held prefix-cache stats, mirrored as gauges
-                    hits = getattr(self.engine, "prefix_hits", None)
-                    if hits is not None:
-                        self.m_prefix_hits.set(hits)
-                        self.m_prefix_saved.set(
-                            self.engine.prefix_tokens_saved)
+                    self._mirror_prefix_gauges()
                 except BaseException as e:   # decode tick died: go unhealthy
                     logger.exception("decode tick failed; marking unhealthy")
                     self._failed = e
@@ -173,6 +168,17 @@ class ServingLoop:
             else:
                 self._abandoned.add(rid)
 
+    def _mirror_prefix_gauges(self) -> None:
+        """Engine-held prefix-cache stats -> gauges. Called after every
+        decode tick AND every submit: a prefill-only request
+        (max_new_tokens=1) completes without the ticker ever running, so
+        tick-time mirroring alone would leave /metrics stale forever on
+        an idle server."""
+        hits = getattr(self.engine, "prefix_hits", None)
+        if hits is not None:
+            self.m_prefix_hits.set(hits)
+            self.m_prefix_saved.set(self.engine.prefix_tokens_saved)
+
     def stream(self, prompt, max_new_tokens, timeout: float = 300.0,
                **sampling):
         """Streaming primitive: submits EAGERLY (validation errors raise
@@ -186,6 +192,7 @@ class ServingLoop:
             if self._failed is not None:
                 raise RuntimeError(f"serving loop failed: {self._failed}")
             rid = self.engine.submit(prompt, max_new_tokens, **sampling)
+            self._mirror_prefix_gauges()
             self._work.notify_all()
 
         def deltas():
@@ -365,8 +372,13 @@ def make_http_server(cfg: ServerConfig, loop: ServingLoop
                     sampling["seed"] = int(body["seed"])
                 if "cache_prefix" in body:
                     # mark this prompt's KV as a reusable prefix (system
-                    # prompts); reuse is automatic on every request
-                    sampling["cache_prefix"] = bool(body["cache_prefix"])
+                    # prompts); reuse is automatic on every request.
+                    # Strict type check: bool("false") is True, and a
+                    # mistyped string would silently pin device memory
+                    if not isinstance(body["cache_prefix"], bool):
+                        raise ValueError(
+                            "cache_prefix must be a JSON boolean")
+                    sampling["cache_prefix"] = body["cache_prefix"]
                 if body.get("stream"):
                     # stream() submits eagerly, so validation errors land
                     # in the except arms below as a clean JSON 4xx —

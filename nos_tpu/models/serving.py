@@ -246,6 +246,10 @@ class DecodeServer:
         past the cap."""
         key = tuple(prompt)
         plen = len(prompt)
+        # pop-then-set: dict assignment to an existing key keeps its OLD
+        # insertion position, and a just-republished hot prefix must not
+        # sit first in line for eviction
+        self._prefixes.pop(key, None)
         self._prefixes[key] = (rk[:, :, :, :plen, :], rv[:, :, :, :plen, :])
         while len(self._prefixes) > self._prefix_max:
             self._prefixes.pop(next(iter(self._prefixes)))

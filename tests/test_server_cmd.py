@@ -335,3 +335,33 @@ def test_stream_closed_after_completion_pops_immediately():
         assert s.rid not in loop._abandoned
     finally:
         loop.shutdown()
+
+
+def test_cache_prefix_requires_json_boolean(served):
+    url, _, _ = served
+    with pytest.raises(urllib.error.HTTPError) as e:
+        post(url, {"prompt": [1, 2], "max_new_tokens": 2,
+                   "cache_prefix": "false"})   # truthy string != bool
+    assert e.value.code == 400
+
+
+def test_prefix_gauges_mirror_without_ticks():
+    # a prefill-only workload (requests completing inside submit) must
+    # still reach /metrics: gauges mirror on submit, not just on tick
+    from nos_tpu.utils.metrics import default_registry
+
+    eng = _FakeEngine()
+    eng.prefix_hits = 3
+    eng.prefix_tokens_saved = 24
+    loop = ServingLoop(eng)
+    try:
+        loop.generate([1], 1, timeout=10)
+        text = default_registry().expose()
+        for line in text.splitlines():
+            if line.startswith("nos_tpu_serve_prefix_hits "):
+                assert float(line.split()[-1]) == 3
+                break
+        else:
+            raise AssertionError("gauge not exposed")
+    finally:
+        loop.shutdown()

@@ -258,3 +258,15 @@ def test_trivial_prefix_overlap_not_counted(params):
     assert srv.prefix_hits == 0
     assert srv.prefix_tokens_saved == 0
     assert got == ref(params, [1, 9, 9, 9, 9, 9], 2)
+
+
+def test_republish_refreshes_lru_position(params):
+    # re-publishing an existing key must move it to most-recently-used:
+    # dict assignment alone keeps the OLD insertion slot, which would
+    # evict the hot system prompt on the next publish
+    srv = DecodeServer(params, CFG, max_batch=1, prefix_cache_size=2)
+    for base in ([1, 2, 3], [4, 5, 6], [1, 2, 3], [7, 8, 9]):
+        srv.submit(base, 1, cache_prefix=True)
+        srv.drain()
+    assert (1, 2, 3) in srv._prefixes          # republished: survived
+    assert (4, 5, 6) not in srv._prefixes      # oldest: evicted
