@@ -370,6 +370,9 @@ def make_http_server(cfg: ServerConfig, loop: ServingLoop
                     sampling["top_p"] = float(body["top_p"])
                 if "seed" in body:
                     sampling["seed"] = int(body["seed"])
+                if "stop_tokens" in body:
+                    sampling["stop_tokens"] = [
+                        int(t) for t in body["stop_tokens"]]
                 if "cache_prefix" in body:
                     # mark this prompt's KV as a reusable prefix (system
                     # prompts); reuse is automatic on every request.

@@ -63,6 +63,14 @@ class _Request:
     out: List[int] = field(default_factory=list)
     slot: int = -1
     cache_prefix: bool = False
+    stop_tokens: tuple = ()
+
+    def note_token(self) -> None:
+        """Called after each appended token: a stop token terminates the
+        request (the stop token IS included in the output — the HF EOS
+        convention) by truncating max_new_tokens to what was produced."""
+        if self.stop_tokens and self.out[-1] in self.stop_tokens:
+            self.max_new_tokens = len(self.out)
 
     @property
     def done(self) -> bool:
@@ -169,7 +177,8 @@ class DecodeServer:
     def submit(self, prompt: List[int], max_new_tokens: int, *,
                temperature: float = 0.0, top_k: int = 0,
                top_p: float = 0.0, seed: Optional[int] = None,
-               cache_prefix: bool = False) -> int:
+               cache_prefix: bool = False,
+               stop_tokens: Optional[List[int]] = None) -> int:
         """Enqueue a request. ``temperature`` 0 = greedy (bit-identical to
         ``generate``); > 0 samples, optionally truncated per-request by
         ``top_k``/``top_p``. ``seed`` keys the request's sample stream
@@ -198,7 +207,8 @@ class DecodeServer:
             temperature=float(temperature), top_k=int(top_k),
             top_p=float(top_p),
             seed=(rid if seed is None else int(seed)) & 0xFFFFFFFF,
-            cache_prefix=bool(cache_prefix) and self._prefix_max > 0))
+            cache_prefix=bool(cache_prefix) and self._prefix_max > 0,
+            stop_tokens=tuple(int(t) for t in stop_tokens or ())))
         self._admit()
         return rid
 
@@ -337,6 +347,7 @@ class DecodeServer:
             self.cache, row["k"], row["v"], jnp.int32(req.slot),
             jnp.int32(plen), jnp.int32(first), self._last)
         req.out.append(first)
+        req.note_token()
         self._finish_if_done(req)
 
     def _finish_if_done(self, req: _Request) -> None:
@@ -368,6 +379,7 @@ class DecodeServer:
         for s in active:
             req = self._active[s]
             req.out.append(int(nxt_host[s]))
+            req.note_token()
             emitted += 1
             self._finish_if_done(req)
         return emitted

@@ -365,3 +365,15 @@ def test_prefix_gauges_mirror_without_ticks():
             raise AssertionError("gauge not exposed")
     finally:
         loop.shutdown()
+
+
+def test_stop_tokens_over_http(served):
+    url, params, mcfg = served
+    full = [int(t) for t in
+            generate(params, mcfg, jnp.asarray([[4, 5]], jnp.int32), 10)[0]]
+    stop = full[2 + 3]
+    got = post(url, {"prompt": [4, 5], "max_new_tokens": 10,
+                     "stop_tokens": [stop]})["tokens"]
+    # truncates at the FIRST occurrence of the stop token
+    first_at = full.index(stop, 2)
+    assert got == full[:first_at + 1] and got[-1] == stop

@@ -270,3 +270,37 @@ def test_republish_refreshes_lru_position(params):
         srv.drain()
     assert (1, 2, 3) in srv._prefixes          # republished: survived
     assert (4, 5, 6) not in srv._prefixes      # oldest: evicted
+
+
+# ---------------------------------------------------------------------------
+# stop tokens
+# ---------------------------------------------------------------------------
+
+def test_stop_token_truncates_and_frees_slot(params):
+    srv = DecodeServer(params, CFG, max_batch=1)
+    full = ref(params, [4, 5], 12)              # find a token to stop on
+    stop = full[2 + 4]                          # 5th generated token
+    rid_a = srv.submit([4, 5], 12, stop_tokens=[stop])
+    rid_b = srv.submit([9], 2)                  # queued behind a
+    results = srv.drain()
+    got = results[rid_a]
+    first_at = full.index(stop, 2)              # fires at FIRST occurrence
+    assert got == full[:first_at + 1]
+    assert got[-1] == stop                      # EOS included (HF convention)
+    assert len(results[rid_b]) == 1 + 2         # slot freed for b
+
+
+def test_stop_token_in_prefill_first_token(params):
+    full = ref(params, [4, 5], 3)
+    first = full[2]                             # token emitted by prefill
+    srv = DecodeServer(params, CFG, max_batch=1)
+    rid = srv.submit([4, 5], 8, stop_tokens=[first])
+    assert srv.drain()[rid] == [4, 5, first]    # terminated immediately
+
+
+def test_stop_token_never_seen_runs_to_max(params):
+    srv = DecodeServer(params, CFG, max_batch=1)
+    rid = srv.submit([4, 5], 6, stop_tokens=[63])   # assume 63 unseen
+    got = srv.drain()[rid]
+    want = ref(params, [4, 5], 6)
+    assert got == want or got[-1] == 63
