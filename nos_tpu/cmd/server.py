@@ -48,6 +48,19 @@ class ServerConfig:
     # prefix-cache entries (0 = off): each holds one prompt's KV on
     # device — budget by model size (flagship: ~64 MB per 1k tokens)
     prefix_cache_size: int = 0
+    # speculative decoding (draft_checkpoint_dir set = on): a smaller
+    # draft model proposes draft_n_tokens per tick, the target verifies
+    # them in one wide forward. Greedy requests stay bit-identical to
+    # plain decoding; sampled requests keep the exact target
+    # distribution (accept-reject). Draft dims below must match the
+    # draft checkpoint's training config.
+    draft_checkpoint_dir: str = ""
+    draft_d_model: int = 256
+    draft_n_layers: int = 2
+    draft_n_heads: int = 4
+    draft_n_kv_heads: int = 0
+    draft_d_ff: int = 704
+    draft_n_tokens: int = 4
     default_max_new_tokens: int = 64
     port: int = 8000
     seed: int = 0
@@ -275,6 +288,20 @@ def build_engine(cfg: ServerConfig):
         max_seq=cfg.max_seq, n_experts=cfg.n_experts, bf16=cfg.bf16,
         checkpoint_dir=cfg.checkpoint_dir, int8=cfg.int8, seed=cfg.seed)
     model_cfg, params = load_params(gcfg)
+    if cfg.draft_checkpoint_dir:
+        from nos_tpu.models.spec_serving import SpeculativeDecodeServer
+
+        dcfg_in = GenerateConfig(
+            vocab=cfg.vocab, d_model=cfg.draft_d_model,
+            n_layers=cfg.draft_n_layers, n_heads=cfg.draft_n_heads,
+            n_kv_heads=cfg.draft_n_kv_heads, d_ff=cfg.draft_d_ff,
+            max_seq=cfg.max_seq, bf16=cfg.bf16,
+            checkpoint_dir=cfg.draft_checkpoint_dir, seed=cfg.seed)
+        draft_cfg, draft_params = load_params(dcfg_in)
+        return SpeculativeDecodeServer(
+            params, model_cfg, draft_params, draft_cfg,
+            n_draft=cfg.draft_n_tokens, max_batch=cfg.max_batch,
+            prefix_cache_size=cfg.prefix_cache_size)
     return DecodeServer(params, model_cfg, max_batch=cfg.max_batch,
                         prefix_cache_size=cfg.prefix_cache_size)
 

@@ -1,0 +1,278 @@
+"""Speculative decoding inside the continuous-batching engine.
+
+``SpeculativeDecodeServer`` extends ``DecodeServer`` with a draft model:
+every tick, the draft proposes ``n_draft`` tokens per slot (n_draft
+sequential small forwards), the target verifies them all in ONE wide
+forward (the same weight traffic as a single decode step — the
+bandwidth economics of models/speculative.py), and each row commits its
+own accepted prefix plus, on the first rejection, the verified
+correction token — up to ``n_draft`` tokens per tick (a full accept
+commits all n_draft proposals; there is no bonus token, matching
+speculative_generate). The slot
+engine's per-row ``pos`` removes speculative_generate's batching
+compromise: that API must advance every row uniformly by the MINIMUM
+acceptance (a single scalar pos), while slots advance independently —
+a row that accepted 3 of 4 commits those 3 plus its correction token
+while its neighbour commits 1.
+
+Exactness contract (same as models/speculative.py, per row):
+- greedy rows (temperature 0) are bit-identical to plain decoding of
+  the target model;
+- sampled rows use accept-reject speculative sampling — every committed
+  token is distributed exactly as target-only sampling, with the RNG
+  keyed by (seed, absolute position, sub-stream) so a row's output is
+  independent of batch composition. (The sample PATH differs from the
+  non-speculative engine's — same distribution, different draws — so a
+  seeded sampled request is reproducible against THIS engine, not
+  token-equal to DecodeServer's.)
+
+Rollback is position arithmetic: the verify pass writes k cache entries
+per row, and per-row ``pos`` is then set to the committed length —
+entries beyond pos are masked out of attention and overwritten by later
+writes ("only pos decides what exists"). The draft keeps its own
+per-row-pos KV cache, maintained under the same invariant as the
+target's: processed == committed[:-1], ``last`` is the newest committed
+token, not yet fed.
+"""
+from __future__ import annotations
+
+import functools
+
+from typing import List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from nos_tpu.models.generate import (
+    _truncate_logits_rows, forward_with_cache, init_cache,
+)
+from nos_tpu.models.serving import DecodeServer, _bucket
+from nos_tpu.models.transformer import Params, TransformerConfig
+
+__all__ = ["SpeculativeDecodeServer"]
+
+
+def _row_dist(logits, temp, topk, topp):
+    """Per-row tempered + truncated sampling distribution [B, V] — the
+    distribution the plain engine samples from (serving's per-slot twin
+    of speculative._dist)."""
+    return jax.nn.softmax(
+        _truncate_logits_rows(logits / jnp.maximum(temp, 1e-6)[:, None],
+                              topk, topp), axis=-1)
+
+
+def _sample_rows(keys, probs):
+    logp = jnp.where(probs > 0, jnp.log(jnp.maximum(probs, 1e-38)),
+                     -jnp.inf)
+    return jax.vmap(jax.random.categorical)(keys, logp)
+
+
+class SpeculativeDecodeServer(DecodeServer):
+    """DecodeServer with draft-verified ticks. ``step()`` emits UP TO
+    ``n_draft`` tokens per active slot per tick instead of one."""
+
+    def __init__(self, params: Params, cfg: TransformerConfig,
+                 draft_params: Params, draft_cfg: TransformerConfig,
+                 *, n_draft: int = 4, max_batch: int = 8,
+                 max_len: Optional[int] = None, **kw):
+        if draft_cfg.vocab != cfg.vocab:
+            raise ValueError("draft and target must share a vocabulary")
+        super().__init__(params, cfg, max_batch=max_batch,
+                         max_len=max_len, **kw)
+        self.draft_params = draft_params
+        self.draft_cfg = draft_cfg
+        self.k = max(1, int(n_draft))
+        self.d_cache = init_cache(draft_cfg, max_batch, self.max_len,
+                                  per_row_pos=True)
+        k = self.k
+
+        def spec_tick(p, dp, last, t_cache, d_cache, keep, temp, topk,
+                      topp, seeds, sampling: bool):
+            t_pos0 = t_cache["pos"]
+            d_pos0 = d_cache["pos"]
+            b = last.shape[0]
+
+            def row_keys(offs, stream):
+                # (seed, absolute position, sub-stream) keying: position
+                # of the token being produced is t_pos0 + 1 + i; streams
+                # 0/1/2 = draft draw / accept u / residual draw
+                return jax.vmap(
+                    lambda s, q: jax.random.fold_in(
+                        jax.random.PRNGKey(s), q * 4 + stream)
+                )(seeds, t_pos0 + 1 + offs)
+
+            # 1. draft proposes k tokens autoregressively
+            drafts, qs = [], []
+            tok = last
+            for i in range(k):
+                dlogits, d_cache = forward_with_cache(
+                    dp, self.draft_cfg, tok, d_cache)
+                step_logits = dlogits[:, -1]
+                nxt = jnp.argmax(step_logits, axis=-1)
+                if sampling:
+                    q = _row_dist(step_logits, temp, topk, topp)
+                    drawn = _sample_rows(row_keys(i, 0), q)
+                    nxt = jnp.where(temp > 0, drawn, nxt)
+                    qs.append(q)
+                tok = nxt[:, None]
+                drafts.append(nxt)
+            proposed = jnp.stack(drafts, axis=1)            # [B, k]
+
+            # 2. target verifies in one pass: logits[:, i] is the
+            # target's verdict on proposed[:, i]
+            feed = jnp.concatenate([last, proposed[:, :-1]], axis=1)
+            tlogits, t_cache = forward_with_cache(p, self.cfg, feed,
+                                                  t_cache)
+            greedy = jnp.argmax(tlogits, axis=-1)           # [B, k]
+            if sampling:
+                pdist = jax.vmap(_row_dist, in_axes=(1, None, None, None),
+                                 out_axes=1)(tlogits, temp, topk, topp)
+                qdist = jnp.stack(qs, axis=1)               # [B, k, V]
+                px = jnp.take_along_axis(
+                    pdist, proposed[..., None], -1)[..., 0]
+                qx = jnp.take_along_axis(
+                    qdist, proposed[..., None], -1)[..., 0]
+                # one accept-u vector per row, keyed at the round's first
+                # produced position (stream 1); u[i] gates proposed[:, i]
+                u = jax.vmap(
+                    lambda key: jax.random.uniform(key, (k,))
+                )(row_keys(0, 1))
+                accept_sampled = u * qx < px
+                accept = jnp.where((temp > 0)[:, None], accept_sampled,
+                                   proposed == greedy)
+            else:
+                accept = proposed == greedy
+
+            # 3. per-row accepted-prefix length a in [0, k]
+            a = jnp.argmin(
+                jnp.concatenate([accept, jnp.zeros((b, 1), bool)], axis=1),
+                axis=1)
+            full = a == k
+            # correction token at the first rejection: target argmax
+            # (greedy) or a residual draw (sampling); full-accept rows
+            # need none (committed = all k proposals, no bonus token —
+            # matching speculative_generate)
+            a_idx = jnp.minimum(a, k - 1)
+            corr = jnp.take_along_axis(greedy, a_idx[:, None], 1)[:, 0]
+            if sampling:
+                p_a = jnp.take_along_axis(
+                    pdist, a_idx[:, None, None], 1)[:, 0]   # [B, V]
+                q_a = jnp.take_along_axis(
+                    qdist, a_idx[:, None, None], 1)[:, 0]
+                resid = jnp.maximum(p_a - q_a, 0.0)
+                norm = jnp.sum(resid, axis=-1, keepdims=True)
+                resid = jnp.where(norm > 0, resid / norm, p_a)
+                corr_s = _sample_rows(row_keys(a_idx, 2), resid)
+                corr = jnp.where(temp > 0, corr_s, corr)
+
+            # 4. committed tokens [B, k]: proposed[:a], then corr, then
+            # dead padding; counts c = k (full accept) | a + 1
+            c = jnp.where(full, k, a + 1)                   # [B]
+            j = jnp.arange(k)[None, :]
+            commit = jnp.where(
+                j < a[:, None], proposed,
+                jnp.where(j == a[:, None], corr[:, None], 0))
+            commit = jnp.where(full[:, None], proposed, commit)
+            # new last = final committed token per row
+            new_last = jnp.take_along_axis(
+                commit, (c - 1)[:, None], 1)                # [B, 1]
+            last = jnp.where(keep[:, None], new_last, last)
+
+            # 5. rollback-by-position: processed == committed[:-1]
+            t_cache["pos"] = jnp.where(keep, t_pos0 + c, t_pos0)
+            d_cache["pos"] = jnp.where(keep, d_pos0 + c, d_pos0)
+            return commit, c, last, t_cache, d_cache
+
+        self._spec_tick = jax.jit(spec_tick, donate_argnums=(3, 4),
+                                  static_argnums=(10,))
+
+        def d_prefill(dp, toks, row):
+            return forward_with_cache(dp, self.draft_cfg, toks, row)
+
+        self._d_prefill = jax.jit(d_prefill)
+
+        def d_install(cache, rk, rv, slot, plen):
+            cache["k"] = jax.lax.dynamic_update_slice(
+                cache["k"], rk, (0, slot, 0, 0, 0))
+            cache["v"] = jax.lax.dynamic_update_slice(
+                cache["v"], rv, (0, slot, 0, 0, 0))
+            cache["pos"] = cache["pos"].at[slot].set(plen)
+            return cache
+
+        self._d_install = jax.jit(d_install, donate_argnums=(0,))
+
+    # ------------------------------------------------------------------
+    def submit(self, prompt, max_new_tokens, **kw) -> int:
+        # headroom: a verify round writes up to k positions past the
+        # committed prefix before rolling back-by-position; without this
+        # the per-row dynamic_update_slice would CLAMP near max_len and
+        # silently overwrite valid KV (same guard as
+        # speculative_generate's s + max_new + k check)
+        if prompt and len(prompt) + max_new_tokens + self.k > self.max_len:
+            raise ValueError(
+                f"prompt ({len(prompt)}) + max_new_tokens "
+                f"({max_new_tokens}) + draft window ({self.k}) exceeds "
+                f"cache length {self.max_len}")
+        return super().submit(prompt, max_new_tokens, **kw)
+
+    @functools.lru_cache(maxsize=None)      # noqa: B019 — engine-lived
+    def _d_row_zeros(self, bucket: int):
+        shape = list(self.d_cache["k"].shape)
+        shape[1], shape[3] = 1, bucket
+        return jnp.zeros(tuple(shape), self.d_cache["k"].dtype)
+
+    def _prefill_slot(self, req) -> None:
+        # draft prefill + install FIRST: the request may finish inside
+        # the super call (stop token / max_new=1), releasing the slot and
+        # recursively admitting a pending request into it — a stale
+        # draft install landing afterwards would overwrite the NEW
+        # request's draft row (no prefix cache here: published entries
+        # hold TARGET KV; the draft is small and its prefill is cheap)
+        slot = req.slot
+        plen = len(req.prompt)
+        bucket = min(_bucket(plen), self.max_len)
+        toks = jnp.asarray([req.prompt + [0] * (bucket - plen)], jnp.int32)
+        row = {
+            "k": self._d_row_zeros(bucket),
+            "v": self._d_row_zeros(bucket),
+            "pos": jnp.zeros((), jnp.int32),
+        }
+        _, row = self._d_prefill(self.draft_params, toks, row)
+        self.d_cache = self._d_install(
+            self.d_cache, row["k"], row["v"], jnp.int32(slot),
+            jnp.int32(plen))
+        super()._prefill_slot(req)
+
+    def _finish_if_done(self, req) -> None:
+        if req.done and req.slot >= 0:
+            self.d_cache["pos"] = self.d_cache["pos"].at[req.slot].set(0)
+        super()._finish_if_done(req)
+
+    # ------------------------------------------------------------------
+    def step(self) -> int:
+        """One speculative tick: up to k tokens per active slot."""
+        if not self._active:
+            return 0
+        active = sorted(self._active)
+        keep = jnp.zeros((self.max_batch,), bool).at[
+            jnp.asarray(active, jnp.int32)].set(True)
+        sampling = any(self._active[s].temperature > 0 for s in active)
+        commit, counts, self._last, self.cache, self.d_cache = \
+            self._spec_tick(
+                self.params, self.draft_params, self._last, self.cache,
+                self.d_cache, keep, self._temp, self._topk, self._topp,
+                self._seed, sampling)
+        commit_host = np.asarray(commit)
+        counts_host = np.asarray(counts)
+        emitted = 0
+        for s in active:
+            req = self._active[s]
+            for j in range(int(counts_host[s])):
+                req.out.append(int(commit_host[s, j]))
+                req.note_token()
+                emitted += 1
+                if req.done:
+                    break
+            self._finish_if_done(req)
+        return emitted
