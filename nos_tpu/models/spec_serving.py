@@ -170,10 +170,11 @@ class SpeculativeDecodeServer(DecodeServer):
             # dead padding; counts c = k (full accept) | a + 1
             c = jnp.where(full, k, a + 1)                   # [B]
             j = jnp.arange(k)[None, :]
+            # full-accept rows (a == k) fall out naturally: j < a holds
+            # for every column, so commit == proposed with no special case
             commit = jnp.where(
                 j < a[:, None], proposed,
                 jnp.where(j == a[:, None], corr[:, None], 0))
-            commit = jnp.where(full[:, None], proposed, commit)
             # new last = final committed token per row
             new_last = jnp.take_along_axis(
                 commit, (c - 1)[:, None], 1)                # [B, 1]
