@@ -1,0 +1,126 @@
+"""Planner property tests (partitioning/planner.py — reference
+internal/partitioning/core/planner.go:67-153): for ARBITRARY mixes of
+used slices and pending sub-slice pods, the produced plan must
+
+1. preserve every used slice on every node (the never-delete-used
+   contract, end to end through fork/commit/revert),
+2. contain only geometries from the generation's allowed table,
+3. conserve each board's silicon,
+4. be deterministic for identical inputs.
+"""
+import random
+
+from hypothesis import given, settings
+from hypothesis import strategies as st
+
+from nos_tpu import constants
+from nos_tpu.kube.objects import (
+    Container, Node, NodeStatus, ObjectMeta, Pod, PodCondition, PodSpec,
+    PodStatus,
+)
+from nos_tpu.partitioning.planner import Planner
+from nos_tpu.partitioning.snapshot import ClusterSnapshot, SnapshotNode
+from nos_tpu.scheduler import framework as fw
+from nos_tpu.tpu import topology
+from nos_tpu.tpu.node import TpuNode
+from nos_tpu.tpu.slice import Profile, geometry_chips
+
+PROFILES = [Profile(1, 1), Profile(2, 2), Profile(2, 4)]
+RESOURCES = {p: p.resource_name for p in PROFILES}
+
+
+def v5e_node(name):
+    return Node(
+        metadata=ObjectMeta(name=name, labels={
+            constants.LABEL_TPU_ACCELERATOR: "tpu-v5-lite-podslice",
+            constants.LABEL_TPU_TOPOLOGY: "2x4",
+            constants.LABEL_PARTITIONING: constants.PARTITIONING_SUBSLICING,
+        }),
+        status=NodeStatus(capacity={"cpu": 16}, allocatable={"cpu": 16}),
+    )
+
+
+def pending_pod(i, profile, qty):
+    return Pod(
+        metadata=ObjectMeta(name=f"pend-{i}", namespace="ns"),
+        spec=PodSpec(containers=[
+            Container(requests={RESOURCES[profile]: qty})]),
+        status=PodStatus(phase="Pending", conditions=[
+            PodCondition(type="PodScheduled", status="False",
+                         reason="Unschedulable")]),
+    )
+
+
+@st.composite
+def scenarios(draw):
+    n_nodes = draw(st.integers(1, 3))
+    seed = draw(st.integers(0, 2**32 - 1))
+    pods = draw(st.lists(
+        st.tuples(st.sampled_from(PROFILES), st.integers(1, 2)),
+        max_size=5))
+    return n_nodes, seed, pods
+
+
+def build(n_nodes, seed):
+    rng = random.Random(seed)
+    nodes = {}
+    for i in range(n_nodes):
+        node = v5e_node(f"n{i}")
+        tn = TpuNode.from_node(node)
+        # random pre-existing usage: init geometry, reserve a random mix
+        for board in tn.boards:
+            board.init_geometry()
+            for p in list(board.free):
+                for _ in range(rng.randint(0, board.free.get(p, 0))):
+                    if rng.random() < 0.5:
+                        board.reserve(p)
+        sn = SnapshotNode(tn, fw.NodeInfo(node, []))
+        sn.refresh_allocatable()
+        nodes[node.metadata.name] = sn
+    return ClusterSnapshot(nodes)
+
+
+def used_map(snapshot):
+    return {name: [dict(b.used) for b in sn.tpu_node.boards]
+            for name, sn in snapshot.nodes().items()}
+
+
+@settings(max_examples=50, deadline=None)
+@given(scenarios())
+def test_plan_preserves_used_and_stays_in_table(sc):
+    n_nodes, seed, pod_specs = sc
+    snapshot = build(n_nodes, seed)
+    used_before = used_map(snapshot)
+    chips_before = {
+        name: [b.total_chips for b in sn.tpu_node.boards]
+        for name, sn in snapshot.nodes().items()}
+
+    pods = [pending_pod(i, p, q) for i, (p, q) in enumerate(pod_specs)]
+    plan = Planner(plan_id_fn=lambda: "t").plan(snapshot, pods)
+
+    gen = "tpu-v5-lite-podslice"
+    for name, np_ in plan.desired_state.items():
+        for idx, geom in np_.boards.items():
+            # (2) only allowed geometries
+            key = tuple(sorted(geom.items(),
+                               key=lambda kv: (kv[0].chips, str(kv[0]))))
+            if key:
+                assert key in topology.allowed_geometries(gen), (
+                    f"{name} board {idx}: off-table geometry {geom}")
+            # (1) every used slice preserved
+            for p, q in used_before[name][idx].items():
+                assert geom.get(p, 0) >= q, (
+                    f"{name} board {idx}: plan dropped used {q}x{p}")
+            # (3) silicon conserved
+            if key:
+                assert geometry_chips(geom) == chips_before[name][idx]
+
+
+@settings(max_examples=25, deadline=None)
+@given(scenarios())
+def test_plan_is_deterministic(sc):
+    n_nodes, seed, pod_specs = sc
+    pods = [pending_pod(i, p, q) for i, (p, q) in enumerate(pod_specs)]
+    plan_a = Planner(plan_id_fn=lambda: "t").plan(build(n_nodes, seed), pods)
+    plan_b = Planner(plan_id_fn=lambda: "t").plan(build(n_nodes, seed), pods)
+    assert plan_a.desired_state == plan_b.desired_state
