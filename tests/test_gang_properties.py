@@ -1,0 +1,74 @@
+"""Gang-placement property tests (scheduler/gang.py): for ARBITRARY
+sequences of gangs thrown at a pool set, every placed gang must be
+all-or-nothing inside ONE ICI domain on distinct hosts, gangs never
+overlap, and every member occupies an axis-aligned contiguous sub-cuboid
+of the pool's host grid (the ICI-locality contract DCN-spanning
+placements would violate).
+"""
+from hypothesis import given, settings
+from hypothesis import strategies as st
+
+from nos_tpu.tpu.ici import group_ici_domains
+from tests.test_gang import gang_pod, make_pool, rig
+
+# gangs drawn over one v5e 8x8 pool (8 hosts): topo -> host count
+TOPOS = {"4x4": 2, "4x8": 4, "8x8": 8}
+
+GANGS = st.lists(st.sampled_from(sorted(TOPOS)), min_size=1, max_size=5)
+
+
+def _host_coords(server, domain, gang, size):
+    names = [n.metadata.name for n in domain.nodes]
+    shape = domain.host_shape
+    out = []
+    for w in range(size):
+        node = server.get("Pod", f"{gang}-{w}", "team-a").spec.node_name
+        if not node:
+            return None                          # unbound member
+        idx = names.index(node)
+        out.append((idx // shape[1], idx % shape[1]))
+    return out
+
+
+def _is_subcuboid(coords):
+    rows = sorted({r for r, _ in coords})
+    cols = sorted({c for _, c in coords})
+    contiguous = (rows == list(range(rows[0], rows[-1] + 1))
+                  and cols == list(range(cols[0], cols[-1] + 1)))
+    return contiguous and len(coords) == len(rows) * len(cols) \
+        and len(set(coords)) == len(coords)
+
+
+@settings(max_examples=30, deadline=None)
+@given(GANGS)
+def test_gangs_place_all_or_nothing_on_disjoint_subcuboids(topos):
+    server, mgr = rig()
+    make_pool(server, "pool-a", 8, topo="8x8")
+    for i, topo in enumerate(topos):
+        for w in range(TOPOS[topo]):
+            server.create(gang_pod(f"g{i}", w, TOPOS[topo], topo=topo))
+    mgr.run_until_idle()
+
+    domain = group_ici_domains(server.list("Node"))["pool-a"]
+    taken = set()
+    placed_hosts = 0
+    for i, topo in enumerate(topos):
+        size = TOPOS[topo]
+        coords = _host_coords(server, domain, f"g{i}", size)
+        bound = [server.get("Pod", f"g{i}-{w}", "team-a").spec.node_name
+                 for w in range(size)]
+        # all-or-nothing: a gang is fully bound or fully unbound
+        assert all(bound) or not any(bound), (topo, bound)
+        if coords is None:
+            continue
+        # distinct hosts forming an axis-aligned contiguous sub-cuboid
+        assert _is_subcuboid(coords), (topo, coords)
+        # disjoint from every other placed gang
+        assert not (set(coords) & taken), (topo, coords, taken)
+        taken |= set(coords)
+        placed_hosts += size
+    assert placed_hosts <= 8
+    # capacity law: if total demand fits the pool, everything placed
+    if sum(TOPOS[t] for t in topos) <= 8:
+        assert placed_hosts == sum(TOPOS[t] for t in topos), (
+            "feasible workload left gangs unplaced")
