@@ -251,11 +251,15 @@ def main():
         print(json.dumps(sched))
         return
 
+    # headline string built from the MEASURED dict, not module constants:
+    # env knobs (sweep/babysitter re-runs) change batch/remat under us
+    remat_desc = ("remat:" + mfu["remat_policy"]
+                  if mfu.get("remat_policy", "full") != "none" else "no-remat")
     result = {
         "metric": (
             f"train-step MFU, {mfu['params_b']}B GQA decoder "
-            f"(d2048/L16/ff8192, seq {SEQ}, batch {BATCH}, bf16+remat), "
-            f"1x {mfu['device']}"
+            f"(d2048/L16/ff8192, seq {SEQ}, batch {mfu['batch']}, "
+            f"bf16+{remat_desc}), 1x {mfu['device']}"
         ),
         "value": mfu["mfu_pct"],
         "unit": "%",

@@ -143,6 +143,17 @@ def main():
             json.dump(summary, f, indent=1)
 
     save()
+    run_queue(queue, summary, save)
+    best = None
+    try:
+        save({"publishing": time.strftime("%H:%M:%S")})
+        best = publish_best(summary)
+    except Exception as e:              # noqa: BLE001 — done must land
+        summary["publish_error"] = f"{type(e).__name__}: {e}"[:200]
+    save({"done": True, "best": best})
+
+
+def run_queue(queue, summary, save):
     while queue:
         if not probe():
             summary["tunnel"] = f"down at {time.strftime('%H:%M:%S')}"
@@ -176,7 +187,68 @@ def main():
         else:
             summary["items"][name] = status
         save()
-    save({"done": True})
+
+
+def publish_best(summary):
+    """After the queue drains: pick the best honest MFU point whose
+    parity gate passed, re-run bench.py under that configuration (env
+    knobs — no source re-pin; the deliberate re-pin stays a reviewed
+    edit), and save the would-be artifact to bench_logs/bench_best.json.
+    The winning config is recorded so the re-pin is a transcription, not
+    a judgment call made from memory."""
+    best = None
+    for name, status in summary["items"].items():
+        if not name.startswith("mfu_") or status != "ok":
+            continue
+        try:
+            with open(os.path.join(LOGDIR, f"{name}.out")) as f:
+                lines = [l for l in f.read().splitlines()
+                         if l.strip().startswith("{")]
+            point = json.loads(lines[-1])
+        except (OSError, ValueError, IndexError):
+            continue
+        mfu = point.get("mfu_pct")
+        if mfu and (best is None or mfu > best["mfu_pct"]):
+            best = point
+    if best is None:
+        return None
+
+    env = dict(os.environ)
+    # scrub stale sweep knobs first (bench_sweep.py:28-31 discipline): a
+    # leftover export must not make the re-run measure a DIFFERENT
+    # config than the recorded winning_config
+    for knob in ("NOS_TPU_BENCH_BATCH", "NOS_TPU_BENCH_REMAT",
+                 "NOS_TPU_BENCH_REMAT_POLICY", "NOS_TPU_BENCH_FAULT",
+                 "NOS_TPU_BENCH_LOSS_CHUNK", "NOS_TPU_ATTN_IMPL"):
+        env.pop(knob, None)
+    policy = best.get("remat_policy", "full")
+    env.update(mfu_env(best.get("batch", 8), policy,
+                       best.get("loss_chunk", 0),
+                       attn=best.get("attn_impl", "flash")))
+    winning = {
+        "attn_impl": best.get("attn_impl"),
+        "batch": best.get("batch"),
+        "remat_policy": policy,
+        "loss_chunk": best.get("loss_chunk", 0),
+        "mfu_pct": best.get("mfu_pct"),
+    }
+    out_path = os.path.join(LOGDIR, "bench_best.json")
+    try:
+        p = subprocess.run([sys.executable, "bench.py"], cwd=REPO, env=env,
+                           capture_output=True, text=True, timeout=1800)
+        with open(out_path, "w") as f:
+            f.write(json.dumps({"winning_config": winning}) + "\n")
+            f.write(p.stdout)
+            if p.returncode != 0:
+                f.write(f"\nrc={p.returncode}\n{p.stderr[-500:]}\n")
+    except subprocess.TimeoutExpired:
+        # never leave a stale artifact masquerading as this run's
+        with open(out_path, "w") as f:
+            f.write(json.dumps({"winning_config": winning,
+                                "error": "bench.py re-run timed out "
+                                         "(tunnel flap?)"}) + "\n")
+    return {k: best.get(k) for k in ("mfu_pct", "batch", "remat_policy",
+                                     "loss_chunk", "attn_impl")}
 
 
 if __name__ == "__main__":
