@@ -45,6 +45,12 @@ class ServerConfig:
     int8: bool = False
     # serving
     max_batch: int = 8
+    # tensor-parallel serving: shard params (transformer.param_shardings)
+    # and the KV cache (generate.cache_shardings — KV heads over tp)
+    # across the first ``tp`` local devices. 0/1 = single device. Tokens
+    # are invariant to tp (tested); requires kv_heads % tp == 0 and
+    # bf16 params (int8's QuantLinear tree has no sharding map yet).
+    tp: int = 0
     # prefix-cache entries (0 = off): each holds one prompt's KV on
     # device — budget by model size (flagship: ~64 MB per 1k tokens)
     prefix_cache_size: int = 0
@@ -282,12 +288,40 @@ def build_engine(cfg: ServerConfig):
     from nos_tpu.cmd.generate import GenerateConfig, load_params
     from nos_tpu.models.serving import DecodeServer
 
+    # tp config errors must fire BEFORE the (multi-GB) checkpoint load
+    mesh = None
+    if cfg.tp and cfg.tp > 1:
+        if cfg.int8:
+            raise ValueError(
+                "tp > 1 with int8 is not supported: the QuantLinear "
+                "param tree has no sharding map — serve bf16 under tp")
+        import jax
+        from jax.sharding import Mesh
+
+        from nos_tpu.models.transformer import param_shardings
+        from nos_tpu.parallel.mesh import arrange_devices
+
+        devs = jax.devices()
+        if len(devs) < cfg.tp:
+            raise ValueError(
+                f"tp={cfg.tp} but only {len(devs)} devices visible")
+        kv = cfg.n_kv_heads or cfg.n_heads
+        if kv % cfg.tp:
+            raise ValueError(
+                f"kv_heads {kv} not divisible by tp={cfg.tp}; the "
+                f"cache head axis cannot shard evenly")
+        # snake-walked placement: tp neighbours one ICI hop apart, same
+        # contract the trainer's mesh gets (parallel/mesh.py)
+        mesh = Mesh(arrange_devices(devs[:cfg.tp], (cfg.tp,)), ("tp",))
+
     gcfg = GenerateConfig(
         vocab=cfg.vocab, d_model=cfg.d_model, n_layers=cfg.n_layers,
         n_heads=cfg.n_heads, n_kv_heads=cfg.n_kv_heads, d_ff=cfg.d_ff,
         max_seq=cfg.max_seq, n_experts=cfg.n_experts, bf16=cfg.bf16,
         checkpoint_dir=cfg.checkpoint_dir, int8=cfg.int8, seed=cfg.seed)
     model_cfg, params = load_params(gcfg)
+    if mesh is not None:
+        params = jax.device_put(params, param_shardings(mesh, model_cfg))
     if cfg.draft_checkpoint_dir:
         from nos_tpu.models.spec_serving import SpeculativeDecodeServer
 
@@ -298,12 +332,15 @@ def build_engine(cfg: ServerConfig):
             max_seq=cfg.max_seq, bf16=cfg.bf16,
             checkpoint_dir=cfg.draft_checkpoint_dir, seed=cfg.seed)
         draft_cfg, draft_params = load_params(dcfg_in)
+        if mesh is not None:
+            draft_params = jax.device_put(
+                draft_params, param_shardings(mesh, draft_cfg))
         return SpeculativeDecodeServer(
             params, model_cfg, draft_params, draft_cfg,
             n_draft=cfg.draft_n_tokens, max_batch=cfg.max_batch,
-            prefix_cache_size=cfg.prefix_cache_size)
+            prefix_cache_size=cfg.prefix_cache_size, mesh=mesh)
     return DecodeServer(params, model_cfg, max_batch=cfg.max_batch,
-                        prefix_cache_size=cfg.prefix_cache_size)
+                        prefix_cache_size=cfg.prefix_cache_size, mesh=mesh)
 
 
 def make_http_server(cfg: ServerConfig, loop: ServingLoop

@@ -61,6 +61,27 @@ def init_cache(cfg: TransformerConfig, batch: int,
     }
 
 
+def cache_shardings(mesh, cfg: TransformerConfig,
+                    per_row_pos: bool = False) -> Cache:
+    """NamedShardings for an ``init_cache`` pytree on a serving mesh:
+    K/V sharded across KV heads over ``tp`` (decode is bound by reading
+    the cache from HBM, so the bandwidth splits across chips exactly
+    like the attention heads do under ``param_shardings``); the slot/
+    batch axis and ``pos`` replicated — slots are admitted and recycled
+    individually by the host, which must see every row. Mesh axes the
+    layout doesn't have are dropped, same contract as the param side."""
+    from nos_tpu.parallel.mesh import logical_to_sharding
+    if "tp" in mesh.axis_names:
+        tp = mesh.shape["tp"]
+        if cfg.kv_heads % tp:
+            raise ValueError(
+                f"kv_heads {cfg.kv_heads} not divisible by tp={tp}; the "
+                f"cache head axis cannot shard evenly")
+    kv = logical_to_sharding(mesh, None, None, "tp", None, None)
+    pos = logical_to_sharding(mesh, *((None,) if per_row_pos else ()))
+    return {"k": kv, "v": kv, "pos": pos}
+
+
 def _cached_attention(q, ck, cv, positions, scale):
     """q: [B, H, S, D] (queries at absolute ``positions``); ck/cv:
     [B, Hkv, T, D] (full cache). Causal against the cache timeline:

@@ -37,7 +37,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from nos_tpu.models.generate import (
-    _truncate_logits_rows, forward_with_cache, init_cache,
+    _truncate_logits_rows, cache_shardings, forward_with_cache, init_cache,
 )
 from nos_tpu.models.transformer import Params, TransformerConfig
 
@@ -94,13 +94,27 @@ class DecodeServer:
 
     def __init__(self, params: Params, cfg: TransformerConfig,
                  max_batch: int = 8, max_len: Optional[int] = None,
-                 prefix_cache_size: int = 0):
+                 prefix_cache_size: int = 0, mesh=None):
         self.params = params
         self.cfg = cfg
         self.max_batch = max_batch
         self.max_len = max_len or cfg.max_seq
+        # tensor-parallel serving: with a mesh, the engine places its KV
+        # cache with the heads axis over ``tp`` (cache_shardings) to
+        # match params sharded by transformer.param_shardings — ONE
+        # decode program spans the chips, host control flow unchanged.
+        # Tokens are invariant to the mesh (tested): sharding splits the
+        # matmuls/cache reads, not the math.
+        self.mesh = mesh
+        self._row_shd = None
         self.cache = init_cache(cfg, max_batch, self.max_len,
                                 per_row_pos=True)
+        if mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec
+            shd = cache_shardings(mesh, cfg, per_row_pos=True)
+            self.cache = jax.device_put(self.cache, shd)
+            self._row_shd = shd["k"]
+            self._rep = NamedSharding(mesh, PartitionSpec())
         self._free = list(range(max_batch))
         self._active: Dict[int, _Request] = {}      # slot -> request
         self._pending: List[_Request] = []
@@ -124,6 +138,13 @@ class DecodeServer:
         self._topk = jnp.zeros((max_batch,), jnp.int32)
         self._topp = jnp.zeros((max_batch,), jnp.float32)
         self._seed = jnp.zeros((max_batch,), jnp.uint32)
+        if mesh is not None:
+            # host-written control rows live replicated on the mesh so
+            # every jitted program sees consistently-placed inputs
+            self._last, self._temp, self._topk, self._topp, self._seed = \
+                jax.device_put(
+                    (self._last, self._temp, self._topk, self._topp,
+                     self._seed), self._rep)
 
         def decode(p, toks, cache, keep, temp, topk, topp, seeds,
                    sampling: bool):
@@ -225,6 +246,10 @@ class DecodeServer:
         shape = list(self.cache["k"].shape)
         shape[1], shape[3] = 1, bucket
         z = jnp.zeros(tuple(shape), self.cache["k"].dtype)
+        if self._row_shd is not None:
+            # scratch rows carry the same head sharding as the shared
+            # cache: prefill runs sharded and _install never gathers
+            z = jax.device_put(z, self._row_shd)
         return z
 
     def _prefix_match(self, prompt: List[int]):

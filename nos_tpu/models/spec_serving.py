@@ -85,6 +85,11 @@ class SpeculativeDecodeServer(DecodeServer):
         self.k = max(1, int(n_draft))
         self.d_cache = init_cache(draft_cfg, max_batch, self.max_len,
                                   per_row_pos=True)
+        if self.mesh is not None:
+            from nos_tpu.models.generate import cache_shardings
+            self.d_cache = jax.device_put(
+                self.d_cache,
+                cache_shardings(self.mesh, draft_cfg, per_row_pos=True))
         k = self.k
 
         def spec_tick(p, dp, last, t_cache, d_cache, keep, temp, topk,
@@ -221,7 +226,14 @@ class SpeculativeDecodeServer(DecodeServer):
     def _d_row_zeros(self, bucket: int):
         shape = list(self.d_cache["k"].shape)
         shape[1], shape[3] = 1, bucket
-        return jnp.zeros(tuple(shape), self.d_cache["k"].dtype)
+        z = jnp.zeros(tuple(shape), self.d_cache["k"].dtype)
+        if self.mesh is not None:
+            # same head sharding as d_cache: draft prefill runs sharded
+            # and the draft install never gathers (mirrors _row_zeros)
+            from nos_tpu.models.generate import cache_shardings
+            z = jax.device_put(
+                z, cache_shardings(self.mesh, self.draft_cfg)["k"])
+        return z
 
     def _prefill_slot(self, req) -> None:
         # draft prefill + install FIRST: the request may finish inside

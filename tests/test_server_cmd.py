@@ -377,3 +377,57 @@ def test_stop_tokens_over_http(served):
     # truncates at the FIRST occurrence of the stop token
     first_at = full.index(stop, 2)
     assert got == full[:first_at + 1] and got[-1] == stop
+
+
+def test_tp_engine_over_http_matches_single_device():
+    """build_engine(tp=2) serves sharded (params + KV cache over a
+    ('tp',) mesh) and the HTTP surface returns the same tokens as the
+    unsharded engine — distributed serving wired end to end through the
+    binary, not just the library."""
+    from jax.sharding import PartitionSpec as P
+
+    from nos_tpu.cmd.server import build_engine
+    cfg = ServerConfig(**MODEL, bf16=False, max_batch=2, port=0,
+                       tp=2, seed=0)
+    eng = build_engine(cfg)
+    assert eng.mesh is not None
+    assert eng.cache["k"].sharding.spec == P(None, None, "tp", None, None)
+    # the tp-invariance reference must come from UNSHARDED params: same
+    # seed, tp off — a sharding-changes-tokens regression must fail here
+    ref = build_engine(ServerConfig(**MODEL, bf16=False, max_batch=2,
+                                    port=0, tp=0, seed=0))
+    assert ref.mesh is None
+    loop = ServingLoop(eng)
+    httpd = make_http_server(cfg, loop)
+    t = threading.Thread(target=httpd.serve_forever, daemon=True)
+    t.start()
+    url = f"http://127.0.0.1:{httpd.server_address[1]}"
+    try:
+        got = post(url, {"prompt": [3, 1, 4], "max_new_tokens": 6})
+        want = generate(ref.params, ref.cfg,
+                        jnp.asarray([[3, 1, 4]], jnp.int32), 6)
+        assert got["tokens"] == [int(x) for x in want[0]]
+    finally:
+        httpd.shutdown()
+        loop.shutdown()
+
+
+def test_tp_with_int8_is_a_clean_config_error():
+    from nos_tpu.cmd.server import build_engine
+    cfg = ServerConfig(**MODEL, bf16=False, max_batch=2, tp=2, int8=True)
+    with pytest.raises(ValueError, match="int8"):
+        build_engine(cfg)
+
+
+def test_tp_more_than_devices_is_a_clean_config_error():
+    from nos_tpu.cmd.server import build_engine
+    cfg = ServerConfig(**MODEL, bf16=False, max_batch=2, tp=999)
+    with pytest.raises(ValueError, match="devices visible"):
+        build_engine(cfg)
+
+
+def test_tp_kv_head_mismatch_is_a_clean_config_error():
+    from nos_tpu.cmd.server import build_engine
+    cfg = ServerConfig(**MODEL, bf16=False, max_batch=2, tp=4)  # kv=2
+    with pytest.raises(ValueError, match="not divisible by tp"):
+        build_engine(cfg)

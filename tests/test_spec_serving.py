@@ -246,3 +246,34 @@ def test_recursive_admit_keeps_draft_cache_fresh(models):
     assert res[rc] == ref(tp, [2], 2)
     assert res[ra] == ref(tp, [4, 5], 1)
     assert res[rb] == ref(tp, [9, 8, 7], 4)
+
+
+def test_spec_tokens_invariant_to_tp_mesh(models):
+    """Speculative engine over a ('tp',) mesh: target AND draft caches
+    sharded across KV heads, tokens identical to the unsharded run."""
+    import numpy as np
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    tparams, _ = models
+    # draft with tp-shardable KV heads (the module DRAFT has kv_heads=1)
+    dcfg2 = tfm.TransformerConfig(
+        vocab=64, d_model=16, n_layers=1, n_heads=2, n_kv_heads=2,
+        d_ff=32, max_seq=64, dtype=jnp.float32)
+    dparams2 = tfm.init_params(jax.random.PRNGKey(2), dcfg2)
+
+    def run(srv):
+        a = srv.submit([4, 5], 10)
+        b = srv.submit([9, 8, 7], 8, temperature=0.7, top_k=8, seed=5)
+        out = srv.drain()
+        return out[a], out[b]
+
+    want = run(SpeculativeDecodeServer(
+        tparams, TCFG, dparams2, dcfg2, n_draft=3, max_batch=2))
+
+    mesh = Mesh(np.asarray(jax.devices()[:2]), ("tp",))
+    stp = jax.device_put(tparams, tfm.param_shardings(mesh, TCFG))
+    sdp = jax.device_put(dparams2, tfm.param_shardings(mesh, dcfg2))
+    srv = SpeculativeDecodeServer(
+        stp, TCFG, sdp, dcfg2, n_draft=3, max_batch=2, mesh=mesh)
+    assert srv.d_cache["k"].sharding.spec == P(None, None, "tp", None, None)
+    assert run(srv) == want
