@@ -54,6 +54,11 @@ class ServerConfig:
     # prefix-cache entries (0 = off): each holds one prompt's KV on
     # device — budget by model size (flagship: ~64 MB per 1k tokens)
     prefix_cache_size: int = 0
+    # chunked prefill (0 = off): power-of-two chunk size; a long
+    # prompt's prefill interleaves with decode ticks one chunk per tick,
+    # bounding the latency hit admission inflicts on active requests.
+    # Not yet composable with draft_checkpoint_dir (speculative).
+    prefill_chunk: int = 0
     # speculative decoding (draft_checkpoint_dir set = on): a smaller
     # draft model proposes draft_n_tokens per tick, the target verifies
     # them in one wide forward. Greedy requests stay bit-identical to
@@ -288,7 +293,16 @@ def build_engine(cfg: ServerConfig):
     from nos_tpu.cmd.generate import GenerateConfig, load_params
     from nos_tpu.models.serving import DecodeServer
 
-    # tp config errors must fire BEFORE the (multi-GB) checkpoint load
+    # config errors must fire BEFORE the (multi-GB) checkpoint load
+    if cfg.prefill_chunk and (cfg.prefill_chunk < 8 or
+                              cfg.prefill_chunk & (cfg.prefill_chunk - 1)):
+        raise ValueError(
+            f"prefill_chunk must be 0 or a power of two >= 8, got "
+            f"{cfg.prefill_chunk}")
+    if cfg.prefill_chunk and cfg.draft_checkpoint_dir:
+        raise ValueError(
+            "speculative serving does not compose with chunked prefill "
+            "yet — unset prefill_chunk or draft_checkpoint_dir")
     mesh = None
     if cfg.tp and cfg.tp > 1:
         if cfg.int8:
@@ -310,6 +324,12 @@ def build_engine(cfg: ServerConfig):
             raise ValueError(
                 f"kv_heads {kv} not divisible by tp={cfg.tp}; the "
                 f"cache head axis cannot shard evenly")
+        if cfg.draft_checkpoint_dir:
+            dkv = cfg.draft_n_kv_heads or cfg.draft_n_heads
+            if dkv % cfg.tp:
+                raise ValueError(
+                    f"draft kv_heads {dkv} not divisible by tp={cfg.tp}; "
+                    f"the draft cache head axis cannot shard evenly")
         # snake-walked placement: tp neighbours one ICI hop apart, same
         # contract the trainer's mesh gets (parallel/mesh.py)
         mesh = Mesh(arrange_devices(devs[:cfg.tp], (cfg.tp,)), ("tp",))
@@ -338,9 +358,11 @@ def build_engine(cfg: ServerConfig):
         return SpeculativeDecodeServer(
             params, model_cfg, draft_params, draft_cfg,
             n_draft=cfg.draft_n_tokens, max_batch=cfg.max_batch,
-            prefix_cache_size=cfg.prefix_cache_size, mesh=mesh)
+            prefix_cache_size=cfg.prefix_cache_size, mesh=mesh,
+            prefill_chunk=cfg.prefill_chunk)
     return DecodeServer(params, model_cfg, max_batch=cfg.max_batch,
-                        prefix_cache_size=cfg.prefix_cache_size, mesh=mesh)
+                        prefix_cache_size=cfg.prefix_cache_size, mesh=mesh,
+                        prefill_chunk=cfg.prefill_chunk)
 
 
 def make_http_server(cfg: ServerConfig, loop: ServingLoop

@@ -37,7 +37,8 @@ import jax.numpy as jnp
 import numpy as np
 
 from nos_tpu.models.generate import (
-    _truncate_logits_rows, cache_shardings, forward_with_cache, init_cache,
+    Cache, _truncate_logits_rows, cache_shardings, forward_with_cache,
+    init_cache,
 )
 from nos_tpu.models.transformer import Params, TransformerConfig
 
@@ -94,7 +95,15 @@ class DecodeServer:
 
     def __init__(self, params: Params, cfg: TransformerConfig,
                  max_batch: int = 8, max_len: Optional[int] = None,
-                 prefix_cache_size: int = 0, mesh=None):
+                 prefix_cache_size: int = 0, mesh=None,
+                 prefill_chunk: int = 0):
+        if prefill_chunk and (prefill_chunk < 8
+                              or prefill_chunk & (prefill_chunk - 1)):
+            raise ValueError(
+                f"prefill_chunk must be 0 or a power of two >= 8, got "
+                f"{prefill_chunk} (chunks are compiled shapes; the final "
+                f"partial chunk pads to a power-of-two bucket that must "
+                f"not exceed the chunk)")
         self.params = params
         self.cfg = cfg
         self.max_batch = max_batch
@@ -119,6 +128,15 @@ class DecodeServer:
         self._active: Dict[int, _Request] = {}      # slot -> request
         self._pending: List[_Request] = []
         self._done: Dict[int, _Request] = {}
+        # chunked prefill (prefill_chunk > 0): a long prompt's prefill
+        # runs as fixed-size chunks interleaved with decode ticks — one
+        # chunk per step() — so admitting a 32k-token request delays the
+        # other slots' next token by ONE bounded chunk forward, not one
+        # whole-prompt forward (head-of-line latency). Entries:
+        # {"req", "row" (scratch cache mid-prefill), "todo" (remaining
+        # token chunks)}. The request holds its slot while prefilling.
+        self._prefill_chunk = prefill_chunk
+        self._prefilling: List[dict] = []
         # prefix cache: token-tuple -> (k_rows, v_rows) of the prefix's
         # KV (device arrays, [L, 1, Hkv, len, D]), LRU-capped at
         # ``prefix_cache_size`` entries (0 = off). Requests submitted
@@ -294,10 +312,17 @@ class DecodeServer:
         proportional to the request), then install the rows + position
         into the shared cache in one donated jitted update. A cached
         prefix skips its share of the forward: its KV rows are written
-        into the scratch cache and only the suffix tokens run."""
+        into the scratch cache and only the suffix tokens run. With
+        ``prefill_chunk`` set and a suffix longer than one chunk, the
+        forwards are deferred to step() one chunk at a time instead
+        (_start_chunked_prefill) — admission costs the host only the
+        scratch allocation."""
         plen = len(req.prompt)
         m, mkey = (self._prefix_match(req.prompt) if self._prefixes
                    else (0, None))
+        if self._prefill_chunk and self._start_chunked_prefill(
+                req, m, mkey):
+            return
         # fit: the suffix's padded bucket must land inside max_len after
         # the prefix (forward_with_cache writes the whole bucket at pos
         # m, and dynamic_update_slice CLAMPS an overrunning start — which
@@ -347,6 +372,81 @@ class DecodeServer:
                 [req.prompt + [0] * (bucket - plen)], jnp.int32)
             logits, row = self._prefill(self.params, toks, row)
             step = logits[0, plen - 1]
+        self._finish_prefill(req, row, step)
+
+    def _start_chunked_prefill(self, req: _Request, m: int,
+                               mkey) -> bool:
+        """Queue ``req`` for chunk-at-a-time prefill (step() drives it).
+        Returns False to fall back to the one-shot path when chunking
+        buys nothing (suffix fits one chunk) or the chunk-padded span
+        cannot fit ``max_len`` (non-power-of-two max_len edge)."""
+        chunk = self._prefill_chunk
+        plen = len(req.prompt)
+
+        def span(m_: int) -> int:
+            # last chunk pads to its own bucket (<= chunk: both are
+            # powers of two), full chunks are exact
+            full, rem = divmod(plen - m_, chunk)
+            return m_ + full * chunk + (_bucket(rem) if rem else 0)
+
+        # profitability (same invariant as the one-shot path): the reuse
+        # must save at least one chunk forward, or a trivial shared head
+        # does extra copies for the same compute while the metrics
+        # report savings. Checked before fit-shrink: shrinking only
+        # lowers m, which never makes an unprofitable match profitable.
+        if m > 0 and -(-(plen - m) // chunk) >= -(-plen // chunk):
+            m = 0
+        # fit: same contract as the one-shot path — a clamped
+        # dynamic_update_slice must never overwrite prefix KV
+        guard = 0
+        while m > 0 and span(m) > self.max_len and guard < 64:
+            m = max(0, self.max_len - (span(m) - m))
+            guard += 1
+        if plen - m <= chunk or span(m) > self.max_len:
+            return False
+        if m > 0:
+            self._prefixes[mkey] = self._prefixes.pop(mkey)   # LRU refresh
+            self.prefix_hits += 1
+            self.prefix_tokens_saved += m
+        bucket = min(_bucket(max(plen, span(m))), self.max_len)
+        row = {
+            "k": self._row_zeros(bucket),
+            "v": self._row_zeros(bucket),
+            "pos": jnp.int32(m),
+        }
+        if m > 0:
+            pk, pv = self._prefixes[mkey]
+            row["k"] = jax.lax.dynamic_update_slice(
+                row["k"], pk[:, :, :, :m, :], (0, 0, 0, 0, 0))
+            row["v"] = jax.lax.dynamic_update_slice(
+                row["v"], pv[:, :, :, :m, :], (0, 0, 0, 0, 0))
+        suffix = req.prompt[m:]
+        todo = [suffix[i:i + chunk] for i in range(0, len(suffix), chunk)]
+        self._prefilling.append({"req": req, "row": row, "todo": todo})
+        return True
+
+    def _prefill_tick(self) -> int:
+        """Run ONE chunk of the head prefilling request; on its last
+        chunk, finish admission (first token + install). Returns tokens
+        emitted (1 on completion, else 0)."""
+        ent = self._prefilling[0]
+        toks_list = ent["todo"].pop(0)
+        rem = len(toks_list)
+        rbucket = _bucket(rem) if ent["todo"] == [] else rem
+        toks = jnp.asarray([toks_list + [0] * (rbucket - rem)], jnp.int32)
+        logits, ent["row"] = self._prefill(self.params, toks, ent["row"])
+        if ent["todo"]:
+            return 0
+        self._prefilling.pop(0)
+        self._finish_prefill(ent["req"], ent["row"], logits[0, rem - 1])
+        return 1
+
+    def _finish_prefill(self, req: _Request, row: Cache,
+                        step: jax.Array) -> None:
+        """Shared admission tail: publish the prefix, pick the first
+        token from the final-position logits, set the slot's sampling
+        rows, and install the prefilled KV into the shared cache."""
+        plen = len(req.prompt)
         if req.cache_prefix:
             self._publish_prefix(req.prompt, row["k"], row["v"])
         if req.temperature > 0:
@@ -387,26 +487,32 @@ class DecodeServer:
 
     # ------------------------------------------------------------------
     def step(self) -> int:
-        """One decode tick for every active slot; returns the number of
-        tokens emitted. Inactive slots ride along (their output discarded,
-        their pos frozen in-graph — same compiled program every tick)."""
-        if not self._active:
-            return 0
-        active = sorted(self._active)
-        keep = jnp.zeros((self.max_batch,), bool).at[
-            jnp.asarray(active, jnp.int32)].set(True)
-        sampling = any(self._active[s].temperature > 0 for s in active)
-        nxt, self._last, self.cache = self._decode(
-            self.params, self._last, self.cache, keep,
-            self._temp, self._topk, self._topp, self._seed, sampling)
-        nxt_host = np.asarray(nxt)          # ONE device->host sync
+        """One decode tick for every active slot, plus ONE prefill chunk
+        for the head admitting request (chunked prefill); returns the
+        number of tokens emitted. Inactive slots ride along (their output
+        discarded, their pos frozen in-graph — same compiled program
+        every tick); slots mid-prefill are excluded from the decode batch
+        (their cache rows aren't installed yet)."""
         emitted = 0
-        for s in active:
-            req = self._active[s]
-            req.out.append(int(nxt_host[s]))
-            req.note_token()
-            emitted += 1
-            self._finish_if_done(req)
+        pre = {ent["req"].slot for ent in self._prefilling}
+        active = sorted(s for s in self._active if s not in pre)
+        if active:
+            keep = jnp.zeros((self.max_batch,), bool).at[
+                jnp.asarray(active, jnp.int32)].set(True)
+            sampling = any(
+                self._active[s].temperature > 0 for s in active)
+            nxt, self._last, self.cache = self._decode(
+                self.params, self._last, self.cache, keep,
+                self._temp, self._topk, self._topp, self._seed, sampling)
+            nxt_host = np.asarray(nxt)      # ONE device->host sync
+            for s in active:
+                req = self._active[s]
+                req.out.append(int(nxt_host[s]))
+                req.note_token()
+                emitted += 1
+                self._finish_if_done(req)
+        if self._prefilling:
+            emitted += self._prefill_tick()
         return emitted
 
     def pop_result(self, rid: int) -> Optional[List[int]]:
@@ -431,6 +537,12 @@ class DecodeServer:
                 del self._pending[i]
                 self._done[rid] = req        # empty output; poppable
                 return True
+        for i, ent in enumerate(self._prefilling):
+            if ent["req"].rid == rid:
+                # drop the chunk queue FIRST: the slot frees below, and
+                # a later _prefill_tick must never install into it
+                del self._prefilling[i]
+                break
         for req in self._active.values():
             if req.rid == rid:
                 req.max_new_tokens = len(req.out)

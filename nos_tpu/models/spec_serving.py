@@ -78,6 +78,11 @@ class SpeculativeDecodeServer(DecodeServer):
                  max_len: Optional[int] = None, **kw):
         if draft_cfg.vocab != cfg.vocab:
             raise ValueError("draft and target must share a vocabulary")
+        if kw.get("prefill_chunk"):
+            raise ValueError(
+                "speculative serving does not compose with chunked "
+                "prefill yet (the draft cache would need the same "
+                "deferred-install machinery)")
         super().__init__(params, cfg, max_batch=max_batch,
                          max_len=max_len, **kw)
         self.draft_params = draft_params
@@ -85,11 +90,12 @@ class SpeculativeDecodeServer(DecodeServer):
         self.k = max(1, int(n_draft))
         self.d_cache = init_cache(draft_cfg, max_batch, self.max_len,
                                   per_row_pos=True)
+        self._d_row_shd = None
         if self.mesh is not None:
             from nos_tpu.models.generate import cache_shardings
-            self.d_cache = jax.device_put(
-                self.d_cache,
-                cache_shardings(self.mesh, draft_cfg, per_row_pos=True))
+            d_shd = cache_shardings(self.mesh, draft_cfg, per_row_pos=True)
+            self.d_cache = jax.device_put(self.d_cache, d_shd)
+            self._d_row_shd = d_shd["k"]
         k = self.k
 
         def spec_tick(p, dp, last, t_cache, d_cache, keep, temp, topk,
@@ -227,12 +233,10 @@ class SpeculativeDecodeServer(DecodeServer):
         shape = list(self.d_cache["k"].shape)
         shape[1], shape[3] = 1, bucket
         z = jnp.zeros(tuple(shape), self.d_cache["k"].dtype)
-        if self.mesh is not None:
+        if self._d_row_shd is not None:
             # same head sharding as d_cache: draft prefill runs sharded
             # and the draft install never gathers (mirrors _row_zeros)
-            from nos_tpu.models.generate import cache_shardings
-            z = jax.device_put(
-                z, cache_shardings(self.mesh, self.draft_cfg)["k"])
+            z = jax.device_put(z, self._d_row_shd)
         return z
 
     def _prefill_slot(self, req) -> None:

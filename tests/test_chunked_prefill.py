@@ -1,0 +1,163 @@
+"""Chunked prefill (models/serving.py prefill_chunk): a long prompt's
+prefill runs as fixed-size chunks interleaved with decode ticks, so
+admission delays active slots' next token by one bounded chunk forward
+instead of one whole-prompt forward — with the engine's invariants
+intact: tokens identical to the unchunked engine (greedy and sampled),
+prefix-cache composition, cancel mid-prefill, slot accounting."""
+import jax
+import jax.numpy as jnp
+import pytest
+
+from nos_tpu.models import transformer as tfm
+from nos_tpu.models.serving import DecodeServer
+
+CFG = tfm.TransformerConfig(
+    vocab=64, d_model=32, n_layers=2, n_heads=4, n_kv_heads=2,
+    d_ff=64, max_seq=128, dtype=jnp.float32)
+LONG = [(i * 7 + 3) % 64 for i in range(40)]    # >> chunk of 8
+
+
+@pytest.fixture(scope="module")
+def params():
+    return tfm.init_params(jax.random.PRNGKey(0), CFG)
+
+
+def drain_all(srv, reqs):
+    rids = [srv.submit(p, n, **kw) for p, n, kw in reqs]
+    out = srv.drain()
+    return [out[r] for r in rids]
+
+
+def test_tokens_invariant_to_chunking(params):
+    reqs = [
+        (LONG, 8, dict()),
+        (LONG[:17], 6, dict(temperature=0.7, top_k=8, seed=5)),
+        ([5, 9], 6, dict()),                    # short: one-shot path
+    ]
+    want = drain_all(DecodeServer(params, CFG, max_batch=2), reqs)
+    got = drain_all(
+        DecodeServer(params, CFG, max_batch=2, prefill_chunk=8), reqs)
+    assert got == want
+
+
+def test_chunk_exact_multiple_and_one_off(params):
+    # prompt lengths around the chunk boundary: exact multiple, +1, -1
+    for plen in (16, 17, 15, 8, 9):
+        prompt = LONG[:plen]
+        want = drain_all(DecodeServer(params, CFG, max_batch=1),
+                         [(prompt, 5, {})])
+        got = drain_all(
+            DecodeServer(params, CFG, max_batch=1, prefill_chunk=8),
+            [(prompt, 5, {})])
+        assert got == want, f"plen={plen}"
+
+
+def test_active_slots_decode_during_prefill(params):
+    """The whole point: while a long prompt prefills chunk by chunk, an
+    already-active request emits one token per step()."""
+    srv = DecodeServer(params, CFG, max_batch=2, prefill_chunk=8)
+    a = srv.submit([4, 5], 30)
+    srv.step()                      # a is decoding
+    before = len(srv.progress(a)[0])
+    b = srv.submit(LONG, 4)         # 5 chunks of 8 — deferred
+    assert srv._prefilling          # admission did not run the forward
+    ticks = 0
+    while srv._prefilling:
+        srv.step()
+        ticks += 1
+    # a progressed on EVERY tick b spent prefilling
+    assert len(srv.progress(a)[0]) - before == ticks
+    assert ticks == 5               # ceil(40/8) chunks, one per tick
+    out = srv.drain()
+    assert out[b][:len(LONG)] == LONG
+
+
+def test_prefix_cache_composes_with_chunking(params):
+    sys_prompt = LONG[:24]
+
+    def run(srv):
+        a = srv.submit(sys_prompt + [1], 4, cache_prefix=True)
+        srv.drain()
+        b = srv.submit(sys_prompt + [2, 3], 4)
+        srv.drain()
+        return srv.pop_result(a), srv.pop_result(b), srv.prefix_hits
+
+    pa, pb, _ = run(DecodeServer(params, CFG, max_batch=1,
+                                 prefix_cache_size=4))
+    ca, cb, hits = run(DecodeServer(params, CFG, max_batch=1,
+                                    prefix_cache_size=4, prefill_chunk=8))
+    assert (ca, cb) == (pa, pb)
+    assert hits >= 1
+
+
+def test_cancel_mid_prefill_frees_slot(params):
+    srv = DecodeServer(params, CFG, max_batch=1, prefill_chunk=8)
+    b = srv.submit(LONG, 4)
+    srv.step()                      # one chunk in
+    assert srv._prefilling
+    assert srv.cancel(b)
+    assert not srv._prefilling and srv._free == [0]
+    # the freed slot serves the next request normally
+    c = srv.submit([7, 7], 3)
+    out = srv.drain()
+    assert out[b] == LONG           # canceled: prompt only
+    assert len(out[c]) == 5
+
+
+def test_bad_chunk_sizes_rejected(params):
+    for bad in (7, 12, 4, -8):
+        with pytest.raises(ValueError, match="power of two"):
+            DecodeServer(params, CFG, max_batch=1, prefill_chunk=bad)
+
+
+def test_spec_server_rejects_chunking(params):
+    from nos_tpu.models.spec_serving import SpeculativeDecodeServer
+    dcfg = tfm.TransformerConfig(
+        vocab=64, d_model=16, n_layers=1, n_heads=2, n_kv_heads=1,
+        d_ff=32, max_seq=128, dtype=jnp.float32)
+    dparams = tfm.init_params(jax.random.PRNGKey(1), dcfg)
+    with pytest.raises(ValueError, match="chunked"):
+        SpeculativeDecodeServer(params, CFG, dparams, dcfg,
+                                prefill_chunk=8)
+
+
+def test_chunking_composes_with_tp_mesh(params):
+    import numpy as np
+    from jax.sharding import Mesh
+
+    mesh = Mesh(np.asarray(jax.devices()[:2]), ("tp",))
+    sp = jax.device_put(params, tfm.param_shardings(mesh, CFG))
+    reqs = [(LONG, 6, {}), (LONG[:13], 5, {})]
+    want = drain_all(DecodeServer(params, CFG, max_batch=2), reqs)
+    got = drain_all(DecodeServer(sp, CFG, max_batch=2, prefill_chunk=8,
+                                 mesh=mesh), reqs)
+    assert got == want
+
+
+def test_server_config_rejects_bad_chunk_and_spec_combo_pre_load():
+    """build_engine fails on config alone — before any checkpoint load."""
+    from nos_tpu.cmd.server import ServerConfig, build_engine
+    base = dict(vocab=64, d_model=32, n_layers=2, n_heads=4,
+                n_kv_heads=2, d_ff=64, max_seq=128, bf16=False)
+    with pytest.raises(ValueError, match="power of two"):
+        build_engine(ServerConfig(**base, prefill_chunk=100))
+    with pytest.raises(ValueError, match="chunked prefill"):
+        build_engine(ServerConfig(**base, prefill_chunk=8,
+                                  draft_checkpoint_dir="/nope"))
+    with pytest.raises(ValueError, match="draft kv_heads"):
+        build_engine(ServerConfig(**base, tp=2, draft_n_kv_heads=1,
+                                  draft_checkpoint_dir="/nope"))
+
+
+def test_trivial_prefix_head_not_used_under_chunking(params):
+    """A 1-token shared head saves no chunk forwards — the chunked path
+    must not count it as a hit (profitability invariant)."""
+    srv = DecodeServer(params, CFG, max_batch=1, prefix_cache_size=4,
+                       prefill_chunk=8)
+    srv.submit([9] + LONG[:20], 3, cache_prefix=True)
+    srv.drain()
+    hits0 = srv.prefix_hits
+    srv.submit([9] + list(reversed(LONG[:20])), 3)   # shares only [9]
+    srv.drain()
+    assert srv.prefix_hits == hits0
+    assert srv.prefix_tokens_saved == 0 or srv.prefix_tokens_saved >= 8
