@@ -56,8 +56,9 @@ class ServerConfig:
     prefix_cache_size: int = 0
     # chunked prefill (0 = off): power-of-two chunk size; a long
     # prompt's prefill interleaves with decode ticks one chunk per tick,
-    # bounding the latency hit admission inflicts on active requests.
-    # Not yet composable with draft_checkpoint_dir (speculative).
+    # bounding the latency hit admission inflicts on active requests
+    # (under speculative decoding the draft cache chunks alongside the
+    # target: one target chunk + one cheap draft chunk per tick).
     prefill_chunk: int = 0
     # speculative decoding (draft_checkpoint_dir set = on): a smaller
     # draft model proposes draft_n_tokens per tick, the target verifies
@@ -299,10 +300,6 @@ def build_engine(cfg: ServerConfig):
         raise ValueError(
             f"prefill_chunk must be 0 or a power of two >= 8, got "
             f"{cfg.prefill_chunk}")
-    if cfg.prefill_chunk and cfg.draft_checkpoint_dir:
-        raise ValueError(
-            "speculative serving does not compose with chunked prefill "
-            "yet — unset prefill_chunk or draft_checkpoint_dir")
     mesh = None
     if cfg.tp and cfg.tp > 1:
         if cfg.int8:

@@ -426,20 +426,30 @@ class DecodeServer:
         return True
 
     def _prefill_tick(self) -> int:
-        """Run ONE chunk of the head prefilling request; on its last
-        chunk, finish admission (first token + install). Returns tokens
-        emitted (1 on completion, else 0)."""
+        """Advance the head prefilling request by one tick; when its
+        chunks are exhausted, finish admission (first token + install).
+        Returns tokens emitted (1 on completion, else 0)."""
         ent = self._prefilling[0]
+        if not self._prefill_advance(ent):
+            return 0
+        self._prefilling.pop(0)
+        self._finish_prefill(ent["req"], ent["row"], ent["step"])
+        return 1
+
+    def _prefill_advance(self, ent: dict) -> bool:
+        """Run ONE chunk forward for ``ent``; on the final chunk, store
+        the last real position's logits in ``ent["step"]`` and return
+        True (entry fully prefilled). Subclasses extend this to advance
+        sibling caches (speculative draft) in the same tick."""
         toks_list = ent["todo"].pop(0)
         rem = len(toks_list)
         rbucket = _bucket(rem) if ent["todo"] == [] else rem
         toks = jnp.asarray([toks_list + [0] * (rbucket - rem)], jnp.int32)
         logits, ent["row"] = self._prefill(self.params, toks, ent["row"])
         if ent["todo"]:
-            return 0
-        self._prefilling.pop(0)
-        self._finish_prefill(ent["req"], ent["row"], logits[0, rem - 1])
-        return 1
+            return False
+        ent["step"] = logits[0, rem - 1]
+        return True
 
     def _finish_prefill(self, req: _Request, row: Cache,
                         step: jax.Array) -> None:
@@ -501,18 +511,28 @@ class DecodeServer:
                 jnp.asarray(active, jnp.int32)].set(True)
             sampling = any(
                 self._active[s].temperature > 0 for s in active)
-            nxt, self._last, self.cache = self._decode(
-                self.params, self._last, self.cache, keep,
-                self._temp, self._topk, self._topp, self._seed, sampling)
-            nxt_host = np.asarray(nxt)      # ONE device->host sync
-            for s in active:
-                req = self._active[s]
-                req.out.append(int(nxt_host[s]))
-                req.note_token()
-                emitted += 1
-                self._finish_if_done(req)
+            emitted += self._tick(active, keep, sampling)
         if self._prefilling:
             emitted += self._prefill_tick()
+        return emitted
+
+    def _tick(self, active: List[int], keep: jax.Array,
+              sampling: bool) -> int:
+        """One compiled decode dispatch for ``active`` slots; the
+        template step() owns the shared scaffolding (mid-prefill slot
+        exclusion, keep mask, sampling flag, prefill tick) so engine
+        subclasses override only this."""
+        nxt, self._last, self.cache = self._decode(
+            self.params, self._last, self.cache, keep,
+            self._temp, self._topk, self._topp, self._seed, sampling)
+        nxt_host = np.asarray(nxt)          # ONE device->host sync
+        emitted = 0
+        for s in active:
+            req = self._active[s]
+            req.out.append(int(nxt_host[s]))
+            req.note_token()
+            emitted += 1
+            self._finish_if_done(req)
         return emitted
 
     def pop_result(self, rid: int) -> Optional[List[int]]:

@@ -78,11 +78,6 @@ class SpeculativeDecodeServer(DecodeServer):
                  max_len: Optional[int] = None, **kw):
         if draft_cfg.vocab != cfg.vocab:
             raise ValueError("draft and target must share a vocabulary")
-        if kw.get("prefill_chunk"):
-            raise ValueError(
-                "speculative serving does not compose with chunked "
-                "prefill yet (the draft cache would need the same "
-                "deferred-install machinery)")
         super().__init__(params, cfg, max_batch=max_batch,
                          max_len=max_len, **kw)
         self.draft_params = draft_params
@@ -90,6 +85,7 @@ class SpeculativeDecodeServer(DecodeServer):
         self.k = max(1, int(n_draft))
         self.d_cache = init_cache(draft_cfg, max_batch, self.max_len,
                                   per_row_pos=True)
+        self._chunked_drow: dict = {}   # rid -> chunk-prefilled draft row
         self._d_row_shd = None
         if self.mesh is not None:
             from nos_tpu.models.generate import cache_shardings
@@ -239,27 +235,72 @@ class SpeculativeDecodeServer(DecodeServer):
             z = jax.device_put(z, self._d_row_shd)
         return z
 
-    def _prefill_slot(self, req) -> None:
-        # draft prefill + install FIRST: the request may finish inside
-        # the super call (stop token / max_new=1), releasing the slot and
-        # recursively admitting a pending request into it — a stale
-        # draft install landing afterwards would overwrite the NEW
-        # request's draft row (no prefix cache here: published entries
-        # hold TARGET KV; the draft is small and its prefill is cheap)
-        slot = req.slot
+    def _start_chunked_prefill(self, req, m, mkey) -> bool:
+        """Chunk the DRAFT cache alongside the target: the per-tick cost
+        stays one target chunk + one (much cheaper) draft chunk, so the
+        head-of-line bound chunked prefill promises holds under
+        speculative decoding too — no whole-prompt draft forward spikes
+        on the install tick. The draft has no prefix cache, so its
+        chunks cover the full prompt."""
+        if not super()._start_chunked_prefill(req, m, mkey):
+            return False
+        ent = self._prefilling[-1]
+        chunk = self._prefill_chunk
         plen = len(req.prompt)
         bucket = min(_bucket(plen), self.max_len)
-        toks = jnp.asarray([req.prompt + [0] * (bucket - plen)], jnp.int32)
-        row = {
+        ent["drow"] = {
             "k": self._d_row_zeros(bucket),
             "v": self._d_row_zeros(bucket),
             "pos": jnp.zeros((), jnp.int32),
         }
-        _, row = self._d_prefill(self.draft_params, toks, row)
+        ent["dtodo"] = [req.prompt[i:i + chunk]
+                        for i in range(0, plen, chunk)]
+        return True
+
+    def _prefill_advance(self, ent) -> bool:
+        if ent["todo"]:
+            super()._prefill_advance(ent)       # one target chunk
+        if ent["dtodo"]:                        # one draft chunk
+            toks_list = ent["dtodo"].pop(0)
+            rem = len(toks_list)
+            rbucket = _bucket(rem) if ent["dtodo"] == [] else rem
+            toks = jnp.asarray([toks_list + [0] * (rbucket - rem)],
+                               jnp.int32)
+            _, ent["drow"] = self._d_prefill(
+                self.draft_params, toks, ent["drow"])
+        if ent["todo"] or ent["dtodo"]:
+            return False
+        # hand the chunk-prefilled draft row to _finish_prefill (keyed
+        # by rid: _prefilling order and recursion-safe)
+        self._chunked_drow[ent["req"].rid] = ent["drow"]
+        return True
+
+    def _finish_prefill(self, req, row, step) -> None:
+        # draft install FIRST: the request may finish inside the super
+        # call (stop token / max_new=1), releasing the slot and
+        # recursively admitting a pending request into it — a stale
+        # draft install landing afterwards would overwrite the NEW
+        # request's draft row (no prefix cache here: published entries
+        # hold TARGET KV). The draft row arrives chunk-prefilled from
+        # _prefill_advance, or is prefilled whole here on the one-shot
+        # (short prompt) path.
+        slot = req.slot
+        plen = len(req.prompt)
+        drow = self._chunked_drow.pop(req.rid, None)
+        if drow is None:
+            bucket = min(_bucket(plen), self.max_len)
+            toks = jnp.asarray([req.prompt + [0] * (bucket - plen)],
+                               jnp.int32)
+            drow = {
+                "k": self._d_row_zeros(bucket),
+                "v": self._d_row_zeros(bucket),
+                "pos": jnp.zeros((), jnp.int32),
+            }
+            _, drow = self._d_prefill(self.draft_params, toks, drow)
         self.d_cache = self._d_install(
-            self.d_cache, row["k"], row["v"], jnp.int32(slot),
+            self.d_cache, drow["k"], drow["v"], jnp.int32(slot),
             jnp.int32(plen))
-        super()._prefill_slot(req)
+        super()._finish_prefill(req, row, step)
 
     def _finish_if_done(self, req) -> None:
         if req.done and req.slot >= 0:
@@ -267,14 +308,10 @@ class SpeculativeDecodeServer(DecodeServer):
         super()._finish_if_done(req)
 
     # ------------------------------------------------------------------
-    def step(self) -> int:
-        """One speculative tick: up to k tokens per active slot."""
-        if not self._active:
-            return 0
-        active = sorted(self._active)
-        keep = jnp.zeros((self.max_batch,), bool).at[
-            jnp.asarray(active, jnp.int32)].set(True)
-        sampling = any(self._active[s].temperature > 0 for s in active)
+    def _tick(self, active, keep, sampling) -> int:
+        """One speculative dispatch: up to k tokens per active slot.
+        The base step() template owns the scaffolding (mid-prefill slot
+        exclusion, keep mask, prefill tick)."""
         commit, counts, self._last, self.cache, self.d_cache = \
             self._spec_tick(
                 self.params, self.draft_params, self._last, self.cache,

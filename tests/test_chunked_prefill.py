@@ -110,15 +110,59 @@ def test_bad_chunk_sizes_rejected(params):
             DecodeServer(params, CFG, max_batch=1, prefill_chunk=bad)
 
 
-def test_spec_server_rejects_chunking(params):
+def test_spec_server_composes_with_chunking(params):
+    """Speculative engine + chunked prefill: the target chunks through
+    ticks, the draft prefills whole at install, and tokens (greedy AND
+    sampled) match the unchunked speculative engine — which itself
+    matches the plain target engine for greedy rows."""
     from nos_tpu.models.spec_serving import SpeculativeDecodeServer
     dcfg = tfm.TransformerConfig(
         vocab=64, d_model=16, n_layers=1, n_heads=2, n_kv_heads=1,
         d_ff=32, max_seq=128, dtype=jnp.float32)
     dparams = tfm.init_params(jax.random.PRNGKey(1), dcfg)
-    with pytest.raises(ValueError, match="chunked"):
-        SpeculativeDecodeServer(params, CFG, dparams, dcfg,
-                                prefill_chunk=8)
+    reqs = [
+        (LONG, 6, dict()),
+        (LONG[:19], 5, dict(temperature=0.7, top_k=8, seed=5)),
+    ]
+
+    def mk(**kw):
+        return SpeculativeDecodeServer(params, CFG, dparams, dcfg,
+                                       n_draft=3, max_batch=2, **kw)
+
+    want = drain_all(mk(), reqs)
+    got = drain_all(mk(prefill_chunk=8), reqs)
+    assert got == want
+    plain = drain_all(DecodeServer(params, CFG, max_batch=2),
+                      [reqs[0]])
+    assert got[0] == plain[0]       # greedy spec == plain target
+
+
+def test_spec_active_slots_tick_during_chunked_prefill(params):
+    from nos_tpu.models.spec_serving import SpeculativeDecodeServer
+    dcfg = tfm.TransformerConfig(
+        vocab=64, d_model=16, n_layers=1, n_heads=2, n_kv_heads=1,
+        d_ff=32, max_seq=128, dtype=jnp.float32)
+    dparams = tfm.init_params(jax.random.PRNGKey(1), dcfg)
+    srv = SpeculativeDecodeServer(params, CFG, dparams, dcfg,
+                                  n_draft=3, max_batch=2,
+                                  prefill_chunk=8)
+    a = srv.submit([4, 5], 30)
+    srv.step()
+    before = len(srv.progress(a)[0])
+    srv.submit(LONG, 4)
+    assert srv._prefilling
+    # the DRAFT chunks alongside the target: no whole-prompt draft
+    # forward can spike the install tick
+    assert len(srv._prefilling[0]["dtodo"]) == 5
+    ticks = 0
+    while srv._prefilling:
+        srv.step()
+        ticks += 1
+    assert ticks == 5
+    assert not srv._chunked_drow       # stash consumed at install
+    # a emitted on every tick (>= 1 token per speculative tick)
+    assert len(srv.progress(a)[0]) - before >= ticks
+    srv.drain()
 
 
 def test_chunking_composes_with_tp_mesh(params):
@@ -141,9 +185,6 @@ def test_server_config_rejects_bad_chunk_and_spec_combo_pre_load():
                 n_kv_heads=2, d_ff=64, max_seq=128, bf16=False)
     with pytest.raises(ValueError, match="power of two"):
         build_engine(ServerConfig(**base, prefill_chunk=100))
-    with pytest.raises(ValueError, match="chunked prefill"):
-        build_engine(ServerConfig(**base, prefill_chunk=8,
-                                  draft_checkpoint_dir="/nope"))
     with pytest.raises(ValueError, match="draft kv_heads"):
         build_engine(ServerConfig(**base, tp=2, draft_n_kv_heads=1,
                                   draft_checkpoint_dir="/nope"))
