@@ -1,9 +1,10 @@
 #!/usr/bin/env python3
-"""Benchmark client — continuous ViT-small inference on a (shared) TPU.
+"""Benchmark client — continuous YOLOS-small-family inference on a (shared) TPU.
 
 Analog of the reference's benchmarks client
 (demos/gpu-sharing-comparison/client/main.py): saturate the accelerator with
-single-image inferences at the YOLOS-small backbone scale and export
+single-image YOLOS-family detection inferences (the reference's exact
+benchmark model — hustvl/yolos-small) and export
 per-inference latency, so Prometheus can aggregate the average inference
 time across pods sharing one chip.
 
@@ -37,7 +38,7 @@ import jax                       # noqa: E402
 import jax.numpy as jnp          # noqa: E402
 import numpy as np               # noqa: E402
 
-from nos_tpu.models import vit                    # noqa: E402
+from nos_tpu.models import yolos                  # noqa: E402
 from nos_tpu.utils.metrics import Histogram, Registry  # noqa: E402
 
 REGISTRY = Registry()
@@ -58,8 +59,8 @@ def build_forward(cfg, batch: int, chain: int = 1):
     @jax.jit
     def run(params, images):
         def body(x, _):
-            logits = vit.forward(params, cfg, images + x)
-            return jnp.sum(logits) * 1e-30, None
+            logits, boxes = yolos.forward(params, cfg, images + x)
+            return (jnp.sum(logits) + jnp.sum(boxes)) * 1e-30, None
 
         x, _ = jax.lax.scan(body, jnp.float32(0), None, length=chain)
         return x
@@ -75,8 +76,8 @@ class BenchRig:
         self.mode = mode
         self.streams = streams
         self.chain = chain
-        cfg = vit.ViTConfig()
-        self.params = jax.device_put(vit.init_params(jax.random.PRNGKey(0), cfg))
+        cfg = yolos.YolosConfig()
+        self.params = jax.device_put(yolos.init_params(jax.random.PRNGKey(0), cfg))
         batch = streams if mode == "multiplex" else 1
         self.images = jax.random.normal(
             jax.random.PRNGKey(1), (batch, cfg.image_size, cfg.image_size, 3),

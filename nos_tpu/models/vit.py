@@ -44,14 +44,15 @@ class ViTConfig:
         return self.d_model // self.n_heads
 
 
-def init_params(rng: jax.Array, cfg: ViTConfig) -> Params:
-    keys = jax.random.split(rng, 4 + cfg.n_layers)
+def dense_init(key, shape, fan_in, dtype):
+    return (jax.random.normal(key, shape, jnp.float32) * fan_in ** -0.5
+            ).astype(dtype)
 
-    def dense(key, shape, fan_in):
-        return (jax.random.normal(key, shape, jnp.float32) * fan_in ** -0.5
-                ).astype(cfg.dtype)
 
-    patch_dim = cfg.patch * cfg.patch * 3
+def init_encoder(rng: jax.Array, cfg: ViTConfig) -> Params:
+    """Stacked encoder blocks + final layer norm — the backbone shared by
+    the classifier head here and the YOLOS detection head (yolos.py)."""
+    keys = jax.random.split(rng, cfg.n_layers)
 
     def block(key):
         ks = jax.random.split(key, 4)
@@ -59,39 +60,30 @@ def init_params(rng: jax.Array, cfg: ViTConfig) -> Params:
         return {
             "ln1_scale": jnp.ones((d,), jnp.float32),
             "ln1_bias": jnp.zeros((d,), jnp.float32),
-            "wqkv": dense(ks[0], (d, 3 * d), d),
-            "wo": dense(ks[1], (d, d), d),
+            "wqkv": dense_init(ks[0], (d, 3 * d), d, cfg.dtype),
+            "wo": dense_init(ks[1], (d, d), d, cfg.dtype),
             "ln2_scale": jnp.ones((d,), jnp.float32),
             "ln2_bias": jnp.zeros((d,), jnp.float32),
-            "w_in": dense(ks[2], (d, f), d),
+            "w_in": dense_init(ks[2], (d, f), d, cfg.dtype),
             "b_in": jnp.zeros((f,), cfg.dtype),
-            "w_out": dense(ks[3], (f, d), f),
+            "w_out": dense_init(ks[3], (f, d), f, cfg.dtype),
             "b_out": jnp.zeros((d,), cfg.dtype),
         }
 
     blocks = jax.tree.map(
-        lambda *xs: jnp.stack(xs), *[block(keys[4 + i]) for i in range(cfg.n_layers)]
+        lambda *xs: jnp.stack(xs), *[block(k) for k in keys]
     )
     return {
-        "patch_proj": dense(keys[0], (patch_dim, cfg.d_model), patch_dim),
-        "cls_token": jnp.zeros((1, 1, cfg.d_model), cfg.dtype),
-        "pos_embed": (jax.random.normal(keys[1], (1, cfg.n_patches + 1, cfg.d_model),
-                                        jnp.float32) * 0.02).astype(cfg.dtype),
         "blocks": blocks,
         "final_ln_scale": jnp.ones((cfg.d_model,), jnp.float32),
         "final_ln_bias": jnp.zeros((cfg.d_model,), jnp.float32),
-        "head": dense(keys[2], (cfg.d_model, cfg.n_classes), cfg.d_model),
     }
 
 
-def forward(params: Params, cfg: ViTConfig, images: jax.Array) -> jax.Array:
-    """images [B, H, W, 3] -> logits [B, n_classes]."""
-    b = images.shape[0]
-    x = patchify(images.astype(cfg.dtype), cfg.patch)
-    x = jnp.dot(x, params["patch_proj"])
-    cls = jnp.broadcast_to(params["cls_token"], (b, 1, cfg.d_model))
-    x = jnp.concatenate([cls, x], axis=1) + params["pos_embed"]
-    seq = x.shape[1]
+def encode(params: Params, cfg: ViTConfig, x: jax.Array) -> jax.Array:
+    """Run the encoder over embedded tokens x [B, S, D] -> [B, S, D]
+    (final layer norm applied). ``params`` needs the init_encoder keys."""
+    b, seq = x.shape[0], x.shape[1]
 
     def block_body(x, blk):
         h = layer_norm(x, blk["ln1_scale"], blk["ln1_bias"])
@@ -105,7 +97,32 @@ def forward(params: Params, cfg: ViTConfig, images: jax.Array) -> jax.Array:
         return x, None
 
     x, _ = jax.lax.scan(block_body, x, params["blocks"])
-    x = layer_norm(x, params["final_ln_scale"], params["final_ln_bias"])
+    return layer_norm(x, params["final_ln_scale"], params["final_ln_bias"])
+
+
+def init_params(rng: jax.Array, cfg: ViTConfig) -> Params:
+    keys = jax.random.split(rng, 4)
+    patch_dim = cfg.patch * cfg.patch * 3
+    return {
+        "patch_proj": dense_init(keys[0], (patch_dim, cfg.d_model), patch_dim,
+                                 cfg.dtype),
+        "cls_token": jnp.zeros((1, 1, cfg.d_model), cfg.dtype),
+        "pos_embed": (jax.random.normal(keys[1], (1, cfg.n_patches + 1, cfg.d_model),
+                                        jnp.float32) * 0.02).astype(cfg.dtype),
+        **init_encoder(keys[3], cfg),
+        "head": dense_init(keys[2], (cfg.d_model, cfg.n_classes), cfg.d_model,
+                           cfg.dtype),
+    }
+
+
+def forward(params: Params, cfg: ViTConfig, images: jax.Array) -> jax.Array:
+    """images [B, H, W, 3] -> logits [B, n_classes]."""
+    b = images.shape[0]
+    x = patchify(images.astype(cfg.dtype), cfg.patch)
+    x = jnp.dot(x, params["patch_proj"])
+    cls = jnp.broadcast_to(params["cls_token"], (b, 1, cfg.d_model))
+    x = jnp.concatenate([cls, x], axis=1) + params["pos_embed"]
+    x = encode(params, cfg, x)
     return jnp.dot(x[:, 0], params["head"]).astype(jnp.float32)
 
 
