@@ -87,6 +87,11 @@ class TrainerConfig:
     # checkpointing
     checkpoint_dir: str = ""
     checkpoint_every: int = 100
+    # preemption: catch SIGTERM (GKE spot/maintenance eviction sends it,
+    # then waits terminationGracePeriodSeconds), finish the in-flight
+    # step, checkpoint, and exit cleanly so the rescheduled gang resumes
+    # from the signal, not from the last periodic save
+    handle_sigterm: bool = True
     # profiling: when set, a jax.profiler trace of steps [profile_start,
     # profile_start+profile_steps) is written here (viewable in
     # TensorBoard/XProf — the TPU tracing story)
@@ -132,8 +137,18 @@ def _maybe_init_distributed() -> None:
         jax.distributed.initialize()
 
 
-def train(cfg: TrainerConfig) -> float:
-    """Run the configured training job; returns the final loss."""
+def train(cfg: TrainerConfig, stop_event=None) -> float:
+    """Run the configured training job; returns the final loss.
+
+    ``stop_event`` (threading.Event) requests a graceful early exit: the
+    loop finishes the current step, checkpoints it, and returns. When
+    ``cfg.handle_sigterm`` is set and this is the main thread, SIGTERM
+    sets the event — the Kubernetes preemption contract (pod deletion →
+    SIGTERM → grace period → SIGKILL), so an evicted gang worker banks
+    its progress instead of losing up to ``checkpoint_every`` steps."""
+    import signal
+    import threading
+
     import jax
     import jax.numpy as jnp
     import optax
@@ -267,7 +282,29 @@ def train(cfg: TrainerConfig) -> float:
             "targets": put(jnp.roll(tokens, -1, axis=1), data_sharding(mesh)),
         }
 
+    stop = stop_event if stop_event is not None else threading.Event()
+    handler_installed = False
+    prev_handler = None
+
+    if jax.process_count() > 1:
+        # gang workers may receive SIGTERM steps apart; a per-process
+        # flag would make the early breaker abandon the collective
+        # step/save its peers are still in and deadlock everyone until
+        # SIGKILL. Agree every step: a one-int32-per-process allgather —
+        # noise next to a training step — so all workers bank the SAME
+        # step together.
+        import numpy as np
+        from jax.experimental import multihost_utils
+
+        def stop_requested() -> bool:
+            flags = multihost_utils.process_allgather(
+                np.asarray(stop.is_set(), np.int32))
+            return bool(np.asarray(flags).any())
+    else:
+        stop_requested = stop.is_set
+
     loss = float("nan")
+    preempted = False
     last_saved = start_step
     profiling = False
     profiled = not (cfg.profile_dir and cfg.profile_steps > 0)
@@ -282,6 +319,14 @@ def train(cfg: TrainerConfig) -> float:
     else:   # synchronous: no background thread, nothing staged ahead
         batches = (batch_for(s) for s in range(start_step, cfg.steps))
     try:
+        # install inside the try so any exception between here and the
+        # loop still restores the handler — a leaked one would swallow
+        # the real eviction signal later in this process's life
+        if cfg.handle_sigterm and \
+                threading.current_thread() is threading.main_thread():
+            prev_handler = signal.signal(
+                signal.SIGTERM, lambda *_: stop.set())
+            handler_installed = True
         for step, batch in zip(range(start_step, cfg.steps), batches):
             if not profiled and step >= cfg.profile_start:
                 # >= so a checkpoint-resumed run past profile_start traces
@@ -295,6 +340,22 @@ def train(cfg: TrainerConfig) -> float:
                 jax.profiler.stop_trace()
                 profiling = False
                 logger.info("profiler trace written to %s", cfg.profile_dir)
+            if stop_requested():
+                # preemption: bank the step just completed (synchronous —
+                # the grace period is short, so this runs BEFORE eval and
+                # the periodic save, not after) and leave. The state is
+                # labeled with the TRUE step count so resume replays the
+                # exact stream an uninterrupted run would have seen.
+                preempted = True
+                jax.block_until_ready(loss_arr)
+                loss = float(loss_arr)
+                if ckpt is not None and last_saved != step + 1:
+                    ckpt.save(step + 1, params, opt_state)
+                    last_saved = step + 1
+                logger.info(
+                    "stop requested (preemption): checkpointed step %d/%d, "
+                    "exiting cleanly", step + 1, cfg.steps)
+                break
             if (step + 1) % cfg.log_every == 0 or step + 1 == cfg.steps:
                 jax.block_until_ready(loss_arr)
                 loss = float(loss_arr)
@@ -322,10 +383,11 @@ def train(cfg: TrainerConfig) -> float:
                 # close() at exit fences the last in-flight save
                 ckpt.save(step + 1, params, opt_state, wait=False)
                 last_saved = step + 1
-        # success path: final save only when steps actually ran (a restart
-        # whose restored step already meets cfg.steps must not relabel old
-        # state); the finally below fences + closes
-        if ckpt is not None and start_step < cfg.steps \
+        # success path: final save only when steps actually ran to the
+        # configured end (a restart whose restored step already meets
+        # cfg.steps must not relabel old state, and a preempted exit must
+        # not label partial progress as cfg.steps); finally fences+closes
+        if ckpt is not None and not preempted and start_step < cfg.steps \
                 and last_saved != cfg.steps:
             ckpt.save(cfg.steps, params, opt_state)
     finally:
@@ -348,6 +410,12 @@ def train(cfg: TrainerConfig) -> float:
         # checkpoint directory
         if ckpt is not None:
             ckpt.close()
+        if handler_installed:
+            # restore even a None previous handler (installed from C):
+            # SIG_DFL is the honest stand-in python can express
+            signal.signal(signal.SIGTERM,
+                          prev_handler if prev_handler is not None
+                          else signal.SIG_DFL)
     return loss
 
 

@@ -25,10 +25,19 @@ class CheckpointManager:
     """Step-numbered train-state checkpoints under one directory."""
 
     def __init__(self, directory: str, max_to_keep: int = 3):
+        import os
+
         import orbax.checkpoint as ocp
 
         self._ocp = ocp
-        self.directory = directory
+        # orbax rejects relative paths at SAVE time (deep inside the
+        # async serializer) — absolutize up front so a pod spec saying
+        # `checkpoint_dir: ckpt` fails fast here or not at all. URI
+        # destinations (gs://bucket/run — the shared storage a
+        # cross-slice resume needs) must pass through untouched.
+        self.directory = (directory if "://" in directory
+                          else os.path.abspath(directory))
+        directory = self.directory
         self.manager = ocp.CheckpointManager(
             directory,
             options=ocp.CheckpointManagerOptions(

@@ -75,6 +75,19 @@ def test_latest_and_retention(tmp_path):
     mgr.close()
 
 
+def test_relative_directory_saves(tmp_path, monkeypatch):
+    """A pod spec saying `checkpoint_dir: ckpt` must work: orbax rejects
+    relative paths deep inside save(), so the manager absolutizes."""
+    monkeypatch.chdir(tmp_path)
+    c = cfg()
+    mesh, params, opt, step, batch = setup(ParallelLayout(dp=2, tp=2), c)
+    opt_state = opt.init(params)
+    mgr = CheckpointManager("ckpt")
+    mgr.save(1, params, opt_state)
+    mgr.close()
+    assert CheckpointManager(str(tmp_path / "ckpt")).latest() == 1
+
+
 def test_restore_empty_dir_raises(tmp_path):
     mgr = CheckpointManager(str(tmp_path / "none"))
     with pytest.raises(FileNotFoundError):
