@@ -77,6 +77,11 @@ class ServerConfig:
     port: int = 8000
     seed: int = 0
     log_level: str = "info"
+    # SIGTERM → stop admitting (503 + readyz flips so the Service pulls
+    # this endpoint), let in-flight requests finish up to this budget,
+    # then exit — the Kubernetes termination contract. Keep it under
+    # the pod's terminationGracePeriodSeconds.
+    drain_timeout_s: float = 30.0
 
     @classmethod
     def from_yaml_file(cls, path: str) -> "ServerConfig":
@@ -90,6 +95,11 @@ class ServerConfig:
             raise ValueError(
                 f"{path}: unknown server config keys {sorted(unknown)}")
         return cls(**data)
+
+
+class DrainingError(RuntimeError):
+    """Submission refused because the server is draining for termination
+    (its own error type so the HTTP layer can answer 503, not 500)."""
 
 
 class ServingLoop:
@@ -123,6 +133,7 @@ class ServingLoop:
         self._lock = threading.Lock()
         self._work = threading.Condition(self._lock)
         self._stop = False
+        self._draining = False
         self._failed: Optional[BaseException] = None
         self._abandoned: set = set()        # rids whose client timed out
         self._thread = threading.Thread(target=self._run, daemon=True)
@@ -131,6 +142,31 @@ class ServingLoop:
     @property
     def healthy(self) -> bool:
         return self._failed is None
+
+    @property
+    def draining(self) -> bool:
+        return self._draining
+
+    def begin_drain(self) -> None:
+        """Stop admitting; in-flight requests keep decoding. The k8s
+        termination sequence: SIGTERM → readiness flips (Service stops
+        routing here) → new submits 503 → wait_idle → exit."""
+        with self._work:
+            self._draining = True
+            self._work.notify_all()
+
+    def wait_idle(self, timeout: float) -> bool:
+        """Block until the engine has no queued or decoding work (or
+        ``timeout``/loop death). Returns True when fully drained."""
+        deadline = time.monotonic() + timeout
+        with self._work:
+            while self.engine.has_work():
+                remaining = deadline - time.monotonic()
+                if remaining <= 0 or self._failed is not None \
+                        or self._stop:
+                    return not self.engine.has_work()
+                self._work.wait(timeout=min(remaining, 1.0))
+            return True
 
     def _run(self) -> None:
         while True:
@@ -216,6 +252,9 @@ class ServingLoop:
         with self._work:
             if self._failed is not None:
                 raise RuntimeError(f"serving loop failed: {self._failed}")
+            if self._draining:
+                raise DrainingError(
+                    "server is draining (terminating); retry elsewhere")
             rid = self.engine.submit(prompt, max_new_tokens, **sampling)
             self._mirror_prefix_gauges()
             self._work.notify_all()
@@ -382,7 +421,12 @@ def make_http_server(cfg: ServerConfig, loop: ServingLoop
                 self._reply(200 if ok else 500,
                             {"status": "ok" if ok else "unhealthy"})
             elif self.path == "/readyz":
-                self._reply(200, {"status": "ok"})
+                # draining flips readiness first: the Service stops
+                # routing new traffic here while in-flight requests finish
+                if loop.draining:
+                    self._reply(503, {"status": "draining"})
+                else:
+                    self._reply(200, {"status": "ok"})
             elif self.path == "/metrics":
                 body = default_registry().expose().encode()
                 self.send_response(200)
@@ -476,7 +520,7 @@ def make_http_server(cfg: ServerConfig, loop: ServingLoop
             except (KeyError, ValueError, TypeError) as e:
                 self._reply(400, {"error": f"{type(e).__name__}: {e}"})
                 return
-            except TimeoutError as e:
+            except (TimeoutError, DrainingError) as e:
                 self._reply(503, {"error": str(e)})
                 return
             except Exception as e:  # decode-loop death → JSON 500, not a dropped conn
@@ -484,7 +528,16 @@ def make_http_server(cfg: ServerConfig, loop: ServingLoop
                 return
             self._reply(200, {"tokens": tokens})
 
-    return ThreadingHTTPServer(("0.0.0.0", cfg.port), Handler)
+    class Server(ThreadingHTTPServer):
+        # handler threads outlive shutdown(): after a drain declares the
+        # ENGINE idle, the thread delivering the final response may still
+        # be between its last wakeup and the socket write — non-daemon
+        # threads make interpreter exit wait for that write instead of
+        # killing it (the connection-reset the drain exists to prevent)
+        daemon_threads = False
+        block_on_close = True
+
+    return Server(("0.0.0.0", cfg.port), Handler)
 
 
 def main(argv: Optional[Sequence[str]] = None) -> None:
@@ -506,6 +559,23 @@ def main(argv: Optional[Sequence[str]] = None) -> None:
 
     loop = ServingLoop(build_engine(cfg))
     httpd = make_http_server(cfg, loop)
+
+    def _finish_drain():
+        drained = loop.wait_idle(cfg.drain_timeout_s)
+        logger.info("drain %s; shutting down",
+                    "complete" if drained else
+                    f"timed out after {cfg.drain_timeout_s:.0f}s")
+        httpd.shutdown()        # must come from another thread
+
+    def _on_sigterm(*_):
+        logger.info("SIGTERM: draining (budget %.0fs)", cfg.drain_timeout_s)
+        loop.begin_drain()
+        threading.Thread(target=_finish_drain, daemon=True).start()
+
+    if threading.current_thread() is threading.main_thread():
+        import signal
+
+        signal.signal(signal.SIGTERM, _on_sigterm)
     logger.info("serving on :%d (max_batch=%d)", cfg.port, cfg.max_batch)
     try:
         httpd.serve_forever()

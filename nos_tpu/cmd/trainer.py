@@ -286,7 +286,12 @@ def train(cfg: TrainerConfig, stop_event=None) -> float:
     handler_installed = False
     prev_handler = None
 
-    if jax.process_count() > 1:
+    # will a stop source exist at all? (config-driven, so every gang
+    # process computes the same answer — the allgather below is a
+    # collective and all processes must agree on running it)
+    will_install = cfg.handle_sigterm and \
+        threading.current_thread() is threading.main_thread()
+    if jax.process_count() > 1 and (stop_event is not None or will_install):
         # gang workers may receive SIGTERM steps apart; a per-process
         # flag would make the early breaker abandon the collective
         # step/save its peers are still in and deadlock everyone until
@@ -300,8 +305,10 @@ def train(cfg: TrainerConfig, stop_event=None) -> float:
             flags = multihost_utils.process_allgather(
                 np.asarray(stop.is_set(), np.int32))
             return bool(np.asarray(flags).any())
-    else:
+    elif stop_event is not None or will_install:
         stop_requested = stop.is_set
+    else:   # no source can ever set the flag: skip even the local check
+        stop_requested = lambda: False  # noqa: E731
 
     loss = float("nan")
     preempted = False

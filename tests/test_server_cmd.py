@@ -431,3 +431,75 @@ def test_tp_kv_head_mismatch_is_a_clean_config_error():
     cfg = ServerConfig(**MODEL, bf16=False, max_batch=2, tp=4)  # kv=2
     with pytest.raises(ValueError, match="not divisible by tp"):
         build_engine(cfg)
+
+
+def test_drain_rejects_new_admits_finishes_inflight():
+    """begin_drain: in-flight work completes and is collectible, new
+    submissions get DrainingError, wait_idle returns True once idle."""
+    from nos_tpu.cmd.server import DrainingError
+
+    class GatedEngine(_FakeEngine):
+        """Refuses to complete work until the test releases it, so the
+        request is PROVABLY still in flight when drain begins."""
+
+        def __init__(self):
+            super().__init__()
+            self.release = threading.Event()
+
+        def step(self):
+            if not self.release.is_set():
+                time.sleep(0.002)   # polite spin while gated
+                return 0
+            return super().step()
+
+    eng = GatedEngine()
+    loop = ServingLoop(eng)
+    try:
+        gen = loop.stream([1, 2], 3, timeout=30)
+        loop.begin_drain()
+        assert eng.pending, "request must still be in flight at drain"
+        with pytest.raises(DrainingError):
+            loop.generate([3], 2, timeout=5)
+        assert not loop.wait_idle(timeout=0.05)   # gated: NOT drained yet
+        eng.release.set()
+        # the in-flight stream still finishes and drains the engine
+        toks = []
+        for delta in gen:
+            toks.extend(delta)
+        assert toks == [0, 1, 2]
+        assert loop.wait_idle(timeout=10)
+        assert loop.draining
+    finally:
+        loop.shutdown()
+
+
+def test_drain_over_http_503_and_readyz_flips():
+    """HTTP view of the termination sequence, on a fresh server so the
+    module-scoped fixture is not poisoned for later tests."""
+    cfg = ServerConfig(**MODEL, bf16=False, max_batch=2, port=0)
+    eng = _FakeEngine()
+    loop = ServingLoop(eng)
+    httpd = make_http_server(cfg, loop)
+    t = threading.Thread(target=httpd.serve_forever, daemon=True)
+    t.start()
+    url = f"http://127.0.0.1:{httpd.server_address[1]}"
+    try:
+        with urllib.request.urlopen(url + "/readyz", timeout=10) as r:
+            assert json.loads(r.read())["status"] == "ok"
+        loop.begin_drain()
+        with urllib.request.urlopen(url + "/readyz", timeout=10) as r:
+            pass
+    except urllib.error.HTTPError as e:
+        assert e.code == 503
+        assert json.loads(e.read())["status"] == "draining"
+    else:
+        raise AssertionError("readyz should be 503 while draining")
+    try:
+        post(url, {"prompt": [1], "max_new_tokens": 2}, timeout=10)
+        raise AssertionError("admission should be 503 while draining")
+    except urllib.error.HTTPError as e:
+        assert e.code == 503
+        assert "draining" in json.loads(e.read())["error"]
+    finally:
+        httpd.shutdown()
+        loop.shutdown()
