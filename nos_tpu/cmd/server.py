@@ -280,6 +280,15 @@ class ServingLoop:
                             if self._failed is not None:
                                 raise RuntimeError(
                                     f"serving loop failed: {self._failed}")
+                            if self._stop:
+                                # loop.shutdown() ran (drain timeout /
+                                # interpreter exit): no tick will ever
+                                # finish this request — fail it NOW so
+                                # the non-daemon handler thread exits
+                                # instead of waiting out its timeout
+                                raise RuntimeError(
+                                    f"request {rid} unfinished at server "
+                                    "shutdown")
                             remaining = deadline - time.monotonic()
                             if remaining <= 0:
                                 raise TimeoutError(
@@ -533,9 +542,10 @@ def make_http_server(cfg: ServerConfig, loop: ServingLoop
         # ENGINE idle, the thread delivering the final response may still
         # be between its last wakeup and the socket write — non-daemon
         # threads make interpreter exit wait for that write instead of
-        # killing it (the connection-reset the drain exists to prevent)
+        # killing it (the connection-reset the drain exists to prevent).
+        # Bounded: loop.shutdown() fails any still-waiting request, so
+        # these threads exit within ~1s of the main loop's finally.
         daemon_threads = False
-        block_on_close = True
 
     return Server(("0.0.0.0", cfg.port), Handler)
 
@@ -580,7 +590,11 @@ def main(argv: Optional[Sequence[str]] = None) -> None:
     try:
         httpd.serve_forever()
     finally:
+        # order matters: shutting the loop first fails any still-waiting
+        # handler (bounded exit), then server_close joins handler threads
+        # (stdlib block_on_close) and releases the listening socket
         loop.shutdown()
+        httpd.server_close()
 
 
 if __name__ == "__main__":

@@ -101,6 +101,11 @@ class TrainerConfig:
     # misc
     log_level: str = "info"
     bf16: bool = True
+    # Prometheus scrape endpoint (0 = off): /metrics + /healthz via the
+    # shared HealthServer, like every control-plane binary. Exposes
+    # nos_tpu_train_* (steps, tokens, step-seconds, loss, eval loss,
+    # checkpoint saves, preemption exits)
+    metrics_port: int = 0
 
     @classmethod
     def from_yaml_file(cls, path: str) -> "TrainerConfig":
@@ -286,29 +291,60 @@ def train(cfg: TrainerConfig, stop_event=None) -> float:
     handler_installed = False
     prev_handler = None
 
-    # will a stop source exist at all? (config-driven, so every gang
-    # process computes the same answer — the allgather below is a
-    # collective and all processes must agree on running it)
     will_install = cfg.handle_sigterm and \
         threading.current_thread() is threading.main_thread()
-    if jax.process_count() > 1 and (stop_event is not None or will_install):
-        # gang workers may receive SIGTERM steps apart; a per-process
-        # flag would make the early breaker abandon the collective
-        # step/save its peers are still in and deadlock everyone until
-        # SIGKILL. Agree every step: a one-int32-per-process allgather —
-        # noise next to a training step — so all workers bank the SAME
-        # step together.
-        import numpy as np
-        from jax.experimental import multihost_utils
+    if jax.process_count() > 1:
+        # The allgather is a COLLECTIVE: every process must run it or
+        # none, and they must decide identically — so the decision keys
+        # on cfg.handle_sigterm alone (config is gang-wide; thread-ness
+        # and per-call stop_event need not be). A process whose handler
+        # didn't install still participates with a never-set flag.
+        if cfg.handle_sigterm:
+            # gang workers may receive SIGTERM steps apart; a per-process
+            # flag would make the early breaker abandon the collective
+            # step/save its peers are still in and deadlock everyone
+            # until SIGKILL. Agree every step: a one-int32-per-process
+            # allgather — noise next to a training step — so all workers
+            # bank the SAME step together.
+            import numpy as np
+            from jax.experimental import multihost_utils
 
-        def stop_requested() -> bool:
-            flags = multihost_utils.process_allgather(
-                np.asarray(stop.is_set(), np.int32))
-            return bool(np.asarray(flags).any())
+            def stop_requested() -> bool:
+                flags = multihost_utils.process_allgather(
+                    np.asarray(stop.is_set(), np.int32))
+                return bool(np.asarray(flags).any())
+        elif stop_event is not None:
+            raise ValueError(
+                "stop_event on a multi-host run requires handle_sigterm: "
+                "true — without the per-step flag agreement an early "
+                "breaker deadlocks the gang's collectives")
+        else:
+            stop_requested = lambda: False  # noqa: E731
     elif stop_event is not None or will_install:
         stop_requested = stop.is_set
     else:   # no source can ever set the flag: skip even the local check
         stop_requested = lambda: False  # noqa: E731
+
+    from nos_tpu.utils.metrics import default_registry
+
+    reg = default_registry()
+    m_steps = reg.counter(
+        "nos_tpu_train_steps_total", "Training steps completed")
+    m_tokens = reg.counter(
+        "nos_tpu_train_tokens_total", "Tokens consumed by training")
+    m_step_s = reg.histogram(
+        "nos_tpu_train_step_seconds",
+        "Avg wall time per step, observed at log boundaries (per-step "
+        "timing would force a device sync every step)",
+        buckets=(0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0))
+    m_saves = reg.counter(
+        "nos_tpu_train_checkpoint_saves_total", "Checkpoints saved")
+    m_preempt = reg.counter(
+        "nos_tpu_train_preemptions_total",
+        "Graceful preemption exits (SIGTERM/stop event, step banked)")
+    g_loss = reg.gauge("nos_tpu_train_loss", "Most recent training loss")
+    g_eval = reg.gauge(
+        "nos_tpu_train_eval_loss", "Most recent held-out eval loss")
 
     loss = float("nan")
     preempted = False
@@ -317,6 +353,7 @@ def train(cfg: TrainerConfig, stop_event=None) -> float:
     profiled = not (cfg.profile_dir and cfg.profile_steps > 0)
     profile_stop = 0
     t0 = time.perf_counter()
+    last_log_t, last_log_step = t0, start_step
     from nos_tpu.train.data import prefetch_to_device
 
     if cfg.prefetch > 0:
@@ -342,6 +379,11 @@ def train(cfg: TrainerConfig, stop_event=None) -> float:
                 profile_stop = step + cfg.profile_steps
             params, opt_state, loss_arr = step_fn(
                 params, opt_state, batch)
+            m_steps.inc()
+            # per-process SHARE of the global batch, so a Prometheus
+            # sum() over a gang's pods reads true global throughput
+            m_tokens.inc(cfg.batch_size * cfg.seq_len
+                         / max(jax.process_count(), 1))
             if profiling and step + 1 >= profile_stop:
                 jax.block_until_ready(loss_arr)
                 jax.profiler.stop_trace()
@@ -359,6 +401,9 @@ def train(cfg: TrainerConfig, stop_event=None) -> float:
                 if ckpt is not None and last_saved != step + 1:
                     ckpt.save(step + 1, params, opt_state)
                     last_saved = step + 1
+                    m_saves.inc()
+                m_preempt.inc()
+                g_loss.set(loss)
                 logger.info(
                     "stop requested (preemption): checkpointed step %d/%d, "
                     "exiting cleanly", step + 1, cfg.steps)
@@ -366,8 +411,13 @@ def train(cfg: TrainerConfig, stop_event=None) -> float:
             if (step + 1) % cfg.log_every == 0 or step + 1 == cfg.steps:
                 jax.block_until_ready(loss_arr)
                 loss = float(loss_arr)
-                dt = time.perf_counter() - t0
+                g_loss.set(loss)
+                now = time.perf_counter()
+                dt = now - t0
                 done = step + 1 - start_step
+                m_step_s.observe((now - last_log_t)
+                                 / max(step + 1 - last_log_step, 1))
+                last_log_t, last_log_step = now, step + 1
                 logger.info("step %d/%d loss %.4f (%.2f steps/s)",
                             step + 1, cfg.steps, loss, done / max(dt, 1e-9))
             if eval_fn is not None and (step + 1) % cfg.eval_every == 0:
@@ -382,6 +432,7 @@ def train(cfg: TrainerConfig, stop_event=None) -> float:
                     ]
                 losses = [eval_fn(params, eb) for eb in eval_batches]
                 mean = sum(float(x) for x in losses) / len(losses)
+                g_eval.set(mean)
                 logger.info("step %d eval loss %.4f (%d batches)",
                             step + 1, mean, cfg.eval_steps)
             if ckpt is not None and (step + 1) % cfg.checkpoint_every == 0:
@@ -390,6 +441,7 @@ def train(cfg: TrainerConfig, stop_event=None) -> float:
                 # close() at exit fences the last in-flight save
                 ckpt.save(step + 1, params, opt_state, wait=False)
                 last_saved = step + 1
+                m_saves.inc()
         # success path: final save only when steps actually ran to the
         # configured end (a restart whose restored step already meets
         # cfg.steps must not relabel old state, and a preempted exit must
@@ -397,6 +449,7 @@ def train(cfg: TrainerConfig, stop_event=None) -> float:
         if ckpt is not None and not preempted and start_step < cfg.steps \
                 and last_saved != cfg.steps:
             ckpt.save(cfg.steps, params, opt_state)
+            m_saves.inc()
     finally:
         # release the prefetch producer (and the device batches it holds)
         # immediately on every exit path, not at GC time — an OOM retry
@@ -436,7 +489,17 @@ def main(argv: Optional[Sequence[str]] = None) -> None:
     logging.basicConfig(level=getattr(logging, cfg.log_level.upper(), 20),
                         format="%(asctime)s %(name)s %(levelname)s %(message)s")
     _maybe_init_distributed()
-    final = train(cfg)
+    health = None
+    if cfg.metrics_port:
+        from nos_tpu.cmd.serve import HealthServer
+
+        health = HealthServer(host="0.0.0.0", port=cfg.metrics_port).start()
+        logger.info("metrics on %s/metrics", health.address)
+    try:
+        final = train(cfg)
+    finally:
+        if health is not None:
+            health.stop()
     logger.info("training done, final loss %.4f", final)
 
 

@@ -196,6 +196,37 @@ def test_sigterm_checkpoints_midrun(tmp_path):
     assert signal.getsignal(signal.SIGTERM) == before
 
 
+def test_metrics_exported(tmp_path):
+    """nos_tpu_train_* metrics move with the run: steps/tokens count,
+    loss gauge lands, checkpoint saves and preemption exits counted."""
+    import threading
+
+    from nos_tpu.utils.metrics import default_registry
+
+    reg = default_registry()
+    steps0 = reg.counter("nos_tpu_train_steps_total", "x").value()
+    saves0 = reg.counter("nos_tpu_train_checkpoint_saves_total", "x").value()
+    pre0 = reg.counter("nos_tpu_train_preemptions_total", "x").value()
+
+    train(tiny(steps=4, checkpoint_dir=str(tmp_path / "a"),
+               checkpoint_every=2))
+    assert reg.counter("nos_tpu_train_steps_total", "x").value() \
+        == steps0 + 4
+    assert reg.counter("nos_tpu_train_tokens_total", "x").value() > 0
+    # saves at steps 2 and 4 (periodic covers the final step)
+    assert reg.counter("nos_tpu_train_checkpoint_saves_total",
+                       "x").value() == saves0 + 2
+    exposed = reg.expose()
+    assert "nos_tpu_train_loss" in exposed
+    assert "nos_tpu_train_step_seconds" in exposed
+
+    ev = threading.Event()
+    ev.set()
+    train(tiny(steps=4, checkpoint_dir=str(tmp_path / "b")), stop_event=ev)
+    assert reg.counter("nos_tpu_train_preemptions_total", "x").value() \
+        == pre0 + 1
+
+
 def test_trains_gpipe_with_sp():
     # the dense long-context + depth recipe is reachable from the binary:
     # pipeline_schedule="gpipe" composes pp with sp/ring attention
