@@ -80,6 +80,11 @@ def load_params(cfg: GenerateConfig):
         template = jax.eval_shape(
             lambda: tfm.init_params(jax.random.PRNGKey(0), model_cfg))
         ckpt = CheckpointManager(cfg.checkpoint_dir)
+        from nos_tpu.train.checkpoint import model_arch_dict
+
+        # mismatched dims fail HERE by field name, not as an orbax
+        # shape error mid-restore
+        ckpt.validate_model_config(model_arch_dict(cfg))
         step = ckpt.latest()
         params = ckpt.restore_params(step, params_template=template)
         ckpt.close()

@@ -209,8 +209,12 @@ def train(cfg: TrainerConfig, stop_event=None) -> float:
     start_step = 0
     if cfg.checkpoint_dir:
         from nos_tpu.train import CheckpointManager
+        from nos_tpu.train.checkpoint import model_arch_dict
 
         ckpt = CheckpointManager(cfg.checkpoint_dir)
+        # stamp (or, on resume, validate against) the architecture so a
+        # config drift between runs fails by field name, not shape error
+        ckpt.write_model_config(model_arch_dict(cfg))
         latest = ckpt.latest()
         if latest is not None:
             params, opt_state = ckpt.restore(

@@ -21,6 +21,22 @@ from typing import Any, Optional
 import jax
 
 
+def model_arch_dict(cfg) -> dict:
+    """The architecture fields stamped beside checkpoints
+    (``model_config.json``) — only fields that determine PARAMETER
+    SHAPES, so a stamp mismatch always means an un-restorable
+    checkpoint (``max_seq`` is deliberately absent: it only feeds RoPE
+    at apply time, and longer-context serving of an existing checkpoint
+    is legitimate). ``n_kv_heads`` is normalized the way
+    TransformerConfig reads it (0 means n_heads)."""
+    return {
+        "vocab": cfg.vocab, "d_model": cfg.d_model,
+        "n_layers": cfg.n_layers, "n_heads": cfg.n_heads,
+        "n_kv_heads": cfg.n_kv_heads or cfg.n_heads,
+        "d_ff": cfg.d_ff, "n_experts": cfg.n_experts,
+    }
+
+
 class CheckpointManager:
     """Step-numbered train-state checkpoints under one directory."""
 
@@ -66,6 +82,68 @@ class CheckpointManager:
 
     def wait_until_finished(self) -> None:
         self.manager.wait_until_finished()
+
+    # ------------------------------------------------------------------
+    # model-config stamp: architecture dims written next to the step
+    # checkpoints so a consumer (generate/server/resume) mismatching the
+    # saved shapes fails with a named field, not an orbax shape error.
+    # Local directories only — URI stores skip silently (the stamp is a
+    # convenience, never a gate on the checkpoint itself).
+
+    def _stamp_path(self) -> Optional[str]:
+        import os
+
+        if "://" in self.directory:
+            return None
+        return os.path.join(self.directory, "model_config.json")
+
+    def write_model_config(self, config: dict) -> None:
+        """Idempotently stamp the architecture (atomic write). Raises if
+        a DIFFERENT architecture is already stamped AND checkpoints
+        exist — resuming a run with changed dims corrupts it silently
+        otherwise. A stale stamp with no checkpoint behind it (aborted
+        mis-configured launch) is simply replaced, not a dead-end."""
+        import json
+        import os
+
+        path = self._stamp_path()
+        if path is None:
+            return
+        if os.path.exists(path):
+            if self.latest() is not None:
+                self.validate_model_config(config)
+                return
+            # no checkpoint to protect: fall through and restamp
+        os.makedirs(self.directory, exist_ok=True)
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(config, f, indent=1, sort_keys=True)
+        os.replace(tmp, path)
+
+    def read_model_config(self) -> Optional[dict]:
+        import json
+        import os
+
+        path = self._stamp_path()
+        if path is None or not os.path.exists(path):
+            return None
+        with open(path) as f:
+            return json.load(f)
+
+    def validate_model_config(self, expect: dict) -> None:
+        """No-op when unstamped; raises naming every mismatched field
+        when the stamp disagrees with ``expect``."""
+        have = self.read_model_config()
+        if have is None:
+            return
+        bad = {k: (have[k], expect[k])
+               for k in expect if k in have and have[k] != expect[k]}
+        if bad:
+            detail = ", ".join(
+                f"{k}: checkpoint has {h}, caller expects {e}"
+                for k, (h, e) in sorted(bad.items()))
+            raise ValueError(
+                f"model config mismatch under {self.directory}: {detail}")
 
     def latest(self) -> Optional[int]:
         return self.manager.latest_step()
