@@ -13,7 +13,7 @@ move on). Every item's stdout/stderr lands in ``bench_logs/`` and a
 rolling ``summary.json`` records per-item status so a human (or the
 next agent turn) can read progress without attaching to the process.
 
-Usage: python hack/bench_babysit.py [--queue default|mfu|infer] &
+Usage: python hack/bench_babysit.py [--queue default|mfu|infer|sharing] &
 """
 import argparse
 import json
@@ -103,8 +103,19 @@ QUEUES = {
         ("serve", ["bench_serve.py"], {}, 1800, None),
         ("infer_tenants", ["bench_infer.py"], {}, 1800, None),
     ],
+    # the reference's actual published table (BASELINE.md): per-request
+    # YOLOS latency at N tenants sharing one accelerator, per sharing
+    # mode. multiplex = the MPS analog, timeslice = the worst case.
+    # 30s measurement windows; one JSON line each (--oneshot).
+    "sharing": [
+        (f"share_{mode}_{n}",
+         ["demos/tpu-sharing-comparison/client/main.py", "--mode", mode,
+          "--streams", str(n), "--seconds", "30", "--oneshot"],
+         {"NOS_TPU_ROOT": REPO}, 1200, None)
+        for mode in ("multiplex", "timeslice") for n in (1, 3, 5, 7)
+    ],
 }
-QUEUES["default"] = QUEUES["mfu"] + QUEUES["infer"]
+QUEUES["default"] = QUEUES["mfu"] + QUEUES["infer"] + QUEUES["sharing"]
 
 
 def run_item(name, argv, env_over, timeout_s, attempt):

@@ -114,6 +114,13 @@ class CheckpointManager:
                 self.validate_model_config(config)
                 return
             # no checkpoint to protect: fall through and restamp
+        elif self.latest() is not None:
+            # pre-stamp-era checkpoints with unknown architecture: the
+            # caller's dims are exactly what we CAN'T trust (a drifted
+            # relaunch would poison the stamp and then blame the
+            # corrected config). Leave unstamped; restore still fails
+            # with the orbax shape error as before.
+            return
         os.makedirs(self.directory, exist_ok=True)
         tmp = path + ".tmp"
         with open(tmp, "w") as f:

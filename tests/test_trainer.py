@@ -283,3 +283,16 @@ def test_trains_gpipe_with_sp():
 def test_1f1b_with_sp_fails_loudly():
     with pytest.raises(ValueError, match="1F1B does not compose with sp"):
         train(tiny(pp=2, sp=2, dp=2, n_microbatches=2))
+
+
+def test_prestamp_checkpoints_never_get_caller_stamp(tmp_path):
+    """A directory holding checkpoints from before the stamp feature
+    must NOT be stamped with the (untrustworthy) caller dims."""
+    import os
+
+    train(tiny(steps=2, checkpoint_dir=str(tmp_path), checkpoint_every=2))
+    os.remove(os.path.join(tmp_path, "model_config.json"))  # pre-stamp era
+    # drifted relaunch: restore fails on shapes, but must not stamp
+    with pytest.raises(Exception):
+        train(tiny(steps=4, d_ff=128, checkpoint_dir=str(tmp_path)))
+    assert not os.path.exists(os.path.join(tmp_path, "model_config.json"))
