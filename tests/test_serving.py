@@ -127,6 +127,47 @@ def test_random_schedules_stay_exact(params):
             assert results[rid] == ref(params, p, n), (trial, rid, p, n)
 
 
+def test_random_schedules_compose_all_features(params):
+    """Composition prober: random engine config (chunked prefill on/off,
+    prefix cache on/off), random prefix publish/reuse, random mid-flight
+    cancels, random interleavings — every surviving request stays
+    bit-exact vs generate(). The single-feature probers above localize a
+    failure; this one exists to catch feature INTERACTIONS."""
+    rng = np.random.default_rng(7)
+    for trial in range(4):
+        chunk = int(rng.choice([0, 8, 16]))
+        pcache = int(rng.choice([0, 2]))
+        srv = DecodeServer(params, CFG, max_batch=2, prefill_chunk=chunk,
+                           prefix_cache_size=pcache)
+        system = [int(t) for t in rng.integers(0, 64, 12)]
+        rids, reqs, canceled = [], [], set()
+        for _ in range(int(rng.integers(3, 7))):
+            if pcache and rng.random() < 0.5:
+                p = system + [int(t) for t in
+                              rng.integers(0, 64, rng.integers(1, 20))]
+            else:
+                p = [int(t) for t in rng.integers(0, 64, rng.integers(1, 41))]
+            n = int(rng.integers(1, 7))
+            kw = {"cache_prefix": True} \
+                if pcache and rng.random() < 0.5 else {}
+            rids.append(srv.submit(p, n, **kw))
+            reqs.append((p, n))
+            if rng.random() < 0.3:
+                j = int(rng.integers(0, len(rids)))
+                # cancel() is False for already-finished rids: those must
+                # STAY in the exactness check below
+                if rids[j] not in canceled and srv.cancel(rids[j]):
+                    canceled.add(rids[j])
+            for _ in range(int(rng.integers(0, 4))):
+                srv.step()
+        results = srv.drain()
+        for rid, (p, n) in zip(rids, reqs):
+            if rid in canceled:
+                continue        # canceled: absent or truncated, both fine
+            assert results[rid] == ref(params, p, n), \
+                (trial, chunk, pcache, rid, p, n)
+
+
 def test_engine_serves_int8_params(params):
     """The quantized pytree drops into the engine unchanged — int8
     serving must match int8 generate() exactly."""
