@@ -1,0 +1,153 @@
+"""Multi-tenant detection serving: the reference's ONLY published
+benchmark scenario, planned end-to-end on one dynamically partitioned
+v5e host.
+
+The reference demo (rwipfelexo/nos ``demos/gpu-sharing-comparison``)
+serves YOLOS-small from N pods sharing one A100 under MIG / MPS /
+time-slicing and publishes per-request latency (BASELINE.md). This
+example is the TPU twin at the isolation end of that spectrum — the MIG
+analog: each tenant owns a hardware-isolated **1x1 sub-slice** of a v5e
+host, carved on demand by the partitioning control plane
+(nos_tpu/partitioning/subslicing.py) and advertised by the tpuagent as
+``nos.ai/tpu-slice-1x1``. Latency per tenant is then flat in the number
+of co-resident tenants — the property the reference measures for MIG
+(0.342-0.345 s at 1..7 pods) — while the chips tenants don't use remain
+carveable for anyone else.
+
+The model each tenant runs is nos_tpu/models/yolos.py — the reference's
+exact model family (ViT-small/16 backbone + 100 detection tokens). The
+shared-chip ends of the spectrum (multiplex = the MPS analog,
+timeslice) are the sharing demo (demos/tpu-sharing-comparison), whose
+hardware table hack/bench_babysit.py --queue sharing measures.
+
+Quota-wise the namespace ElasticQuota bounds the tenants in the
+resource they request (``nos.ai/tpu-slice-1x1`` — accounting is
+bound-keyed, like the reference's MIG-profile quotas), with
+``nos_tpu/tpu/resource_calc.py`` deriving the chip-memory equivalent;
+max = 2x min lets detection borrow idle capacity and be reclaimed by
+in-quota training pods.
+
+Run ``python examples/yolos_multitenant_v5e.py`` for the plan (no TPU
+needed); the worked numbers are asserted in tests/test_example_yolos.py.
+"""
+from __future__ import annotations
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+from nos_tpu import constants                                  # noqa: E402
+from nos_tpu.models.yolos import YolosConfig                   # noqa: E402
+from nos_tpu.tpu import topology                               # noqa: E402
+
+GENERATION = "v5e"
+NAMESPACE = "detect"
+N_TENANTS = 7                 # the reference's largest published point
+SLICE = "1x1"                 # MIG-analog isolation: one chip per tenant
+
+MODEL = YolosConfig()         # YOLOS-small: ViT-small/16 + 100 det tokens
+
+V5E_BF16_TFLOPS = 197.0       # per chip (bench.py PEAK_TFLOPS)
+
+
+def forward_gflops(cfg: YolosConfig, batch: int = 1) -> float:
+    """Analytic matmul GFLOPs of one detection forward (2*m*n*k per
+    matmul): patch projection, per-block qkv/proj/mlp + attention at
+    S = patches + det tokens, class head, box MLP."""
+    s = cfg.n_patches + cfg.n_det_tokens
+    d, f, L = cfg.d_model, cfg.d_ff, cfg.n_layers
+    patch_dim = cfg.patch * cfg.patch * 3
+    per_block = 2 * s * (d * 4 * d          # qkv + output proj
+                         + 2 * d * f)       # mlp in + out
+    attn = 4 * s * s * d                    # scores + weighted sum
+    heads = 2 * cfg.n_det_tokens * (d * (cfg.n_classes + 1)  # class head
+                                    + 2 * d * d + d * 4)     # box mlp
+    total = (2 * cfg.n_patches * patch_dim * d   # det tokens are learned
+             # embeddings, not projections — only image patches matmul here
+             + L * (per_block + attn) + heads)
+    return batch * total / 1e9
+
+
+def plan() -> dict:
+    gen = topology.get_generation(GENERATION)
+    gx, gy = topology.host_grid(GENERATION)
+    sx, sy = (int(v) for v in SLICE.split("x"))
+    per_host = (gx * gy) // (sx * sy)
+    gflops = forward_gflops(MODEL)
+    # compute floor at realistic MXU efficiency for a small model (40%)
+    floor_ms = gflops / (V5E_BF16_TFLOPS * 1e3 * 0.4) * 1e3
+    return {
+        "tenants": N_TENANTS,
+        "slice_resource": constants.RESOURCE_TPU_SLICE_PREFIX + SLICE,
+        "chips_per_host": gen.chips_per_host,
+        "host_grid": f"{gx}x{gy}",
+        "tenants_per_host": per_host,
+        "hosts_needed": -(-N_TENANTS // per_host),
+        "spare_slices": per_host - N_TENANTS % per_host
+        if N_TENANTS % per_host else 0,
+        "forward_gflops": round(gflops, 2),
+        "latency_floor_ms": round(floor_ms, 3),
+        "reference_mig_s": 0.34425,   # A100 MIG at 7 pods (BASELINE.md)
+    }
+
+
+def tenant_pods() -> list:
+    """One serving pod per tenant, each requesting an isolated 1x1
+    sub-slice — the shape demos/tpu-sharing-comparison deploys as its
+    ``subslice`` overlay."""
+    res = constants.RESOURCE_TPU_SLICE_PREFIX + SLICE
+    accel = topology.get_generation(GENERATION).name
+    pods = []
+    for i in range(N_TENANTS):
+        pods.append({
+            "apiVersion": "v1",
+            "kind": "Pod",
+            "metadata": {
+                "name": f"yolos-tenant-{i}",
+                "namespace": NAMESPACE,
+            },
+            "spec": {
+                "schedulerName": constants.SCHEDULER_NAME,
+                "nodeSelector": {
+                    constants.LABEL_TPU_ACCELERATOR: accel,
+                },
+                "containers": [{
+                    "name": "detect",
+                    "image": "nos-tpu/sharing-client:latest",
+                    "command": ["python", "/app/client/main.py",
+                                "--mode", "subslice"],
+                    "resources": {
+                        "requests": {res: 1},
+                        "limits": {res: 1},
+                    },
+                }],
+            },
+        })
+    return pods
+
+
+def quota() -> dict:
+    """Namespace ElasticQuota bounding the tenants in the resource they
+    REQUEST (1x1 sub-slices — quota accounting is bound-keyed, like the
+    reference's MIG-profile quotas; the ResourceCalculator additionally
+    derives nos.ai/tpu-memory from slice requests for memory-bounded
+    quotas). max = 2x min: detection can borrow idle capacity and be
+    reclaimed by in-quota training pods."""
+    res = constants.RESOURCE_TPU_SLICE_PREFIX + SLICE
+    return {
+        "apiVersion": "nos.ai/v1alpha1",
+        "kind": "ElasticQuota",
+        "metadata": {"name": "detect-quota", "namespace": NAMESPACE},
+        "spec": {
+            "min": {res: N_TENANTS},
+            "max": {res: 2 * N_TENANTS},
+        },
+    }
+
+
+if __name__ == "__main__":
+    import json
+
+    print(json.dumps({"plan": plan(), "quota": quota(),
+                      "pods": len(tenant_pods())}, indent=1))

@@ -1,0 +1,126 @@
+"""The multi-tenant detection example (examples/yolos_multitenant_v5e.py):
+plan numbers, pod-per-tenant scheduling onto a sub-sliced v5e host, and
+quota accounting of the sub-slice requests in chips."""
+import importlib.util
+import os
+
+from nos_tpu import constants
+from nos_tpu.tpu.resource_calc import ResourceCalculator
+
+
+def load_example():
+    path = os.path.join(os.path.dirname(__file__), "..", "examples",
+                        "yolos_multitenant_v5e.py")
+    spec = importlib.util.spec_from_file_location("yolos_multitenant_v5e",
+                                                  path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+EX = load_example()
+
+
+def test_plan_numbers():
+    p = EX.plan()
+    # 7 tenants on one 2x4 v5e host: 8 isolated 1x1 slices, one spare
+    assert p["tenants_per_host"] == 8
+    assert p["hosts_needed"] == 1
+    assert p["spare_slices"] == 1
+    assert constants.TPU_SLICE_RESOURCE_REGEX.match(p["slice_resource"])
+    # YOLOS-small forward is ~14 GFLOPs: a chip is never the bottleneck,
+    # which is exactly why isolation costs so little here
+    assert 5 < p["forward_gflops"] < 40
+    assert p["latency_floor_ms"] < 1.0
+    assert p["latency_floor_ms"] / 1e3 < p["reference_mig_s"]
+
+
+def test_forward_gflops_matches_model_scale():
+    """The analytic FLOP count must be consistent with the parameter
+    count (dense transformer: ~2 FLOPs per param per token at S tokens,
+    attention extra) — a sanity bound, not an exact identity."""
+    import jax
+
+    from nos_tpu.models import yolos
+
+    params = yolos.init_params(jax.random.PRNGKey(0), EX.MODEL)
+    n = yolos.param_count(params)
+    s = EX.MODEL.n_patches + EX.MODEL.n_det_tokens
+    dense_floor = 2 * n * s / 1e9      # matmul params touched once per token
+    g = EX.forward_gflops(EX.MODEL)
+    assert dense_floor * 0.8 < g < dense_floor * 2.5, (g, dense_floor)
+
+
+def test_pods_carry_subslice_resource_and_scheduler():
+    pods = EX.tenant_pods()
+    assert len(pods) == 7
+    for pod in pods:
+        spec = pod["spec"]
+        assert spec["schedulerName"] == constants.SCHEDULER_NAME
+        req = spec["containers"][0]["resources"]["requests"]
+        assert req == {EX.plan()["slice_resource"]: 1}
+
+
+def test_quota_bounds_the_requested_resource():
+    """Quota accounting is bound-keyed: the min must be denominated in
+    the resource the pods request (1x1 sub-slices), and its chip-memory
+    equivalent (via ResourceCalculator) is exactly 7 chips' HBM."""
+    q = EX.quota()
+    res = EX.plan()["slice_resource"]
+    assert q["spec"]["min"] == {res: 7}
+    total = {}
+    calc = ResourceCalculator()
+    for pod in EX.tenant_pods():
+        req = pod["spec"]["containers"][0]["resources"]["requests"]
+        for k, v in calc.compute_request(req).items():
+            total[k] = total.get(k, 0) + v
+    assert total[res] == q["spec"]["min"][res]
+    want = calc.compute_request({constants.RESOURCE_TPU: 7})
+    assert total[constants.RESOURCE_TPU_MEMORY] \
+        == want[constants.RESOURCE_TPU_MEMORY]
+
+
+def test_tenants_flow_through_the_real_stack():
+    """The example's quota + pods through the REAL control plane (e2e
+    stack): virgin host sub-sliced on demand, all 7 tenants bound, usage
+    accounted in the bound resource once Running."""
+    import sys
+
+    sys.path.insert(0, os.path.dirname(__file__))
+    from test_e2e_stack import full_stack, pump_batch, v5e_node
+
+    from nos_tpu.api.quota import make_elastic_quota
+    from nos_tpu.kube import ObjectMeta, Pod
+    from nos_tpu.kube.objects import (Container, PodCondition, PodSpec,
+                                      PodStatus)
+
+    server, mgr, clock, agents = full_stack(["host-0"])
+    server.create(v5e_node("host-0"))
+    q = EX.quota()
+    server.create(make_elastic_quota(
+        q["metadata"]["name"], q["metadata"]["namespace"],
+        q["spec"]["min"], q["spec"]["max"]))
+    for m in EX.tenant_pods():
+        c = m["spec"]["containers"][0]
+        server.create(Pod(
+            metadata=ObjectMeta(name=m["metadata"]["name"],
+                                namespace=m["metadata"]["namespace"]),
+            spec=PodSpec(
+                containers=[Container(requests=c["resources"]["requests"])],
+                scheduler_name=m["spec"]["schedulerName"],
+                node_selector=m["spec"].get("nodeSelector", {})),
+            status=PodStatus(phase="Pending", conditions=[
+                PodCondition(type="PodScheduled", status="False",
+                             reason="Unschedulable")]),
+        ))
+    for _ in range(6):
+        pump_batch(mgr, clock)
+    pods = server.list("Pod", namespace="detect")
+    assert len([p for p in pods if p.spec.node_name]) == 7, \
+        [p.metadata.name for p in pods if not p.spec.node_name]
+    for p in pods:
+        p.status.phase = "Running"
+        server.update(p)
+    mgr.run_until_idle()
+    eq = server.get("ElasticQuota", "detect-quota", "detect")
+    assert eq.status.used == {EX.plan()["slice_resource"]: 7}
