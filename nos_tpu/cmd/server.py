@@ -45,11 +45,11 @@ class ServerConfig:
     int8: bool = False
     # serving
     max_batch: int = 8
-    # tensor-parallel serving: shard params (transformer.param_shardings)
-    # and the KV cache (generate.cache_shardings — KV heads over tp)
-    # across the first ``tp`` local devices. 0/1 = single device. Tokens
-    # are invariant to tp (tested); requires kv_heads % tp == 0 and
-    # bf16 params (int8's QuantLinear tree has no sharding map yet).
+    # tensor-parallel serving: shard params (transformer.param_shardings,
+    # or quant.quant_param_shardings when int8) and the KV cache
+    # (generate.cache_shardings — KV heads over tp) across the first
+    # ``tp`` local devices. 0/1 = single device. Tokens are invariant to
+    # tp, bf16 and int8 alike (tested); requires kv_heads % tp == 0.
     tp: int = 0
     # prefix-cache entries (0 = off): each holds one prompt's KV on
     # device — budget by model size (flagship: ~64 MB per 1k tokens)
@@ -350,10 +350,6 @@ def build_engine(cfg: ServerConfig):
             f"{cfg.prefill_chunk}")
     mesh = None
     if cfg.tp and cfg.tp > 1:
-        if cfg.int8:
-            raise ValueError(
-                "tp > 1 with int8 is not supported: the QuantLinear "
-                "param tree has no sharding map — serve bf16 under tp")
         import jax
         from jax.sharding import Mesh
 
@@ -386,7 +382,13 @@ def build_engine(cfg: ServerConfig):
         checkpoint_dir=cfg.checkpoint_dir, int8=cfg.int8, seed=cfg.seed)
     model_cfg, params = load_params(gcfg)
     if mesh is not None:
-        params = jax.device_put(params, param_shardings(mesh, model_cfg))
+        if cfg.int8:
+            from nos_tpu.models.quant import quant_param_shardings
+
+            shardings = quant_param_shardings(mesh, model_cfg)
+        else:
+            shardings = param_shardings(mesh, model_cfg)
+        params = jax.device_put(params, shardings)
     if cfg.draft_checkpoint_dir:
         from nos_tpu.models.spec_serving import SpeculativeDecodeServer
 

@@ -11,9 +11,9 @@ from __future__ import annotations
 
 from typing import Any
 
-from nos_tpu.ops.quant import quantize_array
+from nos_tpu.ops.quant import QuantLinear, quantize_array
 
-__all__ = ["quantize_params"]
+__all__ = ["quantize_params", "quant_param_shardings"]
 
 _DENSE_FFN_KEYS = ("w_gate", "w_up", "w_down")
 _ATTN_KEYS = ("wq", "wk", "wv", "wo")
@@ -36,4 +36,38 @@ def quantize_params(params: Any, *, quantize_embed: bool = True) -> Any:
         # per-ROW scales: a rare token's small row must not quantize
         # against the whole column's max (embed is a gather, not a matmul)
         out["embed"] = quantize_array(params["embed"], axis=-1)
+    return out
+
+
+def quant_param_shardings(mesh, cfg, *, quantize_embed: bool = True):
+    """Shardings for a ``quantize_params`` tree under tensor parallelism
+    (the int8 twin of transformer.param_shardings). Derived, not
+    restated: each QuantLinear's ``q`` keeps the dense weight's layout
+    and ``scale`` is that layout with the quantized axis dropped — so
+    the structure below mirrors ``quantize_params`` key-for-key and the
+    Megatron layout itself has exactly one source of truth
+    (transformer.param_shardings)."""
+    from jax.sharding import NamedSharding
+    from jax.sharding import PartitionSpec as P
+
+    from nos_tpu.models.transformer import param_shardings
+
+    def ql_from(dense_sh, axis):
+        spec = list(dense_sh.spec)
+        while len(spec) < -axis:        # implied trailing replication
+            spec.append(None)
+        del spec[axis]
+        return QuantLinear(q=dense_sh, scale=NamedSharding(mesh, P(*spec)))
+
+    out = dict(param_shardings(mesh, cfg))
+    layers = dict(out["layers"])
+    for k in _ATTN_KEYS:
+        layers[k] = ql_from(layers[k], -2)
+    if "w_router" not in layers:        # dense FFN only; experts stay bf16
+        for k in _DENSE_FFN_KEYS:
+            layers[k] = ql_from(layers[k], -2)
+    out["layers"] = layers
+    out["unembed"] = ql_from(out["unembed"], -2)
+    if quantize_embed:
+        out["embed"] = ql_from(out["embed"], -1)
     return out

@@ -109,3 +109,25 @@ def test_server_prefix_cache_under_mesh(params, sharded_params, mesh):
                                     prefix_cache_size=4, mesh=mesh))
     assert (sa, sb) == (pa, pb)
     assert hits >= 1
+
+
+def test_int8_generate_and_server_invariant_to_tp(params, mesh):
+    """tp + int8 compose: the quantized tree sharded by
+    quant_param_shardings produces the SAME tokens as single-device
+    int8 decode, through generate() and the serving engine."""
+    from nos_tpu.models.quant import quant_param_shardings, quantize_params
+
+    qp = quantize_params(params)
+    qp_sharded = jax.device_put(qp, quant_param_shardings(mesh, CFG))
+
+    prompt = jnp.asarray([[3, 1, 4, 1, 5]], jnp.int32)
+    want = generate(qp, CFG, prompt, 10)
+    got = jax.jit(lambda p: generate(p, CFG, prompt, 10))(qp_sharded)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+    plain = DecodeServer(qp, CFG, max_batch=2)
+    r0 = plain.submit([3, 1, 4, 1, 5], 6)
+    plain_out = plain.drain()[r0]
+    srv = DecodeServer(qp_sharded, CFG, max_batch=2, mesh=mesh)
+    r1 = srv.submit([3, 1, 4, 1, 5], 6)
+    assert srv.drain()[r1] == plain_out
