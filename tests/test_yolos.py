@@ -92,6 +92,23 @@ def test_sinkhorn_matches_brute_force_optimum(seed):
     assert ours <= best + 1e-3, f"seed {seed}: {ours} vs optimal {best}"
 
 
+@pytest.mark.parametrize("seed", range(6))
+def test_sinkhorn_near_ties_and_larger_instances(seed):
+    """Adversarial matching: near-tie costs (quantized to 0.1 so many
+    assignments are almost equivalent) and T=5/Q=10. The greedy-hardened
+    plan must stay one-to-one and within 5% of the brute-force optimum
+    even when Sinkhorn's soft plan is nearly uniform across ties."""
+    rng = np.random.default_rng(100 + seed)
+    q, t = 10, 5
+    cost = (rng.integers(0, 10, (q, t)) / 10.0).astype(np.float32)
+    mask = np.ones(t, bool)
+    assign = np.asarray(sinkhorn_match(jnp.asarray(cost), jnp.asarray(mask)))
+    assert len(set(assign.tolist())) == t
+    ours = sum(cost[assign[i], i] for i in range(t))
+    best = _brute_force_cost(cost, t)
+    assert ours <= best + max(0.05 * abs(best), 0.051), (ours, best)
+
+
 def test_sinkhorn_all_padded_is_safe():
     cost = jnp.ones((4, 3))
     assign = sinkhorn_match(cost, jnp.zeros((3,), bool))
