@@ -84,9 +84,13 @@ class TrainerConfig:
     eval_data_path: str = ""
     eval_every: int = 0
     eval_steps: int = 4
-    # checkpointing
+    # checkpointing: step cadence, plus an optional wall-clock cadence
+    # (0 = off) — with variable step times (compile stalls, input
+    # hiccups, MoE load imbalance) a pure step count can leave long
+    # unprotected gaps; whichever cadence fires first saves
     checkpoint_dir: str = ""
     checkpoint_every: int = 100
+    checkpoint_every_s: float = 0.0
     # preemption: catch SIGTERM (GKE spot/maintenance eviction sends it,
     # then waits terminationGracePeriodSeconds), finish the in-flight
     # step, checkpoint, and exit cleanly so the rescheduled gang resumes
@@ -358,6 +362,7 @@ def train(cfg: TrainerConfig, stop_event=None) -> float:
     profile_stop = 0
     t0 = time.perf_counter()
     last_log_t, last_log_step = t0, start_step
+    last_save_t = t0
     from nos_tpu.train.data import prefetch_to_device
 
     if cfg.prefetch > 0:
@@ -439,12 +444,27 @@ def train(cfg: TrainerConfig, stop_event=None) -> float:
                 g_eval.set(mean)
                 logger.info("step %d eval loss %.4f (%d batches)",
                             step + 1, mean, cfg.eval_steps)
-            if ckpt is not None and (step + 1) % cfg.checkpoint_every == 0:
+            due_by_time = (cfg.checkpoint_every_s > 0 and
+                           time.perf_counter() - last_save_t
+                           >= cfg.checkpoint_every_s)
+            if cfg.checkpoint_every_s > 0 and jax.process_count() > 1:
+                # the save is a COLLECTIVE (orbax sharded write): clocks
+                # differ per host, so process 0's verdict is broadcast —
+                # config-gated, so every process runs this collective or
+                # none does
+                import numpy as np
+                from jax.experimental import multihost_utils
+
+                due_by_time = bool(multihost_utils.broadcast_one_to_all(
+                    np.asarray(due_by_time)))
+            if ckpt is not None and (
+                    (step + 1) % cfg.checkpoint_every == 0 or due_by_time):
                 # async: serialization overlaps the next steps' compute
                 # (params are immutable arrays — the snapshot is safe);
                 # close() at exit fences the last in-flight save
                 ckpt.save(step + 1, params, opt_state, wait=False)
                 last_saved = step + 1
+                last_save_t = time.perf_counter()
                 m_saves.inc()
         # success path: final save only when steps actually ran to the
         # configured end (a restart whose restored step already meets

@@ -296,3 +296,26 @@ def test_prestamp_checkpoints_never_get_caller_stamp(tmp_path):
     with pytest.raises(Exception):
         train(tiny(steps=4, d_ff=128, checkpoint_dir=str(tmp_path)))
     assert not os.path.exists(os.path.join(tmp_path, "model_config.json"))
+
+
+def test_wall_clock_checkpoint_cadence(tmp_path):
+    """checkpoint_every_s: with the step cadence effectively off, a
+    tiny wall-clock budget saves on (nearly) every step; cadence 0
+    keeps the old behavior."""
+    from nos_tpu.train import CheckpointManager
+
+    d = str(tmp_path / "timed")
+    train(tiny(steps=4, checkpoint_dir=d, checkpoint_every=10**6,
+               checkpoint_every_s=1e-9))
+    mgr = CheckpointManager(d)
+    # every step was past the (absurdly small) time budget; retention
+    # keeps the most recent ones and latest is the final step
+    assert mgr.latest() == 4
+    assert len(mgr.manager.all_steps()) >= 2
+    mgr.close()
+
+    d2 = str(tmp_path / "stepcad")
+    train(tiny(steps=4, checkpoint_dir=d2, checkpoint_every=10**6))
+    mgr2 = CheckpointManager(d2)
+    assert mgr2.manager.all_steps() == [4]   # only the final save
+    mgr2.close()
