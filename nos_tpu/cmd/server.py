@@ -24,6 +24,7 @@ from dataclasses import dataclass, fields
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional, Sequence
 
+from nos_tpu.models.serving import QueueFull
 from nos_tpu.utils.metrics import default_registry
 
 logger = logging.getLogger("nos_tpu.server")
@@ -45,6 +46,10 @@ class ServerConfig:
     int8: bool = False
     # serving
     max_batch: int = 8
+    # admission bound (0 = unbounded): beyond max_batch active slots, at
+    # most this many requests wait; past it, POST /v1/generate answers
+    # 429 so clients shed load instead of queueing into timeouts
+    max_pending: int = 0
     # tensor-parallel serving: shard params (transformer.param_shardings,
     # or quant.quant_param_shardings when int8) and the KV cache
     # (generate.cache_shardings — KV heads over tp) across the first
@@ -406,10 +411,11 @@ def build_engine(cfg: ServerConfig):
             params, model_cfg, draft_params, draft_cfg,
             n_draft=cfg.draft_n_tokens, max_batch=cfg.max_batch,
             prefix_cache_size=cfg.prefix_cache_size, mesh=mesh,
-            prefill_chunk=cfg.prefill_chunk)
+            prefill_chunk=cfg.prefill_chunk, max_pending=cfg.max_pending)
     return DecodeServer(params, model_cfg, max_batch=cfg.max_batch,
                         prefix_cache_size=cfg.prefix_cache_size, mesh=mesh,
-                        prefill_chunk=cfg.prefill_chunk)
+                        prefill_chunk=cfg.prefill_chunk,
+                        max_pending=cfg.max_pending)
 
 
 def make_http_server(cfg: ServerConfig, loop: ServingLoop
@@ -418,10 +424,12 @@ def make_http_server(cfg: ServerConfig, loop: ServingLoop
         def log_message(self, fmt, *args):      # route through logging
             logger.debug("http: " + fmt, *args)
 
-        def _reply(self, code: int, body: dict) -> None:
+        def _reply(self, code: int, body: dict, headers=()) -> None:
             data = json.dumps(body).encode()
             self.send_response(code)
             self.send_header("Content-Type", "application/json")
+            for name, value in headers:
+                self.send_header(name, value)
             self.send_header("Content-Length", str(len(data)))
             self.end_headers()
             self.wfile.write(data)
@@ -530,6 +538,10 @@ def make_http_server(cfg: ServerConfig, loop: ServingLoop
                 tokens = loop.generate(prompt, n, **sampling)
             except (KeyError, ValueError, TypeError) as e:
                 self._reply(400, {"error": f"{type(e).__name__}: {e}"})
+                return
+            except QueueFull as e:
+                self._reply(429, {"error": str(e)},
+                            headers=[("Retry-After", "1")])
                 return
             except (TimeoutError, DrainingError) as e:
                 self._reply(503, {"error": str(e)})

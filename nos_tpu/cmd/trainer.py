@@ -363,6 +363,12 @@ def train(cfg: TrainerConfig, stop_event=None) -> float:
     t0 = time.perf_counter()
     last_log_t, last_log_step = t0, start_step
     last_save_t = t0
+    time_cadence_collective = (ckpt is not None
+                               and cfg.checkpoint_every_s > 0
+                               and jax.process_count() > 1)
+    if time_cadence_collective:
+        import numpy as _np
+        from jax.experimental import multihost_utils as _mh_utils
     from nos_tpu.train.data import prefetch_to_device
 
     if cfg.prefetch > 0:
@@ -444,19 +450,16 @@ def train(cfg: TrainerConfig, stop_event=None) -> float:
                 g_eval.set(mean)
                 logger.info("step %d eval loss %.4f (%d batches)",
                             step + 1, mean, cfg.eval_steps)
-            due_by_time = (cfg.checkpoint_every_s > 0 and
-                           time.perf_counter() - last_save_t
+            due_by_time = (ckpt is not None and cfg.checkpoint_every_s > 0
+                           and time.perf_counter() - last_save_t
                            >= cfg.checkpoint_every_s)
-            if cfg.checkpoint_every_s > 0 and jax.process_count() > 1:
+            if time_cadence_collective:
                 # the save is a COLLECTIVE (orbax sharded write): clocks
                 # differ per host, so process 0's verdict is broadcast —
-                # config-gated, so every process runs this collective or
-                # none does
-                import numpy as np
-                from jax.experimental import multihost_utils
-
-                due_by_time = bool(multihost_utils.broadcast_one_to_all(
-                    np.asarray(due_by_time)))
+                # config-gated (ckpt configured + every_s set + multi-
+                # host), so every process runs this collective or none
+                due_by_time = bool(
+                    _mh_utils.broadcast_one_to_all(_np.asarray(due_by_time)))
             if ckpt is not None and (
                     (step + 1) % cfg.checkpoint_every == 0 or due_by_time):
                 # async: serialization overlaps the next steps' compute

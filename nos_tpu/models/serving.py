@@ -42,6 +42,12 @@ from nos_tpu.models.generate import (
 )
 from nos_tpu.models.transformer import Params, TransformerConfig
 
+
+class QueueFull(RuntimeError):
+    """Admission refused: the pending queue is at ``max_pending``. Its
+    own type so the HTTP layer can answer 429 (shed load, retry) rather
+    than a generic 500."""
+
 __all__ = ["DecodeServer"]
 
 
@@ -96,7 +102,7 @@ class DecodeServer:
     def __init__(self, params: Params, cfg: TransformerConfig,
                  max_batch: int = 8, max_len: Optional[int] = None,
                  prefix_cache_size: int = 0, mesh=None,
-                 prefill_chunk: int = 0):
+                 prefill_chunk: int = 0, max_pending: int = 0):
         if prefill_chunk and (prefill_chunk < 8
                               or prefill_chunk & (prefill_chunk - 1)):
             raise ValueError(
@@ -124,6 +130,11 @@ class DecodeServer:
             self.cache = jax.device_put(self.cache, shd)
             self._row_shd = shd["k"]
             self._rep = NamedSharding(mesh, PartitionSpec())
+        # admission bound (0 = unbounded): beyond max_batch active slots,
+        # at most this many requests may WAIT — past it, submit raises
+        # QueueFull so callers shed load (HTTP 429) instead of growing
+        # an unbounded backlog whose tail would time out anyway
+        self.max_pending = max_pending
         self._free = list(range(max_batch))
         self._active: Dict[int, _Request] = {}      # slot -> request
         self._pending: List[_Request] = []
@@ -239,6 +250,11 @@ class DecodeServer:
             raise ValueError(
                 f"top_k must be >= 0 and top_p in [0, 1]: got "
                 f"top_k={top_k}, top_p={top_p}")
+        if self.max_pending and not self._free \
+                and len(self._pending) >= self.max_pending:
+            raise QueueFull(
+                f"{len(self._pending)} requests already waiting "
+                f"(max_pending={self.max_pending}); shed load and retry")
         rid = self._next_rid
         self._next_rid += 1
         self._pending.append(_Request(

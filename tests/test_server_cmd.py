@@ -513,3 +513,41 @@ def test_drain_over_http_503_and_readyz_flips():
     finally:
         httpd.shutdown()
         loop.shutdown()
+
+
+def test_queue_full_is_http_429():
+    cfg = ServerConfig(**MODEL, bf16=False, max_batch=1, max_pending=1,
+                       port=0)
+    # a fake engine enforcing the bound like the real one
+    from nos_tpu.models.serving import QueueFull
+
+    class Bounded(_FakeEngine):
+        def submit(self, prompt, n, **kw):
+            if len(self.pending) >= 2:      # 1 "active" + 1 waiting
+                raise QueueFull("2 requests already waiting "
+                                "(max_pending=1); shed load and retry")
+            return super().submit(prompt, n, **kw)
+
+        def step(self):
+            return 0                         # never completes: queue holds
+
+    eng = Bounded()
+    loop = ServingLoop(eng)
+    httpd = make_http_server(cfg, loop)
+    t = threading.Thread(target=httpd.serve_forever, daemon=True)
+    t.start()
+    url = f"http://127.0.0.1:{httpd.server_address[1]}"
+    try:
+        gens = [loop.stream([1], 2), loop.stream([2], 2)]   # fill it
+        try:
+            post(url, {"prompt": [3], "max_new_tokens": 2}, timeout=10)
+            raise AssertionError("expected 429")
+        except urllib.error.HTTPError as e:
+            assert e.code == 429
+            assert e.headers.get("Retry-After") == "1"
+            assert "shed load" in json.loads(e.read())["error"]
+        for g in gens:
+            g.close()
+    finally:
+        httpd.shutdown()
+        loop.shutdown()

@@ -345,3 +345,19 @@ def test_stop_token_never_seen_runs_to_max(params):
     got = srv.drain()[rid]
     want = ref(params, [4, 5], 6)
     assert got == want or got[-1] == 63
+
+
+def test_max_pending_bounds_admission(params):
+    """With all slots busy and the waiting line at max_pending, submit
+    raises QueueFull; capacity freed by completion re-opens admission."""
+    from nos_tpu.models.serving import QueueFull
+
+    srv = DecodeServer(params, CFG, max_batch=1, max_pending=1)
+    first = srv.submit([1, 2, 3], 30)
+    srv.step()                       # first occupies the only slot
+    srv.submit([4, 5], 30)           # fills the single waiting spot
+    with pytest.raises(QueueFull, match="max_pending=1"):
+        srv.submit([6], 2)
+    results = srv.drain()            # everything completes
+    assert len(results) == 2 and first in results
+    srv.submit([7], 2)               # queue drained: admission re-opens
