@@ -128,6 +128,14 @@ class ServingLoop:
         self.m_abandoned = reg.counter(
             "nos_tpu_serve_abandoned_total",
             "Requests that finished after their client timed out")
+        self.m_rejected = reg.counter(
+            "nos_tpu_serve_rejected_total",
+            "Requests shed at admission (QueueFull -> 429)")
+        self.g_active = reg.gauge(
+            "nos_tpu_serve_active_slots", "Slots decoding right now")
+        self.g_pending = reg.gauge(
+            "nos_tpu_serve_pending_requests",
+            "Requests waiting for a slot")
         self.m_prefix_hits = reg.gauge(
             "nos_tpu_serve_prefix_hits",
             "Prefill requests served from the prefix cache")
@@ -233,17 +241,25 @@ class ServingLoop:
                 self._abandoned.discard(rid)
             else:
                 self._abandoned.add(rid)
+            # cancel mutated occupancy and the ticker may never run again
+            # on an idle server — re-mirror here or the gauges stay stale
+            self._mirror_prefix_gauges()
 
     def _mirror_prefix_gauges(self) -> None:
-        """Engine-held prefix-cache stats -> gauges. Called after every
-        decode tick AND every submit: a prefill-only request
-        (max_new_tokens=1) completes without the ticker ever running, so
-        tick-time mirroring alone would leave /metrics stale forever on
-        an idle server."""
+        """Engine-held stats -> gauges. Called after every decode tick
+        AND every submit: a prefill-only request (max_new_tokens=1)
+        completes without the ticker ever running, so tick-time
+        mirroring alone would leave /metrics stale forever on an idle
+        server."""
         hits = getattr(self.engine, "prefix_hits", None)
         if hits is not None:
             self.m_prefix_hits.set(hits)
             self.m_prefix_saved.set(self.engine.prefix_tokens_saved)
+        occupancy = getattr(self.engine, "occupancy", None)
+        if occupancy is not None:
+            active, pending = occupancy()
+            self.g_active.set(active)
+            self.g_pending.set(pending)
 
     def stream(self, prompt, max_new_tokens, timeout: float = 300.0,
                **sampling):
@@ -260,7 +276,11 @@ class ServingLoop:
             if self._draining:
                 raise DrainingError(
                     "server is draining (terminating); retry elsewhere")
-            rid = self.engine.submit(prompt, max_new_tokens, **sampling)
+            try:
+                rid = self.engine.submit(prompt, max_new_tokens, **sampling)
+            except QueueFull:
+                self.m_rejected.inc()
+                raise
             self._mirror_prefix_gauges()
             self._work.notify_all()
 

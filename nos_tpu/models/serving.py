@@ -48,7 +48,7 @@ class QueueFull(RuntimeError):
     own type so the HTTP layer can answer 429 (shed load, retry) rather
     than a generic 500."""
 
-__all__ = ["DecodeServer"]
+__all__ = ["DecodeServer", "QueueFull"]
 
 
 def _bucket(n: int) -> int:
@@ -603,6 +603,11 @@ class DecodeServer:
             if req.rid == rid:
                 return [], False
         return None
+
+    def occupancy(self) -> tuple:
+        """(active slots, waiting requests) — the live load view the
+        serving loop mirrors into gauges."""
+        return len(self._active), len(self._pending)
 
     def has_work(self) -> bool:
         return bool(self._active or self._pending)

@@ -551,3 +551,63 @@ def test_queue_full_is_http_429():
     finally:
         httpd.shutdown()
         loop.shutdown()
+
+
+def test_occupancy_and_rejection_metrics():
+    from nos_tpu.models.serving import QueueFull
+    from nos_tpu.utils.metrics import default_registry
+
+    reg = default_registry()
+    rej0 = reg.counter("nos_tpu_serve_rejected_total", "x").value()
+
+    class Bounded(_FakeEngine):
+        def submit(self, prompt, n, **kw):
+            if len(self.pending) >= 1:
+                raise QueueFull("full (max_pending=1)")
+            return super().submit(prompt, n, **kw)
+
+        def occupancy(self):
+            return 0, len(self.pending)
+
+        def step(self):
+            return 0
+
+    eng = Bounded()
+    loop = ServingLoop(eng)
+    try:
+        gen = loop.stream([1], 2)
+        assert reg.gauge("nos_tpu_serve_pending_requests", "x").value() == 1
+        with pytest.raises(QueueFull):
+            loop.stream([2], 2)
+        assert reg.counter("nos_tpu_serve_rejected_total",
+                           "x").value() == rej0 + 1
+        gen.close()
+    finally:
+        loop.shutdown()
+
+
+def test_gauges_remirror_after_disconnect_cancel():
+    """A client disconnect on an idle server must not leave the
+    occupancy gauges stuck at the pre-cancel values."""
+    from nos_tpu.utils.metrics import default_registry
+
+    class Cancelable(_FakeEngine):
+        def occupancy(self):
+            return 0, len(self.pending)
+
+        def cancel(self, rid):
+            return self.pending.pop(rid, None) is not None
+
+        def step(self):
+            return 0                      # nothing ever completes
+
+    reg = default_registry()
+    eng = Cancelable()
+    loop = ServingLoop(eng)
+    try:
+        gen = loop.stream([1], 4)
+        assert reg.gauge("nos_tpu_serve_pending_requests", "x").value() == 1
+        gen.close()                       # disconnect -> cancel -> forget
+        assert reg.gauge("nos_tpu_serve_pending_requests", "x").value() == 0
+    finally:
+        loop.shutdown()
