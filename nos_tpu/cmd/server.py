@@ -149,6 +149,8 @@ class ServingLoop:
         self._draining = False
         self._failed: Optional[BaseException] = None
         self._abandoned: set = set()        # rids whose client timed out
+        self.m_rejected.inc(0)          # export 0, not an absent series
+        self._mirror_engine_gauges()
         self._thread = threading.Thread(target=self._run, daemon=True)
         self._thread.start()
 
@@ -192,7 +194,7 @@ class ServingLoop:
                     emitted = self.engine.step()
                     self.m_ticks.inc()
                     self.m_tokens.inc(emitted)
-                    self._mirror_prefix_gauges()
+                    self._mirror_engine_gauges()
                 except BaseException as e:   # decode tick died: go unhealthy
                     logger.exception("decode tick failed; marking unhealthy")
                     self._failed = e
@@ -243,14 +245,15 @@ class ServingLoop:
                 self._abandoned.add(rid)
             # cancel mutated occupancy and the ticker may never run again
             # on an idle server — re-mirror here or the gauges stay stale
-            self._mirror_prefix_gauges()
+            self._mirror_engine_gauges()
 
-    def _mirror_prefix_gauges(self) -> None:
-        """Engine-held stats -> gauges. Called after every decode tick
-        AND every submit: a prefill-only request (max_new_tokens=1)
-        completes without the ticker ever running, so tick-time
-        mirroring alone would leave /metrics stale forever on an idle
-        server."""
+    def _mirror_engine_gauges(self) -> None:
+        """Engine-held stats (prefix cache, occupancy) -> gauges.
+        Called from every path that mutates them — submit, decode tick,
+        and disconnect-cancel (_forget) — plus once at startup: a
+        prefill-only request completes without the ticker ever running,
+        a cancel on an idle server never ticks again, and a fresh pod
+        must export 0s, not absent series."""
         hits = getattr(self.engine, "prefix_hits", None)
         if hits is not None:
             self.m_prefix_hits.set(hits)
@@ -281,7 +284,7 @@ class ServingLoop:
             except QueueFull:
                 self.m_rejected.inc()
                 raise
-            self._mirror_prefix_gauges()
+            self._mirror_engine_gauges()
             self._work.notify_all()
 
         def deltas():

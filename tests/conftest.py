@@ -29,6 +29,20 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 import pytest  # noqa: E402
 
 
+@pytest.fixture(autouse=True, scope="module")
+def _bound_live_xla_programs():
+    """Free compiled XLA programs between test MODULES. The suite
+    compiles thousands of programs into one process; holding them all
+    live has segfaulted XLA:CPU's compiler late in the run (observed
+    deterministically at ~600 tests in: the crash lands inside
+    backend_compile_and_load on the next big pjit, both halves of the
+    suite green in isolation — purely cumulative native state). Dropping
+    cache entries at module boundaries bounds the live set; anything a
+    later module needs simply recompiles."""
+    yield
+    jax.clear_caches()
+
+
 class _Cluster:
     """Minimal wired cluster (apiserver + operator + scheduler) for tests
     that need the control plane but not the partitioning/agent layers."""
