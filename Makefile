@@ -29,6 +29,14 @@ bench-decode:  ## KV-cache decode throughput, bf16 and int8.
 bench-serve:  ## Continuous-batching serving throughput.
 	$(PYTHON) bench_serve.py
 
+.PHONY: bench-infer
+bench-infer:  ## 7-tenant YOLOS-family inference latency (the reference's headline scenario).
+	$(PYTHON) bench_infer.py
+
+.PHONY: e2e
+e2e:  ## Scripted kind e2e (skips without a container runtime).
+	hack/kind/run-e2e.sh
+
 .PHONY: native
 native:  ## Build the tpuagent C++ device layer.
 	$(MAKE) -C native/tpuagent
