@@ -24,7 +24,8 @@ from dataclasses import dataclass, fields
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional, Sequence
 
-from nos_tpu.models.serving import QueueFull
+from nos_tpu.models.errors import QueueFull  # jax-free module: keeps this
+                                             # file importable without jax
 from nos_tpu.utils.metrics import default_registry
 
 logger = logging.getLogger("nos_tpu.server")
@@ -87,6 +88,13 @@ class ServerConfig:
     # then exit — the Kubernetes termination contract. Keep it under
     # the pod's terminationGracePeriodSeconds.
     drain_timeout_s: float = 30.0
+    # per-socket read/write timeout. daemon_threads=False means process
+    # exit JOINS handler threads; without a socket timeout a thread
+    # blocked reading a stalled client's request body would outlive the
+    # drain budget indefinitely (only SIGKILL would end it). Any blocking
+    # socket op now fails within this bound, so exit is bounded by
+    # drain_timeout_s + socket_timeout_s.
+    socket_timeout_s: float = 30.0
 
     @classmethod
     def from_yaml_file(cls, path: str) -> "ServerConfig":
@@ -444,6 +452,12 @@ def build_engine(cfg: ServerConfig):
 def make_http_server(cfg: ServerConfig, loop: ServingLoop
                      ) -> ThreadingHTTPServer:
     class Handler(BaseHTTPRequestHandler):
+        # http.server applies this to the connection socket in setup();
+        # a stalled read/write raises TimeoutError instead of pinning a
+        # non-daemon handler thread past the drain window (see
+        # ServerConfig.socket_timeout_s)
+        timeout = cfg.socket_timeout_s or None
+
         def log_message(self, fmt, *args):      # route through logging
             logger.debug("http: " + fmt, *args)
 
@@ -580,8 +594,11 @@ def make_http_server(cfg: ServerConfig, loop: ServingLoop
         # be between its last wakeup and the socket write — non-daemon
         # threads make interpreter exit wait for that write instead of
         # killing it (the connection-reset the drain exists to prevent).
-        # Bounded: loop.shutdown() fails any still-waiting request, so
-        # these threads exit within ~1s of the main loop's finally.
+        # Bounded: loop.shutdown() fails any still-waiting request, and
+        # Handler.timeout bounds threads blocked on the socket itself
+        # (e.g. reading a stalled client's request body), so every
+        # handler thread exits within ~socket_timeout_s of the main
+        # loop's finally.
         daemon_threads = False
 
     return Server(("0.0.0.0", cfg.port), Handler)

@@ -96,6 +96,14 @@ class TrainerConfig:
     # step, checkpoint, and exit cleanly so the rescheduled gang resumes
     # from the signal, not from the last periodic save
     handle_sigterm: bool = True
+    # multi-host only: the stop flag and the time-cadence verdict must be
+    # agreed collectively (allgather/broadcast), and a per-step host sync
+    # can serialize JAX's async dispatch on fast steps. Agree every N
+    # steps instead — one fused allgather carries both flags. N=8 keeps
+    # detection lag ~8 step times, well inside a 30s grace period for
+    # any real training step; single-host polls its local flag for free
+    # every step regardless.
+    host_sync_every: int = 8
     # profiling: when set, a jax.profiler trace of steps [profile_start,
     # profile_start+profile_steps) is written here (viewable in
     # TensorBoard/XProf — the TPU tracing story)
@@ -301,6 +309,8 @@ def train(cfg: TrainerConfig, stop_event=None) -> float:
 
     will_install = cfg.handle_sigterm and \
         threading.current_thread() is threading.main_thread()
+    fused_sync = None           # multi-host only; None => local flag path
+    stop_requested = None
     if jax.process_count() > 1:
         # The allgather is a COLLECTIVE: every process must run it or
         # none, and they must decide identically — so the decision keys
@@ -311,16 +321,25 @@ def train(cfg: TrainerConfig, stop_event=None) -> float:
             # gang workers may receive SIGTERM steps apart; a per-process
             # flag would make the early breaker abandon the collective
             # step/save its peers are still in and deadlock everyone
-            # until SIGKILL. Agree every step: a one-int32-per-process
-            # allgather — noise next to a training step — so all workers
-            # bank the SAME step together.
+            # until SIGKILL. Agree on a step-keyed cadence (every
+            # cfg.host_sync_every steps — deterministic from gang-wide
+            # config, so all processes sync together): ONE two-int32
+            # allgather per sync carries both the stop flag and the
+            # time-cadence checkpoint verdict, so all workers bank the
+            # SAME step together without a per-step host round-trip
+            # stalling async dispatch.
             import numpy as np
             from jax.experimental import multihost_utils
 
-            def stop_requested() -> bool:
-                flags = multihost_utils.process_allgather(
-                    np.asarray(stop.is_set(), np.int32))
-                return bool(np.asarray(flags).any())
+            def fused_sync(due_local: bool):
+                """One collective for both per-cadence questions: did ANY
+                process see SIGTERM, and is a time-cadence save due by
+                process 0's clock (clocks differ per host, so rank 0
+                arbitrates)."""
+                flags = np.asarray(multihost_utils.process_allgather(
+                    np.asarray([stop.is_set(), due_local], np.int32)))
+                flags = flags.reshape(-1, 2)
+                return bool(flags[:, 0].any()), bool(flags[0, 1])
         elif stop_event is not None:
             raise ValueError(
                 "stop_event on a multi-host run requires handle_sigterm: "
@@ -404,7 +423,21 @@ def train(cfg: TrainerConfig, stop_event=None) -> float:
                 jax.profiler.stop_trace()
                 profiling = False
                 logger.info("profiler trace written to %s", cfg.profile_dir)
-            if stop_requested():
+            sync_now = ((step + 1) % max(cfg.host_sync_every, 1) == 0
+                        or step + 1 == cfg.steps)
+            due_by_time = None      # resolved below on the local path
+            if fused_sync is not None:
+                if sync_now:
+                    due_local = (
+                        ckpt is not None and cfg.checkpoint_every_s > 0
+                        and time.perf_counter() - last_save_t
+                        >= cfg.checkpoint_every_s)
+                    stop_now, due_by_time = fused_sync(due_local)
+                else:
+                    stop_now, due_by_time = False, False
+            else:
+                stop_now = stop_requested()
+            if stop_now:
                 # preemption: bank the step just completed (synchronous —
                 # the grace period is short, so this runs BEFORE eval and
                 # the periodic save, not after) and leave. The state is
@@ -450,16 +483,22 @@ def train(cfg: TrainerConfig, stop_event=None) -> float:
                 g_eval.set(mean)
                 logger.info("step %d eval loss %.4f (%d batches)",
                             step + 1, mean, cfg.eval_steps)
-            due_by_time = (ckpt is not None and cfg.checkpoint_every_s > 0
-                           and time.perf_counter() - last_save_t
-                           >= cfg.checkpoint_every_s)
-            if time_cadence_collective:
-                # the save is a COLLECTIVE (orbax sharded write): clocks
-                # differ per host, so process 0's verdict is broadcast —
-                # config-gated (ckpt configured + every_s set + multi-
-                # host), so every process runs this collective or none
-                due_by_time = bool(
-                    _mh_utils.broadcast_one_to_all(_np.asarray(due_by_time)))
+            if due_by_time is None:
+                # local path: single-host, or multi-host without the
+                # SIGTERM fused sync (handle_sigterm: false)
+                due_by_time = (ckpt is not None
+                               and cfg.checkpoint_every_s > 0
+                               and time.perf_counter() - last_save_t
+                               >= cfg.checkpoint_every_s)
+                if time_cadence_collective:
+                    # the save is a COLLECTIVE (orbax sharded write):
+                    # clocks differ per host, so process 0's verdict is
+                    # broadcast — on the same step-keyed cadence as the
+                    # fused path (sync_now is deterministic gang-wide, so
+                    # the short-circuit is identical on every process)
+                    due_by_time = sync_now and bool(
+                        _mh_utils.broadcast_one_to_all(
+                            _np.asarray(due_by_time)))
             if ckpt is not None and (
                     (step + 1) % cfg.checkpoint_every == 0 or due_by_time):
                 # async: serialization overlaps the next steps' compute

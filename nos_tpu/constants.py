@@ -71,6 +71,18 @@ LABEL_GANG_NAME = DOMAIN + "/gang-name"
 LABEL_GANG_SIZE = DOMAIN + "/gang-size"
 LABEL_GANG_WORKER = DOMAIN + "/gang-worker"
 ANNOTATION_TPU_TOPOLOGY = DOMAIN + "/tpu-topology"
+# Multislice (gang-of-gangs): a JobSet spanning N DCN-connected slices.
+# Each slice's pods form a normal gang (labels above, gang-name unique per
+# slice); the jobset labels tie the N gangs into one co-atomic admission
+# unit — no gang binds unless every slice's gang has a feasible, DISTINCT
+# ICI domain (dp/fsdp ride DCN between slices; tp/sp/ep/pp never leave a
+# slice's ICI — the parallel/layout.py + parallel/mesh.py contract):
+#   nos.ai/jobset-name:   the JobSet this gang belongs to
+#   nos.ai/jobset-slices: total slice (gang) count N
+#   nos.ai/jobset-slice:  this pod's slice index 0..N-1
+LABEL_JOBSET_NAME = DOMAIN + "/jobset-name"
+LABEL_JOBSET_SLICES = DOMAIN + "/jobset-slices"
+LABEL_JOBSET_SLICE = DOMAIN + "/jobset-slice"
 
 CAPACITY_IN_QUOTA = "in-quota"
 CAPACITY_OVER_QUOTA = "over-quota"

@@ -1,0 +1,16 @@
+"""Serving-plane exception types, deliberately jax-free.
+
+``nos_tpu.cmd.server`` keeps jax out of module import (build_engine and
+friends import it lazily) so the binary can parse config / print help in
+a jax-less environment; exception types it catches must live in a module
+with the same property.
+"""
+
+
+class QueueFull(RuntimeError):
+    """Admission refused: the pending queue is at ``max_pending``. Its
+    own type so the HTTP layer can answer 429 (shed load, retry) rather
+    than a generic 500."""
+
+
+__all__ = ["QueueFull"]

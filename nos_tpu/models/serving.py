@@ -43,10 +43,8 @@ from nos_tpu.models.generate import (
 from nos_tpu.models.transformer import Params, TransformerConfig
 
 
-class QueueFull(RuntimeError):
-    """Admission refused: the pending queue is at ``max_pending``. Its
-    own type so the HTTP layer can answer 429 (shed load, retry) rather
-    than a generic 500."""
+from nos_tpu.models.errors import QueueFull  # noqa: F401 — canonical home
+                                             # is jax-free (see errors.py)
 
 __all__ = ["DecodeServer", "QueueFull"]
 

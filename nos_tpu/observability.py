@@ -50,6 +50,11 @@ GANGS_PLACED = _r.counter(
     "nos_scheduler_gangs_placed_total",
     "Multi-host gangs placed atomically.",
 )
+JOBSETS_PLACED = _r.counter(
+    "nos_scheduler_jobsets_placed_total",
+    "Multislice JobSets (gangs of gangs) placed co-atomically across "
+    "distinct ICI domains.",
+)
 
 # --- node agent -------------------------------------------------------
 AGENT_REPORTS = _r.counter(

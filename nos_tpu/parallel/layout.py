@@ -80,6 +80,36 @@ class ParallelLayout:
             return None
         return gen.hosts_for(topo)
 
+    # ------------------------------------------------------------------
+    def per_slice(self, n_slices: int) -> "ParallelLayout":
+        """The layout each slice of an ``n_slices``-slice multislice job
+        runs — the scheduler-side contract behind the jobset labels: only
+        the leading DATA axes (dp, then fsdp) may cross DCN, so the slice
+        count must divide them; every other axis (tp/pp/sp/ep) stays
+        whole inside each slice's ICI. ``per_slice(...).required_topology``
+        is what every slice's gang annotation carries (identical across
+        slices — slices are interchangeable dp replicas), and
+        parallel/mesh.py's arrange_devices enforces the same boundary
+        when the job lays its mesh over the multislice device set."""
+        if n_slices < 1:
+            raise ValueError("n_slices must be >= 1")
+        from dataclasses import replace
+
+        if self.dp % n_slices == 0:
+            return replace(self, dp=self.dp // n_slices)
+        if self.dp * self.fsdp % n_slices == 0:
+            # dp contributes all of itself; fsdp covers the rest. Only
+            # legal when the boundary still lands between fsdp shards:
+            # slices = dp * k with k dividing fsdp.
+            k = n_slices // self.dp
+            if self.dp * k == n_slices and self.fsdp % k == 0:
+                return replace(self, dp=1, fsdp=self.fsdp // k)
+        raise ValueError(
+            f"cannot span {n_slices} slices: only data axes cross DCN and "
+            f"dp x fsdp = {self.dp} x {self.fsdp} is not divisible into "
+            f"{n_slices} slices with whole fsdp shards — model axes "
+            f"(tp/pp/sp/ep) must stay inside one slice's ICI")
+
 
 def layout_for_chips(chips: int, *, prefer_tp_up_to: int = 8) -> ParallelLayout:
     """A sensible default layout for a chip budget: tensor-parallel within a

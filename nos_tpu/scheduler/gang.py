@@ -76,6 +76,39 @@ def required_topology_name(pod: Pod) -> Optional[str]:
     return pod.metadata.annotations.get(constants.ANNOTATION_TPU_TOPOLOGY)
 
 
+# ---------------------------------------------------------------------------
+# Multislice JobSets: a gang of gangs. Each slice's pods are a normal gang
+# (one ICI domain); the jobset labels tie N slices into one co-atomic
+# admission unit placed on N DISTINCT domains — dp/fsdp cross slices over
+# DCN, every model axis (tp/sp/ep/pp) stays on one slice's ICI, which is
+# exactly the boundary parallel/mesh.py's arrange_devices enforces on the
+# workload side.
+
+
+def jobset_key(pod: Pod) -> Optional[GangKey]:
+    name = pod.metadata.labels.get(constants.LABEL_JOBSET_NAME)
+    if not name:
+        return None
+    return GangKey(pod.metadata.namespace, name)
+
+
+def jobset_slices(pod: Pod) -> Optional[int]:
+    try:
+        return int(pod.metadata.labels.get(constants.LABEL_JOBSET_SLICES, ""))
+    except ValueError:
+        return None
+
+
+def jobset_slice(pod: Pod) -> Optional[int]:
+    """None on a missing/malformed label — surfaced as an admission error
+    (silently filing the pod under slice 0 would wedge the jobset with a
+    rejection blaming the wrong slice)."""
+    try:
+        return int(pod.metadata.labels.get(constants.LABEL_JOBSET_SLICE, ""))
+    except ValueError:
+        return None
+
+
 @dataclass(frozen=True)
 class GangAdmission:
     """Typed admission verdict. Iterable as (ok, reason) for the common
@@ -119,12 +152,16 @@ class GangScheduler:
         return members
 
     # ------------------------------------------------------------------
-    def admit(self, members: List[Pod]) -> "GangAdmission":
+    def admit(self, members: List[Pod],
+              check_quota: bool = True) -> "GangAdmission":
         """Gang-level admission: completeness, consistent declaration,
         topology validity, quota bounds on the aggregate request.
         ``waiting`` marks the not-yet-complete case (more members expected)
         as distinct from a hard rejection — metric/backoff classification
-        must not parse the human-readable reason."""
+        must not parse the human-readable reason. ``check_quota=False``
+        defers the quota bound to a caller holding a LARGER atomic unit
+        (admit_jobset checks the union of all slices at once — per-slice
+        checks could each pass while the union busts the max)."""
         if not members:
             return GangAdmission(False, "empty gang")
         declared = gang_size(members[0])
@@ -153,35 +190,51 @@ class GangScheduler:
         # the scheduler's state sync has already tracked their requests
         # into QuotaInfo.used, so adding them again would double-count and
         # wedge the gang the recovery path in place() exists to finish.
-        if self.capacity is not None:
-            total: ResourceList = {}
-            for p in members:
-                if p.spec.node_name:
-                    continue
-                total = add_resources(
-                    total, self.capacity.calc.compute_pod_request(p)
-                )
-            info = self.capacity.quotas.get(members[0].metadata.namespace)
-            if info is not None:
-                if info.used_over_max_with(total):
-                    return GangAdmission(False, "gang would exceed max quota")
-                if self.capacity.quotas.aggregated_used_over_min_with(total):
-                    return GangAdmission(
-                        False, "gang would exceed aggregated min quota")
+        if check_quota:
+            verdict = self._quota_admit(members)
+            if verdict is not None:
+                return verdict
         return GangAdmission(True, "")
+
+    def _quota_admit(self, members: List[Pod]) -> Optional["GangAdmission"]:
+        """Quota bound on the aggregate unbound request of ``members``
+        (one gang, or every slice of a jobset). None = admitted."""
+        if self.capacity is None:
+            return None
+        total: ResourceList = {}
+        for p in members:
+            if p.spec.node_name:
+                continue
+            total = add_resources(
+                total, self.capacity.calc.compute_pod_request(p)
+            )
+        info = self.capacity.quotas.get(members[0].metadata.namespace)
+        if info is not None:
+            if info.used_over_max_with(total):
+                return GangAdmission(False, "gang would exceed max quota")
+            if self.capacity.quotas.aggregated_used_over_min_with(total):
+                return GangAdmission(
+                    False, "gang would exceed aggregated min quota")
+        return None
 
     # ------------------------------------------------------------------
     def place(
-        self, members: List[Pod], snapshot: fw.Snapshot
+        self, members: List[Pod], snapshot: fw.Snapshot,
+        exclude_pools: frozenset = frozenset(),
     ) -> Tuple[Optional[GangPlacement], str]:
         """Find an ICI domain hosting the whole gang. ``members`` is the
         FULL gang in worker order; already-bound members (crash recovery
         after a partial bind) pin the search to their domain and keep their
-        worker-indexed hosts. Returns a placement covering only the unbound
+        worker-indexed hosts. ``exclude_pools`` removes domains already
+        claimed by sibling slices of a jobset (each slice needs its OWN
+        ICI domain). Returns a placement covering only the unbound
         members, or (None, reason)."""
         topo_name = required_topology_name(members[0])
         nodes = [ni.node for ni in snapshot.values()]
         domains = group_ici_domains(nodes)
+        if exclude_pools:
+            domains = {p: d for p, d in domains.items()
+                       if p not in exclude_pools}
         bound = {
             gang_worker(p): p.spec.node_name for p in members if p.spec.node_name
         }
@@ -239,6 +292,112 @@ class GangScheduler:
         if not matching:
             return None, f"no ICI domain supporting topology {topo_name!r} exists"
         return None, "; ".join(reasons) or "no feasible ICI domain"
+
+    # ------------------------------------------------------------------
+    # Multislice JobSets (gang of gangs)
+
+    def collect_jobset(
+        self, pods: List[Pod], key: GangKey
+    ) -> Dict[int, List[Pod]]:
+        """Slice index -> that slice's members in worker order."""
+        slices: Dict[int, List[Pod]] = {}
+        for p in pods:
+            if jobset_key(p) == key:
+                idx = jobset_slice(p)
+                # malformed slice labels collect under -1 so admit_jobset
+                # can reject NAMING the problem instead of mis-filing the
+                # pod into slice 0 and blaming that slice's size
+                slices.setdefault(-1 if idx is None else idx, []).append(p)
+        for members in slices.values():
+            members.sort(key=gang_worker)
+        return slices
+
+    def admit_jobset(
+        self, slices: Dict[int, List[Pod]]
+    ) -> GangAdmission:
+        """Co-atomic admission across every slice of the jobset: all N
+        slices present and individually gang-complete, every slice
+        declaring the SAME topology and size (the dp-over-DCN contract —
+        slices are interchangeable dp replicas, so their within-slice
+        layouts must be identical), and the quota bound checked once on
+        the UNION of all slices (per-slice checks could each pass while
+        the union busts the max)."""
+        if not slices:
+            return GangAdmission(False, "empty jobset")
+        if -1 in slices:
+            bad = [p.metadata.name for p in slices[-1]]
+            return GangAdmission(
+                False,
+                f"missing or invalid {constants.LABEL_JOBSET_SLICE} label "
+                f"on: {', '.join(sorted(bad))}")
+        any_pod = next(iter(slices.values()))[0]
+        declared = jobset_slices(any_pod)
+        if declared is None:
+            return GangAdmission(
+                False, "missing or invalid jobset-slices label")
+        all_pods = [p for ms in slices.values() for p in ms]
+        if any(jobset_slices(p) != declared for p in all_pods):
+            return GangAdmission(
+                False, "jobset members disagree on jobset-slices")
+        if len(slices) < declared:
+            return GangAdmission(
+                False,
+                f"waiting for jobset: {len(slices)}/{declared} slices have "
+                f"members",
+                waiting=True,
+            )
+        if sorted(slices) != list(range(declared)):
+            return GangAdmission(
+                False,
+                f"jobset slice indexes {sorted(slices)} != 0..{declared - 1}")
+        for idx in range(declared):
+            verdict = self.admit(slices[idx], check_quota=False)
+            if not verdict.ok:
+                return GangAdmission(
+                    verdict.ok, f"slice {idx}: {verdict.reason}",
+                    waiting=verdict.waiting)
+        topo = required_topology_name(slices[0][0])
+        sizes = {gang_size(ms[0]) for ms in slices.values()}
+        topos = {required_topology_name(ms[0]) for ms in slices.values()}
+        if len(topos) > 1 or len(sizes) > 1:
+            return GangAdmission(
+                False,
+                f"slices must be identical dp replicas (dp rides DCN; "
+                f"model axes stay on ICI): got topologies {sorted(topos)}, "
+                f"sizes {sorted(sizes)} — expected one topology {topo!r}")
+        verdict = self._quota_admit(all_pods)
+        if verdict is not None:
+            return verdict
+        return GangAdmission(True, "")
+
+    def place_jobset(
+        self, slices: Dict[int, List[Pod]], snapshot: fw.Snapshot
+    ) -> Tuple[Optional[List[GangPlacement]], str]:
+        """One GangPlacement per slice (slice order), each on a DISTINCT
+        ICI domain, or (None, reason). Because admit_jobset enforced that
+        all slices are identical, the greedy slice-by-slice search with
+        claimed domains excluded is complete: any slice fits any feasible
+        domain, so an assignment exists iff N distinct feasible domains
+        exist. Already-bound slices (crash recovery) pin their domain via
+        the normal bound-worker path and claim it first so an unbound
+        sibling cannot steal it."""
+        placements: List[Optional[GangPlacement]] = [None] * len(slices)
+        claimed: set = set()
+        # bound slices first: their domain is already spoken for
+        order = sorted(
+            slices,
+            key=lambda i: (not any(p.spec.node_name for p in slices[i]), i))
+        for idx in order:
+            placement, why = self.place(
+                slices[idx], snapshot, exclude_pools=frozenset(claimed))
+            if placement is None:
+                return None, (
+                    f"slice {idx} "
+                    f"({len(claimed)} sibling slice(s) already hold "
+                    f"{sorted(claimed)}): {why}")
+            placements[idx] = placement
+            claimed.add(placement.domain.pool)
+        return placements, ""  # type: ignore[return-value]
 
     def _free_hosts_after(
         self, domain: IciDomain, placement: GangPlacement, snapshot: fw.Snapshot

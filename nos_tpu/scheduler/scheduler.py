@@ -23,7 +23,7 @@ from nos_tpu.kube.objects import Pod, PodCondition, deep_copy
 from nos_tpu.scheduler import framework as fw
 from nos_tpu.scheduler.cache import ClusterCache
 from nos_tpu.scheduler.capacity import CapacityScheduling
-from nos_tpu.scheduler.gang import GangScheduler, gang_key
+from nos_tpu.scheduler.gang import GangScheduler, gang_key, jobset_key
 from nos_tpu.tpu.resource_calc import ResourceCalculator
 
 logger = logging.getLogger(__name__)
@@ -140,11 +140,19 @@ class Scheduler:
                 )
             ]
             for pod in pods:
-                gk = gang_key(pod)
-                if gk is not None:
-                    if gk in seen_gangs:
+                # a jobset (gang of gangs) is attempted once per pass,
+                # like a gang — keyed by the jobset, not the slice gang
+                jk = jobset_key(pod)
+                if jk is not None:
+                    if ("jobset", jk) in seen_gangs:
                         continue
-                    seen_gangs.add(gk)
+                    seen_gangs.add(("jobset", jk))
+                else:
+                    gk = gang_key(pod)
+                    if gk is not None:
+                        if gk in seen_gangs:
+                            continue
+                        seen_gangs.add(gk)
                 r = self._schedule_one(client, pod, snapshot)
                 result.requeue = result.requeue or r.requeue
         except BaseException:
@@ -174,6 +182,8 @@ class Scheduler:
             obs.SCHEDULE_DURATION.observe(time.monotonic() - started)
 
     def _schedule_one_inner(self, client: Client, pod: Pod, snapshot: fw.Snapshot) -> Result:
+        if jobset_key(pod) is not None:
+            return self._schedule_jobset(client, pod, snapshot)
         if gang_key(pod) is not None:
             return self._schedule_gang(client, pod, snapshot)
         state: fw.CycleState = {}
@@ -279,6 +289,75 @@ class Scheduler:
             "gang %s/%s: placed %d workers on ICI domain %s at host offset %s",
             key.namespace, key.name, len(placement.pods),
             placement.domain.pool, placement.offset,
+        )
+        return Result()
+
+    # ------------------------------------------------------------------
+    def _schedule_jobset(self, client: Client, pod: Pod,
+                         snapshot: fw.Snapshot) -> Result:
+        """Co-atomic placement of a multislice JobSet: every slice's gang
+        gets a feasible, DISTINCT ICI domain or nothing binds — the
+        all-or-nothing contract lifted one level (a jobset holding K of N
+        slices would deadlock the DCN collective exactly like a partial
+        gang deadlocks an ICI one)."""
+        key = jobset_key(pod)
+        slices = self.gang.collect_jobset(
+            self.cache.list("Pod", namespace=key.namespace), key)
+        all_members = [p for ms in slices.values() for p in ms]
+        pending = [p for p in all_members
+                   if not p.spec.node_name and p.status.phase == "Pending"]
+        if not pending:
+            return Result()
+
+        admission = self.gang.admit_jobset(slices)
+        if not admission.ok:
+            obs.SCHEDULE_ATTEMPTS.labels(
+                "gang_wait" if admission.waiting else "unschedulable"
+            ).inc()
+            for p in pending:
+                self._mark_unschedulable(client, p, admission.reason)
+            return Result()
+
+        placements, why = self.gang.place_jobset(slices, snapshot)
+        if placements is None:
+            obs.SCHEDULE_ATTEMPTS.labels("unschedulable").inc()
+            for p in pending:
+                self._mark_unschedulable(
+                    client, p, f"jobset unplaceable: {why}")
+            return Result()
+
+        pairs = [(m, n) for pl in placements
+                 for m, n in zip(pl.pods, pl.nodes)]
+        reserved = []
+        for member, node_name in pairs:
+            st = self.framework.run_reserve({}, member, node_name)
+            if not st.success:
+                for m, n in reserved:
+                    self.framework.run_unreserve({}, m, n)
+                obs.SCHEDULE_ATTEMPTS.labels("unschedulable").inc()
+                for p in pending:
+                    self._mark_unschedulable(client, p, st.reason)
+                return Result()
+            reserved.append((member, node_name))
+
+        for member, node_name in pairs:
+            def bind(p: Pod, n=node_name):
+                p.spec.node_name = n
+                p.status.conditions = [
+                    c for c in p.status.conditions if c.type != "PodScheduled"
+                ] + [PodCondition(type="PodScheduled", status="True")]
+
+            bound = client.patch("Pod", member.metadata.name,
+                                 member.metadata.namespace, bind)
+            snapshot[node_name].add_pod(bound)
+            self.cache.upsert("Pod", bound)
+        obs.JOBSETS_PLACED.inc()
+        obs.GANGS_PLACED.inc(len(placements))
+        obs.SCHEDULE_ATTEMPTS.labels("bound").inc(len(pairs))
+        logger.info(
+            "jobset %s/%s: placed %d slices (%d workers) on ICI domains %s",
+            key.namespace, key.name, len(placements), len(pairs),
+            [pl.domain.pool for pl in placements],
         )
         return Result()
 

@@ -87,29 +87,26 @@ class CheckpointManager:
     # model-config stamp: architecture dims written next to the step
     # checkpoints so a consumer (generate/server/resume) mismatching the
     # saved shapes fails with a named field, not an orbax shape error.
-    # Local directories only — URI stores skip silently (the stamp is a
-    # convenience, never a gate on the checkpoint itself).
+    # I/O goes through etils.epath — the SAME storage layer orbax uses —
+    # so gs://... directories (the shared-storage cross-slice resume
+    # case, where drift protection matters most) are stamped too, not
+    # silently skipped.
 
-    def _stamp_path(self) -> Optional[str]:
-        import os
+    def _stamp_path(self):
+        from etils import epath
 
-        if "://" in self.directory:
-            return None
-        return os.path.join(self.directory, "model_config.json")
+        return epath.Path(self.directory) / "model_config.json"
 
     def write_model_config(self, config: dict) -> None:
-        """Idempotently stamp the architecture (atomic write). Raises if
-        a DIFFERENT architecture is already stamped AND checkpoints
-        exist — resuming a run with changed dims corrupts it silently
-        otherwise. A stale stamp with no checkpoint behind it (aborted
-        mis-configured launch) is simply replaced, not a dead-end."""
+        """Idempotently stamp the architecture. Raises if a DIFFERENT
+        architecture is already stamped AND checkpoints exist — resuming
+        a run with changed dims corrupts it silently otherwise. A stale
+        stamp with no checkpoint behind it (aborted mis-configured
+        launch) is simply replaced, not a dead-end."""
         import json
-        import os
 
         path = self._stamp_path()
-        if path is None:
-            return
-        if os.path.exists(path):
+        if path.exists():
             if self.latest() is not None:
                 self.validate_model_config(config)
                 return
@@ -121,21 +118,28 @@ class CheckpointManager:
             # corrected config). Leave unstamped; restore still fails
             # with the orbax shape error as before.
             return
-        os.makedirs(self.directory, exist_ok=True)
-        tmp = path + ".tmp"
-        with open(tmp, "w") as f:
-            json.dump(config, f, indent=1, sort_keys=True)
-        os.replace(tmp, path)
+        path.parent.mkdir(parents=True, exist_ok=True)
+        body = json.dumps(config, indent=1, sort_keys=True)
+        if "://" in self.directory:
+            # object stores commit whole objects atomically; the local
+            # tmp+rename dance has no analog (and epath.rename is a
+            # copy on GCS anyway)
+            path.write_text(body)
+        else:
+            import os
+
+            tmp = os.fspath(path) + ".tmp"
+            with open(tmp, "w") as f:
+                f.write(body)
+            os.replace(tmp, os.fspath(path))
 
     def read_model_config(self) -> Optional[dict]:
         import json
-        import os
 
         path = self._stamp_path()
-        if path is None or not os.path.exists(path):
+        if not path.exists():
             return None
-        with open(path) as f:
-            return json.load(f)
+        return json.loads(path.read_text())
 
     def validate_model_config(self, expect: dict) -> None:
         """No-op when unstamped; raises naming every mismatched field
