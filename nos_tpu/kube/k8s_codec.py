@@ -29,9 +29,12 @@ from nos_tpu.kube.objects import (
     Affinity,
     ConfigMap,
     Container,
+    LabelSelector,
     Node,
     NodeSelectorRequirement,
     NodeSelectorTerm,
+    PodAffinityTerm,
+    TopologySpreadConstraint,
     NodeSpec,
     NodeStatus,
     ObjectMeta,
@@ -216,11 +219,59 @@ def _container_from_k8s(d: dict) -> Container:
     )
 
 
-def _affinity_to_k8s(a: Optional[Affinity]) -> Optional[dict]:
-    if a is None or not a.node_affinity_required:
+def _label_selector_to_k8s(s: Optional[LabelSelector]) -> Optional[dict]:
+    if s is None:
         return None
-    return {
-        "nodeAffinity": {
+    out: dict = {}
+    if s.match_labels:
+        out["matchLabels"] = dict(s.match_labels)
+    if s.match_expressions:
+        out["matchExpressions"] = [
+            {"key": r.key, "operator": r.operator,
+             **({"values": list(r.values)} if r.values else {})}
+            for r in s.match_expressions
+        ]
+    return out       # {} encodes the match-everything empty selector
+
+
+def _label_selector_from_k8s(d: Optional[dict]) -> Optional[LabelSelector]:
+    if d is None:
+        return None  # nil selector: matches nothing (metav1 distinction)
+    return LabelSelector(
+        match_labels=dict(d.get("matchLabels") or {}),
+        match_expressions=[
+            NodeSelectorRequirement(
+                key=e.get("key", ""), operator=e.get("operator", "In"),
+                values=list(e.get("values") or []))
+            for e in (d.get("matchExpressions") or [])
+        ],
+    )
+
+
+def _pod_aff_term_to_k8s(t: PodAffinityTerm) -> dict:
+    out: dict = {"topologyKey": t.topology_key}
+    sel = _label_selector_to_k8s(t.label_selector)
+    if sel is not None:
+        out["labelSelector"] = sel
+    if t.namespaces:
+        out["namespaces"] = list(t.namespaces)
+    return out
+
+
+def _pod_aff_term_from_k8s(d: dict) -> PodAffinityTerm:
+    return PodAffinityTerm(
+        label_selector=_label_selector_from_k8s(d.get("labelSelector")),
+        topology_key=d.get("topologyKey", ""),
+        namespaces=list(d.get("namespaces") or []),
+    )
+
+
+def _affinity_to_k8s(a: Optional[Affinity]) -> Optional[dict]:
+    if a is None:
+        return None
+    out: dict = {}
+    if a.node_affinity_required:
+        out["nodeAffinity"] = {
             "requiredDuringSchedulingIgnoredDuringExecution": {
                 "nodeSelectorTerms": [
                     {"matchExpressions": [
@@ -232,7 +283,18 @@ def _affinity_to_k8s(a: Optional[Affinity]) -> Optional[dict]:
                 ]
             }
         }
-    }
+    if a.pod_affinity_required:
+        out["podAffinity"] = {
+            "requiredDuringSchedulingIgnoredDuringExecution": [
+                _pod_aff_term_to_k8s(t) for t in a.pod_affinity_required]
+        }
+    if a.pod_anti_affinity_required:
+        out["podAntiAffinity"] = {
+            "requiredDuringSchedulingIgnoredDuringExecution": [
+                _pod_aff_term_to_k8s(t)
+                for t in a.pod_anti_affinity_required]
+        }
+    return out or None
 
 
 def _affinity_from_k8s(d: Optional[dict]) -> Optional[Affinity]:
@@ -241,9 +303,17 @@ def _affinity_from_k8s(d: Optional[dict]) -> Optional[Affinity]:
     sel = ((d.get("nodeAffinity") or {})
            .get("requiredDuringSchedulingIgnoredDuringExecution") or {})
     terms = sel.get("nodeSelectorTerms") or []
-    if not terms:
+    pod_aff = ((d.get("podAffinity") or {})
+               .get("requiredDuringSchedulingIgnoredDuringExecution") or [])
+    pod_anti = ((d.get("podAntiAffinity") or {})
+                .get("requiredDuringSchedulingIgnoredDuringExecution") or [])
+    if not terms and not pod_aff and not pod_anti:
         return None
-    return Affinity(node_affinity_required=[
+    return Affinity(
+        pod_affinity_required=[_pod_aff_term_from_k8s(t) for t in pod_aff],
+        pod_anti_affinity_required=[
+            _pod_aff_term_from_k8s(t) for t in pod_anti],
+        node_affinity_required=[
         NodeSelectorTerm(match_expressions=[
             NodeSelectorRequirement(
                 key=e.get("key", ""), operator=e.get("operator", "In"),
@@ -281,6 +351,14 @@ def pod_to_k8s(p: Pod) -> dict:
     aff = _affinity_to_k8s(p.spec.affinity)
     if aff:
         spec["affinity"] = aff
+    if p.spec.topology_spread_constraints:
+        spec["topologySpreadConstraints"] = [
+            {"maxSkew": c.max_skew, "topologyKey": c.topology_key,
+             "whenUnsatisfiable": c.when_unsatisfiable,
+             **({"labelSelector": _label_selector_to_k8s(c.label_selector)}
+                if c.label_selector is not None else {})}
+            for c in p.spec.topology_spread_constraints
+        ]
     status: dict = {"phase": p.status.phase}
     if p.status.conditions:
         status["conditions"] = [
@@ -321,6 +399,17 @@ def pod_from_k8s(d: dict) -> Pod:
                 for t in (spec.get("tolerations") or [])
             ],
             affinity=_affinity_from_k8s(spec.get("affinity")),
+            topology_spread_constraints=[
+                TopologySpreadConstraint(
+                    max_skew=int(c.get("maxSkew", 1)),
+                    topology_key=c.get("topologyKey", ""),
+                    when_unsatisfiable=c.get("whenUnsatisfiable",
+                                             "DoNotSchedule"),
+                    label_selector=_label_selector_from_k8s(
+                        c.get("labelSelector")),
+                )
+                for c in (spec.get("topologySpreadConstraints") or [])
+            ],
         ),
         status=PodStatus(
             phase=status.get("phase", "Pending"),

@@ -132,13 +132,75 @@ class NodeSelectorTerm:
 
 
 @dataclass
-class Affinity:
-    """requiredDuringSchedulingIgnoredDuringExecution node affinity:
-    OR over terms, AND within a term."""
+class LabelSelector:
+    """metav1.LabelSelector: matchLabels AND matchExpressions (both must
+    hold). An EMPTY selector matches everything — unlike the PDB
+    convention where an empty matchLabels dict matches nothing."""
 
-    node_affinity_required: List[NodeSelectorTerm] = field(default_factory=list)
+    match_labels: Dict[str, str] = field(default_factory=dict)
+    match_expressions: List[NodeSelectorRequirement] = field(default_factory=list)
 
     def matches(self, labels: Dict[str, str]) -> bool:
+        return (
+            all(labels.get(k) == v for k, v in self.match_labels.items())
+            and all(r.matches(labels) for r in self.match_expressions)
+        )
+
+
+@dataclass
+class PodAffinityTerm:
+    """One required pod-(anti-)affinity term: pods matched by
+    ``label_selector`` in ``namespaces`` (empty = the incoming pod's own
+    namespace), grouped by the node-label ``topology_key``. A None
+    selector selects nothing (metav1 nil-vs-empty distinction: empty
+    selector = everything)."""
+
+    label_selector: Optional[LabelSelector] = None
+    topology_key: str = ""
+    namespaces: List[str] = field(default_factory=list)
+
+    def selects(self, pod: "Pod", own_namespace: str) -> bool:
+        if self.label_selector is None:
+            return False
+        nss = self.namespaces or [own_namespace]
+        return (pod.metadata.namespace in nss
+                and self.label_selector.matches(pod.metadata.labels))
+
+
+@dataclass
+class TopologySpreadConstraint:
+    """One spec.topologySpreadConstraints entry. Only
+    whenUnsatisfiable=DoNotSchedule acts as a filter; ScheduleAnyway is a
+    preference (scored, never blocking). A None selector counts no pods
+    (metav1 nil semantics)."""
+
+    max_skew: int = 1
+    topology_key: str = ""
+    when_unsatisfiable: str = "DoNotSchedule"
+    label_selector: Optional[LabelSelector] = None
+
+    def counts(self, pod: "Pod", own_namespace: str) -> bool:
+        """Does an existing ``pod`` count toward this constraint's skew
+        (same namespace + selector match)?"""
+        return (self.label_selector is not None
+                and pod.metadata.namespace == own_namespace
+                and self.label_selector.matches(pod.metadata.labels))
+
+
+@dataclass
+class Affinity:
+    """requiredDuringSchedulingIgnoredDuringExecution affinities: node
+    affinity (OR over terms, AND within a term) plus inter-pod affinity /
+    anti-affinity (every term must hold)."""
+
+    node_affinity_required: List[NodeSelectorTerm] = field(default_factory=list)
+    pod_affinity_required: List[PodAffinityTerm] = field(default_factory=list)
+    pod_anti_affinity_required: List[PodAffinityTerm] = field(
+        default_factory=list)
+
+    def matches(self, labels: Dict[str, str]) -> bool:
+        """Node-affinity half only (pod affinity needs cluster state —
+        scheduler/framework.py InterPodAffinityFit)."""
         if not self.node_affinity_required:
             return True
         return any(t.matches(labels) for t in self.node_affinity_required)
@@ -155,6 +217,8 @@ class PodSpec:
     node_selector: Dict[str, str] = field(default_factory=dict)
     tolerations: List[Toleration] = field(default_factory=list)
     affinity: Optional[Affinity] = None
+    topology_spread_constraints: List[TopologySpreadConstraint] = field(
+        default_factory=list)
 
 
 @dataclass

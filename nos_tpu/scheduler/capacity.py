@@ -97,14 +97,15 @@ class CapacityScheduling:
         # sync_pdbs; empty = no budgets, every victim is non-violating)
         self.pdbs: List[PodDisruptionBudget] = []
 
+    def _fwk(self) -> fw.SchedulerFramework:
+        # standalone unit use: same default filter suite as the wired
+        # scheduler (no silent divergence on taints/cordons/affinity)
+        return self.framework if self.framework is not None \
+            else self._default_framework
+
     def _fits(self, state: fw.CycleState, pod: Pod, node_info: fw.NodeInfo) -> bool:
         nominated: List[Pod] = state.get(NOMINATED_STATE) or []
-        fwk = self.framework
-        if fwk is None:
-            # standalone unit use: same default filter suite as the wired
-            # scheduler (no silent divergence on taints/cordons/affinity)
-            fwk = self._default_framework
-        return fwk.run_filter_with_nominated(
+        return self._fwk().run_filter_with_nominated(
             state, pod, node_info, nominated
         ).success
 
@@ -231,7 +232,7 @@ class CapacityScheduling:
             # by earlier preemption passes (their capacity is spoken for)
             state[NOMINATED_STATE] = snapshot.nominated_for(name, exclude=pod)
             selected = self._select_victims_on_node(
-                state, pod, info, gang_index)
+                state, pod, info, gang_index, snapshot=snapshot)
             if selected is None:
                 continue
             victims, num_violating = selected
@@ -294,6 +295,7 @@ class CapacityScheduling:
         pod: Pod,
         node_info: fw.NodeInfo,
         gang_index: Optional[Dict[object, List[Pod]]] = None,
+        snapshot: Optional[fw.Snapshot] = None,
     ) -> Optional[Tuple[List[Pod], int]]:
         """Reference SelectVictimsOnNode (capacity_scheduling.go:468-675),
         extended with gang-aware all-or-nothing victim units. Returns
@@ -373,21 +375,59 @@ class CapacityScheduling:
         # Remove all potential units, then check the pod fits. Gang members
         # on other nodes refund quota but don't change this node's sim
         # (their capacity frees elsewhere); ``local`` records what actually
-        # left the sim so reprieve restores exactly that.
-        removed: List[Tuple[List[Pod], List[Pod]]] = []  # (unit, local)
+        # left the sim so reprieve restores exactly that. The pre_filter
+        # STATE replay covers local AND remote members: a remote gang
+        # member's eviction changes cluster-wide topology-domain counts
+        # (its own node's labels, not this node's), and skipping it either
+        # evicts a gang that cannot help or misses the one that would.
+        def victim_node(v: Pod):
+            if v.spec.node_name == node_info.node.metadata.name:
+                return sim.node
+            if snapshot is not None:
+                ni = snapshot.get(v.spec.node_name)
+                if ni is not None:
+                    return ni.node
+            return None
+
+        # (unit, local, replayed) — replayed pairs each victim with the
+        # NODE whose labels its state replay used, so restore is exact
+        removed: List[Tuple[List[Pod], List[Pod], list]] = []
+        fwk = self._fwk()
         for unit in potential_units:
             local = [v for v in unit if sim.remove_pod(v)]
+            replayed = []
+            for v in unit:
+                node = victim_node(v)
+                if node is not None:
+                    # kube's RemovePod: the affinity/spread pre_filter
+                    # maps must see the eviction, or removing the very
+                    # pod the preemptor conflicts with would not clear
+                    # the conflict
+                    fwk.run_remove_pod_from_state(state, pod, v, node)
+                    replayed.append((v, node))
             for v in unit:
                 v_info = quotas.get(v.metadata.namespace)
                 if v_info is not None:
                     v_info.delete_pod_if_present(v)
-            removed.append((unit, local))
+            removed.append((unit, local, replayed))
+
+        def bail() -> None:
+            # restore the shared cycle state before bailing: this node's
+            # simulated evictions must not leak into other candidates'
+            # evaluations (the state is shared across the whole cycle)
+            for _unit, _local, replayed_ in removed:
+                for v, node in replayed_:
+                    fwk.run_add_pod_to_state(state, pod, v, node)
+
         if not self._fits(state, pod, sim):
+            bail()
             return None
         if preemptor_info is not None:
             if preemptor_info.used_over_max_with(pod_req):
+                bail()
                 return None
             if quotas.aggregated_used_over_min_with(pod_req):
+                bail()
                 return None
 
         # Reprieve as many units as possible, highest priority first
@@ -405,14 +445,17 @@ class CapacityScheduling:
             ),
         )
         violating_units, _ = filter_units_with_pdb_violation(
-            [u for u, _ in importance], self.pdbs)
+            [u for u, _, _ in importance], self.pdbs)
         violating_ids = {id(u) for u in violating_units}
         order = ([ul for ul in importance if id(ul[0]) in violating_ids]
                  + [ul for ul in importance if id(ul[0]) not in violating_ids])
         num_violating = 0
-        for unit, local in order:
+        still_removed: list = []
+        for unit, local, replayed in order:
             for v in local:
                 sim.add_pod(v)
+            for v, node in replayed:
+                fwk.run_add_pod_to_state(state, pod, v, node)
             for v in unit:
                 v_info = quotas.get(v.metadata.namespace)
                 if v_info is not None:
@@ -427,6 +470,8 @@ class CapacityScheduling:
             if not (fits and quota_ok):
                 for v in local:
                     sim.remove_pod(v)
+                for v, node in replayed:
+                    fwk.run_remove_pod_from_state(state, pod, v, node)
                 for v in unit:
                     v_info = quotas.get(v.metadata.namespace)
                     if v_info is not None:
@@ -434,4 +479,13 @@ class CapacityScheduling:
                 victims.extend(unit)
                 if id(unit) in violating_ids:
                     num_violating += len(unit)
+                still_removed.append(replayed)
+        # the cycle state is SHARED across candidate nodes (and with the
+        # caller): restore the final victims' contributions so this
+        # node's hypothetical eviction doesn't leak into the next
+        # candidate's evaluation — the real eviction is re-primed from a
+        # fresh snapshot next scheduling cycle
+        for replayed in still_removed:
+            for v, node in replayed:
+                fwk.run_add_pod_to_state(state, pod, v, node)
         return victims, num_violating

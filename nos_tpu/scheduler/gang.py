@@ -239,6 +239,18 @@ class GangScheduler:
             gang_worker(p): p.spec.node_name for p in members if p.spec.node_name
         }
 
+        # snapshot-derived filter state (inter-pod affinity maps, topology
+        # spread counts) primed ONCE per unbound member — not per candidate
+        # offset, where the cluster scan would multiply by the sub-cuboid
+        # search space
+        states: Dict[int, fw.CycleState] = {}
+        for p in members:
+            if p.spec.node_name:
+                continue
+            st: fw.CycleState = {}
+            self.framework.prime_filter_state(st, p, snapshot)
+            states[gang_worker(p)] = st
+
         reasons: List[str] = []
         # (exact-mismatch, free-hosts-after, domain-size, pool) — tightest
         # fit first: exact-size domains beat carving a larger pool; among
@@ -272,7 +284,8 @@ class GangScheduler:
                     f"pool {pool}: {topo_name} does not fit in {domain.topology_name}"
                 )
                 continue
-            placement = self._try_domain(members, bound, domain, req_shape, snapshot)
+            placement = self._try_domain(members, bound, domain, req_shape,
+                                         snapshot, states)
             if placement is None:
                 reasons.append(f"pool {pool}: hosts busy or unfit")
                 continue
@@ -427,6 +440,7 @@ class GangScheduler:
         domain: IciDomain,
         req_shape: Tuple[int, ...],
         snapshot: fw.Snapshot,
+        states: Optional[Dict[int, fw.CycleState]] = None,
     ) -> Optional[GangPlacement]:
         """Place the gang on an axis-aligned host-grid sub-cuboid of the
         domain (the whole domain when shapes are equal). Worker w maps to
@@ -467,7 +481,6 @@ class GangScheduler:
                 for w, node_name in bound.items()
             ):
                 continue
-            state: fw.CycleState = {}
             pods: List[Pod] = []
             assignments: List[str] = []
             feasible = True
@@ -475,6 +488,7 @@ class GangScheduler:
                 w = gang_worker(pod)
                 if w in bound:
                     continue
+                state = states.get(w, {}) if states is not None else {}
                 host_name = hosts[w].metadata.name
                 node_info = snapshot.get(host_name)
                 if node_info is None or not self.framework.run_filter_with_nominated(

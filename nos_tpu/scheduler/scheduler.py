@@ -260,29 +260,9 @@ class Scheduler:
                 self._mark_unschedulable(client, p, f"gang unplaceable: {why}")
             return Result()
 
-        reserved = []
-        for member, node_name in zip(placement.pods, placement.nodes):
-            st = self.framework.run_reserve({}, member, node_name)
-            if not st.success:
-                for m, n in reserved:
-                    self.framework.run_unreserve({}, m, n)
-                obs.SCHEDULE_ATTEMPTS.labels("unschedulable").inc()
-                for p in pending:
-                    self._mark_unschedulable(client, p, st.reason)
-                return Result()
-            reserved.append((member, node_name))
-
-        for member, node_name in zip(placement.pods, placement.nodes):
-            def bind(p: Pod, n=node_name):
-                p.spec.node_name = n
-                p.status.conditions = [
-                    c for c in p.status.conditions if c.type != "PodScheduled"
-                ] + [PodCondition(type="PodScheduled", status="True")]
-
-            bound = client.patch("Pod", member.metadata.name,
-                                 member.metadata.namespace, bind)
-            snapshot[node_name].add_pod(bound)
-            self.cache.upsert("Pod", bound)
+        pairs = list(zip(placement.pods, placement.nodes))
+        if not self._reserve_and_bind_all(client, pairs, pending, snapshot):
+            return Result()
         obs.GANGS_PLACED.inc()
         obs.SCHEDULE_ATTEMPTS.labels("bound").inc(len(placement.pods))
         logger.info(
@@ -291,6 +271,41 @@ class Scheduler:
             placement.domain.pool, placement.offset,
         )
         return Result()
+
+    # ------------------------------------------------------------------
+    def _reserve_and_bind_all(self, client: Client, pairs, pending,
+                              snapshot: fw.Snapshot) -> bool:
+        """All-or-nothing Reserve then Bind for a set of (pod, node)
+        assignments — shared by the gang and jobset paths. On any reserve
+        failure everything reserved so far is unreserved, the pending
+        pods are marked unschedulable, and False is returned (nothing
+        bound)."""
+        reserved = []
+        for member, node_name in pairs:
+            st = self.framework.run_reserve({}, member, node_name)
+            if not st.success:
+                for m, n in reserved:
+                    self.framework.run_unreserve({}, m, n)
+                obs.SCHEDULE_ATTEMPTS.labels("unschedulable").inc()
+                for p in pending:
+                    self._mark_unschedulable(client, p, st.reason)
+                return False
+            reserved.append((member, node_name))
+
+        for member, node_name in pairs:
+            def bind(p: Pod, n=node_name):
+                p.spec.node_name = n
+                p.status.nominated_node_name = ""
+                p.status.conditions = [
+                    c for c in p.status.conditions if c.type != "PodScheduled"
+                ] + [PodCondition(type="PodScheduled", status="True")]
+
+            bound = client.patch("Pod", member.metadata.name,
+                                 member.metadata.namespace, bind)
+            snapshot[node_name].add_pod(bound)
+            self.cache.upsert("Pod", bound)
+            snapshot.remove_nominated(member)
+        return True
 
     # ------------------------------------------------------------------
     def _schedule_jobset(self, client: Client, pod: Pod,
@@ -328,29 +343,8 @@ class Scheduler:
 
         pairs = [(m, n) for pl in placements
                  for m, n in zip(pl.pods, pl.nodes)]
-        reserved = []
-        for member, node_name in pairs:
-            st = self.framework.run_reserve({}, member, node_name)
-            if not st.success:
-                for m, n in reserved:
-                    self.framework.run_unreserve({}, m, n)
-                obs.SCHEDULE_ATTEMPTS.labels("unschedulable").inc()
-                for p in pending:
-                    self._mark_unschedulable(client, p, st.reason)
-                return Result()
-            reserved.append((member, node_name))
-
-        for member, node_name in pairs:
-            def bind(p: Pod, n=node_name):
-                p.spec.node_name = n
-                p.status.conditions = [
-                    c for c in p.status.conditions if c.type != "PodScheduled"
-                ] + [PodCondition(type="PodScheduled", status="True")]
-
-            bound = client.patch("Pod", member.metadata.name,
-                                 member.metadata.namespace, bind)
-            snapshot[node_name].add_pod(bound)
-            self.cache.upsert("Pod", bound)
+        if not self._reserve_and_bind_all(client, pairs, pending, snapshot):
+            return Result()
         obs.JOBSETS_PLACED.inc()
         obs.GANGS_PLACED.inc(len(placements))
         obs.SCHEDULE_ATTEMPTS.labels("bound").inc(len(pairs))

@@ -1,0 +1,603 @@
+"""Inter-pod (anti-)affinity + PodTopologySpread filters (VERDICT r4 ask
+#6): the reference's scheduler binary carries every stock kube-scheduler
+plugin by recompiling it (cmd/scheduler/scheduler.go:43-59); this suite
+table-tests the two that were missing from the lean framework against
+kube's documented semantics, end-to-end through the Scheduler and through
+the planner's what-if entry (framework.can_schedule).
+"""
+from nos_tpu import constants
+from nos_tpu.kube import ApiServer, Manager
+from nos_tpu.kube.objects import (
+    Affinity,
+    Container,
+    LabelSelector,
+    Node,
+    NodeSelectorRequirement,
+    NodeSelectorTerm,
+    NodeSpec,
+    NodeStatus,
+    ObjectMeta,
+    Pod,
+    PodAffinityTerm,
+    PodCondition,
+    PodSpec,
+    PodStatus,
+    TopologySpreadConstraint,
+)
+from nos_tpu.scheduler import Scheduler
+from nos_tpu.scheduler import framework as fw
+
+TPU = "google.com/tpu"
+
+
+def node(name, labels=None, cpu=96):
+    return Node(
+        metadata=ObjectMeta(name=name, labels=dict(labels or {})),
+        status=NodeStatus(capacity={"cpu": cpu, TPU: 8},
+                          allocatable={"cpu": cpu, TPU: 8}),
+    )
+
+
+def pod(name, ns="team-a", labels=None, affinity=None, spread=None,
+        node_selector=None, cpu=1):
+    return Pod(
+        metadata=ObjectMeta(name=name, namespace=ns,
+                            labels=dict(labels or {})),
+        spec=PodSpec(
+            containers=[Container(requests={"cpu": cpu})],
+            scheduler_name=constants.SCHEDULER_NAME,
+            affinity=affinity,
+            topology_spread_constraints=list(spread or []),
+            node_selector=dict(node_selector or {}),
+        ),
+        status=PodStatus(phase="Pending", conditions=[PodCondition(
+            type="PodScheduled", status="False", reason="Unschedulable")]),
+    )
+
+
+def rig():
+    server = ApiServer()
+    mgr = Manager(server)
+    mgr.add_controller(Scheduler().controller())
+    return server, mgr
+
+
+def sel(**labels):
+    return LabelSelector(match_labels=labels)
+
+
+def aff_term(topology_key, **labels):
+    return PodAffinityTerm(label_selector=sel(**labels),
+                           topology_key=topology_key)
+
+
+# ---------------------------------------------------------------------------
+# inter-pod affinity
+# ---------------------------------------------------------------------------
+
+
+def test_pod_affinity_colocates_in_topology_domain():
+    """web pods affine to the cache pod's zone: both zone-a nodes are
+    legal, zone-b is not."""
+    server, mgr = rig()
+    server.create(node("a1", {"zone": "a"}))
+    server.create(node("a2", {"zone": "a"}))
+    server.create(node("b1", {"zone": "b"}))
+    server.create(pod("cache", labels={"app": "cache"}))
+    mgr.run_until_idle()
+    cache_node = server.get("Pod", "cache", "team-a").spec.node_name
+    cache_zone = server.get("Node", cache_node).metadata.labels["zone"]
+    server.create(pod("web", labels={"app": "web"}, affinity=Affinity(
+        pod_affinity_required=[aff_term("zone", app="cache")])))
+    mgr.run_until_idle()
+    web_node = server.get("Pod", "web", "team-a").spec.node_name
+    assert web_node
+    assert server.get("Node", web_node).metadata.labels["zone"] == cache_zone
+
+
+def test_pod_affinity_first_replica_rule():
+    """No pod matches the term anywhere, but the incoming pod matches its
+    OWN selector: kube admits it (else self-affine deployments could
+    never start)."""
+    server, mgr = rig()
+    server.create(node("a1", {"zone": "a"}))
+    server.create(pod("web-0", labels={"app": "web"}, affinity=Affinity(
+        pod_affinity_required=[aff_term("zone", app="web")])))
+    mgr.run_until_idle()
+    assert server.get("Pod", "web-0", "team-a").spec.node_name == "a1"
+
+
+def test_pod_affinity_unmatched_term_blocks():
+    """No match anywhere and the pod does NOT satisfy its own term:
+    unschedulable, with the term named."""
+    server, mgr = rig()
+    server.create(node("a1", {"zone": "a"}))
+    server.create(pod("web", labels={"app": "web"}, affinity=Affinity(
+        pod_affinity_required=[aff_term("zone", app="cache")])))
+    mgr.run_until_idle()
+    p = server.get("Pod", "web", "team-a")
+    assert p.spec.node_name == ""
+    assert any("affinity" in c.message for c in p.status.conditions)
+
+
+def test_pod_affinity_requires_topology_key_on_node():
+    server, mgr = rig()
+    server.create(node("plain"))        # no zone label
+    server.create(pod("web", labels={"app": "web"}, affinity=Affinity(
+        pod_affinity_required=[aff_term("zone", app="web")])))
+    mgr.run_until_idle()
+    p = server.get("Pod", "web", "team-a")
+    assert p.spec.node_name == ""
+    assert any("lacks topology key" in c.message for c in p.status.conditions)
+
+
+def test_pod_anti_affinity_spreads_and_saturates():
+    """Per-hostname anti-affinity: two replicas land on distinct nodes;
+    the third has no conflict-free node and stays pending."""
+    server, mgr = rig()
+    server.create(node("n1", {"kubernetes.io/hostname": "n1"}))
+    server.create(node("n2", {"kubernetes.io/hostname": "n2"}))
+    anti = Affinity(pod_anti_affinity_required=[
+        aff_term("kubernetes.io/hostname", app="web")])
+    for i in range(3):
+        server.create(pod(f"web-{i}", labels={"app": "web"}, affinity=anti))
+    mgr.run_until_idle()
+    nodes = [server.get("Pod", f"web-{i}", "team-a").spec.node_name
+             for i in range(3)]
+    placed = [n for n in nodes if n]
+    assert len(placed) == 2 and len(set(placed)) == 2, nodes
+    stuck = [i for i, n in enumerate(nodes) if not n]
+    p = server.get("Pod", f"web-{stuck[0]}", "team-a")
+    assert any("anti-affinity" in c.message for c in p.status.conditions)
+
+
+def test_anti_affinity_symmetry_protects_existing_pod():
+    """kube enforces anti-affinity BOTH ways: an existing pod whose
+    anti-affinity selects the incoming pod forbids its domain even though
+    the incoming pod declares nothing."""
+    server, mgr = rig()
+    server.create(node("a1", {"zone": "a"}))
+    server.create(node("b1", {"zone": "b"}))
+    server.create(pod("loner", labels={"app": "loner"}, affinity=Affinity(
+        pod_anti_affinity_required=[aff_term("zone", app="web")])))
+    mgr.run_until_idle()
+    loner_zone = server.get(
+        "Node", server.get("Pod", "loner", "team-a").spec.node_name
+    ).metadata.labels["zone"]
+    server.create(pod("web", labels={"app": "web"}))
+    mgr.run_until_idle()
+    web_node = server.get("Pod", "web", "team-a").spec.node_name
+    assert web_node
+    assert server.get("Node", web_node).metadata.labels["zone"] != loner_zone
+
+
+def test_pod_affinity_cross_namespace_term():
+    """Explicit namespaces widen the match beyond the pod's own ns;
+    without them, a matching pod in ANOTHER ns is invisible."""
+    server, mgr = rig()
+    server.create(node("a1", {"zone": "a"}))
+    server.create(node("b1", {"zone": "b"}))
+    server.create(pod("cache", ns="infra", labels={"app": "cache"}))
+    mgr.run_until_idle()
+    cache_zone = server.get(
+        "Node", server.get("Pod", "cache", "infra").spec.node_name
+    ).metadata.labels["zone"]
+    # same-ns term: cache (in infra) is invisible; pod doesn't match own
+    # term -> pending
+    server.create(pod("web-same-ns", labels={"app": "web"},
+                      affinity=Affinity(pod_affinity_required=[
+                          aff_term("zone", app="cache")])))
+    # cross-ns term: follows the infra cache
+    term = PodAffinityTerm(label_selector=sel(app="cache"),
+                           topology_key="zone", namespaces=["infra"])
+    server.create(pod("web-cross-ns", labels={"app": "web"},
+                      affinity=Affinity(pod_affinity_required=[term])))
+    mgr.run_until_idle()
+    assert server.get("Pod", "web-same-ns", "team-a").spec.node_name == ""
+    cross = server.get("Pod", "web-cross-ns", "team-a").spec.node_name
+    assert cross
+    assert server.get("Node", cross).metadata.labels["zone"] == cache_zone
+
+
+# ---------------------------------------------------------------------------
+# topology spread
+# ---------------------------------------------------------------------------
+
+
+def spread(max_skew=1, key="zone", when="DoNotSchedule", **labels):
+    return TopologySpreadConstraint(
+        max_skew=max_skew, topology_key=key, when_unsatisfiable=when,
+        label_selector=sel(**labels))
+
+
+def test_spread_forces_emptier_domain():
+    """zone a holds 2 web pods, zone b none: with maxSkew=1 the next web
+    pod MUST land in b (a would skew to 3)."""
+    server, mgr = rig()
+    server.create(node("a1", {"zone": "a"}))
+    server.create(node("a2", {"zone": "a"}))
+    server.create(node("b1", {"zone": "b"}))
+    c = spread(app="web")
+    for name, sel_node in (("w0", "a1"), ("w1", "a2")):
+        p = pod(name, labels={"app": "web"})
+        p.spec.node_name = sel_node
+        p.status.phase = "Running"
+        server.create(p)
+    server.create(pod("w2", labels={"app": "web"}, spread=[c]))
+    mgr.run_until_idle()
+    w2 = server.get("Pod", "w2", "team-a").spec.node_name
+    assert server.get("Node", w2).metadata.labels["zone"] == "b"
+
+
+def test_spread_do_not_schedule_blocks_when_unsatisfiable():
+    """Only zone-a nodes exist with capacity and a=b+2 already: the pod
+    stays pending rather than violating maxSkew."""
+    server, mgr = rig()
+    server.create(node("a1", {"zone": "a"}))
+    server.create(node("b1", {"zone": "b"}, cpu=0))      # no room in b
+    for name in ("w0", "w1"):
+        p = pod(name, labels={"app": "web"})
+        p.spec.node_name = "a1"
+        p.status.phase = "Running"
+        server.create(p)
+    server.create(pod("w2", labels={"app": "web"}, spread=[spread(app="web")]))
+    mgr.run_until_idle()
+    p = server.get("Pod", "w2", "team-a")
+    assert p.spec.node_name == ""
+    assert any("skew" in c.message for c in p.status.conditions)
+
+
+def test_spread_schedule_anyway_never_blocks():
+    server, mgr = rig()
+    server.create(node("a1", {"zone": "a"}))
+    for name in ("w0", "w1"):
+        p = pod(name, labels={"app": "web"})
+        p.spec.node_name = "a1"
+        p.status.phase = "Running"
+        server.create(p)
+    server.create(pod("w2", labels={"app": "web"},
+                      spread=[spread(when="ScheduleAnyway", app="web")]))
+    mgr.run_until_idle()
+    assert server.get("Pod", "w2", "team-a").spec.node_name == "a1"
+
+
+def test_spread_node_inclusion_rule():
+    """Domains whose nodes the pod could never use (nodeSelector mismatch)
+    are excluded from the min-count — kube's node-inclusion rule. Zone b
+    is selector-excluded and empty; without the rule min=0 would block
+    zone a at count 2."""
+    server, mgr = rig()
+    server.create(node("a1", {"zone": "a", "tier": "gpu"}))
+    server.create(node("b1", {"zone": "b", "tier": "cpu"}))
+    for name in ("w0", "w1"):
+        p = pod(name, labels={"app": "web"})
+        p.spec.node_name = "a1"
+        p.status.phase = "Running"
+        server.create(p)
+    server.create(pod("w2", labels={"app": "web"},
+                      node_selector={"tier": "gpu"},
+                      spread=[spread(app="web")]))
+    mgr.run_until_idle()
+    assert server.get("Pod", "w2", "team-a").spec.node_name == "a1"
+
+
+def test_spread_nodes_without_key_rejected():
+    server, mgr = rig()
+    server.create(node("plain"))
+    server.create(pod("w", labels={"app": "web"}, spread=[spread(app="web")]))
+    mgr.run_until_idle()
+    p = server.get("Pod", "w", "team-a")
+    assert p.spec.node_name == ""
+    assert any("lacks topology key" in c.message for c in p.status.conditions)
+
+
+def test_spread_nil_selector_counts_nothing():
+    """metav1 nil labelSelector selects no pods: every domain counts 0,
+    so placement is unconstrained (NOT blocked)."""
+    server, mgr = rig()
+    server.create(node("a1", {"zone": "a"}))
+    for name in ("w0", "w1"):
+        p = pod(name, labels={"app": "web"})
+        p.spec.node_name = "a1"
+        p.status.phase = "Running"
+        server.create(p)
+    c = TopologySpreadConstraint(max_skew=1, topology_key="zone",
+                                 label_selector=None)
+    server.create(pod("w2", labels={"app": "web"}, spread=[c]))
+    mgr.run_until_idle()
+    assert server.get("Pod", "w2", "team-a").spec.node_name == "a1"
+
+
+# ---------------------------------------------------------------------------
+# what-if simulation path (planner) + wire codec
+# ---------------------------------------------------------------------------
+
+
+def test_can_schedule_runs_new_filters():
+    """The planner's what-if entry must see the same verdicts: a pod that
+    violates spread is rejected in simulation too."""
+    n_a = node("a1", {"zone": "a"})
+    running = pod("w0", labels={"app": "web"})
+    running.spec.node_name = "a1"
+    running.status.phase = "Running"
+    running2 = pod("w1", labels={"app": "web"})
+    running2.spec.node_name = "a1"
+    running2.status.phase = "Running"
+    snap = fw.Snapshot.build([n_a, node("b1", {"zone": "b"}, cpu=0)],
+                             [running, running2])
+    f = fw.SchedulerFramework()
+    blocked = pod("w2", labels={"app": "web"}, spread=[spread(app="web")])
+    name, st = f.can_schedule(blocked, snap)
+    assert name is None and not st.success
+    ok_pod = pod("w3", labels={"app": "web"})
+    name, st = f.can_schedule(ok_pod, snap)
+    assert name == "a1" and st.success
+
+
+def test_gang_members_respect_anti_affinity_symmetry():
+    """Gang placement primes the snapshot-derived filter state: a loner
+    pod's anti-affinity on one pool must push the gang to the other."""
+    from tests.test_gang import gang_pod, make_pool
+
+    server, mgr = rig()
+    make_pool(server, "pool-a", 2)
+    make_pool(server, "pool-b", 2)
+    # a loner on pool-a-w0 that forbids gang workers from its nodepool
+    # domain
+    loner = pod("loner", labels={"app": "loner"}, affinity=Affinity(
+        pod_anti_affinity_required=[PodAffinityTerm(
+            label_selector=LabelSelector(
+                match_expressions=[NodeSelectorRequirement(
+                    key=constants.LABEL_GANG_NAME, operator="Exists")]),
+            topology_key=constants.LABEL_NODEPOOL)]))
+    loner.spec.node_name = "pool-a-w0"
+    loner.status.phase = "Running"
+    server.create(loner)
+    for w in range(2):
+        server.create(gang_pod("train", w, 2))
+    mgr.run_until_idle()
+    nodes = [server.get("Pod", f"train-{w}", "team-a").spec.node_name
+             for w in range(2)]
+    assert nodes == ["pool-b-w0", "pool-b-w1"], nodes
+
+
+# ---------------------------------------------------------------------------
+# preemption must be able to CLEAR affinity/spread violations (kube's
+# AddPod/RemovePod state updates — without them the victim simulation sees
+# stale pre_filter maps and concludes "preempting cannot help")
+# ---------------------------------------------------------------------------
+
+
+def _primed_select(cs, snap, preemptor, node_name="n1"):
+    state = {}
+    cs.pre_filter(state, preemptor, snap)
+    cs._fwk().run_pre_filter(state, preemptor, snap)
+    out = cs._select_victims_on_node(state, preemptor, snap[node_name])
+    victims = out[0] if out is not None else None
+    # leak check: the shared cycle state must be fully restored, so a
+    # re-run against the UNMODIFIED snapshot yields the same answer
+    out2 = cs._select_victims_on_node(state, preemptor, snap[node_name])
+    assert (out is None) == (out2 is None)
+    return victims
+
+
+def test_preemption_clears_anti_affinity_conflict():
+    """The only node hosts a lower-priority app=x pod; the preemptor
+    anti-affines to app=x. Evicting the victim must clear the conflict
+    in the simulation (stale maps would pend the preemptor forever)."""
+    from nos_tpu.scheduler.capacity import CapacityScheduling
+
+    cs = CapacityScheduling()
+    victim = pod("victim", ns="ns-x", labels={"app": "x"})
+    victim.spec.node_name = "n1"
+    victim.status.phase = "Running"
+    snap = fw.Snapshot.build([node("n1", {"zone": "a"})], [victim])
+    preemptor = pod("pre", ns="ns-x", affinity=Affinity(
+        pod_anti_affinity_required=[aff_term("zone", app="x")]))
+    preemptor.spec.priority = 100
+    victims = _primed_select(cs, snap, preemptor)
+    assert victims is not None
+    assert [v.metadata.name for v in victims] == ["victim"]
+
+
+def test_preemption_clears_symmetry_conflict():
+    """Symmetric case: the VICTIM declares anti-affinity against the
+    preemptor's labels. Its eviction must clear the forbidden domain."""
+    from nos_tpu.scheduler.capacity import CapacityScheduling
+
+    cs = CapacityScheduling()
+    victim = pod("loner", ns="ns-x", labels={"app": "loner"},
+                 affinity=Affinity(pod_anti_affinity_required=[
+                     aff_term("zone", app="web")]))
+    victim.spec.node_name = "n1"
+    victim.status.phase = "Running"
+    snap = fw.Snapshot.build([node("n1", {"zone": "a"})], [victim])
+    preemptor = pod("web", ns="ns-x", labels={"app": "web"})
+    preemptor.spec.priority = 100
+    victims = _primed_select(cs, snap, preemptor)
+    assert victims is not None
+    assert [v.metadata.name for v in victims] == ["loner"]
+
+
+def test_preemption_clears_spread_violation():
+    """Candidate zone already at max skew: evicting enough matching pods
+    must make the spread constraint satisfiable in simulation."""
+    from nos_tpu.scheduler.capacity import CapacityScheduling
+
+    cs = CapacityScheduling()
+    running = []
+    for i in range(2):
+        p = pod(f"w{i}", ns="ns-x", labels={"app": "web"})
+        p.spec.node_name = "n1"
+        p.status.phase = "Running"
+        running.append(p)
+    snap = fw.Snapshot.build(
+        [node("n1", {"zone": "a"}), node("b1", {"zone": "b"}, cpu=0)],
+        running)
+    preemptor = pod("new", ns="ns-x", labels={"app": "web"},
+                    spread=[spread(app="web")])
+    preemptor.spec.priority = 100
+    victims = _primed_select(cs, snap, preemptor)
+    # both zone-a web pods must go: evicting one still leaves skew
+    # (1 existing + self 1 - min 0) = 2 > 1
+    assert victims is not None
+    assert sorted(v.metadata.name for v in victims) == ["w0", "w1"]
+
+
+def test_preemption_quota_bail_restores_state():
+    """A quota bail-out mid-simulation must restore the cycle state: the
+    phantom eviction on node n1 must not make the preemptor look feasible
+    on n2 (same zone, conflict still live)."""
+    from nos_tpu.quota.info import QuotaInfo, QuotaInfos
+    from nos_tpu.scheduler.capacity import CapacityScheduling
+
+    cs = CapacityScheduling()
+    cs.quotas = QuotaInfos()
+    # max below the preemptor's own request: every victim simulation
+    # passes _fits then bails on used_over_max_with
+    cs.quotas.add(QuotaInfo(name="q", namespace="ns-x", namespaces={"ns-x"},
+                            min={"cpu": 1}, max={"cpu": 3},
+                            calculator=cs.calc))
+    victim = pod("victim", ns="ns-x", labels={
+        "app": "x", constants.LABEL_CAPACITY: "over-quota"}, cpu=4)
+    victim.spec.node_name = "n1"
+    victim.status.phase = "Running"
+    cs.track_pod(victim)
+    snap = fw.Snapshot.build(
+        [node("n1", {"zone": "a"}), node("n2", {"zone": "a"})], [victim],
+        cs.calc)
+    preemptor = pod("pre", ns="ns-x", cpu=4, affinity=Affinity(
+        pod_anti_affinity_required=[aff_term("zone", app="x")]))
+    preemptor.spec.priority = 100
+    state = {}
+    cs.pre_filter(state, preemptor, snap)
+    cs._fwk().run_pre_filter(state, preemptor, snap)
+    out = cs._select_victims_on_node(state, preemptor, snap["n1"])
+    assert out is None      # quota max forbids the preemptor outright
+    # the conflict on the shared zone must still be visible on n2
+    st = cs._fwk().run_filter_with_nominated(state, preemptor, snap["n2"], [])
+    assert not st.success and "anti-affinity" in st.reason
+
+
+def _gang_victim(name, worker, node_name, labels, cpu=4):
+    p = pod(name, ns="ns-x", labels={
+        constants.LABEL_GANG_NAME: "g", constants.LABEL_GANG_SIZE: "2",
+        constants.LABEL_GANG_WORKER: str(worker), **labels}, cpu=cpu)
+    p.spec.node_name = node_name
+    p.status.phase = "Running"
+    return p
+
+
+def test_preemption_remote_gang_member_replayed_anti_affinity():
+    """The anti-affinity conflict lives on a REMOTE member of the victim
+    gang: evicting the gang (a single all-or-nothing unit) clears it, so
+    preemption must succeed — requires replaying the remote member's
+    removal into the pre_filter state with ITS OWN node's labels."""
+    from nos_tpu.scheduler.capacity import CapacityScheduling
+
+    cs = CapacityScheduling()
+    g1 = _gang_victim("g-0", 0, "n1", {"app": "y"})      # resource hog
+    g2 = _gang_victim("g-1", 1, "n2", {"app": "x"})      # the conflict
+    snap = fw.Snapshot.build(
+        [node("n1", {"zone": "a"}, cpu=4), node("n2", {"zone": "a"})],
+        [g1, g2])
+    preemptor = pod("pre", ns="ns-x", cpu=4, affinity=Affinity(
+        pod_anti_affinity_required=[aff_term("zone", app="x")]))
+    preemptor.spec.priority = 100
+    state = {}
+    cs.pre_filter(state, preemptor, snap)
+    cs._fwk().run_pre_filter(state, preemptor, snap)
+    gi = cs._gang_index(snap)
+    out = cs._select_victims_on_node(state, preemptor, snap["n1"], gi,
+                                     snapshot=snap)
+    assert out is not None, "evicting the gang clears the remote conflict"
+    assert sorted(v.metadata.name for v in out[0]) == ["g-0", "g-1"]
+    # state restored: the conflict is visible again on n2 afterwards
+    st = cs._fwk().run_filter_with_nominated(state, preemptor, snap["n2"], [])
+    assert not st.success
+
+
+def test_preemption_never_evicts_gang_that_cannot_help():
+    """The preemptor's AFFINITY anchors are exactly the victim gang:
+    evicting it removes the last match, so the simulation must conclude
+    'preempting cannot help' instead of killing the gang for nothing —
+    requires the remote member's removal to hit the affinity counts."""
+    from nos_tpu.scheduler.capacity import CapacityScheduling
+
+    cs = CapacityScheduling()
+    g1 = _gang_victim("g-0", 0, "n1", {"app": "anchor"})
+    g2 = _gang_victim("g-1", 1, "n2", {"app": "anchor"})
+    snap = fw.Snapshot.build(
+        [node("n1", {"zone": "a"}, cpu=4), node("n2", {"zone": "a"}, cpu=4)],
+        [g1, g2])
+    preemptor = pod("pre", ns="ns-x", cpu=4, affinity=Affinity(
+        pod_affinity_required=[aff_term("zone", app="anchor")]))
+    preemptor.spec.priority = 100
+    state = {}
+    cs.pre_filter(state, preemptor, snap)
+    cs._fwk().run_pre_filter(state, preemptor, snap)
+    gi = cs._gang_index(snap)
+    out = cs._select_victims_on_node(state, preemptor, snap["n1"], gi,
+                                     snapshot=snap)
+    assert out is None, "gang eviction removes the affinity anchor"
+
+
+def test_preemption_affinity_end_to_end():
+    """Through the real scheduler loop: conflict-blocked preemptor
+    evicts the lower-priority conflicting pod and lands."""
+    from nos_tpu.api.quota import make_elastic_quota
+
+    server, mgr = rig()
+    server.create(node("n1", {"zone": "a"}, cpu=8))
+    # min=6: the 4-cpu preemptor pushes used past min (fair-sharing
+    # regime, same-ns lower-priority victims eligible) while the
+    # post-eviction aggregated-min bound (0+4 <= 6) still admits it
+    server.create(make_elastic_quota("qx", "team-a", min={"cpu": 6}))
+    victim = pod("victim", labels={
+        "app": "x", constants.LABEL_CAPACITY: "over-quota"}, cpu=4)
+    victim.spec.node_name = "n1"
+    victim.status.phase = "Running"
+    server.create(victim)
+    pre = pod("pre", labels={"app": "new"}, cpu=4, affinity=Affinity(
+        pod_anti_affinity_required=[aff_term("zone", app="x")]))
+    pre.spec.priority = 100
+    server.create(pre)
+    mgr.run_until_idle(advance_delayed=True)
+    assert server.try_get("Pod", "victim", "team-a") is None
+    assert server.get("Pod", "pre", "team-a").spec.node_name == "n1"
+
+
+def test_wire_codec_roundtrip():
+    """podAffinity/podAntiAffinity/topologySpreadConstraints survive the
+    k8s JSON codec, including the nil-vs-empty selector distinction."""
+    from nos_tpu.kube.k8s_codec import pod_from_k8s, pod_to_k8s
+
+    p = pod("w", labels={"app": "web"},
+            affinity=Affinity(
+                pod_affinity_required=[PodAffinityTerm(
+                    label_selector=sel(app="cache"), topology_key="zone",
+                    namespaces=["infra"])],
+                pod_anti_affinity_required=[aff_term("host", app="web")]),
+            spread=[spread(app="web"),
+                    TopologySpreadConstraint(max_skew=2, topology_key="rack",
+                                             label_selector=None)])
+    rt = pod_from_k8s(pod_to_k8s(p))
+    a = rt.spec.affinity
+    assert a.pod_affinity_required[0].label_selector.match_labels == \
+        {"app": "cache"}
+    assert a.pod_affinity_required[0].namespaces == ["infra"]
+    assert a.pod_anti_affinity_required[0].topology_key == "host"
+    cs = rt.spec.topology_spread_constraints
+    assert cs[0].max_skew == 1 and cs[0].label_selector.match_labels == \
+        {"app": "web"}
+    assert cs[1].max_skew == 2 and cs[1].label_selector is None
+    # decoded from raw k8s JSON with matchExpressions
+    raw = pod_to_k8s(p)
+    raw["spec"]["affinity"]["podAffinity"][
+        "requiredDuringSchedulingIgnoredDuringExecution"][0][
+        "labelSelector"] = {"matchExpressions": [
+            {"key": "app", "operator": "In", "values": ["cache", "redis"]}]}
+    rt2 = pod_from_k8s(raw)
+    expr = rt2.spec.affinity.pod_affinity_required[0] \
+        .label_selector.match_expressions[0]
+    assert expr.operator == "In" and expr.values == ["cache", "redis"]
