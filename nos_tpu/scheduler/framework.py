@@ -613,10 +613,15 @@ class PodTopologySpreadFit:
             return
         labels = node.metadata.labels
         ns = pod.metadata.namespace
+        if not self._node_included(pod, labels):
+            # kube's updateWithPod node check: a domain may contain both
+            # included and excluded nodes, so domain membership alone
+            # (`v in counts`) is not enough — a victim on a
+            # selector-excluded node never contributed to the counts and
+            # must not adjust them
+            return
         for c, counts, _self_num in cached[1]:
             v = labels.get(c.topology_key)
-            # only domains the pre_filter deemed eligible participate —
-            # a victim on an excluded node never entered the counts
             if v is not None and v in counts and c.counts(existing, ns):
                 counts[v] = max(counts[v] + delta, 0)
 

@@ -542,6 +542,42 @@ def test_preemption_never_evicts_gang_that_cannot_help():
     assert out is None, "gang eviction removes the affinity anchor"
 
 
+def test_preemption_spread_replay_respects_node_inclusion():
+    """kube's updateWithPod node check: a victim on a node the preemptor
+    can never use (selector-excluded) never entered the spread counts,
+    so its simulated eviction must not decrement them — else a gang dies
+    for nothing and the preemptor still pends next cycle."""
+    from nos_tpu.scheduler.capacity import CapacityScheduling
+
+    cs = CapacityScheduling()
+    w = pod("w0", ns="ns-x", labels={"app": "web"})     # included node
+    w.spec.node_name = "n1"
+    w.status.phase = "Running"
+    w.spec.priority = 200          # not evictable: only the gang is
+    # gang member on the EXCLUDED node in the same zone, gang-tied to a
+    # resource hog on n1 so the unit looks tempting
+    g1 = _gang_victim("g-0", 0, "n1", {"app": "other"})
+    g2 = _gang_victim("g-1", 1, "n2", {"app": "web"})
+    snap = fw.Snapshot.build(
+        [node("n1", {"zone": "a", "tier": "gpu"}, cpu=5),
+         node("n2", {"zone": "a", "tier": "cpu"}),
+         node("b1", {"zone": "b", "tier": "gpu"}, cpu=0)],
+        [w, g1, g2])
+    preemptor = pod("pre", ns="ns-x", labels={"app": "web"}, cpu=4,
+                    node_selector={"tier": "gpu"},
+                    spread=[spread(app="web")])
+    preemptor.spec.priority = 100
+    state = {}
+    cs.pre_filter(state, preemptor, snap)
+    cs._fwk().run_pre_filter(state, preemptor, snap)
+    gi = cs._gang_index(snap)
+    out = cs._select_victims_on_node(state, preemptor, snap["n1"], gi,
+                                     snapshot=snap)
+    # evicting the gang cannot clear the skew on n1 (zone a keeps w0's
+    # count; zone b's min is 0): preempting cannot help
+    assert out is None or "g-1" not in [v.metadata.name for v in out[0]], out
+
+
 def test_preemption_affinity_end_to_end():
     """Through the real scheduler loop: conflict-blocked preemptor
     evicts the lower-priority conflicting pod and lands."""

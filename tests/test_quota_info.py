@@ -330,3 +330,59 @@ def test_guaranteed_overquotas_zero_used_idle_cluster_returns_full_share():
     got_a = infos(qa, qb).guaranteed_overquotas("ns-a")
     got_b = infos(qa, qb).guaranteed_overquotas("ns-b")
     assert got_a[TPU] == 2.0 and got_b[TPU] == 6.0
+
+
+# ---------------------------------------------------------------------------
+# granularity-boundary rounding (VERDICT r4 ask #10): values that are
+# mathematically exact at the granularity boundary must not be eroded by
+# float representation, and values just under it must floor DOWN.
+# ---------------------------------------------------------------------------
+
+def test_guaranteed_overquotas_millicore_boundary_not_eroded():
+    """cpu shares of 1/3 over 0.3 idle cores: each quota's exact share is
+    100m; binary-float products (0.3 * (1/3) = 0.09999999...) must still
+    land ON the boundary, not at 99m."""
+    infos = QuotaInfos()
+    for ns in ("ns-a", "ns-b", "ns-c"):
+        infos.add(qi(f"q-{ns}", ns, min={"cpu": 0.1}, used={"cpu": 0.0}))
+    # one consumer uses nothing: aggregated overquota = 0.3 cores
+    for ns in ("ns-a", "ns-b", "ns-c"):
+        g = infos.guaranteed_overquotas(ns)["cpu"]
+        assert abs(g - 0.1) < 1e-12, (ns, g)
+
+
+def test_guaranteed_overquotas_chip_boundary_floor_vs_exact():
+    """Chips: a 3-way split of 8 chips guarantees floor(8/3)=2 each (the
+    lost remainder stays first-come-first-served), while a 4-way split of
+    8 is exactly 2 — no erosion, no inflation."""
+    infos = QuotaInfos()
+    for ns in ("a", "b", "c"):
+        infos.add(qi(f"q-{ns}", ns, min={TPU: 4}, used={TPU: 1}))
+    # aggregated overquota = 3 * 3 = 9; share 1/3 -> exact 3.0 each
+    for ns in ("a", "b", "c"):
+        assert infos.guaranteed_overquotas(ns)[TPU] == 3.0
+    infos2 = QuotaInfos()
+    infos2.add(qi("q-a", "a", min={TPU: 5}, used={TPU: 0}))
+    infos2.add(qi("q-b", "b", min={TPU: 3}, used={TPU: 0}))
+    # aggregated = 8; a: 8 * 5/8 = 5 exact; b: 8 * 3/8 = 3 exact
+    assert infos2.guaranteed_overquotas("a")[TPU] == 5.0
+    assert infos2.guaranteed_overquotas("b")[TPU] == 3.0
+    infos3 = QuotaInfos()
+    infos3.add(qi("q-a", "a", min={TPU: 4}, used={TPU: 0}))
+    infos3.add(qi("q-b", "b", min={TPU: 4}, used={TPU: 0}))
+    infos3.add(qi("q-c", "c", min={TPU: 3}, used={TPU: 3}))
+    # aggregated = 8; a,b: 8 * 4/11 = 2.909 -> floored to 2 whole chips
+    assert infos3.guaranteed_overquotas("a")[TPU] == 2.0
+    assert infos3.guaranteed_overquotas("c")[TPU] == 2.0   # 8*3/11=2.18
+
+
+def test_guaranteed_overquotas_sub_slice_scalars_floored_whole():
+    """Sub-slice scalar resources (nos.ai/tpu-slice-1x1) are countable
+    units like chips: fractional guarantees floor to whole slices."""
+    res = "nos.ai/tpu-slice-1x1"
+    infos = QuotaInfos()
+    infos.add(qi("q-a", "a", min={res: 2}, used={res: 0}))
+    infos.add(qi("q-b", "b", min={res: 1}, used={res: 1}))
+    # aggregated overquota = 2; a: 2 * 2/3 = 1.33 -> 1; b: 2/3 -> 0
+    assert infos.guaranteed_overquotas("a")[res] == 1.0
+    assert infos.guaranteed_overquotas("b")[res] == 0.0
