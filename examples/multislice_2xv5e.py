@@ -1,0 +1,92 @@
+"""Multislice showcase: one training job spanning TWO v5e slices over
+DCN, scheduled as a gang of gangs.
+
+Why this shape: a single slice caps out (v5e tops at 256 chips per
+slice); growing past it means multiple slices whose only link is the
+data-center network — orders of magnitude less bandwidth than ICI. The
+design rule that makes this work is the same one
+``nos_tpu/parallel/mesh.py`` enforces when laying a mesh over a
+multislice device set: **only the data axes (dp/fsdp) may cross the
+slice boundary** — their per-step traffic is one gradient all-reduce,
+which overlaps with backward compute — while tp/sp/ep/pp collectives
+(per-layer, latency-bound) stay inside each slice's ICI.
+
+Both halves of the contract come from ``ParallelLayout``:
+
+- workload side: ``layout.per_slice(n_slices)`` divides the dp axis and
+  is what each slice's processes run; ``build_mesh(layout, slice_ids=…)``
+  lays the global mesh so slice boundaries land between dp rows (it
+  REFUSES layouts where a model axis would straddle DCN).
+- scheduler side: ``per_slice(...).required_topology`` is the topology
+  annotation EVERY slice's gang carries (identical across slices —
+  slices are interchangeable dp replicas), and the jobset labels
+  (nos.ai/jobset-name/-slices/-slice) tie the N gangs into one co-atomic
+  admission: nothing binds unless every slice gets its own, DISTINCT ICI
+  domain (a jobset holding K of N slices would deadlock the cross-slice
+  all-reduce exactly like a partial gang deadlocks an ICI collective).
+
+Run ``python examples/multislice_2xv5e.py`` for the plan (no TPU
+needed); tests/test_example_multislice.py schedules the jobset end-to-end
+on a simulated 2-pool cluster and runs one real training step on a
+2-slice virtual mesh.
+"""
+from __future__ import annotations
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+from nos_tpu import constants                                  # noqa: E402
+from nos_tpu.parallel.layout import ParallelLayout             # noqa: E402
+from nos_tpu.tpu import topology                               # noqa: E402
+
+GENERATION = "v5e"
+N_SLICES = 2
+
+# global layout: dp=2 crosses DCN (one row per slice); within a slice,
+# tp x sp = 8 fills a 2x4 (8-chip, one-host) slice. Scale the same shape
+# up by raising tp/sp per slice and dp across more slices.
+GLOBAL_LAYOUT = ParallelLayout(dp=N_SLICES, tp=2, sp=4)
+
+
+def plan() -> dict:
+    per_slice = GLOBAL_LAYOUT.per_slice(N_SLICES)
+    topo = per_slice.required_topology(GENERATION)
+    gen = topology.get_generation(GENERATION)
+    hosts = gen.hosts_for(topo)
+    return {
+        "global_layout": {
+            a: getattr(GLOBAL_LAYOUT, a)
+            for a in ("dp", "fsdp", "tp", "pp", "sp", "ep")
+        },
+        "n_slices": N_SLICES,
+        "per_slice_layout": {
+            a: getattr(per_slice, a)
+            for a in ("dp", "fsdp", "tp", "pp", "sp", "ep")
+        },
+        "slice_topology": topo.name,
+        "hosts_per_slice": hosts,
+        "chips_per_slice": topo.chips,
+        "dcn_axes": ["dp"],            # the ONLY axes allowed to cross
+        "ici_axes": ["tp", "sp"],
+        "pod_labels_slice0_worker0": {
+            constants.LABEL_JOBSET_NAME: "train",
+            constants.LABEL_JOBSET_SLICES: str(N_SLICES),
+            constants.LABEL_JOBSET_SLICE: "0",
+            constants.LABEL_GANG_NAME: "train-slice-0",
+            constants.LABEL_GANG_SIZE: str(hosts),
+            constants.LABEL_GANG_WORKER: "0",
+        },
+        "pod_annotation": {constants.ANNOTATION_TPU_TOPOLOGY: topo.name},
+    }
+
+
+def main() -> None:
+    import json
+
+    print(json.dumps(plan(), indent=2))
+
+
+if __name__ == "__main__":
+    main()

@@ -35,6 +35,8 @@ from nos_tpu.kube.objects import (
     NodeSelectorTerm,
     PodAffinityTerm,
     TopologySpreadConstraint,
+    WeightedNodeSelectorTerm,
+    WeightedPodAffinityTerm,
     NodeSpec,
     NodeStatus,
     ObjectMeta,
@@ -266,62 +268,109 @@ def _pod_aff_term_from_k8s(d: dict) -> PodAffinityTerm:
     )
 
 
+def _node_term_to_k8s(t: NodeSelectorTerm) -> dict:
+    return {"matchExpressions": [
+        {"key": r.key, "operator": r.operator,
+         **({"values": list(r.values)} if r.values else {})}
+        for r in t.match_expressions
+    ]}
+
+
+def _node_term_from_k8s(d: dict) -> NodeSelectorTerm:
+    return NodeSelectorTerm(match_expressions=[
+        NodeSelectorRequirement(
+            key=e.get("key", ""), operator=e.get("operator", "In"),
+            values=list(e.get("values") or []))
+        for e in (d.get("matchExpressions") or [])
+    ])
+
+
 def _affinity_to_k8s(a: Optional[Affinity]) -> Optional[dict]:
     if a is None:
         return None
     out: dict = {}
+    node_aff: dict = {}
     if a.node_affinity_required:
-        out["nodeAffinity"] = {
-            "requiredDuringSchedulingIgnoredDuringExecution": {
-                "nodeSelectorTerms": [
-                    {"matchExpressions": [
-                        {"key": r.key, "operator": r.operator,
-                         **({"values": list(r.values)} if r.values else {})}
-                        for r in t.match_expressions
-                    ]}
-                    for t in a.node_affinity_required
-                ]
-            }
+        node_aff["requiredDuringSchedulingIgnoredDuringExecution"] = {
+            "nodeSelectorTerms": [
+                _node_term_to_k8s(t) for t in a.node_affinity_required]
         }
-    if a.pod_affinity_required:
-        out["podAffinity"] = {
-            "requiredDuringSchedulingIgnoredDuringExecution": [
-                _pod_aff_term_to_k8s(t) for t in a.pod_affinity_required]
-        }
-    if a.pod_anti_affinity_required:
-        out["podAntiAffinity"] = {
-            "requiredDuringSchedulingIgnoredDuringExecution": [
-                _pod_aff_term_to_k8s(t)
-                for t in a.pod_anti_affinity_required]
-        }
+    if a.node_affinity_preferred:
+        node_aff["preferredDuringSchedulingIgnoredDuringExecution"] = [
+            {"weight": w.weight, "preference": _node_term_to_k8s(w.term)}
+            for w in a.node_affinity_preferred
+        ]
+    if node_aff:
+        out["nodeAffinity"] = node_aff
+
+    def pod_block(required, preferred):
+        block: dict = {}
+        if required:
+            block["requiredDuringSchedulingIgnoredDuringExecution"] = [
+                _pod_aff_term_to_k8s(t) for t in required]
+        if preferred:
+            block["preferredDuringSchedulingIgnoredDuringExecution"] = [
+                {"weight": w.weight,
+                 "podAffinityTerm": _pod_aff_term_to_k8s(w.term)}
+                for w in preferred
+            ]
+        return block
+
+    pa = pod_block(a.pod_affinity_required, a.pod_affinity_preferred)
+    if pa:
+        out["podAffinity"] = pa
+    paa = pod_block(a.pod_anti_affinity_required,
+                    a.pod_anti_affinity_preferred)
+    if paa:
+        out["podAntiAffinity"] = paa
     return out or None
 
 
 def _affinity_from_k8s(d: Optional[dict]) -> Optional[Affinity]:
     if not d:
         return None
-    sel = ((d.get("nodeAffinity") or {})
-           .get("requiredDuringSchedulingIgnoredDuringExecution") or {})
+    node_aff = d.get("nodeAffinity") or {}
+    sel = node_aff.get("requiredDuringSchedulingIgnoredDuringExecution") or {}
     terms = sel.get("nodeSelectorTerms") or []
+    node_pref = node_aff.get(
+        "preferredDuringSchedulingIgnoredDuringExecution") or []
     pod_aff = ((d.get("podAffinity") or {})
                .get("requiredDuringSchedulingIgnoredDuringExecution") or [])
+    pod_aff_pref = ((d.get("podAffinity") or {})
+                    .get("preferredDuringSchedulingIgnoredDuringExecution")
+                    or [])
     pod_anti = ((d.get("podAntiAffinity") or {})
                 .get("requiredDuringSchedulingIgnoredDuringExecution") or [])
-    if not terms and not pod_aff and not pod_anti:
+    pod_anti_pref = ((d.get("podAntiAffinity") or {})
+                     .get("preferredDuringSchedulingIgnoredDuringExecution")
+                     or [])
+    if not (terms or node_pref or pod_aff or pod_aff_pref or pod_anti
+            or pod_anti_pref):
         return None
     return Affinity(
         pod_affinity_required=[_pod_aff_term_from_k8s(t) for t in pod_aff],
         pod_anti_affinity_required=[
             _pod_aff_term_from_k8s(t) for t in pod_anti],
-        node_affinity_required=[
-        NodeSelectorTerm(match_expressions=[
-            NodeSelectorRequirement(
-                key=e.get("key", ""), operator=e.get("operator", "In"),
-                values=list(e.get("values") or []))
-            for e in (t.get("matchExpressions") or [])
-        ])
-        for t in terms
-    ])
+        node_affinity_required=[_node_term_from_k8s(t) for t in terms],
+        node_affinity_preferred=[
+            WeightedNodeSelectorTerm(
+                weight=int(w.get("weight", 1)),
+                term=_node_term_from_k8s(w.get("preference") or {}))
+            for w in node_pref
+        ],
+        pod_affinity_preferred=[
+            WeightedPodAffinityTerm(
+                weight=int(w.get("weight", 1)),
+                term=_pod_aff_term_from_k8s(w.get("podAffinityTerm") or {}))
+            for w in pod_aff_pref
+        ],
+        pod_anti_affinity_preferred=[
+            WeightedPodAffinityTerm(
+                weight=int(w.get("weight", 1)),
+                term=_pod_aff_term_from_k8s(w.get("podAffinityTerm") or {}))
+            for w in pod_anti_pref
+        ],
+    )
 
 
 def pod_to_k8s(p: Pod) -> dict:

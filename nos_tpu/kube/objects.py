@@ -188,14 +188,37 @@ class TopologySpreadConstraint:
 
 
 @dataclass
+class WeightedPodAffinityTerm:
+    """preferredDuringScheduling pod-(anti-)affinity entry."""
+
+    weight: int = 1                  # 1..100 per the k8s API
+    term: PodAffinityTerm = field(default_factory=PodAffinityTerm)
+
+
+@dataclass
+class WeightedNodeSelectorTerm:
+    """preferredDuringScheduling node-affinity entry (PreferredSchedulingTerm)."""
+
+    weight: int = 1
+    term: NodeSelectorTerm = field(default_factory=NodeSelectorTerm)
+
+
+@dataclass
 class Affinity:
     """requiredDuringSchedulingIgnoredDuringExecution affinities: node
     affinity (OR over terms, AND within a term) plus inter-pod affinity /
-    anti-affinity (every term must hold)."""
+    anti-affinity (every term must hold). ``*_preferred`` lists are the
+    weighted preferredDuringScheduling halves — scored, never filtering."""
 
     node_affinity_required: List[NodeSelectorTerm] = field(default_factory=list)
     pod_affinity_required: List[PodAffinityTerm] = field(default_factory=list)
     pod_anti_affinity_required: List[PodAffinityTerm] = field(
+        default_factory=list)
+    node_affinity_preferred: List[WeightedNodeSelectorTerm] = field(
+        default_factory=list)
+    pod_affinity_preferred: List[WeightedPodAffinityTerm] = field(
+        default_factory=list)
+    pod_anti_affinity_preferred: List[WeightedPodAffinityTerm] = field(
         default_factory=list)
 
     def matches(self, labels: Dict[str, str]) -> bool:

@@ -370,7 +370,9 @@ class NodeUnschedulableFit:
 class NodeAffinityFit:
     """requiredDuringScheduling node affinity: OR over terms, AND within
     a term (reference planner simulation registers the full plugin suite,
-    cmd/gpupartitioner/gpupartitioner.go:294-318)."""
+    cmd/gpupartitioner/gpupartitioner.go:294-318). preferredDuringScheduling
+    terms contribute their weight to the node's score instead of
+    filtering (kube's NodeAffinity scoring half)."""
 
     name = "NodeAffinity"
 
@@ -381,6 +383,14 @@ class NodeAffinityFit:
         return Status.unresolvable(
             f"node affinity does not match node {node_info.node.metadata.name}"
         )
+
+    def score(self, state: CycleState, pod: Pod, node_info: NodeInfo) -> float:
+        aff = pod.spec.affinity
+        if aff is None or not aff.node_affinity_preferred:
+            return 0.0
+        labels = node_info.node.metadata.labels
+        return float(sum(w.weight for w in aff.node_affinity_preferred
+                         if w.term.matches(labels)))
 
 
 class InterPodAffinityFit:
@@ -423,11 +433,24 @@ class InterPodAffinityFit:
         aff = pod.spec.affinity
         terms = list(aff.pod_affinity_required) if aff else []
         anti = list(aff.pod_anti_affinity_required) if aff else []
+        # preferred terms: (signed weight, term, per-domain MATCH COUNTS)
+        # — scored, never filtering. Kube scores weight x matching-pod
+        # count per topology pair (a domain with 5 conflicting pods must
+        # rank below one with 1), so counts, not set membership. Scoring
+        # covers the pod's OWN preferred terms; existing pods' preferred
+        # (anti-)affinity symmetry weighting (kube scores that too) is
+        # not modeled.
+        pref: List[Tuple[float, object, Dict[str, int]]] = []
+        if aff is not None:
+            pref = [(float(w.weight), w.term, {})
+                    for w in aff.pod_affinity_preferred] + \
+                   [(-float(w.weight), w.term, {})
+                    for w in aff.pod_anti_affinity_preferred]
         ns = pod.metadata.namespace
         term_counts: List[Dict[str, int]] = [{} for _ in terms]
         anti_counts: List[Dict[str, int]] = [{} for _ in anti]
         forbidden: Dict[Tuple[str, str], int] = {}    # symmetry
-        if terms or anti:
+        if terms or anti or pref:
             # the pod declares affinities: full existing-pod scan
             for info in snapshot.values():
                 labels = info.node.metadata.labels
@@ -444,6 +467,11 @@ class InterPodAffinityFit:
                                 and t.topology_key in labels:
                             v = labels[t.topology_key]
                             anti_counts[i][v] = anti_counts[i].get(v, 0) + 1
+                    for _w, t, match_counts in pref:
+                        if t.selects(existing, ns) \
+                                and t.topology_key in labels:
+                            v = labels[t.topology_key]
+                            match_counts[v] = match_counts.get(v, 0) + 1
         # symmetry: only existing pods WITH anti-affinity matter — the
         # snapshot-level index makes this O(anti-affinity pods), i.e.
         # free on the common all-plain-pods cluster
@@ -452,8 +480,21 @@ class InterPodAffinityFit:
                 pair = (t.topology_key, labels[t.topology_key])
                 forbidden[pair] = forbidden.get(pair, 0) + 1
         state[self._KEY] = (
-            id(pod), (terms, term_counts, anti, anti_counts, forbidden))
+            id(pod), (terms, term_counts, anti, anti_counts, forbidden),
+            pref)
         return _OK
+
+    def score(self, state: CycleState, pod: Pod, node_info: NodeInfo) -> float:
+        cached = state.get(self._KEY)
+        if cached is None or cached[0] != id(pod) or not cached[2]:
+            return 0.0
+        labels = node_info.node.metadata.labels
+        total = 0.0
+        for w, t, match_counts in cached[2]:
+            v = labels.get(t.topology_key)
+            if v is not None:
+                total += w * match_counts.get(v, 0)
+        return total
 
     # -- preemption-simulation state updates (kube AddPod/RemovePod) ----
 
@@ -572,11 +613,9 @@ class PodTopologySpreadFit:
 
     def pre_filter(self, state: CycleState, pod: Pod,
                    snapshot: "Snapshot") -> Status:
-        cons = [c for c in pod.spec.topology_spread_constraints
-                if c.when_unsatisfiable == "DoNotSchedule"]
-        computed = []
         ns = pod.metadata.namespace
-        for c in cons:
+
+        def domain_counts(c):
             counts: Dict[str, int] = {}
             for info in snapshot.values():
                 labels = info.node.metadata.labels
@@ -591,6 +630,12 @@ class PodTopologySpreadFit:
                         continue
                     if c.counts(existing, ns):
                         counts[v] += 1
+            return counts
+
+        computed = []       # DoNotSchedule -> filtered
+        scored = []         # ScheduleAnyway -> preference only
+        for c in pod.spec.topology_spread_constraints:
+            counts = domain_counts(c)
             # kube's selfMatchNum: the incoming pod raises the candidate
             # domain's count only if the constraint's selector matches
             # the pod ITSELF — a spread constraint over labels the pod
@@ -598,9 +643,33 @@ class PodTopologySpreadFit:
             self_num = (1 if c.label_selector is not None
                         and c.label_selector.matches(pod.metadata.labels)
                         else 0)
-            computed.append((c, counts, self_num))
-        state[self._KEY] = (id(pod), computed)
+            if c.when_unsatisfiable == "DoNotSchedule":
+                computed.append((c, counts, self_num))
+            else:
+                scored.append((c, counts))
+        state[self._KEY] = (id(pod), computed, scored)
         return _OK
+
+    def score(self, state: CycleState, pod: Pod, node_info: NodeInfo) -> float:
+        """ScheduleAnyway constraints: prefer the domain with the fewest
+        matching pods. A node LACKING the topology key scores worse than
+        any real domain (kube excludes keyless nodes from benefiting
+        from spread scoring — otherwise every replica would pile onto
+        the one unlabeled node, which no domain count ever penalizes).
+        Raw scores are per-plugin; run_score normalizes to 0..100 across
+        candidates before summing with other plugins."""
+        cached = state.get(self._KEY)
+        if cached is None or cached[0] != id(pod) or not cached[2]:
+            return 0.0
+        labels = node_info.node.metadata.labels
+        total = 0.0
+        for c, counts in cached[2]:
+            v = labels.get(c.topology_key)
+            if v is None:
+                total -= float(max(counts.values(), default=0) + 1)
+            else:
+                total -= float(counts.get(v, 0))
+        return total
 
     # -- preemption-simulation state updates (kube AddPod/RemovePod) ----
 
@@ -777,6 +846,26 @@ class SchedulerFramework:
             total += p.score(state, pod, node_info)
         return total
 
+    def score_and_rank(self, state: CycleState, pod: Pod,
+                       names: List[str], snapshot: Snapshot) -> List[str]:
+        """kube's NormalizeScore: each scoring plugin's raw scores are
+        scaled to 0..100 across the candidate set BEFORE summing — raw
+        scales are plugin-local (1-100 affinity weights vs unbounded
+        spread counts), and an unnormalized sum would let whichever
+        plugin has the bigger numbers silently dominate every other
+        preference. Plugins whose raw scores are uniform across the
+        candidates contribute nothing to the ordering. Ties break on
+        node name (deterministic)."""
+        totals = {n: 0.0 for n in names}
+        for p in self._having("score"):
+            raw = [p.score(state, pod, snapshot[n]) for n in names]
+            lo, hi = min(raw), max(raw)
+            if hi > lo:
+                scale = 100.0 / (hi - lo)
+                for n, r in zip(names, raw):
+                    totals[n] += (r - lo) * scale
+        return sorted(names, key=lambda n: (-totals[n], n))
+
     def run_reserve(self, state: CycleState, pod: Pod, node_name: str) -> Status:
         done: List[object] = []
         for p in self._having("reserve"):
@@ -834,7 +923,7 @@ class SchedulerFramework:
             nominated = snapshot.nominated_for(name, exclude=pod)
             st = self.run_filter_with_nominated(state, pod, info, nominated)
             if st.success:
-                feasible.append((self.run_score(state, pod, info), name))
+                feasible.append(name)
                 if len(feasible) >= self.MIN_FEASIBLE_TO_FIND:
                     break
             elif st.reason and st.reason not in reasons:
@@ -846,8 +935,8 @@ class SchedulerFramework:
             return None, Status.unschedulable(
                 f"no feasible node: {detail}" if detail else "no feasible node"
             )
-        feasible.sort(key=lambda t: (-t[0], t[1]))
-        return feasible[0][1], Status.ok()
+        ranked = self.score_and_rank(state, pod, feasible, snapshot)
+        return ranked[0], Status.ok()
 
     def can_schedule(self, pod: Pod, snapshot: Snapshot) -> Tuple[Optional[str], Status]:
         """PreFilter + Filter over all nodes; returns (best node, status).

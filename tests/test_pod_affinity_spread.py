@@ -637,3 +637,167 @@ def test_wire_codec_roundtrip():
     expr = rt2.spec.affinity.pod_affinity_required[0] \
         .label_selector.match_expressions[0]
     assert expr.operator == "In" and expr.values == ["cache", "redis"]
+
+
+# ---------------------------------------------------------------------------
+# preference scoring: preferredDuringScheduling affinities + ScheduleAnyway
+# spread act on node RANKING, never on feasibility
+# ---------------------------------------------------------------------------
+
+
+def test_preferred_node_affinity_ranks_nodes():
+    from nos_tpu.kube.objects import (NodeSelectorRequirement,
+                                      NodeSelectorTerm,
+                                      WeightedNodeSelectorTerm)
+
+    server, mgr = rig()
+    server.create(node("cheap", {"pool": "spot"}))
+    server.create(node("exp", {"pool": "ondemand"}))
+    pref = Affinity(node_affinity_preferred=[WeightedNodeSelectorTerm(
+        weight=50, term=NodeSelectorTerm(match_expressions=[
+            NodeSelectorRequirement(key="pool", operator="In",
+                                    values=["spot"])]))])
+    server.create(pod("w", affinity=pref))
+    mgr.run_until_idle()
+    assert server.get("Pod", "w", "team-a").spec.node_name == "cheap"
+
+
+def test_preferred_pod_affinity_and_anti_affinity_rank():
+    from nos_tpu.kube.objects import WeightedPodAffinityTerm
+
+    server, mgr = rig()
+    server.create(node("a1", {"zone": "a"}))
+    server.create(node("b1", {"zone": "b"}))
+    cache = pod("cache", labels={"app": "cache"})
+    cache.spec.node_name = "b1"
+    cache.status.phase = "Running"
+    server.create(cache)
+    # prefers the cache's zone — lands on b1 though a1 sorts first
+    server.create(pod("web", labels={"app": "web"}, affinity=Affinity(
+        pod_affinity_preferred=[WeightedPodAffinityTerm(
+            weight=10, term=aff_term("zone", app="cache"))])))
+    mgr.run_until_idle()
+    assert server.get("Pod", "web", "team-a").spec.node_name == "b1"
+    # anti-preference pushes the next one AWAY from the cache zone
+    server.create(pod("loner", labels={"app": "loner"}, affinity=Affinity(
+        pod_anti_affinity_preferred=[WeightedPodAffinityTerm(
+            weight=10, term=aff_term("zone", app="cache"))])))
+    mgr.run_until_idle()
+    assert server.get("Pod", "loner", "team-a").spec.node_name == "a1"
+
+
+def test_schedule_anyway_spread_prefers_emptier_domain_but_never_blocks():
+    server, mgr = rig()
+    server.create(node("a1", {"zone": "a"}))
+    server.create(node("b1", {"zone": "b"}))
+    for i in range(2):
+        p = pod(f"w{i}", labels={"app": "web"})
+        p.spec.node_name = "a1"
+        p.status.phase = "Running"
+        server.create(p)
+    c = spread(when="ScheduleAnyway", app="web")
+    server.create(pod("w2", labels={"app": "web"}, spread=[c]))
+    mgr.run_until_idle()
+    # preference: the emptier zone b
+    assert server.get("Pod", "w2", "team-a").spec.node_name == "b1"
+    # when only the crowded zone is feasible, it still schedules
+    server2, mgr2 = rig()
+    server2.create(node("a1", {"zone": "a"}))
+    for i in range(2):
+        p = pod(f"w{i}", labels={"app": "web"})
+        p.spec.node_name = "a1"
+        p.status.phase = "Running"
+        server2.create(p)
+    server2.create(pod("w2", labels={"app": "web"}, spread=[c]))
+    mgr2.run_until_idle()
+    assert server2.get("Pod", "w2", "team-a").spec.node_name == "a1"
+
+
+def test_preferred_affinity_wire_roundtrip():
+    from nos_tpu.kube.k8s_codec import pod_from_k8s, pod_to_k8s
+    from nos_tpu.kube.objects import (NodeSelectorRequirement,
+                                      NodeSelectorTerm,
+                                      WeightedNodeSelectorTerm,
+                                      WeightedPodAffinityTerm)
+
+    p = pod("w", affinity=Affinity(
+        node_affinity_preferred=[WeightedNodeSelectorTerm(
+            weight=30, term=NodeSelectorTerm(match_expressions=[
+                NodeSelectorRequirement(key="pool", operator="In",
+                                        values=["spot"])]))],
+        pod_affinity_preferred=[WeightedPodAffinityTerm(
+            weight=7, term=aff_term("zone", app="cache"))],
+        pod_anti_affinity_preferred=[WeightedPodAffinityTerm(
+            weight=3, term=aff_term("host", app="web"))]))
+    rt = pod_from_k8s(pod_to_k8s(p))
+    a = rt.spec.affinity
+    assert a.node_affinity_preferred[0].weight == 30
+    assert a.node_affinity_preferred[0].term.match_expressions[0].values \
+        == ["spot"]
+    assert a.pod_affinity_preferred[0].weight == 7
+    assert a.pod_affinity_preferred[0].term.label_selector.match_labels \
+        == {"app": "cache"}
+    assert a.pod_anti_affinity_preferred[0].weight == 3
+    assert a.pod_anti_affinity_preferred[0].term.topology_key == "host"
+
+
+def test_schedule_anyway_keyless_node_ranks_worst():
+    """A node lacking the topology key must not become the score-best
+    'empty domain' and absorb every replica (kube excludes keyless nodes
+    from spread-scoring benefit)."""
+    server, mgr = rig()
+    server.create(node("a1", {"zone": "a"}))
+    server.create(node("b1", {"zone": "b"}))
+    server.create(node("plain"))          # no zone label
+    c = spread(when="ScheduleAnyway", app="web")
+    w0 = pod("w0", labels={"app": "web"})
+    w0.spec.node_name = "a1"
+    w0.status.phase = "Running"
+    server.create(w0)
+    server.create(pod("w1", labels={"app": "web"}, spread=[c]))
+    mgr.run_until_idle()
+    # emptier REAL domain (b) beats both the crowded one and keyless
+    assert server.get("Pod", "w1", "team-a").spec.node_name == "b1"
+
+
+def test_preferred_anti_affinity_counts_pods_per_domain():
+    """Kube scores weight x matching-pod COUNT per domain: a zone with 3
+    conflicting pods must rank below a zone with 1, not tie with it."""
+    from nos_tpu.kube.objects import WeightedPodAffinityTerm
+
+    server, mgr = rig()
+    server.create(node("a1", {"zone": "a"}))
+    server.create(node("b1", {"zone": "b"}))
+    for i, zone_node in enumerate(["a1", "b1", "b1", "b1"]):
+        p = pod(f"db-{i}", labels={"app": "db"})
+        p.spec.node_name = zone_node
+        p.status.phase = "Running"
+        server.create(p)
+    server.create(pod("web", labels={"app": "web"}, affinity=Affinity(
+        pod_anti_affinity_preferred=[WeightedPodAffinityTerm(
+            weight=10, term=aff_term("zone", app="db"))])))
+    mgr.run_until_idle()
+    # zone a: 1 db pod; zone b: 3 -> the lesser evil is a1
+    assert server.get("Pod", "web", "team-a").spec.node_name == "a1"
+
+
+def test_score_normalization_prevents_plugin_domination():
+    """kube's NormalizeScore: each plugin is a 0..100 signal regardless
+    of its raw scale — a plugin with big raw numbers (spread counts)
+    must not silently drown one with small raws (1-100 weights)."""
+    class BigRaw:
+        def score(self, state, pod, ni):
+            return {"a": -500.0, "b": 0.0}[ni.node.metadata.name]
+
+    class SmallRaw:
+        def score(self, state, pod, ni):
+            return {"a": 1.0, "b": 0.0}[ni.node.metadata.name]
+
+    f = fw.SchedulerFramework(plugins=[BigRaw(), SmallRaw()])
+    snap = fw.Snapshot.build([node("a"), node("b")], [])
+    p = pod("p")
+    ranked = f.score_and_rank({}, p, ["a", "b"], snap)
+    # raw sum would give a=-499 < b=0 (BigRaw dominates); normalized,
+    # each plugin is a full-scale 100-point signal, so they cancel and
+    # the deterministic name tiebreak decides
+    assert ranked == ["a", "b"]
