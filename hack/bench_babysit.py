@@ -156,12 +156,46 @@ def main():
     save()
     run_queue(queue, summary, save)
     best = None
+    try:        # own try: the optional artifact must not abort the publish
+        collect_landed(summary)
+    except Exception as e:              # noqa: BLE001
+        summary["collect_error"] = f"{type(e).__name__}: {e}"[:200]
     try:
         save({"publishing": time.strftime("%H:%M:%S")})
         best = publish_best(summary)
     except Exception as e:              # noqa: BLE001 — done must land
         summary["publish_error"] = f"{type(e).__name__}: {e}"[:200]
     save({"done": True, "best": best})
+
+
+def _last_json_line(name):
+    """Last JSON line of an item's log, or None (shared by landed.json
+    and the best-MFU pick so the heuristic cannot drift between them)."""
+    try:
+        with open(os.path.join(LOGDIR, f"{name}.out")) as f:
+            lines = [ln for ln in f.read().splitlines()
+                     if ln.strip().startswith("{")]
+        return json.loads(lines[-1])
+    except (OSError, ValueError, IndexError):
+        return None
+
+
+def collect_landed(summary):
+    """Gather the final JSON line of every ok item into ONE artifact
+    (bench_logs/landed.json) so transcribing hardware numbers into
+    BASELINE.json / the sharing README is a read of one file, not a
+    trawl through per-item logs — and a tunnel window that lands points
+    while nobody is watching still leaves a complete record."""
+    landed = {}
+    for name, status in summary["items"].items():
+        if status != "ok":
+            continue
+        point = _last_json_line(name)
+        landed[name] = point if point is not None \
+            else {"error": "no JSON line in log"}
+    with open(os.path.join(LOGDIR, "landed.json"), "w") as f:
+        json.dump({"collected_at": time.strftime("%H:%M:%S"),
+                   "items": landed}, f, indent=1)
 
 
 def run_queue(queue, summary, save):
@@ -211,12 +245,8 @@ def publish_best(summary):
     for name, status in summary["items"].items():
         if not name.startswith("mfu_") or status != "ok":
             continue
-        try:
-            with open(os.path.join(LOGDIR, f"{name}.out")) as f:
-                lines = [l for l in f.read().splitlines()
-                         if l.strip().startswith("{")]
-            point = json.loads(lines[-1])
-        except (OSError, ValueError, IndexError):
+        point = _last_json_line(name)
+        if point is None:
             continue
         mfu = point.get("mfu_pct")
         if mfu and (best is None or mfu > best["mfu_pct"]):

@@ -55,7 +55,10 @@ class TrainerConfig:
     n_microbatches: int = 2            # pp only
     # pp schedule: "1f1b" (P-bounded activation memory; no sp) or
     # "gpipe" (composes with sp/ring attention for dense long-context)
-    pipeline_schedule: str = "1f1b"
+    pipeline_schedule: str = "1f1b"    # 1f1b | gpipe | interleaved
+    # interleaved schedule only: layer chunks per stage (bubble ~ 1/v);
+    # params are stored chunk-major, recorded in the checkpoint stamp
+    virtual_stages: int = 2
     # run
     steps: int = 10
     batch_size: int = 8
@@ -196,18 +199,26 @@ def train(cfg: TrainerConfig, stop_event=None) -> float:
     else:
         shardings = tfm.param_shardings(mesh, model_cfg)
 
+    interleaved = pipelined and cfg.pipeline_schedule == "interleaved"
+
+    def fresh_params():
+        p = tfm.init_params(jax.random.PRNGKey(cfg.seed), model_cfg)
+        if interleaved:
+            # chunk-major layer order: the interleaved schedule's params
+            # layout (checkpoints store this order too — stamped as
+            # layer_order so a schedule drift fails by name)
+            from nos_tpu.parallel.pipeline import interleave_params
+
+            p = interleave_params(p, cfg.pp, cfg.virtual_stages)
+        return p
+
     if jax.process_count() == 1:
-        params = jax.device_put(
-            tfm.init_params(jax.random.PRNGKey(cfg.seed), model_cfg),
-            shardings)
+        params = jax.device_put(fresh_params(), shardings)
     else:
         # multi-host: host arrays can't be device_put onto non-addressable
         # devices; compile the init with the target shardings instead so
         # every process materializes only its shards
-        params = jax.jit(
-            lambda: tfm.init_params(jax.random.PRNGKey(cfg.seed), model_cfg),
-            out_shardings=shardings,
-        )()
+        params = jax.jit(fresh_params, out_shardings=shardings)()
     from nos_tpu.train.optim import build_optimizer
 
     optimizer = build_optimizer(
@@ -238,7 +249,8 @@ def train(cfg: TrainerConfig, stop_event=None) -> float:
     if pipelined:
         step_fn = jax.jit(make_pipeline_train_step(
             model_cfg, optimizer, mesh, n_microbatches=cfg.n_microbatches,
-            schedule=cfg.pipeline_schedule))
+            schedule=cfg.pipeline_schedule,
+            virtual_stages=cfg.virtual_stages))
     else:
         step_fn = jax.jit(tfm.make_train_step(model_cfg, optimizer, mesh))
 
@@ -270,13 +282,23 @@ def train(cfg: TrainerConfig, stop_event=None) -> float:
                 pipeline_1f1b_loss_fn, pipeline_loss_fn,
             )
 
-            # eval matches the training schedule: loss-only 1F1B runs the
-            # cheap forward-only rotation; gpipe (the sp-composing
-            # schedule) evaluates with its own forward
-            ploss = (pipeline_1f1b_loss_fn
-                     if cfg.pipeline_schedule == "1f1b" else pipeline_loss_fn)
-            eval_fn = jax.jit(lambda p, b: ploss(
-                p, model_cfg, b, mesh, cfg.n_microbatches))
+            # eval matches the training schedule: loss-only 1F1B and
+            # interleaved run their cheap forward-only tables; gpipe
+            # (the sp-composing schedule) evaluates with its own forward
+            if interleaved:
+                from nos_tpu.parallel.pipeline import (
+                    pipeline_interleaved_loss_fn,
+                )
+
+                eval_fn = jax.jit(lambda p, b: pipeline_interleaved_loss_fn(
+                    p, model_cfg, b, mesh, cfg.n_microbatches,
+                    cfg.virtual_stages))
+            else:
+                ploss = (pipeline_1f1b_loss_fn
+                         if cfg.pipeline_schedule == "1f1b"
+                         else pipeline_loss_fn)
+                eval_fn = jax.jit(lambda p, b: ploss(
+                    p, model_cfg, b, mesh, cfg.n_microbatches))
         else:
             eval_fn = jax.jit(
                 lambda p, b: tfm.loss_fn(p, model_cfg, b, mesh))

@@ -656,7 +656,7 @@ class PodTopologySpreadFit:
         any real domain (kube excludes keyless nodes from benefiting
         from spread scoring — otherwise every replica would pile onto
         the one unlabeled node, which no domain count ever penalizes).
-        Raw scores are per-plugin; run_score normalizes to 0..100 across
+        Raw scores are per-plugin; score_and_rank normalizes to 0..100 across
         candidates before summing with other plugins."""
         cached = state.get(self._KEY)
         if cached is None or cached[0] != id(pod) or not cached[2]:
@@ -839,12 +839,6 @@ class SchedulerFramework:
             if st.success:
                 return nominated, st
         return None, Status.unschedulable("no post-filter plugin succeeded")
-
-    def run_score(self, state: CycleState, pod: Pod, node_info: NodeInfo) -> float:
-        total = 0.0
-        for p in self._having("score"):
-            total += p.score(state, pod, node_info)
-        return total
 
     def score_and_rank(self, state: CycleState, pod: Pod,
                        names: List[str], snapshot: Snapshot) -> List[str]:

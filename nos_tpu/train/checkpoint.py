@@ -29,12 +29,25 @@ def model_arch_dict(cfg) -> dict:
     at apply time, and longer-context serving of an existing checkpoint
     is legitimate). ``n_kv_heads`` is normalized the way
     TransformerConfig reads it (0 means n_heads)."""
-    return {
+    out = {
         "vocab": cfg.vocab, "d_model": cfg.d_model,
         "n_layers": cfg.n_layers, "n_heads": cfg.n_heads,
         "n_kv_heads": cfg.n_kv_heads or cfg.n_heads,
         "d_ff": cfg.d_ff, "n_experts": cfg.n_experts,
+        # layer ORDER in the stacked layer dim: the interleaved pipeline
+        # schedule stores params chunk-major (parallel/pipeline.py
+        # interleave_params) — resuming such a checkpoint under a
+        # different schedule (or different pp x v) would silently train
+        # with permuted layers, so the order is part of the stamp.
+        # Always present: an absent key on either side would skip the
+        # comparison entirely.
+        "layer_order": "canonical",
     }
+    if getattr(cfg, "pipeline_schedule", "") == "interleaved" \
+            and getattr(cfg, "pp", 1) > 1:
+        out["layer_order"] = (
+            f"interleaved:pp={cfg.pp},v={getattr(cfg, 'virtual_stages', 2)}")
+    return out
 
 
 class CheckpointManager:

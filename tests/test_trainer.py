@@ -319,3 +319,29 @@ def test_wall_clock_checkpoint_cadence(tmp_path):
     mgr2 = CheckpointManager(d2)
     assert mgr2.manager.all_steps() == [4]   # only the final save
     mgr2.close()
+
+
+def test_trains_interleaved_and_resumes(tmp_path):
+    """Interleaved schedule reachable from the binary: trains, stamps
+    the chunk-major layer order, resumes in kind — and a resume under a
+    DIFFERENT schedule fails by field name, not silent layer permutation."""
+    d = str(tmp_path / "ckpt")
+    cfg = tiny(pp=2, dp=2, n_layers=4, n_microbatches=2,
+               pipeline_schedule="interleaved", virtual_stages=2,
+               steps=4, checkpoint_dir=d, checkpoint_every=2)
+    loss = train(cfg)
+    assert loss == loss
+    import json
+    import os
+
+    stamp = json.load(open(os.path.join(d, "model_config.json")))
+    assert stamp["layer_order"] == "interleaved:pp=2,v=2"
+    # same schedule resumes cleanly
+    loss2 = train(tiny(pp=2, dp=2, n_layers=4, n_microbatches=2,
+                       pipeline_schedule="interleaved", virtual_stages=2,
+                       steps=6, checkpoint_dir=d, checkpoint_every=2))
+    assert loss2 == loss2
+    # schedule drift -> named rejection, not permuted layers
+    with pytest.raises(ValueError, match="layer_order"):
+        train(tiny(pp=2, dp=2, n_layers=4, n_microbatches=2,
+                   steps=6, checkpoint_dir=d, checkpoint_every=2))
