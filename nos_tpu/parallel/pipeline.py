@@ -846,6 +846,11 @@ def interleave_layer_order(n_layers: int, stages: int, v: int) -> list:
     pp-sharded leading dim needs no per-step weight reshuffle. Apply with
     ``interleave_params`` before device_put; checkpoints should store the
     canonical order (invert with argsort)."""
+    if n_layers % (stages * v):
+        raise ValueError(
+            f"n_layers {n_layers} not divisible by pp*virtual_stages "
+            f"{stages}*{v}: a floored chunk size would silently DROP the "
+            f"trailing layers")
     K = n_layers // (stages * v)
     order = []
     for p in range(stages):
@@ -855,12 +860,29 @@ def interleave_layer_order(n_layers: int, stages: int, v: int) -> list:
     return order
 
 
+def _permute_layers(params: Params, idx) -> Params:
+    out = dict(params)
+    out["layers"] = jax.tree.map(lambda w: w[idx], params["layers"])
+    return out
+
+
 def interleave_params(params: Params, stages: int, v: int) -> Params:
     n_layers = next(iter(jax.tree.leaves(params["layers"]))).shape[0]
-    order = jnp.asarray(interleave_layer_order(n_layers, stages, v))
-    out = dict(params)
-    out["layers"] = jax.tree.map(lambda w: w[order], params["layers"])
-    return out
+    return _permute_layers(
+        params, jnp.asarray(interleave_layer_order(n_layers, stages, v)))
+
+
+def deinterleave_params(params: Params, stages: int, v: int) -> Params:
+    """Inverse of ``interleave_params``: restore canonical layer order.
+    Needed before serving/exporting a checkpoint trained under the
+    interleaved schedule (its stamp carries layer_order:
+    "interleaved:pp=P,v=V" so a naive consumer fails by name instead of
+    silently running permuted layers)."""
+    import numpy as np
+
+    n_layers = next(iter(jax.tree.leaves(params["layers"]))).shape[0]
+    return _permute_layers(params, jnp.asarray(np.argsort(
+        np.asarray(interleave_layer_order(n_layers, stages, v)))))
 
 
 def pipeline_interleaved_loss_fn(params: Params, cfg: TransformerConfig,

@@ -160,6 +160,13 @@ class CheckpointManager:
         have = self.read_model_config()
         if have is None:
             return
+        have = dict(have)
+        # stamps from before the layer_order field are all canonical-order
+        # checkpoints: defaulting (rather than skipping the absent key)
+        # keeps the drift guard closed when an OLD checkpoint is resumed
+        # under the interleaved schedule
+        if "layer_order" in expect:
+            have.setdefault("layer_order", "canonical")
         bad = {k: (have[k], expect[k])
                for k in expect if k in have and have[k] != expect[k]}
         if bad:

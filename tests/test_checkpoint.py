@@ -120,3 +120,45 @@ def test_async_save_completes_by_close(tmp_path):
     restored = m2.restore_params(params_template=params)
     assert jnp.allclose(restored["embed"], params["embed"])
     m2.close()
+
+
+def test_pre_layer_order_stamp_defaults_to_canonical(tmp_path):
+    """An OLD checkpoint stamp (no layer_order field) must be treated as
+    canonical order: resuming it under the interleaved schedule is the
+    exact drift the stamp exists to reject, and key-skipping comparison
+    would silently pass it."""
+    import json
+    import os
+
+    import pytest
+
+    from nos_tpu.train import CheckpointManager
+
+    d = str(tmp_path / "ckpt")
+    os.makedirs(d)
+    with open(os.path.join(d, "model_config.json"), "w") as f:
+        json.dump({"vocab": 64, "d_model": 32, "n_layers": 4,
+                   "n_heads": 4, "n_kv_heads": 4, "d_ff": 64,
+                   "n_experts": 0}, f)   # pre-layer_order era stamp
+    ck = CheckpointManager(d)
+    expect = {"vocab": 64, "d_model": 32, "n_layers": 4, "n_heads": 4,
+              "n_kv_heads": 4, "d_ff": 64, "n_experts": 0,
+              "layer_order": "interleaved:pp=2,v=2"}
+    with pytest.raises(ValueError, match="layer_order"):
+        ck.validate_model_config(expect)
+    # canonical consumer of the old stamp stays fine
+    ck.validate_model_config({**expect, "layer_order": "canonical"})
+
+
+def test_interleave_rejects_indivisible_layers():
+    import jax
+    import pytest
+
+    from nos_tpu.models import transformer as tfm
+    from nos_tpu.parallel.pipeline import interleave_params
+
+    cfg = tfm.TransformerConfig(vocab=32, d_model=16, n_layers=6,
+                                n_heads=2, d_ff=32, max_seq=16)
+    params = tfm.init_params(jax.random.PRNGKey(0), cfg)
+    with pytest.raises(ValueError, match="DROP"):
+        interleave_params(params, 2, 2)    # 6 % 4 != 0

@@ -518,3 +518,16 @@ def test_interleaved_moe_matches_gpipe():
         params, batch)
     loss, _ = _interleaved(params, cfg, batch, mesh, 2, 2)
     np.testing.assert_allclose(float(loss), float(gpipe), rtol=2e-4)
+
+
+def test_deinterleave_inverts_interleave():
+    from nos_tpu.parallel.pipeline import (deinterleave_params,
+                                           interleave_params)
+
+    cfg = small_cfg(n_layers=8)
+    params = tfm.init_params(jax.random.PRNGKey(0), cfg)
+    rt = deinterleave_params(interleave_params(params, 2, 2), 2, 2)
+    for (pa, a), (pb, b) in zip(jax.tree.leaves_with_path(params),
+                                jax.tree.leaves_with_path(rt)):
+        assert pa == pb
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
