@@ -40,6 +40,10 @@ app.kubernetes.io/managed-by: {{ .Release.Service }}
 {{- printf "%s/%s:%s" .Values.image.registry .Values.tpuAgent.image.repository (include "nos-tpu.tag" .) -}}
 {{- end -}}
 
+{{- define "nos-tpu.devicePlugin.image" -}}
+{{- printf "%s/%s:%s" .Values.image.registry .Values.devicePlugin.image.repository (include "nos-tpu.tag" .) -}}
+{{- end -}}
+
 {{- define "nos-tpu.apiServer.image" -}}
 {{- printf "%s/%s:%s" .Values.image.registry .Values.apiServer.image.repository (include "nos-tpu.tag" .) -}}
 {{- end -}}

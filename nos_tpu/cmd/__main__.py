@@ -9,6 +9,7 @@ _BINARIES = {
     "scheduler": "nos_tpu.cmd.scheduler",
     "partitioner": "nos_tpu.cmd.partitioner",
     "tpuagent": "nos_tpu.cmd.tpuagent",
+    "deviceplugin": "nos_tpu.cmd.deviceplugin",
     "metricsexporter": "nos_tpu.cmd.metricsexporter",
     "trainer": "nos_tpu.cmd.trainer",
     "generate": "nos_tpu.cmd.generate",

@@ -1,0 +1,81 @@
+"""nos-tpu-device-plugin — per-node DaemonSet advertising sub-slice
+resources to the kubelet.
+
+The consumer end of the partitioner's hand-off (analog of the NVIDIA
+device plugin the reference's MPS partitioner restarts,
+internal/partitioning/mps/partitioner.go:61-123): reads the
+``nos.ai/device-plugin.config`` node label + the
+``nos-device-plugin-config`` ConfigMap entry it names, and serves the
+kubelet Device Plugin API v1beta1 (registration, ListAndWatch,
+Allocate) from ``agents/deviceplugin.py``. Plan changes land as new
+ListAndWatch frames on the live stream — no restart, no re-register.
+"""
+from __future__ import annotations
+
+import argparse
+import logging
+import os
+import time
+from typing import Optional, Sequence
+
+from nos_tpu.agents.deviceplugin import (
+    KUBELET_SOCKET,
+    TpuDevicePlugin,
+    config_source_from_client,
+)
+from nos_tpu.cmd import serve
+
+logger = logging.getLogger("nos_tpu.deviceplugin")
+
+
+def main(argv: Optional[Sequence[str]] = None) -> None:
+    parser = argparse.ArgumentParser(prog="nos-tpu-device-plugin",
+                                     description=__doc__)
+    parser.add_argument("--node", default=os.environ.get("NODE_NAME", ""),
+                        help="this node's name (downward-API NODE_NAME)")
+    parser.add_argument("--socket-dir",
+                        default="/var/lib/kubelet/device-plugins",
+                        help="where plugin sockets live (kubelet dir)")
+    parser.add_argument("--kubelet-socket", default=KUBELET_SOCKET)
+    parser.add_argument("--poll-seconds", type=float, default=5.0,
+                        help="hand-off re-read cadence")
+    parser.add_argument("--once", action="store_true",
+                        help="one refresh then exit (smoke/debug)")
+    serve.common_flags(parser, config=False)
+    args = parser.parse_args(argv)
+    serve.setup_logging(args.log_level if args.log_level is not None else 0)
+    if not args.node:
+        parser.error("--node (or NODE_NAME) is required")
+
+    client = serve.connect(args)
+    plugin = TpuDevicePlugin(
+        config_source_from_client(client, args.node),
+        args.socket_dir, kubelet_socket=args.kubelet_socket)
+    health = serve.HealthServer(host=args.health_host,
+                                port=args.health_port).start() \
+        if args.health_port else None
+    try:
+        plugin.refresh()
+        if args.once:
+            return
+        while True:
+            time.sleep(args.poll_seconds)
+            try:
+                # transient failures (apiserver blip, partitioner
+                # mid-write, malformed entry) must NOT crash the pod: a
+                # dying plugin tears down its sockets and the kubelet
+                # zeroes every sub-slice resource until the crashloop
+                # restart re-registers — retry next poll instead
+                plugin.refresh()
+            except Exception:                      # noqa: BLE001
+                logger.exception("refresh failed; retrying next poll")
+    except KeyboardInterrupt:
+        pass
+    finally:
+        if health is not None:
+            health.stop()
+        plugin.stop()
+
+
+if __name__ == "__main__":
+    main()
