@@ -48,6 +48,10 @@ logger = logging.getLogger(__name__)
 API_VERSION = "v1beta1"
 KUBELET_SOCKET = "/var/lib/kubelet/device-plugins/kubelet.sock"
 
+# grpc raw-bytes passthrough (serializer/deserializer for every channel
+# and handler in this module — messages are hand-coded bytes)
+_IDENT = lambda b: b                             # noqa: E731
+
 __all__ = [
     "TpuDevicePlugin",
     "MockKubelet",
@@ -203,8 +207,6 @@ class _ResourceServer:
         self._devices: List[str] = []
         self._lock = threading.Lock()
 
-        ident = lambda b: b                      # noqa: E731
-
         def get_options(request, context):
             return b""                            # DevicePluginOptions{}
 
@@ -234,14 +236,14 @@ class _ResourceServer:
 
         handlers = {
             "GetDevicePluginOptions": grpc.unary_unary_rpc_method_handler(
-                get_options, request_deserializer=ident,
-                response_serializer=ident),
+                get_options, request_deserializer=_IDENT,
+                response_serializer=_IDENT),
             "ListAndWatch": grpc.unary_stream_rpc_method_handler(
-                list_and_watch, request_deserializer=ident,
-                response_serializer=ident),
+                list_and_watch, request_deserializer=_IDENT,
+                response_serializer=_IDENT),
             "Allocate": grpc.unary_unary_rpc_method_handler(
-                allocate, request_deserializer=ident,
-                response_serializer=ident),
+                allocate, request_deserializer=_IDENT,
+                response_serializer=_IDENT),
         }
         from concurrent import futures
 
@@ -249,7 +251,18 @@ class _ResourceServer:
         self._server.add_generic_rpc_handlers((
             grpc.method_handlers_generic_handler(
                 "v1beta1.DevicePlugin", handlers),))
-        self._server.add_insecure_port(f"unix://{socket_path}")
+        # a SIGKILLed predecessor leaves its socket file on the hostPath;
+        # grpc fails to bind an existing path but returns 0 instead of
+        # raising, which would leave us REGISTERED with the kubelet on an
+        # endpoint nobody serves — unlink first and verify the bind
+        try:
+            os.unlink(socket_path)
+        except FileNotFoundError:
+            pass
+        bound = self._server.add_insecure_port(f"unix://{socket_path}")
+        if bound == 0:
+            raise RuntimeError(
+                f"could not bind device-plugin socket {socket_path}")
         self._server.start()
 
     def update_devices(self, dev_ids: List[str]) -> None:
@@ -300,11 +313,10 @@ class TpuDevicePlugin:
     def _register(self, resource: str, endpoint: str) -> None:
         import grpc
 
-        ident = lambda b: b                      # noqa: E731
         channel = grpc.insecure_channel(f"unix://{self.kubelet_socket}")
         register = channel.unary_unary(
             "/v1beta1.Registration/Register",
-            request_serializer=ident, response_deserializer=ident)
+            request_serializer=_IDENT, response_deserializer=_IDENT)
         register(encode_register_request(resource, endpoint), timeout=5)
         channel.close()
 
@@ -420,8 +432,6 @@ class MockKubelet:
         self._done = threading.Event()
         self._cv = threading.Condition()
 
-        ident = lambda b: b                      # noqa: E731
-
         def register(request, context):
             req = decode_register_request(request)
             with self._cv:
@@ -437,21 +447,20 @@ class MockKubelet:
             grpc.method_handlers_generic_handler(
                 "v1beta1.Registration",
                 {"Register": grpc.unary_unary_rpc_method_handler(
-                    register, request_deserializer=ident,
-                    response_serializer=ident)}),))
+                    register, request_deserializer=_IDENT,
+                    response_serializer=_IDENT)}),))
         self._server.add_insecure_port(f"unix://{self.socket_path}")
         self._server.start()
 
     def _consume(self, req: Dict[str, str]) -> None:
         import grpc
 
-        ident = lambda b: b                      # noqa: E731
         endpoint = os.path.join(self.socket_dir, req["endpoint"])
         channel = grpc.insecure_channel(f"unix://{endpoint}")
         self._channels.append(channel)
         law = channel.unary_stream(
             "/v1beta1.DevicePlugin/ListAndWatch",
-            request_serializer=ident, response_deserializer=ident)
+            request_serializer=_IDENT, response_deserializer=_IDENT)
         try:
             for frame in law(b""):
                 with self._cv:
@@ -478,12 +487,11 @@ class MockKubelet:
                  ) -> List[Dict[str, str]]:
         import grpc
 
-        ident = lambda b: b                      # noqa: E731
         endpoint = os.path.join(self.socket_dir, req["endpoint"])
         channel = grpc.insecure_channel(f"unix://{endpoint}")
         alloc = channel.unary_unary(
             "/v1beta1.DevicePlugin/Allocate",
-            request_serializer=ident, response_deserializer=ident)
+            request_serializer=_IDENT, response_deserializer=_IDENT)
         # AllocateRequest{container_requests=1{devices_ids=1}}
         payload = _ld(1, b"".join(_str(1, d) for d in device_ids))
         raw = alloc(payload, timeout=5)

@@ -101,6 +101,11 @@ class TpuAgentConfig(_BaseConfig):
     """Analog of MigAgentConfig/GpuAgentConfig."""
 
     report_interval_seconds: float = constants.DEFAULT_REPORT_INTERVAL_S
+    # When the REAL device plugin (nos-tpu-device-plugin DaemonSet) runs
+    # on the node, the kubelet owns allocatable and the agent must not
+    # also patch node.status (two writers fight); leave True only for
+    # sim/dev clusters without the plugin.
+    manage_allocatable: bool = True
 
     def validate(self) -> None:
         if self.report_interval_seconds <= 0:

@@ -15,6 +15,7 @@ from __future__ import annotations
 import argparse
 import logging
 import os
+import threading
 import time
 from typing import Optional, Sequence
 
@@ -54,21 +55,38 @@ def main(argv: Optional[Sequence[str]] = None) -> None:
     health = serve.HealthServer(host=args.health_host,
                                 port=args.health_port).start() \
         if args.health_port else None
+
+    # pod stop sends SIGTERM, not SIGINT: without a handler the process
+    # dies skipping the finally, leaving stale sockets on the hostPath
+    # for the replacement pod to trip over
+    import signal
+
+    def _sigterm(*_):
+        raise SystemExit(0)
+
+    if threading.current_thread() is threading.main_thread():
+        signal.signal(signal.SIGTERM, _sigterm)
+
+    def safe_refresh() -> None:
+        # transient failures (apiserver blip, partitioner mid-write,
+        # malformed entry) must NOT crash the pod: a dying plugin tears
+        # down its sockets and the kubelet zeroes every sub-slice
+        # resource until the crashloop restart re-registers — retry
+        # next poll instead. The same applies at STARTUP: a bad entry
+        # must leave the pod alive and polling, not crashlooping.
+        try:
+            plugin.refresh()
+        except Exception:                          # noqa: BLE001
+            logger.exception("refresh failed; retrying next poll")
+
     try:
-        plugin.refresh()
         if args.once:
+            plugin.refresh()       # strict: smoke runs must surface errors
             return
+        safe_refresh()
         while True:
             time.sleep(args.poll_seconds)
-            try:
-                # transient failures (apiserver blip, partitioner
-                # mid-write, malformed entry) must NOT crash the pod: a
-                # dying plugin tears down its sockets and the kubelet
-                # zeroes every sub-slice resource until the crashloop
-                # restart re-registers — retry next poll instead
-                plugin.refresh()
-            except Exception:                      # noqa: BLE001
-                logger.exception("refresh failed; retrying next poll")
+            safe_refresh()
     except KeyboardInterrupt:
         pass
     finally:

@@ -42,6 +42,7 @@ def build(server, node_name: str, config: Optional[TpuAgentConfig] = None,
         node_name,
         tpu_client,
         report_interval_s=cfg.report_interval_seconds,
+        manage_allocatable=cfg.manage_allocatable,
         podres_client=podres,
     )
     agent.startup_cleanup(Client(server))

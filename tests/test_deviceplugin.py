@@ -192,3 +192,34 @@ def test_failed_registration_is_retried(socket_dir):
         kubelet.stop()
     finally:
         plugin.stop()
+
+
+def test_stale_socket_from_sigkilled_predecessor_is_replaced(socket_dir):
+    """A SIGKILLed plugin leaves its per-resource socket file on the
+    hostPath; the replacement must unlink and bind fresh — grpc returns
+    0 from add_insecure_port instead of raising, which would leave the
+    kubelet registered to an endpoint nobody serves."""
+    import os
+    import socket as pysocket
+
+    # plant a stale socket file where the plugin will bind
+    stale = os.path.join(socket_dir, "nos-tpu-tpu-slice-1x1.sock")
+    s = pysocket.socket(pysocket.AF_UNIX)
+    s.bind(stale)
+    s.close()                                    # file stays behind
+
+    server = ApiServer()
+    server.create(Node(metadata=ObjectMeta(name="n1"),
+                       status=NodeStatus(capacity={}, allocatable={})))
+    SubslicingPartitioner().apply_partitioning(
+        server, "n1", "plan-1", NodePartitioning(boards={0: {"1x1": 2}}))
+    kubelet = MockKubelet(socket_dir)
+    plugin = TpuDevicePlugin(
+        config_source_from_client(server, "n1"),
+        socket_dir, kubelet_socket=kubelet.socket_path)
+    try:
+        assert plugin.refresh() is True
+        assert kubelet.wait_for(lambda d: len(d.get(SLICE_1x1) or []) == 2)
+    finally:
+        plugin.stop()
+        kubelet.stop()
