@@ -23,6 +23,11 @@ import sys
 import time
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from bench import MFU_ENV_KNOBS, mfu_config_env  # noqa: E402 — one
+# canonical knob vocabulary + config->env mapping (drift between this
+# queue builder and bench.py's adoption gate was a reviewed bug)
 LOGDIR = os.path.join(REPO, "bench_logs")
 PROBE_TIMEOUT_S = 75
 PROBE_RETRY_WAIT_S = 120
@@ -46,13 +51,7 @@ def probe() -> bool:
 
 
 def mfu_env(batch, policy, loss_chunk, attn="flash", **extra):
-    env = {"NOS_TPU_BENCH_BATCH": str(batch), "NOS_TPU_ATTN_IMPL": attn}
-    if policy == "none":
-        env["NOS_TPU_BENCH_REMAT"] = "0"
-    else:
-        env["NOS_TPU_BENCH_REMAT_POLICY"] = policy
-    if loss_chunk:
-        env["NOS_TPU_BENCH_LOSS_CHUNK"] = str(loss_chunk)
+    env = mfu_config_env(batch, policy, loss_chunk, attn)
     env.update(extra)
     return env
 
@@ -258,9 +257,7 @@ def publish_best(summary):
     # scrub stale sweep knobs first (bench_sweep.py:28-31 discipline): a
     # leftover export must not make the re-run measure a DIFFERENT
     # config than the recorded winning_config
-    for knob in ("NOS_TPU_BENCH_BATCH", "NOS_TPU_BENCH_REMAT",
-                 "NOS_TPU_BENCH_REMAT_POLICY", "NOS_TPU_BENCH_FAULT",
-                 "NOS_TPU_BENCH_LOSS_CHUNK", "NOS_TPU_ATTN_IMPL"):
+    for knob in MFU_ENV_KNOBS:
         env.pop(knob, None)
     policy = best.get("remat_policy", "full")
     env.update(mfu_env(best.get("batch", 8), policy,

@@ -205,3 +205,43 @@ class TestLastMeasuredFallback:
         sched = {"mfu_error": "x"}
         bench.attach_last_measured(sched)
         assert "last_measured" not in sched
+
+
+def test_best_measured_config_adoption(tmp_path, monkeypatch):
+    """bench.py adopts the babysitter's hardware-measured winning config
+    when no explicit env knobs are set — and NEVER overrides explicit
+    ones (a sweep landing unattended must upgrade the artifact, an
+    operator's deliberate knob must win)."""
+    import json as _json
+    import os
+
+    import bench
+
+    # point the reader at a scratch bench_logs (it resolves the file
+    # relative to bench.__file__)
+    monkeypatch.setattr(bench, "__file__",
+                        str(tmp_path / "bench.py"))
+    (tmp_path / "bench_logs").mkdir()
+    for knob in ("NOS_TPU_BENCH_BATCH", "NOS_TPU_BENCH_REMAT",
+                 "NOS_TPU_BENCH_REMAT_POLICY", "NOS_TPU_BENCH_LOSS_CHUNK",
+                 "NOS_TPU_ATTN_IMPL"):
+        monkeypatch.delenv(knob, raising=False)
+
+    assert bench.best_measured_config() == {}    # no file yet
+    (tmp_path / "bench_logs" / "bench_best.json").write_text(
+        _json.dumps({"winning_config": {
+            "attn_impl": "splash", "batch": 16,
+            "remat_policy": "except_mlp", "loss_chunk": 512,
+            "mfu_pct": 43.0}}) + "\n")
+    env = bench.best_measured_config()
+    assert env == {"NOS_TPU_BENCH_BATCH": "16",
+                   "NOS_TPU_ATTN_IMPL": "splash",
+                   "NOS_TPU_BENCH_REMAT_POLICY": "except_mlp",
+                   "NOS_TPU_BENCH_LOSS_CHUNK": "512"}
+    monkeypatch.setenv("NOS_TPU_ATTN_IMPL", "flash")
+    assert bench.best_measured_config() == {}    # explicit knob wins
+    monkeypatch.delenv("NOS_TPU_ATTN_IMPL")
+    # a file with no measured mfu must not be adopted
+    (tmp_path / "bench_logs" / "bench_best.json").write_text(
+        _json.dumps({"winning_config": {"batch": 32}}) + "\n")
+    assert bench.best_measured_config() == {}
