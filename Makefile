@@ -3,7 +3,7 @@
 PYTHON ?= python
 IMAGE_REGISTRY ?= ghcr.io/nos-tpu
 VERSION ?= 0.1.0
-COMPONENTS = apiserver operator scheduler partitioner tpuagent metricsexporter trainer server
+COMPONENTS = apiserver operator scheduler partitioner tpuagent deviceplugin metricsexporter trainer server
 
 .PHONY: test
 test:  ## Run the unit + integration suite (virtual 8-device CPU mesh for JAX tests).
