@@ -308,10 +308,11 @@ def run_scale(pools: int = 16, gangs: int = 8, singles: int = 244,
     mgr = Manager(server)
     mgr.add_controller(Scheduler().controller())
 
-    for pool in range(pools):   # pools x 64 hosts, 4 chips/host
-        make_pool(server, f"pool-{pool:02d}", V5P, "4x8x8", 64, 4)
+    HOSTS, CHIPS = 64, 4        # one 4x8x8 v5p pool's shape
+    for pool in range(pools):
+        make_pool(server, f"pool-{pool:02d}", V5P, "4x8x8", HOSTS, CHIPS)
     server.create(make_elastic_quota("q-scale", "team-scale",
-                                     min={TPU: pools * 256}))
+                                     min={TPU: pools * HOSTS * CHIPS}))
     mgr.run_until_idle()
 
     pods = []
@@ -342,7 +343,7 @@ def run_scale(pools: int = 16, gangs: int = 8, singles: int = 244,
     ts = sorted(bind_t.values())
     gaps = [b - a for a, b in zip(ts, ts[1:])]
     return {
-        f"{prefix}_nodes": pools * 64,
+        f"{prefix}_nodes": pools * HOSTS,
         f"{prefix}_pods": len(pods),
         f"{prefix}_p50_s": round(q(lat, 50), 6) if lat else None,
         f"{prefix}_p99_s": round(q(lat, 99), 6) if lat else None,

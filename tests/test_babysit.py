@@ -1,0 +1,114 @@
+"""The hardware-queue babysitter machine itself (hack/bench_babysit.py):
+queue execution, gating, requeue attribution, and the incremental
+artifacts (landed.json + the bench_best.json pointer bench.py adopts).
+The real tunnel can be down for a whole round — the machine must be
+provably correct before a rare window spends itself on it."""
+import importlib.util
+import json
+import os
+
+
+def load_bb(tmp_path):
+    spec = importlib.util.spec_from_file_location(
+        "bb_under_test", os.path.join(os.path.dirname(__file__), "..",
+                                      "hack", "bench_babysit.py"))
+    bb = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(bb)
+    bb.LOGDIR = str(tmp_path)
+    bb.PROBE_RETRY_WAIT_S = 0.01
+    return bb
+
+
+def _item(name, code, requires=None, timeout=30):
+    return (name, ["-c", code], {}, timeout, requires)
+
+
+OK_MFU = ("import json; print(json.dumps({'mfu_pct': 41.0, 'batch': 8, "
+          "'remat_policy': 'full', 'attn_impl': 'flash'}))")
+BETTER_MFU = ("import json; print(json.dumps({'mfu_pct': 43.5, 'batch': 16, "
+              "'remat_policy': 'except_mlp', 'loss_chunk': 512, "
+              "'attn_impl': 'flash'}))")
+
+
+def test_queue_runs_gates_and_lands_incrementally(tmp_path, monkeypatch):
+    bb = load_bb(tmp_path)
+    monkeypatch.setattr(bb, "probe", lambda: True)
+    queue = [
+        _item("parity_flash", "print('{\"max_abs_diff\": 0.01}')"),
+        _item("mfu_a", OK_MFU, requires="parity_flash"),
+        _item("mfu_b", BETTER_MFU, requires="parity_flash"),
+        _item("parity_splash", "import sys; sys.exit(1)"),     # gate FAILS
+        _item("mfu_splash", OK_MFU, requires="parity_splash"),  # must skip
+    ]
+    queue = [(n, a, e, t, r, 0) for n, a, e, t, r in queue]
+    summary = {"items": {}}
+    bb.run_queue(queue, summary, lambda extra=None: None)
+
+    assert summary["items"]["parity_flash"] == "ok"
+    assert summary["items"]["mfu_a"] == "ok"
+    assert summary["items"]["mfu_b"] == "ok"
+    assert summary["items"]["parity_splash"] == "rc=1"
+    assert summary["items"]["mfu_splash"].startswith("skipped: gate")
+
+    # incremental artifacts landed DURING the queue, not only at drain
+    landed = json.load(open(tmp_path / "landed.json"))
+    assert landed["items"]["mfu_b"]["mfu_pct"] == 43.5
+    assert "mfu_splash" not in landed["items"]
+    best = json.loads(open(tmp_path / "bench_best.json").readline())
+    assert best["winning_config"] == {
+        "attn_impl": "flash", "batch": 16, "remat_policy": "except_mlp",
+        "loss_chunk": 512, "mfu_pct": 43.5}
+
+
+def test_tunnel_death_requeues_at_head(tmp_path, monkeypatch):
+    bb = load_bb(tmp_path)
+    # item times out; post-mortem probe says tunnel DEAD -> requeue at
+    # head; second attempt (tunnel back) succeeds
+    probes = iter([True,          # pre-item probe, attempt 1
+                   False,         # post-timeout attribution: tunnel died
+                   True,          # pre-item probe, attempt 2
+                   ])
+    monkeypatch.setattr(bb, "probe", lambda: next(probes, True))
+    calls = {"n": 0}
+    real_run = bb.run_item
+
+    def flaky_run(name, argv, env, timeout_s, attempt):
+        calls["n"] += 1
+        if calls["n"] == 1:
+            return "timeout"
+        return real_run(name, argv, env, timeout_s, attempt)
+
+    monkeypatch.setattr(bb, "run_item", flaky_run)
+    queue = [(n, a, e, t, r, 0) for n, a, e, t, r in
+             [_item("mfu_x", OK_MFU)]]
+    summary = {"items": {}}
+    bb.run_queue(queue, summary, lambda extra=None: None)
+    assert summary["items"]["mfu_x"] == "ok"
+    assert calls["n"] == 2
+
+
+def test_wedged_item_with_live_tunnel_is_failed_not_requeued(
+        tmp_path, monkeypatch):
+    bb = load_bb(tmp_path)
+    monkeypatch.setattr(bb, "probe", lambda: True)   # tunnel alive
+    monkeypatch.setattr(bb, "run_item",
+                        lambda *a, **k: "timeout")
+    queue = [(n, a, e, t, r, 0) for n, a, e, t, r in
+             [_item("mfu_wedge", OK_MFU)]]
+    summary = {"items": {}}
+    bb.run_queue(queue, summary, lambda extra=None: None)
+    assert summary["items"]["mfu_wedge"] == "failed: wedged with tunnel up"
+
+
+def test_select_best_ignores_non_ok_and_non_mfu(tmp_path):
+    bb = load_bb(tmp_path)
+    (tmp_path / "mfu_good.out").write_text(
+        json.dumps({"mfu_pct": 40.0, "batch": 8,
+                    "remat_policy": "full", "attn_impl": "flash"}) + "\n")
+    (tmp_path / "mfu_failed.out").write_text(
+        json.dumps({"mfu_pct": 99.0}) + "\n")
+    (tmp_path / "decode.out").write_text(
+        json.dumps({"mfu_pct": 98.0}) + "\n")
+    best = bb.select_best({"items": {
+        "mfu_good": "ok", "mfu_failed": "rc=1", "decode": "ok"}})
+    assert best["mfu_pct"] == 40.0
