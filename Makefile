@@ -17,6 +17,10 @@ bench:  ## Run the headline benchmark (prints one JSON line).
 bench-sweep:  ## Sweep remat policy x batch x loss-chunk for the MFU config.
 	$(PYTHON) bench_sweep.py
 
+.PHONY: bench-sched
+bench-sched:  ## Scheduler scaling curve (1024- and 4096-node points; --profile via BENCH_SCHED_FLAGS).
+	$(PYTHON) bench_sched.py $(BENCH_SCHED_FLAGS)
+
 .PHONY: bench-attn
 bench-attn:  ## Compare attention kernels (splash/flash/xla) at the flagship shape.
 	$(PYTHON) bench_attn.py

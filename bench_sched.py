@@ -27,6 +27,7 @@ import time
 sys.path.insert(0, ".")
 
 from nos_tpu import constants                               # noqa: E402
+from nos_tpu import observability as obs                    # noqa: E402
 from nos_tpu.api.quota import make_elastic_quota            # noqa: E402
 from nos_tpu.kube import ApiServer, Manager                 # noqa: E402
 from nos_tpu.kube.objects import (                          # noqa: E402
@@ -225,6 +226,12 @@ def run_once_wire():
         stop.set()
         watcher.join(timeout=2)
         api.unsubscribe(sub)
+        # stop the manager's own watch subscriptions BEFORE killing the
+        # sim: orphaned watch threads re-listing a dead server log a
+        # traceback per second each, and with 3 wire reps x 4 kinds that
+        # background churn measurably inflated the later scale4k point
+        # (~0.5s of its burst wall).
+        mgr.stop()
         sim.stop()
 
     lat = {k: (bind_t.get(k) - t0 if bind_t.get(k) else None)
@@ -323,6 +330,17 @@ def run_scale(pools: int = 16, gangs: int = 8, singles: int = 244,
     for i in range(singles):
         pods.append(single_pod(f"one-{i:03d}", "team-scale", 4))
 
+    # service-time + sweep-width percentiles come from the scheduler's
+    # OWN histograms (nos_scheduler_service_seconds /
+    # nos_scheduler_sweep_nodes_visited) — the bench enables raw-sample
+    # retention (off in production daemons), marks the buffers, and reads
+    # the window back, so bench and runtime report from the same counters
+    # instead of the bench re-deriving timings.
+    obs.SCHEDULE_SERVICE.enable_sample_tracking()
+    obs.SWEEP_WIDTH.enable_sample_tracking()
+    svc_mark = obs.SCHEDULE_SERVICE.num_samples()
+    sweep_mark = obs.SWEEP_WIDTH.num_samples()
+
     for p in pods:
         submit_t[(p.metadata.namespace, p.metadata.name)] = time.perf_counter()
         server.create(p)
@@ -334,30 +352,52 @@ def run_scale(pools: int = 16, gangs: int = 8, singles: int = 244,
     def q(xs, p):
         return statistics.quantiles(xs, n=100)[p - 1] if len(xs) > 1 else xs[0]
 
-    # submit->bind latency under a 500-pod BURST mixes queue wait with
-    # scheduling work: the p99 pod mostly *waited in line*. The
-    # inter-bind gap (service time per pod, gang placements amortized
-    # over their members) is the per-pod cost the scheduler actually
-    # controls — published separately so the tail is attributable
-    # (VERDICT r3 weak #6).
+    def hq(hist, p, mark):
+        return hist.quantile(p / 100.0, since=mark)
+
+    # submit->bind latency under a burst mixes queue wait with scheduling
+    # work: the p99 pod mostly *waited in line*, so the headline service
+    # numbers are the scheduler's per-pod attempt cost (gang placements
+    # amortized over their members) read from the runtime histogram. The
+    # inter-bind gap — the r3-r5 definition — is still published as
+    # ``*_interbind_*`` so the curve stays comparable across rounds.
     ts = sorted(bind_t.values())
     gaps = [b - a for a, b in zip(ts, ts[1:])]
+    svc_p50 = hq(obs.SCHEDULE_SERVICE, 50, svc_mark)
+    svc_p99 = hq(obs.SCHEDULE_SERVICE, 99, svc_mark)
     return {
         f"{prefix}_nodes": pools * HOSTS,
         f"{prefix}_pods": len(pods),
         f"{prefix}_p50_s": round(q(lat, 50), 6) if lat else None,
         f"{prefix}_p99_s": round(q(lat, 99), 6) if lat else None,
-        f"{prefix}_service_p50_ms": round(q(gaps, 50) * 1e3, 3)
+        f"{prefix}_service_p50_ms": round(svc_p50 * 1e3, 3)
+        if svc_p50 is not None else None,
+        f"{prefix}_service_p99_ms": round(svc_p99 * 1e3, 3)
+        if svc_p99 is not None else None,
+        f"{prefix}_interbind_p50_ms": round(q(gaps, 50) * 1e3, 3)
         if gaps else None,
-        f"{prefix}_service_p99_ms": round(q(gaps, 99) * 1e3, 3)
+        f"{prefix}_interbind_p99_ms": round(q(gaps, 99) * 1e3, 3)
         if gaps else None,
+        f"{prefix}_sweep_nodes_p50": hq(obs.SWEEP_WIDTH, 50, sweep_mark),
+        f"{prefix}_sweep_nodes_p99": hq(obs.SWEEP_WIDTH, 99, sweep_mark),
         f"{prefix}_burst_wall_s": round(ts[-1] - min(submit_t.values()), 3)
         if ts else None,
         f"{prefix}_unbound_pods": unbound,
     }
 
 
-def main():
+def main(argv=None):
+    import argparse
+
+    ap = argparse.ArgumentParser(
+        description="Scheduler latency/utilization benchmark "
+                    "(prints ONE JSON line on stdout)")
+    ap.add_argument(
+        "--profile", action="store_true",
+        help="cProfile the scale batch passes: dump stats to "
+             "bench_logs/bench_sched.prof and print the top entries to "
+             "stderr (stdout stays the single JSON line)")
+    args = ap.parse_args(argv)
     reps = 5
     gang_lat, sub_lat = [], []
     utils = []
@@ -401,16 +441,42 @@ def main():
         ms_unbound += u
         ms_pools = pools
 
+    if args.profile:
+        import cProfile
+        import io
+        import os
+        import pstats
+
+        profiler = cProfile.Profile()
+        profiler.enable()
     scale = run_scale()
     scale4k = run_scale(pools=64, gangs=32, singles=976, prefix="scale4k")
+    if args.profile:
+        profiler.disable()
+        os.makedirs("bench_logs", exist_ok=True)
+        prof_path = os.path.join("bench_logs", "bench_sched.prof")
+        profiler.dump_stats(prof_path)
+        buf = io.StringIO()
+        pstats.Stats(profiler, stream=buf).sort_stats(
+            "cumulative").print_stats(30)
+        print(f"--profile: scale + scale4k batch passes -> {prof_path}",
+              file=sys.stderr)
+        print(buf.getvalue(), file=sys.stderr)
     result = {
         # HEADLINE: per-pod service time under the 1024-node/500-pod
-        # burst (inter-bind gap — the cost the scheduler controls, queue
-        # wait excluded). Chosen as the cross-round metric because its
-        # definition is burst-shape-independent; submit->bind percentiles
-        # under a burst move whenever batching behavior does.
-        "metric": "per-pod scheduler service time p50 (inter-bind gap), "
-                  "1024-node/500-pod burst, 256-chip v5p JobSets",
+        # burst. Since r06 this is read from the scheduler's own
+        # nos_scheduler_service_seconds histogram (one attempt's wall
+        # time, gang binds amortized over their members) — the r3-r5
+        # inter-bind-gap definition is still published as
+        # scale_interbind_* for cross-round comparison.
+        "metric": "per-pod scheduler service time p50 (runtime histogram, "
+                  "gang-amortized), 1024-node/500-pod burst, "
+                  "256-chip v5p JobSets",
+        "metric_note": (
+            "definition shifted in r6: value now reads the scheduler's "
+            "service-time histogram; the r3-r5 inter-bind-gap series "
+            "continues as scale_interbind_p50_ms/scale_interbind_p99_ms "
+            "— compare rounds within one series, not across them"),
         "value": scale["scale_service_p50_ms"],
         "unit": "ms",
         "vs_baseline": None,   # reference publishes no scheduler latency (SURVEY §6)

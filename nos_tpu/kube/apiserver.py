@@ -120,6 +120,12 @@ class ApiServer:
     def create(self, obj) -> object:
         with self._lock:
             kind = kind_of(obj)
+            # one private copy for the store (the caller keeps its own
+            # object), one shared copy for the watch event AND the return
+            # value: both audiences treat delivered objects as immutable
+            # snapshots (the documented watch contract — see
+            # scheduler/cache.py), and the store object never escapes
+            # un-copied, so two copies do what four used to.
             obj = deep_copy(obj)
             key = (obj.metadata.namespace, obj.metadata.name)
             bucket = self._store.setdefault(kind, {})
@@ -130,9 +136,10 @@ class ApiServer:
             obj.metadata.resource_version = next(self._rv)
             if not obj.metadata.creation_timestamp:
                 obj.metadata.creation_timestamp = self._clock()
-            bucket[key] = deep_copy(obj)
-            self._emit(WatchEvent("ADDED", kind, deep_copy(obj)))
-            return deep_copy(obj)
+            bucket[key] = obj
+            out = deep_copy(obj)
+            self._emit(WatchEvent("ADDED", kind, out))
+            return out
 
     def get(self, kind: str, name: str, namespace: str = "") -> object:
         with self._lock:
@@ -189,7 +196,11 @@ class ApiServer:
                     f"!= {current.metadata.resource_version}"
                 )
             obj = deep_copy(obj)
-            self._admit("UPDATE", obj, deep_copy(current))
+            # admission sees the store's outgoing object directly: after
+            # this update replaces bucket[key], ``current`` is orphaned —
+            # hooks (and the MODIFIED event's ``old``) only read it, so
+            # copying it twice per update bought nothing
+            self._admit("UPDATE", obj, current)
             obj.metadata.uid = current.metadata.uid
             obj.metadata.creation_timestamp = current.metadata.creation_timestamp
             # no-op updates keep the resourceVersion and emit no event
@@ -199,20 +210,21 @@ class ApiServer:
             if obj == current:
                 return deep_copy(current)
             obj.metadata.resource_version = next(self._rv)
-            old = deep_copy(current)
-            bucket[key] = deep_copy(obj)
-            self._emit(WatchEvent("MODIFIED", kind, deep_copy(obj), old))
-            return deep_copy(obj)
+            bucket[key] = obj
+            out = deep_copy(obj)
+            self._emit(WatchEvent("MODIFIED", kind, out, current))
+            return out
 
     def patch(self, kind: str, name: str, namespace: str, mutate: Callable[[object], None]) -> object:
         """Atomic read-modify-write — the moral equivalent of a merge PATCH
         (the reference patches node annotations and pod labels constantly;
         e.g. internal/partitioning/mig/partitioner.go:43-77)."""
         with self._lock:
-            obj = self.get(kind, name, namespace)
-            before = deep_copy(obj)
+            obj = self.get(kind, name, namespace)   # private copy
+            rv = obj.metadata.resource_version
             mutate(obj)
-            obj.metadata.resource_version = before.metadata.resource_version
+            # the mutate fn must not fabricate optimistic-concurrency wins
+            obj.metadata.resource_version = rv
             return self.update(obj)
 
     def delete(self, kind: str, name: str, namespace: str = "") -> None:

@@ -33,6 +33,7 @@ Fidelity points deliberately mirrored from a real apiserver:
 """
 from __future__ import annotations
 
+import bisect
 import copy
 import itertools
 import json
@@ -314,9 +315,16 @@ class K8sSim:
             except (BrokenPipeError, ConnectionResetError, OSError):
                 return False
 
-        idx = 0
+        # the log is rv-ascending (one global counter), so a resuming
+        # watch can bisect straight to its resourceVersion instead of
+        # re-scanning every event since process start — with long-lived
+        # sims the full replay made each (re)subscribe O(total writes)
+        with self.store.lock:
+            idx = bisect.bisect_right(self.store.log, since,
+                                      key=lambda e: e[0])
         deadline = time.monotonic() + 300
         while time.monotonic() < deadline:
+            batch: List[dict] = []
             with self.store.lock:
                 while idx < len(self.store.log):
                     rv, etype, g, r, obj = self.store.log[idx]
@@ -326,10 +334,15 @@ class K8sSim:
                     if parts["namespace"] and \
                             (obj.get("metadata") or {}).get("namespace") != parts["namespace"]:
                         continue
-                    if not send_line({"type": etype, "object": obj}):
-                        return
-                if not self.store.lock.wait(timeout=1.0):
+                    batch.append({"type": etype, "object": obj})
+                if not batch and not self.store.lock.wait(timeout=1.0):
                     continue
+            # write outside the store lock: a slow watch client must not
+            # stall every writer in the sim (log entries are append-only
+            # deep copies, safe to serialize unlocked)
+            for payload in batch:
+                if not send_line(payload):
+                    return
         try:
             h.wfile.write(b"0\r\n\r\n")
         except OSError:

@@ -42,6 +42,26 @@ SCHEDULE_DURATION = _r.histogram(
     "nos_scheduler_e2e_duration_seconds",
     "Wall time to schedule one pod (PreFilter through Bind).",
 )
+SCHEDULE_SERVICE = _r.histogram(
+    "nos_scheduler_service_seconds",
+    "Per-pod scheduling service time: one attempt's wall time, amortized "
+    "over the pods the attempt bound (a 32-worker gang placement counts "
+    "as 32 samples of duration/32). The bench's scale_service_* "
+    "percentiles read THIS histogram — runtime and bench report from the "
+    "same counters.",
+    buckets=(0.0002, 0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025,
+             0.05, 0.1, 0.25, 1.0, 5.0),
+)
+SWEEP_WIDTH = _r.histogram(
+    "nos_scheduler_sweep_nodes_visited",
+    "Nodes the feasibility sweep ran the filter pipeline on, per pod "
+    "attempt (nodes pruned by the free-capacity index are not counted — "
+    "this is the sweep width the scheduler actually pays for).",
+    buckets=(1, 2, 5, 10, 25, 50, 100, 250, 1000, 4096, 16384),
+)
+# NOTE: bench_sched calls enable_sample_tracking() on the two histograms
+# above to read exact percentiles; production daemons never do, so they
+# pay buckets only — no raw-sample buffers.
 PREEMPTION_VICTIMS = _r.counter(
     "nos_scheduler_preemption_victims_total",
     "Pods deleted as preemption victims by the capacity plugin.",

@@ -29,6 +29,10 @@ class SnapshotNode:
 
     def refresh_allocatable(self) -> None:
         """Propagate board geometry into the simulated node allocatable."""
+        # a COW NodeInfo clone shares its node object with the original
+        # until told otherwise — geometry rewrites must go to a private
+        # copy or the fork would leak into the committed snapshot
+        self.node_info.own_node()
         node = self.node_info.node
         node.status.allocatable = self.tpu_node.allocatable_scalar_resources(
             node.status.allocatable
@@ -48,6 +52,7 @@ class ClusterSnapshot:
     def __init__(self, nodes: Optional[Dict[str, SnapshotNode]] = None):
         self._nodes: Dict[str, SnapshotNode] = nodes or {}
         self._forked: Optional[Dict[str, SnapshotNode]] = None
+        self._fw_snap: Optional[fw.Snapshot] = None
 
     # -- fork/commit/revert --------------------------------------------------
     def fork(self) -> None:
@@ -63,6 +68,7 @@ class ClusterSnapshot:
             raise RuntimeError("snapshot not forked")
         self._nodes = self._forked
         self._forked = None
+        self._fw_snap = None    # node objects were just replaced wholesale
 
     def clone(self) -> "ClusterSnapshot":
         return ClusterSnapshot({name: sn.clone() for name, sn in self._nodes.items()})
@@ -84,10 +90,21 @@ class ClusterSnapshot:
         ]
 
     def framework_snapshot(self) -> fw.Snapshot:
-        snap = fw.Snapshot()
-        for name, sn in self._nodes.items():
-            snap[name] = sn.node_info
-        return snap
+        """fw.Snapshot over the live SnapshotNodes. Cached: the planner
+        calls this once per (pod, candidate) what-if, and rebuilding a
+        cluster-wide Snapshot (which rewires per-node callbacks and cold-
+        starts the free-capacity index) per call made the simulation
+        O(nodes) before any filter ran. The cache stays valid across
+        fork/commit/add_pod — those keep the same NodeInfo objects, whose
+        mutations flow into the cached snapshot's indexes through the
+        on_change hooks — and invalidates on revert, which swaps the node
+        objects wholesale."""
+        if self._fw_snap is None:
+            snap = fw.Snapshot()
+            for name, sn in self._nodes.items():
+                snap[name] = sn.node_info
+            self._fw_snap = snap
+        return self._fw_snap
 
     # -- resource math -------------------------------------------------------
     def cluster_available(self) -> ResourceList:

@@ -18,4 +18,5 @@ from nos_tpu.scheduler.framework import (  # noqa: F401
     Status,
 )
 from nos_tpu.scheduler.capacity import CapacityScheduling  # noqa: F401
+from nos_tpu.scheduler.capindex import FreeCapacityIndex  # noqa: F401
 from nos_tpu.scheduler.scheduler import Scheduler  # noqa: F401

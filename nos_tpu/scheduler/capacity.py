@@ -216,23 +216,56 @@ class CapacityScheduling:
     # ------------------------------------------------------------------
     # PostFilter: preemption
     # ------------------------------------------------------------------
+    # Preemption candidate-evaluation cap (kube's preemption dry-run caps
+    # candidates the same way: minCandidateNodesAbsolute). Victim
+    # selection simulates evictions + reprieves per node — O(pods on
+    # node) each — so on a big, busy cluster an uncapped sweep is the
+    # tail. Once at least one viable candidate exists, evaluation stops
+    # after this many screened nodes; while NO candidate has been found
+    # the sweep keeps going, so schedulability is never sacrificed. The
+    # cap applies identically with the index on or off.
+    MAX_PREEMPTION_CANDIDATES = 128
+
     def post_filter(
         self, state: fw.CycleState, pod: Pod, snapshot: fw.Snapshot
     ) -> Tuple[Optional[str], fw.Status]:
-        """Evaluate preemption on every node; pick the node needing the
-        fewest victims (ties: lexical). Returns (node, status); the caller
-        (scheduler loop) deletes ``state['capacity/victims']`` and nominates
-        the pod."""
+        """Evaluate preemption on candidate nodes; pick the node needing
+        the fewest victims (ties: lexical). Returns (node, status); the
+        caller (scheduler loop) deletes ``state['capacity/victims']`` and
+        nominates the pod.
+
+        Candidates come from a screen both sweep modes share: a node must
+        hold at least one pod (something to evict) and its *allocatable*
+        must cover the pod's indexed resources (otherwise NodeResourcesFit
+        still fails after every eviction, so victim selection provably
+        returns None). With the free-capacity index on, the screen reads
+        the index's per-node cache; with it off, the same predicate is
+        computed from each NodeInfo — identical candidate lists, in
+        lexical order, either way."""
+        from nos_tpu.scheduler.capindex import allocatable_covers
+
         best_node: Optional[str] = None
         best_victims: Optional[List[Pod]] = None
         best_rank: Optional[Tuple[int, int]] = None
         gang_index = self._gang_index(snapshot)  # once; reused per node
-        for name, info in sorted(snapshot.items()):
+        req = pod.request()
+        if self._fwk().use_index:
+            names = snapshot.capacity_index().preempt_candidates(req)
+        else:
+            names = [
+                name for name in snapshot.ordered_names()
+                if snapshot[name].pods
+                and allocatable_covers(snapshot[name], req)
+            ]
+        evaluated = 0
+        for name in names:
+            info = snapshot[name]
             # the what-if fit must count pods already nominated to this node
             # by earlier preemption passes (their capacity is spoken for)
             state[NOMINATED_STATE] = snapshot.nominated_for(name, exclude=pod)
             selected = self._select_victims_on_node(
                 state, pod, info, gang_index, snapshot=snapshot)
+            evaluated += 1
             if selected is None:
                 continue
             victims, num_violating = selected
@@ -243,6 +276,9 @@ class CapacityScheduling:
                 best_node = name
                 best_victims = victims
                 best_rank = rank
+            if evaluated >= self.MAX_PREEMPTION_CANDIDATES \
+                    and best_rank is not None:
+                break
         state.pop(NOMINATED_STATE, None)
         if best_node is None:
             return None, fw.Status.unschedulable("preemption found no candidate")

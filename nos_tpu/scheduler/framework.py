@@ -12,6 +12,8 @@ from __future__ import annotations
 from dataclasses import dataclass, field
 from typing import Callable, Dict, List, Optional, Tuple
 
+from nos_tpu import observability as obs
+from nos_tpu.scheduler.capindex import INDEXED_RESOURCES
 from nos_tpu.kube.objects import (
     Node,
     Pod,
@@ -97,6 +99,15 @@ class NodeInfo:
     # the snapshot polling every node
     on_anti_change: Optional[Callable[[], None]] = field(
         default=None, repr=False, compare=False)
+    # set by Snapshot.__setitem__: fired on ANY capacity-relevant change
+    # (pod added/removed, requested-cache invalidated) so the snapshot's
+    # free-capacity index can lazily re-bucket this node (capindex.py)
+    on_change: Optional[Callable[[], None]] = field(
+        default=None, repr=False, compare=False)
+    # copy-on-write state: a clone() shares the pod list / node object of
+    # its source until the first mutation materializes a private copy
+    _shared_pods: bool = field(default=False, repr=False, compare=False)
+    _shared_node: bool = field(default=False, repr=False, compare=False)
 
     @staticmethod
     def _has_required_anti(pod: Pod) -> bool:
@@ -120,6 +131,8 @@ class NodeInfo:
         self._req_cache = None
         self._avail_cache = None
         self._anti_cache = None
+        if self.on_change is not None:
+            self.on_change()
 
     def anti_affinity_pods(self) -> List[Pod]:
         """Active pods on this node declaring required anti-affinity
@@ -142,11 +155,29 @@ class NodeInfo:
             }
         return self._avail_cache   # callers treat as read-only
 
+    def _materialize_pods(self) -> None:
+        if self._shared_pods:
+            self.pods = list(self.pods)
+            self._shared_pods = False
+
+    def own_node(self) -> None:
+        """Detach a COW clone's shared ``node`` before mutating it (the
+        partitioning fork path rewrites ``status.allocatable`` after a
+        geometry change; nothing else writes through ``.node``)."""
+        if self._shared_node:
+            from nos_tpu.kube.objects import deep_copy
+
+            self.node = deep_copy(self.node)
+            self._shared_node = False
+
     def add_pod(self, pod: Pod) -> None:
+        self._materialize_pods()
         self.pods.append(pod)
         if self._req_cache is not None:
             self._req_cache = add_resources(self._req_cache, pod.request())
         self._avail_cache = None
+        if self.on_change is not None:
+            self.on_change()
         if self._has_required_anti(pod):
             if self._anti_cache is not None:
                 self._anti_cache.append(pod)
@@ -159,6 +190,7 @@ class NodeInfo:
                 p.metadata.namespace == pod.metadata.namespace
                 and p.metadata.name == pod.metadata.name
             ):
+                self._materialize_pods()
                 del self.pods[i]
                 self.invalidate_requested()
                 if self._has_required_anti(p) \
@@ -168,9 +200,28 @@ class NodeInfo:
         return False
 
     def clone(self) -> "NodeInfo":
-        from nos_tpu.kube.objects import deep_copy
-
-        return NodeInfo(deep_copy(self.node), [deep_copy(p) for p in self.pods], self.calculator)
+        """Copy-on-write clone: source and clone share the node object
+        and pod list until EITHER side's first mutation (add_pod /
+        remove_pod / own_node) materializes a private copy for itself —
+        both sides are flagged shared because mutation can land on either
+        end (the partitioning fork keeps the CLONE as the pristine backup
+        and mutates the ORIGINAL; the preemption sim mutates the CLONE).
+        Pod objects themselves are never copied — everything in the
+        scheduler treats pods as immutable snapshots (watch events
+        deliver replacements, the bind path patches through the
+        apiserver), so sharing them is safe. What used to be an O(pods)
+        deep copy per trial placement is now O(1) until (unless) the
+        trial actually mutates the node."""
+        c = NodeInfo(self.node, self.pods, self.calculator)
+        c._shared_pods = True
+        c._shared_node = True
+        self._shared_pods = True
+        self._shared_node = True
+        # _req_cache is replaced (never mutated in place) by add_pod, so
+        # the clone may inherit it; _anti_cache IS appended in place and
+        # _avail_cache guards against allocatable drift — recompute both.
+        c._req_cache = self._req_cache
+        return c
 
 
 class Snapshot(Dict[str, NodeInfo]):
@@ -185,19 +236,39 @@ class Snapshot(Dict[str, NodeInfo]):
         super().__init__(*args, **kwargs)
         self._nominated: Dict[str, List[Pod]] = {}
         self._ordered_names: Optional[List[str]] = None
+        self._name_pos: Optional[Dict[str, int]] = None
         self._sym_terms: Optional[list] = None
-        for info in self.values():
+        self._capidx = None          # FreeCapacityIndex, built on demand
+        self._ici_domains: Optional[dict] = None
+        for key, info in self.items():
             info.on_anti_change = self._invalidate_symmetry
+            info.on_change = self._make_capacity_cb(key)
+
+    def _make_capacity_cb(self, key: str):
+        def cb() -> None:
+            idx = self._capidx
+            if idx is not None:
+                idx.mark_dirty(key)
+        return cb
 
     def __setitem__(self, key, value):
         self._ordered_names = None
+        self._name_pos = None
         self._sym_terms = None
+        self._ici_domains = None
         value.on_anti_change = self._invalidate_symmetry
+        value.on_change = self._make_capacity_cb(key)
+        if self._capidx is not None:
+            self._capidx.mark_dirty(key)
         super().__setitem__(key, value)
 
     def __delitem__(self, key):
         self._ordered_names = None
+        self._name_pos = None
         self._sym_terms = None
+        self._ici_domains = None
+        if self._capidx is not None:
+            self._capidx.mark_dirty(key)
         super().__delitem__(key)
 
     def _invalidate_symmetry(self) -> None:
@@ -235,6 +306,40 @@ class Snapshot(Dict[str, NodeInfo]):
             self._ordered_names = sorted(self)
         return self._ordered_names
 
+    def name_positions(self) -> Dict[str, int]:
+        """name -> position in ordered_names() (rotation-order math for
+        the indexed sweep), cached alongside the name list."""
+        if self._name_pos is None:
+            self._name_pos = {
+                n: i for i, n in enumerate(self.ordered_names())}
+        return self._name_pos
+
+    def capacity_index(self):
+        """The snapshot's free-capacity index (capindex.FreeCapacityIndex),
+        created on first use and kept fresh by the NodeInfo on_change
+        hooks; refresh() folds any dirty nodes in before returning."""
+        idx = self._capidx
+        if idx is None:
+            from nos_tpu.scheduler.capindex import FreeCapacityIndex
+
+            idx = self._capidx = FreeCapacityIndex(self)
+        idx.refresh()
+        return idx
+
+    def ici_domains(self) -> dict:
+        """ICI domains of this snapshot's nodes (tpu.ici.group_ici_domains),
+        cached until the node SET changes — the gang sub-cuboid search
+        used to regroup and re-sort all 4k nodes per gang (measured ~1.5s
+        of the 4096-node burst). Node labels are immutable in-place
+        (watch events replace whole objects, which lands in __setitem__),
+        so membership changes are the only invalidation needed."""
+        if self._ici_domains is None:
+            from nos_tpu.tpu.ici import group_ici_domains
+
+            self._ici_domains = group_ici_domains(
+                [info.node for info in self.values()])
+        return self._ici_domains
+
     @staticmethod
     def build(nodes: List[Node], pods: List[Pod],
               calculator: Optional[ResourceCalculator] = None) -> "Snapshot":
@@ -255,15 +360,34 @@ class Snapshot(Dict[str, NodeInfo]):
             self._nominated.setdefault(node, []).append(pod)
 
     def remove_nominated(self, pod: Pod) -> None:
-        for node, pods in self._nominated.items():
-            self._nominated[node] = [
-                p for p in pods
-                if not (p.metadata.name == pod.metadata.name
-                        and p.metadata.namespace == pod.metadata.namespace)
-            ]
+        """Drop ``pod`` from the nominated map. Entries are keyed by the
+        pod's own ``status.nominated_node_name`` (the invariant
+        add_nominated establishes), so only that one node's list is
+        touched — the old implementation rebuilt EVERY node's list per
+        call and kept emptied keys alive forever, which both showed up on
+        the bind path at 4k nodes and leaked dead dict entries across
+        passes. Emptied keys are deleted so ``_nominated`` only ever
+        holds nodes with live nominations."""
+        node = pod.status.nominated_node_name
+        if not node:
+            return
+        pods = self._nominated.get(node)
+        if not pods:
+            return
+        kept = [
+            p for p in pods
+            if not (p.metadata.name == pod.metadata.name
+                    and p.metadata.namespace == pod.metadata.namespace)
+        ]
+        if kept:
+            self._nominated[node] = kept
+        else:
+            del self._nominated[node]
 
     def nominated_for(self, node_name: str, exclude: Optional[Pod] = None) -> List[Pod]:
-        out = self._nominated.get(node_name, [])
+        out = self._nominated.get(node_name)
+        if not out:
+            return []
         if exclude is not None:
             out = [
                 p for p in out
@@ -273,6 +397,12 @@ class Snapshot(Dict[str, NodeInfo]):
         return out
 
     def clone(self) -> "Snapshot":
+        """Copy-on-write clone: every NodeInfo is wrapped by
+        NodeInfo.clone(), which shares the node object and pod list until
+        first mutation — a what-if pass over a 4k-node snapshot now pays
+        O(nodes) tiny wrappers up front and O(pods) copying only on the
+        handful of nodes it actually touches, instead of deep-copying
+        the entire cluster."""
         out = Snapshot()
         for name, info in self.items():
             out[name] = info.clone()
@@ -288,6 +418,10 @@ class NodeResourcesFit:
     """The fit filter: pod request must fit node allocatable minus requested."""
 
     name = "NodeResourcesFit"
+    # opted into prime_filter_state (the gang path's per-member priming):
+    # harmless (caches only the pod's own request) and it keeps the
+    # sub-cuboid search from rebuilding the request dict per (host, offset)
+    needs_prefilter_for_filter = True
     _REQ = "fit/pod_request"
 
     def pre_filter(self, state: CycleState, pod: Pod,
@@ -297,14 +431,14 @@ class NodeResourcesFit:
         # pod identity: a CycleState reused for another pod (gang member
         # loops) must not serve a stale request.
         state[self._REQ] = (id(pod), pod.request())
-        return Status.ok()
+        return _OK
 
     def filter(self, state: CycleState, pod: Pod, node_info: NodeInfo) -> Status:
         cached = state.get(self._REQ)
         req = cached[1] if cached is not None and cached[0] == id(pod) \
             else pod.request()
         if resources_fit(req, node_info.available()):
-            return Status.ok()
+            return _OK
         return Status.unschedulable(
             f"insufficient resources on {node_info.node.metadata.name}"
         )
@@ -315,6 +449,9 @@ class NodeSelectorFit:
 
     name = "NodeSelector"
 
+    def filter_inert(self, state: CycleState, pod: Pod) -> bool:
+        return not pod.spec.node_selector
+
     def filter(self, state: CycleState, pod: Pod, node_info: NodeInfo) -> Status:
         labels = node_info.node.metadata.labels
         for k, v in pod.spec.node_selector.items():
@@ -323,7 +460,7 @@ class NodeSelectorFit:
                     f"node selector {k}={v} does not match node "
                     f"{node_info.node.metadata.name}"
                 )
-        return Status.ok()
+        return _OK
 
 
 class TaintTolerationFit:
@@ -343,7 +480,7 @@ class TaintTolerationFit:
                     f"node {node_info.node.metadata.name} has untolerated "
                     f"taint {taint.key}={taint.value}:{taint.effect}"
                 )
-        return Status.ok()
+        return _OK
 
 
 class NodeUnschedulableFit:
@@ -356,12 +493,12 @@ class NodeUnschedulableFit:
 
     def filter(self, state: CycleState, pod: Pod, node_info: NodeInfo) -> Status:
         if not node_info.node.spec.unschedulable:
-            return Status.ok()
+            return _OK
         from nos_tpu.kube.objects import Taint
 
         synthetic = Taint(key=self.TAINT_KEY, effect="NoSchedule")
         if any(t.tolerates(synthetic) for t in pod.spec.tolerations):
-            return Status.ok()
+            return _OK
         return Status.unresolvable(
             f"node {node_info.node.metadata.name} is unschedulable"
         )
@@ -376,13 +513,21 @@ class NodeAffinityFit:
 
     name = "NodeAffinity"
 
+    def filter_inert(self, state: CycleState, pod: Pod) -> bool:
+        aff = pod.spec.affinity
+        return aff is None or not aff.node_affinity_required
+
     def filter(self, state: CycleState, pod: Pod, node_info: NodeInfo) -> Status:
         aff = pod.spec.affinity
         if aff is None or aff.matches(node_info.node.metadata.labels):
-            return Status.ok()
+            return _OK
         return Status.unresolvable(
             f"node affinity does not match node {node_info.node.metadata.name}"
         )
+
+    def score_inert(self, state: CycleState, pod: Pod) -> bool:
+        aff = pod.spec.affinity
+        return aff is None or not aff.node_affinity_preferred
 
     def score(self, state: CycleState, pod: Pod, node_info: NodeInfo) -> float:
         aff = pod.spec.affinity
@@ -484,6 +629,12 @@ class InterPodAffinityFit:
             pref)
         return _OK
 
+    def score_inert(self, state: CycleState, pod: Pod) -> bool:
+        # mirrors score()'s zero conditions exactly: no primed state for
+        # this pod, or no preferred terms -> every node scores 0
+        cached = state.get(self._KEY)
+        return cached is None or cached[0] != id(pod) or not cached[2]
+
     def score(self, state: CycleState, pod: Pod, node_info: NodeInfo) -> float:
         cached = state.get(self._KEY)
         if cached is None or cached[0] != id(pod) or not cached[2]:
@@ -535,6 +686,16 @@ class InterPodAffinityFit:
     def remove_pod_from_state(self, state: CycleState, pod: Pod,
                               existing: Pod, node: Node) -> None:
         self._adjust(state, pod, existing, node, -1)
+
+    def filter_inert(self, state: CycleState, pod: Pod) -> bool:
+        # inert only with correctly-primed state showing no required
+        # terms, no anti terms AND no cluster-side symmetry domains —
+        # then filter() loops three empty collections for every node
+        cached = state.get(self._KEY)
+        if cached is None or cached[0] != id(pod):
+            return False
+        terms, _tc, anti, _ac, forbidden = cached[1]
+        return not terms and not anti and not forbidden
 
     def filter(self, state: CycleState, pod: Pod, node_info: NodeInfo) -> Status:
         cached = state.get(self._KEY)
@@ -650,6 +811,12 @@ class PodTopologySpreadFit:
         state[self._KEY] = (id(pod), computed, scored)
         return _OK
 
+    def score_inert(self, state: CycleState, pod: Pod) -> bool:
+        # mirrors score()'s zero conditions: no primed state for this pod
+        # or no ScheduleAnyway constraints -> every node scores 0
+        cached = state.get(self._KEY)
+        return cached is None or cached[0] != id(pod) or not cached[2]
+
     def score(self, state: CycleState, pod: Pod, node_info: NodeInfo) -> float:
         """ScheduleAnyway constraints: prefer the domain with the fewest
         matching pods. A node LACKING the topology key scores worse than
@@ -702,6 +869,13 @@ class PodTopologySpreadFit:
                               existing: Pod, node: Node) -> None:
         self._adjust(state, pod, existing, node, -1)
 
+    def filter_inert(self, state: CycleState, pod: Pod) -> bool:
+        # inert with primed state and no DoNotSchedule constraints —
+        # filter() then loops an empty computed list for every node
+        cached = state.get(self._KEY)
+        return cached is not None and cached[0] == id(pod) \
+            and not cached[1]
+
     def filter(self, state: CycleState, pod: Pod, node_info: NodeInfo) -> Status:
         cached = state.get(self._KEY)
         if cached is None or cached[0] != id(pod):
@@ -740,8 +914,17 @@ class SchedulerFramework:
     unreserve / permit / on_bind methods are picked up if present."""
 
     def __init__(self, plugins: Optional[List[object]] = None,
-                 calculator: Optional[ResourceCalculator] = None):
+                 calculator: Optional[ResourceCalculator] = None,
+                 use_index: Optional[bool] = None):
         self.calculator = calculator or ResourceCalculator()
+        # free-capacity-index switch: None -> env default (the escape
+        # hatch NOS_TPU_SCHED_INDEX=0 forces the brute-force sweep; the
+        # parity suite runs both modes and asserts identical placements)
+        if use_index is None:
+            import os
+
+            use_index = os.environ.get("NOS_TPU_SCHED_INDEX", "1") != "0"
+        self.use_index = use_index
         self.plugins: List[object] = [
             NodeUnschedulableFit(),
             NodeSelectorFit(),
@@ -769,16 +952,38 @@ class SchedulerFramework:
     def run_pre_filter(self, state: CycleState, pod: Pod, snapshot: Snapshot) -> Status:
         for p in self._having("pre_filter"):
             st = p.pre_filter(state, pod, snapshot)
-            if not st.success:
+            if st is not _OK and not st.success:
                 return st
-        return Status.ok()
+        return _OK
 
-    def run_filter(self, state: CycleState, pod: Pod, node_info: NodeInfo) -> Status:
-        for p in self._having("filter"):
+    def run_filter(self, state: CycleState, pod: Pod, node_info: NodeInfo,
+                   filters: Optional[List[object]] = None) -> Status:
+        # identity check first: plugins return the shared _OK on success,
+        # and this loop runs per (pod, node) on the feasibility sweep —
+        # two attribute/property hops per plugin add up at 4k nodes.
+        # ``filters`` lets the sweep pass a per-pod pre-narrowed plugin
+        # list (see active_filters); default is the full suite.
+        for p in (self._having("filter") if filters is None else filters):
             st = p.filter(state, pod, node_info)
-            if not st.success:
+            if st is not _OK and not st.success:
                 return st
-        return Status.ok()
+        return _OK
+
+    def active_filters(self, state: CycleState, pod: Pod) -> List[object]:
+        """The filter plugins that can actually reject a node for THIS
+        pod. A plugin declaring ``filter_inert(state, pod) == True``
+        asserts its filter returns success for every node given this pod
+        and primed state (e.g. an empty node_selector loop) — dropping it
+        from the per-node sweep loop is then outcome-identical. Only
+        valid while ``state`` is not mutated (the preemption add/remove
+        hooks re-enter through run_filter with the full suite)."""
+        out = []
+        for p in self._having("filter"):
+            inert = getattr(p, "filter_inert", None)
+            if inert is not None and inert(state, pod):
+                continue
+            out.append(p)
+        return out
 
     def prime_filter_state(self, state: CycleState, pod: Pod,
                            snapshot: Snapshot) -> None:
@@ -811,13 +1016,16 @@ class SchedulerFramework:
     def run_filter_with_nominated(
         self, state: CycleState, pod: Pod, node_info: NodeInfo,
         nominated: List[Pod],
+        filters: Optional[List[object]] = None,
     ) -> Status:
         """Filter with higher-or-equal-priority nominated pods counted as
         if already placed (their capacity is spoken for) — the reference's
         RunFilterPluginsWithNominatedPods (capacity_scheduling.go:610)."""
+        if not nominated:       # the overwhelmingly common sweep case
+            return self.run_filter(state, pod, node_info, filters)
         relevant = [p for p in nominated if p.priority() >= pod.priority()]
         if not relevant:
-            return self.run_filter(state, pod, node_info)
+            return self.run_filter(state, pod, node_info, filters)
         # append/pop instead of cloning: filters only READ pods, and this
         # runs per node per feasibility pass (and per reprieve candidate
         # in preemption) — deep-copying the NodeInfo each time is O(pods)
@@ -825,7 +1033,7 @@ class SchedulerFramework:
         node_info.pods.extend(relevant)
         node_info.invalidate_requested()
         try:
-            return self.run_filter(state, pod, node_info)
+            return self.run_filter(state, pod, node_info, filters)
         finally:
             del node_info.pods[len(node_info.pods) - len(relevant):]
             node_info.invalidate_requested()
@@ -852,6 +1060,14 @@ class SchedulerFramework:
         node name (deterministic)."""
         totals = {n: 0.0 for n in names}
         for p in self._having("score"):
+            # inert fast path: a plugin that can tell from the pod/state
+            # alone that it scores every node 0 is skipped — uniform raw
+            # scores contribute nothing after normalization, and the
+            # common no-preferences pod otherwise pays |candidates| score
+            # calls per plugin on every sweep
+            inert = getattr(p, "score_inert", None)
+            if inert is not None and inert(state, pod):
+                continue
             raw = [p.score(state, pod, snapshot[n]) for n in names]
             lo, hi = min(raw), max(raw)
             if hi > lo:
@@ -903,27 +1119,99 @@ class SchedulerFramework:
         save/restores the rotation cursor so simulations never perturb
         live placement). Scans every node on small clusters; stops after
         MIN_FEASIBLE_TO_FIND feasible candidates on large ones, rotating
-        the scan start across calls."""
+        the scan start across calls.
+
+        With ``use_index`` (default) the sweep consults the snapshot's
+        free-capacity index first and runs the filter pipeline only on
+        nodes whose free capacity can cover the pod's indexed resources.
+        Pruned nodes are exactly those NodeResourcesFit would reject, the
+        surviving candidates are visited in the same rotation order, and
+        the cursor advances by the same position arithmetic — so indexed
+        and brute sweeps pick identical nodes and stay in lockstep across
+        calls (tests/test_sched_parity.py pins this)."""
         feasible = []
         reasons: List[str] = []
         names = snapshot.ordered_names()
         n = len(names)
-        start = getattr(self, "_next_start_node", 0) % max(n, 1)
-        scanned = 0
-        for i in range(n):
-            name = names[(start + i) % n]
-            info = snapshot[name]
-            scanned += 1
-            nominated = snapshot.nominated_for(name, exclude=pod)
-            st = self.run_filter_with_nominated(state, pod, info, nominated)
-            if st.success:
-                feasible.append(name)
-                if len(feasible) >= self.MIN_FEASIBLE_TO_FIND:
-                    break
-            elif st.reason and st.reason not in reasons:
-                reasons.append(st.reason)
-        self._next_start_node = (start + scanned) % max(n, 1)
+        if n == 0:
+            return None, Status.unschedulable("no feasible node")
+        start = getattr(self, "_next_start_node", 0) % n
+        cap = self.MIN_FEASIBLE_TO_FIND
+        visited = 0          # nodes the filter pipeline actually ran on
+        pruned = 0           # nodes the index skipped (resource-infeasible)
+        # cursor advance: the brute sweep counts every position up to the
+        # cap-th feasible node (or the whole ring when the cap isn't
+        # reached) — the indexed sweep reproduces that count from the
+        # winning node's position, keeping both cursors identical
+        scanned_equiv = n
+        # drop filters that provably pass every node for this pod (empty
+        # selector/affinity/spread) — the sweep state is frozen while we
+        # scan, so the per-sweep narrowing is outcome-identical and saves
+        # several dynamic dispatches per visited node
+        sweep_filters = self.active_filters(state, pod)
+        cand = None
+        nofit_filters = None
+        if self.use_index:
+            req = pod.request()
+            cand = snapshot.capacity_index().candidates(req)
+            if cand is not None and all(
+                k in INDEXED_RESOURCES and v > 0 for k, v in req.items()
+            ):
+                # membership in ``cand`` IS resources_fit(req, available)
+                # when every requested resource is indexed and positive —
+                # same tolerance, same available() memo — so re-running
+                # NodeResourcesFit per candidate proves nothing new. It
+                # stays in the suite for nodes with nominated pods, whose
+                # transiently-reduced availability the index can't see.
+                nofit_filters = [p for p in sweep_filters
+                                 if not isinstance(p, NodeResourcesFit)]
+        if cand is not None and len(cand) * 4 <= n:
+            # few candidates: sort just them into rotation order
+            pos = snapshot.name_positions()
+            order = sorted(((pos[nm] - start) % n, nm) for nm in cand)
+            pruned = n - len(order)
+            for rel, name in order:
+                visited += 1
+                nominated = snapshot.nominated_for(name, exclude=pod)
+                st = self.run_filter_with_nominated(
+                    state, pod, snapshot[name], nominated,
+                    sweep_filters if (nofit_filters is None or nominated)
+                    else nofit_filters)
+                if st.success:
+                    feasible.append(name)
+                    if len(feasible) >= cap:
+                        scanned_equiv = rel + 1
+                        break
+                elif st.reason and st.reason not in reasons:
+                    reasons.append(st.reason)
+        else:
+            # dense candidate set (or index off): walk the ring, with an
+            # O(1) membership skip when the index produced a set
+            for i in range(n):
+                name = names[(start + i) % n]
+                if cand is not None and name not in cand:
+                    pruned += 1
+                    continue
+                visited += 1
+                nominated = snapshot.nominated_for(name, exclude=pod)
+                st = self.run_filter_with_nominated(
+                    state, pod, snapshot[name], nominated,
+                    sweep_filters if (nofit_filters is None or nominated)
+                    else nofit_filters)
+                if st.success:
+                    feasible.append(name)
+                    if len(feasible) >= cap:
+                        scanned_equiv = i + 1
+                        break
+                elif st.reason and st.reason not in reasons:
+                    reasons.append(st.reason)
+        self._next_start_node = (start + scanned_equiv) % n
+        obs.SWEEP_WIDTH.observe(visited)
         if not feasible:
+            if pruned:
+                reasons.append(
+                    f"insufficient free capacity on {pruned} node(s) "
+                    f"(capacity index)")
             # aggregate distinct per-node reasons (kube-scheduler style)
             detail = "; ".join(reasons[:4]) if reasons else ""
             return None, Status.unschedulable(

@@ -40,7 +40,7 @@ from nos_tpu import constants
 from nos_tpu.kube.objects import Pod, ResourceList, add_resources
 from nos_tpu.scheduler import framework as fw
 from nos_tpu.tpu import topology
-from nos_tpu.tpu.ici import IciDomain, group_ici_domains
+from nos_tpu.tpu.ici import IciDomain
 
 logger = logging.getLogger(__name__)
 
@@ -230,26 +230,50 @@ class GangScheduler:
         ICI domain). Returns a placement covering only the unbound
         members, or (None, reason)."""
         topo_name = required_topology_name(members[0])
-        nodes = [ni.node for ni in snapshot.values()]
-        domains = group_ici_domains(nodes)
+        # domain grouping is cached on the snapshot (invalidated when the
+        # node set changes) — regrouping 4k nodes per gang dominated the
+        # gang path at the 4096-node scale point
+        domains = snapshot.ici_domains()
         if exclude_pools:
             domains = {p: d for p, d in domains.items()
                        if p not in exclude_pools}
         bound = {
             gang_worker(p): p.spec.node_name for p in members if p.spec.node_name
         }
+        # free-capacity prescreen for the sub-cuboid search: per unbound
+        # worker, the request its host must cover. _try_domain consults
+        # the snapshot index to reject offsets whose hosts provably lack
+        # the capacity BEFORE paying the full filter pipeline; the final
+        # candidate offset still runs every filter, so placements match
+        # the unindexed search exactly.
+        capidx = snapshot.capacity_index() if self.framework.use_index \
+            else None
+        unbound_reqs = None
+        if capidx is not None:
+            from nos_tpu.scheduler.capindex import threshold_constraints
+
+            unbound_reqs = {
+                gang_worker(p): threshold_constraints(p.request())
+                for p in members if not p.spec.node_name
+            }
 
         # snapshot-derived filter state (inter-pod affinity maps, topology
         # spread counts) primed ONCE per unbound member — not per candidate
         # offset, where the cluster scan would multiply by the sub-cuboid
         # search space
         states: Dict[int, fw.CycleState] = {}
+        member_filters: Dict[int, list] = {}
         for p in members:
             if p.spec.node_name:
                 continue
             st: fw.CycleState = {}
             self.framework.prime_filter_state(st, p, snapshot)
-            states[gang_worker(p)] = st
+            w = gang_worker(p)
+            states[w] = st
+            # per-member narrowed filter suite (outcome-identical): the
+            # sub-cuboid search probes many (offset, host) pairs per
+            # member and the state is frozen throughout
+            member_filters[w] = self.framework.active_filters(st, p)
 
         reasons: List[str] = []
         # (exact-mismatch, free-hosts-after, domain-size, pool) — tightest
@@ -257,7 +281,20 @@ class GangScheduler:
         # larger pools prefer the one left with the fewest free hosts after
         # placement (pack into already-fragmented pools, keep big slices
         # whole for big gangs).
-        candidates: List[Tuple[tuple, GangPlacement]] = []
+        #
+        # Branch-and-bound over domains: a domain's full evaluation (the
+        # sub-cuboid filter search + exact free-hosts-after count) is
+        # deferred behind a LOWER BOUND on its rank key — exact flag,
+        # domain size and pool name are known up front, and free-after is
+        # at least (free hosts) - (block hosts), since the placed block
+        # can cover at most block-hosts free hosts. Domains are evaluated
+        # best-bound-first and the loop stops once the best exact key
+        # beats every remaining bound: exact_key >= bound_key always, so
+        # the pruned domains provably lose — the chosen placement is
+        # identical to evaluating everything (the pre-B&B behavior), but
+        # a 64-pool sweep typically full-evaluates only the handful of
+        # fragmented pools that can win the packing score.
+        pending: List[Tuple[tuple, str, object, tuple]] = []
         for pool, domain in sorted(domains.items()):
             req_topo = topology.find_slice_topology(domain.generation, topo_name)
             if req_topo is None:
@@ -284,19 +321,37 @@ class GangScheduler:
                     f"pool {pool}: {topo_name} does not fit in {domain.topology_name}"
                 )
                 continue
+            exact = 0 if domain.topology_name == topo_name else 1
+            block_hosts = 1
+            for d in req_shape:
+                block_hosts *= d
+            free_now = self._free_hosts(domain, snapshot, capidx)
+            bound_key = (exact, max(0, free_now - block_hosts),
+                         domain.expected_hosts or 0, pool)
+            pending.append((bound_key, pool, domain, req_shape))
+        pending.sort(key=lambda t: t[0])
+
+        best_key: Optional[tuple] = None
+        best_placement: Optional[GangPlacement] = None
+        for bound_key, pool, domain, req_shape in pending:
+            if best_key is not None and bound_key > best_key:
+                break   # every remaining domain's exact key is >= its bound
             placement = self._try_domain(members, bound, domain, req_shape,
-                                         snapshot, states)
+                                         snapshot, states,
+                                         capidx=capidx,
+                                         unbound_reqs=unbound_reqs,
+                                         member_filters=member_filters)
             if placement is None:
                 reasons.append(f"pool {pool}: hosts busy or unfit")
                 continue
-            exact = 0 if domain.topology_name == topo_name else 1
-            free_after = self._free_hosts_after(domain, placement, snapshot)
-            candidates.append(
-                ((exact, free_after, domain.expected_hosts or 0, pool), placement)
-            )
-        if candidates:
-            candidates.sort(key=lambda t: t[0])
-            return candidates[0][1], ""
+            exact = bound_key[0]
+            free_after = self._free_hosts_after(domain, placement, snapshot,
+                                                capidx)
+            key = (exact, free_after, domain.expected_hosts or 0, pool)
+            if best_key is None or key < best_key:
+                best_key, best_placement = key, placement
+        if best_placement is not None:
+            return best_placement, ""
 
         matching = [
             d for d in domains.values()
@@ -412,12 +467,39 @@ class GangScheduler:
             claimed.add(placement.domain.pool)
         return placements, ""  # type: ignore[return-value]
 
+    def _free_hosts(self, domain: IciDomain, snapshot: fw.Snapshot,
+                    capidx=None) -> int:
+        """Hosts of the domain with no TPU occupancy right now — the
+        branch-and-bound upper half of the fragmentation score (same
+        free-host predicate as _free_hosts_after, no block excluded).
+        With the index on, the per-node flag set answers in one
+        membership test per host (the flag encodes exactly
+        ``RESOURCE_TPU in info.requested()``, maintained by the same
+        dirty marks as the capacity buckets)."""
+        if capidx is not None:
+            tpu_free = capidx.tpu_free_names()
+            return sum(1 for name in domain.node_names() if name in tpu_free)
+        free = 0
+        for node in domain.nodes:
+            info = snapshot.get(node.metadata.name)
+            if info is None:
+                continue
+            if constants.RESOURCE_TPU in info.requested():
+                continue
+            free += 1
+        return free
+
     def _free_hosts_after(
-        self, domain: IciDomain, placement: GangPlacement, snapshot: fw.Snapshot
+        self, domain: IciDomain, placement: GangPlacement,
+        snapshot: fw.Snapshot, capidx=None,
     ) -> int:
         """Hosts of the domain left with no TPU occupancy after this
         placement lands (fragmentation score input)."""
         taken = set(placement.nodes)
+        if capidx is not None:
+            tpu_free = capidx.tpu_free_names()
+            return sum(1 for name in domain.node_names()
+                       if name not in taken and name in tpu_free)
         free = 0
         for node in domain.nodes:
             name = node.metadata.name
@@ -426,9 +508,11 @@ class GangScheduler:
             info = snapshot.get(name)
             if info is None:
                 continue
-            if any(
-                constants.RESOURCE_TPU in p.request() for p in info.pods
-            ):
+            # requested() is the memoized per-node request sum and carries
+            # a resource key iff some pod requests it — equivalent to
+            # scanning every pod's request() dict, without rebuilding one
+            # dict per (pod, candidate placement)
+            if constants.RESOURCE_TPU in info.requested():
                 continue
             free += 1
         return free
@@ -441,6 +525,9 @@ class GangScheduler:
         req_shape: Tuple[int, ...],
         snapshot: fw.Snapshot,
         states: Optional[Dict[int, fw.CycleState]] = None,
+        capidx=None,
+        unbound_reqs: Optional[Dict[int, object]] = None,
+        member_filters: Optional[Dict[int, list]] = None,
     ) -> Optional[GangPlacement]:
         """Place the gang on an axis-aligned host-grid sub-cuboid of the
         domain (the whole domain when shapes are equal). Worker w maps to
@@ -451,7 +538,14 @@ class GangScheduler:
         assignment must pass the full filter pipeline (one worker per host:
         whole-host chip requests make the resource filter enforce
         exclusivity — which is also what lets several gangs coexist in one
-        pool on disjoint sub-cuboids)."""
+        pool on disjoint sub-cuboids).
+
+        ``capidx``/``unbound_reqs``: optional free-capacity prescreen. An
+        offset where ANY unbound worker's host lacks the indexed free
+        capacity for that worker's request is rejected without running a
+        single filter — the filter sweep would have rejected that offset
+        at the failing member anyway (NodeResourcesFit), so the surviving
+        search order and the returned placement are unchanged."""
         dom_shape = domain.host_shape
         if dom_shape is None:
             return None
@@ -481,6 +575,11 @@ class GangScheduler:
                 for w, node_name in bound.items()
             ):
                 continue
+            if capidx is not None and unbound_reqs is not None and not all(
+                capidx.fits_cons(hosts[w].metadata.name, cons)
+                for w, cons in unbound_reqs.items()
+            ):
+                continue
             pods: List[Pod] = []
             assignments: List[str] = []
             feasible = True
@@ -489,11 +588,14 @@ class GangScheduler:
                 if w in bound:
                     continue
                 state = states.get(w, {}) if states is not None else {}
+                filters = member_filters.get(w) \
+                    if member_filters is not None else None
                 host_name = hosts[w].metadata.name
                 node_info = snapshot.get(host_name)
                 if node_info is None or not self.framework.run_filter_with_nominated(
                     state, pod, node_info,
                     snapshot.nominated_for(host_name, exclude=pod),
+                    filters,
                 ).success:
                     feasible = False
                     break

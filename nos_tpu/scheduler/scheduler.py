@@ -35,6 +35,7 @@ class Scheduler:
         scheduler_name: str = constants.SCHEDULER_NAME,
         calculator: Optional[ResourceCalculator] = None,
         extra_plugins: Optional[list] = None,
+        use_index: Optional[bool] = None,
     ):
         self.scheduler_name = scheduler_name
         self.calc = calculator or ResourceCalculator()
@@ -42,6 +43,7 @@ class Scheduler:
         self.framework = fw.SchedulerFramework(
             plugins=[self.capacity] + list(extra_plugins or []),
             calculator=self.calc,
+            use_index=use_index,
         )
         self.capacity.framework = self.framework
         self.gang = GangScheduler(self.framework, self.capacity)
@@ -54,6 +56,7 @@ class Scheduler:
         # nomination) is owed a retry regardless of generation
         self._batch_gen = -1
         self._retry_pending = False
+        self._bound_in_attempt = 0
 
     # ------------------------------------------------------------------
     def _sync_state(self, client: Client) -> fw.Snapshot:
@@ -173,13 +176,25 @@ class Scheduler:
 
     def _schedule_one(self, client: Client, pod: Pod, snapshot: fw.Snapshot) -> Result:
         started = time.monotonic()
+        # set by the bind paths: how many pods this attempt bound (a gang
+        # attempt binds its whole membership in one _schedule_one call)
+        self._bound_in_attempt = 0
         try:
             return self._schedule_one_inner(client, pod, snapshot)
         except Exception:
             obs.SCHEDULE_ATTEMPTS.labels("error").inc()
             raise
         finally:
-            obs.SCHEDULE_DURATION.observe(time.monotonic() - started)
+            elapsed = time.monotonic() - started
+            obs.SCHEDULE_DURATION.observe(elapsed)
+            # per-pod service time, gang attempts amortized over the pods
+            # they bound — the histogram bench_sched's scale_service_*
+            # percentiles read (failed attempts count as one sample: the
+            # work was still paid on behalf of that pod)
+            n = max(1, self._bound_in_attempt)
+            share = elapsed / n
+            for _ in range(n):
+                obs.SCHEDULE_SERVICE.observe(share)
 
     def _schedule_one_inner(self, client: Client, pod: Pod, snapshot: fw.Snapshot) -> Result:
         if jobset_key(pod) is not None:
@@ -226,6 +241,7 @@ class Scheduler:
         snapshot[node_name].add_pod(bound)
         self.cache.upsert("Pod", bound)
         snapshot.remove_nominated(pod)
+        self._bound_in_attempt = 1
         obs.SCHEDULE_ATTEMPTS.labels("bound").inc()
         logger.info("scheduled %s/%s -> %s", pod.metadata.namespace, pod.metadata.name, node_name)
         return Result()
@@ -305,6 +321,7 @@ class Scheduler:
             snapshot[node_name].add_pod(bound)
             self.cache.upsert("Pod", bound)
             snapshot.remove_nominated(member)
+        self._bound_in_attempt = len(pairs)
         return True
 
     # ------------------------------------------------------------------

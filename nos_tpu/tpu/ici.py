@@ -53,6 +53,22 @@ class IciDomain:
     generation: str                     # GENERATIONS key (label value)
     topology_name: str
     nodes: List[Node] = field(default_factory=list)   # worker order (host_order_key)
+    # memo for host_shape: (generation, topology_name) are fixed at
+    # construction, and node_at() resolves the shape once per candidate
+    # host in the gang sub-cuboid search — hot enough to pin per-instance
+    _host_shape_memo: object = field(default=False, repr=False, compare=False)
+    _node_names_memo: Optional[List[str]] = field(
+        default=None, repr=False, compare=False)
+
+    def node_names(self) -> List[str]:
+        """Host names in worker order, memoized after the domain is built
+        (group_ici_domains sorts and then never mutates ``nodes``) — the
+        gang fragmentation score iterates these per candidate domain."""
+        memo = self._node_names_memo
+        if memo is None:
+            memo = [n.metadata.name for n in self.nodes]
+            object.__setattr__(self, "_node_names_memo", memo)
+        return memo
 
     @property
     def slice_topology(self) -> Optional[topology.SliceTopology]:
@@ -82,10 +98,13 @@ class IciDomain:
         topology.host_shape). Worker index = row-major position in this
         grid — the TPU runtime's host ordering convention (host-index
         label when present, else natural name sort)."""
-        topo = self.slice_topology
-        if topo is None:
-            return None
-        return topology.host_shape(self.generation, topo)
+        memo = self._host_shape_memo
+        if memo is False:            # False = unset (None is a valid answer)
+            topo = self.slice_topology
+            memo = None if topo is None \
+                else topology.host_shape(self.generation, topo)
+            object.__setattr__(self, "_host_shape_memo", memo)
+        return memo
 
     def node_at(self, coord: tuple) -> Optional[Node]:
         """Node at a host-grid coordinate (row-major ravel). Requires a

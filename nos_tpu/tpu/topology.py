@@ -139,12 +139,16 @@ def set_known_generations(gens: List[Generation]) -> None:
         GENERATIONS[g.name] = g
         GENERATIONS[g.short] = g
     allowed_geometries.cache_clear()
+    find_slice_topology.cache_clear()
+    host_shape.cache_clear()
 
 
 def reset_known_generations() -> None:
     GENERATIONS.clear()
     GENERATIONS.update(_DEFAULT_GENERATIONS)
     allowed_geometries.cache_clear()
+    find_slice_topology.cache_clear()
+    host_shape.cache_clear()
 
 
 def load_generations_file(path: str) -> List[Generation]:
@@ -225,13 +229,19 @@ def slice_topologies(generation_name: str) -> Tuple[SliceTopology, ...]:
     return g.topologies if g else ()
 
 
+@lru_cache(maxsize=4096)
 def find_slice_topology(generation_name: str, topo_name: str) -> Optional[SliceTopology]:
+    """Cached: the gang sub-cuboid search resolves (generation, topology
+    name) once per candidate domain per gang — the uncached linear scan
+    plus SliceTopology.name string-joins measured ~1.9s of the 4096-node
+    burst. Cleared by set/reset_known_generations."""
     for t in slice_topologies(generation_name):
         if t.name == topo_name:
             return t
     return None
 
 
+@lru_cache(maxsize=4096)
 def host_shape(generation_name: str, topo: SliceTopology) -> Optional[Tuple[int, ...]]:
     """Host-grid dims of a slice topology: how the slice's hosts tile the
     chip cuboid. 3D generations (v4/v5p, 2x2 boards): (x,y,z) chips →

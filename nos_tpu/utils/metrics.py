@@ -197,20 +197,37 @@ DEFAULT_BUCKETS = (
 )
 
 
-class _HistogramChild:
-    __slots__ = ("buckets", "counts", "total", "count", "_lock")
+# raw-sample retention cap per histogram child (track_samples=True only):
+# enough for any bench run to compute exact percentiles; beyond it the
+# buckets remain correct but quantile() answers only over the first
+# MAX_HISTOGRAM_SAMPLES samples
+MAX_HISTOGRAM_SAMPLES = 1_000_000
 
-    def __init__(self, buckets: Tuple[float, ...]):
+
+class _HistogramChild:
+    __slots__ = ("buckets", "counts", "total", "count", "samples", "_lock")
+
+    def __init__(self, buckets: Tuple[float, ...],
+                 track_samples: bool = False):
         self.buckets = buckets
         self.counts = [0] * len(buckets)
         self.total = 0.0
         self.count = 0
+        # OPT-IN bounded raw-sample buffer backing quantile() —
+        # Prometheus exposition ignores it; local consumers
+        # (bench_sched) read exact percentiles from it instead of
+        # re-deriving timings. Off by default: a long-lived process must
+        # not grow a million-float list per hot histogram nobody reads.
+        self.samples: Optional[List[float]] = [] if track_samples else None
         self._lock = threading.Lock()
 
     def observe(self, v: float) -> None:
         with self._lock:
             self.total += v
             self.count += 1
+            if self.samples is not None \
+                    and len(self.samples) < MAX_HISTOGRAM_SAMPLES:
+                self.samples.append(v)
             for i, ub in enumerate(self.buckets):
                 if v <= ub:
                     self.counts[i] += 1
@@ -222,18 +239,62 @@ class Histogram(_Metric):
 
     def __init__(self, name: str, help_text: str,
                  labelnames: Sequence[str] = (),
-                 buckets: Iterable[float] = DEFAULT_BUCKETS):
+                 buckets: Iterable[float] = DEFAULT_BUCKETS,
+                 track_samples: bool = False):
         super().__init__(name, help_text, labelnames)
         bs = sorted(float(b) for b in buckets)
         if not bs:
             raise ValueError("histogram needs at least one bucket")
         self.buckets = tuple(bs)
+        self.track_samples = bool(track_samples)
 
     def _new_child(self):
-        return _HistogramChild(self.buckets)
+        return _HistogramChild(self.buckets, self.track_samples)
+
+    def enable_sample_tracking(self) -> None:
+        """Turn raw-sample retention on at runtime — for measurement
+        harnesses (bench_sched) that want exact percentiles from a
+        histogram production code registers without retention. New AND
+        existing children start buffering from this call on; daemons that
+        never call it never grow a buffer."""
+        with self._lock:
+            self.track_samples = True
+            children = list(self._children.values())
+        for child in children:
+            with child._lock:
+                if child.samples is None:
+                    child.samples = []
 
     def observe(self, v: float) -> None:
         self._unlabeled().observe(v)
+
+    def num_samples(self, *label_values) -> int:
+        """Length of the retained raw-sample buffer (== observation count
+        until MAX_HISTOGRAM_SAMPLES; 0 when track_samples is off). Use as
+        the ``since`` mark for quantile() to scope percentiles to one
+        measurement window."""
+        samples = self.labels(*label_values).samples
+        return len(samples) if samples is not None else 0
+
+    def quantile(self, q: float, since: int = 0,
+                 *label_values) -> Optional[float]:
+        """Exact nearest-rank percentile (q in (0, 1]) over the raw
+        samples observed at buffer index >= ``since``. None when the
+        window holds no samples or the histogram doesn't retain samples
+        (track_samples=False). This is a local-process convenience on top
+        of the Prometheus surface — scrapes still see only buckets."""
+        import math
+
+        child = self.labels(*label_values)
+        with child._lock:
+            if child.samples is None:
+                return None
+            window = child.samples[since:]
+        if not window:
+            return None
+        window.sort()
+        rank = min(len(window), max(1, math.ceil(q * len(window))))
+        return window[rank - 1]
 
     def _render_child(self, values, child):
         lines = []
@@ -268,10 +329,13 @@ class Registry:
                 if type(existing) is not type(metric) or \
                         existing.labelnames != metric.labelnames or \
                         getattr(existing, "buckets", None) != \
-                        getattr(metric, "buckets", None):
+                        getattr(metric, "buckets", None) or \
+                        getattr(existing, "track_samples", None) != \
+                        getattr(metric, "track_samples", None):
                     raise ValueError(
                         f"metric {metric.name} already registered with a "
-                        f"different type, labels, or buckets")
+                        f"different type, labels, buckets, or sample "
+                        f"tracking")
                 return existing
             self._metrics[metric.name] = metric
             return metric
@@ -286,8 +350,10 @@ class Registry:
 
     def histogram(self, name: str, help_text: str,
                   labelnames: Sequence[str] = (),
-                  buckets: Iterable[float] = DEFAULT_BUCKETS) -> Histogram:
-        return self.register(Histogram(name, help_text, labelnames, buckets))  # type: ignore[return-value]
+                  buckets: Iterable[float] = DEFAULT_BUCKETS,
+                  track_samples: bool = False) -> Histogram:
+        return self.register(Histogram(name, help_text, labelnames, buckets,
+                                       track_samples))  # type: ignore[return-value]
 
     def get(self, name: str) -> Optional[_Metric]:
         with self._lock:

@@ -207,6 +207,63 @@ class TestLastMeasuredFallback:
         assert "last_measured" not in sched
 
 
+@pytest.mark.slow
+def test_scheduler_scale_point_guard():
+    """Reduced run_scale geometry in-process (512 nodes, 248 pods): the
+    free-capacity index regressing — unbound pods, or a service-time tail
+    back in brute-force territory — must fail CI here, not surface in the
+    round artifact. The ceiling is deliberately generous (the real
+    scale4k target lives in ISSUE/BASELINE): this guards the *class* of
+    regression, not the exact number."""
+    import bench_sched
+
+    r = bench_sched.run_scale(pools=8, gangs=4, singles=120, prefix="guard")
+    assert r["guard_unbound_pods"] == 0
+    assert r["guard_nodes"] == 512
+    p99 = r["guard_service_p99_ms"]
+    assert p99 is not None and p99 < 50.0, \
+        f"scheduler service p99 {p99} ms blew the 50 ms guard ceiling"
+    # the sweep-width histogram must show the index actually narrowing
+    # the filter sweep: the feasible cap is 100, and with the index on
+    # the filter pipeline runs on (at most a few over) that many nodes
+    # per pod. With the index effectively off, late-burst pods scan past
+    # hundreds of full hosts, dragging the tail toward cluster size —
+    # these ceilings are strict enough to catch that.
+    # measured: indexed p50/p99 = 100/100 (the feasible cap); brute-force
+    # at this geometry = 120/299
+    assert r["guard_sweep_nodes_p50"] is not None
+    assert r["guard_sweep_nodes_p50"] <= 110, \
+        f"sweep p50 {r['guard_sweep_nodes_p50']} — index not pruning"
+    assert r["guard_sweep_nodes_p99"] <= 150, \
+        f"sweep p99 {r['guard_sweep_nodes_p99']} — index not pruning"
+
+
+def test_histogram_quantiles_back_the_bench():
+    """The bench reads service percentiles from the runtime histogram;
+    pin the quantile/num_samples window semantics it relies on."""
+    from nos_tpu.utils.metrics import Registry
+
+    h = Registry().histogram("t_q", "t", buckets=(1.0, 10.0),
+                             track_samples=True)
+    assert h.quantile(0.5) is None
+    for v in (5.0, 1.0, 9.0, 3.0):
+        h.observe(v)
+    assert h.quantile(0.5) == 3.0          # nearest-rank over 4 samples
+    assert h.quantile(1.0) == 9.0
+    mark = h.num_samples()
+    assert mark == 4
+    assert h.quantile(0.99, since=mark) is None   # empty window
+    h.observe(42.0)
+    assert h.quantile(0.5, since=mark) == 42.0    # window sees only new
+    assert h.quantile(0.5) == 5.0                 # full history unchanged
+    # retention is OPT-IN: a default histogram must not grow a sample
+    # buffer (long-lived daemons) and quantile() must say so with None
+    h2 = Registry().histogram("t_q2", "t", buckets=(1.0,))
+    h2.observe(7.0)
+    assert h2.num_samples() == 0
+    assert h2.quantile(0.5) is None
+
+
 def test_best_measured_config_adoption(tmp_path, monkeypatch):
     """bench.py adopts the babysitter's hardware-measured winning config
     when no explicit env knobs are set — and NEVER overrides explicit
