@@ -3,7 +3,7 @@
 PYTHON ?= python
 IMAGE_REGISTRY ?= ghcr.io/nos-tpu
 VERSION ?= 0.1.0
-COMPONENTS = apiserver operator scheduler partitioner tpuagent deviceplugin metricsexporter trainer server
+COMPONENTS = apiserver operator scheduler partitioner tpuagent deviceplugin lifecycle metricsexporter trainer server
 
 .PHONY: test
 test:  ## Run the unit + integration suite (virtual 8-device CPU mesh for JAX tests).
@@ -20,6 +20,10 @@ bench-sweep:  ## Sweep remat policy x batch x loss-chunk for the MFU config.
 .PHONY: bench-sched
 bench-sched:  ## Scheduler scaling curve (1024- and 4096-node points; --profile via BENCH_SCHED_FLAGS).
 	$(PYTHON) bench_sched.py $(BENCH_SCHED_FLAGS)
+
+.PHONY: bench-chaos
+bench-chaos:  ## Lifecycle chaos storms: detection latency + MTTR histograms (artifact in bench_logs/).
+	$(PYTHON) bench_chaos.py
 
 .PHONY: bench-attn
 bench-attn:  ## Compare attention kernels (splash/flash/xla) at the flagship shape.

@@ -17,9 +17,13 @@ via sub-cuboid placement. Measured:
   chips, after the mixed workload lands. The north star is >= 90% on the
   gang pool.
 
-Prints ONE JSON line. Run directly, or let bench.py embed the numbers.
+Prints ONE JSON line AND writes the same payload to
+``bench_logs/bench_sched.json`` — the driver's tail buffer has truncated
+the (now ~40-key) stdout line before (VERDICT r5 weak #2), so the file is
+the artifact of record and the stdout line is best-effort convenience.
 """
 import json
+import os
 import statistics
 import sys
 import time
@@ -46,6 +50,13 @@ from nos_tpu.kube.objects import (                          # noqa: E402
 from nos_tpu.scheduler import Scheduler                     # noqa: E402
 
 TPU = constants.RESOURCE_TPU
+OUT_PATH = os.path.join("bench_logs", "bench_sched.json")
+# The stable headline series' round-4 value (BENCH_r04.json
+# scale_service_p50_ms): per-pod service time p50 under the
+# 1024-node/500-pod burst. vs_baseline = baseline / current, so > 1.0
+# means faster than the r4 pin (the cross-round comparison VERDICT r5
+# weak #4 asked to restore).
+R4_SCALE_SERVICE_P50_MS = 0.894
 V5P = "tpu-v5p-slice"
 V5E = "tpu-v5-lite-podslice"
 TPU_TAINT = Taint(key=TPU, value="present", effect="NoSchedule")
@@ -444,7 +455,6 @@ def main(argv=None):
     if args.profile:
         import cProfile
         import io
-        import os
         import pstats
 
         profiler = cProfile.Profile()
@@ -479,7 +489,12 @@ def main(argv=None):
             "— compare rounds within one series, not across them"),
         "value": scale["scale_service_p50_ms"],
         "unit": "ms",
-        "vs_baseline": None,   # reference publishes no scheduler latency (SURVEY §6)
+        # the stable series vs its r4 pin (baseline/current; > 1 = faster)
+        # — the reference publishes no scheduler latency (SURVEY §6), so
+        # the repo's own round-4 measurement is the baseline of record
+        "vs_baseline": (
+            round(R4_SCALE_SERVICE_P50_MS / scale["scale_service_p50_ms"], 3)
+            if scale.get("scale_service_p50_ms") else None),
         "gang_p50_s": round(q(gang_lat, 50), 6),
         "gang_p50_note": (
             "definition shifted in r4: burst batching changed what one "
@@ -513,6 +528,11 @@ def main(argv=None):
         **scale,
         **scale4k,
     }
+    # file first (artifact of record), stdout line second (convenience —
+    # a tail-truncated line no longer loses the round's numbers)
+    os.makedirs(os.path.dirname(OUT_PATH), exist_ok=True)
+    with open(OUT_PATH, "w") as f:
+        json.dump(result, f, indent=2)
     print(json.dumps(result))
     return result
 

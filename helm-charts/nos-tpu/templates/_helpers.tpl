@@ -53,6 +53,10 @@ app.kubernetes.io/managed-by: {{ .Release.Service }}
 {{- printf "http://%s-apiserver.%s.svc:%d" (include "nos-tpu.fullname" .) (include "nos-tpu.namespace" .) (int .Values.apiServer.port) -}}
 {{- end -}}
 
+{{- define "nos-tpu.lifecycle.image" -}}
+{{- printf "%s/%s:%s" .Values.image.registry .Values.lifecycle.image.repository (include "nos-tpu.tag" .) -}}
+{{- end -}}
+
 {{- define "nos-tpu.metricsExporter.image" -}}
 {{- printf "%s/%s:%s" .Values.image.registry .Values.metricsExporter.image.repository (include "nos-tpu.tag" .) -}}
 {{- end -}}

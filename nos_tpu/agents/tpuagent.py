@@ -182,6 +182,7 @@ class TpuAgent:
         report_interval_s: Optional[float] = constants.DEFAULT_REPORT_INTERVAL_S,
         manage_allocatable: bool = True,
         podres_client=None,
+        heartbeat: bool = True,
     ):
         self.node_name = node_name
         self.tpu = tpu_client
@@ -193,6 +194,16 @@ class TpuAgent:
         self.report_interval_s = report_interval_s
         self.manage_allocatable = manage_allocatable
         self.shared = SharedState()
+        # node-heartbeat Lease renewal (the kubelet's node-lease contract,
+        # consumed by lifecycle.NodeLifecycleController): this agent IS
+        # the per-node daemon, so its liveness is the node's agent-health
+        # signal — the agent crashing stops the renewals and the
+        # lifecycle controller fences the node after its timeout
+        self._heartbeat = None
+        if heartbeat:
+            from nos_tpu.lifecycle.events import NodeHeartbeat
+
+            self._heartbeat = NodeHeartbeat(node_name)
 
     def _report_result(self) -> Result:
         if self.report_interval_s is None:
@@ -212,6 +223,10 @@ class TpuAgent:
     # Reporter
     # ------------------------------------------------------------------
     def report(self, client: Client, req: Request) -> Result:
+        if self._heartbeat is not None:
+            # renew first: the heartbeat must reflect that THIS daemon is
+            # alive even when the node object is mid-churn below
+            self._heartbeat.renew(client)
         try:
             node = client.get("Node", self.node_name)
         except NotFound:

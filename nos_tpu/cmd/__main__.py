@@ -10,6 +10,7 @@ _BINARIES = {
     "partitioner": "nos_tpu.cmd.partitioner",
     "tpuagent": "nos_tpu.cmd.tpuagent",
     "deviceplugin": "nos_tpu.cmd.deviceplugin",
+    "lifecycle": "nos_tpu.cmd.lifecycle",
     "metricsexporter": "nos_tpu.cmd.metricsexporter",
     "trainer": "nos_tpu.cmd.trainer",
     "generate": "nos_tpu.cmd.generate",

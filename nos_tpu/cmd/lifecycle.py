@@ -1,0 +1,88 @@
+"""nos-tpu-lifecycle — the node-lifecycle / slice-repair controller.
+
+No reference analog (the nos stack assumes healthy nodes; SURVEY §2.7
+flags node/slice fault handling as new TPU ground). Hosts
+``lifecycle.NodeLifecycleController``: watches node heartbeat Leases and
+lifecycle notice annotations, fences dead / preempted / maintenance-due /
+chip-degraded nodes, and evicts displaced multi-host gangs whole so the
+gang scheduler rebinds them atomically on surviving slices.
+"""
+from __future__ import annotations
+
+import argparse
+from typing import Optional, Sequence
+
+from nos_tpu.cmd import serve
+from nos_tpu.kube.controller import Manager
+from nos_tpu.kube.leaderelection import LeaderElectionConfig
+from nos_tpu.lifecycle import NodeLifecycleController
+
+
+def build(
+    server,
+    lease_timeout_s: float = 40.0,
+    check_interval_s: float = 5.0,
+    maintenance_drain_lead_s: float = 120.0,
+    max_unhealthy_chips: int = 0,
+    leader_election: bool = True,
+    identity: str = "lifecycle-0",
+) -> Manager:
+    election = None
+    if leader_election:
+        election = LeaderElectionConfig(
+            lease_name="nos-tpu-lifecycle-leader", identity=identity)
+    mgr = Manager(server, leader_election=election)
+    # the controller keeps its wall-clock default (notice deadlines are
+    # cross-host wall timestamps); the manager's monotonic clock only
+    # paces requeues, and the two need not agree
+    mgr.add_controller(NodeLifecycleController(
+        lease_timeout_s=lease_timeout_s,
+        check_interval_s=check_interval_s,
+        maintenance_drain_lead_s=maintenance_drain_lead_s,
+        max_unhealthy_chips=max_unhealthy_chips,
+    ).controller())
+    return mgr
+
+
+def main(argv: Optional[Sequence[str]] = None) -> None:
+    parser = argparse.ArgumentParser(
+        prog="nos-tpu-lifecycle", description=__doc__)
+    serve.common_flags(parser, config=False)
+    parser.add_argument(
+        "--lease-timeout", type=float, default=40.0,
+        help="seconds a node's heartbeat Lease may sit unchanged before "
+             "the node is declared NotReady (kubelet default ceiling)")
+    parser.add_argument(
+        "--check-interval", type=float, default=5.0,
+        help="seconds between per-node staleness re-checks")
+    parser.add_argument(
+        "--maintenance-drain-lead", type=float, default=120.0,
+        help="seconds ahead of an announced maintenance window to start "
+             "draining the node")
+    parser.add_argument(
+        "--max-unhealthy-chips", type=int, default=0,
+        help="tolerated unhealthy chips per node before slice repair "
+             "treats the host as failed")
+    parser.add_argument(
+        "--identity", default="lifecycle-0",
+        help="leader-election identity (pod name in-cluster)")
+    parser.add_argument(
+        "--no-leader-election", action="store_true",
+        help="single-replica deployments may skip the Lease")
+    args = parser.parse_args(argv)
+
+    serve.setup_logging(args.log_level or 0)
+    mgr = build(
+        serve.connect(args),
+        lease_timeout_s=args.lease_timeout,
+        check_interval_s=args.check_interval,
+        maintenance_drain_lead_s=args.maintenance_drain_lead,
+        max_unhealthy_chips=args.max_unhealthy_chips,
+        leader_election=not args.no_leader_election,
+        identity=args.identity,
+    )
+    serve.run_daemon(mgr, args.health_port, args.health_host)
+
+
+if __name__ == "__main__":
+    main()

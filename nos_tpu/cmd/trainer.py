@@ -113,6 +113,14 @@ class TrainerConfig:
     profile_dir: str = ""
     profile_start: int = 2
     profile_steps: int = 3
+    # lifecycle integration: when BOTH are set, the binary watches its
+    # own node for preemption/maintenance notices on the control plane
+    # (nos_tpu/lifecycle) and turns them into the graceful-stop event —
+    # the same checkpoint-banking path SIGTERM takes, but triggered by
+    # the notice's lead time instead of the eviction itself. node_name
+    # comes from the downward API (spec.nodeName) in the gang manifests.
+    node_name: str = ""
+    lifecycle_api: str = ""
     # misc
     log_level: str = "info"
     bf16: bool = True
@@ -591,9 +599,30 @@ def main(argv: Optional[Sequence[str]] = None) -> None:
 
         health = HealthServer(host="0.0.0.0", port=cfg.metrics_port).start()
         logger.info("metrics on %s/metrics", health.address)
+    stop_event = None
+    notice_mgr = None
+    if cfg.node_name and cfg.lifecycle_api:
+        # preemption-notice watcher: a maintenance/preemption notice on
+        # THIS pod's node sets the stop event train() consumes, banking a
+        # checkpoint inside the notice's lead time (lifecycle/events.py)
+        import threading
+
+        from nos_tpu.kube.controller import Manager
+        from nos_tpu.kube.httpapi import RemoteApiServer
+        from nos_tpu.lifecycle.events import preemption_signal_controller
+
+        stop_event = threading.Event()
+        notice_mgr = Manager(RemoteApiServer(cfg.lifecycle_api))
+        notice_mgr.add_controller(
+            preemption_signal_controller(cfg.node_name, stop_event))
+        threading.Thread(target=notice_mgr.run, daemon=True).start()
+        logger.info("watching node %s for preemption/maintenance notices",
+                    cfg.node_name)
     try:
-        final = train(cfg)
+        final = train(cfg, stop_event=stop_event)
     finally:
+        if notice_mgr is not None:
+            notice_mgr.stop()
         if health is not None:
             health.stop()
     logger.info("training done, final loss %.4f", final)

@@ -128,6 +128,35 @@ DEFAULT_REPORT_INTERVAL_S = 10.0        # migagent report interval
 DEFAULT_DEVICE_PLUGIN_DELAY_S = 5.0     # mps partitioner CM propagation delay
 DEFAULT_POD_RESOURCES_TIMEOUT_S = 10.0
 
+# ---------------------------------------------------------------------------
+# Node lifecycle (nos_tpu/lifecycle) — the slice-repair control plane
+# ---------------------------------------------------------------------------
+# Node heartbeats ride coordination Leases named after the node, in the
+# kubelet's standard lease namespace (on GKE the kubelet renews these; in
+# this stack the tpuagent reporter doubles as the renewer).
+NODE_LEASE_NAMESPACE = "kube-node-lease"
+# GCE-style upcoming-maintenance notice: value is the window start time as
+# wall-clock seconds (time.time — the one cross-host clock domain; see
+# lifecycle/events.py). On a real fleet the GCE metadata watcher stamps
+# this from computeMetadata/v1/instance/maintenance-event.
+ANNOTATION_MAINTENANCE_START = DOMAIN + "/maintenance-window-start"
+# Spot/preemptible preemption notice: value is the ACPI-shutdown deadline
+# (wall-clock seconds). Pods on the node have until then to bank progress
+# — the trainer's SIGTERM checkpoint path keys off this via
+# lifecycle.preemption_signal_controller.
+ANNOTATION_PREEMPTION_DEADLINE = DOMAIN + "/preemption-deadline"
+# Marker the lifecycle controller leaves on nodes IT cordoned, so recovery
+# only uncordons nodes the controller itself fenced (an operator's manual
+# cordon must survive a node heartbeat coming back).
+ANNOTATION_LIFECYCLE_CORDONED = DOMAIN + "/lifecycle-cordoned"
+# Restart generation stamped onto pods the slice-repair path recreates
+# (observability: how many times has this worker been displaced).
+ANNOTATION_LIFECYCLE_RESTARTS = DOMAIN + "/lifecycle-restarts"
+# Taints applied when fencing a node (kube's own unreachable taint key for
+# lease/heartbeat death; a nos key for impending maintenance).
+TAINT_UNREACHABLE = "node.kubernetes.io/unreachable"
+TAINT_MAINTENANCE = DOMAIN + "/maintenance"
+
 # Scheduler / controller names
 SCHEDULER_NAME = "nos-scheduler"
 DEVICE_PLUGIN_CONFIGMAP = "nos-device-plugin-config"

@@ -29,8 +29,10 @@ from nos_tpu.kube.objects import (
     Affinity,
     ConfigMap,
     Container,
+    ContainerPort,
     LabelSelector,
     Node,
+    NodeCondition,
     NodeSelectorRequirement,
     NodeSelectorTerm,
     PodAffinityTerm,
@@ -208,6 +210,13 @@ def _container_to_k8s(c: Container) -> dict:
         res["limits"] = _resources_to_k8s(c.limits)
     if res:
         out["resources"] = res
+    if c.ports:
+        out["ports"] = [
+            {"containerPort": p.container_port,
+             **({"hostPort": p.host_port} if p.host_port else {}),
+             **({"protocol": p.protocol} if p.protocol != "TCP" else {})}
+            for p in c.ports
+        ]
     return out
 
 
@@ -218,6 +227,14 @@ def _container_from_k8s(d: dict) -> Container:
         image=d.get("image", ""),
         requests=_resources_from_k8s(res.get("requests")),
         limits=_resources_from_k8s(res.get("limits")),
+        ports=[
+            ContainerPort(
+                container_port=int(p.get("containerPort") or 0),
+                host_port=int(p.get("hostPort") or 0),
+                protocol=p.get("protocol", "TCP"),
+            )
+            for p in (d.get("ports") or [])
+        ],
     )
 
 
@@ -487,14 +504,24 @@ def node_to_k8s(n: Node) -> dict:
         ]
     if n.spec.unschedulable:
         spec["unschedulable"] = True
+    status: dict = {
+        "capacity": _resources_to_k8s(n.status.capacity),
+        "allocatable": _resources_to_k8s(n.status.allocatable),
+    }
+    if n.status.conditions:
+        status["conditions"] = [
+            {"type": c.type, "status": c.status,
+             **({"reason": c.reason} if c.reason else {}),
+             **({"message": c.message} if c.message else {}),
+             **({"lastTransitionTime": _ts_to_k8s(c.last_transition)}
+                if c.last_transition else {})}
+            for c in n.status.conditions
+        ]
     return {
         "apiVersion": "v1", "kind": "Node",
         "metadata": _meta_to_k8s(n.metadata),
         "spec": spec,
-        "status": {
-            "capacity": _resources_to_k8s(n.status.capacity),
-            "allocatable": _resources_to_k8s(n.status.allocatable),
-        },
+        "status": status,
     }
 
 
@@ -512,6 +539,16 @@ def node_from_k8s(d: dict) -> Node:
         status=NodeStatus(
             capacity=_resources_from_k8s(status.get("capacity")),
             allocatable=_resources_from_k8s(status.get("allocatable")),
+            conditions=[
+                NodeCondition(
+                    type=c.get("type", ""), status=c.get("status", ""),
+                    reason=c.get("reason", ""),
+                    message=c.get("message", ""),
+                    last_transition=_ts_from_k8s(
+                        c.get("lastTransitionTime")),
+                )
+                for c in (status.get("conditions") or [])
+            ],
         ),
     )
 

@@ -62,11 +62,23 @@ class ObjectMeta:
 
 
 @dataclass
+class ContainerPort:
+    """One containerPort entry; only host-port claims matter to scheduling
+    (kube's NodePorts filter rejects nodes where the (hostIP, hostPort,
+    protocol) triple is already claimed — hostIP is not modeled)."""
+
+    container_port: int = 0
+    host_port: int = 0          # 0 = no host port claimed
+    protocol: str = "TCP"
+
+
+@dataclass
 class Container:
     name: str = "main"
     image: str = ""
     requests: ResourceList = field(default_factory=dict)
     limits: ResourceList = field(default_factory=dict)
+    ports: List[ContainerPort] = field(default_factory=list)
 
 
 @dataclass
@@ -292,11 +304,37 @@ class Pod:
     def priority(self) -> int:
         return self.spec.priority if self.spec.priority is not None else 0
 
+    def host_ports(self) -> List[tuple]:
+        """(host_port, protocol) pairs this pod claims on its node (the
+        NodePorts filter input; init containers' ports are not host-bound
+        concurrently with the main containers so only spec.containers
+        count, as in kube)."""
+        return [
+            (p.host_port, p.protocol or "TCP")
+            for c in self.spec.containers
+            for p in c.ports
+            if p.host_port
+        ]
+
+
+@dataclass
+class NodeCondition:
+    """core/v1 NodeCondition as the lifecycle controller maintains it
+    (type=Ready is the one consumed; kubelet's pressure conditions are
+    not modeled)."""
+
+    type: str = ""
+    status: str = ""    # "True" | "False" | "Unknown"
+    reason: str = ""
+    message: str = ""
+    last_transition: float = 0.0
+
 
 @dataclass
 class NodeStatus:
     capacity: ResourceList = field(default_factory=dict)
     allocatable: ResourceList = field(default_factory=dict)
+    conditions: List[NodeCondition] = field(default_factory=list)
 
 
 @dataclass

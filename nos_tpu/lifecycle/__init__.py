@@ -1,0 +1,36 @@
+"""Node lifecycle & slice repair — the fault half of the control plane.
+
+The nos reference assumes nodes stay healthy: its partitioning and quota
+loops react to pod churn, never to node death. On Cloud TPU that blind
+spot is fatal — one unhealthy host invalidates an entire multi-host ICI
+slice, and GKE TPU fleets routinely see maintenance events, spot
+preemption, kubelet lease expiry and agent crashes. This package closes
+the gap:
+
+- ``events``      — the fault/notice model (maintenance, preemption,
+                    lease expiry, chip degradation) and node heartbeats;
+- ``controller``  — the NodeLifecycleController: NotReady detection,
+                    cordon + taint fencing, graceful drain, and
+                    whole-slice gang eviction (a multi-host slice is one
+                    atomic failure domain);
+- ``chaos``       — a seeded, replayable fault injector + harness
+                    driving the whole stack on a simulated clock
+                    (bench_chaos.py reports detection latency and MTTR).
+"""
+from nos_tpu.lifecycle.controller import NodeLifecycleController
+from nos_tpu.lifecycle.events import (
+    NodeHeartbeat,
+    maintenance_start,
+    preemption_deadline,
+    preemption_signal_controller,
+    unhealthy_chip_indexes,
+)
+
+__all__ = [
+    "NodeLifecycleController",
+    "NodeHeartbeat",
+    "maintenance_start",
+    "preemption_deadline",
+    "preemption_signal_controller",
+    "unhealthy_chip_indexes",
+]

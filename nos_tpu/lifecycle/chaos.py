@@ -1,0 +1,508 @@
+"""Seeded, replayable chaos harness for the lifecycle control plane.
+
+Drives the REAL stack — ApiServer double, Scheduler, gang placement,
+NodeLifecycleController — on a simulated clock, injecting a
+seed-deterministic fault schedule: node kills, heartbeat/lease expiry,
+GCE maintenance notices with lead time, spot-preemption notices, chip
+degradation, and watch-stream flaps (drop + informer re-list). Every
+run with the same seed and geometry is BIT-REPRODUCIBLE: the event log
+(and thus ``fingerprint()``) is a pure function of the seed, because
+every time source in the loop is the harness clock and every iteration
+order in the stack is name-sorted.
+
+Measured per fault (simulated-clock seconds, fed into the
+``nos_lifecycle_*`` histograms bench_chaos.py reports):
+
+- **detection latency** — injection to the controller fencing the node
+  (or, for a kill, finishing the drain);
+- **MTTR** — injection to every displaced gang being atomically rebound.
+
+Invariants checked EVERY tick (violations recorded, never masked):
+
+- no node over-committed beyond its TPU allocatable (no double-binds);
+- each gang's bound members sit on distinct hosts of one ICI domain;
+- a fenced or dead node holds no bound pods once drained.
+"""
+from __future__ import annotations
+
+import hashlib
+import random
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Set, Tuple
+
+from nos_tpu import constants, observability as obs
+from nos_tpu.kube.apiserver import ApiServer, NotFound, WatchEvent
+from nos_tpu.kube.client import Client
+from nos_tpu.kube.controller import Manager
+from nos_tpu.kube.objects import (
+    Container,
+    Node,
+    NodeSpec,
+    NodeStatus,
+    ObjectMeta,
+    Pod,
+    PodSpec,
+    PodStatus,
+    Taint,
+    Toleration,
+)
+from nos_tpu.lifecycle.controller import NodeLifecycleController
+from nos_tpu.lifecycle.events import (
+    NodeHeartbeat,
+    deliver_maintenance_notice,
+    deliver_preemption_notice,
+)
+from nos_tpu.scheduler import Scheduler
+from nos_tpu.scheduler.gang import gang_key
+
+TPU = constants.RESOURCE_TPU
+V5E = "tpu-v5-lite-podslice"
+TPU_TAINT = Taint(key=TPU, value="present", effect="NoSchedule")
+TOLERATION = Toleration(key=TPU, operator="Exists")
+
+FAULT_KINDS = ("kill", "expire", "maintenance", "preempt", "degrade", "flap")
+
+
+class FakeClock:
+    """Deterministic monotonic clock shared by the ApiServer, Manager,
+    lifecycle controller and heartbeats."""
+
+    def __init__(self, start: float = 1000.0):
+        self.t = float(start)
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, dt: float) -> float:
+        self.t += dt
+        return self.t
+
+
+@dataclass(frozen=True)
+class Fault:
+    at: float
+    kind: str              # FAULT_KINDS
+    node: str = ""         # empty for cluster-wide faults (flap)
+    lead_s: float = 0.0    # maintenance lead / preemption grace
+    chips: Tuple[int, ...] = ()
+    recover_at: float = 0.0   # 0 = never recovers within the run
+
+
+def seeded_faults(
+    seed: int,
+    node_names: List[str],
+    duration_s: float,
+    n_faults: int = 6,
+    kinds: Tuple[str, ...] = FAULT_KINDS,
+) -> List[Fault]:
+    """A deterministic fault schedule: same (seed, nodes, duration, n) →
+    the identical list. Injection times land in the first 60% of the run
+    so repair has room to complete; at most one standing fault per node
+    (two independent faults on one host mostly shadow each other)."""
+    rng = random.Random(seed)
+    names = sorted(node_names)
+    used: Set[str] = set()
+    faults: List[Fault] = []
+    for i in range(n_faults):
+        kind = kinds[rng.randrange(len(kinds))]
+        # injections land in the first 55% of the run and every recovery
+        # by 85%, so repair can complete inside the window
+        at = round(rng.uniform(0.08, 0.55) * duration_s, 3)
+        recover = round(at + rng.uniform(0.15, 0.3) * duration_s, 3)
+        if kind == "flap":
+            faults.append(Fault(at=at, kind="flap"))
+            continue
+        free = [n for n in names if n not in used]
+        if not free:
+            break
+        node = free[rng.randrange(len(free))]
+        used.add(node)
+        if kind == "maintenance":
+            faults.append(Fault(
+                at=at, kind="maintenance", node=node,
+                lead_s=round(rng.uniform(5.0, 15.0), 3),
+                recover_at=recover))
+        elif kind == "preempt":
+            faults.append(Fault(
+                at=at, kind="preempt", node=node,
+                lead_s=round(rng.uniform(3.0, 8.0), 3),
+                recover_at=recover))
+        elif kind == "degrade":
+            faults.append(Fault(
+                at=at, kind="degrade", node=node,
+                chips=(rng.randrange(8),), recover_at=recover))
+        else:   # kill | expire
+            faults.append(Fault(
+                at=at, kind=kind, node=node, recover_at=recover))
+    faults.sort(key=lambda f: (f.at, f.kind, f.node))
+    return faults
+
+
+@dataclass
+class ChaosReport:
+    seed: int
+    log: List[str] = field(default_factory=list)
+    detection_s: List[float] = field(default_factory=list)
+    mttr_s: List[float] = field(default_factory=list)
+    slice_evictions: int = 0
+    evicted_pods: int = 0
+    double_binds: int = 0
+    invariant_violations: List[str] = field(default_factory=list)
+    unrepaired_gangs: List[str] = field(default_factory=list)
+    unbound_pods_final: int = 0
+    faults: List[Fault] = field(default_factory=list)
+
+    def fingerprint(self) -> str:
+        """sha256 over the event log — equal across runs iff the run was
+        bit-reproducible."""
+        return hashlib.sha256("\n".join(self.log).encode()).hexdigest()
+
+
+class _TrackedFault:
+    """Runtime state of one injected fault (detection/MTTR bookkeeping)."""
+
+    def __init__(self, fault: Fault, displaced_gangs: Set[tuple]):
+        self.fault = fault
+        self.displaced = displaced_gangs      # gang keys displaced at t0
+        self.detected_at: Optional[float] = None
+        self.repaired_at: Optional[float] = None
+
+
+class ChaosHarness:
+    """One seeded end-to-end run. Geometry: ``pools`` v5e 4x4 pools (2
+    hosts x 8 chips each) hosting ``gangs`` 2-worker gangs; spare pools
+    give displaced gangs somewhere to go."""
+
+    def __init__(
+        self,
+        seed: int = 0,
+        pools: int = 6,
+        gangs: int = 3,
+        duration_s: float = 60.0,
+        tick_s: float = 0.5,
+        n_faults: int = 6,
+        lease_timeout_s: float = 3.0,
+        kinds: Tuple[str, ...] = FAULT_KINDS,
+    ):
+        self.seed = seed
+        self.duration_s = duration_s
+        self.tick_s = tick_s
+        self.clock = FakeClock()
+        self.t0 = self.clock()       # fault .at times are relative to this
+        self.server = ApiServer(clock=self.clock)
+        self.client = Client(self.server)
+        self.mgr = Manager(self.server, clock=self.clock)
+        self.scheduler = Scheduler()
+        self.lifecycle = NodeLifecycleController(
+            lease_timeout_s=lease_timeout_s,
+            check_interval_s=tick_s,
+            maintenance_drain_lead_s=20.0,
+            clock=self.clock,
+        )
+        self.mgr.add_controller(self.scheduler.controller())
+        self.mgr.add_controller(self.lifecycle.controller())
+
+        self.node_names: List[str] = []
+        self.pool_of: Dict[str, str] = {}
+        for pool in range(pools):
+            pname = f"chaos-{pool:02d}"
+            for host in range(2):                 # v5e 4x4 = 2 hosts
+                name = f"{pname}-w{host}"
+                self.server.create(Node(
+                    metadata=ObjectMeta(
+                        name=name,
+                        labels={
+                            constants.LABEL_TPU_ACCELERATOR: V5E,
+                            constants.LABEL_TPU_TOPOLOGY: "4x4",
+                            constants.LABEL_NODEPOOL: pname,
+                        },
+                    ),
+                    spec=NodeSpec(taints=[TPU_TAINT]),
+                    status=NodeStatus(capacity={TPU: 8, "cpu": 96},
+                                      allocatable={TPU: 8, "cpu": 96}),
+                ))
+                self.node_names.append(name)
+                self.pool_of[name] = pname
+        from nos_tpu.api.quota import make_elastic_quota
+
+        self.server.create(make_elastic_quota(
+            "q-chaos", "chaos", min={TPU: pools * 16}))
+
+        self.gang_names: List[str] = []
+        for g in range(gangs):
+            job = f"gang-{g}"
+            self.gang_names.append(job)
+            for w in range(2):
+                self.server.create(self._gang_pod(job, w))
+
+        # heartbeats: the harness renews for every live host (standing in
+        # for the per-node tpuagent fleet); faults stop individual renewers
+        self.heartbeats = {
+            n: NodeHeartbeat(n, clock=self.clock) for n in self.node_names}
+        self.alive: Set[str] = set(self.node_names)
+        self.renewing: Set[str] = set(self.node_names)
+
+        self.faults = seeded_faults(
+            seed, self.node_names, duration_s, n_faults, kinds=kinds)
+        self.report = ChaosReport(seed=seed, faults=list(self.faults))
+        self._tracked: List[_TrackedFault] = []
+        self._pending = list(self.faults)
+        self._recoveries: List[Tuple[float, Fault]] = sorted(
+            ((f.recover_at, f) for f in self.faults if f.recover_at),
+            key=lambda x: (x[0], x[1].kind, x[1].node))
+        # node spec snapshots for kill-respawn
+        self._node_specs: Dict[str, Node] = {
+            n: self.server.get("Node", n) for n in self.node_names}
+
+    # ------------------------------------------------------------------
+    def _gang_pod(self, job: str, worker: int) -> Pod:
+        return Pod(
+            metadata=ObjectMeta(
+                name=f"{job}-{worker}", namespace="chaos",
+                labels={
+                    constants.LABEL_GANG_NAME: job,
+                    constants.LABEL_GANG_SIZE: "2",
+                    constants.LABEL_GANG_WORKER: str(worker),
+                },
+                annotations={constants.ANNOTATION_TPU_TOPOLOGY: "4x4"},
+            ),
+            spec=PodSpec(
+                containers=[Container(requests={TPU: 8})],
+                scheduler_name=constants.SCHEDULER_NAME,
+                tolerations=[TOLERATION],
+            ),
+            status=PodStatus(phase="Pending"),
+        )
+
+    # ------------------------------------------------------------------
+    def _log(self, msg: str) -> None:
+        self.report.log.append(f"{self.clock() - self.t0:08.3f} {msg}")
+
+    def _bound_pods(self) -> List[Pod]:
+        return [p for p in self.server.list("Pod")
+                if p.spec.node_name
+                and p.status.phase in ("Pending", "Running")]
+
+    def _gangs_on(self, node: str) -> Set[tuple]:
+        out = set()
+        for p in self._bound_pods():
+            if p.spec.node_name == node:
+                gk = gang_key(p)
+                if gk is not None:
+                    out.add((gk.namespace, gk.name))
+        return out
+
+    # ------------------------------------------------------------------
+    # Fault application
+    # ------------------------------------------------------------------
+    def _apply_fault(self, f: Fault) -> None:
+        displaced = self._gangs_on(f.node) if f.node else set()
+        if f.kind == "kill":
+            self.alive.discard(f.node)
+            self.renewing.discard(f.node)
+            try:
+                self.server.delete("Node", f.node)
+            except NotFound:
+                pass
+        elif f.kind == "expire":
+            self.renewing.discard(f.node)
+        elif f.kind == "maintenance":
+            deliver_maintenance_notice(
+                self.client, f.node, self.clock() + f.lead_s)
+        elif f.kind == "preempt":
+            deliver_preemption_notice(
+                self.client, f.node, self.clock() + f.lead_s)
+        elif f.kind == "degrade":
+            def mutate(n: Node):
+                n.metadata.annotations[
+                    constants.ANNOTATION_UNHEALTHY_CHIPS] = ",".join(
+                        str(i) for i in f.chips)
+            self.client.patch("Node", f.node, "", mutate)
+        elif f.kind == "flap":
+            self._flap_watch()
+        self._tracked.append(_TrackedFault(f, displaced))
+        self._log(f"fault {f.kind} node={f.node or '*'} "
+                  f"displaced={sorted(displaced)}")
+
+    def _apply_recovery(self, f: Fault) -> None:
+        if f.kind == "kill":
+            if f.node in self.alive:
+                return
+            spec = self._node_specs[f.node]
+            self.server.create(Node(
+                metadata=ObjectMeta(name=f.node,
+                                    labels=dict(spec.metadata.labels)),
+                spec=NodeSpec(taints=list(spec.spec.taints)),
+                status=NodeStatus(capacity=dict(spec.status.capacity),
+                                  allocatable=dict(spec.status.allocatable)),
+            ))
+            self.alive.add(f.node)
+            self.renewing.add(f.node)
+        elif f.kind == "expire":
+            self.renewing.add(f.node)
+        elif f.kind in ("maintenance", "preempt"):
+            key = (constants.ANNOTATION_MAINTENANCE_START
+                   if f.kind == "maintenance"
+                   else constants.ANNOTATION_PREEMPTION_DEADLINE)
+
+            def clear(n: Node):
+                n.metadata.annotations.pop(key, None)
+            try:
+                self.client.patch("Node", f.node, "", clear)
+            except NotFound:
+                return
+        elif f.kind == "degrade":
+            def heal(n: Node):
+                n.metadata.annotations.pop(
+                    constants.ANNOTATION_UNHEALTHY_CHIPS, None)
+            try:
+                self.client.patch("Node", f.node, "", heal)
+            except NotFound:
+                return
+        self._log(f"recover {f.kind} node={f.node or '*'}")
+
+    def _flap_watch(self) -> None:
+        """Cut the manager's watch stream and re-list — what a resumed
+        informer does. Buffered (possibly undelivered) events are dropped
+        to simulate the loss; the re-list both re-seeds every controller
+        queue and re-primes the scheduler's cache so stale entries (e.g.
+        a DELETED pod whose event died with the stream) are purged."""
+        while self.mgr._sub.pop() is not None:
+            pass
+        self.scheduler.cache.prime(self.client)
+        for c in self.mgr.controllers:
+            for kind in c.watches:
+                for obj in self.server.list(kind):
+                    c.offer(WatchEvent("ADDED", kind, obj))
+
+    # ------------------------------------------------------------------
+    # Observation
+    # ------------------------------------------------------------------
+    def _node_fenced(self, name: str) -> bool:
+        node = self.server.try_get("Node", name)
+        if node is None:
+            return True
+        return bool(node.metadata.annotations.get(
+            constants.ANNOTATION_LIFECYCLE_CORDONED))
+
+    def _gang_fully_bound(self, ns: str, name: str) -> bool:
+        members = [p for p in self.server.list("Pod", namespace=ns)
+                   if p.metadata.labels.get(
+                       constants.LABEL_GANG_NAME) == name]
+        if not members:
+            return False
+        declared = int(members[0].metadata.labels.get(
+            constants.LABEL_GANG_SIZE, "0"))
+        bound = [p for p in members if p.spec.node_name]
+        return len(members) == declared and len(bound) == declared
+
+    def _observe(self) -> None:
+        now = self.clock()
+        for t in self._tracked:
+            f = t.fault
+            if t.detected_at is None and f.node:
+                if f.kind == "kill":
+                    done = not any(p.spec.node_name == f.node
+                                   for p in self._bound_pods())
+                else:
+                    done = self._node_fenced(f.node)
+                if done:
+                    t.detected_at = now
+                    lat = max(0.0, now - (self.t0 + f.at))
+                    self.report.detection_s.append(lat)
+                    obs.LIFECYCLE_DETECTION.observe(lat)
+                    self._log(f"detected {f.kind} node={f.node} "
+                              f"latency={lat:.3f}")
+            if t.repaired_at is None and t.displaced:
+                if all(self._gang_fully_bound(ns, g)
+                       for ns, g in t.displaced):
+                    t.repaired_at = now
+                    mttr = max(0.0, now - (self.t0 + f.at))
+                    self.report.mttr_s.append(mttr)
+                    obs.LIFECYCLE_MTTR.observe(mttr)
+                    self._log(f"repaired {f.kind} node={f.node} "
+                              f"gangs={sorted(t.displaced)} "
+                              f"mttr={mttr:.3f}")
+
+    def _check_invariants(self) -> None:
+        """Double-bind / over-commit / domain-atomicity checks. A
+        violation is recorded with the sim time so the failure mode is
+        reconstructible from the log alone."""
+        by_node: Dict[str, float] = {}
+        gang_nodes: Dict[tuple, List[Tuple[int, str]]] = {}
+        for p in self._bound_pods():
+            by_node[p.spec.node_name] = (
+                by_node.get(p.spec.node_name, 0.0)
+                + p.request().get(TPU, 0.0))
+            gk = gang_key(p)
+            if gk is not None:
+                worker = int(p.metadata.labels.get(
+                    constants.LABEL_GANG_WORKER, "0"))
+                gang_nodes.setdefault((gk.namespace, gk.name), []).append(
+                    (worker, p.spec.node_name))
+        rel = self.clock() - self.t0
+        for node_name, used in sorted(by_node.items()):
+            node = self.server.try_get("Node", node_name)
+            cap = (node.status.allocatable.get(TPU, 0.0)
+                   if node is not None else 0.0)
+            if node is None or used > cap + 1e-9:
+                self.report.double_binds += 1
+                self.report.invariant_violations.append(
+                    f"{rel:.3f} overcommit {node_name}: {used} > {cap}")
+        for gkey, pairs in sorted(gang_nodes.items()):
+            nodes = [n for _, n in pairs]
+            workers = [w for w, _ in pairs]
+            if len(set(nodes)) != len(nodes) or \
+                    len(set(workers)) != len(workers):
+                self.report.double_binds += 1
+                self.report.invariant_violations.append(
+                    f"{rel:.3f} gang {gkey} double-bind: {sorted(pairs)}")
+            pools = {self.pool_of.get(n, n.rsplit('-w', 1)[0])
+                     for n in nodes}
+            if len(pools) > 1:
+                self.report.double_binds += 1
+                self.report.invariant_violations.append(
+                    f"{rel:.3f} gang {gkey} straddles "
+                    f"domains {sorted(pools)}")
+
+    # ------------------------------------------------------------------
+    def run(self) -> ChaosReport:
+        evicted_before = obs.LIFECYCLE_EVICTED_PODS.total()
+        slices_before = obs.LIFECYCLE_SLICE_EVICTIONS.total()
+        self.mgr.run_until_idle()      # initial placement
+        self._log("initial placement done, bound="
+                  + str(len(self._bound_pods())))
+        end = self.clock() + self.duration_s
+        while self.clock() < end:
+            for name in sorted(self.renewing):
+                self.heartbeats[name].renew(self.client)
+            while self._pending and \
+                    self._pending[0].at + self.t0 <= self.clock():
+                self._apply_fault(self._pending.pop(0))
+            while self._recoveries and \
+                    self._recoveries[0][0] + self.t0 <= self.clock():
+                self._apply_recovery(self._recoveries.pop(0)[1])
+            self.mgr.run_until_idle()
+            self._observe()
+            self._check_invariants()
+            self.clock.advance(self.tick_s)
+        # final convergence pass at the end of the window
+        self.mgr.run_until_idle()
+        self._observe()
+        self._check_invariants()
+        self.report.evicted_pods = int(
+            obs.LIFECYCLE_EVICTED_PODS.total() - evicted_before)
+        self.report.slice_evictions = int(
+            obs.LIFECYCLE_SLICE_EVICTIONS.total() - slices_before)
+        self.report.unbound_pods_final = sum(
+            1 for p in self.server.list("Pod")
+            if not p.spec.node_name and p.status.phase == "Pending")
+        self.report.unrepaired_gangs = sorted(
+            f"{ns}/{g}" for t in self._tracked
+            for ns, g in t.displaced if t.repaired_at is None)
+        self._log(
+            f"end bound={len(self._bound_pods())} "
+            f"unbound={self.report.unbound_pods_final} "
+            f"double_binds={self.report.double_binds}")
+        self.mgr.stop()
+        return self.report

@@ -1,0 +1,420 @@
+"""Node lifecycle controller: NotReady detection, fencing, slice repair.
+
+The level-triggered reconciler that makes node death a first-class input
+to the control plane (the reference has no analog — its controllers react
+to pod churn only). Per node, every pass re-derives the truth from four
+signals (see lifecycle/events.py) and converges the cluster onto it:
+
+- **detection** — the node's heartbeat Lease is judged by the same
+  observed-time rule leader election uses: a record UNCHANGED for a full
+  ``lease_timeout_s`` on the controller's own clock means the host (or
+  its agent) is gone. Remote timestamps are never compared to the local
+  clock, so skewed or differently-epoched clocks cannot false-positive.
+- **fencing** — a dead / preempted / maintenance-due / chip-degraded node
+  is marked ``Ready=False`` (lease death), cordoned
+  (``spec.unschedulable``) and tainted, with a marker annotation so
+  recovery only unfences nodes THIS controller fenced (an operator's
+  manual cordon survives a heartbeat coming back).
+- **slice repair** — the TPU-specific core: a multi-host slice is one
+  atomic failure domain. One dead host evicts the WHOLE gang across its
+  ICI domain (members on healthy hosts included) by deleting every member
+  and recreating it as a fresh Pending pod, so the gang scheduler's
+  all-or-nothing placement rebinds the gang as a unit on surviving
+  capacity. The scheduler's watch-fed cache (and its free-capacity
+  index) absorbs the delete/create churn like any other pod event, so
+  repair cannot double-bind: every recreated worker binds exactly once,
+  through the normal gang admission + placement path.
+- **recovery** — when the signal clears (heartbeats resume, notice
+  withdrawn, chips healthy), a node fenced by this controller is
+  uncordoned, its lifecycle taints dropped, and ``Ready=True`` restored.
+
+Pump with ``Manager.run_until_idle(advance_delayed=False)`` plus explicit
+clock advancement (the chaos harness) or ``Manager.run`` in daemons —
+``advance_delayed=True`` would fast-forward the perpetual lease-poll
+requeue into a livelock.
+"""
+from __future__ import annotations
+
+import logging
+import time
+from typing import Callable, Dict, List, Optional, Set, Tuple
+
+from nos_tpu import constants, observability as obs
+from nos_tpu.kube.apiserver import AlreadyExists, NotFound
+from nos_tpu.kube.client import Client
+from nos_tpu.kube.controller import Controller, Request, Result, Watch
+from nos_tpu.kube.objects import (
+    Node,
+    NodeCondition,
+    ObjectMeta,
+    Pod,
+    PodStatus,
+    Taint,
+    deep_copy,
+)
+from nos_tpu.lifecycle.events import (
+    maintenance_start,
+    preemption_deadline,
+    unhealthy_chip_indexes,
+)
+from nos_tpu.scheduler.gang import gang_key, gang_worker
+
+logger = logging.getLogger(__name__)
+
+
+def _requests_tpu(pod: Pod) -> bool:
+    from nos_tpu.tpu.slice import is_slice_resource
+
+    return any(
+        q > 0 and (r == constants.RESOURCE_TPU or is_slice_resource(r))
+        for r, q in pod.request().items()
+    )
+
+
+class NodeLifecycleController:
+    """One reconciler over (Node, node Lease) pairs; see module docstring.
+
+    ``clock`` defaults to wall clock (``time.time``): notice annotations
+    carry wall-clock deadlines stamped on OTHER hosts, and only the wall
+    clock is a shared domain (events.py). Lease staleness needs no shared
+    domain (observed-change rule), so one clock serves both. The chaos
+    harness injects its simulated clock here AND as the Manager clock so
+    requeue cadence and staleness advance together deterministically.
+    """
+
+    #: drain everything on these reasons; chip degradation drains only
+    #: gangs and TPU-requesting pods (a CPU sidecar on a degraded host is
+    #: unaffected by a bad chip)
+    FULL_DRAIN_REASONS = ("lease_expired", "node_deleted", "maintenance",
+                          "preemption")
+
+    def __init__(
+        self,
+        lease_timeout_s: float = 4.0,
+        check_interval_s: float = 1.0,
+        maintenance_drain_lead_s: float = 30.0,
+        max_unhealthy_chips: int = 0,
+        clock: Callable[[], float] = time.time,
+    ):
+        self.lease_timeout_s = lease_timeout_s
+        self.check_interval_s = check_interval_s
+        self.maintenance_drain_lead_s = maintenance_drain_lead_s
+        self.max_unhealthy_chips = max_unhealthy_chips
+        self.clock = clock
+        # node -> (lease record, first-observed-at on OUR clock)
+        self._observed: Dict[str, Tuple[Optional[tuple], float]] = {}
+        # nodes whose heartbeat we have WITNESSED changing since this
+        # process started: un-fencing a lease_expired node requires this
+        # positive evidence — after a controller restart/failover the
+        # frozen record of a dead node is "first observed" fresh, and
+        # merely not-yet-stale must not uncordon a host that never came
+        # back (the scheduler would bind gangs onto it for a full
+        # timeout before the re-fence)
+        self._witnessed_alive: Set[str] = set()
+        # nodes we have seen exist (guards the deletion path against
+        # reconciles for names that never were nodes, e.g. foreign leases)
+        self._known: Set[str] = set()
+        self._fenced: Set[str] = set()
+        # fenced nodes whose last drain evicted nothing — skipped on
+        # subsequent passes until a pod event touches them (the re-drain
+        # race this guards is watch-visible, so polling it was waste).
+        # Keyed on EVICTED, not found: a fenced node may legitimately
+        # keep non-evictable pods (DaemonSet pods; a CPU sidecar under
+        # chip_degraded) forever
+        self._drained_clean: Set[str] = set()
+
+    # ------------------------------------------------------------------
+    # Reconcile
+    # ------------------------------------------------------------------
+    def reconcile(self, client: Client, req: Request) -> Result:
+        name = req.name
+        node = client.try_get("Node", name)
+        if node is None:
+            self._handle_deleted(client, name)
+            return Result()
+        self._known.add(name)
+        now = self.clock()
+
+        stale = self._lease_stale(client, name, now)
+        degraded = (
+            len(unhealthy_chip_indexes(node)) > self.max_unhealthy_chips)
+        m_start = maintenance_start(node)
+        maintenance_due = (
+            m_start is not None
+            and m_start - now <= self.maintenance_drain_lead_s)
+        preempting = preemption_deadline(node) is not None
+
+        marker = node.metadata.annotations.get(
+            constants.ANNOTATION_LIFECYCLE_CORDONED)
+        if stale:
+            self._fence(client, node, "lease_expired", now)
+        elif preempting:
+            self._fence(client, node, "preemption", now)
+        elif maintenance_due:
+            self._fence(client, node, "maintenance", now)
+        elif degraded:
+            self._fence(client, node, "chip_degraded", now)
+        elif marker == "lease_expired" and \
+                name not in self._witnessed_alive:
+            # fenced for heartbeat death (possibly by a previous
+            # incarnation of this controller): recovery needs POSITIVE
+            # evidence — a witnessed record change — not just a record
+            # this process hasn't watched long enough to call stale
+            pass
+        elif marker:
+            self._unfence(client, node, now)
+        # keep polling: lease staleness and maintenance lead times are
+        # clock transitions no watch event announces
+        return Result(requeue_after=self.check_interval_s)
+
+    # ------------------------------------------------------------------
+    def _lease_stale(self, client: Client, name: str, now: float) -> bool:
+        """Observed-time staleness: True only after the lease record has
+        sat unchanged for a full timeout on OUR clock. A node with no
+        lease at all is never judged (fail open: clusters not running the
+        heartbeat source must not be mass-fenced). A witnessed record
+        CHANGE additionally marks the node heartbeat-alive (the positive
+        evidence the lease_expired recovery path requires)."""
+        lease = client.try_get("Lease", name, constants.NODE_LEASE_NAMESPACE)
+        record = None if lease is None else (
+            lease.spec.holder_identity, lease.spec.renew_time)
+        prev = self._observed.get(name)
+        if prev is None or prev[0] != record:
+            if prev is not None and record is not None:
+                self._witnessed_alive.add(name)
+            self._observed[name] = (record, now)
+            return False
+        if record is None:
+            return False
+        if now - prev[1] >= self.lease_timeout_s:
+            self._witnessed_alive.discard(name)
+            return True
+        return False
+
+    # ------------------------------------------------------------------
+    # Fencing / recovery
+    # ------------------------------------------------------------------
+    def _taints_for(self, reason: str) -> List[Taint]:
+        if reason == "lease_expired":
+            return [Taint(key=constants.TAINT_UNREACHABLE, effect="NoExecute")]
+        return [Taint(key=constants.TAINT_MAINTENANCE, value=reason,
+                      effect="NoSchedule")]
+
+    def _fence(self, client: Client, node: Node, reason: str,
+               now: float) -> None:
+        already = node.metadata.annotations.get(
+            constants.ANNOTATION_LIFECYCLE_CORDONED)
+        if already != reason:
+            taints = self._taints_for(reason)
+            not_ready = reason in ("lease_expired", "node_deleted")
+
+            def mutate(n: Node):
+                n.spec.unschedulable = True
+                have = {t.key for t in n.spec.taints}
+                n.spec.taints.extend(
+                    t for t in taints if t.key not in have)
+                n.metadata.annotations[
+                    constants.ANNOTATION_LIFECYCLE_CORDONED] = reason
+                if not_ready:
+                    self._set_ready(n, "False", reason.title(), now)
+                else:
+                    # a reason transition AWAY from lease death (agent is
+                    # back but a notice/degradation keeps the fence up)
+                    # must clear the stale Ready=False — the node is
+                    # demonstrably alive, just fenced
+                    cur = next((c for c in n.status.conditions
+                                if c.type == "Ready"), None)
+                    if cur is not None and cur.status == "False":
+                        self._set_ready(n, "True", "HeartbeatRestored", now)
+
+            client.patch("Node", node.metadata.name, "", mutate)
+            self._fenced.add(node.metadata.name)
+            self._drained_clean.discard(node.metadata.name)
+            obs.LIFECYCLE_EVENTS.labels(reason).inc()
+            obs.LIFECYCLE_NODES_NOT_READY.set(len(self._fenced))
+            logger.info("fenced node %s (%s): cordoned + tainted",
+                        node.metadata.name, reason)
+        # drain while fenced — but only until a pass finds nothing bound:
+        # a pod racing a bind onto the node between the cordon and the
+        # scheduler observing it arrives as a watch event, which the Pod
+        # watch below turns into a re-drain (discarding _drained_clean),
+        # so polling the full pod list every interval bought nothing
+        if node.metadata.name not in self._drained_clean:
+            if self._drain(client, node.metadata.name, reason) == 0:
+                self._drained_clean.add(node.metadata.name)
+
+    def _unfence(self, client: Client, node: Node, now: float) -> None:
+        lifecycle_keys = {constants.TAINT_UNREACHABLE,
+                          constants.TAINT_MAINTENANCE}
+
+        def mutate(n: Node):
+            n.spec.unschedulable = False
+            n.spec.taints = [t for t in n.spec.taints
+                             if t.key not in lifecycle_keys]
+            n.metadata.annotations.pop(
+                constants.ANNOTATION_LIFECYCLE_CORDONED, None)
+            self._set_ready(n, "True", "HeartbeatRestored", now)
+
+        client.patch("Node", node.metadata.name, "", mutate)
+        self._fenced.discard(node.metadata.name)
+        self._drained_clean.discard(node.metadata.name)
+        obs.LIFECYCLE_EVENTS.labels("recovered").inc()
+        obs.LIFECYCLE_NODES_NOT_READY.set(len(self._fenced))
+        logger.info("recovered node %s: uncordoned, taints cleared",
+                    node.metadata.name)
+
+    @staticmethod
+    def _set_ready(n: Node, status: str, reason: str, now: float) -> None:
+        current = next(
+            (c for c in n.status.conditions if c.type == "Ready"), None)
+        if current is not None and current.status == status:
+            current.reason = reason
+            return
+        n.status.conditions = [
+            c for c in n.status.conditions if c.type != "Ready"
+        ] + [NodeCondition(type="Ready", status=status, reason=reason,
+                           last_transition=now)]
+
+    # ------------------------------------------------------------------
+    # Deletion
+    # ------------------------------------------------------------------
+    def _handle_deleted(self, client: Client, name: str) -> None:
+        bound = [
+            p for p in client.list("Pod")
+            if p.spec.node_name == name
+            and p.status.phase in ("Pending", "Running")
+        ]
+        if name not in self._known and not bound:
+            return     # a foreign lease / never-a-node name: nothing here
+        obs.LIFECYCLE_EVENTS.labels("node_deleted").inc()
+        self._drain(client, name, "node_deleted")
+        self._known.discard(name)
+        self._observed.pop(name, None)
+        self._witnessed_alive.discard(name)
+        self._drained_clean.discard(name)
+        if name in self._fenced:
+            self._fenced.discard(name)
+            obs.LIFECYCLE_NODES_NOT_READY.set(len(self._fenced))
+
+    # ------------------------------------------------------------------
+    # Drain / slice repair
+    # ------------------------------------------------------------------
+    def _drain(self, client: Client, node_name: str, reason: str) -> int:
+        """Evict pods off ``node_name``. Gang members trigger WHOLE-GANG
+        eviction across the ICI domain (the atomic-failure-domain rule);
+        plain pods are evicted individually. On chip degradation only
+        TPU-consuming workloads move. DaemonSet/Node-owned pods are never
+        drained (kube drain semantics: they are node-bound, tolerate the
+        fence taints, and their owning controller — not slice repair —
+        manages their lifecycle). Returns how many pods were evicted
+        (0 = nothing left this drain would act on)."""
+        from nos_tpu.utils.pod import is_owned_by_daemonset_or_node
+
+        on_node = [
+            p for p in client.list("Pod")
+            if p.spec.node_name == node_name
+            and p.status.phase in ("Pending", "Running")
+            and not is_owned_by_daemonset_or_node(p)
+        ]
+        if not on_node:
+            return 0
+        evicted: Set[Tuple[str, str]] = set()
+        gang_keys = sorted(
+            {gk for gk in (gang_key(p) for p in on_node) if gk is not None},
+            key=lambda k: (k.namespace, k.name))
+        for gk in gang_keys:
+            members = sorted(
+                (p for p in client.list("Pod", namespace=gk.namespace)
+                 if gang_key(p) == gk
+                 and p.status.phase in ("Pending", "Running")),
+                key=gang_worker)
+            displaced = [p for p in members if p.spec.node_name]
+            for m in displaced:
+                self._evict_one(client, m, reason, evicted)
+            if displaced:
+                obs.LIFECYCLE_SLICE_EVICTIONS.inc()
+                logger.info(
+                    "slice repair: gang %s/%s fully evicted (%d bound "
+                    "members) after %s on %s", gk.namespace, gk.name,
+                    len(displaced), reason, node_name)
+        for p in on_node:
+            if gang_key(p) is not None:
+                continue
+            if reason == "chip_degraded" and not _requests_tpu(p):
+                continue
+            self._evict_one(client, p, reason, evicted)
+        # evicted (not found) is the clean-ness signal: a fenced node may
+        # legitimately keep non-evictable pods (a CPU sidecar under
+        # chip_degraded) forever, and those must not force re-polling
+        return len(evicted)
+
+    def _evict_one(self, client: Client, pod: Pod, reason: str,
+                   evicted: Set[Tuple[str, str]]) -> None:
+        """Delete + recreate as a fresh Pending pod (this controller is
+        the stack's JobSet-repair half: in kube terms, the eviction plus
+        the owning controller's replacement create, folded into one
+        idempotent step). The recreate clears the bind and identity
+        fields; labels/annotations survive so gang membership does."""
+        key = (pod.metadata.namespace, pod.metadata.name)
+        if key in evicted:
+            return
+        evicted.add(key)
+        try:
+            client.delete("Pod", pod.metadata.name, pod.metadata.namespace)
+        except NotFound:
+            pass
+        anns = dict(pod.metadata.annotations)
+        try:
+            restarts = int(anns.get(
+                constants.ANNOTATION_LIFECYCLE_RESTARTS, "0")) + 1
+        except ValueError:
+            restarts = 1
+        anns[constants.ANNOTATION_LIFECYCLE_RESTARTS] = str(restarts)
+        fresh = Pod(
+            metadata=ObjectMeta(
+                name=pod.metadata.name,
+                namespace=pod.metadata.namespace,
+                labels=dict(pod.metadata.labels),
+                annotations=anns,
+                # keep ownership: on a real cluster the gang pod belongs
+                # to its JobSet controller, and stripping the refs would
+                # both orphan it and misclassify it downstream
+                # (utils/pod.is_owned_by_daemonset_or_node and friends)
+                owner_references=deep_copy(pod.metadata.owner_references),
+            ),
+            spec=deep_copy(pod.spec),
+            status=PodStatus(phase="Pending"),
+        )
+        fresh.spec.node_name = ""
+        try:
+            client.create(fresh)
+        except AlreadyExists:
+            pass   # a racing reconcile already recreated it
+        obs.LIFECYCLE_EVICTED_PODS.labels(reason).inc()
+
+    # ------------------------------------------------------------------
+    def controller(self) -> Controller:
+        def lease_mapper(ev) -> List[Request]:
+            if ev.obj.metadata.namespace != constants.NODE_LEASE_NAMESPACE:
+                return []
+            return [Request(name=ev.obj.metadata.name)]
+
+        def pod_mapper(ev) -> List[Request]:
+            # a pod event touching a fenced node re-arms its drain (the
+            # watch-visible half of the raced-bind guard _fence relies on)
+            node = ev.obj.spec.node_name
+            if node and node in self._fenced:
+                self._drained_clean.discard(node)
+                return [Request(name=node)]
+            return []
+
+        return Controller(
+            "node-lifecycle",
+            self.reconcile,
+            [
+                Watch("Node", mapper=lambda ev: [
+                    Request(name=ev.obj.metadata.name)]),
+                Watch("Lease", mapper=lease_mapper),
+                Watch("Pod", mapper=pod_mapper),
+            ],
+        )

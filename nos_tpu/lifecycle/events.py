@@ -1,0 +1,219 @@
+"""Lifecycle event model: the faults a GKE TPU fleet actually sees.
+
+Four upstream signals feed the lifecycle controller, normalized onto the
+node object so one level-triggered reconciler consumes them all:
+
+- **maintenance notice** — GCE publishes upcoming host maintenance on the
+  instance metadata server with lead time; a node-local watcher stamps
+  the window start onto the node as ``nos.ai/maintenance-window-start``;
+- **preemption notice** — spot/preemptible VMs get an ACPI shutdown
+  signal ~30s ahead; stamped as ``nos.ai/preemption-deadline``;
+- **heartbeat/lease expiry** — the kubelet (here: the tpuagent reporter,
+  see ``NodeHeartbeat``) renews a coordination Lease named after the node
+  in ``kube-node-lease``; a record frozen past the timeout means the host
+  or its agent is gone;
+- **chip degradation** — the tpuagent's device-health probe writes
+  ``nos.ai/status-unhealthy-chips``; on a multi-host slice a single bad
+  chip breaks the whole ICI collective.
+
+Timestamps in the notice annotations are WALL-CLOCK seconds
+(``time.time``; GCE publishes wall deadlines natively) — the one clock
+every host shares, which is what makes cross-host lead-time arithmetic
+meaningful. ``time.monotonic`` would not do: its epoch is per-process,
+so a notice stamped on host A would compare against an unrelated number
+on host B. The chaos harness swaps in ONE simulated clock for every
+producer and consumer, which preserves the same shared-domain property.
+(The lease-staleness rule needs no shared domain at all — it watches
+records for change and never compares remote stamps to a local clock.)
+"""
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from typing import Callable, List, Optional
+
+from nos_tpu import constants
+from nos_tpu.kube.apiserver import NotFound
+from nos_tpu.kube.controller import Controller, Request, Result, Watch
+from nos_tpu.kube import predicates
+from nos_tpu.kube.leaderelection import Lease, LeaseSpec
+from nos_tpu.kube.objects import Node, ObjectMeta
+
+logger = logging.getLogger(__name__)
+
+
+# ---------------------------------------------------------------------------
+# Notice annotation accessors (node -> parsed signal)
+# ---------------------------------------------------------------------------
+
+def _float_annotation(node: Node, key: str) -> Optional[float]:
+    raw = node.metadata.annotations.get(key)
+    if raw is None:
+        return None
+    try:
+        return float(raw)
+    except ValueError:
+        return None
+
+
+def maintenance_start(node: Node) -> Optional[float]:
+    """Start of the announced maintenance window, or None. Malformed
+    values read as None (an unparseable notice must not wedge the node
+    in a half-fenced state — the producer re-stamps on its next poll)."""
+    return _float_annotation(node, constants.ANNOTATION_MAINTENANCE_START)
+
+
+def preemption_deadline(node: Node) -> Optional[float]:
+    """Spot-preemption shutdown deadline, or None."""
+    return _float_annotation(node, constants.ANNOTATION_PREEMPTION_DEADLINE)
+
+
+def unhealthy_chip_indexes(node: Node) -> List[int]:
+    """Chip indexes the tpuagent's health probe reported bad (parsed from
+    the agent's status annotation; unparseable entries are dropped)."""
+    raw = node.metadata.annotations.get(
+        constants.ANNOTATION_UNHEALTHY_CHIPS, "")
+    out = []
+    for part in raw.split(","):
+        part = part.strip()
+        if part.isdigit():
+            out.append(int(part))
+    return out
+
+
+def deliver_maintenance_notice(client, node_name: str, start: float) -> None:
+    """Stamp a maintenance window onto a node (what the GCE metadata
+    watcher does on a real fleet; the chaos harness uses this too)."""
+
+    def mutate(n: Node):
+        n.metadata.annotations[constants.ANNOTATION_MAINTENANCE_START] = \
+            repr(float(start))
+
+    client.patch("Node", node_name, "", mutate)
+
+
+def deliver_preemption_notice(client, node_name: str, deadline: float) -> None:
+    """Stamp a spot-preemption deadline onto a node."""
+
+    def mutate(n: Node):
+        n.metadata.annotations[constants.ANNOTATION_PREEMPTION_DEADLINE] = \
+            repr(float(deadline))
+
+    client.patch("Node", node_name, "", mutate)
+
+
+# ---------------------------------------------------------------------------
+# Node heartbeats (kubelet lease analog)
+# ---------------------------------------------------------------------------
+
+class NodeHeartbeat:
+    """Renews the node's coordination Lease — the kubelet's node-lease
+    contract, performed here by the tpuagent reporter (the stack's
+    per-node daemon). The lifecycle controller never compares the renew
+    timestamp against its own clock; it watches for the record to CHANGE
+    (the same observed-time rule leader election uses), so the renewer's
+    clock domain is irrelevant — only liveness of renewal matters."""
+
+    def __init__(self, node_name: str,
+                 clock: Callable[[], float] = time.time):
+        self.node_name = node_name
+        self.clock = clock
+
+    def renew(self, client) -> bool:
+        """Create-or-renew; returns False (and stays quiet) when the API
+        path can't carry it — a heartbeat must never fail its caller."""
+        now = self.clock()
+        try:
+            try:
+                def mutate(lease: Lease):
+                    lease.spec.holder_identity = self.node_name
+                    lease.spec.renew_time = now
+
+                client.patch("Lease", self.node_name,
+                             constants.NODE_LEASE_NAMESPACE, mutate)
+            except NotFound:
+                client.create(Lease(
+                    metadata=ObjectMeta(
+                        name=self.node_name,
+                        namespace=constants.NODE_LEASE_NAMESPACE),
+                    spec=LeaseSpec(holder_identity=self.node_name,
+                                   acquire_time=now, renew_time=now),
+                ))
+            return True
+        except Exception:
+            logger.debug("node heartbeat for %s failed", self.node_name,
+                         exc_info=True)
+            return False
+
+
+# ---------------------------------------------------------------------------
+# Workload-side preemption signal (trainer integration)
+# ---------------------------------------------------------------------------
+
+def preemption_signal_controller(
+    node_name: str,
+    stop_event: "threading.Event",
+    on_notice: Optional[Callable[[str, float], None]] = None,
+    maintenance_lead_s: float = 120.0,
+    clock: Callable[[], float] = time.time,
+) -> Controller:
+    """A controller a gang worker pod runs next to its trainer: when THIS
+    pod's node receives a preemption (or imminent maintenance) notice,
+    set ``stop_event`` — the very event ``train(cfg, stop_event=...)``
+    already consumes to finish the in-flight step, bank a checkpoint, and
+    exit inside the grace window. This closes the loop from control-plane
+    notice to the trainer's SIGTERM-equivalent checkpoint banking without
+    the workload polling the metadata server itself.
+
+    A preemption notice fires immediately (spot grace is ~30s). A
+    maintenance notice respects its lead time: the stop only fires once
+    the window start is within ``maintenance_lead_s`` — mirroring the
+    lifecycle controller's drain lead, so a notice published an hour
+    ahead does not idle the slice an hour early; until then the
+    controller re-checks on a delayed requeue. ``clock`` must share the
+    notice producer's domain (wall clock in daemons; the sim clock in
+    the harness).
+
+    ``on_notice(kind, deadline)`` fires once per transition for logging /
+    metrics."""
+    fired = {"done": False}
+
+    def fire(kind: str, deadline: float) -> None:
+        fired["done"] = True
+        stop_event.set()
+        if on_notice is not None:
+            on_notice(kind, deadline)
+        logger.info("%s notice for node %s (deadline %.1f): requesting "
+                    "graceful stop", kind, node_name, deadline)
+
+    def reconcile(client, req: Request) -> Result:
+        if fired["done"]:
+            return Result()
+        try:
+            node = client.get("Node", node_name)
+        except NotFound:
+            # node object gone: the host is being torn down — same urgency
+            fire("node-deleted", 0.0)
+            return Result()
+        deadline = preemption_deadline(node)
+        if deadline is not None:
+            fire("preemption", deadline)
+            return Result()
+        start = maintenance_start(node)
+        if start is not None:
+            remaining = start - clock()
+            if remaining <= maintenance_lead_s:
+                fire("maintenance", start)
+                return Result()
+            # not imminent: wake up when it is (capped so a withdrawn
+            # notice is noticed within a lead period)
+            return Result(requeue_after=min(remaining - maintenance_lead_s,
+                                            maintenance_lead_s))
+        return Result()
+
+    return Controller(
+        "preemption-signal",
+        reconcile,
+        [Watch("Node", predicate=predicates.matching_name(node_name))],
+    )

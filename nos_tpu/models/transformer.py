@@ -32,6 +32,7 @@ from nos_tpu.ops.layers import (
 )
 from nos_tpu.ops.moe import moe_ffn
 from nos_tpu.ops.ring_attention import ring_attention
+from nos_tpu.utils.jax_compat import shard_map
 
 Params = Dict[str, Any]
 
@@ -294,7 +295,7 @@ def _attention_call(q, k, v, mesh: Optional[Mesh], sp_strategy: str = "ring"):
         batch = tuple(a for a in ("dp", "fsdp") if a in mesh.axis_names) or None
         tp = "tp" if "tp" in mesh.axis_names else None
         spec = P(batch, tp, "sp", None)
-        out = jax.shard_map(
+        out = shard_map(
             functools.partial(sp_fn, axis_name="sp", causal=True),
             mesh=mesh,
             in_specs=(spec, spec, spec),

@@ -95,6 +95,44 @@ AGENT_UNHEALTHY_CHIPS = _r.gauge(
     ("node",),
 )
 
+# --- node lifecycle / slice repair (nos_tpu/lifecycle) ----------------
+LIFECYCLE_EVENTS = _r.counter(
+    "nos_lifecycle_events_total",
+    "Lifecycle signals handled by the node-lifecycle controller, by kind "
+    "(lease_expired | node_deleted | maintenance | preemption | "
+    "chip_degraded | recovered).",
+    ("kind",),
+)
+LIFECYCLE_EVICTED_PODS = _r.counter(
+    "nos_lifecycle_evicted_pods_total",
+    "Pods drained and recreated by the slice-repair path, by reason.",
+    ("reason",),
+)
+LIFECYCLE_SLICE_EVICTIONS = _r.counter(
+    "nos_lifecycle_slice_evictions_total",
+    "Whole-gang (atomic failure domain) evictions: one dead host evicted "
+    "its entire multi-host gang across the ICI domain.",
+)
+LIFECYCLE_NODES_NOT_READY = _r.gauge(
+    "nos_lifecycle_nodes_not_ready",
+    "Nodes the lifecycle controller currently holds NotReady "
+    "(cordoned + tainted).",
+)
+LIFECYCLE_DETECTION = _r.histogram(
+    "nos_lifecycle_detection_seconds",
+    "Fault-injection to NotReady-detection latency (populated by the "
+    "chaos harness, which knows the injection instant; units are the "
+    "harness's simulated-clock seconds).",
+    buckets=(0.25, 0.5, 1.0, 2.0, 3.0, 5.0, 10.0, 30.0, 60.0, 120.0),
+)
+LIFECYCLE_MTTR = _r.histogram(
+    "nos_lifecycle_mttr_seconds",
+    "Fault-injection to full-repair latency: every gang the fault "
+    "displaced is atomically rebound (chaos-harness simulated-clock "
+    "seconds).",
+    buckets=(0.5, 1.0, 2.0, 5.0, 10.0, 30.0, 60.0, 120.0, 300.0),
+)
+
 # --- quota ------------------------------------------------------------
 QUOTA_USED = _r.gauge(
     "nos_quota_used",

@@ -20,6 +20,8 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from nos_tpu.utils.jax_compat import axis_size, shard_map
+
 
 def _block_attention(qg, k, v, q_offset, kv_offset, causal, scale):
     """One (q_local, kv_block) partial: returns (m, l, o) statistics.
@@ -58,7 +60,7 @@ def ring_attention(
     with Hkv a divisor of H (GQA). Only the small kv heads circulate the
     ring, so GQA's ICI-bandwidth saving is preserved."""
     scale = scale if scale is not None else q.shape[-1] ** -0.5
-    ring_size = jax.lax.axis_size(axis_name)
+    ring_size = axis_size(axis_name)
     my_idx = jax.lax.axis_index(axis_name)
     b, h, s_local, d = q.shape
     h_kv = k.shape[1]
@@ -104,7 +106,7 @@ def ring_attention_sharded(
     """Convenience wrapper: shard [B, H, S, D] over ``seq_axis`` and run the
     ring. For use outside an existing shard_map context."""
     spec = P(None, None, seq_axis, None)
-    fn = jax.shard_map(
+    fn = shard_map(
         functools.partial(ring_attention, axis_name=seq_axis, causal=causal),
         mesh=mesh,
         in_specs=(spec, spec, spec),

@@ -25,6 +25,7 @@ import jax
 from jax.sharding import Mesh, PartitionSpec as P
 
 from nos_tpu.ops.attention import attention
+from nos_tpu.utils.jax_compat import axis_size, shard_map
 
 
 def ulysses_attention(
@@ -38,7 +39,7 @@ def ulysses_attention(
 ) -> jax.Array:
     """q [B, H, S_local, D]; k,v [B, Hkv, S_local, D] — the local shards on
     the ``axis_name`` sequence axis. Returns the local output shard."""
-    n = jax.lax.axis_size(axis_name)
+    n = axis_size(axis_name)
     b, h, s_local, d = q.shape
     h_kv = k.shape[1]
     if h % n or h_kv % n:
@@ -87,7 +88,7 @@ def ulysses_attention_sharded(
 ) -> jax.Array:
     """Convenience wrapper mirroring ring_attention_sharded."""
     spec = P(None, None, seq_axis, None)
-    fn = jax.shard_map(
+    fn = shard_map(
         functools.partial(ulysses_attention, axis_name=seq_axis, causal=causal),
         mesh=mesh,
         in_specs=(spec, spec, spec),

@@ -8,7 +8,7 @@ slice of the ``pp`` axis, and microbatches flow stage-to-stage over ICI via
 program, stage identity from ``axis_index``), not P separate programs.
 
 Composition contract:
-- ``pp`` is the only *manual* axis (``jax.shard_map(axis_names={"pp"})``);
+- ``pp`` is the only *manual* axis (``shard_map(axis_names={"pp"})``);
   dp/fsdp/tp/ep stay auto, so GSPMD still shards the within-stage matmuls
   — pipeline composes freely with data/tensor parallelism AND with MoE
   expert parallelism (the dispatch/combine einsums are dense, so the ep
@@ -71,6 +71,7 @@ from nos_tpu.models.transformer import (
     lm_head_loss,
 )
 from nos_tpu.ops.attention import attention
+from nos_tpu.utils.jax_compat import shard_map
 from nos_tpu.ops.layers import rms_norm, rope_frequencies
 
 
@@ -184,7 +185,7 @@ def pipeline_forward(
 
     manual_axes = {"pp", "sp"} if sp > 1 else {"pp"}
     mb_spec = P(None, None, "sp", None) if sp > 1 else P()
-    stacked, aux_sum = jax.shard_map(
+    stacked, aux_sum = shard_map(
         stage_program,
         mesh=mesh,
         in_specs=(P("pp"), mb_spec, P()),
@@ -422,7 +423,7 @@ def _make_1f1b_op(cfg: TransformerConfig, mesh: Mesh, n_microbatches: int,
         (_, loss), _ = jax.lax.scan(step, init, jnp.arange(M + Pn - 1))
         return jax.lax.psum(loss, "pp")
 
-    sharded = jax.shard_map(
+    sharded = shard_map(
         stage_program,
         mesh=mesh,
         in_specs=(P("pp"), P(), P(), P()),
@@ -430,7 +431,7 @@ def _make_1f1b_op(cfg: TransformerConfig, mesh: Mesh, n_microbatches: int,
         axis_names={"pp"},
         check_vma=False,
     )
-    sharded_fwd = jax.shard_map(
+    sharded_fwd = shard_map(
         stage_program_fwd_only,
         mesh=mesh,
         in_specs=(P("pp"), P(), P(), P()),
@@ -810,13 +811,13 @@ def _make_interleaved_op(cfg: TransformerConfig, mesh: Mesh,
 
     tb, tb_f = tables_of(sched), tables_of(sched_f)
 
-    sharded = jax.shard_map(
+    sharded = shard_map(
         lambda sp, h, xs, tg: run(sp, h, xs, tg, tb, False),
         mesh=mesh, in_specs=(P("pp"), P(), P(), P()),
         out_specs=(P(), P("pp"), P(), P()),
         axis_names={"pp"}, check_vma=False,
     )
-    sharded_fwd = jax.shard_map(
+    sharded_fwd = shard_map(
         lambda sp, h, xs, tg: run(sp, h, xs, tg, tb_f, True),
         mesh=mesh, in_specs=(P("pp"), P(), P(), P()),
         out_specs=P(), axis_names={"pp"}, check_vma=False,

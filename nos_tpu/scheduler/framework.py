@@ -12,7 +12,7 @@ from __future__ import annotations
 from dataclasses import dataclass, field
 from typing import Callable, Dict, List, Optional, Tuple
 
-from nos_tpu import observability as obs
+from nos_tpu import constants, observability as obs
 from nos_tpu.scheduler.capindex import INDEXED_RESOURCES
 from nos_tpu.kube.objects import (
     Node,
@@ -504,6 +504,105 @@ class NodeUnschedulableFit:
         )
 
 
+class NodePortsFit:
+    """kube's NodePorts filter: a pod claiming hostPorts cannot land on a
+    node where another pod already holds any of the same (port, protocol)
+    pairs. Inert for the overwhelming majority of pods (no hostPorts), so
+    the sweep never pays for it unless the pod actually asks."""
+
+    name = "NodePorts"
+    needs_prefilter_for_filter = True
+    _KEY = "ports/wanted"
+
+    def pre_filter(self, state: CycleState, pod: Pod,
+                   snapshot: "Snapshot") -> Status:
+        state[self._KEY] = (id(pod), frozenset(pod.host_ports()))
+        return _OK
+
+    def filter_inert(self, state: CycleState, pod: Pod) -> bool:
+        cached = state.get(self._KEY)
+        if cached is not None and cached[0] == id(pod):
+            return not cached[1]
+        return not pod.host_ports()
+
+    def filter(self, state: CycleState, pod: Pod, node_info: NodeInfo) -> Status:
+        cached = state.get(self._KEY)
+        wanted = cached[1] if cached is not None and cached[0] == id(pod) \
+            else frozenset(pod.host_ports())
+        if not wanted:
+            return _OK
+        for existing in node_info.pods:
+            if existing.status.phase in ("Succeeded", "Failed"):
+                continue
+            for hp in existing.host_ports():
+                if hp in wanted:
+                    return Status.unschedulable(
+                        f"host port {hp[0]}/{hp[1]} already in use on "
+                        f"{node_info.node.metadata.name}")
+        return _OK
+
+
+class NodeResourcesBalancedAllocation:
+    """kube's NodeResourcesBalancedAllocation scoring: prefer the node
+    where placing the pod leaves the utilization fractions of the pod's
+    requested resources closest to each other (score = (1 - stddev) x
+    100). With a single requested resource every node scores the same and
+    normalization drops the plugin from the ranking — it only ever breaks
+    ties between genuinely imbalanced multi-resource placements, exactly
+    like the stock plugin at its default weight."""
+
+    name = "NodeResourcesBalancedAllocation"
+    needs_prefilter_for_filter = False
+    _KEY = "balanced/req"
+
+    def pre_filter(self, state: CycleState, pod: Pod,
+                   snapshot: "Snapshot") -> Status:
+        req = {k: v for k, v in pod.request().items() if v > 0}
+        state[self._KEY] = (id(pod), req)
+        return _OK
+
+    def score_inert(self, state: CycleState, pod: Pod) -> bool:
+        cached = state.get(self._KEY)
+        req = cached[1] if cached is not None and cached[0] == id(pod) \
+            else {k: v for k, v in pod.request().items() if v > 0}
+        # one resource -> stddev 0 on every node -> uniform -> no signal
+        return len(req) < 2
+
+    def score(self, state: CycleState, pod: Pod, node_info: NodeInfo) -> float:
+        cached = state.get(self._KEY)
+        req = cached[1] if cached is not None and cached[0] == id(pod) \
+            else {k: v for k, v in pod.request().items() if v > 0}
+        alloc = node_info.node.status.allocatable
+        used = node_info.requested()
+        fractions = []
+        for k, v in req.items():
+            cap = alloc.get(k, 0)
+            if cap <= 0:
+                continue
+            fractions.append(min(1.0, (used.get(k, 0) + v) / cap))
+        if len(fractions) < 2:
+            return 100.0
+        mean = sum(fractions) / len(fractions)
+        variance = sum((f - mean) ** 2 for f in fractions) / len(fractions)
+        return (1.0 - variance ** 0.5) * 100.0
+
+
+class NodeMaintenanceScore:
+    """Lifecycle integration: score down nodes carrying a pending GCE
+    maintenance-window notice (nos.ai/maintenance-window-start) so new
+    work drifts away from hosts about to reboot BEFORE the lifecycle
+    controller has to drain them. A pure preference — when the window is
+    imminent the controller cordons, which is the hard stop."""
+
+    name = "NodeMaintenance"
+
+    def score(self, state: CycleState, pod: Pod, node_info: NodeInfo) -> float:
+        if constants.ANNOTATION_MAINTENANCE_START in \
+                node_info.node.metadata.annotations:
+            return 0.0
+        return 100.0
+
+
 class NodeAffinityFit:
     """requiredDuringScheduling node affinity: OR over terms, AND within
     a term (reference planner simulation registers the full plugin suite,
@@ -930,9 +1029,12 @@ class SchedulerFramework:
             NodeSelectorFit(),
             TaintTolerationFit(),
             NodeAffinityFit(),
+            NodePortsFit(),
             InterPodAffinityFit(),
             PodTopologySpreadFit(),
             NodeResourcesFit(),
+            NodeResourcesBalancedAllocation(),
+            NodeMaintenanceScore(),
         ]
         if plugins:
             self.plugins.extend(plugins)

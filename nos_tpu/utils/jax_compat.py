@@ -1,0 +1,58 @@
+"""JAX API compatibility shims.
+
+The workload plane targets the modern ``jax.shard_map`` entry point
+(with ``axis_names`` selecting the manual axes and ``check_vma``); older
+toolchains (<= 0.4.x) only ship ``jax.experimental.shard_map.shard_map``
+whose equivalent knobs are ``auto`` (the COMPLEMENT of the manual set)
+and ``check_rep``. This module bridges the two so kernels and the
+pipeline schedule run unchanged on either toolchain — the resolution
+happens per call (cheap: one getattr) so tests that monkeypatch jax see
+the live module.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+
+def shard_map(f, mesh, in_specs, out_specs,
+              axis_names: Optional[set] = None,
+              check_vma: Optional[bool] = None):
+    """``jax.shard_map`` when available, else the experimental spelling
+    with ``axis_names``/``check_vma`` translated to ``auto``/``check_rep``.
+    Omitted kwargs keep each API's own defaults (full-manual, checks on)."""
+    import jax
+
+    native = getattr(jax, "shard_map", None)
+    if native is not None:
+        kwargs = {}
+        if axis_names is not None:
+            kwargs["axis_names"] = axis_names
+        if check_vma is not None:
+            kwargs["check_vma"] = check_vma
+        return native(f, mesh=mesh, in_specs=in_specs,
+                      out_specs=out_specs, **kwargs)
+    from jax.experimental.shard_map import shard_map as legacy
+
+    kwargs = {}
+    if axis_names is not None:
+        kwargs["auto"] = frozenset(mesh.axis_names) - set(axis_names)
+    if check_vma is not None:
+        kwargs["check_rep"] = bool(check_vma)
+    return legacy(f, mesh, in_specs, out_specs, **kwargs)
+
+
+def axis_size(axis_name) -> int:
+    """``jax.lax.axis_size`` when available; on older toolchains read the
+    bound axis frame (a STATIC Python int on both paths — callers use it
+    for trace-time loop bounds, so a traced psum(1, axis) would not do)."""
+    import jax
+
+    native = getattr(jax.lax, "axis_size", None)
+    if native is not None:
+        return native(axis_name)
+    from jax.core import axis_frame
+
+    frame = axis_frame(axis_name)
+    # 0.4.x returns the bound size directly; some point releases return a
+    # frame object carrying .size
+    return frame if isinstance(frame, int) else frame.size

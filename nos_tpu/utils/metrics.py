@@ -145,6 +145,12 @@ class Counter(_Metric):
     def value(self, *label_values) -> float:
         return self.labels(*label_values).value
 
+    def total(self) -> float:
+        """Sum over every label series — harness/test convenience for
+        'how many, regardless of label' deltas."""
+        with self._lock:
+            return sum(child.value for child in self._children.values())
+
     def _render_child(self, values, child):
         return [f"{self.name}{_label_str(self.labelnames, values)} "
                 f"{_format_value(child.value)}"]
@@ -267,6 +273,15 @@ class Histogram(_Metric):
 
     def observe(self, v: float) -> None:
         self._unlabeled().observe(v)
+
+    def observations(self, *label_values) -> Tuple[int, float]:
+        """(count, sum) of everything observed into this child — the
+        cheap always-on aggregate (no sample tracking required). Bench
+        harnesses and tests read it to assert a histogram is populated
+        and to report means without enabling raw-sample retention."""
+        child = self.labels(*label_values)
+        with child._lock:
+            return child.count, child.total
 
     def num_samples(self, *label_values) -> int:
         """Length of the retained raw-sample buffer (== observation count

@@ -254,3 +254,102 @@ def test_feasible_node_cap_binds_and_rotates_on_large_clusters():
     fw2 = fw.SchedulerFramework()
     name3, _ = fw2.find_feasible({}, pod_, small)
     assert name3 == "s1" and fw2._next_start_node == 0
+
+
+# ---------------------------------------------------------------------------
+# stock-plugin gap closure (ISSUE 2 satellite; VERDICT §missing-3):
+# NodePorts filter + NodeResourcesBalancedAllocation scoring
+# ---------------------------------------------------------------------------
+
+def test_node_ports_filter_rejects_conflicting_host_port():
+    from nos_tpu.kube.objects import ContainerPort
+
+    framework = fw.SchedulerFramework()
+    holder = pod("holder", tpu=0)
+    holder.spec.containers[0].requests = {"cpu": 1}
+    holder.spec.containers[0].ports = [
+        ContainerPort(container_port=8080, host_port=8080)]
+    node = tpu_node("ports-n1", taints=[])
+    snapshot = fw.Snapshot.build([node], [])
+    snapshot["ports-n1"].add_pod(holder)
+
+    claimer = pod("claimer", tpu=0)
+    claimer.spec.containers[0].requests = {"cpu": 1}
+    claimer.spec.containers[0].ports = [
+        ContainerPort(container_port=9999, host_port=8080)]
+    state = {}
+    framework.run_pre_filter(state, claimer, snapshot)
+    st = framework.run_filter(state, claimer, snapshot["ports-n1"])
+    assert not st.success and "host port" in st.reason
+
+    # a different port (or protocol) is fine
+    ok = pod("ok", tpu=0)
+    ok.spec.containers[0].requests = {"cpu": 1}
+    ok.spec.containers[0].ports = [
+        ContainerPort(container_port=9999, host_port=8081)]
+    state = {}
+    framework.run_pre_filter(state, ok, snapshot)
+    assert framework.run_filter(state, ok, snapshot["ports-n1"]).success
+    udp = pod("udp", tpu=0)
+    udp.spec.containers[0].requests = {"cpu": 1}
+    udp.spec.containers[0].ports = [
+        ContainerPort(container_port=53, host_port=8080, protocol="UDP")]
+    state = {}
+    framework.run_pre_filter(state, udp, snapshot)
+    assert framework.run_filter(state, udp, snapshot["ports-n1"]).success
+
+
+def test_node_ports_inert_without_host_ports():
+    """The filter must cost nothing for the overwhelmingly common
+    no-hostPort pod: active_filters drops it from the sweep."""
+    framework = fw.SchedulerFramework()
+    p = pod("plain")
+    snapshot = fw.Snapshot.build([tpu_node()], [])
+    state = {}
+    framework.run_pre_filter(state, p, snapshot)
+    names = [f.name for f in framework.active_filters(state, p)]
+    assert "NodePorts" not in names
+
+
+def test_balanced_allocation_prefers_evenly_used_node():
+    """Two nodes fit; the one whose cpu/tpu fractions end up balanced
+    wins (kube NodeResourcesBalancedAllocation semantics)."""
+    server = ApiServer()
+    mgr = Manager(server)
+    mgr.add_controller(Scheduler().controller())
+    # lopsided: cpu nearly exhausted, tpu empty -> placing the mixed pod
+    # leaves fractions (1.0, 0.5): stddev 0.25
+    lopsided = tpu_node("bal-a", taints=[], tpu=16)
+    lopsided.status.allocatable = {TPU: 16, "cpu": 8}
+    lopsided.status.capacity = {TPU: 16, "cpu": 8}
+    # balanced: placing the pod leaves fractions (0.5, 0.5): stddev 0
+    even = tpu_node("bal-b", taints=[], tpu=16)
+    even.status.allocatable = {TPU: 16, "cpu": 16}
+    even.status.capacity = {TPU: 16, "cpu": 16}
+    server.create(lopsided)
+    server.create(even)
+    filler = pod("filler", tpu=0)
+    filler.spec.containers[0].requests = {"cpu": 4}
+    filler.spec.node_name = "bal-a"
+    filler.status.phase = "Running"
+    server.create(filler)
+
+    mixed = pod("mixed", tpu=8)
+    mixed.spec.containers[0].requests = {TPU: 8, "cpu": 4}
+    server.create(mixed)
+    mgr.run_until_idle()
+    # name order alone would pick bal-a; balance flips it
+    assert server.get("Pod", "mixed", "team-a").spec.node_name == "bal-b"
+
+
+def test_balanced_allocation_uniform_for_single_resource():
+    """One requested resource -> stddev 0 everywhere -> the plugin is
+    score-inert and cannot perturb existing orderings."""
+    framework = fw.SchedulerFramework()
+    plugin = next(p for p in framework.plugins
+                  if p.name == "NodeResourcesBalancedAllocation")
+    p = pod("single", tpu=8)
+    state = {}
+    snapshot = fw.Snapshot.build([tpu_node()], [])
+    framework.run_pre_filter(state, p, snapshot)
+    assert plugin.score_inert(state, p)

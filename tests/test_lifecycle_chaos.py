@@ -1,0 +1,92 @@
+"""Chaos acceptance suite (ISSUE 2): under a fixed-seed fault schedule
+mixing node kill, lease expiry and maintenance notices against a cluster
+with multi-host gangs —
+
+- every displaced gang is rebound atomically (all-or-nothing, one ICI
+  domain);
+- zero pods are double-bound (no over-commit, no split gangs);
+- whole-slice eviction fires on single-host failure;
+- the run is bit-reproducible given the seed;
+- the ``nos_lifecycle_*`` detection-latency / MTTR histograms are
+  populated.
+
+Fast storms run in tier-1; the multi-seed soak is ``slow``."""
+import pytest
+
+from nos_tpu import observability as obs
+from nos_tpu.lifecycle.chaos import ChaosHarness, seeded_faults
+
+# the pinned acceptance seed: its schedule mixes kill, lease expiry and
+# maintenance (asserted below so a generator change cannot silently
+# weaken the scenario)
+SEED = 7
+
+
+def test_seeded_schedule_is_deterministic():
+    nodes = [f"n-{i}" for i in range(8)]
+    a = seeded_faults(123, nodes, 60.0, n_faults=6)
+    b = seeded_faults(123, list(reversed(nodes)), 60.0, n_faults=6)
+    assert a == b                     # node-order independent
+    assert a != seeded_faults(124, nodes, 60.0, n_faults=6)
+    assert all(f.at <= 0.55 * 60.0 for f in a)
+    assert all(f.recover_at <= 0.85 * 60.0 for f in a if f.recover_at)
+
+
+def test_fixed_seed_storm_repairs_all_gangs_atomically():
+    harness = ChaosHarness(seed=SEED)
+    kinds = {f.kind for f in harness.faults}
+    # the acceptance mix: node kill + lease expiry + maintenance at least
+    assert {"kill", "expire", "maintenance"} <= kinds, kinds
+
+    det_before, _ = obs.LIFECYCLE_DETECTION.observations()
+    mttr_before, _ = obs.LIFECYCLE_MTTR.observations()
+    report = harness.run()
+
+    # zero double-binds, and every invariant held on every tick
+    assert report.double_binds == 0, report.invariant_violations
+    assert report.invariant_violations == []
+    # whole-slice eviction fired for single-host failures
+    assert report.slice_evictions >= 1
+    # every displaced gang is rebound atomically by the end of the run
+    assert report.unrepaired_gangs == []
+    assert report.unbound_pods_final == 0
+    assert len(report.mttr_s) >= 1
+    assert len(report.detection_s) >= 1
+    # histograms populated
+    det_after, _ = obs.LIFECYCLE_DETECTION.observations()
+    mttr_after, _ = obs.LIFECYCLE_MTTR.observations()
+    assert det_after > det_before
+    assert mttr_after > mttr_before
+
+
+def test_fixed_seed_storm_is_bit_reproducible():
+    a = ChaosHarness(seed=SEED).run()
+    b = ChaosHarness(seed=SEED).run()
+    assert a.log == b.log
+    assert a.fingerprint() == b.fingerprint()
+    # and a different seed takes a different path
+    c = ChaosHarness(seed=SEED + 1).run()
+    assert c.fingerprint() != a.fingerprint()
+
+
+def test_watch_flap_does_not_strand_work():
+    """A storm forced to flap-only: the stream drop + re-list must leave
+    the world fully bound (the re-list purges stale cache entries)."""
+    harness = ChaosHarness(seed=3, n_faults=3, kinds=("flap",),
+                           duration_s=30.0)
+    report = harness.run()
+    assert sum(1 for f in report.faults if f.kind == "flap") == 3
+    assert report.double_binds == 0
+    assert report.unbound_pods_final == 0
+
+
+@pytest.mark.slow
+def test_chaos_soak_many_seeds():
+    """Long soak: every seed in a band must satisfy the acceptance
+    invariants (the loop-until-dry version of the fixed-seed test)."""
+    for seed in range(16):
+        report = ChaosHarness(seed=seed, duration_s=90.0,
+                              n_faults=8).run()
+        assert report.double_binds == 0, (seed, report.invariant_violations)
+        assert report.unrepaired_gangs == [], (seed, report.unrepaired_gangs)
+        assert report.unbound_pods_final == 0, seed

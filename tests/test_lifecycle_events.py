@@ -1,0 +1,75 @@
+"""Lifecycle event model unit tests: notice annotations, unhealthy-chip
+parsing, heartbeat lease semantics."""
+from nos_tpu import constants
+from nos_tpu.kube.apiserver import ApiServer
+from nos_tpu.kube.client import Client
+from nos_tpu.kube.objects import Node, NodeStatus, ObjectMeta
+from nos_tpu.lifecycle.events import (
+    NodeHeartbeat,
+    deliver_maintenance_notice,
+    deliver_preemption_notice,
+    maintenance_start,
+    preemption_deadline,
+    unhealthy_chip_indexes,
+)
+
+
+def _cluster_with_node(name="n0"):
+    server = ApiServer()
+    client = Client(server)
+    server.create(Node(metadata=ObjectMeta(name=name),
+                       status=NodeStatus(allocatable={"cpu": 4})))
+    return server, client
+
+
+def test_notice_annotations_roundtrip():
+    server, client = _cluster_with_node()
+    deliver_maintenance_notice(client, "n0", 123.5)
+    deliver_preemption_notice(client, "n0", 99.25)
+    node = server.get("Node", "n0")
+    assert maintenance_start(node) == 123.5
+    assert preemption_deadline(node) == 99.25
+
+
+def test_malformed_notice_reads_as_none():
+    node = Node(metadata=ObjectMeta(name="x", annotations={
+        constants.ANNOTATION_MAINTENANCE_START: "soon",
+        constants.ANNOTATION_PREEMPTION_DEADLINE: "",
+    }))
+    assert maintenance_start(node) is None
+    assert preemption_deadline(node) is None
+    assert maintenance_start(Node(metadata=ObjectMeta(name="y"))) is None
+
+
+def test_unhealthy_chip_parsing_drops_garbage():
+    node = Node(metadata=ObjectMeta(name="x", annotations={
+        constants.ANNOTATION_UNHEALTHY_CHIPS: "0, 3,seven,,12",
+    }))
+    assert unhealthy_chip_indexes(node) == [0, 3, 12]
+    assert unhealthy_chip_indexes(Node(metadata=ObjectMeta(name="y"))) == []
+
+
+def test_heartbeat_creates_then_renews_lease():
+    server, client = _cluster_with_node()
+    t = [100.0]
+    hb = NodeHeartbeat("n0", clock=lambda: t[0])
+    assert hb.renew(client)
+    lease = server.get("Lease", "n0", constants.NODE_LEASE_NAMESPACE)
+    assert lease.spec.holder_identity == "n0"
+    assert lease.spec.renew_time == 100.0
+    t[0] = 105.0
+    assert hb.renew(client)
+    lease = server.get("Lease", "n0", constants.NODE_LEASE_NAMESPACE)
+    assert lease.spec.renew_time == 105.0
+
+
+def test_heartbeat_failure_is_quiet():
+    class DeadClient:
+        def patch(self, *a, **k):
+            raise RuntimeError("wire down")
+
+        def create(self, *a, **k):
+            raise RuntimeError("wire down")
+
+    hb = NodeHeartbeat("n0")
+    assert hb.renew(DeadClient()) is False

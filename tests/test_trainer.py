@@ -5,6 +5,11 @@ import pytest
 
 from nos_tpu.cmd.trainer import TrainerConfig, train
 
+needs_partial_auto = pytest.mark.skipif(
+    not hasattr(jax, "shard_map"),
+    reason="pp x auto-axis composition needs modern jax.shard_map "
+           "(0.4.x XLA:CPU SPMD lacks PartitionId in partial-auto)")
+
 pytestmark = pytest.mark.skipif(
     len(jax.devices()) < 8, reason="needs 8 virtual devices")
 
@@ -22,6 +27,7 @@ def test_trains_and_loss_finite():
     assert loss == loss and loss < 100
 
 
+@needs_partial_auto
 def test_trains_pipelined():
     loss = train(tiny(pp=2, dp=2, n_microbatches=2))
     assert loss == loss
@@ -272,6 +278,7 @@ def test_metrics_exported(tmp_path):
         == pre0 + 1
 
 
+@needs_partial_auto
 def test_trains_gpipe_with_sp():
     # the dense long-context + depth recipe is reachable from the binary:
     # pipeline_schedule="gpipe" composes pp with sp/ring attention
@@ -321,6 +328,7 @@ def test_wall_clock_checkpoint_cadence(tmp_path):
     mgr2.close()
 
 
+@needs_partial_auto
 def test_trains_interleaved_and_resumes(tmp_path):
     """Interleaved schedule reachable from the binary: trains, stamps
     the chunk-major layer order, resumes in kind — and a resume under a
