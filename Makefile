@@ -25,6 +25,17 @@ bench-sched:  ## Scheduler scaling curve (1024- and 4096-node points; --profile 
 bench-chaos:  ## Lifecycle chaos storms: detection latency + MTTR histograms (artifact in bench_logs/).
 	$(PYTHON) bench_chaos.py
 
+.PHONY: trace-sched
+trace-sched:  ## Run the scheduler bench and report its Perfetto trace (open in ui.perfetto.dev / chrome://tracing).
+	$(PYTHON) bench_sched.py $(BENCH_SCHED_FLAGS) > /dev/null
+	@echo "Perfetto trace: bench_logs/bench_sched.trace.json"
+
+.PHONY: trace-chaos
+trace-chaos:  ## Run the chaos bench and report its Perfetto trace + /debug/traces artifact.
+	$(PYTHON) bench_chaos.py > /dev/null
+	@echo "Perfetto trace: bench_logs/bench_chaos.trace.json"
+	@echo "/debug/traces:  bench_logs/bench_chaos_debug_traces.json"
+
 .PHONY: bench-attn
 bench-attn:  ## Compare attention kernels (splash/flash/xla) at the flagship shape.
 	$(PYTHON) bench_attn.py

@@ -11,25 +11,43 @@ the ``nos_lifecycle_*`` histograms):
 
 - **detection p50/p99** — fault injection to the node being fenced;
 - **MTTR p50/p99** — fault injection to every displaced gang atomically
-  rebound;
+  rebound — now ALSO attributed per named phase span (detect -> fence ->
+  drain -> gang_evict -> rebind) from the repair-episode traces;
 - **correctness counters** — slice evictions, evicted pods, double-binds
   (MUST be 0), unrepaired gangs (MUST be empty), reproducibility (two
   runs of one seed MUST fingerprint identically).
 
-Writes the full result to ``bench_logs/bench_chaos.json`` (tail-truncation
--proof, VERDICT r5 weak #2 convention) and prints ONE short JSON line.
+Artifacts (all from the same run, with matching trace_ids):
+
+- ``bench_logs/bench_chaos.json`` — the result of record (tail-
+  truncation-proof, VERDICT r5 weak #2 convention);
+- ``bench_logs/bench_chaos.trace.json`` — Perfetto / chrome://tracing
+  export of every recorded span (``make trace-chaos``);
+- ``bench_logs/bench_chaos_debug_traces.json`` — the ``/debug/traces``
+  flight-recorder JSON, fetched over HTTP from a real HealthServer, in
+  which at least one pod-journey trace spans quota -> scheduler ->
+  lifecycle.
+
+Prints ONE short JSON line on stdout.
 """
 import json
 import os
 import statistics
 import sys
 import time
+import urllib.request
 
 sys.path.insert(0, ".")
 
 from nos_tpu.lifecycle.chaos import ChaosHarness            # noqa: E402
+from nos_tpu.obs import tracing, trace_export               # noqa: E402
 
 OUT_PATH = os.path.join("bench_logs", "bench_chaos.json")
+TRACE_PATH = os.path.join("bench_logs", "bench_chaos.trace.json")
+DEBUG_TRACES_PATH = os.path.join("bench_logs",
+                                 "bench_chaos_debug_traces.json")
+
+PHASES = ("detect_s", "fence_s", "drain_s", "gang_evict_s", "rebind_s")
 
 
 def q(xs, p):
@@ -38,6 +56,31 @@ def q(xs, p):
     if len(xs) == 1:
         return round(xs[0], 3)
     return round(statistics.quantiles(xs, n=100)[p - 1], 3)
+
+
+def fetch_debug_traces():
+    """GET /debug/traces from a real HealthServer — the same endpoint a
+    production daemon serves next to /metrics — and return (dict, bytes)."""
+    from nos_tpu.cmd.serve import HealthServer
+
+    hs = HealthServer(port=0).start()
+    try:
+        body = urllib.request.urlopen(
+            hs.address + "/debug/traces", timeout=10).read()
+    finally:
+        hs.stop()
+    return json.loads(body), body
+
+
+def find_pod_journey(debug):
+    """The first recorded trace spanning >= 3 control-plane components
+    (quota -> scheduler -> lifecycle): the acceptance evidence that one
+    pod journey is one trace across processes."""
+    want = {"quota", "scheduler", "lifecycle"}
+    for t in debug.get("traces", []):
+        if want.issubset(set(t.get("components", []))):
+            return t
+    return None
 
 
 def main(argv=None):
@@ -57,6 +100,7 @@ def main(argv=None):
     detection, mttr = [], []
     double_binds = evictions = slice_evictions = 0
     unrepaired = []
+    phases = []
     t0 = time.perf_counter()
     for seed in range(args.seeds):
         r = ChaosHarness(seed=seed, duration_s=args.duration,
@@ -67,12 +111,33 @@ def main(argv=None):
         evictions += r.evicted_pods
         slice_evictions += r.slice_evictions
         unrepaired.extend(f"seed{seed}:{g}" for g in r.unrepaired_gangs)
+        for ph in r.mttr_phases:
+            phases.append({"seed": seed, **ph})
     # reproducibility: one seed, run twice, identical event logs
     fp_a = ChaosHarness(seed=0, duration_s=args.duration,
                         n_faults=args.faults).run().fingerprint()
     fp_b = ChaosHarness(seed=0, duration_s=args.duration,
                         n_faults=args.faults).run().fingerprint()
     wall = time.perf_counter() - t0
+
+    # -- trace artifacts (same episodes, same ids) ---------------------
+    os.makedirs("bench_logs", exist_ok=True)
+    trace_export.export_recorder(None, TRACE_PATH)
+    debug, debug_body = fetch_debug_traces()
+    with open(DEBUG_TRACES_PATH, "wb") as f:
+        f.write(debug_body)
+    journey = find_pod_journey(debug)
+    recorded_ids = set(tracing.recorder().trace_ids())
+    episode_ids = sorted({ph["trace_id"] for ph in phases
+                          if ph.get("trace_id")})
+
+    phase_breakdown = {
+        key: {"p50": q([ph[key] for ph in phases
+                        if ph.get(key) is not None], 50),
+              "p99": q([ph[key] for ph in phases
+                        if ph.get(key) is not None], 99)}
+        for key in PHASES
+    }
 
     result = {
         "metric": "chaos MTTR p50 (fault injection -> displaced gangs "
@@ -88,17 +153,32 @@ def main(argv=None):
         "mttr_p50_s": q(mttr, 50),
         "mttr_p99_s": q(mttr, 99),
         "mttr_samples": len(mttr),
+        # MTTR per named phase span, from the repair-episode traces
+        # (simulated-clock seconds; detect/rebind dominate — fence,
+        # drain and gang_evict complete within one controller pass)
+        "mttr_phase_breakdown": phase_breakdown,
+        "mttr_episodes": phases,
+        "episode_trace_ids": episode_ids,
+        "episode_traces_recorded": sum(
+            1 for tid in episode_ids if tid in recorded_ids),
         "slice_evictions": slice_evictions,
         "evicted_pods": evictions,
         "double_binds": double_binds,
         "unrepaired_gangs": unrepaired,
         "reproducible": fp_a == fp_b,
         "wall_s": round(wall, 2),
+        "trace_file": TRACE_PATH,
+        "debug_traces_file": DEBUG_TRACES_PATH,
+        "debug_traces_count": debug.get("trace_count", 0),
+        "pod_journey_trace_id": journey["trace_id"] if journey else None,
+        "pod_journey_components": journey["components"] if journey else None,
     }
-    os.makedirs(os.path.dirname(OUT_PATH), exist_ok=True)
     with open(OUT_PATH, "w") as f:
         json.dump(result, f, indent=2)
-    print(json.dumps(result))
+    # stdout stays a SHORT line (the file is the artifact of record)
+    brief = {k: v for k, v in result.items()
+             if k not in ("mttr_episodes",)}
+    print(json.dumps(brief))
     return result
 
 

@@ -47,10 +47,12 @@ from nos_tpu.kube.objects import (                          # noqa: E402
     Taint,
     Toleration,
 )
+from nos_tpu.obs import trace_export                        # noqa: E402
 from nos_tpu.scheduler import Scheduler                     # noqa: E402
 
 TPU = constants.RESOURCE_TPU
 OUT_PATH = os.path.join("bench_logs", "bench_sched.json")
+TRACE_PATH = os.path.join("bench_logs", "bench_sched.trace.json")
 # The stable headline series' round-4 value (BENCH_r04.json
 # scale_service_p50_ms): per-pod service time p50 under the
 # 1024-node/500-pod burst. vs_baseline = baseline / current, so > 1.0
@@ -527,7 +529,12 @@ def main(argv=None):
         # staying flat across the 4x cluster is the scaling claim, measured
         **scale,
         **scale4k,
+        # Perfetto/chrome://tracing export of the run's recorded traces
+        # (pod-journey spans with tracing at default sampling — the same
+        # configuration the overhead guard holds to <5% on service p99)
+        "trace_file": TRACE_PATH,
     }
+    trace_export.export_recorder(None, TRACE_PATH)
     # file first (artifact of record), stdout line second (convenience —
     # a tail-truncated line no longer loses the round's numbers)
     os.makedirs(os.path.dirname(OUT_PATH), exist_ok=True)

@@ -60,3 +60,13 @@ app.kubernetes.io/managed-by: {{ .Release.Service }}
 {{- define "nos-tpu.metricsExporter.image" -}}
 {{- printf "%s/%s:%s" .Values.image.registry .Values.metricsExporter.image.repository (include "nos-tpu.tag" .) -}}
 {{- end -}}
+
+{{/* Shared observability args every control-plane daemon takes:
+     structured-log format + tracing sampler / flight-recorder knobs
+     (served at /debug/traces next to /metrics). */}}
+{{- define "nos-tpu.observabilityArgs" -}}
+- --log-format={{ .Values.observability.logFormat }}
+- --trace-sampling={{ .Values.observability.tracing.sampling }}
+- --trace-recorder-size={{ .Values.observability.tracing.recorderMaxTraces }}
+- --trace-slow-threshold={{ .Values.observability.tracing.slowThresholdSeconds }}
+{{- end -}}

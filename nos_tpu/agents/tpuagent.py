@@ -37,6 +37,7 @@ from nos_tpu.kube.controller import Controller, Request, Result, Watch
 from nos_tpu.kube import predicates
 from nos_tpu.kube.objects import Node
 from nos_tpu.agents.plan import BoardState, PartitionConfigPlan
+from nos_tpu.obs import tracing as trace
 from nos_tpu.tpu import annotation as ann
 from nos_tpu.tpu.slice import Geometry, Profile, is_slice_resource, parse_profile
 
@@ -302,12 +303,22 @@ class TpuAgent:
                 changed[0] = changed[0] or alloc != n.status.allocatable
                 n.status.allocatable = alloc
 
+        # span only reports that changed something: an unchanged 10s
+        # heartbeat report is not worth a trace entry, so the span is
+        # started but only ended (= recorded) on a changed outcome
+        report_sp = trace.start_span(
+            "tpuagent.report", component="tpuagent",
+            attrs={"node": self.node_name})
         try:
             client.patch("Node", self.node_name, "", mutate)
         except Exception:
             obs.AGENT_REPORTS.labels("error").inc()
+            report_sp.set_error("report patch failed")
+            report_sp.end()
             raise
         obs.AGENT_REPORTS.labels("changed" if changed[0] else "unchanged").inc()
+        if changed[0]:
+            report_sp.end()
         self.shared.mark_reported()
         return self._report_result()
 
@@ -363,11 +374,16 @@ class TpuAgent:
             )
             return Result()
         logger.info("tpuagent %s: applying %s (%s)", self.node_name, plan_id, plan.summary())
-        try:
-            self.tpu.apply_partition(desired, plan_id)
-        except Exception:
-            obs.AGENT_APPLIES.labels("error").inc()
-            raise
+        # the apply joins the partitioner's trace: the spec plan
+        # annotation does not carry a context, but the plan id does tie
+        # the spans together; span it standalone with the id attached
+        with trace.span("tpuagent.apply", component="tpuagent",
+                        attrs={"node": self.node_name, "plan": plan_id}):
+            try:
+                self.tpu.apply_partition(desired, plan_id)
+            except Exception:
+                obs.AGENT_APPLIES.labels("error").inc()
+                raise
         obs.AGENT_APPLIES.labels("ok").inc()
         self.shared.mark_applied()
         return Result()

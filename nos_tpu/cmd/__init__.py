@@ -11,4 +11,64 @@ Run as ``python -m nos_tpu.cmd <binary> [flags]``:
   partitioner      dynamic TPU partitioning control plane
   tpuagent         per-node daemon: reporter + actuator
   metricsexporter  one-shot cluster telemetry snapshot
+
+Shared logging lives here: every binary takes ``--log-format json`` and
+routes through :func:`setup_logging`, which (in json mode) emits one JSON
+object per line with ``trace_id``/``span_id`` injected whenever a tracing
+span is active — so logs and /debug/traces correlate on the same ids.
 """
+from __future__ import annotations
+
+import json
+import logging
+import time
+
+
+class JsonLogFormatter(logging.Formatter):
+    """One JSON object per line; trace correlation fields injected from
+    the context-local tracing span (nos_tpu/obs/tracing.py) when one is
+    active, so ``jq 'select(.trace_id=="…")'`` replays one pod journey
+    straight out of the daemon logs."""
+
+    def format(self, record: logging.LogRecord) -> str:
+        from nos_tpu.obs import tracing
+
+        out = {
+            "ts": time.strftime("%Y-%m-%dT%H:%M:%S",
+                                time.gmtime(record.created))
+            + f".{int(record.msecs):03d}Z",
+            "level": record.levelname,
+            "logger": record.name,
+            "msg": record.getMessage(),
+        }
+        sp = tracing.current()
+        if sp is not None:
+            out["trace_id"] = sp.trace_id
+            out["span_id"] = sp.span_id
+        if record.exc_info:
+            out["exc"] = self.formatException(record.exc_info)
+        return json.dumps(out, ensure_ascii=False)
+
+
+def setup_logging(level: int = 0, log_format: str = "text",
+                  numeric_level: int = None) -> None:
+    """Root logging for a cmd/ binary. ``log_format`` is ``text`` (the
+    classic human-readable line) or ``json`` (structured, one object per
+    line, trace-correlated). ``level`` is the kube-style -v verbosity
+    (0 = INFO, >0 = DEBUG); binaries whose config carries a real logging
+    level name (trainer/server/generate ``log_level: warning``) pass it
+    via ``numeric_level``, which takes precedence."""
+    root = logging.getLogger()
+    for h in list(root.handlers):
+        root.removeHandler(h)
+    handler = logging.StreamHandler()
+    if log_format == "json":
+        handler.setFormatter(JsonLogFormatter())
+    else:
+        handler.setFormatter(logging.Formatter(
+            "%(asctime)s %(levelname)s %(name)s: %(message)s"))
+    root.addHandler(handler)
+    if numeric_level is not None:
+        root.setLevel(numeric_level)
+    else:
+        root.setLevel(logging.DEBUG if level > 0 else logging.INFO)

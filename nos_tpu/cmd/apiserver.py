@@ -45,8 +45,9 @@ def main(argv: Optional[Sequence[str]] = None) -> None:
         help="disable ElasticQuota/CompositeElasticQuota admission validation",
     )
     parser.add_argument("--log-level", type=int, default=0)
+    serve.observability_flags(parser)
     args = parser.parse_args(argv)
-    serve.setup_logging(args.log_level)
+    serve.setup_observability(args)
 
     http = build(args.host, args.port, quota_webhooks=not args.no_quota_webhooks)
     print(f"nos-tpu-apiserver listening at {http.address}")

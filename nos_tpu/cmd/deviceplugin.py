@@ -44,7 +44,7 @@ def main(argv: Optional[Sequence[str]] = None) -> None:
                         help="one refresh then exit (smoke/debug)")
     serve.common_flags(parser, config=False)
     args = parser.parse_args(argv)
-    serve.setup_logging(args.log_level if args.log_level is not None else 0)
+    serve.setup_observability(args)
     if not args.node:
         parser.error("--node (or NODE_NAME) is required")
 

@@ -144,6 +144,10 @@ def main(argv: Optional[Sequence[str]] = None) -> None:
     parser.add_argument("--top-k", type=int, default=None)
     parser.add_argument("--top-p", type=float, default=None)
     parser.add_argument("--int8", action="store_true")
+    parser.add_argument(
+        "--log-format", choices=("text", "json"), default="text",
+        help="log line format; json emits one object per line with "
+             "trace_id/span_id injected when a tracing span is active")
     args = parser.parse_args(argv)
 
     cfg = GenerateConfig.from_yaml_file(args.config) if args.config \
@@ -160,8 +164,10 @@ def main(argv: Optional[Sequence[str]] = None) -> None:
         cfg.top_p = args.top_p
     if args.int8:
         cfg.int8 = True
-    logging.basicConfig(level=getattr(logging, cfg.log_level.upper(), 20),
-                        format="%(asctime)s %(name)s %(levelname)s %(message)s")
+    from nos_tpu.cmd import setup_logging as _shared_setup_logging
+    _shared_setup_logging(
+        0, args.log_format,
+        numeric_level=getattr(logging, cfg.log_level.upper(), 20))
 
     prompts = []
     for raw in args.prompt or ["0"]:

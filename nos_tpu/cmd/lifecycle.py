@@ -71,7 +71,7 @@ def main(argv: Optional[Sequence[str]] = None) -> None:
         help="single-replica deployments may skip the Lease")
     args = parser.parse_args(argv)
 
-    serve.setup_logging(args.log_level or 0)
+    serve.setup_observability(args)
     mgr = build(
         serve.connect(args),
         lease_timeout_s=args.lease_timeout,

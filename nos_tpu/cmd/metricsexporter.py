@@ -83,7 +83,7 @@ def main(argv: Optional[Sequence[str]] = None) -> None:
         help="optional URL to POST the snapshot to (disabled by default)",
     )
     args = parser.parse_args(argv)
-    serve.setup_logging(args.log_level or 0)
+    serve.setup_observability(args)
 
     client = Client(serve.connect(args))
     doc = json.dumps(collect(client), indent=2, sort_keys=True)

@@ -56,8 +56,9 @@ def main(argv: Optional[Sequence[str]] = None) -> None:
 
     cfg = OperatorConfig.from_yaml_file(args.config) if args.config \
         else OperatorConfig()
-    serve.setup_logging(args.log_level if args.log_level is not None
-                        else cfg.log_level)
+    serve.setup_observability(
+        args, args.log_level if args.log_level is not None
+        else cfg.log_level)
     server = serve.connect(args)
     webhook = None
     if args.webhook_certs:

@@ -49,8 +49,9 @@ def main(argv: Optional[Sequence[str]] = None) -> None:
 
     cfg = PartitionerConfig.from_yaml_file(args.config) if args.config \
         else PartitionerConfig()
-    serve.setup_logging(args.log_level if args.log_level is not None
-                        else cfg.log_level)
+    serve.setup_observability(
+        args, args.log_level if args.log_level is not None
+        else cfg.log_level)
     mgr = build(serve.connect(args), cfg)
     serve.run_daemon(mgr, args.health_port, args.health_host)
 

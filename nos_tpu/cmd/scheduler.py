@@ -39,8 +39,9 @@ def main(argv: Optional[Sequence[str]] = None) -> None:
     # (api/scheduler_config — the reference's conversion/defaulting layer)
     cfg = load_scheduler_config(args.config) if args.config \
         else CapacitySchedulingArgs()
-    serve.setup_logging(args.log_level if args.log_level is not None
-                        else cfg.log_level)
+    serve.setup_observability(
+        args, args.log_level if args.log_level is not None
+        else cfg.log_level)
     mgr = build(serve.connect(args), cfg)
     serve.run_daemon(mgr, args.health_port, args.health_host)
 

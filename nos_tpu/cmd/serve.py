@@ -2,26 +2,36 @@
 
 Every reference binary serves healthz/readyz probes and a metrics endpoint
 (cmd/operator/operator.go:112-119; metrics.bindAddress in the component
-ConfigMaps). ``HealthServer`` provides those three endpoints for any
-Manager-hosting process; ``common_flags``/``connect`` standardize the
---api / --health-port flags.
+ConfigMaps). ``HealthServer`` provides those endpoints — plus the tracing
+flight recorder at ``/debug/traces`` — for any Manager-hosting process;
+``common_flags``/``connect`` standardize the --api / --health-port flags.
 """
 from __future__ import annotations
 
 import argparse
+import json
 import logging
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 
+from nos_tpu.cmd import setup_logging as _setup_logging
 from nos_tpu.kube.httpapi import RemoteApiServer
+from nos_tpu.obs import tracing
 from nos_tpu.utils.metrics import default_registry
 
 logger = logging.getLogger(__name__)
 
+OPENMETRICS_CONTENT_TYPE = \
+    "application/openmetrics-text; version=1.0.0; charset=utf-8"
+
 
 class HealthServer:
-    """Serves /healthz, /readyz, /metrics for one binary."""
+    """Serves /healthz, /readyz, /metrics and /debug/traces for one
+    binary. /metrics content-negotiates: an ``Accept`` header asking for
+    ``application/openmetrics-text`` gets the OpenMetrics dialect with
+    trace exemplars on histogram buckets; everything else gets the
+    classic Prometheus text format."""
 
     def __init__(self, manager=None, host: str = "127.0.0.1", port: int = 0):
         mgr = manager
@@ -30,10 +40,11 @@ class HealthServer:
             def log_message(self, fmt, *args):
                 pass
 
-            def _send(self, status: int, text: str) -> None:
+            def _send(self, status: int, text: str,
+                      content_type: str = "text/plain; version=0.0.4") -> None:
                 body = text.encode()
                 self.send_response(status)
-                self.send_header("Content-Type", "text/plain; version=0.0.4")
+                self.send_header("Content-Type", content_type)
                 self.send_header("Content-Length", str(len(body)))
                 self.end_headers()
                 self.wfile.write(body)
@@ -46,7 +57,28 @@ class HealthServer:
                     ok = mgr.readyz() if mgr is not None else True
                     self._send(200 if ok else 500, "ok" if ok else "not ready")
                 elif self.path == "/metrics":
-                    self._send(200, default_registry().expose())
+                    accept = self.headers.get("Accept", "")
+                    if "application/openmetrics-text" in accept:
+                        self._send(200,
+                                   default_registry().expose(openmetrics=True),
+                                   OPENMETRICS_CONTENT_TYPE)
+                    else:
+                        self._send(200, default_registry().expose())
+                elif self.path == "/debug/traces":
+                    self._send(200, json.dumps(tracing.recorder().to_json()),
+                               "application/json")
+                elif self.path.startswith("/debug/traces/"):
+                    tid = self.path.rsplit("/", 1)[1]
+                    spans = tracing.recorder().trace(tid)
+                    if not spans:
+                        self._send(404, json.dumps({"error": "unknown trace",
+                                                    "trace_id": tid}),
+                                   "application/json")
+                    else:
+                        self._send(200, json.dumps({
+                            "trace_id": tid,
+                            "spans": [sp.to_dict() for sp in spans],
+                        }), "application/json")
                 else:
                     self._send(404, "not found")
 
@@ -100,18 +132,44 @@ def common_flags(parser: argparse.ArgumentParser, config: bool = True) -> None:
     )
     parser.add_argument(
         "--health-port", type=int, default=0,
-        help="healthz/readyz/metrics port (0 = ephemeral)",
+        help="healthz/readyz/metrics/debug-traces port (0 = ephemeral)",
     )
     parser.add_argument(
         "--health-host", default="0.0.0.0",
         help="healthz bind address (kubelet probes the pod IP, so the "
              "default binds all interfaces)",
     )
+    observability_flags(parser)
     if config:
         parser.add_argument(
             "-config", "--config", dest="config", default=None,
             help="component config YAML (reference: ctrl.ConfigFile().AtPath)",
         )
+
+
+def observability_flags(parser: argparse.ArgumentParser) -> None:
+    """The shared structured-logging + tracing flags (folded into
+    common_flags; binaries with bespoke parsers call this directly)."""
+    parser.add_argument(
+        "--log-format", choices=("text", "json"), default="text",
+        help="log line format; json emits one object per line with "
+             "trace_id/span_id injected when a tracing span is active",
+    )
+    parser.add_argument(
+        "--trace-sampling", type=float, default=None,
+        help="fraction of new pod-journey traces to record (0 disables, "
+             "1 records all; default from NOS_TPU_TRACE_SAMPLING or 1.0)",
+    )
+    parser.add_argument(
+        "--trace-recorder-size", type=int, default=None,
+        help="flight-recorder capacity: recently completed traces kept "
+             "in memory for /debug/traces (default 256)",
+    )
+    parser.add_argument(
+        "--trace-slow-threshold", type=float, default=None,
+        help="seconds over which a completed span pins its whole trace "
+             "in the flight recorder (default 1.0)",
+    )
 
 
 def connect(args):
@@ -135,10 +193,22 @@ def connect(args):
     return remote
 
 
-def setup_logging(level: int = 0) -> None:
-    logging.basicConfig(
-        level=logging.DEBUG if level > 0 else logging.INFO,
-        format="%(asctime)s %(levelname)s %(name)s: %(message)s",
+def setup_logging(level: int = 0, log_format: str = "text") -> None:
+    _setup_logging(level, log_format)
+
+
+def setup_observability(args, level: Optional[int] = None) -> None:
+    """Apply the shared observability flags: logging format plus the
+    tracing sampler / flight-recorder knobs. Every cmd/ main calls this
+    right after parse_args; ``level`` overrides the -v flag for binaries
+    whose config file carries its own log level."""
+    if level is None:
+        level = getattr(args, "log_level", 0) or 0
+    setup_logging(level, getattr(args, "log_format", "text"))
+    tracing.configure(
+        sampling=getattr(args, "trace_sampling", None),
+        recorder_size=getattr(args, "trace_recorder_size", None),
+        slow_threshold_s=getattr(args, "trace_slow_threshold", None),
     )
 
 

@@ -610,6 +610,10 @@ def main(argv: Optional[Sequence[str]] = None) -> None:
     parser.add_argument("--config", default="", help="server config YAML")
     parser.add_argument("--checkpoint-dir", default="")
     parser.add_argument("--port", type=int, default=None)
+    parser.add_argument(
+        "--log-format", choices=("text", "json"), default="text",
+        help="log line format; json emits one object per line with "
+             "trace_id/span_id injected when a tracing span is active")
     args = parser.parse_args(argv)
 
     cfg = ServerConfig.from_yaml_file(args.config) if args.config \
@@ -618,8 +622,10 @@ def main(argv: Optional[Sequence[str]] = None) -> None:
         cfg.checkpoint_dir = args.checkpoint_dir
     if args.port is not None:
         cfg.port = args.port
-    logging.basicConfig(level=getattr(logging, cfg.log_level.upper(), 20),
-                        format="%(asctime)s %(name)s %(levelname)s %(message)s")
+    from nos_tpu.cmd import setup_logging as _shared_setup_logging
+    _shared_setup_logging(
+        0, args.log_format,
+        numeric_level=getattr(logging, cfg.log_level.upper(), 20))
 
     loop = ServingLoop(build_engine(cfg))
     httpd = make_http_server(cfg, loop)

@@ -76,8 +76,9 @@ def main(argv: Optional[Sequence[str]] = None) -> None:
 
     cfg = TpuAgentConfig.from_yaml_file(args.config) if args.config \
         else TpuAgentConfig()
-    serve.setup_logging(args.log_level if args.log_level is not None
-                        else cfg.log_level)
+    serve.setup_observability(
+        args, args.log_level if args.log_level is not None
+        else cfg.log_level)
     tpu_client = MockTpuClient(chips=args.mock_chips) if args.mock else None
     mgr = build(serve.connect(args), args.node_name, cfg, tpu_client=tpu_client,
                 mock_chips=args.mock_chips,

@@ -586,12 +586,18 @@ def train(cfg: TrainerConfig, stop_event=None) -> float:
 def main(argv: Optional[Sequence[str]] = None) -> None:
     parser = argparse.ArgumentParser(prog="nos-tpu-trainer", description=__doc__)
     parser.add_argument("--config", default="", help="trainer config YAML")
+    parser.add_argument(
+        "--log-format", choices=("text", "json"), default="text",
+        help="log line format; json emits one object per line with "
+             "trace_id/span_id injected when a tracing span is active")
     args = parser.parse_args(argv)
 
     cfg = TrainerConfig.from_yaml_file(args.config) if args.config \
         else TrainerConfig()
-    logging.basicConfig(level=getattr(logging, cfg.log_level.upper(), 20),
-                        format="%(asctime)s %(name)s %(levelname)s %(message)s")
+    from nos_tpu.cmd import setup_logging as _shared_setup_logging
+    _shared_setup_logging(
+        0, args.log_format,
+        numeric_level=getattr(logging, cfg.log_level.upper(), 20))
     _maybe_init_distributed()
     health = None
     if cfg.metrics_port:

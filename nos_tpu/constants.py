@@ -152,6 +152,12 @@ ANNOTATION_LIFECYCLE_CORDONED = DOMAIN + "/lifecycle-cordoned"
 # Restart generation stamped onto pods the slice-repair path recreates
 # (observability: how many times has this worker been displaced).
 ANNOTATION_LIFECYCLE_RESTARTS = DOMAIN + "/lifecycle-restarts"
+# Distributed-tracing context of the pod's journey (W3C traceparent
+# syntax), stamped by the scheduler at quota admission and preserved by
+# the slice-repair recreate, so scheduler attempt, partitioner
+# plan/actuate, tpuagent apply and lifecycle evict->rebind all land in
+# ONE trace (nos_tpu/obs/tracing.py).
+ANNOTATION_TRACE_CONTEXT = "nos-tpu/trace-context"
 # Taints applied when fencing a node (kube's own unreachable taint key for
 # lease/heartbeat death; a nos key for impending maintenance).
 TAINT_UNREACHABLE = "node.kubernetes.io/unreachable"

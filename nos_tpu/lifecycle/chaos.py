@@ -52,6 +52,7 @@ from nos_tpu.lifecycle.events import (
     deliver_maintenance_notice,
     deliver_preemption_notice,
 )
+from nos_tpu.obs import tracing as trace
 from nos_tpu.scheduler import Scheduler
 from nos_tpu.scheduler.gang import gang_key
 
@@ -151,6 +152,12 @@ class ChaosReport:
     unrepaired_gangs: List[str] = field(default_factory=list)
     unbound_pods_final: int = 0
     faults: List[Fault] = field(default_factory=list)
+    # per-repaired-fault MTTR broken down by the episode trace's named
+    # phase spans (detect -> fence -> drain -> gang_evict -> rebind),
+    # keyed to the repair-episode trace_id so the bench report, the
+    # Perfetto export and /debug/traces all reference the SAME episode.
+    # Trace ids are random, so this field is NOT part of fingerprint().
+    mttr_phases: List[dict] = field(default_factory=list)
 
     def fingerprint(self) -> str:
         """sha256 over the event log — equal across runs iff the run was
@@ -166,6 +173,10 @@ class _TrackedFault:
         self.displaced = displaced_gangs      # gang keys displaced at t0
         self.detected_at: Optional[float] = None
         self.repaired_at: Optional[float] = None
+        # the lifecycle controller's repair-episode root span, captured
+        # at detection so the harness can attach its detect/rebind phase
+        # spans to the same trace even after the controller closes it
+        self.episode = None
 
 
 class ChaosHarness:
@@ -396,6 +407,26 @@ class ChaosHarness:
         bound = [p for p in members if p.spec.node_name]
         return len(members) == declared and len(bound) == declared
 
+    def _find_episode(self, node: str):
+        """The node's repair-episode root span: the controller's open
+        episode, or — when the controller already closed it (a node
+        deletion closes on drain) — the newest completed episode for
+        that node read back from the flight recorder."""
+        sp = self.lifecycle.episode_span(node)
+        if sp is not None:
+            return sp
+        rec = trace.recorder()
+        # newest-recorded first: node names repeat across seeded runs in
+        # one process, and the sim clock restarts at the same epoch, so
+        # recorder recency — not span start time — identifies THIS run's
+        # episode
+        for tid in reversed(rec.trace_ids()):
+            for s in rec.trace(tid):
+                if s.name == "lifecycle.repair" \
+                        and s.attrs.get("node") == node:
+                    return s
+        return None
+
     def _observe(self) -> None:
         now = self.clock()
         for t in self._tracked:
@@ -410,7 +441,19 @@ class ChaosHarness:
                     t.detected_at = now
                     lat = max(0.0, now - (self.t0 + f.at))
                     self.report.detection_s.append(lat)
-                    obs.LIFECYCLE_DETECTION.observe(lat)
+                    # grab the repair-episode root (open, or completed
+                    # into the recorder for a kill) and file the detect
+                    # phase (injection -> fence) into the same trace
+                    t.episode = self._find_episode(f.node)
+                    tid = (t.episode.trace_id
+                           if t.episode is not None and t.episode.recording
+                           else None)
+                    obs.LIFECYCLE_DETECTION.observe(lat, trace_id=tid)
+                    if t.episode is not None:
+                        trace.start_span(
+                            "detect", component="chaos", parent=t.episode,
+                            attrs={"kind": f.kind, "node": f.node},
+                            start_time=self.t0 + f.at).end(now)
                     self._log(f"detected {f.kind} node={f.node} "
                               f"latency={lat:.3f}")
             if t.repaired_at is None and t.displaced:
@@ -419,10 +462,68 @@ class ChaosHarness:
                     t.repaired_at = now
                     mttr = max(0.0, now - (self.t0 + f.at))
                     self.report.mttr_s.append(mttr)
-                    obs.LIFECYCLE_MTTR.observe(mttr)
+                    if t.episode is None:
+                        # repair can be observed before detection (the
+                        # gang rebound while the fence was still
+                        # pending); pick the episode up if it exists
+                        t.episode = self._find_episode(f.node)
+                    tid = (t.episode.trace_id
+                           if t.episode is not None and t.episode.recording
+                           else None)
+                    obs.LIFECYCLE_MTTR.observe(mttr, trace_id=tid)
+                    if t.episode is not None:
+                        # rebind phase: fence complete -> every displaced
+                        # gang atomically rebound
+                        trace.start_span(
+                            "rebind", component="chaos", parent=t.episode,
+                            attrs={"gangs": ",".join(
+                                f"{ns}/{g}" for ns, g in sorted(t.displaced))},
+                            start_time=t.detected_at
+                            if t.detected_at is not None
+                            else self.t0 + f.at).end(now)
+                        t.episode.end(now)
+                    self.report.mttr_phases.append(
+                        self._phase_breakdown(t, mttr, now))
                     self._log(f"repaired {f.kind} node={f.node} "
                               f"gangs={sorted(t.displaced)} "
                               f"mttr={mttr:.3f}")
+
+    def _phase_breakdown(self, t: "_TrackedFault", mttr: float,
+                         now: float) -> dict:
+        """MTTR attributed to the episode trace's named phase spans. The
+        fence/drain/gang_evict numbers come from the spans the lifecycle
+        controller recorded; detect/rebind from the harness's own
+        observation spans — all in one trace, so the breakdown, the
+        Perfetto export and /debug/traces agree on ids."""
+        f = t.fault
+        out = {
+            "kind": f.kind,
+            "node": f.node,
+            "trace_id": (t.episode.trace_id
+                         if t.episode is not None and t.episode.recording
+                         else None),
+            "detect_s": (round(t.detected_at - (self.t0 + f.at), 3)
+                         if t.detected_at is not None else None),
+            "fence_s": None,
+            "drain_s": None,
+            "gang_evict_s": None,
+            "rebind_s": (round(now - t.detected_at, 3)
+                         if t.detected_at is not None else None),
+            "mttr_s": round(mttr, 3),
+        }
+        if out["trace_id"]:
+            for sp in trace.recorder().trace(out["trace_id"]):
+                d = sp.duration
+                if d is None:
+                    continue
+                if sp.name == "lifecycle.fence":
+                    out["fence_s"] = round((out["fence_s"] or 0.0) + d, 3)
+                elif sp.name == "lifecycle.drain":
+                    out["drain_s"] = round((out["drain_s"] or 0.0) + d, 3)
+                elif sp.name == "lifecycle.gang_evict":
+                    out["gang_evict_s"] = round(
+                        (out["gang_evict_s"] or 0.0) + d, 3)
+        return out
 
     def _check_invariants(self) -> None:
         """Double-bind / over-commit / domain-atomicity checks. A
@@ -467,6 +568,17 @@ class ChaosHarness:
 
     # ------------------------------------------------------------------
     def run(self) -> ChaosReport:
+        # every span in the run — scheduler attempts included — shares
+        # the harness's simulated clock, so the episode's Perfetto
+        # timeline is one consistent time domain
+        prev_clock = trace.tracer().clock
+        trace.tracer().set_clock(self.clock)
+        try:
+            return self._run()
+        finally:
+            trace.tracer().set_clock(prev_clock)
+
+    def _run(self) -> ChaosReport:
         evicted_before = obs.LIFECYCLE_EVICTED_PODS.total()
         slices_before = obs.LIFECYCLE_SLICE_EVICTIONS.total()
         self.mgr.run_until_idle()      # initial placement
@@ -490,6 +602,9 @@ class ChaosHarness:
         self.mgr.run_until_idle()
         self._observe()
         self._check_invariants()
+        # flush still-open repair episodes (faults that never recovered
+        # inside the window) so their traces complete in the recorder
+        self.lifecycle.close_open_episodes(self.clock())
         self.report.evicted_pods = int(
             obs.LIFECYCLE_EVICTED_PODS.total() - evicted_before)
         self.report.slice_evictions = int(

@@ -57,6 +57,7 @@ from nos_tpu.lifecycle.events import (
     preemption_deadline,
     unhealthy_chip_indexes,
 )
+from nos_tpu.obs import tracing as trace
 from nos_tpu.scheduler.gang import gang_key, gang_worker
 
 logger = logging.getLogger(__name__)
@@ -122,6 +123,13 @@ class NodeLifecycleController:
         # keep non-evictable pods (DaemonSet pods; a CPU sidecar under
         # chip_degraded) forever
         self._drained_clean: Set[str] = set()
+        # per-node repair-episode root spans (one trace per fault
+        # episode: detect -> fence -> drain -> gang_evict -> rebind) and
+        # the open drain-phase spans under them. The chaos harness reads
+        # these via episode_span() to attach its detect/rebind phases —
+        # and MTTR per phase — into the same trace.
+        self._episodes: Dict[str, object] = {}
+        self._drain_spans: Dict[str, object] = {}
 
     # ------------------------------------------------------------------
     # Reconcile
@@ -163,6 +171,11 @@ class NodeLifecycleController:
             pass
         elif marker:
             self._unfence(client, node, now)
+        elif name in self._episodes and name not in self._fenced:
+            # the node is back, healthy and unmarked (a kill-respawn
+            # path: the fence died with the node object) — close the
+            # repair episode so its trace completes
+            self._close_episode(name, now)
         # keep polling: lease staleness and maintenance lead times are
         # clock transitions no watch event announces
         return Result(requeue_after=self.check_interval_s)
@@ -192,6 +205,47 @@ class NodeLifecycleController:
         return False
 
     # ------------------------------------------------------------------
+    # Repair-episode tracing
+    # ------------------------------------------------------------------
+    def _episode(self, node_name: str, reason: str, now: float):
+        """The fault episode's root span for ``node_name`` (created on
+        first fence, reused across reason transitions). Timestamps come
+        from THIS controller's clock so the chaos harness's simulated
+        time and a daemon's wall clock both stay self-consistent."""
+        sp = self._episodes.get(node_name)
+        if sp is None:
+            sp = trace.start_span(
+                "lifecycle.repair", component="lifecycle",
+                attrs={"node": node_name, "reason": reason},
+                parent=None, start_time=now)
+            self._episodes[node_name] = sp
+        elif sp.recording and sp.attrs.get("reason") != reason:
+            sp.add_event("reason_change", ts=now, reason=reason)
+        return sp
+
+    def episode_span(self, node_name: str):
+        """The open repair-episode span for a node (None once closed) —
+        the chaos harness parents its detect/rebind phase spans here."""
+        return self._episodes.get(node_name)
+
+    def _close_episode(self, node_name: str, now: float) -> None:
+        dsp = self._drain_spans.pop(node_name, None)
+        if dsp is not None:
+            dsp.end(now)
+        ep = self._episodes.pop(node_name, None)
+        if ep is not None:
+            ep.end(now)
+
+    def close_open_episodes(self, now: Optional[float] = None) -> None:
+        """Flush every open repair episode to the recorder (daemon
+        shutdown; the chaos harness at end of window) so traces of
+        never-recovered faults still complete."""
+        if now is None:
+            now = self.clock()
+        for node in list(self._episodes):
+            self._close_episode(node, now)
+
+    # ------------------------------------------------------------------
     # Fencing / recovery
     # ------------------------------------------------------------------
     def _taints_for(self, reason: str) -> List[Taint]:
@@ -205,6 +259,11 @@ class NodeLifecycleController:
         already = node.metadata.annotations.get(
             constants.ANNOTATION_LIFECYCLE_CORDONED)
         if already != reason:
+            ep = self._episode(node.metadata.name, reason, now)
+            fence_sp = trace.start_span(
+                "lifecycle.fence", component="lifecycle", parent=ep,
+                attrs={"node": node.metadata.name, "reason": reason},
+                start_time=now)
             taints = self._taints_for(reason)
             not_ready = reason in ("lease_expired", "node_deleted")
 
@@ -228,6 +287,7 @@ class NodeLifecycleController:
                         self._set_ready(n, "True", "HeartbeatRestored", now)
 
             client.patch("Node", node.metadata.name, "", mutate)
+            fence_sp.end(self.clock())
             self._fenced.add(node.metadata.name)
             self._drained_clean.discard(node.metadata.name)
             obs.LIFECYCLE_EVENTS.labels(reason).inc()
@@ -240,8 +300,18 @@ class NodeLifecycleController:
         # watch below turns into a re-drain (discarding _drained_clean),
         # so polling the full pod list every interval bought nothing
         if node.metadata.name not in self._drained_clean:
-            if self._drain(client, node.metadata.name, reason) == 0:
+            ep = self._episodes.get(node.metadata.name)
+            if node.metadata.name not in self._drain_spans:
+                self._drain_spans[node.metadata.name] = trace.start_span(
+                    "lifecycle.drain", component="lifecycle", parent=ep,
+                    attrs={"node": node.metadata.name, "reason": reason},
+                    start_time=self.clock())
+            if self._drain(client, node.metadata.name, reason,
+                           episode=ep) == 0:
                 self._drained_clean.add(node.metadata.name)
+                dsp = self._drain_spans.pop(node.metadata.name, None)
+                if dsp is not None:
+                    dsp.end(self.clock())
 
     def _unfence(self, client: Client, node: Node, now: float) -> None:
         lifecycle_keys = {constants.TAINT_UNREACHABLE,
@@ -258,6 +328,7 @@ class NodeLifecycleController:
         client.patch("Node", node.metadata.name, "", mutate)
         self._fenced.discard(node.metadata.name)
         self._drained_clean.discard(node.metadata.name)
+        self._close_episode(node.metadata.name, now)
         obs.LIFECYCLE_EVENTS.labels("recovered").inc()
         obs.LIFECYCLE_NODES_NOT_READY.set(len(self._fenced))
         logger.info("recovered node %s: uncordoned, taints cleared",
@@ -287,7 +358,17 @@ class NodeLifecycleController:
         if name not in self._known and not bound:
             return     # a foreign lease / never-a-node name: nothing here
         obs.LIFECYCLE_EVENTS.labels("node_deleted").inc()
-        self._drain(client, name, "node_deleted")
+        now = self.clock()
+        ep = self._episode(name, "node_deleted", now)
+        self._drain(client, name, "node_deleted", episode=ep)
+        # the node object is gone and everything evictable was just
+        # drained: the repair action is complete from this controller's
+        # side, so close the episode NOW — leaving it open until a node
+        # of the same name reappears would leak one open span per
+        # scale-down forever (consumers that need the completed trace,
+        # e.g. the chaos harness's phase attribution, read it back from
+        # the flight recorder by the root span's node attr)
+        self._close_episode(name, self.clock())
         self._known.discard(name)
         self._observed.pop(name, None)
         self._witnessed_alive.discard(name)
@@ -299,7 +380,8 @@ class NodeLifecycleController:
     # ------------------------------------------------------------------
     # Drain / slice repair
     # ------------------------------------------------------------------
-    def _drain(self, client: Client, node_name: str, reason: str) -> int:
+    def _drain(self, client: Client, node_name: str, reason: str,
+               episode=None) -> int:
         """Evict pods off ``node_name``. Gang members trigger WHOLE-GANG
         eviction across the ICI domain (the atomic-failure-domain rule);
         plain pods are evicted individually. On chip degradation only
@@ -329,9 +411,18 @@ class NodeLifecycleController:
                  and p.status.phase in ("Pending", "Running")),
                 key=gang_worker)
             displaced = [p for p in members if p.spec.node_name]
-            for m in displaced:
-                self._evict_one(client, m, reason, evicted)
+            gsp = None
             if displaced:
+                gsp = trace.start_span(
+                    "lifecycle.gang_evict", component="lifecycle",
+                    parent=episode,
+                    attrs={"gang": f"{gk.namespace}/{gk.name}",
+                           "members": len(displaced), "reason": reason},
+                    start_time=self.clock())
+            for m in displaced:
+                self._evict_one(client, m, reason, evicted, episode=episode)
+            if displaced:
+                gsp.end(self.clock())
                 obs.LIFECYCLE_SLICE_EVICTIONS.inc()
                 logger.info(
                     "slice repair: gang %s/%s fully evicted (%d bound "
@@ -342,23 +433,36 @@ class NodeLifecycleController:
                 continue
             if reason == "chip_degraded" and not _requests_tpu(p):
                 continue
-            self._evict_one(client, p, reason, evicted)
+            self._evict_one(client, p, reason, evicted, episode=episode)
         # evicted (not found) is the clean-ness signal: a fenced node may
         # legitimately keep non-evictable pods (a CPU sidecar under
         # chip_degraded) forever, and those must not force re-polling
         return len(evicted)
 
     def _evict_one(self, client: Client, pod: Pod, reason: str,
-                   evicted: Set[Tuple[str, str]]) -> None:
+                   evicted: Set[Tuple[str, str]], episode=None) -> None:
         """Delete + recreate as a fresh Pending pod (this controller is
         the stack's JobSet-repair half: in kube terms, the eviction plus
         the owning controller's replacement create, folded into one
         idempotent step). The recreate clears the bind and identity
-        fields; labels/annotations survive so gang membership does."""
+        fields; labels/annotations survive so gang membership does —
+        and so does the nos-tpu/trace-context annotation, which is what
+        lands the rebind in the same journey trace as the eviction."""
         key = (pod.metadata.namespace, pod.metadata.name)
         if key in evicted:
             return
         evicted.add(key)
+        # the eviction, told in the POD's journey trace (the annotation
+        # context stamped at quota admission), cross-linked to the
+        # node's repair-episode trace
+        evict_sp = trace.start_span(
+            "lifecycle.evict", component="lifecycle",
+            parent=trace.pod_trace_context(pod),
+            attrs={"pod": f"{pod.metadata.namespace}/{pod.metadata.name}",
+                   "reason": reason, "node": pod.spec.node_name or ""},
+            start_time=self.clock())
+        if episode is not None and getattr(episode, "recording", False):
+            evict_sp.set_attr("episode_trace_id", episode.trace_id)
         try:
             client.delete("Pod", pod.metadata.name, pod.metadata.namespace)
         except NotFound:
@@ -390,6 +494,7 @@ class NodeLifecycleController:
             client.create(fresh)
         except AlreadyExists:
             pass   # a racing reconcile already recreated it
+        evict_sp.end(self.clock())
         obs.LIFECYCLE_EVICTED_PODS.labels(reason).inc()
 
     # ------------------------------------------------------------------

@@ -1,0 +1,28 @@
+"""nos_tpu.obs — distributed tracing & observability plumbing.
+
+- ``tracing``: zero-dependency spans, cross-process pod-annotation
+  propagation, and the bounded flight recorder behind ``/debug/traces``.
+- ``trace_export``: Perfetto / Chrome trace-event JSON export for the
+  benches (``bench_logs/*.trace.json``).
+
+Domain *metrics* stay in ``nos_tpu/observability.py`` (the histogram /
+counter registry every ``/metrics`` endpoint serves); this package is
+the trace half of the observability story, with OpenMetrics exemplars
+(utils/metrics.py) linking the two.
+"""
+from nos_tpu.obs import tracing  # noqa: F401
+from nos_tpu.obs.tracing import (  # noqa: F401
+    FlightRecorder,
+    Span,
+    SpanContext,
+    Tracer,
+    configure,
+    current,
+    pod_trace_context,
+    recorder,
+    span,
+    stamp_trace_context,
+    start_span,
+    traced,
+    tracer,
+)

@@ -145,6 +145,14 @@ OVERQUOTA_PODS = _r.gauge(
     ("quota",),
 )
 
+# --- distributed tracing (nos_tpu/obs) --------------------------------
+TRACE_SPANS = _r.counter(
+    "nos_trace_spans_total",
+    "Tracing spans completed in this process, by control-plane component "
+    "(scheduler | quota | partitioner | lifecycle | tpuagent | chaos).",
+    ("component",),
+)
+
 # --- utilization (north-star) ----------------------------------------
 CHIPS_ALLOCATABLE = _r.gauge(
     "nos_tpu_chips_allocatable",

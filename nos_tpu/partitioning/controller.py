@@ -22,6 +22,7 @@ from nos_tpu.kube.apiserver import NotFound
 from nos_tpu.kube.client import Client
 from nos_tpu.kube.controller import Controller, Request, Result, Watch
 from nos_tpu.kube.objects import Node, ObjectMeta, Pod
+from nos_tpu.obs import tracing as trace
 from nos_tpu.partitioning.actuator import Actuator
 from nos_tpu.partitioning.planner import Planner
 from nos_tpu.partitioning.snapshot import ClusterSnapshot
@@ -191,10 +192,24 @@ class PartitioningController:
     def _process(self, client: Client, pending: List[Pod]) -> None:
         started = self.clock()
         obs.PLAN_BATCH_SIZE.observe(len(pending))
-        snapshot = self.snapshot_taker.take(self.state)
-        plan = self.planner.plan(snapshot, pending)
-        current = self._current_partitioning()
-        if self.actuator.apply(client, current, plan):
+        # join the journey trace of the first pending pod that carries a
+        # context (stamped by the scheduler at quota admission): the
+        # partitioning that unblocks a pod shows up IN that pod's trace
+        parent = next(
+            (ctx for ctx in (trace.pod_trace_context(p) for p in pending)
+             if ctx is not None), None)
+        with trace.span("partitioner.plan_pass", component="partitioner",
+                        parent=parent,
+                        attrs={"pending_pods": len(pending)}) as pp:
+            with trace.span("partitioner.plan", component="partitioner"):
+                snapshot = self.snapshot_taker.take(self.state)
+                plan = self.planner.plan(snapshot, pending)
+            current = self._current_partitioning()
+            with trace.span("partitioner.actuate", component="partitioner",
+                            attrs={"plan": plan.id}):
+                actuated = self.actuator.apply(client, current, plan)
+            pp.set_attr("outcome", "actuated" if actuated else "noop")
+        if actuated:
             obs.PLANS_TOTAL.labels("actuated").inc()
             logger.info(
                 "partitioner: actuated plan %s for %d pending pods",
@@ -202,7 +217,8 @@ class PartitioningController:
             )
         else:
             obs.PLANS_TOTAL.labels("noop").inc()
-        obs.PLAN_DURATION.observe(self.clock() - started)
+        obs.PLAN_DURATION.observe(self.clock() - started,
+                                  trace_id=pp.trace_id or None)
         self._update_utilization_gauges()
 
     def _update_utilization_gauges(self) -> None:

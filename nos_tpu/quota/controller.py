@@ -25,6 +25,7 @@ from nos_tpu.kube.apiserver import NotFound, WatchEvent
 from nos_tpu.kube.client import Client
 from nos_tpu.kube.controller import Controller, Request, Result, Watch
 from nos_tpu.kube.objects import Pod, ResourceList
+from nos_tpu.obs import tracing as trace
 from nos_tpu.tpu.resource_calc import ResourceCalculator
 
 
@@ -149,17 +150,23 @@ class ElasticQuotaReconciler:
         return Result()
 
     def _reconcile_one(self, client: Client, eq) -> None:
-        pods = _running_pods(client, eq.metadata.namespace)
-        used, over = _compute_used_and_label(
-            client, self.calc, pods, eq.spec.min, eq.spec.max)
-        _export_quota_metrics(eq, used, over)
-        if used != eq.status.used:
-            client.patch(
-                "ElasticQuota",
-                eq.metadata.name,
-                eq.metadata.namespace,
-                lambda o: setattr(o.status, "used", used),
-            )
+        with trace.span(
+            "quota.reconcile", component="quota",
+            attrs={"quota": _quota_metric_name(eq.metadata.namespace,
+                                               eq.metadata.name)},
+        ) as sp:
+            pods = _running_pods(client, eq.metadata.namespace)
+            used, over = _compute_used_and_label(
+                client, self.calc, pods, eq.spec.min, eq.spec.max)
+            sp.set_attr("over_quota_pods", over)
+            _export_quota_metrics(eq, used, over)
+            if used != eq.status.used:
+                client.patch(
+                    "ElasticQuota",
+                    eq.metadata.name,
+                    eq.metadata.namespace,
+                    lambda o: setattr(o.status, "used", used),
+                )
 
     def controller(self) -> Controller:
         return Controller(

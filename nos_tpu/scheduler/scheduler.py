@@ -20,6 +20,7 @@ from nos_tpu.kube.apiserver import NotFound
 from nos_tpu.kube.client import Client
 from nos_tpu.kube.controller import Controller, Request, Result, Watch
 from nos_tpu.kube.objects import Pod, PodCondition, deep_copy
+from nos_tpu.obs import tracing as trace
 from nos_tpu.scheduler import framework as fw
 from nos_tpu.scheduler.cache import ClusterCache
 from nos_tpu.scheduler.capacity import CapacityScheduling
@@ -57,6 +58,12 @@ class Scheduler:
         self._batch_gen = -1
         self._retry_pending = False
         self._bound_in_attempt = 0
+        # pod-journey trace contexts awaiting their annotation stamp:
+        # (ns, name) -> encoded traceparent. Stamps ride the NEXT patch
+        # the scheduler was already making (bind / unschedulable mark /
+        # nomination), so cross-process trace propagation costs zero
+        # extra API writes on the hot path.
+        self._pending_stamp: dict = {}
 
     # ------------------------------------------------------------------
     def _sync_state(self, client: Client) -> fw.Snapshot:
@@ -172,29 +179,74 @@ class Scheduler:
         # this request's own pod is bound by then (reconcile honors
         # _retry_pending before the generation check)
         self._retry_pending = bool(result.requeue)
+        # stamps not applied by now referenced THIS pass's attempt spans;
+        # a later attempt roots (and stamps) a fresh journey, so dropping
+        # the leftovers keeps the map from accumulating deleted pods
+        self._pending_stamp.clear()
         return result
+
+    # -- pod-journey trace plumbing ------------------------------------
+    def _queue_stamp(self, pod: Pod, ctx) -> None:
+        """Remember that ``pod`` should be stamped with journey context
+        ``ctx`` on its next patch (no-op if it already carries one)."""
+        if ctx is None:
+            return
+        if pod.metadata.annotations.get(constants.ANNOTATION_TRACE_CONTEXT):
+            return
+        self._pending_stamp[
+            (pod.metadata.namespace, pod.metadata.name)] = ctx.encode()
+
+    def _apply_stamp(self, p: Pod) -> None:
+        """Fold a queued journey-context stamp into an in-flight patch.
+        Peek, don't pop: the REST adapters re-run the mutate callback on
+        a fresh object per Conflict retry, and a stamp consumed on the
+        first (lost) attempt would silently fragment the journey exactly
+        on the contended clusters tracing is meant to debug. The queue
+        entry is dropped via _stamp_landed once the patch returns."""
+        enc = self._pending_stamp.get(
+            (p.metadata.namespace, p.metadata.name))
+        if enc is not None:
+            p.metadata.annotations.setdefault(
+                constants.ANNOTATION_TRACE_CONTEXT, enc)
+
+    def _stamp_landed(self, pod: Pod) -> None:
+        self._pending_stamp.pop(
+            (pod.metadata.namespace, pod.metadata.name), None)
 
     def _schedule_one(self, client: Client, pod: Pod, snapshot: fw.Snapshot) -> Result:
         started = time.monotonic()
         # set by the bind paths: how many pods this attempt bound (a gang
         # attempt binds its whole membership in one _schedule_one call)
         self._bound_in_attempt = 0
-        try:
-            return self._schedule_one_inner(client, pod, snapshot)
-        except Exception:
-            obs.SCHEDULE_ATTEMPTS.labels("error").inc()
-            raise
-        finally:
-            elapsed = time.monotonic() - started
-            obs.SCHEDULE_DURATION.observe(elapsed)
-            # per-pod service time, gang attempts amortized over the pods
-            # they bound — the histogram bench_sched's scale_service_*
-            # percentiles read (failed attempts count as one sample: the
-            # work was still paid on behalf of that pod)
-            n = max(1, self._bound_in_attempt)
-            share = elapsed / n
-            for _ in range(n):
-                obs.SCHEDULE_SERVICE.observe(share)
+        # journey trace: parent on the context stamped at a previous
+        # admission (rebind after slice repair lands in the SAME trace);
+        # a first-touch pod roots a new trace here, and the attempt
+        # span's context becomes the journey context to stamp
+        parent = trace.pod_trace_context(pod)
+        with trace.span(
+            "scheduler.attempt", component="scheduler", parent=parent,
+            attrs={"pod": f"{pod.metadata.namespace}/{pod.metadata.name}"},
+        ) as sp:
+            if parent is None:
+                self._queue_stamp(pod, sp.context)
+            try:
+                return self._schedule_one_inner(client, pod, snapshot)
+            except Exception:
+                obs.SCHEDULE_ATTEMPTS.labels("error").inc()
+                raise
+            finally:
+                elapsed = time.monotonic() - started
+                tid = sp.trace_id or None
+                obs.SCHEDULE_DURATION.observe(elapsed, trace_id=tid)
+                # per-pod service time, gang attempts amortized over the
+                # pods they bound — the histogram bench_sched's
+                # scale_service_* percentiles read (failed attempts count
+                # as one sample: the work was still paid on behalf of
+                # that pod)
+                n = max(1, self._bound_in_attempt)
+                share = elapsed / n
+                for _ in range(n):
+                    obs.SCHEDULE_SERVICE.observe(share, trace_id=tid)
 
     def _schedule_one_inner(self, client: Client, pod: Pod, snapshot: fw.Snapshot) -> Result:
         if jobset_key(pod) is not None:
@@ -203,10 +255,20 @@ class Scheduler:
             return self._schedule_gang(client, pod, snapshot)
         state: fw.CycleState = {}
 
-        st = self.framework.run_pre_filter(state, pod, snapshot)
+        # the CapacityScheduling plugin's pre-filter IS quota admission
+        # for a single pod — span it under the quota component so the
+        # journey shows which phase said no
+        with trace.span("quota.admit", component="quota") as qsp:
+            st = self.framework.run_pre_filter(state, pod, snapshot)
+            if not st.success:
+                qsp.set_attr("rejected", st.reason)
         node_name: Optional[str] = None
         if st.success:
-            node_name, st = self._find_node(state, pod, snapshot)
+            with trace.span("scheduler.find_node",
+                            component="scheduler") as fsp:
+                node_name, st = self._find_node(state, pod, snapshot)
+                if node_name is not None:
+                    fsp.set_attr("node", node_name)
 
         if not st.success:
             return self._handle_unschedulable(client, pod, snapshot, state, st)
@@ -232,12 +294,16 @@ class Scheduler:
             p.status.conditions = [
                 c for c in p.status.conditions if c.type != "PodScheduled"
             ] + [PodCondition(type="PodScheduled", status="True")]
+            self._apply_stamp(p)
 
         # keep the shared sweep snapshot + cache truthful for later pods;
         # the cache gets the SERVER's returned object (fresh RV) so an
         # in-flight stale watch event cannot regress it
-        bound = client.patch("Pod", pod.metadata.name,
-                             pod.metadata.namespace, bind)
+        with trace.span("scheduler.bind", component="scheduler",
+                        attrs={"node": node_name}):
+            bound = client.patch("Pod", pod.metadata.name,
+                                 pod.metadata.namespace, bind)
+        self._stamp_landed(pod)
         snapshot[node_name].add_pod(bound)
         self.cache.upsert("Pod", bound)
         snapshot.remove_nominated(pod)
@@ -257,7 +323,21 @@ class Scheduler:
         if not pending:
             return Result()
 
-        admission = self.gang.admit(members)
+        # one journey trace per gang: every member is stamped with the
+        # attempt's context, so slice repair of ANY member later finds
+        # its way back to this same trace
+        cur = trace.current()
+        if cur is not None:
+            cur.set_attr("gang", f"{key.namespace}/{key.name}")
+            for p in pending:
+                self._queue_stamp(p, cur.context)
+
+        with trace.span("quota.admit", component="quota",
+                        attrs={"gang": f"{key.namespace}/{key.name}",
+                               "members": len(members)}) as qsp:
+            admission = self.gang.admit(members)
+            if not admission.ok:
+                qsp.set_attr("rejected", admission.reason)
         if not admission.ok:
             obs.SCHEDULE_ATTEMPTS.labels(
                 "gang_wait" if admission.waiting else "unschedulable"
@@ -269,7 +349,14 @@ class Scheduler:
         # place() receives the FULL gang: already-bound members (partial bind
         # from a crashed prior cycle) pin the domain and keep their hosts;
         # the returned placement covers only the unbound members
-        placement, why = self.gang.place(members, snapshot)
+        with trace.span("gang.place", component="scheduler",
+                        attrs={"gang": f"{key.namespace}/{key.name}"}) as psp:
+            placement, why = self.gang.place(members, snapshot)
+            if placement is not None:
+                psp.set_attr("domain", placement.domain.pool)
+                psp.set_attr("offset", str(placement.offset))
+            else:
+                psp.set_attr("rejected", why)
         if placement is None:
             obs.SCHEDULE_ATTEMPTS.labels("unschedulable").inc()
             for p in pending:
@@ -308,19 +395,23 @@ class Scheduler:
                 return False
             reserved.append((member, node_name))
 
-        for member, node_name in pairs:
-            def bind(p: Pod, n=node_name):
-                p.spec.node_name = n
-                p.status.nominated_node_name = ""
-                p.status.conditions = [
-                    c for c in p.status.conditions if c.type != "PodScheduled"
-                ] + [PodCondition(type="PodScheduled", status="True")]
+        with trace.span("scheduler.bind", component="scheduler",
+                        attrs={"pods": len(pairs)}):
+            for member, node_name in pairs:
+                def bind(p: Pod, n=node_name):
+                    p.spec.node_name = n
+                    p.status.nominated_node_name = ""
+                    p.status.conditions = [
+                        c for c in p.status.conditions if c.type != "PodScheduled"
+                    ] + [PodCondition(type="PodScheduled", status="True")]
+                    self._apply_stamp(p)
 
-            bound = client.patch("Pod", member.metadata.name,
-                                 member.metadata.namespace, bind)
-            snapshot[node_name].add_pod(bound)
-            self.cache.upsert("Pod", bound)
-            snapshot.remove_nominated(member)
+                bound = client.patch("Pod", member.metadata.name,
+                                     member.metadata.namespace, bind)
+                self._stamp_landed(member)
+                snapshot[node_name].add_pod(bound)
+                self.cache.upsert("Pod", bound)
+                snapshot.remove_nominated(member)
         self._bound_in_attempt = len(pairs)
         return True
 
@@ -341,7 +432,19 @@ class Scheduler:
         if not pending:
             return Result()
 
-        admission = self.gang.admit_jobset(slices)
+        # one journey trace per jobset, stamped across every slice's gang
+        cur = trace.current()
+        if cur is not None:
+            cur.set_attr("jobset", f"{key.namespace}/{key.name}")
+            for p in pending:
+                self._queue_stamp(p, cur.context)
+
+        with trace.span("quota.admit", component="quota",
+                        attrs={"jobset": f"{key.namespace}/{key.name}",
+                               "slices": len(slices)}) as qsp:
+            admission = self.gang.admit_jobset(slices)
+            if not admission.ok:
+                qsp.set_attr("rejected", admission.reason)
         if not admission.ok:
             obs.SCHEDULE_ATTEMPTS.labels(
                 "gang_wait" if admission.waiting else "unschedulable"
@@ -350,7 +453,14 @@ class Scheduler:
                 self._mark_unschedulable(client, p, admission.reason)
             return Result()
 
-        placements, why = self.gang.place_jobset(slices, snapshot)
+        with trace.span("jobset.place", component="scheduler",
+                        attrs={"jobset": f"{key.namespace}/{key.name}"}) as psp:
+            placements, why = self.gang.place_jobset(slices, snapshot)
+            if placements is not None:
+                psp.set_attr("domains",
+                             ",".join(pl.domain.pool for pl in placements))
+            else:
+                psp.set_attr("rejected", why)
         if placements is None:
             obs.SCHEDULE_ATTEMPTS.labels("unschedulable").inc()
             for p in pending:
@@ -411,9 +521,13 @@ class Scheduler:
         return self.framework.find_feasible(state, pod, snapshot)
 
     def _handle_unschedulable(self, client, pod, snapshot, state, st) -> Result:
-        nominated, post_st = self.framework.run_post_filter(state, pod, snapshot)
-        if post_st.success and nominated is not None:
+        with trace.span("scheduler.preempt", component="scheduler") as psp:
+            nominated, post_st = self.framework.run_post_filter(
+                state, pod, snapshot)
             victims = state.get("capacity/victims") or []
+            psp.set_attr("nominated", nominated or "")
+            psp.set_attr("victims", len(victims))
+        if post_st.success and nominated is not None:
             self._record_disruptions(client, victims)
             for v in victims:
                 try:
@@ -431,8 +545,10 @@ class Scheduler:
             obs.SCHEDULE_ATTEMPTS.labels("preempted_victims").inc()
             def nominate(p: Pod, n=nominated):
                 p.status.nominated_node_name = n
+                self._apply_stamp(p)
             marked = client.patch("Pod", pod.metadata.name,
                                   pod.metadata.namespace, nominate)
+            self._stamp_landed(pod)
             # later pods in this sweep must see the freed capacity as
             # spoken for by this pod — and any PREVIOUS nomination of this
             # pod must go, or it would phantom-reserve two nodes at once
@@ -449,8 +565,7 @@ class Scheduler:
         self._mark_unschedulable(client, pod, st.reason)
         return Result()
 
-    @staticmethod
-    def _mark_unschedulable(client: Client, pod: Pod, reason: str) -> None:
+    def _mark_unschedulable(self, client: Client, pod: Pod, reason: str) -> None:
         current = [
             c for c in pod.status.conditions
             if c.type == "PodScheduled" and c.status == "False"
@@ -470,8 +585,10 @@ class Scheduler:
                     message=reason,
                 )
             ]
+            self._apply_stamp(p)
 
         client.patch("Pod", pod.metadata.name, pod.metadata.namespace, mark)
+        self._stamp_landed(pod)
 
     # ------------------------------------------------------------------
     def controller(self) -> Controller:

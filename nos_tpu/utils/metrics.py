@@ -16,6 +16,7 @@ managed collection can scrape the binaries unchanged.
 from __future__ import annotations
 
 import threading
+from time import time as _now
 from typing import Dict, Iterable, List, Optional, Sequence, Tuple
 
 
@@ -103,19 +104,32 @@ class _Metric:
     def _new_child(self):  # pragma: no cover - overridden
         raise NotImplementedError
 
+    def _family_name(self, openmetrics: bool) -> str:
+        """The metric-family name for HELP/TYPE lines. OpenMetrics names
+        counter families WITHOUT the ``_total`` suffix (the sample keeps
+        it): a strict parser (Prometheus's openmetrics-text reader)
+        rejects `# TYPE foo_total counter` followed by a `foo_total`
+        sample, which would make the exemplar scrape path unusable."""
+        if openmetrics and self.kind == "counter" \
+                and self.name.endswith("_total"):
+            return self.name[:-len("_total")]
+        return self.name
+
     # -- exposition ------------------------------------------------------
-    def collect(self) -> List[str]:
+    def collect(self, openmetrics: bool = False) -> List[str]:
+        family = self._family_name(openmetrics)
         lines = [
-            f"# HELP {self.name} {_escape_help(self.help)}",
-            f"# TYPE {self.name} {self.kind}",
+            f"# HELP {family} {_escape_help(self.help)}",
+            f"# TYPE {family} {self.kind}",
         ]
         with self._lock:
             children = list(self._children.items())
         for values, child in children:
-            lines.extend(self._render_child(values, child))
+            lines.extend(self._render_child(values, child, openmetrics))
         return lines
 
-    def _render_child(self, values, child) -> List[str]:  # pragma: no cover
+    def _render_child(self, values, child,
+                      openmetrics: bool = False) -> List[str]:  # pragma: no cover
         raise NotImplementedError
 
 
@@ -151,7 +165,7 @@ class Counter(_Metric):
         with self._lock:
             return sum(child.value for child in self._children.values())
 
-    def _render_child(self, values, child):
+    def _render_child(self, values, child, openmetrics: bool = False):
         return [f"{self.name}{_label_str(self.labelnames, values)} "
                 f"{_format_value(child.value)}"]
 
@@ -193,7 +207,7 @@ class Gauge(_Metric):
     def value(self, *label_values) -> float:
         return self.labels(*label_values).value
 
-    def _render_child(self, values, child):
+    def _render_child(self, values, child, openmetrics: bool = False):
         return [f"{self.name}{_label_str(self.labelnames, values)} "
                 f"{_format_value(child.value)}"]
 
@@ -211,7 +225,8 @@ MAX_HISTOGRAM_SAMPLES = 1_000_000
 
 
 class _HistogramChild:
-    __slots__ = ("buckets", "counts", "total", "count", "samples", "_lock")
+    __slots__ = ("buckets", "counts", "total", "count", "samples",
+                 "exemplars", "_lock")
 
     def __init__(self, buckets: Tuple[float, ...],
                  track_samples: bool = False):
@@ -225,19 +240,30 @@ class _HistogramChild:
         # re-deriving timings. Off by default: a long-lived process must
         # not grow a million-float list per hot histogram nobody reads.
         self.samples: Optional[List[float]] = [] if track_samples else None
+        # OpenMetrics exemplars: per bucket (+Inf last), the most recent
+        # (trace_id, value, unix_ts) observed with a trace attached.
+        # Lazily allocated — histograms nobody traces pay nothing.
+        self.exemplars: Optional[List[Optional[Tuple[str, float, float]]]] \
+            = None
         self._lock = threading.Lock()
 
-    def observe(self, v: float) -> None:
+    def observe(self, v: float, trace_id: Optional[str] = None) -> None:
         with self._lock:
             self.total += v
             self.count += 1
             if self.samples is not None \
                     and len(self.samples) < MAX_HISTOGRAM_SAMPLES:
                 self.samples.append(v)
+            matched = len(self.buckets)          # +Inf slot
             for i, ub in enumerate(self.buckets):
                 if v <= ub:
                     self.counts[i] += 1
+                    matched = i
                     break
+            if trace_id:
+                if self.exemplars is None:
+                    self.exemplars = [None] * (len(self.buckets) + 1)
+                self.exemplars[matched] = (trace_id, v, _now())
 
 
 class Histogram(_Metric):
@@ -271,8 +297,12 @@ class Histogram(_Metric):
                 if child.samples is None:
                     child.samples = []
 
-    def observe(self, v: float) -> None:
-        self._unlabeled().observe(v)
+    def observe(self, v: float, trace_id: Optional[str] = None) -> None:
+        """Record one observation; ``trace_id`` (when the caller has an
+        active tracing span) attaches an OpenMetrics exemplar to the
+        matched bucket so a slow histogram observation links to the
+        concrete trace that produced it."""
+        self._unlabeled().observe(v, trace_id)
 
     def observations(self, *label_values) -> Tuple[int, float]:
         """(count, sum) of everything observed into this child — the
@@ -311,19 +341,35 @@ class Histogram(_Metric):
         rank = min(len(window), max(1, math.ceil(q * len(window))))
         return window[rank - 1]
 
-    def _render_child(self, values, child):
+    @staticmethod
+    def _exemplar_suffix(child, i: int, openmetrics: bool) -> str:
+        """OpenMetrics exemplar for bucket ``i``: `` # {trace_id="..."}
+        value timestamp``. Classic text format has no exemplar syntax, so
+        the suffix is only rendered for OpenMetrics scrapes."""
+        if not openmetrics or child.exemplars is None:
+            return ""
+        ex = child.exemplars[i]
+        if ex is None:
+            return ""
+        trace_id, value, ts = ex
+        return (f' # {{trace_id="{_escape_label(trace_id)}"}} '
+                f"{_format_value(value)} {ts:.3f}")
+
+    def _render_child(self, values, child, openmetrics: bool = False):
         lines = []
         cumulative = 0
-        for ub, c in zip(child.buckets, child.counts):
+        for i, (ub, c) in enumerate(zip(child.buckets, child.counts)):
             cumulative += c
             lines.append(
                 f"{self.name}_bucket"
                 f"{_label_str(self.labelnames, values, [('le', _format_value(ub))])}"
-                f" {cumulative}")
+                f" {cumulative}"
+                f"{self._exemplar_suffix(child, i, openmetrics)}")
         lines.append(
             f"{self.name}_bucket"
             f"{_label_str(self.labelnames, values, [('le', '+Inf')])}"
-            f" {child.count}")
+            f" {child.count}"
+            f"{self._exemplar_suffix(child, len(child.buckets), openmetrics)}")
         base = _label_str(self.labelnames, values)
         lines.append(f"{self.name}_sum{base} {_format_value(child.total)}")
         lines.append(f"{self.name}_count{base} {child.count}")
@@ -374,12 +420,18 @@ class Registry:
         with self._lock:
             return self._metrics.get(name)
 
-    def expose(self) -> str:
+    def expose(self, openmetrics: bool = False) -> str:
+        """Text exposition. ``openmetrics=True`` renders the OpenMetrics
+        dialect: histogram buckets carry exemplars (`` # {trace_id=...}
+        value ts``) and the body ends with ``# EOF`` — served when a
+        scraper sends ``Accept: application/openmetrics-text``."""
         with self._lock:
             metrics = sorted(self._metrics.values(), key=lambda m: m.name)
         out: List[str] = []
         for m in metrics:
-            out.extend(m.collect())
+            out.extend(m.collect(openmetrics))
+        if openmetrics:
+            out.append("# EOF")
         return "\n".join(out) + ("\n" if out else "")
 
     def reset(self) -> None:

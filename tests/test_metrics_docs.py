@@ -1,0 +1,63 @@
+"""Metrics <-> docs drift guard (ISSUE 3 satellite).
+
+The `docs/telemetry.md` table is only useful if it is trustworthy: every
+metric registered anywhere in `nos_tpu/` must appear in the table, and
+every `nos_*` name in the table must correspond to a registration. The
+scan is textual (regex over registration calls), so metrics registered
+lazily inside functions (cmd/server.py, cmd/trainer.py) are covered
+without importing JAX-heavy modules.
+"""
+import os
+import re
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# `<registry>.counter("nos_...")` / `.gauge(` / `.histogram(` with the
+# name literal on the same or next line
+REGISTRATION = re.compile(
+    r'\.(?:counter|gauge|histogram)\(\s*"(nos_[a-z0-9_]+)"')
+DOC_NAME = re.compile(r"nos_[a-z0-9_]+")
+
+
+def registered_metric_names():
+    names = set()
+    for dirpath, _dirnames, filenames in os.walk(
+            os.path.join(REPO, "nos_tpu")):
+        if "__pycache__" in dirpath:
+            continue
+        for fn in filenames:
+            if not fn.endswith(".py"):
+                continue
+            with open(os.path.join(dirpath, fn)) as f:
+                names.update(REGISTRATION.findall(f.read()))
+    return names
+
+
+def documented_metric_names():
+    names = set()
+    with open(os.path.join(REPO, "docs", "telemetry.md")) as f:
+        for line in f:
+            if line.strip().startswith("|"):
+                names.update(DOC_NAME.findall(line))
+    # histogram rows may cite the _bucket/_sum/_count series; normalize
+    return {re.sub(r"_(bucket|sum|count)$", "", n) for n in names}
+
+
+def test_every_registered_metric_is_documented():
+    code = registered_metric_names()
+    assert code, "scan must find the registered metrics"
+    doc = documented_metric_names()
+    missing = sorted(code - doc)
+    assert not missing, (
+        f"metrics registered but missing from docs/telemetry.md: {missing} "
+        f"— add a table row for each")
+
+
+def test_every_documented_metric_is_registered():
+    doc = documented_metric_names()
+    assert doc, "telemetry.md table must not be empty"
+    code = registered_metric_names()
+    stale = sorted(doc - code)
+    assert not stale, (
+        f"docs/telemetry.md documents metrics no code registers: {stale} "
+        f"— remove the rows or restore the metrics")

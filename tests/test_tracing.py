@@ -1,0 +1,341 @@
+"""Tracing core: spans, propagation, flight recorder, exporter, exemplars.
+
+Covers the ISSUE 3 test checklist for `nos_tpu/obs/`:
+- span parenting (context-local and explicit) and attrs/events/status;
+- ring-buffer eviction order and slow/error-trace pinning;
+- trace-context annotation round-trip through the k8s codec;
+- OpenMetrics exemplar rendering validity
+  (``# {trace_id="..."} value timestamp``);
+- Perfetto/Chrome trace-event export structure.
+"""
+import json
+import re
+
+import pytest
+
+from nos_tpu import constants
+from nos_tpu.kube.k8s_codec import pod_from_k8s, pod_to_k8s
+from nos_tpu.kube.objects import ObjectMeta, Pod
+from nos_tpu.obs import trace_export, tracing
+from nos_tpu.obs.tracing import FlightRecorder, SpanContext, Tracer
+from nos_tpu.utils.metrics import Registry
+
+
+def make_tracer(**kw):
+    rec = FlightRecorder(**kw.pop("recorder_kw", {}))
+    return Tracer(recorder=rec, **kw), rec
+
+
+# ---------------------------------------------------------------------------
+# Span basics & parenting
+# ---------------------------------------------------------------------------
+
+def test_span_parenting_context_local():
+    tr, rec = make_tracer()
+    with tr.span("parent", component="a") as p:
+        with tr.span("child", component="b") as c:
+            assert c.trace_id == p.trace_id
+            assert c.parent_id == p.span_id
+        # context restored: a sibling parents on the same parent
+        with tr.span("sibling", component="b") as s:
+            assert s.parent_id == p.span_id
+    assert p.parent_id is None
+    spans = rec.trace(p.trace_id)
+    assert sorted(sp.name for sp in spans) == ["child", "parent", "sibling"]
+
+
+def test_span_explicit_parent_and_attrs_events():
+    tr, rec = make_tracer()
+    root = tr.start_span("root", component="x", attrs={"k": "v"})
+    root.add_event("thing-happened", detail=1)
+    root.set_attr("k2", 2)
+    root.end()
+    child = tr.start_span("child", component="y", parent=root.context)
+    child.end()
+    assert child.trace_id == root.trace_id
+    assert child.parent_id == root.span_id
+    d = root.to_dict()
+    assert d["attrs"] == {"k": "v", "k2": 2}
+    assert d["events"][0]["name"] == "thing-happened"
+    assert d["status"] == "ok"
+    assert d["duration_s"] >= 0
+
+
+def test_span_end_idempotent_and_error_status():
+    tr, rec = make_tracer()
+    sp = tr.start_span("s", component="x")
+    sp.end(10.0)
+    first = sp.end_time
+    sp.end(99.0)    # second end must not move the stamp or re-record
+    assert sp.end_time == first
+    assert len(rec.trace(sp.trace_id)) == 1
+
+    with pytest.raises(ValueError):
+        with tr.span("boom", component="x") as esp:
+            raise ValueError("nope")
+    assert esp.status == "error"
+    assert "nope" in esp.status_message
+
+
+def test_explicit_timestamps_simulated_clock():
+    tr, _ = make_tracer()
+    sp = tr.start_span("sim", component="x", start_time=1000.0)
+    sp.end(1002.5)
+    assert sp.duration == pytest.approx(2.5)
+
+
+def test_disabled_and_sampled_out_are_noop():
+    tr, rec = make_tracer(enabled=False)
+    with tr.span("off", component="x") as sp:
+        assert not sp.recording
+        assert sp.context is None
+    assert rec.trace_ids() == []
+
+    tr2, rec2 = make_tracer(sampling=0.0)
+    with tr2.span("root", component="x") as root:
+        assert not root.recording
+        # children of an unsampled root inherit the decision — they must
+        # NOT re-roll sampling as fresh roots
+        with tr2.span("child", component="x") as child:
+            assert not child.recording
+    assert rec2.trace_ids() == []
+
+
+def test_decorator_parents_on_current():
+    tr, rec = make_tracer()
+
+    calls = []
+
+    @tracing.traced("decorated", component="z")
+    def fn():
+        calls.append(tracing.current())
+
+    # route the module-level decorator through a scoped tracer
+    old = tracing._default_tracer.recorder
+    tracing._default_tracer.recorder = rec
+    try:
+        fn()
+    finally:
+        tracing._default_tracer.recorder = old
+    assert calls[0] is not None and calls[0].name == "decorated"
+
+
+# ---------------------------------------------------------------------------
+# W3C context encoding + pod-annotation round-trip
+# ---------------------------------------------------------------------------
+
+def test_traceparent_encode_decode_roundtrip():
+    ctx = SpanContext(trace_id="ab" * 16, span_id="cd" * 8)
+    enc = ctx.encode()
+    assert re.fullmatch(r"00-[0-9a-f]{32}-[0-9a-f]{16}-01", enc)
+    assert SpanContext.decode(enc) == ctx
+
+
+@pytest.mark.parametrize("bad", [
+    None, "", "garbage", "00-short-cd-01", "00-" + "g" * 32 + "-" + "cd" * 8 + "-01",
+    "00-" + "ab" * 16 + "-" + "cd" * 8,   # 3 parts
+])
+def test_traceparent_decode_tolerates_malformed(bad):
+    assert SpanContext.decode(bad) is None
+
+
+def test_annotation_roundtrip_through_k8s_codec():
+    tr, _ = make_tracer()
+    sp = tr.start_span("journey", component="scheduler")
+    pod = Pod(metadata=ObjectMeta(name="p", namespace="ns"))
+    tracing.stamp_trace_context(pod, sp.context)
+    wire = pod_to_k8s(pod)
+    # the annotation survives serialization to real-k8s JSON and back
+    assert wire["metadata"]["annotations"][
+        constants.ANNOTATION_TRACE_CONTEXT] == sp.context.encode()
+    back = pod_from_k8s(json.loads(json.dumps(wire)))
+    ctx = tracing.pod_trace_context(back)
+    assert ctx == sp.context
+    # stamp is setdefault: a second stamp must not overwrite the journey
+    other = tr.start_span("other", component="scheduler")
+    tracing.stamp_trace_context(back, other.context)
+    assert tracing.pod_trace_context(back) == sp.context
+
+
+# ---------------------------------------------------------------------------
+# Flight recorder
+# ---------------------------------------------------------------------------
+
+def _span_in(tr, trace_i, dur=0.0, status="ok"):
+    sp = tr.start_span(f"s{trace_i}", component="t", start_time=float(trace_i))
+    if status == "error":
+        sp.set_error("x")
+    sp.end(float(trace_i) + dur)
+    return sp
+
+
+def test_recorder_evicts_oldest_first():
+    tr, rec = make_tracer(recorder_kw=dict(max_traces=3,
+                                           slow_threshold_s=1e9))
+    spans = [_span_in(tr, i) for i in range(5)]
+    kept = rec.trace_ids()
+    assert len(kept) == 3
+    # traces 0 and 1 (oldest by last-touch) evicted, in order
+    assert kept == [spans[2].trace_id, spans[3].trace_id, spans[4].trace_id]
+    assert rec.to_json()["evicted_traces"] == 2
+
+
+def test_recorder_recency_is_last_touch_not_creation():
+    tr, rec = make_tracer(recorder_kw=dict(max_traces=2,
+                                           slow_threshold_s=1e9))
+    a = _span_in(tr, 0)
+    b = _span_in(tr, 1)
+    # touch trace a again: a new span in the same trace refreshes it
+    extra = tr.start_span("again", component="t", parent=a.context,
+                          start_time=5.0)
+    extra.end(5.0)
+    _span_in(tr, 2)    # evicts b (now the oldest), not a
+    kept = set(rec.trace_ids())
+    assert a.trace_id in kept and b.trace_id not in kept
+
+
+def test_recorder_pins_slow_and_error_traces():
+    tr, rec = make_tracer(recorder_kw=dict(max_traces=2,
+                                           slow_threshold_s=1.0))
+    slow = _span_in(tr, 0, dur=2.0)           # pinned: slow
+    err = _span_in(tr, 1, status="error")     # pinned: error
+    for i in range(2, 8):
+        _span_in(tr, i)
+    kept = set(rec.trace_ids())
+    assert slow.trace_id in kept, "slow trace must survive ring churn"
+    assert err.trace_id in kept, "error trace must survive ring churn"
+    assert rec.pinned()[slow.trace_id] == "slow"
+    assert rec.pinned()[err.trace_id] == "error"
+
+
+def test_recorder_pinned_set_bounded():
+    tr, rec = make_tracer(recorder_kw=dict(max_traces=3, max_pinned=2,
+                                           slow_threshold_s=1.0))
+    pins = [_span_in(tr, i, dur=5.0) for i in range(4)]
+    assert len(rec.pinned()) == 2
+    # oldest pins demoted FIFO
+    assert set(rec.pinned()) == {pins[2].trace_id, pins[3].trace_id}
+
+
+def test_recorder_caps_spans_per_trace():
+    tr, rec = make_tracer(recorder_kw=dict(max_spans_per_trace=3))
+    root = tr.start_span("root", component="t", start_time=0.0)
+    root.end(0.0)
+    for i in range(5):
+        c = tr.start_span(f"c{i}", component="t", parent=root.context,
+                          start_time=float(i))
+        c.end(float(i))
+    assert len(rec.trace(root.trace_id)) == 3
+    assert rec.to_json()["dropped_spans"] == 3
+
+
+def test_debug_traces_json_shape():
+    tr, rec = make_tracer()
+    with tr.span("a", component="quota"):
+        with tr.span("b", component="scheduler"):
+            pass
+    doc = rec.to_json()
+    assert doc["trace_count"] == 1
+    t = doc["traces"][0]
+    assert t["components"] == ["quota", "scheduler"]
+    names = {s["name"] for s in t["spans"]}
+    assert names == {"a", "b"}
+    json.dumps(doc)    # must be JSON-serializable as-is
+
+
+# ---------------------------------------------------------------------------
+# Perfetto / Chrome trace-event export
+# ---------------------------------------------------------------------------
+
+def test_chrome_trace_export_structure(tmp_path):
+    tr, rec = make_tracer()
+    root = tr.start_span("scheduler.attempt", component="scheduler",
+                         start_time=100.0)
+    root.add_event("milestone", ts=100.5, detail="x")
+    root.end(101.0)
+    child = tr.start_span("quota.admit", component="quota",
+                          parent=root.context, start_time=100.1)
+    child.end(100.2)
+    open_span = tr.start_span("never-ends", component="quota")  # skipped
+
+    path = str(tmp_path / "out.trace.json")
+    trace_export.export_chrome_trace(rec.spans(), path)
+    doc = json.load(open(path))
+    evs = doc["traceEvents"]
+    xs = [e for e in evs if e["ph"] == "X"]
+    assert len(xs) == 2        # the open span is not drawn
+    by_name = {e["name"]: e for e in xs}
+    # timestamps rebased to the earliest span, microseconds
+    assert by_name["scheduler.attempt"]["ts"] == 0.0
+    assert by_name["scheduler.attempt"]["dur"] == pytest.approx(1e6)
+    assert by_name["quota.admit"]["ts"] == pytest.approx(0.1e6)
+    # one process row per component, named via metadata events
+    meta = {e["args"]["name"] for e in evs if e["ph"] == "M"}
+    assert meta == {"scheduler", "quota"}
+    # span identity rides args so Perfetto search finds trace ids
+    assert by_name["quota.admit"]["args"]["trace_id"] == root.trace_id
+    assert by_name["quota.admit"]["args"]["parent_id"] == root.span_id
+    inst = [e for e in evs if e["ph"] == "i"]
+    assert inst and inst[0]["name"] == "milestone"
+
+
+# ---------------------------------------------------------------------------
+# OpenMetrics exemplars
+# ---------------------------------------------------------------------------
+
+def test_histogram_exemplar_rendering_openmetrics_only():
+    reg = Registry()
+    h = reg.histogram("t_seconds", "help", buckets=(0.1, 1.0))
+    h.observe(0.05, trace_id="a" * 32)
+    h.observe(0.5)                          # no exemplar on this bucket
+    h.observe(5.0, trace_id="b" * 32)       # lands in +Inf
+
+    classic = reg.expose()
+    assert "#" not in classic.replace("# HELP", "").replace("# TYPE", ""), \
+        "classic text format must not carry exemplar syntax"
+
+    om = reg.expose(openmetrics=True)
+    assert om.rstrip().endswith("# EOF")
+    # OpenMetrics exemplar syntax: `# {labels} value timestamp`
+    pat = re.compile(
+        r'^t_seconds_bucket\{le="0.1"\} 1 '
+        r'# \{trace_id="a{32}"\} 0\.05 \d+\.\d+$', re.M)
+    assert pat.search(om), om
+    inf = re.compile(
+        r'^t_seconds_bucket\{le="\+Inf"\} 3 '
+        r'# \{trace_id="b{32}"\} 5 \d+\.\d+$', re.M)
+    assert inf.search(om), om
+    # the un-exemplared bucket renders plain in both dialects
+    assert re.search(r'^t_seconds_bucket\{le="1"\} 2$', om, re.M)
+
+
+def test_histogram_exemplar_keeps_latest_per_bucket():
+    reg = Registry()
+    h = reg.histogram("u_seconds", "help", buckets=(1.0,))
+    h.observe(0.2, trace_id="1" * 32)
+    h.observe(0.3, trace_id="2" * 32)
+    om = reg.expose(openmetrics=True)
+    assert 'trace_id="2' in om and 'trace_id="1' not in om
+
+
+def test_exemplars_free_when_unused():
+    reg = Registry()
+    h = reg.histogram("v_seconds", "help", buckets=(1.0,))
+    h.observe(0.2)
+    assert h.labels().exemplars is None, \
+        "no exemplar storage allocated unless a trace_id is observed"
+
+
+def test_openmetrics_counter_family_drops_total_suffix():
+    reg = Registry()
+    c = reg.counter("w_things_total", "help")
+    c.inc(3)
+    om = reg.expose(openmetrics=True)
+    # OpenMetrics: the FAMILY is named without _total, the sample with it
+    assert "# TYPE w_things counter" in om
+    assert "# HELP w_things help" in om
+    assert "# TYPE w_things_total" not in om
+    assert re.search(r"^w_things_total 3$", om, re.M)
+    # classic text format keeps the registered name everywhere
+    classic = reg.expose()
+    assert "# TYPE w_things_total counter" in classic

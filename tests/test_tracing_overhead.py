@@ -1,0 +1,59 @@
+"""Tracing overhead guard (ISSUE 3 satellite, slow-marked).
+
+Tracing at default sampling must not eat the PR 1 latency win: enabling
+it may move `scale_service` p99 in the bench_sched scale scenario by
+less than 5% vs. tracing disabled.
+
+Methodology: a single run's p99 rests on ~3 samples of a 312-pod burst
+and swings ~10% with host noise — far more than the effect under test.
+So the configurations are INTERLEAVED (off, on, off, on, …) to cancel
+machine drift, the raw per-pod service samples of each side's reps are
+POOLED (the scheduler's own nos_scheduler_service_seconds buffer), and
+one p99 per configuration is computed over its pooled ~1500 samples.
+"""
+import math
+
+import pytest
+
+from nos_tpu import observability as obs
+from nos_tpu.obs import tracing
+
+
+def _p99(samples):
+    xs = sorted(samples)
+    return xs[min(len(xs) - 1, max(0, math.ceil(0.99 * len(xs)) - 1))]
+
+
+@pytest.mark.slow
+def test_tracing_overhead_under_5_percent_on_service_p99():
+    import bench_sched
+
+    hist = obs.SCHEDULE_SERVICE
+    hist.enable_sample_tracking()
+
+    def one_rep():
+        mark = hist.num_samples()
+        out = bench_sched.run_scale(pools=8, gangs=6, singles=120,
+                                    prefix="ovh")
+        assert out["ovh_unbound_pods"] == 0
+        return hist.labels().samples[mark:]
+
+    tracer = tracing.tracer()
+    was_enabled = tracer.enabled
+    off, on = [], []
+    try:
+        one_rep()                      # warm-up rep, discarded
+        for _ in range(5):
+            tracer.enabled = False
+            off.extend(one_rep())
+            tracer.enabled = True
+            on.extend(one_rep())
+    finally:
+        tracer.enabled = was_enabled
+
+    off_p99, on_p99 = _p99(off) * 1e3, _p99(on) * 1e3
+    overhead = (on_p99 - off_p99) / off_p99
+    assert overhead < 0.05, (
+        f"tracing overhead {overhead:.1%} on pooled service p99 "
+        f"(off={off_p99:.3f}ms over {len(off)} samples, "
+        f"on={on_p99:.3f}ms over {len(on)} samples) — must stay under 5%")
