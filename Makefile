@@ -45,7 +45,7 @@ bench-decode:  ## KV-cache decode throughput, bf16 and int8.
 	$(PYTHON) bench_decode.py
 
 .PHONY: bench-serve
-bench-serve:  ## Continuous-batching serving throughput.
+bench-serve:  ## Continuous-batching serving throughput + pipelined-dispatch economics (artifact in bench_logs/bench_serve.json).
 	$(PYTHON) bench_serve.py
 
 .PHONY: bench-infer

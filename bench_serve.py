@@ -1,14 +1,18 @@
 #!/usr/bin/env python3
 """Serving-throughput bench: the continuous-batching engine
 (models/serving.py) at the flagship shape — sustained decode tokens/s
-with all slots busy, and request latency at mixed prompt lengths.
+with all slots busy, request latency at mixed prompt lengths, and the
+pipelined-dispatch economics (host-blocked time per token at
+pipeline_depth 1 vs >= 2, fused decode_steps).
 
 The interesting comparison is against single-request decode
 (bench_decode.py): continuous batching amortizes the per-tick weight
 read over max_batch requests, so engine tokens/s should approach
 batch-B decode tokens/s while serving independent requests. Timing
-fence: results are host-side by construction (the engine syncs one
-array per tick). Prints one JSON line.
+fence: results are host-side by construction (the engine syncs token
+arrays per arrival). Writes ``bench_logs/bench_serve.json`` FIRST (the
+artifact of record — the driver's tail buffer has truncated stdout
+before), then prints the same JSON line.
 """
 import json
 import sys
@@ -23,6 +27,9 @@ from bench import MODEL, smoke_overrides  # noqa: E402
 MAX_BATCH = 8
 PROMPT_LENS = [64, 128, 256, 96, 64, 192, 128, 80]
 NEW_TOKENS = 64
+PIPELINE_DEPTHS = [1, 2, 4]
+FUSED_STEPS = 4
+OUT_PATH = os.path.join("bench_logs", "bench_serve.json")
 
 # NOS_TPU_BENCH_SMOKE=1: tiny-shape dry run of the exact code path (see
 # bench_decode.py) — hardware runs must never be the first execution
@@ -30,6 +37,21 @@ SMOKE = os.environ.get("NOS_TPU_BENCH_SMOKE") == "1"
 if SMOKE:
     MODEL = smoke_overrides(MODEL)
     MAX_BATCH, PROMPT_LENS, NEW_TOKENS = 2, [16, 24, 16], 6
+
+# pipelined-dispatch section: all slots busy, decode-bound — the
+# workload the in-flight window and fused decode_steps exist for. In
+# smoke mode this section uses a MID shape, not smoke_overrides: the
+# shared smoke model's per-tick decode compute is below the fetch-sync
+# measurement floor (~20us), so depth-1 vs depth-2 host-blocked time
+# would compare noise with noise. The mid shape keeps per-tick compute
+# comparable to per-tick host work — the regime where pipelining is
+# decidable — while still finishing in seconds on CPU.
+PIPE_MODEL = MODEL
+PIPE_BATCH, PIPE_PROMPT, PIPE_NEW = 8, 128, 48
+if SMOKE:
+    PIPE_MODEL = dict(MODEL, d_model=256, n_layers=4, n_heads=4,
+                      n_kv_heads=2, d_ff=1024, vocab=512)
+    PIPE_BATCH, PIPE_PROMPT, PIPE_NEW = 8, 48, 24
 
 
 def main():
@@ -92,11 +114,71 @@ def main():
     t_submit_pc = time.perf_counter() - t0
     srv_pc.drain()
 
+    # ------------------------------------------------------------------
+    # pipelined dispatch economics: an all-slots-busy decode-bound
+    # workload at each pipeline depth, reading the engine's own
+    # accounting. The headline is dispatch_gap_s — wall time the engine
+    # had NO decode tick in flight while decodable slots existed, i.e.
+    # the accelerator host-blocked behind bookkeeping. At depth 1 every
+    # tick pays the consume->redispatch gap; at depth >= 2 the window
+    # only empties at barriers, so the gap drops by construction (the
+    # structural claim, robust to machine noise). host_block_s
+    # (dispatch calls + token fetches) is reported alongside as
+    # sync_path_s. Two reps per depth, best taken, so a GC pause can't
+    # flip the comparison the acceptance gate reads.
+    pipe_cfg = tr.TransformerConfig(**PIPE_MODEL)
+    pipe_params = params if PIPE_MODEL == MODEL \
+        else tr.init_params(jax.random.PRNGKey(2), pipe_cfg)
+    pipe_prompts = [
+        [int(x) for x in host_rng.integers(0, pipe_cfg.vocab, PIPE_PROMPT)]
+        for _ in range(PIPE_BATCH)]
+    pipe_max_len = PIPE_PROMPT + PIPE_NEW + 8
+
+    def pipeline_rep(depth, steps=1):
+        eng = DecodeServer(pipe_params, pipe_cfg, max_batch=PIPE_BATCH,
+                           max_len=pipe_max_len, pipeline_depth=depth,
+                           decode_steps=steps)
+        for toks in pipe_prompts:                        # warm compiles
+            eng.submit(toks, 2)
+        eng.drain()
+        best = None
+        for _ in range(2):
+            for toks in pipe_prompts:
+                eng.submit(toks, PIPE_NEW)
+            eng.reset_dispatch_stats()      # timing fence: decode only
+            t0 = time.perf_counter()
+            done = eng.drain()
+            wall = time.perf_counter() - t0
+            assert len(done) == len(pipe_prompts)
+            new = len(pipe_prompts) * (PIPE_NEW - 1)
+            rep = {
+                "pipeline_depth": depth,
+                "decode_steps": steps,
+                "decode_s": round(wall, 4),
+                "decode_tokens_per_s": round(new / wall),
+                "ticks": eng.ticks_dispatched,
+                "dispatch_gap_s": round(eng.dispatch_gap_s, 4),
+                "host_blocked_us_per_token": round(
+                    1e6 * eng.dispatch_gap_s / new, 1),
+                "host_overhead_pct": round(
+                    100.0 * eng.dispatch_gap_s / wall, 1),
+                "sync_path_s": round(eng.host_block_s, 4),
+            }
+            if best is None or rep["host_blocked_us_per_token"] \
+                    < best["host_blocked_us_per_token"]:
+                best = rep
+        return best
+
+    pipeline = [pipeline_rep(d) for d in PIPELINE_DEPTHS]
+    fused = pipeline_rep(PIPELINE_DEPTHS[-1], FUSED_STEPS)
+    gap_by_depth = {p["pipeline_depth"]: p["host_blocked_us_per_token"]
+                    for p in pipeline}
+
     # the first token of each request is emitted by prefill (inside the
     # submit window); the drain window decodes the remaining N-1
     total_new = len(PROMPT_LENS) * (NEW_TOKENS - 1)
     dev = jax.devices()[0]
-    print(json.dumps({
+    result = {
         "metric": "continuous-batching serving, flagship GQA decoder"
                   + (" [SMOKE]" if SMOKE else ""),
         "device": dev.device_kind,
@@ -108,6 +190,21 @@ def main():
         "decode_s": round(t_decode, 3),
         "decode_tokens_per_s": round(total_new / t_decode),
         "completed": len(results),
+        # headline for the pipelining PR: host-blocked (dispatch-gap)
+        # us/token at the deepest window vs the host-serial engine.
+        # vs_baseline = baseline / current (> 1.0 = the pipeline hides
+        # host time), matching the bench_sched.json convention; the
+        # depth-1 run of the SAME binary is the baseline of record —
+        # there was no serving artifact before this round. A fully
+        # hidden gap measures 0.0, so both sides carry a 1 us/token
+        # epsilon to keep the ratio finite and comparable across rounds.
+        "value": gap_by_depth[PIPELINE_DEPTHS[-1]],
+        "unit": "us_host_blocked_per_token",
+        "vs_baseline": round(
+            (gap_by_depth[1] + 1.0)
+            / (gap_by_depth[PIPELINE_DEPTHS[-1]] + 1.0), 3),
+        "pipeline": pipeline,
+        "fused_decode": fused,
         "prefix_cache": {
             "shared_prefix_tokens": sys_len,
             "prefill_admit_s": round(t_submit_pc, 3),
@@ -115,7 +212,13 @@ def main():
             "hits": srv_pc.prefix_hits,
             "tokens_saved": srv_pc.prefix_tokens_saved,
         },
-    }))
+    }
+    # file first (artifact of record), stdout line second
+    os.makedirs(os.path.dirname(OUT_PATH), exist_ok=True)
+    with open(OUT_PATH, "w") as f:
+        json.dump(result, f, indent=2)
+    print(json.dumps(result))
+    return result
 
 
 if __name__ == "__main__":

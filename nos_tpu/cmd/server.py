@@ -66,6 +66,18 @@ class ServerConfig:
     # (under speculative decoding the draft cache chunks alongside the
     # target: one target chunk + one cheap draft chunk per tick).
     prefill_chunk: int = 0
+    # pipelined decode dispatch: up to this many decode ticks in flight
+    # before the host blocks on a token fetch (1 = host-serial). Greedy
+    # outputs stay bit-identical to generate() at any depth; streaming
+    # granularity coarsens to ~depth*decode_steps tokens per SSE frame.
+    # The speculative engine pins this to 1 (its verify burst already
+    # amortizes dispatch overhead).
+    pipeline_depth: int = 1
+    # fused multi-step decode: this many decode steps compiled into ONE
+    # dispatch (lax.scan), [batch, decode_steps] tokens per device sync.
+    # Pays in decode-bound phases; 1 = off. Pinned to 1 under
+    # speculative decoding.
+    decode_steps: int = 1
     # speculative decoding (draft_checkpoint_dir set = on): a smaller
     # draft model proposes draft_n_tokens per tick, the target verifies
     # them in one wide forward. Greedy requests stay bit-identical to
@@ -150,6 +162,23 @@ class ServingLoop:
         self.m_prefix_saved = reg.gauge(
             "nos_tpu_serve_prefix_tokens_saved",
             "Prompt tokens whose prefill was skipped via the prefix cache")
+        # per-tick economics (buckets carry trace exemplars when a
+        # serve.tick span is sampled): service time is the whole
+        # quantum (dispatch + wait + bookkeeping); the dispatch gap
+        # mirrors the engine's structural dispatch_gap_s — time with NO
+        # decode tick in flight while decodable slots existed, i.e. the
+        # accelerator host-blocked. pipeline_depth >= 2 drives the gap
+        # to ~0 (the window never empties outside barriers); the two
+        # histograms together make the win measurable.
+        self.h_tick = reg.histogram(
+            "nos_tpu_serve_tick_seconds",
+            "Serving-loop tick service time (dispatch + wait + host "
+            "bookkeeping)")
+        self.h_gap = reg.histogram(
+            "nos_tpu_serve_dispatch_gap_seconds",
+            "Per-tick dispatch gap: time the engine had no decode tick "
+            "in flight while decodable slots existed (the accelerator "
+            "host-blocked behind bookkeeping)")
         self.engine = engine
         self._lock = threading.Lock()
         self._work = threading.Condition(self._lock)
@@ -191,31 +220,89 @@ class ServingLoop:
                 self._work.wait(timeout=min(remaining, 1.0))
             return True
 
+    def _fail(self, e: BaseException) -> None:
+        """Mark the loop dead (caller holds the lock): /healthz flips
+        BEFORE the single notify_all, so every wait_idle/stream waiter —
+        re-checking under this same lock — observes healthy == False by
+        the time it returns. Exactly one wakeup; the ticker thread exits
+        right after."""
+        logger.exception("decode tick failed; marking unhealthy")
+        self._failed = e
+        self._work.notify_all()
+
     def _run(self) -> None:
+        # engines exposing the split-step protocol (DecodeServer) run
+        # the blocking device wait OUTSIDE the condition lock, so
+        # handlers submit/stream/cancel while the device computes;
+        # step()-only engines (test stubs) tick under the lock as before
+        split = hasattr(self.engine, "step_begin") \
+            and hasattr(self.engine, "step_wait") \
+            and hasattr(self.engine, "step_finish")
+        from nos_tpu.obs import tracing
         while True:
+            sp = None
             with self._work:
                 while not self._stop and not self.engine.has_work():
                     self._work.wait()
                 if self._stop:
                     return
+                t0 = time.monotonic()
+                sp = tracing.start_span("serve.tick", component="server")
+                handle = None
+                emitted = 0
+                gap0 = getattr(self.engine, "dispatch_gap_s", None)
                 try:
-                    emitted = self.engine.step()
+                    if split:
+                        handle = self.engine.step_begin()
+                    else:
+                        emitted = self.engine.step()
+                except BaseException as e:
+                    sp.end()
+                    self._fail(e)
+                    return
+            if split:
+                # the only blocking device wait — lock released, so a
+                # concurrent submit's barrier flush may consume the
+                # handle under us (step_finish is idempotent on it)
+                try:
+                    self.engine.step_wait(handle)
+                except BaseException as e:
+                    with self._work:
+                        sp.end()
+                        self._fail(e)
+                    return
+            with self._work:
+                try:
+                    if split:
+                        emitted = self.engine.step_finish(handle)
+                        if gap0 is not None:
+                            # the engine's structural gap counter: time
+                            # this tick's window sat empty with work
+                            # pending (ended by step_begin's dispatch)
+                            self.h_gap.observe(
+                                self.engine.dispatch_gap_s - gap0,
+                                trace_id=sp.trace_id or None)
                     self.m_ticks.inc()
                     self.m_tokens.inc(emitted)
                     self._mirror_engine_gauges()
-                except BaseException as e:   # decode tick died: go unhealthy
-                    logger.exception("decode tick failed; marking unhealthy")
-                    self._failed = e
-                    self._work.notify_all()
+                    # reap results whose client already gave up, so
+                    # _done can't grow from timed-out requests. Inside
+                    # the try: a failure here (engine died mid-reap)
+                    # must flip /healthz and wake waiters like any
+                    # other tick failure, not kill the ticker silently
+                    for rid in list(self._abandoned):
+                        if self.engine.pop_result(rid) is not None:
+                            self._abandoned.discard(rid)
+                            # completed work, even if nobody is waiting
+                            self.m_requests.inc()
+                            self.m_abandoned.inc()
+                except BaseException as e:
+                    sp.end()
+                    self._fail(e)
                     return
-                # reap results whose client already gave up, so _done
-                # can't grow from timed-out requests
-                for rid in list(self._abandoned):
-                    if self.engine.pop_result(rid) is not None:
-                        self._abandoned.discard(rid)
-                        # completed work, even if nobody is waiting
-                        self.m_requests.inc()
-                        self.m_abandoned.inc()
+                sp.end()
+                self.h_tick.observe(time.monotonic() - t0,
+                                    trace_id=sp.trace_id or None)
                 self._work.notify_all()     # wake waiters to check results
 
     def generate(self, prompt, max_new_tokens, timeout: float = 300.0,
@@ -384,6 +471,12 @@ def build_engine(cfg: ServerConfig):
         raise ValueError(
             f"prefill_chunk must be 0 or a power of two >= 8, got "
             f"{cfg.prefill_chunk}")
+    if cfg.pipeline_depth < 1:
+        raise ValueError(
+            f"pipeline_depth must be >= 1, got {cfg.pipeline_depth}")
+    if cfg.decode_steps < 1:
+        raise ValueError(
+            f"decode_steps must be >= 1, got {cfg.decode_steps}")
     mesh = None
     if cfg.tp and cfg.tp > 1:
         import jax
@@ -442,11 +535,17 @@ def build_engine(cfg: ServerConfig):
             params, model_cfg, draft_params, draft_cfg,
             n_draft=cfg.draft_n_tokens, max_batch=cfg.max_batch,
             prefix_cache_size=cfg.prefix_cache_size, mesh=mesh,
-            prefill_chunk=cfg.prefill_chunk, max_pending=cfg.max_pending)
+            prefill_chunk=cfg.prefill_chunk, max_pending=cfg.max_pending,
+            # accepted for config uniformity; the spec engine pins both
+            # to 1 (see SpeculativeDecodeServer.__init__)
+            pipeline_depth=cfg.pipeline_depth,
+            decode_steps=cfg.decode_steps)
     return DecodeServer(params, model_cfg, max_batch=cfg.max_batch,
                         prefix_cache_size=cfg.prefix_cache_size, mesh=mesh,
                         prefill_chunk=cfg.prefill_chunk,
-                        max_pending=cfg.max_pending)
+                        max_pending=cfg.max_pending,
+                        pipeline_depth=cfg.pipeline_depth,
+                        decode_steps=cfg.decode_steps)
 
 
 def make_http_server(cfg: ServerConfig, loop: ServingLoop
@@ -611,6 +710,14 @@ def main(argv: Optional[Sequence[str]] = None) -> None:
     parser.add_argument("--checkpoint-dir", default="")
     parser.add_argument("--port", type=int, default=None)
     parser.add_argument(
+        "--pipeline-depth", type=int, default=None,
+        help="decode ticks in flight before the host blocks on a token "
+             "fetch (1 = host-serial; overrides config)")
+    parser.add_argument(
+        "--decode-steps", type=int, default=None,
+        help="decode steps fused into one compiled dispatch "
+             "(1 = off; overrides config)")
+    parser.add_argument(
         "--log-format", choices=("text", "json"), default="text",
         help="log line format; json emits one object per line with "
              "trace_id/span_id injected when a tracing span is active")
@@ -622,6 +729,10 @@ def main(argv: Optional[Sequence[str]] = None) -> None:
         cfg.checkpoint_dir = args.checkpoint_dir
     if args.port is not None:
         cfg.port = args.port
+    if args.pipeline_depth is not None:
+        cfg.pipeline_depth = args.pipeline_depth
+    if args.decode_steps is not None:
+        cfg.decode_steps = args.decode_steps
     from nos_tpu.cmd import setup_logging as _shared_setup_logging
     _shared_setup_logging(
         0, args.log_format,

@@ -29,8 +29,10 @@ bit-identical to ``generate``.
 from __future__ import annotations
 
 import functools
+import time
+from collections import deque
 from dataclasses import dataclass, field
-from typing import Dict, List, Optional
+from typing import Deque, Dict, List, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -82,6 +84,22 @@ class _Request:
         return len(self.out) >= self.max_new_tokens
 
 
+class _InFlight:
+    """One dispatched-but-unconsumed decode tick: device handles for its
+    outputs, the slots it decoded for, and (once ``step_wait`` ran) the
+    fetched host arrays. Arrivals are consumed strictly in dispatch
+    order; ``consumed`` makes consumption idempotent so a pipeline flush
+    racing a ``step_finish`` holder processes each tick exactly once."""
+
+    __slots__ = ("payload", "slots", "host", "consumed")
+
+    def __init__(self, payload: Tuple[jax.Array, ...], slots: Tuple[int, ...]):
+        self.payload = payload
+        self.slots = slots
+        self.host: Optional[tuple] = None
+        self.consumed = False
+
+
 class DecodeServer:
     """Continuous-batching engine over ``max_batch`` cache slots.
 
@@ -95,12 +113,24 @@ class DecodeServer:
     carry per-slot temperature/top-k/top-p/seed through the shared
     decode program, with a (seed, position)-keyed stream that is
     invariant to batch composition.
+
+    Dispatch economics knobs (both preserve the exactness contracts
+    above for every setting — tested):
+
+    - ``pipeline_depth=k``: up to k decode ticks in flight before the
+      host blocks on a token fetch; completions observed late roll back
+      by pos-reset, batch-composition changes barrier-flush the window.
+    - ``decode_steps=T``: T decode steps fused into one compiled
+      dispatch ([B, T] tokens per device->host sync), amortizing
+      per-dispatch overhead in decode-bound phases. Streaming
+      granularity coarsens to ~k*T tokens per arrival.
     """
 
     def __init__(self, params: Params, cfg: TransformerConfig,
                  max_batch: int = 8, max_len: Optional[int] = None,
                  prefix_cache_size: int = 0, mesh=None,
-                 prefill_chunk: int = 0, max_pending: int = 0):
+                 prefill_chunk: int = 0, max_pending: int = 0,
+                 pipeline_depth: int = 1, decode_steps: int = 1):
         if prefill_chunk and (prefill_chunk < 8
                               or prefill_chunk & (prefill_chunk - 1)):
             raise ValueError(
@@ -108,6 +138,12 @@ class DecodeServer:
                 f"{prefill_chunk} (chunks are compiled shapes; the final "
                 f"partial chunk pads to a power-of-two bucket that must "
                 f"not exceed the chunk)")
+        if pipeline_depth < 1:
+            raise ValueError(
+                f"pipeline_depth must be >= 1, got {pipeline_depth}")
+        if decode_steps < 1:
+            raise ValueError(
+                f"decode_steps must be >= 1, got {decode_steps}")
         self.params = params
         self.cfg = cfg
         self.max_batch = max_batch
@@ -133,10 +169,41 @@ class DecodeServer:
         # QueueFull so callers shed load (HTTP 429) instead of growing
         # an unbounded backlog whose tail would time out anyway
         self.max_pending = max_pending
-        self._free = list(range(max_batch))
+        self._free: Deque[int] = deque(range(max_batch))
         self._active: Dict[int, _Request] = {}      # slot -> request
-        self._pending: List[_Request] = []
+        self._pending: Deque[_Request] = deque()
         self._done: Dict[int, _Request] = {}
+        # pipelined dispatch: up to ``pipeline_depth`` decode ticks may be
+        # in flight (dispatched, tokens not yet fetched) before the host
+        # blocks — the compiled program feeds itself (``new_last``), so
+        # tick N+1 never needs tick N's tokens on the host. Any batch-
+        # composition change (admission install, cancel) is a barrier
+        # that flushes the window first (``_flush``). ``decode_steps``
+        # fuses that many decode steps inside ONE compiled dispatch
+        # (lax.scan), emitting [B, T] tokens per arrival.
+        self.pipeline_depth = pipeline_depth
+        self.decode_steps = decode_steps
+        self._inflight: Deque[_InFlight] = deque()
+        self._keep_masks: dict = {}     # active-slot tuple -> device mask
+        self._flush_emitted = 0        # tokens consumed outside step()
+        # dispatch-economics counters (bench_serve and the serving
+        # loop's histograms read these):
+        # - dispatch_gap_s: wall time the engine had NO decode tick in
+        #   flight while decodable slots existed — the accelerator
+        #   sitting host-blocked behind bookkeeping. At depth 1 every
+        #   tick pays the consume->redispatch gap; at depth >= 2 the
+        #   window only empties at barriers, so this drops by
+        #   construction on every backend.
+        # - host_block_s: wall time on the device-interaction path —
+        #   compiled dispatch calls (which absorb any input-readiness
+        #   stall the runtime imposes on a donated self-feeding chain)
+        #   plus blocking device->host token fetches.
+        self.dispatch_gap_s = 0.0
+        self.host_block_s = 0.0
+        self.ticks_dispatched = 0
+        self.pipeline_flushes = 0
+        self.tokens_emitted = 0
+        self._idle_since: Optional[float] = None
         # chunked prefill (prefill_chunk > 0): a long prompt's prefill
         # runs as fixed-size chunks interleaved with decode ticks — one
         # chunk per step() — so admitting a 32k-token request delays the
@@ -145,7 +212,7 @@ class DecodeServer:
         # {"req", "row" (scratch cache mid-prefill), "todo" (remaining
         # token chunks)}. The request holds its slot while prefilling.
         self._prefill_chunk = prefill_chunk
-        self._prefilling: List[dict] = []
+        self._prefilling: Deque[dict] = deque()
         # prefix cache: token-tuple -> (k_rows, v_rows) of the prefix's
         # KV (device arrays, [L, 1, Hkv, len, D]), LRU-capped at
         # ``prefix_cache_size`` entries (0 = off). Requests submitted
@@ -173,13 +240,15 @@ class DecodeServer:
                     (self._last, self._temp, self._topk, self._topp,
                      self._seed), self._rep)
 
-        def decode(p, toks, cache, keep, temp, topk, topp, seeds,
-                   sampling: bool):
-            # one fused program: forward, per-row sample-or-argmax,
-            # inactive rows' pos frozen, next feed tokens — cache
-            # donated. ``sampling`` is static: a greedy-only tick (every
-            # active slot at temperature 0 — the host knows) compiles
-            # WITHOUT the vocab-wide sort/softmax/RNG machinery
+        T = self.decode_steps
+
+        def decode_one(p, toks, cache, keep, temp, topk, topp, seeds,
+                       sampling: bool):
+            # one fused step: forward, per-row sample-or-argmax,
+            # inactive rows' pos frozen, next feed tokens. ``sampling``
+            # is static: a greedy-only tick (every active slot at
+            # temperature 0 — the host knows) compiles WITHOUT the
+            # vocab-wide sort/softmax/RNG machinery
             pos0 = cache["pos"]
             logits, cache = forward_with_cache(p, cfg, toks, cache)
             cache["pos"] = jnp.where(keep, cache["pos"], pos0)
@@ -188,7 +257,9 @@ class DecodeServer:
             if sampling:
                 # the token being produced sits at absolute index
                 # pos0 + 1: (seed, index) keys the stream, so a slot's
-                # samples don't depend on who else is in the batch
+                # samples don't depend on who else is in the batch —
+                # and, because pos advances inside the fused scan, not
+                # on how many steps one dispatch fuses
                 keys = jax.vmap(
                     lambda s, i: jax.random.fold_in(
                         jax.random.PRNGKey(s), i)
@@ -199,6 +270,30 @@ class DecodeServer:
                 nxt = jnp.where(temp > 0, sampled, nxt)
             new_last = jnp.where(keep[:, None], nxt[:, None], toks)
             return nxt, new_last, cache
+
+        def decode(p, toks, cache, keep, temp, topk, topp, seeds,
+                   sampling: bool):
+            # cache donated. T == 1 keeps the unscanned program (no scan
+            # wrapper in the hot graph); T > 1 fuses T decode steps into
+            # ONE dispatch via lax.scan — per-step ops identical to the
+            # T == 1 program, so greedy stays bit-exact at any T. Tokens
+            # come back [B, T] per sync.
+            if T == 1:
+                nxt, new_last, cache = decode_one(
+                    p, toks, cache, keep, temp, topk, topp, seeds,
+                    sampling)
+                return nxt[:, None], new_last, cache
+
+            def body(carry, _):
+                toks, cache = carry
+                nxt, new_last, cache = decode_one(
+                    p, toks, cache, keep, temp, topk, topp, seeds,
+                    sampling)
+                return (new_last, cache), nxt
+
+            (last, cache), steps = jax.lax.scan(
+                body, (toks, cache), None, length=T)
+            return steps.swapaxes(0, 1), last, cache        # [B, T]
 
         self._decode = jax.jit(decode, donate_argnums=(2,),
                                static_argnums=(8,))
@@ -266,9 +361,15 @@ class DecodeServer:
         return rid
 
     def _admit(self) -> None:
+        if self._pending and self._free:
+            # pipeline barrier: an admission install changes batch
+            # composition, and un-consumed in-flight arrivals still
+            # reference the OLD slot->request binding — flush them
+            # before _install writes the new request's rows
+            self._flush()
         while self._pending and self._free:
-            req = self._pending.pop(0)
-            slot = self._free.pop(0)
+            req = self._pending.popleft()
+            slot = self._free.popleft()
             req.slot = slot
             self._active[slot] = req
             self._prefill_slot(req)
@@ -435,7 +536,8 @@ class DecodeServer:
             row["v"] = jax.lax.dynamic_update_slice(
                 row["v"], pv[:, :, :, :m, :], (0, 0, 0, 0, 0))
         suffix = req.prompt[m:]
-        todo = [suffix[i:i + chunk] for i in range(0, len(suffix), chunk)]
+        todo = deque(suffix[i:i + chunk]
+                     for i in range(0, len(suffix), chunk))
         self._prefilling.append({"req": req, "row": row, "todo": todo})
         return True
 
@@ -446,7 +548,7 @@ class DecodeServer:
         ent = self._prefilling[0]
         if not self._prefill_advance(ent):
             return 0
-        self._prefilling.pop(0)
+        self._prefilling.popleft()
         self._finish_prefill(ent["req"], ent["row"], ent["step"])
         return 1
 
@@ -455,9 +557,9 @@ class DecodeServer:
         the last real position's logits in ``ent["step"]`` and return
         True (entry fully prefilled). Subclasses extend this to advance
         sibling caches (speculative draft) in the same tick."""
-        toks_list = ent["todo"].pop(0)
+        toks_list = ent["todo"].popleft()
         rem = len(toks_list)
-        rbucket = _bucket(rem) if ent["todo"] == [] else rem
+        rbucket = _bucket(rem) if not ent["todo"] else rem
         toks = jnp.asarray([toks_list + [0] * (rbucket - rem)], jnp.int32)
         logits, ent["row"] = self._prefill(self.params, toks, ent["row"])
         if ent["todo"]:
@@ -499,7 +601,16 @@ class DecodeServer:
         req.note_token()
         self._finish_if_done(req)
 
-    def _finish_if_done(self, req: _Request) -> None:
+    def _finish_if_done(self, req: _Request, admit: bool = True) -> None:
+        """Completion + slot recycling. Resetting the slot's per-row pos
+        is the pipeline ROLLBACK: a completion observed up to
+        pipeline_depth ticks late (or mid-way through a fused
+        decode_steps burst) has over-decoded past the true length, but
+        only pos decides what exists — the truncated host output plus
+        this reset discard the overrun by construction. ``admit=False``
+        is the arrival-consumption path: admission is a pipeline barrier
+        and must not re-enter the flush that is consuming this arrival —
+        the caller admits once, after the window drains."""
         if req.done and req.slot >= 0:
             s = req.slot
             del self._active[s]
@@ -507,46 +618,205 @@ class DecodeServer:
             self._free.append(s)
             req.slot = -1
             self._done[req.rid] = req
-            self._admit()
+            if not self._active:
+                # nothing left to decode: stop the dispatch-gap clock —
+                # an idle engine is not host-blocked, and a stale mark
+                # would book the whole idle period against the next
+                # serving burst's first dispatch
+                self._idle_since = None
+            if admit:
+                self._admit()
 
     # ------------------------------------------------------------------
+    # pipelined decode: step() == step_begin (dispatch) + step_wait
+    # (block on the oldest arrival) + step_finish (host bookkeeping).
+    # The serving loop calls the three phases separately so the blocking
+    # wait runs OUTSIDE its condition lock; library callers and tests
+    # keep calling step().
+    # ------------------------------------------------------------------
     def step(self) -> int:
-        """One decode tick for every active slot, plus ONE prefill chunk
-        for the head admitting request (chunked prefill); returns the
-        number of tokens emitted. Inactive slots ride along (their output
-        discarded, their pos frozen in-graph — same compiled program
-        every tick); slots mid-prefill are excluded from the decode batch
-        (their cache rows aren't installed yet)."""
-        emitted = 0
+        """One scheduling quantum: dispatch decode ticks until the
+        in-flight window is full, consume the oldest arrival, advance
+        ONE prefill chunk for the head admitting request (chunked
+        prefill); returns the number of tokens emitted. Inactive slots
+        ride along in each dispatch (their output discarded, their pos
+        frozen in-graph — same compiled program every tick); slots
+        mid-prefill are excluded from the decode batch (their cache rows
+        aren't installed yet). With pipeline_depth k > 1 a completion is
+        observed up to k ticks late; _finish_if_done's pos reset rolls
+        the overrun back."""
+        handle = self.step_begin()
+        self.step_wait(handle)
+        return self.step_finish(handle)
+
+    def _active_slots(self) -> List[int]:
         pre = {ent["req"].slot for ent in self._prefilling}
-        active = sorted(s for s in self._active if s not in pre)
-        if active:
-            keep = jnp.zeros((self.max_batch,), bool).at[
-                jnp.asarray(active, jnp.int32)].set(True)
-            sampling = any(
-                self._active[s].temperature > 0 for s in active)
-            emitted += self._tick(active, keep, sampling)
+        return sorted(s for s in self._active if s not in pre)
+
+    def step_begin(self) -> Optional[_InFlight]:
+        """Dispatch phase: enqueue compiled decode ticks back-to-back
+        until the in-flight window holds ``pipeline_depth`` entries (the
+        program computes its own next feed tokens on-device, so tick N+1
+        never waits for tick N's tokens), each with a non-blocking
+        device->host token fetch already started. Returns the oldest
+        unconsumed arrival to wait on (None when idle). Cheap host work
+        only — safe to call while holding a serving-loop lock."""
+        active = self._active_slots()
+        while active and len(self._inflight) < self.pipeline_depth:
+            self._dispatch_tick(active)
+        return self._inflight[0] if self._inflight else None
+
+    def step_wait(self, ent: Optional[_InFlight]) -> None:
+        """Block until ``ent``'s tokens are on the host (no-op for None
+        or an entry a barrier flush already consumed). This is the ONLY
+        place the pipelined hot loop blocks on the device; callers that
+        split the phases run it outside their locks."""
+        if ent is None or ent.consumed:
+            return
+        self._fetch(ent)
+
+    def _fetch(self, ent: _InFlight) -> None:
+        if ent.host is not None:
+            return
+        t0 = time.perf_counter()
+        ent.host = tuple(np.asarray(a) for a in ent.payload)
+        self.host_block_s += time.perf_counter() - t0
+
+    def step_finish(self, ent: Optional[_InFlight]) -> int:
+        """Host bookkeeping phase: consume ``ent`` (append tokens,
+        retire completions), run one prefill chunk, and re-admit into
+        any freed slots. Returns tokens emitted, including any consumed
+        by barrier flushes since the last step_finish (so throughput
+        accounting never loses the flushed ticks)."""
+        emitted = self._flush_emitted
+        self._flush_emitted = 0
+        if ent is not None and not ent.consumed:
+            # arrivals are consumed strictly in dispatch order; ent is
+            # the window head unless a flush got there first
+            assert self._inflight and self._inflight[0] is ent
+            self._inflight.popleft()
+            emitted += self._consume(ent)
         if self._prefilling:
             emitted += self._prefill_tick()
+        self._admit()       # fill slots freed by completions (barriers)
+        self._note_window_empty()
         return emitted
 
-    def _tick(self, active: List[int], keep: jax.Array,
-              sampling: bool) -> int:
-        """One compiled decode dispatch for ``active`` slots; the
-        template step() owns the shared scaffolding (mid-prefill slot
-        exclusion, keep mask, sampling flag, prefill tick) so engine
-        subclasses override only this."""
-        nxt, self._last, self.cache = self._decode(
+    def _note_window_empty(self) -> None:
+        """Start the dispatch-gap clock when the in-flight window runs
+        empty with decodable slots still present: from here until the
+        next decode dispatch, the accelerator is host-blocked. Called
+        only at the END of step_finish, after the prefill chunk and
+        admission forwards have run — those are real device work, not
+        gap, and must not be booked against the clock. (Every
+        mid-prefill request holds a slot in _active, so the decodable
+        count is the difference.)"""
+        if not self._inflight and self._idle_since is None \
+                and len(self._active) > len(self._prefilling):
+            self._idle_since = time.perf_counter()
+
+    def reset_dispatch_stats(self) -> None:
+        """Zero the dispatch-economics counters and the gap clock —
+        bench measurement windows call this at their timing fence."""
+        self.dispatch_gap_s = 0.0
+        self.host_block_s = 0.0
+        self.ticks_dispatched = 0
+        self.pipeline_flushes = 0
+        self._idle_since = None
+
+    def _dispatch_tick(self, active: List[int]) -> None:
+        """Enqueue ONE compiled decode dispatch for ``active`` slots and
+        start the async token fetch; no host sync."""
+        keep = self._keep_mask(tuple(active))
+        sampling = any(self._active[s].temperature > 0 for s in active)
+        t0 = time.perf_counter()
+        if self._idle_since is not None:
+            # the dispatch gap ends the moment a tick is in flight again
+            self.dispatch_gap_s += t0 - self._idle_since
+            self._idle_since = None
+        payload = self._dispatch(active, keep, sampling)
+        self.ticks_dispatched += 1
+        for a in payload:
+            copy = getattr(a, "copy_to_host_async", None)
+            if copy is not None:
+                copy()
+        self.host_block_s += time.perf_counter() - t0
+        self._inflight.append(_InFlight(payload, tuple(active)))
+
+    def _keep_mask(self, active: Tuple[int, ...]) -> jax.Array:
+        """Device keep-mask for an active-slot tuple, memoized per
+        instance: active sets repeat for whole decode phases, and
+        rebuilding the mask was a measurable per-dispatch host cost
+        (~1ms on the CPU smoke shape). Bounded: at most 2^max_batch
+        distinct sets, and the dict dies with the engine (a class-level
+        lru_cache would pin every engine — and its device KV cache —
+        for the life of the process)."""
+        keep = self._keep_masks.get(active)
+        if keep is None:
+            keep = jnp.zeros((self.max_batch,), bool).at[
+                jnp.asarray(active, jnp.int32)].set(True)
+            if self.mesh is not None:
+                keep = jax.device_put(keep, self._rep)
+            self._keep_masks[active] = keep
+        return keep
+
+    def _dispatch(self, active: List[int], keep: jax.Array,
+                  sampling: bool) -> Tuple[jax.Array, ...]:
+        """One compiled decode dispatch for ``active`` slots; returns
+        the device handles the matching ``_consume_payload`` will read.
+        The template owns the shared scaffolding (window management,
+        keep mask, sampling flag, async fetch, ordered consumption) so
+        engine subclasses override only this pair."""
+        toks, self._last, self.cache = self._decode(
             self.params, self._last, self.cache, keep,
             self._temp, self._topk, self._topp, self._seed, sampling)
-        nxt_host = np.asarray(nxt)          # ONE device->host sync
+        return (toks,)                                  # [B, T]
+
+    def _consume(self, ent: _InFlight) -> int:
+        """Process one arrival's host tokens in order. Idempotent via
+        ``ent.consumed``: a step_finish holder racing a barrier flush
+        processes each tick exactly once."""
+        if ent.consumed:
+            return 0
+        ent.consumed = True
+        self._fetch(ent)        # usually a no-op: fetch already landed
+        emitted = self._consume_payload(ent, ent.host)
+        self.tokens_emitted += emitted
+        ent.payload = ()        # drop device refs promptly
+        return emitted
+
+    def _consume_payload(self, ent: _InFlight, host: tuple) -> int:
+        """Append one tick's tokens ([B, T]) to its requests. A slot
+        whose request already finished (observed in an EARLIER arrival,
+        or mid-burst below) contributes nothing — its late tokens are
+        the pipeline overrun the pos-reset rollback discards."""
+        (toks,) = host
         emitted = 0
-        for s in active:
-            req = self._active[s]
-            req.out.append(int(nxt_host[s]))
-            req.note_token()
-            emitted += 1
-            self._finish_if_done(req)
+        for s in ent.slots:
+            req = self._active.get(s)
+            if req is None or req.done:
+                continue
+            for j in range(toks.shape[1]):
+                req.out.append(int(toks[s, j]))
+                req.note_token()
+                emitted += 1
+                if req.done:
+                    break
+            self._finish_if_done(req, admit=False)
+        return emitted
+
+    def _flush(self) -> int:
+        """Pipeline barrier: consume every in-flight arrival in dispatch
+        order. Called before any batch-composition change (admission
+        install, cancel) — un-consumed arrivals reference the old
+        slot->request binding and must land first. Tokens emitted here
+        are credited to the next step_finish via _flush_emitted."""
+        emitted = 0
+        if self._inflight:
+            self.pipeline_flushes += 1
+        while self._inflight:
+            emitted += self._consume(self._inflight.popleft())
+        self._flush_emitted += emitted
         return emitted
 
     def pop_result(self, rid: int) -> Optional[List[int]]:
@@ -571,6 +841,17 @@ class DecodeServer:
                 del self._pending[i]
                 self._done[rid] = req        # empty output; poppable
                 return True
+        # pipeline barrier: cancel mutates the slot->request binding; in-
+        # flight arrivals for the old binding must land first (this may
+        # even FINISH the request — then it is already done-table'd and
+        # the scans below correctly find nothing). Unknown/finished rids
+        # change no binding, so they must not collapse the window — the
+        # serving loop cancels unconditionally on every client timeout
+        if not any(req.rid == rid for req in self._active.values()) \
+                and not any(e["req"].rid == rid for e in self._prefilling):
+            return False
+        if self._inflight:
+            self._flush()
         for i, ent in enumerate(self._prefilling):
             if ent["req"].rid == rid:
                 # drop the chunk queue FIRST: the slot frees below, and
@@ -618,6 +899,11 @@ class DecodeServer:
             if not self._active:       # pending but no free slot: bug
                 raise RuntimeError("pending requests with no active slots")
             self.step()
+        # the last completion can leave over-decoded arrivals in flight
+        # (every request already done): drain them so no device handles
+        # linger between serving bursts
+        self._flush()
+        self._flush_emitted = 0
         out = {r.rid: r.prompt + r.out[:r.max_new_tokens]
                for r in self._done.values()}
         self._done.clear()

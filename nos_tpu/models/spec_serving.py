@@ -38,11 +38,11 @@ from __future__ import annotations
 
 import functools
 
-from typing import List, Optional
+from collections import deque
+from typing import Optional
 
 import jax
 import jax.numpy as jnp
-import numpy as np
 
 from nos_tpu.models.generate import (
     _truncate_logits_rows, forward_with_cache, init_cache,
@@ -78,6 +78,17 @@ class SpeculativeDecodeServer(DecodeServer):
                  max_len: Optional[int] = None, **kw):
         if draft_cfg.vocab != cfg.vocab:
             raise ValueError("draft and target must share a vocabulary")
+        # the speculative engine pins pipeline_depth=1 / decode_steps=1:
+        # a spec tick already commits a variable-length burst (up to
+        # n_draft tokens) per dispatch, and the submit-time headroom
+        # guard below budgets exactly ONE un-rolled-back verify window
+        # (k positions) past the committed prefix — k ticks in flight
+        # would need k*n_draft headroom and buy little on top of the
+        # burst amortization the draft/verify split already provides.
+        # Operator configs (nos-tpu-server flags) apply to both engines,
+        # so the knobs are accepted here and clamped, not rejected.
+        kw["pipeline_depth"] = 1
+        kw["decode_steps"] = 1
         super().__init__(params, cfg, max_batch=max_batch,
                          max_len=max_len, **kw)
         self.draft_params = draft_params
@@ -253,17 +264,17 @@ class SpeculativeDecodeServer(DecodeServer):
             "v": self._d_row_zeros(bucket),
             "pos": jnp.zeros((), jnp.int32),
         }
-        ent["dtodo"] = [req.prompt[i:i + chunk]
-                        for i in range(0, plen, chunk)]
+        ent["dtodo"] = deque(req.prompt[i:i + chunk]
+                             for i in range(0, plen, chunk))
         return True
 
     def _prefill_advance(self, ent) -> bool:
         if ent["todo"]:
             super()._prefill_advance(ent)       # one target chunk
         if ent["dtodo"]:                        # one draft chunk
-            toks_list = ent["dtodo"].pop(0)
+            toks_list = ent["dtodo"].popleft()
             rem = len(toks_list)
-            rbucket = _bucket(rem) if ent["dtodo"] == [] else rem
+            rbucket = _bucket(rem) if not ent["dtodo"] else rem
             toks = jnp.asarray([toks_list + [0] * (rbucket - rem)],
                                jnp.int32)
             _, ent["drow"] = self._d_prefill(
@@ -302,31 +313,36 @@ class SpeculativeDecodeServer(DecodeServer):
             jnp.int32(plen))
         super()._finish_prefill(req, row, step)
 
-    def _finish_if_done(self, req) -> None:
+    def _finish_if_done(self, req, admit: bool = True) -> None:
         if req.done and req.slot >= 0:
             self.d_cache["pos"] = self.d_cache["pos"].at[req.slot].set(0)
-        super()._finish_if_done(req)
+        super()._finish_if_done(req, admit)
 
     # ------------------------------------------------------------------
-    def _tick(self, active, keep, sampling) -> int:
+    def _dispatch(self, active, keep, sampling):
         """One speculative dispatch: up to k tokens per active slot.
         The base step() template owns the scaffolding (mid-prefill slot
-        exclusion, keep mask, prefill tick)."""
+        exclusion, keep mask, in-flight window — pinned to depth 1 here —
+        async fetch, prefill tick)."""
         commit, counts, self._last, self.cache, self.d_cache = \
             self._spec_tick(
                 self.params, self.draft_params, self._last, self.cache,
                 self.d_cache, keep, self._temp, self._topk, self._topp,
                 self._seed, sampling)
-        commit_host = np.asarray(commit)
-        counts_host = np.asarray(counts)
+        return commit, counts
+
+    def _consume_payload(self, ent, host) -> int:
+        commit_host, counts_host = host
         emitted = 0
-        for s in active:
-            req = self._active[s]
+        for s in ent.slots:
+            req = self._active.get(s)
+            if req is None or req.done:
+                continue
             for j in range(int(counts_host[s])):
                 req.out.append(int(commit_host[s, j]))
                 req.note_token()
                 emitted += 1
                 if req.done:
                     break
-            self._finish_if_done(req)
+            self._finish_if_done(req, admit=False)
         return emitted

@@ -190,6 +190,8 @@ class CheckpointManager:
         ``mesh`` when given, so the restored state is consistently placed."""
         ocp = self._ocp
         step, as_abstract = self._restore_setup(step, mesh)
+        self._check_template_shapes(step, params=params_template,
+                                    opt_state=opt_state_template)
         restored = self.manager.restore(
             step,
             args=ocp.args.Composite(
@@ -207,6 +209,7 @@ class CheckpointManager:
         mesh semantics as :meth:`restore`."""
         ocp = self._ocp
         step, as_abstract = self._restore_setup(step, mesh)
+        self._check_template_shapes(step, params=params_template)
         restored = self.manager.restore(
             step,
             args=ocp.args.Composite(
@@ -214,6 +217,68 @@ class CheckpointManager:
                     as_abstract(params_template))),
         )
         return restored["params"]
+
+    def _check_template_shapes(self, step: int, **templates: Any) -> None:
+        """Refuse a restore whose template shapes disagree with what is
+        ON DISK. The orbax on this toolchain (0.7.x StandardRestore with
+        an abstract template) does not error on a global-shape mismatch
+        — it silently materializes template-shaped arrays — so a drifted
+        relaunch against a pre-stamp-era (unstamped) checkpoint would
+        resume from fabricated weights instead of failing. Compares
+        against ``item_metadata`` (cheap: metadata only, no array I/O)
+        and names every mismatched leaf path. Unknown metadata layouts
+        skip the check rather than block a legitimate restore."""
+        try:
+            meta = self.manager.item_metadata(step)
+        except Exception:
+            meta = None
+        bad = []
+        for name, template in templates.items():
+            have = getattr(meta, name, None)
+            if have is None:
+                # a FRESH manager (the resume/consumer case — exactly
+                # where drift protection matters) has registered no
+                # handlers yet, so item_metadata yields None per item;
+                # read the item directory's array metadata directly
+                have = self._item_dir_metadata(step, name)
+            if have is None:
+                continue
+            try:
+                pairs = zip(
+                    jax.tree_util.tree_flatten_with_path(have)[0],
+                    jax.tree_util.tree_flatten_with_path(template)[0])
+                for (path, disk), (wpath, want) in pairs:
+                    if path != wpath:   # structure drift: not ours to judge
+                        continue
+                    dshape = getattr(disk, "shape", None)
+                    wshape = getattr(want, "shape", None)
+                    if dshape is not None and wshape is not None \
+                            and tuple(dshape) != tuple(wshape):
+                        keys = jax.tree_util.keystr(path)
+                        bad.append(f"{name}{keys}: checkpoint has "
+                                   f"{tuple(dshape)}, caller expects "
+                                   f"{tuple(wshape)}")
+            except Exception:
+                continue        # tree-structure drift errors in restore
+        if bad:
+            raise ValueError(
+                f"checkpoint shape mismatch under {self.directory} step "
+                f"{step}: " + "; ".join(sorted(bad)))
+
+    def _item_dir_metadata(self, step: int, name: str):
+        """Array metadata (shapes, no array I/O) for one composite item
+        read straight off ``<directory>/<step>/<name>`` — works on a
+        manager that has never saved or restored (no handler registry).
+        None when the layout is not what our ``save`` writes."""
+        from etils import epath
+
+        path = epath.Path(self.directory) / str(step) / name
+        try:
+            if not path.exists():
+                return None
+            return self._ocp.PyTreeCheckpointHandler().metadata(path)
+        except Exception:
+            return None
 
     def _restore_setup(self, step: Optional[int], mesh: Any):
         """Shared restore plumbing: resolve the step and build the

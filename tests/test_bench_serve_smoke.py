@@ -1,0 +1,54 @@
+"""Slow-marked smoke of bench_serve.py: the bench path must not rot
+(ISSUE 4 satellite). Runs the real script in NOS_TPU_BENCH_SMOKE=1 mode
+in a subprocess (its own jax runtime), then checks the artifact of
+record — ``bench_logs/bench_serve.json`` — for the pipelined-dispatch
+acceptance shape: host-blocked time per token strictly lower at
+pipeline_depth >= 2 than at depth 1."""
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.mark.slow
+def test_bench_serve_smoke_writes_pipeline_artifact(tmp_path):
+    env = dict(os.environ, NOS_TPU_BENCH_SMOKE="1", JAX_PLATFORMS="cpu")
+    proc = subprocess.run(
+        [sys.executable, "bench_serve.py"], cwd=REPO, env=env,
+        capture_output=True, text=True, timeout=1200)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+
+    # stdout line parses and the file artifact matches it
+    line = json.loads(proc.stdout.strip().splitlines()[-1])
+    with open(os.path.join(REPO, "bench_logs", "bench_serve.json")) as f:
+        artifact = json.load(f)
+    assert artifact == line
+    assert "[SMOKE]" in artifact["metric"]
+
+    gaps = {p["pipeline_depth"]: p["host_blocked_us_per_token"]
+            for p in artifact["pipeline"]}
+    assert 1 in gaps and max(gaps) >= 2
+    # depth 1 pays a consume->redispatch gap every tick; a pipelined
+    # window may hide it COMPLETELY (0.0 is the success case, not a
+    # measurement bug)
+    assert gaps[1] > 0
+    assert all(g >= 0 for g in gaps.values())
+    # the acceptance gate: the in-flight window hides host time
+    deepest = max(gaps)
+    assert gaps[deepest] < gaps[1], (
+        f"pipeline_depth={deepest} host-blocked/token {gaps[deepest]}us "
+        f"not below depth-1 {gaps[1]}us")
+    assert artifact["vs_baseline"] > 1.0
+    # fused decode reported alongside: T steps per dispatch means far
+    # fewer dispatches than the unfused depth-matched run
+    fused = artifact["fused_decode"]
+    assert fused["decode_steps"] > 1
+    unfused_ticks = max(p["ticks"] for p in artifact["pipeline"])
+    assert fused["ticks"] < unfused_ticks
+    # host_overhead_pct present on every rep (the bench's own headline)
+    for p in artifact["pipeline"] + [fused]:
+        assert 0 <= p["host_overhead_pct"] <= 100

@@ -96,7 +96,7 @@ def test_cancel_mid_prefill_frees_slot(params):
     srv.step()                      # one chunk in
     assert srv._prefilling
     assert srv.cancel(b)
-    assert not srv._prefilling and srv._free == [0]
+    assert not srv._prefilling and list(srv._free) == [0]
     # the freed slot serves the next request normally
     c = srv.submit([7, 7], 3)
     out = srv.drain()

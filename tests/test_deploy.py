@@ -58,13 +58,17 @@ def test_templates_exist_for_every_component():
 
 
 def test_workload_templates_dial_the_apiserver():
-    """Every workload container must pass --api (serve.connect exits
-    otherwise) and the apiserver deployment itself must exist."""
+    """Every CONTROL-PLANE workload container must pass --api
+    (serve.connect exits otherwise) and the apiserver deployment itself
+    must exist. The serving pod is exempt: nos-tpu-server is a
+    workload-plane model server the operator stack schedules — it has
+    no --api flag and talks to nothing but its clients."""
     for t in _templates():
         with open(t) as f:
             text = f.read()
         if re.search(r"kind: (Deployment|DaemonSet)", text) \
-                and "component: apiserver" not in text:
+                and "component: apiserver" not in text \
+                and "component: serving" not in text:
             assert "--api=" in text, f"{t}: workload without --api"
 
 

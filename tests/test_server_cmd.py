@@ -136,6 +136,90 @@ def test_failed_loop_reports_unhealthy():
         loop.generate([1], 2)
 
 
+def test_tick_failure_wakes_wait_idle_and_flips_health_first():
+    """A tick failure during drain must wake wait_idle waiters promptly
+    (one notify, not a 1s-poll timeout ride-out) and /healthz must
+    already read unhealthy by the time any waiter returns."""
+    class Boom(_FakeEngine):
+        def step(self):
+            raise RuntimeError("device fell over mid-drain")
+
+    eng = Boom()
+    eng.pending[0] = 3                  # in-flight work at drain time
+    loop = ServingLoop(eng)
+    try:
+        loop.begin_drain()
+        observed = {}
+
+        def waiter():
+            t0 = time.monotonic()
+            drained = loop.wait_idle(timeout=30)
+            # capture health AT return: the ordering contract is that
+            # _failed is set before the (single) notify_all
+            observed["healthy"] = loop.healthy
+            observed["drained"] = drained
+            observed["took"] = time.monotonic() - t0
+
+        t = threading.Thread(target=waiter)
+        t.start()
+        t.join(timeout=10)
+        assert not t.is_alive(), "wait_idle never woke on tick failure"
+        assert observed["healthy"] is False
+        assert observed["drained"] is False     # work still queued
+        assert observed["took"] < 5             # woke, didn't time out
+    finally:
+        loop.shutdown()
+
+
+def test_reap_failure_marks_unhealthy_not_silent():
+    """The abandoned-request reap runs in the ticker thread; an engine
+    failure there must flip /healthz like any other tick failure, not
+    kill the ticker silently (waiters would then hang to timeout with
+    the pod still reporting healthy)."""
+    class BadReap(_FakeEngine):
+        def __init__(self):
+            super().__init__()
+            self.release = threading.Event()
+
+        def step(self):
+            if not self.release.is_set():
+                time.sleep(0.002)
+                return 0
+            return super().step()
+
+        def pop_result(self, rid):
+            if self.release.is_set() and rid in self.done:
+                raise RuntimeError("reap boom")
+            return super().pop_result(rid)
+
+    eng = BadReap()
+    loop = ServingLoop(eng)
+    try:
+        s = loop.stream([1], 3)
+        s.close()                       # abandon while still in flight
+        assert _wait_until(lambda: s.rid in loop._abandoned)
+        eng.release.set()               # completes, then the reap raises
+        assert _wait_until(lambda: not loop.healthy, timeout=10), \
+            "reap failure left the loop reporting healthy"
+    finally:
+        loop.shutdown()
+
+
+def test_tick_histograms_exported(served):
+    """The pipelined loop's per-tick economics reach /metrics: service
+    time and the host-blocked dispatch gap (observed by the split-step
+    path the real engine takes)."""
+    url, _, _ = served
+    post(url, {"prompt": [3, 1], "max_new_tokens": 4})
+    with urllib.request.urlopen(url + "/metrics", timeout=30) as r:
+        text = r.read().decode()
+    for name in ("nos_tpu_serve_tick_seconds",
+                 "nos_tpu_serve_dispatch_gap_seconds"):
+        count = [line for line in text.splitlines()
+                 if line.startswith(name + "_count")]
+        assert count and float(count[0].split()[-1]) > 0, name
+
+
 def test_metrics_count_requests_and_tokens(served):
     url, _, _ = served
     post(url, {"prompt": [2, 4], "max_new_tokens": 3})

@@ -1,0 +1,299 @@
+"""Pipelined decode dispatch (models/serving.py): the in-flight tick
+window (pipeline_depth) and fused multi-step decode (decode_steps).
+
+The hard invariants this file pins:
+- greedy outputs stay bit-identical to generate() at EVERY
+  (pipeline_depth, decode_steps) combination — late-observed
+  completions roll back by pos-reset, never by numerics;
+- sampled streams are (seed, absolute-position)-keyed, so they are
+  invariant to pipeline depth and fusion width too;
+- batch-composition changes (admission install, cancel) are pipeline
+  barriers that flush the window before mutating slot bindings;
+- admission behavior (QueueFull) is unchanged by pipelining;
+- the speculative engine pins both knobs to 1 and regresses nothing.
+
+Engine reuse note: pipeline_depth is HOST-side state (the window bound)
+— it never enters the compiled program — so tests share one drained
+engine per (decode_steps, max_batch) and set ``eng.pipeline_depth``
+directly instead of paying an XLA compile per grid point. decode_steps
+IS compiled (the lax.scan length), so T=1 and T=4 get separate engines.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from nos_tpu.models import transformer as tfm
+from nos_tpu.models.generate import generate
+from nos_tpu.models.serving import DecodeServer, QueueFull
+
+CFG = tfm.TransformerConfig(vocab=64, d_model=32, n_layers=2, n_heads=4,
+                            n_kv_heads=2, d_ff=64, max_seq=64,
+                            dtype=jnp.float32)
+
+# the ISSUE grid: depth {1, 2, 4} x fused steps {1, 4}
+GRID = [(d, t) for d in (1, 2, 4) for t in (1, 4)]
+
+
+@pytest.fixture(scope="module")
+def params():
+    return tfm.init_params(jax.random.PRNGKey(0), CFG)
+
+
+@pytest.fixture(scope="module")
+def engines(params):
+    """Shared drained engines keyed by (decode_steps, max_batch);
+    at(depth, steps, mb) retunes the host-side window bound."""
+    cache = {}
+
+    def at(depth, steps=1, mb=2):
+        eng = cache.get((steps, mb))
+        if eng is None:
+            eng = DecodeServer(params, CFG, max_batch=mb,
+                               decode_steps=steps)
+            cache[(steps, mb)] = eng
+        assert not eng.has_work(), "previous test left work behind"
+        eng.pipeline_depth = depth
+        eng.max_pending = 0
+        return eng
+
+    return at
+
+
+def ref(params, prompt, n):
+    out = generate(params, CFG, jnp.asarray([prompt], jnp.int32), n)
+    return [int(t) for t in out[0]]
+
+
+@pytest.mark.parametrize("depth,steps", GRID)
+def test_greedy_bit_exact_across_grid(engines, params, depth, steps):
+    # 3 requests over 2 slots with unequal budgets: slot recycling (a
+    # barrier-admission mid-pipeline) happens inside the run
+    srv = engines(depth, steps)
+    prompts = [([1, 2, 3], 6), ([60, 61], 9), ([7, 7, 7, 7, 7], 5)]
+    rids = [srv.submit(p, n) for p, n in prompts]
+    res = srv.drain()
+    for rid, (p, n) in zip(rids, prompts):
+        assert res[rid] == ref(params, p, n), (depth, steps, rid)
+
+
+@pytest.mark.parametrize("depth,steps", [(4, 1), (2, 4)])
+def test_late_arrival_joins_as_barrier(engines, params, depth, steps):
+    srv = engines(depth, steps)
+    r0 = srv.submit([1, 2, 3, 4], 12)
+    for _ in range(3):
+        srv.step()
+    r1 = srv.submit([9, 9], 5)          # admission flushes the window
+    assert not srv._inflight
+    res = srv.drain()
+    assert res[r0] == ref(params, [1, 2, 3, 4], 12)
+    assert res[r1] == ref(params, [9, 9], 5)
+
+
+def test_stop_token_late_detection_rolls_back(engines, params):
+    # the stop token is produced early in the run but OBSERVED up to
+    # depth*steps ticks late: output must truncate exactly at its first
+    # occurrence, and the over-decoded slot must recycle cleanly (the
+    # next request through that slot stays bit-exact)
+    full = ref(params, [4, 5], 16)
+    stop = full[2 + 3]                    # 4th generated token
+    first_at = full.index(stop, 2)
+    srv = engines(4, 4)
+    rid = srv.submit([4, 5], 16, stop_tokens=[stop])
+    s = next(s for s, r in srv._active.items() if r.rid == rid)
+    res = srv.drain()
+    assert res[rid] == full[:first_at + 1]
+    assert res[rid][-1] == stop
+    assert int(srv.cache["pos"][s]) == 0      # rollback: pos reset
+    nxt = srv.submit([9, 8, 7], 6)            # recycled slot: still exact
+    assert srv.drain()[nxt] == ref(params, [9, 8, 7], 6)
+
+
+def test_max_new_reached_mid_window_rolls_back(engines, params):
+    # a 2-token-budget decode at depth 4 over-decodes up to 3 extra
+    # ticks; the overrun must be invisible in the result and the slot
+    # reusable immediately
+    srv = engines(4, 1, mb=1)
+    rid = srv.submit([4, 5], 2)
+    res = srv.drain()
+    assert res[rid] == ref(params, [4, 5], 2)
+    nxt = srv.submit([1, 2, 3], 8)
+    assert srv.drain()[nxt] == ref(params, [1, 2, 3], 8)
+
+
+def test_cancel_mid_flight_is_a_barrier(engines, params):
+    srv = engines(4, 1, mb=1)
+    rid_a = srv.submit([1, 2], 32)
+    rid_b = srv.submit([3], 4)                # queued behind a
+    for _ in range(3):
+        srv.step()
+    assert srv._inflight                      # ticks genuinely in flight
+    assert srv.cancel(rid_a)
+    assert not srv._inflight                  # barrier flushed the window
+    out_a = srv.pop_result(rid_a)
+    assert out_a[:2] == [1, 2]
+    # truncated at the flushed length: prompt + first token + the
+    # decode ticks that had landed by the barrier
+    assert len(out_a) < 2 + 32
+    results = srv.drain()                     # b got the freed slot
+    assert results[rid_b] == ref(params, [3], 4)
+
+
+def test_queue_full_unchanged_under_pipelining(engines, params):
+    srv = engines(4, 1, mb=1)
+    srv.max_pending = 1
+    try:
+        first = srv.submit([1, 2, 3], 30)
+        srv.step()
+        srv.submit([4, 5], 30)
+        with pytest.raises(QueueFull, match="max_pending=1"):
+            srv.submit([6], 2)
+        results = srv.drain()
+        assert len(results) == 2 and first in results
+        srv.submit([7], 2)                    # admission re-opens
+        srv.drain()
+    finally:
+        srv.max_pending = 0
+
+
+@pytest.mark.parametrize("depth,steps", [(2, 1), (4, 4)])
+def test_sampled_streams_invariant_to_depth(engines, params, depth, steps):
+    kw = dict(temperature=0.9, top_k=8, seed=17)
+    base = engines(1, 1)
+    r = base.submit([4, 5], 8, **kw)
+    want = base.drain()[r]
+
+    srv = engines(depth, steps)
+    r1 = srv.submit([4, 5], 8, **kw)                      # same seed
+    r2 = srv.submit([9, 9], 8, temperature=1.2, seed=5)   # noisy neighbour
+    res = srv.drain()
+    assert res[r1] == want, (depth, steps)
+    assert len(res[r2]) == 2 + 8
+
+
+def test_chunked_prefill_composes_with_pipelining(params):
+    # a long prompt chunk-prefills while other slots decode through the
+    # in-flight window; both requests stay exact
+    srv = DecodeServer(params, CFG, max_batch=2, pipeline_depth=4,
+                       prefill_chunk=8)
+    r0 = srv.submit([1, 2, 3], 10)
+    for _ in range(2):
+        srv.step()
+    long = list(range(1, 31))                 # 30 tokens: several chunks
+    r1 = srv.submit(long, 5)
+    res = srv.drain()
+    assert res[r0] == ref(params, [1, 2, 3], 10)
+    assert res[r1] == ref(params, long, 5)
+
+
+def test_split_step_protocol_and_token_accounting(engines, params):
+    # step_begin/step_wait/step_finish compose to step(), and every
+    # token is credited exactly once even when barrier flushes consume
+    # arrivals between phases
+    srv = engines(2, 1)
+    rids = [srv.submit([1, 2], 4), srv.submit([9], 6)]
+    total = 2                                 # prefill emitted 2 already
+    while srv.has_work():
+        h = srv.step_begin()
+        srv.step_wait(h)
+        total += srv.step_finish(h)
+    res = srv.drain()
+    assert total == 4 + 6
+    assert res[rids[0]] == ref(params, [1, 2], 4)
+    assert res[rids[1]] == ref(params, [9], 6)
+    assert srv.tokens_emitted >= 4 + 6 - 2    # engine-side cumulative
+
+
+def test_window_fills_to_depth_and_drains(engines, params):
+    srv = engines(4, 1, mb=1)
+    srv.reset_dispatch_stats()
+    srv.submit([1, 2], 16)
+    srv.step()
+    # one step dispatched up to depth ticks and consumed the oldest
+    assert len(srv._inflight) == 3
+    assert srv.ticks_dispatched == 4
+    srv.drain()
+    assert not srv._inflight                  # drain leaves nothing behind
+
+
+def test_dispatch_stats_accumulate(engines, params):
+    srv = engines(2, 1)
+    srv.reset_dispatch_stats()
+    tokens0 = srv.tokens_emitted
+    srv.submit([1, 2, 3], 8)
+    srv.drain()
+    assert srv.ticks_dispatched > 0
+    assert srv.host_block_s > 0.0
+    assert srv.tokens_emitted - tokens0 >= 7
+
+
+def test_depth1_pays_a_dispatch_gap_deeper_windows_hide_it(
+        engines, params):
+    # the structural claim behind nos_tpu_serve_dispatch_gap_seconds
+    # and the bench acceptance gate: at depth 1 the window empties on
+    # every consume (gap grows per tick); at depth >= 2 it only empties
+    # at barriers
+    srv = engines(1, 1, mb=1)
+    srv.reset_dispatch_stats()
+    srv.submit([1, 2], 12)
+    srv.drain()
+    gap1 = srv.dispatch_gap_s
+    assert gap1 > 0.0
+
+    srv = engines(4, 1, mb=1)
+    srv.reset_dispatch_stats()
+    srv.submit([1, 2], 12)
+    srv.drain()
+    assert srv.dispatch_gap_s < gap1          # window hides the gap
+
+
+def test_validation(params):
+    with pytest.raises(ValueError, match="pipeline_depth"):
+        DecodeServer(params, CFG, pipeline_depth=0)
+    with pytest.raises(ValueError, match="decode_steps"):
+        DecodeServer(params, CFG, decode_steps=0)
+
+
+# ---------------------------------------------------------------------------
+# speculative engine regression: pins depth/steps to 1
+# ---------------------------------------------------------------------------
+
+def test_speculative_engine_pins_pipeline_and_stays_exact(params):
+    from nos_tpu.models.spec_serving import SpeculativeDecodeServer
+
+    dcfg = tfm.TransformerConfig(vocab=64, d_model=16, n_layers=1,
+                                 n_heads=2, n_kv_heads=1, d_ff=32,
+                                 max_seq=64, dtype=jnp.float32)
+    dparams = tfm.init_params(jax.random.PRNGKey(1), dcfg)
+    srv = SpeculativeDecodeServer(
+        params, CFG, dparams, dcfg, n_draft=3, max_batch=2,
+        pipeline_depth=4, decode_steps=4)     # clamped, not honored
+    assert srv.pipeline_depth == 1
+    assert srv.decode_steps == 1
+    r1 = srv.submit([4, 5], 10)
+    r2 = srv.submit([9, 8, 7], 8)
+    res = srv.drain()
+    assert res[r1] == ref(params, [4, 5], 10)
+    assert res[r2] == ref(params, [9, 8, 7], 8)
+
+
+def test_random_schedules_stay_exact_under_pipelining(engines, params):
+    """Crash-prober twin of test_serving.test_random_schedules_stay_exact
+    with the pipeline on: random lengths, budgets, arrival points, AND
+    random step interleavings between submissions — every surviving
+    request bit-exact at (depth 3, steps 4), a deliberately odd corner
+    of the grid."""
+    rng = np.random.default_rng(23)
+    for trial in range(2):
+        srv = engines(3, 4)
+        n_req = int(rng.integers(3, 6))
+        reqs = [([int(t) for t in rng.integers(0, 64, rng.integers(1, 41))],
+                 int(rng.integers(1, 7))) for _ in range(n_req)]
+        rids = []
+        for p, n in reqs:
+            rids.append(srv.submit(p, n))
+            for _ in range(int(rng.integers(0, 3))):
+                srv.step()
+        results = srv.drain()
+        for rid, (p, n) in zip(rids, reqs):
+            assert results[rid] == ref(params, p, n), (trial, rid, p, n)

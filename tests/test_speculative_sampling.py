@@ -28,9 +28,22 @@ def cfg_kw(**kw):
 
 TARGET = cfg_kw()
 DRAFT = cfg_kw(d_model=8, n_layers=1, d_ff=16)
-PARAMS = tfm.init_params(jax.random.PRNGKey(0), TARGET)
-DRAFT_P = tfm.init_params(jax.random.PRNGKey(9), DRAFT)
 PROMPT_ROW = [1, 7, 3]
+
+
+# Lazily built, module-scoped: a module-level init_params here runs at
+# pytest COLLECTION time (imports happen for every selected-or-not run)
+# and its device buffers then sit live under the entire suite — enough
+# native pressure on this toolchain to help tip later allocation-heavy
+# modules (orbax async saves in test_trainer) into native crashes.
+@pytest.fixture(scope="module")
+def PARAMS():
+    return tfm.init_params(jax.random.PRNGKey(0), TARGET)
+
+
+@pytest.fixture(scope="module")
+def DRAFT_P():
+    return tfm.init_params(jax.random.PRNGKey(9), DRAFT)
 
 
 def exact_next_dist(params, cfg, prompt_row, temperature, top_k=0,
@@ -46,8 +59,8 @@ def exact_next_dist(params, cfg, prompt_row, temperature, top_k=0,
     return np.asarray(jax.nn.softmax(_truncate_logits(t, top_k, top_p)))
 
 
-def spec_first_token_counts(draft_p, draft_cfg, temperature, top_k=0,
-                            top_p=0.0, batches=8, rows=256):
+def spec_first_token_counts(params, draft_p, draft_cfg, temperature,
+                            top_k=0, top_p=0.0, batches=8, rows=256):
     """Empirical first-token distribution from speculative sampling:
     ``rows`` identical prompts per call (independent streams), several
     calls with fresh keys."""
@@ -55,7 +68,7 @@ def spec_first_token_counts(draft_p, draft_cfg, temperature, top_k=0,
     counts = np.zeros(VOCAB)
     for i in range(batches):
         out = speculative_generate(
-            PARAMS, TARGET, draft_p, draft_cfg, prompt, 1, n_draft=4,
+            params, TARGET, draft_p, draft_cfg, prompt, 1, n_draft=4,
             temperature=temperature, top_k=top_k, top_p=top_p,
             rng=jax.random.PRNGKey(100 + i))
         toks = np.asarray(out[:, len(PROMPT_ROW)])
@@ -67,34 +80,34 @@ def tv(a, b):
     return 0.5 * float(np.abs(np.asarray(a) - np.asarray(b)).sum())
 
 
-def test_distribution_matches_target_bad_draft():
+def test_distribution_matches_target_bad_draft(PARAMS, DRAFT_P):
     """Draft disagrees often (both accept and reject paths hot): the
     emitted-token distribution must still be the target's, exactly."""
     p_exact = exact_next_dist(PARAMS, TARGET, PROMPT_ROW, 1.0)
-    freq = spec_first_token_counts(DRAFT_P, DRAFT, 1.0)
+    freq = spec_first_token_counts(PARAMS, DRAFT_P, DRAFT, 1.0)
     assert tv(freq, p_exact) < 0.07, (freq, p_exact)
 
 
-def test_distribution_matches_target_perfect_draft():
+def test_distribution_matches_target_perfect_draft(PARAMS):
     """Draft == target: acceptance prob 1 everywhere; still the target
     distribution (and the residual fallback must not fire nonsense)."""
     p_exact = exact_next_dist(PARAMS, TARGET, PROMPT_ROW, 0.7)
-    freq = spec_first_token_counts(PARAMS, TARGET, 0.7)
+    freq = spec_first_token_counts(PARAMS, PARAMS, TARGET, 0.7)
     assert tv(freq, p_exact) < 0.07
 
 
-def test_distribution_matches_under_top_k_top_p():
+def test_distribution_matches_under_top_k_top_p(PARAMS, DRAFT_P):
     """Truncation applies to draft and target alike; emitted tokens keep
     the truncated target distribution and never leave its support."""
     p_exact = exact_next_dist(PARAMS, TARGET, PROMPT_ROW, 1.0,
                               top_k=5, top_p=0.9)
-    freq = spec_first_token_counts(DRAFT_P, DRAFT, 1.0, top_k=5,
-                                   top_p=0.9)
+    freq = spec_first_token_counts(PARAMS, DRAFT_P, DRAFT, 1.0,
+                                   top_k=5, top_p=0.9)
     assert np.all(freq[p_exact == 0.0] == 0.0), "left the nucleus"
     assert tv(freq, p_exact) < 0.07
 
 
-def test_multi_token_stays_in_truncated_support():
+def test_multi_token_stays_in_truncated_support(PARAMS, DRAFT_P):
     """Over a longer sampled generation every token must lie in the
     target's truncated support given its own prefix (teacher-forced
     replay)."""
@@ -117,7 +130,7 @@ def test_multi_token_stays_in_truncated_support():
                 f"row {r} pos {pos + 1}: token {tok} outside top-4")
 
 
-def test_rng_required_and_param_validation():
+def test_rng_required_and_param_validation(PARAMS, DRAFT_P):
     prompt = jnp.asarray([PROMPT_ROW], jnp.int32)
     with pytest.raises(ValueError, match="rng"):
         speculative_generate(PARAMS, TARGET, DRAFT_P, DRAFT, prompt, 4,
@@ -131,7 +144,7 @@ def test_rng_required_and_param_validation():
                              rng=jax.random.PRNGKey(0))
 
 
-def test_sampling_is_deterministic_given_key():
+def test_sampling_is_deterministic_given_key(PARAMS, DRAFT_P):
     prompt = jnp.asarray([PROMPT_ROW], jnp.int32)
     a = speculative_generate(PARAMS, TARGET, DRAFT_P, DRAFT, prompt, 6,
                              temperature=0.9, rng=jax.random.PRNGKey(3))
