@@ -15,6 +15,7 @@ artifact of record — the driver's tail buffer has truncated stdout
 before), then prints the same JSON line.
 """
 import json
+import math
 import sys
 import time
 
@@ -30,6 +31,13 @@ NEW_TOKENS = 64
 PIPELINE_DEPTHS = [1, 2, 4]
 FUSED_STEPS = 4
 OUT_PATH = os.path.join("bench_logs", "bench_serve.json")
+
+# bench SLO targets for the goodput column (operators set their own via
+# nos-tpu-server --slo-ttft-ms/--slo-tpot-ms; these are generous bounds
+# a healthy flagship config should clear, so goodput < 1.0 flags a
+# regression rather than grading the hardware)
+SLO_TTFT_MS = 1000.0
+SLO_TPOT_MS = 100.0
 
 # NOS_TPU_BENCH_SMOKE=1: tiny-shape dry run of the exact code path (see
 # bench_decode.py) — hardware runs must never be the first execution
@@ -134,6 +142,46 @@ def main():
         for _ in range(PIPE_BATCH)]
     pipe_max_len = PIPE_PROMPT + PIPE_NEW + 8
 
+    def pct(xs, q):
+        xs = sorted(xs)
+        return xs[min(len(xs) - 1, max(0, math.ceil(q * len(xs)) - 1))]
+
+    def per_request_stats(ledgers):
+        """TTFT/TPOT/e2e percentiles + goodput from the engine's
+        latency ledgers — the user-experienced view of one rep (the
+        submit loop above is effectively instantaneous next to decode,
+        so queueing is part of the story the percentiles tell)."""
+        ttft = [led["ttft_s"] * 1e3 for led in ledgers
+                if led.get("ttft_s") is not None]
+        tpot = []
+        good = 0
+        for led in ledgers:
+            gaps = led.get("tpot") or ()
+            n = sum(k for _, k in gaps)
+            mean_ms = (sum(g for g, _ in gaps) / n) * 1e3 if n else 0.0
+            if n:
+                tpot.append(mean_ms)
+            ok_ttft = led.get("ttft_s") is not None \
+                and led["ttft_s"] * 1e3 <= SLO_TTFT_MS
+            if ok_ttft and (not n or mean_ms <= SLO_TPOT_MS):
+                good += 1
+        e2e = [led["e2e_s"] * 1e3 for led in ledgers]
+
+        def pcts(xs):
+            if not xs:
+                return {"p50": 0.0, "p95": 0.0, "p99": 0.0}
+            return {"p50": round(pct(xs, 0.50), 3),
+                    "p95": round(pct(xs, 0.95), 3),
+                    "p99": round(pct(xs, 0.99), 3)}
+
+        return {
+            "requests": len(ledgers),
+            "ttft_ms": pcts(ttft),
+            "tpot_ms": pcts(tpot),
+            "e2e_ms": pcts(e2e),
+            "goodput": round(good / len(ledgers), 3) if ledgers else 0.0,
+        }
+
     def pipeline_rep(depth, steps=1):
         eng = DecodeServer(pipe_params, pipe_cfg, max_batch=PIPE_BATCH,
                            max_len=pipe_max_len, pipeline_depth=depth,
@@ -141,6 +189,7 @@ def main():
         for toks in pipe_prompts:                        # warm compiles
             eng.submit(toks, 2)
         eng.drain()
+        eng.drain_ledgers()             # warm-up requests are not data
         best = None
         for _ in range(2):
             for toks in pipe_prompts:
@@ -163,6 +212,7 @@ def main():
                 "host_overhead_pct": round(
                     100.0 * eng.dispatch_gap_s / wall, 1),
                 "sync_path_s": round(eng.host_block_s, 4),
+                "per_request": per_request_stats(eng.drain_ledgers()),
             }
             if best is None or rep["host_blocked_us_per_token"] \
                     < best["host_blocked_us_per_token"]:
@@ -203,6 +253,10 @@ def main():
         "vs_baseline": round(
             (gap_by_depth[1] + 1.0)
             / (gap_by_depth[PIPELINE_DEPTHS[-1]] + 1.0), 3),
+        # per-request SLO frame for every pipeline rep below: the
+        # ledgers grade each config's user-experienced latency against
+        # these targets (goodput = fraction meeting both)
+        "slo": {"ttft_ms": SLO_TTFT_MS, "tpot_ms": SLO_TPOT_MS},
         "pipeline": pipeline,
         "fused_decode": fused,
         "prefix_cache": {

@@ -1,18 +1,21 @@
-"""nos-tpu-metrics-exporter — one-shot cluster telemetry snapshot.
+"""nos-tpu-metrics-exporter — cluster telemetry snapshot.
 
 Analog of cmd/metricsexporter (metricsexporter.go:33-91 + metrics.go:24-42):
-collects cluster facts (nodes, accelerator types, chip counts, quota
-objects) into one JSON document and writes it to a file/stdout. The
-reference POSTs to a vendor endpoint; here upload is gated behind
---endpoint and off by default (and a no-egress environment simply keeps
-the file).
+collects cluster facts (nodes, accelerator types, chip counts — both
+allocatable and USED by bound pods — and quota objects) into one JSON
+document and writes it to a file/stdout. One-shot by default;
+``--interval N`` re-collects every N seconds (rewriting ``--output``
+each cycle) for sidecar-style periodic export. The reference POSTs to a
+vendor endpoint; here upload is gated behind --endpoint and off by
+default (and a no-egress environment simply keeps the file).
 """
 from __future__ import annotations
 
 import argparse
 import json
 import sys
-from typing import Optional, Sequence
+import time
+from typing import Dict, Optional, Sequence
 
 from nos_tpu import constants
 from nos_tpu.cmd import serve
@@ -20,6 +23,21 @@ from nos_tpu.kube.client import Client
 
 
 def collect(client: Client) -> dict:
+    from nos_tpu.tpu.slice import resource_chips
+
+    pods = client.list("Pod")
+    # used chips per node: requests of LIVE pods bound there — pending
+    # pods hold no chips yet, terminated (Succeeded/Failed) pods hold
+    # none anymore even while still bound awaiting GC. The
+    # allocatable-vs-used gap is the snapshot's whole point for
+    # capacity review.
+    used_by_node: Dict[str, float] = {}
+    for p in pods:
+        node = p.spec.node_name
+        if not node or p.status.phase in ("Succeeded", "Failed"):
+            continue
+        used_by_node[node] = \
+            used_by_node.get(node, 0) + resource_chips(p.request())
     nodes = []
     for node in client.list("Node"):
         labels = node.metadata.labels
@@ -29,6 +47,7 @@ def collect(client: Client) -> dict:
             "topology": labels.get(constants.LABEL_TPU_TOPOLOGY),
             "partitioning": labels.get(constants.LABEL_PARTITIONING),
             "tpu_chips": node.status.allocatable.get(constants.RESOURCE_TPU, 0),
+            "tpu_chips_used": used_by_node.get(node.metadata.name, 0),
             "tpu_slices": {
                 k: v for k, v in node.status.allocatable.items()
                 if k.startswith(constants.RESOURCE_TPU_SLICE_PREFIX)
@@ -54,7 +73,6 @@ def collect(client: Client) -> dict:
         }
         for q in client.list("CompositeElasticQuota")
     ]
-    pods = client.list("Pod")
     return {
         "version": "v0.1",
         "nodes": nodes,
@@ -82,25 +100,46 @@ def main(argv: Optional[Sequence[str]] = None) -> None:
         "--endpoint", default=None,
         help="optional URL to POST the snapshot to (disabled by default)",
     )
+    parser.add_argument(
+        "--interval", type=float, default=0.0,
+        help="seconds between snapshot re-collections (0 = one-shot, "
+             "the default); periodic mode rewrites --output each cycle "
+             "until interrupted",
+    )
     args = parser.parse_args(argv)
     serve.setup_observability(args)
 
     client = Client(serve.connect(args))
-    doc = json.dumps(collect(client), indent=2, sort_keys=True)
-    if args.output == "-":
-        print(doc)
-    else:
-        with open(args.output, "w") as f:
-            f.write(doc + "\n")
-    if args.endpoint:
-        import urllib.request
 
-        req = urllib.request.Request(
-            args.endpoint, data=doc.encode(), method="POST",
-            headers={"Content-Type": "application/json"},
-        )
-        with urllib.request.urlopen(req, timeout=30) as resp:
-            print(f"uploaded: HTTP {resp.status}", file=sys.stderr)
+    def snapshot_once() -> None:
+        doc = json.dumps(collect(client), indent=2, sort_keys=True)
+        if args.output == "-":
+            print(doc)
+        else:
+            with open(args.output, "w") as f:
+                f.write(doc + "\n")
+        if args.endpoint:
+            import urllib.request
+
+            req = urllib.request.Request(
+                args.endpoint, data=doc.encode(), method="POST",
+                headers={"Content-Type": "application/json"},
+            )
+            with urllib.request.urlopen(req, timeout=30) as resp:
+                print(f"uploaded: HTTP {resp.status}", file=sys.stderr)
+
+    snapshot_once()     # one-shot mode: a failure exits loudly
+    while args.interval > 0:
+        try:
+            time.sleep(args.interval)
+        except KeyboardInterrupt:
+            break
+        try:
+            snapshot_once()
+        except Exception as e:      # noqa: BLE001 — sidecar keeps going
+            # periodic mode is a long-lived sidecar: one transient API
+            # or upload failure must not kill the export loop
+            print(f"snapshot failed (will retry): {e}", file=sys.stderr)
 
 
 if __name__ == "__main__":

@@ -26,9 +26,24 @@ OPENMETRICS_CONTENT_TYPE = \
     "application/openmetrics-text; version=1.0.0; charset=utf-8"
 
 
+def metrics_payload(accept: str = "") -> tuple:
+    """(body, content_type) for a /metrics scrape, content-negotiated:
+    an ``Accept`` header asking for ``application/openmetrics-text``
+    gets the OpenMetrics dialect (histogram buckets carry trace
+    exemplars); everything else gets the classic Prometheus text
+    format. Shared by HealthServer and the serving binary's bespoke
+    HTTP surface (cmd/server.py) so the two expose identically."""
+    if "application/openmetrics-text" in (accept or ""):
+        return (default_registry().expose(openmetrics=True),
+                OPENMETRICS_CONTENT_TYPE)
+    return default_registry().expose(), "text/plain; version=0.0.4"
+
+
 class HealthServer:
     """Serves /healthz, /readyz, /metrics and /debug/traces for one
-    binary. /metrics content-negotiates: an ``Accept`` header asking for
+    binary — plus /stats when the hosted manager exposes a live
+    introspection snapshot (``stats() -> dict``). /metrics
+    content-negotiates: an ``Accept`` header asking for
     ``application/openmetrics-text`` gets the OpenMetrics dialect with
     trace exemplars on histogram buckets; everything else gets the
     classic Prometheus text format."""
@@ -57,13 +72,19 @@ class HealthServer:
                     ok = mgr.readyz() if mgr is not None else True
                     self._send(200 if ok else 500, "ok" if ok else "not ready")
                 elif self.path == "/metrics":
-                    accept = self.headers.get("Accept", "")
-                    if "application/openmetrics-text" in accept:
-                        self._send(200,
-                                   default_registry().expose(openmetrics=True),
-                                   OPENMETRICS_CONTENT_TYPE)
+                    body, ctype = metrics_payload(
+                        self.headers.get("Accept", ""))
+                    self._send(200, body, ctype)
+                elif self.path == "/stats":
+                    # live introspection: any manager exposing stats()
+                    # serves its JSON snapshot here (the serving binary
+                    # has its own richer handler in cmd/server.py)
+                    stats = getattr(mgr, "stats", None)
+                    if stats is None:
+                        self._send(404, "not found")
                     else:
-                        self._send(200, default_registry().expose())
+                        self._send(200, json.dumps(stats()),
+                                   "application/json")
                 elif self.path == "/debug/traces":
                     self._send(200, json.dumps(tracing.recorder().to_json()),
                                "application/json")

@@ -6,7 +6,14 @@ minimal HTTP API.
                          "temperature": T?, "top_k": K?, "top_p": P?,
                          "seed": S?}
                         -> {"tokens": [full sequence]}
-    GET  /healthz       -> ok
+    GET  /healthz       -> ok          GET /readyz  -> ok | draining
+    GET  /metrics       Prometheus text (OpenMetrics + exemplars when
+                        Accept asks for it)
+    GET  /stats         live JSON snapshot: active slots, pending
+                        queue, pipeline window, prefix cache,
+                        SLO/goodput, rolling request/token rates
+    GET  /debug/traces  tracing flight recorder (serve.request spans;
+                        SLO-breaching requests pinned)
 
 Requests batch continuously: concurrent POSTs share the engine's decode
 ticks (one compiled program per tick serves every active slot), each
@@ -20,15 +27,36 @@ import json
 import logging
 import threading
 import time
+from collections import deque
 from dataclasses import dataclass, fields
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional, Sequence
 
+from nos_tpu.cmd.serve import metrics_payload
 from nos_tpu.models.errors import QueueFull  # jax-free module: keeps this
                                              # file importable without jax
+from nos_tpu.obs import tracing
 from nos_tpu.utils.metrics import default_registry
 
 logger = logging.getLogger("nos_tpu.server")
+
+# terminal request outcomes: every request that enters the serving loop
+# leaves through exactly ONE of these, incrementing
+# nos_tpu_serve_requests_total{outcome} exactly once (pinned by tests)
+OUTCOMES = ("finished", "cancelled", "abandoned", "rejected", "failed")
+
+# TTFT spans prefill (ms on warm buckets) through queueing storms (s);
+# TPOT is per-token (sub-ms fused to ~100ms on big models); compiles
+# run seconds to minutes on real toolchains
+TTFT_BUCKETS = (0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5,
+                5.0, 10.0, 30.0)
+TPOT_BUCKETS = (0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1,
+                0.25, 0.5, 1.0)
+COMPILE_BUCKETS = (0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0,
+                   120.0, 300.0)
+
+# rolling-rate window for the /stats snapshot
+RATE_WINDOW_S = 60.0
 
 
 @dataclass
@@ -95,6 +123,17 @@ class ServerConfig:
     port: int = 8000
     seed: int = 0
     log_level: str = "info"
+    # request-level SLO targets (0 = unset): a completed request meets
+    # its SLO when TTFT (submit -> first token observed) and mean TPOT
+    # (inter-token, first token excluded) are within these bounds.
+    # Feeds nos_tpu_serve_slo_total{slo,outcome} and the goodput gauge;
+    # a breach pins the request's trace in the flight recorder.
+    slo_ttft_ms: float = 0.0
+    slo_tpot_ms: float = 0.0
+    # device-runtime telemetry cadence (seconds; 0 disables): samples
+    # device.memory_stats() into the HBM gauges at most this often —
+    # guarded, so backends without memory stats (CPU) just skip.
+    device_stats_interval_s: float = 10.0
     # SIGTERM → stop admitting (503 + readyz flips so the Service pulls
     # this endpoint), let in-flight requests finish up to this budget,
     # then exit — the Kubernetes termination contract. Keep it under
@@ -134,23 +173,22 @@ class ServingLoop:
     flips to 500 so orchestration restarts the pod instead of every
     request silently burning its timeout."""
 
-    def __init__(self, engine):
+    def __init__(self, engine, slo_ttft_ms: float = 0.0,
+                 slo_tpot_ms: float = 0.0,
+                 device_stats_interval_s: float = 0.0):
         reg = default_registry()
         # register() is idempotent per (name, type, labels) and raises on
         # a mismatched re-registration — exactly what we want at startup
         self.m_requests = reg.counter(
             "nos_tpu_serve_requests_total",
-            "Requests completed by the serving loop")
+            "Requests leaving the serving loop, by terminal outcome "
+            "(finished | cancelled | abandoned | rejected | failed); "
+            "every request increments exactly one outcome exactly once",
+            ("outcome",))
         self.m_tokens = reg.counter(
             "nos_tpu_serve_tokens_total", "Tokens emitted by decode ticks")
         self.m_ticks = reg.counter(
             "nos_tpu_serve_ticks_total", "Decode ticks executed")
-        self.m_abandoned = reg.counter(
-            "nos_tpu_serve_abandoned_total",
-            "Requests that finished after their client timed out")
-        self.m_rejected = reg.counter(
-            "nos_tpu_serve_rejected_total",
-            "Requests shed at admission (QueueFull -> 429)")
         self.g_active = reg.gauge(
             "nos_tpu_serve_active_slots", "Slots decoding right now")
         self.g_pending = reg.gauge(
@@ -179,15 +217,70 @@ class ServingLoop:
             "Per-tick dispatch gap: time the engine had no decode tick "
             "in flight while decodable slots existed (the accelerator "
             "host-blocked behind bookkeeping)")
+        # request-level latency ledger surface (engine stamps, this loop
+        # observes at completion — nothing here runs per token on the
+        # hot tick path; buckets carry trace exemplars of the request's
+        # serve.request span when sampled)
+        self.h_queue = reg.histogram(
+            "nos_tpu_serve_queue_seconds",
+            "Submit -> admitted-to-slot wait per request")
+        self.h_ttft = reg.histogram(
+            "nos_tpu_serve_ttft_seconds",
+            "Time to first token: submit -> first token observed on the "
+            "host (includes queueing and prefill)",
+            buckets=TTFT_BUCKETS)
+        self.h_tpot = reg.histogram(
+            "nos_tpu_serve_tpot_seconds",
+            "Time per output token (inter-token, first token excluded); "
+            "tokens observed in one arrival share the arrival gap evenly",
+            buckets=TPOT_BUCKETS)
+        self.h_e2e = reg.histogram(
+            "nos_tpu_serve_e2e_seconds",
+            "Submit -> terminal per request (finished or cancelled)")
+        self.m_slo = reg.counter(
+            "nos_tpu_serve_slo_total",
+            "Completed requests judged against the configured SLO "
+            "targets, by slo (ttft | tpot) and outcome (met | breached)",
+            ("slo", "outcome"))
+        self.g_goodput = reg.gauge(
+            "nos_tpu_serve_goodput_ratio",
+            "Fraction of completed requests meeting every configured "
+            "SLO target (0 until the first completion; absent when no "
+            "SLO is configured)")
+        self.m_compiles = reg.counter(
+            "nos_tpu_serve_compiles_total",
+            "XLA compiles observed by the engine (first dispatch per "
+            "shape: prefill buckets, decode program variants)")
+        self.h_compile = reg.histogram(
+            "nos_tpu_serve_compile_seconds",
+            "Wall time of each first-dispatch-per-shape call (traces + "
+            "compiles synchronously)",
+            buckets=COMPILE_BUCKETS)
         self.engine = engine
+        self._slo_ttft_s = (slo_ttft_ms or 0.0) / 1e3
+        self._slo_tpot_s = (slo_tpot_ms or 0.0) / 1e3
+        self._goodput_done = 0
+        self._goodput_good = 0
+        self._spans: dict = {}          # rid -> serve.request span
+        self._failed_drained: set = set()   # rids accounted as failed
+        # rolling request/token rates for /stats: (monotonic t,
+        # tokens_cum, finished_cum) appended per tick/completion,
+        # pruned to the last RATE_WINDOW_S seconds
+        self._rates: deque = deque()
+        self._tokens_cum = 0
+        self._finished_cum = 0
+        self._dev_interval = device_stats_interval_s or 0.0
+        self._dev_next = 0.0
         self._lock = threading.Lock()
         self._work = threading.Condition(self._lock)
         self._stop = False
         self._draining = False
         self._failed: Optional[BaseException] = None
         self._abandoned: set = set()        # rids whose client timed out
-        self.m_rejected.inc(0)          # export 0, not an absent series
+        for outcome in OUTCOMES:        # export 0s, not absent series
+            self.m_requests.labels(outcome).inc(0)
         self._mirror_engine_gauges()
+        self._sample_device_stats()
         self._thread = threading.Thread(target=self._run, daemon=True)
         self._thread.start()
 
@@ -225,10 +318,199 @@ class ServingLoop:
         BEFORE the single notify_all, so every wait_idle/stream waiter —
         re-checking under this same lock — observes healthy == False by
         the time it returns. Exactly one wakeup; the ticker thread exits
-        right after."""
+        right after. Abandoned requests are drained as ``failed`` here:
+        the ticker that would have reaped them is the thing dying, so
+        nothing else will ever account for them."""
         logger.exception("decode tick failed; marking unhealthy")
         self._failed = e
+        for rid in self._abandoned:
+            self._account(rid, "failed", self._pop_ledger(rid))
+            self._failed_drained.add(rid)
+        self._abandoned.clear()
         self._work.notify_all()
+
+    # -- request-level accounting (the latency ledger's consumer) -------
+    def _pop_ledger(self, rid: int) -> Optional[dict]:
+        pop = getattr(self.engine, "pop_ledger", None)
+        return pop(rid) if pop is not None else None
+
+    def _account(self, rid: int, outcome: str,
+                 ledger: Optional[dict]) -> None:
+        """Terminal accounting for ONE request (caller holds the lock):
+        increments exactly one requests_total outcome, feeds the
+        TTFT/TPOT/queue/e2e histograms from the engine's ledger, judges
+        the SLO targets, and closes the request's serve.request span —
+        an SLO breach marks the span and pins its trace in the flight
+        recorder, so a breached counter always has a trace to open."""
+        self.m_requests.labels(outcome).inc()
+        sp = self._spans.pop(rid, None)
+        tid = (sp.trace_id or None) if sp is not None else None
+        breaches = []
+        decode_tokens = 0
+        gap_sum = 0.0
+        if ledger:
+            if ledger.get("queue_s") is not None:
+                self.h_queue.observe(max(0.0, ledger["queue_s"]),
+                                     trace_id=tid)
+            ttft = ledger.get("ttft_s")
+            if ttft is not None:
+                self.h_ttft.observe(ttft, trace_id=tid)
+            for gap, n in ledger.get("tpot") or ():
+                # one weighted observe per arrival: n tokens sharing the
+                # arrival gap must not pay n bucket walks under the lock
+                self.h_tpot.observe(gap / n, trace_id=tid, count=n)
+                decode_tokens += n
+                gap_sum += gap
+            if ledger.get("e2e_s") is not None:
+                self.h_e2e.observe(ledger["e2e_s"], trace_id=tid)
+            if outcome == "finished" \
+                    and (self._slo_ttft_s or self._slo_tpot_s):
+                good = True
+                if self._slo_ttft_s and ttft is not None:
+                    met = ttft <= self._slo_ttft_s
+                    self.m_slo.labels(
+                        "ttft", "met" if met else "breached").inc()
+                    if not met:
+                        good = False
+                        breaches.append("ttft")
+                if self._slo_tpot_s and decode_tokens:
+                    met = gap_sum / decode_tokens <= self._slo_tpot_s
+                    self.m_slo.labels(
+                        "tpot", "met" if met else "breached").inc()
+                    if not met:
+                        good = False
+                        breaches.append("tpot")
+                self._goodput_done += 1
+                if good:
+                    self._goodput_good += 1
+                self.g_goodput.set(
+                    self._goodput_good / self._goodput_done)
+        if sp is not None and sp.recording:
+            sp.set_attr("outcome", outcome)
+            if ledger:
+                if ledger.get("ttft_s") is not None:
+                    sp.set_attr("ttft_ms",
+                                round(ledger["ttft_s"] * 1e3, 3))
+                sp.set_attr("output_tokens",
+                            ledger.get("output_tokens", 0))
+            if breaches:
+                sp.set_attr("slo_breach", ",".join(breaches))
+                tracing.recorder().pin(sp.trace_id, "slo")
+            sp.end()
+        if outcome in ("finished", "abandoned"):
+            self._finished_cum += 1
+            self._note_rates()
+
+    def _note_rates(self) -> None:
+        """Append a (t, tokens, requests) mark and prune the rolling
+        window — /stats reads request/token rates from the ends."""
+        now = time.monotonic()
+        self._rates.append((now, self._tokens_cum, self._finished_cum))
+        cutoff = now - RATE_WINDOW_S
+        while len(self._rates) > 1 and self._rates[0][0] < cutoff:
+            self._rates.popleft()
+
+    def _drain_compile_events(self) -> None:
+        """Engine-side compile accounting -> metrics (caller holds the
+        lock; the engine appends events under the same lock)."""
+        events = getattr(self.engine, "compile_events", None)
+        if events:
+            self.engine.compile_events = []
+            for dt in events:
+                self.m_compiles.inc()
+                self.h_compile.observe(dt)
+
+    def _sample_device_stats(self) -> None:
+        """Bounded-cadence device-runtime telemetry: HBM bytes in
+        use/limit per local device via device.memory_stats(). Guarded —
+        the CPU backend (and any backend without memory stats) just
+        never exports the gauges; a telemetry failure must never take
+        the serving loop down."""
+        if self._dev_interval <= 0:
+            return
+        now = time.monotonic()
+        if now < self._dev_next:
+            return
+        self._dev_next = now + self._dev_interval
+        try:
+            import jax
+
+            devices = jax.devices()
+        except Exception:
+            self._dev_interval = 0.0    # no runtime: stop trying
+            return
+        reg = default_registry()
+        for d in devices:
+            try:
+                stats = d.memory_stats()
+            except Exception:
+                stats = None
+            if not stats:
+                continue
+            label = f"{d.platform}:{d.id}"
+            in_use = stats.get("bytes_in_use")
+            if in_use is not None:
+                reg.gauge(
+                    "nos_tpu_device_hbm_bytes_in_use",
+                    "Device memory (HBM) bytes currently allocated, per "
+                    "local device (absent on backends without "
+                    "memory_stats, e.g. CPU)",
+                    ("device",)).labels(label).set(in_use)
+            limit = stats.get("bytes_limit") \
+                or stats.get("bytes_reservable_limit")
+            if limit:
+                reg.gauge(
+                    "nos_tpu_device_hbm_bytes_limit",
+                    "Device memory (HBM) byte capacity, per local device",
+                    ("device",)).labels(label).set(limit)
+
+    def stats(self) -> dict:
+        """The /stats snapshot: the engine's live introspection (slots,
+        pending queue, pipeline window, prefix cache, compiles) plus
+        loop-level health, SLO/goodput state and rolling rates."""
+        with self._work:
+            engine_stats = getattr(self.engine, "stats", None)
+            snap = dict(engine_stats()) if engine_stats is not None \
+                else {}
+            if "slots" not in snap:
+                occupancy = getattr(self.engine, "occupancy", None)
+                if occupancy is not None:
+                    active, pending = occupancy()
+                    snap["active_slots"] = active
+                    snap["pending"] = {"depth": pending}
+            # rates age against NOW, not the last mark: marks are only
+            # appended on ticks/completions, so an idle server's window
+            # must decay to zero here rather than freeze at the last
+            # active minute's throughput
+            now = time.monotonic()
+            window = [m for m in self._rates
+                      if m[0] >= now - RATE_WINDOW_S]
+            if window and now > window[0][0]:
+                dt = now - window[0][0]
+                rates = {
+                    "window_s": round(dt, 3),
+                    "tokens_per_s": round(
+                        (self._tokens_cum - window[0][1]) / dt, 3),
+                    "requests_per_s": round(
+                        (self._finished_cum - window[0][2]) / dt, 3),
+                }
+            else:
+                rates = {"window_s": 0.0, "tokens_per_s": 0.0,
+                         "requests_per_s": 0.0}
+            snap.update({
+                "healthy": self.healthy,
+                "draining": self._draining,
+                "slo": {
+                    "ttft_ms": round(self._slo_ttft_s * 1e3, 3),
+                    "tpot_ms": round(self._slo_tpot_s * 1e3, 3),
+                    "completed": self._goodput_done,
+                    "goodput": (round(self._goodput_good
+                                      / self._goodput_done, 4)
+                                if self._goodput_done else None),
+                },
+                "rates": rates,
+            })
+        return snap
 
     def _run(self) -> None:
         # engines exposing the split-step protocol (DecodeServer) run
@@ -238,7 +520,6 @@ class ServingLoop:
         split = hasattr(self.engine, "step_begin") \
             and hasattr(self.engine, "step_wait") \
             and hasattr(self.engine, "step_finish")
-        from nos_tpu.obs import tracing
         while True:
             sp = None
             with self._work:
@@ -284,18 +565,29 @@ class ServingLoop:
                                 trace_id=sp.trace_id or None)
                     self.m_ticks.inc()
                     self.m_tokens.inc(emitted)
+                    self._tokens_cum += emitted
+                    self._note_rates()
                     self._mirror_engine_gauges()
+                    self._sample_device_stats()
                     # reap results whose client already gave up, so
                     # _done can't grow from timed-out requests. Inside
                     # the try: a failure here (engine died mid-reap)
                     # must flip /healthz and wake waiters like any
                     # other tick failure, not kill the ticker silently
                     for rid in list(self._abandoned):
+                        ledger = self._pop_ledger(rid)
                         if self.engine.pop_result(rid) is not None:
                             self._abandoned.discard(rid)
                             # completed work, even if nobody is waiting
-                            self.m_requests.inc()
-                            self.m_abandoned.inc()
+                            self._account(rid, "abandoned", ledger)
+                        elif self.engine.progress(rid) is None:
+                            # the engine no longer knows the request at
+                            # all (its cancel dropped it outright): no
+                            # result will ever be poppable — resolve it
+                            # NOW, or it never earns its exactly-one
+                            # terminal outcome
+                            self._abandoned.discard(rid)
+                            self._account(rid, "cancelled", ledger)
                 except BaseException as e:
                     sp.end()
                     self._fail(e)
@@ -317,24 +609,45 @@ class ServingLoop:
 
     def _forget(self, rid: int) -> None:
         """Idempotently drop a request in whatever state it is: pop it if
-        finished (counting the completion), mark it abandoned if still
-        decoding (the ticker reaps it), no-op if already handed out. Runs
-        from stream teardown — including client disconnects that land
-        exactly at completion, when the ticker may never tick again on an
-        idle server."""
+        resolvable (accounting the terminal outcome), mark it abandoned
+        if still decoding (the ticker reaps it), no-op if already handed
+        out. Runs from stream teardown — including client disconnects
+        that land exactly at completion, when the ticker may never tick
+        again on an idle server. Outcomes: ``cancelled`` for a client
+        that walked away (disconnect/timeout), ``failed`` when the pop
+        happens during an engine-failure or shutdown drain — the request
+        didn't fail its client, the server failed the request."""
         with self._work:
             if self.engine.progress(rid) is None:
                 self._abandoned.discard(rid)    # already popped
                 return
+            draining_out = self._failed is not None or self._stop
             # stop burning ticks on output nobody will read: cancel frees
             # the slot immediately (engines without cancel — test stubs —
-            # fall back to reap-after-completion)
+            # fall back to reap-after-completion). A dead engine is not
+            # asked to mutate its batch.
             cancel = getattr(self.engine, "cancel", None)
-            if cancel is not None:
+            if cancel is not None and self._failed is None:
                 cancel(rid)
+            ledger = self._pop_ledger(rid)
             if self.engine.pop_result(rid) is not None:
-                self.m_requests.inc()
-                self.m_abandoned.inc()
+                self._account(rid, "failed" if draining_out
+                              else "cancelled", ledger)
+                self._abandoned.discard(rid)
+            elif draining_out:
+                # engine-failure/shutdown drain: no tick will ever
+                # finish this request and no reap will ever pop it —
+                # account it NOW, exactly once
+                if rid not in self._failed_drained:
+                    self._failed_drained.add(rid)
+                    self._account(rid, "failed", ledger)
+                self._abandoned.discard(rid)
+            elif self.engine.progress(rid) is None:
+                # cancel dropped the request outright (nothing poppable,
+                # engine no longer knows it) and the engine may be idle:
+                # no tick's reap will ever resolve it — terminal NOW, or
+                # it never earns its exactly-one outcome
+                self._account(rid, "cancelled", ledger)
                 self._abandoned.discard(rid)
             else:
                 self._abandoned.add(rid)
@@ -358,6 +671,7 @@ class ServingLoop:
             active, pending = occupancy()
             self.g_active.set(active)
             self.g_pending.set(pending)
+        self._drain_compile_events()
 
     def stream(self, prompt, max_new_tokens, timeout: float = 300.0,
                **sampling):
@@ -377,8 +691,17 @@ class ServingLoop:
             try:
                 rid = self.engine.submit(prompt, max_new_tokens, **sampling)
             except QueueFull:
-                self.m_rejected.inc()
+                self.m_requests.labels("rejected").inc()
                 raise
+            # one span per REQUEST (not per token): the request's
+            # journey through the serving loop, closed by _account with
+            # its outcome and latency attrs — SLO breaches pin it
+            sp = tracing.start_span(
+                "serve.request", component="server",
+                attrs={"prompt_tokens": len(prompt),
+                       "max_new_tokens": max_new_tokens})
+            if sp.recording:
+                self._spans[rid] = sp
             self._mirror_engine_gauges()
             self._work.notify_all()
 
@@ -396,8 +719,9 @@ class ServingLoop:
                         toks, done = prog
                         delta = toks[sent:]
                         if done:
+                            ledger = self._pop_ledger(rid)
                             self.engine.pop_result(rid)
-                            self.m_requests.inc()
+                            self._account(rid, "finished", ledger)
                             finished = True
                         elif not delta:
                             if self._failed is not None:
@@ -583,13 +907,36 @@ def make_http_server(cfg: ServerConfig, loop: ServingLoop
                 else:
                     self._reply(200, {"status": "ok"})
             elif self.path == "/metrics":
-                body = default_registry().expose().encode()
+                # content-negotiated like every daemon (cmd/serve.py):
+                # an openmetrics Accept gets exemplar-bearing buckets,
+                # so TTFT/TPOT drill down to concrete request traces
+                text, ctype = metrics_payload(
+                    self.headers.get("Accept", ""))
+                body = text.encode()
                 self.send_response(200)
-                self.send_header("Content-Type",
-                                 "text/plain; version=0.0.4")
+                self.send_header("Content-Type", ctype)
                 self.send_header("Content-Length", str(len(body)))
                 self.end_headers()
                 self.wfile.write(body)
+            elif self.path == "/stats":
+                # live engine introspection: active slots, pending
+                # queue, pipeline window, prefix cache, SLO/goodput,
+                # rolling rates — the operator's first stop before
+                # metrics history or traces
+                self._reply(200, loop.stats())
+            elif self.path == "/debug/traces":
+                self._reply(200, tracing.recorder().to_json())
+            elif self.path.startswith("/debug/traces/"):
+                tid = self.path.rsplit("/", 1)[1]
+                spans = tracing.recorder().trace(tid)
+                if not spans:
+                    self._reply(404, {"error": "unknown trace",
+                                      "trace_id": tid})
+                else:
+                    self._reply(200, {
+                        "trace_id": tid,
+                        "spans": [sp.to_dict() for sp in spans],
+                    })
             else:
                 self._reply(404, {"error": f"unknown path {self.path}"})
 
@@ -718,6 +1065,19 @@ def main(argv: Optional[Sequence[str]] = None) -> None:
         help="decode steps fused into one compiled dispatch "
              "(1 = off; overrides config)")
     parser.add_argument(
+        "--slo-ttft-ms", type=float, default=None,
+        help="time-to-first-token SLO target in ms (0 = unset; feeds "
+             "nos_tpu_serve_slo_total and the goodput gauge; overrides "
+             "config)")
+    parser.add_argument(
+        "--slo-tpot-ms", type=float, default=None,
+        help="mean time-per-output-token SLO target in ms (0 = unset; "
+             "overrides config)")
+    parser.add_argument(
+        "--device-stats-interval", type=float, default=None,
+        help="seconds between device.memory_stats() samples into the "
+             "HBM gauges (0 disables; overrides config)")
+    parser.add_argument(
         "--log-format", choices=("text", "json"), default="text",
         help="log line format; json emits one object per line with "
              "trace_id/span_id injected when a tracing span is active")
@@ -733,12 +1093,21 @@ def main(argv: Optional[Sequence[str]] = None) -> None:
         cfg.pipeline_depth = args.pipeline_depth
     if args.decode_steps is not None:
         cfg.decode_steps = args.decode_steps
+    if args.slo_ttft_ms is not None:
+        cfg.slo_ttft_ms = args.slo_ttft_ms
+    if args.slo_tpot_ms is not None:
+        cfg.slo_tpot_ms = args.slo_tpot_ms
+    if args.device_stats_interval is not None:
+        cfg.device_stats_interval_s = args.device_stats_interval
     from nos_tpu.cmd import setup_logging as _shared_setup_logging
     _shared_setup_logging(
         0, args.log_format,
         numeric_level=getattr(logging, cfg.log_level.upper(), 20))
 
-    loop = ServingLoop(build_engine(cfg))
+    loop = ServingLoop(
+        build_engine(cfg), slo_ttft_ms=cfg.slo_ttft_ms,
+        slo_tpot_ms=cfg.slo_tpot_ms,
+        device_stats_interval_s=cfg.device_stats_interval_s)
     httpd = make_http_server(cfg, loop)
 
     def _finish_drain():

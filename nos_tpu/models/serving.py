@@ -58,6 +58,68 @@ def _bucket(n: int) -> int:
     return b
 
 
+class _Ledger:
+    """Per-request lifecycle stamps on the host monotonic clock
+    (time.perf_counter): submitted -> admitted-to-slot -> prefill
+    start/end -> first token observed -> per-arrival token batches ->
+    done. The serving loop turns a finished ledger into the TTFT / TPOT
+    / queue / e2e histograms, so the stamps measure what the USER
+    experiences — a completion observed ``pipeline_depth`` ticks late
+    is stamped at observation, because that is when its tokens become
+    visible to the client.
+
+    TPOT bookkeeping is lazy: one clock read per consumed arrival (not
+    per token), stored as ``(gap_s, n_tokens)`` pairs — an arrival that
+    lands ``n`` tokens at once attributes ``gap/n`` to each. Because
+    ``t_last`` only ever advances and tokens are attributed exactly at
+    the arrival that appended them, a pipeline rollback (over-decoded
+    ticks whose tokens are never appended) can produce neither negative
+    nor duplicate samples by construction."""
+
+    __slots__ = ("t_submit", "t_admit", "t_prefill_start", "t_prefill_end",
+                 "t_first", "t_last", "t_done", "outcome", "tpot")
+
+    def __init__(self, now: float):
+        self.t_submit = now
+        self.t_admit = 0.0
+        self.t_prefill_start = 0.0
+        self.t_prefill_end = 0.0
+        self.t_first = 0.0          # first token observed on the host
+        self.t_last = 0.0           # most recent token observation
+        self.t_done = 0.0
+        self.outcome: Optional[str] = None
+        self.tpot: List[Tuple[float, int]] = []     # (gap_s, tokens)
+
+    def note_tokens(self, n: int, now: float) -> None:
+        """Attribute ``n`` tokens observed at host instant ``now``. The
+        first token (prefill) only arms ``t_last`` — TPOT is the
+        inter-token series with the first token excluded."""
+        last = self.t_last
+        if last:
+            self.tpot.append((max(0.0, now - last), n))
+        self.t_last = now
+
+    def snapshot(self, req: "_Request") -> dict:
+        """The finished-request record the serving loop and benches
+        read. ``ttft_s`` is None for a request that never produced a
+        token (cancelled while pending)."""
+        admitted = self.t_admit > 0.0
+        return {
+            "rid": req.rid,
+            "outcome": self.outcome or "finished",
+            "prompt_tokens": len(req.prompt),
+            "output_tokens": min(len(req.out), req.max_new_tokens),
+            "queue_s": (self.t_admit if admitted else self.t_done)
+            - self.t_submit,
+            "prefill_s": (self.t_prefill_end - self.t_prefill_start
+                          if self.t_prefill_end else None),
+            "ttft_s": (self.t_first - self.t_submit
+                       if self.t_first else None),
+            "e2e_s": self.t_done - self.t_submit,
+            "tpot": list(self.tpot),
+        }
+
+
 @dataclass
 class _Request:
     rid: int
@@ -71,6 +133,7 @@ class _Request:
     slot: int = -1
     cache_prefix: bool = False
     stop_tokens: tuple = ()
+    led: Optional[_Ledger] = None
 
     def note_token(self) -> None:
         """Called after each appended token: a stop token terminates the
@@ -204,6 +267,28 @@ class DecodeServer:
         self.pipeline_flushes = 0
         self.tokens_emitted = 0
         self._idle_since: Optional[float] = None
+        # request-level latency ledger (see _Ledger): always stamps the
+        # per-REQUEST milestones (submit/admit/prefill/first/done — a
+        # handful of clock reads per request); ``ledger_enabled`` gates
+        # only the per-ARRIVAL TPOT stamping on the hot tick path, so
+        # the overhead guard can compare the instrumented tick path
+        # against the bare one. Finished ledgers park in ``_ledgers``
+        # (FIFO-capped: a library caller that never reads them must not
+        # leak) until pop_ledger/drain_ledgers collects them.
+        self.ledger_enabled = True
+        self.ledger_cap = 4096
+        self._ledgers: Dict[int, dict] = {}
+        # first-dispatch-per-shape compile accounting: the first call
+        # into a jitted program at a new shape key traces + compiles
+        # synchronously, so timing that call isolates XLA compile cost
+        # (an admission storm hitting cold prefill buckets shows up
+        # here, not as mystery tick latency). ``compile_events`` holds
+        # individual durations until the serving loop drains them into
+        # nos_tpu_serve_compile_seconds.
+        self._compiled: set = set()
+        self.compiles = 0
+        self.compile_s = 0.0
+        self.compile_events: List[float] = []
         # chunked prefill (prefill_chunk > 0): a long prompt's prefill
         # runs as fixed-size chunks interleaved with decode ticks — one
         # chunk per step() — so admitting a 32k-token request delays the
@@ -356,7 +441,8 @@ class DecodeServer:
             top_p=float(top_p),
             seed=(rid if seed is None else int(seed)) & 0xFFFFFFFF,
             cache_prefix=bool(cache_prefix) and self._prefix_max > 0,
-            stop_tokens=tuple(int(t) for t in stop_tokens or ())))
+            stop_tokens=tuple(int(t) for t in stop_tokens or ()),
+            led=_Ledger(time.perf_counter())))
         self._admit()
         return rid
 
@@ -372,7 +458,34 @@ class DecodeServer:
             slot = self._free.popleft()
             req.slot = slot
             self._active[slot] = req
+            # admitted-to-slot: prefill starts immediately (one-shot or
+            # the first chunk of a chunked admission)
+            req.led.t_admit = req.led.t_prefill_start = time.perf_counter()
             self._prefill_slot(req)
+
+    def _timed_dispatch(self, key: tuple, fn, *args):
+        """Run ``fn`` and, on its FIRST call per shape ``key``, time it
+        as a compile event: a jitted program traces + compiles
+        synchronously inside that call, so the duration isolates XLA
+        compile cost from steady-state dispatch. Steady-state calls pay
+        one set lookup — nothing else."""
+        if key in self._compiled:
+            return fn(*args)
+        t0 = time.perf_counter()
+        out = fn(*args)
+        dt = time.perf_counter() - t0
+        self._compiled.add(key)
+        self.compiles += 1
+        self.compile_s += dt
+        self.compile_events.append(dt)
+        return out
+
+    def _run_prefill(self, toks, row):
+        """Prefill forward with compile accounting keyed by the shapes
+        XLA keys on: (token bucket, scratch row length)."""
+        return self._timed_dispatch(
+            ("prefill", toks.shape[1], row["k"].shape[3]),
+            self._prefill, self.params, toks, row)
 
     @functools.lru_cache(maxsize=None)      # noqa: B019 — engine-lived
     def _row_zeros(self, bucket: int):
@@ -478,14 +591,14 @@ class DecodeServer:
             suffix = req.prompt[m:]
             toks = jnp.asarray(
                 [suffix + [0] * (sbucket - len(suffix))], jnp.int32)
-            logits, row = self._prefill(self.params, toks, row)
+            logits, row = self._run_prefill(toks, row)
             step = logits[0, len(suffix) - 1]
         else:
             # pad to the row length (not the raw bucket): _bucket can
             # round past max_len and the write must fit the scratch
             toks = jnp.asarray(
                 [req.prompt + [0] * (bucket - plen)], jnp.int32)
-            logits, row = self._prefill(self.params, toks, row)
+            logits, row = self._run_prefill(toks, row)
             step = logits[0, plen - 1]
         self._finish_prefill(req, row, step)
 
@@ -561,7 +674,7 @@ class DecodeServer:
         rem = len(toks_list)
         rbucket = _bucket(rem) if not ent["todo"] else rem
         toks = jnp.asarray([toks_list + [0] * (rbucket - rem)], jnp.int32)
-        logits, ent["row"] = self._prefill(self.params, toks, ent["row"])
+        logits, ent["row"] = self._run_prefill(toks, ent["row"])
         if ent["todo"]:
             return False
         ent["step"] = logits[0, rem - 1]
@@ -599,6 +712,10 @@ class DecodeServer:
             jnp.int32(plen), jnp.int32(first), self._last)
         req.out.append(first)
         req.note_token()
+        # the first token is observed HERE (the argmax/sample above was
+        # a host sync): TTFT's far stamp, and the TPOT clock's arm
+        req.led.t_prefill_end = req.led.t_first = req.led.t_last = \
+            time.perf_counter()
         self._finish_if_done(req)
 
     def _finish_if_done(self, req: _Request, admit: bool = True) -> None:
@@ -618,6 +735,7 @@ class DecodeServer:
             self._free.append(s)
             req.slot = -1
             self._done[req.rid] = req
+            self._record_ledger(req)
             if not self._active:
                 # nothing left to decode: stop the dispatch-gap clock —
                 # an idle engine is not host-blocked, and a stale mark
@@ -626,6 +744,34 @@ class DecodeServer:
                 self._idle_since = None
             if admit:
                 self._admit()
+
+    def _record_ledger(self, req: _Request,
+                       outcome: Optional[str] = None) -> None:
+        """Close the request's ledger and park the snapshot for
+        pop_ledger/drain_ledgers. FIFO-capped: a caller that never
+        collects ledgers (library use, benches between fences) must not
+        grow the engine unboundedly."""
+        led = req.led
+        if outcome is not None and led.outcome is None:
+            led.outcome = outcome
+        led.t_done = time.perf_counter()
+        self._ledgers[req.rid] = led.snapshot(req)
+        while len(self._ledgers) > self.ledger_cap:
+            del self._ledgers[next(iter(self._ledgers))]
+
+    def pop_ledger(self, rid: int) -> Optional[dict]:
+        """The finished request's latency ledger (see _Ledger.snapshot),
+        handed out exactly once — the serving loop pops it alongside
+        pop_result to feed the TTFT/TPOT/queue/e2e histograms. None
+        while the request is still running (or already popped)."""
+        return self._ledgers.pop(rid, None)
+
+    def drain_ledgers(self) -> List[dict]:
+        """All uncollected finished-request ledgers, cleared — the
+        bench-harness bulk read."""
+        out = list(self._ledgers.values())
+        self._ledgers.clear()
+        return out
 
     # ------------------------------------------------------------------
     # pipelined decode: step() == step_begin (dispatch) + step_wait
@@ -734,7 +880,9 @@ class DecodeServer:
             # the dispatch gap ends the moment a tick is in flight again
             self.dispatch_gap_s += t0 - self._idle_since
             self._idle_since = None
-        payload = self._dispatch(active, keep, sampling)
+        payload = self._timed_dispatch(("decode", sampling),
+                                       self._dispatch, active, keep,
+                                       sampling)
         self.ticks_dispatched += 1
         for a in payload:
             copy = getattr(a, "copy_to_host_async", None)
@@ -780,28 +928,38 @@ class DecodeServer:
             return 0
         ent.consumed = True
         self._fetch(ent)        # usually a no-op: fetch already landed
-        emitted = self._consume_payload(ent, ent.host)
+        # ONE clock read per arrival (not per token) stamps every token
+        # this arrival lands — the ledger's hot-path cost in full
+        now = time.perf_counter() if self.ledger_enabled else 0.0
+        emitted = self._consume_payload(ent, ent.host, now)
         self.tokens_emitted += emitted
         ent.payload = ()        # drop device refs promptly
         return emitted
 
-    def _consume_payload(self, ent: _InFlight, host: tuple) -> int:
+    def _consume_payload(self, ent: _InFlight, host: tuple,
+                         now: float = 0.0) -> int:
         """Append one tick's tokens ([B, T]) to its requests. A slot
         whose request already finished (observed in an EARLIER arrival,
         or mid-burst below) contributes nothing — its late tokens are
-        the pipeline overrun the pos-reset rollback discards."""
+        the pipeline overrun the pos-reset rollback discards; because
+        they are never appended, they also never earn a ledger stamp
+        (no duplicate TPOT samples from rollbacks by construction)."""
         (toks,) = host
         emitted = 0
         for s in ent.slots:
             req = self._active.get(s)
             if req is None or req.done:
                 continue
+            n = 0
             for j in range(toks.shape[1]):
                 req.out.append(int(toks[s, j]))
                 req.note_token()
                 emitted += 1
+                n += 1
                 if req.done:
                     break
+            if n and now:
+                req.led.note_tokens(n, now)
             self._finish_if_done(req, admit=False)
         return emitted
 
@@ -840,6 +998,7 @@ class DecodeServer:
             if req.rid == rid:
                 del self._pending[i]
                 self._done[rid] = req        # empty output; poppable
+                self._record_ledger(req, outcome="cancelled")
                 return True
         # pipeline barrier: cancel mutates the slot->request binding; in-
         # flight arrivals for the old binding must land first (this may
@@ -861,6 +1020,7 @@ class DecodeServer:
         for req in self._active.values():
             if req.rid == rid:
                 req.max_new_tokens = len(req.out)
+                req.led.outcome = "cancelled"
                 self._finish_if_done(req)    # frees the slot, admits next
                 return True
         return False
@@ -887,6 +1047,53 @@ class DecodeServer:
         """(active slots, waiting requests) — the live load view the
         serving loop mirrors into gauges."""
         return len(self._active), len(self._pending)
+
+    def stats(self) -> dict:
+        """Live introspection snapshot (the /stats endpoint's engine
+        half): per-slot request state, pending-queue depth and oldest
+        wait, pipeline-window occupancy, prefix-cache and compile
+        accounting. Host dict reads only — safe to call between ticks
+        under the serving loop's lock."""
+        now = time.perf_counter()
+        prefilling = {e["req"].rid for e in self._prefilling}
+        slots = []
+        for s in sorted(self._active):
+            req = self._active[s]
+            slots.append({
+                "slot": s,
+                "rid": req.rid,
+                "age_s": round(now - (req.led.t_admit
+                                      or req.led.t_submit), 6),
+                "pos": len(req.prompt) + len(req.out),
+                "tokens_out": len(req.out),
+                "max_new_tokens": req.max_new_tokens,
+                "prefilling": req.rid in prefilling,
+                "sampling": {"temperature": req.temperature,
+                             "top_k": req.top_k, "top_p": req.top_p,
+                             "seed": req.seed},
+            })
+        oldest = (now - self._pending[0].led.t_submit
+                  if self._pending else 0.0)
+        return {
+            "engine": type(self).__name__,
+            "max_batch": self.max_batch,
+            "max_len": self.max_len,
+            "slots": slots,
+            "pending": {"depth": len(self._pending),
+                        "oldest_wait_s": round(oldest, 6)},
+            "pipeline": {"depth": self.pipeline_depth,
+                         "decode_steps": self.decode_steps,
+                         "in_flight": len(self._inflight),
+                         "flushes": self.pipeline_flushes,
+                         "ticks_dispatched": self.ticks_dispatched},
+            "prefix_cache": {"capacity": self._prefix_max,
+                             "entries": len(self._prefixes),
+                             "hits": self.prefix_hits,
+                             "tokens_saved": self.prefix_tokens_saved},
+            "compiles": {"count": self.compiles,
+                         "seconds": round(self.compile_s, 6)},
+            "tokens_emitted": self.tokens_emitted,
+        }
 
     def has_work(self) -> bool:
         return bool(self._active or self._pending)

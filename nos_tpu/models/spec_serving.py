@@ -235,6 +235,13 @@ class SpeculativeDecodeServer(DecodeServer):
                 f"cache length {self.max_len}")
         return super().submit(prompt, max_new_tokens, **kw)
 
+    def _run_d_prefill(self, toks, row):
+        """Draft prefill with the base engine's first-dispatch-per-shape
+        compile accounting (keyed apart from target prefill)."""
+        return self._timed_dispatch(
+            ("d_prefill", toks.shape[1], row["k"].shape[3]),
+            self._d_prefill, self.draft_params, toks, row)
+
     @functools.lru_cache(maxsize=None)      # noqa: B019 — engine-lived
     def _d_row_zeros(self, bucket: int):
         shape = list(self.d_cache["k"].shape)
@@ -277,8 +284,7 @@ class SpeculativeDecodeServer(DecodeServer):
             rbucket = _bucket(rem) if not ent["dtodo"] else rem
             toks = jnp.asarray([toks_list + [0] * (rbucket - rem)],
                                jnp.int32)
-            _, ent["drow"] = self._d_prefill(
-                self.draft_params, toks, ent["drow"])
+            _, ent["drow"] = self._run_d_prefill(toks, ent["drow"])
         if ent["todo"] or ent["dtodo"]:
             return False
         # hand the chunk-prefilled draft row to _finish_prefill (keyed
@@ -307,7 +313,7 @@ class SpeculativeDecodeServer(DecodeServer):
                 "v": self._d_row_zeros(bucket),
                 "pos": jnp.zeros((), jnp.int32),
             }
-            _, drow = self._d_prefill(self.draft_params, toks, drow)
+            _, drow = self._run_d_prefill(toks, drow)
         self.d_cache = self._d_install(
             self.d_cache, drow["k"], drow["v"], jnp.int32(slot),
             jnp.int32(plen))
@@ -331,18 +337,25 @@ class SpeculativeDecodeServer(DecodeServer):
                 self._seed, sampling)
         return commit, counts
 
-    def _consume_payload(self, ent, host) -> int:
+    def _consume_payload(self, ent, host, now: float = 0.0) -> int:
         commit_host, counts_host = host
         emitted = 0
         for s in ent.slots:
             req = self._active.get(s)
             if req is None or req.done:
                 continue
+            n = 0
             for j in range(int(counts_host[s])):
                 req.out.append(int(commit_host[s, j]))
                 req.note_token()
                 emitted += 1
+                n += 1
                 if req.done:
                     break
+            if n and now:
+                # a verify burst lands up to k tokens at one host
+                # instant: the shared ledger template attributes the
+                # arrival gap evenly across them (see _Ledger)
+                req.led.note_tokens(n, now)
             self._finish_if_done(req, admit=False)
         return emitted

@@ -311,6 +311,16 @@ class FlightRecorder:
                 while len(traces) > self.max_traces:
                     self._evict_one()
 
+    def pin(self, trace_id: str, why: str = "manual") -> None:
+        """Public pin: protect ``trace_id`` from ring churn for reasons
+        the recorder cannot infer from span timing alone — the serving
+        loop pins SLO-breaching request traces so the evidence behind a
+        breached ``nos_tpu_serve_slo_total`` increment survives to be
+        read at ``/debug/traces``. Subject to the same bounded-pinned-set
+        FIFO demotion as slow/error pins."""
+        with self._lock:
+            self._pin(trace_id, why)
+
     def _pin(self, trace_id: str, why: str) -> None:
         if trace_id in self._pinned:
             self._pinned.move_to_end(trace_id)

@@ -225,17 +225,7 @@ class PartitioningController:
         """North-star gauges: allocatable vs used TPU chips on managed nodes.
         Partitioned nodes advertise sub-slice resources INSTEAD of whole
         chips, so both are converted to chip counts."""
-        from nos_tpu.tpu.slice import parse_profile
-
-        def chips(resources) -> float:
-            n = resources.get(constants.RESOURCE_TPU, 0)
-            for r, qty in resources.items():
-                if r.startswith(constants.RESOURCE_TPU_SLICE_PREFIX):
-                    try:
-                        n += qty * parse_profile(r).chips
-                    except ValueError:
-                        continue  # malformed resource name
-            return n
+        from nos_tpu.tpu.slice import resource_chips as chips
 
         allocatable = 0.0
         used = 0.0

@@ -65,6 +65,22 @@ def is_slice_resource(resource_name: str) -> bool:
     return bool(constants.TPU_SLICE_RESOURCE_REGEX.match(resource_name))
 
 
+def resource_chips(resources: Dict[str, float]) -> float:
+    """Chip count of a resource request/allocatable dict: whole chips
+    plus sub-slice resources converted by their geometry. THE
+    utilization-accounting convention — the partitioning controller's
+    north-star gauges and the metrics exporter both read through it, so
+    a new resource shape lands in every consumer at once."""
+    n = resources.get(constants.RESOURCE_TPU, 0)
+    for r, qty in resources.items():
+        if r.startswith(constants.RESOURCE_TPU_SLICE_PREFIX):
+            try:
+                n += qty * parse_profile(r).chips
+            except ValueError:
+                continue    # malformed resource name
+    return n
+
+
 def geometry_chips(g: Geometry) -> int:
     return sum(p.chips * q for p, q in g.items())
 

@@ -247,17 +247,19 @@ class _HistogramChild:
             = None
         self._lock = threading.Lock()
 
-    def observe(self, v: float, trace_id: Optional[str] = None) -> None:
+    def observe(self, v: float, trace_id: Optional[str] = None,
+                count: int = 1) -> None:
         with self._lock:
-            self.total += v
-            self.count += 1
-            if self.samples is not None \
-                    and len(self.samples) < MAX_HISTOGRAM_SAMPLES:
-                self.samples.append(v)
+            self.total += v * count
+            self.count += count
+            if self.samples is not None:
+                room = MAX_HISTOGRAM_SAMPLES - len(self.samples)
+                if room > 0:
+                    self.samples.extend([v] * min(count, room))
             matched = len(self.buckets)          # +Inf slot
             for i, ub in enumerate(self.buckets):
                 if v <= ub:
-                    self.counts[i] += 1
+                    self.counts[i] += count
                     matched = i
                     break
             if trace_id:
@@ -297,12 +299,16 @@ class Histogram(_Metric):
                 if child.samples is None:
                     child.samples = []
 
-    def observe(self, v: float, trace_id: Optional[str] = None) -> None:
-        """Record one observation; ``trace_id`` (when the caller has an
-        active tracing span) attaches an OpenMetrics exemplar to the
-        matched bucket so a slow histogram observation links to the
-        concrete trace that produced it."""
-        self._unlabeled().observe(v, trace_id)
+    def observe(self, v: float, trace_id: Optional[str] = None,
+                count: int = 1) -> None:
+        """Record ``count`` observations of value ``v`` in one bucket
+        walk (count > 1: a batch of identical samples — e.g. n tokens
+        sharing one arrival gap — pays one lock acquisition instead of
+        n). ``trace_id`` (when the caller has an active tracing span)
+        attaches an OpenMetrics exemplar to the matched bucket so a slow
+        histogram observation links to the concrete trace that produced
+        it."""
+        self._unlabeled().observe(v, trace_id, count)
 
     def observations(self, *label_values) -> Tuple[int, float]:
         """(count, sum) of everything observed into this child — the

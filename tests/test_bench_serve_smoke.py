@@ -52,3 +52,16 @@ def test_bench_serve_smoke_writes_pipeline_artifact(tmp_path):
     # host_overhead_pct present on every rep (the bench's own headline)
     for p in artifact["pipeline"] + [fused]:
         assert 0 <= p["host_overhead_pct"] <= 100
+
+    # per-request latency ledger section: TTFT/TPOT/e2e percentiles +
+    # goodput per (pipeline_depth, decode_steps) config
+    assert artifact["slo"]["ttft_ms"] > 0 and artifact["slo"]["tpot_ms"] > 0
+    for p in artifact["pipeline"] + [fused]:
+        pr = p["per_request"]
+        assert pr["requests"] > 0
+        for series in ("ttft_ms", "tpot_ms", "e2e_ms"):
+            q = pr[series]
+            assert 0 <= q["p50"] <= q["p95"] <= q["p99"], (series, q)
+        assert 0.0 <= pr["goodput"] <= 1.0
+        # e2e dominates ttft for a multi-token request by construction
+        assert pr["e2e_ms"]["p50"] >= pr["ttft_ms"]["p50"]

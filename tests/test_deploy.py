@@ -300,6 +300,29 @@ def test_kind_e2e_script_runs_or_skips():
     assert proc.returncode == 0, proc.stdout[-2000:] + proc.stderr[-2000:]
 
 
+def test_serving_deployment_passes_slo_and_telemetry_args():
+    """The serving Deployment template must plumb the SLO + device-
+    telemetry knobs from values.yaml to nos-tpu-server flags (the flags
+    exist on the binary — drift between template and parser fails the
+    server's own tests; this pins the template side)."""
+    path = os.path.join(CHART, "templates", "serving",
+                        "deployment_server.yaml")
+    with open(path) as f:
+        text = f.read()
+    for flag, value in (
+        ("--slo-ttft-ms", ".Values.serving.slo.ttftMs"),
+        ("--slo-tpot-ms", ".Values.serving.slo.tpotMs"),
+        ("--device-stats-interval",
+         ".Values.serving.deviceStatsIntervalSeconds"),
+    ):
+        assert flag in text, f"serving deployment missing {flag}"
+        assert value in text, f"serving deployment missing {value}"
+    with open(os.path.join(CHART, "values.yaml")) as f:
+        values = yaml.safe_load(f)
+    assert values["serving"]["slo"] == {"ttftMs": 0, "tpotMs": 0}
+    assert values["serving"]["deviceStatsIntervalSeconds"] == 10
+
+
 def test_serving_sample_valid():
     """The serving Deployment sample must parse, and its embedded config
     must construct a real ServerConfig (drift between the sample and the

@@ -6,7 +6,10 @@ ApiHttpServer hosting the store, RemoteApiServer clients doing typed CRUD,
 optimistic-concurrency patches, watches, and full multi-"binary" flows
 (operator + scheduler + agent managers over HTTP).
 """
+import json
 import threading
+import urllib.error
+import urllib.request
 
 import pytest
 
@@ -284,12 +287,115 @@ def test_metricsexporter_collect():
             status=NodeStatus(allocatable={"google.com/tpu": 8}),
         ))
         remote.create(make_elastic_quota("eq", "ns", {"google.com/tpu": 4}))
-        remote.create(sample_pod())
+        remote.create(sample_pod())             # pending: holds no chips
+        remote.create(Pod(                      # BOUND: counts as used
+            metadata=ObjectMeta(name="bound", namespace="ns"),
+            spec=PodSpec(
+                containers=[Container(requests={
+                    "google.com/tpu": 2,
+                    "nos.ai/tpu-slice-2x2": 1,  # sub-slice: 4 chips
+                })],
+                node_name="n1",
+            ),
+            status=PodStatus(phase="Running"),
+        ))
+        remote.create(Pod(                      # terminated, awaiting GC:
+            metadata=ObjectMeta(name="done", namespace="ns"),
+            spec=PodSpec(                       # bound but holds NO chips
+                containers=[Container(requests={"google.com/tpu": 8})],
+                node_name="n1",
+            ),
+            status=PodStatus(phase="Succeeded"),
+        ))
         doc = collect(Client(remote))
         assert doc["nodes"][0]["tpu_chips"] == 8
+        # used = LIVE bound pod's whole chips + slice geometry; the
+        # pending pod holds no chips yet, the Succeeded one none anymore
+        assert doc["nodes"][0]["tpu_chips_used"] == 6
         assert doc["nodes"][0]["accelerator"] == "tpu-v5-lite-podslice"
         assert doc["elastic_quotas"][0]["min"] == {"google.com/tpu": 4}
-        assert doc["pod_count"] == 1 and doc["tpu_pod_count"] == 1
+        assert doc["pod_count"] == 3 and doc["tpu_pod_count"] == 3
+    finally:
+        http.stop()
+
+
+def test_healthserver_stats_route():
+    """Every daemon's HealthServer answers GET /stats with the hosted
+    manager's live introspection snapshot (404 when the component
+    exposes none)."""
+    from nos_tpu.cmd.serve import HealthServer
+
+    class Mgr:
+        def healthz(self):
+            return True
+
+        def readyz(self):
+            return True
+
+        def stats(self):
+            return {"kind": "test", "depth": 3}
+
+    hs = HealthServer(Mgr()).start()
+    try:
+        with urllib.request.urlopen(hs.address + "/stats", timeout=10) as r:
+            assert r.headers["Content-Type"] == "application/json"
+            assert json.loads(r.read()) == {"kind": "test", "depth": 3}
+    finally:
+        hs.stop()
+
+    hs = HealthServer().start()             # no manager -> no snapshot
+    try:
+        with pytest.raises(urllib.error.HTTPError) as e:
+            urllib.request.urlopen(hs.address + "/stats", timeout=10)
+        assert e.value.code == 404
+    finally:
+        hs.stop()
+
+
+def test_metricsexporter_main_oneshot_and_interval(tmp_path, monkeypatch):
+    """The exporter binary stays one-shot by default; --interval N
+    re-collects (rewriting --output each cycle) until interrupted."""
+    import types
+
+    from nos_tpu.cmd import apiserver as cmd_apiserver, metricsexporter
+
+    http = cmd_apiserver.build(port=0).start()
+    try:
+        out = tmp_path / "snap.json"
+        metricsexporter.main(
+            ["--api", http.address, "--output", str(out)])
+        doc = json.loads(out.read_text())
+        assert doc["version"] == "v0.1" and doc["nodes"] == []
+
+        # periodic mode: sleep(interval) between cycles; a transient
+        # collect failure must not kill the sidecar loop; interrupting
+        # the sleep exits cleanly after having re-collected
+        sleeps = []
+
+        def fake_sleep(s):
+            sleeps.append(s)
+            if len(sleeps) >= 3:
+                raise KeyboardInterrupt
+
+        real_collect = metricsexporter.collect
+        calls = []
+
+        def flaky_collect(client):
+            calls.append(1)
+            if len(calls) == 2:         # first PERIODIC re-collection
+                raise RuntimeError("transient API hiccup")
+            return real_collect(client)
+
+        monkeypatch.setattr(metricsexporter, "time",
+                            types.SimpleNamespace(sleep=fake_sleep))
+        monkeypatch.setattr(metricsexporter, "collect", flaky_collect)
+        out.unlink()
+        metricsexporter.main(
+            ["--api", http.address, "--output", str(out),
+             "--interval", "0.01"])
+        # survived the hiccup: slept 3 times, re-collected after failure
+        assert sleeps == [0.01] * 3 and len(calls) == 3
+        assert json.loads(out.read_text())["version"] == "v0.1"
     finally:
         http.stop()
 

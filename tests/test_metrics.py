@@ -56,6 +56,24 @@ def test_histogram_buckets_cumulative():
     assert "lat_sum 6.05" in text
 
 
+def test_histogram_weighted_observe():
+    """observe(v, count=n) records n identical samples in one bucket
+    walk — count, sum, buckets, and retained samples all agree with n
+    separate observes."""
+    r = Registry()
+    h = r.histogram("lat", "Latency.", buckets=(0.1, 1.0),
+                    track_samples=True)
+    h.observe(0.05, count=3)
+    h.observe(0.5)
+    text = r.expose()
+    assert 'lat_bucket{le="0.1"} 3' in text
+    assert 'lat_bucket{le="1"} 4' in text
+    assert "lat_count 4" in text
+    assert "lat_sum 0.65" in text
+    assert h.labels().samples == [0.05, 0.05, 0.05, 0.5]
+    assert h.quantile(0.5) == 0.05
+
+
 def test_register_idempotent_and_conflict():
     r = Registry()
     a = r.counter("c_total", "c")

@@ -1,4 +1,5 @@
-"""Metrics <-> docs drift guard (ISSUE 3 satellite).
+"""Metrics <-> docs drift guard (ISSUE 3 satellite) and metric-name
+lint (ISSUE 5 satellite).
 
 The `docs/telemetry.md` table is only useful if it is trustworthy: every
 metric registered anywhere in `nos_tpu/` must appear in the table, and
@@ -6,6 +7,10 @@ every `nos_*` name in the table must correspond to a registration. The
 scan is textual (regex over registration calls), so metrics registered
 lazily inside functions (cmd/server.py, cmd/trainer.py) are covered
 without importing JAX-heavy modules.
+
+The lint keeps future instruments Prometheus-conventional: `nos_`
+prefix, counters end `_total`, timing/size series end `_seconds` /
+`_bytes`, nothing collides with the reserved histogram sample suffixes.
 """
 import os
 import re
@@ -16,11 +21,21 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 # name literal on the same or next line
 REGISTRATION = re.compile(
     r'\.(?:counter|gauge|histogram)\(\s*"(nos_[a-z0-9_]+)"')
+# lint variant: capture the kind AND any first-arg string literal, so a
+# registration that fails the nos_ prefix is caught, not just missed
+KIND_REGISTRATION = re.compile(
+    r'\.(counter|gauge|histogram)\(\s*"([A-Za-z0-9_:]+)"')
 DOC_NAME = re.compile(r"nos_[a-z0-9_]+")
 
 
 def registered_metric_names():
     names = set()
+    for _path, text in _metric_sources():
+        names.update(REGISTRATION.findall(text))
+    return names
+
+
+def _metric_sources():
     for dirpath, _dirnames, filenames in os.walk(
             os.path.join(REPO, "nos_tpu")):
         if "__pycache__" in dirpath:
@@ -28,9 +43,9 @@ def registered_metric_names():
         for fn in filenames:
             if not fn.endswith(".py"):
                 continue
-            with open(os.path.join(dirpath, fn)) as f:
-                names.update(REGISTRATION.findall(f.read()))
-    return names
+            path = os.path.join(dirpath, fn)
+            with open(path) as f:
+                yield path, f.read()
 
 
 def documented_metric_names():
@@ -61,3 +76,63 @@ def test_every_documented_metric_is_registered():
     assert not stale, (
         f"docs/telemetry.md documents metrics no code registers: {stale} "
         f"— remove the rows or restore the metrics")
+
+
+# ---------------------------------------------------------------------------
+# metric-name lint: keep future instruments Prometheus-conventional
+# ---------------------------------------------------------------------------
+
+# count-valued histograms registered before the unit-suffix rule; the
+# list is CLOSED — new histograms must end _seconds or _bytes
+HISTOGRAM_COUNT_NOUNS = {
+    "nos_partitioning_batch_pods",
+    "nos_scheduler_sweep_nodes_visited",
+}
+
+# gauges whose noun phrase qualifies the unit (`..._bytes_in_use`): the
+# unit still reads unambiguously, so they pass as gauge nouns — also a
+# CLOSED list; prefer a terminal unit suffix for new gauges
+GAUGE_UNIT_NOUNS = {
+    "nos_tpu_device_hbm_bytes_in_use",
+    "nos_tpu_device_hbm_bytes_limit",
+}
+
+
+def test_metric_names_follow_prometheus_conventions():
+    seen = []
+    for path, text in _metric_sources():
+        for kind, name in KIND_REGISTRATION.findall(text):
+            seen.append((path, kind, name))
+    assert seen, "scan must find the registered metrics"
+    for path, kind, name in seen:
+        where = f"{os.path.relpath(path, REPO)}: {kind} {name}"
+        assert name.startswith("nos_"), \
+            f"{where} — every metric must carry the nos_ prefix"
+        assert re.fullmatch(r"nos_[a-z0-9_]+", name), \
+            f"{where} — lowercase snake_case only"
+        # reserved suffixes: the exposition appends these to histogram
+        # families, so a base name using them breaks scrapers
+        assert not name.endswith(("_bucket", "_count", "_sum")), \
+            f"{where} — reserved histogram sample suffix"
+        if kind == "counter":
+            assert name.endswith("_total"), \
+                f"{where} — counters must end _total"
+        else:
+            assert not name.endswith("_total"), \
+                f"{where} — only counters may end _total"
+        if kind == "histogram":
+            assert name.endswith(("_seconds", "_bytes")) \
+                or name in HISTOGRAM_COUNT_NOUNS, (
+                f"{where} — histograms must be unit-suffixed "
+                f"(_seconds/_bytes); count-valued shapes belong in "
+                f"HISTOGRAM_COUNT_NOUNS only by explicit exception")
+        # unit words must BE the unit suffix, not buried mid-name
+        # (gauge nouns that qualify the unit are grandfathered above)
+        if name in GAUGE_UNIT_NOUNS:
+            assert kind == "gauge", f"{where} — exception is gauge-only"
+            continue
+        for unit in ("seconds", "bytes"):
+            if f"_{unit}" in name:
+                assert name.endswith(f"_{unit}"), (
+                    f"{where} — '{unit}' must be the terminal unit "
+                    f"suffix")

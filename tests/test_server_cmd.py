@@ -234,7 +234,7 @@ def test_metrics_count_requests_and_tokens(served):
                 return float(line.split()[-1])
         return 0.0
 
-    assert val("nos_tpu_serve_requests_total") >= 1
+    assert val('nos_tpu_serve_requests_total{outcome="finished"}') >= 1
     assert val("nos_tpu_serve_tokens_total") >= 2   # N-1 decode tokens
 
 
@@ -642,7 +642,8 @@ def test_occupancy_and_rejection_metrics():
     from nos_tpu.utils.metrics import default_registry
 
     reg = default_registry()
-    rej0 = reg.counter("nos_tpu_serve_rejected_total", "x").value()
+    rej = reg.counter("nos_tpu_serve_requests_total", "x", ("outcome",))
+    rej0 = rej.value("rejected")
 
     class Bounded(_FakeEngine):
         def submit(self, prompt, n, **kw):
@@ -663,8 +664,7 @@ def test_occupancy_and_rejection_metrics():
         assert reg.gauge("nos_tpu_serve_pending_requests", "x").value() == 1
         with pytest.raises(QueueFull):
             loop.stream([2], 2)
-        assert reg.counter("nos_tpu_serve_rejected_total",
-                           "x").value() == rej0 + 1
+        assert rej.value("rejected") == rej0 + 1
         gen.close()
     finally:
         loop.shutdown()
@@ -693,5 +693,313 @@ def test_gauges_remirror_after_disconnect_cancel():
         assert reg.gauge("nos_tpu_serve_pending_requests", "x").value() == 1
         gen.close()                       # disconnect -> cancel -> forget
         assert reg.gauge("nos_tpu_serve_pending_requests", "x").value() == 0
+    finally:
+        loop.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# request-level SLO observability (ISSUE 5): /stats schema, outcome
+# accounting audit, latency histograms, SLO counters + breach pinning
+# ---------------------------------------------------------------------------
+
+def _outcomes():
+    from nos_tpu.cmd.server import OUTCOMES
+    from nos_tpu.utils.metrics import default_registry
+
+    c = default_registry().counter(
+        "nos_tpu_serve_requests_total", "x", ("outcome",))
+    return {o: c.value(o) for o in OUTCOMES}
+
+
+def _outcome_delta(before):
+    return {o: v - before[o] for o, v in _outcomes().items()
+            if v != before[o]}
+
+
+def test_stats_endpoint_schema(served):
+    """GET /stats serves the live engine snapshot; this pins the schema
+    both halves contribute (engine introspection + loop SLO/rates)."""
+    url, _, _ = served
+    post(url, {"prompt": [2, 3], "max_new_tokens": 3})
+    with urllib.request.urlopen(url + "/stats", timeout=30) as r:
+        assert r.status == 200
+        snap = json.loads(r.read())
+    # engine half (DecodeServer.stats)
+    assert snap["engine"] == "DecodeServer"
+    assert snap["max_batch"] == 2
+    assert isinstance(snap["slots"], list)
+    for s in snap["slots"]:             # usually idle by now, but pin
+        assert set(s) >= {"slot", "rid", "age_s", "pos", "tokens_out",
+                          "max_new_tokens", "prefilling", "sampling"}
+    assert set(snap["pending"]) == {"depth", "oldest_wait_s"}
+    assert set(snap["pipeline"]) == {"depth", "decode_steps", "in_flight",
+                                     "flushes", "ticks_dispatched"}
+    assert set(snap["prefix_cache"]) == {"capacity", "entries", "hits",
+                                         "tokens_saved"}
+    assert snap["compiles"]["count"] >= 1       # cold prefill + decode
+    assert snap["tokens_emitted"] >= 1
+    # loop half (ServingLoop.stats)
+    assert snap["healthy"] is True and snap["draining"] is False
+    assert set(snap["slo"]) == {"ttft_ms", "tpot_ms", "completed",
+                                "goodput"}
+    assert set(snap["rates"]) == {"window_s", "tokens_per_s",
+                                  "requests_per_s"}
+    assert snap["rates"]["tokens_per_s"] >= 0.0
+
+
+def test_latency_histograms_and_compile_metrics_exported(served):
+    """The ledger's histograms reach /metrics with non-zero counts after
+    one completed request, and the compile accounting rides along."""
+    url, _, _ = served
+    post(url, {"prompt": [5, 1], "max_new_tokens": 4})
+    with urllib.request.urlopen(url + "/metrics", timeout=30) as r:
+        text = r.read().decode()
+
+    def count_of(name):
+        for line in text.splitlines():
+            if line.startswith(name + "_count"):
+                return float(line.split()[-1])
+        return 0.0
+
+    for name in ("nos_tpu_serve_queue_seconds",
+                 "nos_tpu_serve_ttft_seconds",
+                 "nos_tpu_serve_e2e_seconds",
+                 "nos_tpu_serve_compile_seconds"):
+        assert count_of(name) >= 1, name
+    # 4 new tokens -> 3 decode tokens, each one TPOT sample
+    assert count_of("nos_tpu_serve_tpot_seconds") >= 3
+    for line in text.splitlines():
+        if line.startswith("nos_tpu_serve_compiles_total "):
+            assert float(line.split()[-1]) >= 1
+            break
+    else:
+        raise AssertionError("compiles_total not exposed")
+
+
+def test_outcome_finished_exactly_once():
+    eng = _FakeEngine()
+    loop = ServingLoop(eng)
+    try:
+        before = _outcomes()
+        loop.generate([1], 3, timeout=10)
+        assert _outcome_delta(before) == {"finished": 1}
+    finally:
+        loop.shutdown()
+
+
+def test_outcome_cancelled_on_disconnect_with_cancelling_engine():
+    """Disconnect mid-decode against an engine whose cancel() parks a
+    partial result: exactly one `cancelled`, never `abandoned`."""
+    class Cancellable(_FakeEngine):
+        def __init__(self):
+            super().__init__()
+            self.hold = True
+
+        def step(self):
+            if self.hold:
+                time.sleep(0.002)
+                return 0
+            return super().step()
+
+        def cancel(self, rid):
+            if rid in self.pending:
+                self.done[rid] = []     # partial output, poppable
+                del self.pending[rid]
+                return True
+            return False
+
+    loop = ServingLoop(Cancellable())
+    try:
+        before = _outcomes()
+        s = loop.stream([1], 5)
+        s.close()
+        assert _outcome_delta(before) == {"cancelled": 1}
+        assert s.rid not in loop._abandoned
+    finally:
+        loop.shutdown()
+
+
+def test_outcome_cancelled_when_cancel_drops_request_outright():
+    """An engine cancel() that deletes the request entirely (nothing
+    poppable, progress -> None) must still resolve to exactly one
+    `cancelled` — the reap loop closes the accounting, the rid must not
+    park in _abandoned forever."""
+    class Dropper(_FakeEngine):
+        def step(self):
+            time.sleep(0.002)
+            return 0                    # nothing ever completes
+
+        def cancel(self, rid):
+            return self.pending.pop(rid, None) is not None
+
+    loop = ServingLoop(Dropper())
+    try:
+        before = _outcomes()
+        s = loop.stream([1], 4)
+        s.close()
+        assert _wait_until(
+            lambda: _outcome_delta(before) == {"cancelled": 1})
+        assert _wait_until(lambda: not loop._abandoned)
+    finally:
+        loop.shutdown()
+
+
+def test_outcome_abandoned_exactly_once():
+    """Client gone, engine (no cancel) finishes the work later: the
+    ticker reap accounts exactly one `abandoned`."""
+    class Delayed(_FakeEngine):
+        def __init__(self):
+            super().__init__()
+            self.release = threading.Event()
+
+        def step(self):
+            if not self.release.is_set():
+                time.sleep(0.002)
+                return 0
+            return super().step()
+
+    eng = Delayed()
+    loop = ServingLoop(eng)
+    try:
+        before = _outcomes()
+        s = loop.stream([1], 4)
+        s.close()
+        assert _wait_until(lambda: s.rid in loop._abandoned)
+        eng.release.set()
+        assert _wait_until(
+            lambda: _outcome_delta(before) == {"abandoned": 1})
+        assert s.rid not in loop._abandoned
+    finally:
+        loop.shutdown()
+
+
+def test_outcome_failed_drain_accounts_exactly_once():
+    """Engine failure: the already-abandoned request is drained as
+    `failed` by _fail; a stream torn down after the failure resolves
+    `failed` too — and re-forgetting must not double-count."""
+    class FailsOnRelease(_FakeEngine):
+        def __init__(self):
+            super().__init__()
+            self.release = threading.Event()
+
+        def step(self):
+            if not self.release.is_set():
+                time.sleep(0.002)
+                return 0
+            raise RuntimeError("engine died")
+
+    eng = FailsOnRelease()
+    loop = ServingLoop(eng)
+    try:
+        before = _outcomes()
+        s1 = loop.stream([1], 4)
+        s2 = loop.stream([2], 4)
+        s1.close()                      # abandoned while in flight
+        assert _wait_until(lambda: s1.rid in loop._abandoned)
+        eng.release.set()               # next tick raises -> _fail
+        assert _wait_until(lambda: not loop.healthy)
+        # s1 drained by _fail; s2 resolves on its own teardown
+        s2.close()
+        assert _outcome_delta(before) == {"failed": 2}
+        # idempotent: re-forgetting an already-drained rid is a no-op
+        loop._forget(s2.rid)
+        assert _outcome_delta(before) == {"failed": 2}
+    finally:
+        loop.shutdown()
+
+
+def test_outcomes_exactly_once_through_pipeline_flush():
+    """Real engine at pipeline_depth=2: one request runs to completion,
+    one is cancelled mid-decode (cancel is a pipeline barrier — the
+    in-flight window flushes, late completions are observed during the
+    flush), one is cancelled while still pending. Every request earns
+    exactly one outcome; the flush double-counts none."""
+    mcfg = tfm.TransformerConfig(**MODEL, dtype=jnp.float32)
+    params = tfm.init_params(jax.random.PRNGKey(0), mcfg)
+    loop = ServingLoop(DecodeServer(params, mcfg, max_batch=2,
+                                    pipeline_depth=2))
+    try:
+        before = _outcomes()
+        runner = loop.stream([1, 2], 10)
+        victim = loop.stream([3, 4], 48)    # long: still decoding at close
+        waiter = loop.stream([5], 8)        # pends: both slots busy
+        waiter.close()                      # cancelled in the pending queue
+        victim.close()                      # cancelled mid-decode (flush)
+        for _ in runner:                    # drain to completion
+            pass
+        assert _wait_until(
+            lambda: sum(_outcome_delta(before).values()) == 3)
+        d = _outcome_delta(before)
+        assert d["finished"] == 1
+        # the closed streams resolve as cancelled (or abandoned, if a
+        # close raced its own completion) — but exactly once each
+        assert d.get("cancelled", 0) + d.get("abandoned", 0) == 2
+    finally:
+        loop.shutdown()
+
+
+def test_stats_rates_decay_when_idle():
+    """/stats rates age against NOW: an idle server must report zero
+    throughput, not freeze at the last active minute's rate."""
+    from nos_tpu.cmd.server import RATE_WINDOW_S
+
+    loop = ServingLoop(_FakeEngine())
+    try:
+        loop.generate([1], 3, timeout=10)
+        live = loop.stats()["rates"]
+        assert live["requests_per_s"] > 0
+        # simulate the window aging out with no further marks
+        with loop._work:
+            loop._rates = type(loop._rates)(
+                (t - RATE_WINDOW_S - 1.0, tok, req)
+                for t, tok, req in loop._rates)
+        idle = loop.stats()["rates"]
+        assert idle == {"window_s": 0.0, "tokens_per_s": 0.0,
+                        "requests_per_s": 0.0}
+    finally:
+        loop.shutdown()
+
+
+def test_slo_counters_goodput_and_breach_pins_trace():
+    from nos_tpu.obs import tracing
+    from nos_tpu.utils.metrics import default_registry
+
+    mcfg = tfm.TransformerConfig(**MODEL, dtype=jnp.float32)
+    params = tfm.init_params(jax.random.PRNGKey(0), mcfg)
+    reg = default_registry()
+    slo = reg.counter("nos_tpu_serve_slo_total", "x", ("slo", "outcome"))
+    keys = [("ttft", "met"), ("ttft", "breached"),
+            ("tpot", "met"), ("tpot", "breached")]
+    base = {k: slo.value(*k) for k in keys}
+
+    # generous targets: both met, goodput 1.0
+    loop = ServingLoop(DecodeServer(params, mcfg, max_batch=1),
+                       slo_ttft_ms=600000.0, slo_tpot_ms=600000.0)
+    try:
+        loop.generate([1, 2], 4, timeout=120)
+        assert slo.value("ttft", "met") == base[("ttft", "met")] + 1
+        assert slo.value("tpot", "met") == base[("tpot", "met")] + 1
+        assert reg.gauge("nos_tpu_serve_goodput_ratio", "x").value() == 1.0
+    finally:
+        loop.shutdown()
+
+    # impossible targets: both breached, goodput 0, trace pinned so the
+    # breached counter always has evidence at /debug/traces
+    loop = ServingLoop(DecodeServer(params, mcfg, max_batch=1),
+                       slo_ttft_ms=1e-6, slo_tpot_ms=1e-6)
+    try:
+        loop.generate([3, 4], 4, timeout=120)
+        assert slo.value("ttft", "breached") == \
+            base[("ttft", "breached")] + 1
+        assert slo.value("tpot", "breached") == \
+            base[("tpot", "breached")] + 1
+        assert reg.gauge("nos_tpu_serve_goodput_ratio", "x").value() == 0.0
+        pinned = [t for t in tracing.recorder().to_json()["traces"]
+                  if t["pinned"] == "slo"]
+        assert any(
+            sp["name"] == "serve.request"
+            and "ttft" in sp["attrs"].get("slo_breach", "")
+            for t in pinned for sp in t["spans"]), \
+            "SLO breach must pin the request's trace"
     finally:
         loop.shutdown()

@@ -57,3 +57,59 @@ def test_tracing_overhead_under_5_percent_on_service_p99():
         f"tracing overhead {overhead:.1%} on pooled service p99 "
         f"(off={off_p99:.3f}ms over {len(off)} samples, "
         f"on={on_p99:.3f}ms over {len(on)} samples) — must stay under 5%")
+
+
+@pytest.mark.slow
+def test_ledger_overhead_under_5_percent_on_tick_path():
+    """ISSUE 5: the request-level latency ledger must hold the
+    instrumented serving tick path within 5% of the uninstrumented one
+    (``ledger_enabled=False`` disables the per-arrival stamping — the
+    only ledger cost the hot tick path pays; milestone stamps are
+    per-request and off-tick).
+
+    Same methodology as the tracing guard above: interleaved
+    configurations to cancel machine drift, per-tick wall samples
+    pooled across reps, one robust statistic (median — a tick's p99
+    rests on single-digit samples of host noise) per side."""
+    import time
+
+    import jax
+    import jax.numpy as jnp
+
+    from nos_tpu.models import transformer as tfm
+    from nos_tpu.models.serving import DecodeServer
+
+    cfg = tfm.TransformerConfig(vocab=64, d_model=32, n_layers=2,
+                                n_heads=4, n_kv_heads=2, d_ff=64,
+                                max_seq=128, dtype=jnp.float32)
+    params = tfm.init_params(jax.random.PRNGKey(0), cfg)
+    srv = DecodeServer(params, cfg, max_batch=4, pipeline_depth=2)
+
+    def one_rep(enabled):
+        srv.ledger_enabled = enabled
+        for i in range(4):
+            srv.submit([i + 1, i + 2, i + 3], 48)
+        ticks = []
+        while srv.has_work():
+            t0 = time.perf_counter()
+            srv.step()
+            ticks.append(time.perf_counter() - t0)
+        srv.drain_ledgers()
+        return ticks
+
+    def p50(xs):
+        xs = sorted(xs)
+        return xs[len(xs) // 2]
+
+    one_rep(True)                      # warm-up: compiles, discarded
+    off, on = [], []
+    for _ in range(6):
+        off.extend(one_rep(False))
+        on.extend(one_rep(True))
+
+    off_med, on_med = p50(off) * 1e6, p50(on) * 1e6
+    overhead = (on_med - off_med) / off_med
+    assert overhead < 0.05, (
+        f"ledger overhead {overhead:.1%} on pooled tick median "
+        f"(off={off_med:.1f}us over {len(off)} ticks, "
+        f"on={on_med:.1f}us over {len(on)} ticks) — must stay under 5%")
