@@ -33,8 +33,9 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional, Sequence
 
 from nos_tpu.cmd.serve import metrics_payload
-from nos_tpu.models.errors import QueueFull  # jax-free module: keeps this
-                                             # file importable without jax
+from nos_tpu.models.errors import (  # jax-free module: keeps this file
+    Infeasible, QueueFull,           # importable without jax
+)
 from nos_tpu.obs import tracing
 from nos_tpu.utils.metrics import default_registry
 
@@ -85,8 +86,11 @@ class ServerConfig:
     # ``tp`` local devices. 0/1 = single device. Tokens are invariant to
     # tp, bf16 and int8 alike (tested); requires kv_heads % tp == 0.
     tp: int = 0
-    # prefix-cache entries (0 = off): each holds one prompt's KV on
-    # device — budget by model size (flagship: ~64 MB per 1k tokens)
+    # prefix cache (0 = off). Slot-static KV: ENTRIES — each holds one
+    # prompt's KV on device (flagship: ~64 MB per 1k tokens). Paged KV
+    # (kv_blocks > 0): BLOCKS — the budget for block-granular prefix
+    # chains shared by refcount, so size it in units of kv_block_size
+    # tokens (a 512-token system prompt at kv_block_size=16 needs 32).
     prefix_cache_size: int = 0
     # chunked prefill (0 = off): power-of-two chunk size; a long
     # prompt's prefill interleaves with decode ticks one chunk per tick,
@@ -106,6 +110,27 @@ class ServerConfig:
     # Pays in decode-bound phases; 1 = off. Pinned to 1 under
     # speculative decoding.
     decode_steps: int = 1
+    # paged KV cache (kv_blocks > 0 = on): KV lives in one pooled HBM
+    # arena of kv_blocks x kv_block_size tokens mapped per slot by
+    # block tables, instead of max_batch x max_seq worst-case rows —
+    # concurrency is then bound by tokens in use, with COW
+    # block-granular prefix sharing and memory-aware admission.
+    # kv_block_size must be a power of two >= 8 dividing max_seq.
+    # Budget: kv_blocks * kv_block_size tokens of KV resident; size it
+    # to HBM after weights (docs/workload-plane/performance-tuning.md
+    # "Paged KV cache"). Pinned off under speculative decoding.
+    kv_block_size: int = 0
+    kv_blocks: int = 0
+    # under block-pool pressure the lowest-priority slot is preempted:
+    # kv_swap true = swap its KV to host RAM and restore byte-exact;
+    # false = drop the KV and recompute it from the tokens on resume
+    # (no host RAM, more FLOPs). Both are bit-exact.
+    kv_swap: bool = True
+    # HBM backstop on admission (0 = off): defer admitting while
+    # device bytes_in_use / bytes_limit exceeds this fraction, per the
+    # same memory_stats() the HBM gauges sample (backends without
+    # memory stats skip the check)
+    kv_hbm_admit_frac: float = 0.95
     # speculative decoding (draft_checkpoint_dir set = on): a smaller
     # draft model proposes draft_n_tokens per tick, the target verifies
     # them in one wide forward. Greedy requests stay bit-identical to
@@ -247,6 +272,30 @@ class ServingLoop:
             "Fraction of completed requests meeting every configured "
             "SLO target (0 until the first completion; absent when no "
             "SLO is configured)")
+        # paged-KV block pool (registered only when the engine pages —
+        # a slot-static server must not export dead zero series)
+        self._preempt_seen = {"swap": 0, "recompute": 0}
+        if getattr(engine, "paged", False):
+            self.g_kv_free = reg.gauge(
+                "nos_tpu_serve_kv_blocks_free",
+                "Paged-KV blocks currently unreferenced (admission "
+                "headroom)")
+            self.g_kv_used = reg.gauge(
+                "nos_tpu_serve_kv_blocks_used",
+                "Paged-KV blocks referenced by at least one holder "
+                "(slot tables + prefix index)")
+            self.g_kv_shared = reg.gauge(
+                "nos_tpu_serve_kv_blocks_cow_shared",
+                "Paged-KV blocks referenced by MORE than one holder — "
+                "each is a cache copy COW sharing avoided")
+            self.m_preempt = reg.counter(
+                "nos_tpu_serve_preempt_total",
+                "Slots preempted under KV block pressure, by mode "
+                "(swap = KV swapped to host and restored byte-exact; "
+                "recompute = KV re-prefilled from the tokens)",
+                ("mode",))
+            for mode in ("swap", "recompute"):
+                self.m_preempt.labels(mode).inc(0)
         self.m_compiles = reg.counter(
             "nos_tpu_serve_compiles_total",
             "XLA compiles observed by the engine (first dispatch per "
@@ -671,6 +720,37 @@ class ServingLoop:
             active, pending = occupancy()
             self.g_active.set(active)
             self.g_pending.set(pending)
+        kv_stats = getattr(self.engine, "kv_stats", None)
+        kv = kv_stats() if kv_stats is not None else None
+        if kv:
+            self.g_kv_free.set(kv["blocks_free"])
+            self.g_kv_used.set(kv["blocks_used"])
+            self.g_kv_shared.set(kv["cow_shared"])
+            for mode, n in kv["preempts"].items():
+                delta = n - self._preempt_seen.get(mode, 0)
+                if delta > 0:
+                    self.m_preempt.labels(mode).inc(delta)
+                    self._preempt_seen[mode] = n
+            # the engine's admission-time HBM snapshot feeds the same
+            # gauges the interval sampler owns, so /metrics moves when
+            # an admission decision observed fresh pressure between
+            # --device-stats-interval ticks
+            hbm = kv.get("hbm")
+            if hbm and hbm.get("in_use") is not None:
+                reg = default_registry()
+                reg.gauge(
+                    "nos_tpu_device_hbm_bytes_in_use",
+                    "Device memory (HBM) bytes currently allocated, per "
+                    "local device (absent on backends without "
+                    "memory_stats, e.g. CPU)",
+                    ("device",)).labels(hbm["device"]).set(hbm["in_use"])
+                if hbm.get("limit"):
+                    reg.gauge(
+                        "nos_tpu_device_hbm_bytes_limit",
+                        "Device memory (HBM) byte capacity, per local "
+                        "device",
+                        ("device",)).labels(hbm["device"]).set(
+                            hbm["limit"])
         self._drain_compile_events()
 
     def stream(self, prompt, max_new_tokens, timeout: float = 300.0,
@@ -801,6 +881,24 @@ def build_engine(cfg: ServerConfig):
     if cfg.decode_steps < 1:
         raise ValueError(
             f"decode_steps must be >= 1, got {cfg.decode_steps}")
+    if cfg.kv_blocks:
+        bs = cfg.kv_block_size
+        if bs < 8 or bs & (bs - 1):
+            raise ValueError(
+                f"kv_block_size must be a power of two >= 8 when "
+                f"kv_blocks is set, got {bs}")
+        if cfg.max_seq % bs:
+            raise ValueError(
+                f"max_seq {cfg.max_seq} must be a multiple of "
+                f"kv_block_size {bs}")
+        if cfg.kv_blocks < 2:
+            raise ValueError(
+                f"kv_blocks must be >= 2 (one reserved null block plus "
+                f"at least one usable), got {cfg.kv_blocks}")
+        if cfg.tp and cfg.tp > 1:
+            raise ValueError(
+                "paged KV (kv_blocks > 0) is not yet mesh-aware; "
+                "run tp with kv_blocks=0")
     mesh = None
     if cfg.tp and cfg.tp > 1:
         import jax
@@ -860,16 +958,22 @@ def build_engine(cfg: ServerConfig):
             n_draft=cfg.draft_n_tokens, max_batch=cfg.max_batch,
             prefix_cache_size=cfg.prefix_cache_size, mesh=mesh,
             prefill_chunk=cfg.prefill_chunk, max_pending=cfg.max_pending,
-            # accepted for config uniformity; the spec engine pins both
-            # to 1 (see SpeculativeDecodeServer.__init__)
+            # accepted for config uniformity; the spec engine pins the
+            # pipeline knobs to 1 and paging off (see
+            # SpeculativeDecodeServer.__init__)
             pipeline_depth=cfg.pipeline_depth,
-            decode_steps=cfg.decode_steps)
+            decode_steps=cfg.decode_steps,
+            kv_block_size=cfg.kv_block_size, kv_blocks=cfg.kv_blocks,
+            kv_swap=cfg.kv_swap, hbm_admit_frac=cfg.kv_hbm_admit_frac)
     return DecodeServer(params, model_cfg, max_batch=cfg.max_batch,
                         prefix_cache_size=cfg.prefix_cache_size, mesh=mesh,
                         prefill_chunk=cfg.prefill_chunk,
                         max_pending=cfg.max_pending,
                         pipeline_depth=cfg.pipeline_depth,
-                        decode_steps=cfg.decode_steps)
+                        decode_steps=cfg.decode_steps,
+                        kv_block_size=cfg.kv_block_size,
+                        kv_blocks=cfg.kv_blocks, kv_swap=cfg.kv_swap,
+                        hbm_admit_frac=cfg.kv_hbm_admit_frac)
 
 
 def make_http_server(cfg: ServerConfig, loop: ServingLoop
@@ -1002,6 +1106,10 @@ def make_http_server(cfg: ServerConfig, loop: ServingLoop
                 if "stop_tokens" in body:
                     sampling["stop_tokens"] = [
                         int(t) for t in body["stop_tokens"]]
+                if "priority" in body:
+                    # paged-KV preemption order: under block pressure
+                    # the LOWEST priority slot yields first
+                    sampling["priority"] = int(body["priority"])
                 if "cache_prefix" in body:
                     # mark this prompt's KV as a reusable prefix (system
                     # prompts); reuse is automatic on every request.
@@ -1019,10 +1127,20 @@ def make_http_server(cfg: ServerConfig, loop: ServingLoop
                     self._stream_sse(gen)
                     return
                 tokens = loop.generate(prompt, n, **sampling)
+            except Infeasible as e:
+                # permanent: the request can NEVER run here (prompt +
+                # budget exceeds the cache, or needs more KV blocks
+                # than the whole pool) — 400 with no Retry-After, so
+                # clients fix the request instead of hammering it
+                self._reply(400, {"error": f"{type(e).__name__}: {e}",
+                                  "infeasible": True})
+                return
             except (KeyError, ValueError, TypeError) as e:
                 self._reply(400, {"error": f"{type(e).__name__}: {e}"})
                 return
             except QueueFull as e:
+                # transient: out of capacity RIGHT NOW (pending queue
+                # or KV block pool) — 429 + Retry-After says come back
                 self._reply(429, {"error": str(e)},
                             headers=[("Retry-After", "1")])
                 return
@@ -1065,6 +1183,21 @@ def main(argv: Optional[Sequence[str]] = None) -> None:
         help="decode steps fused into one compiled dispatch "
              "(1 = off; overrides config)")
     parser.add_argument(
+        "--kv-block-size", type=int, default=None,
+        help="paged-KV block size in tokens (power of two >= 8 "
+             "dividing max_seq; only meaningful with --kv-blocks; "
+             "overrides config)")
+    parser.add_argument(
+        "--kv-blocks", type=int, default=None,
+        help="paged-KV pool size in blocks (0 = slot-static KV; the "
+             "resident KV budget is kv_blocks * kv_block_size tokens; "
+             "overrides config)")
+    parser.add_argument(
+        "--kv-swap", choices=("on", "off"), default=None,
+        help="block-pressure preemption mode: on = swap the victim's "
+             "KV to host and restore byte-exact, off = recompute it "
+             "from the tokens on resume (overrides config)")
+    parser.add_argument(
         "--slo-ttft-ms", type=float, default=None,
         help="time-to-first-token SLO target in ms (0 = unset; feeds "
              "nos_tpu_serve_slo_total and the goodput gauge; overrides "
@@ -1093,6 +1226,12 @@ def main(argv: Optional[Sequence[str]] = None) -> None:
         cfg.pipeline_depth = args.pipeline_depth
     if args.decode_steps is not None:
         cfg.decode_steps = args.decode_steps
+    if args.kv_block_size is not None:
+        cfg.kv_block_size = args.kv_block_size
+    if args.kv_blocks is not None:
+        cfg.kv_blocks = args.kv_blocks
+    if args.kv_swap is not None:
+        cfg.kv_swap = args.kv_swap == "on"
     if args.slo_ttft_ms is not None:
         cfg.slo_ttft_ms = args.slo_ttft_ms
     if args.slo_tpot_ms is not None:

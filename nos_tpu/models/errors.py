@@ -4,13 +4,33 @@
 friends import it lazily) so the binary can parse config / print help in
 a jax-less environment; exception types it catches must live in a module
 with the same property.
+
+The two admission-refusal types encode the permanent/transient split
+the HTTP layer relies on: ``Infeasible`` means THIS request can never
+be served by THIS server (HTTP 400 — retrying is useless), ``QueueFull``
+means the server is out of capacity RIGHT NOW (HTTP 429 + Retry-After —
+shed load and come back). Before the paged KV cache the two were easy
+to conflate; with a block pool, "prompt needs more blocks than the
+whole pool" (permanent) and "no free blocks this instant" (transient)
+must travel different wires.
 """
 
 
 class QueueFull(RuntimeError):
-    """Admission refused: the pending queue is at ``max_pending``. Its
-    own type so the HTTP layer can answer 429 (shed load, retry) rather
-    than a generic 500."""
+    """Admission refused on TRANSIENT capacity: the pending queue is at
+    ``max_pending`` (or, under paged KV, the block pool cannot hold
+    another waiting request right now). Its own type so the HTTP layer
+    can answer 429 + Retry-After (shed load, retry) rather than a
+    generic 500."""
 
 
-__all__ = ["QueueFull"]
+class Infeasible(ValueError):
+    """Admission refused PERMANENTLY: the request can never run on this
+    server's configuration — prompt + max_new_tokens exceeds the cache
+    length, or needs more KV blocks than the whole pool. Subclasses
+    ValueError (the HTTP layer's 400 arm, and what library callers
+    already catch); distinct so callers can tell "fix the request"
+    from "retry later" without string-matching."""
+
+
+__all__ = ["QueueFull", "Infeasible"]

@@ -82,6 +82,94 @@ def cache_shardings(mesh, cfg: TransformerConfig,
     return {"k": kv, "v": kv, "pos": pos}
 
 
+def init_paged_cache(cfg: TransformerConfig, kv_blocks: int,
+                     block_size: int, batch: int,
+                     dtype=None) -> Cache:
+    """Pooled paged KV arena: k/v ``[L, kv_blocks, Hkv, block_size,
+    head_dim]`` — ONE HBM pool shared by every serving slot through
+    per-slot block tables — plus the per-row write position ``pos``
+    [batch]. Block 0 is the reserved null block (kvblocks.NULL_BLOCK):
+    unassigned table entries point at it, so it is never valid data.
+    Unlike ``init_cache`` the resident footprint scales with
+    ``kv_blocks * block_size`` TOTAL tokens, not ``batch * max_len``
+    worst-case tokens — the PagedAttention economics. The per-row
+    LOGICAL timeline length is the block table's affair (the serving
+    engine caps it at its ``max_len <= cfg.max_seq``, same rope-table
+    bound as ``init_cache``)."""
+    dtype = dtype or cfg.dtype
+    shape = (cfg.n_layers, kv_blocks, cfg.kv_heads, block_size,
+             cfg.head_dim)
+    return {
+        "k": jnp.zeros(shape, dtype),
+        "v": jnp.zeros(shape, dtype),
+        "pos": jnp.zeros((batch,), jnp.int32),
+    }
+
+
+def forward_paged(
+    params: Params, cfg: TransformerConfig, tokens: jax.Array,
+    cache: Cache, table: jax.Array,
+) -> Tuple[jax.Array, Cache]:
+    """``forward_with_cache`` over a paged arena: tokens [B, S] (the
+    next S tokens after each row's ``cache['pos']``), per-slot block
+    tables [B, nb] int32 -> (logits [B, S, vocab], updated cache).
+    Per-position math is identical to the slot-static path — K/V writes
+    scatter into the arena by block table
+    (ops.attention.paged_scatter_kv) and attention runs over the
+    gathered per-row timeline (paged_gather_kv) with the same causal
+    ``pos`` mask — so greedy decode under paging is bit-identical to
+    ``generate`` (tested). ``table`` is a plain input, never donated:
+    the host mutates it between dispatches (growth, COW remaps) while
+    the donated arena chains through the self-feeding decode program."""
+    from nos_tpu.ops.attention import paged_gather_kv, paged_scatter_kv
+
+    b, s = tokens.shape
+    pos0 = cache["pos"]                                     # [B]
+    freqs = rope_frequencies(cfg.head_dim, cfg.max_seq, cfg.rope_theta)
+    positions = pos0[:, None] + jnp.arange(s)[None, :]      # [B, S]
+    scale = cfg.head_dim ** -0.5
+
+    x = embed_lookup(params["embed"], tokens, cfg.dtype)
+
+    def layer_body(x, layer_and_cache):
+        layer, ck, cv = layer_and_cache                     # arena slices
+        h = rms_norm(x, layer["attn_norm"])
+        q = qdot(h, layer["wq"]).reshape(b, s, cfg.n_heads, cfg.head_dim)
+        k = qdot(h, layer["wk"]).reshape(b, s, cfg.kv_heads, cfg.head_dim)
+        v = qdot(h, layer["wv"]).reshape(b, s, cfg.kv_heads, cfg.head_dim)
+        q, k = (apply_rope(t, freqs, positions) for t in (q, k))
+        kt = k.transpose(0, 2, 1, 3).astype(ck.dtype)       # [B, Hkv, S, D]
+        vt = v.transpose(0, 2, 1, 3).astype(cv.dtype)
+        ck = paged_scatter_kv(ck, table, pos0, kt)
+        cv = paged_scatter_kv(cv, table, pos0, vt)
+        o = _cached_attention(
+            q.transpose(0, 2, 1, 3), paged_gather_kv(ck, table),
+            paged_gather_kv(cv, table), positions, scale)
+        o = o.transpose(0, 2, 1, 3).reshape(b, s, cfg.d_model)
+        x = x + qdot(o, layer["wo"])
+        if cfg.n_experts > 0:
+            from nos_tpu.ops.moe import moe_ffn
+
+            h2 = rms_norm(x, layer["mlp_norm"])
+            y, _aux = moe_ffn(
+                h2, layer["w_router"], layer["w_gate"], layer["w_up"],
+                layer["w_down"], cfg.expert_capacity_factor,
+            )
+            x = x + y
+        else:
+            h2 = rms_norm(x, layer["mlp_norm"])
+            x = x + swiglu(h2, layer["w_gate"], layer["w_up"],
+                           layer["w_down"])
+        return x, (ck, cv)
+
+    x, (ks, vs) = jax.lax.scan(
+        layer_body, x, (params["layers"], cache["k"], cache["v"]))
+
+    x = rms_norm(x, params["final_norm"])
+    logits = qdot(x, params["unembed"]).astype(jnp.float32)
+    return logits, {"k": ks, "v": vs, "pos": pos0 + s}
+
+
 def _cached_attention(q, ck, cv, positions, scale):
     """q: [B, H, S, D] (queries at absolute ``positions``); ck/cv:
     [B, Hkv, T, D] (full cache). Causal against the cache timeline:

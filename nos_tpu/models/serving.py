@@ -39,16 +39,20 @@ import jax.numpy as jnp
 import numpy as np
 
 from nos_tpu.models.generate import (
-    Cache, _truncate_logits_rows, cache_shardings, forward_with_cache,
-    init_cache,
+    Cache, _truncate_logits_rows, cache_shardings, forward_paged,
+    forward_with_cache, init_cache, init_paged_cache,
+)
+from nos_tpu.models.kvblocks import (
+    BlockAllocator, NoFreeBlocks, PrefixBlockIndex, blocks_for,
 )
 from nos_tpu.models.transformer import Params, TransformerConfig
 
 
-from nos_tpu.models.errors import QueueFull  # noqa: F401 — canonical home
-                                             # is jax-free (see errors.py)
+from nos_tpu.models.errors import (  # noqa: F401 — canonical home is
+    Infeasible, QueueFull,           # jax-free (see errors.py)
+)
 
-__all__ = ["DecodeServer", "QueueFull"]
+__all__ = ["DecodeServer", "QueueFull", "Infeasible"]
 
 
 def _bucket(n: int) -> int:
@@ -134,6 +138,18 @@ class _Request:
     cache_prefix: bool = False
     stop_tokens: tuple = ()
     led: Optional[_Ledger] = None
+    # paged-KV state: admission ordering under memory pressure (higher
+    # priority preempted later), swap-out payload of a preempted slot
+    # (host copies of its KV blocks), and the resume marker that routes
+    # _admit to the restore/recompute path instead of fresh prefill
+    priority: int = 0
+    preempted: bool = False
+    swap_state: Optional[dict] = None
+    # paged admission plumbing: prefix blocks claimed for this request
+    # (refcounts already bumped) and, for chunked prefill, the full
+    # block table reserved at admission
+    shared_blocks: List[int] = field(default_factory=list)
+    reserved_blocks: Optional[List[int]] = None
 
     def note_token(self) -> None:
         """Called after each appended token: a stop token terminates the
@@ -187,13 +203,24 @@ class DecodeServer:
       dispatch ([B, T] tokens per device->host sync), amortizing
       per-dispatch overhead in decode-bound phases. Streaming
       granularity coarsens to ~k*T tokens per arrival.
-    """
+
+    Paged KV (``kv_blocks > 0``): slots stop owning ``[max_len]`` cache
+    rows — KV lives in one pooled arena of ``kv_blocks`` x
+    ``kv_block_size`` tokens mapped per slot by block tables, with
+    refcounted COW sharing (block-granular prefix reuse, ``fork`` for
+    n>1 sampling), memory-aware admission (free-block headroom + the
+    HBM gauges), and preempt-by-swap-or-recompute under pressure
+    (``preempt``/``kv_swap``). Every exactness contract above holds
+    under paging — including across a fork and a preempt-and-resume
+    (tested)."""
 
     def __init__(self, params: Params, cfg: TransformerConfig,
                  max_batch: int = 8, max_len: Optional[int] = None,
                  prefix_cache_size: int = 0, mesh=None,
                  prefill_chunk: int = 0, max_pending: int = 0,
-                 pipeline_depth: int = 1, decode_steps: int = 1):
+                 pipeline_depth: int = 1, decode_steps: int = 1,
+                 kv_block_size: int = 0, kv_blocks: int = 0,
+                 kv_swap: bool = True, hbm_admit_frac: float = 0.0):
         if prefill_chunk and (prefill_chunk < 8
                               or prefill_chunk & (prefill_chunk - 1)):
             raise ValueError(
@@ -211,6 +238,38 @@ class DecodeServer:
         self.cfg = cfg
         self.max_batch = max_batch
         self.max_len = max_len or cfg.max_seq
+        # paged KV (kv_blocks > 0): slots stop owning [max_len] cache
+        # rows — KV lives in ONE pooled arena of kv_blocks x
+        # kv_block_size tokens, mapped per slot by a block table the
+        # decode program gathers/scatters through. Concurrency is then
+        # bound by TOKENS IN USE, not slots x worst-case length.
+        self.paged = kv_blocks > 0
+        self.kv_block_size = kv_block_size if self.paged else 0
+        self.kv_swap = bool(kv_swap)
+        self.hbm_admit_frac = float(hbm_admit_frac or 0.0)
+        if self.paged:
+            bs = kv_block_size
+            if self.max_len > cfg.max_seq:
+                raise ValueError(
+                    f"cache max_len {self.max_len} exceeds the rope "
+                    f"table (cfg.max_seq {cfg.max_seq})")
+            if bs < 8 or bs & (bs - 1):
+                raise ValueError(
+                    f"kv_block_size must be a power of two >= 8, got "
+                    f"{bs} (blocks are compiled copy shapes, and "
+                    f"power-of-two sizes keep them bucket-aligned)")
+            if self.max_len % bs:
+                raise ValueError(
+                    f"max_len {self.max_len} must be a multiple of "
+                    f"kv_block_size {bs}: the gathered per-row timeline "
+                    f"(blocks_per_slot x block_size) must equal max_len "
+                    f"exactly so paged attention stays bit-identical to "
+                    f"the slot-static program")
+            if mesh is not None:
+                raise ValueError(
+                    "paged KV is not yet mesh-aware: run kv_blocks=0 "
+                    "with tp, or paged on a single device (sharding the "
+                    "arena's head axis is the planned follow-up)")
         # tensor-parallel serving: with a mesh, the engine places its KV
         # cache with the heads axis over ``tp`` (cache_shardings) to
         # match params sharded by transformer.param_shardings — ONE
@@ -219,8 +278,29 @@ class DecodeServer:
         # matmuls/cache reads, not the math.
         self.mesh = mesh
         self._row_shd = None
-        self.cache = init_cache(cfg, max_batch, self.max_len,
-                                per_row_pos=True)
+        if self.paged:
+            self._nbs = self.max_len // kv_block_size
+            self._alloc = BlockAllocator(kv_blocks, kv_block_size)
+            self.cache = init_paged_cache(cfg, kv_blocks, kv_block_size,
+                                          max_batch)
+            self._table = jnp.zeros((max_batch, self._nbs), jnp.int32)
+            self._tables: List[List[int]] = [[] for _ in range(max_batch)]
+            self._pindex = (PrefixBlockIndex(self._alloc,
+                                             prefix_cache_size)
+                            if prefix_cache_size > 0 else None)
+        else:
+            self.cache = init_cache(cfg, max_batch, self.max_len,
+                                    per_row_pos=True)
+        # blocks freed while decode ticks are still in flight park here
+        # until the next barrier/window-drain: an in-flight tick's
+        # in-graph writes still target the freeing slot's OLD blocks,
+        # so handing them to a new owner before the window drains would
+        # cross-corrupt KV. Preemption accounting rides alongside.
+        self._deferred: List[int] = []
+        self.preempts = {"swap": 0, "recompute": 0}
+        self.hbm: Optional[dict] = None
+        self._hbm_dead = False
+        self._hbm_next = 0.0
         if mesh is not None:
             from jax.sharding import NamedSharding, PartitionSpec
             shd = cache_shardings(mesh, cfg, per_row_pos=True)
@@ -327,15 +407,18 @@ class DecodeServer:
 
         T = self.decode_steps
 
-        def decode_one(p, toks, cache, keep, temp, topk, topp, seeds,
+        def decode_one(fwd, toks, cache, keep, temp, topk, topp, seeds,
                        sampling: bool):
             # one fused step: forward, per-row sample-or-argmax,
             # inactive rows' pos frozen, next feed tokens. ``sampling``
             # is static: a greedy-only tick (every active slot at
             # temperature 0 — the host knows) compiles WITHOUT the
-            # vocab-wide sort/softmax/RNG machinery
+            # vocab-wide sort/softmax/RNG machinery. ``fwd`` closes
+            # over params and (for paged mode) the block table, so the
+            # per-step ops here are IDENTICAL between the slot-static
+            # and paged programs — the bit-exactness contract.
             pos0 = cache["pos"]
-            logits, cache = forward_with_cache(p, cfg, toks, cache)
+            logits, cache = fwd(toks, cache)
             cache["pos"] = jnp.where(keep, cache["pos"], pos0)
             step = logits[:, -1]                            # [B, vocab]
             nxt = jnp.argmax(step, axis=-1)
@@ -356,23 +439,23 @@ class DecodeServer:
             new_last = jnp.where(keep[:, None], nxt[:, None], toks)
             return nxt, new_last, cache
 
-        def decode(p, toks, cache, keep, temp, topk, topp, seeds,
-                   sampling: bool):
-            # cache donated. T == 1 keeps the unscanned program (no scan
-            # wrapper in the hot graph); T > 1 fuses T decode steps into
-            # ONE dispatch via lax.scan — per-step ops identical to the
-            # T == 1 program, so greedy stays bit-exact at any T. Tokens
-            # come back [B, T] per sync.
+        def decode_core(fwd, toks, cache, keep, temp, topk, topp, seeds,
+                        sampling: bool):
+            # cache donated by the jit wrappers below. T == 1 keeps the
+            # unscanned program (no scan wrapper in the hot graph);
+            # T > 1 fuses T decode steps into ONE dispatch via lax.scan
+            # — per-step ops identical to the T == 1 program, so greedy
+            # stays bit-exact at any T. Tokens come back [B, T] per sync.
             if T == 1:
                 nxt, new_last, cache = decode_one(
-                    p, toks, cache, keep, temp, topk, topp, seeds,
+                    fwd, toks, cache, keep, temp, topk, topp, seeds,
                     sampling)
                 return nxt[:, None], new_last, cache
 
             def body(carry, _):
                 toks, cache = carry
                 nxt, new_last, cache = decode_one(
-                    p, toks, cache, keep, temp, topk, topp, seeds,
+                    fwd, toks, cache, keep, temp, topk, topp, seeds,
                     sampling)
                 return (new_last, cache), nxt
 
@@ -380,8 +463,29 @@ class DecodeServer:
                 body, (toks, cache), None, length=T)
             return steps.swapaxes(0, 1), last, cache        # [B, T]
 
-        self._decode = jax.jit(decode, donate_argnums=(2,),
-                               static_argnums=(8,))
+        def decode(p, toks, cache, keep, temp, topk, topp, seeds,
+                   sampling: bool):
+            return decode_core(
+                lambda t, c: forward_with_cache(p, cfg, t, c),
+                toks, cache, keep, temp, topk, topp, seeds, sampling)
+
+        def decode_paged(p, toks, cache, table, keep, temp, topk, topp,
+                         seeds, sampling: bool):
+            # inactive rows' table entries zero out to the reserved
+            # null block: their in-graph writes (pos frozen afterwards,
+            # output discarded) land somewhere no active row ever
+            # reads, instead of a freed block a new request may own
+            table = jnp.where(keep[:, None], table, 0)
+            return decode_core(
+                lambda t, c: forward_paged(p, cfg, t, c, table),
+                toks, cache, keep, temp, topk, topp, seeds, sampling)
+
+        if self.paged:
+            self._decode = jax.jit(decode_paged, donate_argnums=(2,),
+                                   static_argnums=(9,))
+        else:
+            self._decode = jax.jit(decode, donate_argnums=(2,),
+                                   static_argnums=(8,))
 
         def prefill(p, toks, row_cache):
             return forward_with_cache(p, cfg, toks, row_cache)
@@ -401,25 +505,124 @@ class DecodeServer:
 
         self._install = jax.jit(install, donate_argnums=(0,))
 
+        if self.paged:
+            bs = self.kv_block_size
+
+            def blk_shape(arr):
+                return (arr.shape[0], 1, arr.shape[2], bs, arr.shape[4])
+
+            def install_block(cache, rk, rv, phys, start):
+                # one block of a prefilled scratch row (token offset
+                # ``start``) -> physical arena block ``phys``; traced
+                # scalars, so admission compiles ONE program per
+                # scratch bucket, not per block index
+                bk = jax.lax.dynamic_slice(
+                    rk, (0, 0, 0, start, 0), blk_shape(rk))
+                bv = jax.lax.dynamic_slice(
+                    rv, (0, 0, 0, start, 0), blk_shape(rv))
+                cache["k"] = jax.lax.dynamic_update_slice(
+                    cache["k"], bk, (0, phys, 0, 0, 0))
+                cache["v"] = jax.lax.dynamic_update_slice(
+                    cache["v"], bv, (0, phys, 0, 0, 0))
+                return cache
+
+            self._install_block = jax.jit(install_block,
+                                          donate_argnums=(0,))
+
+            def scratch_from_block(rk, rv, ck, cv, phys, start):
+                # arena block -> scratch-row token offset: seeds the
+                # suffix prefill with a shared prefix's KV (no
+                # donation: rk may be the memoized _row_zeros array)
+                bk = jax.lax.dynamic_slice(
+                    ck, (0, phys, 0, 0, 0), blk_shape(ck))
+                bv = jax.lax.dynamic_slice(
+                    cv, (0, phys, 0, 0, 0), blk_shape(cv))
+                rk = jax.lax.dynamic_update_slice(
+                    rk, bk, (0, 0, 0, start, 0))
+                rv = jax.lax.dynamic_update_slice(
+                    rv, bv, (0, 0, 0, start, 0))
+                return rk, rv
+
+            self._scratch_block = jax.jit(scratch_from_block)
+
+            def cow_block(cache, src, dst):
+                # copy-on-write: duplicate a shared block before its
+                # first write so no written block is ever aliased
+                bk = jax.lax.dynamic_slice(
+                    cache["k"], (0, src, 0, 0, 0), blk_shape(cache["k"]))
+                bv = jax.lax.dynamic_slice(
+                    cache["v"], (0, src, 0, 0, 0), blk_shape(cache["v"]))
+                cache["k"] = jax.lax.dynamic_update_slice(
+                    cache["k"], bk, (0, dst, 0, 0, 0))
+                cache["v"] = jax.lax.dynamic_update_slice(
+                    cache["v"], bv, (0, dst, 0, 0, 0))
+                return cache
+
+            self._cow_block = jax.jit(cow_block, donate_argnums=(0,))
+
+            def restore_block(cache, bk, bv, phys):
+                # swap-in: one host-swapped block ([L, Hkv, bs, D])
+                # back into the arena
+                cache["k"] = jax.lax.dynamic_update_slice(
+                    cache["k"], bk[:, None], (0, phys, 0, 0, 0))
+                cache["v"] = jax.lax.dynamic_update_slice(
+                    cache["v"], bv[:, None], (0, phys, 0, 0, 0))
+                return cache
+
+            self._restore_block = jax.jit(restore_block,
+                                          donate_argnums=(0,))
+
+            def set_row_state(cache, last, slot, pos, tok):
+                # shared admission/resume/fork tail: the slot's device
+                # position and feed token in one donated update
+                cache["pos"] = cache["pos"].at[slot].set(pos)
+                last = last.at[slot, 0].set(tok)
+                return cache, last
+
+            self._set_row_state = jax.jit(set_row_state,
+                                          donate_argnums=(0,))
+
     # ------------------------------------------------------------------
     def submit(self, prompt: List[int], max_new_tokens: int, *,
                temperature: float = 0.0, top_k: int = 0,
                top_p: float = 0.0, seed: Optional[int] = None,
                cache_prefix: bool = False,
-               stop_tokens: Optional[List[int]] = None) -> int:
+               stop_tokens: Optional[List[int]] = None,
+               priority: int = 0) -> int:
         """Enqueue a request. ``temperature`` 0 = greedy (bit-identical to
         ``generate``); > 0 samples, optionally truncated per-request by
         ``top_k``/``top_p``. ``seed`` keys the request's sample stream
         (default: the request id) — same (prompt, params, seed) always
-        yields the same tokens, whatever else shares the batch."""
+        yields the same tokens, whatever else shares the batch.
+        ``priority`` matters only under paged-KV memory pressure: when
+        the block pool runs dry the LOWEST-priority (then
+        youngest-admitted) slot is preempted, never a higher one.
+
+        Refusals split permanent from transient: ``Infeasible`` (a
+        ValueError — the request can NEVER fit this server: HTTP 400)
+        vs ``QueueFull`` (capacity is exhausted right now: HTTP 429 +
+        Retry-After)."""
         if not prompt:
             raise ValueError("empty prompt")
         if max_new_tokens < 1:
             raise ValueError(f"max_new_tokens must be >= 1, got {max_new_tokens}")
         if len(prompt) + max_new_tokens > self.max_len:
-            raise ValueError(
+            raise Infeasible(
                 f"prompt ({len(prompt)}) + max_new_tokens "
                 f"({max_new_tokens}) exceeds cache length {self.max_len}")
+        if self.paged:
+            # total KV the request can ever need: positions
+            # [0, plen + max_new - 1) — the final token is produced by
+            # the forward that writes KV at plen + max_new - 2
+            cap = len(prompt) + max_new_tokens - 1
+            need = blocks_for(cap, self.kv_block_size)
+            if need > self._alloc.capacity:
+                raise Infeasible(
+                    f"request needs {need} KV blocks at its full length "
+                    f"but the pool only has {self._alloc.capacity} "
+                    f"(kv_blocks={self._alloc.num_blocks}, "
+                    f"kv_block_size={self.kv_block_size}); no amount of "
+                    f"retrying can serve it")
         if temperature <= 0 and (top_k or top_p):
             raise ValueError(
                 "top_k/top_p only apply when sampling — set temperature "
@@ -442,6 +645,7 @@ class DecodeServer:
             seed=(rid if seed is None else int(seed)) & 0xFFFFFFFF,
             cache_prefix=bool(cache_prefix) and self._prefix_max > 0,
             stop_tokens=tuple(int(t) for t in stop_tokens or ()),
+            priority=int(priority),
             led=_Ledger(time.perf_counter())))
         self._admit()
         return rid
@@ -454,14 +658,25 @@ class DecodeServer:
             # before _install writes the new request's rows
             self._flush()
         while self._pending and self._free:
+            if self.paged and not self._admit_headroom(self._pending[0]):
+                # memory-aware admission: the head waits for free-block
+                # headroom (or the HBM backstop) instead of thrashing
+                # the pool — completions and preemptions re-run this
+                break
             req = self._pending.popleft()
             slot = self._free.popleft()
             req.slot = slot
             self._active[slot] = req
             # admitted-to-slot: prefill starts immediately (one-shot or
-            # the first chunk of a chunked admission)
+            # the first chunk of a chunked admission); a preempted
+            # request resumes through restore/recompute instead
             req.led.t_admit = req.led.t_prefill_start = time.perf_counter()
-            self._prefill_slot(req)
+            if req.swap_state is not None:
+                self._resume_swapped(req)
+            elif req.preempted:
+                self._resume_recompute(req)
+            else:
+                self._prefill_slot(req)
 
     def _timed_dispatch(self, key: tuple, fn, *args):
         """Run ``fn`` and, on its FIRST call per shape ``key``, time it
@@ -545,6 +760,8 @@ class DecodeServer:
         forwards are deferred to step() one chunk at a time instead
         (_start_chunked_prefill) — admission costs the host only the
         scratch allocation."""
+        if self.paged:
+            return self._paged_prefill_slot(req)
         plen = len(req.prompt)
         m, mkey = (self._prefix_match(req.prompt) if self._prefixes
                    else (0, None))
@@ -686,7 +903,9 @@ class DecodeServer:
         token from the final-position logits, set the slot's sampling
         rows, and install the prefilled KV into the shared cache."""
         plen = len(req.prompt)
-        if req.cache_prefix:
+        if req.cache_prefix and not self.paged:
+            # paged publish happens in _paged_install, where the slot's
+            # block table (the thing being shared) exists
             self._publish_prefix(req.prompt, row["k"], row["v"])
         if req.temperature > 0:
             # token at absolute index plen: same (seed, index) keying as
@@ -707,9 +926,12 @@ class DecodeServer:
         self._seed = self._seed.at[s].set(req.seed)
         # padding garbage past plen stays masked until overwritten: only
         # pos decides what exists
-        self.cache, self._last = self._install(
-            self.cache, row["k"], row["v"], jnp.int32(req.slot),
-            jnp.int32(plen), jnp.int32(first), self._last)
+        if self.paged:
+            self._paged_install(req, row, plen, first)
+        else:
+            self.cache, self._last = self._install(
+                self.cache, row["k"], row["v"], jnp.int32(req.slot),
+                jnp.int32(plen), jnp.int32(first), self._last)
         req.out.append(first)
         req.note_token()
         # the first token is observed HERE (the argmax/sample above was
@@ -731,6 +953,8 @@ class DecodeServer:
         if req.done and req.slot >= 0:
             s = req.slot
             del self._active[s]
+            if self.paged:
+                self._free_slot_blocks(s)
             self.cache["pos"] = self.cache["pos"].at[s].set(0)
             self._free.append(s)
             req.slot = -1
@@ -774,6 +998,553 @@ class DecodeServer:
         return out
 
     # ------------------------------------------------------------------
+    # paged KV subsystem (kv_blocks > 0): block-table admission,
+    # COW fork, memory-aware pressure relief (flush -> prefix eviction
+    # -> preemption by swap or recompute). All host bookkeeping lives
+    # here; the device side is forward_paged's gather/scatter.
+    # ------------------------------------------------------------------
+    def _paged_prefill_slot(self, req: _Request) -> None:
+        """Paged admission: prefill runs over the SAME contiguous
+        scratch row as the slot-static path (identical compiled
+        programs, identical numerics), then lands block-by-block in the
+        arena. A block-granular prefix match skips both the shared
+        head's compute (suffix-only forward) and its storage (the
+        matched blocks are refcount-shared, not copied)."""
+        bs = self.kv_block_size
+        plen = len(req.prompt)
+        m, mkey = (self._pindex.match(req.prompt, plen - 1)
+                   if self._pindex is not None else (0, None))
+        # profitability: block reuse must also save prefill compute
+        # (fewer query tokens per bucket tier) — same invariant as the
+        # slot-static prefix path
+        if m > 0 and _bucket(plen - m) >= _bucket(plen):
+            m = 0
+        # fit: prefix + padded suffix must land inside max_len; shrink
+        # by whole blocks (a partial block cannot be shared)
+        guard = 0
+        while m > 0 and m + _bucket(plen - m) > self.max_len \
+                and guard < 64:
+            m = (max(0, self.max_len - _bucket(plen - m)) // bs) * bs
+            guard += 1
+        if m > 0 and m + _bucket(plen - m) > self.max_len:
+            m = 0
+        if self._prefill_chunk and plen - m > self._prefill_chunk \
+                and self._paged_start_chunked(req, m, mkey):
+            return
+        sbucket = _bucket(plen - m)
+        # scratch rounded up to the block size so whole blocks copy out
+        bucket = min(max(_bucket(max(plen, m + sbucket)), bs),
+                     self.max_len)
+        shared = self._pindex.take(mkey, m) if m > 0 else []
+        req.shared_blocks = shared
+        self._sync_prefix_stats()
+        row = {"k": self._row_zeros(bucket), "v": self._row_zeros(bucket),
+               "pos": jnp.int32(m)}
+        if m > 0:
+            row = self._seed_scratch(row, shared)
+            suffix = req.prompt[m:]
+            toks = jnp.asarray(
+                [suffix + [0] * (sbucket - len(suffix))], jnp.int32)
+            logits, row = self._run_prefill(toks, row)
+            step = logits[0, len(suffix) - 1]
+        else:
+            toks = jnp.asarray(
+                [req.prompt + [0] * (bucket - plen)], jnp.int32)
+            logits, row = self._run_prefill(toks, row)
+            step = logits[0, plen - 1]
+        self._finish_prefill(req, row, step)
+
+    def _paged_start_chunked(self, req: _Request, m: int, mkey) -> bool:
+        """Chunk-at-a-time admission under paging. The slot's FULL
+        block table is reserved here (shared prefix + fresh blocks):
+        prefill spans several ticks during which other slots grow, and
+        an install that discovered an empty pool mid-admission would
+        have no good answer. False falls back to the one-shot path."""
+        bs = self.kv_block_size
+        chunk = self._prefill_chunk
+        plen = len(req.prompt)
+        suffix = plen - m
+        full, rem = divmod(suffix, chunk)
+        span = m + full * chunk + (_bucket(rem) if rem else 0)
+        bucket = min(max(_bucket(max(plen, span)), bs), self.max_len)
+        if suffix <= chunk or span > bucket:
+            return False
+        shared = self._pindex.take(mkey, m) if m > 0 else []
+        try:
+            fresh = self._alloc.alloc_many(
+                blocks_for(plen, bs) - len(shared))
+        except NoFreeBlocks:
+            for b in shared:            # undo the claim, fall back
+                self._alloc.decref(b)
+            if m > 0:
+                # roll the hit stats back too: the one-shot fallback
+                # will take() again — one admission, one hit
+                self._pindex.hits -= 1
+                self._pindex.tokens_saved -= m
+            return False
+        req.shared_blocks = shared
+        req.reserved_blocks = shared + fresh
+        self._sync_prefix_stats()
+        row = {"k": self._row_zeros(bucket), "v": self._row_zeros(bucket),
+               "pos": jnp.int32(m)}
+        if m > 0:
+            row = self._seed_scratch(row, shared)
+        tail = req.prompt[m:]
+        todo = deque(tail[i:i + chunk] for i in range(0, suffix, chunk))
+        self._prefilling.append({"req": req, "row": row, "todo": todo})
+        return True
+
+    def _seed_scratch(self, row: dict, shared: List[int]) -> dict:
+        """Copy a shared prefix's arena blocks into the scratch row so
+        the suffix forward attends to the reused KV — the paged twin of
+        the slot-static path's prefix-row copy."""
+        bs = self.kv_block_size
+        rk, rv = row["k"], row["v"]
+        for j, phys in enumerate(shared):
+            rk, rv = self._timed_dispatch(
+                ("scratchblk", rk.shape[3]), self._scratch_block,
+                rk, rv, self.cache["k"], self.cache["v"],
+                jnp.int32(phys), jnp.int32(j * bs))
+        row["k"], row["v"] = rk, rv
+        return row
+
+    def _paged_install(self, req: _Request, row: Cache, plen: int,
+                       first: int) -> None:
+        """Admission tail under paging: land the prefilled scratch row
+        in the arena block-by-block (shared prefix blocks are table
+        entries, not copies), set the device table row and the slot's
+        pos/feed token, and publish a cache_prefix prompt's full blocks
+        for block-granular reuse."""
+        bs = self.kv_block_size
+        shared = req.shared_blocks
+        req.shared_blocks = []
+        n_total = blocks_for(plen, bs)
+        if req.reserved_blocks is not None:     # chunked admission
+            table = req.reserved_blocks
+            req.reserved_blocks = None
+        else:
+            table = shared + self._alloc.alloc_many(
+                n_total - len(shared))
+        for j in range(len(shared), n_total):
+            self.cache = self._timed_dispatch(
+                ("installblk", row["k"].shape[3]), self._install_block,
+                self.cache, row["k"], row["v"], jnp.int32(table[j]),
+                jnp.int32(j * bs))
+        s = req.slot
+        self._tables[s] = table
+        self._set_table_row(s)
+        self.cache, self._last = self._set_row_state(
+            self.cache, self._last, jnp.int32(s), jnp.int32(plen),
+            jnp.int32(first))
+        if req.cache_prefix and self._pindex is not None:
+            self._pindex.publish(req.prompt, table)
+            self._sync_prefix_stats()
+
+    def _set_table_row(self, slot: int) -> None:
+        """Mirror one slot's host block table into the device table
+        (unassigned logical blocks -> the reserved null block 0)."""
+        row = np.zeros((self._nbs,), np.int32)
+        blocks = self._tables[slot]
+        row[:len(blocks)] = blocks
+        self._table = self._table.at[slot].set(jnp.asarray(row))
+
+    def _sync_prefix_stats(self) -> None:
+        if self._pindex is not None:
+            self.prefix_hits = self._pindex.hits
+            self.prefix_tokens_saved = self._pindex.tokens_saved
+
+    def _free_slot_blocks(self, slot: int) -> None:
+        """Release a finished/cancelled slot's block references. With
+        decode ticks still in flight the frees PARK (_deferred): those
+        ticks' in-graph writes still target this table, and a block
+        re-allocated to a new owner before the window drains would be
+        cross-corrupted. Barriers and window-drain land them."""
+        table = self._tables[slot]
+        self._tables[slot] = []
+        if self._inflight:
+            self._deferred.extend(table)
+        else:
+            for b in table:
+                self._alloc.decref(b)
+
+    def _drain_deferred(self) -> None:
+        if self._deferred and not self._inflight:
+            for b in self._deferred:
+                self._alloc.decref(b)
+            self._deferred.clear()
+
+    def _hbm_sample(self) -> Optional[dict]:
+        """device.memory_stats() snapshot at admission-decision time —
+        the live-gauge backstop the ISSUE asks for, throttled to 2 Hz
+        so a blocked admission retried every tick stays cheap. Guarded:
+        backends without memory stats (CPU) disable themselves."""
+        if self._hbm_dead:
+            return self.hbm
+        now = time.perf_counter()
+        if self.hbm is not None and now < self._hbm_next:
+            return self.hbm
+        self._hbm_next = now + 0.5
+        try:
+            d = jax.devices()[0]
+            stats = d.memory_stats() or {}
+        except Exception:
+            self._hbm_dead = True
+            return self.hbm
+        in_use = stats.get("bytes_in_use")
+        limit = stats.get("bytes_limit") \
+            or stats.get("bytes_reservable_limit")
+        if in_use is None:
+            self._hbm_dead = True
+            return self.hbm
+        self.hbm = {"device": f"{d.platform}:{d.id}",
+                    "in_use": int(in_use), "limit": int(limit or 0)}
+        return self.hbm
+
+    def _admit_headroom(self, req: _Request) -> bool:
+        """Memory-aware admission: the pending head enters only when
+        the pool holds its install blocks plus one block of growth
+        headroom (capped at its full-length need, so a maximal request
+        is not starved), and the HBM gauges say the device itself has
+        room. With no slot decoding, cached prefixes are evicted rather
+        than deadlocking the queue."""
+        bs = self.kv_block_size
+        plen = len(req.prompt)
+        cap_blocks = blocks_for(plen + req.max_new_tokens - 1, bs)
+        if req.swap_state is not None:
+            base_need = req.swap_state["nblk"]
+        elif req.preempted:
+            base_need = blocks_for(plen + len(req.out) - 1, bs)
+        else:
+            base_need = blocks_for(plen, bs)
+        need = min(base_need + 1, max(base_need, cap_blocks))
+        hbm = self._hbm_sample()
+        if self.hbm_admit_frac and hbm and hbm.get("limit") \
+                and hbm["in_use"] / hbm["limit"] > self.hbm_admit_frac:
+            return False
+        if need <= self._alloc.free_count:
+            return True
+        if self._pindex is not None:
+            # cached prefixes are the cheapest memory (the same rank
+            # _relieve_pressure uses): reclaim them for a waiting
+            # request rather than stalling it behind live decoders —
+            # and with NO slot decoding, nothing else will ever free a
+            # block, so this is also the deadlock breaker
+            self._pindex.evict_lru(need - self._alloc.free_count)
+            return need <= self._alloc.free_count
+        return False
+
+    def _ensure_blocks(self, active: List[int]) -> None:
+        """Pre-dispatch block discipline: every decodable slot's next
+        ``decode_steps`` write positions (beyond what in-flight ticks
+        already cover) must land in blocks it owns EXCLUSIVELY —
+        growth allocates, shared blocks COW-copy (the copy op is
+        enqueued after the in-flight writes it must include; single-
+        device dispatch order makes that exact). Positions past the
+        request's terminal length stay unallocated: the zero table
+        entry routes those overrun writes to the null block. Raises
+        NoFreeBlocks under pool pressure."""
+        T = self.decode_steps
+        bs = self.kv_block_size
+        for s in active:
+            req = self._active[s]
+            base = len(req.prompt) + len(req.out) - 1
+            pending = sum(1 for ent in self._inflight
+                          if not ent.consumed and s in ent.slots)
+            start = base + pending * T
+            cap = len(req.prompt) + req.max_new_tokens - 1
+            end = min(start + T, cap)
+            if start >= end:
+                # only overrun writes left: past max_len they null-route
+                # (paged_scatter_kv), within the table they overwrite
+                # positions >= cap that every reader rewrites before
+                # reading — either way, no committed KV is reachable
+                continue
+            table = self._tables[s]
+            changed = False
+            for j in range(start // bs, (end - 1) // bs + 1):
+                if j < len(table):
+                    if not self._alloc.writable(table[j]):
+                        fresh = self._alloc.alloc()
+                        self.cache = self._timed_dispatch(
+                            ("cowblk",), self._cow_block, self.cache,
+                            jnp.int32(table[j]), jnp.int32(fresh))
+                        self._alloc.decref(table[j])
+                        table[j] = fresh
+                        changed = True
+                else:
+                    while len(table) <= j:
+                        table.append(self._alloc.alloc())
+                        changed = True
+            if changed:
+                self._set_table_row(s)
+
+    def _pre_dispatch(self, active: List[int]) -> bool:
+        """Hook run before every decode dispatch. True = dispatch with
+        ``active`` as-is; False = the block pool or batch composition
+        changed (pressure relief ran) — recompute and retry."""
+        if not self.paged:
+            return True
+        try:
+            self._ensure_blocks(active)
+            return True
+        except NoFreeBlocks:
+            self._relieve_pressure()
+            return False
+
+    def _relieve_pressure(self) -> None:
+        """Free KV blocks, cheapest first. Every step either makes
+        progress or escalates, so the step_begin retry loop terminates:
+        1) barrier-flush the window — late-observed completions and
+           deferred frees land;
+        2) evict LRU prefix chains — cached prefixes are reclaimable
+           without hurting any live request;
+        3) preempt the lowest-priority (then youngest-admitted) slot —
+           swap-to-host or recompute per ``kv_swap``, re-enqueued at
+           the FRONT of the pending queue;
+        4) nothing left: raise (the pool cannot serve even one slot —
+           a sizing error, not a load condition)."""
+        if self._inflight:
+            self._flush()
+            return
+        self._drain_deferred()
+        if self._pindex is not None and self._pindex.evict_lru(1) > 0:
+            return
+        if self._preempt_victim():
+            return
+        raise NoFreeBlocks(
+            "KV block pool exhausted with nothing left to reclaim (no "
+            "in-flight ticks, no cached prefixes, no preemptible slot); "
+            "size kv_blocks to hold at least one full-length request")
+
+    def _preempt_victim(self) -> bool:
+        pre = {ent["req"].slot for ent in self._prefilling}
+        cands = [s for s in self._active if s not in pre]
+        if len(cands) <= 1 and not self._prefilling:
+            # the sole decoder cannot steal from itself — UNLESS a
+            # chunk-prefilling admission holds reserved blocks: then
+            # vacating the decoder lets that admission finish, decode,
+            # and free the pool (refusing here would escalate a
+            # transient reservation squeeze into a dead serving loop)
+            return False
+        if not cands:
+            return False
+        victim = min(cands, key=lambda s: (self._active[s].priority,
+                                           -self._active[s].led.t_admit))
+        self._preempt_slot(victim, "swap" if self.kv_swap else "recompute")
+        return True
+
+    def preempt(self, rid: int, mode: Optional[str] = None) -> bool:
+        """Preempt an active request's slot NOW (swap-to-host or
+        recompute; default per ``kv_swap``), re-enqueuing it at the
+        front of the pending queue. The engine calls this itself under
+        block pressure; it is public for operator tooling and the
+        coming request-level elastic-quota controller. False for a
+        request that is not an active, fully-prefilled slot."""
+        if not self.paged:
+            raise RuntimeError("preempt requires paged KV (kv_blocks > 0)")
+        mode = mode or ("swap" if self.kv_swap else "recompute")
+        if mode not in ("swap", "recompute"):
+            raise ValueError(f"mode must be swap|recompute, got {mode!r}")
+        if any(e["req"].rid == rid for e in self._prefilling):
+            return False
+        slot = next((s for s, r in self._active.items() if r.rid == rid),
+                    None)
+        if slot is None:
+            return False
+        self._flush()       # barrier — may even FINISH the request
+        req = self._active.get(slot)
+        if req is None or req.rid != rid or req.done:
+            return False
+        self._preempt_slot(slot, mode)
+        return True
+
+    def _preempt_slot(self, slot: int, mode: str) -> None:
+        """Vacate ``slot`` (window must be flushed): capture resume
+        state (swap: host copies of its committed blocks; recompute:
+        nothing — the tokens themselves are the state), free its
+        blocks, and re-enqueue the request at the FRONT of _pending."""
+        assert not self._inflight, "preemption requires a flushed window"
+        req = self._active.pop(slot)
+        bs = self.kv_block_size
+        base = len(req.prompt) + len(req.out) - 1
+        nblk = blocks_for(base, bs)
+        table = self._tables[slot]
+        if mode == "swap":
+            idx = jnp.asarray(table[:nblk], jnp.int32)
+            req.swap_state = {
+                "nblk": nblk,
+                "k": np.asarray(self.cache["k"][:, idx]),
+                "v": np.asarray(self.cache["v"][:, idx]),
+            }
+        self._tables[slot] = []
+        for b in table:
+            self._alloc.decref(b)
+        self.cache["pos"] = self.cache["pos"].at[slot].set(0)
+        self._free.append(slot)
+        req.slot = -1
+        req.preempted = True
+        self._pending.appendleft(req)
+        self.preempts[mode] += 1
+        if not self._active:
+            self._idle_since = None
+
+    def _resume_swapped(self, req: _Request) -> None:
+        """Swap-in resume: restore the preempted request's KV bytes
+        into freshly allocated blocks — bit-exact by construction (the
+        bytes never changed)."""
+        st = req.swap_state
+        req.swap_state = None
+        req.preempted = False
+        blocks = self._alloc.alloc_many(st["nblk"])
+        for j, phys in enumerate(blocks):
+            self.cache = self._timed_dispatch(
+                ("restoreblk",), self._restore_block, self.cache,
+                jnp.asarray(st["k"][:, j]), jnp.asarray(st["v"][:, j]),
+                jnp.int32(phys))
+        self._tables[req.slot] = blocks
+        self._set_table_row(req.slot)
+        self._resume_row(req)
+
+    def _resume_recompute(self, req: _Request) -> None:
+        """Recompute resume: re-prefill prompt + committed output
+        (minus the not-yet-fed last token). Per-position forward math
+        is chunking-invariant — the same invariant chunked prefill and
+        prefix reuse already rest on — so the regenerated KV, and every
+        token after it, is bit-exact. One-shot scratch prefill (no
+        chunking: the request already waited once)."""
+        req.preempted = False
+        bs = self.kv_block_size
+        seq = req.prompt + req.out[:-1]
+        n = len(seq)
+        bucket = min(max(_bucket(n), bs), self.max_len)
+        row = {"k": self._row_zeros(bucket), "v": self._row_zeros(bucket),
+               "pos": jnp.int32(0)}
+        toks = jnp.asarray([seq + [0] * (bucket - n)], jnp.int32)
+        _logits, row = self._run_prefill(toks, row)
+        blocks = self._alloc.alloc_many(blocks_for(n, bs))
+        for j, phys in enumerate(blocks):
+            self.cache = self._timed_dispatch(
+                ("installblk", row["k"].shape[3]), self._install_block,
+                self.cache, row["k"], row["v"], jnp.int32(phys),
+                jnp.int32(j * bs))
+        self._tables[req.slot] = blocks
+        self._set_table_row(req.slot)
+        self._resume_row(req)
+
+    def _resume_row(self, req: _Request) -> None:
+        """Shared fork/resume tail: sampling rows, device pos (=
+        committed KV length) and the feed token (= last committed,
+        not yet fed)."""
+        s = req.slot
+        self._temp = self._temp.at[s].set(req.temperature)
+        self._topk = self._topk.at[s].set(req.top_k)
+        self._topp = self._topp.at[s].set(req.top_p)
+        self._seed = self._seed.at[s].set(req.seed)
+        base = len(req.prompt) + len(req.out) - 1
+        self.cache, self._last = self._set_row_state(
+            self.cache, self._last, jnp.int32(s), jnp.int32(base),
+            jnp.int32(req.out[-1]))
+        req.led.t_prefill_end = time.perf_counter()
+
+    def fork(self, rid: int, *, max_new_tokens: Optional[int] = None,
+             temperature: Optional[float] = None,
+             top_k: Optional[int] = None, top_p: Optional[float] = None,
+             seed: Optional[int] = None) -> int:
+        """COW-fork an active request: the new request shares every KV
+        block of the source's committed context by refcount — n>1
+        sampling or branching from a shared system prompt for the
+        price of a block table, not a cache copy — and diverges from
+        its next token on. A shared block is copied only on first
+        write (_ensure_blocks), so a fully-greedy fork that never
+        diverges still never aliases a written block. Greedy forks
+        continue bit-identically to the source's own continuation;
+        pass a different ``seed``/``temperature``/``top_*`` to branch a
+        sampled stream. Needs a free slot (QueueFull otherwise) and an
+        active, fully-prefilled source (ValueError otherwise); returns
+        the new request id."""
+        if not self.paged:
+            raise RuntimeError("fork requires paged KV (kv_blocks > 0)")
+        if any(e["req"].rid == rid for e in self._prefilling):
+            raise ValueError(f"request {rid} is still prefilling")
+        src = next((r for r in self._active.values() if r.rid == rid),
+                   None)
+        if src is None:
+            raise ValueError(f"request {rid} is not active")
+        self._flush()       # barrier: batch composition changes below
+        if src.done or src.slot < 0:
+            raise ValueError(
+                f"request {rid} finished during the fork barrier")
+        # free-slot check AFTER the barrier: a completion parked in an
+        # unconsumed in-flight tick frees its slot during the flush
+        if not self._free:
+            raise QueueFull(
+                "no free slot to fork into; retry after a completion")
+        new_max = src.max_new_tokens if max_new_tokens is None \
+            else int(max_new_tokens)
+        if new_max <= len(src.out):
+            raise ValueError(
+                f"max_new_tokens {new_max} <= tokens already produced "
+                f"({len(src.out)}); nothing left to decode")
+        if len(src.prompt) + new_max > self.max_len:
+            raise Infeasible(
+                f"prompt ({len(src.prompt)}) + max_new_tokens "
+                f"({new_max}) exceeds cache length {self.max_len}")
+        fork_cap = blocks_for(len(src.prompt) + new_max - 1,
+                              self.kv_block_size)
+        if fork_cap > self._alloc.capacity:
+            # same permanent-infeasibility guard as submit(): a fork
+            # that can never fit the pool must not enter and later
+            # crash the loop as an unpreemptible sole decoder
+            raise Infeasible(
+                f"fork needs {fork_cap} KV blocks at its full length "
+                f"but the pool only has {self._alloc.capacity}")
+        nrid = self._next_rid
+        self._next_rid += 1
+        req = _Request(
+            nrid, list(src.prompt), new_max,
+            temperature=(src.temperature if temperature is None
+                         else float(temperature)),
+            top_k=src.top_k if top_k is None else int(top_k),
+            top_p=src.top_p if top_p is None else float(top_p),
+            seed=(src.seed if seed is None else int(seed)) & 0xFFFFFFFF,
+            stop_tokens=src.stop_tokens, priority=src.priority,
+            led=_Ledger(time.perf_counter()))
+        req.out = list(src.out)
+        now = time.perf_counter()
+        req.led.t_admit = req.led.t_prefill_start = now
+        req.led.t_first = req.led.t_last = now
+        slot = self._free.popleft()
+        req.slot = slot
+        self._active[slot] = req
+        base = len(src.prompt) + len(src.out) - 1
+        nblk = blocks_for(base, self.kv_block_size)
+        self._tables[slot] = self._alloc.fork(
+            self._tables[src.slot][:nblk])
+        self._set_table_row(slot)
+        self._resume_row(req)
+        return nrid
+
+    def kv_stats(self) -> Optional[dict]:
+        """Block-pool accounting for /stats and the serving-loop
+        gauges; None when paging is off."""
+        if not self.paged:
+            return None
+        return {
+            "block_size": self.kv_block_size,
+            "blocks_total": self._alloc.capacity,
+            "blocks_free": self._alloc.free_count,
+            "blocks_used": self._alloc.used_count,
+            "cow_shared": self._alloc.shared_count(),
+            "deferred_frees": len(self._deferred),
+            "prefix": (self._pindex.stats()
+                       if self._pindex is not None else None),
+            "preempts": dict(self.preempts),
+            "swapped_pending": sum(1 for r in self._pending
+                                   if r.swap_state is not None),
+            "hbm": self.hbm,
+        }
+
+    # ------------------------------------------------------------------
     # pipelined decode: step() == step_begin (dispatch) + step_wait
     # (block on the oldest arrival) + step_finish (host bookkeeping).
     # The serving loop calls the three phases separately so the blocking
@@ -806,9 +1577,18 @@ class DecodeServer:
         never waits for tick N's tokens), each with a non-blocking
         device->host token fetch already started. Returns the oldest
         unconsumed arrival to wait on (None when idle). Cheap host work
-        only — safe to call while holding a serving-loop lock."""
+        only — safe to call while holding a serving-loop lock.
+
+        Under paged KV, every dispatch is preceded by the block
+        discipline (_pre_dispatch): growth blocks allocated, shared
+        blocks COW-copied; pool pressure resolves by barrier-flush ->
+        prefix eviction -> preemption, each of which changes the batch
+        composition — the loop recomputes the active set and retries."""
         active = self._active_slots()
         while active and len(self._inflight) < self.pipeline_depth:
+            if not self._pre_dispatch(active):
+                active = self._active_slots()
+                continue
             self._dispatch_tick(active)
         return self._inflight[0] if self._inflight else None
 
@@ -845,6 +1625,13 @@ class DecodeServer:
         if self._prefilling:
             emitted += self._prefill_tick()
         self._admit()       # fill slots freed by completions (barriers)
+        if not self._active and not self._pending and self._inflight:
+            # the burst ended with over-decoded ticks still in flight:
+            # consume them NOW (their tokens are pure rollback — no
+            # request appends) so no device handles or deferred block
+            # frees linger while the engine idles
+            self._flush()
+        self._drain_deferred()      # paged: window empty -> frees land
         self._note_window_empty()
         return emitted
 
@@ -915,9 +1702,14 @@ class DecodeServer:
         The template owns the shared scaffolding (window management,
         keep mask, sampling flag, async fetch, ordered consumption) so
         engine subclasses override only this pair."""
-        toks, self._last, self.cache = self._decode(
-            self.params, self._last, self.cache, keep,
-            self._temp, self._topk, self._topp, self._seed, sampling)
+        if self.paged:
+            toks, self._last, self.cache = self._decode(
+                self.params, self._last, self.cache, self._table, keep,
+                self._temp, self._topk, self._topp, self._seed, sampling)
+        else:
+            toks, self._last, self.cache = self._decode(
+                self.params, self._last, self.cache, keep,
+                self._temp, self._topk, self._topp, self._seed, sampling)
         return (toks,)                                  # [B, T]
 
     def _consume(self, ent: _InFlight) -> int:
@@ -975,6 +1767,7 @@ class DecodeServer:
         while self._inflight:
             emitted += self._consume(self._inflight.popleft())
         self._flush_emitted += emitted
+        self._drain_deferred()      # paged: barrier landed, frees land
         return emitted
 
     def pop_result(self, rid: int) -> Optional[List[int]]:
@@ -1016,6 +1809,14 @@ class DecodeServer:
                 # drop the chunk queue FIRST: the slot frees below, and
                 # a later _prefill_tick must never install into it
                 del self._prefilling[i]
+                if self.paged:
+                    # blocks reserved at chunked admission (shared
+                    # prefix refs included) were never exposed to the
+                    # device table — release them directly
+                    for b in (ent["req"].reserved_blocks or []):
+                        self._alloc.decref(b)
+                    ent["req"].reserved_blocks = None
+                    ent["req"].shared_blocks = []
                 break
         for req in self._active.values():
             if req.rid == rid:
@@ -1040,7 +1841,9 @@ class DecodeServer:
                 return list(req.out), False
         for req in self._pending:
             if req.rid == rid:
-                return [], False
+                # a preempted request waits here WITH committed tokens:
+                # a streaming client keeps them through the pause
+                return list(req.out), False
         return None
 
     def occupancy(self) -> tuple:
@@ -1086,10 +1889,20 @@ class DecodeServer:
                          "in_flight": len(self._inflight),
                          "flushes": self.pipeline_flushes,
                          "ticks_dispatched": self.ticks_dispatched},
-            "prefix_cache": {"capacity": self._prefix_max,
-                             "entries": len(self._prefixes),
-                             "hits": self.prefix_hits,
-                             "tokens_saved": self.prefix_tokens_saved},
+            "prefix_cache": (
+                {"capacity_blocks": self._pindex.max_blocks,
+                 "entries": self._pindex.stats()["chains"],
+                 "blocks": self._pindex.block_count,
+                 "hits": self._pindex.hits,
+                 "tokens_saved": self._pindex.tokens_saved}
+                if self.paged and self._pindex is not None else
+                {"capacity": self._prefix_max,
+                 "entries": len(self._prefixes),
+                 "hits": self.prefix_hits,
+                 "tokens_saved": self.prefix_tokens_saved}),
+            # block-pool occupancy + the admission-time HBM snapshot:
+            # why a request queued, answerable from one /stats read
+            "kv": self.kv_stats(),
             "compiles": {"count": self.compiles,
                          "seconds": round(self.compile_s, 6)},
             "tokens_emitted": self.tokens_emitted,
@@ -1103,8 +1916,15 @@ class DecodeServer:
         {request_id: prompt + generated tokens} for requests finished
         since the last drain, and forgets them."""
         while self._active or self._pending:
-            if not self._active:       # pending but no free slot: bug
-                raise RuntimeError("pending requests with no active slots")
+            if not self._active:
+                # a preemption can legitimately leave only pending
+                # work (the victim re-queued, everyone else finished):
+                # admission is the step that makes progress here. If
+                # it cannot admit either, THAT is the bug.
+                self._admit()
+                if not self._active:
+                    raise RuntimeError(
+                        "pending requests with no active slots")
             self.step()
         # the last completion can leave over-decoded arrivals in flight
         # (every request already done): drain them so no device handles

@@ -89,6 +89,13 @@ class SpeculativeDecodeServer(DecodeServer):
         # so the knobs are accepted here and clamped, not rejected.
         kw["pipeline_depth"] = 1
         kw["decode_steps"] = 1
+        # paged KV clamps off likewise: the draft model keeps its own
+        # per-row-pos KV cache, and paging BOTH caches (plus the verify
+        # window's k-position rollback discipline over block tables) is
+        # the ROADMAP follow-up that also unpins the pipeline knobs —
+        # until then the spec engine stays slot-static.
+        kw["kv_blocks"] = 0
+        kw["kv_block_size"] = 0
         super().__init__(params, cfg, max_batch=max_batch,
                          max_len=max_len, **kw)
         self.draft_params = draft_params

@@ -26,7 +26,8 @@ import jax
 import jax.numpy as jnp
 
 __all__ = ["attention", "xla_attention", "flash_attention_available",
-           "splash_attention_available", "effective_impl"]
+           "splash_attention_available", "effective_impl",
+           "paged_gather_kv", "paged_scatter_kv"]
 
 
 @functools.cache
@@ -207,6 +208,59 @@ def xla_attention(
         scores = jnp.where(mask, scores, jnp.finfo(jnp.float32).min)
     probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
     return jnp.einsum("bhgqk,bhkd->bhgqd", probs, v).reshape(b, h, s_q, d)
+
+
+def paged_gather_kv(arena: jax.Array, table: jax.Array) -> jax.Array:
+    """Paged-KV compute view: gather one layer's pooled block arena
+    into each row's contiguous cache timeline by its block table.
+
+    arena: [NB, Hkv, bs, D] (the layer's slice of the pooled HBM
+    arena — NB physical blocks of bs tokens); table: [B, nb] int32
+    mapping each row's logical block j to a physical block (entry 0 =
+    the reserved null block for unassigned slots) -> [B, Hkv, nb*bs, D],
+    bit-identical to the slot-static cache for every position the
+    caller's ``pos`` mask admits (garbage beyond ``pos`` is masked to
+    -inf before softmax exactly like slot-static padding, so it cannot
+    perturb the numerics — the paged greedy==generate contract rests on
+    this). The gathered view is a transient the compiler may fuse; the
+    RESIDENT footprint is the arena, which is what paging shrinks.
+
+    XLA formulation (one gather per layer); the Pallas kernel that
+    walks tables in-VMEM without materializing the view is the planned
+    TPU follow-up and slots in behind this same signature.
+    """
+    nb_blocks, h_kv, bs, d = arena.shape
+    b, nb = table.shape
+    view = arena[table]                     # [B, nb, Hkv, bs, D]
+    return view.transpose(0, 2, 1, 3, 4).reshape(b, h_kv, nb * bs, d)
+
+
+def paged_scatter_kv(arena: jax.Array, table: jax.Array, pos: jax.Array,
+                     vals: jax.Array) -> jax.Array:
+    """Write per-row KV entries into the pooled arena by block table.
+
+    arena: [NB, Hkv, bs, D]; table: [B, nb]; pos: [B] (each row's write
+    position on its own timeline); vals: [B, Hkv, S, D] (the S tokens
+    at positions pos..pos+S-1 per row). Rows write only blocks they own
+    exclusively — the host's COW discipline guarantees it — so the
+    scatter never needs atomics. Rows routed to the null block (table
+    all-zeros for inactive slots) may collide there; the null block's
+    content is never read unmasked, so the collision is harmless.
+    Out-of-range logical blocks (pipeline over-decode past the row's
+    timeline) route to the null block too — clamping into the row's
+    LAST entry would wrap the write onto a committed position, which a
+    COW fork sharing that block could still read."""
+    nb_blocks, h_kv, bs, d = arena.shape
+    b, s = vals.shape[0], vals.shape[2]
+    nb = table.shape[1]
+    offs = pos[:, None] + jnp.arange(s)[None, :]            # [B, S]
+    logical = offs // bs
+    phys = jnp.where(
+        logical < nb,
+        jnp.take_along_axis(table, jnp.minimum(logical, nb - 1), axis=1),
+        0)                                                  # [B, S]
+    return arena.at[phys, :, offs % bs, :].set(
+        vals.transpose(0, 2, 1, 3))                         # [B,S,Hkv,D]
 
 
 def attention(

@@ -53,6 +53,24 @@ def test_bench_serve_smoke_writes_pipeline_artifact(tmp_path):
     for p in artifact["pipeline"] + [fused]:
         assert 0 <= p["host_overhead_pct"] <= 100
 
+    # paged-KV section: slot-static vs paged at the SAME KV token
+    # budget over the mixed-length trace — sustained concurrency is
+    # the headline, and the ratio is structural (slot counts and
+    # admission order, not timing), so the acceptance floor pins hard
+    paged = artifact["paged"]
+    assert paged["budget_tokens"] == \
+        paged["static"]["slots"] * paged["max_len"]
+    assert (paged["kv_blocks"] - 1) * paged["kv_block_size"] \
+        <= paged["budget_tokens"]
+    assert paged["static"]["completed"] == paged["trace_requests"]
+    assert paged["paged"]["completed"] == paged["trace_requests"]
+    assert paged["paged"]["slots"] > paged["static"]["slots"]
+    assert paged["paged"]["peak_active_slots"] > \
+        paged["static"]["peak_active_slots"]
+    assert paged["concurrency_ratio"] >= 1.5, (
+        f"paged engine sustained only {paged['concurrency_ratio']}x the "
+        f"slot-static concurrency at the same KV budget (floor: 1.5x)")
+
     # per-request latency ledger section: TTFT/TPOT/e2e percentiles +
     # goodput per (pipeline_depth, decode_steps) config
     assert artifact["slo"]["ttft_ms"] > 0 and artifact["slo"]["tpot_ms"] > 0

@@ -1,17 +1,34 @@
-"""Property tests for the scheduler's watch-fed ClusterCache
-(scheduler/cache.py): under ANY interleaving of watch events — including
-stale, duplicated, and out-of-order deliveries — the cache must converge
-to the freshest-resourceVersion view, never regress an object to an
-older RV, and bump its generation exactly when visible state changes.
-The cache replaced per-event relists (the 1024-node scale point rests on
-it), so these invariants carry the scheduler's correctness at scale.
+"""Cache property tests, two subsystems:
+
+1. The scheduler's watch-fed ClusterCache (scheduler/cache.py): under
+   ANY interleaving of watch events — including stale, duplicated, and
+   out-of-order deliveries — the cache must converge to the freshest-
+   resourceVersion view, never regress an object to an older RV, and
+   bump its generation exactly when visible state changes. These use
+   hypothesis when available (guarded import: environments without it
+   skip rather than failing collection).
+2. The paged-KV BlockAllocator / PrefixBlockIndex
+   (models/kvblocks.py): fuzzed alloc/free/fork/write sequences must
+   keep every referenced block at refcount >= 1, never double-free,
+   never lose a block, and never let a COW fork alias a written block.
+   Pure seeded-``random`` fuzzing — jax-free and hypothesis-free, so
+   the serving engine's memory-safety net runs everywhere.
 """
 import random
 
-from hypothesis import given, settings
-from hypothesis import strategies as st
+import pytest
+
+try:
+    from hypothesis import given, settings
+    from hypothesis import strategies as st
+    HAVE_HYPOTHESIS = True
+except ImportError:        # pragma: no cover - environment-dependent
+    HAVE_HYPOTHESIS = False
 
 from nos_tpu.kube.objects import ObjectMeta, Pod, PodSpec
+from nos_tpu.models.kvblocks import (
+    BlockAllocator, NoFreeBlocks, PrefixBlockIndex, blocks_for,
+)
 from nos_tpu.scheduler.cache import ClusterCache
 
 
@@ -33,15 +50,29 @@ NAMES = ["a", "b", "c"]
 # events drawn natively so Hypothesis can SHRINK a failing interleaving
 # to a minimal readable sequence (an opaque PRNG seed cannot shrink):
 # (name, type, swap-with-next, duplicate-at-end) per history slot
-EVENT_SLOTS = st.lists(
-    st.tuples(
-        st.sampled_from(NAMES),
-        st.sampled_from(["ADDED", "MODIFIED", "MODIFIED", "DELETED"]),
-        st.booleans(),
-        st.booleans(),
-    ),
-    min_size=0, max_size=40,
-)
+if HAVE_HYPOTHESIS:
+    EVENT_SLOTS = st.lists(
+        st.tuples(
+            st.sampled_from(NAMES),
+            st.sampled_from(["ADDED", "MODIFIED", "MODIFIED", "DELETED"]),
+            st.booleans(),
+            st.booleans(),
+        ),
+        min_size=0, max_size=40,
+    )
+else:       # keep the decorators below importable: skip at run time
+    def settings(**kw):
+        return lambda f: pytest.mark.skip(reason="hypothesis missing")(f)
+
+    def given(*a, **kw):
+        return lambda f: f
+
+    class _StStub:
+        def __getattr__(self, name):
+            return lambda *a, **kw: None
+
+    st = _StStub()
+    EVENT_SLOTS = None
 
 
 @settings(max_examples=80, deadline=None)
@@ -150,3 +181,174 @@ def test_remove_and_upsert_roundtrip_generation():
     assert cache.generation == g0 + 2
     cache.remove("Pod", p)                  # absent: no phantom bump
     assert cache.generation == g0 + 2
+
+
+# ---------------------------------------------------------------------------
+# paged-KV BlockAllocator: fuzzed alloc/free/fork/write sequences
+# (ISSUE 6 satellite). A "holder" models one serving slot's block
+# table; "write" models the engine's pre-write COW discipline
+# (_ensure_blocks): a shared block must be copied, never mutated.
+# ---------------------------------------------------------------------------
+
+def _check_conservation(alloc, holders):
+    """No lost blocks, no phantom refs: the allocator's refcounts must
+    equal exactly the references the model holds, and free + used must
+    tile the pool."""
+    refs = {}
+    for table in holders.values():
+        for b in table:
+            refs[b] = refs.get(b, 0) + 1
+    assert alloc.free_count + alloc.used_count == alloc.capacity
+    for b in range(1, alloc.num_blocks):
+        assert alloc.ref(b) == refs.get(b, 0), (
+            f"block {b}: allocator ref {alloc.ref(b)} != "
+            f"model ref {refs.get(b, 0)}")
+    for b, n in refs.items():
+        assert n >= 1 and alloc.ref(b) >= 1
+    # the O(1) shared counter must track the model exactly
+    assert alloc.shared_count() == sum(1 for n in refs.values() if n > 1)
+
+
+@pytest.mark.parametrize("seed", range(8))
+def test_allocator_fuzz_alloc_free_fork_write(seed):
+    rng = random.Random(seed)
+    alloc = BlockAllocator(num_blocks=rng.randint(4, 33),
+                           block_size=8)
+    holders = {}                        # holder id -> list of block ids
+    writes = {}                         # block id -> sole writer id
+    next_h = 0
+    for _ in range(600):
+        op = rng.random()
+        if op < 0.35:                                   # alloc
+            try:
+                b = alloc.alloc()
+            except NoFreeBlocks:
+                assert alloc.free_count == 0
+                continue
+            holders.setdefault(next_h, []).append(b)
+            next_h += 1
+        elif op < 0.55 and holders:                     # free a holder
+            h = rng.choice(list(holders))
+            for b in holders.pop(h):
+                alloc.decref(b)
+                writes.pop(b, None) if alloc.ref(b) == 0 else None
+        elif op < 0.8 and holders:                      # fork a holder
+            h = rng.choice(list(holders))
+            holders.setdefault(next_h, []).extend(
+                alloc.fork(holders[h]))
+            next_h += 1
+        elif holders:                                   # write (COW)
+            h = rng.choice(list(holders))
+            table = holders[h]
+            if not table:
+                continue
+            i = rng.randrange(len(table))
+            b = table[i]
+            if alloc.writable(b):
+                # sole holder: in-place write allowed; record the
+                # writer so aliasing would be detectable
+                assert writes.get(b, h) == h or alloc.ref(b) == 1
+                writes[b] = h
+            else:
+                # shared: the COW discipline — copy, then write the
+                # copy; the original must still be referenced by the
+                # OTHER holders and must never gain this write
+                try:
+                    fresh = alloc.alloc()
+                except NoFreeBlocks:
+                    continue
+                alloc.decref(b)
+                table[i] = fresh
+                writes[fresh] = h
+                assert alloc.ref(b) >= 1, \
+                    "COW source lost its other holders' refs"
+                assert alloc.writable(fresh), \
+                    "freshly COW'd block must be exclusively owned"
+        _check_conservation(alloc, holders)
+    # drain everything: the pool must come back whole
+    for h in list(holders):
+        for b in holders.pop(h):
+            alloc.decref(b)
+    assert alloc.free_count == alloc.capacity
+    assert alloc.used_count == 0
+    assert alloc.shared_count() == 0
+
+
+def test_allocator_double_free_and_bad_refs_raise():
+    alloc = BlockAllocator(num_blocks=4, block_size=8)
+    b = alloc.alloc()
+    alloc.decref(b)
+    with pytest.raises(ValueError, match="double free"):
+        alloc.decref(b)
+    with pytest.raises(ValueError, match="unreferenced"):
+        alloc.incref(b)
+    with pytest.raises(ValueError, match="null block"):
+        alloc.decref(0)
+    with pytest.raises(ValueError, match="null block"):
+        alloc.incref(0)
+    with pytest.raises(NoFreeBlocks):
+        alloc.alloc_many(99)
+    assert alloc.free_count == alloc.capacity   # failed alloc leaked nothing
+
+
+def test_cow_fork_never_aliases_a_written_block():
+    # the acceptance property stated directly: after fork, any write
+    # through either holder lands in a block the other cannot see
+    alloc = BlockAllocator(num_blocks=8, block_size=8)
+    a = alloc.alloc_many(3)
+    b = alloc.fork(a)
+    assert a == b and all(not alloc.writable(x) for x in a)
+    # writer COWs block 1
+    fresh = alloc.alloc()
+    alloc.decref(b[1])
+    b[1] = fresh
+    assert b[1] != a[1]
+    assert alloc.writable(b[1])         # writer owns its copy
+    assert alloc.writable(a[1])         # other holder now sole owner too
+    for x in set(a + b):
+        while alloc.ref(x):
+            alloc.decref(x)
+    assert alloc.free_count == alloc.capacity
+
+
+@pytest.mark.parametrize("seed", range(4))
+def test_prefix_index_fuzz_conserves_blocks(seed):
+    rng = random.Random(1000 + seed)
+    alloc = BlockAllocator(num_blocks=24, block_size=4)
+    idx = PrefixBlockIndex(alloc, max_blocks=rng.randint(2, 10))
+    live = {}                           # chain tokens -> our own refs
+    for _ in range(200):
+        op = rng.random()
+        if op < 0.5:
+            # publish a random prompt (holder allocates, publishes,
+            # then drops its own refs — the slot-lifecycle shape)
+            plen = rng.randint(1, 16)
+            prompt = tuple(rng.randrange(4) for _ in range(plen))
+            need = blocks_for(plen, 4)
+            try:
+                table = alloc.alloc_many(need)
+            except NoFreeBlocks:
+                continue
+            idx.publish(prompt, table)
+            for b in table:
+                alloc.decref(b)
+        elif op < 0.8:
+            # match + take, then release (the admission shape)
+            plen = rng.randint(2, 16)
+            prompt = [rng.randrange(4) for _ in range(plen)]
+            m, key = idx.match(prompt, plen - 1)
+            assert m % 4 == 0
+            if m > 0:
+                assert tuple(prompt[:m]) == key[:m]
+                shared = idx.take(key, m)
+                assert all(alloc.ref(b) >= 2 for b in shared)
+                for b in shared:
+                    alloc.decref(b)
+        else:
+            idx.evict_lru(rng.randint(1, 4))
+        assert idx.block_count <= max(idx.max_blocks,
+                                      max((blocks_for(len(k), 4)
+                                           for k in idx._chains), default=0))
+        assert alloc.used_count == idx.block_count
+    idx.clear()
+    assert alloc.free_count == alloc.capacity, live

@@ -323,6 +323,30 @@ def test_serving_deployment_passes_slo_and_telemetry_args():
     assert values["serving"]["deviceStatsIntervalSeconds"] == 10
 
 
+def test_serving_deployment_passes_paged_kv_args():
+    """The serving Deployment must plumb the paged-KV knobs
+    (serving.kv.*) to nos-tpu-server flags, and the chart defaults must
+    ship paging OFF (slot-static) with swap-mode preemption selected
+    for whoever turns it on."""
+    path = os.path.join(CHART, "templates", "serving",
+                        "deployment_server.yaml")
+    with open(path) as f:
+        text = f.read()
+    for flag, value in (
+        ("--kv-block-size", ".Values.serving.kv.blockSize"),
+        ("--kv-blocks", ".Values.serving.kv.blocks"),
+        ("--kv-swap", ".Values.serving.kv.swap"),
+    ):
+        assert flag in text, f"serving deployment missing {flag}"
+        assert value in text, f"serving deployment missing {value}"
+    # the flag takes on|off, not a raw boolean
+    assert 'ternary "on" "off"' in text
+    with open(os.path.join(CHART, "values.yaml")) as f:
+        values = yaml.safe_load(f)
+    assert values["serving"]["kv"] == {
+        "blockSize": 0, "blocks": 0, "swap": True}
+
+
 def test_serving_sample_valid():
     """The serving Deployment sample must parse, and its embedded config
     must construct a real ServerConfig (drift between the sample and the

@@ -319,6 +319,71 @@ def test_metricsexporter_collect():
         http.stop()
 
 
+def test_serving_http_splits_permanent_400_from_transient_429():
+    """The serving binary's admission refusals travel different wires
+    (ISSUE 6 satellite): a PERMANENTLY infeasible request (more KV
+    blocks than the whole pool, prompt exceeding the cache) answers
+    400 with no Retry-After — retrying is useless — while TRANSIENT
+    capacity exhaustion answers 429 + Retry-After. Runs the real HTTP
+    handler over a jax-free stub engine (cmd/server imports lazily)."""
+    from nos_tpu.cmd.server import (
+        ServerConfig, ServingLoop, make_http_server,
+    )
+    from nos_tpu.models.errors import Infeasible, QueueFull
+
+    class Engine:
+        def has_work(self):
+            return False
+
+        def step(self):
+            return 0
+
+        def submit(self, prompt, max_new_tokens, **kw):
+            if len(prompt) + max_new_tokens > 8:
+                raise Infeasible(
+                    "request needs 99 KV blocks at its full length but "
+                    "the pool only has 3")
+            raise QueueFull("8 requests already waiting "
+                            "(max_pending=8); shed load and retry")
+
+        def pop_result(self, rid):
+            return None
+
+        def progress(self, rid):
+            return None
+
+    loop = ServingLoop(Engine())
+    httpd = make_http_server(ServerConfig(port=0), loop)
+    t = threading.Thread(target=httpd.serve_forever, daemon=True)
+    t.start()
+    url = f"http://127.0.0.1:{httpd.server_address[1]}/v1/generate"
+
+    def post(body):
+        req = urllib.request.Request(
+            url, data=json.dumps(body).encode(),
+            headers={"Content-Type": "application/json"}, method="POST")
+        return urllib.request.urlopen(req, timeout=30)
+
+    try:
+        with pytest.raises(urllib.error.HTTPError) as e:
+            post({"prompt": [1] * 20, "max_new_tokens": 20})
+        assert e.value.code == 400
+        assert e.value.headers.get("Retry-After") is None
+        body = json.loads(e.value.read())
+        assert body["infeasible"] is True
+        assert "KV blocks" in body["error"]
+
+        with pytest.raises(urllib.error.HTTPError) as e:
+            post({"prompt": [1], "max_new_tokens": 2})
+        assert e.value.code == 429
+        assert e.value.headers.get("Retry-After") == "1"
+        assert "infeasible" not in json.loads(e.value.read())
+    finally:
+        httpd.shutdown()
+        loop.shutdown()
+        httpd.server_close()
+
+
 def test_healthserver_stats_route():
     """Every daemon's HealthServer answers GET /stats with the hosted
     manager's live introspection snapshot (404 when the component

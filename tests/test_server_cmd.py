@@ -1003,3 +1003,94 @@ def test_slo_counters_goodput_and_breach_pins_trace():
             "SLO breach must pin the request's trace"
     finally:
         loop.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# paged KV cache (ISSUE 6): /stats block accounting, kv gauges, flags
+# ---------------------------------------------------------------------------
+
+def test_paged_engine_over_http_stats_and_gauges():
+    """A paged serving pod must answer "why is my request queued" from
+    one /stats read — block-pool occupancy + the admission-time HBM
+    snapshot slot — and export the nos_tpu_serve_kv_blocks_* gauges,
+    while serving tokens bit-identical to generate()."""
+    from nos_tpu.utils.metrics import default_registry
+
+    mcfg = tfm.TransformerConfig(**MODEL, dtype=jnp.float32)
+    params = tfm.init_params(jax.random.PRNGKey(0), mcfg)
+    eng = DecodeServer(params, mcfg, max_batch=4, kv_block_size=8,
+                       kv_blocks=24)
+    loop = ServingLoop(eng)
+    httpd = make_http_server(ServerConfig(**MODEL, bf16=False, port=0),
+                             loop)
+    t = threading.Thread(target=httpd.serve_forever, daemon=True)
+    t.start()
+    url = f"http://127.0.0.1:{httpd.server_address[1]}"
+    try:
+        got = post(url, {"prompt": [1, 2, 3], "max_new_tokens": 5,
+                         "priority": 3})
+        want = [int(x) for x in generate(
+            params, mcfg, jnp.asarray([[1, 2, 3]], jnp.int32), 5)[0]]
+        assert got["tokens"] == want
+
+        with urllib.request.urlopen(url + "/stats", timeout=30) as r:
+            snap = json.loads(r.read())
+        kv = snap["kv"]
+        assert kv["block_size"] == 8
+        assert kv["blocks_total"] == 23
+        assert kv["blocks_free"] + kv["blocks_used"] == kv["blocks_total"]
+        assert kv["preempts"] == {"swap": 0, "recompute": 0}
+        assert "cow_shared" in kv and "hbm" in kv
+
+        text = default_registry().expose()
+        assert "nos_tpu_serve_kv_blocks_free" in text
+        assert "nos_tpu_serve_kv_blocks_used" in text
+        assert "nos_tpu_serve_kv_blocks_cow_shared" in text
+        assert 'nos_tpu_serve_preempt_total{mode="swap"}' in text
+        assert 'nos_tpu_serve_preempt_total{mode="recompute"}' in text
+    finally:
+        httpd.shutdown()
+        loop.shutdown()
+        httpd.server_close()
+
+
+def test_build_engine_paged_flags_and_validation():
+    from nos_tpu.cmd.server import build_engine
+
+    eng = build_engine(ServerConfig(**MODEL, bf16=False, max_batch=2,
+                                    kv_block_size=8, kv_blocks=16))
+    assert eng.paged and eng.kv_block_size == 8
+    assert eng.kv_stats()["blocks_total"] == 15
+
+    with pytest.raises(ValueError, match="power of two"):
+        build_engine(ServerConfig(**MODEL, kv_block_size=12, kv_blocks=16))
+    with pytest.raises(ValueError, match="multiple of"):
+        build_engine(ServerConfig(**MODEL, kv_block_size=128,
+                                  kv_blocks=16))
+    with pytest.raises(ValueError, match="mesh-aware"):
+        build_engine(ServerConfig(**MODEL, kv_block_size=8, kv_blocks=16,
+                                  tp=2))
+    with pytest.raises(ValueError, match="kv_blocks"):
+        build_engine(ServerConfig(**MODEL, kv_block_size=8, kv_blocks=1))
+
+
+def test_kv_flags_override_config():
+    from nos_tpu.cmd import server as server_mod
+
+    seen = {}
+
+    def fake_build(cfg):
+        seen["cfg"] = cfg
+        raise SystemExit(0)          # stop before the serving loop
+
+    real = server_mod.build_engine
+    server_mod.build_engine = fake_build
+    try:
+        with pytest.raises(SystemExit):
+            server_mod.main(["--kv-block-size", "16", "--kv-blocks",
+                             "32", "--kv-swap", "off"])
+    finally:
+        server_mod.build_engine = real
+    cfg = seen["cfg"]
+    assert cfg.kv_block_size == 16 and cfg.kv_blocks == 32
+    assert cfg.kv_swap is False

@@ -1,0 +1,460 @@
+"""Paged KV-cache subsystem (ISSUE 6): block-table decode, COW prefix
+sharing and fork, memory-aware admission, preempt-and-resume.
+
+The acceptance invariants this file pins:
+- greedy output stays bit-identical to ``generate()`` under paging at
+  every (pipeline_depth, decode_steps) in {1,2} x {1,4} — including
+  across a COW fork and a preempt-and-resume in BOTH modes (swap and
+  recompute);
+- sampled streams stay (seed, absolute-position)-keyed, so paging does
+  not change them either;
+- block accounting balances at every quiescent point (no leaks, no
+  double frees), COW forks never alias a written block;
+- admission is memory-aware: a request waits for free-block headroom
+  instead of thrashing, permanent-infeasible requests raise Infeasible
+  (HTTP 400) while transient capacity raises QueueFull (429);
+- under pool pressure the engine preempts the lowest-priority slot and
+  re-enqueues it at the FRONT of the queue instead of failing.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from nos_tpu.models import transformer as tfm
+from nos_tpu.models.generate import generate
+from nos_tpu.models.serving import DecodeServer, Infeasible, QueueFull
+
+CFG = tfm.TransformerConfig(vocab=64, d_model=32, n_layers=2, n_heads=4,
+                            n_kv_heads=2, d_ff=64, max_seq=64,
+                            dtype=jnp.float32)
+
+# the ISSUE acceptance grid: {1, 2} x {1, 4}
+GRID = [(d, t) for d in (1, 2) for t in (1, 4)]
+
+
+@pytest.fixture(scope="module")
+def params():
+    return tfm.init_params(jax.random.PRNGKey(0), CFG)
+
+
+@pytest.fixture(scope="module")
+def engines(params):
+    """Shared drained paged engines keyed by (decode_steps, extras);
+    pipeline_depth is host-side state retuned per test (the same
+    compiled-program economics as test_serving_pipeline)."""
+    cache = {}
+
+    def at(depth, steps=1, mb=2, blocks=24, **kw):
+        key = (steps, mb, blocks, tuple(sorted(kw.items())))
+        eng = cache.get(key)
+        if eng is None:
+            eng = DecodeServer(params, CFG, max_batch=mb,
+                               decode_steps=steps, kv_block_size=8,
+                               kv_blocks=blocks, **kw)
+            cache[key] = eng
+        assert not eng.has_work(), "previous test left work behind"
+        eng.pipeline_depth = depth
+        return eng
+
+    return at
+
+
+def ref(params, prompt, n):
+    out = generate(params, CFG, jnp.asarray([prompt], jnp.int32), n)
+    return [int(t) for t in out[0]]
+
+
+def assert_pool_balanced(eng):
+    """Quiescent-pool invariant: every block is either free or held by
+    the prefix index — no slot references, no leaks, no deferred."""
+    assert not eng.has_work()
+    held = eng._pindex.block_count if eng._pindex is not None else 0
+    assert eng._alloc.used_count == held, (
+        eng._alloc.used_count, held)
+    assert not eng._deferred
+    assert all(not t for t in eng._tables)
+
+
+# ---------------------------------------------------------------------------
+# bit-exactness across the grid
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("depth,steps", GRID)
+def test_paged_greedy_bit_exact_across_grid(engines, params, depth, steps):
+    # 3 requests over 2 slots: slot recycling and block realloc inside
+    srv = engines(depth, steps)
+    prompts = [([1, 2, 3], 6), ([60, 61], 9), ([7, 7, 7, 7, 7], 5)]
+    rids = [srv.submit(p, n) for p, n in prompts]
+    res = srv.drain()
+    for rid, (p, n) in zip(rids, prompts):
+        assert res[rid] == ref(params, p, n), (depth, steps, rid)
+    assert_pool_balanced(srv)
+
+
+@pytest.mark.parametrize("depth,steps", GRID)
+def test_cow_fork_bit_exact_across_grid(engines, params, depth, steps):
+    # fork mid-decode: source and fork must BOTH finish bit-identical
+    # to generate(), and the shared tail block must COW-copy rather
+    # than alias (the pool ends balanced, shared count returns to 0)
+    srv = engines(depth, steps)
+    r0 = srv.submit([4, 5], 16)
+    srv.step()
+    f0 = srv.fork(r0)
+    assert srv._alloc.shared_count() > 0      # blocks genuinely shared
+    res = srv.drain()
+    want = ref(params, [4, 5], 16)
+    assert res[r0] == want, (depth, steps, "source")
+    assert res[f0] == want, (depth, steps, "fork")
+    assert srv._alloc.shared_count() == 0
+    assert_pool_balanced(srv)
+
+
+@pytest.mark.parametrize("mode", ["swap", "recompute"])
+@pytest.mark.parametrize("depth,steps", GRID)
+def test_preempt_resume_bit_exact_across_grid(engines, params, depth,
+                                              steps, mode):
+    srv = engines(depth, steps)
+    # budget large enough that the preempt barrier's flush (up to
+    # depth*steps late tokens) cannot finish the victim first
+    r0 = srv.submit([4, 5], 24)
+    r1 = srv.submit([9, 8, 7], 8)
+    for _ in range(2):
+        srv.step()
+    assert srv.preempt(r0, mode)
+    assert srv.kv_stats()["preempts"][mode] >= 1
+    # the victim resumes at the FRONT of the pending queue
+    assert srv._pending and srv._pending[0].rid == r0
+    res = srv.drain()
+    assert res[r0] == ref(params, [4, 5], 24), (depth, steps, mode)
+    assert res[r1] == ref(params, [9, 8, 7], 8), (depth, steps, mode)
+    assert_pool_balanced(srv)
+
+
+def test_sampled_streams_invariant_to_paging(engines, params):
+    kw = dict(temperature=0.9, top_k=8, seed=17)
+    base = DecodeServer(params, CFG, max_batch=2)
+    r = base.submit([4, 5], 8, **kw)
+    want = base.drain()[r]
+
+    srv = engines(2, 1)
+    r1 = srv.submit([4, 5], 8, **kw)
+    r2 = srv.submit([9, 9], 8, temperature=1.2, seed=5)
+    res = srv.drain()
+    assert res[r1] == want
+    assert len(res[r2]) == 2 + 8
+
+
+def test_sampled_fork_diverges_by_seed(engines, params):
+    # n>1 sampling: fork the same source twice with different seeds —
+    # shared history, divergent futures, no cross-corruption
+    srv = engines(1, 1, mb=4, blocks=40)
+    r0 = srv.submit([4, 5], 10, temperature=0.9, seed=3)
+    for _ in range(3):
+        srv.step()
+    f1 = srv.fork(r0, seed=100)
+    f2 = srv.fork(r0, seed=200)
+    res = srv.drain()
+    base = res[r0]
+    # all three share the pre-fork history; the forks diverge after
+    pre = 2 + 3  # prompt + tokens produced before the first fork
+    assert res[f1][:pre] == base[:pre]
+    assert res[f2][:pre] == base[:pre]
+    assert res[f1] != res[f2]
+    assert_pool_balanced(srv)
+
+
+# ---------------------------------------------------------------------------
+# block-granular prefix sharing
+# ---------------------------------------------------------------------------
+
+def test_block_granular_prefix_reuse_is_exact_and_shares_storage(
+        engines, params):
+    srv = engines(1, 1, mb=2, blocks=40, prefix_cache_size=8)
+    sysp = list(range(1, 20))               # 19 tokens -> 2 full blocks
+    srv.submit(sysp + [33], 2, cache_prefix=True)
+    srv.drain()
+    kv0 = srv.kv_stats()
+    assert kv0["prefix"]["blocks"] == 2     # published chain parked
+    used0 = kv0["blocks_used"]
+
+    r = srv.submit(sysp + [40, 41], 5)
+    # while active, the prefix blocks are SHARED, not copied
+    assert srv._alloc.shared_count() >= 2
+    res = srv.drain()
+    assert res[r] == ref(params, sysp + [40, 41], 5)
+    kv = srv.kv_stats()
+    assert kv["prefix"]["hits"] == 1
+    assert kv["prefix"]["tokens_saved"] == 16       # 2 blocks x 8
+    assert kv["blocks_used"] == used0               # nothing leaked
+    srv._pindex.clear()
+    srv.prefix_hits = srv.prefix_tokens_saved = 0
+    assert_pool_balanced(srv)
+
+
+def test_prefix_chains_evicted_under_admission_pressure(engines, params):
+    # prefix blocks must yield to live requests: with the pool nearly
+    # full of published chains and NO active slot, admission evicts
+    # LRU chains instead of deadlocking the queue
+    srv = engines(1, 1, mb=2, blocks=7, prefix_cache_size=8)
+    srv.submit(list(range(1, 17)) + [20], 2, cache_prefix=True)
+    srv.drain()
+    assert srv.kv_stats()["prefix"]["blocks"] == 2
+    long = [33] * 30                       # needs 4 blocks + headroom
+    r = srv.submit(long, 4)
+    res = srv.drain()
+    assert res[r] == ref(params, long, 4)
+    assert srv.kv_stats()["prefix"]["blocks"] == 0     # evicted
+    assert_pool_balanced(srv)
+
+
+# ---------------------------------------------------------------------------
+# memory-aware admission + pressure preemption
+# ---------------------------------------------------------------------------
+
+def test_admission_waits_for_block_headroom(engines, params):
+    # two long requests over a pool that fits ~one: the second shares
+    # the engine but must WAIT (pending, not failed) until the first
+    # completes and frees its blocks
+    srv = engines(1, 1, mb=2, blocks=6)
+    r0 = srv.submit([1] * 20, 8)            # needs 4 blocks at full len
+    r1 = srv.submit([2] * 20, 8)
+    assert len(srv._active) == 1 and len(srv._pending) == 1
+    res = srv.drain()
+    assert res[r0] == ref(params, [1] * 20, 8)
+    assert res[r1] == ref(params, [2] * 20, 8)
+    assert_pool_balanced(srv)
+
+
+def test_pressure_preempts_lowest_priority_youngest(engines, params):
+    # three growing requests over a tight pool: the engine preempts to
+    # make progress, victims chosen lowest-priority-then-youngest, and
+    # every output stays exact. Run at depth 2 so deferred frees and
+    # barrier flushes are exercised too.
+    for mode_kw, mode in ((dict(kv_swap=True), "swap"),
+                          (dict(kv_swap=False), "recompute")):
+        srv = DecodeServer(params, CFG, max_batch=3, kv_block_size=8,
+                           kv_blocks=7, pipeline_depth=2, **mode_kw)
+        protected = srv.submit([1, 2], 20, priority=5)
+        rids = [srv.submit([i + 3, i + 4], 20) for i in range(2)]
+        res = srv.drain()
+        assert res[protected] == ref(params, [1, 2], 20)
+        for i, rid in enumerate(rids):
+            assert res[rid] == ref(params, [i + 3, i + 4], 20), mode
+        kv = srv.kv_stats()
+        assert kv["preempts"][mode] > 0, kv
+        # the high-priority request was never the victim: preempted
+        # requests resume via the preempted flag, which clears — probe
+        # indirectly through totals: at least one preemption happened
+        # and the protected request finished at full length
+        assert len(res[protected]) == 2 + 20
+
+
+def test_priority_protects_from_preemption(engines, params):
+    srv = engines(1, 1, mb=2, blocks=10)
+    hi = srv.submit([1, 2], 6, priority=10)
+    lo = srv.submit([3, 4], 6, priority=0)
+    srv.step()
+    assert srv._preempt_victim()
+    # the LOW priority slot was vacated
+    assert any(r.rid == lo and r.preempted for r in srv._pending) \
+        or lo not in {r.rid for r in srv._active.values()}
+    assert hi in {r.rid for r in srv._active.values()}
+    res = srv.drain()
+    assert res[hi] == ref(params, [1, 2], 6)
+    assert res[lo] == ref(params, [3, 4], 6)
+    assert_pool_balanced(srv)
+
+
+def test_infeasible_vs_queuefull_split(engines, params):
+    srv = engines(1, 1, mb=1, blocks=4)     # 3 usable blocks = 24 tokens
+    # permanent: can never fit the pool -> Infeasible (a ValueError)
+    with pytest.raises(Infeasible, match="KV blocks"):
+        srv.submit([1] * 20, 20)
+    # permanent: exceeds the cache length -> Infeasible
+    with pytest.raises(Infeasible, match="exceeds cache length"):
+        srv.submit([1] * 60, 20)
+    # transient: pool is busy and the waiting line is full -> QueueFull
+    srv.max_pending = 1
+    try:
+        first = srv.submit([1, 2], 10)
+        srv.submit([3, 4], 10)              # waits
+        with pytest.raises(QueueFull, match="max_pending"):
+            srv.submit([5, 6], 2)
+        res = srv.drain()
+        assert res[first] == ref(params, [1, 2], 10)
+    finally:
+        srv.max_pending = 0
+        srv.drain()
+    assert_pool_balanced(srv)
+
+
+def test_prefix_evicted_for_waiting_request_while_others_decode(
+        engines, params):
+    # a pending request must not stall behind idle prefix-cache blocks
+    # just because another slot is decoding: headroom eviction applies
+    # with actives present too
+    srv = engines(1, 1, mb=2, blocks=8, prefix_cache_size=8)
+    srv.submit(list(range(1, 17)) + [20], 2, cache_prefix=True)
+    srv.drain()
+    assert srv.kv_stats()["prefix"]["blocks"] == 2
+    r0 = srv.submit([1, 2], 16)             # decoding, holds blocks
+    long = [33] * 30                        # needs the prefix's blocks
+    r1 = srv.submit(long, 4)
+    assert len(srv._active) == 2, "r1 admitted via prefix eviction"
+    assert srv.kv_stats()["prefix"]["blocks"] == 0
+    res = srv.drain()
+    assert res[r0] == ref(params, [1, 2], 16)
+    assert res[r1] == ref(params, long, 4)
+    assert_pool_balanced(srv)
+
+
+def test_sole_decoder_preempted_when_prefill_reservation_squeezes(
+        params):
+    # chunked admission reserves its full table upfront; if the only
+    # decoder's growth then hits a dry pool, the decoder must yield
+    # (resume later) rather than killing the engine with NoFreeBlocks.
+    # decode_steps=4 makes the decoder outrun the 6-tick prefill:
+    # free after the reservation is 2 blocks, the decoder needs 3 more
+    # within 5 ticks — dry mid-prefill by construction.
+    srv = DecodeServer(params, CFG, max_batch=2, kv_block_size=8,
+                       kv_blocks=10, prefill_chunk=8, decode_steps=4,
+                       kv_swap=False)
+    r0 = srv.submit(list(range(1, 8)), 20)  # 1 block now, 4 at full len
+    long = list(range(1, 49))               # 6 blocks reserved upfront
+    r1 = srv.submit(long, 2)
+    assert srv._prefilling
+    res = srv.drain()
+    assert res[r0] == ref(params, list(range(1, 8)), 20)
+    assert res[r1] == ref(params, long, 2)
+    assert srv.kv_stats()["preempts"]["recompute"] >= 1
+    assert_pool_balanced(srv)
+
+
+def test_fork_finds_slot_freed_by_inflight_completion(engines, params):
+    # a completion parked in an unconsumed in-flight tick frees its
+    # slot during fork's barrier flush — fork must see that capacity
+    srv = engines(4, 1, mb=2)
+    r0 = srv.submit([1, 2], 2)              # finishes almost at once
+    r1 = srv.submit([4, 5], 16)
+    for _ in range(2):
+        srv.step()
+    # r0 is done but may still occupy its slot pending consumption;
+    # fork(r1) must flush, free r0's slot, and succeed
+    f1 = srv.fork(r1)
+    res = srv.drain()
+    want = ref(params, [4, 5], 16)
+    assert res[r1] == want and res[f1] == want
+    assert res[r0] == ref(params, [1, 2], 2)
+    assert_pool_balanced(srv)
+
+
+def test_cancel_mid_prefill_releases_reserved_blocks(params):
+    srv = DecodeServer(params, CFG, max_batch=2, kv_block_size=8,
+                       kv_blocks=12, prefill_chunk=8)
+    r0 = srv.submit([1, 2, 3], 6)
+    long = list(range(1, 31))
+    r1 = srv.submit(long, 5)                # chunked: blocks reserved
+    assert srv._prefilling
+    reserved = srv._alloc.used_count
+    assert srv.cancel(r1)
+    assert srv._alloc.used_count < reserved
+    res = srv.drain()
+    assert res[r0] == ref(params, [1, 2, 3], 6)
+    assert_pool_balanced(srv)
+
+
+def test_chunked_prefill_composes_with_paging(params):
+    srv = DecodeServer(params, CFG, max_batch=2, kv_block_size=8,
+                       kv_blocks=24, prefill_chunk=8, pipeline_depth=2)
+    r0 = srv.submit([1, 2, 3], 10)
+    for _ in range(2):
+        srv.step()
+    long = list(range(1, 31))
+    r1 = srv.submit(long, 5)
+    res = srv.drain()
+    assert res[r0] == ref(params, [1, 2, 3], 10)
+    assert res[r1] == ref(params, long, 5)
+    assert_pool_balanced(srv)
+
+
+def test_scatter_overrun_routes_to_null_block_not_last_entry():
+    # pipeline over-decode can write past a fully-populated table's
+    # timeline; the scatter must route those writes to the reserved
+    # null block — clamping into the row's LAST entry would wrap the
+    # write onto a committed position a COW fork could still read
+    from nos_tpu.ops.attention import paged_scatter_kv
+
+    arena = jnp.zeros((4, 2, 8, 4))             # NB=4, Hkv=2, bs=8, D=4
+    table = jnp.asarray([[1, 2]], jnp.int32)    # 2 logical blocks
+    vals = jnp.ones((1, 2, 1, 4))
+    # in-range write: logical block 1 -> physical 2
+    out = paged_scatter_kv(arena, table, jnp.asarray([9]), vals)
+    assert float(out[2, 0, 1, 0]) == 1.0
+    # overrun write at pos 16 (logical block 2 >= nb): null block 0,
+    # and physical 2's committed content untouched
+    out2 = paged_scatter_kv(out, table, jnp.asarray([16]), vals)
+    assert float(out2[0, 0, 0, 0]) == 1.0       # landed in null block
+    assert bool(jnp.all(out2[1:] == out[1:]))   # real blocks untouched
+
+
+def test_fork_beyond_pool_capacity_is_infeasible(params):
+    srv = DecodeServer(params, CFG, max_batch=2, kv_block_size=8,
+                       kv_blocks=4)             # 3 usable = 24 tokens
+    r0 = srv.submit([1, 2], 8)
+    srv.step()
+    with pytest.raises(Infeasible, match="KV blocks"):
+        srv.fork(r0, max_new_tokens=40)
+    res = srv.drain()
+    assert res[r0] == ref(params, [1, 2], 8)
+    assert_pool_balanced(srv)
+
+
+def test_stats_surface_block_accounting(engines, params):
+    srv = engines(1, 1)
+    rid = srv.submit([1, 2, 3], 4)
+    st = srv.stats()
+    kv = st["kv"]
+    assert kv["block_size"] == 8
+    assert kv["blocks_total"] == kv["blocks_free"] + kv["blocks_used"]
+    assert kv["blocks_used"] >= 1
+    assert set(kv["preempts"]) == {"swap", "recompute"}
+    assert "cow_shared" in kv and "hbm" in kv
+    srv.drain()
+    srv.pop_result(rid)
+
+
+def test_validation(params):
+    with pytest.raises(ValueError, match="power of two"):
+        DecodeServer(params, CFG, kv_block_size=12, kv_blocks=8)
+    with pytest.raises(ValueError, match="multiple of"):
+        DecodeServer(params, CFG, kv_block_size=32, kv_blocks=8,
+                     max_len=48)
+    with pytest.raises(ValueError, match="kv_blocks"):
+        DecodeServer(params, CFG, kv_block_size=8, kv_blocks=1)
+
+
+def test_random_schedules_stay_exact_under_paging(engines, params):
+    """Crash-prober: random lengths, budgets, arrival points, step
+    interleavings, plus a random preemption — every surviving request
+    bit-exact on a paged engine at (depth 2, steps 4)."""
+    rng = np.random.default_rng(29)
+    for trial in range(2):
+        srv = engines(2, 4, mb=3, blocks=32)
+        n_req = int(rng.integers(3, 6))
+        reqs = [([int(t) for t in rng.integers(0, 64, rng.integers(1, 41))],
+                 int(rng.integers(1, 7))) for _ in range(n_req)]
+        rids = []
+        for p, n in reqs:
+            rids.append(srv.submit(p, n))
+            for _ in range(int(rng.integers(0, 3))):
+                srv.step()
+        if srv._active and rng.integers(0, 2):
+            victim = rng.choice(
+                [r.rid for r in srv._active.values()])
+            srv.preempt(int(victim),
+                        "swap" if rng.integers(0, 2) else "recompute")
+        results = srv.drain()
+        for rid, (p, n) in zip(rids, reqs):
+            assert results[rid] == ref(params, p, n), (trial, rid, p, n)
+        assert_pool_balanced(srv)
