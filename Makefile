@@ -48,6 +48,10 @@ bench-decode:  ## KV-cache decode throughput, bf16 and int8.
 bench-serve:  ## Continuous-batching serving throughput + pipelined-dispatch economics (artifact in bench_logs/bench_serve.json).
 	$(PYTHON) bench_serve.py
 
+.PHONY: bench-chaos-serve
+bench-chaos-serve:  ## Serving-plane chaos: supervised restarts, bit-exact resume, MTTR + goodput under a seeded fault schedule (artifact in bench_logs/bench_chaos_serve.json).
+	$(PYTHON) bench_chaos_serve.py
+
 .PHONY: bench-infer
 bench-infer:  ## 7-tenant YOLOS-family inference latency (the reference's headline scenario).
 	$(PYTHON) bench_infer.py

@@ -1,0 +1,256 @@
+#!/usr/bin/env python3
+"""Serving-chaos bench: the self-healing serving plane (ISSUE 7) under
+a seeded fault schedule.
+
+Replays a FIXED greedy request trace against a supervised ServingLoop
+whose engine is wrapped in a deterministic ``FaultInjector`` (injected
+step exceptions + one hung tick the watchdog must catch), in both
+resume modes — ``swap`` (paged KV snapshot restored byte-exact) and
+``recompute`` (re-prefill from the committed tokens) — and reports,
+per scenario:
+
+- restarts (by cause), requests resumed vs lost
+- per-episode detection latency (injector event -> failure observed)
+  and recovery MTTR (failure observed -> engine serving again)
+- bit-exactness: every request's tokens vs an undisturbed clean run
+- goodput under faults: faulted-run tokens/s vs the clean run
+- the outcome-conservation invariant: submitted == finished +
+  cancelled + abandoned + rejected + failed + deadline
+
+Writes ``bench_logs/bench_chaos_serve.json`` FIRST (the artifact of
+record), then prints the same JSON line. NOS_TPU_BENCH_SMOKE=1 runs the
+exact code path at the tiny shared smoke shape.
+"""
+import json
+import sys
+import threading
+import time
+
+sys.path.insert(0, ".")
+
+import os  # noqa: E402
+
+from bench import MODEL, smoke_overrides  # noqa: E402
+
+MAX_BATCH = 4
+PROMPT_LENS = [48, 96, 64, 32, 80, 56]
+NEW_TOKENS = 32
+KV_BLOCK = 16
+PIPELINE_DEPTH = 2
+RESTART_BUDGET = 8
+BACKOFF_S = 0.05
+WATCHDOG_S = 0.5
+HANG_S = 2.0
+# the smoke fault schedule of the acceptance gate: >= 3 injected engine
+# failures + 1 hung tick, at loop-quantum indices spread across the
+# trace's decode phase
+SCHEDULE = {4: "error", 12: "error", 20: "error", 27: "hang"}
+OUT_PATH = os.path.join("bench_logs", "bench_chaos_serve.json")
+
+SMOKE = os.environ.get("NOS_TPU_BENCH_SMOKE") == "1"
+if SMOKE:
+    MODEL = smoke_overrides(MODEL)
+    PROMPT_LENS = [12, 20, 16, 8, 18, 14]
+    NEW_TOKENS = 24
+
+
+def build_model():
+    import jax
+    import jax.numpy as jnp
+
+    from nos_tpu.models import transformer as tfm
+
+    dims = {k: MODEL[k] for k in ("vocab", "d_model", "n_layers",
+                                  "n_heads", "n_kv_heads", "d_ff",
+                                  "max_seq")}
+    dtype = jnp.bfloat16 if MODEL.get("bf16") else jnp.float32
+    cfg = tfm.TransformerConfig(**dims, dtype=dtype)
+    params = tfm.init_params(jax.random.PRNGKey(0), cfg)
+    return params, cfg
+
+
+def make_engine(params, cfg, kv_swap):
+    from nos_tpu.models.serving import DecodeServer
+
+    # pool sized for the full trace plus slack, so pressure-preemption
+    # never competes with the injected faults for the narrative
+    budget_tokens = sum(
+        p + NEW_TOKENS for p in PROMPT_LENS) + 4 * KV_BLOCK
+    blocks = -(-budget_tokens // KV_BLOCK) + 1
+    return DecodeServer(params, cfg, max_batch=MAX_BATCH,
+                        pipeline_depth=PIPELINE_DEPTH,
+                        kv_block_size=KV_BLOCK, kv_blocks=blocks,
+                        kv_swap=kv_swap)
+
+
+def trace_prompts():
+    return [[(7 * i + j) % MODEL["vocab"] for j in range(n)]
+            for i, n in enumerate(PROMPT_LENS)]
+
+
+def run_trace(loop, prompts):
+    outs = {}
+    errs = {}
+
+    def worker(i):
+        try:
+            outs[i] = loop.generate(prompts[i], NEW_TOKENS, timeout=600)
+        except Exception as e:  # noqa: BLE001 — reported, not raised
+            errs[i] = f"{type(e).__name__}: {e}"
+
+    t0 = time.monotonic()
+    threads = [threading.Thread(target=worker, args=(i,))
+               for i in range(len(prompts))]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(900)
+    return outs, errs, time.monotonic() - t0
+
+
+def outcome_totals():
+    from nos_tpu.cmd.server import OUTCOMES
+    from nos_tpu.utils.metrics import default_registry
+
+    c = default_registry().counter(
+        "nos_tpu_serve_requests_total", "", ("outcome",))
+    return {o: c.value(o) for o in OUTCOMES}
+
+
+def run_scenario(mode, params, cfg, expected):
+    from nos_tpu.cmd.server import ServingLoop
+    from nos_tpu.models.supervision import FaultInjector
+
+    kv_swap = mode == "swap"
+    before = outcome_totals()
+    inj = FaultInjector(schedule=dict(SCHEDULE), hang_s=HANG_S)
+    loop = ServingLoop(
+        inj.wrap(make_engine(params, cfg, kv_swap)),
+        engine_factory=lambda: inj.wrap(
+            make_engine(params, cfg, kv_swap)),
+        restart_budget=RESTART_BUDGET, restart_backoff_s=BACKOFF_S,
+        watchdog_s=WATCHDOG_S)
+    prompts = trace_prompts()
+    outs, errs, wall = run_trace(loop, prompts)
+    sup = loop.stats()["supervisor"]
+    loop.shutdown()
+    after = outcome_totals()
+    delta = {o: after[o] - before[o] for o in after}
+
+    # detection latency: attribute each episode to the most recent
+    # injected fault whose timestamp precedes the failure stamp — a
+    # positional zip would misalign the moment any injection fails to
+    # produce exactly one episode (an aborted watchdog trip, a
+    # terminal budget exhaustion), silently corrupting the artifact
+    injected = sorted((e for e in inj.injected if e["kind"] in
+                       ("error", "nofreeblocks", "hang")),
+                      key=lambda e: e["t"])
+    episodes = []
+    j = 0
+    last_ev = None
+    for ep in sup["episodes"]:
+        while j < len(injected) and injected[j]["t"] <= ep["t_fail"]:
+            last_ev = injected[j]
+            j += 1
+        episodes.append({
+            "kind": last_ev["kind"] if last_ev else None,
+            "cause": ep["cause"],
+            "detection_s": (round(max(0.0, ep["t_fail"] - last_ev["t"]),
+                                  4) if last_ev else None),
+            "mttr_s": round(ep["mttr_s"], 4),
+            "resumed": ep["resumed"],
+            "lost": ep["lost"],
+        })
+    mttrs = [e["mttr_s"] for e in episodes]
+    bit_exact = all(outs.get(i) == expected[i]
+                    for i in range(len(prompts)))
+    total_tokens = sum(len(o) - len(p)
+                       for (i, o), p in zip(sorted(outs.items()),
+                                            [prompts[i] for i in
+                                             sorted(outs)]))
+    return {
+        "mode": mode,
+        "requests": len(prompts),
+        "completed": len(outs),
+        "errors": errs,
+        "bit_exact": bit_exact,
+        "restarts": sup["restarts"],
+        "restarts_by_cause": {
+            c: sum(1 for e in sup["episodes"] if e["cause"] == c)
+            for c in ("step_error", "watchdog")},
+        "requests_resumed": dict(sup["resumed"]),
+        "requests_lost": sup["lost"],
+        "injected": inj.counts(),
+        "episodes": episodes,
+        "mttr_s": {
+            "mean": round(sum(mttrs) / len(mttrs), 4) if mttrs else None,
+            "max": round(max(mttrs), 4) if mttrs else None,
+        },
+        "wall_s": round(wall, 3),
+        "tokens_per_s": round(total_tokens / wall, 1) if wall else 0.0,
+        "outcomes": {o: int(v) for o, v in delta.items()},
+        "conservation_ok":
+            sum(delta.values()) == len(prompts) and
+            delta["finished"] == len(prompts),
+    }
+
+
+def main():
+    import jax
+
+    from nos_tpu.cmd.server import ServingLoop
+
+    params, cfg = build_model()
+    prompts = trace_prompts()
+
+    # undisturbed reference run: the bit-exactness oracle AND the
+    # goodput baseline (same engine config, no injector)
+    clean_loop = ServingLoop(make_engine(params, cfg, True))
+    expected, clean_errs, clean_wall = run_trace(clean_loop, prompts)
+    clean_loop.shutdown()
+    assert not clean_errs, f"clean run failed: {clean_errs}"
+    clean_tokens = sum(len(expected[i]) - len(prompts[i])
+                      for i in expected)
+    clean_tps = clean_tokens / clean_wall if clean_wall else 0.0
+
+    scenarios = [run_scenario(m, params, cfg, expected)
+                 for m in ("swap", "recompute")]
+    worst_mttr = max((s["mttr_s"]["max"] or 0.0) for s in scenarios)
+
+    dev = jax.devices()[0]
+    result = {
+        "metric": "serving chaos: supervised restarts + bit-exact "
+                  "resume under a seeded fault schedule"
+                  + (" [SMOKE]" if SMOKE else ""),
+        "device": dev.device_kind,
+        "platform": jax.default_backend(),
+        "value": worst_mttr,
+        "unit": "s_worst_restart_mttr",
+        "requests": len(prompts),
+        "new_tokens_per_request": NEW_TOKENS,
+        "fault_schedule": {str(k): v for k, v in SCHEDULE.items()},
+        "restart_budget": RESTART_BUDGET,
+        "watchdog_s": WATCHDOG_S,
+        "clean": {
+            "wall_s": round(clean_wall, 3),
+            "tokens_per_s": round(clean_tps, 1),
+        },
+        "scenarios": scenarios,
+        # goodput under faults: useful throughput retained while the
+        # engine died >= 4 times mid-trace
+        "goodput_vs_clean": {
+            s["mode"]: round(s["tokens_per_s"] / clean_tps, 3)
+            if clean_tps else None
+            for s in scenarios
+        },
+    }
+    # file first (artifact of record), stdout line second
+    os.makedirs(os.path.dirname(OUT_PATH), exist_ok=True)
+    with open(OUT_PATH, "w") as f:
+        json.dump(result, f, indent=2)
+    print(json.dumps(result))
+    return result
+
+
+if __name__ == "__main__":
+    main()

@@ -25,6 +25,7 @@ from __future__ import annotations
 import argparse
 import json
 import logging
+import math
 import threading
 import time
 from collections import deque
@@ -34,8 +35,10 @@ from typing import Optional, Sequence
 
 from nos_tpu.cmd.serve import metrics_payload
 from nos_tpu.models.errors import (  # jax-free module: keeps this file
-    Infeasible, QueueFull,           # importable without jax
+    DeadlineExceeded, DeadlineUnmeetable, EngineRecovering, Infeasible,
+    QueueFull,                       # importable without jax
 )
+from nos_tpu.models.supervision import EngineSupervisor  # jax-free too
 from nos_tpu.obs import tracing
 from nos_tpu.utils.metrics import default_registry
 
@@ -43,8 +46,11 @@ logger = logging.getLogger("nos_tpu.server")
 
 # terminal request outcomes: every request that enters the serving loop
 # leaves through exactly ONE of these, incrementing
-# nos_tpu_serve_requests_total{outcome} exactly once (pinned by tests)
-OUTCOMES = ("finished", "cancelled", "abandoned", "rejected", "failed")
+# nos_tpu_serve_requests_total{outcome} exactly once (pinned by tests).
+# ``deadline`` covers both shed-at-admission (rolling estimates said the
+# deadline could not be met) and cancelled-mid-flight expiry.
+OUTCOMES = ("finished", "cancelled", "abandoned", "rejected", "failed",
+            "deadline")
 
 # TTFT spans prefill (ms on warm buckets) through queueing storms (s);
 # TPOT is per-token (sub-ms fused to ~100ms on big models); compiles
@@ -58,6 +64,21 @@ COMPILE_BUCKETS = (0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0,
 
 # rolling-rate window for the /stats snapshot
 RATE_WINDOW_S = 60.0
+
+# bound on the recovery capture phase: swap snapshots are device->host
+# copies that can HANG (not just raise) on a lost device — the capture
+# runs on a helper thread joined with this timeout, and on expiry the
+# recovery falls back to a host-only capture (every slot resumes by
+# recompute). Capture is read-only, so the abandoned hung thread races
+# nothing.
+CAPTURE_TIMEOUT_S = 10.0
+
+# deadline-shed probe cadence: every Nth CONSECUTIVE estimate-based
+# shed is admitted anyway. The EWMA estimates only update on completed
+# requests, so an estimate inflated past every deadline would otherwise
+# shed 100% of traffic forever (zero admissions -> zero completions ->
+# no estimate decay); the probe's completion is the decay path.
+DEADLINE_PROBE_EVERY = 8
 
 
 @dataclass
@@ -159,6 +180,33 @@ class ServerConfig:
     # device.memory_stats() into the HBM gauges at most this often —
     # guarded, so backends without memory stats (CPU) just skip.
     device_stats_interval_s: float = 10.0
+    # supervised engine restarts (0 = off, engine failure is terminal as
+    # before): on a decode-tick failure the serving loop captures every
+    # live request's resumable state (committed tokens; swap-to-host KV
+    # snapshot on a paged engine, recompute re-prefill otherwise — both
+    # bit-exact), rebuilds the engine (fresh compile) after exponential
+    # backoff + jitter, and re-admits the captured requests at the
+    # front of the queue. The budget bounds TOTAL rebuild attempts over
+    # the process lifetime; once exhausted, the next failure is
+    # terminal (/healthz flips) and orchestration restarts the pod.
+    restart_budget: int = 2
+    restart_backoff_s: float = 0.5
+    restart_backoff_max_s: float = 10.0
+    # stuck-tick watchdog (0 = off): a dispatched decode tick blocked
+    # in its device wait longer than this with no arrival consumed
+    # counts as an engine failure and takes the same supervised-restart
+    # path (the blocked thread is superseded and exits when it
+    # unblocks). Dispatch-time XLA compiles do NOT count — the clock
+    # arms after dispatch returns — so size it above the slowest
+    # expected device WAIT, not compile time.
+    watchdog_s: float = 0.0
+    # default per-request deadline in seconds (0 = none): a request
+    # must finish within this budget of submission or it is shed at
+    # admission (rolling TTFT/TPOT estimates say it cannot make it —
+    # 429 + Retry-After) or cancelled at the next tick barrier
+    # (terminal outcome ``deadline``, HTTP 504). Per-request override:
+    # JSON field ``deadline_s`` / header ``X-Request-Deadline-S``.
+    default_deadline_s: float = 0.0
     # SIGTERM → stop admitting (503 + readyz flips so the Service pulls
     # this endpoint), let in-flight requests finish up to this budget,
     # then exit — the Kubernetes termination contract. Keep it under
@@ -193,14 +241,39 @@ class DrainingError(RuntimeError):
 
 class ServingLoop:
     """Thread-safe wrapper around DecodeServer: handlers submit and wait;
-    one background thread ticks the engine whenever there is work. A tick
-    failure (XLA OOM, device loss) marks the loop unhealthy — /healthz
-    flips to 500 so orchestration restarts the pod instead of every
-    request silently burning its timeout."""
+    one background thread ticks the engine whenever there is work.
+
+    With an ``engine_factory`` and restart budget, a tick failure (XLA
+    OOM, device loss, a wedged allocator) is no longer terminal: the
+    loop captures every live request's resumable state from the dead
+    engine, rebuilds the engine through the factory (exponential
+    backoff + seeded jitter between attempts), and re-admits the
+    captured requests at the front of the fresh queue — swap-restored
+    byte-exact on a paged engine, recompute-re-prefilled otherwise,
+    both bit-exact, so a greedy request's tokens are indistinguishable
+    from an undisturbed run. While recovery is in flight, submissions
+    get ``EngineRecovering`` (HTTP 503 + Retry-After) and /readyz
+    reports ``degraded``; /healthz flips only on TERMINAL failure —
+    budget exhausted (or no factory, the pre-supervision behavior) —
+    so orchestration restarts the pod exactly when self-healing has
+    given up. A stuck-tick watchdog (``watchdog_s``) counts a tick in
+    flight past the threshold as a failure and takes the same path.
+
+    Requests may carry a deadline (``deadline_s``; ``default_deadline_s``
+    otherwise): unmeetable deadlines are shed at admission against
+    rolling TTFT/TPOT estimates (DeadlineUnmeetable — don't burn a slot
+    on an answer the client will discard), and expired ones are
+    cancelled at the next tick barrier — either way the request's one
+    terminal outcome is ``deadline``."""
 
     def __init__(self, engine, slo_ttft_ms: float = 0.0,
                  slo_tpot_ms: float = 0.0,
-                 device_stats_interval_s: float = 0.0):
+                 device_stats_interval_s: float = 0.0,
+                 engine_factory=None, restart_budget: int = 2,
+                 restart_backoff_s: float = 0.5,
+                 restart_backoff_max_s: float = 10.0,
+                 watchdog_s: float = 0.0,
+                 default_deadline_s: float = 0.0, seed: int = 0):
         reg = default_registry()
         # register() is idempotent per (name, type, labels) and raises on
         # a mismatched re-registration — exactly what we want at startup
@@ -305,6 +378,51 @@ class ServingLoop:
             "Wall time of each first-dispatch-per-shape call (traces + "
             "compiles synchronously)",
             buckets=COMPILE_BUCKETS)
+        # supervised-restart surface (registered only when a factory
+        # makes restarts possible — a supervisor-less loop must not
+        # export dead zero series)
+        self._sup: Optional[EngineSupervisor] = None
+        if engine_factory is not None:
+            self._sup = EngineSupervisor(
+                engine_factory, restart_budget=restart_budget,
+                backoff_s=restart_backoff_s,
+                backoff_max_s=restart_backoff_max_s, seed=seed)
+            self.m_restarts = reg.counter(
+                "nos_tpu_serve_engine_restarts_total",
+                "Supervised engine restarts begun, by cause "
+                "(step_error = a decode tick raised; watchdog = a tick "
+                "exceeded --watchdog-s in flight)",
+                ("cause",))
+            self.m_resumed = reg.counter(
+                "nos_tpu_serve_requests_resumed_total",
+                "Requests resumed across an engine restart, by mode "
+                "(swap = KV snapshot restored byte-exact; recompute = "
+                "re-prefilled from the committed tokens — both "
+                "bit-exact)",
+                ("mode",))
+            self.m_lost = reg.counter(
+                "nos_tpu_serve_requests_lost_total",
+                "Requests that could NOT be resumed across an engine "
+                "restart (capture or restore failed); each is drained "
+                "as outcome=failed exactly once")
+            for cause in ("step_error", "watchdog"):
+                self.m_restarts.labels(cause).inc(0)
+            for mode in ("swap", "recompute"):
+                self.m_resumed.labels(mode).inc(0)
+            self.m_lost.inc(0)
+        if watchdog_s > 0:
+            # the watchdog works WITHOUT a supervisor too (a validated
+            # trip is then a terminal failure — /healthz flips and the
+            # pod restarts), so its counter keys on watchdog_s alone:
+            # registered exactly when a trip is possible, no dead zero
+            # series when the watchdog is off
+            self.m_watchdog = reg.counter(
+                "nos_tpu_serve_watchdog_trips_total",
+                "Stuck-tick watchdog trips: a decode tick stayed "
+                "blocked in its device wait past --watchdog-s with no "
+                "arrival consumed (counted only when the trip is "
+                "validated and starts the failure path)")
+            self.m_watchdog.inc(0)
         self.engine = engine
         self._slo_ttft_s = (slo_ttft_ms or 0.0) / 1e3
         self._slo_tpot_s = (slo_tpot_ms or 0.0) / 1e3
@@ -323,15 +441,60 @@ class ServingLoop:
         self._lock = threading.Lock()
         self._work = threading.Condition(self._lock)
         self._stop = False
+        self._stop_event = threading.Event()    # wakes backoff/monitor
         self._draining = False
         self._failed: Optional[BaseException] = None
         self._abandoned: set = set()        # rids whose client timed out
+        # recovery/deadline bookkeeping, all keyed by the ORIGINAL rid
+        # a client holds (streams survive restarts; _rid_map translates
+        # to the current engine's rid):
+        self._recovering = False
+        self._gen = 0               # ticker generation: bumped per
+        #                             recovery so superseded (stuck)
+        #                             ticker threads exit untouched
+        self._tick_started: Optional[float] = None  # watchdog's clock
+        self._watchdog_s = watchdog_s or 0.0
+        # the LOOP owns the rid namespace a client holds: engines hand
+        # out their own rids, and a rebuilt engine restarts its counter
+        # — without the loop's own monotonic counter, a post-restart
+        # submission could collide with a pre-restart stream's rid and
+        # corrupt the map (caught by the chaos soak). Every admitted
+        # request has an entry here; absent restarts the two sequences
+        # advance in lockstep, so loop rid == engine rid numerically.
+        self._next_rid = 0
+        self._rid_map: dict = {}            # loop rid -> engine rid
+        self._live: set = set()             # admitted, not yet terminal
+        self._lost_rids: set = set()        # dropped in a restart
+        self._default_deadline_s = default_deadline_s or 0.0
+        self._deadlines: dict = {}          # orig rid -> abs monotonic
+        self._deadline_hit: set = set()     # accounted outcome=deadline
+        self._deadline_shed = 0             # shed at admission
+        self._deadline_expired = 0          # cancelled mid-flight
+        self._shed_streak = 0               # consecutive estimate sheds
+        # rolling completion estimates feeding deadline admission
+        # (EWMA over finished requests' ledgers; None until the first).
+        # _est_out_tokens tracks how long requests ACTUALLY run:
+        # max_new_tokens is routinely a ceiling (stop_tokens end most
+        # requests early), and estimating against the ceiling would
+        # systematically shed traffic that comfortably meets its
+        # deadline.
+        self._est_ttft_s: Optional[float] = None
+        self._est_tpot_s: Optional[float] = None
+        self._est_out_tokens: Optional[float] = None
         for outcome in OUTCOMES:        # export 0s, not absent series
             self.m_requests.labels(outcome).inc(0)
         self._mirror_engine_gauges()
         self._sample_device_stats()
         self._thread = threading.Thread(target=self._run, daemon=True)
         self._thread.start()
+        self._monitor_thread: Optional[threading.Thread] = None
+        if self._watchdog_s > 0:
+            # no supervisor needed: without one, a validated trip goes
+            # terminal (_recover routes to _fail) — strictly better
+            # than a silently wedged loop with a green /healthz
+            self._monitor_thread = threading.Thread(
+                target=self._monitor, daemon=True)
+            self._monitor_thread.start()
 
     @property
     def healthy(self) -> bool:
@@ -340,6 +503,13 @@ class ServingLoop:
     @property
     def draining(self) -> bool:
         return self._draining
+
+    @property
+    def recovering(self) -> bool:
+        """True while the supervisor is mid-restart: submissions get
+        503 + Retry-After and /readyz reports ``degraded`` (the Service
+        pulls the endpoint until the rebuilt engine is serving)."""
+        return self._recovering
 
     def begin_drain(self) -> None:
         """Stop admitting; in-flight requests keep decoding. The k8s
@@ -363,17 +533,24 @@ class ServingLoop:
             return True
 
     def _fail(self, e: BaseException) -> None:
-        """Mark the loop dead (caller holds the lock): /healthz flips
-        BEFORE the single notify_all, so every wait_idle/stream waiter —
-        re-checking under this same lock — observes healthy == False by
-        the time it returns. Exactly one wakeup; the ticker thread exits
-        right after. Abandoned requests are drained as ``failed`` here:
-        the ticker that would have reaped them is the thing dying, so
-        nothing else will ever account for them."""
-        logger.exception("decode tick failed; marking unhealthy")
+        """Mark the loop TERMINALLY dead (caller holds the lock):
+        /healthz flips BEFORE the single notify_all, so every
+        wait_idle/stream waiter — re-checking under this same lock —
+        observes healthy == False by the time it returns. Exactly one
+        wakeup; the ticker thread exits right after. Abandoned requests
+        are drained as ``failed`` here: the ticker that would have
+        reaped them is the thing dying, so nothing else will ever
+        account for them. Reached directly when no supervisor is
+        configured, or from _recover once the restart budget is
+        exhausted / shutdown cancels a recovery."""
+        logger.error("serving loop terminally failed: %s", e,
+                     exc_info=e)
         self._failed = e
         for rid in self._abandoned:
-            self._account(rid, "failed", self._pop_ledger(rid))
+            erid = self._rid_map.get(rid)
+            self._account(rid, "failed",
+                          self._pop_ledger(erid)
+                          if erid is not None else None)
             self._failed_drained.add(rid)
         self._abandoned.clear()
         self._work.notify_all()
@@ -392,6 +569,9 @@ class ServingLoop:
         an SLO breach marks the span and pins its trace in the flight
         recorder, so a breached counter always has a trace to open."""
         self.m_requests.labels(outcome).inc()
+        self._live.discard(rid)
+        self._deadlines.pop(rid, None)
+        self._rid_map.pop(rid, None)
         sp = self._spans.pop(rid, None)
         tid = (sp.trace_id or None) if sp is not None else None
         breaches = []
@@ -412,6 +592,22 @@ class ServingLoop:
                 gap_sum += gap
             if ledger.get("e2e_s") is not None:
                 self.h_e2e.observe(ledger["e2e_s"], trace_id=tid)
+            if outcome == "finished":
+                # rolling completion estimates for deadline admission:
+                # EWMA, cheap and recency-weighted — an estimate that
+                # lags a load spike sheds a little late, never forever
+                if ttft is not None:
+                    self._est_ttft_s = ttft if self._est_ttft_s is None \
+                        else 0.8 * self._est_ttft_s + 0.2 * ttft
+                if decode_tokens:
+                    tpot = gap_sum / decode_tokens
+                    self._est_tpot_s = tpot if self._est_tpot_s is None \
+                        else 0.8 * self._est_tpot_s + 0.2 * tpot
+                out_toks = ledger.get("output_tokens") or 0
+                if out_toks:
+                    self._est_out_tokens = float(out_toks) \
+                        if self._est_out_tokens is None \
+                        else 0.8 * self._est_out_tokens + 0.2 * out_toks
             if outcome == "finished" \
                     and (self._slo_ttft_s or self._slo_tpot_s):
                 good = True
@@ -549,6 +745,23 @@ class ServingLoop:
             snap.update({
                 "healthy": self.healthy,
                 "draining": self._draining,
+                "recovering": self._recovering,
+                "supervisor": (
+                    dict(self._sup.stats(),
+                         watchdog_s=self._watchdog_s)
+                    if self._sup is not None else None),
+                "deadline": {
+                    "default_s": self._default_deadline_s,
+                    "active": len(self._deadlines),
+                    "shed": self._deadline_shed,
+                    "expired": self._deadline_expired,
+                    "est_ttft_s": (round(self._est_ttft_s, 6)
+                                   if self._est_ttft_s is not None
+                                   else None),
+                    "est_tpot_s": (round(self._est_tpot_s, 6)
+                                   if self._est_tpot_s is not None
+                                   else None),
+                },
                 "slo": {
                     "ttft_ms": round(self._slo_ttft_s * 1e3, 3),
                     "tpot_ms": round(self._slo_tpot_s * 1e3, 3),
@@ -562,97 +775,464 @@ class ServingLoop:
         return snap
 
     def _run(self) -> None:
+        """Ticker thread: one ``_run_quantum`` per scheduling quantum
+        until stopped, terminally failed, superseded by a recovery
+        (generation bump), or handed off INTO a recovery (an engine
+        failure — _recover spawns the successor ticker itself)."""
+        with self._work:
+            gen = self._gen
+        while self._run_quantum(gen):
+            pass
+
+    def _run_quantum(self, gen: int) -> bool:
         # engines exposing the split-step protocol (DecodeServer) run
         # the blocking device wait OUTSIDE the condition lock, so
         # handlers submit/stream/cancel while the device computes;
-        # step()-only engines (test stubs) tick under the lock as before
-        split = hasattr(self.engine, "step_begin") \
-            and hasattr(self.engine, "step_wait") \
-            and hasattr(self.engine, "step_finish")
-        while True:
-            sp = None
-            with self._work:
-                while not self._stop and not self.engine.has_work():
-                    self._work.wait()
-                if self._stop:
-                    return
-                t0 = time.monotonic()
-                sp = tracing.start_span("serve.tick", component="server")
-                handle = None
-                emitted = 0
-                gap0 = getattr(self.engine, "dispatch_gap_s", None)
-                try:
-                    if split:
-                        handle = self.engine.step_begin()
-                    else:
-                        emitted = self.engine.step()
-                except BaseException as e:
+        # step()-only engines (test stubs) tick under the lock as
+        # before. The engine reference is snapshotted per quantum: a
+        # watchdog recovery swaps self.engine while this thread is
+        # blocked in step_wait, and a superseded thread must only ever
+        # touch the OLD engine — then exit on the generation check.
+        failure = None
+        with self._work:
+            # also exit on terminal failure: the watchdog monitor can
+            # _fail the loop while this thread is blocked — without
+            # this check a revived ticker would keep dispatching
+            # device work against a loop /healthz already reports dead
+            if self._gen != gen or self._failed is not None:
+                return False
+            while not self._stop and not self.engine.has_work():
+                self._work.wait()
+                if self._gen != gen or self._failed is not None:
+                    return False
+            if self._stop:
+                return False
+            eng = self.engine
+            split = hasattr(eng, "step_begin") \
+                and hasattr(eng, "step_wait") \
+                and hasattr(eng, "step_finish")
+            t0 = time.monotonic()
+            sp = tracing.start_span("serve.tick", component="server")
+            handle = None
+            emitted = 0
+            gap0 = getattr(eng, "dispatch_gap_s", None)
+            try:
+                if split:
+                    handle = eng.step_begin()
+                    # the watchdog arms for the BLOCKING wait phase
+                    # only: step_begin compiles synchronously under
+                    # this lock on a first dispatch — seconds of XLA
+                    # work that must not read as a stuck tick (and a
+                    # hang there holds the lock, which no watchdog can
+                    # recover anyway). What the watchdog guards is the
+                    # device wait below — the phase a lost device
+                    # actually wedges.
+                    self._tick_started = time.monotonic()
+                else:
+                    emitted = eng.step()
+            except BaseException as e:
+                sp.end()
+                self._tick_started = None
+                failure = e
+        if failure is not None:
+            self._recover(failure, "step_error", gen)
+            return False
+        if split:
+            # the only blocking device wait — lock released, so a
+            # concurrent submit's barrier flush may consume the
+            # handle under us (step_finish is idempotent on it)
+            try:
+                eng.step_wait(handle)
+            except BaseException as e:
+                with self._work:
                     sp.end()
-                    self._fail(e)
-                    return
-            if split:
-                # the only blocking device wait — lock released, so a
-                # concurrent submit's barrier flush may consume the
-                # handle under us (step_finish is idempotent on it)
-                try:
-                    self.engine.step_wait(handle)
-                except BaseException as e:
-                    with self._work:
-                        sp.end()
-                        self._fail(e)
-                    return
-            with self._work:
-                try:
-                    if split:
-                        emitted = self.engine.step_finish(handle)
-                        if gap0 is not None:
-                            # the engine's structural gap counter: time
-                            # this tick's window sat empty with work
-                            # pending (ended by step_begin's dispatch)
-                            self.h_gap.observe(
-                                self.engine.dispatch_gap_s - gap0,
-                                trace_id=sp.trace_id or None)
-                    self.m_ticks.inc()
-                    self.m_tokens.inc(emitted)
-                    self._tokens_cum += emitted
-                    self._note_rates()
-                    self._mirror_engine_gauges()
-                    self._sample_device_stats()
-                    # reap results whose client already gave up, so
-                    # _done can't grow from timed-out requests. Inside
-                    # the try: a failure here (engine died mid-reap)
-                    # must flip /healthz and wake waiters like any
-                    # other tick failure, not kill the ticker silently
-                    for rid in list(self._abandoned):
-                        ledger = self._pop_ledger(rid)
-                        if self.engine.pop_result(rid) is not None:
-                            self._abandoned.discard(rid)
-                            # completed work, even if nobody is waiting
-                            self._account(rid, "abandoned", ledger)
-                        elif self.engine.progress(rid) is None:
-                            # the engine no longer knows the request at
-                            # all (its cancel dropped it outright): no
-                            # result will ever be poppable — resolve it
-                            # NOW, or it never earns its exactly-one
-                            # terminal outcome
-                            self._abandoned.discard(rid)
-                            self._account(rid, "cancelled", ledger)
-                except BaseException as e:
-                    sp.end()
-                    self._fail(e)
-                    return
+                    if self._gen != gen:
+                        return False    # superseded while blocked
+                    self._tick_started = None
+                self._recover(e, "step_error", gen)
+                return False
+        with self._work:
+            if self._gen != gen or self._failed is not None:
+                # superseded while blocked (watchdog recovery took the
+                # loop over — or failed it terminally): this thread's
+                # tick belongs to the discarded engine and must not
+                # touch loop state
+                sp.end()
+                return False
+            try:
+                if split:
+                    emitted = eng.step_finish(handle)
+                    if gap0 is not None:
+                        # the engine's structural gap counter: time
+                        # this tick's window sat empty with work
+                        # pending (ended by step_begin's dispatch)
+                        self.h_gap.observe(
+                            eng.dispatch_gap_s - gap0,
+                            trace_id=sp.trace_id or None)
+                self._tick_started = None
+                self.m_ticks.inc()
+                self.m_tokens.inc(emitted)
+                self._tokens_cum += emitted
+                self._note_rates()
+                self._mirror_engine_gauges()
+                self._sample_device_stats()
+                self._sweep_deadlines()
+                # reap results whose client already gave up, so
+                # _done can't grow from timed-out requests. Inside
+                # the try: a failure here (engine died mid-reap)
+                # must flip /healthz and wake waiters like any
+                # other tick failure, not kill the ticker silently
+                for rid in list(self._abandoned):
+                    # no identity fallback: once _account popped the
+                    # map, the bare rid may alias a DIFFERENT
+                    # post-restart request with the same engine rid
+                    erid = self._rid_map.get(rid)
+                    if erid is None:
+                        self._abandoned.discard(rid)
+                        continue
+                    ledger = self._pop_ledger(erid)
+                    if self.engine.pop_result(erid) is not None:
+                        self._abandoned.discard(rid)
+                        # completed work, even if nobody is waiting
+                        self._account(rid, "abandoned", ledger)
+                    elif self.engine.progress(erid) is None:
+                        # the engine no longer knows the request at
+                        # all (its cancel dropped it outright): no
+                        # result will ever be poppable — resolve it
+                        # NOW, or it never earns its exactly-one
+                        # terminal outcome
+                        self._abandoned.discard(rid)
+                        self._account(rid, "cancelled", ledger)
+            except BaseException as e:
+                sp.end()
+                self._tick_started = None
+                failure = e
+            else:
                 sp.end()
                 self.h_tick.observe(time.monotonic() - t0,
                                     trace_id=sp.trace_id or None)
-                self._work.notify_all()     # wake waiters to check results
+                self._work.notify_all()  # wake waiters to check results
+        if failure is not None:
+            self._recover(failure, "step_error", gen)
+            return False
+        return True
+
+    # -- supervised recovery (the tentpole) -----------------------------
+    def _recover(self, exc: BaseException, cause: str, gen: int,
+                 stuck_since: Optional[float] = None) -> None:
+        """Safety shell around the recovery state machine: anything —
+        BaseException included — escaping it must flip /healthz, never
+        strand the loop with ``_recovering`` stuck True behind a green
+        liveness probe (the self-healing path's own worst failure
+        mode). The tick seams deliberately catch BaseException for
+        device-runtime weirdness; the rebuild path deserves the same
+        skepticism."""
+        try:
+            self._do_recover(exc, cause, gen, stuck_since)
+        except BaseException as e:  # noqa: BLE001 — see docstring
+            with self._work:
+                self._recovering = False
+                if self._failed is None:
+                    self._fail(e)
+            raise
+
+    def _do_recover(self, exc: BaseException, cause: str, gen: int,
+                    stuck_since: Optional[float] = None) -> None:
+        """Engine failure → supervised restart, or terminal _fail when
+        out of budget / no supervisor / shutting down. Runs on the
+        failing ticker thread (step_error) or the watchdog monitor
+        (cause=watchdog, with the stuck ticker still blocked); either
+        way it ends by spawning a FRESH ticker thread on success, and
+        the calling thread exits. The lock is dropped around backoff +
+        rebuild (seconds of XLA compile): handlers keep answering —
+        503 + Retry-After for submits, degraded /readyz — while
+        /healthz stays green."""
+        with self._work:
+            if self._gen != gen or self._failed is not None:
+                return                  # superseded / already terminal
+            if stuck_since is not None \
+                    and self._tick_started != stuck_since:
+                return  # the "stuck" tick landed between detection
+                #         and here: nothing to recover
+            if cause == "watchdog":
+                # counted only HERE, after the gen/stuck validation: a
+                # trip aborted by the race window must not read as a
+                # phantom stuck tick in the metric
+                self.m_watchdog.inc()
+            if self._sup is None or self._stop \
+                    or not self._sup.can_restart():
+                self._fail(exc)
+                return
+            t_fail = time.monotonic()
+            self._gen += 1
+            gen = self._gen
+            self._recovering = True
+            self._tick_started = None
+            self.m_restarts.labels(cause).inc()
+            attempt = self._sup.note_attempt()
+            logger.warning(
+                "engine failure (%s: %s); supervised restart, attempt "
+                "%d/%d", cause, exc, attempt + 1,
+                self._sup.restart_budget)
+            # engine-rid -> loop-rid, snapshotted NOW while every live
+            # captured request still has its map entry: entries popped
+            # during the unlocked capture/rebuild window (deadline
+            # expiry, a finishing stream) would otherwise make the
+            # restore pass fall back to the ENGINE rid — the wrong
+            # namespace after the first restart, aliasing other
+            # requests
+            cur_to_orig = {v: k for k, v in self._rid_map.items()}
+            eng = self.engine
+            self._work.notify_all()
+        # -- no lock: capture. The engine is quiescent (ticker
+        # superseded by the gen bump, submits rejected, cancels
+        # skipped while recovering) and capture is read-only over
+        # list()-snapshots, so handlers observe _recovering and answer
+        # their fast 503 instead of stalling behind this. A
+        # watchdog-declared-wedged device is not read AT ALL (host
+        # state only; every slot resumes by recompute); for step_error
+        # the swap snapshot is worth attempting, but its device->host
+        # copies can HANG on a genuinely lost device (guards catch
+        # exceptions, not hangs) — so it runs on a helper thread
+        # bounded by CAPTURE_TIMEOUT_S, falling back to a host-only
+        # capture on expiry. The abandoned hung thread races nothing.
+        if cause == "watchdog":
+            captured = self._sup.capture(eng, device_ok=False)
+        else:
+            box: dict = {}
+
+            def _cap():
+                box["states"] = self._sup.capture(eng, device_ok=True)
+
+            ct = threading.Thread(target=_cap, daemon=True)
+            ct.start()
+            ct.join(timeout=CAPTURE_TIMEOUT_S)
+            captured = box.get("states")
+            if captured is None:
+                logger.warning(
+                    "swap capture hung > %.0fs (device lost?); "
+                    "falling back to host-only capture — every "
+                    "slot resumes by recompute", CAPTURE_TIMEOUT_S)
+                captured = self._sup.capture(eng, device_ok=False)
+        # -- no lock: backoff, then rebuild (compiles) ------------------
+        new_engine = None
+        while True:
+            self._stop_event.wait(self._sup.backoff_delay(attempt))
+            if self._stop:
+                break
+            try:
+                new_engine = self._sup.build()
+                break
+            except Exception:
+                logger.exception("engine rebuild failed")
+                if not self._sup.can_restart():
+                    break
+                attempt = self._sup.note_attempt()
+        with self._work:
+            if new_engine is None or self._stop:
+                # budget exhausted — or shutdown() cancelled the
+                # recovery: drain every captured request as ``failed``
+                # exactly once (nothing will ever decode them), then
+                # die terminally. _failed_drained dedupes against the
+                # stream-teardown _forget path.
+                for st in captured:
+                    orig = cur_to_orig.get(st["rid"], st["rid"])
+                    if st.get("done") or orig not in self._live \
+                            or orig in self._failed_drained \
+                            or orig in self._deadline_hit:
+                        continue
+                    self._failed_drained.add(orig)
+                    self._abandoned.discard(orig)
+                    self._account(orig, "failed", None)
+                self._recovering = False
+                self._fail(exc)
+                return
+            self.engine = new_engine
+            self._preempt_seen = {"swap": 0, "recompute": 0}
+            resumed = {"swap": 0, "recompute": 0}
+            lost = 0
+            seen = set()
+            now = time.monotonic()
+            for st in captured:
+                orig = cur_to_orig.get(st["rid"], st["rid"])
+                seen.add(orig)
+                self._rid_map.pop(orig, None)
+                if orig not in self._live \
+                        or orig in self._deadline_hit \
+                        or orig in self._failed_drained:
+                    # already terminally accounted — a deadline that
+                    # expired mid-recovery, a drained failure, or a
+                    # done-state whose stream popped its result during
+                    # the rebuild window: nothing left to restore (and
+                    # re-parking it would leak an unreachable result
+                    # plus a stale rid mapping into the fresh engine)
+                    continue
+                if orig in self._abandoned and not st.get("done"):
+                    # the client walked away mid-recovery: don't burn
+                    # the rebuilt engine on it
+                    self._abandoned.discard(orig)
+                    self._account(orig, "cancelled", None)
+                    continue
+                dl = self._deadlines.get(orig)
+                if dl is not None and now > dl and not st.get("done"):
+                    # its deadline expired during the outage: shed now
+                    self._deadline_hit.add(orig)
+                    self._deadline_expired += 1
+                    self._account(orig, "deadline", None)
+                    continue
+                try:
+                    nrid, mode = self._sup.restore(new_engine, st)
+                except Exception as e:
+                    logger.warning("request %s lost in engine restart: "
+                                   "%s", orig, e)
+                    self._lost_rids.add(orig)
+                    self.m_lost.inc()
+                    lost += 1
+                    self._abandoned.discard(orig)
+                    self._account(orig, "failed", None)
+                    continue
+                self._rid_map[orig] = nrid
+                if st.get("done"):
+                    continue            # a parked result, not a resume
+                resumed[mode] += 1
+                self.m_resumed.labels(mode).inc()
+                sp = self._spans.get(orig)
+                if sp is not None and sp.recording:
+                    # the restart episode, parented into the resumed
+                    # request's own trace — and pinned, so an operator
+                    # can open every request a restart touched
+                    rsp = tracing.start_span(
+                        "serve.recover", component="server", parent=sp,
+                        attrs={"cause": cause, "mode": mode,
+                               "restart": self._sup.restarts + 1})
+                    rsp.end()
+                    tracing.recorder().pin(sp.trace_id, "recover")
+            for orig in sorted(self._live - seen):
+                # live at failure time but absent from the capture (an
+                # engine without capture support, or one whose capture
+                # itself failed): nothing will ever decode it — lost,
+                # drained as ``failed``, exactly once
+                self._lost_rids.add(orig)
+                self.m_lost.inc()
+                lost += 1
+                self._abandoned.discard(orig)
+                self._account(orig, "failed", None)
+            self._recovering = False
+            self._sup.note_recovered(cause, t_fail, resumed, lost)
+            self._mirror_engine_gauges()
+            logger.info(
+                "engine restarted (%s): %d resumed (%d swap / %d "
+                "recompute), %d lost, mttr %.3fs", cause,
+                sum(resumed.values()), resumed["swap"],
+                resumed["recompute"], lost,
+                self._sup.episodes[-1]["mttr_s"])
+            self._work.notify_all()
+            t = threading.Thread(target=self._run, daemon=True)
+            self._thread = t
+            t.start()
+
+    def _monitor(self) -> None:
+        """Stuck-tick watchdog: a decode tick in flight longer than
+        ``watchdog_s`` with no arrival consumed counts as an engine
+        failure — same supervised-restart path, run on THIS thread
+        (the stuck ticker can't free itself; it exits via the
+        generation check whenever it unblocks). Only effective on
+        split-protocol engines: a bare step() hang holds the loop
+        lock, which no watchdog can recover."""
+        period = max(0.02, self._watchdog_s / 4.0)
+        while not self._stop_event.wait(period):
+            with self._work:
+                if self._failed is not None:
+                    return
+                if self._recovering or self._tick_started is None:
+                    continue
+                started = self._tick_started
+                dt = time.monotonic() - started
+                if dt <= self._watchdog_s:
+                    continue
+                gen = self._gen
+                exc: BaseException = TimeoutError(
+                    f"watchdog: decode tick in flight {dt:.2f}s "
+                    f"(> --watchdog-s {self._watchdog_s:.2f}s) with no "
+                    f"arrival consumed")
+            self._recover(exc, "watchdog", gen, stuck_since=started)
+
+    # -- request deadlines ----------------------------------------------
+    def _estimate_completion_s(self, max_new_tokens: int) -> tuple:
+        """Rolling estimate of submit -> finished for a fresh request,
+        as (seconds, expected tokens): EWMA TTFT (queue + prefill)
+        plus EWMA TPOT per expected token. (None, tokens) until the
+        first completion has seeded the estimates — with nothing to
+        judge against, admission stays optimistic. The token count is
+        returned too so the shed message's arithmetic multiplies out
+        to the reported estimate.
+
+        Expected length is min(ceiling, 2 x EWMA actual output):
+        max_new_tokens is routinely a generous ceiling under
+        stop_tokens, and multiplying TPOT by the ceiling would shed
+        early-stopping traffic that finishes comfortably in time. The
+        2x headroom keeps the estimate conservative for
+        longer-than-typical requests; one that still overruns its
+        deadline is caught by the mid-decode sweep (504) — a softer
+        failure than wrongly refusing work the server could do."""
+        tokens = float(max_new_tokens)
+        if self._est_out_tokens is not None:
+            tokens = min(tokens, 2.0 * self._est_out_tokens)
+        if self._est_ttft_s is None:
+            return None, tokens
+        return (self._est_ttft_s
+                + (self._est_tpot_s or 0.0) * max(0.0, tokens - 1),
+                tokens)
+
+    def _sweep_deadlines(self) -> None:
+        """Cancel every live request whose deadline has passed (caller
+        holds the lock; runs each tick quantum — the 'next tick
+        barrier' of the deadline contract — and from stream waiters)."""
+        if not self._deadlines:
+            return
+        now = time.monotonic()
+        for rid, dl in list(self._deadlines.items()):
+            if now > dl and rid not in self._deadline_hit:
+                self._expire_deadline(rid)
+
+    def _expire_deadline(self, rid: int) -> None:
+        """Terminal ``deadline`` outcome for one request, exactly once
+        (caller holds the lock): cancel it out of the engine (pending
+        or mid-decode — cancel is the tick barrier), pop what it left,
+        account. A request that FINISHED before the sweep keeps its
+        ``finished`` outcome — the deadline only beats completion."""
+        erid = self._rid_map.get(rid)
+        prog = self.engine.progress(erid) if erid is not None else None
+        if prog is None or prog[1]:
+            # unknown (already terminal elsewhere) or done: not ours
+            self._deadlines.pop(rid, None)
+            return
+        # same guard as _forget: a dead or mid-recovery engine is not
+        # asked to mutate its batch — DecodeServer.cancel runs a
+        # pipeline-barrier flush that would block on the very device
+        # op a watchdog recovery is routing around (and the captured
+        # request is simply not restored: the tombstone below covers
+        # it). progress/pop_result are host dict reads, safe either way.
+        cancel = getattr(self.engine, "cancel", None)
+        if cancel is not None and self._failed is None \
+                and not self._recovering:
+            cancel(erid)
+        ledger = self._pop_ledger(erid)
+        self.engine.pop_result(erid)
+        self._deadline_hit.add(rid)
+        self._deadline_expired += 1
+        self._abandoned.discard(rid)
+        self._account(rid, "deadline", ledger)
+        self._mirror_engine_gauges()
+        self._work.notify_all()     # the stream raises DeadlineExceeded
 
     def generate(self, prompt, max_new_tokens, timeout: float = 300.0,
-                 **sampling):
+                 deadline_s: Optional[float] = None, **sampling):
         """Unary request: expressed over ``stream`` so there is exactly
         one waiting/abandon/metrics implementation."""
         out = list(prompt)
         for delta in self.stream(prompt, max_new_tokens, timeout,
-                                 **sampling):
+                                 deadline_s=deadline_s, **sampling):
             out.extend(delta)
         return out
 
@@ -667,19 +1247,43 @@ class ServingLoop:
         happens during an engine-failure or shutdown drain — the request
         didn't fail its client, the server failed the request."""
         with self._work:
-            if self.engine.progress(rid) is None:
+            # None (no map entry) means the request was already
+            # terminally accounted and unmapped — the bare rid must NOT
+            # be used against the engine, where it may alias a
+            # different post-restart request with the same number
+            erid = self._rid_map.get(rid)
+            if rid in self._deadline_hit or rid in self._lost_rids:
+                # already terminally accounted (deadline expiry / lost
+                # in a restart): clear leftovers, never account twice.
+                # The tombstone itself survives an in-flight recovery —
+                # _recover's restore pass consults it to skip this
+                # request's captured state (dropping it here would
+                # resurrect an already-accounted request); the rare
+                # stream that tears down mid-recovery leaks one set
+                # entry, which is bounded and harmless. No engine
+                # cleanup here: every tombstone is set alongside its
+                # _account, which already popped the ledger/result and
+                # the rid mapping (erid is None by construction).
+                if not self._recovering:
+                    self._deadline_hit.discard(rid)
+                    self._lost_rids.discard(rid)
+                self._abandoned.discard(rid)
+                return
+            if erid is None or self.engine.progress(erid) is None:
                 self._abandoned.discard(rid)    # already popped
                 return
             draining_out = self._failed is not None or self._stop
             # stop burning ticks on output nobody will read: cancel frees
             # the slot immediately (engines without cancel — test stubs —
             # fall back to reap-after-completion). A dead engine is not
-            # asked to mutate its batch.
+            # asked to mutate its batch; mid-recovery the request will
+            # simply not be restored (_recover sees it in _abandoned).
             cancel = getattr(self.engine, "cancel", None)
-            if cancel is not None and self._failed is None:
-                cancel(rid)
-            ledger = self._pop_ledger(rid)
-            if self.engine.pop_result(rid) is not None:
+            if cancel is not None and self._failed is None \
+                    and not self._recovering:
+                cancel(erid)
+            ledger = self._pop_ledger(erid)
+            if self.engine.pop_result(erid) is not None:
                 self._account(rid, "failed" if draining_out
                               else "cancelled", ledger)
                 self._abandoned.discard(rid)
@@ -691,7 +1295,7 @@ class ServingLoop:
                     self._failed_drained.add(rid)
                     self._account(rid, "failed", ledger)
                 self._abandoned.discard(rid)
-            elif self.engine.progress(rid) is None:
+            elif self.engine.progress(erid) is None:
                 # cancel dropped the request outright (nothing poppable,
                 # engine no longer knows it) and the engine may be idle:
                 # no tick's reap will ever resolve it — terminal NOW, or
@@ -754,25 +1358,92 @@ class ServingLoop:
         self._drain_compile_events()
 
     def stream(self, prompt, max_new_tokens, timeout: float = 300.0,
-               **sampling):
+               deadline_s: Optional[float] = None, **sampling):
         """Streaming primitive: submits EAGERLY (validation errors raise
         here, before the caller commits response headers) and returns an
         iterator yielding lists of newly-decoded tokens as ticks land.
         ``close()`` at ANY point — even before the first ``next()``,
         which a raw generator's finally cannot cover — drops the request
         via ``_forget``. Token identity with the unary path is the
-        engine's batch-composition-invariance contract."""
+        engine's batch-composition-invariance contract.
+
+        ``deadline_s`` (default: the loop's ``default_deadline_s``; 0 /
+        None = none) is the request's completion budget: shed at
+        admission when the rolling TTFT/TPOT estimates say it cannot be
+        met (DeadlineUnmeetable — a QueueFull, so HTTP answers 429 +
+        Retry-After), cancelled at the next tick barrier once expired
+        (the iterator raises DeadlineExceeded). Either way the
+        request's one terminal outcome is ``deadline``."""
         with self._work:
             if self._failed is not None:
                 raise RuntimeError(f"serving loop failed: {self._failed}")
+            if self._recovering:
+                # shed at the door, same accounting as QueueFull: the
+                # request never entered the loop, its one outcome is
+                # ``rejected`` (conservation: every submission attempt
+                # earns exactly one outcome)
+                self.m_requests.labels("rejected").inc()
+                raise EngineRecovering(
+                    "engine restarting after a fault; retry shortly")
             if self._draining:
                 raise DrainingError(
                     "server is draining (terminating); retry elsewhere")
+            dl_s = deadline_s if deadline_s is not None \
+                else (self._default_deadline_s or None)
+            if dl_s is not None:
+                dl_s = float(dl_s)
+                # finite-only: json.loads accepts the NaN literal, and
+                # NaN would pass every comparison below as a silent
+                # never-expiring ghost deadline instead of a clean 400
+                if not math.isfinite(dl_s) or dl_s < 0:
+                    raise ValueError(
+                        f"deadline_s must be a finite number >= 0, "
+                        f"got {dl_s}")
+                if dl_s == 0:
+                    # an EXPLICIT 0 opts out of the fleet default
+                    # (--default-deadline-s): without this, no wire
+                    # value could request unbounded completion on a
+                    # defaulted fleet
+                    dl_s = None
+            if dl_s is not None:
+                est, est_tokens = self._estimate_completion_s(
+                    max_new_tokens)
+                if est is not None and est > dl_s \
+                        and (self._shed_streak + 1) \
+                        % DEADLINE_PROBE_EVERY != 0:
+                    # shed EARLY: don't burn a slot on an answer the
+                    # client will throw away. Same exactly-once outcome
+                    # discipline as every other terminal path. Every
+                    # DEADLINE_PROBE_EVERY'th consecutive shed falls
+                    # through and is admitted as a probe — its
+                    # completion refreshes the EWMA estimates, so a
+                    # stale post-spike estimate cannot lock the server
+                    # into shedding deadline traffic forever.
+                    self.m_requests.labels("deadline").inc()
+                    self._deadline_shed += 1
+                    self._shed_streak += 1
+                    raise DeadlineUnmeetable(
+                        f"deadline {dl_s:.3f}s cannot be met: rolling "
+                        f"estimates put completion at {est:.3f}s "
+                        f"(ttft ~{self._est_ttft_s:.3f}s, ~"
+                        f"{max(0.0, est_tokens - 1):.0f} expected "
+                        f"tokens at "
+                        f"~{(self._est_tpot_s or 0.0) * 1e3:.1f}ms "
+                        f"each); retry with a longer deadline or when "
+                        f"load drops")
             try:
-                rid = self.engine.submit(prompt, max_new_tokens, **sampling)
+                erid = self.engine.submit(prompt, max_new_tokens,
+                                          **sampling)
             except QueueFull:
                 self.m_requests.labels("rejected").inc()
                 raise
+            rid = self._next_rid
+            self._next_rid += 1
+            self._rid_map[rid] = erid
+            self._live.add(rid)
+            self._shed_streak = 0       # an admission ends the streak
+            if dl_s is not None:
+                self._deadlines[rid] = time.monotonic() + dl_s
             # one span per REQUEST (not per token): the request's
             # journey through the serving loop, closed by _account with
             # its outcome and latency attrs — SLO breaches pin it
@@ -792,15 +1463,41 @@ class ServingLoop:
             try:
                 while True:
                     with self._work:
-                        prog = self.engine.progress(rid)
+                        # own-deadline check first: expiry beats both
+                        # further waiting and the vanished error (the
+                        # expire path popped the engine's record)
+                        dl = self._deadlines.get(rid)
+                        if dl is not None and time.monotonic() > dl \
+                                and rid not in self._deadline_hit:
+                            self._expire_deadline(rid)
+                        if rid in self._deadline_hit:
+                            raise DeadlineExceeded(
+                                f"request {rid} exceeded its deadline")
+                        if rid in self._lost_rids:
+                            raise RuntimeError(
+                                f"request {rid} lost in engine restart")
+                        erid = self._rid_map.get(rid)
+                        prog = self.engine.progress(erid) \
+                            if erid is not None else None
                         if prog is None:
+                            if self._recovering:
+                                # mid-restore: the request is captured,
+                                # not gone — wait for the rebuilt engine
+                                self._work.wait(timeout=0.05)
+                                continue
+                            if self._failed is not None:
+                                # drained as failed by a terminal
+                                # engine death (possibly a cancelled
+                                # recovery) — name the real cause
+                                raise RuntimeError(
+                                    f"serving loop failed: {self._failed}")
                             # reaped out from under us (shutdown race)
                             raise RuntimeError(f"request {rid} vanished")
                         toks, done = prog
                         delta = toks[sent:]
                         if done:
-                            ledger = self._pop_ledger(rid)
-                            self.engine.pop_result(rid)
+                            ledger = self._pop_ledger(erid)
+                            self.engine.pop_result(erid)
                             self._account(rid, "finished", ledger)
                             finished = True
                         elif not delta:
@@ -834,10 +1531,19 @@ class ServingLoop:
         return _Stream(self, rid, deltas())
 
     def shutdown(self) -> None:
+        """Stop the loop deterministically, INCLUDING during an
+        in-progress recovery: ``_stop`` + the event interrupt the
+        backoff/rebuild wait, and the recovery thread — seeing _stop —
+        drains its captured requests as ``failed`` (exactly once) and
+        marks the loop terminally failed instead of restoring into an
+        engine nobody will tick (the drain-during-shutdown race)."""
         with self._work:
             self._stop = True
             self._work.notify_all()
+        self._stop_event.set()
         self._thread.join(timeout=5)
+        if self._monitor_thread is not None:
+            self._monitor_thread.join(timeout=5)
 
 
 class _Stream:
@@ -1005,9 +1711,16 @@ def make_http_server(cfg: ServerConfig, loop: ServingLoop
                             {"status": "ok" if ok else "unhealthy"})
             elif self.path == "/readyz":
                 # draining flips readiness first: the Service stops
-                # routing new traffic here while in-flight requests finish
+                # routing new traffic here while in-flight requests
+                # finish. A supervised recovery reports ``degraded`` —
+                # also 503, so the Service pulls the endpoint for the
+                # restart window — while /healthz stays green (only a
+                # TERMINAL, budget-exhausted failure flips it).
                 if loop.draining:
                     self._reply(503, {"status": "draining"})
+                elif loop.recovering:
+                    self._reply(503, {"status": "degraded"},
+                                headers=[("Retry-After", "1")])
                 else:
                     self._reply(200, {"status": "ok"})
             elif self.path == "/metrics":
@@ -1119,6 +1832,14 @@ def make_http_server(cfg: ServerConfig, loop: ServingLoop
                         raise ValueError(
                             "cache_prefix must be a JSON boolean")
                     sampling["cache_prefix"] = body["cache_prefix"]
+                # per-request completion deadline: body field wins,
+                # header second, server default (--default-deadline-s)
+                # last. Unmeetable -> 429 + Retry-After (shed early),
+                # expired mid-flight -> 504 outcome=deadline.
+                deadline = body.get(
+                    "deadline_s", self.headers.get("X-Request-Deadline-S"))
+                if deadline is not None:
+                    sampling["deadline_s"] = float(deadline)
                 if body.get("stream"):
                     # stream() submits eagerly, so validation errors land
                     # in the except arms below as a clean JSON 4xx —
@@ -1139,9 +1860,26 @@ def make_http_server(cfg: ServerConfig, loop: ServingLoop
                 self._reply(400, {"error": f"{type(e).__name__}: {e}"})
                 return
             except QueueFull as e:
-                # transient: out of capacity RIGHT NOW (pending queue
-                # or KV block pool) — 429 + Retry-After says come back
+                # transient: out of capacity RIGHT NOW (pending queue,
+                # KV block pool, or — DeadlineUnmeetable — the rolling
+                # latency estimates say the deadline cannot be met, so
+                # the slot is shed early) — 429 + Retry-After says
+                # come back
                 self._reply(429, {"error": str(e)},
+                            headers=[("Retry-After", "1")])
+                return
+            except DeadlineExceeded as e:
+                # the request was admitted but its deadline expired
+                # mid-flight: cancelled at the tick barrier, terminal
+                # outcome ``deadline``
+                self._reply(504, {"error": str(e),
+                                  "deadline_exceeded": True})
+                return
+            except EngineRecovering as e:
+                # supervised restart in flight: same wire shape as
+                # QueueFull (Retry-After) but 503 — the SERVER is
+                # briefly degraded, not the client over capacity
+                self._reply(503, {"error": str(e)},
                             headers=[("Retry-After", "1")])
                 return
             except (TimeoutError, DrainingError) as e:
@@ -1211,6 +1949,26 @@ def main(argv: Optional[Sequence[str]] = None) -> None:
         help="seconds between device.memory_stats() samples into the "
              "HBM gauges (0 disables; overrides config)")
     parser.add_argument(
+        "--restart-budget", type=int, default=None,
+        help="supervised engine restarts allowed over the process "
+             "lifetime (0 = engine failure is terminal; overrides "
+             "config). On failure, live requests are captured and "
+             "resumed bit-exactly into a rebuilt engine")
+    parser.add_argument(
+        "--watchdog-s", type=float, default=None,
+        help="stuck-tick watchdog threshold in seconds (0 = off; "
+             "overrides config): a decode tick blocked in its device "
+             "wait longer than this counts as an engine failure and "
+             "triggers a supervised restart (dispatch-time compiles "
+             "don't count — size it above the slowest device wait)")
+    parser.add_argument(
+        "--default-deadline-s", type=float, default=None,
+        help="default per-request completion deadline in seconds "
+             "(0 = none; overrides config; per-request override via "
+             "the deadline_s field / X-Request-Deadline-S header). "
+             "Unmeetable deadlines shed at admission (429), expired "
+             "ones cancel at the next tick barrier (504)")
+    parser.add_argument(
         "--log-format", choices=("text", "json"), default="text",
         help="log line format; json emits one object per line with "
              "trace_id/span_id injected when a tracing span is active")
@@ -1238,15 +1996,37 @@ def main(argv: Optional[Sequence[str]] = None) -> None:
         cfg.slo_tpot_ms = args.slo_tpot_ms
     if args.device_stats_interval is not None:
         cfg.device_stats_interval_s = args.device_stats_interval
+    if args.restart_budget is not None:
+        cfg.restart_budget = args.restart_budget
+    if args.watchdog_s is not None:
+        cfg.watchdog_s = args.watchdog_s
+    if args.default_deadline_s is not None:
+        cfg.default_deadline_s = args.default_deadline_s
+    if cfg.restart_budget < 0:
+        raise ValueError(
+            f"restart_budget must be >= 0, got {cfg.restart_budget}")
+    if cfg.watchdog_s < 0 or cfg.default_deadline_s < 0:
+        raise ValueError(
+            "watchdog_s and default_deadline_s must be >= 0")
     from nos_tpu.cmd import setup_logging as _shared_setup_logging
     _shared_setup_logging(
         0, args.log_format,
         numeric_level=getattr(logging, cfg.log_level.upper(), 20))
 
+    # the supervisor's rebuild path: a fresh engine (fresh compile)
+    # from the same config. None when restarts are disabled — engine
+    # failure is then terminal exactly as before supervision existed.
+    factory = (lambda: build_engine(cfg)) if cfg.restart_budget > 0 \
+        else None
     loop = ServingLoop(
         build_engine(cfg), slo_ttft_ms=cfg.slo_ttft_ms,
         slo_tpot_ms=cfg.slo_tpot_ms,
-        device_stats_interval_s=cfg.device_stats_interval_s)
+        device_stats_interval_s=cfg.device_stats_interval_s,
+        engine_factory=factory, restart_budget=cfg.restart_budget,
+        restart_backoff_s=cfg.restart_backoff_s,
+        restart_backoff_max_s=cfg.restart_backoff_max_s,
+        watchdog_s=cfg.watchdog_s,
+        default_deadline_s=cfg.default_deadline_s, seed=cfg.seed)
     httpd = make_http_server(cfg, loop)
 
     def _finish_drain():

@@ -33,4 +33,31 @@ class Infeasible(ValueError):
     from "retry later" without string-matching."""
 
 
-__all__ = ["QueueFull", "Infeasible"]
+class EngineRecovering(RuntimeError):
+    """Submission refused because the engine supervisor is mid-restart
+    (captured requests are being restored into a rebuilt engine). As
+    transient as QueueFull and travels the same wire shape — HTTP 503 +
+    Retry-After — but its own type: 503 says "the SERVER is briefly
+    degraded", 429 says "YOU are over capacity", and load balancers
+    treat them differently."""
+
+
+class DeadlineUnmeetable(QueueFull):
+    """Admission refused because the request's deadline cannot be met:
+    the serving loop's rolling TTFT/TPOT estimates put completion past
+    ``deadline_s``, so the slot is shed EARLY instead of burning decode
+    ticks on an answer the client will discard. Subclasses QueueFull —
+    the same transient 429 + Retry-After wire shape — because backing
+    off and retrying when load drops is exactly the right client move."""
+
+
+class DeadlineExceeded(RuntimeError):
+    """A submitted request's deadline expired before completion: it was
+    cancelled at the next tick barrier (or while still queued) and
+    accounted under the ``deadline`` terminal outcome. The HTTP layer
+    answers 504 — the request was accepted but could not finish in
+    time."""
+
+
+__all__ = ["QueueFull", "Infeasible", "EngineRecovering",
+           "DeadlineUnmeetable", "DeadlineExceeded"]

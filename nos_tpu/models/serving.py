@@ -919,11 +919,7 @@ class DecodeServer:
             first = int(jax.random.categorical(key, trunc[0]))
         else:
             first = int(jnp.argmax(step))
-        s = req.slot
-        self._temp = self._temp.at[s].set(req.temperature)
-        self._topk = self._topk.at[s].set(req.top_k)
-        self._topp = self._topp.at[s].set(req.top_p)
-        self._seed = self._seed.at[s].set(req.seed)
+        self._set_sampling_rows(req)
         # padding garbage past plen stays masked until overwritten: only
         # pos decides what exists
         if self.paged:
@@ -1370,12 +1366,7 @@ class DecodeServer:
         nblk = blocks_for(base, bs)
         table = self._tables[slot]
         if mode == "swap":
-            idx = jnp.asarray(table[:nblk], jnp.int32)
-            req.swap_state = {
-                "nblk": nblk,
-                "k": np.asarray(self.cache["k"][:, idx]),
-                "v": np.asarray(self.cache["v"][:, idx]),
-            }
+            req.swap_state = self._swap_payload(table, nblk)
         self._tables[slot] = []
         for b in table:
             self._alloc.decref(b)
@@ -1387,6 +1378,165 @@ class DecodeServer:
         self.preempts[mode] += 1
         if not self._active:
             self._idle_since = None
+
+    # ------------------------------------------------------------------
+    # supervised-restart support (models/supervision.EngineSupervisor):
+    # capture every live request's resumable state from THIS (failed)
+    # engine, restore captured state into a FRESH engine. Both lean on
+    # the bit-exact resume primitives the paged preemption path proved:
+    # byte-exact swap restore and chunking-invariant recompute
+    # re-prefill — extended here to the slot-static engine too.
+    # ------------------------------------------------------------------
+    def capture_resumable(self, device_ok: bool = True) -> List[dict]:
+        """Resumable snapshots of every request this engine still owes
+        an answer for — active slots (mid-prefill ones as fresh
+        submissions), the pending queue (preempted swap payloads kept),
+        and finished-but-unpopped results — in original arrival (rid)
+        order. Read-only host bookkeeping, safe on a dead engine; the
+        one device interaction (swap-to-host KV snapshot of an active
+        slot's committed blocks, paged + kv_swap only) is guarded —
+        an unreadable device downgrades that slot to recompute — and
+        skipped entirely with ``device_ok=False`` (a watchdog-declared
+        wedged device could HANG the copy, which no guard catches).
+        Iterates over list() snapshots throughout: the supervisor runs
+        capture OUTSIDE the loop lock (so handlers answer 503 fast),
+        and a concurrently tearing-down stream may pop entries from
+        the host dicts while this reads."""
+        pre = {ent["req"].rid for ent in self._prefilling}
+        states = []
+        live = list(self._active.values()) + list(self._pending)
+        for req in sorted(live, key=lambda r: r.rid):
+            st = {
+                "rid": req.rid,
+                "prompt": list(req.prompt),
+                "out": list(req.out[:req.max_new_tokens]),
+                "max_new_tokens": req.max_new_tokens,
+                "temperature": req.temperature,
+                "top_k": req.top_k,
+                "top_p": req.top_p,
+                "seed": req.seed,
+                "stop_tokens": list(req.stop_tokens),
+                "priority": req.priority,
+                "cache_prefix": req.cache_prefix,
+            }
+            if req.rid in pre:
+                st["out"] = []          # mid-prefill: restart admission
+            elif req.swap_state is not None:
+                st["swap"] = req.swap_state     # already host-resident
+            elif device_ok and self.paged and self.kv_swap \
+                    and req.slot >= 0 and req.out:
+                base = len(req.prompt) + len(req.out) - 1
+                nblk = blocks_for(base, self.kv_block_size)
+                table = self._tables[req.slot]
+                if nblk and len(table) >= nblk:
+                    try:
+                        st["swap"] = self._swap_payload(table, nblk)
+                    except Exception:   # device gone: recompute instead
+                        pass
+            states.append(st)
+        for rid, req in list(self._done.items()):
+            states.append({
+                "rid": rid,
+                "prompt": list(req.prompt),
+                "out": list(req.out[:req.max_new_tokens]),
+                "max_new_tokens": req.max_new_tokens,
+                "done": True,
+            })
+        return states
+
+    def restore(self, state: dict) -> int:
+        """Re-admit one captured request into this (fresh) engine with
+        its committed tokens intact, returning its new rid. The
+        supervisor restores in original arrival order into an empty
+        engine, so plain appends reproduce front-of-queue semantics;
+        client re-submissions after recovery queue behind. A ``done``
+        state parks straight in the result table (the loop still owes
+        a client that handoff). Committed output resumes through the
+        preemption machinery: byte-exact swap restore when the state
+        carries a paged KV payload, recompute re-prefill of
+        ``prompt + out[:-1]`` otherwise — both bit-exact, so a greedy
+        request's tokens are indistinguishable from an undisturbed
+        run (tested)."""
+        prompt = list(state["prompt"])
+        max_new = int(state["max_new_tokens"])
+        rid = self._next_rid
+        self._next_rid += 1
+        req = _Request(
+            rid, prompt, max_new,
+            temperature=float(state.get("temperature", 0.0)),
+            top_k=int(state.get("top_k", 0)),
+            top_p=float(state.get("top_p", 0.0)),
+            seed=int(state.get("seed", rid)) & 0xFFFFFFFF,
+            cache_prefix=bool(state.get("cache_prefix", False))
+            and self._prefix_max > 0,
+            stop_tokens=tuple(int(t) for t in state.get("stop_tokens")
+                              or ()),
+            priority=int(state.get("priority", 0)),
+            led=_Ledger(time.perf_counter()))
+        req.out = list(state.get("out") or [])
+        if state.get("done"):
+            self._done[rid] = req
+            return rid
+        if len(prompt) + max_new > self.max_len:
+            raise Infeasible(
+                f"restored prompt ({len(prompt)}) + max_new_tokens "
+                f"({max_new}) exceeds cache length {self.max_len}")
+        if self.paged:
+            need = blocks_for(len(prompt) + max_new - 1,
+                              self.kv_block_size)
+            if need > self._alloc.capacity:
+                raise Infeasible(
+                    f"restored request needs {need} KV blocks but the "
+                    f"pool only has {self._alloc.capacity}")
+        if req.out:
+            swap = state.get("swap")
+            if self.paged and swap is not None:
+                req.swap_state = dict(swap)
+            req.preempted = True
+        self._pending.append(req)
+        self._admit()
+        return rid
+
+    def _swap_payload(self, table: List[int], nblk: int) -> dict:
+        """Host copies of a slot's first ``nblk`` committed KV blocks —
+        the swap-out payload both preemption (_preempt_slot) and
+        supervised-restart capture share, so what the two paths
+        snapshot can never silently diverge."""
+        idx = jnp.asarray(table[:nblk], jnp.int32)
+        return {
+            "nblk": nblk,
+            "k": np.asarray(self.cache["k"][:, idx]),
+            "v": np.asarray(self.cache["v"][:, idx]),
+        }
+
+    def _resume_draft(self, req: _Request, seq: List[int]) -> None:
+        """Hook for engines with sibling caches (the speculative
+        engine's draft KV): re-prefill them over ``seq`` alongside a
+        recompute resume. Base engine: nothing to do."""
+
+    def _resume_recompute_static(self, req: _Request) -> None:
+        """Slot-static recompute resume — the supervised-restart path
+        (slot-static engines never preempt, but a rebuilt engine
+        re-admits requests with committed tokens): re-prefill
+        ``prompt + out[:-1]`` over a scratch row (per-position forward
+        math is chunking-invariant, so the regenerated KV and every
+        token after it are bit-exact) and install with pos = committed
+        length, feed token = the last committed, not-yet-fed token."""
+        req.preempted = False
+        seq = req.prompt + req.out[:-1]
+        n = len(seq)
+        bucket = min(_bucket(n), self.max_len)
+        row = {"k": self._row_zeros(bucket), "v": self._row_zeros(bucket),
+               "pos": jnp.zeros((), jnp.int32)}
+        toks = jnp.asarray([seq + [0] * (bucket - n)], jnp.int32)
+        _logits, row = self._run_prefill(toks, row)
+        s = req.slot
+        self._set_sampling_rows(req)
+        self.cache, self._last = self._install(
+            self.cache, row["k"], row["v"], jnp.int32(s), jnp.int32(n),
+            jnp.int32(req.out[-1]), self._last)
+        self._resume_draft(req, seq)
+        req.led.t_prefill_end = time.perf_counter()
 
     def _resume_swapped(self, req: _Request) -> None:
         """Swap-in resume: restore the preempted request's KV bytes
@@ -1411,7 +1561,11 @@ class DecodeServer:
         is chunking-invariant — the same invariant chunked prefill and
         prefix reuse already rest on — so the regenerated KV, and every
         token after it, is bit-exact. One-shot scratch prefill (no
-        chunking: the request already waited once)."""
+        chunking: the request already waited once). Slot-static engines
+        route to the supervised-restart twin (_resume_recompute_static)
+        — same math over the shared cache row instead of arena blocks."""
+        if not self.paged:
+            return self._resume_recompute_static(req)
         req.preempted = False
         bs = self.kv_block_size
         seq = req.prompt + req.out[:-1]
@@ -1431,15 +1585,24 @@ class DecodeServer:
         self._set_table_row(req.slot)
         self._resume_row(req)
 
-    def _resume_row(self, req: _Request) -> None:
-        """Shared fork/resume tail: sampling rows, device pos (=
-        committed KV length) and the feed token (= last committed,
-        not yet fed)."""
+    def _set_sampling_rows(self, req: _Request) -> None:
+        """Install one request's per-slot sampling params (the rows the
+        compiled decode program reads) — the ONE place they land, shared
+        by admission (_finish_prefill), fork/preempt resume
+        (_resume_row) and supervised-restart static resume, so a future
+        sampling knob cannot silently miss a path."""
         s = req.slot
         self._temp = self._temp.at[s].set(req.temperature)
         self._topk = self._topk.at[s].set(req.top_k)
         self._topp = self._topp.at[s].set(req.top_p)
         self._seed = self._seed.at[s].set(req.seed)
+
+    def _resume_row(self, req: _Request) -> None:
+        """Shared fork/resume tail: sampling rows, device pos (=
+        committed KV length) and the feed token (= last committed,
+        not yet fed)."""
+        s = req.slot
+        self._set_sampling_rows(req)
         base = len(req.prompt) + len(req.out) - 1
         self.cache, self._last = self._set_row_state(
             self.cache, self._last, jnp.int32(s), jnp.int32(base),

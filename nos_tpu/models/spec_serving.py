@@ -331,6 +331,28 @@ class SpeculativeDecodeServer(DecodeServer):
             self.d_cache["pos"] = self.d_cache["pos"].at[req.slot].set(0)
         super()._finish_if_done(req, admit)
 
+    def _resume_draft(self, req, seq) -> None:
+        """Supervised-restart resume for the DRAFT cache: re-prefill it
+        over the same committed sequence the target resume installs
+        (``prompt + out[:-1]``) so the draft invariant — processed ==
+        committed[:-1], pos == committed length - 1 fed next — holds in
+        the rebuilt engine exactly as it did before the failure. The
+        draft's re-prefilled KV is bit-identical to the incrementally
+        built one (chunking invariance), so greedy accept/reject
+        decisions — and therefore committed tokens — are undisturbed."""
+        n = len(seq)
+        bucket = min(_bucket(n), self.max_len)
+        toks = jnp.asarray([seq + [0] * (bucket - n)], jnp.int32)
+        drow = {
+            "k": self._d_row_zeros(bucket),
+            "v": self._d_row_zeros(bucket),
+            "pos": jnp.zeros((), jnp.int32),
+        }
+        _, drow = self._run_d_prefill(toks, drow)
+        self.d_cache = self._d_install(
+            self.d_cache, drow["k"], drow["v"], jnp.int32(req.slot),
+            jnp.int32(n))
+
     # ------------------------------------------------------------------
     def _dispatch(self, active, keep, sampling):
         """One speculative dispatch: up to k tokens per active slot.

@@ -1,0 +1,71 @@
+"""Slow-marked smoke of bench_chaos_serve.py (ISSUE 7 CI satellite):
+the serving-chaos bench path must not rot. Runs the real script in
+NOS_TPU_BENCH_SMOKE=1 mode in a subprocess (its own jax runtime), then
+pins the artifact shape and the acceptance gate: under the seeded
+smoke fault schedule (3 injected engine failures + 1 hung tick, per
+resume mode) the server process survives, every greedy request resumes
+BIT-EXACTLY, zero requests are lost, restart MTTR is reported, and the
+outcome-conservation invariant holds."""
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.mark.slow
+def test_bench_chaos_serve_smoke_survives_and_resumes_bit_exact():
+    env = dict(os.environ, NOS_TPU_BENCH_SMOKE="1", JAX_PLATFORMS="cpu")
+    proc = subprocess.run(
+        [sys.executable, "bench_chaos_serve.py"], cwd=REPO, env=env,
+        capture_output=True, text=True, timeout=1200)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+
+    # stdout line parses and the file artifact matches it
+    line = json.loads(proc.stdout.strip().splitlines()[-1])
+    with open(os.path.join(REPO, "bench_logs",
+                           "bench_chaos_serve.json")) as f:
+        artifact = json.load(f)
+    assert artifact == line
+    assert "[SMOKE]" in artifact["metric"]
+    assert artifact["unit"] == "s_worst_restart_mttr"
+    assert artifact["value"] >= 0
+
+    # the clean reference ran and set the goodput baseline
+    assert artifact["clean"]["tokens_per_s"] > 0
+
+    modes = {s["mode"] for s in artifact["scenarios"]}
+    assert modes == {"swap", "recompute"}
+    for s in artifact["scenarios"]:
+        # the acceptance gate: >= 3 injected engine failures + 1 hung
+        # tick, the process survives, everything resumes bit-exactly
+        assert s["injected"].get("error", 0) >= 3, s["injected"]
+        assert s["injected"].get("hang", 0) >= 1, s["injected"]
+        assert s["restarts"] >= 4
+        assert s["restarts_by_cause"]["watchdog"] >= 1
+        assert s["completed"] == s["requests"], s["errors"]
+        assert s["bit_exact"] is True
+        assert s["requests_lost"] == 0
+        # the resume mode actually exercised matches the scenario
+        if s["mode"] == "swap":
+            assert s["requests_resumed"]["swap"] > 0
+        else:
+            assert s["requests_resumed"]["swap"] == 0
+            assert s["requests_resumed"]["recompute"] > 0
+        # per-episode detection + recovery MTTR reported
+        assert len(s["episodes"]) == s["restarts"]
+        for e in s["episodes"]:
+            assert e["mttr_s"] >= 0
+            assert e["detection_s"] is None or e["detection_s"] >= 0
+        assert s["mttr_s"]["max"] >= s["mttr_s"]["mean"] >= 0
+        # outcome conservation: submitted == sum of terminal outcomes
+        assert s["conservation_ok"] is True
+        assert sum(s["outcomes"].values()) == s["requests"]
+        assert s["outcomes"]["finished"] == s["requests"]
+    # goodput under faults is reported and sane (restart windows cost
+    # throughput; anything above 1.0 would mean the clock lied)
+    for mode, ratio in artifact["goodput_vs_clean"].items():
+        assert 0 < ratio <= 1.5, (mode, ratio)

@@ -347,6 +347,31 @@ def test_serving_deployment_passes_paged_kv_args():
         "blockSize": 0, "blocks": 0, "swap": True}
 
 
+def test_serving_deployment_passes_supervisor_and_deadline_args():
+    """The serving Deployment must plumb the self-healing knobs
+    (serving.supervisor.*, serving.deadline.*) to nos-tpu-server flags
+    (ISSUE 7 satellite), and the chart defaults must ship supervised
+    restarts ON (budget 2) with the watchdog and default deadline off —
+    self-healing by default, no behavior change for latency contracts."""
+    path = os.path.join(CHART, "templates", "serving",
+                        "deployment_server.yaml")
+    with open(path) as f:
+        text = f.read()
+    for flag, value in (
+        ("--restart-budget", ".Values.serving.supervisor.restartBudget"),
+        ("--watchdog-s", ".Values.serving.supervisor.watchdogSeconds"),
+        ("--default-deadline-s",
+         ".Values.serving.deadline.defaultSeconds"),
+    ):
+        assert flag in text, f"serving deployment missing {flag}"
+        assert value in text, f"serving deployment missing {value}"
+    with open(os.path.join(CHART, "values.yaml")) as f:
+        values = yaml.safe_load(f)
+    assert values["serving"]["supervisor"] == {
+        "restartBudget": 2, "watchdogSeconds": 0}
+    assert values["serving"]["deadline"] == {"defaultSeconds": 0}
+
+
 def test_serving_sample_valid():
     """The serving Deployment sample must parse, and its embedded config
     must construct a real ServerConfig (drift between the sample and the

@@ -740,6 +740,11 @@ def test_stats_endpoint_schema(served):
     assert snap["tokens_emitted"] >= 1
     # loop half (ServingLoop.stats)
     assert snap["healthy"] is True and snap["draining"] is False
+    assert snap["recovering"] is False
+    assert snap["supervisor"] is None   # no engine factory configured
+    assert set(snap["deadline"]) == {"default_s", "active", "shed",
+                                     "expired", "est_ttft_s",
+                                     "est_tpot_s"}
     assert set(snap["slo"]) == {"ttft_ms", "tpot_ms", "completed",
                                 "goodput"}
     assert set(snap["rates"]) == {"window_s", "tokens_per_s",
@@ -1094,3 +1099,37 @@ def test_kv_flags_override_config():
     cfg = seen["cfg"]
     assert cfg.kv_block_size == 16 and cfg.kv_blocks == 32
     assert cfg.kv_swap is False
+
+
+def test_supervisor_and_deadline_flags_override_config():
+    """--restart-budget / --watchdog-s / --default-deadline-s reach the
+    ServerConfig the engine factory closes over (ISSUE 7 CI satellite),
+    and invalid values are clean config errors before any model load."""
+    from nos_tpu.cmd import server as server_mod
+
+    seen = {}
+
+    def fake_build(cfg):
+        seen["cfg"] = cfg
+        raise SystemExit(0)          # stop before the serving loop
+
+    real = server_mod.build_engine
+    server_mod.build_engine = fake_build
+    try:
+        with pytest.raises(SystemExit):
+            server_mod.main(["--restart-budget", "5", "--watchdog-s",
+                             "2.5", "--default-deadline-s", "30"])
+        cfg = seen["cfg"]
+        assert cfg.restart_budget == 5
+        assert cfg.watchdog_s == 2.5
+        assert cfg.default_deadline_s == 30.0
+        with pytest.raises(ValueError, match="restart_budget"):
+            server_mod.main(["--restart-budget", "-1"])
+        with pytest.raises(ValueError, match="watchdog_s"):
+            server_mod.main(["--watchdog-s", "-0.5"])
+    finally:
+        server_mod.build_engine = real
+    # config-file defaults exist and are sane
+    cfg = ServerConfig()
+    assert cfg.restart_budget == 2 and cfg.watchdog_s == 0.0
+    assert cfg.default_deadline_s == 0.0

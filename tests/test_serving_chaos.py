@@ -1,0 +1,837 @@
+"""Self-healing serving (ISSUE 7 tentpole): supervised engine restarts,
+request deadlines, and the seeded serving-chaos machinery.
+
+Two layers, mirroring the implementation split:
+
+- jax-free stub-engine tests drive ServingLoop + EngineSupervisor +
+  FaultInjector through restarts, watchdog trips, deadline expiry, lost
+  requests, budget exhaustion, and the shutdown-during-recovery race —
+  the exactly-once outcome discipline is the invariant everywhere, and
+  the slow-marked multi-seed soak hammers it under random fault/client
+  schedules.
+- real-engine tests pin the headline contract: a greedy request resumed
+  across an injected engine restart is BIT-IDENTICAL to an undisturbed
+  run, at every (pipeline_depth, decode_steps) in {1,2} x {1,4}, in
+  both swap (byte-exact KV restore) and recompute (re-prefill) modes.
+"""
+import threading
+import time
+
+import pytest
+
+from nos_tpu.cmd.server import OUTCOMES, ServingLoop
+from nos_tpu.models.errors import DeadlineExceeded, DeadlineUnmeetable
+from nos_tpu.models.supervision import EngineSupervisor, FaultInjector
+from nos_tpu.utils.metrics import default_registry
+
+
+# ---------------------------------------------------------------------------
+# stub engine: a split-protocol token mill honoring the DecodeServer
+# surface the loop relies on (progress = generated-only, pop_result =
+# prompt + generated, capture/restore for the supervisor)
+# ---------------------------------------------------------------------------
+
+class StubEngine:
+    def __init__(self, tokens_per_tick: int = 1):
+        self.reqs = {}          # rid -> {"prompt", "out", "n"}
+        self.done = {}          # rid -> {"prompt", "out"}
+        self.ledgers = {}       # rid -> fixed-latency ledger snapshot
+        self.next_rid = 0
+        self.tokens_per_tick = tokens_per_tick
+
+    def submit(self, prompt, max_new_tokens, **kw):
+        rid = self.next_rid
+        self.next_rid += 1
+        self.reqs[rid] = {"prompt": list(prompt), "out": [],
+                          "n": max_new_tokens}
+        return rid
+
+    # deterministic token rule: next token == absolute position, so a
+    # restarted engine continuing from restored state produces exactly
+    # the sequence an undisturbed run would — any duplication or gap in
+    # the stream is visible in the output itself
+    def _mint(self, d):
+        d["out"].append(len(d["prompt"]) + len(d["out"]))
+
+    def capture_resumable(self):
+        sts = [{"rid": r, "prompt": d["prompt"], "out": list(d["out"]),
+                "max_new_tokens": d["n"]}
+               for r, d in sorted(self.reqs.items())]
+        sts += [{"rid": r, "prompt": d["prompt"], "out": list(d["out"]),
+                 "max_new_tokens": len(d["out"]), "done": True}
+                for r, d in sorted(self.done.items())]
+        return sts
+
+    def restore(self, state):
+        rid = self.next_rid
+        self.next_rid += 1
+        d = {"prompt": list(state["prompt"]), "out": list(state["out"]),
+             "n": int(state["max_new_tokens"])}
+        if state.get("done"):
+            self.done[rid] = d
+        else:
+            self.reqs[rid] = d
+        return rid
+
+    def has_work(self):
+        return bool(self.reqs)
+
+    def step_begin(self):
+        return object()
+
+    def step_wait(self, handle):
+        time.sleep(0.0005)
+
+    def step_finish(self, handle):
+        emitted = 0
+        for rid, d in list(self.reqs.items()):
+            for _ in range(self.tokens_per_tick):
+                self._mint(d)
+                emitted += 1
+                if len(d["out"]) >= d["n"]:
+                    break
+            if len(d["out"]) >= d["n"]:
+                self.done[rid] = d
+                del self.reqs[rid]
+                # fixed-latency ledger: seeds the loop's rolling
+                # TTFT/TPOT estimates deterministically (10 ms TTFT,
+                # 0.5 ms/token) for the deadline-admission tests
+                n = len(d["out"])
+                self.ledgers[rid] = {
+                    "queue_s": 0.0, "ttft_s": 0.01,
+                    "e2e_s": 0.01 + 0.0005 * n,
+                    "tpot": [(0.0005 * (n - 1), n - 1)] if n > 1 else [],
+                    "output_tokens": n,
+                }
+        return emitted
+
+    def pop_ledger(self, rid):
+        return self.ledgers.pop(rid, None)
+
+    def progress(self, rid):
+        if rid in self.done:
+            return list(self.done[rid]["out"]), True
+        d = self.reqs.get(rid)
+        if d is None:
+            return None
+        return list(d["out"]), False
+
+    def pop_result(self, rid):
+        d = self.done.pop(rid, None)
+        return None if d is None else d["prompt"] + d["out"]
+
+    def cancel(self, rid):
+        d = self.reqs.pop(rid, None)
+        if d is None:
+            return False
+        self.done[rid] = d
+        return True
+
+
+def outcome_totals():
+    c = default_registry().counter(
+        "nos_tpu_serve_requests_total", "", ("outcome",))
+    return {o: c.value(o) for o in OUTCOMES}
+
+
+def outcome_delta(before):
+    after = outcome_totals()
+    return {o: after[o] - before[o] for o in OUTCOMES}
+
+
+def make_loop(injector=None, factory=lambda: StubEngine(), **kw):
+    wrap = injector.wrap if injector is not None else (lambda e: e)
+    kw.setdefault("restart_backoff_s", 0.01)
+    kw.setdefault("restart_budget", 4)
+    return ServingLoop(wrap(factory()),
+                       engine_factory=lambda: wrap(factory()), **kw)
+
+
+def expected_tokens(prompt, n):
+    return list(prompt) + list(range(len(prompt), len(prompt) + n))
+
+
+# ---------------------------------------------------------------------------
+# supervised restarts over the stub
+# ---------------------------------------------------------------------------
+
+def test_restart_resumes_all_requests_exactly_once():
+    before = outcome_totals()
+    inj = FaultInjector(schedule={3: "error", 7: "error"})
+    loop = make_loop(inj)
+    outs = {}
+
+    def worker(i):
+        outs[i] = loop.generate([100 + i], 12, timeout=30)
+
+    threads = [threading.Thread(target=worker, args=(i,))
+               for i in range(3)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(60)
+    try:
+        assert loop._sup.restarts == 2
+        assert loop._sup.resumed["recompute"] == 6 and loop._sup.lost == 0
+        for i in range(3):
+            assert outs[i] == expected_tokens([100 + i], 12)
+        d = outcome_delta(before)
+        assert d["finished"] == 3
+        assert sum(d.values()) == 3         # exactly one outcome each
+        assert loop.healthy and not loop.recovering
+        # episodes report MTTR for the chaos bench
+        eps = loop.stats()["supervisor"]["episodes"]
+        assert len(eps) == 2 and all(e["mttr_s"] >= 0 for e in eps)
+    finally:
+        loop.shutdown()
+
+
+def test_budget_exhaustion_is_terminal_and_drains_failed():
+    before = outcome_totals()
+    inj = FaultInjector(schedule={2: "error", 4: "error", 6: "error"})
+    loop = make_loop(inj, restart_budget=2)
+    outs, errs = {}, {}
+
+    def worker(i):
+        try:
+            outs[i] = loop.generate([1], 50, timeout=30)
+        except RuntimeError as e:
+            errs[i] = e
+
+    threads = [threading.Thread(target=worker, args=(i,))
+               for i in range(2)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(60)
+    try:
+        assert not loop.healthy             # third failure: terminal
+        assert errs and not outs
+        d = outcome_delta(before)
+        assert d["failed"] == 2 and sum(d.values()) == 2
+    finally:
+        loop.shutdown()
+
+
+def test_no_factory_keeps_terminal_failure_behavior():
+    """restart_budget/engine_factory absent == the pre-supervision
+    contract: first engine failure flips /healthz."""
+    before = outcome_totals()
+    inj = FaultInjector(schedule={1: "error"})
+    eng = inj.wrap(StubEngine())
+    loop = ServingLoop(eng)
+    with pytest.raises(RuntimeError, match="serving loop failed"):
+        loop.generate([1], 10, timeout=30)
+    try:
+        assert not loop.healthy
+        d = outcome_delta(before)
+        assert d["failed"] == 1 and sum(d.values()) == 1
+    finally:
+        loop.shutdown()
+
+
+def test_engine_without_capture_loses_requests_accounted_failed():
+    before = outcome_totals()
+
+    class Bare(StubEngine):
+        capture_resumable = property()      # AttributeError on access
+
+    inj = FaultInjector(schedule={1: "error"})
+    loop = ServingLoop(
+        inj.wrap(Bare()), engine_factory=lambda: inj.wrap(Bare()),
+        restart_budget=2, restart_backoff_s=0.01)
+    with pytest.raises(RuntimeError):
+        loop.generate([1], 30, timeout=30)
+    try:
+        d = outcome_delta(before)
+        # nothing captured -> the in-flight request is simply gone from
+        # the rebuilt engine; the stream observes the vanish and the
+        # teardown accounts it exactly once
+        assert sum(d.values()) == 1
+        assert loop.healthy                 # the restart itself worked
+    finally:
+        loop.shutdown()
+
+
+def test_restore_failure_accounts_lost_exactly_once():
+    before = outcome_totals()
+
+    class RestoreBoom(StubEngine):
+        def __init__(self, fresh=False):
+            super().__init__()
+            self.fresh = fresh
+
+        def restore(self, state):
+            if self.fresh:
+                raise ValueError("cannot restore here")
+            return super().restore(state)
+
+    inj = FaultInjector(schedule={1: "error"})
+    loop = ServingLoop(
+        inj.wrap(RestoreBoom()),
+        engine_factory=lambda: inj.wrap(RestoreBoom(fresh=True)),
+        restart_budget=2, restart_backoff_s=0.01)
+    with pytest.raises(RuntimeError, match="lost in engine restart"):
+        loop.generate([1], 30, timeout=30)
+    try:
+        d = outcome_delta(before)
+        assert d["failed"] == 1 and sum(d.values()) == 1
+        assert loop._sup.lost == 1
+        lost = default_registry().counter(
+            "nos_tpu_serve_requests_lost_total", "")
+        assert lost.total() >= 1
+    finally:
+        loop.shutdown()
+
+
+def test_watchdog_trips_on_hung_tick_and_recovers():
+    before = outcome_totals()
+    inj = FaultInjector(schedule={3: "hang"}, hang_s=1.0)
+    loop = make_loop(inj, watchdog_s=0.15)
+    out = loop.generate([5], 20, timeout=30)
+    try:
+        assert out == expected_tokens([5], 20)
+        assert loop._sup.restarts == 1
+        eps = loop.stats()["supervisor"]["episodes"]
+        assert eps[0]["cause"] == "watchdog"
+        trips = default_registry().counter(
+            "nos_tpu_serve_watchdog_trips_total", "")
+        assert trips.total() >= 1
+        d = outcome_delta(before)
+        assert d["finished"] == 1 and sum(d.values()) == 1
+        # the superseded (stuck) ticker must exit once it unblocks and
+        # leave the recovered loop serving normally
+        time.sleep(1.0)
+        assert loop.healthy
+        assert loop.generate([6], 3, timeout=30) == \
+            expected_tokens([6], 3)
+    finally:
+        loop.shutdown()
+
+
+def test_watchdog_without_supervisor_fails_terminally():
+    """watchdogSeconds > 0 with restartBudget = 0 (no engine factory)
+    must still arm the watchdog: a validated trip then goes TERMINAL —
+    /healthz flips and orchestration restarts the pod — instead of the
+    loop wedging forever behind a green health check."""
+    before = outcome_totals()
+    trips = default_registry().counter(
+        "nos_tpu_serve_watchdog_trips_total", "")
+    t0 = trips.total()
+    inj = FaultInjector(schedule={2: "hang"}, hang_s=1.0)
+    loop = ServingLoop(inj.wrap(StubEngine()), watchdog_s=0.15)
+    assert loop._monitor_thread is not None
+    with pytest.raises(RuntimeError, match="watchdog"):
+        loop.generate([1], 30, timeout=30)
+    try:
+        assert not loop.healthy
+        assert trips.total() - t0 == 1
+        d = outcome_delta(before)
+        assert d["failed"] == 1 and sum(d.values()) == 1
+    finally:
+        loop.shutdown()
+
+
+def test_recovering_rejects_submits_and_resumes_streams():
+    """Mid-recovery, new submissions get EngineRecovering while already-
+    admitted streams ride through the restart."""
+    from nos_tpu.models.errors import EngineRecovering
+
+    gate = threading.Event()
+
+    def factory():
+        gate.wait(10)
+        return StubEngine()
+
+    inj = FaultInjector(schedule={2: "error"})
+    loop = ServingLoop(
+        inj.wrap(StubEngine()),
+        engine_factory=lambda: inj.wrap(factory()),
+        restart_budget=2, restart_backoff_s=0.01)
+    outs = {}
+
+    def worker():
+        outs[0] = loop.generate([9], 10, timeout=30)
+
+    t = threading.Thread(target=worker)
+    t.start()
+    deadline = time.monotonic() + 10
+    while not loop.recovering and time.monotonic() < deadline:
+        time.sleep(0.005)
+    try:
+        assert loop.recovering
+        assert loop.healthy                 # NOT terminal
+        with pytest.raises(EngineRecovering):
+            loop.generate([1], 2, timeout=5)
+        gate.set()
+        t.join(30)
+        assert outs[0] == expected_tokens([9], 10)
+        assert not loop.recovering
+    finally:
+        gate.set()
+        loop.shutdown()
+
+
+def test_shutdown_during_recovery_drains_captured_failed():
+    """The drain-during-shutdown race (ISSUE 7 bugfix satellite):
+    shutdown() landing while a recovery is rebuilding must cancel the
+    recovery deterministically — captured requests drain as ``failed``
+    exactly once, the loop dies terminally, nothing hangs."""
+    before = outcome_totals()
+    gate = threading.Event()
+
+    def slow_factory():
+        gate.wait(30)                       # recovery parks here
+        return StubEngine()
+
+    inj = FaultInjector(schedule={2: "error"})
+    loop = ServingLoop(
+        inj.wrap(StubEngine()),
+        engine_factory=lambda: inj.wrap(slow_factory()),
+        restart_budget=2, restart_backoff_s=0.01)
+    errs = {}
+
+    def worker(i):
+        try:
+            loop.generate([i], 40, timeout=30)
+        except RuntimeError as e:
+            errs[i] = e
+
+    threads = [threading.Thread(target=worker, args=(i,))
+               for i in range(2)]
+    for t in threads:
+        t.start()
+    deadline = time.monotonic() + 10
+    while not loop.recovering and time.monotonic() < deadline:
+        time.sleep(0.005)
+    assert loop.recovering
+    t0 = time.monotonic()
+    loop.shutdown()             # must interrupt the parked rebuild
+    took = time.monotonic() - t0
+    gate.set()
+    for t in threads:
+        t.join(30)
+    assert took < 10, f"shutdown blocked {took:.1f}s on recovery"
+    assert not loop.healthy
+    assert len(errs) == 2
+    d = outcome_delta(before)
+    assert d["failed"] == 2 and sum(d.values()) == 2
+
+
+# ---------------------------------------------------------------------------
+# request deadlines
+# ---------------------------------------------------------------------------
+
+def test_deadline_expires_mid_decode_exactly_once():
+    before = outcome_totals()
+    loop = ServingLoop(StubEngine())
+    # slow the mill: ~0.5ms/tick, 10000 tokens would take ~5s
+    with pytest.raises(DeadlineExceeded):
+        loop.generate([1], 10_000, timeout=30, deadline_s=0.1)
+    try:
+        d = outcome_delta(before)
+        assert d["deadline"] == 1 and sum(d.values()) == 1
+        stats = loop.stats()
+        assert stats["deadline"]["expired"] == 1
+        assert stats["deadline"]["active"] == 0     # cleaned up
+        # the engine slot was cancelled, not left decoding
+        assert not loop.engine.has_work()
+    finally:
+        loop.shutdown()
+
+
+def test_deadline_admission_shed_when_estimates_say_unmeetable():
+    before = outcome_totals()
+    loop = ServingLoop(StubEngine())
+    try:
+        # seed the rolling estimates with one completed request
+        loop.generate([1], 40, timeout=30)
+        assert loop.stats()["deadline"]["est_ttft_s"] is not None
+        assert loop.stats()["deadline"]["est_tpot_s"] is not None
+        # ~0.5 ms/token: 100k tokens cannot land inside 1 ms
+        with pytest.raises(DeadlineUnmeetable):
+            loop.generate([1], 100_000, timeout=30, deadline_s=0.001)
+        d = outcome_delta(before)
+        assert d["deadline"] == 1 and d["finished"] == 1
+        assert sum(d.values()) == 2
+        assert loop.stats()["deadline"]["shed"] == 1
+        # a generous deadline still admits
+        assert loop.generate([2], 3, timeout=30, deadline_s=60.0) \
+            == expected_tokens([2], 3)
+    finally:
+        loop.shutdown()
+
+
+def test_deadline_shed_probe_breaks_estimate_lockin():
+    """Estimates only refresh on completions, so a stale-high estimate
+    that sheds 100% of deadline traffic would never decay — every Nth
+    consecutive shed must be admitted as a probe whose completion
+    unlocks admission again."""
+    from nos_tpu.cmd.server import DEADLINE_PROBE_EVERY
+
+    loop = ServingLoop(StubEngine())
+    try:
+        loop.generate([1], 5, timeout=30)   # seed the estimates
+        # poison them: pretend the engine got slow (est ~2.2s for a
+        # 3-token request vs a 1s deadline -> every admission sheds)
+        loop._est_ttft_s, loop._est_tpot_s = 2.0, 0.1
+        outcomes = []
+        admitted_streak = 0
+        for _ in range(30 * DEADLINE_PROBE_EVERY):
+            try:
+                loop.generate([2], 3, timeout=30, deadline_s=1.0)
+                outcomes.append("admitted")
+                admitted_streak += 1
+                if admitted_streak >= 2:
+                    break               # admitted on MERIT, not probe
+            except DeadlineUnmeetable:
+                outcomes.append("shed")
+                admitted_streak = 0
+        # the first N-1 attempts shed, the Nth was the probe...
+        assert outcomes[:DEADLINE_PROBE_EVERY] == \
+            ["shed"] * (DEADLINE_PROBE_EVERY - 1) + ["admitted"]
+        # ...and probe completions (the stub reports ~10ms latencies)
+        # decayed the EWMA until admission unlocked on merit — two
+        # consecutive admissions cannot both be probes
+        assert admitted_streak >= 2, outcomes
+        assert loop._est_ttft_s < 2.0
+    finally:
+        loop.shutdown()
+
+
+def test_deadline_validation_and_explicit_zero_opts_out():
+    loop = ServingLoop(StubEngine(), default_deadline_s=0.0001)
+    try:
+        with pytest.raises(ValueError, match="deadline_s"):
+            loop.generate([1], 2, deadline_s=-1.0)
+        # the fleet default applies when the field is omitted...
+        with pytest.raises(DeadlineExceeded):
+            loop.generate([1], 10_000, timeout=30)
+        # ...and an EXPLICIT deadline_s=0 opts out of it — the only
+        # wire value that can request unbounded completion
+        assert loop.generate([2], 5, timeout=30, deadline_s=0) \
+            == expected_tokens([2], 5)
+    finally:
+        loop.shutdown()
+
+
+def test_default_deadline_applies_and_restart_preserves_deadlines():
+    """A request's deadline keeps ticking across a restart: one that
+    expired during the outage is shed at restore time, not resumed."""
+    before = outcome_totals()
+    gate = threading.Event()
+
+    def slow_factory():
+        gate.wait(5)
+        return StubEngine()
+
+    inj = FaultInjector(schedule={2: "error"})
+    loop = ServingLoop(
+        inj.wrap(StubEngine()),
+        engine_factory=lambda: inj.wrap(slow_factory()),
+        restart_budget=2, restart_backoff_s=0.01,
+        default_deadline_s=0.2)
+    errs, outs = {}, {}
+
+    def worker():
+        try:
+            outs[0] = loop.generate([1], 50, timeout=30)
+        except DeadlineExceeded as e:
+            errs[0] = e
+
+    t = threading.Thread(target=worker)
+    t.start()
+    deadline = time.monotonic() + 10
+    while not loop.recovering and time.monotonic() < deadline:
+        time.sleep(0.005)
+    time.sleep(0.3)                     # outlive the 0.2s deadline
+    gate.set()
+    t.join(30)
+    try:
+        assert errs and not outs
+        d = outcome_delta(before)
+        assert d["deadline"] == 1 and sum(d.values()) == 1
+        assert loop._sup.resumed == {"swap": 0, "recompute": 0}
+    finally:
+        gate.set()
+        loop.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# FaultInjector / supervisor units
+# ---------------------------------------------------------------------------
+
+def test_fault_injector_seeded_schedule_is_deterministic():
+    a = FaultInjector(seed=7, p_error=0.2, p_hang=0.1)
+    b = FaultInjector(seed=7, p_error=0.2, p_hang=0.1)
+    kinds_a, kinds_b = [], []
+    for inj, kinds in ((a, kinds_a), (b, kinds_b)):
+        for _ in range(200):
+            try:
+                inj.before_dispatch(None)
+                kinds.append(inj.injected[-1]["kind"]
+                             if inj.injected and
+                             inj.injected[-1]["tick"] == inj.tick - 1
+                             else None)
+            except RuntimeError:
+                kinds.append("error")
+            inj.before_wait = lambda: None  # don't actually sleep
+    assert kinds_a == kinds_b
+    assert "error" in kinds_a
+
+
+def test_fault_injector_rejects_unknown_kind():
+    with pytest.raises(ValueError, match="unknown fault kinds"):
+        FaultInjector(schedule={0: "meteor"})
+
+
+def test_supervisor_backoff_is_seeded_and_bounded():
+    a = EngineSupervisor(lambda: None, restart_budget=5, backoff_s=0.5,
+                         backoff_max_s=2.0, seed=3)
+    b = EngineSupervisor(lambda: None, restart_budget=5, backoff_s=0.5,
+                         backoff_max_s=2.0, seed=3)
+    da = [a.backoff_delay(i) for i in range(5)]
+    db = [b.backoff_delay(i) for i in range(5)]
+    assert da == db                     # seeded jitter: reproducible
+    assert all(d <= 2.0 * 1.25 + 1e-9 for d in da)
+    assert all(d >= 0 for d in da)
+    with pytest.raises(ValueError):
+        EngineSupervisor(lambda: None, restart_budget=-1)
+
+
+def test_chaos_engine_proxy_mirrors_inner_surface():
+    inj = FaultInjector()
+    eng = StubEngine()
+    proxy = inj.wrap(eng)
+    assert hasattr(proxy, "step_begin") and hasattr(proxy, "cancel")
+    assert not hasattr(proxy, "kv_stats")
+    rid = proxy.submit([1], 2)
+    assert proxy.progress(rid) == ([], False)
+    proxy.step_begin()
+    proxy.step_wait(None)
+    proxy.step_finish(None)
+    assert inj.tick == 1
+    # attribute WRITES delegate too: the serving loop assigns
+    # engine.compile_events = [] to drain the compile ledger, and a
+    # proxy-shadowed copy would silently fork from the real engine
+    proxy.compile_events = ["x"]
+    assert eng.compile_events == ["x"]
+    assert "compile_events" not in vars(proxy)
+
+
+# ---------------------------------------------------------------------------
+# seeded chaos soak: every submitted request reaches exactly one
+# terminal outcome under random faults, disconnects and deadlines
+# ---------------------------------------------------------------------------
+
+# ---------------------------------------------------------------------------
+# real engine: a greedy request resumed across an injected restart is
+# BIT-IDENTICAL to an undisturbed run — swap restores the KV bytes,
+# recompute re-prefills prompt + out[:-1] (chunking-invariant), and the
+# slot-static engine recomputes over the shared cache row
+# ---------------------------------------------------------------------------
+
+MODEL = dict(vocab=64, d_model=32, n_layers=2, n_heads=4, n_kv_heads=2,
+             d_ff=64, max_seq=64)
+
+
+@pytest.fixture(scope="module")
+def real_params():
+    import jax
+    import jax.numpy as jnp
+
+    from nos_tpu.models import transformer as tfm
+
+    cfg = tfm.TransformerConfig(**MODEL, dtype=jnp.float32)
+    return tfm.init_params(jax.random.PRNGKey(0), cfg), cfg
+
+
+def _check_bit_exact_resume(real_params, mk_engine, depth, steps,
+                            want_mode):
+    import jax.numpy as jnp
+
+    from nos_tpu.models.generate import generate
+
+    params, cfg = real_params
+    inj = FaultInjector(schedule={2: "error"})
+    loop = ServingLoop(
+        inj.wrap(mk_engine(depth, steps)),
+        engine_factory=lambda: inj.wrap(mk_engine(depth, steps)),
+        restart_budget=2, restart_backoff_s=0.01)
+    prompts = [[1, 2, 3], [7, 8]]
+    outs = {}
+
+    def worker(i):
+        outs[i] = loop.generate(prompts[i], 10, timeout=180)
+
+    threads = [threading.Thread(target=worker, args=(i,))
+               for i in range(len(prompts))]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(300)
+    try:
+        assert loop._sup.restarts == 1, "fault did not trigger a restart"
+        assert loop._sup.lost == 0
+        assert loop._sup.resumed[want_mode] >= 1, loop._sup.resumed
+        for i, p in enumerate(prompts):
+            want = [int(t) for t in generate(
+                params, cfg, jnp.asarray([p], jnp.int32), 10)[0]]
+            assert outs.get(i) == want, (
+                f"depth={depth} steps={steps}: resumed request {i} "
+                f"diverged from the undisturbed run")
+    finally:
+        loop.shutdown()
+
+
+def _paged(real_params, swap):
+    from nos_tpu.models.serving import DecodeServer
+
+    params, cfg = real_params
+
+    def mk(depth, steps):
+        return DecodeServer(params, cfg, max_batch=2,
+                            pipeline_depth=depth, decode_steps=steps,
+                            kv_block_size=8, kv_blocks=17, kv_swap=swap)
+    return mk
+
+
+def _static(real_params):
+    from nos_tpu.models.serving import DecodeServer
+
+    params, cfg = real_params
+
+    def mk(depth, steps):
+        return DecodeServer(params, cfg, max_batch=2,
+                            pipeline_depth=depth, decode_steps=steps)
+    return mk
+
+
+def test_restart_resume_bit_exact_swap(real_params):
+    _check_bit_exact_resume(real_params, _paged(real_params, True),
+                            2, 4, "swap")
+
+
+def test_restart_resume_bit_exact_recompute(real_params):
+    _check_bit_exact_resume(real_params, _paged(real_params, False),
+                            1, 1, "recompute")
+
+
+def test_restart_resume_bit_exact_slot_static(real_params):
+    _check_bit_exact_resume(real_params, _static(real_params),
+                            2, 4, "recompute")
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("depth", [1, 2])
+@pytest.mark.parametrize("steps", [1, 4])
+@pytest.mark.parametrize("swap", [True, False])
+def test_restart_resume_bit_exact_matrix(real_params, depth, steps,
+                                         swap):
+    """The full (pipeline_depth, decode_steps) x (swap, recompute)
+    matrix of the ISSUE 7 coverage satellite."""
+    _check_bit_exact_resume(real_params, _paged(real_params, swap),
+                            depth, steps, "swap" if swap else "recompute")
+
+
+@pytest.mark.slow
+def test_restart_resume_bit_exact_speculative(real_params):
+    """The speculative engine resumes too: target AND draft caches
+    re-prefill over the committed sequence, so greedy accept/reject
+    decisions — and the committed tokens — are undisturbed."""
+    import jax
+    import jax.numpy as jnp
+
+    from nos_tpu.models import transformer as tfm
+    from nos_tpu.models.generate import generate
+    from nos_tpu.models.spec_serving import SpeculativeDecodeServer
+
+    params, cfg = real_params
+    dmodel = dict(MODEL, d_model=16, n_layers=1, n_heads=2,
+                  n_kv_heads=1, d_ff=32)
+    dcfg = tfm.TransformerConfig(**dmodel, dtype=jnp.float32)
+    dparams = tfm.init_params(jax.random.PRNGKey(1), dcfg)
+
+    def mk(depth, steps):
+        return SpeculativeDecodeServer(params, cfg, dparams, dcfg,
+                                       n_draft=3, max_batch=2)
+
+    inj = FaultInjector(schedule={2: "error"})
+    loop = ServingLoop(
+        inj.wrap(mk(1, 1)), engine_factory=lambda: inj.wrap(mk(1, 1)),
+        restart_budget=2, restart_backoff_s=0.01)
+    try:
+        out = loop.generate([1, 2, 3], 10, timeout=300)
+        assert loop._sup.restarts == 1 and loop._sup.lost == 0
+        want = [int(t) for t in generate(
+            params, cfg, jnp.asarray([[1, 2, 3]], jnp.int32), 10)[0]]
+        assert out == want
+    finally:
+        loop.shutdown()
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("seed", range(8))
+def test_chaos_soak_outcome_conservation(seed):
+    import random
+
+    rng = random.Random(1000 + seed)
+    before = outcome_totals()
+    inj = FaultInjector(seed=seed, p_error=0.04, p_hang=0.01,
+                        p_slow=0.05, hang_s=0.6, slow_s=0.01)
+    loop = make_loop(inj, restart_budget=64, watchdog_s=0.2)
+    n_requests = 16
+    submitted = []
+    lock = threading.Lock()
+
+    def worker(i):
+        prompt = [i] * rng.randint(1, 3)
+        n = rng.randint(3, 40)
+        deadline = rng.choice([None, None, None, 0.05, 2.0])
+        disconnect = rng.random() < 0.2
+        try:
+            stream = loop.stream(prompt, n, timeout=60,
+                                 deadline_s=deadline)
+        except Exception:
+            with lock:
+                submitted.append(("rejected", i))
+            return
+        with lock:
+            submitted.append(("admitted", i))
+        try:
+            got = list(prompt)
+            for k, delta in enumerate(stream):
+                got.extend(delta)
+                if disconnect and k >= 1:
+                    stream.close()
+                    return
+            assert got == expected_tokens(prompt, n), got
+        except Exception:
+            pass
+        finally:
+            stream.close()
+
+    threads = [threading.Thread(target=worker, args=(i,))
+               for i in range(n_requests)]
+    for t in threads:
+        rng.random() and time.sleep(rng.random() * 0.01)
+        t.start()
+    for t in threads:
+        t.join(120)
+    try:
+        # let any trailing reap/abandon accounting land
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            d = outcome_delta(before)
+            if sum(d.values()) >= n_requests and not loop.engine.reqs:
+                break
+            time.sleep(0.05)
+        d = outcome_delta(before)
+        assert sum(d.values()) == n_requests, (
+            f"seed {seed}: outcome conservation violated: {d} "
+            f"(submitted {n_requests})")
+        assert all(v >= 0 for v in d.values()), d
+        # no leaked engine state on the final engine
+        assert not loop.engine.reqs
+    finally:
+        loop.shutdown()
