@@ -3,7 +3,7 @@
 PYTHON ?= python
 IMAGE_REGISTRY ?= ghcr.io/nos-tpu
 VERSION ?= 0.1.0
-COMPONENTS = apiserver operator scheduler partitioner tpuagent deviceplugin lifecycle metricsexporter trainer server
+COMPONENTS = apiserver operator scheduler partitioner tpuagent deviceplugin lifecycle fleet metricsexporter trainer server
 
 .PHONY: test
 test:  ## Run the unit + integration suite (virtual 8-device CPU mesh for JAX tests).
@@ -51,6 +51,10 @@ bench-serve:  ## Continuous-batching serving throughput + pipelined-dispatch eco
 .PHONY: bench-chaos-serve
 bench-chaos-serve:  ## Serving-plane chaos: supervised restarts, bit-exact resume, MTTR + goodput under a seeded fault schedule (artifact in bench_logs/bench_chaos_serve.json).
 	$(PYTHON) bench_chaos_serve.py
+
+.PHONY: bench-autoscale
+bench-autoscale:  ## Fleet autoscaler vs static fleet on a seeded diurnal + flash-crowd trace (artifact in bench_logs/bench_autoscale.json).
+	$(PYTHON) bench_autoscale.py
 
 .PHONY: bench-infer
 bench-infer:  ## 7-tenant YOLOS-family inference latency (the reference's headline scenario).

@@ -1,0 +1,259 @@
+#!/usr/bin/env python3
+"""Fleet-autoscaler bench: a seeded diurnal + flash-crowd arrival trace
+replayed against static vs autoscaled serving fleets (ISSUE 8).
+
+The control plane is REAL — the in-process API server, the nos
+scheduler (ElasticQuota admission + binding), the quota reconciler
+(in-quota/over-quota labeling) and the fleet controller all run
+unmodified — while the data plane is the deterministic serving-fleet
+model (nos_tpu/fleet/sim.py): replicas with decode slots, queues and
+/stats snapshots, advanced tick-by-tick on a FakeClock. Nothing reads
+the wall clock, so the whole run is bit-reproducible at a fixed seed.
+
+Three fleets see the identical trace:
+
+- ``static``       — provisioned for MEAN demand: the chip-hour-
+                     comparable baseline the acceptance invariant is
+                     judged against (equal-or-fewer chips must buy
+                     equal-or-better goodput);
+- ``static_peak``  — provisioned for PEAK demand: the over-provisioned
+                     ops alternative, reported for context (the
+                     autoscaler approaches its goodput at a fraction of
+                     its chip-hours);
+- ``autoscaled``   — the fleet controller scraping replica /stats and
+                     scaling through quota admission, with graceful
+                     drains on the way down.
+
+Reported per fleet: goodput (TTFT-SLO), breach rate, chip-hours,
+chips-per-goodput (chip_hours / goodput — the cost of useful work),
+requeues and the conservation invariant. Writes
+``bench_logs/bench_autoscale.json`` FIRST (the artifact of record),
+then prints the same JSON line. NOS_TPU_BENCH_SMOKE=1 runs the exact
+code path on a shortened trace.
+"""
+import json
+import math
+import os
+import random
+import sys
+
+sys.path.insert(0, ".")
+
+from nos_tpu import constants  # noqa: E402
+from nos_tpu.api.quota import make_elastic_quota  # noqa: E402
+from nos_tpu.fleet import FleetConfig, FleetController, PolicyConfig  # noqa: E402
+from nos_tpu.fleet.sim import SimFleet, SimKubelet  # noqa: E402
+from nos_tpu.kube import ApiServer, Manager  # noqa: E402
+from nos_tpu.kube.client import Client  # noqa: E402
+from nos_tpu.kube.objects import (  # noqa: E402
+    Container, Node, NodeStatus, ObjectMeta, Pod, PodCondition, PodSpec,
+    PodStatus,
+)
+from nos_tpu.quota.controller import ElasticQuotaReconciler  # noqa: E402
+from nos_tpu.scheduler import Scheduler  # noqa: E402
+
+SEED = 20260804
+NAMESPACE = "serve"
+CHIPS_PER_REPLICA = 4.0
+SLO_TTFT_S = 10.0
+DT_S = 1.0
+STARTUP_S = 8.0         # bind -> Running: provisioning + compile warmup
+
+SMOKE = os.environ.get("NOS_TPU_BENCH_SMOKE") == "1"
+TRACE_S = 600 if SMOKE else 1800
+CROWD = (180, 270) if SMOKE else (800, 950)   # flash-crowd window
+CROWD_X = 5.0
+BASE_RPS = 3.0
+DIURNAL_AMP = 0.9
+DRAIN_OUT_S = 900       # post-trace settle budget (usually much less)
+
+MAX_REPLICAS = 6
+STATIC_MEAN = 3         # mean demand (~2 replicas) + N+1 headroom
+OUT_PATH = os.path.join("bench_logs", "bench_autoscale.json")
+
+POLICY = PolicyConfig(
+    min_replicas=1, max_replicas=MAX_REPLICAS,
+    queue_high=4.0, queue_low=0.5,
+    goodput_floor=0.90, goodput_ceiling=0.97,
+    oldest_wait_high_s=2.0,
+    up_stable_s=3.0, down_stable_s=30.0,
+    up_cooldown_s=5.0, down_cooldown_s=30.0,
+    max_step_up=3, max_step_down=1,
+)
+
+
+class FakeClock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+def arrival_rate(t: float) -> float:
+    """Requests/s at sim-time t: one compressed diurnal cycle over the
+    trace plus a flash-crowd multiplier inside the CROWD window."""
+    diurnal = 1.0 + DIURNAL_AMP * math.sin(
+        2 * math.pi * (t / TRACE_S - 0.25))
+    rate = BASE_RPS * diurnal
+    if CROWD[0] <= t < CROWD[1]:
+        rate *= CROWD_X
+    return max(0.0, rate)
+
+
+def replica_pod(name: str, fleet: str) -> Pod:
+    return Pod(
+        metadata=ObjectMeta(
+            name=name, namespace=NAMESPACE,
+            labels={constants.LABEL_FLEET: fleet,
+                    "app.kubernetes.io/component": "serving"}),
+        spec=PodSpec(
+            containers=[Container(
+                name="server",
+                requests={constants.RESOURCE_TPU: CHIPS_PER_REPLICA})],
+            scheduler_name=constants.SCHEDULER_NAME),
+        status=PodStatus(
+            phase="Pending",
+            conditions=[PodCondition(type="PodScheduled", status="False",
+                                     reason="Unschedulable")]))
+
+
+def build_rig(clock, fleet_name: str, autoscale: bool):
+    server = ApiServer()
+    mgr = Manager(server, clock=clock)
+    mgr.add_controller(ElasticQuotaReconciler().controller())
+    mgr.add_controller(Scheduler().controller())
+    client = Client(server)
+    # capacity: 3 hosts x 8 chips; quota min covers the whole pool for
+    # the serve namespace (the borrow/reclaim story is pinned by
+    # tests/test_fleet_integration.py — this bench isolates the
+    # traffic-driven loop)
+    for i in range(3):
+        server.create(Node(
+            metadata=ObjectMeta(name=f"host-{i}"),
+            status=NodeStatus(
+                capacity={constants.RESOURCE_TPU: 8, "cpu": 32},
+                allocatable={constants.RESOURCE_TPU: 8, "cpu": 32})))
+    server.create(make_elastic_quota(
+        "serve-quota", NAMESPACE,
+        min={constants.RESOURCE_TPU: MAX_REPLICAS * CHIPS_PER_REPLICA}))
+    ctl = None
+    if autoscale:
+        ctl = FleetController(
+            FleetConfig(
+                name=fleet_name, namespace=NAMESPACE,
+                resource=constants.RESOURCE_TPU,
+                chips_per_replica=CHIPS_PER_REPLICA,
+                policy=POLICY, reconcile_interval_s=2.0,
+                drain_timeout_s=45.0),
+            clock=clock)
+        mgr.add_controller(ctl.controller())
+    return server, mgr, client, ctl
+
+
+def run_fleet(name: str, replicas_static: int, autoscale: bool) -> dict:
+    clock = FakeClock()
+    rng = random.Random(SEED)
+    fleet = SimFleet(clock, slo_ttft_s=SLO_TTFT_S, max_batch=8,
+                     tokens_per_s=50.0, prefill_s=0.25,
+                     goodput_window_s=60.0)
+    server, mgr, client, ctl = build_rig(clock, name, autoscale)
+    kubelet = SimKubelet(fleet, clock, fleet_label=name,
+                         namespace=NAMESPACE, startup_s=STARTUP_S)
+    if ctl is not None:
+        ctl.stats_source = fleet.stats_source
+    else:
+        for i in range(replicas_static):
+            server.create(replica_pod(f"{name}-r{i}", name))
+    chip_seconds = 0.0
+    timeline = []           # (t, running_replicas) sampled every 30s
+    carry = 0.0
+    t = 0.0
+    end = float(TRACE_S)
+    settle_deadline = end + DRAIN_OUT_S
+    while True:
+        if t < end:
+            carry += arrival_rate(t) * DT_S
+            while carry >= 1.0:
+                carry -= 1.0
+                fleet.submit(tokens=rng.randint(20, 80))
+        mgr.run_until_idle()
+        kubelet.sync(client)
+        mgr.run_until_idle()
+        fleet.tick(DT_S)
+        running = sum(
+            1 for p in client.list(
+                "Pod", namespace=NAMESPACE,
+                label_selector={constants.LABEL_FLEET: name})
+            if p.status.phase == "Running")
+        chip_seconds += running * CHIPS_PER_REPLICA * DT_S
+        if int(t) % 30 == 0:
+            timeline.append((int(t), running))
+        clock.advance(DT_S)
+        t += DT_S
+        if t >= end and (fleet.in_system() == 0
+                         or t >= settle_deadline):
+            break
+    report = fleet.report()
+    goodput = report["goodput"] or 0.0
+    chip_hours = chip_seconds / 3600.0
+    report.update({
+        "fleet": name,
+        "autoscaled": autoscale,
+        "chip_hours": round(chip_hours, 4),
+        "chips_per_goodput": (round(chip_hours / goodput, 4)
+                              if goodput else None),
+        "settle_s": round(t - end, 1),
+        "replica_timeline": timeline,
+        "replicas_peak": max(n for _, n in timeline),
+        "replicas_mean": round(
+            sum(n for _, n in timeline) / len(timeline), 3),
+    })
+    if ctl is not None:
+        report["controller"] = ctl.stats()
+    mgr.stop()
+    return report
+
+
+def main():
+    static = run_fleet("static", STATIC_MEAN, autoscale=False)
+    static_peak = run_fleet("peak", MAX_REPLICAS, autoscale=False)
+    auto = run_fleet("auto", 0, autoscale=True)
+    result = {
+        "metric": "fleet autoscaler vs static fleets on a seeded "
+                  "diurnal + flash-crowd trace"
+                  + (" [SMOKE]" if SMOKE else ""),
+        "seed": SEED,
+        "trace": {
+            "duration_s": TRACE_S, "base_rps": BASE_RPS,
+            "diurnal_amplitude": DIURNAL_AMP,
+            "flash_crowd_window_s": list(CROWD),
+            "flash_crowd_x": CROWD_X,
+            "slo_ttft_s": SLO_TTFT_S,
+            "startup_s": STARTUP_S,
+            "chips_per_replica": CHIPS_PER_REPLICA,
+        },
+        # headline: chips-per-goodput of the autoscaled fleet relative
+        # to the mean-provisioned static baseline (lower is better; the
+        # acceptance invariant is goodput >= static at <= chip-hours)
+        "value": (round(auto["chips_per_goodput"]
+                        / static["chips_per_goodput"], 4)
+                  if static["chips_per_goodput"]
+                  and auto["chips_per_goodput"] else None),
+        "unit": "x_chips_per_goodput_vs_static",
+        "static": static,
+        "static_peak": static_peak,
+        "autoscaled": auto,
+    }
+    os.makedirs(os.path.dirname(OUT_PATH), exist_ok=True)
+    with open(OUT_PATH, "w") as f:
+        json.dump(result, f, indent=2)
+    print(json.dumps(result))
+    return result
+
+
+if __name__ == "__main__":
+    main()

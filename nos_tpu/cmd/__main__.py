@@ -11,6 +11,7 @@ _BINARIES = {
     "tpuagent": "nos_tpu.cmd.tpuagent",
     "deviceplugin": "nos_tpu.cmd.deviceplugin",
     "lifecycle": "nos_tpu.cmd.lifecycle",
+    "fleet": "nos_tpu.cmd.fleet",
     "metricsexporter": "nos_tpu.cmd.metricsexporter",
     "trainer": "nos_tpu.cmd.trainer",
     "generate": "nos_tpu.cmd.generate",

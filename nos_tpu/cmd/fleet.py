@@ -1,0 +1,219 @@
+"""nos-tpu-fleet — the serving-fleet autoscaler (ISSUE 8).
+
+Hosts ``fleet.FleetController``: scrapes each ``nos-tpu-server``
+replica's ``/stats`` (goodput, queue depth + oldest wait, TTFT p99,
+uptime + config echo), runs the hysteresis-damped scaling policy, and
+actuates through the operator plane — scale-up creates replica pods
+whose chip requests flow through ElasticQuota (borrowing slack when it
+exists, clamped when it does not), scale-down drains a replica
+gracefully (POST /admin/drain flips its readiness, in-flight requests
+finish, then the pod is released).
+
+Replica pods are found by the ``nos.ai/fleet=<name>`` label in
+``--namespace``; their /stats endpoints are reached through
+``--replica-url-template``. The default addresses replicas by POD IP
+(``{ip}`` = status.podIP): no Service required, and a draining replica
+— gone from Service endpoints the moment its readiness flips — stays
+reachable, so the controller can observe "in-flight work finished"
+instead of waiting out the drain budget. ``{name}``/``{namespace}``
+placeholders remain for DNS-fronted setups.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import logging
+import urllib.request
+from typing import Optional, Sequence
+
+from nos_tpu.cmd import serve
+from nos_tpu.fleet import FleetConfig, FleetController, PolicyConfig
+from nos_tpu.kube.controller import Manager
+from nos_tpu.kube.leaderelection import LeaderElectionConfig
+
+logger = logging.getLogger(__name__)
+
+
+class HttpReplicaClient:
+    """/stats scraper + drain trigger over the replica's own HTTP
+    surface. One failure returns None (the controller reads an
+    unscrapable replica as a signal, not an error).
+
+    The default template addresses replicas by POD IP (``{ip}`` =
+    ``status.podIP``): it needs no Service, resolves on any flat pod
+    network, and — critically for the drain sequence — keeps working
+    after ``/admin/drain`` flips readiness, when the pod drops out of
+    Service endpoints/DNS but keeps its IP. ``{name}``/``{namespace}``
+    remain available for DNS-fronted setups (a headless Service with
+    ``publishNotReadyAddresses: true``)."""
+
+    def __init__(self, url_template: str, timeout_s: float = 2.0):
+        self.url_template = url_template
+        self.timeout_s = timeout_s
+
+    def _url(self, pod) -> Optional[str]:
+        ip = pod.status.pod_ip
+        if "{ip}" in self.url_template and not ip:
+            return None         # not started yet: nothing to reach
+        return self.url_template.format(
+            name=pod.metadata.name, namespace=pod.metadata.namespace,
+            ip=ip)
+
+    def stats(self, pod) -> Optional[dict]:
+        url = self._url(pod)
+        if url is None:
+            return None
+        try:
+            with urllib.request.urlopen(
+                    url + "/stats", timeout=self.timeout_s) as r:
+                return json.loads(r.read())
+        except Exception:   # noqa: BLE001 — unreachable is a signal
+            return None
+
+    def drain(self, pod) -> None:
+        url = self._url(pod)
+        if url is None:
+            return              # deletion's SIGTERM path still drains
+        req = urllib.request.Request(
+            url + "/admin/drain", data=b"{}",
+            headers={"Content-Type": "application/json"}, method="POST")
+        with urllib.request.urlopen(req, timeout=self.timeout_s):
+            pass
+
+
+def build(server, cfg: FleetConfig, stats_source=None, drain_hook=None,
+          leader_election: bool = True,
+          identity: str = "fleet-0") -> Manager:
+    election = None
+    if leader_election:
+        election = LeaderElectionConfig(
+            lease_name=f"nos-tpu-fleet-{cfg.name}-leader",
+            identity=identity)
+    mgr = Manager(server, leader_election=election)
+    ctl = FleetController(cfg, stats_source=stats_source,
+                          drain_hook=drain_hook)
+    mgr.add_controller(ctl.controller())
+    mgr.stats = ctl.stats           # HealthServer /stats route
+    return mgr
+
+
+def main(argv: Optional[Sequence[str]] = None) -> None:
+    parser = argparse.ArgumentParser(prog="nos-tpu-fleet",
+                                     description=__doc__)
+    serve.common_flags(parser, config=False)
+    parser.add_argument("--fleet", default="default",
+                        help="fleet name (the nos.ai/fleet label value)")
+    parser.add_argument("--namespace", default="serving",
+                        help="namespace the replica pods live in (the "
+                             "namespace whose ElasticQuota governs them)")
+    parser.add_argument(
+        "--chips-per-replica", type=float, default=4.0,
+        help="chips each replica pod requests (flows through "
+             "ElasticQuota admission)")
+    parser.add_argument(
+        "--resource", default="google.com/tpu",
+        help="resource name each replica requests (a sub-slice "
+             "resource like nos.ai/tpu-slice-2x2 for partitioned hosts)")
+    parser.add_argument("--min-replicas", type=int, default=1)
+    parser.add_argument("--max-replicas", type=int, default=8)
+    parser.add_argument(
+        "--interval", type=float, default=5.0,
+        help="seconds between reconcile/scrape passes")
+    parser.add_argument(
+        "--queue-high", type=float, default=4.0,
+        help="pending requests per ready replica above which sustained "
+             "pressure scales up")
+    parser.add_argument(
+        "--queue-low", type=float, default=0.5,
+        help="pending per replica below which a healthy fleet may "
+             "shrink (the gap to --queue-high is the hysteresis band)")
+    parser.add_argument(
+        "--goodput-floor", type=float, default=0.90,
+        help="goodput below which the fleet scales up even without a "
+             "queue")
+    parser.add_argument(
+        "--goodput-ceiling", type=float, default=0.98,
+        help="goodput required before the fleet may scale down")
+    parser.add_argument(
+        "--ttft-p99-high-ms", type=float, default=0.0,
+        help="worst-replica TTFT p99 above which the fleet scales up "
+             "(0 = disabled)")
+    parser.add_argument(
+        "--oldest-wait-high-s", type=float, default=0.0,
+        help="oldest queued-request wait above which the fleet scales "
+             "up (0 = disabled)")
+    parser.add_argument(
+        "--up-stable", type=float, default=15.0,
+        help="seconds pressure must hold before a scale-up step")
+    parser.add_argument(
+        "--down-stable", type=float, default=60.0,
+        help="seconds of idleness before a scale-down step")
+    parser.add_argument(
+        "--up-cooldown", type=float, default=30.0,
+        help="minimum seconds between scale-up steps")
+    parser.add_argument(
+        "--down-cooldown", type=float, default=120.0,
+        help="minimum seconds between scale-down steps")
+    parser.add_argument("--max-step-up", type=int, default=2)
+    parser.add_argument("--max-step-down", type=int, default=1)
+    parser.add_argument(
+        "--drain-timeout", type=float, default=60.0,
+        help="seconds a draining replica may finish in-flight work "
+             "before the pod is released anyway")
+    parser.add_argument(
+        "--replica-priority", type=int, default=0,
+        help="pod priority for replica pods (preemption victim order)")
+    parser.add_argument(
+        "--replica-url-template",
+        default="http://{ip}:8000",
+        help="how to reach a replica pod's HTTP surface; {ip} "
+             "(status.podIP — works without a Service and survives the "
+             "drain readiness flip), {name} and {namespace} are "
+             "substituted")
+    parser.add_argument(
+        "--scrape-timeout", type=float, default=2.0,
+        help="per-replica /stats scrape timeout in seconds")
+    parser.add_argument(
+        "--identity", default="fleet-0",
+        help="leader-election identity (pod name in-cluster)")
+    parser.add_argument(
+        "--no-leader-election", action="store_true",
+        help="single-replica deployments may skip the Lease")
+    args = parser.parse_args(argv)
+
+    serve.setup_observability(args)
+    cfg = FleetConfig(
+        name=args.fleet, namespace=args.namespace,
+        resource=args.resource,
+        chips_per_replica=args.chips_per_replica,
+        policy=PolicyConfig(
+            min_replicas=args.min_replicas,
+            max_replicas=args.max_replicas,
+            queue_high=args.queue_high, queue_low=args.queue_low,
+            goodput_floor=args.goodput_floor,
+            goodput_ceiling=args.goodput_ceiling,
+            ttft_p99_high_s=args.ttft_p99_high_ms / 1e3,
+            oldest_wait_high_s=args.oldest_wait_high_s,
+            up_stable_s=args.up_stable, down_stable_s=args.down_stable,
+            up_cooldown_s=args.up_cooldown,
+            down_cooldown_s=args.down_cooldown,
+            max_step_up=args.max_step_up,
+            max_step_down=args.max_step_down,
+        ),
+        reconcile_interval_s=args.interval,
+        drain_timeout_s=args.drain_timeout,
+        priority=args.replica_priority,
+    )
+    replica = HttpReplicaClient(args.replica_url_template,
+                                timeout_s=args.scrape_timeout)
+    mgr = build(
+        serve.connect(args), cfg,
+        stats_source=replica.stats, drain_hook=replica.drain,
+        leader_election=not args.no_leader_election,
+        identity=args.identity,
+    )
+    serve.run_daemon(mgr, args.health_port, args.health_host)
+
+
+if __name__ == "__main__":
+    main()

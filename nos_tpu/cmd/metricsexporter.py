@@ -22,6 +22,64 @@ from nos_tpu.cmd import serve
 from nos_tpu.kube.client import Client
 
 
+def _quota_slack(client: Client) -> dict:
+    """Per-namespace ElasticQuota slack in CHIPS, from the quota
+    aggregates (quota/info.py) over the objects' reported status:
+
+    - ``borrowable``: the namespace's own unused min — capacity other
+      namespaces may borrow FROM it right now (Σ over its quota's
+      resources of max(0, min - used), chips-converted);
+    - ``guaranteed_overquota``: the namespace's fair share of the
+      cluster-wide borrowable pool (``guaranteed_overquotas`` — the
+      floor preemption protects when several namespaces borrow at
+      once).
+
+    Exported as nos_tpu_quota_borrowable_chips{namespace} /
+    nos_tpu_quota_guaranteed_overquota_chips{namespace} and mirrored
+    into the JSON snapshot — the capacity-review view of "who could
+    lend, who is owed" that the fleet controller's scale decisions act
+    on. One series per QUOTA: a CompositeElasticQuota spanning several
+    namespaces exports a single series labeled with the sorted member
+    list joined by "," — per-member rows would each carry the full
+    slack and any sum() over the gauge would over-count the pool."""
+    from nos_tpu.fleet.quota import build_quota_infos
+    from nos_tpu.tpu.slice import resource_chips
+    from nos_tpu.utils.metrics import default_registry
+
+    infos = build_quota_infos(client, recompute_used=False)
+    reg = default_registry()
+    g_borrow = reg.gauge(
+        "nos_tpu_quota_borrowable_chips",
+        "Chips of this namespace's ElasticQuota min currently unused — "
+        "the slack other namespaces may borrow from it (composite "
+        "quotas export one series labeled with their joined member "
+        "namespaces, so sum() reads the true pool)",
+        ("namespace",))
+    g_guaranteed = reg.gauge(
+        "nos_tpu_quota_guaranteed_overquota_chips",
+        "Chips of the cluster-wide borrowable pool guaranteed to this "
+        "namespace (its proportional share of aggregated overquotas — "
+        "the floor quota preemption protects)",
+        ("namespace",))
+    out = {}
+    seen = set()
+    for ns in sorted(infos):
+        info = infos[ns]
+        if id(info) in seen:
+            continue                    # composite: export ONCE
+        seen.add(id(info))
+        label = ",".join(sorted(info.namespaces)) or ns
+        unused = {r: max(0.0, m - info.used.get(r, 0))
+                  for r, m in info.min.items()}
+        borrowable = resource_chips(unused)
+        guaranteed = resource_chips(infos.guaranteed_overquotas(ns))
+        g_borrow.labels(label).set(borrowable)
+        g_guaranteed.labels(label).set(guaranteed)
+        out[label] = {"borrowable_chips": borrowable,
+                      "guaranteed_overquota_chips": guaranteed}
+    return out
+
+
 def collect(client: Client) -> dict:
     from nos_tpu.tpu.slice import resource_chips
 
@@ -78,6 +136,7 @@ def collect(client: Client) -> dict:
         "nodes": nodes,
         "elastic_quotas": quotas,
         "composite_elastic_quotas": composite,
+        "quota_slack": _quota_slack(client),
         "pod_count": len(pods),
         "tpu_pod_count": sum(
             1 for p in pods

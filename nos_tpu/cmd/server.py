@@ -6,6 +6,9 @@ minimal HTTP API.
                          "temperature": T?, "top_k": K?, "top_p": P?,
                          "seed": S?}
                         -> {"tokens": [full sequence]}
+    POST /admin/drain   begin graceful drain (stop admitting, flip
+                        /readyz; the fleet controller's scale-down hook)
+    POST /admin/undrain revert a drain (resume admitting)
     GET  /healthz       -> ok          GET /readyz  -> ok | draining
     GET  /metrics       Prometheus text (OpenMetrics + exemplars when
                         Accept asks for it)
@@ -273,7 +276,8 @@ class ServingLoop:
                  restart_backoff_s: float = 0.5,
                  restart_backoff_max_s: float = 10.0,
                  watchdog_s: float = 0.0,
-                 default_deadline_s: float = 0.0, seed: int = 0):
+                 default_deadline_s: float = 0.0, seed: int = 0,
+                 config_echo: Optional[dict] = None):
         reg = default_registry()
         # register() is idempotent per (name, type, labels) and raises on
         # a mismatched re-registration — exactly what we want at startup
@@ -424,6 +428,14 @@ class ServingLoop:
                 "validated and starts the failure path)")
             self.m_watchdog.inc(0)
         self.engine = engine
+        # /stats restart + drift detectors for the fleet controller: a
+        # scrape whose uptime went BACKWARDS means the process (not
+        # just the engine) restarted between scrapes — its empty rates
+        # are a fresh ledger, not collapsed load — and the config echo
+        # lets the controller spot a replica running drifted knobs
+        # without shelling into the pod
+        self._started = time.monotonic()
+        self._config_echo = dict(config_echo) if config_echo else None
         self._slo_ttft_s = (slo_ttft_ms or 0.0) / 1e3
         self._slo_tpot_s = (slo_tpot_ms or 0.0) / 1e3
         self._goodput_done = 0
@@ -436,6 +448,10 @@ class ServingLoop:
         self._rates: deque = deque()
         self._tokens_cum = 0
         self._finished_cum = 0
+        # rolling TTFT samples over recent completions: /stats serves
+        # the p99 the fleet controller's latency trigger reads (the
+        # histogram buckets can't answer a percentile cheaply in-process)
+        self._ttfts: deque = deque(maxlen=256)
         self._dev_interval = device_stats_interval_s or 0.0
         self._dev_next = 0.0
         self._lock = threading.Lock()
@@ -519,6 +535,15 @@ class ServingLoop:
             self._draining = True
             self._work.notify_all()
 
+    def cancel_drain(self) -> None:
+        """Resume admitting after a drain that is NOT followed by
+        termination (an operator reverting a mistaken or unwanted
+        POST /admin/drain — the drain endpoint shares the serving
+        port's trust domain, so reversibility is the recovery path)."""
+        with self._work:
+            self._draining = False
+            self._work.notify_all()
+
     def wait_idle(self, timeout: float) -> bool:
         """Block until the engine has no queued or decoding work (or
         ``timeout``/loop death). Returns True when fully drained."""
@@ -584,6 +609,8 @@ class ServingLoop:
             ttft = ledger.get("ttft_s")
             if ttft is not None:
                 self.h_ttft.observe(ttft, trace_id=tid)
+                if outcome == "finished":
+                    self._ttfts.append(ttft)
             for gap, n in ledger.get("tpot") or ():
                 # one weighted observe per arrival: n tokens sharing the
                 # arrival gap must not pay n bucket walks under the lock
@@ -723,6 +750,11 @@ class ServingLoop:
                     active, pending = occupancy()
                     snap["active_slots"] = active
                     snap["pending"] = {"depth": pending}
+            elif "active_slots" not in snap:
+                # normalize: the engine reports a per-slot LIST; scrape
+                # consumers (the fleet controller's drain-idle check)
+                # need the count under one key whatever the engine
+                snap["active_slots"] = len(snap["slots"])
             # rates age against NOW, not the last mark: marks are only
             # appended on ticks/completions, so an idle server's window
             # must decay to zero here rather than freeze at the last
@@ -746,6 +778,17 @@ class ServingLoop:
                 "healthy": self.healthy,
                 "draining": self._draining,
                 "recovering": self._recovering,
+                "uptime_s": round(now - self._started, 3),
+                "config": self._config_echo or {},
+                "per_request": {
+                    "window": len(self._ttfts),
+                    "ttft_p99_s": (
+                        round(sorted(self._ttfts)[
+                            min(len(self._ttfts) - 1,
+                                math.ceil(0.99 * len(self._ttfts)) - 1)],
+                            6)
+                        if self._ttfts else None),
+                },
                 "supervisor": (
                     dict(self._sup.stats(),
                          watchdog_s=self._watchdog_s)
@@ -1798,6 +1841,23 @@ def make_http_server(cfg: ServerConfig, loop: ServingLoop
                 gen.close()
 
         def do_POST(self):
+            if self.path == "/admin/drain":
+                # the fleet controller's graceful scale-down hook:
+                # stop admitting (readyz flips to draining, the
+                # Service pulls the endpoint), let in-flight requests
+                # finish; the pod is deleted once /stats reports no
+                # work (or the controller's drain budget expires and
+                # deletion's SIGTERM path owns the tail). Shares the
+                # serving port's trust domain (no auth, like the rest
+                # of this surface) — hence reversible via
+                # /admin/undrain rather than a one-way latch.
+                loop.begin_drain()
+                self._reply(200, {"status": "draining"})
+                return
+            if self.path == "/admin/undrain":
+                loop.cancel_drain()
+                self._reply(200, {"status": "ok"})
+                return
             if self.path != "/v1/generate":
                 self._reply(404, {"error": f"unknown path {self.path}"})
                 return
@@ -1854,18 +1914,24 @@ def make_http_server(cfg: ServerConfig, loop: ServingLoop
                 # than the whole pool) — 400 with no Retry-After, so
                 # clients fix the request instead of hammering it
                 self._reply(400, {"error": f"{type(e).__name__}: {e}",
-                                  "infeasible": True})
+                                  "infeasible": True,
+                                  "reason": e.reason})
                 return
             except (KeyError, ValueError, TypeError) as e:
-                self._reply(400, {"error": f"{type(e).__name__}: {e}"})
+                self._reply(400, {"error": f"{type(e).__name__}: {e}",
+                                  "reason": "bad_request"})
                 return
             except QueueFull as e:
-                # transient: out of capacity RIGHT NOW (pending queue,
-                # KV block pool, or — DeadlineUnmeetable — the rolling
-                # latency estimates say the deadline cannot be met, so
-                # the slot is shed early) — 429 + Retry-After says
-                # come back
-                self._reply(429, {"error": str(e)},
+                # transient: out of capacity RIGHT NOW — 429 +
+                # Retry-After says come back. ``reason`` splits the
+                # shed causes machine-readably (queue_full = slots or
+                # the waiting line; hbm_admission = free slots but the
+                # KV pool / HBM headroom blocks admission;
+                # deadline_unmeetable = the rolling latency estimates
+                # say the client's deadline cannot be met): the fleet
+                # controller scales on capacity pressure, not on
+                # deadline pressure, and must tell them apart
+                self._reply(429, {"error": str(e), "reason": e.reason},
                             headers=[("Retry-After", "1")])
                 return
             except DeadlineExceeded as e:
@@ -2026,7 +2092,18 @@ def main(argv: Optional[Sequence[str]] = None) -> None:
         restart_backoff_s=cfg.restart_backoff_s,
         restart_backoff_max_s=cfg.restart_backoff_max_s,
         watchdog_s=cfg.watchdog_s,
-        default_deadline_s=cfg.default_deadline_s, seed=cfg.seed)
+        default_deadline_s=cfg.default_deadline_s, seed=cfg.seed,
+        # /stats config echo: what the fleet controller compares across
+        # replicas to catch config drift between scrapes
+        config_echo={
+            "max_batch": cfg.max_batch,
+            "pipeline_depth": cfg.pipeline_depth,
+            "decode_steps": cfg.decode_steps,
+            "kv_block_size": cfg.kv_block_size,
+            "kv_blocks": cfg.kv_blocks,
+            "kv_swap": cfg.kv_swap,
+            "max_seq": cfg.max_seq,
+        })
     httpd = make_http_server(cfg, loop)
 
     def _finish_drain():

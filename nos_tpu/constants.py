@@ -163,6 +163,18 @@ ANNOTATION_TRACE_CONTEXT = "nos-tpu/trace-context"
 TAINT_UNREACHABLE = "node.kubernetes.io/unreachable"
 TAINT_MAINTENANCE = DOMAIN + "/maintenance"
 
+# ---------------------------------------------------------------------------
+# Serving-fleet autoscaler (nos_tpu/fleet/)
+# ---------------------------------------------------------------------------
+# Replica pods of one autoscaled serving fleet carry nos.ai/fleet=<name>;
+# the fleet controller only ever creates, drains and deletes pods bearing
+# its own fleet label.
+LABEL_FLEET = DOMAIN + "/fleet"
+# Stamped by the fleet controller when a replica is selected for graceful
+# scale-down: the replica stops admitting (readiness flips), in-flight
+# requests finish (or the drain budget expires), then the pod is deleted.
+ANNOTATION_FLEET_DRAIN = DOMAIN + "/fleet-drain"
+
 # Scheduler / controller names
 SCHEDULER_NAME = "nos-scheduler"
 DEVICE_PLUGIN_CONFIGMAP = "nos-device-plugin-config"

@@ -1,0 +1,30 @@
+"""Serving-fleet autoscaler (ISSUE 8): the controller that closes the
+loop from the serving plane's SLO signals (goodput, queue depth, TTFT —
+the ``/stats`` surface PR 5 built) back into the operator plane (pods
+through ElasticQuota, gang scheduling, graceful drains).
+
+- ``policy``     — the hysteresis-damped scaling policy (pure, clock-
+                   injected, deterministic: what the property tests and
+                   ``bench_autoscale.py`` drive with a FakeClock);
+- ``controller`` — the HPA-analog reconciler actuating the policy's
+                   decisions as replica pods whose chip requests flow
+                   through ElasticQuota;
+- ``quota``      — chip-slack accounting over ElasticQuota objects
+                   (what may be borrowed, what a guaranteed namespace
+                   can reclaim);
+- ``sim``        — a deterministic discrete-time serving-fleet model
+                   (replicas, queues, SLO judging) for benches and
+                   integration tests.
+"""
+from nos_tpu.fleet.controller import FleetConfig, FleetController
+from nos_tpu.fleet.policy import (
+    Decision, FleetSignals, PolicyConfig, ReplicaStats, ScalingPolicy,
+    parse_replica_stats,
+)
+from nos_tpu.fleet.quota import QuotaView, build_quota_infos
+
+__all__ = [
+    "Decision", "FleetConfig", "FleetController", "FleetSignals",
+    "PolicyConfig", "QuotaView", "ReplicaStats", "ScalingPolicy",
+    "build_quota_infos", "parse_replica_stats",
+]

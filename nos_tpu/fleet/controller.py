@@ -1,0 +1,552 @@
+"""Serving-fleet controller: the HPA-analog reconciler that closes the
+loop from serving SLO signals into the operator plane (ISSUE 8
+tentpole).
+
+Every reconcile pass (one ``fleet.reconcile`` span):
+
+1. **observe** — list the fleet's replica pods (``nos.ai/fleet=<name>``
+   in the fleet namespace), scrape each live replica's ``/stats``
+   (goodput ratio, pending depth + oldest wait, TTFT p99, ``uptime_s``
+   + config echo) through an injectable ``stats_source`` — HTTP against
+   real pods (cmd/fleet.py), a simulator in benches/tests. A replica
+   whose uptime regressed since the last scrape RESTARTED between
+   scrapes: its empty rates are excluded from the SLO aggregates (fresh
+   silence is not collapsed load), and a replica echoing config that
+   differs from the fleet's reference is flagged as drifted.
+2. **decide** — run the hysteresis-damped ``ScalingPolicy``
+   (fleet/policy.py): target bands + stability windows + cooldowns +
+   step limits, all on the injected clock.
+3. **clamp** — re-derive the ElasticQuota aggregates (fleet/quota.py)
+   and cap scale-up at the chips quota admission would actually grant:
+   own unused min first, then borrowable cluster slack
+   (``aggregated_overquotas`` semantics), minus chips of replicas
+   already created but not yet accounted. When a GUARANTEED namespace
+   is starved while this fleet holds borrowed chips, the controller
+   sheds borrowed replicas gracefully (the scheduler's preemption
+   would otherwise evict them mid-request).
+4. **actuate** — scale-up creates replica pods (chip requests, the nos
+   scheduler name) that flow through quota admission + gang binding
+   like any workload pod; scale-down picks victims (borrowed/over-quota
+   first, then youngest), marks them draining
+   (``nos.ai/fleet-drain``), tells the replica to stop admitting (the
+   PR 7 readiness path via ``drain_hook``), waits for in-flight work to
+   finish (or the drain budget), then deletes the pod — the same
+   delete-and-let-the-scheduler-converge discipline the lifecycle
+   controller's eviction machinery uses.
+
+Scaling EPISODES are traced: the first actuation after steady state
+opens a ``fleet.episode`` root span; every ``fleet.scale_up`` /
+``fleet.drain`` / ``fleet.release`` action is parented into it; the
+episode closes when ready replicas match desired and no drain is in
+flight — so one trace holds a whole "flash crowd arrived, fleet grew
+2->5, then shrank back" story.
+"""
+from __future__ import annotations
+
+import logging
+import time
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional
+
+from nos_tpu import constants
+from nos_tpu.fleet.policy import (
+    Decision, FleetSignals, PolicyConfig, ReplicaStats, ScalingPolicy,
+    parse_replica_stats,
+)
+from nos_tpu.fleet.quota import QuotaView, build_quota_infos
+from nos_tpu.kube.apiserver import NotFound
+from nos_tpu.kube.client import Client
+from nos_tpu.kube.controller import Controller, Request, Result, Watch
+from nos_tpu.kube.objects import (
+    Container, ObjectMeta, Pod, PodCondition, PodSpec, PodStatus,
+)
+from nos_tpu.obs import tracing
+from nos_tpu.tpu.resource_calc import ResourceCalculator
+from nos_tpu.utils.metrics import default_registry
+
+logger = logging.getLogger(__name__)
+
+__all__ = ["FleetConfig", "FleetController"]
+
+#: replica-pod states the gauges report
+REPLICA_STATES = ("desired", "ready", "starting", "draining")
+
+
+@dataclass
+class FleetConfig:
+    """One serving fleet (helm: ``fleet.*``)."""
+
+    name: str = "default"
+    namespace: str = "serving"
+    # chips each replica pod requests (flows through ElasticQuota; use
+    # a sub-slice resource for partitioned hosts)
+    resource: str = constants.RESOURCE_TPU
+    chips_per_replica: float = 4.0
+    policy: PolicyConfig = field(default_factory=PolicyConfig)
+    reconcile_interval_s: float = 5.0
+    # graceful-drain budget: a draining replica that still reports work
+    # past this is released anyway (its server's own SIGTERM drain and
+    # the supervisor's capture path own the tail)
+    drain_timeout_s: float = 60.0
+    # pod priority for replica pods (victim ordering under preemption)
+    priority: int = 0
+    image: str = "nos-tpu-server"
+
+
+class FleetController:
+    """Level-triggered fleet reconciler; see module docstring.
+
+    ``stats_source(pod) -> Optional[dict]`` returns a replica's /stats
+    snapshot (None = unreachable); ``drain_hook(pod)`` tells a replica
+    to stop admitting (POST /admin/drain over HTTP; a no-op default
+    keeps drains working purely through deletion's SIGTERM path).
+    ``clock`` paces cooldowns/stability windows AND drain budgets —
+    inject a FakeClock for determinism.
+    """
+
+    def __init__(self, cfg: FleetConfig,
+                 stats_source: Optional[Callable[[Pod], Optional[dict]]]
+                 = None,
+                 drain_hook: Optional[Callable[[Pod], None]] = None,
+                 calculator: Optional[ResourceCalculator] = None,
+                 clock: Callable[[], float] = time.monotonic):
+        self.cfg = cfg
+        self.policy = ScalingPolicy(cfg.policy)
+        self.stats_source = stats_source or (lambda pod: None)
+        self.drain_hook = drain_hook
+        self.calc = calculator or ResourceCalculator()
+        self.clock = clock
+        self._uptimes: Dict[str, float] = {}      # pod -> last uptime_s
+        self._drain_started: Dict[str, float] = {}
+        self._clamped = False       # quota clamp bound last pass (edge)
+        self._seq = 0
+        self._episode = None                      # open fleet.episode span
+        self._last: dict = {}                     # stats() snapshot
+        reg = default_registry()
+        self.g_replicas = reg.gauge(
+            "nos_tpu_fleet_replicas",
+            "Serving-fleet replica pods by state (desired = the "
+            "policy's current target after the quota clamp; ready = "
+            "Running and scrapable; starting = created but not serving "
+            "yet; draining = marked for graceful scale-down)",
+            ("state",))
+        self.m_scale = reg.counter(
+            "nos_tpu_fleet_scale_events_total",
+            "Fleet scaling actuations, by direction (up | down) and "
+            "reason (queue_depth | goodput | ttft_p99 | oldest_wait | "
+            "idle | min_replicas | no_ready_replicas | quota_reclaim; "
+            "quota_clamped marks an up-step cut short by ElasticQuota "
+            "slack)",
+            ("direction", "reason"))
+        self.h_reconcile = reg.histogram(
+            "nos_tpu_fleet_reconcile_seconds",
+            "Wall time of one fleet reconcile pass (scrape + decide + "
+            "actuate)")
+        self.g_slack = reg.gauge(
+            "nos_tpu_fleet_quota_slack_chips",
+            "Chips the fleet could still request before ElasticQuota "
+            "admission refuses them (own-max ceiling and the "
+            "cluster-wide aggregated-min ceiling, planned-but-unbound "
+            "replicas subtracted)")
+        self.g_drift = reg.gauge(
+            "nos_tpu_fleet_config_drift_replicas",
+            "Replicas whose /stats config echo differs from the "
+            "fleet's reference replica (a rollout in flight, or a pod "
+            "running drifted knobs)")
+
+    # -- pod inventory --------------------------------------------------
+    def _replica_pods(self, client: Client) -> List[Pod]:
+        return sorted(
+            client.list("Pod", namespace=self.cfg.namespace,
+                        label_selector={constants.LABEL_FLEET:
+                                        self.cfg.name}),
+            key=lambda p: (p.metadata.creation_timestamp,
+                           p.metadata.name))
+
+    def _new_replica(self) -> Pod:
+        self._seq += 1
+        name = f"{self.cfg.name}-r{self._seq}"
+        return Pod(
+            metadata=ObjectMeta(
+                name=name, namespace=self.cfg.namespace,
+                labels={
+                    constants.LABEL_FLEET: self.cfg.name,
+                    "app.kubernetes.io/component": "serving",
+                }),
+            spec=PodSpec(
+                containers=[Container(
+                    name="server", image=self.cfg.image,
+                    requests={self.cfg.resource:
+                              self.cfg.chips_per_replica})],
+                scheduler_name=constants.SCHEDULER_NAME,
+                priority=self.cfg.priority,
+            ),
+            status=PodStatus(
+                phase="Pending",
+                conditions=[PodCondition(
+                    type="PodScheduled", status="False",
+                    reason="Unschedulable")],
+            ))
+
+    # -- reconcile ------------------------------------------------------
+    def reconcile(self, client: Client, req: Request) -> Result:
+        t0 = time.monotonic()
+        with tracing.span("fleet.reconcile", component="fleet",
+                          attrs={"fleet": self.cfg.name}) as sp:
+            self._reconcile(client, sp)
+        self.h_reconcile.observe(time.monotonic() - t0)
+        return Result(requeue_after=self.cfg.reconcile_interval_s)
+
+    def _reconcile(self, client: Client, sp) -> None:
+        cfg = self.cfg
+        now = self.clock()
+        pods = self._replica_pods(client)
+        # re-seed the name counter from what exists: after a controller
+        # restart / leader failover _seq starts at 0 and regenerating a
+        # live pod's name would abort the pass on AlreadyExists
+        for p in pods:
+            _, _, suffix = p.metadata.name.rpartition("-r")
+            if suffix.isdigit():
+                self._seq = max(self._seq, int(suffix))
+        # prune per-pod state for replicas that left OUTSIDE our own
+        # delete path (scheduler preemption of an over-quota replica,
+        # node eviction, kubectl delete) — names are never reused, so
+        # without this the dicts grow for the daemon's lifetime
+        live_names = {p.metadata.name for p in pods}
+        for d in (self._uptimes, self._drain_started):
+            for name in list(d):
+                if name not in live_names:
+                    del d[name]
+        drain_names = {p.metadata.name for p in pods
+                       if p.metadata.annotations.get(
+                           constants.ANNOTATION_FLEET_DRAIN)}
+        steering = [p for p in pods
+                    if p.metadata.name not in drain_names]
+
+        # scrape every live replica; classify
+        replicas: List[ReplicaStats] = []
+        ready_pods: Dict[str, Pod] = {}
+        starting = 0
+        for p in steering:
+            if p.status.phase != "Running":
+                starting += 1
+                continue
+            name = p.metadata.name
+            snap = self._scrape(p)
+            st = parse_replica_stats(name, snap,
+                                     self._uptimes.get(name))
+            if st.uptime_s is not None:
+                self._uptimes[name] = st.uptime_s
+            replicas.append(st)
+            if st.ready:
+                ready_pods[name] = p
+        drift = self._config_drift(replicas)
+        self.g_drift.set(drift)
+
+        signals = FleetSignals.aggregate(
+            replicas, total_replicas=len(steering))
+        current = len(steering)
+        decision = self.policy.decide(signals, current, now)
+        desired = decision.desired
+
+        # quota clamp: chips the scheduler would actually admit
+        view = QuotaView(build_quota_infos(client, self.calc),
+                         cfg.namespace)
+        planned_chips = sum(
+            self.calc.compute_pod_request(p).get(cfg.resource, 0.0)
+            for p in steering
+            if p.status.phase != "Running" and not p.is_scheduled())
+        headroom = view.headroom(cfg.resource,
+                                 {cfg.resource: planned_chips})
+        if headroom != float("inf"):
+            self.g_slack.set(headroom)
+        quota_clamped = False
+        if desired > current and cfg.chips_per_replica > 0 \
+                and headroom != float("inf"):
+            affordable = current + int(headroom // cfg.chips_per_replica)
+            if affordable < desired:
+                quota_clamped = True
+                desired = max(current, affordable)
+                if desired == current and not self._clamped:
+                    # the clamp swallowed the WHOLE step: no actuation
+                    # branch below will run, but the operator still
+                    # needs the "why isn't it growing" event — emitted
+                    # on the transition into fully-clamped, not every
+                    # starved pass
+                    self.m_scale.labels("up", "quota_clamped").inc()
+                    logger.info(
+                        "fleet %s: scale up (%s) fully clamped by "
+                        "quota slack (%.1f chips headroom)", cfg.name,
+                        decision.reason, headroom)
+        self._clamped = quota_clamped and desired == current
+
+        # guaranteed reclaim: shed borrowed replicas gracefully when a
+        # guaranteed namespace is starved and we are over our min
+        reclaim_sheds = 0
+        over_min = view.over_min(cfg.resource)
+        if over_min > 0 and desired >= current:
+            pressure = view.reclaim_pressure(client, cfg.resource,
+                                             self.calc)
+            if pressure > 0 and cfg.chips_per_replica > 0:
+                owed = min(over_min, pressure)
+                reclaim_sheds = min(
+                    int(-(-owed // cfg.chips_per_replica)),   # ceil
+                    current - cfg.policy.min_replicas)
+                if reclaim_sheds > 0:
+                    desired = current - reclaim_sheds
+
+        sp.set_attr("current", current)
+        sp.set_attr("desired", desired)
+        sp.set_attr("reason", decision.reason)
+
+        # -- actuate ----------------------------------------------------
+        if desired > current:
+            reason = decision.reason
+            self._open_episode("up", reason, current, desired)
+            for _ in range(desired - current):
+                pod = self._new_replica()
+                with tracing.span("fleet.scale_up", component="fleet",
+                                  parent=self._episode,
+                                  attrs={"pod": pod.metadata.name,
+                                         "reason": reason}):
+                    client.create(pod)
+            self.m_scale.labels(
+                "up", "quota_clamped" if quota_clamped else reason).inc()
+            logger.info("fleet %s: scale up %d -> %d (%s%s)", cfg.name,
+                        current, desired, reason,
+                        ", quota_clamped" if quota_clamped else "")
+        elif desired < current:
+            reason = ("quota_reclaim" if reclaim_sheds
+                      else decision.reason)
+            self._open_episode("down", reason, current, desired)
+            victims = self._pick_victims(
+                steering, current - desired,
+                borrowed_first=bool(reclaim_sheds))
+            for victim in victims:
+                self._begin_drain(client, victim, reason, now)
+            self.m_scale.labels("down", reason).inc()
+            logger.info("fleet %s: scale down %d -> %d (%s)", cfg.name,
+                        current, desired, reason)
+
+        # advance drains already in flight (and the ones just marked):
+        # ONE re-list covers the pods/annotations this pass changed,
+        # and everything downstream derives from it
+        pods_now = self._replica_pods(client)
+        released = self._advance_drains(client, now, pods_now)
+        n_draining = sum(
+            1 for p in pods_now
+            if p.metadata.annotations.get(constants.ANNOTATION_FLEET_DRAIN)
+            and p.metadata.name not in released)
+        self.g_replicas.labels("desired").set(desired)
+        self.g_replicas.labels("ready").set(len(ready_pods))
+        self.g_replicas.labels("starting").set(starting)
+        self.g_replicas.labels("draining").set(n_draining)
+        self._last = {
+            "fleet": cfg.name,
+            "namespace": cfg.namespace,
+            "replicas": {
+                "desired": desired, "ready": len(ready_pods),
+                "starting": starting, "draining": n_draining,
+            },
+            "signals": {
+                "pending_total": signals.pending_total,
+                "pending_per_replica": round(
+                    signals.pending_per_replica, 3),
+                "goodput": signals.goodput,
+                "ttft_p99_s": signals.ttft_p99_s,
+                "oldest_wait_s": signals.oldest_wait_s,
+                "restarted_replicas": signals.restarted_replicas,
+            },
+            "decision": {"direction": decision.direction,
+                         "reason": decision.reason},
+            "quota": {
+                "slack_chips": (headroom if headroom != float("inf")
+                                else None),
+                "over_min_chips": over_min,
+            },
+            "config_drift_replicas": drift,
+        }
+        self._maybe_close_episode(desired, len(ready_pods),
+                                  drains=n_draining > 0)
+
+    # -- scrape helpers -------------------------------------------------
+    def _scrape(self, pod: Pod) -> Optional[dict]:
+        try:
+            return self.stats_source(pod)
+        except Exception:       # noqa: BLE001 — an unscrapable replica
+            return None         # is a signal, never a crashed reconcile
+
+    def _config_drift(self, replicas: List[ReplicaStats]) -> int:
+        """Replicas whose /stats config echo differs from the fleet's
+        MODAL echo this pass. The reference is recomputed every scrape
+        (deterministic tie-break), so a completed fleet-wide rollout
+        reads as zero drift again — a fixed first-seen reference would
+        report N forever after any intentional config change."""
+        import json as _json
+
+        keys = [_json.dumps(r.config, sort_keys=True)
+                for r in replicas if r.config]
+        if not keys:
+            return 0
+        counts: Dict[str, int] = {}
+        for k in keys:
+            counts[k] = counts.get(k, 0) + 1
+        ref = max(sorted(counts), key=lambda k: counts[k])
+        return sum(1 for k in keys if k != ref)
+
+    # -- drain machinery ------------------------------------------------
+    def _pick_victims(self, steering: List[Pod], n: int,
+                      borrowed_first: bool) -> List[Pod]:
+        """Scale-down victim order: not-yet-Running pods first (free to
+        cancel — nothing is in flight on them), then over-quota
+        (borrowed) replicas, then youngest. ``borrowed_first`` (the
+        reclaim path) prefers replicas the quota reconciler has labeled
+        over-quota; when labeling lags a reconciler pass it falls back
+        to youngest — the shed COUNT is already bounded by the chips
+        held beyond min, so guaranteed capacity is preserved either
+        way, only the specific pod choice degrades."""
+        from nos_tpu.utils.pod import is_over_quota
+
+        unstarted = [p for p in steering if p.status.phase != "Running"]
+        running = [p for p in steering if p.status.phase == "Running"]
+        pool = sorted(
+            running,
+            key=lambda p: (not is_over_quota(p),
+                           -p.metadata.creation_timestamp,
+                           p.metadata.name))
+        if borrowed_first:
+            pool = [p for p in pool if is_over_quota(p)] or pool
+        return (list(reversed(unstarted)) + pool)[:n]
+
+    def _begin_drain(self, client: Client, pod: Pod, reason: str,
+                     now: float) -> None:
+        """Stop the replica admitting (readiness flips, the Service
+        pulls the endpoint) and mark it draining; the pod is released
+        in _advance_drains once idle or past the budget."""
+        name = pod.metadata.name
+        if pod.status.phase != "Running":
+            # never served: cancel outright (a Pending pod holds no
+            # in-flight requests; deleting it un-asks the scheduler)
+            with tracing.span("fleet.release", component="fleet",
+                              parent=self._episode,
+                              attrs={"pod": name, "reason": reason,
+                                     "unstarted": True}):
+                self._delete(client, pod)
+            return
+        with tracing.span("fleet.drain", component="fleet",
+                          parent=self._episode,
+                          attrs={"pod": name, "reason": reason}):
+            # durable record FIRST: if the replica stopped admitting
+            # (hook) before the annotation landed and the patch then
+            # failed, later passes would see an unannotated zombie —
+            # never drain-timed, never released, holding its chips.
+            # Annotate-then-hook fails safe in both orders of failure:
+            # a failed patch leaves the replica untouched (pass
+            # retries), a failed hook is covered by deletion's SIGTERM.
+            try:
+                client.patch(
+                    "Pod", name, pod.metadata.namespace,
+                    lambda p: p.metadata.annotations.update(
+                        {constants.ANNOTATION_FLEET_DRAIN: "scale-down"}))
+            except NotFound:
+                return
+            self._drain_started[name] = now
+            if self.drain_hook is not None:
+                try:
+                    self.drain_hook(pod)
+                except Exception:   # noqa: BLE001 — deletion's SIGTERM
+                    pass            # path still drains the replica
+
+    def _advance_drains(self, client: Client, now: float,
+                        pods: List[Pod]) -> set:
+        """Release every draining replica that has finished its
+        in-flight work — or exhausted the drain budget (its server's
+        SIGTERM drain and supervisor capture own the tail from there).
+        ``pods`` is the caller's fresh list (one LIST per pass, not one
+        per phase); returns the released pod names."""
+        released = set()
+        for pod in pods:
+            name = pod.metadata.name
+            if not pod.metadata.annotations.get(
+                    constants.ANNOTATION_FLEET_DRAIN):
+                continue
+            started = self._drain_started.setdefault(name, now)
+            snap = self._scrape(pod)
+            idle = False
+            if snap is not None:
+                pend = (snap.get("pending") or {}).get("depth", 0)
+                active = snap.get("active_slots")
+                if active is None:
+                    # engines report a per-slot list; a replica mid-
+                    # rollout may predate the normalized count key
+                    active = len(snap.get("slots") or ())
+                idle = not active and not pend
+            if idle or now - started >= self.cfg.drain_timeout_s:
+                with tracing.span(
+                        "fleet.release", component="fleet",
+                        parent=self._episode,
+                        attrs={"pod": name, "idle": idle,
+                               "drain_s": round(now - started, 3)}):
+                    self._delete(client, pod)
+                released.add(name)
+        return released
+
+    def _delete(self, client: Client, pod: Pod) -> None:
+        name = pod.metadata.name
+        try:
+            client.delete("Pod", name, pod.metadata.namespace)
+        except NotFound:
+            pass
+        self._drain_started.pop(name, None)
+        self._uptimes.pop(name, None)
+
+    # -- episode spans --------------------------------------------------
+    def _open_episode(self, direction: str, reason: str,
+                      current: int, desired: int) -> None:
+        if self._episode is None:
+            self._episode = tracing.start_span(
+                "fleet.episode", component="fleet",
+                attrs={"fleet": self.cfg.name})
+        if self._episode.recording:
+            self._episode.set_attr("direction", direction)
+            self._episode.set_attr("reason", reason)
+            self._episode.set_attr("from_replicas", current)
+            self._episode.set_attr("to_replicas", desired)
+
+    def _maybe_close_episode(self, desired: int, ready: int,
+                             drains: bool) -> None:
+        if self._episode is None:
+            return
+        if ready == desired and not drains:
+            self._episode.end()
+            self._episode = None
+
+    # -- plumbing -------------------------------------------------------
+    def stats(self) -> dict:
+        """Live snapshot for the HealthServer's /stats route."""
+        return dict(self._last)
+
+    def controller(self) -> Controller:
+        """Watches wake the reconciler on pod/quota churn; the
+        ``requeue_after`` in every Result keeps the periodic scrape
+        cadence even with no events."""
+        fleet_req = Request(name=self.cfg.name,
+                            namespace=self.cfg.namespace)
+
+        def to_fleet(_ev) -> List[Request]:
+            return [fleet_req]
+
+        ctl = Controller(
+            f"fleet/{self.cfg.name}",
+            self.reconcile,
+            [
+                Watch("Pod", mapper=to_fleet),
+                Watch("ElasticQuota", mapper=to_fleet),
+                Watch("CompositeElasticQuota", mapper=to_fleet),
+            ],
+        )
+        # self-seed: an empty cluster emits no initial-sync events, but
+        # the bootstrap reconcile (min_replicas) must still run — and
+        # its requeue_after keeps the cadence from there
+        ctl.enqueue(fleet_req)
+        return ctl

@@ -1,0 +1,309 @@
+"""Hysteresis-damped scaling policy for the serving fleet.
+
+The decision kernel the fleet controller runs every reconcile: pure
+host-side arithmetic over scraped ``/stats`` snapshots, with an injected
+clock, so tests and ``bench_autoscale.py`` drive it deterministically
+with a FakeClock and the property suite (tests/test_fleet_policy.py) can
+pin its damping guarantees:
+
+- **target bands, not setpoints** — scale-up pressure and scale-down
+  idleness use DIFFERENT thresholds (``queue_high`` vs ``queue_low``,
+  ``goodput_floor`` vs ``goodput_ceiling``); signals inside the dead
+  band between them accumulate no intent in either direction, so a
+  noisy stationary signal cannot flap the fleet;
+- **stability windows** — pressure (idleness) must hold CONTINUOUSLY
+  for ``up_stable_s`` (``down_stable_s``) before a step; one sample
+  back inside the band resets the timer;
+- **cooldowns** — after a step, the same direction is locked out for
+  ``up_cooldown_s`` / ``down_cooldown_s`` (and a direction FLIP always
+  waits out the stability window from zero), bounding oscillation even
+  against an adversarial signal;
+- **step limits** — one decision moves at most ``max_step_up`` /
+  ``max_step_down`` replicas (0 disables that direction entirely, the
+  HPA idiom for "never scale up/down automatically"), and never
+  outside [``min_replicas``, ``max_replicas``].
+
+The controller applies one more clamp AFTER this policy: ElasticQuota
+slack (fleet/quota.py) may cap a scale-up below the policy's ask, and a
+guaranteed namespace reclaiming borrowed chips may force a drain the
+policy did not request.
+"""
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass, field
+from typing import List, Optional
+
+__all__ = [
+    "Decision", "FleetSignals", "PolicyConfig", "ReplicaStats",
+    "ScalingPolicy", "parse_replica_stats",
+]
+
+
+@dataclass(frozen=True)
+class PolicyConfig:
+    """Scaling-policy knobs (helm: ``fleet.policy.*``)."""
+
+    min_replicas: int = 1
+    max_replicas: int = 8
+    # queue-pressure band: pending requests per READY replica. Sustained
+    # above queue_high -> scale up; below queue_low (with goodput
+    # healthy) -> scale down. The gap between them is the hysteresis
+    # dead band.
+    queue_high: float = 4.0
+    queue_low: float = 0.5
+    # goodput band (fraction of completed requests meeting every SLO,
+    # from the replicas' own ledgers). Below the floor -> pressure even
+    # with a short queue (slow replicas breach without queueing); the
+    # fleet only shrinks while goodput sits at/above the ceiling.
+    goodput_floor: float = 0.90
+    goodput_ceiling: float = 0.98
+    # optional latency triggers (0 = disabled): worst replica TTFT p99,
+    # oldest pending wait
+    ttft_p99_high_s: float = 0.0
+    oldest_wait_high_s: float = 0.0
+    # damping
+    up_stable_s: float = 15.0
+    down_stable_s: float = 60.0
+    up_cooldown_s: float = 30.0
+    down_cooldown_s: float = 120.0
+    max_step_up: int = 2
+    max_step_down: int = 1
+
+
+@dataclass
+class ReplicaStats:
+    """One replica's scraped ``/stats``, reduced to what the policy
+    consumes. ``uptime_s`` + the config echo are the restart/drift
+    detectors: a replica whose uptime went BACKWARDS since the last
+    scrape restarted between scrapes — its empty rates mean "fresh
+    process", not "collapsed load" — and one whose echoed config
+    differs from its peers is running drifted knobs."""
+
+    name: str
+    healthy: bool = True
+    ready: bool = True
+    uptime_s: Optional[float] = None
+    restarted: bool = False         # uptime regressed vs previous scrape
+    goodput: Optional[float] = None
+    completed: int = 0
+    pending_depth: int = 0
+    oldest_wait_s: float = 0.0
+    ttft_p99_s: Optional[float] = None
+    active_slots: int = 0
+    config: dict = field(default_factory=dict)
+
+
+def parse_replica_stats(name: str, snap: Optional[dict],
+                        prev_uptime_s: Optional[float] = None
+                        ) -> ReplicaStats:
+    """/stats JSON -> ReplicaStats (tolerant: a replica mid-rollout may
+    serve an older schema; absent fields read as quiet, not broken)."""
+    if not snap:
+        return ReplicaStats(name=name, healthy=False, ready=False)
+    pending = snap.get("pending") or {}
+    slo = snap.get("slo") or {}
+    per_req = snap.get("per_request") or {}
+    uptime = snap.get("uptime_s")
+    restarted = (uptime is not None and prev_uptime_s is not None
+                 and uptime < prev_uptime_s)
+    ttft = per_req.get("ttft_p99_s")
+    return ReplicaStats(
+        name=name,
+        healthy=bool(snap.get("healthy", True)),
+        ready=(bool(snap.get("healthy", True))
+               and not snap.get("draining") and not snap.get("recovering")),
+        uptime_s=uptime,
+        restarted=restarted,
+        goodput=slo.get("goodput"),
+        completed=int(slo.get("completed") or 0),
+        pending_depth=int(pending.get("depth") or 0),
+        oldest_wait_s=float(pending.get("oldest_wait_s") or 0.0),
+        ttft_p99_s=ttft,
+        active_slots=int(snap.get("active_slots") or 0),
+        config=dict(snap.get("config") or {}),
+    )
+
+
+@dataclass
+class FleetSignals:
+    """Aggregated fleet state for one decision."""
+
+    ready_replicas: int = 0
+    total_replicas: int = 0         # ready + starting/pending pods
+    pending_total: int = 0          # queued requests across replicas
+    pending_per_replica: float = 0.0
+    goodput: Optional[float] = None  # completion-weighted across replicas
+    ttft_p99_s: Optional[float] = None      # worst replica
+    oldest_wait_s: float = 0.0              # worst replica
+    restarted_replicas: int = 0
+
+    @classmethod
+    def aggregate(cls, replicas: List[ReplicaStats],
+                  total_replicas: Optional[int] = None) -> "FleetSignals":
+        """Fold per-replica scrapes into fleet signals. Freshly
+        RESTARTED replicas contribute their queue depth (real work) but
+        not their goodput/TTFT (an empty ledger is silence, not
+        health); replicas that could not be scraped contribute nothing.
+        QUEUE DEPTH counts every scraped replica, ready or not — a
+        fleet whose replicas are all recovering/draining still has real
+        queued work, and it must register as pressure (the
+        no_ready_replicas trigger) rather than silence."""
+        ready = [r for r in replicas if r.ready]
+        judged = [r for r in ready
+                  if not r.restarted and r.goodput is not None
+                  and r.completed > 0]
+        total_done = sum(r.completed for r in judged)
+        goodput = (sum(r.goodput * r.completed for r in judged)
+                   / total_done if total_done else None)
+        ttfts = [r.ttft_p99_s for r in ready
+                 if not r.restarted and r.ttft_p99_s is not None]
+        pending = sum(r.pending_depth for r in replicas)
+        return cls(
+            ready_replicas=len(ready),
+            total_replicas=(total_replicas if total_replicas is not None
+                            else len(replicas)),
+            pending_total=pending,
+            pending_per_replica=pending / max(1, len(ready)),
+            goodput=goodput,
+            ttft_p99_s=max(ttfts) if ttfts else None,
+            oldest_wait_s=max((r.oldest_wait_s for r in ready),
+                              default=0.0),
+            restarted_replicas=sum(1 for r in replicas if r.restarted),
+        )
+
+
+@dataclass(frozen=True)
+class Decision:
+    desired: int
+    direction: str = "hold"         # up | down | hold
+    reason: str = "in_band"
+    pressure: float = 0.0           # the signal that drove it (debug)
+
+
+class ScalingPolicy:
+    """Stateful decision kernel; one instance per fleet. All state is
+    host scalars keyed on the injected clock — snapshotting/replaying a
+    decision sequence is just replaying (signals, now) pairs."""
+
+    def __init__(self, cfg: PolicyConfig):
+        if cfg.min_replicas < 0 or cfg.max_replicas < cfg.min_replicas:
+            raise ValueError(
+                f"need 0 <= min_replicas <= max_replicas, got "
+                f"{cfg.min_replicas}..{cfg.max_replicas}")
+        if cfg.queue_low > cfg.queue_high:
+            raise ValueError(
+                f"queue_low {cfg.queue_low} must not exceed queue_high "
+                f"{cfg.queue_high} (the gap is the hysteresis band)")
+        if cfg.goodput_floor > cfg.goodput_ceiling:
+            raise ValueError(
+                f"goodput_floor {cfg.goodput_floor} must not exceed "
+                f"goodput_ceiling {cfg.goodput_ceiling}")
+        if cfg.max_step_up < 0 or cfg.max_step_down < 0:
+            raise ValueError(
+                "max_step_up/max_step_down must be >= 0 "
+                "(0 disables that direction)")
+        self.cfg = cfg
+        self._pressure_since: Optional[float] = None
+        self._idle_since: Optional[float] = None
+        self._last_up_t: Optional[float] = None
+        self._last_down_t: Optional[float] = None
+
+    # -- classification -------------------------------------------------
+    def _pressure_reason(self, s: FleetSignals) -> Optional[tuple]:
+        """(reason, magnitude) when the fleet is under scale-up
+        pressure; None inside/below the band. Magnitude is in 'missing
+        replicas' units for the queue trigger, 1.0 for the rest."""
+        c = self.cfg
+        if s.ready_replicas == 0 and s.pending_total > 0:
+            # queued work with nobody serving it. Deliberately NOT
+            # triggered by total_replicas == 0 alone: bootstrap below
+            # min_replicas is decide()'s own branch, and a
+            # min_replicas=0 fleet idled down to zero has no queue to
+            # observe — waking it on emptiness would flap 0->1->0
+            # forever (scale-FROM-zero needs an activator in front,
+            # not a controller guessing)
+            return ("no_ready_replicas", 1.0)
+        if s.pending_per_replica > c.queue_high:
+            return ("queue_depth",
+                    s.pending_per_replica / c.queue_high - 1.0)
+        if s.goodput is not None and s.goodput < c.goodput_floor:
+            return ("goodput", 1.0)
+        if c.ttft_p99_high_s and s.ttft_p99_s is not None \
+                and s.ttft_p99_s > c.ttft_p99_high_s:
+            return ("ttft_p99", 1.0)
+        if c.oldest_wait_high_s \
+                and s.oldest_wait_s > c.oldest_wait_high_s:
+            return ("oldest_wait", 1.0)
+        return None
+
+    def _is_idle(self, s: FleetSignals) -> bool:
+        c = self.cfg
+        if s.ready_replicas == 0:
+            return False
+        if s.pending_per_replica >= c.queue_low:
+            return False
+        # goodput None (nothing judged recently) reads as healthy: an
+        # idle fleet completes nothing, and "no completions" must not
+        # pin it at peak size forever
+        return s.goodput is None or s.goodput >= c.goodput_ceiling
+
+    # -- decide ---------------------------------------------------------
+    def decide(self, signals: FleetSignals, current: int,
+               now: float) -> Decision:
+        """One reconcile's verdict. ``current`` is the replica count the
+        controller is steering (ready + starting, draining excluded);
+        the returned ``desired`` is already clamped to bounds and step
+        limits — the quota clamp is the controller's job."""
+        c = self.cfg
+        if current < c.min_replicas:
+            # below the floor is never a policy question (a fresh fleet,
+            # or an external deletion): restore it immediately, no
+            # stability window — there is nothing to damp
+            return Decision(desired=c.min_replicas, direction="up",
+                            reason="min_replicas")
+        pressure = self._pressure_reason(signals)
+        idle = pressure is None and self._is_idle(signals)
+        if pressure is not None:
+            self._idle_since = None
+            if self._pressure_since is None:
+                self._pressure_since = now
+            held = now - self._pressure_since
+            cooled = (self._last_up_t is None
+                      or now - self._last_up_t >= c.up_cooldown_s)
+            if held >= c.up_stable_s and cooled \
+                    and current < c.max_replicas \
+                    and c.max_step_up > 0:
+                reason, magnitude = pressure
+                step = min(c.max_step_up,
+                           max(1, math.ceil(magnitude)))
+                desired = min(c.max_replicas, current + step)
+                self._last_up_t = now
+                self._pressure_since = None     # re-sustain for the next
+                return Decision(desired=desired, direction="up",
+                                reason=reason, pressure=magnitude)
+            reason, magnitude = pressure
+            return Decision(desired=current, direction="hold",
+                            reason=f"stabilizing:{reason}",
+                            pressure=magnitude)
+        self._pressure_since = None
+        if idle:
+            if self._idle_since is None:
+                self._idle_since = now
+            held = now - self._idle_since
+            cooled = (self._last_down_t is None
+                      or now - self._last_down_t >= c.down_cooldown_s)
+            if held >= c.down_stable_s and cooled \
+                    and current > c.min_replicas \
+                    and c.max_step_down > 0:
+                step = min(c.max_step_down, current - c.min_replicas)
+                desired = current - max(1, step)
+                self._last_down_t = now
+                self._idle_since = None
+                return Decision(desired=desired, direction="down",
+                                reason="idle")
+            return Decision(desired=current, direction="hold",
+                            reason="stabilizing:idle")
+        self._idle_since = None
+        return Decision(desired=current, direction="hold",
+                        reason="in_band")

@@ -1,0 +1,163 @@
+"""ElasticQuota slack accounting for the fleet controller.
+
+The scheduler's capacity plugin enforces two ceilings at admission
+(scheduler/capacity.py PreFilter): a namespace may not exceed its own
+``max`` (when enforced), and cluster-wide Σused + req may not exceed
+Σmin. The fleet controller must PLAN against the same arithmetic — a
+scale-up whose pods would be rejected at admission just parks Pending
+pods in the queue — so this module rebuilds the same ``QuotaInfos``
+aggregates (quota/info.py) from the API objects and answers the two
+planning questions:
+
+- ``headroom(ns, resource)``: how much more of ``resource`` may pods in
+  ``ns`` request before the scheduler refuses them (own-max ceiling AND
+  the aggregate-min ceiling — i.e. guaranteed room plus borrowable
+  slack);
+- ``reclaim_pressure(...)``: is a GUARANTEED namespace (used below its
+  min) currently starved by borrowed capacity — the signal on which the
+  fleet sheds borrowed replicas gracefully instead of waiting for the
+  scheduler's preemption to evict them.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import List, Optional
+
+from nos_tpu.kube.client import Client
+from nos_tpu.kube.objects import Pod, ResourceList
+from nos_tpu.quota.info import QuotaInfo, QuotaInfos
+from nos_tpu.tpu.resource_calc import ResourceCalculator
+
+__all__ = ["QuotaView", "build_quota_infos"]
+
+
+def build_quota_infos(client: Client,
+                      calculator: Optional[ResourceCalculator] = None,
+                      recompute_used: bool = True) -> QuotaInfos:
+    """QuotaInfos over every ElasticQuota / CompositeElasticQuota the
+    API server knows. ``recompute_used=True`` (the controller's choice)
+    re-derives ``used`` level-triggered from Running pods — the quota
+    reconciler's own rule — so a stale ``status.used`` between operator
+    passes cannot mis-size a scaling step; ``False`` trusts the status
+    (the metrics exporter's cheap snapshot)."""
+    calc = calculator or ResourceCalculator()
+    infos = QuotaInfos()
+    counted = ("Running", "Pending")
+    for eq in client.list("ElasticQuota"):
+        infos.add(QuotaInfo(
+            name=eq.metadata.name, namespace=eq.metadata.namespace,
+            namespaces={eq.metadata.namespace},
+            min=dict(eq.spec.min),
+            max=dict(eq.spec.max) if eq.spec.max is not None else None,
+            used=dict(eq.status.used), calculator=calc))
+    for ceq in client.list("CompositeElasticQuota"):
+        infos.add(QuotaInfo(
+            name=ceq.metadata.name, namespace=ceq.metadata.namespace,
+            namespaces=set(ceq.spec.namespaces),
+            min=dict(ceq.spec.min),
+            max=dict(ceq.spec.max) if ceq.spec.max is not None else None,
+            used=dict(ceq.status.used), calculator=calc))
+    if recompute_used:
+        for info in {id(i): i for i in infos.values()}.values():
+            info.used = {}
+            info.pods = set()
+        for pod in client.list("Pod"):
+            # count Running pods (the quota reconciler's rule) AND
+            # bound-but-not-started ones: a pod the scheduler has
+            # admitted holds its quota the moment it binds, and
+            # planning against Running-only would re-spend chips a
+            # reclaiming namespace just won back
+            if pod.status.phase not in counted or (
+                    pod.status.phase == "Pending"
+                    and not pod.is_scheduled()):
+                continue
+            info = infos.get(pod.metadata.namespace)
+            if info is not None:
+                info.add_pod_if_not_present(pod)
+    return infos
+
+
+@dataclass
+class QuotaView:
+    """One reconcile's quota snapshot, from the fleet's viewpoint."""
+
+    infos: QuotaInfos
+    namespace: str
+
+    @property
+    def governed(self) -> bool:
+        """False when no quota covers the fleet namespace — nothing
+        clamps (and nothing can be reclaimed from us either)."""
+        return self.infos.get(self.namespace) is not None
+
+    def headroom(self, resource: str,
+                 planned: ResourceList = None) -> float:
+        """Units of ``resource`` pods in the fleet namespace may still
+        request before quota admission refuses them: the cluster-wide
+        Σmin - Σused slack (borrowing allowed up to it), further capped
+        by the namespace's own ``max`` when enforced. ``planned``
+        subtracts requests this controller has already created but the
+        quota operator has not accounted yet (Pending replicas)."""
+        if not self.governed:
+            return float("inf")
+        planned_v = (planned or {}).get(resource, 0.0)
+        total_min = self.infos.aggregated_min().get(resource, 0.0)
+        total_used = self.infos.aggregated_used().get(resource, 0.0)
+        slack = total_min - total_used - planned_v
+        own = self.infos[self.namespace]
+        if own.max is not None and resource in own.max:
+            own_room = (own.max[resource]
+                        - own.used.get(resource, 0.0) - planned_v)
+            slack = min(slack, own_room)
+        return max(0.0, slack)
+
+    def guaranteed(self, resource: str) -> float:
+        """The fleet namespace's own unused min: chips it holds by
+        right, not by borrowing."""
+        if not self.governed:
+            return float("inf")
+        own = self.infos[self.namespace]
+        return max(0.0, own.min.get(resource, 0.0)
+                   - own.used.get(resource, 0.0))
+
+    def over_min(self, resource: str) -> float:
+        """Units the fleet namespace uses BEYOND its min — borrowed
+        capacity a guaranteed owner may reclaim."""
+        if not self.governed:
+            return 0.0
+        own = self.infos[self.namespace]
+        return max(0.0, own.used.get(resource, 0.0)
+                   - own.min.get(resource, 0.0))
+
+    def reclaim_pressure(self, client: Client, resource: str,
+                         calculator: Optional[ResourceCalculator] = None
+                         ) -> float:
+        """Units of ``resource`` that GUARANTEED traffic is waiting on:
+        Σ over Pending-unschedulable pods in OTHER namespaces whose
+        quota still has unused min covering the pod's request. Positive
+        while the fleet holds borrowed capacity means the borrow must
+        be returned (the shed path); the scheduler's preemption would
+        eventually force the same outcome by evicting over-quota pods,
+        but a graceful drain loses no in-flight requests."""
+        calc = calculator or ResourceCalculator()
+        pressure = 0.0
+        claimed: dict = {}              # quota id -> already-counted req
+        for pod in client.list("Pod"):
+            ns = pod.metadata.namespace
+            if ns == self.namespace or pod.is_scheduled() \
+                    or not pod.is_unschedulable():
+                continue
+            info = self.infos.get(ns)
+            if info is None:
+                continue
+            req = calc.compute_pod_request(pod).get(resource, 0.0)
+            if req <= 0:
+                continue
+            seen = claimed.setdefault(id(info), 0.0)
+            unused_min = (info.min.get(resource, 0.0)
+                          - info.used.get(resource, 0.0) - seen)
+            take = min(req, max(0.0, unused_min))
+            if take > 0:
+                claimed[id(info)] = seen + take
+                pressure += take
+        return pressure

@@ -1,0 +1,328 @@
+"""Deterministic discrete-time serving-fleet model.
+
+``bench_autoscale.py`` and the fleet integration tests need a data
+plane that (a) produces the exact ``/stats`` signal surface the fleet
+controller scrapes, (b) honors the drain contract (stop admitting,
+finish in-flight, requeue what cannot finish — lossless), and (c) is
+bit-reproducible under a FakeClock. Real ServingLoops are wall-clock
+threaded; this module models them instead:
+
+- ``SimRequest``  — arrival time, output-token budget, first-token /
+  completion stamps; TTFT is judged against the fleet's SLO from the
+  ORIGINAL arrival, so a request requeued off a drained replica keeps
+  its clock running (a late requeue is a breach, not a reset);
+- ``SimReplica``  — max_batch decode slots at a fixed per-slot token
+  rate with a prefill delay, a pending queue, and a ``stats()``
+  snapshot shaped like the serving binary's ``/stats`` (uptime, config
+  echo, goodput/TTFT-p99 over a rolling window);
+- ``SimFleet``    — the Service/router: a fleet-level queue dispatched
+  least-loaded to ready replicas, drains, and lossless removal
+  (unfinished requests return to the fleet queue). Conservation —
+  submitted == completed + in-system — is a standing invariant tests
+  assert at every step;
+- ``SimKubelet``  — the pod <-> replica bridge: bound pods become
+  Running replicas after a provisioning delay, drain annotations begin
+  drains, deleted pods remove replicas (requeue included).
+
+Everything advances on ``tick(dt)``; nothing reads the wall clock.
+"""
+from __future__ import annotations
+
+import math
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Callable, Deque, Dict, List, Optional
+
+from nos_tpu import constants
+from nos_tpu.kube.client import Client
+
+__all__ = ["SimFleet", "SimKubelet", "SimReplica", "SimRequest"]
+
+
+@dataclass
+class SimRequest:
+    rid: int
+    arrival_t: float
+    tokens: int                     # output tokens still to decode
+    tokens_left: float = 0.0
+    first_token_t: Optional[float] = None
+    done_t: Optional[float] = None
+    prefill_left: float = 0.0
+    requeues: int = 0
+
+    def __post_init__(self):
+        self.tokens_left = float(self.tokens)
+
+
+@dataclass
+class SimReplica:
+    """One replica's serving model: ``max_batch`` slots decoding at
+    ``tokens_per_s`` each, ``prefill_s`` before a slot's first token."""
+
+    name: str
+    clock: Callable[[], float]
+    max_batch: int = 8
+    tokens_per_s: float = 40.0
+    prefill_s: float = 0.25
+    goodput_window_s: float = 60.0
+    config: dict = field(default_factory=dict)
+    started_at: float = 0.0
+    draining: bool = False
+    active: List[SimRequest] = field(default_factory=list)
+    pending: Deque[SimRequest] = field(default_factory=deque)
+    # (done_t, ttft_s) of completions, pruned to the goodput window
+    _ledger: Deque[tuple] = field(default_factory=deque)
+    _completed_total: int = 0
+    slo_ttft_s: float = 0.0
+
+    def __post_init__(self):
+        self.started_at = self.clock()
+
+    # -- serving --------------------------------------------------------
+    def admit(self, req: SimRequest) -> bool:
+        if self.draining:
+            return False
+        self.pending.append(req)
+        return True
+
+    def load(self) -> int:
+        return len(self.active) + len(self.pending)
+
+    def tick(self, dt: float) -> List[SimRequest]:
+        """Advance ``dt`` seconds; returns requests completed."""
+        now = self.clock()
+        while self.pending and len(self.active) < self.max_batch:
+            req = self.pending.popleft()
+            req.prefill_left = self.prefill_s
+            self.active.append(req)
+        done: List[SimRequest] = []
+        for req in list(self.active):
+            budget = dt
+            if req.prefill_left > 0:
+                used = min(budget, req.prefill_left)
+                req.prefill_left -= used
+                budget -= used
+                if req.prefill_left > 0:
+                    continue
+            if req.first_token_t is None:
+                # first token lands the instant prefill retires
+                req.first_token_t = now + (dt - budget)
+            req.tokens_left -= budget * self.tokens_per_s
+            if req.tokens_left <= 1e-9:
+                req.done_t = now + dt
+                self.active.remove(req)
+                done.append(req)
+                self._ledger.append(
+                    (req.done_t, req.first_token_t - req.arrival_t))
+                self._completed_total += 1
+        cutoff = now + dt - self.goodput_window_s
+        while self._ledger and self._ledger[0][0] < cutoff:
+            self._ledger.popleft()
+        return done
+
+    def take_unfinished(self) -> List[SimRequest]:
+        """Drain-timeout / removal path: every request still in flight
+        leaves the replica for requeue elsewhere — nothing is lost.
+        Progress resets (the KV left with the replica) but the arrival
+        stamp — and so the SLO clock — survives."""
+        out = list(self.pending) + list(self.active)
+        self.pending.clear()
+        self.active.clear()
+        for req in out:
+            req.tokens_left = float(req.tokens)
+            req.first_token_t = None
+            req.prefill_left = 0.0
+            req.requeues += 1
+        return out
+
+    # -- the /stats surface --------------------------------------------
+    def stats(self) -> dict:
+        now = self.clock()
+        ttfts = sorted(t for _, t in self._ledger)
+        goodput = None
+        p99 = None
+        if ttfts:
+            if self.slo_ttft_s > 0:
+                met = sum(1 for t in ttfts if t <= self.slo_ttft_s)
+                goodput = met / len(ttfts)
+            p99 = ttfts[min(len(ttfts) - 1,
+                            math.ceil(0.99 * len(ttfts)) - 1)]
+        oldest = max((now - r.arrival_t for r in self.pending),
+                     default=0.0)
+        return {
+            "healthy": True,
+            "draining": self.draining,
+            "recovering": False,
+            "uptime_s": round(now - self.started_at, 6),
+            "active_slots": len(self.active),
+            "pending": {"depth": len(self.pending),
+                        "oldest_wait_s": round(oldest, 6)},
+            "slo": {"goodput": goodput,
+                    "completed": len(ttfts)},
+            "per_request": {"ttft_p99_s": p99},
+            "config": dict(self.config),
+        }
+
+
+class SimFleet:
+    """The fleet data plane + router; see module docstring."""
+
+    def __init__(self, clock: Callable[[], float],
+                 slo_ttft_s: float = 10.0, max_batch: int = 8,
+                 tokens_per_s: float = 40.0, prefill_s: float = 0.25,
+                 goodput_window_s: float = 60.0,
+                 config_echo: Optional[dict] = None):
+        self.clock = clock
+        self.slo_ttft_s = slo_ttft_s
+        self.max_batch = max_batch
+        self.tokens_per_s = tokens_per_s
+        self.prefill_s = prefill_s
+        self.goodput_window_s = goodput_window_s
+        self.config_echo = dict(config_echo or {
+            "max_batch": max_batch, "pipeline_depth": 2,
+            "decode_steps": 1, "kv_blocks": 0, "kv_block_size": 0})
+        self.replicas: Dict[str, SimReplica] = {}
+        self.queue: Deque[SimRequest] = deque()
+        self.completed: List[SimRequest] = []
+        self.submitted = 0
+        self.requeued = 0
+        self._next_rid = 0
+
+    # -- replica lifecycle ----------------------------------------------
+    def add_replica(self, name: str) -> SimReplica:
+        rep = SimReplica(
+            name=name, clock=self.clock, max_batch=self.max_batch,
+            tokens_per_s=self.tokens_per_s, prefill_s=self.prefill_s,
+            goodput_window_s=self.goodput_window_s,
+            config=dict(self.config_echo))
+        rep.slo_ttft_s = self.slo_ttft_s
+        self.replicas[name] = rep
+        return rep
+
+    def drain(self, name: str) -> None:
+        rep = self.replicas.get(name)
+        if rep is not None:
+            rep.draining = True
+
+    def remove(self, name: str) -> int:
+        """Delete a replica; unfinished requests requeue at the FRONT
+        of the fleet queue (they have waited longest). Returns how many
+        were requeued — the lossless-drain invariant's ledger."""
+        rep = self.replicas.pop(name, None)
+        if rep is None:
+            return 0
+        unfinished = rep.take_unfinished()
+        for req in reversed(unfinished):
+            self.queue.appendleft(req)
+        self.requeued += len(unfinished)
+        return len(unfinished)
+
+    # -- traffic --------------------------------------------------------
+    def submit(self, tokens: int) -> SimRequest:
+        req = SimRequest(rid=self._next_rid, arrival_t=self.clock(),
+                         tokens=tokens)
+        self._next_rid += 1
+        self.submitted += 1
+        self.queue.append(req)
+        return req
+
+    def _dispatch(self) -> None:
+        admitting = sorted(
+            (r for r in self.replicas.values() if not r.draining),
+            key=lambda r: (r.load(), r.name))
+        if not admitting:
+            return
+        while self.queue:
+            target = min(admitting, key=lambda r: (r.load(), r.name))
+            # keep per-replica queues shallow: past 3x max_batch total
+            # load (1x active + up to 2x queued) the request waits at
+            # the router (arrival stamp keeps aging) — the controller's
+            # queue-depth signal reads the replica-side queues
+            if target.load() >= 3 * target.max_batch:
+                return
+            target.admit(self.queue.popleft())
+
+    def tick(self, dt: float) -> None:
+        self._dispatch()
+        for name in sorted(self.replicas):
+            self.completed.extend(self.replicas[name].tick(dt))
+
+    # -- invariants & report --------------------------------------------
+    def in_system(self) -> int:
+        return len(self.queue) + sum(r.load()
+                                     for r in self.replicas.values())
+
+    def conservation_ok(self) -> bool:
+        return self.submitted == len(self.completed) + self.in_system()
+
+    def report(self) -> dict:
+        ttfts = sorted(r.first_token_t - r.arrival_t
+                       for r in self.completed)
+        met = sum(1 for t in ttfts if t <= self.slo_ttft_s)
+        n = len(ttfts)
+        return {
+            "submitted": self.submitted,
+            "completed": n,
+            "in_system": self.in_system(),
+            "requeued": self.requeued,
+            "goodput": round(met / n, 6) if n else None,
+            "slo_breach_rate": round(1.0 - met / n, 6) if n else None,
+            "ttft_p50_s": round(ttfts[n // 2], 4) if n else None,
+            "ttft_p99_s": (round(ttfts[min(n - 1,
+                                           math.ceil(0.99 * n) - 1)], 4)
+                           if n else None),
+            "conservation_ok": self.conservation_ok(),
+        }
+
+    # -- the controller's scrape seam ------------------------------------
+    def stats_source(self, pod) -> Optional[dict]:
+        rep = self.replicas.get(pod.metadata.name)
+        return rep.stats() if rep is not None else None
+
+
+class SimKubelet:
+    """Bridges fleet pods in the API server to SimFleet replicas: the
+    kubelet + Service roles of the simulation. Call ``sync`` once per
+    sim step, AFTER the scheduler has had its chance to bind."""
+
+    def __init__(self, fleet: SimFleet, clock: Callable[[], float],
+                 fleet_label: str, namespace: str,
+                 startup_s: float = 5.0):
+        self.fleet = fleet
+        self.clock = clock
+        self.fleet_label = fleet_label
+        self.namespace = namespace
+        self.startup_s = startup_s
+        self._bound_at: Dict[str, float] = {}
+
+    def sync(self, client: Client) -> None:
+        now = self.clock()
+        seen = set()
+        for pod in client.list("Pod", namespace=self.namespace,
+                               label_selector={constants.LABEL_FLEET:
+                                               self.fleet_label}):
+            name = pod.metadata.name
+            seen.add(name)
+            if not pod.is_scheduled():
+                continue
+            if pod.status.phase == "Pending":
+                bound = self._bound_at.setdefault(name, now)
+                if now - bound >= self.startup_s:
+                    client.patch(
+                        "Pod", name, pod.metadata.namespace,
+                        lambda p: setattr(p.status, "phase", "Running"))
+                    self.fleet.add_replica(name)
+                continue
+            if pod.status.phase == "Running" \
+                    and name not in self.fleet.replicas:
+                # controller restart / pre-existing pod: adopt it
+                self.fleet.add_replica(name)
+            if pod.metadata.annotations.get(
+                    constants.ANNOTATION_FLEET_DRAIN):
+                self.fleet.drain(name)
+        for name in list(self.fleet.replicas):
+            if name not in seen:
+                self.fleet.remove(name)     # deleted pod: requeue work
+        for name in list(self._bound_at):
+            if name not in seen:
+                del self._bound_at[name]

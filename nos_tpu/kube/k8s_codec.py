@@ -435,6 +435,8 @@ def pod_to_k8s(p: Pod) -> dict:
         ]
     if p.status.nominated_node_name:
         status["nominatedNodeName"] = p.status.nominated_node_name
+    if p.status.pod_ip:
+        status["podIP"] = p.status.pod_ip
     return {
         "apiVersion": "v1", "kind": "Pod",
         "metadata": _meta_to_k8s(p.metadata),
@@ -486,6 +488,7 @@ def pod_from_k8s(d: dict) -> Pod:
                 for c in (status.get("conditions") or [])
             ],
             nominated_node_name=status.get("nominatedNodeName", ""),
+            pod_ip=status.get("podIP", ""),
         ),
     )
 

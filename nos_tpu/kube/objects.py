@@ -269,6 +269,10 @@ class PodStatus:
     phase: str = "Pending"   # Pending | Running | Succeeded | Failed
     conditions: List[PodCondition] = field(default_factory=list)
     nominated_node_name: str = ""
+    # status.podIP: how peers reach the pod without Service DNS (the
+    # fleet controller scrapes replicas by IP — a draining pod drops
+    # out of Service endpoints but keeps its IP)
+    pod_ip: str = ""
 
 
 @dataclass

@@ -13,7 +13,15 @@ shed load and come back). Before the paged KV cache the two were easy
 to conflate; with a block pool, "prompt needs more blocks than the
 whole pool" (permanent) and "no free blocks this instant" (transient)
 must travel different wires.
-"""
+
+Every refusal also carries a machine-readable ``reason`` slug the HTTP
+layer copies into the 429/400 body (``queue_full`` /
+``deadline_unmeetable`` / ``hbm_admission`` / ``infeasible``): the
+fleet controller must tell CAPACITY pressure (shed because the fleet
+is undersized — scale up) from DEADLINE pressure (shed because the
+client's budget was tight — scaling may not help) and MEMORY pressure
+(the KV pool or HBM, not slots, is the bottleneck) without parsing
+prose."""
 
 
 class QueueFull(RuntimeError):
@@ -21,7 +29,16 @@ class QueueFull(RuntimeError):
     ``max_pending`` (or, under paged KV, the block pool cannot hold
     another waiting request right now). Its own type so the HTTP layer
     can answer 429 + Retry-After (shed load, retry) rather than a
-    generic 500."""
+    generic 500. ``reason`` refines the cause on the wire:
+    ``queue_full`` (slots/queue exhausted) vs ``hbm_admission`` (free
+    slots exist but KV-block/HBM headroom is blocking admission)."""
+
+    reason = "queue_full"
+
+    def __init__(self, *args, reason: str = None):
+        super().__init__(*args)
+        if reason is not None:
+            self.reason = reason
 
 
 class Infeasible(ValueError):
@@ -31,6 +48,8 @@ class Infeasible(ValueError):
     ValueError (the HTTP layer's 400 arm, and what library callers
     already catch); distinct so callers can tell "fix the request"
     from "retry later" without string-matching."""
+
+    reason = "infeasible"
 
 
 class EngineRecovering(RuntimeError):
@@ -49,6 +68,8 @@ class DeadlineUnmeetable(QueueFull):
     ticks on an answer the client will discard. Subclasses QueueFull —
     the same transient 429 + Retry-After wire shape — because backing
     off and retrying when load drops is exactly the right client move."""
+
+    reason = "deadline_unmeetable"
 
 
 class DeadlineExceeded(RuntimeError):

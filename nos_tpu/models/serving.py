@@ -312,6 +312,12 @@ class DecodeServer:
         # QueueFull so callers shed load (HTTP 429) instead of growing
         # an unbounded backlog whose tail would time out anyway
         self.max_pending = max_pending
+        # True while _admit last broke on the paged memory-headroom
+        # check with free slots available: the queue is blocked on
+        # KV-blocks/HBM, not slots — submit sheds with
+        # reason="hbm_admission" so operators (and the fleet
+        # controller) can tell memory pressure from slot scarcity
+        self._admit_blocked = False
         self._free: Deque[int] = deque(range(max_batch))
         self._active: Dict[int, _Request] = {}      # slot -> request
         self._pending: Deque[_Request] = deque()
@@ -631,11 +637,22 @@ class DecodeServer:
             raise ValueError(
                 f"top_k must be >= 0 and top_p in [0, 1]: got "
                 f"top_k={top_k}, top_p={top_p}")
-        if self.max_pending and not self._free \
-                and len(self._pending) >= self.max_pending:
-            raise QueueFull(
-                f"{len(self._pending)} requests already waiting "
-                f"(max_pending={self.max_pending}); shed load and retry")
+        if self.max_pending and len(self._pending) >= self.max_pending:
+            if not self._free:
+                raise QueueFull(
+                    f"{len(self._pending)} requests already waiting "
+                    f"(max_pending={self.max_pending}); shed load and "
+                    f"retry")
+            if self.paged and self._admit_blocked:
+                # free slots exist but the queue head is waiting on
+                # KV-block/HBM headroom: without this shed the pending
+                # line would grow past max_pending unbounded whenever
+                # memory (not slots) is the bottleneck
+                raise QueueFull(
+                    f"{len(self._pending)} requests already waiting "
+                    f"(max_pending={self.max_pending}) on KV-block/HBM "
+                    f"headroom; shed load and retry",
+                    reason="hbm_admission")
         rid = self._next_rid
         self._next_rid += 1
         self._pending.append(_Request(
@@ -651,6 +668,7 @@ class DecodeServer:
         return rid
 
     def _admit(self) -> None:
+        self._admit_blocked = False
         if self._pending and self._free:
             # pipeline barrier: an admission install changes batch
             # composition, and un-consumed in-flight arrivals still
@@ -662,6 +680,7 @@ class DecodeServer:
                 # memory-aware admission: the head waits for free-block
                 # headroom (or the HBM backstop) instead of thrashing
                 # the pool — completions and preemptions re-run this
+                self._admit_blocked = True
                 break
             req = self._pending.popleft()
             slot = self._free.popleft()

@@ -1,0 +1,78 @@
+"""Slow-marked smoke of bench_autoscale.py (ISSUE 8 CI satellite): the
+autoscaler bench path must not rot. Runs the real script in
+NOS_TPU_BENCH_SMOKE=1 mode in a subprocess, pins the artifact shape,
+the structural acceptance invariant — the autoscaled fleet's goodput >=
+the (mean-provisioned) static fleet's at equal or fewer chip-hours,
+with lower chips-per-goodput — and bit-reproducibility at the fixed
+seed (a second run produces a byte-identical artifact)."""
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def run_bench():
+    env = dict(os.environ, NOS_TPU_BENCH_SMOKE="1", JAX_PLATFORMS="cpu")
+    proc = subprocess.run(
+        [sys.executable, "bench_autoscale.py"], cwd=REPO, env=env,
+        capture_output=True, text=True, timeout=600)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    return json.loads(proc.stdout.strip().splitlines()[-1])
+
+
+@pytest.mark.slow
+def test_bench_autoscale_smoke_invariants_and_reproducibility():
+    line = run_bench()
+    with open(os.path.join(REPO, "bench_logs",
+                           "bench_autoscale.json")) as f:
+        artifact = json.load(f)
+    assert artifact == line
+    assert "[SMOKE]" in artifact["metric"]
+    assert artifact["unit"] == "x_chips_per_goodput_vs_static"
+    assert 0 < artifact["value"] < 1.0      # the headline win
+
+    trace = artifact["trace"]
+    for key in ("duration_s", "base_rps", "flash_crowd_window_s",
+                "slo_ttft_s", "chips_per_replica", "startup_s"):
+        assert key in trace
+
+    fleets = {k: artifact[k]
+              for k in ("static", "static_peak", "autoscaled")}
+    for name, f in fleets.items():
+        # shape
+        for key in ("goodput", "slo_breach_rate", "chip_hours",
+                    "chips_per_goodput", "submitted", "completed",
+                    "replica_timeline", "replicas_peak",
+                    "replicas_mean", "requeued"):
+            assert key in f, (name, key)
+        # the identical seeded trace hit every fleet
+        assert f["submitted"] == fleets["static"]["submitted"] > 0
+        # lossless data plane: everything submitted completed
+        assert f["conservation_ok"] is True
+        assert f["completed"] == f["submitted"]
+        assert f["in_system"] == 0
+
+    static, peak, auto = (fleets["static"], fleets["static_peak"],
+                          fleets["autoscaled"])
+    # the fleet actually scaled (traffic moved it both ways)
+    assert auto["autoscaled"] is True
+    assert auto["replicas_peak"] > 1
+    assert auto["replicas_peak"] > min(
+        n for _, n in auto["replica_timeline"] if n > 0)
+    assert "controller" in auto
+
+    # -- THE acceptance invariant (ISSUE 8): goodput >= static at
+    # equal-or-fewer chip-hours, with lower chips-per-goodput ---------
+    assert auto["goodput"] >= static["goodput"]
+    assert auto["chip_hours"] <= static["chip_hours"]
+    assert auto["chips_per_goodput"] < static["chips_per_goodput"]
+    # context: the peak fleet buys its goodput with far more chips
+    assert peak["chip_hours"] > auto["chip_hours"]
+
+    # -- bit-reproducibility at the fixed seed ------------------------
+    again = run_bench()
+    assert again == line

@@ -53,7 +53,8 @@ def test_templates_exist_for_every_component():
                  "scheduler/deployment_scheduler",
                  "tpu-partitioner/deployment_tpu-partitioner",
                  "tpu-partitioner/configmap_known-tpu-topologies",
-                 "tpuagent/daemonset_tpuagent", "pod_metrics-exporter"):
+                 "tpuagent/daemonset_tpuagent", "pod_metrics-exporter",
+                 "fleet/deployment_fleet", "fleet/rbac_fleet"):
         assert frag in joined, f"missing template {frag}"
 
 
@@ -393,3 +394,58 @@ def test_serving_sample_valid():
     assert ctr["livenessProbe"]["httpGet"]["path"] == "/healthz"
     cfg = ServerConfig(**yaml.safe_load(cm["data"]["server.yaml"]))
     assert cfg.int8 and cfg.checkpoint_dir == "/ckpt"
+
+
+def test_fleet_deployment_passes_policy_and_quota_args():
+    """The fleet Deployment template (ISSUE 8 satellite) must plumb the
+    fleet identity, quota sizing, and every policy knob to nos-tpu-fleet
+    flags, and the chart defaults must match the binary's."""
+    path = os.path.join(CHART, "templates", "fleet",
+                        "deployment_fleet.yaml")
+    with open(path) as f:
+        text = f.read()
+    for flag, value in [
+        ("--fleet", ".Values.fleet.fleetName"),
+        ("--chips-per-replica", ".Values.fleet.chipsPerReplica"),
+        ("--resource", ".Values.fleet.resource"),
+        ("--min-replicas", ".Values.fleet.minReplicas"),
+        ("--max-replicas", ".Values.fleet.maxReplicas"),
+        ("--interval", ".Values.fleet.reconcileIntervalSeconds"),
+        ("--drain-timeout", ".Values.fleet.drainTimeoutSeconds"),
+        ("--replica-url-template", ".Values.fleet.replicaUrlTemplate"),
+        ("--queue-high", ".Values.fleet.policy.queueHigh"),
+        ("--queue-low", ".Values.fleet.policy.queueLow"),
+        ("--goodput-floor", ".Values.fleet.policy.goodputFloor"),
+        ("--goodput-ceiling", ".Values.fleet.policy.goodputCeiling"),
+        ("--ttft-p99-high-ms", ".Values.fleet.policy.ttftP99HighMs"),
+        ("--oldest-wait-high-s",
+         ".Values.fleet.policy.oldestWaitHighSeconds"),
+        ("--up-stable", ".Values.fleet.policy.upStableSeconds"),
+        ("--down-stable", ".Values.fleet.policy.downStableSeconds"),
+        ("--up-cooldown", ".Values.fleet.policy.upCooldownSeconds"),
+        ("--down-cooldown", ".Values.fleet.policy.downCooldownSeconds"),
+        ("--max-step-up", ".Values.fleet.policy.maxStepUp"),
+        ("--max-step-down", ".Values.fleet.policy.maxStepDown"),
+    ]:
+        assert flag in text, f"fleet deployment missing {flag}"
+        assert value in text, f"fleet deployment missing {value}"
+    # RBAC exists alongside (pods RW + quotas RO + leases)
+    rbac = os.path.join(CHART, "templates", "fleet", "rbac_fleet.yaml")
+    with open(rbac) as f:
+        rbac_text = f.read()
+    assert "elasticquotas" in rbac_text
+    assert "delete" in rbac_text
+    with open(os.path.join(CHART, "values.yaml")) as f:
+        values = yaml.safe_load(f)
+    assert values["fleet"]["enabled"] is False
+    assert values["fleet"]["chipsPerReplica"] == 4
+    assert values["fleet"]["minReplicas"] == 1
+    assert values["fleet"]["maxReplicas"] == 8
+    assert values["fleet"]["policy"] == {
+        "queueHigh": 4, "queueLow": 0.5,
+        "goodputFloor": 0.90, "goodputCeiling": 0.98,
+        "ttftP99HighMs": 0, "oldestWaitHighSeconds": 0,
+        "upStableSeconds": 15, "downStableSeconds": 60,
+        "upCooldownSeconds": 30, "downCooldownSeconds": 120,
+        "maxStepUp": 2, "maxStepDown": 1,
+    }

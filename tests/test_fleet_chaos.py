@@ -1,0 +1,190 @@
+"""Fleet-level drain chaos (ISSUE 8 acceptance): scale-down drains are
+LOSSLESS — every in-flight request on a drained replica finishes or is
+requeued and completes, exactly once. Rides the PR 7 harness: real
+ServingLoops over the deterministic StubEngine token mill (next token
+== absolute position, so any duplicated or dropped work is visible in
+the output itself), plus the seeded FaultInjector for the
+drain-during-restart interplay.
+
+The router here plays the role the Service + client retries play in a
+real fleet: a request shed by a draining/dead replica is resubmitted to
+a surviving one.
+"""
+import threading
+import time
+
+from test_serving_chaos import StubEngine, outcome_delta, outcome_totals
+
+from nos_tpu.cmd.server import DrainingError, ServingLoop
+from nos_tpu.models.errors import EngineRecovering, QueueFull
+from nos_tpu.models.supervision import FaultInjector
+
+
+def expected_tokens(prompt, n):
+    return list(prompt) + [len(prompt) + i for i in range(n)]
+
+
+class FleetRouter:
+    """Round-robin over non-draining replicas with retry-on-shed: the
+    fleet-level requeue path a drained replica's in-flight work takes."""
+
+    def __init__(self, loops):
+        self.loops = loops
+        self._rr = 0
+        self._lock = threading.Lock()
+
+    def _pick(self, exclude):
+        with self._lock:
+            order = list(range(len(self.loops)))
+            order = order[self._rr:] + order[:self._rr]
+            self._rr = (self._rr + 1) % len(self.loops)
+        for i in order:
+            loop = self.loops[i]
+            if i not in exclude and loop.healthy and not loop.draining:
+                return i, loop
+        return None, None
+
+    def run(self, prompt, n, attempts=12):
+        """Returns (tokens, tries). Retries until a replica delivers."""
+        tried = set()
+        last = None
+        for _ in range(attempts):
+            i, loop = self._pick(tried)
+            if loop is None:
+                tried = set()       # all excluded: widen and back off
+                time.sleep(0.01)
+                continue
+            try:
+                return loop.generate(list(prompt), n, timeout=60), i
+            except (DrainingError, QueueFull, EngineRecovering,
+                    TimeoutError, RuntimeError) as e:
+                last = e
+                tried.add(i)
+                continue
+        raise AssertionError(f"request never completed: {last}")
+
+
+def run_fleet_trace(loops, n_requests, new_tokens):
+    router = FleetRouter(loops)
+    results = {}
+    errors = {}
+
+    def worker(i):
+        prompt = [100 + i]
+        try:
+            toks, replica = router.run(prompt, new_tokens)
+            results[i] = (toks, replica)
+        except Exception as e:      # noqa: BLE001 — asserted below
+            errors[i] = e
+
+    threads = [threading.Thread(target=worker, args=(i,))
+               for i in range(n_requests)]
+    for t in threads:
+        t.start()
+    return threads, results, errors
+
+
+def join_all(threads, timeout=60):
+    for t in threads:
+        t.join(timeout)
+    assert not any(t.is_alive() for t in threads), "stuck request"
+
+
+def test_graceful_drain_finishes_in_flight_work_losslessly():
+    """A drained replica keeps decoding what it admitted (admission
+    stops, /readyz flips); requests shed at its door complete on the
+    survivors. Every request finishes exactly once, tokens exact."""
+    before = outcome_totals()
+    loops = [ServingLoop(StubEngine(tokens_per_tick=4))
+             for _ in range(3)]
+    try:
+        threads, results, errors = run_fleet_trace(
+            loops, n_requests=18, new_tokens=60)
+        time.sleep(0.005)
+        # the controller's step 2: stop admitting, let work finish
+        loops[0].begin_drain()
+        assert loops[0].wait_idle(timeout=30)
+        join_all(threads)
+        assert errors == {}
+        assert len(results) == 18
+        for i, (toks, _) in results.items():
+            assert toks == expected_tokens([100 + i], 60), f"req {i}"
+        # conservation across the whole fleet: each request earned
+        # exactly one ``finished`` somewhere
+        delta = outcome_delta(before)
+        assert delta["finished"] == 18
+        assert delta["failed"] == 0
+    finally:
+        for lp in loops:
+            lp.shutdown()
+
+
+def test_drain_timeout_requeues_unfinished_work_exactly_once():
+    """The drain budget expires with work still in flight (the
+    controller releases the pod anyway): displaced requests are
+    requeued by the router and complete on survivors — outcome
+    conservation holds, nothing completes twice, tokens stay exact."""
+    before = outcome_totals()
+    loops = [ServingLoop(StubEngine(tokens_per_tick=1))
+             for _ in range(3)]
+    try:
+        threads, results, errors = run_fleet_trace(
+            loops, n_requests=15, new_tokens=300)
+        time.sleep(0.02)            # work is mid-flight everywhere
+        # drain budget ~0: the release path (pod delete / SIGTERM)
+        loops[0].begin_drain()
+        loops[0].wait_idle(timeout=0.01)
+        loops[0].shutdown()
+        join_all(threads)
+        assert errors == {}
+        assert len(results) == 15
+        for i, (toks, _) in results.items():
+            assert toks == expected_tokens([100 + i], 300), f"req {i}"
+        # the shed replica's in-flight work really was displaced and
+        # completed elsewhere
+        displaced = [i for i, (_, replica) in results.items()
+                     if replica != 0]
+        assert displaced, "drain displaced nothing — test lost its bite"
+        delta = outcome_delta(before)
+        # exactly one finish per request; the killed replica's
+        # interrupted admissions drained as failed/cancelled, never as
+        # a second finish
+        assert delta["finished"] == 15
+        assert delta["failed"] >= 0
+        assert sum(max(0, int(v)) for v in delta.values()) >= 15
+    finally:
+        for lp in loops:
+            lp.shutdown()
+
+
+def test_drain_during_supervised_restart_interplay():
+    """Drain one replica while another is mid-supervised-restart (the
+    PR 7 injector): the router rides out both — 503s from the
+    recovering replica, sheds from the draining one — and every
+    request still completes exactly once with exact tokens."""
+    before = outcome_totals()
+    inj = FaultInjector(schedule={6: "error"})
+    loops = [
+        ServingLoop(StubEngine(tokens_per_tick=2)),
+        ServingLoop(inj.wrap(StubEngine(tokens_per_tick=2)),
+                    engine_factory=lambda: inj.wrap(
+                        StubEngine(tokens_per_tick=2)),
+                    restart_budget=4, restart_backoff_s=0.01),
+        ServingLoop(StubEngine(tokens_per_tick=2)),
+    ]
+    try:
+        threads, results, errors = run_fleet_trace(
+            loops, n_requests=12, new_tokens=120)
+        time.sleep(0.01)
+        loops[0].begin_drain()
+        loops[0].wait_idle(timeout=30)
+        join_all(threads)
+        assert errors == {}
+        assert len(results) == 12
+        for i, (toks, _) in results.items():
+            assert toks == expected_tokens([100 + i], 120), f"req {i}"
+        delta = outcome_delta(before)
+        assert delta["finished"] == 12
+    finally:
+        for lp in loops:
+            lp.shutdown()

@@ -1,0 +1,336 @@
+"""FleetController unit tests (ISSUE 8 tentpole): observe / decide /
+clamp / actuate over the in-process API server with injected stats and
+a fake clock — no scheduler, no jax.
+"""
+import pytest
+
+from nos_tpu import constants
+from nos_tpu.api.quota import make_elastic_quota
+from nos_tpu.fleet import FleetConfig, FleetController, PolicyConfig
+from nos_tpu.kube import ApiServer, Manager
+from nos_tpu.kube.client import Client
+from nos_tpu.kube.objects import (
+    Container, ObjectMeta, Pod, PodSpec, PodStatus,
+)
+
+
+class FakeClock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+FAST_POLICY = PolicyConfig(
+    min_replicas=1, max_replicas=5,
+    queue_high=4.0, queue_low=0.5,
+    up_stable_s=2.0, down_stable_s=2.0,
+    up_cooldown_s=5.0, down_cooldown_s=1.0,
+    max_step_up=2, max_step_down=1,
+)
+
+
+def busy(depth=40, goodput=None, uptime=100.0, config=None):
+    return {
+        "healthy": True, "uptime_s": uptime, "active_slots": 8,
+        "pending": {"depth": depth, "oldest_wait_s": 1.0},
+        "slo": {"goodput": goodput,
+                "completed": 10 if goodput is not None else 0},
+        "per_request": {}, "config": config or {},
+    }
+
+
+def idle(uptime=100.0, active=0, depth=0):
+    return {
+        "healthy": True, "uptime_s": uptime, "active_slots": active,
+        "pending": {"depth": depth, "oldest_wait_s": 0.0},
+        "slo": {"goodput": None, "completed": 0},
+        "per_request": {}, "config": {},
+    }
+
+
+@pytest.fixture
+def rig():
+    server = ApiServer()
+    clock = FakeClock()
+    mgr = Manager(server, clock=clock)
+    stats = {}
+    drained = []
+    ctl = FleetController(
+        FleetConfig(name="f", namespace="serve",
+                    chips_per_replica=4.0, policy=FAST_POLICY,
+                    reconcile_interval_s=1.0, drain_timeout_s=10.0),
+        stats_source=lambda pod: stats.get(pod.metadata.name),
+        drain_hook=lambda pod: drained.append(pod.metadata.name),
+        clock=clock)
+    mgr.add_controller(ctl.controller())
+    return server, mgr, clock, ctl, stats, drained
+
+
+def fleet_pods(server, name="f"):
+    return sorted(
+        (p for p in server.list("Pod", namespace="serve")
+         if p.metadata.labels.get(constants.LABEL_FLEET) == name),
+        key=lambda p: p.metadata.name)
+
+
+def pump(mgr, clock, seconds, dt=1.0):
+    t = 0.0
+    while t < seconds:
+        mgr.run_until_idle()
+        clock.advance(dt)
+        t += dt
+    mgr.run_until_idle()
+
+
+def mark_running(server, stats, snap=None):
+    for p in fleet_pods(server):
+        if p.status.phase != "Running":
+            server.patch("Pod", p.metadata.name, "serve",
+                         lambda o: setattr(o.status, "phase", "Running"))
+        if snap is not None:
+            stats[p.metadata.name] = snap
+
+
+# ---------------------------------------------------------------------------
+def test_bootstrap_creates_min_replicas(rig):
+    server, mgr, clock, ctl, stats, _ = rig
+    mgr.run_until_idle()
+    pods = fleet_pods(server)
+    assert len(pods) == 1
+    assert pods[0].spec.scheduler_name == constants.SCHEDULER_NAME
+    assert pods[0].request() == {constants.RESOURCE_TPU: 4.0}
+    # replica pods enter Pending-unschedulable so the nos scheduler
+    # picks them up like any workload pod
+    assert pods[0].is_unschedulable()
+
+
+def test_sustained_queue_pressure_scales_up_with_step_limit(rig):
+    server, mgr, clock, ctl, stats, _ = rig
+    mgr.run_until_idle()
+    mark_running(server, stats, busy(depth=40))
+    pump(mgr, clock, 4)
+    pods = fleet_pods(server)
+    assert len(pods) == 1 + FAST_POLICY.max_step_up  # one step, capped
+    # starting (not Running) pods count toward current: no runaway
+    # step while the first batch provisions
+    pump(mgr, clock, 1)
+    assert len(fleet_pods(server)) == len(pods)
+    snap = ctl.stats()
+    assert snap["replicas"]["starting"] == FAST_POLICY.max_step_up
+
+
+def test_scale_down_drains_youngest_then_releases_when_idle(rig):
+    server, mgr, clock, ctl, stats, drained = rig
+    mgr.run_until_idle()
+    mark_running(server, stats, busy(depth=40))
+    pump(mgr, clock, 4)
+    mark_running(server, stats, busy(depth=40))
+    pump(mgr, clock, 2)
+    names = [p.metadata.name for p in fleet_pods(server)]
+    assert len(names) == 3
+    # everything goes quiet: fleet shrinks one step per decision
+    for n in names:
+        stats[n] = idle()
+    pump(mgr, clock, 4)
+    left = [p.metadata.name for p in fleet_pods(server)]
+    assert len(left) == 2
+    gone = set(names) - set(left)
+    assert gone == {max(names)}         # youngest victim first
+    assert list(gone)[0] in drained     # drain hook (stop admitting)
+
+
+def test_draining_replica_with_work_waits_then_times_out(rig):
+    server, mgr, clock, ctl, stats, drained = rig
+    mgr.run_until_idle()
+    mark_running(server, stats, busy(depth=40))
+    pump(mgr, clock, 4)
+    mark_running(server, stats, busy(depth=40))
+    pump(mgr, clock, 2)
+    names = [p.metadata.name for p in fleet_pods(server)]
+    # quiet signals but the youngest replica still has in-flight work
+    for n in names:
+        stats[n] = idle()
+    stats[max(names)] = idle(active=2, depth=1)
+    pump(mgr, clock, 3)
+    pods = {p.metadata.name: p for p in fleet_pods(server)}
+    assert max(names) in pods           # not released: work in flight
+    assert pods[max(names)].metadata.annotations.get(
+        constants.ANNOTATION_FLEET_DRAIN)
+    assert ctl.stats()["replicas"]["draining"] == 1
+    # drain budget (10s) expires: released anyway — the server's own
+    # SIGTERM drain and the supervisor capture own the tail
+    pump(mgr, clock, 11)
+    assert max(names) not in {p.metadata.name
+                              for p in fleet_pods(server)}
+
+
+def test_quota_clamps_scale_up_to_admissible_chips(rig):
+    server, mgr, clock, ctl, stats, _ = rig
+    # Σmin = 8 chips -> at 4 chips/replica only 2 replicas are ever
+    # admissible, however hard the queue pushes
+    server.create(make_elastic_quota(
+        "serve-q", "serve", min={constants.RESOURCE_TPU: 8.0}))
+    mgr.run_until_idle()
+    mark_running(server, stats, busy(depth=80))
+    pump(mgr, clock, 10)
+    mark_running(server, stats, busy(depth=80))
+    pump(mgr, clock, 10)
+    assert len(fleet_pods(server)) == 2
+    assert ctl.stats()["quota"]["slack_chips"] == 0.0
+
+
+def test_guaranteed_reclaim_sheds_borrowed_replicas_first(rig):
+    server, mgr, clock, ctl, stats, drained = rig
+    server.create(make_elastic_quota(
+        "serve-q", "serve", min={constants.RESOURCE_TPU: 4.0}))
+    server.create(make_elastic_quota(
+        "batch-q", "batch", min={constants.RESOURCE_TPU: 8.0}))
+    mgr.run_until_idle()
+    mark_running(server, stats, busy(depth=80))
+    pump(mgr, clock, 10)        # borrows batch's idle min: 3 replicas
+    pods = fleet_pods(server)
+    mark_running(server, stats, busy(depth=80))
+    pump(mgr, clock, 2)
+    pods = fleet_pods(server)
+    assert len(pods) == 3
+    # mark the two youngest as over-quota (the quota reconciler's
+    # labeling job) so the reclaim path has its victims
+    for p in sorted(pods, key=lambda p: p.metadata.name)[-2:]:
+        server.patch("Pod", p.metadata.name, "serve",
+                     lambda o: o.metadata.labels.update(
+                         {constants.LABEL_CAPACITY:
+                          constants.CAPACITY_OVER_QUOTA}))
+    # a guaranteed namespace's pod goes Pending-unschedulable: the
+    # borrow must be returned
+    server.create(Pod(
+        metadata=ObjectMeta(name="train-0", namespace="batch"),
+        spec=PodSpec(containers=[Container(
+            requests={constants.RESOURCE_TPU: 8.0})]),
+        status=PodStatus(phase="Pending")))
+    server.patch("Pod", "train-0", "batch",
+                 lambda o: o.status.conditions.append(
+                     __import__("nos_tpu.kube.objects",
+                                fromlist=["PodCondition"]).PodCondition(
+                         type="PodScheduled", status="False",
+                         reason="Unschedulable")))
+    for p in fleet_pods(server):
+        stats[p.metadata.name] = idle()     # drains release instantly
+    pump(mgr, clock, 3)
+    left = fleet_pods(server)
+    assert len(left) == 1
+    # the guaranteed replica survived; the borrowed ones were drained
+    assert all(p.metadata.labels.get(constants.LABEL_CAPACITY)
+               != constants.CAPACITY_OVER_QUOTA for p in left)
+    assert len(drained) >= 2
+
+
+def test_restarted_replica_not_misread_and_drift_reported(rig):
+    server, mgr, clock, ctl, stats, _ = rig
+    mgr.run_until_idle()
+    mark_running(server, stats, busy(depth=40))
+    pump(mgr, clock, 4)
+    names = sorted(p.metadata.name for p in fleet_pods(server))
+    ref_cfg = {"pipeline_depth": 2, "decode_steps": 1, "kv_blocks": 64}
+    stats[names[0]] = busy(depth=0, goodput=1.0, uptime=500.0,
+                           config=ref_cfg)
+    for n in names[1:]:
+        server.patch("Pod", n, "serve",
+                     lambda o: setattr(o.status, "phase", "Running"))
+        stats[n] = busy(depth=6, goodput=1.0, uptime=500.0,
+                        config=ref_cfg)
+    pump(mgr, clock, 1)
+    # one replica restarts (uptime regresses) and comes back with
+    # drifted knobs and an empty ledger
+    stats[names[1]] = dict(busy(depth=6, uptime=1.0,
+                                config={"pipeline_depth": 1}),
+                           slo={"goodput": 0.0, "completed": 0})
+    pump(mgr, clock, 1)
+    snap = ctl.stats()
+    assert snap["signals"]["restarted_replicas"] == 1
+    # the fresh process's empty ledger did not crater fleet goodput
+    assert snap["signals"]["goodput"] == 1.0
+    assert snap["config_drift_replicas"] >= 1
+
+
+def test_stats_snapshot_shape(rig):
+    server, mgr, clock, ctl, stats, _ = rig
+    mgr.run_until_idle()
+    snap = ctl.stats()
+    assert snap["fleet"] == "f"
+    assert set(snap["replicas"]) == {"desired", "ready", "starting",
+                                     "draining"}
+    assert "pending_per_replica" in snap["signals"]
+    assert "direction" in snap["decision"]
+
+
+def test_fleet_binary_build_over_http():
+    """The nos-tpu-fleet binary's manager wiring over the real HTTP
+    apiserver: bootstrap creates min_replicas through the remote
+    client, and the manager exposes the controller's /stats snapshot
+    for the HealthServer route."""
+    from nos_tpu.cmd import apiserver as cmd_apiserver
+    from nos_tpu.cmd import fleet as cmd_fleet
+    from nos_tpu.kube.httpapi import RemoteApiServer
+
+    http = cmd_apiserver.build(port=0).start()
+    try:
+        mgr = cmd_fleet.build(
+            RemoteApiServer(http.address),
+            FleetConfig(name="web", namespace="serve",
+                        chips_per_replica=4.0, policy=FAST_POLICY),
+            leader_election=False)
+        mgr.run_until_idle()
+        client = RemoteApiServer(http.address)
+        pods = [p for p in client.list("Pod", namespace="serve")
+                if p.metadata.labels.get(constants.LABEL_FLEET) == "web"]
+        assert len(pods) == 1
+        snap = mgr.stats()
+        assert snap["fleet"] == "web"
+        assert snap["replicas"]["desired"] == 1
+    finally:
+        http.stop()
+
+
+def test_http_replica_client_scrape_and_drain():
+    """HttpReplicaClient against a real nos-tpu-server HTTP surface
+    (jax-free stub engine), addressed by POD IP (the default template
+    — a draining pod leaves Service DNS but keeps its IP): /stats
+    scrape parses, replicas without an IP yet and unreachable replicas
+    read as None, and drain() flips the replica to draining."""
+    import threading
+
+    from test_httpapi import _MillEngine
+
+    from nos_tpu.cmd import fleet as cmd_fleet
+    from nos_tpu.cmd.server import ServerConfig, ServingLoop, \
+        make_http_server
+    from nos_tpu.kube.objects import PodStatus
+
+    loop = ServingLoop(_MillEngine(), config_echo={"max_batch": 8})
+    httpd = make_http_server(ServerConfig(port=0), loop)
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    port = httpd.server_address[1]
+    client = cmd_fleet.HttpReplicaClient("http://{ip}:%d" % port)
+    # no IP yet (pod not started): None, no network attempt
+    unstarted = Pod(metadata=ObjectMeta(name="web-r1", namespace="serve"))
+    assert client.stats(unstarted) is None
+    client.drain(unstarted)     # no-op, never raises
+    pod = Pod(metadata=ObjectMeta(name="web-r1", namespace="serve"),
+              status=PodStatus(phase="Running", pod_ip="127.0.0.1"))
+    try:
+        snap = client.stats(pod)
+        assert snap["config"] == {"max_batch": 8}
+        assert snap["uptime_s"] >= 0
+        client.drain(pod)
+        assert client.stats(pod)["draining"] is True
+    finally:
+        httpd.shutdown()
+        loop.shutdown()
+        httpd.server_close()
+    # dead replica: None, never an exception
+    assert client.stats(pod) is None

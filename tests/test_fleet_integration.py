@@ -1,0 +1,164 @@
+"""Fleet <-> ElasticQuota integration (ISSUE 8 acceptance): the fleet
+borrows only available slack and sheds borrowed replicas when a
+guaranteed namespace reclaims — pinned end-to-end against the REAL
+control plane: in-process API server, the nos scheduler (quota
+admission + preemption), the quota reconciler (used accounting +
+in-quota/over-quota labeling) and the fleet controller, with the
+deterministic sim data plane feeding /stats signals. Everything runs
+on one fake clock.
+"""
+import pytest
+
+from nos_tpu import constants
+from nos_tpu.api.quota import make_elastic_quota
+from nos_tpu.fleet import FleetConfig, FleetController, PolicyConfig
+from nos_tpu.fleet.sim import SimFleet, SimKubelet
+from nos_tpu.kube import ApiServer, Manager
+from nos_tpu.kube.client import Client
+from nos_tpu.kube.objects import (
+    Container, Node, NodeStatus, ObjectMeta, Pod, PodCondition, PodSpec,
+    PodStatus,
+)
+from nos_tpu.quota.controller import ElasticQuotaReconciler
+from nos_tpu.scheduler import Scheduler
+
+CHIPS = 4.0
+TPU = constants.RESOURCE_TPU
+
+
+class FakeClock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+@pytest.fixture
+def rig():
+    server = ApiServer()
+    clock = FakeClock()
+    mgr = Manager(server, clock=clock)
+    mgr.add_controller(ElasticQuotaReconciler().controller())
+    mgr.add_controller(Scheduler().controller())
+    client = Client(server)
+    for i in range(2):
+        server.create(Node(
+            metadata=ObjectMeta(name=f"host-{i}"),
+            status=NodeStatus(capacity={TPU: 8, "cpu": 32},
+                              allocatable={TPU: 8, "cpu": 32})))
+    # serve is guaranteed 4 chips, batch 12: Σmin == cluster capacity,
+    # so everything serve runs beyond one replica is BORROWED slack
+    server.create(make_elastic_quota("serve-q", "serve",
+                                     min={TPU: 4.0}, max={TPU: 16.0}))
+    server.create(make_elastic_quota("batch-q", "batch",
+                                     min={TPU: 12.0}))
+    fleet = SimFleet(clock, slo_ttft_s=10.0, max_batch=8,
+                     tokens_per_s=50.0)
+    ctl = FleetController(
+        FleetConfig(name="web", namespace="serve",
+                    chips_per_replica=CHIPS,
+                    policy=PolicyConfig(
+                        min_replicas=1, max_replicas=6,
+                        queue_high=4.0, queue_low=0.5,
+                        up_stable_s=2.0, down_stable_s=2.0,
+                        up_cooldown_s=3.0, down_cooldown_s=1.0,
+                        max_step_up=2, max_step_down=2),
+                    reconcile_interval_s=1.0, drain_timeout_s=8.0),
+        stats_source=fleet.stats_source, clock=clock)
+    mgr.add_controller(ctl.controller())
+    kubelet = SimKubelet(fleet, clock, fleet_label="web",
+                         namespace="serve", startup_s=2.0)
+    return server, mgr, clock, client, fleet, kubelet, ctl
+
+
+def pump(rig_tuple, seconds, rps=0.0, dt=1.0):
+    server, mgr, clock, client, fleet, kubelet, ctl = rig_tuple
+    t = 0.0
+    carry = 0.0
+    while t < seconds:
+        carry += rps * dt
+        while carry >= 1.0:
+            carry -= 1.0
+            fleet.submit(tokens=40)
+        mgr.run_until_idle()
+        kubelet.sync(client)
+        mgr.run_until_idle()
+        fleet.tick(dt)
+        clock.advance(dt)
+        t += dt
+    mgr.run_until_idle()
+
+
+def serve_pods(server):
+    return sorted(
+        (p for p in server.list("Pod", namespace="serve")
+         if p.metadata.labels.get(constants.LABEL_FLEET) == "web"),
+        key=lambda p: p.metadata.name)
+
+
+def batch_pod(name):
+    return Pod(
+        metadata=ObjectMeta(name=name, namespace="batch"),
+        spec=PodSpec(
+            containers=[Container(requests={TPU: CHIPS})],
+            scheduler_name=constants.SCHEDULER_NAME),
+        status=PodStatus(
+            phase="Pending",
+            conditions=[PodCondition(type="PodScheduled", status="False",
+                                     reason="Unschedulable")]))
+
+
+def test_fleet_borrows_slack_then_sheds_on_guaranteed_reclaim(rig):
+    server, mgr, clock, client, fleet, kubelet, ctl = rig
+
+    # -- phase 1: batch idle, heavy traffic -> the fleet borrows -------
+    pump(rig, 40, rps=30.0)
+    pods = serve_pods(server)
+    running = [p for p in pods if p.status.phase == "Running"]
+    assert len(running) == 4, \
+        f"fleet should grow to the full 16-chip pool (4 own + 12 " \
+        f"borrowed), got {len(running)}"
+    # quota admission held: never past Σmin == 16 chips even though
+    # max_replicas is 6 — the clamp, not the scheduler queue, stopped it
+    assert len(pods) == 4
+    assert ctl.stats()["quota"]["slack_chips"] == 0.0
+    # the quota reconciler accounted and labeled the borrow
+    eq = server.get("ElasticQuota", "serve-q", "serve")
+    assert eq.status.used == {TPU: 16.0}
+    labels = sorted(p.metadata.labels.get(constants.LABEL_CAPACITY)
+                    for p in serve_pods(server))
+    assert labels.count(constants.CAPACITY_OVER_QUOTA) == 3
+    assert labels.count(constants.CAPACITY_IN_QUOTA) == 1
+
+    # -- phase 2: the guaranteed namespace reclaims its min ------------
+    for i in range(3):
+        server.create(batch_pod(f"train-{i}"))
+    submitted_before = fleet.submitted
+    pump(rig, 60, rps=30.0)
+    # batch got its guaranteed chips back (scheduler preemption of
+    # over-quota pods and/or the controller's graceful shed — both
+    # converge here)
+    batch = {p.metadata.name: p
+             for p in server.list("Pod", namespace="batch")}
+    bound = [n for n, p in batch.items() if p.spec.node_name]
+    assert len(bound) == 3, f"guaranteed pods still parked: {batch}"
+    # the fleet backed off to what its own min affords and did NOT
+    # recreate borrowed replicas while the guaranteed namespace is full
+    pods = serve_pods(server)
+    assert len(pods) == 1, [p.metadata.name for p in pods]
+    assert fleet.submitted > submitted_before
+    # lossless: every request displaced off a shed replica was requeued
+    # — conservation holds at fleet level throughout
+    assert fleet.requeued > 0
+    assert fleet.conservation_ok()
+
+    # -- phase 3: batch releases -> the fleet may borrow again ---------
+    for i in range(3):
+        server.delete("Pod", f"train-{i}", "batch")
+    pump(rig, 30, rps=30.0)
+    assert len(serve_pods(server)) > 1
+    assert fleet.conservation_ok()

@@ -1,0 +1,337 @@
+"""Property tests for the fleet scaling policy (ISSUE 8 satellite):
+the damping guarantees the autoscaler's stability rests on, all driven
+deterministically on a fake clock.
+
+- no flapping under a seeded noisy stationary signal (hysteresis +
+  stability windows hold);
+- monotone response to sustained load steps;
+- cooldowns respected across reconcile intervals;
+- scale-down never below min replicas, scale-up never past max.
+"""
+import random
+
+import pytest
+
+from nos_tpu.fleet.policy import (
+    FleetSignals, PolicyConfig, ReplicaStats, ScalingPolicy,
+    parse_replica_stats,
+)
+
+CFG = PolicyConfig(
+    min_replicas=1, max_replicas=8,
+    queue_high=4.0, queue_low=0.5,
+    goodput_floor=0.90, goodput_ceiling=0.98,
+    up_stable_s=15.0, down_stable_s=60.0,
+    up_cooldown_s=30.0, down_cooldown_s=120.0,
+    max_step_up=2, max_step_down=1,
+)
+
+
+def sig(pending_per_replica=0.0, ready=2, goodput=None, ttft=None,
+        oldest=0.0):
+    return FleetSignals(
+        ready_replicas=ready, total_replicas=ready,
+        pending_total=int(pending_per_replica * ready),
+        pending_per_replica=pending_per_replica,
+        goodput=goodput, ttft_p99_s=ttft, oldest_wait_s=oldest)
+
+
+def drive(policy, signal_fn, current, t0=0.0, steps=600, dt=1.0):
+    """Run one decision per dt; apply desired instantly (the
+    best-case actuator). Returns the decision log."""
+    log = []
+    t = t0
+    for _ in range(steps):
+        s = signal_fn(t, current)
+        d = policy.decide(s, current, t)
+        log.append((t, current, d))
+        current = d.desired
+        t += dt
+    return log
+
+
+# ---------------------------------------------------------------------------
+# no flapping
+# ---------------------------------------------------------------------------
+def test_noisy_stationary_signal_never_flaps():
+    """Noise around the middle of the dead band — with occasional
+    single-sample spikes past queue_high — must produce ZERO scaling
+    events: a spike never sustains the stability window, and in-band
+    samples reset the pressure timer."""
+    rng = random.Random(20260804)
+
+    def noisy(t, current):
+        base = 2.0 + rng.uniform(-1.4, 1.4)
+        if rng.random() < 0.08:         # isolated spike past the band
+            base = CFG.queue_high + rng.uniform(0.1, 3.0)
+        return sig(pending_per_replica=base, ready=current)
+
+    policy = ScalingPolicy(CFG)
+    log = drive(policy, noisy, current=3, steps=2000)
+    moves = [(t, d) for t, _, d in log if d.direction != "hold"]
+    assert moves == [], f"noisy stationary signal moved the fleet: " \
+                        f"{moves[:5]}"
+
+
+def test_in_band_oscillation_is_dead():
+    """A signal oscillating anywhere inside [queue_low, queue_high]
+    accumulates intent in NEITHER direction."""
+    policy = ScalingPolicy(CFG)
+    log = drive(
+        policy,
+        lambda t, c: sig(
+            pending_per_replica=CFG.queue_low + 0.01
+            + (CFG.queue_high - CFG.queue_low - 0.02)
+            * (0.5 + 0.5 * ((int(t) % 7) / 6)),
+            ready=c),
+        current=4, steps=1200)
+    assert all(d.direction == "hold" for _, _, d in log)
+
+
+# ---------------------------------------------------------------------------
+# monotone response to sustained load steps
+# ---------------------------------------------------------------------------
+@pytest.mark.parametrize("lo,hi", [(5.0, 9.0), (4.5, 20.0), (6.0, 8.0)])
+def test_sustained_step_response_is_monotone(lo, hi):
+    """A fleet under sustained load ``hi`` is never smaller, at any
+    time, than the same fleet under sustained load ``lo``."""
+    def fleet_sizes(load):
+        policy = ScalingPolicy(CFG)
+        return [cur for _, cur, _ in drive(
+            policy, lambda t, c: sig(pending_per_replica=load, ready=c),
+            current=1, steps=400)]
+
+    small, big = fleet_sizes(lo), fleet_sizes(hi)
+    assert all(b >= s for s, b in zip(small, big)), \
+        "heavier sustained load produced a smaller fleet"
+    assert big[-1] >= small[-1]
+    assert small[-1] > 1        # sustained pressure did scale up
+
+
+def test_sustained_pressure_scales_up_and_brief_pressure_does_not():
+    policy = ScalingPolicy(CFG)
+    # pressure shorter than up_stable_s: no event
+    for t in range(10):
+        d = policy.decide(sig(pending_per_replica=9.0, ready=2), 2,
+                          float(t))
+    assert d.direction == "hold" and d.reason.startswith("stabilizing")
+    # back in band: timer resets
+    policy.decide(sig(pending_per_replica=2.0, ready=2), 2, 10.0)
+    # now sustain past the window: exactly one step fires
+    got_up = None
+    for t in range(11, 40):
+        d = policy.decide(sig(pending_per_replica=9.0, ready=2), 2,
+                          float(t))
+        if d.direction == "up":
+            got_up = (t, d)
+            break
+    assert got_up is not None
+    t_up, d = got_up
+    assert t_up - 11 >= CFG.up_stable_s
+    assert d.desired == 2 + CFG.max_step_up   # magnitude >1 band excess
+
+
+# ---------------------------------------------------------------------------
+# cooldowns
+# ---------------------------------------------------------------------------
+def test_up_cooldown_respected_across_reconcile_intervals():
+    policy = ScalingPolicy(CFG)
+    ups = []
+    current = 1
+
+    def heavy(t, c):
+        return sig(pending_per_replica=50.0, ready=max(1, c))
+
+    t = 0.0
+    for _ in range(1000):
+        d = policy.decide(heavy(t, current), current, t)
+        if d.direction == "up":
+            ups.append(t)
+        current = d.desired
+        t += 1.0
+    assert len(ups) >= 2
+    gaps = [b - a for a, b in zip(ups, ups[1:])]
+    assert all(g >= CFG.up_cooldown_s for g in gaps), gaps
+
+
+def test_down_cooldown_and_stability_respected():
+    policy = ScalingPolicy(CFG)
+    downs = []
+    current = 8
+    t = 0.0
+    for _ in range(3000):
+        d = policy.decide(sig(pending_per_replica=0.0, ready=current,
+                              goodput=1.0), current, t)
+        if d.direction == "down":
+            downs.append(t)
+        current = d.desired
+        t += 1.0
+    assert len(downs) >= 2
+    assert downs[0] >= CFG.down_stable_s
+    gaps = [b - a for a, b in zip(downs, downs[1:])]
+    assert all(g >= CFG.down_cooldown_s for g in gaps), gaps
+    assert current == CFG.min_replicas      # idles all the way down...
+
+
+# ---------------------------------------------------------------------------
+# bounds
+# ---------------------------------------------------------------------------
+def test_bounds_hold_under_adversarial_signals():
+    rng = random.Random(7)
+    policy = ScalingPolicy(CFG)
+    current = 3
+    t = 0.0
+    for _ in range(5000):
+        load = rng.choice([0.0, 0.0, 100.0, 100.0, 2.0])
+        d = policy.decide(
+            sig(pending_per_replica=load, ready=max(1, current),
+                goodput=rng.choice([None, 0.5, 1.0])),
+            current, t)
+        assert CFG.min_replicas <= d.desired <= CFG.max_replicas
+        # single-decision step limits
+        assert d.desired - current <= CFG.max_step_up
+        assert current - d.desired <= max(CFG.max_step_down,
+                                          current - CFG.min_replicas)
+        current = d.desired
+        t += 1.0
+
+
+def test_below_min_restores_immediately_without_damping():
+    policy = ScalingPolicy(CFG)
+    d = policy.decide(sig(ready=0), 0, 0.0)
+    assert d.direction == "up" and d.desired == CFG.min_replicas
+    assert d.reason == "min_replicas"
+
+
+# ---------------------------------------------------------------------------
+# signal plumbing: goodput trigger, restart/drift detection
+# ---------------------------------------------------------------------------
+def test_goodput_floor_triggers_without_queue():
+    policy = ScalingPolicy(CFG)
+    up = None
+    for t in range(100):
+        d = policy.decide(
+            sig(pending_per_replica=0.1, ready=2, goodput=0.5), 2,
+            float(t))
+        if d.direction == "up":
+            up = d
+            break
+    assert up is not None and up.reason == "goodput"
+
+
+def test_restarted_replicas_excluded_from_slo_aggregates():
+    """A replica whose uptime regressed (fresh process) contributes its
+    queue but not its empty goodput — collapsed-load misreads are the
+    failure mode the uptime echo exists to prevent."""
+    fresh = parse_replica_stats("r1", {
+        "healthy": True, "uptime_s": 2.0, "active_slots": 0,
+        "pending": {"depth": 6, "oldest_wait_s": 1.0},
+        "slo": {"goodput": 0.0, "completed": 1},
+        "per_request": {"ttft_p99_s": 0.0},
+    }, prev_uptime_s=500.0)
+    assert fresh.restarted
+    old = parse_replica_stats("r2", {
+        "healthy": True, "uptime_s": 900.0, "active_slots": 4,
+        "pending": {"depth": 2, "oldest_wait_s": 0.2},
+        "slo": {"goodput": 1.0, "completed": 50},
+        "per_request": {"ttft_p99_s": 0.3},
+    }, prev_uptime_s=899.0)
+    assert not old.restarted
+    s = FleetSignals.aggregate([fresh, old])
+    assert s.goodput == 1.0             # fresh ledger not misread
+    assert s.pending_total == 8         # but its queue is real work
+    assert s.ttft_p99_s == 0.3
+    assert s.restarted_replicas == 1
+
+
+def test_unscraped_and_draining_replicas_read_as_not_ready():
+    gone = parse_replica_stats("r1", None)
+    assert not gone.ready and not gone.healthy
+    draining = parse_replica_stats("r2", {
+        "healthy": True, "draining": True, "uptime_s": 5.0,
+        "pending": {"depth": 0}, "slo": {}, "per_request": {},
+    })
+    assert not draining.ready
+    s = FleetSignals.aggregate([gone, draining])
+    assert s.ready_replicas == 0
+
+
+def test_policy_config_validation():
+    with pytest.raises(ValueError, match="hysteresis"):
+        ScalingPolicy(PolicyConfig(queue_low=5.0, queue_high=4.0))
+    with pytest.raises(ValueError, match="min_replicas"):
+        ScalingPolicy(PolicyConfig(min_replicas=5, max_replicas=2))
+    with pytest.raises(ValueError, match="goodput_floor"):
+        ScalingPolicy(PolicyConfig(goodput_floor=0.99,
+                                   goodput_ceiling=0.9))
+
+
+def test_all_replicas_unready_with_queued_work_is_pressure():
+    """A fleet whose replicas are all recovering/draining while clients
+    queue must register pressure (no_ready_replicas), not silence:
+    queue depth aggregates over every scraped replica, ready or not."""
+    recovering = parse_replica_stats("r1", {
+        "healthy": True, "recovering": True, "uptime_s": 5.0,
+        "pending": {"depth": 5, "oldest_wait_s": 3.0},
+        "slo": {}, "per_request": {},
+    })
+    assert not recovering.ready
+    s = FleetSignals.aggregate([recovering, recovering])
+    assert s.ready_replicas == 0 and s.pending_total == 10
+    policy = ScalingPolicy(CFG)
+    up = None
+    for t in range(60):
+        d = policy.decide(s, 2, float(t))
+        if d.direction == "up":
+            up = d
+            break
+    assert up is not None and up.reason == "no_ready_replicas"
+
+
+def test_step_limit_zero_disables_direction():
+    """max_step_up/max_step_down = 0 means 'never scale that way' (the
+    HPA idiom) — not a forced 1-replica step."""
+    no_down = ScalingPolicy(PolicyConfig(
+        min_replicas=1, max_replicas=8, max_step_down=0,
+        down_stable_s=1.0, down_cooldown_s=1.0))
+    current = 5
+    for t in range(200):
+        d = no_down.decide(sig(pending_per_replica=0.0, ready=current,
+                               goodput=1.0), current, float(t))
+        current = d.desired
+    assert current == 5                 # never shrank
+    no_up = ScalingPolicy(PolicyConfig(
+        min_replicas=1, max_replicas=8, max_step_up=0,
+        up_stable_s=1.0, up_cooldown_s=1.0))
+    current = 2
+    for t in range(200):
+        d = no_up.decide(sig(pending_per_replica=50.0, ready=current),
+                         current, float(t))
+        current = d.desired
+    assert current == 2                 # never grew
+    with pytest.raises(ValueError, match="max_step"):
+        ScalingPolicy(PolicyConfig(max_step_up=-1))
+
+
+def test_scale_to_zero_fleet_does_not_flap_awake():
+    """min_replicas=0: an idle fleet scales to zero and STAYS there —
+    emptiness alone is not pressure (a zero-replica fleet has no queue
+    to observe; waking it needs traffic an activator would route)."""
+    policy = ScalingPolicy(PolicyConfig(
+        min_replicas=0, max_replicas=4,
+        down_stable_s=2.0, down_cooldown_s=1.0,
+        up_stable_s=1.0, up_cooldown_s=1.0))
+    current = 1
+    woke = []
+    for t in range(300):
+        ready = current
+        d = policy.decide(
+            FleetSignals(ready_replicas=ready, total_replicas=current,
+                         pending_total=0, pending_per_replica=0.0,
+                         goodput=None),
+            current, float(t))
+        if d.direction == "up":
+            woke.append((t, d.reason))
+        current = d.desired
+    assert current == 0
+    assert woke == [], f"scaled-to-zero fleet flapped awake: {woke}"

@@ -783,3 +783,223 @@ generations:
     bad.write_text("generations:\n  - name: x\n")
     with pytest.raises(ValueError):
         topology.load_generations_file(str(bad))
+
+
+# ---------------------------------------------------------------------------
+# serving wire: machine-readable shed reasons + /stats echo + /admin/drain
+# (ISSUE 8 satellites)
+# ---------------------------------------------------------------------------
+
+def test_serving_http_shed_reasons_are_machine_readable():
+    """429/400 bodies carry a ``reason`` slug (queue_full /
+    hbm_admission / deadline_unmeetable / infeasible) so the fleet
+    controller can tell capacity pressure from deadline pressure from
+    memory pressure without parsing prose."""
+    from nos_tpu.cmd.server import ServingLoop
+    from nos_tpu.models.errors import Infeasible, QueueFull
+
+    class Engine:
+        def has_work(self):
+            return False
+
+        def step(self):
+            return 0
+
+        def submit(self, prompt, max_new_tokens, **kw):
+            if len(prompt) >= 20:
+                raise Infeasible("needs 99 KV blocks, pool has 3")
+            if len(prompt) >= 10:
+                raise QueueFull(
+                    "4 waiting on KV-block/HBM headroom",
+                    reason="hbm_admission")
+            raise QueueFull("8 requests already waiting (max_pending)")
+
+        def pop_result(self, rid):
+            return None
+
+        def progress(self, rid):
+            return None
+
+    loop = ServingLoop(Engine())
+    httpd, url = _serve_loop(loop)
+
+    def shed(prompt_len, extra=None):
+        body = {"prompt": [1] * prompt_len, "max_new_tokens": 2}
+        body.update(extra or {})
+        with pytest.raises(urllib.error.HTTPError) as e:
+            _post_json(url, body)
+        return e.value.code, json.loads(e.value.read())
+
+    try:
+        code, body = shed(20)
+        assert (code, body["reason"]) == (400, "infeasible")
+        assert body["infeasible"] is True
+        code, body = shed(10)
+        assert (code, body["reason"]) == (429, "hbm_admission")
+        code, body = shed(1)
+        assert (code, body["reason"]) == (429, "queue_full")
+        # malformed requests get a reason too (never confused with sheds)
+        with pytest.raises(urllib.error.HTTPError) as e:
+            _post_json(url, {"prompt": "oops"})
+        assert e.value.code == 400
+        assert json.loads(e.value.read())["reason"] == "bad_request"
+    finally:
+        httpd.shutdown()
+        loop.shutdown()
+        httpd.server_close()
+
+
+def test_serving_http_deadline_shed_reason_on_the_wire():
+    from nos_tpu.cmd.server import ServingLoop
+
+    loop = ServingLoop(_MillEngine())
+    httpd, url = _serve_loop(loop)
+    try:
+        # seed the rolling estimates (10ms TTFT, 0.5ms TPOT)
+        _post_json(url, {"prompt": [1], "max_new_tokens": 20})
+        with pytest.raises(urllib.error.HTTPError) as e:
+            _post_json(url, {"prompt": [1], "max_new_tokens": 100_000,
+                             "deadline_s": 0.001})
+        assert e.value.code == 429
+        assert json.loads(e.value.read())["reason"] \
+            == "deadline_unmeetable"
+    finally:
+        httpd.shutdown()
+        loop.shutdown()
+        httpd.server_close()
+
+
+def test_serving_http_stats_uptime_and_config_echo():
+    """/stats carries ``uptime_s`` + a config echo (ISSUE 8 satellite):
+    the fleet controller detects replica restarts (uptime regression)
+    and config drift between scrapes instead of misreading a fresh
+    engine's empty rates as collapsed load."""
+    import time as _t
+
+    from nos_tpu.cmd.server import ServingLoop
+
+    echo = {"max_batch": 4, "pipeline_depth": 2, "decode_steps": 1,
+            "kv_block_size": 16, "kv_blocks": 64, "kv_swap": True,
+            "max_seq": 512}
+    loop = ServingLoop(_MillEngine(), config_echo=echo)
+    httpd, url = _serve_loop(loop)
+    try:
+        snap = json.loads(urllib.request.urlopen(
+            url + "/stats", timeout=10).read())
+        assert snap["config"] == echo
+        assert snap["uptime_s"] >= 0
+        # per-request percentiles start empty, fill on completion (the
+        # fleet controller's TTFT-p99 trigger reads this key)
+        assert snap["per_request"] == {"window": 0, "ttft_p99_s": None}
+        _post_json(url, {"prompt": [1], "max_new_tokens": 5})
+        _t.sleep(0.02)
+        snap2 = json.loads(urllib.request.urlopen(
+            url + "/stats", timeout=10).read())
+        assert snap2["uptime_s"] > snap["uptime_s"]
+        assert snap2["per_request"]["window"] == 1
+        assert snap2["per_request"]["ttft_p99_s"] == 0.01  # mill ledger
+    finally:
+        httpd.shutdown()
+        loop.shutdown()
+        httpd.server_close()
+
+
+def test_serving_http_admin_drain_flips_readiness_and_sheds():
+    """POST /admin/drain — the fleet controller's graceful scale-down
+    hook: admission stops (503), /readyz reports draining (the Service
+    pulls the endpoint), /healthz stays green, and /stats shows the
+    drain so the controller knows when to release the pod."""
+    from nos_tpu.cmd.server import ServingLoop
+
+    loop = ServingLoop(_MillEngine())
+    httpd, url = _serve_loop(loop)
+    try:
+        req = urllib.request.Request(
+            url + "/admin/drain", data=b"{}", method="POST",
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=10) as r:
+            assert json.loads(r.read())["status"] == "draining"
+        with pytest.raises(urllib.error.HTTPError) as e:
+            urllib.request.urlopen(url + "/readyz", timeout=10)
+        assert e.value.code == 503
+        assert json.loads(e.value.read())["status"] == "draining"
+        with urllib.request.urlopen(url + "/healthz", timeout=10) as r:
+            assert r.status == 200
+        with pytest.raises(urllib.error.HTTPError) as e:
+            _post_json(url, {"prompt": [1], "max_new_tokens": 2})
+        assert e.value.code == 503
+        snap = json.loads(urllib.request.urlopen(
+            url + "/stats", timeout=10).read())
+        assert snap["draining"] is True
+        # drains are reversible (the endpoint shares the serving
+        # port's trust domain — a mistaken drain must not brick the
+        # replica until pod deletion): /admin/undrain resumes service
+        req = urllib.request.Request(
+            url + "/admin/undrain", data=b"{}", method="POST",
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=10) as r:
+            assert json.loads(r.read())["status"] == "ok"
+        with urllib.request.urlopen(url + "/readyz", timeout=10) as r:
+            assert r.status == 200
+        assert _post_json(url, {"prompt": [3], "max_new_tokens": 2})[
+            "tokens"] == [3, 1, 2]
+    finally:
+        httpd.shutdown()
+        loop.shutdown()
+        httpd.server_close()
+
+
+def test_metricsexporter_quota_slack_gauges_and_snapshot():
+    """Per-namespace quota-slack surfaces (ISSUE 8 satellite): the
+    exporter computes borrowable chips (a namespace's own unused min)
+    and guaranteed-overquota chips (its fair share of the cluster
+    borrowable pool) from the quota aggregates, exports them as
+    labeled gauges and mirrors them into the JSON snapshot."""
+    from nos_tpu.cmd import apiserver as cmd_apiserver
+    from nos_tpu.cmd.metricsexporter import collect
+    from nos_tpu.kube.client import Client
+    from nos_tpu.kube.httpapi import RemoteApiServer
+    from nos_tpu.utils.metrics import default_registry
+
+    http = cmd_apiserver.build(port=0).start()
+    try:
+        remote = RemoteApiServer(http.address)
+        ga = make_elastic_quota("qa", "team-a",
+                                min={"google.com/tpu": 8})
+        ga.status.used = {"google.com/tpu": 2}      # 6 borrowable
+        remote.create(ga)
+        gb = make_elastic_quota("qb", "team-b",
+                                min={"google.com/tpu": 4})
+        gb.status.used = {"google.com/tpu": 4}      # fully used
+        remote.create(gb)
+        doc = collect(Client(remote))
+        assert doc["quota_slack"]["team-a"]["borrowable_chips"] == 6
+        assert doc["quota_slack"]["team-b"]["borrowable_chips"] == 0
+        # guaranteed split of the 6-chip pool proportional to min
+        # share (8:4), floored: team-a 4, team-b 2
+        assert doc["quota_slack"]["team-a"][
+            "guaranteed_overquota_chips"] == 4
+        assert doc["quota_slack"]["team-b"][
+            "guaranteed_overquota_chips"] == 2
+        reg = default_registry()
+        assert reg.gauge("nos_tpu_quota_borrowable_chips", "",
+                         ("namespace",)).value("team-a") == 6
+        assert reg.gauge("nos_tpu_quota_guaranteed_overquota_chips",
+                         "", ("namespace",)).value("team-b") == 2
+        # a composite spanning several namespaces exports ONE series
+        # (joined member label) — per-member rows would each carry the
+        # full slack and sum() would over-count the pool
+        from nos_tpu.api.quota import make_composite_elastic_quota
+
+        ceq = make_composite_elastic_quota(
+            "teams-cd", "", ["team-d", "team-c"],
+            min={"google.com/tpu": 8})
+        ceq.status.used = {"google.com/tpu": 2}
+        remote.create(ceq)
+        doc = collect(Client(remote))
+        assert doc["quota_slack"]["team-c,team-d"][
+            "borrowable_chips"] == 6
+        assert "team-c" not in doc["quota_slack"]
+        assert "team-d" not in doc["quota_slack"]
+    finally:
+        http.stop()

@@ -289,6 +289,51 @@ def test_infeasible_vs_queuefull_split(engines, params):
     assert_pool_balanced(srv)
 
 
+def test_memory_blocked_queue_sheds_with_hbm_admission_reason(
+        engines, params):
+    """Free SLOTS but no KV headroom: the waiting line past max_pending
+    sheds with reason="hbm_admission" (ISSUE 8 satellite) instead of
+    growing unbounded — the wire tells memory pressure from slot
+    scarcity, and before this shed existed the queue had NO bound at
+    all whenever memory (not slots) was the bottleneck."""
+    srv = engines(1, 1, mb=2, blocks=4)     # 3 usable blocks, 2 slots
+    srv.max_pending = 1
+    try:
+        a = srv.submit([1] * 10, 10)        # needs the whole pool
+        srv.submit([2] * 10, 10)            # waits on headroom
+        assert srv._admit_blocked and srv._free    # slot free, blocked
+        with pytest.raises(QueueFull) as e:
+            srv.submit([3] * 10, 2)
+        assert e.value.reason == "hbm_admission"
+        assert "headroom" in str(e.value)
+        res = srv.drain()
+        assert res[a] == ref(params, [1] * 10, 10)
+    finally:
+        srv.max_pending = 0
+        srv.drain()
+    assert_pool_balanced(srv)
+
+
+def test_shed_reasons_on_error_types(engines):
+    """The machine-readable reason slugs ride the exception types."""
+    srv = engines(1, 1, mb=1, blocks=4)
+    with pytest.raises(Infeasible) as e:
+        srv.submit([1] * 40, 40)
+    assert e.value.reason == "infeasible"
+    srv.max_pending = 1
+    try:
+        first = srv.submit([1, 2], 4)
+        srv.submit([3, 4], 4)
+        with pytest.raises(QueueFull) as e:
+            srv.submit([5, 6], 2)
+        assert e.value.reason == "queue_full"
+        assert first in srv.drain()
+    finally:
+        srv.max_pending = 0
+        srv.drain()
+    assert_pool_balanced(srv)
+
+
 def test_prefix_evicted_for_waiting_request_while_others_decode(
         engines, params):
     # a pending request must not stall behind idle prefix-cache blocks
