@@ -79,6 +79,36 @@ if SMOKE:
     PAGED_SLOTS = 6
     PAGED_TRACE = [(16 + 8 * (i % 3), 16) for i in range(8)]
 
+# speculative section: the spec engine over PAGED KV at every unpinned
+# (pipeline_depth, decode_steps) — TPOT and tokens-per-dispatch, plus
+# the structural dispatch gap (depth >= 2 must not be worse than depth
+# 1: that inequality is the acceptance gate this PR un-forfeits). The
+# draft is a quarter-ish model sharing the target vocab; acceptance on
+# random weights is near zero, which is the CONSERVATIVE case for the
+# gate (every window pays full draft cost for ~1 committed token).
+SPEC_GRID = [(d, t) for d in (1, 2) for t in (1, 4)]
+SPEC_DRAFT_N = 4
+SPEC_BATCH, SPEC_PROMPT, SPEC_NEW = 4, 32, 24
+SPEC_MAX_LEN = 256
+if SMOKE:
+    SPEC_BATCH, SPEC_PROMPT, SPEC_NEW = 4, 24, 16
+    SPEC_MAX_LEN = 128
+
+# int8-vs-bf16 paged concurrency at the SAME HBM byte budget: the bf16
+# pool gets the paged section's token budget in BYTES; the int8 pool
+# gets the same bytes, which (per-token scale overhead included) buys
+# ~1.8-2x the blocks — the sustained-concurrency ratio is the headline
+# (acceptance floor 1.5x). Deterministic: slot counts and admission
+# order decide it, not timing.
+INT8_TRACE = [(48 + 16 * (i % 8), 32) for i in range(24)]
+# SAME slot count for both engines: the block pool must be the binding
+# constraint (a slot-capped bf16 rep would flatter the ratio down),
+# so the only difference between the reps is bytes-per-token
+INT8_SLOTS = 16
+if SMOKE:
+    INT8_TRACE = [(16 + 8 * (i % 3), 16) for i in range(16)]
+    INT8_SLOTS = 12
+
 
 def main():
     import jax
@@ -253,24 +283,35 @@ def main():
     trace = [([int(x) for x in host_rng.integers(0, cfg.vocab, plen)], n)
              for plen, n in PAGED_TRACE]
 
-    def concurrency_rep(eng, paged_engine):
-        for plen in sorted({len(p) for p, _ in trace}):  # warm compiles
+    def concurrency_rep(eng, paged_engine, rep_trace=None):
+        rep_trace = trace if rep_trace is None else rep_trace
+        for plen in sorted({len(p) for p, _ in rep_trace}):  # warm
             eng.submit([1] * plen, 2)
         eng.drain()
-        for toks, n in trace:
+        for toks, n in rep_trace:
             eng.submit(toks, n)
         samples = []
+        backlog = []
         t0 = time.perf_counter()
         while eng.has_work():
             eng.step()
             samples.append(len(eng._active))
+            if eng._pending:
+                # pool-limited ticks: requests are waiting, so active
+                # slots == what the KV budget admits — the structural
+                # concurrency figure, undiluted by the drain-down tail
+                # (a bigger pool finishes its backlog sooner and would
+                # otherwise be penalized with more few-active samples)
+                backlog.append(len(eng._active))
         wall = time.perf_counter() - t0
         done = eng.drain()
-        assert len(done) >= len(trace)
-        new_tokens = sum(n for _, n in trace)
+        assert len(done) >= len(rep_trace)
+        new_tokens = sum(n for _, n in rep_trace)
         rep = {
             "slots": eng.max_batch,
             "avg_active_slots": round(sum(samples) / len(samples), 3),
+            "avg_active_backlogged": round(
+                sum(backlog) / len(backlog), 3) if backlog else None,
             "peak_active_slots": max(samples),
             "wall_s": round(wall, 4),
             "tokens_per_s": round(new_tokens / wall),
@@ -302,6 +343,125 @@ def main():
         "concurrency_ratio": round(
             paged_rep["avg_active_slots"]
             / max(static_rep["avg_active_slots"], 1e-9), 3),
+    }
+
+    # ------------------------------------------------------------------
+    # speculative decoding over paged KV at every unpinned
+    # (pipeline_depth, decode_steps). TPOT here is decode wall per new
+    # token (the user-facing per-token latency of the burst); the
+    # structural claim — the acceptance gate — is that depth 2 is not
+    # worse than depth 1 on the engine's own dispatch-gap accounting
+    # AND on TPOT (best of 3 reps, so one GC pause can't flip it).
+    from nos_tpu.models.spec_serving import SpeculativeDecodeServer
+
+    spec_tcfg = tr.TransformerConfig(**MODEL)
+    spec_dcfg = tr.TransformerConfig(**dict(
+        MODEL, d_model=MODEL["d_model"] // 2, n_layers=1,
+        d_ff=MODEL["d_ff"] // 2, n_heads=max(2, MODEL["n_heads"] // 2),
+        n_kv_heads=1))
+    spec_tp = params
+    spec_dp = tr.init_params(jax.random.PRNGKey(7), spec_dcfg)
+    spec_prompts = [
+        [int(x) for x in host_rng.integers(0, spec_tcfg.vocab,
+                                           SPEC_PROMPT)]
+        for _ in range(SPEC_BATCH)]
+    spec_blocks = SPEC_BATCH * (SPEC_MAX_LEN // KV_BLOCK) + 1
+
+    def spec_rep(depth, steps):
+        eng = SpeculativeDecodeServer(
+            spec_tp, spec_tcfg, spec_dp, spec_dcfg,
+            n_draft=SPEC_DRAFT_N, max_batch=SPEC_BATCH,
+            max_len=SPEC_MAX_LEN, pipeline_depth=depth,
+            decode_steps=steps, kv_block_size=KV_BLOCK,
+            kv_blocks=spec_blocks)
+        for toks in spec_prompts:                        # warm compiles
+            eng.submit(toks, 2)
+        eng.drain()
+        eng.drain_ledgers()
+        best = None
+        for _ in range(3):
+            for toks in spec_prompts:
+                eng.submit(toks, SPEC_NEW)
+            eng.reset_dispatch_stats()
+            tok0, tick0 = eng.tokens_emitted, eng.ticks_dispatched
+            t0 = time.perf_counter()
+            done = eng.drain()
+            wall = time.perf_counter() - t0
+            assert len(done) == len(spec_prompts)
+            eng.drain_ledgers()
+            new = len(spec_prompts) * (SPEC_NEW - 1)
+            ticks = max(1, eng.ticks_dispatched - tick0)
+            rep = {
+                "pipeline_depth": depth,
+                "decode_steps": steps,
+                "n_draft": SPEC_DRAFT_N,
+                "decode_s": round(wall, 4),
+                "tpot_ms": round(1e3 * wall / new, 4),
+                "tokens_per_dispatch": round(
+                    (eng.tokens_emitted - tok0) / ticks, 3),
+                "dispatch_gap_s": round(eng.dispatch_gap_s, 4),
+                "host_blocked_us_per_token": round(
+                    1e6 * eng.dispatch_gap_s / new, 1),
+                "acceptance": round(
+                    eng.spec_accepted / max(1, eng.spec_drafted), 4),
+            }
+            if best is None or rep["tpot_ms"] < best["tpot_ms"]:
+                best = rep
+        return best
+
+    spec_grid = [spec_rep(d, t) for d, t in SPEC_GRID]
+    spec_tpot = {(p["pipeline_depth"], p["decode_steps"]): p["tpot_ms"]
+                 for p in spec_grid}
+    spec_section = {
+        "kv": "paged",
+        "grid": spec_grid,
+        # the un-forfeited pipelining win, stated as the ISSUE
+        # acceptance reads it: the spec engine's own depth-2 TPOT vs
+        # its own depth-1 (same decode_steps)
+        "tpot_depth1_ms": spec_tpot[(1, 1)],
+        "tpot_depth2_ms": spec_tpot[(2, 1)],
+        "depth2_not_worse": spec_tpot[(2, 1)] <= spec_tpot[(1, 1)],
+    }
+
+    # ------------------------------------------------------------------
+    # bf16 vs int8 paged KV at the SAME HBM byte budget. Bytes/token:
+    # bf16 = 2 (k+v) x L x Hkv x D x 2B; int8 = 2 x L x Hkv x (D x 1B
+    # + 4B f32 scale). The same byte budget therefore buys the int8
+    # arena ~1.8-2x the blocks — which the mixed trace converts into
+    # sustained concurrent slots (acceptance floor 1.5x).
+    hkv = cfg.kv_heads
+    bpt_bf16 = 2 * cfg.n_layers * hkv * cfg.head_dim * 2
+    bpt_int8 = 2 * cfg.n_layers * hkv * (cfg.head_dim + 4)
+    budget_bytes = PAGED_STATIC_SLOTS * PAGED_MAX_LEN * bpt_bf16
+    blocks_bf16 = budget_bytes // (KV_BLOCK * bpt_bf16) + 1
+    blocks_int8 = budget_bytes // (KV_BLOCK * bpt_int8) + 1
+    int8_trace = [
+        ([int(x) for x in host_rng.integers(0, cfg.vocab, plen)], n)
+        for plen, n in INT8_TRACE]
+    bf16_rep = concurrency_rep(
+        DecodeServer(params, cfg, max_batch=INT8_SLOTS,
+                     max_len=PAGED_MAX_LEN, kv_block_size=KV_BLOCK,
+                     kv_blocks=blocks_bf16), True, int8_trace)
+    int8_rep = concurrency_rep(
+        DecodeServer(params, cfg, max_batch=INT8_SLOTS,
+                     max_len=PAGED_MAX_LEN, kv_block_size=KV_BLOCK,
+                     kv_blocks=blocks_int8, kv_dtype="int8"),
+        True, int8_trace)
+    int8_section = {
+        "budget_bytes": budget_bytes,
+        "bytes_per_token": {"bf16": bpt_bf16, "int8": bpt_int8},
+        "kv_blocks": {"bf16": blocks_bf16, "int8": blocks_int8},
+        "trace_requests": len(int8_trace),
+        "bf16": bf16_rep,
+        "int8": int8_rep,
+        # the headline: sustained slots at the same HBM byte budget,
+        # measured over pool-limited (backlogged) ticks — acceptance
+        # floor 1.5x (the byte math alone predicts ~1.8-2x)
+        "concurrency_ratio": round(
+            (int8_rep["avg_active_backlogged"]
+             or int8_rep["avg_active_slots"])
+            / max(bf16_rep["avg_active_backlogged"]
+                  or bf16_rep["avg_active_slots"], 1e-9), 3),
     }
 
     # the first token of each request is emitted by prefill (inside the
@@ -340,6 +500,8 @@ def main():
         "pipeline": pipeline,
         "fused_decode": fused,
         "paged": paged_section,
+        "speculative": spec_section,
+        "kv_int8": int8_section,
         "prefix_cache": {
             "shared_prefix_tokens": sys_len,
             "prefill_admit_s": round(t_submit_pc, 3),

@@ -150,6 +150,16 @@ class ServerConfig:
     # false = drop the KV and recompute it from the tokens on resume
     # (no host RAM, more FLOPs). Both are bit-exact.
     kv_swap: bool = True
+    # paged-KV storage dtype: bf16 (the model dtype) or int8 —
+    # quantized on the paged scatter with per-block scales, dequantized
+    # on the gather. int8 roughly halves KV bytes per token, so a fixed
+    # HBM budget holds ~2x the blocks and sustains ~2x the concurrent
+    # slots; greedy serving stays self-consistent (token-identical to a
+    # reference generate through the same int8 KV path — tested), at a
+    # small bounded numeric delta vs bf16. Requires kv_blocks > 0: the
+    # slot-static engine has no per-block scale storage and the server
+    # rejects the combination with a clear error.
+    kv_dtype: str = "bf16"
     # HBM backstop on admission (0 = off): defer admitting while
     # device bytes_in_use / bytes_limit exceeds this fraction, per the
     # same memory_stats() the HBM gauges sample (backends without
@@ -159,8 +169,13 @@ class ServerConfig:
     # draft model proposes draft_n_tokens per tick, the target verifies
     # them in one wide forward. Greedy requests stay bit-identical to
     # plain decoding; sampled requests keep the exact target
-    # distribution (accept-reject). Draft dims below must match the
-    # draft checkpoint's training config.
+    # distribution (accept-reject). The speculative engine rides the
+    # FULL dispatch template: pipeline_depth/decode_steps/paged-KV/
+    # kv_dtype all apply (a fused dispatch commits up to
+    # decode_steps * draft_n_tokens tokens per slot, accept/reject
+    # resolves in-graph so pipelined windows never wait on the host).
+    # Draft dims below must match the draft checkpoint's training
+    # config.
     draft_checkpoint_dir: str = ""
     draft_d_model: int = 256
     draft_n_layers: int = 2
@@ -373,6 +388,32 @@ class ServingLoop:
                 ("mode",))
             for mode in ("swap", "recompute"):
                 self.m_preempt.labels(mode).inc(0)
+        # speculative decoding (registered only on a speculative
+        # engine — a plain decode server must not export dead zero
+        # series): proposals drafted vs accepted by verify, plus the
+        # accepted-per-verify-window distribution. accepted/draft is
+        # the live acceptance rate; a sagging rate means the draft has
+        # drifted from the traffic and speculation is burning draft
+        # FLOPs for rollbacks.
+        self._spec_seen = {"drafted": 0, "accepted": 0}
+        if hasattr(engine, "spec_drafted"):
+            self.m_spec_draft = reg.counter(
+                "nos_tpu_serve_spec_draft_total",
+                "Draft-model proposals submitted to verify windows "
+                "(n_draft per round per active slot)")
+            self.m_spec_accepted = reg.counter(
+                "nos_tpu_serve_spec_accepted_total",
+                "Draft proposals accepted by target verification; "
+                "divided by nos_tpu_serve_spec_draft_total this is the "
+                "live acceptance rate")
+            self.h_spec_window = reg.histogram(
+                "nos_tpu_serve_spec_accepted_per_window",
+                "Accepted proposals per verify window (0..n_draft); "
+                "mass near n_draft means speculation is paying, mass "
+                "at 0 means the draft is guessing wrong",
+                buckets=(0.0, 1.0, 2.0, 3.0, 4.0, 6.0, 8.0, 12.0, 16.0))
+            self.m_spec_draft.inc(0)
+            self.m_spec_accepted.inc(0)
         self.m_compiles = reg.counter(
             "nos_tpu_serve_compiles_total",
             "XLA compiles observed by the engine (first dispatch per "
@@ -1092,6 +1133,7 @@ class ServingLoop:
                 return
             self.engine = new_engine
             self._preempt_seen = {"swap": 0, "recompute": 0}
+            self._spec_seen = {"drafted": 0, "accepted": 0}
             resumed = {"swap": 0, "recompute": 0}
             lost = 0
             seen = set()
@@ -1367,6 +1409,22 @@ class ServingLoop:
             active, pending = occupancy()
             self.g_active.set(active)
             self.g_pending.set(pending)
+        drafted = getattr(self.engine, "spec_drafted", None)
+        if drafted is not None and hasattr(self, "m_spec_draft"):
+            d_delta = drafted - self._spec_seen["drafted"]
+            if d_delta > 0:
+                self.m_spec_draft.inc(d_delta)
+                self._spec_seen["drafted"] = drafted
+            accepted = self.engine.spec_accepted
+            a_delta = accepted - self._spec_seen["accepted"]
+            if a_delta > 0:
+                self.m_spec_accepted.inc(a_delta)
+                self._spec_seen["accepted"] = accepted
+            events = self.engine.spec_window_events
+            if events:
+                self.engine.spec_window_events = []
+                for a in events:
+                    self.h_spec_window.observe(float(a))
         kv_stats = getattr(self.engine, "kv_stats", None)
         kv = kv_stats() if kv_stats is not None else None
         if kv:
@@ -1630,6 +1688,18 @@ def build_engine(cfg: ServerConfig):
     if cfg.decode_steps < 1:
         raise ValueError(
             f"decode_steps must be >= 1, got {cfg.decode_steps}")
+    if cfg.kv_dtype not in ("bf16", "int8"):
+        raise ValueError(
+            f"kv_dtype must be bf16|int8, got {cfg.kv_dtype!r}")
+    if cfg.kv_dtype == "int8" and not cfg.kv_blocks:
+        raise ValueError(
+            "kv_dtype=int8 requires the paged KV cache: set "
+            "kv_blocks/kv_block_size (the slot-static engine has no "
+            "per-block scale storage, so int8 KV is not supported "
+            "there) — or run kv_dtype=bf16")
+    if cfg.draft_checkpoint_dir and cfg.draft_n_tokens < 1:
+        raise ValueError(
+            f"draft_n_tokens must be >= 1, got {cfg.draft_n_tokens}")
     if cfg.kv_blocks:
         bs = cfg.kv_block_size
         if bs < 8 or bs & (bs - 1):
@@ -1707,13 +1777,13 @@ def build_engine(cfg: ServerConfig):
             n_draft=cfg.draft_n_tokens, max_batch=cfg.max_batch,
             prefix_cache_size=cfg.prefix_cache_size, mesh=mesh,
             prefill_chunk=cfg.prefill_chunk, max_pending=cfg.max_pending,
-            # accepted for config uniformity; the spec engine pins the
-            # pipeline knobs to 1 and paging off (see
-            # SpeculativeDecodeServer.__init__)
+            # the speculative engine rides the full dispatch template:
+            # pipelined windows, fused rounds, paged + int8 KV all apply
             pipeline_depth=cfg.pipeline_depth,
             decode_steps=cfg.decode_steps,
             kv_block_size=cfg.kv_block_size, kv_blocks=cfg.kv_blocks,
-            kv_swap=cfg.kv_swap, hbm_admit_frac=cfg.kv_hbm_admit_frac)
+            kv_swap=cfg.kv_swap, hbm_admit_frac=cfg.kv_hbm_admit_frac,
+            kv_dtype=cfg.kv_dtype)
     return DecodeServer(params, model_cfg, max_batch=cfg.max_batch,
                         prefix_cache_size=cfg.prefix_cache_size, mesh=mesh,
                         prefill_chunk=cfg.prefill_chunk,
@@ -1722,7 +1792,8 @@ def build_engine(cfg: ServerConfig):
                         decode_steps=cfg.decode_steps,
                         kv_block_size=cfg.kv_block_size,
                         kv_blocks=cfg.kv_blocks, kv_swap=cfg.kv_swap,
-                        hbm_admit_frac=cfg.kv_hbm_admit_frac)
+                        hbm_admit_frac=cfg.kv_hbm_admit_frac,
+                        kv_dtype=cfg.kv_dtype)
 
 
 def make_http_server(cfg: ServerConfig, loop: ServingLoop
@@ -2002,6 +2073,22 @@ def main(argv: Optional[Sequence[str]] = None) -> None:
              "KV to host and restore byte-exact, off = recompute it "
              "from the tokens on resume (overrides config)")
     parser.add_argument(
+        "--kv-dtype", choices=("bf16", "int8"), default=None,
+        help="paged-KV storage dtype (overrides config): int8 "
+             "quantizes KV on write with per-block scales — ~2x the "
+             "blocks per HBM byte, ~2x sustained paged concurrency — "
+             "and requires --kv-blocks (the slot-static engine has no "
+             "scale storage; rejected with a clear error)")
+    parser.add_argument(
+        "--draft-checkpoint-dir", default=None,
+        help="enable speculative decoding: checkpoint of the draft "
+             "model that proposes --draft-n-tokens per verify window "
+             "(draft dims come from the config file; overrides config)")
+    parser.add_argument(
+        "--draft-n-tokens", type=int, default=None,
+        help="speculative proposals per verify window (>= 1; only "
+             "meaningful with --draft-checkpoint-dir; overrides config)")
+    parser.add_argument(
         "--slo-ttft-ms", type=float, default=None,
         help="time-to-first-token SLO target in ms (0 = unset; feeds "
              "nos_tpu_serve_slo_total and the goodput gauge; overrides "
@@ -2056,6 +2143,12 @@ def main(argv: Optional[Sequence[str]] = None) -> None:
         cfg.kv_blocks = args.kv_blocks
     if args.kv_swap is not None:
         cfg.kv_swap = args.kv_swap == "on"
+    if args.kv_dtype is not None:
+        cfg.kv_dtype = args.kv_dtype
+    if args.draft_checkpoint_dir is not None:
+        cfg.draft_checkpoint_dir = args.draft_checkpoint_dir
+    if args.draft_n_tokens is not None:
+        cfg.draft_n_tokens = args.draft_n_tokens
     if args.slo_ttft_ms is not None:
         cfg.slo_ttft_ms = args.slo_ttft_ms
     if args.slo_tpot_ms is not None:
@@ -2102,6 +2195,10 @@ def main(argv: Optional[Sequence[str]] = None) -> None:
             "kv_block_size": cfg.kv_block_size,
             "kv_blocks": cfg.kv_blocks,
             "kv_swap": cfg.kv_swap,
+            "kv_dtype": cfg.kv_dtype,
+            "speculative": bool(cfg.draft_checkpoint_dir),
+            "draft_n_tokens": (cfg.draft_n_tokens
+                               if cfg.draft_checkpoint_dir else 0),
             "max_seq": cfg.max_seq,
         })
     httpd = make_http_server(cfg, loop)

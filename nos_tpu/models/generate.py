@@ -84,7 +84,7 @@ def cache_shardings(mesh, cfg: TransformerConfig,
 
 def init_paged_cache(cfg: TransformerConfig, kv_blocks: int,
                      block_size: int, batch: int,
-                     dtype=None) -> Cache:
+                     dtype=None, kv_dtype: str = "bf16") -> Cache:
     """Pooled paged KV arena: k/v ``[L, kv_blocks, Hkv, block_size,
     head_dim]`` — ONE HBM pool shared by every serving slot through
     per-slot block tables — plus the per-row write position ``pos``
@@ -95,10 +95,30 @@ def init_paged_cache(cfg: TransformerConfig, kv_blocks: int,
     worst-case tokens — the PagedAttention economics. The per-row
     LOGICAL timeline length is the block table's affair (the serving
     engine caps it at its ``max_len <= cfg.max_seq``, same rope-table
-    bound as ``init_cache``)."""
+    bound as ``init_cache``).
+
+    ``kv_dtype="int8"`` stores the arena quantized (symmetric int8, one
+    f32 scale per (layer, block, head, token) living in the
+    ``k_scale``/``v_scale`` planes — scales are indexed by PHYSICAL
+    block, so they are freed/forked/COW'd in lockstep with their
+    blocks): KV bytes per token drop ~2x vs bf16, which at a fixed HBM
+    budget roughly doubles the block pool and therefore sustained
+    paged concurrency. Writes quantize in ``paged_scatter_kv`` path,
+    reads dequantize in the gather path — see ``forward_paged``."""
     dtype = dtype or cfg.dtype
     shape = (cfg.n_layers, kv_blocks, cfg.kv_heads, block_size,
              cfg.head_dim)
+    if kv_dtype not in ("bf16", "int8"):
+        raise ValueError(
+            f"kv_dtype must be bf16|int8, got {kv_dtype!r}")
+    if kv_dtype == "int8":
+        return {
+            "k": jnp.zeros(shape, jnp.int8),
+            "v": jnp.zeros(shape, jnp.int8),
+            "k_scale": jnp.zeros(shape[:-1], jnp.float32),
+            "v_scale": jnp.zeros(shape[:-1], jnp.float32),
+            "pos": jnp.zeros((batch,), jnp.int32),
+        }
     return {
         "k": jnp.zeros(shape, dtype),
         "v": jnp.zeros(shape, dtype),
@@ -120,11 +140,24 @@ def forward_paged(
     ``pos`` mask — so greedy decode under paging is bit-identical to
     ``generate`` (tested). ``table`` is a plain input, never donated:
     the host mutates it between dispatches (growth, COW remaps) while
-    the donated arena chains through the self-feeding decode program."""
-    from nos_tpu.ops.attention import paged_gather_kv, paged_scatter_kv
+    the donated arena chains through the self-feeding decode program.
+
+    An int8 arena (``init_paged_cache(kv_dtype="int8")`` — the cache
+    carries ``k_scale``/``v_scale`` planes) quantizes each K/V write on
+    the scatter (per-token symmetric scales stored per physical block)
+    and dequantizes on the gather, so the per-position attention math
+    downstream of the dequant is the SAME program — the int8
+    self-consistency contract (serving == reference generate through
+    the identical int8 KV path) holds because writer and reader share
+    these exact quantize/dequantize ops."""
+    from nos_tpu.ops.attention import (
+        dequantize_kv, paged_gather_kv, paged_gather_scale,
+        paged_scatter_kv, paged_scatter_scale, quantize_kv,
+    )
 
     b, s = tokens.shape
     pos0 = cache["pos"]                                     # [B]
+    int8_kv = "k_scale" in cache
     freqs = rope_frequencies(cfg.head_dim, cfg.max_seq, cfg.rope_theta)
     positions = pos0[:, None] + jnp.arange(s)[None, :]      # [B, S]
     scale = cfg.head_dim ** -0.5
@@ -132,19 +165,36 @@ def forward_paged(
     x = embed_lookup(params["embed"], tokens, cfg.dtype)
 
     def layer_body(x, layer_and_cache):
-        layer, ck, cv = layer_and_cache                     # arena slices
+        if int8_kv:
+            layer, ck, cv, cks, cvs = layer_and_cache       # arena slices
+        else:
+            layer, ck, cv = layer_and_cache
+            cks = cvs = None
         h = rms_norm(x, layer["attn_norm"])
         q = qdot(h, layer["wq"]).reshape(b, s, cfg.n_heads, cfg.head_dim)
         k = qdot(h, layer["wk"]).reshape(b, s, cfg.kv_heads, cfg.head_dim)
         v = qdot(h, layer["wv"]).reshape(b, s, cfg.kv_heads, cfg.head_dim)
         q, k = (apply_rope(t, freqs, positions) for t in (q, k))
-        kt = k.transpose(0, 2, 1, 3).astype(ck.dtype)       # [B, Hkv, S, D]
-        vt = v.transpose(0, 2, 1, 3).astype(cv.dtype)
-        ck = paged_scatter_kv(ck, table, pos0, kt)
-        cv = paged_scatter_kv(cv, table, pos0, vt)
+        kt = k.transpose(0, 2, 1, 3)                        # [B, Hkv, S, D]
+        vt = v.transpose(0, 2, 1, 3)
+        if int8_kv:
+            kq, ksc = quantize_kv(kt)
+            vq, vsc = quantize_kv(vt)
+            ck = paged_scatter_kv(ck, table, pos0, kq)
+            cv = paged_scatter_kv(cv, table, pos0, vq)
+            cks = paged_scatter_scale(cks, table, pos0, ksc)
+            cvs = paged_scatter_scale(cvs, table, pos0, vsc)
+            gk = dequantize_kv(paged_gather_kv(ck, table),
+                               paged_gather_scale(cks, table), cfg.dtype)
+            gv = dequantize_kv(paged_gather_kv(cv, table),
+                               paged_gather_scale(cvs, table), cfg.dtype)
+        else:
+            ck = paged_scatter_kv(ck, table, pos0, kt.astype(ck.dtype))
+            cv = paged_scatter_kv(cv, table, pos0, vt.astype(cv.dtype))
+            gk = paged_gather_kv(ck, table)
+            gv = paged_gather_kv(cv, table)
         o = _cached_attention(
-            q.transpose(0, 2, 1, 3), paged_gather_kv(ck, table),
-            paged_gather_kv(cv, table), positions, scale)
+            q.transpose(0, 2, 1, 3), gk, gv, positions, scale)
         o = o.transpose(0, 2, 1, 3).reshape(b, s, cfg.d_model)
         x = x + qdot(o, layer["wo"])
         if cfg.n_experts > 0:
@@ -160,14 +210,70 @@ def forward_paged(
             h2 = rms_norm(x, layer["mlp_norm"])
             x = x + swiglu(h2, layer["w_gate"], layer["w_up"],
                            layer["w_down"])
-        return x, (ck, cv)
+        return x, ((ck, cv, cks, cvs) if int8_kv else (ck, cv))
 
-    x, (ks, vs) = jax.lax.scan(
-        layer_body, x, (params["layers"], cache["k"], cache["v"]))
+    if int8_kv:
+        x, (ks, vs, kss, vss) = jax.lax.scan(
+            layer_body, x,
+            (params["layers"], cache["k"], cache["v"],
+             cache["k_scale"], cache["v_scale"]))
+        out_cache = {"k": ks, "v": vs, "k_scale": kss, "v_scale": vss,
+                     "pos": pos0 + s}
+    else:
+        x, (ks, vs) = jax.lax.scan(
+            layer_body, x, (params["layers"], cache["k"], cache["v"]))
+        out_cache = {"k": ks, "v": vs, "pos": pos0 + s}
 
     x = rms_norm(x, params["final_norm"])
     logits = qdot(x, params["unembed"]).astype(jnp.float32)
-    return logits, {"k": ks, "v": vs, "pos": pos0 + s}
+    return logits, out_cache
+
+
+def generate_paged(
+    params: Params,
+    cfg: TransformerConfig,
+    prompt: jax.Array,
+    max_new_tokens: int,
+    *,
+    block_size: int,
+    kv_dtype: str = "bf16",
+    max_len: Optional[int] = None,
+) -> jax.Array:
+    """Reference GREEDY generation through the paged KV path: prompt
+    [B, S] -> [B, S + max_new_tokens], decoding one token at a time
+    over a paged arena with a dense identity-style block table (row i
+    owns blocks [1 + i*nb, 1 + (i+1)*nb); block 0 stays the reserved
+    null block). Exists as the oracle the serving engine is pinned
+    against: with ``kv_dtype="bf16"`` it is bit-identical to
+    ``generate`` (paged_gather/scatter preserve the timeline exactly),
+    and with ``kv_dtype="int8"`` it IS the definition of correct int8
+    decoding — the serving engine must match it token-for-token through
+    the identical quantize-on-write / dequantize-on-read ops."""
+    b, s = prompt.shape
+    if max_new_tokens <= 0:
+        return prompt
+    max_len = max_len or cfg.max_seq
+    if s + max_new_tokens > max_len:
+        raise ValueError(
+            f"prompt ({s}) + max_new_tokens ({max_new_tokens}) exceeds "
+            f"cache length {max_len}")
+    if max_len % block_size:
+        raise ValueError(
+            f"max_len {max_len} must be a multiple of block_size "
+            f"{block_size}")
+    nb = max_len // block_size
+    cache = init_paged_cache(cfg, 1 + b * nb, block_size, b,
+                             kv_dtype=kv_dtype)
+    table = (1 + jnp.arange(b * nb, dtype=jnp.int32)).reshape(b, nb)
+    logits, cache = forward_paged(params, cfg, prompt, cache, table)
+    tok = jnp.argmax(logits[:, -1], axis=-1)
+    out = [tok]
+    for _ in range(max_new_tokens - 1):
+        logits, cache = forward_paged(params, cfg, tok[:, None], cache,
+                                      table)
+        tok = jnp.argmax(logits[:, -1], axis=-1)
+        out.append(tok)
+    return jnp.concatenate([prompt, jnp.stack(out, axis=1)], axis=1)
 
 
 def _cached_attention(q, ck, cv, positions, scale):

@@ -33,8 +33,8 @@ from __future__ import annotations
 from collections import deque
 from typing import Deque, Dict, List, Optional, Sequence, Tuple
 
-__all__ = ["BlockAllocator", "PrefixBlockIndex", "NoFreeBlocks",
-           "NULL_BLOCK", "blocks_for"]
+__all__ = ["BlockAllocator", "PrefixBlockIndex", "ScaleLedger",
+           "NoFreeBlocks", "NULL_BLOCK", "blocks_for"]
 
 # physical block 0: the reserved null/scratch block every unassigned
 # block-table entry points at (see module docstring)
@@ -79,6 +79,12 @@ class BlockAllocator:
         # mirror reads this per request under the serving-loop lock,
         # so it must not scan a production-sized pool
         self._shared = 0
+        # int8-KV engines attach a ScaleLedger so per-block
+        # quantization-scale bookkeeping drops in LOCKSTEP with block
+        # frees — whoever decrefs the last reference (slot teardown,
+        # prefix eviction, preemption), the scale entry dies with the
+        # block, never from a parallel code path that could drift
+        self.scale_ledger: Optional["ScaleLedger"] = None
 
     # -- core ----------------------------------------------------------
     @property
@@ -136,6 +142,8 @@ class BlockAllocator:
             self._shared -= 1
         elif self._refs[block] == 0:
             self._free.append(block)
+            if self.scale_ledger is not None:
+                self.scale_ledger.note_free(block)
             return True
         return False
 
@@ -269,3 +277,52 @@ class PrefixBlockIndex:
                 "capacity_blocks": self.max_blocks,
                 "hits": self.hits,
                 "tokens_saved": self.tokens_saved}
+
+
+class ScaleLedger:
+    """Host mirror of the int8 arena's per-block quantization scales:
+    which PHYSICAL blocks currently carry valid scale entries, and a
+    monotone data version per block so the property tests can prove
+    the lockstep lifecycle the device arrays rely on:
+
+    - a WRITE into a block stamps (or re-stamps) its scale version —
+      the device-side quantize-on-scatter writes data and scale in one
+      program, so host bookkeeping treats them as one event;
+    - a COW copy duplicates the source's version onto the fresh block
+      (``_cow_block`` device-copies data AND scale planes together);
+    - a FORK shares the block id itself, so the scale entry is shared
+      by construction — nothing to track;
+    - the block's FREE drops the entry, driven by the allocator's
+      decref (``BlockAllocator.scale_ledger``), so a reused block can
+      never present a stale scale as fresh data's.
+
+    Pure host accounting (jax-free): the engine keeps it for /stats
+    (``scaled_blocks``) and the invariants live in
+    tests/test_cache_properties.py's fuzz."""
+
+    def __init__(self) -> None:
+        self._ver: Dict[int, int] = {}      # physical block -> version
+        self._next = 0
+
+    def note_write(self, block: int) -> None:
+        """Data (and therefore scales) written into ``block``."""
+        self._ver[block] = self._next
+        self._next += 1
+
+    def note_copy(self, src: int, dst: int) -> None:
+        """COW: ``dst`` now holds a byte-copy of ``src``'s data and
+        scale planes — same version, distinct block."""
+        if src in self._ver:
+            self._ver[dst] = self._ver[src]
+
+    def note_free(self, block: int) -> None:
+        self._ver.pop(block, None)
+
+    def version(self, block: int) -> Optional[int]:
+        return self._ver.get(block)
+
+    @property
+    def count(self) -> int:
+        """Blocks currently carrying valid scales (the /stats
+        ``scaled_blocks`` figure)."""
+        return len(self._ver)

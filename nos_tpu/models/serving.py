@@ -43,8 +43,10 @@ from nos_tpu.models.generate import (
     forward_with_cache, init_cache, init_paged_cache,
 )
 from nos_tpu.models.kvblocks import (
-    BlockAllocator, NoFreeBlocks, PrefixBlockIndex, blocks_for,
+    BlockAllocator, NoFreeBlocks, PrefixBlockIndex, ScaleLedger,
+    blocks_for,
 )
+from nos_tpu.ops.attention import dequantize_kv, quantize_kv
 from nos_tpu.models.transformer import Params, TransformerConfig
 
 
@@ -220,7 +222,8 @@ class DecodeServer:
                  prefill_chunk: int = 0, max_pending: int = 0,
                  pipeline_depth: int = 1, decode_steps: int = 1,
                  kv_block_size: int = 0, kv_blocks: int = 0,
-                 kv_swap: bool = True, hbm_admit_frac: float = 0.0):
+                 kv_swap: bool = True, hbm_admit_frac: float = 0.0,
+                 kv_dtype: str = "bf16"):
         if prefill_chunk and (prefill_chunk < 8
                               or prefill_chunk & (prefill_chunk - 1)):
             raise ValueError(
@@ -247,6 +250,22 @@ class DecodeServer:
         self.kv_block_size = kv_block_size if self.paged else 0
         self.kv_swap = bool(kv_swap)
         self.hbm_admit_frac = float(hbm_admit_frac or 0.0)
+        # int8 KV (paged only): the arena stores quantized K/V with
+        # per-block scale planes — ~2x fewer KV bytes per token, so a
+        # fixed HBM budget holds ~2x the blocks and sustains ~2x the
+        # concurrency. Prefill/attention math still runs in cfg.dtype:
+        # writes quantize on the paged scatter, reads dequantize on the
+        # gather (ops/attention.quantize_kv / dequantize_kv).
+        if kv_dtype not in ("bf16", "int8"):
+            raise ValueError(
+                f"kv_dtype must be bf16|int8, got {kv_dtype!r}")
+        if kv_dtype == "int8" and not self.paged:
+            raise ValueError(
+                "kv_dtype=int8 requires the paged KV cache (set "
+                "kv_blocks/kv_block_size): the slot-static engine has "
+                "no per-block scale storage — run bf16, or enable "
+                "paging to use int8 KV")
+        self.kv_dtype = kv_dtype if self.paged else "bf16"
         if self.paged:
             bs = kv_block_size
             if self.max_len > cfg.max_seq:
@@ -282,7 +301,15 @@ class DecodeServer:
             self._nbs = self.max_len // kv_block_size
             self._alloc = BlockAllocator(kv_blocks, kv_block_size)
             self.cache = init_paged_cache(cfg, kv_blocks, kv_block_size,
-                                          max_batch)
+                                          max_batch,
+                                          kv_dtype=self.kv_dtype)
+            self._scales: Optional[ScaleLedger] = None
+            if self.kv_dtype == "int8":
+                # per-block scale lifecycle rides the allocator: frees
+                # drop the ledger entry in the same decref that frees
+                # the block, wherever that decref comes from
+                self._scales = ScaleLedger()
+                self._alloc.scale_ledger = self._scales
             self._table = jnp.zeros((max_batch, self._nbs), jnp.int32)
             self._tables: List[List[int]] = [[] for _ in range(max_batch)]
             self._pindex = (PrefixBlockIndex(self._alloc,
@@ -291,6 +318,7 @@ class DecodeServer:
         else:
             self.cache = init_cache(cfg, max_batch, self.max_len,
                                     per_row_pos=True)
+            self._scales = None
         # blocks freed while decode ticks are still in flight park here
         # until the next barrier/window-drain: an in-flight tick's
         # in-graph writes still target the freeing slot's OLD blocks,
@@ -517,15 +545,36 @@ class DecodeServer:
             def blk_shape(arr):
                 return (arr.shape[0], 1, arr.shape[2], bs, arr.shape[4])
 
+            def scale_blk(arr):
+                # the [L, NB, Hkv, bs] scale-plane slice of one block
+                return (arr.shape[0], 1, arr.shape[2], bs)
+
             def install_block(cache, rk, rv, phys, start):
                 # one block of a prefilled scratch row (token offset
                 # ``start``) -> physical arena block ``phys``; traced
                 # scalars, so admission compiles ONE program per
-                # scratch bucket, not per block index
+                # scratch bucket, not per block index. An int8 arena
+                # quantizes here — the scratch row stays cfg.dtype so
+                # prefill math is dtype-invariant.
                 bk = jax.lax.dynamic_slice(
                     rk, (0, 0, 0, start, 0), blk_shape(rk))
                 bv = jax.lax.dynamic_slice(
                     rv, (0, 0, 0, start, 0), blk_shape(rv))
+                if "k_scale" in cache:
+                    # the SAME per-token symmetric rule the decode
+                    # scatter applies (quantize_kv is shape-generic
+                    # over leading axes) — ONE implementation, so
+                    # prefill-installed and decode-written positions
+                    # dequantize identically
+                    bk, sk = quantize_kv(bk)
+                    bv, sv = quantize_kv(bv)
+                    cache["k_scale"] = jax.lax.dynamic_update_slice(
+                        cache["k_scale"], sk, (0, phys, 0, 0))
+                    cache["v_scale"] = jax.lax.dynamic_update_slice(
+                        cache["v_scale"], sv, (0, phys, 0, 0))
+                else:
+                    bk = bk.astype(cache["k"].dtype)
+                    bv = bv.astype(cache["v"].dtype)
                 cache["k"] = jax.lax.dynamic_update_slice(
                     cache["k"], bk, (0, phys, 0, 0, 0))
                 cache["v"] = jax.lax.dynamic_update_slice(
@@ -535,14 +584,28 @@ class DecodeServer:
             self._install_block = jax.jit(install_block,
                                           donate_argnums=(0,))
 
-            def scratch_from_block(rk, rv, ck, cv, phys, start):
+            def scratch_from_block(rk, rv, cache, phys, start):
                 # arena block -> scratch-row token offset: seeds the
                 # suffix prefill with a shared prefix's KV (no
-                # donation: rk may be the memoized _row_zeros array)
+                # donation: rk may be the memoized _row_zeros array).
+                # int8 arenas dequantize back to the scratch dtype so
+                # the suffix forward attends to the SAME values a
+                # decode-path gather would read.
                 bk = jax.lax.dynamic_slice(
-                    ck, (0, phys, 0, 0, 0), blk_shape(ck))
+                    cache["k"], (0, phys, 0, 0, 0),
+                    blk_shape(cache["k"]))
                 bv = jax.lax.dynamic_slice(
-                    cv, (0, phys, 0, 0, 0), blk_shape(cv))
+                    cache["v"], (0, phys, 0, 0, 0),
+                    blk_shape(cache["v"]))
+                if "k_scale" in cache:
+                    sk = jax.lax.dynamic_slice(
+                        cache["k_scale"], (0, phys, 0, 0),
+                        scale_blk(cache["k_scale"]))
+                    sv = jax.lax.dynamic_slice(
+                        cache["v_scale"], (0, phys, 0, 0),
+                        scale_blk(cache["v_scale"]))
+                    bk = dequantize_kv(bk, sk, rk.dtype)
+                    bv = dequantize_kv(bv, sv, rv.dtype)
                 rk = jax.lax.dynamic_update_slice(
                     rk, bk, (0, 0, 0, start, 0))
                 rv = jax.lax.dynamic_update_slice(
@@ -553,7 +616,9 @@ class DecodeServer:
 
             def cow_block(cache, src, dst):
                 # copy-on-write: duplicate a shared block before its
-                # first write so no written block is ever aliased
+                # first write so no written block is ever aliased. The
+                # scale planes copy in the SAME program — a COW'd int8
+                # block without its scales would dequantize garbage.
                 bk = jax.lax.dynamic_slice(
                     cache["k"], (0, src, 0, 0, 0), blk_shape(cache["k"]))
                 bv = jax.lax.dynamic_slice(
@@ -562,6 +627,17 @@ class DecodeServer:
                     cache["k"], bk, (0, dst, 0, 0, 0))
                 cache["v"] = jax.lax.dynamic_update_slice(
                     cache["v"], bv, (0, dst, 0, 0, 0))
+                if "k_scale" in cache:
+                    sk = jax.lax.dynamic_slice(
+                        cache["k_scale"], (0, src, 0, 0),
+                        scale_blk(cache["k_scale"]))
+                    sv = jax.lax.dynamic_slice(
+                        cache["v_scale"], (0, src, 0, 0),
+                        scale_blk(cache["v_scale"]))
+                    cache["k_scale"] = jax.lax.dynamic_update_slice(
+                        cache["k_scale"], sk, (0, dst, 0, 0))
+                    cache["v_scale"] = jax.lax.dynamic_update_slice(
+                        cache["v_scale"], sv, (0, dst, 0, 0))
                 return cache
 
             self._cow_block = jax.jit(cow_block, donate_argnums=(0,))
@@ -577,6 +653,24 @@ class DecodeServer:
 
             self._restore_block = jax.jit(restore_block,
                                           donate_argnums=(0,))
+
+            def restore_block_q(cache, bk, bv, sk, sv, phys):
+                # int8 swap-in: the quantized bytes AND their scales
+                # restore together — byte-exact by construction, so a
+                # swapped-and-restored int8 slot continues on the
+                # identical dequantized timeline
+                cache["k"] = jax.lax.dynamic_update_slice(
+                    cache["k"], bk[:, None], (0, phys, 0, 0, 0))
+                cache["v"] = jax.lax.dynamic_update_slice(
+                    cache["v"], bv[:, None], (0, phys, 0, 0, 0))
+                cache["k_scale"] = jax.lax.dynamic_update_slice(
+                    cache["k_scale"], sk[:, None], (0, phys, 0, 0))
+                cache["v_scale"] = jax.lax.dynamic_update_slice(
+                    cache["v_scale"], sv[:, None], (0, phys, 0, 0))
+                return cache
+
+            self._restore_block_q = jax.jit(restore_block_q,
+                                            donate_argnums=(0,))
 
             def set_row_state(cache, last, slot, pos, tok):
                 # shared admission/resume/fork tail: the slot's device
@@ -725,7 +819,11 @@ class DecodeServer:
     def _row_zeros(self, bucket: int):
         shape = list(self.cache["k"].shape)
         shape[1], shape[3] = 1, bucket
-        z = jnp.zeros(tuple(shape), self.cache["k"].dtype)
+        # scratch rows stay cfg.dtype even over an int8 arena: prefill
+        # math is full-precision, quantization happens at block install
+        dtype = (self.cfg.dtype if self.kv_dtype == "int8"
+                 else self.cache["k"].dtype)
+        z = jnp.zeros(tuple(shape), dtype)
         if self._row_shd is not None:
             # scratch rows carry the same head sharding as the shared
             # cache: prefill runs sharded and _install never gathers
@@ -1118,8 +1216,7 @@ class DecodeServer:
         for j, phys in enumerate(shared):
             rk, rv = self._timed_dispatch(
                 ("scratchblk", rk.shape[3]), self._scratch_block,
-                rk, rv, self.cache["k"], self.cache["v"],
-                jnp.int32(phys), jnp.int32(j * bs))
+                rk, rv, self.cache, jnp.int32(phys), jnp.int32(j * bs))
         row["k"], row["v"] = rk, rv
         return row
 
@@ -1145,6 +1242,8 @@ class DecodeServer:
                 ("installblk", row["k"].shape[3]), self._install_block,
                 self.cache, row["k"], row["v"], jnp.int32(table[j]),
                 jnp.int32(j * bs))
+            if self._scales is not None:
+                self._scales.note_write(table[j])
         s = req.slot
         self._tables[s] = table
         self._set_table_row(s)
@@ -1248,18 +1347,24 @@ class DecodeServer:
             return need <= self._alloc.free_count
         return False
 
+    def _dispatch_span(self) -> int:
+        """Max KV positions ONE decode dispatch writes per slot —
+        ``decode_steps`` for the plain engine; the speculative engine
+        overrides with ``decode_steps * n_draft`` (each fused round
+        writes a whole verify window before rolling back by pos)."""
+        return self.decode_steps
+
     def _ensure_blocks(self, active: List[int]) -> None:
         """Pre-dispatch block discipline: every decodable slot's next
-        ``decode_steps`` write positions (beyond what in-flight ticks
-        already cover) must land in blocks it owns EXCLUSIVELY —
+        ``_dispatch_span()`` write positions (beyond what in-flight
+        ticks already cover) must land in blocks it owns EXCLUSIVELY —
         growth allocates, shared blocks COW-copy (the copy op is
         enqueued after the in-flight writes it must include; single-
         device dispatch order makes that exact). Positions past the
         request's terminal length stay unallocated: the zero table
         entry routes those overrun writes to the null block. Raises
         NoFreeBlocks under pool pressure."""
-        T = self.decode_steps
-        bs = self.kv_block_size
+        T = self._dispatch_span()
         for s in active:
             req = self._active[s]
             base = len(req.prompt) + len(req.out) - 1
@@ -1274,24 +1379,39 @@ class DecodeServer:
                 # positions >= cap that every reader rewrites before
                 # reading — either way, no committed KV is reachable
                 continue
-            table = self._tables[s]
-            changed = False
-            for j in range(start // bs, (end - 1) // bs + 1):
-                if j < len(table):
-                    if not self._alloc.writable(table[j]):
-                        fresh = self._alloc.alloc()
-                        self.cache = self._timed_dispatch(
-                            ("cowblk",), self._cow_block, self.cache,
-                            jnp.int32(table[j]), jnp.int32(fresh))
-                        self._alloc.decref(table[j])
-                        table[j] = fresh
-                        changed = True
-                else:
-                    while len(table) <= j:
-                        table.append(self._alloc.alloc())
-                        changed = True
-            if changed:
-                self._set_table_row(s)
+            self._grow_slot_blocks(s, start, end)
+
+    def _grow_slot_blocks(self, s: int, start: int, end: int) -> None:
+        """Make slot ``s`` own every block covering write positions
+        [start, end) exclusively: COW-copy shared blocks, allocate
+        growth. The speculative engine extends this to grow the draft
+        table over the same span (draft and target timelines advance
+        in lockstep)."""
+        bs = self.kv_block_size
+        table = self._tables[s]
+        changed = False
+        for j in range(start // bs, (end - 1) // bs + 1):
+            if j < len(table):
+                if not self._alloc.writable(table[j]):
+                    fresh = self._alloc.alloc()
+                    self.cache = self._timed_dispatch(
+                        ("cowblk",), self._cow_block, self.cache,
+                        jnp.int32(table[j]), jnp.int32(fresh))
+                    if self._scales is not None:
+                        self._scales.note_copy(table[j], fresh)
+                    self._alloc.decref(table[j])
+                    table[j] = fresh
+                    changed = True
+            else:
+                while len(table) <= j:
+                    table.append(self._alloc.alloc())
+                    changed = True
+            if self._scales is not None:
+                # data + scales written by this dispatch's scatter:
+                # stamped at the decision point the host actually has
+                self._scales.note_write(table[j])
+        if changed:
+            self._set_table_row(s)
 
     def _pre_dispatch(self, active: List[int]) -> bool:
         """Hook run before every decode dispatch. True = dispatch with
@@ -1520,13 +1640,20 @@ class DecodeServer:
         """Host copies of a slot's first ``nblk`` committed KV blocks —
         the swap-out payload both preemption (_preempt_slot) and
         supervised-restart capture share, so what the two paths
-        snapshot can never silently diverge."""
+        snapshot can never silently diverge. An int8 arena swaps the
+        quantized bytes PLUS their per-block scales (the payload is
+        the dequantizable unit — and roughly half the bf16 bytes, so
+        preempt/recovery traffic shrinks with the arena)."""
         idx = jnp.asarray(table[:nblk], jnp.int32)
-        return {
+        payload = {
             "nblk": nblk,
             "k": np.asarray(self.cache["k"][:, idx]),
             "v": np.asarray(self.cache["v"][:, idx]),
         }
+        if self.kv_dtype == "int8":
+            payload["k_scale"] = np.asarray(self.cache["k_scale"][:, idx])
+            payload["v_scale"] = np.asarray(self.cache["v_scale"][:, idx])
+        return payload
 
     def _resume_draft(self, req: _Request, seq: List[int]) -> None:
         """Hook for engines with sibling caches (the speculative
@@ -1566,12 +1693,27 @@ class DecodeServer:
         req.preempted = False
         blocks = self._alloc.alloc_many(st["nblk"])
         for j, phys in enumerate(blocks):
-            self.cache = self._timed_dispatch(
-                ("restoreblk",), self._restore_block, self.cache,
-                jnp.asarray(st["k"][:, j]), jnp.asarray(st["v"][:, j]),
-                jnp.int32(phys))
+            if "k_scale" in st:
+                self.cache = self._timed_dispatch(
+                    ("restoreblkq",), self._restore_block_q, self.cache,
+                    jnp.asarray(st["k"][:, j]),
+                    jnp.asarray(st["v"][:, j]),
+                    jnp.asarray(st["k_scale"][:, j]),
+                    jnp.asarray(st["v_scale"][:, j]), jnp.int32(phys))
+            else:
+                self.cache = self._timed_dispatch(
+                    ("restoreblk",), self._restore_block, self.cache,
+                    jnp.asarray(st["k"][:, j]),
+                    jnp.asarray(st["v"][:, j]), jnp.int32(phys))
+            if self._scales is not None:
+                self._scales.note_write(phys)
         self._tables[req.slot] = blocks
         self._set_table_row(req.slot)
+        # sibling caches (the speculative draft) re-prefill over the
+        # committed sequence: the target KV restored byte-exact above,
+        # the draft regenerated chunking-invariantly — accept/reject
+        # decisions continue undisturbed
+        self._resume_draft(req, req.prompt + req.out[:-1])
         self._resume_row(req)
 
     def _resume_recompute(self, req: _Request) -> None:
@@ -1600,8 +1742,11 @@ class DecodeServer:
                 ("installblk", row["k"].shape[3]), self._install_block,
                 self.cache, row["k"], row["v"], jnp.int32(phys),
                 jnp.int32(j * bs))
+            if self._scales is not None:
+                self._scales.note_write(phys)
         self._tables[req.slot] = blocks
         self._set_table_row(req.slot)
+        self._resume_draft(req, seq)
         self._resume_row(req)
 
     def _set_sampling_rows(self, req: _Request) -> None:
@@ -1713,6 +1858,9 @@ class DecodeServer:
             return None
         return {
             "block_size": self.kv_block_size,
+            "dtype": self.kv_dtype,
+            "scaled_blocks": (self._scales.count
+                              if self._scales is not None else None),
             "blocks_total": self._alloc.capacity,
             "blocks_free": self._alloc.free_count,
             "blocks_used": self._alloc.used_count,

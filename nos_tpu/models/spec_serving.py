@@ -15,16 +15,41 @@ acceptance (a single scalar pos), while slots advance independently —
 a row that accepted 3 of 4 commits those 3 plus its correction token
 while its neighbour commits 1.
 
+Fast path (this PR's tentpole): the engine rides the full dispatch
+template instead of pinning it —
+
+- ``pipeline_depth=k``: up to k draft+verify dispatches in flight; the
+  draft burst for window k+1 speculates on-device while verify k's
+  tokens are still in transit to the host. Accept/reject and the
+  resulting pos advance happen IN-GRAPH, so a rejection needs no host
+  round-trip: dispatch k+1 reads the committed pos dispatch k wrote.
+  The only host-side rollback is the pipeline one every engine shares —
+  completions observed late reset pos ("only pos decides what exists").
+- ``decode_steps=T``: T draft+verify rounds fused into ONE dispatch
+  (lax.scan), [B, T, n_draft] committed tokens per device->host sync.
+- paged KV (``kv_blocks > 0``): target AND draft caches live in pooled
+  arenas with per-slot block tables. The draft pool mirrors the
+  target's block count; draft blocks are always exclusively owned (no
+  prefix sharing; ``fork`` copies the committed draft blocks outright —
+  the draft writes every tick, so COW would copy on the next dispatch
+  anyway). A verify window that rolled back leaves speculated-ahead
+  writes in tail blocks past the committed prefix: once the in-flight
+  window drains, those tails are freed and their table entries zeroed
+  back to the null block (``_trim_spec_tails``) so the pool, a fork,
+  and a swap capture all see exactly the committed footprint.
+  ``kv_dtype="int8"`` applies to both arenas.
+
 Exactness contract (same as models/speculative.py, per row):
 - greedy rows (temperature 0) are bit-identical to plain decoding of
-  the target model;
+  the target model — at every (pipeline_depth, decode_steps), paged or
+  slot-static, across COW forks and preempt-and-resume (tested);
 - sampled rows use accept-reject speculative sampling — every committed
   token is distributed exactly as target-only sampling, with the RNG
   keyed by (seed, absolute position, sub-stream) so a row's output is
-  independent of batch composition. (The sample PATH differs from the
-  non-speculative engine's — same distribution, different draws — so a
-  seeded sampled request is reproducible against THIS engine, not
-  token-equal to DecodeServer's.)
+  independent of batch composition AND of the dispatch knobs. (The
+  sample PATH differs from the non-speculative engine's — same
+  distribution, different draws — so a seeded sampled request is
+  reproducible against THIS engine, not token-equal to DecodeServer's.)
 
 Rollback is position arithmetic: the verify pass writes k cache entries
 per row, and per-row ``pos`` is then set to the committed length —
@@ -39,15 +64,20 @@ from __future__ import annotations
 import functools
 
 from collections import deque
-from typing import Optional
+from typing import List, Optional
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from nos_tpu.models.generate import (
-    _truncate_logits_rows, forward_with_cache, init_cache,
+    _truncate_logits_rows, forward_paged, forward_with_cache, init_cache,
+    init_paged_cache,
 )
-from nos_tpu.models.serving import DecodeServer, _bucket
+from nos_tpu.models.kvblocks import (
+    BlockAllocator, NoFreeBlocks, ScaleLedger, blocks_for,
+)
+from nos_tpu.models.serving import DecodeServer, QueueFull, _bucket
 from nos_tpu.models.transformer import Params, TransformerConfig
 
 __all__ = ["SpeculativeDecodeServer"]
@@ -69,8 +99,10 @@ def _sample_rows(keys, probs):
 
 
 class SpeculativeDecodeServer(DecodeServer):
-    """DecodeServer with draft-verified ticks. ``step()`` emits UP TO
-    ``n_draft`` tokens per active slot per tick instead of one."""
+    """DecodeServer with draft-verified ticks. Each fused round emits UP
+    TO ``n_draft`` tokens per active slot; a dispatch fuses
+    ``decode_steps`` rounds and up to ``pipeline_depth`` dispatches fly
+    before the host blocks — the template's economics, unpinned."""
 
     def __init__(self, params: Params, cfg: TransformerConfig,
                  draft_params: Params, draft_cfg: TransformerConfig,
@@ -78,42 +110,65 @@ class SpeculativeDecodeServer(DecodeServer):
                  max_len: Optional[int] = None, **kw):
         if draft_cfg.vocab != cfg.vocab:
             raise ValueError("draft and target must share a vocabulary")
-        # the speculative engine pins pipeline_depth=1 / decode_steps=1:
-        # a spec tick already commits a variable-length burst (up to
-        # n_draft tokens) per dispatch, and the submit-time headroom
-        # guard below budgets exactly ONE un-rolled-back verify window
-        # (k positions) past the committed prefix — k ticks in flight
-        # would need k*n_draft headroom and buy little on top of the
-        # burst amortization the draft/verify split already provides.
-        # Operator configs (nos-tpu-server flags) apply to both engines,
-        # so the knobs are accepted here and clamped, not rejected.
-        kw["pipeline_depth"] = 1
-        kw["decode_steps"] = 1
-        # paged KV clamps off likewise: the draft model keeps its own
-        # per-row-pos KV cache, and paging BOTH caches (plus the verify
-        # window's k-position rollback discipline over block tables) is
-        # the ROADMAP follow-up that also unpins the pipeline knobs —
-        # until then the spec engine stays slot-static.
-        kw["kv_blocks"] = 0
-        kw["kv_block_size"] = 0
         super().__init__(params, cfg, max_batch=max_batch,
                          max_len=max_len, **kw)
         self.draft_params = draft_params
         self.draft_cfg = draft_cfg
         self.k = max(1, int(n_draft))
-        self.d_cache = init_cache(draft_cfg, max_batch, self.max_len,
-                                  per_row_pos=True)
+        # speculation observability: proposals drafted vs accepted by
+        # verify (the engine-side truth nos_tpu_serve_spec_*_total
+        # mirrors), plus per-verify-window accepted counts parked for
+        # the serving loop's histogram (FIFO-capped like compile
+        # events: a library caller that never drains must not leak)
+        self.spec_drafted = 0
+        self.spec_accepted = 0
+        self.spec_window_events: List[int] = []
         self._chunked_drow: dict = {}   # rid -> chunk-prefilled draft row
+        # rid -> draft blocks reserved at chunked-admission start (the
+        # draft twin of the base class's req.reserved_blocks): chunked
+        # prefill spans ticks during which decoders GROW draft blocks,
+        # and an install that found the draft pool dry mid-admission
+        # would have no good answer — NoFreeBlocks escaping step()
+        # would kill the serving loop
+        self._chunked_dreserved: dict = {}
         self._d_row_shd = None
+        if self.paged:
+            # the draft's own pooled arena: same block geometry as the
+            # target's (draft and target timelines advance in lockstep,
+            # and the draft has no prefix sharing, so its worst-case
+            # block need per slot equals the target's)
+            self._d_alloc = BlockAllocator(self._alloc.num_blocks,
+                                           self.kv_block_size)
+            self._d_scales: Optional[ScaleLedger] = None
+            if self.kv_dtype == "int8":
+                self._d_scales = ScaleLedger()
+                self._d_alloc.scale_ledger = self._d_scales
+            self.d_cache = init_paged_cache(
+                draft_cfg, self._alloc.num_blocks, self.kv_block_size,
+                max_batch, kv_dtype=self.kv_dtype)
+            self._d_table = jnp.zeros((max_batch, self._nbs), jnp.int32)
+            self._d_tables: List[List[int]] = [
+                [] for _ in range(max_batch)]
+            self._d_deferred: List[int] = []
+        else:
+            self.d_cache = init_cache(draft_cfg, max_batch, self.max_len,
+                                      per_row_pos=True)
         if self.mesh is not None:
             from nos_tpu.models.generate import cache_shardings
             d_shd = cache_shardings(self.mesh, draft_cfg, per_row_pos=True)
             self.d_cache = jax.device_put(self.d_cache, d_shd)
             self._d_row_shd = d_shd["k"]
         k = self.k
+        T = self.decode_steps
 
-        def spec_tick(p, dp, last, t_cache, d_cache, keep, temp, topk,
-                      topp, seeds, sampling: bool):
+        def spec_round(p, dp, last, t_cache, d_cache, t_fwd, d_fwd, keep,
+                       temp, topk, topp, seeds, sampling: bool):
+            """ONE draft+verify round: propose k, verify in one wide
+            forward, commit the accepted prefix (+ correction), roll
+            back by pos. ``t_fwd``/``d_fwd`` close over the cache
+            flavour (slot-static forward_with_cache or forward_paged
+            with the block table), so the accept/reject math is ONE
+            implementation across both."""
             t_pos0 = t_cache["pos"]
             d_pos0 = d_cache["pos"]
             b = last.shape[0]
@@ -131,8 +186,7 @@ class SpeculativeDecodeServer(DecodeServer):
             drafts, qs = [], []
             tok = last
             for i in range(k):
-                dlogits, d_cache = forward_with_cache(
-                    dp, self.draft_cfg, tok, d_cache)
+                dlogits, d_cache = d_fwd(dp, tok, d_cache)
                 step_logits = dlogits[:, -1]
                 nxt = jnp.argmax(step_logits, axis=-1)
                 if sampling:
@@ -147,8 +201,7 @@ class SpeculativeDecodeServer(DecodeServer):
             # 2. target verifies in one pass: logits[:, i] is the
             # target's verdict on proposed[:, i]
             feed = jnp.concatenate([last, proposed[:, :-1]], axis=1)
-            tlogits, t_cache = forward_with_cache(p, self.cfg, feed,
-                                                  t_cache)
+            tlogits, t_cache = t_fwd(p, feed, t_cache)
             greedy = jnp.argmax(tlogits, axis=-1)           # [B, k]
             if sampling:
                 pdist = jax.vmap(_row_dist, in_axes=(1, None, None, None),
@@ -208,10 +261,68 @@ class SpeculativeDecodeServer(DecodeServer):
             # 5. rollback-by-position: processed == committed[:-1]
             t_cache["pos"] = jnp.where(keep, t_pos0 + c, t_pos0)
             d_cache["pos"] = jnp.where(keep, d_pos0 + c, d_pos0)
-            return commit, c, last, t_cache, d_cache
+            return commit, c, a, last, t_cache, d_cache
 
-        self._spec_tick = jax.jit(spec_tick, donate_argnums=(3, 4),
-                                  static_argnums=(10,))
+        def spec_core(p, dp, last, t_cache, d_cache, t_fwd, d_fwd, keep,
+                      temp, topk, topp, seeds, sampling: bool):
+            # T == 1 keeps the unscanned program; T > 1 fuses T rounds
+            # into ONE dispatch via lax.scan — per-round ops identical,
+            # so greedy stays bit-exact at any T (each round reads the
+            # pos the previous round committed: rejections resolve
+            # in-graph, never on the host). Arrivals come back
+            # [B, T, k] committed tokens + [B, T] counts/accepted.
+            if T == 1:
+                commit, c, a, last, t_cache, d_cache = spec_round(
+                    p, dp, last, t_cache, d_cache, t_fwd, d_fwd, keep,
+                    temp, topk, topp, seeds, sampling)
+                return (commit[:, None], c[:, None], a[:, None], last,
+                        t_cache, d_cache)
+
+            def body(carry, _):
+                last, t_cache, d_cache = carry
+                commit, c, a, last, t_cache, d_cache = spec_round(
+                    p, dp, last, t_cache, d_cache, t_fwd, d_fwd, keep,
+                    temp, topk, topp, seeds, sampling)
+                return (last, t_cache, d_cache), (commit, c, a)
+
+            (last, t_cache, d_cache), (commits, cs, accs) = jax.lax.scan(
+                body, (last, t_cache, d_cache), None, length=T)
+            return (commits.transpose(1, 0, 2), cs.swapaxes(0, 1),
+                    accs.swapaxes(0, 1), last, t_cache, d_cache)
+
+        if self.paged:
+            def spec_tick_paged(p, dp, last, t_cache, d_cache, t_table,
+                                d_table, keep, temp, topk, topp, seeds,
+                                sampling: bool):
+                # inactive rows' tables zero to the reserved null block
+                # (both caches): their in-graph writes land somewhere
+                # no active row ever reads
+                t_table = jnp.where(keep[:, None], t_table, 0)
+                d_table = jnp.where(keep[:, None], d_table, 0)
+                return spec_core(
+                    p, dp, last, t_cache, d_cache,
+                    lambda pp, t, c: forward_paged(pp, self.cfg, t, c,
+                                                   t_table),
+                    lambda pp, t, c: forward_paged(pp, self.draft_cfg,
+                                                   t, c, d_table),
+                    keep, temp, topk, topp, seeds, sampling)
+
+            self._spec_tick = jax.jit(spec_tick_paged,
+                                      donate_argnums=(3, 4),
+                                      static_argnums=(12,))
+        else:
+            def spec_tick(p, dp, last, t_cache, d_cache, keep, temp,
+                          topk, topp, seeds, sampling: bool):
+                return spec_core(
+                    p, dp, last, t_cache, d_cache,
+                    lambda pp, t, c: forward_with_cache(pp, self.cfg,
+                                                        t, c),
+                    lambda pp, t, c: forward_with_cache(
+                        pp, self.draft_cfg, t, c),
+                    keep, temp, topk, topp, seeds, sampling)
+
+            self._spec_tick = jax.jit(spec_tick, donate_argnums=(3, 4),
+                                      static_argnums=(10,))
 
         def d_prefill(dp, toks, row):
             return forward_with_cache(dp, self.draft_cfg, toks, row)
@@ -228,17 +339,31 @@ class SpeculativeDecodeServer(DecodeServer):
 
         self._d_install = jax.jit(d_install, donate_argnums=(0,))
 
+        if self.paged:
+            def d_set_pos(cache, slot, pos):
+                cache["pos"] = cache["pos"].at[slot].set(pos)
+                return cache
+
+            self._d_set_pos = jax.jit(d_set_pos, donate_argnums=(0,))
+
     # ------------------------------------------------------------------
     def submit(self, prompt, max_new_tokens, **kw) -> int:
-        # headroom: a verify round writes up to k positions past the
-        # committed prefix before rolling back-by-position; without this
-        # the per-row dynamic_update_slice would CLAMP near max_len and
-        # silently overwrite valid KV (same guard as
-        # speculative_generate's s + max_new + k check)
-        if prompt and len(prompt) + max_new_tokens + self.k > self.max_len:
+        # slot-static headroom: every in-flight dispatch can write up to
+        # decode_steps * k positions past the committed prefix before
+        # rolling back-by-position; without this the per-row
+        # dynamic_update_slice would CLAMP near max_len and silently
+        # overwrite valid KV (same guard as speculative_generate's
+        # s + max_new + k check, scaled by the unpinned window). The
+        # PAGED engine needs no extra headroom: overrun positions past
+        # the table null-route (paged_scatter_kv), so only the base
+        # plen + max_new <= max_len bound applies — unpinning paging
+        # widened the servable range.
+        window = self.pipeline_depth * self.decode_steps * self.k
+        if not self.paged and prompt \
+                and len(prompt) + max_new_tokens + window > self.max_len:
             raise ValueError(
                 f"prompt ({len(prompt)}) + max_new_tokens "
-                f"({max_new_tokens}) + draft window ({self.k}) exceeds "
+                f"({max_new_tokens}) + draft window ({window}) exceeds "
                 f"cache length {self.max_len}")
         return super().submit(prompt, max_new_tokens, **kw)
 
@@ -251,36 +376,78 @@ class SpeculativeDecodeServer(DecodeServer):
 
     @functools.lru_cache(maxsize=None)      # noqa: B019 — engine-lived
     def _d_row_zeros(self, bucket: int):
-        shape = list(self.d_cache["k"].shape)
-        shape[1], shape[3] = 1, bucket
-        z = jnp.zeros(tuple(shape), self.d_cache["k"].dtype)
+        shape = (self.draft_cfg.n_layers, 1, self.draft_cfg.kv_heads,
+                 bucket, self.draft_cfg.head_dim)
+        z = jnp.zeros(shape, self.draft_cfg.dtype)
         if self._d_row_shd is not None:
             # same head sharding as d_cache: draft prefill runs sharded
             # and the draft install never gathers (mirrors _row_zeros)
             z = jax.device_put(z, self._d_row_shd)
         return z
 
-    def _start_chunked_prefill(self, req, m, mkey) -> bool:
+    def _d_bucket(self, n: int) -> int:
+        """Draft scratch-row bucket: the prompt's power-of-two bucket,
+        never below one KV block under paging (blocks install whole)."""
+        b = min(_bucket(n), self.max_len)
+        if self.paged:
+            b = max(b, self.kv_block_size)
+        return b
+
+    def _fresh_drow(self, bucket: int) -> dict:
+        return {
+            "k": self._d_row_zeros(bucket),
+            "v": self._d_row_zeros(bucket),
+            "pos": jnp.zeros((), jnp.int32),
+        }
+
+    # -- draft admission (chunked + one-shot, slot-static + paged) -----
+    def _attach_draft_chunks(self, ent, req) -> None:
         """Chunk the DRAFT cache alongside the target: the per-tick cost
         stays one target chunk + one (much cheaper) draft chunk, so the
         head-of-line bound chunked prefill promises holds under
         speculative decoding too — no whole-prompt draft forward spikes
         on the install tick. The draft has no prefix cache, so its
         chunks cover the full prompt."""
-        if not super()._start_chunked_prefill(req, m, mkey):
-            return False
-        ent = self._prefilling[-1]
         chunk = self._prefill_chunk
         plen = len(req.prompt)
-        bucket = min(_bucket(plen), self.max_len)
-        ent["drow"] = {
-            "k": self._d_row_zeros(bucket),
-            "v": self._d_row_zeros(bucket),
-            "pos": jnp.zeros((), jnp.int32),
-        }
+        ent["drow"] = self._fresh_drow(self._d_bucket(plen))
         ent["dtodo"] = deque(req.prompt[i:i + chunk]
                              for i in range(0, plen, chunk))
+
+    def _start_chunked_prefill(self, req, m, mkey) -> bool:
+        if not super()._start_chunked_prefill(req, m, mkey):
+            return False
+        self._attach_draft_chunks(self._prefilling[-1], req)
         return True
+
+    def _paged_start_chunked(self, req, m, mkey) -> bool:
+        # reserve the draft's install blocks UP FRONT (no prefix
+        # sharing shrinks them): a dry draft pool falls back to the
+        # one-shot path, whose install runs in the same tick its
+        # headroom was checked — never mid-flight
+        try:
+            reserved = self._d_alloc.alloc_many(
+                blocks_for(len(req.prompt), self.kv_block_size))
+        except NoFreeBlocks:
+            return False
+        if not super()._paged_start_chunked(req, m, mkey):
+            for b in reserved:
+                self._d_alloc.decref(b)
+            return False
+        self._chunked_dreserved[req.rid] = reserved
+        self._attach_draft_chunks(self._prefilling[-1], req)
+        return True
+
+    def cancel(self, rid: int) -> bool:
+        ok = super().cancel(rid)
+        if self.paged:
+            # a cancel that dropped a mid-prefill entry released the
+            # TARGET reservation in the base class; the draft twin
+            # releases here (popped at install otherwise, so this is a
+            # no-op for active/finished requests)
+            for b in self._chunked_dreserved.pop(rid, None) or []:
+                self._d_alloc.decref(b)
+        return ok
 
     def _prefill_advance(self, ent) -> bool:
         if ent["todo"]:
@@ -299,6 +466,37 @@ class SpeculativeDecodeServer(DecodeServer):
         self._chunked_drow[ent["req"].rid] = ent["drow"]
         return True
 
+    def _install_draft_row(self, req, drow: dict, plen: int) -> None:
+        """Land one prefilled draft scratch row for ``req``'s slot:
+        slot-static = the donated whole-row install; paged = block-wise
+        into the draft arena (quantizing on install under int8, same as
+        the target's _install_block). A chunked admission installs into
+        the blocks reserved at its start; one-shot/resume installs
+        allocate here, in the same tick their headroom was checked."""
+        slot = req.slot
+        if not self.paged:
+            self.d_cache = self._d_install(
+                self.d_cache, drow["k"], drow["v"], jnp.int32(slot),
+                jnp.int32(plen))
+            return
+        bs = self.kv_block_size
+        for b in self._d_tables[slot]:      # stale leftovers (resume)
+            self._d_alloc.decref(b)
+        table = self._chunked_dreserved.pop(req.rid, None)
+        if table is None:
+            table = self._d_alloc.alloc_many(blocks_for(plen, bs))
+        for j, phys in enumerate(table):
+            self.d_cache = self._timed_dispatch(
+                ("dinstallblk", drow["k"].shape[3]), self._install_block,
+                self.d_cache, drow["k"], drow["v"], jnp.int32(phys),
+                jnp.int32(j * bs))
+            if self._d_scales is not None:
+                self._d_scales.note_write(phys)
+        self._d_tables[slot] = table
+        self._set_d_table_row(slot)
+        self.d_cache = self._d_set_pos(self.d_cache, jnp.int32(slot),
+                                       jnp.int32(plen))
+
     def _finish_prefill(self, req, row, step) -> None:
         # draft install FIRST: the request may finish inside the super
         # call (stop token / max_new=1), releasing the slot and
@@ -308,22 +506,15 @@ class SpeculativeDecodeServer(DecodeServer):
         # hold TARGET KV). The draft row arrives chunk-prefilled from
         # _prefill_advance, or is prefilled whole here on the one-shot
         # (short prompt) path.
-        slot = req.slot
         plen = len(req.prompt)
         drow = self._chunked_drow.pop(req.rid, None)
         if drow is None:
-            bucket = min(_bucket(plen), self.max_len)
+            bucket = self._d_bucket(plen)
             toks = jnp.asarray([req.prompt + [0] * (bucket - plen)],
                                jnp.int32)
-            drow = {
-                "k": self._d_row_zeros(bucket),
-                "v": self._d_row_zeros(bucket),
-                "pos": jnp.zeros((), jnp.int32),
-            }
+            drow = self._fresh_drow(bucket)
             _, drow = self._run_d_prefill(toks, drow)
-        self.d_cache = self._d_install(
-            self.d_cache, drow["k"], drow["v"], jnp.int32(slot),
-            jnp.int32(plen))
+        self._install_draft_row(req, drow, plen)
         super()._finish_prefill(req, row, step)
 
     def _finish_if_done(self, req, admit: bool = True) -> None:
@@ -332,59 +523,252 @@ class SpeculativeDecodeServer(DecodeServer):
         super()._finish_if_done(req, admit)
 
     def _resume_draft(self, req, seq) -> None:
-        """Supervised-restart resume for the DRAFT cache: re-prefill it
-        over the same committed sequence the target resume installs
+        """Resume hook for the DRAFT cache (preempt-and-resume in both
+        modes, and supervised restarts): re-prefill it over the same
+        committed sequence the target resume installs
         (``prompt + out[:-1]``) so the draft invariant — processed ==
         committed[:-1], pos == committed length - 1 fed next — holds in
-        the rebuilt engine exactly as it did before the failure. The
+        the rebuilt slot exactly as it did before the pause. The
         draft's re-prefilled KV is bit-identical to the incrementally
         built one (chunking invariance), so greedy accept/reject
         decisions — and therefore committed tokens — are undisturbed."""
         n = len(seq)
-        bucket = min(_bucket(n), self.max_len)
+        bucket = self._d_bucket(n)
         toks = jnp.asarray([seq + [0] * (bucket - n)], jnp.int32)
-        drow = {
-            "k": self._d_row_zeros(bucket),
-            "v": self._d_row_zeros(bucket),
-            "pos": jnp.zeros((), jnp.int32),
-        }
+        drow = self._fresh_drow(bucket)
         _, drow = self._run_d_prefill(toks, drow)
-        self.d_cache = self._d_install(
-            self.d_cache, drow["k"], drow["v"], jnp.int32(req.slot),
-            jnp.int32(n))
+        self._install_draft_row(req, drow, n)
+
+    # -- paged draft-block discipline ----------------------------------
+    def _set_d_table_row(self, slot: int) -> None:
+        row = np.zeros((self._nbs,), np.int32)
+        blocks = self._d_tables[slot]
+        row[:len(blocks)] = blocks
+        self._d_table = self._d_table.at[slot].set(jnp.asarray(row))
+
+    def _dispatch_span(self) -> int:
+        # each fused round writes a whole verify window (k positions)
+        # before rolling back by pos
+        return self.decode_steps * self.k
+
+    def _grow_slot_blocks(self, s: int, start: int, end: int) -> None:
+        super()._grow_slot_blocks(s, start, end)
+        # the draft table grows over the SAME span: draft and target
+        # timelines advance in lockstep (both sit at the committed
+        # length). Draft blocks are exclusively owned by construction
+        # (no prefix sharing, forks copy), so growth never COWs.
+        bs = self.kv_block_size
+        table = self._d_tables[s]
+        changed = False
+        for j in range(start // bs, (end - 1) // bs + 1):
+            while len(table) <= j:
+                table.append(self._d_alloc.alloc())
+                changed = True
+            if self._d_scales is not None:
+                self._d_scales.note_write(table[j])
+        if changed:
+            self._set_d_table_row(s)
+
+    def _free_slot_blocks(self, slot: int) -> None:
+        super()._free_slot_blocks(slot)
+        table = self._d_tables[slot]
+        self._d_tables[slot] = []
+        if self._inflight:
+            self._d_deferred.extend(table)
+        else:
+            for b in table:
+                self._d_alloc.decref(b)
+
+    def _drain_deferred(self) -> None:
+        super()._drain_deferred()
+        if not self.paged:
+            return
+        if self._d_deferred and not self._inflight:
+            for b in self._d_deferred:
+                self._d_alloc.decref(b)
+            self._d_deferred.clear()
+        if not self._inflight:
+            self._trim_spec_tails()
+
+    def _trim_spec_tails(self) -> None:
+        """Verify-window rollback, settled at the block layer: with the
+        in-flight window empty, any block past the committed prefix
+        holds only speculated-then-rolled-back writes — nothing ``pos``
+        admits. Free those tails (both caches) and zero their table
+        entries back to the null block, so the pool's free count, a
+        COW fork's shared set, and a swap capture all see exactly the
+        committed footprint, never speculation residue."""
+        bs = self.kv_block_size
+        pre = {ent["req"].slot for ent in self._prefilling}
+        for s, req in list(self._active.items()):
+            if s in pre or req.slot < 0:
+                continue
+            need = blocks_for(len(req.prompt) + len(req.out) - 1, bs)
+            table = self._tables[s]
+            if len(table) > need:
+                for b in table[need:]:
+                    self._alloc.decref(b)
+                del table[need:]
+                self._set_table_row(s)
+            d_table = self._d_tables[s]
+            if len(d_table) > need:
+                for b in d_table[need:]:
+                    self._d_alloc.decref(b)
+                del d_table[need:]
+                self._set_d_table_row(s)
+
+    def _admit_headroom(self, req) -> bool:
+        if not super()._admit_headroom(req):
+            return False
+        # the draft pool must hold the prompt's install blocks plus one
+        # of growth too — no prefix sharing shrinks the draft's need,
+        # so a heavily-shared target admission can still be
+        # draft-bound. Pressure relief (preemption frees BOTH pools)
+        # unblocks it like any other headroom wait.
+        plen = len(req.prompt)
+        cap_blocks = blocks_for(plen + req.max_new_tokens - 1,
+                                self.kv_block_size)
+        committed = plen + len(req.out) - 1 if req.preempted else plen
+        base_need = blocks_for(committed, self.kv_block_size)
+        need = min(base_need + 1, max(base_need, cap_blocks))
+        return need <= self._d_alloc.free_count
+
+    def _preempt_slot(self, slot: int, mode: str) -> None:
+        super()._preempt_slot(slot, mode)
+        # the draft's blocks free outright in BOTH modes: swap resume
+        # restores the target byte-exact and re-prefills the draft
+        # (_resume_draft via the base resume paths) — the draft is
+        # derivable state, not payload
+        for b in self._d_tables[slot]:
+            self._d_alloc.decref(b)
+        self._d_tables[slot] = []
+        self.d_cache["pos"] = self.d_cache["pos"].at[slot].set(0)
+
+    def fork(self, rid: int, **kw) -> int:
+        """COW-fork under speculation: the target's committed blocks
+        share by refcount exactly as DecodeServer.fork; the DRAFT's
+        committed blocks copy outright into fresh blocks (the draft
+        writes every round, so a COW would copy on the very next
+        dispatch anyway — eager copy is the same cost with none of the
+        shared-state bookkeeping). The fork's accept/reject decisions
+        then run over bit-identical draft KV, so a greedy fork
+        continues bit-identically to its source."""
+        if not self.paged:
+            raise RuntimeError("fork requires paged KV (kv_blocks > 0)")
+        src = next((r for r in self._active.values() if r.rid == rid),
+                   None)
+        if src is not None:
+            # barrier first (super().fork flushes anyway), then check
+            # DRAFT capacity before the base fork commits anything —
+            # a half-made fork with no draft blocks would corrupt the
+            # accept/reject stream
+            self._flush()
+            src = next((r for r in self._active.values()
+                        if r.rid == rid), None)
+            if src is not None and src.slot >= 0 and not src.done:
+                nblk = blocks_for(
+                    len(src.prompt) + len(src.out) - 1,
+                    self.kv_block_size)
+                if nblk > self._d_alloc.free_count:
+                    raise QueueFull(
+                        f"fork needs {nblk} free draft-KV blocks, "
+                        f"{self._d_alloc.free_count} free; retry after "
+                        f"a completion")
+        nrid = super().fork(rid, **kw)
+        new = next(r for r in self._active.values() if r.rid == nrid)
+        src = next(r for r in self._active.values() if r.rid == rid)
+        base = len(new.prompt) + len(new.out) - 1
+        nblk = blocks_for(base, self.kv_block_size)
+        fresh = self._d_alloc.alloc_many(nblk)
+        for j, dst in enumerate(fresh):
+            self.d_cache = self._timed_dispatch(
+                ("dcowblk",), self._cow_block, self.d_cache,
+                jnp.int32(self._d_tables[src.slot][j]), jnp.int32(dst))
+            if self._d_scales is not None:
+                self._d_scales.note_copy(self._d_tables[src.slot][j],
+                                         dst)
+        self._d_tables[new.slot] = fresh
+        self._set_d_table_row(new.slot)
+        self.d_cache = self._d_set_pos(self.d_cache,
+                                       jnp.int32(new.slot),
+                                       jnp.int32(base))
+        return nrid
 
     # ------------------------------------------------------------------
     def _dispatch(self, active, keep, sampling):
-        """One speculative dispatch: up to k tokens per active slot.
-        The base step() template owns the scaffolding (mid-prefill slot
-        exclusion, keep mask, in-flight window — pinned to depth 1 here —
-        async fetch, prefill tick)."""
-        commit, counts, self._last, self.cache, self.d_cache = \
-            self._spec_tick(
-                self.params, self.draft_params, self._last, self.cache,
-                self.d_cache, keep, self._temp, self._topk, self._topp,
-                self._seed, sampling)
-        return commit, counts
+        """One speculative dispatch: decode_steps fused rounds of up to
+        k tokens per active slot. The base step() template owns the
+        scaffolding (mid-prefill slot exclusion, keep mask, in-flight
+        window, async fetch, prefill tick); with pipeline_depth > 1 the
+        next window's draft burst is enqueued while this one's verify
+        is still in flight — accept/reject resolves in-graph, so the
+        chain never waits on the host."""
+        if self.paged:
+            commit, counts, accepted, self._last, self.cache, \
+                self.d_cache = self._spec_tick(
+                    self.params, self.draft_params, self._last,
+                    self.cache, self.d_cache, self._table,
+                    self._d_table, keep, self._temp, self._topk,
+                    self._topp, self._seed, sampling)
+        else:
+            commit, counts, accepted, self._last, self.cache, \
+                self.d_cache = self._spec_tick(
+                    self.params, self.draft_params, self._last,
+                    self.cache, self.d_cache, keep, self._temp,
+                    self._topk, self._topp, self._seed, sampling)
+        return commit, counts, accepted
 
     def _consume_payload(self, ent, host, now: float = 0.0) -> int:
-        commit_host, counts_host = host
+        commit_host, counts_host, acc_host = host   # [B,T,k] [B,T] [B,T]
         emitted = 0
+        rounds = counts_host.shape[1]
         for s in ent.slots:
             req = self._active.get(s)
             if req is None or req.done:
                 continue
             n = 0
-            for j in range(int(counts_host[s])):
-                req.out.append(int(commit_host[s, j]))
-                req.note_token()
-                emitted += 1
-                n += 1
+            for t in range(rounds):
                 if req.done:
-                    break
+                    break       # later rounds are pure rollback
+                self.spec_drafted += self.k
+                a = int(acc_host[s, t])
+                self.spec_accepted += a
+                self.spec_window_events.append(a)
+                if len(self.spec_window_events) > 4096:
+                    del self.spec_window_events[:2048]
+                for j in range(int(counts_host[s, t])):
+                    req.out.append(int(commit_host[s, t, j]))
+                    req.note_token()
+                    emitted += 1
+                    n += 1
+                    if req.done:
+                        break
             if n and now:
-                # a verify burst lands up to k tokens at one host
-                # instant: the shared ledger template attributes the
-                # arrival gap evenly across them (see _Ledger)
+                # a verify burst lands up to decode_steps*k tokens at
+                # one host instant: the shared ledger template
+                # attributes the arrival gap evenly across them
                 req.led.note_tokens(n, now)
             self._finish_if_done(req, admit=False)
         return emitted
+
+    def stats(self) -> dict:
+        st = super().stats()
+        spec = {
+            "n_draft": self.k,
+            "drafted": self.spec_drafted,
+            "accepted": self.spec_accepted,
+            "acceptance": (round(self.spec_accepted
+                                 / self.spec_drafted, 4)
+                           if self.spec_drafted else None),
+        }
+        if self.paged:
+            spec["draft_kv"] = {
+                "blocks_total": self._d_alloc.capacity,
+                "blocks_free": self._d_alloc.free_count,
+                "blocks_used": self._d_alloc.used_count,
+                "scaled_blocks": (self._d_scales.count
+                                  if self._d_scales is not None
+                                  else None),
+            }
+        st["speculative"] = spec
+        return st

@@ -30,6 +30,13 @@ single scalar ``cache['pos']`` then stays valid for every row. Rolling
 back speculation is just resetting ``pos``: entries beyond it are masked
 out of attention and overwritten by later writes
 (models/generate._cached_attention).
+
+This module is the library/batch API (one call, lockstep rows). The
+SERVING twin — per-row independent advance, pipelined draft/verify
+dispatches, fused rounds, paged/int8 KV — is
+``models/spec_serving.SpeculativeDecodeServer``; it restates the same
+accept-reject math per slot (``_row_dist`` there mirrors ``_dist``
+here), so the two stay the exactness oracle for each other.
 """
 from __future__ import annotations
 

@@ -20,7 +20,7 @@ from __future__ import annotations
 
 import functools
 import os
-from typing import Optional
+from typing import Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -233,6 +233,63 @@ def paged_gather_kv(arena: jax.Array, table: jax.Array) -> jax.Array:
     b, nb = table.shape
     view = arena[table]                     # [B, nb, Hkv, bs, D]
     return view.transpose(0, 2, 1, 3, 4).reshape(b, h_kv, nb * bs, d)
+
+
+def quantize_kv(vals: jax.Array) -> Tuple[jax.Array, jax.Array]:
+    """Symmetric int8 quantization of KV entries, one scale per
+    (row, head, token): vals [B, Hkv, S, D] -> (q int8 [B, Hkv, S, D],
+    scale f32 [B, Hkv, S]) with q = round(vals / scale), scale =
+    amax / 127 over the head_dim axis. A per-TOKEN scale (stored in the
+    arena's per-block scale planes, so it lives and dies with the
+    block) keeps one outlier token from crushing a whole block's
+    precision; an all-zero vector quantizes against scale 1 so the
+    round-trip stays exact for it."""
+    amax = jnp.max(jnp.abs(vals.astype(jnp.float32)), axis=-1)
+    scale = jnp.where(amax > 0, amax / 127.0, 1.0)          # [B, Hkv, S]
+    q = jnp.clip(
+        jnp.round(vals.astype(jnp.float32) / scale[..., None]),
+        -127, 127).astype(jnp.int8)
+    return q, scale
+
+
+def dequantize_kv(q: jax.Array, scale: jax.Array, dtype) -> jax.Array:
+    """Inverse of ``quantize_kv``: q [..., T, D] int8 with scale
+    [..., T] -> dtype. The multiply runs in f32 (the scale's dtype) and
+    casts once at the end, so the dequantized timeline is deterministic
+    across call sites — the int8 self-consistency contract (serving ==
+    reference generate through the same int8 KV path) rests on every
+    reader applying this exact op."""
+    return (q.astype(jnp.float32) * scale[..., None]).astype(dtype)
+
+
+def paged_gather_scale(scales: jax.Array, table: jax.Array) -> jax.Array:
+    """Scale-plane twin of ``paged_gather_kv``: scales [NB, Hkv, bs]
+    -> [B, Hkv, nb*bs], the per-token dequantization scales laid out on
+    each row's gathered timeline."""
+    nb_blocks, h_kv, bs = scales.shape
+    b, nb = table.shape
+    view = scales[table]                    # [B, nb, Hkv, bs]
+    return view.transpose(0, 2, 1, 3).reshape(b, h_kv, nb * bs)
+
+
+def paged_scatter_scale(scales: jax.Array, table: jax.Array,
+                        pos: jax.Array, vals: jax.Array) -> jax.Array:
+    """Scale-plane twin of ``paged_scatter_kv``: write per-token scales
+    [B, Hkv, S] at positions pos..pos+S-1 on each row's timeline, with
+    the same null-block routing for out-of-range logical blocks (an
+    overrun scale is as harmless as an overrun KV write — the null
+    block is never read unmasked)."""
+    nb_blocks, h_kv, bs = scales.shape
+    b, s = vals.shape[0], vals.shape[2]
+    nb = table.shape[1]
+    offs = pos[:, None] + jnp.arange(s)[None, :]            # [B, S]
+    logical = offs // bs
+    phys = jnp.where(
+        logical < nb,
+        jnp.take_along_axis(table, jnp.minimum(logical, nb - 1), axis=1),
+        0)                                                  # [B, S]
+    return scales.at[phys, :, offs % bs].set(
+        vals.transpose(0, 2, 1))                            # [B, S, Hkv]
 
 
 def paged_scatter_kv(arena: jax.Array, table: jax.Array, pos: jax.Array,

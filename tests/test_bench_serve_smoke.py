@@ -83,3 +83,51 @@ def test_bench_serve_smoke_writes_pipeline_artifact(tmp_path):
         assert 0.0 <= pr["goodput"] <= 1.0
         # e2e dominates ttft for a multi-token request by construction
         assert pr["e2e_ms"]["p50"] >= pr["ttft_ms"]["p50"]
+
+    # speculative section (ISSUE 10): the paged spec engine at every
+    # unpinned (pipeline_depth, decode_steps) — the acceptance gate is
+    # depth-2 TPOT not worse than the engine's own depth-1, plus the
+    # structural dispatch-gap inequality the pipeline section already
+    # proves for plain decode
+    spec = artifact["speculative"]
+    assert spec["kv"] == "paged"
+    combos = {(p["pipeline_depth"], p["decode_steps"])
+              for p in spec["grid"]}
+    assert combos == {(1, 1), (1, 4), (2, 1), (2, 4)}
+    for p in spec["grid"]:
+        assert p["tpot_ms"] > 0
+        assert p["tokens_per_dispatch"] >= 1
+        assert 0.0 <= p["acceptance"] <= 1.0
+        assert p["host_blocked_us_per_token"] >= 0
+    by = {(p["pipeline_depth"], p["decode_steps"]): p
+          for p in spec["grid"]}
+    # fused rounds multiply tokens-per-dispatch structurally
+    assert by[(1, 4)]["tokens_per_dispatch"] \
+        > by[(1, 1)]["tokens_per_dispatch"]
+    # the un-forfeited pipelining win: depth 2 hides the host gap the
+    # depth-1 engine pays every dispatch (structural), and TPOT is not
+    # worse (the ISSUE acceptance inequality, best-of-3 reps)
+    assert by[(2, 1)]["host_blocked_us_per_token"] \
+        <= by[(1, 1)]["host_blocked_us_per_token"]
+    assert spec["depth2_not_worse"], (
+        f"speculative depth-2 TPOT {spec['tpot_depth2_ms']}ms worse "
+        f"than its own depth-1 {spec['tpot_depth1_ms']}ms")
+    assert spec["tpot_depth2_ms"] <= spec["tpot_depth1_ms"]
+
+    # int8-vs-bf16 paged concurrency at the SAME HBM byte budget: the
+    # int8 arena stores ~0.55x the bytes per token, so the same budget
+    # buys ~1.8x the blocks; the backlogged-concurrency ratio must
+    # clear the 1.5x acceptance floor (structural: slot counts and
+    # admission order decide it, not timing)
+    int8 = artifact["kv_int8"]
+    bpt = int8["bytes_per_token"]
+    assert bpt["int8"] < 0.6 * bpt["bf16"]
+    assert int8["kv_blocks"]["int8"] > int8["kv_blocks"]["bf16"]
+    assert int8["bf16"]["completed"] == int8["trace_requests"]
+    assert int8["int8"]["completed"] == int8["trace_requests"]
+    # identical slot caps: the BLOCK pool must be the binding
+    # constraint, or the ratio would measure max_batch, not bytes
+    assert int8["bf16"]["slots"] == int8["int8"]["slots"]
+    assert int8["concurrency_ratio"] >= 1.5, (
+        f"int8 paged KV sustained only {int8['concurrency_ratio']}x "
+        f"the bf16 concurrency at the same byte budget (floor: 1.5x)")

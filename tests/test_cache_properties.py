@@ -27,7 +27,8 @@ except ImportError:        # pragma: no cover - environment-dependent
 
 from nos_tpu.kube.objects import ObjectMeta, Pod, PodSpec
 from nos_tpu.models.kvblocks import (
-    BlockAllocator, NoFreeBlocks, PrefixBlockIndex, blocks_for,
+    BlockAllocator, NoFreeBlocks, PrefixBlockIndex, ScaleLedger,
+    blocks_for,
 )
 from nos_tpu.scheduler.cache import ClusterCache
 
@@ -309,6 +310,112 @@ def test_cow_fork_never_aliases_a_written_block():
         while alloc.ref(x):
             alloc.decref(x)
     assert alloc.free_count == alloc.capacity
+
+
+# ---------------------------------------------------------------------------
+# per-block SCALE lifecycle (ISSUE 10 satellite): an int8 arena stores
+# quantization scales per PHYSICAL block. The ledger must stay in
+# lockstep with the allocator — written blocks carry a scale entry, COW
+# copies duplicate it, frees drop it via the allocator's decref hook —
+# or a reused block could present a stale scale as fresh data's.
+# ---------------------------------------------------------------------------
+
+def _check_scales(alloc, ledger, holders, written):
+    referenced = {b for t in holders.values() for b in t}
+    for b in list(ledger._ver):
+        assert b in referenced, \
+            f"block {b} freed but its scale entry survived"
+    for b in written:
+        if b in referenced:
+            assert ledger.version(b) is not None, \
+                f"written live block {b} lost its scale entry"
+
+
+@pytest.mark.parametrize("seed", range(6))
+def test_scale_ledger_fuzz_lockstep_with_allocator(seed):
+    rng = random.Random(500 + seed)
+    alloc = BlockAllocator(num_blocks=rng.randint(4, 25), block_size=8)
+    ledger = ScaleLedger()
+    alloc.scale_ledger = ledger         # frees drop entries in lockstep
+    holders = {}
+    written = set()
+    next_h = 0
+    for _ in range(500):
+        op = rng.random()
+        if op < 0.3:                                    # alloc + write
+            try:
+                b = alloc.alloc()
+            except NoFreeBlocks:
+                continue
+            holders.setdefault(next_h, []).append(b)
+            next_h += 1
+            ledger.note_write(b)        # install quantizes on write
+            written.add(b)
+        elif op < 0.5 and holders:                      # free a holder
+            h = rng.choice(list(holders))
+            for b in holders.pop(h):
+                alloc.decref(b)
+        elif op < 0.7 and holders:                      # fork (shares
+            h = rng.choice(list(holders))               # scales by id)
+            holders.setdefault(next_h, []).extend(
+                alloc.fork(holders[h]))
+            next_h += 1
+        elif holders:                                   # COW write
+            h = rng.choice(list(holders))
+            table = holders[h]
+            if not table:
+                continue
+            i = rng.randrange(len(table))
+            b = table[i]
+            if alloc.writable(b):
+                ledger.note_write(b)
+                written.add(b)
+            else:
+                try:
+                    fresh = alloc.alloc()
+                except NoFreeBlocks:
+                    continue
+                # the engine's COW order: device-copy data+scales,
+                # then the dispatch's scatter stamps the new write
+                ledger.note_copy(b, fresh)
+                if b in written:
+                    assert ledger.version(fresh) == ledger.version(b), \
+                        "COW copy must carry the source's scale version"
+                alloc.decref(b)
+                table[i] = fresh
+                ledger.note_write(fresh)
+                written.add(fresh)
+        _check_scales(alloc, ledger, holders, written)
+    for h in list(holders):
+        for b in holders.pop(h):
+            alloc.decref(b)
+    assert alloc.free_count == alloc.capacity
+    assert ledger.count == 0, \
+        "a fully drained pool must leave no scale entries behind"
+
+
+def test_scale_ledger_copy_and_free_semantics():
+    alloc = BlockAllocator(num_blocks=6, block_size=8)
+    led = ScaleLedger()
+    alloc.scale_ledger = led
+    a = alloc.alloc()
+    led.note_write(a)
+    v = led.version(a)
+    b = alloc.alloc()
+    led.note_copy(a, b)
+    assert led.version(b) == v          # COW: same data, same version
+    led.note_write(b)
+    assert led.version(b) != v          # a later write re-stamps
+    # copy from an unwritten source is a no-op, not a phantom entry
+    c = alloc.alloc()
+    d = alloc.alloc()
+    led.note_copy(c, d)
+    assert led.version(d) is None
+    alloc.decref(a)
+    assert led.version(a) is None       # freed in lockstep (hook)
+    for blk in (b, c, d):
+        alloc.decref(blk)
+    assert led.count == 0
 
 
 @pytest.mark.parametrize("seed", range(4))

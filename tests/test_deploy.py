@@ -345,7 +345,42 @@ def test_serving_deployment_passes_paged_kv_args():
     with open(os.path.join(CHART, "values.yaml")) as f:
         values = yaml.safe_load(f)
     assert values["serving"]["kv"] == {
-        "blockSize": 0, "blocks": 0, "swap": True}
+        "blockSize": 0, "blocks": 0, "swap": True, "dtype": "bf16"}
+
+
+def test_serving_deployment_passes_kv_dtype_and_speculative_args():
+    """The serving Deployment must plumb serving.kv.dtype and the
+    serving.speculative.* block to nos-tpu-server flags (ISSUE 10
+    satellite — no dead knobs: every value lands in a flag the server
+    validates). Defaults ship bf16 KV and speculation OFF; the
+    speculative flags render only when a draft checkpoint is set, so a
+    plain deployment's args stay clean."""
+    path = os.path.join(CHART, "templates", "serving",
+                        "deployment_server.yaml")
+    with open(path) as f:
+        text = f.read()
+    for flag, value in (
+        ("--kv-dtype", ".Values.serving.kv.dtype"),
+        ("--draft-checkpoint-dir",
+         ".Values.serving.speculative.draftCheckpointDir"),
+        ("--draft-n-tokens", ".Values.serving.speculative.nTokens"),
+    ):
+        assert flag in text, f"serving deployment missing {flag}"
+        assert value in text, f"serving deployment missing {value}"
+    # speculative args are conditional on the draft checkpoint
+    assert "if .Values.serving.speculative.draftCheckpointDir" in text
+    with open(os.path.join(CHART, "values.yaml")) as f:
+        values = yaml.safe_load(f)
+    assert values["serving"]["kv"]["dtype"] == "bf16"
+    assert values["serving"]["speculative"] == {
+        "draftCheckpointDir": "", "nTokens": 4}
+    # README documents every new knob (the rows are the operator's
+    # discovery surface; an undocumented knob is half-dead)
+    with open(os.path.join(CHART, "README.md")) as f:
+        readme = f.read()
+    for row in ("serving.kv.dtype", "serving.speculative.draftCheckpointDir",
+                "serving.speculative.nTokens"):
+        assert row in readme, f"helm README missing {row} row"
 
 
 def test_serving_deployment_passes_supervisor_and_deadline_args():

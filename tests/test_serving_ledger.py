@@ -100,9 +100,18 @@ def test_queue_time_measured_behind_a_busy_slot(params):
     srv.drain()
     led_first = srv.pop_ledger(first)
     led_wait = srv.pop_ledger(waiter)
-    # the waiter queued for (at least) the head request's decode run
+    # the waiter queued for (at least) the head request's decode run.
+    # The lower bound is first's own DECODE span (the sum of its
+    # inter-token gaps): the waiter was already pending before first's
+    # second token, so every one of those gaps elapsed inside the
+    # waiter's queue window. (Comparing against a fraction of first's
+    # e2e — the old assertion — is machine-dependent: on a fast-decode
+    # box e2e is dominated by first's own synchronous prefill, which
+    # the waiter never waits on.)
     assert led_wait["queue_s"] > led_first["queue_s"]
-    assert led_wait["queue_s"] >= led_first["e2e_s"] * 0.5
+    decode_span = sum(g for g, _ in led_first["tpot"])
+    assert decode_span > 0
+    assert led_wait["queue_s"] >= decode_span * 0.9
 
 
 def test_cancel_pending_closes_ledger_without_ttft(params):

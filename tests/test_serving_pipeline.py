@@ -255,10 +255,12 @@ def test_validation(params):
 
 
 # ---------------------------------------------------------------------------
-# speculative engine regression: pins depth/steps to 1
+# speculative engine: the dispatch knobs are HONORED (ISSUE 10 unpinned
+# the clamp) and output stays exact — the full grid lives in
+# tests/test_spec_paged.py; this pins the template integration
 # ---------------------------------------------------------------------------
 
-def test_speculative_engine_pins_pipeline_and_stays_exact(params):
+def test_speculative_engine_honors_pipeline_and_stays_exact(params):
     from nos_tpu.models.spec_serving import SpeculativeDecodeServer
 
     dcfg = tfm.TransformerConfig(vocab=64, d_model=16, n_layers=1,
@@ -267,14 +269,19 @@ def test_speculative_engine_pins_pipeline_and_stays_exact(params):
     dparams = tfm.init_params(jax.random.PRNGKey(1), dcfg)
     srv = SpeculativeDecodeServer(
         params, CFG, dparams, dcfg, n_draft=3, max_batch=2,
-        pipeline_depth=4, decode_steps=4)     # clamped, not honored
-    assert srv.pipeline_depth == 1
-    assert srv.decode_steps == 1
+        pipeline_depth=2, decode_steps=2)     # honored, not clamped
+    assert srv.pipeline_depth == 2
+    assert srv.decode_steps == 2
     r1 = srv.submit([4, 5], 10)
     r2 = srv.submit([9, 8, 7], 8)
     res = srv.drain()
     assert res[r1] == ref(params, [4, 5], 10)
     assert res[r2] == ref(params, [9, 8, 7], 8)
+    # the window genuinely pipelines: more than one tick may be in
+    # flight between steps (ticks dispatched outruns arrivals consumed
+    # at some point is hard to observe post-drain; assert the knob
+    # reached the template instead)
+    assert srv._spec_tick is not None
 
 
 def test_random_schedules_stay_exact_under_pipelining(engines, params):

@@ -1,0 +1,394 @@
+"""Fast-path speculative decoding (ISSUE 10 tentpole): the spec engine
+unpinned from pipeline_depth=decode_steps=1 and brought onto paged KV.
+
+Acceptance invariants pinned here:
+- greedy output stays bit-identical to plain target decoding at EVERY
+  (pipeline_depth, decode_steps) in {1,2} x {1,4}, slot-static AND
+  paged — including across a COW fork and a preempt-and-resume in both
+  modes (swap and recompute);
+- sampled spec streams are reproducible and invariant to the dispatch
+  knobs and to paging (the RNG keys on (seed, absolute position,
+  sub-stream), never on dispatch shape);
+- DRAFT-cache coherence (the ride-along bugfix): fork() and preempt()
+  must keep the draft's KV in lockstep with the committed sequence.
+  Greedy token output CANNOT catch a stale draft (accept-reject
+  guarantees target tokens whatever the draft proposes), so the sharp
+  probe is acceptance itself: with draft == target every verify window
+  must accept ALL proposals — any post-fork/post-preempt acceptance
+  drop means the draft cache drifted;
+- block accounting: both pools (target + draft) balance at quiescence,
+  and verify-window rollback trims speculated-ahead tail blocks back
+  to the committed footprint once the in-flight window drains.
+"""
+import jax
+import jax.numpy as jnp
+import pytest
+
+from nos_tpu.models import transformer as tfm
+from nos_tpu.models.generate import generate
+from nos_tpu.models.kvblocks import blocks_for
+from nos_tpu.models.serving import QueueFull  # noqa: F401 (fork shed)
+from nos_tpu.models.spec_serving import SpeculativeDecodeServer
+
+TARGET = dict(vocab=64, d_model=32, n_layers=2, n_heads=4, n_kv_heads=2,
+              d_ff=64, max_seq=64, dtype=jnp.float32)
+DRAFT = dict(vocab=64, d_model=16, n_layers=1, n_heads=2, n_kv_heads=1,
+             d_ff=32, max_seq=64, dtype=jnp.float32)
+
+TCFG = tfm.TransformerConfig(**TARGET)
+DCFG = tfm.TransformerConfig(**DRAFT)
+
+# the ISSUE acceptance grid: every previously-pinned combination
+GRID = [(d, t) for d in (1, 2) for t in (1, 4)]
+
+
+@pytest.fixture(scope="module")
+def models():
+    return (tfm.init_params(jax.random.PRNGKey(0), TCFG),
+            tfm.init_params(jax.random.PRNGKey(1), DCFG))
+
+
+def ref(tp, prompt, n):
+    return [int(t) for t in
+            generate(tp, TCFG, jnp.asarray([prompt], jnp.int32), n)[0]]
+
+
+def mk(models, *, depth=1, steps=1, paged=True, blocks=24, mb=2, **kw):
+    tp, dp = models
+    if paged:
+        kw.update(kv_block_size=8, kv_blocks=blocks)
+    return SpeculativeDecodeServer(
+        tp, TCFG, dp, DCFG, n_draft=3, max_batch=mb,
+        pipeline_depth=depth, decode_steps=steps, **kw)
+
+
+def assert_pools_balanced(srv):
+    """Quiescent invariant for BOTH pools: target blocks all free or
+    prefix-held, draft blocks all free (the draft never publishes)."""
+    assert not srv.has_work()
+    held = srv._pindex.block_count if srv._pindex is not None else 0
+    assert srv._alloc.used_count == held, (srv._alloc.used_count, held)
+    assert srv._d_alloc.used_count == 0, srv._d_alloc.used_count
+    assert not srv._deferred and not srv._d_deferred
+    assert all(not t for t in srv._tables)
+    assert all(not t for t in srv._d_tables)
+
+
+# ---------------------------------------------------------------------------
+# greedy bit-exactness across the unpinned grid
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("depth,steps", GRID)
+@pytest.mark.parametrize("paged", [False, True])
+def test_spec_greedy_bit_exact_across_grid(models, depth, steps, paged):
+    tp, _ = models
+    srv = mk(models, depth=depth, steps=steps, paged=paged)
+    # 3 requests over 2 slots: slot recycling + draft-row recycling
+    prompts = [([4, 5], 10), ([9, 8, 7], 8), ([7, 7, 7, 7], 5)]
+    rids = [srv.submit(p, n) for p, n in prompts]
+    res = srv.drain()
+    for rid, (p, n) in zip(rids, prompts):
+        assert res[rid] == ref(tp, p, n), (depth, steps, paged, rid)
+    if paged:
+        assert_pools_balanced(srv)
+
+
+@pytest.mark.parametrize("depth,steps", GRID)
+def test_spec_cow_fork_bit_exact_across_grid(models, depth, steps):
+    tp, _ = models
+    srv = mk(models, depth=depth, steps=steps, blocks=40)
+    r0 = srv.submit([4, 5], 16)
+    srv.step()
+    f0 = srv.fork(r0)
+    assert srv._alloc.shared_count() > 0      # target blocks shared
+    # the DRAFT is copied, never shared (it writes every round)
+    assert srv._d_alloc.shared_count() == 0
+    res = srv.drain()
+    want = ref(tp, [4, 5], 16)
+    assert res[r0] == want, (depth, steps, "source")
+    assert res[f0] == want, (depth, steps, "fork")
+    assert_pools_balanced(srv)
+
+
+@pytest.mark.parametrize("mode", ["swap", "recompute"])
+@pytest.mark.parametrize("depth,steps", GRID)
+def test_spec_preempt_resume_bit_exact_across_grid(models, depth, steps,
+                                                   mode):
+    tp, _ = models
+    srv = mk(models, depth=depth, steps=steps, blocks=40)
+    r0 = srv.submit([4, 5], 20)
+    r1 = srv.submit([9, 8, 7], 8)
+    for _ in range(2):
+        srv.step()
+    assert srv.preempt(r0, mode)
+    assert srv.kv_stats()["preempts"][mode] >= 1
+    res = srv.drain()
+    assert res[r0] == ref(tp, [4, 5], 20), (depth, steps, mode)
+    assert res[r1] == ref(tp, [9, 8, 7], 8), (depth, steps, mode)
+    assert_pools_balanced(srv)
+
+
+# ---------------------------------------------------------------------------
+# sampled streams: reproducible, knob- and paging-invariant
+# ---------------------------------------------------------------------------
+
+def test_spec_sampled_streams_invariant_to_knobs_and_paging(models):
+    kw = dict(temperature=0.9, top_k=8, seed=17)
+    base = mk(models, depth=1, steps=1, paged=False)
+    r = base.submit([4, 5], 8, **kw)
+    want = base.drain()[r]
+    for depth, steps in [(2, 1), (1, 4), (2, 4)]:
+        for paged in (False, True):
+            srv = mk(models, depth=depth, steps=steps, paged=paged)
+            r1 = srv.submit([4, 5], 8, **kw)
+            r2 = srv.submit([9, 9], 8, temperature=1.2, seed=5)
+            res = srv.drain()
+            assert res[r1] == want, (depth, steps, paged)
+            assert len(res[r2]) == 2 + 8
+
+
+# ---------------------------------------------------------------------------
+# draft-cache coherence (the ride-along bugfix): with draft == target,
+# every verify window must accept everything — forever, across fork and
+# preempt. A stale draft row shows up as an acceptance drop.
+# ---------------------------------------------------------------------------
+
+def mk_self_draft(models, **kw):
+    tp, _ = models
+    kw.setdefault("pipeline_depth", 2)
+    kw.setdefault("decode_steps", 1)
+    kw.setdefault("kv_block_size", 8)
+    kw.setdefault("kv_blocks", 48)
+    return SpeculativeDecodeServer(tp, TCFG, tp, TCFG, n_draft=3,
+                                   max_batch=3, **kw)
+
+
+def assert_full_acceptance(srv):
+    assert srv.spec_drafted > 0
+    assert srv.spec_accepted == srv.spec_drafted, (
+        f"acceptance {srv.spec_accepted}/{srv.spec_drafted}: the draft "
+        f"cache diverged from the committed sequence")
+
+
+def test_fork_keeps_draft_cache_coherent(models):
+    tp, _ = models
+    srv = mk_self_draft(models)
+    r0 = srv.submit([4, 5], 14)
+    for _ in range(2):
+        srv.step()
+    f0 = srv.fork(r0)
+    res = srv.drain()
+    want = ref(tp, [4, 5], 14)
+    assert res[r0] == want and res[f0] == want
+    # the sharp probe: the FORK's windows accepted everything too —
+    # before the fix the fork's draft rows held garbage, so its rounds
+    # would reject and acceptance would sag below 100%
+    assert_full_acceptance(srv)
+    assert_pools_balanced(srv)
+
+
+@pytest.mark.parametrize("mode", ["swap", "recompute"])
+def test_preempt_keeps_draft_cache_coherent(models, mode):
+    tp, _ = models
+    srv = mk_self_draft(models)
+    r0 = srv.submit([4, 5], 18)
+    r1 = srv.submit([9, 8], 8)
+    for _ in range(2):
+        srv.step()
+    assert srv.preempt(r0, mode)
+    res = srv.drain()
+    assert res[r0] == ref(tp, [4, 5], 18), mode
+    assert res[r1] == ref(tp, [9, 8], 8), mode
+    assert_full_acceptance(srv)
+    assert_pools_balanced(srv)
+
+
+def test_draft_pos_tracks_committed_across_fork_and_preempt(models):
+    srv = mk_self_draft(models)
+    r0 = srv.submit([4, 5], 16)
+    for _ in range(2):
+        srv.step()
+    srv.fork(r0)
+    srv._flush()
+    for s, req in srv._active.items():
+        # invariant: draft processed == committed[:-1]
+        want = len(req.prompt) + len(req.out) - 1
+        assert int(srv.d_cache["pos"][s]) == want, (s, req.rid)
+    srv.drain()
+    assert_pools_balanced(srv)
+
+
+def test_sampled_stream_bitexact_across_preempt(models):
+    """Sampled accept-reject draws depend on the draft's q — a stale
+    draft changes the sample PATH. The preempted-and-resumed run must
+    reproduce the undisturbed run token-for-token."""
+    kw = dict(temperature=0.8, top_k=8, seed=23)
+    srv = mk(models, depth=1, steps=1, blocks=40)
+    r = srv.submit([4, 5], 12, **kw)
+    want = srv.drain()[r]
+
+    srv2 = mk(models, depth=1, steps=1, blocks=40)
+    r2 = srv2.submit([4, 5], 12, **kw)
+    for _ in range(2):
+        srv2.step()
+    assert srv2.preempt(r2, "recompute")
+    assert srv2.drain()[r2] == want
+    assert_pools_balanced(srv2)
+
+
+# ---------------------------------------------------------------------------
+# paged-specific discipline
+# ---------------------------------------------------------------------------
+
+def test_rollback_trims_speculative_tail_blocks(models):
+    """After a window drains, no slot may hold blocks past its
+    committed footprint — speculated-ahead writes were rolled back by
+    pos, and their tail blocks must return to the pool with their
+    table entries zeroed to the null block."""
+    srv = mk(models, depth=1, steps=1, blocks=24)
+    srv.submit([4, 5], 16)
+    for _ in range(3):
+        srv.step()
+    assert not srv._inflight
+    for s, req in srv._active.items():
+        need = blocks_for(len(req.prompt) + len(req.out) - 1, 8)
+        assert len(srv._tables[s]) <= need, (s, srv._tables[s])
+        assert len(srv._d_tables[s]) <= need
+        # device table tail beyond the host table is the null block
+        row = [int(x) for x in srv._table[s]]
+        assert all(x == 0 for x in row[len(srv._tables[s]):])
+    srv.drain()
+    assert_pools_balanced(srv)
+
+
+def test_paged_spec_drops_slotstatic_headroom_guard(models):
+    """Slot-static spec submits reserve pipeline*steps*n_draft
+    positions of headroom below max_len; PAGED submits need none
+    (overrun writes null-route), so paging WIDENS the servable range."""
+    static = mk(models, depth=2, steps=1, paged=False, mb=1)
+    window = 2 * 1 * 3
+    plen = 64 - 4 - window + 1          # static guard trips by 1
+    with pytest.raises(ValueError, match="draft window"):
+        static.submit(list(range(1, plen + 1)), 4)
+    tp, _ = models
+    paged = mk(models, depth=2, steps=1, mb=1, blocks=24)
+    rid = paged.submit(list(range(1, plen + 1)), 4)
+    res = paged.drain()
+    assert res[rid] == ref(tp, list(range(1, plen + 1)), 4)
+    assert_pools_balanced(paged)
+
+
+def test_spec_prefix_cache_composes_with_paging(models):
+    tp, _ = models
+    system = list(range(1, 20))         # 19 tokens -> 2 full blocks
+    srv = mk(models, depth=2, steps=1, blocks=40,
+             prefix_cache_size=8)
+    srv.submit(system + [33], 2, cache_prefix=True)
+    srv.drain()
+    rid = srv.submit(system + [40, 41], 6)
+    res = srv.drain()
+    assert srv.kv_stats()["prefix"]["hits"] == 1
+    assert res[rid] == ref(tp, system + [40, 41], 6)
+    srv._pindex.clear()
+    srv.prefix_hits = srv.prefix_tokens_saved = 0
+    assert_pools_balanced(srv)
+
+
+def test_spec_chunked_prefill_composes_with_paging(models):
+    tp, _ = models
+    srv = mk(models, depth=2, steps=1, blocks=40, prefill_chunk=8)
+    r0 = srv.submit([1, 2, 3], 8)
+    for _ in range(2):
+        srv.step()
+    long = list(range(1, 31))
+    r1 = srv.submit(long, 5)
+    res = srv.drain()
+    assert res[r0] == ref(tp, [1, 2, 3], 8)
+    assert res[r1] == ref(tp, long, 5)
+    assert_pools_balanced(srv)
+
+
+def test_spec_stats_surface(models):
+    srv = mk(models, depth=2, steps=1)
+    rid = srv.submit([1, 2, 3], 6)
+    srv.drain()
+    srv.pop_result(rid)
+    st = srv.stats()
+    spec = st["speculative"]
+    assert spec["n_draft"] == 3
+    assert spec["drafted"] > 0
+    assert 0 <= spec["accepted"] <= spec["drafted"]
+    dkv = spec["draft_kv"]
+    assert dkv["blocks_total"] == dkv["blocks_free"] + dkv["blocks_used"]
+    assert st["pipeline"]["depth"] == 2
+    # window events parked for the serving loop's histogram
+    assert srv.spec_window_events
+
+
+def test_spec_int8_kv_self_consistent_across_depth(models):
+    """int8 KV under speculation: the (1,1) run IS the reference —
+    every other (depth, steps) must reproduce it token-for-token
+    (same quantize/dequantize path, same accept/reject math)."""
+    base = mk(models, depth=1, steps=1, blocks=40, kv_dtype="int8")
+    prompts = [([4, 5], 10), ([9, 8, 7], 8)]
+    rids = [base.submit(p, n) for p, n in prompts]
+    res0 = base.drain()
+    want = [res0[r] for r in rids]
+    for depth, steps in [(2, 1), (2, 4)]:
+        srv = mk(models, depth=depth, steps=steps, blocks=40,
+                 kv_dtype="int8")
+        rids = [srv.submit(p, n) for p, n in prompts]
+        res = srv.drain()
+        assert [res[r] for r in rids] == want, (depth, steps)
+        assert_pools_balanced(srv)
+
+
+def test_chunked_admission_reserves_draft_blocks(models):
+    """The draft pool's install blocks are reserved at chunked-
+    admission start (review finding): decoders growing draft blocks
+    across the prefill ticks must not be able to drain the pool out
+    from under the pending install — NoFreeBlocks escaping step()
+    would kill the serving loop. decode_steps=4 makes the decoder
+    outrun the chunked prefill, the same squeeze shape the target's
+    reservation test uses."""
+    tp, _ = models
+    srv = mk(models, depth=1, steps=4, blocks=12, prefill_chunk=8,
+             kv_swap=False)
+    r0 = srv.submit(list(range(1, 8)), 20)
+    long = list(range(1, 33))
+    r1 = srv.submit(long, 2)
+    # the chunked admission (if taken) holds a draft reservation
+    if srv._prefilling:
+        rid = srv._prefilling[0]["req"].rid
+        assert rid not in srv._chunked_dreserved \
+            or srv._chunked_dreserved[rid]
+    res = srv.drain()
+    assert res[r0] == ref(tp, list(range(1, 8)), 20)
+    assert res[r1] == ref(tp, long, 2)
+    assert not srv._chunked_dreserved
+    assert_pools_balanced(srv)
+
+
+def test_cancel_mid_prefill_releases_draft_reservation(models):
+    srv = mk(models, depth=1, steps=1, blocks=40, prefill_chunk=8)
+    r0 = srv.submit([1, 2, 3], 6)
+    long = list(range(1, 31))
+    r1 = srv.submit(long, 5)
+    assert srv._prefilling
+    assert srv._chunked_dreserved.get(r1)
+    used = srv._d_alloc.used_count
+    assert srv.cancel(r1)
+    assert r1 not in srv._chunked_dreserved
+    assert srv._d_alloc.used_count < used
+    res = srv.drain()
+    tp, _ = models
+    assert res[r0] == ref(tp, [1, 2, 3], 6)
+    assert_pools_balanced(srv)
+
+
+def test_fork_requires_paging_still(models):
+    srv = mk(models, paged=False)
+    srv.submit([1, 2], 4)
+    with pytest.raises(RuntimeError, match="paged"):
+        srv.fork(0)
+    srv.drain()
