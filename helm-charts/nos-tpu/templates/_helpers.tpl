@@ -57,6 +57,10 @@ app.kubernetes.io/managed-by: {{ .Release.Service }}
 {{- printf "%s/%s:%s" .Values.image.registry .Values.lifecycle.image.repository (include "nos-tpu.tag" .) -}}
 {{- end -}}
 
+{{- define "nos-tpu.gateway.image" -}}
+{{- printf "%s/%s:%s" .Values.image.registry .Values.gateway.image.repository (include "nos-tpu.tag" .) -}}
+{{- end -}}
+
 {{- define "nos-tpu.fleet.image" -}}
 {{- printf "%s/%s:%s" .Values.image.registry .Values.fleet.image.repository (include "nos-tpu.tag" .) -}}
 {{- end -}}

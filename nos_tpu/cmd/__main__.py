@@ -12,6 +12,7 @@ _BINARIES = {
     "deviceplugin": "nos_tpu.cmd.deviceplugin",
     "lifecycle": "nos_tpu.cmd.lifecycle",
     "fleet": "nos_tpu.cmd.fleet",
+    "gateway": "nos_tpu.cmd.gateway",
     "metricsexporter": "nos_tpu.cmd.metricsexporter",
     "trainer": "nos_tpu.cmd.trainer",
     "generate": "nos_tpu.cmd.generate",

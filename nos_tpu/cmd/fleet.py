@@ -81,9 +81,28 @@ class HttpReplicaClient:
             pass
 
 
+class HttpGatewayClient:
+    """Scrapes the gateway's /stats for the door-queue activation
+    signal (``--gateway-url``). Unreachable reads as None — the
+    controller treats gateway silence as zero pressure, and the
+    ConfigMap annotation remains the durable fallback path."""
+
+    def __init__(self, url: str, timeout_s: float = 2.0):
+        self.url = url.rstrip("/")
+        self.timeout_s = timeout_s
+
+    def stats(self) -> Optional[dict]:
+        try:
+            with urllib.request.urlopen(
+                    self.url + "/stats", timeout=self.timeout_s) as r:
+                return json.loads(r.read())
+        except Exception:   # noqa: BLE001 — unreachable is a signal
+            return None
+
+
 def build(server, cfg: FleetConfig, stats_source=None, drain_hook=None,
           leader_election: bool = True,
-          identity: str = "fleet-0") -> Manager:
+          identity: str = "fleet-0", gateway_source=None) -> Manager:
     election = None
     if leader_election:
         election = LeaderElectionConfig(
@@ -91,7 +110,8 @@ def build(server, cfg: FleetConfig, stats_source=None, drain_hook=None,
             identity=identity)
     mgr = Manager(server, leader_election=election)
     ctl = FleetController(cfg, stats_source=stats_source,
-                          drain_hook=drain_hook)
+                          drain_hook=drain_hook,
+                          gateway_source=gateway_source)
     mgr.add_controller(ctl.controller())
     mgr.stats = ctl.stats           # HealthServer /stats route
     return mgr
@@ -174,6 +194,12 @@ def main(argv: Optional[Sequence[str]] = None) -> None:
         "--scrape-timeout", type=float, default=2.0,
         help="per-replica /stats scrape timeout in seconds")
     parser.add_argument(
+        "--gateway-url", default="",
+        help="base URL of the nos-tpu-gateway front door; its /stats "
+             "door_queue becomes the scale-from-zero activation "
+             "signal (empty = read the nos.ai/gateway-queued ConfigMap "
+             "annotation the gateway stamps instead)")
+    parser.add_argument(
         "--identity", default="fleet-0",
         help="leader-election identity (pod name in-cluster)")
     parser.add_argument(
@@ -206,11 +232,15 @@ def main(argv: Optional[Sequence[str]] = None) -> None:
     )
     replica = HttpReplicaClient(args.replica_url_template,
                                 timeout_s=args.scrape_timeout)
+    gateway = (HttpGatewayClient(args.gateway_url,
+                                 timeout_s=args.scrape_timeout)
+               if args.gateway_url else None)
     mgr = build(
         serve.connect(args), cfg,
         stats_source=replica.stats, drain_hook=replica.drain,
         leader_election=not args.no_leader_election,
         identity=args.identity,
+        gateway_source=gateway.stats if gateway else None,
     )
     serve.run_daemon(mgr, args.health_port, args.health_host)
 

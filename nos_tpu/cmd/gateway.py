@@ -1,0 +1,518 @@
+"""nos-tpu-gateway — the serving fleet's front door (ISSUE 11).
+
+    POST /v1/generate   same wire shape as nos-tpu-server, proxied to a
+                        replica picked by prefix-affinity (the prompt's
+                        leading block-chain hashed onto a consistent
+                        ring), least-loaded fallback under a bounded
+                        imbalance; unary and SSE streaming alike
+    GET  /healthz       gateway process liveness
+    GET  /readyz        always ok while running — a gateway with ZERO
+                        replicas still accepts traffic (it queues at
+                        the door and activates the fleet)
+    GET  /stats         router snapshot: replicas, door queue, routes,
+                        sheds, retries (the fleet controller's
+                        --gateway-url scrape target)
+    GET  /metrics       nos_tpu_gateway_* (+ /debug/traces)
+
+Discovery mirrors the fleet controller: ``nos.ai/fleet=<name>`` pods in
+``--namespace``, scraped by POD IP through ``--replica-url-template``
+(a draining replica leaves Service endpoints but keeps its IP — the
+gateway must keep seeing it to stop routing there gracefully).
+
+Retry semantics are the productionized ``test_fleet_chaos`` router:
+per-replica 429/503 sheds back off reason-aware and retry the next
+candidate; a replica dying mid-request requeues the attempt; the
+request completes EXACTLY once fleet-wide (each replica's serving loop
+accounts its own interrupted attempts). Deadlines propagate with the
+budget REMAINING after door queueing and retries, via the existing
+``X-Request-Deadline-S`` header.
+
+Scale-from-zero: with no admitting replica, requests park at the door
+and the gateway publishes its queue depth as the activation signal —
+the ``nos_tpu_gateway_door_queue`` gauge, ``/stats`` ``door_queue``,
+and the ``nos.ai/gateway-queued`` annotation stamped onto the
+``nos-tpu-gateway-<fleet>`` ConfigMap — which the fleet controller
+consumes as pressure even at ready==0. The queue flushes on the first
+replica turning ready.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import logging
+import threading
+import urllib.error
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Iterable, Optional, Sequence
+
+from nos_tpu import constants
+from nos_tpu.cmd import serve
+from nos_tpu.cmd.fleet import HttpReplicaClient
+from nos_tpu.cmd.serve import metrics_payload
+from nos_tpu.gateway import (
+    GatewayRouter, PodDiscovery, Replica, ReplicaUnreachable,
+    RouterConfig,
+)
+from nos_tpu.kube.apiserver import NotFound
+from nos_tpu.kube.client import Client
+from nos_tpu.kube.objects import ConfigMap, ObjectMeta
+from nos_tpu.models.errors import (
+    DeadlineExceeded, EngineRecovering, Infeasible, QueueFull,
+)
+from nos_tpu.obs import tracing
+
+logger = logging.getLogger(__name__)
+
+
+class HttpReplicaTransport:
+    """One dispatch attempt over a replica's own HTTP surface, raising
+    the serving-plane error taxonomy the router retries through. The
+    remaining deadline budget travels as ``X-Request-Deadline-S``."""
+
+    def __init__(self, timeout_s: float = 300.0):
+        self.timeout_s = timeout_s
+
+    def _request(self, replica: Replica, req: dict, stream: bool):
+        if not replica.handle:
+            # a Running pod without an IP yet: nothing to dial
+            raise ReplicaUnreachable(
+                f"replica {replica.name} has no address yet")
+        body = dict(req["sampling"])
+        body["prompt"] = req["prompt"]
+        body["max_new_tokens"] = req["max_new_tokens"]
+        if stream:
+            body["stream"] = True
+        headers = {"Content-Type": "application/json"}
+        if req.get("deadline_s") is not None:
+            headers["X-Request-Deadline-S"] = f"{req['deadline_s']:.3f}"
+        timeout = self.timeout_s
+        if req.get("deadline_s") is not None:
+            timeout = min(timeout, req["deadline_s"] + 5.0)
+        return urllib.request.Request(
+            f"{replica.handle}/v1/generate",
+            data=json.dumps(body).encode(), headers=headers,
+            method="POST"), timeout
+
+    def _raise_for(self, e: urllib.error.HTTPError):
+        try:
+            payload = json.loads(e.read() or b"{}")
+        except Exception:   # noqa: BLE001 — body is advisory
+            payload = {}
+        msg = payload.get("error") or f"replica answered {e.code}"
+        reason = payload.get("reason")
+        if e.code == 429:
+            raise QueueFull(msg, reason=reason or "queue_full")
+        if e.code == 400:
+            if payload.get("infeasible"):
+                raise Infeasible(msg)
+            raise ValueError(msg)
+        if e.code == 504:
+            raise DeadlineExceeded(msg)
+        if e.code == 503:
+            if reason == "recovering":
+                raise EngineRecovering(msg)
+            # draining / timeout / unknown 503: retryable elsewhere
+            raise RuntimeError(msg)
+        raise RuntimeError(msg)
+
+    def send(self, replica: Replica, req: dict) -> list:
+        request, timeout = self._request(replica, req, stream=False)
+        try:
+            with urllib.request.urlopen(request, timeout=timeout) as r:
+                return json.loads(r.read())["tokens"]
+        except urllib.error.HTTPError as e:
+            self._raise_for(e)
+        except (urllib.error.URLError, OSError) as e:
+            raise ReplicaUnreachable(
+                f"replica {replica.name} unreachable: {e}") from e
+
+    def send_stream(self, replica: Replica, req: dict
+                    ) -> Iterable[list]:
+        """SSE passthrough: yields token-list deltas; an in-band error
+        frame BEFORE any data raises (retryable at the router), after
+        data it raises too — the router propagates it (no replay)."""
+        request, timeout = self._request(replica, req, stream=True)
+        try:
+            resp = urllib.request.urlopen(request, timeout=timeout)
+        except urllib.error.HTTPError as e:
+            self._raise_for(e)
+            return
+        except (urllib.error.URLError, OSError) as e:
+            raise ReplicaUnreachable(
+                f"replica {replica.name} unreachable: {e}") from e
+        try:
+            for raw in resp:
+                line = raw.strip()
+                if not line or not line.startswith(b"data: "):
+                    continue
+                data = line[len(b"data: "):]
+                if data == b"[DONE]":
+                    return
+                frame = json.loads(data)
+                if "error" in frame:
+                    raise RuntimeError(frame["error"])
+                yield frame.get("tokens") or []
+            # stream ended without [DONE]: the replica died mid-answer
+            raise ReplicaUnreachable(
+                f"replica {replica.name} closed the stream early")
+        finally:
+            resp.close()
+
+
+class AnnotationStamper:
+    """Publishes the door-queue depth as the ``nos.ai/gateway-queued``
+    annotation on the ``nos-tpu-gateway-<fleet>`` ConfigMap — the
+    durable half of the activation signal (the gauge being the live
+    half). Runs on its own thread: the router calls ``note`` under its
+    lock, so the network write must happen elsewhere. Level-triggered
+    and idempotent: only depth CHANGES are stamped, including back to
+    zero (a stale nonzero annotation would hold a scaled-to-zero fleet
+    awake forever)."""
+
+    def __init__(self, client: Client, fleet: str, namespace: str):
+        self.client = client
+        self.fleet = fleet
+        self.namespace = namespace
+        self.name = f"nos-tpu-gateway-{fleet}"
+        self._event = threading.Event()
+        self._stop = False
+        self._depth = 0
+        self._stamped: Optional[int] = None
+        self._thread = threading.Thread(
+            target=self._run, name="gateway-activation", daemon=True)
+
+    def start(self) -> "AnnotationStamper":
+        self._ensure()
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop = True
+        self._event.set()
+        self._thread.join(timeout=5)
+
+    def note(self, depth: int) -> None:
+        self._depth = depth
+        self._event.set()
+
+    def _ensure(self) -> None:
+        try:
+            self.client.create(ConfigMap(
+                metadata=ObjectMeta(name=self.name,
+                                    namespace=self.namespace),
+                data={"fleet": self.fleet}))
+        except Exception:   # noqa: BLE001 — AlreadyExists or transient;
+            pass            # the patch below is the real write
+
+    def _run(self) -> None:
+        while not self._stop:
+            self._event.wait()
+            self._event.clear()
+            if self._stop:
+                return
+            depth = self._depth
+            if depth == self._stamped:
+                continue
+            try:
+                self.client.patch(
+                    "ConfigMap", self.name, self.namespace,
+                    lambda cm: cm.metadata.annotations.update(
+                        {constants.ANNOTATION_GATEWAY_QUEUED: str(depth)}))
+                self._stamped = depth
+            except NotFound:
+                self._ensure()
+                self._event.set()       # retry the stamp
+            except Exception as e:  # noqa: BLE001 — advisory signal
+                logger.debug("activation stamp failed: %s", e)
+
+
+class DiscoveryLoop:
+    """Polls PodDiscovery every ``interval_s`` into the router."""
+
+    def __init__(self, discovery: PodDiscovery, router: GatewayRouter,
+                 interval_s: float):
+        self.discovery = discovery
+        self.router = router
+        self.interval_s = interval_s
+        self._stop = threading.Event()
+        self._thread = threading.Thread(
+            target=self._run, name="gateway-discovery", daemon=True)
+
+    def start(self) -> "DiscoveryLoop":
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._thread.join(timeout=5)
+
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            try:
+                self.router.update(self.discovery.poll())
+            except Exception as e:  # noqa: BLE001 — a failed poll keeps
+                logger.warning("discovery pass failed: %s", e)  # last view
+            self._stop.wait(self.interval_s)
+
+
+def make_http_server(router: GatewayRouter, port: int,
+                     fleet: str) -> ThreadingHTTPServer:
+    class Handler(BaseHTTPRequestHandler):
+        def log_message(self, fmt, *args):
+            logger.debug("http: " + fmt, *args)
+
+        def _reply(self, code: int, body: dict, headers=()) -> None:
+            data = json.dumps(body).encode()
+            self.send_response(code)
+            self.send_header("Content-Type", "application/json")
+            for name, value in headers:
+                self.send_header(name, value)
+            self.send_header("Content-Length", str(len(data)))
+            self.end_headers()
+            self.wfile.write(data)
+
+        def do_GET(self):
+            if self.path == "/healthz":
+                self._reply(200, {"status": "ok"})
+            elif self.path == "/readyz":
+                # a replica-less gateway is still READY: it queues at
+                # the door and wakes the fleet — flipping readiness
+                # here would hide the front door exactly when the
+                # scale-from-zero path needs it reachable
+                self._reply(200, {"status": "ok"})
+            elif self.path == "/metrics":
+                text, ctype = metrics_payload(
+                    self.headers.get("Accept", ""))
+                body = text.encode()
+                self.send_response(200)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+            elif self.path == "/stats":
+                snap = router.stats()
+                snap["fleet"] = fleet
+                self._reply(200, snap)
+            elif self.path == "/debug/traces":
+                self._reply(200, tracing.recorder().to_json())
+            else:
+                self._reply(404, {"error": f"unknown path {self.path}"})
+
+        def _stream_sse(self, gen, first=None) -> None:
+            """Mirror of the serving binary's SSE framing: deltas as
+            ``data:`` frames, errors in-band, always a ``[DONE]``.
+            ``first`` is the pre-pulled delta do_POST primed with —
+            by the time headers commit here, sheds have already taken
+            the JSON 4xx path."""
+            try:
+                self.send_response(200)
+                self.send_header("Content-Type", "text/event-stream")
+                self.send_header("Cache-Control", "no-cache")
+                self.send_header("Connection", "close")
+                self.end_headers()
+                if first is not None:
+                    self.wfile.write(
+                        b"data: " + json.dumps({"tokens": first}).encode()
+                        + b"\n\n")
+                    self.wfile.flush()
+                for delta in gen:
+                    self.wfile.write(
+                        b"data: " + json.dumps({"tokens": delta}).encode()
+                        + b"\n\n")
+                    self.wfile.flush()
+                self.wfile.write(b"data: [DONE]\n\n")
+            except OSError:
+                pass
+            except Exception as e:  # noqa: BLE001 — in-band error frame
+                try:
+                    self.wfile.write(
+                        b"data: " + json.dumps(
+                            {"error": f"{type(e).__name__}: {e}"}).encode()
+                        + b"\n\ndata: [DONE]\n\n")
+                except OSError:
+                    pass
+            finally:
+                gen.close()
+
+        def do_POST(self):
+            if self.path != "/v1/generate":
+                self._reply(404, {"error": f"unknown path {self.path}"})
+                return
+            try:
+                length = int(self.headers.get("Content-Length", 0))
+                body = json.loads(self.rfile.read(length) or b"{}")
+                prompt = [int(t) for t in body.pop("prompt")]
+                n = int(body.pop("max_new_tokens", 64))
+                stream = bool(body.pop("stream", False))
+                deadline = body.pop(
+                    "deadline_s",
+                    self.headers.get("X-Request-Deadline-S"))
+                deadline_s = float(deadline) if deadline is not None \
+                    else None
+                # every remaining body key forwards verbatim — the
+                # replica owns validation of its own wire surface
+                if stream:
+                    gen = router.stream(prompt, n, deadline_s=deadline_s,
+                                        **body)
+                    # prime the FIRST delta before committing the
+                    # status line: router.stream is lazy, and a door
+                    # shed / spent deadline / exhausted retry budget
+                    # must answer the same JSON 429/504 the replica
+                    # surface answers — not a 200 whose body carries
+                    # an error frame no Retry-After logic can see
+                    # (the serving binary submits eagerly for exactly
+                    # this reason)
+                    try:
+                        first = next(gen)
+                    except StopIteration:
+                        first = None
+                    self._stream_sse(gen, first=first)
+                    return
+                tokens, replica, attempts = router.dispatch(
+                    prompt, n, deadline_s=deadline_s, **body)
+            except Infeasible as e:
+                self._reply(400, {"error": f"{type(e).__name__}: {e}",
+                                  "infeasible": True,
+                                  "reason": e.reason})
+                return
+            except (KeyError, ValueError, TypeError) as e:
+                self._reply(400, {"error": f"{type(e).__name__}: {e}",
+                                  "reason": "bad_request"})
+                return
+            except QueueFull as e:
+                # the gateway's own door sheds (fleet_queue_full /
+                # fleet_hbm_admission / door_queue_full /
+                # no_ready_replicas) and replica sheds that survived
+                # the retry budget — same 429 + Retry-After shape
+                self._reply(429, {"error": str(e), "reason": e.reason},
+                            headers=[("Retry-After", "1")])
+                return
+            except DeadlineExceeded as e:
+                self._reply(504, {"error": str(e),
+                                  "deadline_exceeded": True})
+                return
+            except EngineRecovering as e:
+                self._reply(503, {"error": str(e),
+                                  "reason": "recovering"},
+                            headers=[("Retry-After", "1")])
+                return
+            except Exception as e:  # noqa: BLE001 — retries exhausted
+                self._reply(502, {"error": f"{type(e).__name__}: {e}"})
+                return
+            self._reply(200, {"tokens": tokens, "replica": replica,
+                              "attempts": attempts})
+
+    class Server(ThreadingHTTPServer):
+        daemon_threads = True
+
+    return Server(("0.0.0.0", port), Handler)
+
+
+def main(argv: Optional[Sequence[str]] = None) -> None:
+    parser = argparse.ArgumentParser(prog="nos-tpu-gateway",
+                                     description=__doc__)
+    serve.common_flags(parser, config=False)
+    parser.add_argument("--fleet", default="default",
+                        help="fleet name (the nos.ai/fleet label value)")
+    parser.add_argument("--namespace", default="serving",
+                        help="namespace the replica pods live in")
+    parser.add_argument("--port", type=int, default=8080,
+                        help="front-door HTTP port")
+    parser.add_argument(
+        "--replica-url-template", default="http://{ip}:8000",
+        help="how to reach a replica pod's HTTP surface ({ip} = "
+             "status.podIP; {name}/{namespace} substituted)")
+    parser.add_argument(
+        "--scrape-timeout", type=float, default=2.0,
+        help="per-replica /stats scrape timeout in seconds")
+    parser.add_argument(
+        "--discovery-interval", type=float, default=2.0,
+        help="seconds between replica discovery/scrape passes")
+    parser.add_argument(
+        "--block-size", type=int, default=16,
+        help="affinity-hash block size in tokens — match the replicas' "
+             "--kv-block-size so the routed block-chain is the one "
+             "their PrefixBlockIndex actually shares")
+    parser.add_argument(
+        "--affinity-blocks", type=int, default=4,
+        help="leading FULL blocks hashed into the affinity key; set at "
+             "or below your shortest shared system-prompt length in "
+             "blocks (hashing past the shared prefix scatters it)")
+    parser.add_argument(
+        "--max-imbalance", type=float, default=4.0,
+        help="requests a ring candidate may carry beyond the "
+             "least-loaded replica before affinity yields to balance")
+    parser.add_argument(
+        "--admit-pending-per-replica", type=float, default=0.0,
+        help="fleet-wide pending per admitting replica above which the "
+             "door sheds 429 reason=fleet_queue_full (0 = off)")
+    parser.add_argument(
+        "--admit-hbm-frac", type=float, default=0.0,
+        help="shed 429 reason=fleet_hbm_admission while EVERY "
+             "admitting replica reports HBM use at/above this fraction "
+             "(0 = off)")
+    parser.add_argument(
+        "--max-door-queue", type=int, default=256,
+        help="requests that may park at the door while no replica "
+             "admits (scale-from-zero); past it the door sheds 429")
+    parser.add_argument(
+        "--door-wait", type=float, default=30.0,
+        help="seconds a parked request waits for a replica before "
+             "shedding 429 reason=no_ready_replicas")
+    parser.add_argument(
+        "--retry-attempts", type=int, default=12,
+        help="dispatch attempts per request before failing it")
+    parser.add_argument(
+        "--retry-backoff", type=float, default=0.05,
+        help="reason-aware retry backoff base in seconds")
+    parser.add_argument(
+        "--request-timeout", type=float, default=300.0,
+        help="per-attempt replica HTTP timeout in seconds")
+    args = parser.parse_args(argv)
+
+    serve.setup_observability(args)
+    client = Client(serve.connect(args))
+    transport = HttpReplicaTransport(timeout_s=args.request_timeout)
+    stamper = AnnotationStamper(client, args.fleet,
+                                args.namespace).start()
+    router = GatewayRouter(
+        RouterConfig(
+            block_size=args.block_size,
+            affinity_blocks=args.affinity_blocks,
+            max_imbalance=args.max_imbalance,
+            admit_pending_per_replica=args.admit_pending_per_replica,
+            admit_hbm_frac=args.admit_hbm_frac,
+            max_door_queue=args.max_door_queue,
+            door_wait_s=args.door_wait,
+            max_attempts=args.retry_attempts,
+            backoff_s=args.retry_backoff,
+        ),
+        transport=transport.send,
+        stream_transport=transport.send_stream,
+        on_activation=stamper.note,
+    )
+    scraper = HttpReplicaClient(args.replica_url_template,
+                                timeout_s=args.scrape_timeout)
+
+    def handle_for(pod):
+        return scraper._url(pod)
+
+    discovery = DiscoveryLoop(
+        PodDiscovery(client, args.fleet, args.namespace,
+                     stats_source=scraper.stats, handle_for=handle_for),
+        router, args.discovery_interval).start()
+    httpd = make_http_server(router, args.port, args.fleet)
+    logger.info("gateway for fleet %s on :%d", args.fleet, args.port)
+    try:
+        httpd.serve_forever()
+    except KeyboardInterrupt:
+        pass
+    finally:
+        discovery.stop()
+        stamper.stop()
+        httpd.server_close()
+
+
+if __name__ == "__main__":
+    main()

@@ -2015,12 +2015,21 @@ def make_http_server(cfg: ServerConfig, loop: ServingLoop
             except EngineRecovering as e:
                 # supervised restart in flight: same wire shape as
                 # QueueFull (Retry-After) but 503 — the SERVER is
-                # briefly degraded, not the client over capacity
-                self._reply(503, {"error": str(e)},
+                # briefly degraded, not the client over capacity.
+                # ``reason`` makes the 503 family machine-readable for
+                # the gateway's retry policy (a recovering replica is
+                # worth a short backoff; a draining one never is)
+                self._reply(503, {"error": str(e),
+                                  "reason": "recovering"},
                             headers=[("Retry-After", "1")])
                 return
-            except (TimeoutError, DrainingError) as e:
-                self._reply(503, {"error": str(e)})
+            except DrainingError as e:
+                self._reply(503, {"error": str(e),
+                                  "reason": "draining"})
+                return
+            except TimeoutError as e:
+                self._reply(503, {"error": str(e),
+                                  "reason": "timeout"})
                 return
             except Exception as e:  # decode-loop death → JSON 500, not a dropped conn
                 self._reply(500, {"error": f"{type(e).__name__}: {e}"})

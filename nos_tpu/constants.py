@@ -174,6 +174,13 @@ LABEL_FLEET = DOMAIN + "/fleet"
 # scale-down: the replica stops admitting (readiness flips), in-flight
 # requests finish (or the drain budget expires), then the pod is deleted.
 ANNOTATION_FLEET_DRAIN = DOMAIN + "/fleet-drain"
+# Scale-from-zero activation signal (nos_tpu/gateway/): the gateway
+# stamps its door-queue depth onto the ``nos-tpu-gateway-<fleet>``
+# ConfigMap under this annotation whenever the depth changes (including
+# back to zero). The fleet controller reads it as queued-at-door
+# pressure — the signal that wakes a min_replicas=0 fleet — when no
+# richer gateway_source (the gateway's /stats over HTTP) is wired.
+ANNOTATION_GATEWAY_QUEUED = DOMAIN + "/gateway-queued"
 
 # Scheduler / controller names
 SCHEDULER_NAME = "nos-scheduler"

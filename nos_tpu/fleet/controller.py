@@ -109,11 +109,23 @@ class FleetController:
                  = None,
                  drain_hook: Optional[Callable[[Pod], None]] = None,
                  calculator: Optional[ResourceCalculator] = None,
-                 clock: Callable[[], float] = time.monotonic):
+                 clock: Callable[[], float] = time.monotonic,
+                 gateway_source: Optional[Callable[[], Optional[dict]]]
+                 = None):
         self.cfg = cfg
         self.policy = ScalingPolicy(cfg.policy)
         self.stats_source = stats_source or (lambda pod: None)
         self.drain_hook = drain_hook
+        # the gateway's activation signal: a callable returning its
+        # /stats snapshot ({"door_queue": n, ...} — HTTP in the binary
+        # via --gateway-url, the router object in benches/tests). When
+        # None, the controller falls back to the nos.ai/gateway-queued
+        # annotation the gateway binary stamps onto the
+        # nos-tpu-gateway-<fleet> ConfigMap. Either way, queued-at-door
+        # requests count as pressure EVEN AT ready == 0 — without this
+        # a scaled-to-zero fleet registers no signal at all (the
+        # activator gap the policy documented).
+        self.gateway_source = gateway_source
         self.calc = calculator or ResourceCalculator()
         self.clock = clock
         self._uptimes: Dict[str, float] = {}      # pod -> last uptime_s
@@ -134,9 +146,10 @@ class FleetController:
             "nos_tpu_fleet_scale_events_total",
             "Fleet scaling actuations, by direction (up | down) and "
             "reason (queue_depth | goodput | ttft_p99 | oldest_wait | "
-            "idle | min_replicas | no_ready_replicas | quota_reclaim; "
-            "quota_clamped marks an up-step cut short by ElasticQuota "
-            "slack)",
+            "idle | min_replicas | no_ready_replicas | activation = "
+            "gateway door queue woke a scaled-to-zero fleet | "
+            "quota_reclaim; quota_clamped marks an up-step cut short "
+            "by ElasticQuota slack)",
             ("direction", "reason"))
         self.h_reconcile = reg.histogram(
             "nos_tpu_fleet_reconcile_seconds",
@@ -243,8 +256,10 @@ class FleetController:
         drift = self._config_drift(replicas)
         self.g_drift.set(drift)
 
+        gateway_queued = self._gateway_queued(client)
         signals = FleetSignals.aggregate(
-            replicas, total_replicas=len(steering))
+            replicas, total_replicas=len(steering),
+            gateway_queued=gateway_queued)
         current = len(steering)
         decision = self.policy.decide(signals, current, now)
         desired = decision.desired
@@ -356,6 +371,7 @@ class FleetController:
                 "ttft_p99_s": signals.ttft_p99_s,
                 "oldest_wait_s": signals.oldest_wait_s,
                 "restarted_replicas": signals.restarted_replicas,
+                "gateway_queued": signals.gateway_queued,
             },
             "decision": {"direction": decision.direction,
                          "reason": decision.reason},
@@ -370,6 +386,40 @@ class FleetController:
                                   drains=n_draining > 0)
 
     # -- scrape helpers -------------------------------------------------
+    def _gateway_queued(self, client: Client) -> int:
+        """Requests parked at the gateway's door — the scale-from-zero
+        pressure signal. Preferred source is the injected
+        ``gateway_source`` (the gateway's /stats); the fallback is the
+        ``nos.ai/gateway-queued`` annotation the gateway binary stamps
+        onto the ``nos-tpu-gateway-<fleet>`` ConfigMap. No gateway at
+        all reads as 0 — exactly the pre-gateway behavior."""
+        if self.gateway_source is not None:
+            snap = None
+            try:
+                snap = self.gateway_source()
+            except Exception:   # noqa: BLE001 — an unreachable gateway
+                snap = None     # is silence, never a crashed reconcile
+            if snap is not None:
+                return int(snap.get("door_queue")
+                           or snap.get("queued") or 0)
+            # source wired but unreachable: fall THROUGH to the
+            # ConfigMap annotation — it is the durable half of the
+            # signal, and a controller->gateway network break must not
+            # strand a queued cold burst at a scaled-to-zero fleet
+        try:
+            cm = client.get("ConfigMap",
+                            f"nos-tpu-gateway-{self.cfg.name}",
+                            self.cfg.namespace)
+        except NotFound:
+            return 0
+        except Exception:       # noqa: BLE001 — same: silence
+            return 0
+        try:
+            return int(cm.metadata.annotations.get(
+                constants.ANNOTATION_GATEWAY_QUEUED, 0))
+        except (TypeError, ValueError):
+            return 0
+
     def _scrape(self, pod: Pod) -> Optional[dict]:
         try:
             return self.stats_source(pod)
@@ -543,6 +593,10 @@ class FleetController:
                 Watch("Pod", mapper=to_fleet),
                 Watch("ElasticQuota", mapper=to_fleet),
                 Watch("CompositeElasticQuota", mapper=to_fleet),
+                # the gateway's activation annotation rides a ConfigMap:
+                # a door-queue stamp must wake a scaled-to-zero fleet
+                # NOW, not at the next requeue_after tick
+                Watch("ConfigMap", mapper=to_fleet),
             ],
         )
         # self-seed: an empty cluster emits no initial-sync events, but

@@ -137,10 +137,16 @@ class FleetSignals:
     ttft_p99_s: Optional[float] = None      # worst replica
     oldest_wait_s: float = 0.0              # worst replica
     restarted_replicas: int = 0
+    # requests parked at the gateway's door because no replica admits —
+    # the scale-from-zero activation signal (gateway /stats door_queue
+    # or the nos.ai/gateway-queued annotation). Counted into
+    # pending_total too: door-queued work IS pending work.
+    gateway_queued: int = 0
 
     @classmethod
     def aggregate(cls, replicas: List[ReplicaStats],
-                  total_replicas: Optional[int] = None) -> "FleetSignals":
+                  total_replicas: Optional[int] = None,
+                  gateway_queued: int = 0) -> "FleetSignals":
         """Fold per-replica scrapes into fleet signals. Freshly
         RESTARTED replicas contribute their queue depth (real work) but
         not their goodput/TTFT (an empty ledger is silence, not
@@ -148,7 +154,13 @@ class FleetSignals:
         QUEUE DEPTH counts every scraped replica, ready or not — a
         fleet whose replicas are all recovering/draining still has real
         queued work, and it must register as pressure (the
-        no_ready_replicas trigger) rather than silence."""
+        no_ready_replicas trigger) rather than silence. The same
+        holds ONE LAYER UP for ``gateway_queued``: requests parked at
+        the gateway's door never reach a replica queue at all — before
+        the gateway existed, a scaled-to-zero fleet registered no
+        signal whatsoever (the policy's documented activator gap) —
+        so they fold into pending here, pressure-visible even at
+        ready == 0 and total == 0."""
         ready = [r for r in replicas if r.ready]
         judged = [r for r in ready
                   if not r.restarted and r.goodput is not None
@@ -158,7 +170,8 @@ class FleetSignals:
                    / total_done if total_done else None)
         ttfts = [r.ttft_p99_s for r in ready
                  if not r.restarted and r.ttft_p99_s is not None]
-        pending = sum(r.pending_depth for r in replicas)
+        pending = sum(r.pending_depth for r in replicas) \
+            + max(0, gateway_queued)
         return cls(
             ready_replicas=len(ready),
             total_replicas=(total_replicas if total_replicas is not None
@@ -170,6 +183,7 @@ class FleetSignals:
             oldest_wait_s=max((r.oldest_wait_s for r in ready),
                               default=0.0),
             restarted_replicas=sum(1 for r in replicas if r.restarted),
+            gateway_queued=max(0, gateway_queued),
         )
 
 
@@ -215,14 +229,26 @@ class ScalingPolicy:
         pressure; None inside/below the band. Magnitude is in 'missing
         replicas' units for the queue trigger, 1.0 for the rest."""
         c = self.cfg
+        if s.ready_replicas == 0 and s.total_replicas == 0 \
+                and s.gateway_queued > 0:
+            # THE activator arm (ISSUE 11): a min_replicas=0 fleet
+            # scaled to zero has no replica queue to observe, but the
+            # gateway's door queue is real demand parked in front of
+            # zero capacity. Magnitude is in "missing replicas" units
+            # (queued work over the queue band) so a large cold burst
+            # may start more than one replica, bounded by max_step_up
+            # as always.
+            return ("activation",
+                    max(1.0, s.gateway_queued / max(1.0, c.queue_high)))
         if s.ready_replicas == 0 and s.pending_total > 0:
             # queued work with nobody serving it. Deliberately NOT
             # triggered by total_replicas == 0 alone: bootstrap below
             # min_replicas is decide()'s own branch, and a
             # min_replicas=0 fleet idled down to zero has no queue to
             # observe — waking it on emptiness would flap 0->1->0
-            # forever (scale-FROM-zero needs an activator in front,
-            # not a controller guessing)
+            # forever. With a gateway in front, its door queue (folded
+            # into pending_total, and the dedicated activation arm
+            # above) is exactly that activator.
             return ("no_ready_replicas", 1.0)
         if s.pending_per_replica > c.queue_high:
             return ("queue_depth",
@@ -263,6 +289,26 @@ class ScalingPolicy:
             return Decision(desired=c.min_replicas, direction="up",
                             reason="min_replicas")
         pressure = self._pressure_reason(signals)
+        if pressure is not None and pressure[0] == "activation" \
+                and current == 0 and c.max_step_up > 0:
+            # scale-FROM-zero is undamped like the min_replicas
+            # restore: stability windows exist to keep noise from
+            # flapping a fleet, and a door queue parked in front of
+            # ZERO capacity is not noise — every second of damping
+            # here is a second added to every queued user's TTFT.
+            # The up-cooldown still applies (a flapping activation
+            # signal must not out-create the scheduler); while not
+            # cooled the decision falls through to the damped path.
+            cooled = (self._last_up_t is None
+                      or now - self._last_up_t >= c.up_cooldown_s)
+            if cooled:
+                reason, magnitude = pressure
+                step = min(c.max_step_up, max(1, math.ceil(magnitude)))
+                self._last_up_t = now
+                self._pressure_since = None
+                return Decision(desired=min(c.max_replicas, step),
+                                direction="up", reason=reason,
+                                pressure=magnitude)
         idle = pressure is None and self._is_idle(signals)
         if pressure is not None:
             self._idle_since = None

@@ -15,28 +15,46 @@ threaded; this module models them instead:
   rate with a prefill delay, a pending queue, and a ``stats()``
   snapshot shaped like the serving binary's ``/stats`` (uptime, config
   echo, goodput/TTFT-p99 over a rolling window);
-- ``SimFleet``    — the Service/router: a fleet-level queue dispatched
-  least-loaded to ready replicas, drains, and lossless removal
+- ``SimFleet``    — the gateway/router: a fleet-level DOOR queue
+  dispatched to ready replicas under a pluggable policy
+  (``least_loaded`` | ``random`` | ``prefix_affinity`` — the last
+  sharing the PRODUCTION ring implementation from
+  ``nos_tpu/gateway/ring.py``, so the sim's affinity routing and the
+  gateway binary's cannot drift), drains, and lossless removal
   (unfinished requests return to the fleet queue). Conservation —
   submitted == completed + in-system — is a standing invariant tests
-  assert at every step;
+  assert at every step. ``gateway_stats()`` exposes the door-queue
+  depth in the gateway's /stats shape, so the fleet controller's
+  ``gateway_source`` can consume the sim as its activation signal;
 - ``SimKubelet``  — the pod <-> replica bridge: bound pods become
   Running replicas after a provisioning delay, drain annotations begin
   drains, deleted pods remove replicas (requeue included).
+
+Replicas model PR 6's block-granular prefix cache at the level routing
+cares about: each carries an LRU set of affinity keys (chains) it has
+prefilled before; admitting a request whose key is cached skips
+``prefix_hit_save`` of the prefill — the TTFT the fleet-wide cache is
+worth. Affinity routing lands a key on one home replica (one cold miss
+per key fleet-wide); scatter policies pay the miss once PER replica
+and churn each other's LRU.
 
 Everything advances on ``tick(dt)``; nothing reads the wall clock.
 """
 from __future__ import annotations
 
 import math
+import random as _random
 from collections import deque
 from dataclasses import dataclass, field
 from typing import Callable, Deque, Dict, List, Optional
 
 from nos_tpu import constants
+from nos_tpu.gateway.ring import HashRing, affinity_pick, prefix_key
 from nos_tpu.kube.client import Client
 
 __all__ = ["SimFleet", "SimKubelet", "SimReplica", "SimRequest"]
+
+ROUTERS = ("least_loaded", "random", "prefix_affinity")
 
 
 @dataclass
@@ -49,6 +67,11 @@ class SimRequest:
     done_t: Optional[float] = None
     prefill_left: float = 0.0
     requeues: int = 0
+    # affinity identity: the prefix_key of the request's prompt (None =
+    # no full-block prefix / promptless legacy submit) and whether its
+    # admission hit the serving replica's prefix cache
+    prefix: Optional[str] = None
+    prefix_hit: Optional[bool] = None
 
     def __post_init__(self):
         self.tokens_left = float(self.tokens)
@@ -74,6 +97,16 @@ class SimReplica:
     _ledger: Deque[tuple] = field(default_factory=deque)
     _completed_total: int = 0
     slo_ttft_s: float = 0.0
+    # the routing-level model of PR 6's PrefixBlockIndex: an LRU of
+    # affinity keys (prefix chains) this replica has prefilled before.
+    # 0 chains = model off (every admission pays full prefill). A hit
+    # skips prefix_hit_save of the prefill — the blocks are already in
+    # the replica's arena, only the suffix runs.
+    prefix_chains: int = 0
+    prefix_hit_save: float = 0.8
+    prefix_hits: int = 0
+    prefix_misses: int = 0
+    _prefix_lru: Dict[str, None] = field(default_factory=dict)
 
     def __post_init__(self):
         self.started_at = self.clock()
@@ -94,6 +127,22 @@ class SimReplica:
         while self.pending and len(self.active) < self.max_batch:
             req = self.pending.popleft()
             req.prefill_left = self.prefill_s
+            if req.prefix is not None and self.prefix_chains > 0:
+                if req.prefix in self._prefix_lru:
+                    # chain already in the arena: suffix-only prefill
+                    self._prefix_lru[req.prefix] = \
+                        self._prefix_lru.pop(req.prefix)  # LRU refresh
+                    req.prefill_left = \
+                        self.prefill_s * (1.0 - self.prefix_hit_save)
+                    req.prefix_hit = True
+                    self.prefix_hits += 1
+                else:
+                    self._prefix_lru[req.prefix] = None
+                    while len(self._prefix_lru) > self.prefix_chains:
+                        self._prefix_lru.pop(
+                            next(iter(self._prefix_lru)))
+                    req.prefix_hit = False
+                    self.prefix_misses += 1
             self.active.append(req)
         done: List[SimRequest] = []
         for req in list(self.active):
@@ -171,7 +220,15 @@ class SimFleet:
                  slo_ttft_s: float = 10.0, max_batch: int = 8,
                  tokens_per_s: float = 40.0, prefill_s: float = 0.25,
                  goodput_window_s: float = 60.0,
-                 config_echo: Optional[dict] = None):
+                 config_echo: Optional[dict] = None,
+                 router: str = "least_loaded",
+                 block_size: int = 16, affinity_blocks: int = 4,
+                 max_imbalance: float = 8.0,
+                 prefix_chains: int = 0, prefix_hit_save: float = 0.8,
+                 seed: int = 0):
+        if router not in ROUTERS:
+            raise ValueError(
+                f"router must be one of {ROUTERS}, got {router!r}")
         self.clock = clock
         self.slo_ttft_s = slo_ttft_s
         self.max_batch = max_batch
@@ -181,6 +238,18 @@ class SimFleet:
         self.config_echo = dict(config_echo or {
             "max_batch": max_batch, "pipeline_depth": 2,
             "decode_steps": 1, "kv_blocks": 0, "kv_block_size": 0})
+        self.router = router
+        self.block_size = block_size
+        self.affinity_blocks = affinity_blocks
+        self.max_imbalance = max_imbalance
+        self.prefix_chains = prefix_chains
+        self.prefix_hit_save = prefix_hit_save
+        # the PRODUCTION ring (gateway/ring.py) over non-draining
+        # replicas: the sim's prefix_affinity policy and the gateway
+        # binary route identically by construction
+        self._ring = HashRing()
+        self._rng = _random.Random(seed)
+        self.route_counts: Dict[str, int] = {}
         self.replicas: Dict[str, SimReplica] = {}
         self.queue: Deque[SimRequest] = deque()
         self.completed: List[SimRequest] = []
@@ -194,15 +263,21 @@ class SimFleet:
             name=name, clock=self.clock, max_batch=self.max_batch,
             tokens_per_s=self.tokens_per_s, prefill_s=self.prefill_s,
             goodput_window_s=self.goodput_window_s,
-            config=dict(self.config_echo))
+            config=dict(self.config_echo),
+            prefix_chains=self.prefix_chains,
+            prefix_hit_save=self.prefix_hit_save)
         rep.slo_ttft_s = self.slo_ttft_s
         self.replicas[name] = rep
+        self._ring.add(name)
         return rep
 
     def drain(self, name: str) -> None:
         rep = self.replicas.get(name)
         if rep is not None:
             rep.draining = True
+            # a draining replica must stop attracting its keys — the
+            # cache leaves with it (same rule as the gateway router)
+            self._ring.remove(name)
 
     def remove(self, name: str) -> int:
         """Delete a replica; unfinished requests requeue at the FRONT
@@ -211,6 +286,7 @@ class SimFleet:
         rep = self.replicas.pop(name, None)
         if rep is None:
             return 0
+        self._ring.remove(name)
         unfinished = rep.take_unfinished()
         for req in reversed(unfinished):
             self.queue.appendleft(req)
@@ -218,13 +294,37 @@ class SimFleet:
         return len(unfinished)
 
     # -- traffic --------------------------------------------------------
-    def submit(self, tokens: int) -> SimRequest:
+    def submit(self, tokens: int,
+               prompt: Optional[List[int]] = None) -> SimRequest:
         req = SimRequest(rid=self._next_rid, arrival_t=self.clock(),
-                         tokens=tokens)
+                         tokens=tokens,
+                         prefix=(prefix_key(prompt, self.block_size,
+                                            self.affinity_blocks)
+                                 if prompt is not None else None))
         self._next_rid += 1
         self.submitted += 1
         self.queue.append(req)
         return req
+
+    def _route(self, req: SimRequest, admitting: List[SimReplica]):
+        """One routing decision under the configured policy:
+        ``(replica, route_label)``. Returns ``(None, ...)`` when no
+        replica may take the request right now."""
+        if self.router == "least_loaded":
+            return (min(admitting, key=lambda r: (r.load(), r.name)),
+                    "least_loaded")
+        if self.router == "random":
+            under = sorted((r for r in admitting
+                            if r.load() < 3 * r.max_batch),
+                           key=lambda r: r.name)
+            return ((self._rng.choice(under) if under else None),
+                    "random")
+        loads = {r.name: float(r.load()) for r in admitting}
+        name, route = affinity_pick(
+            req.prefix, self._ring, loads, sorted(loads),
+            self.max_imbalance)
+        return ((self.replicas.get(name) if name is not None else None),
+                route)
 
     def _dispatch(self) -> None:
         admitting = sorted(
@@ -233,13 +333,21 @@ class SimFleet:
         if not admitting:
             return
         while self.queue:
-            target = min(admitting, key=lambda r: (r.load(), r.name))
+            target, route = self._route(self.queue[0], admitting)
             # keep per-replica queues shallow: past 3x max_batch total
             # load (1x active + up to 2x queued) the request waits at
-            # the router (arrival stamp keeps aging) — the controller's
-            # queue-depth signal reads the replica-side queues
-            if target.load() >= 3 * target.max_batch:
+            # the router/door (arrival stamp keeps aging) — the
+            # controller's queue-depth signal reads the replica-side
+            # queues, and the door depth rides gateway_stats()
+            if target is None or target.load() >= 3 * target.max_batch:
                 return
+            # count the route only when the request is actually
+            # admitted: a saturated head-of-queue request is re-decided
+            # every tick, and per-ATTEMPT counting would inflate the
+            # affinity/fallback split the bench artifact reports
+            if self.router == "prefix_affinity":
+                self.route_counts[route] = \
+                    self.route_counts.get(route, 0) + 1
             target.admit(self.queue.popleft())
 
     def tick(self, dt: float) -> None:
@@ -260,13 +368,24 @@ class SimFleet:
                        for r in self.completed)
         met = sum(1 for t in ttfts if t <= self.slo_ttft_s)
         n = len(ttfts)
+        keyed = [r for r in self.completed if r.prefix is not None]
+        hits = sum(1 for r in keyed if r.prefix_hit)
+        prefix = {
+            "keyed_requests": len(keyed),
+            "hits": hits,
+            "hit_rate": (round(hits / len(keyed), 6) if keyed else None),
+        }
         return {
+            "router": self.router,
+            "prefix": prefix,
+            "routes": dict(sorted(self.route_counts.items())),
             "submitted": self.submitted,
             "completed": n,
             "in_system": self.in_system(),
             "requeued": self.requeued,
             "goodput": round(met / n, 6) if n else None,
             "slo_breach_rate": round(1.0 - met / n, 6) if n else None,
+            "ttft_mean_s": round(sum(ttfts) / n, 4) if n else None,
             "ttft_p50_s": round(ttfts[n // 2], 4) if n else None,
             "ttft_p99_s": (round(ttfts[min(n - 1,
                                            math.ceil(0.99 * n) - 1)], 4)
@@ -278,6 +397,14 @@ class SimFleet:
     def stats_source(self, pod) -> Optional[dict]:
         rep = self.replicas.get(pod.metadata.name)
         return rep.stats() if rep is not None else None
+
+    def gateway_stats(self) -> dict:
+        """The fleet-level door queue in the gateway's /stats shape —
+        plug straight into ``FleetController(gateway_source=...)`` so a
+        scaled-to-zero sim fleet registers activation pressure."""
+        return {"door_queue": len(self.queue),
+                "ready_replicas": sum(
+                    1 for r in self.replicas.values() if not r.draining)}
 
 
 class SimKubelet:

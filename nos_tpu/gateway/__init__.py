@@ -1,0 +1,33 @@
+"""Fleet front door (ISSUE 11): the production gateway between clients
+and the autoscaled serving fleet.
+
+- ``ring``      — prefix-affinity consistent hashing: the prompt's
+                  leading block-chain (``kvblocks`` block arithmetic)
+                  hashed onto a ring over replicas, so shared system
+                  prompts repeatedly land where their KV blocks already
+                  live — PR 6's per-replica prefix cache, fleet-wide;
+- ``router``    — the exactly-once retrying dispatch core (the
+                  ``test_fleet_chaos`` fixture productionized): health/
+                  drain-aware retry with reason-aware backoff, global
+                  admission from scraped ``/stats``, deadline
+                  propagation, and the scale-from-zero door queue whose
+                  depth is the activation signal the fleet controller
+                  consumes;
+- ``discovery`` — the pod inventory (fleet label + pod IP +
+                  drain/readiness), derived the same way the fleet
+                  controller derives it.
+
+The binary is ``nos-tpu-gateway`` (``nos_tpu/cmd/gateway.py``);
+``fleet/sim.py`` shares the ring implementation so the sim's routing
+policies and the production router cannot drift.
+"""
+from nos_tpu.gateway.discovery import PodDiscovery
+from nos_tpu.gateway.ring import HashRing, affinity_pick, prefix_key
+from nos_tpu.gateway.router import (
+    GatewayRouter, Replica, ReplicaUnreachable, RouterConfig,
+)
+
+__all__ = [
+    "GatewayRouter", "HashRing", "PodDiscovery", "Replica",
+    "ReplicaUnreachable", "RouterConfig", "affinity_pick", "prefix_key",
+]

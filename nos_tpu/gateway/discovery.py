@@ -1,0 +1,77 @@
+"""Replica discovery for the gateway: the same pod inventory the fleet
+controller reconciles against — ``nos.ai/fleet=<name>`` labeled pods in
+the fleet namespace, Running, addressed by POD IP, drain/readiness
+aware — folded into the router's ``Replica`` table.
+
+The gateway and the controller MUST see the same fleet: a replica the
+controller counts as ready but the gateway won't route to (or the
+reverse) is a capacity accounting split-brain. Both therefore derive
+readiness the same way:
+
+- pod carries the fleet label and is ``Running``;
+- pod is NOT annotated ``nos.ai/fleet-drain`` (the controller's
+  graceful scale-down mark);
+- the replica's scraped ``/stats`` says ``healthy`` and neither
+  ``draining`` nor ``recovering`` (the same ``parse_replica_stats``
+  readiness rule, minus the SLO parsing the gateway doesn't need).
+
+``stats_source(pod) -> Optional[dict]`` is injectable exactly like the
+controller's — HTTP by pod IP in the binary, a SimFleet or a
+ServingLoop table in benches and tests — so discovery is testable
+without sockets. An unscrapable Running pod is surfaced as a known but
+NOT-ready replica (down, not gone): its ring membership drops — keys
+reroute — but the gateway keeps reporting it, because "I can see the
+pod but not the server" is a signal the operator wants."""
+from __future__ import annotations
+
+import logging
+from typing import Callable, List, Optional
+
+from nos_tpu import constants
+from nos_tpu.gateway.router import Replica
+from nos_tpu.kube.client import Client
+
+logger = logging.getLogger(__name__)
+
+__all__ = ["PodDiscovery"]
+
+
+class PodDiscovery:
+    """Polls the API server for the fleet's replica pods and returns
+    the router's ``Replica`` table. ``handle_for(pod)`` derives the
+    transport handle (the base URL in the binary; tests map names to
+    ServingLoops)."""
+
+    def __init__(self, client: Client, fleet: str, namespace: str,
+                 stats_source: Callable[[object], Optional[dict]],
+                 handle_for: Optional[Callable[[object], object]] = None):
+        self.client = client
+        self.fleet = fleet
+        self.namespace = namespace
+        self.stats_source = stats_source
+        self.handle_for = handle_for or (lambda pod: pod)
+
+    def poll(self) -> List[Replica]:
+        replicas: List[Replica] = []
+        pods = self.client.list(
+            "Pod", namespace=self.namespace,
+            label_selector={constants.LABEL_FLEET: self.fleet})
+        for pod in sorted(pods, key=lambda p: p.metadata.name):
+            if pod.status.phase != "Running":
+                continue
+            drain_marked = bool(pod.metadata.annotations.get(
+                constants.ANNOTATION_FLEET_DRAIN))
+            try:
+                snap = self.stats_source(pod)
+            except Exception:   # noqa: BLE001 — unreachable is a state,
+                snap = None     # never a crashed discovery pass
+            snap = snap or {}
+            healthy = bool(snap.get("healthy", False))
+            draining = drain_marked or bool(snap.get("draining"))
+            ready = (healthy and not draining
+                     and not snap.get("recovering"))
+            replicas.append(Replica(
+                name=pod.metadata.name,
+                handle=self.handle_for(pod),
+                ready=ready, draining=draining, stats=snap))
+        return replicas

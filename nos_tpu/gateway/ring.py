@@ -1,0 +1,176 @@
+"""Prefix-affinity consistent hashing — the gateway's routing kernel,
+deliberately jax-free (importable from the sim and the error paths).
+
+The idea (ISSUE 11 tentpole): PR 6 gave every replica a block-granular
+``PrefixBlockIndex`` — KV blocks of published prompts shared by
+refcount with any request whose prompt starts with the same tokens.
+That cache is per-replica; a fleet router that scatters requests
+randomly pays the prefill for the same system prompt once PER REPLICA
+instead of once per fleet. Hashing the prompt's leading block-chain
+onto a consistent-hash ring over replicas makes requests sharing a
+prefix land on the SAME replica, where their blocks already live —
+the per-replica prefix cache becomes a fleet-wide one, partitioned by
+prefix instead of duplicated.
+
+Three pieces, shared verbatim by the gateway binary and ``fleet/sim.py``
+(so the sim's ``prefix_affinity`` router and the production router
+cannot drift):
+
+- ``prefix_key``    — the affinity key: a digest over the prompt's
+  leading FULL blocks (the same ``len(prompt) // block_size``
+  arithmetic ``kvblocks.PrefixBlockIndex`` uses — only full blocks are
+  ever shared, so only full blocks may route), capped at
+  ``affinity_blocks`` so requests sharing a system prompt longer than
+  the cap still map to one key (hashing deeper than the shared prefix
+  would scatter them by their distinct tails);
+- ``HashRing``      — a consistent-hash ring with virtual nodes:
+  replica add/remove moves only ~1/N of the key space (ring stability
+  is what makes the affinity durable across scaling events);
+- ``affinity_pick`` — the pick rule: walk the ring's preference order
+  and take the first admitting replica whose load is within
+  ``max_imbalance`` of the least-loaded one; past that bound, fall
+  back to least-loaded. Affinity is a LOCALITY optimization, never a
+  load-balancing override — a hot prefix cannot melt its home replica.
+"""
+from __future__ import annotations
+
+import bisect
+import hashlib
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+__all__ = ["HashRing", "affinity_pick", "prefix_key"]
+
+
+def _digest(data: bytes) -> int:
+    """Stable 64-bit hash (hashlib, not ``hash()`` — Python salts the
+    builtin per process, and ring placement must survive restarts)."""
+    return int.from_bytes(hashlib.blake2b(data, digest_size=8).digest(),
+                          "big")
+
+
+def prefix_key(prompt: Sequence[int], block_size: int,
+               affinity_blocks: int = 4) -> Optional[str]:
+    """Affinity key for ``prompt``: a digest over its leading
+    ``min(len(prompt) // block_size, affinity_blocks)`` full blocks of
+    tokens — block-size arithmetic identical to
+    ``kvblocks.PrefixBlockIndex`` (``full = len(prompt) // bs``; only
+    full blocks are shareable, so only full blocks route). None when
+    the prompt has no full block (nothing shareable to colocate — the
+    caller falls back to least-loaded).
+
+    ``affinity_blocks`` caps the keyed depth: two prompts sharing a
+    system prefix of >= cap blocks but diverging after it must map to
+    the SAME key, so the cap should sit at or below the shortest
+    shared-prefix length you care to colocate (in blocks)."""
+    if block_size < 1:
+        raise ValueError(f"block_size must be >= 1, got {block_size}")
+    full = min(len(prompt) // block_size, max(0, affinity_blocks))
+    if full == 0:
+        return None
+    head = prompt[:full * block_size]
+    toks = b",".join(str(int(t)).encode() for t in head)
+    return hashlib.blake2b(toks, digest_size=16).hexdigest()
+
+
+class HashRing:
+    """Consistent-hash ring over named replicas with ``vnodes`` virtual
+    points per replica. ``lookup`` returns the full preference order
+    (clockwise from the key's point, distinct replicas) so callers can
+    walk fallbacks that preserve as much affinity as possible when the
+    owner is saturated or draining."""
+
+    def __init__(self, vnodes: int = 64):
+        if vnodes < 1:
+            raise ValueError(f"vnodes must be >= 1, got {vnodes}")
+        self.vnodes = vnodes
+        self._points: List[int] = []        # sorted vnode hashes
+        self._owner: Dict[int, str] = {}    # vnode hash -> replica
+        self._nodes: set = set()
+
+    def __contains__(self, node: str) -> bool:
+        return node in self._nodes
+
+    def __len__(self) -> int:
+        return len(self._nodes)
+
+    def nodes(self) -> List[str]:
+        return sorted(self._nodes)
+
+    def add(self, node: str) -> None:
+        if node in self._nodes:
+            return
+        self._nodes.add(node)
+        for i in range(self.vnodes):
+            h = _digest(f"{node}#{i}".encode())
+            # vanishingly unlikely 64-bit collision: skip rather than
+            # silently overwrite another replica's point
+            if h in self._owner:
+                continue
+            self._owner[h] = node
+            bisect.insort(self._points, h)
+
+    def remove(self, node: str) -> None:
+        if node not in self._nodes:
+            return
+        self._nodes.discard(node)
+        dead = [h for h, n in self._owner.items() if n == node]
+        for h in dead:
+            del self._owner[h]
+            idx = bisect.bisect_left(self._points, h)
+            if idx < len(self._points) and self._points[idx] == h:
+                self._points.pop(idx)
+
+    def sync(self, nodes: Iterable[str]) -> None:
+        """Reconcile membership to exactly ``nodes`` (discovery's
+        level-triggered update): adds and removals move only the
+        affected replicas' key ranges."""
+        want = set(nodes)
+        for node in list(self._nodes - want):
+            self.remove(node)
+        for node in sorted(want - self._nodes):
+            self.add(node)
+
+    def lookup(self, key: str, n: Optional[int] = None) -> List[str]:
+        """Preference order for ``key``: distinct replicas clockwise
+        from the key's ring point, at most ``n`` (all by default)."""
+        if not self._points:
+            return []
+        limit = len(self._nodes) if n is None else min(n, len(self._nodes))
+        start = bisect.bisect(self._points, _digest(key.encode()))
+        seen: List[str] = []
+        for i in range(len(self._points)):
+            node = self._owner[self._points[(start + i) % len(self._points)]]
+            if node not in seen:
+                seen.append(node)
+                if len(seen) >= limit:
+                    break
+        return seen
+
+
+def affinity_pick(key: Optional[str], ring: HashRing,
+                  loads: Dict[str, float], admitting: Sequence[str],
+                  max_imbalance: float = 4.0
+                  ) -> Tuple[Optional[str], str]:
+    """ONE routing decision, shared by the gateway router and the sim's
+    ``prefix_affinity`` policy: ``(replica, route)`` where ``route`` is
+    ``affinity`` (a ring candidate within the imbalance bound took it),
+    ``fallback`` (every ring candidate was overloaded/not admitting —
+    least-loaded took it) or ``no_key`` (no full-block prefix to key
+    on). ``loads`` is whatever load measure the caller balances on
+    (gateway: in-flight + queued per replica; sim: slot+queue depth);
+    the BOUND is what keeps affinity from becoming a hot-spot machine:
+    a candidate may exceed the least-loaded replica by at most
+    ``max_imbalance`` before routing gives locality up for balance."""
+    pool = [r for r in admitting]
+    if not pool:
+        return None, "no_replicas"
+    floor = min(loads.get(r, 0.0) for r in pool)
+    if key is not None:
+        allowed = set(pool)
+        for cand in ring.lookup(key):
+            if cand not in allowed:
+                continue
+            if loads.get(cand, 0.0) <= floor + max_imbalance:
+                return cand, "affinity"
+        return min(pool, key=lambda r: (loads.get(r, 0.0), r)), "fallback"
+    return min(pool, key=lambda r: (loads.get(r, 0.0), r)), "no_key"

@@ -1,0 +1,618 @@
+"""The gateway's routing core: prefix-affinity dispatch with
+exactly-once completion semantics, fleet-wide admission, and the
+scale-from-zero door queue (ISSUE 11 tentpole). Transport-injected and
+jax-free: the binary (cmd/gateway.py) plugs in an HTTP transport, the
+tests drive REAL ServingLoops, and benches mix both — the routing/
+retry/queueing state machine is identical everywhere.
+
+This productionizes the retrying router that until now lived as a test
+fixture (``tests/test_fleet_chaos.py``): the fixture proved fleet-level
+outcome conservation — every request finishes EXACTLY ONCE even when
+replicas drain, die mid-request, or 503 through a supervised restart —
+and this module keeps that contract while adding what a fixture never
+needed:
+
+- **prefix-affinity dispatch** (``gateway/ring.py``): requests sharing
+  a leading block-chain land on the replica whose ``PrefixBlockIndex``
+  already holds those KV blocks, least-loaded fallback past a bounded
+  per-replica imbalance;
+- **global admission**: the per-replica ``/stats`` the fleet controller
+  already scrapes, aggregated at the door — fleet-wide pending depth or
+  HBM pressure sheds BEFORE work reaches a replica, with
+  machine-readable reasons (``fleet_queue_full`` / ``fleet_hbm_admission``
+  / ``door_queue_full``) so clients and the autoscaler can tell
+  capacity pressure from everything else;
+- **deadline propagation**: a request's completion budget starts at
+  the DOOR; time spent queued or retrying shrinks what is forwarded to
+  the replica (the existing ``X-Request-Deadline-S`` header in the
+  HTTP transport), and an expired budget sheds at the gateway without
+  burning replica work;
+- **the scale-from-zero door queue**: with no admitting replica,
+  requests park in FIFO arrival order (bounded), the gateway publishes
+  an activation signal (``nos_tpu_gateway_door_queue`` gauge, /stats
+  ``door_queue``, and the ``on_activation`` hook the binary uses to
+  stamp the ``nos.ai/gateway-queued`` annotation) which the
+  ``FleetController`` consumes as pressure — and the queue flushes the
+  moment the first replica turns ready.
+
+Exactly-once semantics, precisely: the router resubmits a request ONLY
+when the previous attempt raised before delivering a result (shed,
+recovering, draining, unreachable, death mid-request). A replica that
+died mid-request accounts its own interrupted attempt terminally
+(``failed``/``cancelled`` — the serving loop's exactly-once outcome
+discipline), so the fleet-wide ledger shows exactly one ``finished``
+per request and the client sees exactly one completion. Streaming
+retries only until the FIRST delta is on the wire; after that a
+failure propagates (replaying tokens the client already holds would be
+a double-finish in stream form).
+"""
+from __future__ import annotations
+
+import logging
+import random
+import threading
+import time
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Any, Callable, Deque, Dict, Iterable, List, Optional
+
+from nos_tpu.gateway.ring import HashRing, affinity_pick, prefix_key
+from nos_tpu.models.errors import (
+    DeadlineExceeded, EngineRecovering, Infeasible, QueueFull,
+)
+from nos_tpu.obs import tracing
+from nos_tpu.utils.metrics import default_registry
+
+logger = logging.getLogger(__name__)
+
+__all__ = ["GatewayRouter", "Replica", "ReplicaUnreachable",
+           "RouterConfig"]
+
+#: terminal outcomes nos_tpu_gateway_requests_total reports
+OUTCOMES = ("completed", "shed", "deadline", "failed")
+
+#: door-shed reason slugs (the gateway's own additions to the
+#: serving-plane reason table in docs/autoscaling.md)
+REASON_FLEET_QUEUE = "fleet_queue_full"
+REASON_FLEET_HBM = "fleet_hbm_admission"
+REASON_DOOR_QUEUE = "door_queue_full"
+REASON_NO_REPLICAS = "no_ready_replicas"
+
+
+class ReplicaUnreachable(RuntimeError):
+    """The transport could not reach the replica (connection refused /
+    reset, scrape-dead pod): the request may or may not have started
+    there — either way THIS attempt delivered nothing, so the router
+    requeues it. The replica side accounts its own interrupted attempt
+    exactly once; resubmission cannot double-finish."""
+
+
+@dataclass
+class Replica:
+    """One replica as the router sees it. ``handle`` is opaque transport
+    state (a base URL for HTTP, a ServingLoop in tests, a SimReplica in
+    benches); ``stats`` is the last scraped ``/stats`` snapshot (the
+    same surface the fleet controller reads); ``inflight`` counts
+    requests THIS router currently has dispatched there — the load term
+    that is always fresh even when scrapes lag."""
+
+    name: str
+    handle: Any = None
+    ready: bool = True
+    draining: bool = False
+    stats: dict = field(default_factory=dict)
+    inflight: int = 0
+
+    def load(self) -> float:
+        pend = (self.stats.get("pending") or {}).get("depth", 0) or 0
+        active = self.stats.get("active_slots") or 0
+        return float(self.inflight + pend + active)
+
+    def hbm_frac(self) -> Optional[float]:
+        hbm = (self.stats.get("kv") or {}).get("hbm") or {}
+        in_use, limit = hbm.get("in_use"), hbm.get("limit")
+        if in_use is None or not limit:
+            return None
+        return in_use / limit
+
+
+@dataclass(frozen=True)
+class RouterConfig:
+    """Routing/admission knobs (helm: ``gateway.*``)."""
+
+    # affinity hashing: must match the replicas' --kv-block-size so the
+    # routed block-chain is the one PrefixBlockIndex actually shares;
+    # affinity_blocks caps the keyed depth (see ring.prefix_key)
+    block_size: int = 16
+    affinity_blocks: int = 4
+    # a ring candidate may exceed the least-loaded replica's load by at
+    # most this many requests before affinity yields to balance
+    max_imbalance: float = 4.0
+    # global admission (0 = disabled): shed at the door when fleet-wide
+    # pending per admitting replica exceeds the bound, or when EVERY
+    # admitting replica reports HBM use at/above the fraction
+    admit_pending_per_replica: float = 0.0
+    admit_hbm_frac: float = 0.0
+    # scale-from-zero door queue: how many requests may park while no
+    # replica admits, and how long one may wait before shedding
+    max_door_queue: int = 256
+    door_wait_s: float = 30.0
+    # retry budget per request (attempts, not replicas) and the
+    # reason-aware backoff base (seeded jitter on top)
+    max_attempts: int = 12
+    backoff_s: float = 0.05
+    backoff_max_s: float = 1.0
+    seed: int = 0
+
+
+class GatewayRouter:
+    """See module docstring. ``transport(replica, request) -> tokens``
+    performs one unary attempt; ``stream_transport(replica, request)``
+    returns an iterator of token-list deltas. ``request`` is a dict:
+    ``{"prompt", "max_new_tokens", "deadline_s", "sampling"}`` with
+    ``deadline_s`` already reduced to the REMAINING budget (None =
+    unbounded). Both raise the serving-plane error types (QueueFull /
+    EngineRecovering / DrainingError-shaped RuntimeErrors) or
+    ``ReplicaUnreachable``; anything retryable is retried on the next
+    candidate, everything else propagates."""
+
+    def __init__(self, cfg: RouterConfig = RouterConfig(),
+                 transport: Optional[Callable[[Replica, dict], list]] = None,
+                 stream_transport: Optional[
+                     Callable[[Replica, dict], Iterable[list]]] = None,
+                 on_activation: Optional[Callable[[int], None]] = None,
+                 clock: Callable[[], float] = time.monotonic,
+                 sleep: Callable[[float], None] = time.sleep):
+        self.cfg = cfg
+        self.transport = transport
+        self.stream_transport = stream_transport
+        self.on_activation = on_activation
+        self.clock = clock
+        self.sleep = sleep
+        self._rng = random.Random(cfg.seed)
+        self._lock = threading.Condition()
+        self._replicas: Dict[str, Replica] = {}
+        # in-flight attempts keyed by NAME, owned by the router — the
+        # Replica objects are replaced wholesale on every discovery
+        # update, so counting on them would lose decrements from
+        # requests that outlive one poll (the load signal would creep
+        # up forever). The table objects mirror the dict for load().
+        self._inflight: Dict[str, int] = {}
+        self._ring = HashRing()
+        self._door: Deque[int] = deque()        # ticket FIFO (rids)
+        self._next_ticket = 0
+        self._door_peak = 0
+        self._counts: Dict[str, int] = {k: 0 for k in OUTCOMES}
+        self._shed: Dict[str, int] = {}
+        self._routes: Dict[str, int] = {}
+        self._retries = 0
+        reg = default_registry()
+        self.m_requests = reg.counter(
+            "nos_tpu_gateway_requests_total",
+            "Requests leaving the gateway, by terminal outcome "
+            "(completed | shed = refused at the door with a reason | "
+            "deadline = budget spent before a replica delivered | "
+            "failed = retry budget exhausted or non-retryable error); "
+            "exactly one outcome per request",
+            ("outcome",))
+        self.m_shed = reg.counter(
+            "nos_tpu_gateway_shed_total",
+            "Door sheds by machine-readable reason (fleet_queue_full | "
+            "fleet_hbm_admission | door_queue_full | no_ready_replicas "
+            "— the gateway's own reasons, disjoint from the per-replica "
+            "429 reasons it retries through)",
+            ("reason",))
+        self.m_route = reg.counter(
+            "nos_tpu_gateway_route_total",
+            "Routing decisions by path (affinity = the prefix key's "
+            "ring candidate took it | fallback = ring candidates were "
+            "saturated/not admitting, least-loaded took it | no_key = "
+            "prompt had no full-block prefix to key on)",
+            ("path",))
+        self.m_retries = reg.counter(
+            "nos_tpu_gateway_retries_total",
+            "Dispatch attempts beyond each request's first, by cause "
+            "(shed | recovering | unreachable | error)",
+            ("cause",))
+        self.g_door = reg.gauge(
+            "nos_tpu_gateway_door_queue",
+            "Requests parked at the gateway because no replica is "
+            "admitting — the scale-from-zero activation signal the "
+            "fleet controller consumes as pressure")
+        self.h_door_wait = reg.histogram(
+            "nos_tpu_gateway_door_wait_seconds",
+            "Time requests spent parked in the door queue before "
+            "dispatch or shed")
+        self.g_replicas = reg.gauge(
+            "nos_tpu_gateway_replicas",
+            "Replicas as the gateway's discovery sees them, by state "
+            "(ready = admitting | draining | down = known but not "
+            "admitting for any other reason)",
+            ("state",))
+
+    # -- membership ------------------------------------------------------
+    def update(self, replicas: Iterable[Replica]) -> None:
+        """Level-triggered membership + stats refresh from discovery.
+        The ring holds exactly the ADMITTING replicas (ready and not
+        draining): a draining replica must stop attracting its keys —
+        its cache leaves with it — and ring points are derived from the
+        name, so a replica bouncing through not-ready and back restores
+        the identical mapping. A 0 -> >=1 admitting transition flushes
+        the door queue."""
+        with self._lock:
+            had_admitting = bool(self._admitting())
+            fresh = {}
+            for r in replicas:
+                r.inflight = self._inflight.get(r.name, 0)
+                fresh[r.name] = r
+            self._replicas = fresh
+            # prune settled counts for replicas that left the fleet
+            for name in [n for n, c in self._inflight.items()
+                         if c == 0 and n not in fresh]:
+                del self._inflight[name]
+            self._ring.sync(n for n in fresh
+                            if fresh[n].ready and not fresh[n].draining)
+            n_ready = len(self._admitting())
+            n_drain = sum(1 for r in fresh.values() if r.draining)
+            self.g_replicas.labels("ready").set(n_ready)
+            self.g_replicas.labels("draining").set(n_drain)
+            self.g_replicas.labels("down").set(
+                len(fresh) - n_ready - n_drain)
+            if not had_admitting and n_ready:
+                self._lock.notify_all()     # flush the door queue
+
+    def _admitting(self) -> List[str]:
+        return [n for n, r in self._replicas.items()
+                if r.ready and not r.draining]
+
+    def _inflight_delta(self, name: str, delta: int) -> None:
+        """Caller holds the lock. The dict is the truth; the current
+        table object (which discovery may have replaced since the
+        attempt started) mirrors it for ``load()``."""
+        self._inflight[name] = max(0, self._inflight.get(name, 0) + delta)
+        rep = self._replicas.get(name)
+        if rep is not None:
+            rep.inflight = self._inflight[name]
+
+    # -- admission -------------------------------------------------------
+    def _admit(self) -> None:
+        """Fleet-wide admission, caller holds the lock: shed at the
+        door — with a machine-readable reason — before work reaches a
+        replica. Uses the same scraped /stats the controller reads plus
+        the router's own in-flight attribution (fresh even when scrapes
+        lag)."""
+        cfg = self.cfg
+        admitting = self._admitting()
+        if not admitting:
+            return                  # the door queue's job, not a shed
+        if cfg.admit_pending_per_replica > 0:
+            pending = sum(self._replicas[n].load() for n in admitting) \
+                + len(self._door)
+            if pending / len(admitting) > cfg.admit_pending_per_replica:
+                self._note_shed(REASON_FLEET_QUEUE)
+                raise QueueFull(
+                    f"fleet saturated: {pending:.0f} requests pending "
+                    f"across {len(admitting)} replicas (bound "
+                    f"{cfg.admit_pending_per_replica}/replica); retry "
+                    f"when load drops", reason=REASON_FLEET_QUEUE)
+        if cfg.admit_hbm_frac > 0:
+            fracs = [self._replicas[n].hbm_frac() for n in admitting]
+            fracs = [f for f in fracs if f is not None]
+            if fracs and min(fracs) >= cfg.admit_hbm_frac:
+                self._note_shed(REASON_FLEET_HBM)
+                raise QueueFull(
+                    f"every replica reports HBM use >= "
+                    f"{cfg.admit_hbm_frac:.0%} — KV memory, not slots, "
+                    f"is the fleet bottleneck", reason=REASON_FLEET_HBM)
+
+    def _note_shed(self, reason: str) -> None:
+        self._shed[reason] = self._shed.get(reason, 0) + 1
+        self.m_shed.labels(reason).inc()
+        self._counts["shed"] += 1
+        self.m_requests.labels("shed").inc()
+
+    # -- the door queue (scale-from-zero) --------------------------------
+    def _door_depth_changed(self) -> None:
+        # on_activation runs UNDER the router lock (every depth change
+        # originates inside it): implementations must hand off — set an
+        # event, bump an atomic — never block on I/O here. The binary's
+        # annotation stamper is a separate thread for exactly this.
+        depth = len(self._door)
+        self._door_peak = max(self._door_peak, depth)
+        self.g_door.set(depth)
+        if self.on_activation is not None:
+            try:
+                self.on_activation(depth)
+            except Exception:   # noqa: BLE001 — the signal is advisory;
+                pass            # a failed stamp must never fail a request
+
+    def _wait_for_replica(self, deadline: Optional[float]) -> None:
+        """Park until some replica admits (FIFO ticket, bounded queue,
+        bounded wait). Caller holds the lock. Raises QueueFull /
+        DeadlineExceeded on shed — each with its one terminal
+        accounting."""
+        cfg = self.cfg
+        if len(self._door) >= cfg.max_door_queue:
+            self._note_shed(REASON_DOOR_QUEUE)
+            raise QueueFull(
+                f"gateway door queue full ({cfg.max_door_queue}) with "
+                f"no replica admitting", reason=REASON_DOOR_QUEUE)
+        ticket = self._next_ticket
+        self._next_ticket += 1
+        self._door.append(ticket)
+        self._door_depth_changed()
+        t0 = self.clock()
+        give_up = t0 + cfg.door_wait_s
+        if deadline is not None:
+            give_up = min(give_up, deadline)
+        try:
+            while not self._admitting():
+                now = self.clock()
+                if now >= give_up:
+                    if deadline is not None and now >= deadline:
+                        self._counts["deadline"] += 1
+                        self.m_requests.labels("deadline").inc()
+                        raise DeadlineExceeded(
+                            "request spent its deadline parked at the "
+                            "gateway door (no replica became ready)")
+                    self._note_shed(REASON_NO_REPLICAS)
+                    raise QueueFull(
+                        f"no replica became ready within "
+                        f"{cfg.door_wait_s:.0f}s", reason=REASON_NO_REPLICAS)
+                self._lock.wait(timeout=min(0.05, give_up - now))
+        finally:
+            self._door.remove(ticket)
+            self._door_depth_changed()
+            self.h_door_wait.observe(self.clock() - t0)
+
+    # -- dispatch --------------------------------------------------------
+    def _pick(self, key: Optional[str],
+              tried: Optional[set] = None) -> Optional[Replica]:
+        """One routing decision. ``tried`` excludes replicas that
+        already failed THIS request (the fixture router's discipline):
+        a dead-but-still-listed replica must not eat the whole retry
+        budget. When every admitting replica has been tried, the set
+        widens — transient sheds (429 under load, 503 mid-restart)
+        deserve a second lap."""
+        admitting = self._admitting()
+        if tried:
+            fresh = [n for n in admitting if n not in tried]
+            if fresh:
+                admitting = fresh
+            else:
+                tried.clear()       # widen: second lap over everyone
+        loads = {n: self._replicas[n].load() for n in admitting}
+        name, route = affinity_pick(key, self._ring, loads, admitting,
+                                    self.cfg.max_imbalance)
+        if name is None:
+            return None
+        self._routes[route] = self._routes.get(route, 0) + 1
+        self.m_route.labels(route).inc()
+        return self._replicas[name]
+
+    def _backoff_s(self, exc: Exception, attempt: int) -> float:
+        """Reason-aware: capacity sheds (429 queue_full/hbm) back off
+        exponentially — hammering a saturated fleet helps nobody;
+        deadline_unmeetable retries the NEXT replica immediately (the
+        estimate that shed it is replica-local); recovering/draining/
+        unreachable use a short flat delay (a different replica is
+        expected to answer now)."""
+        cfg = self.cfg
+        if isinstance(exc, QueueFull):
+            if exc.reason == "deadline_unmeetable":
+                return 0.0
+            d = min(cfg.backoff_max_s, cfg.backoff_s * (2 ** attempt))
+        else:
+            d = cfg.backoff_s
+        return d * (0.5 + self._rng.random())
+
+    def dispatch(self, prompt: List[int], max_new_tokens: int,
+                 deadline_s: Optional[float] = None, **sampling):
+        """Unary request through the fleet: returns ``(tokens,
+        replica_name, attempts)``. Exactly-once: resubmission happens
+        only after an attempt raised without delivering."""
+        cfg = self.cfg
+        t0 = self.clock()
+        deadline = t0 + deadline_s if deadline_s else None
+        key = prefix_key(prompt, cfg.block_size, cfg.affinity_blocks)
+        with tracing.span("gateway.request", component="gateway",
+                          attrs={"prompt_tokens": len(prompt),
+                                 "affinity_key": key or ""}) as sp:
+            tokens, name, attempts = self._dispatch(
+                prompt, max_new_tokens, deadline, key, sampling)
+            sp.set_attr("replica", name)
+            sp.set_attr("attempts", attempts)
+        return tokens, name, attempts
+
+    def _remaining(self, deadline: Optional[float]) -> Optional[float]:
+        if deadline is None:
+            return None
+        rem = deadline - self.clock()
+        if rem <= 0:
+            with self._lock:
+                self._counts["deadline"] += 1
+                self.m_requests.labels("deadline").inc()
+            raise DeadlineExceeded(
+                "request spent its deadline at the gateway (queueing + "
+                "retries consumed the budget before a replica delivered)")
+        return rem
+
+    def _dispatch(self, prompt, max_new_tokens, deadline, key, sampling):
+        if self.transport is None:
+            raise RuntimeError("router has no transport")
+        last: Optional[Exception] = None
+        tried: set = set()
+        for attempt in range(self.cfg.max_attempts):
+            rem = self._remaining(deadline)
+            with self._lock:
+                if not self._admitting():
+                    self._wait_for_replica(deadline)
+                self._admit()
+                rep = self._pick(key, tried)
+                if rep is None:
+                    continue
+                self._inflight_delta(rep.name, +1)
+            req = {"prompt": list(prompt),
+                   "max_new_tokens": max_new_tokens,
+                   "deadline_s": rem, "sampling": dict(sampling)}
+            try:
+                tokens = self.transport(rep, req)
+            except Infeasible:
+                with self._lock:
+                    self._counts["failed"] += 1
+                self.m_requests.labels("failed").inc()
+                raise
+            except DeadlineExceeded:
+                with self._lock:
+                    self._counts["deadline"] += 1
+                self.m_requests.labels("deadline").inc()
+                raise
+            except (QueueFull, ReplicaUnreachable, TimeoutError,
+                    RuntimeError) as e:
+                last = e
+                tried.add(rep.name)
+                with self._lock:
+                    self._retries += 1
+                self.m_retries.labels(self._retry_cause(e)).inc()
+                self.sleep(self._backoff_s(e, attempt))
+                continue
+            finally:
+                with self._lock:
+                    self._inflight_delta(rep.name, -1)
+            with self._lock:
+                self._counts["completed"] += 1
+            self.m_requests.labels("completed").inc()
+            return tokens, rep.name, attempt + 1
+        self._raise_exhausted(last)
+
+    @staticmethod
+    def _retry_cause(e: Exception) -> str:
+        return ("shed" if isinstance(e, QueueFull)
+                else "unreachable" if isinstance(e, ReplicaUnreachable)
+                else "recovering" if isinstance(e, EngineRecovering)
+                else "error")
+
+    def _raise_exhausted(self, last: Optional[Exception]):
+        """Retry budget spent: one terminal ``failed`` outcome. When
+        the LAST refusal was a capacity shed, re-raise it as QueueFull
+        (reason preserved) so the HTTP layer answers 429 + Retry-After —
+        pure fleet saturation must read as back-off-and-retry, never as
+        a 502 server fault."""
+        with self._lock:
+            self._counts["failed"] += 1
+        self.m_requests.labels("failed").inc()
+        if isinstance(last, QueueFull):
+            raise QueueFull(
+                f"shed by every replica across {self.cfg.max_attempts} "
+                f"attempts: {last}", reason=last.reason)
+        raise RuntimeError(
+            f"request failed after {self.cfg.max_attempts} attempts: "
+            f"{last}")
+
+    def stream(self, prompt: List[int], max_new_tokens: int,
+               deadline_s: Optional[float] = None, **sampling):
+        """Streaming passthrough: retries attempts like ``dispatch``
+        until the FIRST delta arrives, then yields deltas straight
+        through — a failure after first-byte propagates (tokens already
+        left the building; a transparent replay would double-deliver).
+        Returns a generator; closing it mid-stream closes the replica
+        stream (the serving loop accounts the cancel)."""
+        if self.stream_transport is None:
+            raise RuntimeError("router has no stream transport")
+        cfg = self.cfg
+        t0 = self.clock()
+        deadline = t0 + deadline_s if deadline_s else None
+        key = prefix_key(prompt, cfg.block_size, cfg.affinity_blocks)
+
+        def gen():
+            last: Optional[Exception] = None
+            tried: set = set()
+            for attempt in range(cfg.max_attempts):
+                rem = self._remaining(deadline)
+                with self._lock:
+                    if not self._admitting():
+                        self._wait_for_replica(deadline)
+                    self._admit()
+                    rep = self._pick(key, tried)
+                    if rep is None:
+                        continue
+                    self._inflight_delta(rep.name, +1)
+                req = {"prompt": list(prompt),
+                       "max_new_tokens": max_new_tokens,
+                       "deadline_s": rem, "sampling": dict(sampling)}
+                started = False
+                try:
+                    for delta in self.stream_transport(rep, req):
+                        started = True
+                        yield delta
+                    with self._lock:
+                        self._counts["completed"] += 1
+                    self.m_requests.labels("completed").inc()
+                    return
+                except Infeasible:
+                    with self._lock:
+                        self._counts["failed"] += 1
+                    self.m_requests.labels("failed").inc()
+                    raise
+                except DeadlineExceeded:
+                    with self._lock:
+                        self._counts["deadline"] += 1
+                    self.m_requests.labels("deadline").inc()
+                    raise
+                except (QueueFull, ReplicaUnreachable, TimeoutError,
+                        RuntimeError) as e:
+                    if started:
+                        # first byte is out: exactly-once forbids replay
+                        with self._lock:
+                            self._counts["failed"] += 1
+                        self.m_requests.labels("failed").inc()
+                        raise
+                    last = e
+                    tried.add(rep.name)
+                    with self._lock:
+                        self._retries += 1
+                    self.m_retries.labels(self._retry_cause(e)).inc()
+                    self.sleep(self._backoff_s(e, attempt))
+                    continue
+                finally:
+                    with self._lock:
+                        self._inflight_delta(rep.name, -1)
+            self._raise_exhausted(last)
+
+        return gen()
+
+    # -- introspection ---------------------------------------------------
+    def stats(self) -> dict:
+        """The gateway's /stats snapshot; the fleet controller's
+        ``gateway_source`` reads ``door_queue`` as the scale-from-zero
+        pressure signal."""
+        with self._lock:
+            admitting = set(self._admitting())
+            return {
+                "door_queue": len(self._door),
+                "door_queue_peak": self._door_peak,
+                "replicas": {
+                    name: {
+                        "ready": r.ready and not r.draining,
+                        "draining": r.draining,
+                        "inflight": r.inflight,
+                        "load": r.load(),
+                    } for name, r in sorted(self._replicas.items())
+                },
+                "ready_replicas": len(admitting),
+                "requests": dict(self._counts),
+                "shed": dict(self._shed),
+                "routes": dict(self._routes),
+                "retries": self._retries,
+                "ring": {"replicas": self._ring.nodes(),
+                         "vnodes": self._ring.vnodes},
+                "config": {
+                    "block_size": self.cfg.block_size,
+                    "affinity_blocks": self.cfg.affinity_blocks,
+                    "max_imbalance": self.cfg.max_imbalance,
+                    "admit_pending_per_replica":
+                        self.cfg.admit_pending_per_replica,
+                    "admit_hbm_frac": self.cfg.admit_hbm_frac,
+                    "max_door_queue": self.cfg.max_door_queue,
+                },
+            }

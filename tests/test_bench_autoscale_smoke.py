@@ -56,6 +56,42 @@ def test_bench_autoscale_smoke_invariants_and_reproducibility():
         assert f["completed"] == f["submitted"]
         assert f["in_system"] == 0
 
+    # -- routed mode (ISSUE 11): prefix-affinity must measurably beat
+    # random AND least-loaded on fleet-wide prefix-hit rate and TTFT --
+    routed = artifact["routed"]
+    assert set(routed["policies"]) == {"random", "least_loaded",
+                                       "prefix_affinity"}
+    for name, pol in routed["policies"].items():
+        assert pol["conservation_ok"] is True, name
+        assert pol["completed"] == pol["submitted"] > 0, name
+    aff = routed["policies"]["prefix_affinity"]
+    for name in ("random", "least_loaded"):
+        other = routed["policies"][name]
+        assert aff["prefix_hit_rate"] > other["prefix_hit_rate"], name
+        assert aff["ttft_mean_s"] < other["ttft_mean_s"], name
+        assert aff["ttft_p50_s"] < other["ttft_p50_s"], name
+        assert aff["ttft_p99_s"] <= other["ttft_p99_s"], name
+    assert routed["affinity_beats_all_on_hit_rate"] is True
+    assert routed["affinity_beats_all_on_ttft"] is True
+    assert aff["routes"].get("affinity", 0) > 0
+
+    # -- scale-from-zero (ISSUE 11): a min_replicas=0 fleet scaled to
+    # zero serves a cold burst losslessly through the REAL gateway ----
+    sfz = artifact["scale_from_zero"]
+    assert sfz["scaled_to_zero"] is True
+    assert sfz["warm_completed"] > 0 and sfz["warm_errors"] == []
+    assert sfz["burst_completed"] == sfz["burst_submitted"] > 0
+    assert sfz["burst_errors"] == [] and sfz["stuck_requests"] == 0
+    # the whole burst parked at the door, the controller SAW it as
+    # pressure (the activator satellite), and replicas were started
+    assert sfz["door_queue_peak"] == sfz["burst_submitted"]
+    assert sfz["gateway_queued_seen_by_controller"] \
+        == sfz["burst_submitted"]
+    assert sfz["activation_replicas"] >= 1
+    # conservation + bit-exactness vs a never-scaled-down fleet
+    assert sfz["conservation_ok"] is True
+    assert sfz["bit_exact_vs_never_scaled"] is True
+
     static, peak, auto = (fleets["static"], fleets["static_peak"],
                           fleets["autoscaled"])
     # the fleet actually scaled (traffic moved it both ways)

@@ -54,7 +54,8 @@ def test_templates_exist_for_every_component():
                  "tpu-partitioner/deployment_tpu-partitioner",
                  "tpu-partitioner/configmap_known-tpu-topologies",
                  "tpuagent/daemonset_tpuagent", "pod_metrics-exporter",
-                 "fleet/deployment_fleet", "fleet/rbac_fleet"):
+                 "fleet/deployment_fleet", "fleet/rbac_fleet",
+                 "gateway/deployment_gateway", "gateway/rbac_gateway"):
         assert frag in joined, f"missing template {frag}"
 
 
@@ -484,3 +485,60 @@ def test_fleet_deployment_passes_policy_and_quota_args():
         "upCooldownSeconds": 30, "downCooldownSeconds": 120,
         "maxStepUp": 2, "maxStepDown": 1,
     }
+    # the activator wire: --gateway-url renders only when the value is
+    # set (empty default falls back to the ConfigMap annotation), and
+    # the fleet may read the gateway's ConfigMap
+    assert "--gateway-url={{ .Values.fleet.gatewayUrl }}" in text
+    assert "if .Values.fleet.gatewayUrl" in text
+    assert values["fleet"]["gatewayUrl"] == ""
+    assert "configmaps" in rbac_text
+
+
+def test_gateway_deployment_passes_routing_and_door_args():
+    """The gateway Deployment template (ISSUE 11 satellite) must plumb
+    the fleet identity, affinity/admission/door/retry knobs to
+    nos-tpu-gateway flags, ship a Service in front, and default
+    disabled like the fleet controller it pairs with."""
+    path = os.path.join(CHART, "templates", "gateway",
+                        "deployment_gateway.yaml")
+    with open(path) as f:
+        text = f.read()
+    for flag, value in [
+        ("--fleet", ".Values.gateway.fleetName"),
+        ("--port", ".Values.gateway.port"),
+        ("--replica-url-template", ".Values.gateway.replicaUrlTemplate"),
+        ("--discovery-interval",
+         ".Values.gateway.discoveryIntervalSeconds"),
+        ("--block-size", ".Values.gateway.affinity.blockSize"),
+        ("--affinity-blocks", ".Values.gateway.affinity.blocks"),
+        ("--max-imbalance", ".Values.gateway.affinity.maxImbalance"),
+        ("--admit-pending-per-replica",
+         ".Values.gateway.admission.pendingPerReplica"),
+        ("--admit-hbm-frac", ".Values.gateway.admission.hbmFrac"),
+        ("--max-door-queue", ".Values.gateway.door.maxQueue"),
+        ("--door-wait", ".Values.gateway.door.waitSeconds"),
+        ("--retry-attempts", ".Values.gateway.retry.attempts"),
+        ("--retry-backoff", ".Values.gateway.retry.backoffSeconds"),
+    ]:
+        assert flag in text, f"gateway deployment missing {flag}"
+        assert value in text, f"gateway deployment missing {value}"
+    # clients dial the gateway Service, not replica pods
+    assert "kind: Service" in text
+    rbac = os.path.join(CHART, "templates", "gateway",
+                        "rbac_gateway.yaml")
+    with open(rbac) as f:
+        rbac_text = f.read()
+    assert "pods" in rbac_text and "configmaps" in rbac_text
+    with open(os.path.join(CHART, "values.yaml")) as f:
+        values = yaml.safe_load(f)
+    gw = values["gateway"]
+    assert gw["enabled"] is False
+    assert gw["fleetName"] == values["fleet"]["fleetName"]
+    assert gw["replicaUrlTemplate"] == values["fleet"]["replicaUrlTemplate"]
+    # chart defaults must match the binary's flag defaults
+    assert gw["port"] == 8080
+    assert gw["affinity"] == {"blockSize": 16, "blocks": 4,
+                              "maxImbalance": 4}
+    assert gw["admission"] == {"pendingPerReplica": 0, "hbmFrac": 0}
+    assert gw["door"] == {"maxQueue": 256, "waitSeconds": 30}
+    assert gw["retry"] == {"attempts": 12, "backoffSeconds": 0.05}

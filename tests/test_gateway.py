@@ -1,0 +1,968 @@
+"""Fleet front door (ISSUE 11): the prefix-affinity gateway's routing
+kernel, exactly-once retry semantics over REAL ServingLoops (the PR 7
+StubEngine/FaultInjector harness), global admission, deadline
+propagation, and the scale-from-zero door queue + activator loop
+through the real FleetController. All jax-free."""
+import threading
+import time
+import urllib.request
+
+import pytest
+from test_serving_chaos import (
+    StubEngine, expected_tokens, outcome_delta, outcome_totals,
+)
+
+from nos_tpu import constants
+from nos_tpu.cmd.server import ServingLoop
+from nos_tpu.fleet import FleetConfig, FleetController, PolicyConfig
+from nos_tpu.fleet.sim import SimFleet
+from nos_tpu.gateway import (
+    GatewayRouter, HashRing, PodDiscovery, Replica, ReplicaUnreachable,
+    RouterConfig, affinity_pick, prefix_key,
+)
+from nos_tpu.kube import ApiServer
+from nos_tpu.kube.client import Client
+from nos_tpu.kube.objects import (
+    ConfigMap, Container, ObjectMeta, Pod, PodSpec, PodStatus,
+)
+from nos_tpu.models.errors import (
+    DeadlineExceeded, EngineRecovering, QueueFull,
+)
+from nos_tpu.models.supervision import FaultInjector
+
+
+# ---------------------------------------------------------------------------
+# prefix_key: the block-chain arithmetic shared with kvblocks
+# ---------------------------------------------------------------------------
+def test_prefix_key_block_arithmetic_matches_prefix_index():
+    bs = 16
+    sys_prompt = list(range(100, 100 + 3 * bs))    # 3 full blocks
+    # same leading full blocks -> same key, whatever the tail
+    a = prefix_key(sys_prompt + [1, 2, 3], bs, affinity_blocks=4)
+    b = prefix_key(sys_prompt + [9] * 40, bs, affinity_blocks=4)
+    assert a is not None
+    # with affinity_blocks=4 and only 3 shared full blocks, the longer
+    # prompt keys its 4th block too — prompts diverging after the
+    # shared prefix scatter unless the cap sits at/below it
+    assert a != b
+    a3 = prefix_key(sys_prompt + [1, 2, 3], bs, affinity_blocks=3)
+    b3 = prefix_key(sys_prompt + [9] * 40, bs, affinity_blocks=3)
+    assert a3 == b3 is not None
+    # no full block -> no key (nothing shareable to colocate); the same
+    # ``len(prompt) // block_size`` rule PrefixBlockIndex publishes by
+    assert prefix_key(list(range(bs - 1)), bs) is None
+    assert prefix_key([], bs) is None
+    # divergence INSIDE the keyed depth -> different keys
+    other = list(sys_prompt)
+    other[5] += 1
+    assert prefix_key(other, bs, 3) != a3
+    with pytest.raises(ValueError):
+        prefix_key([1, 2, 3], 0)
+
+
+# ---------------------------------------------------------------------------
+# ring stability
+# ---------------------------------------------------------------------------
+def _owners(ring, keys):
+    return {k: ring.lookup(k)[0] for k in keys}
+
+
+def test_ring_stability_under_add_drain_death():
+    ring = HashRing()
+    for n in ("r1", "r2", "r3"):
+        ring.add(n)
+    keys = [prefix_key(list(range(i, i + 64)), 16) for i in range(300)]
+    base = _owners(ring, keys)
+
+    # ADD: only ~1/N of the key space moves, the rest stay home
+    ring.add("r4")
+    after_add = _owners(ring, keys)
+    moved = sum(1 for k in keys if base[k] != after_add[k])
+    assert 0 < moved < len(keys) / 2
+    # every moved key moved TO the new replica, never shuffled between
+    # survivors (the consistent-hashing contract)
+    assert all(after_add[k] == "r4" for k in keys
+               if base[k] != after_add[k])
+
+    # DEATH/DRAIN (remove): the removed replica's keys redistribute,
+    # everyone else's stay put
+    ring.remove("r4")
+    assert _owners(ring, keys) == base
+    ring.remove("r2")
+    after_rm = _owners(ring, keys)
+    assert all(after_rm[k] == base[k] for k in keys
+               if base[k] != "r2")
+    assert all(after_rm[k] in ("r1", "r3") for k in keys)
+
+    # membership is restorable bit-identically (ring points derive from
+    # the name): a replica bouncing not-ready -> ready re-owns exactly
+    # its old keys
+    ring.add("r2")
+    assert _owners(ring, keys) == base
+
+
+def test_ring_sync_and_lookup_order():
+    ring = HashRing(vnodes=16)
+    ring.sync(["a", "b", "c"])
+    assert ring.nodes() == ["a", "b", "c"]
+    key = prefix_key(list(range(64)), 16)
+    order = ring.lookup(key)
+    assert sorted(order) == ["a", "b", "c"]      # all distinct
+    assert ring.lookup(key, n=2) == order[:2]
+    ring.sync(["a"])
+    assert ring.lookup(key) == ["a"]
+    ring.sync([])
+    assert ring.lookup(key) == []
+
+
+def test_affinity_pick_bounded_imbalance():
+    ring = HashRing()
+    ring.sync(["a", "b", "c"])
+    key = prefix_key(list(range(64)), 16)
+    owner = ring.lookup(key)[0]
+    others = [n for n in ("a", "b", "c") if n != owner]
+    even = {n: 1.0 for n in ("a", "b", "c")}
+
+    got, route = affinity_pick(key, ring, even, ["a", "b", "c"], 4.0)
+    assert (got, route) == (owner, "affinity")
+    # owner overloaded beyond the bound: locality yields to balance
+    loads = dict(even)
+    loads[owner] = 10.0
+    # the next ring candidate within bound keeps partial affinity
+    got2, route2 = affinity_pick(key, ring, loads, ["a", "b", "c"], 4.0)
+    assert got2 == ring.lookup(key)[1] and route2 == "affinity"
+    # ALL ring candidates overloaded -> least-loaded fallback
+    loads = {n: 10.0 for n in ("a", "b", "c")}
+    loads[others[0]] = 1.0
+    got3, route3 = affinity_pick(key, ring, loads, ["a", "b", "c"], 4.0)
+    assert route3 in ("affinity", "fallback")
+    assert got3 == others[0] or loads[got3] <= loads[others[0]] + 4.0
+    # no key -> least-loaded
+    got4, route4 = affinity_pick(None, ring, loads, ["a", "b", "c"], 4.0)
+    assert (got4, route4) == (others[0], "no_key")
+    # nobody admitting
+    assert affinity_pick(key, ring, {}, [], 4.0) == (None, "no_replicas")
+
+
+# ---------------------------------------------------------------------------
+# router over real ServingLoops
+# ---------------------------------------------------------------------------
+def loop_transport(rep: Replica, req: dict):
+    loop = rep.handle
+    if loop is None:
+        raise ReplicaUnreachable(f"{rep.name} has no loop")
+    return loop.generate(req["prompt"], req["max_new_tokens"],
+                         timeout=60, deadline_s=req.get("deadline_s"))
+
+
+def loop_stream_transport(rep: Replica, req: dict):
+    loop = rep.handle
+    if loop is None:
+        raise ReplicaUnreachable(f"{rep.name} has no loop")
+    return loop.stream(req["prompt"], req["max_new_tokens"],
+                       timeout=60, deadline_s=req.get("deadline_s"))
+
+
+class LoopRefresher:
+    """The discovery loop's role for in-process tests: polls the
+    ServingLoops' own health/drain state into the router table."""
+
+    def __init__(self, router, loops, interval_s=0.005):
+        self.router = router
+        self.loops = loops          # name -> ServingLoop (mutable)
+        self.interval_s = interval_s
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._run, daemon=True)
+
+    def refresh_once(self):
+        self.router.update([
+            Replica(name=name, handle=lp,
+                    ready=(lp.healthy and not lp.draining
+                           and not lp.recovering),
+                    draining=lp.draining, stats=lp.stats())
+            for name, lp in sorted(self.loops.items())])
+
+    def _run(self):
+        while not self._stop.is_set():
+            try:
+                self.refresh_once()
+            except Exception:   # noqa: BLE001 — keep last view
+                pass
+            self._stop.wait(self.interval_s)
+
+    def __enter__(self):
+        self.refresh_once()
+        self._thread.start()
+        return self
+
+    def __exit__(self, *exc):
+        self._stop.set()
+        self._thread.join(timeout=5)
+
+
+def run_gateway_trace(router, n_requests, new_tokens):
+    results, errors = {}, {}
+
+    def worker(i):
+        try:
+            toks, replica, attempts = router.dispatch(
+                [100 + i], new_tokens)
+            results[i] = (toks, replica, attempts)
+        except Exception as e:      # noqa: BLE001 — asserted by callers
+            errors[i] = e
+
+    threads = [threading.Thread(target=worker, args=(i,))
+               for i in range(n_requests)]
+    for t in threads:
+        t.start()
+    return threads, results, errors
+
+
+def join_all(threads, timeout=60):
+    for t in threads:
+        t.join(timeout)
+    assert not any(t.is_alive() for t in threads), "stuck request"
+
+
+def test_gateway_exactly_once_under_drain_restart_and_kill():
+    """The productionized chaos router (ISSUE 11 tentpole): one replica
+    drains mid-trace, one rides a supervised restart (injected step
+    errors -> 503s), one is KILLED outright — every request completes
+    exactly once with exact tokens, fleet-wide outcome conservation
+    holds (finished == N, no double-finish)."""
+    before = outcome_totals()
+    inj = FaultInjector(schedule={5: "error", 13: "error"})
+    loops = {
+        "r0": ServingLoop(StubEngine(tokens_per_tick=2)),
+        "r1": ServingLoop(inj.wrap(StubEngine(tokens_per_tick=2)),
+                          engine_factory=lambda: inj.wrap(
+                              StubEngine(tokens_per_tick=2)),
+                          restart_budget=4, restart_backoff_s=0.01),
+        "r2": ServingLoop(StubEngine(tokens_per_tick=2)),
+        "r3": ServingLoop(StubEngine(tokens_per_tick=2)),
+    }
+    router = GatewayRouter(
+        RouterConfig(max_attempts=20, backoff_s=0.005,
+                     backoff_max_s=0.05),
+        transport=loop_transport)
+    try:
+        with LoopRefresher(router, loops):
+            threads, results, errors = run_gateway_trace(
+                router, n_requests=18, new_tokens=120)
+            time.sleep(0.01)        # work is mid-flight everywhere
+            loops["r0"].begin_drain()
+            time.sleep(0.01)
+            loops["r3"].shutdown()  # death: displaced work requeues
+            join_all(threads)
+        assert errors == {}
+        assert len(results) == 18
+        for i, (toks, _rep, _att) in results.items():
+            assert toks == expected_tokens([100 + i], 120), f"req {i}"
+        delta = outcome_delta(before)
+        assert delta["finished"] == 18
+        # gateway-side ledger: every request earned exactly one outcome
+        snap = router.stats()
+        assert snap["requests"]["completed"] == 18
+        assert snap["requests"]["failed"] == 0
+    finally:
+        for lp in loops.values():
+            lp.shutdown()
+
+
+def test_gateway_affinity_routes_shared_prefixes_to_one_replica():
+    """Requests sharing a leading block-chain land on ONE replica (its
+    PrefixBlockIndex would hold the blocks); distinct prefixes spread
+    across the ring."""
+    bs = 16
+    loops = {f"r{i}": ServingLoop(StubEngine(tokens_per_tick=8))
+             for i in range(4)}
+    router = GatewayRouter(
+        RouterConfig(block_size=bs, affinity_blocks=2,
+                     max_imbalance=50.0),
+        transport=loop_transport)
+    try:
+        with LoopRefresher(router, loops):
+            prefixes = [[1000 + 7 * p + j for j in range(2 * bs)]
+                        for p in range(8)]
+            homes = {}
+            for p, pref in enumerate(prefixes):
+                reps = set()
+                for i in range(4):
+                    toks, rep, _ = router.dispatch(pref + [p, i], 4)
+                    assert toks[:len(pref)] == pref
+                    reps.add(rep)
+                homes[p] = reps
+            # every prefix has exactly one home while the fleet is
+            # stable and imbalance never binds
+            assert all(len(r) == 1 for r in homes.values())
+            # and the keys spread over more than one replica
+            assert len({next(iter(r)) for r in homes.values()}) > 1
+            assert router.stats()["routes"].get("affinity", 0) == 32
+    finally:
+        for lp in loops.values():
+            lp.shutdown()
+
+
+def test_gateway_streaming_passthrough_and_preflight_retry():
+    """Streaming: deltas concatenate to the exact unary tokens; a
+    draining replica shed BEFORE the first byte retries elsewhere."""
+    loops = {"r0": ServingLoop(StubEngine(tokens_per_tick=3)),
+             "r1": ServingLoop(StubEngine(tokens_per_tick=3))}
+    router = GatewayRouter(
+        RouterConfig(max_attempts=8, backoff_s=0.002),
+        transport=loop_transport, stream_transport=loop_stream_transport)
+    try:
+        with LoopRefresher(router, loops) as ref:
+            out = []
+            for delta in router.stream([7], 30):
+                out.extend(delta)
+            assert out == list(range(1, 31))
+            # drain one replica and pin stale-table retry: the router's
+            # view still says ready, the loop sheds, the stream retries
+            # on the survivor before any byte is out
+            loops["r0"].begin_drain()
+            loops["r1"].begin_drain()
+            ref.refresh_once()
+            # both draining: no admitting replica -> door queue; undrain
+            # r1 in the background to flush
+            def undrain():
+                time.sleep(0.05)
+                loops["r1"].cancel_drain()
+            threading.Thread(target=undrain, daemon=True).start()
+            out2 = []
+            for delta in router.stream([9], 12):
+                out2.extend(delta)
+            assert out2 == list(range(1, 13))
+    finally:
+        for lp in loops.values():
+            lp.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# deadline propagation
+# ---------------------------------------------------------------------------
+def test_deadline_budget_shrinks_across_queueing_and_retries():
+    """The replica receives the REMAINING budget, not the original:
+    time burned by a shed+backoff comes out of what is forwarded (the
+    X-Request-Deadline-S discipline, transport-agnostic)."""
+    class FakeClock:
+        def __init__(self):
+            self.t = 100.0
+
+        def __call__(self):
+            return self.t
+
+    clock = FakeClock()
+    seen = []
+    fail_first = {"n": 1}
+
+    def transport(rep, req):
+        clock.t += 0.5              # the attempt itself takes time
+        if fail_first["n"]:
+            fail_first["n"] -= 1
+            raise QueueFull("busy")
+        seen.append(req["deadline_s"])
+        return req["prompt"]
+
+    router = GatewayRouter(
+        RouterConfig(max_attempts=4, backoff_s=0.0),
+        transport=transport, clock=clock,
+        sleep=lambda s: setattr(clock, "t", clock.t + s))
+    router.update([Replica(name="a", handle=None),
+                   Replica(name="b", handle=None)])
+    toks, _, attempts = router.dispatch([1, 2, 3], 1, deadline_s=10.0)
+    assert attempts == 2
+    assert len(seen) == 1
+    # first attempt consumed 0.5s: the retry forwards < 10 - 0.5
+    assert seen[0] <= 9.5
+    assert seen[0] > 8.0
+
+    # a budget fully spent at the gateway sheds WITHOUT reaching a
+    # replica, as DeadlineExceeded
+    def slow_transport(rep, req):
+        clock.t += 6.0
+        raise QueueFull("busy")
+
+    router2 = GatewayRouter(
+        RouterConfig(max_attempts=4, backoff_s=0.0),
+        transport=slow_transport, clock=clock,
+        sleep=lambda s: setattr(clock, "t", clock.t + s))
+    router2.update([Replica(name="a", handle=None),
+                    Replica(name="b", handle=None)])
+    with pytest.raises(DeadlineExceeded):
+        router2.dispatch([1], 1, deadline_s=10.0)
+    assert router2.stats()["requests"]["deadline"] == 1
+
+
+def test_http_transport_sets_deadline_header():
+    from nos_tpu.cmd.gateway import HttpReplicaTransport
+
+    tr = HttpReplicaTransport()
+    req, timeout = tr._request(
+        Replica(name="r", handle="http://10.0.0.1:8000"),
+        {"prompt": [1], "max_new_tokens": 4, "deadline_s": 3.25,
+         "sampling": {"temperature": 0.5}}, stream=False)
+    assert req.get_header("X-request-deadline-s") == "3.250"
+    assert timeout <= 3.25 + 5.0
+    import json as _json
+    body = _json.loads(req.data)
+    assert body["temperature"] == 0.5 and body["prompt"] == [1]
+    with pytest.raises(ReplicaUnreachable):
+        tr._request(Replica(name="r", handle=None),
+                    {"prompt": [1], "max_new_tokens": 1,
+                     "sampling": {}}, stream=False)
+
+
+def test_deadline_expires_while_parked_at_the_door():
+    router = GatewayRouter(
+        RouterConfig(door_wait_s=30.0),
+        transport=lambda rep, req: req["prompt"])
+    t0 = time.monotonic()
+    with pytest.raises(DeadlineExceeded):
+        router.dispatch([1], 1, deadline_s=0.15)
+    assert time.monotonic() - t0 < 5.0
+    assert router.stats()["requests"]["deadline"] == 1
+
+
+def test_inflight_survives_discovery_refresh_mid_request():
+    """Discovery replaces the Replica objects wholesale every poll; a
+    request in flight across a refresh must still settle the live
+    table's in-flight count back to zero (regression: the decrement
+    used to land on the stale pre-refresh object, creeping load() up
+    forever and eventually shedding an idle fleet)."""
+    release = threading.Event()
+    entered = threading.Event()
+
+    def transport(rep, req):
+        entered.set()
+        release.wait(10)
+        return req["prompt"]
+
+    router = GatewayRouter(RouterConfig(), transport=transport)
+    router.update([Replica(name="a", handle=None)])
+    t = threading.Thread(
+        target=lambda: router.dispatch([1, 2], 1), daemon=True)
+    t.start()
+    assert entered.wait(5)
+    assert router.stats()["replicas"]["a"]["inflight"] == 1
+    # discovery refresh races the in-flight request
+    router.update([Replica(name="a", handle=None)])
+    assert router.stats()["replicas"]["a"]["inflight"] == 1
+    release.set()
+    t.join(10)
+    assert router.stats()["replicas"]["a"]["inflight"] == 0
+    # a replica that left mid-flight prunes once settled
+    assert "a" in router._inflight
+    router.update([])
+    router.update([Replica(name="a", handle=None)])
+    assert router.stats()["replicas"]["a"]["inflight"] == 0
+
+
+def test_retry_exhaustion_preserves_capacity_shed_wire_shape():
+    """All attempts shed 429: the router must re-raise QueueFull with
+    the last reason so the HTTP layer answers 429 + Retry-After, not a
+    502 server fault (regression: a bare RuntimeError used to take the
+    generic arm)."""
+    def transport(rep, req):
+        raise QueueFull("pool dry", reason="hbm_admission")
+
+    router = GatewayRouter(
+        RouterConfig(max_attempts=3, backoff_s=0.0),
+        transport=transport, sleep=lambda s: None)
+    router.update([Replica(name="a", handle=None),
+                   Replica(name="b", handle=None)])
+    with pytest.raises(QueueFull) as e:
+        router.dispatch([1], 1)
+    assert e.value.reason == "hbm_admission"
+    assert router.stats()["requests"]["failed"] == 1
+    # non-capacity exhaustion still reads as a failure
+    def dead(rep, req):
+        raise ReplicaUnreachable("gone")
+
+    router2 = GatewayRouter(
+        RouterConfig(max_attempts=2, backoff_s=0.0),
+        transport=dead, sleep=lambda s: None)
+    router2.update([Replica(name="a", handle=None)])
+    with pytest.raises(RuntimeError) as e:
+        router2.dispatch([1], 1)
+    assert not isinstance(e.value, QueueFull)
+
+
+def test_gateway_http_stream_shed_is_json_429_not_sse_200():
+    """A streaming request shed at the door must answer the same JSON
+    429 the unary path answers (regression: the lazy stream generator
+    used to let do_POST commit a 200 before the shed surfaced)."""
+    import json as _json
+
+    from nos_tpu.cmd.gateway import make_http_server as make_gw_server
+
+    router = GatewayRouter(
+        RouterConfig(max_door_queue=4, door_wait_s=0.05),
+        transport=lambda rep, req: req["prompt"],
+        stream_transport=lambda rep, req: iter([req["prompt"]]))
+    gw_httpd = make_gw_server(router, 0, "web")
+    threading.Thread(target=gw_httpd.serve_forever, daemon=True).start()
+    gw = f"http://127.0.0.1:{gw_httpd.server_address[1]}"
+    try:
+        req = urllib.request.Request(
+            gw + "/v1/generate",
+            data=_json.dumps({"prompt": [1], "max_new_tokens": 2,
+                              "stream": True}).encode(),
+            headers={"Content-Type": "application/json"}, method="POST")
+        with pytest.raises(urllib.error.HTTPError) as e:
+            urllib.request.urlopen(req, timeout=10)
+        assert e.value.code == 429
+        body = _json.loads(e.value.read())
+        assert body["reason"] == "no_ready_replicas"
+        assert e.value.headers.get("Retry-After") == "1"
+    finally:
+        gw_httpd.shutdown()
+
+
+def test_controller_gateway_source_outage_falls_back_to_configmap():
+    """gateway_source wired but unreachable: the durable ConfigMap
+    annotation must still activate a scaled-to-zero fleet (regression:
+    an unreachable source used to read as zero pressure and strand the
+    queued burst)."""
+    server = ApiServer()
+    client = Client(server)
+    server.create(ConfigMap(
+        metadata=ObjectMeta(
+            name="nos-tpu-gateway-web", namespace="serve",
+            annotations={constants.ANNOTATION_GATEWAY_QUEUED: "7"}),
+        data={}))
+
+    def broken_source():
+        raise OSError("gateway unreachable")
+
+    ctl = FleetController(
+        FleetConfig(name="web", namespace="serve",
+                    policy=PolicyConfig(min_replicas=0, max_replicas=4,
+                                        max_step_up=2)),
+        gateway_source=broken_source, clock=lambda: 1000.0)
+
+    class _NullSpan:
+        recording = False
+
+        def set_attr(self, *a, **k):
+            pass
+
+    ctl._reconcile(client, _NullSpan())
+    assert ctl.stats()["signals"]["gateway_queued"] == 7
+    assert ctl.stats()["decision"]["reason"] == "activation"
+    assert len(client.list("Pod", namespace="serve")) == 2
+
+
+# ---------------------------------------------------------------------------
+# global admission
+# ---------------------------------------------------------------------------
+def test_global_admission_sheds_on_fleet_pending_and_hbm():
+    router = GatewayRouter(
+        RouterConfig(admit_pending_per_replica=2.0),
+        transport=lambda rep, req: req["prompt"])
+    router.update([
+        Replica(name="a", stats={"pending": {"depth": 5},
+                                 "active_slots": 2}),
+        Replica(name="b", stats={"pending": {"depth": 4},
+                                 "active_slots": 1}),
+    ])
+    with pytest.raises(QueueFull) as e:
+        router.dispatch([1], 1)
+    assert e.value.reason == "fleet_queue_full"
+    assert router.stats()["shed"] == {"fleet_queue_full": 1}
+
+    hbm_router = GatewayRouter(
+        RouterConfig(admit_hbm_frac=0.9),
+        transport=lambda rep, req: req["prompt"])
+    hbm_router.update([
+        Replica(name="a", stats={"kv": {"hbm": {"in_use": 95,
+                                                "limit": 100}}}),
+        Replica(name="b", stats={"kv": {"hbm": {"in_use": 99,
+                                                "limit": 100}}}),
+    ])
+    with pytest.raises(QueueFull) as e:
+        hbm_router.dispatch([1], 1)
+    assert e.value.reason == "fleet_hbm_admission"
+    # ONE replica under the bar is enough to admit (the pick spreads)
+    hbm_router.update([
+        Replica(name="a", stats={"kv": {"hbm": {"in_use": 10,
+                                                "limit": 100}}}),
+        Replica(name="b", stats={"kv": {"hbm": {"in_use": 99,
+                                                "limit": 100}}}),
+    ])
+    toks, _, _ = hbm_router.dispatch([1], 1)
+    assert toks == [1]
+
+
+# ---------------------------------------------------------------------------
+# scale-from-zero: door queue + flush + the activator loop
+# ---------------------------------------------------------------------------
+def test_door_queue_parks_and_flushes_on_first_ready():
+    """With no admitting replica, requests park FIFO at the door and
+    the activation signal fires; the first ready replica flushes the
+    queue and every parked request completes."""
+    signals = []
+    loops = {}
+    router = GatewayRouter(
+        RouterConfig(door_wait_s=30.0, max_attempts=8,
+                     backoff_s=0.002),
+        transport=loop_transport, on_activation=signals.append)
+    try:
+        threads, results, errors = run_gateway_trace(
+            router, n_requests=6, new_tokens=20)
+        deadline = time.monotonic() + 10
+        while (router.stats()["door_queue"] < 6
+               and time.monotonic() < deadline):
+            time.sleep(0.002)
+        snap = router.stats()
+        assert snap["door_queue"] == 6
+        assert snap["door_queue_peak"] == 6
+        assert max(signals) == 6        # the activation signal fired
+        # first replica turns ready -> flush
+        loops["r0"] = ServingLoop(StubEngine(tokens_per_tick=4))
+        with LoopRefresher(router, loops):
+            join_all(threads)
+        assert errors == {}
+        assert len(results) == 6
+        for i, (toks, rep, _) in results.items():
+            assert toks == expected_tokens([100 + i], 20)
+            assert rep == "r0"
+        assert router.stats()["door_queue"] == 0
+        assert 0 in signals             # and cleared back to zero
+    finally:
+        for lp in loops.values():
+            lp.shutdown()
+
+
+def test_door_queue_bounds_and_no_ready_shed_reasons():
+    router = GatewayRouter(
+        RouterConfig(max_door_queue=0, door_wait_s=0.05),
+        transport=lambda rep, req: req["prompt"])
+    with pytest.raises(QueueFull) as e:
+        router.dispatch([1], 1)
+    assert e.value.reason == "door_queue_full"
+
+    router2 = GatewayRouter(
+        RouterConfig(max_door_queue=4, door_wait_s=0.05),
+        transport=lambda rep, req: req["prompt"])
+    with pytest.raises(QueueFull) as e:
+        router2.dispatch([1], 1)
+    assert e.value.reason == "no_ready_replicas"
+    shed = router2.stats()["shed"]
+    assert shed == {"no_ready_replicas": 1}
+
+
+def _fleet_pod(name, fleet, namespace, phase="Running"):
+    return Pod(
+        metadata=ObjectMeta(name=name, namespace=namespace,
+                            labels={constants.LABEL_FLEET: fleet}),
+        spec=PodSpec(containers=[Container(
+            requests={constants.RESOURCE_TPU: 4.0})]),
+        status=PodStatus(phase=phase, pod_ip="10.0.0.9"))
+
+
+def test_controller_treats_gateway_queue_as_pressure_at_zero():
+    """THE activator satellite: a min_replicas=0 fleet with ZERO pods
+    registers gateway door-queue pressure and starts replicas — via
+    the injected gateway_source AND via the ConfigMap annotation
+    fallback. Without a signal it stays asleep (no 0->1->0 flap)."""
+    def reconcile_once(gateway_source=None, stamp_annotation=None):
+        server = ApiServer()
+        client = Client(server)
+        if stamp_annotation is not None:
+            server.create(ConfigMap(
+                metadata=ObjectMeta(
+                    name="nos-tpu-gateway-web", namespace="serve",
+                    annotations={constants.ANNOTATION_GATEWAY_QUEUED:
+                                 str(stamp_annotation)}),
+                data={}))
+        ctl = FleetController(
+            FleetConfig(name="web", namespace="serve",
+                        policy=PolicyConfig(min_replicas=0,
+                                            max_replicas=4,
+                                            max_step_up=2)),
+            gateway_source=gateway_source, clock=lambda: 1000.0)
+        ctl._reconcile(client, _NullSpan())
+        pods = client.list("Pod", namespace="serve")
+        return ctl, pods
+
+    class _NullSpan:
+        recording = False
+
+        def set_attr(self, *a, **k):
+            pass
+
+    # no gateway signal: a scaled-to-zero fleet stays asleep
+    ctl, pods = reconcile_once()
+    assert pods == []
+    assert ctl.stats()["signals"]["gateway_queued"] == 0
+
+    # injected gateway_source: door queue -> activation scale-up
+    ctl, pods = reconcile_once(
+        gateway_source=lambda: {"door_queue": 9})
+    assert len(pods) == 2           # magnitude 9/4 -> capped at step 2
+    assert ctl.stats()["decision"] == {"direction": "up",
+                                       "reason": "activation"}
+    assert ctl.stats()["signals"]["gateway_queued"] == 9
+
+    # ConfigMap annotation fallback (the gateway binary's stamp)
+    ctl, pods = reconcile_once(stamp_annotation=3)
+    assert len(pods) == 1
+    assert ctl.stats()["decision"]["reason"] == "activation"
+
+    # a stale zero annotation keeps the fleet asleep
+    ctl, pods = reconcile_once(stamp_annotation=0)
+    assert pods == []
+
+
+def test_discovery_mirrors_controller_readiness_rules():
+    server = ApiServer()
+    client = Client(server)
+    for pod in (
+        _fleet_pod("web-r1", "web", "serve"),
+        _fleet_pod("web-r2", "web", "serve"),
+        _fleet_pod("web-r3", "web", "serve", phase="Pending"),
+        _fleet_pod("other-r1", "other", "serve"),
+    ):
+        server.create(pod)
+    client.patch("Pod", "web-r2", "serve",
+                 lambda p: p.metadata.annotations.update(
+                     {constants.ANNOTATION_FLEET_DRAIN: "scale-down"}))
+
+    stats = {
+        "web-r1": {"healthy": True, "draining": False,
+                   "recovering": False},
+        "web-r2": {"healthy": True, "draining": False,
+                   "recovering": False},
+    }
+    disc = PodDiscovery(
+        client, "web", "serve",
+        stats_source=lambda pod: stats.get(pod.metadata.name))
+    reps = {r.name: r for r in disc.poll()}
+    # Running pods of THIS fleet only; the Pending one is invisible
+    assert set(reps) == {"web-r1", "web-r2"}
+    assert reps["web-r1"].ready and not reps["web-r1"].draining
+    # the drain ANNOTATION alone (controller-marked) flips readiness,
+    # even while the replica itself still admits — same rule the
+    # controller steers by
+    assert reps["web-r2"].draining and not reps["web-r2"].ready
+    # an unscrapable replica is known but not ready (down, not gone)
+    stats.pop("web-r1")
+    reps = {r.name: r for r in disc.poll()}
+    assert not reps["web-r1"].ready and not reps["web-r1"].draining
+
+
+# ---------------------------------------------------------------------------
+# sim <-> gateway: shared ring, pluggable policies
+# ---------------------------------------------------------------------------
+def test_sim_prefix_affinity_shares_the_production_ring():
+    class Clock:
+        t = 0.0
+
+        def __call__(self):
+            return self.t
+
+    clock = Clock()
+    fleet = SimFleet(clock, router="prefix_affinity", block_size=16,
+                     affinity_blocks=2, prefix_chains=8,
+                     max_imbalance=100.0)
+    for i in range(4):
+        fleet.add_replica(f"r{i}")
+    sys_prompt = list(range(200, 232))       # 2 full blocks
+    # the sim's routing decision must equal the production kernel's
+    ring = HashRing()
+    ring.sync([f"r{i}" for i in range(4)])
+    key = prefix_key(sys_prompt, 16, 2)
+    expected_home = ring.lookup(key)[0]
+    for _ in range(6):
+        fleet.submit(tokens=10, prompt=sys_prompt)
+    fleet.tick(1.0)
+    home = [name for name, rep in fleet.replicas.items()
+            if rep.load() or rep.prefix_hits or rep.prefix_misses]
+    assert home == [expected_home]
+    rep = fleet.replicas[expected_home]
+    # first admission cold, the rest hit the chain
+    assert rep.prefix_misses == 1
+    assert rep.prefix_hits >= 1
+
+    with pytest.raises(ValueError):
+        SimFleet(clock, router="bogus")
+
+
+def test_sim_router_policies_conserve_and_diverge():
+    """All three policies are lossless on the same seeded trace;
+    affinity gets a strictly better fleet-wide prefix-hit rate."""
+    class Clock:
+        def __init__(self):
+            self.t = 0.0
+
+        def __call__(self):
+            return self.t
+
+    import random as _r
+
+    def run(policy):
+        clock = Clock()
+        fleet = SimFleet(clock, router=policy, block_size=16,
+                         affinity_blocks=2, prefix_chains=3,
+                         prefill_s=1.0, max_imbalance=4.0, seed=3)
+        for i in range(3):
+            fleet.add_replica(f"r{i}")
+        rng = _r.Random(11)
+        prompts = [[700 + 31 * p + j for j in range(32)]
+                   for p in range(12)]
+        for step in range(300):
+            if step < 240:
+                fleet.submit(tokens=rng.randint(5, 20),
+                             prompt=prompts[rng.randrange(12)])
+            fleet.tick(1.0)
+            clock.t += 1.0
+        rep = fleet.report()
+        assert rep["conservation_ok"]
+        assert rep["completed"] == rep["submitted"] > 0
+        return rep
+
+    reports = {p: run(p) for p in ("least_loaded", "random",
+                                   "prefix_affinity")}
+    aff = reports["prefix_affinity"]["prefix"]["hit_rate"]
+    assert aff > reports["least_loaded"]["prefix"]["hit_rate"]
+    assert aff > reports["random"]["prefix"]["hit_rate"]
+    assert reports["prefix_affinity"]["routes"].get("affinity", 0) > 0
+    # routes count ADMISSIONS, not attempts: a saturated head-of-queue
+    # request re-decided every tick must not inflate the split
+    routed = reports["prefix_affinity"]
+    assert sum(routed["routes"].values()) == routed["submitted"]
+
+
+# ---------------------------------------------------------------------------
+# wire-level: the whole front door over real sockets
+# ---------------------------------------------------------------------------
+def test_gateway_http_proxies_unary_and_sse_over_real_sockets():
+    """End to end on the wire: a REAL serving HTTP server (StubEngine
+    ServingLoop behind cmd/server's surface) fronted by the REAL
+    gateway HTTP server + HttpReplicaTransport — unary and SSE
+    streaming both proxy exact tokens, the response names the replica,
+    and a draining replica 503 retries to the survivor."""
+    import json as _json
+
+    from nos_tpu.cmd.gateway import (
+        HttpReplicaTransport, make_http_server as make_gw_server,
+    )
+    from nos_tpu.cmd.server import ServerConfig, make_http_server
+
+    loops = {"r0": ServingLoop(StubEngine(tokens_per_tick=4)),
+             "r1": ServingLoop(StubEngine(tokens_per_tick=4))}
+    backends = {}
+    for name, lp in loops.items():
+        httpd = make_http_server(ServerConfig(port=0), lp)
+        threading.Thread(target=httpd.serve_forever,
+                         daemon=True).start()
+        backends[name] = (
+            httpd, f"http://127.0.0.1:{httpd.server_address[1]}")
+
+    transport = HttpReplicaTransport(timeout_s=30.0)
+    router = GatewayRouter(
+        RouterConfig(max_attempts=8, backoff_s=0.002),
+        transport=transport.send,
+        stream_transport=transport.send_stream)
+    router.update([Replica(name=n, handle=url)
+                   for n, (_h, url) in sorted(backends.items())])
+    gw_httpd = make_gw_server(router, 0, "web")
+    threading.Thread(target=gw_httpd.serve_forever, daemon=True).start()
+    gw = f"http://127.0.0.1:{gw_httpd.server_address[1]}"
+    try:
+        # unary through the door
+        req = urllib.request.Request(
+            gw + "/v1/generate",
+            data=_json.dumps({"prompt": [7], "max_new_tokens": 12,
+                              "deadline_s": 30}).encode(),
+            headers={"Content-Type": "application/json"}, method="POST")
+        with urllib.request.urlopen(req, timeout=30) as r:
+            body = _json.loads(r.read())
+        assert body["tokens"] == expected_tokens([7], 12)
+        assert body["replica"] in backends and body["attempts"] == 1
+
+        # SSE streaming through the door
+        req = urllib.request.Request(
+            gw + "/v1/generate",
+            data=_json.dumps({"prompt": [9], "max_new_tokens": 8,
+                              "stream": True}).encode(),
+            headers={"Content-Type": "application/json"}, method="POST")
+        toks, done = [], False
+        with urllib.request.urlopen(req, timeout=30) as r:
+            for raw in r:
+                line = raw.strip()
+                if not line.startswith(b"data: "):
+                    continue
+                data = line[len(b"data: "):]
+                if data == b"[DONE]":
+                    done = True
+                    break
+                toks.extend(_json.loads(data)["tokens"])
+        assert done and toks == list(range(1, 9))
+
+        # a draining replica 503s (reason=draining): the gateway rides
+        # it to the survivor — clients never see the drain
+        loops["r0"].begin_drain()
+        loops["r1"].begin_drain()
+        loops["r0"].cancel_drain()      # exactly one survivor
+        for i in range(4):
+            req = urllib.request.Request(
+                gw + "/v1/generate",
+                data=_json.dumps({"prompt": [30 + i],
+                                  "max_new_tokens": 5}).encode(),
+                headers={"Content-Type": "application/json"},
+                method="POST")
+            with urllib.request.urlopen(req, timeout=30) as r:
+                body = _json.loads(r.read())
+            assert body["tokens"] == expected_tokens([30 + i], 5)
+        # gateway /stats serves the router snapshot
+        snap = _json.loads(urllib.request.urlopen(
+            gw + "/stats", timeout=10).read())
+        assert snap["fleet"] == "web"
+        assert snap["requests"]["completed"] >= 6
+        # gateway /metrics exports the nos_tpu_gateway_* family
+        metrics = urllib.request.urlopen(
+            gw + "/metrics", timeout=10).read().decode()
+        assert "nos_tpu_gateway_requests_total" in metrics
+    finally:
+        gw_httpd.shutdown()
+        for httpd, _url in backends.values():
+            httpd.shutdown()
+        for lp in loops.values():
+            lp.shutdown()
+
+
+def test_http_transport_maps_replica_errors():
+    import json as _json
+
+    from nos_tpu.cmd.gateway import HttpReplicaTransport
+
+    class FakeHTTPError(urllib.error.HTTPError):
+        def __init__(self, code, payload):
+            self._payload = _json.dumps(payload).encode()
+            urllib.error.HTTPError.__init__(
+                self, "http://x", code, "err", {}, None)
+
+        def read(self):
+            return self._payload
+
+    tr = HttpReplicaTransport()
+    with pytest.raises(QueueFull) as e:
+        tr._raise_for(FakeHTTPError(
+            429, {"error": "full", "reason": "hbm_admission"}))
+    assert e.value.reason == "hbm_admission"
+    with pytest.raises(EngineRecovering):
+        tr._raise_for(FakeHTTPError(
+            503, {"error": "restarting", "reason": "recovering"}))
+    with pytest.raises(RuntimeError):
+        tr._raise_for(FakeHTTPError(
+            503, {"error": "draining", "reason": "draining"}))
+    with pytest.raises(DeadlineExceeded):
+        tr._raise_for(FakeHTTPError(504, {"error": "late"}))
+    from nos_tpu.models.errors import Infeasible
+    with pytest.raises(Infeasible):
+        tr._raise_for(FakeHTTPError(
+            400, {"error": "too big", "infeasible": True}))
+    with pytest.raises(ValueError):
+        tr._raise_for(FakeHTTPError(400, {"error": "bad json"}))

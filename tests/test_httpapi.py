@@ -533,7 +533,12 @@ def test_serving_http_recovery_is_503_with_retry_after_not_dead():
             _post_json(url, {"prompt": [1], "max_new_tokens": 2})
         assert e.value.code == 503
         assert e.value.headers.get("Retry-After") == "1"
-        assert "restarting" in json.loads(e.value.read())["error"]
+        body = json.loads(e.value.read())
+        assert "restarting" in body["error"]
+        # machine-readable 503 reason: the gateway's retry policy
+        # tells a recovering replica (short backoff) from a draining
+        # one (route elsewhere immediately) without parsing prose
+        assert body["reason"] == "recovering"
         # release the rebuild: the in-flight request resumes and
         # finishes bit-exactly (mill tokens are self-checking)
         gate.set()
@@ -928,6 +933,7 @@ def test_serving_http_admin_drain_flips_readiness_and_sheds():
         with pytest.raises(urllib.error.HTTPError) as e:
             _post_json(url, {"prompt": [1], "max_new_tokens": 2})
         assert e.value.code == 503
+        assert json.loads(e.value.read())["reason"] == "draining"
         snap = json.loads(urllib.request.urlopen(
             url + "/stats", timeout=10).read())
         assert snap["draining"] is True
