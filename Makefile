@@ -56,6 +56,10 @@ bench-chaos-serve:  ## Serving-plane chaos: supervised restarts, bit-exact resum
 bench-autoscale:  ## Fleet autoscaler vs static fleet on a seeded diurnal + flash-crowd trace (artifact in bench_logs/bench_autoscale.json).
 	$(PYTHON) bench_autoscale.py
 
+.PHONY: bench-cluster
+bench-cluster:  ## One pool, two planes: harvested shared pool vs segregated clusters, checkpoint-then-gang-evict reclaim (artifact in bench_logs/bench_cluster.json).
+	$(PYTHON) bench_cluster.py
+
 .PHONY: bench-infer
 bench-infer:  ## 7-tenant YOLOS-family inference latency (the reference's headline scenario).
 	$(PYTHON) bench_infer.py

@@ -1,0 +1,473 @@
+#!/usr/bin/env python3
+"""One pool, two planes (ISSUE 12): a seeded diurnal + flash-crowd
+trace replayed over one shared 48-chip pool running BOTH planes — the
+autoscaled serving fleet and the harvest plane's preemptible training
+gangs — vs two statically segregated clusters at the same total chips,
+and vs the same pool left unharvested.
+
+The control plane is REAL — in-process API server, the nos scheduler
+(gang placement + quota admission + the reclaim-notice grace window),
+the quota reconciler, the fleet controller and the harvest controller
+all run unmodified — while the data planes are the deterministic sims
+(fleet/sim.py serving replicas, harvest/sim.py training gangs), all on
+one FakeClock: the whole run is bit-reproducible at a fixed seed.
+
+Three configurations see the identical serving trace:
+
+- ``harvested``   — the thesis demo: the serving fleet autoscales over
+                    the pool; in troughs the harvester borrows the
+                    unused ElasticQuota min for training gangs; when
+                    the flash crowd returns, quota reclaim runs
+                    checkpoint -> fence -> gang-evict -> witnessed
+                    resume, so the chips come back without losing
+                    either plane's work;
+- ``unharvested`` — the same autoscaled fleet with the trough chips
+                    sitting idle (the PR 8 status quo — the serving
+                    baseline the harvested run must not degrade);
+- ``segregated``  — two static clusters at the SAME total chips: a
+                    peak-provisioned serving cluster (32 chips) and a
+                    dedicated 16-chip training cluster running one gang
+                    continuously — the ops alternative to sharing.
+
+Useful work = tokens served within the TTFT SLO + tokens trained
+(steps x tokens/step), per chip-hour of the WHOLE provisioned pool.
+The acceptance invariants (pinned by tests/test_bench_cluster_smoke.py):
+harvested beats segregated on useful-work-per-chip-hour, its serving
+goodput is no worse than the unharvested fleet's, zero serving
+requests are displaced by the borrow, and per-reclaim training loss
+stays within the checkpoint-interval bound. Writes
+``bench_logs/bench_cluster.json`` FIRST, then prints the same JSON.
+NOS_TPU_BENCH_SMOKE=1 runs the exact code path on a shortened trace.
+"""
+import json
+import math
+import os
+import random
+import sys
+
+sys.path.insert(0, ".")
+
+from nos_tpu import constants  # noqa: E402
+from nos_tpu.api.quota import make_elastic_quota  # noqa: E402
+from nos_tpu.fleet import FleetConfig, FleetController, PolicyConfig  # noqa: E402
+from nos_tpu.fleet.sim import SimFleet, SimKubelet  # noqa: E402
+from nos_tpu.harvest import HarvestConfig, HarvestController  # noqa: E402
+from nos_tpu.harvest.sim import SimHarvestKubelet, SimTrainer  # noqa: E402
+from nos_tpu.kube import ApiServer, Manager  # noqa: E402
+from nos_tpu.kube.client import Client  # noqa: E402
+from nos_tpu.kube.objects import (  # noqa: E402
+    Container, Node, NodeStatus, ObjectMeta, Pod, PodCondition, PodSpec,
+    PodStatus,
+)
+from nos_tpu.quota.controller import ElasticQuotaReconciler  # noqa: E402
+from nos_tpu.scheduler import Scheduler  # noqa: E402
+
+SEED = 20260812
+SMOKE = os.environ.get("NOS_TPU_BENCH_SMOKE") == "1"
+
+# -- the shared pool: 3 pools x 2 hosts x 8 chips ---------------------------
+POOLS = ("a", "b", "c")
+HOSTS_PER_POOL = 2
+CHIPS_PER_HOST = 8.0
+TOTAL_CHIPS = len(POOLS) * HOSTS_PER_POOL * CHIPS_PER_HOST     # 48
+
+# -- serving ----------------------------------------------------------------
+NAMESPACE = "serve"
+CHIPS_PER_REPLICA = 4.0
+MAX_REPLICAS = 8                      # 32 chips at peak
+SLO_TTFT_S = 10.0
+STARTUP_S = 8.0
+DT_S = 1.0
+TRACE_S = 600 if SMOKE else 1800
+CROWD = (200, 290) if SMOKE else (800, 950)
+CROWD_X = 5.0
+CROWD_RAMP_S = 40.0
+BASE_RPS = 3.0
+DIURNAL_AMP = 0.9
+DRAIN_OUT_S = 900
+
+# -- training ---------------------------------------------------------------
+GANG_SIZE = HOSTS_PER_POOL            # one gang = one whole pool
+CHIPS_PER_WORKER = CHIPS_PER_HOST
+GANG_CHIPS = GANG_SIZE * CHIPS_PER_WORKER                      # 16
+MAX_GANGS = 2
+STEP_RATE = 1.0                       # steps/s per gang
+TOKENS_PER_STEP = 512
+CKPT_INTERVAL_S = 60.0
+CKPT_DURATION_S = 2.0
+CKPT_BUDGET_S = 15.0
+RECLAIM_GRACE_S = 20.0
+LAUNCH_STABLE_S = 20.0
+
+OUT_PATH = os.path.join("bench_logs", "bench_cluster.json")
+
+POLICY = PolicyConfig(
+    min_replicas=1, max_replicas=MAX_REPLICAS,
+    queue_high=4.0, queue_low=0.5,
+    goodput_floor=0.90, goodput_ceiling=0.97,
+    oldest_wait_high_s=2.0,
+    up_stable_s=3.0, down_stable_s=30.0,
+    up_cooldown_s=5.0, down_cooldown_s=30.0,
+    max_step_up=3, max_step_down=1,
+)
+
+
+class FakeClock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+def arrival_rate(t: float) -> float:
+    diurnal = 1.0 + DIURNAL_AMP * math.sin(
+        2 * math.pi * (t / TRACE_S - 0.25))
+    rate = BASE_RPS * diurnal
+    if CROWD[0] <= t < CROWD[1]:
+        # flash crowds ramp over tens of seconds, they don't step: the
+        # multiplier climbs linearly over CROWD_RAMP_S then holds
+        ramp = min(1.0, (t - CROWD[0]) / CROWD_RAMP_S)
+        rate *= 1.0 + (CROWD_X - 1.0) * ramp
+    return max(0.0, rate)
+
+
+def slice_host(name, pool):
+    return Node(
+        metadata=ObjectMeta(name=name, labels={
+            constants.LABEL_TPU_ACCELERATOR: "tpu-v5-lite-podslice",
+            constants.LABEL_TPU_TOPOLOGY: "4x4",
+            constants.LABEL_NODEPOOL: pool,
+        }),
+        status=NodeStatus(
+            capacity={constants.RESOURCE_TPU: CHIPS_PER_HOST, "cpu": 96},
+            allocatable={constants.RESOURCE_TPU: CHIPS_PER_HOST,
+                         "cpu": 96}))
+
+
+def replica_pod(name: str, fleet: str) -> Pod:
+    return Pod(
+        metadata=ObjectMeta(
+            name=name, namespace=NAMESPACE,
+            labels={constants.LABEL_FLEET: fleet,
+                    "app.kubernetes.io/component": "serving"}),
+        spec=PodSpec(
+            containers=[Container(
+                name="server",
+                requests={constants.RESOURCE_TPU: CHIPS_PER_REPLICA})],
+            scheduler_name=constants.SCHEDULER_NAME),
+        status=PodStatus(
+            phase="Pending",
+            conditions=[PodCondition(type="PodScheduled", status="False",
+                                     reason="Unschedulable")]))
+
+
+def tokens_in_slo(fleet: SimFleet) -> int:
+    return sum(r.tokens for r in fleet.completed
+               if r.first_token_t - r.arrival_t <= SLO_TTFT_S)
+
+
+def run_pool(name: str, *, harvest: bool, autoscale: bool = True,
+             static_replicas: int = 0, n_pools: int = len(POOLS),
+             serve_quota: float = TOTAL_CHIPS,
+             max_gangs: int = MAX_GANGS) -> dict:
+    """One configuration over one (sub)pool: the real control plane on
+    a FakeClock, the sim data planes, the identical seeded trace."""
+    clock = FakeClock()
+    rng = random.Random(SEED)
+    server = ApiServer()
+    mgr = Manager(server, clock=clock)
+    mgr.add_controller(ElasticQuotaReconciler().controller())
+    mgr.add_controller(Scheduler(
+        reclaim_grace_s=(RECLAIM_GRACE_S if harvest else 0.0),
+        clock=clock).controller())
+    client = Client(server)
+    for pool in POOLS[:n_pools]:
+        for w in range(HOSTS_PER_POOL):
+            server.create(slice_host(f"pool-{pool}-w{w}",
+                                     f"pool-{pool}"))
+    server.create(make_elastic_quota(
+        "serve-q", NAMESPACE,
+        min={constants.RESOURCE_TPU: serve_quota}))
+    server.create(make_elastic_quota(
+        "batch-q", "batch", min={constants.RESOURCE_TPU: 0.0}))
+
+    fleet = SimFleet(clock, slo_ttft_s=SLO_TTFT_S, max_batch=8,
+                     tokens_per_s=50.0, prefill_s=0.25,
+                     goodput_window_s=60.0)
+    if autoscale:
+        ctl = FleetController(
+            FleetConfig(
+                name=name, namespace=NAMESPACE,
+                chips_per_replica=CHIPS_PER_REPLICA,
+                policy=POLICY, reconcile_interval_s=2.0,
+                drain_timeout_s=45.0),
+            stats_source=fleet.stats_source, clock=clock)
+        mgr.add_controller(ctl.controller())
+    else:
+        ctl = None
+        for i in range(static_replicas):
+            server.create(replica_pod(f"{name}-r{i}", name))
+    kubelet = SimKubelet(fleet, clock, fleet_label=name,
+                         namespace=NAMESPACE, startup_s=STARTUP_S)
+
+    trainer = SimTrainer(clock, step_rate=STEP_RATE,
+                         ckpt_interval_s=CKPT_INTERVAL_S,
+                         ckpt_duration_s=CKPT_DURATION_S,
+                         tokens_per_step=TOKENS_PER_STEP)
+    hctl = None
+    hkubelet = None
+    if harvest:
+        hctl = HarvestController(
+            HarvestConfig(
+                name="hv", namespace="batch", gang_size=GANG_SIZE,
+                chips_per_worker=CHIPS_PER_WORKER, topology="4x4",
+                max_gangs=max_gangs,
+                checkpoint_budget_s=CKPT_BUDGET_S,
+                checkpoint_interval_s=CKPT_INTERVAL_S,
+                launch_stable_s=LAUNCH_STABLE_S,
+                reconcile_interval_s=1.0),
+            trainer=trainer, clock=clock)
+        mgr.add_controller(hctl.controller())
+        hkubelet = SimHarvestKubelet(trainer, clock, "hv", "batch",
+                                     startup_s=STARTUP_S)
+
+    # displaced-serving audit: a replica that vanishes while still
+    # HOLDING requests and not marked draining had those requests
+    # killed under it (scheduler preemption of a serving pod — the
+    # thing the borrow must never cause). A replica that leaves idle,
+    # or after the drain annotation, is the fleet's own lossless
+    # scale-down: the controller may annotate and release an idle
+    # replica within one reconcile pass, so the annotation alone is
+    # not the discriminator — load is.
+    displaced = []
+    seen_running = {}           # name -> (drain-annotated?, load)
+
+    def audit():
+        now_running = {}
+        for p in client.list("Pod", namespace=NAMESPACE,
+                             label_selector={constants.LABEL_FLEET:
+                                             name}):
+            if p.status.phase == "Running":
+                rep = fleet.replicas.get(p.metadata.name)
+                now_running[p.metadata.name] = (
+                    bool(p.metadata.annotations.get(
+                        constants.ANNOTATION_FLEET_DRAIN)),
+                    rep.load() if rep is not None else 0)
+        for pod_name, (drained, load) in seen_running.items():
+            if pod_name not in now_running and not drained and load > 0:
+                displaced.append(pod_name)
+        seen_running.clear()
+        seen_running.update(now_running)
+
+    chip_seconds_bound = 0.0
+    timeline = []
+    carry = 0.0
+    t = 0.0
+    end = float(TRACE_S)
+    settle_deadline = end + DRAIN_OUT_S
+    while True:
+        if t < end:
+            carry += arrival_rate(t) * DT_S
+            while carry >= 1.0:
+                carry -= 1.0
+                fleet.submit(tokens=rng.randint(20, 80))
+        mgr.run_until_idle()
+        kubelet.sync(client)
+        if hkubelet is not None:
+            hkubelet.sync(client)
+        mgr.run_until_idle()
+        fleet.tick(DT_S)
+        trainer.tick(DT_S)
+        audit()
+        running = len(seen_running)
+        gangs_bound = sum(
+            1 for p in client.list("Pod", namespace="batch")
+            if p.spec.node_name and p.status.phase == "Running") \
+            // max(1, GANG_SIZE)
+        chip_seconds_bound += (
+            running * CHIPS_PER_REPLICA
+            + gangs_bound * GANG_CHIPS) * DT_S
+        if int(t) % 30 == 0:
+            timeline.append((int(t), running, gangs_bound))
+        clock.advance(DT_S)
+        t += DT_S
+        if t >= end and (fleet.in_system() == 0 or t >= settle_deadline):
+            break
+    report = fleet.report()
+    mgr.stop()
+
+    served_slo = tokens_in_slo(fleet)
+    trained = trainer.report()
+    pool_chips = n_pools * HOSTS_PER_POOL * CHIPS_PER_HOST
+    chip_hours = pool_chips * t / 3600.0
+    useful = served_slo + trained["trained_tokens"]
+    out = {
+        "pool": name,
+        "pool_chips": pool_chips,
+        "duration_s": t,
+        "serving": {
+            "goodput": report["goodput"],
+            "submitted": report["submitted"],
+            "completed": report["completed"],
+            "conservation_ok": report["conservation_ok"],
+            "requeued": report["requeued"],
+            "tokens_in_slo": served_slo,
+            "displaced": displaced,
+            "replicas_peak": max((r for _, r, _ in timeline),
+                                 default=0),
+        },
+        "training": {
+            "useful_steps": trained["useful_steps"],
+            "trained_tokens": trained["trained_tokens"],
+            "checkpoints_committed": trained["checkpoints_committed"],
+            "checkpoints_lost": trained["checkpoints_lost"],
+            "gang_peak": max((g for _, _, g in timeline), default=0),
+        },
+        "useful_tokens": useful,
+        "chip_hours_provisioned": round(chip_hours, 4),
+        "chip_hours_bound": round(chip_seconds_bound / 3600.0, 4),
+        "useful_per_chip_hour": round(useful / chip_hours, 2),
+        "timeline": timeline,
+    }
+    if hctl is not None:
+        ledger = hctl.ledger()
+        out["reclaims"] = {
+            "ledger": ledger,
+            "by_outcome": {
+                o: sum(1 for e in ledger if e["outcome"] == o)
+                for o in ("graceful", "forced", "preempted")},
+            "steps_lost_total": sum(e["steps_lost"] for e in ledger),
+            "max_steps_lost": max(
+                (e["steps_lost"] for e in ledger), default=0),
+        }
+    return out
+
+
+def run_segregated_training() -> dict:
+    """The dedicated 16-chip training cluster: one gang, always on,
+    same trainer model and checkpoint cadence, no reclaims ever."""
+    clock = FakeClock()
+    trainer = SimTrainer(clock, step_rate=STEP_RATE,
+                         ckpt_interval_s=CKPT_INTERVAL_S,
+                         ckpt_duration_s=CKPT_DURATION_S,
+                         tokens_per_step=TOKENS_PER_STEP)
+    trainer.attach("dedicated-g0")
+    trainer.resume("dedicated-g0", [], 0)
+    t = 0.0
+    while t < TRACE_S:
+        trainer.tick(DT_S)
+        clock.advance(DT_S)
+        t += DT_S
+    rep = trainer.report()
+    chips = GANG_CHIPS
+    chip_hours = chips * t / 3600.0
+    return {
+        "pool": "segregated-training",
+        "pool_chips": chips,
+        "duration_s": t,
+        "training": {
+            "useful_steps": rep["useful_steps"],
+            "trained_tokens": rep["trained_tokens"],
+            "checkpoints_committed": rep["checkpoints_committed"],
+        },
+        "useful_tokens": rep["trained_tokens"],
+        "chip_hours_provisioned": round(chip_hours, 4),
+    }
+
+
+def main():
+    harvested = run_pool("shared", harvest=True)
+    unharvested = run_pool("solo", harvest=False)
+    # segregated: a peak-static serving cluster on 2 pools (32 chips)
+    # plus the dedicated training cluster on the remaining 16
+    seg_serving = run_pool("peak", harvest=False, autoscale=False,
+                           static_replicas=MAX_REPLICAS, n_pools=2,
+                           serve_quota=2 * HOSTS_PER_POOL
+                           * CHIPS_PER_HOST)
+    seg_training = run_segregated_training()
+
+    seg_useful = (seg_serving["useful_tokens"]
+                  + seg_training["useful_tokens"])
+    seg_chip_hours = (seg_serving["chip_hours_provisioned"]
+                      + seg_training["chip_hours_provisioned"])
+    # chip-hour fairness: both sides of the comparison provision the
+    # SAME 48 chips; normalize on the longer wall (the drain-out tails
+    # differ by a few seconds)
+    wall = max(harvested["duration_s"], seg_serving["duration_s"],
+               seg_training["duration_s"])
+    harvested_per = harvested["useful_tokens"] / (
+        TOTAL_CHIPS * wall / 3600.0)
+    seg_per = seg_useful / (TOTAL_CHIPS * wall / 3600.0)
+    unharv_per = unharvested["useful_tokens"] / (
+        TOTAL_CHIPS * wall / 3600.0)
+
+    ledger = harvested.get("reclaims", {}).get("ledger", [])
+    loss_bound = STEP_RATE * (CKPT_INTERVAL_S + CKPT_DURATION_S
+                              + CKPT_BUDGET_S) + 3
+    invariants = {
+        "harvested_beats_segregated": harvested_per > seg_per,
+        "harvested_beats_unharvested": harvested_per > unharv_per,
+        "serving_goodput_no_worse_than_unharvested":
+            (harvested["serving"]["goodput"] or 0.0)
+            >= (unharvested["serving"]["goodput"] or 0.0) - 1e-9,
+        "serving_displaced_zero":
+            harvested["serving"]["displaced"] == [],
+        "serving_lossless":
+            harvested["serving"]["conservation_ok"]
+            and harvested["serving"]["completed"]
+            == harvested["serving"]["submitted"],
+        "reclaims_happened": len(ledger) > 0,
+        "steps_lost_within_bound": all(
+            e["steps_lost"] <= loss_bound for e in ledger),
+    }
+    result = {
+        "metric": "one pool two planes: harvested shared pool vs "
+                  "segregated clusters on a seeded diurnal + "
+                  "flash-crowd trace"
+                  + (" [SMOKE]" if SMOKE else ""),
+        "seed": SEED,
+        "trace": {
+            "duration_s": TRACE_S, "base_rps": BASE_RPS,
+            "diurnal_amplitude": DIURNAL_AMP,
+            "flash_crowd_window_s": list(CROWD),
+            "flash_crowd_x": CROWD_X,
+            "slo_ttft_s": SLO_TTFT_S,
+            "total_chips": TOTAL_CHIPS,
+            "chips_per_replica": CHIPS_PER_REPLICA,
+            "gang_chips": GANG_CHIPS,
+            "tokens_per_step": TOKENS_PER_STEP,
+            "ckpt_interval_s": CKPT_INTERVAL_S,
+            "ckpt_budget_s": CKPT_BUDGET_S,
+            "reclaim_grace_s": RECLAIM_GRACE_S,
+        },
+        # headline: useful work per chip-hour, harvested over segregated
+        "value": round(harvested_per / seg_per, 4) if seg_per else None,
+        "unit": "x_useful_work_per_chip_hour_vs_segregated",
+        "useful_per_chip_hour": {
+            "harvested": round(harvested_per, 2),
+            "segregated": round(seg_per, 2),
+            "unharvested": round(unharv_per, 2),
+        },
+        "invariants": invariants,
+        "harvested": harvested,
+        "unharvested": unharvested,
+        "segregated": {
+            "serving": seg_serving,
+            "training": seg_training,
+            "useful_tokens": seg_useful,
+            "chip_hours_provisioned": seg_chip_hours,
+        },
+    }
+    os.makedirs(os.path.dirname(OUT_PATH), exist_ok=True)
+    with open(OUT_PATH, "w") as f:
+        json.dump(result, f, indent=2)
+    print(json.dumps(result))
+    return result
+
+
+if __name__ == "__main__":
+    main()

@@ -65,6 +65,10 @@ app.kubernetes.io/managed-by: {{ .Release.Service }}
 {{- printf "%s/%s:%s" .Values.image.registry .Values.fleet.image.repository (include "nos-tpu.tag" .) -}}
 {{- end -}}
 
+{{- define "nos-tpu.harvest.image" -}}
+{{- printf "%s/%s:%s" .Values.image.registry .Values.harvest.image.repository (include "nos-tpu.tag" .) -}}
+{{- end -}}
+
 {{- define "nos-tpu.serving.image" -}}
 {{- printf "%s/%s:%s" .Values.image.registry .Values.serving.image.repository (include "nos-tpu.tag" .) -}}
 {{- end -}}

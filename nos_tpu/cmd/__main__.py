@@ -13,6 +13,7 @@ _BINARIES = {
     "lifecycle": "nos_tpu.cmd.lifecycle",
     "fleet": "nos_tpu.cmd.fleet",
     "gateway": "nos_tpu.cmd.gateway",
+    "harvest": "nos_tpu.cmd.harvest",
     "metricsexporter": "nos_tpu.cmd.metricsexporter",
     "trainer": "nos_tpu.cmd.trainer",
     "generate": "nos_tpu.cmd.generate",

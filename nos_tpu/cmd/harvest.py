@@ -1,0 +1,141 @@
+"""nos-tpu-harvest — the diurnal chip harvester (ISSUE 12).
+
+Hosts ``harvest.HarvestController``: keeps ``--max-gangs`` preemptible
+training JobSet gangs parked in ``--namespace`` under a scheduling
+hold, releases a gang to the nos scheduler whenever the pool's
+ElasticQuota slack has covered a whole gang for ``--launch-stable``
+seconds (gang admission's all-or-nothing placement is the real launch
+gate), and — when quota reclaim fires and the scheduler stamps a
+``nos.ai/reclaim-notice-deadline`` on a gang — runs the graceful
+reclaim protocol: checkpoint (bounded by ``--checkpoint-budget``),
+fence, gang-evict through the lifecycle eviction machinery, witnessed
+resume on the next trough's rebind.
+
+The trainer seam rides pod annotations (checkpoint requests / fences /
+resume steps the training job polls) with ``--checkpoint-root`` as the
+witness: the durable step is read from the gang's orbax checkpoint
+directory on shared storage (``<root>/<gang>``), so a resume restarts
+only from evidence the harvester can see. Without a checkpoint root the
+harvester still conserves quota semantics — it just cannot credit
+banked progress (documented degradation, not an error).
+"""
+from __future__ import annotations
+
+import argparse
+from typing import Optional, Sequence
+
+from nos_tpu.cmd import serve
+from nos_tpu.harvest import (
+    AnnotationTrainerBridge, HarvestConfig, HarvestController,
+)
+from nos_tpu.kube.client import Client
+from nos_tpu.kube.controller import Manager
+from nos_tpu.kube.leaderelection import LeaderElectionConfig
+
+
+def build(server, cfg: HarvestConfig, trainer=None,
+          leader_election: bool = True,
+          identity: str = "harvest-0") -> Manager:
+    election = None
+    if leader_election:
+        election = LeaderElectionConfig(
+            lease_name=f"nos-tpu-harvest-{cfg.name}-leader",
+            identity=identity)
+    mgr = Manager(server, leader_election=election)
+    ctl = HarvestController(cfg, trainer=trainer)
+    mgr.add_controller(ctl.controller())
+    mgr.stats = ctl.stats           # HealthServer /stats route
+    return mgr
+
+
+def main(argv: Optional[Sequence[str]] = None) -> None:
+    parser = argparse.ArgumentParser(prog="nos-tpu-harvest",
+                                     description=__doc__)
+    serve.common_flags(parser, config=False)
+    parser.add_argument("--name", default="harvest",
+                        help="harvest plane name (the nos.ai/harvest "
+                             "label value on gang pods)")
+    parser.add_argument("--namespace", default="batch",
+                        help="the borrower namespace the training gangs "
+                             "run in (its ElasticQuota min may be 0 — "
+                             "the pure-scavenger shape)")
+    parser.add_argument(
+        "--resource", default="google.com/tpu",
+        help="resource name each gang worker requests")
+    parser.add_argument(
+        "--gang-size", type=int, default=2,
+        help="workers (hosts) per training JobSet gang")
+    parser.add_argument(
+        "--chips-per-worker", type=float, default=8.0,
+        help="chips each gang worker requests")
+    parser.add_argument(
+        "--topology", default="4x4",
+        help="slice topology the gang requires (the "
+             "nos.ai/tpu-topology annotation gang placement honors)")
+    parser.add_argument(
+        "--max-gangs", type=int, default=2,
+        help="gang slots the harvester maintains (parked when the pool "
+             "has no slack)")
+    parser.add_argument(
+        "--checkpoint-budget", type=float, default=30.0,
+        help="seconds a reclaim-noticed gang may spend banking a "
+             "checkpoint before the fence+gang-evict is forced anyway "
+             "(keep at or under the scheduler's reclaim grace window)")
+    parser.add_argument(
+        "--checkpoint-interval", type=float, default=60.0,
+        help="the training jobs' checkpoint cadence — the unit the "
+             "work-conservation invariant is stated in (lost work per "
+             "reclaim <= one interval + save duration + budget)")
+    parser.add_argument(
+        "--launch-stable", type=float, default=15.0,
+        help="seconds quota slack must cover a whole gang before a "
+             "parked gang is released to the scheduler")
+    parser.add_argument(
+        "--interval", type=float, default=5.0,
+        help="seconds between reconcile passes")
+    parser.add_argument(
+        "--priority", type=int, default=-10,
+        help="pod priority for gang workers (preemption victim order; "
+             "keep it below first-party batch workloads)")
+    parser.add_argument(
+        "--trainer-image", default="nos-tpu-trainer",
+        help="container image the gang worker pods run")
+    parser.add_argument(
+        "--checkpoint-root", default="",
+        help="shared-storage root of the gangs' orbax checkpoint "
+             "directories (<root>/<gang>): the WITNESS a quota-reclaim "
+             "resume is gated on; empty = no banked-progress credit")
+    parser.add_argument(
+        "--identity", default="harvest-0",
+        help="leader-election identity (pod name in-cluster)")
+    parser.add_argument(
+        "--no-leader-election", action="store_true",
+        help="single-replica deployments may skip the Lease")
+    args = parser.parse_args(argv)
+
+    serve.setup_observability(args)
+    cfg = HarvestConfig(
+        name=args.name, namespace=args.namespace,
+        resource=args.resource,
+        gang_size=args.gang_size,
+        chips_per_worker=args.chips_per_worker,
+        topology=args.topology,
+        max_gangs=args.max_gangs,
+        checkpoint_budget_s=args.checkpoint_budget,
+        checkpoint_interval_s=args.checkpoint_interval,
+        launch_stable_s=args.launch_stable,
+        reconcile_interval_s=args.interval,
+        priority=args.priority,
+        image=args.trainer_image,
+    )
+    server = serve.connect(args)
+    trainer = AnnotationTrainerBridge(
+        Client(server), checkpoint_root=args.checkpoint_root or None)
+    mgr = build(server, cfg, trainer=trainer,
+                leader_election=not args.no_leader_election,
+                identity=args.identity)
+    serve.run_daemon(mgr, args.health_port, args.health_host)
+
+
+if __name__ == "__main__":
+    main()

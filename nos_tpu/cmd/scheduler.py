@@ -18,20 +18,30 @@ from nos_tpu.scheduler import Scheduler
 from nos_tpu.tpu.resource_calc import ResourceCalculator
 
 
-def build(server, config: Optional[CapacitySchedulingArgs] = None) -> Manager:
+def build(server, config: Optional[CapacitySchedulingArgs] = None,
+          reclaim_grace_s: float = 0.0) -> Manager:
     cfg = config or CapacitySchedulingArgs()
     calc = ResourceCalculator(
         tpu_memory_gb=cfg.tpu_resource_memory_gb,
         nvidia_gpu_memory_gb=cfg.nvidia_gpu_resource_memory_gb,
     )
     mgr = Manager(server, leader_election=cfg.leader_election_config("scheduler"))
-    mgr.add_controller(Scheduler(calculator=calc).controller())
+    mgr.add_controller(Scheduler(
+        calculator=calc, reclaim_grace_s=reclaim_grace_s).controller())
     return mgr
 
 
 def main(argv: Optional[Sequence[str]] = None) -> None:
     parser = argparse.ArgumentParser(prog="nos-tpu-scheduler", description=__doc__)
     serve.common_flags(parser)
+    parser.add_argument(
+        "--reclaim-grace-s", type=float, default=0.0,
+        help="gang-eviction grace window: preemption of an over-quota "
+             "GANG first stamps a nos.ai/reclaim-notice-deadline "
+             "annotation (now + grace) and defers the deletion, giving "
+             "a notice-aware controller (nos-tpu-harvest) time to "
+             "checkpoint-then-gang-evict; 0 = delete immediately "
+             "(the pre-harvest behavior)")
     args = parser.parse_args(argv)
 
     # accepts both the flat snake_case args file and a full
@@ -42,7 +52,8 @@ def main(argv: Optional[Sequence[str]] = None) -> None:
     serve.setup_observability(
         args, args.log_level if args.log_level is not None
         else cfg.log_level)
-    mgr = build(serve.connect(args), cfg)
+    mgr = build(serve.connect(args), cfg,
+                reclaim_grace_s=args.reclaim_grace_s)
     serve.run_daemon(mgr, args.health_port, args.health_host)
 
 

@@ -182,6 +182,37 @@ ANNOTATION_FLEET_DRAIN = DOMAIN + "/fleet-drain"
 # richer gateway_source (the gateway's /stats over HTTP) is wired.
 ANNOTATION_GATEWAY_QUEUED = DOMAIN + "/gateway-queued"
 
+# ---------------------------------------------------------------------------
+# Diurnal chip harvesting (nos_tpu/harvest/) — one pool, two planes
+# ---------------------------------------------------------------------------
+# Preemptible training gangs launched by the harvest controller carry
+# nos.ai/harvest=<name>; the controller only ever creates, reclaims and
+# relaunches pods bearing its own label.
+LABEL_HARVEST = DOMAIN + "/harvest"
+# Gang-level quota-reclaim notice (the pod analog of
+# ANNOTATION_PREEMPTION_DEADLINE on nodes): when the capacity scheduler
+# selects an over-quota GANG as a preemption victim and a reclaim grace
+# window is configured, it stamps this annotation (value = wall-clock
+# deadline seconds) on every member instead of deleting them outright.
+# A notice-aware controller (the harvester) uses the window to run
+# checkpoint -> fence -> gang-evict; at expiry the scheduler deletes the
+# gang anyway — the blunt fallback when nobody intercepts the notice.
+ANNOTATION_RECLAIM_NOTICE = DOMAIN + "/reclaim-notice-deadline"
+# The harvester's reclaim-protocol state, stamped on every gang member
+# as one JSON object ({"id","phase","deadline","step"}) so a controller
+# restart mid-reclaim re-enters idempotently from the API server's
+# durable record — never a double-evict, never an orphaned fence.
+ANNOTATION_HARVEST_RECLAIM = DOMAIN + "/harvest-reclaim"
+# Stamped onto the Pending pods the gang-evict recreates: the durable
+# checkpoint step a witnessed resume must restart from.
+ANNOTATION_HARVEST_RESUME_STEP = DOMAIN + "/harvest-resume-step"
+# Scheduling gate (the kube schedulingGates analog): the nos scheduler
+# skips Pending pods carrying this annotation entirely. The harvester
+# parks evicted gangs under it so they cannot race the serving fleet
+# for the chips their own eviction just freed; stripping it is the
+# relaunch decision.
+ANNOTATION_SCHEDULING_HOLD = DOMAIN + "/scheduling-hold"
+
 # Scheduler / controller names
 SCHEDULER_NAME = "nos-scheduler"
 DEVICE_PLUGIN_CONFIGMAP = "nos-device-plugin-config"

@@ -275,10 +275,21 @@ class FleetController:
                                  {cfg.resource: planned_chips})
         if headroom != float("inf"):
             self.g_slack.set(headroom)
+        # the clamp allows the LARGER of borrowable slack and the
+        # fleet's own guaranteed headroom: when a borrower (the harvest
+        # plane) has consumed the aggregate slack, slack reads 0 even
+        # below this fleet's own min — and a fleet that never creates
+        # pods against its guarantee can never raise the
+        # Pending-unschedulable demand that makes quota reclaim fire.
+        # Pods created on the guarantee park unschedulable until the
+        # reclaim (graceful gang-evict or scheduler preemption at
+        # notice expiry) frees their chips.
+        allow = max(headroom, view.guaranteed_headroom(
+            cfg.resource, {cfg.resource: planned_chips}))
         quota_clamped = False
         if desired > current and cfg.chips_per_replica > 0 \
-                and headroom != float("inf"):
-            affordable = current + int(headroom // cfg.chips_per_replica)
+                and allow != float("inf"):
+            affordable = current + int(allow // cfg.chips_per_replica)
             if affordable < desired:
                 quota_clamped = True
                 desired = max(current, affordable)

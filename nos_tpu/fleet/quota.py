@@ -120,6 +120,28 @@ class QuotaView:
         return max(0.0, own.min.get(resource, 0.0)
                    - own.used.get(resource, 0.0))
 
+    def guaranteed_headroom(self, resource: str,
+                            planned: ResourceList = None) -> float:
+        """Units the fleet may request on its OWN guaranteed min alone,
+        ``planned`` (created-but-unaccounted pods) subtracted and the
+        own-max ceiling applied. Distinct from :meth:`headroom`: when a
+        borrower has consumed the aggregate slack, ``headroom`` reads 0
+        even while this namespace sits below its min — but pods created
+        against the guarantee are exactly the Pending-unschedulable
+        demand that makes quota reclaim fire (the harvester's graceful
+        shed, the scheduler's preemption), so the clamp must allow
+        them."""
+        if not self.governed:
+            return float("inf")
+        planned_v = (planned or {}).get(resource, 0.0)
+        own = self.infos[self.namespace]
+        room = (own.min.get(resource, 0.0)
+                - own.used.get(resource, 0.0) - planned_v)
+        if own.max is not None and resource in own.max:
+            room = min(room, own.max[resource]
+                       - own.used.get(resource, 0.0) - planned_v)
+        return max(0.0, room)
+
     def over_min(self, resource: str) -> float:
         """Units the fleet namespace uses BEYOND its min — borrowed
         capacity a guaranteed owner may reclaim."""

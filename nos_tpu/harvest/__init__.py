@@ -1,0 +1,25 @@
+"""Diurnal chip harvesting — the batch half of "one pool, two planes".
+
+The serving fleet's autoscaler (nos_tpu/fleet) frees chips in traffic
+troughs; this package borrows them for preemptible training gangs and
+hands them back gracefully — checkpoint, fence, gang-evict, witnessed
+resume — when quota reclaim fires:
+
+- ``controller`` — the HarvestController: parked gang slots, the
+  launch/hysteresis decision, and the annotation-journaled reclaim
+  protocol (notice -> checkpoint budget -> fence -> gang-evict ->
+  witnessed resume, with its degradation ladder);
+- ``trainer``    — the trainer seam (duck-typed contract, the
+  pod-annotation + checkpoint-directory bridge the binary uses);
+- ``sim``        — the deterministic FakeClock training-plane model
+  (SimTrainer + SimHarvestKubelet) benches and tests drive.
+"""
+from nos_tpu.harvest.controller import HarvestConfig, HarvestController
+from nos_tpu.harvest.trainer import AnnotationTrainerBridge, NullTrainer
+
+__all__ = [
+    "AnnotationTrainerBridge",
+    "HarvestConfig",
+    "HarvestController",
+    "NullTrainer",
+]

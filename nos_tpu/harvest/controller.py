@@ -1,0 +1,742 @@
+"""Diurnal chip harvester: preemptible training on the serving pool's
+troughs, with checkpoint-then-gang-evict quota reclaim (ISSUE 12).
+
+One pool, two planes. The serving fleet (nos_tpu/fleet) hands chips back
+in traffic troughs; this controller puts them to work: it keeps
+``max_gangs`` preemptible training JobSet gangs (scheduler/gang.py
+labels + topology annotations) PARKED in a batch namespace under a
+scheduling hold, and releases a gang to the scheduler whenever the
+serving namespace's unused ElasticQuota min has been idle long enough to
+borrow — gang admission's all-or-nothing placement is the launch gate,
+so a released gang binds exactly when one whole slice is free.
+
+The robustness headline is the **graceful reclaim protocol**. When the
+flash crowd returns, the serving fleet creates pods against its
+guaranteed min, quota reclaim fires, and the capacity scheduler — with a
+reclaim grace window — stamps a ``nos.ai/reclaim-notice-deadline`` on
+the over-quota gang instead of deleting it. The harvester intercepts the
+notice and walks a durable, annotation-journaled state machine:
+
+  notice -> **checkpoint** (async, bounded by ``checkpoint_budget_s``
+  and the notice deadline) -> **fence** (stop stepping: every further
+  step would be lost anyway) -> **gang-evict** (the lifecycle
+  eviction machinery: delete + recreate Pending, parked under the
+  scheduling hold with the durable resume step stamped on) ->
+  **witnessed resume** (on the next trough's rebind, training restarts
+  from the checkpoint step the harvester can SEE in shared storage —
+  never from a process's claim).
+
+Degradation ladder: a checkpoint that hangs or exceeds the budget
+forces the fence+evict anyway (outcome ``forced``; resume falls back to
+the last durable checkpoint); pods that vanish mid-protocol — the
+scheduler's notice expired, or node death mid-checkpoint routed through
+slice repair — finalize as ``preempted`` and the slot is respawned
+parked. Every transition is stamped into the
+``nos.ai/harvest-reclaim`` annotation BEFORE the action runs, so a
+controller restart mid-reclaim re-enters idempotently from the API
+server's durable record: never a double-evict, never an orphaned fence.
+
+The conservation invariant this plane is judged on (pinned by
+tests/test_harvest_chaos.py under a seeded soak): training work lost
+per reclaim is at most one checkpoint interval (+ the save duration and
+reclaim budget), and serving requests displaced by harvesting == 0 —
+serving pods stay within their guaranteed min, so they are never
+preemption victims of the borrow.
+"""
+from __future__ import annotations
+
+import json
+import logging
+import time
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional
+
+from nos_tpu import constants
+from nos_tpu.fleet.quota import QuotaView, build_quota_infos
+from nos_tpu.harvest.trainer import NullTrainer
+from nos_tpu.kube.apiserver import AlreadyExists, NotFound
+from nos_tpu.kube.client import Client
+from nos_tpu.kube.controller import Controller, Request, Result, Watch
+from nos_tpu.kube.objects import (
+    Container, ObjectMeta, Pod, PodCondition, PodSpec, PodStatus,
+)
+from nos_tpu.lifecycle.controller import evict_pod
+from nos_tpu.obs import tracing
+from nos_tpu.scheduler.gang import reclaim_notice_deadline
+from nos_tpu.tpu.resource_calc import ResourceCalculator
+from nos_tpu.utils.metrics import default_registry
+
+logger = logging.getLogger(__name__)
+
+__all__ = ["HarvestConfig", "HarvestController"]
+
+#: reclaim outcomes the counter reports
+OUTCOMES = ("graceful", "forced", "preempted")
+#: gang-slot states the gauge reports
+GANG_STATES = ("running", "binding", "pending", "parked", "reclaiming")
+
+_ALIVE = ("Pending", "Running")
+
+
+@dataclass
+class HarvestConfig:
+    """One harvest plane (helm: ``harvest.*``)."""
+
+    name: str = "harvest"
+    # the borrower namespace the gangs run in (its ElasticQuota min may
+    # be 0 — the pure-scavenger shape: everything it runs is borrowed)
+    namespace: str = "batch"
+    resource: str = constants.RESOURCE_TPU
+    # gang geometry: workers per JobSet gang, chips per worker, and the
+    # slice topology the gang's parallelism layout requires
+    gang_size: int = 2
+    chips_per_worker: float = 8.0
+    topology: str = "4x4"
+    max_gangs: int = 2
+    # the graceful-reclaim budget: how long a noticed gang may spend
+    # banking a checkpoint before the fence+evict is forced anyway (the
+    # scheduler's notice deadline caps it further when earlier)
+    checkpoint_budget_s: float = 30.0
+    # the training jobs' checkpoint cadence — the unit of the
+    # conservation invariant (work lost per reclaim <= one interval +
+    # save duration + budget) and what the telemetry rows are read in
+    checkpoint_interval_s: float = 60.0
+    # quota slack must cover a whole gang CONTINUOUSLY this long before
+    # a parked gang is released to the scheduler (launch hysteresis: a
+    # momentary dip in serving usage is not a trough)
+    launch_stable_s: float = 15.0
+    reconcile_interval_s: float = 5.0
+    # harvest pods ride low priority: preemption victim order inside
+    # the batch namespace, below any first-party batch workloads
+    priority: int = -10
+    image: str = "nos-tpu-trainer"
+
+
+class HarvestController:
+    """Level-triggered harvester; see module docstring.
+
+    ``trainer`` is the seam to the training jobs (harvest/trainer.py
+    documents the duck-typed contract; harvest/sim.SimTrainer for
+    benches/tests, AnnotationTrainerBridge in the binary). ``clock``
+    shares the node-notice wall-clock domain; inject a FakeClock for
+    determinism.
+    """
+
+    def __init__(self, cfg: HarvestConfig, trainer=None,
+                 calculator: Optional[ResourceCalculator] = None,
+                 clock: Callable[[], float] = time.time):
+        self.cfg = cfg
+        self.trainer = trainer if trainer is not None else NullTrainer()
+        self.calc = calculator or ResourceCalculator()
+        self.clock = clock
+        self._slack_since: Optional[float] = None
+        self._admitted: set = set()          # gangs witnessed-resumed
+        self._episodes: Dict[str, object] = {}      # gang -> reclaim span
+        self._phase_spans: Dict[str, object] = {}
+        self._ledger: List[dict] = []        # finalized reclaim records
+        self._last: dict = {}                # stats() snapshot
+        reg = default_registry()
+        self.g_borrowed = reg.gauge(
+            "nos_tpu_harvest_borrowed_chips",
+            "Chips the harvest plane's training gangs currently hold of "
+            "the shared pool (bound members' requests; with a zero-min "
+            "batch quota — the scavenger shape — all of it is borrowed "
+            "from other namespaces' unused ElasticQuota min)")
+        self.g_gangs = reg.gauge(
+            "nos_tpu_harvest_gangs",
+            "Harvest gang slots by state (running = all members Running "
+            "and stepping; binding = released and partially placed; "
+            "pending = released, awaiting gang admission; parked = held "
+            "back from the scheduler awaiting a trough; reclaiming = "
+            "mid checkpoint-then-gang-evict)",
+            ("state",))
+        self.m_reclaims = reg.counter(
+            "nos_tpu_harvest_reclaims_total",
+            "Quota-reclaim episodes finalized, by outcome (graceful = "
+            "checkpoint landed within budget before the gang-evict; "
+            "forced = budget/notice expired or the checkpoint hung, "
+            "evicted on the last durable checkpoint; preempted = the "
+            "gang's pods vanished mid-protocol — scheduler notice "
+            "expiry or node death — and the slot was respawned parked)",
+            ("outcome",))
+        self.h_reclaim = reg.histogram(
+            "nos_tpu_harvest_reclaim_seconds",
+            "Wall time of one reclaim episode, notice -> gang-evict "
+            "complete")
+        self.m_steps_lost = reg.counter(
+            "nos_tpu_harvest_steps_lost_total",
+            "Training steps lost to reclaims (step at eviction minus "
+            "the durable checkpoint step resumed from; bounded by one "
+            "checkpoint interval + save duration + reclaim budget)")
+
+    # -- pod inventory --------------------------------------------------
+    def _slots(self) -> List[str]:
+        return [f"{self.cfg.name}-g{i}" for i in range(self.cfg.max_gangs)]
+
+    def _harvest_pods(self, client: Client) -> List[Pod]:
+        return sorted(
+            (p for p in client.list("Pod", namespace=self.cfg.namespace,
+                                    label_selector={
+                                        constants.LABEL_HARVEST:
+                                        self.cfg.name})
+             if p.status.phase in _ALIVE),
+            key=lambda p: p.metadata.name)
+
+    @staticmethod
+    def _gangs(pods: List[Pod]) -> Dict[str, List[Pod]]:
+        out: Dict[str, List[Pod]] = {}
+        for p in pods:
+            gang = p.metadata.labels.get(constants.LABEL_GANG_NAME)
+            if gang:
+                out.setdefault(gang, []).append(p)
+        return out
+
+    def _worker_pod(self, gang: str, worker: int, resume_step: int) -> Pod:
+        cfg = self.cfg
+        return Pod(
+            metadata=ObjectMeta(
+                name=f"{gang}-w{worker}", namespace=cfg.namespace,
+                labels={
+                    constants.LABEL_HARVEST: cfg.name,
+                    constants.LABEL_GANG_NAME: gang,
+                    constants.LABEL_GANG_SIZE: str(cfg.gang_size),
+                    constants.LABEL_GANG_WORKER: str(worker),
+                    "app.kubernetes.io/component": "harvest",
+                },
+                annotations={
+                    constants.ANNOTATION_TPU_TOPOLOGY: cfg.topology,
+                    # born parked: releasing the hold is the launch
+                    constants.ANNOTATION_SCHEDULING_HOLD: "harvest-parked",
+                    constants.ANNOTATION_HARVEST_RESUME_STEP:
+                        str(int(resume_step)),
+                }),
+            spec=PodSpec(
+                containers=[Container(
+                    name="trainer", image=cfg.image,
+                    requests={cfg.resource: cfg.chips_per_worker})],
+                scheduler_name=constants.SCHEDULER_NAME,
+                priority=cfg.priority,
+            ),
+            status=PodStatus(
+                phase="Pending",
+                conditions=[PodCondition(
+                    type="PodScheduled", status="False",
+                    reason="Unschedulable")],
+            ))
+
+    # -- reclaim-state journal ------------------------------------------
+    @staticmethod
+    def _reclaim_state(members: List[Pod]) -> Optional[dict]:
+        for m in members:
+            raw = m.metadata.annotations.get(
+                constants.ANNOTATION_HARVEST_RECLAIM)
+            if raw:
+                try:
+                    return json.loads(raw)
+                except ValueError:
+                    continue
+        return None
+
+    def _stamp_state(self, client: Client, members: List[Pod],
+                     state: dict) -> None:
+        enc = json.dumps(state, sort_keys=True)
+
+        def mutate(p: Pod):
+            p.metadata.annotations[
+                constants.ANNOTATION_HARVEST_RECLAIM] = enc
+
+        for m in members:
+            try:
+                client.patch("Pod", m.metadata.name,
+                             m.metadata.namespace, mutate)
+            except NotFound:
+                continue
+        gang = state.get("gang") or (
+            members[0].metadata.labels.get(constants.LABEL_GANG_NAME)
+            if members else None)
+        if gang:
+            self._journal_cm(client, gang, enc)
+
+    # -- the durable journal mirror -------------------------------------
+    # Pod annotations carry the reclaim journal while the pods exist —
+    # but a notice-expiry delete (or node GC) can erase every member
+    # while a restarted harvester has never observed them, and then
+    # nothing durable says a reclaim was mid-flight. The
+    # ``nos-tpu-harvest-<name>`` ConfigMap (the gateway's durable-signal
+    # idiom) mirrors each active reclaim's journal under data key
+    # ``reclaim.<gang>``; _finalize clears it, and the slot-respawn path
+    # reads it back so a vanished gang's episode is still accounted —
+    # with its ORIGINAL id/notice step — across restarts.
+    def _cm_name(self) -> str:
+        return f"nos-tpu-harvest-{self.cfg.name}"
+
+    def _journal_cm(self, client: Client, gang: str,
+                    enc: Optional[str]) -> None:
+        key = f"reclaim.{gang}"
+
+        def mutate(cm):
+            if enc is None:
+                cm.data.pop(key, None)
+            else:
+                cm.data[key] = enc
+
+        try:
+            client.patch("ConfigMap", self._cm_name(),
+                         self.cfg.namespace, mutate)
+        except NotFound:
+            if enc is None:
+                return
+            from nos_tpu.kube.objects import ConfigMap, ObjectMeta
+            try:
+                client.create(ConfigMap(
+                    metadata=ObjectMeta(name=self._cm_name(),
+                                        namespace=self.cfg.namespace),
+                    data={key: enc}))
+            except AlreadyExists:
+                self._journal_cm(client, gang, enc)
+        except Exception:   # noqa: BLE001 — the mirror is accounting
+            pass            # durability, never a crashed reconcile
+
+    def _journal_cm_read(self, client: Client, gang: str
+                         ) -> Optional[dict]:
+        try:
+            cm = client.get("ConfigMap", self._cm_name(),
+                            self.cfg.namespace)
+        except Exception:   # noqa: BLE001 — incl. NotFound
+            return None
+        raw = cm.data.get(f"reclaim.{gang}")
+        if not raw:
+            return None
+        try:
+            return json.loads(raw)
+        except ValueError:
+            return None
+
+    # -- reconcile ------------------------------------------------------
+    def reconcile(self, client: Client, req: Request) -> Result:
+        with tracing.span("harvest.reconcile", component="harvest",
+                          attrs={"harvest": self.cfg.name}) as sp:
+            self._reconcile(client, sp)
+        return Result(requeue_after=self.cfg.reconcile_interval_s)
+
+    def _reconcile(self, client: Client, sp) -> None:
+        cfg = self.cfg
+        now = self.clock()
+        pods = self._harvest_pods(client)
+        gangs = self._gangs(pods)
+
+        # 1. the reclaim protocol: intercept fresh notices, advance
+        #    journaled state machines (idempotent re-entry included)
+        for gang in sorted(gangs):
+            members = gangs[gang]
+            state = self._reclaim_state(members)
+            bound = [m for m in members if m.spec.node_name]
+            if state is None and bound and any(
+                    reclaim_notice_deadline(m) is not None for m in bound):
+                state = self._begin_reclaim(client, gang, members, now)
+            if state is not None:
+                self._advance_reclaim(client, gang, members, state, now)
+
+        # 2. witnessed resume: a gang fully Running with no reclaim in
+        #    flight trains only after the controller has witnessed its
+        #    durable checkpoint step and admitted it explicitly
+        gangs = self._gangs(self._harvest_pods(client))
+        for gang in sorted(gangs):
+            members = gangs[gang]
+            if self._reclaim_state(members) is not None:
+                continue
+            running = [m for m in members if m.status.phase == "Running"]
+            if len(running) < cfg.gang_size or \
+                    not all(m.spec.node_name for m in running):
+                if not running:
+                    self._admitted.discard(gang)
+                continue
+            if gang in self._admitted:
+                continue
+            if not self.trainer.ready(gang, members):
+                continue
+            resume_step = int(self.trainer.durable_step(gang, members))
+            with tracing.span(
+                    "harvest.resume", component="harvest",
+                    parent=tracing.pod_trace_context(members[0]),
+                    attrs={"gang": gang, "from_step": resume_step}):
+                self.trainer.resume(gang, members, resume_step)
+            self._admitted.add(gang)
+            logger.info("harvest %s: gang %s witnessed-resumed from "
+                        "step %d", cfg.name, gang, resume_step)
+
+        # 3. slot maintenance: every configured slot exists (respawn
+        #    vanished gangs PARKED, resume lineage from the witness)
+        for slot in self._slots():
+            if slot in gangs:
+                continue
+            # a reclaim was mid-flight when the gang's pods vanished
+            # wholesale (notice expiry deleted them before any eviction
+            # of ours): account the blunt outcome before the slot is
+            # reborn. The durable ConfigMap journal mirror — not just
+            # this process's memory — says whether one was open, so a
+            # harvester restarted mid-reclaim still files the episode
+            # under its ORIGINAL id and notice step.
+            state = self._journal_cm_read(client, slot)
+            if state is None and slot in self._episodes:
+                state = {"id": "", "t0": now,
+                         # last-known step: the unbanked backlog is the
+                         # fault's cost, and the ledger must attribute
+                         # it there, not to the protocol
+                         "step": int(self.trainer.step(slot, []))}
+            if state is not None:
+                self._finalize(client, slot, [], state, now,
+                               outcome="preempted")
+            resume_step = int(self.trainer.durable_step(slot, []))
+            for w in range(cfg.gang_size):
+                try:
+                    client.create(self._worker_pod(slot, w, resume_step))
+                except AlreadyExists:
+                    pass
+            logger.info("harvest %s: gang %s parked (resume step %d)",
+                        cfg.name, slot, resume_step)
+
+        # 4. launch decision: release ONE parked gang when the pool's
+        #    quota slack has covered a whole gang for launch_stable_s
+        #    and nothing guaranteed is waiting
+        pods = self._harvest_pods(client)
+        gangs = self._gangs(pods)
+        view = QuotaView(build_quota_infos(client, self.calc),
+                         cfg.namespace)
+        pressure = view.reclaim_pressure(client, cfg.resource, self.calc)
+        reclaiming = any(self._reclaim_state(m) is not None
+                         for m in gangs.values())
+        noticed = any(reclaim_notice_deadline(p) is not None for p in pods)
+        planned = sum(
+            self.calc.compute_pod_request(p).get(cfg.resource, 0.0)
+            for p in pods
+            if not p.spec.node_name and not p.metadata.annotations.get(
+                constants.ANNOTATION_SCHEDULING_HOLD))
+        slack = view.headroom(cfg.resource, {cfg.resource: planned})
+        gang_chips = cfg.gang_size * cfg.chips_per_worker
+        parked = sorted(
+            gang for gang, members in gangs.items()
+            if any(m.metadata.annotations.get(
+                constants.ANNOTATION_SCHEDULING_HOLD) for m in members))
+        can_release = (parked and pressure <= 0 and not reclaiming
+                       and not noticed and slack >= gang_chips)
+        if can_release:
+            if self._slack_since is None:
+                self._slack_since = now
+            elif now - self._slack_since >= cfg.launch_stable_s:
+                self._release_gang(client, parked[0], gangs[parked[0]])
+                self._slack_since = None     # re-sustain for the next
+        else:
+            self._slack_since = None
+
+        # 5. gauges + snapshot
+        states = self._gang_states(gangs)
+        for state in GANG_STATES:
+            self.g_gangs.labels(state).set(
+                sum(1 for s in states.values() if s == state))
+        borrowed = sum(
+            self.calc.compute_pod_request(p).get(cfg.resource, 0.0)
+            for p in pods if p.spec.node_name)
+        self.g_borrowed.set(borrowed)
+        sp.set_attr("gangs", len(gangs))
+        sp.set_attr("borrowed_chips", borrowed)
+        self._last = {
+            "harvest": cfg.name,
+            "namespace": cfg.namespace,
+            "gangs": dict(sorted(states.items())),
+            "borrowed_chips": borrowed,
+            "quota": {
+                "slack_chips": (slack if slack != float("inf") else None),
+                "reclaim_pressure_chips": pressure,
+            },
+            "reclaims": {
+                "total": len(self._ledger),
+                "by_outcome": {
+                    o: sum(1 for r in self._ledger
+                           if r["outcome"] == o) for o in OUTCOMES},
+                "steps_lost_total": sum(r["steps_lost"]
+                                        for r in self._ledger),
+                "last": (self._ledger[-1] if self._ledger else None),
+            },
+        }
+
+    def _gang_states(self, gangs: Dict[str, List[Pod]]) -> Dict[str, str]:
+        out: Dict[str, str] = {}
+        for gang, members in gangs.items():
+            if self._reclaim_state(members) is not None:
+                out[gang] = "reclaiming"
+            elif any(m.metadata.annotations.get(
+                    constants.ANNOTATION_SCHEDULING_HOLD)
+                    for m in members):
+                out[gang] = "parked"
+            elif all(m.status.phase == "Running" for m in members) \
+                    and len(members) >= self.cfg.gang_size:
+                out[gang] = "running"
+            elif any(m.spec.node_name for m in members):
+                out[gang] = "binding"
+            else:
+                out[gang] = "pending"
+        return out
+
+    # -- the reclaim protocol -------------------------------------------
+    def _begin_reclaim(self, client: Client, gang: str,
+                       members: List[Pod], now: float) -> dict:
+        """Intercept the scheduler's reclaim notice: journal phase
+        ``checkpoint`` with the bounded deadline, then ask the trainer
+        for an async checkpoint of the current step."""
+        cfg = self.cfg
+        deadline = now + cfg.checkpoint_budget_s
+        notice = min((d for d in (reclaim_notice_deadline(m)
+                                  for m in members) if d is not None),
+                     default=None)
+        if notice is not None:
+            deadline = min(deadline, notice)
+        state = {
+            "id": f"{gang}@{round(now, 3)}",
+            "gang": gang,
+            "phase": "checkpoint",
+            "deadline": round(deadline, 3),
+            "step": int(self.trainer.step(gang, members)),
+            "t0": round(now, 3),
+        }
+        self._stamp_state(client, members, state)
+        self.trainer.request_checkpoint(gang, members)
+        ep = tracing.start_span(
+            "harvest.reclaim", component="harvest",
+            attrs={"gang": gang, "id": state["id"],
+                   "notice_step": state["step"]},
+            start_time=now)
+        self._episodes[gang] = ep
+        self._phase_spans[gang] = tracing.start_span(
+            "harvest.checkpoint", component="harvest", parent=ep,
+            attrs={"gang": gang, "budget_s":
+                   round(deadline - now, 3)},
+            start_time=now)
+        logger.info(
+            "harvest %s: reclaim notice intercepted for gang %s — "
+            "checkpointing step %d with %.1fs budget", cfg.name, gang,
+            state["step"], deadline - now)
+        return state
+
+    def _episode(self, gang: str, state: dict, now: float):
+        """The open reclaim-episode span (recreated with a marker after
+        a controller restart — the journal survives, in-memory spans do
+        not)."""
+        ep = self._episodes.get(gang)
+        if ep is None:
+            ep = tracing.start_span(
+                "harvest.reclaim", component="harvest",
+                attrs={"gang": gang, "id": state.get("id", ""),
+                       "reentered": True},
+                start_time=now)
+            self._episodes[gang] = ep
+        return ep
+
+    def _enter_phase(self, gang: str, phase: str, ep, now: float) -> None:
+        prev = self._phase_spans.pop(gang, None)
+        if prev is not None:
+            prev.end(now)
+        self._phase_spans[gang] = tracing.start_span(
+            f"harvest.{phase}", component="harvest", parent=ep,
+            attrs={"gang": gang}, start_time=now)
+
+    def _advance_reclaim(self, client: Client, gang: str,
+                         members: List[Pod], state: dict,
+                         now: float) -> None:
+        # re-read every member: the caller's listing predates this
+        # pass's own journal stamps (begin_reclaim in the same pass —
+        # the reclaim-races-a-scale-up case), and acting on a stale
+        # journal view is how a reclaim could finalize without evicting
+        # and then finalize again
+        fresh: List[Pod] = []
+        for m in members:
+            try:
+                fresh.append(client.get("Pod", m.metadata.name,
+                                        m.metadata.namespace))
+            except NotFound:
+                continue
+        members = [m for m in fresh if m.status.phase in _ALIVE]
+        ep = self._episode(gang, state, now)
+        bound = [m for m in members if m.spec.node_name]
+        journaled = [m for m in members if m.metadata.annotations.get(
+            constants.ANNOTATION_HARVEST_RECLAIM)]
+        phase = state["phase"]
+
+        if phase == "checkpoint":
+            if not bound:
+                # the chips are already gone (scheduler notice expiry,
+                # node death routed through slice repair): nothing left
+                # to checkpoint or evict — repark any recreated members
+                # (clearing the journal so this finalizes exactly once)
+                # and account the preempted outcome
+                durable = int(self.trainer.durable_step(gang, members))
+                for m in journaled:
+                    try:
+                        client.patch("Pod", m.metadata.name,
+                                     m.metadata.namespace,
+                                     self._park(durable))
+                    except NotFound:
+                        pass
+                self._finalize(client, gang, members, state, now,
+                               outcome="preempted")
+                return
+            durable = int(self.trainer.durable_step(gang, members))
+            if durable >= state["step"]:
+                state = dict(state, phase="fence", outcome="graceful")
+            elif now >= state["deadline"]:
+                state = dict(state, phase="fence", outcome="forced")
+                logger.warning(
+                    "harvest %s: checkpoint budget exhausted for gang "
+                    "%s (durable %d < notice step %d) — forcing the "
+                    "gang-evict", self.cfg.name, gang, durable,
+                    state["step"])
+            else:
+                return                       # keep waiting out the budget
+            self._stamp_state(client, journaled, state)
+            self._enter_phase(gang, "fence", ep, now)
+            phase = "fence"
+
+        if phase == "fence":
+            # journal BEFORE acting: re-entry repeats the (idempotent)
+            # fence rather than skipping it
+            state = dict(state, phase="evict")
+            self._stamp_state(client, journaled, state)
+            self.trainer.fence(gang, members)
+            self._enter_phase(gang, "gang_evict", ep, now)
+            phase = "evict"
+
+        if phase == "evict":
+            self.trainer.fence(gang, members)    # re-entry cover
+            durable = int(self.trainer.durable_step(gang, members))
+            lost = max(0, int(self.trainer.step(gang, members)) - durable)
+            for m in journaled:
+                if m.spec.node_name:
+                    # the lifecycle eviction machinery: delete +
+                    # recreate Pending, reparked with the resume step
+                    evict_pod(client, m, "quota_reclaim",
+                              clock=self.clock, episode=ep,
+                              component="harvest",
+                              mutate_recreated=self._park(durable))
+                else:
+                    # already recreated unbound by someone else (slice
+                    # repair preserves annotations): just repark it —
+                    # deleting it again would be the double-evict this
+                    # journal exists to prevent
+                    try:
+                        client.patch("Pod", m.metadata.name,
+                                     m.metadata.namespace,
+                                     self._park(durable))
+                    except NotFound:
+                        pass
+            self._finalize(client, gang, members, state, now,
+                           outcome=state.get("outcome", "graceful"),
+                           steps_lost=lost, resume_step=durable)
+
+    def _park(self, durable: int):
+        """The recreate/repark mutation: strip every transient
+        reclaim-protocol mark, hold the pod back from the scheduler,
+        stamp the witnessed resume step."""
+        from nos_tpu.harvest import trainer as tseam
+
+        def mutate(p: Pod):
+            anns = p.metadata.annotations
+            anns.pop(constants.ANNOTATION_HARVEST_RECLAIM, None)
+            anns.pop(constants.ANNOTATION_RECLAIM_NOTICE, None)
+            anns.pop(tseam.ANNOTATION_FENCE, None)
+            anns.pop(tseam.ANNOTATION_CHECKPOINT_REQUEST, None)
+            anns[constants.ANNOTATION_SCHEDULING_HOLD] = "harvest-parked"
+            anns[constants.ANNOTATION_HARVEST_RESUME_STEP] = \
+                str(int(durable))
+
+        return mutate
+
+    def _finalize(self, client: Client, gang: str, members: List[Pod],
+                  state: dict, now: float, outcome: str,
+                  steps_lost: Optional[int] = None,
+                  resume_step: Optional[int] = None) -> None:
+        if resume_step is None:
+            resume_step = int(self.trainer.durable_step(gang, members))
+        if steps_lost is None:
+            steps_lost = max(
+                0, int(self.trainer.step(gang, members)) - resume_step)
+        self.m_reclaims.labels(outcome).inc()
+        self.m_steps_lost.inc(steps_lost)
+        duration = max(0.0, now - float(state.get("t0", now)))
+        self.h_reclaim.observe(duration)
+        self._ledger.append({
+            "id": state.get("id", ""),
+            "gang": gang,
+            "outcome": outcome,
+            "steps_lost": steps_lost,
+            "notice_step": state.get("step", 0),
+            "resume_step": resume_step,
+            "duration_s": round(duration, 3),
+        })
+        self._admitted.discard(gang)
+        self._journal_cm(client, gang, None)     # episode accounted
+        psp = self._phase_spans.pop(gang, None)
+        if psp is not None:
+            psp.end(now)
+        ep = self._episodes.pop(gang, None)
+        if ep is not None:
+            if ep.recording:
+                ep.set_attr("outcome", outcome)
+                ep.set_attr("steps_lost", steps_lost)
+            ep.end(now)
+        logger.info(
+            "harvest %s: reclaim of gang %s finalized (%s, %d steps "
+            "lost, %.1fs)", self.cfg.name, gang, outcome, steps_lost,
+            duration)
+
+    # -- launch ---------------------------------------------------------
+    def _release_gang(self, client: Client, gang: str,
+                      members: List[Pod]) -> None:
+        """Strip the scheduling hold: from here gang admission's
+        all-or-nothing placement decides when the gang actually binds."""
+        def mutate(p: Pod):
+            p.metadata.annotations.pop(
+                constants.ANNOTATION_SCHEDULING_HOLD, None)
+
+        with tracing.span("harvest.launch", component="harvest",
+                          attrs={"gang": gang,
+                                 "members": len(members)}):
+            for m in members:
+                try:
+                    client.patch("Pod", m.metadata.name,
+                                 m.metadata.namespace, mutate)
+                except NotFound:
+                    continue
+        logger.info("harvest %s: released gang %s to the scheduler",
+                    self.cfg.name, gang)
+
+    # -- plumbing -------------------------------------------------------
+    def stats(self) -> dict:
+        """Live snapshot for the HealthServer's /stats route."""
+        return dict(self._last)
+
+    def ledger(self) -> List[dict]:
+        """Finalized reclaim records (tests/benches read the outcomes,
+        steps lost and durations here)."""
+        return list(self._ledger)
+
+    def controller(self) -> Controller:
+        req = Request(name=self.cfg.name, namespace=self.cfg.namespace)
+
+        def to_harvest(_ev) -> List[Request]:
+            return [req]
+
+        ctl = Controller(
+            f"harvest/{self.cfg.name}",
+            self.reconcile,
+            [
+                # pod churn carries the reclaim notices and bind/evict
+                # transitions; quota churn re-sizes the launch decision
+                Watch("Pod", mapper=to_harvest),
+                Watch("ElasticQuota", mapper=to_harvest),
+                Watch("CompositeElasticQuota", mapper=to_harvest),
+            ],
+        )
+        # self-seed like the fleet controller: an empty cluster emits no
+        # initial-sync events but the slots must still be parked
+        ctl.enqueue(req)
+        return ctl

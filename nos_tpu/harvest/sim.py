@@ -1,0 +1,266 @@
+"""Deterministic discrete-time training-plane model.
+
+``bench_cluster.py`` and the harvest tests need a training data plane
+that (a) speaks the harvest controller's trainer seam exactly
+(harvest/trainer.py), (b) models the async-checkpoint discipline the
+real orbax path has — stepping continues during a save, a save becomes
+durable only when it COMMITS, a killed slice loses its in-flight save —
+and (c) is bit-reproducible under a FakeClock:
+
+- ``SimTrainer``        — per-gang step counters advancing while the
+  gang is attached AND admitted (witnessed-resumed) AND unfenced, an
+  auto-checkpoint cadence (``ckpt_interval_s``, committing
+  ``ckpt_duration_s`` later), on-demand checkpoints for the reclaim
+  protocol, and a ``durable`` registry that plays the role of shared
+  storage: it survives detach (the checkpoint outlives the slice), and
+  it is what ``durable_step`` — the harvester's witness — reads.
+  Chaos hooks: ``hang_checkpoints`` wedges every future save (the
+  degradation ladder's forced path), ``kill`` drops a gang as a dead
+  node would (in-flight save lost).
+- ``SimHarvestKubelet`` — the pod <-> trainer bridge: bound gang pods
+  become Running after a provisioning delay, a fully-Running gang
+  attaches to the trainer, a gang losing any member detaches (steps
+  freeze, admission revoked — the next witnessed resume re-admits).
+
+Everything advances on ``tick(dt)``; nothing reads the wall clock.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Callable, Dict, List, Optional
+
+from nos_tpu import constants
+from nos_tpu.kube.client import Client
+
+__all__ = ["SimHarvestKubelet", "SimTrainer"]
+
+
+@dataclass
+class _GangState:
+    step: float = 0.0
+    attached: bool = False
+    admitted: bool = False
+    fenced: bool = False
+    hung: bool = False
+    # in-flight checkpoint: (captured step, commit time); None = idle
+    ckpt: Optional[tuple] = None
+    # a reclaim-requested checkpoint queued behind an in-flight save
+    queued: bool = False
+    next_auto: float = 0.0
+    reattaches: int = 0
+
+
+class SimTrainer:
+    """The trainer seam's deterministic model; see module docstring."""
+
+    def __init__(self, clock: Callable[[], float],
+                 step_rate: float = 1.0,
+                 ckpt_interval_s: float = 60.0,
+                 ckpt_duration_s: float = 5.0,
+                 tokens_per_step: int = 2048):
+        self.clock = clock
+        self.step_rate = step_rate
+        self.ckpt_interval_s = ckpt_interval_s
+        self.ckpt_duration_s = ckpt_duration_s
+        self.tokens_per_step = tokens_per_step
+        self._gangs: Dict[str, _GangState] = {}
+        #: the "shared storage": gang -> last durably committed step.
+        #: Survives detach/kill — exactly what a real checkpoint dir does.
+        self.durable: Dict[str, int] = {}
+        self.checkpoints_committed = 0
+        self.checkpoints_lost = 0
+
+    def _state(self, gang: str) -> _GangState:
+        return self._gangs.setdefault(gang, _GangState())
+
+    # -- kubelet bridge -------------------------------------------------
+    def attach(self, gang: str) -> None:
+        st = self._state(gang)
+        if not st.attached:
+            st.attached = True
+            st.reattaches += 1
+            # a fresh slice starts from the durable lineage and does NOT
+            # step until the harvester witnesses that lineage and
+            # resumes it (the witnessed-resume gate)
+            st.step = float(self.durable.get(gang, 0))
+            st.admitted = False
+            st.fenced = False
+            st.ckpt = None
+            st.queued = False
+
+    def detach(self, gang: str) -> None:
+        st = self._gangs.get(gang)
+        if st is None or not st.attached:
+            return
+        st.attached = False
+        st.admitted = False
+        st.fenced = False
+        if st.ckpt is not None:
+            self.checkpoints_lost += 1       # the save died with the slice
+            st.ckpt = None
+        st.queued = False
+
+    def kill(self, gang: str) -> None:
+        """Node-death semantics: the slice is gone NOW, any in-flight
+        save is lost (orbax commits atomically — a torn save is no
+        save)."""
+        self.detach(gang)
+
+    # -- chaos ----------------------------------------------------------
+    def hang_checkpoints(self, gang: str, hung: bool = True) -> None:
+        """Wedge every current and future save of ``gang`` (the forced
+        arm of the degradation ladder)."""
+        st = self._state(gang)
+        st.hung = hung
+
+    # -- time -----------------------------------------------------------
+    def tick(self, dt: float) -> None:
+        now = self.clock()
+        for gang in sorted(self._gangs):
+            st = self._gangs[gang]
+            # commit an in-flight save that has run its duration
+            if st.ckpt is not None and not st.hung \
+                    and now >= st.ckpt[1]:
+                self.durable[gang] = max(
+                    self.durable.get(gang, 0), int(st.ckpt[0]))
+                st.ckpt = None
+                self.checkpoints_committed += 1
+                if st.queued and st.attached:
+                    st.queued = False
+                    self._begin_ckpt(gang, st)
+            if not (st.attached and st.admitted and not st.fenced):
+                continue
+            st.step += self.step_rate * dt
+            if st.ckpt is None and now + dt >= st.next_auto:
+                self._begin_ckpt(gang, st)
+                st.next_auto = now + dt + self.ckpt_interval_s
+
+    def _begin_ckpt(self, gang: str, st: _GangState) -> None:
+        st.ckpt = (int(st.step), self.clock() + self.ckpt_duration_s)
+
+    # -- the harvester's trainer seam -----------------------------------
+    def ready(self, gang: str, members: List) -> bool:
+        st = self._gangs.get(gang)
+        return st is not None and st.attached
+
+    def step(self, gang: str, members: List) -> int:
+        st = self._gangs.get(gang)
+        if st is None:
+            return self.durable.get(gang, 0)
+        return int(st.step)
+
+    def durable_step(self, gang: str, members: List) -> int:
+        return self.durable.get(gang, 0)
+
+    def request_checkpoint(self, gang: str, members: List) -> None:
+        st = self._gangs.get(gang)
+        if st is None or not st.attached:
+            return
+        if st.ckpt is not None:
+            # an auto save is mid-flight: it captured an OLDER step, so
+            # the reclaim's request queues behind it — graceful needs a
+            # checkpoint at/after the notice step
+            st.queued = True
+            return
+        self._begin_ckpt(gang, st)
+
+    def fence(self, gang: str, members: List) -> None:
+        st = self._gangs.get(gang)
+        if st is not None:
+            st.fenced = True
+
+    def resume(self, gang: str, members: List, from_step: int) -> None:
+        st = self._gangs.get(gang)
+        if st is None or not st.attached:
+            return
+        if st.admitted:
+            return              # idempotent: never rewind a live gang
+        st.step = float(from_step)
+        st.admitted = True
+        st.fenced = False
+        st.next_auto = self.clock() + self.ckpt_interval_s
+
+    # -- accounting -----------------------------------------------------
+    def useful_steps(self) -> int:
+        """Preserved training progress across all gangs: a live admitted
+        gang's current step IS its banked-plus-live lineage; a detached
+        or unadmitted gang is worth exactly its durable checkpoint."""
+        total = 0
+        names = set(self._gangs) | set(self.durable)
+        for gang in names:
+            st = self._gangs.get(gang)
+            if st is not None and st.attached and st.admitted:
+                total += int(st.step)
+            else:
+                total += self.durable.get(gang, 0)
+        return total
+
+    def report(self) -> dict:
+        return {
+            "useful_steps": self.useful_steps(),
+            "trained_tokens": self.useful_steps() * self.tokens_per_step,
+            "checkpoints_committed": self.checkpoints_committed,
+            "checkpoints_lost": self.checkpoints_lost,
+            "durable": dict(sorted(self.durable.items())),
+        }
+
+
+class SimHarvestKubelet:
+    """Bridges harvest gang pods in the API server to SimTrainer gangs:
+    the kubelet role of the simulation. Call ``sync`` once per sim step,
+    AFTER the scheduler has had its chance to bind."""
+
+    def __init__(self, trainer: SimTrainer, clock: Callable[[], float],
+                 harvest_label: str, namespace: str,
+                 startup_s: float = 5.0):
+        self.trainer = trainer
+        self.clock = clock
+        self.harvest_label = harvest_label
+        self.namespace = namespace
+        self.startup_s = startup_s
+        self._bound_at: Dict[str, float] = {}
+        self._attached: set = set()
+
+    def sync(self, client: Client) -> None:
+        now = self.clock()
+        pods = [p for p in client.list(
+            "Pod", namespace=self.namespace,
+            label_selector={constants.LABEL_HARVEST: self.harvest_label})
+            if p.status.phase in ("Pending", "Running")]
+        seen = set()
+        gangs: Dict[str, List] = {}
+        for pod in pods:
+            name = pod.metadata.name
+            seen.add(name)
+            gang = pod.metadata.labels.get(constants.LABEL_GANG_NAME)
+            if gang:
+                gangs.setdefault(gang, []).append(pod)
+            if not pod.is_scheduled():
+                continue
+            if pod.status.phase == "Pending":
+                bound = self._bound_at.setdefault(name, now)
+                if now - bound >= self.startup_s:
+                    client.patch(
+                        "Pod", name, pod.metadata.namespace,
+                        lambda p: setattr(p.status, "phase", "Running"))
+        for name in list(self._bound_at):
+            if name not in seen:
+                del self._bound_at[name]
+        # attach fully-Running gangs; detach any gang losing a member
+        running_gangs = set()
+        for gang, members in gangs.items():
+            size = 0
+            try:
+                size = int(members[0].metadata.labels.get(
+                    constants.LABEL_GANG_SIZE, "0"))
+            except ValueError:
+                pass
+            if size and len(members) >= size and all(
+                    m.status.phase == "Running" and m.spec.node_name
+                    for m in members):
+                running_gangs.add(gang)
+        for gang in sorted(running_gangs - self._attached):
+            self.trainer.attach(gang)
+        for gang in sorted(self._attached - running_gangs):
+            self.trainer.detach(gang)
+        self._attached = running_gangs

@@ -17,7 +17,7 @@ the gap:
                     driving the whole stack on a simulated clock
                     (bench_chaos.py reports detection latency and MTTR).
 """
-from nos_tpu.lifecycle.controller import NodeLifecycleController
+from nos_tpu.lifecycle.controller import NodeLifecycleController, evict_pod
 from nos_tpu.lifecycle.events import (
     NodeHeartbeat,
     maintenance_start,
@@ -28,6 +28,7 @@ from nos_tpu.lifecycle.events import (
 
 __all__ = [
     "NodeLifecycleController",
+    "evict_pod",
     "NodeHeartbeat",
     "maintenance_start",
     "preemption_deadline",

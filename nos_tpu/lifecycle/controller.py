@@ -72,6 +72,66 @@ def _requests_tpu(pod: Pod) -> bool:
     )
 
 
+def evict_pod(client: Client, pod: Pod, reason: str, *,
+              clock: Callable[[], float] = time.time,
+              episode=None, component: str = "lifecycle",
+              mutate_recreated: Optional[Callable[[Pod], None]] = None,
+              ) -> None:
+    """THE eviction step of the stack: delete ``pod`` and recreate it as
+    a fresh Pending pod (this is the JobSet-repair half — in kube terms,
+    the eviction plus the owning controller's replacement create, folded
+    into one idempotent step). The recreate clears the bind and identity
+    fields; labels/annotations survive so gang membership does — and so
+    does the nos-tpu/trace-context annotation, which is what lands the
+    rebind in the same journey trace as the eviction. Shared by the node
+    lifecycle controller's slice repair and the harvest controller's
+    quota-reclaim gang-evict (``mutate_recreated`` lets the harvester
+    park the fresh pod under a scheduling hold and stamp its
+    resume-step; the transient reclaim annotations are its to strip)."""
+    evict_sp = trace.start_span(
+        "lifecycle.evict", component=component,
+        parent=trace.pod_trace_context(pod),
+        attrs={"pod": f"{pod.metadata.namespace}/{pod.metadata.name}",
+               "reason": reason, "node": pod.spec.node_name or ""},
+        start_time=clock())
+    if episode is not None and getattr(episode, "recording", False):
+        evict_sp.set_attr("episode_trace_id", episode.trace_id)
+    try:
+        client.delete("Pod", pod.metadata.name, pod.metadata.namespace)
+    except NotFound:
+        pass
+    anns = dict(pod.metadata.annotations)
+    try:
+        restarts = int(anns.get(
+            constants.ANNOTATION_LIFECYCLE_RESTARTS, "0")) + 1
+    except ValueError:
+        restarts = 1
+    anns[constants.ANNOTATION_LIFECYCLE_RESTARTS] = str(restarts)
+    fresh = Pod(
+        metadata=ObjectMeta(
+            name=pod.metadata.name,
+            namespace=pod.metadata.namespace,
+            labels=dict(pod.metadata.labels),
+            annotations=anns,
+            # keep ownership: on a real cluster the gang pod belongs
+            # to its JobSet controller, and stripping the refs would
+            # both orphan it and misclassify it downstream
+            # (utils/pod.is_owned_by_daemonset_or_node and friends)
+            owner_references=deep_copy(pod.metadata.owner_references),
+        ),
+        spec=deep_copy(pod.spec),
+        status=PodStatus(phase="Pending"),
+    )
+    fresh.spec.node_name = ""
+    if mutate_recreated is not None:
+        mutate_recreated(fresh)
+    try:
+        client.create(fresh)
+    except AlreadyExists:
+        pass   # a racing reconcile already recreated it
+    evict_sp.end(clock())
+
+
 class NodeLifecycleController:
     """One reconciler over (Node, node Lease) pairs; see module docstring.
 
@@ -441,60 +501,14 @@ class NodeLifecycleController:
 
     def _evict_one(self, client: Client, pod: Pod, reason: str,
                    evicted: Set[Tuple[str, str]], episode=None) -> None:
-        """Delete + recreate as a fresh Pending pod (this controller is
-        the stack's JobSet-repair half: in kube terms, the eviction plus
-        the owning controller's replacement create, folded into one
-        idempotent step). The recreate clears the bind and identity
-        fields; labels/annotations survive so gang membership does —
-        and so does the nos-tpu/trace-context annotation, which is what
-        lands the rebind in the same journey trace as the eviction."""
+        """Slice repair's use of the shared ``evict_pod`` step, told in
+        the POD's journey trace (the annotation context stamped at quota
+        admission), cross-linked to the node's repair-episode trace."""
         key = (pod.metadata.namespace, pod.metadata.name)
         if key in evicted:
             return
         evicted.add(key)
-        # the eviction, told in the POD's journey trace (the annotation
-        # context stamped at quota admission), cross-linked to the
-        # node's repair-episode trace
-        evict_sp = trace.start_span(
-            "lifecycle.evict", component="lifecycle",
-            parent=trace.pod_trace_context(pod),
-            attrs={"pod": f"{pod.metadata.namespace}/{pod.metadata.name}",
-                   "reason": reason, "node": pod.spec.node_name or ""},
-            start_time=self.clock())
-        if episode is not None and getattr(episode, "recording", False):
-            evict_sp.set_attr("episode_trace_id", episode.trace_id)
-        try:
-            client.delete("Pod", pod.metadata.name, pod.metadata.namespace)
-        except NotFound:
-            pass
-        anns = dict(pod.metadata.annotations)
-        try:
-            restarts = int(anns.get(
-                constants.ANNOTATION_LIFECYCLE_RESTARTS, "0")) + 1
-        except ValueError:
-            restarts = 1
-        anns[constants.ANNOTATION_LIFECYCLE_RESTARTS] = str(restarts)
-        fresh = Pod(
-            metadata=ObjectMeta(
-                name=pod.metadata.name,
-                namespace=pod.metadata.namespace,
-                labels=dict(pod.metadata.labels),
-                annotations=anns,
-                # keep ownership: on a real cluster the gang pod belongs
-                # to its JobSet controller, and stripping the refs would
-                # both orphan it and misclassify it downstream
-                # (utils/pod.is_owned_by_daemonset_or_node and friends)
-                owner_references=deep_copy(pod.metadata.owner_references),
-            ),
-            spec=deep_copy(pod.spec),
-            status=PodStatus(phase="Pending"),
-        )
-        fresh.spec.node_name = ""
-        try:
-            client.create(fresh)
-        except AlreadyExists:
-            pass   # a racing reconcile already recreated it
-        evict_sp.end(self.clock())
+        evict_pod(client, pod, reason, clock=self.clock, episode=episode)
         obs.LIFECYCLE_EVICTED_PODS.labels(reason).inc()
 
     # ------------------------------------------------------------------

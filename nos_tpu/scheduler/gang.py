@@ -109,6 +109,55 @@ def jobset_slice(pod: Pod) -> Optional[int]:
         return None
 
 
+# ---------------------------------------------------------------------------
+# Gang-level quota-reclaim notices (the pod analog of the node-level
+# preemption notice in lifecycle/events.py): capacity preemption with a
+# grace window stamps a deadline on every member of a victim gang
+# instead of deleting it, so a notice-aware controller (the harvester)
+# can bank progress — checkpoint, fence, gang-evict — before the chips
+# are taken. Values are wall-clock seconds (the one cross-host clock
+# domain, same rule as the node notices).
+
+
+def reclaim_notice_deadline(pod: Pod) -> Optional[float]:
+    """The gang's reclaim-notice deadline, or None when un-noticed /
+    malformed (a bad annotation must never break scheduling)."""
+    raw = pod.metadata.annotations.get(constants.ANNOTATION_RECLAIM_NOTICE)
+    if not raw:
+        return None
+    try:
+        return float(raw)
+    except ValueError:
+        return None
+
+
+def stamp_reclaim_notice(client, pods: List[Pod], deadline: float) -> None:
+    """Idempotently stamp the reclaim notice on every gang member. An
+    already-noticed member keeps its ORIGINAL deadline — re-selection by
+    a later preemption pass must not push the eviction out forever."""
+    from nos_tpu.kube.apiserver import NotFound
+
+    for pod in pods:
+        if reclaim_notice_deadline(pod) is not None:
+            continue
+
+        def mutate(p: Pod):
+            # keep only a VALID existing deadline; a malformed value
+            # must be overwritten, or the deferral loop would re-derive
+            # "no notice yet" forever and the preemptor would starve
+            # behind a gang that never becomes evictable
+            if reclaim_notice_deadline(p) is None:
+                p.metadata.annotations[
+                    constants.ANNOTATION_RECLAIM_NOTICE] = \
+                    repr(float(deadline))
+
+        try:
+            client.patch("Pod", pod.metadata.name,
+                         pod.metadata.namespace, mutate)
+        except NotFound:
+            continue        # vanished under the notice: nothing to stamp
+
+
 @dataclass(frozen=True)
 class GangAdmission:
     """Typed admission verdict. Iterable as (ok, reason) for the common

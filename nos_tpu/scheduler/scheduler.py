@@ -24,7 +24,10 @@ from nos_tpu.obs import tracing as trace
 from nos_tpu.scheduler import framework as fw
 from nos_tpu.scheduler.cache import ClusterCache
 from nos_tpu.scheduler.capacity import CapacityScheduling
-from nos_tpu.scheduler.gang import GangScheduler, gang_key, jobset_key
+from nos_tpu.scheduler.gang import (
+    GangScheduler, gang_key, jobset_key, reclaim_notice_deadline,
+    stamp_reclaim_notice,
+)
 from nos_tpu.tpu.resource_calc import ResourceCalculator
 
 logger = logging.getLogger(__name__)
@@ -37,8 +40,19 @@ class Scheduler:
         calculator: Optional[ResourceCalculator] = None,
         extra_plugins: Optional[list] = None,
         use_index: Optional[bool] = None,
+        reclaim_grace_s: float = 0.0,
+        clock=time.time,
     ):
         self.scheduler_name = scheduler_name
+        # gang-eviction grace: when > 0, preemption of a GANG victim unit
+        # first stamps a reclaim notice (deadline = now + grace) on its
+        # members and defers the deletion until the deadline passes — the
+        # window a notice-aware controller (nos_tpu/harvest) uses to run
+        # checkpoint -> fence -> gang-evict instead of losing work. 0
+        # preserves the immediate-delete behavior. The clock shares the
+        # node-notice wall-clock domain; tests/benches inject a FakeClock.
+        self.reclaim_grace_s = reclaim_grace_s
+        self.clock = clock
         self.calc = calculator or ResourceCalculator()
         self.capacity = CapacityScheduling(self.calc)
         self.framework = fw.SchedulerFramework(
@@ -117,6 +131,8 @@ class Scheduler:
                 and pod.spec.scheduler_name == self.scheduler_name
                 and not pod.spec.node_name
                 and pod.status.phase == "Pending"
+                and not pod.metadata.annotations.get(
+                    constants.ANNOTATION_SCHEDULING_HOLD)
             ):
                 first = pod
             elif not self._retry_pending:
@@ -147,6 +163,11 @@ class Scheduler:
                     and not p.spec.node_name
                     and p.status.phase == "Pending"
                     and (p.metadata.namespace, p.metadata.name) != me
+                    # scheduling gate (kube schedulingGates analog): a
+                    # held pod is parked demand, not a placement ask —
+                    # the harvester strips the hold to relaunch
+                    and not p.metadata.annotations.get(
+                        constants.ANNOTATION_SCHEDULING_HOLD)
                 )
             ]
             for pod in pods:
@@ -165,6 +186,14 @@ class Scheduler:
                         seen_gangs.add(gk)
                 r = self._schedule_one(client, pod, snapshot)
                 result.requeue = result.requeue or r.requeue
+                if r.requeue_after is not None:
+                    # a deferred preemption (reclaim-notice grace) paces
+                    # its retry by the notice deadline; the batch result
+                    # keeps the soonest one
+                    result.requeue_after = (
+                        r.requeue_after
+                        if result.requeue_after is None
+                        else min(result.requeue_after, r.requeue_after))
         except BaseException:
             # incomplete pass: the controller's error-requeue must not be
             # swallowed by the generation guard on redelivery
@@ -177,8 +206,12 @@ class Scheduler:
         self._batch_gen = self.cache.generation
         # a preemption nominated someone: the retry must survive even if
         # this request's own pod is bound by then (reconcile honors
-        # _retry_pending before the generation check)
-        self._retry_pending = bool(result.requeue)
+        # _retry_pending before the generation check). A DEFERRED
+        # preemption (reclaim-notice grace) must survive it too: the
+        # clock ticking toward the notice deadline changes no cache
+        # generation, and the expiry retry is the deletion's only ride.
+        self._retry_pending = bool(result.requeue) \
+            or result.requeue_after is not None
         # stamps not applied by now referenced THIS pass's attempt spans;
         # a later attempt roots (and stamps) a fresh journey, so dropping
         # the leftovers keeps the map from accumulating deleted pods
@@ -483,6 +516,50 @@ class Scheduler:
         return Result()
 
     # ------------------------------------------------------------------
+    def _defer_noticed_gangs(self, client, victims) -> Optional[float]:
+        """The reclaim-notice half of gang preemption: with a grace
+        window configured, victim GANG members are stamped with a
+        ``nos.ai/reclaim-notice-deadline`` annotation (now + grace) on
+        first selection instead of being deleted, and the whole
+        preemption defers while any stamped gang's deadline is in the
+        future. Returns seconds until the soonest deadline when the
+        deletion must wait, None when every victim is deletable now
+        (no grace, no gangs, or every notice expired). Non-gang victims
+        never defer — the notice is gang-eviction semantics (a training
+        slice is one atomic failure domain; half a gang buys nothing)."""
+        if self.reclaim_grace_s <= 0:
+            return None
+        now = self.clock()
+        waits = []
+        by_gang: dict = {}
+        for v in victims:
+            gk = gang_key(v)
+            if gk is not None:
+                by_gang.setdefault(gk, []).append(v)
+        for gk, members in sorted(by_gang.items(),
+                                  key=lambda kv: (kv[0].namespace,
+                                                  kv[0].name)):
+            deadline = next(
+                (d for d in (reclaim_notice_deadline(m) for m in members)
+                 if d is not None), None)
+            if deadline is None:
+                deadline = now + self.reclaim_grace_s
+                stamp_reclaim_notice(client, members, deadline)
+                for m in members:
+                    try:
+                        self.cache.upsert("Pod", client.get(
+                            "Pod", m.metadata.name,
+                            m.metadata.namespace))
+                    except NotFound:
+                        continue    # vanished under the stamp: fine
+                logger.info(
+                    "reclaim notice: gang %s/%s has %.1fs to bank "
+                    "progress before eviction", gk.namespace, gk.name,
+                    self.reclaim_grace_s)
+            if deadline > now:
+                waits.append(deadline - now)
+        return min(waits) if waits else None
+
     def _record_disruptions(self, client, victims) -> None:
         """Before deleting victims, record them in every matching PDB's
         ``status.disrupted_pods`` (the eviction-API side effect kube's
@@ -528,6 +605,21 @@ class Scheduler:
             psp.set_attr("nominated", nominated or "")
             psp.set_attr("victims", len(victims))
         if post_st.success and nominated is not None:
+            deferred = self._defer_noticed_gangs(client, victims)
+            if deferred is not None:
+                # at least one victim GANG is inside its reclaim-notice
+                # grace window: delete nothing this attempt (a partial
+                # delete would break the victim set's fit math), leave
+                # the preemptor unschedulable, and retry at the soonest
+                # deadline — by then the notice-aware controller has
+                # evicted the gang gracefully, or the expiry path below
+                # deletes it
+                obs.SCHEDULE_ATTEMPTS.labels("reclaim_notice").inc()
+                self._mark_unschedulable(
+                    client, pod,
+                    "waiting for gang reclaim notice "
+                    f"({deferred:.1f}s remaining)")
+                return Result(requeue_after=max(0.1, deferred))
             self._record_disruptions(client, victims)
             for v in victims:
                 try:

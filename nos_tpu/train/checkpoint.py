@@ -50,6 +50,27 @@ def model_arch_dict(cfg) -> dict:
     return out
 
 
+def latest_step(directory: str) -> Optional[int]:
+    """The last durably committed step under ``directory`` — orbax's
+    ``latest_step`` without constructing a full manager, so cheap enough
+    to poll. This is the harvest controller's WITNESS
+    (nos_tpu/harvest/trainer.py): a quota-reclaim resume is gated on a
+    checkpoint the harvester can SEE in shared storage, never on a
+    training process's claim. None when nothing is committed (or the
+    directory does not exist yet)."""
+    import orbax.checkpoint as ocp
+    from etils import epath
+
+    path = epath.Path(directory)
+    try:
+        if not path.exists():
+            return None
+        steps = ocp.utils.checkpoint_steps(path)
+    except Exception:       # pragma: no cover - storage-layer variance
+        return None
+    return max(steps) if steps else None
+
+
 class CheckpointManager:
     """Step-numbered train-state checkpoints under one directory."""
 
@@ -95,6 +116,35 @@ class CheckpointManager:
 
     def wait_until_finished(self) -> None:
         self.manager.wait_until_finished()
+
+    def wait_within(self, timeout_s: float) -> bool:
+        """Budget-bounded fence for an in-flight async save: True when
+        the background commit finished inside ``timeout_s``. The
+        reclaim-notice discipline (nos_tpu/harvest): a training job told
+        to bank progress waits only as long as the checkpoint budget —
+        a hung save must not hold the gang past its eviction deadline
+        (orbax's own ``wait_until_finished`` blocks unboundedly, so the
+        bound rides a waiter thread). ONE waiter per manager: a timed-out
+        waiter is still parked inside ``wait_until_finished``, and a
+        later call re-joins it instead of stacking a second thread into
+        the same (not thread-safe) orbax wait."""
+        import threading
+
+        t = getattr(self, "_waiter", None)
+        if t is None or not t.is_alive():
+            done = threading.Event()
+
+            def waiter():
+                try:
+                    self.manager.wait_until_finished()
+                finally:
+                    done.set()
+
+            t = threading.Thread(target=waiter, daemon=True)
+            self._waiter = t
+            self._waiter_done = done
+            t.start()
+        return self._waiter_done.wait(timeout=max(0.0, timeout_s))
 
     # ------------------------------------------------------------------
     # model-config stamp: architecture dims written next to the step

@@ -1,0 +1,93 @@
+"""Slow-marked smoke of bench_cluster.py (ISSUE 12 CI satellite): the
+one-pool-two-planes bench path must not rot. Runs the real script in
+NOS_TPU_BENCH_SMOKE=1 mode in a subprocess, pins the artifact shape and
+the structural acceptance invariants — the harvested single pool beats
+two statically segregated clusters on useful-work-per-chip-hour with
+serving goodput no worse than the unharvested fleet, zero displaced
+serving requests, reclaim losses within the checkpoint-interval bound —
+and bit-reproducibility at the fixed seed (a second run produces a
+byte-identical artifact)."""
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+ARTIFACT = os.path.join(REPO, "bench_logs", "bench_cluster.json")
+
+
+def run_bench():
+    env = dict(os.environ, NOS_TPU_BENCH_SMOKE="1", JAX_PLATFORMS="cpu")
+    proc = subprocess.run(
+        [sys.executable, "bench_cluster.py"], cwd=REPO, env=env,
+        capture_output=True, text=True, timeout=600)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    return json.loads(proc.stdout.strip().splitlines()[-1])
+
+
+@pytest.mark.slow
+def test_bench_cluster_smoke_invariants_and_reproducibility():
+    line = run_bench()
+    with open(ARTIFACT) as f:
+        artifact = json.load(f)
+    assert artifact == line
+    assert "[SMOKE]" in artifact["metric"]
+    assert artifact["unit"] == "x_useful_work_per_chip_hour_vs_segregated"
+
+    # -- the headline: one shared pool beats segregation ----------------
+    assert artifact["value"] > 1.0
+    per = artifact["useful_per_chip_hour"]
+    assert per["harvested"] > per["segregated"] > 0
+    assert per["harvested"] > per["unharvested"] > 0
+
+    # -- the acceptance invariants, as the bench itself judged them ----
+    inv = artifact["invariants"]
+    for key in ("harvested_beats_segregated",
+                "harvested_beats_unharvested",
+                "serving_goodput_no_worse_than_unharvested",
+                "serving_displaced_zero", "serving_lossless",
+                "reclaims_happened", "steps_lost_within_bound"):
+        assert inv[key] is True, key
+
+    # -- shape + cross-checks ------------------------------------------
+    trace = artifact["trace"]
+    for key in ("duration_s", "flash_crowd_window_s", "total_chips",
+                "gang_chips", "tokens_per_step", "ckpt_interval_s",
+                "ckpt_budget_s", "reclaim_grace_s"):
+        assert key in trace, key
+    for pool in ("harvested", "unharvested"):
+        run = artifact[pool]
+        s = run["serving"]
+        assert s["conservation_ok"] is True
+        assert s["completed"] == s["submitted"] > 0
+        assert s["displaced"] == []
+        assert run["training"]["useful_steps"] >= 0
+        assert run["useful_tokens"] == s["tokens_in_slo"] \
+            + run["training"]["trained_tokens"]
+    # the identical seeded trace hit every serving plane
+    assert artifact["harvested"]["serving"]["submitted"] \
+        == artifact["unharvested"]["serving"]["submitted"] \
+        == artifact["segregated"]["serving"]["serving"]["submitted"]
+    # the unharvested pool trains nothing; the harvested pool does
+    assert artifact["unharvested"]["training"]["trained_tokens"] == 0
+    assert artifact["harvested"]["training"]["trained_tokens"] > 0
+    # reclaim ledger: every loss within the interval bound, outcomes
+    # accounted exactly once per id
+    rec = artifact["harvested"]["reclaims"]
+    ids = [e["id"] for e in rec["ledger"] if e["id"]]
+    assert len(ids) == len(set(ids))
+    assert rec["steps_lost_total"] == sum(
+        e["steps_lost"] for e in rec["ledger"])
+    bound = trace["ckpt_interval_s"] + trace["ckpt_budget_s"] + 10
+    assert rec["max_steps_lost"] <= bound
+
+    # -- bit-reproducibility -------------------------------------------
+    with open(ARTIFACT, "rb") as f:
+        first = f.read()
+    line2 = run_bench()
+    with open(ARTIFACT, "rb") as f:
+        second = f.read()
+    assert line2 == line
+    assert first == second, "artifact must be byte-identical across reruns"

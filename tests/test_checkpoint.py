@@ -64,14 +64,37 @@ def test_save_restore_roundtrip_across_meshes(tmp_path):
 
 
 def test_latest_and_retention(tmp_path):
+    from nos_tpu.train.checkpoint import latest_step
+
     c = cfg()
     _, params, opt, step, batch = setup(ParallelLayout(dp=2), c)
     opt_state = opt.init(params)
+    # the manager-free witness (the harvester's reclaim-resume gate)
+    # reads the same storage truth, including "nothing committed yet"
+    assert latest_step(str(tmp_path / "ckpt")) is None
     mgr = CheckpointManager(str(tmp_path / "ckpt"), max_to_keep=2)
     for s in (1, 2, 3):
         mgr.save(s, params, opt_state)
     assert mgr.latest() == 3
     assert sorted(mgr.manager.all_steps()) == [2, 3]   # retention pruned 1
+    assert latest_step(str(tmp_path / "ckpt")) == 3
+    assert latest_step(str(tmp_path / "never-written")) is None
+    mgr.close()
+
+
+def test_wait_within_bounds_an_async_save(tmp_path):
+    """The budgeted fence the reclaim-notice discipline uses: True when
+    the background commit lands inside the budget (and the checkpoint
+    really is durable by then), monotone-safe to call again after."""
+    c = cfg()
+    _, params, opt, step, batch = setup(ParallelLayout(dp=2), c)
+    opt_state = opt.init(params)
+    mgr = CheckpointManager(str(tmp_path / "ckpt"))
+    mgr.save(1, params, opt_state, wait=False)
+    assert mgr.wait_within(30.0) is True
+    assert mgr.latest() == 1
+    # idle manager: an immediate re-fence returns at once
+    assert mgr.wait_within(0.1) is True
     mgr.close()
 
 

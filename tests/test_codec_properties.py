@@ -8,6 +8,13 @@ the shapes someone thought of.
 """
 import json
 
+import pytest
+
+# hypothesis is not in every image: skip cleanly instead of ERRORING
+# collection (the PR 6 guard pattern, applied module-level because
+# every test here is property-based)
+pytest.importorskip("hypothesis")
+
 from hypothesis import given, settings
 from hypothesis import strategies as st
 

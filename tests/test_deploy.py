@@ -55,7 +55,8 @@ def test_templates_exist_for_every_component():
                  "tpu-partitioner/configmap_known-tpu-topologies",
                  "tpuagent/daemonset_tpuagent", "pod_metrics-exporter",
                  "fleet/deployment_fleet", "fleet/rbac_fleet",
-                 "gateway/deployment_gateway", "gateway/rbac_gateway"):
+                 "gateway/deployment_gateway", "gateway/rbac_gateway",
+                 "harvest/deployment_harvest", "harvest/rbac_harvest"):
         assert frag in joined, f"missing template {frag}"
 
 
@@ -492,6 +493,78 @@ def test_fleet_deployment_passes_policy_and_quota_args():
     assert "if .Values.fleet.gatewayUrl" in text
     assert values["fleet"]["gatewayUrl"] == ""
     assert "configmaps" in rbac_text
+
+
+def test_harvest_deployment_passes_gang_and_reclaim_args():
+    """The harvest Deployment template (ISSUE 12 satellite) must plumb
+    the plane identity, gang geometry, and every reclaim knob to
+    nos-tpu-harvest flags, and the chart defaults must match the
+    binary's HarvestConfig defaults."""
+    from nos_tpu.harvest import HarvestConfig
+
+    path = os.path.join(CHART, "templates", "harvest",
+                        "deployment_harvest.yaml")
+    with open(path) as f:
+        text = f.read()
+    for flag, value in [
+        ("--name", ".Values.harvest.name"),
+        ("--namespace", ".Values.harvest.namespace"),
+        ("--resource", ".Values.harvest.resource"),
+        ("--gang-size", ".Values.harvest.gangSize"),
+        ("--chips-per-worker", ".Values.harvest.chipsPerWorker"),
+        ("--topology", ".Values.harvest.topology"),
+        ("--max-gangs", ".Values.harvest.maxGangs"),
+        ("--checkpoint-budget",
+         ".Values.harvest.checkpointBudgetSeconds"),
+        ("--checkpoint-interval",
+         ".Values.harvest.checkpointIntervalSeconds"),
+        ("--launch-stable", ".Values.harvest.launchStableSeconds"),
+        ("--interval", ".Values.harvest.reconcileIntervalSeconds"),
+        ("--priority", ".Values.harvest.priority"),
+        ("--trainer-image", ".Values.harvest.trainerImage"),
+    ]:
+        assert flag in text, f"harvest deployment missing {flag}"
+        assert value in text, f"harvest deployment missing {value}"
+    # the witness renders only when shared storage is configured
+    assert "--checkpoint-root={{ .Values.harvest.checkpointRoot }}" \
+        in text
+    assert "if .Values.harvest.checkpointRoot" in text
+    # RBAC exists alongside (pods RW — evictions — + quotas RO + leases)
+    rbac = os.path.join(CHART, "templates", "harvest",
+                        "rbac_harvest.yaml")
+    with open(rbac) as f:
+        rbac_text = f.read()
+    assert "elasticquotas" in rbac_text
+    assert "delete" in rbac_text
+    with open(os.path.join(CHART, "values.yaml")) as f:
+        values = yaml.safe_load(f)
+    hv = values["harvest"]
+    cfg = HarvestConfig()
+    assert hv["enabled"] is False
+    assert hv["name"] == cfg.name
+    assert hv["namespace"] == cfg.namespace
+    assert hv["resource"] == cfg.resource
+    assert hv["gangSize"] == cfg.gang_size
+    assert hv["chipsPerWorker"] == cfg.chips_per_worker
+    assert hv["topology"] == cfg.topology
+    assert hv["maxGangs"] == cfg.max_gangs
+    assert hv["checkpointBudgetSeconds"] == cfg.checkpoint_budget_s
+    assert hv["checkpointIntervalSeconds"] == cfg.checkpoint_interval_s
+    assert hv["launchStableSeconds"] == cfg.launch_stable_s
+    assert hv["reconcileIntervalSeconds"] == cfg.reconcile_interval_s
+    assert hv["priority"] == cfg.priority
+    assert hv["trainerImage"] == cfg.image
+    assert hv["checkpointRoot"] == ""
+    # the scheduler side of the reclaim handshake: the grace knob is
+    # plumbed, defaults OFF (pre-harvest behavior), and the budget the
+    # chart ships stays inside the window an operator would enable
+    sched = os.path.join(CHART, "templates", "scheduler",
+                         "deployment_scheduler.yaml")
+    with open(sched) as f:
+        sched_text = f.read()
+    assert "--reclaim-grace-s={{ .Values.scheduler.reclaimGraceSeconds }}" \
+        in sched_text
+    assert values["scheduler"]["reclaimGraceSeconds"] == 0
 
 
 def test_gateway_deployment_passes_routing_and_door_args():

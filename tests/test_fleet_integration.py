@@ -164,6 +164,130 @@ def test_fleet_borrows_slack_then_sheds_on_guaranteed_reclaim(rig):
     assert fleet.conservation_ok()
 
 
+def test_fleet_reborrows_after_harvester_releases_at_trough_end():
+    """ISSUE 12 satellite — the re-borrow round-trip the PR 8 reclaim
+    path left untested, now with the harvest plane as the borrower:
+    in a trough the harvester borrows the serving namespace's unused
+    min for a training gang; when the pressure episode returns the
+    fleet creates replicas against its guaranteed min (the clamp must
+    NOT strand it at zero slack), the scheduler's reclaim notice fires,
+    the harvester checkpoint-then-gang-evicts, and the serving fleet
+    actually grows into the released chips; at the next trough the
+    harvester borrows them back and training resumes from its durable
+    lineage."""
+    from nos_tpu.harvest import HarvestConfig, HarvestController
+    from nos_tpu.harvest.sim import SimHarvestKubelet, SimTrainer
+    from tests.test_harvest import slice_host
+
+    server = ApiServer()
+    clock = FakeClock()
+    mgr = Manager(server, clock=clock)
+    mgr.add_controller(ElasticQuotaReconciler().controller())
+    mgr.add_controller(Scheduler(reclaim_grace_s=30.0,
+                                 clock=clock).controller())
+    client = Client(server)
+    for pool in ("a", "b"):
+        for w in range(2):
+            server.create(slice_host(f"pool-{pool}-w{w}",
+                                     f"pool-{pool}"))
+    # serve owns the whole 32-chip pool's guarantee; batch scavenges
+    server.create(make_elastic_quota("serve-q", "serve",
+                                     min={TPU: 32.0}))
+    server.create(make_elastic_quota("batch-q", "batch",
+                                     min={TPU: 0.0}))
+    fleet = SimFleet(clock, slo_ttft_s=10.0, max_batch=8,
+                     tokens_per_s=50.0)
+    ctl = FleetController(
+        FleetConfig(name="web", namespace="serve",
+                    chips_per_replica=CHIPS,
+                    policy=PolicyConfig(
+                        min_replicas=1, max_replicas=6,
+                        queue_high=4.0, queue_low=0.5,
+                        up_stable_s=2.0, down_stable_s=8.0,
+                        up_cooldown_s=3.0, down_cooldown_s=4.0,
+                        max_step_up=3, max_step_down=2),
+                    reconcile_interval_s=1.0, drain_timeout_s=8.0),
+        stats_source=fleet.stats_source, clock=clock)
+    mgr.add_controller(ctl.controller())
+    kubelet = SimKubelet(fleet, clock, fleet_label="web",
+                         namespace="serve", startup_s=2.0)
+    trainer = SimTrainer(clock, step_rate=1.0, ckpt_interval_s=20.0,
+                         ckpt_duration_s=2.0)
+    hctl = HarvestController(
+        HarvestConfig(name="hv", namespace="batch", gang_size=2,
+                      chips_per_worker=8.0, topology="4x4",
+                      max_gangs=1, checkpoint_budget_s=10.0,
+                      checkpoint_interval_s=20.0, launch_stable_s=4.0,
+                      reconcile_interval_s=1.0),
+        trainer=trainer, clock=clock)
+    mgr.add_controller(hctl.controller())
+    hkubelet = SimHarvestKubelet(trainer, clock, "hv", "batch",
+                                 startup_s=2.0)
+
+    def pump(seconds, rps=0.0):
+        t = 0.0
+        carry = 0.0
+        while t < seconds:
+            carry += rps
+            while carry >= 1.0:
+                carry -= 1.0
+                fleet.submit(tokens=40)
+            mgr.run_until_idle()
+            kubelet.sync(client)
+            hkubelet.sync(client)
+            mgr.run_until_idle()
+            fleet.tick(1.0)
+            trainer.tick(1.0)
+            clock.advance(1.0)
+            t += 1.0
+        mgr.run_until_idle()
+
+    def gang_pods():
+        return [p for p in server.list("Pod", namespace="batch")
+                if p.status.phase in ("Pending", "Running")]
+
+    # -- trough: the harvester borrows the serve namespace's unused min
+    pump(40, rps=1.0)
+    gang = gang_pods()
+    assert len(gang) == 2 and all(
+        p.status.phase == "Running" for p in gang), \
+        [(p.metadata.name, p.status.phase) for p in gang]
+    steps_banked = trainer.useful_steps()
+    assert steps_banked > 0
+
+    # -- pressure episode: the fleet must grow THROUGH the borrow ------
+    pump(50, rps=25.0)
+    running = [p for p in serve_pods(server)
+               if p.status.phase == "Running"]
+    assert len(running) >= 4, \
+        "the fleet never re-borrowed the chips the harvester held: " \
+        f"{[p.metadata.name for p in serve_pods(server)]}"
+    # the gang went through the graceful reclaim and is parked
+    ledger = hctl.ledger()
+    assert len(ledger) >= 1
+    assert all(e["outcome"] in ("graceful", "forced") for e in ledger)
+    gang = gang_pods()
+    assert all(not p.spec.node_name for p in gang)
+    assert all(p.metadata.annotations.get(
+        constants.ANNOTATION_SCHEDULING_HOLD) for p in gang)
+    # lossless on the serving side throughout
+    assert fleet.conservation_ok()
+
+    # -- trough returns: the harvester borrows back, lineage survives --
+    # (long enough for the crowd's breached completions to age out of
+    # the goodput window — the policy rightly refuses to shrink a fleet
+    # whose recent goodput is poor)
+    pump(140, rps=0.5)
+    gang = gang_pods()
+    assert all(p.status.phase == "Running" for p in gang), \
+        [(p.metadata.name, p.status.phase) for p in gang]
+    assert trainer.useful_steps() >= steps_banked
+    st = trainer._gangs["hv-g0"]
+    assert st.admitted and not st.fenced
+    assert fleet.conservation_ok()
+    mgr.stop()
+
+
 def test_routed_mode_prefix_affinity_through_the_full_control_plane():
     """Routed-mode integration (ISSUE 11 satellite): the sim fleet runs
     the gateway's prefix-affinity ring under the REAL controller/

@@ -5,8 +5,30 @@ parallel/mesh.py's arrange_devices enforces on the workload side, now a
 scheduler-side contract (VERDICT r4 ask #5; SURVEY §5 "distributed
 communication backend").
 """
-from hypothesis import given, settings
-from hypothesis import strategies as st
+import pytest
+
+try:
+    # hypothesis is not in every image: the PR 6 guard pattern — the
+    # one property test skips, the nine example-based tests still run
+    # (they were previously lost to a module collection ERROR)
+    from hypothesis import given, settings
+    from hypothesis import strategies as st
+    HAVE_HYPOTHESIS = True
+except ImportError:        # pragma: no cover - environment-dependent
+    HAVE_HYPOTHESIS = False
+
+    def settings(**kw):
+        return lambda f: pytest.mark.skip(
+            reason="hypothesis missing")(f)
+
+    def given(*a, **kw):
+        return lambda f: f
+
+    class _StStub:
+        def __getattr__(self, name):
+            return lambda *a, **kw: None
+
+    st = _StStub()
 
 from nos_tpu import constants
 from nos_tpu.api.quota import make_elastic_quota

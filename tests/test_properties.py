@@ -7,6 +7,13 @@ Kept cheap (max_examples bounded) so the suite stays fast.
 import math
 
 import numpy as np
+import pytest
+
+# hypothesis is not in every image: skip cleanly instead of ERRORING
+# collection (the PR 6 guard pattern, applied module-level because
+# every test here is property-based)
+pytest.importorskip("hypothesis")
+
 from hypothesis import given, settings
 from hypothesis import strategies as st
 

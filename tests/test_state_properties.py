@@ -6,6 +6,13 @@ generative invariants) and ClusterSnapshot fork/commit/revert algebra
 """
 import random
 
+import pytest
+
+# hypothesis is not in every image: skip cleanly instead of ERRORING
+# collection (the PR 6 guard pattern, applied module-level because
+# every test here is property-based)
+pytest.importorskip("hypothesis")
+
 from hypothesis import given, settings
 from hypothesis import strategies as st
 
