@@ -60,6 +60,9 @@ from nos_tpu.kube.objects import ConfigMap, ObjectMeta
 from nos_tpu.models.errors import (
     DeadlineExceeded, EngineRecovering, Infeasible, QueueFull,
 )
+from nos_tpu.models.tenantquota import (
+    TenantQuotaConfig, validate_tenant_name,
+)
 from nos_tpu.obs import tracing
 
 logger = logging.getLogger(__name__)
@@ -350,11 +353,19 @@ def make_http_server(router: GatewayRouter, port: int,
                     self.headers.get("X-Request-Deadline-S"))
                 deadline_s = float(deadline) if deadline is not None \
                     else None
+                # request-level elastic-quota identity (body field
+                # wins, X-Tenant header second): rides the door's
+                # fleet-wide max admission, scopes the affinity key,
+                # and forwards to the replica's weighted admission
+                tenant = body.pop("tenant",
+                                  self.headers.get("X-Tenant"))
+                if tenant is not None:
+                    tenant = validate_tenant_name(str(tenant))
                 # every remaining body key forwards verbatim — the
                 # replica owns validation of its own wire surface
                 if stream:
                     gen = router.stream(prompt, n, deadline_s=deadline_s,
-                                        **body)
+                                        tenant=tenant, **body)
                     # prime the FIRST delta before committing the
                     # status line: router.stream is lazy, and a door
                     # shed / spent deadline / exhausted retry budget
@@ -370,7 +381,8 @@ def make_http_server(router: GatewayRouter, port: int,
                     self._stream_sse(gen, first=first)
                     return
                 tokens, replica, attempts = router.dispatch(
-                    prompt, n, deadline_s=deadline_s, **body)
+                    prompt, n, deadline_s=deadline_s, tenant=tenant,
+                    **body)
             except Infeasible as e:
                 self._reply(400, {"error": f"{type(e).__name__}: {e}",
                                   "infeasible": True,
@@ -461,6 +473,23 @@ def main(argv: Optional[Sequence[str]] = None) -> None:
         help="seconds a parked request waits for a replica before "
              "shedding 429 reason=no_ready_replicas")
     parser.add_argument(
+        "--tenant-config", default="",
+        help="request-level elastic quota at the door: FLEET-WIDE "
+             "per-tenant token-rate min/max as a file path or inline "
+             "JSON (empty = off). A tenant at/over its max — summed "
+             "from the scraped per-replica /stats tenants sections — "
+             "sheds 429 reason=tenant_quota before reaching any "
+             "replica; the affinity key is tenant-scoped (matching "
+             "the replicas' tenant-scoped prefix chains) unless "
+             "share_prefix opts out")
+    parser.add_argument(
+        "--tenant-quota-attempts", type=int, default=2,
+        help="total dispatch attempts answered 429 tenant_quota "
+             "before the request fails as 429 (1 = fail on the first "
+             "quota shed; the Nth shed is the failing one) — a burst "
+             "tenant backs off on its quota instead of walking the "
+             "fleet's full retry ladder")
+    parser.add_argument(
         "--retry-attempts", type=int, default=12,
         help="dispatch attempts per request before failing it")
     parser.add_argument(
@@ -487,6 +516,8 @@ def main(argv: Optional[Sequence[str]] = None) -> None:
             door_wait_s=args.door_wait,
             max_attempts=args.retry_attempts,
             backoff_s=args.retry_backoff,
+            tenant_config=TenantQuotaConfig.load(args.tenant_config),
+            tenant_quota_attempts=args.tenant_quota_attempts,
         ),
         transport=transport.send,
         stream_transport=transport.send_stream,

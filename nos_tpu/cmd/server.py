@@ -42,6 +42,9 @@ from nos_tpu.models.errors import (  # jax-free module: keeps this file
     QueueFull,                       # importable without jax
 )
 from nos_tpu.models.supervision import EngineSupervisor  # jax-free too
+from nos_tpu.models.tenantquota import (   # jax-free (quota math only)
+    TenantQuotaConfig, validate_tenant_name,
+)
 from nos_tpu.obs import tracing
 from nos_tpu.utils.metrics import default_registry
 
@@ -194,6 +197,16 @@ class ServerConfig:
     # a breach pins the request's trace in the flight recorder.
     slo_ttft_ms: float = 0.0
     slo_tpot_ms: float = 0.0
+    # request-level elastic quota (empty = off): per-tenant token-rate
+    # min/max with borrowing — a file path or inline JSON (see
+    # models/tenantquota.TenantQuotaConfig). With it set, requests
+    # carry a tenant (JSON field ``tenant`` / header ``X-Tenant``;
+    # unlabeled traffic is the default tenant), admission is the
+    # weighted tenant pick instead of FIFO, a guaranteed tenant
+    # reclaims slots by bit-exact preemption (paged engines), tenants
+    # at/over max shed 429 reason=tenant_quota under contention, and
+    # the prefix cache is tenant-scoped (share_prefix opts out).
+    tenant_config: str = ""
     # device-runtime telemetry cadence (seconds; 0 disables): samples
     # device.memory_stats() into the HBM gauges at most this often —
     # guarded, so backends without memory stats (CPU) just skip.
@@ -292,7 +305,8 @@ class ServingLoop:
                  restart_backoff_max_s: float = 10.0,
                  watchdog_s: float = 0.0,
                  default_deadline_s: float = 0.0, seed: int = 0,
-                 config_echo: Optional[dict] = None):
+                 config_echo: Optional[dict] = None,
+                 tenant_quota: Optional[TenantQuotaConfig] = None):
         reg = default_registry()
         # register() is idempotent per (name, type, labels) and raises on
         # a mismatched re-registration — exactly what we want at startup
@@ -414,6 +428,54 @@ class ServingLoop:
                 buckets=(0.0, 1.0, 2.0, 3.0, 4.0, 6.0, 8.0, 12.0, 16.0))
             self.m_spec_draft.inc(0)
             self.m_spec_accepted.inc(0)
+        # request-level elastic quota (registered only when tenancy is
+        # configured — a single-tenant server must not export dead
+        # per-tenant series). Labels are the CONFIGURED tenant names:
+        # unknown wire tenants resolve to the default tenant, so
+        # cardinality is operator-bounded, never client-controlled.
+        self._tenant_cfg = tenant_quota
+        self._tenant_of: dict = {}          # loop rid -> tenant label
+        self._tenant_goodput: dict = {}     # label -> [judged, good]
+        self._tenant_preempt_seen: dict = {}
+        if tenant_quota is not None:
+            self.m_tenant_tokens = reg.counter(
+                "nos_tpu_serve_tenant_tokens_total",
+                "Output tokens delivered per tenant — the goodput "
+                "numerator the quota's min/max rates govern",
+                ("tenant",))
+            self.m_tenant_shed = reg.counter(
+                "nos_tpu_serve_tenant_shed_total",
+                "Admission sheds per tenant by machine-readable reason "
+                "(tenant_quota = the tenant is at/over its own max "
+                "token-rate under contention; queue_full / "
+                "hbm_admission / deadline_unmeetable = the shared "
+                "capacity reasons, attributed to the tenant that hit "
+                "them)",
+                ("tenant", "reason"))
+            self.m_tenant_preempt = reg.counter(
+                "nos_tpu_serve_tenant_preempt_total",
+                "Slots preempted per (victim) tenant by mode (swap | "
+                "recompute) — quota reclaim for a guaranteed tenant "
+                "and block-pool pressure both count; every preemption "
+                "resumes bit-exactly",
+                ("tenant", "mode"))
+            self.g_tenant_goodput = reg.gauge(
+                "nos_tpu_serve_tenant_goodput_ratio",
+                "Per-tenant goodput: finished-and-SLO-met requests "
+                "over all server-judged terminal outcomes (finished, "
+                "failed, deadline — client cancels excluded); with no "
+                "SLO configured, finished requests count as good",
+                ("tenant",))
+            self.g_tenant_borrowed = reg.gauge(
+                "nos_tpu_serve_tenant_borrowed_tokens_per_s",
+                "Token-rate each tenant currently runs ABOVE its "
+                "guaranteed min — the lent idle capacity the elastic "
+                "quota exists to hand out (and reclaim)",
+                ("tenant",))
+            for t in tenant_quota.names():
+                self.m_tenant_tokens.labels(t).inc(0)
+                for mode in ("swap", "recompute"):
+                    self.m_tenant_preempt.labels(t, mode).inc(0)
         self.m_compiles = reg.counter(
             "nos_tpu_serve_compiles_total",
             "XLA compiles observed by the engine (first dispatch per "
@@ -698,6 +760,22 @@ class ServingLoop:
                     self._goodput_good += 1
                 self.g_goodput.set(
                     self._goodput_good / self._goodput_done)
+        if self._tenant_cfg is not None:
+            t = self._tenant_of.pop(
+                rid, self._tenant_cfg.default_tenant)
+            if ledger and ledger.get("output_tokens"):
+                self.m_tenant_tokens.labels(t).inc(
+                    ledger["output_tokens"])
+            if outcome in ("finished", "failed", "deadline"):
+                # per-tenant goodput over SERVER-judged outcomes: a
+                # client walking away (cancelled/abandoned) is not a
+                # quality verdict on the quota, a shed/failure/breach
+                # is. No SLO targets -> finishing IS good.
+                gp = self._tenant_goodput.setdefault(t, [0, 0])
+                gp[0] += 1
+                if outcome == "finished" and not breaches:
+                    gp[1] += 1
+                self.g_tenant_goodput.labels(t).set(gp[1] / gp[0])
         if sp is not None and sp.recording:
             sp.set_attr("outcome", outcome)
             if ledger:
@@ -1134,6 +1212,7 @@ class ServingLoop:
             self.engine = new_engine
             self._preempt_seen = {"swap": 0, "recompute": 0}
             self._spec_seen = {"drafted": 0, "accepted": 0}
+            self._tenant_preempt_seen = {}
             resumed = {"swap": 0, "recompute": 0}
             lost = 0
             seen = set()
@@ -1425,6 +1504,18 @@ class ServingLoop:
                 self.engine.spec_window_events = []
                 for a in events:
                     self.h_spec_window.observe(float(a))
+        tenant_snap = getattr(self.engine, "tenant_snapshot", None)
+        if self._tenant_cfg is not None and tenant_snap is not None:
+            snap = tenant_snap()
+            for t, row in (snap or {}).items():
+                self.g_tenant_borrowed.labels(t).set(
+                    row.get("borrowed_tokens_per_s", 0.0))
+                for mode, n in (row.get("preempts") or {}).items():
+                    seen = self._tenant_preempt_seen.get((t, mode), 0)
+                    if n > seen:
+                        self.m_tenant_preempt.labels(t, mode).inc(
+                            n - seen)
+                        self._tenant_preempt_seen[(t, mode)] = n
         kv_stats = getattr(self.engine, "kv_stats", None)
         kv = kv_stats() if kv_stats is not None else None
         if kv:
@@ -1459,7 +1550,8 @@ class ServingLoop:
         self._drain_compile_events()
 
     def stream(self, prompt, max_new_tokens, timeout: float = 300.0,
-               deadline_s: Optional[float] = None, **sampling):
+               deadline_s: Optional[float] = None,
+               tenant: Optional[str] = None, **sampling):
         """Streaming primitive: submits EAGERLY (validation errors raise
         here, before the caller commits response headers) and returns an
         iterator yielding lists of newly-decoded tokens as ticks land.
@@ -1474,7 +1566,14 @@ class ServingLoop:
         met (DeadlineUnmeetable — a QueueFull, so HTTP answers 429 +
         Retry-After), cancelled at the next tick barrier once expired
         (the iterator raises DeadlineExceeded). Either way the
-        request's one terminal outcome is ``deadline``."""
+        request's one terminal outcome is ``deadline``.
+
+        ``tenant`` is the request-level elastic-quota identity
+        (``X-Tenant`` / JSON ``tenant`` on the wire): it rides to the
+        engine's weighted admission and keys the per-tenant
+        goodput/shed/preempt accounting here."""
+        tlabel = (self._tenant_cfg.resolve(tenant)
+                  if self._tenant_cfg is not None else None)
         with self._work:
             if self._failed is not None:
                 raise RuntimeError(f"serving loop failed: {self._failed}")
@@ -1523,6 +1622,9 @@ class ServingLoop:
                     self.m_requests.labels("deadline").inc()
                     self._deadline_shed += 1
                     self._shed_streak += 1
+                    if tlabel is not None:
+                        self.m_tenant_shed.labels(
+                            tlabel, "deadline_unmeetable").inc()
                     raise DeadlineUnmeetable(
                         f"deadline {dl_s:.3f}s cannot be met: rolling "
                         f"estimates put completion at {est:.3f}s "
@@ -1532,16 +1634,28 @@ class ServingLoop:
                         f"~{(self._est_tpot_s or 0.0) * 1e3:.1f}ms "
                         f"each); retry with a longer deadline or when "
                         f"load drops")
+            if tenant is not None:
+                # down to the engine's weighted admission; engines
+                # without tenancy (test stubs) just see an extra kwarg
+                sampling["tenant"] = tenant
             try:
                 erid = self.engine.submit(prompt, max_new_tokens,
                                           **sampling)
-            except QueueFull:
+            except QueueFull as e:
                 self.m_requests.labels("rejected").inc()
+                if tlabel is not None:
+                    # tenant_quota (the tenant's own ceiling) and the
+                    # shared capacity reasons alike, attributed to the
+                    # tenant that hit them
+                    self.m_tenant_shed.labels(
+                        tlabel, getattr(e, "reason", "queue_full")).inc()
                 raise
             rid = self._next_rid
             self._next_rid += 1
             self._rid_map[rid] = erid
             self._live.add(rid)
+            if tlabel is not None:
+                self._tenant_of[rid] = tlabel
             self._shed_streak = 0       # an admission ends the streak
             if dl_s is not None:
                 self._deadlines[rid] = time.monotonic() + dl_s
@@ -1745,6 +1859,10 @@ def build_engine(cfg: ServerConfig):
         # contract the trainer's mesh gets (parallel/mesh.py)
         mesh = Mesh(arrange_devices(devs[:cfg.tp], (cfg.tp,)), ("tp",))
 
+    # request-level elastic quota: parsed HERE so the supervisor's
+    # rebuild factory re-creates a tenant-aware engine from the same
+    # config (a restart must not silently drop tenancy)
+    tenant_quota = TenantQuotaConfig.load(cfg.tenant_config)
     gcfg = GenerateConfig(
         vocab=cfg.vocab, d_model=cfg.d_model, n_layers=cfg.n_layers,
         n_heads=cfg.n_heads, n_kv_heads=cfg.n_kv_heads, d_ff=cfg.d_ff,
@@ -1783,7 +1901,7 @@ def build_engine(cfg: ServerConfig):
             decode_steps=cfg.decode_steps,
             kv_block_size=cfg.kv_block_size, kv_blocks=cfg.kv_blocks,
             kv_swap=cfg.kv_swap, hbm_admit_frac=cfg.kv_hbm_admit_frac,
-            kv_dtype=cfg.kv_dtype)
+            kv_dtype=cfg.kv_dtype, tenant_quota=tenant_quota)
     return DecodeServer(params, model_cfg, max_batch=cfg.max_batch,
                         prefix_cache_size=cfg.prefix_cache_size, mesh=mesh,
                         prefill_chunk=cfg.prefill_chunk,
@@ -1793,7 +1911,8 @@ def build_engine(cfg: ServerConfig):
                         kv_block_size=cfg.kv_block_size,
                         kv_blocks=cfg.kv_blocks, kv_swap=cfg.kv_swap,
                         hbm_admit_frac=cfg.kv_hbm_admit_frac,
-                        kv_dtype=cfg.kv_dtype)
+                        kv_dtype=cfg.kv_dtype,
+                        tenant_quota=tenant_quota)
 
 
 def make_http_server(cfg: ServerConfig, loop: ServingLoop
@@ -1963,6 +2082,17 @@ def make_http_server(cfg: ServerConfig, loop: ServingLoop
                         raise ValueError(
                             "cache_prefix must be a JSON boolean")
                     sampling["cache_prefix"] = body["cache_prefix"]
+                # request-level elastic-quota identity: body field
+                # wins, X-Tenant header second; absent = the default
+                # tenant. Validated (it becomes a metric label and a
+                # prefix-cache scope); a tenant at/over its max
+                # token-rate under contention sheds 429
+                # reason=tenant_quota.
+                tenant = body.get("tenant",
+                                  self.headers.get("X-Tenant"))
+                if tenant is not None:
+                    sampling["tenant"] = validate_tenant_name(
+                        str(tenant))
                 # per-request completion deadline: body field wins,
                 # header second, server default (--default-deadline-s)
                 # last. Unmeetable -> 429 + Retry-After (shed early),
@@ -2124,6 +2254,15 @@ def main(argv: Optional[Sequence[str]] = None) -> None:
              "triggers a supervised restart (dispatch-time compiles "
              "don't count — size it above the slowest device wait)")
     parser.add_argument(
+        "--tenant-config", default=None,
+        help="request-level elastic quota: per-tenant token-rate "
+             "min/max with borrowing, as a file path or inline JSON "
+             "(empty = tenancy off; overrides config). Requests carry "
+             "a tenant via the JSON field / X-Tenant header; admission "
+             "becomes the weighted tenant pick, guaranteed tenants "
+             "reclaim slots by bit-exact preemption, over-max tenants "
+             "shed 429 reason=tenant_quota under contention")
+    parser.add_argument(
         "--default-deadline-s", type=float, default=None,
         help="default per-request completion deadline in seconds "
              "(0 = none; overrides config; per-request override via "
@@ -2170,6 +2309,8 @@ def main(argv: Optional[Sequence[str]] = None) -> None:
         cfg.watchdog_s = args.watchdog_s
     if args.default_deadline_s is not None:
         cfg.default_deadline_s = args.default_deadline_s
+    if args.tenant_config is not None:
+        cfg.tenant_config = args.tenant_config
     if cfg.restart_budget < 0:
         raise ValueError(
             f"restart_budget must be >= 0, got {cfg.restart_budget}")
@@ -2186,6 +2327,10 @@ def main(argv: Optional[Sequence[str]] = None) -> None:
     # failure is then terminal exactly as before supervision existed.
     factory = (lambda: build_engine(cfg)) if cfg.restart_budget > 0 \
         else None
+    # parsed once more for the LOOP's accounting half (the engine half
+    # parses inside build_engine so the supervisor factory carries it);
+    # a malformed config fails HERE, before the checkpoint load
+    tenant_quota = TenantQuotaConfig.load(cfg.tenant_config)
     loop = ServingLoop(
         build_engine(cfg), slo_ttft_ms=cfg.slo_ttft_ms,
         slo_tpot_ms=cfg.slo_tpot_ms,
@@ -2195,6 +2340,7 @@ def main(argv: Optional[Sequence[str]] = None) -> None:
         restart_backoff_max_s=cfg.restart_backoff_max_s,
         watchdog_s=cfg.watchdog_s,
         default_deadline_s=cfg.default_deadline_s, seed=cfg.seed,
+        tenant_quota=tenant_quota,
         # /stats config echo: what the fleet controller compares across
         # replicas to catch config drift between scrapes
         config_echo={
@@ -2209,6 +2355,11 @@ def main(argv: Optional[Sequence[str]] = None) -> None:
             "draft_n_tokens": (cfg.draft_n_tokens
                                if cfg.draft_checkpoint_dir else 0),
             "max_seq": cfg.max_seq,
+            # tenant quota drifting between replicas would make the
+            # fleet's notion of "fair" replica-dependent — surface it
+            # in the same drift detector as every other knob
+            "tenant_quota": (tenant_quota.echo()
+                             if tenant_quota is not None else None),
         })
     httpd = make_http_server(cfg, loop)
 

@@ -49,7 +49,8 @@ def _digest(data: bytes) -> int:
 
 
 def prefix_key(prompt: Sequence[int], block_size: int,
-               affinity_blocks: int = 4) -> Optional[str]:
+               affinity_blocks: int = 4,
+               tenant: Optional[str] = None) -> Optional[str]:
     """Affinity key for ``prompt``: a digest over its leading
     ``min(len(prompt) // block_size, affinity_blocks)`` full blocks of
     tokens — block-size arithmetic identical to
@@ -61,7 +62,16 @@ def prefix_key(prompt: Sequence[int], block_size: int,
     ``affinity_blocks`` caps the keyed depth: two prompts sharing a
     system prefix of >= cap blocks but diverging after it must map to
     the SAME key, so the cap should sit at or below the shortest
-    shared-prefix length you care to colocate (in blocks)."""
+    shared-prefix length you care to colocate (in blocks).
+
+    ``tenant`` (ISSUE 13 satellite) folds the request's tenant into
+    the digest — the routing twin of the replicas' tenant-scoped
+    ``PrefixBlockIndex`` chains: with scoping on, two tenants sending
+    identical prompts hold DISJOINT chains, so co-locating them buys
+    nothing and leaks timing; scoping the key keeps each tenant's
+    prefix working set on its own home replica. None (unlabeled
+    traffic, or the ``share_prefix`` opt-out) keeps the legacy
+    tenant-free key."""
     if block_size < 1:
         raise ValueError(f"block_size must be >= 1, got {block_size}")
     full = min(len(prompt) // block_size, max(0, affinity_blocks))
@@ -69,6 +79,8 @@ def prefix_key(prompt: Sequence[int], block_size: int,
         return None
     head = prompt[:full * block_size]
     toks = b",".join(str(int(t)).encode() for t in head)
+    if tenant is not None:
+        toks = tenant.encode() + b"\x00" + toks
     return hashlib.blake2b(toks, digest_size=16).hexdigest()
 
 

@@ -59,7 +59,9 @@ from typing import Any, Callable, Deque, Dict, Iterable, List, Optional
 from nos_tpu.gateway.ring import HashRing, affinity_pick, prefix_key
 from nos_tpu.models.errors import (
     DeadlineExceeded, EngineRecovering, Infeasible, QueueFull,
+    TenantQuotaExceeded,
 )
+from nos_tpu.models.tenantquota import TenantQuotaConfig
 from nos_tpu.obs import tracing
 from nos_tpu.utils.metrics import default_registry
 
@@ -77,6 +79,12 @@ REASON_FLEET_QUEUE = "fleet_queue_full"
 REASON_FLEET_HBM = "fleet_hbm_admission"
 REASON_DOOR_QUEUE = "door_queue_full"
 REASON_NO_REPLICAS = "no_ready_replicas"
+#: the request-level elastic-quota shed (ISSUE 13): the submitting
+#: tenant's FLEET-WIDE token-rate (summed from the scraped per-replica
+#: /stats ``tenants`` sections) is at/over its gateway-configured max —
+#: same slug as the per-replica shed, so clients see one reason
+#: whichever door refused them
+REASON_TENANT = "tenant_quota"
 
 
 class ReplicaUnreachable(RuntimeError):
@@ -143,6 +151,17 @@ class RouterConfig:
     backoff_s: float = 0.05
     backoff_max_s: float = 1.0
     seed: int = 0
+    # request-level elastic quota at the door (None = off): fleet-wide
+    # per-tenant token-rate max (summed from the scraped /stats
+    # ``tenants`` sections), shed reason=tenant_quota before work
+    # reaches any replica; also scopes the affinity key per tenant
+    # (share_prefix opts out) and bounds the TOTAL dispatch attempts
+    # answered tenant_quota before the request fails as 429 (the Nth
+    # quota shed is the failing one; 1 = fail on the first) — a burst
+    # tenant backs off on ITS quota instead of consuming the fleet's
+    # retry capacity while guaranteed tenants wait
+    tenant_config: Optional[TenantQuotaConfig] = None
+    tenant_quota_attempts: int = 2
 
 
 class GatewayRouter:
@@ -184,6 +203,7 @@ class GatewayRouter:
         self._door_peak = 0
         self._counts: Dict[str, int] = {k: 0 for k in OUTCOMES}
         self._shed: Dict[str, int] = {}
+        self._tenant_shed: Dict[str, int] = {}
         self._routes: Dict[str, int] = {}
         self._retries = 0
         reg = default_registry()
@@ -199,8 +219,9 @@ class GatewayRouter:
             "nos_tpu_gateway_shed_total",
             "Door sheds by machine-readable reason (fleet_queue_full | "
             "fleet_hbm_admission | door_queue_full | no_ready_replicas "
-            "— the gateway's own reasons, disjoint from the per-replica "
-            "429 reasons it retries through)",
+            "| tenant_quota = a tenant at/over its fleet-wide max "
+            "token-rate — the gateway's own reasons, disjoint from the "
+            "per-replica 429 reasons it retries through)",
             ("reason",))
         self.m_route = reg.counter(
             "nos_tpu_gateway_route_total",
@@ -275,13 +296,49 @@ class GatewayRouter:
             rep.inflight = self._inflight[name]
 
     # -- admission -------------------------------------------------------
-    def _admit(self) -> None:
+    def fleet_tenant_rate(self, tenant: Optional[str]) -> float:
+        """The tenant's fleet-wide token-rate: its per-replica rate
+        rows (the scraped /stats ``tenants`` sections) summed over
+        every known replica — the aggregate the gateway's own min/max
+        semantics judge, mirroring how the fleet controller aggregates
+        every other per-replica signal. Caller holds the lock."""
+        tc = self.cfg.tenant_config
+        if tc is None:
+            return 0.0
+        label = tc.resolve(tenant)
+        total = 0.0
+        for r in self._replicas.values():
+            row = (r.stats.get("tenants") or {}).get(label) or {}
+            total += row.get("rate_tokens_per_s", 0.0) or 0.0
+        return total
+
+    def _admit(self, tenant: Optional[str] = None) -> None:
         """Fleet-wide admission, caller holds the lock: shed at the
         door — with a machine-readable reason — before work reaches a
         replica. Uses the same scraped /stats the controller reads plus
         the router's own in-flight attribution (fresh even when scrapes
         lag)."""
         cfg = self.cfg
+        tc = cfg.tenant_config
+        if tc is not None:
+            # the request-level quota's door arm: the tenant's
+            # FLEET-WIDE rate at/over its gateway max sheds here, with
+            # the same tenant_quota slug the replicas use — before the
+            # request burns door-queue space or a retry ladder. min is
+            # deliberately not door-enforced: guarantees are enforced
+            # where slots live (weighted admission + reclaim inside
+            # each engine); the door only stops over-ceiling traffic.
+            spec = tc.spec(tenant)
+            if spec.max_rate > 0 \
+                    and self.fleet_tenant_rate(tenant) >= spec.max_rate:
+                label = tc.resolve(tenant)
+                self._tenant_shed[label] = \
+                    self._tenant_shed.get(label, 0) + 1
+                self._note_shed(REASON_TENANT)
+                raise TenantQuotaExceeded(
+                    f"tenant {label!r} is at/over its fleet-wide max "
+                    f"of {spec.max_rate:.1f} tokens/s; back off until "
+                    f"its window drains")
         admitting = self._admitting()
         if not admitting:
             return                  # the door queue's job, not a shed
@@ -401,25 +458,56 @@ class GatewayRouter:
         if isinstance(exc, QueueFull):
             if exc.reason == "deadline_unmeetable":
                 return 0.0
-            d = min(cfg.backoff_max_s, cfg.backoff_s * (2 ** attempt))
+            if exc.reason == REASON_TENANT:
+                # quota, not capacity: the shed clears when the
+                # tenant's OWN window drains, so go straight to the
+                # ceiling instead of probing the fleet on the way up
+                d = cfg.backoff_max_s
+            else:
+                d = min(cfg.backoff_max_s,
+                        cfg.backoff_s * (2 ** attempt))
         else:
             d = cfg.backoff_s
         return d * (0.5 + self._rng.random())
 
+    def _key_scope(self, tenant: Optional[str]) -> Optional[str]:
+        """The affinity key's tenant scope: the RESOLVED tenant under
+        a quota config (unless ``share_prefix`` opts the fleet out of
+        scoping), None otherwise. Tenancy unconfigured = legacy
+        tenant-free keys even for labeled traffic: the replicas only
+        scope their chains when THEY run a tenant config, and
+        splitting the gateway's keys by a label the replica caches
+        ignore would scatter one shared prefix across replicas for no
+        isolation gain. Resolution mirrors the replicas' own
+        ``_prefix_scope`` (unknown labels fold into the default
+        tenant), so the colocated cache hits the routing promises
+        actually exist."""
+        tc = self.cfg.tenant_config
+        if tc is None or tc.share_prefix:
+            return None
+        return tc.resolve(tenant)
+
     def dispatch(self, prompt: List[int], max_new_tokens: int,
-                 deadline_s: Optional[float] = None, **sampling):
+                 deadline_s: Optional[float] = None,
+                 tenant: Optional[str] = None, **sampling):
         """Unary request through the fleet: returns ``(tokens,
         replica_name, attempts)``. Exactly-once: resubmission happens
-        only after an attempt raised without delivering."""
+        only after an attempt raised without delivering. ``tenant``
+        rides the door admission (fleet-wide max), scopes the affinity
+        key, and forwards to the replica for its own weighted
+        admission."""
         cfg = self.cfg
         t0 = self.clock()
         deadline = t0 + deadline_s if deadline_s else None
-        key = prefix_key(prompt, cfg.block_size, cfg.affinity_blocks)
+        key = prefix_key(prompt, cfg.block_size, cfg.affinity_blocks,
+                         tenant=self._key_scope(tenant))
         with tracing.span("gateway.request", component="gateway",
                           attrs={"prompt_tokens": len(prompt),
+                                 "tenant": tenant or "",
                                  "affinity_key": key or ""}) as sp:
             tokens, name, attempts = self._dispatch(
-                prompt, max_new_tokens, deadline, key, sampling)
+                prompt, max_new_tokens, deadline, key, sampling,
+                tenant)
             sp.set_attr("replica", name)
             sp.set_attr("attempts", attempts)
         return tokens, name, attempts
@@ -437,24 +525,29 @@ class GatewayRouter:
                 "retries consumed the budget before a replica delivered)")
         return rem
 
-    def _dispatch(self, prompt, max_new_tokens, deadline, key, sampling):
+    def _dispatch(self, prompt, max_new_tokens, deadline, key, sampling,
+                  tenant=None):
         if self.transport is None:
             raise RuntimeError("router has no transport")
         last: Optional[Exception] = None
         tried: set = set()
+        tq_sheds = 0
+        samp = dict(sampling)
+        if tenant is not None:
+            samp["tenant"] = tenant     # the replica's own admission
         for attempt in range(self.cfg.max_attempts):
             rem = self._remaining(deadline)
             with self._lock:
                 if not self._admitting():
                     self._wait_for_replica(deadline)
-                self._admit()
+                self._admit(tenant)
                 rep = self._pick(key, tried)
                 if rep is None:
                     continue
                 self._inflight_delta(rep.name, +1)
             req = {"prompt": list(prompt),
                    "max_new_tokens": max_new_tokens,
-                   "deadline_s": rem, "sampling": dict(sampling)}
+                   "deadline_s": rem, "sampling": dict(samp)}
             try:
                 tokens = self.transport(rep, req)
             except Infeasible:
@@ -474,6 +567,17 @@ class GatewayRouter:
                 with self._lock:
                     self._retries += 1
                 self.m_retries.labels(self._retry_cause(e)).inc()
+                if isinstance(e, QueueFull) \
+                        and e.reason == REASON_TENANT:
+                    # tenant-aware retry: per-replica quota sheds get
+                    # a SMALL dedicated budget — a burst tenant being
+                    # told "you are over YOUR ceiling" must back off,
+                    # not walk the whole fleet retrying while
+                    # guaranteed tenants' requests queue behind its
+                    # attempts
+                    tq_sheds += 1
+                    if tq_sheds >= self.cfg.tenant_quota_attempts:
+                        self._raise_exhausted(e)
                 self.sleep(self._backoff_s(e, attempt))
                 continue
             finally:
@@ -510,36 +614,43 @@ class GatewayRouter:
             f"{last}")
 
     def stream(self, prompt: List[int], max_new_tokens: int,
-               deadline_s: Optional[float] = None, **sampling):
+               deadline_s: Optional[float] = None,
+               tenant: Optional[str] = None, **sampling):
         """Streaming passthrough: retries attempts like ``dispatch``
         until the FIRST delta arrives, then yields deltas straight
         through — a failure after first-byte propagates (tokens already
         left the building; a transparent replay would double-deliver).
         Returns a generator; closing it mid-stream closes the replica
-        stream (the serving loop accounts the cancel)."""
+        stream (the serving loop accounts the cancel). ``tenant`` as
+        in ``dispatch``."""
         if self.stream_transport is None:
             raise RuntimeError("router has no stream transport")
         cfg = self.cfg
         t0 = self.clock()
         deadline = t0 + deadline_s if deadline_s else None
-        key = prefix_key(prompt, cfg.block_size, cfg.affinity_blocks)
+        key = prefix_key(prompt, cfg.block_size, cfg.affinity_blocks,
+                         tenant=self._key_scope(tenant))
+        samp = dict(sampling)
+        if tenant is not None:
+            samp["tenant"] = tenant
 
         def gen():
             last: Optional[Exception] = None
             tried: set = set()
+            tq_sheds = 0
             for attempt in range(cfg.max_attempts):
                 rem = self._remaining(deadline)
                 with self._lock:
                     if not self._admitting():
                         self._wait_for_replica(deadline)
-                    self._admit()
+                    self._admit(tenant)
                     rep = self._pick(key, tried)
                     if rep is None:
                         continue
                     self._inflight_delta(rep.name, +1)
                 req = {"prompt": list(prompt),
                        "max_new_tokens": max_new_tokens,
-                       "deadline_s": rem, "sampling": dict(sampling)}
+                       "deadline_s": rem, "sampling": dict(samp)}
                 started = False
                 try:
                     for delta in self.stream_transport(rep, req):
@@ -572,6 +683,12 @@ class GatewayRouter:
                     with self._lock:
                         self._retries += 1
                     self.m_retries.labels(self._retry_cause(e)).inc()
+                    if isinstance(e, QueueFull) \
+                            and e.reason == REASON_TENANT:
+                        # same tenant-aware retry cap as dispatch()
+                        tq_sheds += 1
+                        if tq_sheds >= cfg.tenant_quota_attempts:
+                            self._raise_exhausted(e)
                     self.sleep(self._backoff_s(e, attempt))
                     continue
                 finally:
@@ -602,6 +719,7 @@ class GatewayRouter:
                 "ready_replicas": len(admitting),
                 "requests": dict(self._counts),
                 "shed": dict(self._shed),
+                "tenant_shed": dict(self._tenant_shed),
                 "routes": dict(self._routes),
                 "retries": self._retries,
                 "ring": {"replicas": self._ring.nodes(),
@@ -614,5 +732,9 @@ class GatewayRouter:
                         self.cfg.admit_pending_per_replica,
                     "admit_hbm_frac": self.cfg.admit_hbm_frac,
                     "max_door_queue": self.cfg.max_door_queue,
+                    "tenant_quota": (
+                        self.cfg.tenant_config.echo()
+                        if self.cfg.tenant_config is not None
+                        else None),
                 },
             }

@@ -16,12 +16,14 @@ must travel different wires.
 
 Every refusal also carries a machine-readable ``reason`` slug the HTTP
 layer copies into the 429/400 body (``queue_full`` /
-``deadline_unmeetable`` / ``hbm_admission`` / ``infeasible``): the
-fleet controller must tell CAPACITY pressure (shed because the fleet
-is undersized — scale up) from DEADLINE pressure (shed because the
-client's budget was tight — scaling may not help) and MEMORY pressure
-(the KV pool or HBM, not slots, is the bottleneck) without parsing
-prose."""
+``deadline_unmeetable`` / ``hbm_admission`` / ``tenant_quota`` /
+``infeasible``): the fleet controller must tell CAPACITY pressure
+(shed because the fleet is undersized — scale up) from DEADLINE
+pressure (shed because the client's budget was tight — scaling may not
+help), MEMORY pressure (the KV pool or HBM, not slots, is the
+bottleneck) and QUOTA pressure (one tenant exceeded its own
+entitlement — scaling the fleet for it would starve the guaranteed
+tenants the quota exists to protect) without parsing prose."""
 
 
 class QueueFull(RuntimeError):
@@ -72,6 +74,20 @@ class DeadlineUnmeetable(QueueFull):
     reason = "deadline_unmeetable"
 
 
+class TenantQuotaExceeded(QueueFull):
+    """Admission refused because the submitting TENANT is at/over its
+    ``max`` token-rate while the engine (or, at the gateway, the fleet)
+    is under contention — the last rung of the elastic-quota
+    degradation ladder (borrow -> stop lending -> preempt -> shed).
+    Subclasses QueueFull — the same transient 429 + Retry-After wire
+    shape — because the right client move is to back off until its own
+    window drains; scaling the fleet is NOT the answer (the
+    ``tenant_quota`` reason is how the autoscaler and the gateway's
+    retry policy tell this shed from genuine capacity pressure)."""
+
+    reason = "tenant_quota"
+
+
 class DeadlineExceeded(RuntimeError):
     """A submitted request's deadline expired before completion: it was
     cancelled at the next tick barrier (or while still queued) and
@@ -81,4 +97,5 @@ class DeadlineExceeded(RuntimeError):
 
 
 __all__ = ["QueueFull", "Infeasible", "EngineRecovering",
-           "DeadlineUnmeetable", "DeadlineExceeded"]
+           "DeadlineUnmeetable", "DeadlineExceeded",
+           "TenantQuotaExceeded"]

@@ -183,12 +183,23 @@ class PrefixBlockIndex:
     (``allocator.fork`` on publish); eviction is LRU whole-chain under
     ``max_blocks``. Capacity pressure from live slots calls
     ``evict_lru`` before any slot is preempted — cached prefixes are
-    the cheapest memory to reclaim."""
+    the cheapest memory to reclaim.
+
+    Chains are SCOPE-partitioned (ISSUE 13 satellite): ``match`` /
+    ``publish`` take an opaque ``scope`` (the serving engine passes
+    the request's tenant), and a chain only ever matches prompts in
+    its own scope. Cross-tenant KV block sharing is a timing
+    side-channel (an adversary probing whether another tenant's prompt
+    is cached by watching its own TTFT) and an isolation hole once a
+    shared block is COW-relied on — two tenants publishing identical
+    prompts therefore get DISJOINT chains unless the operator opts
+    into sharing (``TenantQuotaConfig.share_prefix``), which collapses
+    every scope to the default ``None``."""
 
     def __init__(self, allocator: BlockAllocator, max_blocks: int):
         self.alloc = allocator
         self.max_blocks = max_blocks
-        # insertion-ordered LRU: full token tuple -> list of block ids
+        # insertion-ordered LRU: (scope, full token tuple) -> block ids
         self._chains: Dict[tuple, List[int]] = {}
         self.hits = 0
         self.tokens_saved = 0
@@ -197,20 +208,24 @@ class PrefixBlockIndex:
     def block_count(self) -> int:
         return sum(len(c) for c in self._chains.values())
 
-    def match(self, prompt: Sequence[int], cap: int
+    def match(self, prompt: Sequence[int], cap: int,
+              scope: Optional[str] = None
               ) -> Tuple[int, Optional[tuple]]:
         """(m, chain_key) for the longest block-aligned common head
-        between ``prompt`` and any chain, with m <= cap (the caller
-        passes plen-1: at least one suffix token must run to produce
-        logits). (0, None) when nothing matches. Pure lookup — the
-        caller decides whether the match is used before ``take`` moves
-        refcounts and LRU order. Linear scan over chains: the index is
-        operator-capped small (system prompts, not pages)."""
+        between ``prompt`` and any chain IN ``scope``, with m <= cap
+        (the caller passes plen-1: at least one suffix token must run
+        to produce logits). (0, None) when nothing matches. Pure
+        lookup — the caller decides whether the match is used before
+        ``take`` moves refcounts and LRU order. Linear scan over
+        chains: the index is operator-capped small (system prompts,
+        not pages)."""
         bs = self.alloc.block_size
         best, best_key = 0, None
         for key in self._chains:
+            if key[0] != scope:
+                continue        # another tenant's chain: invisible
             m = 0
-            for a, b in zip(key, prompt):
+            for a, b in zip(key[1], prompt):
                 if a != b:
                     break
                 m += 1
@@ -232,15 +247,17 @@ class PrefixBlockIndex:
         self.tokens_saved += m
         return shared
 
-    def publish(self, prompt: Sequence[int], blocks: Sequence[int]) -> None:
-        """Register ``prompt``'s full blocks as a reusable chain (the
-        holder keeps its own references; the index takes one more per
-        block), then LRU-evict past the block budget."""
+    def publish(self, prompt: Sequence[int], blocks: Sequence[int],
+                scope: Optional[str] = None) -> None:
+        """Register ``prompt``'s full blocks as a reusable chain in
+        ``scope`` (the holder keeps its own references; the index
+        takes one more per block), then LRU-evict past the block
+        budget."""
         bs = self.alloc.block_size
         full = len(prompt) // bs
         if full == 0 or self.max_blocks <= 0:
             return
-        key = tuple(prompt[:full * bs])
+        key = (scope, tuple(prompt[:full * bs]))
         if key in self._chains:
             self._chains[key] = self._chains.pop(key)   # LRU refresh
             return

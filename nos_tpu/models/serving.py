@@ -47,14 +47,19 @@ from nos_tpu.models.kvblocks import (
     blocks_for,
 )
 from nos_tpu.ops.attention import dequantize_kv, quantize_kv
+from nos_tpu.models.tenantquota import (
+    DEFAULT_TENANT, TenantQuotaConfig, TenantScheduler,
+)
 from nos_tpu.models.transformer import Params, TransformerConfig
 
 
 from nos_tpu.models.errors import (  # noqa: F401 — canonical home is
     Infeasible, QueueFull,           # jax-free (see errors.py)
+    TenantQuotaExceeded,
 )
 
-__all__ = ["DecodeServer", "QueueFull", "Infeasible"]
+__all__ = ["DecodeServer", "QueueFull", "Infeasible",
+           "TenantQuotaExceeded"]
 
 
 def _bucket(n: int) -> int:
@@ -112,6 +117,7 @@ class _Ledger:
         admitted = self.t_admit > 0.0
         return {
             "rid": req.rid,
+            "tenant": req.tenant,
             "outcome": self.outcome or "finished",
             "prompt_tokens": len(req.prompt),
             "output_tokens": min(len(req.out), req.max_new_tokens),
@@ -145,6 +151,11 @@ class _Request:
     # (host copies of its KV blocks), and the resume marker that routes
     # _admit to the restore/recompute path instead of fresh prefill
     priority: int = 0
+    # request-level elastic quota: the tenant this request's tokens,
+    # sheds and preemptions are accounted to (DEFAULT_TENANT for
+    # unlabeled traffic); also the prefix-cache scope unless sharing
+    # is enabled
+    tenant: str = DEFAULT_TENANT
     preempted: bool = False
     swap_state: Optional[dict] = None
     # paged admission plumbing: prefix blocks claimed for this request
@@ -223,7 +234,9 @@ class DecodeServer:
                  pipeline_depth: int = 1, decode_steps: int = 1,
                  kv_block_size: int = 0, kv_blocks: int = 0,
                  kv_swap: bool = True, hbm_admit_frac: float = 0.0,
-                 kv_dtype: str = "bf16"):
+                 kv_dtype: str = "bf16",
+                 tenant_quota: Optional[TenantQuotaConfig] = None,
+                 tenant_clock=None):
         if prefill_chunk and (prefill_chunk < 8
                               or prefill_chunk & (prefill_chunk - 1)):
             raise ValueError(
@@ -326,6 +339,10 @@ class DecodeServer:
         # cross-corrupt KV. Preemption accounting rides alongside.
         self._deferred: List[int] = []
         self.preempts = {"swap": 0, "recompute": 0}
+        # quota-reclaim preemptions (a subset of preempts): slots
+        # vacated because a guaranteed tenant was waiting, not because
+        # the block pool ran dry
+        self.tenant_reclaims = 0
         self.hbm: Optional[dict] = None
         self._hbm_dead = False
         self._hbm_next = 0.0
@@ -340,6 +357,22 @@ class DecodeServer:
         # QueueFull so callers shed load (HTTP 429) instead of growing
         # an unbounded backlog whose tail would time out anyway
         self.max_pending = max_pending
+        # request-level elastic quota (tenant_quota set = on): the
+        # admission queue stops being FIFO — a jax-free weighted
+        # scheduler (models/tenantquota.py) picks the next admitted
+        # request by tenant token-rate vs min/max, guaranteed tenants
+        # first, borrowed capacity proportional to the SAME
+        # guaranteed_overquotas math the pod-level quota layer runs.
+        # When a guaranteed tenant waits with no headroom, the engine
+        # reclaims by preempting the most-over-quota tenant's youngest
+        # slot through the bit-exact preemption machinery (paged only).
+        # ``tenant_clock`` injects the rate clock for deterministic
+        # benches/tests; production uses the host monotonic clock.
+        self._tq = (TenantScheduler(tenant_quota)
+                    if tenant_quota is not None else None)
+        self._tq_clock = tenant_clock or time.perf_counter
+        self._prefix_scoped = (tenant_quota is not None
+                               and not tenant_quota.share_prefix)
         # True while _admit last broke on the paged memory-headroom
         # check with free slots available: the queue is blocked on
         # KV-blocks/HBM, not slots — submit sheds with
@@ -688,7 +721,8 @@ class DecodeServer:
                top_p: float = 0.0, seed: Optional[int] = None,
                cache_prefix: bool = False,
                stop_tokens: Optional[List[int]] = None,
-               priority: int = 0) -> int:
+               priority: int = 0,
+               tenant: Optional[str] = None) -> int:
         """Enqueue a request. ``temperature`` 0 = greedy (bit-identical to
         ``generate``); > 0 samples, optionally truncated per-request by
         ``top_k``/``top_p``. ``seed`` keys the request's sample stream
@@ -697,6 +731,14 @@ class DecodeServer:
         ``priority`` matters only under paged-KV memory pressure: when
         the block pool runs dry the LOWEST-priority (then
         youngest-admitted) slot is preempted, never a higher one.
+
+        ``tenant`` is the request-level elastic-quota identity (None =
+        the default tenant). With ``tenant_quota`` configured,
+        admission order is the weighted tenant pick, the prefix cache
+        is tenant-scoped, and a tenant measured at/over its ``max``
+        token-rate while the engine is busy is shed with the
+        machine-readable ``tenant_quota`` reason (TenantQuotaExceeded,
+        a QueueFull: HTTP 429 + Retry-After).
 
         Refusals split permanent from transient: ``Infeasible`` (a
         ValueError — the request can NEVER fit this server: HTTP 400)
@@ -731,6 +773,25 @@ class DecodeServer:
             raise ValueError(
                 f"top_k must be >= 0 and top_p in [0, 1]: got "
                 f"top_k={top_k}, top_p={top_p}")
+        if self._tq is not None:
+            now = self._tq_clock()
+            busy = bool(not self._free or self._pending
+                        or self._prefilling)
+            if busy and self._tq.over_max(tenant, now):
+                # the ladder's last rung: this tenant is at/over its
+                # max token-rate AND the engine has contention — shed
+                # with the tenant_quota reason so the client (and the
+                # gateway's retry policy) backs off on ITS quota, not
+                # on fleet capacity. An idle engine keeps lending even
+                # past max: refusing work for an idle slot would trade
+                # throughput for nothing (work conservation).
+                self._tq.note_shed(tenant)
+                spec = self._tq.cfg.spec(tenant)
+                raise TenantQuotaExceeded(
+                    f"tenant {self._tq.cfg.resolve(tenant)!r} is at "
+                    f"{self._tq.rate(tenant, now):.1f} tokens/s, "
+                    f"max {spec.max_rate:.1f}, with the engine under "
+                    f"contention; back off until the window drains")
         if self.max_pending and len(self._pending) >= self.max_pending:
             if not self._free:
                 raise QueueFull(
@@ -757,6 +818,7 @@ class DecodeServer:
             cache_prefix=bool(cache_prefix) and self._prefix_max > 0,
             stop_tokens=tuple(int(t) for t in stop_tokens or ()),
             priority=int(priority),
+            tenant=(str(tenant) if tenant else DEFAULT_TENANT),
             led=_Ledger(time.perf_counter())))
         self._admit()
         return rid
@@ -769,14 +831,32 @@ class DecodeServer:
             # reference the OLD slot->request binding — flush them
             # before _install writes the new request's rows
             self._flush()
-        while self._pending and self._free:
-            if self.paged and not self._admit_headroom(self._pending[0]):
-                # memory-aware admission: the head waits for free-block
-                # headroom (or the HBM backstop) instead of thrashing
-                # the pool — completions and preemptions re-run this
+        while self._pending:
+            if not self._free:
+                # request-level quota reclaim: a guaranteed tenant
+                # waiting with every slot busy may evict the most-
+                # over-quota tenant's youngest slot (bit-exact, re-
+                # enqueued under its own tenant's weight — never
+                # killed). Without a tenant scheduler (or nothing to
+                # reclaim) this is the old "queue waits for a
+                # completion" behavior.
+                if not self._reclaim_for(
+                        self._pending[self._pick_pending()]):
+                    break
+                continue
+            i = self._pick_pending()
+            req = self._pending[i]
+            if self.paged and not self._admit_headroom(req):
+                # memory-aware admission: the picked head waits for
+                # free-block headroom (or the HBM backstop) instead of
+                # thrashing the pool — completions and preemptions
+                # re-run this. A guaranteed tenant blocked on headroom
+                # reclaims blocks the same way it reclaims slots.
+                if self._reclaim_for(req):
+                    continue
                 self._admit_blocked = True
                 break
-            req = self._pending.popleft()
+            del self._pending[i]
             slot = self._free.popleft()
             req.slot = slot
             self._active[slot] = req
@@ -790,6 +870,86 @@ class DecodeServer:
                 self._resume_recompute(req)
             else:
                 self._prefill_slot(req)
+
+    def _pick_pending(self) -> int:
+        """Index of the next request to admit. FIFO without a tenant
+        scheduler; with one, the weighted tenant pick — guaranteed
+        (under-min) tenants first, then borrowers ordered so realized
+        borrowing stays proportional to their guaranteed_overquotas
+        shares, over-max tenants last (work conservation: they still
+        admit when nobody else is waiting). Within a tenant, arrival
+        order (a preempted request sits at the global front, so it is
+        the first of its tenant by construction)."""
+        if self._tq is None or len(self._pending) <= 1:
+            return 0
+        t = self._tq.pick((r.tenant for r in self._pending),
+                          self._tq_clock())
+        for i, r in enumerate(self._pending):
+            if self._tq.cfg.resolve(r.tenant) == t:
+                return i
+        return 0
+
+    def _reclaim_for(self, req: _Request) -> bool:
+        """Preemptive quota reclaim for ``req``'s tenant (the ISSUE 13
+        tentpole): when a GUARANTEED tenant (under its min token-rate)
+        waits with no free slot or no block headroom, vacate the most-
+        over-quota tenant's youngest slot through the existing
+        bit-exact preemption machinery — swap or recompute per
+        ``kv_swap``, re-enqueued at the front of the queue where the
+        weighted pick re-admits it under its own tenant's weight the
+        moment capacity allows. Never victimizes a tenant within its
+        min, never the requester's own tenant, and only ever on a
+        paged engine (slot-static engines have no preempt primitive).
+        Returns True when it made progress (a preemption, or a flush
+        that freed a slot), False when there is nothing to reclaim —
+        the caller then falls back to waiting, exactly the pre-quota
+        behavior."""
+        if self._tq is None or not self.paged:
+            return False
+        now = self._tq_clock()
+        if not self._tq.under_min(req.tenant, now):
+            return False
+        me = self._tq.cfg.resolve(req.tenant)
+
+        def victims():
+            pre = {e["req"].slot for e in self._prefilling}
+            out = []
+            for s, r in self._active.items():
+                if s in pre or r.done or not r.out:
+                    continue
+                vt = self._tq.cfg.resolve(r.tenant)
+                if vt == me or not self._tq.over_min(r.tenant, now):
+                    continue
+                out.append((s, r, vt))
+            return out
+
+        # pre-scan BEFORE paying the flush barrier: under sustained
+        # guaranteed load with nothing preemptible (every slot
+        # within-min — commonly the requester's own tenant), this runs
+        # on every _admit, and flushing the in-flight window each time
+        # would serialize the pipelined decode for nothing
+        if not victims():
+            return False
+        free0 = len(self._free)
+        self._flush()       # barrier: preemption needs a drained window
+        if len(self._free) > free0:
+            return True     # a late completion freed a slot: progress
+        cands = victims()   # re-scan: the flush may have finished one
+        if not cands:
+            return False
+        # most-over-quota tenant first (over-rate normalized by its
+        # fair borrow share — the same fairness currency the pick
+        # admits by), youngest slot within it (least sunk work lost to
+        # the re-queue wait). ONE shares build for the whole ranking:
+        # each build walks the QuotaInfos aggregates.
+        shares = self._tq.borrow_shares(now)
+        ratios = {vt: self._tq.over_quota_ratio(vt, now, shares)
+                  for _, _, vt in cands}
+        s, _r, _vt = max(
+            cands, key=lambda c: (ratios[c[2]], c[1].led.t_admit))
+        self._preempt_slot(s, "swap" if self.kv_swap else "recompute")
+        self.tenant_reclaims += 1
+        return True
 
     def _timed_dispatch(self, key: tuple, fn, *args):
         """Run ``fn`` and, on its FIRST call per shape ``key``, time it
@@ -830,9 +990,26 @@ class DecodeServer:
             z = jax.device_put(z, self._row_shd)
         return z
 
-    def _prefix_match(self, prompt: List[int]):
+    def _prefix_scope(self, req: _Request) -> Optional[str]:
+        """The prefix-cache partition this request may share KV with:
+        its RESOLVED tenant under a tenant-scoped cache (the ISSUE 13
+        default — cross-tenant KV sharing is a timing side-channel),
+        one global scope (None) otherwise (no tenancy, or the
+        operator's ``share_prefix`` opt-out for trusted fleets).
+        Resolved, not raw: unknown labels fold into the default tenant
+        exactly like their quota/metrics identity — matching the
+        gateway's affinity-key scoping (so the cache hits its routing
+        colocates actually exist) and keeping scope cardinality
+        operator-bounded rather than client-minted."""
+        if not self._prefix_scoped:
+            return None
+        return self._tq.cfg.resolve(req.tenant)
+
+    def _prefix_match(self, prompt: List[int],
+                      scope: Optional[str] = None):
         """Pure lookup: (m, entry_key) for the longest common HEAD
-        between ``prompt`` and any cached entry — a partial entry match
+        between ``prompt`` and any cached entry in ``scope`` — a
+        partial entry match
         reuses the entry's first m KV rows (valid on their own: they are
         exactly positions 0..m), so an identical prompt resubmit reuses
         plen-1 of itself and a longer cached prompt still serves its
@@ -844,8 +1021,10 @@ class DecodeServer:
         cap = len(prompt) - 1
         best, best_key = 0, None
         for key in self._prefixes:
+            if key[0] != scope:
+                continue        # another tenant's prefix: invisible
             m = 0
-            for a, b in zip(key, prompt[:cap]):
+            for a, b in zip(key[1], prompt[:cap]):
                 if a != b:
                     break
                 m += 1
@@ -853,11 +1032,12 @@ class DecodeServer:
                 best, best_key = m, key
         return best, best_key
 
-    def _publish_prefix(self, prompt: List[int], rk, rv) -> None:
-        """Store this prompt's KV rows as a reusable prefix (trimmed to
-        the exact prompt length), evicting least-recently-used entries
-        past the cap."""
-        key = tuple(prompt)
+    def _publish_prefix(self, prompt: List[int], rk, rv,
+                        scope: Optional[str] = None) -> None:
+        """Store this prompt's KV rows as a reusable prefix in
+        ``scope`` (trimmed to the exact prompt length), evicting
+        least-recently-used entries past the cap."""
+        key = (scope, tuple(prompt))
         plen = len(prompt)
         # pop-then-set: dict assignment to an existing key keeps its OLD
         # insertion position, and a just-republished hot prefix must not
@@ -880,8 +1060,9 @@ class DecodeServer:
         if self.paged:
             return self._paged_prefill_slot(req)
         plen = len(req.prompt)
-        m, mkey = (self._prefix_match(req.prompt) if self._prefixes
-                   else (0, None))
+        m, mkey = (self._prefix_match(req.prompt,
+                                      self._prefix_scope(req))
+                   if self._prefixes else (0, None))
         if self._prefill_chunk and self._start_chunked_prefill(
                 req, m, mkey):
             return
@@ -1023,7 +1204,8 @@ class DecodeServer:
         if req.cache_prefix and not self.paged:
             # paged publish happens in _paged_install, where the slot's
             # block table (the thing being shared) exists
-            self._publish_prefix(req.prompt, row["k"], row["v"])
+            self._publish_prefix(req.prompt, row["k"], row["v"],
+                                 self._prefix_scope(req))
         if req.temperature > 0:
             # token at absolute index plen: same (seed, index) keying as
             # the decode program, so prefill vs decode is seamless
@@ -1047,11 +1229,20 @@ class DecodeServer:
                 jnp.int32(plen), jnp.int32(first), self._last)
         req.out.append(first)
         req.note_token()
+        self._note_tenant_tokens(req, 1)
         # the first token is observed HERE (the argmax/sample above was
         # a host sync): TTFT's far stamp, and the TPOT clock's arm
         req.led.t_prefill_end = req.led.t_first = req.led.t_last = \
             time.perf_counter()
         self._finish_if_done(req)
+
+    def _note_tenant_tokens(self, req: _Request, n: int) -> None:
+        """Tenant token-rate accounting — the currency the weighted
+        pick, max-rate sheds and reclaim all decide on. One scheduler
+        note per arrival (not per token), same cost discipline as the
+        latency ledger."""
+        if self._tq is not None and n:
+            self._tq.note_tokens(req.tenant, n, self._tq_clock())
 
     def _finish_if_done(self, req: _Request, admit: bool = True) -> None:
         """Completion + slot recycling. Resetting the slot's per-row pos
@@ -1125,7 +1316,8 @@ class DecodeServer:
         matched blocks are refcount-shared, not copied)."""
         bs = self.kv_block_size
         plen = len(req.prompt)
-        m, mkey = (self._pindex.match(req.prompt, plen - 1)
+        m, mkey = (self._pindex.match(req.prompt, plen - 1,
+                                      self._prefix_scope(req))
                    if self._pindex is not None else (0, None))
         # profitability: block reuse must also save prefill compute
         # (fewer query tokens per bucket tier) — same invariant as the
@@ -1251,7 +1443,8 @@ class DecodeServer:
             self.cache, self._last, jnp.int32(s), jnp.int32(plen),
             jnp.int32(first))
         if req.cache_prefix and self._pindex is not None:
-            self._pindex.publish(req.prompt, table)
+            self._pindex.publish(req.prompt, table,
+                                 self._prefix_scope(req))
             self._sync_prefix_stats()
 
     def _set_table_row(self, slot: int) -> None:
@@ -1515,6 +1708,8 @@ class DecodeServer:
         req.preempted = True
         self._pending.appendleft(req)
         self.preempts[mode] += 1
+        if self._tq is not None:
+            self._tq.note_preempt(req.tenant, mode)
         if not self._active:
             self._idle_since = None
 
@@ -1556,6 +1751,7 @@ class DecodeServer:
                 "seed": req.seed,
                 "stop_tokens": list(req.stop_tokens),
                 "priority": req.priority,
+                "tenant": req.tenant,
                 "cache_prefix": req.cache_prefix,
             }
             if req.rid in pre:
@@ -1579,6 +1775,7 @@ class DecodeServer:
                 "prompt": list(req.prompt),
                 "out": list(req.out[:req.max_new_tokens]),
                 "max_new_tokens": req.max_new_tokens,
+                "tenant": req.tenant,
                 "done": True,
             })
         return states
@@ -1611,6 +1808,7 @@ class DecodeServer:
             stop_tokens=tuple(int(t) for t in state.get("stop_tokens")
                               or ()),
             priority=int(state.get("priority", 0)),
+            tenant=str(state.get("tenant") or DEFAULT_TENANT),
             led=_Ledger(time.perf_counter()))
         req.out = list(state.get("out") or [])
         if state.get("done"):
@@ -1835,6 +2033,7 @@ class DecodeServer:
             top_p=src.top_p if top_p is None else float(top_p),
             seed=(src.seed if seed is None else int(seed)) & 0xFFFFFFFF,
             stop_tokens=src.stop_tokens, priority=src.priority,
+            tenant=src.tenant,
             led=_Ledger(time.perf_counter()))
         req.out = list(src.out)
         now = time.perf_counter()
@@ -1869,6 +2068,7 @@ class DecodeServer:
             "prefix": (self._pindex.stats()
                        if self._pindex is not None else None),
             "preempts": dict(self.preempts),
+            "tenant_reclaims": self.tenant_reclaims,
             "swapped_pending": sum(1 for r in self._pending
                                    if r.swap_state is not None),
             "hbm": self.hbm,
@@ -2082,6 +2282,7 @@ class DecodeServer:
                     break
             if n and now:
                 req.led.note_tokens(n, now)
+            self._note_tenant_tokens(req, n)
             self._finish_if_done(req, admit=False)
         return emitted
 
@@ -2195,6 +2396,7 @@ class DecodeServer:
             slots.append({
                 "slot": s,
                 "rid": req.rid,
+                "tenant": req.tenant,
                 "age_s": round(now - (req.led.t_admit
                                       or req.led.t_submit), 6),
                 "pos": len(req.prompt) + len(req.out),
@@ -2233,10 +2435,34 @@ class DecodeServer:
             # block-pool occupancy + the admission-time HBM snapshot:
             # why a request queued, answerable from one /stats read
             "kv": self.kv_stats(),
+            # request-level elastic quota: per-tenant rates vs min/max,
+            # borrow shares, sheds and reclaim preemptions — the
+            # gateway sums ``rate_tokens_per_s`` across replicas for
+            # its fleet-wide door admission
+            "tenants": self.tenant_snapshot(),
             "compiles": {"count": self.compiles,
                          "seconds": round(self.compile_s, 6)},
             "tokens_emitted": self.tokens_emitted,
         }
+
+    def tenant_snapshot(self) -> Optional[dict]:
+        """Per-tenant quota accounting for /stats and the serving
+        loop's gauge mirror; None when tenancy is off (no dead
+        sections on single-tenant servers)."""
+        if self._tq is None:
+            return None
+        snap = self._tq.snapshot(self._tq_clock())
+        pending_by, active_by = {}, {}
+        for r in self._pending:
+            t = self._tq.cfg.resolve(r.tenant)
+            pending_by[t] = pending_by.get(t, 0) + 1
+        for r in self._active.values():
+            t = self._tq.cfg.resolve(r.tenant)
+            active_by[t] = active_by.get(t, 0) + 1
+        for name, row in snap.items():
+            row["pending"] = pending_by.get(name, 0)
+            row["active"] = active_by.get(name, 0)
+        return snap
 
     def has_work(self) -> bool:
         return bool(self._active or self._pending)

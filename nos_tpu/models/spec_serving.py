@@ -748,6 +748,7 @@ class SpeculativeDecodeServer(DecodeServer):
                 # one host instant: the shared ledger template
                 # attributes the arrival gap evenly across them
                 req.led.note_tokens(n, now)
+            self._note_tenant_tokens(req, n)
             self._finish_if_done(req, admit=False)
         return emitted
 

@@ -446,7 +446,10 @@ def test_prefix_index_fuzz_conserves_blocks(seed):
             m, key = idx.match(prompt, plen - 1)
             assert m % 4 == 0
             if m > 0:
-                assert tuple(prompt[:m]) == key[:m]
+                # chain keys are (scope, tokens); scope None when the
+                # engine runs without tenant quota
+                assert key[0] is None
+                assert tuple(prompt[:m]) == key[1][:m]
                 shared = idx.take(key, m)
                 assert all(alloc.ref(b) >= 2 for b in shared)
                 for b in shared:
@@ -454,7 +457,7 @@ def test_prefix_index_fuzz_conserves_blocks(seed):
         else:
             idx.evict_lru(rng.randint(1, 4))
         assert idx.block_count <= max(idx.max_blocks,
-                                      max((blocks_for(len(k), 4)
+                                      max((blocks_for(len(k[1]), 4)
                                            for k in idx._chains), default=0))
         assert alloc.used_count == idx.block_count
     idx.clear()

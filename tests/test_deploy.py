@@ -615,3 +615,52 @@ def test_gateway_deployment_passes_routing_and_door_args():
     assert gw["admission"] == {"pendingPerReplica": 0, "hbmFrac": 0}
     assert gw["door"] == {"maxQueue": 256, "waitSeconds": 30}
     assert gw["retry"] == {"attempts": 12, "backoffSeconds": 0.05}
+
+
+def test_tenant_quota_args_plumbed_on_both_binaries():
+    """ISSUE 13 satellite: serving.tenants.* and gateway.tenants.*
+    must plumb --tenant-config (conditionally: an empty config renders
+    NO flag, keeping tenancy off by default) on both deployments, the
+    chart defaults must equal the code defaults, and the README must
+    document the rows."""
+    import yaml
+
+    spath = os.path.join(CHART, "templates", "serving",
+                         "deployment_server.yaml")
+    with open(spath) as f:
+        stext = f.read()
+    assert "--tenant-config" in stext, "serving missing --tenant-config"
+    assert ".Values.serving.tenants.config" in stext
+    assert "if .Values.serving.tenants.config" in stext, \
+        "serving --tenant-config must render only when set"
+
+    gpath = os.path.join(CHART, "templates", "gateway",
+                         "deployment_gateway.yaml")
+    with open(gpath) as f:
+        gtext = f.read()
+    assert "--tenant-config" in gtext, "gateway missing --tenant-config"
+    assert ".Values.gateway.tenants.config" in gtext
+    assert "if .Values.gateway.tenants.config" in gtext
+    assert "--tenant-quota-attempts" in gtext
+    assert ".Values.gateway.tenants.quotaAttempts" in gtext
+
+    with open(os.path.join(CHART, "values.yaml")) as f:
+        values = yaml.safe_load(f)
+    # chart defaults == code defaults: tenancy OFF out of the box
+    assert values["serving"]["tenants"] == {"config": ""}
+    assert values["gateway"]["tenants"] == {"config": "",
+                                            "quotaAttempts": 2}
+    from nos_tpu.cmd.server import ServerConfig
+
+    assert ServerConfig().tenant_config == ""
+    from nos_tpu.gateway.router import RouterConfig
+
+    assert RouterConfig().tenant_config is None
+    assert RouterConfig().tenant_quota_attempts == \
+        values["gateway"]["tenants"]["quotaAttempts"]
+
+    with open(os.path.join(CHART, "README.md")) as f:
+        readme = f.read()
+    for row in ("serving.tenants.config", "gateway.tenants.config",
+                "gateway.tenants.quotaAttempts"):
+        assert row in readme, f"helm README missing {row} row"

@@ -44,8 +44,9 @@ class FleetRouter:
                 return i, loop
         return None, None
 
-    def run(self, prompt, n, attempts=12):
-        """Returns (tokens, tries). Retries until a replica delivers."""
+    def run(self, prompt, n, attempts=12, **kw):
+        """Returns (tokens, tries). Retries until a replica delivers.
+        Extra kwargs (e.g. ``tenant=``) forward to the serving loop."""
         tried = set()
         last = None
         for _ in range(attempts):
@@ -55,7 +56,8 @@ class FleetRouter:
                 time.sleep(0.01)
                 continue
             try:
-                return loop.generate(list(prompt), n, timeout=60), i
+                return loop.generate(list(prompt), n, timeout=60,
+                                     **kw), i
             except (DrainingError, QueueFull, EngineRecovering,
                     TimeoutError, RuntimeError) as e:
                 last = e
@@ -185,6 +187,71 @@ def test_drain_during_supervised_restart_interplay():
             assert toks == expected_tokens([100 + i], 120), f"req {i}"
         delta = outcome_delta(before)
         assert delta["finished"] == 12
+    finally:
+        for lp in loops:
+            lp.shutdown()
+
+
+def test_burst_tenant_adversary_over_restart_conserves_per_tenant():
+    """ISSUE 13 chaos satellite, fleet edition: tenant-tagged traffic
+    (a guaranteed tenant + a burst adversary at many times its share)
+    rides the retrying router across replicas while one replica dies
+    through a supervised restart mid-flight. Pins per-tenant outcome
+    conservation — submitted == finished + rejected per tenant, tagged
+    by tenant at the CLIENT — and no cross-tenant double-finish after
+    the rebuilt engine restores its captured requests (every finished
+    output is exact for its own prompt, and the fleet-wide finished
+    total is exactly the per-tenant finished sum)."""
+    from nos_tpu.models.tenantquota import TenantQuotaConfig
+
+    before = outcome_totals()
+    tq = TenantQuotaConfig.from_json(
+        '{"tenants": {"gold": {"min_rate": 1000},'
+        ' "burst": {"max_rate": 1000}}}')
+    inj = FaultInjector(schedule={6: "error"})
+    loops = [
+        ServingLoop(StubEngine(tokens_per_tick=2), tenant_quota=tq),
+        ServingLoop(inj.wrap(StubEngine(tokens_per_tick=2)),
+                    engine_factory=lambda: inj.wrap(
+                        StubEngine(tokens_per_tick=2)),
+                    restart_budget=4, restart_backoff_s=0.01,
+                    tenant_quota=tq),
+        ServingLoop(StubEngine(tokens_per_tick=2), tenant_quota=tq),
+    ]
+    router = FleetRouter(loops)
+    reqs = [("gold", i) for i in range(4)] \
+        + [("burst", i) for i in range(12)]
+    results, errors = {}, {}
+
+    def worker(tenant, i):
+        prompt = [100 + i if tenant == "gold" else 200 + i]
+        try:
+            toks, replica = router.run(prompt, 80, tenant=tenant)
+            results[(tenant, i)] = (toks, replica, list(prompt))
+        except Exception as e:      # noqa: BLE001 — asserted below
+            errors[(tenant, i)] = e
+
+    threads = [threading.Thread(target=worker, args=r) for r in reqs]
+    for t in threads:
+        t.start()
+    join_all(threads, timeout=120)
+    try:
+        assert errors == {}
+        assert len(results) == len(reqs)
+        # per-tenant conservation at the client: every tagged request
+        # finished exactly once
+        by_tenant = {}
+        for (tenant, _i), (toks, _rep, prompt) in results.items():
+            by_tenant[tenant] = by_tenant.get(tenant, 0) + 1
+            # no cross-tenant double-finish / restore mix-up: the
+            # output is ITS OWN prompt's token mill, exactly
+            assert toks == expected_tokens(prompt, 80), (tenant, _i)
+        assert by_tenant == {"gold": 4, "burst": 12}
+        # fleet-wide ledger agrees: exactly one finish per request —
+        # the restarted replica's restored requests did not finish a
+        # second time anywhere
+        delta = outcome_delta(before)
+        assert delta["finished"] == len(reqs)
     finally:
         for lp in loops:
             lp.shutdown()

@@ -966,3 +966,153 @@ def test_http_transport_maps_replica_errors():
             400, {"error": "too big", "infeasible": True}))
     with pytest.raises(ValueError):
         tr._raise_for(FakeHTTPError(400, {"error": "bad json"}))
+
+
+# ---------------------------------------------------------------------------
+# request-level elastic quota at the door (ISSUE 13)
+# ---------------------------------------------------------------------------
+def _tenant_cfg(json_text='{"tenants": {"gold": {"min_rate": 100},'
+                          ' "burst": {"max_rate": 10}}}'):
+    from nos_tpu.models.tenantquota import TenantQuotaConfig
+
+    return TenantQuotaConfig.from_json(json_text)
+
+
+def test_tenant_quota_door_shed_from_scraped_stats():
+    """The gateway aggregates the replicas' per-tenant rates (the
+    /stats ``tenants`` sections the engines now publish) and sheds a
+    tenant at/over its FLEET-WIDE max with the same tenant_quota slug
+    the replicas use — before the request reaches any replica."""
+    router = GatewayRouter(
+        RouterConfig(tenant_config=_tenant_cfg()),
+        transport=lambda rep, req: req["prompt"])
+    router.update([
+        Replica(name="a", stats={"tenants": {
+            "burst": {"rate_tokens_per_s": 6.0},
+            "gold": {"rate_tokens_per_s": 50.0}}}),
+        Replica(name="b", stats={"tenants": {
+            "burst": {"rate_tokens_per_s": 5.0}}}),
+    ])
+    assert router.fleet_tenant_rate("burst") == 11.0
+    with pytest.raises(QueueFull) as e:
+        router.dispatch([1], 1, tenant="burst")
+    assert e.value.reason == "tenant_quota"
+    st = router.stats()
+    assert st["shed"] == {"tenant_quota": 1}
+    assert st["tenant_shed"] == {"burst": 1}
+    assert st["config"]["tenant_quota"]["tenants"]["burst"][
+        "max_rate"] == 10
+    # gold has no max — admitted at any rate; unknown tenants resolve
+    # to the default tenant (no max either)
+    toks, _, _ = router.dispatch([1], 1, tenant="gold")
+    assert toks == [1]
+    toks, _, _ = router.dispatch([1], 1, tenant="nobody")
+    assert toks == [1]
+    # below the fleet max the burst tenant admits too
+    router.update([Replica(name="a", stats={"tenants": {
+        "burst": {"rate_tokens_per_s": 3.0}}})])
+    toks, _, _ = router.dispatch([1], 1, tenant="burst")
+    assert toks == [1]
+
+
+def test_tenant_quota_retry_cap_and_forwarding():
+    """Per-replica tenant_quota sheds burn a SMALL dedicated retry
+    budget (a burst tenant backs off on its quota instead of walking
+    the fleet), the exhaustion re-raises as 429-shaped QueueFull with
+    the reason preserved, and the tenant forwards to the replica in
+    the request's sampling."""
+    attempts = []
+
+    def shedding_transport(rep, req):
+        attempts.append((rep.name, req["sampling"].get("tenant")))
+        raise QueueFull("tenant 'burst' is at/over its max",
+                        reason="tenant_quota")
+
+    router = GatewayRouter(
+        RouterConfig(max_attempts=12, tenant_quota_attempts=2,
+                     tenant_config=_tenant_cfg()),
+        transport=shedding_transport, sleep=lambda s: None)
+    router.update([Replica(name="a"), Replica(name="b"),
+                   Replica(name="c")])
+    with pytest.raises(QueueFull) as e:
+        router.dispatch([1], 1, tenant="burst")
+    assert e.value.reason == "tenant_quota"
+    # exactly tenant_quota_attempts attempts — not max_attempts
+    assert len(attempts) == 2
+    assert all(t == "burst" for _, t in attempts)
+    assert router.stats()["requests"]["failed"] == 1
+
+    # ordinary capacity sheds still get the full ladder
+    attempts.clear()
+
+    def capacity_shed(rep, req):
+        attempts.append(rep.name)
+        raise QueueFull("full", reason="queue_full")
+
+    router2 = GatewayRouter(
+        RouterConfig(max_attempts=5, tenant_quota_attempts=2,
+                     tenant_config=_tenant_cfg()),
+        transport=capacity_shed, sleep=lambda s: None)
+    router2.update([Replica(name="a"), Replica(name="b")])
+    with pytest.raises(QueueFull) as e:
+        router2.dispatch([1], 1, tenant="burst")
+    assert e.value.reason == "queue_full"
+    assert len(attempts) == 5
+
+
+def test_prefix_key_tenant_scoping_and_opt_out():
+    """Tenant-scoped affinity keys (the routing twin of the replicas'
+    tenant-scoped PrefixBlockIndex chains): same prompt, different
+    tenants -> different keys; share_prefix collapses the scope."""
+    bs = 16
+    prompt = list(range(2 * bs))
+    k_none = prefix_key(prompt, bs)
+    k_a = prefix_key(prompt, bs, tenant="a")
+    k_b = prefix_key(prompt, bs, tenant="b")
+    assert len({k_none, k_a, k_b}) == 3         # all disjoint
+    assert prefix_key(prompt, bs, tenant="a") == k_a   # stable
+
+    router = GatewayRouter(
+        RouterConfig(tenant_config=_tenant_cfg()),
+        transport=lambda rep, req: req["prompt"])
+    assert router._key_scope("gold") == "gold"
+    assert router._key_scope("nobody") == "default"     # resolved
+    assert router._key_scope(None) == "default"
+    shared = GatewayRouter(
+        RouterConfig(tenant_config=_tenant_cfg(
+            '{"share_prefix": true, "tenants": {}}')),
+        transport=lambda rep, req: req["prompt"])
+    assert shared._key_scope("gold") is None            # opt-out
+    # no tenant config: legacy tenant-free keys even for labeled
+    # traffic — the replicas only scope their caches under a tenant
+    # config, and splitting keys they don't scope by would scatter a
+    # shared prefix across replicas for no isolation gain
+    bare = GatewayRouter(RouterConfig(),
+                         transport=lambda rep, req: req["prompt"])
+    assert bare._key_scope("x") is None
+    assert bare._key_scope(None) is None
+
+
+def test_tenant_rides_streams_and_admission():
+    """The stream path shares the door admission and the forwarding:
+    an over-fleet-max tenant's stream sheds tenant_quota before the
+    first byte; an admitted stream forwards the tenant."""
+    seen = {}
+
+    def stream_transport(rep, req):
+        seen["tenant"] = req["sampling"].get("tenant")
+        yield [1, 2]
+        yield [3]
+
+    router = GatewayRouter(
+        RouterConfig(tenant_config=_tenant_cfg()),
+        stream_transport=stream_transport)
+    router.update([Replica(name="a", stats={"tenants": {
+        "burst": {"rate_tokens_per_s": 50.0}}})])
+    gen = router.stream([1], 4, tenant="burst")
+    with pytest.raises(QueueFull) as e:
+        next(gen)
+    assert e.value.reason == "tenant_quota"
+    out = list(router.stream([1], 4, tenant="gold"))
+    assert out == [[1, 2], [3]]
+    assert seen["tenant"] == "gold"

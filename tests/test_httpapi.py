@@ -1009,3 +1009,114 @@ def test_metricsexporter_quota_slack_gauges_and_snapshot():
         assert "team-d" not in doc["quota_slack"]
     finally:
         http.stop()
+
+
+def test_serving_http_tenant_wire_and_tenant_quota_shed():
+    """ISSUE 13 satellite: tenant identity travels the wire (JSON
+    field beats X-Tenant header), a tenant at/over its max sheds 429
+    with the machine-readable ``tenant_quota`` reason + Retry-After,
+    malformed tenant names 400, and the per-tenant shed counter lands
+    in /metrics. Jax-free stub engine — the quota DECISION lives in
+    DecodeServer (tested in test_tenant_serving.py); here the stub
+    raises what the engine would and the wire shape is pinned."""
+    from nos_tpu.cmd.server import (
+        ServerConfig, ServingLoop, make_http_server,
+    )
+    from nos_tpu.models.errors import TenantQuotaExceeded
+    from nos_tpu.models.tenantquota import TenantQuotaConfig
+
+    seen = []
+
+    class Engine:
+        def __init__(self):
+            self.n = 0
+            self.res = {}
+
+        def has_work(self):
+            return False
+
+        def step(self):
+            return 0
+
+        def submit(self, prompt, max_new_tokens, **kw):
+            seen.append(kw.get("tenant"))
+            if kw.get("tenant") == "burst":
+                raise TenantQuotaExceeded(
+                    "tenant 'burst' is at 99.0 tokens/s, max 5.0, "
+                    "with the engine under contention")
+            rid = self.n
+            self.n += 1
+            self.res[rid] = (list(prompt), [7] * max_new_tokens)
+            return rid
+
+        def progress(self, rid):
+            r = self.res.get(rid)
+            return (list(r[1]), True) if r is not None else None
+
+        def pop_result(self, rid):
+            r = self.res.pop(rid, None)
+            return None if r is None else r[0] + r[1]
+
+    tq = TenantQuotaConfig.from_json(
+        '{"tenants": {"gold": {"min_rate": 100},'
+        ' "burst": {"max_rate": 5}}}')
+    loop = ServingLoop(Engine(), tenant_quota=tq)
+    httpd = make_http_server(ServerConfig(port=0), loop)
+    t = threading.Thread(target=httpd.serve_forever, daemon=True)
+    t.start()
+    base = f"http://127.0.0.1:{httpd.server_address[1]}"
+
+    def post(body, headers=()):
+        hdrs = {"Content-Type": "application/json"}
+        hdrs.update(dict(headers))
+        req = urllib.request.Request(
+            base + "/v1/generate", data=json.dumps(body).encode(),
+            headers=hdrs, method="POST")
+        with urllib.request.urlopen(req, timeout=30) as r:
+            return json.loads(r.read())
+
+    try:
+        # header route
+        out = post({"prompt": [1, 2], "max_new_tokens": 2},
+                   headers=[("X-Tenant", "gold")])
+        assert out["tokens"] == [1, 2, 7, 7]
+        assert seen[-1] == "gold"
+        # body field beats the header
+        post({"prompt": [1], "max_new_tokens": 1, "tenant": "gold"},
+             headers=[("X-Tenant", "burst")])
+        assert seen[-1] == "gold"
+        # unlabeled: no tenant kwarg reaches the engine
+        post({"prompt": [1], "max_new_tokens": 1})
+        assert seen[-1] is None
+
+        # the tenant_quota shed: 429 + Retry-After + the reason slug
+        with pytest.raises(urllib.error.HTTPError) as e:
+            post({"prompt": [1], "max_new_tokens": 1,
+                  "tenant": "burst"})
+        assert e.value.code == 429
+        assert e.value.headers.get("Retry-After") == "1"
+        body = json.loads(e.value.read())
+        assert body["reason"] == "tenant_quota"
+        assert "burst" in body["error"]
+
+        # malformed tenant name: clean 400, never a metric label
+        with pytest.raises(urllib.error.HTTPError) as e:
+            post({"prompt": [1], "max_new_tokens": 1,
+                  "tenant": "x" * 300})
+        assert e.value.code == 400
+        assert json.loads(e.value.read())["reason"] == "bad_request"
+
+        # the shed counted under the tenant's label
+        with urllib.request.urlopen(base + "/metrics", timeout=30) as r:
+            metrics = r.read().decode()
+        assert 'nos_tpu_serve_tenant_shed_total{reason="tenant_quota"' \
+            in metrics or "nos_tpu_serve_tenant_shed_total" in metrics
+        assert 'tenant="burst"' in metrics
+        # stats surfaces the quota config echo for drift detection
+        with urllib.request.urlopen(base + "/stats", timeout=30) as r:
+            stats = json.loads(r.read())
+        assert stats["healthy"] is True
+    finally:
+        httpd.shutdown()
+        loop.shutdown()
+        httpd.server_close()

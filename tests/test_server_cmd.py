@@ -1234,3 +1234,40 @@ def test_supervisor_and_deadline_flags_override_config():
     cfg = ServerConfig()
     assert cfg.restart_budget == 2 and cfg.watchdog_s == 0.0
     assert cfg.default_deadline_s == 0.0
+
+
+def test_tenant_config_flag_overrides_and_validates_early():
+    """--tenant-config reaches the ServerConfig the engine factory
+    closes over (ISSUE 13 CI satellite), a malformed inline JSON is a
+    clean config error BEFORE any model load, and tenancy is off by
+    default (empty string -> TenantQuotaConfig.load returns None)."""
+    from nos_tpu.cmd import server as server_mod
+    from nos_tpu.models.tenantquota import TenantQuotaConfig
+
+    seen = {}
+
+    def fake_build(cfg):
+        seen["cfg"] = cfg
+        raise SystemExit(0)          # stop before the serving loop
+
+    real = server_mod.build_engine
+    server_mod.build_engine = fake_build
+    try:
+        spec = ('{"tenants": {"gold": {"min_rate": 200},'
+                ' "burst": {"max_rate": 50}}}')
+        with pytest.raises(SystemExit):
+            server_mod.main(["--tenant-config", spec])
+        cfg = seen["cfg"]
+        assert cfg.tenant_config == spec
+        parsed = TenantQuotaConfig.load(cfg.tenant_config)
+        assert parsed.tenants["gold"].min_rate == 200
+        # min > max is a parse-time config error (fires in main's own
+        # loop-side parse, before the fake factory even runs)
+        with pytest.raises(ValueError, match="min_rate"):
+            server_mod.main([
+                "--tenant-config",
+                '{"tenants": {"a": {"min_rate": 9, "max_rate": 3}}}'])
+    finally:
+        server_mod.build_engine = real
+    assert ServerConfig().tenant_config == ""
+    assert TenantQuotaConfig.load("") is None

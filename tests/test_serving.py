@@ -266,7 +266,7 @@ def test_prefix_lru_eviction(params):
         srv.submit(base, 1, cache_prefix=True)
         srv.drain()
     assert len(srv._prefixes) == 2
-    assert (1, 2, 3) not in srv._prefixes
+    assert (None, (1, 2, 3)) not in srv._prefixes
     rid = srv.submit([1, 2, 3, 10], 3)               # evicted: no hit
     got = srv.drain()[rid]
     assert srv.prefix_hits == 0
@@ -311,8 +311,10 @@ def test_republish_refreshes_lru_position(params):
     for base in ([1, 2, 3], [4, 5, 6], [1, 2, 3], [7, 8, 9]):
         srv.submit(base, 1, cache_prefix=True)
         srv.drain()
-    assert (1, 2, 3) in srv._prefixes          # republished: survived
-    assert (4, 5, 6) not in srv._prefixes      # oldest: evicted
+    # keys are (scope, tokens): scope None outside tenant quota (the
+    # tenant-scoped prefix cache partitions by request tenant)
+    assert (None, (1, 2, 3)) in srv._prefixes      # republished: survived
+    assert (None, (4, 5, 6)) not in srv._prefixes  # oldest: evicted
 
 
 # ---------------------------------------------------------------------------

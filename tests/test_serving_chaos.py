@@ -835,3 +835,97 @@ def test_chaos_soak_outcome_conservation(seed):
         assert not loop.engine.reqs
     finally:
         loop.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# burst-tenant adversary over a supervised restart (ISSUE 13 chaos
+# satellite): quota reclaim preempts the over-quota tenant, the engine
+# then DIES, and the rebuilt engine restores the preempted requests —
+# per-tenant conservation + no cross-tenant double-finish
+# ---------------------------------------------------------------------------
+
+def test_tenant_burst_adversary_restart_conserves_per_tenant(
+        real_params):
+    """A burst tenant holds every slot; a guaranteed tenant's arrival
+    reclaims one (bit-exact preempt); an injected engine failure then
+    kills the engine with the preempted request still PENDING — the
+    rebuilt engine must restore everything under the right tenants.
+    Pins: every request finishes exactly once, bit-identical to its
+    OWN prompt's undisturbed run (a cross-tenant double-finish or
+    restore mix-up would corrupt some output), per-tenant token
+    accounting matches what each tenant's requests actually produced,
+    and the reclaim preemption is charged to the burst tenant."""
+    import jax.numpy as jnp
+
+    from nos_tpu.models.generate import generate
+    from nos_tpu.models.serving import DecodeServer
+    from nos_tpu.models.tenantquota import (
+        TenantQuotaConfig, TenantSpec,
+    )
+
+    params, cfg = real_params
+    tq = TenantQuotaConfig(
+        tenants={"gold": TenantSpec("gold", min_rate=1000.0),
+                 "burst": TenantSpec("burst", max_rate=1000.0)},
+        window_s=8.0)
+
+    def mk():
+        return DecodeServer(params, cfg, max_batch=2, kv_block_size=8,
+                            kv_blocks=33, kv_swap=True,
+                            tenant_quota=tq)
+
+    inj = FaultInjector(schedule={4: "error"})
+    loop = ServingLoop(inj.wrap(mk()),
+                       engine_factory=lambda: inj.wrap(mk()),
+                       restart_budget=2, restart_backoff_s=0.01,
+                       tenant_quota=tq)
+    reg = default_registry()
+    tok_c = reg.counter("nos_tpu_serve_tenant_tokens_total", "",
+                        ("tenant",))
+    pre_c = reg.counter("nos_tpu_serve_tenant_preempt_total", "",
+                        ("tenant", "mode"))
+    tok0 = {t: tok_c.value(t) for t in ("gold", "burst")}
+    pre0 = pre_c.value("burst", "swap")
+
+    prompts = {"burst-0": ([1, 2, 3], 8), "burst-1": ([4, 5, 6], 8),
+               "gold-0": ([7, 8], 6)}
+    outs = {}
+
+    def worker(name, tenant, prompt, n):
+        outs[name] = loop.generate(list(prompt), n, timeout=180,
+                                   tenant=tenant)
+
+    bthreads = [threading.Thread(
+        target=worker, args=(k, "burst", *prompts[k]))
+        for k in ("burst-0", "burst-1")]
+    for t in bthreads:
+        t.start()
+    # wait until burst holds BOTH slots so gold's arrival must reclaim
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline:
+        snap = getattr(loop.engine, "tenant_snapshot", lambda: None)()
+        if snap and snap["burst"]["active"] == 2:
+            break
+        time.sleep(0.005)
+    gthread = threading.Thread(
+        target=worker, args=("gold-0", "gold", *prompts["gold-0"]))
+    gthread.start()
+    for t in bthreads + [gthread]:
+        t.join(300)
+    try:
+        assert loop._sup.restarts == 1, "fault did not fire"
+        assert loop._sup.lost == 0
+        # no cross-tenant double-finish / restore mix-up: each output
+        # is ITS OWN prompt's undisturbed run, token for token
+        for name, (prompt, n) in prompts.items():
+            want = [int(t) for t in generate(
+                params, cfg, jnp.asarray([prompt], jnp.int32), n)[0]]
+            assert outs.get(name) == want, name
+        # per-tenant conservation: tokens accounted under each tenant
+        # == what that tenant's finished requests produced
+        assert tok_c.value("gold") - tok0["gold"] == 6
+        assert tok_c.value("burst") - tok0["burst"] == 16
+        # the reclaim was charged to the burst tenant (swap mode)
+        assert pre_c.value("burst", "swap") - pre0 >= 1
+    finally:
+        loop.shutdown()
