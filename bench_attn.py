@@ -1,18 +1,38 @@
 #!/usr/bin/env python3
-"""Attention-kernel microbench at the flagship shape: forward and
-forward+backward wall-clock for each dispatchable implementation
-(splash / legacy flash / XLA), so kernel choice and block-size sweeps are
-decided by measurement, not vibes. Timing fence is the host transfer
-(block_until_ready lies on 'axon' — see bench_mfu.py).
+"""Attention-kernel microbench, one JSON line per measured point.
 
-Usage: python bench_attn.py [reps]
-Env: NOS_TPU_SPLASH_* block-size overrides are honored (ops/attention.py);
-NOS_TPU_ATTN_ONLY=<impl> restricts to one implementation so an
-orchestrator can isolate each kernel in its own process (a wedged Mosaic
-compile then kills one point, not the whole comparison — the round-3
-outage playbook).
-Prints one JSON line per impl.
+Sections (--sections, default both):
+
+- ``attn``: forward and forward+backward wall-clock for each
+  dispatchable training-shape implementation (splash / legacy flash /
+  XLA) at the flagship shape, so kernel choice and block-size sweeps
+  are decided by measurement, not vibes.
+- ``paged_decode``: the serving decode step over a PAGED arena —
+  XLA-gather formulation vs the fused Pallas table-walk kernel
+  (ops.attention.paged_decode_attention) vs the slot-static contiguous
+  cache, across context lengths (--paged-ctx, default 1k/4k/16k) and
+  kv dtypes bf16/int8. The XLA point materializes the gathered
+  timeline (plus a dequantized copy for int8) exactly like
+  forward_paged's escape hatch; the kernel point streams arena blocks
+  in-kernel with dequant fused into the inner loop. Off-TPU the kernel
+  only runs in interpret mode, which measures nothing — those points
+  print as skipped unless --paged-interpret forces them (parity
+  checks, not perf).
+
+Timing fence is the host transfer (block_until_ready lies on 'axon' —
+see bench_mfu.py).
+
+Usage: python bench_attn.py [reps] [--sections attn,paged_decode]
+                            [--paged-ctx 1024,4096,16384] ...
+Env: NOS_TPU_SPLASH_* block-size overrides are honored
+(ops/attention.py); NOS_TPU_ATTN_ONLY=<impl> restricts the attn
+section to one implementation and NOS_TPU_PAGED_ONLY=<impl>
+(xla|kernel|slot_static) does the same for paged_decode, so an
+orchestrator can isolate each kernel in its own process (a wedged
+Mosaic compile then kills one point, not the whole comparison — the
+round-3 outage playbook).
 """
+import argparse
 import json
 import os
 import sys
@@ -23,10 +43,30 @@ sys.path.insert(0, ".")
 from bench import BATCH, MODEL, SEQ, phase_marker  # noqa: E402
 from bench_mfu import host_fence  # noqa: E402
 
-REPS = int(sys.argv[1]) if len(sys.argv) > 1 else 10
+PAGED_IMPLS = ("xla", "kernel", "slot_static")
 
 
-def main():
+def parse_args(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("reps", nargs="?", type=int, default=10,
+                    help="timed repetitions per point (default 10)")
+    ap.add_argument("--sections", default="attn,paged_decode",
+                    help="comma list of sections to run: "
+                         "attn,paged_decode")
+    ap.add_argument("--paged-ctx", default="1024,4096,16384",
+                    help="paged_decode context lengths, comma list")
+    ap.add_argument("--paged-batch", type=int, default=8,
+                    help="paged_decode decode batch (rows)")
+    ap.add_argument("--paged-block", type=int, default=128,
+                    help="paged-KV block size in tokens")
+    ap.add_argument("--paged-interpret", action="store_true",
+                    help="run the Pallas kernel points in interpret "
+                         "mode off-TPU (exactness probing; the timings "
+                         "are meaningless)")
+    return ap.parse_args(argv)
+
+
+def attn_section(reps):
     import jax
     import jax.numpy as jnp
 
@@ -69,10 +109,10 @@ def main():
 
             phase("fwd_timing")
             t0 = time.perf_counter()
-            for _ in range(REPS):
+            for _ in range(reps):
                 out = fwd(q, k, v)
             host_fence(out)
-            t_fwd = (time.perf_counter() - t0) / REPS
+            t_fwd = (time.perf_counter() - t0) / reps
 
             phase("bwd_compile")
             t0 = time.perf_counter()
@@ -82,10 +122,10 @@ def main():
 
             phase("bwd_timing")
             t0 = time.perf_counter()
-            for _ in range(REPS):
+            for _ in range(reps):
                 g = grad(q, k, v)
             host_fence(g[0])
-            t_bwd = (time.perf_counter() - t0) / REPS
+            t_bwd = (time.perf_counter() - t0) / reps
             phase("done")
         except Exception as e:
             print(json.dumps({"impl": impl,
@@ -100,6 +140,159 @@ def main():
             "compile_fwd_s": round(compile_fwd, 1),
             "compile_bwd_s": round(compile_bwd, 1),
         }), flush=True)
+
+
+def paged_decode_section(args):
+    """Decode-step attention over a paged arena, one JSON line per
+    (ctx, kv_dtype, impl) point. Shapes ride the flagship MODEL dims;
+    every row decodes at pos = ctx - 1 (the worst-case full-context
+    step the TPOT tail is made of)."""
+    import jax
+    import jax.numpy as jnp
+
+    from nos_tpu.models.generate import _cached_attention
+    from nos_tpu.ops import attention as at
+
+    reps = args.reps
+    b = args.paged_batch
+    bs = args.paged_block
+    h, hkv = MODEL["n_heads"], MODEL["n_kv_heads"]
+    d = MODEL["d_model"] // h
+    on_tpu = jax.default_backend() == "tpu"
+    only = os.environ.get("NOS_TPU_PAGED_ONLY", "")
+    if only and only not in PAGED_IMPLS:
+        # fail fast: a typo'd isolation env would otherwise measure
+        # the fallthrough path and emit a mislabeled point
+        raise SystemExit(
+            f"NOS_TPU_PAGED_ONLY must be one of {PAGED_IMPLS}, "
+            f"got {only!r}")
+    impls = [only] if only else list(PAGED_IMPLS)
+    rng = jax.random.PRNGKey(0)
+
+    def point(ctx, kv_dtype, impl):
+        base = {"section": "paged_decode", "ctx": ctx,
+                "kv_dtype": kv_dtype, "impl": impl,
+                "shape": f"b{b} h{h} kv{hkv} d{d} bs{bs}"}
+        if impl == "slot_static" and kv_dtype == "int8":
+            return dict(base, skipped="int8 requires the paged arena "
+                                      "(no slot-static scale storage)")
+        os.environ["NOS_TPU_PAGED_KERNEL"] = \
+            "1" if impl == "kernel" else "0"
+        if impl == "kernel":
+            eff = at.effective_paged_impl(d)
+            if eff != "kernel":
+                return dict(base, skipped=f"dispatches {eff}")
+            if not on_tpu and not args.paged_interpret:
+                return dict(base, skipped="interpret-only off TPU "
+                                          "(--paged-interpret forces)")
+        nb = ctx // bs
+        ks = jax.random.split(rng, 4)
+        q = jax.random.normal(ks[0], (b, h, 1, d), jnp.bfloat16)
+        pos = jnp.full((b,), ctx - 1, jnp.int32)
+        if impl == "slot_static":
+            ck = jax.random.normal(ks[1], (b, hkv, ctx, d), jnp.bfloat16)
+            cv = jax.random.normal(ks[2], (b, hkv, ctx, d), jnp.bfloat16)
+            step = jax.jit(lambda q, ck, cv, pos: _cached_attention(
+                q, ck, cv, pos[:, None], d ** -0.5))
+            operands = (q, ck, cv, pos)
+        else:
+            nb_phys = b * nb + 1
+            ka = jax.random.normal(
+                ks[1], (nb_phys, hkv, bs, d), jnp.bfloat16)
+            va = jax.random.normal(
+                ks[2], (nb_phys, hkv, bs, d), jnp.bfloat16)
+            table = (1 + jnp.arange(b * nb, dtype=jnp.int32)
+                     ).reshape(b, nb)
+            if kv_dtype == "int8":
+                ka, kscale = at.quantize_kv(ka)
+                va, vscale = at.quantize_kv(va)
+
+                if impl == "kernel":
+                    def step_fn(q, ka, va, ksc, vsc, table, pos):
+                        return at.paged_decode_attention(
+                            q, ka, va, table, pos,
+                            k_scale=ksc, v_scale=vsc)
+                else:
+                    def step_fn(q, ka, va, ksc, vsc, table, pos):
+                        gk = at.dequantize_kv(
+                            at.paged_gather_kv(ka, table),
+                            at.paged_gather_scale(ksc, table),
+                            jnp.bfloat16)
+                        gv = at.dequantize_kv(
+                            at.paged_gather_kv(va, table),
+                            at.paged_gather_scale(vsc, table),
+                            jnp.bfloat16)
+                        return _cached_attention(
+                            q, gk, gv, pos[:, None], d ** -0.5)
+                operands = (q, ka, va, kscale, vscale, table, pos)
+            else:
+                if impl == "kernel":
+                    def step_fn(q, ka, va, table, pos):
+                        return at.paged_decode_attention(
+                            q, ka, va, table, pos)
+                else:
+                    def step_fn(q, ka, va, table, pos):
+                        return _cached_attention(
+                            q, at.paged_gather_kv(ka, table),
+                            at.paged_gather_kv(va, table),
+                            pos[:, None], d ** -0.5)
+                operands = (q, ka, va, table, pos)
+            step = jax.jit(step_fn)
+        try:
+            phase_marker(f"paged_{impl}", f"ctx{ctx}_{kv_dtype}_compile")
+            t0 = time.perf_counter()
+            out = step(*operands)
+            host_fence(out)
+            compile_s = time.perf_counter() - t0
+            phase_marker(f"paged_{impl}", f"ctx{ctx}_{kv_dtype}_timing")
+            t0 = time.perf_counter()
+            for _ in range(reps):
+                out = step(*operands)
+            host_fence(out)
+            step_ms = (time.perf_counter() - t0) / reps * 1e3
+        except Exception as e:
+            return dict(base, error=f"{type(e).__name__}: {e}"[:200])
+        # bytes the formulation moves per step (the model the doc
+        # carries): every impl reads the live KV once; the XLA paged
+        # point ALSO writes + re-reads the gathered bf16 view (and for
+        # int8, the materialized dequantized copy is that view)
+        kv_bytes = 2 * b * hkv * ctx * d * (1 if kv_dtype == "int8"
+                                            else 2)
+        scale_bytes = 2 * b * hkv * ctx * 4 if kv_dtype == "int8" else 0
+        view_bytes = 2 * b * hkv * ctx * d * 2
+        traffic = kv_bytes + scale_bytes
+        if impl == "xla":
+            traffic += 2 * view_bytes          # write view + read back
+        return dict(
+            base,
+            eff=("kernel" if impl == "kernel"
+                 else "xla" if impl == "xla" else "slot_static"),
+            interpret=bool(impl == "kernel" and not on_tpu),
+            decode_step_ms=round(step_ms, 4),
+            compile_s=round(compile_s, 2),
+            model_bytes_per_step=traffic,
+        )
+
+    for ctx in [int(c) for c in args.paged_ctx.split(",") if c]:
+        if ctx % bs:
+            # a truncated paged arena vs a full-ctx slot-static cache
+            # would be an unfair, mislabeled comparison — refuse the
+            # point instead of silently rounding
+            raise SystemExit(
+                f"--paged-ctx {ctx} must be a multiple of "
+                f"--paged-block {bs}")
+        for kv_dtype in ("bf16", "int8"):
+            for impl in impls:
+                print(json.dumps(point(ctx, kv_dtype, impl)), flush=True)
+
+
+def main(argv=None):
+    args = parse_args(argv)
+    sections = [s.strip() for s in args.sections.split(",") if s.strip()]
+    if "attn" in sections:
+        attn_section(args.reps)
+    if "paged_decode" in sections:
+        paged_decode_section(args)
 
 
 if __name__ == "__main__":

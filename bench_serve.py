@@ -497,6 +497,10 @@ def main():
             kv = eng.kv_stats()
             rep["preempts"] = kv["preempts"]
             rep["blocks_total"] = kv["blocks_total"]
+            # which paged decode-attention formulation the engine's
+            # programs traced (NOS_TPU_PAGED_KERNEL): "kernel" = the
+            # fused Pallas table walk, "xla" = the gather formulation
+            rep["kernel"] = kv["kernel"]
         return rep
 
     static_rep = concurrency_rep(
@@ -509,6 +513,7 @@ def main():
     paged_section = {
         "kv_block_size": KV_BLOCK,
         "kv_blocks": kv_blocks,
+        "kernel": paged_rep["kernel"],
         "budget_tokens": budget_tokens,
         "max_len": PAGED_MAX_LEN,
         "trace_requests": len(trace),
@@ -625,6 +630,7 @@ def main():
         True, int8_trace)
     int8_section = {
         "budget_bytes": budget_bytes,
+        "kernel": int8_rep["kernel"],
         "bytes_per_token": {"bf16": bpt_bf16, "int8": bpt_int8},
         "kv_blocks": {"bf16": blocks_bf16, "int8": blocks_int8},
         "trace_requests": len(int8_trace),

@@ -86,10 +86,21 @@ QUEUES = {
          mfu_env(32, "minimal", 512), 1500, "parity_flash"),
         ("parity_splash", ["hack/attn_parity.py"],
          {"NOS_TPU_ATTN_IMPL": "splash"}, 1200, None),
-        ("attn_splash", ["bench_attn.py", "5"],
+        ("attn_splash", ["bench_attn.py", "5", "--sections", "attn"],
          {"NOS_TPU_ATTN_ONLY": "splash"}, 1200, None),
-        ("attn_flash", ["bench_attn.py", "5"],
+        ("attn_flash", ["bench_attn.py", "5", "--sections", "attn"],
          {"NOS_TPU_ATTN_ONLY": "flash"}, 1200, None),
+        # paged decode-attention formulations, one process per impl so
+        # a wedged Mosaic compile kills one point (round-3 playbook)
+        ("paged_decode_xla",
+         ["bench_attn.py", "5", "--sections", "paged_decode"],
+         {"NOS_TPU_PAGED_ONLY": "xla"}, 1200, None),
+        ("paged_decode_kernel",
+         ["bench_attn.py", "5", "--sections", "paged_decode"],
+         {"NOS_TPU_PAGED_ONLY": "kernel"}, 1200, None),
+        ("paged_decode_static",
+         ["bench_attn.py", "5", "--sections", "paged_decode"],
+         {"NOS_TPU_PAGED_ONLY": "slot_static"}, 1200, None),
         ("mfu_b8_exceptmlp512_splash", ["bench_mfu.py"],
          mfu_env(8, "except_mlp", 512, attn="splash"), 1500,
          "parity_splash"),

@@ -29,6 +29,7 @@ import argparse
 import json
 import logging
 import math
+import os
 import threading
 import time
 from collections import deque
@@ -163,6 +164,16 @@ class ServerConfig:
     # slot-static engine has no per-block scale storage and the server
     # rejects the combination with a clear error.
     kv_dtype: str = "bf16"
+    # paged decode-attention formulation: "on" = the fused Pallas
+    # kernel (paged_decode_attention walks the block table in-kernel,
+    # streams KV blocks HBM->VMEM and fuses the int8 dequant into the
+    # attention inner loop — no materialized gather), "off" = the XLA
+    # gather formulation, which stays the escape hatch and the parity
+    # oracle. Plumbed as NOS_TPU_PAGED_KERNEL for the engine (the flag
+    # is authoritative on a server: a restart must trace the same
+    # formulation). Default off: flip on per fleet after burn-in; the
+    # config echo surfaces drift. Requires kv_blocks > 0.
+    paged_kernel: str = "off"
     # HBM backstop on admission (0 = off): defer admitting while
     # device bytes_in_use / bytes_limit exceeds this fraction, per the
     # same memory_stats() the HBM gauges sample (backends without
@@ -1811,6 +1822,29 @@ def build_engine(cfg: ServerConfig):
             "kv_blocks/kv_block_size (the slot-static engine has no "
             "per-block scale storage, so int8 KV is not supported "
             "there) — or run kv_dtype=bf16")
+    if cfg.paged_kernel not in ("on", "off"):
+        raise ValueError(
+            f"paged_kernel must be on|off, got {cfg.paged_kernel!r}")
+    if cfg.paged_kernel == "on" and not cfg.kv_blocks:
+        raise ValueError(
+            "paged_kernel=on requires the paged KV cache: set "
+            "kv_blocks/kv_block_size (the kernel walks per-slot block "
+            "tables; the slot-static engine has none) — or run "
+            "paged_kernel=off")
+    if cfg.paged_kernel == "on" and cfg.draft_checkpoint_dir:
+        raise ValueError(
+            "paged_kernel=on is not supported with speculative "
+            "decoding yet: the spec engine's verify windows run the "
+            "S>1 gather formulation, and mixing it with kernel decode "
+            "would break greedy's bit-identity to plain decoding — "
+            "the engine would silently clamp the kernel off, so "
+            "reject the contradictory config instead (kernelized "
+            "verify windows are the ROADMAP follow-up)")
+    # plumbed by env so every trace site (base + speculative engines,
+    # and the supervisor's rebuild factory, which re-enters here) sees
+    # one authoritative answer; set BEFORE the engine compiles
+    os.environ["NOS_TPU_PAGED_KERNEL"] = \
+        "1" if cfg.paged_kernel == "on" else "0"
     if cfg.draft_checkpoint_dir and cfg.draft_n_tokens < 1:
         raise ValueError(
             f"draft_n_tokens must be >= 1, got {cfg.draft_n_tokens}")
@@ -2219,6 +2253,17 @@ def main(argv: Optional[Sequence[str]] = None) -> None:
              "and requires --kv-blocks (the slot-static engine has no "
              "scale storage; rejected with a clear error)")
     parser.add_argument(
+        "--paged-kernel", choices=("on", "off"), default=None,
+        help="paged decode-attention formulation (overrides config): "
+             "on = the fused Pallas kernel (in-kernel block-table "
+             "walk, int8 dequant fused into the attention inner loop "
+             "— no materialized gather; requires --kv-blocks), off = "
+             "the XLA gather formulation (the escape hatch and the "
+             "parity oracle). Not yet supported with speculative "
+             "decoding (rejected at startup: verify windows pin the "
+             "gather formulation). Plumbed as NOS_TPU_PAGED_KERNEL; "
+             "echoed in /stats config for fleet drift detection")
+    parser.add_argument(
         "--draft-checkpoint-dir", default=None,
         help="enable speculative decoding: checkpoint of the draft "
              "model that proposes --draft-n-tokens per verify window "
@@ -2293,6 +2338,8 @@ def main(argv: Optional[Sequence[str]] = None) -> None:
         cfg.kv_swap = args.kv_swap == "on"
     if args.kv_dtype is not None:
         cfg.kv_dtype = args.kv_dtype
+    if args.paged_kernel is not None:
+        cfg.paged_kernel = args.paged_kernel
     if args.draft_checkpoint_dir is not None:
         cfg.draft_checkpoint_dir = args.draft_checkpoint_dir
     if args.draft_n_tokens is not None:
@@ -2351,6 +2398,10 @@ def main(argv: Optional[Sequence[str]] = None) -> None:
             "kv_blocks": cfg.kv_blocks,
             "kv_swap": cfg.kv_swap,
             "kv_dtype": cfg.kv_dtype,
+            # kernel drift between replicas would make decode numerics
+            # replica-dependent (online-softmax vs gather formulation)
+            # — surface it in the same drift detector as every knob
+            "paged_kernel": cfg.paged_kernel,
             "speculative": bool(cfg.draft_checkpoint_dir),
             "draft_n_tokens": (cfg.draft_n_tokens
                                if cfg.draft_checkpoint_dir else 0),

@@ -128,7 +128,8 @@ def init_paged_cache(cfg: TransformerConfig, kv_blocks: int,
 
 def forward_paged(
     params: Params, cfg: TransformerConfig, tokens: jax.Array,
-    cache: Cache, table: jax.Array,
+    cache: Cache, table: jax.Array, *,
+    paged_impl: Optional[str] = None,
 ) -> Tuple[jax.Array, Cache]:
     """``forward_with_cache`` over a paged arena: tokens [B, S] (the
     next S tokens after each row's ``cache['pos']``), per-slot block
@@ -149,13 +150,38 @@ def forward_paged(
     downstream of the dequant is the SAME program — the int8
     self-consistency contract (serving == reference generate through
     the identical int8 KV path) holds because writer and reader share
-    these exact quantize/dequantize ops."""
+    these exact quantize/dequantize ops.
+
+    Decode steps (S == 1) dispatch the fused Pallas kernel when
+    ``NOS_TPU_PAGED_KERNEL=1`` (``ops.attention.effective_paged_impl``):
+    ``paged_decode_attention`` walks the block table in-kernel and
+    fuses the int8 dequant into the attention inner loop, so neither
+    the gathered timeline nor a dequantized bf16 copy is ever
+    materialized. Prefill and wider windows (S > 1) keep the XLA
+    gather — its view is BIT-identical to the slot-static timeline,
+    which is what keeps serving's slot-static prefill and this path
+    interchangeable; the kernel's online softmax is equivalent only
+    within reassociation tolerance, so it is confined to the decode
+    shape where serving and the ``generate_paged`` oracle run the
+    identical program either way.
+
+    ``paged_impl`` ("kernel" | "xla") overrides the env lookup: the
+    serving engine passes the formulation it captured at build time so
+    a later env change (another engine built in the same process)
+    cannot silently flip what a not-yet-traced shape compiles to while
+    /stats echoes the stale value; the speculative engine pins "xla"
+    (its verify windows are S > 1 gather — mixing would break its
+    greedy-equals-plain-decoding contract at near-tie logits)."""
     from nos_tpu.ops.attention import (
-        dequantize_kv, paged_gather_kv, paged_gather_scale,
-        paged_scatter_kv, paged_scatter_scale, quantize_kv,
+        dequantize_kv, effective_paged_impl, paged_decode_attention,
+        paged_gather_kv, paged_gather_scale, paged_scatter_kv,
+        paged_scatter_scale, quantize_kv,
     )
 
     b, s = tokens.shape
+    if paged_impl is None:
+        paged_impl = effective_paged_impl(cfg.head_dim)
+    use_kernel = s == 1 and paged_impl == "kernel"
     pos0 = cache["pos"]                                     # [B]
     int8_kv = "k_scale" in cache
     freqs = rope_frequencies(cfg.head_dim, cfg.max_seq, cfg.rope_theta)
@@ -177,24 +203,41 @@ def forward_paged(
         q, k = (apply_rope(t, freqs, positions) for t in (q, k))
         kt = k.transpose(0, 2, 1, 3)                        # [B, Hkv, S, D]
         vt = v.transpose(0, 2, 1, 3)
-        if int8_kv:
-            kq, ksc = quantize_kv(kt)
-            vq, vsc = quantize_kv(vt)
-            ck = paged_scatter_kv(ck, table, pos0, kq)
-            cv = paged_scatter_kv(cv, table, pos0, vq)
-            cks = paged_scatter_scale(cks, table, pos0, ksc)
-            cvs = paged_scatter_scale(cvs, table, pos0, vsc)
-            gk = dequantize_kv(paged_gather_kv(ck, table),
-                               paged_gather_scale(cks, table), cfg.dtype)
-            gv = dequantize_kv(paged_gather_kv(cv, table),
-                               paged_gather_scale(cvs, table), cfg.dtype)
+        # named phases so bench_profile traces attribute decode-step
+        # time to the table-walk kernel vs the surrounding ops
+        with jax.named_scope("paged_scatter"):
+            if int8_kv:
+                kq, ksc = quantize_kv(kt)
+                vq, vsc = quantize_kv(vt)
+                ck = paged_scatter_kv(ck, table, pos0, kq)
+                cv = paged_scatter_kv(cv, table, pos0, vq)
+                cks = paged_scatter_scale(cks, table, pos0, ksc)
+                cvs = paged_scatter_scale(cvs, table, pos0, vsc)
+            else:
+                ck = paged_scatter_kv(ck, table, pos0,
+                                      kt.astype(ck.dtype))
+                cv = paged_scatter_kv(cv, table, pos0,
+                                      vt.astype(cv.dtype))
+        if use_kernel:
+            with jax.named_scope("paged_attention_kernel"):
+                o = paged_decode_attention(
+                    q.transpose(0, 2, 1, 3), ck, cv, table, pos0,
+                    k_scale=cks, v_scale=cvs, scale=scale)
         else:
-            ck = paged_scatter_kv(ck, table, pos0, kt.astype(ck.dtype))
-            cv = paged_scatter_kv(cv, table, pos0, vt.astype(cv.dtype))
-            gk = paged_gather_kv(ck, table)
-            gv = paged_gather_kv(cv, table)
-        o = _cached_attention(
-            q.transpose(0, 2, 1, 3), gk, gv, positions, scale)
+            with jax.named_scope("paged_gather"):
+                if int8_kv:
+                    gk = dequantize_kv(
+                        paged_gather_kv(ck, table),
+                        paged_gather_scale(cks, table), cfg.dtype)
+                    gv = dequantize_kv(
+                        paged_gather_kv(cv, table),
+                        paged_gather_scale(cvs, table), cfg.dtype)
+                else:
+                    gk = paged_gather_kv(ck, table)
+                    gv = paged_gather_kv(cv, table)
+            with jax.named_scope("paged_attention"):
+                o = _cached_attention(
+                    q.transpose(0, 2, 1, 3), gk, gv, positions, scale)
         o = o.transpose(0, 2, 1, 3).reshape(b, s, cfg.d_model)
         x = x + qdot(o, layer["wo"])
         if cfg.n_experts > 0:
@@ -248,7 +291,15 @@ def generate_paged(
     ``generate`` (paged_gather/scatter preserve the timeline exactly),
     and with ``kv_dtype="int8"`` it IS the definition of correct int8
     decoding — the serving engine must match it token-for-token through
-    the identical quantize-on-write / dequantize-on-read ops."""
+    the identical quantize-on-write / dequantize-on-read ops.
+
+    Honors ``NOS_TPU_PAGED_KERNEL`` like every ``forward_paged``
+    caller: with the fused kernel enabled, decode steps here trace the
+    SAME kernel program serving traces, so serving == this reference
+    stays token-for-token — but the bf16 bit-identity to ``generate``
+    above is a property of the XLA formulation (the kernel's online
+    softmax is tolerance-equivalent, not bit-equal; see
+    tests/test_paged_kernel.py)."""
     b, s = prompt.shape
     if max_new_tokens <= 0:
         return prompt

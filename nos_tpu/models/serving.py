@@ -46,7 +46,9 @@ from nos_tpu.models.kvblocks import (
     BlockAllocator, NoFreeBlocks, PrefixBlockIndex, ScaleLedger,
     blocks_for,
 )
-from nos_tpu.ops.attention import dequantize_kv, quantize_kv
+from nos_tpu.ops.attention import (
+    dequantize_kv, effective_paged_impl, quantize_kv,
+)
 from nos_tpu.models.tenantquota import (
     DEFAULT_TENANT, TenantQuotaConfig, TenantScheduler,
 )
@@ -279,6 +281,16 @@ class DecodeServer:
                 "no per-block scale storage — run bf16, or enable "
                 "paging to use int8 KV")
         self.kv_dtype = kv_dtype if self.paged else "bf16"
+        # which paged decode-attention formulation this engine's
+        # programs trace: NOS_TPU_PAGED_KERNEL captured ONCE at build
+        # and passed explicitly into every forward_paged trace, so a
+        # later env change (another engine built in this process) can
+        # neither flip a not-yet-compiled shape's formulation nor make
+        # the /stats echo lie. The speculative subclass overrides this
+        # to "xla" (its verify windows must share one formulation with
+        # its decode — see spec_serving).
+        self.paged_kernel = (effective_paged_impl(cfg.head_dim)
+                             if self.paged else None)
         if self.paged:
             bs = kv_block_size
             if self.max_len > cfg.max_seq:
@@ -544,12 +556,21 @@ class DecodeServer:
             # reads, instead of a freed block a new request may own
             table = jnp.where(keep[:, None], table, 0)
             return decode_core(
-                lambda t, c: forward_paged(p, cfg, t, c, table),
+                lambda t, c: forward_paged(p, cfg, t, c, table,
+                                           paged_impl=self.paged_kernel),
                 toks, cache, keep, temp, topk, topp, seeds, sampling)
 
         if self.paged:
             self._decode = jax.jit(decode_paged, donate_argnums=(2,),
                                    static_argnums=(9,))
+            # 1-row decode twin for kernel-formulation recompute
+            # resume (_replay_committed): same forward_paged, same
+            # formulation, no keep/sampling machinery — its outputs
+            # are only the KV writes. Undonated: the replay threads
+            # the live arena through without surrendering it.
+            self._replay_step = jax.jit(
+                lambda p, t, c, tab: forward_paged(
+                    p, cfg, t, c, tab, paged_impl=self.paged_kernel))
         else:
             self._decode = jax.jit(decode, donate_argnums=(2,),
                                    static_argnums=(8,))
@@ -1922,7 +1943,13 @@ class DecodeServer:
         token after it, is bit-exact. One-shot scratch prefill (no
         chunking: the request already waited once). Slot-static engines
         route to the supervised-restart twin (_resume_recompute_static)
-        — same math over the shared cache row instead of arena blocks."""
+        — same math over the shared cache row instead of arena blocks.
+        With the fused decode kernel on, chunking-invariance covers
+        only the prompt span (the kernel's decode steps are not
+        bit-equal to a gather prefill of the same positions), so
+        _replay_committed re-runs the committed output tokens through
+        the kernel program afterwards — bit-exactness preserved by
+        replay instead of by invariance."""
         if not self.paged:
             return self._resume_recompute_static(req)
         req.preempted = False
@@ -1944,8 +1971,36 @@ class DecodeServer:
                 self._scales.note_write(phys)
         self._tables[req.slot] = blocks
         self._set_table_row(req.slot)
+        if self.paged_kernel == "kernel" and len(req.out) > 1:
+            self._replay_committed(req)
         self._resume_draft(req, seq)
         self._resume_row(req)
+
+    def _replay_committed(self, req: _Request) -> None:
+        """Kernel-formulation tail of recompute resume: the one-shot
+        re-prefill above rebuilt the committed-OUTPUT span with
+        gather-formulation math, but the undisturbed run built those
+        positions with S==1 kernel decode steps — tolerance-equivalent,
+        not bit-equal, and resume promises bit-exactness. Overwrite
+        them by replaying the committed tokens through a 1-row twin of
+        the decode program (same kernel, same per-position inputs;
+        per-row math is batch-invariant — the property the
+        serving==generate_paged pin already rests on), so the rebuilt
+        arena is bit-identical to the undisturbed run's. Rare path:
+        one extra 1-row dispatch per committed token, cache undonated
+        (a transient arena alias per call beats surrendering the
+        engine's live buffers)."""
+        n0 = len(req.prompt)
+        table = self._table[req.slot:req.slot + 1]
+        cache = {k: v for k, v in self.cache.items() if k != "pos"}
+        for i, tok in enumerate(req.out[:-1]):
+            cache["pos"] = jnp.asarray([n0 + i], jnp.int32)
+            _lg, cache = self._timed_dispatch(
+                ("replaytok",), self._replay_step, self.params,
+                jnp.asarray([[tok]], jnp.int32), cache, table)
+        for key in self.cache:
+            if key != "pos":
+                self.cache[key] = cache[key]
 
     def _set_sampling_rows(self, req: _Request) -> None:
         """Install one request's per-slot sampling params (the rows the
@@ -2058,6 +2113,7 @@ class DecodeServer:
         return {
             "block_size": self.kv_block_size,
             "dtype": self.kv_dtype,
+            "kernel": self.paged_kernel,
             "scaled_blocks": (self._scales.count
                               if self._scales is not None else None),
             "blocks_total": self._alloc.capacity,

@@ -133,6 +133,16 @@ class SpeculativeDecodeServer(DecodeServer):
         self._chunked_dreserved: dict = {}
         self._d_row_shd = None
         if self.paged:
+            # the fused decode kernel stays OFF here regardless of
+            # NOS_TPU_PAGED_KERNEL: verify windows are S > 1 (gather
+            # formulation), and mixing kernel decode with gather
+            # verify would let a near-tie argmax commit a different
+            # token than plain decoding — breaking this engine's
+            # greedy-equals-plain-decoding contract. One formulation
+            # end to end until the kernel covers S > 1 (ROADMAP
+            # follow-up); kv_stats echoes the clamp.
+            self.paged_kernel = "xla"
+        if self.paged:
             # the draft's own pooled arena: same block geometry as the
             # target's (draft and target timelines advance in lockstep,
             # and the draft has no prefix sharing, so its worst-case
@@ -301,10 +311,15 @@ class SpeculativeDecodeServer(DecodeServer):
                 d_table = jnp.where(keep[:, None], d_table, 0)
                 return spec_core(
                     p, dp, last, t_cache, d_cache,
-                    lambda pp, t, c: forward_paged(pp, self.cfg, t, c,
-                                                   t_table),
-                    lambda pp, t, c: forward_paged(pp, self.draft_cfg,
-                                                   t, c, d_table),
+                    # paged_impl pinned to the engine's clamped "xla":
+                    # draft decode and target verify must trace ONE
+                    # formulation (see the clamp in __init__)
+                    lambda pp, t, c: forward_paged(
+                        pp, self.cfg, t, c, t_table,
+                        paged_impl=self.paged_kernel),
+                    lambda pp, t, c: forward_paged(
+                        pp, self.draft_cfg, t, c, d_table,
+                        paged_impl=self.paged_kernel),
                     keep, temp, topk, topp, seeds, sampling)
 
             self._spec_tick = jax.jit(spec_tick_paged,

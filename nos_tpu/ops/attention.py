@@ -24,10 +24,13 @@ from typing import Optional, Tuple
 
 import jax
 import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
 
 __all__ = ["attention", "xla_attention", "flash_attention_available",
            "splash_attention_available", "effective_impl",
-           "paged_gather_kv", "paged_scatter_kv"]
+           "paged_gather_kv", "paged_scatter_kv",
+           "paged_decode_attention", "effective_paged_impl"]
 
 
 @functools.cache
@@ -272,6 +275,28 @@ def paged_gather_scale(scales: jax.Array, table: jax.Array) -> jax.Array:
     return view.transpose(0, 2, 1, 3).reshape(b, h_kv, nb * bs)
 
 
+def _route_paged_writes(table: jax.Array, pos: jax.Array, s: int,
+                        bs: int) -> Tuple[jax.Array, jax.Array]:
+    """THE block-routing rule for paged writes, shared by
+    ``paged_scatter_kv`` and ``paged_scatter_scale``: positions
+    pos..pos+s-1 on each row's timeline -> (phys [B, S] physical block
+    ids, offs [B, S] within-block offsets). Out-of-range logical blocks
+    (pipeline over-decode past the row's table) route to the reserved
+    null block 0 — clamping into the row's LAST entry would wrap the
+    write onto a committed position, which a COW fork sharing that
+    block could still read. One implementation, so the KV planes and
+    their scale planes can never silently disagree about where a
+    token's bytes land."""
+    b, nb = table.shape
+    offs = pos[:, None] + jnp.arange(s)[None, :]            # [B, S]
+    logical = offs // bs
+    phys = jnp.where(
+        logical < nb,
+        jnp.take_along_axis(table, jnp.minimum(logical, nb - 1), axis=1),
+        0)                                                  # [B, S]
+    return phys, offs % bs
+
+
 def paged_scatter_scale(scales: jax.Array, table: jax.Array,
                         pos: jax.Array, vals: jax.Array) -> jax.Array:
     """Scale-plane twin of ``paged_scatter_kv``: write per-token scales
@@ -280,15 +305,9 @@ def paged_scatter_scale(scales: jax.Array, table: jax.Array,
     overrun scale is as harmless as an overrun KV write — the null
     block is never read unmasked)."""
     nb_blocks, h_kv, bs = scales.shape
-    b, s = vals.shape[0], vals.shape[2]
-    nb = table.shape[1]
-    offs = pos[:, None] + jnp.arange(s)[None, :]            # [B, S]
-    logical = offs // bs
-    phys = jnp.where(
-        logical < nb,
-        jnp.take_along_axis(table, jnp.minimum(logical, nb - 1), axis=1),
-        0)                                                  # [B, S]
-    return scales.at[phys, :, offs % bs].set(
+    s = vals.shape[2]
+    phys, offs = _route_paged_writes(table, pos, s, bs)
+    return scales.at[phys, :, offs].set(
         vals.transpose(0, 2, 1))                            # [B, S, Hkv]
 
 
@@ -304,20 +323,205 @@ def paged_scatter_kv(arena: jax.Array, table: jax.Array, pos: jax.Array,
     all-zeros for inactive slots) may collide there; the null block's
     content is never read unmasked, so the collision is harmless.
     Out-of-range logical blocks (pipeline over-decode past the row's
-    timeline) route to the null block too — clamping into the row's
-    LAST entry would wrap the write onto a committed position, which a
-    COW fork sharing that block could still read."""
+    timeline) route to the null block too — see ``_route_paged_writes``
+    (ONE copy of the routing rule, shared with the scale plane)."""
     nb_blocks, h_kv, bs, d = arena.shape
-    b, s = vals.shape[0], vals.shape[2]
-    nb = table.shape[1]
-    offs = pos[:, None] + jnp.arange(s)[None, :]            # [B, S]
-    logical = offs // bs
-    phys = jnp.where(
-        logical < nb,
-        jnp.take_along_axis(table, jnp.minimum(logical, nb - 1), axis=1),
-        0)                                                  # [B, S]
-    return arena.at[phys, :, offs % bs, :].set(
+    s = vals.shape[2]
+    phys, offs = _route_paged_writes(table, pos, s, bs)
+    return arena.at[phys, :, offs, :].set(
         vals.transpose(0, 2, 1, 3))                         # [B,S,Hkv,D]
+
+
+def effective_paged_impl(head_dim: Optional[int] = None, *,
+                         force_xla: bool = False) -> str:
+    """Which formulation the paged decode-attention path dispatches:
+    "kernel" (the fused Pallas table-walk, ``paged_decode_attention``)
+    or "xla" (the gather formulation, ``paged_gather_kv`` + masked
+    softmax). Same idiom as ``effective_impl``: the bench and the
+    config echo record what actually dispatched, never the request.
+
+    NOS_TPU_PAGED_KERNEL=1 selects the kernel (interpret-mode off-TPU,
+    so the parity suites run under JAX_PLATFORMS=cpu); =0 or unset
+    keeps the XLA formulation — the escape hatch AND the cross-check
+    oracle the kernel is pinned against. On TPU the compiled kernel is
+    gated to the probed head_dims (64/128, like ``effective_impl``);
+    other shapes fall back to XLA rather than gamble on Mosaic."""
+    if force_xla or os.environ.get("NOS_TPU_PAGED_KERNEL", "0") != "1":
+        return "xla"
+    if (jax.default_backend() == "tpu" and head_dim is not None
+            and head_dim not in (64, 128)):
+        return "xla"
+    return "kernel"
+
+
+def _paged_decode_kernel(table_ref, pos_ref, q_ref, k_ref, v_ref, *rest,
+                         s: int, bs: int, nb: int, gs: int,
+                         sm_scale: float, int8: bool, compute_dtype):
+    """Grid point (b, h_kv, j): fold arena block ``table[b, j]`` of kv
+    head h_kv into row b's online softmax. The block arrives in VMEM
+    via the BlockSpec index map (the in-kernel table walk — scalar-
+    prefetched tables steer the HBM->VMEM pipeline copies, so the
+    gathered timeline never exists); j is the minor grid axis, so the
+    running statistics in scratch survive across a row's blocks."""
+    if int8:
+        ks_ref, vs_ref, o_ref, acc_ref, m_ref, l_ref = rest
+    else:
+        o_ref, acc_ref, m_ref, l_ref = rest
+    b = pl.program_id(0)
+    j = pl.program_id(2)
+    neg = jnp.finfo(jnp.float32).min
+    pos_b = pos_ref[b]
+
+    @pl.when(j == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+        m_ref[...] = jnp.full_like(m_ref, neg)
+        l_ref[...] = jnp.zeros_like(l_ref)
+
+    # blocks holding no position <= pos_b + s - 1 contribute nothing:
+    # skip the compute (their pipeline copy was already elided by the
+    # index map's revisit clamp)
+    last_needed = (pos_b + s - 1) // bs
+
+    @pl.when(j <= last_needed)
+    def _block():
+        q = q_ref[0, 0]                                     # [GS, D]
+        k = k_ref[0, 0]                                     # [bs, D]
+        v = v_ref[0, 0]
+        if int8:
+            # dequantize_kv's exact rule, fused at the point of use:
+            # f32 multiply, ONE cast to the compute dtype — so the
+            # kernel and the XLA gather read identical timelines
+            k = (k.astype(jnp.float32)
+                 * ks_ref[0, 0][:, None]).astype(compute_dtype)
+            v = (v.astype(jnp.float32)
+                 * vs_ref[0, 0][:, None]).astype(compute_dtype)
+        scores = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * sm_scale  # [GS, bs]
+        # causal mask against the cache timeline: query row r (= g*S +
+        # s_idx) sits at absolute position pos_b + s_idx and admits
+        # timeline slots t <= that — the same ``pos`` mask that keeps
+        # null-block garbage and partial-last-block tails out of the
+        # XLA formulation's softmax
+        t_idx = j * bs + jax.lax.broadcasted_iota(
+            jnp.int32, (gs, bs), 1)
+        s_idx = jax.lax.broadcasted_iota(jnp.int32, (gs, bs), 0) % s
+        scores = jnp.where(t_idx <= pos_b + s_idx, scores, neg)
+        m_prev = m_ref[:, :1]                               # [GS, 1]
+        m_cur = jnp.max(scores, axis=-1, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_cur)
+        alpha = jnp.exp(m_prev - m_new)
+        p = jnp.exp(scores - m_new)                         # [GS, bs] f32
+        l_new = alpha * l_ref[:, :1] + jnp.sum(p, axis=-1, keepdims=True)
+        acc_ref[...] = acc_ref[...] * alpha + jnp.dot(
+            p, v.astype(jnp.float32),
+            preferred_element_type=jnp.float32)             # [GS, D]
+        m_ref[...] = jnp.broadcast_to(m_new, m_ref.shape)
+        l_ref[...] = jnp.broadcast_to(l_new, l_ref.shape)
+
+    @pl.when(j == nb - 1)
+    def _finalize():
+        l = l_ref[:, :1]
+        o_ref[0, 0] = (acc_ref[...]
+                       / jnp.where(l == 0.0, 1.0, l)).astype(o_ref.dtype)
+
+
+def paged_decode_attention(
+    q: jax.Array, k_arena: jax.Array, v_arena: jax.Array,
+    table: jax.Array, pos: jax.Array, *,
+    k_scale: Optional[jax.Array] = None,
+    v_scale: Optional[jax.Array] = None,
+    scale: Optional[float] = None,
+    interpret: Optional[bool] = None,
+) -> jax.Array:
+    """Fused paged decode attention: walk each row's block table INSIDE
+    the kernel grid, stream (quantized or plain) KV blocks HBM->VMEM by
+    physical block id, and run flash-style online softmax over them —
+    the vLLM paged-attention formulation on the repo's Pallas layer.
+
+    q: [B, H, S, D] queries at absolute positions pos..pos+S-1 per row
+    (S = 1 is the decode step; small S covers fused/speculative
+    windows); k_arena/v_arena: [NB, Hkv, bs, D] (one layer's slice of
+    the pooled arena); table: [B, nb] int32 (entry 0 = the reserved
+    null block); pos: [B] int32. With ``k_scale``/``v_scale``
+    [NB, Hkv, bs] the arena is int8 and ``dequantize_kv``'s exact
+    scale-multiply runs in the inner loop — the bf16 timeline is never
+    materialized, in HBM or at all. -> [B, H, S, D] in q's dtype.
+
+    Equivalent to ``paged_gather_kv`` (+ ``dequantize_kv``) followed by
+    the masked-softmax attention of ``generate._cached_attention``, up
+    to online-softmax reassociation (parity pinned within tolerance in
+    tests/test_paged_kernel.py; the XLA formulation stays the oracle).
+    Bytes per step drop from gather-write + attention-read of the
+    materialized [B, Hkv, nb*bs, D] view (x2 more for the int8 dequant
+    copy) to ONE arena read of the live blocks.
+
+    ``interpret`` defaults to True off-TPU so the kernel runs (slowly,
+    exactly) under tier-1's JAX_PLATFORMS=cpu."""
+    b, h, s, d = q.shape
+    nb_phys, h_kv, bs, _ = k_arena.shape
+    nb = table.shape[1]
+    g = h // h_kv
+    gs = g * s
+    sm_scale = scale if scale is not None else d ** -0.5
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    int8 = k_scale is not None
+    # group query heads per kv head (GQA, same reshape convention as
+    # xla_attention): row r of the [GS, D] q block is (g, s_idx)
+    qg = q.reshape(b, h_kv, g, s, d).reshape(b, h_kv, gs, d)
+
+    def idx_q(bb, hh, j, tref, pref):
+        return (bb, hh, 0, 0)
+
+    def idx_kv(bb, hh, j, tref, pref):
+        # the table walk: scalar-prefetched block tables steer the
+        # pipeline's HBM->VMEM copy for grid step (bb, hh, j). Dead
+        # tail iterations (every position of block j masked by pos)
+        # revisit the last live block — an unchanged index elides the
+        # copy, so a short row costs its live blocks, not nb
+        last = (pref[bb] + s - 1) // bs
+        return (tref[bb, jnp.minimum(j, last)], hh, 0, 0)
+
+    def idx_scale(bb, hh, j, tref, pref):
+        last = (pref[bb] + s - 1) // bs
+        return (tref[bb, jnp.minimum(j, last)], hh, 0)
+
+    in_specs = [
+        pl.BlockSpec((1, 1, gs, d), idx_q),
+        pl.BlockSpec((1, 1, bs, d), idx_kv),
+        pl.BlockSpec((1, 1, bs, d), idx_kv),
+    ]
+    operands = [table, pos, qg, k_arena, v_arena]
+    if int8:
+        in_specs += [pl.BlockSpec((1, 1, bs), idx_scale),
+                     pl.BlockSpec((1, 1, bs), idx_scale)]
+        operands += [k_scale, v_scale]
+
+    kernel = functools.partial(
+        _paged_decode_kernel, s=s, bs=bs, nb=nb, gs=gs,
+        sm_scale=sm_scale, int8=int8, compute_dtype=q.dtype)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(b, h_kv, nb),
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec((1, 1, gs, d), idx_q),
+        scratch_shapes=[
+            pltpu.VMEM((gs, d), jnp.float32),       # acc
+            pltpu.VMEM((gs, 128), jnp.float32),     # running max
+            pltpu.VMEM((gs, 128), jnp.float32),     # running denom
+        ],
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((b, h_kv, gs, d), q.dtype),
+        compiler_params=pltpu.TPUCompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+        interpret=interpret,
+    )(*operands)
+    return out.reshape(b, h_kv, g, s, d).reshape(b, h, s, d)
 
 
 def attention(

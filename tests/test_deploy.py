@@ -347,7 +347,8 @@ def test_serving_deployment_passes_paged_kv_args():
     with open(os.path.join(CHART, "values.yaml")) as f:
         values = yaml.safe_load(f)
     assert values["serving"]["kv"] == {
-        "blockSize": 0, "blocks": 0, "swap": True, "dtype": "bf16"}
+        "blockSize": 0, "blocks": 0, "swap": True, "dtype": "bf16",
+        "pagedKernel": False}
 
 
 def test_serving_deployment_passes_kv_dtype_and_speculative_args():
@@ -383,6 +384,31 @@ def test_serving_deployment_passes_kv_dtype_and_speculative_args():
     for row in ("serving.kv.dtype", "serving.speculative.draftCheckpointDir",
                 "serving.speculative.nTokens"):
         assert row in readme, f"helm README missing {row} row"
+
+
+def test_serving_deployment_passes_paged_kernel_arg():
+    """The serving Deployment must plumb serving.kv.pagedKernel to
+    --paged-kernel=on|off (ISSUE 14 satellite: the fused Pallas
+    decode-attention kernel's fleet knob), with the chart default
+    matching the binary's ServerConfig default (off — the XLA gather
+    formulation is the escape hatch and parity oracle until a fleet
+    opts in), and a README row so the knob is discoverable."""
+    path = os.path.join(CHART, "templates", "serving",
+                        "deployment_server.yaml")
+    with open(path) as f:
+        text = f.read()
+    assert "--paged-kernel=" in text, "serving deployment missing flag"
+    assert 'ternary "on" "off" .Values.serving.kv.pagedKernel' in text
+    with open(os.path.join(CHART, "values.yaml")) as f:
+        values = yaml.safe_load(f)
+    assert values["serving"]["kv"]["pagedKernel"] is False
+    # chart default == code default (rendered through the ternary)
+    from nos_tpu.cmd.server import ServerConfig
+    rendered = "on" if values["serving"]["kv"]["pagedKernel"] else "off"
+    assert rendered == ServerConfig().paged_kernel
+    with open(os.path.join(CHART, "README.md")) as f:
+        readme = f.read()
+    assert "serving.kv.pagedKernel" in readme, "helm README missing row"
 
 
 def test_serving_deployment_passes_supervisor_and_deadline_args():

@@ -1155,6 +1155,63 @@ def test_build_engine_int8_and_draft_validation():
     assert eng.kv_stats()["dtype"] == "int8"
 
 
+def test_paged_kernel_flag_plumbed_and_validated(monkeypatch):
+    """--paged-kernel reaches the ServerConfig, defaults cross-check
+    (off — the XLA gather formulation stays the escape hatch until a
+    fleet opts in), an invalid value is a clean config error BEFORE any
+    model load, and build_engine plumbs the choice to the engine as
+    NOS_TPU_PAGED_KERNEL so /stats kv.kernel echoes what the programs
+    actually trace (ISSUE 14 satellite)."""
+    # pin + restore the process-global env the flag plumbs
+    monkeypatch.setenv("NOS_TPU_PAGED_KERNEL", "0")
+    from nos_tpu.cmd import server as server_mod
+    from nos_tpu.cmd.server import build_engine
+
+    seen = {}
+
+    def fake_build(cfg):
+        seen["cfg"] = cfg
+        raise SystemExit(0)          # stop before the serving loop
+
+    real = server_mod.build_engine
+    server_mod.build_engine = fake_build
+    try:
+        with pytest.raises(SystemExit):
+            server_mod.main(["--kv-block-size", "8", "--kv-blocks",
+                             "16", "--paged-kernel", "on"])
+    finally:
+        server_mod.build_engine = real
+    assert seen["cfg"].paged_kernel == "on"
+    assert ServerConfig().paged_kernel == "off"
+
+    # config-file garbage fails loudly before the checkpoint load
+    with pytest.raises(ValueError, match="on\\|off"):
+        build_engine(ServerConfig(**MODEL, kv_block_size=8,
+                                  kv_blocks=16, paged_kernel="maybe"))
+    # the kernel walks per-slot block tables: slot-static has none
+    with pytest.raises(ValueError, match="paged_kernel.*paged|paged"):
+        build_engine(ServerConfig(**MODEL, paged_kernel="on"))
+    # kernel + speculative would silently clamp (the spec engine pins
+    # the gather formulation end to end) — contradictory config is a
+    # clean startup error instead
+    with pytest.raises(ValueError, match="speculative"):
+        build_engine(ServerConfig(**MODEL, kv_block_size=8,
+                                  kv_blocks=16, paged_kernel="on",
+                                  draft_checkpoint_dir="/ckpt/d"))
+
+    # on|off reach the engine: kv_stats echoes the traced formulation
+    eng = build_engine(ServerConfig(**MODEL, max_batch=2,
+                                    kv_block_size=8, kv_blocks=16,
+                                    paged_kernel="on"))
+    assert eng.kv_stats()["kernel"] == "kernel"
+    import os
+    assert os.environ["NOS_TPU_PAGED_KERNEL"] == "1"
+    eng = build_engine(ServerConfig(**MODEL, max_batch=2,
+                                    kv_block_size=8, kv_blocks=16))
+    assert eng.kv_stats()["kernel"] == "xla"
+    assert os.environ["NOS_TPU_PAGED_KERNEL"] == "0"
+
+
 def test_speculative_engine_stats_and_metrics_over_loop():
     """A REAL speculative engine behind the ServingLoop: /stats carries
     the speculative section and the spec counters + accepted-per-window
