@@ -123,12 +123,64 @@ class HttpReplicaTransport:
         request, timeout = self._request(replica, req, stream=False)
         try:
             with urllib.request.urlopen(request, timeout=timeout) as r:
-                return json.loads(r.read())["tokens"]
+                payload = json.loads(r.read())
+                if "handoff" in payload:
+                    # a prefill-role replica answered with the handoff
+                    # descriptor: hand it to the router's phase 2
+                    return payload
+                return payload["tokens"]
         except urllib.error.HTTPError as e:
             self._raise_for(e)
         except (urllib.error.URLError, OSError) as e:
             raise ReplicaUnreachable(
                 f"replica {replica.name} unreachable: {e}") from e
+
+    def _resume_target(self, replica: Replica, desc: dict,
+                       deadline_s=None):
+        """The one phase-2 preamble resume and resume_stream share:
+        resolve the decode replica's base address (its handle, else the
+        descriptor's target) and clamp the socket timeout to the
+        request deadline."""
+        base = replica.handle or desc.get("target")
+        if not base:
+            raise ReplicaUnreachable(
+                f"decode replica {replica.name} has no address")
+        timeout = self.timeout_s
+        if deadline_s is not None:
+            timeout = min(timeout, deadline_s + 5.0)
+        return base, timeout
+
+    def resume(self, replica: Replica, desc: dict,
+               deadline_s=None) -> list:
+        """Phase 2 unary: fetch a handed-off request's full sequence
+        from the decode replica (``GET /v1/result/<rid>``)."""
+        base, timeout = self._resume_target(replica, desc, deadline_s)
+        try:
+            with urllib.request.urlopen(
+                    f"{base}/v1/result/{desc['rid']}",
+                    timeout=timeout) as r:
+                return json.loads(r.read())["tokens"]
+        except urllib.error.HTTPError as e:
+            self._raise_for(e)
+        except (urllib.error.URLError, OSError) as e:
+            raise ReplicaUnreachable(
+                f"decode replica {replica.name} unreachable: {e}") from e
+
+    def resume_stream(self, replica: Replica, desc: dict,
+                      deadline_s=None) -> Iterable[list]:
+        """Phase 2 streaming: SSE attach to the decode replica's
+        ``/v1/stream/<rid>`` — same frame protocol as send_stream."""
+        base, timeout = self._resume_target(replica, desc, deadline_s)
+        try:
+            resp = urllib.request.urlopen(
+                f"{base}/v1/stream/{desc['rid']}", timeout=timeout)
+        except urllib.error.HTTPError as e:
+            self._raise_for(e)
+            return
+        except (urllib.error.URLError, OSError) as e:
+            raise ReplicaUnreachable(
+                f"decode replica {replica.name} unreachable: {e}") from e
+        yield from self._iter_sse(resp, replica.name)
 
     def send_stream(self, replica: Replica, req: dict
                     ) -> Iterable[list]:
@@ -144,6 +196,13 @@ class HttpReplicaTransport:
         except (urllib.error.URLError, OSError) as e:
             raise ReplicaUnreachable(
                 f"replica {replica.name} unreachable: {e}") from e
+        yield from self._iter_sse(resp, replica.name)
+
+    @staticmethod
+    def _iter_sse(resp, name: str) -> Iterable[list]:
+        """The one SSE frame loop send_stream and resume_stream share:
+        yields token-list deltas until [DONE]; in-band error frames and
+        early closes raise."""
         try:
             for raw in resp:
                 line = raw.strip()
@@ -158,7 +217,7 @@ class HttpReplicaTransport:
                 yield frame.get("tokens") or []
             # stream ended without [DONE]: the replica died mid-answer
             raise ReplicaUnreachable(
-                f"replica {replica.name} closed the stream early")
+                f"replica {name} closed the stream early")
         finally:
             resp.close()
 
@@ -521,6 +580,8 @@ def main(argv: Optional[Sequence[str]] = None) -> None:
         ),
         transport=transport.send,
         stream_transport=transport.send_stream,
+        resume_transport=transport.resume,
+        resume_stream_transport=transport.resume_stream,
         on_activation=stamper.note,
     )
     scraper = HttpReplicaClient(args.replica_url_template,

@@ -110,10 +110,30 @@ class ServerConfig:
     max_pending: int = 0
     # tensor-parallel serving: shard params (transformer.param_shardings,
     # or quant.quant_param_shardings when int8) and the KV cache
-    # (generate.cache_shardings — KV heads over tp) across the first
-    # ``tp`` local devices. 0/1 = single device. Tokens are invariant to
-    # tp, bf16 and int8 alike (tested); requires kv_heads % tp == 0.
+    # (generate.cache_shardings — KV heads over tp; a paged arena
+    # shards the same head axis via paged_cache_shardings, scale
+    # planes included) across the first ``tp`` local devices. 0/1 =
+    # single device. Tokens are invariant to tp — greedy AND sampled,
+    # slot-static and paged, bf16 and int8 alike (tested; sampling
+    # decisions run on a replicated f32 logit row so the mesh cannot
+    # perturb the stream); requires kv_heads % tp == 0.
     tp: int = 0
+    # prefill/decode disaggregation role: "colocated" (default — one
+    # engine prefills and decodes), "prefill" (requests leave after
+    # their first token as a KV handoff shipped to a decode replica;
+    # requires kv_blocks > 0 and a decode_pool), "decode" (adopts
+    # handoffs via POST /v1/handoff and serves /v1/result//v1/stream;
+    # requires kv_blocks > 0 with the SAME kv_block_size/kv_dtype/
+    # model geometry as its prefill peers — restore validates and
+    # rejects mismatches). int8 KV halves the handoff bytes over DCN.
+    # The gateway routes new requests to prefill replicas and streams
+    # from the decode replica after handoff.
+    role: str = "colocated"
+    # comma-separated decode-replica base URLs a prefill-role server
+    # round-robins its handoffs across (e.g.
+    # "http://decode-0:8000,http://decode-1:8000"); required (non-empty)
+    # when role=prefill, ignored otherwise
+    decode_pool: str = ""
     # prefix cache (0 = off). Slot-static KV: ENTRIES — each holds one
     # prompt's KV on device (flagship: ~64 MB per 1k tokens). Paged KV
     # (kv_blocks > 0): BLOCKS — the budget for block-granular prefix
@@ -317,7 +337,11 @@ class ServingLoop:
                  watchdog_s: float = 0.0,
                  default_deadline_s: float = 0.0, seed: int = 0,
                  config_echo: Optional[dict] = None,
-                 tenant_quota: Optional[TenantQuotaConfig] = None):
+                 tenant_quota: Optional[TenantQuotaConfig] = None,
+                 role: str = "colocated",
+                 handoff_targets: Optional[list] = None,
+                 handoff_send=None,
+                 adopt_ttl_s: float = 600.0):
         reg = default_registry()
         # register() is idempotent per (name, type, labels) and raises on
         # a mismatched re-registration — exactly what we want at startup
@@ -487,6 +511,59 @@ class ServingLoop:
                 self.m_tenant_tokens.labels(t).inc(0)
                 for mode in ("swap", "recompute"):
                     self.m_tenant_preempt.labels(t, mode).inc(0)
+        # prefill/decode disaggregation (registered only on a
+        # prefill-role loop — colocated and decode servers must not
+        # export dead zero series): handoffs shipped to the decode
+        # pool by outcome, payload bytes per handoff (the int8-halves-
+        # the-wire claim is readable straight off this histogram), and
+        # capture+ship wall time per handoff
+        self.role = role
+        self._handoff_targets = list(handoff_targets or [])
+        self._handoff_send = handoff_send
+        self._handoff_rr = 0
+        self._handoff_done: dict = {}       # loop rid -> descriptor
+        self._handoff_gone: set = set()     # client departed pre-push
+        # adopted-request TTL (decode role): an adopted handoff whose
+        # consumer never shows up — the gateway crashed mid-resume, or
+        # phase 2 exhausted its attempts — must not decode-and-park
+        # forever. adopt() arms rid -> abs monotonic expiry here; a
+        # consumer attach (result/watch) disarms it (the consumer's
+        # own timeout/disconnect discipline owns the lifecycle from
+        # there); _reap_orphans cancels whatever expires unclaimed.
+        self._handoff_deadline: dict = {}   # loop rid -> abs monotonic
+        self._adopt_ttl_s = adopt_ttl_s
+        self._adopted: dict = {}            # loop rid -> prompt tokens
+        # finished adopted results kept for re-fetch (same TTL): a
+        # gateway retry of /v1/result after a socket timeout races the
+        # abandoned first handler for the single engine pop — the
+        # winner parks the tokens here so the loser still answers
+        # instead of failing a fully-decoded request as "vanished"
+        self._adopted_final: dict = {}      # loop rid -> full tokens
+        # live _deltas consumers per rid: only the LAST one's teardown
+        # forgets the request — an abandoned handler timing out must
+        # not cancel the rid a retried resume is still attached to
+        self._watchers: dict = {}           # loop rid -> consumer count
+        if role == "prefill":
+            self.m_handoff = reg.counter(
+                "nos_tpu_serve_handoff_total",
+                "Prefill->decode handoffs leaving this prefill-role "
+                "server, by outcome (sent = adopted by a decode "
+                "replica | failed = every decode-pool target refused "
+                "or was unreachable; the request's one terminal "
+                "outcome follows it)",
+                ("outcome",))
+            self.h_handoff_bytes = reg.histogram(
+                "nos_tpu_serve_handoff_bytes",
+                "KV payload bytes per handoff (quantized blocks + "
+                "per-block scales under int8 — roughly half the bf16 "
+                "bytes per request over DCN)",
+                buckets=(1e3, 1e4, 1e5, 1e6, 1e7, 1e8, 1e9))
+            self.h_handoff = reg.histogram(
+                "nos_tpu_serve_handoff_seconds",
+                "Wall time per handoff: KV swap-out capture plus the "
+                "ship to the decode replica")
+            for outcome in ("sent", "failed"):
+                self.m_handoff.labels(outcome).inc(0)
         self.m_compiles = reg.counter(
             "nos_tpu_serve_compiles_total",
             "XLA compiles observed by the engine (first dispatch per "
@@ -617,6 +694,11 @@ class ServingLoop:
         self._sample_device_stats()
         self._thread = threading.Thread(target=self._run, daemon=True)
         self._thread.start()
+        self._handoff_thread: Optional[threading.Thread] = None
+        if role == "prefill" and self._handoff_send is not None:
+            self._handoff_thread = threading.Thread(
+                target=self._push_handoffs, daemon=True)
+            self._handoff_thread.start()
         self._monitor_thread: Optional[threading.Thread] = None
         if self._watchdog_s > 0:
             # no supervisor needed: without one, a validated trip goes
@@ -625,6 +707,11 @@ class ServingLoop:
             self._monitor_thread = threading.Thread(
                 target=self._monitor, daemon=True)
             self._monitor_thread.start()
+        self._orphan_thread: Optional[threading.Thread] = None
+        if role == "decode" and adopt_ttl_s > 0:
+            self._orphan_thread = threading.Thread(
+                target=self._reap_orphans, daemon=True)
+            self._orphan_thread.start()
 
     @property
     def healthy(self) -> bool:
@@ -711,6 +798,11 @@ class ServingLoop:
         self._live.discard(rid)
         self._deadlines.pop(rid, None)
         self._rid_map.pop(rid, None)
+        # an adopted (decode-role) request's prompt leaves with its
+        # terminal outcome: the streaming attach path never calls
+        # result(), so accounting is the one hook both paths share
+        self._adopted.pop(rid, None)
+        self._handoff_deadline.pop(rid, None)
         sp = self._spans.pop(rid, None)
         tid = (sp.trace_id or None) if sp is not None else None
         breaches = []
@@ -1401,6 +1493,241 @@ class ServingLoop:
         self._mirror_engine_gauges()
         self._work.notify_all()     # the stream raises DeadlineExceeded
 
+    # -- prefill/decode disaggregation ----------------------------------
+    def _push_handoffs(self) -> None:
+        """Pusher thread (prefill role): drain the engine's parked
+        handoff states and ship each to a decode-pool target —
+        round-robin, next target on failure, two laps before the
+        handoff (and its request) fails. Encoding and the network send
+        run OUTSIDE the loop lock; only the bookkeeping (result map,
+        terminal accounting, metrics) re-enters it."""
+        from nos_tpu.models.handoff import encode_handoff, handoff_nbytes
+        while True:
+            with self._work:
+                while not self._stop and self._failed is None \
+                        and not getattr(self.engine, "_handoffs", None):
+                    self._work.wait(timeout=0.25)
+                if self._stop or self._failed is not None:
+                    return
+                states = self.engine.pop_handoffs()
+                # reverse map BEFORE releasing the lock: a recovery
+                # could remap rids while we ship
+                rev = {erid: lrid
+                       for lrid, erid in self._rid_map.items()}
+            for st in states:
+                with self._work:
+                    lrid0 = rev.get(st["rid"])
+                    if lrid0 is not None \
+                            and lrid0 in self._handoff_gone:
+                        # the client departed while the payload was
+                        # parked: don't ship KV nobody will read —
+                        # resolve the request as cancelled here
+                        self._handoff_gone.discard(lrid0)
+                        self._account(lrid0, "cancelled",
+                                      self._pop_ledger(st["rid"]))
+                        self._work.notify_all()
+                        continue
+                t0 = time.monotonic()
+                data = encode_handoff(st)
+                sent, last_err = None, None
+                targets = self._handoff_targets
+                for _ in range(max(1, 2 * len(targets))):
+                    target = targets[self._handoff_rr % len(targets)]
+                    self._handoff_rr += 1
+                    try:
+                        remote_rid = self._handoff_send(target, data)
+                        sent = {"target": target, "rid": int(remote_rid)}
+                        break
+                    except Exception as e:  # noqa: BLE001 — next target
+                        last_err = e
+                with self._work:
+                    lrid = rev.get(st["rid"])
+                    ledger = self._pop_ledger(st["rid"])
+                    self.h_handoff_bytes.observe(handoff_nbytes(st))
+                    self.h_handoff.observe(time.monotonic() - t0)
+                    if sent is not None:
+                        self.m_handoff.labels("sent").inc()
+                        if lrid is not None:
+                            if lrid in self._handoff_gone:
+                                # departed mid-ship: the decode side
+                                # owns an orphan now, but THIS loop's
+                                # outcome is exactly-once cancelled
+                                # and no descriptor parks unclaimed
+                                self._handoff_gone.discard(lrid)
+                                self._account(lrid, "cancelled",
+                                              ledger)
+                            else:
+                                self._handoff_done[lrid] = sent
+                                self._account(lrid, "finished", ledger)
+                    else:
+                        logger.error("handoff for rid %s failed on "
+                                     "every decode target: %s",
+                                     st["rid"], last_err)
+                        self.m_handoff.labels("failed").inc()
+                        if lrid is not None:
+                            self._handoff_done[lrid] = {
+                                "error": f"handoff failed: {last_err}"}
+                            self._account(lrid, "failed", ledger)
+                    self._work.notify_all()
+
+    def prefill(self, prompt, max_new_tokens, timeout: float = 300.0,
+                deadline_s: Optional[float] = None, **sampling):
+        """Prefill-role request path: submit, wait for the handoff to
+        land on a decode replica, return its descriptor
+        ``{"handoff": {"target", "rid"}}`` — the gateway (or client)
+        then streams/fetches from the decode replica. A request whose
+        first token already completes it (max_new_tokens == 1) never
+        hands off: its tokens come back directly, same wire shape as
+        a colocated answer."""
+        del deadline_s      # enforced at the gateway/decode side
+        with self._work:
+            if self._failed is not None:
+                raise RuntimeError(f"serving loop failed: {self._failed}")
+            if self._recovering:
+                self.m_requests.labels("rejected").inc()
+                raise EngineRecovering(
+                    "engine restarting after a fault; retry shortly")
+            if self._draining:
+                raise DrainingError(
+                    "server is draining (terminating); retry elsewhere")
+            try:
+                erid = self.engine.submit(prompt, max_new_tokens,
+                                          **sampling)
+            except QueueFull:
+                self.m_requests.labels("rejected").inc()
+                raise
+            rid = self._next_rid
+            self._next_rid += 1
+            self._rid_map[rid] = erid
+            self._live.add(rid)
+            self._mirror_engine_gauges()
+            self._work.notify_all()
+            deadline = time.monotonic() + timeout
+            while True:
+                done = self._handoff_done.pop(rid, None)
+                if done is not None:
+                    if "error" in done:
+                        raise RuntimeError(done["error"])
+                    return {"handoff": done}
+                cur = self._rid_map.get(rid)
+                prog = self.engine.progress(cur) \
+                    if cur is not None else None
+                if prog is not None and prog[1]:
+                    # completed locally (max_new_tokens == 1): the
+                    # ordinary unary answer
+                    ledger = self._pop_ledger(cur)
+                    self.engine.pop_result(cur)
+                    self._account(rid, "finished", ledger)
+                    return {"tokens": list(prompt) + prog[0]}
+                if self._failed is not None:
+                    raise RuntimeError(
+                        f"serving loop failed: {self._failed}")
+                if self._stop:
+                    raise RuntimeError(
+                        f"request {rid} unfinished at server shutdown")
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    self._forget_locked(rid)
+                    raise TimeoutError(f"request {rid} timed out "
+                                       f"awaiting handoff")
+                self._work.wait(timeout=min(remaining, 1.0))
+
+    def _forget_locked(self, rid: int) -> None:
+        """_forget's body expects to take the lock itself; this is the
+        already-locked twin for the prefill wait path."""
+        self._work.release()
+        try:
+            self._forget(rid)
+        finally:
+            self._work.acquire()
+
+    def adopt(self, data: bytes) -> int:
+        """Decode-role ingest: decode one handoff payload and restore
+        it into the engine — byte-exact resume of the prefilled KV plus
+        the committed first token. Returns the loop rid ``result`` /
+        ``watch`` serve. Geometry mismatches (block size, kv_dtype,
+        model dims) raise Infeasible from the engine's restore."""
+        from nos_tpu.models.handoff import decode_handoff
+        state = decode_handoff(data)
+        with self._work:
+            if self._failed is not None:
+                raise RuntimeError(f"serving loop failed: {self._failed}")
+            if self._recovering:
+                raise EngineRecovering(
+                    "engine restarting after a fault; retry shortly")
+            if self._draining:
+                raise DrainingError(
+                    "server is draining (terminating); retry elsewhere")
+            erid = self.engine.restore(state)
+            rid = self._next_rid
+            self._next_rid += 1
+            self._rid_map[rid] = erid
+            self._live.add(rid)
+            self._adopted[rid] = list(state["prompt"])
+            if self._adopt_ttl_s > 0:
+                self._handoff_deadline[rid] = \
+                    time.monotonic() + self._adopt_ttl_s
+            self._mirror_engine_gauges()
+            self._work.notify_all()
+        return rid
+
+    def watch(self, rid: int, timeout: float = 300.0):
+        """Attach to an adopted request's token stream (the decode-side
+        SSE surface after a handoff): yields newly-decoded token lists
+        exactly like ``stream``, for a request that entered via
+        ``adopt`` instead of ``submit``."""
+        with self._work:
+            if self._rid_map.get(rid) is None:
+                raise ValueError(f"unknown request {rid}")
+            # a consumer owns the lifecycle now (its disconnect runs
+            # _forget): the unclaimed-orphan TTL stands down
+            self._handoff_deadline.pop(rid, None)
+        return _Stream(self, rid, self._deltas(rid, timeout))
+
+    def result(self, rid: int, timeout: float = 300.0):
+        """Block for an adopted request's full sequence (prompt +
+        generated) — the decode-side unary surface after a handoff.
+        Idempotent once finished (until the re-fetch TTL expires): a
+        gateway retrying after a socket timeout gets the same tokens
+        its abandoned first attempt drained."""
+        with self._work:
+            final = self._adopted_final.get(rid)
+            if final is not None:
+                return list(final)
+            prompt = self._adopted.get(rid)
+            if prompt is None:
+                raise ValueError(f"unknown request {rid}")
+            self._handoff_deadline.pop(rid, None)   # consumer attached
+        out = list(prompt)
+        try:
+            for delta in self._deltas(rid, timeout):
+                out.extend(delta)
+        except RuntimeError:
+            # "request N vanished": a concurrent result() handler for
+            # the same rid (an abandoned attempt the client timed out
+            # on) won the engine pop — its parked final answers us.
+            # Brief recheck window: the winner's pop (inside _deltas)
+            # and its park below are two lock acquisitions apart.
+            end = time.monotonic() + 2.0
+            with self._work:
+                while True:
+                    final = self._adopted_final.get(rid)
+                    if final is not None:
+                        return list(final)
+                    if time.monotonic() >= end:
+                        break
+                    self._work.wait(timeout=0.05)
+            raise
+        with self._work:
+            self._adopted_final[rid] = list(out)
+            if self._adopt_ttl_s > 0:
+                # re-fetch grace window; _reap_orphans drops it after
+                self._handoff_deadline[rid] = \
+                    time.monotonic() + self._adopt_ttl_s
+            self._adopted.pop(rid, None)
+            self._work.notify_all()
+        return out
+
     def generate(self, prompt, max_new_tokens, timeout: float = 300.0,
                  deadline_s: Optional[float] = None, **sampling):
         """Unary request: expressed over ``stream`` so there is exactly
@@ -1445,6 +1772,15 @@ class ServingLoop:
                 self._abandoned.discard(rid)
                 return
             if erid is None or self.engine.progress(erid) is None:
+                # prefill role: the request may be parked as — or
+                # already shipped as — a handoff. Drop any unclaimed
+                # descriptor, and tombstone a still-live rid so the
+                # pusher resolves a departed client's handoff as
+                # cancelled instead of parking a descriptor nobody
+                # will ever pop.
+                self._handoff_done.pop(rid, None)
+                if self.role == "prefill" and rid in self._live:
+                    self._handoff_gone.add(rid)
                 self._abandoned.discard(rid)    # already popped
                 return
             draining_out = self._failed is not None or self._stop
@@ -1682,79 +2018,129 @@ class ServingLoop:
             self._mirror_engine_gauges()
             self._work.notify_all()
 
-        def deltas():
-            sent = 0
-            finished = False
-            deadline = time.monotonic() + timeout
-            try:
-                while True:
-                    with self._work:
-                        # own-deadline check first: expiry beats both
-                        # further waiting and the vanished error (the
-                        # expire path popped the engine's record)
-                        dl = self._deadlines.get(rid)
-                        if dl is not None and time.monotonic() > dl \
-                                and rid not in self._deadline_hit:
-                            self._expire_deadline(rid)
-                        if rid in self._deadline_hit:
-                            raise DeadlineExceeded(
-                                f"request {rid} exceeded its deadline")
-                        if rid in self._lost_rids:
-                            raise RuntimeError(
-                                f"request {rid} lost in engine restart")
-                        erid = self._rid_map.get(rid)
-                        prog = self.engine.progress(erid) \
-                            if erid is not None else None
-                        if prog is None:
-                            if self._recovering:
-                                # mid-restore: the request is captured,
-                                # not gone — wait for the rebuilt engine
-                                self._work.wait(timeout=0.05)
-                                continue
-                            if self._failed is not None:
-                                # drained as failed by a terminal
-                                # engine death (possibly a cancelled
-                                # recovery) — name the real cause
-                                raise RuntimeError(
-                                    f"serving loop failed: {self._failed}")
-                            # reaped out from under us (shutdown race)
-                            raise RuntimeError(f"request {rid} vanished")
-                        toks, done = prog
-                        delta = toks[sent:]
-                        if done:
-                            ledger = self._pop_ledger(erid)
-                            self.engine.pop_result(erid)
-                            self._account(rid, "finished", ledger)
-                            finished = True
-                        elif not delta:
-                            if self._failed is not None:
-                                raise RuntimeError(
-                                    f"serving loop failed: {self._failed}")
-                            if self._stop:
-                                # loop.shutdown() ran (drain timeout /
-                                # interpreter exit): no tick will ever
-                                # finish this request — fail it NOW so
-                                # the non-daemon handler thread exits
-                                # instead of waiting out its timeout
-                                raise RuntimeError(
-                                    f"request {rid} unfinished at server "
-                                    "shutdown")
-                            remaining = deadline - time.monotonic()
-                            if remaining <= 0:
-                                raise TimeoutError(
-                                    f"request {rid} timed out")
-                            self._work.wait(timeout=min(remaining, 1.0))
-                            continue
-                    if delta:
-                        sent += len(delta)
-                        yield delta
-                    if finished:
-                        return
-            finally:
-                if not finished:        # timeout / failure / client gone
-                    self._forget(rid)
+        return _Stream(self, rid, self._deltas(rid, timeout))
 
-        return _Stream(self, rid, deltas())
+    def _deltas(self, rid: int, timeout: float):
+        """The one token-delta generator behind ``stream`` (submitted
+        requests) and ``watch``/``result`` (adopted handoffs): yields
+        newly-decoded token lists until the request finishes, with the
+        deadline/recovery/abandon discipline shared verbatim."""
+        sent = 0
+        finished = False
+        deadline = time.monotonic() + timeout
+        with self._work:
+            self._watchers[rid] = self._watchers.get(rid, 0) + 1
+        try:
+            while True:
+                with self._work:
+                    # own-deadline check first: expiry beats both
+                    # further waiting and the vanished error (the
+                    # expire path popped the engine's record)
+                    dl = self._deadlines.get(rid)
+                    if dl is not None and time.monotonic() > dl \
+                            and rid not in self._deadline_hit:
+                        self._expire_deadline(rid)
+                    if rid in self._deadline_hit:
+                        raise DeadlineExceeded(
+                            f"request {rid} exceeded its deadline")
+                    if rid in self._lost_rids:
+                        raise RuntimeError(
+                            f"request {rid} lost in engine restart")
+                    erid = self._rid_map.get(rid)
+                    prog = self.engine.progress(erid) \
+                        if erid is not None else None
+                    if prog is None:
+                        if self._recovering:
+                            # mid-restore: the request is captured,
+                            # not gone — wait for the rebuilt engine
+                            self._work.wait(timeout=0.05)
+                            continue
+                        if self._failed is not None:
+                            # drained as failed by a terminal
+                            # engine death (possibly a cancelled
+                            # recovery) — name the real cause
+                            raise RuntimeError(
+                                f"serving loop failed: {self._failed}")
+                        # reaped out from under us (shutdown race)
+                        raise RuntimeError(f"request {rid} vanished")
+                    toks, done = prog
+                    delta = toks[sent:]
+                    if done:
+                        ledger = self._pop_ledger(erid)
+                        self.engine.pop_result(erid)
+                        self._account(rid, "finished", ledger)
+                        finished = True
+                    elif not delta:
+                        if self._failed is not None:
+                            raise RuntimeError(
+                                f"serving loop failed: {self._failed}")
+                        if self._stop:
+                            # loop.shutdown() ran (drain timeout /
+                            # interpreter exit): no tick will ever
+                            # finish this request — fail it NOW so
+                            # the non-daemon handler thread exits
+                            # instead of waiting out its timeout
+                            raise RuntimeError(
+                                f"request {rid} unfinished at server "
+                                "shutdown")
+                        remaining = deadline - time.monotonic()
+                        if remaining <= 0:
+                            raise TimeoutError(
+                                f"request {rid} timed out")
+                        self._work.wait(timeout=min(remaining, 1.0))
+                        continue
+                if delta:
+                    sent += len(delta)
+                    yield delta
+                if finished:
+                    return
+        finally:
+            with self._work:
+                left = self._watchers.get(rid, 0) - 1
+                if left > 0:
+                    self._watchers[rid] = left
+                else:
+                    self._watchers.pop(rid, None)
+            if not finished and left <= 0:
+                # timeout / failure / client gone — and no OTHER
+                # consumer (a retried resume) still attached
+                self._forget(rid)
+
+    def _forget_if_unwatched(self, rid: int) -> None:
+        """_Stream.close()'s forget: skipped while another consumer
+        (a retried handoff resume) is still attached to the rid."""
+        with self._work:
+            if self._watchers.get(rid, 0) > 0:
+                return
+        self._forget(rid)
+
+    def _reap_orphans(self) -> None:
+        """Decode-role reaper thread: an adopted handoff whose consumer
+        never attached (the gateway crashed mid-resume, or phase 2
+        exhausted its retries — the pusher's 'decode side owns an
+        orphan now' case) would otherwise decode to completion and park
+        its result, ledger and rid maps forever. Whatever is still
+        armed in _handoff_deadline past its TTL is dropped: unclaimed
+        live requests are cancelled out of the engine (terminal
+        ``cancelled``, exactly once), consumed finals just leave the
+        re-fetch cache."""
+        period = min(5.0, max(0.1, self._adopt_ttl_s / 4.0))
+        while not self._stop_event.wait(period):
+            expired: list = []
+            with self._work:
+                if not self._handoff_deadline:
+                    continue
+                now = time.monotonic()
+                for rid, dl in list(self._handoff_deadline.items()):
+                    if now <= dl:
+                        continue
+                    self._handoff_deadline.pop(rid, None)
+                    if self._adopted_final.pop(rid, None) is not None:
+                        self._adopted.pop(rid, None)
+                    else:
+                        expired.append(rid)
+            for rid in expired:
+                self._forget(rid)
 
     def shutdown(self) -> None:
         """Stop the loop deterministically, INCLUDING during an
@@ -1770,6 +2156,10 @@ class ServingLoop:
         self._thread.join(timeout=5)
         if self._monitor_thread is not None:
             self._monitor_thread.join(timeout=5)
+        if self._handoff_thread is not None:
+            self._handoff_thread.join(timeout=5)
+        if self._orphan_thread is not None:
+            self._orphan_thread.join(timeout=5)
 
 
 class _Stream:
@@ -1792,7 +2182,7 @@ class _Stream:
 
     def close(self) -> None:
         self._gen.close()
-        self._loop._forget(self.rid)
+        self._loop._forget_if_unwatched(self.rid)
 
 
 def build_engine(cfg: ServerConfig):
@@ -1862,10 +2252,34 @@ def build_engine(cfg: ServerConfig):
             raise ValueError(
                 f"kv_blocks must be >= 2 (one reserved null block plus "
                 f"at least one usable), got {cfg.kv_blocks}")
-        if cfg.tp and cfg.tp > 1:
+        if cfg.tp and cfg.tp > 1 and cfg.draft_checkpoint_dir:
             raise ValueError(
-                "paged KV (kv_blocks > 0) is not yet mesh-aware; "
-                "run tp with kv_blocks=0")
+                "speculative decoding over a paged arena is single-host "
+                "only (the draft arena is not mesh-aware yet): run "
+                "tp with kv_blocks=0, or paged speculative with tp=0 "
+                "— the engine would reject the combination anyway, "
+                "refuse it before the checkpoint load")
+    if cfg.role not in ("colocated", "prefill", "decode"):
+        raise ValueError(
+            f"role must be colocated|prefill|decode, got {cfg.role!r}")
+    if cfg.role != "colocated" and not cfg.kv_blocks:
+        raise ValueError(
+            f"role={cfg.role} requires the paged KV cache (set "
+            f"kv_blocks/kv_block_size): the prefill->decode handoff "
+            f"payload is the paged swap format — quantized blocks + "
+            f"per-block scales — which the slot-static engine cannot "
+            f"produce or adopt")
+    if cfg.role == "prefill" and not cfg.decode_pool.strip():
+        raise ValueError(
+            "role=prefill requires --decode-pool (comma-separated "
+            "decode-replica base URLs): a prefill server with nowhere "
+            "to ship its handoffs would strand every request after "
+            "its first token")
+    if cfg.role != "colocated" and cfg.draft_checkpoint_dir:
+        raise ValueError(
+            f"role={cfg.role} is not supported with speculative "
+            f"decoding: the draft cache has no handoff payload format "
+            f"— run the speculative fleet colocated")
     mesh = None
     if cfg.tp and cfg.tp > 1:
         import jax
@@ -1946,7 +2360,7 @@ def build_engine(cfg: ServerConfig):
                         kv_blocks=cfg.kv_blocks, kv_swap=cfg.kv_swap,
                         hbm_admit_frac=cfg.kv_hbm_admit_frac,
                         kv_dtype=cfg.kv_dtype,
-                        tenant_quota=tenant_quota)
+                        tenant_quota=tenant_quota, role=cfg.role)
 
 
 def make_http_server(cfg: ServerConfig, loop: ServingLoop
@@ -2008,6 +2422,40 @@ def make_http_server(cfg: ServerConfig, loop: ServingLoop
                 # rolling rates — the operator's first stop before
                 # metrics history or traces
                 self._reply(200, loop.stats())
+            elif self.path.startswith("/v1/result/"):
+                # decode-role unary attach: the full sequence of an
+                # adopted handoff once it finishes (gateway phase 2)
+                try:
+                    rid = int(self.path.rsplit("/", 1)[1].split("?")[0])
+                    tokens = loop.result(rid, timeout=cfg.drain_timeout_s
+                                         + 270.0)
+                except ValueError as e:
+                    self._reply(404, {"error": str(e),
+                                      "reason": "unknown_rid"})
+                    return
+                except DeadlineExceeded as e:
+                    self._reply(504, {"error": str(e),
+                                      "deadline_exceeded": True})
+                    return
+                except TimeoutError as e:
+                    self._reply(503, {"error": str(e),
+                                      "reason": "timeout"})
+                    return
+                except Exception as e:  # noqa: BLE001 — JSON 500
+                    self._reply(500, {"error": f"{type(e).__name__}: {e}"})
+                    return
+                self._reply(200, {"tokens": tokens})
+            elif self.path.startswith("/v1/stream/"):
+                # decode-role streaming attach: SSE over an adopted
+                # handoff's remaining tokens
+                try:
+                    rid = int(self.path.rsplit("/", 1)[1].split("?")[0])
+                    gen = loop.watch(rid)
+                except ValueError as e:
+                    self._reply(404, {"error": str(e),
+                                      "reason": "unknown_rid"})
+                    return
+                self._stream_sse(gen)
             elif self.path == "/debug/traces":
                 self._reply(200, tracing.recorder().to_json())
             elif self.path.startswith("/debug/traces/"):
@@ -2082,6 +2530,31 @@ def make_http_server(cfg: ServerConfig, loop: ServingLoop
                 loop.cancel_drain()
                 self._reply(200, {"status": "ok"})
                 return
+            if self.path == "/v1/handoff":
+                # decode-role ingest: one encoded handoff payload ->
+                # adopted rid (restored byte-exact into the engine)
+                try:
+                    length = int(self.headers.get("Content-Length", 0))
+                    rid = loop.adopt(self.rfile.read(length))
+                except Infeasible as e:
+                    self._reply(400, {"error": f"{type(e).__name__}: {e}",
+                                      "infeasible": True,
+                                      "reason": e.reason})
+                    return
+                except EngineRecovering as e:
+                    self._reply(503, {"error": str(e),
+                                      "reason": "recovering"},
+                                headers=[("Retry-After", "1")])
+                    return
+                except DrainingError as e:
+                    self._reply(503, {"error": str(e),
+                                      "reason": "draining"})
+                    return
+                except Exception as e:  # noqa: BLE001 — JSON 500
+                    self._reply(500, {"error": f"{type(e).__name__}: {e}"})
+                    return
+                self._reply(200, {"rid": rid})
+                return
             if self.path != "/v1/generate":
                 self._reply(404, {"error": f"unknown path {self.path}"})
                 return
@@ -2135,6 +2608,16 @@ def make_http_server(cfg: ServerConfig, loop: ServingLoop
                     "deadline_s", self.headers.get("X-Request-Deadline-S"))
                 if deadline is not None:
                     sampling["deadline_s"] = float(deadline)
+                if cfg.role == "prefill":
+                    # prefill role: the answer is a handoff descriptor
+                    # ({"handoff": {"target", "rid"}}) the gateway
+                    # follows to the decode replica's /v1/result or
+                    # /v1/stream — or plain tokens when the first
+                    # token already completed the request. The
+                    # ``stream`` flag is irrelevant here: streaming
+                    # happens at the decode replica.
+                    self._reply(200, loop.prefill(prompt, n, **sampling))
+                    return
                 if body.get("stream"):
                     # stream() submits eagerly, so validation errors land
                     # in the except arms below as a clean JSON 4xx —
@@ -2264,6 +2747,23 @@ def main(argv: Optional[Sequence[str]] = None) -> None:
              "gather formulation). Plumbed as NOS_TPU_PAGED_KERNEL; "
              "echoed in /stats config for fleet drift detection")
     parser.add_argument(
+        "--role", choices=("colocated", "prefill", "decode"),
+        default=None,
+        help="prefill/decode disaggregation role (overrides config): "
+             "colocated = one engine prefills and decodes (default); "
+             "prefill = requests leave after their first token as a "
+             "KV handoff shipped round-robin to --decode-pool "
+             "(requires --kv-blocks; int8 KV halves handoff bytes); "
+             "decode = adopts handoffs via POST /v1/handoff and "
+             "serves /v1/result//v1/stream (requires --kv-blocks and "
+             "the same kv geometry as the prefill peers). Echoed in "
+             "/stats config for fleet drift detection")
+    parser.add_argument(
+        "--decode-pool", default=None,
+        help="comma-separated decode-replica base URLs a prefill-role "
+             "server ships handoffs to (required with --role=prefill; "
+             "overrides config)")
+    parser.add_argument(
         "--draft-checkpoint-dir", default=None,
         help="enable speculative decoding: checkpoint of the draft "
              "model that proposes --draft-n-tokens per verify window "
@@ -2340,6 +2840,10 @@ def main(argv: Optional[Sequence[str]] = None) -> None:
         cfg.kv_dtype = args.kv_dtype
     if args.paged_kernel is not None:
         cfg.paged_kernel = args.paged_kernel
+    if args.role is not None:
+        cfg.role = args.role
+    if args.decode_pool is not None:
+        cfg.decode_pool = args.decode_pool
     if args.draft_checkpoint_dir is not None:
         cfg.draft_checkpoint_dir = args.draft_checkpoint_dir
     if args.draft_n_tokens is not None:
@@ -2378,8 +2882,27 @@ def main(argv: Optional[Sequence[str]] = None) -> None:
     # parses inside build_engine so the supervisor factory carries it);
     # a malformed config fails HERE, before the checkpoint load
     tenant_quota = TenantQuotaConfig.load(cfg.tenant_config)
+
+    def _http_handoff_send(target: str, data: bytes) -> int:
+        """Ship one encoded handoff to a decode replica; returns the
+        decode-side rid. Errors propagate — the pusher tries the next
+        pool target."""
+        import urllib.request
+
+        req = urllib.request.Request(
+            target.rstrip("/") + "/v1/handoff", data=data,
+            headers={"Content-Type": "application/octet-stream"},
+            method="POST")
+        with urllib.request.urlopen(req, timeout=30) as resp:
+            return int(json.loads(resp.read())["rid"])
+
+    decode_pool = [u.strip() for u in cfg.decode_pool.split(",")
+                   if u.strip()]
     loop = ServingLoop(
         build_engine(cfg), slo_ttft_ms=cfg.slo_ttft_ms,
+        role=cfg.role, handoff_targets=decode_pool,
+        handoff_send=(_http_handoff_send if cfg.role == "prefill"
+                      else None),
         slo_tpot_ms=cfg.slo_tpot_ms,
         device_stats_interval_s=cfg.device_stats_interval_s,
         engine_factory=factory, restart_budget=cfg.restart_budget,
@@ -2406,6 +2929,13 @@ def main(argv: Optional[Sequence[str]] = None) -> None:
             "draft_n_tokens": (cfg.draft_n_tokens
                                if cfg.draft_checkpoint_dir else 0),
             "max_seq": cfg.max_seq,
+            # disaggregation role + mesh shape: the gateway routes NEW
+            # requests only to prefill/colocated replicas off this
+            # echo, and a replica decoding on a drifted mesh (or the
+            # wrong role) is exactly the split-brain the fleet drift
+            # detector exists to catch
+            "role": cfg.role,
+            "mesh": {"tp": cfg.tp if cfg.tp and cfg.tp > 1 else 0},
             # tenant quota drifting between replicas would make the
             # fleet's notion of "fair" replica-dependent — surface it
             # in the same drift detector as every other knob

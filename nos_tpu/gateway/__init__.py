@@ -24,10 +24,12 @@ policies and the production router cannot drift.
 from nos_tpu.gateway.discovery import PodDiscovery
 from nos_tpu.gateway.ring import HashRing, affinity_pick, prefix_key
 from nos_tpu.gateway.router import (
-    GatewayRouter, Replica, ReplicaUnreachable, RouterConfig,
+    GatewayRouter, HandoffResumeError, Replica, ReplicaUnreachable,
+    RouterConfig,
 )
 
 __all__ = [
-    "GatewayRouter", "HashRing", "PodDiscovery", "Replica",
-    "ReplicaUnreachable", "RouterConfig", "affinity_pick", "prefix_key",
+    "GatewayRouter", "HandoffResumeError", "HashRing", "PodDiscovery",
+    "Replica", "ReplicaUnreachable", "RouterConfig", "affinity_pick",
+    "prefix_key",
 ]

@@ -73,5 +73,13 @@ class PodDiscovery:
             replicas.append(Replica(
                 name=pod.metadata.name,
                 handle=self.handle_for(pod),
-                ready=ready, draining=draining, stats=snap))
+                ready=ready, draining=draining, stats=snap,
+                # the replica's own /stats config echo names its
+                # disaggregation role; decode-role replicas stay OUT
+                # of the new-request ring (they take KV handoffs from
+                # prefill replicas, addressed by the prefill server's
+                # --decode-pool). An unscrapable pod defaults to
+                # colocated — it is not ready anyway.
+                role=str((snap.get("config") or {}).get(
+                    "role", "colocated"))))
         return replicas

@@ -67,8 +67,8 @@ from nos_tpu.utils.metrics import default_registry
 
 logger = logging.getLogger(__name__)
 
-__all__ = ["GatewayRouter", "Replica", "ReplicaUnreachable",
-           "RouterConfig"]
+__all__ = ["GatewayRouter", "HandoffResumeError", "Replica",
+           "ReplicaUnreachable", "RouterConfig"]
 
 #: terminal outcomes nos_tpu_gateway_requests_total reports
 OUTCOMES = ("completed", "shed", "deadline", "failed")
@@ -85,6 +85,14 @@ REASON_NO_REPLICAS = "no_ready_replicas"
 #: same slug as the per-replica shed, so clients see one reason
 #: whichever door refused them
 REASON_TENANT = "tenant_quota"
+
+
+class HandoffResumeError(Exception):
+    """Phase 2 of a disaggregated request failed: the prefill replica
+    already shipped the KV to a decode replica, so re-dispatching from
+    scratch would re-prefill AND orphan the adopted request — the
+    router therefore never retries the whole request past this point
+    (deliberately NOT a RuntimeError: the retry arms catch those)."""
 
 
 class ReplicaUnreachable(RuntimeError):
@@ -110,6 +118,12 @@ class Replica:
     draining: bool = False
     stats: dict = field(default_factory=dict)
     inflight: int = 0
+    # prefill/decode disaggregation role (the replica's /stats config
+    # echo): NEW requests route only to "colocated"/"prefill" replicas;
+    # "decode" replicas never join the ring — they receive work as KV
+    # handoffs from prefill replicas, and the router only talks to
+    # them in phase 2 (resume_transport) of a handed-off request
+    role: str = "colocated"
 
     def load(self) -> float:
         pend = (self.stats.get("pending") or {}).get("depth", 0) or 0
@@ -181,10 +195,23 @@ class GatewayRouter:
                      Callable[[Replica, dict], Iterable[list]]] = None,
                  on_activation: Optional[Callable[[int], None]] = None,
                  clock: Callable[[], float] = time.monotonic,
-                 sleep: Callable[[float], None] = time.sleep):
+                 sleep: Callable[[float], None] = time.sleep,
+                 resume_transport: Optional[
+                     Callable[[Replica, dict, Optional[float]],
+                              list]] = None,
+                 resume_stream_transport: Optional[
+                     Callable[[Replica, dict, Optional[float]],
+                              Iterable[list]]] = None):
         self.cfg = cfg
         self.transport = transport
         self.stream_transport = stream_transport
+        # disaggregation phase 2: a prefill replica answered with a
+        # handoff descriptor {"target", "rid"} — these fetch/stream the
+        # tokens from the decode replica it names. HTTP binary: GET
+        # /v1/result/<rid> and /v1/stream/<rid>; tests inject
+        # ServingLoop.result/watch directly.
+        self.resume_transport = resume_transport
+        self.resume_stream_transport = resume_stream_transport
         self.on_activation = on_activation
         self.clock = clock
         self.sleep = sleep
@@ -202,6 +229,7 @@ class GatewayRouter:
         self._next_ticket = 0
         self._door_peak = 0
         self._counts: Dict[str, int] = {k: 0 for k in OUTCOMES}
+        self._handoffs = 0
         self._shed: Dict[str, int] = {}
         self._tenant_shed: Dict[str, int] = {}
         self._routes: Dict[str, int] = {}
@@ -230,6 +258,14 @@ class GatewayRouter:
             "saturated/not admitting, least-loaded took it | no_key = "
             "prompt had no full-block prefix to key on)",
             ("path",))
+        self.m_handoff = reg.counter(
+            "nos_tpu_gateway_handoff_total",
+            "Disaggregated requests the gateway followed from a "
+            "prefill replica's handoff descriptor to a decode replica, "
+            "by outcome (resumed = tokens delivered from the decode "
+            "replica | failed = phase 2 exhausted its attempts — the "
+            "request is NOT re-dispatched, its KV already moved)",
+            ("outcome",))
         self.m_retries = reg.counter(
             "nos_tpu_gateway_retries_total",
             "Dispatch attempts beyond each request's first, by cause "
@@ -272,7 +308,8 @@ class GatewayRouter:
                          if c == 0 and n not in fresh]:
                 del self._inflight[name]
             self._ring.sync(n for n in fresh
-                            if fresh[n].ready and not fresh[n].draining)
+                            if fresh[n].ready and not fresh[n].draining
+                            and fresh[n].role != "decode")
             n_ready = len(self._admitting())
             n_drain = sum(1 for r in fresh.values() if r.draining)
             self.g_replicas.labels("ready").set(n_ready)
@@ -283,8 +320,10 @@ class GatewayRouter:
                 self._lock.notify_all()     # flush the door queue
 
     def _admitting(self) -> List[str]:
+        """Replicas NEW requests may land on: ready, not draining, and
+        not decode-role (decode replicas only take handed-off KV)."""
         return [n for n, r in self._replicas.items()
-                if r.ready and not r.draining]
+                if r.ready and not r.draining and r.role != "decode"]
 
     def _inflight_delta(self, name: str, delta: int) -> None:
         """Caller holds the lock. The dict is the truth; the current
@@ -583,11 +622,144 @@ class GatewayRouter:
             finally:
                 with self._lock:
                     self._inflight_delta(rep.name, -1)
+            if isinstance(tokens, dict):
+                # a prefill-role replica answers with a handoff
+                # descriptor (follow it — phase 2, never re-dispatched)
+                # or {"tokens": ...} when the first token completed
+                # the request locally
+                if "handoff" in tokens:
+                    tokens = self._follow_handoff(tokens["handoff"],
+                                                  deadline)
+                else:
+                    tokens = tokens.get("tokens", tokens)
             with self._lock:
                 self._counts["completed"] += 1
             self.m_requests.labels("completed").inc()
             return tokens, rep.name, attempt + 1
         self._raise_exhausted(last)
+
+    def _resolve_target(self, target) -> Replica:
+        """The decode replica a handoff descriptor names: matched by
+        name or transport handle against discovery's table, else a
+        synthetic Replica around the raw target (the prefill server
+        addresses its pool by base URL, which IS the HTTP handle)."""
+        with self._lock:
+            for r in self._replicas.values():
+                if r.name == target or r.handle == target:
+                    return r
+        return Replica(name=str(target), handle=target, role="decode")
+
+    def _follow_handoff(self, desc: dict, deadline: Optional[float]):
+        """Phase 2 of a disaggregated request: fetch the tokens from
+        the decode replica the descriptor names. Bounded retries
+        against THAT replica only (attach is idempotent until the
+        result is handed out); on exhaustion the request fails
+        terminally — the KV already moved, so re-dispatching from
+        scratch would re-prefill and orphan the adopted request."""
+        if self.resume_transport is None:
+            raise HandoffResumeError(
+                "prefill replica answered with a handoff but the "
+                "router has no resume_transport configured")
+        rep = self._resolve_target(desc.get("target"))
+        last: Optional[Exception] = None
+        for attempt in range(3):
+            try:
+                rem = self._remaining(deadline)
+            except DeadlineExceeded:
+                # gateway-side expiry between attempts (_remaining
+                # self-accounts the request outcome): the handoff
+                # counter must record the failed resume too, exactly
+                # like the decode-raised 504 arm below
+                self.m_handoff.labels("failed").inc()
+                raise
+            try:
+                tokens = self.resume_transport(rep, desc, rem)
+            except (ReplicaUnreachable, EngineRecovering,
+                    TimeoutError) as e:
+                last = e
+                self.sleep(self._backoff_s(e, attempt))
+                continue
+            except DeadlineExceeded:
+                # the DECODE side says the budget expired: one
+                # terminal deadline outcome, like every other exit
+                with self._lock:
+                    self._counts["deadline"] += 1
+                self.m_requests.labels("deadline").inc()
+                self.m_handoff.labels("failed").inc()
+                raise
+            except Exception as e:  # noqa: BLE001 — non-retryable
+                # 400/404/500/draining from the decode replica: no
+                # amount of retrying THIS replica helps, and retrying
+                # elsewhere is forbidden (the KV lives only there)
+                last = e
+                break
+            with self._lock:
+                self._handoffs += 1
+            self.m_handoff.labels("resumed").inc()
+            return tokens
+        with self._lock:
+            self._counts["failed"] += 1
+        self.m_requests.labels("failed").inc()
+        self.m_handoff.labels("failed").inc()
+        raise HandoffResumeError(
+            f"handoff resume at {rep.name} failed: {last}")
+
+    def _follow_handoff_stream(self, desc: dict,
+                               deadline: Optional[float]):
+        """Streaming twin of ``_follow_handoff``: attach to the decode
+        replica's stream, retrying transient failures against THAT
+        replica only until the first delta (attach is idempotent);
+        after first byte a failure propagates (no replay — tokens left
+        the building), and exhaustion/non-retryables convert to the
+        terminal HandoffResumeError so the caller's retry arm can
+        never re-dispatch a request whose KV already moved."""
+        if self.resume_stream_transport is None:
+            raise HandoffResumeError(
+                "prefill replica answered with a handoff but the "
+                "router has no resume_stream_transport configured")
+        rep = self._resolve_target(desc.get("target"))
+        last: Optional[Exception] = None
+        for attempt in range(3):
+            rem = None
+            if deadline is not None:
+                # NOT _remaining(): that self-accounts the deadline
+                # outcome, but this raise lands in the caller's
+                # ``except DeadlineExceeded`` arm which accounts it —
+                # exactly once, like the transport-raised 504
+                rem = deadline - self.clock()
+                if rem <= 0:
+                    raise DeadlineExceeded(
+                        "request spent its deadline at the gateway "
+                        "during the handoff stream attach")
+            started = False
+            try:
+                for delta in self.resume_stream_transport(rep, desc,
+                                                          rem):
+                    if not started:
+                        started = True
+                        with self._lock:
+                            self._handoffs += 1
+                        self.m_handoff.labels("resumed").inc()
+                    yield delta
+                return
+            except (ReplicaUnreachable, EngineRecovering,
+                    TimeoutError) as e:
+                if started:
+                    raise       # first byte out: exactly-once forbids replay
+                last = e
+                self.sleep(self._backoff_s(e, attempt))
+                continue
+            except DeadlineExceeded:
+                raise           # the caller accounts the deadline outcome
+            except Exception as e:  # noqa: BLE001 — non-retryable
+                if started:
+                    raise
+                last = e
+                break
+        # the caller's HandoffResumeError arm accounts the terminal
+        # failed outcome AND the m_handoff failed sample — once
+        raise HandoffResumeError(
+            f"handoff stream resume at {rep.name} failed: {last}")
 
     @staticmethod
     def _retry_cause(e: Exception) -> str:
@@ -623,7 +795,11 @@ class GatewayRouter:
         Returns a generator; closing it mid-stream closes the replica
         stream (the serving loop accounts the cancel). ``tenant`` as
         in ``dispatch``."""
-        if self.stream_transport is None:
+        if self.stream_transport is None \
+                and self.resume_stream_transport is None:
+            # a pure-disagg fleet streams via transport (phase 1 unary
+            # to the prefill replica) + resume_stream_transport, so
+            # either streaming path satisfies the guard
             raise RuntimeError("router has no stream transport")
         cfg = self.cfg
         t0 = self.clock()
@@ -652,10 +828,52 @@ class GatewayRouter:
                        "max_new_tokens": max_new_tokens,
                        "deadline_s": rem, "sampling": dict(samp)}
                 started = False
+                released = False
                 try:
-                    for delta in self.stream_transport(rep, req):
-                        started = True
-                        yield delta
+                    if rep.role == "prefill":
+                        # disaggregated stream: the prefill replica
+                        # answers unary with a handoff descriptor, the
+                        # token stream comes from the decode replica
+                        # (phase 2 — once the descriptor is back the
+                        # KV has moved, so no whole-request retry:
+                        # _follow_handoff_stream retries the DECODE
+                        # replica only and is terminal on exhaustion)
+                        res = self.transport(rep, req)
+                        if isinstance(res, dict) and "handoff" in res:
+                            # prefill's work ended with the descriptor:
+                            # release its inflight BEFORE the (long)
+                            # phase-2 decode stream, like the unary
+                            # path — or least-loaded routing would see
+                            # a free prefill replica as busy for the
+                            # whole downstream decode
+                            with self._lock:
+                                self._inflight_delta(rep.name, -1)
+                            released = True
+                            for delta in self._follow_handoff_stream(
+                                    res["handoff"], deadline):
+                                started = True
+                                yield delta
+                        else:
+                            # completed at prefill (max_new_tokens 1):
+                            # the generated tail is the single delta
+                            toks = (res.get("tokens", res)
+                                    if isinstance(res, dict) else res)
+                            started = True
+                            yield list(toks[len(prompt):])
+                    else:
+                        if self.stream_transport is None:
+                            # pure-disagg wiring (resume-only) but
+                            # discovery surfaced a colocated replica
+                            # (e.g. mid-migration): retryable — the
+                            # next attempt can land on a prefill
+                            # replica this router CAN stream through
+                            raise ReplicaUnreachable(
+                                f"replica {rep.name} role={rep.role} "
+                                "needs a stream_transport this router "
+                                "was not configured with")
+                        for delta in self.stream_transport(rep, req):
+                            started = True
+                            yield delta
                     with self._lock:
                         self._counts["completed"] += 1
                     self.m_requests.labels("completed").inc()
@@ -669,6 +887,14 @@ class GatewayRouter:
                     with self._lock:
                         self._counts["deadline"] += 1
                     self.m_requests.labels("deadline").inc()
+                    raise
+                except HandoffResumeError:
+                    # phase 2 failed before first byte: terminal — the
+                    # KV already moved, re-dispatch would re-prefill
+                    with self._lock:
+                        self._counts["failed"] += 1
+                    self.m_requests.labels("failed").inc()
+                    self.m_handoff.labels("failed").inc()
                     raise
                 except (QueueFull, ReplicaUnreachable, TimeoutError,
                         RuntimeError) as e:
@@ -692,8 +918,9 @@ class GatewayRouter:
                     self.sleep(self._backoff_s(e, attempt))
                     continue
                 finally:
-                    with self._lock:
-                        self._inflight_delta(rep.name, -1)
+                    if not released:
+                        with self._lock:
+                            self._inflight_delta(rep.name, -1)
             self._raise_exhausted(last)
 
         return gen()
@@ -712,11 +939,13 @@ class GatewayRouter:
                     name: {
                         "ready": r.ready and not r.draining,
                         "draining": r.draining,
+                        "role": r.role,
                         "inflight": r.inflight,
                         "load": r.load(),
                     } for name, r in sorted(self._replicas.items())
                 },
                 "ready_replicas": len(admitting),
+                "handoffs": self._handoffs,
                 "requests": dict(self._counts),
                 "shed": dict(self._shed),
                 "tenant_shed": dict(self._tenant_shed),

@@ -61,6 +61,37 @@ def init_cache(cfg: TransformerConfig, batch: int,
     }
 
 
+def replicated_logits(step: jax.Array, mesh=None) -> jax.Array:
+    """Canonicalize a logit row for a SAMPLING decision: f32, and —
+    under a mesh — constrained replicated before any sort/softmax/
+    categorical runs on it.
+
+    Why (the triaged root cause of the seed-old sharded-sampling
+    failures): logits leave the unembed matmul sharded over the vocab
+    axis (`tp`), and GSPMD propagates that sharding BACKWARD into
+    `jax.random.categorical`'s threefry program — whose partitioned
+    lowering draws DIFFERENT gumbel bits than the replicated one, so
+    the sharded engine sampled a different stream than the single-host
+    engine even from bitwise-close logits (the sort/softmax/cumsum
+    stages were verified bit-equal; only the in-categorical RNG
+    diverged). Constraining the row replicated makes the whole
+    decision pipeline — truncation thresholds, CDF boundaries, and the
+    RNG — run the exact single-device program on every chip: same
+    bits as an unsharded run, so sampled streams are invariant to the
+    mesh. The remaining tp reduction-order ULPs in the logit VALUES
+    are absorbed the same way greedy argmax absorbs them (O(1) gaps
+    at every comparison, not O(ulp)). f32 is a no-op today (logits
+    are already f32) but pins the contract against a lower-precision
+    head. With ``mesh=None`` this is the identity on values —
+    single-host streams are unchanged."""
+    step = step.astype(jnp.float32)
+    if mesh is not None:
+        from jax.sharding import NamedSharding, PartitionSpec
+        step = jax.lax.with_sharding_constraint(
+            step, NamedSharding(mesh, PartitionSpec()))
+    return step
+
+
 def cache_shardings(mesh, cfg: TransformerConfig,
                     per_row_pos: bool = False) -> Cache:
     """NamedShardings for an ``init_cache`` pytree on a serving mesh:
@@ -126,10 +157,74 @@ def init_paged_cache(cfg: TransformerConfig, kv_blocks: int,
     }
 
 
+def paged_cache_shardings(mesh, cfg: TransformerConfig,
+                          kv_dtype: str = "bf16") -> Cache:
+    """NamedShardings for an ``init_paged_cache`` pytree on a serving
+    mesh: the arena (and, for int8, its scale planes) shards across KV
+    heads over ``tp`` — axis 2 of ``[L, NB, Hkv, bs, D]``, the same
+    head axis ``cache_shardings`` splits, because paged decode is
+    bound by reading the arena from HBM exactly like the slot-static
+    cache. The BLOCK axis stays replicated: block ids are host control
+    state (tables, allocator refcounts), and every host must be able
+    to address any block. ``pos`` and block tables are host-written
+    control rows — replicated, like the slot-static mesh convention
+    (serving.py keeps them device_put replicated)."""
+    from nos_tpu.parallel.mesh import logical_to_sharding
+    if "tp" in mesh.axis_names:
+        tp = mesh.shape["tp"]
+        if cfg.kv_heads % tp:
+            raise ValueError(
+                f"kv_heads {cfg.kv_heads} not divisible by tp={tp}; the "
+                f"paged arena's head axis cannot shard evenly")
+    kv = logical_to_sharding(mesh, None, None, "tp", None, None)
+    shd = {"k": kv, "v": kv,
+           "pos": logical_to_sharding(mesh, None)}
+    if kv_dtype == "int8":
+        scale = logical_to_sharding(mesh, None, None, "tp", None)
+        shd["k_scale"] = scale
+        shd["v_scale"] = scale
+    return shd
+
+
+def _paged_kernel_sharded(q, ck, cv, table, pos, *, k_scale, v_scale,
+                          scale, mesh):
+    """``paged_decode_attention`` under a mesh: shard_map over the
+    ``tp`` axis so each chip runs the Pallas kernel on ITS slice of
+    the head axis (arena blocks arrive pre-sharded over Hkv; q over H;
+    tables/pos are replicated control rows). The kernel grid is
+    head-parallel — rows of different kv heads never share softmax
+    state — so the per-shard program is the single-host kernel at
+    Hkv/tp heads, and no collective (and no unsharded timeline) is
+    needed. Meshes without a ``tp`` axis run the kernel replicated
+    (every axis in the specs below degenerates to no partitioning)."""
+    from jax.experimental.shard_map import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    tp = "tp" if "tp" in mesh.axis_names else None
+    head = P(None, tp, None, None)      # q/out [B,H,S,D]; arena [NB,Hkv,bs,D]
+    rep = P()
+    in_specs = (head, head, head, rep, rep)
+    args = [q, ck, cv, table, pos]
+    if k_scale is not None:
+        sc = P(None, tp, None)          # [NB, Hkv, bs]
+        in_specs = in_specs + (sc, sc)
+        args += [k_scale, v_scale]
+
+    def local(q, ck, cv, table, pos, *scales):
+        ks, vs = scales if scales else (None, None)
+        from nos_tpu.ops.attention import paged_decode_attention
+        return paged_decode_attention(q, ck, cv, table, pos,
+                                      k_scale=ks, v_scale=vs,
+                                      scale=scale)
+
+    return shard_map(local, mesh=mesh, in_specs=in_specs,
+                     out_specs=head, check_rep=False)(*args)
+
+
 def forward_paged(
     params: Params, cfg: TransformerConfig, tokens: jax.Array,
     cache: Cache, table: jax.Array, *,
-    paged_impl: Optional[str] = None,
+    paged_impl: Optional[str] = None, mesh=None,
 ) -> Tuple[jax.Array, Cache]:
     """``forward_with_cache`` over a paged arena: tokens [B, S] (the
     next S tokens after each row's ``cache['pos']``), per-slot block
@@ -171,7 +266,17 @@ def forward_paged(
     cannot silently flip what a not-yet-traced shape compiles to while
     /stats echoes the stale value; the speculative engine pins "xla"
     (its verify windows are S > 1 gather — mixing would break its
-    greedy-equals-plain-decoding contract at near-tie logits)."""
+    greedy-equals-plain-decoding contract at near-tie logits).
+
+    ``mesh`` (the serving engine's mesh, None single-host) only
+    matters to the kernel formulation: Pallas cannot be auto-
+    partitioned by GSPMD, so kernel decode steps on a mesh run under
+    ``shard_map`` over the ``tp`` axis (head-parallel — each chip
+    walks its own Hkv/tp slice of the arena; see
+    ``_paged_kernel_sharded``). The XLA gather formulation needs no
+    mesh plumb: GSPMD partitions the gather/scatter/attention ops
+    itself, keeping the arena's head sharding through the gathered
+    view — the mesh escape hatch."""
     from nos_tpu.ops.attention import (
         dequantize_kv, effective_paged_impl, paged_decode_attention,
         paged_gather_kv, paged_gather_scale, paged_scatter_kv,
@@ -220,9 +325,15 @@ def forward_paged(
                                       vt.astype(cv.dtype))
         if use_kernel:
             with jax.named_scope("paged_attention_kernel"):
-                o = paged_decode_attention(
-                    q.transpose(0, 2, 1, 3), ck, cv, table, pos0,
-                    k_scale=cks, v_scale=cvs, scale=scale)
+                if mesh is not None:
+                    o = _paged_kernel_sharded(
+                        q.transpose(0, 2, 1, 3), ck, cv, table, pos0,
+                        k_scale=cks, v_scale=cvs, scale=scale,
+                        mesh=mesh)
+                else:
+                    o = paged_decode_attention(
+                        q.transpose(0, 2, 1, 3), ck, cv, table, pos0,
+                        k_scale=cks, v_scale=cvs, scale=scale)
         else:
             with jax.named_scope("paged_gather"):
                 if int8_kv:
@@ -476,6 +587,7 @@ def generate(
     top_p: float = 0.0,
     rng: Optional[jax.Array] = None,
     max_len: Optional[int] = None,
+    mesh=None,
 ) -> jax.Array:
     """Greedy (temperature 0) or temperature sampling, optionally
     truncated to the ``top_k`` most likely tokens and/or the smallest
@@ -484,7 +596,14 @@ def generate(
     decode steps — jit the whole call.
 
     ``max_len`` bounds the cache (default cfg.max_seq); the caller must
-    keep S + max_new_tokens <= max_len."""
+    keep S + max_new_tokens <= max_len.
+
+    ``mesh``: pass the device mesh when ``params`` are tp-sharded and
+    ``temperature > 0`` — every sampling decision then runs on a
+    replicated f32 logit row (``replicated_logits``), which pins the
+    sampled stream bit-equal to the single-device run across mesh
+    shapes (greedy needs no mesh: argmax is layout-exact already).
+    The serving engine passes its own mesh automatically."""
     b, s = prompt.shape
     if max_new_tokens <= 0:
         return prompt
@@ -510,7 +629,11 @@ def generate(
     def pick(step_logits, key):
         if temperature > 0:
             # temperature FIRST, truncation second: the nucleus must
-            # cover the distribution actually sampled from
+            # cover the distribution actually sampled from. The row is
+            # canonicalized (replicated f32) BEFORE any decision op so
+            # the whole pipeline — including categorical's RNG — runs
+            # the single-device program whatever the params' sharding
+            step_logits = replicated_logits(step_logits, mesh)
             return jax.random.categorical(
                 key,
                 _truncate_logits(step_logits / temperature, top_k, top_p),

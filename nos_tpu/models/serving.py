@@ -41,7 +41,9 @@ import numpy as np
 from nos_tpu.models.generate import (
     Cache, _truncate_logits_rows, cache_shardings, forward_paged,
     forward_with_cache, init_cache, init_paged_cache,
+    paged_cache_shardings, replicated_logits,
 )
+from nos_tpu.models.handoff import handoff_nbytes
 from nos_tpu.models.kvblocks import (
     BlockAllocator, NoFreeBlocks, PrefixBlockIndex, ScaleLedger,
     blocks_for,
@@ -238,7 +240,7 @@ class DecodeServer:
                  kv_swap: bool = True, hbm_admit_frac: float = 0.0,
                  kv_dtype: str = "bf16",
                  tenant_quota: Optional[TenantQuotaConfig] = None,
-                 tenant_clock=None):
+                 tenant_clock=None, role: str = "colocated"):
         if prefill_chunk and (prefill_chunk < 8
                               or prefill_chunk & (prefill_chunk - 1)):
             raise ValueError(
@@ -309,11 +311,30 @@ class DecodeServer:
                     f"(blocks_per_slot x block_size) must equal max_len "
                     f"exactly so paged attention stays bit-identical to "
                     f"the slot-static program")
-            if mesh is not None:
+            if mesh is not None and "tp" in mesh.axis_names \
+                    and cfg.kv_heads % mesh.shape["tp"]:
                 raise ValueError(
-                    "paged KV is not yet mesh-aware: run kv_blocks=0 "
-                    "with tp, or paged on a single device (sharding the "
-                    "arena's head axis is the planned follow-up)")
+                    f"paged KV on this mesh: kv_heads {cfg.kv_heads} "
+                    f"not divisible by tp={mesh.shape['tp']} — the "
+                    f"block arena shards its head axis over tp "
+                    f"(paged_cache_shardings) and cannot split a head; "
+                    f"use a tp that divides kv_heads or run kv_blocks=0")
+        # prefill/decode disaggregation role: "colocated" (the default
+        # — prefill and decode in one engine), "prefill" (requests
+        # leave after their first token as a KV handoff payload, see
+        # pop_handoffs), "decode" (a colocated engine that mainly
+        # adopts handoffs via restore; identical engine behavior, the
+        # label is for validation + the /stats config echo)
+        if role not in ("colocated", "prefill", "decode"):
+            raise ValueError(
+                f"role must be colocated|prefill|decode, got {role!r}")
+        if role != "colocated" and not self.paged:
+            raise ValueError(
+                f"role={role} requires the paged KV cache (set "
+                f"kv_blocks/kv_block_size): the handoff payload is the "
+                f"swap format — quantized blocks + per-block scales — "
+                f"which only the paged engine stores")
+        self.role = role
         # tensor-parallel serving: with a mesh, the engine places its KV
         # cache with the heads axis over ``tp`` (cache_shardings) to
         # match params sharded by transformer.param_shardings — ONE
@@ -351,6 +372,16 @@ class DecodeServer:
         # cross-corrupt KV. Preemption accounting rides alongside.
         self._deferred: List[int] = []
         self.preempts = {"swap": 0, "recompute": 0}
+        # prefill/decode disaggregation (role="prefill"): requests that
+        # finished prefill park HERE as resumable handoff states (the
+        # swap-payload format — see _handoff_slot) until the serving
+        # loop ships them to a decode-role engine. Insertion-ordered:
+        # pop_handoffs hands them out in admission order. The counters
+        # feed nos_tpu_serve_handoff_* and the bench's byte model.
+        self._handoffs: Dict[int, dict] = {}
+        self.handoffs = 0
+        self.handoff_payload_bytes = 0
+        self.handoff_capture_s = 0.0
         # quota-reclaim preemptions (a subset of preempts): slots
         # vacated because a guaranteed tenant was waiting, not because
         # the block pool ran dry
@@ -360,10 +391,27 @@ class DecodeServer:
         self._hbm_next = 0.0
         if mesh is not None:
             from jax.sharding import NamedSharding, PartitionSpec
-            shd = cache_shardings(mesh, cfg, per_row_pos=True)
-            self.cache = jax.device_put(self.cache, shd)
-            self._row_shd = shd["k"]
             self._rep = NamedSharding(mesh, PartitionSpec())
+            if self.paged:
+                # the arena (and int8 scale planes) shard their KV-head
+                # axis over tp — same convention as the slot-static
+                # cache; block ids stay replicated (they are host
+                # control state). Scratch prefill rows carry the same
+                # head sharding, so prefill runs sharded and the
+                # block installs never gather.
+                shd = paged_cache_shardings(mesh, cfg,
+                                            kv_dtype=self.kv_dtype)
+                self.cache = jax.device_put(self.cache, shd)
+                self._row_shd = cache_shardings(
+                    mesh, cfg, per_row_pos=True)["k"]
+                # the device block table is a host-written control row
+                # like _last/_temp below: replicated, so every jitted
+                # program sees consistently-placed inputs
+                self._table = jax.device_put(self._table, self._rep)
+            else:
+                shd = cache_shardings(mesh, cfg, per_row_pos=True)
+                self.cache = jax.device_put(self.cache, shd)
+                self._row_shd = shd["k"]
         # admission bound (0 = unbounded): beyond max_batch active slots,
         # at most this many requests may WAIT — past it, submit raises
         # QueueFull so callers shed load (HTTP 429) instead of growing
@@ -499,7 +547,18 @@ class DecodeServer:
             pos0 = cache["pos"]
             logits, cache = fwd(toks, cache)
             cache["pos"] = jnp.where(keep, cache["pos"], pos0)
-            step = logits[:, -1]                            # [B, vocab]
+            step = logits[:, -1]                             # [B, vocab]
+            if sampling:
+                # the decision row is canonicalized (replicated f32
+                # under a mesh) BEFORE argmax/truncation/categorical:
+                # sharded engines then run the exact single-device
+                # sampling program — same RNG bits, same thresholds —
+                # so tokens stay invariant to the mesh on the SAMPLED
+                # path too (see generate.replicated_logits for the
+                # triaged root cause). Greedy-only ticks skip it:
+                # argmax is layout-exact already, and the hottest path
+                # must not pay a per-step [B, vocab] all-gather
+                step = replicated_logits(step, mesh)
             nxt = jnp.argmax(step, axis=-1)
             if sampling:
                 # the token being produced sits at absolute index
@@ -557,7 +616,8 @@ class DecodeServer:
             table = jnp.where(keep[:, None], table, 0)
             return decode_core(
                 lambda t, c: forward_paged(p, cfg, t, c, table,
-                                           paged_impl=self.paged_kernel),
+                                           paged_impl=self.paged_kernel,
+                                           mesh=mesh),
                 toks, cache, keep, temp, topk, topp, seeds, sampling)
 
         if self.paged:
@@ -570,7 +630,8 @@ class DecodeServer:
             # the live arena through without surrendering it.
             self._replay_step = jax.jit(
                 lambda p, t, c, tab: forward_paged(
-                    p, cfg, t, c, tab, paged_impl=self.paged_kernel))
+                    p, cfg, t, c, tab, paged_impl=self.paged_kernel,
+                    mesh=mesh))
         else:
             self._decode = jax.jit(decode, donate_argnums=(2,),
                                    static_argnums=(8,))
@@ -696,35 +757,34 @@ class DecodeServer:
 
             self._cow_block = jax.jit(cow_block, donate_argnums=(0,))
 
-            def restore_block(cache, bk, bv, phys):
-                # swap-in: one host-swapped block ([L, Hkv, bs, D])
-                # back into the arena
-                cache["k"] = jax.lax.dynamic_update_slice(
-                    cache["k"], bk[:, None], (0, phys, 0, 0, 0))
-                cache["v"] = jax.lax.dynamic_update_slice(
-                    cache["v"], bv[:, None], (0, phys, 0, 0, 0))
+            def restore_blocks(cache, bk, bv, idx):
+                # swap-in: a request's WHOLE payload ([L, nblk, Hkv,
+                # bs, D]) scatters back into the arena in ONE donated
+                # dispatch — swap resume, supervised restart and
+                # handoff adoption were paying one dispatch per block,
+                # which showed up as decode-tick stalls on a decode-
+                # role engine adopting under load. Shape key = nblk
+                # (bounded by max_len / block_size compiled variants).
+                cache["k"] = cache["k"].at[:, idx].set(bk)
+                cache["v"] = cache["v"].at[:, idx].set(bv)
                 return cache
 
-            self._restore_block = jax.jit(restore_block,
-                                          donate_argnums=(0,))
+            self._restore_blocks = jax.jit(restore_blocks,
+                                           donate_argnums=(0,))
 
-            def restore_block_q(cache, bk, bv, sk, sv, phys):
+            def restore_blocks_q(cache, bk, bv, sk, sv, idx):
                 # int8 swap-in: the quantized bytes AND their scales
                 # restore together — byte-exact by construction, so a
                 # swapped-and-restored int8 slot continues on the
                 # identical dequantized timeline
-                cache["k"] = jax.lax.dynamic_update_slice(
-                    cache["k"], bk[:, None], (0, phys, 0, 0, 0))
-                cache["v"] = jax.lax.dynamic_update_slice(
-                    cache["v"], bv[:, None], (0, phys, 0, 0, 0))
-                cache["k_scale"] = jax.lax.dynamic_update_slice(
-                    cache["k_scale"], sk[:, None], (0, phys, 0, 0))
-                cache["v_scale"] = jax.lax.dynamic_update_slice(
-                    cache["v_scale"], sv[:, None], (0, phys, 0, 0))
+                cache["k"] = cache["k"].at[:, idx].set(bk)
+                cache["v"] = cache["v"].at[:, idx].set(bv)
+                cache["k_scale"] = cache["k_scale"].at[:, idx].set(sk)
+                cache["v_scale"] = cache["v_scale"].at[:, idx].set(sv)
                 return cache
 
-            self._restore_block_q = jax.jit(restore_block_q,
-                                            donate_argnums=(0,))
+            self._restore_blocks_q = jax.jit(restore_blocks_q,
+                                             donate_argnums=(0,))
 
             def set_row_state(cache, last, slot, pos, tok):
                 # shared admission/resume/fork tail: the slot's device
@@ -1227,6 +1287,13 @@ class DecodeServer:
             # block table (the thing being shared) exists
             self._publish_prefix(req.prompt, row["k"], row["v"],
                                  self._prefix_scope(req))
+        if self.mesh is not None:
+            # the first-token decision runs EAGERLY on this row: under
+            # a mesh it would otherwise execute on the vocab-sharded
+            # layout the unembed left it in, where categorical's RNG
+            # draws different bits than the single-host run (the
+            # decode program's replicated_logits twin, eager form)
+            step = jax.device_put(step.astype(jnp.float32), self._rep)
         if req.temperature > 0:
             # token at absolute index plen: same (seed, index) keying as
             # the decode program, so prefill vs decode is seamless
@@ -1255,6 +1322,12 @@ class DecodeServer:
         # a host sync): TTFT's far stamp, and the TPOT clock's arm
         req.led.t_prefill_end = req.led.t_first = req.led.t_last = \
             time.perf_counter()
+        if self.role == "prefill" and not req.done:
+            # disaggregated serving: a prefill-role engine never
+            # decodes — the request leaves NOW as a KV handoff payload
+            # (its prompt KV + first token), and the freed slot admits
+            # the next prefill
+            return self._handoff_slot(req)
         self._finish_if_done(req)
 
     def _note_tenant_tokens(self, req: _Request, n: int) -> None:
@@ -1735,6 +1808,73 @@ class DecodeServer:
             self._idle_since = None
 
     # ------------------------------------------------------------------
+    # prefill/decode disaggregation (role="prefill"): after prefill
+    # produces a request's first token, the request leaves this engine
+    # as a resumable handoff state — the SAME swap-payload format
+    # preemption and supervised restart already serialize (quantized
+    # blocks + per-block scales under int8, so the handoff bytes halve
+    # with the arena) — which a decode-role engine adopts via the
+    # ordinary ``restore()``, bit-exactly. One payload format for
+    # preempt, restart and handoff: the three paths can never drift.
+    # ------------------------------------------------------------------
+    def _request_state(self, req: _Request) -> dict:
+        """The resumable description of one request — the schema
+        ``restore()`` consumes, shared by supervised-restart capture
+        and the handoff path."""
+        return {
+            "rid": req.rid,
+            "prompt": list(req.prompt),
+            "out": list(req.out[:req.max_new_tokens]),
+            "max_new_tokens": req.max_new_tokens,
+            "temperature": req.temperature,
+            "top_k": req.top_k,
+            "top_p": req.top_p,
+            "seed": req.seed,
+            "stop_tokens": list(req.stop_tokens),
+            "priority": req.priority,
+            "tenant": req.tenant,
+            "cache_prefix": req.cache_prefix,
+        }
+
+    def _handoff_slot(self, req: _Request) -> None:
+        """Vacate a freshly-prefilled slot into a handoff state: swap
+        the committed KV (prompt positions — the first token's KV is
+        written by the decode step that consumes it, which happens on
+        the decode engine) to host, free the blocks, park the state for
+        ``pop_handoffs``. The prefill engine never dispatches a decode
+        tick, so there is no in-flight window to barrier here; the
+        deferred-free discipline still applies for safety."""
+        t0 = time.perf_counter()
+        s = req.slot
+        base = len(req.prompt) + len(req.out) - 1
+        nblk = blocks_for(base, self.kv_block_size)
+        table = self._tables[s]
+        state = self._request_state(req)
+        state["swap"] = self._swap_payload(table, nblk)
+        state["handoff"] = True
+        del self._active[s]
+        self._free_slot_blocks(s)
+        self.cache["pos"] = self.cache["pos"].at[s].set(0)
+        self._free.append(s)
+        req.slot = -1
+        self._handoffs[req.rid] = state
+        self.handoffs += 1
+        self.handoff_payload_bytes += handoff_nbytes(state)
+        self.handoff_capture_s += time.perf_counter() - t0
+        self._record_ledger(req, outcome="handoff")
+        if not self._active:
+            self._idle_since = None
+        self._admit()
+
+    def pop_handoffs(self) -> List[dict]:
+        """Drain the parked handoff states in admission order — the
+        serving loop ships each to a decode-role replica and resolves
+        the waiting client with the decode-side rid."""
+        out = list(self._handoffs.values())
+        self._handoffs.clear()
+        return out
+
+    # ------------------------------------------------------------------
     # supervised-restart support (models/supervision.EngineSupervisor):
     # capture every live request's resumable state from THIS (failed)
     # engine, restore captured state into a FRESH engine. Both lean on
@@ -1761,20 +1901,7 @@ class DecodeServer:
         states = []
         live = list(self._active.values()) + list(self._pending)
         for req in sorted(live, key=lambda r: r.rid):
-            st = {
-                "rid": req.rid,
-                "prompt": list(req.prompt),
-                "out": list(req.out[:req.max_new_tokens]),
-                "max_new_tokens": req.max_new_tokens,
-                "temperature": req.temperature,
-                "top_k": req.top_k,
-                "top_p": req.top_p,
-                "seed": req.seed,
-                "stop_tokens": list(req.stop_tokens),
-                "priority": req.priority,
-                "tenant": req.tenant,
-                "cache_prefix": req.cache_prefix,
-            }
+            st = self._request_state(req)
             if req.rid in pre:
                 st["out"] = []          # mid-prefill: restart admission
             elif req.swap_state is not None:
@@ -1790,6 +1917,10 @@ class DecodeServer:
                     except Exception:   # device gone: recompute instead
                         pass
             states.append(st)
+        # parked handoff states (prefill role): already host-resident
+        # resumable dicts — an engine death between prefill and the
+        # loop's push must not lose the KV the client already paid for
+        states.extend(dict(st) for st in self._handoffs.values())
         for rid, req in list(self._done.items()):
             states.append({
                 "rid": rid,
@@ -1835,6 +1966,16 @@ class DecodeServer:
         if state.get("done"):
             self._done[rid] = req
             return rid
+        if state.get("handoff") and self.role == "prefill":
+            # a rebuilt PREFILL engine re-parks a captured handoff
+            # state (the payload is host-resident — no device work):
+            # the loop still owes a decode replica this push. A decode
+            # engine adopting the same state falls through below to
+            # the ordinary swap-restore resume.
+            st = dict(state)
+            st["rid"] = rid
+            self._handoffs[rid] = st
+            return rid
         if len(prompt) + max_new > self.max_len:
             raise Infeasible(
                 f"restored prompt ({len(prompt)}) + max_new_tokens "
@@ -1849,6 +1990,28 @@ class DecodeServer:
         if req.out:
             swap = state.get("swap")
             if self.paged and swap is not None:
+                want = tuple(self.cache["k"].shape[i] for i in (0, 2, 3, 4))
+                got = tuple(np.asarray(swap["k"]).shape[i]
+                            for i in (0, 2, 3, 4))
+                want_dt = str(self.cache["k"].dtype)
+                got_dt = str(np.asarray(swap["k"]).dtype)
+                if want != got or want_dt != got_dt or \
+                        (("k_scale" in swap) !=
+                         (self.kv_dtype == "int8")):
+                    # a handoff/restart payload from a mismatched
+                    # engine (different block size, kv heads, layers or
+                    # kv_dtype — INCLUDING the planes' float dtype: the
+                    # scatter below would silently cast bf16<->f32,
+                    # perturbing the KV timeline the byte-exact
+                    # contract promises) can never restore here —
+                    # permanent, so Infeasible (HTTP 400), not a retry
+                    raise Infeasible(
+                        f"KV payload geometry [L,Hkv,bs,D]={got} "
+                        f"dtype={got_dt} does not match this engine's "
+                        f"arena {want} dtype={want_dt} "
+                        f"kv_dtype={self.kv_dtype}; handoff/restore "
+                        f"requires identical kv_block_size, kv_dtype "
+                        f"and model geometry on both ends")
                 req.swap_state = dict(swap)
             req.preempted = True
         self._pending.append(req)
@@ -1910,21 +2073,21 @@ class DecodeServer:
         st = req.swap_state
         req.swap_state = None
         req.preempted = False
-        blocks = self._alloc.alloc_many(st["nblk"])
-        for j, phys in enumerate(blocks):
-            if "k_scale" in st:
-                self.cache = self._timed_dispatch(
-                    ("restoreblkq",), self._restore_block_q, self.cache,
-                    jnp.asarray(st["k"][:, j]),
-                    jnp.asarray(st["v"][:, j]),
-                    jnp.asarray(st["k_scale"][:, j]),
-                    jnp.asarray(st["v_scale"][:, j]), jnp.int32(phys))
-            else:
-                self.cache = self._timed_dispatch(
-                    ("restoreblk",), self._restore_block, self.cache,
-                    jnp.asarray(st["k"][:, j]),
-                    jnp.asarray(st["v"][:, j]), jnp.int32(phys))
-            if self._scales is not None:
+        nblk = st["nblk"]
+        blocks = self._alloc.alloc_many(nblk)
+        idx = jnp.asarray(blocks, jnp.int32)
+        if "k_scale" in st:
+            self.cache = self._timed_dispatch(
+                ("restoreblks_q", nblk), self._restore_blocks_q,
+                self.cache, jnp.asarray(st["k"]), jnp.asarray(st["v"]),
+                jnp.asarray(st["k_scale"]), jnp.asarray(st["v_scale"]),
+                idx)
+        else:
+            self.cache = self._timed_dispatch(
+                ("restoreblks", nblk), self._restore_blocks, self.cache,
+                jnp.asarray(st["k"]), jnp.asarray(st["v"]), idx)
+        if self._scales is not None:
+            for phys in blocks:
                 self._scales.note_write(phys)
         self._tables[req.slot] = blocks
         self._set_table_row(req.slot)
@@ -2467,6 +2630,16 @@ class DecodeServer:
                   if self._pending else 0.0)
         return {
             "engine": type(self).__name__,
+            "role": self.role,
+            # prefill/decode disaggregation surface (None when
+            # colocated — no dead sections): parked payloads waiting
+            # for the loop's push, cumulative handoffs and bytes
+            "handoff": ({
+                "ready": len(self._handoffs),
+                "total": self.handoffs,
+                "payload_bytes": self.handoff_payload_bytes,
+                "capture_s": round(self.handoff_capture_s, 6),
+            } if self.role == "prefill" else None),
             "max_batch": self.max_batch,
             "max_len": self.max_len,
             "slots": slots,

@@ -110,6 +110,23 @@ class SpeculativeDecodeServer(DecodeServer):
                  max_len: Optional[int] = None, **kw):
         if draft_cfg.vocab != cfg.vocab:
             raise ValueError("draft and target must share a vocabulary")
+        if kw.get("kv_blocks") and kw.get("mesh") is not None:
+            # the base engine's paged arena is mesh-aware now, but this
+            # engine is not: its draft arena, verify-window trimming and
+            # lockstep block growth have no sharded-arena coverage yet.
+            # Documented single-host clamp (ROADMAP follow-up) — reject
+            # the combination cleanly at startup rather than build an
+            # engine whose draft cache silently stays unsharded.
+            raise ValueError(
+                "speculative decoding over a paged arena is single-host "
+                "only: run mesh=None with kv_blocks, or tp with "
+                "kv_blocks=0 (sharding the draft+target arenas in "
+                "lockstep is the documented follow-up)")
+        if kw.get("role", "colocated") != "colocated":
+            raise ValueError(
+                "speculative decoding does not support prefill/decode "
+                "disaggregation roles: the draft cache has no handoff "
+                "payload format; run role=colocated")
         super().__init__(params, cfg, max_batch=max_batch,
                          max_len=max_len, **kw)
         self.draft_params = draft_params

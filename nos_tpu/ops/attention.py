@@ -458,7 +458,15 @@ def paged_decode_attention(
     copy) to ONE arena read of the live blocks.
 
     ``interpret`` defaults to True off-TPU so the kernel runs (slowly,
-    exactly) under tier-1's JAX_PLATFORMS=cpu."""
+    exactly) under tier-1's JAX_PLATFORMS=cpu.
+
+    Single-device entry point: Pallas cannot be auto-partitioned by
+    GSPMD, so a mesh-sharded arena dispatches this kernel per head
+    shard via ``generate._paged_kernel_sharded`` (shard_map over the
+    ``tp`` axis — the grid is head-parallel, so each chip runs this
+    exact kernel on its Hkv/tp slice with no collective); the XLA
+    gather formulation stays the mesh escape hatch GSPMD partitions
+    itself."""
     b, h, s, d = q.shape
     nb_phys, h_kv, bs, _ = k_arena.shape
     nb = table.shape[1]

@@ -160,6 +160,60 @@ def test_bench_serve_smoke_writes_pipeline_artifact(tmp_path):
     assert sum(mt["elastic"]["horizon_tokens"].values()) \
         > sum(mt["hard_partition"]["horizon_tokens"].values())
 
+    # disaggregation section (ISSUE 15): colocated vs prefill/decode
+    # role split at equal chips under the mixed trace
+    dg = artifact["disagg"]
+    assert dg["chips_per_arm"] == 2
+    assert dg["colocated"]["completed"] == dg["disagg"]["completed"] \
+        == dg["trace"]["residents"] + dg["trace"]["arrivals"]
+    # token conservation across the role split, in the TIMED arms too
+    assert dg["timed_conserved"]
+    # the acceptance gates: dedicated prefill beats colocated on
+    # arrival TTFT p99, and the decode plane's TPOT stays flat (median
+    # AND tail) while prefills stream in
+    assert dg["ttft_wins"] and dg["ttft_p99_speedup"] > 1.0, (
+        f"disagg TTFT p99 {dg['disagg']['arrival_ttft_ms']} did not "
+        f"beat colocated {dg['colocated']['arrival_ttft_ms']}")
+    assert dg["tpot_flat"], (
+        f"disagg decode TPOT {dg['disagg']['resident_tpot_ms']} not "
+        f"flat vs colocated {dg['colocated']['resident_tpot_ms']}")
+    # handoff accounting: every request shipped exactly once, with a
+    # positive payload
+    ho = dg["disagg"]["handoff"]
+    assert ho["requests"] == dg["disagg"]["completed"]
+    assert ho["payload_bytes"] > 0
+    assert ho["bytes_per_request"] * ho["requests"] == pytest.approx(
+        ho["payload_bytes"], rel=0.01)
+    # structural half: conservation through the WIRE encoding per
+    # kv_dtype, the ~0.5x int8 byte model, byte-identical rerun
+    st = dg["structural"]
+    assert st["bf16"]["conserved"] and st["int8"]["conserved"]
+    assert st["int8"]["handoffs"] == st["bf16"]["handoffs"] > 0
+    assert st["int8_vs_bf16_bytes"] < 0.6, (
+        f"int8 handoff bytes {st['int8_vs_bf16_bytes']}x bf16 — the "
+        f"structural ~0.5x claim does not hold")
+    assert dg["rerun_identical"]
+
+
+@pytest.mark.slow
+def test_disagg_structural_reruns_byte_identical():
+    """The disagg section's structural half (wire-format conservation
+    + the byte model) has no clocks in it — two fresh runs must
+    serialize byte-identically."""
+    import jax
+
+    sys.path.insert(0, REPO)
+    os.environ.setdefault("NOS_TPU_BENCH_SMOKE", "1")
+    import bench_serve
+    from nos_tpu.models import transformer as tr
+
+    cfg = tr.TransformerConfig(**bench_serve.MODEL)
+    params = tr.init_params(jax.random.PRNGKey(0), cfg)
+    a = bench_serve._dg_structural(params, cfg)
+    b = bench_serve._dg_structural(params, cfg)
+    assert json.dumps(a, sort_keys=True) == json.dumps(b, sort_keys=True)
+    assert a["bf16"]["conserved"] and a["int8"]["conserved"]
+
 
 @pytest.mark.slow
 def test_multi_tenant_section_reruns_byte_identical():

@@ -49,31 +49,24 @@ def test_generate_greedy_invariant_to_tp(params, sharded_params):
     np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
 
 
-def test_generate_sampled_invariant_to_tp(params, sharded_params):
-    # KNOWN FAILING SINCE SEED (triaged in the ISSUE 11 PR, owned by
-    # the multi-host serving item): tp sharding changes the matmul
-    # reduction order, so the sharded logits differ from the
-    # single-device logits by float-accumulation ULPs (measured
-    # ~1.1e-6 max-abs on this config; greedy argmax is robust to it —
-    # the greedy twins above pass). The SAMPLED path is not: top-k/
-    # top-p truncation thresholds and the categorical draw's CDF
-    # boundaries sit on exact float values, so an ULP-level logit
-    # perturbation flips which token a given uniform draw selects,
-    # and the sequences diverge from the first flipped draw onward.
-    # This is a numerics-under-sharding property of the sampling
-    # kernel, NOT a routing/serving-surface assumption — nothing the
-    # gateway/front-door work touches. The fix belongs to the
-    # multi-host sharded-serving item (ROADMAP): either make sampling
-    # decisions on a reduction-order-invariant surface (e.g. argmax
-    # over gumbel-perturbed logits computed in f32 with a fixed
-    # reduction, as the exact-match bar demands) or pin the test to a
-    # tolerance-aware contract (same distribution, not same stream).
+def test_generate_sampled_invariant_to_tp(params, sharded_params, mesh):
+    # Fixed after two seed-old failing rounds: the sharded logits
+    # differ from single-device only by tp reduction-order ULPs
+    # (greedy argmax absorbs those — the greedy twins above always
+    # passed), but the categorical draw itself diverged because GSPMD
+    # propagates the vocab sharding backward into the threefry
+    # program, whose partitioned lowering draws DIFFERENT gumbel bits.
+    # generate(mesh=...) now canonicalizes every sampling decision
+    # onto a replicated f32 logit row (generate.replicated_logits), so
+    # the sharded engine runs the exact single-device sampling program
+    # — same bits, same stream.
     prompt = jnp.asarray([[5, 9, 2]], jnp.int32)
     kw = dict(temperature=0.8, top_k=16, top_p=0.9,
               rng=jax.random.PRNGKey(7))
     want = generate(params, CFG, prompt, 10, **kw)
     got = jax.jit(
-        lambda p: generate(p, CFG, prompt, 10, **kw))(sharded_params)
+        lambda p: generate(p, CFG, prompt, 10, mesh=mesh,
+                           **kw))(sharded_params)
     np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
 
 
@@ -91,15 +84,10 @@ def test_cache_shardings_shape_and_validation(mesh):
 def test_server_tokens_invariant_to_mesh(params, sharded_params, mesh):
     """The full engine — bucketed prefill, install, continuous decode,
     slot recycling — over the mesh, token-identical to the unsharded
-    engine, greedy and sampled slots mixed in one batch.
-
-    KNOWN FAILING SINCE SEED — same root cause as
-    test_generate_sampled_invariant_to_tp above: the greedy slot
-    matches, the two SAMPLED slots diverge once an ULP-level logit
-    difference (tp reduction order) crosses a top-k/top-p/CDF
-    boundary. Triaged under ISSUE 11; the multi-host sharded-serving
-    ROADMAP item owns the fix (see the comment above for the two
-    candidate shapes)."""
+    engine, greedy and sampled slots mixed in one batch. The sampled
+    slots are the seed-old regression: fixed by the engine
+    canonicalizing every sampling decision onto a replicated f32 row
+    (see test_generate_sampled_invariant_to_tp)."""
     reqs = [
         ([3, 1, 4, 1, 5], 8, dict()),
         ([2, 7], 10, dict(temperature=0.7, top_k=8, seed=3)),

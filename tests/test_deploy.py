@@ -690,3 +690,29 @@ def test_tenant_quota_args_plumbed_on_both_binaries():
     for row in ("serving.tenants.config", "gateway.tenants.config",
                 "gateway.tenants.quotaAttempts"):
         assert row in readme, f"helm README missing {row} row"
+
+
+def test_serving_deployment_passes_role_and_decode_pool_args():
+    """The serving Deployment must plumb serving.role / serving.decodePool
+    to --role/--decode-pool (ISSUE 15 satellite: prefill/decode
+    disaggregation), chart defaults must match the binary's
+    ServerConfig defaults, and the knobs must be README-discoverable."""
+    path = os.path.join(CHART, "templates", "serving",
+                        "deployment_server.yaml")
+    with open(path) as f:
+        text = f.read()
+    assert "--role={{ .Values.serving.role }}" in text
+    assert "--decode-pool={{ .Values.serving.decodePool }}" in text
+    # decode-pool only renders when set: an empty --decode-pool flag
+    # would be a dead arg on every colocated fleet
+    assert "if .Values.serving.decodePool" in text
+    with open(os.path.join(CHART, "values.yaml")) as f:
+        values = yaml.safe_load(f)
+    from nos_tpu.cmd.server import ServerConfig
+    assert values["serving"]["role"] == ServerConfig().role == "colocated"
+    assert values["serving"]["decodePool"] == ServerConfig().decode_pool \
+        == ""
+    with open(os.path.join(CHART, "README.md")) as f:
+        readme = f.read()
+    for row in ("serving.role", "serving.decodePool"):
+        assert row in readme, f"helm README missing {row}"

@@ -1116,3 +1116,117 @@ def test_tenant_rides_streams_and_admission():
     out = list(router.stream([1], 4, tenant="gold"))
     assert out == [[1, 2], [3]]
     assert seen["tenant"] == "gold"
+
+
+# ---------------------------------------------------------------------------
+# prefill/decode disaggregation (ISSUE 15): role-aware routing + the
+# two-phase handoff follow. Real-engine conservation is pinned in
+# tests/test_serving_sharded.py and test_server_cmd.py; here the
+# routing state machine itself, jax-free.
+# ---------------------------------------------------------------------------
+def _disagg_router(adopted, fail_resume=0):
+    from nos_tpu.gateway.router import HandoffResumeError  # noqa: F401
+
+    calls = {"prefill": 0, "resume": 0}
+
+    def transport(rep, req):
+        assert rep.role != "decode", \
+            "a decode replica must never receive a NEW request"
+        calls["prefill"] += 1
+        if rep.role == "prefill":
+            rid = len(adopted)
+            adopted[rid] = list(req["prompt"]) + [900 + i for i in
+                                                  range(req["max_new_tokens"])]
+            return {"handoff": {"target": "decode-0", "rid": rid}}
+        return list(req["prompt"]) + [7]
+
+    def resume(rep, desc, rem):
+        assert rep.name == "decode-0"
+        calls["resume"] += 1
+        if calls["resume"] <= fail_resume:
+            raise ReplicaUnreachable("decode hiccup")
+        return adopted[desc["rid"]]
+
+    def resume_stream(rep, desc, rem):
+        full = adopted[desc["rid"]]
+        yield full[-2:-1]
+        yield full[-1:]
+
+    router = GatewayRouter(
+        RouterConfig(max_attempts=4, backoff_s=0.0, block_size=2),
+        transport=transport, resume_transport=resume,
+        resume_stream_transport=resume_stream, sleep=lambda s: None)
+    router.update([
+        Replica(name="prefill-0", role="prefill"),
+        Replica(name="decode-0", role="decode"),
+    ])
+    return router, calls
+
+
+def test_gateway_routes_to_prefill_and_resumes_at_decode():
+    adopted = {}
+    router, calls = _disagg_router(adopted)
+    # the decode replica is known but NOT in the new-request ring
+    snap = router.stats()
+    assert snap["ready_replicas"] == 1
+    assert snap["ring"]["replicas"] == ["prefill-0"]
+    assert snap["replicas"]["decode-0"]["role"] == "decode"
+
+    toks, name, attempts = router.dispatch([1, 2, 3, 4], 3)
+    assert name == "prefill-0" and attempts == 1
+    assert toks == [1, 2, 3, 4, 900, 901, 902]
+
+    # streaming: phase 1 unary to the prefill replica, deltas from the
+    # decode replica
+    out = []
+    for delta in router.stream([5, 6, 7, 8], 2):
+        out.extend(delta)
+    assert out == [900, 901]
+    assert router.stats()["handoffs"] == 2
+
+
+def test_gateway_handoff_resume_retries_then_fails_terminally():
+    from nos_tpu.gateway.router import HandoffResumeError
+
+    # one transient decode hiccup: resumed on the retry, ONE prefill
+    adopted = {}
+    router, calls = _disagg_router(adopted, fail_resume=1)
+    toks, _, _ = router.dispatch([1, 2], 2)
+    assert toks == [1, 2, 900, 901]
+    assert calls["prefill"] == 1 and calls["resume"] == 2
+
+    # permanent decode failure: phase 2 exhausts its attempts and the
+    # request fails TERMINALLY — the prefill replica is never asked to
+    # re-prefill (the KV already moved)
+    adopted = {}
+    router, calls = _disagg_router(adopted, fail_resume=99)
+    with pytest.raises(HandoffResumeError):
+        router.dispatch([1, 2], 2)
+    assert calls["prefill"] == 1
+    assert router.stats()["requests"]["failed"] == 1
+
+
+def test_discovery_parses_role_from_config_echo():
+    server = ApiServer()
+    client = Client(server)
+    for name, role in (("pre-0", "prefill"), ("dec-0", "decode"),
+                       ("co-0", None)):
+        client.create(Pod(
+            metadata=ObjectMeta(
+                name=name, namespace="serving",
+                labels={constants.LABEL_FLEET: "f"}),
+            spec=PodSpec(containers=[Container()]),
+            status=PodStatus(phase="Running")))
+
+    def stats_source(pod):
+        role = {"pre-0": "prefill", "dec-0": "decode"}.get(
+            pod.metadata.name)
+        snap = {"healthy": True}
+        if role:
+            snap["config"] = {"role": role}
+        return snap
+
+    disc = PodDiscovery(client, "f", "serving", stats_source)
+    got = {r.name: r.role for r in disc.poll()}
+    assert got == {"pre-0": "prefill", "dec-0": "decode",
+                   "co-0": "colocated"}

@@ -1074,11 +1074,41 @@ def test_build_engine_paged_flags_and_validation():
     with pytest.raises(ValueError, match="multiple of"):
         build_engine(ServerConfig(**MODEL, kv_block_size=128,
                                   kv_blocks=16))
-    with pytest.raises(ValueError, match="mesh-aware"):
-        build_engine(ServerConfig(**MODEL, kv_block_size=8, kv_blocks=16,
-                                  tp=2))
     with pytest.raises(ValueError, match="kv_blocks"):
         build_engine(ServerConfig(**MODEL, kv_block_size=8, kv_blocks=1))
+
+
+def test_build_engine_paged_mesh_and_role_validation():
+    """The old paged+tp rejection is GONE — the arena is mesh-aware
+    (tests/test_serving_sharded.py pins bit-exactness) — replaced by
+    real config validation: divisibility for the sharded head axis,
+    the speculative single-host clamp, and the disaggregation-role
+    requirements, all failing BEFORE any checkpoint load."""
+    from nos_tpu.cmd.server import build_engine
+
+    # paged + tp now builds a mesh engine (head axis divides evenly)
+    eng = build_engine(ServerConfig(**MODEL, bf16=False, max_batch=2,
+                                    kv_block_size=8, kv_blocks=16, tp=2))
+    assert eng.paged and eng.mesh is not None
+    assert eng.cache["k"].sharding.spec[2] == "tp"
+
+    # spec engine keeps its documented single-host clamp, refused
+    # before the (multi-GB in production) checkpoint load
+    with pytest.raises(ValueError, match="single-host"):
+        build_engine(ServerConfig(**MODEL, kv_block_size=8, kv_blocks=16,
+                                  tp=2, draft_checkpoint_dir="/nope"))
+    # roles: validated values, paged-only, prefill needs a pool
+    with pytest.raises(ValueError, match="role must be"):
+        build_engine(ServerConfig(**MODEL, role="proxy"))
+    with pytest.raises(ValueError, match="paged KV"):
+        build_engine(ServerConfig(**MODEL, role="decode"))
+    with pytest.raises(ValueError, match="decode-pool"):
+        build_engine(ServerConfig(**MODEL, role="prefill",
+                                  kv_block_size=8, kv_blocks=16))
+    with pytest.raises(ValueError, match="speculative"):
+        build_engine(ServerConfig(**MODEL, role="decode",
+                                  kv_block_size=8, kv_blocks=16,
+                                  draft_checkpoint_dir="/nope"))
 
 
 def test_kv_flags_override_config():
@@ -1328,3 +1358,285 @@ def test_tenant_config_flag_overrides_and_validates_early():
         server_mod.build_engine = real
     assert ServerConfig().tenant_config == ""
     assert TenantQuotaConfig.load("") is None
+
+
+# ---------------------------------------------------------------------------
+# prefill/decode disaggregation (ISSUE 15): flags, config echo, and the
+# two-server HTTP handoff path end to end
+# ---------------------------------------------------------------------------
+
+def test_role_flags_override_config_and_defaults_match_code():
+    """--role/--decode-pool reach the ServerConfig (the helm values'
+    landing pads), and the dataclass defaults match what the chart
+    defaults render — no dead knobs, no silent drift."""
+    from nos_tpu.cmd import server as server_mod
+
+    seen = {}
+
+    def fake_build(cfg):
+        seen["cfg"] = cfg
+        raise SystemExit(0)
+
+    real = server_mod.build_engine
+    server_mod.build_engine = fake_build
+    try:
+        with pytest.raises(SystemExit):
+            server_mod.main(["--role", "prefill", "--decode-pool",
+                             "http://d0:8000,http://d1:8000"])
+    finally:
+        server_mod.build_engine = real
+    cfg = seen["cfg"]
+    assert cfg.role == "prefill"
+    assert cfg.decode_pool == "http://d0:8000,http://d1:8000"
+    assert ServerConfig().role == "colocated"
+    assert ServerConfig().decode_pool == ""
+
+
+def test_config_echo_grows_role_and_mesh():
+    """/stats config echo carries role + mesh shape — what the fleet
+    drift detector compares across replicas, and what the gateway's
+    role-aware routing reads."""
+    mcfg = tfm.TransformerConfig(**MODEL, dtype=jnp.float32)
+    params = tfm.init_params(jax.random.PRNGKey(0), mcfg)
+    loop = ServingLoop(DecodeServer(params, mcfg, max_batch=1),
+                       config_echo={"role": "colocated",
+                                    "mesh": {"tp": 0}})
+    try:
+        echo = loop.stats()["config"]
+        assert echo["role"] == "colocated"
+        assert echo["mesh"] == {"tp": 0}
+    finally:
+        loop.shutdown()
+
+
+def test_http_prefill_decode_handoff_end_to_end():
+    """Two REAL servers over HTTP: a decode-role pod and a prefill-role
+    pod whose decode pool points at it. POST /v1/generate at the
+    prefill pod returns a handoff descriptor; following it to the
+    decode pod's /v1/result yields token-for-token the colocated
+    engine's answer (greedy and sampled), /v1/stream serves the same
+    tokens as SSE, and both pods' /stats surface the handoff."""
+    import urllib.request
+
+    mcfg = tfm.TransformerConfig(**MODEL, dtype=jnp.float32)
+    params = tfm.init_params(jax.random.PRNGKey(0), mcfg)
+    kv = dict(max_batch=2, kv_block_size=8, kv_blocks=24)
+
+    # the undisturbed colocated reference
+    co = DecodeServer(params, mcfg, **kv)
+    reqs = [([1, 2, 3], 6, {}),
+            ([4, 4, 2, 7], 8, {"temperature": 0.7, "top_k": 8,
+                               "seed": 11})]
+    rids = [co.submit(p, n, **s) for p, n, s in reqs]
+    ref = co.drain()
+    want = [ref[r] for r in rids]
+
+    def _http_send(target, data):
+        req = urllib.request.Request(
+            target.rstrip("/") + "/v1/handoff", data=data,
+            headers={"Content-Type": "application/octet-stream"},
+            method="POST")
+        with urllib.request.urlopen(req, timeout=60) as resp:
+            return int(json.loads(resp.read())["rid"])
+
+    dec_loop = ServingLoop(
+        DecodeServer(params, mcfg, role="decode", **kv), role="decode",
+        config_echo={"role": "decode"})
+    dec_httpd = make_http_server(
+        ServerConfig(**MODEL, bf16=False, port=0, role="decode",
+                     kv_block_size=8, kv_blocks=24), dec_loop)
+    threading.Thread(target=dec_httpd.serve_forever, daemon=True).start()
+    dec_url = f"http://127.0.0.1:{dec_httpd.server_address[1]}"
+
+    pre_loop = ServingLoop(
+        DecodeServer(params, mcfg, role="prefill", **kv), role="prefill",
+        handoff_targets=[dec_url], handoff_send=_http_send,
+        config_echo={"role": "prefill"})
+    pre_httpd = make_http_server(
+        ServerConfig(**MODEL, bf16=False, port=0, role="prefill",
+                     decode_pool=dec_url, kv_block_size=8, kv_blocks=24),
+        pre_loop)
+    threading.Thread(target=pre_httpd.serve_forever, daemon=True).start()
+    pre_url = f"http://127.0.0.1:{pre_httpd.server_address[1]}"
+
+    try:
+        got = []
+        for (p, n, s), expect in zip(reqs, want):
+            body = dict({"prompt": p, "max_new_tokens": n}, **s)
+            res = post(pre_url, body)
+            assert "handoff" in res, res
+            assert res["handoff"]["target"] == dec_url
+            with urllib.request.urlopen(
+                    f"{dec_url}/v1/result/{res['handoff']['rid']}",
+                    timeout=120) as r:
+                got.append(json.loads(r.read())["tokens"])
+        assert got == want
+
+        # streaming attach: SSE from the decode pod conserves tokens
+        res = post(pre_url, {"prompt": [1, 2, 3], "max_new_tokens": 6})
+        rid = res["handoff"]["rid"]
+        toks = []
+        with urllib.request.urlopen(
+                f"{dec_url}/v1/stream/{rid}", timeout=120) as r:
+            for line in r:
+                line = line.decode().strip()
+                if not line.startswith("data: "):
+                    continue
+                payload = line[len("data: "):]
+                if payload == "[DONE]":
+                    break
+                frame = json.loads(payload)
+                assert "error" not in frame, frame
+                toks.extend(frame["tokens"])
+        assert [1, 2, 3] + toks == want[0]
+
+        # a request completed by its first token never hands off
+        res = post(pre_url, {"prompt": [1, 2, 3], "max_new_tokens": 1})
+        assert res["tokens"] == want[0][:4]
+
+        # both /stats surfaces tell the disagg story
+        with urllib.request.urlopen(pre_url + "/stats", timeout=30) as r:
+            psnap = json.loads(r.read())
+        assert psnap["role"] == "prefill"
+        assert psnap["handoff"]["total"] == 3
+        assert psnap["handoff"]["payload_bytes"] > 0
+        with urllib.request.urlopen(dec_url + "/stats", timeout=30) as r:
+            dsnap = json.loads(r.read())
+        assert dsnap["role"] == "decode"
+
+        # prefill-side metrics: handoff counter/bytes/seconds series
+        from nos_tpu.utils.metrics import default_registry
+        text = default_registry().expose()
+        assert 'nos_tpu_serve_handoff_total{outcome="sent"}' in text
+        assert "nos_tpu_serve_handoff_bytes" in text
+        assert "nos_tpu_serve_handoff_seconds" in text
+
+        # unknown rid on the decode surface: clean 404, not a hang
+        try:
+            urllib.request.urlopen(dec_url + "/v1/result/9999",
+                                   timeout=30)
+            assert False, "expected 404"
+        except urllib.error.HTTPError as e:
+            assert e.code == 404
+    finally:
+        pre_httpd.shutdown()
+        pre_loop.shutdown()
+        pre_httpd.server_close()
+        dec_httpd.shutdown()
+        dec_loop.shutdown()
+        dec_httpd.server_close()
+
+
+class _ParkingEngine(_FakeEngine):
+    """Prefill-role stub: a submitted request leaves progress() at once
+    (parked as a handoff inside the engine) and only surfaces in
+    ``_handoffs`` when the test releases it — models the window between
+    first token and the pusher's pop."""
+
+    def __init__(self):
+        super().__init__()
+        self._handoffs, self.parked = [], {}
+
+    def submit(self, prompt, n, **kw):
+        rid = super().submit(prompt, n, **kw)
+        del self.pending[rid]
+        self.parked[rid] = {"rid": rid, "prompt": list(prompt)}
+        return rid
+
+    def release(self, rid):
+        self._handoffs.append(self.parked.pop(rid))
+
+    def pop_handoffs(self):
+        out, self._handoffs = self._handoffs, []
+        return out
+
+
+def test_prefill_handoff_cancelled_when_client_departs_pre_push():
+    """A prefill client that times out while its payload is parked must
+    resolve as exactly one `cancelled` WITHOUT shipping KV nobody will
+    read, and must not park an unclaimed descriptor in _handoff_done."""
+    shipped = []
+    eng = _ParkingEngine()
+    loop = ServingLoop(eng, role="prefill",
+                       handoff_targets=["http://dec"],
+                       handoff_send=lambda t, d: shipped.append(t) or 1)
+    try:
+        before = _outcomes()
+        with pytest.raises(TimeoutError):
+            loop.prefill([1, 2, 3], 6, timeout=0.05)
+        assert loop._handoff_gone          # departed-client tombstone
+        eng.release(0)                     # handoff surfaces post-departure
+        with loop._work:
+            loop._work.notify_all()
+        assert _wait_until(lambda: not loop._handoff_gone
+                           and not eng._handoffs)
+        assert shipped == []
+        assert loop._handoff_done == {}
+        assert _outcome_delta(before) == {"cancelled": 1}
+        assert not loop._live and not loop._adopted
+    finally:
+        loop.shutdown()
+
+
+def test_adopted_prompt_released_on_watch_path():
+    """The streaming attach path (watch/SSE) never calls result(), so
+    _account must be the hook that releases an adopted request's prompt
+    — otherwise every streamed disagg request leaks it forever."""
+    from nos_tpu.models.handoff import encode_handoff
+
+    class Adopting(_FakeEngine):
+        def restore(self, state):
+            rid = self._rid
+            self._rid += 1
+            self.pending[rid] = 3
+            return rid
+
+    loop = ServingLoop(Adopting(), role="decode")
+    try:
+        rid = loop.adopt(encode_handoff({"rid": 0, "prompt": [1, 2]}))
+        assert loop._adopted == {rid: [1, 2]}
+        toks = []
+        for delta in loop.watch(rid):
+            toks.extend(delta)
+        assert toks == [0, 1, 2]
+        assert loop._adopted == {}, "watch path leaked the prompt"
+    finally:
+        loop.shutdown()
+
+
+def test_adopted_orphan_reaped_and_result_refetchable():
+    """(a) An adopted handoff nobody ever fetches — the gateway died
+    mid-resume, or phase 2 exhausted its retries — is cancelled out of
+    the engine after ``adopt_ttl_s`` instead of parking its result and
+    rid maps forever; (b) within the grace window a finished result()
+    is idempotent, so a gateway retrying /v1/result after a socket
+    timeout gets the tokens its abandoned first attempt drained rather
+    than 'request N vanished'; (c) the re-fetch cache itself is reaped
+    when the window closes."""
+    from nos_tpu.models.handoff import encode_handoff
+
+    class Adopting(_FakeEngine):
+        def restore(self, state):
+            rid = self._rid
+            self._rid += 1
+            self.pending[rid] = 3
+            return rid
+
+    loop = ServingLoop(Adopting(), role="decode", adopt_ttl_s=0.3)
+    try:
+        before = _outcomes()
+        rid = loop.adopt(encode_handoff({"rid": 0, "prompt": [1, 2]}))
+        assert _wait_until(lambda: not loop._adopted
+                           and rid not in loop._rid_map)
+        assert _outcome_delta(before) == {"cancelled": 1}
+        assert loop._handoff_deadline == {}
+
+        rid2 = loop.adopt(encode_handoff({"rid": 1, "prompt": [1, 2]}))
+        want = loop.result(rid2, timeout=5)
+        assert want == [1, 2, 0, 1, 2]
+        assert loop.result(rid2, timeout=5) == want     # idempotent
+        assert _wait_until(lambda: rid2 not in loop._adopted_final)
+        with pytest.raises(ValueError):                 # window closed
+            loop.result(rid2, timeout=5)
+    finally:
+        loop.shutdown()

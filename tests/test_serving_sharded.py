@@ -1,0 +1,284 @@
+"""Multi-host sharded paged serving + prefill/decode disaggregation
+(ISSUE 15 tentpole): the paged KV subsystem over a device mesh, and the
+KV-handoff role split.
+
+Contract 1 — the sharded paged arena: a paged engine on a CPU mesh
+(arena head axis over ``tp`` via ``paged_cache_shardings``, block
+tables/allocator host-replicated control rows) produces token-for-token
+identical output to the single-host paged engine — bf16 and int8
+arenas, greedy and sampled slots, across a COW fork and a
+preempt-and-resume in both modes. Sharding splits the matmuls and the
+arena reads, never the math; sampling decisions run on a replicated
+f32 logit row (``generate.replicated_logits``) so the mesh cannot
+perturb the stream either.
+
+Contract 2 — disaggregation: a prefill-role engine ships every request
+after its first token as a KV handoff (the swap-payload format —
+quantized blocks + scales under int8) which a decode-role engine
+adopts via ``restore``, and the combined pipeline conserves every
+token vs an undisturbed colocated run — including through the wire
+encoding and a mid-handoff supervised engine restart on either side.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh, PartitionSpec as P
+
+from nos_tpu.models import transformer as tfm
+from nos_tpu.models.generate import paged_cache_shardings
+from nos_tpu.models.handoff import (
+    decode_handoff, encode_handoff, handoff_nbytes,
+)
+from nos_tpu.models.serving import DecodeServer
+
+CFG = tfm.TransformerConfig(
+    vocab=64, d_model=32, n_layers=2, n_heads=4, n_kv_heads=2,
+    d_ff=64, max_seq=64, dtype=jnp.float32)
+
+# greedy + sampled mixed, prompts crossing block boundaries
+REQS = [
+    ([3, 1, 4, 1, 5], 8, dict()),
+    ([2, 7], 10, dict(temperature=0.7, top_k=8, seed=3)),
+    ([9, 9, 1, 2, 6, 6, 1, 8, 3], 6, dict(temperature=0.5, top_p=0.8,
+                                          seed=11)),
+]
+
+PAGED = dict(max_batch=2, max_len=64, kv_block_size=8, kv_blocks=24)
+
+
+@pytest.fixture(scope="module")
+def params():
+    return tfm.init_params(jax.random.PRNGKey(0), CFG)
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    devs = np.asarray(jax.devices()[:8]).reshape(4, 2)
+    return Mesh(devs, ("dp", "tp"))
+
+
+@pytest.fixture(scope="module")
+def sharded_params(params, mesh):
+    return jax.device_put(params, tfm.param_shardings(mesh, CFG))
+
+
+def run_trace(srv, reqs=REQS):
+    rids = [srv.submit(p, n, **kw) for p, n, kw in reqs]
+    out = srv.drain()
+    return [out[r] for r in rids]
+
+
+# ---------------------------------------------------------------------------
+# contract 1: the sharded paged arena
+# ---------------------------------------------------------------------------
+@pytest.mark.parametrize("kv_dtype", ["bf16", "int8"])
+def test_paged_engine_tokens_invariant_to_mesh(params, sharded_params,
+                                               mesh, kv_dtype):
+    """Paged engine on the mesh == single-host paged engine,
+    token-for-token, greedy and sampled slots mixed — and the arena
+    actually lives sharded (head axis over tp, scale planes too)."""
+    kw = dict(PAGED, kv_dtype=kv_dtype)
+    want = run_trace(DecodeServer(params, CFG, **kw))
+    srv = DecodeServer(sharded_params, CFG, mesh=mesh, **kw)
+    assert run_trace(srv) == want
+    # trailing Nones normalize away after the donated decode program,
+    # so pin the head axis positionally
+    assert tuple(srv.cache["k"].sharding.spec)[:3] == (None, None, "tp")
+    if kv_dtype == "int8":
+        assert tuple(srv.cache["k_scale"].sharding.spec)[:3] == \
+            (None, None, "tp")
+
+
+def test_paged_cache_shardings_validation(mesh):
+    shd = paged_cache_shardings(mesh, CFG, kv_dtype="int8")
+    assert shd["k"].spec == P(None, None, "tp", None, None)
+    assert shd["k_scale"].spec == P(None, None, "tp", None)
+    bad = tfm.TransformerConfig(
+        vocab=64, d_model=48, n_layers=2, n_heads=3, n_kv_heads=3,
+        d_ff=64, max_seq=64, dtype=jnp.float32)
+    with pytest.raises(ValueError, match="not divisible by tp"):
+        paged_cache_shardings(mesh, bad)
+    # the engine rejects the same combination with its own clear error
+    with pytest.raises(ValueError, match="head axis"):
+        DecodeServer(tfm.init_params(jax.random.PRNGKey(1), bad), bad,
+                     mesh=mesh, max_batch=2, max_len=64,
+                     kv_block_size=8, kv_blocks=16)
+
+
+def test_paged_cow_fork_invariant_to_mesh(params, sharded_params, mesh):
+    """COW fork mid-decode: source and fork both continue bit-equal to
+    the single-host engine's fork — the shared-block refcounts and the
+    copy-on-write device copies compose with the sharded arena."""
+    def run(srv):
+        r0 = srv.submit([3, 1, 4, 1, 5], 10)
+        for _ in range(3):
+            srv.step()
+        r1 = srv.fork(r0, seed=5)
+        out = srv.drain()
+        return out[r0], out[r1]
+
+    kw = dict(PAGED, max_batch=3, kv_blocks=30, kv_dtype="int8")
+    assert run(DecodeServer(sharded_params, CFG, mesh=mesh, **kw)) \
+        == run(DecodeServer(params, CFG, **kw))
+
+
+@pytest.mark.parametrize("mode", ["swap", "recompute"])
+def test_paged_preempt_resume_invariant_to_mesh(params, sharded_params,
+                                                mesh, mode):
+    """Preempt-and-resume (swap = byte restore through the sharded
+    arena; recompute = re-prefill) stays bit-exact on the mesh."""
+    def run(srv):
+        r0 = srv.submit([3, 1, 4, 1, 5], 10)
+        for _ in range(3):
+            srv.step()
+        assert srv.preempt(r0, mode)
+        return srv.drain()[r0]
+
+    assert run(DecodeServer(sharded_params, CFG, mesh=mesh, **PAGED)) \
+        == run(DecodeServer(params, CFG, **PAGED))
+
+
+def test_spec_engine_keeps_single_host_clamp(params, sharded_params,
+                                             mesh):
+    """The speculative engine documents its paged single-host clamp as
+    a clean startup error (its draft arena is not mesh-aware)."""
+    from nos_tpu.models.spec_serving import SpeculativeDecodeServer
+
+    dcfg = tfm.TransformerConfig(
+        vocab=64, d_model=16, n_layers=1, n_heads=2, n_kv_heads=2,
+        d_ff=32, max_seq=64, dtype=jnp.float32)
+    dparams = tfm.init_params(jax.random.PRNGKey(9), dcfg)
+    with pytest.raises(ValueError, match="single-host"):
+        SpeculativeDecodeServer(
+            sharded_params, CFG, dparams, dcfg, mesh=mesh,
+            max_batch=2, max_len=64, kv_block_size=8, kv_blocks=24)
+
+
+# ---------------------------------------------------------------------------
+# contract 2: prefill/decode disaggregation over the KV handoff
+# ---------------------------------------------------------------------------
+@pytest.mark.parametrize("kv_dtype,chunk", [("bf16", 0), ("int8", 0),
+                                            ("int8", 8)])
+def test_handoff_conserves_every_token(params, kv_dtype, chunk):
+    """2-engine prefill->decode pipeline (through the WIRE encoding)
+    == undisturbed colocated run, token-for-token — one-shot and
+    chunked prefill, bf16 and int8 payloads."""
+    kw = dict(PAGED, kv_dtype=kv_dtype)
+    co = DecodeServer(params, CFG, **kw)
+    want = run_trace(co)
+
+    pre = DecodeServer(params, CFG, role="prefill",
+                       prefill_chunk=chunk, **kw)
+    dec = DecodeServer(params, CFG, role="decode", **kw)
+    for p, n, s in REQS:
+        pre.submit(p, n, **s)
+    while pre.has_work():
+        pre.step()
+    states = pre.pop_handoffs()
+    assert len(states) == len(REQS)
+    assert pre.handoffs == len(REQS)
+    assert pre.handoff_payload_bytes == \
+        sum(handoff_nbytes(st) for st in states)
+    drids = [dec.restore(decode_handoff(encode_handoff(st)))
+             for st in states]
+    out = dec.drain()
+    assert [out[r] for r in drids] == want
+
+
+def test_int8_halves_handoff_bytes(params):
+    """The structural byte model: an int8 arena's payload carries
+    int8 KV + f32 per-token scales vs 4-byte (f32-config) KV — the
+    per-request ratio is pinned by dtype arithmetic alone, and on a
+    bf16 fleet works out to ~0.5x (the headline). Same block count,
+    same request, strictly fewer bytes."""
+    sizes = {}
+    for kv_dtype in ("bf16", "int8"):
+        pre = DecodeServer(params, CFG, role="prefill",
+                           **dict(PAGED, kv_dtype=kv_dtype))
+        pre.submit([1] * 16, 4)
+        while pre.has_work():
+            pre.step()
+        sizes[kv_dtype] = handoff_nbytes(pre.pop_handoffs()[0])
+    # f32 config: KV bytes drop 4x, scales add back 4B/token-head-layer
+    d = CFG.head_dim
+    itemsize = jnp.zeros((), CFG.dtype).dtype.itemsize
+    expect = (d + 4) / (itemsize * d)
+    assert sizes["int8"] / sizes["bf16"] == pytest.approx(expect)
+    assert sizes["int8"] < sizes["bf16"]
+
+
+def test_mid_handoff_supervised_restart_conserves_tokens(params):
+    """An engine death mid-handoff loses nothing: (a) a PREFILL engine
+    dying with parked payloads captures them (capture_resumable) and a
+    rebuilt prefill engine re-parks them; (b) a DECODE engine dying
+    mid-decode of adopted requests restores them bit-exactly — the
+    end-to-end outputs stay equal to the undisturbed colocated run."""
+    kw = dict(PAGED, kv_dtype="int8")
+    want = run_trace(DecodeServer(params, CFG, **kw))
+
+    # (a) prefill side: die between prefill and push
+    pre = DecodeServer(params, CFG, role="prefill", **kw)
+    for p, n, s in REQS:
+        pre.submit(p, n, **s)
+    while pre.has_work():
+        pre.step()
+    assert len(pre._handoffs) == len(REQS)
+    captured = pre.capture_resumable()
+    pre2 = DecodeServer(params, CFG, role="prefill", **kw)
+    for st in captured:
+        pre2.restore(st)
+    states = pre2.pop_handoffs()
+    assert len(states) == len(REQS)
+
+    # (b) decode side: adopt, decode a few ticks, die, rebuild, resume
+    dec = DecodeServer(params, CFG, role="decode", **kw)
+    drids = [dec.restore(decode_handoff(encode_handoff(st)))
+             for st in states]
+    for _ in range(2):
+        dec.step()
+    snap = dec.capture_resumable()
+    dec2 = DecodeServer(params, CFG, role="decode", **kw)
+    rid_map = {}
+    for st in snap:
+        rid_map[st["rid"]] = dec2.restore(st)
+    out = dec2.drain()
+    got = [out[rid_map[r]] for r in drids]
+    assert got == want
+
+
+def test_handoff_geometry_mismatch_rejected(params):
+    """A decode engine with a different block size cannot adopt the
+    payload byte-exactly — clean permanent refusal, not corruption."""
+    from nos_tpu.models.errors import Infeasible
+
+    pre = DecodeServer(params, CFG, role="prefill", **PAGED)
+    pre.submit([1] * 12, 4)
+    while pre.has_work():
+        pre.step()
+    st = pre.pop_handoffs()[0]
+    wrong = DecodeServer(params, CFG, role="decode",
+                         **dict(PAGED, kv_block_size=16, kv_blocks=12))
+    with pytest.raises(Infeasible, match="geometry"):
+        wrong.restore(decode_handoff(encode_handoff(st)))
+
+
+def test_sharded_decode_adopts_handoff(params, sharded_params, mesh):
+    """The scenario the multislice examples gang-schedule but could
+    not serve: prefill on one (single-host) engine, decode on a
+    MESH-sharded paged engine — handoff adopts across the topology
+    change and the tokens still match the colocated single-host run
+    (the payload is host bytes; the restore scatters them into the
+    sharded arena)."""
+    want = run_trace(DecodeServer(params, CFG, **PAGED))
+    pre = DecodeServer(params, CFG, role="prefill", **PAGED)
+    for p, n, s in REQS:
+        pre.submit(p, n, **s)
+    while pre.has_work():
+        pre.step()
+    dec = DecodeServer(sharded_params, CFG, mesh=mesh, role="decode",
+                       **PAGED)
+    drids = [dec.restore(decode_handoff(encode_handoff(st)))
+             for st in pre.pop_handoffs()]
+    out = dec.drain()
+    assert [out[r] for r in drids] == want
