@@ -37,7 +37,7 @@ trace-chaos:  ## Run the chaos bench and report its Perfetto trace + /debug/trac
 	@echo "/debug/traces:  bench_logs/bench_chaos_debug_traces.json"
 
 .PHONY: bench-attn
-bench-attn:  ## Compare attention kernels (splash/flash/xla) at the flagship shape.
+bench-attn:  ## Attention kernels (splash/flash/xla) + paged decode/window points + kernel-vs-gather spec report (artifact in bench_logs/bench_attn.json).
 	$(PYTHON) bench_attn.py
 
 .PHONY: bench-decode

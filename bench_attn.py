@@ -14,10 +14,27 @@ Sections (--sections, default both):
   kv dtypes bf16/int8. The XLA point materializes the gathered
   timeline (plus a dequantized copy for int8) exactly like
   forward_paged's escape hatch; the kernel point streams arena blocks
-  in-kernel with dequant fused into the inner loop. Off-TPU the kernel
-  only runs in interpret mode, which measures nothing — those points
-  print as skipped unless --paged-interpret forces them (parity
-  checks, not perf).
+  in-kernel with dequant fused into the inner loop. S>1 window points
+  (--paged-windows, default 4,5,8 — fused decode_steps, a draft_n=4
+  verify burst, a prefix-hit suffix bucket) time the same comparison
+  at the query widths spec decoding and fused decode actually
+  dispatch; slot_static has no windowed serving path, so windows
+  compare kernel vs gather only. Off-TPU the kernel only runs in
+  interpret mode, which measures nothing — those points print as
+  skipped unless --paged-interpret forces them (parity checks, not
+  perf).
+- ``spec_window_report``: one summary line per (window, kv_dtype) —
+  max |kernel - gather| over a ragged-pos batch (every row at a
+  different causal depth, the shape a spec verify burst actually has)
+  plus the structural HBM byte model for both formulations. This is
+  the kernel-vs-gather parity/bytes evidence behind the fleet
+  --paged-kernel=on default; the smoke test pins that kernel bytes
+  are strictly below gather bytes at every point.
+
+Every emitted point is also collected into
+``bench_logs/bench_attn.json`` (the artifact of record — the driver's
+tail buffer has truncated stdout before), written before the final
+summary line prints.
 
 Timing fence is the host transfer (block_until_ready lies on 'axon' —
 see bench_mfu.py).
@@ -44,21 +61,37 @@ from bench import BATCH, MODEL, SEQ, phase_marker  # noqa: E402
 from bench_mfu import host_fence  # noqa: E402
 
 PAGED_IMPLS = ("xla", "kernel", "slot_static")
+OUT_PATH = os.path.join("bench_logs", "bench_attn.json")
+
+# every emitted point lands here too; main() writes the artifact after
+# the sections run so a truncated stdout never loses the record
+RESULTS = []
+
+
+def emit(point):
+    RESULTS.append(point)
+    print(json.dumps(point), flush=True)
 
 
 def parse_args(argv=None):
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("reps", nargs="?", type=int, default=10,
                     help="timed repetitions per point (default 10)")
-    ap.add_argument("--sections", default="attn,paged_decode",
+    ap.add_argument("--sections", default="attn,paged_decode,"
+                                          "spec_window_report",
                     help="comma list of sections to run: "
-                         "attn,paged_decode")
+                         "attn,paged_decode,spec_window_report")
     ap.add_argument("--paged-ctx", default="1024,4096,16384",
                     help="paged_decode context lengths, comma list")
     ap.add_argument("--paged-batch", type=int, default=8,
                     help="paged_decode decode batch (rows)")
     ap.add_argument("--paged-block", type=int, default=128,
                     help="paged-KV block size in tokens")
+    ap.add_argument("--paged-windows", default="4,5,8",
+                    help="S>1 query-window widths for the windowed "
+                         "paged points and the spec report (default "
+                         "4 = fused decode_steps, 5 = draft_n=4 "
+                         "verify burst, 8 = suffix-prefill bucket)")
     ap.add_argument("--paged-interpret", action="store_true",
                     help="run the Pallas kernel points in interpret "
                          "mode off-TPU (exactness probing; the timings "
@@ -86,7 +119,7 @@ def attn_section(reps):
         os.environ["NOS_TPU_ATTN_IMPL"] = impl
         eff = at.effective_impl(q.shape, k.shape)
         if eff != impl:
-            print(json.dumps({"impl": impl, "skipped": f"dispatches {eff}"}))
+            emit({"impl": impl, "skipped": f"dispatches {eff}"})
             continue
 
         fwd = jax.jit(lambda q, k, v: at.attention(q, k, v, causal=True))
@@ -128,25 +161,27 @@ def attn_section(reps):
             t_bwd = (time.perf_counter() - t0) / reps
             phase("done")
         except Exception as e:
-            print(json.dumps({"impl": impl,
-                              "error": f"{type(e).__name__}: {e}"[:200]}))
+            emit({"impl": impl,
+                  "error": f"{type(e).__name__}: {e}"[:200]})
             continue
 
-        print(json.dumps({
+        emit({
             "impl": impl,
             "shape": f"b{b} h{h} kv{kv} s{s} d{d} causal bf16",
             "fwd_ms": round(t_fwd * 1e3, 2),
             "fwd_bwd_ms": round(t_bwd * 1e3, 2),
             "compile_fwd_s": round(compile_fwd, 1),
             "compile_bwd_s": round(compile_bwd, 1),
-        }), flush=True)
+        })
 
 
 def paged_decode_section(args):
     """Decode-step attention over a paged arena, one JSON line per
-    (ctx, kv_dtype, impl) point. Shapes ride the flagship MODEL dims;
-    every row decodes at pos = ctx - 1 (the worst-case full-context
-    step the TPOT tail is made of)."""
+    (ctx, kv_dtype, impl[, s]) point. Shapes ride the flagship MODEL
+    dims; every row's window ends at pos ctx - 1 (the worst-case
+    full-context step the TPOT tail is made of). S == 1 points compare
+    all three impls; the --paged-windows S > 1 points compare kernel
+    vs gather only (slot_static has no windowed serving path)."""
     import jax
     import jax.numpy as jnp
 
@@ -169,13 +204,15 @@ def paged_decode_section(args):
     impls = [only] if only else list(PAGED_IMPLS)
     rng = jax.random.PRNGKey(0)
 
-    def point(ctx, kv_dtype, impl):
+    def point(ctx, kv_dtype, impl, s=1):
         base = {"section": "paged_decode", "ctx": ctx,
-                "kv_dtype": kv_dtype, "impl": impl,
-                "shape": f"b{b} h{h} kv{hkv} d{d} bs{bs}"}
+                "kv_dtype": kv_dtype, "impl": impl, "s": s,
+                "shape": f"b{b} h{h} kv{hkv} s{s} d{d} bs{bs}"}
         if impl == "slot_static" and kv_dtype == "int8":
             return dict(base, skipped="int8 requires the paged arena "
                                       "(no slot-static scale storage)")
+        if s >= ctx:
+            return dict(base, skipped=f"window {s} needs ctx > {s}")
         os.environ["NOS_TPU_PAGED_KERNEL"] = \
             "1" if impl == "kernel" else "0"
         if impl == "kernel":
@@ -187,13 +224,20 @@ def paged_decode_section(args):
                                           "(--paged-interpret forces)")
         nb = ctx // bs
         ks = jax.random.split(rng, 4)
-        q = jax.random.normal(ks[0], (b, h, 1, d), jnp.bfloat16)
-        pos = jnp.full((b,), ctx - 1, jnp.int32)
+        q = jax.random.normal(ks[0], (b, h, s, d), jnp.bfloat16)
+        # window base: query rows sit at pos..pos+s-1, the last at the
+        # full-context frontier ctx - 1 (same tail step as s == 1)
+        pos = jnp.full((b,), ctx - s, jnp.int32)
+
+        def rows(pos):
+            # per-row absolute query positions for the gather oracle
+            return pos[:, None] + jnp.arange(s)[None, :]
+
         if impl == "slot_static":
             ck = jax.random.normal(ks[1], (b, hkv, ctx, d), jnp.bfloat16)
             cv = jax.random.normal(ks[2], (b, hkv, ctx, d), jnp.bfloat16)
             step = jax.jit(lambda q, ck, cv, pos: _cached_attention(
-                q, ck, cv, pos[:, None], d ** -0.5))
+                q, ck, cv, rows(pos), d ** -0.5))
             operands = (q, ck, cv, pos)
         else:
             nb_phys = b * nb + 1
@@ -223,7 +267,7 @@ def paged_decode_section(args):
                             at.paged_gather_scale(vsc, table),
                             jnp.bfloat16)
                         return _cached_attention(
-                            q, gk, gv, pos[:, None], d ** -0.5)
+                            q, gk, gv, rows(pos), d ** -0.5)
                 operands = (q, ka, va, kscale, vscale, table, pos)
             else:
                 if impl == "kernel":
@@ -235,16 +279,17 @@ def paged_decode_section(args):
                         return _cached_attention(
                             q, at.paged_gather_kv(ka, table),
                             at.paged_gather_kv(va, table),
-                            pos[:, None], d ** -0.5)
+                            rows(pos), d ** -0.5)
                 operands = (q, ka, va, table, pos)
             step = jax.jit(step_fn)
+        tag = f"ctx{ctx}_{kv_dtype}" + (f"_s{s}" if s > 1 else "")
         try:
-            phase_marker(f"paged_{impl}", f"ctx{ctx}_{kv_dtype}_compile")
+            phase_marker(f"paged_{impl}", f"{tag}_compile")
             t0 = time.perf_counter()
             out = step(*operands)
             host_fence(out)
             compile_s = time.perf_counter() - t0
-            phase_marker(f"paged_{impl}", f"ctx{ctx}_{kv_dtype}_timing")
+            phase_marker(f"paged_{impl}", f"{tag}_timing")
             t0 = time.perf_counter()
             for _ in range(reps):
                 out = step(*operands)
@@ -255,7 +300,10 @@ def paged_decode_section(args):
         # bytes the formulation moves per step (the model the doc
         # carries): every impl reads the live KV once; the XLA paged
         # point ALSO writes + re-reads the gathered bf16 view (and for
-        # int8, the materialized dequantized copy is that view)
+        # int8, the materialized dequantized copy is that view). The
+        # view traffic is independent of s — a wider query window
+        # amortizes it over s tokens, but the kernel pays none of it
+        # at any width
         kv_bytes = 2 * b * hkv * ctx * d * (1 if kv_dtype == "int8"
                                             else 2)
         scale_bytes = 2 * b * hkv * ctx * 4 if kv_dtype == "int8" else 0
@@ -273,6 +321,7 @@ def paged_decode_section(args):
             model_bytes_per_step=traffic,
         )
 
+    windows = [int(w) for w in args.paged_windows.split(",") if w]
     for ctx in [int(c) for c in args.paged_ctx.split(",") if c]:
         if ctx % bs:
             # a truncated paged arena vs a full-ctx slot-static cache
@@ -283,16 +332,124 @@ def paged_decode_section(args):
                 f"--paged-block {bs}")
         for kv_dtype in ("bf16", "int8"):
             for impl in impls:
-                print(json.dumps(point(ctx, kv_dtype, impl)), flush=True)
+                emit(point(ctx, kv_dtype, impl))
+        # S>1 windows: the verify-burst / fused-decode / suffix shapes
+        # — kernel vs the gather oracle only
+        for s in windows:
+            for kv_dtype in ("bf16", "int8"):
+                for impl in impls:
+                    if impl == "slot_static":
+                        continue
+                    emit(point(ctx, kv_dtype, impl, s))
+
+
+def spec_window_report_section(args):
+    """Kernel-vs-gather spec-grid report: for each (window, kv_dtype)
+    the max |kernel - gather| over a RAGGED-pos batch (every row's
+    window ends at a different causal depth — the shape a speculative
+    verify burst over mixed-age slots actually has) plus the
+    structural HBM byte model of both formulations. One JSON line per
+    point; the smoke test pins kernel bytes strictly below gather
+    bytes and parity within the fuzz tolerance."""
+    import jax
+    import jax.numpy as jnp
+
+    from nos_tpu.models.generate import _cached_attention
+    from nos_tpu.ops import attention as at
+
+    b = args.paged_batch
+    bs = args.paged_block
+    h, hkv = MODEL["n_heads"], MODEL["n_kv_heads"]
+    d = MODEL["d_model"] // h
+    on_tpu = jax.default_backend() == "tpu"
+    eff = at.effective_paged_impl(d)
+    if eff != "kernel":
+        emit({"section": "spec_window_report",
+              "skipped": f"dispatches {eff}"})
+        return
+    if not on_tpu and not args.paged_interpret:
+        emit({"section": "spec_window_report",
+              "skipped": "interpret-only off TPU "
+                         "(--paged-interpret forces)"})
+        return
+    # smallest requested ctx: parity is shape-generic and interpret
+    # mode is O(slow), so the report probes the cheapest arena
+    ctx = min(int(c) for c in args.paged_ctx.split(",") if c)
+    nb = ctx // bs
+    nb_phys = b * nb + 1
+    for s in [int(w) for w in args.paged_windows.split(",") if w]:
+        if s >= ctx:
+            emit({"section": "spec_window_report", "s": s,
+                  "skipped": f"window {s} needs ctx > {s}"})
+            continue
+        for kv_dtype in ("bf16", "int8"):
+            ks = jax.random.split(
+                jax.random.fold_in(jax.random.PRNGKey(1), s), 3)
+            q = jax.random.normal(ks[0], (b, h, s, d), jnp.bfloat16)
+            ka = jax.random.normal(
+                ks[1], (nb_phys, hkv, bs, d), jnp.bfloat16)
+            va = jax.random.normal(
+                ks[2], (nb_phys, hkv, bs, d), jnp.bfloat16)
+            table = (1 + jnp.arange(b * nb, dtype=jnp.int32)
+                     ).reshape(b, nb)
+            # ragged window bases: a linear ramp from 0 to the deepest
+            # legal base, so dead-tail elision and per-row masking are
+            # both on the hook
+            pos = jnp.asarray(
+                [(ctx - s) * i // max(1, b - 1) for i in range(b)],
+                jnp.int32)
+            rows = pos[:, None] + jnp.arange(s)[None, :]
+            if kv_dtype == "int8":
+                ka_q, ksc = at.quantize_kv(ka)
+                va_q, vsc = at.quantize_kv(va)
+                got = at.paged_decode_attention(
+                    q, ka_q, va_q, table, pos, k_scale=ksc, v_scale=vsc)
+                gk = at.dequantize_kv(
+                    at.paged_gather_kv(ka_q, table),
+                    at.paged_gather_scale(ksc, table), jnp.bfloat16)
+                gv = at.dequantize_kv(
+                    at.paged_gather_kv(va_q, table),
+                    at.paged_gather_scale(vsc, table), jnp.bfloat16)
+            else:
+                got = at.paged_decode_attention(q, ka, va, table, pos)
+                gk = at.paged_gather_kv(ka, table)
+                gv = at.paged_gather_kv(va, table)
+            want = _cached_attention(q, gk, gv, rows, d ** -0.5)
+            diff = float(jnp.max(jnp.abs(
+                got.astype(jnp.float32) - want.astype(jnp.float32))))
+            kv_bytes = 2 * b * hkv * ctx * d * (1 if kv_dtype == "int8"
+                                                else 2)
+            scale_bytes = (2 * b * hkv * ctx * 4
+                           if kv_dtype == "int8" else 0)
+            view_bytes = 2 * b * hkv * ctx * d * 2
+            kernel_bytes = kv_bytes + scale_bytes
+            gather_bytes = kernel_bytes + 2 * view_bytes
+            emit({
+                "section": "spec_window_report", "s": s, "ctx": ctx,
+                "kv_dtype": kv_dtype,
+                "shape": f"b{b} h{h} kv{hkv} s{s} d{d} bs{bs}",
+                "max_abs_diff": diff,
+                "kernel_bytes": kernel_bytes,
+                "gather_bytes": gather_bytes,
+                "bytes_ratio": round(gather_bytes / kernel_bytes, 2),
+            })
 
 
 def main(argv=None):
     args = parse_args(argv)
+    del RESULTS[:]            # repeated main() calls (tests) start clean
     sections = [s.strip() for s in args.sections.split(",") if s.strip()]
     if "attn" in sections:
         attn_section(args.reps)
     if "paged_decode" in sections:
         paged_decode_section(args)
+    if "spec_window_report" in sections:
+        spec_window_report_section(args)
+    os.makedirs(os.path.dirname(OUT_PATH), exist_ok=True)
+    with open(OUT_PATH, "w") as f:
+        json.dump({"sections": sections, "points": RESULTS}, f, indent=2)
+    print(json.dumps({"artifact": OUT_PATH, "points": len(RESULTS)}),
+          flush=True)
 
 
 if __name__ == "__main__":

@@ -134,6 +134,14 @@ class ServerConfig:
     # "http://decode-0:8000,http://decode-1:8000"); required (non-empty)
     # when role=prefill, ignored otherwise
     decode_pool: str = ""
+    # pusher health memory: after a failed handoff push the target
+    # decode replica is skipped for this many seconds before the
+    # round-robin retries it (0 disables — every push re-probes dead
+    # replicas and eats the connect timeout on the serving thread).
+    # Skips are counted in nos_tpu_serve_handoff_skipped_total; if the
+    # whole pool is cooling down the pusher ignores the cooldown
+    # rather than dropping the handoff
+    handoff_cooldown_s: float = 5.0
     # prefix cache (0 = off). Slot-static KV: ENTRIES — each holds one
     # prompt's KV on device (flagship: ~64 MB per 1k tokens). Paged KV
     # (kv_blocks > 0): BLOCKS — the budget for block-granular prefix
@@ -184,16 +192,21 @@ class ServerConfig:
     # slot-static engine has no per-block scale storage and the server
     # rejects the combination with a clear error.
     kv_dtype: str = "bf16"
-    # paged decode-attention formulation: "on" = the fused Pallas
-    # kernel (paged_decode_attention walks the block table in-kernel,
-    # streams KV blocks HBM->VMEM and fuses the int8 dequant into the
-    # attention inner loop — no materialized gather), "off" = the XLA
-    # gather formulation, which stays the escape hatch and the parity
-    # oracle. Plumbed as NOS_TPU_PAGED_KERNEL for the engine (the flag
-    # is authoritative on a server: a restart must trace the same
-    # formulation). Default off: flip on per fleet after burn-in; the
-    # config echo surfaces drift. Requires kv_blocks > 0.
-    paged_kernel: str = "off"
+    # paged attention formulation: "on" = the fused Pallas kernel
+    # (paged_decode_attention walks the block table in-kernel for
+    # EVERY query shape — decode steps, speculative verify bursts,
+    # prefix-hit suffix prefill — streams KV blocks HBM->VMEM and
+    # fuses the int8 dequant into the attention inner loop: no
+    # materialized gather), "off" = the XLA gather formulation, which
+    # stays the escape hatch and the parity oracle. Plumbed as
+    # NOS_TPU_PAGED_KERNEL for the engine (the flag is authoritative
+    # on a server: a restart must trace the same formulation).
+    # Default ON since the parity burn-in the config echo was built
+    # for: every serving configuration (speculative, tp-sharded,
+    # disaggregated) runs the kernel; --paged-kernel=off is the
+    # documented escape hatch. Inert without kv_blocks (the kernel
+    # walks per-slot block tables; slot-static engines have none).
+    paged_kernel: str = "on"
     # HBM backstop on admission (0 = off): defer admitting while
     # device bytes_in_use / bytes_limit exceeds this fraction, per the
     # same memory_stats() the HBM gauges sample (backends without
@@ -341,6 +354,7 @@ class ServingLoop:
                  role: str = "colocated",
                  handoff_targets: Optional[list] = None,
                  handoff_send=None,
+                 handoff_cooldown_s: float = 5.0,
                  adopt_ttl_s: float = 600.0):
         reg = default_registry()
         # register() is idempotent per (name, type, labels) and raises on
@@ -523,6 +537,22 @@ class ServingLoop:
         self._handoff_rr = 0
         self._handoff_done: dict = {}       # loop rid -> descriptor
         self._handoff_gone: set = set()     # client departed pre-push
+        # pusher health memory: a decode replica that refused/failed a
+        # push is skipped for this cooldown window before being
+        # retried (blind round-robin would keep burning an attempt on
+        # a dead replica every lap). target url -> abs monotonic the
+        # cooldown ends; cleared on the next successful push. When the
+        # WHOLE pool is cooling the pusher falls back to probing every
+        # target — a cooldown degrades to blind round-robin, never to
+        # dropping the handoff.
+        self._handoff_cooldown_s = handoff_cooldown_s or 0.0
+        self._handoff_unhealthy: dict = {}  # target -> abs monotonic
+        # prefill-side deadline carry: the prefill server doesn't
+        # ENFORCE deadlines (phase 1 is short; the decode side owns
+        # expiry) but must not DROP them — the pusher attaches the
+        # remaining seconds at ship time so the adopting decode
+        # replica can shed expired phase-2 work early.
+        self._prefill_deadlines: dict = {}  # loop rid -> abs monotonic
         # adopted-request TTL (decode role): an adopted handoff whose
         # consumer never shows up — the gateway crashed mid-resume, or
         # phase 2 exhausted its attempts — must not decode-and-park
@@ -562,8 +592,16 @@ class ServingLoop:
                 "nos_tpu_serve_handoff_seconds",
                 "Wall time per handoff: KV swap-out capture plus the "
                 "ship to the decode replica")
+            self.m_handoff_skipped = reg.counter(
+                "nos_tpu_serve_handoff_skipped_total",
+                "Decode-pool targets skipped by the pusher while "
+                "cooling down after a failed push (health memory: a "
+                "replica that refused a handoff is not retried for "
+                "--handoff-cooldown-s); a sustained rate means part "
+                "of the decode pool is down")
             for outcome in ("sent", "failed"):
                 self.m_handoff.labels(outcome).inc(0)
+            self.m_handoff_skipped.inc(0)
         self.m_compiles = reg.counter(
             "nos_tpu_serve_compiles_total",
             "XLA compiles observed by the engine (first dispatch per "
@@ -797,6 +835,7 @@ class ServingLoop:
         self.m_requests.labels(outcome).inc()
         self._live.discard(rid)
         self._deadlines.pop(rid, None)
+        self._prefill_deadlines.pop(rid, None)
         self._rid_map.pop(rid, None)
         # an adopted (decode-role) request's prompt leaves with its
         # terminal outcome: the streaming attach path never calls
@@ -1527,19 +1566,43 @@ class ServingLoop:
                                       self._pop_ledger(st["rid"]))
                         self._work.notify_all()
                         continue
+                    dl = (self._prefill_deadlines.get(lrid0)
+                          if lrid0 is not None else None)
+                if dl is not None:
+                    # carry the REMAINING seconds, computed at ship
+                    # time: wall budgets survive the hop without any
+                    # cross-host clock sync. An already-negative carry
+                    # still ships — adopt() arms it in the past and
+                    # the decode side's next sweep sheds the expired
+                    # work instead of decoding an answer nobody waits
+                    # for. The descriptor key rides the handoff's JSON
+                    # meta plane (models/handoff.py round-trips
+                    # non-array keys verbatim).
+                    st["deadline_s"] = dl - time.monotonic()
                 t0 = time.monotonic()
                 data = encode_handoff(st)
                 sent, last_err = None, None
                 targets = self._handoff_targets
-                for _ in range(max(1, 2 * len(targets))):
-                    target = targets[self._handoff_rr % len(targets)]
+                now = time.monotonic()
+                pool = [t for t in targets
+                        if self._handoff_unhealthy.get(t, 0.0) <= now]
+                if len(pool) < len(targets):
+                    self.m_handoff_skipped.inc(len(targets) - len(pool))
+                if not pool:
+                    pool = targets      # whole pool cooling: probe all
+                for _ in range(max(1, 2 * len(pool))):
+                    target = pool[self._handoff_rr % len(pool)]
                     self._handoff_rr += 1
                     try:
                         remote_rid = self._handoff_send(target, data)
                         sent = {"target": target, "rid": int(remote_rid)}
+                        self._handoff_unhealthy.pop(target, None)
                         break
                     except Exception as e:  # noqa: BLE001 — next target
                         last_err = e
+                        if self._handoff_cooldown_s > 0:
+                            self._handoff_unhealthy[target] = \
+                                time.monotonic() + self._handoff_cooldown_s
                 with self._work:
                     lrid = rev.get(st["rid"])
                     ledger = self._pop_ledger(st["rid"])
@@ -1578,8 +1641,26 @@ class ServingLoop:
         then streams/fetches from the decode replica. A request whose
         first token already completes it (max_new_tokens == 1) never
         hands off: its tokens come back directly, same wire shape as
-        a colocated answer."""
-        del deadline_s      # enforced at the gateway/decode side
+        a colocated answer.
+
+        ``deadline_s`` is not ENFORCED here (phase 1 is short; expiry
+        is the decode side's job) but it is no longer dropped: the
+        pusher ships the remaining budget inside the handoff
+        descriptor and the adopting replica arms it, so expired
+        phase-2 work is shed early instead of decoding unread
+        tokens."""
+        dl_s = deadline_s if deadline_s is not None \
+            else (self._default_deadline_s or None)
+        if dl_s is not None:
+            dl_s = float(dl_s)
+            # same finite-only discipline as stream(): NaN passes every
+            # comparison as a never-expiring ghost deadline
+            if not math.isfinite(dl_s) or dl_s < 0:
+                raise ValueError(
+                    f"deadline_s must be a finite number >= 0, "
+                    f"got {dl_s}")
+            if dl_s == 0:       # an EXPLICIT 0 opts out of the default
+                dl_s = None
         with self._work:
             if self._failed is not None:
                 raise RuntimeError(f"serving loop failed: {self._failed}")
@@ -1600,6 +1681,8 @@ class ServingLoop:
             self._next_rid += 1
             self._rid_map[rid] = erid
             self._live.add(rid)
+            if dl_s is not None:
+                self._prefill_deadlines[rid] = time.monotonic() + dl_s
             self._mirror_engine_gauges()
             self._work.notify_all()
             deadline = time.monotonic() + timeout
@@ -1649,6 +1732,10 @@ class ServingLoop:
         model dims) raise Infeasible from the engine's restore."""
         from nos_tpu.models.handoff import decode_handoff
         state = decode_handoff(data)
+        # deadline carried through the handoff (remaining seconds at
+        # ship time): popped before restore — it is loop bookkeeping,
+        # not engine KV state
+        carried_dl = state.pop("deadline_s", None)
         with self._work:
             if self._failed is not None:
                 raise RuntimeError(f"serving loop failed: {self._failed}")
@@ -1667,6 +1754,16 @@ class ServingLoop:
             if self._adopt_ttl_s > 0:
                 self._handoff_deadline[rid] = \
                     time.monotonic() + self._adopt_ttl_s
+            if carried_dl is not None:
+                # arm the carried request deadline in the SAME ledger
+                # stream()'s deadlines live in: _deltas raises
+                # DeadlineExceeded and _sweep_deadlines sheds it
+                # mid-decode exactly like a locally-submitted request.
+                # A non-positive carry (expired in transit) arms in
+                # the past and the next sweep cancels it before it
+                # burns a decode tick quantum.
+                self._deadlines[rid] = \
+                    time.monotonic() + float(carried_dl)
             self._mirror_engine_gauges()
             self._work.notify_all()
         return rid
@@ -2215,26 +2312,14 @@ def build_engine(cfg: ServerConfig):
     if cfg.paged_kernel not in ("on", "off"):
         raise ValueError(
             f"paged_kernel must be on|off, got {cfg.paged_kernel!r}")
-    if cfg.paged_kernel == "on" and not cfg.kv_blocks:
-        raise ValueError(
-            "paged_kernel=on requires the paged KV cache: set "
-            "kv_blocks/kv_block_size (the kernel walks per-slot block "
-            "tables; the slot-static engine has none) — or run "
-            "paged_kernel=off")
-    if cfg.paged_kernel == "on" and cfg.draft_checkpoint_dir:
-        raise ValueError(
-            "paged_kernel=on is not supported with speculative "
-            "decoding yet: the spec engine's verify windows run the "
-            "S>1 gather formulation, and mixing it with kernel decode "
-            "would break greedy's bit-identity to plain decoding — "
-            "the engine would silently clamp the kernel off, so "
-            "reject the contradictory config instead (kernelized "
-            "verify windows are the ROADMAP follow-up)")
     # plumbed by env so every trace site (base + speculative engines,
     # and the supervisor's rebuild factory, which re-enters here) sees
-    # one authoritative answer; set BEFORE the engine compiles
+    # one authoritative answer; set BEFORE the engine compiles. The
+    # kernel walks per-slot block tables, so on a slot-static engine
+    # (kv_blocks=0) the fleet-default "on" is INERT rather than an
+    # error — the default flip must not break every non-paged config.
     os.environ["NOS_TPU_PAGED_KERNEL"] = \
-        "1" if cfg.paged_kernel == "on" else "0"
+        "1" if (cfg.paged_kernel == "on" and cfg.kv_blocks) else "0"
     if cfg.draft_checkpoint_dir and cfg.draft_n_tokens < 1:
         raise ValueError(
             f"draft_n_tokens must be >= 1, got {cfg.draft_n_tokens}")
@@ -2252,13 +2337,6 @@ def build_engine(cfg: ServerConfig):
             raise ValueError(
                 f"kv_blocks must be >= 2 (one reserved null block plus "
                 f"at least one usable), got {cfg.kv_blocks}")
-        if cfg.tp and cfg.tp > 1 and cfg.draft_checkpoint_dir:
-            raise ValueError(
-                "speculative decoding over a paged arena is single-host "
-                "only (the draft arena is not mesh-aware yet): run "
-                "tp with kv_blocks=0, or paged speculative with tp=0 "
-                "— the engine would reject the combination anyway, "
-                "refuse it before the checkpoint load")
     if cfg.role not in ("colocated", "prefill", "decode"):
         raise ValueError(
             f"role must be colocated|prefill|decode, got {cfg.role!r}")
@@ -2275,11 +2353,13 @@ def build_engine(cfg: ServerConfig):
             "decode-replica base URLs): a prefill server with nowhere "
             "to ship its handoffs would strand every request after "
             "its first token")
-    if cfg.role != "colocated" and cfg.draft_checkpoint_dir:
+    if cfg.role == "prefill" and cfg.draft_checkpoint_dir:
         raise ValueError(
-            f"role={cfg.role} is not supported with speculative "
-            f"decoding: the draft cache has no handoff payload format "
-            f"— run the speculative fleet colocated")
+            "role=prefill with speculative decoding is pointless: a "
+            "prefill replica never decodes, so the draft would only "
+            "burn HBM — run the draft on the decode side "
+            "(role=decode re-prefills it from each adopted handoff) "
+            "or colocated")
     mesh = None
     if cfg.tp and cfg.tp > 1:
         import jax
@@ -2349,7 +2429,8 @@ def build_engine(cfg: ServerConfig):
             decode_steps=cfg.decode_steps,
             kv_block_size=cfg.kv_block_size, kv_blocks=cfg.kv_blocks,
             kv_swap=cfg.kv_swap, hbm_admit_frac=cfg.kv_hbm_admit_frac,
-            kv_dtype=cfg.kv_dtype, tenant_quota=tenant_quota)
+            kv_dtype=cfg.kv_dtype, tenant_quota=tenant_quota,
+            role=cfg.role)
     return DecodeServer(params, model_cfg, max_batch=cfg.max_batch,
                         prefix_cache_size=cfg.prefix_cache_size, mesh=mesh,
                         prefill_chunk=cfg.prefill_chunk,
@@ -2737,14 +2818,14 @@ def main(argv: Optional[Sequence[str]] = None) -> None:
              "scale storage; rejected with a clear error)")
     parser.add_argument(
         "--paged-kernel", choices=("on", "off"), default=None,
-        help="paged decode-attention formulation (overrides config): "
-             "on = the fused Pallas kernel (in-kernel block-table "
-             "walk, int8 dequant fused into the attention inner loop "
-             "— no materialized gather; requires --kv-blocks), off = "
+        help="paged attention formulation (overrides config): on "
+             "[default] = the fused Pallas kernel for every query "
+             "shape — decode steps, speculative verify bursts, "
+             "prefix-hit suffix prefill (in-kernel block-table walk, "
+             "int8 dequant fused into the attention inner loop — no "
+             "materialized gather; inert without --kv-blocks), off = "
              "the XLA gather formulation (the escape hatch and the "
-             "parity oracle). Not yet supported with speculative "
-             "decoding (rejected at startup: verify windows pin the "
-             "gather formulation). Plumbed as NOS_TPU_PAGED_KERNEL; "
+             "parity oracle). Plumbed as NOS_TPU_PAGED_KERNEL; "
              "echoed in /stats config for fleet drift detection")
     parser.add_argument(
         "--role", choices=("colocated", "prefill", "decode"),
@@ -2763,6 +2844,12 @@ def main(argv: Optional[Sequence[str]] = None) -> None:
         help="comma-separated decode-replica base URLs a prefill-role "
              "server ships handoffs to (required with --role=prefill; "
              "overrides config)")
+    parser.add_argument(
+        "--handoff-cooldown-s", type=float, default=None,
+        help="seconds a decode replica is skipped by the handoff "
+             "pusher after a failed push before the round-robin "
+             "retries it (0 = re-probe every time; skips counted in "
+             "nos_tpu_serve_handoff_skipped_total; overrides config)")
     parser.add_argument(
         "--draft-checkpoint-dir", default=None,
         help="enable speculative decoding: checkpoint of the draft "
@@ -2844,6 +2931,8 @@ def main(argv: Optional[Sequence[str]] = None) -> None:
         cfg.role = args.role
     if args.decode_pool is not None:
         cfg.decode_pool = args.decode_pool
+    if args.handoff_cooldown_s is not None:
+        cfg.handoff_cooldown_s = args.handoff_cooldown_s
     if args.draft_checkpoint_dir is not None:
         cfg.draft_checkpoint_dir = args.draft_checkpoint_dir
     if args.draft_n_tokens is not None:
@@ -2903,6 +2992,7 @@ def main(argv: Optional[Sequence[str]] = None) -> None:
         role=cfg.role, handoff_targets=decode_pool,
         handoff_send=(_http_handoff_send if cfg.role == "prefill"
                       else None),
+        handoff_cooldown_s=cfg.handoff_cooldown_s,
         slo_tpot_ms=cfg.slo_tpot_ms,
         device_stats_interval_s=cfg.device_stats_interval_s,
         engine_factory=factory, restart_budget=cfg.restart_budget,

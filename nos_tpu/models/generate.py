@@ -247,26 +247,33 @@ def forward_paged(
     the identical int8 KV path) holds because writer and reader share
     these exact quantize/dequantize ops.
 
-    Decode steps (S == 1) dispatch the fused Pallas kernel when
+    Every query shape dispatches the fused Pallas kernel when
     ``NOS_TPU_PAGED_KERNEL=1`` (``ops.attention.effective_paged_impl``):
-    ``paged_decode_attention`` walks the block table in-kernel and
-    fuses the int8 dequant into the attention inner loop, so neither
-    the gathered timeline nor a dequantized bf16 copy is ever
-    materialized. Prefill and wider windows (S > 1) keep the XLA
-    gather — its view is BIT-identical to the slot-static timeline,
-    which is what keeps serving's slot-static prefill and this path
-    interchangeable; the kernel's online softmax is equivalent only
-    within reassociation tolerance, so it is confined to the decode
-    shape where serving and the ``generate_paged`` oracle run the
-    identical program either way.
+    ``paged_decode_attention`` walks the block table in-kernel for the
+    whole [B, S] query window — decode steps (S == 1), fused
+    multi-step decode, speculative verify bursts, and paged suffix
+    prefill alike — and fuses the int8 dequant into the attention
+    inner loop, so neither the gathered timeline nor a dequantized
+    bf16 copy is ever materialized. The kernel's per-row causal mask
+    (query position ``pos + s_idx`` vs block-local key positions) plus
+    dead-tail elision make a width-S window accumulate EXACTLY the
+    online-softmax state S sequential decode steps would: rows whose
+    causal frontier ends mid-window see only all-masked scores for
+    later blocks, which underflow to exact zeros in the f32
+    accumulator. That is what lets kernel decode and kernel verify
+    commit identical tokens (the speculative greedy-equals-plain
+    contract) — tested against the XLA gather oracle across the fuzz
+    grid in tests/test_paged_kernel.py.
 
     ``paged_impl`` ("kernel" | "xla") overrides the env lookup: the
     serving engine passes the formulation it captured at build time so
     a later env change (another engine built in the same process)
     cannot silently flip what a not-yet-traced shape compiles to while
-    /stats echoes the stale value; the speculative engine pins "xla"
-    (its verify windows are S > 1 gather — mixing would break its
-    greedy-equals-plain-decoding contract at near-tie logits).
+    /stats echoes the stale value. The two formulations agree
+    token-for-token on every serving contract, but only within
+    reassociation tolerance at the logit level (the kernel's online
+    softmax reassociates), so one engine must never mix them across
+    dispatches of the same stream.
 
     ``mesh`` (the serving engine's mesh, None single-host) only
     matters to the kernel formulation: Pallas cannot be auto-
@@ -286,7 +293,7 @@ def forward_paged(
     b, s = tokens.shape
     if paged_impl is None:
         paged_impl = effective_paged_impl(cfg.head_dim)
-    use_kernel = s == 1 and paged_impl == "kernel"
+    use_kernel = paged_impl == "kernel"
     pos0 = cache["pos"]                                     # [B]
     int8_kv = "k_scale" in cache
     freqs = rope_frequencies(cfg.head_dim, cfg.max_seq, cfg.rope_theta)
@@ -405,12 +412,12 @@ def generate_paged(
     the identical quantize-on-write / dequantize-on-read ops.
 
     Honors ``NOS_TPU_PAGED_KERNEL`` like every ``forward_paged``
-    caller: with the fused kernel enabled, decode steps here trace the
-    SAME kernel program serving traces, so serving == this reference
-    stays token-for-token — but the bf16 bit-identity to ``generate``
-    above is a property of the XLA formulation (the kernel's online
-    softmax is tolerance-equivalent, not bit-equal; see
-    tests/test_paged_kernel.py)."""
+    caller: with the fused kernel enabled, prefill AND decode steps
+    here trace the SAME kernel programs serving traces, so serving ==
+    this reference stays token-for-token — but the bf16 bit-identity
+    to ``generate`` above is a property of the XLA formulation (the
+    kernel's online softmax is tolerance-equivalent, not bit-equal;
+    see tests/test_paged_kernel.py)."""
     b, s = prompt.shape
     if max_new_tokens <= 0:
         return prompt

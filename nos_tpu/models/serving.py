@@ -288,9 +288,11 @@ class DecodeServer:
         # and passed explicitly into every forward_paged trace, so a
         # later env change (another engine built in this process) can
         # neither flip a not-yet-compiled shape's formulation nor make
-        # the /stats echo lie. The speculative subclass overrides this
-        # to "xla" (its verify windows must share one formulation with
-        # its decode — see spec_serving).
+        # the /stats echo lie. One formulation for every query shape:
+        # decode steps, speculative verify bursts and in-arena suffix
+        # prefill all trace the same choice (the kernel's S>1 causal
+        # window makes verify bit-consistent with sequential kernel
+        # decode — see forward_paged).
         self.paged_kernel = (effective_paged_impl(cfg.head_dim)
                              if self.paged else None)
         if self.paged:
@@ -624,10 +626,13 @@ class DecodeServer:
             self._decode = jax.jit(decode_paged, donate_argnums=(2,),
                                    static_argnums=(9,))
             # 1-row decode twin for kernel-formulation recompute
-            # resume (_replay_committed): same forward_paged, same
-            # formulation, no keep/sampling machinery — its outputs
-            # are only the KV writes. Undonated: the replay threads
-            # the live arena through without surrendering it.
+            # resume (_replay_committed) and in-arena suffix prefill
+            # (_paged_prefill_in_arena — jit re-specializes per window
+            # width, so one callable serves both the [1,1] replay and
+            # the bucketed S>1 windows): same forward_paged, same
+            # formulation, no keep/sampling machinery. Undonated: the
+            # replay threads the live arena through without
+            # surrendering it.
             self._replay_step = jax.jit(
                 lambda p, t, c, tab: forward_paged(
                     p, cfg, t, c, tab, paged_impl=self.paged_kernel,
@@ -1277,10 +1282,14 @@ class DecodeServer:
         return True
 
     def _finish_prefill(self, req: _Request, row: Cache,
-                        step: jax.Array) -> None:
+                        step: jax.Array, *,
+                        installed: bool = False) -> None:
         """Shared admission tail: publish the prefix, pick the first
         token from the final-position logits, set the slot's sampling
-        rows, and install the prefilled KV into the shared cache."""
+        rows, and install the prefilled KV into the shared cache
+        (``installed=True`` — the in-arena kernel prefill — means the
+        KV already lives in the arena; only the table/pos/feed state
+        and the publish remain)."""
         plen = len(req.prompt)
         if req.cache_prefix and not self.paged:
             # paged publish happens in _paged_install, where the slot's
@@ -1310,7 +1319,8 @@ class DecodeServer:
         # padding garbage past plen stays masked until overwritten: only
         # pos decides what exists
         if self.paged:
-            self._paged_install(req, row, plen, first)
+            self._paged_install(req, row, plen, first,
+                                installed=installed)
         else:
             self.cache, self._last = self._install(
                 self.cache, row["k"], row["v"], jnp.int32(req.slot),
@@ -1437,6 +1447,15 @@ class DecodeServer:
         shared = self._pindex.take(mkey, m) if m > 0 else []
         req.shared_blocks = shared
         self._sync_prefix_stats()
+        if m > 0 and self.paged_kernel == "kernel":
+            # with the fused kernel, a prefix-hit suffix prefills on
+            # the paged formulation IN the arena: the S>1 kernel window
+            # attends over the shared head through the block table, so
+            # the scratch row, its _seed_scratch block copies and the
+            # install pass all disappear. The dense scratch path
+            # remains for m == 0 (no shared head to read through a
+            # table) and for the gather formulation.
+            return self._paged_prefill_in_arena(req, m, sbucket)
         row = {"k": self._row_zeros(bucket), "v": self._row_zeros(bucket),
                "pos": jnp.int32(m)}
         if m > 0:
@@ -1452,6 +1471,46 @@ class DecodeServer:
             logits, row = self._run_prefill(toks, row)
             step = logits[0, plen - 1]
         self._finish_prefill(req, row, step)
+
+    def _paged_prefill_in_arena(self, req: _Request, m: int,
+                                sbucket: int) -> None:
+        """Prefix-hit admission through the fused kernel: allocate the
+        slot's full block table up front (shared prefix entries + fresh
+        suffix blocks — the chunked path's reservation discipline),
+        then run ONE bucketed S>1 window of the kernel program over a
+        1-row cache view at pos=m: the ``_replay_committed`` template,
+        one window wide. K/V scatter lands directly in the fresh blocks
+        (quantizing on write under int8, exactly like decode steps);
+        attention reads the shared head through the scalar-prefetched
+        in-kernel table walk instead of re-attending over a dense
+        scratch copy. Padding past the suffix routes to the null block
+        or to masked tail positions — the same only-``pos``-decides-
+        what-exists invariant the scratch row relies on."""
+        bs = self.kv_block_size
+        plen = len(req.prompt)
+        s = req.slot
+        shared = req.shared_blocks
+        n_total = blocks_for(plen, bs)
+        table = shared + self._alloc.alloc_many(n_total - len(shared))
+        self._tables[s] = table
+        self._set_table_row(s)
+        if self._scales is not None:
+            for blk in table[len(shared):]:
+                self._scales.note_write(blk)
+        suffix = req.prompt[m:]
+        toks = jnp.asarray(
+            [suffix + [0] * (sbucket - len(suffix))], jnp.int32)
+        cache = {k: v for k, v in self.cache.items() if k != "pos"}
+        cache["pos"] = jnp.asarray([m], jnp.int32)
+        logits, cache = self._timed_dispatch(
+            ("prefill_arena", sbucket), self._replay_step, self.params,
+            toks, cache, self._table[s:s + 1])
+        for key in self.cache:
+            if key != "pos":
+                self.cache[key] = cache[key]
+        step = logits[0, len(suffix) - 1]
+        req.reserved_blocks = table
+        self._finish_prefill(req, None, step, installed=True)
 
     def _paged_start_chunked(self, req: _Request, m: int, mkey) -> bool:
         """Chunk-at-a-time admission under paging. The slot's FULL
@@ -1507,12 +1566,14 @@ class DecodeServer:
         return row
 
     def _paged_install(self, req: _Request, row: Cache, plen: int,
-                       first: int) -> None:
+                       first: int, *, installed: bool = False) -> None:
         """Admission tail under paging: land the prefilled scratch row
         in the arena block-by-block (shared prefix blocks are table
         entries, not copies), set the device table row and the slot's
         pos/feed token, and publish a cache_prefix prompt's full blocks
-        for block-granular reuse."""
+        for block-granular reuse. ``installed=True`` skips the
+        block-install pass: the in-arena kernel prefill scattered the
+        suffix KV straight into its (pre-reserved) blocks."""
         bs = self.kv_block_size
         shared = req.shared_blocks
         req.shared_blocks = []
@@ -1523,13 +1584,15 @@ class DecodeServer:
         else:
             table = shared + self._alloc.alloc_many(
                 n_total - len(shared))
-        for j in range(len(shared), n_total):
-            self.cache = self._timed_dispatch(
-                ("installblk", row["k"].shape[3]), self._install_block,
-                self.cache, row["k"], row["v"], jnp.int32(table[j]),
-                jnp.int32(j * bs))
-            if self._scales is not None:
-                self._scales.note_write(table[j])
+        if not installed:
+            for j in range(len(shared), n_total):
+                self.cache = self._timed_dispatch(
+                    ("installblk", row["k"].shape[3]),
+                    self._install_block,
+                    self.cache, row["k"], row["v"], jnp.int32(table[j]),
+                    jnp.int32(j * bs))
+                if self._scales is not None:
+                    self._scales.note_write(table[j])
         s = req.slot
         self._tables[s] = table
         self._set_table_row(s)

@@ -72,7 +72,7 @@ import numpy as np
 
 from nos_tpu.models.generate import (
     _truncate_logits_rows, forward_paged, forward_with_cache, init_cache,
-    init_paged_cache,
+    init_paged_cache, replicated_logits,
 )
 from nos_tpu.models.kvblocks import (
     BlockAllocator, NoFreeBlocks, ScaleLedger, blocks_for,
@@ -110,23 +110,14 @@ class SpeculativeDecodeServer(DecodeServer):
                  max_len: Optional[int] = None, **kw):
         if draft_cfg.vocab != cfg.vocab:
             raise ValueError("draft and target must share a vocabulary")
-        if kw.get("kv_blocks") and kw.get("mesh") is not None:
-            # the base engine's paged arena is mesh-aware now, but this
-            # engine is not: its draft arena, verify-window trimming and
-            # lockstep block growth have no sharded-arena coverage yet.
-            # Documented single-host clamp (ROADMAP follow-up) — reject
-            # the combination cleanly at startup rather than build an
-            # engine whose draft cache silently stays unsharded.
+        if kw.get("role", "colocated") == "prefill":
             raise ValueError(
-                "speculative decoding over a paged arena is single-host "
-                "only: run mesh=None with kv_blocks, or tp with "
-                "kv_blocks=0 (sharding the draft+target arenas in "
-                "lockstep is the documented follow-up)")
-        if kw.get("role", "colocated") != "colocated":
-            raise ValueError(
-                "speculative decoding does not support prefill/decode "
-                "disaggregation roles: the draft cache has no handoff "
-                "payload format; run role=colocated")
+                "speculative decoding on a prefill-role engine is "
+                "pointless: a prefill replica never decodes, so the "
+                "draft would only burn HBM. Run the draft on the "
+                "decode side (role=decode adopts handoffs and "
+                "re-prefills the draft from the committed sequence) or "
+                "colocated")
         super().__init__(params, cfg, max_batch=max_batch,
                          max_len=max_len, **kw)
         self.draft_params = draft_params
@@ -150,16 +141,6 @@ class SpeculativeDecodeServer(DecodeServer):
         self._chunked_dreserved: dict = {}
         self._d_row_shd = None
         if self.paged:
-            # the fused decode kernel stays OFF here regardless of
-            # NOS_TPU_PAGED_KERNEL: verify windows are S > 1 (gather
-            # formulation), and mixing kernel decode with gather
-            # verify would let a near-tie argmax commit a different
-            # token than plain decoding — breaking this engine's
-            # greedy-equals-plain-decoding contract. One formulation
-            # end to end until the kernel covers S > 1 (ROADMAP
-            # follow-up); kv_stats echoes the clamp.
-            self.paged_kernel = "xla"
-        if self.paged:
             # the draft's own pooled arena: same block geometry as the
             # target's (draft and target timelines advance in lockstep,
             # and the draft has no prefix sharing, so its worst-case
@@ -181,10 +162,29 @@ class SpeculativeDecodeServer(DecodeServer):
             self.d_cache = init_cache(draft_cfg, max_batch, self.max_len,
                                       per_row_pos=True)
         if self.mesh is not None:
-            from nos_tpu.models.generate import cache_shardings
-            d_shd = cache_shardings(self.mesh, draft_cfg, per_row_pos=True)
-            self.d_cache = jax.device_put(self.d_cache, d_shd)
-            self._d_row_shd = d_shd["k"]
+            from nos_tpu.models.generate import (
+                cache_shardings, paged_cache_shardings,
+            )
+            if self.paged:
+                # draft + target arenas shard in LOCKSTEP over tp: the
+                # draft arena takes the same KV-head-axis sharding as
+                # the target's (paged_cache_shardings validates the
+                # draft's head divisibility), its device block table
+                # stays a replicated host-written control row, and the
+                # draft scratch prefill row carries the target
+                # convention's head sharding so installs never gather.
+                self.d_cache = jax.device_put(
+                    self.d_cache,
+                    paged_cache_shardings(self.mesh, draft_cfg,
+                                          kv_dtype=self.kv_dtype))
+                self._d_row_shd = cache_shardings(
+                    self.mesh, draft_cfg, per_row_pos=True)["k"]
+                self._d_table = jax.device_put(self._d_table, self._rep)
+            else:
+                d_shd = cache_shardings(self.mesh, draft_cfg,
+                                        per_row_pos=True)
+                self.d_cache = jax.device_put(self.d_cache, d_shd)
+                self._d_row_shd = d_shd["k"]
         k = self.k
         T = self.decode_steps
 
@@ -214,7 +214,15 @@ class SpeculativeDecodeServer(DecodeServer):
             tok = last
             for i in range(k):
                 dlogits, d_cache = d_fwd(dp, tok, d_cache)
-                step_logits = dlogits[:, -1]
+                # canonicalize every SAMPLING-decision row (see
+                # generate.replicated_logits): under a mesh the vocab-
+                # sharded logits would partition categorical's RNG
+                # lowering, drawing different bits than the single-host
+                # run — the paged arena's sharding propagation tickles
+                # this where the slot-static layout happened not to.
+                # Identity on values single-host.
+                step_logits = replicated_logits(dlogits[:, -1],
+                                                self.mesh)
                 nxt = jnp.argmax(step_logits, axis=-1)
                 if sampling:
                     q = _row_dist(step_logits, temp, topk, topp)
@@ -229,6 +237,7 @@ class SpeculativeDecodeServer(DecodeServer):
             # target's verdict on proposed[:, i]
             feed = jnp.concatenate([last, proposed[:, :-1]], axis=1)
             tlogits, t_cache = t_fwd(p, feed, t_cache)
+            tlogits = replicated_logits(tlogits, self.mesh)
             greedy = jnp.argmax(tlogits, axis=-1)           # [B, k]
             if sampling:
                 pdist = jax.vmap(_row_dist, in_axes=(1, None, None, None),
@@ -328,15 +337,21 @@ class SpeculativeDecodeServer(DecodeServer):
                 d_table = jnp.where(keep[:, None], d_table, 0)
                 return spec_core(
                     p, dp, last, t_cache, d_cache,
-                    # paged_impl pinned to the engine's clamped "xla":
-                    # draft decode and target verify must trace ONE
-                    # formulation (see the clamp in __init__)
+                    # ONE formulation end to end: draft decode steps
+                    # (S == 1) and target verify bursts (S == k) trace
+                    # the engine's captured paged_kernel — under the
+                    # fused kernel the S>1 verify window accumulates
+                    # exactly what sequential kernel decode would (see
+                    # forward_paged), which is what keeps this engine's
+                    # greedy-equals-plain-decoding contract intact.
+                    # mesh plumbs through for the kernel's shard_map
+                    # (both arenas shard their head axis over tp).
                     lambda pp, t, c: forward_paged(
                         pp, self.cfg, t, c, t_table,
-                        paged_impl=self.paged_kernel),
+                        paged_impl=self.paged_kernel, mesh=self.mesh),
                     lambda pp, t, c: forward_paged(
                         pp, self.draft_cfg, t, c, d_table,
-                        paged_impl=self.paged_kernel),
+                        paged_impl=self.paged_kernel, mesh=self.mesh),
                     keep, temp, topk, topp, seeds, sampling)
 
             self._spec_tick = jax.jit(spec_tick_paged,
@@ -377,6 +392,13 @@ class SpeculativeDecodeServer(DecodeServer):
                 return cache
 
             self._d_set_pos = jax.jit(d_set_pos, donate_argnums=(0,))
+            # draft twin of the base _replay_step: 1-row draft decode
+            # for kernel-formulation resume (_replay_draft) — same
+            # forward_paged, same captured formulation, undonated
+            self._d_replay_step = jax.jit(
+                lambda dp, t, c, tab: forward_paged(
+                    dp, self.draft_cfg, t, c, tab,
+                    paged_impl=self.paged_kernel, mesh=self.mesh))
 
     # ------------------------------------------------------------------
     def submit(self, prompt, max_new_tokens, **kw) -> int:
@@ -529,7 +551,8 @@ class SpeculativeDecodeServer(DecodeServer):
         self.d_cache = self._d_set_pos(self.d_cache, jnp.int32(slot),
                                        jnp.int32(plen))
 
-    def _finish_prefill(self, req, row, step) -> None:
+    def _finish_prefill(self, req, row, step, *,
+                        installed: bool = False) -> None:
         # draft install FIRST: the request may finish inside the super
         # call (stop token / max_new=1), releasing the slot and
         # recursively admitting a pending request into it — a stale
@@ -547,7 +570,7 @@ class SpeculativeDecodeServer(DecodeServer):
             drow = self._fresh_drow(bucket)
             _, drow = self._run_d_prefill(toks, drow)
         self._install_draft_row(req, drow, plen)
-        super()._finish_prefill(req, row, step)
+        super()._finish_prefill(req, row, step, installed=installed)
 
     def _finish_if_done(self, req, admit: bool = True) -> None:
         if req.done and req.slot >= 0:
@@ -562,14 +585,45 @@ class SpeculativeDecodeServer(DecodeServer):
         committed[:-1], pos == committed length - 1 fed next — holds in
         the rebuilt slot exactly as it did before the pause. The
         draft's re-prefilled KV is bit-identical to the incrementally
-        built one (chunking invariance), so greedy accept/reject
-        decisions — and therefore committed tokens — are undisturbed."""
+        built one (chunking invariance) under the gather formulation;
+        under the fused kernel the committed out-span is then replayed
+        through the 1-row kernel twin (``_replay_draft``) so the same
+        bit-exactness holds. Greedy accept/reject decisions — and
+        therefore committed tokens — are undisturbed either way."""
         n = len(seq)
         bucket = self._d_bucket(n)
         toks = jnp.asarray([seq + [0] * (bucket - n)], jnp.int32)
         drow = self._fresh_drow(bucket)
         _, drow = self._run_d_prefill(toks, drow)
         self._install_draft_row(req, drow, n)
+        if self.paged and self.paged_kernel == "kernel" \
+                and n > len(req.prompt):
+            self._replay_draft(req, seq)
+
+    def _replay_draft(self, req, seq) -> None:
+        """Kernel-formulation tail of the draft resume — the draft twin
+        of ``serving._replay_committed``: the dense re-prefill above
+        rebuilt the committed out-span with gather-formulation math,
+        but the undisturbed run built those draft positions with S==1
+        kernel steps (tolerance-equivalent, not bit-equal). Overwrite
+        them by replaying the committed tokens through the 1-row draft
+        decode twin so the rebuilt draft arena — and therefore every
+        later proposal distribution a sampled row's residual draw
+        depends on — is bit-identical to the undisturbed run's. This
+        is also the disagg-adopt path: a decode-role spec engine
+        re-prefills its draft from the adopted target handoff through
+        exactly this hook."""
+        n0 = len(req.prompt)
+        table = self._d_table[req.slot:req.slot + 1]
+        cache = {k: v for k, v in self.d_cache.items() if k != "pos"}
+        for p in range(n0, len(seq)):
+            cache["pos"] = jnp.asarray([p], jnp.int32)
+            _lg, cache = self._timed_dispatch(
+                ("replaydtok",), self._d_replay_step, self.draft_params,
+                jnp.asarray([[seq[p]]], jnp.int32), cache, table)
+        for key in self.d_cache:
+            if key != "pos":
+                self.d_cache[key] = cache[key]
 
     # -- paged draft-block discipline ----------------------------------
     def _set_d_table_row(self, slot: int) -> None:

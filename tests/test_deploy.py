@@ -348,7 +348,7 @@ def test_serving_deployment_passes_paged_kv_args():
         values = yaml.safe_load(f)
     assert values["serving"]["kv"] == {
         "blockSize": 0, "blocks": 0, "swap": True, "dtype": "bf16",
-        "pagedKernel": False}
+        "pagedKernel": True}
 
 
 def test_serving_deployment_passes_kv_dtype_and_speculative_args():
@@ -388,11 +388,12 @@ def test_serving_deployment_passes_kv_dtype_and_speculative_args():
 
 def test_serving_deployment_passes_paged_kernel_arg():
     """The serving Deployment must plumb serving.kv.pagedKernel to
-    --paged-kernel=on|off (ISSUE 14 satellite: the fused Pallas
-    decode-attention kernel's fleet knob), with the chart default
-    matching the binary's ServerConfig default (off — the XLA gather
-    formulation is the escape hatch and parity oracle until a fleet
-    opts in), and a README row so the knob is discoverable."""
+    --paged-kernel=on|off (the fused Pallas decode-attention kernel's
+    fleet knob), with the chart default matching the binary's
+    ServerConfig default (ON since the ISSUE 16 spec-grid parity
+    burn-in — the XLA gather formulation stays the documented
+    --paged-kernel=off escape hatch and parity oracle), and a README
+    row so the knob is discoverable."""
     path = os.path.join(CHART, "templates", "serving",
                         "deployment_server.yaml")
     with open(path) as f:
@@ -401,7 +402,7 @@ def test_serving_deployment_passes_paged_kernel_arg():
     assert 'ternary "on" "off" .Values.serving.kv.pagedKernel' in text
     with open(os.path.join(CHART, "values.yaml")) as f:
         values = yaml.safe_load(f)
-    assert values["serving"]["kv"]["pagedKernel"] is False
+    assert values["serving"]["kv"]["pagedKernel"] is True
     # chart default == code default (rendered through the ternary)
     from nos_tpu.cmd.server import ServerConfig
     rendered = "on" if values["serving"]["kv"]["pagedKernel"] else "off"

@@ -1080,10 +1080,12 @@ def test_build_engine_paged_flags_and_validation():
 
 def test_build_engine_paged_mesh_and_role_validation():
     """The old paged+tp rejection is GONE — the arena is mesh-aware
-    (tests/test_serving_sharded.py pins bit-exactness) — replaced by
-    real config validation: divisibility for the sharded head axis,
-    the speculative single-host clamp, and the disaggregation-role
-    requirements, all failing BEFORE any checkpoint load."""
+    (tests/test_serving_sharded.py pins bit-exactness) — and since
+    ISSUE 16 so is the speculative single-host clamp: spec + tp shards
+    draft and target arenas in lockstep. What remains is real config
+    validation — divisibility for the sharded head axes and the
+    disaggregation-role requirements — all failing BEFORE any
+    checkpoint load."""
     from nos_tpu.cmd.server import build_engine
 
     # paged + tp now builds a mesh engine (head axis divides evenly)
@@ -1092,12 +1094,21 @@ def test_build_engine_paged_mesh_and_role_validation():
     assert eng.paged and eng.mesh is not None
     assert eng.cache["k"].sharding.spec[2] == "tp"
 
-    # spec engine keeps its documented single-host clamp, refused
-    # before the (multi-GB in production) checkpoint load
-    with pytest.raises(ValueError, match="single-host"):
+    # the single-host spec clamp is GONE: spec + tp passes config
+    # validation (tests/test_serving_sharded.py pins the mesh
+    # bit-exactness) and reaches the draft checkpoint load itself
+    with pytest.raises(FileNotFoundError, match="/nope"):
         build_engine(ServerConfig(**MODEL, kv_block_size=8, kv_blocks=16,
                                   tp=2, draft_checkpoint_dir="/nope"))
-    # roles: validated values, paged-only, prefill needs a pool
+    # ...but the DRAFT cache head axis must still shard evenly, and
+    # that is refused before the (multi-GB in production) load
+    with pytest.raises(ValueError, match="draft kv_heads"):
+        build_engine(ServerConfig(**MODEL, kv_block_size=8, kv_blocks=16,
+                                  tp=2, draft_n_heads=3,
+                                  draft_checkpoint_dir="/nope"))
+    # roles: validated values, paged-only, prefill needs a pool, and
+    # a draft on a replica that never decodes is refused (run spec on
+    # the decode side — it re-prefills the draft from each adoption)
     with pytest.raises(ValueError, match="role must be"):
         build_engine(ServerConfig(**MODEL, role="proxy"))
     with pytest.raises(ValueError, match="paged KV"):
@@ -1106,7 +1117,8 @@ def test_build_engine_paged_mesh_and_role_validation():
         build_engine(ServerConfig(**MODEL, role="prefill",
                                   kv_block_size=8, kv_blocks=16))
     with pytest.raises(ValueError, match="speculative"):
-        build_engine(ServerConfig(**MODEL, role="decode",
+        build_engine(ServerConfig(**MODEL, role="prefill",
+                                  decode_pool="http://d0:8000",
                                   kv_block_size=8, kv_blocks=16,
                                   draft_checkpoint_dir="/nope"))
 
@@ -1187,11 +1199,12 @@ def test_build_engine_int8_and_draft_validation():
 
 def test_paged_kernel_flag_plumbed_and_validated(monkeypatch):
     """--paged-kernel reaches the ServerConfig, defaults cross-check
-    (off — the XLA gather formulation stays the escape hatch until a
-    fleet opts in), an invalid value is a clean config error BEFORE any
-    model load, and build_engine plumbs the choice to the engine as
-    NOS_TPU_PAGED_KERNEL so /stats kv.kernel echoes what the programs
-    actually trace (ISSUE 14 satellite)."""
+    (ON — after the ISSUE 16 parity burn-in the fused kernel is the
+    fleet default and the XLA gather formulation is the --paged-kernel
+    =off escape hatch), an invalid value is a clean config error
+    BEFORE any model load, and build_engine plumbs the choice to the
+    engine as NOS_TPU_PAGED_KERNEL so /stats kv.kernel echoes what the
+    programs actually trace."""
     # pin + restore the process-global env the flag plumbs
     monkeypatch.setenv("NOS_TPU_PAGED_KERNEL", "0")
     from nos_tpu.cmd import server as server_mod
@@ -1208,38 +1221,44 @@ def test_paged_kernel_flag_plumbed_and_validated(monkeypatch):
     try:
         with pytest.raises(SystemExit):
             server_mod.main(["--kv-block-size", "8", "--kv-blocks",
-                             "16", "--paged-kernel", "on"])
+                             "16", "--paged-kernel", "off"])
     finally:
         server_mod.build_engine = real
-    assert seen["cfg"].paged_kernel == "on"
-    assert ServerConfig().paged_kernel == "off"
+    assert seen["cfg"].paged_kernel == "off"
+    assert ServerConfig().paged_kernel == "on"
 
     # config-file garbage fails loudly before the checkpoint load
     with pytest.raises(ValueError, match="on\\|off"):
         build_engine(ServerConfig(**MODEL, kv_block_size=8,
                                   kv_blocks=16, paged_kernel="maybe"))
-    # the kernel walks per-slot block tables: slot-static has none
-    with pytest.raises(ValueError, match="paged_kernel.*paged|paged"):
-        build_engine(ServerConfig(**MODEL, paged_kernel="on"))
-    # kernel + speculative would silently clamp (the spec engine pins
-    # the gather formulation end to end) — contradictory config is a
-    # clean startup error instead
-    with pytest.raises(ValueError, match="speculative"):
-        build_engine(ServerConfig(**MODEL, kv_block_size=8,
-                                  kv_blocks=16, paged_kernel="on",
-                                  draft_checkpoint_dir="/ckpt/d"))
+    # the kernel walks per-slot block tables: on a slot-static engine
+    # the fleet-default "on" is INERT (env pinned "0"), not a startup
+    # error — flipping the default must not break non-paged configs
+    import os
+    eng = build_engine(ServerConfig(**MODEL, max_batch=2,
+                                    paged_kernel="on"))
+    assert eng.kv_stats() is None
+    assert os.environ["NOS_TPU_PAGED_KERNEL"] == "0"
 
     # on|off reach the engine: kv_stats echoes the traced formulation
-    eng = build_engine(ServerConfig(**MODEL, max_batch=2,
-                                    kv_block_size=8, kv_blocks=16,
-                                    paged_kernel="on"))
-    assert eng.kv_stats()["kernel"] == "kernel"
-    import os
-    assert os.environ["NOS_TPU_PAGED_KERNEL"] == "1"
+    # (the default IS on — ISSUE 16; the old spec/mesh rejections are
+    # gone, the spec engine rides the kernel end to end)
     eng = build_engine(ServerConfig(**MODEL, max_batch=2,
                                     kv_block_size=8, kv_blocks=16))
+    assert eng.kv_stats()["kernel"] == "kernel"
+    assert os.environ["NOS_TPU_PAGED_KERNEL"] == "1"
+    eng = build_engine(ServerConfig(**MODEL, max_batch=2,
+                                    kv_block_size=8, kv_blocks=16,
+                                    paged_kernel="off"))
     assert eng.kv_stats()["kernel"] == "xla"
     assert os.environ["NOS_TPU_PAGED_KERNEL"] == "0"
+    # speculative on a prefill-role replica stays a clean config error
+    # (a prefill server never decodes — the draft would only burn HBM)
+    with pytest.raises(ValueError, match="speculative"):
+        build_engine(ServerConfig(**MODEL, kv_block_size=8,
+                                  kv_blocks=16, role="prefill",
+                                  decode_pool="http://d0:8000",
+                                  draft_checkpoint_dir="/ckpt/d"))
 
 
 def test_speculative_engine_stats_and_metrics_over_loop():
@@ -1574,6 +1593,124 @@ def test_prefill_handoff_cancelled_when_client_departs_pre_push():
         assert loop._handoff_done == {}
         assert _outcome_delta(before) == {"cancelled": 1}
         assert not loop._live and not loop._adopted
+    finally:
+        loop.shutdown()
+
+
+def test_handoff_carries_deadline_and_adopt_arms_it():
+    """deadline_s survives disaggregation (ISSUE 16 satellite): the
+    prefill pusher ships the REMAINING wall budget inside the handoff
+    descriptor (computed at ship time — no cross-host clock sync), and
+    the adopting decode loop arms it in the same ledger stream() uses,
+    so expired phase-2 work is shed by the next sweep instead of
+    decoding tokens nobody waits for."""
+    from nos_tpu.models.handoff import decode_handoff, encode_handoff
+
+    shipped = []
+    eng = _ParkingEngine()
+    loop = ServingLoop(eng, role="prefill",
+                       handoff_targets=["http://dec"],
+                       handoff_send=lambda t, d: shipped.append(d) or 7)
+    try:
+        done = {}
+
+        def client():
+            done["res"] = loop.prefill([1, 2, 3], 6, deadline_s=30.0)
+
+        th = threading.Thread(target=client, daemon=True)
+        th.start()
+        assert _wait_until(lambda: 0 in eng.parked)
+        eng.release(0)
+        with loop._work:
+            loop._work.notify_all()
+        th.join(timeout=10)
+        assert done["res"]["handoff"] == {"target": "http://dec",
+                                          "rid": 7}
+        st = decode_handoff(shipped[0])
+        assert 0 < st["deadline_s"] <= 30.0
+        assert loop._prefill_deadlines == {}    # accounted, not leaked
+    finally:
+        loop.shutdown()
+
+    class Adopting(_FakeEngine):
+        # the first adopt (erid 0) never completes on its own — the
+        # tick is step-then-sweep, so an instant-finish engine would
+        # always beat the sweep and the shed path would be untestable
+        live = {1}
+
+        def restore(self, state):
+            rid = self._rid
+            self._rid += 1
+            self.pending[rid] = 3
+            return rid
+
+        def cancel(self, rid):
+            self.pending.pop(rid, None)
+
+        def step(self):
+            for rid, n in list(self.pending.items()):
+                if rid in self.live:
+                    self.done[rid] = list(range(n))
+                    del self.pending[rid]
+            return 1
+
+    dec = ServingLoop(Adopting(), role="decode")
+    try:
+        # an already-expired carry (the handoff out-raced its budget)
+        # is shed with the terminal `deadline` outcome, exactly once
+        before = _outcomes()
+        dec.adopt(encode_handoff({"rid": 0, "prompt": [1, 2],
+                                  "deadline_s": -60.0}))
+        assert _wait_until(
+            lambda: _outcome_delta(before).get("deadline") == 1)
+        # a live carry decodes to completion — the deadline only ever
+        # beats completion, it never races a healthy request
+        rid2 = dec.adopt(encode_handoff({"rid": 1, "prompt": [1, 2],
+                                         "deadline_s": 60.0}))
+        assert dec.result(rid2, timeout=5) == [1, 2, 0, 1, 2]
+    finally:
+        dec.shutdown()
+
+
+def test_pusher_cooldown_skips_failed_decode_target():
+    """Pusher health memory (ISSUE 16 satellite): after a failed push
+    the target sits out --handoff-cooldown-s, so the round-robin stops
+    feeding handoffs to a dead replica's connect timeout; the skip is
+    counted (nos_tpu_serve_handoff_skipped_total) and the pool falls
+    back to probing everyone rather than dropping work when every
+    target is cooling down."""
+    calls = []
+
+    def send(target, data):
+        calls.append(target)
+        if target == "http://bad":
+            raise OSError("connection refused")
+        return 1
+
+    eng = _ParkingEngine()
+    loop = ServingLoop(eng, role="prefill",
+                       handoff_targets=["http://bad", "http://good"],
+                       handoff_send=send, handoff_cooldown_s=60.0)
+    try:
+        for i in range(2):
+            done = {}
+
+            def client():
+                done["res"] = loop.prefill([1, 2, 3], 6)
+
+            th = threading.Thread(target=client, daemon=True)
+            th.start()
+            assert _wait_until(lambda: i in eng.parked)
+            eng.release(i)
+            with loop._work:
+                loop._work.notify_all()
+            th.join(timeout=10)
+            assert done["res"]["handoff"]["target"] == "http://good"
+        # first handoff probed bad (arming the cooldown) then good;
+        # the second skipped bad entirely
+        assert calls == ["http://bad", "http://good", "http://good"]
+        assert loop.m_handoff_skipped.value() >= 1
+        assert "http://bad" in loop._handoff_unhealthy
     finally:
         loop.shutdown()
 

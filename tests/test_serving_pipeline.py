@@ -284,6 +284,56 @@ def test_speculative_engine_honors_pipeline_and_stays_exact(params):
     assert srv._spec_tick is not None
 
 
+# two representative corners stay tier-1 (both dtypes, both k values,
+# complementary to the pair test_serving_sharded.py keeps); the full
+# grid rides -m slow — each case compiles TWO spec engines (kernel on
+# + gather oracle) and the tier-1 wall budget is shared
+@pytest.mark.parametrize("k,T,kv_dtype", [
+    pytest.param(1, 1, "bf16", marks=pytest.mark.slow),
+    pytest.param(1, 1, "int8", marks=pytest.mark.slow),
+    (1, 4, "bf16"),
+    pytest.param(1, 4, "int8", marks=pytest.mark.slow),
+    pytest.param(2, 1, "bf16", marks=pytest.mark.slow),
+    pytest.param(2, 1, "int8", marks=pytest.mark.slow),
+    pytest.param(2, 4, "bf16", marks=pytest.mark.slow),
+    (2, 4, "int8"),
+])
+def test_spec_kernel_on_matches_gather_oracle_over_grid(
+        params, monkeypatch, k, T, kv_dtype):
+    """ISSUE 16 acceptance, single-host leg: the paged speculative
+    engine with the fused kernel ON commits token-for-token what the
+    XLA gather formulation commits, across the full (n_draft,
+    decode_steps) x dtype grid with greedy AND seeded-sampled slots.
+    The kernel's verify bursts ride S>1 query windows; a width-S
+    window accumulates exactly what S sequential S==1 steps would, so
+    neither the accept/reject walk nor the residual draws can see the
+    formulation."""
+    from nos_tpu.models.spec_serving import SpeculativeDecodeServer
+
+    dcfg = tfm.TransformerConfig(vocab=64, d_model=16, n_layers=1,
+                                 n_heads=2, n_kv_heads=1, d_ff=32,
+                                 max_seq=64, dtype=jnp.float32)
+    dparams = tfm.init_params(jax.random.PRNGKey(1), dcfg)
+    reqs = [([4, 5], 10, dict()),
+            ([9, 8, 7], 8, dict(temperature=0.6, top_k=8, seed=7))]
+
+    def trace():
+        srv = SpeculativeDecodeServer(
+            params, CFG, dparams, dcfg, n_draft=k, decode_steps=T,
+            max_batch=2, max_len=64, kv_block_size=8, kv_blocks=24,
+            kv_dtype=kv_dtype)
+        rids = [srv.submit(p, n, **kw) for p, n, kw in reqs]
+        out = srv.drain()
+        return [out[r] for r in rids], srv.kv_stats()["kernel"]
+
+    monkeypatch.setenv("NOS_TPU_PAGED_KERNEL", "1")
+    on, echo_on = trace()
+    monkeypatch.setenv("NOS_TPU_PAGED_KERNEL", "0")
+    off, echo_off = trace()
+    assert (echo_on, echo_off) == ("kernel", "xla")
+    assert on == off, (k, T, kv_dtype)
+
+
 def test_random_schedules_stay_exact_under_pipelining(engines, params):
     """Crash-prober twin of test_serving.test_random_schedules_stay_exact
     with the pipeline on: random lengths, budgets, arrival points, AND

@@ -139,20 +139,64 @@ def test_paged_preempt_resume_invariant_to_mesh(params, sharded_params,
         == run(DecodeServer(params, CFG, **PAGED))
 
 
-def test_spec_engine_keeps_single_host_clamp(params, sharded_params,
-                                             mesh):
-    """The speculative engine documents its paged single-host clamp as
-    a clean startup error (its draft arena is not mesh-aware)."""
+DCFG = tfm.TransformerConfig(
+    vocab=64, d_model=16, n_layers=1, n_heads=2, n_kv_heads=2,
+    d_ff=32, max_seq=64, dtype=jnp.float32)
+
+
+@pytest.fixture(scope="module")
+def dparams():
+    return tfm.init_params(jax.random.PRNGKey(9), DCFG)
+
+
+@pytest.fixture(scope="module")
+def sharded_dparams(dparams, mesh):
+    return jax.device_put(dparams, tfm.param_shardings(mesh, DCFG))
+
+
+@pytest.fixture
+def kernel_on(monkeypatch):
+    monkeypatch.setenv("NOS_TPU_PAGED_KERNEL", "1")
+
+
+# two representative corners stay tier-1 (both dtypes, both k values,
+# a fused and an unfused T); the full grid rides -m slow — each mesh
+# spec trace costs seconds of CPU compile and the tier-1 wall budget
+# is shared by the whole suite
+@pytest.mark.parametrize("k,T,kv_dtype", [
+    pytest.param(1, 1, "bf16", marks=pytest.mark.slow),
+    pytest.param(1, 1, "int8", marks=pytest.mark.slow),
+    pytest.param(1, 4, "bf16", marks=pytest.mark.slow),
+    (1, 4, "int8"),
+    pytest.param(2, 1, "bf16", marks=pytest.mark.slow),
+    pytest.param(2, 1, "int8", marks=pytest.mark.slow),
+    (2, 4, "bf16"),
+    pytest.param(2, 4, "int8", marks=pytest.mark.slow),
+])
+def test_spec_engine_kernel_on_invariant_to_mesh(
+        params, sharded_params, dparams, sharded_dparams, mesh,
+        kernel_on, k, T, kv_dtype):
+    """The ISSUE 15 clamp is gone: the speculative engine runs its
+    draft+target arenas sharded in lockstep over tp, with the fused
+    kernel tracing every query shape (draft steps, S>1 verify bursts,
+    fused decode) — token-for-token with the single-host spec engine
+    across the full (k, T) x dtype grid, greedy and seeded-sampled
+    rows mixed. Sampling decisions ride replicated f32 logit rows
+    (generate.replicated_logits), so vocab sharding cannot re-draw
+    them."""
     from nos_tpu.models.spec_serving import SpeculativeDecodeServer
 
-    dcfg = tfm.TransformerConfig(
-        vocab=64, d_model=16, n_layers=1, n_heads=2, n_kv_heads=2,
-        d_ff=32, max_seq=64, dtype=jnp.float32)
-    dparams = tfm.init_params(jax.random.PRNGKey(9), dcfg)
-    with pytest.raises(ValueError, match="single-host"):
-        SpeculativeDecodeServer(
-            sharded_params, CFG, dparams, dcfg, mesh=mesh,
-            max_batch=2, max_len=64, kv_block_size=8, kv_blocks=24)
+    kw = dict(PAGED, kv_dtype=kv_dtype, n_draft=k, decode_steps=T)
+    want = run_trace(SpeculativeDecodeServer(
+        params, CFG, dparams, DCFG, **kw))
+    srv = SpeculativeDecodeServer(
+        sharded_params, CFG, sharded_dparams, DCFG, mesh=mesh, **kw)
+    assert srv.kv_stats()["kernel"] == "kernel"
+    assert run_trace(srv) == want
+    # both arenas actually live sharded: target AND draft head axes
+    assert tuple(srv.cache["k"].sharding.spec)[:3] == (None, None, "tp")
+    assert tuple(srv.d_cache["k"].sharding.spec)[:3] == \
+        (None, None, "tp")
 
 
 # ---------------------------------------------------------------------------
@@ -278,6 +322,38 @@ def test_sharded_decode_adopts_handoff(params, sharded_params, mesh):
         pre.step()
     dec = DecodeServer(sharded_params, CFG, mesh=mesh, role="decode",
                        **PAGED)
+    drids = [dec.restore(decode_handoff(encode_handoff(st)))
+             for st in pre.pop_handoffs()]
+    out = dec.drain()
+    assert [out[r] for r in drids] == want
+
+
+# int8 (the production handoff format) stays tier-1; bf16 rides -m slow
+@pytest.mark.parametrize("kv_dtype", [
+    pytest.param("bf16", marks=pytest.mark.slow), "int8"])
+def test_spec_decode_role_adopts_handoff_kernel_on(
+        params, dparams, kernel_on, kv_dtype):
+    """Speculative decoding on the decode side of a disaggregated
+    fleet (ISSUE 16): a draft-less prefill replica ships the handoff,
+    a decode-role SPEC engine adopts it — the draft arena re-prefills
+    from the committed sequence and the kernel replays the committed
+    out-span through the 1-row kernel twin (_replay_draft), so the
+    resumed stream is token-for-token what a colocated spec engine
+    produces, greedy and seeded-sampled rows alike."""
+    from nos_tpu.models.spec_serving import SpeculativeDecodeServer
+
+    kw = dict(PAGED, kv_dtype=kv_dtype)
+    spec_kw = dict(kw, n_draft=2, decode_steps=1)
+    want = run_trace(SpeculativeDecodeServer(
+        params, CFG, dparams, DCFG, **spec_kw))
+
+    pre = DecodeServer(params, CFG, role="prefill", **kw)
+    for p, n, s in REQS:
+        pre.submit(p, n, **s)
+    while pre.has_work():
+        pre.step()
+    dec = SpeculativeDecodeServer(params, CFG, dparams, DCFG,
+                                  role="decode", **spec_kw)
     drids = [dec.restore(decode_handoff(encode_handoff(st)))
              for st in pre.pop_handoffs()]
     out = dec.drain()
