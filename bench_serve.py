@@ -134,6 +134,31 @@ if SMOKE:
     MT_STEPS = 64
 
 
+# tiered KV fabric section (ISSUE 17): one replica under prefix-cache
+# pressure on a zipf system-prompt trace — tiered (host-RAM demotion,
+# promote-on-hit) vs drop-and-recompute. Every number is STRUCTURAL:
+# "prefill chip-seconds" is prefill tokens computed (per-token prefill
+# cost is shape-fixed, so the token count IS the chip-time axis) and
+# TTFT is the prefill tokens a request pays before its first emitted
+# token (prefill runs inside the submit window) — reruns are
+# byte-identical by construction. Pressure = an HBM prefix cache of
+# KF_CACHE_CHAINS entries under KF_SYS system prompts: every prompt
+# switch evicts, so the tiered arm's next hit on a demoted chain
+# promotes it back (suffix-only prefill) where the drop arm
+# re-prefills the whole system prompt.
+KF_SYS = 4                  # distinct system prompts, zipf popularity
+KF_SYS_BLOCKS = 4           # KV_BLOCK-sized blocks per system prompt
+KF_ZIPF_S = 1.1
+KF_SUFFIX = 8               # unique per-request suffix tokens
+KF_NEW = 8                  # decode tokens per request
+KF_REQUESTS = 24
+KF_CACHE_CHAINS = 1         # HBM prefix-cache entries: the pressure
+KF_HOST_BYTES = 1 << 22     # host tier big enough to hold every chain
+if SMOKE:
+    KF_SYS_BLOCKS = 2
+    KF_REQUESTS = 16
+
+
 # disaggregation section (ISSUE 15): colocated vs prefill/decode role
 # split at EQUAL chips (two engines either way, each on its own
 # thread) under a mixed trace — decode-heavy residents plus a stream
@@ -634,6 +659,114 @@ def multi_tenant_section(params, cfg):
     }
 
 
+def kv_fabric_section(params, cfg):
+    """The tiered KV fabric rep (see the KF_* block): runs the SAME
+    code path main() ships, callable directly by the smoke test.
+    Returns a JSON-safe dict with no wall-clock fields — two fresh
+    runs serialize byte-identically."""
+    import numpy as np
+
+    from nos_tpu.kvfabric import HostTierStore
+    from nos_tpu.models.serving import DecodeServer
+
+    bs = KV_BLOCK
+    sys_len = KF_SYS_BLOCKS * bs
+    max_len = -(-(sys_len + KF_SUFFIX + KF_NEW + 8) // bs) * bs
+    host_rng = np.random.default_rng(17)
+    sys_prompts = [[int(x) for x in host_rng.integers(1, cfg.vocab, sys_len)]
+                   for _ in range(KF_SYS)]
+    # zipf popularity over the system prompts, then a unique suffix per
+    # request — the shared-system-prompt serving shape the prefix cache
+    # exists for
+    w = np.array([1.0 / (r + 1) ** KF_ZIPF_S for r in range(KF_SYS)])
+    picks = host_rng.choice(KF_SYS, size=KF_REQUESTS, p=w / w.sum())
+    trace = [(int(s),
+              [int(x) for x in host_rng.integers(1, cfg.vocab, KF_SUFFIX)])
+             for s in picks]
+    per_req = -(-(sys_len + KF_SUFFIX + KF_NEW) // bs) + 1
+
+    def run(tiered, cache_chains, blocks):
+        host = HostTierStore(KF_HOST_BYTES) if tiered else None
+        eng = DecodeServer(params, cfg, max_batch=2, max_len=max_len,
+                           kv_block_size=bs, kv_blocks=blocks,
+                           kv_dtype="int8",
+                           prefix_cache_size=cache_chains,
+                           host_tier=host)
+        # warm phase: publish every system prompt's chain once, OUTSIDE
+        # the measured trace (both arms pay the same cold prefills; the
+        # measured difference is then purely what each arm does with an
+        # evicted chain — demote-and-promote vs drop-and-recompute)
+        for sp in sys_prompts:
+            eng.submit(sp + [1], 2, cache_prefix=True)
+            while eng.has_work():
+                eng.step()
+            eng.drain()
+        ttft, outputs = [], []
+        for si, suffix in trace:
+            prompt = sys_prompts[si] + suffix
+            saved0 = eng.prefix_tokens_saved
+            eng.submit(prompt, KF_NEW, cache_prefix=True)
+            while eng.has_work():
+                eng.step()
+            got = eng.drain()
+            outputs.append(next(iter(got.values())))
+            ttft.append(len(prompt) - (eng.prefix_tokens_saved - saved0))
+        snap = eng.prefix_index_snapshot()
+        return {
+            "prefill_tokens": sum(ttft),
+            "ttft_prefill_tokens": {"p50": pct(ttft, 0.50),
+                                    "p99": pct(ttft, 0.99)},
+            "prefix_hits": eng.prefix_hits,
+            "evicted": snap["evicted"],
+            "fabric": snap["fabric"],
+            "host_tier": (None if snap["host_tier"] is None
+                          else {k: snap["host_tier"][k]
+                                for k in ("chains", "bytes")}),
+        }, outputs
+
+    # pressure arms share the pool and the 1-chain cache; the
+    # no-pressure oracle gets a cache and pool big enough that nothing
+    # is ever evicted — its outputs are the bit-exactness reference
+    pool = 4 * per_req
+    tiered, tiered_out = run(True, KF_CACHE_CHAINS, pool)
+    drop, drop_out = run(False, KF_CACHE_CHAINS, pool)
+    relief, _ = run(True, KF_CACHE_CHAINS, pool)  # rerun determinism
+    assert relief == tiered
+    big_pool = KF_SYS * (KF_SYS_BLOCKS + 1) + KF_REQUESTS * 2 + 4 * per_req
+    nopress, nopress_out = run(False, KF_SYS + KF_REQUESTS, big_pool)
+    return {
+        "kv": "paged-int8",
+        "trace": {"requests": KF_REQUESTS, "system_prompts": KF_SYS,
+                  "system_prompt_tokens": sys_len, "zipf_s": KF_ZIPF_S,
+                  "suffix_tokens": KF_SUFFIX, "new_tokens": KF_NEW,
+                  "prefix_cache_chains": KF_CACHE_CHAINS},
+        "tiered": tiered,
+        "drop": drop,
+        "no_pressure": {"prefill_tokens": nopress["prefill_tokens"],
+                        "prefix_hits": nopress["prefix_hits"]},
+        # the acceptance headlines (booleans the smoke test pins):
+        # pressure + tiering must beat pressure + drop on BOTH latency
+        # percentiles AND total prefill chip-work, with every served
+        # token bit-identical to the undisturbed no-pressure run
+        "ttft_wins": (
+            tiered["ttft_prefill_tokens"]["p50"]
+            < drop["ttft_prefill_tokens"]["p50"]
+            and tiered["ttft_prefill_tokens"]["p99"]
+            < drop["ttft_prefill_tokens"]["p99"]),
+        "prefill_chip_ratio": round(
+            drop["prefill_tokens"] / max(tiered["prefill_tokens"], 1), 3),
+        "bit_exact_vs_no_pressure": tiered_out == nopress_out,
+        # the drop arm is NOT held to bit-exactness — re-prefilling an
+        # evicted chain recomputes the suffix over the pre-quantization
+        # activations, where a hit (promoted or resident) reads the
+        # dequantized int8 blocks; under int8 KV the recompute path can
+        # drift by a token. Reported, not gated: it is the strongest
+        # argument FOR tiering (demote/promote moves the exact bytes,
+        # so pressure never changes a served token)
+        "drop_bit_exact_vs_no_pressure": drop_out == nopress_out,
+    }
+
+
 def main():
     import jax
 
@@ -997,6 +1130,12 @@ def main():
     mt_section = multi_tenant_section(params, cfg)
 
     # ------------------------------------------------------------------
+    # tiered KV fabric (ISSUE 17): host-RAM demotion vs
+    # drop-and-recompute under prefix-cache pressure on the zipf
+    # system-prompt trace — structural, byte-identical across reruns
+    kf_section = kv_fabric_section(params, cfg)
+
+    # ------------------------------------------------------------------
     # prefill/decode disaggregation (ISSUE 15): colocated vs role-split
     # at equal chips under the mixed trace; handoff byte model bf16 vs
     # int8; conservation + byte-identical structural rerun
@@ -1041,6 +1180,7 @@ def main():
         "speculative": spec_section,
         "kv_int8": int8_section,
         "multi_tenant": mt_section,
+        "kv_fabric": kf_section,
         "disagg": dg_section,
         "prefix_cache": {
             "shared_prefix_tokens": sys_len,

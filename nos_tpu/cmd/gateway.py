@@ -84,6 +84,10 @@ class HttpReplicaTransport:
         body = dict(req["sampling"])
         body["prompt"] = req["prompt"]
         body["max_new_tokens"] = req["max_new_tokens"]
+        if req.get("kv_sources"):
+            # KV-fabric peer-pull offer: the replica fetches the named
+            # peer chain before admitting the request (best-effort)
+            body["kv_sources"] = req["kv_sources"]
         if stream:
             body["stream"] = True
         headers = {"Content-Type": "application/json"}
@@ -549,6 +553,22 @@ def main(argv: Optional[Sequence[str]] = None) -> None:
              "tenant backs off on its quota instead of walking the "
              "fleet's full retry ladder")
     parser.add_argument(
+        "--kv-fabric", choices=("on", "off"), default="off",
+        help="fleet-wide KV fabric (off [default]): on = keep a union "
+             "index over the replicas' /stats prefix_index sections "
+             "and attach a peer-pull offer (kv_sources naming the "
+             "warmest peer's /v1/kvchain/<digest>) to dispatches whose "
+             "routed replica is colder on the prompt's prefix chain — "
+             "the replica pulls the chain instead of re-prefilling. "
+             "Requires replicas running a prefix cache; pair with "
+             "--kv-host-tier-bytes on the replicas so evicted chains "
+             "stay pullable from host RAM")
+    parser.add_argument(
+        "--kv-fabric-max-blocks", type=int, default=32,
+        help="deepest block-aligned prompt prefix the fabric "
+             "enumerates chain digests for per dispatch (cost is one "
+             "digest per block, longest-first)")
+    parser.add_argument(
         "--retry-attempts", type=int, default=12,
         help="dispatch attempts per request before failing it")
     parser.add_argument(
@@ -577,6 +597,8 @@ def main(argv: Optional[Sequence[str]] = None) -> None:
             backoff_s=args.retry_backoff,
             tenant_config=TenantQuotaConfig.load(args.tenant_config),
             tenant_quota_attempts=args.tenant_quota_attempts,
+            fabric=args.kv_fabric == "on",
+            fabric_max_blocks=args.kv_fabric_max_blocks,
         ),
         transport=transport.send,
         stream_transport=transport.send_stream,

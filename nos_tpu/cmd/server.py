@@ -212,6 +212,19 @@ class ServerConfig:
     # same memory_stats() the HBM gauges sample (backends without
     # memory stats skip the check)
     kv_hbm_admit_frac: float = 0.95
+    # host-RAM KV tier (ISSUE 17, 0 = off): bytes of host RAM bounding
+    # the kvfabric HostTierStore under the HBM arena. With it on,
+    # prefix-chain eviction under block pressure DEMOTES the LRU
+    # chain's swap payload (quantized bytes + scales) to host RAM
+    # instead of dropping it, and a later prefix miss that matches the
+    # stored chain PROMOTES it back bit-exactly — re-prefill chip-
+    # seconds traded for one host-RAM round trip. Requires kv_blocks
+    # AND prefix_cache_size > 0 (the tier stores prefix chains, which
+    # only the paged prefix index produces); the store empties on a
+    # supervised engine rebuild (host RAM is replica-local state, not
+    # durable). Size it a few multiples of the hot system prompts'
+    # payload bytes; the demotion ladder is HBM -> host -> drop.
+    kv_host_tier_bytes: int = 0
     # speculative decoding (draft_checkpoint_dir set = on): a smaller
     # draft model proposes draft_n_tokens per tick, the target verifies
     # them in one wide forward. Greedy requests stay bit-identical to
@@ -451,6 +464,37 @@ class ServingLoop:
                 ("mode",))
             for mode in ("swap", "recompute"):
                 self.m_preempt.labels(mode).inc(0)
+        # prefix-cache eviction tiers + KV fabric (ISSUE 17), both
+        # registered whenever the engine has a paged prefix index —
+        # evict_lru dropped chains SILENTLY before this, fabric on or
+        # off, and a replica serves/adopts peer-pull chains even
+        # without its own host tier. Engine-side events delta-mirror
+        # (and reset with the _preempt_seen family on a supervised
+        # engine swap); pull_hit/pull_miss are counted loop-side in
+        # prefetch_chain.
+        self._prefix_evict_seen = {"drop": 0, "demote": 0}
+        self._fabric_seen = {"demote": 0, "promote": 0}
+        if getattr(engine, "_pindex", None) is not None:
+            self.m_prefix_evict = reg.counter(
+                "nos_tpu_serve_prefix_evict_total",
+                "Prefix chains evicted from the HBM index under block "
+                "pressure, by tier (drop = thrown away — the next hit "
+                "re-prefills; demote = swap payload captured into the "
+                "host-RAM KV tier for bit-exact promotion later)",
+                ("tier",))
+            for tier in ("drop", "demote"):
+                self.m_prefix_evict.labels(tier).inc(0)
+            self.m_kvfabric = reg.counter(
+                "nos_tpu_serve_kvfabric_total",
+                "KV-fabric tier transitions, by event (demote = chain "
+                "captured into the host tier instead of dropped; "
+                "promote = chain scattered back into the arena on a "
+                "prefix miss, bit-exact; pull_hit / pull_miss = "
+                "gateway-offered peer chains adopted vs failed/"
+                "rejected)",
+                ("event",))
+            for ev in ("demote", "promote", "pull_hit", "pull_miss"):
+                self.m_kvfabric.labels(ev).inc(0)
         # speculative decoding (registered only on a speculative
         # engine — a plain decode server must not export dead zero
         # series): proposals drafted vs accepted by verify, plus the
@@ -726,6 +770,12 @@ class ServingLoop:
         self._est_ttft_s: Optional[float] = None
         self._est_tpot_s: Optional[float] = None
         self._est_out_tokens: Optional[float] = None
+        # KV-fabric peer pull: injectable fetcher (url -> payload
+        # bytes) so tests/benches pull chains without a socket; None =
+        # the urllib default in _fetch_chain_bytes. Pull outcomes are
+        # loop-side counters (the engine only sees decoded payloads).
+        self.chain_fetch = None
+        self._pull_counts = {"pull_hit": 0, "pull_miss": 0}
         for outcome in OUTCOMES:        # export 0s, not absent series
             self.m_requests.labels(outcome).inc(0)
         self._mirror_engine_gauges()
@@ -1075,6 +1125,9 @@ class ServingLoop:
                                 if self._goodput_done else None),
                 },
                 "rates": rates,
+                # KV-fabric peer-pull outcomes (loop-side: the engine
+                # only sees decoded payloads, never fetches)
+                "kv_fabric_pulls": dict(self._pull_counts),
             })
         return snap
 
@@ -1355,6 +1408,11 @@ class ServingLoop:
             self._preempt_seen = {"swap": 0, "recompute": 0}
             self._spec_seen = {"drafted": 0, "accepted": 0}
             self._tenant_preempt_seen = {}
+            # the rebuilt engine's eviction/fabric counters start at 0
+            # (and its host tier starts empty): reset the mirrors or
+            # the deltas would go negative and freeze the counters
+            self._prefix_evict_seen = {"drop": 0, "demote": 0}
+            self._fabric_seen = {"demote": 0, "promote": 0}
             resumed = {"swap": 0, "recompute": 0}
             lost = 0
             seen = set()
@@ -1768,6 +1826,64 @@ class ServingLoop:
             self._work.notify_all()
         return rid
 
+    def export_chain(self, digest: str) -> Optional[bytes]:
+        """KV-fabric peer-pull serve (GET /v1/kvchain/<digest>): one
+        chain's codec payload from this replica's HBM prefix index or
+        host tier, or None. The HBM snapshot runs under the loop lock
+        — chain blocks are never written in place (COW), so the
+        gathered bytes are stable even between decode ticks."""
+        export = getattr(self.engine, "export_chain", None)
+        if export is None:
+            return None
+        with self._work:
+            if self._failed is not None or self._recovering:
+                return None
+            return export(digest)
+
+    def _fetch_chain_bytes(self, url: str, timeout_s: float = 5.0
+                           ) -> bytes:
+        import urllib.request
+        with urllib.request.urlopen(url, timeout=timeout_s) as resp:
+            if resp.status != 200:
+                raise RuntimeError(f"kvchain fetch {url}: {resp.status}")
+            return resp.read()
+
+    def prefetch_chain(self, sources, tenant: Optional[str] = None
+                       ) -> bool:
+        """Best-effort adoption of gateway-offered peer chains BEFORE
+        a request submits: fetch the codec payload from the named peer
+        (outside the loop lock — a slow peer must not stall the
+        serving loop), then ingest it under the lock so the request's
+        own prefix match hits warm. Every failure path returns False
+        (counted pull_miss) and the request simply prefills — the
+        fabric is an accelerator, never a dependency."""
+        ok = False
+        for src in sources if isinstance(sources, list) else ():
+            if not isinstance(src, dict):
+                continue
+            url, digest = src.get("url"), src.get("digest")
+            if not isinstance(url, str) or not url:
+                continue
+            try:
+                fetch = self.chain_fetch or self._fetch_chain_bytes
+                data = fetch(url)
+                with self._work:
+                    if self._failed is not None or self._recovering:
+                        raise RuntimeError("loop not serving")
+                    adopted = self.engine.ingest_chain(
+                        data, tenant,
+                        expect_digest=digest
+                        if isinstance(digest, str) else None)
+            except Exception as exc:
+                logger.debug("kvfabric pull failed: %s", exc)
+                adopted = False
+            ev = "pull_hit" if adopted else "pull_miss"
+            self._pull_counts[ev] += 1
+            if hasattr(self, "m_kvfabric"):
+                self.m_kvfabric.labels(ev).inc()
+            ok = ok or adopted
+        return ok
+
     def watch(self, rid: int, timeout: float = 300.0):
         """Attach to an adopted request's token stream (the decode-side
         SSE surface after a handoff): yields newly-decoded token lists
@@ -1960,6 +2076,20 @@ class ServingLoop:
                         self.m_tenant_preempt.labels(t, mode).inc(
                             n - seen)
                         self._tenant_preempt_seen[(t, mode)] = n
+        pindex = getattr(self.engine, "_pindex", None)
+        if pindex is not None and hasattr(self, "m_prefix_evict"):
+            for tier, n in pindex.evicted.items():
+                delta = n - self._prefix_evict_seen.get(tier, 0)
+                if delta > 0:
+                    self.m_prefix_evict.labels(tier).inc(delta)
+                    self._prefix_evict_seen[tier] = n
+            for ev, n in getattr(self.engine, "_fabric", {}).items():
+                if ev not in self._fabric_seen:
+                    continue    # ingest* counts ride pull_hit/pull_miss
+                delta = n - self._fabric_seen[ev]
+                if delta > 0:
+                    self.m_kvfabric.labels(ev).inc(delta)
+                    self._fabric_seen[ev] = n
         kv_stats = getattr(self.engine, "kv_stats", None)
         kv = kv_stats() if kv_stats is not None else None
         if kv:
@@ -2360,6 +2490,18 @@ def build_engine(cfg: ServerConfig):
             "burn HBM — run the draft on the decode side "
             "(role=decode re-prefills it from each adopted handoff) "
             "or colocated")
+    if cfg.kv_host_tier_bytes < 0:
+        raise ValueError(
+            f"kv_host_tier_bytes must be >= 0, got "
+            f"{cfg.kv_host_tier_bytes}")
+    if cfg.kv_host_tier_bytes and not (cfg.kv_blocks
+                                       and cfg.prefix_cache_size):
+        raise ValueError(
+            "kv_host_tier_bytes requires the paged KV cache with a "
+            "prefix cache (set kv_blocks/kv_block_size AND "
+            "prefix_cache_size): the host tier stores demoted prefix "
+            "chains, which only the paged prefix index produces — "
+            "without one there is nothing to demote")
     mesh = None
     if cfg.tp and cfg.tp > 1:
         import jax
@@ -2391,6 +2533,14 @@ def build_engine(cfg: ServerConfig):
     # rebuild factory re-creates a tenant-aware engine from the same
     # config (a restart must not silently drop tenancy)
     tenant_quota = TenantQuotaConfig.load(cfg.tenant_config)
+    # host-RAM KV tier (built per engine: a supervised rebuild starts
+    # with an EMPTY tier — its content was host process state tied to
+    # the failed engine's arena geometry, and demotions refill it)
+    host_tier = None
+    if cfg.kv_host_tier_bytes:
+        from nos_tpu.kvfabric import HostTierStore
+
+        host_tier = HostTierStore(cfg.kv_host_tier_bytes)
     gcfg = GenerateConfig(
         vocab=cfg.vocab, d_model=cfg.d_model, n_layers=cfg.n_layers,
         n_heads=cfg.n_heads, n_kv_heads=cfg.n_kv_heads, d_ff=cfg.d_ff,
@@ -2430,7 +2580,7 @@ def build_engine(cfg: ServerConfig):
             kv_block_size=cfg.kv_block_size, kv_blocks=cfg.kv_blocks,
             kv_swap=cfg.kv_swap, hbm_admit_frac=cfg.kv_hbm_admit_frac,
             kv_dtype=cfg.kv_dtype, tenant_quota=tenant_quota,
-            role=cfg.role)
+            role=cfg.role, host_tier=host_tier)
     return DecodeServer(params, model_cfg, max_batch=cfg.max_batch,
                         prefix_cache_size=cfg.prefix_cache_size, mesh=mesh,
                         prefill_chunk=cfg.prefill_chunk,
@@ -2441,7 +2591,8 @@ def build_engine(cfg: ServerConfig):
                         kv_blocks=cfg.kv_blocks, kv_swap=cfg.kv_swap,
                         hbm_admit_frac=cfg.kv_hbm_admit_frac,
                         kv_dtype=cfg.kv_dtype,
-                        tenant_quota=tenant_quota, role=cfg.role)
+                        tenant_quota=tenant_quota, role=cfg.role,
+                        host_tier=host_tier)
 
 
 def make_http_server(cfg: ServerConfig, loop: ServingLoop
@@ -2537,6 +2688,29 @@ def make_http_server(cfg: ServerConfig, loop: ServingLoop
                                       "reason": "unknown_rid"})
                     return
                 self._stream_sse(gen)
+            elif self.path.startswith("/v1/kvchain/"):
+                # KV-fabric peer pull: the codec payload of one prefix
+                # chain by fleet digest, served raw (octet-stream, not
+                # JSON — it IS the handoff wire format) from this
+                # replica's HBM index or host tier. 404 means the
+                # chain aged out since the gateway's last /stats
+                # scrape; the puller just prefills.
+                digest = self.path.rsplit("/", 1)[1].split("?")[0]
+                try:
+                    data = loop.export_chain(digest)
+                except Exception as e:  # noqa: BLE001 — JSON 500
+                    self._reply(500, {"error": f"{type(e).__name__}: {e}"})
+                    return
+                if data is None:
+                    self._reply(404, {"error": "unknown chain",
+                                      "digest": digest})
+                    return
+                self.send_response(200)
+                self.send_header("Content-Type",
+                                 "application/octet-stream")
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
             elif self.path == "/debug/traces":
                 self._reply(200, tracing.recorder().to_json())
             elif self.path.startswith("/debug/traces/"):
@@ -2689,6 +2863,14 @@ def make_http_server(cfg: ServerConfig, loop: ServingLoop
                     "deadline_s", self.headers.get("X-Request-Deadline-S"))
                 if deadline is not None:
                     sampling["deadline_s"] = float(deadline)
+                if body.get("kv_sources"):
+                    # gateway-attached KV-fabric peer offers: pull the
+                    # named chain(s) from peer replicas BEFORE submit,
+                    # so this request's prefix match hits warm.
+                    # Best-effort by design — any failure just means a
+                    # normal prefill (prefetch_chain never raises).
+                    loop.prefetch_chain(body["kv_sources"],
+                                        sampling.get("tenant"))
                 if cfg.role == "prefill":
                     # prefill role: the answer is a handoff descriptor
                     # ({"handoff": {"target", "rid"}}) the gateway
@@ -2817,6 +2999,16 @@ def main(argv: Optional[Sequence[str]] = None) -> None:
              "and requires --kv-blocks (the slot-static engine has no "
              "scale storage; rejected with a clear error)")
     parser.add_argument(
+        "--kv-host-tier-bytes", type=int, default=None,
+        help="host-RAM KV tier capacity in bytes (0 = off [default]; "
+             "overrides config; requires --kv-blocks and a prefix "
+             "cache). Prefix chains evicted from the HBM arena under "
+             "block pressure DEMOTE here instead of dropping, and a "
+             "later prefix miss that matches a stored chain PROMOTES "
+             "it back via the batched restore scatter, bit-exact. "
+             "Also backs GET /v1/kvchain/<digest> so gateway peer "
+             "pulls can warm other replicas from this tier")
+    parser.add_argument(
         "--paged-kernel", choices=("on", "off"), default=None,
         help="paged attention formulation (overrides config): on "
              "[default] = the fused Pallas kernel for every query "
@@ -2925,6 +3117,8 @@ def main(argv: Optional[Sequence[str]] = None) -> None:
         cfg.kv_swap = args.kv_swap == "on"
     if args.kv_dtype is not None:
         cfg.kv_dtype = args.kv_dtype
+    if args.kv_host_tier_bytes is not None:
+        cfg.kv_host_tier_bytes = args.kv_host_tier_bytes
     if args.paged_kernel is not None:
         cfg.paged_kernel = args.paged_kernel
     if args.role is not None:
@@ -3011,6 +3205,9 @@ def main(argv: Optional[Sequence[str]] = None) -> None:
             "kv_blocks": cfg.kv_blocks,
             "kv_swap": cfg.kv_swap,
             "kv_dtype": cfg.kv_dtype,
+            # host-tier capacity drifting between replicas would skew
+            # the gateway's peer-pull economics — same drift detector
+            "kv_host_tier_bytes": cfg.kv_host_tier_bytes,
             # kernel drift between replicas would make decode numerics
             # replica-dependent (online-softmax vs gather formulation)
             # — surface it in the same drift detector as every knob

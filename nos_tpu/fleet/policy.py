@@ -92,6 +92,12 @@ class ReplicaStats:
     ttft_p99_s: Optional[float] = None
     active_slots: int = 0
     config: dict = field(default_factory=dict)
+    # KV-fabric: the replica's /stats ``prefix_index`` section (chain
+    # digests + lengths + tier), None when the replica doesn't report
+    # one (fabric off, or an older schema mid-rollout). The gateway
+    # feeds these into its FleetPrefixIndex; an unscrapable replica's
+    # stats are None so its chains age out of the fleet index.
+    prefix_index: Optional[dict] = None
 
 
 def parse_replica_stats(name: str, snap: Optional[dict],
@@ -122,6 +128,9 @@ def parse_replica_stats(name: str, snap: Optional[dict],
         ttft_p99_s=ttft,
         active_slots=int(snap.get("active_slots") or 0),
         config=dict(snap.get("config") or {}),
+        prefix_index=(snap.get("prefix_index")
+                      if isinstance(snap.get("prefix_index"), dict)
+                      else None),
     )
 
 

@@ -57,6 +57,8 @@ from dataclasses import dataclass, field
 from typing import Any, Callable, Deque, Dict, Iterable, List, Optional
 
 from nos_tpu.gateway.ring import HashRing, affinity_pick, prefix_key
+from nos_tpu.kvfabric.codec import chain_digest
+from nos_tpu.kvfabric.fleet import FleetPrefixIndex
 from nos_tpu.models.errors import (
     DeadlineExceeded, EngineRecovering, Infeasible, QueueFull,
     TenantQuotaExceeded,
@@ -176,6 +178,17 @@ class RouterConfig:
     # retry capacity while guaranteed tenants wait
     tenant_config: Optional[TenantQuotaConfig] = None
     tenant_quota_attempts: int = 2
+    # fleet-wide KV fabric (ISSUE 17): when on, the gateway keeps a
+    # union index over the replicas' /stats ``prefix_index`` sections
+    # and, on a dispatch whose routed replica is NOT the warmest
+    # holder of the prompt's prefix chain, attaches ONE peer-pull
+    # offer (``kv_sources``) naming the warmest peer's
+    # /v1/kvchain/<digest> — the replica pulls the chain instead of
+    # re-prefilling. fabric_max_blocks caps how deep a prompt prefix
+    # the gateway enumerates digests for (cost is one digest per
+    # block, longest-first).
+    fabric: bool = False
+    fabric_max_blocks: int = 32
 
 
 class GatewayRouter:
@@ -234,6 +247,15 @@ class GatewayRouter:
         self._tenant_shed: Dict[str, int] = {}
         self._routes: Dict[str, int] = {}
         self._retries = 0
+        # KV fabric: the union view over replica prefix_index sections
+        # (synced wholesale in update(), so unscrapable/departed
+        # replicas age out), plus an injectable URL builder for the
+        # peer-pull source — tests with in-process loop handles
+        # override it; the default only knows string handles (the HTTP
+        # base URL).
+        self._fleet_index = FleetPrefixIndex()
+        self._fabric_offered = 0
+        self.chain_url: Optional[Callable[[Replica, str], str]] = None
         reg = default_registry()
         self.m_requests = reg.counter(
             "nos_tpu_gateway_requests_total",
@@ -280,6 +302,13 @@ class GatewayRouter:
             "nos_tpu_gateway_door_wait_seconds",
             "Time requests spent parked in the door queue before "
             "dispatch or shed")
+        self.m_fabric_offered = reg.counter(
+            "nos_tpu_gateway_kvfabric_offered_total",
+            "Peer-pull chain offers the gateway attached to dispatched "
+            "requests (KV fabric): the routed replica was colder than "
+            "a peer on the prompt's prefix chain, so the request "
+            "carried one kv_sources entry naming the warmest peer's "
+            "/v1/kvchain/<digest>")
         self.g_replicas = reg.gauge(
             "nos_tpu_gateway_replicas",
             "Replicas as the gateway's discovery sees them, by state "
@@ -310,6 +339,13 @@ class GatewayRouter:
             self._ring.sync(n for n in fresh
                             if fresh[n].ready and not fresh[n].draining
                             and fresh[n].role != "decode")
+            if self.cfg.fabric:
+                # fleet prefix index: wholesale per scrape, so a
+                # replica that left the fleet (or stopped answering
+                # /stats — its snapshot is empty) ages out with it
+                self._fleet_index.sync({
+                    name: (r.stats or {}).get("prefix_index")
+                    for name, r in fresh.items()})
             n_ready = len(self._admitting())
             n_drain = sum(1 for r in fresh.values() if r.draining)
             self.g_replicas.labels("ready").set(n_ready)
@@ -526,6 +562,60 @@ class GatewayRouter:
             return None
         return tc.resolve(tenant)
 
+    def _fabric_offer(self, rep: Replica, prompt: List[int],
+                      tenant: Optional[str]) -> Optional[dict]:
+        """At most ONE peer-pull offer for this dispatch, or None.
+        Caller holds the lock. Enumerates the prompt's block-aligned
+        prefix digests LONGEST-first (capped at fabric_max_blocks) and
+        offers the warmest peer holding any of them — but only when
+        that peer's chain is strictly longer than anything the routed
+        replica itself holds (pulling what the target already has, or
+        less, wastes a fetch on the latency path). Digests embed the
+        tenant scope, so a lookup can only ever surface chains
+        published under the requester's own scope — cross-tenant
+        migration is structurally impossible, not just filtered."""
+        if not self.cfg.fabric:
+            return None
+        bs = self.cfg.block_size
+        nblk = min(len(prompt) // bs, self.cfg.fabric_max_blocks)
+        scope = self._key_scope(tenant)
+        own_best = 0
+        best = None                     # (len, peer Replica, digest)
+        for b in range(nblk, 0, -1):
+            digest = chain_digest(prompt[:b * bs], scope)
+            own_best = max(own_best,
+                           self._fleet_index.replica_len(rep.name, digest))
+            for name, row in self._fleet_index.holders(
+                    digest, exclude=rep.name):
+                peer = self._replicas.get(name)
+                if peer is None:
+                    continue
+                ln = int(row.get("len") or 0)
+                if best is None or ln > best[0]:
+                    best = (ln, peer, digest)
+            if best is not None:
+                # longest-first enumeration: the first depth with any
+                # peer holder IS the longest pullable chain, and every
+                # own-chain candidate at least that deep has already
+                # been folded into own_best
+                break
+        if best is None or best[0] <= own_best:
+            return None
+        ln, peer, digest = best
+        try:
+            url = (self.chain_url(peer, digest)
+                   if self.chain_url is not None
+                   else f"{peer.handle}/v1/kvchain/{digest}"
+                   if isinstance(peer.handle, str) else None)
+        except Exception:   # noqa: BLE001 — offers are best-effort
+            url = None
+        if not url:
+            return None
+        self._fabric_offered += 1
+        self.m_fabric_offered.inc()
+        return {"url": url, "digest": digest, "len": ln,
+                "replica": peer.name}
+
     def dispatch(self, prompt: List[int], max_new_tokens: int,
                  deadline_s: Optional[float] = None,
                  tenant: Optional[str] = None, **sampling):
@@ -584,9 +674,12 @@ class GatewayRouter:
                 if rep is None:
                     continue
                 self._inflight_delta(rep.name, +1)
+                offer = self._fabric_offer(rep, prompt, tenant)
             req = {"prompt": list(prompt),
                    "max_new_tokens": max_new_tokens,
                    "deadline_s": rem, "sampling": dict(samp)}
+            if offer is not None:
+                req["kv_sources"] = [offer]
             try:
                 tokens = self.transport(rep, req)
             except Infeasible:
@@ -824,9 +917,12 @@ class GatewayRouter:
                     if rep is None:
                         continue
                     self._inflight_delta(rep.name, +1)
+                    offer = self._fabric_offer(rep, prompt, tenant)
                 req = {"prompt": list(prompt),
                        "max_new_tokens": max_new_tokens,
                        "deadline_s": rem, "sampling": dict(samp)}
+                if offer is not None:
+                    req["kv_sources"] = [offer]
                 started = False
                 released = False
                 try:
@@ -953,6 +1049,9 @@ class GatewayRouter:
                 "retries": self._retries,
                 "ring": {"replicas": self._ring.nodes(),
                          "vnodes": self._ring.vnodes},
+                "kv_fabric": dict(self._fleet_index.stats(),
+                                  enabled=self.cfg.fabric,
+                                  offered=self._fabric_offered),
                 "config": {
                     "block_size": self.cfg.block_size,
                     "affinity_blocks": self.cfg.affinity_blocks,
@@ -961,6 +1060,8 @@ class GatewayRouter:
                         self.cfg.admit_pending_per_replica,
                     "admit_hbm_frac": self.cfg.admit_hbm_frac,
                     "max_door_queue": self.cfg.max_door_queue,
+                    "fabric": self.cfg.fabric,
+                    "fabric_max_blocks": self.cfg.fabric_max_blocks,
                     "tenant_quota": (
                         self.cfg.tenant_config.echo()
                         if self.cfg.tenant_config is not None

@@ -1,0 +1,40 @@
+"""Fleet-wide KV fabric (ISSUE 17): tiered prefix caching across the
+serving fleet instead of per-replica HBM islands.
+
+Three pieces, deliberately jax-free (the gateway imports this plane and
+must never pay a jax import, and the host tier is pure numpy-bytes
+bookkeeping):
+
+- ``codec``    — the chain wire format: a prefix chain's identity
+  (``chain_digest`` over its scope + token content, the same blake2b-16
+  arithmetic as the gateway's affinity ``prefix_key``) and its payload
+  (``encode_chain``/``decode_chain`` wrapping the ``models/handoff.py``
+  swap codec PR 15 proved adopts byte-exactly across hosts);
+- ``hosttier`` — ``HostTierStore``: the bounded host-RAM tier under a
+  replica's HBM arena. Prefix-chain eviction under block pressure
+  DEMOTES the LRU chain's quantized bytes + scale planes here instead
+  of dropping them; a later prefix miss that hits the store PROMOTES
+  the chain back via the engine's batched adopt-by-scatter, bit-exact;
+- ``fleet``    — ``FleetPrefixIndex``: the gateway's union of every
+  replica's ``/stats`` ``prefix_index`` section (chain digests +
+  lengths + tier), so a miss on the affinity-routed replica can pull
+  the chain from a peer replica (one HTTP fetch of the codec payload)
+  instead of re-prefilling.
+
+Tenant scoping is preserved end to end: chains stay keyed
+``(scope, tokens)`` per the ISSUE 13 side-channel rule, the digest
+itself embeds the scope (two tenants' identical prompts can never
+collide), and the ingest path re-derives the requester's scope before
+any pulled chain enters a cache — cross-replica migration never
+crosses tenant scopes.
+"""
+from nos_tpu.kvfabric.codec import (
+    chain_digest, chain_nbytes, decode_chain, encode_chain,
+)
+from nos_tpu.kvfabric.fleet import FleetPrefixIndex
+from nos_tpu.kvfabric.hosttier import HostTierStore
+
+__all__ = [
+    "FleetPrefixIndex", "HostTierStore", "chain_digest", "chain_nbytes",
+    "decode_chain", "encode_chain",
+]

@@ -1,0 +1,74 @@
+"""The gateway's fleet-wide prefix index (jax-free).
+
+A union view over every replica's ``/stats`` ``prefix_index`` section:
+which chain digests live where (HBM or host tier, at what length), so
+the router can turn a prefix miss on the affinity-routed replica into
+ONE peer-pull fetch (``GET /v1/kvchain/<digest>``) instead of a full
+re-prefill.
+
+Freshness discipline: ``sync`` replaces each replica's entries
+WHOLESALE from its latest scrape, and replicas absent from the scrape
+set — departed pods, or pods whose ``/stats`` stopped answering —
+drop out entirely. A stale entry here costs a wasted fetch against a
+dead pod on the latency path, so the index only ever reflects the
+most recent successful scrape, exactly like the router's replica set
+itself.
+
+Digests embed the tenant scope (``codec.chain_digest``), so the index
+needs no scope column to stay isolation-correct: a lookup for one
+scope's digest can only ever name chains published under that scope.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+__all__ = ["FleetPrefixIndex"]
+
+
+class FleetPrefixIndex:
+    """name -> digest -> chain row, rebuilt per discovery poll."""
+
+    def __init__(self) -> None:
+        self._replicas: Dict[str, Dict[str, dict]] = {}
+
+    def sync(self, sections: Dict[str, Optional[dict]]) -> None:
+        """Adopt the latest scrape: ``sections`` maps every CURRENTLY
+        scraped replica name to its ``prefix_index`` /stats section
+        (None when the replica did not report one). Names absent from
+        ``sections`` age out — no tombstones, no TTLs."""
+        fresh: Dict[str, Dict[str, dict]] = {}
+        for name, sec in sections.items():
+            rows = (sec or {}).get("chains") or []
+            by_digest: Dict[str, dict] = {}
+            for row in rows:
+                digest = row.get("digest")
+                if digest and int(row.get("len") or 0) > 0:
+                    by_digest[digest] = row
+            if by_digest:
+                fresh[name] = by_digest
+        self._replicas = fresh
+
+    def holders(self, digest: str,
+                exclude: Optional[str] = None) -> List[Tuple[str, dict]]:
+        """Replicas holding ``digest`` as (name, row), the routed
+        replica excluded (pulling a chain from the replica about to
+        serve the request is a no-op by definition)."""
+        out = []
+        for name, rows in self._replicas.items():
+            if name == exclude:
+                continue
+            row = rows.get(digest)
+            if row is not None:
+                out.append((name, row))
+        return out
+
+    def replica_len(self, name: str, digest: str) -> int:
+        """Token length of ``digest``'s chain on ``name`` (0 = not
+        held) — how the router compares a peer's chain against the
+        routed replica's own warmth before offering a pull."""
+        row = self._replicas.get(name, {}).get(digest)
+        return int(row.get("len") or 0) if row is not None else 0
+
+    def stats(self) -> dict:
+        return {"replicas": len(self._replicas),
+                "chains": sum(len(r) for r in self._replicas.values())}

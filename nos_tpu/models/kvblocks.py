@@ -31,7 +31,7 @@ fuzz thousands of alloc/free/fork/write sequences per second.
 from __future__ import annotations
 
 from collections import deque
-from typing import Deque, Dict, List, Optional, Sequence, Tuple
+from typing import Callable, Deque, Dict, List, Optional, Sequence, Tuple
 
 __all__ = ["BlockAllocator", "PrefixBlockIndex", "ScaleLedger",
            "NoFreeBlocks", "NULL_BLOCK", "blocks_for"]
@@ -203,6 +203,16 @@ class PrefixBlockIndex:
         self._chains: Dict[tuple, List[int]] = {}
         self.hits = 0
         self.tokens_saved = 0
+        # eviction observability + the KV-fabric demotion hook (ISSUE
+        # 17): every evicted chain counts under exactly one tier —
+        # "demote" when ``on_evict`` (the engine's host-tier capture,
+        # called with the chain's key and block ids BEFORE the
+        # refcounts drop, so the arena bytes are still live to read)
+        # accepted it, "drop" otherwise (no hook, hook refused, or
+        # hook failed). evict_lru dropped chains silently before this.
+        self.evicted = {"drop": 0, "demote": 0}
+        self.on_evict: Optional[
+            Callable[[tuple, Tuple[int, ...]], bool]] = None
 
     @property
     def block_count(self) -> int:
@@ -269,8 +279,19 @@ class PrefixBlockIndex:
 
     def _evict_one(self) -> int:
         key = next(iter(self._chains))
+        chain = self._chains.pop(key)
+        tier = "drop"
+        if self.on_evict is not None:
+            # a failed demotion must degrade to the pre-fabric drop,
+            # never abort pressure relief mid-flight
+            try:
+                if self.on_evict(key, tuple(chain)):
+                    tier = "demote"
+            except Exception:
+                tier = "drop"
+        self.evicted[tier] += 1
         freed = 0
-        for b in self._chains.pop(key):
+        for b in chain:
             if self.alloc.decref(b):
                 freed += 1
         return freed
@@ -288,12 +309,18 @@ class PrefixBlockIndex:
         while self._chains:
             self._evict_one()
 
+    def chain_items(self) -> List[Tuple[tuple, List[int]]]:
+        """(key, block ids) snapshot in LRU order, oldest first — the
+        KV-fabric export/snapshot surface (read-only by contract)."""
+        return list(self._chains.items())
+
     def stats(self) -> dict:
         return {"chains": len(self._chains),
                 "blocks": self.block_count,
                 "capacity_blocks": self.max_blocks,
                 "hits": self.hits,
-                "tokens_saved": self.tokens_saved}
+                "tokens_saved": self.tokens_saved,
+                "evicted": dict(self.evicted)}
 
 
 class ScaleLedger:

@@ -44,6 +44,7 @@ from nos_tpu.models.generate import (
     paged_cache_shardings, replicated_logits,
 )
 from nos_tpu.models.handoff import handoff_nbytes
+from nos_tpu.kvfabric.codec import chain_digest, decode_chain, encode_chain
 from nos_tpu.models.kvblocks import (
     BlockAllocator, NoFreeBlocks, PrefixBlockIndex, ScaleLedger,
     blocks_for,
@@ -240,7 +241,8 @@ class DecodeServer:
                  kv_swap: bool = True, hbm_admit_frac: float = 0.0,
                  kv_dtype: str = "bf16",
                  tenant_quota: Optional[TenantQuotaConfig] = None,
-                 tenant_clock=None, role: str = "colocated"):
+                 tenant_clock=None, role: str = "colocated",
+                 host_tier=None):
         if prefill_chunk and (prefill_chunk < 8
                               or prefill_chunk & (prefill_chunk - 1)):
             raise ValueError(
@@ -367,6 +369,30 @@ class DecodeServer:
             self.cache = init_cache(cfg, max_batch, self.max_len,
                                     per_row_pos=True)
             self._scales = None
+        # KV fabric (ISSUE 17): ``host_tier`` is a kvfabric
+        # HostTierStore — the host-RAM tier under the HBM arena.
+        # With it attached, prefix-chain eviction DEMOTES the LRU
+        # chain's swap payload into the store (the PrefixBlockIndex
+        # on_evict hook) instead of dropping it, and a prefix miss
+        # that matches a stored chain PROMOTES it back via the batched
+        # restore scatter, bit-exact. Independent of the tier, a paged
+        # engine with a prefix index can export chains by digest
+        # (export_chain) and adopt a peer replica's payload
+        # (ingest_chain) — the cross-replica migration half.
+        if host_tier is not None and (not self.paged
+                                      or self._pindex is None):
+            raise ValueError(
+                "host_tier requires the paged KV cache with a prefix "
+                "index (kv_blocks/kv_block_size + prefix_cache_size): "
+                "the tier stores demoted prefix chains, which only the "
+                "paged prefix index produces")
+        self._host_tier = host_tier
+        self._fabric = {"demote": 0, "promote": 0,
+                        "ingest": 0, "ingest_rejected": 0}
+        self._digests: Dict[tuple, str] = {}    # chain key -> digest
+        self._blk_nbytes: Optional[int] = None
+        if self._host_tier is not None:
+            self._pindex.on_evict = self._demote_chain
         # blocks freed while decode ticks are still in flight park here
         # until the next barrier/window-drain: an in-flight tick's
         # in-graph writes still target the freeing slot's OLD blocks,
@@ -1423,6 +1449,11 @@ class DecodeServer:
         m, mkey = (self._pindex.match(req.prompt, plen - 1,
                                       self._prefix_scope(req))
                    if self._pindex is not None else (0, None))
+        if self._host_tier is not None:
+            # an HBM miss (or a shorter HBM hit) may still be a host-
+            # tier hit: promote the demoted chain back into the arena
+            # before the profitability/fit checks judge the match
+            m, mkey = self._promote_from_host(req, m, mkey, plen)
         # profitability: block reuse must also save prefill compute
         # (fewer query tokens per bucket tier) — same invariant as the
         # slot-static prefix path
@@ -2100,6 +2131,204 @@ class DecodeServer:
             payload["v_scale"] = np.asarray(self.cache["v_scale"][:, idx])
         return payload
 
+    # ------------------------------------------------------------------
+    # KV fabric (ISSUE 17): host-RAM tier demote/promote under the HBM
+    # arena, plus cross-replica chain export/ingest. Everything below
+    # moves the SAME swap payload preemption and handoff already move
+    # byte-exactly, so tier transitions are bit-exact by construction.
+    # ------------------------------------------------------------------
+    def _demote_chain(self, key: tuple, blocks: Tuple[int, ...]) -> bool:
+        """PrefixBlockIndex.on_evict: offer an evicting chain to the
+        host tier. Runs BEFORE the chain's refcounts drop, so the
+        arena blocks are still live to snapshot. True = demoted (the
+        eviction counts tier="demote"); False falls through to the
+        pre-fabric drop."""
+        scope, tokens = key
+        swap = self._swap_payload(list(blocks), len(blocks))
+        if not self._host_tier.put(scope, tokens, swap):
+            return False
+        self._fabric["demote"] += 1
+        return True
+
+    def _promote_from_host(self, req: _Request, m: int, mkey,
+                           plen: int) -> Tuple[int, Optional[tuple]]:
+        """Admission-time promotion: if the host tier holds a strictly
+        longer prefix of ``req.prompt`` than the HBM index matched,
+        scatter it back into fresh arena blocks, republish it, and
+        re-match. The chain moves tiers (host entry popped); a full
+        pool or mismatched payload leaves the original match
+        untouched — promotion is always best-effort, never required
+        for correctness."""
+        bs = self.kv_block_size
+        scope = self._prefix_scope(req)
+        cap = ((plen - 1) // bs) * bs
+        key = self._host_tier.match(scope, req.prompt, cap)
+        if key is None or len(key[1]) <= m:
+            return m, mkey
+        ent = self._host_tier.get(key)
+        if ent is None or not self._ingest_swap(key[1], ent["swap"],
+                                                scope):
+            return m, mkey
+        self._host_tier.pop(key)
+        self._fabric["promote"] += 1
+        return self._pindex.match(req.prompt, plen - 1, scope)
+
+    def _ingest_swap(self, tokens: tuple, swap: dict,
+                     scope: Optional[str]) -> bool:
+        """Land a chain payload in the arena as a published prefix
+        chain: the adopt-by-scatter restore (bit-exact — the bytes
+        never changed), then ``publish`` so the next match COW-shares
+        it. Allocation never preempts live work for a cache fill —
+        only LRU prefix chains may be reclaimed to make room; False =
+        no room or a mismatched payload, and the caller falls back to
+        plain prefill."""
+        nblk = int(swap.get("nblk") or 0)
+        bs = self.kv_block_size
+        if nblk <= 0 or nblk * bs != len(tokens) \
+                or nblk > self._alloc.capacity:
+            return False
+        # same geometry gate as restore(): a payload from a mismatched
+        # engine (block size, heads, layers, kv_dtype) must never
+        # scatter — it would silently cast or misalign the timeline
+        want = tuple(self.cache["k"].shape[i] for i in (0, 2, 3, 4))
+        got_arr = np.asarray(swap["k"])
+        if want != tuple(got_arr.shape[i] for i in (0, 2, 3, 4)) \
+                or str(self.cache["k"].dtype) != str(got_arr.dtype) \
+                or ("k_scale" in swap) != (self.kv_dtype == "int8"):
+            return False
+        if nblk > self._alloc.free_count:
+            self._pindex.evict_lru(nblk - self._alloc.free_count)
+            if nblk > self._alloc.free_count:
+                return False
+        blocks = self._alloc.alloc_many(nblk)
+        idx = jnp.asarray(blocks, jnp.int32)
+        if "k_scale" in swap:
+            self.cache = self._timed_dispatch(
+                ("restoreblks_q", nblk), self._restore_blocks_q,
+                self.cache, jnp.asarray(swap["k"]),
+                jnp.asarray(swap["v"]), jnp.asarray(swap["k_scale"]),
+                jnp.asarray(swap["v_scale"]), idx)
+        else:
+            self.cache = self._timed_dispatch(
+                ("restoreblks", nblk), self._restore_blocks, self.cache,
+                jnp.asarray(swap["k"]), jnp.asarray(swap["v"]), idx)
+        if self._scales is not None:
+            for phys in blocks:
+                self._scales.note_write(phys)
+        self._pindex.publish(list(tokens), blocks, scope)
+        for b in blocks:    # the index holds its own references now
+            self._alloc.decref(b)
+        return True
+
+    def prefix_scope_for(self, tenant: Optional[str]) -> Optional[str]:
+        """The ``_prefix_scope`` rule for a raw tenant label (no
+        request object yet — the peer-pull ingest path resolves the
+        requester's scope BEFORE any pulled chain enters the cache)."""
+        if not self._prefix_scoped:
+            return None
+        return self._tq.cfg.resolve(tenant)
+
+    def ingest_chain(self, data: bytes, tenant: Optional[str] = None,
+                     expect_digest: Optional[str] = None) -> bool:
+        """Adopt a fabric chain payload pulled from a peer replica.
+        Rejections (counted, never raised — a failed pull falls back
+        to plain prefill): undecodable bytes, a payload scope that is
+        not the requesting tenant's OWN resolved scope (cross-tenant
+        migration barrier), a digest that does not match the payload's
+        recomputed identity, or no arena room."""
+        if not self.paged or self._pindex is None:
+            return False
+        try:
+            state = decode_chain(data)
+        except ValueError:
+            self._fabric["ingest_rejected"] += 1
+            return False
+        scope = state.get("scope")
+        tokens = tuple(int(t) for t in state.get("tokens") or ())
+        if scope != self.prefix_scope_for(tenant) \
+                or (expect_digest is not None
+                    and chain_digest(tokens, scope) != expect_digest) \
+                or not self._ingest_swap(tokens, state["swap"], scope):
+            self._fabric["ingest_rejected"] += 1
+            return False
+        self._fabric["ingest"] += 1
+        return True
+
+    def export_chain(self, digest: str) -> Optional[bytes]:
+        """One chain's fabric payload by fleet-wide digest (the
+        ``GET /v1/kvchain/<digest>`` surface): an HBM chain snapshots
+        through ``_swap_payload`` — the same bytes a demotion would
+        store — and a host-tier chain ships as stored. None = not
+        here (the puller re-prefills; peers' indexes are eventually
+        consistent by design)."""
+        if not self.paged or self._pindex is None:
+            return None
+        for key, chain in self._pindex.chain_items():
+            if self._chain_digest(key) == digest:
+                swap = self._swap_payload(list(chain), len(chain))
+                return encode_chain(key[0], key[1], swap)
+        if self._host_tier is not None:
+            hit = self._host_tier.find(digest)
+            if hit is not None:
+                key, ent = hit
+                return encode_chain(key[0], key[1], ent["swap"])
+        return None
+
+    def _chain_digest(self, key: tuple) -> str:
+        d = self._digests.get(key)
+        if d is None:
+            d = chain_digest(key[1], key[0])
+            self._digests[key] = d
+        return d
+
+    def _chain_block_nbytes(self) -> int:
+        """Host-side bytes one arena block snapshots to (KV planes +
+        scale planes under int8) — sizes the /stats chain rows without
+        materializing any payload."""
+        if self._blk_nbytes is None:
+            tot = 0
+            for name in ("k", "v", "k_scale", "v_scale"):
+                arr = self.cache.get(name)
+                if arr is None:
+                    continue
+                per = arr.dtype.itemsize * arr.shape[0]
+                for d in arr.shape[2:]:
+                    per *= d
+                tot += int(per)
+            self._blk_nbytes = tot
+        return self._blk_nbytes
+
+    def prefix_index_snapshot(self) -> Optional[dict]:
+        """The /stats ``prefix_index`` section the gateway's fleet
+        index consumes: eviction tiers, fabric counters, host-tier
+        occupancy, and every resident chain as (digest, token length,
+        tier, bytes, scope). Present whenever the engine has a paged
+        prefix index — fabric off still reports evictions and HBM
+        chains (the observability half stands alone); None
+        otherwise."""
+        if not self.paged or self._pindex is None:
+            return None
+        per_blk = self._chain_block_nbytes()
+        chains, live = [], set()
+        for key, blks in self._pindex.chain_items():
+            live.add(key)
+            chains.append({"digest": self._chain_digest(key),
+                           "len": len(key[1]), "tier": "hbm",
+                           "nbytes": per_blk * len(blks),
+                           "scope": key[0]})
+        # drop cached digests of evicted chains alongside the snapshot
+        self._digests = {k: v for k, v in self._digests.items()
+                         if k in live}
+        host = None
+        if self._host_tier is not None:
+            host = self._host_tier.stats()
+            for row in self._host_tier.digests():
+                chains.append(dict(row, tier="host"))
+        return {"evicted": dict(self._pindex.evicted),
+                "fabric": dict(self._fabric),
+                "host_tier": host,
+                "chains": chains}
+
     def _resume_draft(self, req: _Request, seq: List[int]) -> None:
         """Hook for engines with sibling caches (the speculative
         engine's draft KV): re-prefill them over ``seq`` alongside a
@@ -2724,6 +2953,11 @@ class DecodeServer:
                  "entries": len(self._prefixes),
                  "hits": self.prefix_hits,
                  "tokens_saved": self.prefix_tokens_saved}),
+            # the KV-fabric surface: chain digests + lengths + tier
+            # (what the gateway's fleet index scrapes), eviction tiers
+            # and host-tier occupancy; None without a paged prefix
+            # index
+            "prefix_index": self.prefix_index_snapshot(),
             # block-pool occupancy + the admission-time HBM snapshot:
             # why a request queued, answerable from one /stats read
             "kv": self.kv_stats(),

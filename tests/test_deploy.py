@@ -348,7 +348,7 @@ def test_serving_deployment_passes_paged_kv_args():
         values = yaml.safe_load(f)
     assert values["serving"]["kv"] == {
         "blockSize": 0, "blocks": 0, "swap": True, "dtype": "bf16",
-        "pagedKernel": True}
+        "pagedKernel": True, "hostTierBytes": 0}
 
 
 def test_serving_deployment_passes_kv_dtype_and_speculative_args():
@@ -410,6 +410,52 @@ def test_serving_deployment_passes_paged_kernel_arg():
     with open(os.path.join(CHART, "README.md")) as f:
         readme = f.read()
     assert "serving.kv.pagedKernel" in readme, "helm README missing row"
+
+
+def test_kv_fabric_knobs_reach_flags_with_code_defaults():
+    """The tiered KV-fabric knobs (ISSUE 17) must land in flags on both
+    planes — serving.kv.hostTierBytes -> --kv-host-tier-bytes on the
+    server, gateway.fabric.enabled/maxBlocks -> --kv-fabric=on|off /
+    --kv-fabric-max-blocks on the gateway — with chart defaults equal
+    to the code defaults (fabric OFF, host tier 0 bytes: the escape
+    hatch is the default) and README rows for discoverability."""
+    spath = os.path.join(CHART, "templates", "serving",
+                         "deployment_server.yaml")
+    with open(spath) as f:
+        stext = f.read()
+    assert "--kv-host-tier-bytes=" in stext, "serving missing flag"
+    assert ".Values.serving.kv.hostTierBytes" in stext
+
+    gpath = os.path.join(CHART, "templates", "gateway",
+                         "deployment_gateway.yaml")
+    with open(gpath) as f:
+        gtext = f.read()
+    assert "--kv-fabric=" in gtext, "gateway missing --kv-fabric"
+    assert 'ternary "on" "off" .Values.gateway.fabric.enabled' in gtext
+    assert "--kv-fabric-max-blocks=" in gtext
+    assert ".Values.gateway.fabric.maxBlocks" in gtext
+
+    with open(os.path.join(CHART, "values.yaml")) as f:
+        values = yaml.safe_load(f)
+    assert values["serving"]["kv"]["hostTierBytes"] == 0
+    assert values["gateway"]["fabric"] == {"enabled": False,
+                                           "maxBlocks": 32}
+    from nos_tpu.cmd.server import ServerConfig
+
+    assert ServerConfig().kv_host_tier_bytes == \
+        values["serving"]["kv"]["hostTierBytes"]
+    from nos_tpu.gateway.router import RouterConfig
+
+    rendered = "on" if values["gateway"]["fabric"]["enabled"] else "off"
+    assert (RouterConfig().fabric is True) == (rendered == "on")
+    assert RouterConfig().fabric_max_blocks == \
+        values["gateway"]["fabric"]["maxBlocks"]
+
+    with open(os.path.join(CHART, "README.md")) as f:
+        readme = f.read()
+    for row in ("serving.kv.hostTierBytes", "gateway.fabric.enabled",
+                "gateway.fabric.maxBlocks"):
+        assert row in readme, f"helm README missing {row} row"
 
 
 def test_serving_deployment_passes_supervisor_and_deadline_args():

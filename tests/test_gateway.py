@@ -1230,3 +1230,166 @@ def test_discovery_parses_role_from_config_echo():
     got = {r.name: r.role for r in disc.poll()}
     assert got == {"pre-0": "prefill", "dec-0": "decode",
                    "co-0": "colocated"}
+
+
+# ---------------------------------------------------------------------------
+# fleet-wide KV fabric (ISSUE 17): the gateway's peer-pull plane
+# ---------------------------------------------------------------------------
+
+def _chain_stats(prompt, bs=16, scope=None, tier="hbm"):
+    """A replica /stats ``prefix_index`` section holding the prompt's
+    full-block chain — the shape serving.prefix_index_snapshot emits."""
+    from nos_tpu.kvfabric import chain_digest
+    n = (len(prompt) // bs) * bs
+    return {"prefix_index": {
+        "chains": [{"digest": chain_digest(prompt[:n], scope),
+                    "len": n, "tier": tier, "nbytes": n * 64,
+                    "scope": scope}]}}
+
+
+def test_fleet_index_ages_out_unscrapable_replicas():
+    """A replica that stops answering /stats (empty snapshot) or
+    leaves the fleet must drop out of the fleet prefix index on the
+    next discovery pass — a stale entry is a wasted fetch against a
+    dead pod on the latency path."""
+    router = GatewayRouter(RouterConfig(fabric=True),
+                           transport=lambda rep, req: req["prompt"])
+    prompt = list(range(32))
+    router.update([
+        Replica(name="a", handle="http://a:8000"),
+        Replica(name="b", handle="http://b:8000",
+                stats=_chain_stats(prompt)),
+    ])
+    assert router.stats()["kv_fabric"]["chains"] == 1
+    # b's /stats stopped answering: discovery hands it empty stats
+    router.update([
+        Replica(name="a", handle="http://a:8000"),
+        Replica(name="b", handle="http://b:8000", stats={}),
+    ])
+    assert router.stats()["kv_fabric"]["chains"] == 0
+    # and a replica absent from discovery entirely ages out too
+    router.update([Replica(name="b", handle="http://b:8000",
+                           stats=_chain_stats(prompt))])
+    assert router.stats()["kv_fabric"]["chains"] == 1
+    router.update([Replica(name="a", handle="http://a:8000")])
+    assert router.stats()["kv_fabric"]["chains"] == 0
+
+
+def test_fabric_attaches_one_peer_pull_offer():
+    """Routed replica cold + a peer warm on the prompt's chain -> the
+    dispatched request carries exactly ONE kv_sources offer naming the
+    peer's /v1/kvchain/<digest>; the transport body forwards it."""
+    from nos_tpu.kvfabric import chain_digest
+    seen = {}
+
+    def transport(rep, req):
+        seen["req"] = req
+        return req["prompt"]
+
+    router = GatewayRouter(RouterConfig(fabric=True),
+                           transport=transport)
+    prompt = list(range(40))                    # 2 full blocks of 16
+    router.update([
+        Replica(name="a", handle="http://a:8000"),
+        Replica(name="b", handle="http://b:8000", draining=True,
+                stats=_chain_stats(prompt, tier="host")),
+    ])
+    _, name, _ = router.dispatch(prompt, 4)
+    assert name == "a"                  # b is draining: never routed
+    digest = chain_digest(prompt[:32])
+    assert seen["req"]["kv_sources"] == [{
+        "url": f"http://b:8000/v1/kvchain/{digest}",
+        "digest": digest, "len": 32, "replica": "b"}]
+    assert router.stats()["kv_fabric"]["offered"] == 1
+    # the HTTP transport forwards the offer in the POST body
+    from nos_tpu.cmd.gateway import HttpReplicaTransport
+    import json as _json
+    request, _ = HttpReplicaTransport()._request(
+        Replica(name="a", handle="http://a:8000"), seen["req"],
+        stream=False)
+    assert _json.loads(request.data)["kv_sources"] == \
+        seen["req"]["kv_sources"]
+
+
+def test_fabric_no_offer_when_routed_replica_is_warmest():
+    calls = []
+    router = GatewayRouter(RouterConfig(fabric=True),
+                           transport=lambda rep, req: calls.append(req)
+                           or req["prompt"])
+    prompt = list(range(40))
+    # the routed replica holds the SAME 2-block chain: nothing to pull
+    router.update([
+        Replica(name="a", handle="http://a:8000",
+                stats=_chain_stats(prompt)),
+        Replica(name="b", handle="http://b:8000", draining=True,
+                stats=_chain_stats(prompt)),
+    ])
+    router.dispatch(prompt, 4)
+    assert "kv_sources" not in calls[-1]
+    # a peer holding only a SHORTER chain than the routed replica's
+    # own is not worth a fetch either
+    router.update([
+        Replica(name="a", handle="http://a:8000",
+                stats=_chain_stats(prompt)),
+        Replica(name="b", handle="http://b:8000", draining=True,
+                stats=_chain_stats(prompt[:16])),
+    ])
+    router.dispatch(prompt, 4)
+    assert "kv_sources" not in calls[-1]
+    assert router.stats()["kv_fabric"]["offered"] == 0
+
+
+def test_fabric_off_attaches_nothing_and_skips_the_index():
+    calls = []
+    router = GatewayRouter(RouterConfig(),        # fabric defaults off
+                           transport=lambda rep, req: calls.append(req)
+                           or req["prompt"])
+    prompt = list(range(40))
+    router.update([
+        Replica(name="a", handle="http://a:8000"),
+        Replica(name="b", handle="http://b:8000", draining=True,
+                stats=_chain_stats(prompt)),
+    ])
+    router.dispatch(prompt, 4)
+    assert "kv_sources" not in calls[-1]
+    snap = router.stats()["kv_fabric"]
+    assert snap == {"replicas": 0, "chains": 0, "enabled": False,
+                    "offered": 0}
+    assert router.stats()["config"]["fabric"] is False
+    assert router.stats()["config"]["fabric_max_blocks"] == 32
+
+
+def test_fabric_offers_are_tenant_scope_exact():
+    """Digests embed the tenant scope: a peer's chain published under
+    another tenant's scope can never be offered to this tenant's
+    request — the lookup key itself differs, isolation needs no
+    filter."""
+    calls = []
+    router = GatewayRouter(
+        RouterConfig(fabric=True, tenant_config=_tenant_cfg()),
+        transport=lambda rep, req: calls.append(req)
+        or req["prompt"])
+    prompt = list(range(40))
+    router.update([
+        Replica(name="a", handle="http://a:8000"),
+        Replica(name="b", handle="http://b:8000", draining=True,
+                stats=_chain_stats(prompt, scope="gold")),
+    ])
+    router.dispatch(prompt, 4, tenant="burst")
+    assert "kv_sources" not in calls[-1]
+    router.dispatch(prompt, 4, tenant="gold")
+    assert calls[-1]["kv_sources"][0]["replica"] == "b"
+
+
+def test_parse_replica_stats_carries_prefix_index():
+    from nos_tpu.fleet.policy import parse_replica_stats
+    sec = _chain_stats(list(range(32)))["prefix_index"]
+    st = parse_replica_stats("r", {"healthy": True,
+                                   "prefix_index": sec})
+    assert st.prefix_index == sec
+    # absent / malformed / unscrapable all read as None
+    assert parse_replica_stats("r", {"healthy": True}).prefix_index \
+        is None
+    assert parse_replica_stats(
+        "r", {"prefix_index": "junk"}).prefix_index is None
+    assert parse_replica_stats("r", None).prefix_index is None

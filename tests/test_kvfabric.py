@@ -1,0 +1,407 @@
+"""Fleet-wide KV fabric (ISSUE 17): tiered prefix cache with host-RAM
+demotion and cross-replica chain migration.
+
+The acceptance invariants this file pins:
+- a demote -> promote round trip is BYTE-identical at the KV-plane
+  level (k/v AND the int8 scale planes — the chain's bytes never
+  change, they only move tiers), and the served tokens stay bit-exact;
+- cross-tenant chains never match nor migrate across scopes: a
+  demoted chain is invisible to other tenants' misses, and an
+  exported chain is rejected on ingest under a different tenant;
+- the host tier is a bounded LRU over payload BYTES: inserting past
+  capacity evicts oldest-first, an entry larger than the whole store
+  is rejected outright;
+- a promotion racing a concurrent decode step on a real paged engine
+  is safe — both the in-flight request and the promoted-prefix
+  request finish bit-identical to generate();
+- export_chain/ingest_chain move a chain between two REAL engines
+  with bit-exact downstream decode, and a digest mismatch rejects.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from nos_tpu.kvfabric import (
+    FleetPrefixIndex, HostTierStore, chain_digest, decode_chain,
+    encode_chain,
+)
+from nos_tpu.models import transformer as tfm
+from nos_tpu.models.generate import generate
+from nos_tpu.models.serving import DecodeServer
+from nos_tpu.models.tenantquota import TenantQuotaConfig, TenantSpec
+
+CFG = tfm.TransformerConfig(vocab=64, d_model=32, n_layers=2, n_heads=4,
+                            n_kv_heads=2, d_ff=64, max_seq=64,
+                            dtype=jnp.float32)
+
+
+@pytest.fixture(scope="module")
+def params():
+    return tfm.init_params(jax.random.PRNGKey(0), CFG)
+
+
+def ref(params, prompt, n):
+    out = generate(params, CFG, jnp.asarray([prompt], jnp.int32), n)
+    return [int(t) for t in out[0]]
+
+
+def fabric_engine(params, host_bytes=1 << 20, prefix_blocks=8, **kw):
+    kw.setdefault("max_batch", 2)
+    kw.setdefault("kv_block_size", 8)
+    kw.setdefault("kv_blocks", 24)
+    kw.setdefault("kv_dtype", "int8")
+    host = HostTierStore(host_bytes) if host_bytes else None
+    eng = DecodeServer(params, CFG, prefix_cache_size=prefix_blocks,
+                       host_tier=host, **kw)
+    return eng, host
+
+
+def swap_bytes(eng, blocks):
+    """The chain's KV planes as raw bytes, per array key — the
+    bytes-pin the tiering must preserve exactly."""
+    swap = eng._swap_payload(list(blocks), len(blocks))
+    return {k: np.asarray(v).tobytes()
+            for k, v in swap.items() if k != "nblk"}
+
+
+def quota(share_prefix=False):
+    return TenantQuotaConfig(
+        tenants={"gold": TenantSpec("gold"),
+                 "burst": TenantSpec("burst")},
+        window_s=8.0, share_prefix=share_prefix)
+
+
+# ---------------------------------------------------------------------------
+# codec
+# ---------------------------------------------------------------------------
+
+def test_chain_digest_embeds_scope():
+    toks = [1, 2, 3, 4]
+    assert chain_digest(toks) == chain_digest(list(toks))
+    assert chain_digest(toks) != chain_digest(toks, "gold")
+    assert chain_digest(toks, "gold") != chain_digest(toks, "burst")
+    # token boundaries are unambiguous: [1, 23] vs [12, 3]
+    assert chain_digest([1, 23]) != chain_digest([12, 3])
+
+
+def test_encode_decode_chain_roundtrip_bytes():
+    rng = np.random.default_rng(0)
+    swap = {
+        "k": rng.integers(-128, 127, (2, 3, 2, 8, 8), dtype=np.int8),
+        "v": rng.integers(-128, 127, (2, 3, 2, 8, 8), dtype=np.int8),
+        "k_scale": rng.random((2, 3, 2, 1, 8), dtype=np.float32),
+        "v_scale": rng.random((2, 3, 2, 1, 8), dtype=np.float32),
+        "nblk": 3,
+    }
+    data = encode_chain("gold", [5, 6, 7], swap)
+    state = decode_chain(data)
+    assert state["scope"] == "gold" and state["tokens"] == [5, 6, 7]
+    for key in ("k", "v", "k_scale", "v_scale"):
+        out = state["swap"][key]
+        assert out.dtype == swap[key].dtype
+        assert out.tobytes() == swap[key].tobytes(), key
+
+
+def test_decode_chain_rejects_foreign_payload():
+    from nos_tpu.models.handoff import encode_handoff
+    blob = encode_handoff({"swap": {"k": np.zeros((1, 1), np.int8)}})
+    with pytest.raises(ValueError):
+        decode_chain(blob)
+
+
+# ---------------------------------------------------------------------------
+# host tier
+# ---------------------------------------------------------------------------
+
+def _swap(n=1, fill=0):
+    return {"k": np.full((2, n, 2, 8, 8), fill, np.int8),
+            "v": np.full((2, n, 2, 8, 8), fill, np.int8),
+            "nblk": n}
+
+
+def test_host_tier_capacity_bound_evicts_lru():
+    one = sum(np.asarray(v).nbytes for k, v in _swap().items()
+              if k != "nblk")
+    store = HostTierStore(2 * one)
+    assert store.put(None, [1] * 8, _swap(fill=1))
+    assert store.put(None, [2] * 8, _swap(fill=2))
+    assert len(store) == 2 and store.nbytes == 2 * one
+    # a read refreshes LRU order: chain 1 becomes most-recent…
+    assert store.match(None, [1] * 8 + [9] * 8, 8) is not None
+    assert store.get((None, tuple([1] * 8))) is not None
+    # …so inserting a third evicts chain 2, not chain 1
+    assert store.put(None, [3] * 8, _swap(fill=3))
+    assert len(store) == 2 and store.nbytes == 2 * one
+    assert store.match(None, [2] * 8, 8) is None
+    assert store.match(None, [1] * 8, 8) is not None
+    assert store.counts["evicted"] == 1
+
+
+def test_host_tier_rejects_oversize_chain():
+    store = HostTierStore(16)           # smaller than any real payload
+    assert not store.put(None, [1] * 8, _swap())
+    assert len(store) == 0 and store.counts["rejected"] == 1
+
+
+def test_host_tier_match_is_scope_filtered():
+    store = HostTierStore(1 << 20)
+    assert store.put("gold", [1] * 8, _swap())
+    assert store.match("gold", [1] * 16, 16) is not None
+    assert store.match("burst", [1] * 16, 16) is None
+    assert store.match(None, [1] * 16, 16) is None
+
+
+def test_host_tier_longest_match_wins():
+    store = HostTierStore(1 << 20)
+    store.put(None, [1] * 8, _swap(1))
+    store.put(None, [1] * 16, _swap(2))
+    key = store.match(None, [1] * 24, 24)
+    assert key is not None and len(key[1]) == 16
+    # cap bounds the usable prefix: only the short chain fits under 8
+    key = store.match(None, [1] * 24, 8)
+    assert key is not None and len(key[1]) == 8
+
+
+# ---------------------------------------------------------------------------
+# fleet index
+# ---------------------------------------------------------------------------
+
+def test_fleet_index_sync_ages_out_missing_replicas():
+    idx = FleetPrefixIndex()
+    row = {"digest": "abc", "len": 16, "tier": "hbm"}
+    idx.sync({"rep-0": {"chains": [row]}, "rep-1": {"chains": [row]}})
+    assert len(idx.holders("abc")) == 2
+    assert idx.holders("abc", exclude="rep-0") == [("rep-1", row)]
+    # rep-1 left the scrape set (departed or unscrapable): aged out
+    idx.sync({"rep-0": {"chains": [row]}})
+    assert [n for n, _ in idx.holders("abc")] == ["rep-0"]
+    # a replica that stops reporting the section ages out too
+    idx.sync({"rep-0": None})
+    assert idx.holders("abc") == []
+    assert idx.stats() == {"replicas": 0, "chains": 0}
+
+
+# ---------------------------------------------------------------------------
+# demote -> promote on a real paged engine
+# ---------------------------------------------------------------------------
+
+def test_demote_promote_roundtrip_byte_identical(params):
+    eng, host = fabric_engine(params, prefix_blocks=1)
+    sys_a, sys_b = [7] * 8, [9] * 8
+    eng.submit(sys_a + [1, 2], 4, cache_prefix=True)
+    eng.drain()
+    key = (None, tuple(sys_a))
+    blocks = dict(eng._pindex.chain_items())[key]
+    before = swap_bytes(eng, blocks)
+    # publishing a second chain into a 1-block cache demotes the first
+    eng.submit(sys_b + [3, 4], 4, cache_prefix=True)
+    eng.drain()
+    assert eng._fabric["demote"] == 1
+    assert eng._pindex.evicted == {"drop": 0, "demote": 1}
+    assert host.match(None, sys_a, 8) == key
+    # a prefix miss on the demoted chain promotes it back, bit-exact
+    out = eng.submit(sys_a + [5, 6], 6)
+    res = eng.drain()
+    assert eng._fabric["promote"] == 1
+    assert host.match(None, sys_a, 8) is None   # one tier at a time
+    assert res[out] == ref(params, sys_a + [5, 6], 6)
+    blocks = dict(eng._pindex.chain_items())[key]
+    after = swap_bytes(eng, blocks)
+    assert set(after) == {"k", "v", "k_scale", "v_scale"}
+    for plane, want in before.items():
+        assert after[plane] == want, f"{plane} changed across tiers"
+
+
+def test_demotion_falls_back_to_drop_without_host_room(params):
+    # a host tier too small for any chain: eviction counts as a drop,
+    # the engine keeps working, nothing is promoted later
+    eng, host = fabric_engine(params, host_bytes=16, prefix_blocks=1)
+    eng.submit([7] * 8 + [1], 3, cache_prefix=True)
+    eng.drain()
+    eng.submit([9] * 8 + [2], 3, cache_prefix=True)
+    eng.drain()
+    assert eng._pindex.evicted == {"drop": 1, "demote": 0}
+    assert len(host) == 0 and host.counts["rejected"] == 1
+    rid = eng.submit([7] * 8 + [1, 2], 4)
+    res = eng.drain()
+    assert eng._fabric["promote"] == 0
+    assert res[rid] == ref(params, [7] * 8 + [1, 2], 4)
+
+
+def test_promote_races_concurrent_decode(params):
+    # the oracle is the SAME int8 engine without any tiering traffic
+    # (int8 KV quantization legitimately drifts from fp32 generate()
+    # over a long decode; the invariant here is that a promotion
+    # landing mid-flight changes NOTHING for either request)
+    sys_a = [7] * 8
+    oracle, _ = fabric_engine(params, host_bytes=0, prefix_blocks=8)
+    oracle.submit(sys_a + [1, 2], 4, cache_prefix=True)
+    oracle.drain()
+    o0 = oracle.submit([4, 5], 24)
+    oracle.step()
+    o1 = oracle.submit(sys_a + [5, 6], 6)
+    want = oracle.drain()
+
+    eng, host = fabric_engine(params, prefix_blocks=1)
+    eng.submit(sys_a + [1, 2], 4, cache_prefix=True)
+    eng.drain()
+    eng.submit([9] * 8 + [3], 4, cache_prefix=True)
+    eng.drain()
+    assert eng._fabric["demote"] == 1
+    # a long request decodes IN FLIGHT while the promote dispatches
+    r0 = eng.submit([4, 5], 24)
+    eng.step()
+    r1 = eng.submit(sys_a + [5, 6], 6)
+    res = eng.drain()
+    assert eng._fabric["promote"] == 1
+    assert res[r0] == want[o0]
+    assert res[r1] == want[o1]
+    # quiescent pool stays balanced after the cross-tier traffic
+    held = eng._pindex.block_count
+    assert eng._alloc.used_count == held
+
+
+def test_bf16_chains_tier_byte_identical(params):
+    # the fabric is dtype-agnostic: no scale planes under bf16, and
+    # the k/v planes still round-trip bit-exact
+    eng, host = fabric_engine(params, prefix_blocks=1, kv_dtype="bf16")
+    sys_a = [7] * 8
+    eng.submit(sys_a + [1], 3, cache_prefix=True)
+    eng.drain()
+    key = (None, tuple(sys_a))
+    before = swap_bytes(eng, dict(eng._pindex.chain_items())[key])
+    assert set(before) == {"k", "v"}
+    eng.submit([9] * 8 + [2], 3, cache_prefix=True)
+    eng.drain()
+    rid = eng.submit(sys_a + [5], 4)
+    res = eng.drain()
+    # promote re-publishes sys_a into the 1-block cache, which in turn
+    # demotes the OTHER chain — the tiers keep trading, nothing drops
+    assert eng._fabric == {"demote": 2, "promote": 1, "ingest": 0,
+                           "ingest_rejected": 0}
+    assert res[rid] == ref(params, sys_a + [5], 4)
+    after = swap_bytes(eng, dict(eng._pindex.chain_items())[key])
+    assert after == before
+
+
+# ---------------------------------------------------------------------------
+# tenant isolation
+# ---------------------------------------------------------------------------
+
+def test_cross_tenant_chains_never_match_nor_migrate(params):
+    eng, host = fabric_engine(params, prefix_blocks=1,
+                              tenant_quota=quota())
+    sys_a = [7] * 8
+    eng.submit(sys_a + [1], 3, cache_prefix=True, tenant="gold")
+    eng.drain()
+    eng.submit([9] * 8 + [2], 3, cache_prefix=True, tenant="gold")
+    eng.drain()
+    assert eng._fabric["demote"] == 1
+    assert host.match("gold", sys_a, 8) is not None
+    # another tenant's identical prompt must NOT promote gold's chain
+    rid = eng.submit(sys_a + [5], 4, tenant="burst")
+    res = eng.drain()
+    assert eng._fabric["promote"] == 0
+    assert host.match("gold", sys_a, 8) is not None  # still gold's
+    assert res[rid] == ref(params, sys_a + [5], 4)
+    # gold's own miss does promote it
+    rid = eng.submit(sys_a + [6], 4, tenant="gold")
+    res = eng.drain()
+    assert eng._fabric["promote"] == 1
+    assert res[rid] == ref(params, sys_a + [6], 4)
+
+
+def test_ingest_rejects_cross_tenant_chain(params):
+    eng, _ = fabric_engine(params, prefix_blocks=4,
+                           tenant_quota=quota())
+    sys_a = [7] * 8
+    eng.submit(sys_a + [1], 3, cache_prefix=True, tenant="gold")
+    eng.drain()
+    digest = chain_digest(sys_a, "gold")
+    blob = eng.export_chain(digest)
+    assert blob is not None
+    peer, _ = fabric_engine(params, prefix_blocks=4,
+                            tenant_quota=quota())
+    # the chain is scoped to gold: adopting it for burst (or for the
+    # unscoped default) would cross the tenant side channel
+    assert not peer.ingest_chain(blob, tenant="burst")
+    assert peer._fabric["ingest_rejected"] == 1
+    assert peer.ingest_chain(blob, tenant="gold")
+    assert peer._fabric["ingest"] == 1
+
+
+# ---------------------------------------------------------------------------
+# cross-engine migration (the peer-pull payload path)
+# ---------------------------------------------------------------------------
+
+def test_export_ingest_between_engines_bit_exact(params):
+    src, _ = fabric_engine(params, prefix_blocks=4)
+    sys_a = [7] * 8
+    src.submit(sys_a + [1, 2], 4, cache_prefix=True)
+    src.drain()
+    digest = chain_digest(sys_a)
+    blob = src.export_chain(digest)
+    assert blob is not None
+    assert src.export_chain("no-such-digest") is None
+
+    dst, _ = fabric_engine(params, prefix_blocks=4)
+    assert dst.ingest_chain(blob, expect_digest=digest)
+    assert dst._fabric["ingest"] == 1
+    # the adopted chain serves a prefix hit with bit-exact output
+    before_saved = dst._pindex.stats()["tokens_saved"]
+    rid = dst.submit(sys_a + [5, 6], 6)
+    res = dst.drain()
+    assert res[rid] == ref(params, sys_a + [5, 6], 6)
+    assert dst._pindex.stats()["tokens_saved"] > before_saved
+    # digest mismatch (corrupt fetch / stale index) rejects cleanly
+    assert not dst.ingest_chain(blob, expect_digest="deadbeef")
+    assert dst._fabric["ingest_rejected"] == 1
+
+
+def test_export_serves_host_tier_chains(params):
+    eng, host = fabric_engine(params, prefix_blocks=1)
+    sys_a = [7] * 8
+    eng.submit(sys_a + [1], 3, cache_prefix=True)
+    eng.drain()
+    eng.submit([9] * 8 + [2], 3, cache_prefix=True)
+    eng.drain()
+    assert host.match(None, sys_a, 8) is not None   # demoted
+    blob = eng.export_chain(chain_digest(sys_a))
+    assert blob is not None                          # host tier serves it
+    state = decode_chain(blob)
+    assert state["scope"] is None and state["tokens"] == sys_a
+    assert state["swap"]["nblk"] == 1
+
+
+# ---------------------------------------------------------------------------
+# /stats prefix_index section
+# ---------------------------------------------------------------------------
+
+def test_prefix_index_snapshot_reports_both_tiers(params):
+    eng, host = fabric_engine(params, prefix_blocks=1)
+    eng.submit([7] * 8 + [1], 3, cache_prefix=True)
+    eng.drain()
+    eng.submit([9] * 8 + [2], 3, cache_prefix=True)
+    eng.drain()
+    snap = eng.stats()["prefix_index"]
+    tiers = {row["digest"]: row["tier"] for row in snap["chains"]}
+    assert tiers == {chain_digest([9] * 8): "hbm",
+                     chain_digest([7] * 8): "host"}
+    for row in snap["chains"]:
+        assert row["len"] == 8 and row["nbytes"] > 0
+    assert snap["evicted"] == {"drop": 0, "demote": 1}
+    assert snap["fabric"]["demote"] == 1
+    assert snap["host_tier"]["chains"] == 1
+    assert snap["host_tier"]["capacity_bytes"] == 1 << 20
+
+
+def test_prefix_index_absent_without_paging(params):
+    eng = DecodeServer(params, CFG, max_batch=2)
+    assert eng.stats()["prefix_index"] is None
+
+
+def test_host_tier_requires_prefix_cache(params):
+    with pytest.raises(ValueError):
+        DecodeServer(params, CFG, max_batch=2, kv_block_size=8,
+                     kv_blocks=24, host_tier=HostTierStore(1 << 20))

@@ -1777,3 +1777,148 @@ def test_adopted_orphan_reaped_and_result_refetchable():
             loop.result(rid2, timeout=5)
     finally:
         loop.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# fleet-wide KV fabric (ISSUE 17): host tier flag, /v1/kvchain, peer pull
+# ---------------------------------------------------------------------------
+
+def test_kv_host_tier_flag_and_validation():
+    """--kv-host-tier-bytes reaches the ServerConfig and build_engine
+    wires a bounded HostTierStore under the paged engine; the knob
+    without its prerequisites is a clean config error (no dead helm
+    values)."""
+    from nos_tpu.cmd import server as server_mod
+    from nos_tpu.cmd.server import build_engine
+
+    seen = {}
+
+    def fake_build(cfg):
+        seen["cfg"] = cfg
+        raise SystemExit(0)          # stop before the serving loop
+
+    real = server_mod.build_engine
+    server_mod.build_engine = fake_build
+    try:
+        with pytest.raises(SystemExit):
+            server_mod.main(["--kv-block-size", "8", "--kv-blocks",
+                             "16", "--kv-host-tier-bytes", "1048576"])
+    finally:
+        server_mod.build_engine = real
+    assert seen["cfg"].kv_host_tier_bytes == 1048576
+    assert ServerConfig().kv_host_tier_bytes == 0       # escape hatch
+
+    with pytest.raises(ValueError, match="host_tier|host-tier|prefix"):
+        build_engine(ServerConfig(**MODEL, kv_host_tier_bytes=1 << 20))
+    with pytest.raises(ValueError, match=">= 0|negative"):
+        build_engine(ServerConfig(**MODEL, kv_host_tier_bytes=-1))
+    eng = build_engine(ServerConfig(**MODEL, bf16=False, max_batch=2,
+                                    kv_block_size=8, kv_blocks=16,
+                                    prefix_cache_size=4,
+                                    kv_host_tier_bytes=1 << 20))
+    assert eng._host_tier is not None
+    assert eng._host_tier.capacity_bytes == 1 << 20
+
+
+def test_kvchain_endpoint_and_peer_pull_over_http():
+    """The full migration hop over real sockets: replica A publishes a
+    prefix chain, GET /v1/kvchain/<digest> serves its codec payload
+    raw, and a /v1/generate on replica B carrying the gateway-shaped
+    kv_sources offer pulls + ingests it before admission — B's served
+    tokens stay bit-identical and its pull ledger records the hit."""
+    from nos_tpu.kvfabric import HostTierStore, chain_digest
+    from nos_tpu.kvfabric.codec import decode_chain
+    from nos_tpu.utils.metrics import default_registry
+
+    mcfg = tfm.TransformerConfig(**MODEL, dtype=jnp.float32)
+    params = tfm.init_params(jax.random.PRNGKey(0), mcfg)
+    scfg = ServerConfig(**MODEL, bf16=False, port=0)
+
+    def serve():
+        eng = DecodeServer(params, mcfg, max_batch=2, kv_block_size=8,
+                           kv_blocks=24, prefix_cache_size=8,
+                           host_tier=HostTierStore(1 << 20))
+        loop = ServingLoop(eng)
+        httpd = make_http_server(scfg, loop)
+        threading.Thread(target=httpd.serve_forever, daemon=True).start()
+        return (f"http://127.0.0.1:{httpd.server_address[1]}", loop,
+                httpd)
+
+    url_a, loop_a, httpd_a = serve()
+    url_b, loop_b, httpd_b = serve()
+    sys_p = [7] * 8
+    try:
+        post(url_a, {"prompt": sys_p + [1, 2], "max_new_tokens": 4,
+                     "cache_prefix": True})
+        digest = chain_digest(sys_p)
+        with urllib.request.urlopen(
+                f"{url_a}/v1/kvchain/{digest}", timeout=30) as r:
+            assert r.headers["Content-Type"] == "application/octet-stream"
+            blob = r.read()
+        assert decode_chain(blob)["tokens"] == sys_p
+        with pytest.raises(urllib.error.HTTPError) as e:
+            urllib.request.urlopen(f"{url_a}/v1/kvchain/feedface",
+                                   timeout=30)
+        assert e.value.code == 404
+
+        offer = {"url": f"{url_a}/v1/kvchain/{digest}",
+                 "digest": digest, "len": len(sys_p)}
+        got = post(url_b, {"prompt": sys_p + [5, 6],
+                           "max_new_tokens": 6, "kv_sources": [offer]})
+        want = [int(x) for x in generate(
+            params, mcfg,
+            jnp.asarray([sys_p + [5, 6]], jnp.int32), 6)[0]]
+        assert got["tokens"] == want
+        assert loop_b.stats()["kv_fabric_pulls"] == {"pull_hit": 1,
+                                                     "pull_miss": 0}
+        rows = loop_b.stats()["prefix_index"]["chains"]
+        assert digest in {row["digest"] for row in rows}
+
+        # a dead peer or stale digest degrades to a plain prefill —
+        # never an error on the request path
+        got = post(url_b, {"prompt": [9] * 8 + [1],
+                           "max_new_tokens": 3,
+                           "kv_sources": [{"url": f"{url_a}/v1/kvchain/"
+                                           "feedface",
+                                           "digest": "feedface"}]})
+        want = [int(x) for x in generate(
+            params, mcfg, jnp.asarray([[9] * 8 + [1]], jnp.int32), 3)[0]]
+        assert got["tokens"] == want
+        assert loop_b.stats()["kv_fabric_pulls"]["pull_miss"] == 1
+
+        text = default_registry().expose()
+        assert 'nos_tpu_serve_kvfabric_total{event="pull_hit"}' in text
+        assert 'nos_tpu_serve_kvfabric_total{event="pull_miss"}' in text
+    finally:
+        for httpd, loop in ((httpd_a, loop_a), (httpd_b, loop_b)):
+            httpd.shutdown()
+            loop.shutdown()
+            httpd.server_close()
+
+
+def test_prefix_evict_counters_mirror_by_tier():
+    """nos_tpu_serve_prefix_evict_total{tier=...} mirrors the engine's
+    eviction ledger — demote vs hbm-drop split — and registers (at
+    zero) whenever a prefix cache exists, fabric on or off."""
+    from nos_tpu.kvfabric import HostTierStore
+    from nos_tpu.utils.metrics import default_registry
+
+    mcfg = tfm.TransformerConfig(**MODEL, dtype=jnp.float32)
+    params = tfm.init_params(jax.random.PRNGKey(0), mcfg)
+    eng = DecodeServer(params, mcfg, max_batch=2, kv_block_size=8,
+                       kv_blocks=24, prefix_cache_size=1,
+                       host_tier=HostTierStore(1 << 20))
+    loop = ServingLoop(eng)
+    try:
+        loop.generate([7] * 8 + [1], 3, cache_prefix=True)
+        # publishing the second chain demotes the first (1-block cache)
+        loop.generate([9] * 8 + [2], 3, cache_prefix=True)
+        assert loop._prefix_evict_seen["demote"] == 1
+        assert loop._prefix_evict_seen["drop"] == 0
+        assert loop._fabric_seen["demote"] == 1
+        text = default_registry().expose()
+        assert 'nos_tpu_serve_prefix_evict_total{tier="demote"}' in text
+        assert 'nos_tpu_serve_prefix_evict_total{tier="drop"}' in text
+        assert 'nos_tpu_serve_kvfabric_total{event="demote"}' in text
+    finally:
+        loop.shutdown()
