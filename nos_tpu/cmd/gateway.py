@@ -54,6 +54,7 @@ from nos_tpu.gateway import (
     GatewayRouter, PodDiscovery, Replica, ReplicaUnreachable,
     RouterConfig,
 )
+from nos_tpu.kvfabric import FABRIC_TOKEN_HEADER
 from nos_tpu.kube.apiserver import NotFound
 from nos_tpu.kube.client import Client
 from nos_tpu.kube.objects import ConfigMap, ObjectMeta
@@ -73,8 +74,10 @@ class HttpReplicaTransport:
     the serving-plane error taxonomy the router retries through. The
     remaining deadline budget travels as ``X-Request-Deadline-S``."""
 
-    def __init__(self, timeout_s: float = 300.0):
+    def __init__(self, timeout_s: float = 300.0,
+                 fabric_token: str = ""):
         self.timeout_s = timeout_s
+        self.fabric_token = fabric_token or ""
 
     def _request(self, replica: Replica, req: dict, stream: bool):
         if not replica.handle:
@@ -84,13 +87,20 @@ class HttpReplicaTransport:
         body = dict(req["sampling"])
         body["prompt"] = req["prompt"]
         body["max_new_tokens"] = req["max_new_tokens"]
-        if req.get("kv_sources"):
-            # KV-fabric peer-pull offer: the replica fetches the named
-            # peer chain before admitting the request (best-effort)
-            body["kv_sources"] = req["kv_sources"]
         if stream:
             body["stream"] = True
         headers = {"Content-Type": "application/json"}
+        if req.get("kv_sources"):
+            # KV-fabric peer-pull offer: the replica fetches the named
+            # peer chain before admitting the request (best-effort).
+            # Stamped with the fleet's shared fabric token — replicas
+            # drop tokenless offers, because an offer steers their
+            # outbound fetcher and seeds their prefix cache (only the
+            # gateway may attach one; client-supplied kv_sources are
+            # stripped at the door)
+            body["kv_sources"] = req["kv_sources"]
+            if self.fabric_token:
+                headers[FABRIC_TOKEN_HEADER] = self.fabric_token
         if req.get("deadline_s") is not None:
             headers["X-Request-Deadline-S"] = f"{req['deadline_s']:.3f}"
         timeout = self.timeout_s
@@ -424,6 +434,12 @@ def make_http_server(router: GatewayRouter, port: int,
                                   self.headers.get("X-Tenant"))
                 if tenant is not None:
                     tenant = validate_tenant_name(str(tenant))
+                # kv_sources is fleet-internal (the router attaches
+                # its own offers, token-stamped): a client-supplied
+                # one would steer a replica's outbound fetcher (blind
+                # SSRF) and seed its prefix cache (poisoning) —
+                # stripped, never forwarded
+                body.pop("kv_sources", None)
                 # every remaining body key forwards verbatim — the
                 # replica owns validation of its own wire surface
                 if stream:
@@ -569,6 +585,14 @@ def main(argv: Optional[Sequence[str]] = None) -> None:
              "enumerates chain digests for per dispatch (cost is one "
              "digest per block, longest-first)")
     parser.add_argument(
+        "--kv-fabric-token", default="",
+        help="shared fleet secret stamped (as X-NOS-KV-Fabric-Token) "
+             "on dispatches carrying kv_sources offers — replicas "
+             "drop tokenless offers and refuse tokenless "
+             "/v1/kvchain exports, so --kv-fabric=on requires it; "
+             "set the SAME value on every replica's "
+             "--kv-fabric-token")
+    parser.add_argument(
         "--retry-attempts", type=int, default=12,
         help="dispatch attempts per request before failing it")
     parser.add_argument(
@@ -578,10 +602,16 @@ def main(argv: Optional[Sequence[str]] = None) -> None:
         "--request-timeout", type=float, default=300.0,
         help="per-attempt replica HTTP timeout in seconds")
     args = parser.parse_args(argv)
+    if args.kv_fabric == "on" and not args.kv_fabric_token:
+        # a tokenless fabric is a silent no-op: every replica drops
+        # tokenless kv_sources offers — fail loud at startup instead
+        parser.error("--kv-fabric=on requires --kv-fabric-token "
+                     "(replicas ignore tokenless peer-pull offers)")
 
     serve.setup_observability(args)
     client = Client(serve.connect(args))
-    transport = HttpReplicaTransport(timeout_s=args.request_timeout)
+    transport = HttpReplicaTransport(timeout_s=args.request_timeout,
+                                     fabric_token=args.kv_fabric_token)
     stamper = AnnotationStamper(client, args.fleet,
                                 args.namespace).start()
     router = GatewayRouter(

@@ -38,6 +38,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional, Sequence
 
 from nos_tpu.cmd.serve import metrics_payload
+from nos_tpu.kvfabric import FABRIC_TOKEN_HEADER  # jax-free plane
 from nos_tpu.models.errors import (  # jax-free module: keeps this file
     DeadlineExceeded, DeadlineUnmeetable, EngineRecovering, Infeasible,
     QueueFull,                       # importable without jax
@@ -225,6 +226,18 @@ class ServerConfig:
     # durable). Size it a few multiples of the hot system prompts'
     # payload bytes; the demotion ladder is HBM -> host -> drop.
     kv_host_tier_bytes: int = 0
+    # shared fleet secret gating the KV fabric's HTTP surfaces ("" =
+    # fabric HTTP disabled): a replica only HONORS a kv_sources
+    # peer-pull offer and only SERVES GET /v1/kvchain/<digest> when
+    # the request carries this value in the X-NOS-KV-Fabric-Token
+    # header. kv_sources steers the replica's outbound fetcher and
+    # seeds its prefix cache, and chain digests are public arithmetic
+    # over scope + tokens — without the gate, any client reaching the
+    # serving port gets blind SSRF, cross-tenant KV exfiltration and
+    # prefix-cache poisoning. Set the SAME value on every replica and
+    # on the gateway (--kv-fabric-token); the host tier itself
+    # (demote/promote on this replica) needs no token.
+    kv_fabric_token: str = ""
     # speculative decoding (draft_checkpoint_dir set = on): a smaller
     # draft model proposes draft_n_tokens per tick, the target verifies
     # them in one wide forward. Greedy requests stay bit-identical to
@@ -368,7 +381,8 @@ class ServingLoop:
                  handoff_targets: Optional[list] = None,
                  handoff_send=None,
                  handoff_cooldown_s: float = 5.0,
-                 adopt_ttl_s: float = 600.0):
+                 adopt_ttl_s: float = 600.0,
+                 fabric_token: str = ""):
         reg = default_registry()
         # register() is idempotent per (name, type, labels) and raises on
         # a mismatched re-registration — exactly what we want at startup
@@ -491,9 +505,11 @@ class ServingLoop:
                 "promote = chain scattered back into the arena on a "
                 "prefix miss, bit-exact; pull_hit / pull_miss = "
                 "gateway-offered peer chains adopted vs failed/"
-                "rejected)",
+                "rejected; pull_denied = kv_sources offers without "
+                "the fleet's fabric token, never honored)",
                 ("event",))
-            for ev in ("demote", "promote", "pull_hit", "pull_miss"):
+            for ev in ("demote", "promote", "pull_hit", "pull_miss",
+                       "pull_denied"):
                 self.m_kvfabric.labels(ev).inc(0)
         # speculative decoding (registered only on a speculative
         # engine — a plain decode server must not export dead zero
@@ -774,8 +790,16 @@ class ServingLoop:
         # bytes) so tests/benches pull chains without a socket; None =
         # the urllib default in _fetch_chain_bytes. Pull outcomes are
         # loop-side counters (the engine only sees decoded payloads).
+        # Pulls are single-flight per digest (_pull_inflight): a burst
+        # of requests sharing one cold prefix rides the first fetch
+        # instead of thundering-herding the peer's export path.
         self.chain_fetch = None
-        self._pull_counts = {"pull_hit": 0, "pull_miss": 0}
+        self.chain_fetch_timeout_s = 2.0
+        self.fabric_token = fabric_token or ""
+        self._pull_lock = threading.Lock()
+        self._pull_inflight: dict = {}      # digest -> flight record
+        self._pull_counts = {"pull_hit": 0, "pull_miss": 0,
+                             "pull_denied": 0}
         for outcome in OUTCOMES:        # export 0s, not absent series
             self.m_requests.labels(outcome).inc(0)
         self._mirror_engine_gauges()
@@ -1829,9 +1853,25 @@ class ServingLoop:
     def export_chain(self, digest: str) -> Optional[bytes]:
         """KV-fabric peer-pull serve (GET /v1/kvchain/<digest>): one
         chain's codec payload from this replica's HBM prefix index or
-        host tier, or None. The HBM snapshot runs under the loop lock
-        — chain blocks are never written in place (COW), so the
-        gathered bytes are stable even between decode ticks."""
+        host tier, or None. The loop lock is held only for the chain
+        lookup + async gather ENQUEUE (export_chain_begin); the
+        blocking device->host copy and npz encode of a multi-megabyte
+        payload run OUTSIDE it, so concurrent peer pulls never stall
+        decode ticks or admission on this replica. The gather reads
+        the arena version current at enqueue (chain blocks are COW,
+        never written in place), so the released lock cannot skew the
+        snapshot."""
+        begin = getattr(self.engine, "export_chain_begin", None)
+        if begin is not None:
+            with self._work:
+                if self._failed is not None or self._recovering:
+                    return None
+                handle = begin(digest)
+            if handle is None:
+                return None
+            return self.engine.export_chain_finish(handle)
+        # stub engines without the two-phase surface: whole export
+        # under the lock, as before
         export = getattr(self.engine, "export_chain", None)
         if export is None:
             return None
@@ -1840,49 +1880,111 @@ class ServingLoop:
                 return None
             return export(digest)
 
-    def _fetch_chain_bytes(self, url: str, timeout_s: float = 5.0
+    def _fetch_chain_bytes(self, url: str, timeout_s: float = 2.0
                            ) -> bytes:
+        import urllib.parse
         import urllib.request
-        with urllib.request.urlopen(url, timeout=timeout_s) as resp:
+        if urllib.parse.urlsplit(url).scheme not in ("http", "https"):
+            # an offer names a fleet peer's HTTP surface and nothing
+            # else — file:// and friends must never reach urlopen
+            raise ValueError(f"kvchain fetch: non-http url {url!r}")
+        req = urllib.request.Request(url)
+        if self.fabric_token:
+            # peer /v1/kvchain exports are token-gated (fleet-internal)
+            req.add_header(FABRIC_TOKEN_HEADER, self.fabric_token)
+        with urllib.request.urlopen(req, timeout=timeout_s) as resp:
             if resp.status != 200:
                 raise RuntimeError(f"kvchain fetch {url}: {resp.status}")
             return resp.read()
 
-    def prefetch_chain(self, sources, tenant: Optional[str] = None
-                       ) -> bool:
+    def note_pull_denied(self) -> None:
+        """A kv_sources offer arrived without the fleet's fabric token
+        (or none is configured): never honored — the offer steers this
+        replica's outbound fetcher and seeds its prefix cache, so a
+        client-supplied one is blind SSRF plus cache poisoning.
+        Counted so operators can see misconfigured (or probing)
+        callers."""
+        self._count_pull("pull_denied")
+
+    def _count_pull(self, ev: str) -> None:
+        self._pull_counts[ev] += 1
+        if hasattr(self, "m_kvfabric"):
+            self.m_kvfabric.labels(ev).inc()
+
+    def prefetch_chain(self, sources, tenant: Optional[str] = None,
+                       deadline_s: Optional[float] = None) -> bool:
         """Best-effort adoption of gateway-offered peer chains BEFORE
         a request submits: fetch the codec payload from the named peer
         (outside the loop lock — a slow peer must not stall the
         serving loop), then ingest it under the lock so the request's
-        own prefix match hits warm. Every failure path returns False
-        (counted pull_miss) and the request simply prefills — the
-        fabric is an accelerator, never a dependency."""
+        own prefix match hits warm. Offers without a digest are
+        ignored (the digest binds the pull to one (scope, tokens)
+        identity — ingest re-checks it against the decoded payload).
+        Every failure path returns False (counted pull_miss) and the
+        request simply prefills — the fabric is an accelerator, never
+        a dependency."""
         ok = False
         for src in sources if isinstance(sources, list) else ():
             if not isinstance(src, dict):
                 continue
             url, digest = src.get("url"), src.get("digest")
-            if not isinstance(url, str) or not url:
+            if not isinstance(url, str) or not url \
+                    or not isinstance(digest, str) or not digest:
                 continue
-            try:
-                fetch = self.chain_fetch or self._fetch_chain_bytes
-                data = fetch(url)
-                with self._work:
-                    if self._failed is not None or self._recovering:
-                        raise RuntimeError("loop not serving")
-                    adopted = self.engine.ingest_chain(
-                        data, tenant,
-                        expect_digest=digest
-                        if isinstance(digest, str) else None)
-            except Exception as exc:
-                logger.debug("kvfabric pull failed: %s", exc)
-                adopted = False
-            ev = "pull_hit" if adopted else "pull_miss"
-            self._pull_counts[ev] += 1
-            if hasattr(self, "m_kvfabric"):
-                self.m_kvfabric.labels(ev).inc()
+            adopted = self._pull_single_flight(url, digest, tenant,
+                                               deadline_s)
+            self._count_pull("pull_hit" if adopted else "pull_miss")
             ok = ok or adopted
         return ok
+
+    def _pull_single_flight(self, url: str, digest: str,
+                            tenant: Optional[str],
+                            deadline_s: Optional[float]) -> bool:
+        """One fetch+ingest per digest at a time: concurrent requests
+        sharing the same cold prefix ride the leader's pull — when it
+        lands, the chain is in the local index and every rider's own
+        prefix match hits warm (re-fetching the identical payload
+        would only hammer the peer's export path)."""
+        with self._pull_lock:
+            flight = self._pull_inflight.get(digest)
+            leader = flight is None
+            if leader:
+                flight = {"done": threading.Event(), "adopted": False}
+                self._pull_inflight[digest] = flight
+        if not leader:
+            flight["done"].wait(
+                timeout=self.chain_fetch_timeout_s + 5.0)
+            return flight["adopted"]
+        try:
+            flight["adopted"] = self._pull_once(url, digest, tenant,
+                                                deadline_s)
+        finally:
+            with self._pull_lock:
+                self._pull_inflight.pop(digest, None)
+            flight["done"].set()
+        return flight["adopted"]
+
+    def _pull_once(self, url: str, digest: str,
+                   tenant: Optional[str],
+                   deadline_s: Optional[float]) -> bool:
+        timeout = self.chain_fetch_timeout_s
+        if deadline_s is not None:
+            # never spend more of the request's own completion budget
+            # waiting on a peer than the budget itself allows
+            timeout = max(0.1, min(timeout, float(deadline_s)))
+        try:
+            if self.chain_fetch is not None:
+                data = self.chain_fetch(url)
+            else:
+                data = self._fetch_chain_bytes(url, timeout_s=timeout)
+            with self._work:
+                if self._failed is not None or self._recovering:
+                    raise RuntimeError("loop not serving")
+                return bool(self.engine.ingest_chain(
+                    data, tenant, expect_digest=digest))
+        except Exception as exc:
+            logger.debug("kvfabric pull failed: %s", exc)
+            return False
 
     def watch(self, rid: int, timeout: float = 300.0):
         """Attach to an adopted request's token stream (the decode-side
@@ -2694,7 +2796,19 @@ def make_http_server(cfg: ServerConfig, loop: ServingLoop
                 # JSON — it IS the handoff wire format) from this
                 # replica's HBM index or host tier. 404 means the
                 # chain aged out since the gateway's last /stats
-                # scrape; the puller just prefills.
+                # scrape; the puller just prefills. Fleet-internal:
+                # only peer replicas ever call this, and chain digests
+                # are public arithmetic over scope + tokens, so an
+                # ungated export would hand any client another
+                # tenant's KV bytes plus a 200-vs-404 cache-residency
+                # oracle (the ISSUE 13 side channel) — hence the
+                # shared-token gate, closed when no token is set.
+                if not cfg.kv_fabric_token or self.headers.get(
+                        FABRIC_TOKEN_HEADER) != cfg.kv_fabric_token:
+                    self._reply(403, {"error": "kv fabric token "
+                                      "required",
+                                      "reason": "fabric_token"})
+                    return
                 digest = self.path.rsplit("/", 1)[1].split("?")[0]
                 try:
                     data = loop.export_chain(digest)
@@ -2869,8 +2983,19 @@ def make_http_server(cfg: ServerConfig, loop: ServingLoop
                     # so this request's prefix match hits warm.
                     # Best-effort by design — any failure just means a
                     # normal prefill (prefetch_chain never raises).
-                    loop.prefetch_chain(body["kv_sources"],
-                                        sampling.get("tenant"))
+                    # Honored ONLY with the fleet's shared fabric
+                    # token: an offer steers this replica's outbound
+                    # fetcher (SSRF) and seeds its prefix cache
+                    # (poisoning), so client-supplied ones are counted
+                    # and dropped — the gateway strips the field from
+                    # client bodies and stamps the token on its own.
+                    if cfg.kv_fabric_token and self.headers.get(
+                            FABRIC_TOKEN_HEADER) == cfg.kv_fabric_token:
+                        loop.prefetch_chain(
+                            body["kv_sources"], sampling.get("tenant"),
+                            deadline_s=sampling.get("deadline_s"))
+                    else:
+                        loop.note_pull_denied()
                 if cfg.role == "prefill":
                     # prefill role: the answer is a handoff descriptor
                     # ({"handoff": {"target", "rid"}}) the gateway
@@ -3009,6 +3134,15 @@ def main(argv: Optional[Sequence[str]] = None) -> None:
              "Also backs GET /v1/kvchain/<digest> so gateway peer "
              "pulls can warm other replicas from this tier")
     parser.add_argument(
+        "--kv-fabric-token", default=None,
+        help="shared fleet secret gating the KV fabric's HTTP "
+             "surfaces (empty = disabled [default]; overrides "
+             "config): kv_sources peer-pull offers are only honored "
+             "and GET /v1/kvchain/<digest> only served when the "
+             "request's X-NOS-KV-Fabric-Token header matches. Set "
+             "the SAME value on every replica and on the gateway's "
+             "--kv-fabric-token")
+    parser.add_argument(
         "--paged-kernel", choices=("on", "off"), default=None,
         help="paged attention formulation (overrides config): on "
              "[default] = the fused Pallas kernel for every query "
@@ -3119,6 +3253,8 @@ def main(argv: Optional[Sequence[str]] = None) -> None:
         cfg.kv_dtype = args.kv_dtype
     if args.kv_host_tier_bytes is not None:
         cfg.kv_host_tier_bytes = args.kv_host_tier_bytes
+    if args.kv_fabric_token is not None:
+        cfg.kv_fabric_token = args.kv_fabric_token
     if args.paged_kernel is not None:
         cfg.paged_kernel = args.paged_kernel
     if args.role is not None:
@@ -3195,6 +3331,7 @@ def main(argv: Optional[Sequence[str]] = None) -> None:
         watchdog_s=cfg.watchdog_s,
         default_deadline_s=cfg.default_deadline_s, seed=cfg.seed,
         tenant_quota=tenant_quota,
+        fabric_token=cfg.kv_fabric_token,
         # /stats config echo: what the fleet controller compares across
         # replicas to catch config drift between scrapes
         config_echo={
@@ -3208,6 +3345,11 @@ def main(argv: Optional[Sequence[str]] = None) -> None:
             # host-tier capacity drifting between replicas would skew
             # the gateway's peer-pull economics — same drift detector
             "kv_host_tier_bytes": cfg.kv_host_tier_bytes,
+            # whether the fabric HTTP surfaces are token-gated open —
+            # a BOOLEAN, never the secret itself: one tokenless
+            # replica silently dropping every peer pull is exactly
+            # the config drift the echo exists to catch
+            "kv_fabric_auth": bool(cfg.kv_fabric_token),
             # kernel drift between replicas would make decode numerics
             # replica-dependent (online-softmax vs gather formulation)
             # — surface it in the same drift detector as every knob

@@ -27,14 +27,21 @@ itself embeds the scope (two tenants' identical prompts can never
 collide), and the ingest path re-derives the requester's scope before
 any pulled chain enters a cache — cross-replica migration never
 crosses tenant scopes.
+
+The fabric's HTTP surfaces are fleet-internal: a replica only honors a
+``kv_sources`` offer and only serves ``GET /v1/kvchain/<digest>`` when
+the request carries the fleet's shared ``--kv-fabric-token`` secret in
+``FABRIC_TOKEN_HEADER`` — the gateway strips client-supplied offers at
+the door and stamps the token on its own.
 """
 from nos_tpu.kvfabric.codec import (
-    chain_digest, chain_nbytes, decode_chain, encode_chain,
+    FABRIC_TOKEN_HEADER, chain_digest, chain_nbytes, decode_chain,
+    encode_chain,
 )
 from nos_tpu.kvfabric.fleet import FleetPrefixIndex
 from nos_tpu.kvfabric.hosttier import HostTierStore
 
 __all__ = [
-    "FleetPrefixIndex", "HostTierStore", "chain_digest", "chain_nbytes",
-    "decode_chain", "encode_chain",
+    "FABRIC_TOKEN_HEADER", "FleetPrefixIndex", "HostTierStore",
+    "chain_digest", "chain_nbytes", "decode_chain", "encode_chain",
 ]

@@ -29,7 +29,19 @@ from nos_tpu.models.handoff import (
     decode_handoff, encode_handoff, handoff_nbytes,
 )
 
-__all__ = ["chain_digest", "chain_nbytes", "decode_chain", "encode_chain"]
+__all__ = ["FABRIC_TOKEN_HEADER", "chain_digest", "chain_nbytes",
+           "decode_chain", "encode_chain"]
+
+# The fleet-internal trust marker for the fabric's HTTP surfaces: the
+# gateway stamps it on dispatches carrying ``kv_sources`` offers, and
+# replicas require it both to HONOR an offer (kv_sources steers the
+# replica's outbound fetcher and seeds its prefix cache — a client-
+# supplied offer would be blind SSRF plus cache poisoning) and to
+# SERVE ``GET /v1/kvchain/<digest>`` (digests are public arithmetic
+# over scope + tokens, so an open export would hand any client another
+# tenant's KV bytes and a cache-residency oracle). The value is the
+# shared ``--kv-fabric-token`` secret.
+FABRIC_TOKEN_HEADER = "X-NOS-KV-Fabric-Token"
 
 
 def chain_digest(tokens: Sequence[int], scope: Optional[str] = None) -> str:

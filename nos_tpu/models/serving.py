@@ -2155,10 +2155,8 @@ class DecodeServer:
         """Admission-time promotion: if the host tier holds a strictly
         longer prefix of ``req.prompt`` than the HBM index matched,
         scatter it back into fresh arena blocks, republish it, and
-        re-match. The chain moves tiers (host entry popped); a full
-        pool or mismatched payload leaves the original match
-        untouched — promotion is always best-effort, never required
-        for correctness."""
+        re-match. The chain moves tiers (host entry popped); promotion
+        is always best-effort, never required for correctness."""
         bs = self.kv_block_size
         scope = self._prefix_scope(req)
         cap = ((plen - 1) // bs) * bs
@@ -2166,11 +2164,17 @@ class DecodeServer:
         if key is None or len(key[1]) <= m:
             return m, mkey
         ent = self._host_tier.get(key)
-        if ent is None or not self._ingest_swap(key[1], ent["swap"],
-                                                scope):
+        if ent is None:
             return m, mkey
-        self._host_tier.pop(key)
-        self._fabric["promote"] += 1
+        if self._ingest_swap(key[1], ent["swap"], scope):
+            self._host_tier.pop(key)
+            self._fabric["promote"] += 1
+        # re-match after ANY ingest attempt, success or failure: a
+        # FAILED ingest may still have run evict_lru making room, and
+        # that eviction can take mkey's own chain with it (a shared
+        # chain's blocks free nothing, so the sweep can empty the
+        # index and still come up short) — returning the pre-eviction
+        # (m, mkey) would hand take() a key the index no longer holds
         return self._pindex.match(req.prompt, plen - 1, scope)
 
     def _ingest_swap(self, tokens: tuple, swap: dict,
@@ -2254,25 +2258,59 @@ class DecodeServer:
         self._fabric["ingest"] += 1
         return True
 
-    def export_chain(self, digest: str) -> Optional[bytes]:
-        """One chain's fabric payload by fleet-wide digest (the
-        ``GET /v1/kvchain/<digest>`` surface): an HBM chain snapshots
-        through ``_swap_payload`` — the same bytes a demotion would
-        store — and a host-tier chain ships as stored. None = not
-        here (the puller re-prefills; peers' indexes are eventually
-        consistent by design)."""
+    def export_chain_begin(self, digest: str) -> Optional[tuple]:
+        """Phase 1 of a peer-pull export (runs under the serving-loop
+        lock): locate ``digest``'s chain and ENQUEUE the device gather
+        of its blocks. jax dispatch is asynchronous, so this returns
+        as soon as the gather is on the stream — the gather reads the
+        arena version current at enqueue (chain blocks are COW, never
+        written in place, and later cache updates produce new
+        buffers), so the snapshot is stable no matter what decodes
+        after the lock drops. A host-tier hit returns its stored host
+        payload directly. Returns an opaque handle for
+        ``export_chain_finish``, or None (not here — the puller
+        re-prefills; peers' indexes are eventually consistent by
+        design)."""
         if not self.paged or self._pindex is None:
             return None
         for key, chain in self._pindex.chain_items():
             if self._chain_digest(key) == digest:
-                swap = self._swap_payload(list(chain), len(chain))
-                return encode_chain(key[0], key[1], swap)
+                idx = jnp.asarray(chain, jnp.int32)
+                swap = {"nblk": len(chain),
+                        "k": self.cache["k"][:, idx],
+                        "v": self.cache["v"][:, idx]}
+                if self.kv_dtype == "int8":
+                    swap["k_scale"] = self.cache["k_scale"][:, idx]
+                    swap["v_scale"] = self.cache["v_scale"][:, idx]
+                return key[0], key[1], swap
         if self._host_tier is not None:
             hit = self._host_tier.find(digest)
             if hit is not None:
                 key, ent = hit
-                return encode_chain(key[0], key[1], ent["swap"])
+                return key[0], key[1], ent["swap"]
         return None
+
+    @staticmethod
+    def export_chain_finish(handle: tuple) -> bytes:
+        """Phase 2 (safe OUTSIDE the loop lock): the blocking
+        device->host fetch of the gathered planes plus npz encoding —
+        the multi-megabyte part of an export, off the serving loop's
+        critical section."""
+        scope, tokens, swap = handle
+        out = {k: (v if isinstance(v, (int, np.ndarray))
+                   else np.asarray(v)) for k, v in swap.items()}
+        return encode_chain(scope, tokens, out)
+
+    def export_chain(self, digest: str) -> Optional[bytes]:
+        """One chain's fabric payload by fleet-wide digest (the
+        ``GET /v1/kvchain/<digest>`` surface): an HBM chain snapshots
+        the same bytes a demotion would store, a host-tier chain ships
+        as stored. Begin + finish in one call, for callers with no
+        lock to shed."""
+        handle = self.export_chain_begin(digest)
+        if handle is None:
+            return None
+        return self.export_chain_finish(handle)
 
     def _chain_digest(self, key: tuple) -> str:
         d = self._digests.get(key)

@@ -348,7 +348,7 @@ def test_serving_deployment_passes_paged_kv_args():
         values = yaml.safe_load(f)
     assert values["serving"]["kv"] == {
         "blockSize": 0, "blocks": 0, "swap": True, "dtype": "bf16",
-        "pagedKernel": True, "hostTierBytes": 0}
+        "pagedKernel": True, "hostTierBytes": 0, "fabricToken": ""}
 
 
 def test_serving_deployment_passes_kv_dtype_and_speculative_args():
@@ -425,6 +425,10 @@ def test_kv_fabric_knobs_reach_flags_with_code_defaults():
         stext = f.read()
     assert "--kv-host-tier-bytes=" in stext, "serving missing flag"
     assert ".Values.serving.kv.hostTierBytes" in stext
+    # the fleet fabric secret renders only when set (no empty-string
+    # flag noise) on BOTH planes
+    assert "--kv-fabric-token=" in stext
+    assert "{{- if .Values.serving.kv.fabricToken }}" in stext
 
     gpath = os.path.join(CHART, "templates", "gateway",
                          "deployment_gateway.yaml")
@@ -434,12 +438,16 @@ def test_kv_fabric_knobs_reach_flags_with_code_defaults():
     assert 'ternary "on" "off" .Values.gateway.fabric.enabled' in gtext
     assert "--kv-fabric-max-blocks=" in gtext
     assert ".Values.gateway.fabric.maxBlocks" in gtext
+    assert "--kv-fabric-token=" in gtext
+    assert "{{- if .Values.gateway.fabric.token }}" in gtext
 
     with open(os.path.join(CHART, "values.yaml")) as f:
         values = yaml.safe_load(f)
     assert values["serving"]["kv"]["hostTierBytes"] == 0
+    assert values["serving"]["kv"]["fabricToken"] == ""
     assert values["gateway"]["fabric"] == {"enabled": False,
-                                           "maxBlocks": 32}
+                                           "maxBlocks": 32,
+                                           "token": ""}
     from nos_tpu.cmd.server import ServerConfig
 
     assert ServerConfig().kv_host_tier_bytes == \
@@ -453,8 +461,9 @@ def test_kv_fabric_knobs_reach_flags_with_code_defaults():
 
     with open(os.path.join(CHART, "README.md")) as f:
         readme = f.read()
-    for row in ("serving.kv.hostTierBytes", "gateway.fabric.enabled",
-                "gateway.fabric.maxBlocks"):
+    for row in ("serving.kv.hostTierBytes", "serving.kv.fabricToken",
+                "gateway.fabric.enabled", "gateway.fabric.maxBlocks",
+                "gateway.fabric.token"):
         assert row in readme, f"helm README missing {row} row"
 
 

@@ -1301,14 +1301,30 @@ def test_fabric_attaches_one_peer_pull_offer():
         "url": f"http://b:8000/v1/kvchain/{digest}",
         "digest": digest, "len": 32, "replica": "b"}]
     assert router.stats()["kv_fabric"]["offered"] == 1
-    # the HTTP transport forwards the offer in the POST body
+    # the HTTP transport forwards the offer in the POST body and
+    # stamps the fleet's fabric token on it (replicas drop tokenless
+    # offers); the token never rides requests WITHOUT an offer
     from nos_tpu.cmd.gateway import HttpReplicaTransport
+    from nos_tpu.kvfabric import FABRIC_TOKEN_HEADER
     import json as _json
+    request, _ = HttpReplicaTransport(fabric_token="fleet-secret") \
+        ._request(Replica(name="a", handle="http://a:8000"),
+                  seen["req"], stream=False)
+    assert _json.loads(request.data)["kv_sources"] == \
+        seen["req"]["kv_sources"]
+    tok_key = FABRIC_TOKEN_HEADER.capitalize()  # urllib's storage key
+    assert request.headers[tok_key] == "fleet-secret"
+    bare = dict(seen["req"])
+    bare.pop("kv_sources")
+    request, _ = HttpReplicaTransport(fabric_token="fleet-secret") \
+        ._request(Replica(name="a", handle="http://a:8000"), bare,
+                  stream=False)
+    assert tok_key not in request.headers
+    # a tokenless transport (fabric off) forwards the offer bare
     request, _ = HttpReplicaTransport()._request(
         Replica(name="a", handle="http://a:8000"), seen["req"],
         stream=False)
-    assert _json.loads(request.data)["kv_sources"] == \
-        seen["req"]["kv_sources"]
+    assert tok_key not in request.headers
 
 
 def test_fabric_no_offer_when_routed_replica_is_warmest():
@@ -1379,6 +1395,48 @@ def test_fabric_offers_are_tenant_scope_exact():
     assert "kv_sources" not in calls[-1]
     router.dispatch(prompt, 4, tenant="gold")
     assert calls[-1]["kv_sources"][0]["replica"] == "b"
+
+
+def test_gateway_door_strips_client_supplied_kv_sources():
+    """kv_sources is fleet-internal: a client posting its own offer to
+    the gateway door would steer a replica's outbound fetcher (blind
+    SSRF) and seed its prefix cache (poisoning) — the door strips the
+    field; only the router may attach one."""
+    import json as _json
+
+    from nos_tpu.cmd.gateway import make_http_server as make_gw_server
+
+    calls = []
+    router = GatewayRouter(
+        RouterConfig(),
+        transport=lambda rep, req: calls.append(req) or req["prompt"])
+    router.update([Replica(name="a", handle="http://a:8000")])
+    gw_httpd = make_gw_server(router, 0, "web")
+    threading.Thread(target=gw_httpd.serve_forever, daemon=True).start()
+    gw = f"http://127.0.0.1:{gw_httpd.server_address[1]}"
+    try:
+        req = urllib.request.Request(
+            gw + "/v1/generate",
+            data=_json.dumps({
+                "prompt": [1, 2], "max_new_tokens": 2,
+                "kv_sources": [{"url": "file:///etc/passwd",
+                                "digest": "aa"}]}).encode(),
+            headers={"Content-Type": "application/json"}, method="POST")
+        with urllib.request.urlopen(req, timeout=10) as r:
+            assert r.status == 200
+        assert calls and "kv_sources" not in calls[-1]
+        assert "kv_sources" not in calls[-1].get("sampling", {})
+    finally:
+        gw_httpd.shutdown()
+
+
+def test_gateway_main_refuses_tokenless_fabric():
+    """--kv-fabric=on without --kv-fabric-token is a startup error,
+    not a silent no-op: every replica drops tokenless peer-pull
+    offers, so the fabric would never move a byte."""
+    from nos_tpu.cmd import gateway as gateway_mod
+    with pytest.raises(SystemExit):
+        gateway_mod.main(["--kv-fabric", "on"])
 
 
 def test_parse_replica_stats_carries_prefix_index():

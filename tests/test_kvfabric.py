@@ -262,6 +262,74 @@ def test_promote_races_concurrent_decode(params):
     assert eng._alloc.used_count == held
 
 
+def test_failed_promote_that_evicts_the_matched_chain_re_matches(params):
+    """Regression: a promotion whose ingest FAILS can still have run
+    evict_lru — and that sweep can take the very chain the caller's
+    pre-promotion match returned (a COW-shared chain's eviction frees
+    zero blocks, so the index empties and the ingest still comes up
+    short). _promote_from_host used to return the stale pre-eviction
+    (m, mkey) on that path; take(mkey) then raises KeyError. The
+    contract now: the returned (m, mkey) is ALWAYS a fresh match —
+    take-able or (0, None) — after any ingest attempt."""
+    from nos_tpu.models.serving import _Request
+
+    sys8, sys32 = [7] * 8, [7] * 32
+
+    # oracle: same int8 quantization, no tiering traffic
+    oracle, _ = fabric_engine(params, host_bytes=0, prefix_blocks=8)
+    o0 = oracle.submit(sys8 + [2], 8)
+    o1 = oracle.submit(sys32 + [9], 2)
+    want = oracle.drain()
+
+    # donor builds the 4-block chain payload the host tier will hold
+    donor, _ = fabric_engine(params, prefix_blocks=8)
+    donor.submit(sys32 + [1], 2, cache_prefix=True)
+    donor.drain()
+    dblocks = dict(donor._pindex.chain_items())[(None, tuple(sys32))]
+    payload = donor._swap_payload(list(dblocks), len(dblocks))
+
+    # 5-block pool (one reserved): chain A ([7]*8) published + a live
+    # request COW-sharing it leaves 3 free — the 4-block host chain
+    # can't land, and evicting A frees nothing (r0 holds its block)
+    eng, host = fabric_engine(params, prefix_blocks=4, kv_blocks=6)
+    assert host.put(None, tuple(sys32), payload)
+    eng.submit(sys8 + [1], 2, cache_prefix=True)
+    eng.drain()
+    r0 = eng.submit(sys8 + [2], 8)          # COW-shares A's block
+    eng.step()
+
+    # drive the promotion exactly as admission would: match hits A
+    # (m=8), the host tier holds a strictly longer chain, and the
+    # ingest's eviction sweep takes A with it before coming up short
+    probe = _Request(rid=-1, prompt=sys32 + [9], max_new_tokens=2)
+    m, mkey = eng._pindex.match(probe.prompt, len(probe.prompt) - 1,
+                                None)
+    assert (m, mkey) == (8, (None, tuple(sys8)))
+    m2, mkey2 = eng._promote_from_host(probe, m, mkey,
+                                       len(probe.prompt))
+    assert eng._fabric["promote"] == 0      # the ingest came up dry
+    assert eng._fabric["demote"] == 1       # ...after demoting A
+    chains = dict(eng._pindex.chain_items())
+    assert (None, tuple(sys8)) not in chains
+    # pre-fix this returned the stale (8, A-key): take(mkey2) would
+    # KeyError and kill the admission
+    assert (m2, mkey2) == (0, None)
+    assert host.get((None, tuple(sys32))) is not None
+
+    # end-to-end: the same squeeze through real admission parks the
+    # request (headroom), and r0's completion lets the retried
+    # admission promote the host chain for real — bit-exact decode
+    r1 = eng.submit(sys32 + [9], 2)
+    res = eng.drain()
+    assert eng._fabric["promote"] == 1
+    assert host.get((None, tuple(sys32))) is None    # moved tiers
+    assert host.get((None, tuple(sys8))) is not None  # A still demoted
+    assert res[r0] == want[o0]
+    assert res[r1] == want[o1]
+    # quiescent pool stays balanced after the cross-tier traffic
+    assert eng._alloc.used_count == eng._pindex.block_count
+
+
 def test_bf16_chains_tier_byte_identical(params):
     # the fabric is dtype-agnostic: no scale planes under bf16, and
     # the k/v planes still round-trip bit-exact

@@ -35,10 +35,11 @@ def served():
     loop.shutdown()
 
 
-def post(url, body, timeout=120):
+def post(url, body, timeout=120, headers=None):
     req = urllib.request.Request(
         url + "/v1/generate", data=json.dumps(body).encode(),
-        headers={"Content-Type": "application/json"}, method="POST")
+        headers={"Content-Type": "application/json", **(headers or {})},
+        method="POST")
     with urllib.request.urlopen(req, timeout=timeout) as r:
         return json.loads(r.read())
 
@@ -1802,11 +1803,14 @@ def test_kv_host_tier_flag_and_validation():
     try:
         with pytest.raises(SystemExit):
             server_mod.main(["--kv-block-size", "8", "--kv-blocks",
-                             "16", "--kv-host-tier-bytes", "1048576"])
+                             "16", "--kv-host-tier-bytes", "1048576",
+                             "--kv-fabric-token", "fleet-secret"])
     finally:
         server_mod.build_engine = real
     assert seen["cfg"].kv_host_tier_bytes == 1048576
+    assert seen["cfg"].kv_fabric_token == "fleet-secret"
     assert ServerConfig().kv_host_tier_bytes == 0       # escape hatch
+    assert ServerConfig().kv_fabric_token == ""         # fabric closed
 
     with pytest.raises(ValueError, match="host_tier|host-tier|prefix"):
         build_engine(ServerConfig(**MODEL, kv_host_tier_bytes=1 << 20))
@@ -1825,24 +1829,36 @@ def test_kvchain_endpoint_and_peer_pull_over_http():
     prefix chain, GET /v1/kvchain/<digest> serves its codec payload
     raw, and a /v1/generate on replica B carrying the gateway-shaped
     kv_sources offer pulls + ingests it before admission — B's served
-    tokens stay bit-identical and its pull ledger records the hit."""
-    from nos_tpu.kvfabric import HostTierStore, chain_digest
+    tokens stay bit-identical and its pull ledger records the hit.
+    Every fabric surface is token-gated: tokenless or wrong-token
+    /v1/kvchain reads answer 403, and a kv_sources offer arriving
+    without the fleet token is counted as pull_denied, never fetched."""
+    from nos_tpu.kvfabric import (FABRIC_TOKEN_HEADER, HostTierStore,
+                                  chain_digest)
     from nos_tpu.kvfabric.codec import decode_chain
     from nos_tpu.utils.metrics import default_registry
 
+    TOK = "fleet-secret"
     mcfg = tfm.TransformerConfig(**MODEL, dtype=jnp.float32)
     params = tfm.init_params(jax.random.PRNGKey(0), mcfg)
-    scfg = ServerConfig(**MODEL, bf16=False, port=0)
+    scfg = ServerConfig(**MODEL, bf16=False, port=0,
+                        kv_fabric_token=TOK)
 
     def serve():
         eng = DecodeServer(params, mcfg, max_batch=2, kv_block_size=8,
                            kv_blocks=24, prefix_cache_size=8,
                            host_tier=HostTierStore(1 << 20))
-        loop = ServingLoop(eng)
+        loop = ServingLoop(eng, fabric_token=TOK)
         httpd = make_http_server(scfg, loop)
         threading.Thread(target=httpd.serve_forever, daemon=True).start()
         return (f"http://127.0.0.1:{httpd.server_address[1]}", loop,
                 httpd)
+
+    def get_chain(url, digest, token=None):
+        req = urllib.request.Request(
+            f"{url}/v1/kvchain/{digest}",
+            headers={} if token is None else {FABRIC_TOKEN_HEADER: token})
+        return urllib.request.urlopen(req, timeout=30)
 
     url_a, loop_a, httpd_a = serve()
     url_b, loop_b, httpd_b = serve()
@@ -1851,26 +1867,44 @@ def test_kvchain_endpoint_and_peer_pull_over_http():
         post(url_a, {"prompt": sys_p + [1, 2], "max_new_tokens": 4,
                      "cache_prefix": True})
         digest = chain_digest(sys_p)
-        with urllib.request.urlopen(
-                f"{url_a}/v1/kvchain/{digest}", timeout=30) as r:
+        with get_chain(url_a, digest, TOK) as r:
             assert r.headers["Content-Type"] == "application/octet-stream"
             blob = r.read()
         assert decode_chain(blob)["tokens"] == sys_p
+        # the export surface is fleet-internal: no token or a stale
+        # token is a 403 before any cache lookup happens (no
+        # residency oracle for unauthenticated callers)
+        for bad in (None, "wrong-secret"):
+            with pytest.raises(urllib.error.HTTPError) as e:
+                get_chain(url_a, digest, bad)
+            assert e.value.code == 403
         with pytest.raises(urllib.error.HTTPError) as e:
-            urllib.request.urlopen(f"{url_a}/v1/kvchain/feedface",
-                                   timeout=30)
+            get_chain(url_a, "feedface", TOK)
         assert e.value.code == 404
 
         offer = {"url": f"{url_a}/v1/kvchain/{digest}",
                  "digest": digest, "len": len(sys_p)}
+        # a tokenless offer (a client spoofing the gateway) is dropped
+        # before any network fetch — the prompt still serves correctly
         got = post(url_b, {"prompt": sys_p + [5, 6],
                            "max_new_tokens": 6, "kv_sources": [offer]})
         want = [int(x) for x in generate(
             params, mcfg,
             jnp.asarray([sys_p + [5, 6]], jnp.int32), 6)[0]]
         assert got["tokens"] == want
-        assert loop_b.stats()["kv_fabric_pulls"] == {"pull_hit": 1,
-                                                     "pull_miss": 0}
+        assert loop_b.stats()["kv_fabric_pulls"] == {
+            "pull_hit": 0, "pull_miss": 0, "pull_denied": 1}
+        rows = loop_b.stats()["prefix_index"]["chains"]
+        assert digest not in {row["digest"] for row in rows}
+
+        # the same offer stamped with the fleet token (as the gateway
+        # does) pulls + ingests before admission
+        got = post(url_b, {"prompt": sys_p + [5, 6],
+                           "max_new_tokens": 6, "kv_sources": [offer]},
+                   headers={FABRIC_TOKEN_HEADER: TOK})
+        assert got["tokens"] == want
+        assert loop_b.stats()["kv_fabric_pulls"] == {
+            "pull_hit": 1, "pull_miss": 0, "pull_denied": 1}
         rows = loop_b.stats()["prefix_index"]["chains"]
         assert digest in {row["digest"] for row in rows}
 
@@ -1880,7 +1914,8 @@ def test_kvchain_endpoint_and_peer_pull_over_http():
                            "max_new_tokens": 3,
                            "kv_sources": [{"url": f"{url_a}/v1/kvchain/"
                                            "feedface",
-                                           "digest": "feedface"}]})
+                                           "digest": "feedface"}]},
+                   headers={FABRIC_TOKEN_HEADER: TOK})
         want = [int(x) for x in generate(
             params, mcfg, jnp.asarray([[9] * 8 + [1]], jnp.int32), 3)[0]]
         assert got["tokens"] == want
@@ -1889,11 +1924,87 @@ def test_kvchain_endpoint_and_peer_pull_over_http():
         text = default_registry().expose()
         assert 'nos_tpu_serve_kvfabric_total{event="pull_hit"}' in text
         assert 'nos_tpu_serve_kvfabric_total{event="pull_miss"}' in text
+        assert 'nos_tpu_serve_kvfabric_total{event="pull_denied"}' in text
     finally:
         for httpd, loop in ((httpd_a, loop_a), (httpd_b, loop_b)):
             httpd.shutdown()
             loop.shutdown()
             httpd.server_close()
+
+
+def test_kv_fabric_pull_guards():
+    """The pull path's local guards, no sockets involved: non-http(s)
+    offer URLs (file://, ftp://) are rejected before any fetch is
+    dispatched, malformed offers are skipped, and concurrent offers
+    for the same digest collapse into one fetch (single-flight)."""
+    # scheme allowlist: _fetch_chain_bytes refuses anything that is
+    # not plain http(s) — urlopen would happily read file:// paths
+    loop = ServingLoop(_FakeEngine())
+    try:
+        for url in ("file:///etc/passwd", "ftp://peer/x", "gopher://x"):
+            with pytest.raises(ValueError, match="non-http"):
+                loop._fetch_chain_bytes(url)
+
+        fetched = []
+
+        def fake_fetch(url):
+            fetched.append(url)
+            return b"blob"
+
+        loop.chain_fetch = fake_fetch
+        # malformed offers (missing url / missing digest / wrong
+        # types) are skipped without a fetch and without a ledger hit
+        loop.prefetch_chain([{"digest": "aa"}, {"url": "http://p/x"},
+                             {"url": 7, "digest": "aa"},
+                             {"url": "http://p/x", "digest": ""},
+                             "nonsense", None])
+        assert fetched == []
+        assert loop._pull_counts == {"pull_hit": 0, "pull_miss": 0,
+                                     "pull_denied": 0}
+    finally:
+        loop.shutdown()
+
+    # single-flight: two threads racing the same digest produce ONE
+    # fetch; the follower inherits the leader's outcome
+    class _IngestEngine(_FakeEngine):
+        def __init__(self):
+            super().__init__()
+            self.ingested = []
+
+        def ingest_chain(self, blob, tenant=None, expect_digest=None):
+            self.ingested.append(blob)
+            return True
+
+    eng = _IngestEngine()
+    loop = ServingLoop(eng)
+    gate = threading.Event()
+    calls = []
+
+    def slow_fetch(url):
+        calls.append(url)
+        gate.wait(timeout=10)
+        return b"blob"
+
+    loop.chain_fetch = slow_fetch
+    try:
+        offers = [{"url": "http://peer/v1/kvchain/aa", "digest": "aa"}]
+        t1 = threading.Thread(target=loop.prefetch_chain, args=(offers,))
+        t1.start()
+        deadline = time.time() + 5
+        while not calls and time.time() < deadline:
+            time.sleep(0.01)            # leader is inside the fetch
+        t2 = threading.Thread(target=loop.prefetch_chain, args=(offers,))
+        t2.start()
+        time.sleep(0.1)                 # follower parks on the event
+        gate.set()
+        t1.join(timeout=10)
+        t2.join(timeout=10)
+        assert calls == ["http://peer/v1/kvchain/aa"]
+        assert len(eng.ingested) == 1
+        assert loop._pull_counts["pull_hit"] == 2
+        assert loop._pull_counts["pull_miss"] == 0
+    finally:
+        loop.shutdown()
 
 
 def test_prefix_evict_counters_mirror_by_tier():
