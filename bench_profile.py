@@ -1,18 +1,30 @@
 #!/usr/bin/env python3
 """Component timing for the flagship MFU config: where does the step time
 go? Times each piece with the host-transfer fence (block_until_ready lies
-on 'axon' — see bench_mfu.py). Used to target VERDICT r2 next #2c."""
+on 'axon' — see bench_mfu.py). Used to target VERDICT r2 next #2c.
+
+Also hosts the serving-side TTFT decomposition (ISSUE 18): a PURE
+function over stitched trace spans (the /debug/traces JSON of a
+gateway journey and the replica spans it parented) that splits a
+request's time-to-first-token into door-wait / route / queue / prefill
+/ handoff / first-decode-tick. Importable without jax — the training
+bench below only imports its stack inside main().
+
+    python bench_profile.py                      # training component bench
+    python bench_profile.py --ttft traces.json   # decompose stitched traces
+"""
+import argparse
 import json
 import sys
 import time
 
 sys.path.insert(0, ".")
 
-from bench import BATCH, MODEL, SEQ  # noqa: E402
-from bench_mfu import host_fence  # noqa: E402
+TTFT_ARTIFACT = "bench_logs/bench_profile_ttft.json"
 
 
 def timeit(fn, *args, reps=5, warmup=2):
+    from bench_mfu import host_fence
     out = None
     for _ in range(warmup):
         out = fn(*args)
@@ -24,11 +36,130 @@ def timeit(fn, *args, reps=5, warmup=2):
     return (time.perf_counter() - t0) / reps
 
 
+# ---------------------------------------------------------------------------
+# TTFT decomposition over stitched traces (jax-free)
+# ---------------------------------------------------------------------------
+
+def _r(v):
+    return round(float(v), 6)
+
+
+def decompose_ttft(spans):
+    """Split ONE request journey's TTFT into its serving phases.
+
+    ``spans`` is a list of span dicts (``Span.to_dict()`` /
+    ``/debug/traces`` shape) sharing one trace_id: a ``gateway.request``
+    root, its ``gateway.attempt`` children, and the replica-side
+    ``serve.request`` span(s) the winning attempt parented (one for a
+    colocated fleet; a role=prefill + role=decode pair for a
+    disaggregated one). Pure arithmetic over the recorded stamps and
+    attrs — deterministic for a given span set, so the artifact is
+    byte-reproducible by construction.
+
+    Phases (seconds, absent components contribute null):
+      door_wait_s        the gateway door queue (root's door_wait_s attr)
+      route_s            root start -> winning attempt start, minus door
+      queue_s            replica submit -> admitted (serve.request
+                         queue_ms attr, prefill side on a disagg fleet)
+      prefill_s          the prefill-side serve.request span up to its
+                         recorded first token (ttft_ms), minus queueing
+      handoff_s          prefill-side span end -> decode-side span start
+                         (ship + adopt)
+      first_decode_tick_s  decode-side ttft_ms (adopt -> first emitted
+                         token) on a disagg fleet; null when colocated
+    """
+    root = None
+    attempts = []
+    serves = []
+    for sp in spans:
+        if sp.get("name") == "gateway.request":
+            root = sp
+        elif sp.get("name") == "gateway.attempt":
+            attempts.append(sp)
+        elif sp.get("name") == "serve.request":
+            serves.append(sp)
+    if root is None:
+        return None
+    attrs = root.get("attrs") or {}
+    out = {
+        "trace_id": root.get("trace_id"),
+        "door_wait_s": _r(attrs.get("door_wait_s", 0.0)),
+        "route_s": None, "queue_s": None, "prefill_s": None,
+        "handoff_s": None, "first_decode_tick_s": None,
+        "attempts": len(attempts),
+    }
+    win = None
+    for a in sorted(attempts, key=lambda s: s.get("start") or 0.0):
+        if (a.get("attrs") or {}).get("outcome") == "completed":
+            win = a
+            break
+    if win is not None and win.get("start") is not None \
+            and root.get("start") is not None:
+        out["route_s"] = _r(max(
+            0.0, win["start"] - root["start"] - out["door_wait_s"]))
+    prefill = next(
+        (s for s in serves
+         if (s.get("attrs") or {}).get("role") == "prefill"), None)
+    decode = next(
+        (s for s in serves
+         if (s.get("attrs") or {}).get("role") == "decode"), None)
+    local = prefill if prefill is not None else (
+        serves[0] if serves else None)
+    if local is not None:
+        lat = local.get("attrs") or {}
+        if lat.get("queue_ms") is not None:
+            out["queue_s"] = _r(lat["queue_ms"] / 1e3)
+        if lat.get("ttft_ms") is not None:
+            out["prefill_s"] = _r(max(
+                0.0, lat["ttft_ms"] / 1e3 - (out["queue_s"] or 0.0)))
+    if prefill is not None and decode is not None \
+            and prefill.get("end") is not None \
+            and decode.get("start") is not None:
+        out["handoff_s"] = _r(max(
+            0.0, decode["start"] - prefill["end"]))
+        dat = decode.get("attrs") or {}
+        if dat.get("ttft_ms") is not None:
+            out["first_decode_tick_s"] = _r(dat["ttft_ms"] / 1e3)
+    return out
+
+
+def ttft_section(spans):
+    """Decompose every journey in a stitched span dump: group by
+    trace_id, one decomposition per gateway.request root, canonically
+    ordered — ``json.dumps(..., sort_keys=True)`` of this value is the
+    byte-reproducible artifact."""
+    by_trace = {}
+    for sp in spans:
+        tid = sp.get("trace_id")
+        if tid:
+            by_trace.setdefault(tid, []).append(sp)
+    rows = []
+    for tid in sorted(by_trace):
+        row = decompose_ttft(by_trace[tid])
+        if row is not None:
+            rows.append(row)
+    return {"section": "ttft_decomposition", "requests": rows,
+            "journeys": len(rows)}
+
+
+def write_ttft_artifact(spans, path=TTFT_ARTIFACT):
+    import os
+    doc = ttft_section(spans)
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    payload = json.dumps(doc, sort_keys=True, indent=1) + "\n"
+    with open(path, "w") as f:
+        f.write(payload)
+    return path
+
+
 def main():
     import jax
     import jax.numpy as jnp
     import optax
 
+    from bench import BATCH, MODEL, SEQ
     from nos_tpu.models import transformer as tr
     from nos_tpu.ops.attention import attention
 
@@ -112,4 +243,17 @@ def main():
 
 
 if __name__ == "__main__":
-    main()
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument(
+        "--ttft", metavar="TRACES_JSON",
+        help="decompose a stitched /debug/traces span dump into "
+             "bench_logs/ instead of running the training bench")
+    ns = ap.parse_args()
+    if ns.ttft:
+        with open(ns.ttft) as f:
+            dump = json.load(f)
+        spans = dump.get("spans", dump) if isinstance(dump, dict) else dump
+        path = write_ttft_artifact(spans)
+        print(path)
+    else:
+        main()

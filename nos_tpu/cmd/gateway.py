@@ -103,6 +103,11 @@ class HttpReplicaTransport:
                 headers[FABRIC_TOKEN_HEADER] = self.fabric_token
         if req.get("deadline_s") is not None:
             headers["X-Request-Deadline-S"] = f"{req['deadline_s']:.3f}"
+        if req.get("traceparent"):
+            # W3C trace context: the replica's serve.request span
+            # parents into the gateway attempt instead of minting a
+            # fresh trace_id — one trace per request, fleet-wide
+            headers["traceparent"] = req["traceparent"]
         timeout = self.timeout_s
         if req.get("deadline_s") is not None:
             timeout = min(timeout, req["deadline_s"] + 5.0)

@@ -73,6 +73,19 @@ COMPILE_BUCKETS = (0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0,
 # rolling-rate window for the /stats snapshot
 RATE_WINDOW_S = 60.0
 
+# decode-tick phases, in tick order: assemble (admission + batch
+# assembly inside step_begin), dispatch (device dispatch of the decode
+# program), wait (host blocked on the in-flight step + result fetch),
+# sample (consume/commit in step_finish), bookkeep (ledger, rates,
+# gauges, deadline sweep). Non-split engines can't separate the first
+# four — their whole step lands under ``dispatch``.
+TICK_PHASES = ("assemble", "dispatch", "wait", "sample", "bookkeep")
+
+# sub-ms phase buckets: a healthy pipelined tick spends microseconds on
+# its host phases, so the default request-scale buckets would flatline
+TICK_PHASE_BUCKETS = (0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005,
+                      0.01, 0.025, 0.05, 0.1, 0.25, 1.0)
+
 # bound on the recovery capture phase: swap snapshots are device->host
 # copies that can HANG (not just raise) on a lost device — the capture
 # runs on a helper thread joined with this timeout, and on expiry the
@@ -424,6 +437,23 @@ class ServingLoop:
             "Per-tick dispatch gap: time the engine had no decode tick "
             "in flight while decodable slots existed (the accelerator "
             "host-blocked behind bookkeeping)")
+        # decode-tick phase profiler: the tick decomposed into named
+        # phases — assemble (admission/batch assembly inside
+        # step_begin), dispatch (device dispatch of the decode step),
+        # wait (host blocked on the in-flight step + fetch), sample
+        # (consume/commit in step_finish), bookkeep (ledger, rates,
+        # gauges). Derived from the clock reads the loop ALREADY takes
+        # per tick plus two new ones (PR 5 discipline: no per-phase
+        # clock spam inside the engine hot path).
+        self.h_tick_phase = reg.histogram(
+            "nos_tpu_serve_tick_phase_seconds",
+            "Serving-loop tick time decomposed by phase",
+            labelnames=("phase",), buckets=TICK_PHASE_BUCKETS)
+        for _ph in TICK_PHASES:
+            self.h_tick_phase.labels(_ph)
+        # rolling per-tick phase samples for /stats and /debug/profile:
+        # (monotonic tick start, {phase: seconds})
+        self._tick_phases: deque = deque(maxlen=256)
         # request-level latency ledger surface (engine stamps, this loop
         # observes at completion — nothing here runs per token on the
         # hot tick path; buckets carry trace exemplars of the request's
@@ -613,6 +643,11 @@ class ServingLoop:
         # remaining seconds at ship time so the adopting decode
         # replica can shed expired phase-2 work early.
         self._prefill_deadlines: dict = {}  # loop rid -> abs monotonic
+        # trace carry over the same seam: the prefill-side request
+        # span's encoded context ships in the handoff meta plane so the
+        # adopting decode replica's serve.request parents into the SAME
+        # journey instead of minting a fresh trace_id
+        self._prefill_traceparents: dict = {}   # loop rid -> traceparent
         # adopted-request TTL (decode role): an adopted handoff whose
         # consumer never shows up — the gateway crashed mid-resume, or
         # phase 2 exhausted its attempts — must not decode-and-park
@@ -910,6 +945,7 @@ class ServingLoop:
         self._live.discard(rid)
         self._deadlines.pop(rid, None)
         self._prefill_deadlines.pop(rid, None)
+        self._prefill_traceparents.pop(rid, None)
         self._rid_map.pop(rid, None)
         # an adopted (decode-role) request's prompt leaves with its
         # terminal outcome: the streaming attach path never calls
@@ -998,6 +1034,10 @@ class ServingLoop:
                 if ledger.get("ttft_s") is not None:
                     sp.set_attr("ttft_ms",
                                 round(ledger["ttft_s"] * 1e3, 3))
+                if ledger.get("queue_s") is not None:
+                    sp.set_attr(
+                        "queue_ms",
+                        round(max(0.0, ledger["queue_s"]) * 1e3, 3))
                 sp.set_attr("output_tokens",
                             ledger.get("output_tokens", 0))
             if breaches:
@@ -1016,6 +1056,87 @@ class ServingLoop:
         cutoff = now - RATE_WINDOW_S
         while len(self._rates) > 1 and self._rates[0][0] < cutoff:
             self._rates.popleft()
+
+    def _note_tick_phases(self, t0: float, t1: float, t2: float,
+                          t3: float, t4: float, eng,
+                          tid: Optional[str] = None) -> None:
+        """Decompose one tick into TICK_PHASES from the clock reads the
+        quantum already takes plus the two post-wait reads (caller
+        holds the lock). ``eng`` is the split-protocol engine — its
+        ``last_assemble_s`` splits step_begin into assemble vs device
+        dispatch — or None for step()-only engines, whose whole step
+        lands under ``dispatch``. ``sample`` covers step_finish plus
+        the loop-lock reacquisition after the device wait."""
+        if eng is not None:
+            begin = max(0.0, t1 - t0)
+            assemble = max(
+                0.0, float(getattr(eng, "last_assemble_s", 0.0) or 0.0))
+            assemble = min(assemble, begin)
+            phases = {
+                "assemble": assemble,
+                "dispatch": begin - assemble,
+                "wait": max(0.0, t2 - t1),
+                "sample": max(0.0, t3 - t2),
+                "bookkeep": max(0.0, t4 - t3),
+            }
+        else:
+            phases = {
+                "assemble": 0.0,
+                "dispatch": max(0.0, t1 - t0),
+                "wait": 0.0,
+                "sample": 0.0,
+                "bookkeep": max(0.0, t4 - t1),
+            }
+        for ph, v in phases.items():
+            self.h_tick_phase.labels(ph).observe(v, trace_id=tid)
+        self._tick_phases.append((t0, phases))
+
+    def _tick_phase_snapshot(self) -> dict:
+        """Rolling per-phase totals over the ring window for /stats
+        (caller holds the lock): where recent tick time went, without
+        scraping histogram buckets."""
+        totals = {ph: 0.0 for ph in TICK_PHASES}
+        for _t, phases in self._tick_phases:
+            for ph, v in phases.items():
+                totals[ph] += v
+        return {
+            "window": len(self._tick_phases),
+            "seconds": {ph: round(v, 6) for ph, v in totals.items()},
+        }
+
+    def profile_trace(self, last_n: int = 64) -> dict:
+        """Chrome trace-event JSON of the last N decode ticks, each
+        tick a slice with its phase children — the /debug/profile
+        payload, rendered by obs/trace_export.to_chrome_trace. The
+        synthesized spans share ONE fixed valid-hex trace id so every
+        tick lands on the same Perfetto lane, and none feed the flight
+        recorder (constructed with _tracer=None)."""
+        from nos_tpu.obs.trace_export import to_chrome_trace
+        from nos_tpu.obs.tracing import Span, _new_span_id
+        with self._lock:
+            ticks = list(self._tick_phases)[-max(1, int(last_n)):]
+        if not ticks:
+            return {"traceEvents": [],
+                    "displayTimeUnit": "ms"}
+        tid = "70726f66696c6500" + "0" * 16   # "profile" in hex, padded
+        spans = []
+        for i, (t0, phases) in enumerate(ticks):
+            root = Span("serve.tick", "server", tid, _new_span_id(),
+                        None, t0, attrs={"tick": i}, _tracer=None)
+            cursor = t0
+            for ph in TICK_PHASES:
+                dur = phases.get(ph, 0.0)
+                if dur <= 0.0:
+                    continue
+                child = Span("tick." + ph, "server", tid,
+                             _new_span_id(), root.span_id, cursor,
+                             _tracer=None)
+                child.end(end_time=cursor + dur)
+                cursor += dur
+                spans.append(child)
+            root.end(end_time=max(cursor, t0))
+            spans.append(root)
+        return to_chrome_trace(spans)
 
     def _drain_compile_events(self) -> None:
         """Engine-side compile accounting -> metrics (caller holds the
@@ -1152,6 +1273,7 @@ class ServingLoop:
                 # KV-fabric peer-pull outcomes (loop-side: the engine
                 # only sees decoded payloads, never fetches)
                 "kv_fabric_pulls": dict(self._pull_counts),
+                "tick_phases": self._tick_phase_snapshot(),
             })
         return snap
 
@@ -1208,9 +1330,11 @@ class ServingLoop:
                     # recover anyway). What the watchdog guards is the
                     # device wait below — the phase a lost device
                     # actually wedges.
-                    self._tick_started = time.monotonic()
+                    t1 = time.monotonic()
+                    self._tick_started = t1
                 else:
                     emitted = eng.step()
+                    t1 = time.monotonic()
             except BaseException as e:
                 sp.end()
                 self._tick_started = None
@@ -1218,6 +1342,7 @@ class ServingLoop:
         if failure is not None:
             self._recover(failure, "step_error", gen)
             return False
+        t2 = t1
         if split:
             # the only blocking device wait — lock released, so a
             # concurrent submit's barrier flush may consume the
@@ -1232,6 +1357,7 @@ class ServingLoop:
                     self._tick_started = None
                 self._recover(e, "step_error", gen)
                 return False
+            t2 = time.monotonic()
         with self._work:
             if self._gen != gen or self._failed is not None:
                 # superseded while blocked (watchdog recovery took the
@@ -1240,9 +1366,11 @@ class ServingLoop:
                 # touch loop state
                 sp.end()
                 return False
+            t3 = t2
             try:
                 if split:
                     emitted = eng.step_finish(handle)
+                    t3 = time.monotonic()
                     if gap0 is not None:
                         # the engine's structural gap counter: time
                         # this tick's window sat empty with work
@@ -1290,8 +1418,12 @@ class ServingLoop:
                 failure = e
             else:
                 sp.end()
-                self.h_tick.observe(time.monotonic() - t0,
+                t4 = time.monotonic()
+                self.h_tick.observe(t4 - t0,
                                     trace_id=sp.trace_id or None)
+                self._note_tick_phases(t0, t1, t2, t3, t4,
+                                       eng if split else None,
+                                       tid=sp.trace_id or None)
                 self._work.notify_all()  # wake waiters to check results
         if failure is not None:
             self._recover(failure, "step_error", gen)
@@ -1650,6 +1782,13 @@ class ServingLoop:
                         continue
                     dl = (self._prefill_deadlines.get(lrid0)
                           if lrid0 is not None else None)
+                    tp = (self._prefill_traceparents.get(lrid0)
+                          if lrid0 is not None else None)
+                if tp is not None:
+                    # the journey context rides the same JSON meta
+                    # plane as deadline_s: the adopting decode
+                    # replica's serve.request parents into it
+                    st["traceparent"] = tp
                 if dl is not None:
                     # carry the REMAINING seconds, computed at ship
                     # time: wall budgets survive the hop without any
@@ -1716,7 +1855,8 @@ class ServingLoop:
                     self._work.notify_all()
 
     def prefill(self, prompt, max_new_tokens, timeout: float = 300.0,
-                deadline_s: Optional[float] = None, **sampling):
+                deadline_s: Optional[float] = None,
+                traceparent: Optional[str] = None, **sampling):
         """Prefill-role request path: submit, wait for the handoff to
         land on a decode replica, return its descriptor
         ``{"handoff": {"target", "rid"}}`` — the gateway (or client)
@@ -1765,6 +1905,23 @@ class ServingLoop:
             self._live.add(rid)
             if dl_s is not None:
                 self._prefill_deadlines[rid] = time.monotonic() + dl_s
+            # the prefill side of a disaggregated request records its
+            # own serve.request span (role=prefill, closed by _account
+            # when the handoff ships or the request completes locally)
+            # and STASHES a context for the pusher: the encoded child
+            # context when recording, else the raw inbound header —
+            # tracing-off prefill replicas still forward the journey
+            # untouched to the decode side
+            sp = tracing.start_span(
+                "serve.request", component="server", parent=traceparent,
+                attrs={"prompt_tokens": len(prompt),
+                       "max_new_tokens": max_new_tokens,
+                       "role": "prefill"})
+            if sp.recording:
+                self._spans[rid] = sp
+                self._prefill_traceparents[rid] = sp.context.encode()
+            elif traceparent:
+                self._prefill_traceparents[rid] = traceparent
             self._mirror_engine_gauges()
             self._work.notify_all()
             deadline = time.monotonic() + timeout
@@ -1818,6 +1975,10 @@ class ServingLoop:
         # ship time): popped before restore — it is loop bookkeeping,
         # not engine KV state
         carried_dl = state.pop("deadline_s", None)
+        # trace context carried the same way: the decode side's
+        # serve.request span parents into the prefill side's, so one
+        # trace_id spans the disaggregated pair
+        carried_tp = state.pop("traceparent", None)
         with self._work:
             if self._failed is not None:
                 raise RuntimeError(f"serving loop failed: {self._failed}")
@@ -1846,6 +2007,14 @@ class ServingLoop:
                 # burns a decode tick quantum.
                 self._deadlines[rid] = \
                     time.monotonic() + float(carried_dl)
+            sp = tracing.start_span(
+                "serve.request", component="server",
+                parent=carried_tp if isinstance(carried_tp, str)
+                else None,
+                attrs={"prompt_tokens": len(state["prompt"]),
+                       "role": "decode", "adopted": True})
+            if sp.recording:
+                self._spans[rid] = sp
             self._mirror_engine_gauges()
             self._work.notify_all()
         return rid
@@ -1880,8 +2049,8 @@ class ServingLoop:
                 return None
             return export(digest)
 
-    def _fetch_chain_bytes(self, url: str, timeout_s: float = 2.0
-                           ) -> bytes:
+    def _fetch_chain_bytes(self, url: str, timeout_s: float = 2.0,
+                           traceparent: Optional[str] = None) -> bytes:
         import urllib.parse
         import urllib.request
         if urllib.parse.urlsplit(url).scheme not in ("http", "https"):
@@ -1892,19 +2061,34 @@ class ServingLoop:
         if self.fabric_token:
             # peer /v1/kvchain exports are token-gated (fleet-internal)
             req.add_header(FABRIC_TOKEN_HEADER, self.fabric_token)
+        if traceparent:
+            # the holder's kvfabric.serve span parents into the
+            # puller's kvfabric.pull — the peer hop stays in-trace
+            req.add_header("traceparent", traceparent)
         with urllib.request.urlopen(req, timeout=timeout_s) as resp:
             if resp.status != 200:
                 raise RuntimeError(f"kvchain fetch {url}: {resp.status}")
             return resp.read()
 
-    def note_pull_denied(self) -> None:
+    def note_pull_denied(self, digest: Optional[str] = None,
+                         parent: Optional[str] = None) -> None:
         """A kv_sources offer arrived without the fleet's fabric token
         (or none is configured): never honored — the offer steers this
         replica's outbound fetcher and seeds its prefix cache, so a
         client-supplied one is blind SSRF plus cache poisoning.
         Counted so operators can see misconfigured (or probing)
-        callers."""
+        callers; when the request carries a trace (``parent``), the
+        denial is also filed into it as a kvfabric.pull span — a
+        denied pull inside a slow request must not be invisible.
+        Tokenless probes (no trace) stay counters-only so they cannot
+        spam the flight recorder with fresh roots."""
         self._count_pull("pull_denied")
+        if parent:
+            dsp = tracing.start_span(
+                "kvfabric.pull", component="kvfabric", parent=parent,
+                attrs={"outcome": "pull_denied",
+                       "digest": digest or ""})
+            dsp.end()
 
     def _count_pull(self, ev: str) -> None:
         self._pull_counts[ev] += 1
@@ -1912,7 +2096,8 @@ class ServingLoop:
             self.m_kvfabric.labels(ev).inc()
 
     def prefetch_chain(self, sources, tenant: Optional[str] = None,
-                       deadline_s: Optional[float] = None) -> bool:
+                       deadline_s: Optional[float] = None,
+                       parent: Optional[str] = None) -> bool:
         """Best-effort adoption of gateway-offered peer chains BEFORE
         a request submits: fetch the codec payload from the named peer
         (outside the loop lock — a slow peer must not stall the
@@ -1931,15 +2116,27 @@ class ServingLoop:
             if not isinstance(url, str) or not url \
                     or not isinstance(digest, str) or not digest:
                 continue
-            adopted = self._pull_single_flight(url, digest, tenant,
-                                               deadline_s)
-            self._count_pull("pull_hit" if adopted else "pull_miss")
+            # the pull is a child of the request's journey (parent =
+            # the inbound traceparent): a slow or missed peer pull
+            # inside a slow request shows up IN that request's trace
+            psp = tracing.start_span(
+                "kvfabric.pull", component="kvfabric", parent=parent,
+                attrs={"digest": digest, "url": url})
+            adopted = self._pull_single_flight(
+                url, digest, tenant, deadline_s,
+                traceparent=(psp.context.encode() if psp.recording
+                             else None))
+            outcome = "pull_hit" if adopted else "pull_miss"
+            psp.set_attr("outcome", outcome)
+            psp.end()
+            self._count_pull(outcome)
             ok = ok or adopted
         return ok
 
     def _pull_single_flight(self, url: str, digest: str,
                             tenant: Optional[str],
-                            deadline_s: Optional[float]) -> bool:
+                            deadline_s: Optional[float],
+                            traceparent: Optional[str] = None) -> bool:
         """One fetch+ingest per digest at a time: concurrent requests
         sharing the same cold prefix ride the leader's pull — when it
         lands, the chain is in the local index and every rider's own
@@ -1957,7 +2154,7 @@ class ServingLoop:
             return flight["adopted"]
         try:
             flight["adopted"] = self._pull_once(url, digest, tenant,
-                                                deadline_s)
+                                                deadline_s, traceparent)
         finally:
             with self._pull_lock:
                 self._pull_inflight.pop(digest, None)
@@ -1966,7 +2163,8 @@ class ServingLoop:
 
     def _pull_once(self, url: str, digest: str,
                    tenant: Optional[str],
-                   deadline_s: Optional[float]) -> bool:
+                   deadline_s: Optional[float],
+                   traceparent: Optional[str] = None) -> bool:
         timeout = self.chain_fetch_timeout_s
         if deadline_s is not None:
             # never spend more of the request's own completion budget
@@ -1976,7 +2174,8 @@ class ServingLoop:
             if self.chain_fetch is not None:
                 data = self.chain_fetch(url)
             else:
-                data = self._fetch_chain_bytes(url, timeout_s=timeout)
+                data = self._fetch_chain_bytes(url, timeout_s=timeout,
+                                               traceparent=traceparent)
             with self._work:
                 if self._failed is not None or self._recovering:
                     raise RuntimeError("loop not serving")
@@ -2044,12 +2243,14 @@ class ServingLoop:
         return out
 
     def generate(self, prompt, max_new_tokens, timeout: float = 300.0,
-                 deadline_s: Optional[float] = None, **sampling):
+                 deadline_s: Optional[float] = None,
+                 traceparent: Optional[str] = None, **sampling):
         """Unary request: expressed over ``stream`` so there is exactly
         one waiting/abandon/metrics implementation."""
         out = list(prompt)
         for delta in self.stream(prompt, max_new_tokens, timeout,
-                                 deadline_s=deadline_s, **sampling):
+                                 deadline_s=deadline_s,
+                                 traceparent=traceparent, **sampling):
             out.extend(delta)
         return out
 
@@ -2227,7 +2428,8 @@ class ServingLoop:
 
     def stream(self, prompt, max_new_tokens, timeout: float = 300.0,
                deadline_s: Optional[float] = None,
-               tenant: Optional[str] = None, **sampling):
+               tenant: Optional[str] = None,
+               traceparent: Optional[str] = None, **sampling):
         """Streaming primitive: submits EAGERLY (validation errors raise
         here, before the caller commits response headers) and returns an
         iterator yielding lists of newly-decoded tokens as ticks land.
@@ -2337,9 +2539,13 @@ class ServingLoop:
                 self._deadlines[rid] = time.monotonic() + dl_s
             # one span per REQUEST (not per token): the request's
             # journey through the serving loop, closed by _account with
-            # its outcome and latency attrs — SLO breaches pin it
+            # its outcome and latency attrs — SLO breaches pin it. An
+            # inbound ``traceparent`` (the gateway attempt's context)
+            # is ADOPTED instead of minting a fresh trace_id, so the
+            # fleet sees one trace per request; malformed headers fall
+            # back to a fresh root (tracing.py's decode contract).
             sp = tracing.start_span(
-                "serve.request", component="server",
+                "serve.request", component="server", parent=traceparent,
                 attrs={"prompt_tokens": len(prompt),
                        "max_new_tokens": max_new_tokens})
             if sp.recording:
@@ -2803,22 +3009,42 @@ def make_http_server(cfg: ServerConfig, loop: ServingLoop
                 # tenant's KV bytes plus a 200-vs-404 cache-residency
                 # oracle (the ISSUE 13 side channel) — hence the
                 # shared-token gate, closed when no token is set.
+                digest = self.path.rsplit("/", 1)[1].split("?")[0]
+                # the holder's side of a peer pull, parented into the
+                # puller's kvfabric.pull. Recorded only when the pull
+                # carries a trace — tokenless probes must not be able
+                # to mint fresh roots into the flight recorder.
+                inbound_tp = self.headers.get("traceparent")
+                ssp = tracing.start_span(
+                    "kvfabric.serve", component="kvfabric",
+                    parent=inbound_tp,
+                    attrs={"digest": digest}) if inbound_tp \
+                    else tracing.NOOP_SPAN
                 if not cfg.kv_fabric_token or self.headers.get(
                         FABRIC_TOKEN_HEADER) != cfg.kv_fabric_token:
+                    ssp.set_attr("outcome", "denied")
+                    ssp.end()
                     self._reply(403, {"error": "kv fabric token "
                                       "required",
                                       "reason": "fabric_token"})
                     return
-                digest = self.path.rsplit("/", 1)[1].split("?")[0]
                 try:
                     data = loop.export_chain(digest)
                 except Exception as e:  # noqa: BLE001 — JSON 500
+                    ssp.set_attr("outcome", "error")
+                    ssp.set_error(str(e))
+                    ssp.end()
                     self._reply(500, {"error": f"{type(e).__name__}: {e}"})
                     return
                 if data is None:
+                    ssp.set_attr("outcome", "miss")
+                    ssp.end()
                     self._reply(404, {"error": "unknown chain",
                                       "digest": digest})
                     return
+                ssp.set_attr("outcome", "served")
+                ssp.set_attr("nbytes", len(data))
+                ssp.end()
                 self.send_response(200)
                 self.send_header("Content-Type",
                                  "application/octet-stream")
@@ -2838,6 +3064,22 @@ def make_http_server(cfg: ServerConfig, loop: ServingLoop
                         "trace_id": tid,
                         "spans": [sp.to_dict() for sp in spans],
                     })
+            elif self.path.startswith("/debug/profile"):
+                # Perfetto/chrome trace of the last N decode ticks
+                # decomposed into phases — save the body to a file and
+                # open it at ui.perfetto.dev. ?ticks=N bounds the
+                # window (default 64, capped at the phase ring).
+                n = 64
+                if "?" in self.path:
+                    try:
+                        from urllib.parse import parse_qs, urlsplit
+                        q = parse_qs(urlsplit(self.path).query)
+                        n = int(q.get("ticks", ["64"])[0])
+                    except (ValueError, IndexError):
+                        self._reply(400, {"error": "ticks must be an "
+                                          "integer"})
+                        return
+                self._reply(200, loop.profile_trace(last_n=n))
             else:
                 self._reply(404, {"error": f"unknown path {self.path}"})
 
@@ -2977,6 +3219,14 @@ def make_http_server(cfg: ServerConfig, loop: ServingLoop
                     "deadline_s", self.headers.get("X-Request-Deadline-S"))
                 if deadline is not None:
                     sampling["deadline_s"] = float(deadline)
+                # inbound W3C trace context: the request's
+                # serve.request span ADOPTS the caller's trace (the
+                # gateway attempt's) instead of minting a fresh one —
+                # malformed values degrade to a fresh root inside
+                # tracing, never to an error
+                inbound_tp = self.headers.get("traceparent")
+                if inbound_tp:
+                    sampling["traceparent"] = inbound_tp
                 if body.get("kv_sources"):
                     # gateway-attached KV-fabric peer offers: pull the
                     # named chain(s) from peer replicas BEFORE submit,
@@ -2993,9 +3243,16 @@ def make_http_server(cfg: ServerConfig, loop: ServingLoop
                             FABRIC_TOKEN_HEADER) == cfg.kv_fabric_token:
                         loop.prefetch_chain(
                             body["kv_sources"], sampling.get("tenant"),
-                            deadline_s=sampling.get("deadline_s"))
+                            deadline_s=sampling.get("deadline_s"),
+                            parent=inbound_tp)
                     else:
-                        loop.note_pull_denied()
+                        srcs = body["kv_sources"]
+                        loop.note_pull_denied(
+                            digest=(srcs[0].get("digest")
+                                    if isinstance(srcs, list) and srcs
+                                    and isinstance(srcs[0], dict)
+                                    else None),
+                            parent=inbound_tp)
                 if cfg.role == "prefill":
                     # prefill role: the answer is a handoff descriptor
                     # ({"handoff": {"target", "rid"}}) the gateway
@@ -3227,10 +3484,11 @@ def main(argv: Optional[Sequence[str]] = None) -> None:
              "the deadline_s field / X-Request-Deadline-S header). "
              "Unmeetable deadlines shed at admission (429), expired "
              "ones cancel at the next tick barrier (504)")
-    parser.add_argument(
-        "--log-format", choices=("text", "json"), default="text",
-        help="log line format; json emits one object per line with "
-             "trace_id/span_id injected when a tracing span is active")
+    # the fleet-shared observability flags (--log-format plus the
+    # --trace-* sampler / flight-recorder knobs), same as every
+    # control-plane binary — Helm feeds all daemons from one helper
+    from nos_tpu.cmd.serve import observability_flags
+    observability_flags(parser)
     args = parser.parse_args(argv)
 
     cfg = ServerConfig.from_yaml_file(args.config) if args.config \
@@ -3291,6 +3549,10 @@ def main(argv: Optional[Sequence[str]] = None) -> None:
     _shared_setup_logging(
         0, args.log_format,
         numeric_level=getattr(logging, cfg.log_level.upper(), 20))
+    tracing.configure(
+        sampling=args.trace_sampling,
+        recorder_size=args.trace_recorder_size,
+        slow_threshold_s=args.trace_slow_threshold)
 
     # the supervisor's rebuild path: a fresh engine (fresh compile)
     # from the same config. None when restarts are disabled — engine

@@ -458,11 +458,14 @@ class GatewayRouter:
             except Exception:   # noqa: BLE001 — the signal is advisory;
                 pass            # a failed stamp must never fail a request
 
-    def _wait_for_replica(self, deadline: Optional[float]) -> None:
+    def _wait_for_replica(self, deadline: Optional[float],
+                          sp=None) -> None:
         """Park until some replica admits (FIFO ticket, bounded queue,
         bounded wait). Caller holds the lock. Raises QueueFull /
         DeadlineExceeded on shed — each with its one terminal
-        accounting."""
+        accounting. ``sp`` (the journey's root span) gets the measured
+        wait as ``door_wait_s`` so a stitched trace can attribute TTFT
+        to the door."""
         cfg = self.cfg
         if len(self._door) >= cfg.max_door_queue:
             self._note_shed(REASON_DOOR_QUEUE)
@@ -495,7 +498,10 @@ class GatewayRouter:
         finally:
             self._door.remove(ticket)
             self._door_depth_changed()
-            self.h_door_wait.observe(self.clock() - t0)
+            waited = self.clock() - t0
+            self.h_door_wait.observe(waited)
+            if sp is not None and sp.recording:
+                sp.set_attr("door_wait_s", round(waited, 6))
 
     # -- dispatch --------------------------------------------------------
     def _pick(self, key: Optional[str],
@@ -636,7 +642,7 @@ class GatewayRouter:
                                  "affinity_key": key or ""}) as sp:
             tokens, name, attempts = self._dispatch(
                 prompt, max_new_tokens, deadline, key, sampling,
-                tenant)
+                tenant, sp)
             sp.set_attr("replica", name)
             sp.set_attr("attempts", attempts)
         return tokens, name, attempts
@@ -655,7 +661,7 @@ class GatewayRouter:
         return rem
 
     def _dispatch(self, prompt, max_new_tokens, deadline, key, sampling,
-                  tenant=None):
+                  tenant=None, sp=None):
         if self.transport is None:
             raise RuntimeError("router has no transport")
         last: Optional[Exception] = None
@@ -668,37 +674,56 @@ class GatewayRouter:
             rem = self._remaining(deadline)
             with self._lock:
                 if not self._admitting():
-                    self._wait_for_replica(deadline)
+                    self._wait_for_replica(deadline, sp)
                 self._admit(tenant)
                 rep = self._pick(key, tried)
                 if rep is None:
                     continue
                 self._inflight_delta(rep.name, +1)
                 offer = self._fabric_offer(rep, prompt, tenant)
+            # each attempt is its own child span under the journey root:
+            # retries show up as SIBLINGS, and the winning attempt's
+            # context rides the wire as `traceparent` so the replica's
+            # serve.request parents into this trace instead of minting
+            # a fresh one
+            asp = tracing.start_span(
+                "gateway.attempt", component="gateway", parent=sp,
+                attrs={"replica": rep.name, "attempt": attempt + 1})
             req = {"prompt": list(prompt),
                    "max_new_tokens": max_new_tokens,
                    "deadline_s": rem, "sampling": dict(samp)}
+            if asp.recording:
+                req["traceparent"] = asp.context.encode()
             if offer is not None:
                 req["kv_sources"] = [offer]
             try:
                 tokens = self.transport(rep, req)
-            except Infeasible:
+                asp.set_attr("outcome", "completed")
+            except Infeasible as e:
+                asp.set_attr("outcome", "infeasible")
+                asp.set_error(str(e))
                 with self._lock:
                     self._counts["failed"] += 1
                 self.m_requests.labels("failed").inc()
                 raise
-            except DeadlineExceeded:
+            except DeadlineExceeded as e:
+                asp.set_attr("outcome", "deadline")
+                asp.set_error(str(e))
                 with self._lock:
                     self._counts["deadline"] += 1
                 self.m_requests.labels("deadline").inc()
                 raise
             except (QueueFull, ReplicaUnreachable, TimeoutError,
                     RuntimeError) as e:
+                cause = self._retry_cause(e)
+                asp.set_attr("outcome", cause)
+                asp.set_attr("backoff_reason", cause)
+                asp.set_error(str(e))
                 last = e
                 tried.add(rep.name)
                 with self._lock:
                     self._retries += 1
-                self.m_retries.labels(self._retry_cause(e)).inc()
+                self.m_retries.labels(cause).inc()
                 if isinstance(e, QueueFull) \
                         and e.reason == REASON_TENANT:
                     # tenant-aware retry: per-replica quota sheds get
@@ -713,6 +738,7 @@ class GatewayRouter:
                 self.sleep(self._backoff_s(e, attempt))
                 continue
             finally:
+                asp.end()
                 with self._lock:
                     self._inflight_delta(rep.name, -1)
             if isinstance(tokens, dict):
@@ -903,7 +929,7 @@ class GatewayRouter:
         if tenant is not None:
             samp["tenant"] = tenant
 
-        def gen():
+        def attempts(root):
             last: Optional[Exception] = None
             tried: set = set()
             tq_sheds = 0
@@ -911,16 +937,21 @@ class GatewayRouter:
                 rem = self._remaining(deadline)
                 with self._lock:
                     if not self._admitting():
-                        self._wait_for_replica(deadline)
+                        self._wait_for_replica(deadline, root)
                     self._admit(tenant)
                     rep = self._pick(key, tried)
                     if rep is None:
                         continue
                     self._inflight_delta(rep.name, +1)
                     offer = self._fabric_offer(rep, prompt, tenant)
+                asp = tracing.start_span(
+                    "gateway.attempt", component="gateway", parent=root,
+                    attrs={"replica": rep.name, "attempt": attempt + 1})
                 req = {"prompt": list(prompt),
                        "max_new_tokens": max_new_tokens,
                        "deadline_s": rem, "sampling": dict(samp)}
+                if asp.recording:
+                    req["traceparent"] = asp.context.encode()
                 if offer is not None:
                     req["kv_sources"] = [offer]
                 started = False
@@ -970,23 +1001,32 @@ class GatewayRouter:
                         for delta in self.stream_transport(rep, req):
                             started = True
                             yield delta
+                    asp.set_attr("outcome", "completed")
+                    root.set_attr("replica", rep.name)
+                    root.set_attr("attempts", attempt + 1)
                     with self._lock:
                         self._counts["completed"] += 1
                     self.m_requests.labels("completed").inc()
                     return
-                except Infeasible:
+                except Infeasible as e:
+                    asp.set_attr("outcome", "infeasible")
+                    asp.set_error(str(e))
                     with self._lock:
                         self._counts["failed"] += 1
                     self.m_requests.labels("failed").inc()
                     raise
-                except DeadlineExceeded:
+                except DeadlineExceeded as e:
+                    asp.set_attr("outcome", "deadline")
+                    asp.set_error(str(e))
                     with self._lock:
                         self._counts["deadline"] += 1
                     self.m_requests.labels("deadline").inc()
                     raise
-                except HandoffResumeError:
+                except HandoffResumeError as e:
                     # phase 2 failed before first byte: terminal — the
                     # KV already moved, re-dispatch would re-prefill
+                    asp.set_attr("outcome", "handoff_failed")
+                    asp.set_error(str(e))
                     with self._lock:
                         self._counts["failed"] += 1
                     self.m_requests.labels("failed").inc()
@@ -996,15 +1036,21 @@ class GatewayRouter:
                         RuntimeError) as e:
                     if started:
                         # first byte is out: exactly-once forbids replay
+                        asp.set_attr("outcome", "failed_midstream")
+                        asp.set_error(str(e))
                         with self._lock:
                             self._counts["failed"] += 1
                         self.m_requests.labels("failed").inc()
                         raise
+                    cause = self._retry_cause(e)
+                    asp.set_attr("outcome", cause)
+                    asp.set_attr("backoff_reason", cause)
+                    asp.set_error(str(e))
                     last = e
                     tried.add(rep.name)
                     with self._lock:
                         self._retries += 1
-                    self.m_retries.labels(self._retry_cause(e)).inc()
+                    self.m_retries.labels(cause).inc()
                     if isinstance(e, QueueFull) \
                             and e.reason == REASON_TENANT:
                         # same tenant-aware retry cap as dispatch()
@@ -1014,10 +1060,34 @@ class GatewayRouter:
                     self.sleep(self._backoff_s(e, attempt))
                     continue
                 finally:
+                    asp.end()
                     if not released:
                         with self._lock:
                             self._inflight_delta(rep.name, -1)
             self._raise_exhausted(last)
+
+        def gen():
+            # a generator cannot hold a contextvar scope open across
+            # yields, so the journey root is an EXPLICIT span ended in
+            # the outer finally; attempts parent on it by reference —
+            # retries land as siblings under this one root
+            root = tracing.start_span(
+                "gateway.request", component="gateway",
+                attrs={"prompt_tokens": len(prompt),
+                       "tenant": tenant or "",
+                       "affinity_key": key or "",
+                       "stream": True})
+            try:
+                yield from attempts(root)
+            except GeneratorExit:
+                # client hung up: a cancel, not a fault — don't pin
+                root.set_attr("outcome", "cancelled")
+                raise
+            except BaseException as e:  # noqa: BLE001 — span bookkeeping
+                root.set_error(str(e))
+                raise
+            finally:
+                root.end()
 
         return gen()
 

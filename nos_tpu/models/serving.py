@@ -502,6 +502,13 @@ class DecodeServer:
         self.pipeline_flushes = 0
         self.tokens_emitted = 0
         self._idle_since: Optional[float] = None
+        # tick-phase seam for the serving loop's profiler: the last
+        # step_begin's host time split into assembly (block discipline,
+        # admission, batch composition) vs device dispatch — derived
+        # from the perf_counter reads _dispatch_tick already takes
+        # plus one pair at step_begin's edges
+        self.last_assemble_s = 0.0
+        self._begin_dispatch_s = 0.0
         # request-level latency ledger (see _Ledger): always stamps the
         # per-REQUEST milestones (submit/admit/prefill/first/done — a
         # handful of clock reads per request); ``ledger_enabled`` gates
@@ -2663,12 +2670,18 @@ class DecodeServer:
         blocks COW-copied; pool pressure resolves by barrier-flush ->
         prefix eviction -> preemption, each of which changes the batch
         composition — the loop recomputes the active set and retries."""
+        t_begin = time.perf_counter()
+        self._begin_dispatch_s = 0.0
         active = self._active_slots()
         while active and len(self._inflight) < self.pipeline_depth:
             if not self._pre_dispatch(active):
                 active = self._active_slots()
                 continue
             self._dispatch_tick(active)
+        # everything in this call that was NOT inside _dispatch_tick is
+        # assembly: block discipline, batch composition, keep-mask work
+        self.last_assemble_s = max(
+            0.0, time.perf_counter() - t_begin - self._begin_dispatch_s)
         return self._inflight[0] if self._inflight else None
 
     def step_wait(self, ent: Optional[_InFlight]) -> None:
@@ -2754,7 +2767,9 @@ class DecodeServer:
             copy = getattr(a, "copy_to_host_async", None)
             if copy is not None:
                 copy()
-        self.host_block_s += time.perf_counter() - t0
+        dt = time.perf_counter() - t0
+        self.host_block_s += dt
+        self._begin_dispatch_s += dt
         self._inflight.append(_InFlight(payload, tuple(active)))
 
     def _keep_mask(self, active: Tuple[int, ...]) -> jax.Array:

@@ -1,5 +1,5 @@
-"""Metrics <-> docs drift guard (ISSUE 3 satellite) and metric-name
-lint (ISSUE 5 satellite).
+"""Metrics <-> docs drift guard (ISSUE 3 satellite), metric-name lint
+(ISSUE 5 satellite), and span-name registry lint (ISSUE 18 satellite).
 
 The `docs/telemetry.md` table is only useful if it is trustworthy: every
 metric registered anywhere in `nos_tpu/` must appear in the table, and
@@ -11,6 +11,11 @@ without importing JAX-heavy modules.
 The lint keeps future instruments Prometheus-conventional: `nos_`
 prefix, counters end `_total`, timing/size series end `_seconds` /
 `_bytes`, nothing collides with the reserved histogram sample suffixes.
+
+The span registry works the same way for traces: every span-name
+literal minted anywhere in `nos_tpu/` must have a row in the
+`docs/tracing.md` taxonomy table, and names must read as dotted
+`component.verb` so a trace is legible without the source open.
 """
 import os
 import re
@@ -140,3 +145,91 @@ def test_metric_names_follow_prometheus_conventions():
                 assert name.endswith(f"_{unit}"), (
                     f"{where} — '{unit}' must be the terminal unit "
                     f"suffix")
+
+
+# ---------------------------------------------------------------------------
+# span-name registry: every span minted in code has a tracing.md row
+# ---------------------------------------------------------------------------
+
+# any span construction site with its name as a string literal: the
+# context-manager form (`tracing.span("...")`), the explicit form
+# (`start_span("...")`), and raw Span(...) synthesis (trace_export
+# inputs). Dynamic names (f-strings, "prefix" + var) are linted at
+# their literal prefix when one exists, else invisible to the scan —
+# keep span names literal so the registry stays complete.
+SPAN_SITE = re.compile(
+    r'(?:\bstart_span|\.span|\bSpan)\(\s*["\']([A-Za-z0-9_.]+)["\']')
+
+# tracing.md documents families with placeholders (`tick.<phase>`): a
+# code literal matches a doc name either exactly or as the prefix left
+# of the placeholder
+DOC_SPAN = re.compile(r"`([a-z][a-z0-9_.<>]*)`")
+
+# pre-taxonomy chaos-harness phase spans: named for the MTTR phase they
+# time inside a lifecycle.repair episode, grandfathered as the CLOSED
+# exception to dotted component.verb naming
+UNDOTTED_SPANS = {"detect", "rebind"}
+
+
+def minted_span_names():
+    sites = []
+    for path, text in _metric_sources():
+        for name in SPAN_SITE.findall(text):
+            sites.append((path, name))
+    return sites
+
+
+def documented_span_names():
+    names = set()
+    in_table = False
+    with open(os.path.join(REPO, "docs", "tracing.md")) as f:
+        for line in f:
+            if line.startswith("| Span |"):
+                in_table = True
+                continue
+            if in_table and not line.strip().startswith("|"):
+                in_table = False
+            if in_table:
+                first_cell = line.split("|")[1]
+                names.update(DOC_SPAN.findall(first_cell))
+    return names
+
+
+def _doc_covers(name, doc):
+    if name in doc:
+        return True
+    for d in doc:
+        if "<" in d and name.rstrip(".") == d.split("<")[0].rstrip("."):
+            return True
+    return False
+
+
+def test_every_minted_span_is_documented():
+    sites = minted_span_names()
+    assert sites, "scan must find the span sites"
+    doc = documented_span_names()
+    assert doc, "tracing.md span table must not be empty"
+    missing = sorted({name for _p, name in sites
+                      if not _doc_covers(name, doc)})
+    assert not missing, (
+        f"spans minted in code but missing from the docs/tracing.md "
+        f"taxonomy table: {missing} — add a row for each")
+
+
+def test_span_names_are_dotted_component_verb():
+    for path, name in minted_span_names():
+        if name in UNDOTTED_SPANS:
+            continue
+        where = f"{os.path.relpath(path, REPO)}: span {name!r}"
+        if name.endswith("."):
+            # a prefix literal ("tick." + phase) mints a dotted family;
+            # the component segment must still be well-formed
+            assert re.fullmatch(r"[a-z][a-z0-9_]*\.", name), (
+                f"{where} — span-family prefix must be a lowercase "
+                f"snake component followed by a dot")
+            continue
+        assert re.fullmatch(
+            r"[a-z][a-z0-9_]*\.[a-z][a-z0-9_]*", name), (
+            f"{where} — span names are dotted component.verb "
+            f"(lowercase snake segments); undotted legacy names live "
+            f"in UNDOTTED_SPANS only by explicit exception")

@@ -1,0 +1,240 @@
+"""Decode-tick phase profiler (ISSUE 18): the serving-loop tick
+decomposed into assemble / dispatch / wait / sample / bookkeep under
+the one-clock-read discipline — the nos_tpu_serve_tick_phase_seconds
+histogram, the /stats rolling breakdown, and the /debug/profile
+Perfetto export of the last N ticks. Jax-free: stub engines, the real
+ServingLoop + HTTP surface."""
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from nos_tpu.cmd.server import (
+    TICK_PHASES, ServerConfig, ServingLoop, make_http_server,
+)
+from test_trace_stitching import _InstantEngine, fresh_recorder
+
+
+class _SplitEngine(_InstantEngine):
+    """Split-step stub with a visible wait phase and an assemble stamp
+    (the DecodeServer seam: ``last_assemble_s`` is host work inside
+    step_begin minus its dispatch call)."""
+
+    last_assemble_s = 0.0
+
+    def step_begin(self):
+        t0 = time.perf_counter()
+        time.sleep(0.002)       # host-side assemble work
+        self.last_assemble_s = time.perf_counter() - t0
+        return object()
+
+    def step_wait(self, handle):
+        time.sleep(0.004)       # the "device" computes
+
+    def step_finish(self, handle):
+        return self.step()
+
+
+def test_tick_phases_in_stats_and_histogram():
+    loop = ServingLoop(_SplitEngine())
+    try:
+        loop.generate([1, 2], 2, timeout=30)
+        snap = loop.stats()["tick_phases"]
+        assert snap["window"] >= 1
+        assert set(snap["seconds"]) == set(TICK_PHASES)
+        assert all(v >= 0.0 for v in snap["seconds"].values())
+        # the split protocol's signature: a real wait phase, and the
+        # assemble stamp carved out of the pre-dispatch host time
+        assert snap["seconds"]["wait"] > 0.0
+        assert snap["seconds"]["assemble"] > 0.0
+        # every phase label observed, one histogram sample per phase
+        # per tick
+        n = None
+        for ph in TICK_PHASES:
+            child = loop.h_tick_phase.labels(ph)
+            assert child.count >= 1
+            n = child.count if n is None else n
+            assert child.count == n, "phases must tick in lockstep"
+    finally:
+        loop.shutdown()
+
+
+def test_tick_phases_whole_step_engine_buckets_under_dispatch():
+    """step()-only engines (no split protocol) can't be decomposed:
+    the whole step lands under ``dispatch`` and wait/sample stay
+    zero — phases never lie about a seam that wasn't measured."""
+    loop = ServingLoop(_InstantEngine())
+    try:
+        loop.generate([1], 2, timeout=30)
+        snap = loop.stats()["tick_phases"]
+        assert snap["window"] >= 1
+        assert snap["seconds"]["dispatch"] >= 0.0
+        assert snap["seconds"]["wait"] == 0.0
+        assert snap["seconds"]["sample"] == 0.0
+        assert snap["seconds"]["assemble"] == 0.0
+    finally:
+        loop.shutdown()
+
+
+def test_profile_trace_shape_and_recorder_isolation():
+    loop = ServingLoop(_SplitEngine())
+    try:
+        # no ticks yet: a valid, empty Perfetto document
+        assert loop.profile_trace() == {"traceEvents": [],
+                                        "displayTimeUnit": "ms"}
+        loop.generate([1, 2, 3], 3, timeout=30)
+        with fresh_recorder() as rec:
+            doc = loop.profile_trace(last_n=8)
+            # synthesized spans must NEVER feed the flight recorder —
+            # /debug/profile is a read, not a write
+            assert rec.trace_ids() == []
+        evs = [e for e in doc["traceEvents"] if e["ph"] == "X"]
+        assert evs, "at least one tick drawn"
+        roots = [e for e in evs if e["name"] == "serve.tick"]
+        kids = [e for e in evs if e["name"].startswith("tick.")]
+        assert roots and kids
+        assert {e["name"] for e in kids} <= {
+            "tick." + ph for ph in TICK_PHASES}
+        # one Perfetto lane: every tick shares the synthetic trace id
+        assert len({e["tid"] for e in evs}) == 1
+        # children tile their root: phase spans sit inside the tick
+        r0 = roots[0]
+        for e in kids:
+            if e["args"]["trace_id"] == r0["args"]["trace_id"]:
+                assert e["ts"] >= r0["ts"] - 1e-6
+        # last_n bounds the window
+        one = loop.profile_trace(last_n=1)
+        assert len([e for e in one["traceEvents"]
+                    if e.get("name") == "serve.tick"]) == 1
+    finally:
+        loop.shutdown()
+
+
+def test_debug_profile_endpoint_over_http():
+    loop = ServingLoop(_SplitEngine())
+    httpd = make_http_server(ServerConfig(port=0), loop)
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    url = f"http://127.0.0.1:{httpd.server_address[1]}"
+    try:
+        body = json.dumps({"prompt": [5], "max_new_tokens": 2}).encode()
+        req = urllib.request.Request(
+            url + "/v1/generate", data=body,
+            headers={"Content-Type": "application/json"}, method="POST")
+        with urllib.request.urlopen(req, timeout=30) as r:
+            json.loads(r.read())
+        with urllib.request.urlopen(url + "/debug/profile?ticks=4",
+                                    timeout=10) as r:
+            doc = json.loads(r.read())
+        assert doc["displayTimeUnit"] == "ms"
+        names = {e["name"] for e in doc["traceEvents"]
+                 if e["ph"] == "X"}
+        assert "serve.tick" in names
+        assert any(n.startswith("tick.") for n in names)
+        # a garbage ?ticks is a clean 400, not a 500
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(url + "/debug/profile?ticks=soon",
+                                   timeout=10)
+        assert ei.value.code == 400
+    finally:
+        httpd.shutdown()
+        loop.shutdown()
+
+
+def test_phase_histogram_carries_tick_exemplars():
+    """A slow phase must link to the concrete serve.tick trace that
+    produced it: the labeled histogram observes with the tick span's
+    trace_id, surfacing OpenMetrics exemplars."""
+    loop = ServingLoop(_SplitEngine())
+    try:
+        loop.generate([1], 2, timeout=30)
+        child = loop.h_tick_phase.labels("wait")
+        assert child.exemplars is not None
+        assert any(ex is not None for ex in child.exemplars)
+    finally:
+        loop.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# bench_profile: TTFT decomposition over stitched traces
+# ---------------------------------------------------------------------------
+
+def _journey(tid="a" * 32, t0=100.0):
+    """A deterministic disaggregated journey as span dicts (fixed
+    floats — byte-reproducibility needs identical inputs, and the
+    decomposition itself must add no entropy)."""
+    return [
+        {"name": "gateway.request", "component": "gateway",
+         "trace_id": tid, "span_id": "r" * 16, "parent_id": None,
+         "start": t0, "end": t0 + 2.0,
+         "attrs": {"door_wait_s": 0.25, "attempts": 2}},
+        {"name": "gateway.attempt", "component": "gateway",
+         "trace_id": tid, "span_id": "a1" * 8, "parent_id": "r" * 16,
+         "start": t0 + 0.3, "end": t0 + 0.35,
+         "attrs": {"attempt": 1, "outcome": "unreachable"}},
+        {"name": "gateway.attempt", "component": "gateway",
+         "trace_id": tid, "span_id": "a2" * 8, "parent_id": "r" * 16,
+         "start": t0 + 0.4, "end": t0 + 2.0,
+         "attrs": {"attempt": 2, "outcome": "completed"}},
+        {"name": "serve.request", "component": "server",
+         "trace_id": tid, "span_id": "p" * 16, "parent_id": "a2" * 8,
+         "start": t0 + 0.45, "end": t0 + 1.0,
+         "attrs": {"role": "prefill", "queue_ms": 50.0,
+                   "ttft_ms": 500.0}},
+        {"name": "serve.request", "component": "server",
+         "trace_id": tid, "span_id": "d" * 16, "parent_id": "p" * 16,
+         "start": t0 + 1.2, "end": t0 + 2.0,
+         "attrs": {"role": "decode", "adopted": True,
+                   "ttft_ms": 80.0}},
+    ]
+
+
+def test_ttft_decomposition_values():
+    import bench_profile
+
+    row = bench_profile.decompose_ttft(_journey())
+    assert row == {
+        "trace_id": "a" * 32,
+        "door_wait_s": 0.25,
+        # winning (completed) attempt start - root start - door wait
+        "route_s": pytest.approx(0.15),
+        "queue_s": pytest.approx(0.05),
+        # prefill ttft minus its queueing share
+        "prefill_s": pytest.approx(0.45),
+        # prefill span end -> decode span start (ship + adopt)
+        "handoff_s": pytest.approx(0.2),
+        "first_decode_tick_s": pytest.approx(0.08),
+        "attempts": 2,
+    }
+    # colocated journey: no prefill/decode pair, no handoff phases
+    colo = [s for s in _journey() if s["attrs"].get("role") != "decode"]
+    colo[-1]["attrs"]["role"] = "colocated"
+    row2 = bench_profile.decompose_ttft(colo)
+    assert row2["handoff_s"] is None
+    assert row2["first_decode_tick_s"] is None
+    assert row2["queue_s"] == pytest.approx(0.05)
+    # a span set with no gateway root is not a journey
+    assert bench_profile.decompose_ttft(
+        [s for s in _journey() if s["name"] != "gateway.request"]) is None
+
+
+def test_ttft_artifact_is_byte_reproducible(tmp_path):
+    import bench_profile
+
+    spans = _journey() + _journey(tid="b" * 32, t0=500.0)
+    p1 = tmp_path / "one.json"
+    p2 = tmp_path / "two.json"
+    bench_profile.write_ttft_artifact(spans, path=str(p1))
+    # same spans, shuffled order: the artifact must not depend on
+    # input ordering (traces are sorted, keys canonicalized)
+    bench_profile.write_ttft_artifact(list(reversed(spans)),
+                                      path=str(p2))
+    b1, b2 = p1.read_bytes(), p2.read_bytes()
+    assert b1 == b2, "TTFT artifact must be byte-reproducible"
+    doc = json.loads(b1)
+    assert doc["section"] == "ttft_decomposition"
+    assert doc["journeys"] == 2
+    assert [r["trace_id"] for r in doc["requests"]] == \
+        ["a" * 32, "b" * 32]

@@ -1,0 +1,445 @@
+"""Cross-process trace stitching (ISSUE 18): ONE trace_id per request
+across the disaggregated fleet. The gateway roots the journey
+(gateway.request) with each retry a sibling gateway.attempt child; the
+winning attempt's context rides the wire as a `traceparent` header so
+the replica's serve.request adopts the trace; the prefill->decode
+handoff carries it on the same JSON meta plane as deadline_s; and the
+KV-fabric peer pull files kvfabric.pull / kvfabric.serve spans into the
+same journey. All jax-free: stub engines behind the REAL HTTP surfaces.
+"""
+import contextlib
+import json
+import threading
+import urllib.request
+
+from nos_tpu.cmd.server import ServerConfig, ServingLoop, make_http_server
+from nos_tpu.gateway import (
+    GatewayRouter, Replica, ReplicaUnreachable, RouterConfig,
+)
+from nos_tpu.kvfabric.codec import FABRIC_TOKEN_HEADER
+from nos_tpu.obs import tracing
+from nos_tpu.obs.tracing import FlightRecorder, SpanContext
+
+
+@contextlib.contextmanager
+def fresh_recorder():
+    """Scope the module-level tracer to a private recorder so
+    assertions see exactly this test's spans."""
+    rec = FlightRecorder()
+    old = tracing._default_tracer.recorder
+    tracing._default_tracer.recorder = rec
+    try:
+        yield rec
+    finally:
+        tracing._default_tracer.recorder = old
+
+
+def one_trace(rec, name):
+    """The single trace containing a span called ``name``."""
+    hits = [tid for tid in rec.trace_ids()
+            if any(sp.name == name for sp in rec.trace(tid))]
+    assert len(hits) == 1, f"expected one {name} trace, got {hits}"
+    return rec.trace(hits[0])
+
+
+def by_name(spans, name):
+    out = [sp for sp in spans if sp.name == name]
+    assert len(out) == 1, f"expected one {name}, got {len(out)}"
+    return out[0]
+
+
+def assert_no_orphans(spans):
+    ids = {sp.span_id for sp in spans}
+    roots = [sp for sp in spans if sp.parent_id is None]
+    assert len(roots) == 1, \
+        f"one root expected, got {[sp.name for sp in roots]}"
+    for sp in spans:
+        assert sp.parent_id is None or sp.parent_id in ids, \
+            f"orphan span {sp.name}: parent {sp.parent_id} not in trace"
+
+
+# ---------------------------------------------------------------------------
+# gateway: retries are SIBLING attempt spans under one root
+# ---------------------------------------------------------------------------
+
+def test_retry_attempts_are_sibling_spans_under_one_root():
+    reqs = []
+
+    def transport(rep, req):
+        reqs.append(req)
+        if len(reqs) == 1:
+            raise ReplicaUnreachable("first replica down")
+        return list(req["prompt"]) + [5]
+
+    router = GatewayRouter(
+        RouterConfig(max_attempts=3, backoff_s=0.0),
+        transport=transport, sleep=lambda s: None)
+    router.update([Replica(name="a"), Replica(name="b")])
+    with fresh_recorder() as rec:
+        toks, name, attempts = router.dispatch([1, 2], 1)
+    assert attempts == 2 and toks == [1, 2, 5]
+
+    spans = one_trace(rec, "gateway.request")
+    root = by_name(spans, "gateway.request")
+    assert root.parent_id is None
+    assert root.attrs["replica"] == name
+    assert root.attrs["attempts"] == 2
+    att = sorted((sp for sp in spans if sp.name == "gateway.attempt"),
+                 key=lambda sp: sp.attrs["attempt"])
+    assert len(att) == 2
+    # siblings: BOTH parent on the root, not on each other
+    assert [sp.parent_id for sp in att] == [root.span_id] * 2
+    assert att[0].status == "error"
+    assert att[0].attrs["outcome"] == "unreachable"
+    assert att[0].attrs["backoff_reason"] == "unreachable"
+    assert att[1].attrs["outcome"] == "completed"
+    assert att[1].status == "ok"
+    # the wire traceparent of each attempt IS that attempt's context —
+    # a replica's serve.request parents under the attempt that reached
+    # it, never under a failed sibling
+    ctxs = [SpanContext.decode(r["traceparent"]) for r in reqs]
+    assert [c.span_id for c in ctxs] == [sp.span_id for sp in att]
+    assert {c.trace_id for c in ctxs} == {root.trace_id}
+    assert_no_orphans(spans)
+
+
+def test_door_wait_lands_on_the_journey_root():
+    """Time parked at the scale-from-zero door is the one TTFT phase
+    only the gateway can see: the root span records it so the
+    bench_profile decomposition can attribute it."""
+    router = GatewayRouter(
+        RouterConfig(max_attempts=2, backoff_s=0.0, door_wait_s=10.0),
+        transport=lambda rep, req: list(req["prompt"]) + [4],
+        sleep=lambda s: None)
+    router.update([Replica(name="a", ready=False)])
+
+    def wake():
+        router.update([Replica(name="a", ready=True)])
+
+    t = threading.Timer(0.05, wake)
+    with fresh_recorder() as rec:
+        t.start()
+        toks, _, _ = router.dispatch([1], 1)
+        t.join()
+    assert toks == [1, 4]
+    root = by_name(one_trace(rec, "gateway.request"), "gateway.request")
+    assert root.attrs["door_wait_s"] > 0.0
+
+
+def test_stream_cancelled_by_client_is_not_an_error_trace():
+    """A client hanging up mid-SSE closes the generator: the journey
+    root records outcome=cancelled but must NOT carry error status
+    (the recorder would pin every hangup as evidence)."""
+    def stream_transport(rep, req):
+        yield [1]
+        yield [2]
+        yield [3]
+
+    router = GatewayRouter(
+        RouterConfig(max_attempts=2, backoff_s=0.0),
+        transport=lambda rep, req: [0],
+        stream_transport=stream_transport, sleep=lambda s: None)
+    router.update([Replica(name="a")])
+    with fresh_recorder() as rec:
+        gen = router.stream([9], 3)
+        assert next(gen) == [1]
+        gen.close()
+    spans = one_trace(rec, "gateway.request")
+    root = by_name(spans, "gateway.request")
+    assert root.attrs["outcome"] == "cancelled"
+    assert root.status == "ok"
+
+
+# ---------------------------------------------------------------------------
+# stub engines: a prefill loop that parks every submit as a handoff,
+# and a decode loop that adopts and finishes in a few ticks
+# ---------------------------------------------------------------------------
+
+class _InstantEngine:
+    """Three-tokens-then-done stub (no split-step protocol)."""
+
+    def __init__(self):
+        self.pending, self.done, self._rid = {}, {}, 0
+
+    def submit(self, prompt, n, **kw):
+        rid = self._rid
+        self._rid += 1
+        self.pending[rid] = min(3, n)
+        return rid
+
+    def has_work(self):
+        return bool(self.pending)
+
+    def step(self):
+        for rid, n in list(self.pending.items()):
+            self.done[rid] = list(range(n))
+            del self.pending[rid]
+        return 1
+
+    def progress(self, rid):
+        if rid in self.done:
+            return list(self.done[rid]), True
+        if rid in self.pending:
+            return [], False
+        return None
+
+    def pop_result(self, rid):
+        return self.done.pop(rid, None)
+
+
+class _PrefillEngine(_InstantEngine):
+    """Every submit is immediately a parked handoff state."""
+
+    def __init__(self):
+        super().__init__()
+        self._handoffs = []
+
+    def submit(self, prompt, n, **kw):
+        rid = self._rid
+        self._rid += 1
+        self._handoffs.append({"rid": rid, "prompt": list(prompt),
+                               "max_new_tokens": n})
+        return rid
+
+    def pop_handoffs(self):
+        out, self._handoffs = self._handoffs, []
+        return out
+
+
+class _AdoptingEngine(_InstantEngine):
+    def restore(self, state):
+        rid = self._rid
+        self._rid += 1
+        self.pending[rid] = 3
+        return rid
+
+    def cancel(self, rid):
+        self.pending.pop(rid, None)
+
+
+def _serve(loop, **cfg_kw):
+    httpd = make_http_server(ServerConfig(port=0, **cfg_kw), loop)
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    return httpd, f"http://127.0.0.1:{httpd.server_address[1]}"
+
+
+# ---------------------------------------------------------------------------
+# the whole wire: gateway -> prefill -> decode, one trace_id
+# ---------------------------------------------------------------------------
+
+def test_one_trace_spans_gateway_prefill_decode_over_http():
+    """The acceptance spine: a request through the REAL gateway HTTP
+    door to a REAL prefill-role server whose handoff ships over HTTP
+    to a REAL decode-role server — every hop lands in ONE trace with
+    correct parenting (gateway.request -> gateway.attempt ->
+    serve.request[prefill] -> serve.request[decode]) and zero orphan
+    spans, and the stitched span set decomposes into the bench_profile
+    TTFT phases."""
+    from nos_tpu.cmd.gateway import (
+        HttpReplicaTransport, make_http_server as make_gw_server,
+    )
+
+    with fresh_recorder() as rec:
+        dec_loop = ServingLoop(_AdoptingEngine(), role="decode")
+        dec_httpd, dec_url = _serve(dec_loop, role="decode")
+
+        def _http_send(target, data):
+            req = urllib.request.Request(
+                target.rstrip("/") + "/v1/handoff", data=data,
+                headers={"Content-Type": "application/octet-stream"},
+                method="POST")
+            with urllib.request.urlopen(req, timeout=30) as resp:
+                return int(json.loads(resp.read())["rid"])
+
+        pre_loop = ServingLoop(
+            _PrefillEngine(), role="prefill",
+            handoff_targets=[dec_url], handoff_send=_http_send)
+        pre_httpd, pre_url = _serve(pre_loop, role="prefill",
+                                    decode_pool=dec_url)
+
+        transport = HttpReplicaTransport(timeout_s=30.0)
+        router = GatewayRouter(
+            RouterConfig(max_attempts=4, backoff_s=0.01),
+            transport=transport.send,
+            stream_transport=transport.send_stream,
+            resume_transport=transport.resume,
+            resume_stream_transport=transport.resume_stream)
+        router.update([
+            Replica(name="pre-0", handle=pre_url, role="prefill"),
+            Replica(name="dec-0", handle=dec_url, role="decode"),
+        ])
+        gw_httpd = make_gw_server(router, 0, "web")
+        threading.Thread(target=gw_httpd.serve_forever,
+                         daemon=True).start()
+        gw = f"http://127.0.0.1:{gw_httpd.server_address[1]}"
+        try:
+            req = urllib.request.Request(
+                gw + "/v1/generate",
+                data=json.dumps({"prompt": [1, 2, 3],
+                                 "max_new_tokens": 6,
+                                 "deadline_s": 30}).encode(),
+                headers={"Content-Type": "application/json"},
+                method="POST")
+            with urllib.request.urlopen(req, timeout=30) as r:
+                body = json.loads(r.read())
+            assert body["tokens"] == [1, 2, 3, 0, 1, 2]
+        finally:
+            gw_httpd.shutdown()
+            pre_httpd.shutdown()
+            dec_httpd.shutdown()
+            pre_loop.shutdown()
+            dec_loop.shutdown()
+
+        spans = one_trace(rec, "gateway.request")
+        root = by_name(spans, "gateway.request")
+        attempt = by_name(spans, "gateway.attempt")
+        serves = [sp for sp in spans if sp.name == "serve.request"]
+        pre_sp = next(sp for sp in serves
+                      if sp.attrs.get("role") == "prefill")
+        dec_sp = next(sp for sp in serves
+                      if sp.attrs.get("role") == "decode")
+        # the parenting chain IS the journey
+        assert attempt.parent_id == root.span_id
+        assert pre_sp.parent_id == attempt.span_id
+        assert dec_sp.parent_id == pre_sp.span_id
+        assert dec_sp.attrs["adopted"] is True
+        assert len({sp.trace_id for sp in spans}) == 1
+        assert_no_orphans(spans)
+        # every span closed: a stitched journey has no dangling work
+        assert all(sp.end_time is not None for sp in spans)
+
+        # the stitched spans ARE bench_profile's input: the TTFT
+        # decomposition finds the journey and its disagg phases
+        import bench_profile
+        doc = bench_profile.ttft_section([sp.to_dict() for sp in spans])
+        assert doc["journeys"] == 1
+        row = doc["requests"][0]
+        assert row["trace_id"] == root.trace_id
+        assert row["attempts"] == 1
+        assert row["door_wait_s"] >= 0.0
+        assert row["route_s"] >= 0.0
+        assert row["handoff_s"] >= 0.0
+
+
+def test_tracing_off_still_forwards_the_journey_header():
+    """A tracing-disabled prefill replica must not BREAK the fleet's
+    stitching: the inbound traceparent is forwarded verbatim through
+    the handoff meta plane even though this hop records nothing."""
+    shipped = []
+    loop = ServingLoop(
+        _PrefillEngine(), role="prefill",
+        handoff_targets=["http://dec"],
+        handoff_send=lambda t, d: shipped.append(d) or 1)
+    old = tracing._default_tracer.enabled
+    tracing._default_tracer.enabled = False
+    try:
+        wire = "00-" + "ab" * 16 + "-" + "cd" * 8 + "-01"
+        res = loop.prefill([1, 2], 4, timeout=10, traceparent=wire)
+        assert res["handoff"]["rid"] == 1
+    finally:
+        tracing._default_tracer.enabled = old
+        loop.shutdown()
+    from nos_tpu.models.handoff import decode_handoff
+    st = decode_handoff(shipped[0])
+    assert st["traceparent"] == wire
+
+
+# ---------------------------------------------------------------------------
+# KV-fabric legs: pull/serve/denial all join the request's trace
+# ---------------------------------------------------------------------------
+
+def test_fabric_pull_and_serve_spans_join_the_request_trace():
+    """A kv_sources offer honored at the puller files a kvfabric.pull
+    child under the request's inbound context; the holder's
+    /v1/kvchain files a kvfabric.serve child under the PULL span (the
+    header crossed the wire) — one trace covers both replicas."""
+    with fresh_recorder() as rec:
+        hold_loop = ServingLoop(_InstantEngine(),
+                                fabric_token="fleet-secret")
+        hold_httpd, hold_url = _serve(hold_loop,
+                                      kv_fabric_token="fleet-secret")
+        pull_loop = ServingLoop(_InstantEngine(),
+                                fabric_token="fleet-secret")
+        pull_httpd, pull_url = _serve(pull_loop,
+                                      kv_fabric_token="fleet-secret")
+        root = tracing.start_span("gateway.attempt", component="gateway")
+        try:
+            body = {"prompt": [1, 2], "max_new_tokens": 2,
+                    "kv_sources": [{
+                        "url": f"{hold_url}/v1/kvchain/d1gest",
+                        "digest": "d1gest", "replica": "holder"}]}
+            req = urllib.request.Request(
+                pull_url + "/v1/generate",
+                data=json.dumps(body).encode(),
+                headers={"Content-Type": "application/json",
+                         FABRIC_TOKEN_HEADER: "fleet-secret",
+                         "traceparent": root.context.encode()},
+                method="POST")
+            with urllib.request.urlopen(req, timeout=30) as r:
+                assert json.loads(r.read())["tokens"] == [1, 2, 0, 1]
+        finally:
+            root.end()
+            hold_httpd.shutdown()
+            pull_httpd.shutdown()
+            hold_loop.shutdown()
+            pull_loop.shutdown()
+
+        spans = one_trace(rec, "kvfabric.pull")
+        assert {sp.trace_id for sp in spans} == {root.trace_id}
+        pull = by_name(spans, "kvfabric.pull")
+        serve = by_name(spans, "kvfabric.serve")
+        assert pull.parent_id == root.span_id
+        # stub engines hold no chains: the holder answers miss, the
+        # puller records the miss — the OUTCOME is in the trace either
+        # way, which is the point
+        assert pull.attrs["outcome"] == "pull_miss"
+        assert pull.attrs["digest"] == "d1gest"
+        assert serve.parent_id == pull.span_id
+        assert serve.attrs["outcome"] == "miss"
+        # the request itself rides the same trace
+        sreq = by_name(spans, "serve.request")
+        assert sreq.parent_id == root.span_id
+
+
+def test_fabric_denied_pull_is_linked_into_the_trace():
+    """An offer arriving WITHOUT the fleet token is refused — and when
+    the request carries a trace, the denial is visible inside it as a
+    kvfabric.pull span with outcome=pull_denied. A tokenless probe
+    with no trace stays counters-only (no fresh recorder roots)."""
+    with fresh_recorder() as rec:
+        loop = ServingLoop(_InstantEngine())
+        httpd, url = _serve(loop, kv_fabric_token="fleet-secret")
+        root = tracing.start_span("gateway.attempt", component="gateway")
+        try:
+            body = {"prompt": [3], "max_new_tokens": 1,
+                    "kv_sources": [{"url": "http://evil/v1/kvchain/xx",
+                                    "digest": "xx"}]}
+            req = urllib.request.Request(
+                url + "/v1/generate", data=json.dumps(body).encode(),
+                headers={"Content-Type": "application/json",
+                         "traceparent": root.context.encode()},
+                method="POST")
+            with urllib.request.urlopen(req, timeout=30) as r:
+                json.loads(r.read())
+            # same offer, no trace context: counted, not recorded
+            req2 = urllib.request.Request(
+                url + "/v1/generate", data=json.dumps(body).encode(),
+                headers={"Content-Type": "application/json"},
+                method="POST")
+            with urllib.request.urlopen(req2, timeout=30) as r:
+                json.loads(r.read())
+        finally:
+            root.end()
+            httpd.shutdown()
+            loop.shutdown()
+        assert loop._pull_counts["pull_denied"] == 2
+        spans = one_trace(rec, "kvfabric.pull")
+        denied = by_name(spans, "kvfabric.pull")
+        assert denied.parent_id == root.span_id
+        assert denied.attrs["outcome"] == "pull_denied"
+        assert denied.attrs["digest"] == "xx"
+        # the traceless denial minted no recorder root
+        fab_traces = [tid for tid in rec.trace_ids()
+                      if any(sp.component == "kvfabric"
+                             for sp in rec.trace(tid))]
+        assert fab_traces == [denied.trace_id]
