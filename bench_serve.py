@@ -197,6 +197,258 @@ if SMOKE:
     DG_GAP_S = 0.12
 
 
+# stall-free colocated serving section (ISSUE 19): deadline-slack
+# budgeted chunked prefill vs the unconditional chunk-per-tick rule on
+# ONE colocated engine, under a FAKE cost-model clock: a pure decode
+# tick costs one unit; a tick that also forwards a prefill chunk costs
+# 1 + CC_CHUNK_COST (per-chunk forward cost is shape-fixed, so chunk
+# count IS the time axis — every number structural, reruns
+# byte-identical). Residents decode with TPOT-critical deadlines (one
+# tick of headroom below their completion floor, so ANY chunk stall
+# breaches); a burst of concurrent long prompts arrives mid-stream
+# with staggered TTFT deadlines. The claims the smoke test pins:
+#   tpot_flat: the budgeted arm's TPOT-slack clamp defers chunk work
+#     while resident slack is negative, so resident TPOT p99 holds
+#     the 1.0-unit decode floor; the unbudgeted arm stalls every tick
+#     of the burst and p99 blows up to 1 + CC_CHUNK_COST;
+#   prefill_within_bound: flatness has a price — budgeted prefill
+#     throughput stays within CC_PREFILL_BOUND of unbudgeted (the
+#     deferred chunks run after decode drains, they are not dropped);
+#   edf_orders_by_slack: prefills complete in deadline-slack order,
+#     not submit order (the EDF pick);
+#   bit_exact: every served token identical to the unbudgeted run —
+#     the budget changes WHEN a chunk runs, never its contents;
+#   shed at the earliest layer: with chunk backlog queued, an
+#     unmeetable deadline is refused at ADMISSION (the backlog term
+#     in the loop's estimate), before the engine sees a single token.
+CC_CHUNK = 32
+CC_BUDGET = 32              # tokens/tick: up to one chunk when slack allows
+CC_CHUNK_COST = 4.0         # one chunk forward ~ 4 decode-tick latencies
+CC_RESIDENT, CC_RES_PROMPT, CC_RES_NEW = 4, 8, 48
+CC_ARRIVALS, CC_ARR_PROMPT, CC_ARR_NEW = 4, 256, 4
+CC_MAX_LEN = 320
+CC_WARM_TICKS = 8           # resident decode ticks before the burst
+CC_PREFILL_BOUND = 2.5      # budgeted prefill throughput within this
+if SMOKE:
+    CC_RES_NEW = 32
+    CC_ARR_PROMPT = 128
+    CC_MAX_LEN = 160
+
+
+def chunked_colocated_section(params, cfg):
+    """The stall-free colocated rep (see the CC_* block): runs the SAME
+    code path main() ships, callable directly by the smoke test.
+    Every value is structural (clock units are decode ticks + chunk
+    forwards), so reruns serialize byte-identically."""
+    from nos_tpu.cmd.server import ServingLoop
+    from nos_tpu.models.errors import DeadlineUnmeetable
+    from nos_tpu.models.serving import DecodeServer
+
+    # arrival deadlines DESCEND with submit order: EDF must advance the
+    # last-submitted (tightest) prompt first — the opposite of FIFO
+    arr_deadlines = [100.0 * (CC_ARRIVALS - i) for i in range(CC_ARRIVALS)]
+    res_prompts = [[(3 * i + j) % (cfg.vocab - 2) + 1
+                    for j in range(CC_RES_PROMPT)]
+                   for i in range(CC_RESIDENT)]
+    arr_prompts = [[(5 * i + 7 * j) % (cfg.vocab - 2) + 1
+                    for j in range(CC_ARR_PROMPT)]
+                   for i in range(CC_ARRIVALS)]
+
+    def run(budget):
+        clock = [0.0]
+        eng = DecodeServer(params, cfg,
+                           max_batch=CC_RESIDENT + CC_ARRIVALS,
+                           max_len=CC_MAX_LEN, prefill_chunk=CC_CHUNK,
+                           prefill_budget=budget,
+                           slack_clock=lambda: clock[0])
+        # pin the cost model to the fake clock: one decode tick == one
+        # unit, one chunk forward == CC_CHUNK_COST units — slack math
+        # is then exact, not sampled
+        eng.tick_s_hint = 1.0
+        eng.prefill_tok_s_hint = CC_CHUNK_COST / CC_CHUNK
+        chunk_mark = [eng.prefill_chunk_tokens]
+
+        def tick():
+            """One engine step; returns the clock at which this tick's
+            tokens landed. step_finish samples BEFORE it runs chunk
+            forwards, so a chunk's cost delays the NEXT tick's tokens,
+            not the ones emitted alongside it."""
+            eng.step()
+            emit_clock = clock[0] + 1.0
+            clock[0] = emit_clock + CC_CHUNK_COST * (
+                eng.prefill_chunk_tokens - chunk_mark[0]) / CC_CHUNK
+            chunk_mark[0] = eng.prefill_chunk_tokens
+            return emit_clock
+
+        # TPOT-critical residents: the scheduler evaluates slack right
+        # after a tick's token lands (rem_out already decremented) and
+        # right before the tick's cost posts to the clock, where a
+        # clean decode holds deadline - clock - rem_out at a constant
+        # deadline - CC_RES_NEW + 2. A deadline of CC_RES_NEW - 2.5
+        # pins that slack at -0.5 ticks — decode exactly at its TPOT
+        # budget with zero headroom, so the budgeted scheduler must
+        # never stall it for a chunk
+        residents = [eng.submit(p, CC_RES_NEW,
+                                deadline_s=CC_RES_NEW - 2.5)
+                     for p in res_prompts]
+        last_emit = 0.0
+        for _ in range(CC_WARM_TICKS):
+            last_emit = tick()
+        burst_clock = clock[0]
+        arrivals = [eng.submit(p, CC_ARR_NEW, deadline_s=arr_deadlines[i])
+                    for i, p in enumerate(arr_prompts)]
+        tpot, finish_order = [], []
+        prefill_done_clock = None
+        in_queue = set(arrivals)
+
+        def note_prefill_progress():
+            nonlocal prefill_done_clock
+            queued = {e["req"].rid for e in eng._prefilling}
+            for rid in arrivals:
+                if rid in in_queue and rid not in queued:
+                    in_queue.discard(rid)
+                    finish_order.append(rid)
+            if prefill_done_clock is None and not eng._prefilling:
+                prefill_done_clock = clock[0]
+
+        while not all(eng.progress(r)[1] for r in residents):
+            before = [len(eng.progress(r)[0]) for r in residents]
+            emit_clock = tick()
+            note_prefill_progress()
+            emitted = sum(
+                len(eng.progress(r)[0]) - b
+                for r, b in zip(residents, before))
+            if emitted:
+                # every active resident emits each tick: the gap
+                # between emission points IS its decode TPOT in clock
+                # units (1.0 + whatever the PREVIOUS tick's chunk
+                # forwards pushed the dispatch back by)
+                tpot.extend([emit_clock - last_emit] * emitted)
+            last_emit = emit_clock
+        while eng.has_work():
+            tick()
+            note_prefill_progress()
+        results = eng.drain()
+        prefill_tokens = sum(len(p) for p in arr_prompts)
+        prefill_clock = prefill_done_clock - burst_clock
+        return {
+            "ticks_to_residents_done": round(clock[0], 3),
+            "tpot_p50": round(pct(tpot, 0.50), 3),
+            "tpot_p99": round(pct(tpot, 0.99), 3),
+            "prefill_clock": round(prefill_clock, 3),
+            "prefill_tokens_per_clock": round(
+                prefill_tokens / prefill_clock, 3),
+            "prefill_finish_order": finish_order,
+            "budget_spent_tokens": eng.prefill_budget_spent,
+            "clamped_ticks": eng.prefill_budget_clamped,
+            "overrides": eng.prefill_budget_overrides,
+        }, results
+
+    unb, unb_out = run(0)
+    bud, bud_out = run(CC_BUDGET)
+
+    # deadline sheds land at the EARLIEST layer that can know: the
+    # ServingLoop's admission estimate now carries the engine's chunk
+    # backlog, so an unmeetable deadline is refused before the engine
+    # sees the request (zero chip work burned on it)
+    class _BacklogStub:
+        def __init__(self):
+            self.pending, self.done, self.ledgers = {}, {}, {}
+            self._rid, self.backlog_s = 0, 0.0
+
+        def submit(self, prompt, n, **kw):
+            rid = self._rid
+            self._rid += 1
+            self.pending[rid] = n
+            return rid
+
+        def has_work(self):
+            return bool(self.pending)
+
+        def step(self):
+            for rid, n in list(self.pending.items()):
+                self.done[rid] = list(range(n))
+                del self.pending[rid]
+                # fixed-latency ledger: seeds the loop's rolling
+                # TTFT/TPOT estimates deterministically
+                self.ledgers[rid] = {
+                    "queue_s": 0.0, "ttft_s": 0.01,
+                    "e2e_s": 0.01 + 0.0005 * n,
+                    "tpot": [(0.0005 * (n - 1), n - 1)] if n > 1 else [],
+                    "output_tokens": n,
+                }
+            return 1
+
+        def pop_ledger(self, rid):
+            return self.ledgers.pop(rid, None)
+
+        def progress(self, rid):
+            if rid in self.done:
+                return list(self.done[rid]), True
+            if rid in self.pending:
+                return [], False
+            return None
+
+        def pop_result(self, rid):
+            return self.done.pop(rid, None)
+
+        def prefill_backlog_s(self):
+            return self.backlog_s
+
+    stub = _BacklogStub()
+    loop = ServingLoop(stub)
+    try:
+        loop.generate([1], 4, timeout=30)   # seed the EWMA estimates
+        submits_before_shed = stub._rid
+        stub.backlog_s = 60.0               # a minute of queued chunks
+        shed_msg = None
+        try:
+            loop.generate([2], 3, timeout=30, deadline_s=1.0)
+        except DeadlineUnmeetable as e:
+            shed_msg = str(e)
+        shed = {
+            "layer": "admission",
+            "sheds": loop.stats()["deadline"]["shed"],
+            "mentions_backlog": bool(
+                shed_msg and "prefill queued ahead" in shed_msg),
+            # the engine never saw the shed request: zero tokens burned
+            "engine_submits_during_shed":
+                stub._rid - submits_before_shed,
+        }
+    finally:
+        loop.shutdown()
+
+    # the budgeted arm's prefills must finish tightest-deadline first:
+    # arrivals were submitted loosest-first, so slack order is exactly
+    # REVERSED submit (= rid) order
+    bud_edf = bud["prefill_finish_order"] == sorted(
+        bud["prefill_finish_order"], reverse=True)
+    throughput_ratio = round(
+        unb["prefill_tokens_per_clock"]
+        / bud["prefill_tokens_per_clock"], 3)
+    return {
+        "chunk": CC_CHUNK,
+        "budget": CC_BUDGET,
+        "residents": CC_RESIDENT,
+        "arrivals": CC_ARRIVALS,
+        "arrival_prompt_tokens": CC_ARR_PROMPT,
+        "unbudgeted": unb,
+        "budgeted": bud,
+        # headline: the TPOT-slack clamp defers chunk work while the
+        # TPOT-critical residents decode, so their p99 holds the pure
+        # decode floor; the unbudgeted arm stalls every burst tick
+        "tpot_flat": bud["tpot_p99"] <= 1.0,
+        "tpot_blowup_ratio": round(
+            unb["tpot_p99"] / bud["tpot_p99"], 3),
+        "prefill_throughput_ratio": throughput_ratio,
+        "prefill_bound": CC_PREFILL_BOUND,
+        "prefill_within_bound": throughput_ratio <= CC_PREFILL_BOUND,
+        "edf_orders_by_slack": bud_edf,
+        "bit_exact": unb_out == bud_out,
+        "shed": shed,
+    }
+
+
 def _dg_blocks(n_requests, prompt, new):
     per = -(-(prompt + new) // DG_KV_BLOCK) + 1
     return n_requests * per
@@ -1141,6 +1393,12 @@ def main():
     # int8; conservation + byte-identical structural rerun
     dg_section = disagg_section(params, cfg)
 
+    # ------------------------------------------------------------------
+    # stall-free colocated serving (ISSUE 19): per-tick prefill budget
+    # + deadline-slack EDF vs the unbudgeted chunk rule on the fake
+    # cost-model clock — structural, byte-identical across reruns
+    cc_section = chunked_colocated_section(params, cfg)
+
     # the first token of each request is emitted by prefill (inside the
     # submit window); the drain window decodes the remaining N-1
     total_new = len(PROMPT_LENS) * (NEW_TOKENS - 1)
@@ -1182,6 +1440,7 @@ def main():
         "multi_tenant": mt_section,
         "kv_fabric": kf_section,
         "disagg": dg_section,
+        "chunked_colocated": cc_section,
         "prefix_cache": {
             "shared_prefix_tokens": sys_len,
             "prefill_admit_s": round(t_submit_pc, 3),

@@ -156,6 +156,15 @@ class ServerConfig:
     # whole pool is cooling down the pusher ignores the cooldown
     # rather than dropping the handoff
     handoff_cooldown_s: float = 5.0
+    # prefill-side decode-pool health view (role=prefill; 0 = off): at
+    # most every this-many seconds the handoff pusher refreshes a
+    # health snapshot of the decode pool from each target's /stats
+    # (queue depth, draining/recovering flags) and prefers healthy,
+    # least-loaded replicas — a draining replica is skipped BEFORE the
+    # first failed attempt instead of being discovered by one. Off,
+    # the pusher is the blind round-robin with only the failure
+    # cooldown above.
+    handoff_health_interval_s: float = 0.0
     # prefix cache (0 = off). Slot-static KV: ENTRIES — each holds one
     # prompt's KV on device (flagship: ~64 MB per 1k tokens). Paged KV
     # (kv_blocks > 0): BLOCKS — the budget for block-granular prefix
@@ -168,6 +177,19 @@ class ServerConfig:
     # (under speculative decoding the draft cache chunks alongside the
     # target: one target chunk + one cheap draft chunk per tick).
     prefill_chunk: int = 0
+    # per-tick chunked-prefill budget in prompt tokens (0 = the
+    # unconditional one-chunk-per-tick rule; requires prefill_chunk):
+    # each decode tick the engine spends at most this many prompt
+    # tokens on chunk forwards, picking WHICH chunked admissions
+    # advance by deadline slack (EDF on estimated TTFT; the budget
+    # clamps to zero while any decode slot's TPOT slack is negative),
+    # so N concurrent long prompts can no longer multiply every decode
+    # tick by N chunk forwards. Outputs stay token-identical to the
+    # unbudgeted run for every budget (scheduling changes WHEN a chunk
+    # runs, never its contents). Config-echoed for fleet drift
+    # detection; see docs/workload-plane/performance-tuning.md
+    # "Stall-free colocated serving".
+    prefill_budget: int = 0
     # pipelined decode dispatch: up to this many decode ticks in flight
     # before the host blocks on a token fetch (1 = host-serial). Greedy
     # outputs stay bit-identical to generate() at any depth; streaming
@@ -394,6 +416,7 @@ class ServingLoop:
                  handoff_targets: Optional[list] = None,
                  handoff_send=None,
                  handoff_cooldown_s: float = 5.0,
+                 handoff_health_interval_s: float = 0.0,
                  adopt_ttl_s: float = 600.0,
                  fabric_token: str = ""):
         reg = default_registry()
@@ -637,6 +660,19 @@ class ServingLoop:
         # dropping the handoff.
         self._handoff_cooldown_s = handoff_cooldown_s or 0.0
         self._handoff_unhealthy: dict = {}  # target -> abs monotonic
+        # pusher health VIEW (beyond the reactive cooldown above): at
+        # a bounded cadence the pusher scrapes each decode target's
+        # /stats so pushes prefer healthy, least-loaded replicas and a
+        # draining/recovering replica is skipped BEFORE the first
+        # failed attempt. ``pool_stats_fetch`` is the injectable
+        # fetcher (target url -> parsed stats dict) so tests and
+        # benches drive the view without sockets — same seam as
+        # chain_fetch; None = the urllib default.
+        self._handoff_health_interval_s = handoff_health_interval_s \
+            or 0.0
+        self.pool_stats_fetch = None
+        self._pool_health: dict = {}    # target -> health row
+        self._pool_health_at: Optional[float] = None
         # prefill-side deadline carry: the prefill server doesn't
         # ENFORCE deadlines (phase 1 is short; the decode side owns
         # expiry) but must not DROP them — the pusher attaches the
@@ -689,14 +725,40 @@ class ServingLoop:
                 "ship to the decode replica")
             self.m_handoff_skipped = reg.counter(
                 "nos_tpu_serve_handoff_skipped_total",
-                "Decode-pool targets skipped by the pusher while "
-                "cooling down after a failed push (health memory: a "
-                "replica that refused a handoff is not retried for "
-                "--handoff-cooldown-s); a sustained rate means part "
-                "of the decode pool is down")
+                "Decode-pool targets skipped by the pusher: cooling "
+                "down after a failed push (--handoff-cooldown-s) or "
+                "reported draining/recovering by the scraped health "
+                "view (--handoff-health-interval-s — skipped BEFORE "
+                "the first failed attempt); a sustained rate means "
+                "part of the decode pool is down or rolling")
             for outcome in ("sent", "failed"):
                 self.m_handoff.labels(outcome).inc(0)
             self.m_handoff_skipped.inc(0)
+        # budgeted chunked prefill (registered only when the engine
+        # runs a per-tick budget — an unbudgeted loop must not export
+        # dead zero series); mirrored seen-delta style like the
+        # preempt/spec counters, reset on a supervised engine swap
+        if getattr(engine, "prefill_budget", 0) > 0:
+            self.m_psched_spent = reg.counter(
+                "nos_tpu_serve_prefill_budget_tokens_total",
+                "Prompt tokens of chunked prefill charged against the "
+                "per-tick budget (--prefill-budget), including "
+                "TTFT-critical overdraws")
+            self.m_psched_clamp = reg.counter(
+                "nos_tpu_serve_prefill_clamp_total",
+                "Ticks the prefill budget clamped to zero because an "
+                "active decode slot's TPOT slack went negative — "
+                "decode drains first, prefill rides its banked credit")
+            self.m_psched_override = reg.counter(
+                "nos_tpu_serve_prefill_override_total",
+                "Over-budget chunk forwards granted to a prefill "
+                "whose TTFT slack was inside one decode tick (at most "
+                "one per tick; the overdraw pays back from later "
+                "budget)")
+            self.m_psched_spent.inc(0)
+            self.m_psched_clamp.inc(0)
+            self.m_psched_override.inc(0)
+        self._psched_seen = {"spent": 0, "clamped": 0, "overrides": 0}
         self.m_compiles = reg.counter(
             "nos_tpu_serve_compiles_total",
             "XLA compiles observed by the engine (first dispatch per "
@@ -1079,6 +1141,13 @@ class ServingLoop:
                 "sample": max(0.0, t3 - t2),
                 "bookkeep": max(0.0, t4 - t3),
             }
+            if getattr(eng, "prefill_budget", 0) > 0:
+                # the budgeted prefill scheduler's TPOT cost model
+                # samples the decode half of the tick (assemble +
+                # dispatch + wait); step_finish — which runs the
+                # prefill chunks themselves — is excluded so prefill
+                # work cannot inflate its own clamp threshold
+                eng.note_tick_seconds(max(0.0, t2 - t0))
         else:
             phases = {
                 "assemble": 0.0,
@@ -1564,6 +1633,8 @@ class ServingLoop:
             self._preempt_seen = {"swap": 0, "recompute": 0}
             self._spec_seen = {"drafted": 0, "accepted": 0}
             self._tenant_preempt_seen = {}
+            self._psched_seen = {"spent": 0, "clamped": 0,
+                                 "overrides": 0}
             # the rebuilt engine's eviction/fabric counters start at 0
             # (and its host tier starts empty): reset the mirrors or
             # the deltas would go negative and freeze the counters
@@ -1747,6 +1818,77 @@ class ServingLoop:
         self._work.notify_all()     # the stream raises DeadlineExceeded
 
     # -- prefill/decode disaggregation ----------------------------------
+    def _fetch_pool_stats(self, target: str) -> dict:
+        """Default /stats scraper for the pusher's decode-pool health
+        view; ``pool_stats_fetch`` overrides it (tests, benches)."""
+        import urllib.request
+
+        with urllib.request.urlopen(
+                target.rstrip("/") + "/stats", timeout=2) as resp:
+            return json.loads(resp.read())
+
+    def _refresh_pool_health(self, targets) -> None:
+        """Refresh the pusher's health view of the decode pool from
+        each target's /stats, at most every
+        --handoff-health-interval-s. A target whose scrape fails goes
+        UNKNOWN (dropped from the view), not unhealthy — the push
+        attempt itself owns failure cooldowns."""
+        now = time.monotonic()
+        if self._pool_health_at is not None and \
+                now - self._pool_health_at \
+                < self._handoff_health_interval_s:
+            return
+        self._pool_health_at = now
+        fetch = self.pool_stats_fetch or self._fetch_pool_stats
+        health = {}
+        for t in targets:
+            try:
+                st = fetch(t)
+            except Exception:   # noqa: BLE001 — scrape is best-effort
+                continue
+            pending = st.get("pending")
+            depth = pending.get("depth", 0) \
+                if isinstance(pending, dict) else 0
+            health[t] = {
+                "queue": int(depth or 0),
+                "draining": bool(st.get("draining")),
+                "recovering": bool(st.get("recovering")),
+            }
+        self._pool_health = health
+
+    def _order_pool(self, pool: list) -> list:
+        """Order push candidates by the health view: draining or
+        recovering targets are dropped (skipped BEFORE a failed
+        attempt — counted in nos_tpu_serve_handoff_skipped_total),
+        healthy ones sort by scraped queue depth ascending with the
+        round-robin cursor breaking ties, unknown targets (scrape
+        failed) sort after every known-healthy one. An empty result
+        (whole pool draining) falls back to the unordered pool —
+        the health view degrades to blind round-robin, never to
+        dropping the handoff."""
+        if not self._pool_health:
+            return pool
+        keep, skipped = [], 0
+        for t in pool:
+            h = self._pool_health.get(t)
+            if h is not None and (h["draining"] or h["recovering"]):
+                skipped += 1
+                continue
+            keep.append(t)
+        if skipped:
+            self.m_handoff_skipped.inc(skipped)
+        if not keep:
+            return pool
+        rank = {t: i for i, t in enumerate(keep)}
+        rr = self._handoff_rr
+
+        def key(t):
+            h = self._pool_health.get(t)
+            return ((0, h["queue"]) if h is not None else (1, 0)) \
+                + ((rank[t] - rr) % len(keep),)
+
+        return sorted(keep, key=key)
+
     def _push_handoffs(self) -> None:
         """Pusher thread (prefill role): drain the engine's parked
         handoff states and ship each to a decode-pool target —
@@ -1809,10 +1951,20 @@ class ServingLoop:
                         if self._handoff_unhealthy.get(t, 0.0) <= now]
                 if len(pool) < len(targets):
                     self.m_handoff_skipped.inc(len(targets) - len(pool))
+                ordered = None
                 if not pool:
                     pool = targets      # whole pool cooling: probe all
-                for _ in range(max(1, 2 * len(pool))):
-                    target = pool[self._handoff_rr % len(pool)]
+                elif self._handoff_health_interval_s > 0:
+                    # health view: skip draining/recovering targets
+                    # before the first attempt, try the least-loaded
+                    # healthy replica first (RR breaks ties)
+                    self._refresh_pool_health(pool)
+                    ordered = self._order_pool(pool)
+                for i in range(max(1, 2 * len(pool))):
+                    if ordered:
+                        target = ordered[i % len(ordered)]
+                    else:
+                        target = pool[self._handoff_rr % len(pool)]
                     self._handoff_rr += 1
                     try:
                         remote_rid = self._handoff_send(target, data)
@@ -1893,6 +2045,11 @@ class ServingLoop:
             if self._draining:
                 raise DrainingError(
                     "server is draining (terminating); retry elsewhere")
+            if dl_s is not None:
+                # the budgeted prefill scheduler orders waiting chunk
+                # work by TTFT slack even on a prefill-role engine;
+                # engines without it just see an extra kwarg
+                sampling["deadline_s"] = dl_s
             try:
                 erid = self.engine.submit(prompt, max_new_tokens,
                                           **sampling)
@@ -2393,6 +2550,19 @@ class ServingLoop:
                 if delta > 0:
                     self.m_kvfabric.labels(ev).inc(delta)
                     self._fabric_seen[ev] = n
+        if hasattr(self, "m_psched_spent"):
+            for attr, key, m in (
+                    ("prefill_budget_spent", "spent",
+                     self.m_psched_spent),
+                    ("prefill_budget_clamped", "clamped",
+                     self.m_psched_clamp),
+                    ("prefill_budget_overrides", "overrides",
+                     self.m_psched_override)):
+                n = getattr(self.engine, attr, 0)
+                delta = n - self._psched_seen[key]
+                if delta > 0:
+                    m.inc(delta)
+                    self._psched_seen[key] = n
         kv_stats = getattr(self.engine, "kv_stats", None)
         kv = kv_stats() if kv_stats is not None else None
         if kv:
@@ -2486,6 +2656,16 @@ class ServingLoop:
             if dl_s is not None:
                 est, est_tokens = self._estimate_completion_s(
                     max_new_tokens)
+                # under a per-tick prefill budget the chunk queue
+                # ahead of this request delays its TTFT: account the
+                # estimated backlog seconds at admission — the
+                # earliest layer that can know the deadline is dead
+                backlog_fn = getattr(self.engine, "prefill_backlog_s",
+                                     None)
+                backlog_s = float(backlog_fn() or 0.0) \
+                    if backlog_fn is not None else 0.0
+                if est is not None:
+                    est += backlog_s
                 if est is not None and est > dl_s \
                         and (self._shed_streak + 1) \
                         % DEADLINE_PROBE_EVERY != 0:
@@ -2510,12 +2690,22 @@ class ServingLoop:
                         f"{max(0.0, est_tokens - 1):.0f} expected "
                         f"tokens at "
                         f"~{(self._est_tpot_s or 0.0) * 1e3:.1f}ms "
-                        f"each); retry with a longer deadline or when "
-                        f"load drops")
+                        f"each"
+                        + (f", plus ~{backlog_s:.3f}s of chunked "
+                           f"prefill queued ahead" if backlog_s else "")
+                        + "); retry with a longer deadline or when "
+                        "load drops")
             if tenant is not None:
                 # down to the engine's weighted admission; engines
                 # without tenancy (test stubs) just see an extra kwarg
                 sampling["tenant"] = tenant
+            if dl_s is not None:
+                # thread the remaining budget to the engine: its
+                # budgeted prefill scheduler orders chunk work by the
+                # slack left against it (enforcement stays HERE —
+                # _sweep_deadlines owns expiry); engines without the
+                # scheduler (test stubs) just see an extra kwarg
+                sampling["deadline_s"] = dl_s
             try:
                 erid = self.engine.submit(prompt, max_new_tokens,
                                           **sampling)
@@ -2732,6 +2922,14 @@ def build_engine(cfg: ServerConfig):
         raise ValueError(
             f"prefill_chunk must be 0 or a power of two >= 8, got "
             f"{cfg.prefill_chunk}")
+    if cfg.prefill_budget < 0:
+        raise ValueError(
+            f"prefill_budget must be >= 0, got {cfg.prefill_budget}")
+    if cfg.prefill_budget and not cfg.prefill_chunk:
+        raise ValueError(
+            "prefill_budget requires chunked prefill (set "
+            "prefill_chunk): the budget schedules chunk forwards, and "
+            "without chunking there is nothing to budget")
     if cfg.pipeline_depth < 1:
         raise ValueError(
             f"pipeline_depth must be >= 1, got {cfg.pipeline_depth}")
@@ -2888,7 +3086,8 @@ def build_engine(cfg: ServerConfig):
             kv_block_size=cfg.kv_block_size, kv_blocks=cfg.kv_blocks,
             kv_swap=cfg.kv_swap, hbm_admit_frac=cfg.kv_hbm_admit_frac,
             kv_dtype=cfg.kv_dtype, tenant_quota=tenant_quota,
-            role=cfg.role, host_tier=host_tier)
+            role=cfg.role, host_tier=host_tier,
+            prefill_budget=cfg.prefill_budget)
     return DecodeServer(params, model_cfg, max_batch=cfg.max_batch,
                         prefix_cache_size=cfg.prefix_cache_size, mesh=mesh,
                         prefill_chunk=cfg.prefill_chunk,
@@ -2900,7 +3099,8 @@ def build_engine(cfg: ServerConfig):
                         hbm_admit_frac=cfg.kv_hbm_admit_frac,
                         kv_dtype=cfg.kv_dtype,
                         tenant_quota=tenant_quota, role=cfg.role,
-                        host_tier=host_tier)
+                        host_tier=host_tier,
+                        prefill_budget=cfg.prefill_budget)
 
 
 def make_http_server(cfg: ServerConfig, loop: ServingLoop
@@ -3359,6 +3559,24 @@ def main(argv: Optional[Sequence[str]] = None) -> None:
         help="decode steps fused into one compiled dispatch "
              "(1 = off; overrides config)")
     parser.add_argument(
+        "--prefill-chunk", type=int, default=None,
+        help="chunked prefill chunk size in prompt tokens (0 = off "
+             "[default]; power of two >= 8; a long prompt's prefill "
+             "runs chunk-at-a-time interleaved with decode ticks "
+             "instead of one monolithic forward; overrides config)")
+    parser.add_argument(
+        "--prefill-budget", type=int, default=None,
+        help="per-tick chunked-prefill budget in prompt tokens (0 = "
+             "the unconditional one-chunk-per-tick rule [default]; "
+             "requires --prefill-chunk; overrides config): each "
+             "decode tick spends at most this many prompt tokens on "
+             "chunk forwards, chosen by deadline slack (EDF on "
+             "estimated TTFT; clamps to zero while a decode slot's "
+             "TPOT slack is negative) so colocated decode TPOT holds "
+             "flat under long-prompt admission storms. Outputs stay "
+             "token-identical to the unbudgeted run; echoed in "
+             "/stats config for fleet drift detection")
+    parser.add_argument(
         "--kv-block-size", type=int, default=None,
         help="paged-KV block size in tokens (power of two >= 8 "
              "dividing max_seq; only meaningful with --kv-blocks; "
@@ -3434,6 +3652,16 @@ def main(argv: Optional[Sequence[str]] = None) -> None:
              "retries it (0 = re-probe every time; skips counted in "
              "nos_tpu_serve_handoff_skipped_total; overrides config)")
     parser.add_argument(
+        "--handoff-health-interval-s", type=float, default=None,
+        help="decode-pool health-view refresh cadence in seconds for "
+             "a --role=prefill server's handoff pusher (0 = off "
+             "[default]; overrides config): the pusher scrapes each "
+             "decode target's /stats at most this often and prefers "
+             "healthy, least-loaded replicas — a draining replica is "
+             "skipped before the first failed attempt (counted in "
+             "nos_tpu_serve_handoff_skipped_total) instead of being "
+             "discovered by one")
+    parser.add_argument(
         "--draft-checkpoint-dir", default=None,
         help="enable speculative decoding: checkpoint of the draft "
              "model that proposes --draft-n-tokens per verify window "
@@ -3501,6 +3729,10 @@ def main(argv: Optional[Sequence[str]] = None) -> None:
         cfg.pipeline_depth = args.pipeline_depth
     if args.decode_steps is not None:
         cfg.decode_steps = args.decode_steps
+    if args.prefill_chunk is not None:
+        cfg.prefill_chunk = args.prefill_chunk
+    if args.prefill_budget is not None:
+        cfg.prefill_budget = args.prefill_budget
     if args.kv_block_size is not None:
         cfg.kv_block_size = args.kv_block_size
     if args.kv_blocks is not None:
@@ -3521,6 +3753,8 @@ def main(argv: Optional[Sequence[str]] = None) -> None:
         cfg.decode_pool = args.decode_pool
     if args.handoff_cooldown_s is not None:
         cfg.handoff_cooldown_s = args.handoff_cooldown_s
+    if args.handoff_health_interval_s is not None:
+        cfg.handoff_health_interval_s = args.handoff_health_interval_s
     if args.draft_checkpoint_dir is not None:
         cfg.draft_checkpoint_dir = args.draft_checkpoint_dir
     if args.draft_n_tokens is not None:
@@ -3585,6 +3819,7 @@ def main(argv: Optional[Sequence[str]] = None) -> None:
         handoff_send=(_http_handoff_send if cfg.role == "prefill"
                       else None),
         handoff_cooldown_s=cfg.handoff_cooldown_s,
+        handoff_health_interval_s=cfg.handoff_health_interval_s,
         slo_tpot_ms=cfg.slo_tpot_ms,
         device_stats_interval_s=cfg.device_stats_interval_s,
         engine_factory=factory, restart_budget=cfg.restart_budget,
@@ -3600,6 +3835,11 @@ def main(argv: Optional[Sequence[str]] = None) -> None:
             "max_batch": cfg.max_batch,
             "pipeline_depth": cfg.pipeline_depth,
             "decode_steps": cfg.decode_steps,
+            # chunking + the per-tick prefill budget drifting between
+            # replicas makes colocated TPOT replica-dependent under
+            # the same traffic — surface both in the drift detector
+            "prefill_chunk": cfg.prefill_chunk,
+            "prefill_budget": cfg.prefill_budget,
             "kv_block_size": cfg.kv_block_size,
             "kv_blocks": cfg.kv_blocks,
             "kv_swap": cfg.kv_swap,

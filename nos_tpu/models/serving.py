@@ -168,6 +168,12 @@ class _Request:
     # block table reserved at admission
     shared_blocks: List[int] = field(default_factory=list)
     reserved_blocks: Optional[List[int]] = None
+    # SLO plumbing (budgeted chunked prefill): absolute completion
+    # deadline on the engine's slack clock, None = no deadline. Set at
+    # submit from the serving loop's remaining deadline budget; the
+    # budgeted prefill scheduler orders chunk work by the slack left
+    # against it and clamps prefill when a decode slot's runs out.
+    deadline: Optional[float] = None
 
     def note_token(self) -> None:
         """Called after each appended token: a stop token terminates the
@@ -242,7 +248,11 @@ class DecodeServer:
                  kv_dtype: str = "bf16",
                  tenant_quota: Optional[TenantQuotaConfig] = None,
                  tenant_clock=None, role: str = "colocated",
-                 host_tier=None):
+                 host_tier=None, prefill_budget: int = 0,
+                 slack_clock=None):
+        if prefill_budget < 0:
+            raise ValueError(
+                f"prefill_budget must be >= 0, got {prefill_budget}")
         if prefill_chunk and (prefill_chunk < 8
                               or prefill_chunk & (prefill_chunk - 1)):
             raise ValueError(
@@ -540,6 +550,41 @@ class DecodeServer:
         # token chunks)}. The request holds its slot while prefilling.
         self._prefill_chunk = prefill_chunk
         self._prefilling: Deque[dict] = deque()
+        # per-tick prefill budget (prefill_budget > 0): each step()
+        # spends at most this many prompt tokens on chunk forwards,
+        # choosing WHICH chunked admissions advance by deadline slack
+        # (EDF on estimated TTFT) instead of the unconditional
+        # head-of-line one-chunk-per-tick rule. Budget left unspent on
+        # a light tick accrues as credit (capped) so a chunk larger
+        # than the budget still advances every few ticks; when any
+        # decode slot's TPOT slack goes negative the budget clamps to
+        # zero for the tick so decode drains first; a prefill whose
+        # TTFT slack is inside one tick may overdraw the budget once
+        # per tick (credit goes negative and pays back). Scheduling
+        # only changes WHEN a chunk runs — never its contents — so
+        # every budget schedule is token-identical to the unbudgeted
+        # run (tested). 0 = the legacy unconditional rule.
+        self.prefill_budget = prefill_budget
+        self._prefill_credit = 0.0
+        # slack clock: all deadline arithmetic (submit stamps, EDF
+        # order, clamp checks) reads this — injectable so benches and
+        # tests schedule deterministically on a fake clock
+        self._slack_clock = slack_clock or time.monotonic
+        # rolling cost model measured on THIS engine: seconds per
+        # prefill prompt-token (sampled around each chunk forward) and
+        # seconds per decode tick (fed by the serving loop's
+        # tick-phase profiler via note_tick_seconds; plain step()
+        # callers self-measure on compile-free ticks). The *_hint
+        # attrs pin the estimates for deterministic scheduling tests.
+        self._chunk_tok_s: Deque[float] = deque(maxlen=64)
+        self._tick_s: Deque[float] = deque(maxlen=64)
+        self.prefill_tok_s_hint: Optional[float] = None
+        self.tick_s_hint: Optional[float] = None
+        # budgeted-scheduler accounting (stats + the loop's counters)
+        self.prefill_chunk_tokens = 0    # all chunk-forward tokens
+        self.prefill_budget_spent = 0    # tokens charged to a budget
+        self.prefill_budget_clamped = 0  # ticks clamped for TPOT slack
+        self.prefill_budget_overrides = 0   # TTFT-critical overdraws
         # prefix cache: token-tuple -> (k_rows, v_rows) of the prefix's
         # KV (device arrays, [L, 1, Hkv, len, D]), LRU-capped at
         # ``prefix_cache_size`` entries (0 = off). Requests submitted
@@ -841,7 +886,8 @@ class DecodeServer:
                cache_prefix: bool = False,
                stop_tokens: Optional[List[int]] = None,
                priority: int = 0,
-               tenant: Optional[str] = None) -> int:
+               tenant: Optional[str] = None,
+               deadline_s: Optional[float] = None) -> int:
         """Enqueue a request. ``temperature`` 0 = greedy (bit-identical to
         ``generate``); > 0 samples, optionally truncated per-request by
         ``top_k``/``top_p``. ``seed`` keys the request's sample stream
@@ -858,6 +904,14 @@ class DecodeServer:
         token-rate while the engine is busy is shed with the
         machine-readable ``tenant_quota`` reason (TenantQuotaExceeded,
         a QueueFull: HTTP 429 + Retry-After).
+
+        ``deadline_s`` is the request's remaining completion budget in
+        seconds (None/0 = none): the budgeted chunked-prefill
+        scheduler (``prefill_budget``) orders chunk work EDF-style on
+        the slack left against it and protects decode slots whose
+        TPOT slack runs out. Enforcement (shedding, mid-flight
+        expiry) stays with the serving loop — the engine only
+        schedules against it.
 
         Refusals split permanent from transient: ``Infeasible`` (a
         ValueError — the request can NEVER fit this server: HTTP 400)
@@ -938,7 +992,9 @@ class DecodeServer:
             stop_tokens=tuple(int(t) for t in stop_tokens or ()),
             priority=int(priority),
             tenant=(str(tenant) if tenant else DEFAULT_TENANT),
-            led=_Ledger(time.perf_counter())))
+            led=_Ledger(time.perf_counter()),
+            deadline=(self._slack_clock() + float(deadline_s)
+                      if deadline_s else None)))
         self._admit()
         return rid
 
@@ -1291,13 +1347,174 @@ class DecodeServer:
     def _prefill_tick(self) -> int:
         """Advance the head prefilling request by one tick; when its
         chunks are exhausted, finish admission (first token + install).
-        Returns tokens emitted (1 on completion, else 0)."""
-        ent = self._prefilling[0]
-        if not self._prefill_advance(ent):
+        Returns tokens emitted (1 on completion, else 0). The legacy
+        unbudgeted rule — _prefill_sched delegates here when
+        prefill_budget is 0."""
+        return self._advance_entry(0)
+
+    def _advance_entry(self, idx: int) -> int:
+        """Run ONE timed chunk forward for ``self._prefilling[idx]``
+        (the measurement feeds the budget scheduler's cost model),
+        retiring the entry through _finish_prefill when its chunks are
+        exhausted. Returns tokens emitted (1 on completion, else 0)."""
+        ent = self._prefilling[idx]
+        cost = self._chunk_cost(ent)
+        t0 = time.perf_counter()
+        done = self._prefill_advance(ent)
+        dt = time.perf_counter() - t0
+        self.prefill_chunk_tokens += cost
+        if cost > 0:
+            self._chunk_tok_s.append(dt / cost)
+        if not done:
             return 0
-        self._prefilling.popleft()
+        del self._prefilling[idx]
         self._finish_prefill(ent["req"], ent["row"], ent["step"])
         return 1
+
+    def _chunk_cost(self, ent: dict) -> int:
+        """Prompt tokens the entry's NEXT chunk forward will process —
+        the unit the per-tick budget is denominated in. Subclasses
+        whose entries carry sibling chunk queues (the speculative
+        draft) override so the cost stays defined until the whole
+        entry retires."""
+        return len(ent["todo"][0])
+
+    def _prefill_remaining(self, ent: dict) -> int:
+        """Prompt tokens still to prefill for the entry — the work
+        term of its TTFT-slack estimate."""
+        return sum(len(c) for c in ent["todo"])
+
+    def note_tick_seconds(self, seconds: float) -> None:
+        """Feed one measured decode-tick duration into the rolling
+        TPOT cost model (the serving loop calls this with its
+        tick-phase profiler's totals; plain step() callers
+        self-measure on compile-free ticks)."""
+        if seconds > 0:
+            self._tick_s.append(seconds)
+
+    def _est_prefill_tok_s(self) -> float:
+        """Estimated seconds per prefill prompt-token: the pinned hint
+        when a bench/test set one, else the rolling-window median —
+        0.0 until the first chunk forward lands (a cold model means
+        slack checks stand down rather than guess)."""
+        if self.prefill_tok_s_hint is not None:
+            return self.prefill_tok_s_hint
+        if not self._chunk_tok_s:
+            return 0.0
+        s = sorted(self._chunk_tok_s)
+        return s[len(s) // 2]
+
+    def _est_tick_s(self) -> float:
+        """Estimated seconds per decode tick (the TPOT cost model):
+        hint, else rolling median, else 0.0 (stand down)."""
+        if self.tick_s_hint is not None:
+            return self.tick_s_hint
+        if not self._tick_s:
+            return 0.0
+        s = sorted(self._tick_s)
+        return s[len(s) // 2]
+
+    def prefill_backlog(self) -> int:
+        """Prompt tokens queued in chunked-prefill entries — what a
+        fresh admission must wait behind under a per-tick budget."""
+        return sum(self._prefill_remaining(e) for e in self._prefilling)
+
+    def prefill_backlog_s(self) -> float:
+        """Estimated seconds of chunk-forward work in the prefill
+        backlog (0.0 when idle or the cost model is cold): the serving
+        loop adds this to its admission-time completion estimate so a
+        deadline that cannot survive the chunk queue ahead of it sheds
+        at submit — the earliest layer that can know."""
+        return self.prefill_backlog() * self._est_prefill_tok_s()
+
+    def _ttft_slack(self, ent: dict, now: float, tok_s: float) -> float:
+        """Seconds of slack before the entry's deadline assuming its
+        remaining chunks ran back-to-back; +inf with no deadline (so
+        deadline-less work sorts last, FIFO-stable)."""
+        req = ent["req"]
+        if req.deadline is None:
+            return float("inf")
+        return (req.deadline - now) \
+            - self._prefill_remaining(ent) * tok_s
+
+    def _prefill_sched(self) -> int:
+        """One tick of budgeted chunked prefill. With no budget
+        configured, the legacy unconditional rule: exactly one chunk
+        for the head entry. With one, spend at most ``prefill_budget``
+        prompt tokens (plus accrued credit) on chunk forwards this
+        tick, advancing entries in EDF order on estimated TTFT slack;
+        clamp to zero when any decode slot's TPOT slack is negative;
+        allow ONE over-budget chunk when the most urgent prefill's
+        TTFT slack is inside one tick. The scheduler chooses only WHEN
+        chunks run — their contents, the order within a request, and
+        the forwards themselves are exactly the unbudgeted ones, so
+        outputs stay token-identical to the unbudgeted run."""
+        if not self._prefilling:
+            return 0
+        if self.prefill_budget <= 0:
+            return self._prefill_tick()
+        now = self._slack_clock()
+        tok_s = self._est_prefill_tok_s()
+        tick_s = self._est_tick_s()
+        budget = float(self.prefill_budget)
+        decode_slots = [s for s in self._active_slots()
+                        if not self._active[s].done]
+        if tick_s > 0:
+            for s in decode_slots:
+                r = self._active[s]
+                if r.deadline is None:
+                    continue
+                rem_out = max(0, r.max_new_tokens - len(r.out))
+                if (r.deadline - now) - rem_out * tick_s < 0:
+                    # a decode slot is already out of TPOT slack:
+                    # every chunk forward now widens its inter-token
+                    # gaps further — decode drains first, prefill
+                    # rides on whatever credit it accrued
+                    budget = 0.0
+                    self.prefill_budget_clamped += 1
+                    break
+        clamped = budget == 0.0
+        # unspent budget accrues as credit, capped so a long idle
+        # stretch cannot bank an unbounded prefill burst; the cap
+        # covers the largest chunk so a chunk bigger than the per-tick
+        # budget still advances every ceil(chunk/budget) ticks
+        cap = float(max(self.prefill_budget, self._prefill_chunk))
+        self._prefill_credit = min(self._prefill_credit + budget, cap)
+        emitted = 0
+        advanced = 0
+        overrode = False
+        while self._prefilling:
+            # re-rank every iteration: _finish_prefill can recursively
+            # admit a NEW chunked entry, and slack shifts as work runs
+            idx = min(range(len(self._prefilling)),
+                      key=lambda i: (self._ttft_slack(
+                          self._prefilling[i], now, tok_s), i))
+            cost = self._chunk_cost(self._prefilling[idx])
+            if self._prefill_credit >= cost:
+                self._prefill_credit -= cost
+                self.prefill_budget_spent += cost
+            elif (not clamped and not overrode
+                  and self._ttft_slack(self._prefilling[idx], now,
+                                       tok_s) < max(tick_s, 0.0)):
+                # TTFT-critical overdraw: this prefill's deadline dies
+                # within ~one tick of waiting — exceed the budget for
+                # ONE chunk and pay it back (credit goes negative)
+                self._prefill_credit -= cost
+                self.prefill_budget_spent += cost
+                self.prefill_budget_overrides += 1
+                overrode = True
+            elif advanced == 0 and not decode_slots:
+                # liveness: nothing decodable and no credit banked —
+                # an idle engine must still make prefill progress
+                # (drain() would otherwise spin forever). One free
+                # advance per tick, no budget charge: exactly the
+                # legacy pace.
+                pass
+            else:
+                break
+            emitted += self._advance_entry(idx)
+            advanced += 1
+        return emitted
 
     def _prefill_advance(self, ent: dict) -> bool:
         """Run ONE chunk forward for ``ent``; on the final chunk, store
@@ -2648,8 +2865,16 @@ class DecodeServer:
         aren't installed yet). With pipeline_depth k > 1 a completion is
         observed up to k ticks late; _finish_if_done's pos reset rolls
         the overrun back."""
+        c0, t0 = self.compiles, time.perf_counter()
         handle = self.step_begin()
         self.step_wait(handle)
+        if handle is not None and self.prefill_budget > 0 \
+                and self.compiles == c0:
+            # library callers never run the serving loop's tick-phase
+            # profiler: self-measure the decode dispatch + wait as the
+            # TPOT cost-model sample, skipping ticks that paid a
+            # synchronous XLA compile (they'd poison the median)
+            self.note_tick_seconds(time.perf_counter() - t0)
         return self.step_finish(handle)
 
     def _active_slots(self) -> List[int]:
@@ -2715,7 +2940,7 @@ class DecodeServer:
             self._inflight.popleft()
             emitted += self._consume(ent)
         if self._prefilling:
-            emitted += self._prefill_tick()
+            emitted += self._prefill_sched()
         self._admit()       # fill slots freed by completions (barriers)
         if not self._active and not self._pending and self._inflight:
             # the burst ended with over-decoded ticks still in flight:
@@ -2990,6 +3215,22 @@ class DecodeServer:
             "slots": slots,
             "pending": {"depth": len(self._pending),
                         "oldest_wait_s": round(oldest, 6)},
+            # budgeted chunked prefill (None when chunking is off — no
+            # dead sections): budget + banked credit, the chunk-queue
+            # backlog a fresh admission waits behind, and the clamp /
+            # overdraw counters the loop mirrors into counters
+            "prefill_sched": ({
+                "budget": self.prefill_budget,
+                "credit": round(self._prefill_credit, 3),
+                "backlog_tokens": self.prefill_backlog(),
+                "chunk_tokens": self.prefill_chunk_tokens,
+                "budget_spent_tokens": self.prefill_budget_spent,
+                "clamped_ticks": self.prefill_budget_clamped,
+                "overrides": self.prefill_budget_overrides,
+                "est_prefill_tok_s": round(
+                    self._est_prefill_tok_s(), 9),
+                "est_tick_s": round(self._est_tick_s(), 9),
+            } if self._prefill_chunk else None),
             "pipeline": {"depth": self.pipeline_depth,
                          "decode_steps": self.decode_steps,
                          "in_flight": len(self._inflight),

@@ -503,6 +503,24 @@ class SpeculativeDecodeServer(DecodeServer):
                 self._d_alloc.decref(b)
         return ok
 
+    def _chunk_cost(self, ent) -> int:
+        # budget accounting: the draft chunk rides the target chunk's
+        # charge (one target + one much-cheaper draft forward per
+        # advance — the same pairing the unbudgeted rule runs); once
+        # the target queue empties first (prefix-hit admissions skip
+        # target chunks the draft must still cover) the residual draft
+        # chunks are charged at their own token count so the cost
+        # stays defined until the entry retires
+        if ent["todo"]:
+            return len(ent["todo"][0])
+        return len(ent["dtodo"][0])
+
+    def _prefill_remaining(self, ent) -> int:
+        # the entry retires only when BOTH queues empty: remaining
+        # work (the TTFT-slack term) is whichever queue runs longer
+        return max(sum(len(c) for c in ent["todo"]),
+                   sum(len(c) for c in ent["dtodo"]))
+
     def _prefill_advance(self, ent) -> bool:
         if ent["todo"]:
             super()._prefill_advance(ent)       # one target chunk

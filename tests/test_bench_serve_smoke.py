@@ -216,6 +216,37 @@ def test_bench_serve_smoke_writes_pipeline_artifact(tmp_path):
         f"structural ~0.5x claim does not hold")
     assert dg["rerun_identical"]
 
+    # stall-free colocated section (ISSUE 19): deadline-slack-budgeted
+    # chunked prefill vs the unconditional chunk-per-tick rule, on the
+    # section's cost-model clock (chunk forward = 4 decode ticks)
+    cc = artifact["chunked_colocated"]
+    # the headline: the unbudgeted arm stalls every resident decode
+    # tick it runs a chunk on (TPOT p99 blows up by the chunk cost);
+    # the budgeted arm's TPOT-slack clamp holds the tail at the
+    # 1-tick decode floor
+    assert cc["tpot_flat"], (
+        f"budgeted TPOT p99 {cc['budgeted']['tpot_p99']} not flat vs "
+        f"unbudgeted {cc['unbudgeted']['tpot_p99']}")
+    assert cc["tpot_blowup_ratio"] > 1.0
+    assert cc["budgeted"]["clamped_ticks"] > 0
+    # prefill throughput gives up only a bounded factor for that tail
+    assert cc["prefill_within_bound"], (
+        f"budgeted prefill throughput ratio "
+        f"{cc['prefill_throughput_ratio']} over bound "
+        f"{cc['prefill_bound']}")
+    # EDF: arrivals carry descending slack in submit order, so the
+    # budgeted arm must finish them in REVERSE submit order
+    assert cc["edf_orders_by_slack"]
+    # budget schedules are an ordering concern only — every request's
+    # tokens match the unbudgeted oracle exactly
+    assert cc["bit_exact"]
+    # deadline shed is attributed at the earliest layer (admission),
+    # names the prefill backlog ahead, and never reached the engine
+    assert cc["shed"]["layer"] == "admission"
+    assert cc["shed"]["sheds"] >= 1
+    assert cc["shed"]["mentions_backlog"]
+    assert cc["shed"]["engine_submits_during_shed"] == 0
+
 
 @pytest.mark.slow
 def test_disagg_structural_reruns_byte_identical():
@@ -289,6 +320,62 @@ def test_kv_fabric_section_headlines():
     # exactly: same hits, same prefill work
     assert kf["tiered"]["prefill_tokens"] == \
         kf["no_pressure"]["prefill_tokens"]
+
+
+def test_chunked_colocated_section_headlines():
+    """Tier-1 smoke of the chunked_colocated section (ISSUE 19): on
+    the section's cost-model clock the budgeted arm's TPOT-slack clamp
+    must hold resident decode TPOT p99 at the 1-tick floor while the
+    unbudgeted chunk-per-tick rule blows the tail up by the chunk
+    cost, with bounded prefill-throughput give-up, EDF finish order on
+    the descending-slack arrivals, bit-identical tokens, and the
+    deadline shed attributed at admission."""
+    import jax
+
+    sys.path.insert(0, REPO)
+    os.environ.setdefault("NOS_TPU_BENCH_SMOKE", "1")
+    import bench_serve
+    from nos_tpu.models import transformer as tr
+
+    cfg = tr.TransformerConfig(**bench_serve.MODEL)
+    params = tr.init_params(jax.random.PRNGKey(0), cfg)
+    cc = bench_serve.chunked_colocated_section(params, cfg)
+    assert cc["tpot_flat"]
+    assert cc["tpot_blowup_ratio"] > 1.0
+    assert cc["budgeted"]["clamped_ticks"] > 0
+    assert cc["prefill_within_bound"]
+    assert cc["edf_orders_by_slack"]
+    assert cc["bit_exact"]
+    # the clamp starves prefill while residents decode, so the
+    # budgeted arm must pay MORE wall-clock for the same prefill
+    # tokens — if it doesn't, the section is vacuous
+    assert cc["budgeted"]["prefill_clock"] > \
+        cc["unbudgeted"]["prefill_clock"]
+    assert cc["budgeted"]["budget_spent_tokens"] == \
+        cc["arrivals"] * cc["arrival_prompt_tokens"]
+    assert cc["shed"]["layer"] == "admission"
+    assert cc["shed"]["sheds"] >= 1
+    assert cc["shed"]["mentions_backlog"]
+    assert cc["shed"]["engine_submits_during_shed"] == 0
+
+
+@pytest.mark.slow
+def test_chunked_colocated_section_reruns_byte_identical():
+    """The section runs on its own deterministic cost-model clock —
+    two fresh runs must serialize byte-identically, the
+    artifact-reproducibility bar the other structural sections hold."""
+    import jax
+
+    sys.path.insert(0, REPO)
+    os.environ.setdefault("NOS_TPU_BENCH_SMOKE", "1")
+    import bench_serve
+    from nos_tpu.models import transformer as tr
+
+    cfg = tr.TransformerConfig(**bench_serve.MODEL)
+    params = tr.init_params(jax.random.PRNGKey(0), cfg)
+    a = bench_serve.chunked_colocated_section(params, cfg)
+    b = bench_serve.chunked_colocated_section(params, cfg)
+    assert json.dumps(a, sort_keys=True) == json.dumps(b, sort_keys=True)
 
 
 @pytest.mark.slow

@@ -1,0 +1,493 @@
+"""Deadline-slack-budgeted chunked prefill (models/serving.py
+prefill_budget): each tick the engine spends at most a token budget on
+chunk forwards, picks chunk work EDF-style on TTFT slack, clamps the
+budget toward zero when an active decode slot's TPOT slack goes
+negative, and may overdraw once per tick for a TTFT-critical prefill —
+all while the bit-exactness contract holds: ANY budget schedule yields
+token-identical output to the unbudgeted (budget=0) run.
+
+Also covers the prefill-side decode-pool health view: the handoff
+pusher scrapes /stats and prefers healthy least-loaded decode
+replicas, skipping draining ones BEFORE the first failed attempt."""
+import random
+import threading
+import time
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from nos_tpu.models import transformer as tfm
+from nos_tpu.models.serving import DecodeServer
+
+CFG = tfm.TransformerConfig(
+    vocab=64, d_model=32, n_layers=2, n_heads=4, n_kv_heads=2,
+    d_ff=64, max_seq=128, dtype=jnp.float32)
+LONG = [(i * 7 + 3) % 64 for i in range(40)]    # >> chunk of 8
+
+
+@pytest.fixture(scope="module")
+def params():
+    return tfm.init_params(jax.random.PRNGKey(0), CFG)
+
+
+def drain_all(srv, reqs):
+    rids = [srv.submit(p, n, **kw) for p, n, kw in reqs]
+    out = srv.drain()
+    return [out[r] for r in rids]
+
+
+class FakeClock:
+    """Injectable slack clock: deadlines and slack math become pure
+    functions of test-controlled time."""
+
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+
+# ---------------------------------------------------------------------------
+# bit-exactness: any budget schedule == the unbudgeted run
+# ---------------------------------------------------------------------------
+
+MIX = [
+    (LONG, 6, dict()),
+    (LONG[:17], 5, dict(temperature=0.7, top_k=8, seed=5)),
+    ([5, 9], 6, dict()),
+    (LONG[:33], 4, dict()),
+]
+
+
+def test_budget_invariance_slot_static(params):
+    want = drain_all(
+        DecodeServer(params, CFG, max_batch=4, prefill_chunk=8), MIX)
+    for budget in (4, 16):
+        got = drain_all(
+            DecodeServer(params, CFG, max_batch=4, prefill_chunk=8,
+                         prefill_budget=budget), MIX)
+        assert got == want, f"budget={budget}"
+
+
+@pytest.mark.parametrize("kernel", ["0", "1"])
+def test_budget_invariance_paged_kernel_on_and_off(params, monkeypatch,
+                                                   kernel):
+    """Both paged-attention paths (--paged-kernel on AND off) schedule
+    under the budget with tokens identical to unbudgeted."""
+    monkeypatch.setenv("NOS_TPU_PAGED_KERNEL", kernel)
+
+    def mk(**kw):
+        return DecodeServer(params, CFG, max_batch=2, prefill_chunk=8,
+                            kv_block_size=8, kv_blocks=24, **kw)
+
+    reqs = [(LONG, 4, {}), (LONG[:17], 4, {})]
+    want = drain_all(mk(), reqs)
+    got = drain_all(mk(prefill_budget=8), reqs)
+    assert got == want
+
+
+@pytest.mark.parametrize("seed", range(2))
+def test_budget_invariance_seeded_fuzz(params, seed):
+    """Seeded fuzz over budget x chunk x concurrent-long-prompt mixes:
+    outputs bit-identical to the unbudgeted oracle at the same chunk."""
+    rng = random.Random(100 + seed)
+    chunk = rng.choice([8, 16])
+    budget = rng.choice([2, 4, 8, 16, 40])
+    pool = [LONG, LONG[:33], LONG[:17], [5, 9], [1, 2, 3]]
+    reqs = []
+    for _ in range(rng.randint(3, 5)):
+        p = rng.choice(pool)
+        kw = {}
+        if rng.random() < 0.4:
+            kw = dict(temperature=0.8, top_k=8, seed=rng.randint(0, 99))
+        reqs.append((p, rng.randint(3, 6), kw))
+    want = drain_all(
+        DecodeServer(params, CFG, max_batch=4, prefill_chunk=chunk),
+        reqs)
+    got = drain_all(
+        DecodeServer(params, CFG, max_batch=4, prefill_chunk=chunk,
+                     prefill_budget=budget), reqs)
+    assert got == want, f"chunk={chunk} budget={budget}"
+
+
+def test_spec_engine_inherits_budgeted_chunking(params):
+    """The speculative engine rides the same scheduler: draft chunks
+    advance in lockstep with the target's, charged once per pick."""
+    from nos_tpu.models.spec_serving import SpeculativeDecodeServer
+    dcfg = tfm.TransformerConfig(
+        vocab=64, d_model=16, n_layers=1, n_heads=2, n_kv_heads=1,
+        d_ff=32, max_seq=128, dtype=jnp.float32)
+    dparams = tfm.init_params(jax.random.PRNGKey(1), dcfg)
+    reqs = [(LONG, 6, dict()),
+            (LONG[:19], 5, dict(temperature=0.7, top_k=8, seed=5))]
+
+    def mk(**kw):
+        return SpeculativeDecodeServer(params, CFG, dparams, dcfg,
+                                       n_draft=3, max_batch=2,
+                                       prefill_chunk=8, **kw)
+
+    want = drain_all(mk(), reqs)
+    bud = mk(prefill_budget=8)
+    assert bud.prefill_budget == 8
+    got = drain_all(bud, reqs)
+    assert got == want
+
+
+# ---------------------------------------------------------------------------
+# scheduler behavior: deterministic under injected clock + cost hints
+# ---------------------------------------------------------------------------
+
+def test_submit_records_deadline_on_slack_clock(params):
+    clk = FakeClock()
+    clk.t = 50.0
+    srv = DecodeServer(params, CFG, max_batch=1, prefill_chunk=8,
+                       prefill_budget=8, slack_clock=clk)
+    srv.submit(LONG, 4, deadline_s=7.0)
+    assert srv._prefilling[0]["req"].deadline == 57.0
+    srv.drain()
+    srv2 = DecodeServer(params, CFG, max_batch=1, prefill_chunk=8,
+                        prefill_budget=8, slack_clock=clk)
+    srv2.submit(LONG, 4)
+    assert srv2._prefilling[0]["req"].deadline is None
+    srv2.drain()
+
+
+def test_tpot_clamp_starves_prefill_until_decode_drains(params):
+    """When an active decode slot's TPOT slack is negative the budget
+    clamps to zero: no chunk runs, the clamp counter ticks, and the
+    prefill completes only after the pressured decode finishes."""
+    clk = FakeClock()
+    srv = DecodeServer(params, CFG, max_batch=2, prefill_chunk=8,
+                       prefill_budget=40, slack_clock=clk)
+    srv.tick_s_hint = 1.0           # 1 time-unit per decode tick
+    srv.prefill_tok_s_hint = 0.0    # prefill looks free: no TTFT urgency
+    a = srv.submit([4, 5], 20, deadline_s=5.0)   # needs 20 ticks, has 5
+    srv.step()                      # a active and decoding
+    srv.submit(LONG, 4)
+    chunks_before = len(srv._prefilling[0]["todo"])
+    srv.step()
+    assert srv.prefill_budget_clamped >= 1
+    assert len(srv._prefilling[0]["todo"]) == chunks_before  # starved
+    out = srv.drain()               # a finishes; clamp lifts; b drains
+    assert srv._prefilling == srv._prefilling.__class__()
+    assert len(out[a]) == 2 + 20
+
+
+def test_ttft_critical_prefill_overdraws_once_per_tick(params):
+    """A prefill whose TTFT slack is gone may exceed the budget — but
+    only one overdraw per tick, paid back from future credit."""
+    clk = FakeClock()
+    srv = DecodeServer(params, CFG, max_batch=2, prefill_chunk=8,
+                       prefill_budget=2, slack_clock=clk)
+    srv.tick_s_hint = 1.0
+    srv.prefill_tok_s_hint = 1.0    # 40 remaining tokens ~ 40 units
+    srv.submit([4, 5], 30)          # active decode: no liveness free pass
+    srv.step()
+    b = srv.submit(LONG, 4, deadline_s=10.0)    # hopeless TTFT: slack<0
+    chunks_before = len(srv._prefilling[0]["todo"])
+    srv.step()
+    assert srv.prefill_budget_overrides == 1
+    # exactly ONE chunk advanced: the overdraw is once-per-tick and the
+    # negative credit blocks a second pick
+    assert len(srv._prefilling[0]["todo"]) == chunks_before - 1
+    assert srv._prefill_credit < 0
+    out = srv.drain()
+    assert out[b][:len(LONG)] == LONG
+
+
+def test_edf_picks_tightest_deadline_first(params):
+    """Two queued prefills: the one with less TTFT slack advances
+    first even though it was submitted second."""
+    clk = FakeClock()
+    srv = DecodeServer(params, CFG, max_batch=2, prefill_chunk=8,
+                       prefill_budget=8, slack_clock=clk)
+    srv.tick_s_hint = 1.0
+    srv.prefill_tok_s_hint = 1.0 / 8
+    a = srv.submit(LONG, 4, deadline_s=100.0)   # loose
+    b = srv.submit(LONG[:32], 4, deadline_s=6.0)   # tight
+    before = {a: len(srv._prefilling[0]["todo"]),
+              b: len(srv._prefilling[1]["todo"])}
+    srv.step()
+    by_rid = {e["req"].rid: len(e["todo"]) for e in srv._prefilling}
+    assert by_rid[b] == before[b] - 1       # tight one advanced
+    assert by_rid[a] == before[a]           # loose one waited
+    srv.drain()
+
+
+def test_no_deadline_falls_back_to_fifo(params):
+    srv = DecodeServer(params, CFG, max_batch=2, prefill_chunk=8,
+                       prefill_budget=8)
+    a = srv.submit(LONG, 3)
+    b = srv.submit(LONG[:32], 3)
+    before_a = len(srv._prefilling[0]["todo"])
+    srv.step()
+    by_rid = {e["req"].rid: len(e["todo"]) for e in srv._prefilling}
+    assert by_rid[a] == before_a - 1        # FIFO: first submit first
+    assert by_rid[b] == 4
+    srv.drain()
+
+
+def test_liveness_tiny_budget_drains_without_decode_work(params):
+    """budget << chunk with nothing decoding: the free-advance rule
+    keeps one chunk per tick flowing so drain() never spins."""
+    srv = DecodeServer(params, CFG, max_batch=1, prefill_chunk=8,
+                       prefill_budget=1)
+    want = drain_all(
+        DecodeServer(params, CFG, max_batch=1, prefill_chunk=8),
+        [(LONG, 4, {})])
+    got = drain_all(srv, [(LONG, 4, {})])
+    assert got == want
+
+
+def test_credit_accrual_is_capped_and_paces_chunks(params):
+    """budget=4, chunk=8: credit accrues to the cap max(budget, chunk)
+    and a chunk advances every second tick while decode holds the
+    slot — budgeted pacing, not starvation."""
+    srv = DecodeServer(params, CFG, max_batch=2, prefill_chunk=8,
+                       prefill_budget=4)
+    srv.submit([4, 5], 30)
+    srv.step()
+    srv.submit(LONG, 3)
+    advanced = []
+    for _ in range(10):
+        before = sum(len(e["todo"]) for e in srv._prefilling)
+        srv.step()
+        after = sum(len(e["todo"]) for e in srv._prefilling)
+        advanced.append(before - after)
+        assert srv._prefill_credit <= max(srv.prefill_budget,
+                                          srv._prefill_chunk)
+    # every other tick advances exactly one chunk: 4+4 credit per pair
+    assert sum(advanced) == 5
+    assert max(advanced) == 1
+    srv.drain()
+
+
+def test_stats_surface_and_backlog_accessors(params):
+    srv = DecodeServer(params, CFG, max_batch=2, prefill_chunk=8,
+                       prefill_budget=16)
+    srv.prefill_tok_s_hint = 0.5
+    srv.submit(LONG, 3)
+    assert srv.prefill_backlog() == len(LONG)
+    assert srv.prefill_backlog_s() == pytest.approx(len(LONG) * 0.5)
+    st = srv.stats()["prefill_sched"]
+    assert st["budget"] == 16
+    assert st["backlog_tokens"] == len(LONG)
+    assert set(st) == {"budget", "credit", "backlog_tokens",
+                       "chunk_tokens", "budget_spent_tokens",
+                       "clamped_ticks", "overrides",
+                       "est_prefill_tok_s", "est_tick_s"}
+    srv.drain()
+    assert srv.stats()["prefill_sched"]["backlog_tokens"] == 0
+    # chunking off -> no scheduler section at all
+    plain = DecodeServer(params, CFG, max_batch=1)
+    assert plain.stats()["prefill_sched"] is None
+
+
+def test_bad_budget_rejected(params):
+    with pytest.raises(ValueError, match="prefill_budget"):
+        DecodeServer(params, CFG, max_batch=1, prefill_chunk=8,
+                     prefill_budget=-1)
+
+
+def test_server_config_rejects_budget_without_chunking():
+    """build_engine fails on config alone — before any checkpoint."""
+    from nos_tpu.cmd.server import ServerConfig, build_engine
+    base = dict(vocab=64, d_model=32, n_layers=2, n_heads=4,
+                n_kv_heads=2, d_ff=64, max_seq=128, bf16=False)
+    with pytest.raises(ValueError, match="prefill_chunk"):
+        build_engine(ServerConfig(**base, prefill_budget=64))
+    with pytest.raises(ValueError, match=">= 0"):
+        build_engine(ServerConfig(**base, prefill_chunk=8,
+                                  prefill_budget=-1))
+
+
+# ---------------------------------------------------------------------------
+# chaos: supervised restart mid-budgeted-prefill — recompute-resume
+# replays under the same budget, per-request conservation holds
+# ---------------------------------------------------------------------------
+
+def test_restart_mid_budgeted_prefill_resumes_bit_exact(params):
+    from nos_tpu.cmd.server import ServingLoop
+    from nos_tpu.models.generate import generate
+    from nos_tpu.models.supervision import FaultInjector
+
+    def mk():
+        return DecodeServer(params, CFG, max_batch=2, prefill_chunk=8,
+                            prefill_budget=8)
+
+    inj = FaultInjector(schedule={2: "error"})   # trips mid-prefill
+    loop = ServingLoop(inj.wrap(mk()), engine_factory=lambda: inj.wrap(mk()),
+                       restart_budget=2, restart_backoff_s=0.01)
+    prompts = [LONG, [7, 8]]
+    outs = {}
+
+    def worker(i):
+        outs[i] = loop.generate(prompts[i], 8, timeout=180)
+
+    threads = [threading.Thread(target=worker, args=(i,))
+               for i in range(len(prompts))]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(300)
+    try:
+        assert loop._sup.restarts == 1
+        assert loop._sup.lost == 0
+        for i, p in enumerate(prompts):
+            want = [int(t) for t in generate(
+                params, CFG, jnp.asarray([p], jnp.int32), 8)[0]]
+            assert outs.get(i) == want, (
+                f"request {i} diverged across the budgeted restart")
+    finally:
+        loop.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# prefill-side decode-pool health view
+# ---------------------------------------------------------------------------
+
+class _ParkingEngine:
+    """Prefill-role stub: submit parks a handoff; release() surfaces it
+    to the pusher."""
+
+    def __init__(self):
+        self.pending, self.done, self._rid = {}, {}, 0
+        self._handoffs, self.parked = [], {}
+
+    def submit(self, prompt, n, **kw):
+        rid = self._rid
+        self._rid += 1
+        self.parked[rid] = {"rid": rid, "prompt": list(prompt)}
+        return rid
+
+    def has_work(self):
+        return False
+
+    def step(self):
+        return 0
+
+    def progress(self, rid):
+        return None
+
+    def pop_result(self, rid):
+        return self.done.pop(rid, None)
+
+    def release(self, rid):
+        self._handoffs.append(self.parked.pop(rid))
+
+    def pop_handoffs(self):
+        out, self._handoffs = self._handoffs, []
+        return out
+
+
+def _wait_until(cond, timeout=10.0):
+    t0 = time.monotonic()
+    while time.monotonic() - t0 < timeout:
+        if cond():
+            return True
+        time.sleep(0.005)
+    return False
+
+
+def _mk_prefill_loop(stats_by_target, shipped, fail=()):
+    from nos_tpu.cmd.server import ServingLoop
+    eng = _ParkingEngine()
+
+    def send(target, data):
+        if target in fail:
+            raise ConnectionError("boom")
+        shipped.append(target)
+        return 1
+
+    loop = ServingLoop(eng, role="prefill",
+                       handoff_targets=sorted(stats_by_target),
+                       handoff_send=send,
+                       handoff_health_interval_s=60.0)
+    loop.pool_stats_fetch = lambda t: stats_by_target[t]
+    return eng, loop
+
+
+def test_pusher_prefers_healthy_least_loaded_and_skips_draining():
+    """Draining replica skipped BEFORE any attempt; among healthy ones
+    the push goes to the smallest scraped queue."""
+    from nos_tpu.utils.metrics import default_registry
+    stats = {
+        "http://a": {"pending": {"depth": 3}},
+        "http://b": {"pending": {"depth": 1}},
+        "http://c": {"pending": {"depth": 0}, "draining": True},
+    }
+    shipped = []
+    eng, loop = _mk_prefill_loop(stats, shipped)
+    skip0 = loop.m_handoff_skipped.value()
+    try:
+        rid = eng.submit([1, 2], 4)
+        eng.release(rid)
+        with loop._work:
+            loop._work.notify_all()
+        assert _wait_until(lambda: shipped)
+        assert shipped == ["http://b"]      # least-loaded healthy
+        assert loop.m_handoff_skipped.value() == skip0 + 1
+        assert loop._pool_health["http://c"]["draining"]
+    finally:
+        loop.shutdown()
+
+
+def test_pusher_health_view_unknown_sorts_after_known():
+    """A target whose scrape FAILS goes unknown — still eligible, but
+    after every known-healthy replica."""
+    stats = {
+        "http://a": {"pending": {"depth": 9}},
+    }
+
+    def fetch(t):
+        if t == "http://b":
+            raise OSError("scrape down")
+        return stats[t]
+
+    shipped = []
+    eng, loop = _mk_prefill_loop(
+        {"http://a": None, "http://b": None}, shipped)
+    loop.pool_stats_fetch = fetch
+    try:
+        loop._refresh_pool_health(["http://a", "http://b"])
+        assert loop._order_pool(["http://b", "http://a"]) == \
+            ["http://a", "http://b"]
+    finally:
+        loop.shutdown()
+
+
+def test_pusher_whole_pool_draining_falls_back_to_round_robin():
+    """The health view degrades to blind RR, never to dropping the
+    handoff: with every replica draining the push still lands."""
+    stats = {
+        "http://a": {"pending": {"depth": 0}, "draining": True},
+        "http://b": {"recovering": True},
+    }
+    shipped = []
+    eng, loop = _mk_prefill_loop(stats, shipped)
+    try:
+        rid = eng.submit([1, 2], 4)
+        eng.release(rid)
+        with loop._work:
+            loop._work.notify_all()
+        assert _wait_until(lambda: shipped)
+        assert shipped[0] in ("http://a", "http://b")
+    finally:
+        loop.shutdown()
+
+
+def test_pusher_health_refresh_respects_cadence():
+    """Scrapes are bounded by --handoff-health-interval-s: a second
+    refresh inside the window is a no-op."""
+    calls = []
+    eng, loop = _mk_prefill_loop({"http://a": {"pending": {"depth": 0}}},
+                                 [])
+    loop.pool_stats_fetch = lambda t: calls.append(t) or {
+        "pending": {"depth": 0}}
+    try:
+        loop._refresh_pool_health(["http://a"])
+        loop._refresh_pool_health(["http://a"])
+        assert calls == ["http://a"]
+    finally:
+        loop.shutdown()

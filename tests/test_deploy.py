@@ -772,3 +772,38 @@ def test_serving_deployment_passes_role_and_decode_pool_args():
         readme = f.read()
     for row in ("serving.role", "serving.decodePool"):
         assert row in readme, f"helm README missing {row}"
+
+
+def test_serving_deployment_passes_prefill_budget_and_health_args():
+    """The serving Deployment must plumb the stall-free colocated
+    serving knobs (ISSUE 19): serving.prefillChunk / .prefillBudget /
+    .handoffHealthIntervalSeconds rendered to --prefill-chunk /
+    --prefill-budget / --handoff-health-interval-s, chart defaults
+    equal to the binary's ServerConfig defaults (all off — no behavior
+    change on upgrade), and the knobs README-discoverable."""
+    path = os.path.join(CHART, "templates", "serving",
+                        "deployment_server.yaml")
+    with open(path) as f:
+        text = f.read()
+    for flag, value in (
+        ("--prefill-chunk", ".Values.serving.prefillChunk"),
+        ("--prefill-budget", ".Values.serving.prefillBudget"),
+        ("--handoff-health-interval-s",
+         ".Values.serving.handoffHealthIntervalSeconds"),
+    ):
+        assert f"{flag}={{{{ {value} }}}}" in text, (
+            f"serving deployment missing {flag} <- {value}")
+    with open(os.path.join(CHART, "values.yaml")) as f:
+        values = yaml.safe_load(f)
+    from nos_tpu.cmd.server import ServerConfig
+    assert values["serving"]["prefillChunk"] \
+        == ServerConfig().prefill_chunk == 0
+    assert values["serving"]["prefillBudget"] \
+        == ServerConfig().prefill_budget == 0
+    assert values["serving"]["handoffHealthIntervalSeconds"] \
+        == ServerConfig().handoff_health_interval_s == 0
+    with open(os.path.join(CHART, "README.md")) as f:
+        readme = f.read()
+    for row in ("serving.prefillChunk", "serving.prefillBudget",
+                "serving.handoffHealthIntervalSeconds"):
+        assert row in readme, f"helm README missing {row} row"

@@ -279,6 +279,10 @@ def test_spec_tokens_invariant_to_tp_mesh(models):
     assert run(srv) == want
 
 
+# slow tier (~12s, the biggest fuzz in tier-1): the pairwise
+# composition tests above and the plain-engine random-schedule fuzzes
+# (test_serving/test_serving_paged/test_serving_pipeline) stay tier-1
+@pytest.mark.slow
 def test_random_schedules_compose_all_spec_features(models):
     """Composition prober for the SPECULATIVE engine: random config
     (chunked prefill on/off, prefix cache on/off, draft depth), random

@@ -130,6 +130,10 @@ def test_profiler_trace_written(tmp_path, _fresh_jax_subprocess_env):
     assert found, "profiler trace directory is empty"
 
 
+# slow tier: the heaviest test in the suite (two cache-less child jax
+# startups, ~25s) probing one profiler edge; tier-1 keeps the profiler
+# path covered via test_profiler_trace_written
+@pytest.mark.slow
 def test_profiler_fires_on_resume_past_start(tmp_path,
                                              _fresh_jax_subprocess_env):
     import os
@@ -161,6 +165,10 @@ def test_trains_from_token_shards(tmp_path, _fresh_jax_subprocess_env):
     assert loss == loss and loss < 100
 
 
+# slow tier (three cache-less child train() runs, ~12s): tier-1 keeps
+# the resume-reproduces-the-uninterrupted-stream property covered via
+# test_stop_event_checkpoints_and_resumes
+@pytest.mark.slow
 def test_dataset_resume_reproduces_uninterrupted_run(
         tmp_path, _fresh_jax_subprocess_env):
     """Resume-stability through train() itself: checkpoint at step 2,
