@@ -134,6 +134,21 @@ if SMOKE:
     MT_STEPS = 64
 
 
+# SLO accounting section (ISSUE 20): the chip-second attribution
+# ledger + error-budget engine replayed on a deterministic COST-MODEL
+# clock — every quantum's duration is computed from the work it
+# carries (SA_MS_PER_TOKEN x tokens moved), never measured, so reruns
+# are byte-identical by construction and the structural-share claim is
+# checkable as exact integer nanoseconds.
+SA_MS_PER_TOKEN = 2.0       # modeled chip cost of moving one token
+SA_STEADY_S = 360           # phase A: steady mixed traffic
+SA_BURST_S = 60             # phase B: burst floods, gold TTFT degrades
+SA_FAST_WINDOW_S = 300.0
+SA_SLOW_WINDOW_S = 3600.0
+SA_BURN_THRESHOLD = 14.4
+SA_GOLD_TTFT_MS = 200.0     # gold's p99 objective; phase B misses it
+
+
 # tiered KV fabric section (ISSUE 17): one replica under prefix-cache
 # pressure on a zipf system-prompt trace — tiered (host-RAM demotion,
 # promote-on-hit) vs drop-and-recompute. Every number is STRUCTURAL:
@@ -911,6 +926,93 @@ def multi_tenant_section(params, cfg):
     }
 
 
+def slo_accounting_section():
+    """The SLO accounting rep (see the SA_* block): replays a two-phase
+    tenant trace through the REAL ChipLedger + SloBudgetEngine on the
+    cost-model clock. Phase A is steady mixed traffic inside every
+    objective; in phase B the burst tenant floods the replica and
+    gold's TTFT degrades past its p99 target, so the fast window's
+    burn rate crosses the trip threshold exactly once (the capture
+    interval rate-limits the rest of the sustained breach). jax-free
+    and measurement-free: callable directly by the NON-slow smoke test
+    that pins byte-identical reruns and the structural-share claim."""
+    from nos_tpu.models.tenantquota import (
+        TenantQuotaConfig, TenantSloSpec, TenantSpec,
+    )
+    from nos_tpu.obs.slo import (
+        IDLE_TENANT, ChipLedger, SloBudgetEngine, objectives_from_quota,
+    )
+
+    quota = TenantQuotaConfig(
+        tenants={
+            "gold": TenantSpec("gold", min_rate=MT_GOLD_MIN,
+                               slo=TenantSloSpec(
+                                   ttft_p99_ms=SA_GOLD_TTFT_MS,
+                                   goodput_floor=0.95)),
+            "burst": TenantSpec("burst", max_rate=MT_BURST_MAX),
+        }, window_s=MT_WINDOW)
+    led = ChipLedger()
+    eng = SloBudgetEngine(
+        objectives_from_quota(quota),
+        fast_window_s=SA_FAST_WINDOW_S, slow_window_s=SA_SLOW_WINDOW_S,
+        burn_threshold=SA_BURN_THRESHOLD)
+    tokens = {}                 # (tenant, phase) -> structural total
+    trip_at = []
+    for sec in range(SA_STEADY_S + SA_BURST_S):
+        t0 = float(sec)
+        if sec < SA_STEADY_S:
+            work = {("gold", "decode"): 3, ("burst", "decode"): 1}
+            if sec % 10 == 0:   # a fresh gold admission
+                work[("gold", "prefill")] = 12
+        else:
+            work = {("gold", "decode"): 1, ("burst", "decode"): 6,
+                    ("burst", "prefill"): 16}
+        for k, n in work.items():
+            tokens[k] = tokens.get(k, 0) + n
+        # quantum duration IS the modeled cost of its work; the rest
+        # of each one-second tick accrues to the explicit idle tenant
+        dur_s = sum(work.values()) * SA_MS_PER_TOKEN / 1e3
+        led.note_quantum(t0, t0 + dur_s, work,
+                         {"gold": 64 * 1024, "burst": 32 * 1024})
+        # terminal verdicts: one gold completion every 10 s in phase A
+        # (inside every objective), one per second in phase B with its
+        # TTFT pushed past the target by the flood
+        if sec < SA_STEADY_S and sec % 10 == 9:
+            eng.note("gold", "ttft_p99", False, t0)
+            eng.note("gold", "goodput", False, t0)
+        elif sec >= SA_STEADY_S:
+            if eng.note("gold", "ttft_p99", True, t0):
+                trip_at.append(sec)
+            eng.note("gold", "goodput", False, t0)
+    horizon = float(SA_STEADY_S + SA_BURST_S)
+    snap = led.snapshot()
+    totals = led.totals_ns()
+    # the structural-share claim, exact: each quantum's duration is
+    # tokens x SA_MS_PER_TOKEN and the split is token-weighted, so
+    # every (tenant, phase) charge must equal its OWN token count x
+    # SA_MS_PER_TOKEN in integer nanoseconds
+    per_tok_ns = int(SA_MS_PER_TOKEN * 1e6)
+    structural = all(
+        totals.get(k, 0) == n * per_tok_ns for k, n in tokens.items())
+    return {
+        "ms_per_token": SA_MS_PER_TOKEN,
+        "steady_s": SA_STEADY_S,
+        "burst_s": SA_BURST_S,
+        "fast_window_s": SA_FAST_WINDOW_S,
+        "burn_threshold": SA_BURN_THRESHOLD,
+        "chip_ms": snap["chip_ms"],
+        "idle_ms": snap["chip_ms"][IDLE_TENANT]["idle"],
+        "kv_byte_seconds": snap["kv_byte_seconds"],
+        "slo": eng.snapshot(horizon)["objectives"],
+        "trip_at_s": trip_at,
+        # the three headline claims (booleans the smoke test pins)
+        "attribution_conserved": snap["conserved"],
+        "attribution_structural": structural,
+        "burst_trips_fast_window_once": len(trip_at) == 1
+        and trip_at[0] >= SA_STEADY_S,
+    }
+
+
 def kv_fabric_section(params, cfg):
     """The tiered KV fabric rep (see the KF_* block): runs the SAME
     code path main() ships, callable directly by the smoke test.
@@ -1398,6 +1500,7 @@ def main():
     # + deadline-slack EDF vs the unbudgeted chunk rule on the fake
     # cost-model clock — structural, byte-identical across reruns
     cc_section = chunked_colocated_section(params, cfg)
+    sa_section = slo_accounting_section()
 
     # the first token of each request is emitted by prefill (inside the
     # submit window); the drain window decodes the remaining N-1
@@ -1441,6 +1544,7 @@ def main():
         "kv_fabric": kf_section,
         "disagg": dg_section,
         "chunked_colocated": cc_section,
+        "slo_accounting": sa_section,
         "prefix_cache": {
             "shared_prefix_tokens": sys_len,
             "prefill_admit_s": round(t_submit_pc, 3),

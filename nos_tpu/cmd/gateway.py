@@ -12,6 +12,12 @@
     GET  /stats         router snapshot: replicas, door queue, routes,
                         sheds, retries (the fleet controller's
                         --gateway-url scrape target)
+    GET  /v1/slo        fleet SLO roll-up (ISSUE 20): per-tenant burn
+                        rates and budget remaining recomputed from
+                        summed per-replica window counts, chip-second
+                        attribution totals, and useful work per chip
+                        hour (optionally folding in --harvest-url's
+                        harvested chip-seconds)
     GET  /metrics       nos_tpu_gateway_* (+ /debug/traces)
 
 Discovery mirrors the fleet controller: ``nos.ai/fleet=<name>`` pods in
@@ -375,6 +381,10 @@ def make_http_server(router: GatewayRouter, port: int,
                 snap = router.stats()
                 snap["fleet"] = fleet
                 self._reply(200, snap)
+            elif self.path == "/v1/slo":
+                snap = router.slo()
+                snap["fleet"] = fleet
+                self._reply(200, snap)
             elif self.path == "/debug/traces":
                 self._reply(200, tracing.recorder().to_json())
             else:
@@ -598,6 +608,18 @@ def main(argv: Optional[Sequence[str]] = None) -> None:
              "set the SAME value on every replica's "
              "--kv-fabric-token")
     parser.add_argument(
+        "--slo-burn-threshold", type=float, default=14.4,
+        help="fleet fast-window burn rate at/above which an "
+             "aggregated (tenant, objective) row reports breaching "
+             "in GET /v1/slo (burn recomputed from summed "
+             "per-replica window counts)")
+    parser.add_argument(
+        "--harvest-url", default="",
+        help="harvest controller /stats URL; when set, its "
+             "harvested_chip_seconds counter feeds the "
+             "useful-work-per-chip-hour figure in GET /v1/slo "
+             "(empty = serving chip-seconds only)")
+    parser.add_argument(
         "--retry-attempts", type=int, default=12,
         help="dispatch attempts per request before failing it")
     parser.add_argument(
@@ -634,6 +656,7 @@ def main(argv: Optional[Sequence[str]] = None) -> None:
             tenant_quota_attempts=args.tenant_quota_attempts,
             fabric=args.kv_fabric == "on",
             fabric_max_blocks=args.kv_fabric_max_blocks,
+            slo_burn_threshold=args.slo_burn_threshold,
         ),
         transport=transport.send,
         stream_transport=transport.send_stream,
@@ -641,6 +664,15 @@ def main(argv: Optional[Sequence[str]] = None) -> None:
         resume_stream_transport=transport.resume_stream,
         on_activation=stamper.note,
     )
+    if args.harvest_url:
+        def _harvest_stats(url=args.harvest_url,
+                           timeout=args.scrape_timeout):
+            try:
+                with urllib.request.urlopen(url, timeout=timeout) as rsp:
+                    return json.loads(rsp.read().decode())
+            except (urllib.error.URLError, OSError, ValueError):
+                return None     # feed absent this cycle; roll-up uses 0
+        router.harvest_source = _harvest_stats
     scraper = HttpReplicaClient(args.replica_url_template,
                                 timeout_s=args.scrape_timeout)
 

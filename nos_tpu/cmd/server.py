@@ -48,6 +48,11 @@ from nos_tpu.models.tenantquota import (   # jax-free (quota math only)
     TenantQuotaConfig, validate_tenant_name,
 )
 from nos_tpu.obs import tracing
+from nos_tpu.obs.slo import (  # jax-free (budget/ledger policy only)
+    IDLE_TENANT,
+    SloBudgetEngine,
+    objectives_from_quota,
+)
 from nos_tpu.utils.metrics import default_registry
 
 logger = logging.getLogger("nos_tpu.server")
@@ -302,6 +307,17 @@ class ServerConfig:
     # a breach pins the request's trace in the flight recorder.
     slo_ttft_ms: float = 0.0
     slo_tpot_ms: float = 0.0
+    # per-tenant SLO error budgets (ISSUE 20; active only when the
+    # tenant config below carries ``slo`` objectives): SRE
+    # multi-burn-rate windows — the fast window pages/trips breach
+    # capture, the slow window measures budget remaining. A fast-window
+    # burn at/over the threshold emits an slo.breach span and pins the
+    # breaching request's trace, at most once per capture interval per
+    # (tenant, objective).
+    slo_fast_window_s: float = 300.0
+    slo_slow_window_s: float = 3600.0
+    slo_burn_threshold: float = 14.4
+    slo_capture_interval_s: float = 300.0
     # request-level elastic quota (empty = off): per-tenant token-rate
     # min/max with borrowing — a file path or inline JSON (see
     # models/tenantquota.TenantQuotaConfig). With it set, requests
@@ -418,7 +434,13 @@ class ServingLoop:
                  handoff_cooldown_s: float = 5.0,
                  handoff_health_interval_s: float = 0.0,
                  adopt_ttl_s: float = 600.0,
-                 fabric_token: str = ""):
+                 fabric_token: str = "",
+                 slo_fast_window_s: float = 300.0,
+                 slo_slow_window_s: float = 3600.0,
+                 slo_burn_threshold: float = 14.4,
+                 slo_capture_interval_s: float = 300.0,
+                 slo_min_events: int = 10,
+                 slo_clock=None):
         reg = default_registry()
         # register() is idempotent per (name, type, labels) and raises on
         # a mismatched re-registration — exactly what we want at startup
@@ -638,6 +660,75 @@ class ServingLoop:
                 self.m_tenant_tokens.labels(t).inc(0)
                 for mode in ("swap", "recompute"):
                     self.m_tenant_preempt.labels(t, mode).inc(0)
+        # per-tenant SLO error budgets + chip-second attribution
+        # (ISSUE 20): ON only when the tenant config carries ``slo``
+        # objectives — an unconfigured fleet registers none of these
+        # series and pays zero new per-tick work (the engine's ledger
+        # is None too; the config echo's ``slo_accounting`` block is
+        # the mode proof)
+        self.slo_engine = None
+        self._slo_clock = slo_clock or time.monotonic
+        self._chip_cum_ns: dict = {}        # (tenant, phase) -> ns
+        self._chip_seen_ns: dict = {}       # current engine's mirror
+        self._chip_cum_kvbs: dict = {}      # tenant -> byte-seconds
+        self._chip_seen_kvbs: dict = {}
+        self._chip_cum_wall_ns = 0
+        self._chip_seen_wall_ns = 0
+        self._slo_targets: dict = {}        # tenant -> TenantSloSpec
+        if tenant_quota is not None and tenant_quota.slo_enabled():
+            self._slo_targets = {
+                n: s.slo for n, s in tenant_quota.tenants.items()
+                if s.slo is not None}
+            self.slo_engine = SloBudgetEngine(
+                objectives_from_quota(tenant_quota),
+                fast_window_s=slo_fast_window_s,
+                slow_window_s=slo_slow_window_s,
+                burn_threshold=slo_burn_threshold,
+                capture_interval_s=slo_capture_interval_s,
+                min_events=slo_min_events)
+            self.g_slo_budget = reg.gauge(
+                "nos_tpu_serve_slo_budget_remaining_ratio",
+                "Slow-window error budget left per (tenant, "
+                "objective): 1 = untouched, 0 = exhausted "
+                "(bad-event fraction at/over the objective's allowance)",
+                ("tenant", "objective"))
+            self.g_slo_burn = reg.gauge(
+                "nos_tpu_serve_slo_burn_rate",
+                "SRE multi-window burn rate per (tenant, objective, "
+                "window = fast | slow): bad-event fraction over the "
+                "window divided by the objective's error-budget "
+                "allowance; a fast-window burn at/over the trip "
+                "threshold emits an slo.breach span and pins the "
+                "breaching request's trace",
+                ("tenant", "objective", "window"))
+            self.m_chip_ms = reg.counter(
+                "nos_tpu_serve_tenant_chip_ms_total",
+                "Engine wall milliseconds attributed per (tenant, "
+                "phase = decode | prefill | idle): each quantum's "
+                "measured duration split over its structural token "
+                "weights, idle time under the _idle tenant — the "
+                "ledger conserves (sum over series == engine wall "
+                "time), across supervised engine swaps too "
+                "(delta-mirrored)",
+                ("tenant", "phase"))
+            self.m_kv_byte_s = reg.counter(
+                "nos_tpu_serve_tenant_kv_byte_seconds_total",
+                "HBM byte-seconds of paged-KV residency per tenant "
+                "(block-table + prefix-chain references; _shared = "
+                "unscoped prefix chains), accrued over each engine "
+                "quantum",
+                ("tenant",))
+            for t, objs in sorted(
+                    self.slo_engine.objectives.items()):
+                for obj in sorted(objs):
+                    self.g_slo_budget.labels(t, obj).set(1.0)
+                    for w in ("fast", "slow"):
+                        self.g_slo_burn.labels(t, obj, w).set(0.0)
+            for t in tenant_quota.names():
+                for ph in ("decode", "prefill"):
+                    self.m_chip_ms.labels(t, ph).inc(0)
+                self.m_kv_byte_s.labels(t).inc(0)
+            self.m_chip_ms.labels(IDLE_TENANT, "idle").inc(0)
         # prefill/decode disaggregation (registered only on a
         # prefill-role loop — colocated and decode servers must not
         # export dead zero series): handoffs shipped to the decode
@@ -1074,9 +1165,14 @@ class ServingLoop:
                     self._goodput_good += 1
                 self.g_goodput.set(
                     self._goodput_good / self._goodput_done)
+        slo_trips: list = []
+        slo_tenant = None
         if self._tenant_cfg is not None:
             t = self._tenant_of.pop(
                 rid, self._tenant_cfg.default_tenant)
+            slo_tenant = t
+            slo_trips = self._judge_tenant_slo(
+                t, outcome, ledger, decode_tokens, gap_sum)
             if ledger and ledger.get("output_tokens"):
                 self.m_tenant_tokens.labels(t).inc(
                     ledger["output_tokens"])
@@ -1105,10 +1201,81 @@ class ServingLoop:
             if breaches:
                 sp.set_attr("slo_breach", ",".join(breaches))
                 tracing.recorder().pin(sp.trace_id, "slo")
+            if slo_trips:
+                # fast-window burn trip (ISSUE 20): mint the
+                # registry-linted slo.breach span under the breaching
+                # request and pin its stitched trace ONCE — the budget
+                # engine's per-(tenant, objective) capture interval is
+                # the rate limit keeping a sustained breach from
+                # wedging the flight recorder
+                for obj in slo_trips:
+                    bsp = tracing.start_span(
+                        "slo.breach", component="server", parent=sp,
+                        attrs={"tenant": slo_tenant, "objective": obj,
+                               "burn_threshold":
+                                   self.slo_engine.burn_threshold})
+                    bsp.end()
+                # pin through the tracer's ACTIVE recorder — the same
+                # sink the request's spans landed in
+                rec = tracing.tracer().recorder
+                if rec is not None:
+                    rec.pin(sp.trace_id, "slo_burn")
             sp.end()
         if outcome in ("finished", "abandoned"):
             self._finished_cum += 1
             self._note_rates()
+
+    def _judge_tenant_slo(self, tenant: str, outcome: str,
+                          ledger: Optional[dict], decode_tokens: int,
+                          gap_sum: float) -> list:
+        """Feed one terminal request into the tenant's error-budget
+        windows (ISSUE 20) and refresh its burn/budget gauges. TTFT and
+        TPOT objectives judge finished requests against the tenant's
+        p99 targets; the goodput objective judges every server-decided
+        outcome (finished good, failed/deadline bad — client cancels
+        are not a quality verdict, same convention as the tenant
+        goodput gauge). Returns the objectives whose fast window
+        TRIPPED on this event (rate-limited by the engine)."""
+        if self.slo_engine is None:
+            return []
+        targets = self._slo_targets.get(tenant)
+        tracked = self.slo_engine.tracked(tenant)
+        if targets is None or not tracked:
+            return []
+        now = self._slo_clock()
+        trips = []
+        judged = False
+        if outcome == "finished" and ledger:
+            ttft = ledger.get("ttft_s")
+            if "ttft_p99" in tracked and ttft is not None:
+                bad = ttft > targets.ttft_p99_ms / 1e3
+                if self.slo_engine.note(tenant, "ttft_p99", bad, now):
+                    trips.append("ttft_p99")
+                judged = True
+            if "tpot_p99" in tracked and decode_tokens:
+                bad = gap_sum / decode_tokens \
+                    > targets.tpot_p99_ms / 1e3
+                if self.slo_engine.note(tenant, "tpot_p99", bad, now):
+                    trips.append("tpot_p99")
+                judged = True
+        if "goodput" in tracked \
+                and outcome in ("finished", "failed", "deadline"):
+            bad = outcome != "finished"
+            if self.slo_engine.note(tenant, "goodput", bad, now):
+                trips.append("goodput")
+            judged = True
+        if judged:
+            for row in self.slo_engine.rows(now):
+                if row["tenant"] != tenant:
+                    continue
+                obj = row["objective"]
+                self.g_slo_budget.labels(tenant, obj).set(
+                    row["budget_remaining_ratio"])
+                self.g_slo_burn.labels(tenant, obj, "fast").set(
+                    row["burn_fast"])
+                self.g_slo_burn.labels(tenant, obj, "slow").set(
+                    row["burn_slow"])
+        return trips
 
     def _note_rates(self) -> None:
         """Append a (t, tokens, requests) mark and prune the rolling
@@ -1343,6 +1510,12 @@ class ServingLoop:
                 # only sees decoded payloads, never fetches)
                 "kv_fabric_pulls": dict(self._pull_counts),
                 "tick_phases": self._tick_phase_snapshot(),
+                # ISSUE 20: None when SLO accounting is off — the
+                # stable-key contract the /stats drift guard pins
+                "slo_budget": (
+                    self.slo_engine.snapshot(self._slo_clock())
+                    if self.slo_engine is not None else None),
+                "chip_ledger": self._chip_ledger_block(),
             })
         return snap
 
@@ -1490,6 +1663,12 @@ class ServingLoop:
                 t4 = time.monotonic()
                 self.h_tick.observe(t4 - t0,
                                     trace_id=sp.trace_id or None)
+                chip_note = getattr(eng, "chip_note_quantum", None)
+                if chip_note is not None:
+                    # the attribution ledger charges the quantum with
+                    # the SAME two reads the tick profiler pays —
+                    # no-op unless SLO accounting is configured
+                    chip_note(t0, t4)
                 self._note_tick_phases(t0, t1, t2, t3, t4,
                                        eng if split else None,
                                        tid=sp.trace_id or None)
@@ -1640,6 +1819,12 @@ class ServingLoop:
             # the deltas would go negative and freeze the counters
             self._prefix_evict_seen = {"drop": 0, "demote": 0}
             self._fabric_seen = {"demote": 0, "promote": 0}
+            # the rebuilt engine's attribution ledger restarts at zero:
+            # reset the chip mirrors (the cumulative totals keep the
+            # old engine's charges — conservation holds across swaps)
+            self._chip_seen_ns = {}
+            self._chip_seen_kvbs = {}
+            self._chip_seen_wall_ns = 0
             resumed = {"swap": 0, "recompute": 0}
             lost = 0
             seen = set()
@@ -2563,6 +2748,7 @@ class ServingLoop:
                 if delta > 0:
                     m.inc(delta)
                     self._psched_seen[key] = n
+        self._mirror_chip_ledger()
         kv_stats = getattr(self.engine, "kv_stats", None)
         kv = kv_stats() if kv_stats is not None else None
         if kv:
@@ -2595,6 +2781,55 @@ class ServingLoop:
                         ("device",)).labels(hbm["device"]).set(
                             hbm["limit"])
         self._drain_compile_events()
+
+    def _mirror_chip_ledger(self) -> None:
+        """Delta-mirror the engine's attribution ledger into the
+        chip-ms / kv-byte-seconds counters AND the loop's cumulative
+        totals (which survive supervised engine swaps — the PR 13
+        tenant-counter pattern: ``_do_recover`` resets the seen dicts
+        when a rebuilt engine restarts its ledger from zero, so the
+        cumulative view stays monotone and stays conserved)."""
+        chip = getattr(self.engine, "chip", None)
+        if chip is None or self.slo_engine is None:
+            return
+        for key, ns in chip.totals_ns().items():
+            delta = ns - self._chip_seen_ns.get(key, 0)
+            if delta > 0:
+                self._chip_seen_ns[key] = ns
+                self._chip_cum_ns[key] = \
+                    self._chip_cum_ns.get(key, 0) + delta
+                self.m_chip_ms.labels(*key).inc(delta / 1e6)
+        delta = chip.wall_ns - self._chip_seen_wall_ns
+        if delta > 0:
+            self._chip_seen_wall_ns = chip.wall_ns
+            self._chip_cum_wall_ns += delta
+        for t, bs in chip.kv_byte_seconds().items():
+            d = bs - self._chip_seen_kvbs.get(t, 0.0)
+            if d > 0:
+                self._chip_seen_kvbs[t] = bs
+                self._chip_cum_kvbs[t] = \
+                    self._chip_cum_kvbs.get(t, 0.0) + d
+                self.m_kv_byte_s.labels(t).inc(d)
+
+    def _chip_ledger_block(self) -> Optional[dict]:
+        """/stats ``chip_ledger``: the loop's cumulative attribution
+        totals (None = SLO accounting off). Conservation is judged on
+        the cumulative integers, so it holds across engine swaps."""
+        if self.slo_engine is None:
+            return None
+        self._mirror_chip_ledger()
+        per: dict = {}
+        for (t, ph), ns in sorted(self._chip_cum_ns.items()):
+            per.setdefault(t, {})[ph] = round(ns / 1e6, 3)
+        return {
+            "wall_ms": round(self._chip_cum_wall_ns / 1e6, 3),
+            "chip_ms": per,
+            "kv_byte_seconds": {
+                t: round(v, 3)
+                for t, v in sorted(self._chip_cum_kvbs.items())},
+            "conserved": (sum(self._chip_cum_ns.values())
+                          == self._chip_cum_wall_ns),
+        }
 
     def stream(self, prompt, max_new_tokens, timeout: float = 300.0,
                deadline_s: Optional[float] = None,
@@ -3680,6 +3915,25 @@ def main(argv: Optional[Sequence[str]] = None) -> None:
         help="mean time-per-output-token SLO target in ms (0 = unset; "
              "overrides config)")
     parser.add_argument(
+        "--slo-fast-window-s", type=float, default=None,
+        help="fast burn-rate window in seconds for per-tenant SLO "
+             "error budgets (active only when the tenant config "
+             "carries slo objectives; overrides config)")
+    parser.add_argument(
+        "--slo-slow-window-s", type=float, default=None,
+        help="slow burn-rate window in seconds (budget-remaining "
+             "horizon; overrides config)")
+    parser.add_argument(
+        "--slo-burn-threshold", type=float, default=None,
+        help="fast-window burn rate at/over which a breach trip fires "
+             "(emits an slo.breach span and pins the breaching "
+             "request's trace; overrides config)")
+    parser.add_argument(
+        "--slo-capture-interval-s", type=float, default=None,
+        help="minimum seconds between breach-capture trips per "
+             "(tenant, objective) — the flight-recorder rate limit "
+             "(overrides config)")
+    parser.add_argument(
         "--device-stats-interval", type=float, default=None,
         help="seconds between device.memory_stats() samples into the "
              "HBM gauges (0 disables; overrides config)")
@@ -3763,6 +4017,14 @@ def main(argv: Optional[Sequence[str]] = None) -> None:
         cfg.slo_ttft_ms = args.slo_ttft_ms
     if args.slo_tpot_ms is not None:
         cfg.slo_tpot_ms = args.slo_tpot_ms
+    if args.slo_fast_window_s is not None:
+        cfg.slo_fast_window_s = args.slo_fast_window_s
+    if args.slo_slow_window_s is not None:
+        cfg.slo_slow_window_s = args.slo_slow_window_s
+    if args.slo_burn_threshold is not None:
+        cfg.slo_burn_threshold = args.slo_burn_threshold
+    if args.slo_capture_interval_s is not None:
+        cfg.slo_capture_interval_s = args.slo_capture_interval_s
     if args.device_stats_interval is not None:
         cfg.device_stats_interval_s = args.device_stats_interval
     if args.restart_budget is not None:
@@ -3829,6 +4091,10 @@ def main(argv: Optional[Sequence[str]] = None) -> None:
         default_deadline_s=cfg.default_deadline_s, seed=cfg.seed,
         tenant_quota=tenant_quota,
         fabric_token=cfg.kv_fabric_token,
+        slo_fast_window_s=cfg.slo_fast_window_s,
+        slo_slow_window_s=cfg.slo_slow_window_s,
+        slo_burn_threshold=cfg.slo_burn_threshold,
+        slo_capture_interval_s=cfg.slo_capture_interval_s,
         # /stats config echo: what the fleet controller compares across
         # replicas to catch config drift between scrapes
         config_echo={
@@ -3872,6 +4138,19 @@ def main(argv: Optional[Sequence[str]] = None) -> None:
             # in the same drift detector as every other knob
             "tenant_quota": (tenant_quota.echo()
                              if tenant_quota is not None else None),
+            # SLO accounting mode proof (ISSUE 20 acceptance): which
+            # mode this replica runs — enabled only when the tenant
+            # config carries objectives — plus the window/threshold
+            # knobs whose drift would make fleet burn rates
+            # replica-dependent
+            "slo_accounting": {
+                "enabled": bool(tenant_quota is not None
+                                and tenant_quota.slo_enabled()),
+                "fast_window_s": cfg.slo_fast_window_s,
+                "slow_window_s": cfg.slo_slow_window_s,
+                "burn_threshold": cfg.slo_burn_threshold,
+                "capture_interval_s": cfg.slo_capture_interval_s,
+            },
         })
     httpd = make_http_server(cfg, loop)
 

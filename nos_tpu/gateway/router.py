@@ -65,6 +65,7 @@ from nos_tpu.models.errors import (
 )
 from nos_tpu.models.tenantquota import TenantQuotaConfig
 from nos_tpu.obs import tracing
+from nos_tpu.obs.slo import IDLE_TENANT, aggregate_slo
 from nos_tpu.utils.metrics import default_registry
 
 logger = logging.getLogger(__name__)
@@ -189,6 +190,11 @@ class RouterConfig:
     # block, longest-first).
     fabric: bool = False
     fabric_max_blocks: int = 32
+    # fleet SLO roll-up (ISSUE 20): fast-window burn-rate at/above this
+    # marks an aggregated (tenant, objective) row ``breaching`` in
+    # GET /v1/slo — fleet burn is recomputed from SUMMED window counts,
+    # not averaged per-replica ratios
+    slo_burn_threshold: float = 14.4
 
 
 class GatewayRouter:
@@ -315,6 +321,26 @@ class GatewayRouter:
             "(ready = admitting | draining | down = known but not "
             "admitting for any other reason)",
             ("state",))
+        # fleet SLO roll-up (ISSUE 20): gauges mirror the aggregated
+        # view GET /v1/slo serves; label rows appear as replicas with
+        # configured tenants join the scrape
+        self.g_slo_budget = reg.gauge(
+            "nos_tpu_gateway_slo_budget_remaining_ratio",
+            "Fleet-wide fraction of each tenant objective's slow-window "
+            "error budget still unspent (1 = untouched, 0 = exhausted), "
+            "recomputed from summed per-replica window counts",
+            ("tenant", "objective"))
+        self.g_slo_burn = reg.gauge(
+            "nos_tpu_gateway_slo_burn_rate",
+            "Fleet-wide SLO burn rate (bad fraction / allowed) per "
+            "tenant objective and window (fast | slow), from summed "
+            "per-replica window counts — fast at/above the burn "
+            "threshold marks the row breaching in GET /v1/slo",
+            ("tenant", "objective", "window"))
+        # chip-second harvest feed for useful-work-per-chip-hour: the
+        # binary wires a /stats scrape of the harvest controller here
+        # (--harvest-url); tests inject HarvestController.stats
+        self.harvest_source: Optional[Callable[[], Optional[dict]]] = None
 
     # -- membership ------------------------------------------------------
     def update(self, replicas: Iterable[Replica]) -> None:
@@ -352,6 +378,16 @@ class GatewayRouter:
             self.g_replicas.labels("draining").set(n_drain)
             self.g_replicas.labels("down").set(
                 len(fresh) - n_ready - n_drain)
+            for row in self._slo_locked()["objectives"]:
+                self.g_slo_budget.labels(
+                    row["tenant"], row["objective"]).set(
+                    row["budget_remaining_ratio"])
+                self.g_slo_burn.labels(
+                    row["tenant"], row["objective"], "fast").set(
+                    row["burn_fast"])
+                self.g_slo_burn.labels(
+                    row["tenant"], row["objective"], "slow").set(
+                    row["burn_slow"])
             if not had_admitting and n_ready:
                 self._lock.notify_all()     # flush the door queue
 
@@ -1092,6 +1128,68 @@ class GatewayRouter:
         return gen()
 
     # -- introspection ---------------------------------------------------
+    # -- fleet SLO roll-up (ISSUE 20) ------------------------------------
+    def _slo_locked(self) -> dict:
+        """Caller holds the lock. Merges the per-replica ``slo_budget``
+        and ``chip_ledger`` /stats blocks from the discovery scrape into
+        the fleet view ``GET /v1/slo`` serves: burn recomputed from
+        summed window counts, chip-ms/KV byte-seconds summed per tenant,
+        and the useful-work-per-chip-hour figure folding in harvested
+        chip-seconds from the optional ``harvest_source`` feed."""
+        blocks: List[dict] = []
+        chip_ms: Dict[str, Dict[str, float]] = {}
+        kv_bs: Dict[str, float] = {}
+        wall_ms = busy_ms = 0.0
+        ledger_replicas = 0
+        for _name, r in sorted(self._replicas.items()):
+            st = r.stats or {}
+            blk = st.get("slo_budget")
+            if blk:
+                blocks.append(blk)
+            led = st.get("chip_ledger")
+            if not led:
+                continue
+            ledger_replicas += 1
+            wall_ms += float(led.get("wall_ms", 0.0))
+            for tenant, phases in (led.get("chip_ms") or {}).items():
+                per = chip_ms.setdefault(tenant, {})
+                for phase, ms in phases.items():
+                    per[phase] = per.get(phase, 0.0) + ms
+                    if tenant != IDLE_TENANT:
+                        busy_ms += ms
+            for tenant, bs in (led.get("kv_byte_seconds") or {}).items():
+                kv_bs[tenant] = kv_bs.get(tenant, 0.0) + bs
+        harvested_s = 0.0
+        if self.harvest_source is not None:
+            try:
+                hs = self.harvest_source() or {}
+            except Exception:
+                hs = {}
+            harvested_s = float(
+                hs.get("harvested_chip_seconds", 0.0) or 0.0)
+        busy_s, wall_s = busy_ms / 1e3, wall_ms / 1e3
+        return {
+            "burn_threshold": self.cfg.slo_burn_threshold,
+            "objectives": aggregate_slo(
+                blocks, self.cfg.slo_burn_threshold),
+            "chip_ms": chip_ms,
+            "kv_byte_seconds": kv_bs,
+            "useful_work": {
+                "serving_busy_chip_s": round(busy_s, 6),
+                "serving_wall_chip_s": round(wall_s, 6),
+                "harvested_chip_s": round(harvested_s, 6),
+                "useful_work_per_chip_hour": (
+                    round(3600.0 * (busy_s + harvested_s) / wall_s, 3)
+                    if wall_s > 0 else None),
+                "ledger_replicas": ledger_replicas,
+            },
+        }
+
+    def slo(self) -> dict:
+        """The fleet SLO/attribution roll-up ``GET /v1/slo`` serves."""
+        with self._lock:
+            return self._slo_locked()
+
     def stats(self) -> dict:
         """The gateway's /stats snapshot; the fleet controller's
         ``gateway_source`` reads ``door_queue`` as the scale-from-zero
@@ -1122,6 +1220,7 @@ class GatewayRouter:
                 "kv_fabric": dict(self._fleet_index.stats(),
                                   enabled=self.cfg.fabric,
                                   offered=self._fabric_offered),
+                "slo": self._slo_locked(),
                 "config": {
                     "block_size": self.cfg.block_size,
                     "affinity_blocks": self.cfg.affinity_blocks,
@@ -1132,6 +1231,7 @@ class GatewayRouter:
                     "max_door_queue": self.cfg.max_door_queue,
                     "fabric": self.cfg.fabric,
                     "fabric_max_blocks": self.cfg.fabric_max_blocks,
+                    "slo_burn_threshold": self.cfg.slo_burn_threshold,
                     "tenant_quota": (
                         self.cfg.tenant_config.echo()
                         if self.cfg.tenant_config is not None

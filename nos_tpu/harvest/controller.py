@@ -49,7 +49,7 @@ import json
 import logging
 import time
 from dataclasses import dataclass, field
-from typing import Callable, Dict, List, Optional
+from typing import Callable, Dict, List, Optional, Tuple
 
 from nos_tpu import constants
 from nos_tpu.fleet.quota import QuotaView, build_quota_infos
@@ -135,6 +135,12 @@ class HarvestController:
         self._phase_spans: Dict[str, object] = {}
         self._ledger: List[dict] = []        # finalized reclaim records
         self._last: dict = {}                # stats() snapshot
+        # chip-second harvest ledger (ISSUE 20): borrowed chips × wall
+        # time between reconciles, accrued on the injectable clock —
+        # the gateway's --harvest-url feed for useful work per chip
+        # hour in GET /v1/slo
+        self._harvested_chip_s = 0.0
+        self._harvest_prev: Optional[Tuple[float, float]] = None
         reg = default_registry()
         self.g_borrowed = reg.gauge(
             "nos_tpu_harvest_borrowed_chips",
@@ -168,6 +174,13 @@ class HarvestController:
             "Training steps lost to reclaims (step at eviction minus "
             "the durable checkpoint step resumed from; bounded by one "
             "checkpoint interval + save duration + reclaim budget)")
+        self.m_chip_seconds = reg.counter(
+            "nos_tpu_harvest_chip_seconds_total",
+            "Chip-seconds of otherwise-idle capacity the harvest plane "
+            "has put to work: borrowed chips integrated over wall time "
+            "between reconciles — the gateway folds this (via "
+            "--harvest-url) into useful work per chip hour in "
+            "GET /v1/slo")
 
     # -- pod inventory --------------------------------------------------
     def _slots(self) -> List[str]:
@@ -438,6 +451,16 @@ class HarvestController:
             self.calc.compute_pod_request(p).get(cfg.resource, 0.0)
             for p in pods if p.spec.node_name)
         self.g_borrowed.set(borrowed)
+        # chip-second accrual: the PREVIOUS borrowed level held for the
+        # interval since the previous reconcile (left Riemann sum on
+        # the injectable clock — deterministic under a fake clock)
+        if self._harvest_prev is not None:
+            prev_t, prev_borrowed = self._harvest_prev
+            accrued = prev_borrowed * max(0.0, now - prev_t)
+            self._harvested_chip_s += accrued
+            if accrued:
+                self.m_chip_seconds.inc(accrued)
+        self._harvest_prev = (now, borrowed)
         sp.set_attr("gangs", len(gangs))
         sp.set_attr("borrowed_chips", borrowed)
         self._last = {
@@ -445,6 +468,7 @@ class HarvestController:
             "namespace": cfg.namespace,
             "gangs": dict(sorted(states.items())),
             "borrowed_chips": borrowed,
+            "harvested_chip_seconds": round(self._harvested_chip_s, 3),
             "quota": {
                 "slack_chips": (slack if slack != float("inf") else None),
                 "reclaim_pressure_chips": pressure,

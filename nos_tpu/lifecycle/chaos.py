@@ -451,7 +451,8 @@ class ChaosHarness:
                     obs.LIFECYCLE_DETECTION.observe(lat, trace_id=tid)
                     if t.episode is not None:
                         trace.start_span(
-                            "detect", component="chaos", parent=t.episode,
+                            "chaos.detect", component="chaos",
+                            parent=t.episode,
                             attrs={"kind": f.kind, "node": f.node},
                             start_time=self.t0 + f.at).end(now)
                     self._log(f"detected {f.kind} node={f.node} "
@@ -475,7 +476,8 @@ class ChaosHarness:
                         # rebind phase: fence complete -> every displaced
                         # gang atomically rebound
                         trace.start_span(
-                            "rebind", component="chaos", parent=t.episode,
+                            "chaos.rebind", component="chaos",
+                            parent=t.episode,
                             attrs={"gangs": ",".join(
                                 f"{ns}/{g}" for ns, g in sorted(t.displaced))},
                             start_time=t.detected_at

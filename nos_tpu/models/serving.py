@@ -52,6 +52,7 @@ from nos_tpu.models.kvblocks import (
 from nos_tpu.ops.attention import (
     dequantize_kv, effective_paged_impl, quantize_kv,
 )
+from nos_tpu.obs.slo import ChipLedger
 from nos_tpu.models.tenantquota import (
     DEFAULT_TENANT, TenantQuotaConfig, TenantScheduler,
 )
@@ -471,6 +472,17 @@ class DecodeServer:
         self._tq_clock = tenant_clock or time.perf_counter
         self._prefix_scoped = (tenant_quota is not None
                                and not tenant_quota.share_prefix)
+        # per-tenant chip-second attribution (ISSUE 20): ON only when
+        # the tenant config carries slo objectives — every hot-path
+        # hook below is a single ``self.chip is None`` check when off
+        # (the acceptance bar: unconfigured == zero new per-tick work).
+        # ``_chip_work`` accumulates this quantum's structural token
+        # weights ((tenant, phase) -> tokens); chip_note_quantum drains
+        # it into the ledger with the quantum's existing clock reads.
+        self.chip = (ChipLedger()
+                     if tenant_quota is not None
+                     and tenant_quota.slo_enabled() else None)
+        self._chip_work: Dict[Tuple[str, str], int] = {}
         # True while _admit last broke on the paged memory-headroom
         # check with free slots available: the queue is blocked on
         # KV-blocks/HBM, not slots — submit sheds with
@@ -1290,6 +1302,7 @@ class DecodeServer:
                 [req.prompt + [0] * (bucket - plen)], jnp.int32)
             logits, row = self._run_prefill(toks, row)
             step = logits[0, plen - 1]
+        self._chip_add(req.tenant, "prefill", plen - m)
         self._finish_prefill(req, row, step)
 
     def _start_chunked_prefill(self, req: _Request, m: int,
@@ -1363,6 +1376,7 @@ class DecodeServer:
         done = self._prefill_advance(ent)
         dt = time.perf_counter() - t0
         self.prefill_chunk_tokens += cost
+        self._chip_add(ent["req"].tenant, "prefill", cost)
         if cost > 0:
             self._chunk_tok_s.append(dt / cost)
         if not done:
@@ -1598,6 +1612,55 @@ class DecodeServer:
         if self._tq is not None and n:
             self._tq.note_tokens(req.tenant, n, self._tq_clock())
 
+    def _chip_add(self, tenant: Optional[str], phase: str,
+                  n: int) -> None:
+        """Accumulate ``n`` tokens of structural work weight for this
+        quantum's attribution split (ISSUE 20): decode tokens emitted
+        per slot (batch-share weighting) and prefill prompt-tokens
+        advanced, both charged to the RESOLVED tenant. One dict-add per
+        arrival/chunk when SLO accounting is on; one attribute check
+        when off."""
+        if self.chip is None or n <= 0:
+            return
+        key = (self._tq.cfg.resolve(tenant), phase)
+        self._chip_work[key] = self._chip_work.get(key, 0) + n
+
+    def _chip_kv_bytes(self) -> Dict[str, int]:
+        """Resident HBM KV bytes per tenant, from the paged arena's
+        refcounts: each active slot's block table charges its resolved
+        tenant; prefix chains held by the index charge their scope (or
+        ``_shared`` for an unscoped cache). Charging is per REFERENCE —
+        a copy-on-write-shared block charges every holder, the same
+        convention the arena's own occupancy accounting uses. Empty for
+        slot-static engines (fixed allocation, not per-tenant)."""
+        if not self.paged:
+            return {}
+        nb = self._chain_block_nbytes()
+        out: Dict[str, int] = {}
+        for s, req in self._active.items():
+            blocks = len(self._tables[s]) if s < len(self._tables) else 0
+            if blocks:
+                t = self._tq.cfg.resolve(req.tenant)
+                out[t] = out.get(t, 0) + nb * blocks
+        if self._pindex is not None:
+            for (scope, _toks), blocks in self._pindex.chain_items():
+                t = scope if scope is not None else "_shared"
+                out[t] = out.get(t, 0) + nb * len(blocks)
+        return out
+
+    def chip_note_quantum(self, t0: float, t1: float) -> None:
+        """Charge one engine quantum ``[t0, t1]`` to the attribution
+        ledger, draining the accumulated token weights — the serving
+        loop calls this with the SAME two tick-profiler clock reads it
+        already pays for (one-clock-read discipline: the ledger adds no
+        timer of its own); library step() self-charges. No-op when SLO
+        accounting is off."""
+        if self.chip is None:
+            return
+        work = self._chip_work
+        self._chip_work = {}
+        self.chip.note_quantum(t0, t1, work, self._chip_kv_bytes())
+
     def _finish_if_done(self, req: _Request, admit: bool = True) -> None:
         """Completion + slot recycling. Resetting the slot's per-row pos
         is the pipeline ROLLBACK: a completion observed up to
@@ -1725,6 +1788,7 @@ class DecodeServer:
                 [req.prompt + [0] * (bucket - plen)], jnp.int32)
             logits, row = self._run_prefill(toks, row)
             step = logits[0, plen - 1]
+        self._chip_add(req.tenant, "prefill", plen - m)
         self._finish_prefill(req, row, step)
 
     def _paged_prefill_in_arena(self, req: _Request, m: int,
@@ -1765,6 +1829,7 @@ class DecodeServer:
                 self.cache[key] = cache[key]
         step = logits[0, len(suffix) - 1]
         req.reserved_blocks = table
+        self._chip_add(req.tenant, "prefill", len(suffix))
         self._finish_prefill(req, None, step, installed=True)
 
     def _paged_start_chunked(self, req: _Request, m: int, mkey) -> bool:
@@ -2875,7 +2940,13 @@ class DecodeServer:
             # TPOT cost-model sample, skipping ticks that paid a
             # synchronous XLA compile (they'd poison the median)
             self.note_tick_seconds(time.perf_counter() - t0)
-        return self.step_finish(handle)
+        emitted = self.step_finish(handle)
+        if self.chip is not None:
+            # library callers have no serving loop paying the
+            # tick-profiler reads: self-charge the quantum (one tail
+            # clock read, only when SLO accounting is on)
+            self.chip_note_quantum(t0, time.perf_counter())
+        return emitted
 
     def _active_slots(self) -> List[int]:
         pre = {ent["req"].slot for ent in self._prefilling}
@@ -3072,6 +3143,7 @@ class DecodeServer:
             if n and now:
                 req.led.note_tokens(n, now)
             self._note_tenant_tokens(req, n)
+            self._chip_add(req.tenant, "decode", n)
             self._finish_if_done(req, admit=False)
         return emitted
 

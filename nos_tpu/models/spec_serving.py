@@ -853,6 +853,10 @@ class SpeculativeDecodeServer(DecodeServer):
                 # attributes the arrival gap evenly across them
                 req.led.note_tokens(n, now)
             self._note_tenant_tokens(req, n)
+            # draft + verify both ran inside this quantum's measured
+            # duration, so spec overhead charges the SERVED tenant via
+            # the same accepted-token weights (ISSUE 20)
+            self._chip_add(req.tenant, "decode", n)
             self._finish_if_done(req, admit=False)
         return emitted
 

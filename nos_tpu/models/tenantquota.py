@@ -49,8 +49,8 @@ from typing import Deque, Dict, Iterable, List, Optional, Tuple
 
 from nos_tpu.quota.info import QuotaInfo, QuotaInfos
 
-__all__ = ["DEFAULT_TENANT", "TenantSpec", "TenantQuotaConfig",
-           "TenantScheduler", "RATE_RESOURCE"]
+__all__ = ["DEFAULT_TENANT", "TenantSloSpec", "TenantSpec",
+           "TenantQuotaConfig", "TenantScheduler", "RATE_RESOURCE"]
 
 #: the tenant unlabeled traffic is accounted to
 DEFAULT_TENANT = "default"
@@ -70,15 +70,35 @@ MAX_TENANT_LEN = 128
 
 
 @dataclass(frozen=True)
+class TenantSloSpec:
+    """One tenant's SLO objectives (ISSUE 20): p99 latency targets and
+    a goodput floor, all optional (0 = objective not tracked). These
+    feed the serving loop's ``SloBudgetEngine``; a config with no
+    ``slo`` blocks anywhere runs with SLO accounting OFF (zero new
+    per-tick work)."""
+
+    ttft_p99_ms: float = 0.0
+    tpot_p99_ms: float = 0.0
+    goodput_floor: float = 0.0
+
+    def echo(self) -> dict:
+        return {"ttft_p99_ms": self.ttft_p99_ms,
+                "tpot_p99_ms": self.tpot_p99_ms,
+                "goodput_floor": self.goodput_floor}
+
+
+@dataclass(frozen=True)
 class TenantSpec:
     """One tenant's token-rate quota. ``min_rate`` tokens/s are
     GUARANTEED (admitted first, reclaimed by preemption when necessary);
     ``max_rate`` is the borrowing ceiling under contention (0 =
-    unlimited). min <= max is validated at parse time."""
+    unlimited). min <= max is validated at parse time. ``slo`` carries
+    the tenant's optional error-budget objectives."""
 
     name: str
     min_rate: float = 0.0
     max_rate: float = 0.0
+    slo: Optional[TenantSloSpec] = None
 
 
 @dataclass
@@ -155,13 +175,37 @@ class TenantQuotaConfig:
         tenants = {}
         for name, body in (data.get("tenants") or {}).items():
             validate_tenant_name(name)
-            extra = set(body) - {"min_rate", "max_rate"}
+            extra = set(body) - {"min_rate", "max_rate", "slo"}
             if extra:
                 raise ValueError(
                     f"tenant {name!r}: unknown keys {sorted(extra)}")
+            slo = None
+            if body.get("slo") is not None:
+                sbody = body["slo"]
+                if not isinstance(sbody, dict):
+                    raise ValueError(
+                        f"tenant {name!r}: slo must be an object")
+                sextra = set(sbody) - {"ttft_p99_ms", "tpot_p99_ms",
+                                       "goodput_floor"}
+                if sextra:
+                    raise ValueError(
+                        f"tenant {name!r}: unknown slo keys "
+                        f"{sorted(sextra)}")
+                slo = TenantSloSpec(
+                    ttft_p99_ms=float(sbody.get("ttft_p99_ms", 0.0)),
+                    tpot_p99_ms=float(sbody.get("tpot_p99_ms", 0.0)),
+                    goodput_floor=float(
+                        sbody.get("goodput_floor", 0.0)))
+                if slo.ttft_p99_ms < 0 or slo.tpot_p99_ms < 0:
+                    raise ValueError(
+                        f"tenant {name!r}: slo targets must be >= 0")
+                if not 0.0 <= slo.goodput_floor < 1.0:
+                    raise ValueError(
+                        f"tenant {name!r}: goodput_floor must be in "
+                        f"[0, 1)")
             tenants[name] = TenantSpec(
                 name, min_rate=float(body.get("min_rate", 0.0)),
-                max_rate=float(body.get("max_rate", 0.0)))
+                max_rate=float(body.get("max_rate", 0.0)), slo=slo)
         return cls(
             tenants=tenants,
             default_tenant=str(data.get("default_tenant",
@@ -184,9 +228,15 @@ class TenantQuotaConfig:
     def names(self) -> List[str]:
         return sorted(self.tenants)
 
+    def slo_enabled(self) -> bool:
+        """True when ANY tenant carries objectives — the single switch
+        for the ledger + budget engine (ISSUE 20 acceptance: absent
+        config must mean zero new per-tick work)."""
+        return any(s.slo is not None for s in self.tenants.values())
+
     def echo(self) -> dict:
         """Config-echo shape for /stats (fleet drift detection)."""
-        return {
+        out = {
             "default_tenant": self.default_tenant,
             "window_s": self.window_s,
             "share_prefix": self.share_prefix,
@@ -194,6 +244,10 @@ class TenantQuotaConfig:
                 n: {"min_rate": s.min_rate, "max_rate": s.max_rate}
                 for n, s in sorted(self.tenants.items())},
         }
+        for n, s in self.tenants.items():
+            if s.slo is not None:
+                out["tenants"][n]["slo"] = s.slo.echo()
+        return out
 
 
 def validate_tenant_name(name: str) -> str:

@@ -4,6 +4,9 @@
   propagation, and the bounded flight recorder behind ``/debug/traces``.
 - ``trace_export``: Perfetto / Chrome trace-event JSON export for the
   benches (``bench_logs/*.trace.json``).
+- ``slo``: the per-tenant chip-second attribution ledger and the
+  multi-window SLO error-budget engine (ISSUE 20) — jax-free policy
+  objects; the serving loop owns their metric/span export.
 
 Domain *metrics* stay in ``nos_tpu/observability.py`` (the histogram /
 counter registry every ``/metrics`` endpoint serves); this package is
@@ -11,6 +14,13 @@ the trace half of the observability story, with OpenMetrics exemplars
 (utils/metrics.py) linking the two.
 """
 from nos_tpu.obs import tracing  # noqa: F401
+from nos_tpu.obs.slo import (  # noqa: F401
+    IDLE_TENANT,
+    ChipLedger,
+    SloBudgetEngine,
+    aggregate_slo,
+    objectives_from_quota,
+)
 from nos_tpu.obs.tracing import (  # noqa: F401
     FlightRecorder,
     Span,

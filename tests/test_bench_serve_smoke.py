@@ -396,3 +396,39 @@ def test_kv_fabric_section_reruns_byte_identical():
     a = bench_serve.kv_fabric_section(params, cfg)
     b = bench_serve.kv_fabric_section(params, cfg)
     assert json.dumps(a, sort_keys=True) == json.dumps(b, sort_keys=True)
+
+
+def test_slo_accounting_section_headlines_and_reruns():
+    """Tier-1 smoke of the slo_accounting section (ISSUE 20): jax-free
+    and cheap enough to run the twice-run byte pin inline. On the
+    cost-model clock the burst phase must trip gold's fast TTFT window
+    exactly once (the capture interval rate-limits the sustained
+    breach), every (tenant, phase) charge must equal its structural
+    token count x the modeled per-token cost exactly, and the ledger
+    must conserve — and because nothing is measured, two fresh runs
+    serialize byte-identically."""
+    sys.path.insert(0, REPO)
+    import bench_serve
+
+    a = bench_serve.slo_accounting_section()
+    b = bench_serve.slo_accounting_section()
+    assert json.dumps(a, sort_keys=True) == json.dumps(b, sort_keys=True)
+    assert a["attribution_conserved"] is True
+    assert a["attribution_structural"] is True
+    assert a["burst_trips_fast_window_once"] is True
+    [trip_s] = a["trip_at_s"]
+    assert trip_s >= a["steady_s"]
+    rows = {r["objective"]: r for r in a["slo"]
+            if r["tenant"] == "gold"}
+    # the flood spent gold's TTFT budget but left goodput whole:
+    # objectives are judged independently
+    assert rows["ttft_p99"]["trips"] == 1
+    assert rows["ttft_p99"]["budget_remaining_ratio"] == 0.0
+    assert rows["ttft_p99"]["burn_fast"] > a["burn_threshold"]
+    assert rows["goodput"]["trips"] == 0
+    assert rows["goodput"]["budget_remaining_ratio"] == 1.0
+    # idle is explicit, not vanished: the one-second ticks dwarf the
+    # few-ms quanta, so the idle bucket dominates the wall clock
+    assert a["idle_ms"] > sum(
+        ms for t_, phases in a["chip_ms"].items() if t_ != "_idle"
+        for ms in phases.values())

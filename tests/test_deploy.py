@@ -315,6 +315,11 @@ def test_serving_deployment_passes_slo_and_telemetry_args():
     for flag, value in (
         ("--slo-ttft-ms", ".Values.serving.slo.ttftMs"),
         ("--slo-tpot-ms", ".Values.serving.slo.tpotMs"),
+        ("--slo-fast-window-s", ".Values.serving.slo.fastWindowSeconds"),
+        ("--slo-slow-window-s", ".Values.serving.slo.slowWindowSeconds"),
+        ("--slo-burn-threshold", ".Values.serving.slo.burnThreshold"),
+        ("--slo-capture-interval-s",
+         ".Values.serving.slo.captureIntervalSeconds"),
         ("--device-stats-interval",
          ".Values.serving.deviceStatsIntervalSeconds"),
     ):
@@ -322,7 +327,12 @@ def test_serving_deployment_passes_slo_and_telemetry_args():
         assert value in text, f"serving deployment missing {value}"
     with open(os.path.join(CHART, "values.yaml")) as f:
         values = yaml.safe_load(f)
-    assert values["serving"]["slo"] == {"ttftMs": 0, "tpotMs": 0}
+    # error-budget window defaults must match the binary's flag
+    # defaults — drift makes fleet burn rates replica-dependent
+    assert values["serving"]["slo"] == {
+        "ttftMs": 0, "tpotMs": 0, "fastWindowSeconds": 300,
+        "slowWindowSeconds": 3600, "burnThreshold": 14.4,
+        "captureIntervalSeconds": 300}
     assert values["serving"]["deviceStatsIntervalSeconds"] == 10
 
 
@@ -674,6 +684,8 @@ def test_gateway_deployment_passes_routing_and_door_args():
         ("--door-wait", ".Values.gateway.door.waitSeconds"),
         ("--retry-attempts", ".Values.gateway.retry.attempts"),
         ("--retry-backoff", ".Values.gateway.retry.backoffSeconds"),
+        ("--slo-burn-threshold", ".Values.gateway.slo.burnThreshold"),
+        ("--harvest-url", ".Values.gateway.slo.harvestUrl"),
     ]:
         assert flag in text, f"gateway deployment missing {flag}"
         assert value in text, f"gateway deployment missing {value}"
@@ -697,6 +709,7 @@ def test_gateway_deployment_passes_routing_and_door_args():
     assert gw["admission"] == {"pendingPerReplica": 0, "hbmFrac": 0}
     assert gw["door"] == {"maxQueue": 256, "waitSeconds": 30}
     assert gw["retry"] == {"attempts": 12, "backoffSeconds": 0.05}
+    assert gw["slo"] == {"burnThreshold": 14.4, "harvestUrl": ""}
 
 
 def test_tenant_quota_args_plumbed_on_both_binaries():

@@ -921,6 +921,13 @@ def test_gateway_http_proxies_unary_and_sse_over_real_sockets():
             gw + "/stats", timeout=10).read())
         assert snap["fleet"] == "web"
         assert snap["requests"]["completed"] >= 6
+        # /stats drift guard (ISSUE 20 satellite): the wire payload is
+        # exactly the documented key contract
+        from test_metrics_docs import GATEWAY_STATS_KEYS
+        assert set(snap) == GATEWAY_STATS_KEYS, (
+            f"gateway /stats drifted from the documented contract: "
+            f"extra {sorted(set(snap) - GATEWAY_STATS_KEYS)}, missing "
+            f"{sorted(GATEWAY_STATS_KEYS - set(snap))}")
         # gateway /metrics exports the nos_tpu_gateway_* family
         metrics = urllib.request.urlopen(
             gw + "/metrics", timeout=10).read().decode()

@@ -893,6 +893,12 @@ def test_serving_http_stats_uptime_and_config_echo():
             url + "/stats", timeout=10).read())
         assert snap["config"] == echo
         assert snap["uptime_s"] >= 0
+        # /stats drift guard (ISSUE 20 satellite): every top-level key
+        # on the wire must be in the documented contract
+        from test_metrics_docs import REPLICA_STATS_KEYS
+        assert set(snap) <= REPLICA_STATS_KEYS, (
+            f"undocumented /stats keys: "
+            f"{sorted(set(snap) - REPLICA_STATS_KEYS)}")
         # per-request percentiles start empty, fill on completion (the
         # fleet controller's TTFT-p99 trigger reads this key)
         assert snap["per_request"] == {"window": 0, "ttft_p99_s": None}

@@ -142,9 +142,14 @@ def test_metric_names_follow_prometheus_conventions():
             continue
         for unit in ("seconds", "bytes"):
             if f"_{unit}" in name:
-                assert name.endswith(f"_{unit}"), (
+                # counters accumulating a unit quantity end
+                # _<unit>_total (process_cpu_seconds_total-style)
+                ok = name.endswith(f"_{unit}") or (
+                    kind == "counter"
+                    and name.endswith(f"_{unit}_total"))
+                assert ok, (
                     f"{where} — '{unit}' must be the terminal unit "
-                    f"suffix")
+                    f"suffix (before _total on counters)")
 
 
 # ---------------------------------------------------------------------------
@@ -164,12 +169,6 @@ SPAN_SITE = re.compile(
 # code literal matches a doc name either exactly or as the prefix left
 # of the placeholder
 DOC_SPAN = re.compile(r"`([a-z][a-z0-9_.<>]*)`")
-
-# pre-taxonomy chaos-harness phase spans: named for the MTTR phase they
-# time inside a lifecycle.repair episode, grandfathered as the CLOSED
-# exception to dotted component.verb naming
-UNDOTTED_SPANS = {"detect", "rebind"}
-
 
 def minted_span_names():
     sites = []
@@ -218,8 +217,6 @@ def test_every_minted_span_is_documented():
 
 def test_span_names_are_dotted_component_verb():
     for path, name in minted_span_names():
-        if name in UNDOTTED_SPANS:
-            continue
         where = f"{os.path.relpath(path, REPO)}: span {name!r}"
         if name.endswith("."):
             # a prefix literal ("tick." + phase) mints a dotted family;
@@ -231,5 +228,67 @@ def test_span_names_are_dotted_component_verb():
         assert re.fullmatch(
             r"[a-z][a-z0-9_]*\.[a-z][a-z0-9_]*", name), (
             f"{where} — span names are dotted component.verb "
-            f"(lowercase snake segments); undotted legacy names live "
-            f"in UNDOTTED_SPANS only by explicit exception")
+            f"(lowercase snake segments)")
+
+
+# ---------------------------------------------------------------------------
+# /stats drift guard (ISSUE 20 satellite): the snapshot's TOP-LEVEL
+# keys are a wire contract — the fleet controller, the gateway scrape
+# and the KV fabric all consume them. The literal sets here are the
+# authoritative lists; docs/telemetry.md's tables must match them
+# exactly, and the HTTP integration tests check real payloads against
+# these sets (so a key added in code without a doc row fails there).
+# ---------------------------------------------------------------------------
+
+REPLICA_STATS_KEYS = {
+    "engine", "role", "handoff", "max_batch", "max_len", "slots",
+    "active_slots", "pending", "prefill_sched", "pipeline",
+    "prefix_cache", "prefix_index", "kv", "tenants", "compiles",
+    "tokens_emitted", "healthy", "draining", "recovering", "uptime_s",
+    "config", "per_request", "supervisor", "deadline", "slo", "rates",
+    "kv_fabric_pulls", "tick_phases", "slo_budget", "chip_ledger",
+}
+
+GATEWAY_STATS_KEYS = {
+    "door_queue", "door_queue_peak", "replicas", "ready_replicas",
+    "handoffs", "requests", "shed", "tenant_shed", "routes", "retries",
+    "ring", "kv_fabric", "slo", "config", "fleet",
+}
+
+STATS_KEY = re.compile(r"`([a-z_0-9]+)`")
+
+
+def documented_stats_keys(which):
+    keys = set()
+    in_table = False
+    with open(os.path.join(REPO, "docs", "telemetry.md")) as f:
+        for line in f:
+            if line.startswith(f"| {which} `/stats` key |"):
+                in_table = True
+                continue
+            if in_table and not line.strip().startswith("|"):
+                in_table = False
+            if in_table:
+                first_cell = line.split("|")[1]
+                keys.update(STATS_KEY.findall(first_cell))
+    return keys
+
+
+def test_replica_stats_keys_match_docs():
+    doc = documented_stats_keys("Replica")
+    assert doc, "telemetry.md replica /stats table must not be empty"
+    assert doc == REPLICA_STATS_KEYS, (
+        f"replica /stats keys drifted — docs-only: "
+        f"{sorted(doc - REPLICA_STATS_KEYS)}, undocumented: "
+        f"{sorted(REPLICA_STATS_KEYS - doc)}; update the "
+        f"docs/telemetry.md table AND this set together")
+
+
+def test_gateway_stats_keys_match_docs():
+    doc = documented_stats_keys("Gateway")
+    assert doc, "telemetry.md gateway /stats table must not be empty"
+    assert doc == GATEWAY_STATS_KEYS, (
+        f"gateway /stats keys drifted — docs-only: "
+        f"{sorted(doc - GATEWAY_STATS_KEYS)}, undocumented: "
+        f"{sorted(GATEWAY_STATS_KEYS - doc)}; update the "
+        f"docs/telemetry.md table AND this set together")

@@ -1,0 +1,500 @@
+"""Per-tenant chip-second attribution + SLO error budgets (ISSUE 20):
+the ledger's exact conservation invariant (fuzzed through preempt/
+resume, tenant reclaim, handoff adopt and supervised engine swaps),
+the burn-rate windows on an injectable clock, breach-triggered trace
+capture with its rate limit, and the gateway's fleet roll-up served at
+``GET /v1/slo`` over real sockets."""
+import json
+import random
+import threading
+import urllib.request
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from nos_tpu.models import transformer as tfm
+from nos_tpu.models.serving import DecodeServer
+from nos_tpu.models.supervision import FaultInjector
+from nos_tpu.models.tenantquota import (
+    TenantQuotaConfig, TenantSloSpec, TenantSpec,
+)
+from nos_tpu.obs import tracing
+from nos_tpu.obs.slo import (
+    IDLE_TENANT, ChipLedger, SloBudgetEngine, aggregate_slo,
+    objectives_from_quota,
+)
+from test_serving_chaos import StubEngine
+from test_trace_stitching import fresh_recorder
+
+from nos_tpu.cmd.server import ServingLoop
+
+CFG = tfm.TransformerConfig(vocab=64, d_model=32, n_layers=2, n_heads=4,
+                            n_kv_heads=2, d_ff=64, max_seq=64,
+                            dtype=jnp.float32)
+
+
+@pytest.fixture(scope="module")
+def params():
+    return tfm.init_params(jax.random.PRNGKey(0), CFG)
+
+
+def slo_quota(gold_slo=None, burst_slo=None, gold_min=100.0):
+    return TenantQuotaConfig(
+        tenants={
+            "gold": TenantSpec("gold", min_rate=gold_min, slo=gold_slo),
+            "burst": TenantSpec("burst", max_rate=50.0, slo=burst_slo),
+        }, window_s=8.0)
+
+
+GOLD_SLO = TenantSloSpec(ttft_p99_ms=500.0, tpot_p99_ms=50.0,
+                         goodput_floor=0.95)
+
+
+def paged_engine(params, tq, clock, **kw):
+    kw.setdefault("max_batch", 2)
+    kw.setdefault("max_len", 64)
+    kw.setdefault("kv_block_size", 8)
+    kw.setdefault("kv_blocks", 17)
+    return DecodeServer(params, CFG, tenant_quota=tq,
+                        tenant_clock=lambda: clock[0], **kw)
+
+
+# ---------------------------------------------------------------------------
+# ChipLedger: the exact-split cost model
+# ---------------------------------------------------------------------------
+
+def test_chip_ledger_split_is_exact_with_residual_and_idle_gap():
+    """One second split 1:2 across two buckets: floored proportional
+    shares with the residual nanosecond on the LAST sorted bucket, a
+    gap before the quantum charged to the explicit idle tenant, and a
+    weightless quantum landing entirely in idle."""
+    led = ChipLedger()
+    led.note_quantum(0.0, 1.0, {("a", "decode"): 1, ("b", "decode"): 2})
+    t = led.totals_ns()
+    assert t[("a", "decode")] == 333_333_333
+    assert t[("b", "decode")] == 666_666_667     # takes the residual
+    assert led.conserved() and led.wall_ns == 1_000_000_000
+    # 0.5 s gap, then a quantum that moved nothing: both are idle
+    led.note_quantum(1.5, 1.75, None)
+    t = led.totals_ns()
+    assert t[(IDLE_TENANT, "idle")] == 750_000_000
+    assert led.conserved() and led.wall_ns == 1_750_000_000
+
+
+def test_chip_ledger_conservation_fuzz():
+    """Seeded fuzz over arbitrary quantum sequences — overlapping
+    timestamps, zero-length quanta, weight maps of every shape — the
+    invariant sum(charges) == wall holds EXACTLY after every call.
+    This is the structural form of the preempt/reclaim/adopt/swap
+    guarantee: those paths only vary WHICH weights appear, never the
+    arithmetic."""
+    rng = random.Random(20)
+    tenants = ["gold", "burst", "free"]
+    led = ChipLedger()
+    t = 0.0
+    for _ in range(500):
+        t0 = t + rng.random() * 0.01 * rng.choice([0, 1, 1])
+        t1 = t0 + rng.random() * 0.005 * rng.choice([0, 1, 1, 1])
+        work = {}
+        for tenant in rng.sample(tenants, rng.randint(0, 3)):
+            work[(tenant, rng.choice(["decode", "prefill"]))] = \
+                rng.randint(0, 7)
+        kv = {tenant: rng.randint(0, 4096) for tenant in tenants
+              if rng.random() < 0.5}
+        led.note_quantum(t0, t1, work or None, kv or None)
+        assert led.conserved(), (t0, t1, work)
+        t = max(t, t1)
+    assert led.wall_ns > 0
+    snap = led.snapshot()
+    assert snap["conserved"]
+    assert set(snap["chip_ms"]) <= set(tenants) | {IDLE_TENANT}
+
+
+def test_chip_ledger_kv_byte_seconds_accrue_over_full_span():
+    """Residency persists through the gap BETWEEN quanta: 1024 bytes
+    across a quantum whose span (gap + work) is 2 s accrues 2048
+    byte-seconds, clock-injectable and exact."""
+    led = ChipLedger()
+    led.note_quantum(0.0, 1.0, {("gold", "decode"): 1},
+                     {"gold": 1024})
+    led.note_quantum(2.0, 3.0, {("gold", "decode"): 1},
+                     {"gold": 1024})
+    assert led.kv_byte_seconds() == {"gold": 1024.0 * 3.0}
+    assert led.conserved()
+
+
+# ---------------------------------------------------------------------------
+# SloBudgetEngine: burn-rate windows on an injectable clock
+# ---------------------------------------------------------------------------
+
+def test_objectives_from_quota_maps_targets_to_allowances():
+    quota = slo_quota(gold_slo=GOLD_SLO,
+                      burst_slo=TenantSloSpec(goodput_floor=0.9))
+    objs = objectives_from_quota(quota)
+    assert objs == {
+        "gold": {"ttft_p99": 0.01, "tpot_p99": 0.01, "goodput": 0.05},
+        "burst": {"goodput": 0.1},
+    }
+    assert objectives_from_quota(slo_quota()) == {}
+    assert not slo_quota().slo_enabled()
+    assert slo_quota(gold_slo=GOLD_SLO).slo_enabled()
+
+
+def test_burn_trip_needs_min_events_and_respects_rate_limit():
+    eng = SloBudgetEngine({"gold": {"goodput": 0.05}},
+                          fast_window_s=300.0, slow_window_s=3600.0,
+                          burn_threshold=14.4,
+                          capture_interval_s=300.0, min_events=4)
+    now = 100.0
+    # three bad events: burn is 20x allowed but min_events gates
+    for i in range(3):
+        assert eng.note("gold", "goodput", True, now + i) is False
+    assert eng.note("gold", "goodput", True, now + 3) is True
+    # sustained breach inside the capture interval: NO second trip
+    for i in range(4, 10):
+        assert eng.note("gold", "goodput", True, now + i) is False
+    assert eng.trips == {("gold", "goodput"): 1}
+    # past the interval the next bad event may trip again
+    assert eng.note("gold", "goodput", True, now + 304) is True
+    assert eng.trips[("gold", "goodput")] == 2
+    # unconfigured (tenant, objective) pairs never trip
+    assert eng.note("burst", "goodput", True, now) is False
+    assert eng.note("gold", "ttft_p99", True, now) is False
+
+
+def test_burn_windows_roll_over_and_budget_recovers():
+    eng = SloBudgetEngine({"gold": {"goodput": 0.5}},
+                          fast_window_s=10.0, slow_window_s=100.0,
+                          min_events=1)
+    for i in range(4):
+        eng.note("gold", "goodput", i % 2 == 0, float(i))
+    [row] = eng.rows(4.0)
+    assert row["windows"]["fast"] == {"total": 4, "bad": 2}
+    assert row["burn_fast"] == 1.0          # 0.5 bad / 0.5 allowed
+    assert row["budget_remaining_ratio"] == 0.0
+    # 20 s later the fast window is empty, slow still holds the events
+    [row] = eng.rows(24.0)
+    assert row["windows"]["fast"] == {"total": 0, "bad": 0}
+    assert row["burn_fast"] == 0.0
+    assert row["windows"]["slow"] == {"total": 4, "bad": 2}
+    # 200 s later the slow window has rolled too: budget restored
+    [row] = eng.rows(204.0)
+    assert row["windows"]["slow"] == {"total": 0, "bad": 0}
+    assert row["budget_remaining_ratio"] == 1.0
+
+
+def test_aggregate_slo_sums_window_counts_not_ratios():
+    """Fleet burn comes from SUMMED counts: one replica at 100% bad
+    over 2 events plus one at 0% over 8 is a 20% fleet bad fraction —
+    not the 50% a ratio average would claim."""
+    def block(total, bad):
+        return {"objectives": [{
+            "tenant": "gold", "objective": "goodput", "allowed": 0.1,
+            "windows": {"fast": {"total": total, "bad": bad},
+                        "slow": {"total": total, "bad": bad}},
+            "trips": 1,
+        }]}
+    [row] = aggregate_slo([block(2, 2), block(8, 0)],
+                          burn_threshold=14.4)
+    assert row["windows"]["fast"] == {"total": 10, "bad": 2}
+    assert row["burn_fast"] == 2.0          # 0.2 / 0.1
+    assert row["replicas"] == 2 and row["trips"] == 2
+    assert row["budget_remaining_ratio"] == 0.0
+    assert row["breaching"] is False
+    [hot] = aggregate_slo([block(5, 5)], burn_threshold=9.0)
+    assert hot["breaching"] is True
+    assert aggregate_slo([]) == []
+
+
+# ---------------------------------------------------------------------------
+# engine-level attribution on the real model
+# ---------------------------------------------------------------------------
+
+def test_engine_attribution_conserves_through_reclaim_and_preempt(
+        params):
+    """The real paged engine under tenant reclaim: burst fills the
+    slots, a gold arrival preempts one through the quota machinery,
+    everything completes — and every wall nanosecond the ledger saw is
+    attributed (decode + prefill charges per tenant, idle for the
+    rest), with KV byte-seconds accrued for both tenants."""
+    clock = [0.0]
+    eng = paged_engine(params, slo_quota(gold_slo=GOLD_SLO), clock,
+                       kv_swap=True)
+    assert eng.chip is not None             # slo config turns it on
+    b1 = eng.submit([1, 2, 3], 8, tenant="burst")
+    b2 = eng.submit([4, 5, 6], 8, tenant="burst")
+    eng.step()
+    clock[0] += 0.1
+    g = eng.submit([7, 8], 6, tenant="gold")
+    assert eng.tenant_reclaims == 1 and eng.preempts["swap"] == 1
+    while eng.has_work():
+        eng.step()
+        clock[0] += 0.1
+    out = eng.drain()
+    assert set(out) == {b1, b2, g}
+    assert eng.chip.conserved()
+    snap = eng.chip.snapshot()
+    assert snap["conserved"] and snap["wall_ms"] > 0
+    for tenant in ("gold", "burst"):
+        assert snap["chip_ms"][tenant]["decode"] > 0
+        assert snap["chip_ms"][tenant]["prefill"] > 0
+        assert snap["kv_byte_seconds"][tenant] > 0
+
+
+def test_engine_attribution_off_without_slo_config(params):
+    """A tenant config with NO slo blocks means chip is None — the
+    charge paths and the per-quantum note are no-ops (zero new
+    per-tick work), and /stats carries no ledger."""
+    clock = [0.0]
+    eng = paged_engine(params, slo_quota(), clock)
+    assert eng.chip is None
+    rid = eng.submit([1, 2], 4, tenant="gold")
+    out = eng.drain()
+    assert out[rid]
+
+
+def test_handoff_adopt_charges_decode_to_served_tenant(params):
+    """Disaggregation: the prefill engine charges the tenant's prefill
+    tokens, the decode engine adopting the handed-off KV charges the
+    SAME tenant's decode tokens — both ledgers conserve
+    independently."""
+    kw = dict(max_batch=2, max_len=64, kv_block_size=8, kv_blocks=17,
+              kv_swap=True)
+    tq = slo_quota(gold_slo=GOLD_SLO)
+    clock = [0.0]
+    pre = DecodeServer(params, CFG, role="prefill", tenant_quota=tq,
+                       tenant_clock=lambda: clock[0], **kw)
+    dec = DecodeServer(params, CFG, role="decode", tenant_quota=tq,
+                       tenant_clock=lambda: clock[0], **kw)
+    pre.submit([1, 2, 3, 4], 5, tenant="gold")
+    # admission charges accrue into the pending work map and drain at
+    # the next quantum note — step once even if the handoff already
+    # retired the request (the serving loop notes every quantum)
+    pre.step()
+    while pre.has_work():
+        pre.step()
+    [st] = pre.pop_handoffs()
+    assert st["tenant"] == "gold"
+    drid = dec.restore(st)
+    out = dec.drain()
+    assert len(out[drid]) == 4 + 5
+    assert pre.chip.conserved() and dec.chip.conserved()
+    assert pre.chip.snapshot()["chip_ms"]["gold"]["prefill"] > 0
+    assert dec.chip.snapshot()["chip_ms"]["gold"]["decode"] > 0
+
+
+# ---------------------------------------------------------------------------
+# serving-loop: mirrors, swaps, breach capture
+# ---------------------------------------------------------------------------
+
+class ChipStub(StubEngine):
+    """StubEngine + a real ChipLedger fed through the loop's
+    ``chip_note_quantum`` seam, charging emitted tokens to one
+    tenant — enough to exercise the loop's delta-mirror across
+    supervised engine swaps without device work."""
+
+    def __init__(self, tenant="gold", **kw):
+        super().__init__(**kw)
+        self.chip = ChipLedger()
+        self._chip_pending = 0
+        self._chip_tenant = tenant
+
+    def step_finish(self, handle):
+        emitted = super().step_finish(handle)
+        self._chip_pending += emitted
+        return emitted
+
+    def chip_note_quantum(self, t0, t1):
+        work, self._chip_pending = (
+            {(self._chip_tenant, "decode"): self._chip_pending}
+            if self._chip_pending else None), 0
+        self.chip.note_quantum(t0, t1, work, None)
+
+
+def test_loop_unconfigured_slo_is_off():
+    """No tenant config, or a tenant config without slo blocks: the
+    budget engine does not exist and /stats pins the mode with
+    explicit nulls."""
+    for tq in (None, slo_quota()):
+        loop = ServingLoop(StubEngine(), tenant_quota=tq)
+        try:
+            assert loop.slo_engine is None
+            snap = loop.stats()
+            assert snap["slo_budget"] is None
+            assert snap["chip_ledger"] is None
+        finally:
+            loop.shutdown()
+
+
+def test_loop_chip_mirror_conserves_across_supervised_restart():
+    """The PR 13 delta-mirror pattern: a supervised engine swap births
+    a fresh zeroed ledger; the loop's cumulative totals keep the dead
+    engine's charges and stay conserved."""
+    inj = FaultInjector(schedule={3: "error"})
+    loop = ServingLoop(
+        inj.wrap(ChipStub()),
+        engine_factory=lambda: inj.wrap(ChipStub()),
+        restart_backoff_s=0.01, restart_budget=2,
+        tenant_quota=slo_quota(gold_slo=GOLD_SLO))
+    try:
+        assert loop.generate([5], 10, tenant="gold", timeout=30) \
+            == [5] + list(range(1, 11))
+        assert loop._sup.restarts == 1
+        block = loop.stats()["chip_ledger"]
+        assert block["conserved"]
+        assert block["wall_ms"] > 0
+        assert block["chip_ms"]["gold"]["decode"] > 0
+        # the live engine's own ledger restarted from zero: strictly
+        # less charge than the cumulative view that spans the swap
+        live = loop.engine.chip.totals_ns().get(("gold", "decode"), 0)
+        assert 0 < live < loop._chip_cum_ns[("gold", "decode")]
+    finally:
+        loop.shutdown()
+
+
+def test_loop_breach_pins_stitched_trace_exactly_once():
+    """A fast-window burn trip mints the slo.breach span under the
+    breaching request and pins its trace (why=slo_burn) — then the
+    capture interval holds further trips, so a SUSTAINED breach pins
+    exactly one trace."""
+    quota = slo_quota(gold_slo=TenantSloSpec(ttft_p99_ms=0.0001))
+    loop = ServingLoop(
+        StubEngine(), tenant_quota=quota,
+        slo_min_events=1, slo_capture_interval_s=1e9)
+    try:
+        with fresh_recorder() as rec:
+            for i in range(3):
+                loop.generate([10 + i], 3, tenant="gold", timeout=30)
+            pins = {tid: why for tid, why in rec.pinned().items()
+                    if why == "slo_burn"}
+            assert len(pins) == 1, rec.pinned()
+            [tid] = pins
+            spans = {sp.name: sp for sp in rec.trace(tid)}
+            assert "serve.request" in spans
+            breach = spans["slo.breach"]
+            assert breach.attrs["tenant"] == "gold"
+            assert breach.attrs["objective"] == "ttft_p99"
+            assert breach.parent_id == spans["serve.request"].span_id
+        assert loop.slo_engine.trips == {("gold", "ttft_p99"): 1}
+        snap = loop.stats()["slo_budget"]
+        [row] = [r for r in snap["objectives"]
+                 if r["objective"] == "ttft_p99"]
+        assert row["windows"]["fast"]["bad"] == 3
+        assert row["trips"] == 1
+    finally:
+        loop.shutdown()
+
+
+def test_slo_flags_reach_server_config():
+    """No dead knobs: every serving.slo.* helm value lands in the
+    ServerConfig main() builds the loop from, and the chart defaults
+    match the binary's (the test_deploy.py values pin is the other
+    half of this contract)."""
+    from nos_tpu.cmd import server as server_mod
+    from nos_tpu.cmd.server import ServerConfig
+
+    seen = {}
+
+    def fake_build(cfg):
+        seen["cfg"] = cfg
+        raise SystemExit(0)          # stop before the serving loop
+
+    real = server_mod.build_engine
+    server_mod.build_engine = fake_build
+    try:
+        with pytest.raises(SystemExit):
+            server_mod.main(["--slo-fast-window-s", "60",
+                             "--slo-slow-window-s", "600",
+                             "--slo-burn-threshold", "6.0",
+                             "--slo-capture-interval-s", "30"])
+    finally:
+        server_mod.build_engine = real
+    cfg = seen["cfg"]
+    assert cfg.slo_fast_window_s == 60.0
+    assert cfg.slo_slow_window_s == 600.0
+    assert cfg.slo_burn_threshold == 6.0
+    assert cfg.slo_capture_interval_s == 30.0
+    dflt = ServerConfig()
+    assert dflt.slo_fast_window_s == 300.0
+    assert dflt.slo_slow_window_s == 3600.0
+    assert dflt.slo_burn_threshold == 14.4
+    assert dflt.slo_capture_interval_s == 300.0
+
+
+# ---------------------------------------------------------------------------
+# gateway: GET /v1/slo over real sockets, >= 2 replicas
+# ---------------------------------------------------------------------------
+
+def test_gateway_v1_slo_aggregates_two_replicas_over_http():
+    from nos_tpu.cmd.gateway import make_http_server as make_gw_server
+    from nos_tpu.cmd.server import ServerConfig, make_http_server
+    from nos_tpu.gateway.router import (
+        GatewayRouter, Replica, RouterConfig,
+    )
+
+    quota = slo_quota(gold_slo=TenantSloSpec(goodput_floor=0.9))
+    loops, backends = {}, {}
+    for name in ("r0", "r1"):
+        lp = ServingLoop(StubEngine(tokens_per_tick=4),
+                         tenant_quota=quota)
+        httpd = make_http_server(ServerConfig(port=0), lp)
+        threading.Thread(target=httpd.serve_forever,
+                         daemon=True).start()
+        loops[name] = lp
+        backends[name] = (
+            httpd, f"http://127.0.0.1:{httpd.server_address[1]}")
+
+    router = GatewayRouter(RouterConfig(slo_burn_threshold=2.0))
+    router.harvest_source = lambda: {"harvested_chip_seconds": 7.2}
+    gw_httpd = make_gw_server(router, 0, "web")
+    threading.Thread(target=gw_httpd.serve_forever, daemon=True).start()
+    gw = f"http://127.0.0.1:{gw_httpd.server_address[1]}"
+    try:
+        # three finished gold requests per replica -> goodput window
+        # counts on each replica's own budget engine
+        for lp in loops.values():
+            for i in range(3):
+                lp.generate([i], 2, tenant="gold", timeout=30)
+        replicas = []
+        for name, (_h, url) in sorted(backends.items()):
+            snap = json.loads(urllib.request.urlopen(
+                url + "/stats", timeout=10).read())
+            assert snap["slo_budget"] is not None
+            replicas.append(Replica(name=name, handle=url, stats=snap))
+        router.update(replicas)
+
+        body = json.loads(urllib.request.urlopen(
+            gw + "/v1/slo", timeout=10).read())
+        assert body["fleet"] == "web"
+        assert body["burn_threshold"] == 2.0
+        [row] = body["objectives"]
+        assert (row["tenant"], row["objective"]) == ("gold", "goodput")
+        assert row["replicas"] == 2
+        assert row["windows"]["slow"] == {"total": 6, "bad": 0}
+        assert row["budget_remaining_ratio"] == 1.0
+        assert row["breaching"] is False
+        uw = body["useful_work"]
+        assert uw["harvested_chip_s"] == 7.2
+        assert uw["ledger_replicas"] == 2
+        # the gateway mirrors the aggregated rows into its gauges
+        from nos_tpu.utils.metrics import default_registry
+        reg = default_registry()
+        assert reg.gauge(
+            "nos_tpu_gateway_slo_budget_remaining_ratio", "",
+            ("tenant", "objective")).value("gold", "goodput") == 1.0
+        assert reg.gauge(
+            "nos_tpu_gateway_slo_burn_rate", "",
+            ("tenant", "objective", "window")).value(
+            "gold", "goodput", "slow") == 0.0
+        # /stats carries the same roll-up under the documented key
+        snap = json.loads(urllib.request.urlopen(
+            gw + "/stats", timeout=10).read())
+        assert snap["slo"]["objectives"] == body["objectives"]
+        assert snap["config"]["slo_burn_threshold"] == 2.0
+    finally:
+        gw_httpd.shutdown()
+        for httpd, _url in backends.values():
+            httpd.shutdown()
+        for lp in loops.values():
+            lp.shutdown()

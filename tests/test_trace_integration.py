@@ -222,7 +222,7 @@ def test_chaos_episode_traces_have_named_phases():
             continue
         names = {sp.name for sp in rec.trace(ph["trace_id"])}
         assert "lifecycle.repair" in names
-        assert "rebind" in names
+        assert "chaos.rebind" in names
         # phases must account for the MTTR they decompose: detect+rebind
         # span injection->fence and fence->repair back to back
         if ph["detect_s"] is not None and ph["rebind_s"] is not None:
